@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: one scheduling tick at BASELINE config-3 scale
+(patch-build burst: 200 distros, 50k tasks, task groups + single-host
+constraints) on the batched TPU solve vs the serial reference-equivalent
+path (the stand-in for the reference's serial per-distro Go loop, see
+BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+where vs_baseline is the speedup factor (serial ms / tpu ms).
+"""
+import json
+import statistics
+import sys
+import time
+
+from evergreen_tpu.ops.solve import run_solve
+from evergreen_tpu.scheduler import serial
+from evergreen_tpu.scheduler.snapshot import build_snapshot
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+N_DISTROS = 200
+N_TASKS = 50_000
+TICKS = 5
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    distros, tasks_by_distro, hosts_by_distro, estimates, deps_met = (
+        generate_problem(
+            N_DISTROS,
+            N_TASKS,
+            seed=3,
+            task_group_fraction=0.25,
+            patch_fraction=0.6,
+            hosts_per_distro=25,
+        )
+    )
+    gen_s = time.perf_counter() - t0
+
+    # --- TPU path: snapshot + batched solve ------------------------------- #
+    # warmup (compile)
+    snap = build_snapshot(
+        distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
+    )
+    run_solve(snap.arrays)
+
+    tick_ms = []
+    snap_ms = []
+    solve_ms = []
+    for _ in range(TICKS):
+        t1 = time.perf_counter()
+        snap = build_snapshot(
+            distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
+        )
+        t2 = time.perf_counter()
+        run_solve(snap.arrays)
+        t3 = time.perf_counter()
+        snap_ms.append((t2 - t1) * 1e3)
+        solve_ms.append((t3 - t2) * 1e3)
+        tick_ms.append((t3 - t1) * 1e3)
+
+    tpu_ms = statistics.median(tick_ms)
+
+    # --- serial baseline (reference-equivalent loop over distros) ---------- #
+    t4 = time.perf_counter()
+    serial.serial_tick(
+        distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
+    )
+    serial_ms = (time.perf_counter() - t4) * 1e3
+
+    result = {
+        "metric": "sched_tick_50k_tasks_200_distros",
+        "value": round(tpu_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(serial_ms / tpu_ms, 2),
+    }
+    print(json.dumps(result))
+    print(
+        f"# snapshot={statistics.median(snap_ms):.1f}ms "
+        f"solve={statistics.median(solve_ms):.1f}ms "
+        f"serial_baseline={serial_ms:.1f}ms gen={gen_s:.1f}s "
+        f"target=<500ms",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""evergreen_tpu — a TPU-native continuous-integration platform.
+
+A ground-up rebuild of the capabilities of Evergreen (MongoDB's CI system,
+reference at /root/reference) with the scheduling plane redesigned for TPU:
+instead of a serial Go loop planning ~200 distros one at a time every 15s
+(reference units/crons_remote_fifteen_second.go:48-55), each tick snapshots
+(runnable tasks × distros × hosts) into padded device arrays and runs ONE
+batched JAX solve producing every distro's ordered task queue and host-spawn
+count in a single fused program.
+
+Layout:
+  models/     domain documents (task, host, distro, build, version, …)
+  storage/    pluggable document store (in-memory engine, atomic CAS)
+  ops/        jittable JAX kernels: batched planner + host allocator
+  parallel/   device mesh + sharding specs for the batched solve
+  scheduler/  snapshot builder, serial reference oracle, tick driver
+  dispatch/   DAG dispatcher (server-side task handout)
+  agent/      worker runtime (task execution on hosts)
+  cloud/      cloud-provider managers (mock, docker, ec2-fleet-shaped)
+  ingestion/  project YAML parser, versions/builds, patches, generate.tasks
+  queue/      background job plane (amboy-equivalent)
+  events/     event log → trigger → notification pipeline
+  api/        REST surfaces (agent protocol first)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,247 @@
+"""Global constants for the evergreen_tpu framework.
+
+Mirrors the semantics of the reference's top-level ``globals.go`` constants
+(reference: globals.go:185,264,267,301-304) without copying its structure:
+only the constants the scheduling/dispatch/agent planes consume are defined,
+and numeric scheduling constants also exist as entries in the device-side
+settings matrix (see evergreen_tpu/scheduler/snapshot.py).
+"""
+from __future__ import annotations
+
+import enum
+
+# --------------------------------------------------------------------------- #
+# Task statuses (reference: globals.go task status block + apimodels)
+# --------------------------------------------------------------------------- #
+
+
+class TaskStatus(str, enum.Enum):
+    UNDISPATCHED = "undispatched"
+    DISPATCHED = "dispatched"
+    STARTED = "started"
+    SUCCEEDED = "success"
+    FAILED = "failed"
+    ABORTED = "aborted"
+    INACTIVE = "inactive"
+    # Display-only statuses derived from failure details:
+    SYSTEM_FAILED = "system-failed"
+    SETUP_FAILED = "setup-failed"
+    TIMED_OUT = "task-timed-out"
+    BLOCKED = "blocked"
+    WILL_RUN = "will-run"
+
+
+#: Statuses in which a task has finished running.
+TASK_COMPLETED_STATUSES = frozenset(
+    {TaskStatus.SUCCEEDED.value, TaskStatus.FAILED.value}
+)
+
+#: Statuses in which a task occupies (or is about to occupy) a host.
+TASK_IN_PROGRESS_STATUSES = frozenset(
+    {TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value}
+)
+
+
+class HostStatus(str, enum.Enum):
+    """Host lifecycle states (reference: model/host state machine, host.go)."""
+
+    UNINITIALIZED = "initializing"  # intent host, not yet materialized
+    BUILDING = "building"
+    BUILDING_FAILED = "building-failed"
+    STARTING = "starting"
+    PROVISIONING = "provisioning"
+    PROVISION_FAILED = "provision failed"
+    RUNNING = "running"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    QUARANTINED = "quarantined"
+    DECOMMISSIONED = "decommissioned"
+    TERMINATED = "terminated"
+
+
+#: States counted as "active" capacity by the allocator
+#: (reference: model/host/host.go AllActiveHosts / IsActive).
+HOST_ACTIVE_STATUSES = frozenset(
+    {
+        HostStatus.UNINITIALIZED.value,
+        HostStatus.BUILDING.value,
+        HostStatus.STARTING.value,
+        HostStatus.PROVISIONING.value,
+        HostStatus.RUNNING.value,
+    }
+)
+
+HOST_UP_STATUSES = frozenset(
+    {
+        HostStatus.RUNNING.value,
+        HostStatus.STARTING.value,
+        HostStatus.PROVISIONING.value,
+    }
+)
+
+
+class BuildStatus(str, enum.Enum):
+    CREATED = "created"
+    STARTED = "started"
+    SUCCEEDED = "success"
+    FAILED = "failed"
+
+
+class VersionStatus(str, enum.Enum):
+    CREATED = "created"
+    STARTED = "started"
+    SUCCEEDED = "success"
+    FAILED = "failed"
+
+
+class PatchStatus(str, enum.Enum):
+    CREATED = "created"
+    STARTED = "started"
+    SUCCEEDED = "success"
+    FAILED = "failed"
+
+
+# --------------------------------------------------------------------------- #
+# Requesters (reference: globals.go requester constants)
+# --------------------------------------------------------------------------- #
+
+
+class Requester(str, enum.Enum):
+    REPOTRACKER = "gitter_request"  # mainline commit builds
+    PATCH = "patch_request"  # CLI patches
+    GITHUB_PR = "github_pull_request"
+    GITHUB_MERGE = "github_merge_request"  # merge queue
+    AD_HOC = "ad_hoc"  # periodic builds
+    TRIGGER = "trigger_request"  # downstream project triggers
+
+
+PATCH_REQUESTERS = frozenset(
+    {Requester.PATCH.value, Requester.GITHUB_PR.value, Requester.GITHUB_MERGE.value}
+)
+
+
+def is_patch_requester(requester: str) -> bool:
+    return requester in PATCH_REQUESTERS
+
+
+def is_github_merge_queue_requester(requester: str) -> bool:
+    return requester == Requester.GITHUB_MERGE.value
+
+
+def is_mainline_requester(requester: str) -> bool:
+    return requester in (Requester.REPOTRACKER.value, Requester.AD_HOC.value,
+                         Requester.TRIGGER.value)
+
+
+# --------------------------------------------------------------------------- #
+# Task activators (reference: globals.go activator constants)
+# --------------------------------------------------------------------------- #
+
+STEPBACK_TASK_ACTIVATOR = "stepback-activator"
+API_TASK_ACTIVATOR = "apiv2-task-activator"
+GENERATE_TASKS_ACTIVATOR = "generate-tasks-activator"
+
+# --------------------------------------------------------------------------- #
+# Scheduling constants (reference: globals.go:185,267; units/host_allocator.go:35)
+# --------------------------------------------------------------------------- #
+
+#: Target queue turnaround per host in seconds (reference 30min,
+#: globals.go:267 MaxDurationPerDistroHost).
+MAX_DURATION_PER_DISTRO_HOST_S = 30 * 60
+
+#: Maximum user-settable task priority (reference globals.go:185).
+MAX_TASK_PRIORITY = 100
+
+#: Priority value used to disable a task (reference: priority < 0 semantics).
+DISABLED_TASK_PRIORITY = -1
+
+#: Global cap on in-flight intent hosts (reference units/host_allocator.go:35).
+MAX_INTENT_HOSTS_IN_FLIGHT = 5000
+
+#: Tasks stale in the queue longer than this get unscheduled
+#: (reference: task.UnscheduleStaleUnderwaterHostTasks, one week).
+UNDERWATER_UNSCHEDULE_THRESHOLD_S = 7 * 24 * 3600
+
+#: Alert threshold for estimated makespan at max hosts
+#: (reference scheduler/wrapper.go:22, 24h).
+DYNAMIC_DISTRO_RUNTIME_ALERT_THRESHOLD_S = 24 * 3600
+
+#: generate.tasks limits (reference model/generate.go:24-25).
+MAX_GENERATED_BUILD_VARIANTS = 200
+MAX_GENERATED_TASKS = 25_000
+
+#: Consecutive system failures before a host is disabled
+#: (reference rest/route/host_agent.go:32).
+CONSECUTIVE_SYSTEM_FAILURE_THRESHOLD = 3
+
+#: Default seconds between scheduler ticks (reference operations/service.go:99).
+SCHEDULER_TICK_INTERVAL_S = 15
+
+# --------------------------------------------------------------------------- #
+# Planner / allocator enum knobs (reference model/distro/distro.go:267-300)
+# --------------------------------------------------------------------------- #
+
+
+class PlannerVersion(str, enum.Enum):
+    TUNABLE = "tunable"  # reference's tunable planner semantics, serial
+    TPU = "tpu"  # batched JAX solve (this framework's north star)
+
+
+class DispatcherVersion(str, enum.Enum):
+    REVISED_WITH_DEPENDENCIES = "revised-with-dependencies"
+
+
+class FinderVersion(str, enum.Enum):
+    LEGACY = "legacy"
+    PARALLEL = "parallel"
+    PIPELINE = "pipeline"
+    ALTERNATE = "alternate"
+
+
+class RoundingRule(str, enum.Enum):
+    DEFAULT = ""
+    DOWN = "round-down"
+    UP = "round-up"
+
+
+class FeedbackRule(str, enum.Enum):
+    DEFAULT = ""
+    WAITS_OVER_THRESH = "waits-over-thresh"
+    NO_FEEDBACK = "no-feedback"
+
+
+class OverallocatedRule(str, enum.Enum):
+    DEFAULT = ""
+    TERMINATE = "terminate-hosts-when-overallocated"
+    IGNORE = "no-terminations-when-overallocated"
+
+
+# --------------------------------------------------------------------------- #
+# Cloud providers (reference cloud/cloud.go provider names)
+# --------------------------------------------------------------------------- #
+
+
+class Provider(str, enum.Enum):
+    EC2_FLEET = "ec2-fleet"
+    EC2_ONDEMAND = "ec2-ondemand"
+    DOCKER = "docker"
+    STATIC = "static"
+    MOCK = "mock"
+    DOCKER_MOCK = "docker-mock"
+
+
+#: Providers whose hosts are dynamically spawned/terminated
+#: (reference distro.IsEphemeral).
+EPHEMERAL_PROVIDERS = frozenset(
+    {
+        Provider.EC2_FLEET.value,
+        Provider.EC2_ONDEMAND.value,
+        Provider.DOCKER.value,
+        Provider.MOCK.value,
+        Provider.DOCKER_MOCK.value,
+    }
+)
+
+#: Sentinel commit-queue boost added to unit priority
+#: (reference scheduler/planner.go:299-301).
+COMMIT_QUEUE_PRIORITY_BOOST = 200

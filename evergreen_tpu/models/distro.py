@@ -1,0 +1,164 @@
+"""Distro document + tunable scheduler settings.
+
+Mirrors the knobs of the reference's ``distro.Distro`` that the scheduling
+plane consumes (reference model/distro/distro.go:29,267-300,352-405). The
+planner/allocator settings become rows of the device-side settings matrix in
+the batched solve (see evergreen_tpu/scheduler/snapshot.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..globals import (
+    EPHEMERAL_PROVIDERS,
+    MAX_DURATION_PER_DISTRO_HOST_S,
+    DispatcherVersion,
+    FeedbackRule,
+    FinderVersion,
+    OverallocatedRule,
+    PlannerVersion,
+    Provider,
+    RoundingRule,
+)
+from ..storage.store import Collection, Store
+
+COLLECTION = "distros"
+
+
+@dataclasses.dataclass
+class PlannerSettings:
+    """Reference model/distro/distro.go:286-300. Defaults follow the
+    reference's resolved defaults (GetPatchFactor et al. fall back to the
+    global scheduler config; we bake the commonly-deployed defaults)."""
+
+    version: str = PlannerVersion.TPU.value
+    target_time_s: float = 0.0  # 0 → use MAX_DURATION_PER_DISTRO_HOST_S
+    group_versions: bool = False
+    patch_factor: int = 0
+    patch_time_in_queue_factor: int = 0
+    commit_queue_factor: int = 0
+    mainline_time_in_queue_factor: int = 0
+    expected_runtime_factor: int = 0
+    generate_task_factor: int = 0
+    num_dependents_factor: float = 0.0
+    stepback_task_factor: int = 0
+
+    def max_duration_per_host_s(self) -> float:
+        return self.target_time_s if self.target_time_s > 0 else float(
+            MAX_DURATION_PER_DISTRO_HOST_S
+        )
+
+
+@dataclasses.dataclass
+class HostAllocatorSettings:
+    """Reference model/distro/distro.go:267-280."""
+
+    version: str = "utilization"
+    minimum_hosts: int = 0
+    maximum_hosts: int = 0
+    auto_tune_maximum_hosts: bool = False
+    rounding_rule: str = RoundingRule.DOWN.value
+    feedback_rule: str = FeedbackRule.WAITS_OVER_THRESH.value
+    hosts_overallocated_rule: str = OverallocatedRule.DEFAULT.value
+    acceptable_host_idle_time_s: float = 0.0
+    future_host_fraction: float = 0.5
+
+
+@dataclasses.dataclass
+class DispatcherSettings:
+    version: str = DispatcherVersion.REVISED_WITH_DEPENDENCIES.value
+
+
+@dataclasses.dataclass
+class FinderSettings:
+    version: str = FinderVersion.PIPELINE.value
+
+
+@dataclasses.dataclass
+class Distro:
+    id: str
+    provider: str = Provider.MOCK.value
+    arch: str = "linux_amd64"
+    work_dir: str = "/data/evg"
+    user: str = "evg-user"
+    disabled: bool = False
+    container_pool: str = ""
+    aliases: List[str] = dataclasses.field(default_factory=list)
+    setup: str = ""
+    provider_settings: dict = dataclasses.field(default_factory=dict)
+    planner_settings: PlannerSettings = dataclasses.field(
+        default_factory=PlannerSettings
+    )
+    host_allocator_settings: HostAllocatorSettings = dataclasses.field(
+        default_factory=HostAllocatorSettings
+    )
+    dispatcher_settings: DispatcherSettings = dataclasses.field(
+        default_factory=DispatcherSettings
+    )
+    finder_settings: FinderSettings = dataclasses.field(default_factory=FinderSettings)
+    single_task_distro: bool = False
+
+    def is_ephemeral(self) -> bool:
+        return self.provider in EPHEMERAL_PROVIDERS
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Distro":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        for key, sub in (
+            ("planner_settings", PlannerSettings),
+            ("host_allocator_settings", HostAllocatorSettings),
+            ("dispatcher_settings", DispatcherSettings),
+            ("finder_settings", FinderSettings),
+        ):
+            if isinstance(doc.get(key), dict):
+                doc[key] = sub(**doc[key])
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+def coll(store: Store) -> Collection:
+    return store.collection(COLLECTION)
+
+
+def insert(store: Store, d: Distro) -> None:
+    coll(store).insert(d.to_doc())
+
+
+def upsert(store: Store, d: Distro) -> None:
+    coll(store).upsert(d.to_doc())
+
+
+def get(store: Store, distro_id: str) -> Optional[Distro]:
+    doc = coll(store).get(distro_id)
+    return Distro.from_doc(doc) if doc else None
+
+
+def find_all(store: Store) -> List[Distro]:
+    return [Distro.from_doc(d) for d in coll(store).find()]
+
+
+def find_needs_planning(store: Store) -> List[Distro]:
+    """Distros whose task queues get planned: non-disabled ones, plus static
+    distros even when disabled (reference distro.ByNeedsPlanning,
+    model/distro/db.go:198-212)."""
+    return [
+        d
+        for d in find_all(store)
+        if (not d.disabled or d.provider == Provider.STATIC.value)
+        and not d.container_pool
+    ]
+
+
+def find_needs_hosts_planning(store: Store) -> List[Distro]:
+    """Distros the host allocator runs for: ALL non-container-pool distros,
+    including disabled ones — disabled distros still maintain their minimum
+    hosts (reference distro.ByNeedsHostsPlanning, model/distro/db.go:214-224,
+    and the disabled branch of UtilizationBasedHostAllocator :51-67)."""
+    return [d for d in find_all(store) if not d.container_pool]

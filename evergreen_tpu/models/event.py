@@ -1,0 +1,93 @@
+"""Typed event log — appended on every state change, consumed by the
+trigger/notification pipeline (reference model/event/ package; acts as a
+durable outbox, SURVEY §3.5)."""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time as _time
+from typing import List, Optional
+
+from ..storage.store import Collection, Store
+
+COLLECTION = "events"
+
+_SEQ = itertools.count()
+_SEQ_LOCK = threading.Lock()
+
+
+# Resource types (reference model/event/event.go)
+RESOURCE_TASK = "TASK"
+RESOURCE_HOST = "HOST"
+RESOURCE_BUILD = "BUILD"
+RESOURCE_VERSION = "VERSION"
+RESOURCE_PATCH = "PATCH"
+RESOURCE_DISTRO = "DISTRO"
+RESOURCE_ADMIN = "ADMIN"
+
+
+@dataclasses.dataclass
+class Event:
+    id: str
+    resource_type: str
+    event_type: str
+    resource_id: str
+    timestamp: float
+    processed_at: float = 0.0
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Event":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        return cls(**doc)
+
+
+def coll(store: Store) -> Collection:
+    return store.collection(COLLECTION)
+
+
+def log(
+    store: Store,
+    resource_type: str,
+    event_type: str,
+    resource_id: str,
+    data: Optional[dict] = None,
+    timestamp: Optional[float] = None,
+) -> Event:
+    with _SEQ_LOCK:
+        seq = next(_SEQ)
+    ev = Event(
+        id=f"evt-{seq}",
+        resource_type=resource_type,
+        event_type=event_type,
+        resource_id=resource_id,
+        timestamp=_time.time() if timestamp is None else timestamp,
+        data=data or {},
+    )
+    coll(store).insert(ev.to_doc())
+    return ev
+
+
+def find_unprocessed(store: Store, limit: int = 0) -> List[Event]:
+    evs = [Event.from_doc(d) for d in coll(store).find(lambda d: d["processed_at"] == 0.0)]
+    evs.sort(key=lambda e: e.timestamp)
+    return evs[:limit] if limit else evs
+
+
+def mark_processed(store: Store, event_id: str, when: Optional[float] = None) -> bool:
+    return coll(store).update(
+        event_id, {"processed_at": _time.time() if when is None else when}
+    )
+
+
+def find_by_resource(store: Store, resource_id: str) -> List[Event]:
+    evs = [Event.from_doc(d) for d in coll(store).find(lambda d: d["resource_id"] == resource_id)]
+    evs.sort(key=lambda e: e.timestamp)
+    return evs

@@ -1,0 +1,220 @@
+"""Host document + state machine.
+
+Mirrors the allocator/dispatch-consumed core of the reference's ``host.Host``
+(reference model/host/host.go, 4.4k LoC): status lifecycle, atomic
+running-task assignment, task-group stickiness, intent hosts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+import uuid
+from typing import List, Optional
+
+from ..globals import (
+    HOST_ACTIVE_STATUSES,
+    HOST_UP_STATUSES,
+    HostStatus,
+)
+from ..storage.store import Collection, Store
+
+COLLECTION = "hosts"
+
+
+@dataclasses.dataclass
+class Host:
+    id: str
+    distro_id: str = ""
+    provider: str = "mock"
+    status: str = HostStatus.UNINITIALIZED.value
+    started_by: str = "mci"  # "mci" == system-owned; else spawn host user
+    user_host: bool = False
+    no_expiration: bool = False
+    expiration_time: float = 0.0
+
+    creation_time: float = 0.0
+    start_time: float = 0.0
+    agent_start_time: float = 0.0
+    termination_time: float = 0.0
+    last_communication_time: float = 0.0
+
+    # Dispatch state (reference host.go RunningTask block)
+    running_task: str = ""
+    running_task_group: str = ""
+    running_task_build_variant: str = ""
+    running_task_version: str = ""
+    running_task_project: str = ""
+    running_task_group_order: int = 0
+    last_task: str = ""
+    last_group: str = ""
+    last_build_variant: str = ""
+    last_version: str = ""
+    last_project: str = ""
+    task_count: int = 0
+    task_group_teardown_start_time: float = 0.0
+
+    total_idle_time_s: float = 0.0
+    provision_time: float = 0.0
+    needs_reprovision: str = ""
+    provision_attempts: int = 0
+
+    # Container-pool topology (reference host.go parent/container fields)
+    parent_id: str = ""
+    has_containers: bool = False
+    container_pool_id: str = ""
+
+    instance_type: str = ""
+    zone: str = ""
+    ip_address: str = ""
+    external_id: str = ""  # cloud-provider instance id
+
+    def __post_init__(self) -> None:
+        if self.creation_time == 0.0:
+            self.creation_time = _time.time()
+
+    # -- predicates (reference model/host/host.go:215 IsFree etc.) ----------- #
+
+    def is_tearing_down(self) -> bool:
+        return self.task_group_teardown_start_time > 0.0
+
+    def is_free(self) -> bool:
+        return self.running_task == "" and not self.is_tearing_down()
+
+    def is_active(self) -> bool:
+        return self.status in HOST_ACTIVE_STATUSES
+
+    def is_up(self) -> bool:
+        return self.status in HOST_UP_STATUSES
+
+    def can_run_tasks(self) -> bool:
+        return self.status == HostStatus.RUNNING.value and self.started_by == "mci"
+
+    def task_group_string(self) -> str:
+        return (
+            f"{self.running_task_group}_{self.running_task_build_variant}_"
+            f"{self.running_task_project}_{self.running_task_version}"
+        )
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Host":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+def new_intent(distro_id: str, provider: str) -> Host:
+    """Cloud-agnostic placeholder host created by the allocator output
+    (reference scheduler/scheduler.go:176-220 CreateIntentHosts +
+    host.NewIntent)."""
+    return Host(
+        id=f"evg-{distro_id}-{uuid.uuid4().hex[:12]}",
+        distro_id=distro_id,
+        provider=provider,
+        status=HostStatus.UNINITIALIZED.value,
+    )
+
+
+def coll(store: Store) -> Collection:
+    return store.collection(COLLECTION)
+
+
+def insert(store: Store, h: Host) -> None:
+    coll(store).insert(h.to_doc())
+
+
+def insert_many(store: Store, hosts: List[Host]) -> None:
+    coll(store).insert_many([h.to_doc() for h in hosts])
+
+
+def get(store: Store, host_id: str) -> Optional[Host]:
+    doc = coll(store).get(host_id)
+    return Host.from_doc(doc) if doc else None
+
+
+def find(store: Store, pred=None) -> List[Host]:
+    return [Host.from_doc(d) for d in coll(store).find(pred)]
+
+
+def all_active_hosts(store: Store, distro_id: str = "") -> List[Host]:
+    """Capacity view for the allocator (reference host.AllActiveHosts via
+    units/host_allocator.go:152): system-owned hosts in an active state."""
+
+    def pred(doc: dict) -> bool:
+        if doc["status"] not in HOST_ACTIVE_STATUSES:
+            return False
+        if doc["started_by"] != "mci":
+            return False
+        if distro_id and doc["distro_id"] != distro_id:
+            return False
+        return True
+
+    return find(store, pred)
+
+
+def assign_running_task(
+    store: Store, host_id: str, task, dispatch_time: float
+) -> bool:
+    """Atomic compare-and-set of the host's running task — the dispatch
+    correctness primitive (reference rest/route/host_agent.go:311-420)."""
+    return coll(store).compare_and_set(
+        host_id,
+        expect={"running_task": "", "status": HostStatus.RUNNING.value},
+        update={
+            "running_task": task.id,
+            "running_task_group": task.task_group,
+            "running_task_build_variant": task.build_variant,
+            "running_task_version": task.version,
+            "running_task_project": task.project,
+            "running_task_group_order": task.task_group_order,
+            "last_communication_time": dispatch_time,
+        },
+    )
+
+
+def clear_running_task(store: Store, host_id: str, task_id: str, now: float) -> bool:
+    """Clear assignment at task end, recording last-task affinity state
+    (reference host.ClearRunningTask)."""
+    c = coll(store)
+    doc = c.get(host_id)
+    if doc is None or doc.get("running_task") != task_id:
+        return False
+    return c.compare_and_set(
+        host_id,
+        expect={"running_task": task_id},
+        update={
+            "running_task": "",
+            "last_task": task_id,
+            "last_group": doc.get("running_task_group", ""),
+            "last_build_variant": doc.get("running_task_build_variant", ""),
+            "last_version": doc.get("running_task_version", ""),
+            "last_project": doc.get("running_task_project", ""),
+            "running_task_group": "",
+            "running_task_build_variant": "",
+            "running_task_version": "",
+            "running_task_project": "",
+            "running_task_group_order": 0,
+            "task_count": doc.get("task_count", 0) + 1,
+            "last_communication_time": now,
+        },
+    )
+
+
+def remove_stale_initializing(store: Store, distro_id: str, now: float,
+                              ttl_s: float = 3 * 60.0) -> int:
+    """Drop intent hosts that never started building (reference
+    host.RemoveStaleInitializing via units/host_allocator.go:127)."""
+
+    def pred(doc: dict) -> bool:
+        return (
+            doc["status"] == HostStatus.UNINITIALIZED.value
+            and (not distro_id or doc["distro_id"] == distro_id)
+            and now - doc.get("creation_time", now) > ttl_s
+        )
+
+    return coll(store).remove_where(pred)

@@ -1,0 +1,122 @@
+"""Persisted task queue + per-queue aggregate info.
+
+The queue doc is the durable artifact of a planning tick (reference
+model/task_queue.go:48-78 DistroQueueInfo; scheduler/task_queue_persister.go).
+It is a pure function of the snapshot, so resume ≡ rerun (SURVEY §5
+checkpoint analog).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..storage.store import Collection, Store
+
+COLLECTION = "task_queues"
+SECONDARY_COLLECTION = "task_secondary_queues"
+
+
+@dataclasses.dataclass
+class TaskGroupInfo:
+    """Per-task-group aggregates feeding the allocator (reference
+    model/task_queue.go TaskGroupInfo)."""
+
+    name: str = ""
+    count: int = 0
+    max_hosts: int = 0
+    expected_duration_s: float = 0.0
+    count_free: int = 0
+    count_required: int = 0
+    count_duration_over_threshold: int = 0
+    count_wait_over_threshold: int = 0
+    count_dep_filled_merge_queue: int = 0
+    duration_over_threshold_s: float = 0.0
+
+
+@dataclasses.dataclass
+class DistroQueueInfo:
+    length: int = 0
+    length_with_dependencies_met: int = 0
+    count_dep_filled_merge_queue: int = 0
+    expected_duration_s: float = 0.0
+    max_duration_threshold_s: float = 0.0
+    plan_created_at: float = 0.0
+    count_duration_over_threshold: int = 0
+    duration_over_threshold_s: float = 0.0
+    count_wait_over_threshold: int = 0
+    task_group_infos: List[TaskGroupInfo] = dataclasses.field(default_factory=list)
+    secondary_queue: bool = False
+
+
+@dataclasses.dataclass
+class TaskQueueItem:
+    """One planned queue entry — the fields the DAG dispatcher needs
+    (reference model/task_queue.go TaskQueueItem)."""
+
+    id: str
+    display_name: str = ""
+    build_variant: str = ""
+    project: str = ""
+    version: str = ""
+    requester: str = ""
+    revision_order_number: int = 0
+    priority: int = 0
+    sort_value: float = 0.0
+    task_group: str = ""
+    task_group_max_hosts: int = 0
+    task_group_order: int = 0
+    expected_duration_s: float = 0.0
+    num_dependents: int = 0
+    dependencies: List[str] = dataclasses.field(default_factory=list)
+    dependencies_met: bool = True
+
+
+@dataclasses.dataclass
+class TaskQueue:
+    distro_id: str
+    queue: List[TaskQueueItem] = dataclasses.field(default_factory=list)
+    info: DistroQueueInfo = dataclasses.field(default_factory=DistroQueueInfo)
+    generated_at: float = 0.0
+
+    def length(self) -> int:
+        return len(self.queue)
+
+    def to_doc(self) -> dict:
+        return {
+            "_id": self.distro_id,
+            "distro_id": self.distro_id,
+            "queue": [dataclasses.asdict(i) for i in self.queue],
+            "info": dataclasses.asdict(self.info),
+            "generated_at": self.generated_at,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "TaskQueue":
+        info_doc = dict(doc.get("info", {}))
+        info_doc["task_group_infos"] = [
+            TaskGroupInfo(**g) for g in info_doc.get("task_group_infos", [])
+        ]
+        return cls(
+            distro_id=doc["distro_id"],
+            queue=[TaskQueueItem(**i) for i in doc.get("queue", [])],
+            info=DistroQueueInfo(**info_doc),
+            generated_at=doc.get("generated_at", 0.0),
+        )
+
+
+def coll(store: Store, secondary: bool = False) -> Collection:
+    return store.collection(SECONDARY_COLLECTION if secondary else COLLECTION)
+
+
+def save(store: Store, queue: TaskQueue, secondary: bool = False) -> None:
+    coll(store, secondary).upsert(queue.to_doc())
+
+
+def load(store: Store, distro_id: str, secondary: bool = False) -> Optional[TaskQueue]:
+    doc = coll(store, secondary).get(distro_id)
+    return TaskQueue.from_doc(doc) if doc else None
+
+
+def load_info(store: Store, distro_id: str) -> Optional[DistroQueueInfo]:
+    q = load(store, distro_id)
+    return q.info if q else None

@@ -1,0 +1,77 @@
+"""Version document — one revision (or patch) of a project
+(reference model/version.go)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..globals import VersionStatus
+from ..storage.store import Collection, Store
+
+COLLECTION = "versions"
+
+
+@dataclasses.dataclass
+class Version:
+    id: str
+    project: str = ""
+    branch: str = ""
+    revision: str = ""
+    revision_order_number: int = 0
+    requester: str = ""
+    author: str = ""
+    message: str = ""
+    status: str = VersionStatus.CREATED.value
+    activated: bool = False
+    create_time: float = 0.0
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    build_ids: List[str] = dataclasses.field(default_factory=list)
+    build_variants_status: List[dict] = dataclasses.field(default_factory=list)
+    config_yaml: str = ""
+    errors: List[str] = dataclasses.field(default_factory=list)
+    warnings: List[str] = dataclasses.field(default_factory=list)
+    ignored: bool = False
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Version":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+def coll(store: Store) -> Collection:
+    return store.collection(COLLECTION)
+
+
+def insert(store: Store, v: Version) -> None:
+    coll(store).insert(v.to_doc())
+
+
+def get(store: Store, version_id: str) -> Optional[Version]:
+    doc = coll(store).get(version_id)
+    return Version.from_doc(doc) if doc else None
+
+
+def find_by_project_order(
+    store: Store, project: str, lo: int, hi: int, requester: str = ""
+) -> List[Version]:
+    """Versions for a project in a revision-order window (stepback walks
+    this; reference model/version.go VersionByMostRecentSystemRequester)."""
+
+    def pred(d: dict) -> bool:
+        if d["project"] != project:
+            return False
+        if requester and d["requester"] != requester:
+            return False
+        return lo <= d["revision_order_number"] <= hi
+
+    out = [Version.from_doc(d) for d in coll(store).find(pred)]
+    out.sort(key=lambda v: v.revision_order_number)
+    return out

@@ -1,0 +1,310 @@
+"""The batched scheduling solve — one jittable program for ALL distros.
+
+Replaces the reference's per-distro serial loop (units/crons.go:274-331 →
+scheduler/wrapper.go:30 PlanDistro + units/host_allocator.go:77) with a
+single fused XLA program:
+
+  planner   — unit scoring (scheduler/planner.go:200-310) via segment
+              reductions over task→unit membership edges, then ONE
+              variadic lexicographic sort (lax.sort, 8 keys) producing
+              every distro's ordered queue at once;
+  allocator — utilization-based host allocation
+              (scheduler/utilization_based_host_allocator.go) via segment
+              reductions over distro × task-group segments, with every
+              per-distro knob as a parameter vector.
+
+Everything is static-shaped (snapshot buckets), branch-free (jnp.where), and
+float32/int32 — no data-dependent Python control flow under jit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..globals import MAX_DURATION_PER_DISTRO_HOST_S
+
+_WEEK_S = 7.0 * 24.0 * 3600.0
+
+
+def _seg_sum(x, seg, n):
+    return jax.ops.segment_sum(x, seg, num_segments=n)
+
+
+def _seg_max(x, seg, n):
+    return jax.ops.segment_max(x, seg, num_segments=n)
+
+
+def _seg_min(x, seg, n):
+    return jax.ops.segment_min(x, seg, num_segments=n)
+
+
+# --------------------------------------------------------------------------- #
+# Planner
+# --------------------------------------------------------------------------- #
+
+
+def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Compute per-unit sorting values and the global queue ordering."""
+    N = a["t_valid"].shape[0]
+    U = a["u_distro"].shape[0]
+    D = a["d_valid"].shape[0]
+
+    m_task, m_unit = a["m_task"], a["m_unit"]
+    m_valid = a["m_valid"]
+
+    def gather(x):
+        return jnp.where(m_valid, x[m_task], 0)
+
+    f32 = jnp.float32
+
+    # ---- unit aggregates (scheduler/planner.go:310-340 unitInfo) ---------- #
+    u_len = _seg_sum(m_valid.astype(f32), m_unit, U)
+    u_len_safe = jnp.maximum(u_len, 1.0)
+    u_merge = _seg_max(gather(a["t_is_merge"].astype(jnp.int32)), m_unit, U) > 0
+    u_patch = _seg_max(gather(a["t_is_patch"].astype(jnp.int32)), m_unit, U) > 0
+    u_non_group = (
+        _seg_max(
+            gather((~a["t_in_group"]).astype(jnp.int32)), m_unit, U
+        )
+        > 0
+    )
+    u_generate = _seg_max(gather(a["t_generate"].astype(jnp.int32)), m_unit, U) > 0
+    u_stepback = _seg_max(gather(a["t_stepback"].astype(jnp.int32)), m_unit, U) > 0
+    u_tiq = _seg_sum(gather(a["t_time_in_queue_s"].astype(f32)), m_unit, U)
+    u_max_priority = _seg_max(gather(a["t_priority"]), m_unit, U).astype(f32)
+    u_runtime = _seg_sum(gather(a["t_expected_s"].astype(f32)), m_unit, U)
+    u_max_numdep = _seg_max(gather(a["t_num_dependents"]), m_unit, U).astype(f32)
+
+    ud = a["u_distro"]
+
+    # ---- computePriority (planner.go:271-304) ----------------------------- #
+    priority = 1.0 + u_max_priority
+    priority = jnp.where(~u_non_group, priority + u_len, priority)
+    priority = jnp.where(
+        u_generate, priority * jnp.trunc(a["d_generate_factor"][ud]), priority
+    )
+    priority = jnp.where(u_merge, priority + 200.0, priority)
+
+    # ---- computeRankValue (planner.go:223-268) ---------------------------- #
+    patch_rank = jnp.trunc(a["d_patch_factor"][ud]) + jnp.trunc(
+        a["d_patch_tiq_factor"][ud]
+    ) * jnp.floor((u_tiq / 60.0) / u_len_safe)
+    merge_rank = jnp.trunc(a["d_cq_factor"][ud])
+    avg_life = u_tiq / u_len_safe
+    mainline_rank = jnp.where(
+        avg_life < _WEEK_S,
+        jnp.trunc(a["d_mainline_tiq_factor"][ud])
+        * jnp.trunc((_WEEK_S - avg_life) / 3600.0),
+        0.0,
+    ) + jnp.where(u_stepback, jnp.trunc(a["d_stepback_factor"][ud]), 0.0)
+
+    rank = 1.0 + jnp.where(
+        u_patch, patch_rank, jnp.where(u_merge, merge_rank, mainline_rank)
+    )
+    rank = rank + jnp.trunc(a["d_numdep_factor"][ud] * u_max_numdep)
+    rank = rank + jnp.trunc(a["d_runtime_factor"][ud]) * jnp.floor(
+        (u_runtime / 60.0) / u_len_safe
+    )
+
+    u_value = priority * rank + u_len  # planner.go:209-217
+
+    # ---- per-task claimed unit: max value, ties → smallest unit index ----- #
+    # (the deterministic stand-in for Export's first-claim over sorted units,
+    #  planner.go:462-481)
+    m_value = jnp.where(m_valid, u_value[m_unit], -jnp.inf)
+    t_best_value = _seg_max(m_value, m_task, N)
+    is_best = m_valid & (m_value >= t_best_value[m_task])
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    t_best_unit = _seg_min(jnp.where(is_best, m_unit, big), m_task, N)
+    t_best_unit = jnp.where(t_best_unit == big, 0, t_best_unit)
+
+    # ---- global lexicographic sort (one fused sort for all distros) ------- #
+    t_valid = a["t_valid"]
+    D_key = jnp.where(t_valid, a["t_distro"], D).astype(jnp.int32)
+    neg_value = jnp.where(t_valid, -t_best_value, jnp.inf).astype(f32)
+    keys = (
+        D_key,
+        neg_value,
+        t_best_unit.astype(jnp.int32),
+        a["t_group_order"].astype(jnp.int32),
+        -a["t_num_dependents"].astype(jnp.int32),
+        -a["t_priority"].astype(jnp.int32),
+        -a["t_expected_s"].astype(f32),
+        jnp.arange(N, dtype=jnp.int32),
+    )
+    sorted_ops = lax.sort(keys, num_keys=8)
+    order = sorted_ops[7]
+
+    return {
+        "order": order,
+        "t_value": jnp.where(t_valid, t_best_value, 0.0),
+        "t_unit": t_best_unit,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Allocator
+# --------------------------------------------------------------------------- #
+
+
+def allocator(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Batched utilization-based host allocation + queue aggregate info."""
+    G = a["g_distro"].shape[0]
+    D = a["d_valid"].shape[0]
+    f32 = jnp.float32
+
+    t_valid = a["t_valid"]
+    t_seg = a["t_seg"]
+    t_distro = a["t_distro"]
+    deps_met = t_valid & a["t_deps_met"]
+    dur = a["t_expected_s"].astype(f32)
+    gd = a["g_distro"]
+    thresh_d = jnp.where(a["d_thresh_s"] > 0, a["d_thresh_s"], 1.0)
+    t_thresh = thresh_d[t_distro]
+
+    # ---- per-segment queue aggregates (scheduler/scheduler.go:57-164) ----- #
+    # Under the revised dispatcher, only dependency-met tasks contribute
+    # (IncludesDependencies=true, scheduler/scheduler.go:28-33,84-96).
+    cnt = _seg_sum(deps_met.astype(f32), t_seg, G)
+    exp_dur = _seg_sum(jnp.where(deps_met, dur, 0.0), t_seg, G)
+    over = deps_met & (dur > t_thresh)
+    over_cnt = _seg_sum(over.astype(f32), t_seg, G)
+    over_dur = _seg_sum(jnp.where(over, dur, 0.0), t_seg, G)
+    wait_over = deps_met & (a["t_wait_dep_met_s"] > t_thresh)
+    wait_over_cnt = _seg_sum(wait_over.astype(f32), t_seg, G)
+    merge_met = deps_met & a["t_is_merge"]
+    merge_cnt = _seg_sum(merge_met.astype(f32), t_seg, G)
+
+    # ---- per-segment host aggregates -------------------------------------- #
+    h_valid = a["h_valid"]
+    h_seg = a["h_seg"]
+    h_free = h_valid & a["h_free"]
+    free_cnt = _seg_sum(h_free.astype(f32), h_seg, G)
+    host_cnt = _seg_sum(h_valid.astype(f32), h_seg, G)
+
+    # soon-free fraction per running host
+    # (utilization_based_host_allocator.go:309-379, 3σ guard :352-358)
+    h_running = h_valid & a["h_running"]
+    time_left = a["h_expected_s"] - a["h_elapsed_s"]
+    h_thresh = thresh_d[a["h_distro"]]
+    frac = jnp.clip((h_thresh - time_left) / h_thresh, 0.0, 1.0)
+    guard = (
+        (a["h_elapsed_s"] > float(MAX_DURATION_PER_DISTRO_HOST_S))
+        & (a["h_std_s"] > 0)
+        & (a["h_elapsed_s"] > a["h_expected_s"] + 3.0 * a["h_std_s"])
+    )
+    frac = jnp.where(guard, 0.0, frac)
+    frac = jnp.where(h_running, a["d_future_fraction"][a["h_distro"]] * frac, 0.0)
+    soon_free = _seg_sum(frac, h_seg, G)
+    expected_free = free_cnt + jnp.floor(soon_free)
+
+    # ---- evalHostUtilization per segment (:134-207) ------------------------ #
+    seg_active = a["g_unnamed"] | (cnt > 0)
+    seg_eph = a["d_ephemeral"][gd] & seg_active & a["g_valid"]
+    max_hosts_seg = jnp.where(
+        a["g_unnamed"], a["d_max_hosts"][gd], a["g_max_hosts"]
+    ).astype(f32)
+
+    overdue = jnp.where(a["d_feedback"][gd], wait_over_cnt, 0.0)
+    short_dur = exp_dur - over_dur
+    needed = (
+        short_dur / thresh_d[gd] - expected_free + over_cnt + overdue + merge_cnt
+    )
+    special = (expected_free < 1.0) & (needed > 0.0) & (needed < 1.0)
+    rounded = jnp.where(a["d_round_up"][gd], jnp.ceil(needed), jnp.floor(needed))
+    n = jnp.where(special, 1.0, rounded)
+    n = jnp.maximum(n, 0.0)
+    n = jnp.minimum(n, cnt)
+    n = jnp.where(n + host_cnt > max_hosts_seg, max_hosts_seg - host_cnt, n)
+    n = jnp.maximum(n, 0.0)
+    n = jnp.where(max_hosts_seg < 1.0, 0.0, n)
+    n = jnp.where(seg_eph, n, 0.0)
+    free_contrib = jnp.where(seg_eph, expected_free, 0.0)
+
+    # ---- distro-level reduction (:26-131) ---------------------------------- #
+    required = _seg_sum(n, gd, D)
+    free_approx = _seg_sum(free_contrib, gd, D)
+    d_free = _seg_sum(h_free.astype(f32), a["h_distro"], D)
+    d_existing = _seg_sum(h_valid.astype(f32), a["h_distro"], D)
+    d_deps_met = _seg_sum(
+        jnp.where(deps_met, 1.0, 0.0), t_distro, D
+    )
+
+    # never exceed the number of dependency-met tasks (:113-118)
+    required = jnp.where(
+        required + d_free > d_deps_met, d_deps_met - d_free, required
+    )
+    required = jnp.maximum(required, 0.0)
+
+    # minimum-hosts top-up (:121-128)
+    d_min = a["d_min_hosts"].astype(f32)
+    required = required + jnp.maximum(d_min - (d_existing + required), 0.0)
+
+    # disabled distros only top up to the minimum (:51-67)
+    required = jnp.where(
+        a["d_disabled"], jnp.maximum(d_min - d_existing, 0.0), required
+    )
+    # at-max-hosts early return for non-docker providers — checked BEFORE the
+    # disabled branch in the reference (:39-48), so it wins even when disabled
+    at_max = (~a["d_is_docker"]) & (d_existing >= a["d_max_hosts"].astype(f32))
+    required = jnp.where(at_max, 0.0, required)
+    required = jnp.where(a["d_valid"], required, 0.0)
+
+    # ---- distro-level queue info (persisted, model/task_queue.go:48-78) ---- #
+    d_len = _seg_sum(t_valid.astype(f32), t_distro, D)
+    d_exp_dur = _seg_sum(jnp.where(deps_met, dur, 0.0), t_distro, D)
+    d_over_cnt = _seg_sum(over.astype(f32), t_distro, D)
+    d_over_dur = _seg_sum(jnp.where(over, dur, 0.0), t_distro, D)
+    d_wait_over = _seg_sum(wait_over.astype(f32), t_distro, D)
+    d_merge = _seg_sum(merge_met.astype(f32), t_distro, D)
+
+    i32 = jnp.int32
+    return {
+        "d_new_hosts": required.astype(i32),
+        "d_free_approx": free_approx.astype(i32),
+        "d_length": d_len.astype(i32),
+        "d_deps_met": d_deps_met.astype(i32),
+        "d_expected_dur_s": d_exp_dur,
+        "d_over_count": d_over_cnt.astype(i32),
+        "d_over_dur_s": d_over_dur,
+        "d_wait_over": d_wait_over.astype(i32),
+        "d_merge": d_merge.astype(i32),
+        "g_count": cnt.astype(i32),
+        "g_expected_dur_s": exp_dur,
+        "g_count_free": expected_free.astype(i32),
+        "g_count_required": n.astype(i32),
+        "g_over_count": over_cnt.astype(i32),
+        "g_over_dur_s": over_dur,
+        "g_wait_over": wait_over_cnt.astype(i32),
+        "g_merge": merge_cnt.astype(i32),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Combined solve
+# --------------------------------------------------------------------------- #
+
+
+def solve(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """The whole scheduling tick on device: ordered queues + spawn counts."""
+    out = planner(a)
+    out.update(allocator(a))
+    return out
+
+
+@functools.cache
+def _compiled_solve():
+    return jax.jit(solve)
+
+
+def run_solve(arrays: Dict) -> Dict:
+    """Run the jitted solve on numpy inputs, returning numpy outputs.
+    Compilation is cached per shape bucket (snapshot padding keeps the set
+    of distinct shapes small under churn)."""
+    fn = _compiled_solve()
+    out = fn(arrays)
+    return {k: jax.device_get(v) for k, v in out.items()}

@@ -1,0 +1,53 @@
+"""Device mesh + sharding for the batched scheduling solve.
+
+The reference scales by fanning out one Go job per distro
+(units/crons.go:274-331). Here the scaling axis is the device mesh: every
+per-task / per-membership / per-host / per-unit / per-segment array is
+sharded along its leading axis across the mesh, the distro settings matrix is
+replicated, and XLA inserts the collectives (scatter-add all-reduces for the
+segment reductions, all-to-all exchanges for the global lexicographic sort)
+over ICI. Multi-slice scale-out would map the same program over DCN — no
+NCCL/MPI analog exists to port (SURVEY §2.3).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: arrays replicated across the mesh (small per-distro parameter vectors)
+_REPLICATED_PREFIXES = ("d_",)
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def snapshot_shardings(
+    arrays: Dict[str, np.ndarray], mesh: Mesh, axis: str = "shard"
+) -> Dict[str, NamedSharding]:
+    """Leading-axis sharding for the big arrays, replication for the distro
+    matrix. Bucket sizes are multiples of 16 (snapshot._bucket), so any
+    power-of-two mesh up to 16 divides them evenly."""
+    out = {}
+    n = mesh.devices.size
+    for name, arr in arrays.items():
+        if name.startswith(_REPLICATED_PREFIXES) or arr.shape[0] % n != 0:
+            out[name] = NamedSharding(mesh, P())
+        else:
+            out[name] = NamedSharding(mesh, P(axis))
+    return out
+
+
+def shard_snapshot(
+    arrays: Dict[str, np.ndarray], mesh: Mesh, axis: str = "shard"
+) -> Dict[str, jax.Array]:
+    shardings = snapshot_shardings(arrays, mesh, axis)
+    return {
+        name: jax.device_put(arr, shardings[name]) for name, arr in arrays.items()
+    }

@@ -1,0 +1,81 @@
+"""Queue persister — write the ordered plan as a TaskQueue doc per distro.
+
+Reference: scheduler/task_queue_persister.go:17-84 (PersistTaskQueue +
+capTaskQueueLength). The cap keeps straddling task groups whole: if the cut
+point lands inside a task-group run, the whole group straddling the boundary
+is retained.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional
+
+from ..models import task as task_mod
+from ..models.task import Task
+from ..models.task_queue import (
+    DistroQueueInfo,
+    TaskQueue,
+    TaskQueueItem,
+    save,
+)
+from ..storage.store import Store
+
+
+def cap_queue_length(
+    items: List[TaskQueueItem], max_len: int
+) -> List[TaskQueueItem]:
+    """task_queue_persister.go:66-84: truncate to max_len but keep a task
+    group that straddles the cut whole."""
+    if max_len <= 0 or len(items) <= max_len:
+        return items
+    cut = max_len
+    straddler = items[cut - 1].task_group
+    if straddler:
+        while cut < len(items) and items[cut].task_group == straddler:
+            cut += 1
+    return items[:cut]
+
+
+def persist_task_queue(
+    store: Store,
+    distro_id: str,
+    plan: List[Task],
+    sort_values: Dict[str, float],
+    deps_met: Dict[str, bool],
+    info: DistroQueueInfo,
+    max_scheduled_per_distro: int = 0,
+    secondary: bool = False,
+    now: Optional[float] = None,
+) -> TaskQueue:
+    now = _time.time() if now is None else now
+    items = [
+        TaskQueueItem(
+            id=t.id,
+            display_name=t.display_name,
+            build_variant=t.build_variant,
+            project=t.project,
+            version=t.version,
+            requester=t.requester,
+            revision_order_number=t.revision_order_number,
+            priority=t.priority,
+            sort_value=sort_values.get(t.id, 0.0),
+            task_group=t.task_group,
+            task_group_max_hosts=t.task_group_max_hosts,
+            task_group_order=t.task_group_order,
+            expected_duration_s=t.expected_duration_s,
+            num_dependents=t.num_dependents,
+            dependencies=[d.task_id for d in t.depends_on],
+            dependencies_met=deps_met.get(t.id, True),
+        )
+        for t in plan
+    ]
+    items = cap_queue_length(items, max_scheduled_per_distro)
+    queue = TaskQueue(distro_id=distro_id, queue=items, info=info, generated_at=now)
+    save(store, queue, secondary=secondary)
+    task_mod.mark_scheduled(
+        store,
+        [i.id for i in items],
+        now,
+        deps_met_ids=[i.id for i in items if i.dependencies_met],
+    )
+    return queue

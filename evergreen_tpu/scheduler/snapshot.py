@@ -1,0 +1,357 @@
+"""Snapshot builder: domain documents → padded device arrays.
+
+Replaces the reference's per-distro task finders + per-task dependency checks
+(scheduler/task_finder.go, scheduler/scheduler.go:57-164) with one host-side
+packing pass that produces the tensor inputs of the batched TPU solve:
+
+  * task feature arrays [N]   (priority, requester one-hots, durations, …)
+  * unit-membership edges [M] (task → planner unit, from the grouping rules
+                               of scheduler/planner.go:431-459)
+  * allocator segments [G]    (distro × task-group aggregation targets)
+  * host arrays [H]           (free/running state + running-task estimates)
+  * distro settings matrix [D]
+
+All arrays are padded to bucket sizes (geometric growth) so queue churn does
+not trigger recompilation storms (SURVEY §7 "ragged data on TPU").
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..globals import (
+    FeedbackRule,
+    Provider,
+    RoundingRule,
+    is_github_merge_queue_requester,
+    is_patch_requester,
+)
+from ..models.distro import Distro
+from ..models.host import Host
+from ..models.task import Task
+from .serial import RunningTaskEstimate, prepare_units
+
+
+def _bucket(n: int, minimum: int = 32) -> int:
+    """Round up to the next bucket size: powers of two interleaved with
+    1.5× midpoints, so padding waste stays ≤ 50% while distinct compiled
+    shapes grow only logarithmically with queue size."""
+    if n <= minimum:
+        return minimum
+    lo = 1 << (int(n).bit_length() - 1)
+    if n <= lo:
+        return lo
+    mid = lo + lo // 2
+    if n <= mid:
+        return mid
+    return lo * 2
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Point-in-time tensor view of the whole scheduling problem."""
+
+    now: float
+    distro_ids: List[str]
+    task_ids: List[str]
+    host_ids: List[str]
+    #: segment index → (distro index, group name)
+    seg_names: List[Tuple[int, str]]
+    #: real (unpadded) sizes
+    n_tasks: int
+    n_units: int
+    n_hosts: int
+    n_segs: int
+    n_distros: int
+    #: dict of numpy arrays (see build_snapshot for the schema)
+    arrays: Dict[str, np.ndarray]
+
+    def shape_key(self) -> Tuple[int, ...]:
+        a = self.arrays
+        return (
+            len(a["t_valid"]),
+            len(a["m_task"]),
+            len(a["u_distro"]),
+            len(a["g_distro"]),
+            len(a["h_valid"]),
+            len(a["d_valid"]),
+        )
+
+
+def compute_deps_met(
+    tasks: List[Task], finished_status: Dict[str, str]
+) -> Dict[str, bool]:
+    """Dependency-met mask over the snapshot's tasks.
+
+    Reference semantics (scheduler/scheduler.go:166-173 checkDependenciesMet →
+    task.DependenciesMet): a dependency is met iff its parent is finished with
+    the required status. Parents inside the snapshot are by construction
+    unfinished (all snapshot tasks are undispatched), so only out-of-snapshot
+    parents can satisfy edges; their statuses arrive via ``finished_status``
+    (task id → final status for finished tasks).
+    """
+    in_snapshot = {t.id for t in tasks}
+    met: Dict[str, bool] = {}
+    for t in tasks:
+        if t.override_dependencies or not t.depends_on:
+            met[t.id] = True
+            continue
+        ok = True
+        for dep in t.depends_on:
+            if dep.task_id in in_snapshot:
+                ok = False
+                break
+            status = finished_status.get(dep.task_id)
+            if status is None:
+                ok = False
+                break
+            if dep.status != "*" and status != dep.status:
+                ok = False
+                break
+        met[t.id] = ok
+    return met
+
+
+def build_snapshot(
+    distros: List[Distro],
+    tasks_by_distro: Dict[str, List[Task]],
+    hosts_by_distro: Dict[str, List[Host]],
+    running_estimates: Dict[str, RunningTaskEstimate],
+    deps_met: Dict[str, bool],
+    now: float,
+) -> Snapshot:
+    d_index = {d.id: i for i, d in enumerate(distros)}
+    n_d = len(distros)
+
+    # ---- flatten tasks + build planner unit memberships ------------------- #
+    flat_tasks: List[Task] = []
+    t_distro: List[int] = []
+    m_task: List[int] = []
+    m_unit: List[int] = []
+    u_distro: List[int] = []
+    unit_base = 0
+    for d in distros:
+        tasks = tasks_by_distro.get(d.id, [])
+        base = len(flat_tasks)
+        units, membership = prepare_units(d, tasks)
+        local_index = {t.id: base + j for j, t in enumerate(tasks)}
+        for t in tasks:
+            flat_tasks.append(t)
+            t_distro.append(d_index[d.id])
+        for u in units:
+            u_distro.append(d_index[d.id])
+        for tid, unit_idxs in membership.items():
+            for ui in unit_idxs:
+                m_task.append(local_index[tid])
+                m_unit.append(unit_base + ui)
+        unit_base += len(units)
+
+    n_t, n_m, n_u = len(flat_tasks), len(m_task), len(u_distro)
+
+    # ---- allocator segments: one "" segment per distro + named groups ----- #
+    seg_index: Dict[Tuple[int, str], int] = {}
+    seg_names: List[Tuple[int, str]] = []
+    seg_max_hosts: List[int] = []
+
+    def seg_for(di: int, name: str, max_hosts: int = 0) -> int:
+        key = (di, name)
+        idx = seg_index.get(key)
+        if idx is None:
+            idx = len(seg_names)
+            seg_index[key] = idx
+            seg_names.append(key)
+            seg_max_hosts.append(max_hosts)
+        elif max_hosts and not seg_max_hosts[idx]:
+            seg_max_hosts[idx] = max_hosts
+        return idx
+
+    for di in range(n_d):
+        seg_for(di, "")
+
+    t_seg = np.zeros(n_t, dtype=np.int32)
+    for i, t in enumerate(flat_tasks):
+        di = t_distro[i]
+        name = t.task_group_string() if t.task_group else ""
+        t_seg[i] = seg_for(di, name, t.task_group_max_hosts)
+
+    # ---- hosts ------------------------------------------------------------ #
+    flat_hosts: List[Host] = []
+    h_distro: List[int] = []
+    h_seg: List[int] = []
+    for d in distros:
+        for h in hosts_by_distro.get(d.id, []):
+            di = d_index[d.id]
+            flat_hosts.append(h)
+            h_distro.append(di)
+            name = ""
+            if h.running_task and h.running_task_group:
+                name = h.task_group_string()
+            h_seg.append(seg_for(di, name))
+    n_h = len(flat_hosts)
+    n_g = len(seg_names)
+
+    # ---- padded allocation ------------------------------------------------ #
+    N = _bucket(max(n_t, 1))
+    M = _bucket(max(n_m, 1))
+    U = _bucket(max(n_u, 1))
+    G = _bucket(max(n_g, 1))
+    H = _bucket(max(n_h, 1))
+    D = _bucket(max(n_d, 1), minimum=8)
+
+    a: Dict[str, np.ndarray] = {}
+
+    def zeros(name, size, dtype):
+        arr = np.zeros(size, dtype=dtype)
+        a[name] = arr
+        return arr
+
+    # task arrays
+    t_valid = zeros("t_valid", N, np.bool_)
+    t_distro_a = np.full(N, D - 1, dtype=np.int32)
+    a["t_distro"] = t_distro_a
+    t_priority = zeros("t_priority", N, np.int32)
+    t_is_merge = zeros("t_is_merge", N, np.bool_)
+    t_is_patch = zeros("t_is_patch", N, np.bool_)
+    t_stepback = zeros("t_stepback", N, np.bool_)
+    t_generate = zeros("t_generate", N, np.bool_)
+    t_in_group = zeros("t_in_group", N, np.bool_)
+    t_group_order = zeros("t_group_order", N, np.int32)
+    t_time_in_queue = zeros("t_time_in_queue_s", N, np.float32)
+    t_expected = zeros("t_expected_s", N, np.float32)
+    t_wait_dep_met = zeros("t_wait_dep_met_s", N, np.float32)
+    t_num_dependents = zeros("t_num_dependents", N, np.int32)
+    t_deps_met = zeros("t_deps_met", N, np.bool_)
+    t_seg_a = np.full(N, G - 1, dtype=np.int32)
+    a["t_seg"] = t_seg_a
+
+    for i, t in enumerate(flat_tasks):
+        t_valid[i] = True
+        t_distro_a[i] = t_distro[i]
+        t_priority[i] = t.priority
+        merge = is_github_merge_queue_requester(t.requester)
+        t_is_merge[i] = merge
+        t_is_patch[i] = (not merge) and is_patch_requester(t.requester)
+        t_stepback[i] = t.is_stepback_activated()
+        t_generate[i] = t.generate_task
+        t_in_group[i] = bool(t.task_group)
+        t_group_order[i] = t.task_group_order
+        t_time_in_queue[i] = t.time_in_queue(now)
+        t_expected[i] = t.expected_duration_s
+        t_wait_dep_met[i] = t.wait_since_dependencies_met(now)
+        t_num_dependents[i] = t.num_dependents
+        t_deps_met[i] = deps_met.get(t.id, True)
+        t_seg_a[i] = t_seg[i]
+
+    # membership arrays (padding points at dummy task N-1 / unit U-1)
+    m_task_a = np.full(M, N - 1, dtype=np.int32)
+    m_unit_a = np.full(M, U - 1, dtype=np.int32)
+    m_valid = zeros("m_valid", M, np.bool_)
+    if n_m:
+        m_task_a[:n_m] = m_task
+        m_unit_a[:n_m] = m_unit
+        m_valid[:n_m] = True
+    a["m_task"] = m_task_a
+    a["m_unit"] = m_unit_a
+
+    # unit arrays
+    u_distro_a = np.full(U, D - 1, dtype=np.int32)
+    if n_u:
+        u_distro_a[:n_u] = u_distro
+    a["u_distro"] = u_distro_a
+
+    # segment arrays
+    g_distro = np.full(G, D - 1, dtype=np.int32)
+    g_unnamed = zeros("g_unnamed", G, np.bool_)
+    g_max_hosts = zeros("g_max_hosts", G, np.int32)
+    g_valid = zeros("g_valid", G, np.bool_)
+    for gi, (di, name) in enumerate(seg_names):
+        g_distro[gi] = di
+        g_unnamed[gi] = name == ""
+        g_max_hosts[gi] = seg_max_hosts[gi]
+        g_valid[gi] = True
+    a["g_distro"] = g_distro
+
+    # host arrays
+    h_valid = zeros("h_valid", H, np.bool_)
+    h_distro_a = np.full(H, D - 1, dtype=np.int32)
+    a["h_distro"] = h_distro_a
+    h_seg_a = np.full(H, G - 1, dtype=np.int32)
+    a["h_seg"] = h_seg_a
+    h_free = zeros("h_free", H, np.bool_)
+    h_running = zeros("h_running", H, np.bool_)
+    h_elapsed = zeros("h_elapsed_s", H, np.float32)
+    h_expected = zeros("h_expected_s", H, np.float32)
+    h_std = zeros("h_std_s", H, np.float32)
+    for i, h in enumerate(flat_hosts):
+        h_valid[i] = True
+        h_distro_a[i] = h_distro[i]
+        h_seg_a[i] = h_seg[i]
+        h_free[i] = h.is_free()
+        running = bool(h.running_task)
+        est = running_estimates.get(h.id)
+        h_running[i] = running and est is not None
+        if running and est is not None:
+            h_elapsed[i] = est.elapsed_s
+            h_expected[i] = est.expected_s
+            h_std[i] = est.std_dev_s
+
+    # distro settings matrix
+    d_valid = zeros("d_valid", D, np.bool_)
+    d_min_hosts = zeros("d_min_hosts", D, np.int32)
+    d_max_hosts = zeros("d_max_hosts", D, np.int32)
+    d_future_fraction = zeros("d_future_fraction", D, np.float32)
+    d_round_up = zeros("d_round_up", D, np.bool_)
+    d_feedback = zeros("d_feedback", D, np.bool_)
+    d_disabled = zeros("d_disabled", D, np.bool_)
+    d_ephemeral = zeros("d_ephemeral", D, np.bool_)
+    d_is_docker = zeros("d_is_docker", D, np.bool_)
+    d_thresh = zeros("d_thresh_s", D, np.float32)
+    d_patch_factor = zeros("d_patch_factor", D, np.float32)
+    d_patch_tiq_factor = zeros("d_patch_tiq_factor", D, np.float32)
+    d_cq_factor = zeros("d_cq_factor", D, np.float32)
+    d_mainline_tiq_factor = zeros("d_mainline_tiq_factor", D, np.float32)
+    d_runtime_factor = zeros("d_runtime_factor", D, np.float32)
+    d_generate_factor = zeros("d_generate_factor", D, np.float32)
+    d_numdep_factor = zeros("d_numdep_factor", D, np.float32)
+    d_stepback_factor = zeros("d_stepback_factor", D, np.float32)
+
+    def factor(v: float) -> float:
+        return float(v) if v > 0 else 1.0
+
+    for i, d in enumerate(distros):
+        ps, hs = d.planner_settings, d.host_allocator_settings
+        d_valid[i] = True
+        d_min_hosts[i] = hs.minimum_hosts
+        d_max_hosts[i] = hs.maximum_hosts
+        d_future_fraction[i] = hs.future_host_fraction
+        d_round_up[i] = hs.rounding_rule == RoundingRule.UP.value
+        d_feedback[i] = hs.feedback_rule == FeedbackRule.WAITS_OVER_THRESH.value
+        d_disabled[i] = d.disabled
+        d_ephemeral[i] = d.is_ephemeral()
+        d_is_docker[i] = d.provider == Provider.DOCKER.value
+        d_thresh[i] = ps.max_duration_per_host_s()
+        d_patch_factor[i] = factor(ps.patch_factor)
+        d_patch_tiq_factor[i] = factor(ps.patch_time_in_queue_factor)
+        d_cq_factor[i] = factor(ps.commit_queue_factor)
+        d_mainline_tiq_factor[i] = factor(ps.mainline_time_in_queue_factor)
+        d_runtime_factor[i] = factor(ps.expected_runtime_factor)
+        d_generate_factor[i] = factor(ps.generate_task_factor)
+        d_numdep_factor[i] = factor(ps.num_dependents_factor)
+        d_stepback_factor[i] = factor(ps.stepback_task_factor)
+
+    return Snapshot(
+        now=now,
+        distro_ids=[d.id for d in distros],
+        task_ids=[t.id for t in flat_tasks],
+        host_ids=[h.id for h in flat_hosts],
+        seg_names=seg_names,
+        n_tasks=n_t,
+        n_units=n_u,
+        n_hosts=n_h,
+        n_segs=n_g,
+        n_distros=n_d,
+        arrays=a,
+    )

@@ -1,0 +1,283 @@
+"""Scheduler tick driver — the PlanDistro equivalent, batched.
+
+Reference flow (scheduler/wrapper.go:30 PlanDistro, per distro):
+  underwater unschedule → find runnable → prioritize → queue info → persist,
+with host allocation as a separate per-distro job (units/host_allocator.go).
+
+Here ONE tick does all distros: build the snapshot, run the batched device
+solve (ops/solve.py), then unpack device outputs into per-distro TaskQueue
+docs and intent hosts. The tick is a pure function of the snapshot —
+stateless resume semantics (SURVEY §5).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..globals import (
+    MAX_INTENT_HOSTS_IN_FLIGHT,
+    UNDERWATER_UNSCHEDULE_THRESHOLD_S,
+    HostStatus,
+    PlannerVersion,
+)
+from ..models import distro as distro_mod
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models.distro import Distro
+from ..models.host import Host, new_intent
+from ..models.task import Task
+from ..models.task_queue import DistroQueueInfo, TaskGroupInfo, TaskQueue
+from ..storage.store import Store
+from . import serial
+from .persister import persist_task_queue
+from .snapshot import Snapshot, build_snapshot, compute_deps_met
+
+
+@dataclasses.dataclass
+class TickOptions:
+    max_scheduled_per_distro: int = 0
+    planner_version: str = PlannerVersion.TPU.value
+    underwater_unschedule: bool = True
+    create_intent_hosts: bool = True
+    #: global cap on in-flight intent hosts (units/host_allocator.go:35)
+    max_intent_hosts: int = MAX_INTENT_HOSTS_IN_FLIGHT
+
+
+@dataclasses.dataclass
+class TickResult:
+    queues: Dict[str, TaskQueue]
+    new_hosts: Dict[str, int]
+    intent_hosts: List[Host]
+    n_tasks: int
+    n_distros: int
+    snapshot_ms: float = 0.0
+    solve_ms: float = 0.0
+    total_ms: float = 0.0
+
+
+def gather_tick_inputs(
+    store: Store, now: float
+) -> Tuple[
+    List[Distro],
+    Dict[str, List[Task]],
+    Dict[str, List[Host]],
+    Dict[str, serial.RunningTaskEstimate],
+    Dict[str, bool],
+]:
+    """Read the store into solver inputs: runnable tasks per distro, active
+    hosts per distro, running-task duration estimates, dep-met mask."""
+    # The snapshot covers the allocator's distro set (a superset that
+    # includes disabled distros, which still maintain minimum hosts); task
+    # queues are only gathered for the plannable subset (reference
+    # model/distro/db.go:198-224).
+    distros = distro_mod.find_needs_hosts_planning(store)
+    all_ids = {d.id for d in distros}
+    distro_ids = {d.id for d in distro_mod.find_needs_planning(store)}
+
+    # Materialize only runnable tasks (the finder's doc-level filter,
+    # scheduler/task_finder.go:34-36) — NOT the full task history, which
+    # grows without bound in a CI system.
+    tasks_by_distro: Dict[str, List[Task]] = {d.id: [] for d in distros}
+    runnable: List[Task] = []
+    for t in task_mod.find_host_runnable(store):
+        if t.distro_id in distro_ids:
+            tasks_by_distro[t.distro_id].append(t)
+            runnable.append(t)
+
+    # Resolve only the dependency parents the runnable set references.
+    parent_ids = {d.task_id for t in runnable for d in t.depends_on}
+    finished_status = {
+        t.id: t.status
+        for t in task_mod.by_ids(store, list(parent_ids))
+        if t.is_finished()
+    }
+    deps_met = compute_deps_met(runnable, finished_status)
+
+    hosts_by_distro: Dict[str, List[Host]] = {d.id: [] for d in distros}
+    active_hosts = [
+        h for h in host_mod.all_active_hosts(store) if h.distro_id in all_ids
+    ]
+    running_ids = [h.running_task for h in active_hosts if h.running_task]
+    running_tasks = {t.id: t for t in task_mod.by_ids(store, running_ids)}
+    running_estimates: Dict[str, serial.RunningTaskEstimate] = {}
+    for h in active_hosts:
+        hosts_by_distro[h.distro_id].append(h)
+        if h.running_task:
+            rt = running_tasks.get(h.running_task)
+            if rt is not None:
+                running_estimates[h.id] = serial.RunningTaskEstimate(
+                    elapsed_s=max(0.0, now - rt.start_time),
+                    expected_s=rt.expected_duration_s,
+                    std_dev_s=rt.duration_std_dev_s,
+                )
+    return distros, tasks_by_distro, hosts_by_distro, running_estimates, deps_met
+
+
+def _unpack_solve(
+    snapshot: Snapshot,
+    out: Dict[str, np.ndarray],
+    tasks_by_distro: Dict[str, List[Task]],
+) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, DistroQueueInfo], Dict[str, int]]:
+    """Device outputs → per-distro ordered plans, sort values, queue infos,
+    spawn counts."""
+    by_id: Dict[str, Task] = {}
+    for tasks in tasks_by_distro.values():
+        for t in tasks:
+            by_id[t.id] = t
+
+    order = out["order"]
+    t_value = out["t_value"]
+    n = snapshot.n_tasks
+    plans: Dict[str, List[Task]] = {d: [] for d in snapshot.distro_ids}
+    sort_values: Dict[str, Dict[str, float]] = {d: {} for d in snapshot.distro_ids}
+    t_distro = snapshot.arrays["t_distro"]
+    for idx in order:
+        if idx >= n:
+            continue
+        tid = snapshot.task_ids[idx]
+        did = snapshot.distro_ids[t_distro[idx]]
+        plans[did].append(by_id[tid])
+        sort_values[did][tid] = float(t_value[idx])
+
+    # per-segment TaskGroupInfos
+    seg_infos: Dict[int, List[TaskGroupInfo]] = {}
+    for gi, (di, name) in enumerate(snapshot.seg_names):
+        info = TaskGroupInfo(
+            name=name,
+            count=int(out["g_count"][gi]),
+            max_hosts=int(snapshot.arrays["g_max_hosts"][gi]),
+            expected_duration_s=float(out["g_expected_dur_s"][gi]),
+            count_free=int(out["g_count_free"][gi]),
+            count_required=int(out["g_count_required"][gi]),
+            count_duration_over_threshold=int(out["g_over_count"][gi]),
+            count_wait_over_threshold=int(out["g_wait_over"][gi]),
+            count_dep_filled_merge_queue=int(out["g_merge"][gi]),
+            duration_over_threshold_s=float(out["g_over_dur_s"][gi]),
+        )
+        seg_infos.setdefault(di, []).append(info)
+
+    infos: Dict[str, DistroQueueInfo] = {}
+    new_hosts: Dict[str, int] = {}
+    for di, did in enumerate(snapshot.distro_ids):
+        infos[did] = DistroQueueInfo(
+            length=int(out["d_length"][di]),
+            length_with_dependencies_met=int(out["d_deps_met"][di]),
+            count_dep_filled_merge_queue=int(out["d_merge"][di]),
+            expected_duration_s=float(out["d_expected_dur_s"][di]),
+            max_duration_threshold_s=float(snapshot.arrays["d_thresh_s"][di]),
+            count_duration_over_threshold=int(out["d_over_count"][di]),
+            duration_over_threshold_s=float(out["d_over_dur_s"][di]),
+            count_wait_over_threshold=int(out["d_wait_over"][di]),
+            task_group_infos=seg_infos.get(di, []),
+        )
+        new_hosts[did] = int(out["d_new_hosts"][di])
+    return plans, sort_values, infos, new_hosts
+
+
+def run_tick(
+    store: Store,
+    opts: Optional[TickOptions] = None,
+    now: Optional[float] = None,
+) -> TickResult:
+    """One full scheduling tick over every distro."""
+    from ..ops.solve import run_solve  # deferred: keeps jax import lazy
+
+    opts = opts or TickOptions()
+    now = _time.time() if now is None else now
+    t0 = _time.perf_counter()
+
+    if opts.underwater_unschedule:
+        task_mod.unschedule_stale_underwater(
+            store, "", now, UNDERWATER_UNSCHEDULE_THRESHOLD_S
+        )
+
+    (
+        distros,
+        tasks_by_distro,
+        hosts_by_distro,
+        running_estimates,
+        deps_met,
+    ) = gather_tick_inputs(store, now)
+
+    queues: Dict[str, TaskQueue] = {}
+    new_hosts: Dict[str, int] = {}
+    intent_hosts: List[Host] = []
+    snapshot_ms = solve_ms = 0.0
+    n_tasks = sum(len(v) for v in tasks_by_distro.values())
+
+    if opts.planner_version == PlannerVersion.TPU.value:
+        t1 = _time.perf_counter()
+        snapshot = build_snapshot(
+            distros, tasks_by_distro, hosts_by_distro, running_estimates,
+            deps_met, now,
+        )
+        t2 = _time.perf_counter()
+        out = run_solve(snapshot.arrays)
+        t3 = _time.perf_counter()
+        snapshot_ms = (t2 - t1) * 1e3
+        solve_ms = (t3 - t2) * 1e3
+        plans, sort_values, infos, new_hosts = _unpack_solve(
+            snapshot, out, tasks_by_distro
+        )
+    else:
+        results = serial.serial_tick(
+            distros, tasks_by_distro, hosts_by_distro, running_estimates,
+            deps_met, now,
+        )
+        plans = {d: r[0] for d, r in results.items()}
+        infos = {d: r[1] for d, r in results.items()}
+        new_hosts = {d: r[2] for d, r in results.items()}
+        sort_values = {d: r[3] for d, r in results.items()}
+
+    # Persist queues + create intent hosts (scheduler/scheduler.go:176-220),
+    # honoring the global intent-host cap (units/host_allocator.go:35).
+    n_intents_in_flight = host_mod.coll(store).count(
+        lambda doc: doc["status"] == HostStatus.UNINITIALIZED.value
+    )
+    budget = max(0, opts.max_intent_hosts - n_intents_in_flight)
+    for d in distros:
+        plan = plans.get(d.id, [])
+        queues[d.id] = persist_task_queue(
+            store,
+            d.id,
+            plan,
+            sort_values.get(d.id, {}),
+            deps_met,
+            infos.get(d.id, DistroQueueInfo()),
+            opts.max_scheduled_per_distro,
+            now=now,
+        )
+        if opts.create_intent_hosts:
+            n = min(new_hosts.get(d.id, 0), budget)
+            budget -= n
+            created = []
+            for _ in range(n):
+                intent = new_intent(d.id, d.provider)
+                host_mod.insert(store, intent)
+                created.append(intent)
+            intent_hosts.extend(created)
+            if created:
+                event_mod.log(
+                    store,
+                    event_mod.RESOURCE_HOST,
+                    "HOSTS_CREATED",
+                    d.id,
+                    {"count": len(created)},
+                    timestamp=now,
+                )
+
+    total_ms = (_time.perf_counter() - t0) * 1e3
+    return TickResult(
+        queues=queues,
+        new_hosts=new_hosts,
+        intent_hosts=intent_hosts,
+        n_tasks=n_tasks,
+        n_distros=len(distros),
+        snapshot_ms=snapshot_ms,
+        solve_ms=solve_ms,
+        total_ms=total_ms,
+    )

@@ -1,0 +1,198 @@
+"""Pluggable document store.
+
+The reference keeps every document in MongoDB and leans on two primitives for
+correctness: atomic compare-and-set updates (e.g. assigning
+``host.RunningTask`` during dispatch, reference rest/route/host_agent.go:311-420)
+and scope-locked background jobs. This store provides the same primitives over
+an in-memory engine so that the solver path has no external-database
+dependency; a different engine can be swapped in behind ``Store``.
+
+Thread-safety: a single re-entrant lock guards each collection. The scheduler
+tick itself never blocks on this lock for long — the snapshot builder reads
+whole collections in one lock acquisition.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+
+class Collection:
+    """A named map of id -> document (a plain dict)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._docs: Dict[str, dict] = {}
+        self._lock = threading.RLock()
+
+    # -- basic CRUD --------------------------------------------------------- #
+
+    def insert(self, doc: dict) -> None:
+        doc_id = doc["_id"]
+        with self._lock:
+            if doc_id in self._docs:
+                raise KeyError(f"duplicate _id {doc_id!r} in {self.name}")
+            self._docs[doc_id] = doc
+
+    def upsert(self, doc: dict) -> None:
+        with self._lock:
+            self._docs[doc["_id"]] = doc
+
+    def insert_many(self, docs: Iterable[dict]) -> None:
+        with self._lock:
+            for doc in docs:
+                if doc["_id"] in self._docs:
+                    raise KeyError(f"duplicate _id {doc['_id']!r} in {self.name}")
+            for doc in docs:
+                self._docs[doc["_id"]] = doc
+
+    def get(self, doc_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._docs.get(doc_id)
+
+    def find(self, pred: Optional[Callable[[dict], bool]] = None) -> List[dict]:
+        with self._lock:
+            if pred is None:
+                return list(self._docs.values())
+            return [d for d in self._docs.values() if pred(d)]
+
+    def find_ids(self, ids: Iterable[str]) -> List[dict]:
+        with self._lock:
+            return [self._docs[i] for i in ids if i in self._docs]
+
+    def remove(self, doc_id: str) -> bool:
+        with self._lock:
+            return self._docs.pop(doc_id, None) is not None
+
+    def remove_where(self, pred: Callable[[dict], bool]) -> int:
+        with self._lock:
+            doomed = [i for i, d in self._docs.items() if pred(d)]
+            for i in doomed:
+                del self._docs[i]
+            return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._docs.clear()
+
+    def count(self, pred: Optional[Callable[[dict], bool]] = None) -> int:
+        with self._lock:
+            if pred is None:
+                return len(self._docs)
+            return sum(1 for d in self._docs.values() if pred(d))
+
+    def __len__(self) -> int:
+        return len(self._docs)
+
+    def __iter__(self) -> Iterator[dict]:
+        return iter(self.find())
+
+    # -- atomic primitives --------------------------------------------------- #
+
+    def compare_and_set(
+        self,
+        doc_id: str,
+        expect: Dict[str, Any],
+        update: Dict[str, Any],
+    ) -> bool:
+        """Atomically apply ``update`` iff every field in ``expect`` matches.
+
+        This is the dispatch-correctness primitive: the reference's atomic
+        ``host.RunningTask`` assignment (rest/route/host_agent.go:311-420) and
+        task state transitions use Mongo conditional updates the same way.
+        """
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None:
+                return False
+            for key, val in expect.items():
+                if doc.get(key) != val:
+                    return False
+            doc.update(update)
+            return True
+
+    def update(self, doc_id: str, update: Dict[str, Any]) -> bool:
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None:
+                return False
+            doc.update(update)
+            return True
+
+    def update_where(
+        self, pred: Callable[[dict], bool], update: Dict[str, Any]
+    ) -> int:
+        with self._lock:
+            n = 0
+            for doc in self._docs.values():
+                if pred(doc):
+                    doc.update(update)
+                    n += 1
+            return n
+
+    def mutate(self, doc_id: str, fn: Callable[[dict], None]) -> bool:
+        """Run ``fn`` on the document under the collection lock."""
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None:
+                return False
+            fn(doc)
+            return True
+
+    def snapshot(self) -> List[dict]:
+        """Deep-copied point-in-time view (for the snapshot builder)."""
+        with self._lock:
+            return copy.deepcopy(list(self._docs.values()))
+
+
+class Store:
+    """A namespace of collections, analogous to one Mongo database."""
+
+    def __init__(self) -> None:
+        self._collections: Dict[str, Collection] = {}
+        self._lock = threading.Lock()
+
+    def collection(self, name: str) -> Collection:
+        with self._lock:
+            coll = self._collections.get(name)
+            if coll is None:
+                coll = Collection(name)
+                self._collections[name] = coll
+            return coll
+
+    def clear_collections(self, *names: str) -> None:
+        """Test seam, mirroring the reference's db.ClearCollections pattern
+        (reference testutil usage throughout *_test.go)."""
+        with self._lock:
+            if not names:
+                for coll in self._collections.values():
+                    coll.clear()
+            else:
+                for name in names:
+                    if name in self._collections:
+                        self._collections[name].clear()
+
+    def __getitem__(self, name: str) -> Collection:
+        return self.collection(name)
+
+
+_GLOBAL_STORE: Optional[Store] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_store() -> Store:
+    """Process-wide default store (the reference's evergreen.GetEnvironment().DB()
+    analog, reference environment.go:93)."""
+    global _GLOBAL_STORE
+    with _GLOBAL_LOCK:
+        if _GLOBAL_STORE is None:
+            _GLOBAL_STORE = Store()
+        return _GLOBAL_STORE
+
+
+def reset_global_store() -> Store:
+    global _GLOBAL_STORE
+    with _GLOBAL_LOCK:
+        _GLOBAL_STORE = Store()
+        return _GLOBAL_STORE

@@ -1,0 +1,139 @@
+"""Synthetic workload generator for the BASELINE.json benchmark configs.
+
+Shapes follow BASELINE.md: (1) 1 distro × 1k tasks, (2) 50 distros × 10k
+tasks with dependency edges, (3) patch-burst 200 distros × 50k tasks with
+task groups + single-host groups, (4) mixed docker/ec2 with maxHosts caps,
+(5) churn variant for incremental re-plan.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Tuple
+
+from ..globals import Provider, Requester, STEPBACK_TASK_ACTIVATOR
+from ..models.distro import Distro, HostAllocatorSettings, PlannerSettings
+from ..models.host import Host
+from ..models.task import Dependency, Task
+from ..scheduler.serial import RunningTaskEstimate
+from ..scheduler.snapshot import compute_deps_met
+
+NOW = 1_750_000_000.0
+
+
+def generate_problem(
+    n_distros: int,
+    n_tasks: int,
+    seed: int = 0,
+    task_group_fraction: float = 0.2,
+    dep_fraction: float = 0.25,
+    patch_fraction: float = 0.4,
+    hosts_per_distro: int = 20,
+    provider_mix: Tuple[str, ...] = (Provider.MOCK.value,),
+    max_hosts: int = 100,
+) -> Tuple[
+    List[Distro],
+    Dict[str, List[Task]],
+    Dict[str, List[Host]],
+    Dict[str, RunningTaskEstimate],
+    Dict[str, bool],
+]:
+    rng = random.Random(seed)
+    distros = []
+    tasks_by_distro: Dict[str, List[Task]] = {}
+    hosts_by_distro: Dict[str, List[Host]] = {}
+    estimates: Dict[str, RunningTaskEstimate] = {}
+
+    for di in range(n_distros):
+        d = Distro(
+            id=f"d{di:03d}",
+            provider=provider_mix[di % len(provider_mix)],
+            planner_settings=PlannerSettings(
+                group_versions=di % 3 == 0,
+                patch_factor=7,
+                patch_time_in_queue_factor=2,
+                commit_queue_factor=20,
+                mainline_time_in_queue_factor=1,
+                expected_runtime_factor=1,
+                generate_task_factor=10,
+                num_dependents_factor=2.0,
+                stepback_task_factor=10,
+            ),
+            host_allocator_settings=HostAllocatorSettings(
+                minimum_hosts=di % 7 == 0 and 2 or 0,
+                maximum_hosts=max_hosts,
+                future_host_fraction=0.5,
+            ),
+        )
+        distros.append(d)
+
+        per = n_tasks // n_distros + (1 if di < n_tasks % n_distros else 0)
+        tasks: List[Task] = []
+        for ti in range(per):
+            in_group = rng.random() < task_group_fraction
+            gid = rng.randrange(6)
+            is_patch = rng.random() < patch_fraction
+            requester = (
+                rng.choice(
+                    [
+                        Requester.PATCH.value,
+                        Requester.GITHUB_PR.value,
+                        Requester.GITHUB_MERGE.value,
+                    ]
+                )
+                if is_patch
+                else Requester.REPOTRACKER.value
+            )
+            t = Task(
+                id=f"d{di:03d}-t{ti}",
+                distro_id=d.id,
+                project=f"proj{di % 10}",
+                version=f"d{di:03d}-v{rng.randrange(8)}",
+                build_variant=f"bv{rng.randrange(4)}",
+                status="undispatched",
+                activated=True,
+                requester=requester,
+                priority=rng.choice([0] * 8 + [10, 100]),
+                activated_time=NOW - rng.uniform(30, 2e5),
+                create_time=NOW - 2.5e5,
+                scheduled_time=NOW - rng.uniform(0, 4e3),
+                dependencies_met_time=NOW - rng.uniform(0, 4e3),
+                task_group=f"tg{gid}" if in_group else "",
+                task_group_max_hosts=[1, 1, 2, 2, 5, 8][gid] if in_group else 0,
+                task_group_order=ti % 5 if in_group else 0,
+                generate_task=rng.random() < 0.05,
+                activated_by=STEPBACK_TASK_ACTIVATOR if rng.random() < 0.03 else "",
+                num_dependents=rng.choice([0] * 6 + [1, 2, 5, 20]),
+                expected_duration_s=rng.uniform(10, 3600),
+            )
+            if ti > 0 and rng.random() < dep_fraction:
+                dep = tasks[rng.randrange(len(tasks))]
+                t.depends_on = [Dependency(task_id=dep.id)]
+            tasks.append(t)
+        tasks_by_distro[d.id] = tasks
+
+        hosts: List[Host] = []
+        for hi in range(hosts_per_distro):
+            h = Host(
+                id=f"d{di:03d}-h{hi}",
+                distro_id=d.id,
+                status="running",
+                creation_time=NOW - 7200,
+            )
+            if rng.random() < 0.6 and tasks:
+                rt = tasks[rng.randrange(len(tasks))]
+                h.running_task = f"d{di:03d}-running-{hi}"
+                h.running_task_group = rt.task_group
+                h.running_task_build_variant = rt.build_variant
+                h.running_task_project = rt.project
+                h.running_task_version = rt.version
+                estimates[h.id] = RunningTaskEstimate(
+                    elapsed_s=rng.uniform(0, 3600),
+                    expected_s=rng.uniform(10, 3600),
+                    std_dev_s=rng.choice([0.0, 60.0, 300.0]),
+                )
+            hosts.append(h)
+        hosts_by_distro[d.id] = hosts
+
+    all_tasks = [t for ts in tasks_by_distro.values() for t in ts]
+    deps_met = compute_deps_met(all_tasks, {})
+    return distros, tasks_by_distro, hosts_by_distro, estimates, deps_met

@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported so that
+multi-device sharding paths are exercised without TPU hardware (the analog of
+the reference's real-local-MongoDB test bootstrap, testutil/config.go:28-70).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from evergreen_tpu.storage.store import reset_global_store  # noqa: E402
+
+
+@pytest.fixture()
+def store():
+    """Fresh store per test — the db.ClearCollections analog."""
+    return reset_global_store()

@@ -1,0 +1,111 @@
+"""Core domain model + store semantics (reference analog:
+model/task, model/host package tests)."""
+import time
+
+from evergreen_tpu.globals import HostStatus, TaskStatus
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Dependency, Task
+
+
+def make_task(tid, **kw):
+    defaults = dict(
+        id=tid,
+        status=TaskStatus.UNDISPATCHED.value,
+        activated=True,
+        distro_id="d1",
+        create_time=time.time(),
+    )
+    defaults.update(kw)
+    return Task(**defaults)
+
+
+def test_task_roundtrip(store):
+    t = make_task(
+        "t1",
+        depends_on=[Dependency(task_id="t0", status="success")],
+        task_group="tg",
+        task_group_max_hosts=1,
+    )
+    task_mod.insert(store, t)
+    got = task_mod.get(store, "t1")
+    assert got is not None
+    assert got.depends_on[0].task_id == "t0"
+    assert got.is_single_host_task_group()
+
+
+def test_dependencies_met_semantics(store):
+    parent = make_task("p", status=TaskStatus.SUCCEEDED.value)
+    child_ok = make_task("c1", depends_on=[Dependency(task_id="p")])
+    child_wrong_status = make_task(
+        "c2", depends_on=[Dependency(task_id="p", status="failed")]
+    )
+    child_any = make_task("c3", depends_on=[Dependency(task_id="p", status="*")])
+    child_missing = make_task("c4", depends_on=[Dependency(task_id="nope")])
+    cache = {"p": parent}
+    assert child_ok.dependencies_met(cache)
+    assert not child_wrong_status.dependencies_met(cache)
+    assert child_any.dependencies_met(cache)
+    assert not child_missing.dependencies_met(cache)
+    child_override = make_task(
+        "c5", depends_on=[Dependency(task_id="nope")], override_dependencies=True
+    )
+    assert child_override.dependencies_met(cache)
+
+
+def test_find_host_runnable_filters(store):
+    task_mod.insert_many(
+        store,
+        [
+            make_task("runnable"),
+            make_task("inactive", activated=False),
+            make_task("started", status=TaskStatus.STARTED.value),
+            make_task("disabled", priority=-1),
+            make_task("other-distro", distro_id="d2"),
+            make_task(
+                "blocked",
+                depends_on=[Dependency(task_id="x", unattainable=True)],
+            ),
+            make_task("secondary", distro_id="d2", secondary_distros=["d1"]),
+        ],
+    )
+    got = {t.id for t in task_mod.find_host_runnable(store, "d1")}
+    assert got == {"runnable", "secondary"}
+
+
+def test_host_atomic_assignment(store):
+    h = Host(id="h1", distro_id="d1", status=HostStatus.RUNNING.value)
+    host_mod.insert(store, h)
+    t = make_task("t1", task_group="tg", build_variant="bv", version="v1", project="p1")
+    now = time.time()
+    assert host_mod.assign_running_task(store, "h1", t, now)
+    # Second assignment must fail: host already busy.
+    t2 = make_task("t2")
+    assert not host_mod.assign_running_task(store, "h1", t2, now)
+    got = host_mod.get(store, "h1")
+    assert got.running_task == "t1"
+    assert not got.is_free()
+    assert host_mod.clear_running_task(store, "h1", "t1", now)
+    got = host_mod.get(store, "h1")
+    assert got.is_free()
+    assert got.last_task == "t1"
+    assert got.last_group == "tg"
+    assert got.task_count == 1
+
+
+def test_underwater_unschedule(store):
+    now = time.time()
+    task_mod.insert_many(
+        store,
+        [
+            make_task("fresh", activated_time=now - 60),
+            make_task("stale", activated_time=now - 8 * 24 * 3600),
+        ],
+    )
+    doomed = task_mod.unschedule_stale_underwater(
+        store, "d1", now, threshold_s=7 * 24 * 3600
+    )
+    assert doomed == ["stale"]
+    assert task_mod.get(store, "stale").activated is False
+    assert task_mod.get(store, "fresh").activated is True

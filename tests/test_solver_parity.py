@@ -1,0 +1,195 @@
+"""Solver parity fuzzing: the batched device solve must agree with the
+serial reference-equivalent oracle on randomized scheduling problems.
+
+This is the analog of the reference's allocator fuzzer
+(scheduler/host_allocator_fuzzer_test.go:20-80) extended to cover the
+planner's queue ordering as well.
+"""
+import random
+import time
+
+import numpy as np
+import pytest
+
+from evergreen_tpu.globals import Provider, Requester, STEPBACK_TASK_ACTIVATOR
+from evergreen_tpu.models.distro import (
+    Distro,
+    HostAllocatorSettings,
+    PlannerSettings,
+)
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Dependency, Task
+from evergreen_tpu.ops.solve import run_solve
+from evergreen_tpu.scheduler import serial
+from evergreen_tpu.scheduler.snapshot import build_snapshot, compute_deps_met
+
+NOW = 1_700_000_000.0
+
+
+def random_problem(rng: random.Random, n_distros=3, max_tasks=40, max_hosts=10):
+    distros = []
+    tasks_by_distro = {}
+    hosts_by_distro = {}
+    estimates = {}
+    for di in range(n_distros):
+        d = Distro(
+            id=f"d{di}",
+            provider=rng.choice(
+                [Provider.MOCK.value, Provider.STATIC.value, Provider.DOCKER.value]
+            ),
+            planner_settings=PlannerSettings(
+                group_versions=rng.random() < 0.5,
+                patch_factor=rng.choice([0, 2, 10]),
+                patch_time_in_queue_factor=rng.choice([0, 1, 5]),
+                commit_queue_factor=rng.choice([0, 3]),
+                mainline_time_in_queue_factor=rng.choice([0, 1, 2]),
+                expected_runtime_factor=rng.choice([0, 1, 3]),
+                generate_task_factor=rng.choice([0, 5, 50]),
+                num_dependents_factor=rng.choice([0.0, 1.0, 2.5]),
+                stepback_task_factor=rng.choice([0, 10]),
+                target_time_s=rng.choice([0.0, 600.0, 1800.0]),
+            ),
+            host_allocator_settings=HostAllocatorSettings(
+                minimum_hosts=rng.choice([0, 0, 2]),
+                maximum_hosts=rng.choice([1, 5, 50, 1000]),
+                future_host_fraction=rng.choice([0.0, 0.5, 1.0]),
+                rounding_rule=rng.choice(["round-down", "round-up"]),
+                feedback_rule=rng.choice(["waits-over-thresh", "no-feedback"]),
+            ),
+            disabled=rng.random() < 0.1,
+        )
+        distros.append(d)
+
+        n_tasks = rng.randrange(0, max_tasks)
+        tasks = []
+        for ti in range(n_tasks):
+            in_group = rng.random() < 0.3
+            group_id = rng.randrange(3)
+            requester = rng.choice(
+                [
+                    Requester.REPOTRACKER.value,
+                    Requester.PATCH.value,
+                    Requester.GITHUB_PR.value,
+                    Requester.GITHUB_MERGE.value,
+                ]
+            )
+            t = Task(
+                id=f"{d.id}-t{ti}",
+                distro_id=d.id,
+                project="proj",
+                version=f"{d.id}-v{rng.randrange(3)}",
+                build_variant=f"bv{rng.randrange(2)}",
+                status="undispatched",
+                activated=True,
+                requester=requester,
+                priority=rng.choice([0, 0, 1, 50, 100]),
+                activated_time=NOW - rng.uniform(0, 3e5),
+                create_time=NOW - 4e5,
+                scheduled_time=NOW - rng.uniform(0, 4e3),
+                dependencies_met_time=NOW - rng.uniform(0, 4e3),
+                task_group=f"tg{group_id}" if in_group else "",
+                # max-hosts is uniform per group in reality (it comes from the
+                # task_group YAML definition) — keep the fixture consistent.
+                task_group_max_hosts=[1, 2, 5][group_id] if in_group else 0,
+                task_group_order=rng.randrange(5) if in_group else 0,
+                generate_task=rng.random() < 0.1,
+                activated_by=STEPBACK_TASK_ACTIVATOR
+                if rng.random() < 0.1
+                else "",
+                num_dependents=rng.choice([0, 0, 1, 7]),
+                expected_duration_s=rng.uniform(10, 4000),
+            )
+            if ti > 0 and rng.random() < 0.3:
+                dep = tasks[rng.randrange(len(tasks))]
+                t.depends_on = [Dependency(task_id=dep.id)]
+            # some tasks depend on already-finished external tasks
+            if rng.random() < 0.2:
+                t.depends_on.append(
+                    Dependency(task_id=f"ext-{rng.randrange(5)}")
+                )
+            tasks.append(t)
+        tasks_by_distro[d.id] = tasks
+
+        hosts = []
+        for hi in range(rng.randrange(0, max_hosts)):
+            h = Host(
+                id=f"{d.id}-h{hi}",
+                distro_id=d.id,
+                status="running",
+                creation_time=NOW - 3600,
+            )
+            if rng.random() < 0.5 and tasks:
+                rt = tasks[rng.randrange(len(tasks))]
+                h.running_task = f"running-{hi}"
+                h.running_task_group = rt.task_group
+                h.running_task_build_variant = rt.build_variant
+                h.running_task_project = rt.project
+                h.running_task_version = rt.version
+                estimates[h.id] = serial.RunningTaskEstimate(
+                    elapsed_s=rng.uniform(0, 4000),
+                    expected_s=rng.uniform(10, 4000),
+                    std_dev_s=rng.choice([0.0, 30.0, 200.0]),
+                )
+            hosts.append(h)
+        hosts_by_distro[d.id] = hosts
+
+    # external finished parents: even ids succeeded, odd failed
+    finished = {f"ext-{i}": ("success" if i % 2 == 0 else "failed") for i in range(5)}
+    all_tasks = [t for ts in tasks_by_distro.values() for t in ts]
+    deps_met = compute_deps_met(all_tasks, finished)
+    return distros, tasks_by_distro, hosts_by_distro, estimates, deps_met
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_device_matches_serial_oracle(seed):
+    rng = random.Random(seed)
+    distros, tasks_by_distro, hosts_by_distro, estimates, deps_met = random_problem(
+        rng
+    )
+
+    expected = serial.serial_tick(
+        distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
+    )
+
+    snapshot = build_snapshot(
+        distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
+    )
+    out = run_solve(snapshot.arrays)
+
+    # Unpack device ordering per distro.
+    t_distro = snapshot.arrays["t_distro"]
+    got_orders = {d.id: [] for d in distros}
+    for idx in out["order"]:
+        if idx >= snapshot.n_tasks:
+            continue
+        did = snapshot.distro_ids[t_distro[idx]]
+        got_orders[did].append(snapshot.task_ids[idx])
+
+    for di, d in enumerate(distros):
+        plan, info, n_new, _ = expected[d.id]
+        want_order = [t.id for t in plan]
+        assert got_orders[d.id] == want_order, (
+            f"seed={seed} distro={d.id}: queue order mismatch\n"
+            f"want={want_order}\ngot={got_orders[d.id]}"
+        )
+        assert int(out["d_new_hosts"][di]) == n_new, (
+            f"seed={seed} distro={d.id}: new hosts mismatch "
+            f"want={n_new} got={int(out['d_new_hosts'][di])}"
+        )
+        assert int(out["d_length"][di]) == info.length
+        assert int(out["d_deps_met"][di]) == info.length_with_dependencies_met
+        assert int(out["d_over_count"][di]) == info.count_duration_over_threshold
+        assert int(out["d_wait_over"][di]) == info.count_wait_over_threshold
+        np.testing.assert_allclose(
+            float(out["d_expected_dur_s"][di]),
+            info.expected_duration_s,
+            rtol=1e-4,
+        )
+
+
+def test_empty_problem():
+    distros = [Distro(id="d0")]
+    snapshot = build_snapshot(distros, {"d0": []}, {"d0": []}, {}, {}, NOW)
+    out = run_solve(snapshot.arrays)
+    assert int(out["d_new_hosts"][0]) == 0
+    assert int(out["d_length"][0]) == 0

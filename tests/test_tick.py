@@ -1,0 +1,119 @@
+"""Full scheduling tick through the store: snapshot → batched solve →
+persisted queues + intent hosts (the PlanDistro + host-allocator job
+pipeline, reference scheduler/wrapper.go:30 + units/host_allocator.go:77)."""
+import time
+
+from evergreen_tpu.globals import HostStatus, PlannerVersion, Provider
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import task_queue as tq_mod
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Dependency, Task
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+NOW = 1_700_000_000.0
+
+
+def seed_problem(store):
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1",
+            provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=10),
+        ),
+    )
+    tasks = [
+        Task(
+            id=f"t{i}",
+            distro_id="d1",
+            project="p",
+            version="v1",
+            build_variant="bv",
+            status="undispatched",
+            activated=True,
+            requester="gitter_request",
+            activated_time=NOW - 600,
+            create_time=NOW - 700,
+            scheduled_time=NOW - 600,
+            expected_duration_s=300.0,
+            priority=i,  # later tasks sort first
+        )
+        for i in range(5)
+    ]
+    # t0 depends on t4 (in queue, unmet); t1 depends on a finished task.
+    tasks[0].depends_on = [Dependency(task_id="t4")]
+    tasks[0].num_dependents = 0
+    tasks[4].num_dependents = 1
+    tasks[1].depends_on = [Dependency(task_id="done1")]
+    finished = Task(
+        id="done1", distro_id="d1", status="success", activated=True
+    )
+    task_mod.insert_many(store, tasks + [finished])
+    return tasks
+
+
+def test_tick_persists_queue_and_intents(store):
+    seed_problem(store)
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert res.n_distros == 1
+    assert res.n_tasks == 5
+
+    q = tq_mod.load(store, "d1")
+    assert q is not None
+    assert q.length() == 5
+    # Priority dominates the unit value formula → descending by priority,
+    # except t0 rides in t4's unit via the dependency-closure grouping
+    # (planner.go:448-456) and sorts after it (fewer dependents).
+    assert [i.id for i in q.queue] == ["t4", "t0", "t3", "t2", "t1"]
+    # t0's dependency is in-queue → unmet; others met.
+    met = {i.id: i.dependencies_met for i in q.queue}
+    assert met == {"t0": False, "t1": True, "t2": True, "t3": True, "t4": True}
+    assert q.info.length_with_dependencies_met == 4
+
+    # Allocator: 4 deps-met short tasks × 300s = 1200s / 1800s → <1 host,
+    # no free hosts → the small-queue rescue spawns exactly 1.
+    assert res.new_hosts["d1"] == 1
+    assert len(res.intent_hosts) == 1
+    intents = host_mod.find(
+        store, lambda d: d["status"] == HostStatus.UNINITIALIZED.value
+    )
+    assert len(intents) == 1
+    assert intents[0].distro_id == "d1"
+
+    # Tasks got scheduled_time stamped.
+    assert task_mod.get(store, "t4").scheduled_time > 0
+
+
+def test_tick_serial_and_tpu_agree_through_store(store):
+    seed_problem(store)
+    res_tpu = run_tick(
+        store, TickOptions(create_intent_hosts=False), now=NOW
+    )
+    q_tpu = tq_mod.load(store, "d1")
+    res_serial = run_tick(
+        store,
+        TickOptions(
+            create_intent_hosts=False,
+            planner_version=PlannerVersion.TUNABLE.value,
+        ),
+        now=NOW,
+    )
+    q_serial = tq_mod.load(store, "d1")
+    assert [i.id for i in q_tpu.queue] == [i.id for i in q_serial.queue]
+    assert res_tpu.new_hosts == res_serial.new_hosts
+
+
+def test_intent_host_global_cap(store):
+    seed_problem(store)
+    # Pre-fill intent hosts to the cap: no new intents may be created.
+    for i in range(3):
+        host_mod.insert(
+            store,
+            Host(id=f"intent{i}", distro_id="d1",
+                 status=HostStatus.UNINITIALIZED.value),
+        )
+    res = run_tick(store, TickOptions(max_intent_hosts=3), now=NOW)
+    assert len(res.intent_hosts) == 0
