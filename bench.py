@@ -14,7 +14,7 @@ import statistics
 import sys
 import time
 
-from evergreen_tpu.ops.solve import run_solve
+from evergreen_tpu.ops.solve import run_solve_packed
 from evergreen_tpu.scheduler import serial
 from evergreen_tpu.scheduler.snapshot import build_snapshot
 from evergreen_tpu.utils.benchgen import NOW, generate_problem
@@ -43,7 +43,7 @@ def main() -> None:
     snap = build_snapshot(
         distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
     )
-    run_solve(snap.arrays)
+    run_solve_packed(snap)
 
     tick_ms = []
     snap_ms = []
@@ -54,7 +54,7 @@ def main() -> None:
             distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
         )
         t2 = time.perf_counter()
-        run_solve(snap.arrays)
+        run_solve_packed(snap)
         t3 = time.perf_counter()
         snap_ms.append((t2 - t1) * 1e3)
         solve_ms.append((t3 - t2) * 1e3)

@@ -1,0 +1,85 @@
+"""Packed transfer layout for the solve.
+
+The axon TPU tunnel pays a round trip per host↔device transfer, so shipping
+~40 input arrays and ~20 outputs individually dominates tick latency. The
+snapshot builder allocates every array as a view into one of three typed
+arenas (f32 / i32 / u8-bool); the jitted program receives exactly three
+device buffers, slices the fields out (static offsets), runs the solve, and
+re-packs outputs into two buffers. One compiled program, five transfers
+total.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_DTYPES = {
+    "f32": np.float32,
+    "i32": np.int32,
+    "u8": np.uint8,
+}
+
+
+class Arena:
+    """Allocates named 1-D views out of three typed buffers."""
+
+    def __init__(self) -> None:
+        self._plan: List[Tuple[str, str, int]] = []  # (name, kind, size)
+        self._bufs: Dict[str, np.ndarray] = {}
+        self._layout: Dict[str, Tuple[str, int, int]] = {}
+        self._sizes = {"f32": 0, "i32": 0, "u8": 0}
+        self._finalized = False
+
+    def alloc(self, name: str, size: int, kind: str) -> None:
+        assert not self._finalized
+        self._plan.append((name, kind, size))
+        self._layout[name] = (kind, self._sizes[kind], size)
+        self._sizes[kind] += size
+
+    def finalize(self) -> None:
+        for kind, total in self._sizes.items():
+            self._bufs[kind] = np.zeros(max(total, 1), dtype=_DTYPES[kind])
+        self._finalized = True
+
+    def view(self, name: str) -> np.ndarray:
+        kind, off, size = self._layout[name]
+        return self._bufs[kind][off : off + size]
+
+    @property
+    def buffers(self) -> Dict[str, np.ndarray]:
+        return self._bufs
+
+    def layout_key(self) -> Tuple:
+        """Hashable static layout for jit."""
+        return tuple(self._plan)
+
+
+def unpack(bufs: Dict, layout_key: Tuple) -> Dict:
+    """Inside-jit: slice the three buffers back into the named arrays.
+    Bool fields (u8) are re-cast; offsets are trace-time constants so XLA
+    sees plain static slices."""
+    import jax.numpy as jnp
+
+    offsets = {"f32": 0, "i32": 0, "u8": 0}
+    out = {}
+    for name, kind, size in layout_key:
+        off = offsets[kind]
+        sl = jnp.asarray(bufs[kind])[off : off + size]
+        offsets[kind] = off + size
+        out[name] = sl.astype(jnp.bool_) if _is_bool_field(name) else sl
+    return out
+
+
+_BOOL_FIELDS = {
+    "t_valid", "t_is_merge", "t_is_patch", "t_stepback", "t_generate",
+    "t_in_group", "t_deps_met", "m_valid", "g_unnamed", "g_valid",
+    "h_valid", "h_free", "h_running", "d_valid", "d_round_up", "d_feedback",
+    "d_disabled", "d_ephemeral", "d_is_docker",
+}
+
+
+def _is_bool_field(name: str) -> bool:
+    return name in _BOOL_FIELDS
+
+

@@ -308,3 +308,70 @@ def run_solve(arrays: Dict) -> Dict:
     fn = _compiled_solve()
     out = fn(arrays)
     return {k: jax.device_get(v) for k, v in out.items()}
+
+
+# --------------------------------------------------------------------------- #
+# Packed transfer path (ops/packing.py): 3 buffers in, 2 buffers out —
+# minimizes host↔device round trips, which dominate tick latency over the
+# tunnel-attached TPU.
+# --------------------------------------------------------------------------- #
+
+#: output name → (dtype kind, dim symbol); dims resolve from the shape key
+#: (N tasks, G segments, D distros).
+OUTPUT_SPEC = (
+    ("order", "i32", "N"),
+    ("t_unit", "i32", "N"),
+    ("d_new_hosts", "i32", "D"),
+    ("d_free_approx", "i32", "D"),
+    ("d_length", "i32", "D"),
+    ("d_deps_met", "i32", "D"),
+    ("d_over_count", "i32", "D"),
+    ("d_wait_over", "i32", "D"),
+    ("d_merge", "i32", "D"),
+    ("g_count", "i32", "G"),
+    ("g_count_free", "i32", "G"),
+    ("g_count_required", "i32", "G"),
+    ("g_over_count", "i32", "G"),
+    ("g_wait_over", "i32", "G"),
+    ("g_merge", "i32", "G"),
+    ("t_value", "f32", "N"),
+    ("d_expected_dur_s", "f32", "D"),
+    ("d_over_dur_s", "f32", "D"),
+    ("g_expected_dur_s", "f32", "G"),
+    ("g_over_dur_s", "f32", "G"),
+)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _packed_solve(bufs: Dict, layout_key):
+    from .packing import unpack
+
+    a = unpack(bufs, layout_key)
+    out = solve(a)
+    i32_buf = jnp.concatenate(
+        [out[name] for name, kind, _ in OUTPUT_SPEC if kind == "i32"]
+    )
+    f32_buf = jnp.concatenate(
+        [out[name] for name, kind, _ in OUTPUT_SPEC if kind == "f32"]
+    )
+    return i32_buf, f32_buf
+
+
+def run_solve_packed(snapshot) -> Dict:
+    """One tick's device work with five transfers total: three arena
+    buffers up, two packed result buffers down."""
+    i32_buf, f32_buf = _packed_solve(
+        snapshot.arena.buffers, snapshot.arena.layout_key()
+    )
+    i32_np, f32_np = jax.device_get((i32_buf, f32_buf))
+
+    N, _, _, G, _, D = snapshot.shape_key()
+    dims = {"N": N, "G": G, "D": D}
+    out: Dict = {}
+    offs = {"i32": 0, "f32": 0}
+    bufs_np = {"i32": i32_np, "f32": f32_np}
+    for name, kind, dim in OUTPUT_SPEC:
+        size = dims[dim]
+        out[name] = bufs_np[kind][offs[kind] : offs[kind] + size]
+        offs[kind] += size
+    return out

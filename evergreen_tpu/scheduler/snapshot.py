@@ -12,13 +12,14 @@ packing pass that produces the tensor inputs of the batched TPU solve:
   * distro settings matrix [D]
 
 All arrays are padded to bucket sizes (geometric growth) so queue churn does
-not trigger recompilation storms (SURVEY §7 "ragged data on TPU").
+not trigger recompilation storms (SURVEY §7 "ragged data on TPU"), and all
+are views into three typed transfer arenas (ops/packing.py) so one tick
+ships exactly three host→device buffers.
 """
 from __future__ import annotations
 
 import dataclasses
-import math
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -26,19 +27,90 @@ from ..globals import (
     FeedbackRule,
     Provider,
     RoundingRule,
+    STEPBACK_TASK_ACTIVATOR,
     is_github_merge_queue_requester,
     is_patch_requester,
 )
 from ..models.distro import Distro
 from ..models.host import Host
 from ..models.task import Task
-from .serial import RunningTaskEstimate, prepare_units
+from ..ops.packing import Arena
+from .serial import RunningTaskEstimate
+
+
+def build_memberships(
+    distro: Distro, tasks: List[Task], base: int
+) -> Tuple[int, List[int], List[int]]:
+    """Snapshot-specialized unit grouping: returns
+    (n_units, membership task indices, membership unit indices).
+
+    Semantics identical to serial.prepare_units (the oracle form of
+    reference scheduler/planner.go:431-459) including unit creation ORDER —
+    unit index is the planner's deterministic tie-break — but without
+    per-unit object allocation. The parity fuzzer pins the equivalence.
+    """
+    group_versions = distro.planner_settings.group_versions
+    key_to_unit: Dict[str, int] = {}   # group-string / version / task-id keys
+    task_unit: Dict[str, int] = {}     # task id -> registered unit
+    mem_by_task: List[List[int]] = []
+    n_units = 0
+
+    for t in tasks:
+        units_of_t: List[int] = []
+        if t.task_group:
+            k = t.task_group_string()
+            u = key_to_unit.get(k)
+            if u is None:
+                u = key_to_unit[k] = n_units
+                n_units += 1
+            units_of_t.append(u)
+            task_unit.setdefault(t.id, u)
+            if group_versions:
+                v = key_to_unit.get(t.version)
+                if v is None:
+                    v = key_to_unit[t.version] = n_units
+                    n_units += 1
+                if v not in units_of_t:
+                    units_of_t.append(v)
+        elif group_versions:
+            v = key_to_unit.get(t.version)
+            if v is None:
+                v = key_to_unit[t.version] = n_units
+                n_units += 1
+            units_of_t.append(v)
+            task_unit.setdefault(t.id, v)
+        else:
+            u = n_units
+            n_units += 1
+            units_of_t.append(u)
+            task_unit[t.id] = u
+        mem_by_task.append(units_of_t)
+
+    # dependency-closure pass: a task joins the unit registered under each
+    # of its dependencies' ids (planner.go:448-456)
+    for j, t in enumerate(tasks):
+        if t.depends_on:
+            lst = mem_by_task[j]
+            for dep in t.depends_on:
+                u = task_unit.get(dep.task_id)
+                if u is not None and u not in lst:
+                    lst.append(u)
+
+    m_task: List[int] = []
+    m_unit: List[int] = []
+    for j, lst in enumerate(mem_by_task):
+        ti = base + j
+        for u in lst:
+            m_task.append(ti)
+            m_unit.append(u)
+    return n_units, m_task, m_unit
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
     """Round up to the next bucket size: powers of two interleaved with
     1.5× midpoints, so padding waste stays ≤ 50% while distinct compiled
-    shapes grow only logarithmically with queue size."""
+    shapes grow only logarithmically with queue size. All buckets ≥ 32 are
+    multiples of 16, so power-of-two meshes divide them evenly."""
     if n <= minimum:
         return minimum
     lo = 1 << (int(n).bit_length() - 1)
@@ -66,8 +138,9 @@ class Snapshot:
     n_hosts: int
     n_segs: int
     n_distros: int
-    #: dict of numpy arrays (see build_snapshot for the schema)
+    #: named views into the transfer arenas (bool fields exposed as bool)
     arrays: Dict[str, np.ndarray]
+    arena: Arena = None
 
     def shape_key(self) -> Tuple[int, ...]:
         a = self.arrays
@@ -115,6 +188,47 @@ def compute_deps_met(
     return met
 
 
+#: field name → arena kind; the single source of truth for the layout.
+FIELD_KINDS: Dict[str, str] = {
+    # tasks [N]
+    "t_valid": "u8", "t_distro": "i32", "t_priority": "i32",
+    "t_is_merge": "u8", "t_is_patch": "u8", "t_stepback": "u8",
+    "t_generate": "u8", "t_in_group": "u8", "t_group_order": "i32",
+    "t_time_in_queue_s": "f32", "t_expected_s": "f32",
+    "t_wait_dep_met_s": "f32", "t_num_dependents": "i32",
+    "t_deps_met": "u8", "t_seg": "i32",
+    # memberships [M]
+    "m_task": "i32", "m_unit": "i32", "m_valid": "u8",
+    # units [U]
+    "u_distro": "i32",
+    # segments [G]
+    "g_distro": "i32", "g_unnamed": "u8", "g_max_hosts": "i32",
+    "g_valid": "u8",
+    # hosts [H]
+    "h_valid": "u8", "h_distro": "i32", "h_seg": "i32", "h_free": "u8",
+    "h_running": "u8", "h_elapsed_s": "f32", "h_expected_s": "f32",
+    "h_std_s": "f32",
+    # distros [D]
+    "d_valid": "u8", "d_min_hosts": "i32", "d_max_hosts": "i32",
+    "d_future_fraction": "f32", "d_round_up": "u8", "d_feedback": "u8",
+    "d_disabled": "u8", "d_ephemeral": "u8", "d_is_docker": "u8",
+    "d_thresh_s": "f32", "d_patch_factor": "f32", "d_patch_tiq_factor": "f32",
+    "d_cq_factor": "f32", "d_mainline_tiq_factor": "f32",
+    "d_runtime_factor": "f32", "d_generate_factor": "f32",
+    "d_numdep_factor": "f32", "d_stepback_factor": "f32",
+}
+
+_DIM_OF_FIELD = {
+    "t_": "N", "m_": "M", "u_": "U", "g_": "G", "h_": "H", "d_": "D",
+}
+
+
+def _factor(v: float) -> float:
+    """Reference fallback: factors ≤ 0 resolve to 1
+    (model/distro/distro.go:352-405)."""
+    return float(v) if v > 0 else 1.0
+
+
 def build_snapshot(
     distros: List[Distro],
     tasks_by_distro: Dict[str, List[Task]],
@@ -136,25 +250,21 @@ def build_snapshot(
     for d in distros:
         tasks = tasks_by_distro.get(d.id, [])
         base = len(flat_tasks)
-        units, membership = prepare_units(d, tasks)
-        local_index = {t.id: base + j for j, t in enumerate(tasks)}
-        for t in tasks:
-            flat_tasks.append(t)
-            t_distro.append(d_index[d.id])
-        for u in units:
-            u_distro.append(d_index[d.id])
-        for tid, unit_idxs in membership.items():
-            for ui in unit_idxs:
-                m_task.append(local_index[tid])
-                m_unit.append(unit_base + ui)
-        unit_base += len(units)
+        n_units_d, mt, mu = build_memberships(d, tasks, base)
+        di = d_index[d.id]
+        flat_tasks.extend(tasks)
+        t_distro.extend([di] * len(tasks))
+        u_distro.extend([di] * n_units_d)
+        m_task.extend(mt)
+        m_unit.extend(mu if unit_base == 0 else [u + unit_base for u in mu])
+        unit_base += n_units_d
 
     n_t, n_m, n_u = len(flat_tasks), len(m_task), len(u_distro)
 
     # ---- allocator segments: one "" segment per distro + named groups ----- #
     seg_index: Dict[Tuple[int, str], int] = {}
     seg_names: List[Tuple[int, str]] = []
-    seg_max_hosts: List[int] = []
+    seg_max_hosts_l: List[int] = []
 
     def seg_for(di: int, name: str, max_hosts: int = 0) -> int:
         key = (di, name)
@@ -163,184 +273,163 @@ def build_snapshot(
             idx = len(seg_names)
             seg_index[key] = idx
             seg_names.append(key)
-            seg_max_hosts.append(max_hosts)
-        elif max_hosts and not seg_max_hosts[idx]:
-            seg_max_hosts[idx] = max_hosts
+            seg_max_hosts_l.append(max_hosts)
+        elif max_hosts and not seg_max_hosts_l[idx]:
+            seg_max_hosts_l[idx] = max_hosts
         return idx
 
     for di in range(n_d):
         seg_for(di, "")
 
-    t_seg = np.zeros(n_t, dtype=np.int32)
-    for i, t in enumerate(flat_tasks):
-        di = t_distro[i]
-        name = t.task_group_string() if t.task_group else ""
-        t_seg[i] = seg_for(di, name, t.task_group_max_hosts)
+    t_seg = [
+        seg_for(
+            t_distro[i],
+            t.task_group_string() if t.task_group else "",
+            t.task_group_max_hosts,
+        )
+        for i, t in enumerate(flat_tasks)
+    ]
 
     # ---- hosts ------------------------------------------------------------ #
     flat_hosts: List[Host] = []
     h_distro: List[int] = []
     h_seg: List[int] = []
     for d in distros:
+        di = d_index[d.id]
         for h in hosts_by_distro.get(d.id, []):
-            di = d_index[d.id]
             flat_hosts.append(h)
             h_distro.append(di)
-            name = ""
-            if h.running_task and h.running_task_group:
-                name = h.task_group_string()
+            name = (
+                h.task_group_string()
+                if h.running_task and h.running_task_group
+                else ""
+            )
             h_seg.append(seg_for(di, name))
     n_h = len(flat_hosts)
     n_g = len(seg_names)
 
-    # ---- padded allocation ------------------------------------------------ #
+    # ---- padded arena allocation ------------------------------------------ #
     N = _bucket(max(n_t, 1))
     M = _bucket(max(n_m, 1))
     U = _bucket(max(n_u, 1))
     G = _bucket(max(n_g, 1))
     H = _bucket(max(n_h, 1))
     D = _bucket(max(n_d, 1), minimum=8)
+    dims = {"N": N, "M": M, "U": U, "G": G, "H": H, "D": D}
+
+    arena = Arena()
+    for name, kind in FIELD_KINDS.items():
+        arena.alloc(name, dims[_DIM_OF_FIELD[name[:2]]], kind)
+    arena.finalize()
 
     a: Dict[str, np.ndarray] = {}
+    for name, kind in FIELD_KINDS.items():
+        v = arena.view(name)
+        a[name] = v.view(np.bool_) if kind == "u8" else v
 
-    def zeros(name, size, dtype):
-        arr = np.zeros(size, dtype=dtype)
-        a[name] = arr
+    def fill(name: str, values, pad=0):
+        arr = a[name]
+        if pad:
+            arr[:] = pad
+        n = len(values)
+        if n:
+            arr[:n] = values
         return arr
 
-    # task arrays
-    t_valid = zeros("t_valid", N, np.bool_)
-    t_distro_a = np.full(N, D - 1, dtype=np.int32)
-    a["t_distro"] = t_distro_a
-    t_priority = zeros("t_priority", N, np.int32)
-    t_is_merge = zeros("t_is_merge", N, np.bool_)
-    t_is_patch = zeros("t_is_patch", N, np.bool_)
-    t_stepback = zeros("t_stepback", N, np.bool_)
-    t_generate = zeros("t_generate", N, np.bool_)
-    t_in_group = zeros("t_in_group", N, np.bool_)
-    t_group_order = zeros("t_group_order", N, np.int32)
-    t_time_in_queue = zeros("t_time_in_queue_s", N, np.float32)
-    t_expected = zeros("t_expected_s", N, np.float32)
-    t_wait_dep_met = zeros("t_wait_dep_met_s", N, np.float32)
-    t_num_dependents = zeros("t_num_dependents", N, np.int32)
-    t_deps_met = zeros("t_deps_met", N, np.bool_)
-    t_seg_a = np.full(N, G - 1, dtype=np.int32)
-    a["t_seg"] = t_seg_a
+    # task columns (vectorized python→numpy conversion, one pass per field)
+    fill("t_valid", [True] * n_t)
+    fill("t_distro", t_distro, pad=D - 1)
+    fill("t_priority", [t.priority for t in flat_tasks])
+    merge_flags = [is_github_merge_queue_requester(t.requester) for t in flat_tasks]
+    fill("t_is_merge", merge_flags)
+    fill(
+        "t_is_patch",
+        [
+            (not m) and is_patch_requester(t.requester)
+            for m, t in zip(merge_flags, flat_tasks)
+        ],
+    )
+    fill(
+        "t_stepback",
+        [t.activated_by == STEPBACK_TASK_ACTIVATOR for t in flat_tasks],
+    )
+    fill("t_generate", [t.generate_task for t in flat_tasks])
+    fill("t_in_group", [bool(t.task_group) for t in flat_tasks])
+    fill("t_group_order", [t.task_group_order for t in flat_tasks])
+    fill(
+        "t_time_in_queue_s",
+        [
+            max(0.0, now - (t.activated_time or t.ingest_time))
+            if (t.activated_time or t.ingest_time) > 0.0
+            else 0.0
+            for t in flat_tasks
+        ],
+    )
+    fill("t_expected_s", [t.expected_duration_s for t in flat_tasks])
+    fill(
+        "t_wait_dep_met_s",
+        [
+            max(0.0, now - max(t.scheduled_time, t.dependencies_met_time))
+            if max(t.scheduled_time, t.dependencies_met_time) > 0.0
+            else 0.0
+            for t in flat_tasks
+        ],
+    )
+    fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
+    fill("t_deps_met", [deps_met.get(t.id, True) for t in flat_tasks])
+    fill("t_seg", t_seg, pad=G - 1)
 
-    for i, t in enumerate(flat_tasks):
-        t_valid[i] = True
-        t_distro_a[i] = t_distro[i]
-        t_priority[i] = t.priority
-        merge = is_github_merge_queue_requester(t.requester)
-        t_is_merge[i] = merge
-        t_is_patch[i] = (not merge) and is_patch_requester(t.requester)
-        t_stepback[i] = t.is_stepback_activated()
-        t_generate[i] = t.generate_task
-        t_in_group[i] = bool(t.task_group)
-        t_group_order[i] = t.task_group_order
-        t_time_in_queue[i] = t.time_in_queue(now)
-        t_expected[i] = t.expected_duration_s
-        t_wait_dep_met[i] = t.wait_since_dependencies_met(now)
-        t_num_dependents[i] = t.num_dependents
-        t_deps_met[i] = deps_met.get(t.id, True)
-        t_seg_a[i] = t_seg[i]
+    # memberships (padding points at dummy task N-1 / unit U-1)
+    fill("m_task", m_task, pad=N - 1)
+    fill("m_unit", m_unit, pad=U - 1)
+    fill("m_valid", [True] * n_m)
 
-    # membership arrays (padding points at dummy task N-1 / unit U-1)
-    m_task_a = np.full(M, N - 1, dtype=np.int32)
-    m_unit_a = np.full(M, U - 1, dtype=np.int32)
-    m_valid = zeros("m_valid", M, np.bool_)
-    if n_m:
-        m_task_a[:n_m] = m_task
-        m_unit_a[:n_m] = m_unit
-        m_valid[:n_m] = True
-    a["m_task"] = m_task_a
-    a["m_unit"] = m_unit_a
+    fill("u_distro", u_distro, pad=D - 1)
 
-    # unit arrays
-    u_distro_a = np.full(U, D - 1, dtype=np.int32)
-    if n_u:
-        u_distro_a[:n_u] = u_distro
-    a["u_distro"] = u_distro_a
+    # segments
+    fill("g_distro", [di for di, _ in seg_names], pad=D - 1)
+    fill("g_unnamed", [name == "" for _, name in seg_names])
+    fill("g_max_hosts", seg_max_hosts_l)
+    fill("g_valid", [True] * n_g)
 
-    # segment arrays
-    g_distro = np.full(G, D - 1, dtype=np.int32)
-    g_unnamed = zeros("g_unnamed", G, np.bool_)
-    g_max_hosts = zeros("g_max_hosts", G, np.int32)
-    g_valid = zeros("g_valid", G, np.bool_)
-    for gi, (di, name) in enumerate(seg_names):
-        g_distro[gi] = di
-        g_unnamed[gi] = name == ""
-        g_max_hosts[gi] = seg_max_hosts[gi]
-        g_valid[gi] = True
-    a["g_distro"] = g_distro
-
-    # host arrays
-    h_valid = zeros("h_valid", H, np.bool_)
-    h_distro_a = np.full(H, D - 1, dtype=np.int32)
-    a["h_distro"] = h_distro_a
-    h_seg_a = np.full(H, G - 1, dtype=np.int32)
-    a["h_seg"] = h_seg_a
-    h_free = zeros("h_free", H, np.bool_)
-    h_running = zeros("h_running", H, np.bool_)
-    h_elapsed = zeros("h_elapsed_s", H, np.float32)
-    h_expected = zeros("h_expected_s", H, np.float32)
-    h_std = zeros("h_std_s", H, np.float32)
-    for i, h in enumerate(flat_hosts):
-        h_valid[i] = True
-        h_distro_a[i] = h_distro[i]
-        h_seg_a[i] = h_seg[i]
-        h_free[i] = h.is_free()
-        running = bool(h.running_task)
-        est = running_estimates.get(h.id)
-        h_running[i] = running and est is not None
-        if running and est is not None:
-            h_elapsed[i] = est.elapsed_s
-            h_expected[i] = est.expected_s
-            h_std[i] = est.std_dev_s
+    # hosts
+    fill("h_valid", [True] * n_h)
+    fill("h_distro", h_distro, pad=D - 1)
+    fill("h_seg", h_seg, pad=G - 1)
+    fill("h_free", [h.is_free() for h in flat_hosts])
+    ests = [running_estimates.get(h.id) if h.running_task else None for h in flat_hosts]
+    fill("h_running", [e is not None for e in ests])
+    fill("h_elapsed_s", [e.elapsed_s if e else 0.0 for e in ests])
+    fill("h_expected_s", [e.expected_s if e else 0.0 for e in ests])
+    fill("h_std_s", [e.std_dev_s if e else 0.0 for e in ests])
 
     # distro settings matrix
-    d_valid = zeros("d_valid", D, np.bool_)
-    d_min_hosts = zeros("d_min_hosts", D, np.int32)
-    d_max_hosts = zeros("d_max_hosts", D, np.int32)
-    d_future_fraction = zeros("d_future_fraction", D, np.float32)
-    d_round_up = zeros("d_round_up", D, np.bool_)
-    d_feedback = zeros("d_feedback", D, np.bool_)
-    d_disabled = zeros("d_disabled", D, np.bool_)
-    d_ephemeral = zeros("d_ephemeral", D, np.bool_)
-    d_is_docker = zeros("d_is_docker", D, np.bool_)
-    d_thresh = zeros("d_thresh_s", D, np.float32)
-    d_patch_factor = zeros("d_patch_factor", D, np.float32)
-    d_patch_tiq_factor = zeros("d_patch_tiq_factor", D, np.float32)
-    d_cq_factor = zeros("d_cq_factor", D, np.float32)
-    d_mainline_tiq_factor = zeros("d_mainline_tiq_factor", D, np.float32)
-    d_runtime_factor = zeros("d_runtime_factor", D, np.float32)
-    d_generate_factor = zeros("d_generate_factor", D, np.float32)
-    d_numdep_factor = zeros("d_numdep_factor", D, np.float32)
-    d_stepback_factor = zeros("d_stepback_factor", D, np.float32)
-
-    def factor(v: float) -> float:
-        return float(v) if v > 0 else 1.0
-
-    for i, d in enumerate(distros):
-        ps, hs = d.planner_settings, d.host_allocator_settings
-        d_valid[i] = True
-        d_min_hosts[i] = hs.minimum_hosts
-        d_max_hosts[i] = hs.maximum_hosts
-        d_future_fraction[i] = hs.future_host_fraction
-        d_round_up[i] = hs.rounding_rule == RoundingRule.UP.value
-        d_feedback[i] = hs.feedback_rule == FeedbackRule.WAITS_OVER_THRESH.value
-        d_disabled[i] = d.disabled
-        d_ephemeral[i] = d.is_ephemeral()
-        d_is_docker[i] = d.provider == Provider.DOCKER.value
-        d_thresh[i] = ps.max_duration_per_host_s()
-        d_patch_factor[i] = factor(ps.patch_factor)
-        d_patch_tiq_factor[i] = factor(ps.patch_time_in_queue_factor)
-        d_cq_factor[i] = factor(ps.commit_queue_factor)
-        d_mainline_tiq_factor[i] = factor(ps.mainline_time_in_queue_factor)
-        d_runtime_factor[i] = factor(ps.expected_runtime_factor)
-        d_generate_factor[i] = factor(ps.generate_task_factor)
-        d_numdep_factor[i] = factor(ps.num_dependents_factor)
-        d_stepback_factor[i] = factor(ps.stepback_task_factor)
+    ps_l = [d.planner_settings for d in distros]
+    hs_l = [d.host_allocator_settings for d in distros]
+    fill("d_valid", [True] * n_d)
+    fill("d_min_hosts", [h.minimum_hosts for h in hs_l])
+    fill("d_max_hosts", [h.maximum_hosts for h in hs_l])
+    fill("d_future_fraction", [h.future_host_fraction for h in hs_l])
+    fill("d_round_up", [h.rounding_rule == RoundingRule.UP.value for h in hs_l])
+    fill(
+        "d_feedback",
+        [h.feedback_rule == FeedbackRule.WAITS_OVER_THRESH.value for h in hs_l],
+    )
+    fill("d_disabled", [d.disabled for d in distros])
+    fill("d_ephemeral", [d.is_ephemeral() for d in distros])
+    fill("d_is_docker", [d.provider == Provider.DOCKER.value for d in distros])
+    fill("d_thresh_s", [p.max_duration_per_host_s() for p in ps_l])
+    fill("d_patch_factor", [_factor(p.patch_factor) for p in ps_l])
+    fill("d_patch_tiq_factor", [_factor(p.patch_time_in_queue_factor) for p in ps_l])
+    fill("d_cq_factor", [_factor(p.commit_queue_factor) for p in ps_l])
+    fill(
+        "d_mainline_tiq_factor",
+        [_factor(p.mainline_time_in_queue_factor) for p in ps_l],
+    )
+    fill("d_runtime_factor", [_factor(p.expected_runtime_factor) for p in ps_l])
+    fill("d_generate_factor", [_factor(p.generate_task_factor) for p in ps_l])
+    fill("d_numdep_factor", [_factor(p.num_dependents_factor) for p in ps_l])
+    fill("d_stepback_factor", [_factor(p.stepback_task_factor) for p in ps_l])
 
     return Snapshot(
         now=now,
@@ -354,4 +443,5 @@ def build_snapshot(
         n_segs=n_g,
         n_distros=n_d,
         arrays=a,
+        arena=arena,
     )

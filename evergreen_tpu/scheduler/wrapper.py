@@ -184,7 +184,7 @@ def run_tick(
     now: Optional[float] = None,
 ) -> TickResult:
     """One full scheduling tick over every distro."""
-    from ..ops.solve import run_solve  # deferred: keeps jax import lazy
+    from ..ops.solve import run_solve_packed  # deferred: keeps jax import lazy
 
     opts = opts or TickOptions()
     now = _time.time() if now is None else now
@@ -216,7 +216,7 @@ def run_tick(
             deps_met, now,
         )
         t2 = _time.perf_counter()
-        out = run_solve(snapshot.arrays)
+        out = run_solve_packed(snapshot)
         t3 = _time.perf_counter()
         snapshot_ms = (t2 - t1) * 1e3
         solve_ms = (t3 - t2) * 1e3
