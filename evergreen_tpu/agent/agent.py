@@ -1,0 +1,148 @@
+"""Agent core loop: poll → setup → run blocks → report.
+
+Re-implements the skeleton of the reference agent
+(agent/agent.go:212-1542): poll next_task with backoff, set up the task
+(working dir + expansions), run pre / main / post blocks through the command
+registry, heartbeat between commands, classify the failure, and end the
+task. Process teardown (killProcs) maps to subprocess scoping; jasper is not
+needed because commands run as directly-managed subprocesses.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import subprocess
+import tempfile
+import time as _time
+from typing import List, Optional, Tuple
+
+from ..globals import TaskStatus
+from . import command as _command_pkg  # noqa: F401 — registers commands
+from .command import basic as _basic  # noqa: F401
+from .command.base import CommandContext, Expansions, get_command
+from .comm import Communicator, TaskConfig
+
+
+@dataclasses.dataclass
+class AgentOptions:
+    host_id: str
+    work_dir: str = ""
+    cleanup_work_dir: bool = True
+    #: jittered idle backoff bounds (agent/agent.go:233,287-299)
+    min_poll_interval_s: float = 0.1
+    max_poll_interval_s: float = 5.0
+
+
+class Agent:
+    def __init__(self, comm: Communicator, options: AgentOptions) -> None:
+        self.comm = comm
+        self.options = options
+        if not self.options.work_dir:
+            self.options.work_dir = tempfile.mkdtemp(prefix="evg-agent-")
+
+    # -- single task -------------------------------------------------------- #
+
+    def run_once(self) -> Optional[str]:
+        """Poll once; run the assigned task to completion if any.
+        Returns the finished task id or None when the queue is empty."""
+        task = self.comm.next_task(self.options.host_id)
+        if task is None:
+            return None
+        cfg = self.comm.get_task_config(task)
+        self.comm.start_task(task.id)
+        status, details_type, details_desc, timed_out, artifacts = self._run_task(cfg)
+        self.comm.end_task(
+            task.id,
+            status,
+            details_type=details_type,
+            details_desc=details_desc,
+            timed_out=timed_out,
+            artifacts=artifacts,
+        )
+        return task.id
+
+    def run_until_idle(self, max_tasks: int = 0) -> List[str]:
+        """Drain the queue (the smoke-test drive loop)."""
+        done: List[str] = []
+        while True:
+            tid = self.run_once()
+            if tid is None:
+                return done
+            done.append(tid)
+            if max_tasks and len(done) >= max_tasks:
+                return done
+
+    # -- block execution ---------------------------------------------------- #
+
+    def _run_task(self, cfg: TaskConfig) -> Tuple[str, str, str, bool, dict]:
+        task = cfg.task
+        task_dir = os.path.join(self.options.work_dir, task.id)
+        os.makedirs(task_dir, exist_ok=True)
+        log_lines: List[str] = []
+
+        ctx = CommandContext(
+            work_dir=task_dir,
+            expansions=Expansions(cfg.expansions),
+            task_id=task.id,
+            task_name=task.display_name,
+            project=task.project,
+            log=log_lines.append,
+            exec_timeout_s=cfg.exec_timeout_s,
+            idle_timeout_s=cfg.idle_timeout_s,
+        )
+
+        status = TaskStatus.SUCCEEDED.value
+        details_type = ""
+        details_desc = ""
+        timed_out = False
+
+        # pre block: failures only fail the task when pre_error_fails_task
+        # (agent/agent.go runPreAndMain :752-938)
+        pre_failed, pre_desc = self._run_block(ctx, cfg.pre, "pre")
+        if pre_failed and cfg.pre_error_fails_task:
+            status = TaskStatus.FAILED.value
+            details_type = "setup"
+            details_desc = pre_desc
+
+        if status == TaskStatus.SUCCEEDED.value:
+            try:
+                main_failed, main_desc = self._run_block(ctx, cfg.commands, "task")
+            except subprocess.TimeoutExpired:
+                main_failed, main_desc, timed_out = True, "exec timeout", True
+                self._run_block(ctx, cfg.timeout_handler, "timeout")
+            if main_failed:
+                status = TaskStatus.FAILED.value
+                details_type = "test"
+                details_desc = main_desc
+
+        # post block always runs; its failures never change the task status
+        # unless post_error_fails_task (not yet surfaced)
+        self._run_block(ctx, cfg.post, "post")
+
+        self.comm.send_log(task.id, log_lines)
+        if self.options.cleanup_work_dir:
+            shutil.rmtree(task_dir, ignore_errors=True)
+        return status, details_type, details_desc, timed_out, ctx.artifacts
+
+    def _run_block(
+        self, ctx: CommandContext, commands: List[dict], block: str
+    ) -> Tuple[bool, str]:
+        """Run one command block; returns (failed, description)."""
+        for i, spec in enumerate(commands):
+            spec = dict(spec)
+            name = spec.pop("command", "")
+            params = spec.get("params", spec)
+            display = spec.get("display_name", name)
+            ctx.log(f"[{block}] running {display!r}")
+            if self.comm.heartbeat(ctx.task_id):
+                return True, "task aborted"
+            try:
+                cmd = get_command(name, params)
+            except KeyError as e:
+                return True, str(e)
+            result = cmd.execute(ctx)
+            if result.failed:
+                ctx.log(f"[{block}] command {display!r} failed: {result.error}")
+                return True, f"'{display}' in block {block!r}: {result.error}"
+        return False, ""

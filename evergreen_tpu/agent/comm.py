@@ -1,0 +1,156 @@
+"""Agent↔server communicator.
+
+The reference agent talks to the server exclusively through a retrying REST
+client (agent/internal/client/); tests swap in a mock communicator
+(agent/internal/client/mock.go). Same seam here: the Agent depends only on
+this interface. LocalCommunicator binds directly to the store + dispatcher
+(the in-process transport); the REST transport (api plane) implements the
+same interface over HTTP.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time as _time
+from typing import Any, Dict, List, Optional
+
+from ..dispatch.assign import assign_next_available_task
+from ..dispatch.dag_dispatcher import DispatcherService
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models.lifecycle import mark_end, mark_task_started
+from ..models.task import Task
+from ..storage.store import Store
+
+PARSER_PROJECTS_COLLECTION = "parser_projects"
+
+
+@dataclasses.dataclass
+class TaskConfig:
+    """What the agent needs to run one task (reference
+    apimodels/agent_models.go NextTaskResponse + fetched project config)."""
+
+    task: Task
+    commands: List[Dict[str, Any]]
+    pre: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    post: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    timeout_handler: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    expansions: Dict[str, str] = dataclasses.field(default_factory=dict)
+    exec_timeout_s: float = 0.0
+    idle_timeout_s: float = 0.0
+    pre_error_fails_task: bool = False
+
+
+class Communicator(abc.ABC):
+    @abc.abstractmethod
+    def next_task(self, host_id: str) -> Optional[Task]:
+        ...
+
+    @abc.abstractmethod
+    def get_task_config(self, task: Task) -> TaskConfig:
+        ...
+
+    @abc.abstractmethod
+    def start_task(self, task_id: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def heartbeat(self, task_id: str) -> bool:
+        """Returns True if the task should abort."""
+
+    @abc.abstractmethod
+    def end_task(
+        self, task_id: str, status: str, details_type: str = "",
+        details_desc: str = "", timed_out: bool = False,
+        artifacts: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        ...
+
+    @abc.abstractmethod
+    def send_log(self, task_id: str, lines: List[str]) -> None:
+        ...
+
+
+class LocalCommunicator(Communicator):
+    """Direct store binding — the in-process transport used by the smoke
+    path and agent tests."""
+
+    def __init__(self, store: Store, dispatcher_service: DispatcherService) -> None:
+        self.store = store
+        self.svc = dispatcher_service
+
+    def next_task(self, host_id: str) -> Optional[Task]:
+        host = host_mod.get(self.store, host_id)
+        if host is None:
+            return None
+        return assign_next_available_task(self.store, self.svc, host)
+
+    def get_task_config(self, task: Task) -> TaskConfig:
+        doc = self.store.collection(PARSER_PROJECTS_COLLECTION).get(task.version)
+        if doc is None:
+            return TaskConfig(task=task, commands=[])
+        task_def = doc.get("tasks", {}).get(task.display_name, {})
+        expansions = dict(doc.get("expansions", {}))
+        expansions.update(
+            {
+                "task_id": task.id,
+                "task_name": task.display_name,
+                "build_variant": task.build_variant,
+                "version_id": task.version,
+                "project": task.project,
+                "revision": task.revision,
+            }
+        )
+        return TaskConfig(
+            task=task,
+            commands=list(task_def.get("commands", [])),
+            pre=list(doc.get("pre", [])),
+            post=list(doc.get("post", [])),
+            timeout_handler=list(doc.get("timeout", [])),
+            expansions=expansions,
+            exec_timeout_s=float(
+                task_def.get("exec_timeout_secs", doc.get("exec_timeout_secs", 0)) or 0
+            ),
+            idle_timeout_s=float(task_def.get("timeout_secs", 0) or 0),
+            pre_error_fails_task=bool(doc.get("pre_error_fails_task", False)),
+        )
+
+    def start_task(self, task_id: str) -> None:
+        mark_task_started(self.store, task_id)
+
+    def heartbeat(self, task_id: str) -> bool:
+        now = _time.time()
+        task_mod.coll(self.store).update(task_id, {"last_heartbeat": now})
+        t = task_mod.get(self.store, task_id)
+        return bool(t and t.aborted)
+
+    def end_task(
+        self, task_id: str, status: str, details_type: str = "",
+        details_desc: str = "", timed_out: bool = False,
+        artifacts: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        mark_end(
+            self.store,
+            task_id,
+            status,
+            details_type=details_type,
+            details_desc=details_desc,
+            timed_out=timed_out,
+        )
+        if artifacts:
+            gen = artifacts.get("generate_tasks")
+            if gen:
+                # staged for the ingestion plane's generate handler
+                self.store.collection("generate_requests").upsert(
+                    {"_id": task_id, "task_id": task_id, "payloads": gen,
+                     "processed": False}
+                )
+
+    def send_log(self, task_id: str, lines: List[str]) -> None:
+        coll = self.store.collection("task_logs")
+        doc = coll.get(task_id)
+        if doc is None:
+            coll.upsert({"_id": task_id, "lines": list(lines)})
+        else:
+            doc["lines"].extend(lines)

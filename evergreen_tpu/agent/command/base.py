@@ -1,0 +1,111 @@
+"""Agent command framework: registry + execution context.
+
+The reference's agent resolves ~35 pluggable commands by name from YAML
+(agent/command/registry.go:21-60) and executes them with a per-task context.
+Same shape here: Command subclasses register a name, parse their YAML params,
+and execute against a CommandContext.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import re
+import time as _time
+from typing import Any, Callable, Dict, List, Optional
+
+_EXPANSION_RE = re.compile(r"\$\{([A-Za-z0-9_.|\- ]+)\}")
+
+
+class Expansions:
+    """${key} / ${key|default} substitution (reference util/expansion.go +
+    util/expand_params.go)."""
+
+    def __init__(self, values: Optional[Dict[str, str]] = None) -> None:
+        self._values: Dict[str, str] = dict(values or {})
+
+    def get(self, key: str, default: str = "") -> str:
+        return self._values.get(key, default)
+
+    def put(self, key: str, value: str) -> None:
+        self._values[key] = value
+
+    def update(self, values: Dict[str, str]) -> None:
+        self._values.update(values)
+
+    def as_dict(self) -> Dict[str, str]:
+        return dict(self._values)
+
+    def expand(self, text: str) -> str:
+        def repl(m: "re.Match[str]") -> str:
+            body = m.group(1)
+            if "|" in body:
+                key, default = body.split("|", 1)
+                return self._values.get(key.strip(), default)
+            return self._values.get(body.strip(), "")
+
+        return _EXPANSION_RE.sub(repl, text)
+
+    def expand_any(self, value: Any) -> Any:
+        if isinstance(value, str):
+            return self.expand(value)
+        if isinstance(value, list):
+            return [self.expand_any(v) for v in value]
+        if isinstance(value, dict):
+            return {k: self.expand_any(v) for k, v in value.items()}
+        return value
+
+
+@dataclasses.dataclass
+class CommandResult:
+    exit_code: int = 0
+    error: str = ""
+    # commands may ask the task to end early / fail without stopping the block
+    failed: bool = False
+
+
+@dataclasses.dataclass
+class CommandContext:
+    work_dir: str
+    expansions: Expansions
+    task_id: str = ""
+    task_name: str = ""
+    project: str = ""
+    log: Callable[[str], None] = lambda line: None
+    #: set by timeout.update / callbacks
+    exec_timeout_s: float = 0.0
+    idle_timeout_s: float = 0.0
+    #: sink for generate.tasks payloads, keyval state, etc.
+    artifacts: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class Command(abc.ABC):
+    name: str = ""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None) -> None:
+        self.params = params or {}
+
+    @abc.abstractmethod
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        ...
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_command(cls: type) -> type:
+    assert issubclass(cls, Command) and cls.name
+    if cls.name in _REGISTRY:
+        raise KeyError(f"duplicate command name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_command(name: str, params: Optional[Dict[str, Any]] = None) -> Command:
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise KeyError(f"unknown command {name!r}")
+    return cls(params)
+
+
+def known_commands() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,187 @@
+"""Core agent commands: process execution, expansions, key-value.
+
+Reference equivalents: shell.exec / subprocess.exec
+(agent/command/shell.go, subprocess_exec.go), expansions.update /
+expansions.write (expansion_update.go, expansion_write.go), keyval.inc
+(keyval.go), timeout.update (timeout.go).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Any, Dict
+
+from .base import (
+    Command,
+    CommandContext,
+    CommandResult,
+    register_command,
+)
+
+
+@register_command
+class ShellExec(Command):
+    """Run a script through a shell in the task working directory."""
+
+    name = "shell.exec"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        params = ctx.expansions.expand_any(self.params)
+        script = params.get("script", "")
+        shell = params.get("shell", "bash")
+        working_dir = os.path.join(ctx.work_dir, params.get("working_dir", ""))
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in params.get("env", {}).items()})
+        env.setdefault("EVR_TASK_ID", ctx.task_id)
+        continue_on_err = bool(params.get("continue_on_err", False))
+
+        os.makedirs(working_dir, exist_ok=True)
+        proc = subprocess.run(
+            [shell, "-c", script],
+            cwd=working_dir,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=ctx.exec_timeout_s or None,
+        )
+        for line in (proc.stdout or "").splitlines():
+            ctx.log(line)
+        for line in (proc.stderr or "").splitlines():
+            ctx.log(f"[stderr] {line}")
+        if proc.returncode != 0 and not continue_on_err:
+            return CommandResult(
+                exit_code=proc.returncode,
+                failed=True,
+                error=f"shell script returned {proc.returncode}",
+            )
+        return CommandResult(exit_code=proc.returncode)
+
+
+@register_command
+class SubprocessExec(Command):
+    """Run a binary with args (no shell)."""
+
+    name = "subprocess.exec"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        params = ctx.expansions.expand_any(self.params)
+        binary = params.get("binary", "")
+        args = [str(a) for a in params.get("args", [])]
+        working_dir = os.path.join(ctx.work_dir, params.get("working_dir", ""))
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in params.get("env", {}).items()})
+        os.makedirs(working_dir, exist_ok=True)
+        try:
+            proc = subprocess.run(
+                [binary, *args],
+                cwd=working_dir,
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=ctx.exec_timeout_s or None,
+            )
+        except FileNotFoundError:
+            return CommandResult(exit_code=127, failed=True,
+                                 error=f"binary not found: {binary}")
+        for line in (proc.stdout or "").splitlines():
+            ctx.log(line)
+        if proc.returncode != 0 and not params.get("continue_on_err", False):
+            return CommandResult(
+                exit_code=proc.returncode,
+                failed=True,
+                error=f"process returned {proc.returncode}",
+            )
+        return CommandResult(exit_code=proc.returncode)
+
+
+@register_command
+class ExpansionsUpdate(Command):
+    name = "expansions.update"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        for upd in self.params.get("updates", []):
+            key = upd.get("key", "")
+            if not key:
+                continue
+            if "concat" in upd:
+                ctx.expansions.put(
+                    key, ctx.expansions.get(key) + ctx.expansions.expand(upd["concat"])
+                )
+            else:
+                ctx.expansions.put(key, ctx.expansions.expand(upd.get("value", "")))
+        return CommandResult()
+
+
+@register_command
+class ExpansionsWrite(Command):
+    name = "expansions.write"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        import yaml
+
+        path = os.path.join(
+            ctx.work_dir, ctx.expansions.expand(self.params.get("file", "expansions.yml"))
+        )
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            yaml.safe_dump(ctx.expansions.as_dict(), f)
+        return CommandResult()
+
+
+@register_command
+class KeyvalInc(Command):
+    """Increment a named counter, exposing the value as an expansion
+    (reference agent/command/keyval.go; counter state lives with the task
+    context's artifact sink, persisted by the communicator)."""
+
+    name = "keyval.inc"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        key = self.params.get("key", "")
+        dest = self.params.get("destination", key)
+        counters: Dict[str, int] = ctx.artifacts.setdefault("keyval", {})
+        counters[key] = counters.get(key, 0) + 1
+        ctx.expansions.put(dest, str(counters[key]))
+        return CommandResult()
+
+
+@register_command
+class TimeoutUpdate(Command):
+    name = "timeout.update"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        params = ctx.expansions.expand_any(self.params)
+        if "exec_timeout_secs" in params:
+            ctx.exec_timeout_s = float(params["exec_timeout_secs"])
+        if "timeout_secs" in params:
+            ctx.idle_timeout_s = float(params["timeout_secs"])
+        return CommandResult()
+
+
+@register_command
+class GenerateTasks(Command):
+    """Stage a generate.tasks JSON payload for the server (reference
+    agent/command/generate.go; the server-side expansion happens in the
+    ingestion plane's generate handler)."""
+
+    name = "generate.tasks"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        import json
+
+        payloads = []
+        for fname in self.params.get("files", []):
+            path = os.path.join(ctx.work_dir, ctx.expansions.expand(fname))
+            try:
+                with open(path) as f:
+                    payloads.append(json.load(f))
+            except FileNotFoundError:
+                return CommandResult(
+                    failed=True, error=f"generate.tasks file not found: {fname}"
+                )
+            except json.JSONDecodeError as e:
+                return CommandResult(
+                    failed=True, error=f"generate.tasks invalid JSON in {fname}: {e}"
+                )
+        ctx.artifacts.setdefault("generate_tasks", []).extend(payloads)
+        return CommandResult()

@@ -1,0 +1,87 @@
+"""Host provisioning pipeline: intent → cloud spawn → running agent.
+
+Condenses the reference's provisioning job chain
+(units/provisioning_create_host.go:121-576 createHostJob →
+units/provisioning_setup_host.go → units/provisioning_agent_deploy.go) into
+store-driven steps the job plane ticks through. Real SSH/jasper deployment is
+replaced by the agent runtime attaching in-process (agent/); the state
+machine and events are preserved.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from ..globals import HostStatus
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..storage.store import Store
+from .manager import CloudHostStatus, get_manager
+
+
+def create_hosts_from_intents(
+    store: Store, now: Optional[float] = None, limit: int = 0
+) -> List[str]:
+    """Spawn cloud instances for intent hosts (reference
+    units/provisioning_create_host.go:121,410)."""
+    now = _time.time() if now is None else now
+    spawned = []
+    intents = host_mod.find(
+        store, lambda d: d["status"] == HostStatus.UNINITIALIZED.value
+    )
+    for h in intents:
+        if limit and len(spawned) >= limit:
+            break
+        try:
+            mgr = get_manager(h.provider)
+        except KeyError:
+            continue
+        mgr.spawn_host(store, h)
+        spawned.append(h.id)
+        event_mod.log(
+            store, event_mod.RESOURCE_HOST, "HOST_STARTED", h.id, timestamp=now
+        )
+    return spawned
+
+
+def provision_ready_hosts(
+    store: Store, now: Optional[float] = None
+) -> List[str]:
+    """Promote hosts whose cloud instance is up to RUNNING and mark the
+    agent deployable (reference provisioning_setup_host +
+    provisioning_agent_deploy collapsed)."""
+    now = _time.time() if now is None else now
+    ready = []
+    pending = host_mod.find(
+        store,
+        lambda d: d["status"]
+        in (
+            HostStatus.STARTING.value,
+            HostStatus.PROVISIONING.value,
+            HostStatus.BUILDING.value,
+        ),
+    )
+    for h in pending:
+        try:
+            mgr = get_manager(h.provider)
+        except KeyError:
+            continue
+        if mgr.get_instance_status(store, h) == CloudHostStatus.RUNNING:
+            host_mod.coll(store).update(
+                h.id,
+                {
+                    "status": HostStatus.RUNNING.value,
+                    "provision_time": now,
+                    "agent_start_time": now,
+                    "last_communication_time": now,
+                },
+            )
+            ready.append(h.id)
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_HOST,
+                "HOST_PROVISIONED",
+                h.id,
+                timestamp=now,
+            )
+    return ready

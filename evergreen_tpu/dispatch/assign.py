@@ -1,0 +1,82 @@
+"""Agent-facing task assignment: the next_task route's core.
+
+Reference: assignNextAvailableTask (rest/route/host_agent.go:219-420) — loop
+the dispatcher's FindNextTask until a still-runnable task is found, then
+atomically couple it to the host (compare-and-set on the host document) and
+mark it dispatched. The CAS pair is the system's dispatch-race guard.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Optional, Tuple
+
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models.host import Host
+from ..models.lifecycle import mark_task_dispatched
+from ..models.task import Task
+from ..storage.store import Store
+from .dag_dispatcher import DispatcherService, TaskSpec
+
+
+def spec_for_host(host: Host) -> TaskSpec:
+    """Task-group stickiness comes from the host's last-run context
+    (reference host_agent.go builds TaskSpec from the host's LastGroup)."""
+    return TaskSpec(
+        group=host.last_group,
+        build_variant=host.last_build_variant,
+        project=host.last_project,
+        version=host.last_version,
+    )
+
+
+def assign_next_available_task(
+    store: Store,
+    svc: DispatcherService,
+    host: Host,
+    now: Optional[float] = None,
+) -> Optional[Task]:
+    """Returns the task now assigned to this host, or None if the queue has
+    nothing dispatchable."""
+    now = _time.time() if now is None else now
+    if host.running_task:
+        # Reference returns the already-assigned task so a crashed agent can
+        # resume (host_agent.go:209-216).
+        return task_mod.get(store, host.running_task)
+    if not host.can_run_tasks():
+        return None
+
+    spec = spec_for_host(host)
+    dispatcher = svc.get(host.distro_id)
+    dispatcher.refresh(now)
+
+    while True:
+        item = dispatcher.find_next_task(spec, now)
+        if item is None:
+            return None
+        t = task_mod.get(store, item.id)
+        if t is None:
+            continue
+        # Re-validate against the live document: planning ran up to a tick
+        # ago (host_agent.go ProjectCanDispatchTask gate).
+        if not t.is_dispatchable():
+            continue
+        if not host_mod.assign_running_task(store, host.id, t, now):
+            # Another request raced this host to a task; bail and let the
+            # agent re-poll (reference returns nil on CAS failure).
+            return None
+        if not mark_task_dispatched(store, t.id, host.id, now):
+            # Task was concurrently taken (e.g. by another distro's queue
+            # via secondary distros): release the host and keep looking.
+            host_mod.clear_running_task(store, host.id, t.id, now)
+            continue
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_TASK,
+            "TASK_DISPATCHED",
+            t.id,
+            {"host_id": host.id},
+            timestamp=now,
+        )
+        return task_mod.get(store, t.id)

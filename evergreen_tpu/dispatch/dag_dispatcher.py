@@ -1,0 +1,270 @@
+"""Server-side DAG dispatcher: hands queued tasks to agents.
+
+Re-implements the semantics of the reference's revised-with-dependencies
+dispatcher (model/task_queue_service_dependency.go:56-650): an in-memory
+per-distro structure rebuilt from the persisted queue on a TTL, holding
+
+  * a dependency graph over queue items, topologically ordered with ties
+    broken by the planner's queue rank (topo.SortStabilized, :216);
+  * task-group units whose tasks dispatch in group-order with max-hosts
+    enforcement and single-host-group failure blocking (:560-650);
+  * dispatch marking so one item is handed to at most one host per rebuild
+    (the durable guarantee is the host document's atomic compare-and-set,
+    rest/route/host_agent.go:311-420).
+
+Instead of gonum, the topological sort is a stabilized Kahn's algorithm over
+the queue's local edges (heap keyed by queue index). Tasks in dependency
+cycles are excluded from dispatch, mirroring topo.Unorderable handling.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+import time as _time
+from typing import Dict, List, Optional
+
+from ..globals import TaskStatus
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models import task_queue as tq_mod
+from ..models.task_queue import TaskQueueItem
+from ..storage.store import Store
+
+DEFAULT_TTL_S = 60.0
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """The host's last-run task context, used for task-group stickiness
+    (reference model/task_queue.go TaskSpec)."""
+
+    group: str = ""
+    build_variant: str = ""
+    project: str = ""
+    version: str = ""
+    group_max_hosts: int = 0
+
+
+def composite_group_id(group: str, variant: str, project: str, version: str) -> str:
+    return f"{group}_{variant}_{project}_{version}"
+
+
+@dataclasses.dataclass
+class _GroupUnit:
+    id: str
+    group: str
+    variant: str
+    project: str
+    version: str
+    max_hosts: int
+    tasks: List[TaskQueueItem] = dataclasses.field(default_factory=list)
+
+
+class DAGDispatcher:
+    def __init__(
+        self, store: Store, distro_id: str, ttl_s: float = DEFAULT_TTL_S
+    ) -> None:
+        self.store = store
+        self.distro_id = distro_id
+        self.ttl_s = ttl_s
+        self._lock = threading.RLock()
+        self._last_updated = 0.0
+        self._sorted: List[TaskQueueItem] = []
+        self._items: Dict[str, TaskQueueItem] = {}
+        self._groups: Dict[str, _GroupUnit] = {}
+        self._dispatched: set = set()
+
+    # -- rebuild ------------------------------------------------------------ #
+
+    def refresh(self, now: Optional[float] = None, force: bool = False) -> None:
+        now = _time.time() if now is None else now
+        with self._lock:
+            if not force and now - self._last_updated < self.ttl_s:
+                return
+            queue = tq_mod.load(self.store, self.distro_id)
+            self.rebuild(queue.queue if queue else [], now)
+
+    def rebuild(self, items: List[TaskQueueItem], now: float) -> None:
+        with self._lock:
+            self._items = {it.id: it for it in items}
+            self._dispatched = set()
+            self._groups = {}
+            for it in items:
+                if it.task_group:
+                    gid = composite_group_id(
+                        it.task_group, it.build_variant, it.project, it.version
+                    )
+                    unit = self._groups.get(gid)
+                    if unit is None:
+                        unit = _GroupUnit(
+                            id=gid,
+                            group=it.task_group,
+                            variant=it.build_variant,
+                            project=it.project,
+                            version=it.version,
+                            max_hosts=it.task_group_max_hosts,
+                        )
+                        self._groups[gid] = unit
+                    unit.tasks.append(it)
+            for unit in self._groups.values():
+                unit.tasks.sort(key=lambda it: it.task_group_order)
+
+            self._sorted = self._topo_sort(items)
+            self._last_updated = now
+
+    def _topo_sort(self, items: List[TaskQueueItem]) -> List[TaskQueueItem]:
+        """Stabilized Kahn: dependency order first, planner queue rank as the
+        tie-break (reference rebuild :205-249)."""
+        index = {it.id: i for i, it in enumerate(items)}
+        indegree = {it.id: 0 for it in items}
+        children: Dict[str, List[str]] = {it.id: [] for it in items}
+        for it in items:
+            for dep in it.dependencies:
+                if dep in index:  # only edges internal to the queue
+                    children[dep].append(it.id)
+                    indegree[it.id] += 1
+        ready = [index[i] for i, deg in indegree.items() if deg == 0]
+        heapq.heapify(ready)
+        out: List[TaskQueueItem] = []
+        while ready:
+            qi = heapq.heappop(ready)
+            it = items[qi]
+            out.append(it)
+            for child in children[it.id]:
+                indegree[child] -= 1
+                if indegree[child] == 0:
+                    heapq.heappush(ready, index[child])
+        # nodes still with indegree > 0 form cycles: excluded from dispatch
+        return out
+
+    # -- dispatch ------------------------------------------------------------ #
+
+    def find_next_task(
+        self, spec: TaskSpec, now: Optional[float] = None
+    ) -> Optional[TaskQueueItem]:
+        """The agent-facing handout (reference FindNextTask :258-492)."""
+        now = _time.time() if now is None else now
+        with self._lock:
+            # Task-group stickiness: a host that just ran a group task gets
+            # the group's next task if any remain (:269-282).
+            if spec.group:
+                gid = composite_group_id(
+                    spec.group, spec.build_variant, spec.project, spec.version
+                )
+                unit = self._groups.get(gid)
+                if unit is not None and self._group_has_dispatchable(unit):
+                    nxt = self._next_task_group_task(unit)
+                    if nxt is not None:
+                        return nxt
+
+            for it in self._sorted:
+                if it.task_group_max_hosts == 0:
+                    if not it.dependencies_met:
+                        continue
+                    if it.id in self._dispatched:
+                        continue
+                    self._dispatched.add(it.id)
+                    t = task_mod.get(self.store, it.id)
+                    if t is None:
+                        return None
+                    if t.start_time > 0.0:
+                        continue
+                    if not self._deps_met_fresh(t):
+                        continue
+                    return it
+                else:
+                    gid = composite_group_id(
+                        it.task_group, it.build_variant, it.project, it.version
+                    )
+                    unit = self._groups.get(gid)
+                    if unit is None or not self._group_has_dispatchable(unit):
+                        continue
+                    running = host_mod.coll(self.store).count(
+                        lambda doc: doc["running_task_group"] == unit.group
+                        and doc["running_task_build_variant"] == unit.variant
+                        and doc["running_task_project"] == unit.project
+                        and doc["running_task_version"] == unit.version
+                    )
+                    if running >= unit.max_hosts > 0:
+                        continue
+                    nxt = self._next_task_group_task(unit)
+                    if nxt is not None:
+                        return nxt
+            return None
+
+    def _group_has_dispatchable(self, unit: _GroupUnit) -> bool:
+        return any(
+            it.dependencies_met and it.id not in self._dispatched
+            for it in unit.tasks
+        )
+
+    def _next_task_group_task(self, unit: _GroupUnit) -> Optional[TaskQueueItem]:
+        """Group tasks dispatch in group order; a failed earlier task blocks
+        the rest of a single-host group (reference nextTaskGroupTask
+        :608-680 + isBlockedSingleHostTaskGroup)."""
+        for it in unit.tasks:
+            if it.id in self._dispatched:
+                continue
+            t = task_mod.get(self.store, it.id)
+            if t is None:
+                return None
+            if self._blocked_single_host_group(unit, t):
+                self._groups.pop(unit.id, None)
+                return None
+            if t.start_time > 0.0:
+                self._dispatched.add(it.id)
+                continue
+            if not self._deps_met_fresh(t):
+                continue
+            self._dispatched.add(it.id)
+            return it
+        return None
+
+    def _blocked_single_host_group(self, unit: _GroupUnit, t) -> bool:
+        """A single-host group is done dispatching when the candidate task
+        already ran and did not succeed (reference
+        isBlockedSingleHostTaskGroup :689-693 — blocking of LATER members
+        happens at task end, models/lifecycle.py block_single_host_group)."""
+        return (
+            unit.max_hosts == 1
+            and t.finish_time > 0.0
+            and t.status != TaskStatus.SUCCEEDED.value
+        )
+
+    def _deps_met_fresh(self, t) -> bool:
+        """Re-check dependencies against current task states (reference
+        FindNextTask re-validates via task.DependenciesMet :399-414)."""
+        if not t.depends_on:
+            return True
+        cache = {
+            p.id: p
+            for p in task_mod.by_ids(self.store, [d.task_id for d in t.depends_on])
+        }
+        return t.dependencies_met(cache)
+
+
+class DispatcherService:
+    """TTL-cached per-distro dispatchers (reference
+    model/task_queue_service.go:54-100)."""
+
+    def __init__(self, store: Store, ttl_s: float = DEFAULT_TTL_S) -> None:
+        self.store = store
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._dispatchers: Dict[str, DAGDispatcher] = {}
+
+    def get(self, distro_id: str) -> DAGDispatcher:
+        with self._lock:
+            disp = self._dispatchers.get(distro_id)
+            if disp is None:
+                disp = DAGDispatcher(self.store, distro_id, self.ttl_s)
+                self._dispatchers[distro_id] = disp
+            return disp
+
+    def refresh_find_next_task(
+        self, distro_id: str, spec: TaskSpec, now: Optional[float] = None
+    ) -> Optional[TaskQueueItem]:
+        disp = self.get(distro_id)
+        disp.refresh(now)
+        return disp.find_next_task(spec, now)

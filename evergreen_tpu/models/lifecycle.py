@@ -1,0 +1,360 @@
+"""Task lifecycle state transitions.
+
+The MarkEnd path is the write-heavy heart of the control plane (reference
+model/task_lifecycle.go:713-1150): finishing a task propagates to dependent
+tasks (finished flags + transitive unattainable marking), frees the host,
+feeds the event log, evaluates stepback, and rolls build/version statuses up.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional
+
+from ..globals import (
+    STEPBACK_TASK_ACTIVATOR,
+    BuildStatus,
+    Requester,
+    TaskStatus,
+    VersionStatus,
+)
+from ..storage.store import Store
+from . import build as build_mod
+from . import event as event_mod
+from . import host as host_mod
+from . import task as task_mod
+from . import version as version_mod
+from .task import DEP_STATUS_ANY, Task
+
+
+def mark_task_dispatched(
+    store: Store, task_id: str, host_id: str, now: Optional[float] = None
+) -> bool:
+    """Atomic undispatched→dispatched transition (reference
+    task.MarkAsHostDispatched via rest/route/host_agent.go:311-420)."""
+    now = _time.time() if now is None else now
+    return task_mod.coll(store).compare_and_set(
+        task_id,
+        expect={"status": TaskStatus.UNDISPATCHED.value},
+        update={
+            "status": TaskStatus.DISPATCHED.value,
+            "dispatch_time": now,
+            "host_id": host_id,
+            "last_heartbeat": now,
+        },
+    )
+
+
+def mark_task_started(
+    store: Store, task_id: str, now: Optional[float] = None
+) -> bool:
+    now = _time.time() if now is None else now
+    ok = task_mod.coll(store).compare_and_set(
+        task_id,
+        expect={"status": TaskStatus.DISPATCHED.value},
+        update={
+            "status": TaskStatus.STARTED.value,
+            "start_time": now,
+            "last_heartbeat": now,
+        },
+    )
+    if ok:
+        event_mod.log(
+            store, event_mod.RESOURCE_TASK, "TASK_STARTED", task_id, timestamp=now
+        )
+    return ok
+
+
+def _dep_satisfied(dep_status: str, final_status: str) -> bool:
+    if dep_status == DEP_STATUS_ANY:
+        return True
+    return dep_status == final_status
+
+
+def update_dependencies_on_finish(
+    store: Store, finished: Task, now: float
+) -> List[str]:
+    """Propagate a finished task's outcome to its dependents: set the edge's
+    finished flag; if unsatisfied, mark it unattainable and transitively
+    block downstream tasks (reference UpdateBlockedDependencies +
+    MarkDependenciesFinished, model/task_lifecycle.go:775-776).
+
+    Returns the ids of tasks that became blocked.
+    """
+    coll = task_mod.coll(store)
+    # Wave of (task id, final-or-blocked status, blocked?) to propagate.
+    newly_blocked: List[str] = []
+    wave = [(finished.id, finished.status, False)]
+    seen: set = set()
+    while wave:
+        parent_id, parent_status, parent_blocked = wave.pop()
+        if parent_id in seen:
+            continue
+        seen.add(parent_id)
+
+        def affects(doc: dict) -> bool:
+            return any(d["task_id"] == parent_id for d in doc.get("depends_on", []))
+
+        for doc in coll.find(affects):
+            changed = False
+            became_blocked = False
+            for dep in doc["depends_on"]:
+                if dep["task_id"] != parent_id:
+                    continue
+                if parent_blocked:
+                    if not dep["unattainable"]:
+                        dep["unattainable"] = True
+                        changed = became_blocked = True
+                else:
+                    dep["finished"] = True
+                    changed = True
+                    if not _dep_satisfied(dep["status"], parent_status):
+                        if not dep["unattainable"]:
+                            dep["unattainable"] = True
+                            became_blocked = True
+            if changed:
+                coll.update(doc["_id"], {"depends_on": doc["depends_on"]})
+            if became_blocked and not doc.get("override_dependencies", False):
+                newly_blocked.append(doc["_id"])
+                wave.append((doc["_id"], "", True))
+                event_mod.log(
+                    store,
+                    event_mod.RESOURCE_TASK,
+                    "TASK_BLOCKED",
+                    doc["_id"],
+                    {"blocked_by": parent_id},
+                    timestamp=now,
+                )
+    return newly_blocked
+
+
+def block_single_host_task_group(store: Store, t: Task, now: float) -> List[str]:
+    """When a single-host task-group member fails, later members of the
+    group must not run: they gain an unattainable dependency on the failed
+    task (reference: EndTask-side group blocking,
+    model/task_lifecycle.go blockTaskGroupTasks; the dispatcher comment at
+    task_queue_service_dependency.go:690 'rely on EndTask to block later
+    tasks')."""
+    if not t.is_single_host_task_group():
+        return []
+    if t.status == TaskStatus.SUCCEEDED.value:
+        return []
+    group_key = t.task_group_string()
+    blocked: List[str] = []
+    c = task_mod.coll(store)
+    for doc in c.find(
+        lambda d: d["task_group"] == t.task_group
+        and d["build_variant"] == t.build_variant
+        and d["project"] == t.project
+        and d["version"] == t.version
+        and d["task_group_order"] > t.task_group_order
+        and d["status"] == TaskStatus.UNDISPATCHED.value
+    ):
+        deps = doc.get("depends_on", [])
+        if any(d["task_id"] == t.id for d in deps):
+            for d in deps:
+                if d["task_id"] == t.id:
+                    d["unattainable"] = True
+                    d["finished"] = True
+        else:
+            deps.append(
+                {
+                    "task_id": t.id,
+                    "status": TaskStatus.SUCCEEDED.value,
+                    "unattainable": True,
+                    "finished": True,
+                }
+            )
+        c.update(doc["_id"], {"depends_on": deps})
+        blocked.append(doc["_id"])
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_TASK,
+            "TASK_BLOCKED",
+            doc["_id"],
+            {"blocked_by": t.id, "reason": "single-host task group failure",
+             "group": group_key},
+            timestamp=now,
+        )
+    return blocked
+
+
+def evaluate_stepback(store: Store, t: Task, now: float) -> Optional[str]:
+    """Linear stepback: when a mainline task fails, activate the same task
+    at the previous mainline commit if it has never run (reference
+    doLinearStepback, model/task_lifecycle.go:464; evaluated from MarkEnd
+    :849-882). Returns the activated task id, if any."""
+    if t.status != TaskStatus.FAILED.value:
+        return None
+    if t.requester != Requester.REPOTRACKER.value:
+        return None
+    if t.details_type == "system":
+        return None  # system failures don't step back
+
+    candidates = task_mod.find(
+        store,
+        lambda doc: doc["project"] == t.project
+        and doc["build_variant"] == t.build_variant
+        and doc["display_name"] == t.display_name
+        and doc["requester"] == Requester.REPOTRACKER.value
+        and doc["revision_order_number"] < t.revision_order_number,
+    )
+    if not candidates:
+        return None
+    prev = max(candidates, key=lambda x: x.revision_order_number)
+    if prev.status != TaskStatus.UNDISPATCHED.value or prev.activated:
+        return None  # previous already ran or is about to — nothing to bisect yet
+    task_mod.coll(store).update(
+        prev.id,
+        {
+            "activated": True,
+            "activated_by": STEPBACK_TASK_ACTIVATOR,
+            "activated_time": now,
+        },
+    )
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_TASK,
+        "TASK_ACTIVATED_STEPBACK",
+        prev.id,
+        {"failed_task": t.id},
+        timestamp=now,
+    )
+    return prev.id
+
+
+def update_build_and_version_status(store: Store, t: Task, now: float) -> None:
+    """Roll task status up to its build and version (reference
+    UpdateBuildAndVersionStatusForTask, model/task_lifecycle.go)."""
+    if not t.build_id:
+        return
+    b = build_mod.get(store, t.build_id)
+    if b is None:
+        return
+    member_tasks = task_mod.find(store, lambda d: d["build_id"] == t.build_id)
+    activated = [x for x in member_tasks if x.activated or x.is_finished()]
+    all_finished = activated and all(x.is_finished() for x in activated)
+    any_failed = any(x.status == TaskStatus.FAILED.value for x in member_tasks)
+    any_active = any(
+        x.status in (TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value)
+        for x in member_tasks
+    )
+    if all_finished:
+        new_status = (
+            BuildStatus.FAILED.value if any_failed else BuildStatus.SUCCEEDED.value
+        )
+    elif any_active or any(x.is_finished() for x in member_tasks):
+        new_status = BuildStatus.STARTED.value
+    else:
+        new_status = b.status
+    if new_status != b.status:
+        update: Dict = {"status": new_status}
+        if new_status == BuildStatus.STARTED.value and b.start_time == 0.0:
+            update["start_time"] = now
+        if new_status in (BuildStatus.FAILED.value, BuildStatus.SUCCEEDED.value):
+            update["finish_time"] = now
+        build_mod.coll(store).update(b.id, update)
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_BUILD,
+            f"BUILD_{new_status.upper().replace('-', '_')}",
+            b.id,
+            timestamp=now,
+        )
+
+    if not b.version:
+        return
+    v = version_mod.get(store, b.version)
+    if v is None:
+        return
+    builds = build_mod.find_by_version(store, b.version)
+    statuses = [
+        new_status if x.id == b.id else x.status for x in builds
+    ]
+    if statuses and all(
+        s in (BuildStatus.FAILED.value, BuildStatus.SUCCEEDED.value)
+        for s in statuses
+    ):
+        v_status = (
+            VersionStatus.FAILED.value
+            if any(s == BuildStatus.FAILED.value for s in statuses)
+            else VersionStatus.SUCCEEDED.value
+        )
+    elif any(
+        s
+        in (
+            BuildStatus.STARTED.value,
+            BuildStatus.FAILED.value,
+            BuildStatus.SUCCEEDED.value,
+        )
+        for s in statuses
+    ):
+        v_status = VersionStatus.STARTED.value
+    else:
+        v_status = v.status
+    if v_status != v.status:
+        update = {"status": v_status}
+        if v_status == VersionStatus.STARTED.value and v.start_time == 0.0:
+            update["start_time"] = now
+        if v_status in (VersionStatus.FAILED.value, VersionStatus.SUCCEEDED.value):
+            update["finish_time"] = now
+        version_mod.coll(store).update(v.id, update)
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_VERSION,
+            f"VERSION_{v_status.upper()}",
+            v.id,
+            timestamp=now,
+        )
+
+
+def mark_end(
+    store: Store,
+    task_id: str,
+    status: str,
+    now: Optional[float] = None,
+    details_type: str = "",
+    details_desc: str = "",
+    timed_out: bool = False,
+) -> Optional[Task]:
+    """Finish a task: final status + details, host release, dependency
+    propagation, event, stepback, status rollup (reference model.MarkEnd,
+    model/task_lifecycle.go:713-1150)."""
+    now = _time.time() if now is None else now
+    c = task_mod.coll(store)
+    doc = c.get(task_id)
+    if doc is None:
+        return None
+    if doc["status"] not in (
+        TaskStatus.DISPATCHED.value,
+        TaskStatus.STARTED.value,
+    ):
+        return None
+    c.update(
+        task_id,
+        {
+            "status": status,
+            "finish_time": now,
+            "details_type": details_type,
+            "details_desc": details_desc,
+            "details_timed_out": timed_out,
+        },
+    )
+    t = task_mod.get(store, task_id)
+
+    if t.host_id:
+        host_mod.clear_running_task(store, t.host_id, task_id, now)
+
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_TASK,
+        "TASK_FINISHED",
+        task_id,
+        {"status": status, "details_type": details_type},
+        timestamp=now,
+    )
+
+    update_dependencies_on_finish(store, t, now)
+    block_single_host_task_group(store, t, now)
+    evaluate_stepback(store, t, now)
+    update_build_and_version_status(store, t, now)
+    return t

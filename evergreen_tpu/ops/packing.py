@@ -57,8 +57,8 @@ class Arena:
 
 def unpack(bufs: Dict, layout_key: Tuple) -> Dict:
     """Inside-jit: slice the three buffers back into the named arrays.
-    Bool fields (u8) are re-cast; offsets are trace-time constants so XLA
-    sees plain static slices."""
+    u8 fields are bool by convention and re-cast; offsets are trace-time
+    constants so XLA sees plain static slices."""
     import jax.numpy as jnp
 
     offsets = {"f32": 0, "i32": 0, "u8": 0}
@@ -67,19 +67,7 @@ def unpack(bufs: Dict, layout_key: Tuple) -> Dict:
         off = offsets[kind]
         sl = jnp.asarray(bufs[kind])[off : off + size]
         offsets[kind] = off + size
-        out[name] = sl.astype(jnp.bool_) if _is_bool_field(name) else sl
+        out[name] = sl.astype(jnp.bool_) if kind == "u8" else sl
     return out
-
-
-_BOOL_FIELDS = {
-    "t_valid", "t_is_merge", "t_is_patch", "t_stepback", "t_generate",
-    "t_in_group", "t_deps_met", "m_valid", "g_unnamed", "g_valid",
-    "h_valid", "h_free", "h_running", "d_valid", "d_round_up", "d_feedback",
-    "d_disabled", "d_ephemeral", "d_is_docker",
-}
-
-
-def _is_bool_field(name: str) -> bool:
-    return name in _BOOL_FIELDS
 
 

@@ -27,7 +27,6 @@ from ..globals import (
     FeedbackRule,
     Provider,
     RoundingRule,
-    STEPBACK_TASK_ACTIVATOR,
     is_github_merge_queue_requester,
     is_patch_requester,
 )
@@ -349,31 +348,15 @@ def build_snapshot(
             for m, t in zip(merge_flags, flat_tasks)
         ],
     )
-    fill(
-        "t_stepback",
-        [t.activated_by == STEPBACK_TASK_ACTIVATOR for t in flat_tasks],
-    )
+    fill("t_stepback", [t.is_stepback_activated() for t in flat_tasks])
     fill("t_generate", [t.generate_task for t in flat_tasks])
     fill("t_in_group", [bool(t.task_group) for t in flat_tasks])
     fill("t_group_order", [t.task_group_order for t in flat_tasks])
-    fill(
-        "t_time_in_queue_s",
-        [
-            max(0.0, now - (t.activated_time or t.ingest_time))
-            if (t.activated_time or t.ingest_time) > 0.0
-            else 0.0
-            for t in flat_tasks
-        ],
-    )
+    fill("t_time_in_queue_s", [t.time_in_queue(now) for t in flat_tasks])
     fill("t_expected_s", [t.expected_duration_s for t in flat_tasks])
     fill(
         "t_wait_dep_met_s",
-        [
-            max(0.0, now - max(t.scheduled_time, t.dependencies_met_time))
-            if max(t.scheduled_time, t.dependencies_met_time) > 0.0
-            else 0.0
-            for t in flat_tasks
-        ],
+        [t.wait_since_dependencies_met(now) for t in flat_tasks],
     )
     fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
     fill("t_deps_met", [deps_met.get(t.id, True) for t in flat_tasks])

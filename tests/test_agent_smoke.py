@@ -1,0 +1,244 @@
+"""End-to-end smoke: project → tick (TPU solve) → mock cloud provisioning →
+agent runs real shell commands → MarkEnd → dependency unblock → stepback.
+
+This is the single-machine analog of the reference's smoke suite
+(smoke/internal/host/smoke_test.go): every layer the metric touches runs.
+"""
+import time
+
+from evergreen_tpu.agent.agent import Agent, AgentOptions
+from evergreen_tpu.agent.comm import (
+    PARSER_PROJECTS_COLLECTION,
+    LocalCommunicator,
+)
+from evergreen_tpu.cloud.mock import MockCloudManager
+from evergreen_tpu.cloud.provisioning import (
+    create_hosts_from_intents,
+    provision_ready_hosts,
+)
+from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+from evergreen_tpu.globals import (
+    HostStatus,
+    Provider,
+    Requester,
+    TaskStatus,
+)
+from evergreen_tpu.models import build as build_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import version as version_mod
+from evergreen_tpu.models.build import Build
+from evergreen_tpu.models.distro import (
+    Distro,
+    HostAllocatorSettings,
+)
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models.task import Dependency, Task
+from evergreen_tpu.models.version import Version
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+
+def seed_e2e(store, now):
+    MockCloudManager.reset(instant_up=True)
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1",
+            provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=4),
+        ),
+    )
+    version_mod.insert(
+        store,
+        Version(
+            id="v1", project="core", revision="abc123",
+            revision_order_number=10, requester=Requester.REPOTRACKER.value,
+            activated=True,
+        ),
+    )
+    build_mod.insert(
+        store,
+        Build(
+            id="b1", version="v1", project="core", build_variant="release",
+            activated=True, tasks=["compile", "test", "lint"],
+        ),
+    )
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        {
+            "_id": "v1",
+            "pre": [],
+            "post": [],
+            "tasks": {
+                "compile": {
+                    "commands": [
+                        {"command": "shell.exec",
+                         "params": {"script": "echo compiling ${task_name}"}}
+                    ]
+                },
+                "test": {
+                    "commands": [
+                        {"command": "shell.exec",
+                         "params": {"script": "echo testing && true"}}
+                    ]
+                },
+                "lint": {
+                    "commands": [
+                        {"command": "shell.exec",
+                         "params": {"script": "exit 3"}}
+                    ]
+                },
+            },
+            "expansions": {"branch": "main"},
+        }
+    )
+
+    def mk(tid, name, deps=(), order=10):
+        return Task(
+            id=tid, display_name=name, project="core", version="v1",
+            build_id="b1", build_variant="release", distro_id="d1",
+            status=TaskStatus.UNDISPATCHED.value, activated=True,
+            requester=Requester.REPOTRACKER.value,
+            revision="abc123", revision_order_number=order,
+            activated_time=now - 60, create_time=now - 120,
+            expected_duration_s=60.0,
+            depends_on=[Dependency(task_id=d) for d in deps],
+            num_dependents=1 if tid == "t-compile" else 0,
+        )
+
+    task_mod.insert_many(
+        store,
+        [
+            mk("t-compile", "compile"),
+            mk("t-test", "test", deps=["t-compile"]),
+            mk("t-lint", "lint"),
+        ],
+    )
+
+
+def test_full_pipeline(store, tmp_path):
+    now = time.time()
+    seed_e2e(store, now)
+
+    # 1. Scheduling tick: plan queues + allocate hosts on the TPU path.
+    res = run_tick(store, TickOptions(), now=now)
+    assert res.new_hosts["d1"] >= 1
+    assert len(res.intent_hosts) >= 1
+
+    # 2. Provisioning: intent → mock cloud instance → running host.
+    spawned = create_hosts_from_intents(store, now)
+    assert spawned
+    ready = provision_ready_hosts(store, now)
+    assert ready
+    hosts = host_mod.find(
+        store, lambda d: d["status"] == HostStatus.RUNNING.value
+    )
+    assert hosts
+
+    # 3. Agent drains the queue on the provisioned host.
+    svc = DispatcherService(store)
+    comm = LocalCommunicator(store, svc)
+    agent = Agent(
+        comm,
+        AgentOptions(host_id=hosts[0].id, work_dir=str(tmp_path)),
+    )
+    finished = agent.run_until_idle()
+    # compile must run before its dependent; lint fails (exit 3)
+    assert "t-compile" in finished
+
+    compile_t = task_mod.get(store, "t-compile")
+    assert compile_t.status == TaskStatus.SUCCEEDED.value
+
+    lint_t = task_mod.get(store, "t-lint")
+    assert lint_t.status == TaskStatus.FAILED.value
+    assert lint_t.details_type == "test"
+
+    # 4. The dependent test task becomes runnable on the NEXT tick: the
+    # queue item's deps-met flag is recomputed at plan time and the
+    # dispatcher picks it up after a refresh (reference waits for the
+    # in-memory queue TTL, task_queue_service_dependency.go:316-317).
+    assert task_mod.get(store, "t-test").status == TaskStatus.UNDISPATCHED.value
+    run_tick(store, TickOptions(), now=now + 15)
+    svc.get("d1").refresh(force=True)
+    finished2 = agent.run_until_idle()
+    assert finished2 == ["t-test"]
+    assert task_mod.get(store, "t-test").status == TaskStatus.SUCCEEDED.value
+
+    # 5. Host released after each task.
+    h = host_mod.get(store, hosts[0].id)
+    assert h.is_free()
+    assert h.task_count == len(finished) + len(finished2)
+
+    # 6. Task logs were captured.
+    logs = store.collection("task_logs").get("t-compile")
+    assert any("compiling compile" in line for line in logs["lines"])
+
+
+def test_failure_blocks_dependents_and_steps_back(store, tmp_path):
+    now = time.time()
+    MockCloudManager.reset(instant_up=True)
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1",
+            provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=2),
+        ),
+    )
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        {
+            "_id": "v2",
+            "tasks": {
+                "flaky": {
+                    "commands": [
+                        {"command": "shell.exec", "params": {"script": "exit 1"}}
+                    ]
+                },
+            },
+        }
+    )
+
+    def mk(tid, name, order, activated, deps=()):
+        return Task(
+            id=tid, display_name=name, project="core", version="v2",
+            build_id="", build_variant="release", distro_id="d1",
+            status=TaskStatus.UNDISPATCHED.value, activated=activated,
+            requester=Requester.REPOTRACKER.value,
+            revision_order_number=order,
+            activated_time=now - 60 if activated else 0.0,
+            create_time=now - 120,
+            expected_duration_s=60.0,
+            depends_on=[Dependency(task_id=d) for d in deps],
+        )
+
+    task_mod.insert_many(
+        store,
+        [
+            mk("prev-flaky", "flaky", order=9, activated=False),
+            mk("cur-flaky", "flaky", order=10, activated=True),
+            mk("downstream", "other", order=10, activated=True,
+               deps=["cur-flaky"]),
+        ],
+    )
+
+    run_tick(store, TickOptions(), now=now)
+    create_hosts_from_intents(store, now)
+    provision_ready_hosts(store, now)
+    hosts = host_mod.find(
+        store, lambda d: d["status"] == HostStatus.RUNNING.value
+    )
+    svc = DispatcherService(store)
+    agent = Agent(
+        LocalCommunicator(store, svc),
+        AgentOptions(host_id=hosts[0].id, work_dir=str(tmp_path)),
+    )
+    finished = agent.run_until_idle()
+    assert finished == ["cur-flaky"]
+
+    # Failure marked the dependent's edge unattainable → blocked.
+    downstream = task_mod.get(store, "downstream")
+    assert downstream.blocked()
+
+    # Linear stepback activated the previous commit's task.
+    prev = task_mod.get(store, "prev-flaky")
+    assert prev.activated
+    assert prev.is_stepback_activated()

@@ -1,0 +1,200 @@
+"""DAG dispatcher semantics (reference model/task_queue_service_dependency.go
+tests): topological handout order, task-group stickiness, single-host group
+blocking, max-hosts enforcement, dispatch races."""
+import time
+
+from evergreen_tpu.dispatch.assign import assign_next_available_task
+from evergreen_tpu.dispatch.dag_dispatcher import (
+    DAGDispatcher,
+    DispatcherService,
+    TaskSpec,
+)
+from evergreen_tpu.globals import HostStatus, TaskStatus
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import task_queue as tq_mod
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Dependency, Task
+from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+
+NOW = 1_700_000_000.0
+
+
+def qitem(tid, **kw):
+    defaults = dict(id=tid, dependencies_met=True)
+    defaults.update(kw)
+    return TaskQueueItem(**defaults)
+
+
+def seed_task(store, tid, **kw):
+    defaults = dict(
+        id=tid,
+        distro_id="d1",
+        status=TaskStatus.UNDISPATCHED.value,
+        activated=True,
+    )
+    defaults.update(kw)
+    t = Task(**defaults)
+    task_mod.insert(store, t)
+    return t
+
+
+def running_host(store, hid, **kw):
+    h = Host(id=hid, distro_id="d1", status=HostStatus.RUNNING.value, **kw)
+    host_mod.insert(store, h)
+    return h
+
+
+def save_queue(store, items):
+    tq_mod.save(store, TaskQueue(distro_id="d1", queue=items, generated_at=NOW))
+
+
+def test_topological_order_overrides_queue_rank(store):
+    # b is ranked first but depends on a: a must dispatch before b.
+    seed_task(store, "a")
+    seed_task(store, "b", depends_on=[Dependency(task_id="a")])
+    save_queue(
+        store,
+        [qitem("b", dependencies=["a"], dependencies_met=False), qitem("a")],
+    )
+    disp = DAGDispatcher(store, "d1")
+    disp.refresh(NOW)
+    first = disp.find_next_task(TaskSpec(), NOW)
+    assert first.id == "a"
+    # b's dependency is still unmet → nothing else dispatchable
+    assert disp.find_next_task(TaskSpec(), NOW) is None
+
+
+def test_group_stickiness_and_order(store):
+    for i in range(3):
+        seed_task(
+            store, f"g{i}", task_group="tg", task_group_max_hosts=1,
+            task_group_order=i, build_variant="bv", project="p", version="v",
+        )
+    seed_task(store, "solo")
+    save_queue(
+        store,
+        [qitem("solo")]
+        + [
+            qitem(
+                f"g{i}",
+                task_group="tg",
+                task_group_max_hosts=1,
+                task_group_order=i,
+                build_variant="bv",
+                project="p",
+                version="v",
+            )
+            for i in range(3)
+        ],
+    )
+    disp = DAGDispatcher(store, "d1")
+    disp.refresh(NOW)
+    spec = TaskSpec(group="tg", build_variant="bv", project="p", version="v")
+    # Host that just ran the group gets group tasks in group order.
+    assert disp.find_next_task(spec, NOW).id == "g0"
+    assert disp.find_next_task(spec, NOW).id == "g1"
+    assert disp.find_next_task(spec, NOW).id == "g2"
+    # Group exhausted → falls through to the rest of the queue.
+    assert disp.find_next_task(spec, NOW).id == "solo"
+
+
+def test_single_host_group_blocked_by_failure(store):
+    # The candidate queue item already ran and failed (stale queue): the
+    # whole single-host group stops dispatching (reference
+    # isBlockedSingleHostTaskGroup).
+    seed_task(
+        store, "g1", task_group="tg", task_group_max_hosts=1,
+        task_group_order=1, build_variant="bv", project="p", version="v",
+        status=TaskStatus.FAILED.value, finish_time=NOW - 10,
+    )
+    seed_task(
+        store, "g2", task_group="tg", task_group_max_hosts=1,
+        task_group_order=2, build_variant="bv", project="p", version="v",
+    )
+    save_queue(
+        store,
+        [
+            qitem(gid, task_group="tg", task_group_max_hosts=1,
+                  task_group_order=i + 1, build_variant="bv", project="p",
+                  version="v")
+            for i, gid in enumerate(["g1", "g2"])
+        ],
+    )
+    disp = DAGDispatcher(store, "d1")
+    disp.refresh(NOW)
+    assert disp.find_next_task(TaskSpec(), NOW) is None
+
+
+def test_single_host_group_failure_blocks_later_members_at_end(store):
+    """End-time blocking: a failed single-host group member gives later
+    members an unattainable dependency (models/lifecycle.py)."""
+    from evergreen_tpu.models.lifecycle import mark_end
+
+    for i in range(3):
+        seed_task(
+            store, f"g{i}", task_group="tg", task_group_max_hosts=1,
+            task_group_order=i, build_variant="bv", project="p", version="v",
+            status=TaskStatus.STARTED.value if i == 0
+            else TaskStatus.UNDISPATCHED.value,
+        )
+    mark_end(store, "g0", TaskStatus.FAILED.value, now=NOW)
+    assert task_mod.get(store, "g1").blocked()
+    assert task_mod.get(store, "g2").blocked()
+
+
+def test_group_max_hosts_enforced(store):
+    for i in range(2):
+        seed_task(
+            store, f"g{i}", task_group="tg", task_group_max_hosts=1,
+            task_group_order=i, build_variant="bv", project="p", version="v",
+        )
+    save_queue(
+        store,
+        [
+            qitem(f"g{i}", task_group="tg", task_group_max_hosts=1,
+                  task_group_order=i, build_variant="bv", project="p",
+                  version="v")
+            for i in range(2)
+        ],
+    )
+    # Another host is already running this group → max_hosts=1 blocks.
+    running_host(
+        store, "busy",
+        running_task="g0", running_task_group="tg",
+        running_task_build_variant="bv", running_task_project="p",
+        running_task_version="v",
+    )
+    disp = DAGDispatcher(store, "d1")
+    disp.refresh(NOW)
+    assert disp.find_next_task(TaskSpec(), NOW) is None
+
+
+def test_assignment_is_atomic_per_host(store):
+    seed_task(store, "t1")
+    seed_task(store, "t2")
+    save_queue(store, [qitem("t1"), qitem("t2")])
+    h = running_host(store, "h1")
+    svc = DispatcherService(store)
+    got = assign_next_available_task(store, svc, h, NOW)
+    assert got.id == "t1"
+    assert got.status == TaskStatus.DISPATCHED.value
+    assert host_mod.get(store, "h1").running_task == "t1"
+    # Re-poll while still assigned returns the same task (agent resume).
+    got2 = assign_next_available_task(store, svc, host_mod.get(store, "h1"), NOW)
+    assert got2.id == "t1"
+    # A second host gets the next task, not t1.
+    h2 = running_host(store, "h2")
+    got3 = assign_next_available_task(store, svc, h2, NOW)
+    assert got3.id == "t2"
+
+
+def test_stale_task_not_dispatched(store):
+    # Task was deactivated after planning: live revalidation must skip it.
+    seed_task(store, "t1", activated=False)
+    seed_task(store, "t2")
+    save_queue(store, [qitem("t1"), qitem("t2")])
+    h = running_host(store, "h1")
+    svc = DispatcherService(store)
+    got = assign_next_available_task(store, svc, h, NOW)
+    assert got.id == "t2"
