@@ -141,7 +141,20 @@ class Agent:
                 cmd = get_command(name, params)
             except KeyError as e:
                 return True, str(e)
-            result = cmd.execute(ctx)
+            # function vars overlay the expansions for this command only
+            # (reference model/project.go function var scoping)
+            saved = None
+            cmd_vars = spec.get("vars")
+            if cmd_vars:
+                saved = ctx.expansions.as_dict()
+                ctx.expansions.update(
+                    {k: ctx.expansions.expand(str(v)) for k, v in cmd_vars.items()}
+                )
+            try:
+                result = cmd.execute(ctx)
+            finally:
+                if saved is not None:
+                    ctx.expansions.restore(saved)
             if result.failed:
                 ctx.log(f"[{block}] command {display!r} failed: {result.error}")
                 return True, f"'{display}' in block {block!r}: {result.error}"

@@ -93,6 +93,11 @@ class LocalCommunicator(Communicator):
         task_def = doc.get("tasks", {}).get(task.display_name, {})
         expansions = dict(doc.get("expansions", {}))
         expansions.update(
+            doc.get("variants", {})
+            .get(task.build_variant, {})
+            .get("expansions", {})
+        )
+        expansions.update(
             {
                 "task_id": task.id,
                 "task_name": task.display_name,
@@ -102,11 +107,19 @@ class LocalCommunicator(Communicator):
                 "revision": task.revision,
             }
         )
+        pre = list(doc.get("pre", []))
+        post = list(doc.get("post", []))
+        if task.task_group:
+            # Task-group members swap pre/post for the group's setup/teardown
+            # blocks (reference agent/agent.go runPreAndMain group handling).
+            tg = doc.get("task_groups", {}).get(task.task_group, {})
+            pre = list(tg.get("setup_task", []))
+            post = list(tg.get("teardown_task", []))
         return TaskConfig(
             task=task,
             commands=list(task_def.get("commands", [])),
-            pre=list(doc.get("pre", [])),
-            post=list(doc.get("post", [])),
+            pre=pre,
+            post=post,
             timeout_handler=list(doc.get("timeout", [])),
             expansions=expansions,
             exec_timeout_s=float(

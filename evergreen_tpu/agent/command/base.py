@@ -32,6 +32,10 @@ class Expansions:
     def update(self, values: Dict[str, str]) -> None:
         self._values.update(values)
 
+    def restore(self, values: Dict[str, str]) -> None:
+        """Replace the whole map (used to pop a function-var scope)."""
+        self._values = dict(values)
+
     def as_dict(self) -> Dict[str, str]:
         return dict(self._values)
 

@@ -152,6 +152,10 @@ MAX_DURATION_PER_DISTRO_HOST_S = 30 * 60
 #: Maximum user-settable task priority (reference globals.go:185).
 MAX_TASK_PRIORITY = 100
 
+#: Expected duration assumed for tasks with no runtime history
+#: (reference model/task/task.go:65 defaultTaskDuration, 10 min).
+DEFAULT_TASK_DURATION_S = 10 * 60
+
 #: Priority value used to disable a task (reference: priority < 0 semantics).
 DISABLED_TASK_PRIORITY = -1
 

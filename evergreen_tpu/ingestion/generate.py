@@ -1,0 +1,279 @@
+"""generate.tasks: dynamic DAG growth at runtime.
+
+A running task emits JSON that appends new buildvariants/tasks to its own
+version (reference model/generate.go:24-172, job units/generate_tasks.go).
+The agent stages payloads in the ``generate_requests`` collection
+(agent/comm.py); this handler merges them into the version's parser project,
+creates the new builds/tasks, and re-plans on the next tick — BASELINE
+config 5's churn driver.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Dict, List, Optional
+
+from ..globals import (
+    GENERATE_TASKS_ACTIVATOR,
+    MAX_GENERATED_BUILD_VARIANTS,
+    MAX_GENERATED_TASKS,
+    TaskStatus,
+)
+from ..models import build as build_mod
+from ..models import event as event_mod
+from ..models import task as task_mod
+from ..models import version as version_mod
+from ..models.build import Build
+from ..models.task import Dependency, Task
+from ..storage.store import Store
+from .parser import (
+    ParserBV,
+    ParserProject,
+    ParserTask,
+    ParserTaskGroup,
+    ProjectParseError,
+    _as_list,
+)
+from .project import (
+    PARSER_PROJECTS_COLLECTION,
+    _compute_num_dependents,
+    _requester_allowed,
+    _sanitize,
+    build_agent_config_doc,
+    expand_function_commands,
+    resolve_variant_tasks,
+    task_id_for,
+)
+
+
+class GenerateError(Exception):
+    pass
+
+
+def _parser_project_from_doc(store: Store, version_id: str) -> ParserProject:
+    """Reconstruct enough of the parser project from the stored version
+    config to merge generated definitions."""
+    from .parser import parse_project
+
+    v = version_mod.get(store, version_id)
+    if v is None:
+        raise GenerateError(f"version {version_id!r} not found")
+    return parse_project(v.config_yaml or "")
+
+
+def _merge_payload(pp: ParserProject, payload: Dict[str, Any]) -> List[str]:
+    """Merge one generate.tasks JSON payload into the parser project
+    (reference model/generate.go:136-230 addGeneratedProjectToConfig).
+    Returns the buildvariant names touched."""
+    touched: List[str] = []
+    for t in _as_list(payload.get("tasks")):
+        pp.tasks.append(ParserTask.parse(t))
+    for tg in _as_list(payload.get("task_groups")):
+        pp.task_groups.append(ParserTaskGroup.parse(tg))
+    for fname, cmds in (payload.get("functions") or {}).items():
+        if fname in pp.functions:
+            raise GenerateError(
+                f"generated function {fname!r} already exists in project"
+            )
+        from .parser import _command_set
+
+        pp.functions[fname] = _command_set(cmds)
+    existing_bvs = {bv.name: bv for bv in pp.buildvariants}
+    for bv_doc in _as_list(payload.get("buildvariants")):
+        name = str(bv_doc.get("name", ""))
+        new_bv = ParserBV.parse(bv_doc)
+        if name in existing_bvs:
+            existing_bvs[name].tasks.extend(new_bv.tasks)
+            existing_bvs[name].display_tasks.extend(new_bv.display_tasks)
+        else:
+            pp.buildvariants.append(new_bv)
+            existing_bvs[name] = new_bv
+        touched.append(name)
+    return touched
+
+
+def _check_limits(pp: ParserProject) -> None:
+    """reference model/generate.go:24-25 limits."""
+    if len(pp.buildvariants) > MAX_GENERATED_BUILD_VARIANTS:
+        raise GenerateError(
+            f"generated project has {len(pp.buildvariants)} build variants, "
+            f"limit is {MAX_GENERATED_BUILD_VARIANTS}"
+        )
+    n_tasks = sum(len(bv.tasks) for bv in pp.buildvariants)
+    if n_tasks > MAX_GENERATED_TASKS:
+        raise GenerateError(
+            f"generated project references {n_tasks} tasks, limit is "
+            f"{MAX_GENERATED_TASKS}"
+        )
+
+
+def _check_cycles(tasks: List[Task]) -> None:
+    """Dependency cycle detection over the grown version (reference
+    model/generate.go:483)."""
+    index = {t.id: t for t in tasks}
+    color: Dict[str, int] = {}
+
+    def visit(tid: str, path: List[str]) -> None:
+        color[tid] = 1
+        for dep in index[tid].depends_on:
+            pid = dep.task_id
+            if pid not in index:
+                continue
+            if color.get(pid) == 1:
+                raise GenerateError(
+                    f"dependency cycle detected: {' -> '.join(path + [pid])}"
+                )
+            if color.get(pid, 0) == 0:
+                visit(pid, path + [pid])
+        color[tid] = 2
+
+    for t in tasks:
+        if color.get(t.id, 0) == 0:
+            visit(t.id, [t.id])
+
+
+def process_generate_requests(
+    store: Store, now: Optional[float] = None
+) -> List[str]:
+    """Apply all staged generate.tasks payloads (reference
+    units/generate_tasks.go:109-251). Returns ids of newly created tasks."""
+    now = _time.time() if now is None else now
+    created: List[str] = []
+    coll = store.collection("generate_requests")
+    for doc in coll.find(lambda d: not d.get("processed")):
+        generator = task_mod.get(store, doc["task_id"])
+        if generator is None:
+            coll.update(doc["_id"], {"processed": True, "error": "no generator task"})
+            continue
+        try:
+            created.extend(
+                _apply_for_version(
+                    store, generator, doc.get("payloads", []), now
+                )
+            )
+            coll.update(doc["_id"], {"processed": True})
+        except (GenerateError, ProjectParseError) as e:
+            coll.update(doc["_id"], {"processed": True, "error": str(e)})
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_TASK,
+                "GENERATE_TASKS_FAILED",
+                generator.id,
+                {"error": str(e)},
+                timestamp=now,
+            )
+    return created
+
+
+def _apply_for_version(
+    store: Store, generator: Task, payloads: List[Dict[str, Any]], now: float
+) -> List[str]:
+    version_id = generator.version
+    pp = _parser_project_from_doc(store, version_id)
+    for payload in payloads:
+        _merge_payload(pp, payload)
+    _check_limits(pp)
+
+    v = version_mod.get(store, version_id)
+    existing_tasks = task_mod.find(store, lambda d: d["version"] == version_id)
+    existing_ids = {t.id for t in existing_tasks}
+    by_variant_task = {
+        (t.build_variant, t.display_name): t for t in existing_tasks
+    }
+    builds_by_variant = {
+        b.build_variant: b for b in build_mod.find_by_version(store, version_id)
+    }
+
+    new_tasks: List[Task] = []
+    resolved_new = []
+    for bv in pp.buildvariants:
+        units = resolve_variant_tasks(pp, bv)
+        units = [u for u in units if _requester_allowed(u, v.requester)]
+        if not units:
+            continue
+        build = builds_by_variant.get(bv.name)
+        if build is None:
+            build_id = _sanitize(f"{version_id}_{bv.name}")
+            build = Build(
+                id=build_id,
+                version=version_id,
+                project=v.project,
+                build_variant=bv.name,
+                display_name=bv.display_name,
+                revision=v.revision,
+                revision_order_number=v.revision_order_number,
+                requester=v.requester,
+                activated=True,
+                activated_time=now,
+                create_time=now,
+            )
+            build_mod.insert(store, build)
+            builds_by_variant[bv.name] = build
+            version_mod.coll(store).mutate(
+                version_id, lambda d: d["build_ids"].append(build.id)
+            )
+        for rtu in units:
+            tid = task_id_for(
+                v.project, bv.name, rtu.task_def.name, v.revision,
+                v.revision_order_number,
+            )
+            if tid in existing_ids:
+                continue
+            run_on = rtu.unit.run_on or rtu.task_def.run_on or bv.run_on
+            t = Task(
+                id=tid,
+                display_name=rtu.task_def.name,
+                project=v.project,
+                version=version_id,
+                build_id=build.id,
+                build_variant=bv.name,
+                distro_id=run_on[0] if run_on else generator.distro_id,
+                secondary_distros=list(run_on[1:]),
+                revision=v.revision,
+                revision_order_number=v.revision_order_number,
+                status=TaskStatus.UNDISPATCHED.value,
+                activated=True,
+                activated_by=GENERATE_TASKS_ACTIVATOR,
+                activated_time=now,
+                priority=rtu.unit.priority or rtu.task_def.priority,
+                requester=v.requester,
+                create_time=now,
+                generated_by=generator.id,
+                task_group=rtu.group_name,
+                task_group_max_hosts=rtu.group_max_hosts,
+                task_group_order=rtu.group_order,
+                generate_task=any(
+                    c.get("command") == "generate.tasks"
+                    for c in rtu.task_def.commands
+                ),
+            )
+            existing_ids.add(tid)
+            by_variant_task[(bv.name, rtu.task_def.name)] = t
+            new_tasks.append(t)
+            resolved_new.append(rtu)
+            build_mod.coll(store).mutate(
+                build.id, lambda d, _tid=tid: d["tasks"].append(_tid)
+            )
+
+    from .project import _expand_dependencies
+
+    _expand_dependencies(pp, resolved_new, new_tasks, by_variant_task)
+    all_tasks = existing_tasks + new_tasks
+    _check_cycles(all_tasks)
+    _compute_num_dependents(all_tasks)
+    # persist recomputed num_dependents on existing tasks too
+    for t in existing_tasks:
+        task_mod.coll(store).update(t.id, {"num_dependents": t.num_dependents})
+
+    task_mod.insert_many(store, new_tasks)
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        build_agent_config_doc(version_id, pp)
+    )
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_VERSION,
+        "VERSION_TASKS_GENERATED",
+        version_id,
+        {"generator": generator.id, "count": len(new_tasks)},
+        timestamp=now,
+    )
+    return [t.id for t in new_tasks]

@@ -1,0 +1,441 @@
+"""Project configuration parser: evergreen.yml → ParserProject.
+
+Implements the schema of the reference's parser project
+(model/project_parser.go:80-152 ParserProject, :127 parserTaskGroup,
+:152 parserTask, :336 parserBV, :443 parserBVTaskUnit) over plain
+yaml.safe_load output. Flexible YAML forms are normalized here the way the
+reference's custom unmarshalers do: single-or-list dependencies, string-or-
+list run_on/tags, single-command-or-list command sets, string-or-struct
+dependency selectors.
+
+Matrix axes (model/project_parser_matrix.go) are parsed but expansion is
+not yet implemented — using them is reported as a validation error.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+
+class ProjectParseError(Exception):
+    pass
+
+
+def _as_list(v: Any) -> List:
+    if v is None:
+        return []
+    if isinstance(v, list):
+        return v
+    return [v]
+
+
+def _as_str_list(v: Any) -> List[str]:
+    return [str(x) for x in _as_list(v)]
+
+
+def _command_set(v: Any) -> List[Dict[str, Any]]:
+    """A YAMLCommandSet is either one command mapping or a list of them
+    (reference YAMLCommandSet)."""
+    out = []
+    for item in _as_list(v):
+        if isinstance(item, dict):
+            out.append(dict(item))
+        else:
+            raise ProjectParseError(f"command entry must be a mapping, got {item!r}")
+    return out
+
+
+@dataclasses.dataclass
+class ParserDependency:
+    """reference model/project_parser.go:205 parserDependency."""
+
+    name: str
+    variant: str = ""
+    status: str = ""
+    patch_optional: bool = False
+    omit_generated_tasks: bool = False
+
+    @classmethod
+    def parse(cls, v: Any) -> "ParserDependency":
+        if isinstance(v, str):
+            return cls(name=v)
+        if isinstance(v, dict):
+            return cls(
+                name=str(v.get("name", "")),
+                variant=str(v.get("variant", "") or ""),
+                status=str(v.get("status", "") or ""),
+                patch_optional=bool(v.get("patch_optional", False)),
+                omit_generated_tasks=bool(v.get("omit_generated_tasks", False)),
+            )
+        raise ProjectParseError(f"invalid depends_on entry: {v!r}")
+
+
+def _deps(v: Any) -> List[ParserDependency]:
+    return [ParserDependency.parse(x) for x in _as_list(v)]
+
+
+@dataclasses.dataclass
+class ParserTask:
+    """reference model/project_parser.go:152."""
+
+    name: str
+    priority: int = 0
+    exec_timeout_secs: int = 0
+    depends_on: List[ParserDependency] = dataclasses.field(default_factory=list)
+    commands: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    tags: List[str] = dataclasses.field(default_factory=list)
+    run_on: List[str] = dataclasses.field(default_factory=list)
+    patchable: Optional[bool] = None
+    patch_only: Optional[bool] = None
+    disable: Optional[bool] = None
+    allow_for_git_tag: Optional[bool] = None
+    git_tag_only: Optional[bool] = None
+    allowed_requesters: List[str] = dataclasses.field(default_factory=list)
+    stepback: Optional[bool] = None
+    must_have_results: Optional[bool] = None
+
+    @classmethod
+    def parse(cls, v: Dict[str, Any]) -> "ParserTask":
+        name = str(v.get("name", ""))
+        if not name:
+            raise ProjectParseError("task is missing a name")
+        return cls(
+            name=name,
+            priority=int(v.get("priority", 0) or 0),
+            exec_timeout_secs=int(v.get("exec_timeout_secs", 0) or 0),
+            depends_on=_deps(v.get("depends_on")),
+            commands=_command_set(v.get("commands")),
+            tags=_as_str_list(v.get("tags")),
+            run_on=_as_str_list(v.get("run_on")),
+            patchable=v.get("patchable"),
+            patch_only=v.get("patch_only"),
+            disable=v.get("disable"),
+            allow_for_git_tag=v.get("allow_for_git_tag"),
+            git_tag_only=v.get("git_tag_only"),
+            allowed_requesters=_as_str_list(v.get("allowed_requesters")),
+            stepback=v.get("stepback"),
+            must_have_results=v.get("must_have_test_results"),
+        )
+
+
+@dataclasses.dataclass
+class ParserTaskGroup:
+    """reference model/project_parser.go:127 parserTaskGroup."""
+
+    name: str
+    max_hosts: int = 0
+    tasks: List[str] = dataclasses.field(default_factory=list)
+    setup_group: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    setup_group_can_fail_task: bool = False
+    setup_group_timeout_secs: int = 0
+    teardown_group: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    teardown_group_timeout_secs: int = 0
+    setup_task: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    setup_task_can_fail_task: bool = False
+    setup_task_timeout_secs: int = 0
+    teardown_task: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    teardown_task_can_fail_task: bool = False
+    teardown_task_timeout_secs: int = 0
+    timeout: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    callback_timeout_secs: int = 0
+    tags: List[str] = dataclasses.field(default_factory=list)
+    share_processes: bool = False
+
+    @classmethod
+    def parse(cls, v: Dict[str, Any]) -> "ParserTaskGroup":
+        name = str(v.get("name", ""))
+        if not name:
+            raise ProjectParseError("task group is missing a name")
+        return cls(
+            name=name,
+            max_hosts=int(v.get("max_hosts", 0) or 0),
+            tasks=_as_str_list(v.get("tasks")),
+            setup_group=_command_set(v.get("setup_group")),
+            setup_group_can_fail_task=bool(v.get("setup_group_can_fail_task", False)),
+            setup_group_timeout_secs=int(v.get("setup_group_timeout_secs", 0) or 0),
+            teardown_group=_command_set(v.get("teardown_group")),
+            teardown_group_timeout_secs=int(
+                v.get("teardown_group_timeout_secs", 0) or 0
+            ),
+            setup_task=_command_set(v.get("setup_task")),
+            setup_task_can_fail_task=bool(v.get("setup_task_can_fail_task", False)),
+            setup_task_timeout_secs=int(v.get("setup_task_timeout_secs", 0) or 0),
+            teardown_task=_command_set(v.get("teardown_task")),
+            teardown_task_can_fail_task=bool(
+                v.get("teardown_task_can_fail_task", False)
+            ),
+            teardown_task_timeout_secs=int(v.get("teardown_task_timeout_secs", 0) or 0),
+            timeout=_command_set(v.get("timeout")),
+            callback_timeout_secs=int(v.get("callback_timeout_secs", 0) or 0),
+            tags=_as_str_list(v.get("tags")),
+            share_processes=bool(v.get("share_processes", False)),
+        )
+
+
+@dataclasses.dataclass
+class ParserBVTaskUnit:
+    """reference model/project_parser.go:443."""
+
+    name: str
+    patchable: Optional[bool] = None
+    patch_only: Optional[bool] = None
+    disable: Optional[bool] = None
+    allow_for_git_tag: Optional[bool] = None
+    git_tag_only: Optional[bool] = None
+    allowed_requesters: List[str] = dataclasses.field(default_factory=list)
+    exec_timeout_secs: int = 0
+    priority: int = 0
+    depends_on: List[ParserDependency] = dataclasses.field(default_factory=list)
+    stepback: Optional[bool] = None
+    run_on: List[str] = dataclasses.field(default_factory=list)
+    batchtime: Optional[int] = None
+    cron: str = ""
+    activate: Optional[bool] = None
+
+    @classmethod
+    def parse(cls, v: Any) -> "ParserBVTaskUnit":
+        if isinstance(v, str):
+            return cls(name=v)
+        name = str(v.get("name", ""))
+        if not name:
+            raise ProjectParseError("buildvariant task entry is missing a name")
+        return cls(
+            name=name,
+            patchable=v.get("patchable"),
+            patch_only=v.get("patch_only"),
+            disable=v.get("disable"),
+            allow_for_git_tag=v.get("allow_for_git_tag"),
+            git_tag_only=v.get("git_tag_only"),
+            allowed_requesters=_as_str_list(v.get("allowed_requesters")),
+            exec_timeout_secs=int(v.get("exec_timeout_secs", 0) or 0),
+            priority=int(v.get("priority", 0) or 0),
+            depends_on=_deps(v.get("depends_on")),
+            stepback=v.get("stepback"),
+            run_on=_as_str_list(v.get("run_on") or v.get("distros")),
+            batchtime=v.get("batchtime"),
+            cron=str(v.get("cron", "") or ""),
+            activate=v.get("activate"),
+        )
+
+
+@dataclasses.dataclass
+class DisplayTask:
+    name: str
+    execution_tasks: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ParserBV:
+    """reference model/project_parser.go:336 parserBV."""
+
+    name: str
+    display_name: str = ""
+    expansions: Dict[str, str] = dataclasses.field(default_factory=dict)
+    tags: List[str] = dataclasses.field(default_factory=list)
+    modules: List[str] = dataclasses.field(default_factory=list)
+    disable: Optional[bool] = None
+    batchtime: Optional[int] = None
+    cron: str = ""
+    stepback: Optional[bool] = None
+    deactivate_previous: Optional[bool] = None
+    run_on: List[str] = dataclasses.field(default_factory=list)
+    tasks: List[ParserBVTaskUnit] = dataclasses.field(default_factory=list)
+    display_tasks: List[DisplayTask] = dataclasses.field(default_factory=list)
+    depends_on: List[ParserDependency] = dataclasses.field(default_factory=list)
+    activate: Optional[bool] = None
+    patchable: Optional[bool] = None
+    patch_only: Optional[bool] = None
+    allow_for_git_tag: Optional[bool] = None
+    git_tag_only: Optional[bool] = None
+    allowed_requesters: List[str] = dataclasses.field(default_factory=list)
+    exec_timeout_secs: int = 0
+
+    @classmethod
+    def parse(cls, v: Dict[str, Any]) -> "ParserBV":
+        name = str(v.get("name", ""))
+        if not name:
+            raise ProjectParseError("buildvariant is missing a name")
+        return cls(
+            name=name,
+            display_name=str(v.get("display_name", "") or name),
+            expansions={
+                str(k): str(val) for k, val in (v.get("expansions") or {}).items()
+            },
+            tags=_as_str_list(v.get("tags")),
+            modules=_as_str_list(v.get("modules")),
+            disable=v.get("disable"),
+            batchtime=v.get("batchtime"),
+            cron=str(v.get("cron", "") or ""),
+            stepback=v.get("stepback"),
+            deactivate_previous=v.get("deactivate_previous"),
+            run_on=_as_str_list(v.get("run_on")),
+            tasks=[ParserBVTaskUnit.parse(t) for t in _as_list(v.get("tasks"))],
+            display_tasks=[
+                DisplayTask(
+                    name=str(dt.get("name", "")),
+                    execution_tasks=_as_str_list(dt.get("execution_tasks")),
+                )
+                for dt in _as_list(v.get("display_tasks"))
+            ],
+            depends_on=_deps(v.get("depends_on")),
+            activate=v.get("activate"),
+            patchable=v.get("patchable"),
+            patch_only=v.get("patch_only"),
+            allow_for_git_tag=v.get("allow_for_git_tag"),
+            git_tag_only=v.get("git_tag_only"),
+            allowed_requesters=_as_str_list(v.get("allowed_requesters")),
+            exec_timeout_secs=int(v.get("exec_timeout_secs", 0) or 0),
+        )
+
+
+@dataclasses.dataclass
+class Module:
+    name: str = ""
+    repo: str = ""
+    branch: str = ""
+    prefix: str = ""
+    auto_update: bool = False
+
+
+@dataclasses.dataclass
+class ParserProject:
+    stepback: bool = False
+    pre_error_fails_task: bool = False
+    post_error_fails_task: bool = False
+    oom_tracker: bool = False
+    owner: str = ""
+    repo: str = ""
+    remote_path: str = ""
+    branch: str = ""
+    identifier: str = ""
+    display_name: str = ""
+    command_type: str = ""
+    ignore: List[str] = dataclasses.field(default_factory=list)
+    parameters: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    pre: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    post: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    timeout: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    callback_timeout_secs: int = 0
+    pre_timeout_secs: int = 0
+    post_timeout_secs: int = 0
+    modules: List[Module] = dataclasses.field(default_factory=list)
+    buildvariants: List[ParserBV] = dataclasses.field(default_factory=list)
+    functions: Dict[str, List[Dict[str, Any]]] = dataclasses.field(
+        default_factory=dict
+    )
+    task_groups: List[ParserTaskGroup] = dataclasses.field(default_factory=list)
+    tasks: List[ParserTask] = dataclasses.field(default_factory=list)
+    exec_timeout_secs: int = 0
+    timeout_secs: int = 0
+    include: List[Dict[str, str]] = dataclasses.field(default_factory=list)
+    axes: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+
+def parse_project(
+    yaml_text: str,
+    include_resolver=None,
+) -> ParserProject:
+    """Parse an evergreen.yml. ``include_resolver(filename, module) -> str``
+    supplies included file contents (reference parserInclude +
+    project_parser_merge_functions.go); includes merge list/map fields."""
+    data = yaml.safe_load(yaml_text)
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ProjectParseError("project config must be a YAML mapping")
+    pp = _parse_dict(data)
+
+    for inc in pp.include:
+        fname = inc.get("filename", "")
+        module = inc.get("module", "")
+        if include_resolver is None:
+            raise ProjectParseError(
+                f"project includes {fname!r} but no include resolver is available"
+            )
+        sub = parse_project(include_resolver(fname, module), include_resolver)
+        _merge(pp, sub)
+    return pp
+
+
+def _parse_dict(data: Dict[str, Any]) -> ParserProject:
+    try:
+        return ParserProject(
+            stepback=bool(data.get("stepback", False)),
+            pre_error_fails_task=bool(data.get("pre_error_fails_task", False)),
+            post_error_fails_task=bool(data.get("post_error_fails_task", False)),
+            oom_tracker=bool(data.get("oom_tracker", False)),
+            owner=str(data.get("owner", "") or ""),
+            repo=str(data.get("repo", "") or ""),
+            remote_path=str(data.get("remote_path", "") or ""),
+            branch=str(data.get("branch", "") or ""),
+            identifier=str(data.get("identifier", "") or ""),
+            display_name=str(data.get("display_name", "") or ""),
+            command_type=str(data.get("command_type", "") or ""),
+            ignore=_as_str_list(data.get("ignore")),
+            parameters=_as_list(data.get("parameters")),
+            pre=_command_set(data.get("pre")),
+            post=_command_set(data.get("post")),
+            timeout=_command_set(data.get("timeout")),
+            callback_timeout_secs=int(data.get("callback_timeout_secs", 0) or 0),
+            pre_timeout_secs=int(data.get("pre_timeout_secs", 0) or 0),
+            post_timeout_secs=int(data.get("post_timeout_secs", 0) or 0),
+            modules=[
+                Module(
+                    name=str(m.get("name", "")),
+                    repo=str(m.get("repo", "")),
+                    branch=str(m.get("branch", "")),
+                    prefix=str(m.get("prefix", "")),
+                    auto_update=bool(m.get("auto_update", False)),
+                )
+                for m in _as_list(data.get("modules"))
+            ],
+            buildvariants=[
+                ParserBV.parse(bv) for bv in _as_list(data.get("buildvariants"))
+            ],
+            functions={
+                str(name): _command_set(cmds)
+                for name, cmds in (data.get("functions") or {}).items()
+            },
+            task_groups=[
+                ParserTaskGroup.parse(tg) for tg in _as_list(data.get("task_groups"))
+            ],
+            tasks=[ParserTask.parse(t) for t in _as_list(data.get("tasks"))],
+            exec_timeout_secs=int(data.get("exec_timeout_secs", 0) or 0),
+            timeout_secs=int(data.get("timeout_secs", 0) or 0),
+            include=[
+                inc if isinstance(inc, dict) else {"filename": str(inc)}
+                for inc in _as_list(data.get("include"))
+            ],
+            axes=_as_list(data.get("axes")),
+        )
+    except ProjectParseError:
+        raise
+    except (TypeError, ValueError, AttributeError) as e:
+        raise ProjectParseError(f"malformed project config: {e}") from e
+
+
+def _merge(base: ParserProject, other: ParserProject) -> None:
+    """Include merge: list fields append, map fields union with
+    duplicate-key errors (reference project_parser_merge_functions.go)."""
+    base.tasks.extend(other.tasks)
+    base.task_groups.extend(other.task_groups)
+    base.buildvariants.extend(other.buildvariants)
+    base.parameters.extend(other.parameters)
+    base.modules.extend(other.modules)
+    for name, cmds in other.functions.items():
+        if name in base.functions:
+            raise ProjectParseError(
+                f"duplicate function {name!r} defined in included file"
+            )
+        base.functions[name] = cmds
+    for field in ("pre", "post", "timeout"):
+        ours = getattr(base, field)
+        theirs = getattr(other, field)
+        if theirs:
+            if ours:
+                raise ProjectParseError(
+                    f"block {field!r} defined in both base and included file"
+                )
+            setattr(base, field, theirs)

@@ -1,0 +1,162 @@
+"""Patch system: patch documents, intents, finalization.
+
+Reference: model/patch/ (patch docs), units/patch_intent.go (async intent
+processing: fetch config at base revision, select tasks/variants, finalize),
+model/patch_lifecycle.go:620 FinalizePatch (create the patch version).
+CLI patches and GitHub PR patches both land here; only the intent source
+differs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time as _time
+from typing import List, Optional
+
+from ..globals import PatchStatus, Requester
+from ..models import event as event_mod
+from ..models import version as version_mod
+from ..storage.store import Store
+from .parser import parse_project
+from .project import CreatedVersion
+from .repotracker import get_project_ref
+from .selectors import select
+
+PATCHES_COLLECTION = "patches"
+
+_patch_seq = itertools.count(1)
+
+
+@dataclasses.dataclass
+class ModulePatch:
+    module: str = ""
+    githash: str = ""
+    diff: str = ""
+
+
+@dataclasses.dataclass
+class Patch:
+    id: str
+    project: str = ""
+    author: str = ""
+    description: str = ""
+    githash: str = ""  # base revision
+    diff: str = ""
+    module_patches: List[ModulePatch] = dataclasses.field(default_factory=list)
+    #: requested variants/tasks ("*" or names or tag selectors)
+    variants: List[str] = dataclasses.field(default_factory=list)
+    tasks: List[str] = dataclasses.field(default_factory=list)
+    requester: str = Requester.PATCH.value
+    status: str = PatchStatus.CREATED.value
+    create_time: float = 0.0
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    activated: bool = False
+    version: str = ""  # set at finalize
+    patch_number: int = 0
+    github_pr_number: int = 0
+    config_yaml: str = ""  # project file with the patch applied
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Patch":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        doc["module_patches"] = [
+            m if isinstance(m, ModulePatch) else ModulePatch(**m)
+            for m in doc.get("module_patches", [])
+        ]
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+def insert_patch(store: Store, p: Patch) -> None:
+    if p.patch_number == 0:
+        p.patch_number = next(_patch_seq)
+    store.collection(PATCHES_COLLECTION).insert(p.to_doc())
+
+
+def get_patch(store: Store, patch_id: str) -> Optional[Patch]:
+    doc = store.collection(PATCHES_COLLECTION).get(patch_id)
+    return Patch.from_doc(doc) if doc else None
+
+
+def finalize_patch(
+    store: Store, patch_id: str, now: Optional[float] = None
+) -> Optional[CreatedVersion]:
+    """Create the patch version: variant/task selection narrowed to the
+    patch's requested set, requester-gated task filtering applied inside
+    create_version (reference FinalizePatch model/patch_lifecycle.go:620 +
+    intent selection units/patch_intent.go:593-663)."""
+    now = _time.time() if now is None else now
+    p = get_patch(store, patch_id)
+    if p is None or p.version:
+        return None
+    ref = get_project_ref(store, p.project)
+    if ref is None or ref.patching_disabled:
+        return None
+
+    pp = parse_project(p.config_yaml)
+    want_variants = set(p.variants)
+    if "*" not in want_variants and want_variants:
+        expanded = set()
+        for sel in want_variants:
+            expanded.update(select(sel, pp.buildvariants))
+        want_variants = expanded
+    want_tasks = set(p.tasks)
+    if "*" not in want_tasks and want_tasks:
+        expanded = set()
+        for sel in want_tasks:
+            expanded.update(select(sel, pp.tasks))
+        want_tasks = expanded
+
+    # narrow variants at the parser level; tasks are filtered after selector
+    # resolution so tag-selector variant entries still resolve correctly
+    if want_variants and "*" not in p.variants:
+        pp.buildvariants = [
+            bv for bv in pp.buildvariants if bv.name in want_variants
+        ]
+    task_filter = (
+        want_tasks if (want_tasks and "*" not in p.tasks) else None
+    )
+
+    from .project import materialize_version
+
+    version_id = f"patch_{p.patch_number}_{p.project}"
+    created = materialize_version(
+        store,
+        pp,
+        project=p.project,
+        yaml_text=p.config_yaml,
+        revision=p.githash,
+        order=p.patch_number,
+        requester=p.requester,
+        author=p.author,
+        message=p.description,
+        version_id=version_id,
+        now=now,
+        default_distro=ref.default_distro,
+        task_filter=task_filter,
+    )
+    store.collection(PATCHES_COLLECTION).update(
+        patch_id,
+        {
+            "version": created.version.id,
+            "status": PatchStatus.STARTED.value,
+            "activated": True,
+            "start_time": now,
+        },
+    )
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_PATCH,
+        "PATCH_FINALIZED",
+        patch_id,
+        {"version": created.version.id},
+        timestamp=now,
+    )
+    return created

@@ -1,0 +1,462 @@
+"""Project translation + version materialization.
+
+Turns a ParserProject into runnable documents: Version + Builds + Tasks with
+expanded dependencies and the agent-consumable parser-project doc. This is
+the equivalent of the reference's translation + version creation path
+(model/project_parser.go TranslateProject, repotracker/repotracker.go:613
+CreateVersionFromConfig → :870 createVersionItems) shared by mainline
+commits, patches, and triggers (model/patch_lifecycle.go:620 FinalizePatch).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..globals import Requester, TaskStatus, VersionStatus, is_patch_requester
+from ..models import build as build_mod
+from ..models import event as event_mod
+from ..models import task as task_mod
+from ..models import version as version_mod
+from ..models.build import Build
+from ..models.task import Dependency, Task
+from ..models.version import Version
+from ..storage.store import Store
+from .parser import (
+    ParserBV,
+    ParserBVTaskUnit,
+    ParserProject,
+    ParserTask,
+    ProjectParseError,
+    parse_project,
+)
+from .selectors import select
+
+PARSER_PROJECTS_COLLECTION = "parser_projects"
+
+_ID_SANITIZE = re.compile(r"[^A-Za-z0-9_]+")
+
+
+def _sanitize(part: str) -> str:
+    return _ID_SANITIZE.sub("_", part)
+
+
+def task_id_for(
+    project: str, variant: str, task_name: str, revision: str, order: int
+) -> str:
+    return _sanitize(f"{project}_{variant}_{task_name}_{revision[:10]}_{order}")
+
+
+@dataclasses.dataclass
+class ResolvedTaskUnit:
+    """One concrete (variant, task) pair after selector/task-group expansion."""
+
+    task_def: ParserTask
+    unit: ParserBVTaskUnit
+    variant: ParserBV
+    group_name: str = ""
+    group_max_hosts: int = 0
+    group_order: int = 0
+
+
+def expand_function_commands(
+    pp: ParserProject, commands: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Inline ``func:`` references, attaching their vars (reference
+    model/project.go command expansion; vars become expansions scoped to the
+    function's commands)."""
+    out: List[Dict[str, Any]] = []
+    for spec in commands:
+        if "func" in spec:
+            fname = spec["func"]
+            cmds = pp.functions.get(fname)
+            if cmds is None:
+                raise ProjectParseError(f"undefined function {fname!r}")
+            fvars = {str(k): str(v) for k, v in (spec.get("vars") or {}).items()}
+            for c in cmds:
+                c2 = dict(c)
+                if fvars:
+                    merged = dict(c2.get("vars", {}))
+                    merged.update(fvars)
+                    c2["vars"] = merged
+                out.append(c2)
+        else:
+            out.append(dict(spec))
+    return out
+
+
+def resolve_variant_tasks(
+    pp: ParserProject, bv: ParserBV
+) -> List[ResolvedTaskUnit]:
+    """Expand a buildvariant's task list: entries may name a task, a task
+    group, or a tag selector (reference parserBV evaluation in
+    model/project_parser.go evaluateBuildVariants)."""
+    task_by_name = {t.name: t for t in pp.tasks}
+    group_by_name = {g.name: g for g in pp.task_groups}
+    out: List[ResolvedTaskUnit] = []
+    seen: set = set()
+
+    for unit in bv.tasks:
+        group = group_by_name.get(unit.name)
+        if group is not None:
+            for order, member in enumerate(group.tasks, start=1):
+                td = task_by_name.get(member)
+                if td is None:
+                    raise ProjectParseError(
+                        f"task group {group.name!r} references unknown task "
+                        f"{member!r}"
+                    )
+                if member in seen:
+                    continue
+                seen.add(member)
+                out.append(
+                    ResolvedTaskUnit(
+                        task_def=td,
+                        unit=unit,
+                        variant=bv,
+                        group_name=group.name,
+                        group_max_hosts=group.max_hosts or 1,
+                        group_order=order,
+                    )
+                )
+            continue
+
+        names = (
+            [unit.name]
+            if unit.name in task_by_name
+            else select(unit.name, pp.tasks)
+        )
+        if not names:
+            raise ProjectParseError(
+                f"buildvariant {bv.name!r} references unknown task or "
+                f"selector {unit.name!r}"
+            )
+        for name in names:
+            if name in seen:
+                continue
+            seen.add(name)
+            out.append(
+                ResolvedTaskUnit(task_def=task_by_name[name], unit=unit, variant=bv)
+            )
+    return out
+
+
+def _requester_allowed(
+    rtu: ResolvedTaskUnit, requester: str
+) -> bool:
+    """patchable / patch_only / git_tag_only gating vs the requester
+    (reference model/project.go ProjectCanDispatchTask-era gating at
+    creation)."""
+
+    def setting(attr: str) -> Optional[bool]:
+        for src in (rtu.unit, rtu.task_def, rtu.variant):
+            v = getattr(src, attr, None)
+            if v is not None:
+                return bool(v)
+        return None
+
+    is_patch = is_patch_requester(requester)
+    if setting("disable"):
+        return False
+    if is_patch and setting("patchable") is False:
+        return False
+    if not is_patch and setting("patch_only") is True:
+        return False
+    if setting("git_tag_only") is True:
+        return False  # git-tag requester not yet modeled
+    return True
+
+
+@dataclasses.dataclass
+class CreatedVersion:
+    version: Version
+    builds: List[Build]
+    tasks: List[Task]
+
+
+def create_version(
+    store: Store,
+    project: str,
+    yaml_text: str,
+    revision: str,
+    order: int,
+    requester: str,
+    author: str = "",
+    message: str = "",
+    version_id: Optional[str] = None,
+    now: Optional[float] = None,
+    activate: bool = True,
+    default_distro: str = "",
+    include_resolver=None,
+) -> CreatedVersion:
+    """CreateVersionFromConfig equivalent (repotracker/repotracker.go:613,
+    :870 createVersionItems): parse, then materialize version + builds +
+    tasks + dependency expansion + agent config doc."""
+    pp = parse_project(yaml_text, include_resolver)
+    if pp.axes:
+        raise ProjectParseError(
+            "matrix axes are not yet supported by this framework"
+        )
+    return materialize_version(
+        store,
+        pp,
+        project=project,
+        yaml_text=yaml_text,
+        revision=revision,
+        order=order,
+        requester=requester,
+        author=author,
+        message=message,
+        version_id=version_id,
+        now=now,
+        activate=activate,
+        default_distro=default_distro,
+    )
+
+
+def materialize_version(
+    store: Store,
+    pp: ParserProject,
+    *,
+    project: str,
+    yaml_text: str,
+    revision: str,
+    order: int,
+    requester: str,
+    author: str = "",
+    message: str = "",
+    version_id: Optional[str] = None,
+    now: Optional[float] = None,
+    activate: bool = True,
+    default_distro: str = "",
+    task_filter: Optional[set] = None,
+) -> CreatedVersion:
+    """``task_filter``: when set, only resolved tasks with these display
+    names are created (patch task selection, units/patch_intent.go:593)."""
+    now = _time.time() if now is None else now
+    vid = version_id or _sanitize(f"{project}_{order}_{revision[:10]}")
+
+    version = Version(
+        id=vid,
+        project=project,
+        branch=pp.branch,
+        revision=revision,
+        revision_order_number=order,
+        requester=requester,
+        author=author,
+        message=message,
+        status=VersionStatus.CREATED.value,
+        activated=activate,
+        create_time=now,
+        config_yaml=yaml_text,
+    )
+
+    builds: List[Build] = []
+    tasks: List[Task] = []
+    #: (variant, task name) → Task for dependency expansion
+    by_variant_task: Dict[Tuple[str, str], Task] = {}
+    resolved: List[ResolvedTaskUnit] = []
+
+    for bv in pp.buildvariants:
+        if bv.disable:
+            continue
+        units = resolve_variant_tasks(pp, bv)
+        units = [u for u in units if _requester_allowed(u, requester)]
+        if task_filter is not None:
+            units = [u for u in units if u.task_def.name in task_filter]
+        if not units:
+            continue
+        build_id = _sanitize(f"{vid}_{bv.name}")
+        bv_activate = activate and bv.activate is not False
+        build = Build(
+            id=build_id,
+            version=vid,
+            project=project,
+            build_variant=bv.name,
+            display_name=bv.display_name,
+            revision=revision,
+            revision_order_number=order,
+            requester=requester,
+            activated=bv_activate,
+            activated_time=now if bv_activate else 0.0,
+            create_time=now,
+        )
+        for rtu in units:
+            run_on = (
+                rtu.unit.run_on or rtu.task_def.run_on or bv.run_on or
+                ([default_distro] if default_distro else [])
+            )
+            t_activate = bv_activate and rtu.unit.activate is not False
+            t = Task(
+                id=task_id_for(project, bv.name, rtu.task_def.name, revision, order),
+                display_name=rtu.task_def.name,
+                project=project,
+                version=vid,
+                build_id=build_id,
+                build_variant=bv.name,
+                distro_id=run_on[0] if run_on else "",
+                secondary_distros=list(run_on[1:]),
+                revision=revision,
+                revision_order_number=order,
+                status=TaskStatus.UNDISPATCHED.value,
+                activated=t_activate,
+                activated_time=now if t_activate else 0.0,
+                priority=rtu.unit.priority or rtu.task_def.priority,
+                requester=requester,
+                create_time=now,
+                task_group=rtu.group_name,
+                task_group_max_hosts=rtu.group_max_hosts,
+                task_group_order=rtu.group_order,
+                generate_task=any(
+                    c.get("command") == "generate.tasks"
+                    for c in rtu.task_def.commands
+                ),
+            )
+            build.tasks.append(t.id)
+            tasks.append(t)
+            by_variant_task[(bv.name, rtu.task_def.name)] = t
+            resolved.append(rtu)
+        builds.append(build)
+        version.build_ids.append(build_id)
+        version.build_variants_status.append(
+            {"build_variant": bv.name, "build_id": build_id,
+             "activated": bv_activate}
+        )
+
+    _expand_dependencies(pp, resolved, tasks, by_variant_task)
+    _compute_num_dependents(tasks)
+
+    version_mod.insert(store, version)
+    for b in builds:
+        build_mod.insert(store, b)
+    task_mod.insert_many(store, tasks)
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        build_agent_config_doc(vid, pp)
+    )
+    event_mod.log(
+        store, event_mod.RESOURCE_VERSION, "VERSION_CREATED", vid, timestamp=now
+    )
+    return CreatedVersion(version=version, builds=builds, tasks=tasks)
+
+
+def _expand_dependencies(
+    pp: ParserProject,
+    resolved: List[ResolvedTaskUnit],
+    tasks: List[Task],
+    by_variant_task: Dict[Tuple[str, str], Task],
+) -> None:
+    """Translate parser dependencies into concrete task-id edges.
+
+    Precedence: BV task unit > task definition > buildvariant (reference
+    model/project_parser.go evaluateDependsOn). Selector semantics: name
+    ``*`` → every task, variant ``*`` → every variant, empty variant → same
+    variant; status "" → success, ``*`` → any finish.
+    """
+    variants = sorted({v for v, _ in by_variant_task})
+    for rtu, t in zip(resolved, tasks):
+        deps = (
+            rtu.unit.depends_on
+            or rtu.task_def.depends_on
+            or rtu.variant.depends_on
+        )
+        edges: List[Dependency] = []
+        seen: set = set()
+        for pd in deps:
+            dep_variants = (
+                variants if pd.variant == "*"
+                else [pd.variant or rtu.variant.name]
+            )
+            for dv in dep_variants:
+                if pd.name == "*":
+                    names = [
+                        name for (v, name) in by_variant_task if v == dv
+                    ]
+                else:
+                    names = [pd.name]
+                for name in names:
+                    parent = by_variant_task.get((dv, name))
+                    if parent is None or parent.id == t.id:
+                        continue
+                    if parent.id in seen:
+                        continue
+                    seen.add(parent.id)
+                    status = pd.status or TaskStatus.SUCCEEDED.value
+                    edges.append(Dependency(task_id=parent.id, status=status))
+        if edges:
+            t.depends_on = edges
+
+
+def _compute_num_dependents(tasks: List[Task]) -> None:
+    """NumDependents = number of tasks transitively depending on each task
+    (reference model/task/task.go:145 + version creation fill-in)."""
+    children: Dict[str, List[str]] = {t.id: [] for t in tasks}
+    for t in tasks:
+        for dep in t.depends_on:
+            if dep.task_id in children:
+                children[dep.task_id].append(t.id)
+
+    # reverse-topological accumulation of dependent sets (versions are small
+    # enough that a per-node BFS would also do; sets keep it exact on DAGs)
+    dependents: Dict[str, set] = {}
+
+    def collect(tid: str, stack: set) -> set:
+        if tid in dependents:
+            return dependents[tid]
+        if tid in stack:  # cycle guard; validator reports cycles separately
+            return set()
+        stack.add(tid)
+        acc: set = set()
+        for child in children[tid]:
+            acc.add(child)
+            acc |= collect(child, stack)
+        stack.discard(tid)
+        dependents[tid] = acc
+        return acc
+
+    for t in tasks:
+        t.num_dependents = len(collect(t.id, set()))
+
+
+def build_agent_config_doc(version_id: str, pp: ParserProject) -> Dict[str, Any]:
+    """The agent-consumable project doc: function-expanded command blocks
+    per task, task-group blocks, per-variant expansions."""
+    tasks_doc: Dict[str, Any] = {}
+    for td in pp.tasks:
+        tasks_doc[td.name] = {
+            "commands": expand_function_commands(pp, td.commands),
+            "exec_timeout_secs": td.exec_timeout_secs or pp.exec_timeout_secs,
+            "timeout_secs": pp.timeout_secs,
+        }
+    groups_doc: Dict[str, Any] = {}
+    for tg in pp.task_groups:
+        groups_doc[tg.name] = {
+            "max_hosts": tg.max_hosts or 1,
+            "tasks": tg.tasks,
+            "setup_group": expand_function_commands(pp, tg.setup_group),
+            "setup_task": expand_function_commands(pp, tg.setup_task),
+            "teardown_task": expand_function_commands(pp, tg.teardown_task),
+            "teardown_group": expand_function_commands(pp, tg.teardown_group),
+            "timeout": expand_function_commands(pp, tg.timeout),
+            "setup_group_can_fail_task": tg.setup_group_can_fail_task,
+            "setup_task_can_fail_task": tg.setup_task_can_fail_task,
+        }
+    variants_doc = {
+        bv.name: {"expansions": bv.expansions} for bv in pp.buildvariants
+    }
+    return {
+        "_id": version_id,
+        "pre": expand_function_commands(pp, pp.pre),
+        "post": expand_function_commands(pp, pp.post),
+        "timeout": expand_function_commands(pp, pp.timeout),
+        "pre_error_fails_task": pp.pre_error_fails_task,
+        "post_error_fails_task": pp.post_error_fails_task,
+        "exec_timeout_secs": pp.exec_timeout_secs,
+        "stepback": pp.stepback,
+        "oom_tracker": pp.oom_tracker,
+        "command_type": pp.command_type,
+        "tasks": tasks_doc,
+        "task_groups": groups_doc,
+        "variants": variants_doc,
+        "expansions": {},
+    }

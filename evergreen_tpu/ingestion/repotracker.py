@@ -1,0 +1,134 @@
+"""Repotracker: revisions → versions.
+
+The reference polls GitHub / receives push webhooks and creates a version
+per new revision (repotracker/repotracker.go:88 FetchRevisions, :220
+StoreRevisions, :613 CreateVersionFromConfig). Here the VCS boundary is the
+RevisionSource interface: production implementations fetch from a git
+provider; tests push revisions directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import List, Optional
+
+from ..globals import Requester
+from ..models import event as event_mod
+from ..models import version as version_mod
+from ..storage.store import Store
+from .parser import ProjectParseError
+from .project import CreatedVersion, create_version
+
+PROJECT_REFS_COLLECTION = "project_refs"
+
+
+@dataclasses.dataclass
+class ProjectRef:
+    """Per-branch project settings (the scheduler/ingestion-relevant core of
+    the reference's model/project_ref.go)."""
+
+    id: str
+    display_name: str = ""
+    owner: str = ""
+    repo: str = ""
+    branch: str = "main"
+    remote_path: str = "evergreen.yml"
+    enabled: bool = True
+    batch_time_minutes: int = 0
+    deactivate_previous: bool = False
+    stepback_disabled: bool = False
+    patching_disabled: bool = False
+    dispatching_disabled: bool = False
+    default_distro: str = ""
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "ProjectRef":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+def upsert_project_ref(store: Store, ref: ProjectRef) -> None:
+    store.collection(PROJECT_REFS_COLLECTION).upsert(ref.to_doc())
+
+
+def get_project_ref(store: Store, project_id: str) -> Optional[ProjectRef]:
+    doc = store.collection(PROJECT_REFS_COLLECTION).get(project_id)
+    return ProjectRef.from_doc(doc) if doc else None
+
+
+@dataclasses.dataclass
+class Revision:
+    revision: str
+    author: str = ""
+    message: str = ""
+    create_time: float = 0.0
+    config_yaml: str = ""  # the project file at this revision
+
+
+def store_revisions(
+    store: Store,
+    project_id: str,
+    revisions: List[Revision],
+    now: Optional[float] = None,
+) -> List[CreatedVersion]:
+    """Create one version per new revision, oldest first (reference
+    StoreRevisions :220-380). A config that fails to parse creates a
+    stub version carrying the error, so the failure is visible in the UI
+    instead of silently dropped (reference createStubVersion path)."""
+    now = _time.time() if now is None else now
+    ref = get_project_ref(store, project_id)
+    if ref is None or not ref.enabled:
+        return []
+
+    # next revision order number follows the project's latest version
+    existing = version_mod.find_by_project_order(
+        store, project_id, 0, 1 << 60, requester=Requester.REPOTRACKER.value
+    )
+    next_order = (existing[-1].revision_order_number + 1) if existing else 1
+
+    out: List[CreatedVersion] = []
+    for rev in revisions:
+        try:
+            created = create_version(
+                store,
+                project_id,
+                rev.config_yaml,
+                revision=rev.revision,
+                order=next_order,
+                requester=Requester.REPOTRACKER.value,
+                author=rev.author,
+                message=rev.message,
+                now=now,
+                default_distro=ref.default_distro,
+            )
+            out.append(created)
+        except ProjectParseError as e:
+            stub = version_mod.Version(
+                id=f"{project_id}_{next_order}_{rev.revision[:10]}_stub",
+                project=project_id,
+                revision=rev.revision,
+                revision_order_number=next_order,
+                requester=Requester.REPOTRACKER.value,
+                author=rev.author,
+                message=rev.message,
+                create_time=now,
+                errors=[str(e)],
+            )
+            version_mod.insert(store, stub)
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_VERSION,
+                "VERSION_CREATE_FAILED",
+                stub.id,
+                {"error": str(e)},
+                timestamp=now,
+            )
+        next_order += 1
+    return out

@@ -1,0 +1,42 @@
+"""Name/tag selector engine for task and variant references.
+
+Implements the commonly-used subset of the reference's selector grammar
+(model/project_selector.go): a selector is whitespace-separated criteria
+intersected together; each criterion is a plain name, ``*`` (all), ``.tag``
+(tag match), or a ``!``-negated form of either.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Protocol, Sequence
+
+
+class Named(Protocol):
+    name: str
+    tags: List[str]
+
+
+def _matches(criterion: str, item: Named) -> bool:
+    neg = criterion.startswith("!")
+    if neg:
+        criterion = criterion[1:]
+    if criterion == "*":
+        hit = True
+    elif criterion.startswith("."):
+        hit = criterion[1:] in item.tags
+    else:
+        hit = criterion == item.name
+    return hit != neg
+
+
+def select(selector: str, items: Sequence[Named]) -> List[str]:
+    """Resolve a selector to the names it matches, preserving item order."""
+    criteria = selector.split()
+    if not criteria:
+        return []
+    return [
+        it.name for it in items if all(_matches(c, it) for c in criteria)
+    ]
+
+
+def is_simple_name(selector: str) -> bool:
+    return not any(ch in selector for ch in " .!*")

@@ -13,6 +13,7 @@ import time as _time
 from typing import Dict, Iterable, List, Optional
 
 from ..globals import (
+    DEFAULT_TASK_DURATION_S,
     STEPBACK_TASK_ACTIVATOR,
     TASK_COMPLETED_STATUSES,
     TaskStatus,
@@ -115,6 +116,17 @@ class Task:
     def __post_init__(self) -> None:
         if self.ingest_time == 0.0 and self.create_time:
             self.ingest_time = self.create_time
+
+    def fetch_expected_duration(self) -> DurationStats:
+        """Expected runtime with the no-history default (reference
+        model/task/task.go:3510-3580 FetchExpectedDuration: stats rollup,
+        falling back to defaultTaskDuration)."""
+        if self.expected_duration_s > 0:
+            return DurationStats(
+                average_s=self.expected_duration_s,
+                std_dev_s=self.duration_std_dev_s,
+            )
+        return DurationStats(average_s=float(DEFAULT_TASK_DURATION_S))
 
     # -- identity ----------------------------------------------------------- #
 

@@ -54,6 +54,10 @@ def insert(store: Store, v: Version) -> None:
     coll(store).insert(v.to_doc())
 
 
+def find(store: Store, pred=None) -> List[Version]:
+    return [Version.from_doc(d) for d in coll(store).find(pred)]
+
+
 def get(store: Store, version_id: str) -> Optional[Version]:
     doc = coll(store).get(version_id)
     return Version.from_doc(doc) if doc else None

@@ -151,7 +151,7 @@ def unit_value(
         contains_stepback = contains_stepback or t.is_stepback_activated()
         time_in_queue_s += t.time_in_queue(now)
         max_priority = max(max_priority, t.priority)
-        expected_runtime_s += t.expected_duration_s
+        expected_runtime_s += t.fetch_expected_duration().average_s
         max_num_dependents = max(max_num_dependents, t.num_dependents)
 
     # computePriority (planner.go:271-304)
@@ -196,7 +196,7 @@ def _task_list_key(t: Task):
         t.task_group_order,
         -t.num_dependents,
         -t.priority,
-        -t.expected_duration_s,
+        -t.fetch_expected_duration().average_s,
     )
 
 
@@ -267,7 +267,7 @@ def get_distro_queue_info(
         counted = (not includes_dependencies) or met
         if counted:
             info.count += 1
-            info.expected_duration_s += t.expected_duration_s
+            info.expected_duration_s += t.fetch_expected_duration().average_s
 
         if met:
             n_deps_met += 1
@@ -276,7 +276,7 @@ def get_distro_queue_info(
                 info.count_dep_filled_merge_queue += 1
 
         if counted:
-            dur = t.expected_duration_s
+            dur = t.fetch_expected_duration().average_s
             total_expected += dur
             if dur > max_duration_threshold_s:
                 info.count_duration_over_threshold += 1

@@ -353,7 +353,10 @@ def build_snapshot(
     fill("t_in_group", [bool(t.task_group) for t in flat_tasks])
     fill("t_group_order", [t.task_group_order for t in flat_tasks])
     fill("t_time_in_queue_s", [t.time_in_queue(now) for t in flat_tasks])
-    fill("t_expected_s", [t.expected_duration_s for t in flat_tasks])
+    fill(
+        "t_expected_s",
+        [t.fetch_expected_duration().average_s for t in flat_tasks],
+    )
     fill(
         "t_wait_dep_met_s",
         [t.wait_since_dependencies_met(now) for t in flat_tasks],

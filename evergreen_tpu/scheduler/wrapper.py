@@ -109,10 +109,11 @@ def gather_tick_inputs(
         if h.running_task:
             rt = running_tasks.get(h.running_task)
             if rt is not None:
+                stats = rt.fetch_expected_duration()
                 running_estimates[h.id] = serial.RunningTaskEstimate(
                     elapsed_s=max(0.0, now - rt.start_time),
-                    expected_s=rt.expected_duration_s,
-                    std_dev_s=rt.duration_std_dev_s,
+                    expected_s=stats.average_s,
+                    std_dev_s=stats.std_dev_s,
                 )
     return distros, tasks_by_distro, hosts_by_distro, running_estimates, deps_met
 

@@ -1,0 +1,131 @@
+"""The complete CI loop from a pushed revision to a green version:
+repotracker → version/build/tasks → TPU tick → provisioning → agent →
+MarkEnd → status rollup. The closest analog to the reference's full smoke
+flow (smoke/internal/host/smoke_test.go) plus repotracker ingestion."""
+import textwrap
+import time
+
+from evergreen_tpu.agent.agent import Agent, AgentOptions
+from evergreen_tpu.agent.comm import LocalCommunicator
+from evergreen_tpu.cloud.mock import MockCloudManager
+from evergreen_tpu.cloud.provisioning import (
+    create_hosts_from_intents,
+    provision_ready_hosts,
+)
+from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+from evergreen_tpu.globals import (
+    BuildStatus,
+    HostStatus,
+    Provider,
+    VersionStatus,
+)
+from evergreen_tpu.ingestion.generate import process_generate_requests
+from evergreen_tpu.ingestion.repotracker import (
+    ProjectRef,
+    Revision,
+    store_revisions,
+    upsert_project_ref,
+)
+from evergreen_tpu.models import build as build_mod
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import version as version_mod
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+CONFIG = textwrap.dedent(
+    """
+    functions:
+      say:
+        - command: shell.exec
+          params: {script: "echo ${word|nothing}"}
+    tasks:
+      - name: build
+        commands:
+          - func: say
+            vars: {word: building}
+      - name: test
+        depends_on: [{name: build}]
+        commands:
+          - func: say
+            vars: {word: testing}
+      - name: makegen
+        commands:
+          - command: shell.exec
+            params: {script: "echo '{\\"tasks\\":[{\\"name\\":\\"extra\\",\\"commands\\":[{\\"command\\":\\"shell.exec\\",\\"params\\":{\\"script\\":\\"echo extra\\"}}]}],\\"buildvariants\\":[{\\"name\\":\\"lin\\",\\"tasks\\":[{\\"name\\":\\"extra\\"}]}]}' > gen.json"}
+          - command: generate.tasks
+            params: {files: [gen.json]}
+    buildvariants:
+      - name: lin
+        run_on: [ubuntu]
+        tasks: [{name: build}, {name: test}, {name: makegen}]
+    """
+)
+
+
+def drain(store, svc, tmp_path, now):
+    """Run one tick + provision + drain every running host."""
+    run_tick(store, TickOptions(), now=now)
+    create_hosts_from_intents(store, now)
+    provision_ready_hosts(store, now)
+    for d in svc._dispatchers.values():
+        d.refresh(force=True)
+    finished = []
+    for h in host_mod.find(
+        store, lambda d: d["status"] == HostStatus.RUNNING.value
+    ):
+        agent = Agent(
+            LocalCommunicator(store, svc),
+            AgentOptions(host_id=h.id, work_dir=str(tmp_path)),
+        )
+        finished.extend(agent.run_until_idle())
+    return finished
+
+
+def test_push_to_green_version(store, tmp_path):
+    now = time.time()
+    MockCloudManager.reset()
+    distro_mod.insert(
+        store,
+        Distro(
+            id="ubuntu",
+            provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=4),
+        ),
+    )
+    upsert_project_ref(store, ProjectRef(id="myproj"))
+
+    created = store_revisions(
+        store, "myproj", [Revision(revision="deadbeef01", config_yaml=CONFIG)],
+        now=now,
+    )
+    assert len(created) == 1
+    vid = created[0].version.id
+    assert len(created[0].tasks) == 3
+
+    svc = DispatcherService(store)
+    done1 = drain(store, svc, tmp_path, now)
+    # build + makegen run in wave 1 (test waits on build)
+    assert {task_mod.get(store, t).display_name for t in done1} == {
+        "build", "makegen",
+    }
+
+    # generate.tasks payload staged by the agent → ingestion grows the DAG
+    new_ids = process_generate_requests(store, now=now + 1)
+    assert len(new_ids) == 1
+    assert task_mod.get(store, new_ids[0]).display_name == "extra"
+
+    done2 = drain(store, svc, tmp_path, now + 15)
+    assert {task_mod.get(store, t).display_name for t in done2} == {
+        "test", "extra",
+    }
+
+    # Everything green → build + version statuses rolled up.
+    v = version_mod.get(store, vid)
+    assert v.status == VersionStatus.SUCCEEDED.value
+    builds = build_mod.find_by_version(store, vid)
+    assert all(b.status == BuildStatus.SUCCEEDED.value for b in builds)
+    # The generated task's log proves the dynamic task actually executed.
+    logs = store.collection("task_logs").get(new_ids[0])
+    assert any("extra" in line for line in logs["lines"])

@@ -1,0 +1,267 @@
+"""Ingestion plane: YAML parsing, version creation, dependency expansion,
+patches, generate.tasks, repotracker (reference analog: model/project_parser
+tests, repotracker tests, model/generate tests)."""
+import textwrap
+
+import pytest
+
+from evergreen_tpu.globals import Requester, TaskStatus
+from evergreen_tpu.ingestion.generate import process_generate_requests
+from evergreen_tpu.ingestion.parser import (
+    ProjectParseError,
+    parse_project,
+)
+from evergreen_tpu.ingestion.patches import (
+    Patch,
+    finalize_patch,
+    get_patch,
+    insert_patch,
+)
+from evergreen_tpu.ingestion.project import create_version
+from evergreen_tpu.ingestion.repotracker import (
+    ProjectRef,
+    Revision,
+    store_revisions,
+    upsert_project_ref,
+)
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import version as version_mod
+
+YAML = textwrap.dedent(
+    """
+    stepback: true
+    pre_error_fails_task: true
+    pre:
+      - command: shell.exec
+        params: {script: "echo pre"}
+    post:
+      - command: shell.exec
+        params: {script: "echo post"}
+    functions:
+      compile-it:
+        - command: shell.exec
+          params: {script: "echo build-${target|default}"}
+    tasks:
+      - name: compile
+        tags: [primary]
+        commands:
+          - func: compile-it
+            vars: {target: core}
+      - name: unit-test
+        tags: [test]
+        depends_on:
+          - name: compile
+        commands:
+          - command: shell.exec
+            params: {script: "echo test"}
+      - name: lint
+        tags: [test]
+        patchable: false
+        commands:
+          - command: shell.exec
+            params: {script: "echo lint"}
+      - name: bench
+        tags: [perf]
+        commands:
+          - command: shell.exec
+            params: {script: "echo bench"}
+    task_groups:
+      - name: perf_group
+        max_hosts: 1
+        tasks: [bench]
+    buildvariants:
+      - name: linux
+        display_name: Linux
+        run_on: [ubuntu2204]
+        expansions: {arch: x86}
+        tasks:
+          - name: compile
+          - name: ".test"
+          - name: perf_group
+      - name: mac
+        run_on: [macos]
+        tasks:
+          - name: compile
+    """
+)
+
+
+def test_parse_full_schema():
+    pp = parse_project(YAML)
+    assert pp.stepback and pp.pre_error_fails_task
+    assert [t.name for t in pp.tasks] == ["compile", "unit-test", "lint", "bench"]
+    assert pp.tasks[1].depends_on[0].name == "compile"
+    assert pp.task_groups[0].max_hosts == 1
+    assert len(pp.buildvariants) == 2
+    assert pp.buildvariants[0].expansions == {"arch": "x86"}
+
+
+def test_parse_errors():
+    with pytest.raises(ProjectParseError):
+        parse_project("tasks:\n  - commands: []\n")  # missing name
+    with pytest.raises(ProjectParseError):
+        parse_project("- not a mapping\n")
+
+
+def test_create_version_expands_everything(store):
+    created = create_version(
+        store, "proj", YAML, revision="abcdef1234", order=5,
+        requester=Requester.REPOTRACKER.value, now=1000.0,
+    )
+    v = created.version
+    assert v.revision_order_number == 5
+    # linux: compile, unit-test (.test tag), lint (.test tag), bench (group)
+    # mac: compile
+    names = {(t.build_variant, t.display_name) for t in created.tasks}
+    assert names == {
+        ("linux", "compile"),
+        ("linux", "unit-test"),
+        ("linux", "lint"),
+        ("linux", "bench"),
+        ("mac", "compile"),
+    }
+    by_name = {(t.build_variant, t.display_name): t for t in created.tasks}
+    # dependency expanded to the same-variant compile task
+    ut = by_name[("linux", "unit-test")]
+    assert ut.depends_on[0].task_id == by_name[("linux", "compile")].id
+    # num_dependents counted
+    assert by_name[("linux", "compile")].num_dependents == 1
+    assert by_name[("mac", "compile")].num_dependents == 0
+    # run_on resolution
+    assert ut.distro_id == "ubuntu2204"
+    assert by_name[("mac", "compile")].distro_id == "macos"
+    # task group membership
+    bench = by_name[("linux", "bench")]
+    assert bench.task_group == "perf_group"
+    assert bench.task_group_max_hosts == 1
+    # agent config doc has expanded function commands with vars
+    doc = store.collection("parser_projects").get(v.id)
+    cmd = doc["tasks"]["compile"]["commands"][0]
+    assert cmd["command"] == "shell.exec"
+    assert cmd["vars"] == {"target": "core"}
+    assert doc["variants"]["linux"]["expansions"] == {"arch": "x86"}
+
+
+def test_patch_finalize_narrows_and_gates(store):
+    upsert_project_ref(store, ProjectRef(id="proj"))
+    insert_patch(
+        store,
+        Patch(
+            id="p1", project="proj", author="me", githash="abcdef1234",
+            config_yaml=YAML, variants=["linux"], tasks=["compile", "unit-test", "lint"],
+        ),
+    )
+    created = finalize_patch(store, "p1", now=1000.0)
+    assert created is not None
+    names = {(t.build_variant, t.display_name) for t in created.tasks}
+    # lint is patchable: false → excluded despite being requested;
+    # mac variant not requested.
+    assert names == {("linux", "compile"), ("linux", "unit-test")}
+    assert all(t.requester == Requester.PATCH.value for t in created.tasks)
+    p = get_patch(store, "p1")
+    assert p.version == created.version.id
+
+
+def test_repotracker_creates_versions_and_stubs(store):
+    upsert_project_ref(store, ProjectRef(id="proj", default_distro="dflt"))
+    out = store_revisions(
+        store,
+        "proj",
+        [
+            Revision(revision="aaaa111111", config_yaml=YAML),
+            Revision(revision="bbbb222222", config_yaml="tasks:\n  - commands: []"),
+            Revision(revision="cccc333333", config_yaml=YAML),
+        ],
+        now=1000.0,
+    )
+    assert len(out) == 2  # middle one failed to parse
+    orders = [c.version.revision_order_number for c in out]
+    assert orders == [1, 3]
+    stubs = version_mod.find(
+        store, lambda d: d.get("errors")
+    )
+    assert len(stubs) == 1
+    assert stubs[0].revision == "bbbb222222"
+
+
+def test_generate_tasks_grows_version(store):
+    created = create_version(
+        store, "proj", YAML, revision="abcdef1234", order=7,
+        requester=Requester.REPOTRACKER.value, now=1000.0,
+    )
+    generator = next(
+        t for t in created.tasks
+        if (t.build_variant, t.display_name) == ("linux", "compile")
+    )
+    payload = {
+        "tasks": [
+            {
+                "name": "gen-test-1",
+                "commands": [
+                    {"command": "shell.exec", "params": {"script": "echo g1"}}
+                ],
+                "depends_on": [{"name": "compile"}],
+            }
+        ],
+        "buildvariants": [
+            {"name": "linux", "tasks": [{"name": "gen-test-1"}]},
+            {
+                "name": "arm",
+                "run_on": ["arm64"],
+                "tasks": [{"name": "gen-test-1"}],
+            },
+        ],
+    }
+    store.collection("generate_requests").upsert(
+        {"_id": generator.id, "task_id": generator.id, "payloads": [payload],
+         "processed": False}
+    )
+    new_ids = process_generate_requests(store, now=1001.0)
+    assert len(new_ids) == 2  # linux + arm
+    new_tasks = task_mod.by_ids(store, new_ids)
+    variants = {t.build_variant for t in new_tasks}
+    assert variants == {"linux", "arm"}
+    linux_gen = next(t for t in new_tasks if t.build_variant == "linux")
+    assert linux_gen.generated_by == generator.id
+    assert linux_gen.depends_on[0].task_id == generator.id
+    # generator's dependent count now includes the generated task
+    assert task_mod.get(store, generator.id).num_dependents >= 1
+    # request marked processed; re-processing is a no-op
+    assert process_generate_requests(store, now=1002.0) == []
+
+
+def test_generate_tasks_cycle_detection(store):
+    simple = textwrap.dedent(
+        """
+        tasks:
+          - name: gen
+            commands:
+              - command: generate.tasks
+                params: {files: [g.json]}
+        buildvariants:
+          - name: bv
+            run_on: [d1]
+            tasks: [{name: gen}]
+        """
+    )
+    created = create_version(
+        store, "proj", simple, revision="abc", order=1,
+        requester=Requester.REPOTRACKER.value, now=1000.0,
+    )
+    gen_task = created.tasks[0]
+    assert gen_task.generate_task
+    payload = {
+        "tasks": [
+            {"name": "x", "commands": [], "depends_on": [{"name": "y"}]},
+            {"name": "y", "commands": [], "depends_on": [{"name": "x"}]},
+        ],
+        "buildvariants": [{"name": "bv", "tasks": [{"name": "x"}, {"name": "y"}]}],
+    }
+    store.collection("generate_requests").upsert(
+        {"_id": gen_task.id, "task_id": gen_task.id, "payloads": [payload],
+         "processed": False}
+    )
+    new_ids = process_generate_requests(store, now=1001.0)
+    assert new_ids == []
+    req = store.collection("generate_requests").get(gen_task.id)
+    assert "cycle" in req["error"]
