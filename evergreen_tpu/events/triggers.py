@@ -1,0 +1,210 @@
+"""Trigger engine: events → subscriptions → notifications.
+
+Reference: trigger/process.go:28 NotificationsFromEvent (match events
+against subscription selectors), per-type trigger sets
+(trigger/{task,build,host,patch,version}.go), notification docs
+(model/notification/), delivery jobs (units/event_notifier.go:64-101,
+units/event_send.go).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from ..globals import TaskStatus
+from ..models import event as event_mod
+from ..models.event import Event
+from ..storage.store import Store
+
+SUBSCRIPTIONS_COLLECTION = "subscriptions"
+NOTIFICATIONS_COLLECTION = "notifications"
+
+_seq = itertools.count()
+_seq_lock = threading.Lock()
+
+
+# trigger names (reference trigger/registry.go trigger constants)
+TRIGGER_OUTCOME = "outcome"
+TRIGGER_FAILURE = "failure"
+TRIGGER_SUCCESS = "success"
+TRIGGER_FIRST_FAILURE = "first-failure-in-version"
+
+
+@dataclasses.dataclass
+class Subscription:
+    """Who wants to hear about what (reference model/event/subscriptions.go):
+    resource type + trigger + selector filters → a subscriber channel."""
+
+    id: str
+    resource_type: str
+    trigger: str
+    subscriber_type: str  # email | slack | webhook | github-status | jira
+    subscriber_target: str
+    #: selector filters on the event payload (project, requester, id, …)
+    filters: Dict[str, str] = dataclasses.field(default_factory=dict)
+    owner: str = ""
+    enabled: bool = True
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Subscription":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        return cls(**doc)
+
+
+def add_subscription(store: Store, sub: Subscription) -> None:
+    store.collection(SUBSCRIPTIONS_COLLECTION).upsert(sub.to_doc())
+
+
+@dataclasses.dataclass
+class Notification:
+    id: str
+    subscription_id: str
+    subscriber_type: str
+    subscriber_target: str
+    subject: str
+    body: str
+    created_at: float
+    sent_at: float = 0.0
+    error: str = ""
+
+
+# --------------------------------------------------------------------------- #
+# Event → trigger evaluation
+# --------------------------------------------------------------------------- #
+
+
+def _event_triggers(store: Store, ev: Event) -> List[str]:
+    """Which trigger names does this event fire? (reference per-type
+    trigger sets, trigger/task.go etc.)"""
+    triggers: List[str] = []
+    if ev.event_type in ("TASK_FINISHED",):
+        triggers.append(TRIGGER_OUTCOME)
+        status = ev.data.get("status", "")
+        if status == TaskStatus.FAILED.value:
+            triggers.append(TRIGGER_FAILURE)
+        elif status == TaskStatus.SUCCEEDED.value:
+            triggers.append(TRIGGER_SUCCESS)
+    elif ev.event_type.startswith("BUILD_") or ev.event_type.startswith("VERSION_"):
+        triggers.append(TRIGGER_OUTCOME)
+        if ev.event_type.endswith("FAILED"):
+            triggers.append(TRIGGER_FAILURE)
+        elif ev.event_type.endswith("SUCCESS") or ev.event_type.endswith("SUCCEEDED"):
+            triggers.append(TRIGGER_SUCCESS)
+    elif ev.resource_type == event_mod.RESOURCE_HOST:
+        triggers.append(TRIGGER_OUTCOME)
+    elif ev.resource_type == event_mod.RESOURCE_PATCH:
+        triggers.append(TRIGGER_OUTCOME)
+    return triggers
+
+
+def _matches(store: Store, sub: Subscription, ev: Event) -> bool:
+    if not sub.enabled or sub.resource_type != ev.resource_type:
+        return False
+    for key, want in sub.filters.items():
+        if key == "id":
+            if ev.resource_id != want:
+                return False
+        else:
+            # resolve against the event payload, then the resource document
+            got = ev.data.get(key)
+            if got is None:
+                got = _resource_field(store, ev, key)
+            if str(got) != want:
+                return False
+    return True
+
+
+def _resource_field(store: Store, ev: Event, key: str):
+    coll_by_type = {
+        event_mod.RESOURCE_TASK: "tasks",
+        event_mod.RESOURCE_BUILD: "builds",
+        event_mod.RESOURCE_VERSION: "versions",
+        event_mod.RESOURCE_HOST: "hosts",
+        event_mod.RESOURCE_PATCH: "patches",
+    }
+    coll = coll_by_type.get(ev.resource_type)
+    if coll is None:
+        return None
+    doc = store.collection(coll).get(ev.resource_id)
+    return doc.get(key) if doc else None
+
+
+def notifications_from_event(store: Store, ev: Event) -> List[Notification]:
+    """trigger/process.go:28 — match the event's fired triggers against
+    subscriptions, building notification docs."""
+    fired = _event_triggers(store, ev)
+    if not fired:
+        return []
+    out: List[Notification] = []
+    for doc in store.collection(SUBSCRIPTIONS_COLLECTION).find():
+        sub = Subscription.from_doc(doc)
+        if sub.trigger not in fired:
+            continue
+        if not _matches(store, sub, ev):
+            continue
+        with _seq_lock:
+            nid = f"ntf-{next(_seq)}"
+        out.append(
+            Notification(
+                id=nid,
+                subscription_id=sub.id,
+                subscriber_type=sub.subscriber_type,
+                subscriber_target=sub.subscriber_target,
+                subject=f"[evergreen-tpu] {ev.resource_type.lower()} "
+                f"{ev.resource_id}: {ev.event_type.lower()}",
+                body=str(ev.data),
+                created_at=ev.timestamp,
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Delivery (reference units/event_send.go; channel senders pluggable)
+# --------------------------------------------------------------------------- #
+
+Sender = Callable[[Notification], None]
+_SENDERS: Dict[str, Sender] = {}
+
+
+def register_sender(subscriber_type: str, sender: Sender) -> None:
+    _SENDERS[subscriber_type] = sender
+
+
+def process_unprocessed_events(
+    store: Store, now: Optional[float] = None, limit: int = 0
+) -> int:
+    """The event-notifier job (units/event_notifier.go:64-101): scan the
+    unprocessed event log, create + deliver notifications, mark processed.
+    """
+    now = _time.time() if now is None else now
+    coll = store.collection(NOTIFICATIONS_COLLECTION)
+    n = 0
+    for ev in event_mod.find_unprocessed(store, limit):
+        for ntf in notifications_from_event(store, ev):
+            sender = _SENDERS.get(ntf.subscriber_type)
+            error = ""
+            if sender is not None:
+                try:
+                    sender(ntf)
+                    ntf.sent_at = now
+                except Exception as e:  # delivery failures are recorded
+                    error = str(e)
+            else:
+                error = f"no sender for {ntf.subscriber_type!r}"
+            doc = dataclasses.asdict(ntf)
+            doc["_id"] = doc.pop("id")
+            doc["error"] = error
+            coll.upsert(doc)
+            n += 1
+        event_mod.mark_processed(store, ev.id, now)
+    return n

@@ -331,6 +331,11 @@ def materialize_version(
     for b in builds:
         build_mod.insert(store, b)
     task_mod.insert_many(store, tasks)
+    # stamp expected durations from the historical rollups so the scheduler
+    # snapshot reads plain fields (SURVEY §7 duration-stats freshness)
+    from ..models import taskstats
+
+    taskstats.stamp_expected_durations(store, tasks)
     store.collection(PARSER_PROJECTS_COLLECTION).upsert(
         build_agent_config_doc(vid, pp)
     )

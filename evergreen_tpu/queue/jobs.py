@@ -1,0 +1,224 @@
+"""Background job plane: the amboy-equivalent.
+
+The reference runs every background operation as an amboy Job on
+Mongo-backed distributed queues with worker pools, scope locks, and
+interval-driven populators (SURVEY §2.2: environment.go:469-486,
+units/crons.go). This is the same architecture in-process: jobs are named,
+scope-locked, deduplicated units of work executed by a worker pool; cron
+populators enqueue them on interval ticks.
+
+Durability: job state lives in the store's ``jobs`` collection so the plane
+is introspectable and a replacement process resumes from queue state —
+jobs themselves are idempotent store-driven functions (the reference's
+stateless-resume property, SURVEY §5).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import threading
+import time as _time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Set
+
+from ..models import event as event_mod
+from ..storage.store import Store
+
+JOBS_COLLECTION = "jobs"
+
+
+class Job(abc.ABC):
+    """One unit of background work (reference amboy.Job).
+
+    ``job_id`` deduplicates: enqueueing an id already pending is a no-op
+    (amboy's EnqueueUnique). ``scopes`` are exclusive locks: two jobs
+    sharing a scope never run concurrently (amboy scope locks,
+    units/scheduler.go:48-49).
+    """
+
+    job_type: str = "job"
+    max_time_s: float = 0.0
+
+    def __init__(self, job_id: str, scopes: Optional[List[str]] = None) -> None:
+        self.job_id = job_id
+        self.scopes = scopes or []
+
+    @abc.abstractmethod
+    def run(self, store: Store) -> None:
+        ...
+
+
+class FnJob(Job):
+    """Adapter for plain functions."""
+
+    def __init__(
+        self,
+        job_id: str,
+        fn: Callable[[Store], None],
+        scopes: Optional[List[str]] = None,
+        job_type: str = "fn",
+    ) -> None:
+        super().__init__(job_id, scopes)
+        self.fn = fn
+        self.job_type = job_type
+
+    def run(self, store: Store) -> None:
+        self.fn(store)
+
+
+class JobQueue:
+    """Scope-locked worker-pool queue."""
+
+    def __init__(self, store: Store, workers: int = 4, name: str = "service") -> None:
+        self.store = store
+        self.name = name
+        self._executor = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=f"jobq-{name}"
+        )
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Job] = {}
+        self._held_scopes: Set[str] = set()
+        self._waiting: List[Job] = []
+        self._closed = False
+
+    # -- enqueue ------------------------------------------------------------- #
+
+    def put(self, job: Job) -> bool:
+        """Enqueue unless a job with the same id is already pending/running."""
+        with self._lock:
+            if self._closed or job.job_id in self._pending:
+                return False
+            self._pending[job.job_id] = job
+            self.store.collection(JOBS_COLLECTION).upsert(
+                {
+                    "_id": job.job_id,
+                    "type": job.job_type,
+                    "status": "pending",
+                    "enqueued_at": _time.time(),
+                    "scopes": job.scopes,
+                    "error": "",
+                }
+            )
+            if self._try_acquire(job):
+                self._submit(job)
+            else:
+                self._waiting.append(job)
+            return True
+
+    def _try_acquire(self, job: Job) -> bool:
+        if any(s in self._held_scopes for s in job.scopes):
+            return False
+        self._held_scopes.update(job.scopes)
+        return True
+
+    def _submit(self, job: Job) -> None:
+        self._executor.submit(self._run_job, job)
+
+    # -- execution ----------------------------------------------------------- #
+
+    def _run_job(self, job: Job) -> None:
+        coll = self.store.collection(JOBS_COLLECTION)
+        coll.update(job.job_id, {"status": "running", "started_at": _time.time()})
+        error = ""
+        try:
+            job.run(self.store)
+        except Exception:  # job errors must never kill the worker pool
+            error = traceback.format_exc()
+            event_mod.log(
+                self.store,
+                event_mod.RESOURCE_ADMIN,
+                "JOB_FAILED",
+                job.job_id,
+                {"type": job.job_type, "error": error[-2000:]},
+            )
+        coll.update(
+            job.job_id,
+            {
+                "status": "failed" if error else "completed",
+                "finished_at": _time.time(),
+                "error": error[-2000:],
+            },
+        )
+        with self._lock:
+            self._pending.pop(job.job_id, None)
+            for s in job.scopes:
+                self._held_scopes.discard(s)
+            # release any waiters whose scopes are now free
+            still_waiting = []
+            for w in self._waiting:
+                if self._try_acquire(w):
+                    self._submit(w)
+                else:
+                    still_waiting.append(w)
+            self._waiting = still_waiting
+
+    # -- introspection / lifecycle ------------------------------------------- #
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            if self.pending_count() == 0:
+                return True
+            _time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._executor.shutdown(wait=True)
+
+
+@dataclasses.dataclass
+class IntervalOperation:
+    """A cron populator: every ``interval_s``, generate jobs to enqueue
+    (reference amboy.IntervalQueueOperation + units/crons.go populators)."""
+
+    name: str
+    interval_s: float
+    populate: Callable[[Store, float], List[Job]]
+    last_run: float = 0.0
+
+
+class CronRunner:
+    """Drives interval operations. ``tick()`` is callable manually (tests,
+    single-step CLI) or continuously via ``run_background``."""
+
+    def __init__(self, store: Store, queue: JobQueue) -> None:
+        self.store = store
+        self.queue = queue
+        self.ops: List[IntervalOperation] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, op: IntervalOperation) -> None:
+        self.ops.append(op)
+
+    def tick(self, now: Optional[float] = None, force: bool = False) -> int:
+        now = _time.time() if now is None else now
+        n = 0
+        for op in self.ops:
+            if force or now - op.last_run >= op.interval_s:
+                op.last_run = now
+                for job in op.populate(self.store, now):
+                    if self.queue.put(job):
+                        n += 1
+        return n
+
+    def run_background(self, poll_s: float = 1.0) -> None:
+        def loop() -> None:
+            while not self._stop.is_set():
+                self.tick()
+                self._stop.wait(poll_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="cron")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

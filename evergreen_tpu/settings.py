@@ -1,0 +1,137 @@
+"""Runtime settings: store-backed config sections + service flags.
+
+Mirrors the reference's two-tier config system (SURVEY §5): a bootstrap
+Settings object plus DB-backed config sections editable at runtime
+(reference config_sections.go:23-68 registry; config_serviceflags.go
+kill-switches checked at the top of every job/route).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Type
+
+from .storage.store import Store
+
+CONFIG_COLLECTION = "config"
+
+
+class ConfigSection:
+    """Subclasses are dataclasses with a ``section_id`` class attr
+    (reference ConfigSection interface: SectionId/Get/Set/ValidateAndDefault).
+    """
+
+    section_id: str = ""
+
+    @classmethod
+    def get(cls, store: Store) -> "ConfigSection":
+        doc = store.collection(CONFIG_COLLECTION).get(cls.section_id)
+        if doc is None:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+    def set(self, store: Store) -> None:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = self.section_id
+        store.collection(CONFIG_COLLECTION).upsert(doc)
+
+
+_SECTIONS: Dict[str, Type[ConfigSection]] = {}
+
+
+def register_section(cls: Type[ConfigSection]) -> Type[ConfigSection]:
+    assert cls.section_id
+    _SECTIONS[cls.section_id] = cls
+    return cls
+
+
+def get_section(store: Store, section_id: str) -> Optional[ConfigSection]:
+    cls = _SECTIONS.get(section_id)
+    return cls.get(store) if cls else None
+
+
+def all_sections() -> Dict[str, Type[ConfigSection]]:
+    return dict(_SECTIONS)
+
+
+@register_section
+@dataclasses.dataclass
+class ServiceFlags(ConfigSection):
+    """Per-subsystem kill-switches (reference config_serviceflags.go;
+    checked e.g. units/scheduler.go:66, rest/route/host_agent.go:168)."""
+
+    section_id = "service_flags"
+
+    scheduler_disabled: bool = False
+    host_allocator_disabled: bool = False
+    host_init_disabled: bool = False
+    monitor_disabled: bool = False
+    agent_start_disabled: bool = False
+    repotracker_disabled: bool = False
+    task_dispatch_disabled: bool = False
+    event_processing_disabled: bool = False
+    alerts_disabled: bool = False
+    background_stats_disabled: bool = False
+    task_logging_disabled: bool = False
+    cache_stats_job_disabled: bool = False
+    stepback_disabled: bool = False
+    patching_disabled: bool = False
+    generate_tasks_disabled: bool = False
+
+
+@register_section
+@dataclasses.dataclass
+class SchedulerConfig(ConfigSection):
+    """Global scheduler knobs (reference config_scheduler.go)."""
+
+    section_id = "scheduler"
+
+    target_time_seconds: int = 0
+    patch_factor: int = 0
+    patch_time_in_queue_factor: int = 0
+    commit_queue_factor: int = 0
+    mainline_time_in_queue_factor: int = 0
+    expected_runtime_factor: int = 0
+    generate_task_factor: int = 0
+    num_dependents_factor: float = 0.0
+    stepback_task_factor: int = 0
+    max_scheduled_tasks_per_distro: int = 0
+
+
+@register_section
+@dataclasses.dataclass
+class TaskLimitsConfig(ConfigSection):
+    """reference config_task_limits.go."""
+
+    section_id = "task_limits"
+
+    max_tasks_per_version: int = 0
+    max_pending_generated_tasks: int = 0
+    max_generate_task_json_size_kb: int = 0
+    max_concurrent_large_parser_project_tasks: int = 0
+    max_hourly_patch_tasks: int = 0
+    max_exec_timeout_secs: int = 0
+    max_task_execution: int = 9  # max automatic restarts
+
+
+@register_section
+@dataclasses.dataclass
+class HostInitConfig(ConfigSection):
+    """reference config_hostinit.go."""
+
+    section_id = "host_init"
+
+    host_throttle: int = 32
+    provisioning_throttle: int = 200
+    cloud_status_batch_size: int = 100
+    max_total_dynamic_hosts: int = 5000
+
+
+@register_section
+@dataclasses.dataclass
+class NotifyConfig(ConfigSection):
+    section_id = "notify"
+
+    buffer_target_per_interval: int = 20
+    buffer_interval_seconds: int = 60
+    eventual_consistency_delay_s: float = 0.0

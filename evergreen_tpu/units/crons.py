@@ -1,0 +1,198 @@
+"""Cron populators: the service's background heartbeat.
+
+Reference: units/crons.go + crons_remote_* populators driven by
+amboy.IntervalQueueOperation (operations/service.go:70-128). The key
+architectural change: the 15-second scheduling tick enqueues ONE batched
+solve job for all distros instead of one scheduler + one allocator job per
+distro (units/crons.go:274-331) — the TPU solve replaced the fan-out.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List
+
+from ..events.triggers import process_unprocessed_events
+from ..cloud.provisioning import create_hosts_from_intents, provision_ready_hosts
+from ..ingestion.generate import process_generate_requests
+from ..models import taskstats
+from ..queue.jobs import CronRunner, FnJob, Job, JobQueue
+from ..scheduler.wrapper import TickOptions, run_tick
+from ..settings import HostInitConfig, ServiceFlags
+from ..storage.store import Store
+from . import host_jobs, task_jobs
+
+
+def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
+    """The 15s tick (crons_remote_fifteen_second.go:42-55): one batched
+    planner+allocator solve, scope-locked so ticks never overlap."""
+    flags = ServiceFlags.get(store)
+    if flags.scheduler_disabled and flags.host_allocator_disabled:
+        return []
+
+    def run(s: Store) -> None:
+        opts = TickOptions(
+            create_intent_hosts=not flags.host_allocator_disabled,
+        )
+        run_tick(s, opts, now=_time.time())
+
+    return [
+        FnJob(
+            f"scheduler-tick-{now:.3f}",
+            run,
+            scopes=["scheduler-tick"],
+            job_type="scheduler-tick",
+        )
+    ]
+
+
+def generate_tasks_jobs(store: Store, now: float) -> List[Job]:
+    flags = ServiceFlags.get(store)
+    if flags.generate_tasks_disabled:
+        return []
+    pending = store.collection("generate_requests").count(
+        lambda d: not d.get("processed")
+    )
+    if not pending:
+        return []
+    return [
+        FnJob(
+            f"generate-tasks-{now:.3f}",
+            lambda s: process_generate_requests(s),
+            scopes=["generate-tasks"],
+            job_type="generate-tasks",
+        )
+    ]
+
+
+def host_creation_jobs(store: Store, now: float) -> List[Job]:
+    """Spawn cloud instances for intent hosts, throttled
+    (units/provisioning_create_host.go + config_hostinit.go throttle)."""
+    flags = ServiceFlags.get(store)
+    if flags.host_init_disabled:
+        return []
+    throttle = HostInitConfig.get(store).host_throttle
+
+    return [
+        FnJob(
+            f"host-create-{now:.3f}",
+            lambda s: create_hosts_from_intents(s, limit=throttle),
+            scopes=["host-create"],
+            job_type="host-create",
+        ),
+        FnJob(
+            f"host-provision-{now:.3f}",
+            lambda s: provision_ready_hosts(s),
+            scopes=["host-provision"],
+            job_type="host-provision",
+        ),
+    ]
+
+
+def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
+    flags = ServiceFlags.get(store)
+    if flags.monitor_disabled:
+        return []
+    return [
+        FnJob(
+            f"host-monitor-{now:.3f}",
+            lambda s: host_jobs.monitor_host_cloud_state(s),
+            scopes=["host-monitor"],
+            job_type="host-monitor",
+        ),
+        FnJob(
+            f"idle-termination-{now:.3f}",
+            lambda s: host_jobs.terminate_idle_hosts(s),
+            scopes=["idle-termination"],
+            job_type="idle-termination",
+        ),
+        FnJob(
+            f"host-drawdown-{now:.3f}",
+            lambda s: host_jobs.host_drawdown(s),
+            scopes=["host-drawdown"],
+            job_type="host-drawdown",
+        ),
+    ]
+
+
+def task_monitoring_jobs(store: Store, now: float) -> List[Job]:
+    flags = ServiceFlags.get(store)
+    if flags.monitor_disabled:
+        return []
+    return [
+        FnJob(
+            f"task-exec-timeout-{now:.3f}",
+            lambda s: task_jobs.monitor_stale_heartbeats(s),
+            scopes=["task-exec-timeout"],
+            job_type="task-exec-timeout",
+        )
+    ]
+
+
+def event_notifier_jobs(store: Store, now: float) -> List[Job]:
+    flags = ServiceFlags.get(store)
+    if flags.event_processing_disabled:
+        return []
+    return [
+        FnJob(
+            f"event-notifier-{now:.3f}",
+            lambda s: process_unprocessed_events(s),
+            scopes=["event-notifier"],
+            job_type="event-notifier",
+        )
+    ]
+
+
+def stats_jobs(store: Store, now: float) -> List[Job]:
+    flags = ServiceFlags.get(store)
+    if flags.background_stats_disabled:
+        return []
+    return [
+        FnJob(
+            f"host-stats-{now:.3f}",
+            lambda s: host_jobs.sample_host_stats(s),
+            scopes=["host-stats"],
+            job_type="host-stats",
+        )
+    ]
+
+
+def hourly_jobs(store: Store, now: float) -> List[Job]:
+    flags = ServiceFlags.get(store)
+    jobs: List[Job] = []
+    if not flags.cache_stats_job_disabled:
+        jobs.append(
+            FnJob(
+                f"cache-task-stats-{now:.3f}",
+                lambda s: taskstats.cache_historical_task_data(s),
+                scopes=["cache-task-stats"],
+                job_type="cache-task-stats",
+            )
+        )
+    jobs.append(
+        FnJob(
+            f"distro-auto-tune-{now:.3f}",
+            lambda s: host_jobs.auto_tune_distro_max_hosts(s),
+            scopes=["distro-auto-tune"],
+            job_type="distro-auto-tune",
+        )
+    )
+    return jobs
+
+
+def build_cron_runner(store: Store, queue: JobQueue) -> CronRunner:
+    """Wire the full background plane (the reference's populator registry,
+    operations/service.go:70-128)."""
+    from ..queue.jobs import IntervalOperation
+
+    runner = CronRunner(store, queue)
+    runner.register(IntervalOperation("scheduler-tick", 15.0, scheduler_tick_jobs))
+    runner.register(IntervalOperation("generate-tasks", 15.0, generate_tasks_jobs))
+    runner.register(IntervalOperation("host-creation", 15.0, host_creation_jobs))
+    runner.register(IntervalOperation("host-monitoring", 60.0, host_monitoring_jobs))
+    runner.register(
+        IntervalOperation("task-monitoring", 5 * 60.0, task_monitoring_jobs)
+    )
+    runner.register(IntervalOperation("event-notifier", 60.0, event_notifier_jobs))
+    runner.register(IntervalOperation("stats", 60.0, stats_jobs))
+    runner.register(IntervalOperation("hourly", 3600.0, hourly_jobs))
+    return runner

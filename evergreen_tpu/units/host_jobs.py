@@ -1,0 +1,245 @@
+"""Host lifecycle background jobs.
+
+Reference equivalents: units/host_monitoring_check.go:31 (cloud-truth
+reconciliation), units/host_monitoring_idle_termination.go (idle reaping),
+units/host_termination.go, units/host_drawdown.go (overallocation
+feedback), units/task_stranded_cleanup.go (tasks on dead hosts),
+units/distro_auto_tune.go (max-hosts auto-tuning from usage history),
+units/stats_host.go (hoststat sampling).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from ..cloud.manager import CloudHostStatus, get_manager
+from ..globals import (
+    HostStatus,
+    OverallocatedRule,
+    TaskStatus,
+)
+from ..models import distro as distro_mod
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models import task_queue as tq_mod
+from ..models.lifecycle import mark_end
+from ..storage.store import Store
+
+HOSTSTATS_COLLECTION = "host_stats"
+
+#: default idle threshold before termination (reference
+#: units/host_monitoring_idle_termination.go idleTimeCutoff ~ minutes)
+DEFAULT_IDLE_CUTOFF_S = 4 * 60.0
+
+
+def monitor_host_cloud_state(store: Store, now: Optional[float] = None) -> List[str]:
+    """Reconcile host docs against provider truth: externally-terminated
+    instances are marked terminated and their running tasks system-failed
+    (reference units/host_monitoring_check.go:31 +
+    units/task_stranded_cleanup.go)."""
+    now = _time.time() if now is None else now
+    changed: List[str] = []
+    for h in host_mod.find(
+        store,
+        lambda d: d["status"]
+        in (
+            HostStatus.RUNNING.value,
+            HostStatus.PROVISIONING.value,
+            HostStatus.STARTING.value,
+        ),
+    ):
+        try:
+            mgr = get_manager(h.provider)
+        except KeyError:
+            continue
+        cloud_status = mgr.get_instance_status(store, h)
+        if cloud_status in (
+            CloudHostStatus.TERMINATED,
+            CloudHostStatus.NONEXISTENT,
+            CloudHostStatus.STOPPED,
+        ):
+            host_mod.coll(store).update(
+                h.id,
+                {
+                    "status": HostStatus.TERMINATED.value,
+                    "termination_time": now,
+                },
+            )
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_HOST,
+                "HOST_EXTERNALLY_TERMINATED",
+                h.id,
+                {"cloud_status": cloud_status},
+                timestamp=now,
+            )
+            changed.append(h.id)
+            if h.running_task:
+                fix_stranded_task(store, h.running_task, h.id, now)
+    return changed
+
+
+def fix_stranded_task(
+    store: Store, task_id: str, host_id: str, now: float
+) -> None:
+    """System-fail a task whose host died (reference
+    units/task_stranded_cleanup.go + model.ResetTaskOrMarkSystemFailed)."""
+    t = task_mod.get(store, task_id)
+    if t is None or t.is_finished():
+        return
+    mark_end(
+        store,
+        task_id,
+        TaskStatus.FAILED.value,
+        now=now,
+        details_type="system",
+        details_desc=f"host {host_id} was terminated while task was running",
+    )
+
+
+def terminate_idle_hosts(store: Store, now: Optional[float] = None) -> List[str]:
+    """Reap ephemeral hosts idle beyond the distro's acceptable idle time,
+    never dipping below minimum hosts (reference
+    units/host_monitoring_idle_termination.go)."""
+    now = _time.time() if now is None else now
+    reaped: List[str] = []
+    for d in distro_mod.find_all(store):
+        if not d.is_ephemeral():
+            continue
+        cutoff = d.host_allocator_settings.acceptable_host_idle_time_s or (
+            DEFAULT_IDLE_CUTOFF_S
+        )
+        hosts = host_mod.all_active_hosts(store, d.id)
+        running = [h for h in hosts if h.status == HostStatus.RUNNING.value]
+        min_hosts = d.host_allocator_settings.minimum_hosts
+        can_kill = len(hosts) - min_hosts
+        if can_kill <= 0:
+            continue
+        idle = [
+            h
+            for h in running
+            if h.is_free()
+            and now - max(h.last_communication_time, h.provision_time, h.start_time)
+            > cutoff
+        ]
+        idle.sort(key=lambda h: h.creation_time)
+        for h in idle[:can_kill]:
+            _terminate(store, h, "idle", now)
+            reaped.append(h.id)
+    return reaped
+
+
+def _terminate(store: Store, h, reason: str, now: float) -> None:
+    try:
+        mgr = get_manager(h.provider)
+        mgr.terminate_instance(store, h, reason)
+    except KeyError:
+        host_mod.coll(store).update(
+            h.id,
+            {"status": HostStatus.TERMINATED.value, "termination_time": now},
+        )
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_HOST,
+        "HOST_TERMINATED",
+        h.id,
+        {"reason": reason},
+        timestamp=now,
+    )
+
+
+def host_drawdown(store: Store, now: Optional[float] = None) -> List[str]:
+    """Overallocation feedback: when the latest queue needs far fewer hosts
+    than exist, terminate free surplus (reference units/host_drawdown.go,
+    populated from allocator feedback units/host_allocator.go:327-334)."""
+    now = _time.time() if now is None else now
+    reaped: List[str] = []
+    for d in distro_mod.find_all(store):
+        if not d.is_ephemeral():
+            continue
+        if (
+            d.host_allocator_settings.hosts_overallocated_rule
+            != OverallocatedRule.TERMINATE.value
+        ):
+            continue
+        queue = tq_mod.load(store, d.id)
+        demand = queue.info.length_with_dependencies_met if queue else 0
+        hosts = host_mod.all_active_hosts(store, d.id)
+        min_hosts = d.host_allocator_settings.minimum_hosts
+        surplus = len(hosts) - max(demand, min_hosts)
+        if surplus <= 0:
+            continue
+        free = [
+            h
+            for h in hosts
+            if h.status == HostStatus.RUNNING.value and h.is_free()
+        ]
+        free.sort(key=lambda h: h.creation_time)
+        for h in free[:surplus]:
+            _terminate(store, h, "overallocated", now)
+            reaped.append(h.id)
+    return reaped
+
+
+def sample_host_stats(store: Store, now: Optional[float] = None) -> None:
+    """Persist per-distro host usage samples feeding auto-tune (reference
+    hoststat writes at units/host_allocator.go:459-472)."""
+    now = _time.time() if now is None else now
+    coll = store.collection(HOSTSTATS_COLLECTION)
+    for d in distro_mod.find_all(store):
+        hosts = host_mod.all_active_hosts(store, d.id)
+        busy = sum(1 for h in hosts if not h.is_free())
+        coll.upsert(
+            {
+                "_id": f"{d.id}:{int(now)}",
+                "distro_id": d.id,
+                "at": now,
+                "num_hosts": len(hosts),
+                "num_busy": busy,
+            }
+        )
+
+
+def auto_tune_distro_max_hosts(
+    store: Store,
+    now: Optional[float] = None,
+    window_s: float = 24 * 3600.0,
+    headroom: float = 1.25,
+) -> List[str]:
+    """Tune MaximumHosts per opted-in distro from historical peak usage
+    (reference units/distro_auto_tune.go:54-214)."""
+    now = _time.time() if now is None else now
+    cutoff = now - window_s
+    tuned: List[str] = []
+    stats = store.collection(HOSTSTATS_COLLECTION).find(
+        lambda d: d["at"] >= cutoff
+    )
+    peak_by_distro = {}
+    for s in stats:
+        peak_by_distro[s["distro_id"]] = max(
+            peak_by_distro.get(s["distro_id"], 0), s["num_busy"]
+        )
+    for d in distro_mod.find_all(store):
+        if not d.host_allocator_settings.auto_tune_maximum_hosts:
+            continue
+        peak = peak_by_distro.get(d.id)
+        if peak is None:
+            continue
+        new_max = max(
+            d.host_allocator_settings.minimum_hosts + 1,
+            int(peak * headroom) + 1,
+        )
+        if new_max != d.host_allocator_settings.maximum_hosts:
+            d.host_allocator_settings.maximum_hosts = new_max
+            distro_mod.upsert(store, d)
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_DISTRO,
+                "DISTRO_MAX_HOSTS_AUTOTUNED",
+                d.id,
+                {"new_max": new_max, "peak_busy": peak},
+                timestamp=now,
+            )
+            tuned.append(d.id)
+    return tuned

@@ -1,0 +1,159 @@
+"""Task monitoring + restart background jobs.
+
+Reference equivalents: units/task_monitor_execution_timeout.go:73-143
+(stale-heartbeat reaping, populated every 5 min), model/task_lifecycle.go
+reset functions + units/tasks_restart.go (restarts with execution
+archive), abort handling.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from ..globals import TaskStatus
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models.lifecycle import mark_end
+from ..storage.store import Store
+
+#: a dispatched/started task with no heartbeat for this long is presumed
+#: dead (reference agent heartbeat cadence + taskExecutionTimeout)
+DEFAULT_HEARTBEAT_TIMEOUT_S = 7 * 60.0
+
+ARCHIVE_COLLECTION = "task_archives"
+
+
+def monitor_stale_heartbeats(
+    store: Store,
+    now: Optional[float] = None,
+    timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
+) -> List[str]:
+    """System-fail in-flight tasks whose heartbeat went stale (reference
+    units/task_monitor_execution_timeout.go:73,143)."""
+    now = _time.time() if now is None else now
+    reaped: List[str] = []
+    for doc in task_mod.coll(store).find(
+        lambda d: d["status"]
+        in (TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value)
+        and now - max(d.get("last_heartbeat", 0.0), d.get("dispatch_time", 0.0))
+        > timeout_s
+    ):
+        mark_end(
+            store,
+            doc["_id"],
+            TaskStatus.FAILED.value,
+            now=now,
+            details_type="system",
+            details_desc="heartbeat timeout: task presumed dead",
+        )
+        reaped.append(doc["_id"])
+        # free the host if it still claims this task
+        if doc.get("host_id"):
+            host_mod.clear_running_task(store, doc["host_id"], doc["_id"], now)
+    return reaped
+
+
+def abort_task(store: Store, task_id: str, by: str = "",
+               now: Optional[float] = None) -> bool:
+    """Flag a task for abort; the agent observes it at the next heartbeat
+    (reference task.SetAborted + agent abort handling)."""
+    now = _time.time() if now is None else now
+    ok = task_mod.coll(store).update(task_id, {"aborted": True})
+    if ok:
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_TASK,
+            "TASK_ABORT_REQUESTED",
+            task_id,
+            {"by": by},
+            timestamp=now,
+        )
+    return ok
+
+
+def restart_task(
+    store: Store, task_id: str, by: str = "", now: Optional[float] = None
+) -> bool:
+    """Archive the finished execution and reset the task to run again
+    (reference model/task_lifecycle.go reset functions; Task.Execution
+    archive semantics)."""
+    now = _time.time() if now is None else now
+    c = task_mod.coll(store)
+    doc = c.get(task_id)
+    if doc is None:
+        return False
+    if doc["status"] not in (
+        TaskStatus.SUCCEEDED.value,
+        TaskStatus.FAILED.value,
+    ):
+        return False
+
+    # archive current execution
+    store.collection(ARCHIVE_COLLECTION).upsert(
+        {
+            "_id": f"{task_id}:{doc['execution']}",
+            "task_id": task_id,
+            "execution": doc["execution"],
+            "status": doc["status"],
+            "details_type": doc.get("details_type", ""),
+            "start_time": doc.get("start_time", 0.0),
+            "finish_time": doc.get("finish_time", 0.0),
+            "host_id": doc.get("host_id", ""),
+        }
+    )
+
+    # reset dependency edges that pointed at this task on dependents
+    def reset_dep_edges(dep_doc: dict) -> None:
+        changed = False
+        for dep in dep_doc.get("depends_on", []):
+            if dep["task_id"] == task_id:
+                dep["finished"] = False
+                dep["unattainable"] = False
+                changed = True
+        if changed:
+            c.update(dep_doc["_id"], {"depends_on": dep_doc["depends_on"]})
+
+    for dep_doc in c.find(
+        lambda d: any(x["task_id"] == task_id for x in d.get("depends_on", []))
+    ):
+        reset_dep_edges(dep_doc)
+
+    c.update(
+        task_id,
+        {
+            "status": TaskStatus.UNDISPATCHED.value,
+            "execution": doc["execution"] + 1,
+            "activated": True,
+            "activated_by": by,
+            "activated_time": now,
+            "dispatch_time": 0.0,
+            "start_time": 0.0,
+            "finish_time": 0.0,
+            "scheduled_time": 0.0,
+            "dependencies_met_time": 0.0,
+            "host_id": "",
+            "aborted": False,
+            "details_type": "",
+            "details_desc": "",
+            "details_timed_out": False,
+            "last_heartbeat": 0.0,
+        },
+    )
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_TASK,
+        "TASK_RESTARTED",
+        task_id,
+        {"by": by, "execution": doc["execution"] + 1},
+        timestamp=now,
+    )
+    return True
+
+
+def get_task_execution_archive(store: Store, task_id: str) -> List[dict]:
+    out = store.collection(ARCHIVE_COLLECTION).find(
+        lambda d: d["task_id"] == task_id
+    )
+    out.sort(key=lambda d: d["execution"])
+    return out

@@ -1,0 +1,243 @@
+"""Background job plane, host/task monitoring jobs, trigger engine
+(reference analog: units/* tests, trigger tests)."""
+import threading
+import time
+
+from evergreen_tpu.events.triggers import (
+    Subscription,
+    add_subscription,
+    process_unprocessed_events,
+    register_sender,
+)
+from evergreen_tpu.globals import HostStatus, Provider, TaskStatus
+from evergreen_tpu.cloud.mock import MockCloudManager
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import event as event_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import taskstats
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.queue.jobs import FnJob, JobQueue
+from evergreen_tpu.units import host_jobs, task_jobs
+
+NOW = 1_700_000_000.0
+
+
+def test_job_queue_scope_locks_and_dedupe(store):
+    q = JobQueue(store, workers=4)
+    order = []
+    lock = threading.Lock()
+    started = threading.Event()
+
+    def slow(s):
+        started.set()
+        time.sleep(0.15)
+        with lock:
+            order.append("slow")
+
+    def fast(s):
+        with lock:
+            order.append("fast")
+
+    assert q.put(FnJob("slow", slow, scopes=["x"]))
+    started.wait(2)
+    # same scope → must wait for slow; same id → dedupe
+    assert q.put(FnJob("fast-sc", fast, scopes=["x"]))
+    assert not q.put(FnJob("slow", slow))
+    assert q.wait_idle(5)
+    assert order == ["slow", "fast"]
+    jobs = store.collection("jobs").find()
+    assert {j["status"] for j in jobs} == {"completed"}
+    q.close()
+
+
+def test_job_failure_recorded_not_fatal(store):
+    q = JobQueue(store, workers=1)
+
+    def boom(s):
+        raise RuntimeError("kaboom")
+
+    q.put(FnJob("boom", boom))
+    q.put(FnJob("ok", lambda s: None))
+    assert q.wait_idle(5)
+    doc = store.collection("jobs").get("boom")
+    assert doc["status"] == "failed"
+    assert "kaboom" in doc["error"]
+    assert store.collection("jobs").get("ok")["status"] == "completed"
+    q.close()
+
+
+def _running_host(store, hid, distro="d1", **kw):
+    h = Host(
+        id=hid, distro_id=distro, status=HostStatus.RUNNING.value,
+        provider=Provider.MOCK.value, creation_time=NOW - 3600, **kw
+    )
+    host_mod.insert(store, h)
+    return h
+
+
+def test_cloud_reconciliation_strands_task(store):
+    MockCloudManager.reset()
+    distro_mod.insert(store, Distro(id="d1", provider=Provider.MOCK.value))
+    h = _running_host(store, "h1", external_id="mock-h1",
+                      running_task="t1", last_communication_time=NOW)
+    MockCloudManager.instances["mock-h1"] = "terminated"
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", start_time=NOW - 60),
+    )
+    changed = host_jobs.monitor_host_cloud_state(store, NOW)
+    assert changed == ["h1"]
+    assert host_mod.get(store, "h1").status == HostStatus.TERMINATED.value
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.FAILED.value
+    assert t.details_type == "system"
+
+
+def test_idle_termination_respects_minimum(store):
+    MockCloudManager.reset()
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1", provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(
+                minimum_hosts=1, maximum_hosts=5,
+                acceptable_host_idle_time_s=60.0,
+            ),
+        ),
+    )
+    for i in range(3):
+        _running_host(
+            store, f"h{i}", external_id=f"mock-h{i}",
+            last_communication_time=NOW - 600,
+        )
+        MockCloudManager.instances[f"mock-h{i}"] = "running"
+    reaped = host_jobs.terminate_idle_hosts(store, NOW)
+    # 3 hosts, min 1 → at most 2 reaped
+    assert len(reaped) == 2
+    left = host_mod.all_active_hosts(store, "d1")
+    assert len(left) == 1
+
+
+def test_heartbeat_monitor_reaps_dead_tasks(store):
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", start_time=NOW - 3600,
+             last_heartbeat=NOW - 3600),
+    )
+    _running_host(store, "h1", running_task="t1")
+    reaped = task_jobs.monitor_stale_heartbeats(store, NOW)
+    assert reaped == ["t1"]
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.FAILED.value
+    assert t.details_type == "system"
+    assert host_mod.get(store, "h1").is_free()
+
+
+def test_restart_task_archives_and_resets(store):
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.FAILED.value,
+             activated=True, execution=0, start_time=NOW - 100,
+             finish_time=NOW - 50, details_type="test"),
+    )
+    task_mod.insert(
+        store,
+        Task(id="child", distro_id="d1", status=TaskStatus.UNDISPATCHED.value,
+             activated=True),
+    )
+    # child's dep edge was marked unattainable by t1's failure
+    from evergreen_tpu.models.task import Dependency
+    task_mod.coll(store).update(
+        "child",
+        {"depends_on": [{"task_id": "t1", "status": "success",
+                         "unattainable": True, "finished": True}]},
+    )
+    assert task_jobs.restart_task(store, "t1", by="user1", now=NOW)
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.UNDISPATCHED.value
+    assert t.execution == 1
+    assert t.activated
+    archive = task_jobs.get_task_execution_archive(store, "t1")
+    assert len(archive) == 1 and archive[0]["status"] == TaskStatus.FAILED.value
+    # dependent's edge reset so it can wait for the rerun
+    child = task_mod.get(store, "child")
+    assert not child.blocked()
+    assert not child.depends_on[0].finished
+
+
+def test_taskstats_rollup_and_stamping(store):
+    for i in range(4):
+        task_mod.insert(
+            store,
+            Task(id=f"done{i}", project="p", build_variant="bv",
+                 display_name="compile", status=TaskStatus.SUCCEEDED.value,
+                 activated=True, start_time=NOW - 1000,
+                 finish_time=NOW - 1000 + 120 + i * 20),
+        )
+    n = taskstats.cache_historical_task_data(store, now=NOW)
+    assert n == 1
+    roll = taskstats.get_rollup(store, "p", "bv", "compile")
+    assert 120 <= roll.average_s <= 200
+    assert roll.count == 4
+
+    fresh = Task(id="new1", project="p", build_variant="bv",
+                 display_name="compile", activated=True)
+    task_mod.insert(store, fresh)
+    taskstats.stamp_expected_durations(store, [fresh])
+    assert task_mod.get(store, "new1").expected_duration_s == roll.average_s
+
+
+def test_trigger_pipeline_delivers_notifications(store):
+    sent = []
+    register_sender("email", lambda n: sent.append(n))
+    add_subscription(
+        store,
+        Subscription(
+            id="sub1", resource_type=event_mod.RESOURCE_TASK,
+            trigger="failure", subscriber_type="email",
+            subscriber_target="dev@example.com",
+            filters={"project": "p"},
+        ),
+    )
+    task_mod.insert(
+        store,
+        Task(id="t1", project="p", status=TaskStatus.STARTED.value,
+             activated=True, start_time=NOW - 5),
+    )
+    from evergreen_tpu.models.lifecycle import mark_end
+    mark_end(store, "t1", TaskStatus.FAILED.value, now=NOW)
+    n = process_unprocessed_events(store, now=NOW)
+    assert n >= 1
+    assert len(sent) == 1
+    assert "t1" in sent[0].subject
+    # events marked processed; re-run delivers nothing new
+    assert process_unprocessed_events(store, now=NOW) == 0
+    ntf_docs = store.collection("notifications").find()
+    assert any(d["sent_at"] > 0 for d in ntf_docs)
+
+
+def test_auto_tune_from_host_stats(store):
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1", provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(
+                maximum_hosts=100, auto_tune_maximum_hosts=True,
+            ),
+        ),
+    )
+    for i, busy in enumerate([3, 7, 5]):
+        store.collection("host_stats").upsert(
+            {"_id": f"d1:{i}", "distro_id": "d1", "at": NOW - 100 + i,
+             "num_hosts": 10, "num_busy": busy}
+        )
+    tuned = host_jobs.auto_tune_distro_max_hosts(store, now=NOW)
+    assert tuned == ["d1"]
+    d = distro_mod.get(store, "d1")
+    # peak 7 × 1.25 headroom + 1 = 9
+    assert d.host_allocator_settings.maximum_hosts == 9
