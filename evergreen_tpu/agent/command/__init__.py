@@ -1,0 +1,4 @@
+"""Agent command registry. Importing the package registers the built-in
+commands (reference agent/command/registry.go init())."""
+from . import basic  # noqa: F401 — registers shell.exec et al.
+from .base import get_command, known_commands, register_command  # noqa: F401

@@ -1,0 +1,116 @@
+"""REST transport for the agent — the production communicator.
+
+Speaks the agent protocol over HTTP against the REST API (api/rest.py), the
+way the reference agent only ever talks to the app server through its
+retrying REST client (agent/internal/client/). Retries with backoff on
+transport errors.
+"""
+from __future__ import annotations
+
+import json
+import time as _time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..models.task import Task
+from .comm import Communicator, TaskConfig
+
+
+class RestCommunicator(Communicator):
+    def __init__(
+        self, base_url: str, retries: int = 3, backoff_s: float = 0.2
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.retries = retries
+        self.backoff_s = backoff_s
+
+    # -- transport ----------------------------------------------------------- #
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        url = f"{self.base_url}{path}"
+        data = json.dumps(body or {}).encode() if method != "GET" else None
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                # 4xx/5xx with a JSON body is a protocol answer, not a
+                # transport failure
+                try:
+                    payload = json.loads(e.read() or b"{}")
+                except json.JSONDecodeError:
+                    payload = {"error": str(e)}
+                payload["_status"] = e.code
+                return payload
+            except (urllib.error.URLError, TimeoutError) as e:
+                last_err = e
+                _time.sleep(self.backoff_s * (2 ** attempt))
+        raise ConnectionError(f"agent->server call failed: {last_err}")
+
+    # -- protocol ------------------------------------------------------------ #
+
+    def next_task(self, host_id: str) -> Optional[Task]:
+        resp = self._call("GET", f"/rest/v2/hosts/{host_id}/agent/next_task")
+        tid = resp.get("task_id")
+        if not tid:
+            return None
+        cfg = self._call("GET", f"/rest/v2/tasks/{tid}/agent/config")
+        self._project_doc = cfg.get("project", {})
+        return Task.from_doc(cfg["task"])
+
+    def get_task_config(self, task: Task) -> TaskConfig:
+        doc = getattr(self, "_project_doc", None)
+        if doc is None or doc.get("_id") != task.version:
+            cfg = self._call("GET", f"/rest/v2/tasks/{task.id}/agent/config")
+            doc = cfg.get("project", {})
+        # reuse the LocalCommunicator resolution logic on the fetched doc
+        from .comm import LocalCommunicator
+
+        resolver = LocalCommunicator.__new__(LocalCommunicator)
+
+        class _OneDocStore:
+            def __init__(self, inner):
+                self._doc = inner
+
+            def collection(self, name):
+                return self
+
+            def get(self, _id):
+                return self._doc if self._doc.get("_id") == _id else None
+
+        resolver.store = _OneDocStore(doc)
+        resolver.svc = None
+        return LocalCommunicator.get_task_config(resolver, task)
+
+    def start_task(self, task_id: str) -> None:
+        self._call("POST", f"/rest/v2/tasks/{task_id}/agent/start")
+
+    def heartbeat(self, task_id: str) -> bool:
+        resp = self._call("POST", f"/rest/v2/tasks/{task_id}/agent/heartbeat")
+        return bool(resp.get("abort"))
+
+    def end_task(
+        self, task_id: str, status: str, details_type: str = "",
+        details_desc: str = "", timed_out: bool = False,
+        artifacts: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        body = {
+            "status": status,
+            "details_type": details_type,
+            "details_desc": details_desc,
+            "timed_out": timed_out,
+        }
+        if artifacts and artifacts.get("generate_tasks"):
+            body["generate_tasks"] = artifacts["generate_tasks"]
+        self._call("POST", f"/rest/v2/tasks/{task_id}/agent/end", body)
+
+    def send_log(self, task_id: str, lines: List[str]) -> None:
+        self._call(
+            "POST", f"/rest/v2/tasks/{task_id}/agent/logs", {"lines": lines}
+        )

@@ -1,0 +1,426 @@
+"""REST v2 API surface.
+
+A stdlib-only WSGI application covering the reference's REST v2 routes that
+matter operationally (reference rest/route/): the agent protocol
+(host_agent.go:38 next_task, agent.go heartbeat/end_task), task actions
+(abort/restart/priority), hosts, distros, versions/builds, patches, project
+refs, admin settings + service flags, and the event/notification surfaces.
+
+Route handlers follow the reference's Parse/Run split loosely: each handler
+is a function (method, match, body) → (status, payload).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..dispatch.assign import assign_next_available_task
+from ..dispatch.dag_dispatcher import DispatcherService
+from ..globals import TaskStatus
+from ..ingestion import patches as patch_mod
+from ..ingestion import repotracker as repotracker_mod
+from ..ingestion.validator import validate_project
+from ..models import build as build_mod
+from ..models import distro as distro_mod
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models import version as version_mod
+from ..models.lifecycle import mark_end, mark_task_started
+from ..settings import ServiceFlags, all_sections, get_section
+from ..storage.store import Store
+from ..units import task_jobs
+
+JSON = "application/json"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+Handler = Callable[[str, re.Match, dict], Tuple[int, Any]]
+
+
+class RestApi:
+    def __init__(self, store: Store, dispatcher_service: Optional[DispatcherService] = None) -> None:
+        self.store = store
+        self.svc = dispatcher_service or DispatcherService(store)
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._register_routes()
+
+    # ------------------------------------------------------------------ #
+    # plumbing
+    # ------------------------------------------------------------------ #
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        self._routes.append((method, re.compile(f"^{pattern}$"), handler))
+
+    def handle(
+        self, method: str, path: str, body: Optional[dict] = None
+    ) -> Tuple[int, Any]:
+        body = body or {}
+        for m, pattern, handler in self._routes:
+            if m != method:
+                continue
+            match = pattern.match(path)
+            if match:
+                try:
+                    return handler(method, match, body)
+                except ApiError as e:
+                    return e.status, {"error": e.message}
+                except KeyError as e:
+                    return 404, {"error": f"not found: {e}"}
+        return 404, {"error": f"no route for {method} {path}"}
+
+    def wsgi_app(self, environ, start_response):
+        method = environ["REQUEST_METHOD"]
+        path = environ.get("PATH_INFO", "/")
+        body = {}
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        if length:
+            try:
+                body = json.loads(environ["wsgi.input"].read(length) or b"{}")
+            except json.JSONDecodeError:
+                start_response("400 Bad Request", [("Content-Type", JSON)])
+                return [json.dumps({"error": "invalid JSON body"}).encode()]
+        status, payload = self.handle(method, path, body)
+        reason = {200: "OK", 201: "Created", 400: "Bad Request",
+                  404: "Not Found", 409: "Conflict", 503: "Service Unavailable"}
+        start_response(
+            f"{status} {reason.get(status, 'OK')}", [("Content-Type", JSON)]
+        )
+        return [json.dumps(payload, default=str).encode()]
+
+    def serve(self, host: str = "127.0.0.1", port: int = 9090):
+        """Run a blocking HTTP server (CLI `service web`)."""
+        from wsgiref.simple_server import WSGIServer, make_server
+        from socketserver import ThreadingMixIn
+
+        class ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+            daemon_threads = True
+
+        server = make_server(
+            host, port, self.wsgi_app, server_class=ThreadingWSGIServer
+        )
+        return server
+
+    # ------------------------------------------------------------------ #
+    # routes
+    # ------------------------------------------------------------------ #
+
+    def _register_routes(self) -> None:
+        r = self.route
+        # agent protocol (reference rest/route/host_agent.go, agent.go)
+        r("GET", r"/rest/v2/hosts/(?P<host>[^/]+)/agent/next_task", self.next_task)
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/config", self.task_config)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/start", self.start_task)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/heartbeat", self.heartbeat)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/end", self.end_task)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/logs", self.append_logs)
+
+        # tasks
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)", self.get_task)
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/logs", self.get_logs)
+        r("PATCH", r"/rest/v2/tasks/(?P<task>[^/]+)", self.patch_task)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/abort", self.abort_task)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/restart", self.restart_task)
+
+        # hosts / distros
+        r("GET", r"/rest/v2/hosts", self.list_hosts)
+        r("GET", r"/rest/v2/hosts/(?P<host>[^/]+)", self.get_host)
+        r("GET", r"/rest/v2/distros", self.list_distros)
+        r("GET", r"/rest/v2/distros/(?P<distro>[^/]+)/queue", self.get_queue)
+
+        # versions / builds / projects
+        r("GET", r"/rest/v2/versions/(?P<version>[^/]+)", self.get_version)
+        r("GET", r"/rest/v2/versions/(?P<version>[^/]+)/tasks", self.version_tasks)
+        r("GET", r"/rest/v2/builds/(?P<build>[^/]+)", self.get_build)
+        r("GET", r"/rest/v2/projects", self.list_projects)
+        r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/revisions", self.push_revision)
+        r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/validate", self.validate)
+
+        # patches
+        r("POST", r"/rest/v2/patches", self.create_patch)
+        r("GET", r"/rest/v2/patches/(?P<patch>[^/]+)", self.get_patch)
+        r("POST", r"/rest/v2/patches/(?P<patch>[^/]+)/finalize", self.finalize)
+
+        # admin / events
+        r("GET", r"/rest/v2/admin/settings", self.get_admin)
+        r("POST", r"/rest/v2/admin/settings", self.set_admin)
+        r("GET", r"/rest/v2/status", self.status)
+        r("GET", r"/rest/v2/events", self.list_events)
+
+    # -- agent protocol ------------------------------------------------- #
+
+    def next_task(self, method, match, body):
+        flags = ServiceFlags.get(self.store)
+        if flags.task_dispatch_disabled:
+            return 200, {"task_id": "", "should_exit": False}
+        h = host_mod.get(self.store, match["host"])
+        if h is None:
+            raise ApiError(404, f"host {match['host']!r} not found")
+        t = assign_next_available_task(self.store, self.svc, h)
+        if t is None:
+            return 200, {"task_id": "", "should_exit": False}
+        return 200, {
+            "task_id": t.id,
+            "task_execution": t.execution,
+            "version": t.version,
+            "build_id": t.build_id,
+            "should_exit": False,
+        }
+
+    def task_config(self, method, match, body):
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        doc = self.store.collection("parser_projects").get(t.version) or {}
+        return 200, {"task": t.to_doc(), "project": doc}
+
+    def start_task(self, method, match, body):
+        ok = mark_task_started(self.store, match["task"])
+        return 200, {"ok": ok}
+
+    def heartbeat(self, method, match, body):
+        now = _time.time()
+        task_mod.coll(self.store).update(match["task"], {"last_heartbeat": now})
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        return 200, {"abort": t.aborted}
+
+    def end_task(self, method, match, body):
+        t = mark_end(
+            self.store,
+            match["task"],
+            body.get("status", TaskStatus.FAILED.value),
+            details_type=body.get("details_type", ""),
+            details_desc=body.get("details_desc", ""),
+            timed_out=body.get("timed_out", False),
+        )
+        if t is None:
+            raise ApiError(409, "task is not in a running state")
+        gen = body.get("generate_tasks")
+        if gen:
+            self.store.collection("generate_requests").upsert(
+                {"_id": t.id, "task_id": t.id, "payloads": gen,
+                 "processed": False}
+            )
+        return 200, {"status": t.status}
+
+    def append_logs(self, method, match, body):
+        coll = self.store.collection("task_logs")
+        tid = match["task"]
+        lines = [str(x) for x in body.get("lines", [])]
+        doc = coll.get(tid)
+        if doc is None:
+            coll.upsert({"_id": tid, "lines": lines})
+        else:
+            doc["lines"].extend(lines)
+        return 200, {"ok": True}
+
+    # -- tasks ----------------------------------------------------------- #
+
+    def get_task(self, method, match, body):
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        return 200, t.to_doc()
+
+    def get_logs(self, method, match, body):
+        doc = self.store.collection("task_logs").get(match["task"])
+        return 200, {"lines": doc["lines"] if doc else []}
+
+    def patch_task(self, method, match, body):
+        update = {}
+        if "priority" in body:
+            update["priority"] = int(body["priority"])
+        if "activated" in body:
+            update["activated"] = bool(body["activated"])
+            if update["activated"]:
+                update["activated_time"] = _time.time()
+                update["activated_by"] = body.get("user", "api")
+        if not update:
+            raise ApiError(400, "nothing to update")
+        if not task_mod.coll(self.store).update(match["task"], update):
+            raise ApiError(404, "task not found")
+        return 200, task_mod.get(self.store, match["task"]).to_doc()
+
+    def abort_task(self, method, match, body):
+        ok = task_jobs.abort_task(self.store, match["task"], body.get("user", "api"))
+        if not ok:
+            raise ApiError(404, "task not found")
+        return 200, {"ok": True}
+
+    def restart_task(self, method, match, body):
+        ok = task_jobs.restart_task(self.store, match["task"], body.get("user", "api"))
+        if not ok:
+            raise ApiError(409, "task is not restartable")
+        return 200, task_mod.get(self.store, match["task"]).to_doc()
+
+    # -- hosts / distros -------------------------------------------------- #
+
+    def list_hosts(self, method, match, body):
+        return 200, [h.to_doc() for h in host_mod.find(self.store)]
+
+    def get_host(self, method, match, body):
+        h = host_mod.get(self.store, match["host"])
+        if h is None:
+            raise ApiError(404, "host not found")
+        return 200, h.to_doc()
+
+    def list_distros(self, method, match, body):
+        return 200, [d.to_doc() for d in distro_mod.find_all(self.store)]
+
+    def get_queue(self, method, match, body):
+        from ..models import task_queue as tq_mod
+
+        q = tq_mod.load(self.store, match["distro"])
+        if q is None:
+            raise ApiError(404, "no queue for distro")
+        return 200, q.to_doc()
+
+    # -- versions / projects ---------------------------------------------- #
+
+    def get_version(self, method, match, body):
+        v = version_mod.get(self.store, match["version"])
+        if v is None:
+            raise ApiError(404, "version not found")
+        return 200, v.to_doc()
+
+    def version_tasks(self, method, match, body):
+        ts = task_mod.find(
+            self.store, lambda d: d["version"] == match["version"]
+        )
+        return 200, [t.to_doc() for t in ts]
+
+    def get_build(self, method, match, body):
+        b = build_mod.get(self.store, match["build"])
+        if b is None:
+            raise ApiError(404, "build not found")
+        return 200, b.to_doc()
+
+    def list_projects(self, method, match, body):
+        return 200, self.store.collection(
+            repotracker_mod.PROJECT_REFS_COLLECTION
+        ).find()
+
+    def push_revision(self, method, match, body):
+        created = repotracker_mod.store_revisions(
+            self.store,
+            match["project"],
+            [
+                repotracker_mod.Revision(
+                    revision=body.get("revision", ""),
+                    author=body.get("author", ""),
+                    message=body.get("message", ""),
+                    config_yaml=body.get("config_yaml", ""),
+                )
+            ],
+        )
+        if not created:
+            raise ApiError(400, "no version created (project disabled or bad config)")
+        return 201, {"version_id": created[0].version.id,
+                     "n_tasks": len(created[0].tasks)}
+
+    def validate(self, method, match, body):
+        issues = validate_project(
+            self.store, body.get("config_yaml", ""), match["project"]
+        )
+        return 200, {"issues": [dataclasses_to_dict(i) for i in issues]}
+
+    # -- patches ----------------------------------------------------------- #
+
+    def create_patch(self, method, match, body):
+        p = patch_mod.Patch(
+            id=body.get("id") or f"patch-{int(_time.time() * 1e6)}",
+            project=body.get("project", ""),
+            author=body.get("author", ""),
+            description=body.get("description", ""),
+            githash=body.get("githash", ""),
+            diff=body.get("diff", ""),
+            variants=body.get("variants", []),
+            tasks=body.get("tasks", []),
+            config_yaml=body.get("config_yaml", ""),
+            create_time=_time.time(),
+        )
+        patch_mod.insert_patch(self.store, p)
+        if body.get("finalize"):
+            created = patch_mod.finalize_patch(self.store, p.id)
+            if created is None:
+                raise ApiError(400, "patch could not be finalized")
+        return 201, patch_mod.get_patch(self.store, p.id).to_doc()
+
+    def get_patch(self, method, match, body):
+        p = patch_mod.get_patch(self.store, match["patch"])
+        if p is None:
+            raise ApiError(404, "patch not found")
+        return 200, p.to_doc()
+
+    def finalize(self, method, match, body):
+        created = patch_mod.finalize_patch(self.store, match["patch"])
+        if created is None:
+            raise ApiError(409, "patch cannot be finalized")
+        return 200, {"version_id": created.version.id,
+                     "n_tasks": len(created.tasks)}
+
+    # -- admin ------------------------------------------------------------- #
+
+    def get_admin(self, method, match, body):
+        out = {}
+        for sid in all_sections():
+            section = get_section(self.store, sid)
+            if section is not None:
+                import dataclasses as _dc
+
+                out[sid] = _dc.asdict(section)
+        return 200, out
+
+    def set_admin(self, method, match, body):
+        import dataclasses as _dc
+
+        updated = []
+        for sid, values in body.items():
+            cls = all_sections().get(sid)
+            if cls is None:
+                raise ApiError(400, f"unknown config section {sid!r}")
+            section = cls.get(self.store)
+            known = {f.name for f in _dc.fields(section)}
+            for k, v in values.items():
+                if k not in known:
+                    raise ApiError(400, f"unknown field {k!r} in section {sid!r}")
+                setattr(section, k, v)
+            section.set(self.store)
+            updated.append(sid)
+        return 200, {"updated": updated}
+
+    def status(self, method, match, body):
+        return 200, {
+            "tasks": task_mod.coll(self.store).count(),
+            "hosts": host_mod.coll(self.store).count(),
+            "distros": distro_mod.coll(self.store).count(),
+            "versions": version_mod.coll(self.store).count(),
+            "jobs_pending": self.store.collection("jobs").count(
+                lambda d: d["status"] in ("pending", "running")
+            ),
+        }
+
+    def list_events(self, method, match, body):
+        evs = self.store.collection("events").find()
+        evs.sort(key=lambda d: d["timestamp"])
+        return 200, evs[-200:]
+
+
+def dataclasses_to_dict(x):
+    import dataclasses as _dc
+
+    return _dc.asdict(x) if _dc.is_dataclass(x) else x

@@ -1,0 +1,199 @@
+"""Command-line entry point.
+
+Mirrors the reference's urfave/cli surface (operations/: `evergreen service
+web`, `evergreen agent`, `evergreen patch`, `evergreen validate`, admin
+commands; cmd/evergreen/evergreen.go) as `python -m evergreen_tpu <cmd>`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time as _time
+from typing import List, Optional
+
+
+def cmd_service(args) -> int:
+    """Run the app server: REST API + background job plane
+    (reference operations/service.go `service web`)."""
+    from .api.rest import RestApi
+    from .queue.jobs import JobQueue
+    from .storage.store import global_store
+    from .units.crons import build_cron_runner
+
+    store = global_store()
+    api = RestApi(store)
+    queue = JobQueue(store, workers=args.workers)
+    runner = build_cron_runner(store, queue)
+    runner.run_background()
+    server = api.serve(args.host, args.port)
+    print(f"evergreen-tpu service listening on {args.host}:{args.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        runner.stop()
+        queue.close()
+    return 0
+
+
+def cmd_agent(args) -> int:
+    """Run a worker agent against a server (reference operations/agent.go)."""
+    from .agent.agent import Agent, AgentOptions
+    from .agent.rest_comm import RestCommunicator
+
+    comm = RestCommunicator(args.api_server)
+    agent = Agent(
+        comm,
+        AgentOptions(host_id=args.host_id, work_dir=args.working_dir or ""),
+    )
+    print(f"agent for host {args.host_id} polling {args.api_server}")
+    idle_sleep = agent.options.min_poll_interval_s
+    while True:
+        tid = agent.run_once()
+        if tid:
+            print(f"completed task {tid}")
+            idle_sleep = agent.options.min_poll_interval_s
+        else:
+            if args.once:
+                return 0
+            _time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 2, agent.options.max_poll_interval_s)
+
+
+def cmd_validate(args) -> int:
+    """Validate a project file (reference operations/validate.go)."""
+    from .ingestion.validator import LEVEL_ERROR, validate_project
+
+    text = open(args.file).read()
+    issues = validate_project(None, text)
+    for issue in issues:
+        print(f"{issue.level}: {issue.message}")
+    if any(i.level == LEVEL_ERROR for i in issues):
+        return 1
+    print("valid" if not issues else "valid with warnings")
+    return 0
+
+
+def _client(args):
+    import urllib.request
+
+    def call(method: str, path: str, body: Optional[dict] = None) -> dict:
+        req = urllib.request.Request(
+            f"{args.api_server}{path}",
+            data=json.dumps(body or {}).encode() if body is not None else None,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    return call
+
+
+def cmd_patch(args) -> int:
+    """Create (and optionally finalize) a patch (reference
+    operations/patch.go)."""
+    call = _client(args)
+    body = {
+        "project": args.project,
+        "description": args.description,
+        "author": args.author,
+        "githash": args.githash,
+        "variants": args.variants.split(",") if args.variants else ["*"],
+        "tasks": args.tasks.split(",") if args.tasks else ["*"],
+        "config_yaml": open(args.config).read() if args.config else "",
+        "finalize": args.finalize,
+    }
+    out = call("POST", "/rest/v2/patches", body)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_admin(args) -> int:
+    call = _client(args)
+    if args.action == "get":
+        print(json.dumps(call("GET", "/rest/v2/admin/settings"), indent=2))
+    elif args.action == "set-flag":
+        out = call(
+            "POST",
+            "/rest/v2/admin/settings",
+            {"service_flags": {args.flag: args.value.lower() == "true"}},
+        )
+        print(json.dumps(out))
+    return 0
+
+
+def cmd_status(args) -> int:
+    call = _client(args)
+    print(json.dumps(call("GET", "/rest/v2/status"), indent=2))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import subprocess
+
+    return subprocess.call([sys.executable, "bench.py"])
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="evergreen-tpu",
+        description="TPU-native continuous-integration platform",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("service", help="run the app server")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=9090)
+    s.add_argument("--workers", type=int, default=8)
+    s.set_defaults(fn=cmd_service)
+
+    a = sub.add_parser("agent", help="run a worker agent")
+    a.add_argument("--host-id", required=True)
+    a.add_argument("--api-server", default="http://127.0.0.1:9090")
+    a.add_argument("--working-dir", default="")
+    a.add_argument("--once", action="store_true",
+                   help="exit when the queue is empty")
+    a.set_defaults(fn=cmd_agent)
+
+    v = sub.add_parser("validate", help="validate a project config file")
+    v.add_argument("file")
+    v.set_defaults(fn=cmd_validate)
+
+    pa = sub.add_parser("patch", help="create a patch build")
+    pa.add_argument("--project", required=True)
+    pa.add_argument("--description", default="")
+    pa.add_argument("--author", default="")
+    pa.add_argument("--githash", default="")
+    pa.add_argument("--variants", default="")
+    pa.add_argument("--tasks", default="")
+    pa.add_argument("--config", default="")
+    pa.add_argument("--finalize", action="store_true")
+    pa.add_argument("--api-server", default="http://127.0.0.1:9090")
+    pa.set_defaults(fn=cmd_patch)
+
+    ad = sub.add_parser("admin", help="admin settings")
+    ad.add_argument("action", choices=["get", "set-flag"])
+    ad.add_argument("--flag", default="")
+    ad.add_argument("--value", default="true")
+    ad.add_argument("--api-server", default="http://127.0.0.1:9090")
+    ad.set_defaults(fn=cmd_admin)
+
+    st = sub.add_parser("status", help="service status")
+    st.add_argument("--api-server", default="http://127.0.0.1:9090")
+    st.set_defaults(fn=cmd_status)
+
+    b = sub.add_parser("bench", help="run the scheduling benchmark")
+    b.set_defaults(fn=cmd_bench)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,195 @@
+"""Project configuration validator.
+
+Reference: validator/project_validator.go:258 CheckProject — static checks
+producing errors (block version creation) and warnings (advisory), consumed
+by the CLI `validate` command and ingestion.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..models import distro as distro_mod
+from ..storage.store import Store
+from .parser import ParserProject, ProjectParseError, parse_project
+from .project import resolve_variant_tasks
+from .selectors import select
+
+LEVEL_ERROR = "error"
+LEVEL_WARNING = "warning"
+
+
+@dataclasses.dataclass
+class ValidationIssue:
+    level: str
+    message: str
+
+
+def validate_project(
+    store: Optional[Store], yaml_text: str, project_id: str = ""
+) -> List[ValidationIssue]:
+    issues: List[ValidationIssue] = []
+    try:
+        pp = parse_project(yaml_text)
+    except ProjectParseError as e:
+        return [ValidationIssue(LEVEL_ERROR, f"parse error: {e}")]
+
+    issues.extend(check_structure(pp))
+    if store is not None:
+        issues.extend(check_run_on(store, pp))
+    return issues
+
+
+def check_structure(pp: ParserProject) -> List[ValidationIssue]:
+    issues: List[ValidationIssue] = []
+    task_names = [t.name for t in pp.tasks]
+    dupes = {n for n in task_names if task_names.count(n) > 1}
+    for n in sorted(dupes):
+        issues.append(ValidationIssue(LEVEL_ERROR, f"duplicate task name {n!r}"))
+    task_set = set(task_names)
+    group_names = [g.name for g in pp.task_groups]
+    group_set = set(group_names)
+
+    if not pp.buildvariants:
+        issues.append(
+            ValidationIssue(LEVEL_ERROR, "project has no buildvariants")
+        )
+    if not pp.tasks:
+        issues.append(ValidationIssue(LEVEL_ERROR, "project has no tasks"))
+
+    if pp.axes:
+        issues.append(
+            ValidationIssue(
+                LEVEL_ERROR, "matrix axes are not supported by this framework"
+            )
+        )
+
+    for g in pp.task_groups:
+        for member in g.tasks:
+            if member not in task_set:
+                issues.append(
+                    ValidationIssue(
+                        LEVEL_ERROR,
+                        f"task group {g.name!r} references unknown task "
+                        f"{member!r}",
+                    )
+                )
+
+    bv_names = [bv.name for bv in pp.buildvariants]
+    bv_dupes = {n for n in bv_names if bv_names.count(n) > 1}
+    for n in sorted(bv_dupes):
+        issues.append(
+            ValidationIssue(LEVEL_ERROR, f"duplicate buildvariant name {n!r}")
+        )
+
+    for bv in pp.buildvariants:
+        if not bv.tasks:
+            issues.append(
+                ValidationIssue(
+                    LEVEL_WARNING, f"buildvariant {bv.name!r} has no tasks"
+                )
+            )
+        for unit in bv.tasks:
+            if unit.name in task_set or unit.name in group_set:
+                continue
+            if not select(unit.name, pp.tasks):
+                issues.append(
+                    ValidationIssue(
+                        LEVEL_ERROR,
+                        f"buildvariant {bv.name!r} references unknown task "
+                        f"or selector {unit.name!r}",
+                    )
+                )
+
+    # dependency references + cycle check over the (task-name) graph
+    for t in pp.tasks:
+        for dep in t.depends_on:
+            if dep.name != "*" and dep.name not in task_set:
+                issues.append(
+                    ValidationIssue(
+                        LEVEL_ERROR,
+                        f"task {t.name!r} depends on unknown task {dep.name!r}",
+                    )
+                )
+    issues.extend(_check_dependency_cycles(pp))
+
+    # command sanity: known command names where resolvable
+    from ..agent.command.base import known_commands
+
+    known = set(known_commands())
+    for t in pp.tasks:
+        for c in t.commands:
+            name = c.get("command")
+            if name and name not in known and "func" not in c:
+                issues.append(
+                    ValidationIssue(
+                        LEVEL_WARNING,
+                        f"task {t.name!r} uses unknown command {name!r}",
+                    )
+                )
+            fn = c.get("func")
+            if fn and fn not in pp.functions:
+                issues.append(
+                    ValidationIssue(
+                        LEVEL_ERROR,
+                        f"task {t.name!r} calls undefined function {fn!r}",
+                    )
+                )
+    return issues
+
+
+def _check_dependency_cycles(pp: ParserProject) -> List[ValidationIssue]:
+    graph = {t.name: [d.name for d in t.depends_on if d.name != "*"]
+             for t in pp.tasks}
+    color = {}
+    cycle: List[str] = []
+
+    def visit(n: str, path: List[str]) -> bool:
+        color[n] = 1
+        for m in graph.get(n, []):
+            if color.get(m) == 1:
+                cycle.extend(path + [m])
+                return True
+            if color.get(m, 0) == 0 and visit(m, path + [m]):
+                return True
+        color[n] = 2
+        return False
+
+    for n in graph:
+        if color.get(n, 0) == 0 and visit(n, [n]):
+            return [
+                ValidationIssue(
+                    LEVEL_ERROR,
+                    f"dependency cycle: {' -> '.join(cycle)}",
+                )
+            ]
+    return []
+
+
+def check_run_on(store: Store, pp: ParserProject) -> List[ValidationIssue]:
+    """Warn when run_on names no known distro (reference validator distro
+    checks)."""
+    issues: List[ValidationIssue] = []
+    known = {d.id for d in distro_mod.find_all(store)}
+    for d in distro_mod.find_all(store):
+        known.update(d.aliases)
+    if not known:
+        return issues
+
+    def check(names, where):
+        for n in names:
+            if n not in known:
+                issues.append(
+                    ValidationIssue(
+                        LEVEL_WARNING,
+                        f"{where} runs on unknown distro {n!r}",
+                    )
+                )
+
+    for bv in pp.buildvariants:
+        check(bv.run_on, f"buildvariant {bv.name!r}")
+        for unit in bv.tasks:
+            check(unit.run_on, f"task {unit.name!r} in {bv.name!r}")
+    for t in pp.tasks:
+        check(t.run_on, f"task {t.name!r}")
+    return issues

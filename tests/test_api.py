@@ -1,0 +1,167 @@
+"""REST API + HTTP agent protocol: a real server on a socket, a real agent
+over the wire (reference analog: rest/route tests + smoke endpoint checks)."""
+import json
+import threading
+import time
+
+import pytest
+
+from evergreen_tpu.agent.agent import Agent, AgentOptions
+from evergreen_tpu.agent.rest_comm import RestCommunicator
+from evergreen_tpu.api.rest import RestApi
+from evergreen_tpu.cloud.mock import MockCloudManager
+from evergreen_tpu.cloud.provisioning import (
+    create_hosts_from_intents,
+    provision_ready_hosts,
+)
+from evergreen_tpu.globals import HostStatus, Provider, TaskStatus
+from evergreen_tpu.ingestion.repotracker import ProjectRef, upsert_project_ref
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+CONFIG = """
+tasks:
+  - name: hello
+    commands:
+      - command: shell.exec
+        params: {script: "echo over-the-wire"}
+  - name: boom
+    commands:
+      - command: shell.exec
+        params: {script: "exit 9"}
+buildvariants:
+  - name: lin
+    run_on: [ubuntu]
+    tasks: [{name: hello}, {name: boom}]
+"""
+
+
+@pytest.fixture()
+def server(store):
+    api = RestApi(store)
+    srv = api.serve("127.0.0.1", 0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{port}", api
+    srv.shutdown()
+
+
+def seed(store):
+    MockCloudManager.reset()
+    distro_mod.insert(
+        store,
+        Distro(
+            id="ubuntu",
+            provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=3),
+        ),
+    )
+    upsert_project_ref(store, ProjectRef(id="proj"))
+
+
+def test_full_http_cycle(store, server, tmp_path):
+    base, api = server
+    seed(store)
+    comm = RestCommunicator(base)
+
+    # push a revision over the API
+    resp = comm._call(
+        "POST",
+        "/rest/v2/projects/proj/revisions",
+        {"revision": "f00dfeed01", "config_yaml": CONFIG},
+    )
+    assert resp.get("n_tasks") == 2, resp
+
+    # plan + provision (in-process; the cron plane covers this elsewhere)
+    run_tick(store, TickOptions())
+    create_hosts_from_intents(store)
+    provision_ready_hosts(store)
+    hosts = host_mod.find(
+        store, lambda d: d["status"] == HostStatus.RUNNING.value
+    )
+    assert hosts
+
+    # drive the agent purely over HTTP
+    agent = Agent(
+        comm, AgentOptions(host_id=hosts[0].id, work_dir=str(tmp_path))
+    )
+    finished = agent.run_until_idle()
+    assert len(finished) == 2
+
+    statuses = {
+        t["display_name"]: t["status"]
+        for t in comm._call("GET", f"/rest/v2/versions/{resp['version_id']}/tasks")
+    }
+    assert statuses == {"hello": "success", "boom": "failed"}
+
+    # logs went over the wire
+    hello_id = next(
+        t.id for t in task_mod.find(store) if t.display_name == "hello"
+    )
+    logs = comm._call("GET", f"/rest/v2/tasks/{hello_id}/logs")
+    assert any("over-the-wire" in line for line in logs["lines"])
+
+
+def test_task_actions_and_admin(store, server):
+    base, api = server
+    seed(store)
+    comm = RestCommunicator(base)
+    task_mod.insert(
+        store,
+        task_mod.Task(
+            id="t1", distro_id="ubuntu", status=TaskStatus.FAILED.value,
+            activated=True, finish_time=time.time(),
+        ),
+    )
+    # restart over API
+    out = comm._call("POST", "/rest/v2/tasks/t1/restart", {"user": "me"})
+    assert out["status"] == TaskStatus.UNDISPATCHED.value
+    # priority PATCH
+    out = comm._call("PATCH", "/rest/v2/tasks/t1", {"priority": 42})
+    assert out["priority"] == 42
+    # abort flag
+    comm._call("POST", "/rest/v2/tasks/t1/abort", {})
+    assert task_mod.get(store, "t1").aborted
+
+    # admin settings roundtrip
+    out = comm._call(
+        "POST",
+        "/rest/v2/admin/settings",
+        {"service_flags": {"scheduler_disabled": True}},
+    )
+    assert out["updated"] == ["service_flags"]
+    settings = comm._call("GET", "/rest/v2/admin/settings")
+    assert settings["service_flags"]["scheduler_disabled"] is True
+    # unknown section rejected
+    out = comm._call("POST", "/rest/v2/admin/settings", {"bogus": {}})
+    assert out.get("_status") == 400
+
+    status = comm._call("GET", "/rest/v2/status")
+    assert status["tasks"] == 1
+
+
+def test_validate_endpoint(store, server):
+    base, _ = server
+    seed(store)
+    comm = RestCommunicator(base)
+    out = comm._call(
+        "POST",
+        "/rest/v2/projects/proj/validate",
+        {"config_yaml": "tasks:\n  - name: a\n    depends_on: [{name: nope}]\n"
+                        "buildvariants:\n  - name: bv\n    tasks: [{name: a}]\n"},
+    )
+    msgs = [i["message"] for i in out["issues"]]
+    assert any("unknown task 'nope'" in m for m in msgs)
+
+
+def test_404_and_bad_json(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    out = comm._call("GET", "/rest/v2/tasks/nope")
+    assert out.get("_status") == 404
+    out = comm._call("GET", "/rest/v2/not/a/route")
+    assert out.get("_status") == 404
