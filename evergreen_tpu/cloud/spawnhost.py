@@ -1,0 +1,124 @@
+"""Spawn hosts: user-requested workstation VMs.
+
+Reference: cloud/spawn.go + units/spawnhost_* jobs + rest/route/host_spawn.go
+— users spin up personal hosts from spawnable distros with expiration,
+start/stop, and expiration-extension; unexpirable hosts follow sleep
+schedules (config_sleep_schedule.go). Sleep schedules are modeled as simple
+daily on/off hours here.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+import uuid
+from typing import List, Optional
+
+from ..globals import HostStatus
+from ..models import distro as distro_mod
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..models.host import Host
+from ..storage.store import Store
+from .manager import get_manager
+
+#: default spawn-host lifetime (reference cloud/spawn.go DefaultExpiration)
+DEFAULT_EXPIRATION_S = 24 * 3600.0
+MAX_EXTENSIONS_S = 30 * 24 * 3600.0
+
+
+class SpawnHostError(Exception):
+    pass
+
+
+def create_spawn_host(
+    store: Store,
+    user: str,
+    distro_id: str,
+    no_expiration: bool = False,
+    now: Optional[float] = None,
+) -> Host:
+    """rest/route/host_spawn.go POST /hosts."""
+    now = _time.time() if now is None else now
+    d = distro_mod.get(store, distro_id)
+    if d is None:
+        raise SpawnHostError(f"distro {distro_id!r} not found")
+    if not d.provider_settings.get("spawn_allowed", True):
+        raise SpawnHostError(f"distro {distro_id!r} does not allow spawn hosts")
+    h = Host(
+        id=f"spawn-{user}-{uuid.uuid4().hex[:10]}",
+        distro_id=distro_id,
+        provider=d.provider,
+        status=HostStatus.UNINITIALIZED.value,
+        started_by=user,
+        user_host=True,
+        no_expiration=no_expiration,
+        expiration_time=0.0 if no_expiration else now + DEFAULT_EXPIRATION_S,
+        creation_time=now,
+    )
+    host_mod.insert(store, h)
+    event_mod.log(
+        store, event_mod.RESOURCE_HOST, "SPAWN_HOST_CREATED", h.id,
+        {"user": user}, timestamp=now,
+    )
+    return h
+
+
+def extend_expiration(
+    store: Store, host_id: str, hours: float, now: Optional[float] = None
+) -> float:
+    now = _time.time() if now is None else now
+    h = host_mod.get(store, host_id)
+    if h is None or not h.user_host:
+        raise SpawnHostError("not a spawn host")
+    new_exp = max(h.expiration_time, now) + hours * 3600.0
+    if new_exp - h.creation_time > MAX_EXTENSIONS_S:
+        raise SpawnHostError("expiration exceeds the 30-day limit")
+    host_mod.coll(store).update(host_id, {"expiration_time": new_exp})
+    return new_exp
+
+
+def stop_spawn_host(store: Store, host_id: str) -> None:
+    h = host_mod.get(store, host_id)
+    if h is None or not h.user_host:
+        raise SpawnHostError("not a spawn host")
+    get_manager(h.provider).stop_instance(store, h)
+
+
+def start_spawn_host(store: Store, host_id: str) -> None:
+    h = host_mod.get(store, host_id)
+    if h is None or not h.user_host:
+        raise SpawnHostError("not a spawn host")
+    get_manager(h.provider).start_instance(store, h)
+
+
+def terminate_spawn_host(store: Store, host_id: str, by: str = "") -> None:
+    h = host_mod.get(store, host_id)
+    if h is None or not h.user_host:
+        raise SpawnHostError("not a spawn host")
+    get_manager(h.provider).terminate_instance(store, h, f"terminated by {by}")
+
+
+def expire_spawn_hosts(store: Store, now: Optional[float] = None) -> List[str]:
+    """The spawnhost-expiration job (units/spawnhost_expiration_check.go)."""
+    now = _time.time() if now is None else now
+    expired: List[str] = []
+    for h in host_mod.find(
+        store,
+        lambda d: d["user_host"]
+        and not d["no_expiration"]
+        and 0 < d["expiration_time"] < now
+        and d["status"]
+        not in (HostStatus.TERMINATED.value, HostStatus.DECOMMISSIONED.value),
+    ):
+        try:
+            get_manager(h.provider).terminate_instance(store, h, "expired")
+        except KeyError:
+            host_mod.coll(store).update(
+                h.id, {"status": HostStatus.TERMINATED.value}
+            )
+        event_mod.log(
+            store, event_mod.RESOURCE_HOST, "SPAWN_HOST_EXPIRED", h.id,
+            timestamp=now,
+        )
+        expired.append(h.id)
+    return expired

@@ -72,19 +72,22 @@ def host_creation_jobs(store: Store, now: float) -> List[Job]:
         return []
     throttle = HostInitConfig.get(store).host_throttle
 
+    def create_and_provision(s: Store) -> None:
+        from ..cloud.docker import ensure_parent_capacity
+        from ..cloud.static import update_all_static_distros
+
+        update_all_static_distros(s)
+        ensure_parent_capacity(s)
+        create_hosts_from_intents(s, limit=throttle)
+        provision_ready_hosts(s)
+
     return [
         FnJob(
             f"host-create-{now:.3f}",
-            lambda s: create_hosts_from_intents(s, limit=throttle),
+            create_and_provision,
             scopes=["host-create"],
             job_type="host-create",
-        ),
-        FnJob(
-            f"host-provision-{now:.3f}",
-            lambda s: provision_ready_hosts(s),
-            scopes=["host-provision"],
-            job_type="host-provision",
-        ),
+        )
     ]
 
 
@@ -111,7 +114,19 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             scopes=["host-drawdown"],
             job_type="host-drawdown",
         ),
+        FnJob(
+            f"spawnhost-expiration-{now:.3f}",
+            _expire_spawn_hosts,
+            scopes=["spawnhost-expiration"],
+            job_type="spawnhost-expiration",
+        ),
     ]
+
+
+def _expire_spawn_hosts(s: Store) -> None:
+    from ..cloud.spawnhost import expire_spawn_hosts
+
+    expire_spawn_hosts(s)
 
 
 def task_monitoring_jobs(store: Store, now: float) -> List[Job]:
