@@ -1,0 +1,163 @@
+"""Solver sidecar: the TPU solve behind a socket.
+
+The north star's deployment shape (BASELINE.json / SURVEY §7 step 5): a
+non-Python control plane ships the snapshot tensor to a sidecar and gets
+back queue orderings + spawn counts. This server hosts the batched JAX
+solve; clients speak a length-prefixed binary protocol (no IDL runtime
+needed — the snapshot arena layout is fully determined by the shape key,
+snapshot.arena_for_dims). The C++ client lives in native/evgsolve.
+
+Wire format (little-endian):
+  request:  magic "EVGS" | u32 version=1 | 6×u32 shape key (N,M,U,G,H,D)
+            | u64 n_f32 | f32 data | u64 n_i32 | i32 data | u64 n_u8 | u8 data
+  response: u32 status (0=ok) |
+            ok   → u64 n_i32 | i32 data | u64 n_f32 | f32 data
+            err  → u32 msg_len | msg bytes
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"EVGS"
+VERSION = 1
+
+
+def _read_exact(sock_file, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock_file.read(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def _solve_buffers(
+    shape: Tuple[int, int, int, int, int, int],
+    f32_buf: np.ndarray,
+    i32_buf: np.ndarray,
+    u8_buf: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the packed solve on raw arena buffers."""
+    import jax
+
+    from ..ops.solve import _packed_solve
+    from ..scheduler.snapshot import arena_for_dims
+
+    dims = dict(zip("NMUGHD", shape))
+    arena = arena_for_dims(dims)
+    want = {k: v.shape[0] for k, v in arena.buffers.items()}
+    got = {"f32": f32_buf.shape[0], "i32": i32_buf.shape[0], "u8": u8_buf.shape[0]}
+    if want != got:
+        raise ValueError(f"buffer sizes {got} do not match shape key (want {want})")
+    bufs = {"f32": f32_buf, "i32": i32_buf, "u8": u8_buf}
+    out_i32, out_f32 = _packed_solve(bufs, arena.layout_key())
+    out_i32, out_f32 = jax.device_get((out_i32, out_f32))
+    return np.asarray(out_i32), np.asarray(out_f32)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        while True:
+            try:
+                header = self.rfile.read(4)
+            except ConnectionError:
+                return
+            if not header:
+                return
+            try:
+                if header != MAGIC:
+                    raise ValueError(f"bad magic {header!r}")
+                (version,) = struct.unpack("<I", _read_exact(self.rfile, 4))
+                if version != VERSION:
+                    raise ValueError(f"unsupported protocol version {version}")
+                shape = struct.unpack("<6I", _read_exact(self.rfile, 24))
+                bufs = []
+                for dtype, itemsize in ((np.float32, 4), (np.int32, 4), (np.uint8, 1)):
+                    (count,) = struct.unpack("<Q", _read_exact(self.rfile, 8))
+                    if count > 1 << 31:
+                        raise ValueError(f"buffer too large: {count}")
+                    data = _read_exact(self.rfile, count * itemsize)
+                    bufs.append(np.frombuffer(data, dtype=dtype).copy())
+                out_i32, out_f32 = _solve_buffers(shape, *bufs)
+                self.wfile.write(struct.pack("<I", 0))
+                self.wfile.write(struct.pack("<Q", out_i32.shape[0]))
+                self.wfile.write(out_i32.astype("<i4").tobytes())
+                self.wfile.write(struct.pack("<Q", out_f32.shape[0]))
+                self.wfile.write(out_f32.astype("<f4").tobytes())
+                self.wfile.flush()
+            except (ValueError, ConnectionError, struct.error) as e:
+                try:
+                    msg = str(e).encode()[:4096]
+                    self.wfile.write(struct.pack("<I", 1))
+                    self.wfile.write(struct.pack("<I", len(msg)))
+                    self.wfile.write(msg)
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                return
+
+
+class SidecarServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(host: str = "127.0.0.1", port: int = 9091) -> SidecarServer:
+    return SidecarServer((host, port), _Handler)
+
+
+def serve_background(host: str = "127.0.0.1", port: int = 0) -> Tuple[SidecarServer, int]:
+    server = serve(host, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+class SidecarClient:
+    """Python reference client (the C++ client in native/evgsolve speaks the
+    same protocol)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.addr = (host, port)
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, timeout=120)
+            self._file = self._sock.makefile("rwb")
+        return self._file
+
+    def solve(self, snapshot) -> Tuple[np.ndarray, np.ndarray]:
+        f = self._connect()
+        bufs = snapshot.arena.buffers
+        f.write(MAGIC)
+        f.write(struct.pack("<I", VERSION))
+        f.write(struct.pack("<6I", *snapshot.shape_key()))
+        for kind, dtype in (("f32", "<f4"), ("i32", "<i4"), ("u8", "u1")):
+            arr = np.ascontiguousarray(bufs[kind])
+            f.write(struct.pack("<Q", arr.shape[0]))
+            f.write(arr.astype(dtype).tobytes())
+        f.flush()
+        (status,) = struct.unpack("<I", _read_exact(f, 4))
+        if status != 0:
+            (mlen,) = struct.unpack("<I", _read_exact(f, 4))
+            raise RuntimeError(
+                f"sidecar error: {_read_exact(f, mlen).decode()}"
+            )
+        (n_i32,) = struct.unpack("<Q", _read_exact(f, 8))
+        i32 = np.frombuffer(_read_exact(f, 4 * n_i32), dtype="<i4").copy()
+        (n_f32,) = struct.unpack("<Q", _read_exact(f, 8))
+        f32 = np.frombuffer(_read_exact(f, 4 * n_f32), dtype="<f4").copy()
+        return i32, f32
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

@@ -62,6 +62,20 @@ def cmd_agent(args) -> int:
             idle_sleep = min(idle_sleep * 2, agent.options.max_poll_interval_s)
 
 
+def cmd_solver(args) -> int:
+    """Run the TPU solver sidecar (the Solve(SnapshotTensor) service a
+    non-Python control plane calls; C++ client in native/evgsolve)."""
+    from .api.sidecar import serve
+
+    server = serve(args.host, args.port)
+    print(f"solver sidecar listening on {args.host}:{args.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_validate(args) -> int:
     """Validate a project file (reference operations/validate.go)."""
     from .ingestion.validator import LEVEL_ERROR, validate_project
@@ -157,6 +171,11 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--once", action="store_true",
                    help="exit when the queue is empty")
     a.set_defaults(fn=cmd_agent)
+
+    so = sub.add_parser("solver", help="run the TPU solver sidecar")
+    so.add_argument("--host", default="127.0.0.1")
+    so.add_argument("--port", type=int, default=9091)
+    so.set_defaults(fn=cmd_solver)
 
     v = sub.add_parser("validate", help="validate a project config file")
     v.add_argument("file")

@@ -222,6 +222,19 @@ _DIM_OF_FIELD = {
 }
 
 
+def arena_for_dims(dims: Dict[str, int]) -> Arena:
+    """Allocate the canonical snapshot arena for bucket sizes
+    ``{"N":…, "M":…, "U":…, "G":…, "H":…, "D":…}``. The field order of
+    FIELD_KINDS fully determines the transfer layout — the sidecar protocol
+    (api/sidecar.py, native/evgsolve) reconstructs it from the shape key
+    alone."""
+    arena = Arena()
+    for name, kind in FIELD_KINDS.items():
+        arena.alloc(name, dims[_DIM_OF_FIELD[name[:2]]], kind)
+    arena.finalize()
+    return arena
+
+
 def _factor(v: float) -> float:
     """Reference fallback: factors ≤ 0 resolve to 1
     (model/distro/distro.go:352-405)."""
@@ -316,10 +329,7 @@ def build_snapshot(
     D = _bucket(max(n_d, 1), minimum=8)
     dims = {"N": N, "M": M, "U": U, "G": G, "H": H, "D": D}
 
-    arena = Arena()
-    for name, kind in FIELD_KINDS.items():
-        arena.alloc(name, dims[_DIM_OF_FIELD[name[:2]]], kind)
-    arena.finalize()
+    arena = arena_for_dims(dims)
 
     a: Dict[str, np.ndarray] = {}
     for name, kind in FIELD_KINDS.items():

@@ -1,0 +1,136 @@
+#include "evgsolve.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace evgsolve {
+
+namespace {
+constexpr char kMagic[4] = {'E', 'V', 'G', 'S'};
+constexpr uint32_t kVersion = 1;
+}  // namespace
+
+Client::Client(const std::string& host, uint16_t port)
+    : host_(host), port_(port) {}
+
+Client::~Client() { Close(); }
+
+bool Client::Connect() {
+  if (fd_ >= 0) return true;
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_str = std::to_string(port_);
+  int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    error_ = std::string("getaddrinfo: ") + gai_strerror(rc);
+    return false;
+  }
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd_ < 0) continue;
+    if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd_);
+    fd_ = -1;
+  }
+  freeaddrinfo(res);
+  if (fd_ < 0) {
+    error_ = std::string("connect failed: ") + strerror(errno);
+    return false;
+  }
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return true;
+}
+
+void Client::Close() {
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Client::WriteAll(const void* data, size_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len > 0) {
+    ssize_t n = send(fd_, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      error_ = std::string("send: ") + strerror(errno);
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Client::ReadAll(void* data, size_t len) {
+  char* p = static_cast<char*>(data);
+  while (len > 0) {
+    ssize_t n = recv(fd_, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      error_ = n == 0 ? "server closed connection"
+                      : std::string("recv: ") + strerror(errno);
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Client::Solve(const Snapshot& snapshot, SolveResult* result) {
+  if (!Connect()) return false;
+
+  // request
+  if (!WriteAll(kMagic, 4)) return false;
+  if (!WriteAll(&kVersion, 4)) return false;
+  if (!WriteAll(&snapshot.shape, sizeof(ShapeKey))) return false;
+
+  uint64_t n = snapshot.f32.size();
+  if (!WriteAll(&n, 8)) return false;
+  if (n && !WriteAll(snapshot.f32.data(), n * sizeof(float))) return false;
+  n = snapshot.i32.size();
+  if (!WriteAll(&n, 8)) return false;
+  if (n && !WriteAll(snapshot.i32.data(), n * sizeof(int32_t))) return false;
+  n = snapshot.u8.size();
+  if (!WriteAll(&n, 8)) return false;
+  if (n && !WriteAll(snapshot.u8.data(), n)) return false;
+
+  // response
+  uint32_t status = 0;
+  if (!ReadAll(&status, 4)) return false;
+  if (status != 0) {
+    uint32_t mlen = 0;
+    if (!ReadAll(&mlen, 4)) return false;
+    std::string msg(mlen, '\0');
+    if (mlen && !ReadAll(&msg[0], mlen)) return false;
+    error_ = "sidecar error: " + msg;
+    return false;
+  }
+  uint64_t n_i32 = 0;
+  if (!ReadAll(&n_i32, 8)) return false;
+  result->i32.resize(n_i32);
+  if (n_i32 && !ReadAll(result->i32.data(), n_i32 * sizeof(int32_t)))
+    return false;
+  uint64_t n_f32 = 0;
+  if (!ReadAll(&n_f32, 8)) return false;
+  result->f32.resize(n_f32);
+  if (n_f32 && !ReadAll(result->f32.data(), n_f32 * sizeof(float)))
+    return false;
+  return true;
+}
+
+}  // namespace evgsolve

@@ -1,0 +1,89 @@
+// evgsolve — C++ client for the TPU scheduling-solver sidecar.
+//
+// The sidecar (evergreen_tpu/api/sidecar.py) hosts the batched JAX solve;
+// this library lets a non-Python control plane ship snapshot arenas and
+// receive queue orderings + spawn counts, matching the north-star
+// architecture (SURVEY §7 step 5: Solve(SnapshotTensor) -> queues, spawns).
+//
+// Wire protocol (little-endian), version 1:
+//   request:  "EVGS" | u32 version | 6x u32 shape key (N,M,U,G,H,D)
+//             | u64 n_f32 | f32[] | u64 n_i32 | i32[] | u64 n_u8 | u8[]
+//   response: u32 status | ok: u64 n_i32, i32[], u64 n_f32, f32[]
+//                        | err: u32 len, msg
+#ifndef EVGSOLVE_H
+#define EVGSOLVE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace evgsolve {
+
+struct ShapeKey {
+  uint32_t n_tasks;        // N: padded task count
+  uint32_t n_memberships;  // M: task->unit edges
+  uint32_t n_units;        // U: planner units
+  uint32_t n_segments;     // G: distro x task-group segments
+  uint32_t n_hosts;        // H
+  uint32_t n_distros;      // D
+};
+
+// Snapshot transfer arenas. Field layout within each arena is the canonical
+// order defined by evergreen_tpu/scheduler/snapshot.py FIELD_KINDS and is
+// fully determined by the shape key.
+struct Snapshot {
+  ShapeKey shape;
+  std::vector<float> f32;
+  std::vector<int32_t> i32;
+  std::vector<uint8_t> u8;
+};
+
+// Solve outputs, packed per evergreen_tpu/ops/solve.py OUTPUT_SPEC:
+// i32: order[N], t_unit[N], d_new_hosts[D], d_free_approx[D], d_length[D],
+//      d_deps_met[D], d_over_count[D], d_wait_over[D], d_merge[D],
+//      g_count[G], g_count_free[G], g_count_required[G], g_over_count[G],
+//      g_wait_over[G], g_merge[G]
+// f32: t_value[N], d_expected_dur_s[D], d_over_dur_s[D],
+//      g_expected_dur_s[G], g_over_dur_s[G]
+struct SolveResult {
+  std::vector<int32_t> i32;
+  std::vector<float> f32;
+
+  // convenience accessors into the packed buffers
+  const int32_t* order(const ShapeKey& s) const { return i32.data(); }
+  const int32_t* new_hosts(const ShapeKey& s) const {
+    return i32.data() + 2ull * s.n_tasks;  // after order + t_unit
+  }
+};
+
+class Client {
+ public:
+  Client(const std::string& host, uint16_t port);
+  ~Client();
+
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Connects (idempotent). Returns false and sets last_error() on failure.
+  bool Connect();
+  void Close();
+
+  // Ships the snapshot, blocks for the solve result.
+  // Returns false and sets last_error() on transport or server error.
+  bool Solve(const Snapshot& snapshot, SolveResult* result);
+
+  const std::string& last_error() const { return error_; }
+
+ private:
+  bool WriteAll(const void* data, size_t len);
+  bool ReadAll(void* data, size_t len);
+
+  std::string host_;
+  uint16_t port_;
+  int fd_ = -1;
+  std::string error_;
+};
+
+}  // namespace evgsolve
+
+#endif  // EVGSOLVE_H

@@ -1,0 +1,86 @@
+// evgsolve_cli — demo control-plane client: load a snapshot dump, call the
+// solver sidecar, print per-distro spawn counts + the queue head.
+//
+// Snapshot dump format (written by tests / tools via
+// evergreen_tpu.api.sidecar dump helpers): the wire request payload without
+// magic/version — 6x u32 shape key, then u64-count-prefixed f32/i32/u8
+// arenas.
+//
+// Usage: evgsolve_cli <host> <port> <snapshot.bin> [repeats]
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "evgsolve.h"
+
+static bool LoadDump(const char* path, evgsolve::Snapshot* snap) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    perror("open snapshot");
+    return false;
+  }
+  bool ok = fread(&snap->shape, sizeof(snap->shape), 1, f) == 1;
+  uint64_t n = 0;
+  if (ok) ok = fread(&n, 8, 1, f) == 1;
+  if (ok) {
+    snap->f32.resize(n);
+    ok = n == 0 || fread(snap->f32.data(), sizeof(float), n, f) == n;
+  }
+  if (ok) ok = fread(&n, 8, 1, f) == 1;
+  if (ok) {
+    snap->i32.resize(n);
+    ok = n == 0 || fread(snap->i32.data(), sizeof(int32_t), n, f) == n;
+  }
+  if (ok) ok = fread(&n, 8, 1, f) == 1;
+  if (ok) {
+    snap->u8.resize(n);
+    ok = n == 0 || fread(snap->u8.data(), 1, n, f) == n;
+  }
+  fclose(f);
+  if (!ok) fprintf(stderr, "malformed snapshot dump: %s\n", path);
+  return ok;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <host> <port> <snapshot.bin> [repeats]\n",
+            argv[0]);
+    return 2;
+  }
+  evgsolve::Snapshot snap;
+  if (!LoadDump(argv[3], &snap)) return 1;
+  const int repeats = argc > 4 ? atoi(argv[4]) : 1;
+
+  evgsolve::Client client(argv[1], static_cast<uint16_t>(atoi(argv[2])));
+  evgsolve::SolveResult result;
+  for (int i = 0; i < repeats; ++i) {
+    if (!client.Solve(snap, &result)) {
+      fprintf(stderr, "solve failed: %s\n", client.last_error().c_str());
+      return 1;
+    }
+  }
+
+  const evgsolve::ShapeKey& s = snap.shape;
+  const uint64_t want_i32 = 2ull * s.n_tasks + 7ull * s.n_distros +
+                            6ull * s.n_segments;
+  const uint64_t want_f32 =
+      1ull * s.n_tasks + 2ull * s.n_distros + 2ull * s.n_segments;
+  if (result.i32.size() != want_i32 || result.f32.size() != want_f32) {
+    fprintf(stderr, "unexpected result sizes: i32=%zu (want %llu) f32=%zu (want %llu)\n",
+            result.i32.size(), (unsigned long long)want_i32,
+            result.f32.size(), (unsigned long long)want_f32);
+    return 1;
+  }
+
+  const int32_t* order = result.order(s);
+  const int32_t* new_hosts = result.new_hosts(s);
+  long long total_spawns = 0;
+  for (uint32_t d = 0; d < s.n_distros; ++d) total_spawns += new_hosts[d];
+
+  printf("solve ok: N=%u D=%u G=%u\n", s.n_tasks, s.n_distros, s.n_segments);
+  printf("queue head:");
+  for (uint32_t i = 0; i < s.n_tasks && i < 8; ++i) printf(" %d", order[i]);
+  printf("\ntotal spawns: %lld\n", total_spawns);
+  return 0;
+}
