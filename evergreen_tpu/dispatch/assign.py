@@ -50,11 +50,20 @@ def assign_next_available_task(
     spec = spec_for_host(host)
     dispatcher = svc.get(host.distro_id)
     dispatcher.refresh(now)
+    secondary: Optional[object] = None  # lazily-built alias-queue fallback
 
     while True:
         item = dispatcher.find_next_task(spec, now)
         if item is None:
-            return None
+            # primary queue exhausted → serve the distro's secondary (alias)
+            # queue (reference: separate alias dispatcher,
+            # model/task_queue_service.go:61)
+            if secondary is None:
+                secondary = svc.get(host.distro_id, secondary=True)
+                secondary.refresh(now)
+            item = secondary.find_next_task(spec, now)
+            if item is None:
+                return None
         t = task_mod.get(store, item.id)
         if t is None:
             continue

@@ -63,11 +63,13 @@ class _GroupUnit:
 
 class DAGDispatcher:
     def __init__(
-        self, store: Store, distro_id: str, ttl_s: float = DEFAULT_TTL_S
+        self, store: Store, distro_id: str, ttl_s: float = DEFAULT_TTL_S,
+        secondary: bool = False,
     ) -> None:
         self.store = store
         self.distro_id = distro_id
         self.ttl_s = ttl_s
+        self.secondary = secondary
         self._lock = threading.RLock()
         self._last_updated = 0.0
         self._sorted: List[TaskQueueItem] = []
@@ -82,7 +84,8 @@ class DAGDispatcher:
         with self._lock:
             if not force and now - self._last_updated < self.ttl_s:
                 return
-            queue = tq_mod.load(self.store, self.distro_id)
+            queue = tq_mod.load(self.store, self.distro_id,
+                                secondary=self.secondary)
             self.rebuild(queue.queue if queue else [], now)
 
     def rebuild(self, items: List[TaskQueueItem], now: float) -> None:
@@ -254,12 +257,15 @@ class DispatcherService:
         self._lock = threading.Lock()
         self._dispatchers: Dict[str, DAGDispatcher] = {}
 
-    def get(self, distro_id: str) -> DAGDispatcher:
+    def get(self, distro_id: str, secondary: bool = False) -> DAGDispatcher:
+        key = f"{distro_id}//secondary" if secondary else distro_id
         with self._lock:
-            disp = self._dispatchers.get(distro_id)
+            disp = self._dispatchers.get(key)
             if disp is None:
-                disp = DAGDispatcher(self.store, distro_id, self.ttl_s)
-                self._dispatchers[distro_id] = disp
+                disp = DAGDispatcher(
+                    self.store, distro_id, self.ttl_s, secondary=secondary
+                )
+                self._dispatchers[key] = disp
             return disp
 
     def refresh_find_next_task(

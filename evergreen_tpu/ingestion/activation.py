@@ -1,0 +1,118 @@
+"""Version/build activation scheduling: batchtime, cron, periodic builds.
+
+Reference: model/version_activation.go (batch-time deferred activation),
+units/version_activation_catchup.go (the catchup job),
+units/periodic_builds.go (interval-created ad-hoc versions), cron specs on
+project refs (model/project_ref.go:2642).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from ..globals import Requester
+from ..models import build as build_mod
+from ..models import event as event_mod
+from ..models import task as task_mod
+from ..models import version as version_mod
+from ..storage.store import Store
+from .repotracker import Revision, get_project_ref, store_revisions
+
+ACTIVATION_COLLECTION = "pending_activations"
+PERIODIC_COLLECTION = "periodic_builds"
+
+
+def defer_activation(
+    store: Store, build_id: str, activate_at: float
+) -> None:
+    """Record a build for later activation (batchtime semantics: the
+    reference deactivates at creation and activates when the batch window
+    elapses)."""
+    store.collection(ACTIVATION_COLLECTION).upsert(
+        {"_id": build_id, "build_id": build_id, "activate_at": activate_at,
+         "done": False}
+    )
+
+
+def activate_build(store: Store, build_id: str, now: float, by: str) -> int:
+    """Activate a build and its tasks."""
+    b = build_mod.get(store, build_id)
+    if b is None:
+        return 0
+    build_mod.coll(store).update(
+        build_id, {"activated": True, "activated_time": now}
+    )
+    n = task_mod.coll(store).update_where(
+        lambda d: d["build_id"] == build_id and not d["activated"],
+        {"activated": True, "activated_time": now, "activated_by": by},
+    )
+    event_mod.log(
+        store, event_mod.RESOURCE_BUILD, "BUILD_ACTIVATED", build_id,
+        {"by": by}, timestamp=now,
+    )
+    return n
+
+
+def activation_catchup(store: Store, now: Optional[float] = None) -> List[str]:
+    """Activate builds whose batch window has elapsed (reference
+    units/version_activation_catchup.go)."""
+    now = _time.time() if now is None else now
+    activated: List[str] = []
+    coll = store.collection(ACTIVATION_COLLECTION)
+    for doc in coll.find(lambda d: not d["done"] and d["activate_at"] <= now):
+        activate_build(store, doc["build_id"], now, "batchtime-activator")
+        coll.update(doc["_id"], {"done": True})
+        activated.append(doc["build_id"])
+    return activated
+
+
+# --------------------------------------------------------------------------- #
+# Periodic builds (reference units/periodic_builds.go)
+# --------------------------------------------------------------------------- #
+
+
+def define_periodic_build(
+    store: Store,
+    project_id: str,
+    definition_id: str,
+    interval_s: float,
+    config_yaml: str,
+    message: str = "periodic build",
+) -> None:
+    store.collection(PERIODIC_COLLECTION).upsert(
+        {
+            "_id": f"{project_id}:{definition_id}",
+            "project": project_id,
+            "definition_id": definition_id,
+            "interval_s": interval_s,
+            "config_yaml": config_yaml,
+            "message": message,
+            "next_run": 0.0,
+        }
+    )
+
+
+def run_periodic_builds(store: Store, now: Optional[float] = None) -> List[str]:
+    now = _time.time() if now is None else now
+    created: List[str] = []
+    coll = store.collection(PERIODIC_COLLECTION)
+    for doc in coll.find(lambda d: d["next_run"] <= now):
+        ref = get_project_ref(store, doc["project"])
+        if ref is None or not ref.enabled:
+            continue
+        out = store_revisions(
+            store,
+            doc["project"],
+            [
+                Revision(
+                    revision=f"periodic-{doc['definition_id']}-{int(now)}",
+                    message=doc["message"],
+                    config_yaml=doc["config_yaml"],
+                )
+            ],
+            now=now,
+            requester=Requester.AD_HOC.value,
+        )
+        coll.update(doc["_id"], {"next_run": now + doc["interval_s"]})
+        created.extend(c.version.id for c in out)
+    return created

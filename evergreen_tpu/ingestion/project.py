@@ -269,6 +269,16 @@ def materialize_version(
             continue
         build_id = _sanitize(f"{vid}_{bv.name}")
         bv_activate = activate and bv.activate is not False
+        # batchtime defers mainline activation by N minutes (reference
+        # model/version_activation.go; patches ignore batchtime)
+        batch_deferred = (
+            bv_activate
+            and bv.batchtime is not None
+            and bv.batchtime > 0
+            and not is_patch_requester(requester)
+        )
+        if batch_deferred:
+            bv_activate = False
         build = Build(
             id=build_id,
             version=vid,
@@ -317,12 +327,36 @@ def materialize_version(
             tasks.append(t)
             by_variant_task[(bv.name, rtu.task_def.name)] = t
             resolved.append(rtu)
+        # display tasks: named groupings of execution tasks for the UI
+        # (reference model/project_parser.go displayTask + build fields)
+        for dt in bv.display_tasks:
+            exec_ids = [
+                by_variant_task[(bv.name, n)].id
+                for n in dt.execution_tasks
+                if (bv.name, n) in by_variant_task
+            ]
+            if exec_ids:
+                store.collection("display_tasks").upsert(
+                    {
+                        "_id": _sanitize(f"{build_id}_display_{dt.name}"),
+                        "name": dt.name,
+                        "build_id": build_id,
+                        "version": vid,
+                        "build_variant": bv.name,
+                        "execution_tasks": exec_ids,
+                    }
+                )
+
         builds.append(build)
         version.build_ids.append(build_id)
         version.build_variants_status.append(
             {"build_variant": bv.name, "build_id": build_id,
              "activated": bv_activate}
         )
+        if batch_deferred:
+            from .activation import defer_activation
+
+            defer_activation(store, build_id, now + bv.batchtime * 60.0)
 
     _expand_dependencies(pp, resolved, tasks, by_variant_task)
     _compute_num_dependents(tasks)

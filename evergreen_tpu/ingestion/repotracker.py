@@ -37,6 +37,7 @@ class ProjectRef:
     batch_time_minutes: int = 0
     deactivate_previous: bool = False
     stepback_disabled: bool = False
+    stepback_bisect: bool = False
     patching_disabled: bool = False
     dispatching_disabled: bool = False
     default_distro: str = ""
@@ -77,6 +78,7 @@ def store_revisions(
     project_id: str,
     revisions: List[Revision],
     now: Optional[float] = None,
+    requester: str = Requester.REPOTRACKER.value,
 ) -> List[CreatedVersion]:
     """Create one version per new revision, oldest first (reference
     StoreRevisions :220-380). A config that fails to parse creates a
@@ -89,7 +91,7 @@ def store_revisions(
 
     # next revision order number follows the project's latest version
     existing = version_mod.find_by_project_order(
-        store, project_id, 0, 1 << 60, requester=Requester.REPOTRACKER.value
+        store, project_id, 0, 1 << 60, requester=requester
     )
     next_order = (existing[-1].revision_order_number + 1) if existing else 1
 
@@ -102,7 +104,7 @@ def store_revisions(
                 rev.config_yaml,
                 revision=rev.revision,
                 order=next_order,
-                requester=Requester.REPOTRACKER.value,
+                requester=requester,
                 author=rev.author,
                 message=rev.message,
                 now=now,
@@ -115,7 +117,7 @@ def store_revisions(
                 project=project_id,
                 revision=rev.revision,
                 revision_order_number=next_order,
-                requester=Requester.REPOTRACKER.value,
+                requester=requester,
                 author=rev.author,
                 message=rev.message,
                 create_time=now,

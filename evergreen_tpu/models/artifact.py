@@ -1,0 +1,165 @@
+"""Artifacts + test results + blob storage.
+
+Reference: model/artifact/ (attached artifact records + signed URLs,
+rest/route/artifact_sign.go), model/task/test_result_service.go +
+model/testresult (per-task test results), and pail (blob storage over S3)
+— here a content-addressed local blob store with the same get/put seam.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import os
+import time as _time
+from typing import List, Optional
+
+from ..storage.store import Store
+
+ARTIFACTS_COLLECTION = "artifacts"
+TEST_RESULTS_COLLECTION = "test_results"
+
+_SIGNING_KEY = b"evergreen-tpu-artifact-signing"
+
+
+# --------------------------------------------------------------------------- #
+# Blob store (the pail seam)
+# --------------------------------------------------------------------------- #
+
+
+class BlobStore:
+    """Local filesystem bucket with the get/put/exists surface of pail."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "_")
+        return os.path.join(self.root, safe)
+
+    def put(self, key: str, data: bytes) -> str:
+        path = self._path(key)
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+
+# --------------------------------------------------------------------------- #
+# Artifacts
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class ArtifactFile:
+    name: str
+    link: str
+    visibility: str = "public"  # public | private | signed
+    content_type: str = ""
+
+
+def attach_artifacts(
+    store: Store, task_id: str, execution: int, files: List[ArtifactFile]
+) -> None:
+    """reference agent command attach.artifacts → model/artifact records."""
+    coll = store.collection(ARTIFACTS_COLLECTION)
+    doc = coll.get(f"{task_id}:{execution}")
+    entries = [dataclasses.asdict(f) for f in files]
+    if doc is None:
+        coll.upsert(
+            {
+                "_id": f"{task_id}:{execution}",
+                "task_id": task_id,
+                "execution": execution,
+                "files": entries,
+            }
+        )
+    else:
+        doc["files"].extend(entries)
+
+
+def get_artifacts(store: Store, task_id: str, execution: int = 0) -> List[ArtifactFile]:
+    doc = store.collection(ARTIFACTS_COLLECTION).get(f"{task_id}:{execution}")
+    if doc is None:
+        return []
+    return [ArtifactFile(**f) for f in doc["files"]]
+
+
+def sign_url(link: str, expires_at: float) -> str:
+    """Signed artifact link (reference rest/route/artifact_sign.go)."""
+    payload = f"{link}:{int(expires_at)}".encode()
+    sig = hmac.new(_SIGNING_KEY, payload, hashlib.sha256).hexdigest()[:32]
+    return f"{link}?expires={int(expires_at)}&sig={sig}"
+
+
+def verify_signed_url(url: str, now: Optional[float] = None) -> bool:
+    now = _time.time() if now is None else now
+    try:
+        link, qs = url.split("?", 1)
+        params = dict(kv.split("=", 1) for kv in qs.split("&"))
+        expires = int(params["expires"])
+        if expires < now:
+            return False
+        expect = sign_url(link, expires).split("sig=")[1]
+        return hmac.compare_digest(expect, params["sig"])
+    except (ValueError, KeyError):
+        return False
+
+
+# --------------------------------------------------------------------------- #
+# Test results
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class TestResult:
+    test_name: str
+    status: str  # pass | fail | skip
+    duration_s: float = 0.0
+    log_url: str = ""
+    line_num: int = 0
+
+
+def attach_test_results(
+    store: Store, task_id: str, execution: int, results: List[TestResult]
+) -> None:
+    """reference attach.results / attach.xunit_results →
+    model/task/test_result_service.go."""
+    coll = store.collection(TEST_RESULTS_COLLECTION)
+    doc_id = f"{task_id}:{execution}"
+    doc = coll.get(doc_id)
+    entries = [dataclasses.asdict(r) for r in results]
+    if doc is None:
+        coll.upsert(
+            {
+                "_id": doc_id,
+                "task_id": task_id,
+                "execution": execution,
+                "results": entries,
+            }
+        )
+    else:
+        doc["results"].extend(entries)
+    # tasks with failing results surface it on the task doc (reference
+    # Task.ResultsFailed / HasFailedTests)
+    if any(r.status == "fail" for r in results):
+        store.collection("tasks").update(task_id, {"results_failed": True})
+
+
+def get_test_results(
+    store: Store, task_id: str, execution: int = 0
+) -> List[TestResult]:
+    doc = store.collection(TEST_RESULTS_COLLECTION).get(f"{task_id}:{execution}")
+    if doc is None:
+        return []
+    return [TestResult(**r) for r in doc["results"]]

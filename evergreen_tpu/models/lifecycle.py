@@ -179,16 +179,22 @@ def block_single_host_task_group(store: Store, t: Task, now: float) -> List[str]
 
 
 def evaluate_stepback(store: Store, t: Task, now: float) -> Optional[str]:
-    """Linear stepback: when a mainline task fails, activate the same task
-    at the previous mainline commit if it has never run (reference
-    doLinearStepback, model/task_lifecycle.go:464; evaluated from MarkEnd
-    :849-882). Returns the activated task id, if any."""
+    """Stepback: when a mainline task fails, activate the same task at an
+    earlier commit to locate the offending revision — the previous commit
+    (linear, reference doLinearStepback model/task_lifecycle.go:464) or the
+    midpoint between the last pass and this failure (bisect, :496),
+    selected per project ref. Returns the activated task id, if any."""
     if t.status != TaskStatus.FAILED.value:
         return None
     if t.requester != Requester.REPOTRACKER.value:
         return None
     if t.details_type == "system":
         return None  # system failures don't step back
+
+    ref_doc = store.collection("project_refs").get(t.project) or {}
+    if ref_doc.get("stepback_disabled"):
+        return None
+    bisect = bool(ref_doc.get("stepback_bisect"))
 
     candidates = task_mod.find(
         store,
@@ -200,11 +206,33 @@ def evaluate_stepback(store: Store, t: Task, now: float) -> Optional[str]:
     )
     if not candidates:
         return None
-    prev = max(candidates, key=lambda x: x.revision_order_number)
-    if prev.status != TaskStatus.UNDISPATCHED.value or prev.activated:
-        return None  # previous already ran or is about to — nothing to bisect yet
+    candidates.sort(key=lambda x: x.revision_order_number)
+
+    target: Optional[Task] = None
+    if bisect:
+        # window: (last passing order, current failing order)
+        passing = [
+            c for c in candidates if c.status == TaskStatus.SUCCEEDED.value
+        ]
+        lo = passing[-1].revision_order_number if passing else 0
+        window = [
+            c
+            for c in candidates
+            if lo < c.revision_order_number < t.revision_order_number
+            and c.status == TaskStatus.UNDISPATCHED.value
+            and not c.activated
+        ]
+        if window:
+            target = window[len(window) // 2]
+    else:
+        prev = candidates[-1]
+        if prev.status == TaskStatus.UNDISPATCHED.value and not prev.activated:
+            target = prev
+
+    if target is None:
+        return None
     task_mod.coll(store).update(
-        prev.id,
+        target.id,
         {
             "activated": True,
             "activated_by": STEPBACK_TASK_ACTIVATOR,
@@ -215,11 +243,11 @@ def evaluate_stepback(store: Store, t: Task, now: float) -> Optional[str]:
         store,
         event_mod.RESOURCE_TASK,
         "TASK_ACTIVATED_STEPBACK",
-        prev.id,
-        {"failed_task": t.id},
+        target.id,
+        {"failed_task": t.id, "mode": "bisect" if bisect else "linear"},
         timestamp=now,
     )
-    return prev.id
+    return target.id
 
 
 def update_build_and_version_status(store: Store, t: Task, now: float) -> None:

@@ -1,0 +1,119 @@
+"""Users, API keys, roles.
+
+Reference: auth/ package (naive/github/okta/api-only user managers,
+auth.go:17 LoadUserManager) + gimlet role-based ACL wired in
+environment.go:1249. One pluggable UserManager with the naive (config
+users) implementation; role scopes gate admin/project actions.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import secrets
+import time as _time
+from typing import List, Optional
+
+from ..storage.store import Collection, Store
+
+COLLECTION = "users"
+
+# role scopes (the subset of gimlet's role manager the routes consume)
+SCOPE_SUPERUSER = "superuser"
+SCOPE_PROJECT_ADMIN = "project_admin"  # per-project, stored as project:<id>
+SCOPE_TASK_ADMIN = "task_admin"
+
+
+@dataclasses.dataclass
+class User:
+    id: str
+    display_name: str = ""
+    email: str = ""
+    api_key: str = ""
+    roles: List[str] = dataclasses.field(default_factory=list)
+    created_at: float = 0.0
+    banned: bool = False
+
+    def has_scope(self, scope: str) -> bool:
+        return not self.banned and (
+            scope in self.roles or SCOPE_SUPERUSER in self.roles
+        )
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "User":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        return cls(**doc)
+
+
+def coll(store: Store) -> Collection:
+    return store.collection(COLLECTION)
+
+
+def create_user(
+    store: Store, user_id: str, display_name: str = "", email: str = "",
+    roles: Optional[List[str]] = None,
+) -> User:
+    u = User(
+        id=user_id,
+        display_name=display_name or user_id,
+        email=email,
+        api_key=secrets.token_hex(16),
+        roles=roles or [],
+        created_at=_time.time(),
+    )
+    coll(store).insert(u.to_doc())
+    return u
+
+
+def get_user(store: Store, user_id: str) -> Optional[User]:
+    doc = coll(store).get(user_id)
+    return User.from_doc(doc) if doc else None
+
+
+def user_by_api_key(store: Store, api_key: str) -> Optional[User]:
+    if not api_key:
+        return None
+    docs = coll(store).find(lambda d: d.get("api_key") == api_key)
+    return User.from_doc(docs[0]) if docs else None
+
+
+def grant_role(store: Store, user_id: str, role: str) -> bool:
+    def add(doc: dict) -> None:
+        if role not in doc["roles"]:
+            doc["roles"].append(role)
+
+    return coll(store).mutate(user_id, add)
+
+
+class RateLimiter:
+    """Sliding-window per-key limiter (reference ratelimit/ NewRateLimiter,
+    Redis-backed there; windowed counters here)."""
+
+    def __init__(self, store: Store, limit: int, window_s: float = 60.0) -> None:
+        self.store = store
+        self.limit = limit
+        self.window_s = window_s
+
+    def allow(self, key: str, now: Optional[float] = None) -> bool:
+        now = _time.time() if now is None else now
+        bucket = int(now // self.window_s)
+        doc_id = f"{key}:{bucket}"
+        coll = self.store.collection("rate_limits")
+
+        count = {"n": 0}
+
+        def bump(doc: dict) -> None:
+            doc["n"] += 1
+            count["n"] = doc["n"]
+
+        if not coll.mutate(doc_id, bump):
+            coll.upsert({"_id": doc_id, "n": 1, "at": now})
+            count["n"] = 1
+        # opportunistic cleanup of old windows
+        coll.remove_where(lambda d: now - d.get("at", now) > 2 * self.window_s)
+        return count["n"] <= self.limit

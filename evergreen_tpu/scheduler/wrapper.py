@@ -37,6 +37,10 @@ from .persister import persist_task_queue
 from .snapshot import Snapshot, build_snapshot, compute_deps_met
 
 
+#: distro-id suffix marking secondary (alias) queue rows in the solve
+ALIAS_SUFFIX = "::alias"
+
+
 @dataclasses.dataclass
 class TickOptions:
     max_scheduled_per_distro: int = 0
@@ -82,11 +86,26 @@ def gather_tick_inputs(
     # scheduler/task_finder.go:34-36) — NOT the full task history, which
     # grows without bound in a CI system.
     tasks_by_distro: Dict[str, List[Task]] = {d.id: [] for d in distros}
+    alias_tasks: Dict[str, List[Task]] = {}
     runnable: List[Task] = []
     for t in task_mod.find_host_runnable(store):
         if t.distro_id in distro_ids:
             tasks_by_distro[t.distro_id].append(t)
             runnable.append(t)
+        for sd in t.secondary_distros:
+            if sd in distro_ids and sd != t.distro_id:
+                alias_tasks.setdefault(sd, []).append(t)
+                if t.distro_id not in distro_ids:
+                    runnable.append(t)
+
+    # Secondary (alias) queues plan as extra rows of the SAME batched solve
+    # (the reference runs a separate alias-scheduler job per distro,
+    # units/scheduler_alias.go; here it's just more rows in the tensor).
+    for did, tasks in sorted(alias_tasks.items()):
+        base = next(d for d in distros if d.id == did)
+        alias = dataclasses.replace(base, id=f"{did}{ALIAS_SUFFIX}")
+        distros.append(alias)
+        tasks_by_distro[alias.id] = tasks
 
     # Resolve only the dependency parents the runnable set references.
     parent_ids = {d.task_id for t in runnable for d in t.depends_on}
@@ -242,16 +261,23 @@ def run_tick(
     budget = max(0, opts.max_intent_hosts - n_intents_in_flight)
     for d in distros:
         plan = plans.get(d.id, [])
+        is_alias = d.id.endswith(ALIAS_SUFFIX)
+        base_id = d.id[: -len(ALIAS_SUFFIX)] if is_alias else d.id
+        info = infos.get(d.id, DistroQueueInfo())
+        info.secondary_queue = is_alias
         queues[d.id] = persist_task_queue(
             store,
-            d.id,
+            base_id,
             plan,
             sort_values.get(d.id, {}),
             deps_met,
-            infos.get(d.id, DistroQueueInfo()),
+            info,
             opts.max_scheduled_per_distro,
+            secondary=is_alias,
             now=now,
         )
+        if is_alias:
+            continue  # alias rows never spawn hosts (units/scheduler_alias.go)
         if opts.create_intent_hosts:
             n = min(new_hosts.get(d.id, 0), budget)
             budget -= n
@@ -272,6 +298,20 @@ def run_tick(
                 )
 
     total_ms = (_time.perf_counter() - t0) * 1e3
+    # per-solve timing span (the reference's scheduler span attributes,
+    # SURVEY §5 tracing; sink is the store's spans collection)
+    from ..utils.tracing import Tracer
+
+    with Tracer(store, "scheduler").span(
+        "tick",
+        n_tasks=n_tasks,
+        n_distros=len(distros),
+        snapshot_ms=round(snapshot_ms, 2),
+        solve_ms=round(solve_ms, 2),
+        total_ms=round(total_ms, 2),
+        planner=opts.planner_version,
+    ):
+        pass
     return TickResult(
         queues=queues,
         new_hosts=new_hosts,

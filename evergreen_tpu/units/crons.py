@@ -143,6 +143,27 @@ def task_monitoring_jobs(store: Store, now: float) -> List[Job]:
     ]
 
 
+def activation_jobs(store: Store, now: float) -> List[Job]:
+    """Batchtime catch-up + periodic builds (reference
+    units/version_activation_catchup.go, units/periodic_builds.go)."""
+    from ..ingestion.activation import activation_catchup, run_periodic_builds
+
+    return [
+        FnJob(
+            f"activation-catchup-{now:.3f}",
+            lambda s: activation_catchup(s),
+            scopes=["activation-catchup"],
+            job_type="activation-catchup",
+        ),
+        FnJob(
+            f"periodic-builds-{now:.3f}",
+            lambda s: run_periodic_builds(s),
+            scopes=["periodic-builds"],
+            job_type="periodic-builds",
+        ),
+    ]
+
+
 def event_notifier_jobs(store: Store, now: float) -> List[Job]:
     flags = ServiceFlags.get(store)
     if flags.event_processing_disabled:
@@ -207,6 +228,7 @@ def build_cron_runner(store: Store, queue: JobQueue) -> CronRunner:
     runner.register(
         IntervalOperation("task-monitoring", 5 * 60.0, task_monitoring_jobs)
     )
+    runner.register(IntervalOperation("activation", 60.0, activation_jobs))
     runner.register(IntervalOperation("event-notifier", 60.0, event_notifier_jobs))
     runner.register(IntervalOperation("stats", 60.0, stats_jobs))
     runner.register(IntervalOperation("hourly", 3600.0, hourly_jobs))

@@ -1,0 +1,60 @@
+"""Lightweight tracing: spans with attributes, persisted for inspection.
+
+The reference instruments everything with OpenTelemetry (SURVEY §5:
+config_tracer.go, per-package tracers, rich span attributes on scheduler
+jobs). This is the same seam without the OTLP dependency: spans nest via a
+context manager, carry attributes, and land in the store's ``spans``
+collection (an OTLP exporter can replace the sink wholesale).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time as _time
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..storage.store import Store
+
+SPANS_COLLECTION = "spans"
+
+_seq = itertools.count()
+_seq_lock = threading.Lock()
+_local = threading.local()
+
+
+class Tracer:
+    def __init__(self, store: Optional[Store], component: str) -> None:
+        self.store = store
+        self.component = component
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[Dict[str, Any]]:
+        with _seq_lock:
+            span_id = f"span-{next(_seq)}"
+        parent = getattr(_local, "current", None)
+        start = _time.perf_counter()
+        record: Dict[str, Any] = {
+            "_id": span_id,
+            "component": self.component,
+            "name": name,
+            "parent": parent,
+            "started_at": _time.time(),
+            "attributes": dict(attributes),
+        }
+        _local.current = span_id
+        try:
+            yield record
+        finally:
+            _local.current = parent
+            record["duration_ms"] = (_time.perf_counter() - start) * 1e3
+            if self.store is not None:
+                self.store.collection(SPANS_COLLECTION).upsert(record)
+
+
+def get_spans(store: Store, component: str = "") -> List[dict]:
+    spans = store.collection(SPANS_COLLECTION).find(
+        (lambda d: d["component"] == component) if component else None
+    )
+    spans.sort(key=lambda d: d["started_at"])
+    return spans

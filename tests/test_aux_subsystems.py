@@ -1,0 +1,257 @@
+"""Auxiliary subsystems: users/auth, rate limiting, artifacts/test results,
+annotations, tracing, parameter store, batchtime activation, periodic
+builds, bisect stepback, alias queues."""
+import textwrap
+import time
+
+from evergreen_tpu.cloud.parameterstore import FakeSSMClient, ParameterManager
+from evergreen_tpu.dispatch.assign import assign_next_available_task
+from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+from evergreen_tpu.globals import (
+    HostStatus,
+    Provider,
+    Requester,
+    TaskStatus,
+)
+from evergreen_tpu.ingestion.activation import (
+    activation_catchup,
+    define_periodic_build,
+    run_periodic_builds,
+)
+from evergreen_tpu.ingestion.repotracker import (
+    ProjectRef,
+    Revision,
+    store_revisions,
+    upsert_project_ref,
+)
+from evergreen_tpu.models import annotations as ann_mod
+from evergreen_tpu.models import artifact as artifact_mod
+from evergreen_tpu.models import build as build_mod
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import user as user_mod
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.lifecycle import mark_end
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+from evergreen_tpu.utils.tracing import Tracer, get_spans
+
+NOW = 1_700_000_000.0
+
+
+def test_users_roles_api_keys(store):
+    u = user_mod.create_user(store, "alice", roles=["project:core"])
+    assert user_mod.user_by_api_key(store, u.api_key).id == "alice"
+    assert user_mod.user_by_api_key(store, "wrong") is None
+    assert u.has_scope("project:core")
+    assert not u.has_scope(user_mod.SCOPE_SUPERUSER)
+    user_mod.grant_role(store, "alice", user_mod.SCOPE_SUPERUSER)
+    u2 = user_mod.get_user(store, "alice")
+    assert u2.has_scope("anything-at-all")  # superuser passes every scope
+
+
+def test_rate_limiter(store):
+    rl = user_mod.RateLimiter(store, limit=3, window_s=60)
+    assert all(rl.allow("k", NOW + i) for i in range(3))
+    assert not rl.allow("k", NOW + 3)
+    # different key unaffected; next window resets
+    assert rl.allow("other", NOW)
+    assert rl.allow("k", NOW + 61)
+
+
+def test_artifacts_and_signed_urls(store, tmp_path):
+    blob = artifact_mod.BlobStore(str(tmp_path / "bucket"))
+    blob.put("task1/out.log", b"contents")
+    assert blob.get("task1/out.log") == b"contents"
+
+    artifact_mod.attach_artifacts(
+        store, "t1", 0,
+        [artifact_mod.ArtifactFile(name="log", link="http://bucket/out.log")],
+    )
+    files = artifact_mod.get_artifacts(store, "t1")
+    assert files[0].name == "log"
+    url = artifact_mod.sign_url("http://bucket/out.log", NOW + 3600)
+    assert artifact_mod.verify_signed_url(url, NOW)
+    assert not artifact_mod.verify_signed_url(url, NOW + 7200)  # expired
+    assert not artifact_mod.verify_signed_url(url.replace("sig=", "sig=ff"), NOW)
+
+
+def test_test_results_mark_task(store):
+    task_mod.insert(store, Task(id="t1", activated=True))
+    artifact_mod.attach_test_results(
+        store, "t1", 0,
+        [
+            artifact_mod.TestResult(test_name="a", status="pass"),
+            artifact_mod.TestResult(test_name="b", status="fail"),
+        ],
+    )
+    assert task_mod.get(store, "t1").results_failed
+    results = artifact_mod.get_test_results(store, "t1")
+    assert {r.test_name for r in results} == {"a", "b"}
+
+
+def test_annotations_and_build_baron(store):
+    task_mod.insert(
+        store, Task(id="t1", project="core", status=TaskStatus.FAILED.value)
+    )
+    ann_mod.add_issue(
+        store, "t1", 0, ann_mod.IssueLink(url="http://jira/ABC-1", added_by="me")
+    )
+    ann = ann_mod.get_annotation(store, "t1")
+    assert ann.issues[0].url == "http://jira/ABC-1"
+
+    ann_mod.register_ticket_searcher(
+        "core",
+        lambda proj, doc: [ann_mod.IssueLink(url="http://jira/KNOWN-7",
+                                             source="build-baron")],
+    )
+    suggested = ann_mod.build_baron_suggest(store, "t1")
+    assert suggested[0].url == "http://jira/KNOWN-7"
+    assert ann_mod.get_annotation(store, "t1").suspected_issues
+
+
+def test_tracer_spans(store):
+    tracer = Tracer(store, "scheduler")
+    with tracer.span("tick", n_tasks=5):
+        with tracer.span("solve"):
+            pass
+    spans = get_spans(store, "scheduler")
+    assert [s["name"] for s in spans] == ["tick", "solve"]
+    assert spans[1]["parent"] == spans[0]["_id"]
+    assert spans[0]["attributes"] == {"n_tasks": 5}
+
+
+def test_parameter_store(store):
+    pm = ParameterManager(FakeSSMClient(store))
+    pm.put("github/token", "s3cret")
+    assert pm.get("github/token") == "s3cret"
+    assert pm.get("missing") is None
+    assert pm.delete("github/token")
+    assert pm.get("github/token", use_cache=False) is None
+
+
+BATCH_CONFIG = textwrap.dedent(
+    """
+    tasks:
+      - name: t1
+        commands: [{command: shell.exec, params: {script: "true"}}]
+    buildvariants:
+      - name: batched
+        batchtime: 60
+        run_on: [d1]
+        tasks: [{name: t1}]
+      - name: immediate
+        run_on: [d1]
+        tasks: [{name: t1}]
+    """
+)
+
+
+def test_batchtime_defers_activation(store):
+    upsert_project_ref(store, ProjectRef(id="proj"))
+    created = store_revisions(
+        store, "proj", [Revision(revision="abc1234567", config_yaml=BATCH_CONFIG)],
+        now=NOW,
+    )[0]
+    by_variant = {t.build_variant: t for t in created.tasks}
+    assert by_variant["immediate"].activated
+    assert not by_variant["batched"].activated
+    # before the window: nothing activates
+    assert activation_catchup(store, NOW + 30 * 60) == []
+    # after 60 minutes: the deferred build activates
+    activated = activation_catchup(store, NOW + 61 * 60)
+    assert len(activated) == 1
+    t = task_mod.get(store, by_variant["batched"].id)
+    assert t.activated
+
+
+def test_periodic_builds(store):
+    upsert_project_ref(store, ProjectRef(id="proj"))
+    define_periodic_build(
+        store, "proj", "nightly", 24 * 3600,
+        "tasks:\n  - name: t\n    commands: []\nbuildvariants:\n"
+        "  - name: bv\n    run_on: [d1]\n    tasks: [{name: t}]\n",
+    )
+    created = run_periodic_builds(store, NOW)
+    assert len(created) == 1
+    # not due again until the interval elapses
+    assert run_periodic_builds(store, NOW + 60) == []
+    assert len(run_periodic_builds(store, NOW + 25 * 3600)) == 1
+    v = store.collection("versions").get(created[0])
+    assert v["requester"] == Requester.AD_HOC.value
+
+
+def test_bisect_stepback(store):
+    upsert_project_ref(store, ProjectRef(id="proj", stepback_bisect=True))
+
+    def mk(order, status, activated):
+        return Task(
+            id=f"t{order}", project="proj", build_variant="bv",
+            display_name="compile", requester=Requester.REPOTRACKER.value,
+            revision_order_number=order, status=status, activated=activated,
+        )
+
+    task_mod.insert_many(
+        store,
+        [mk(1, TaskStatus.SUCCEEDED.value, True)]
+        + [mk(i, TaskStatus.UNDISPATCHED.value, False) for i in range(2, 10)]
+        + [mk(10, TaskStatus.STARTED.value, True)],
+    )
+    mark_end(store, "t10", TaskStatus.FAILED.value, now=NOW)
+    activated = [
+        t for t in task_mod.find(store)
+        if t.is_stepback_activated()
+    ]
+    # midpoint of orders 2..9 → index 4 of the window → order 6
+    assert [t.revision_order_number for t in activated] == [6]
+
+
+def test_alias_queue_planned_and_dispatched(store):
+    distro_mod.insert(
+        store,
+        Distro(id="primary", provider=Provider.MOCK.value,
+               host_allocator_settings=HostAllocatorSettings(maximum_hosts=5)),
+    )
+    distro_mod.insert(
+        store,
+        Distro(id="overflow", provider=Provider.MOCK.value,
+               host_allocator_settings=HostAllocatorSettings(maximum_hosts=5)),
+    )
+    task_mod.insert(
+        store,
+        Task(
+            id="t1", distro_id="primary", secondary_distros=["overflow"],
+            status=TaskStatus.UNDISPATCHED.value, activated=True,
+            activated_time=NOW - 60, create_time=NOW - 100,
+            expected_duration_s=60,
+        ),
+    )
+    run_tick(store, TickOptions(create_intent_hosts=False), now=NOW)
+    from evergreen_tpu.models import task_queue as tq_mod
+
+    primary_q = tq_mod.load(store, "primary")
+    overflow_secondary = tq_mod.load(store, "overflow", secondary=True)
+    assert [i.id for i in primary_q.queue] == ["t1"]
+    assert [i.id for i in overflow_secondary.queue] == ["t1"]
+    assert overflow_secondary.info.secondary_queue
+
+    # an overflow-distro host picks the task up via the alias queue
+    host_mod.insert(
+        store,
+        Host(id="h-ov", distro_id="overflow", status=HostStatus.RUNNING.value),
+    )
+    svc = DispatcherService(store)
+    got = assign_next_available_task(
+        store, svc, host_mod.get(store, "h-ov"), NOW
+    )
+    assert got is not None and got.id == "t1"
+    # primary dispatcher can no longer hand it out (already dispatched)
+    host_mod.insert(
+        store,
+        Host(id="h-pr", distro_id="primary", status=HostStatus.RUNNING.value),
+    )
+    assert assign_next_available_task(
+        store, svc, host_mod.get(store, "h-pr"), NOW
+    ) is None
