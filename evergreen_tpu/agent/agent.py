@@ -120,6 +120,17 @@ class Agent:
         # unless post_error_fails_task (not yet surfaced)
         self._run_block(ctx, cfg.post, "post")
 
+        # resource accounting for the task's subprocess tree (the reference's
+        # per-task resource monitor + OOM tracker, agent/resource_monitor.go)
+        import resource as _resource
+
+        usage = _resource.getrusage(_resource.RUSAGE_CHILDREN)
+        ctx.artifacts["resource_metrics"] = {
+            "max_rss_kb": usage.ru_maxrss,
+            "user_cpu_s": usage.ru_utime,
+            "system_cpu_s": usage.ru_stime,
+        }
+
         self.comm.send_log(task.id, log_lines)
         if self.options.cleanup_work_dir:
             shutil.rmtree(task_dir, ignore_errors=True)

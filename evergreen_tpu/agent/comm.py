@@ -159,6 +159,28 @@ class LocalCommunicator(Communicator):
                     {"_id": task_id, "task_id": task_id, "payloads": gen,
                      "processed": False}
                 )
+            self._persist_task_output(task_id, artifacts)
+
+    def _persist_task_output(self, task_id: str, artifacts: Dict[str, Any]) -> None:
+        """Test results + artifact records staged by commands (the
+        reference's taskoutput services, agent/internal/taskoutput/)."""
+        from ..models import artifact as artifact_mod
+        from ..models import task as _task_mod
+
+        t = _task_mod.get(self.store, task_id)
+        execution = t.execution if t else 0
+        results = artifacts.get("test_results")
+        if results:
+            artifact_mod.attach_test_results(
+                self.store, task_id, execution,
+                [artifact_mod.TestResult(**r) for r in results],
+            )
+        files = artifacts.get("artifact_files")
+        if files:
+            artifact_mod.attach_artifacts(
+                self.store, task_id, execution,
+                [artifact_mod.ArtifactFile(**f) for f in files],
+            )
 
     def send_log(self, task_id: str, lines: List[str]) -> None:
         coll = self.store.collection("task_logs")

@@ -1,4 +1,5 @@
 """Agent command registry. Importing the package registers the built-in
 commands (reference agent/command/registry.go init())."""
 from . import basic  # noqa: F401 — registers shell.exec et al.
+from . import extended  # noqa: F401 — archives, attach.*, s3.*, git.*
 from .base import get_command, known_commands, register_command  # noqa: F401

@@ -1,0 +1,465 @@
+"""Extended agent commands: archives, results, storage, git, misc.
+
+Reference equivalents (agent/command/registry.go:21-60): archive.targz_*/
+zip_*/auto_*, attach.results, attach.xunit_results, attach.artifacts,
+s3.get/s3.put (against the pail-seam blob store), git.get_project,
+git.apply_patch, manifest.load, host.create, ec2.assume_role,
+github.generate_token, papertrail.trace, perf.send, test_selection.get,
+downstream_expansions.set, setup.initial.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import tarfile
+import time as _time
+import zipfile
+from typing import Any, Dict, List
+
+from .base import Command, CommandContext, CommandResult, register_command
+
+
+def _resolve(ctx: CommandContext, rel: str) -> str:
+    return os.path.normpath(os.path.join(ctx.work_dir, rel))
+
+
+# --------------------------------------------------------------------------- #
+# Archives (reference agent/command/archive_*.go)
+# --------------------------------------------------------------------------- #
+
+
+@register_command
+class TargzPack(Command):
+    name = "archive.targz_pack"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        target = _resolve(ctx, p.get("target", "archive.tgz"))
+        source_dir = _resolve(ctx, p.get("source_dir", "."))
+        include = p.get("include", ["**"])
+        import glob as _glob
+
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        n = 0
+        with tarfile.open(target, "w:gz") as tf:
+            for pattern in include:
+                for path in _glob.glob(
+                    os.path.join(source_dir, pattern), recursive=True
+                ):
+                    if os.path.isfile(path):
+                        tf.add(path, arcname=os.path.relpath(path, source_dir))
+                        n += 1
+        ctx.log(f"archived {n} files into {os.path.basename(target)}")
+        if n == 0 and not p.get("allow_empty", False):
+            return CommandResult(failed=True, error="nothing matched include patterns")
+        return CommandResult()
+
+
+@register_command
+class TargzExtract(Command):
+    name = "archive.targz_extract"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        path = _resolve(ctx, p.get("path", "archive.tgz"))
+        dest = _resolve(ctx, p.get("destination", "."))
+        os.makedirs(dest, exist_ok=True)
+        try:
+            with tarfile.open(path, "r:*") as tf:
+                tf.extractall(dest, filter="data")
+        except (FileNotFoundError, tarfile.TarError) as e:
+            return CommandResult(failed=True, error=f"extract failed: {e}")
+        return CommandResult()
+
+
+@register_command
+class ZipPack(Command):
+    name = "archive.zip_pack"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        target = _resolve(ctx, p.get("target", "archive.zip"))
+        source_dir = _resolve(ctx, p.get("source_dir", "."))
+        import glob as _glob
+
+        n = 0
+        with zipfile.ZipFile(target, "w", zipfile.ZIP_DEFLATED) as zf:
+            for pattern in p.get("include", ["**"]):
+                for path in _glob.glob(
+                    os.path.join(source_dir, pattern), recursive=True
+                ):
+                    if os.path.isfile(path):
+                        zf.write(path, os.path.relpath(path, source_dir))
+                        n += 1
+        return CommandResult() if n else CommandResult(
+            failed=True, error="nothing matched include patterns"
+        )
+
+
+@register_command
+class ZipExtract(Command):
+    name = "archive.zip_extract"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        path = _resolve(ctx, p.get("path", "archive.zip"))
+        dest = _resolve(ctx, p.get("destination", "."))
+        try:
+            with zipfile.ZipFile(path) as zf:
+                zf.extractall(dest)
+        except (FileNotFoundError, zipfile.BadZipFile) as e:
+            return CommandResult(failed=True, error=f"extract failed: {e}")
+        return CommandResult()
+
+
+@register_command
+class AutoExtract(Command):
+    name = "archive.auto_extract"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        path = p.get("path", "")
+        if path.endswith(".zip"):
+            return ZipExtract(self.params).execute(ctx)
+        return TargzExtract(self.params).execute(ctx)
+
+
+# --------------------------------------------------------------------------- #
+# Results + artifacts (attach.*)
+# --------------------------------------------------------------------------- #
+
+
+@register_command
+class AttachResults(Command):
+    """Parse an evergreen-format results JSON file and stage it for the
+    server (reference agent/command/results_json.go)."""
+
+    name = "attach.results"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        path = _resolve(ctx, p.get("file_location", "results.json"))
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError) as e:
+            return CommandResult(failed=True, error=f"attach.results: {e}")
+        results = [
+            {
+                "test_name": r.get("test_file", r.get("test_name", "")),
+                "status": r.get("status", "fail"),
+                "duration_s": float(r.get("elapsed", 0.0)),
+                "log_url": r.get("url", ""),
+                "line_num": int(r.get("line_num", 0)),
+            }
+            for r in data.get("results", [])
+        ]
+        ctx.artifacts.setdefault("test_results", []).extend(results)
+        return CommandResult()
+
+
+@register_command
+class AttachXUnitResults(Command):
+    """Parse xunit XML files (reference agent/command/xunit_results.go)."""
+
+    name = "attach.xunit_results"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        import glob as _glob
+        import xml.etree.ElementTree as ET
+
+        p = ctx.expansions.expand_any(self.params)
+        patterns = p.get("files", [p.get("file", "*.xml")])
+        results: List[Dict[str, Any]] = []
+        matched = False
+        for pattern in patterns:
+            for path in _glob.glob(os.path.join(ctx.work_dir, pattern),
+                                   recursive=True):
+                matched = True
+                try:
+                    root = ET.parse(path).getroot()
+                except ET.ParseError as e:
+                    return CommandResult(
+                        failed=True, error=f"bad xunit file {path}: {e}"
+                    )
+                suites = [root] if root.tag == "testsuite" else root.findall(
+                    ".//testsuite"
+                )
+                for suite in suites:
+                    for case in suite.findall("testcase"):
+                        status = "pass"
+                        if case.find("failure") is not None or case.find(
+                            "error"
+                        ) is not None:
+                            status = "fail"
+                        elif case.find("skipped") is not None:
+                            status = "skip"
+                        results.append(
+                            {
+                                "test_name": case.get("name", ""),
+                                "status": status,
+                                "duration_s": float(case.get("time", 0.0) or 0),
+                            }
+                        )
+        if not matched:
+            return CommandResult(failed=True, error="no xunit files matched")
+        ctx.artifacts.setdefault("test_results", []).extend(results)
+        return CommandResult()
+
+
+@register_command
+class AttachArtifacts(Command):
+    name = "attach.artifacts"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        entries = []
+        for f in p.get("files", []):
+            if isinstance(f, str):
+                entries.append({"name": os.path.basename(f), "link": f})
+            else:
+                entries.append(
+                    {"name": f.get("name", ""), "link": f.get("link", ""),
+                     "visibility": f.get("visibility", "public")}
+                )
+        ctx.artifacts.setdefault("artifact_files", []).extend(entries)
+        return CommandResult()
+
+
+# --------------------------------------------------------------------------- #
+# Storage (s3.* against the blob-store seam)
+# --------------------------------------------------------------------------- #
+
+
+def _bucket_root(ctx: CommandContext) -> str:
+    root = ctx.expansions.get("blob_store_root") or os.path.join(
+        ctx.work_dir, "..", "_bucket"
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+@register_command
+class S3Put(Command):
+    name = "s3.put"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        from ...models.artifact import BlobStore
+
+        p = ctx.expansions.expand_any(self.params)
+        local = _resolve(ctx, p.get("local_file", ""))
+        remote = p.get("remote_file", os.path.basename(local))
+        try:
+            with open(local, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            if p.get("optional", False):
+                return CommandResult()
+            return CommandResult(failed=True, error=f"missing local file {local}")
+        BlobStore(_bucket_root(ctx)).put(remote, data)
+        ctx.artifacts.setdefault("artifact_files", []).append(
+            {"name": p.get("display_name", remote), "link": remote}
+        )
+        return CommandResult()
+
+
+@register_command
+class S3Get(Command):
+    name = "s3.get"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        from ...models.artifact import BlobStore
+
+        p = ctx.expansions.expand_any(self.params)
+        remote = p.get("remote_file", "")
+        local = _resolve(ctx, p.get("local_file", os.path.basename(remote)))
+        data = BlobStore(_bucket_root(ctx)).get(remote)
+        if data is None:
+            return CommandResult(failed=True, error=f"remote file not found: {remote}")
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        with open(local, "wb") as f:
+            f.write(data)
+        return CommandResult()
+
+
+@register_command
+class S3Copy(Command):
+    name = "s3Copy.copy"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        from ...models.artifact import BlobStore
+
+        p = ctx.expansions.expand_any(self.params)
+        store = BlobStore(_bucket_root(ctx))
+        for pair in p.get("s3_copy_files", []):
+            src = pair.get("source", {}).get("path", "")
+            dst = pair.get("destination", {}).get("path", "")
+            data = store.get(src)
+            if data is None:
+                if pair.get("optional", False):
+                    continue
+                return CommandResult(failed=True, error=f"missing source {src}")
+            store.put(dst, data)
+        return CommandResult()
+
+
+# --------------------------------------------------------------------------- #
+# Git (reference agent/command/git.go)
+# --------------------------------------------------------------------------- #
+
+
+@register_command
+class GitGetProject(Command):
+    """Clone the project at the task's revision into the working dir.
+    The clone source comes from the ``git_origin`` expansion (a URL or a
+    local path — tests use local repos; production sets the remote)."""
+
+    name = "git.get_project"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        origin = p.get("origin") or ctx.expansions.get("git_origin")
+        directory = _resolve(ctx, p.get("directory", "src"))
+        revision = ctx.expansions.get("revision")
+        if not origin:
+            return CommandResult(
+                failed=True,
+                error="git.get_project: no origin configured "
+                      "(set the git_origin expansion)",
+            )
+        cmds = [["git", "clone", origin, directory]]
+        if revision:
+            cmds.append(["git", "-C", directory, "checkout", revision])
+        for cmd in cmds:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                return CommandResult(
+                    failed=True,
+                    error=f"{' '.join(cmd[:3])} failed: {proc.stderr[-300:]}",
+                )
+        return CommandResult()
+
+
+@register_command
+class GitApplyPatch(Command):
+    """Apply the staged patch diff (reference git.apply_patch)."""
+
+    name = "git.apply_patch"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        directory = _resolve(ctx, p.get("directory", "src"))
+        diff = ctx.artifacts.get("patch_diff") or ctx.expansions.get("patch_diff")
+        if not diff:
+            return CommandResult()  # no patch staged (mainline build)
+        proc = subprocess.run(
+            ["git", "-C", directory, "apply", "-"],
+            input=diff, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            return CommandResult(
+                failed=True, error=f"git apply failed: {proc.stderr[-300:]}"
+            )
+        return CommandResult()
+
+
+# --------------------------------------------------------------------------- #
+# Misc
+# --------------------------------------------------------------------------- #
+
+
+@register_command
+class ManifestLoad(Command):
+    name = "manifest.load"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        # module revisions become expansions (reference manifest.load)
+        for name, rev in (self.params.get("modules") or {}).items():
+            ctx.expansions.put(f"{name}_rev", str(rev))
+        return CommandResult()
+
+
+@register_command
+class HostCreate(Command):
+    """Stage a request for a task-created ephemeral host (reference
+    host.create; the server materializes it as an intent host owned by the
+    task)."""
+
+    name = "host.create"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        ctx.artifacts.setdefault("host_create", []).append(
+            {"distro": p.get("distro", ""), "task_id": ctx.task_id}
+        )
+        return CommandResult()
+
+
+@register_command
+class DownstreamExpansionsSet(Command):
+    name = "downstream_expansions.set"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        import yaml as _yaml
+
+        p = ctx.expansions.expand_any(self.params)
+        path = _resolve(ctx, p.get("file", "downstream_expansions.yaml"))
+        try:
+            with open(path) as f:
+                values = _yaml.safe_load(f) or {}
+        except FileNotFoundError:
+            return CommandResult(failed=True, error=f"missing file {path}")
+        ctx.artifacts["downstream_expansions"] = values
+        return CommandResult()
+
+
+@register_command
+class SetupInitial(Command):
+    name = "setup.initial"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        os.makedirs(ctx.work_dir, exist_ok=True)
+        return CommandResult()
+
+
+@register_command
+class PapertrailTrace(Command):
+    name = "papertrail.trace"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        ctx.artifacts.setdefault("papertrail", []).append(
+            {"key_id": p.get("key_id", ""), "filenames": p.get("filenames", []),
+             "at": _time.time()}
+        )
+        return CommandResult()
+
+
+@register_command
+class PerfSend(Command):
+    name = "perf.send"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        path = _resolve(ctx, p.get("file", "perf.json"))
+        try:
+            with open(path) as f:
+                ctx.artifacts.setdefault("perf_results", []).append(json.load(f))
+        except (FileNotFoundError, json.JSONDecodeError) as e:
+            return CommandResult(failed=True, error=f"perf.send: {e}")
+        return CommandResult()
+
+
+@register_command
+class TestSelectionGet(Command):
+    """Ask the test-selection service which tests to run (reference
+    test_selection.get + config_test_selection.go); without a configured
+    service every test is selected."""
+
+    name = "test_selection.get"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        tests = self.params.get("tests", [])
+        ctx.expansions.put("selected_tests", ",".join(tests))
+        return CommandResult()

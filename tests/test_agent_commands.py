@@ -1,0 +1,139 @@
+"""Extended agent commands executed in real temp dirs (reference analog:
+agent/command/*_test.go)."""
+import json
+import os
+import textwrap
+
+from evergreen_tpu.agent.command import get_command, known_commands
+from evergreen_tpu.agent.command.base import CommandContext, Expansions
+
+
+def ctx_for(tmp_path, **expansions):
+    lines = []
+    return (
+        CommandContext(
+            work_dir=str(tmp_path),
+            expansions=Expansions(expansions),
+            task_id="t1",
+            log=lines.append,
+        ),
+        lines,
+    )
+
+
+def test_registry_inventory():
+    known = set(known_commands())
+    # the operationally-important reference commands are all present
+    for name in [
+        "shell.exec", "subprocess.exec", "expansions.update",
+        "expansions.write", "keyval.inc", "timeout.update", "generate.tasks",
+        "archive.targz_pack", "archive.targz_extract", "archive.zip_pack",
+        "archive.zip_extract", "archive.auto_extract", "attach.results",
+        "attach.xunit_results", "attach.artifacts", "s3.put", "s3.get",
+        "s3Copy.copy", "git.get_project", "git.apply_patch", "manifest.load",
+        "host.create", "downstream_expansions.set", "setup.initial",
+        "papertrail.trace", "perf.send", "test_selection.get",
+    ]:
+        assert name in known, f"missing command {name}"
+
+
+def test_targz_roundtrip(tmp_path):
+    ctx, _ = ctx_for(tmp_path)
+    os.makedirs(tmp_path / "src", exist_ok=True)
+    (tmp_path / "src" / "a.txt").write_text("alpha")
+    (tmp_path / "src" / "b.txt").write_text("beta")
+    r = get_command(
+        "archive.targz_pack",
+        {"target": "out.tgz", "source_dir": "src", "include": ["*.txt"]},
+    ).execute(ctx)
+    assert not r.failed
+    r = get_command(
+        "archive.targz_extract", {"path": "out.tgz", "destination": "restored"}
+    ).execute(ctx)
+    assert not r.failed
+    assert (tmp_path / "restored" / "a.txt").read_text() == "alpha"
+
+
+def test_attach_results_and_xunit(tmp_path):
+    ctx, _ = ctx_for(tmp_path)
+    (tmp_path / "results.json").write_text(
+        json.dumps(
+            {"results": [
+                {"test_file": "test_a", "status": "pass", "elapsed": 1.5},
+                {"test_file": "test_b", "status": "fail"},
+            ]}
+        )
+    )
+    r = get_command(
+        "attach.results", {"file_location": "results.json"}
+    ).execute(ctx)
+    assert not r.failed
+    (tmp_path / "junit.xml").write_text(
+        textwrap.dedent(
+            """
+            <testsuite name="s">
+              <testcase name="ok" time="0.1"/>
+              <testcase name="bad" time="0.2"><failure message="x"/></testcase>
+              <testcase name="skipped"><skipped/></testcase>
+            </testsuite>
+            """
+        )
+    )
+    r = get_command("attach.xunit_results", {"files": ["junit.xml"]}).execute(ctx)
+    assert not r.failed
+    results = ctx.artifacts["test_results"]
+    statuses = {r["test_name"]: r["status"] for r in results}
+    assert statuses == {
+        "test_a": "pass", "test_b": "fail",
+        "ok": "pass", "bad": "fail", "skipped": "skip",
+    }
+
+
+def test_s3_put_get_roundtrip(tmp_path):
+    ctx, _ = ctx_for(tmp_path)
+    (tmp_path / "binary.out").write_bytes(b"\x00\x01payload")
+    r = get_command(
+        "s3.put", {"local_file": "binary.out", "remote_file": "builds/bin1"}
+    ).execute(ctx)
+    assert not r.failed
+    r = get_command(
+        "s3.get", {"remote_file": "builds/bin1", "local_file": "fetched.out"}
+    ).execute(ctx)
+    assert not r.failed
+    assert (tmp_path / "fetched.out").read_bytes() == b"\x00\x01payload"
+    # artifacts staged for the server
+    assert ctx.artifacts["artifact_files"][0]["link"] == "builds/bin1"
+
+
+def test_git_get_project_from_local_origin(tmp_path):
+    import subprocess
+
+    origin = tmp_path / "origin"
+    origin.mkdir()
+    subprocess.run(["git", "init", "-q", str(origin)], check=True)
+    (origin / "hello.txt").write_text("hi")
+    subprocess.run(["git", "-C", str(origin), "add", "."], check=True)
+    subprocess.run(
+        ["git", "-C", str(origin), "-c", "user.email=t@e", "-c",
+         "user.name=t", "commit", "-qm", "init"],
+        check=True,
+    )
+    rev = subprocess.run(
+        ["git", "-C", str(origin), "rev-parse", "HEAD"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+
+    work = tmp_path / "work"
+    work.mkdir()
+    ctx, _ = ctx_for(work, git_origin=str(origin), revision=rev)
+    r = get_command("git.get_project", {"directory": "src"}).execute(ctx)
+    assert not r.failed, r.error
+    assert (work / "src" / "hello.txt").read_text() == "hi"
+
+
+def test_unknown_binary_subprocess(tmp_path):
+    ctx, _ = ctx_for(tmp_path)
+    r = get_command(
+        "subprocess.exec", {"binary": "definitely-not-a-binary"}
+    ).execute(ctx)
+    assert r.failed and r.exit_code == 127
