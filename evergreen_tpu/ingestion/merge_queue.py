@@ -1,0 +1,106 @@
+"""GitHub merge queue support.
+
+Reference: merge-group webhooks create versions per merge group
+(model/patch/github.go, units/merge_queue_patch_recovery.go, docs
+Merge-Queue.md). Merge-queue tasks carry the GITHUB_MERGE requester, which
+the planner boosts ahead of everything (scheduler/planner.go:299 +200
+priority, commit-queue factor) and the allocator counts 1:1
+(CountDepFilledMergeQueueTasks).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from ..globals import PatchStatus, Requester, VersionStatus
+from ..models import event as event_mod
+from ..models import version as version_mod
+from ..storage.store import Store
+from .patches import Patch, finalize_patch, get_patch, insert_patch
+from .repotracker import get_project_ref
+
+
+def enqueue_merge_group(
+    store: Store,
+    project: str,
+    head_sha: str,
+    head_ref: str,
+    config_yaml: str,
+    now: Optional[float] = None,
+) -> Optional[str]:
+    """A merge-group webhook event → an immediately-finalized merge patch
+    (reference rest/route/github.go merge_group handling)."""
+    now = _time.time() if now is None else now
+    ref = get_project_ref(store, project)
+    if ref is None or not ref.enabled:
+        return None
+    patch_id = f"mg-{project}-{head_sha[:10]}"
+    if get_patch(store, patch_id) is not None:
+        return patch_id  # duplicate delivery
+    insert_patch(
+        store,
+        Patch(
+            id=patch_id,
+            project=project,
+            author="github-merge-queue",
+            description=f"merge group {head_ref}",
+            githash=head_sha,
+            variants=["*"],
+            tasks=["*"],
+            requester=Requester.GITHUB_MERGE.value,
+            config_yaml=config_yaml,
+            create_time=now,
+        ),
+    )
+    created = finalize_patch(store, patch_id, now=now)
+    if created is None:
+        return None
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_PATCH,
+        "MERGE_GROUP_ENQUEUED",
+        patch_id,
+        {"version": created.version.id, "head_ref": head_ref},
+        timestamp=now,
+    )
+    return patch_id
+
+
+def recover_stuck_merge_queue(
+    store: Store, now: Optional[float] = None, stuck_after_s: float = 4 * 3600.0
+) -> List[str]:
+    """Fail merge-queue patches whose version has been running too long so
+    GitHub unblocks the queue (reference units/merge_queue_patch_recovery.go).
+    """
+    now = _time.time() if now is None else now
+    recovered: List[str] = []
+    for doc in store.collection("patches").find(
+        lambda d: d.get("requester") == Requester.GITHUB_MERGE.value
+        and d.get("status") == PatchStatus.STARTED.value
+        and 0 < d.get("start_time", 0.0) < now - stuck_after_s
+    ):
+        v = version_mod.get(store, doc.get("version", ""))
+        if v is not None and v.status in (
+            VersionStatus.SUCCEEDED.value,
+            VersionStatus.FAILED.value,
+        ):
+            final = (
+                PatchStatus.SUCCEEDED.value
+                if v.status == VersionStatus.SUCCEEDED.value
+                else PatchStatus.FAILED.value
+            )
+        else:
+            final = PatchStatus.FAILED.value
+        store.collection("patches").update(
+            doc["_id"], {"status": final, "finish_time": now}
+        )
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_PATCH,
+            "MERGE_QUEUE_PATCH_RECOVERED",
+            doc["_id"],
+            {"final_status": final},
+            timestamp=now,
+        )
+        recovered.append(doc["_id"])
+    return recovered

@@ -253,6 +253,16 @@ def run_tick(
         new_hosts = {d: r[2] for d, r in results.items()}
         sort_values = {d: r[3] for d, r in results.items()}
 
+    # Single-task distros allocate 1:1 with dependency-met tasks (reference
+    # units/host_allocator.go:174-181), bypassing the utilization heuristic.
+    for d in distros:
+        if getattr(d, "single_task_distro", False) and d.id in new_hosts:
+            info = infos.get(d.id)
+            demand = info.length_with_dependencies_met if info else 0
+            existing = len(hosts_by_distro.get(d.id, []))
+            cap = d.host_allocator_settings.maximum_hosts or demand
+            new_hosts[d.id] = max(0, min(demand, cap - existing))
+
     # Persist queues + create intent hosts (scheduler/scheduler.go:176-220),
     # honoring the global intent-host cap (units/host_allocator.go:35).
     n_intents_in_flight = host_mod.coll(store).count(

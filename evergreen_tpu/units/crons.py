@@ -212,7 +212,21 @@ def hourly_jobs(store: Store, now: float) -> List[Job]:
             job_type="distro-auto-tune",
         )
     )
+    jobs.append(
+        FnJob(
+            f"merge-queue-recovery-{now:.3f}",
+            _recover_merge_queue,
+            scopes=["merge-queue-recovery"],
+            job_type="merge-queue-recovery",
+        )
+    )
     return jobs
+
+
+def _recover_merge_queue(s: Store) -> None:
+    from ..ingestion.merge_queue import recover_stuck_merge_queue
+
+    recover_stuck_merge_queue(s)
 
 
 def build_cron_runner(store: Store, queue: JobQueue) -> CronRunner:

@@ -1,0 +1,83 @@
+"""Merge queue: merge-group enqueue, planner boost, recovery job."""
+import textwrap
+
+from evergreen_tpu.globals import PatchStatus, Requester
+from evergreen_tpu.ingestion.merge_queue import (
+    enqueue_merge_group,
+    recover_stuck_merge_queue,
+)
+from evergreen_tpu.ingestion.repotracker import ProjectRef, upsert_project_ref
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import task_queue as tq_mod
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+NOW = 1_700_000_000.0
+
+CONFIG = textwrap.dedent(
+    """
+    tasks:
+      - name: verify
+        commands: [{command: shell.exec, params: {script: "true"}}]
+    buildvariants:
+      - name: lin
+        run_on: [d1]
+        tasks: [{name: verify}]
+    """
+)
+
+
+def test_merge_group_outranks_mainline(store):
+    upsert_project_ref(store, ProjectRef(id="proj"))
+    distro_mod.insert(
+        store,
+        Distro(id="d1",
+               host_allocator_settings=HostAllocatorSettings(maximum_hosts=5)),
+    )
+    # a mainline task already queued
+    task_mod.insert(
+        store,
+        task_mod.Task(
+            id="mainline-task", distro_id="d1", project="proj",
+            status="undispatched", activated=True,
+            requester=Requester.REPOTRACKER.value,
+            activated_time=NOW - 30, create_time=NOW - 60,
+            expected_duration_s=60,
+        ),
+    )
+    pid = enqueue_merge_group(
+        store, "proj", "cafecafe01", "gh-readonly-queue/main/pr-7",
+        CONFIG, now=NOW,
+    )
+    assert pid is not None
+    # duplicate delivery is idempotent
+    assert enqueue_merge_group(
+        store, "proj", "cafecafe01", "gh-readonly-queue/main/pr-7",
+        CONFIG, now=NOW,
+    ) == pid
+
+    merge_tasks = [
+        t for t in task_mod.find(store)
+        if t.requester == Requester.GITHUB_MERGE.value
+    ]
+    assert merge_tasks, "merge group should create tasks"
+
+    run_tick(store, TickOptions(create_intent_hosts=False), now=NOW)
+    q = tq_mod.load(store, "d1")
+    # the merge-queue task planned ahead of the mainline task (commit-queue
+    # priority boost, scheduler/planner.go:299)
+    assert q.queue[0].id == merge_tasks[0].id
+    assert q.queue[-1].id == "mainline-task"
+
+
+def test_merge_queue_recovery(store):
+    upsert_project_ref(store, ProjectRef(id="proj"))
+    enqueue_merge_group(store, "proj", "beefbeef02", "q/main/pr-9", CONFIG,
+                        now=NOW)
+    # not stuck yet
+    assert recover_stuck_merge_queue(store, NOW + 60) == []
+    recovered = recover_stuck_merge_queue(store, NOW + 5 * 3600)
+    assert len(recovered) == 1
+    doc = store.collection("patches").get(recovered[0])
+    assert doc["status"] == PatchStatus.FAILED.value
