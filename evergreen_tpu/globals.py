@@ -119,13 +119,17 @@ PATCH_REQUESTERS = frozenset(
     {Requester.PATCH.value, Requester.GITHUB_PR.value, Requester.GITHUB_MERGE.value}
 )
 
+#: plain-string constant — enum attribute access costs show up in the
+#: 50k-task snapshot hot loop
+GITHUB_MERGE_REQUESTER = Requester.GITHUB_MERGE.value
+
 
 def is_patch_requester(requester: str) -> bool:
     return requester in PATCH_REQUESTERS
 
 
 def is_github_merge_queue_requester(requester: str) -> bool:
-    return requester == Requester.GITHUB_MERGE.value
+    return requester == GITHUB_MERGE_REQUESTER
 
 
 def is_mainline_requester(requester: str) -> bool:
