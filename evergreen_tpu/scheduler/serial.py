@@ -220,9 +220,13 @@ def plan_distro_queue(
     out: List[Task] = []
     sort_values: Dict[str, float] = {}
     seen: set = set()
+    # Final tie-break: task creation index. The reference's within-unit
+    # ordering on full ties is nondeterministic (Unit.tasks is a Go map);
+    # both of our paths pin it to the queue's task order.
+    index = {t.id: i for i, t in enumerate(tasks)}
     for val, _, u in scored:
         members = [by_id[i] for i in u.task_ids]
-        members.sort(key=_task_list_key)
+        members.sort(key=lambda t: (*_task_list_key(t), index[t.id]))
         for t in members:
             if t.id in seen:
                 continue

@@ -83,10 +83,16 @@ def random_problem(rng: random.Random, n_distros=3, max_tasks=40, max_hosts=10):
                 activated=True,
                 requester=requester,
                 priority=rng.choice([0, 0, 1, 50, 100]),
-                activated_time=NOW - rng.uniform(0, 3e5),
+                # zeros exercise the fallback branches (ingest-time basis,
+                # zero-wait, default duration) in both solver paths
+                activated_time=rng.choice(
+                    [0.0, NOW - rng.uniform(0, 3e5), NOW - rng.uniform(0, 3e5)]
+                ),
                 create_time=NOW - 4e5,
-                scheduled_time=NOW - rng.uniform(0, 4e3),
-                dependencies_met_time=NOW - rng.uniform(0, 4e3),
+                scheduled_time=rng.choice([0.0, NOW - rng.uniform(0, 4e3)]),
+                dependencies_met_time=rng.choice(
+                    [0.0, NOW - rng.uniform(0, 4e3)]
+                ),
                 task_group=f"tg{group_id}" if in_group else "",
                 # max-hosts is uniform per group in reality (it comes from the
                 # task_group YAML definition) — keep the fixture consistent.
@@ -97,7 +103,9 @@ def random_problem(rng: random.Random, n_distros=3, max_tasks=40, max_hosts=10):
                 if rng.random() < 0.1
                 else "",
                 num_dependents=rng.choice([0, 0, 1, 7]),
-                expected_duration_s=rng.uniform(10, 4000),
+                expected_duration_s=rng.choice(
+                    [0.0, rng.uniform(10, 4000), rng.uniform(10, 4000)]
+                ),
             )
             if ti > 0 and rng.random() < 0.3:
                 dep = tasks[rng.randrange(len(tasks))]
