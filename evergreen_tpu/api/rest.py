@@ -145,6 +145,8 @@ class RestApi:
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)/tasks", self.version_tasks)
         r("GET", r"/rest/v2/builds/(?P<build>[^/]+)", self.get_build)
         r("GET", r"/rest/v2/projects", self.list_projects)
+        r("PUT", r"/rest/v2/projects/(?P<project>[^/]+)", self.put_project)
+        r("PUT", r"/rest/v2/distros/(?P<distro>[^/]+)", self.put_distro)
         r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/revisions", self.push_revision)
         r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/validate", self.validate)
 
@@ -313,6 +315,60 @@ class RestApi:
         return 200, self.store.collection(
             repotracker_mod.PROJECT_REFS_COLLECTION
         ).find()
+
+    def put_project(self, method, match, body):
+        """Create/update a project ref (reference rest/route project
+        settings routes)."""
+        import dataclasses as _dc
+
+        ref = repotracker_mod.get_project_ref(
+            self.store, match["project"]
+        ) or repotracker_mod.ProjectRef(id=match["project"])
+        known = {f.name for f in _dc.fields(ref)} - {"id"}
+        for k, v in body.items():
+            if k not in known:
+                raise ApiError(400, f"unknown project field {k!r}")
+            setattr(ref, k, v)
+        repotracker_mod.upsert_project_ref(self.store, ref)
+        return 200, ref.to_doc()
+
+    def put_distro(self, method, match, body):
+        """Create/update a distro (reference rest/route/distro.go)."""
+        import dataclasses as _dc
+
+        from ..models.distro import (
+            DispatcherSettings,
+            FinderSettings,
+            HostAllocatorSettings,
+            PlannerSettings,
+        )
+
+        d = distro_mod.get(self.store, match["distro"]) or distro_mod.Distro(
+            id=match["distro"]
+        )
+        subsections = {
+            "planner_settings": PlannerSettings,
+            "host_allocator_settings": HostAllocatorSettings,
+            "dispatcher_settings": DispatcherSettings,
+            "finder_settings": FinderSettings,
+        }
+        known = {f.name for f in _dc.fields(d)} - {"id"}
+        for k, v in body.items():
+            if k not in known:
+                raise ApiError(400, f"unknown distro field {k!r}")
+            if k in subsections and isinstance(v, dict):
+                current = getattr(d, k)
+                sub_known = {f.name for f in _dc.fields(current)}
+                for sk, sv in v.items():
+                    if sk not in sub_known:
+                        raise ApiError(
+                            400, f"unknown field {sk!r} in {k!r}"
+                        )
+                    setattr(current, sk, sv)
+            else:
+                setattr(d, k, v)
+        distro_mod.upsert(self.store, d)
+        return 200, d.to_doc()
 
     def push_revision(self, method, match, body):
         created = repotracker_mod.store_revisions(

@@ -1,0 +1,9 @@
+"""Cloud provider managers. Importing the package registers every built-in
+provider with the manager factory (reference cloud/cloud.go:147-177
+GetManager switch covers all providers unconditionally)."""
+from . import manager  # noqa: F401
+from . import docker  # noqa: F401
+from . import ec2_fleet  # noqa: F401
+from . import mock  # noqa: F401
+from . import static  # noqa: F401
+from .manager import CloudManager, get_manager, register_manager  # noqa: F401
