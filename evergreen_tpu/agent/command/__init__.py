@@ -2,4 +2,5 @@
 commands (reference agent/command/registry.go init())."""
 from . import basic  # noqa: F401 — registers shell.exec et al.
 from . import extended  # noqa: F401 — archives, attach.*, s3.*, git.*
+from . import caching  # noqa: F401 — cache.*, gotest, host.list, credentials
 from .base import get_command, known_commands, register_command  # noqa: F401

@@ -1,0 +1,161 @@
+"""Remaining registry commands: build caches, go test parsing, host
+listing, credential helpers.
+
+Reference equivalents: cache.save/cache.restore (agent/command/cache.go —
+keyed directory caches in bucket storage), gotest.parse_files
+(agent/command/gotest.go), host.list (agent/command/host_list.go),
+ec2.assume_role + github.generate_token (credential brokering — the broker
+is a pluggable seam; defaults mint scoped placeholder credentials so task
+scripts exercise the flow without cloud access).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import io
+import os
+import re
+import tarfile
+import time as _time
+import uuid
+
+from .base import Command, CommandContext, CommandResult, register_command
+from .extended import _bucket_root, _resolve
+
+
+@register_command
+class CacheSave(Command):
+    name = "cache.save"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        from ...models.artifact import BlobStore
+
+        p = ctx.expansions.expand_any(self.params)
+        key = p.get("key", "")
+        if not key:
+            return CommandResult(failed=True, error="cache.save requires a key")
+        paths = p.get("paths", [p.get("path", "")])
+        buf = io.BytesIO()
+        n = 0
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            for rel in paths:
+                src = _resolve(ctx, rel)
+                if os.path.isdir(src):
+                    tf.add(src, arcname=rel)
+                    n += 1
+                elif os.path.isfile(src):
+                    tf.add(src, arcname=rel)
+                    n += 1
+        if n == 0:
+            return CommandResult(failed=True, error="cache.save matched nothing")
+        BlobStore(_bucket_root(ctx)).put(f"cache/{key}", buf.getvalue())
+        ctx.log(f"saved cache {key!r} ({n} entries)")
+        return CommandResult()
+
+
+@register_command
+class CacheRestore(Command):
+    name = "cache.restore"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        from ...models.artifact import BlobStore
+
+        p = ctx.expansions.expand_any(self.params)
+        key = p.get("key", "")
+        data = BlobStore(_bucket_root(ctx)).get(f"cache/{key}")
+        hit = data is not None
+        ctx.expansions.put("cache_hit", "true" if hit else "false")
+        if not hit:
+            ctx.log(f"cache miss for {key!r}")
+            return CommandResult()  # a miss is not a failure
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tf:
+            tf.extractall(ctx.work_dir, filter="data")
+        ctx.log(f"restored cache {key!r}")
+        return CommandResult()
+
+
+_GOTEST_RUN = re.compile(r"^=== RUN\s+(\S+)")
+_GOTEST_RESULT = re.compile(r"^--- (PASS|FAIL|SKIP):\s+(\S+)\s+\(([\d.]+)s\)")
+
+
+@register_command
+class GotestParseFiles(Command):
+    name = "gotest.parse_files"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        results = []
+        matched = False
+        for pattern in p.get("files", []):
+            for path in _glob.glob(os.path.join(ctx.work_dir, pattern),
+                                   recursive=True):
+                matched = True
+                with open(path, errors="replace") as f:
+                    for line in f:
+                        m = _GOTEST_RESULT.match(line.strip())
+                        if m:
+                            status = {"PASS": "pass", "FAIL": "fail",
+                                      "SKIP": "skip"}[m.group(1)]
+                            results.append(
+                                {
+                                    "test_name": m.group(2),
+                                    "status": status,
+                                    "duration_s": float(m.group(3)),
+                                }
+                            )
+        if not matched:
+            return CommandResult(failed=True, error="no gotest files matched")
+        ctx.artifacts.setdefault("test_results", []).extend(results)
+        return CommandResult()
+
+
+@register_command
+class HostList(Command):
+    """Expose hosts created by this task via host.create (reference
+    host.list waits for task-created hosts)."""
+
+    name = "host.list"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        import json
+
+        created = ctx.artifacts.get("host_create", [])
+        path = self.params.get("path", "")
+        if path:
+            full = _resolve(ctx, path)
+            with open(full, "w") as f:
+                json.dump(created, f)
+        ctx.expansions.put("num_hosts", str(len(created)))
+        return CommandResult()
+
+
+@register_command
+class EC2AssumeRole(Command):
+    """Credential brokering seam (reference ec2.assume_role brokered via
+    the app server's STS access)."""
+
+    name = "ec2.assume_role"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        role_arn = p.get("role_arn", "")
+        if not role_arn:
+            return CommandResult(failed=True, error="role_arn is required")
+        session = uuid.uuid4().hex
+        ctx.expansions.put("AWS_ACCESS_KEY_ID", f"ASIA{session[:16].upper()}")
+        ctx.expansions.put("AWS_SECRET_ACCESS_KEY", session)
+        ctx.expansions.put("AWS_SESSION_TOKEN", f"token-{session}")
+        ctx.expansions.put("aws_role_expiration",
+                           str(_time.time() + 15 * 60))
+        ctx.log(f"assumed role {role_arn} (brokered)")
+        return CommandResult()
+
+
+@register_command
+class GithubGenerateToken(Command):
+    name = "github.generate_token"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        dest = p.get("expansion_name", "github_token")
+        ctx.expansions.put(dest, f"ghs_{uuid.uuid4().hex}")
+        return CommandResult()

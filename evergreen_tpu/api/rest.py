@@ -47,12 +47,54 @@ class ApiError(Exception):
 Handler = Callable[[str, re.Match, dict], Tuple[int, Any]]
 
 
+#: route prefixes the agent protocol uses (host-credentialed in the
+#: reference; exempt from user-key auth)
+_AGENT_PATHS = re.compile(r"^/rest/v2/(hosts/[^/]+/agent/|tasks/[^/]+/agent/)")
+_ADMIN_PATHS = re.compile(r"^/rest/v2/(admin/|distros/[^/]+$|projects/[^/]+$)")
+
+
 class RestApi:
-    def __init__(self, store: Store, dispatcher_service: Optional[DispatcherService] = None) -> None:
+    def __init__(
+        self,
+        store: Store,
+        dispatcher_service: Optional[DispatcherService] = None,
+        require_auth: bool = False,
+        rate_limit_per_min: int = 0,
+    ) -> None:
         self.store = store
         self.svc = dispatcher_service or DispatcherService(store)
+        self.require_auth = require_auth
+        self._rate_limiter = None
+        if rate_limit_per_min:
+            from ..models.user import RateLimiter
+
+            self._rate_limiter = RateLimiter(store, rate_limit_per_min)
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
         self._register_routes()
+
+    def _authorize(
+        self, method: str, path: str, headers: Dict[str, str]
+    ) -> Optional[Tuple[int, Any]]:
+        """API-key auth + role gating (reference: gimlet auth middleware +
+        role manager, environment.go:1249; agent routes use host
+        credentials instead of user keys)."""
+        if self._rate_limiter is not None:
+            key = headers.get("api-user") or headers.get("x-forwarded-for", "anon")
+            if not self._rate_limiter.allow(key):
+                return 429, {"error": "rate limit exceeded"}
+        if not self.require_auth or _AGENT_PATHS.match(path):
+            return None
+        from ..models import user as user_mod
+
+        u = user_mod.user_by_api_key(self.store, headers.get("api-key", ""))
+        if u is None or u.id != headers.get("api-user", u.id):
+            return 401, {"error": "invalid or missing API credentials"}
+        mutating = method in ("POST", "PUT", "PATCH", "DELETE")
+        if mutating and _ADMIN_PATHS.match(path) and not u.has_scope(
+            user_mod.SCOPE_SUPERUSER
+        ):
+            return 403, {"error": "admin scope required"}
+        return None
 
     # ------------------------------------------------------------------ #
     # plumbing
@@ -62,9 +104,16 @@ class RestApi:
         self._routes.append((method, re.compile(f"^{pattern}$"), handler))
 
     def handle(
-        self, method: str, path: str, body: Optional[dict] = None
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Any]:
         body = body or {}
+        denied = self._authorize(method, path, headers or {})
+        if denied is not None:
+            return denied
         for m, pattern, handler in self._routes:
             if m != method:
                 continue
@@ -92,9 +141,16 @@ class RestApi:
             except json.JSONDecodeError:
                 start_response("400 Bad Request", [("Content-Type", JSON)])
                 return [json.dumps({"error": "invalid JSON body"}).encode()]
-        status, payload = self.handle(method, path, body)
+        headers = {
+            k[5:].replace("_", "-").lower(): v
+            for k, v in environ.items()
+            if k.startswith("HTTP_")
+        }
+        status, payload = self.handle(method, path, body, headers)
         reason = {200: "OK", 201: "Created", 400: "Bad Request",
-                  404: "Not Found", 409: "Conflict", 503: "Service Unavailable"}
+                  401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+                  409: "Conflict", 429: "Too Many Requests",
+                  503: "Service Unavailable"}
         start_response(
             f"{status} {reason.get(status, 'OK')}", [("Content-Type", JSON)]
         )

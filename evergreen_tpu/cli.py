@@ -22,7 +22,11 @@ def cmd_service(args) -> int:
     from .units.crons import build_cron_runner
 
     store = global_store()
-    api = RestApi(store)
+    api = RestApi(
+        store,
+        require_auth=args.require_auth,
+        rate_limit_per_min=args.rate_limit,
+    )
     queue = JobQueue(store, workers=args.workers)
     runner = build_cron_runner(store, queue)
     runner.run_background()
@@ -162,6 +166,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=9090)
     s.add_argument("--workers", type=int, default=8)
+    s.add_argument("--require-auth", action="store_true",
+                   help="require API keys on user routes")
+    s.add_argument("--rate-limit", type=int, default=0,
+                   help="requests/min per user (0 = unlimited)")
     s.set_defaults(fn=cmd_service)
 
     a = sub.add_parser("agent", help="run a worker agent")

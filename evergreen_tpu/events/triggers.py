@@ -180,16 +180,71 @@ def register_sender(subscriber_type: str, sender: Sender) -> None:
     _SENDERS[subscriber_type] = sender
 
 
+DOWNSTREAM_TRIGGERS_COLLECTION = "project_triggers"
+
+
+def define_downstream_trigger(
+    store: Store,
+    upstream_project: str,
+    downstream_project: str,
+    config_yaml: str,
+    on: str = TRIGGER_SUCCESS,
+) -> None:
+    """Cross-project build trigger: upstream version outcome → downstream
+    version (reference trigger/process.go:111 EvalProjectTriggers)."""
+    store.collection(DOWNSTREAM_TRIGGERS_COLLECTION).upsert(
+        {
+            "_id": f"{upstream_project}->{downstream_project}",
+            "upstream": upstream_project,
+            "downstream": downstream_project,
+            "config_yaml": config_yaml,
+            "on": on,
+        }
+    )
+
+
+def _eval_project_triggers(store: Store, ev: Event, now: float) -> None:
+    if ev.resource_type != event_mod.RESOURCE_VERSION:
+        return
+    fired = _event_triggers(store, ev)
+    v = store.collection("versions").get(ev.resource_id)
+    if v is None:
+        return
+    from ..globals import Requester
+    from ..ingestion.repotracker import Revision, store_revisions
+
+    for doc in store.collection(DOWNSTREAM_TRIGGERS_COLLECTION).find(
+        lambda d: d["upstream"] == v["project"]
+    ):
+        if doc["on"] not in fired:
+            continue
+        store_revisions(
+            store,
+            doc["downstream"],
+            [
+                Revision(
+                    revision=f"trigger-{ev.resource_id[:20]}",
+                    message=f"triggered by upstream {ev.resource_id}",
+                    config_yaml=doc["config_yaml"],
+                )
+            ],
+            now=now,
+            requester=Requester.TRIGGER.value,
+        )
+
+
 def process_unprocessed_events(
     store: Store, now: Optional[float] = None, limit: int = 0
 ) -> int:
     """The event-notifier job (units/event_notifier.go:64-101): scan the
-    unprocessed event log, create + deliver notifications, mark processed.
+    unprocessed event log, create + deliver notifications, evaluate
+    downstream project triggers, mark processed.
     """
     now = _time.time() if now is None else now
     coll = store.collection(NOTIFICATIONS_COLLECTION)
     n = 0
     for ev in event_mod.find_unprocessed(store, limit):
+        _eval_project_triggers(store, ev, now)
         for ntf in notifications_from_event(store, ev):
             sender = _SENDERS.get(ntf.subscriber_type)
             error = ""

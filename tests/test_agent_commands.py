@@ -137,3 +137,52 @@ def test_unknown_binary_subprocess(tmp_path):
         "subprocess.exec", {"binary": "definitely-not-a-binary"}
     ).execute(ctx)
     assert r.failed and r.exit_code == 127
+
+
+def test_cache_save_restore_roundtrip(tmp_path):
+    bucket = str(tmp_path / "bucket")
+    work1 = tmp_path / "w1"
+    work1.mkdir()
+    ctx, _ = ctx_for(work1, blob_store_root=bucket)
+    os.makedirs(work1 / "deps", exist_ok=True)
+    (work1 / "deps" / "lib.bin").write_bytes(b"cached-bytes")
+    r = get_command("cache.save", {"key": "deps-v1", "paths": ["deps"]}).execute(ctx)
+    assert not r.failed, r.error
+
+    # a fresh working dir restores from the same bucket
+    work2 = tmp_path / "w2"
+    work2.mkdir()
+    ctx2, _ = ctx_for(work2, blob_store_root=bucket)
+    r = get_command("cache.restore", {"key": "deps-v1"}).execute(ctx2)
+    assert not r.failed
+    assert ctx2.expansions.get("cache_hit") == "true"
+    assert (work2 / "deps" / "lib.bin").read_bytes() == b"cached-bytes"
+    # miss is not a failure
+    r = get_command("cache.restore", {"key": "nope"}).execute(ctx2)
+    assert not r.failed
+    assert ctx2.expansions.get("cache_hit") == "false"
+
+
+def test_gotest_parse_files(tmp_path):
+    ctx, _ = ctx_for(tmp_path)
+    (tmp_path / "gotest.out").write_text(
+        "=== RUN   TestAlpha\n--- PASS: TestAlpha (0.03s)\n"
+        "=== RUN   TestBeta\n--- FAIL: TestBeta (1.20s)\n"
+        "--- SKIP: TestGamma (0.00s)\nFAIL\n"
+    )
+    r = get_command("gotest.parse_files", {"files": ["gotest.out"]}).execute(ctx)
+    assert not r.failed
+    statuses = {x["test_name"]: x["status"] for x in ctx.artifacts["test_results"]}
+    assert statuses == {"TestAlpha": "pass", "TestBeta": "fail",
+                       "TestGamma": "skip"}
+
+
+def test_credential_commands(tmp_path):
+    ctx, _ = ctx_for(tmp_path)
+    r = get_command("ec2.assume_role", {"role_arn": "arn:aws:iam::1:role/x"}).execute(ctx)
+    assert not r.failed
+    assert ctx.expansions.get("AWS_ACCESS_KEY_ID").startswith("ASIA")
+    r = get_command("github.generate_token", {}).execute(ctx)
+    assert ctx.expansions.get("github_token").startswith("ghs_")
+    r = get_command("ec2.assume_role", {}).execute(ctx)
+    assert r.failed

@@ -165,3 +165,41 @@ def test_404_and_bad_json(store, server):
     assert out.get("_status") == 404
     out = comm._call("GET", "/rest/v2/not/a/route")
     assert out.get("_status") == 404
+
+
+def test_auth_enforcement(store):
+    from evergreen_tpu.models import user as user_mod
+
+    api = RestApi(store, require_auth=True)
+    # anonymous user route → 401
+    status, _ = api.handle("GET", "/rest/v2/status", {}, {})
+    assert status == 401
+    # agent routes stay host-credentialed (exempt)
+    status, _ = api.handle(
+        "GET", "/rest/v2/hosts/h1/agent/next_task", {}, {}
+    )
+    assert status in (404, 200)  # not 401
+    # valid key passes; admin mutation needs superuser
+    u = user_mod.create_user(store, "dev")
+    hdrs = {"api-user": "dev", "api-key": u.api_key}
+    status, _ = api.handle("GET", "/rest/v2/status", {}, hdrs)
+    assert status == 200
+    status, _ = api.handle(
+        "POST", "/rest/v2/admin/settings",
+        {"service_flags": {"scheduler_disabled": True}}, hdrs,
+    )
+    assert status == 403
+    user_mod.grant_role(store, "dev", user_mod.SCOPE_SUPERUSER)
+    status, _ = api.handle(
+        "POST", "/rest/v2/admin/settings",
+        {"service_flags": {"scheduler_disabled": True}}, hdrs,
+    )
+    assert status == 200
+
+
+def test_rate_limited_api(store):
+    api = RestApi(store, rate_limit_per_min=2)
+    hdrs = {"api-user": "x"}
+    assert api.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 200
+    assert api.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 200
+    assert api.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 429

@@ -1,0 +1,116 @@
+"""Concurrency invariants: the CAS-based dispatch plane under parallel
+agents (the reference's -race + atomic RunningTask assignment guarantees,
+rest/route/host_agent.go:311-420)."""
+import threading
+import time
+
+from evergreen_tpu.dispatch.assign import assign_next_available_task
+from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+from evergreen_tpu.globals import HostStatus, TaskStatus
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import task_queue as tq_mod
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.lifecycle import mark_end, mark_task_started
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+
+NOW = 1_700_000_000.0
+N_TASKS = 60
+N_HOSTS = 12
+
+
+def seed(store):
+    tasks = [
+        Task(
+            id=f"t{i:03d}", distro_id="d1", status=TaskStatus.UNDISPATCHED.value,
+            activated=True, expected_duration_s=10,
+        )
+        for i in range(N_TASKS)
+    ]
+    task_mod.insert_many(store, tasks)
+    tq_mod.save(
+        store,
+        TaskQueue(
+            distro_id="d1",
+            queue=[TaskQueueItem(id=t.id, dependencies_met=True) for t in tasks],
+            generated_at=NOW,
+        ),
+    )
+    hosts = [
+        Host(id=f"h{i}", distro_id="d1", status=HostStatus.RUNNING.value)
+        for i in range(N_HOSTS)
+    ]
+    for h in hosts:
+        host_mod.insert(store, h)
+    return hosts
+
+
+def test_parallel_agents_never_double_dispatch(store):
+    hosts = seed(store)
+    svc = DispatcherService(store)
+    dispatched = []
+    lock = threading.Lock()
+    errors = []
+
+    def agent_loop(host_id):
+        try:
+            while True:
+                h = host_mod.get(store, host_id)
+                t = assign_next_available_task(store, svc, h, NOW)
+                if t is None:
+                    # re-poll a few times in case of CAS-bail races
+                    time.sleep(0.002)
+                    h = host_mod.get(store, host_id)
+                    t = assign_next_available_task(store, svc, h, NOW)
+                    if t is None:
+                        return
+                with lock:
+                    dispatched.append(t.id)
+                mark_task_started(store, t.id)
+                mark_end(store, t.id, TaskStatus.SUCCEEDED.value, now=NOW)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=agent_loop, args=(h.id,)) for h in hosts
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    # every task dispatched exactly once
+    assert len(dispatched) == len(set(dispatched)) == N_TASKS
+    # all finished, all hosts free
+    assert all(
+        t.status == TaskStatus.SUCCEEDED.value for t in task_mod.find(store)
+    )
+    assert all(
+        host_mod.get(store, h.id).is_free() for h in hosts
+    )
+    # per-host task counts sum correctly
+    total = sum(host_mod.get(store, h.id).task_count for h in hosts)
+    assert total == N_TASKS
+
+
+def test_concurrent_job_queue_scope_exclusivity(store):
+    from evergreen_tpu.queue.jobs import FnJob, JobQueue
+
+    q = JobQueue(store, workers=8)
+    active = {"n": 0, "max": 0}
+    lock = threading.Lock()
+
+    def critical(s):
+        with lock:
+            active["n"] += 1
+            active["max"] = max(active["max"], active["n"])
+        time.sleep(0.01)
+        with lock:
+            active["n"] -= 1
+
+    for i in range(20):
+        q.put(FnJob(f"crit-{i}", critical, scopes=["the-scope"]))
+    assert q.wait_idle(30)
+    assert active["max"] == 1, "scope lock must serialize jobs"
+    q.close()

@@ -241,3 +241,37 @@ def test_auto_tune_from_host_stats(store):
     d = distro_mod.get(store, "d1")
     # peak 7 × 1.25 headroom + 1 = 9
     assert d.host_allocator_settings.maximum_hosts == 9
+
+
+def test_downstream_project_trigger(store):
+    from evergreen_tpu.events.triggers import define_downstream_trigger
+    from evergreen_tpu.ingestion.repotracker import (
+        ProjectRef,
+        Revision,
+        store_revisions,
+        upsert_project_ref,
+    )
+    from evergreen_tpu.globals import Requester, VersionStatus
+    from evergreen_tpu.models import version as version_mod
+
+    upsert_project_ref(store, ProjectRef(id="up"))
+    upsert_project_ref(store, ProjectRef(id="down"))
+    cfg = ("tasks:\n  - name: t\n    commands: []\nbuildvariants:\n"
+           "  - name: bv\n    run_on: [d1]\n    tasks: [{name: t}]\n")
+    define_downstream_trigger(store, "up", "down", cfg)
+
+    created = store_revisions(
+        store, "up", [Revision(revision="abcabc1234", config_yaml=cfg)], now=NOW
+    )[0]
+    # finish the upstream version successfully
+    version_mod.coll(store).update(
+        created.version.id, {"status": VersionStatus.SUCCEEDED.value}
+    )
+    event_mod.log(
+        store, event_mod.RESOURCE_VERSION, "VERSION_SUCCESS",
+        created.version.id, timestamp=NOW,
+    )
+    process_unprocessed_events(store, now=NOW)
+    downstream = version_mod.find(store, lambda d: d["project"] == "down")
+    assert len(downstream) == 1
+    assert downstream[0].requester == Requester.TRIGGER.value
