@@ -42,17 +42,22 @@ class ShellExec(Command):
             env=env,
             capture_output=True,
             text=True,
-            timeout=ctx.exec_timeout_s or None,
+            timeout=ctx.exec_timeout_s or ctx.idle_timeout_s or None,
         )
         for line in (proc.stdout or "").splitlines():
             ctx.log(line)
         for line in (proc.stderr or "").splitlines():
             ctx.log(f"[stderr] {line}")
+        if proc.returncode in (-9, 137):
+            # SIGKILL without our timeout firing is the classic OOM-kill
+            # signature (reference agent OOM tracker, agent/agent.go:1150)
+            ctx.artifacts["oom_killed"] = True
         if proc.returncode != 0 and not continue_on_err:
             return CommandResult(
                 exit_code=proc.returncode,
                 failed=True,
-                error=f"shell script returned {proc.returncode}",
+                error=f"shell script returned {proc.returncode}"
+                + (" (possible OOM kill)" if proc.returncode in (-9, 137) else ""),
             )
         return CommandResult(exit_code=proc.returncode)
 

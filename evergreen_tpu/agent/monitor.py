@@ -1,0 +1,68 @@
+"""Agent monitor: the parent process that keeps an agent alive on a host.
+
+Reference: operations/agent_monitor.go — a thin supervisor that spawns the
+agent as a subprocess and respawns it with backoff when it exits
+abnormally, so a crashing task cannot take the host out of rotation.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time as _time
+from typing import List, Optional
+
+
+class AgentMonitor:
+    def __init__(
+        self,
+        host_id: str,
+        api_server: str,
+        working_dir: str = "",
+        min_backoff_s: float = 1.0,
+        max_backoff_s: float = 60.0,
+        max_respawns: int = 0,
+    ) -> None:
+        self.host_id = host_id
+        self.api_server = api_server
+        self.working_dir = working_dir
+        self.min_backoff_s = min_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.max_respawns = max_respawns
+        self.respawns = 0
+
+    def _agent_argv(self) -> List[str]:
+        argv = [
+            sys.executable, "-m", "evergreen_tpu", "agent",
+            "--host-id", self.host_id,
+            "--api-server", self.api_server,
+        ]
+        if self.working_dir:
+            argv += ["--working-dir", self.working_dir]
+        return argv
+
+    def run_once(self) -> int:
+        """Run one agent process to completion; returns its exit code."""
+        proc = subprocess.run(self._agent_argv())
+        return proc.returncode
+
+    def run(self, log=print) -> None:
+        backoff = self.min_backoff_s
+        while True:
+            started = _time.time()
+            code = self.run_once()
+            if code == 0:
+                log(f"agent for {self.host_id} exited cleanly")
+                return
+            self.respawns += 1
+            if self.max_respawns and self.respawns >= self.max_respawns:
+                log(f"agent crashed {self.respawns} times; giving up")
+                return
+            # healthy-for-a-while runs reset the backoff
+            if _time.time() - started > 60:
+                backoff = self.min_backoff_s
+            log(
+                f"agent exited with {code}; respawning in {backoff:.1f}s "
+                f"(restart #{self.respawns})"
+            )
+            _time.sleep(backoff)
+            backoff = min(backoff * 2, self.max_backoff_s)

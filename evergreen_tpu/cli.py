@@ -66,6 +66,20 @@ def cmd_agent(args) -> int:
             idle_sleep = min(idle_sleep * 2, agent.options.max_poll_interval_s)
 
 
+def cmd_agent_monitor(args) -> int:
+    """Supervise an agent process, respawning on crashes (reference
+    operations/agent_monitor.go)."""
+    from .agent.monitor import AgentMonitor
+
+    AgentMonitor(
+        host_id=args.host_id,
+        api_server=args.api_server,
+        working_dir=args.working_dir,
+        max_respawns=args.max_respawns,
+    ).run()
+    return 0
+
+
 def cmd_solver(args) -> int:
     """Run the TPU solver sidecar (the Solve(SnapshotTensor) service a
     non-Python control plane calls; C++ client in native/evgsolve)."""
@@ -179,6 +193,13 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--once", action="store_true",
                    help="exit when the queue is empty")
     a.set_defaults(fn=cmd_agent)
+
+    am = sub.add_parser("agent-monitor", help="supervise an agent process")
+    am.add_argument("--host-id", required=True)
+    am.add_argument("--api-server", default="http://127.0.0.1:9090")
+    am.add_argument("--working-dir", default="")
+    am.add_argument("--max-respawns", type=int, default=0)
+    am.set_defaults(fn=cmd_agent_monitor)
 
     so = sub.add_parser("solver", help="run the TPU solver sidecar")
     so.add_argument("--host", default="127.0.0.1")

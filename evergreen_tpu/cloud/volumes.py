@@ -1,0 +1,175 @@
+"""Volumes + sleep schedules for spawn hosts.
+
+Reference: cloud.Manager volume surface (cloud/cloud.go AttachVolume/
+DetachVolume/CreateVolume...), rest/route/host_spawn.go volume routes, and
+unexpirable-host sleep schedules (config_sleep_schedule.go +
+units/spawnhost jobs): daily off-hours windows during which user hosts are
+stopped, then started again.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+import uuid
+from typing import List, Optional
+
+from ..globals import HostStatus
+from ..models import event as event_mod
+from ..models import host as host_mod
+from ..storage.store import Store
+from .manager import get_manager
+
+VOLUMES_COLLECTION = "volumes"
+
+
+class VolumeError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Volume:
+    id: str
+    created_by: str = ""
+    size_gb: int = 0
+    availability_zone: str = ""
+    host_id: str = ""  # attached host, "" when detached
+    home_volume: bool = False
+    expiration_time: float = 0.0
+    no_expiration: bool = False
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["_id"] = doc.pop("id")
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Volume":
+        doc = dict(doc)
+        doc["id"] = doc.pop("_id")
+        return cls(**doc)
+
+
+def create_volume(
+    store: Store, user: str, size_gb: int, zone: str = "",
+    now: Optional[float] = None,
+) -> Volume:
+    now = _time.time() if now is None else now
+    v = Volume(
+        id=f"vol-{uuid.uuid4().hex[:12]}",
+        created_by=user,
+        size_gb=size_gb,
+        availability_zone=zone,
+        expiration_time=now + 24 * 3600.0,
+    )
+    store.collection(VOLUMES_COLLECTION).insert(v.to_doc())
+    return v
+
+
+def get_volume(store: Store, volume_id: str) -> Optional[Volume]:
+    doc = store.collection(VOLUMES_COLLECTION).get(volume_id)
+    return Volume.from_doc(doc) if doc else None
+
+
+def attach_volume(store: Store, volume_id: str, host_id: str) -> None:
+    v = get_volume(store, volume_id)
+    if v is None:
+        raise VolumeError(f"volume {volume_id!r} not found")
+    if v.host_id:
+        raise VolumeError(f"volume {volume_id!r} already attached to {v.host_id}")
+    h = host_mod.get(store, host_id)
+    if h is None or not h.user_host:
+        raise VolumeError("volumes attach to spawn hosts only")
+    store.collection(VOLUMES_COLLECTION).update(volume_id, {"host_id": host_id})
+    event_mod.log(
+        store, event_mod.RESOURCE_HOST, "VOLUME_ATTACHED", host_id,
+        {"volume_id": volume_id},
+    )
+
+
+def detach_volume(store: Store, volume_id: str) -> None:
+    v = get_volume(store, volume_id)
+    if v is None:
+        raise VolumeError(f"volume {volume_id!r} not found")
+    store.collection(VOLUMES_COLLECTION).update(volume_id, {"host_id": ""})
+
+
+def volumes_for_user(store: Store, user: str) -> List[Volume]:
+    return [
+        Volume.from_doc(d)
+        for d in store.collection(VOLUMES_COLLECTION).find(
+            lambda d: d["created_by"] == user
+        )
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# Sleep schedules (unexpirable spawn hosts)
+# --------------------------------------------------------------------------- #
+
+SLEEP_SCHEDULES_COLLECTION = "sleep_schedules"
+
+
+@dataclasses.dataclass
+class SleepSchedule:
+    """Daily off-hours window in whole hours (config_sleep_schedule.go's
+    recurring schedule reduced to its common shape)."""
+
+    host_id: str
+    stop_hour_utc: int = 22
+    start_hour_utc: int = 8
+    enabled: bool = True
+
+    def should_be_stopped(self, now: float) -> bool:
+        hour = int(now // 3600) % 24
+        if self.stop_hour_utc == self.start_hour_utc:
+            return False
+        if self.stop_hour_utc < self.start_hour_utc:
+            return self.stop_hour_utc <= hour < self.start_hour_utc
+        return hour >= self.stop_hour_utc or hour < self.start_hour_utc
+
+
+def set_sleep_schedule(store: Store, schedule: SleepSchedule) -> None:
+    doc = dataclasses.asdict(schedule)
+    doc["_id"] = schedule.host_id
+    store.collection(SLEEP_SCHEDULES_COLLECTION).upsert(doc)
+
+
+def enforce_sleep_schedules(
+    store: Store, now: Optional[float] = None
+) -> List[str]:
+    """Stop/start unexpirable spawn hosts per their schedules (reference
+    units/spawnhost sleep-schedule jobs). Returns host ids acted on."""
+    now = _time.time() if now is None else now
+    acted: List[str] = []
+    for doc in store.collection(SLEEP_SCHEDULES_COLLECTION).find(
+        lambda d: d.get("enabled", True)
+    ):
+        sched = SleepSchedule(
+            host_id=doc["host_id"],
+            stop_hour_utc=doc["stop_hour_utc"],
+            start_hour_utc=doc["start_hour_utc"],
+            enabled=doc.get("enabled", True),
+        )
+        h = host_mod.get(store, sched.host_id)
+        if h is None or not h.user_host or not h.no_expiration:
+            continue
+        want_stopped = sched.should_be_stopped(now)
+        try:
+            mgr = get_manager(h.provider)
+        except KeyError:
+            continue
+        if want_stopped and h.status == HostStatus.RUNNING.value:
+            mgr.stop_instance(store, h)
+            acted.append(h.id)
+            event_mod.log(
+                store, event_mod.RESOURCE_HOST, "HOST_SLEEP", h.id,
+                timestamp=now,
+            )
+        elif not want_stopped and h.status == HostStatus.STOPPED.value:
+            mgr.start_instance(store, h)
+            acted.append(h.id)
+            event_mod.log(
+                store, event_mod.RESOURCE_HOST, "HOST_WAKE", h.id,
+                timestamp=now,
+            )
+    return acted

@@ -385,4 +385,9 @@ def mark_end(
     block_single_host_task_group(store, t, now)
     evaluate_stepback(store, t, now)
     update_build_and_version_status(store, t, now)
+
+    # cloud cost attribution (reference model/task_lifecycle.go:754-768)
+    from .cost import attribute_task_cost
+
+    attribute_task_cost(store, task_id, now)
     return t

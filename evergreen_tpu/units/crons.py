@@ -120,6 +120,12 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             scopes=["spawnhost-expiration"],
             job_type="spawnhost-expiration",
         ),
+        FnJob(
+            f"sleep-schedules-{now:.3f}",
+            _enforce_sleep_schedules,
+            scopes=["sleep-schedules"],
+            job_type="sleep-schedules",
+        ),
     ]
 
 
@@ -127,6 +133,12 @@ def _expire_spawn_hosts(s: Store) -> None:
     from ..cloud.spawnhost import expire_spawn_hosts
 
     expire_spawn_hosts(s)
+
+
+def _enforce_sleep_schedules(s: Store) -> None:
+    from ..cloud.volumes import enforce_sleep_schedules
+
+    enforce_sleep_schedules(s)
 
 
 def task_monitoring_jobs(store: Store, now: float) -> List[Job]:

@@ -255,3 +255,78 @@ def test_alias_queue_planned_and_dispatched(store):
     assert assign_next_available_task(
         store, svc, host_mod.get(store, "h-pr"), NOW
     ) is None
+
+
+def test_cost_attribution(store):
+    from evergreen_tpu.models.cost import (
+        CostConfig,
+        attribute_task_cost,
+        project_cost,
+    )
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models import host as hmod
+
+    CostConfig(on_demand_prices={"c5.xlarge": 0.17}).set(store)
+    hmod.insert(
+        store,
+        Host(id="h1", distro_id="d1", instance_type="c5.xlarge",
+             status=HostStatus.RUNNING.value),
+    )
+    task_mod.insert(
+        store,
+        Task(id="t1", project="core", distro_id="d1", host_id="h1",
+             status=TaskStatus.SUCCEEDED.value, start_time=NOW - 3600,
+             finish_time=NOW),
+    )
+    cost = attribute_task_cost(store, "t1", now=NOW)
+    # 1 hour * (0.17 + 0.01 ebs)
+    assert abs(cost - 0.18) < 1e-9
+    assert abs(project_cost(store, "core") - 0.18) < 1e-9
+
+
+def test_volumes_and_sleep_schedules(store):
+    from evergreen_tpu.cloud import spawnhost
+    from evergreen_tpu.cloud.mock import MockCloudManager
+    from evergreen_tpu.cloud.volumes import (
+        SleepSchedule,
+        attach_volume,
+        create_volume,
+        detach_volume,
+        enforce_sleep_schedules,
+        set_sleep_schedule,
+        volumes_for_user,
+    )
+    from evergreen_tpu.cloud.provisioning import (
+        create_hosts_from_intents,
+        provision_ready_hosts,
+    )
+    import pytest as _pytest
+    from evergreen_tpu.cloud.volumes import VolumeError
+
+    MockCloudManager.reset()
+    distro_mod.insert(store, Distro(id="ws", provider=Provider.MOCK.value))
+    h = spawnhost.create_spawn_host(store, "bob", "ws", no_expiration=True,
+                                    now=NOW)
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW)
+
+    v = create_volume(store, "bob", 100)
+    attach_volume(store, v.id, h.id)
+    assert volumes_for_user(store, "bob")[0].host_id == h.id
+    with _pytest.raises(VolumeError):
+        attach_volume(store, v.id, h.id)  # already attached
+    detach_volume(store, v.id)
+    assert volumes_for_user(store, "bob")[0].host_id == ""
+
+    # sleep schedule: stopped during off-hours, started during on-hours
+    set_sleep_schedule(
+        store, SleepSchedule(host_id=h.id, stop_hour_utc=22, start_hour_utc=8)
+    )
+    midnight = (NOW // 86400) * 86400 + 23 * 3600  # 23:00 UTC
+    acted = enforce_sleep_schedules(store, midnight)
+    assert acted == [h.id]
+    assert host_mod.get(store, h.id).status == HostStatus.STOPPED.value
+    noon = (NOW // 86400) * 86400 + 12 * 3600
+    acted = enforce_sleep_schedules(store, noon)
+    assert acted == [h.id]
+    assert host_mod.get(store, h.id).status == HostStatus.RUNNING.value
