@@ -57,7 +57,11 @@ def _parser_project_from_doc(store: Store, version_id: str) -> ParserProject:
     v = version_mod.get(store, version_id)
     if v is None:
         raise GenerateError(f"version {version_id!r} not found")
-    return parse_project(v.config_yaml or "")
+    pp = parse_project(v.config_yaml or "")
+    from .matrix import expand_matrices
+
+    expand_matrices(pp)
+    return pp
 
 
 def _merge_payload(pp: ParserProject, payload: Dict[str, Any]) -> List[str]:

@@ -1,0 +1,149 @@
+"""Matrix buildvariant expansion.
+
+Reference: model/project_matrix.go — a buildvariants entry may be a matrix:
+axes define dimensions (axis values carry variables/run_on/tags), the
+matrix's spec selects values per axis ("*" or explicit lists), the cross
+product becomes one buildvariant per cell minus exclude_spec matches, and
+rules add/remove tasks or set expansions on matching cells.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List
+
+from .parser import (
+    ParserBV,
+    ParserBVTaskUnit,
+    ParserProject,
+    ProjectParseError,
+    _as_list,
+    _as_str_list,
+)
+
+
+def _axis_values(axis: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return _as_list(axis.get("values"))
+
+
+def _select_axis_values(
+    axis: Dict[str, Any], spec: Any
+) -> List[Dict[str, Any]]:
+    values = _axis_values(axis)
+    wanted = _as_str_list(spec)
+    if wanted == ["*"]:
+        return values
+    by_id = {str(v.get("id")): v for v in values}
+    out = []
+    for w in wanted:
+        if w.startswith("."):  # tag selector over axis values
+            out.extend(
+                v for v in values if w[1:] in _as_str_list(v.get("tags"))
+            )
+        elif w in by_id:
+            out.append(by_id[w])
+        else:
+            raise ProjectParseError(
+                f"axis {axis.get('id')!r} has no value {w!r}"
+            )
+    return out
+
+
+def _cell_matches(cell: Dict[str, str], definition: Dict[str, Any]) -> bool:
+    for axis_id, vals in definition.items():
+        wanted = _as_str_list(vals)
+        if "*" not in wanted and cell.get(axis_id) not in wanted:
+            return False
+    return True
+
+
+def cell_variant_name(matrix_id: str, cell: Dict[str, str]) -> str:
+    parts = "_".join(f"{k}~{v}" for k, v in sorted(cell.items()))
+    return f"{matrix_id}__{parts}"
+
+
+def expand_matrices(pp: ParserProject) -> None:
+    """Replace matrix entries (pp.matrices) with concrete buildvariants."""
+    if not pp.matrices:
+        if pp.axes and not pp.matrices:
+            # axes without matrices are legal (unused definitions)
+            pass
+        return
+    axes_by_id = {str(a.get("id")): a for a in pp.axes}
+
+    for m in pp.matrices:
+        matrix_id = str(m.get("matrix_name", ""))
+        if not matrix_id:
+            raise ProjectParseError("matrix entry is missing matrix_name")
+        spec = m.get("matrix_spec") or {}
+        if not spec:
+            raise ProjectParseError(f"matrix {matrix_id!r} has no matrix_spec")
+        axis_ids = sorted(spec)
+        selected: List[List[Dict[str, Any]]] = []
+        for axis_id in axis_ids:
+            axis = axes_by_id.get(axis_id)
+            if axis is None:
+                raise ProjectParseError(
+                    f"matrix {matrix_id!r} references unknown axis {axis_id!r}"
+                )
+            selected.append(_select_axis_values(axis, spec[axis_id]))
+
+        excludes = _as_list(m.get("exclude_spec"))
+        rules = _as_list(m.get("rules"))
+        base_tasks = _as_list(m.get("tasks"))
+
+        for combo in itertools.product(*selected):
+            cell = {
+                axis_id: str(v.get("id"))
+                for axis_id, v in zip(axis_ids, combo)
+            }
+            if any(_cell_matches(cell, ex) for ex in excludes):
+                continue
+
+            expansions: Dict[str, str] = {}
+            run_on: List[str] = _as_str_list(m.get("run_on"))
+            tags: List[str] = _as_str_list(m.get("tags"))
+            for axis_id, v in zip(axis_ids, combo):
+                expansions.update(
+                    {str(k): str(val) for k, val in (v.get("variables") or {}).items()}
+                )
+                expansions[axis_id] = str(v.get("id"))
+                if v.get("run_on"):
+                    run_on = _as_str_list(v.get("run_on"))
+                tags.extend(_as_str_list(v.get("tags")))
+
+            tasks = [ParserBVTaskUnit.parse(t) for t in base_tasks]
+
+            # rules: add/remove tasks or set expansions on matching cells
+            # (reference matrixRule / ruleAction)
+            for rule in rules:
+                conditions = _as_list(rule.get("if"))
+                if conditions and not any(
+                    _cell_matches(cell, c) for c in conditions
+                ):
+                    continue
+                then = rule.get("then") or {}
+                for t in _as_list(then.get("add_tasks")):
+                    tasks.append(ParserBVTaskUnit.parse(t))
+                removals = set(_as_str_list(then.get("remove_tasks")))
+                if removals:
+                    tasks = [t for t in tasks if t.name not in removals]
+                for k, v in (then.get("set") or {}).items():
+                    expansions[str(k)] = str(v)
+
+            display = str(m.get("display_name", "") or matrix_id)
+            for axis_id, value_id in cell.items():
+                display = display.replace("${" + axis_id + "}", value_id)
+
+            pp.buildvariants.append(
+                ParserBV(
+                    name=cell_variant_name(matrix_id, cell),
+                    display_name=display,
+                    expansions=expansions,
+                    tags=sorted(set(tags)),
+                    run_on=run_on,
+                    tasks=tasks,
+                    stepback=m.get("stepback"),
+                    batchtime=m.get("batchtime"),
+                )
+            )
+    pp.matrices = []

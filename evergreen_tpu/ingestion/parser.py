@@ -331,6 +331,9 @@ class ParserProject:
     timeout_secs: int = 0
     include: List[Dict[str, str]] = dataclasses.field(default_factory=list)
     axes: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    #: raw matrix entries found in the buildvariants list
+    # (model/project_matrix.go; expanded by ingestion/matrix.py)
+    matrices: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
 
 def parse_project(
@@ -392,7 +395,14 @@ def _parse_dict(data: Dict[str, Any]) -> ParserProject:
                 for m in _as_list(data.get("modules"))
             ],
             buildvariants=[
-                ParserBV.parse(bv) for bv in _as_list(data.get("buildvariants"))
+                ParserBV.parse(bv)
+                for bv in _as_list(data.get("buildvariants"))
+                if "matrix_name" not in bv
+            ],
+            matrices=[
+                bv
+                for bv in _as_list(data.get("buildvariants"))
+                if isinstance(bv, dict) and "matrix_name" in bv
             ],
             functions={
                 str(name): _command_set(cmds)

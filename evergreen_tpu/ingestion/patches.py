@@ -101,6 +101,9 @@ def finalize_patch(
         return None
 
     pp = parse_project(p.config_yaml)
+    from .matrix import expand_matrices
+
+    expand_matrices(pp)
     want_variants = set(p.variants)
     if "*" not in want_variants and want_variants:
         expanded = set()

@@ -194,10 +194,9 @@ def create_version(
     :870 createVersionItems): parse, then materialize version + builds +
     tasks + dependency expansion + agent config doc."""
     pp = parse_project(yaml_text, include_resolver)
-    if pp.axes:
-        raise ProjectParseError(
-            "matrix axes are not yet supported by this framework"
-        )
+    from .matrix import expand_matrices
+
+    expand_matrices(pp)
     return materialize_version(
         store,
         pp,

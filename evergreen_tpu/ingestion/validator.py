@@ -31,6 +31,9 @@ def validate_project(
     issues: List[ValidationIssue] = []
     try:
         pp = parse_project(yaml_text)
+        from .matrix import expand_matrices
+
+        expand_matrices(pp)
     except ProjectParseError as e:
         return [ValidationIssue(LEVEL_ERROR, f"parse error: {e}")]
 
@@ -56,13 +59,6 @@ def check_structure(pp: ParserProject) -> List[ValidationIssue]:
         )
     if not pp.tasks:
         issues.append(ValidationIssue(LEVEL_ERROR, "project has no tasks"))
-
-    if pp.axes:
-        issues.append(
-            ValidationIssue(
-                LEVEL_ERROR, "matrix axes are not supported by this framework"
-            )
-        )
 
     for g in pp.task_groups:
         for member in g.tasks:

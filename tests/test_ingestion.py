@@ -265,3 +265,92 @@ def test_generate_tasks_cycle_detection(store):
     assert new_ids == []
     req = store.collection("generate_requests").get(gen_task.id)
     assert "cycle" in req["error"]
+
+
+MATRIX_YAML = textwrap.dedent(
+    """
+    axes:
+      - id: os
+        values:
+          - id: linux
+            variables: {cc: gcc}
+            run_on: [ubuntu2204]
+          - id: windows
+            variables: {cc: msvc}
+            run_on: [win2022]
+          - id: macos
+            tags: [desktop]
+            run_on: [mac]
+    
+      - id: pyver
+        values:
+          - id: py310
+            variables: {python: "3.10"}
+          - id: py312
+            variables: {python: "3.12"}
+    tasks:
+      - name: unit
+        commands:
+          - command: shell.exec
+            params: {script: "echo ${cc}-${python}"}
+      - name: slow-it
+        commands: []
+    buildvariants:
+      - matrix_name: test-matrix
+        display_name: "${os} py ${pyver}"
+        matrix_spec:
+          os: ["linux", "windows"]
+          pyver: "*"
+        exclude_spec:
+          - os: windows
+            pyver: py310
+        tasks:
+          - name: unit
+        rules:
+          - if:
+              - os: linux
+                pyver: py312
+            then:
+              add_tasks: [{name: slow-it}]
+              set: {extra_flag: "on"}
+    """
+)
+
+
+def test_matrix_expansion(store):
+    created = create_version(
+        store, "proj", MATRIX_YAML, revision="m1m1m1m1", order=1,
+        requester=Requester.REPOTRACKER.value, now=1000.0,
+    )
+    variants = {t.build_variant for t in created.tasks}
+    # 2x2 cross product minus the windows/py310 exclusion = 3 cells
+    assert variants == {
+        "test-matrix__os~linux_pyver~py310",
+        "test-matrix__os~linux_pyver~py312",
+        "test-matrix__os~windows_pyver~py312",
+    }
+    # rule added slow-it only to the linux/py312 cell
+    by_variant = {}
+    for t in created.tasks:
+        by_variant.setdefault(t.build_variant, set()).add(t.display_name)
+    assert by_variant["test-matrix__os~linux_pyver~py312"] == {"unit", "slow-it"}
+    assert by_variant["test-matrix__os~linux_pyver~py310"] == {"unit"}
+    # axis run_on + variables landed in the agent config doc
+    doc = store.collection("parser_projects").get(created.version.id)
+    exp = doc["variants"]["test-matrix__os~linux_pyver~py312"]["expansions"]
+    assert exp["cc"] == "gcc" and exp["python"] == "3.12"
+    assert exp["extra_flag"] == "on"
+    assert exp["os"] == "linux"
+    linux_tasks = [
+        t for t in created.tasks
+        if t.build_variant == "test-matrix__os~linux_pyver~py310"
+    ]
+    assert all(t.distro_id == "ubuntu2204" for t in linux_tasks)
+
+
+def test_matrix_validation_errors():
+    from evergreen_tpu.ingestion.validator import validate_project
+
+    bad = MATRIX_YAML.replace('os: ["linux", "windows"]', 'os: ["solaris"]')
+    issues = validate_project(None, bad)
+    assert any("no value 'solaris'" in i.message for i in issues)
