@@ -1,0 +1,169 @@
+"""GitHub webhook intake.
+
+Reference: rest/route/github.go (1.6k LoC hookHandler) — push events drive
+the repotracker, pull_request events create PR patch intents, merge_group
+events enqueue merge-queue versions. Signature verification uses the
+standard X-Hub-Signature-256 HMAC. The project is resolved by owner/repo +
+branch against project refs.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..globals import Requester
+from ..ingestion import patches as patch_mod
+from ..ingestion.merge_queue import enqueue_merge_group
+from ..ingestion.repotracker import (
+    PROJECT_REFS_COLLECTION,
+    ProjectRef,
+    Revision,
+    store_revisions,
+)
+from ..storage.store import Store
+
+
+def verify_signature(secret: str, body: bytes, signature: str) -> bool:
+    """X-Hub-Signature-256 check (reference uses go-github's validation)."""
+    if not secret:
+        return True  # verification disabled
+    if not signature.startswith("sha256="):
+        return False
+    want = hmac.new(secret.encode(), body, hashlib.sha256).hexdigest()
+    return hmac.compare_digest(want, signature[len("sha256="):])
+
+
+def _projects_for_repo(
+    store: Store, owner: str, repo: str, branch: str = ""
+) -> List[ProjectRef]:
+    out = []
+    for doc in store.collection(PROJECT_REFS_COLLECTION).find(
+        lambda d: d.get("owner") == owner and d.get("repo") == repo
+        and d.get("enabled", True)
+    ):
+        ref = ProjectRef.from_doc(doc)
+        if branch and ref.branch != branch:
+            continue
+        out.append(ref)
+    return out
+
+
+class GithubHookHandler:
+    """Dispatches webhook payloads by event type. The config-file fetcher is
+    injectable: production fetches the project file at the revision from
+    GitHub; tests supply it directly (the zero-egress seam)."""
+
+    def __init__(self, store: Store, config_fetcher=None) -> None:
+        self.store = store
+        #: (owner, repo, revision, path) -> yaml text
+        self.config_fetcher = config_fetcher or (lambda *a: "")
+
+    def handle(
+        self, event_type: str, payload: Dict[str, Any],
+        now: Optional[float] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        now = _time.time() if now is None else now
+        if event_type == "push":
+            return self._push(payload, now)
+        if event_type == "pull_request":
+            return self._pull_request(payload, now)
+        if event_type == "merge_group":
+            return self._merge_group(payload, now)
+        if event_type == "ping":
+            return 200, {"ok": True}
+        return 200, {"ignored": event_type}
+
+    # -- push → repotracker -------------------------------------------------- #
+
+    def _push(self, payload: Dict[str, Any], now: float):
+        repo = payload.get("repository", {})
+        owner = repo.get("owner", {}).get("name") or repo.get("owner", {}).get(
+            "login", ""
+        )
+        name = repo.get("name", "")
+        branch = (payload.get("ref") or "").replace("refs/heads/", "")
+        created = []
+        for ref in _projects_for_repo(self.store, owner, name, branch):
+            revisions = [
+                Revision(
+                    revision=c.get("id", ""),
+                    author=c.get("author", {}).get("name", ""),
+                    message=c.get("message", ""),
+                    config_yaml=self.config_fetcher(
+                        owner, name, c.get("id", ""), ref.remote_path
+                    ),
+                )
+                for c in payload.get("commits", [])
+            ]
+            out = store_revisions(self.store, ref.id, revisions, now=now)
+            created.extend(c.version.id for c in out)
+        return 200, {"versions": created}
+
+    # -- pull_request → PR patch --------------------------------------------- #
+
+    def _pull_request(self, payload: Dict[str, Any], now: float):
+        action = payload.get("action", "")
+        if action not in ("opened", "synchronize", "reopened"):
+            return 200, {"ignored": action}
+        pr = payload.get("pull_request", {})
+        base = pr.get("base", {})
+        repo = base.get("repo", {})
+        owner = repo.get("owner", {}).get("login", "")
+        name = repo.get("name", "")
+        branch = base.get("ref", "")
+        head_sha = pr.get("head", {}).get("sha", "")
+        number = int(payload.get("number") or pr.get("number") or 0)
+        created = []
+        for ref in _projects_for_repo(self.store, owner, name, branch):
+            if ref.patching_disabled:
+                continue
+            patch_id = f"pr-{ref.id}-{number}-{head_sha[:8]}"
+            if patch_mod.get_patch(self.store, patch_id) is not None:
+                continue  # duplicate delivery
+            patch_mod.insert_patch(
+                self.store,
+                patch_mod.Patch(
+                    id=patch_id,
+                    project=ref.id,
+                    author=pr.get("user", {}).get("login", ""),
+                    description=pr.get("title", f"PR #{number}"),
+                    githash=head_sha,
+                    variants=["*"],
+                    tasks=["*"],
+                    requester=Requester.GITHUB_PR.value,
+                    github_pr_number=number,
+                    config_yaml=self.config_fetcher(
+                        owner, name, head_sha, ref.remote_path
+                    ),
+                    create_time=now,
+                ),
+            )
+            out = patch_mod.finalize_patch(self.store, patch_id, now=now)
+            if out is not None:
+                created.append(out.version.id)
+        return 200, {"versions": created}
+
+    # -- merge_group → merge queue ------------------------------------------- #
+
+    def _merge_group(self, payload: Dict[str, Any], now: float):
+        if payload.get("action") != "checks_requested":
+            return 200, {"ignored": payload.get("action")}
+        mg = payload.get("merge_group", {})
+        repo = payload.get("repository", {})
+        owner = repo.get("owner", {}).get("login", "")
+        name = repo.get("name", "")
+        head_sha = mg.get("head_sha", "")
+        head_ref = mg.get("head_ref", "")
+        branch = (mg.get("base_ref") or "").replace("refs/heads/", "")
+        enqueued = []
+        for ref in _projects_for_repo(self.store, owner, name, branch):
+            pid = enqueue_merge_group(
+                self.store, ref.id, head_sha, head_ref,
+                self.config_fetcher(owner, name, head_sha, ref.remote_path),
+                now=now,
+            )
+            if pid:
+                enqueued.append(pid)
+        return 200, {"patches": enqueued}

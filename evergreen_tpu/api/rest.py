@@ -71,6 +71,22 @@ class RestApi:
             self._rate_limiter = RateLimiter(store, rate_limit_per_min)
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
         self._register_routes()
+        #: GitHub webhook intake (reference rest/route/github.go); secret +
+        #: config fetcher injectable
+        from .github_hooks import GithubHookHandler
+
+        self.github_hooks = GithubHookHandler(store)
+        self.webhook_secret = ""
+
+    def _github_hook(self, raw: bytes, headers: Dict[str, str], body: dict):
+        from .github_hooks import verify_signature
+
+        if not verify_signature(
+            self.webhook_secret, raw, headers.get("x-hub-signature-256", "")
+        ):
+            return 401, {"error": "invalid webhook signature"}
+        event = headers.get("x-github-event", "")
+        return self.github_hooks.handle(event, body)
 
     def _authorize(
         self, method: str, path: str, headers: Dict[str, str]
@@ -131,13 +147,15 @@ class RestApi:
         method = environ["REQUEST_METHOD"]
         path = environ.get("PATH_INFO", "/")
         body = {}
+        raw = b""
         try:
             length = int(environ.get("CONTENT_LENGTH") or 0)
         except ValueError:
             length = 0
         if length:
+            raw = environ["wsgi.input"].read(length) or b"{}"
             try:
-                body = json.loads(environ["wsgi.input"].read(length) or b"{}")
+                body = json.loads(raw)
             except json.JSONDecodeError:
                 start_response("400 Bad Request", [("Content-Type", JSON)])
                 return [json.dumps({"error": "invalid JSON body"}).encode()]
@@ -146,7 +164,10 @@ class RestApi:
             for k, v in environ.items()
             if k.startswith("HTTP_")
         }
-        status, payload = self.handle(method, path, body, headers)
+        if path == "/hooks/github":
+            status, payload = self._github_hook(raw, headers, body)
+        else:
+            status, payload = self.handle(method, path, body, headers)
         reason = {200: "OK", 201: "Created", 400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
                   409: "Conflict", 429: "Too Many Requests",
