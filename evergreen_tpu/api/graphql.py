@@ -1,0 +1,368 @@
+"""GraphQL surface: the query/mutation subset the Spruce UI leans on.
+
+The reference serves a gqlgen schema of ~139k generated lines
+(graphql/generated.go) backing the Spruce UI; the hand-written substance is
+the resolvers. Here: a compact spec-subset executor (single operation,
+field arguments, variables, aliases, nested selection sets — no fragments
+or directives) over a resolver registry covering the operationally
+important queries (task, tasks, version, build, host, hosts, distros,
+patch, projects, taskLogs, taskTests) and mutations (scheduleTask,
+unscheduleTask, abortTask, restartTask, setTaskPriority).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..models import build as build_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models import version as version_mod
+from ..storage.store import Store
+
+
+class GraphQLError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------- #
+# Minimal GraphQL document parser
+# --------------------------------------------------------------------------- #
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<punct>[{}():,$!\[\]=])
+      | (?P<name>[_A-Za-z][_0-9A-Za-z]*)
+      | (?P<string>"(?:[^"\\]|\\.)*")
+      | (?P<number>-?\d+(?:\.\d+)?)
+      | (?P<comment>\#[^\n]*)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise GraphQLError(f"syntax error near {rest[:24]!r}")
+        pos = m.end()
+        if m.lastgroup == "comment":
+            continue
+        out.append((m.lastgroup, m.group(m.lastgroup)))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]) -> None:
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise GraphQLError("unexpected end of query")
+        self.i += 1
+        return tok
+
+    def expect(self, value: str) -> None:
+        kind, got = self.next()
+        if got != value:
+            raise GraphQLError(f"expected {value!r}, got {got!r}")
+
+    def parse_document(self) -> Tuple[str, List[dict]]:
+        kind, val = self.peek() or ("", "")
+        op = "query"
+        if kind == "name" and val in ("query", "mutation"):
+            op = val
+            self.next()
+            if self.peek() and self.peek()[0] == "name":
+                self.next()  # operation name
+            if self.peek() and self.peek()[1] == "(":
+                self._skip_variable_defs()
+        return op, self.parse_selection_set()
+
+    def _skip_variable_defs(self) -> None:
+        depth = 0
+        while True:
+            _, val = self.next()
+            if val == "(":
+                depth += 1
+            elif val == ")":
+                depth -= 1
+                if depth == 0:
+                    return
+
+    def parse_selection_set(self) -> List[dict]:
+        self.expect("{")
+        fields = []
+        while True:
+            tok = self.peek()
+            if tok is None:
+                raise GraphQLError("unterminated selection set")
+            if tok[1] == "}":
+                self.next()
+                return fields
+            fields.append(self.parse_field())
+
+    def parse_field(self) -> dict:
+        kind, name = self.next()
+        if kind != "name":
+            raise GraphQLError(f"expected field name, got {name!r}")
+        alias = None
+        if self.peek() and self.peek()[1] == ":":
+            self.next()
+            alias, name = name, self.next()[1]
+        args: Dict[str, Any] = {}
+        if self.peek() and self.peek()[1] == "(":
+            self.next()
+            while self.peek() and self.peek()[1] != ")":
+                arg_name = self.next()[1]
+                self.expect(":")
+                args[arg_name] = self.parse_value()
+                if self.peek() and self.peek()[1] == ",":
+                    self.next()
+            self.expect(")")
+        selection: Optional[List[dict]] = None
+        if self.peek() and self.peek()[1] == "{":
+            selection = self.parse_selection_set()
+        return {
+            "name": name,
+            "alias": alias or name,
+            "args": args,
+            "selection": selection,
+        }
+
+    def parse_value(self) -> Any:
+        kind, val = self.next()
+        if val == "$":
+            return {"$var": self.next()[1]}
+        if kind == "string":
+            return val[1:-1].encode().decode("unicode_escape")
+        if kind == "number":
+            return float(val) if "." in val else int(val)
+        if kind == "name":
+            return {"true": True, "false": False, "null": None}.get(val, val)
+        if val == "[":
+            items = []
+            while self.peek() and self.peek()[1] != "]":
+                items.append(self.parse_value())
+                if self.peek() and self.peek()[1] == ",":
+                    self.next()
+            self.expect("]")
+            return items
+        raise GraphQLError(f"unsupported value token {val!r}")
+
+
+def _resolve_vars(value: Any, variables: Dict[str, Any]) -> Any:
+    if isinstance(value, dict) and "$var" in value:
+        name = value["$var"]
+        if name not in variables:
+            raise GraphQLError(f"missing variable ${name}")
+        return variables[name]
+    if isinstance(value, list):
+        return [_resolve_vars(v, variables) for v in value]
+    return value
+
+
+# --------------------------------------------------------------------------- #
+# Execution over the resolver registry
+# --------------------------------------------------------------------------- #
+
+
+def _project(value: Any, selection: Optional[List[dict]], store: Store) -> Any:
+    if selection is None or value is None:
+        return value
+    if isinstance(value, list):
+        return [_project(v, selection, store) for v in value]
+    if not isinstance(value, dict):
+        return value
+    out = {}
+    for field in selection:
+        name = field["name"]
+        sub = value.get(name)
+        out[field["alias"]] = _project(sub, field["selection"], store)
+    return out
+
+
+class GraphQLApi:
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self.queries: Dict[str, Callable] = {
+            "task": self._q_task,
+            "tasks": self._q_tasks,
+            "version": self._q_version,
+            "build": self._q_build,
+            "host": self._q_host,
+            "hosts": self._q_hosts,
+            "distros": self._q_distros,
+            "patch": self._q_patch,
+            "projects": self._q_projects,
+            "taskLogs": self._q_task_logs,
+            "taskTests": self._q_task_tests,
+        }
+        self.mutations: Dict[str, Callable] = {
+            "scheduleTask": self._m_schedule,
+            "unscheduleTask": self._m_unschedule,
+            "abortTask": self._m_abort,
+            "restartTask": self._m_restart,
+            "setTaskPriority": self._m_priority,
+        }
+
+    # -- entry --------------------------------------------------------------- #
+
+    def execute(
+        self, query: str, variables: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        variables = variables or {}
+        try:
+            op, selection = _Parser(_tokenize(query)).parse_document()
+            registry = self.queries if op == "query" else self.mutations
+            data: Dict[str, Any] = {}
+            for field in selection:
+                fn = registry.get(field["name"])
+                if fn is None:
+                    raise GraphQLError(
+                        f"unknown {op} field {field['name']!r}"
+                    )
+                args = {
+                    k: _resolve_vars(v, variables)
+                    for k, v in field["args"].items()
+                }
+                data[field["alias"]] = _project(
+                    fn(**args), field["selection"], self.store
+                )
+            return {"data": data}
+        except GraphQLError as e:
+            return {"errors": [{"message": str(e)}]}
+        except TypeError as e:
+            return {"errors": [{"message": f"bad arguments: {e}"}]}
+
+    # -- query resolvers ------------------------------------------------------ #
+
+    def _task_doc(self, task_id: str) -> Optional[dict]:
+        t = task_mod.get(self.store, task_id)
+        if t is None:
+            return None
+        doc = t.to_doc()
+        doc["id"] = doc["_id"]
+        return doc
+
+    def _q_task(self, taskId: str):
+        return self._task_doc(taskId)
+
+    def _q_tasks(self, versionId: str):
+        docs = []
+        for t in task_mod.find(
+            self.store, lambda d: d["version"] == versionId
+        ):
+            doc = t.to_doc()
+            doc["id"] = doc["_id"]
+            docs.append(doc)
+        return docs
+
+    def _q_version(self, versionId: str):
+        v = version_mod.get(self.store, versionId)
+        if v is None:
+            return None
+        doc = v.to_doc()
+        doc["id"] = doc["_id"]
+        return doc
+
+    def _q_build(self, buildId: str):
+        b = build_mod.get(self.store, buildId)
+        if b is None:
+            return None
+        doc = b.to_doc()
+        doc["id"] = doc["_id"]
+        return doc
+
+    def _q_host(self, hostId: str):
+        h = host_mod.get(self.store, hostId)
+        if h is None:
+            return None
+        doc = h.to_doc()
+        doc["id"] = doc["_id"]
+        return doc
+
+    def _q_hosts(self, distroId: str = ""):
+        return [
+            {**h.to_doc(), "id": h.id}
+            for h in host_mod.find(
+                self.store,
+                (lambda d: d["distro_id"] == distroId) if distroId else None,
+            )
+        ]
+
+    def _q_distros(self):
+        from ..models import distro as distro_mod
+
+        return [
+            {**d.to_doc(), "id": d.id} for d in distro_mod.find_all(self.store)
+        ]
+
+    def _q_patch(self, patchId: str):
+        from ..ingestion.patches import get_patch
+
+        p = get_patch(self.store, patchId)
+        if p is None:
+            return None
+        doc = p.to_doc()
+        doc["id"] = doc["_id"]
+        return doc
+
+    def _q_projects(self):
+        return self.store.collection("project_refs").find()
+
+    def _q_task_logs(self, taskId: str):
+        doc = self.store.collection("task_logs").get(taskId)
+        return {"taskId": taskId, "lines": doc["lines"] if doc else []}
+
+    def _q_task_tests(self, taskId: str, execution: int = 0):
+        from ..models.artifact import get_test_results
+
+        return [
+            {"testName": r.test_name, "status": r.status,
+             "durationS": r.duration_s, "logUrl": r.log_url}
+            for r in get_test_results(self.store, taskId, execution)
+        ]
+
+    # -- mutation resolvers --------------------------------------------------- #
+
+    def _m_schedule(self, taskId: str):
+        import time as _time
+
+        task_mod.coll(self.store).update(
+            taskId,
+            {"activated": True, "activated_by": "graphql",
+             "activated_time": _time.time()},
+        )
+        return self._task_doc(taskId)
+
+    def _m_unschedule(self, taskId: str):
+        task_mod.coll(self.store).update(taskId, {"activated": False})
+        return self._task_doc(taskId)
+
+    def _m_abort(self, taskId: str):
+        from ..units.task_jobs import abort_task
+
+        abort_task(self.store, taskId, by="graphql")
+        return self._task_doc(taskId)
+
+    def _m_restart(self, taskId: str):
+        from ..units.task_jobs import restart_task
+
+        restart_task(self.store, taskId, by="graphql")
+        return self._task_doc(taskId)
+
+    def _m_priority(self, taskId: str, priority: int):
+        task_mod.coll(self.store).update(taskId, {"priority": int(priority)})
+        return self._task_doc(taskId)

@@ -232,6 +232,9 @@ class RestApi:
         r("GET", r"/rest/v2/patches/(?P<patch>[^/]+)", self.get_patch)
         r("POST", r"/rest/v2/patches/(?P<patch>[^/]+)/finalize", self.finalize)
 
+        # graphql (reference graphql/http_handler.go)
+        r("POST", r"/graphql", self.graphql)
+
         # admin / events
         r("GET", r"/rest/v2/admin/settings", self.get_admin)
         r("POST", r"/rest/v2/admin/settings", self.set_admin)
@@ -535,6 +538,14 @@ class RestApi:
             section.set(self.store)
             updated.append(sid)
         return 200, {"updated": updated}
+
+    def graphql(self, method, match, body):
+        from .graphql import GraphQLApi
+
+        result = GraphQLApi(self.store).execute(
+            body.get("query", ""), body.get("variables") or {}
+        )
+        return 200, result
 
     def status(self, method, match, body):
         return 200, {

@@ -1,0 +1,102 @@
+"""GraphQL subset: parsing, projection, variables, aliases, mutations
+(reference analog: graphql/query_test.go corpus style)."""
+from evergreen_tpu.api.graphql import GraphQLApi
+from evergreen_tpu.api.rest import RestApi
+from evergreen_tpu.globals import TaskStatus
+from evergreen_tpu.models import build as build_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import version as version_mod
+from evergreen_tpu.models.build import Build
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.models.version import Version
+
+
+def seed(store):
+    version_mod.insert(store, Version(id="v1", project="p", status="started"))
+    build_mod.insert(store, Build(id="b1", version="v1", build_variant="lin"))
+    task_mod.insert_many(
+        store,
+        [
+            Task(id="t1", display_name="compile", version="v1", build_id="b1",
+                 status=TaskStatus.SUCCEEDED.value, activated=True, priority=5),
+            Task(id="t2", display_name="test", version="v1", build_id="b1",
+                 status=TaskStatus.FAILED.value, activated=True),
+        ],
+    )
+    store.collection("task_logs").upsert({"_id": "t1", "lines": ["hello-log"]})
+
+
+def test_query_projection_and_nesting(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute(
+        """
+        query {
+          task(taskId: "t1") { id display_name status priority }
+          all: tasks(versionId: "v1") { id status }
+          version(versionId: "v1") { id project status }
+          taskLogs(taskId: "t1") { lines }
+        }
+        """
+    )
+    assert "errors" not in out, out
+    data = out["data"]
+    assert data["task"] == {
+        "id": "t1", "display_name": "compile", "status": "success",
+        "priority": 5,
+    }
+    assert {t["id"] for t in data["all"]} == {"t1", "t2"}
+    assert data["version"]["project"] == "p"
+    assert data["taskLogs"]["lines"] == ["hello-log"]
+
+
+def test_variables_and_missing(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute(
+        'query GetTask($id: String!) { task(taskId: $id) { id } }',
+        {"id": "t2"},
+    )
+    assert out["data"]["task"]["id"] == "t2"
+    out = gql.execute(
+        'query($id: String!) { task(taskId: $id) { id } }', {}
+    )
+    assert "errors" in out
+    out = gql.execute('query { task(taskId: "nope") { id } }')
+    assert out["data"]["task"] is None
+    out = gql.execute("query { bogusField { id } }")
+    assert "unknown query field" in out["errors"][0]["message"]
+
+
+def test_mutations(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute(
+        'mutation { setTaskPriority(taskId: "t1", priority: 99) { id priority } }'
+    )
+    assert out["data"]["setTaskPriority"]["priority"] == 99
+    out = gql.execute('mutation { abortTask(taskId: "t1") { id aborted } }')
+    assert out["data"]["abortTask"]["aborted"] is True
+    out = gql.execute('mutation { restartTask(taskId: "t2") { id status execution } }')
+    assert out["data"]["restartTask"]["status"] == TaskStatus.UNDISPATCHED.value
+    assert out["data"]["restartTask"]["execution"] == 1
+    out = gql.execute('mutation { unscheduleTask(taskId: "t1") { activated } }')
+    assert out["data"]["unscheduleTask"]["activated"] is False
+
+
+def test_graphql_over_http_route(store):
+    seed(store)
+    api = RestApi(store)
+    status, payload = api.handle(
+        "POST", "/graphql",
+        {"query": 'query { task(taskId: "t1") { id status } }'},
+    )
+    assert status == 200
+    assert payload["data"]["task"]["status"] == "success"
+
+
+def test_syntax_errors_are_clean(store):
+    gql = GraphQLApi(store)
+    assert "errors" in gql.execute("query { task(taskId: } }")
+    assert "errors" in gql.execute("{ unterminated")
+    assert "errors" in gql.execute("")
