@@ -216,8 +216,11 @@ class Task:
             d if isinstance(d, Dependency) else Dependency.from_doc(d)
             for d in doc.get("depends_on", [])
         ]
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = _TASK_FIELDS  # dataclasses.fields() per doc is hot-loop cost
         return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+_TASK_FIELDS = frozenset(f.name for f in dataclasses.fields(Task))
 
 
 # --------------------------------------------------------------------------- #
@@ -289,14 +292,25 @@ def mark_scheduled(
     deps_met_set = set(deps_met_ids)
     n = 0
     for tid in task_ids:
+        # check-before-mutate: mutate() fires change notifications, and a
+        # steady-state tick must not dirty 50k unchanged tasks
+        doc = c.get(tid)
+        if doc is None:
+            continue
+        needs_sched = doc.get("scheduled_time", 0.0) <= 0.0
+        needs_dmt = (
+            tid in deps_met_set and doc.get("dependencies_met_time", 0.0) <= 0.0
+        )
+        if not (needs_sched or needs_dmt):
+            continue
 
-        def stamp(doc: dict) -> None:
+        def stamp(d: dict) -> None:
             nonlocal n
-            if doc.get("scheduled_time", 0.0) <= 0.0:
-                doc["scheduled_time"] = when
+            if d.get("scheduled_time", 0.0) <= 0.0:
+                d["scheduled_time"] = when
                 n += 1
-            if tid in deps_met_set and doc.get("dependencies_met_time", 0.0) <= 0.0:
-                doc["dependencies_met_time"] = when
+            if tid in deps_met_set and d.get("dependencies_met_time", 0.0) <= 0.0:
+                d["dependencies_met_time"] = when
 
         c.mutate(tid, stamp)
     return n

@@ -1,0 +1,155 @@
+"""Incremental tick cache: dirty-tracked runnable-task maintenance.
+
+The reference's finder re-queries Mongo for the full runnable set every
+tick for every distro (scheduler/task_finder.go). Under churn (BASELINE
+config 5 — generate.tasks growth, stepback activations, finishes) most of
+the set is unchanged tick to tick, so this cache subscribes to the tasks
+collection and re-materializes ONLY dirty documents; gather() then assembles
+the solver inputs from the warm runnable map instead of scanning the store.
+
+Correctness: the listener fires inside the collection lock on every write
+path (storage/store.py), so a task can never change without landing in the
+dirty set; apply() re-evaluates dirty ids against the same predicate the
+cold-path finder uses (models/task.find_host_runnable).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..globals import TaskStatus
+from ..models import distro as distro_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models.task import Task
+from ..storage.store import Store
+from . import serial
+from .snapshot import compute_deps_met
+
+
+class TickCache:
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self._lock = threading.Lock()
+        self._dirty: Set[str] = set()
+        self._primed = False
+        #: runnable task id → materialized Task
+        self._runnable: Dict[str, Task] = {}
+        task_mod.coll(store).add_listener(self._on_task_change)
+
+    # listener runs under the collection lock: flag only
+    def _on_task_change(self, task_id: str) -> None:
+        self._dirty.add(task_id)
+        if not task_id:  # defensive; ids are never empty
+            self._primed = False
+
+    def _qualifies(self, doc: Optional[dict]) -> bool:
+        if doc is None:
+            return False
+        if doc["status"] != TaskStatus.UNDISPATCHED.value or not doc["activated"]:
+            return False
+        if doc["priority"] < 0:
+            return False
+        if doc.get("execution_platform", "host") != "host":
+            return False
+        if any(d.get("unattainable") for d in doc.get("depends_on", [])) and not doc.get(
+            "override_dependencies", False
+        ):
+            return False
+        return True
+
+    def apply_dirty(self) -> int:
+        """Fold pending changes into the runnable map; returns changes."""
+        with self._lock:
+            if not self._primed:
+                self._runnable = {
+                    t.id: t for t in task_mod.find_host_runnable(self.store)
+                }
+                self._dirty.clear()
+                self._primed = True
+                return len(self._runnable)
+            dirty, self._dirty = self._dirty, set()
+            coll = task_mod.coll(self.store)
+            n = 0
+            for tid in dirty:
+                doc = coll.get(tid)
+                if self._qualifies(doc):
+                    self._runnable[tid] = Task.from_doc(doc)
+                    n += 1
+                elif tid in self._runnable:
+                    del self._runnable[tid]
+                    n += 1
+            return n
+
+    def gather(self, now: float) -> Tuple:
+        """Same contract as scheduler.wrapper.gather_tick_inputs, served
+        from the warm runnable map."""
+        self.apply_dirty()
+        distros = distro_mod.find_needs_hosts_planning(self.store)
+        all_ids = {d.id for d in distros}
+        plannable = {d.id for d in distro_mod.find_needs_planning(self.store)}
+
+        tasks_by_distro: Dict[str, List[Task]] = {d.id: [] for d in distros}
+        alias_tasks: Dict[str, List[Task]] = {}
+        runnable: List[Task] = []
+        with self._lock:
+            current = list(self._runnable.values())
+        for t in current:
+            if t.distro_id in plannable:
+                tasks_by_distro[t.distro_id].append(t)
+                runnable.append(t)
+            for sd in t.secondary_distros:
+                if sd in plannable and sd != t.distro_id:
+                    alias_tasks.setdefault(sd, []).append(t)
+                    if t.distro_id not in plannable:
+                        runnable.append(t)
+        import dataclasses as _dc
+
+        from .wrapper import ALIAS_SUFFIX
+
+        for did, ts in sorted(alias_tasks.items()):
+            base = next(d for d in distros if d.id == did)
+            alias = _dc.replace(base, id=f"{did}{ALIAS_SUFFIX}")
+            distros.append(alias)
+            tasks_by_distro[alias.id] = ts
+
+        from ..globals import TASK_COMPLETED_STATUSES
+
+        parent_ids = {d.task_id for t in runnable for d in t.depends_on}
+        coll = task_mod.coll(self.store)
+        finished_status = {}
+        for doc in coll.find_ids(list(parent_ids)):
+            if doc["status"] in TASK_COMPLETED_STATUSES:
+                finished_status[doc["_id"]] = doc["status"]
+        deps_met = compute_deps_met(runnable, finished_status)
+
+        hosts_by_distro: Dict[str, List] = {d.id: [] for d in distros}
+        active_hosts = [
+            h
+            for h in host_mod.all_active_hosts(self.store)
+            if h.distro_id in all_ids
+        ]
+        from ..globals import DEFAULT_TASK_DURATION_S
+
+        running_ids = [h.running_task for h in active_hosts if h.running_task]
+        running_docs = {
+            d["_id"]: d for d in coll.find_ids(running_ids)
+        }
+        running_estimates: Dict[str, serial.RunningTaskEstimate] = {}
+        for h in active_hosts:
+            hosts_by_distro[h.distro_id].append(h)
+            if h.running_task:
+                rd = running_docs.get(h.running_task)
+                if rd is not None:
+                    dur = rd.get("expected_duration_s", 0.0)
+                    running_estimates[h.id] = serial.RunningTaskEstimate(
+                        elapsed_s=max(0.0, now - rd.get("start_time", now)),
+                        expected_s=dur if dur > 0 else float(DEFAULT_TASK_DURATION_S),
+                        std_dev_s=rd.get("duration_std_dev_s", 0.0)
+                        if dur > 0 else 0.0,
+                    )
+        return distros, tasks_by_distro, hosts_by_distro, running_estimates, deps_met
+
+    def runnable_count(self) -> int:
+        with self._lock:
+            return len(self._runnable)

@@ -46,36 +46,72 @@ def persist_task_queue(
     max_scheduled_per_distro: int = 0,
     secondary: bool = False,
     now: Optional[float] = None,
-) -> TaskQueue:
+) -> int:
+    """Persist the plan; returns the number of queue items written."""
     now = _time.time() if now is None else now
-    items = [
-        TaskQueueItem(
-            id=t.id,
-            display_name=t.display_name,
-            build_variant=t.build_variant,
-            project=t.project,
-            version=t.version,
-            requester=t.requester,
-            revision_order_number=t.revision_order_number,
-            priority=t.priority,
-            sort_value=sort_values.get(t.id, 0.0),
-            task_group=t.task_group,
-            task_group_max_hosts=t.task_group_max_hosts,
-            task_group_order=t.task_group_order,
-            expected_duration_s=t.expected_duration_s,
-            num_dependents=t.num_dependents,
-            dependencies=[d.task_id for d in t.depends_on],
-            dependencies_met=deps_met.get(t.id, True),
-        )
+    # plain dicts on the hot path: dataclass construction + asdict for a
+    # 50k-item queue costs seconds per tick; TaskQueueItem remains the
+    # read-side type (TaskQueue.from_doc)
+    item_docs = [
+        {
+            "id": t.id,
+            "display_name": t.display_name,
+            "build_variant": t.build_variant,
+            "project": t.project,
+            "version": t.version,
+            "requester": t.requester,
+            "revision_order_number": t.revision_order_number,
+            "priority": t.priority,
+            "sort_value": sort_values.get(t.id, 0.0),
+            "task_group": t.task_group,
+            "task_group_max_hosts": t.task_group_max_hosts,
+            "task_group_order": t.task_group_order,
+            "expected_duration_s": t.expected_duration_s,
+            "num_dependents": t.num_dependents,
+            "dependencies": [d.task_id for d in t.depends_on],
+            "dependencies_met": deps_met.get(t.id, True),
+        }
         for t in plan
     ]
-    items = cap_queue_length(items, max_scheduled_per_distro)
-    queue = TaskQueue(distro_id=distro_id, queue=items, info=info, generated_at=now)
-    save(store, queue, secondary=secondary)
+    item_docs = cap_queue_docs(item_docs, max_scheduled_per_distro)
+    info_doc = {
+        **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
+        "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],
+    }
+    tq_coll = save_doc(
+        store,
+        {
+            "_id": distro_id,
+            "distro_id": distro_id,
+            "queue": item_docs,
+            "info": info_doc,
+            "generated_at": now,
+        },
+        secondary=secondary,
+    )
     task_mod.mark_scheduled(
         store,
-        [i.id for i in items],
+        [i["id"] for i in item_docs],
         now,
-        deps_met_ids=[i.id for i in items if i.dependencies_met],
+        deps_met_ids=[i["id"] for i in item_docs if i["dependencies_met"]],
     )
-    return queue
+    return len(item_docs)
+
+
+def save_doc(store: Store, doc: dict, secondary: bool = False):
+    from ..models.task_queue import coll as tq_coll
+
+    c = tq_coll(store, secondary)
+    c.upsert(doc)
+    return c
+
+
+def cap_queue_docs(items: List[dict], max_len: int) -> List[dict]:
+    if max_len <= 0 or len(items) <= max_len:
+        return items
+    cut = max_len
+    straddler = items[cut - 1]["task_group"]
+    if straddler:
+        while cut < len(items) and items[cut]["task_group"] == straddler:
+            cut += 1
+    return items[:cut]

@@ -49,11 +49,34 @@ class TickOptions:
     create_intent_hosts: bool = True
     #: global cap on in-flight intent hosts (units/host_allocator.go:35)
     max_intent_hosts: int = MAX_INTENT_HOSTS_IN_FLIGHT
+    #: incremental runnable-set maintenance between ticks (scheduler/cache.py)
+    use_cache: bool = False
+
+
+_tick_caches = None
+
+
+def tick_cache_for(store: Store):
+    """Per-store TickCache singleton (the long-lived service uses one so
+    each tick only re-materializes changed tasks)."""
+    global _tick_caches
+    from .cache import TickCache
+
+    if _tick_caches is None:
+        import weakref
+
+        _tick_caches = weakref.WeakKeyDictionary()
+    cache = _tick_caches.get(store)
+    if cache is None:
+        cache = TickCache(store)
+        _tick_caches[store] = cache
+    return cache
 
 
 @dataclasses.dataclass
 class TickResult:
-    queues: Dict[str, TaskQueue]
+    #: distro id -> number of queue items persisted this tick
+    queues: Dict[str, int]
     new_hosts: Dict[str, int]
     intent_hosts: List[Host]
     n_tasks: int
@@ -215,15 +238,24 @@ def run_tick(
             store, "", now, UNDERWATER_UNSCHEDULE_THRESHOLD_S
         )
 
-    (
-        distros,
-        tasks_by_distro,
-        hosts_by_distro,
-        running_estimates,
-        deps_met,
-    ) = gather_tick_inputs(store, now)
+    if opts.use_cache:
+        (
+            distros,
+            tasks_by_distro,
+            hosts_by_distro,
+            running_estimates,
+            deps_met,
+        ) = tick_cache_for(store).gather(now)
+    else:
+        (
+            distros,
+            tasks_by_distro,
+            hosts_by_distro,
+            running_estimates,
+            deps_met,
+        ) = gather_tick_inputs(store, now)
 
-    queues: Dict[str, TaskQueue] = {}
+    queues: Dict[str, int] = {}
     new_hosts: Dict[str, int] = {}
     intent_hosts: List[Host] = []
     snapshot_ms = solve_ms = 0.0

@@ -25,6 +25,18 @@ class Collection:
         self.name = name
         self._docs: Dict[str, dict] = {}
         self._lock = threading.RLock()
+        #: change listeners: fn(doc_id) called after any write touching the
+        #: doc. Callbacks MUST be trivial (set a dirty flag) — they run
+        #: under the collection lock.
+        self._listeners: List[Callable[[str], None]] = []
+
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _notify(self, doc_id: str) -> None:
+        for fn in self._listeners:
+            fn(doc_id)
 
     # -- basic CRUD --------------------------------------------------------- #
 
@@ -34,10 +46,12 @@ class Collection:
             if doc_id in self._docs:
                 raise KeyError(f"duplicate _id {doc_id!r} in {self.name}")
             self._docs[doc_id] = doc
+            self._notify(doc_id)
 
     def upsert(self, doc: dict) -> None:
         with self._lock:
             self._docs[doc["_id"]] = doc
+            self._notify(doc["_id"])
 
     def insert_many(self, docs: Iterable[dict]) -> None:
         with self._lock:
@@ -46,6 +60,7 @@ class Collection:
                     raise KeyError(f"duplicate _id {doc['_id']!r} in {self.name}")
             for doc in docs:
                 self._docs[doc["_id"]] = doc
+                self._notify(doc["_id"])
 
     def get(self, doc_id: str) -> Optional[dict]:
         with self._lock:
@@ -63,18 +78,25 @@ class Collection:
 
     def remove(self, doc_id: str) -> bool:
         with self._lock:
-            return self._docs.pop(doc_id, None) is not None
+            gone = self._docs.pop(doc_id, None) is not None
+            if gone:
+                self._notify(doc_id)
+            return gone
 
     def remove_where(self, pred: Callable[[dict], bool]) -> int:
         with self._lock:
             doomed = [i for i, d in self._docs.items() if pred(d)]
             for i in doomed:
                 del self._docs[i]
+                self._notify(i)
             return len(doomed)
 
     def clear(self) -> None:
         with self._lock:
+            ids = list(self._docs)
             self._docs.clear()
+            for i in ids:
+                self._notify(i)
 
     def count(self, pred: Optional[Callable[[dict], bool]] = None) -> int:
         with self._lock:
@@ -110,6 +132,7 @@ class Collection:
                 if doc.get(key) != val:
                     return False
             doc.update(update)
+            self._notify(doc_id)
             return True
 
     def update(self, doc_id: str, update: Dict[str, Any]) -> bool:
@@ -118,6 +141,7 @@ class Collection:
             if doc is None:
                 return False
             doc.update(update)
+            self._notify(doc_id)
             return True
 
     def update_where(
@@ -128,6 +152,7 @@ class Collection:
             for doc in self._docs.values():
                 if pred(doc):
                     doc.update(update)
+                    self._notify(doc["_id"])
                     n += 1
             return n
 
@@ -138,6 +163,7 @@ class Collection:
             if doc is None:
                 return False
             fn(doc)
+            self._notify(doc_id)
             return True
 
     def snapshot(self) -> List[dict]:

@@ -32,6 +32,7 @@ def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
     def run(s: Store) -> None:
         opts = TickOptions(
             create_intent_hosts=not flags.host_allocator_disabled,
+            use_cache=True,  # long-lived service: incremental gathering
         )
         run_tick(s, opts, now=_time.time())
 

@@ -1,0 +1,121 @@
+"""Incremental tick cache: the cached gather must match the cold-path
+gather after arbitrary store churn (BASELINE config 5's correctness side)."""
+import random
+
+from evergreen_tpu.globals import Requester, TaskStatus
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+from evergreen_tpu.models.task import Dependency, Task
+from evergreen_tpu.scheduler.cache import TickCache
+from evergreen_tpu.scheduler.wrapper import (
+    TickOptions,
+    gather_tick_inputs,
+    run_tick,
+)
+
+NOW = 1_700_000_000.0
+
+
+def mk_task(i, distro="d1", **kw):
+    defaults = dict(
+        id=f"t{i:03d}", distro_id=distro, status=TaskStatus.UNDISPATCHED.value,
+        activated=True, requester=Requester.REPOTRACKER.value,
+        activated_time=NOW - 60, create_time=NOW - 100,
+        expected_duration_s=60.0,
+    )
+    defaults.update(kw)
+    return Task(**defaults)
+
+
+def snapshot_inputs(tup):
+    distros, tasks_by_distro, hosts_by_distro, estimates, deps_met = tup
+    return (
+        [d.id for d in distros],
+        {k: [t.id for t in v] for k, v in tasks_by_distro.items()},
+        dict(sorted(deps_met.items())),
+    )
+
+
+def test_cache_tracks_churn_exactly(store):
+    rng = random.Random(4)
+    for d in ("d1", "d2"):
+        distro_mod.insert(
+            store,
+            Distro(id=d,
+                   host_allocator_settings=HostAllocatorSettings(maximum_hosts=5)),
+        )
+    task_mod.insert_many(store, [mk_task(i) for i in range(30)])
+    cache = TickCache(store)
+    assert snapshot_inputs(cache.gather(NOW)) == snapshot_inputs(
+        gather_tick_inputs(store, NOW)
+    )
+
+    # churn: finishes, deactivations, priority-disable, new tasks, deps,
+    # secondary distros, removals
+    coll = task_mod.coll(store)
+    for step in range(60):
+        op = rng.randrange(6)
+        tid = f"t{rng.randrange(40):03d}"
+        if op == 0:
+            coll.update(tid, {"status": TaskStatus.SUCCEEDED.value})
+        elif op == 1:
+            coll.update(tid, {"activated": rng.random() < 0.5})
+        elif op == 2:
+            coll.update(tid, {"priority": rng.choice([-1, 0, 10])})
+        elif op == 3:
+            new_id = 100 + step
+            try:
+                task_mod.insert(
+                    store,
+                    mk_task(new_id, distro=rng.choice(["d1", "d2"]),
+                            secondary_distros=["d2"] if rng.random() < 0.4
+                            else []),
+                )
+            except KeyError:
+                pass
+        elif op == 4:
+            coll.update(
+                tid,
+                {"depends_on": [{"task_id": "t000", "status": "success",
+                                 "unattainable": rng.random() < 0.3,
+                                 "finished": False}]},
+            )
+        else:
+            coll.remove(tid)
+
+        got = snapshot_inputs(cache.gather(NOW))
+        want = snapshot_inputs(gather_tick_inputs(store, NOW))
+        assert got == want, f"divergence after step {step} (op {op})"
+
+
+def test_cached_tick_equals_cold_tick(store):
+    distro_mod.insert(
+        store,
+        Distro(id="d1",
+               host_allocator_settings=HostAllocatorSettings(maximum_hosts=5)),
+    )
+    task_mod.insert_many(
+        store,
+        [mk_task(i, priority=i % 7) for i in range(25)]
+        + [mk_task(100, depends_on=[Dependency(task_id="t001")])],
+    )
+    res_cold = run_tick(
+        store, TickOptions(create_intent_hosts=False, use_cache=False), now=NOW
+    )
+    from evergreen_tpu.models import task_queue as tq_mod
+
+    q_cold = [i.id for i in tq_mod.load(store, "d1").queue]
+    res_warm = run_tick(
+        store, TickOptions(create_intent_hosts=False, use_cache=True), now=NOW
+    )
+    q_warm = [i.id for i in tq_mod.load(store, "d1").queue]
+    assert q_cold == q_warm
+    assert res_cold.new_hosts == res_warm.new_hosts
+    # mutate and re-tick through the cache: changes are observed
+    task_mod.coll(store).update("t003", {"activated": False})
+    run_tick(
+        store, TickOptions(create_intent_hosts=False, use_cache=True), now=NOW
+    )
+    q2 = [i.id for i in tq_mod.load(store, "d1").queue]
+    assert "t003" not in q2 and len(q2) == len(q_warm) - 1
