@@ -4,13 +4,17 @@ The reference's finder re-queries Mongo for the full runnable set every
 tick for every distro (scheduler/task_finder.go). Under churn (BASELINE
 config 5 — generate.tasks growth, stepback activations, finishes) most of
 the set is unchanged tick to tick, so this cache subscribes to the tasks
-collection and re-materializes ONLY dirty documents; gather() then assembles
-the solver inputs from the warm runnable map instead of scanning the store.
+collection and re-materializes ONLY dirty documents; gather() then feeds
+the warm runnable set into the shared gather_tick_inputs assembly.
 
-Correctness: the listener fires inside the collection lock on every write
-path (storage/store.py), so a task can never change without landing in the
-dirty set; apply() re-evaluates dirty ids against the same predicate the
-cold-path finder uses (models/task.find_host_runnable).
+Invariants:
+  * the change listener fires inside the collection lock on every write
+    path (storage/store.py), so a task can never change without landing in
+    the dirty set; the dirty set has its own leaf lock (never held while
+    touching the store) so listener and drain cannot deadlock or lose ids;
+  * the emitted task order is the store's key order
+    (Collection.key_order), so a cached tick is bit-identical to a cold
+    rerun from the same store — resume ≡ rerun holds.
 """
 from __future__ import annotations
 
@@ -18,30 +22,26 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..globals import TaskStatus
-from ..models import distro as distro_mod
-from ..models import host as host_mod
 from ..models import task as task_mod
 from ..models.task import Task
 from ..storage.store import Store
-from . import serial
-from .snapshot import compute_deps_met
 
 
 class TickCache:
     def __init__(self, store: Store) -> None:
         self.store = store
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards _runnable/_primed
+        self._dirty_lock = threading.Lock()  # leaf lock: guards _dirty only
         self._dirty: Set[str] = set()
         self._primed = False
         #: runnable task id → materialized Task
         self._runnable: Dict[str, Task] = {}
         task_mod.coll(store).add_listener(self._on_task_change)
 
-    # listener runs under the collection lock: flag only
+    # Runs under the collection lock; touch only the leaf dirty lock.
     def _on_task_change(self, task_id: str) -> None:
-        self._dirty.add(task_id)
-        if not task_id:  # defensive; ids are never empty
-            self._primed = False
+        with self._dirty_lock:
+            self._dirty.add(task_id)
 
     def _qualifies(self, doc: Optional[dict]) -> bool:
         if doc is None:
@@ -62,13 +62,15 @@ class TickCache:
         """Fold pending changes into the runnable map; returns changes."""
         with self._lock:
             if not self._primed:
+                with self._dirty_lock:
+                    self._dirty.clear()
                 self._runnable = {
                     t.id: t for t in task_mod.find_host_runnable(self.store)
                 }
-                self._dirty.clear()
                 self._primed = True
                 return len(self._runnable)
-            dirty, self._dirty = self._dirty, set()
+            with self._dirty_lock:
+                dirty, self._dirty = self._dirty, set()
             coll = task_mod.coll(self.store)
             n = 0
             for tid in dirty:
@@ -81,74 +83,25 @@ class TickCache:
                     n += 1
             return n
 
+    def runnable_in_store_order(self) -> List[Task]:
+        """The warm runnable set, ordered exactly as a cold collection scan
+        would emit it (value-tied tasks break ties by input position in the
+        planner, serial.py, so ordering is part of correctness)."""
+        self.apply_dirty()
+        order = task_mod.coll(self.store).key_order()
+        with self._lock:
+            tasks = list(self._runnable.values())
+        tasks.sort(key=lambda t: order.get(t.id, 1 << 60))
+        return tasks
+
     def gather(self, now: float) -> Tuple:
         """Same contract as scheduler.wrapper.gather_tick_inputs, served
         from the warm runnable map."""
-        self.apply_dirty()
-        distros = distro_mod.find_needs_hosts_planning(self.store)
-        all_ids = {d.id for d in distros}
-        plannable = {d.id for d in distro_mod.find_needs_planning(self.store)}
+        from .wrapper import gather_tick_inputs
 
-        tasks_by_distro: Dict[str, List[Task]] = {d.id: [] for d in distros}
-        alias_tasks: Dict[str, List[Task]] = {}
-        runnable: List[Task] = []
-        with self._lock:
-            current = list(self._runnable.values())
-        for t in current:
-            if t.distro_id in plannable:
-                tasks_by_distro[t.distro_id].append(t)
-                runnable.append(t)
-            for sd in t.secondary_distros:
-                if sd in plannable and sd != t.distro_id:
-                    alias_tasks.setdefault(sd, []).append(t)
-                    if t.distro_id not in plannable:
-                        runnable.append(t)
-        import dataclasses as _dc
-
-        from .wrapper import ALIAS_SUFFIX
-
-        for did, ts in sorted(alias_tasks.items()):
-            base = next(d for d in distros if d.id == did)
-            alias = _dc.replace(base, id=f"{did}{ALIAS_SUFFIX}")
-            distros.append(alias)
-            tasks_by_distro[alias.id] = ts
-
-        from ..globals import TASK_COMPLETED_STATUSES
-
-        parent_ids = {d.task_id for t in runnable for d in t.depends_on}
-        coll = task_mod.coll(self.store)
-        finished_status = {}
-        for doc in coll.find_ids(list(parent_ids)):
-            if doc["status"] in TASK_COMPLETED_STATUSES:
-                finished_status[doc["_id"]] = doc["status"]
-        deps_met = compute_deps_met(runnable, finished_status)
-
-        hosts_by_distro: Dict[str, List] = {d.id: [] for d in distros}
-        active_hosts = [
-            h
-            for h in host_mod.all_active_hosts(self.store)
-            if h.distro_id in all_ids
-        ]
-        from ..globals import DEFAULT_TASK_DURATION_S
-
-        running_ids = [h.running_task for h in active_hosts if h.running_task]
-        running_docs = {
-            d["_id"]: d for d in coll.find_ids(running_ids)
-        }
-        running_estimates: Dict[str, serial.RunningTaskEstimate] = {}
-        for h in active_hosts:
-            hosts_by_distro[h.distro_id].append(h)
-            if h.running_task:
-                rd = running_docs.get(h.running_task)
-                if rd is not None:
-                    dur = rd.get("expected_duration_s", 0.0)
-                    running_estimates[h.id] = serial.RunningTaskEstimate(
-                        elapsed_s=max(0.0, now - rd.get("start_time", now)),
-                        expected_s=dur if dur > 0 else float(DEFAULT_TASK_DURATION_S),
-                        std_dev_s=rd.get("duration_std_dev_s", 0.0)
-                        if dur > 0 else 0.0,
-                    )
-        return distros, tasks_by_distro, hosts_by_distro, running_estimates, deps_met
+        return gather_tick_inputs(
+            self.store, now, runnable_tasks=self.runnable_in_store_order()
+        )
 
     def runnable_count(self) -> int:
         with self._lock:

@@ -12,28 +12,8 @@ from typing import Dict, List, Optional
 
 from ..models import task as task_mod
 from ..models.task import Task
-from ..models.task_queue import (
-    DistroQueueInfo,
-    TaskQueue,
-    TaskQueueItem,
-    save,
-)
+from ..models.task_queue import DistroQueueInfo
 from ..storage.store import Store
-
-
-def cap_queue_length(
-    items: List[TaskQueueItem], max_len: int
-) -> List[TaskQueueItem]:
-    """task_queue_persister.go:66-84: truncate to max_len but keep a task
-    group that straddles the cut whole."""
-    if max_len <= 0 or len(items) <= max_len:
-        return items
-    cut = max_len
-    straddler = items[cut - 1].task_group
-    if straddler:
-        while cut < len(items) and items[cut].task_group == straddler:
-            cut += 1
-    return items[:cut]
 
 
 def persist_task_queue(
@@ -78,7 +58,7 @@ def persist_task_queue(
         **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
         "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],
     }
-    tq_coll = save_doc(
+    save_doc(
         store,
         {
             "_id": distro_id,

@@ -53,24 +53,28 @@ class TickOptions:
     use_cache: bool = False
 
 
-_tick_caches = None
+#: per-store TickCache singletons. Intentionally strong references: a
+#: TickCache registers an unremovable listener on the store's tasks
+#: collection, so cache and store share a lifetime anyway; a process holds
+#: one long-lived store (plus short-lived test stores, which die with their
+#: interpreter). Guarded so concurrent first ticks cannot register two
+#: listeners.
+_tick_caches: Dict[int, object] = {}
+_tick_caches_lock = __import__("threading").Lock()
 
 
 def tick_cache_for(store: Store):
     """Per-store TickCache singleton (the long-lived service uses one so
     each tick only re-materializes changed tasks)."""
-    global _tick_caches
     from .cache import TickCache
 
-    if _tick_caches is None:
-        import weakref
-
-        _tick_caches = weakref.WeakKeyDictionary()
-    cache = _tick_caches.get(store)
-    if cache is None:
-        cache = TickCache(store)
-        _tick_caches[store] = cache
-    return cache
+    key = id(store)
+    with _tick_caches_lock:
+        entry = _tick_caches.get(key)
+        if entry is None or entry[0] is not store:
+            entry = (store, TickCache(store))
+            _tick_caches[key] = entry
+        return entry[1]
 
 
 @dataclasses.dataclass
@@ -87,7 +91,9 @@ class TickResult:
 
 
 def gather_tick_inputs(
-    store: Store, now: float
+    store: Store,
+    now: float,
+    runnable_tasks: Optional[List[Task]] = None,
 ) -> Tuple[
     List[Distro],
     Dict[str, List[Task]],
@@ -96,7 +102,13 @@ def gather_tick_inputs(
     Dict[str, bool],
 ]:
     """Read the store into solver inputs: runnable tasks per distro, active
-    hosts per distro, running-task duration estimates, dep-met mask."""
+    hosts per distro, running-task duration estimates, dep-met mask.
+
+    ``runnable_tasks`` lets the incremental TickCache supply the warm
+    runnable set (already in store order); when absent, the cold-path
+    finder scans the collection (scheduler/task_finder.go:34-36 analog) —
+    never the full task history.
+    """
     # The snapshot covers the allocator's distro set (a superset that
     # includes disabled distros, which still maintain minimum hosts); task
     # queues are only gathered for the plannable subset (reference
@@ -105,13 +117,13 @@ def gather_tick_inputs(
     all_ids = {d.id for d in distros}
     distro_ids = {d.id for d in distro_mod.find_needs_planning(store)}
 
-    # Materialize only runnable tasks (the finder's doc-level filter,
-    # scheduler/task_finder.go:34-36) — NOT the full task history, which
-    # grows without bound in a CI system.
+    if runnable_tasks is None:
+        runnable_tasks = task_mod.find_host_runnable(store)
+
     tasks_by_distro: Dict[str, List[Task]] = {d.id: [] for d in distros}
     alias_tasks: Dict[str, List[Task]] = {}
     runnable: List[Task] = []
-    for t in task_mod.find_host_runnable(store):
+    for t in runnable_tasks:
         if t.distro_id in distro_ids:
             tasks_by_distro[t.distro_id].append(t)
             runnable.append(t)
@@ -130,12 +142,16 @@ def gather_tick_inputs(
         distros.append(alias)
         tasks_by_distro[alias.id] = tasks
 
-    # Resolve only the dependency parents the runnable set references.
+    # Resolve dependency parents + running-task estimates from raw docs
+    # (materializing Task objects here is hot-loop cost).
+    from ..globals import DEFAULT_TASK_DURATION_S, TASK_COMPLETED_STATUSES
+
+    coll = task_mod.coll(store)
     parent_ids = {d.task_id for t in runnable for d in t.depends_on}
     finished_status = {
-        t.id: t.status
-        for t in task_mod.by_ids(store, list(parent_ids))
-        if t.is_finished()
+        doc["_id"]: doc["status"]
+        for doc in coll.find_ids(list(parent_ids))
+        if doc["status"] in TASK_COMPLETED_STATUSES
     }
     deps_met = compute_deps_met(runnable, finished_status)
 
@@ -144,18 +160,19 @@ def gather_tick_inputs(
         h for h in host_mod.all_active_hosts(store) if h.distro_id in all_ids
     ]
     running_ids = [h.running_task for h in active_hosts if h.running_task]
-    running_tasks = {t.id: t for t in task_mod.by_ids(store, running_ids)}
+    running_docs = {d["_id"]: d for d in coll.find_ids(running_ids)}
     running_estimates: Dict[str, serial.RunningTaskEstimate] = {}
     for h in active_hosts:
         hosts_by_distro[h.distro_id].append(h)
         if h.running_task:
-            rt = running_tasks.get(h.running_task)
-            if rt is not None:
-                stats = rt.fetch_expected_duration()
+            rd = running_docs.get(h.running_task)
+            if rd is not None:
+                dur = rd.get("expected_duration_s", 0.0)
                 running_estimates[h.id] = serial.RunningTaskEstimate(
-                    elapsed_s=max(0.0, now - rt.start_time),
-                    expected_s=stats.average_s,
-                    std_dev_s=stats.std_dev_s,
+                    elapsed_s=max(0.0, now - rd.get("start_time", now)),
+                    expected_s=dur if dur > 0 else float(DEFAULT_TASK_DURATION_S),
+                    std_dev_s=rd.get("duration_std_dev_s", 0.0)
+                    if dur > 0 else 0.0,
                 )
     return distros, tasks_by_distro, hosts_by_distro, running_estimates, deps_met
 

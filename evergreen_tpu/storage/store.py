@@ -76,6 +76,12 @@ class Collection:
         with self._lock:
             return [self._docs[i] for i in ids if i in self._docs]
 
+    def key_order(self) -> Dict[str, int]:
+        """id → insertion position (dicts preserve insertion order); the
+        deterministic ordering contract incremental caches must reproduce."""
+        with self._lock:
+            return {k: i for i, k in enumerate(self._docs)}
+
     def remove(self, doc_id: str) -> bool:
         with self._lock:
             gone = self._docs.pop(doc_id, None) is not None

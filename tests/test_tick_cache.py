@@ -37,8 +37,12 @@ def snapshot_inputs(tup):
     )
 
 
-def test_cache_tracks_churn_exactly(store):
-    rng = random.Random(4)
+import pytest
+
+
+@pytest.mark.parametrize("seed", [4, 5, 7, 8, 9, 11, 17])
+def test_cache_tracks_churn_exactly(store, seed):
+    rng = random.Random(seed)
     for d in ("d1", "d2"):
         distro_mod.insert(
             store,
@@ -87,6 +91,27 @@ def test_cache_tracks_churn_exactly(store):
         got = snapshot_inputs(cache.gather(NOW))
         want = snapshot_inputs(gather_tick_inputs(store, NOW))
         assert got == want, f"divergence after step {step} (op {op})"
+
+
+def test_cache_requalification_preserves_store_order(store):
+    """Deactivate→reactivate must not move a task to the end of the cached
+    ordering (value ties break by input position in the planner)."""
+    distro_mod.insert(
+        store,
+        Distro(id="d1",
+               host_allocator_settings=HostAllocatorSettings(maximum_hosts=5)),
+    )
+    task_mod.insert_many(store, [mk_task(i) for i in range(6)])
+    cache = TickCache(store)
+    cache.gather(NOW)
+    coll = task_mod.coll(store)
+    coll.update("t002", {"activated": False})
+    cache.gather(NOW)
+    coll.update("t002", {"activated": True})
+    got = snapshot_inputs(cache.gather(NOW))
+    want = snapshot_inputs(gather_tick_inputs(store, NOW))
+    assert got == want
+    assert got[1]["d1"] == [f"t{i:03d}" for i in range(6)]
 
 
 def test_cached_tick_equals_cold_tick(store):
