@@ -143,6 +143,11 @@ class GithubHookHandler:
             out = patch_mod.finalize_patch(self.store, patch_id, now=now)
             if out is not None:
                 created.append(out.version.id)
+                from ..events.github_status import subscribe_patch_status
+
+                subscribe_patch_status(
+                    self.store, patch_id, out.version.id, owner, name, head_sha
+                )
         return 200, {"versions": created}
 
     # -- merge_group → merge queue ------------------------------------------- #

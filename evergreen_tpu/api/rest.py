@@ -77,6 +77,9 @@ class RestApi:
 
         self.github_hooks = GithubHookHandler(store)
         self.webhook_secret = ""
+        from ..events.github_status import install as _install_ghs
+
+        _install_ghs(store)
 
     def _github_hook(self, raw: bytes, headers: Dict[str, str], body: dict):
         from .github_hooks import verify_signature

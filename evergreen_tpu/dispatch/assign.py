@@ -20,6 +20,43 @@ from ..storage.store import Store
 from .dag_dispatcher import DispatcherService, TaskSpec
 
 
+class _LargeParserGuard:
+    """Per-assignment-call cache of the large-parser concurrency check."""
+
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self._limit: Optional[int] = None
+        self._in_flight: Optional[int] = None
+        self._large_versions: dict = {}
+
+    def _version_is_large(self, version_id: str) -> bool:
+        cached = self._large_versions.get(version_id)
+        if cached is None:
+            doc = self.store.collection("parser_projects").get(version_id)
+            cached = bool(doc and doc.get("large"))
+            self._large_versions[version_id] = cached
+        return cached
+
+    def blocks(self, t: Task) -> bool:
+        if self._limit is None:
+            from ..settings import TaskLimitsConfig
+
+            self._limit = (
+                TaskLimitsConfig.get(self.store)
+                .max_concurrent_large_parser_project_tasks
+            )
+        if self._limit <= 0 or not self._version_is_large(t.version):
+            return False
+        if self._in_flight is None:
+            from ..globals import TASK_IN_PROGRESS_STATUSES
+
+            self._in_flight = task_mod.coll(self.store).count(
+                lambda d: d["status"] in TASK_IN_PROGRESS_STATUSES
+                and self._version_is_large(d["version"])
+            )
+        return self._in_flight >= self._limit
+
+
 def spec_for_host(host: Host) -> TaskSpec:
     """Task-group stickiness comes from the host's last-run context
     (reference host_agent.go builds TaskSpec from the host's LastGroup)."""
@@ -52,6 +89,7 @@ def assign_next_available_task(
     dispatcher.refresh(now)
     secondary: Optional[object] = None  # lazily-built alias-queue fallback
 
+    large_guard = _LargeParserGuard(store)
     while True:
         item = dispatcher.find_next_task(spec, now)
         if item is None:
@@ -66,6 +104,11 @@ def assign_next_available_task(
                 return None
         t = task_mod.get(store, item.id)
         if t is None:
+            continue
+        if large_guard.blocks(t):
+            # concurrency cap on large-parser-project tasks (reference
+            # checkMaxConcurrentLargeParserProjectTasks,
+            # model/task_queue_service_dependency.go:572-594)
             continue
         # Re-validate against the live document: planning ran up to a tick
         # ago (host_agent.go ProjectCanDispatchTask gate).

@@ -1,0 +1,84 @@
+"""GitHub commit-status reporting.
+
+Reference: units/github_status_api.go + the PR-patch status subscriptions
+created at intent processing (units/patch_intent.go:515-592). Outbound
+delivery is a seam: statuses land in the ``github_status_outbox``
+collection, which a deployment drains with a real GitHub client (this
+image is zero-egress). The notifier pipeline routes version-outcome events
+for PR/merge patches here via the standard subscription machinery.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time as _time
+from typing import List, Optional
+
+from ..storage.store import Store
+from .triggers import (
+    Notification,
+    Subscription,
+    TRIGGER_OUTCOME,
+    add_subscription,
+    register_sender,
+)
+
+OUTBOX_COLLECTION = "github_status_outbox"
+
+_seq = itertools.count()
+_lock = threading.Lock()
+_store_ref: Optional[Store] = None
+
+
+def install(store: Store) -> None:
+    """Register the github-status channel sender bound to this store."""
+    global _store_ref
+    _store_ref = store
+    register_sender("github-status", _send)
+
+
+def _send(ntf: Notification) -> None:
+    if _store_ref is None:
+        raise RuntimeError("github-status sender not installed")
+    with _lock:
+        n = next(_seq)
+    # target format: "<owner>/<repo>@<sha>"
+    repo, _, sha = ntf.subscriber_target.partition("@")
+    state = "failure" if "fail" in ntf.body else "success"
+    _store_ref.collection(OUTBOX_COLLECTION).upsert(
+        {
+            "_id": f"ghs-{n}",
+            "repo": repo,
+            "sha": sha,
+            "state": state,
+            "description": ntf.subject,
+            "context": "evergreen-tpu",
+            "created_at": _time.time(),
+            "delivered": False,
+        }
+    )
+
+
+def subscribe_patch_status(
+    store: Store, patch_id: str, version_id: str, owner: str, repo: str,
+    head_sha: str,
+) -> None:
+    """Version outcome → GitHub status for a PR/merge patch (the
+    subscriptions the reference creates per patch intent)."""
+    add_subscription(
+        store,
+        Subscription(
+            id=f"ghs-{patch_id}",
+            resource_type="VERSION",
+            trigger=TRIGGER_OUTCOME,
+            subscriber_type="github-status",
+            subscriber_target=f"{owner}/{repo}@{head_sha}",
+            filters={"id": version_id},
+        ),
+    )
+
+
+def pending_statuses(store: Store) -> List[dict]:
+    return store.collection(OUTBOX_COLLECTION).find(
+        lambda d: not d["delivered"]
+    )

@@ -197,6 +197,12 @@ def create_version(
     from .matrix import expand_matrices
 
     expand_matrices(pp)
+    if not pp.buildvariants or not pp.tasks:
+        # an empty/missing config must surface as a failed (stub) version,
+        # not a silent zero-task version (repotracker stub path)
+        raise ProjectParseError(
+            "project config defines no buildvariants or no tasks"
+        )
     return materialize_version(
         store,
         pp,
@@ -482,8 +488,16 @@ def build_agent_config_doc(version_id: str, pp: ParserProject) -> Dict[str, Any]
     variants_doc = {
         bv.name: {"expansions": bv.expansions} for bv in pp.buildvariants
     }
+    # "large parser project" flag: the reference stores oversized parser
+    # projects in S3 and throttles how many of their tasks run concurrently
+    # (NumQueuedLargeParserProjectTasks, model/task_queue.go;
+    # checkMaxConcurrentLargeParserProjectTasks in the dispatcher)
+    is_large = len(tasks_doc) > 500 or sum(
+        len(t["commands"]) for t in tasks_doc.values()
+    ) > 5000
     return {
         "_id": version_id,
+        "large": is_large,
         "pre": expand_function_commands(pp, pp.pre),
         "post": expand_function_commands(pp, pp.post),
         "timeout": expand_function_commands(pp, pp.timeout),

@@ -330,3 +330,68 @@ def test_volumes_and_sleep_schedules(store):
     acted = enforce_sleep_schedules(store, noon)
     assert acted == [h.id]
     assert host_mod.get(store, h.id).status == HostStatus.RUNNING.value
+
+
+def test_github_status_outbox(store):
+    from evergreen_tpu.events import github_status as ghs
+    from evergreen_tpu.events.triggers import process_unprocessed_events
+    from evergreen_tpu.models import event as event_mod
+    from evergreen_tpu.models import version as version_mod
+    from evergreen_tpu.models.version import Version
+
+    ghs.install(store)
+    version_mod.insert(store, Version(id="pv1", project="proj", status="failed"))
+    ghs.subscribe_patch_status(store, "p1", "pv1", "acme", "widgets", "abc123")
+    event_mod.log(
+        store, event_mod.RESOURCE_VERSION, "VERSION_FAILED", "pv1",
+        {"status": "failed"}, timestamp=NOW,
+    )
+    process_unprocessed_events(store, now=NOW)
+    pending = ghs.pending_statuses(store)
+    assert len(pending) == 1
+    assert pending[0]["repo"] == "acme/widgets"
+    assert pending[0]["sha"] == "abc123"
+    assert pending[0]["state"] == "failure"
+
+
+def test_large_parser_project_throttle(store):
+    from evergreen_tpu.dispatch.assign import assign_next_available_task
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+    from evergreen_tpu.settings import TaskLimitsConfig
+
+    TaskLimitsConfig(max_concurrent_large_parser_project_tasks=1).set(store)
+    store.collection("parser_projects").upsert({"_id": "vbig", "large": True})
+    # one large-project task already running
+    task_mod.insert(
+        store,
+        Task(id="running-big", version="vbig", distro_id="d1",
+             status=TaskStatus.STARTED.value, activated=True),
+    )
+    task_mod.insert(
+        store,
+        Task(id="queued-big", version="vbig", distro_id="d1",
+             status=TaskStatus.UNDISPATCHED.value, activated=True),
+    )
+    task_mod.insert(
+        store,
+        Task(id="queued-small", version="vsmall", distro_id="d1",
+             status=TaskStatus.UNDISPATCHED.value, activated=True),
+    )
+    tq_mod.save(
+        store,
+        TaskQueue(
+            distro_id="d1",
+            queue=[TaskQueueItem(id="queued-big", dependencies_met=True),
+                   TaskQueueItem(id="queued-small", dependencies_met=True)],
+            generated_at=NOW,
+        ),
+    )
+    host_mod.insert(
+        store, Host(id="h1", distro_id="d1", status=HostStatus.RUNNING.value)
+    )
+    svc = DispatcherService(store)
+    got = assign_next_available_task(store, svc, host_mod.get(store, "h1"), NOW)
+    # the big-project task is throttled; the small one dispatches
+    assert got is not None and got.id == "queued-small"
