@@ -1,0 +1,185 @@
+"""Golden allocator/planner scenarios, ported in spirit from the
+reference's scheduler test suites (scheduler/utilization_based_host_
+allocator_test.go + scheduler/planner_test.go behaviors). Each scenario
+runs through BOTH the serial oracle and the device solve."""
+import pytest
+
+from evergreen_tpu.globals import (
+    Provider,
+    Requester,
+    STEPBACK_TASK_ACTIVATOR,
+)
+from evergreen_tpu.models.distro import (
+    Distro,
+    HostAllocatorSettings,
+    PlannerSettings,
+)
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.ops.solve import run_solve_packed
+from evergreen_tpu.scheduler import serial
+from evergreen_tpu.scheduler.snapshot import build_snapshot
+
+NOW = 1_700_000_000.0
+
+
+def run_both(distro, tasks, hosts, estimates=None, deps_met=None):
+    estimates = estimates or {}
+    deps_met = deps_met or {t.id: True for t in tasks}
+    plan, _ = serial.plan_distro_queue(distro, tasks, NOW)
+    info = serial.get_distro_queue_info(distro, plan, deps_met, NOW)
+    n_serial, _ = serial.utilization_based_host_allocator(
+        serial.AllocatorInput(
+            distro=distro, existing_hosts=hosts, queue_info=info,
+            running_estimates=estimates,
+        )
+    )
+    snap = build_snapshot(
+        [distro], {distro.id: tasks}, {distro.id: hosts}, estimates,
+        deps_met, NOW,
+    )
+    out = run_solve_packed(snap)
+    n_device = int(out["d_new_hosts"][0])
+    assert n_serial == n_device, (n_serial, n_device)
+    order = [
+        snap.task_ids[i] for i in out["order"] if i < snap.n_tasks
+    ]
+    assert order == [t.id for t in plan]
+    return n_serial, plan
+
+
+def mk_distro(**hs):
+    defaults = dict(maximum_hosts=50)
+    defaults.update(hs)
+    return Distro(
+        id="d0", provider=Provider.MOCK.value,
+        host_allocator_settings=HostAllocatorSettings(**defaults),
+    )
+
+
+def mk_task(i, dur, **kw):
+    defaults = dict(
+        id=f"t{i}", distro_id="d0", status="undispatched", activated=True,
+        requester=Requester.REPOTRACKER.value, activated_time=NOW - 300,
+        create_time=NOW - 400, scheduled_time=NOW - 300,
+        expected_duration_s=dur,
+    )
+    defaults.update(kw)
+    return Task(**defaults)
+
+
+def free_host(i):
+    return Host(id=f"h{i}", distro_id="d0", status="running")
+
+
+def busy_host(i, elapsed, expected, std=0.0):
+    h = Host(id=f"h{i}", distro_id="d0", status="running",
+             running_task=f"r{i}")
+    return h, serial.RunningTaskEstimate(
+        elapsed_s=elapsed, expected_s=expected, std_dev_s=std
+    )
+
+
+def test_no_tasks_no_hosts():
+    n, _ = run_both(mk_distro(), [], [])
+    assert n == 0
+
+
+def test_small_queue_rescue_spawns_one():
+    # 20 min of work / 30 min target < 1 host, no free hosts → exactly 1
+    n, _ = run_both(mk_distro(), [mk_task(0, 600), mk_task(1, 600)], [])
+    assert n == 1
+
+
+def test_free_hosts_absorb_load():
+    tasks = [mk_task(i, 600) for i in range(4)]  # 40 min work
+    hosts = [free_host(i) for i in range(2)]
+    n, _ = run_both(mk_distro(), tasks, hosts)
+    assert n == 0  # 40/30 = 1.33 needed, 2 free
+
+
+def test_long_tasks_get_dedicated_hosts():
+    # each task longer than the 30-min threshold → one host per task
+    tasks = [mk_task(i, 3600) for i in range(3)]
+    n, _ = run_both(mk_distro(), tasks, [])
+    assert n == 3
+
+
+def test_max_hosts_caps_spawning():
+    tasks = [mk_task(i, 3600) for i in range(10)]
+    hosts = [free_host(i) for i in range(2)]
+    n, _ = run_both(mk_distro(maximum_hosts=5), tasks, hosts)
+    assert n == 3  # cap 5 - 2 existing
+
+
+def test_at_max_hosts_returns_zero():
+    tasks = [mk_task(i, 3600) for i in range(10)]
+    hosts = [free_host(i) for i in range(5)]
+    n, _ = run_both(mk_distro(maximum_hosts=5), tasks, hosts)
+    assert n == 0
+
+
+def test_static_provider_never_spawns():
+    d = Distro(
+        id="d0", provider=Provider.STATIC.value,
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=50),
+    )
+    tasks = [mk_task(i, 3600) for i in range(5)]
+    n, _ = run_both(d, tasks, [])
+    assert n == 0
+
+
+def test_soon_free_hosts_reduce_spawning():
+    # 60 min of short work; a busy host with 5 min left counts fractionally
+    tasks = [mk_task(i, 1200) for i in range(3)]  # 60 min total
+    h, est = busy_host(0, elapsed=1500, expected=1800)
+    n, _ = run_both(
+        mk_distro(future_host_fraction=1.0), tasks, [h], {h.id: est}
+    )
+    # turnaround needs 2 hosts; soon-free ≈ (1800-300)/1800 = 0.83 → floor 0
+    assert n == 2
+
+
+def test_3sigma_outlier_host_not_counted_free():
+    tasks = [mk_task(i, 1200) for i in range(3)]
+    # task way over its expected duration with tight std: frac forced to 0
+    h, est = busy_host(0, elapsed=4 * 1800, expected=600, std=10.0)
+    n_out, _ = run_both(
+        mk_distro(future_host_fraction=1.0), tasks, [h], {h.id: est}
+    )
+    assert n_out == 2
+
+
+def test_stepback_and_priority_order():
+    d = Distro(
+        id="d0", provider=Provider.MOCK.value,
+        planner_settings=PlannerSettings(stepback_task_factor=50),
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=50),
+    )
+    normal = mk_task(0, 600)
+    stepback = mk_task(1, 600, activated_by=STEPBACK_TASK_ACTIVATOR)
+    priority = mk_task(2, 600, priority=90)
+    _, plan = run_both(d, [normal, stepback, priority], [])
+    assert [t.id for t in plan] == ["t2", "t1", "t0"]
+
+
+def test_patch_outranks_mainline_with_factor():
+    # a fresh mainline commit carries the 7-day recency bonus (~168 x
+    # mainline factor, planner.go:246-251), so the patch factor must beat
+    # it — with 300 the patch wins; with the default it would not
+    d = Distro(
+        id="d0", provider=Provider.MOCK.value,
+        planner_settings=PlannerSettings(patch_factor=300),
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=50),
+    )
+    mainline = mk_task(0, 600)
+    patch = mk_task(1, 600, requester=Requester.PATCH.value)
+    _, plan = run_both(d, [mainline, patch], [])
+    assert plan[0].id == "t1"
+
+
+def test_disabled_distro_tops_up_minimum():
+    d = mk_distro(minimum_hosts=2)
+    d.disabled = True
+    n, _ = run_both(d, [], [free_host(0)])
+    assert n == 1
