@@ -144,21 +144,36 @@ def find_all(store: Store) -> List[Distro]:
     return [Distro.from_doc(d) for d in coll(store).find()]
 
 
+def _pool_parent_ids(store: Store) -> set:
+    """Distro ids that serve as container-pool PARENT hosts — these are
+    managed by the pool-capacity logic, not the normal scheduler/allocator
+    fan-out (reference ByNeedsPlanning's $nin over
+    config.ContainerPools.Pools[*].Distro, model/distro/db.go:199-212).
+    Container distros themselves ARE planned and allocated."""
+    doc = store.collection("config").get("container_pools")
+    if not doc:
+        return set()
+    return {p.get("distro", "") for p in doc.get("pools", [])}
+
+
 def find_needs_planning(store: Store) -> List[Distro]:
     """Distros whose task queues get planned: non-disabled ones, plus static
     distros even when disabled (reference distro.ByNeedsPlanning,
     model/distro/db.go:198-212)."""
+    parents = _pool_parent_ids(store)
     return [
         d
         for d in find_all(store)
         if (not d.disabled or d.provider == Provider.STATIC.value)
-        and not d.container_pool
+        and d.id not in parents
     ]
 
 
 def find_needs_hosts_planning(store: Store) -> List[Distro]:
-    """Distros the host allocator runs for: ALL non-container-pool distros,
-    including disabled ones — disabled distros still maintain their minimum
-    hosts (reference distro.ByNeedsHostsPlanning, model/distro/db.go:214-224,
-    and the disabled branch of UtilizationBasedHostAllocator :51-67)."""
-    return [d for d in find_all(store) if not d.container_pool]
+    """Distros the host allocator runs for: everything except container-pool
+    parent distros, including disabled ones — disabled distros still
+    maintain their minimum hosts (reference distro.ByNeedsHostsPlanning,
+    model/distro/db.go:214-224, and the disabled branch of
+    UtilizationBasedHostAllocator :51-67)."""
+    parents = _pool_parent_ids(store)
+    return [d for d in find_all(store) if d.id not in parents]

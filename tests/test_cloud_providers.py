@@ -146,3 +146,73 @@ def test_spawn_host_lifecycle_and_expiration(store):
     expired = spawnhost.expire_spawn_hosts(store, new_exp + 1)
     assert expired == [h.id]
     assert host_mod.get(store, h.id).status == HostStatus.TERMINATED.value
+
+
+def test_container_distro_planned_end_to_end(store, tmp_path):
+    """Container distros must flow through the normal tick (they were the
+    reference's ByNeedsPlanning inclusion; only pool PARENTS are excluded)."""
+    from evergreen_tpu.agent.agent import Agent, AgentOptions
+    from evergreen_tpu.agent.comm import LocalCommunicator
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+    docker_mod.reset_default_client()
+    MockCloudManager.reset()
+    set_container_pools(
+        store, [ContainerPool(id="pool1", distro="d-parent", max_containers=2)]
+    )
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d-parent", provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=2),
+        ),
+    )
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d-containers", provider=Provider.DOCKER.value,
+            container_pool="pool1",
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=4),
+        ),
+    )
+    store.collection("parser_projects").upsert(
+        {"_id": "v1", "tasks": {"job": {"commands": [
+            {"command": "shell.exec", "params": {"script": "echo in-container"}}
+        ]}}}
+    )
+    task_mod.insert(
+        store,
+        Task(id="ct1", display_name="job", version="v1",
+             distro_id="d-containers", status="undispatched", activated=True,
+             activated_time=NOW - 60, create_time=NOW - 100,
+             expected_duration_s=60),
+    )
+
+    res = run_tick(store, TickOptions(), now=NOW)
+    # the container distro was planned and allocated
+    assert res.new_hosts.get("d-containers", 0) >= 1
+    # parent distro is NOT part of the allocator fan-out
+    assert "d-parent" not in res.new_hosts
+
+    # pool capacity job creates parents; provisioning brings everything up
+    ensure_parent_capacity(store, NOW)
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW)
+    create_hosts_from_intents(store, NOW)  # containers onto live parents
+    provision_ready_hosts(store, NOW)
+    container_hosts = host_mod.find(
+        store,
+        lambda d: d["distro_id"] == "d-containers"
+        and d["status"] == HostStatus.RUNNING.value,
+    )
+    assert container_hosts
+
+    agent = Agent(
+        LocalCommunicator(store, DispatcherService(store)),
+        AgentOptions(host_id=container_hosts[0].id, work_dir=str(tmp_path)),
+    )
+    assert agent.run_until_idle() == ["ct1"]
+    assert task_mod.get(store, "ct1").status == "success"
