@@ -163,6 +163,14 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_smoke(args) -> int:
+    """Boot everything in one process and drive a sample project to green
+    (reference smoke harness, smoke/internal/)."""
+    from .smoke import run_demo
+
+    return run_demo(port=args.port)
+
+
 def cmd_bench(args) -> int:
     import subprocess
 
@@ -232,6 +240,10 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="service status")
     st.add_argument("--api-server", default="http://127.0.0.1:9090")
     st.set_defaults(fn=cmd_status)
+
+    sm = sub.add_parser("smoke", help="one-process end-to-end smoke demo")
+    sm.add_argument("--port", type=int, default=0)
+    sm.set_defaults(fn=cmd_smoke)
 
     b = sub.add_parser("bench", help="run the scheduling benchmark")
     b.set_defaults(fn=cmd_bench)

@@ -1,0 +1,159 @@
+"""One-command smoke demo: the whole platform in one process.
+
+The reference ships a smoke harness that boots a real app server + agent
+against seeded data and drives a task through the full lifecycle
+(smoke/internal/host/smoke_test.go, cmd/load-smoke-data). Same idea:
+seed a sample project + distro, run the cron plane until hosts exist, run
+an agent over HTTP, and report what happened.
+"""
+from __future__ import annotations
+
+import json
+import tempfile
+import textwrap
+import time
+import threading
+import urllib.request
+
+SAMPLE_PROJECT = textwrap.dedent(
+    """
+    functions:
+      banner:
+        - command: shell.exec
+          params: {script: "echo === ${phase} ==="}
+    tasks:
+      - name: compile
+        commands:
+          - func: banner
+            vars: {phase: compile}
+          - command: shell.exec
+            params: {script: "echo compiling && sleep 0.1 && echo done > artifact.txt"}
+          - command: s3.put
+            params: {local_file: artifact.txt, remote_file: "builds/artifact.txt"}
+      - name: unit-tests
+        depends_on: [{name: compile}]
+        commands:
+          - func: banner
+            vars: {phase: test}
+          - command: shell.exec
+            params: {script: "echo 'ok 1 - smoke' && true"}
+      - name: lint
+        commands:
+          - command: shell.exec
+            params: {script: "echo linting"}
+    buildvariants:
+      - name: linux
+        display_name: "Linux smoke"
+        run_on: [smoke-distro]
+        tasks: [{name: compile}, {name: unit-tests}, {name: lint}]
+    """
+)
+
+
+def run_demo(port: int = 0, verbose: bool = True) -> int:
+    from .api.rest import RestApi
+    from .queue.jobs import JobQueue
+    from .storage.store import Store
+    from .units.crons import build_cron_runner
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(msg)
+
+    store = Store()
+    api = RestApi(store)
+    server = api.serve("127.0.0.1", port)
+    actual_port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    queue = JobQueue(store, workers=4)
+    runner = build_cron_runner(store, queue)
+    base = f"http://127.0.0.1:{actual_port}"
+    log(f"service up at {base}")
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            f"{base}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    call("PUT", "/rest/v2/distros/smoke-distro",
+         {"provider": "mock",
+          "host_allocator_settings": {"maximum_hosts": 3}})
+    call("PUT", "/rest/v2/projects/smoke-project", {"display_name": "Smoke"})
+    out = call(
+        "POST", "/rest/v2/projects/smoke-project/revisions",
+        {"revision": "deadbeef42", "config_yaml": SAMPLE_PROJECT,
+         "message": "smoke revision"},
+    )
+    version_id = out["version_id"]
+    log(f"version {version_id} created with {out['n_tasks']} tasks")
+
+    # drive the cron plane until a host is running
+    deadline = time.time() + 120
+    hosts = []
+    while time.time() < deadline:
+        runner.tick(force=True)
+        queue.wait_idle(60)
+        hosts = [
+            h for h in call("GET", "/rest/v2/hosts")
+            if h["status"] == "running"
+        ]
+        if hosts:
+            break
+    if not hosts:
+        print("FAIL: no host provisioned")
+        return 1
+    log(f"host {hosts[0]['_id']} provisioned by the cron plane")
+
+    # run the agent over HTTP until the queue drains (two waves: unit-tests
+    # waits for compile to finish + the next planning tick)
+    from .agent.agent import Agent, AgentOptions
+    from .agent.rest_comm import RestCommunicator
+
+    with tempfile.TemporaryDirectory(prefix="evg-smoke-") as workdir:
+        agent = Agent(
+            RestCommunicator(base),
+            AgentOptions(host_id=hosts[0]["_id"], work_dir=workdir),
+        )
+        finished = []
+        for _ in range(3):
+            finished += agent.run_until_idle()
+            runner.tick(force=True)
+            queue.wait_idle(60)
+            api.svc.get("smoke-distro").refresh(force=True)
+            tasks = call("GET", f"/rest/v2/versions/{version_id}/tasks")
+            if all(t["status"] in ("success", "failed") for t in tasks):
+                break
+
+    tasks = call("GET", f"/rest/v2/versions/{version_id}/tasks")
+    version = call("GET", f"/rest/v2/versions/{version_id}")
+    log("")
+    log("results:")
+    ok = True
+    for t in sorted(tasks, key=lambda x: x["display_name"]):
+        log(f"  {t['display_name']:<12} {t['status']}")
+        ok = ok and t["status"] == "success"
+    log(f"version status: {version['status']}")
+    logs = call(
+        "GET",
+        f"/rest/v2/tasks/{[t for t in tasks if t['display_name']=='compile'][0]['_id']}/logs",
+    )
+    log(f"compile log lines: {len(logs['lines'])}")
+    gql = call(
+        "POST", "/graphql",
+        {"query": f'query {{ version(versionId: "{version_id}") {{ status }} }}'},
+    )
+    log(f"graphql agrees: {gql['data']['version']['status']}")
+
+    runner.stop()
+    queue.close()
+    server.shutdown()
+    if ok and version["status"] == "success":
+        log("\nSMOKE OK")
+        return 0
+    print("\nSMOKE FAILED")
+    return 1
