@@ -346,28 +346,48 @@ def build_snapshot(
             arr[:n] = values
         return arr
 
-    # task columns (vectorized python→numpy conversion, one pass per field)
-    fill("t_valid", [True] * n_t)
+    # task columns: one native pass when the evgpack extension is
+    # available (native/evgpack — ~12 Python-level passes collapse into a
+    # single C loop), else the pure-Python reference implementation below.
     fill("t_distro", t_distro, pad=D - 1)
-    fill("t_priority", [t.priority for t in flat_tasks])
-    merge_flags = [is_github_merge_queue_requester(t.requester) for t in flat_tasks]
-    fill("t_is_merge", merge_flags)
-    fill(
-        "t_is_patch",
-        [
-            (not m) and is_patch_requester(t.requester)
-            for m, t in zip(merge_flags, flat_tasks)
-        ],
-    )
-    fill("t_stepback", [t.is_stepback_activated() for t in flat_tasks])
-    fill("t_generate", [t.generate_task for t in flat_tasks])
-    fill("t_in_group", [bool(t.task_group) for t in flat_tasks])
-    fill("t_group_order", [t.task_group_order for t in flat_tasks])
-    # Vectorized forms of Task.time_in_queue / wait_since_dependencies_met /
-    # fetch_expected_duration over raw columns: per-task method calls cost
-    # ~100ms at 50k tasks. The serial oracle still calls the methods, so the
-    # parity fuzzer pins these numpy forms to the method semantics.
-    if n_t:
+    from ..utils.native import get_evgpack
+
+    evgpack = get_evgpack()
+    if evgpack is not None and n_t:
+        cols = {
+            name: a[name][:n_t]
+            for name in (
+                "t_valid", "t_is_merge", "t_is_patch", "t_stepback",
+                "t_generate", "t_in_group", "t_priority", "t_group_order",
+                "t_num_dependents", "t_time_in_queue_s", "t_expected_s",
+                "t_wait_dep_met_s",
+            )
+        }
+        evgpack.pack_task_columns(
+            flat_tasks, now, float(DEFAULT_TASK_DURATION_S), cols
+        )
+    elif n_t:
+        fill("t_valid", [True] * n_t)
+        fill("t_priority", [t.priority for t in flat_tasks])
+        merge_flags = [
+            is_github_merge_queue_requester(t.requester) for t in flat_tasks
+        ]
+        fill("t_is_merge", merge_flags)
+        fill(
+            "t_is_patch",
+            [
+                (not m) and is_patch_requester(t.requester)
+                for m, t in zip(merge_flags, flat_tasks)
+            ],
+        )
+        fill("t_stepback", [t.is_stepback_activated() for t in flat_tasks])
+        fill("t_generate", [t.generate_task for t in flat_tasks])
+        fill("t_in_group", [bool(t.task_group) for t in flat_tasks])
+        fill("t_group_order", [t.task_group_order for t in flat_tasks])
+        # Vectorized forms of Task.time_in_queue /
+        # wait_since_dependencies_met / fetch_expected_duration over raw
+        # columns (the serial oracle still calls the methods; the parity
+        # fuzzer pins these forms to the method semantics).
         act = np.fromiter((t.activated_time for t in flat_tasks), np.float64, n_t)
         ingest = np.fromiter((t.ingest_time for t in flat_tasks), np.float64, n_t)
         basis = np.where(act > 0.0, act, ingest)
@@ -390,7 +410,7 @@ def build_snapshot(
         a["t_expected_s"][:n_t] = np.where(
             dur > 0.0, dur, float(DEFAULT_TASK_DURATION_S)
         )
-    fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
+        fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
     fill("t_deps_met", [deps_met.get(t.id, True) for t in flat_tasks])
     fill("t_seg", t_seg, pad=G - 1)
 

@@ -1,0 +1,68 @@
+"""Native extension loader: build-on-first-use with graceful fallback.
+
+The evgpack C extension (native/evgpack) accelerates the snapshot's
+per-task column extraction. It is built with g++ directly against the
+CPython headers the first time it is needed (no build-system dependency),
+cached next to its source, and every caller falls back to the pure-Python
+path when the toolchain or build is unavailable.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_module = None
+_attempted = False
+
+_SRC_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "..", "native", "evgpack"
+)
+
+
+def _build(src: str, out: str) -> bool:
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src, "-o", out,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        return r.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_evgpack() -> Optional[object]:
+    """The compiled evgpack module, or None (pure-Python fallback)."""
+    global _module, _attempted
+    with _lock:
+        if _attempted:
+            return _module
+        _attempted = True
+        if os.environ.get("EVG_DISABLE_NATIVE"):
+            return None
+        src = os.path.abspath(os.path.join(_SRC_DIR, "evgpack.cpp"))
+        if not os.path.exists(src):
+            return None
+        build_dir = os.path.join(os.path.dirname(src), "build")
+        so_path = os.path.join(build_dir, "evgpack.so")
+        try:
+            os.makedirs(build_dir, exist_ok=True)
+            if (
+                not os.path.exists(so_path)
+                or os.path.getmtime(so_path) < os.path.getmtime(src)
+            ):
+                if not _build(src, so_path):
+                    return None
+            spec = importlib.util.spec_from_file_location("evgpack", so_path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _module = mod
+        except (OSError, ImportError):
+            _module = None
+        return _module

@@ -1,0 +1,234 @@
+// evgpack — native snapshot packer for the scheduling tick.
+//
+// The per-task column extraction is the hottest host-side loop of a tick
+// (~12 Python-level passes over 50k Task objects). This CPython extension
+// makes ONE pass, reading attributes through the C API and writing the
+// snapshot arena views directly. Semantics mirror
+// evergreen_tpu/scheduler/snapshot.py's fill block exactly; the Python
+// implementation remains as the fallback and the behavioral reference.
+//
+// Built with g++ at first use (see evergreen_tpu/utils/native.py); no
+// build-system dependency.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// cached attribute-name objects (created once at module init)
+PyObject* s_priority;
+PyObject* s_requester;
+PyObject* s_activated_by;
+PyObject* s_generate_task;
+PyObject* s_task_group;
+PyObject* s_task_group_order;
+PyObject* s_activated_time;
+PyObject* s_ingest_time;
+PyObject* s_scheduled_time;
+PyObject* s_dependencies_met_time;
+PyObject* s_expected_duration_s;
+PyObject* s_num_dependents;
+
+bool StrEquals(PyObject* obj, const char* want) {
+  if (!PyUnicode_Check(obj)) return false;
+  const char* got = PyUnicode_AsUTF8(obj);
+  return got != nullptr && strcmp(got, want) == 0;
+}
+
+double AsDouble(PyObject* obj, bool* ok) {
+  double v = PyFloat_AsDouble(obj);
+  if (v == -1.0 && PyErr_Occurred()) {
+    *ok = false;
+    return 0.0;
+  }
+  return v;
+}
+
+// pack_task_columns(tasks, now, default_duration_s, out) -> None
+//
+// ``out`` maps column name -> writable contiguous numpy views:
+//   int32:  t_priority, t_group_order, t_num_dependents
+//   uint8:  t_valid, t_is_merge, t_is_patch, t_stepback, t_generate,
+//           t_in_group
+//   float32: t_time_in_queue_s, t_expected_s, t_wait_dep_met_s
+PyObject* PackTaskColumns(PyObject*, PyObject* args) {
+  PyObject* tasks;
+  double now;
+  double default_dur;
+  PyObject* out;
+  if (!PyArg_ParseTuple(args, "OddO", &tasks, &now, &default_dur, &out)) {
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
+  if (seq == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+  auto view = [&](const char* name, Py_ssize_t itemsize,
+                  Py_buffer* buf) -> bool {
+    PyObject* arr = PyDict_GetItemString(out, name);  // borrowed
+    if (arr == nullptr) {
+      PyErr_Format(PyExc_KeyError, "missing output column %s", name);
+      return false;
+    }
+    if (PyObject_GetBuffer(arr, buf, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) !=
+        0) {
+      return false;
+    }
+    if (buf->itemsize != itemsize || buf->len < n * itemsize) {
+      PyBuffer_Release(buf);
+      PyErr_Format(PyExc_ValueError, "column %s has wrong shape/dtype", name);
+      return false;
+    }
+    return true;
+  };
+
+  Py_buffer b_valid{}, b_merge{}, b_patch{}, b_stepback{}, b_generate{},
+      b_in_group{};
+  Py_buffer b_priority{}, b_group_order{}, b_numdep{};
+  Py_buffer b_tiq{}, b_expected{}, b_wait{};
+  Py_buffer* all[] = {&b_valid,    &b_merge,       &b_patch, &b_stepback,
+                      &b_generate, &b_in_group,    &b_priority,
+                      &b_group_order, &b_numdep,   &b_tiq,   &b_expected,
+                      &b_wait};
+  int acquired = 0;
+  bool ok = view("t_valid", 1, &b_valid) && ++acquired &&
+            view("t_is_merge", 1, &b_merge) && ++acquired &&
+            view("t_is_patch", 1, &b_patch) && ++acquired &&
+            view("t_stepback", 1, &b_stepback) && ++acquired &&
+            view("t_generate", 1, &b_generate) && ++acquired &&
+            view("t_in_group", 1, &b_in_group) && ++acquired &&
+            view("t_priority", 4, &b_priority) && ++acquired &&
+            view("t_group_order", 4, &b_group_order) && ++acquired &&
+            view("t_num_dependents", 4, &b_numdep) && ++acquired &&
+            view("t_time_in_queue_s", 4, &b_tiq) && ++acquired &&
+            view("t_expected_s", 4, &b_expected) && ++acquired &&
+            view("t_wait_dep_met_s", 4, &b_wait) && ++acquired;
+  if (!ok) {
+    for (int i = 0; i < acquired; ++i) PyBuffer_Release(all[i]);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+
+  auto* valid = static_cast<uint8_t*>(b_valid.buf);
+  auto* merge = static_cast<uint8_t*>(b_merge.buf);
+  auto* patch = static_cast<uint8_t*>(b_patch.buf);
+  auto* stepback = static_cast<uint8_t*>(b_stepback.buf);
+  auto* generate = static_cast<uint8_t*>(b_generate.buf);
+  auto* in_group = static_cast<uint8_t*>(b_in_group.buf);
+  auto* priority = static_cast<int32_t*>(b_priority.buf);
+  auto* group_order = static_cast<int32_t*>(b_group_order.buf);
+  auto* numdep = static_cast<int32_t*>(b_numdep.buf);
+  auto* tiq = static_cast<float*>(b_tiq.buf);
+  auto* expected = static_cast<float*>(b_expected.buf);
+  auto* wait = static_cast<float*>(b_wait.buf);
+
+  bool good = true;
+  for (Py_ssize_t i = 0; good && i < n; ++i) {
+    PyObject* t = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+
+    PyObject* req = PyObject_GetAttr(t, s_requester);
+    PyObject* act_by = PyObject_GetAttr(t, s_activated_by);
+    PyObject* gen = PyObject_GetAttr(t, s_generate_task);
+    PyObject* tg = PyObject_GetAttr(t, s_task_group);
+    PyObject* prio = PyObject_GetAttr(t, s_priority);
+    PyObject* tgo = PyObject_GetAttr(t, s_task_group_order);
+    PyObject* nd = PyObject_GetAttr(t, s_num_dependents);
+    PyObject* at = PyObject_GetAttr(t, s_activated_time);
+    PyObject* it = PyObject_GetAttr(t, s_ingest_time);
+    PyObject* st = PyObject_GetAttr(t, s_scheduled_time);
+    PyObject* dmt = PyObject_GetAttr(t, s_dependencies_met_time);
+    PyObject* dur = PyObject_GetAttr(t, s_expected_duration_s);
+
+    if (!req || !act_by || !gen || !tg || !prio || !tgo || !nd || !at || !it ||
+        !st || !dmt || !dur) {
+      good = false;
+    } else {
+      valid[i] = 1;
+      const bool is_merge = StrEquals(req, "github_merge_request");
+      merge[i] = is_merge ? 1 : 0;
+      patch[i] = (!is_merge && (StrEquals(req, "patch_request") ||
+                                StrEquals(req, "github_pull_request")))
+                     ? 1
+                     : 0;
+      stepback[i] = StrEquals(act_by, "stepback-activator") ? 1 : 0;
+      generate[i] = PyObject_IsTrue(gen) ? 1 : 0;
+      const bool grouped =
+          PyUnicode_Check(tg) && PyUnicode_GetLength(tg) > 0;
+      in_group[i] = grouped ? 1 : 0;
+
+      priority[i] = static_cast<int32_t>(PyLong_AsLong(prio));
+      group_order[i] = static_cast<int32_t>(PyLong_AsLong(tgo));
+      numdep[i] = static_cast<int32_t>(PyLong_AsLong(nd));
+
+      const double activated = AsDouble(at, &good);
+      const double ingest = AsDouble(it, &good);
+      const double sched = AsDouble(st, &good);
+      const double deps_met_t = AsDouble(dmt, &good);
+      const double duration = AsDouble(dur, &good);
+      if (good) {
+        // Task.time_in_queue: activated time, else ingest time
+        const double basis = activated > 0.0 ? activated : ingest;
+        tiq[i] = basis > 0.0 && now > basis
+                     ? static_cast<float>(now - basis)
+                     : 0.0f;
+        // Task.wait_since_dependencies_met
+        const double start = sched > deps_met_t ? sched : deps_met_t;
+        wait[i] = start > 0.0 && now > start
+                      ? static_cast<float>(now - start)
+                      : 0.0f;
+        // Task.fetch_expected_duration default
+        expected[i] = static_cast<float>(duration > 0.0 ? duration
+                                                        : default_dur);
+      }
+      if (PyErr_Occurred()) good = false;
+    }
+    Py_XDECREF(req);
+    Py_XDECREF(act_by);
+    Py_XDECREF(gen);
+    Py_XDECREF(tg);
+    Py_XDECREF(prio);
+    Py_XDECREF(tgo);
+    Py_XDECREF(nd);
+    Py_XDECREF(at);
+    Py_XDECREF(it);
+    Py_XDECREF(st);
+    Py_XDECREF(dmt);
+    Py_XDECREF(dur);
+  }
+
+  for (auto* b : all) PyBuffer_Release(b);
+  Py_DECREF(seq);
+  if (!good) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef kMethods[] = {
+    {"pack_task_columns", PackTaskColumns, METH_VARARGS,
+     "Fill per-task snapshot columns in one native pass."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "evgpack",
+    "Native snapshot packer for evergreen_tpu.", -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_evgpack(void) {
+  s_priority = PyUnicode_InternFromString("priority");
+  s_requester = PyUnicode_InternFromString("requester");
+  s_activated_by = PyUnicode_InternFromString("activated_by");
+  s_generate_task = PyUnicode_InternFromString("generate_task");
+  s_task_group = PyUnicode_InternFromString("task_group");
+  s_task_group_order = PyUnicode_InternFromString("task_group_order");
+  s_activated_time = PyUnicode_InternFromString("activated_time");
+  s_ingest_time = PyUnicode_InternFromString("ingest_time");
+  s_scheduled_time = PyUnicode_InternFromString("scheduled_time");
+  s_dependencies_met_time = PyUnicode_InternFromString("dependencies_met_time");
+  s_expected_duration_s = PyUnicode_InternFromString("expected_duration_s");
+  s_num_dependents = PyUnicode_InternFromString("num_dependents");
+  return PyModule_Create(&kModule);
+}

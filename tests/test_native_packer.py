@@ -1,0 +1,55 @@
+"""Native snapshot packer (native/evgpack): the C pass must fill columns
+bit-identically to the pure-Python fallback."""
+import numpy as np
+import pytest
+
+from evergreen_tpu.scheduler.snapshot import build_snapshot
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+from evergreen_tpu.utils import native
+
+
+@pytest.fixture()
+def problem():
+    return generate_problem(6, 400, seed=21, task_group_fraction=0.3,
+                            hosts_per_distro=4)
+
+
+def test_native_matches_python_fallback(problem, monkeypatch, store):
+    distros, tbd, hbd, est, dm = problem
+    if native.get_evgpack() is None:
+        pytest.skip("g++ toolchain unavailable; python fallback is the path")
+    snap_native = build_snapshot(distros, tbd, hbd, est, dm, NOW)
+
+    # force the fallback by disabling the cached module
+    monkeypatch.setattr(native, "_module", None)
+    monkeypatch.setattr(native, "_attempted", True)
+    snap_py = build_snapshot(distros, tbd, hbd, est, dm, NOW)
+
+    for name in snap_native.arrays:
+        np.testing.assert_array_equal(
+            snap_native.arrays[name],
+            snap_py.arrays[name],
+            err_msg=f"column {name} differs between native and python",
+        )
+
+
+def test_native_handles_degenerate_values(store, monkeypatch):
+    """Zero times, zero durations, unicode ids — the fallback branches."""
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.task import Task
+
+    if native.get_evgpack() is None:
+        pytest.skip("g++ toolchain unavailable")
+    d = Distro(id="d0")
+    tasks = [
+        Task(id="zero", distro_id="d0", activated=True, status="undispatched"),
+        Task(id="üñíçødé", distro_id="d0", activated=True,
+             status="undispatched", requester="github_merge_request",
+             activated_time=NOW - 5, expected_duration_s=0.0),
+    ]
+    snap = build_snapshot([d], {"d0": tasks}, {"d0": []}, {}, {}, NOW)
+    a = snap.arrays
+    assert a["t_time_in_queue_s"][0] == 0.0  # no activated/ingest time
+    assert a["t_expected_s"][0] == 600.0  # default duration
+    assert bool(a["t_is_merge"][1])
+    assert a["t_time_in_queue_s"][1] == pytest.approx(5.0)
