@@ -260,10 +260,25 @@ def build_snapshot(
     m_unit: List[int] = []
     u_distro: List[int] = []
     unit_base = 0
+    from ..utils.native import get_evgpack
+
+    evgpack = get_evgpack()
+    group_keys: List[str] = []
     for d in distros:
         tasks = tasks_by_distro.get(d.id, [])
         base = len(flat_tasks)
-        n_units_d, mt, mu = build_memberships(d, tasks, base)
+        if evgpack is not None:
+            n_units_d, mt, mu, gkeys = evgpack.build_memberships(
+                tasks, bool(d.planner_settings.group_versions)
+            )
+            if base:
+                mt = [base + x for x in mt]
+            group_keys.extend(gkeys)
+        else:
+            n_units_d, mt, mu = build_memberships(d, tasks, base)
+            group_keys.extend(
+                t.task_group_string() if t.task_group else "" for t in tasks
+            )
         di = d_index[d.id]
         flat_tasks.extend(tasks)
         t_distro.extend([di] * len(tasks))
@@ -297,8 +312,8 @@ def build_snapshot(
     t_seg = [
         seg_for(
             t_distro[i],
-            t.task_group_string() if t.task_group else "",
-            t.task_group_max_hosts,
+            group_keys[i],
+            t.task_group_max_hosts if group_keys[i] else 0,
         )
         for i, t in enumerate(flat_tasks)
     ]

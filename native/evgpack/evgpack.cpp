@@ -14,6 +14,9 @@
 
 #include <cstdint>
 #include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
 
 namespace {
 
@@ -204,9 +207,203 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// build_memberships(tasks, group_versions) ->
+//   (n_units, m_task list, m_unit list, group_keys list)
+//
+// Mirrors evergreen_tpu/scheduler/snapshot.py::build_memberships exactly,
+// including unit creation ORDER (the planner's deterministic tie-break):
+//   * task-group members unite under the group string (also returned per
+//     task for segment assignment; "" for ungrouped tasks);
+//   * with group_versions, tasks also join their version's unit;
+//   * otherwise singleton units;
+//   * second pass: tasks join the unit registered under each dependency id.
+PyObject* BuildMemberships(PyObject*, PyObject* args) {
+  PyObject* tasks;
+  int group_versions;
+  if (!PyArg_ParseTuple(args, "Op", &tasks, &group_versions)) return nullptr;
+  PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
+  if (seq == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+  static PyObject* s_id = PyUnicode_InternFromString("id");
+  static PyObject* s_version = PyUnicode_InternFromString("version");
+  static PyObject* s_build_variant = PyUnicode_InternFromString("build_variant");
+  static PyObject* s_project = PyUnicode_InternFromString("project");
+  static PyObject* s_depends_on = PyUnicode_InternFromString("depends_on");
+  static PyObject* s_task_id = PyUnicode_InternFromString("task_id");
+
+  struct Scope {
+    PyObject* seq;
+    ~Scope() { Py_DECREF(seq); }
+  } scope{seq};
+
+  std::unordered_map<std::string, int32_t> key_to_unit;
+  std::unordered_map<std::string, int32_t> task_unit;
+  std::vector<std::vector<int32_t>> mem_by_task(n);
+  std::vector<std::string> task_ids(n);
+  int32_t n_units = 0;
+
+  PyObject* group_keys = PyList_New(n);
+  if (group_keys == nullptr) return nullptr;
+
+  bool good = true;
+  for (Py_ssize_t i = 0; good && i < n; ++i) {
+    PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* tg = PyObject_GetAttr(t, s_task_group);
+    PyObject* tid = PyObject_GetAttr(t, s_id);
+    if (!tg || !tid || !PyUnicode_Check(tg) || !PyUnicode_Check(tid)) {
+      Py_XDECREF(tg);
+      Py_XDECREF(tid);
+      good = false;
+      break;
+    }
+    task_ids[i] = PyUnicode_AsUTF8(tid);
+    auto& units_of_t = mem_by_task[i];
+    const bool grouped = PyUnicode_GetLength(tg) > 0;
+    PyObject* group_key_obj = nullptr;
+    if (grouped) {
+      PyObject* bv = PyObject_GetAttr(t, s_build_variant);
+      PyObject* proj = PyObject_GetAttr(t, s_project);
+      PyObject* ver = PyObject_GetAttr(t, s_version);
+      if (!bv || !proj || !ver) {
+        Py_XDECREF(bv);
+        Py_XDECREF(proj);
+        Py_XDECREF(ver);
+        Py_DECREF(tg);
+        Py_DECREF(tid);
+        good = false;
+        break;
+      }
+      // Task.task_group_string(): group _ variant _ project _ version
+      group_key_obj = PyUnicode_FromFormat("%U_%U_%U_%U", tg, bv, proj, ver);
+      const std::string key = PyUnicode_AsUTF8(group_key_obj);
+      auto it = key_to_unit.find(key);
+      int32_t u;
+      if (it == key_to_unit.end()) {
+        u = n_units++;
+        key_to_unit.emplace(key, u);
+      } else {
+        u = it->second;
+      }
+      units_of_t.push_back(u);
+      task_unit.emplace(task_ids[i], u);
+      if (group_versions) {
+        const std::string vkey = PyUnicode_AsUTF8(ver);
+        auto vit = key_to_unit.find(vkey);
+        int32_t v;
+        if (vit == key_to_unit.end()) {
+          v = n_units++;
+          key_to_unit.emplace(vkey, v);
+        } else {
+          v = vit->second;
+        }
+        if (v != u) units_of_t.push_back(v);
+      }
+      Py_DECREF(bv);
+      Py_DECREF(proj);
+      Py_DECREF(ver);
+    } else if (group_versions) {
+      PyObject* ver = PyObject_GetAttr(t, s_version);
+      if (!ver) {
+        Py_DECREF(tg);
+        Py_DECREF(tid);
+        good = false;
+        break;
+      }
+      const std::string vkey = PyUnicode_AsUTF8(ver);
+      auto vit = key_to_unit.find(vkey);
+      int32_t v;
+      if (vit == key_to_unit.end()) {
+        v = n_units++;
+        key_to_unit.emplace(vkey, v);
+      } else {
+        v = vit->second;
+      }
+      units_of_t.push_back(v);
+      task_unit.emplace(task_ids[i], v);
+      Py_DECREF(ver);
+    } else {
+      const int32_t u = n_units++;
+      units_of_t.push_back(u);
+      task_unit.emplace(task_ids[i], u);
+    }
+    if (group_key_obj == nullptr) {
+      group_key_obj = PyUnicode_FromString("");
+    }
+    PyList_SET_ITEM(group_keys, i, group_key_obj);  // steals
+    Py_DECREF(tg);
+    Py_DECREF(tid);
+  }
+
+  // dependency-closure pass
+  for (Py_ssize_t i = 0; good && i < n; ++i) {
+    PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* deps = PyObject_GetAttr(t, s_depends_on);
+    if (deps == nullptr) {
+      good = false;
+      break;
+    }
+    PyObject* dep_seq = PySequence_Fast(deps, "depends_on must be a sequence");
+    Py_DECREF(deps);
+    if (dep_seq == nullptr) {
+      good = false;
+      break;
+    }
+    const Py_ssize_t nd = PySequence_Fast_GET_SIZE(dep_seq);
+    auto& lst = mem_by_task[i];
+    for (Py_ssize_t j = 0; j < nd; ++j) {
+      PyObject* dep = PySequence_Fast_GET_ITEM(dep_seq, j);
+      PyObject* dep_id = PyObject_GetAttr(dep, s_task_id);
+      if (dep_id == nullptr || !PyUnicode_Check(dep_id)) {
+        Py_XDECREF(dep_id);
+        good = false;
+        break;
+      }
+      auto it = task_unit.find(PyUnicode_AsUTF8(dep_id));
+      Py_DECREF(dep_id);
+      if (it != task_unit.end()) {
+        const int32_t u = it->second;
+        bool present = false;
+        for (int32_t x : lst) {
+          if (x == u) {
+            present = true;
+            break;
+          }
+        }
+        if (!present) lst.push_back(u);
+      }
+    }
+    Py_DECREF(dep_seq);
+  }
+
+  if (!good) {
+    Py_DECREF(group_keys);
+    if (!PyErr_Occurred()) {
+      PyErr_SetString(PyExc_TypeError, "malformed task objects");
+    }
+    return nullptr;
+  }
+
+  size_t total = 0;
+  for (auto& lst : mem_by_task) total += lst.size();
+  PyObject* m_task = PyList_New(static_cast<Py_ssize_t>(total));
+  PyObject* m_unit = PyList_New(static_cast<Py_ssize_t>(total));
+  Py_ssize_t k = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    for (int32_t u : mem_by_task[i]) {
+      PyList_SET_ITEM(m_task, k, PyLong_FromSsize_t(i));
+      PyList_SET_ITEM(m_unit, k, PyLong_FromLong(u));
+      ++k;
+    }
+  }
+  return Py_BuildValue("iNNN", n_units, m_task, m_unit, group_keys);
+}
+
 PyMethodDef kMethods[] = {
     {"pack_task_columns", PackTaskColumns, METH_VARARGS,
      "Fill per-task snapshot columns in one native pass."},
+    {"build_memberships", BuildMemberships, METH_VARARGS,
+     "Planner unit grouping: (n_units, m_task, m_unit, group_keys)."},
     {nullptr, nullptr, 0, nullptr},
 };
 
