@@ -269,10 +269,8 @@ def build_snapshot(
         base = len(flat_tasks)
         if evgpack is not None:
             n_units_d, mt, mu, gkeys = evgpack.build_memberships(
-                tasks, bool(d.planner_settings.group_versions)
+                tasks, bool(d.planner_settings.group_versions), base
             )
-            if base:
-                mt = [base + x for x in mt]
             group_keys.extend(gkeys)
         else:
             n_units_d, mt, mu = build_memberships(d, tasks, base)

@@ -207,20 +207,20 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
-// build_memberships(tasks, group_versions) ->
+// build_memberships(tasks, group_versions, base) ->
 //   (n_units, m_task list, m_unit list, group_keys list)
 //
 // Mirrors evergreen_tpu/scheduler/snapshot.py::build_memberships exactly,
-// including unit creation ORDER (the planner's deterministic tie-break):
-//   * task-group members unite under the group string (also returned per
-//     task for segment assignment; "" for ungrouped tasks);
-//   * with group_versions, tasks also join their version's unit;
-//   * otherwise singleton units;
-//   * second pass: tasks join the unit registered under each dependency id.
+// including unit creation ORDER (the planner's deterministic tie-break)
+// and tolerance for depends_on=None. Task indices in m_task are offset by
+// ``base`` (the caller's global flat-task position).
 PyObject* BuildMemberships(PyObject*, PyObject* args) {
   PyObject* tasks;
   int group_versions;
-  if (!PyArg_ParseTuple(args, "Op", &tasks, &group_versions)) return nullptr;
+  Py_ssize_t base = 0;
+  if (!PyArg_ParseTuple(args, "Op|n", &tasks, &group_versions, &base)) {
+    return nullptr;
+  }
   PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
   if (seq == nullptr) return nullptr;
   const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
@@ -237,6 +237,21 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
     ~Scope() { Py_DECREF(seq); }
   } scope{seq};
 
+  // checked str -> utf8: raises a Python TypeError/UnicodeError instead of
+  // crashing on non-str attributes or non-encodable surrogates
+  auto as_utf8 = [](PyObject* obj, const char* what,
+                    const char** out) -> bool {
+    if (obj == nullptr) return false;
+    if (!PyUnicode_Check(obj)) {
+      PyErr_Format(PyExc_TypeError, "task attribute %s must be str", what);
+      return false;
+    }
+    const char* c = PyUnicode_AsUTF8(obj);
+    if (c == nullptr) return false;  // encoding error already set
+    *out = c;
+    return true;
+  };
+
   std::unordered_map<std::string, int32_t> key_to_unit;
   std::unordered_map<std::string, int32_t> task_unit;
   std::vector<std::vector<int32_t>> mem_by_task(n);
@@ -251,71 +266,79 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
     PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
     PyObject* tg = PyObject_GetAttr(t, s_task_group);
     PyObject* tid = PyObject_GetAttr(t, s_id);
-    if (!tg || !tid || !PyUnicode_Check(tg) || !PyUnicode_Check(tid)) {
+    const char* tg_c = nullptr;
+    const char* tid_c = nullptr;
+    if (!as_utf8(tg, "task_group", &tg_c) || !as_utf8(tid, "id", &tid_c)) {
       Py_XDECREF(tg);
       Py_XDECREF(tid);
       good = false;
       break;
     }
-    task_ids[i] = PyUnicode_AsUTF8(tid);
+    task_ids[i] = tid_c;
     auto& units_of_t = mem_by_task[i];
-    const bool grouped = PyUnicode_GetLength(tg) > 0;
+    const bool grouped = tg_c[0] != '\0';
     PyObject* group_key_obj = nullptr;
     if (grouped) {
       PyObject* bv = PyObject_GetAttr(t, s_build_variant);
       PyObject* proj = PyObject_GetAttr(t, s_project);
       PyObject* ver = PyObject_GetAttr(t, s_version);
-      if (!bv || !proj || !ver) {
-        Py_XDECREF(bv);
-        Py_XDECREF(proj);
+      const char* bv_c = nullptr;
+      const char* proj_c = nullptr;
+      const char* ver_c = nullptr;
+      const bool attrs_ok = as_utf8(bv, "build_variant", &bv_c) &&
+                            as_utf8(proj, "project", &proj_c) &&
+                            as_utf8(ver, "version", &ver_c);
+      if (attrs_ok) {
+        // Task.task_group_string(): group _ variant _ project _ version
+        std::string key;
+        key.reserve(strlen(tg_c) + strlen(bv_c) + strlen(proj_c) +
+                    strlen(ver_c) + 3);
+        key.append(tg_c).append("_").append(bv_c).append("_")
+            .append(proj_c).append("_").append(ver_c);
+        auto it = key_to_unit.find(key);
+        int32_t u;
+        if (it == key_to_unit.end()) {
+          u = n_units++;
+          key_to_unit.emplace(key, u);
+        } else {
+          u = it->second;
+        }
+        units_of_t.push_back(u);
+        task_unit.emplace(task_ids[i], u);
+        if (group_versions) {
+          auto vit = key_to_unit.find(ver_c);
+          int32_t v;
+          if (vit == key_to_unit.end()) {
+            v = n_units++;
+            key_to_unit.emplace(ver_c, v);
+          } else {
+            v = vit->second;
+          }
+          if (v != u) units_of_t.push_back(v);
+        }
+        group_key_obj = PyUnicode_FromString(key.c_str());
+        if (group_key_obj == nullptr) good = false;
+      } else {
+        good = false;
+      }
+      Py_XDECREF(bv);
+      Py_XDECREF(proj);
+      Py_XDECREF(ver);
+    } else if (group_versions) {
+      PyObject* ver = PyObject_GetAttr(t, s_version);
+      const char* ver_c = nullptr;
+      if (!as_utf8(ver, "version", &ver_c)) {
         Py_XDECREF(ver);
         Py_DECREF(tg);
         Py_DECREF(tid);
         good = false;
         break;
       }
-      // Task.task_group_string(): group _ variant _ project _ version
-      group_key_obj = PyUnicode_FromFormat("%U_%U_%U_%U", tg, bv, proj, ver);
-      const std::string key = PyUnicode_AsUTF8(group_key_obj);
-      auto it = key_to_unit.find(key);
-      int32_t u;
-      if (it == key_to_unit.end()) {
-        u = n_units++;
-        key_to_unit.emplace(key, u);
-      } else {
-        u = it->second;
-      }
-      units_of_t.push_back(u);
-      task_unit.emplace(task_ids[i], u);
-      if (group_versions) {
-        const std::string vkey = PyUnicode_AsUTF8(ver);
-        auto vit = key_to_unit.find(vkey);
-        int32_t v;
-        if (vit == key_to_unit.end()) {
-          v = n_units++;
-          key_to_unit.emplace(vkey, v);
-        } else {
-          v = vit->second;
-        }
-        if (v != u) units_of_t.push_back(v);
-      }
-      Py_DECREF(bv);
-      Py_DECREF(proj);
-      Py_DECREF(ver);
-    } else if (group_versions) {
-      PyObject* ver = PyObject_GetAttr(t, s_version);
-      if (!ver) {
-        Py_DECREF(tg);
-        Py_DECREF(tid);
-        good = false;
-        break;
-      }
-      const std::string vkey = PyUnicode_AsUTF8(ver);
-      auto vit = key_to_unit.find(vkey);
+      auto vit = key_to_unit.find(ver_c);
       int32_t v;
       if (vit == key_to_unit.end()) {
         v = n_units++;
-        key_to_unit.emplace(vkey, v);
+        key_to_unit.emplace(ver_c, v);
       } else {
         v = vit->second;
       }
@@ -327,21 +350,31 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
       units_of_t.push_back(u);
       task_unit.emplace(task_ids[i], u);
     }
-    if (group_key_obj == nullptr) {
+    if (good && group_key_obj == nullptr) {
       group_key_obj = PyUnicode_FromString("");
+      if (group_key_obj == nullptr) good = false;
     }
-    PyList_SET_ITEM(group_keys, i, group_key_obj);  // steals
+    if (good) {
+      PyList_SET_ITEM(group_keys, i, group_key_obj);  // steals
+    } else {
+      Py_XDECREF(group_key_obj);
+    }
     Py_DECREF(tg);
     Py_DECREF(tid);
   }
 
-  // dependency-closure pass
+  // dependency-closure pass (depends_on may be None: treated as empty,
+  // matching the Python fallback's `if t.depends_on:` guard)
   for (Py_ssize_t i = 0; good && i < n; ++i) {
     PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
     PyObject* deps = PyObject_GetAttr(t, s_depends_on);
     if (deps == nullptr) {
       good = false;
       break;
+    }
+    if (deps == Py_None) {
+      Py_DECREF(deps);
+      continue;
     }
     PyObject* dep_seq = PySequence_Fast(deps, "depends_on must be a sequence");
     Py_DECREF(deps);
@@ -351,15 +384,16 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
     }
     const Py_ssize_t nd = PySequence_Fast_GET_SIZE(dep_seq);
     auto& lst = mem_by_task[i];
-    for (Py_ssize_t j = 0; j < nd; ++j) {
+    for (Py_ssize_t j = 0; good && j < nd; ++j) {
       PyObject* dep = PySequence_Fast_GET_ITEM(dep_seq, j);
       PyObject* dep_id = PyObject_GetAttr(dep, s_task_id);
-      if (dep_id == nullptr || !PyUnicode_Check(dep_id)) {
+      const char* dep_c = nullptr;
+      if (!as_utf8(dep_id, "task_id", &dep_c)) {
         Py_XDECREF(dep_id);
         good = false;
         break;
       }
-      auto it = task_unit.find(PyUnicode_AsUTF8(dep_id));
+      auto it = task_unit.find(dep_c);
       Py_DECREF(dep_id);
       if (it != task_unit.end()) {
         const int32_t u = it->second;
@@ -388,13 +422,34 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
   for (auto& lst : mem_by_task) total += lst.size();
   PyObject* m_task = PyList_New(static_cast<Py_ssize_t>(total));
   PyObject* m_unit = PyList_New(static_cast<Py_ssize_t>(total));
+  if (m_task == nullptr || m_unit == nullptr) {
+    Py_XDECREF(m_task);
+    Py_XDECREF(m_unit);
+    Py_DECREF(group_keys);
+    return nullptr;
+  }
   Py_ssize_t k = 0;
-  for (Py_ssize_t i = 0; i < n; ++i) {
+  for (Py_ssize_t i = 0; good && i < n; ++i) {
     for (int32_t u : mem_by_task[i]) {
-      PyList_SET_ITEM(m_task, k, PyLong_FromSsize_t(i));
-      PyList_SET_ITEM(m_unit, k, PyLong_FromLong(u));
+      PyObject* a = PyLong_FromSsize_t(base + i);
+      PyObject* b = PyLong_FromLong(u);
+      if (a == nullptr || b == nullptr) {
+        Py_XDECREF(a);
+        Py_XDECREF(b);
+        good = false;
+        break;
+      }
+      PyList_SET_ITEM(m_task, k, a);
+      PyList_SET_ITEM(m_unit, k, b);
       ++k;
     }
+  }
+  if (!good) {
+    Py_DECREF(m_task);
+    Py_DECREF(m_unit);
+    Py_DECREF(group_keys);
+    if (!PyErr_Occurred()) PyErr_NoMemory();
+    return nullptr;
   }
   return Py_BuildValue("iNNN", n_units, m_task, m_unit, group_keys);
 }

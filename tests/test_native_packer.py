@@ -53,3 +53,25 @@ def test_native_handles_degenerate_values(store, monkeypatch):
     assert a["t_expected_s"][0] == 600.0  # default duration
     assert bool(a["t_is_merge"][1])
     assert a["t_time_in_queue_s"][1] == pytest.approx(5.0)
+
+
+def test_native_error_paths_raise_not_crash():
+    """Review-found crash classes must surface as Python exceptions."""
+    from evergreen_tpu.models.task import Task
+
+    m = native.get_evgpack()
+    if m is None:
+        pytest.skip("g++ toolchain unavailable")
+    bad_ver = Task(id="y", task_group="g")
+    bad_ver.version = None
+    with pytest.raises(TypeError):
+        m.build_memberships([bad_ver], False, 0)
+    surrogate = Task(id="bad\udc80")
+    with pytest.raises(UnicodeEncodeError):
+        m.build_memberships([surrogate], False, 0)
+    none_deps = Task(id="w")
+    none_deps.depends_on = None
+    assert m.build_memberships([none_deps], False, 0) == (1, [0], [0], [""])
+    # base offsets are emitted natively
+    out = m.build_memberships([Task(id="a"), Task(id="b")], False, 7)
+    assert out[1] == [7, 8]
