@@ -71,6 +71,12 @@ class TaskQueueItem:
     dependencies_met: bool = True
 
 
+#: column order of the persisted queue doc (matches TaskQueueItem fields)
+_ITEM_FIELDS = tuple(
+    f.name for f in dataclasses.fields(TaskQueueItem)
+)
+
+
 @dataclasses.dataclass
 class TaskQueue:
     distro_id: str
@@ -96,9 +102,21 @@ class TaskQueue:
         info_doc["task_group_infos"] = [
             TaskGroupInfo(**g) for g in info_doc.get("task_group_infos", [])
         ]
+        cols = doc.get("cols")
+        if cols is not None:
+            # columnar persist format (scheduler/persister.py): one list per
+            # field — 50k-item queues write in milliseconds; items are
+            # reconstructed here on the read side (TTL-amortized)
+            names = list(_ITEM_FIELDS)
+            queue = [
+                TaskQueueItem(**dict(zip(names, values)))
+                for values in zip(*(cols[n] for n in names))
+            ]
+        else:
+            queue = [TaskQueueItem(**i) for i in doc.get("queue", [])]
         return cls(
             distro_id=doc["distro_id"],
-            queue=[TaskQueueItem(**i) for i in doc.get("queue", [])],
+            queue=queue,
             info=DistroQueueInfo(**info_doc),
             generated_at=doc.get("generated_at", 0.0),
         )

@@ -29,31 +29,31 @@ def persist_task_queue(
 ) -> int:
     """Persist the plan; returns the number of queue items written."""
     now = _time.time() if now is None else now
-    # plain dicts on the hot path: dataclass construction + asdict for a
-    # 50k-item queue costs seconds per tick; TaskQueueItem remains the
-    # read-side type (TaskQueue.from_doc)
-    item_docs = [
-        {
-            "id": t.id,
-            "display_name": t.display_name,
-            "build_variant": t.build_variant,
-            "project": t.project,
-            "version": t.version,
-            "requester": t.requester,
-            "revision_order_number": t.revision_order_number,
-            "priority": t.priority,
-            "sort_value": sort_values.get(t.id, 0.0),
-            "task_group": t.task_group,
-            "task_group_max_hosts": t.task_group_max_hosts,
-            "task_group_order": t.task_group_order,
-            "expected_duration_s": t.expected_duration_s,
-            "num_dependents": t.num_dependents,
-            "dependencies": [d.task_id for d in t.depends_on],
-            "dependencies_met": deps_met.get(t.id, True),
-        }
-        for t in plan
-    ]
-    item_docs = cap_queue_docs(item_docs, max_scheduled_per_distro)
+    # columnar persist: one list comprehension per field instead of 50k
+    # small dicts — queue writes are every-tick work (the read side
+    # reconstructs items in TaskQueue.from_doc on TTL-amortized rebuilds)
+    n = len(plan)
+    cut = _cap_cut(plan, max_scheduled_per_distro)
+    if cut < n:
+        plan = plan[:cut]
+    cols = {
+        "id": [t.id for t in plan],
+        "display_name": [t.display_name for t in plan],
+        "build_variant": [t.build_variant for t in plan],
+        "project": [t.project for t in plan],
+        "version": [t.version for t in plan],
+        "requester": [t.requester for t in plan],
+        "revision_order_number": [t.revision_order_number for t in plan],
+        "priority": [t.priority for t in plan],
+        "sort_value": [sort_values.get(t.id, 0.0) for t in plan],
+        "task_group": [t.task_group for t in plan],
+        "task_group_max_hosts": [t.task_group_max_hosts for t in plan],
+        "task_group_order": [t.task_group_order for t in plan],
+        "expected_duration_s": [t.expected_duration_s for t in plan],
+        "num_dependents": [t.num_dependents for t in plan],
+        "dependencies": [[d.task_id for d in t.depends_on] for t in plan],
+        "dependencies_met": [deps_met.get(t.id, True) for t in plan],
+    }
     info_doc = {
         **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
         "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],
@@ -63,7 +63,7 @@ def persist_task_queue(
         {
             "_id": distro_id,
             "distro_id": distro_id,
-            "queue": item_docs,
+            "cols": cols,
             "info": info_doc,
             "generated_at": now,
         },
@@ -71,11 +71,27 @@ def persist_task_queue(
     )
     task_mod.mark_scheduled(
         store,
-        [i["id"] for i in item_docs],
+        cols["id"],
         now,
-        deps_met_ids=[i["id"] for i in item_docs if i["dependencies_met"]],
+        deps_met_ids=[
+            tid for tid, met in zip(cols["id"], cols["dependencies_met"]) if met
+        ],
     )
-    return len(item_docs)
+    return len(plan)
+
+
+def _cap_cut(plan: List[Task], max_len: int) -> int:
+    """capTaskQueueLength (task_queue_persister.go:66-84): cut at max_len
+    but keep a task group straddling the boundary whole."""
+    n = len(plan)
+    if max_len <= 0 or n <= max_len:
+        return n
+    cut = max_len
+    straddler = plan[cut - 1].task_group
+    if straddler:
+        while cut < n and plan[cut].task_group == straddler:
+            cut += 1
+    return cut
 
 
 def save_doc(store: Store, doc: dict, secondary: bool = False):
@@ -84,14 +100,3 @@ def save_doc(store: Store, doc: dict, secondary: bool = False):
     c = tq_coll(store, secondary)
     c.upsert(doc)
     return c
-
-
-def cap_queue_docs(items: List[dict], max_len: int) -> List[dict]:
-    if max_len <= 0 or len(items) <= max_len:
-        return items
-    cut = max_len
-    straddler = items[cut - 1]["task_group"]
-    if straddler:
-        while cut < len(items) and items[cut]["task_group"] == straddler:
-            cut += 1
-    return items[:cut]

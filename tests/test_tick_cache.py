@@ -144,3 +144,34 @@ def test_cached_tick_equals_cold_tick(store):
     )
     q2 = [i.id for i in tq_mod.load(store, "d1").queue]
     assert "t003" not in q2 and len(q2) == len(q_warm) - 1
+
+
+def test_queue_cap_keeps_straddling_group_whole(store):
+    """task_queue_persister.go:66-84 semantics through the columnar
+    persister."""
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.scheduler.persister import persist_task_queue
+    from evergreen_tpu.models.task_queue import DistroQueueInfo
+
+    plan = (
+        [mk_task(i) for i in range(3)]
+        + [mk_task(10 + i, task_group="tg", task_group_max_hosts=1,
+                   task_group_order=i, build_variant="bv")
+           for i in range(4)]
+        + [mk_task(50)]
+    )
+    task_mod.insert_many(store, plan)
+    # cut lands at index 5 — inside the 4-task group starting at index 3
+    n = persist_task_queue(
+        store, "d1", plan, {}, {t.id: True for t in plan},
+        DistroQueueInfo(), max_scheduled_per_distro=5, now=NOW,
+    )
+    q = tq_mod.load(store, "d1")
+    ids = [i.id for i in q.queue]
+    # the whole straddling group is kept; the trailing solo task is cut
+    assert n == 7
+    assert ids == [t.id for t in plan[:7]]
+    assert "t050" not in ids
+    # roundtrip preserves item fields through the columnar format
+    assert q.queue[3].task_group == "tg"
+    assert q.queue[3].task_group_order == 0
