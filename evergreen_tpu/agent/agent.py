@@ -49,7 +49,7 @@ class Agent:
         task = self.comm.next_task(self.options.host_id)
         if task is None:
             return None
-        cfg = self.comm.get_task_config(task)
+        cfg = self.comm.get_task_config(task, self.options.host_id)
         self.comm.start_task(task.id)
         status, details_type, details_desc, timed_out, artifacts = self._run_task(cfg)
         self.comm.end_task(

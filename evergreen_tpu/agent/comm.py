@@ -48,7 +48,7 @@ class Communicator(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def get_task_config(self, task: Task) -> TaskConfig:
+    def get_task_config(self, task: Task, host_id: str = "") -> TaskConfig:
         ...
 
     @abc.abstractmethod
@@ -86,7 +86,7 @@ class LocalCommunicator(Communicator):
             return None
         return assign_next_available_task(self.store, self.svc, host)
 
-    def get_task_config(self, task: Task) -> TaskConfig:
+    def get_task_config(self, task: Task, host_id: str = "") -> TaskConfig:
         doc = self.store.collection(PARSER_PROJECTS_COLLECTION).get(task.version)
         if doc is None:
             return TaskConfig(task=task, commands=[])
@@ -111,10 +111,29 @@ class LocalCommunicator(Communicator):
         post = list(doc.get("post", []))
         if task.task_group:
             # Task-group members swap pre/post for the group's setup/teardown
-            # blocks (reference agent/agent.go runPreAndMain group handling).
+            # blocks (reference agent/agent.go runPreAndMain group handling);
+            # setup_group additionally runs before the FIRST group task on
+            # each host (the host's last_group tracks this), and
+            # teardown_group after the group's last task on this host.
             tg = doc.get("task_groups", {}).get(task.task_group, {})
             pre = list(tg.get("setup_task", []))
             post = list(tg.get("teardown_task", []))
+            if host_id:
+                from ..models import host as host_mod
+
+                h = host_mod.get(self.store, host_id)
+                if h is not None and h.last_group != task.task_group:
+                    pre = list(tg.get("setup_group", [])) + pre
+                remaining = self.store.collection("tasks").count(
+                    lambda d: d.get("task_group") == task.task_group
+                    and d["build_variant"] == task.build_variant
+                    and d["version"] == task.version
+                    and d["_id"] != task.id
+                    and d["status"] in ("undispatched", "dispatched", "started")
+                    and d.get("activated")
+                )
+                if remaining == 0:
+                    post = post + list(tg.get("teardown_group", []))
         return TaskConfig(
             task=task,
             commands=list(task_def.get("commands", [])),

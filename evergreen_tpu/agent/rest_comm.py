@@ -60,33 +60,33 @@ class RestCommunicator(Communicator):
         tid = resp.get("task_id")
         if not tid:
             return None
-        cfg = self._call("GET", f"/rest/v2/tasks/{tid}/agent/config")
-        self._project_doc = cfg.get("project", {})
+        cfg = self._call(
+            "GET", f"/rest/v2/hosts/{host_id}/agent/task_config/{tid}"
+        )
+        self._resolved_cfg = cfg
         return Task.from_doc(cfg["task"])
 
-    def get_task_config(self, task: Task) -> TaskConfig:
-        doc = getattr(self, "_project_doc", None)
-        if doc is None or doc.get("_id") != task.version:
-            cfg = self._call("GET", f"/rest/v2/tasks/{task.id}/agent/config")
-            doc = cfg.get("project", {})
-        # reuse the LocalCommunicator resolution logic on the fetched doc
-        from .comm import LocalCommunicator
-
-        resolver = LocalCommunicator.__new__(LocalCommunicator)
-
-        class _OneDocStore:
-            def __init__(self, inner):
-                self._doc = inner
-
-            def collection(self, name):
-                return self
-
-            def get(self, _id):
-                return self._doc if self._doc.get("_id") == _id else None
-
-        resolver.store = _OneDocStore(doc)
-        resolver.svc = None
-        return LocalCommunicator.get_task_config(resolver, task)
+    def get_task_config(self, task: Task, host_id: str = "") -> TaskConfig:
+        cfg = getattr(self, "_resolved_cfg", None)
+        if cfg is None or cfg.get("task", {}).get("_id") != task.id:
+            cfg = self._call(
+                "GET",
+                f"/rest/v2/hosts/{host_id or task.host_id}"
+                f"/agent/task_config/{task.id}",
+            )
+        # blocks arrive fully resolved by the server (incl. task-group
+        # setup_group/teardown_group based on the host's group state)
+        return TaskConfig(
+            task=task,
+            commands=cfg.get("commands", []),
+            pre=cfg.get("pre", []),
+            post=cfg.get("post", []),
+            timeout_handler=cfg.get("timeout_handler", []),
+            expansions=cfg.get("expansions", {}),
+            exec_timeout_s=float(cfg.get("exec_timeout_s", 0) or 0),
+            idle_timeout_s=float(cfg.get("idle_timeout_s", 0) or 0),
+            pre_error_fails_task=bool(cfg.get("pre_error_fails_task", False)),
+        )
 
     def start_task(self, task_id: str) -> None:
         self._call("POST", f"/rest/v2/tasks/{task_id}/agent/start")

@@ -202,6 +202,11 @@ class RestApi:
         # agent protocol (reference rest/route/host_agent.go, agent.go)
         r("GET", r"/rest/v2/hosts/(?P<host>[^/]+)/agent/next_task", self.next_task)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/config", self.task_config)
+        r(
+            "GET",
+            r"/rest/v2/hosts/(?P<host>[^/]+)/agent/task_config/(?P<task>[^/]+)",
+            self.resolved_task_config,
+        )
         r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/start", self.start_task)
         r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/heartbeat", self.heartbeat)
         r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/end", self.end_task)
@@ -270,6 +275,29 @@ class RestApi:
             raise ApiError(404, "task not found")
         doc = self.store.collection("parser_projects").get(t.version) or {}
         return 200, {"task": t.to_doc(), "project": doc}
+
+    def resolved_task_config(self, method, match, body):
+        """Server-side block resolution (incl. host task-group state:
+        setup_group/teardown_group) so the HTTP agent gets final blocks."""
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        from ..agent.comm import LocalCommunicator
+
+        cfg = LocalCommunicator(self.store, self.svc).get_task_config(
+            t, match["host"]
+        )
+        return 200, {
+            "task": t.to_doc(),
+            "commands": cfg.commands,
+            "pre": cfg.pre,
+            "post": cfg.post,
+            "timeout_handler": cfg.timeout_handler,
+            "expansions": cfg.expansions,
+            "exec_timeout_s": cfg.exec_timeout_s,
+            "idle_timeout_s": cfg.idle_timeout_s,
+            "pre_error_fails_task": cfg.pre_error_fails_task,
+        }
 
     def start_task(self, method, match, body):
         ok = mark_task_started(self.store, match["task"])

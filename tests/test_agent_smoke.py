@@ -242,3 +242,75 @@ def test_failure_blocks_dependents_and_steps_back(store, tmp_path):
     prev = task_mod.get(store, "prev-flaky")
     assert prev.activated
     assert prev.is_stepback_activated()
+
+
+def test_task_group_setup_and_teardown_blocks(store, tmp_path):
+    """setup_group runs before the first group task on a host;
+    teardown_group after the last (reference runPreAndMain group
+    handling + parserTaskGroup blocks)."""
+    now = time.time()
+    MockCloudManager.reset(instant_up=True)
+    distro_mod.insert(
+        store,
+        Distro(id="d1", provider=Provider.MOCK.value,
+               host_allocator_settings=HostAllocatorSettings(maximum_hosts=2)),
+    )
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        {
+            "_id": "vg",
+            "tasks": {
+                "g1": {"commands": [{"command": "shell.exec",
+                                     "params": {"script": "echo main-1"}}]},
+                "g2": {"commands": [{"command": "shell.exec",
+                                     "params": {"script": "echo main-2"}}]},
+            },
+            "task_groups": {
+                "grp": {
+                    "max_hosts": 1,
+                    "tasks": ["g1", "g2"],
+                    "setup_group": [{"command": "shell.exec",
+                                     "params": {"script": "echo SETUP-GROUP"}}],
+                    "setup_task": [{"command": "shell.exec",
+                                    "params": {"script": "echo setup-task"}}],
+                    "teardown_task": [{"command": "shell.exec",
+                                       "params": {"script": "echo teardown-task"}}],
+                    "teardown_group": [{"command": "shell.exec",
+                                        "params": {"script": "echo TEARDOWN-GROUP"}}],
+                },
+            },
+        }
+    )
+
+    def mk(tid, name, order):
+        return Task(
+            id=tid, display_name=name, project="p", version="vg",
+            distro_id="d1", build_variant="bv", status=TaskStatus.UNDISPATCHED.value,
+            activated=True, requester=Requester.REPOTRACKER.value,
+            activated_time=now - 60, create_time=now - 100,
+            task_group="grp", task_group_max_hosts=1, task_group_order=order,
+            expected_duration_s=30,
+        )
+
+    task_mod.insert_many(store, [mk("tg1", "g1", 1), mk("tg2", "g2", 2)])
+    run_tick(store, TickOptions(), now=now)
+    create_hosts_from_intents(store, now)
+    provision_ready_hosts(store, now)
+    hosts = host_mod.find(
+        store, lambda d: d["status"] == HostStatus.RUNNING.value
+    )
+    agent = Agent(
+        LocalCommunicator(store, DispatcherService(store)),
+        AgentOptions(host_id=hosts[0].id, work_dir=str(tmp_path)),
+    )
+    finished = agent.run_until_idle()
+    assert finished == ["tg1", "tg2"]
+
+    logs1 = store.collection("task_logs").get("tg1")["lines"]
+    logs2 = store.collection("task_logs").get("tg2")["lines"]
+    # first group task on the host: setup_group + setup_task, no teardown_group
+    assert any("SETUP-GROUP" in line for line in logs1)
+    assert any("setup-task" in line for line in logs1)
+    assert not any("TEARDOWN-GROUP" in line for line in logs1)
+    # second (last) group task: no setup_group, teardown_group at the end
+    assert not any("SETUP-GROUP" in line for line in logs2)
+    assert any("TEARDOWN-GROUP" in line for line in logs2)
