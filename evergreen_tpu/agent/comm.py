@@ -179,6 +179,18 @@ class LocalCommunicator(Communicator):
                      "processed": False}
                 )
             self._persist_task_output(task_id, artifacts)
+            # host.create requests become intent hosts owned by the task
+            # (reference host.create + units/provisioning for task hosts)
+            for req in artifacts.get("host_create", []):
+                if req.get("distro"):
+                    from ..models import distro as distro_mod
+                    from ..models.host import new_intent
+
+                    d = distro_mod.get(self.store, req["distro"])
+                    if d is not None:
+                        intent = new_intent(d.id, d.provider)
+                        intent.started_by = f"task:{task_id}"
+                        host_mod.insert(self.store, intent)
 
     def _persist_task_output(self, task_id: str, artifacts: Dict[str, Any]) -> None:
         """Test results + artifact records staged by commands (the

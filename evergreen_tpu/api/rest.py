@@ -229,6 +229,11 @@ class RestApi:
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)", self.get_version)
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)/tasks", self.version_tasks)
         r("GET", r"/rest/v2/builds/(?P<build>[^/]+)", self.get_build)
+        r(
+            "GET",
+            r"/rest/v2/builds/(?P<build>[^/]+)/display_tasks",
+            self.build_display_tasks,
+        )
         r("GET", r"/rest/v2/projects", self.list_projects)
         r("PUT", r"/rest/v2/projects/(?P<project>[^/]+)", self.put_project)
         r("PUT", r"/rest/v2/distros/(?P<distro>[^/]+)", self.put_distro)
@@ -421,6 +426,38 @@ class RestApi:
         if b is None:
             raise ApiError(404, "build not found")
         return 200, b.to_doc()
+
+    def build_display_tasks(self, method, match, body):
+        """Display-task groupings with rolled-up status (reference display
+        tasks on builds; status = worst member status)."""
+        out = []
+        for doc in self.store.collection("display_tasks").find(
+            lambda d: d["build_id"] == match["build"]
+        ):
+            members = task_mod.by_ids(self.store, doc["execution_tasks"])
+            statuses = [m.status for m in members]
+            if any(s == TaskStatus.FAILED.value for s in statuses):
+                rollup = TaskStatus.FAILED.value
+            elif statuses and all(
+                s == TaskStatus.SUCCEEDED.value for s in statuses
+            ):
+                rollup = TaskStatus.SUCCEEDED.value
+            elif any(
+                s in (TaskStatus.STARTED.value, TaskStatus.DISPATCHED.value)
+                for s in statuses
+            ):
+                rollup = TaskStatus.STARTED.value
+            else:
+                rollup = TaskStatus.UNDISPATCHED.value
+            out.append(
+                {
+                    "name": doc["name"],
+                    "build_id": doc["build_id"],
+                    "execution_tasks": doc["execution_tasks"],
+                    "status": rollup,
+                }
+            )
+        return 200, out
 
     def list_projects(self, method, match, body):
         return 200, self.store.collection(

@@ -203,3 +203,50 @@ def test_rate_limited_api(store):
     assert api.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 200
     assert api.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 200
     assert api.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 429
+
+
+def test_display_tasks_rollup(store, server):
+    base, _ = server
+    seed(store)
+    comm = RestCommunicator(base)
+    store.collection("display_tasks").upsert(
+        {"_id": "dt1", "name": "all-the-things", "build_id": "b1",
+         "version": "v1", "build_variant": "lin",
+         "execution_tasks": ["e1", "e2"]}
+    )
+    task_mod.insert(
+        store, task_mod.Task(id="e1", build_id="b1",
+                             status=TaskStatus.SUCCEEDED.value)
+    )
+    task_mod.insert(
+        store, task_mod.Task(id="e2", build_id="b1",
+                             status=TaskStatus.FAILED.value)
+    )
+    out = comm._call("GET", "/rest/v2/builds/b1/display_tasks")
+    assert out[0]["name"] == "all-the-things"
+    assert out[0]["status"] == TaskStatus.FAILED.value
+
+
+def test_host_create_materializes_intent(store, server, tmp_path):
+    from evergreen_tpu.agent.comm import LocalCommunicator
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.models import distro as distro_mod_
+    from evergreen_tpu.models.distro import Distro as Distro_
+
+    seed(store)
+    distro_mod_.insert(store, Distro_(id="task-host-distro"))
+    task_mod.insert(
+        store, task_mod.Task(id="creator", status=TaskStatus.STARTED.value,
+                             activated=True, start_time=time.time()),
+    )
+    comm = LocalCommunicator(store, DispatcherService(store))
+    comm.end_task(
+        "creator", TaskStatus.SUCCEEDED.value,
+        artifacts={"host_create": [{"distro": "task-host-distro",
+                                    "task_id": "creator"}]},
+    )
+    intents = host_mod.find(
+        store, lambda d: d["distro_id"] == "task-host-distro"
+    )
+    assert len(intents) == 1
+    assert intents[0].started_by == "task:creator"
