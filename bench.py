@@ -108,6 +108,25 @@ def main() -> None:
         distros, tasks_by_distro, hosts_by_distro
     )
 
+    # --- the other BASELINE configs, reported for completeness ------------- #
+    extra = {}
+    for name, kwargs in (
+        ("cfg1_1d_1k", dict(n_distros=1, n_tasks=1_000)),
+        ("cfg2_50d_10k_deps", dict(n_distros=50, n_tasks=10_000,
+                                   dep_fraction=0.5)),
+        ("cfg4_mixed_providers", dict(
+            n_distros=100, n_tasks=20_000,
+            provider_mix=("mock", "docker", "ec2-fleet"), max_hosts=20,
+        )),
+    ):
+        p = generate_problem(seed=9, **kwargs)
+        s0 = build_snapshot(*p, NOW)
+        run_solve_packed(s0)  # warm this shape
+        t1 = time.perf_counter()
+        s1 = build_snapshot(*p, NOW)
+        run_solve_packed(s1)
+        extra[name] = (time.perf_counter() - t1) * 1e3
+
     result = {
         "metric": "sched_tick_50k_tasks_200_distros",
         "value": round(tpu_ms, 2),
@@ -115,11 +134,12 @@ def main() -> None:
         "vs_baseline": round(serial_ms / tpu_ms, 2),
     }
     print(json.dumps(result))
+    configs = " ".join(f"{k}={v:.0f}ms" for k, v in extra.items())
     print(
         f"# snapshot={statistics.median(snap_ms):.1f}ms "
         f"solve={statistics.median(solve_ms):.1f}ms "
         f"serial_baseline={serial_ms:.1f}ms gen={gen_s:.1f}s "
-        f"churn_tick={churn_ms:.1f}ms target=<500ms",
+        f"churn_tick={churn_ms:.1f}ms {configs} target=<500ms",
         file=sys.stderr,
     )
 

@@ -253,6 +253,10 @@ class RestApi:
         r("POST", r"/rest/v2/admin/settings", self.set_admin)
         r("GET", r"/rest/v2/status", self.status)
         r("GET", r"/rest/v2/events", self.list_events)
+        r("POST", r"/rest/v2/subscriptions", self.create_subscription)
+        r("GET", r"/rest/v2/subscriptions", self.list_subscriptions)
+        r("GET", r"/rest/v2/stats/spans", self.list_spans)
+        r("GET", r"/rest/v2/stats/hosts", self.host_stats)
 
     # -- agent protocol ------------------------------------------------- #
 
@@ -630,6 +634,38 @@ class RestApi:
         evs = self.store.collection("events").find()
         evs.sort(key=lambda d: d["timestamp"])
         return 200, evs[-200:]
+
+    def create_subscription(self, method, match, body):
+        """Notification subscriptions (reference rest/route subscriptions)."""
+        from ..events.triggers import Subscription, add_subscription
+
+        try:
+            sub = Subscription(
+                id=body.get("id") or f"sub-{_time.time_ns()}",
+                resource_type=body["resource_type"],
+                trigger=body["trigger"],
+                subscriber_type=body["subscriber_type"],
+                subscriber_target=body["subscriber_target"],
+                filters=body.get("filters", {}),
+                owner=body.get("owner", ""),
+            )
+        except KeyError as e:
+            raise ApiError(400, f"missing subscription field {e}")
+        add_subscription(self.store, sub)
+        return 201, sub.to_doc()
+
+    def list_subscriptions(self, method, match, body):
+        return 200, self.store.collection("subscriptions").find()
+
+    def list_spans(self, method, match, body):
+        from ..utils.tracing import get_spans
+
+        return 200, get_spans(self.store)[-200:]
+
+    def host_stats(self, method, match, body):
+        stats = self.store.collection("host_stats").find()
+        stats.sort(key=lambda d: d["at"])
+        return 200, stats[-500:]
 
 
 def dataclasses_to_dict(x):

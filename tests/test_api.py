@@ -250,3 +250,26 @@ def test_host_create_materializes_intent(store, server, tmp_path):
     )
     assert len(intents) == 1
     assert intents[0].started_by == "task:creator"
+
+
+def test_subscriptions_and_stats_endpoints(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    out = comm._call(
+        "POST", "/rest/v2/subscriptions",
+        {"resource_type": "TASK", "trigger": "failure",
+         "subscriber_type": "email", "subscriber_target": "x@y.z",
+         "filters": {"project": "p"}},
+    )
+    assert out["resource_type"] == "TASK"
+    subs = comm._call("GET", "/rest/v2/subscriptions")
+    assert len(subs) == 1
+    out = comm._call("POST", "/rest/v2/subscriptions", {"trigger": "failure"})
+    assert out.get("_status") == 400
+    # spans recorded by a tick are visible
+    from evergreen_tpu.utils.tracing import Tracer
+
+    with Tracer(store, "scheduler").span("tick", n_tasks=1):
+        pass
+    spans = comm._call("GET", "/rest/v2/stats/spans")
+    assert any(s["name"] == "tick" for s in spans)
