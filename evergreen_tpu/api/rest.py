@@ -167,10 +167,17 @@ class RestApi:
             for k, v in environ.items()
             if k.startswith("HTTP_")
         }
+        if path in ("/", "/ui"):
+            from .ui import PAGE
+
+            start_response("200 OK", [("Content-Type", "text/html")])
+            return [PAGE.encode()]
         if path == "/hooks/github":
             status, payload = self._github_hook(raw, headers, body)
         else:
-            status, payload = self.handle(method, path, body, headers)
+            # query strings are informational only (e.g. ?limit=)
+            status, payload = self.handle(method, path.split("?")[0], body,
+                                          headers)
         reason = {200: "OK", 201: "Created", 400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
                   409: "Conflict", 429: "Too Many Requests",
@@ -226,6 +233,7 @@ class RestApi:
         r("GET", r"/rest/v2/distros/(?P<distro>[^/]+)/queue", self.get_queue)
 
         # versions / builds / projects
+        r("GET", r"/rest/v2/versions", self.list_versions)
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)", self.get_version)
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)/tasks", self.version_tasks)
         r("GET", r"/rest/v2/builds/(?P<build>[^/]+)", self.get_build)
@@ -412,6 +420,11 @@ class RestApi:
         return 200, q.to_doc()
 
     # -- versions / projects ---------------------------------------------- #
+
+    def list_versions(self, method, match, body):
+        docs = version_mod.coll(self.store).find()
+        docs.sort(key=lambda d: d.get("create_time", 0.0), reverse=True)
+        return 200, docs[:50]
 
     def get_version(self, method, match, body):
         v = version_mod.get(self.store, match["version"])

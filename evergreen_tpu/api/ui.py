@@ -1,0 +1,88 @@
+"""Minimal web UI.
+
+The reference's UI surface is Spruce (a separate React app on the GraphQL
+API). This is the single-page stand-in: one HTML page polling the REST API
+for versions, tasks, hosts and recent events — enough to watch the system
+run from a browser.
+"""
+from __future__ import annotations
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>evergreen-tpu</title>
+<style>
+  body { font: 13px/1.45 -apple-system, Segoe UI, sans-serif; margin: 2rem;
+         color: #222; }
+  h1 { font-size: 18px; } h2 { font-size: 14px; margin-top: 1.6em; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 3px 10px 3px 0;
+           border-bottom: 1px solid #eee; }
+  .success { color: #0a7d36; } .failed { color: #c0392b; }
+  .started, .dispatched { color: #b8860b; }
+  .undispatched { color: #888; }
+  code { background: #f5f5f5; padding: 0 3px; }
+  #statusbar { color: #555; }
+</style>
+</head>
+<body>
+<h1>evergreen-tpu</h1>
+<div id="statusbar">loading…</div>
+<h2>Recent versions</h2>
+<table id="versions"><thead><tr><th>version</th><th>project</th>
+<th>status</th><th>tasks</th></tr></thead><tbody></tbody></table>
+<h2>Hosts</h2>
+<table id="hosts"><thead><tr><th>host</th><th>distro</th><th>status</th>
+<th>running task</th></tr></thead><tbody></tbody></table>
+<h2>Recent events</h2>
+<table id="events"><thead><tr><th>type</th><th>resource</th></tr></thead>
+<tbody></tbody></table>
+<script>
+async function j(p) { const r = await fetch(p); return r.json(); }
+function row(cells) {
+  const tr = document.createElement("tr");
+  for (const [text, cls] of cells) {
+    const td = document.createElement("td");
+    td.textContent = text;
+    if (cls) td.className = cls;
+    tr.appendChild(td);
+  }
+  return tr;
+}
+function fill(id, rows) {
+  const tb = document.querySelector(`#${id} tbody`);
+  tb.replaceChildren(...rows);
+}
+async function refresh() {
+  try {
+    const s = await j("/rest/v2/status");
+    document.getElementById("statusbar").textContent =
+      `tasks: ${s.tasks} · hosts: ${s.hosts} · distros: ${s.distros} ` +
+      `· versions: ${s.versions} · jobs pending: ${s.jobs_pending}`;
+    const versions = await j("/rest/v2/versions?limit=15");
+    const vrows = [];
+    for (const v of versions) {
+      const tasks = await j(`/rest/v2/versions/${v._id}/tasks`);
+      const done = tasks.filter(t => t.status === "success").length;
+      vrows.push(row([[v._id], [v.project], [v.status, v.status],
+                      [`${done}/${tasks.length} ok`]]));
+    }
+    fill("versions", vrows);
+    const hosts = await j("/rest/v2/hosts");
+    fill("hosts", hosts.slice(0, 30).map(h =>
+      row([[h._id], [h.distro_id], [h.status, h.status],
+           [h.running_task || "—"]])));
+    const events = await j("/rest/v2/events");
+    fill("events", events.slice(-20).reverse().map(e =>
+      row([[e.event_type], [e.resource_id]])));
+  } catch (err) {
+    document.getElementById("statusbar").textContent = "error: " + err;
+  }
+}
+refresh();
+setInterval(refresh, 5000);
+</script>
+</body>
+</html>
+"""

@@ -1,0 +1,79 @@
+set -e
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PYTHONPATH=/root/repo
+cd /root/repo
+PORT=19251
+python -m evergreen_tpu service --port $PORT > /tmp/soak_svc2.log 2>&1 &
+SVC=$!
+trap "kill $SVC 2>/dev/null; pkill -f 'evergreen_tpu agent' 2>/dev/null" EXIT
+for i in $(seq 40); do curl -s localhost:$PORT/rest/v2/status >/dev/null 2>&1 && break; sleep 0.5; done
+
+python - <<'PY' &
+import json, textwrap, time, urllib.request
+base = "http://127.0.0.1:19251"
+def call(method, path, body=None):
+    req = urllib.request.Request(base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method, headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read() or b"{}")
+call("PUT", "/rest/v2/distros/soak",
+     {"provider": "mock", "host_allocator_settings": {"maximum_hosts": 6}})
+call("PUT", "/rest/v2/projects/soakproj", {})
+cfg = textwrap.dedent("""
+tasks:
+  - name: work-a
+    commands: [{command: shell.exec, params: {script: "sleep 0.2 && echo a"}}]
+  - name: work-b
+    depends_on: [{name: work-a}]
+    commands: [{command: shell.exec, params: {script: "sleep 0.1 && echo b"}}]
+  - name: work-c
+    commands: [{command: shell.exec, params: {script: "sleep 0.15 && echo c"}}]
+buildvariants:
+  - name: bv
+    run_on: [soak]
+    tasks: [{name: work-a}, {name: work-b}, {name: work-c}]
+""")
+for i in range(1, 6):
+    out = call("POST", "/rest/v2/projects/soakproj/revisions",
+               {"revision": f"rev{i:08d}", "config_yaml": cfg})
+    print("pushed", out, flush=True)
+    time.sleep(10)
+PY
+PUSHER=$!
+
+for i in $(seq 120); do
+  N=$(curl -s localhost:$PORT/rest/v2/hosts | python -c "import json,sys; print(sum(1 for h in json.load(sys.stdin) if h['status']=='running'))" 2>/dev/null || echo 0)
+  [ "${N:-0}" -ge 1 ] && break
+  sleep 2
+done
+HOSTS=$(curl -s localhost:$PORT/rest/v2/hosts | python -c "import json,sys; print(' '.join(h['_id'] for h in json.load(sys.stdin) if h['status']=='running'))")
+echo "agents on: $HOSTS"
+for H in $HOSTS; do
+  python -m evergreen_tpu agent --host-id "$H" --api-server http://127.0.0.1:$PORT > /tmp/soak_agent2_$H.log 2>&1 &
+done
+
+wait $PUSHER || true
+sleep 100
+
+python - <<'PY'
+import collections, json, urllib.request
+base = "http://127.0.0.1:19251"
+def get(p):
+    return json.load(urllib.request.urlopen(base + p, timeout=30))
+print("status:", get("/rest/v2/status"))
+counts = collections.Counter()
+pending = []
+for i in range(1, 6):
+    vid = f"soakproj_{i}_rev0000000"
+    try:
+        v = get(f"/rest/v2/versions/{vid}")
+        counts[v["status"]] += 1
+        if v["status"] not in ("success", "failed"):
+            pending.append(vid)
+    except Exception:
+        counts["missing"] += 1
+print("version outcomes:", dict(counts), "pending:", pending)
+failed_jobs = [e for e in get("/rest/v2/events") if e["event_type"] == "JOB_FAILED"]
+print("failed jobs:", len(failed_jobs))
+for e in failed_jobs[:3]:
+    print("  ", e["data"].get("type"), (e["data"].get("error") or "")[-200:])
+PY
