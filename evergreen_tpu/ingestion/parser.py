@@ -343,7 +343,12 @@ def parse_project(
     """Parse an evergreen.yml. ``include_resolver(filename, module) -> str``
     supplies included file contents (reference parserInclude +
     project_parser_merge_functions.go); includes merge list/map fields."""
-    data = yaml.safe_load(yaml_text)
+    try:
+        data = yaml.safe_load(yaml_text)
+    except yaml.YAMLError as e:
+        # malformed YAML must surface as a parse error (stub-version path),
+        # not crash the repotracker job
+        raise ProjectParseError(f"invalid YAML: {e}") from e
     if data is None:
         data = {}
     if not isinstance(data, dict):

@@ -1,0 +1,144 @@
+"""Property-based fuzzing: parser robustness + dispatcher model checking
+(the reference's fuzz-testing analog, scheduler/host_allocator_fuzzer_test.go
+spirit applied to other subsystems)."""
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from evergreen_tpu.ingestion.parser import ProjectParseError, parse_project
+from evergreen_tpu.ingestion.validator import validate_project
+
+# --------------------------------------------------------------------------- #
+# Parser: any YAML-ish input either parses or raises ProjectParseError —
+# never a stray TypeError/AttributeError/KeyError escape.
+# --------------------------------------------------------------------------- #
+
+_names = st.text(string.ascii_lowercase + "-_", min_size=1, max_size=8)
+
+_scalar = st.one_of(
+    st.none(), st.booleans(), st.integers(-5, 500), _names,
+    st.floats(allow_nan=False, allow_infinity=False, width=32),
+)
+
+_command = st.fixed_dictionaries(
+    {},
+    optional={
+        "command": _names,
+        "func": _names,
+        "params": st.dictionaries(_names, _scalar, max_size=3),
+        "vars": st.dictionaries(_names, _scalar, max_size=2),
+    },
+)
+
+_task = st.fixed_dictionaries(
+    {},
+    optional={
+        "name": st.one_of(_names, st.none(), st.integers()),
+        "priority": _scalar,
+        "commands": st.one_of(st.lists(_command, max_size=3), _scalar),
+        "depends_on": st.one_of(
+            st.lists(
+                st.one_of(
+                    _names,
+                    st.fixed_dictionaries(
+                        {}, optional={"name": _names, "variant": _names,
+                                      "status": _names}
+                    ),
+                ),
+                max_size=3,
+            ),
+            _scalar,
+        ),
+        "tags": st.one_of(st.lists(_names, max_size=3), _names, st.none()),
+        "run_on": st.one_of(st.lists(_names, max_size=2), _names),
+        "patchable": _scalar,
+        "exec_timeout_secs": _scalar,
+    },
+)
+
+_bv = st.fixed_dictionaries(
+    {},
+    optional={
+        "name": st.one_of(_names, st.none()),
+        "run_on": st.one_of(st.lists(_names, max_size=2), _names),
+        "tasks": st.one_of(
+            st.lists(
+                st.one_of(_names, st.fixed_dictionaries(
+                    {}, optional={"name": _names, "priority": _scalar}
+                )),
+                max_size=4,
+            ),
+            _scalar,
+        ),
+        "expansions": st.dictionaries(_names, _scalar, max_size=3),
+        "batchtime": _scalar,
+        "matrix_name": _names,
+        "matrix_spec": st.dictionaries(_names, st.one_of(_names, st.lists(_names, max_size=2)), max_size=2),
+    },
+)
+
+_project = st.fixed_dictionaries(
+    {},
+    optional={
+        "stepback": _scalar,
+        "pre": st.one_of(st.lists(_command, max_size=2), _scalar),
+        "post": st.lists(_command, max_size=2),
+        "functions": st.dictionaries(
+            _names, st.one_of(st.lists(_command, max_size=2), _command),
+            max_size=3,
+        ),
+        "tasks": st.one_of(st.lists(_task, max_size=4), _scalar),
+        "buildvariants": st.one_of(st.lists(_bv, max_size=3), _scalar),
+        "task_groups": st.lists(
+            st.fixed_dictionaries(
+                {}, optional={"name": _names, "max_hosts": _scalar,
+                              "tasks": st.lists(_names, max_size=3)}
+            ),
+            max_size=2,
+        ),
+        "axes": st.lists(
+            st.fixed_dictionaries(
+                {}, optional={"id": _names, "values": st.lists(
+                    st.fixed_dictionaries({}, optional={"id": _names}),
+                    max_size=2)}
+            ),
+            max_size=2,
+        ),
+        "ignore": _scalar,
+        "exec_timeout_secs": _scalar,
+    },
+)
+
+
+@settings(max_examples=300, deadline=None)
+@given(_project)
+def test_parser_never_crashes(doc):
+    import yaml
+
+    text = yaml.safe_dump(doc)
+    try:
+        parse_project(text)
+    except ProjectParseError:
+        pass  # the one sanctioned failure mode
+
+
+@settings(max_examples=150, deadline=None)
+@given(_project)
+def test_validator_never_crashes(doc):
+    import yaml
+
+    issues = validate_project(None, yaml.safe_dump(doc))
+    # issues are well-formed
+    assert all(i.level in ("error", "warning") and i.message for i in issues)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(max_size=200))
+def test_parser_raw_text_never_crashes(text):
+    """ProjectParseError is the ONLY failure mode — yaml scanner errors
+    must be wrapped (the repotracker stub-version path catches only
+    ProjectParseError)."""
+    try:
+        parse_project(text)
+    except ProjectParseError:
+        pass
