@@ -20,5 +20,16 @@ from evergreen_tpu.storage.store import reset_global_store  # noqa: E402
 
 @pytest.fixture()
 def store():
-    """Fresh store per test — the db.ClearCollections analog."""
+    """Fresh store per test — the db.ClearCollections analog — plus resets
+    of process-global fakes/registries so tests cannot cross-pollute."""
+    from evergreen_tpu.cloud import docker as docker_mod
+    from evergreen_tpu.cloud import ec2_fleet
+    from evergreen_tpu.cloud.mock import MockCloudManager
+    from evergreen_tpu.events import github_status, triggers
+
+    MockCloudManager.reset()
+    ec2_fleet.reset_default_client()
+    docker_mod.reset_default_client()
+    triggers._SENDERS.clear()
+    github_status._store_ref = None
     return reset_global_store()
