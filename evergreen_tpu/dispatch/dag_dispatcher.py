@@ -72,6 +72,7 @@ class DAGDispatcher:
         self.secondary = secondary
         self._lock = threading.RLock()
         self._last_updated = 0.0
+        self._loaded_stamp = 0.0
         self._sorted: List[TaskQueueItem] = []
         self._items: Dict[str, TaskQueueItem] = {}
         self._groups: Dict[str, _GroupUnit] = {}
@@ -83,9 +84,23 @@ class DAGDispatcher:
         now = _time.time() if now is None else now
         with self._lock:
             if not force and now - self._last_updated < self.ttl_s:
-                return
+                # dependency-wake fast path: a MarkEnd flipped queue flags
+                # and stamped the doc dirty (dispatch/wake.py) — rebuild
+                # immediately instead of waiting out the TTL
+                doc = tq_mod.coll(self.store, self.secondary).get(self.distro_id)
+                stamp = 0.0
+                if doc is not None:
+                    stamp = max(doc.get("generated_at", 0.0),
+                                doc.get("dirty_at", 0.0))
+                if stamp <= self._loaded_stamp:
+                    return
             queue = tq_mod.load(self.store, self.distro_id,
                                 secondary=self.secondary)
+            doc = tq_mod.coll(self.store, self.secondary).get(self.distro_id)
+            self._loaded_stamp = (
+                max(doc.get("generated_at", 0.0), doc.get("dirty_at", 0.0))
+                if doc else 0.0
+            )
             self.rebuild(queue.queue if queue else [], now)
 
     def rebuild(self, items: List[TaskQueueItem], now: float) -> None:

@@ -1,0 +1,61 @@
+"""Dependency wake: flip ready dependents' queue flags without a re-plan.
+
+The reference leaves freshly-unblocked dependents waiting for the next
+planning tick AND the dispatcher's TTL refresh
+(task_queue_service_dependency.go:316-317 "we just wait for the in-memory
+queue to refresh"). Here MarkEnd knows exactly which dependents became
+ready, so it updates their persisted queue items' dependencies-met flags in
+place (ordering is untouched — exactly what the next tick would compute)
+and stamps the queue dirty; dispatchers rebuild on the next poll instead
+of waiting out their TTL.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..models import task_queue as tq_mod
+from ..storage.store import Store
+
+
+def wake_dependents(store: Store, ready_ids: List[str], now: float) -> int:
+    """Mark ready tasks dependencies-met in their distros' queue docs.
+    Returns the number of queue entries updated."""
+    # group ready ids by the distro whose queue holds them
+    by_distro: Dict[str, List[str]] = {}
+    task_coll = store.collection("tasks")
+    for tid in ready_ids:
+        doc = task_coll.get(tid)
+        if doc is None:
+            continue
+        by_distro.setdefault(doc["distro_id"], []).append(tid)
+        for sd in doc.get("secondary_distros", []):
+            by_distro.setdefault(sd, []).append(tid)
+
+    n = 0
+    for distro_id, tids in by_distro.items():
+        for secondary in (False, True):
+            coll = tq_mod.coll(store, secondary)
+            qdoc = coll.get(distro_id)
+            if qdoc is None:
+                continue
+            want = set(tids)
+            updated = False
+            cols = qdoc.get("cols")
+            if cols is not None:
+                ids = cols["id"]
+                met = cols["dependencies_met"]
+                for idx, qid in enumerate(ids):
+                    if qid in want and not met[idx]:
+                        met[idx] = True
+                        updated = True
+                        n += 1
+            else:  # legacy item-list format
+                for item in qdoc.get("queue", []):
+                    if item["id"] in want and not item["dependencies_met"]:
+                        item["dependencies_met"] = True
+                        updated = True
+                        n += 1
+            if updated:
+                # bump the dirty stamp so dispatchers rebuild on next poll
+                coll.update(distro_id, {"dirty_at": now})
+    return n

@@ -78,9 +78,17 @@ def update_dependencies_on_finish(
     block downstream tasks (reference UpdateBlockedDependencies +
     MarkDependenciesFinished, model/task_lifecycle.go:775-776).
 
+    Dependents whose every edge is now finished-and-satisfied get a
+    dependency WAKE: their queue items flip to dependencies-met in place
+    and the distro's dispatcher is invalidated, so they dispatch on the
+    next poll instead of after the next planning tick + dispatcher TTL
+    (a latency improvement over the reference, which waits for both —
+    task_queue_service_dependency.go:316-317).
+
     Returns the ids of tasks that became blocked.
     """
     coll = task_mod.coll(store)
+    newly_ready: List[str] = []
     # Wave of (task id, final-or-blocked status, blocked?) to propagate.
     newly_blocked: List[str] = []
     wave = [(finished.id, finished.status, False)]
@@ -113,6 +121,18 @@ def update_dependencies_on_finish(
                             became_blocked = True
             if changed:
                 coll.update(doc["_id"], {"depends_on": doc["depends_on"]})
+                if (
+                    not became_blocked
+                    and doc["status"] == TaskStatus.UNDISPATCHED.value
+                    and doc.get("activated")
+                    and all(
+                        d["finished"] and not d["unattainable"]
+                        for d in doc["depends_on"]
+                    )
+                ):
+                    newly_ready.append(doc["_id"])
+                    if doc.get("dependencies_met_time", 0.0) <= 0.0:
+                        coll.update(doc["_id"], {"dependencies_met_time": now})
             if became_blocked and not doc.get("override_dependencies", False):
                 newly_blocked.append(doc["_id"])
                 wave.append((doc["_id"], "", True))
@@ -124,6 +144,11 @@ def update_dependencies_on_finish(
                     {"blocked_by": parent_id},
                     timestamp=now,
                 )
+
+    if newly_ready:
+        from ..dispatch.wake import wake_dependents
+
+        wake_dependents(store, newly_ready, now)
     return newly_blocked
 
 

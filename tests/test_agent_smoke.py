@@ -144,6 +144,7 @@ def test_full_pipeline(store, tmp_path):
     finished = agent.run_until_idle()
     # compile must run before its dependent; lint fails (exit 3)
     assert "t-compile" in finished
+    assert finished.index("t-compile") < finished.index("t-test")
 
     compile_t = task_mod.get(store, "t-compile")
     assert compile_t.status == TaskStatus.SUCCEEDED.value
@@ -152,16 +153,12 @@ def test_full_pipeline(store, tmp_path):
     assert lint_t.status == TaskStatus.FAILED.value
     assert lint_t.details_type == "test"
 
-    # 4. The dependent test task becomes runnable on the NEXT tick: the
-    # queue item's deps-met flag is recomputed at plan time and the
-    # dispatcher picks it up after a refresh (reference waits for the
-    # in-memory queue TTL, task_queue_service_dependency.go:316-317).
-    assert task_mod.get(store, "t-test").status == TaskStatus.UNDISPATCHED.value
-    run_tick(store, TickOptions(), now=now + 15)
-    svc.get("d1").refresh(force=True)
-    finished2 = agent.run_until_idle()
-    assert finished2 == ["t-test"]
+    # 4. The dependent test task ran in the SAME drain: the dependency
+    # wake flips its queue flag when compile finishes (dispatch/wake.py)
+    # instead of waiting for the next tick + dispatcher TTL like the
+    # reference (task_queue_service_dependency.go:316-317).
     assert task_mod.get(store, "t-test").status == TaskStatus.SUCCEEDED.value
+    finished2 = []
 
     # 5. Host released after each task.
     h = host_mod.get(store, hosts[0].id)

