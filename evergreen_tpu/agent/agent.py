@@ -116,9 +116,17 @@ class Agent:
                 details_type = "test"
                 details_desc = main_desc
 
-        # post block always runs; its failures never change the task status
-        # unless post_error_fails_task (not yet surfaced)
-        self._run_block(ctx, cfg.post, "post")
+        # post block always runs; its failures only change the task status
+        # when post_error_fails_task is set (reference agent post handling)
+        post_failed, post_desc = self._run_block(ctx, cfg.post, "post")
+        if (
+            post_failed
+            and cfg.post_error_fails_task
+            and status == TaskStatus.SUCCEEDED.value
+        ):
+            status = TaskStatus.FAILED.value
+            details_type = "setup"
+            details_desc = post_desc
 
         # resource accounting for the task's subprocess tree (the reference's
         # per-task resource monitor + OOM tracker, agent/resource_monitor.go)

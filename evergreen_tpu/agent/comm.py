@@ -40,6 +40,7 @@ class TaskConfig:
     exec_timeout_s: float = 0.0
     idle_timeout_s: float = 0.0
     pre_error_fails_task: bool = False
+    post_error_fails_task: bool = False
 
 
 class Communicator(abc.ABC):
@@ -146,6 +147,7 @@ class LocalCommunicator(Communicator):
             ),
             idle_timeout_s=float(task_def.get("timeout_secs", 0) or 0),
             pre_error_fails_task=bool(doc.get("pre_error_fails_task", False)),
+            post_error_fails_task=bool(doc.get("post_error_fails_task", False)),
         )
 
     def start_task(self, task_id: str) -> None:

@@ -86,6 +86,7 @@ class RestCommunicator(Communicator):
             exec_timeout_s=float(cfg.get("exec_timeout_s", 0) or 0),
             idle_timeout_s=float(cfg.get("idle_timeout_s", 0) or 0),
             pre_error_fails_task=bool(cfg.get("pre_error_fails_task", False)),
+            post_error_fails_task=bool(cfg.get("post_error_fails_task", False)),
         )
 
     def start_task(self, task_id: str) -> None:

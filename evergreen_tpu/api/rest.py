@@ -236,6 +236,8 @@ class RestApi:
         r("GET", r"/rest/v2/versions", self.list_versions)
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)", self.get_version)
         r("GET", r"/rest/v2/versions/(?P<version>[^/]+)/tasks", self.version_tasks)
+        r("POST", r"/rest/v2/versions/(?P<version>[^/]+)/restart", self.restart_version)
+        r("POST", r"/rest/v2/versions/(?P<version>[^/]+)/abort", self.abort_version)
         r("GET", r"/rest/v2/builds/(?P<build>[^/]+)", self.get_build)
         r(
             "GET",
@@ -314,6 +316,7 @@ class RestApi:
             "exec_timeout_s": cfg.exec_timeout_s,
             "idle_timeout_s": cfg.idle_timeout_s,
             "pre_error_fails_task": cfg.pre_error_fails_task,
+            "post_error_fails_task": cfg.post_error_fails_task,
         }
 
     def start_task(self, method, match, body):
@@ -437,6 +440,36 @@ class RestApi:
             self.store, lambda d: d["version"] == match["version"]
         )
         return 200, [t.to_doc() for t in ts]
+
+    def restart_version(self, method, match, body):
+        """Restart every finished task of a version (reference
+        units/tasks_restart.go / version restart route)."""
+        by = body.get("user", "api")
+        restarted = []
+        for t in task_mod.find(
+            self.store, lambda d: d["version"] == match["version"]
+        ):
+            if t.is_finished() and task_jobs.restart_task(
+                self.store, t.id, by=by
+            ):
+                restarted.append(t.id)
+        return 200, {"restarted": restarted}
+
+    def abort_version(self, method, match, body):
+        """Flag every in-flight task of a version for abort and deactivate
+        the queued ones (reference version abort semantics)."""
+        by = body.get("user", "api")
+        aborted, deactivated = [], []
+        for t in task_mod.find(
+            self.store, lambda d: d["version"] == match["version"]
+        ):
+            if t.status in (TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value):
+                task_jobs.abort_task(self.store, t.id, by=by)
+                aborted.append(t.id)
+            elif t.status == TaskStatus.UNDISPATCHED.value and t.activated:
+                task_mod.coll(self.store).update(t.id, {"activated": False})
+                deactivated.append(t.id)
+        return 200, {"aborted": aborted, "deactivated": deactivated}
 
     def get_build(self, method, match, body):
         b = build_mod.get(self.store, match["build"])

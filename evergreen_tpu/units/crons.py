@@ -110,6 +110,12 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             job_type="idle-termination",
         ),
         FnJob(
+            f"stale-building-{now:.3f}",
+            lambda s: host_jobs.reap_stale_building_hosts(s),
+            scopes=["stale-building"],
+            job_type="stale-building",
+        ),
+        FnJob(
             f"host-drawdown-{now:.3f}",
             lambda s: host_jobs.host_drawdown(s),
             scopes=["host-drawdown"],

@@ -98,6 +98,31 @@ def fix_stranded_task(
     )
 
 
+def reap_stale_building_hosts(
+    store: Store, now: Optional[float] = None, stale_after_s: float = 15 * 60.0
+) -> List[str]:
+    """Hosts stuck spawning/provisioning beyond the window are failed and
+    terminated so capacity intent doesn't leak (reference
+    host.MarkStaleBuildingAsFailed via units/host_allocator.go:127-134 +
+    provision-failed handling)."""
+    now = _time.time() if now is None else now
+    reaped: List[str] = []
+    for h in host_mod.find(
+        store,
+        lambda d: d["status"]
+        in (
+            HostStatus.BUILDING.value,
+            HostStatus.STARTING.value,
+            HostStatus.PROVISIONING.value,
+        )
+        and now - max(d.get("start_time", 0.0), d.get("creation_time", 0.0))
+        > stale_after_s,
+    ):
+        _terminate(store, h, "stale building/provisioning", now)
+        reaped.append(h.id)
+    return reaped
+
+
 def terminate_idle_hosts(store: Store, now: Optional[float] = None) -> List[str]:
     """Reap ephemeral hosts idle beyond the distro's acceptable idle time,
     never dipping below minimum hosts (reference

@@ -186,3 +186,35 @@ def test_credential_commands(tmp_path):
     assert ctx.expansions.get("github_token").startswith("ghs_")
     r = get_command("ec2.assume_role", {}).execute(ctx)
     assert r.failed
+
+
+def test_post_error_fails_task_flag(tmp_path, store):
+    from evergreen_tpu.agent.agent import Agent, AgentOptions
+    from evergreen_tpu.agent.comm import LocalCommunicator
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.globals import HostStatus, TaskStatus
+    from evergreen_tpu.models import host as hmod, task as tmod
+    from evergreen_tpu.models import task_queue as tqmod
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+
+    store.collection("parser_projects").upsert(
+        {"_id": "v1", "post_error_fails_task": True,
+         "post": [{"command": "shell.exec", "params": {"script": "exit 7"}}],
+         "tasks": {"t": {"commands": [
+             {"command": "shell.exec", "params": {"script": "true"}}]}}}
+    )
+    tmod.insert(store, Task(id="pt1", display_name="t", version="v1",
+                            distro_id="d1", status="undispatched",
+                            activated=True))
+    tqmod.save(store, TaskQueue(distro_id="d1",
+                                queue=[TaskQueueItem(id="pt1")]))
+    hmod.insert(store, Host(id="h1", distro_id="d1",
+                            status=HostStatus.RUNNING.value))
+    agent = Agent(LocalCommunicator(store, DispatcherService(store)),
+                  AgentOptions(host_id="h1", work_dir=str(tmp_path)))
+    assert agent.run_until_idle() == ["pt1"]
+    t = tmod.get(store, "pt1")
+    assert t.status == TaskStatus.FAILED.value
+    assert t.details_type == "setup"

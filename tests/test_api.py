@@ -273,3 +273,29 @@ def test_subscriptions_and_stats_endpoints(store, server):
         pass
     spans = comm._call("GET", "/rest/v2/stats/spans")
     assert any(s["name"] == "tick" for s in spans)
+
+
+def test_version_restart_and_abort(store, server):
+    base, _ = server
+    seed(store)
+    comm = RestCommunicator(base)
+    task_mod.insert_many(
+        store,
+        [
+            task_mod.Task(id="vt1", version="vv", status=TaskStatus.SUCCEEDED.value,
+                          activated=True, finish_time=time.time()),
+            task_mod.Task(id="vt2", version="vv", status=TaskStatus.STARTED.value,
+                          activated=True, start_time=time.time()),
+            task_mod.Task(id="vt3", version="vv",
+                          status=TaskStatus.UNDISPATCHED.value, activated=True),
+        ],
+    )
+    out = comm._call("POST", "/rest/v2/versions/vv/abort", {"user": "me"})
+    assert out["aborted"] == ["vt2"]
+    assert out["deactivated"] == ["vt3"]
+    assert task_mod.get(store, "vt2").aborted
+    assert not task_mod.get(store, "vt3").activated
+
+    out = comm._call("POST", "/rest/v2/versions/vv/restart", {"user": "me"})
+    assert out["restarted"] == ["vt1"]
+    assert task_mod.get(store, "vt1").status == TaskStatus.UNDISPATCHED.value

@@ -275,3 +275,20 @@ def test_downstream_project_trigger(store):
     downstream = version_mod.find(store, lambda d: d["project"] == "down")
     assert len(downstream) == 1
     assert downstream[0].requester == Requester.TRIGGER.value
+
+
+def test_stale_building_hosts_reaped(store):
+    MockCloudManager.reset()
+    distro_mod.insert(store, Distro(id="d1", provider=Provider.MOCK.value))
+    fresh = Host(id="fresh", distro_id="d1", provider=Provider.MOCK.value,
+                 status=HostStatus.STARTING.value, creation_time=NOW - 60,
+                 start_time=NOW - 60)
+    stale = Host(id="stale", distro_id="d1", provider=Provider.MOCK.value,
+                 status=HostStatus.PROVISIONING.value,
+                 creation_time=NOW - 3600, start_time=NOW - 3600)
+    host_mod.insert(store, fresh)
+    host_mod.insert(store, stale)
+    reaped = host_jobs.reap_stale_building_hosts(store, NOW)
+    assert reaped == ["stale"]
+    assert host_mod.get(store, "stale").status == HostStatus.TERMINATED.value
+    assert host_mod.get(store, "fresh").status == HostStatus.STARTING.value
