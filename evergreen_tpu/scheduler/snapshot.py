@@ -249,7 +249,10 @@ def build_snapshot(
     running_estimates: Dict[str, RunningTaskEstimate],
     deps_met: Dict[str, bool],
     now: float,
+    force_dims: Dict[str, int] = None,
 ) -> Snapshot:
+    """``force_dims`` overrides the computed bucket sizes (the sharded
+    solve pads every shard to common dims so the blocks stack)."""
     d_index = {d.id: i for i, d in enumerate(distros)}
     n_d = len(distros)
 
@@ -335,13 +338,19 @@ def build_snapshot(
     n_g = len(seg_names)
 
     # ---- padded arena allocation ------------------------------------------ #
-    N = _bucket(max(n_t, 1))
-    M = _bucket(max(n_m, 1))
-    U = _bucket(max(n_u, 1))
-    G = _bucket(max(n_g, 1))
-    H = _bucket(max(n_h, 1))
-    D = _bucket(max(n_d, 1), minimum=8)
-    dims = {"N": N, "M": M, "U": U, "G": G, "H": H, "D": D}
+    if force_dims is not None:
+        dims = dict(force_dims)
+    else:
+        dims = {
+            "N": _bucket(max(n_t, 1)),
+            "M": _bucket(max(n_m, 1)),
+            "U": _bucket(max(n_u, 1)),
+            "G": _bucket(max(n_g, 1)),
+            "H": _bucket(max(n_h, 1)),
+            "D": _bucket(max(n_d, 1), minimum=8),
+        }
+    N, M, U = dims["N"], dims["M"], dims["U"]
+    G, H, D = dims["G"], dims["H"], dims["D"]
 
     arena = arena_for_dims(dims)
 
