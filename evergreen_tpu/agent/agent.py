@@ -75,6 +75,42 @@ class Agent:
 
     # -- block execution ---------------------------------------------------- #
 
+    class _HeartbeatLoop:
+        """Background heartbeat while commands run (reference
+        agent/agent.go background heartbeat goroutine): without it a
+        single long command outlives the server's stale-heartbeat monitor
+        and gets reaped mid-run."""
+
+        def __init__(self, comm: Communicator, task_id: str,
+                     interval_s: float = 30.0) -> None:
+            import threading
+
+            self.comm = comm
+            self.task_id = task_id
+            self.interval_s = interval_s
+            self.abort_requested = False
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"heartbeat-{task_id[:16]}",
+            )
+
+        def _loop(self) -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    if self.comm.heartbeat(self.task_id):
+                        self.abort_requested = True
+                except Exception:
+                    pass  # transport hiccups; the next beat retries
+
+        def __enter__(self) -> "Agent._HeartbeatLoop":
+            self._thread.start()
+            return self
+
+        def __exit__(self, *exc) -> None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+
     def _run_task(self, cfg: TaskConfig) -> Tuple[str, str, str, bool, dict]:
         task = cfg.task
         task_dir = os.path.join(self.options.work_dir, task.id)
@@ -97,24 +133,27 @@ class Agent:
         details_desc = ""
         timed_out = False
 
-        # pre block: failures only fail the task when pre_error_fails_task
-        # (agent/agent.go runPreAndMain :752-938)
-        pre_failed, pre_desc = self._run_block(ctx, cfg.pre, "pre")
-        if pre_failed and cfg.pre_error_fails_task:
-            status = TaskStatus.FAILED.value
-            details_type = "setup"
-            details_desc = pre_desc
-
-        if status == TaskStatus.SUCCEEDED.value:
-            try:
-                main_failed, main_desc = self._run_block(ctx, cfg.commands, "task")
-            except subprocess.TimeoutExpired:
-                main_failed, main_desc, timed_out = True, "exec timeout", True
-                self._run_block(ctx, cfg.timeout_handler, "timeout")
-            if main_failed:
+        with self._HeartbeatLoop(self.comm, task.id) as beats:
+            # pre block: failures only fail the task when
+            # pre_error_fails_task (agent/agent.go runPreAndMain :752-938)
+            pre_failed, pre_desc = self._run_block(ctx, cfg.pre, "pre")
+            if pre_failed and cfg.pre_error_fails_task:
                 status = TaskStatus.FAILED.value
-                details_type = "test"
-                details_desc = main_desc
+                details_type = "setup"
+                details_desc = pre_desc
+
+            if status == TaskStatus.SUCCEEDED.value and not beats.abort_requested:
+                try:
+                    main_failed, main_desc = self._run_block(
+                        ctx, cfg.commands, "task"
+                    )
+                except subprocess.TimeoutExpired:
+                    main_failed, main_desc, timed_out = True, "exec timeout", True
+                    self._run_block(ctx, cfg.timeout_handler, "timeout")
+                if main_failed:
+                    status = TaskStatus.FAILED.value
+                    details_type = "test"
+                    details_desc = main_desc
 
         # post block always runs; its failures only change the task status
         # when post_error_fails_task is set (reference agent post handling)

@@ -103,7 +103,10 @@ def sharded_solve_fn(mesh, axis: str = "shard"):
         out = solve(local)
         return {k: v[None, ...] for k, v in out.items()}
 
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
 
     fn = shard_map(
         per_shard,
