@@ -104,17 +104,23 @@ def sharded_solve_fn(mesh, axis: str = "shard"):
         return {k: v[None, ...] for k, v in out.items()}
 
     try:
-        from jax import shard_map  # jax >= 0.8
+        from jax import shard_map  # jax >= 0.8 (check_rep retired)
+        fn = shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=({k: P(axis) for k in _IN_KEYS},),
+            out_specs={k: P(axis) for k in _OUT_KEYS},
+        )
     except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map
+        from jax.experimental.shard_map import shard_map as _sm
 
-    fn = shard_map(
-        per_shard,
-        mesh=mesh,
-        in_specs=({k: P(axis) for k in _IN_KEYS},),
-        out_specs={k: P(axis) for k in _OUT_KEYS},
-        check_rep=False,
-    )
+        fn = _sm(
+            per_shard,
+            mesh=mesh,
+            in_specs=({k: P(axis) for k in _IN_KEYS},),
+            out_specs={k: P(axis) for k in _OUT_KEYS},
+            check_rep=False,
+        )
     return jax.jit(fn)
 
 
