@@ -82,24 +82,30 @@ class Agent:
         and gets reaped mid-run."""
 
         def __init__(self, comm: Communicator, task_id: str,
-                     interval_s: float = 30.0) -> None:
+                     abort_event, interval_s: float = 30.0) -> None:
             import threading
 
             self.comm = comm
             self.task_id = task_id
             self.interval_s = interval_s
-            self.abort_requested = False
+            self.abort_event = abort_event
             self._stop = threading.Event()
             self._thread = threading.Thread(
                 target=self._loop, daemon=True,
                 name=f"heartbeat-{task_id[:16]}",
             )
 
+        @property
+        def abort_requested(self) -> bool:
+            return self.abort_event.is_set()
+
         def _loop(self) -> None:
             while not self._stop.wait(self.interval_s):
                 try:
                     if self.comm.heartbeat(self.task_id):
-                        self.abort_requested = True
+                        # flips the shared event: a running command's
+                        # process group is killed by run_process
+                        self.abort_event.set()
                 except Exception:
                     pass  # transport hiccups; the next beat retries
 
@@ -117,6 +123,9 @@ class Agent:
         os.makedirs(task_dir, exist_ok=True)
         log_lines: List[str] = []
 
+        import threading as _threading
+
+        abort_event = _threading.Event()
         ctx = CommandContext(
             work_dir=task_dir,
             expansions=Expansions(cfg.expansions),
@@ -126,6 +135,7 @@ class Agent:
             log=log_lines.append,
             exec_timeout_s=cfg.exec_timeout_s,
             idle_timeout_s=cfg.idle_timeout_s,
+            abort_event=abort_event,
         )
 
         status = TaskStatus.SUCCEEDED.value
@@ -133,7 +143,7 @@ class Agent:
         details_desc = ""
         timed_out = False
 
-        with self._HeartbeatLoop(self.comm, task.id) as beats:
+        with self._HeartbeatLoop(self.comm, task.id, abort_event) as beats:
             # pre block: failures only fail the task when
             # pre_error_fails_task (agent/agent.go runPreAndMain :752-938)
             pre_failed, pre_desc = self._run_block(ctx, cfg.pre, "pre")
@@ -143,6 +153,8 @@ class Agent:
                 details_desc = pre_desc
 
             if status == TaskStatus.SUCCEEDED.value and not beats.abort_requested:
+                from .command.basic import TaskAborted
+
                 try:
                     main_failed, main_desc = self._run_block(
                         ctx, cfg.commands, "task"
@@ -150,6 +162,8 @@ class Agent:
                 except subprocess.TimeoutExpired:
                     main_failed, main_desc, timed_out = True, "exec timeout", True
                     self._run_block(ctx, cfg.timeout_handler, "timeout")
+                except TaskAborted:
+                    main_failed, main_desc = True, "task aborted by request"
                 if main_failed:
                     status = TaskStatus.FAILED.value
                     details_type = "test"

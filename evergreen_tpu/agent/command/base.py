@@ -75,6 +75,9 @@ class CommandContext:
     task_name: str = ""
     project: str = ""
     log: Callable[[str], None] = lambda line: None
+    #: set by the agent's heartbeat loop when the server requests abort;
+    #: process-running commands must kill their subprocess and stop
+    abort_event: Any = None
     #: set by timeout.update / callbacks
     exec_timeout_s: float = 0.0
     idle_timeout_s: float = 0.0

@@ -9,7 +9,8 @@ from __future__ import annotations
 
 import os
 import subprocess
-from typing import Any, Dict
+import time as _time
+from typing import Any, Dict, List, Tuple
 
 from .base import (
     Command,
@@ -17,6 +18,51 @@ from .base import (
     CommandResult,
     register_command,
 )
+
+
+class TaskAborted(Exception):
+    """Raised when the server-requested abort kills a running command."""
+
+
+def run_process(
+    ctx: CommandContext, argv: List[str], cwd: str, env: Dict[str, str],
+) -> Tuple[int, str, str]:
+    """Run a command as an abortable subprocess: polls the context's abort
+    event and kills the process mid-run when set (reference agent abort
+    semantics — killProcs, agent/agent.go:1542); enforces the exec/idle
+    timeout. Returns (returncode, stdout, stderr)."""
+    timeout_s = ctx.exec_timeout_s or ctx.idle_timeout_s or 0.0
+    deadline = _time.monotonic() + timeout_s if timeout_s else None
+    proc = subprocess.Popen(
+        argv, cwd=cwd, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,  # own process group: kill takes the tree
+    )
+    while True:
+        try:
+            out, err = proc.communicate(timeout=0.5)
+            return proc.returncode, out or "", err or ""
+        except subprocess.TimeoutExpired:
+            if ctx.abort_event is not None and ctx.abort_event.is_set():
+                _kill_tree(proc)
+                proc.communicate()
+                raise TaskAborted("task aborted by request")
+            if deadline is not None and _time.monotonic() > deadline:
+                _kill_tree(proc)
+                proc.communicate()
+                raise subprocess.TimeoutExpired(argv, timeout_s)
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    import signal
+
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
 
 
 @register_command
@@ -36,30 +82,23 @@ class ShellExec(Command):
         continue_on_err = bool(params.get("continue_on_err", False))
 
         os.makedirs(working_dir, exist_ok=True)
-        proc = subprocess.run(
-            [shell, "-c", script],
-            cwd=working_dir,
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=ctx.exec_timeout_s or ctx.idle_timeout_s or None,
-        )
-        for line in (proc.stdout or "").splitlines():
+        code, out, err = run_process(ctx, [shell, "-c", script], working_dir, env)
+        for line in out.splitlines():
             ctx.log(line)
-        for line in (proc.stderr or "").splitlines():
+        for line in err.splitlines():
             ctx.log(f"[stderr] {line}")
-        if proc.returncode in (-9, 137):
+        if code in (-9, 137):
             # SIGKILL without our timeout firing is the classic OOM-kill
             # signature (reference agent OOM tracker, agent/agent.go:1150)
             ctx.artifacts["oom_killed"] = True
-        if proc.returncode != 0 and not continue_on_err:
+        if code != 0 and not continue_on_err:
             return CommandResult(
-                exit_code=proc.returncode,
+                exit_code=code,
                 failed=True,
-                error=f"shell script returned {proc.returncode}"
-                + (" (possible OOM kill)" if proc.returncode in (-9, 137) else ""),
+                error=f"shell script returned {code}"
+                + (" (possible OOM kill)" if code in (-9, 137) else ""),
             )
-        return CommandResult(exit_code=proc.returncode)
+        return CommandResult(exit_code=code)
 
 
 @register_command
@@ -77,26 +116,19 @@ class SubprocessExec(Command):
         env.update({k: str(v) for k, v in params.get("env", {}).items()})
         os.makedirs(working_dir, exist_ok=True)
         try:
-            proc = subprocess.run(
-                [binary, *args],
-                cwd=working_dir,
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=ctx.exec_timeout_s or None,
-            )
+            code, out, err = run_process(ctx, [binary, *args], working_dir, env)
         except FileNotFoundError:
             return CommandResult(exit_code=127, failed=True,
                                  error=f"binary not found: {binary}")
-        for line in (proc.stdout or "").splitlines():
+        for line in out.splitlines():
             ctx.log(line)
-        if proc.returncode != 0 and not params.get("continue_on_err", False):
+        if code != 0 and not params.get("continue_on_err", False):
             return CommandResult(
-                exit_code=proc.returncode,
+                exit_code=code,
                 failed=True,
-                error=f"process returned {proc.returncode}",
+                error=f"process returned {code}",
             )
-        return CommandResult(exit_code=proc.returncode)
+        return CommandResult(exit_code=code)
 
 
 @register_command

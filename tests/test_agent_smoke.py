@@ -311,3 +311,60 @@ def test_task_group_setup_and_teardown_blocks(store, tmp_path):
     # second (last) group task: no setup_group, teardown_group at the end
     assert not any("SETUP-GROUP" in line for line in logs2)
     assert any("TEARDOWN-GROUP" in line for line in logs2)
+
+
+def test_abort_kills_running_command(store, tmp_path):
+    """Aborting a task kills its in-flight process (reference killProcs
+    semantics) instead of waiting for the command to finish."""
+    import threading
+    import time as _t
+
+    from evergreen_tpu.units.task_jobs import abort_task
+
+    MockCloudManager.reset(instant_up=True)
+    distro_mod.insert(store, Distro(id="d1", provider=Provider.MOCK.value,
+                                    host_allocator_settings=HostAllocatorSettings(maximum_hosts=1)))
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        {"_id": "va", "tasks": {"slow": {"commands": [
+            {"command": "shell.exec", "params": {"script": "sleep 60"}}
+        ]}}}
+    )
+    now = time.time()
+    task_mod.insert(
+        store,
+        Task(id="slow1", display_name="slow", version="va", distro_id="d1",
+             status=TaskStatus.UNDISPATCHED.value, activated=True,
+             activated_time=now - 5, create_time=now - 10,
+             expected_duration_s=60),
+    )
+    run_tick(store, TickOptions(), now=now)
+    create_hosts_from_intents(store, now)
+    provision_ready_hosts(store, now)
+    hosts = host_mod.find(store, lambda d: d["status"] == HostStatus.RUNNING.value)
+
+    agent = Agent(
+        LocalCommunicator(store, DispatcherService(store)),
+        AgentOptions(host_id=hosts[0].id, work_dir=str(tmp_path)),
+    )
+    # speed the heartbeat loop way up for the test
+    orig = Agent._HeartbeatLoop.__init__
+
+    def fast_init(self, comm, task_id, abort_event, interval_s=30.0):
+        orig(self, comm, task_id, abort_event, interval_s=0.2)
+
+    Agent._HeartbeatLoop.__init__ = fast_init
+    try:
+        aborter = threading.Timer(
+            1.0, lambda: abort_task(store, "slow1", by="test")
+        )
+        aborter.start()
+        t0 = _t.time()
+        finished = agent.run_until_idle()
+        elapsed = _t.time() - t0
+    finally:
+        Agent._HeartbeatLoop.__init__ = orig
+    assert finished == ["slow1"]
+    assert elapsed < 30, f"abort should kill the 60s sleep, took {elapsed:.1f}s"
+    t = task_mod.get(store, "slow1")
+    assert t.status == TaskStatus.FAILED.value
+    assert "abort" in t.details_desc
