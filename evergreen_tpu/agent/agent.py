@@ -143,25 +143,37 @@ class Agent:
         details_desc = ""
         timed_out = False
 
+        from .command.basic import TaskAborted
+
         with self._HeartbeatLoop(self.comm, task.id, abort_event) as beats:
             # pre block: failures only fail the task when
-            # pre_error_fails_task (agent/agent.go runPreAndMain :752-938)
-            pre_failed, pre_desc = self._run_block(ctx, cfg.pre, "pre")
-            if pre_failed and cfg.pre_error_fails_task:
+            # pre_error_fails_task (agent/agent.go runPreAndMain :752-938);
+            # an abort during pre fails the task outright
+            try:
+                pre_failed, pre_desc = self._run_block(ctx, cfg.pre, "pre")
+            except TaskAborted:
+                pre_failed, pre_desc = True, "task aborted by request"
+                status = TaskStatus.FAILED.value
+                details_type = "test"
+                details_desc = pre_desc
+            if pre_failed and cfg.pre_error_fails_task and (
+                status == TaskStatus.SUCCEEDED.value
+            ):
                 status = TaskStatus.FAILED.value
                 details_type = "setup"
                 details_desc = pre_desc
 
             if status == TaskStatus.SUCCEEDED.value and not beats.abort_requested:
-                from .command.basic import TaskAborted
-
                 try:
                     main_failed, main_desc = self._run_block(
                         ctx, cfg.commands, "task"
                     )
                 except subprocess.TimeoutExpired:
                     main_failed, main_desc, timed_out = True, "exec timeout", True
-                    self._run_block(ctx, cfg.timeout_handler, "timeout")
+                    try:
+                        self._run_block(ctx, cfg.timeout_handler, "timeout")
+                    except (subprocess.TimeoutExpired, TaskAborted):
+                        pass
                 except TaskAborted:
                     main_failed, main_desc = True, "task aborted by request"
                 if main_failed:
@@ -169,9 +181,14 @@ class Agent:
                     details_type = "test"
                     details_desc = main_desc
 
-        # post block always runs; its failures only change the task status
-        # when post_error_fails_task is set (reference agent post handling)
-        post_failed, post_desc = self._run_block(ctx, cfg.post, "post")
+        # post/teardown must run even after an abort: clear the flag so the
+        # cleanup commands are not killed on their first poll (the reference
+        # gives teardown its own timeout rather than skipping it)
+        abort_event.clear()
+        try:
+            post_failed, post_desc = self._run_block(ctx, cfg.post, "post")
+        except (subprocess.TimeoutExpired, TaskAborted):
+            post_failed, post_desc = True, "post block interrupted"
         if (
             post_failed
             and cfg.post_error_fails_task

@@ -26,30 +26,42 @@ class TaskAborted(Exception):
 
 def run_process(
     ctx: CommandContext, argv: List[str], cwd: str, env: Dict[str, str],
+    timeout_s: float = 0.0,
 ) -> Tuple[int, str, str]:
     """Run a command as an abortable subprocess: polls the context's abort
     event and kills the process mid-run when set (reference agent abort
-    semantics — killProcs, agent/agent.go:1542); enforces the exec/idle
-    timeout. Returns (returncode, stdout, stderr)."""
-    timeout_s = ctx.exec_timeout_s or ctx.idle_timeout_s or 0.0
+    semantics — killProcs, agent/agent.go:1542); enforces ``timeout_s``
+    when nonzero. Killed commands' captured output is logged so the task
+    log shows what they printed. Returns (returncode, stdout, stderr)."""
     deadline = _time.monotonic() + timeout_s if timeout_s else None
     proc = subprocess.Popen(
         argv, cwd=cwd, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         start_new_session=True,  # own process group: kill takes the tree
     )
+
+    def _kill_and_log(reason: str) -> None:
+        _kill_tree(proc)
+        try:
+            out, err = proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        for line in (out or "").splitlines()[-50:]:
+            ctx.log(line)
+        for line in (err or "").splitlines()[-50:]:
+            ctx.log(f"[stderr] {line}")
+        ctx.log(f"[killed: {reason}]")
+
     while True:
         try:
             out, err = proc.communicate(timeout=0.5)
             return proc.returncode, out or "", err or ""
         except subprocess.TimeoutExpired:
             if ctx.abort_event is not None and ctx.abort_event.is_set():
-                _kill_tree(proc)
-                proc.communicate()
+                _kill_and_log("task aborted by request")
                 raise TaskAborted("task aborted by request")
             if deadline is not None and _time.monotonic() > deadline:
-                _kill_tree(proc)
-                proc.communicate()
+                _kill_and_log(f"exec timeout after {timeout_s:.0f}s")
                 raise subprocess.TimeoutExpired(argv, timeout_s)
 
 
@@ -82,7 +94,10 @@ class ShellExec(Command):
         continue_on_err = bool(params.get("continue_on_err", False))
 
         os.makedirs(working_dir, exist_ok=True)
-        code, out, err = run_process(ctx, [shell, "-c", script], working_dir, env)
+        code, out, err = run_process(
+            ctx, [shell, "-c", script], working_dir, env,
+            timeout_s=ctx.exec_timeout_s or ctx.idle_timeout_s or 0.0,
+        )
         for line in out.splitlines():
             ctx.log(line)
         for line in err.splitlines():
@@ -116,7 +131,10 @@ class SubprocessExec(Command):
         env.update({k: str(v) for k, v in params.get("env", {}).items()})
         os.makedirs(working_dir, exist_ok=True)
         try:
-            code, out, err = run_process(ctx, [binary, *args], working_dir, env)
+            code, out, err = run_process(
+                ctx, [binary, *args], working_dir, env,
+                timeout_s=ctx.exec_timeout_s,
+            )
         except FileNotFoundError:
             return CommandResult(exit_code=127, failed=True,
                                  error=f"binary not found: {binary}")

@@ -368,3 +368,54 @@ def test_abort_kills_running_command(store, tmp_path):
     t = task_mod.get(store, "slow1")
     assert t.status == TaskStatus.FAILED.value
     assert "abort" in t.details_desc
+
+
+def test_abort_still_runs_post_block(store, tmp_path):
+    """Teardown runs even when the main command was killed by abort."""
+    import threading
+
+    from evergreen_tpu.units.task_jobs import abort_task
+
+    MockCloudManager.reset(instant_up=True)
+    distro_mod.insert(store, Distro(id="d1", provider=Provider.MOCK.value,
+                                    host_allocator_settings=HostAllocatorSettings(maximum_hosts=1)))
+    store.collection(PARSER_PROJECTS_COLLECTION).upsert(
+        {"_id": "vp", "post": [{"command": "shell.exec",
+                                "params": {"script": "echo POST-RAN"}}],
+         "tasks": {"slow": {"commands": [
+             {"command": "shell.exec", "params": {"script": "sleep 60"}}
+         ]}}}
+    )
+    now = time.time()
+    task_mod.insert(
+        store,
+        Task(id="slow2", display_name="slow", version="vp", distro_id="d1",
+             status=TaskStatus.UNDISPATCHED.value, activated=True,
+             activated_time=now - 5, create_time=now - 10,
+             expected_duration_s=60),
+    )
+    run_tick(store, TickOptions(), now=now)
+    create_hosts_from_intents(store, now)
+    provision_ready_hosts(store, now)
+    hosts = host_mod.find(store, lambda d: d["status"] == HostStatus.RUNNING.value)
+    agent = Agent(
+        LocalCommunicator(store, DispatcherService(store)),
+        AgentOptions(host_id=hosts[0].id, work_dir=str(tmp_path)),
+    )
+    orig = Agent._HeartbeatLoop.__init__
+
+    def fast_init(self, comm, task_id, abort_event, interval_s=30.0):
+        orig(self, comm, task_id, abort_event, interval_s=0.2)
+
+    Agent._HeartbeatLoop.__init__ = fast_init
+    try:
+        threading.Timer(1.0, lambda: abort_task(store, "slow2", by="t")).start()
+        finished = agent.run_until_idle()
+    finally:
+        Agent._HeartbeatLoop.__init__ = orig
+    assert finished == ["slow2"]
+    t = task_mod.get(store, "slow2")
+    assert t.status == TaskStatus.FAILED.value
+    logs = store.collection("task_logs").get("slow2")["lines"]
+    assert any("POST-RAN" in line for line in logs)
+    assert any("killed: task aborted" in line for line in logs)
