@@ -171,7 +171,10 @@ class Agent:
                 except subprocess.TimeoutExpired:
                     main_failed, main_desc, timed_out = True, "exec timeout", True
                     try:
-                        self._run_block(ctx, cfg.timeout_handler, "timeout")
+                        self._run_block(
+                            ctx, cfg.timeout_handler, "timeout",
+                            ignore_abort=True,
+                        )
                     except (subprocess.TimeoutExpired, TaskAborted):
                         pass
                 except TaskAborted:
@@ -186,7 +189,9 @@ class Agent:
         # gives teardown its own timeout rather than skipping it)
         abort_event.clear()
         try:
-            post_failed, post_desc = self._run_block(ctx, cfg.post, "post")
+            post_failed, post_desc = self._run_block(
+                ctx, cfg.post, "post", ignore_abort=True
+            )
         except (subprocess.TimeoutExpired, TaskAborted):
             post_failed, post_desc = True, "post block interrupted"
         if (
@@ -215,16 +220,19 @@ class Agent:
         return status, details_type, details_desc, timed_out, ctx.artifacts
 
     def _run_block(
-        self, ctx: CommandContext, commands: List[dict], block: str
+        self, ctx: CommandContext, commands: List[dict], block: str,
+        ignore_abort: bool = False,
     ) -> Tuple[bool, str]:
-        """Run one command block; returns (failed, description)."""
+        """Run one command block; returns (failed, description).
+        ``ignore_abort``: teardown blocks run to completion even when the
+        task was aborted (reference teardown semantics)."""
         for i, spec in enumerate(commands):
             spec = dict(spec)
             name = spec.pop("command", "")
             params = spec.get("params", spec)
             display = spec.get("display_name", name)
             ctx.log(f"[{block}] running {display!r}")
-            if self.comm.heartbeat(ctx.task_id):
+            if self.comm.heartbeat(ctx.task_id) and not ignore_abort:
                 return True, "task aborted"
             try:
                 cmd = get_command(name, params)
