@@ -183,3 +183,50 @@ def test_disabled_distro_tops_up_minimum():
     d.disabled = True
     n, _ = run_both(d, [], [free_host(0)])
     assert n == 1
+
+
+def test_group_versions_units_ride_together():
+    """With group_versions, a version's tasks form one unit and export as a
+    contiguous block (reference ShouldGroupVersions path,
+    planner.go:437-446)."""
+    d = Distro(
+        id="d0", provider=Provider.MOCK.value,
+        planner_settings=PlannerSettings(group_versions=True),
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=50),
+    )
+    tasks = []
+    for v, prio in (("v-hot", 50), ("v-cold", 0)):
+        for i in range(3):
+            tasks.append(mk_task(f"{v}-{i}", 600, version=v, priority=prio if i == 0 else 0))
+    # interleave creation order so grouping must reorder
+    tasks = [tasks[0], tasks[3], tasks[1], tasks[4], tasks[2], tasks[5]]
+    for i, t in enumerate(tasks):
+        t.id = t.id  # ids already unique
+    _, plan = run_both(d, tasks, [])
+    order = [t.id for t in plan]
+    # v-hot unit (max priority 50) exports first, contiguously
+    assert order[:3] == [t.id for t in plan[:3]]
+    assert all(t.version == "v-hot" for t in plan[:3])
+    assert all(t.version == "v-cold" for t in plan[3:])
+
+
+def test_group_versions_dep_closure_merges_versions():
+    """A dependency across versions pulls the dependent into the parent
+    version's unit under group_versions (planner.go dep pass)."""
+    from evergreen_tpu.models.task import Dependency
+
+    d = Distro(
+        id="d0", provider=Provider.MOCK.value,
+        planner_settings=PlannerSettings(group_versions=True),
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=50),
+    )
+    a = mk_task("a", 600, version="v1", priority=80)
+    b = mk_task("b", 600, version="v2",
+                depends_on=[Dependency(task_id="ta")])
+    c = mk_task("c", 600, version="v2")
+    _, plan = run_both(d, [a, b, c], [])
+    order = [t.id for t in plan]
+    # b belongs to BOTH v2's unit and (via dep) v1's high-priority unit;
+    # it exports with whichever unit ranks higher — v1's
+    assert order.index("ta") < order.index("tc")
+    assert order.index("tb") < order.index("tc")
