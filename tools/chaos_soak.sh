@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Chaos soak: service + agents + revision pushes + random aborts/restarts.
+set -e
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$PYTHONPATH"
+PORT=${PORT:-19270}
+python -m evergreen_tpu service --port $PORT > /tmp/chaos_svc.log 2>&1 &
+SVC=$!
+trap "kill -9 $SVC 2>/dev/null; pkill -9 -f 'evergreen_tpu agent' 2>/dev/null || true" EXIT
+for i in $(seq 60); do curl -s localhost:$PORT/rest/v2/status >/dev/null 2>&1 && break; sleep 0.5; done
+
+python - <<PY
+import json, random, textwrap, threading, time, urllib.request
+base = "http://127.0.0.1:$PORT"
+def call(m, p, b=None):
+    req = urllib.request.Request(base+p, data=json.dumps(b).encode() if b is not None else None,
+        method=m, headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read() or b"{}")
+call("PUT", "/rest/v2/distros/chaos", {"provider": "mock",
+     "host_allocator_settings": {"maximum_hosts": 5}})
+call("PUT", "/rest/v2/projects/chaosproj", {})
+cfg = textwrap.dedent("""
+tasks:
+  - name: quick
+    commands: [{command: shell.exec, params: {script: "sleep 0.1 && echo q"}}]
+  - name: medium
+    depends_on: [{name: quick}]
+    commands: [{command: shell.exec, params: {script: "sleep 0.6 && echo m"}}]
+  - name: slow
+    commands: [{command: shell.exec, params: {script: "sleep 2 && echo s"}}]
+buildvariants:
+  - name: bv
+    run_on: [chaos]
+    tasks: [{name: quick}, {name: medium}, {name: slow}]
+""")
+rng = random.Random(7)
+for i in range(1, 7):
+    call("POST", "/rest/v2/projects/chaosproj/revisions",
+         {"revision": f"chaos{i:06d}xx", "config_yaml": cfg})
+    time.sleep(8)
+    # chaos: abort or restart a random known task
+    tasks = []
+    for j in range(1, i + 1):
+        vid = f"chaosproj_{j}_chaos" + f"{j:06d}"[:5]
+        try:
+            tasks += call("GET", f"/rest/v2/versions/{vid}/tasks")
+        except Exception:
+            pass
+    if tasks and rng.random() < 0.7:
+        t = rng.choice(tasks)
+        op = "abort" if t["status"] in ("started", "dispatched") else (
+            "restart" if t["status"] in ("success", "failed") else None)
+        if op:
+            try:
+                call("POST", f"/rest/v2/tasks/{t['_id']}/{op}", {"user": "chaos"})
+                print("chaos:", op, t["_id"], flush=True)
+            except Exception as e:
+                print("chaos op failed:", e, flush=True)
+print("pushes done", flush=True)
+PY
+
+# attach agents as hosts come up (up to 4)
+STARTED=""
+for i in $(seq 30); do
+  for H in $(curl -s localhost:$PORT/rest/v2/hosts | python -c "import json,sys; print(' '.join(h['_id'] for h in json.load(sys.stdin) if h['status']=='running'))" 2>/dev/null); do
+    case "$STARTED" in *"$H"*) ;; *)
+      python -m evergreen_tpu agent --host-id "$H" --api-server http://127.0.0.1:$PORT > /tmp/chaos_agent_$H.log 2>&1 &
+      STARTED="$STARTED $H";;
+    esac
+  done
+  sleep 4
+done &
+ATTACHER=$!
+
+sleep 150
+kill $ATTACHER 2>/dev/null || true
+
+python - <<PY
+import collections, json, urllib.request
+base = "http://127.0.0.1:$PORT"
+def get(p):
+    return json.load(urllib.request.urlopen(base+p, timeout=30))
+print("status:", get("/rest/v2/status"))
+counts = collections.Counter()
+for v in get("/rest/v2/versions?limit=50"):
+    if v["project"] == "chaosproj":
+        counts[v["status"]] += 1
+print("version outcomes:", dict(counts))
+tstat = collections.Counter(t["status"] for v in get("/rest/v2/versions?limit=50")
+                            if v["project"]=="chaosproj"
+                            for t in get(f"/rest/v2/versions/{v['_id']}/tasks"))
+print("task statuses:", dict(tstat))
+failed_jobs = [e for e in get("/rest/v2/events") if e["event_type"] == "JOB_FAILED"]
+print("failed background jobs:", len(failed_jobs))
+for e in failed_jobs[:3]:
+    print("  ", e["data"].get("type"), (e["data"].get("error") or "")[-160:])
+PY
