@@ -255,6 +255,14 @@ class RestApi:
         r("GET", r"/rest/v2/patches/(?P<patch>[^/]+)", self.get_patch)
         r("POST", r"/rest/v2/patches/(?P<patch>[^/]+)/finalize", self.finalize)
 
+        # task output + annotations (reference rest/route/annotations.go,
+        # artifact_sign.go, test results routes)
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/tests", self.task_tests)
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/artifacts", self.task_artifacts)
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/annotations", self.get_annotations)
+        r("PUT", r"/rest/v2/tasks/(?P<task>[^/]+)/annotation", self.put_annotation)
+        r("POST", r"/rest/v2/artifacts/sign", self.sign_artifact)
+
         # graphql (reference graphql/http_handler.go)
         r("POST", r"/graphql", self.graphql)
 
@@ -656,6 +664,82 @@ class RestApi:
             section.set(self.store)
             updated.append(sid)
         return 200, {"updated": updated}
+
+    def task_tests(self, method, match, body):
+        from ..models.artifact import get_test_results
+
+        import dataclasses as _dc
+
+        return 200, [
+            _dc.asdict(r)
+            for r in get_test_results(
+                self.store, match["task"], int(body.get("execution", 0) or 0)
+            )
+        ]
+
+    def task_artifacts(self, method, match, body):
+        import dataclasses as _dc
+
+        from ..models.artifact import get_artifacts
+
+        return 200, [
+            _dc.asdict(f)
+            for f in get_artifacts(
+                self.store, match["task"], int(body.get("execution", 0) or 0)
+            )
+        ]
+
+    def get_annotations(self, method, match, body):
+        import dataclasses as _dc
+
+        from ..models.annotations import get_annotation
+
+        ann = get_annotation(
+            self.store, match["task"], int(body.get("execution", 0) or 0)
+        )
+        return 200, _dc.asdict(ann) if ann else {}
+
+    def put_annotation(self, method, match, body):
+        from ..models.annotations import (
+            Annotation,
+            IssueLink,
+            get_annotation,
+            upsert_annotation,
+        )
+
+        execution = int(body.get("execution", 0) or 0)
+        ann = get_annotation(self.store, match["task"], execution) or Annotation(
+            task_id=match["task"], execution=execution
+        )
+        if "note" in body:
+            ann.note = str(body["note"])
+        for issue in body.get("issues", []):
+            ann.issues.append(
+                IssueLink(
+                    url=issue.get("url", ""),
+                    issue_key=issue.get("issue_key", ""),
+                    source="api",
+                    added_by=body.get("user", "api"),
+                )
+            )
+        for issue in body.get("suspected_issues", []):
+            ann.suspected_issues.append(
+                IssueLink(url=issue.get("url", ""), source="api",
+                          added_by=body.get("user", "api"))
+            )
+        upsert_annotation(self.store, ann)
+        import dataclasses as _dc
+
+        return 200, _dc.asdict(ann)
+
+    def sign_artifact(self, method, match, body):
+        from ..models.artifact import sign_url
+
+        link = body.get("link", "")
+        if not link:
+            raise ApiError(400, "link is required")
+        expires_at = float(body.get("expires_at") or (_time.time() + 3600))
+        return 200, {"url": sign_url(link, expires_at)}
 
     def graphql(self, method, match, body):
         from .graphql import GraphQLApi

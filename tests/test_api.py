@@ -299,3 +299,42 @@ def test_version_restart_and_abort(store, server):
     out = comm._call("POST", "/rest/v2/versions/vv/restart", {"user": "me"})
     assert out["restarted"] == ["vt1"]
     assert task_mod.get(store, "vt1").status == TaskStatus.UNDISPATCHED.value
+
+
+def test_task_output_and_annotation_routes(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    from evergreen_tpu.models.artifact import (
+        ArtifactFile,
+        TestResult,
+        attach_artifacts,
+        attach_test_results,
+        verify_signed_url,
+    )
+
+    task_mod.insert(store, task_mod.Task(id="t1", activated=True))
+    attach_test_results(
+        store, "t1", 0, [TestResult(test_name="a", status="pass")]
+    )
+    attach_artifacts(
+        store, "t1", 0, [ArtifactFile(name="log", link="bucket/x.log")]
+    )
+    assert comm._call("GET", "/rest/v2/tasks/t1/tests")[0]["test_name"] == "a"
+    assert comm._call("GET", "/rest/v2/tasks/t1/artifacts")[0]["name"] == "log"
+
+    out = comm._call(
+        "PUT", "/rest/v2/tasks/t1/annotation",
+        {"note": "flaky on arm", "issues": [{"url": "http://jira/X-1"}],
+         "user": "dev"},
+    )
+    assert out["note"] == "flaky on arm"
+    got = comm._call("GET", "/rest/v2/tasks/t1/annotations")
+    assert got["issues"][0]["url"] == "http://jira/X-1"
+
+    signed = comm._call(
+        "POST", "/rest/v2/artifacts/sign",
+        {"link": "bucket/x.log", "expires_at": time.time() + 60},
+    )
+    assert verify_signed_url(signed["url"])
+    out = comm._call("POST", "/rest/v2/artifacts/sign", {})
+    assert out.get("_status") == 400
