@@ -310,14 +310,15 @@ def build_snapshot(
     for di in range(n_d):
         seg_for(di, "")
 
-    t_seg = [
-        seg_for(
-            t_distro[i],
-            group_keys[i],
-            t.task_group_max_hosts if group_keys[i] else 0,
-        )
-        for i, t in enumerate(flat_tasks)
-    ]
+    # ungrouped tasks (the majority) map to their distro's "" segment,
+    # which by construction IS segment index di — no lookup needed
+    t_seg: List[int] = [0] * n_t
+    for i, t in enumerate(flat_tasks):
+        key = group_keys[i]
+        if key:
+            t_seg[i] = seg_for(t_distro[i], key, t.task_group_max_hosts)
+        else:
+            t_seg[i] = t_distro[i]
 
     # ---- hosts ------------------------------------------------------------ #
     flat_hosts: List[Host] = []
