@@ -57,6 +57,7 @@ class RestCommunicator(Communicator):
 
     def next_task(self, host_id: str) -> Optional[Task]:
         resp = self._call("GET", f"/rest/v2/hosts/{host_id}/agent/next_task")
+        self.should_exit = bool(resp.get("should_exit"))
         tid = resp.get("task_id")
         if not tid:
             return None

@@ -208,6 +208,9 @@ class GraphQLApi:
             "projects": self._q_projects,
             "taskLogs": self._q_task_logs,
             "taskTests": self._q_task_tests,
+            "buildVariants": self._q_build_variants,
+            "displayTasks": self._q_display_tasks,
+            "patches": self._q_patches,
         }
         self.mutations: Dict[str, Callable] = {
             "scheduleTask": self._m_schedule,
@@ -334,6 +337,33 @@ class GraphQLApi:
              "durationS": r.duration_s, "logUrl": r.log_url}
             for r in get_test_results(self.store, taskId, execution)
         ]
+
+    def _q_build_variants(self, versionId: str):
+        """Per-variant task rollups for a version (the Spruce waterfall
+        row shape)."""
+        variants = {}
+        for t in task_mod.find(
+            self.store, lambda d: d["version"] == versionId
+        ):
+            v = variants.setdefault(
+                t.build_variant, {"variant": t.build_variant, "tasks": []}
+            )
+            v["tasks"].append(
+                {"id": t.id, "displayName": t.display_name, "status": t.status}
+            )
+        return list(variants.values())
+
+    def _q_display_tasks(self, buildId: str):
+        return self.store.collection("display_tasks").find(
+            lambda d: d["build_id"] == buildId
+        )
+
+    def _q_patches(self, project: str = "", limit: int = 20):
+        docs = self.store.collection("patches").find(
+            (lambda d: d["project"] == project) if project else None
+        )
+        docs.sort(key=lambda d: d.get("create_time", 0.0), reverse=True)
+        return docs[: int(limit)]
 
     # -- mutation resolvers --------------------------------------------------- #
 

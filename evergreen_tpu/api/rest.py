@@ -286,8 +286,16 @@ class RestApi:
         if h is None:
             raise ApiError(404, f"host {match['host']!r} not found")
         t = assign_next_available_task(self.store, self.svc, h)
+        # single-task distros run exactly one task per host, then the agent
+        # exits and the host is recycled (reference units/host_allocator.go
+        # :174-181 + agent single-task-distro exit)
+        d = distro_mod.get(self.store, h.distro_id)
+        single = bool(d and d.single_task_distro)
         if t is None:
-            return 200, {"task_id": "", "should_exit": False}
+            return 200, {
+                "task_id": "",
+                "should_exit": single and h.task_count > 0,
+            }
         return 200, {
             "task_id": t.id,
             "task_execution": t.execution,

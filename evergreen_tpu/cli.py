@@ -60,6 +60,9 @@ def cmd_agent(args) -> int:
             print(f"completed task {tid}")
             idle_sleep = agent.options.min_poll_interval_s
         else:
+            if getattr(comm, "should_exit", False):
+                print("single-task distro: exiting after completed task")
+                return 0
             if args.once:
                 return 0
             _time.sleep(idle_sleep)

@@ -361,6 +361,19 @@ def run_tick(
     # SURVEY §5 tracing; sink is the store's spans collection)
     from ..utils.tracing import Tracer
 
+    # time-to-empty estimate per tick (the reference's allocator telemetry,
+    # units/host_allocator.go:295-334): queued work over usable capacity
+    tte = {}
+    for d in distros:
+        info = infos.get(d.id)
+        if info is None or d.id.endswith(ALIAS_SUFFIX):
+            continue
+        capacity = max(
+            len(hosts_by_distro.get(d.id, [])) + new_hosts.get(d.id, 0), 1
+        )
+        tte[d.id] = round(info.expected_duration_s / capacity, 1)
+    worst = max(tte.items(), key=lambda kv: kv[1]) if tte else ("", 0.0)
+
     with Tracer(store, "scheduler").span(
         "tick",
         n_tasks=n_tasks,
@@ -369,6 +382,8 @@ def run_tick(
         solve_ms=round(solve_ms, 2),
         total_ms=round(total_ms, 2),
         planner=opts.planner_version,
+        worst_time_to_empty_s=worst[1],
+        worst_time_to_empty_distro=worst[0],
     ):
         pass
     return TickResult(
