@@ -72,8 +72,33 @@ for i in $(seq 30); do
 done &
 ATTACHER=$!
 
-sleep 150
+sleep 120
 kill $ATTACHER 2>/dev/null || true
+
+# chaos phase 2: restart finished tasks + abort anything running, then let
+# the system re-converge (agents are still polling)
+python - <<PY
+import json, random, urllib.request
+base = "http://127.0.0.1:$PORT"
+def call(m, p, b=None):
+    req = urllib.request.Request(base+p, data=json.dumps(b).encode() if b is not None else None,
+        method=m, headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read() or b"{}")
+rng = random.Random(3)
+tasks = []
+for v in call("GET", "/rest/v2/versions?limit=50"):
+    if v["project"] == "chaosproj":
+        tasks += call("GET", f"/rest/v2/versions/{v['_id']}/tasks")
+done = [t for t in tasks if t["status"] in ("success", "failed")]
+for t in rng.sample(done, min(3, len(done))):
+    call("POST", f"/rest/v2/tasks/{t['_id']}/restart", {"user": "chaos"})
+    print("chaos: restart", t["_id"], flush=True)
+running = [t for t in tasks if t["status"] in ("started", "dispatched")]
+for t in running[:2]:
+    call("POST", f"/rest/v2/tasks/{t['_id']}/abort", {"user": "chaos"})
+    print("chaos: abort", t["_id"], flush=True)
+PY
+sleep 100
 
 python - <<PY
 import collections, json, urllib.request
