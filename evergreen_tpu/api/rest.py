@@ -257,6 +257,8 @@ class RestApi:
 
         # task output + annotations (reference rest/route/annotations.go,
         # artifact_sign.go, test results routes)
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/queue_position",
+          self.queue_position)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/tests", self.task_tests)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/artifacts", self.task_artifacts)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/annotations", self.get_annotations)
@@ -672,6 +674,41 @@ class RestApi:
             section.set(self.store)
             updated.append(sid)
         return 200, {"updated": updated}
+
+    def queue_position(self, method, match, body):
+        """Where a task sits in its distro's planned queue + a rough wait
+        estimate (reference task queue position surface)."""
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        from ..models import task_queue as tq_mod
+
+        doc = tq_mod.coll(self.store).get(t.distro_id)
+        if doc is None:
+            return 200, {"position": -1, "queue_length": 0}
+        ids = doc["cols"]["id"] if doc.get("cols") else [
+            i["id"] for i in doc.get("queue", [])
+        ]
+        durs = doc["cols"]["expected_duration_s"] if doc.get("cols") else [
+            i["expected_duration_s"] for i in doc.get("queue", [])
+        ]
+        try:
+            pos = ids.index(t.id)
+        except ValueError:
+            return 200, {"position": -1, "queue_length": len(ids)}
+        hosts = max(
+            1,
+            host_mod.coll(self.store).count(
+                lambda d: d["distro_id"] == t.distro_id
+                and d["status"] == "running" and d["started_by"] == "mci"
+            ),
+        )
+        est_wait = sum(durs[:pos]) / hosts
+        return 200, {
+            "position": pos,
+            "queue_length": len(ids),
+            "estimated_wait_s": round(est_wait, 1),
+        }
 
     def task_tests(self, method, match, body):
         from ..models.artifact import get_test_results

@@ -166,6 +166,27 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_user(args) -> int:
+    """Create users / grant roles (the auth bootstrap; reference admin
+    user management)."""
+    from .models import user as user_mod
+    from .storage.store import global_store
+
+    store = global_store()
+    if args.action == "create":
+        u = user_mod.create_user(
+            store, args.user_id, roles=args.roles.split(",") if args.roles else []
+        )
+        print(json.dumps({"user": u.id, "api_key": u.api_key,
+                          "roles": u.roles}, indent=2))
+    elif args.action == "grant":
+        if not user_mod.grant_role(store, args.user_id, args.roles):
+            print("no such user", file=sys.stderr)
+            return 1
+        print("granted")
+    return 0
+
+
 def cmd_smoke(args) -> int:
     """Boot everything in one process and drive a sample project to green
     (reference smoke harness, smoke/internal/)."""
@@ -243,6 +264,12 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="service status")
     st.add_argument("--api-server", default="http://127.0.0.1:9090")
     st.set_defaults(fn=cmd_status)
+
+    us = sub.add_parser("user", help="create users / grant roles")
+    us.add_argument("action", choices=["create", "grant"])
+    us.add_argument("user_id")
+    us.add_argument("--roles", default="", help="comma-separated (create) or one role (grant)")
+    us.set_defaults(fn=cmd_user)
 
     sm = sub.add_parser("smoke", help="one-process end-to-end smoke demo")
     sm.add_argument("--port", type=int, default=0)

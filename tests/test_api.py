@@ -338,3 +338,33 @@ def test_task_output_and_annotation_routes(store, server):
     assert verify_signed_url(signed["url"])
     out = comm._call("POST", "/rest/v2/artifacts/sign", {})
     assert out.get("_status") == 400
+
+
+def test_queue_position_endpoint(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.host import Host
+
+    task_mod.insert_many(
+        store,
+        [task_mod.Task(id=f"q{i}", distro_id="dq", activated=True)
+         for i in range(3)],
+    )
+    tq_mod.save(
+        store,
+        TaskQueue(distro_id="dq", queue=[
+            TaskQueueItem(id=f"q{i}", expected_duration_s=600.0)
+            for i in range(3)
+        ]),
+    )
+    host_mod.insert(
+        store, Host(id="hq", distro_id="dq", status=HostStatus.RUNNING.value)
+    )
+    out = comm._call("GET", "/rest/v2/tasks/q2/queue_position")
+    assert out["position"] == 2
+    assert out["queue_length"] == 3
+    assert out["estimated_wait_s"] == 1200.0
+    out = comm._call("GET", "/rest/v2/tasks/missing/queue_position")
+    assert out.get("_status") == 404
