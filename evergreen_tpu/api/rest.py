@@ -220,6 +220,7 @@ class RestApi:
         r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/logs", self.append_logs)
 
         # tasks
+        r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/executions", self.task_executions)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)", self.get_task)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/logs", self.get_logs)
         r("PATCH", r"/rest/v2/tasks/(?P<task>[^/]+)", self.patch_task)
@@ -386,6 +387,23 @@ class RestApi:
         if t is None:
             raise ApiError(404, "task not found")
         return 200, t.to_doc()
+
+    def task_executions(self, method, match, body):
+        """Archived past executions plus the live one (reference
+        Task.Execution archive semantics)."""
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        archive = task_jobs.get_task_execution_archive(self.store, match["task"])
+        current = {
+            "execution": t.execution,
+            "status": t.status,
+            "start_time": t.start_time,
+            "finish_time": t.finish_time,
+            "host_id": t.host_id,
+            "current": True,
+        }
+        return 200, archive + [current]
 
     def get_logs(self, method, match, body):
         doc = self.store.collection("task_logs").get(match["task"])

@@ -368,3 +368,20 @@ def test_queue_position_endpoint(store, server):
     assert out["estimated_wait_s"] == 1200.0
     out = comm._call("GET", "/rest/v2/tasks/missing/queue_position")
     assert out.get("_status") == 404
+
+
+def test_task_executions_archive(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    from evergreen_tpu.units.task_jobs import restart_task
+
+    task_mod.insert(
+        store,
+        task_mod.Task(id="tx1", status=TaskStatus.FAILED.value, activated=True,
+                      start_time=time.time() - 100, finish_time=time.time()),
+    )
+    restart_task(store, "tx1", by="me")
+    out = comm._call("GET", "/rest/v2/tasks/tx1/executions")
+    assert len(out) == 2
+    assert out[0]["execution"] == 0 and out[0]["status"] == TaskStatus.FAILED.value
+    assert out[1]["current"] and out[1]["execution"] == 1
