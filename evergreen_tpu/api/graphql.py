@@ -368,13 +368,9 @@ class GraphQLApi:
     # -- mutation resolvers --------------------------------------------------- #
 
     def _m_schedule(self, taskId: str):
-        import time as _time
+        from ..models.lifecycle import activate_task_with_dependencies
 
-        task_mod.coll(self.store).update(
-            taskId,
-            {"activated": True, "activated_by": "graphql",
-             "activated_time": _time.time()},
-        )
+        activate_task_with_dependencies(self.store, taskId, "graphql")
         return self._task_doc(taskId)
 
     def _m_unschedule(self, taskId: str):

@@ -411,18 +411,29 @@ class RestApi:
 
     def patch_task(self, method, match, body):
         update = {}
+        acted = False
         if "priority" in body:
             update["priority"] = int(body["priority"])
         if "activated" in body:
-            update["activated"] = bool(body["activated"])
-            if update["activated"]:
-                update["activated_time"] = _time.time()
-                update["activated_by"] = body.get("user", "api")
-        if not update:
+            if bool(body["activated"]):
+                from ..models.lifecycle import activate_task_with_dependencies
+
+                activate_task_with_dependencies(
+                    self.store, match["task"], body.get("user", "api")
+                )
+                acted = True
+            else:
+                update["activated"] = False
+        if not update and not acted:
             raise ApiError(400, "nothing to update")
-        if not task_mod.coll(self.store).update(match["task"], update):
+        if update and not task_mod.coll(self.store).update(
+            match["task"], update
+        ):
             raise ApiError(404, "task not found")
-        return 200, task_mod.get(self.store, match["task"]).to_doc()
+        t = task_mod.get(self.store, match["task"])
+        if t is None:
+            raise ApiError(404, "task not found")
+        return 200, t.to_doc()
 
     def abort_task(self, method, match, body):
         ok = task_jobs.abort_task(self.store, match["task"], body.get("user", "api"))

@@ -203,6 +203,45 @@ def block_single_host_task_group(store: Store, t: Task, now: float) -> List[str]
     return blocked
 
 
+def activate_task_with_dependencies(
+    store: Store, task_id: str, by: str, now: Optional[float] = None
+) -> List[str]:
+    """Activate a task AND its unfinished dependency closure (reference
+    model.SetActiveState / task.ActivateDeactivatedDependencies —
+    scheduling a task implies scheduling everything it needs).
+    Returns every task id activated."""
+    now = _time.time() if now is None else now
+    c = task_mod.coll(store)
+    activated: List[str] = []
+    stack = [task_id]
+    seen: set = set()
+    while stack:
+        tid = stack.pop()
+        if tid in seen:
+            continue
+        seen.add(tid)
+        doc = c.get(tid)
+        if doc is None:
+            continue
+        if doc["status"] == TaskStatus.UNDISPATCHED.value and not doc["activated"]:
+            c.update(
+                tid,
+                {"activated": True, "activated_by": by, "activated_time": now},
+            )
+            activated.append(tid)
+        stack.extend(d["task_id"] for d in doc.get("depends_on", []))
+    if activated:
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_TASK,
+            "TASKS_ACTIVATED",
+            task_id,
+            {"by": by, "count": len(activated)},
+            timestamp=now,
+        )
+    return activated
+
+
 def evaluate_stepback(store: Store, t: Task, now: float) -> Optional[str]:
     """Stepback: when a mainline task fails, activate the same task at an
     earlier commit to locate the offending revision — the previous commit

@@ -385,3 +385,28 @@ def test_task_executions_archive(store, server):
     assert len(out) == 2
     assert out[0]["execution"] == 0 and out[0]["status"] == TaskStatus.FAILED.value
     assert out[1]["current"] and out[1]["execution"] == 1
+
+
+def test_activation_cascades_to_dependencies(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    from evergreen_tpu.models.task import Dependency
+
+    task_mod.insert_many(
+        store,
+        [
+            task_mod.Task(id="root-dep", activated=False,
+                          status=TaskStatus.UNDISPATCHED.value),
+            task_mod.Task(id="mid-dep", activated=False,
+                          status=TaskStatus.UNDISPATCHED.value,
+                          depends_on=[Dependency(task_id="root-dep")]),
+            task_mod.Task(id="leaf", activated=False,
+                          status=TaskStatus.UNDISPATCHED.value,
+                          depends_on=[Dependency(task_id="mid-dep")]),
+        ],
+    )
+    out = comm._call("PATCH", "/rest/v2/tasks/leaf", {"activated": True})
+    assert out["activated"] is True
+    # the whole chain woke up
+    assert task_mod.get(store, "mid-dep").activated
+    assert task_mod.get(store, "root-dep").activated
