@@ -274,6 +274,16 @@ class RestApi:
         r("POST", r"/rest/v2/admin/settings", self.set_admin)
         r("GET", r"/rest/v2/status", self.status)
         r("GET", r"/rest/v2/events", self.list_events)
+        r(
+            "GET",
+            r"/rest/v2/resources/(?P<resource>[^/]+)/events",
+            self.resource_events,
+        )
+        r(
+            "GET",
+            r"/rest/v2/projects/(?P<project>[^/]+)/waterfall",
+            self.waterfall,
+        )
         r("POST", r"/rest/v2/subscriptions", self.create_subscription)
         r("GET", r"/rest/v2/subscriptions", self.list_subscriptions)
         r("GET", r"/rest/v2/stats/spans", self.list_spans)
@@ -838,6 +848,53 @@ class RestApi:
         evs = self.store.collection("events").find()
         evs.sort(key=lambda d: d["timestamp"])
         return 200, evs[-200:]
+
+    def resource_events(self, method, match, body):
+        """Event timeline for one resource (task/host/version/…) — the
+        reference's event-log finders surfaced per entity."""
+        import dataclasses as _dc
+
+        return 200, [
+            _dc.asdict(e)
+            for e in event_mod.find_by_resource(self.store, match["resource"])
+        ]
+
+    def waterfall(self, method, match, body):
+        """Versions × variants grid for a project (the Spruce waterfall's
+        data shape)."""
+        versions = version_mod.find(
+            self.store, lambda d: d["project"] == match["project"]
+        )
+        versions.sort(key=lambda v: v.revision_order_number, reverse=True)
+        out = []
+        for v in versions[: int(body.get("limit", 10) or 10)]:
+            variants = {}
+            for t in task_mod.find(
+                self.store, lambda d: d["version"] == v.id
+            ):
+                cell = variants.setdefault(
+                    t.build_variant, {"total": 0, "success": 0, "failed": 0,
+                                      "in_progress": 0}
+                )
+                cell["total"] += 1
+                if t.status == TaskStatus.SUCCEEDED.value:
+                    cell["success"] += 1
+                elif t.status == TaskStatus.FAILED.value:
+                    cell["failed"] += 1
+                elif t.status in (TaskStatus.STARTED.value,
+                                  TaskStatus.DISPATCHED.value):
+                    cell["in_progress"] += 1
+            out.append(
+                {
+                    "version_id": v.id,
+                    "revision": v.revision,
+                    "message": v.message,
+                    "order": v.revision_order_number,
+                    "status": v.status,
+                    "variants": variants,
+                }
+            )
+        return 200, out
 
     def create_subscription(self, method, match, body):
         """Notification subscriptions (reference rest/route subscriptions)."""

@@ -410,3 +410,37 @@ def test_activation_cascades_to_dependencies(store, server):
     # the whole chain woke up
     assert task_mod.get(store, "mid-dep").activated
     assert task_mod.get(store, "root-dep").activated
+
+
+def test_waterfall_and_resource_events(store, server):
+    base, _ = server
+    comm = RestCommunicator(base)
+    from evergreen_tpu.models import version as vmod
+    from evergreen_tpu.models.version import Version
+    from evergreen_tpu.models import event as emod
+
+    vmod.insert(store, Version(id="wv1", project="wp", revision="r1",
+                               revision_order_number=1, status="failed"))
+    vmod.insert(store, Version(id="wv2", project="wp", revision="r2",
+                               revision_order_number=2, status="started"))
+    task_mod.insert_many(
+        store,
+        [
+            task_mod.Task(id="w1", version="wv2", build_variant="lin",
+                          status=TaskStatus.SUCCEEDED.value),
+            task_mod.Task(id="w2", version="wv2", build_variant="lin",
+                          status=TaskStatus.STARTED.value),
+            task_mod.Task(id="w3", version="wv1", build_variant="mac",
+                          status=TaskStatus.FAILED.value),
+        ],
+    )
+    grid = comm._call("GET", "/rest/v2/projects/wp/waterfall")
+    assert [g["version_id"] for g in grid] == ["wv2", "wv1"]
+    assert grid[0]["variants"]["lin"] == {"total": 2, "success": 1,
+                                          "failed": 0, "in_progress": 1}
+    assert grid[1]["variants"]["mac"]["failed"] == 1
+
+    emod.log(store, emod.RESOURCE_TASK, "TASK_STARTED", "w1")
+    emod.log(store, emod.RESOURCE_TASK, "TASK_FINISHED", "w1")
+    events = comm._call("GET", "/rest/v2/resources/w1/events")
+    assert [e["event_type"] for e in events] == ["TASK_STARTED", "TASK_FINISHED"]
