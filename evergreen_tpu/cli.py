@@ -22,6 +22,10 @@ def cmd_service(args) -> int:
     from .units.crons import build_cron_runner
 
     store = global_store()
+    from .storage.migrations import apply_migrations
+
+    for name, result in apply_migrations(store):
+        print(f"migration {name}: {result}")
     api = RestApi(
         store,
         require_auth=args.require_auth,

@@ -1,0 +1,83 @@
+"""Data migrations: ordered, recorded, idempotent.
+
+The reference runs DB migrations through anser (go.mod mongodb/anser).
+Same contract here: migrations register with a monotonically-ordered name,
+apply exactly once per store (recorded in the ``migrations`` collection),
+and run at service startup before the job plane starts.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, List, Tuple
+
+from .store import Store
+
+COLLECTION = "migrations"
+
+_REGISTRY: Dict[str, Callable[[Store], None]] = {}
+
+
+def register_migration(name: str):
+    """Decorator: names must sort in application order (e.g.
+    ``0001-add-field``)."""
+
+    def wrap(fn: Callable[[Store], None]):
+        if name in _REGISTRY:
+            raise KeyError(f"duplicate migration {name!r}")
+        _REGISTRY[name] = fn
+        return fn
+
+    return wrap
+
+
+def pending_migrations(store: Store) -> List[str]:
+    applied = {d["_id"] for d in store.collection(COLLECTION).find()}
+    return [n for n in sorted(_REGISTRY) if n not in applied]
+
+
+def apply_migrations(store: Store) -> List[Tuple[str, str]]:
+    """Run every unapplied migration in order; returns
+    [(name, "applied"|"failed: …")]. A failure stops the chain (later
+    migrations may depend on earlier ones)."""
+    out: List[Tuple[str, str]] = []
+    coll = store.collection(COLLECTION)
+    for name in pending_migrations(store):
+        try:
+            _REGISTRY[name](store)
+        except Exception as e:  # record and halt
+            out.append((name, f"failed: {e}"))
+            break
+        coll.upsert({"_id": name, "applied_at": _time.time()})
+        out.append((name, "applied"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Built-in migrations (the live examples; new schema changes append here)
+# --------------------------------------------------------------------------- #
+
+
+@register_migration("0001-task-execution-platform-default")
+def _m0001(store: Store) -> None:
+    """Tasks created before execution_platform existed default to host."""
+    store.collection("tasks").update_where(
+        lambda d: "execution_platform" not in d,
+        {"execution_platform": "host"},
+    )
+
+
+@register_migration("0002-queue-docs-to-columnar")
+def _m0002(store: Store) -> None:
+    """Rewrite legacy item-list queue docs into the columnar format."""
+    from ..models.task_queue import TaskQueue, _ITEM_FIELDS
+
+    for coll_name in ("task_queues", "task_secondary_queues"):
+        coll = store.collection(coll_name)
+        for doc in coll.find(lambda d: "cols" not in d and "queue" in d):
+            items = doc.get("queue", [])
+            cols = {
+                name: [item.get(name) for item in items]
+                for name in _ITEM_FIELDS
+            }
+            coll.update(doc["_id"], {"cols": cols})
+            coll.mutate(doc["_id"], lambda d: d.pop("queue", None))

@@ -109,3 +109,26 @@ def test_underwater_unschedule(store):
     assert doomed == ["stale"]
     assert task_mod.get(store, "stale").activated is False
     assert task_mod.get(store, "fresh").activated is True
+
+
+def test_migrations_apply_once_and_in_order(store):
+    from evergreen_tpu.storage import migrations as mig
+    from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+    from evergreen_tpu.models import task_queue as tq_mod
+
+    # a legacy queue doc (item-list format) migrates to columnar
+    tq_mod.save(
+        store,
+        TaskQueue(distro_id="dm", queue=[TaskQueueItem(id="a"),
+                                         TaskQueueItem(id="b")]),
+    )
+    out = mig.apply_migrations(store)
+    assert all(result == "applied" for _, result in out)
+    doc = tq_mod.coll(store).get("dm")
+    assert "cols" in doc and "queue" not in doc
+    assert doc["cols"]["id"] == ["a", "b"]
+    q = tq_mod.load(store, "dm")
+    assert [i.id for i in q.queue] == ["a", "b"]
+    # second run is a no-op
+    assert mig.apply_migrations(store) == []
+    assert mig.pending_migrations(store) == []
