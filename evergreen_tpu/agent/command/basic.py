@@ -26,43 +26,81 @@ class TaskAborted(Exception):
 
 def run_process(
     ctx: CommandContext, argv: List[str], cwd: str, env: Dict[str, str],
-    timeout_s: float = 0.0,
+    timeout_s: float = 0.0, idle_timeout_s: float = 0.0,
 ) -> Tuple[int, str, str]:
-    """Run a command as an abortable subprocess: polls the context's abort
-    event and kills the process mid-run when set (reference agent abort
-    semantics — killProcs, agent/agent.go:1542); enforces ``timeout_s``
-    when nonzero. Killed commands' captured output is logged so the task
-    log shows what they printed. Returns (returncode, stdout, stderr)."""
+    """Run a command as an abortable subprocess.
+
+    * polls the context's abort event and kills the process tree mid-run
+      when set (reference killProcs, agent/agent.go:1542);
+    * ``timeout_s``: hard cap on total runtime (exec_timeout);
+    * ``idle_timeout_s``: kills the command when it produces NO output for
+      that long (the reference's timeout_secs idle semantics) — output is
+      streamed by reader threads so idleness is measured live.
+
+    Killed commands' captured output tail is logged. Returns
+    (returncode, stdout, stderr)."""
+    import io
+    import threading
+
     deadline = _time.monotonic() + timeout_s if timeout_s else None
     proc = subprocess.Popen(
         argv, cwd=cwd, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         start_new_session=True,  # own process group: kill takes the tree
     )
+    out_buf: List[str] = []
+    err_buf: List[str] = []
+    last_output = [_time.monotonic()]
 
-    def _kill_and_log(reason: str) -> None:
+    def reader(pipe, buf):
+        for line in iter(pipe.readline, ""):
+            buf.append(line)
+            last_output[0] = _time.monotonic()
+        pipe.close()
+
+    threads = [
+        threading.Thread(target=reader, args=(proc.stdout, out_buf), daemon=True),
+        threading.Thread(target=reader, args=(proc.stderr, err_buf), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+
+    def finish() -> Tuple[int, str, str]:
+        for t in threads:
+            t.join(timeout=5)
+        return proc.returncode, "".join(out_buf), "".join(err_buf)
+
+    def kill_and_log(reason: str) -> None:
         _kill_tree(proc)
-        try:
-            out, err = proc.communicate(timeout=5)
-        except subprocess.TimeoutExpired:
-            out, err = "", ""
-        for line in (out or "").splitlines()[-50:]:
+        proc.wait(timeout=5)
+        for t in threads:
+            t.join(timeout=5)
+        for line in "".join(out_buf).splitlines()[-50:]:
             ctx.log(line)
-        for line in (err or "").splitlines()[-50:]:
+        for line in "".join(err_buf).splitlines()[-50:]:
             ctx.log(f"[stderr] {line}")
         ctx.log(f"[killed: {reason}]")
 
     while True:
         try:
-            out, err = proc.communicate(timeout=0.5)
-            return proc.returncode, out or "", err or ""
+            proc.wait(timeout=0.5)
+            return finish()
         except subprocess.TimeoutExpired:
+            now_m = _time.monotonic()
             if ctx.abort_event is not None and ctx.abort_event.is_set():
-                _kill_and_log("task aborted by request")
+                kill_and_log("task aborted by request")
                 raise TaskAborted("task aborted by request")
-            if deadline is not None and _time.monotonic() > deadline:
-                _kill_and_log(f"exec timeout after {timeout_s:.0f}s")
+            if deadline is not None and now_m > deadline:
+                kill_and_log(f"exec timeout after {timeout_s:.0f}s")
                 raise subprocess.TimeoutExpired(argv, timeout_s)
+            if (
+                idle_timeout_s
+                and now_m - last_output[0] > idle_timeout_s
+            ):
+                kill_and_log(
+                    f"idle timeout: no output for {idle_timeout_s:.0f}s"
+                )
+                raise subprocess.TimeoutExpired(argv, idle_timeout_s)
 
 
 def _kill_tree(proc: subprocess.Popen) -> None:
@@ -96,7 +134,8 @@ class ShellExec(Command):
         os.makedirs(working_dir, exist_ok=True)
         code, out, err = run_process(
             ctx, [shell, "-c", script], working_dir, env,
-            timeout_s=ctx.exec_timeout_s or ctx.idle_timeout_s or 0.0,
+            timeout_s=ctx.exec_timeout_s,
+            idle_timeout_s=ctx.idle_timeout_s,
         )
         for line in out.splitlines():
             ctx.log(line)
@@ -134,6 +173,7 @@ class SubprocessExec(Command):
             code, out, err = run_process(
                 ctx, [binary, *args], working_dir, env,
                 timeout_s=ctx.exec_timeout_s,
+                idle_timeout_s=ctx.idle_timeout_s,
             )
         except FileNotFoundError:
             return CommandResult(exit_code=127, failed=True,

@@ -218,3 +218,32 @@ def test_post_error_fails_task_flag(tmp_path, store):
     t = tmod.get(store, "pt1")
     assert t.status == TaskStatus.FAILED.value
     assert t.details_type == "setup"
+
+
+def test_idle_timeout_vs_active_output(tmp_path):
+    """A command producing output survives past the idle window; a silent
+    command is killed by it (reference timeout_secs idle semantics)."""
+    import subprocess as sp
+
+    import pytest as _pytest
+
+    # chatty command: runs 3s total, outputs every 0.5s, idle window 1.5s
+    ctx, lines = ctx_for(tmp_path)
+    ctx.idle_timeout_s = 1.5
+    r = get_command(
+        "shell.exec",
+        {"script": "for i in 1 2 3 4 5 6; do echo tick$i; sleep 0.5; done"},
+    ).execute(ctx)
+    assert not r.failed
+    assert any("tick6" in line for line in lines)
+
+    # silent command: killed after the idle window, well before 60s
+    ctx2, lines2 = ctx_for(tmp_path)
+    ctx2.idle_timeout_s = 1.5
+    import time as _t
+
+    t0 = _t.time()
+    with _pytest.raises(sp.TimeoutExpired):
+        get_command("shell.exec", {"script": "sleep 60"}).execute(ctx2)
+    assert _t.time() - t0 < 20
+    assert any("idle timeout" in line for line in lines2)
