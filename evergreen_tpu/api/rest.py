@@ -78,8 +78,10 @@ class RestApi:
         self.github_hooks = GithubHookHandler(store)
         self.webhook_secret = ""
         from ..events.github_status import install as _install_ghs
+        from ..events.senders import install as _install_senders
 
         _install_ghs(store)
+        _install_senders(store)
 
     def _github_hook(self, raw: bytes, headers: Dict[str, str], body: dict):
         from .github_hooks import verify_signature

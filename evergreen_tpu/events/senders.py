@@ -1,0 +1,73 @@
+"""Default notification channel senders.
+
+The reference delivers through per-channel send jobs (units/event_send.go:
+email via SMTP, Slack, Jira issues/comments, evergreen-webhooks, GitHub
+statuses). This image is zero-egress, so every built-in sender delivers to
+a per-channel outbox collection with the exact payload a real transport
+would send; deployments drain the outboxes or register real senders over
+the same ``register_sender`` seam.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time as _time
+from typing import Optional
+
+from ..storage.store import Store
+from .triggers import Notification, register_sender
+
+_seq = itertools.count()
+_lock = threading.Lock()
+_store_ref: Optional[Store] = None
+
+OUTBOX = {
+    "email": "email_outbox",
+    "slack": "slack_outbox",
+    "jira-issue": "jira_outbox",
+    "jira-comment": "jira_outbox",
+    "webhook": "webhook_outbox",
+}
+
+
+def _payload(channel: str, ntf: Notification) -> dict:
+    if channel == "email":
+        return {"to": ntf.subscriber_target, "subject": ntf.subject,
+                "body": ntf.body}
+    if channel == "slack":
+        return {"channel": ntf.subscriber_target,
+                "text": f"{ntf.subject}\n{ntf.body}"}
+    if channel in ("jira-issue", "jira-comment"):
+        return {"project_or_issue": ntf.subscriber_target,
+                "kind": channel, "summary": ntf.subject,
+                "description": ntf.body}
+    # webhook: the reference POSTs a signed JSON payload
+    return {"url": ntf.subscriber_target,
+            "payload": {"subject": ntf.subject, "body": ntf.body}}
+
+
+def install(store: Store) -> None:
+    """Register outbox senders for every standard channel."""
+    global _store_ref
+    _store_ref = store
+
+    def make(channel: str):
+        def send(ntf: Notification) -> None:
+            if _store_ref is None:
+                raise RuntimeError("senders not installed")
+            with _lock:
+                n = next(_seq)
+            _store_ref.collection(OUTBOX[channel]).upsert(
+                {
+                    "_id": f"{channel}-{n}",
+                    "channel_type": channel,
+                    "created_at": _time.time(),
+                    "delivered": False,
+                    **_payload(channel, ntf),
+                }
+            )
+
+        return send
+
+    for channel in OUTBOX:
+        register_sender(channel, make(channel))

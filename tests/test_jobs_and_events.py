@@ -292,3 +292,32 @@ def test_stale_building_hosts_reaped(store):
     assert reaped == ["stale"]
     assert host_mod.get(store, "stale").status == HostStatus.TERMINATED.value
     assert host_mod.get(store, "fresh").status == HostStatus.STARTING.value
+
+
+def test_default_channel_senders_write_outboxes(store):
+    from evergreen_tpu.events import senders
+    from evergreen_tpu.models.lifecycle import mark_end
+
+    senders.install(store)
+    for chan, target in (("email", "dev@x.y"), ("slack", "#ci"),
+                         ("webhook", "https://hooks/x")):
+        add_subscription(
+            store,
+            Subscription(
+                id=f"s-{chan}", resource_type=event_mod.RESOURCE_TASK,
+                trigger="failure", subscriber_type=chan,
+                subscriber_target=target,
+            ),
+        )
+    task_mod.insert(
+        store,
+        Task(id="nt1", status=TaskStatus.STARTED.value, activated=True,
+             start_time=NOW - 5),
+    )
+    mark_end(store, "nt1", TaskStatus.FAILED.value, now=NOW)
+    process_unprocessed_events(store, now=NOW)
+    assert len(store.collection("email_outbox").find()) == 1
+    assert store.collection("slack_outbox").find()[0]["channel_type"] == "slack"
+    hook = store.collection("webhook_outbox").find()[0]
+    assert hook["url"] == "https://hooks/x"
+    assert "nt1" in hook["payload"]["subject"]
