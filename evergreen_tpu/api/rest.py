@@ -914,6 +914,14 @@ class RestApi:
             )
         except KeyError as e:
             raise ApiError(400, f"missing subscription field {e}")
+        from ..events.triggers import _SENDERS
+
+        if sub.subscriber_type not in _SENDERS:
+            raise ApiError(
+                400,
+                f"unknown subscriber type {sub.subscriber_type!r}; "
+                f"registered channels: {sorted(_SENDERS)}",
+            )
         add_subscription(self.store, sub)
         return 201, sub.to_doc()
 

@@ -9,10 +9,7 @@ for PR/merge patches here via the standard subscription machinery.
 """
 from __future__ import annotations
 
-import itertools
-import threading
-import time as _time
-from typing import List, Optional
+from typing import List
 
 from ..storage.store import Store
 from .triggers import (
@@ -25,37 +22,26 @@ from .triggers import (
 
 OUTBOX_COLLECTION = "github_status_outbox"
 
-_seq = itertools.count()
-_lock = threading.Lock()
-_store_ref: Optional[Store] = None
+
+def _status_payload(ntf: Notification) -> dict:
+    # target format: "<owner>/<repo>@<sha>"
+    repo, _, sha = ntf.subscriber_target.partition("@")
+    return {
+        "repo": repo,
+        "sha": sha,
+        "state": "failure" if "fail" in ntf.body else "success",
+        "description": ntf.subject,
+        "context": "evergreen-tpu",
+    }
 
 
 def install(store: Store) -> None:
     """Register the github-status channel sender bound to this store."""
-    global _store_ref
-    _store_ref = store
-    register_sender("github-status", _send)
+    from .senders import make_outbox_sender
 
-
-def _send(ntf: Notification) -> None:
-    if _store_ref is None:
-        raise RuntimeError("github-status sender not installed")
-    with _lock:
-        n = next(_seq)
-    # target format: "<owner>/<repo>@<sha>"
-    repo, _, sha = ntf.subscriber_target.partition("@")
-    state = "failure" if "fail" in ntf.body else "success"
-    _store_ref.collection(OUTBOX_COLLECTION).upsert(
-        {
-            "_id": f"ghs-{n}",
-            "repo": repo,
-            "sha": sha,
-            "state": state,
-            "description": ntf.subject,
-            "context": "evergreen-tpu",
-            "created_at": _time.time(),
-            "delivered": False,
-        }
+    register_sender(
+        "github-status",
+        make_outbox_sender(store, OUTBOX_COLLECTION, _status_payload),
     )
 
 

@@ -9,65 +9,70 @@ the same ``register_sender`` seam.
 """
 from __future__ import annotations
 
-import itertools
-import threading
 import time as _time
-from typing import Optional
+import uuid
+from typing import Callable, Dict
 
 from ..storage.store import Store
 from .triggers import Notification, register_sender
 
-_seq = itertools.count()
-_lock = threading.Lock()
-_store_ref: Optional[Store] = None
-
 OUTBOX = {
     "email": "email_outbox",
     "slack": "slack_outbox",
-    "jira-issue": "jira_outbox",
+    "jira": "jira_outbox",
     "jira-comment": "jira_outbox",
     "webhook": "webhook_outbox",
 }
 
 
+def make_outbox_sender(
+    store: Store,
+    collection: str,
+    payload_fn: Callable[[Notification], dict],
+) -> Callable[[Notification], None]:
+    """Shared outbox delivery: the store is closure-captured (multiple
+    installs against different stores stay independent) and ids are
+    process-restart-safe UUIDs so undrained docs are never overwritten."""
+
+    def send(ntf: Notification) -> None:
+        store.collection(collection).insert(
+            {
+                "_id": f"ntf-{uuid.uuid4().hex}",
+                "created_at": _time.time(),
+                "delivered": False,
+                **payload_fn(ntf),
+            }
+        )
+
+    return send
+
+
 def _payload(channel: str, ntf: Notification) -> dict:
+    base = {"channel_type": channel}
     if channel == "email":
-        return {"to": ntf.subscriber_target, "subject": ntf.subject,
-                "body": ntf.body}
-    if channel == "slack":
-        return {"channel": ntf.subscriber_target,
-                "text": f"{ntf.subject}\n{ntf.body}"}
-    if channel in ("jira-issue", "jira-comment"):
-        return {"project_or_issue": ntf.subscriber_target,
-                "kind": channel, "summary": ntf.subject,
-                "description": ntf.body}
-    # webhook: the reference POSTs a signed JSON payload
-    return {"url": ntf.subscriber_target,
-            "payload": {"subject": ntf.subject, "body": ntf.body}}
+        base.update({"to": ntf.subscriber_target, "subject": ntf.subject,
+                     "body": ntf.body})
+    elif channel == "slack":
+        base.update({"slack_channel": ntf.subscriber_target,
+                     "text": f"{ntf.subject}\n{ntf.body}"})
+    elif channel in ("jira", "jira-comment"):
+        base.update({"project_or_issue": ntf.subscriber_target,
+                     "kind": channel, "summary": ntf.subject,
+                     "description": ntf.body})
+    else:  # webhook: the reference POSTs a signed JSON payload
+        base.update({"url": ntf.subscriber_target,
+                     "payload": {"subject": ntf.subject, "body": ntf.body}})
+    return base
 
 
 def install(store: Store) -> None:
-    """Register outbox senders for every standard channel."""
-    global _store_ref
-    _store_ref = store
-
-    def make(channel: str):
-        def send(ntf: Notification) -> None:
-            if _store_ref is None:
-                raise RuntimeError("senders not installed")
-            with _lock:
-                n = next(_seq)
-            _store_ref.collection(OUTBOX[channel]).upsert(
-                {
-                    "_id": f"{channel}-{n}",
-                    "channel_type": channel,
-                    "created_at": _time.time(),
-                    "delivered": False,
-                    **_payload(channel, ntf),
-                }
-            )
-
-        return send
-
-    for channel in OUTBOX:
-        register_sender(channel, make(channel))
+    """Register outbox senders for every standard channel against this
+    store."""
+    for channel, collection in OUTBOX.items():
+        register_sender(
+            channel,
+            make_outbox_sender(
+                store, collection,
+                lambda ntf, _c=channel: _payload(_c, ntf),
+            ),
+        )

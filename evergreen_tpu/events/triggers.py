@@ -41,7 +41,7 @@ class Subscription:
     id: str
     resource_type: str
     trigger: str
-    subscriber_type: str  # email | slack | webhook | github-status | jira
+    subscriber_type: str  # email|slack|webhook|github-status|jira|jira-comment
     subscriber_target: str
     #: selector filters on the event payload (project, requester, id, …)
     filters: Dict[str, str] = dataclasses.field(default_factory=dict)
