@@ -165,6 +165,11 @@ def compute_deps_met(
     unfinished (all snapshot tasks are undispatched), so only out-of-snapshot
     parents can satisfy edges; their statuses arrive via ``finished_status``
     (task id → final status for finished tasks).
+
+    Deliberately pure Python: a C-API evgpack version was measured SLOWER
+    (~32ms vs ~25ms at 50k tasks / 25% dep fraction) — the loop body is
+    already cached-hash dict/set probes, and generic ``PyObject_GetAttr``
+    from C loses to the interpreter's specialized ``LOAD_ATTR``.
     """
     in_snapshot = {t.id for t in tasks}
     met: Dict[str, bool] = {}
