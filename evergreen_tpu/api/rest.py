@@ -234,6 +234,7 @@ class RestApi:
         r("GET", r"/rest/v2/hosts/(?P<host>[^/]+)", self.get_host)
         r("GET", r"/rest/v2/distros", self.list_distros)
         r("GET", r"/rest/v2/distros/(?P<distro>[^/]+)/queue", self.get_queue)
+        r("GET", r"/rest/v2/distros/(?P<distro>[^/]+)", self.get_distro)
 
         # versions / builds / projects
         r("GET", r"/rest/v2/versions", self.list_versions)
@@ -589,6 +590,13 @@ class RestApi:
         repotracker_mod.upsert_project_ref(self.store, ref)
         return 200, ref.to_doc()
 
+    def get_distro(self, method, match, body):
+        """Single distro by id (reference rest/route/distro.go GET)."""
+        d = distro_mod.get(self.store, match["distro"])
+        if d is None:
+            raise ApiError(404, f"distro {match['distro']!r} not found")
+        return 200, d.to_doc()
+
     def put_distro(self, method, match, body):
         """Create/update a distro (reference rest/route/distro.go)."""
         import dataclasses as _dc
@@ -613,7 +621,9 @@ class RestApi:
         for k, v in body.items():
             if k not in known:
                 raise ApiError(400, f"unknown distro field {k!r}")
-            if k in subsections and isinstance(v, dict):
+            if k in subsections and not isinstance(v, dict):
+                raise ApiError(400, f"{k} must be an object")
+            if k in subsections:
                 current = getattr(d, k)
                 sub_known = {f.name for f in _dc.fields(current)}
                 for sk, sv in v.items():
@@ -624,6 +634,31 @@ class RestApi:
                     setattr(current, sk, sv)
             else:
                 setattr(d, k, v)
+        # version-knob validation (reference globals.go:1104-1120
+        # ValidTaskPlannerVersions / ValidTaskDispatcherVersions /
+        # ValidTaskFinderVersions / ValidHostAllocatorVersions, enforced by
+        # distro validation before save)
+        from ..globals import (
+            DispatcherVersion,
+            FinderVersion,
+            HostAllocatorVersion,
+            PlannerVersion,
+        )
+
+        for section, valid in (
+            ("planner_settings", {v.value for v in PlannerVersion}),
+            ("dispatcher_settings", {v.value for v in DispatcherVersion}),
+            ("finder_settings", {v.value for v in FinderVersion}),
+            ("host_allocator_settings",
+             {v.value for v in HostAllocatorVersion}),
+        ):
+            got = getattr(d, section).version
+            if got not in valid:
+                raise ApiError(
+                    400,
+                    f"invalid {section}.version {got!r}; "
+                    f"valid: {sorted(valid)}",
+                )
         distro_mod.upsert(self.store, d)
         return 200, d.to_doc()
 

@@ -193,10 +193,17 @@ SCHEDULER_TICK_INTERVAL_S = 15
 class PlannerVersion(str, enum.Enum):
     TUNABLE = "tunable"  # reference's tunable planner semantics, serial
     TPU = "tpu"  # batched JAX solve (this framework's north star)
+    #: the reference's alternative comparator-chain planner
+    #: (scheduler/task_prioritizer.go); planned host-side per distro
+    CMP_BASED = "cmpbased"
 
 
 class DispatcherVersion(str, enum.Enum):
     REVISED_WITH_DEPENDENCIES = "revised-with-dependencies"
+
+
+class HostAllocatorVersion(str, enum.Enum):
+    UTILIZATION = "utilization"
 
 
 class FinderVersion(str, enum.Enum):

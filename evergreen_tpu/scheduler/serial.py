@@ -485,6 +485,30 @@ def utilization_based_host_allocator(inp: AllocatorInput) -> Tuple[int, int]:
 # --------------------------------------------------------------------------- #
 
 
+def queue_info_and_new_hosts(
+    d: Distro,
+    plan: List[Task],
+    deps_met: Dict[str, bool],
+    hosts: List[Host],
+    running_estimates: Dict[str, RunningTaskEstimate],
+    now: float,
+) -> Tuple[DistroQueueInfo, int]:
+    """Queue info + utilization allocation for one planned distro — the
+    per-distro tail every planner shares (serial tick and the cmp-based
+    path in the tick wrapper), kept in one place so allocator wiring
+    changes cannot diverge between them."""
+    info = get_distro_queue_info(d, plan, deps_met, now)
+    n_new, _ = utilization_based_host_allocator(
+        AllocatorInput(
+            distro=d,
+            existing_hosts=hosts,
+            queue_info=info,
+            running_estimates=running_estimates,
+        )
+    )
+    return info, n_new
+
+
 def serial_tick(
     distros: List[Distro],
     tasks_by_distro: Dict[str, List[Task]],
@@ -501,15 +525,9 @@ def serial_tick(
     for d in distros:
         tasks = tasks_by_distro.get(d.id, [])
         plan, sort_values = plan_distro_queue(d, tasks, now)
-        info = get_distro_queue_info(d, plan, deps_met, now)
-        hosts = hosts_by_distro.get(d.id, [])
-        n_new, _ = utilization_based_host_allocator(
-            AllocatorInput(
-                distro=d,
-                existing_hosts=hosts,
-                queue_info=info,
-                running_estimates=running_estimates,
-            )
+        info, n_new = queue_info_and_new_hosts(
+            d, plan, deps_met, hosts_by_distro.get(d.id, []),
+            running_estimates, now,
         )
         out[d.id] = (plan, info, n_new, sort_values)
     return out

@@ -278,11 +278,26 @@ def run_tick(
     snapshot_ms = solve_ms = 0.0
     n_tasks = sum(len(v) for v in tasks_by_distro.values())
 
-    if opts.planner_version == PlannerVersion.TPU.value:
+    # Per-distro planner selection (reference scheduler/scheduler.go:28
+    # PrioritizeTasks): cmp-based distros are planned host-side with the
+    # comparator chain; everything else goes through the batched solve.
+    cmp_distros = [
+        d for d in distros
+        if d.planner_settings.version == PlannerVersion.CMP_BASED.value
+    ]
+    solver_distros = [
+        d for d in distros
+        if d.planner_settings.version != PlannerVersion.CMP_BASED.value
+    ]
+
+    plans: Dict[str, List[Task]] = {}
+    sort_values: Dict[str, Dict[str, float]] = {}
+    infos: Dict[str, DistroQueueInfo] = {}
+    if solver_distros and opts.planner_version == PlannerVersion.TPU.value:
         t1 = _time.perf_counter()
         snapshot = build_snapshot(
-            distros, tasks_by_distro, hosts_by_distro, running_estimates,
-            deps_met, now,
+            solver_distros, tasks_by_distro, hosts_by_distro,
+            running_estimates, deps_met, now,
         )
         t2 = _time.perf_counter()
         out = run_solve_packed(snapshot)
@@ -292,15 +307,43 @@ def run_tick(
         plans, sort_values, infos, new_hosts = _unpack_solve(
             snapshot, out, tasks_by_distro
         )
-    else:
+    elif solver_distros:
         results = serial.serial_tick(
-            distros, tasks_by_distro, hosts_by_distro, running_estimates,
-            deps_met, now,
+            solver_distros, tasks_by_distro, hosts_by_distro,
+            running_estimates, deps_met, now,
         )
         plans = {d: r[0] for d, r in results.items()}
         infos = {d: r[1] for d, r in results.items()}
         new_hosts = {d: r[2] for d, r in results.items()}
         sort_values = {d: r[3] for d, r in results.items()}
+
+    if cmp_distros:
+        from . import cmp_prioritizer
+
+        # only the version docs the cmp tasks actually reference (the
+        # merge-queue comparator reads the version's requester)
+        version_ids = {
+            t.version
+            for d in cmp_distros
+            for t in tasks_by_distro.get(d.id, [])
+            if t.version
+        }
+        version_requesters = {
+            doc["_id"]: doc.get("requester", "")
+            for doc in store.collection("versions").find_ids(version_ids)
+        }
+        for d in cmp_distros:
+            plan = cmp_prioritizer.prioritize_tasks(
+                tasks_by_distro.get(d.id, []), version_requesters
+            )
+            info, n_new = serial.queue_info_and_new_hosts(
+                d, plan, deps_met, hosts_by_distro.get(d.id, []),
+                running_estimates, now,
+            )
+            plans[d.id] = plan
+            infos[d.id] = info
+            new_hosts[d.id] = n_new
+            sort_values[d.id] = {}
 
     # Single-task distros allocate 1:1 with dependency-met tasks (reference
     # units/host_allocator.go:174-181), bypassing the utilization heuristic.

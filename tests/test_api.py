@@ -444,3 +444,54 @@ def test_waterfall_and_resource_events(store, server):
     emod.log(store, emod.RESOURCE_TASK, "TASK_FINISHED", "w1")
     events = comm._call("GET", "/rest/v2/resources/w1/events")
     assert [e["event_type"] for e in events] == ["TASK_STARTED", "TASK_FINISHED"]
+
+
+def test_distro_get_put_and_version_validation(store, server):
+    base, api = server
+    comm = RestCommunicator(base)
+
+    resp = comm._call(
+        "PUT",
+        "/rest/v2/distros/d-api",
+        {
+            "provider": "mock",
+            "planner_settings": {"version": "cmpbased"},
+            "host_allocator_settings": {"maximum_hosts": 4},
+        },
+    )
+    assert resp["planner_settings"]["version"] == "cmpbased"
+
+    # single-distro GET round-trips the stored config
+    got = comm._call("GET", "/rest/v2/distros/d-api")
+    assert got["planner_settings"]["version"] == "cmpbased"
+    assert got["host_allocator_settings"]["maximum_hosts"] == 4
+    missing = comm._call("GET", "/rest/v2/distros/nope")
+    assert "error" in missing
+
+    # invalid version knobs are rejected, not silently stored
+    # (reference globals.go ValidTaskPlannerVersions et al.)
+    bad = comm._call(
+        "PUT",
+        "/rest/v2/distros/d-bad",
+        {"provider": "mock", "planner_settings": {"version": "quantum"}},
+    )
+    assert "invalid planner_settings.version" in bad.get("error", "")
+    assert distro_mod.get(store, "d-bad") is None
+
+
+def test_distro_put_rejects_bad_subsection_types(store, server):
+    base, api = server
+    comm = RestCommunicator(base)
+    # non-object subsection must 400, not replace the dataclass (and not 500)
+    bad = comm._call(
+        "PUT", "/rest/v2/distros/d-t",
+        {"provider": "mock", "planner_settings": "tunable"},
+    )
+    assert "must be an object" in bad.get("error", "")
+    assert distro_mod.get(store, "d-t") is None
+    # empty host-allocator version is not a valid allocator
+    bad = comm._call(
+        "PUT", "/rest/v2/distros/d-t",
+        {"provider": "mock", "host_allocator_settings": {"version": ""}},
+    )
+    assert "invalid host_allocator_settings.version" in bad.get("error", "")

@@ -1,0 +1,230 @@
+"""Cmp-based prioritizer: the reference's comparator-chain planner
+(scheduler/task_prioritizer.go, task_priority_cmp.go) — bucket split,
+chain ordering, 1:1 interleave merge, and per-distro tick integration."""
+from evergreen_tpu.globals import PlannerVersion, Provider
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import task_queue as tq_mod
+from evergreen_tpu.models.distro import (
+    Distro,
+    HostAllocatorSettings,
+    PlannerSettings,
+)
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.scheduler.cmp_prioritizer import (
+    explain_order,
+    prioritize_tasks,
+    split_by_requester,
+)
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+NOW = 1_700_000_000.0
+
+
+def _task(id, **kw):
+    kw.setdefault("requester", "gitter_request")
+    kw.setdefault("project", "p")
+    return Task(id=id, **kw)
+
+
+def test_split_by_requester_buckets():
+    tasks = [
+        _task("hp", priority=101, requester="patch_request"),
+        _task("main1"),
+        _task("periodic", requester="ad_hoc"),
+        _task("cli", requester="patch_request"),
+        _task("pr", requester="github_pull_request"),
+        _task("mq", requester="github_merge_request"),
+        _task("bogus", requester="unknown_requester"),
+    ]
+    high, patch, mainline, dropped = split_by_requester(tasks)
+    assert [t.id for t in high] == ["hp"]
+    assert [t.id for t in patch] == ["cli", "pr", "mq"]
+    # ad-hoc/periodic builds are system requesters → mainline bucket
+    assert [t.id for t in mainline] == ["main1", "periodic"]
+    # unrecognized requesters are dropped (reference logs + skips them),
+    # and surfaced so the starvation is visible
+    assert [t.id for t in dropped] == ["bogus"]
+
+
+def test_unrecognized_requester_logged_and_excluded(caplog):
+    import logging
+
+    with caplog.at_level(logging.ERROR,
+                         logger="evergreen_tpu.scheduler.cmp_prioritizer"):
+        out = prioritize_tasks([_task("ok"), _task("bad", requester="weird")])
+    assert [t.id for t in out] == ["ok"]
+    assert "unrecognized requester" in caplog.text
+    assert "bad" in caplog.text
+
+
+def test_merge_interleaves_patch_and_mainline_one_to_one():
+    tasks = (
+        [_task(f"m{i}", revision_order_number=10 - i) for i in range(4)]
+        + [_task(f"p{i}", requester="patch_request", ingest_time=NOW + i)
+           for i in range(2)]
+        + [_task("vip", priority=200)]
+    )
+    out = [t.id for t in prioritize_tasks(tasks)]
+    # high-priority leads; patches take even slots until exhausted
+    assert out == ["vip", "p0", "m0", "p1", "m1", "m2", "m3"]
+
+
+def test_priority_numdeps_generate_chain():
+    tasks = [
+        _task("low", priority=1),
+        _task("high", priority=5),
+        _task("deps", priority=5, num_dependents=3),
+        _task("gen", priority=5, num_dependents=3, generate_task=True),
+    ]
+    out = [t.id for t in prioritize_tasks(tasks)]
+    assert out == ["gen", "deps", "high", "low"]
+
+
+def test_age_policy_same_project_newer_commit_first():
+    tasks = [
+        _task("old", revision_order_number=1),
+        _task("new", revision_order_number=2),
+    ]
+    assert [t.id for t in prioritize_tasks(tasks)] == ["new", "old"]
+
+
+def test_age_policy_cross_project_older_ingest_first():
+    tasks = [
+        _task("late", project="a", ingest_time=NOW),
+        _task("early", project="b", ingest_time=NOW - 100),
+    ]
+    assert [t.id for t in prioritize_tasks(tasks)] == ["early", "late"]
+
+
+def test_age_policy_patches_older_first():
+    tasks = [
+        _task("late", requester="patch_request", ingest_time=NOW),
+        _task("early", requester="patch_request", ingest_time=NOW - 100),
+    ]
+    assert [t.id for t in prioritize_tasks(tasks)] == ["early", "late"]
+
+
+def test_runtime_longer_first_zero_never_decides():
+    tasks = [
+        _task("short", expected_duration_s=60.0),
+        _task("long", expected_duration_s=600.0),
+        _task("unknown", expected_duration_s=0.0),
+    ]
+    out = [t.id for t in prioritize_tasks(tasks)]
+    assert out.index("long") < out.index("short")
+    # zero duration ties with everything → stable pre-sort order holds
+    assert "unknown" in out
+
+
+def test_task_groups_lead_and_stay_adjacent_in_order():
+    tasks = [
+        _task("solo", priority=50),
+        _task("g2", build_id="b1", task_group="tg", task_group_order=2),
+        _task("g1", build_id="b1", task_group="tg", task_group_order=1),
+        _task("h1", build_id="b2", task_group="other", task_group_order=1),
+    ]
+    out = [t.id for t in prioritize_tasks(tasks)]
+    # grouped tasks outrank ungrouped regardless of priority; members run
+    # in group order; groups keep lexical (build, group) blocks
+    assert out == ["g1", "g2", "h1", "solo"]
+
+
+def test_equal_group_order_is_terminal_tie_not_priority_sorted():
+    """Same group+build with equal task_group_order: the chain must STOP
+    (reference byTaskGroupOrder decides every grouped pair), so priority
+    cannot reorder members away from the stable pre-sort order."""
+    tasks = [
+        _task("ga", build_id="b", task_group="tg", task_group_order=0,
+              priority=1),
+        _task("gb", build_id="b", task_group="tg", task_group_order=0,
+              priority=99),
+    ]
+    out = [t.id for t in prioritize_tasks(tasks)]
+    # pre-sort is reverse-lexical on build-group-id → gb before ga; the
+    # higher priority of gb must NOT be the reason (terminal tie), which
+    # explain_order confirms
+    assert out == ["gb", "ga"]
+    assert explain_order(tasks[0], tasks[1]).startswith(
+        "order within task group: same group and order"
+    )
+
+
+def test_merge_queue_version_outranks_priority_below_groups():
+    tasks = [
+        _task("plain", version="v1", priority=10),
+        _task("merge", version="vmq", priority=0),
+    ]
+    out = prioritize_tasks(
+        tasks, version_requesters={"vmq": "github_merge_request"}
+    )
+    assert [t.id for t in out] == ["merge", "plain"]
+
+
+def test_explain_order_names_deciding_comparator():
+    t1 = _task("a", priority=5)
+    t2 = _task("b", priority=1)
+    assert explain_order(t1, t2).startswith("task priority:")
+    assert "a before b" in explain_order(t1, t2)
+    assert explain_order(t1, t1) == "tie: insertion order preserved"
+
+
+def test_tick_plans_cmp_distro_next_to_solver_distros(store):
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d-cmp",
+            provider=Provider.MOCK.value,
+            planner_settings=PlannerSettings(
+                version=PlannerVersion.CMP_BASED.value
+            ),
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=10),
+        ),
+    )
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d-tpu",
+            provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=10),
+        ),
+    )
+    common = dict(
+        status="undispatched",
+        activated=True,
+        activated_time=NOW - 600,
+        create_time=NOW - 700,
+        scheduled_time=NOW - 600,
+        expected_duration_s=300.0,
+        project="p",
+        build_variant="bv",
+    )
+    cmp_tasks = [
+        Task(id=f"c{i}", distro_id="d-cmp", requester="gitter_request",
+             version="v1", revision_order_number=i, **common)
+        for i in range(3)
+    ] + [
+        Task(id="cp", distro_id="d-cmp", requester="patch_request",
+             version="v2", **common)
+    ]
+    tpu_tasks = [
+        Task(id=f"s{i}", distro_id="d-tpu", requester="gitter_request",
+             version="v1", priority=i, **common)
+        for i in range(3)
+    ]
+    task_mod.insert_many(store, cmp_tasks + tpu_tasks)
+
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert res.n_distros == 2
+
+    # cmp distro: patch leads (even interleave slot), then commits
+    # newest-revision-first (same-project byAge policy)
+    q = tq_mod.load(store, "d-cmp")
+    assert [i.id for i in q.queue] == ["cp", "c2", "c1", "c0"]
+    # queue info + utilization allocator still ran for the cmp distro
+    assert q.info.expected_duration_s > 0
+    assert res.new_hosts["d-cmp"] >= 1
+
+    # solver distro unaffected: tunable-value order (priority desc)
+    q2 = tq_mod.load(store, "d-tpu")
+    assert [i.id for i in q2.queue] == ["s2", "s1", "s0"]
