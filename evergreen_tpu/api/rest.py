@@ -146,6 +146,10 @@ class RestApi:
                     return e.status, {"error": e.message}
                 except KeyError as e:
                     return 404, {"error": f"not found: {e}"}
+                except (ValueError, TypeError) as e:
+                    # malformed client input (?limit=abc, wrong-typed JSON
+                    # field) is a 400, not a WSGI stack trace
+                    return 400, {"error": f"bad request: {e}"}
         return 404, {"error": f"no route for {method} {path}"}
 
     def wsgi_app(self, environ, start_response):
@@ -177,7 +181,19 @@ class RestApi:
         if path == "/hooks/github":
             status, payload = self._github_hook(raw, headers, body)
         else:
-            # query strings are informational only (e.g. ?limit=)
+            # query-string params merge into the handler body (JSON body
+            # keys win) so GET endpoints can take ?limit= / ?variants= /
+            # ?execution= the way the reference's gimlet routes do. GET
+            # only — mutating routes take their input from the JSON body,
+            # and a ?variants= string must not shadow a list-typed field.
+            # Repeated keys collapse to the last value so handlers always
+            # see scalars.
+            qs = environ.get("QUERY_STRING", "")
+            if qs and method == "GET" and isinstance(body, dict):
+                from urllib.parse import parse_qs
+
+                for k, vs in parse_qs(qs, keep_blank_values=True).items():
+                    body.setdefault(k, vs[-1])
             status, payload = self.handle(method, path.split("?")[0], body,
                                           headers)
         reason = {200: "OK", 201: "Created", 400: "Bad Request",
@@ -249,6 +265,8 @@ class RestApi:
             self.build_display_tasks,
         )
         r("GET", r"/rest/v2/projects", self.list_projects)
+        r("GET", r"/rest/v2/projects/(?P<project>[^/]+)/last_green",
+          self.last_green)
         r("PUT", r"/rest/v2/projects/(?P<project>[^/]+)", self.put_project)
         r("PUT", r"/rest/v2/distros/(?P<distro>[^/]+)", self.put_distro)
         r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/revisions", self.push_revision)
@@ -494,6 +512,44 @@ class RestApi:
         if v is None:
             raise ApiError(404, "version not found")
         return 200, v.to_doc()
+
+    def last_green(self, method, match, body):
+        """Most recent mainline version whose builds for ALL requested
+        variants succeeded (reference GetLastGreen, operations/http.go:352,
+        backing the `last-green` CLI command)."""
+        from ..globals import BuildStatus, is_mainline_requester
+        from ..models import build as build_mod
+
+        raw = body.get("variants", "")
+        variants = [
+            v for v in (raw if isinstance(raw, list) else raw.split(","))
+            if v
+        ]
+        if not variants:
+            raise ApiError(400, "variants required (?variants=a,b)")
+        candidates = version_mod.coll(self.store).find(
+            lambda d: d["project"] == match["project"]
+            and is_mainline_requester(d.get("requester", ""))
+        )
+        candidates.sort(
+            key=lambda d: d.get("revision_order_number", 0), reverse=True
+        )
+        # one scan of builds grouped by version (not a rescan per
+        # candidate — the builds collection dwarfs one project's versions)
+        green_by_version: dict = {}
+        for b in build_mod.coll(self.store).find(
+            lambda d: d["status"] == BuildStatus.SUCCEEDED.value
+        ):
+            green_by_version.setdefault(b["version"], set()).add(
+                b["build_variant"]
+            )
+        want = set(variants)
+        for doc in candidates:
+            if want <= green_by_version.get(doc["_id"], set()):
+                return 200, doc
+        raise ApiError(
+            404, f"no green version for variants {sorted(want)}"
+        )
 
     def version_tasks(self, method, match, body):
         ts = task_mod.find(

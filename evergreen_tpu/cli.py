@@ -116,6 +116,7 @@ def cmd_validate(args) -> int:
 
 
 def _client(args):
+    import urllib.error
     import urllib.request
 
     def call(method: str, path: str, body: Optional[dict] = None) -> dict:
@@ -125,8 +126,16 @@ def _client(args):
             method=method,
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return json.loads(resp.read() or b"{}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            # 4xx/5xx with a JSON body is a protocol answer the command
+            # should print, not a stack trace
+            try:
+                return json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                return {"error": f"HTTP {e.code}"}
 
     return call
 
@@ -188,6 +197,96 @@ def cmd_user(args) -> int:
             print("no such user", file=sys.stderr)
             return 1
         print("granted")
+    return 0
+
+
+def cmd_last_green(args) -> int:
+    """Most recent successful version for the given variants (reference
+    operations/last_green.go)."""
+    from urllib.parse import quote, urlencode
+
+    call = _client(args)
+    out = call(
+        "GET",
+        f"/rest/v2/projects/{quote(args.project, safe='')}/last_green"
+        f"?{urlencode({'variants': args.variants})}",
+    )
+    print(json.dumps(out, indent=2))
+    return 0 if "error" not in out else 1
+
+
+def cmd_fetch(args) -> int:
+    """Download a task's source config and/or artifacts into a directory
+    (reference operations/fetch.go; source here is the version's resolved
+    project config + revision metadata — there is no git remote to clone
+    in this deployment, the config IS the build recipe)."""
+    import os
+    import shutil
+    import urllib.request
+    from urllib.parse import quote
+
+    call = _client(args)
+    if not (args.source or args.artifacts):
+        print("nothing to do: pass --source and/or --artifacts",
+              file=sys.stderr)
+        return 1
+    task_path = quote(args.task, safe="")
+    task = call("GET", f"/rest/v2/tasks/{task_path}")
+    if "error" in task:
+        print(json.dumps(task), file=sys.stderr)
+        return 1
+    dest = os.path.join(
+        args.dir, f"{task.get('display_name', args.task)}-{args.task}"
+    )
+    os.makedirs(dest, exist_ok=True)
+
+    if args.source:
+        version = call(
+            "GET",
+            f"/rest/v2/versions/{quote(task.get('version', ''), safe='')}",
+        )
+        if "error" in version:
+            print(f"cannot fetch source: {json.dumps(version)}",
+                  file=sys.stderr)
+            return 1
+        with open(os.path.join(dest, "evergreen.yml"), "w") as f:
+            f.write(version.get("config_yaml", ""))
+        meta = {
+            k: version.get(k)
+            for k in ("project", "revision", "revision_order_number",
+                      "requester", "message", "author")
+        }
+        meta["task"] = args.task
+        with open(os.path.join(dest, "METADATA.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        print(f"source -> {dest}")
+
+    if args.artifacts:
+        files = call("GET", f"/rest/v2/tasks/{task_path}/artifacts")
+        if isinstance(files, dict) and "error" in files:
+            print(f"cannot list artifacts: {json.dumps(files)}",
+                  file=sys.stderr)
+            return 1
+        n = 0
+        for entry in files if isinstance(files, list) else []:
+            link, name = entry.get("link", ""), entry.get("name", "file")
+            target = os.path.join(dest, os.path.basename(name) or "file")
+            try:
+                if link.startswith(("http://", "https://")):
+                    with urllib.request.urlopen(link, timeout=30) as r, open(
+                        target, "wb"
+                    ) as f:
+                        shutil.copyfileobj(r, f)
+                elif os.path.exists(link):  # in-image pail/S3 bucket seam
+                    shutil.copy(link, target)
+                else:
+                    print(f"skip {name}: unreachable link {link!r}",
+                          file=sys.stderr)
+                    continue
+                n += 1
+            except OSError as e:
+                print(f"skip {name}: {e}", file=sys.stderr)
+        print(f"{n} artifact(s) -> {dest}")
     return 0
 
 
@@ -257,6 +356,25 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--finalize", action="store_true")
     pa.add_argument("--api-server", default="http://127.0.0.1:9090")
     pa.set_defaults(fn=cmd_patch)
+
+    lg = sub.add_parser(
+        "last-green",
+        help="most recent successful version for given variants",
+    )
+    lg.add_argument("--project", required=True)
+    lg.add_argument("--variants", required=True,
+                    help="comma-separated buildvariant names")
+    lg.add_argument("--api-server", default="http://127.0.0.1:9090")
+    lg.set_defaults(fn=cmd_last_green)
+
+    fe = sub.add_parser("fetch",
+                        help="download a task's source and/or artifacts")
+    fe.add_argument("--task", required=True)
+    fe.add_argument("--dir", default=".")
+    fe.add_argument("--source", action="store_true")
+    fe.add_argument("--artifacts", action="store_true")
+    fe.add_argument("--api-server", default="http://127.0.0.1:9090")
+    fe.set_defaults(fn=cmd_fetch)
 
     ad = sub.add_parser("admin", help="admin settings")
     ad.add_argument("action", choices=["get", "set-flag"])

@@ -495,3 +495,39 @@ def test_distro_put_rejects_bad_subsection_types(store, server):
         {"provider": "mock", "host_allocator_settings": {"version": ""}},
     )
     assert "invalid host_allocator_settings.version" in bad.get("error", "")
+
+
+def test_last_green_endpoint(store, server):
+    base, api = server
+    from evergreen_tpu.models import build as build_mod
+    from evergreen_tpu.models import version as version_mod
+    from evergreen_tpu.models.build import Build
+    from evergreen_tpu.models.version import Version
+
+    for i, builds in enumerate(
+        [{"lin": "success", "win": "success"},
+         {"lin": "success", "win": "failed"}]
+    ):
+        vid = f"lgv{i}"
+        version_mod.coll(store).upsert(
+            Version(id=vid, project="lgp", requester="gitter_request",
+                    revision_order_number=i).to_doc()
+        )
+        for bv, st in builds.items():
+            build_mod.coll(store).upsert(
+                Build(id=f"{vid}-{bv}", version=vid, build_variant=bv,
+                      status=st).to_doc()
+            )
+
+    comm = RestCommunicator(base)
+    # query-string params reach the handler (the gimlet ?variants= shape)
+    got = comm._call("GET", "/rest/v2/projects/lgp/last_green?variants=lin,win")
+    assert got["_id"] == "lgv0"
+    # newer version wins when only lin must be green
+    got = comm._call("GET", "/rest/v2/projects/lgp/last_green?variants=lin")
+    assert got["_id"] == "lgv1"
+    # no green → 404 error body, variants required → 400
+    assert "error" in comm._call(
+        "GET", "/rest/v2/projects/lgp/last_green?variants=mac")
+    assert "variants required" in comm._call(
+        "GET", "/rest/v2/projects/lgp/last_green").get("error", "")
