@@ -39,23 +39,52 @@ from .serial import RunningTaskEstimate
 
 
 def build_memberships(
-    distro: Distro, tasks: List[Task], base: int
-) -> Tuple[int, List[int], List[int]]:
-    """Snapshot-specialized unit grouping: returns
-    (n_units, membership task indices, membership unit indices).
+    distro: Distro,
+    tasks: List[Task],
+    base: int,
+    unit_base: int = 0,
+    di: int = 0,
+    named_base: int = 0,
+    t_seg_out=None,
+    deps_met: Dict[str, bool] = None,
+    t_dm_out=None,
+    want_group_keys: bool = True,
+) -> Tuple[int, bytes, bytes, List[str], List[str], List[int]]:
+    """Snapshot-specialized unit grouping + allocator segments: returns
+    (n_units, membership task indices, membership unit indices — both as
+    raw little-endian int32 bytes for np.frombuffer —, per-task group
+    keys, distinct segment names in first-seen order, per-segment
+    max-hosts). Unit indices are emitted with ``unit_base`` added; when
+    ``t_seg_out`` (a writable int32 buffer) is given, each task's final
+    global segment id is written in place — ``di`` (the distro's ""
+    segment) for ungrouped tasks, ``named_base`` + local ordinal for
+    grouped ones. When ``t_dm_out`` (writable uint8) is given, each
+    task's ``deps_met.get(id, True)`` lands there in the same pass. The
+    per-task group-keys list is skipped (``None`` in its slot) unless
+    ``want_group_keys`` — the snapshot discards it, segments carry the
+    same information.
 
     Semantics identical to serial.prepare_units (the oracle form of
     reference scheduler/planner.go:431-459) including unit creation ORDER —
     unit index is the planner's deterministic tie-break — but without
-    per-unit object allocation. The parity fuzzer pins the equivalence.
+    per-unit object allocation. The parity fuzzer pins the equivalence,
+    and the native evgpack implementation mirrors this function exactly.
     """
     group_versions = distro.planner_settings.group_versions
     key_to_unit: Dict[str, int] = {}   # group-string / version / task-id keys
     task_unit: Dict[str, int] = {}     # task id -> registered unit
     mem_by_task: List[List[int]] = []
     n_units = 0
+    group_keys: List[str] = []
+    seg_ord: Dict[str, int] = {}
+    seg_names: List[str] = []
+    seg_max: List[int] = []
 
-    for t in tasks:
+    for i, t in enumerate(tasks):
+        if t_dm_out is not None:
+            t_dm_out[i] = (
+                deps_met.get(t.id, True) if deps_met is not None else True
+            )
         units_of_t: List[int] = []
         if t.task_group:
             k = t.task_group_string()
@@ -72,18 +101,34 @@ def build_memberships(
                     n_units += 1
                 if v not in units_of_t:
                     units_of_t.append(v)
-        elif group_versions:
-            v = key_to_unit.get(t.version)
-            if v is None:
-                v = key_to_unit[t.version] = n_units
-                n_units += 1
-            units_of_t.append(v)
-            task_unit.setdefault(t.id, v)
+            if want_group_keys:
+                group_keys.append(k)
+            so = seg_ord.get(k)
+            if so is None:
+                so = seg_ord[k] = len(seg_names)
+                seg_names.append(k)
+                seg_max.append(0)
+            if seg_max[so] == 0 and t.task_group_max_hosts > 0:
+                seg_max[so] = t.task_group_max_hosts
+            if t_seg_out is not None:
+                t_seg_out[i] = named_base + so
         else:
-            u = n_units
-            n_units += 1
-            units_of_t.append(u)
-            task_unit[t.id] = u
+            if group_versions:
+                v = key_to_unit.get(t.version)
+                if v is None:
+                    v = key_to_unit[t.version] = n_units
+                    n_units += 1
+                units_of_t.append(v)
+                task_unit.setdefault(t.id, v)
+            else:
+                u = n_units
+                n_units += 1
+                units_of_t.append(u)
+                task_unit[t.id] = u
+            if want_group_keys:
+                group_keys.append("")
+            if t_seg_out is not None:
+                t_seg_out[i] = di
         mem_by_task.append(units_of_t)
 
     # dependency-closure pass: a task joins the unit registered under each
@@ -102,8 +147,15 @@ def build_memberships(
         ti = base + j
         for u in lst:
             m_task.append(ti)
-            m_unit.append(u)
-    return n_units, m_task, m_unit
+            m_unit.append(unit_base + u)
+    return (
+        n_units,
+        np.asarray(m_task, np.int32).tobytes(),
+        np.asarray(m_unit, np.int32).tobytes(),
+        group_keys if want_group_keys else None,
+        seg_names,
+        seg_max,
+    )
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
@@ -262,43 +314,67 @@ def build_snapshot(
     n_d = len(distros)
 
     # ---- flatten tasks + build planner unit memberships ------------------- #
+    # One pass per distro produces units, memberships AND allocator-segment
+    # assignments (native evgpack when available): segment layout is the n_d
+    # "" segments first (global seg id == distro index), then each distro's
+    # named task-group segments in first-seen order.
     flat_tasks: List[Task] = []
     t_distro: List[int] = []
-    m_task: List[int] = []
-    m_unit: List[int] = []
     u_distro: List[int] = []
     unit_base = 0
     from ..utils.native import get_evgpack
 
     evgpack = get_evgpack()
-    group_keys: List[str] = []
+    n_t_total = sum(len(tasks_by_distro.get(d.id, [])) for d in distros)
+    t_seg_np = np.zeros(max(n_t_total, 1), np.int32)
+    t_dm_np = np.ones(max(n_t_total, 1), np.uint8)
+    m_task_parts: List[np.ndarray] = []
+    m_unit_parts: List[np.ndarray] = []
+    seg_names: List[Tuple[int, str]] = [(di, "") for di in range(n_d)]
+    seg_max_hosts_l: List[int] = [0] * n_d
+    named_base = n_d
+    fn = evgpack.build_memberships if evgpack is not None else None
     for d in distros:
         tasks = tasks_by_distro.get(d.id, [])
         base = len(flat_tasks)
-        if evgpack is not None:
-            n_units_d, mt, mu, gkeys = evgpack.build_memberships(
-                tasks, bool(d.planner_settings.group_versions), base
-            )
-            group_keys.extend(gkeys)
-        else:
-            n_units_d, mt, mu = build_memberships(d, tasks, base)
-            group_keys.extend(
-                t.task_group_string() if t.task_group else "" for t in tasks
-            )
         di = d_index[d.id]
+        seg_slice = t_seg_np[base:base + len(tasks)]
+        dm_slice = t_dm_np[base:base + len(tasks)]
+        if fn is not None:
+            n_units_d, mt, mu, _gkeys, snames, smax = fn(
+                tasks, bool(d.planner_settings.group_versions), base,
+                unit_base, di, named_base, seg_slice, deps_met, dm_slice,
+                False,
+            )
+        else:
+            n_units_d, mt, mu, _gkeys, snames, smax = build_memberships(
+                d, tasks, base, unit_base, di, named_base, seg_slice,
+                deps_met, dm_slice, False,
+            )
+        seg_names.extend((di, nm) for nm in snames)
+        seg_max_hosts_l.extend(smax)
+        named_base += len(snames)
         flat_tasks.extend(tasks)
         t_distro.extend([di] * len(tasks))
         u_distro.extend([di] * n_units_d)
-        m_task.extend(mt)
-        m_unit.extend(mu if unit_base == 0 else [u + unit_base for u in mu])
+        m_task_parts.append(np.frombuffer(mt, np.int32))
+        m_unit_parts.append(np.frombuffer(mu, np.int32))
         unit_base += n_units_d
 
+    m_task = (
+        np.concatenate(m_task_parts) if m_task_parts
+        else np.empty(0, np.int32)
+    )
+    m_unit = (
+        np.concatenate(m_unit_parts) if m_unit_parts
+        else np.empty(0, np.int32)
+    )
     n_t, n_m, n_u = len(flat_tasks), len(m_task), len(u_distro)
 
-    # ---- allocator segments: one "" segment per distro + named groups ----- #
-    seg_index: Dict[Tuple[int, str], int] = {}
-    seg_names: List[Tuple[int, str]] = []
-    seg_max_hosts_l: List[int] = []
+    # ---- hosts (may introduce segments no queued task names) -------------- #
+    seg_index: Dict[Tuple[int, str], int] = {
+        key: idx for idx, key in enumerate(seg_names)
+    }
 
     def seg_for(di: int, name: str, max_hosts: int = 0) -> int:
         key = (di, name)
@@ -312,20 +388,6 @@ def build_snapshot(
             seg_max_hosts_l[idx] = max_hosts
         return idx
 
-    for di in range(n_d):
-        seg_for(di, "")
-
-    # ungrouped tasks (the majority) map to their distro's "" segment,
-    # which by construction IS segment index di — no lookup needed
-    t_seg: List[int] = [0] * n_t
-    for i, t in enumerate(flat_tasks):
-        key = group_keys[i]
-        if key:
-            t_seg[i] = seg_for(t_distro[i], key, t.task_group_max_hosts)
-        else:
-            t_seg[i] = t_distro[i]
-
-    # ---- hosts ------------------------------------------------------------ #
     flat_hosts: List[Host] = []
     h_distro: List[int] = []
     h_seg: List[int] = []
@@ -439,8 +501,8 @@ def build_snapshot(
             dur > 0.0, dur, float(DEFAULT_TASK_DURATION_S)
         )
         fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
-    fill("t_deps_met", [deps_met.get(t.id, True) for t in flat_tasks])
-    fill("t_seg", t_seg, pad=G - 1)
+    fill("t_deps_met", t_dm_np[:n_t].view(np.bool_))
+    fill("t_seg", t_seg_np[:n_t], pad=G - 1)
 
     # memberships (padding points at dummy task N-1 / unit U-1)
     fill("m_task", m_task, pad=N - 1)

@@ -218,7 +218,23 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
   PyObject* tasks;
   int group_versions;
   Py_ssize_t base = 0;
-  if (!PyArg_ParseTuple(args, "Op|n", &tasks, &group_versions, &base)) {
+  Py_ssize_t unit_base = 0;
+  Py_ssize_t di = 0;
+  Py_ssize_t named_base = 0;
+  PyObject* t_seg_out = nullptr;
+  PyObject* deps_met = nullptr;
+  PyObject* t_dm_out = nullptr;
+  int want_group_keys = 1;
+  if (!PyArg_ParseTuple(args, "Op|nnnnOOOp", &tasks, &group_versions, &base,
+                        &unit_base, &di, &named_base, &t_seg_out, &deps_met,
+                        &t_dm_out, &want_group_keys)) {
+    return nullptr;
+  }
+  if (deps_met == Py_None) deps_met = nullptr;
+  if (deps_met != nullptr && !PyDict_Check(deps_met)) {
+    // a silent all-met fallback here would schedule blocked tasks;
+    // non-dict mappings must go through the Python path instead
+    PyErr_SetString(PyExc_TypeError, "deps_met must be a dict or None");
     return nullptr;
   }
   PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
@@ -231,6 +247,9 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
   static PyObject* s_project = PyUnicode_InternFromString("project");
   static PyObject* s_depends_on = PyUnicode_InternFromString("depends_on");
   static PyObject* s_task_id = PyUnicode_InternFromString("task_id");
+  static PyObject* s_tg_max_hosts =
+      PyUnicode_InternFromString("task_group_max_hosts");
+  static PyObject* s_empty = PyUnicode_InternFromString("");
 
   struct Scope {
     PyObject* seq;
@@ -258,14 +277,77 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
   std::vector<std::string> task_ids(n);
   int32_t n_units = 0;
 
-  PyObject* group_keys = PyList_New(n);
+  // allocator segments: ordinal per distinct group string (first-seen
+  // order), final global seg id = named_base + ordinal for grouped tasks
+  // and di (the distro's "" segment) for ungrouped ones — the same
+  // assignment the snapshot's seg_for loop produced in Python
+  std::unordered_map<std::string, int32_t> seg_ord;
+  std::vector<PyObject*> seg_name_objs;  // owns one ref each until output
+  std::vector<long> seg_max;
+  std::vector<int32_t> seg_vec(n);
+
+  // optional dependency-met column: deps_met.get(task.id, True) written
+  // straight into the caller's uint8 buffer (folds the snapshot's 50k-item
+  // dict-lookup comprehension into this pass)
+  uint8_t* dm_buf = nullptr;
+  Py_buffer dm_view{};
+  if (t_dm_out != nullptr && t_dm_out != Py_None && n > 0) {
+    if (PyObject_GetBuffer(t_dm_out, &dm_view,
+                           PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) != 0) {
+      return nullptr;
+    }
+    if (dm_view.itemsize != 1 || dm_view.len < n) {
+      PyBuffer_Release(&dm_view);
+      PyErr_SetString(PyExc_ValueError,
+                      "t_dm_out must be a writable uint8 buffer of >= n");
+      return nullptr;
+    }
+    dm_buf = static_cast<uint8_t*>(dm_view.buf);
+  }
+  struct DmScope {
+    Py_buffer* view;
+    uint8_t* buf;
+    ~DmScope() {
+      if (buf != nullptr) PyBuffer_Release(view);
+    }
+  } dm_scope{&dm_view, dm_buf};
+
+  // group_keys is optional output: the snapshot's production path discards
+  // it (segments carry the same information), so skip the n-element list
+  // and its per-task increfs unless asked for
+  PyObject* group_keys = want_group_keys ? PyList_New(n) : Py_None;
   if (group_keys == nullptr) return nullptr;
+  if (!want_group_keys) Py_INCREF(group_keys);
 
   bool good = true;
   for (Py_ssize_t i = 0; good && i < n; ++i) {
     PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
     PyObject* tg = PyObject_GetAttr(t, s_task_group);
     PyObject* tid = PyObject_GetAttr(t, s_id);
+    if (dm_buf != nullptr && tid != nullptr) {
+      if (deps_met != nullptr) {
+        PyObject* got = PyDict_GetItemWithError(deps_met, tid);  // borrowed
+        if (got == nullptr && PyErr_Occurred()) {
+          Py_XDECREF(tg);
+          Py_DECREF(tid);
+          good = false;
+          break;
+        }
+        int truth = 1;
+        if (got != nullptr) {
+          truth = PyObject_IsTrue(got);
+          if (truth < 0) {  // __bool__ raised
+            Py_XDECREF(tg);
+            Py_DECREF(tid);
+            good = false;
+            break;
+          }
+        }
+        dm_buf[i] = truth ? 1 : 0;
+      } else {
+        dm_buf[i] = 1;
+      }
+    }
     const char* tg_c = nullptr;
     const char* tid_c = nullptr;
     if (!as_utf8(tg, "task_group", &tg_c) || !as_utf8(tid, "id", &tid_c)) {
@@ -316,8 +398,40 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
           }
           if (v != u) units_of_t.push_back(v);
         }
-        group_key_obj = PyUnicode_FromString(key.c_str());
-        if (group_key_obj == nullptr) good = false;
+        auto sit = seg_ord.find(key);
+        int32_t so;
+        if (sit == seg_ord.end()) {
+          so = static_cast<int32_t>(seg_name_objs.size());
+          seg_ord.emplace(key, so);
+          PyObject* name_obj = PyUnicode_FromString(key.c_str());
+          if (name_obj == nullptr) {
+            good = false;
+          } else {
+            seg_name_objs.push_back(name_obj);
+            seg_max.push_back(0);
+          }
+        } else {
+          so = sit->second;
+        }
+        if (good) {
+          seg_vec[i] = static_cast<int32_t>(named_base) + so;
+          // first task with a nonzero group max-hosts wins (seg_for)
+          if (seg_max[so] == 0) {
+            PyObject* mh = PyObject_GetAttr(t, s_tg_max_hosts);
+            if (mh == nullptr) {
+              good = false;
+            } else {
+              const long v = PyLong_AsLong(mh);
+              if (v == -1 && PyErr_Occurred()) good = false;
+              else if (v > 0) seg_max[so] = v;
+              Py_DECREF(mh);
+            }
+          }
+        }
+        if (good && want_group_keys) {
+          group_key_obj = seg_name_objs[so];
+          Py_INCREF(group_key_obj);
+        }
       } else {
         good = false;
       }
@@ -350,11 +464,14 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
       units_of_t.push_back(u);
       task_unit.emplace(task_ids[i], u);
     }
-    if (good && group_key_obj == nullptr) {
-      group_key_obj = PyUnicode_FromString("");
-      if (group_key_obj == nullptr) good = false;
+    if (good && !grouped) {
+      seg_vec[i] = static_cast<int32_t>(di);  // the distro's "" segment
+      if (want_group_keys) {
+        group_key_obj = s_empty;
+        Py_INCREF(group_key_obj);
+      }
     }
-    if (good) {
+    if (good && want_group_keys) {
       PyList_SET_ITEM(group_keys, i, group_key_obj);  // steals
     } else {
       Py_XDECREF(group_key_obj);
@@ -412,46 +529,91 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
 
   if (!good) {
     Py_DECREF(group_keys);
+    for (PyObject* o : seg_name_objs) Py_DECREF(o);
     if (!PyErr_Occurred()) {
       PyErr_SetString(PyExc_TypeError, "malformed task objects");
     }
     return nullptr;
   }
 
+  // final per-task segment ids straight into the caller's int32 buffer
+  if (t_seg_out != nullptr && t_seg_out != Py_None && n > 0) {
+    Py_buffer buf{};
+    if (PyObject_GetBuffer(t_seg_out, &buf,
+                           PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) != 0) {
+      Py_DECREF(group_keys);
+      for (PyObject* o : seg_name_objs) Py_DECREF(o);
+      return nullptr;
+    }
+    if (buf.itemsize != 4 ||
+        buf.len < n * static_cast<Py_ssize_t>(sizeof(int32_t))) {
+      PyBuffer_Release(&buf);
+      Py_DECREF(group_keys);
+      for (PyObject* o : seg_name_objs) Py_DECREF(o);
+      PyErr_SetString(PyExc_ValueError,
+                      "t_seg_out must be a writable int32 buffer of >= n");
+      return nullptr;
+    }
+    memcpy(buf.buf, seg_vec.data(), n * sizeof(int32_t));
+    PyBuffer_Release(&buf);
+  }
+
+  // memberships as raw int32 little-endian bytes: np.frombuffer on the
+  // Python side — no 2×M PyLong allocations crossing the boundary
   size_t total = 0;
   for (auto& lst : mem_by_task) total += lst.size();
-  PyObject* m_task = PyList_New(static_cast<Py_ssize_t>(total));
-  PyObject* m_unit = PyList_New(static_cast<Py_ssize_t>(total));
+  std::vector<int32_t> mt_vec(total);
+  std::vector<int32_t> mu_vec(total);
+  size_t k = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    for (int32_t u : mem_by_task[i]) {
+      mt_vec[k] = static_cast<int32_t>(base + i);
+      mu_vec[k] = static_cast<int32_t>(unit_base) + u;
+      ++k;
+    }
+  }
+  PyObject* m_task = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(mt_vec.data()),
+      static_cast<Py_ssize_t>(total * sizeof(int32_t)));
+  PyObject* m_unit = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(mu_vec.data()),
+      static_cast<Py_ssize_t>(total * sizeof(int32_t)));
   if (m_task == nullptr || m_unit == nullptr) {
     Py_XDECREF(m_task);
     Py_XDECREF(m_unit);
     Py_DECREF(group_keys);
+    for (PyObject* o : seg_name_objs) Py_DECREF(o);
     return nullptr;
   }
-  Py_ssize_t k = 0;
-  for (Py_ssize_t i = 0; good && i < n; ++i) {
-    for (int32_t u : mem_by_task[i]) {
-      PyObject* a = PyLong_FromSsize_t(base + i);
-      PyObject* b = PyLong_FromLong(u);
-      if (a == nullptr || b == nullptr) {
-        Py_XDECREF(a);
-        Py_XDECREF(b);
-        good = false;
-        break;
-      }
-      PyList_SET_ITEM(m_task, k, a);
-      PyList_SET_ITEM(m_unit, k, b);
-      ++k;
-    }
-  }
-  if (!good) {
+  const Py_ssize_t n_segs = static_cast<Py_ssize_t>(seg_name_objs.size());
+  PyObject* seg_names = PyList_New(n_segs);
+  PyObject* seg_max_out = PyList_New(n_segs);
+  if (seg_names == nullptr || seg_max_out == nullptr) {
+    Py_XDECREF(seg_names);
+    Py_XDECREF(seg_max_out);
     Py_DECREF(m_task);
     Py_DECREF(m_unit);
     Py_DECREF(group_keys);
-    if (!PyErr_Occurred()) PyErr_NoMemory();
+    for (PyObject* o : seg_name_objs) Py_DECREF(o);
     return nullptr;
   }
-  return Py_BuildValue("iNNN", n_units, m_task, m_unit, group_keys);
+  for (Py_ssize_t s = 0; s < n_segs; ++s) {
+    PyList_SET_ITEM(seg_names, s, seg_name_objs[s]);  // steals creation ref
+  }
+  for (Py_ssize_t s = 0; s < n_segs; ++s) {
+    PyObject* mh = PyLong_FromLong(seg_max[s]);
+    if (mh == nullptr) {
+      Py_DECREF(seg_names);  // owns every name ref now
+      Py_DECREF(seg_max_out);
+      Py_DECREF(m_task);
+      Py_DECREF(m_unit);
+      Py_DECREF(group_keys);
+      return nullptr;
+    }
+    PyList_SET_ITEM(seg_max_out, s, mh);
+  }
+  return Py_BuildValue("iNNNNN", n_units, m_task, m_unit, group_keys,
+                       seg_names, seg_max_out);
 }
 
 PyMethodDef kMethods[] = {

@@ -71,7 +71,78 @@ def test_native_error_paths_raise_not_crash():
         m.build_memberships([surrogate], False, 0)
     none_deps = Task(id="w")
     none_deps.depends_on = None
-    assert m.build_memberships([none_deps], False, 0) == (1, [0], [0], [""])
-    # base offsets are emitted natively
-    out = m.build_memberships([Task(id="a"), Task(id="b")], False, 7)
-    assert out[1] == [7, 8]
+    out = m.build_memberships([none_deps], False, 0)
+    assert out[0] == 1 and out[3:] == ([""], [], [])
+    assert np.frombuffer(out[1], np.int32).tolist() == [0]
+    assert np.frombuffer(out[2], np.int32).tolist() == [0]
+    # base offsets are emitted natively (tasks and units)
+    out = m.build_memberships([Task(id="a"), Task(id="b")], False, 7, 3)
+    assert np.frombuffer(out[1], np.int32).tolist() == [7, 8]
+    assert np.frombuffer(out[2], np.int32).tolist() == [3, 4]
+
+
+def test_native_segment_assignment(store):
+    """Grouped tasks get named_base+ordinal segments, ungrouped get di;
+    first nonzero group max-hosts wins; native == python fallback."""
+    import evergreen_tpu.scheduler.snapshot as snap
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.task import Task
+
+    m = native.get_evgpack()
+    if m is None:
+        pytest.skip("g++ toolchain unavailable")
+    tasks = [
+        Task(id="a", task_group="g1", build_variant="bv", project="p",
+             version="v", task_group_max_hosts=0),
+        Task(id="b"),
+        Task(id="c", task_group="g1", build_variant="bv", project="p",
+             version="v", task_group_max_hosts=5),
+        Task(id="d", task_group="g2", build_variant="bv", project="p",
+             version="v", task_group_max_hosts=2),
+    ]
+    seg_native = np.zeros(4, np.int32)
+    rn = m.build_memberships(tasks, False, 0, 0, 3, 10, seg_native)
+    seg_py = np.zeros(4, np.int32)
+    rp = snap.build_memberships(Distro(id="d"), tasks, 0, 0, 3, 10, seg_py)
+    assert rn == rp
+    np.testing.assert_array_equal(seg_native, seg_py)
+    np.testing.assert_array_equal(seg_native, [10, 3, 10, 11])
+    # g1's max-hosts comes from the first task with a nonzero value
+    assert rn[5] == [5, 2]
+
+
+def test_native_deps_met_column(store):
+    """The deps-met column written in the same native pass equals the
+    dict-comprehension form, with missing ids defaulting to True."""
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.task import Task
+    import evergreen_tpu.scheduler.snapshot as snap
+
+    m = native.get_evgpack()
+    if m is None:
+        pytest.skip("g++ toolchain unavailable")
+    tasks = [Task(id=f"t{i}") for i in range(4)]
+    dm = {"t0": True, "t1": False, "t3": False}
+    out_native = np.ones(4, np.uint8)
+    m.build_memberships(tasks, False, 0, 0, 0, 1, None, dm, out_native)
+    out_py = np.ones(4, np.uint8)
+    snap.build_memberships(Distro(id="d"), tasks, 0, 0, 0, 1, None, dm,
+                           out_py)
+    np.testing.assert_array_equal(out_native, out_py)
+    np.testing.assert_array_equal(out_native, [1, 0, 1, 0])
+
+
+def test_native_deps_met_rejects_non_dict_mapping(store):
+    """A non-dict mapping must raise, not silently mark all deps met."""
+    import collections
+
+    from evergreen_tpu.models.task import Task
+
+    m = native.get_evgpack()
+    if m is None:
+        pytest.skip("g++ toolchain unavailable")
+    with pytest.raises(TypeError):
+        m.build_memberships(
+            [Task(id="a")], False, 0, 0, 0, 1, None,
+            collections.ChainMap({"a": False}), np.ones(1, np.uint8),
+        )
