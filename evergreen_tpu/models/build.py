@@ -38,8 +38,11 @@ class Build:
     def from_doc(cls, doc: dict) -> "Build":
         doc = dict(doc)
         doc["id"] = doc.pop("_id")
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = _BUILD_FIELDS  # fields() per doc is hot-loop cost
         return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+_BUILD_FIELDS = frozenset(f.name for f in dataclasses.fields(Build))
 
 
 def coll(store: Store) -> Collection:

@@ -119,8 +119,11 @@ class Distro:
         ):
             if isinstance(doc.get(key), dict):
                 doc[key] = sub(**doc[key])
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = _DISTRO_FIELDS  # fields() per doc is hot-loop cost
         return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+_DISTRO_FIELDS = frozenset(f.name for f in dataclasses.fields(Distro))
 
 
 def coll(store: Store) -> Collection:

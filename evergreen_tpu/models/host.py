@@ -104,8 +104,11 @@ class Host:
     def from_doc(cls, doc: dict) -> "Host":
         doc = dict(doc)
         doc["id"] = doc.pop("_id")
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = _HOST_FIELDS  # fields() per doc is hot-loop cost
         return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+_HOST_FIELDS = frozenset(f.name for f in dataclasses.fields(Host))
 
 
 def new_intent(distro_id: str, provider: str) -> Host:

@@ -42,8 +42,11 @@ class Version:
     def from_doc(cls, doc: dict) -> "Version":
         doc = dict(doc)
         doc["id"] = doc.pop("_id")
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = _VERSION_FIELDS  # fields() per doc is hot-loop cost
         return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+_VERSION_FIELDS = frozenset(f.name for f in dataclasses.fields(Version))
 
 
 def coll(store: Store) -> Collection:

@@ -193,6 +193,9 @@ class Snapshot:
     #: named views into the transfer arenas (bool fields exposed as bool)
     arrays: Dict[str, np.ndarray]
     arena: Arena = None
+    #: the task objects in flat (task_ids) order — lets result unpacking
+    #: index tasks positionally instead of round-tripping through id dicts
+    flat_tasks: List[Task] = None
 
     def shape_key(self) -> Tuple[int, ...]:
         a = self.arrays
@@ -569,4 +572,5 @@ def build_snapshot(
         n_distros=n_d,
         arrays=a,
         arena=arena,
+        flat_tasks=flat_tasks,
     )

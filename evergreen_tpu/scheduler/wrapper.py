@@ -180,28 +180,32 @@ def gather_tick_inputs(
 def _unpack_solve(
     snapshot: Snapshot,
     out: Dict[str, np.ndarray],
-    tasks_by_distro: Dict[str, List[Task]],
 ) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, DistroQueueInfo], Dict[str, int]]:
     """Device outputs → per-distro ordered plans, sort values, queue infos,
     spawn counts."""
-    by_id: Dict[str, Task] = {}
-    for tasks in tasks_by_distro.values():
-        for t in tasks:
-            by_id[t.id] = t
-
-    order = out["order"]
-    t_value = out["t_value"]
+    flat = snapshot.flat_tasks
     n = snapshot.n_tasks
-    plans: Dict[str, List[Task]] = {d: [] for d in snapshot.distro_ids}
-    sort_values: Dict[str, Dict[str, float]] = {d: {} for d in snapshot.distro_ids}
-    t_distro = snapshot.arrays["t_distro"]
-    for idx in order:
-        if idx >= n:
-            continue
-        tid = snapshot.task_ids[idx]
-        did = snapshot.distro_ids[t_distro[idx]]
-        plans[did].append(by_id[tid])
-        sort_values[did][tid] = float(t_value[idx])
+    task_ids = snapshot.task_ids
+    # The solve's first sort key is the distro index, so the returned order
+    # is already segmented distro by distro: drop padding, then slice per
+    # distro — no per-element Python loop over the padded [N] array.
+    order = np.asarray(out["order"])
+    real = order[order < n]
+    t_distro = np.asarray(snapshot.arrays["t_distro"])
+    dpd = t_distro[real]
+    vals = np.asarray(out["t_value"])[real].astype(float)
+    bounds = np.searchsorted(dpd, np.arange(len(snapshot.distro_ids) + 1))
+    ro = real.tolist()
+    vl = vals.tolist()
+    plans: Dict[str, List[Task]] = {}
+    sort_values: Dict[str, Dict[str, float]] = {}
+    for di, did in enumerate(snapshot.distro_ids):
+        lo, hi = int(bounds[di]), int(bounds[di + 1])
+        seg = ro[lo:hi]
+        plans[did] = [flat[i] for i in seg]
+        sort_values[did] = dict(
+            zip((task_ids[i] for i in seg), vl[lo:hi])
+        )
 
     # per-segment TaskGroupInfos
     seg_infos: Dict[int, List[TaskGroupInfo]] = {}
@@ -305,7 +309,7 @@ def run_tick(
         snapshot_ms = (t2 - t1) * 1e3
         solve_ms = (t3 - t2) * 1e3
         plans, sort_values, infos, new_hosts = _unpack_solve(
-            snapshot, out, tasks_by_distro
+            snapshot, out
         )
     elif solver_distros:
         results = serial.serial_tick(
