@@ -130,6 +130,32 @@ class Task:
 
     # -- identity ----------------------------------------------------------- #
 
+    def queue_row(self) -> tuple:
+        """The queue-persist columns that never change for a materialized
+        task (scheduler/persister.py), memoized per instance: the
+        incremental TickCache replaces changed docs with NEW Task objects,
+        so an unchanged task pays the 13-attribute extraction once across
+        all its ticks, not once per tick."""
+        row = self.__dict__.get("_qrow")
+        if row is None:
+            row = self.__dict__["_qrow"] = (
+                self.id,
+                self.display_name,
+                self.build_variant,
+                self.project,
+                self.version,
+                self.requester,
+                self.revision_order_number,
+                self.priority,
+                self.task_group,
+                self.task_group_max_hosts,
+                self.task_group_order,
+                self.expected_duration_s,
+                self.num_dependents,
+                [d.task_id for d in self.depends_on],
+            )
+        return row
+
     def task_group_string(self) -> str:
         """Unit key for task-group members (reference
         model/task/task.go GetTaskGroupString): group _ variant _ project _ version."""

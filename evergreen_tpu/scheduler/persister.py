@@ -36,23 +36,34 @@ def persist_task_queue(
     cut = _cap_cut(plan, max_scheduled_per_distro)
     if cut < n:
         plan = plan[:cut]
+    # static per-task columns come from Task.queue_row (memoized on the
+    # instance — under the incremental cache an unchanged task extracts
+    # its 13 attributes once, ever) and transpose in C via zip; only
+    # sort_value and dependencies_met are recomputed each tick.
+    (ids, display_names, build_variants, projects, versions,
+     requesters, revision_orders, priorities, task_groups,
+     group_max_hosts, group_orders, expected_durations,
+     num_dependents, dependencies) = (
+        (list(c) for c in zip(*[t.queue_row() for t in plan]))
+        if plan else ([] for _ in range(14))
+    )
     cols = {
-        "id": [t.id for t in plan],
-        "display_name": [t.display_name for t in plan],
-        "build_variant": [t.build_variant for t in plan],
-        "project": [t.project for t in plan],
-        "version": [t.version for t in plan],
-        "requester": [t.requester for t in plan],
-        "revision_order_number": [t.revision_order_number for t in plan],
-        "priority": [t.priority for t in plan],
-        "sort_value": [sort_values.get(t.id, 0.0) for t in plan],
-        "task_group": [t.task_group for t in plan],
-        "task_group_max_hosts": [t.task_group_max_hosts for t in plan],
-        "task_group_order": [t.task_group_order for t in plan],
-        "expected_duration_s": [t.expected_duration_s for t in plan],
-        "num_dependents": [t.num_dependents for t in plan],
-        "dependencies": [[d.task_id for d in t.depends_on] for t in plan],
-        "dependencies_met": [deps_met.get(t.id, True) for t in plan],
+        "id": ids,
+        "display_name": display_names,
+        "build_variant": build_variants,
+        "project": projects,
+        "version": versions,
+        "requester": requesters,
+        "revision_order_number": revision_orders,
+        "priority": priorities,
+        "sort_value": [sort_values.get(i, 0.0) for i in ids],
+        "task_group": task_groups,
+        "task_group_max_hosts": group_max_hosts,
+        "task_group_order": group_orders,
+        "expected_duration_s": expected_durations,
+        "num_dependents": num_dependents,
+        "dependencies": dependencies,
+        "dependencies_met": [deps_met.get(i, True) for i in ids],
     }
     info_doc = {
         **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},

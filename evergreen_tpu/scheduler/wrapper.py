@@ -349,6 +349,12 @@ def run_tick(
             new_hosts[d.id] = n_new
             sort_values[d.id] = {}
 
+    # Alias rows plan queues but never allocate hosts (the reference's
+    # alias scheduler has no allocator job, units/scheduler_alias.go) —
+    # drop their solve outputs from the reported spawn counts.
+    for k in [k for k in new_hosts if k.endswith(ALIAS_SUFFIX)]:
+        del new_hosts[k]
+
     # Single-task distros allocate 1:1 with dependency-met tasks (reference
     # units/host_allocator.go:174-181), bypassing the utilization heuristic.
     for d in distros:
