@@ -144,14 +144,21 @@ def find(store: Store, pred=None) -> List[Host]:
     return [Host.from_doc(d) for d in coll(store).find(pred)]
 
 
+def is_active_host_doc(doc: dict) -> bool:
+    """The allocator's capacity predicate at doc level — the ONE
+    definition shared by the cold scan below and the TickCache's warm
+    host map (scheduler/cache.py), so warm/cold parity cannot drift."""
+    return (
+        doc["status"] in HOST_ACTIVE_STATUSES and doc["started_by"] == "mci"
+    )
+
+
 def all_active_hosts(store: Store, distro_id: str = "") -> List[Host]:
     """Capacity view for the allocator (reference host.AllActiveHosts via
     units/host_allocator.go:152): system-owned hosts in an active state."""
 
     def pred(doc: dict) -> bool:
-        if doc["status"] not in HOST_ACTIVE_STATUSES:
-            return False
-        if doc["started_by"] != "mci":
+        if not is_active_host_doc(doc):
             return False
         if distro_id and doc["distro_id"] != distro_id:
             return False

@@ -22,7 +22,9 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..globals import TaskStatus
+from ..models import host as host_mod
 from ..models import task as task_mod
+from ..models.host import Host, is_active_host_doc
 from ..models.task import Task
 from ..storage.store import Store
 
@@ -37,11 +39,23 @@ class TickCache:
         #: runnable task id → materialized Task
         self._runnable: Dict[str, Task] = {}
         task_mod.coll(store).add_listener(self._on_task_change)
+        #: active host id → materialized Host (same dirty-tracking scheme
+        #: over the hosts collection: assignments/terminations churn a few
+        #: hosts per tick, not the 4k-host capacity view)
+        self._hosts_dirty: Set[str] = set()
+        self._hosts_primed = False
+        self._active_hosts: Dict[str, Host] = {}
+        host_mod.coll(store).add_listener(self._on_host_change)
 
     # Runs under the collection lock; touch only the leaf dirty lock.
     def _on_task_change(self, task_id: str) -> None:
         with self._dirty_lock:
             self._dirty.add(task_id)
+
+    # Runs under the collection lock; touch only the leaf dirty lock.
+    def _on_host_change(self, host_id: str) -> None:
+        with self._dirty_lock:
+            self._hosts_dirty.add(host_id)
 
     def _qualifies(self, doc: Optional[dict]) -> bool:
         if doc is None:
@@ -83,6 +97,44 @@ class TickCache:
                     n += 1
             return n
 
+    def _host_qualifies(self, doc: Optional[dict]) -> bool:
+        return doc is not None and is_active_host_doc(doc)
+
+    def apply_hosts_dirty(self) -> int:
+        """Fold pending host changes into the active-host map."""
+        with self._lock:
+            if not self._hosts_primed:
+                with self._dirty_lock:
+                    self._hosts_dirty.clear()
+                self._active_hosts = {
+                    h.id: h for h in host_mod.all_active_hosts(self.store)
+                }
+                self._hosts_primed = True
+                return len(self._active_hosts)
+            with self._dirty_lock:
+                dirty = self._hosts_dirty
+                self._hosts_dirty = set()
+            coll = host_mod.coll(self.store)
+            n = 0
+            for hid in dirty:
+                doc = coll.get(hid)
+                if self._host_qualifies(doc):
+                    self._active_hosts[hid] = Host.from_doc(doc)
+                    n += 1
+                elif hid in self._active_hosts:
+                    del self._active_hosts[hid]
+                    n += 1
+            return n
+
+    def active_hosts_in_store_order(self) -> List[Host]:
+        """The warm capacity view, in cold-scan (store key) order."""
+        self.apply_hosts_dirty()
+        order = host_mod.coll(self.store).key_order()
+        with self._lock:
+            hosts = list(self._active_hosts.values())
+        hosts.sort(key=lambda h: order.get(h.id, 1 << 60))
+        return hosts
+
     def runnable_in_store_order(self) -> List[Task]:
         """The warm runnable set, ordered exactly as a cold collection scan
         would emit it (value-tied tasks break ties by input position in the
@@ -100,7 +152,10 @@ class TickCache:
         from .wrapper import gather_tick_inputs
 
         return gather_tick_inputs(
-            self.store, now, runnable_tasks=self.runnable_in_store_order()
+            self.store,
+            now,
+            runnable_tasks=self.runnable_in_store_order(),
+            active_hosts=self.active_hosts_in_store_order(),
         )
 
     def runnable_count(self) -> int:

@@ -94,6 +94,7 @@ def gather_tick_inputs(
     store: Store,
     now: float,
     runnable_tasks: Optional[List[Task]] = None,
+    active_hosts: Optional[List[Host]] = None,
 ) -> Tuple[
     List[Distro],
     Dict[str, List[Task]],
@@ -104,9 +105,9 @@ def gather_tick_inputs(
     """Read the store into solver inputs: runnable tasks per distro, active
     hosts per distro, running-task duration estimates, dep-met mask.
 
-    ``runnable_tasks`` lets the incremental TickCache supply the warm
-    runnable set (already in store order); when absent, the cold-path
-    finder scans the collection (scheduler/task_finder.go:34-36 analog) —
+    ``runnable_tasks`` / ``active_hosts`` let the incremental TickCache
+    supply warm sets (already in store order); when absent, the cold-path
+    finders scan the collections (scheduler/task_finder.go:34-36 analog) —
     never the full task history.
     """
     # The snapshot covers the allocator's distro set (a superset that
@@ -156,9 +157,9 @@ def gather_tick_inputs(
     deps_met = compute_deps_met(runnable, finished_status)
 
     hosts_by_distro: Dict[str, List[Host]] = {d.id: [] for d in distros}
-    active_hosts = [
-        h for h in host_mod.all_active_hosts(store) if h.distro_id in all_ids
-    ]
+    if active_hosts is None:
+        active_hosts = host_mod.all_active_hosts(store)
+    active_hosts = [h for h in active_hosts if h.distro_id in all_ids]
     running_ids = [h.running_task for h in active_hosts if h.running_task]
     running_docs = {d["_id"]: d for d in coll.find_ids(running_ids)}
     running_estimates: Dict[str, serial.RunningTaskEstimate] = {}

@@ -29,6 +29,12 @@ class Collection:
         #: doc. Callbacks MUST be trivial (set a dirty flag) — they run
         #: under the collection lock.
         self._listeners: List[Callable[[str], None]] = []
+        #: memoized id → monotonic insertion rank, maintained incrementally:
+        #: consumers only SORT by it, so ranks need monotonicity, not
+        #: contiguity — inserts append the next counter value and removals
+        #: just drop the key (relative order of survivors is unchanged).
+        self._key_order_cache: Optional[Dict[str, int]] = None
+        self._order_rank = 0
 
     def add_listener(self, fn: Callable[[str], None]) -> None:
         with self._lock:
@@ -46,20 +52,33 @@ class Collection:
             if doc_id in self._docs:
                 raise KeyError(f"duplicate _id {doc_id!r} in {self.name}")
             self._docs[doc_id] = doc
+            if self._key_order_cache is not None:
+                self._key_order_cache[doc_id] = self._order_rank
+            self._order_rank += 1
             self._notify(doc_id)
 
     def upsert(self, doc: dict) -> None:
         with self._lock:
+            if doc["_id"] not in self._docs:
+                if self._key_order_cache is not None:
+                    self._key_order_cache[doc["_id"]] = self._order_rank
+                self._order_rank += 1
             self._docs[doc["_id"]] = doc
             self._notify(doc["_id"])
 
     def insert_many(self, docs: Iterable[dict]) -> None:
+        docs = list(docs)  # may be a generator; two passes below
         with self._lock:
+            seen = set()
             for doc in docs:
-                if doc["_id"] in self._docs:
+                if doc["_id"] in self._docs or doc["_id"] in seen:
                     raise KeyError(f"duplicate _id {doc['_id']!r} in {self.name}")
+                seen.add(doc["_id"])
             for doc in docs:
                 self._docs[doc["_id"]] = doc
+                if self._key_order_cache is not None:
+                    self._key_order_cache[doc["_id"]] = self._order_rank
+                self._order_rank += 1
                 self._notify(doc["_id"])
 
     def get(self, doc_id: str) -> Optional[dict]:
@@ -77,15 +96,24 @@ class Collection:
             return [self._docs[i] for i in ids if i in self._docs]
 
     def key_order(self) -> Dict[str, int]:
-        """id → insertion position (dicts preserve insertion order); the
-        deterministic ordering contract incremental caches must reproduce."""
+        """id → monotonic insertion rank (dicts preserve insertion order);
+        the deterministic ordering contract incremental caches must
+        reproduce. The returned mapping is a shared memo — treat it as
+        read-only."""
         with self._lock:
-            return {k: i for i, k in enumerate(self._docs)}
+            if self._key_order_cache is None:
+                self._key_order_cache = {
+                    k: i for i, k in enumerate(self._docs)
+                }
+                self._order_rank = len(self._docs)
+            return self._key_order_cache
 
     def remove(self, doc_id: str) -> bool:
         with self._lock:
             gone = self._docs.pop(doc_id, None) is not None
             if gone:
+                if self._key_order_cache is not None:
+                    self._key_order_cache.pop(doc_id, None)
                 self._notify(doc_id)
             return gone
 
@@ -94,6 +122,8 @@ class Collection:
             doomed = [i for i, d in self._docs.items() if pred(d)]
             for i in doomed:
                 del self._docs[i]
+                if self._key_order_cache is not None:
+                    self._key_order_cache.pop(i, None)
                 self._notify(i)
             return len(doomed)
 
@@ -101,6 +131,8 @@ class Collection:
         with self._lock:
             ids = list(self._docs)
             self._docs.clear()
+            self._key_order_cache = None
+            self._order_rank = 0
             for i in ids:
                 self._notify(i)
 

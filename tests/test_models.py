@@ -132,3 +132,16 @@ def test_migrations_apply_once_and_in_order(store):
     # second run is a no-op
     assert mig.apply_migrations(store) == []
     assert mig.pending_migrations(store) == []
+
+
+def test_insert_many_rejects_intra_batch_duplicates(store):
+    import pytest
+
+    coll = store.collection("things")
+    with pytest.raises(KeyError):
+        coll.insert_many([{"_id": "a"}, {"_id": "b"}, {"_id": "a"}])
+    # the failed batch must not have been partially applied
+    assert coll.count() == 0
+    # generators work (two passes need materialization)
+    coll.insert_many({"_id": f"g{i}"} for i in range(3))
+    assert coll.count() == 3

@@ -33,6 +33,10 @@ def snapshot_inputs(tup):
     return (
         [d.id for d in distros],
         {k: [t.id for t in v] for k, v in tasks_by_distro.items()},
+        {k: [(h.id, h.status, h.running_task) for h in v]
+         for k, v in hosts_by_distro.items()},
+        dict(sorted((k, (e.elapsed_s, e.expected_s))
+                    for k, e in estimates.items())),
         dict(sorted(deps_met.items())),
     )
 
@@ -55,12 +59,26 @@ def test_cache_tracks_churn_exactly(store, seed):
         gather_tick_inputs(store, NOW)
     )
 
+    from evergreen_tpu.globals import HostStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models.host import Host
+
+    host_mod.insert_many(
+        store,
+        [Host(id=f"h{i:03d}", distro_id=rng.choice(["d1", "d2"]),
+              status=HostStatus.RUNNING.value, started_by="mci")
+         for i in range(8)],
+    )
+
     # churn: finishes, deactivations, priority-disable, new tasks, deps,
-    # secondary distros, removals
+    # secondary distros, removals — plus host lifecycle (spawn, terminate,
+    # task assignment, ownership flip) for the active-host cache
     coll = task_mod.coll(store)
-    for step in range(60):
-        op = rng.randrange(6)
+    hcoll = host_mod.coll(store)
+    for step in range(80):
+        op = rng.randrange(9)
         tid = f"t{rng.randrange(40):03d}"
+        hid = f"h{rng.randrange(12):03d}"
         if op == 0:
             coll.update(tid, {"status": TaskStatus.SUCCEEDED.value})
         elif op == 1:
@@ -85,8 +103,27 @@ def test_cache_tracks_churn_exactly(store, seed):
                                  "unattainable": rng.random() < 0.3,
                                  "finished": False}]},
             )
-        else:
+        elif op == 5:
             coll.remove(tid)
+        elif op == 6:
+            hcoll.update(hid, {"status": rng.choice(
+                [HostStatus.RUNNING.value, HostStatus.TERMINATED.value,
+                 HostStatus.PROVISIONING.value])})
+        elif op == 7:
+            try:
+                host_mod.insert(
+                    store,
+                    Host(id=f"h{100 + step:03d}",
+                         distro_id=rng.choice(["d1", "d2"]),
+                         status=HostStatus.RUNNING.value, started_by="mci"),
+                )
+            except KeyError:
+                pass
+        else:
+            hcoll.update(hid, {
+                "running_task": rng.choice(["", tid]),
+                "started_by": rng.choice(["mci", "user1"]),
+            })
 
         got = snapshot_inputs(cache.gather(NOW))
         want = snapshot_inputs(gather_tick_inputs(store, NOW))
