@@ -3,7 +3,9 @@
 The reference serves a gqlgen schema of ~139k generated lines
 (graphql/generated.go) backing the Spruce UI; the hand-written substance is
 the resolvers. Here: a compact spec-subset executor (single operation,
-field arguments, variables, aliases, nested selection sets — no fragments
+field arguments, variables, aliases, nested selection sets, named and
+inline fragments (flattened at parse time; type conditions are advisory
+over the schemaless doc store), @include/@skip directives on fields
 or directives) over a resolver registry covering the operationally
 important queries (task, tasks, version, build, host, hosts, distros,
 patch, projects, taskLogs, taskTests) and mutations (scheduleTask,
@@ -31,7 +33,7 @@ class GraphQLError(Exception):
 
 _TOKEN = re.compile(
     r"""\s*(?:
-        (?P<punct>[{}():,$!\[\]=])
+        (?P<punct>\.\.\.|[{}():,$!\[\]=@])
       | (?P<name>[_A-Za-z][_0-9A-Za-z]*)
       | (?P<string>"(?:[^"\\]|\\.)*")
       | (?P<number>-?\d+(?:\.\d+)?)
@@ -79,16 +81,34 @@ class _Parser:
             raise GraphQLError(f"expected {value!r}, got {got!r}")
 
     def parse_document(self) -> Tuple[str, List[dict]]:
-        kind, val = self.peek() or ("", "")
         op = "query"
-        if kind == "name" and val in ("query", "mutation"):
-            op = val
-            self.next()
-            if self.peek() and self.peek()[0] == "name":
-                self.next()  # operation name
-            if self.peek() and self.peek()[1] == "(":
-                self._skip_variable_defs()
-        return op, self.parse_selection_set()
+        selection: Optional[List[dict]] = None
+        fragments: Dict[str, List[dict]] = {}
+        while self.peek() is not None:
+            kind, val = self.peek()
+            if kind == "name" and val == "fragment":
+                self.next()
+                frag_name = self.next()[1]
+                self.expect("on")
+                self.next()  # type condition (advisory — schemaless store)
+                fragments[frag_name] = self.parse_selection_set()
+                continue
+            this_op = "query"
+            if kind == "name" and val in ("query", "mutation"):
+                this_op = val
+                self.next()
+                if self.peek() and self.peek()[0] == "name":
+                    self.next()  # operation name
+                if self.peek() and self.peek()[1] == "(":
+                    self._skip_variable_defs()
+            if selection is None:  # execute the first operation
+                op = this_op
+                selection = self.parse_selection_set()
+            else:
+                self.parse_selection_set()  # skip extra operations
+        if selection is None:
+            raise GraphQLError("no operation in document")
+        return op, _flatten_fragments(selection, fragments, set())
 
     def _skip_variable_defs(self) -> None:
         depth = 0
@@ -111,16 +131,33 @@ class _Parser:
             if tok[1] == "}":
                 self.next()
                 return fields
+            if tok[1] == "...":
+                self.next()
+                nxt = self.peek()
+                if nxt and nxt[1] == "on":  # typed inline fragment
+                    self.next()
+                    self.next()  # type condition (advisory)
+                    fields.append({
+                        "directives": self._parse_directives(),
+                        "inline": self.parse_selection_set(),
+                    })
+                elif nxt and nxt[1] in ("@", "{"):  # untyped inline group
+                    fields.append({
+                        "directives": self._parse_directives(),
+                        "inline": self.parse_selection_set(),
+                    })
+                elif nxt and nxt[0] == "name":  # named spread
+                    name = self.next()[1]
+                    fields.append({
+                        "spread": name,
+                        "directives": self._parse_directives(),
+                    })
+                else:
+                    raise GraphQLError("malformed fragment spread")
+                continue
             fields.append(self.parse_field())
 
-    def parse_field(self) -> dict:
-        kind, name = self.next()
-        if kind != "name":
-            raise GraphQLError(f"expected field name, got {name!r}")
-        alias = None
-        if self.peek() and self.peek()[1] == ":":
-            self.next()
-            alias, name = name, self.next()[1]
+    def _parse_args(self) -> Dict[str, Any]:
         args: Dict[str, Any] = {}
         if self.peek() and self.peek()[1] == "(":
             self.next()
@@ -131,6 +168,25 @@ class _Parser:
                 if self.peek() and self.peek()[1] == ",":
                     self.next()
             self.expect(")")
+        return args
+
+    def _parse_directives(self) -> List[dict]:
+        out: List[dict] = []
+        while self.peek() and self.peek()[1] == "@":
+            self.next()
+            out.append({"name": self.next()[1], "args": self._parse_args()})
+        return out
+
+    def parse_field(self) -> dict:
+        kind, name = self.next()
+        if kind != "name":
+            raise GraphQLError(f"expected field name, got {name!r}")
+        alias = None
+        if self.peek() and self.peek()[1] == ":":
+            self.next()
+            alias, name = name, self.next()[1]
+        args = self._parse_args()
+        directives = self._parse_directives()
         selection: Optional[List[dict]] = None
         if self.peek() and self.peek()[1] == "{":
             selection = self.parse_selection_set()
@@ -138,6 +194,7 @@ class _Parser:
             "name": name,
             "alias": alias or name,
             "args": args,
+            "directives": directives,
             "selection": selection,
         }
 
@@ -162,6 +219,84 @@ class _Parser:
         raise GraphQLError(f"unsupported value token {val!r}")
 
 
+def _flatten_fragments(
+    selection: List[dict],
+    fragments: Dict[str, List[dict]],
+    active: set,
+    outer_directives: Tuple[dict, ...] = (),
+) -> List[dict]:
+    """Substitute named spreads and inline fragments in place, recursively,
+    with cycle detection — downstream execution sees only plain fields.
+    Directives on a spread/inline gate every spliced field (prepended to
+    each field's own list: ALL must allow for the field to be included),
+    and fields sharing a response key have their selection sets merged per
+    the spec's CollectFields rule (when name/args/directives agree;
+    otherwise the later field wins, a documented subset limit)."""
+    out: List[dict] = []
+    for item in selection:
+        if "spread" in item:
+            name = item["spread"]
+            if name in active:
+                raise GraphQLError(f"fragment cycle through {name!r}")
+            body = fragments.get(name)
+            if body is None:
+                raise GraphQLError(f"unknown fragment {name!r}")
+            out.extend(_flatten_fragments(
+                body, fragments, active | {name},
+                outer_directives + tuple(item.get("directives") or ()),
+            ))
+        elif "inline" in item:
+            out.extend(_flatten_fragments(
+                item["inline"], fragments, active,
+                outer_directives + tuple(item.get("directives") or ()),
+            ))
+        else:
+            field = dict(item)
+            field["directives"] = (
+                list(outer_directives) + list(field.get("directives") or [])
+            )
+            if field.get("selection") is not None:
+                field["selection"] = _flatten_fragments(
+                    field["selection"], fragments, active
+                )
+            out.append(field)
+    return _merge_response_keys(out)
+
+
+def _merge_response_keys(fields: List[dict]) -> List[dict]:
+    merged: Dict[str, dict] = {}
+    out: List[dict] = []
+    for f in fields:
+        prev = merged.get(f["alias"])
+        if (
+            prev is not None
+            and prev["name"] == f["name"]
+            and prev["args"] == f["args"]
+            and prev["directives"] == f["directives"]
+        ):
+            if f.get("selection"):
+                prev["selection"] = _merge_response_keys(
+                    (prev.get("selection") or []) + f["selection"]
+                )
+            continue
+        if prev is not None:  # divergent duplicate: later wins
+            out.remove(prev)
+        merged[f["alias"]] = f
+        out.append(f)
+    return out
+
+
+def _directives_allow(field: dict, variables: Dict[str, Any]) -> bool:
+    """@include(if:) / @skip(if:) — the two spec-built-in directives."""
+    for d in field.get("directives") or []:
+        cond = bool(_resolve_vars(d["args"].get("if", True), variables))
+        if d["name"] == "include" and not cond:
+            return False
+        if d["name"] == "skip" and cond:
+            return False
+    return True
+
+
 def _resolve_vars(value: Any, variables: Dict[str, Any]) -> Any:
     if isinstance(value, dict) and "$var" in value:
         name = value["$var"]
@@ -178,18 +313,28 @@ def _resolve_vars(value: Any, variables: Dict[str, Any]) -> Any:
 # --------------------------------------------------------------------------- #
 
 
-def _project(value: Any, selection: Optional[List[dict]], store: Store) -> Any:
+def _project(
+    value: Any,
+    selection: Optional[List[dict]],
+    store: Store,
+    variables: Optional[Dict[str, Any]] = None,
+) -> Any:
     if selection is None or value is None:
         return value
     if isinstance(value, list):
-        return [_project(v, selection, store) for v in value]
+        return [_project(v, selection, store, variables) for v in value]
     if not isinstance(value, dict):
         return value
+    variables = variables or {}
     out = {}
     for field in selection:
+        if not _directives_allow(field, variables):
+            continue
         name = field["name"]
         sub = value.get(name)
-        out[field["alias"]] = _project(sub, field["selection"], store)
+        out[field["alias"]] = _project(
+            sub, field["selection"], store, variables
+        )
     return out
 
 
@@ -231,6 +376,8 @@ class GraphQLApi:
             registry = self.queries if op == "query" else self.mutations
             data: Dict[str, Any] = {}
             for field in selection:
+                if not _directives_allow(field, variables):
+                    continue
                 fn = registry.get(field["name"])
                 if fn is None:
                     raise GraphQLError(
@@ -241,7 +388,7 @@ class GraphQLApi:
                     for k, v in field["args"].items()
                 }
                 data[field["alias"]] = _project(
-                    fn(**args), field["selection"], self.store
+                    fn(**args), field["selection"], self.store, variables
                 )
             return {"data": data}
         except GraphQLError as e:

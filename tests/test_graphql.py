@@ -100,3 +100,117 @@ def test_syntax_errors_are_clean(store):
     assert "errors" in gql.execute("query { task(taskId: } }")
     assert "errors" in gql.execute("{ unterminated")
     assert "errors" in gql.execute("")
+
+
+def test_named_fragments_flatten(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute("""
+        query {
+          task(taskId: "t1") { ...core status }
+        }
+        fragment core on Task { id display_name ...ids }
+        fragment ids on Task { project }
+    """)
+    assert "errors" not in out, out
+    t = out["data"]["task"]
+    assert {"id", "display_name", "project", "status"} <= set(t)
+
+
+def test_inline_fragment_applies(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute("""
+        { task(taskId: "t1") { id ... on Task { status } } }
+    """)
+    assert out["data"]["task"]["status"]
+
+
+def test_fragment_cycle_is_error(store):
+    gql = GraphQLApi(store)
+    out = gql.execute("""
+        { task(taskId: "t1") { ...a } }
+        fragment a on Task { ...b }
+        fragment b on Task { ...a }
+    """)
+    assert "cycle" in out["errors"][0]["message"]
+
+
+def test_unknown_fragment_is_error(store):
+    gql = GraphQLApi(store)
+    out = gql.execute('{ task(taskId: "t1") { ...nope } }')
+    assert "unknown fragment" in out["errors"][0]["message"]
+
+
+def test_include_skip_directives(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute(
+        """
+        query Q($wantStatus: Boolean!) {
+          task(taskId: "t1") {
+            id
+            status @include(if: $wantStatus)
+            project @skip(if: $wantStatus)
+          }
+        }
+        """,
+        {"wantStatus": True},
+    )
+    t = out["data"]["task"]
+    assert "status" in t and "project" not in t
+    out = gql.execute(
+        """
+        query Q($wantStatus: Boolean!) {
+          task(taskId: "t1") {
+            id
+            status @include(if: $wantStatus)
+            project @skip(if: $wantStatus)
+          }
+        }
+        """,
+        {"wantStatus": False},
+    )
+    t = out["data"]["task"]
+    assert "status" not in t and "project" in t
+
+
+def test_spread_directives_gate_spliced_fields(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    q = """
+        query Q($x: Boolean!) {
+          task(taskId: "t1") { id ...core @skip(if: $x) }
+        }
+        fragment core on Task { status }
+    """
+    assert "status" not in gql.execute(q, {"x": True})["data"]["task"]
+    assert "status" in gql.execute(q, {"x": False})["data"]["task"]
+
+
+def test_untyped_inline_group_with_directive(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    q = '{ task(taskId: "t1") { id ... @include(if: false) { status } } }'
+    out = gql.execute(q)
+    assert "errors" not in out, out
+    assert "status" not in out["data"]["task"]
+
+
+def test_overlapping_fragments_merge_selections(store):
+    seed(store)
+    gql = GraphQLApi(store)
+    out = gql.execute("""
+        { version(versionId: "v1") { ...a ...b } }
+        fragment a on Version { id }
+        fragment b on Version { project status }
+    """)
+    assert set(out["data"]["version"]) == {"id", "project", "status"}
+    # duplicate top-level field with identical shape resolves ONCE and
+    # projects the union of selections
+    out = gql.execute("""
+        { task(taskId: "t1") { ...a ...b } }
+        fragment a on Task { id display_name }
+        fragment b on Task { id status }
+    """)
+    assert set(out["data"]["task"]) == {"id", "display_name", "status"}
