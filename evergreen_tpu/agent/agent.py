@@ -38,6 +38,8 @@ class Agent:
     def __init__(self, comm: Communicator, options: AgentOptions) -> None:
         self.comm = comm
         self.options = options
+        #: set when the server orders a stop (poisoned host, decommission)
+        self.should_exit = False
         if not self.options.work_dir:
             self.options.work_dir = tempfile.mkdtemp(prefix="evg-agent-")
 
@@ -52,7 +54,7 @@ class Agent:
         cfg = self.comm.get_task_config(task, self.options.host_id)
         self.comm.start_task(task.id)
         status, details_type, details_desc, timed_out, artifacts = self._run_task(cfg)
-        self.comm.end_task(
+        resp = self.comm.end_task(
             task.id,
             status,
             details_type=details_type,
@@ -60,18 +62,22 @@ class Agent:
             timed_out=timed_out,
             artifacts=artifacts,
         )
+        if resp and resp.get("should_exit"):
+            # server ordered a stop (poisoned host, decommission, …)
+            self.should_exit = True
         return task.id
 
     def run_until_idle(self, max_tasks: int = 0) -> List[str]:
         """Drain the queue (the smoke-test drive loop)."""
         done: List[str] = []
-        while True:
+        while not self.should_exit:
             tid = self.run_once()
             if tid is None:
                 return done
             done.append(tid)
             if max_tasks and len(done) >= max_tasks:
                 return done
+        return done
 
     # -- block execution ---------------------------------------------------- #
 

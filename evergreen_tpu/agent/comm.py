@@ -65,8 +65,10 @@ class Communicator(abc.ABC):
         self, task_id: str, status: str, details_type: str = "",
         details_desc: str = "", timed_out: bool = False,
         artifacts: Optional[Dict[str, Any]] = None,
-    ) -> None:
-        ...
+    ) -> Dict[str, Any]:
+        """Report the task result; the response carries ``should_exit``
+        when the server wants the agent to stop (poisoned host,
+        single-task distro, decommission)."""
 
     @abc.abstractmethod
     def send_log(self, task_id: str, lines: List[str]) -> None:
@@ -163,8 +165,10 @@ class LocalCommunicator(Communicator):
         self, task_id: str, status: str, details_type: str = "",
         details_desc: str = "", timed_out: bool = False,
         artifacts: Optional[Dict[str, Any]] = None,
-    ) -> None:
-        mark_end(
+    ) -> Dict[str, Any]:
+        from ..models.lifecycle import finish_agent_task
+
+        t, should_exit = finish_agent_task(
             self.store,
             task_id,
             status,
@@ -193,6 +197,7 @@ class LocalCommunicator(Communicator):
                         intent = new_intent(d.id, d.provider)
                         intent.started_by = f"task:{task_id}"
                         host_mod.insert(self.store, intent)
+        return {"should_exit": should_exit}
 
     def _persist_task_output(self, task_id: str, artifacts: Dict[str, Any]) -> None:
         """Test results + artifact records staged by commands (the

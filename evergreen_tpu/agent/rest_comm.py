@@ -101,7 +101,7 @@ class RestCommunicator(Communicator):
         self, task_id: str, status: str, details_type: str = "",
         details_desc: str = "", timed_out: bool = False,
         artifacts: Optional[Dict[str, Any]] = None,
-    ) -> None:
+    ) -> Dict[str, Any]:
         body = {
             "status": status,
             "details_type": details_type,
@@ -110,7 +110,9 @@ class RestCommunicator(Communicator):
         }
         if artifacts and artifacts.get("generate_tasks"):
             body["generate_tasks"] = artifacts["generate_tasks"]
-        self._call("POST", f"/rest/v2/tasks/{task_id}/agent/end", body)
+        return self._call(
+            "POST", f"/rest/v2/tasks/{task_id}/agent/end", body
+        )
 
     def send_log(self, task_id: str, lines: List[str]) -> None:
         self._call(

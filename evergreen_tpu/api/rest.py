@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..dispatch.assign import assign_next_available_task
 from ..dispatch.dag_dispatcher import DispatcherService
-from ..globals import TaskStatus
+from ..globals import HostStatus, TaskStatus
 from ..ingestion import patches as patch_mod
 from ..ingestion import repotracker as repotracker_mod
 from ..ingestion.validator import validate_project
@@ -319,6 +319,13 @@ class RestApi:
         h = host_mod.get(self.store, match["host"])
         if h is None:
             raise ApiError(404, f"host {match['host']!r} not found")
+        # agents on hosts taken out of service (decommissioned/quarantined/
+        # terminating) exit instead of polling forever (reference
+        # rest/route/host_agent.go host-status gate before dispatch)
+        if h.status != HostStatus.RUNNING.value:
+            # reference checkHostHealth (rest/route/host_agent.go): an
+            # agent on any non-running host exits instead of polling
+            return 200, {"task_id": "", "should_exit": True}
         t = assign_next_available_task(self.store, self.svc, h)
         # single-task distros run exactly one task per host, then the agent
         # exits and the host is recycled (reference units/host_allocator.go
@@ -382,7 +389,9 @@ class RestApi:
         return 200, {"abort": t.aborted}
 
     def end_task(self, method, match, body):
-        t = mark_end(
+        from ..models.lifecycle import finish_agent_task
+
+        t, should_exit = finish_agent_task(
             self.store,
             match["task"],
             body.get("status", TaskStatus.FAILED.value),
@@ -398,7 +407,7 @@ class RestApi:
                 {"_id": t.id, "task_id": t.id, "payloads": gen,
                  "processed": False}
             )
-        return 200, {"status": t.status}
+        return 200, {"status": t.status, "should_exit": should_exit}
 
     def append_logs(self, method, match, body):
         coll = self.store.collection("task_logs")
@@ -473,6 +482,17 @@ class RestApi:
         return 200, {"ok": True}
 
     def restart_task(self, method, match, body):
+        """Restart a finished task; an in-progress task is flagged
+        reset_when_finished instead (reference SetResetWhenFinished), so
+        it — or its whole single-host task group — restarts on finish."""
+        from ..globals import TASK_IN_PROGRESS_STATUSES
+
+        t = task_mod.get(self.store, match["task"])
+        if t is not None and t.status in TASK_IN_PROGRESS_STATUSES:
+            task_mod.coll(self.store).update(
+                t.id, {"reset_when_finished": True}
+            )
+            return 200, {"reset_when_finished": True}
         ok = task_jobs.restart_task(self.store, match["task"], body.get("user", "api"))
         if not ok:
             raise ApiError(409, "task is not restartable")

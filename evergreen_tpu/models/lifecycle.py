@@ -8,11 +8,15 @@ feeds the event log, evaluates stepback, and rolls build/version statuses up.
 from __future__ import annotations
 
 import time as _time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..globals import (
+    CONSECUTIVE_SYSTEM_FAILURE_THRESHOLD,
     STEPBACK_TASK_ACTIVATOR,
+    TASK_COMPLETED_STATUSES,
     BuildStatus,
+    HostStatus,
+    Provider,
     Requester,
     TaskStatus,
     VersionStatus,
@@ -150,6 +154,134 @@ def update_dependencies_on_finish(
 
         wake_dependents(store, newly_ready, now)
     return newly_blocked
+
+
+def check_reset_single_host_task_group(
+    store: Store, t: Task, now: float
+) -> bool:
+    """Once every task of a single-host task group is finished (or blocked
+    or deactivated), restart the whole group if any member requested it via
+    ``reset_when_finished`` (reference model/task_lifecycle.go:2770
+    checkResetSingleHostTaskGroup, invoked from MarkEnd). Returns whether a
+    reset happened."""
+    if not t.is_single_host_task_group():
+        return False
+    members = task_mod.find(
+        store,
+        lambda d: d["build_id"] == t.build_id
+        and d["task_group"] == t.task_group,
+    )
+    if not members:
+        return False
+    should_reset = False
+    for m in members:
+        if m.reset_when_finished:
+            should_reset = True
+        if (
+            m.status not in TASK_COMPLETED_STATUSES
+            and m.activated
+            and not m.blocked()
+        ):
+            return False  # a member still needs to run
+    if not should_reset:
+        return False
+    from ..units.task_jobs import restart_task
+
+    c = task_mod.coll(store)
+    reset_ids: List[str] = []
+    for m in members:
+        c.update(m.id, {"reset_when_finished": False})
+        if m.status in TASK_COMPLETED_STATUSES:
+            if restart_task(store, m.id, by="single-host-group-reset",
+                            now=now):
+                reset_ids.append(m.id)
+        else:
+            # never ran this round (deactivated or blocked): reactivate so
+            # the whole group reruns together (reference resetManyTasks
+            # resets every member, model/task_lifecycle.go:2798)
+            c.update(m.id, {"activated": True,
+                            "activated_by": "single-host-group-reset",
+                            "activated_time": now})
+            reset_ids.append(m.id)
+    event_mod.log(
+        store,
+        event_mod.RESOURCE_TASK,
+        "TASK_GROUP_RESET",
+        t.id,
+        {"task_group": t.task_group, "build_id": t.build_id,
+         "members": reset_ids},
+        timestamp=now,
+    )
+    return True
+
+
+def finish_agent_task(
+    store: Store,
+    task_id: str,
+    status: str,
+    details_type: str = "",
+    details_desc: str = "",
+    timed_out: bool = False,
+    now: Optional[float] = None,
+) -> Tuple[Optional[Task], bool]:
+    """The one agent-facing finish path shared by every transport (HTTP
+    route and in-process communicator): MarkEnd plus poisoned-host
+    accounting. Returns (finished task or None if not running,
+    should_exit)."""
+    now = _time.time() if now is None else now
+    t = mark_end(
+        store,
+        task_id,
+        status,
+        now=now,
+        details_type=details_type,
+        details_desc=details_desc,
+        timed_out=timed_out,
+    )
+    if t is None:
+        return None, False
+    return t, note_host_task_outcome(store, t, details_type, now)
+
+
+def note_host_task_outcome(
+    store: Store, t: Task, details_type: str, now: float
+) -> bool:
+    """Poisoned-host detection (reference rest/route/host_agent.go:32,1454-
+    1469): a dynamic host whose last N task finishes were all system
+    failures is assumed unhealthy — decommission it and tell the agent to
+    exit. Returns should_exit. Static hosts are managed separately and are
+    never auto-disabled."""
+    if not t.host_id:
+        return False
+    hcoll = host_mod.coll(store)
+    h = hcoll.get(t.host_id)
+    if h is None or h["provider"] == Provider.STATIC.value:
+        return False
+    system_failed = (
+        t.status == TaskStatus.FAILED.value and details_type == "system"
+    )
+    if not system_failed:
+        if h.get("consecutive_system_fails", 0):
+            hcoll.update(t.host_id, {"consecutive_system_fails": 0})
+        return False
+    n = h.get("consecutive_system_fails", 0) + 1
+    hcoll.update(t.host_id, {"consecutive_system_fails": n})
+    if n < CONSECUTIVE_SYSTEM_FAILURE_THRESHOLD:
+        return False
+    if h["status"] == HostStatus.RUNNING.value:
+        # already-down statuses (quarantined for debugging, terminated,
+        # decommissioned) are never overwritten — the reference's poison
+        # handler no-ops on any non-running host
+        hcoll.update(t.host_id, {"status": HostStatus.DECOMMISSIONED.value})
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_HOST,
+            "HOST_POISONED",
+            t.host_id,
+            {"consecutive_system_failures": n, "task_id": t.id},
+            timestamp=now,
+        )
+    return True
 
 
 def block_single_host_task_group(store: Store, t: Task, now: float) -> List[str]:
@@ -447,6 +579,16 @@ def mark_end(
 
     update_dependencies_on_finish(store, t, now)
     block_single_host_task_group(store, t, now)
+    check_reset_single_host_task_group(store, t, now)
+    if t.reset_when_finished and not t.is_single_host_task_group():
+        # reference SetResetWhenFinished semantics for ordinary tasks: a
+        # restart requested while the task ran happens now, automatically.
+        # Single-host group members defer to the group reset above, which
+        # fires only once every member has finished.
+        from ..units.task_jobs import restart_task
+
+        task_mod.coll(store).update(t.id, {"reset_when_finished": False})
+        restart_task(store, t.id, by="reset-when-finished", now=now)
     evaluate_stepback(store, t, now)
     update_build_and_version_status(store, t, now)
 

@@ -395,3 +395,158 @@ def test_large_parser_project_throttle(store):
     got = assign_next_available_task(store, svc, host_mod.get(store, "h1"), NOW)
     # the big-project task is throttled; the small one dispatches
     assert got is not None and got.id == "queued-small"
+
+
+def test_poisoned_host_decommissioned_after_consecutive_system_failures(store):
+    """reference rest/route/host_agent.go:32: 3 consecutive system-failed
+    task finishes on a dynamic host → decommission + agent should_exit.
+    A non-system failure in between resets the streak."""
+    from evergreen_tpu.globals import HostStatus, Provider, TaskStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.lifecycle import mark_end, note_host_task_outcome
+    from evergreen_tpu.models.task import Task
+
+    host_mod.insert(store, Host(id="h1", distro_id="d1", provider="mock",
+                                status=HostStatus.RUNNING.value))
+
+    def finish(i, details_type):
+        t = Task(id=f"p{i}", distro_id="d1", host_id="h1",
+                 status=TaskStatus.STARTED.value)
+        task_mod.insert(store, t)
+        ended = mark_end(store, t.id, TaskStatus.FAILED.value,
+                         details_type=details_type, now=NOW + i)
+        return note_host_task_outcome(store, ended, details_type, NOW + i)
+
+    assert finish(0, "system") is False
+    assert finish(1, "system") is False
+    assert finish(2, "") is False        # ordinary failure resets streak
+    assert finish(3, "system") is False
+    assert finish(4, "system") is False
+    assert finish(5, "system") is True   # third consecutive → poisoned
+    h = host_mod.get(store, "h1")
+    assert h.status == HostStatus.DECOMMISSIONED.value
+    from evergreen_tpu.models import event as event_mod
+    assert any(e.event_type == "HOST_POISONED"
+               for e in event_mod.find_by_resource(store, "h1"))
+
+
+def test_static_hosts_never_poisoned(store):
+    from evergreen_tpu.globals import HostStatus, TaskStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.lifecycle import mark_end, note_host_task_outcome
+    from evergreen_tpu.models.task import Task
+
+    host_mod.insert(store, Host(id="hs", distro_id="d1", provider="static",
+                                status=HostStatus.RUNNING.value))
+    for i in range(4):
+        t = Task(id=f"s{i}", distro_id="d1", host_id="hs",
+                 status=TaskStatus.STARTED.value)
+        task_mod.insert(store, t)
+        ended = mark_end(store, t.id, TaskStatus.FAILED.value,
+                         details_type="system", now=NOW + i)
+        assert note_host_task_outcome(store, ended, "system", NOW + i) is False
+    assert host_mod.get(store, "hs").status == HostStatus.RUNNING.value
+
+
+def test_single_host_task_group_reset_when_finished(store):
+    """reference model/task_lifecycle.go:2770: once every member of a
+    single-host group finishes, a reset_when_finished member restarts the
+    whole group with archived executions."""
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.lifecycle import mark_end
+    from evergreen_tpu.models.task import Task
+
+    common = dict(distro_id="d1", build_id="b1", task_group="tg",
+                  task_group_max_hosts=1, activated=True,
+                  status=TaskStatus.STARTED.value)
+    task_mod.insert_many(store, [
+        Task(id="g1", task_group_order=1, reset_when_finished=True, **common),
+        Task(id="g2", task_group_order=2, **common),
+    ])
+    # first finish: g2 still running → no reset yet
+    mark_end(store, "g1", TaskStatus.FAILED.value, now=NOW)
+    assert task_mod.get(store, "g1").status == TaskStatus.FAILED.value
+    # last finish triggers the group reset
+    mark_end(store, "g2", TaskStatus.SUCCEEDED.value, now=NOW + 1)
+    g1, g2 = task_mod.get(store, "g1"), task_mod.get(store, "g2")
+    assert g1.status == TaskStatus.UNDISPATCHED.value
+    assert g2.status == TaskStatus.UNDISPATCHED.value
+    assert g1.execution == 1 and g2.execution == 1
+    assert not g1.reset_when_finished  # no reset loop on next finish
+    # archived execution 0 is queryable
+    from evergreen_tpu.units.task_jobs import get_task_execution_archive
+    assert get_task_execution_archive(store, "g1")[0]["execution"] == 0
+
+
+def test_group_reset_reactivates_deactivated_members(store):
+    """A member the user deactivated mid-run rejoins the group rerun
+    (reference resetManyTasks resets every member)."""
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.lifecycle import mark_end
+    from evergreen_tpu.models.task import Task
+
+    common = dict(distro_id="d1", build_id="b1", task_group="tg2",
+                  task_group_max_hosts=1)
+    task_mod.insert_many(store, [
+        Task(id="r1", task_group_order=1, reset_when_finished=True,
+             activated=True, status=TaskStatus.STARTED.value, **common),
+        Task(id="r2", task_group_order=2, activated=False,
+             status=TaskStatus.UNDISPATCHED.value, **common),
+    ])
+    mark_end(store, "r1", TaskStatus.FAILED.value, now=NOW)
+    r1, r2 = task_mod.get(store, "r1"), task_mod.get(store, "r2")
+    assert r1.status == TaskStatus.UNDISPATCHED.value and r1.execution == 1
+    assert r2.activated and r2.execution == 0  # reactivated, never ran
+
+
+def test_restart_in_progress_task_sets_reset_flag(store):
+    """REST restart on a running task flags reset_when_finished instead
+    of 409ing; the restart happens automatically at finish."""
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.models.lifecycle import mark_end
+
+    task_mod.insert(store, Task(id="rw1", distro_id="d1", activated=True,
+                                status=TaskStatus.STARTED.value))
+    api = RestApi(store)
+    status, body = api.handle("POST", "/rest/v2/tasks/rw1/restart", {})
+    assert status == 200 and body["reset_when_finished"] is True
+    mark_end(store, "rw1", TaskStatus.FAILED.value, now=NOW)
+    t = task_mod.get(store, "rw1")
+    assert t.status == TaskStatus.UNDISPATCHED.value and t.execution == 1
+    assert not t.reset_when_finished
+
+
+def test_poison_never_overwrites_quarantine(store):
+    from evergreen_tpu.models.lifecycle import mark_end, note_host_task_outcome
+
+    host_mod.insert(store, Host(id="hq", distro_id="d1", provider="mock",
+                                status=HostStatus.QUARANTINED.value,
+                                consecutive_system_fails=2)
+                    if "consecutive_system_fails" in
+                    {f.name for f in __import__("dataclasses").fields(Host)}
+                    else Host(id="hq", distro_id="d1", provider="mock",
+                              status=HostStatus.QUARANTINED.value))
+    host_mod.coll(store).update("hq", {"consecutive_system_fails": 2})
+    task_mod.insert(store, Task(id="q1", distro_id="d1", host_id="hq",
+                                status=TaskStatus.STARTED.value))
+    ended = mark_end(store, "q1", TaskStatus.FAILED.value,
+                     details_type="system", now=NOW)
+    assert note_host_task_outcome(store, ended, "system", NOW) is True
+    # quarantine preserved for the operator; host still out of service
+    assert host_mod.get(store, "hq").status == HostStatus.QUARANTINED.value
+
+
+def test_next_task_exits_agent_on_any_non_running_host(store):
+    from evergreen_tpu.api.rest import RestApi
+
+    host_mod.insert(store, Host(id="hstop", distro_id="d1", provider="mock",
+                                status=HostStatus.STOPPED.value))
+    api = RestApi(store)
+    status, body = api.handle("GET", "/rest/v2/hosts/hstop/agent/next_task", {})
+    assert status == 200 and body["should_exit"] is True
