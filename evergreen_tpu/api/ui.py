@@ -1,9 +1,10 @@
-"""Minimal web UI.
+"""Web UI.
 
 The reference's UI surface is Spruce (a separate React app on the GraphQL
-API). This is the single-page stand-in: one HTML page polling the REST API
-for versions, tasks, hosts and recent events — enough to watch the system
-run from a browser.
+API). This is the dependency-free stand-in: one HTML page with hash
+routing over the REST API — overview (versions / hosts / events), distro
+queue views, version drill-down, and task detail with logs, test results
+and artifacts. Enough to watch and debug the system from a browser.
 """
 from __future__ import annotations
 
@@ -14,74 +15,215 @@ PAGE = """<!doctype html>
 <title>evergreen-tpu</title>
 <style>
   body { font: 13px/1.45 -apple-system, Segoe UI, sans-serif; margin: 2rem;
-         color: #222; }
+         color: #222; max-width: 1100px; }
   h1 { font-size: 18px; } h2 { font-size: 14px; margin-top: 1.6em; }
   table { border-collapse: collapse; width: 100%; }
   th, td { text-align: left; padding: 3px 10px 3px 0;
            border-bottom: 1px solid #eee; }
-  .success { color: #0a7d36; } .failed { color: #c0392b; }
+  .success { color: #0a7d36; } .failed, .fail { color: #c0392b; }
   .started, .dispatched { color: #b8860b; }
   .undispatched { color: #888; }
-  code { background: #f5f5f5; padding: 0 3px; }
+  .pass { color: #0a7d36; }
+  code, pre { background: #f5f5f5; padding: 0 3px; }
+  pre { padding: 8px; overflow-x: auto; max-height: 360px; }
   #statusbar { color: #555; }
+  nav a { margin-right: 14px; }
+  a { color: #2457a7; text-decoration: none; cursor: pointer; }
+  a:hover { text-decoration: underline; }
+  .muted { color: #999; }
 </style>
 </head>
 <body>
 <h1>evergreen-tpu</h1>
+<nav><a href="#/">overview</a><a href="#/queues">queues</a></nav>
 <div id="statusbar">loading…</div>
-<h2>Recent versions</h2>
-<table id="versions"><thead><tr><th>version</th><th>project</th>
-<th>status</th><th>tasks</th></tr></thead><tbody></tbody></table>
-<h2>Hosts</h2>
-<table id="hosts"><thead><tr><th>host</th><th>distro</th><th>status</th>
-<th>running task</th></tr></thead><tbody></tbody></table>
-<h2>Recent events</h2>
-<table id="events"><thead><tr><th>type</th><th>resource</th></tr></thead>
-<tbody></tbody></table>
+<div id="view"></div>
 <script>
-async function j(p) { const r = await fetch(p); return r.json(); }
-function row(cells) {
-  const tr = document.createElement("tr");
-  for (const [text, cls] of cells) {
-    const td = document.createElement("td");
-    td.textContent = text;
-    if (cls) td.className = cls;
-    tr.appendChild(td);
+async function j(p) {
+  const r = await fetch(p);
+  if (!r.ok) throw new Error(`${p} -> ${r.status}`);
+  return r.json();
+}
+function el(tag, attrs, ...children) {
+  const e = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "href") e.setAttribute("href", v);
+    else if (k === "class") e.className = v;
+    else e[k] = v;
   }
-  return tr;
+  for (const c of children)
+    e.appendChild(typeof c === "string" ? document.createTextNode(c) : c);
+  return e;
 }
-function fill(id, rows) {
-  const tb = document.querySelector(`#${id} tbody`);
-  tb.replaceChildren(...rows);
+function table(headers, rows) {
+  return el("table", {},
+    el("thead", {}, el("tr", {}, ...headers.map(h => el("th", {}, h)))),
+    el("tbody", {}, ...rows));
 }
-async function refresh() {
-  try {
-    const s = await j("/rest/v2/status");
-    document.getElementById("statusbar").textContent =
-      `tasks: ${s.tasks} · hosts: ${s.hosts} · distros: ${s.distros} ` +
-      `· versions: ${s.versions} · jobs pending: ${s.jobs_pending}`;
-    const versions = await j("/rest/v2/versions?limit=15");
-    const vrows = [];
-    for (const v of versions) {
-      const tasks = await j(`/rest/v2/versions/${v._id}/tasks`);
-      const done = tasks.filter(t => t.status === "success").length;
-      vrows.push(row([[v._id], [v.project], [v.status, v.status],
-                      [`${done}/${tasks.length} ok`]]));
+function tr(cells) {
+  return el("tr", {}, ...cells.map(c =>
+    c instanceof HTMLElement ? el("td", {}, c)
+      : el("td", { class: c[1] || "" }, String(c[0]))));
+}
+function statusCell(s) { return [s, s]; }
+const view = document.getElementById("view");
+
+async function statusbar() {
+  const s = await j("/rest/v2/status");
+  document.getElementById("statusbar").textContent =
+    `tasks: ${s.tasks} · hosts: ${s.hosts} · distros: ${s.distros} ` +
+    `· versions: ${s.versions} · jobs pending: ${s.jobs_pending}`;
+}
+
+async function overview() {
+  const [versions, hosts, events] = await Promise.all([
+    j("/rest/v2/versions?limit=15"), j("/rest/v2/hosts"),
+    j("/rest/v2/events"),
+  ]);
+  const taskLists = await Promise.all(versions.slice(0, 15).map(v =>
+    j(`/rest/v2/versions/${v._id}/tasks`)));
+  const vrows = versions.slice(0, 15).map((v, i) => {
+    const tasks = taskLists[i];
+    const done = tasks.filter(t => t.status === "success").length;
+    return tr([
+      el("a", { href: `#/version/${v._id}` }, v._id),
+      [v.project], statusCell(v.status), [`${done}/${tasks.length} ok`],
+    ]);
+  });
+  return [
+    el("h2", {}, "Recent versions"),
+    table(["version", "project", "status", "tasks"], vrows),
+    el("h2", {}, "Hosts"),
+    table(["host", "distro", "status", "running task"],
+      hosts.slice(0, 30).map(h => tr([
+        [h._id], [h.distro_id], statusCell(h.status),
+        h.running_task
+          ? el("a", { href: `#/task/${h.running_task}` }, h.running_task)
+          : ["—", "muted"],
+      ]))),
+    el("h2", {}, "Recent events"),
+    table(["type", "resource"],
+      events.slice(-20).reverse().map(e =>
+        tr([[e.event_type], [e.resource_id]]))),
+  ];
+}
+
+async function queues() {
+  const distros = await j("/rest/v2/distros");
+  // parallel fetch; 404 means "no queue yet" (empty), anything else is
+  // surfaced — an operator must be able to tell errors from empty queues
+  const results = await Promise.all(distros.map(d =>
+    j(`/rest/v2/distros/${d._id}/queue`)
+      .then(q => ({ items: q.queue }))
+      .catch(e => String(e).includes("404") ? { items: [] }
+                                            : { error: String(e) })));
+  const blocks = [el("h2", {}, "Task queues")];
+  distros.forEach((d, k) => {
+    const r = results[k];
+    const planner = d.planner_settings
+      ? ` (${d.planner_settings.version})` : "";
+    if (r.error) {
+      blocks.push(el("h2", {}, `${d._id}${planner}`));
+      blocks.push(el("p", { class: "failed" }, r.error));
+      return;
     }
-    fill("versions", vrows);
-    const hosts = await j("/rest/v2/hosts");
-    fill("hosts", hosts.slice(0, 30).map(h =>
-      row([[h._id], [h.distro_id], [h.status, h.status],
-           [h.running_task || "—"]])));
-    const events = await j("/rest/v2/events");
-    fill("events", events.slice(-20).reverse().map(e =>
-      row([[e.event_type], [e.resource_id]])));
+    blocks.push(el("h2", {},
+      `${d._id} — ${r.items.length} queued${planner}`));
+    blocks.push(table(["#", "task", "group", "deps met", "expected s"],
+      r.items.slice(0, 20).map((i, n) => tr([
+        [n + 1],
+        el("a", { href: `#/task/${i.id}` }, i.id),
+        [i.task_group || "—", i.task_group ? "" : "muted"],
+        [i.dependencies_met ? "yes" : "no",
+         i.dependencies_met ? "success" : "undispatched"],
+        [Math.round(i.expected_duration_s)],
+      ]))));
+  });
+  return blocks;
+}
+
+async function versionView(vid) {
+  const [v, tasks] = await Promise.all([
+    j(`/rest/v2/versions/${vid}`), j(`/rest/v2/versions/${vid}/tasks`),
+  ]);
+  return [
+    el("h2", {}, `Version ${vid}`),
+    el("p", {}, `project ${v.project} · status `,
+      el("span", { class: v.status }, v.status),
+      ` · ${(v.message || "").slice(0, 120)}`),
+    table(["task", "variant", "status", "host"],
+      tasks.map(t => tr([
+        el("a", { href: `#/task/${t._id}` },
+          `${t.display_name || t._id}`),
+        [t.build_variant], statusCell(t.status),
+        [t.host_id || "—", t.host_id ? "" : "muted"],
+      ]))),
+  ];
+}
+
+async function taskView(tid) {
+  const t = await j(`/rest/v2/tasks/${tid}`);
+  const parts = [
+    el("h2", {}, `Task ${t.display_name || tid}`),
+    el("p", {},
+      el("span", { class: t.status }, t.status),
+      ` · version `, el("a", { href: `#/version/${t.version}` }, t.version),
+      ` · execution ${t.execution} · host ${t.host_id || "—"}`),
+  ];
+  try {
+    const tests = await j(`/rest/v2/tasks/${tid}/tests`);
+    if (tests.length) {
+      parts.push(el("h2", {}, "Test results"));
+      parts.push(table(["test", "status"],
+        tests.map(r => tr([[r.test_name], statusCell(r.status)]))));
+    }
+  } catch (e) {}
+  try {
+    const arts = await j(`/rest/v2/tasks/${tid}/artifacts`);
+    if (arts.length) {
+      parts.push(el("h2", {}, "Artifacts"));
+      parts.push(table(["name", "link"],
+        arts.map(a => tr([[a.name],
+                          el("a", { href: a.link }, a.link)]))));
+    }
+  } catch (e) {}
+  try {
+    const logs = await j(`/rest/v2/tasks/${tid}/logs`);
+    parts.push(el("h2", {}, "Logs"));
+    parts.push(el("pre", {},
+      (logs.lines || []).slice(-400).join("\\n") || "(empty)"));
+  } catch (e) {}
+  return parts;
+}
+
+let gen = 0;  // stale-render guard: only the newest route() may paint
+async function route(isRefresh) {
+  const my = ++gen;
+  const h = location.hash || "#/";
+  try {
+    await statusbar();
+    let nodes;
+    if (h.startsWith("#/task/")) nodes = await taskView(h.slice(7));
+    else if (h.startsWith("#/version/")) nodes = await versionView(h.slice(10));
+    else if (h === "#/queues") nodes = await queues();
+    else nodes = await overview();
+    if (my !== gen) return;  // user navigated while we were fetching
+    view.replaceChildren(...nodes);
   } catch (err) {
-    document.getElementById("statusbar").textContent = "error: " + err;
+    if (my !== gen) return;
+    if (isRefresh) {  // keep last-good tables on a transient blip
+      document.getElementById("statusbar").textContent = "refresh error: " + err;
+      return;
+    }
+    view.replaceChildren(el("p", { class: "failed" }, "error: " + err));
   }
 }
-refresh();
-setInterval(refresh, 5000);
+window.addEventListener("hashchange", () => route(false));
+route(false);
+setInterval(() => {  // background refresh only on the live views
+  const h = location.hash || "#/";
+  if (h === "#/" || h === "#/queues") route(true);
+}, 5000);
 </script>
 </body>
 </html>
