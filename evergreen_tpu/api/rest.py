@@ -309,6 +309,7 @@ class RestApi:
         r("GET", r"/rest/v2/subscriptions", self.list_subscriptions)
         r("GET", r"/rest/v2/stats/spans", self.list_spans)
         r("GET", r"/rest/v2/stats/hosts", self.host_stats)
+        r("GET", r"/rest/v2/stats/system", self.system_stats)
 
     # -- agent protocol ------------------------------------------------- #
 
@@ -1043,6 +1044,17 @@ class RestApi:
         from ..utils.tracing import get_spans
 
         return 200, get_spans(self.store)[-200:]
+
+    def system_stats(self, method, match, body):
+        """Recent system samples (tasks by status, queue lengths/age, job
+        depth, rusage) — the stats_task/stats_queue/stats_amboy/
+        stats_sysinfo sampler output (units/task_jobs.sample_system_stats).
+        """
+        from ..units.task_jobs import SYSTEM_STATS_COLLECTION
+
+        docs = self.store.collection(SYSTEM_STATS_COLLECTION).find()
+        docs.sort(key=lambda d: d["at"], reverse=True)
+        return 200, docs[: int(body.get("limit", 20) or 20)]
 
     def host_stats(self, method, match, body):
         stats = self.store.collection("host_stats").find()

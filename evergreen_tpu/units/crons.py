@@ -207,7 +207,13 @@ def stats_jobs(store: Store, now: float) -> List[Job]:
             lambda s: host_jobs.sample_host_stats(s),
             scopes=["host-stats"],
             job_type="host-stats",
-        )
+        ),
+        FnJob(
+            f"system-stats-{now:.3f}",
+            lambda s: task_jobs.sample_system_stats(s),
+            scopes=["system-stats"],
+            job_type="system-stats",
+        ),
     ]
 
 

@@ -8,7 +8,7 @@ archive), abort handling.
 from __future__ import annotations
 
 import time as _time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..globals import TaskStatus
 from ..models import event as event_mod
@@ -157,3 +157,55 @@ def get_task_execution_archive(store: Store, task_id: str) -> List[dict]:
     )
     out.sort(key=lambda d: d["execution"])
     return out
+
+
+SYSTEM_STATS_COLLECTION = "system_stats"
+_SYSTEM_STATS_KEEP = 500
+
+
+def sample_system_stats(store: Store, now: Optional[float] = None) -> dict:
+    """Periodic system samplers: task counts by status, per-distro queue
+    length/age, background-job depth and process rusage in one document
+    (reference units/stats_task.go, stats_queue.go, stats_amboy.go,
+    stats_sysinfo.go — the de-facto metrics the reference emits as
+    structured logs; here persisted and served at /rest/v2/stats/system).
+    """
+    import resource
+
+    now = _time.time() if now is None else now
+    task_counts: Dict[str, int] = {}
+    for doc in task_mod.coll(store).find():
+        task_counts[doc["status"]] = task_counts.get(doc["status"], 0) + 1
+    from ..models import task_queue as task_queue_mod
+
+    queues = {}
+    for qdoc in task_queue_mod.coll(store).find():
+        cols = qdoc.get("cols") or {}
+        n = len(cols.get("id", qdoc.get("queue", [])))
+        queues[qdoc["_id"]] = {
+            "length": n,
+            "age_s": round(max(0.0, now - qdoc.get("generated_at", now)), 3),
+        }
+    jobs = store.collection("jobs")
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    doc = {
+        "_id": f"sys-{now:.3f}",
+        "at": now,
+        "tasks_by_status": task_counts,
+        "queues": queues,
+        "jobs_pending": jobs.count(
+            lambda d: d["status"] in ("pending", "running")
+        ),
+        "jobs_failed": jobs.count(lambda d: d["status"] == "failed"),
+        "max_rss_kb": ru.ru_maxrss,
+        "cpu_user_s": round(ru.ru_utime, 3),
+    }
+    coll = store.collection(SYSTEM_STATS_COLLECTION)
+    coll.upsert(doc)
+    # bounded history: drop the oldest samples beyond the window (by the
+    # numeric timestamp — string ids don't sort chronologically across
+    # digit-width boundaries)
+    docs = sorted(coll.find(), key=lambda d: d["at"])
+    for stale in docs[:-_SYSTEM_STATS_KEEP]:
+        coll.remove(stale["_id"])
+    return doc

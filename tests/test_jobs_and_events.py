@@ -321,3 +321,49 @@ def test_default_channel_senders_write_outboxes(store):
     hook = store.collection("webhook_outbox").find()[0]
     assert hook["url"] == "https://hooks/x"
     assert "nt1" in hook["payload"]["subject"]
+
+
+def test_system_stats_sampler(store):
+    """stats_task/queue/amboy/sysinfo sampler analog: one document with
+    task counts, queue lengths/age, job depth and rusage, bounded
+    history, served over REST."""
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.scheduler.persister import persist_task_queue
+    from evergreen_tpu.models.task_queue import DistroQueueInfo
+    from evergreen_tpu.units.task_jobs import sample_system_stats
+
+    task_mod.insert_many(store, [
+        Task(id="s1", status="undispatched"),
+        Task(id="s2", status="success"),
+        Task(id="s3", status="success"),
+    ])
+    persist_task_queue(store, "d1", [task_mod.get(store, "s1")], {}, {},
+                       DistroQueueInfo(), now=NOW)
+    doc = sample_system_stats(store, now=NOW + 30)
+    assert doc["tasks_by_status"] == {"undispatched": 1, "success": 2}
+    assert doc["queues"]["d1"]["length"] == 1
+    assert doc["queues"]["d1"]["age_s"] == 30.0
+    assert doc["max_rss_kb"] > 0
+
+    api = RestApi(store)
+    status, out = api.handle("GET", "/rest/v2/stats/system", {})
+    assert status == 200 and out[0]["_id"] == doc["_id"]
+
+    # bounded history: shrink the window and verify oldest-by-timestamp
+    # samples are the ones pruned
+    from evergreen_tpu.units import task_jobs as tj
+    from evergreen_tpu.units.task_jobs import SYSTEM_STATS_COLLECTION
+    orig = tj._SYSTEM_STATS_KEEP
+    tj._SYSTEM_STATS_KEEP = 3
+    try:
+        for i in range(5):
+            sample_system_stats(store, now=NOW + 100 + i)
+    finally:
+        tj._SYSTEM_STATS_KEEP = orig
+    remaining = store.collection(SYSTEM_STATS_COLLECTION).find()
+    assert len(remaining) == 3
+    assert sorted(d["at"] for d in remaining) == [
+        NOW + 102, NOW + 103, NOW + 104
+    ]
