@@ -1050,11 +1050,14 @@ class RestApi:
         depth, rusage) — the stats_task/stats_queue/stats_amboy/
         stats_sysinfo sampler output (units/task_jobs.sample_system_stats).
         """
-        from ..units.task_jobs import SYSTEM_STATS_COLLECTION
-
-        docs = self.store.collection(SYSTEM_STATS_COLLECTION).find()
+        docs = self.store.collection(
+            task_jobs.SYSTEM_STATS_COLLECTION
+        ).find()
         docs.sort(key=lambda d: d["at"], reverse=True)
-        return 200, docs[: int(body.get("limit", 20) or 20)]
+        limit = int(body.get("limit", 20))
+        if limit <= 0:  # "?limit=0"/negative: a limit, not a slice trick
+            limit = 20
+        return 200, docs[:limit]
 
     def host_stats(self, method, match, body):
         stats = self.store.collection("host_stats").find()

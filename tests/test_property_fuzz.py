@@ -142,3 +142,102 @@ def test_parser_raw_text_never_crashes(text):
         parse_project(text)
     except ProjectParseError:
         pass
+
+
+# --------------------------------------------------------------------------- #
+# Cmp-based prioritizer invariants (scheduler/cmp_prioritizer.py): any task
+# population yields a deterministic permutation with the structural
+# guarantees of the reference's comparator-chain plan.
+# --------------------------------------------------------------------------- #
+
+from evergreen_tpu.globals import MAX_TASK_PRIORITY
+from evergreen_tpu.models.task import Task as _Task
+from evergreen_tpu.scheduler.cmp_prioritizer import (
+    prioritize_tasks,
+    split_by_requester,
+)
+
+_requesters = st.sampled_from([
+    "gitter_request", "patch_request", "github_pull_request",
+    "github_merge_request", "ad_hoc", "trigger_request", "bogus_requester",
+])
+
+
+@st.composite
+def _cmp_tasks(draw):
+    n = draw(st.integers(0, 24))
+    tasks = []
+    for i in range(n):
+        grouped = draw(st.booleans())
+        tasks.append(_Task(
+            id=f"f{i}",
+            requester=draw(_requesters),
+            priority=draw(st.sampled_from([0, 1, 5, 50, 101, 200])),
+            num_dependents=draw(st.integers(0, 4)),
+            generate_task=draw(st.booleans()),
+            project=draw(st.sampled_from(["pa", "pb"])),
+            build_id=draw(st.sampled_from(["b1", "b2"])) if grouped else "",
+            task_group=draw(st.sampled_from(["g1", "g2"])) if grouped else "",
+            task_group_order=draw(st.integers(0, 3)),
+            revision_order_number=draw(st.integers(0, 9)),
+            ingest_time=1e9 + draw(st.integers(0, 1000)),
+            expected_duration_s=float(draw(st.sampled_from([0, 60, 600]))),
+        ))
+    return tasks
+
+
+@settings(max_examples=120, deadline=None)
+@given(_cmp_tasks())
+def test_cmp_prioritizer_invariants(tasks):
+    out = prioritize_tasks(tasks)
+    high, patch, mainline, dropped = split_by_requester(tasks)
+
+    # permutation of the non-dropped input: nothing lost, nothing duplicated
+    assert sorted(t.id for t in out) == sorted(
+        t.id for t in high + patch + mainline
+    )
+    assert not set(t.id for t in out) & {t.id for t in dropped}
+
+    # over-max-priority tasks lead the queue, always
+    n_high = len(high)
+    assert all(t.priority > MAX_TASK_PRIORITY for t in out[:n_high])
+
+    # deterministic: same input, same plan
+    assert [t.id for t in prioritize_tasks(tasks)] == [t.id for t in out]
+
+    # 1:1 interleave shape: until one bucket empties, patch tasks occupy
+    # even offsets of the merged tail and mainline tasks odd offsets
+    tail = out[n_high:]
+    np_, nm = len(patch), len(mainline)
+    for idx in range(min(np_, nm) * 2 - 1 if np_ and nm else 0):
+        bucket = patch if idx % 2 == 0 else mainline
+        assert any(t.id == tail[idx].id for t in bucket), (
+            f"slot {idx} not from the expected bucket"
+        )
+
+
+@settings(max_examples=60, deadline=None)
+@given(_cmp_tasks())
+def test_cmp_prioritizer_groups_contiguous_within_bucket(tasks):
+    """Within one requester bucket, members of the same (build, group)
+    form one contiguous block in task_group_order (the byTaskGroupOrder
+    guarantee)."""
+    for t in tasks:
+        t.requester = "gitter_request"  # single bucket
+        t.priority = min(t.priority, MAX_TASK_PRIORITY)
+    out = prioritize_tasks(tasks)
+    seen_blocks = set()
+    prev_key = None
+    for t in out:
+        key = (t.build_id, t.task_group) if t.task_group else None
+        if key != prev_key and key is not None:
+            assert key not in seen_blocks, f"group {key} split in plan"
+            seen_blocks.add(key)
+        prev_key = key
+    # grouped tasks all come before ungrouped ones
+    ungrouped_seen = False
+    for t in out:
+        if not t.task_group:
+            ungrouped_seen = True
+        elif ungrouped_seen:
+            raise AssertionError("grouped task after ungrouped block")
