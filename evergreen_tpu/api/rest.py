@@ -70,6 +70,10 @@ class RestApi:
 
             self._rate_limiter = RateLimiter(store, rate_limit_per_min)
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        #: per-request authenticated identity (thread-local: the WSGI
+        #: server is threading). Set by _authorize, read by ownership
+        #: checks on user-resource routes (spawn hosts, volumes).
+        self._ident = threading.local()
         self._register_routes()
         #: GitHub webhook intake (reference rest/route/github.go); secret +
         #: config fetcher injectable
@@ -103,6 +107,8 @@ class RestApi:
             key = headers.get("api-user") or headers.get("x-forwarded-for", "anon")
             if not self._rate_limiter.allow(key):
                 return 429, {"error": "rate limit exceeded"}
+        self._ident.user = ""
+        self._ident.superuser = False
         if not self.require_auth or _AGENT_PATHS.match(path):
             return None
         from ..models import user as user_mod
@@ -110,6 +116,8 @@ class RestApi:
         u = user_mod.user_by_api_key(self.store, headers.get("api-key", ""))
         if u is None or u.id != headers.get("api-user", u.id):
             return 401, {"error": "invalid or missing API credentials"}
+        self._ident.user = u.id
+        self._ident.superuser = u.has_scope(user_mod.SCOPE_SUPERUSER)
         mutating = method in ("POST", "PUT", "PATCH", "DELETE")
         if mutating and _ADMIN_PATHS.match(path) and not u.has_scope(
             user_mod.SCOPE_SUPERUSER
@@ -247,6 +255,22 @@ class RestApi:
 
         # hosts / distros
         r("GET", r"/rest/v2/hosts", self.list_hosts)
+        # spawn hosts + volumes (reference rest/route/host_spawn.go)
+        r("POST", r"/rest/v2/hosts", self.spawn_host)
+        r("POST", r"/rest/v2/hosts/(?P<host>[^/]+)/start", self.spawn_start)
+        r("POST", r"/rest/v2/hosts/(?P<host>[^/]+)/stop", self.spawn_stop)
+        r("POST", r"/rest/v2/hosts/(?P<host>[^/]+)/terminate",
+          self.spawn_terminate)
+        r("POST", r"/rest/v2/hosts/(?P<host>[^/]+)/extend_expiration",
+          self.spawn_extend)
+        r("POST", r"/rest/v2/hosts/(?P<host>[^/]+)/sleep_schedule",
+          self.spawn_sleep_schedule)
+        r("POST", r"/rest/v2/volumes", self.create_volume)
+        r("GET", r"/rest/v2/volumes", self.list_volumes)
+        r("POST", r"/rest/v2/volumes/(?P<volume>[^/]+)/attach",
+          self.attach_volume)
+        r("POST", r"/rest/v2/volumes/(?P<volume>[^/]+)/detach",
+          self.detach_volume)
         r("GET", r"/rest/v2/hosts/(?P<host>[^/]+)", self.get_host)
         r("GET", r"/rest/v2/distros", self.list_distros)
         r("GET", r"/rest/v2/distros/(?P<distro>[^/]+)/queue", self.get_queue)
@@ -500,6 +524,166 @@ class RestApi:
         return 200, task_mod.get(self.store, match["task"]).to_doc()
 
     # -- hosts / distros -------------------------------------------------- #
+
+    # -- spawn hosts + volumes (reference rest/route/host_spawn.go) ------- #
+
+    def _require_owner(self, owner: str) -> None:
+        """Ownership gate for user resources (reference host_spawn.go
+        checks the authenticated user against host.StartedBy). Enforced
+        whenever an authenticated identity exists; without auth
+        configured there is no verified identity to compare (dev mode)."""
+        ident = getattr(self._ident, "user", "")
+        if ident and ident != owner and not getattr(
+            self._ident, "superuser", False
+        ):
+            raise ApiError(403, f"resource belongs to {owner!r}")
+
+    @staticmethod
+    def _spawn_call(fn, *args, **kw):
+        from ..cloud.spawnhost import SpawnHostError
+        from ..cloud.volumes import VolumeError
+
+        try:
+            return fn(*args, **kw)
+        except (SpawnHostError, VolumeError) as e:
+            raise ApiError(400, str(e))
+
+    def spawn_host(self, method, match, body):
+        from ..cloud import spawnhost
+
+        user = body.get("user", "")
+        distro = body.get("distro", "")
+        if not user or not distro:
+            raise ApiError(400, "user and distro required")
+        h = self._spawn_call(
+            spawnhost.create_spawn_host,
+            self.store, user, distro,
+            no_expiration=bool(body.get("no_expiration", False)),
+        )
+        return 200, h.to_doc()
+
+    def _spawn_host_owner(self, host_id: str) -> str:
+        h = host_mod.get(self.store, host_id)
+        if h is None or not h.user_host:
+            raise ApiError(400, "not a spawn host")
+        self._require_owner(h.started_by)
+        return h.started_by
+
+    def spawn_start(self, method, match, body):
+        from ..cloud import spawnhost
+
+        self._spawn_host_owner(match["host"])
+        self._spawn_call(spawnhost.start_spawn_host, self.store, match["host"])
+        return 200, {"ok": True}
+
+    def spawn_stop(self, method, match, body):
+        from ..cloud import spawnhost
+
+        self._spawn_host_owner(match["host"])
+        self._spawn_call(spawnhost.stop_spawn_host, self.store, match["host"])
+        return 200, {"ok": True}
+
+    def spawn_terminate(self, method, match, body):
+        from ..cloud import spawnhost
+
+        owner = self._spawn_host_owner(match["host"])
+        self._spawn_call(
+            spawnhost.terminate_spawn_host, self.store, match["host"],
+            by=body.get("user", owner),
+        )
+        return 200, {"ok": True}
+
+    def spawn_extend(self, method, match, body):
+        from ..cloud import spawnhost
+
+        self._spawn_host_owner(match["host"])
+        hours = float(body.get("hours", 0) or 0)
+        if hours <= 0:
+            raise ApiError(400, "hours must be positive")
+        new_exp = self._spawn_call(
+            spawnhost.extend_expiration, self.store, match["host"], hours
+        )
+        return 200, {"expiration_time": new_exp}
+
+    def spawn_sleep_schedule(self, method, match, body):
+        from ..cloud.volumes import SleepSchedule, set_sleep_schedule
+
+        h = host_mod.get(self.store, match["host"])
+        if h is None or not h.user_host:
+            raise ApiError(400, "not a spawn host")
+        self._require_owner(h.started_by)
+        if not h.no_expiration:
+            # enforcement only runs for unexpirable hosts
+            # (cloud/volumes.py enforce_sleep_schedules) — storing a
+            # schedule here would be silently dead configuration
+            raise ApiError(
+                400, "sleep schedules apply to no-expiration hosts only"
+            )
+        stop = int(body.get("stop_hour_utc", 22))
+        start = int(body.get("start_hour_utc", 8))
+        if not (0 <= stop <= 23 and 0 <= start <= 23):
+            raise ApiError(400, "hours must be in 0..23")
+        set_sleep_schedule(
+            self.store,
+            SleepSchedule(
+                host_id=match["host"],
+                stop_hour_utc=stop,
+                start_hour_utc=start,
+                enabled=bool(body.get("enabled", True)),
+            ),
+        )
+        return 200, {"ok": True}
+
+    def create_volume(self, method, match, body):
+        from ..cloud import volumes
+
+        user = body.get("user", "")
+        size = int(body.get("size_gb", 0) or 0)
+        if not user or size <= 0:
+            raise ApiError(400, "user and positive size_gb required")
+        v = self._spawn_call(
+            volumes.create_volume, self.store, user, size,
+            zone=body.get("zone", ""),
+        )
+        return 200, v.to_doc()
+
+    def list_volumes(self, method, match, body):
+        from ..cloud import volumes
+
+        user = body.get("user", "")
+        if user:
+            return 200, [
+                v.to_doc() for v in volumes.volumes_for_user(self.store, user)
+            ]
+        return 200, self.store.collection("volumes").find()
+
+    def _volume_owner(self, volume_id: str) -> str:
+        from ..cloud import volumes
+
+        v = volumes.get_volume(self.store, volume_id)
+        if v is None:
+            raise ApiError(404, "volume not found")
+        self._require_owner(v.created_by)
+        return v.created_by
+
+    def attach_volume(self, method, match, body):
+        from ..cloud import volumes
+
+        self._volume_owner(match["volume"])
+        host = body.get("host", "")
+        if not host:
+            raise ApiError(400, "host required")
+        self._spawn_call(
+            volumes.attach_volume, self.store, match["volume"], host
+        )
+        return 200, {"ok": True}
+
+    def detach_volume(self, method, match, body):
+        from ..cloud import volumes
+
+        self._volume_owner(match["volume"])
+        self._spawn_call(volumes.detach_volume, self.store, match["volume"])
+        return 200, {"ok": True}
 
     def list_hosts(self, method, match, body):
         return 200, [h.to_doc() for h in host_mod.find(self.store)]
@@ -1054,8 +1238,8 @@ class RestApi:
             task_jobs.SYSTEM_STATS_COLLECTION
         ).find()
         docs.sort(key=lambda d: d["at"], reverse=True)
-        limit = int(body.get("limit", 20))
-        if limit <= 0:  # "?limit=0"/negative: a limit, not a slice trick
+        limit = int(body.get("limit", 20) or 20)  # "" and 0 -> default
+        if limit <= 0:  # negative: a limit, not a slice trick
             limit = 20
         return 200, docs[:limit]
 

@@ -531,3 +531,104 @@ def test_last_green_endpoint(store, server):
         "GET", "/rest/v2/projects/lgp/last_green?variants=mac")
     assert "variants required" in comm._call(
         "GET", "/rest/v2/projects/lgp/last_green").get("error", "")
+
+
+def test_spawn_host_and_volume_routes(store, server):
+    """Spawn-host lifecycle + volumes over REST (reference
+    rest/route/host_spawn.go)."""
+    base, api = server
+    from evergreen_tpu.cloud.mock import MockCloudManager  # registered fake
+    from evergreen_tpu.globals import Provider
+
+    distro_mod.insert(store, Distro(id="ws", provider=Provider.MOCK.value))
+    comm = RestCommunicator(base)
+
+    h = comm._call("POST", "/rest/v2/hosts",
+                   {"user": "alice", "distro": "ws"})
+    hid = h["_id"]
+    assert h["user_host"] and h["started_by"] == "alice"
+    assert h["expiration_time"] > 0
+
+    # extend expiration; 30-day cap enforced as a clean 400
+    out = comm._call("POST", f"/rest/v2/hosts/{hid}/extend_expiration",
+                     {"hours": 5})
+    assert out["expiration_time"] > h["expiration_time"]
+    over = comm._call("POST", f"/rest/v2/hosts/{hid}/extend_expiration",
+                      {"hours": 24 * 40})
+    assert "30-day" in over.get("error", "")
+
+    # volumes: create → attach → double-attach rejected → detach
+    v = comm._call("POST", "/rest/v2/volumes",
+                   {"user": "alice", "size_gb": 32})
+    assert comm._call("POST", f"/rest/v2/volumes/{v['_id']}/attach",
+                      {"host": hid}) == {"ok": True}
+    again = comm._call("POST", f"/rest/v2/volumes/{v['_id']}/attach",
+                       {"host": hid})
+    assert "already attached" in again.get("error", "")
+    mine = comm._call("GET", "/rest/v2/volumes?user=alice")
+    assert mine[0]["host_id"] == hid
+    assert comm._call("POST", f"/rest/v2/volumes/{v['_id']}/detach",
+                      {}) == {"ok": True}
+
+    # sleep schedules are only meaningful on no-expiration hosts (the
+    # enforcement loop skips expirable ones) — storing one would be dead
+    # config, so the API rejects it
+    rejected = comm._call("POST", f"/rest/v2/hosts/{hid}/sleep_schedule",
+                          {"stop_hour_utc": 20, "start_hour_utc": 6})
+    assert "no-expiration" in rejected.get("error", "")
+    h2 = comm._call("POST", "/rest/v2/hosts",
+                    {"user": "alice", "distro": "ws",
+                     "no_expiration": True})
+    assert comm._call("POST", f"/rest/v2/hosts/{h2['_id']}/sleep_schedule",
+                      {"stop_hour_utc": 20, "start_hour_utc": 6}
+                      )["ok"] is True
+    bad_hours = comm._call("POST",
+                           f"/rest/v2/hosts/{h2['_id']}/sleep_schedule",
+                           {"stop_hour_utc": 30})
+    assert "0..23" in bad_hours.get("error", "")
+    # zero/negative extension is rejected, not a silent no-op
+    assert "positive" in comm._call(
+        "POST", f"/rest/v2/hosts/{hid}/extend_expiration", {"hours": -3}
+    ).get("error", "")
+    assert comm._call("POST", f"/rest/v2/hosts/{hid}/terminate",
+                      {"user": "alice"})["ok"] is True
+    # spawning on a non-spawn-host target errors cleanly
+    bad = comm._call("POST", "/rest/v2/hosts", {"user": "alice",
+                                                "distro": "nope"})
+    assert "not found" in bad.get("error", "")
+
+
+def test_spawn_host_ownership_enforced(store):
+    """With auth on, a user cannot mutate another user's spawn host or
+    volume (reference host_spawn.go ownership checks)."""
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.cloud.spawnhost import create_spawn_host
+    from evergreen_tpu.cloud.volumes import create_volume
+    from evergreen_tpu.globals import Provider
+    from evergreen_tpu.models import user as user_mod
+
+    distro_mod.insert(store, Distro(id="ws", provider=Provider.MOCK.value))
+    alice = user_mod.create_user(store, "alice")
+    mallory = user_mod.create_user(store, "mallory")
+    root = user_mod.create_user(store, "root",
+                                roles=[user_mod.SCOPE_SUPERUSER])
+    h = create_spawn_host(store, "alice", "ws")
+    v = create_volume(store, "alice", 8)
+    api = RestApi(store, require_auth=True)
+
+    def call(u, method, path, body=None):
+        return api.handle(method, path, body or {}, headers={
+            "api-key": u.api_key, "api-user": u.id,
+        })
+
+    st, out = call(mallory, "POST", f"/rest/v2/hosts/{h.id}/terminate")
+    assert st == 403 and "belongs to" in out["error"]
+    st, out = call(mallory, "POST", f"/rest/v2/volumes/{v.id}/attach",
+                   {"host": h.id})
+    assert st == 403
+    # the owner and a superuser can
+    st, out = call(alice, "POST", f"/rest/v2/volumes/{v.id}/attach",
+                   {"host": h.id})
+    assert st == 200
+    st, out = call(root, "POST", f"/rest/v2/hosts/{h.id}/terminate")
+    assert st == 200
