@@ -348,6 +348,8 @@ class GraphQLApi:
             "build": self._q_build,
             "host": self._q_host,
             "hosts": self._q_hosts,
+            "myHosts": self._q_my_hosts,
+            "myVolumes": self._q_my_volumes,
             "distros": self._q_distros,
             "patch": self._q_patch,
             "projects": self._q_projects,
@@ -442,6 +444,26 @@ class GraphQLApi:
         doc = h.to_doc()
         doc["id"] = doc["_id"]
         return doc
+
+    def _q_my_hosts(self, userId: str):
+        """Spruce myHosts: the user's spawn hosts (reference
+        graphql host resolvers over host.ByUserWithRunningStatus)."""
+        return [
+            {**h.to_doc(), "id": h.id}
+            for h in host_mod.find(
+                self.store,
+                lambda d: d.get("user_host") and d["started_by"] == userId,
+            )
+        ]
+
+    def _q_my_volumes(self, userId: str):
+        """Spruce myVolumes (reference graphql volume resolvers)."""
+        from ..cloud.volumes import volumes_for_user
+
+        return [
+            {**v.to_doc(), "id": v.id}
+            for v in volumes_for_user(self.store, userId)
+        ]
 
     def _q_hosts(self, distroId: str = ""):
         return [

@@ -551,7 +551,7 @@ class RestApi:
     def spawn_host(self, method, match, body):
         from ..cloud import spawnhost
 
-        user = body.get("user", "")
+        user = self._claimed_user(body)
         distro = body.get("distro", "")
         if not user or not distro:
             raise ApiError(400, "user and distro required")
@@ -562,12 +562,28 @@ class RestApi:
         )
         return 200, h.to_doc()
 
-    def _spawn_host_owner(self, host_id: str) -> str:
+    def _spawn_host_owner(self, host_id: str):
+        """Fetch + validate + ownership-gate a spawn host; returns it."""
         h = host_mod.get(self.store, host_id)
         if h is None or not h.user_host:
             raise ApiError(400, "not a spawn host")
         self._require_owner(h.started_by)
-        return h.started_by
+        return h
+
+    def _claimed_user(self, body: dict) -> str:
+        """The acting user for resource creation: the authenticated
+        identity when auth is on (a body 'user' naming someone else is
+        rejected — creation cannot be attributed to another user); the
+        body field in dev mode."""
+        ident = getattr(self._ident, "user", "")
+        claimed = body.get("user", "")
+        if ident:
+            if claimed and claimed != ident and not getattr(
+                self._ident, "superuser", False
+            ):
+                raise ApiError(403, f"cannot act as {claimed!r}")
+            return claimed or ident
+        return claimed
 
     def spawn_start(self, method, match, body):
         from ..cloud import spawnhost
@@ -586,10 +602,10 @@ class RestApi:
     def spawn_terminate(self, method, match, body):
         from ..cloud import spawnhost
 
-        owner = self._spawn_host_owner(match["host"])
+        owner = self._spawn_host_owner(match["host"]).started_by
         self._spawn_call(
             spawnhost.terminate_spawn_host, self.store, match["host"],
-            by=body.get("user", owner),
+            by=body.get("user") or owner,
         )
         return 200, {"ok": True}
 
@@ -608,10 +624,7 @@ class RestApi:
     def spawn_sleep_schedule(self, method, match, body):
         from ..cloud.volumes import SleepSchedule, set_sleep_schedule
 
-        h = host_mod.get(self.store, match["host"])
-        if h is None or not h.user_host:
-            raise ApiError(400, "not a spawn host")
-        self._require_owner(h.started_by)
+        h = self._spawn_host_owner(match["host"])
         if not h.no_expiration:
             # enforcement only runs for unexpirable hosts
             # (cloud/volumes.py enforce_sleep_schedules) — storing a
@@ -637,7 +650,7 @@ class RestApi:
     def create_volume(self, method, match, body):
         from ..cloud import volumes
 
-        user = body.get("user", "")
+        user = self._claimed_user(body)
         size = int(body.get("size_gb", 0) or 0)
         if not user or size <= 0:
             raise ApiError(400, "user and positive size_gb required")
@@ -650,7 +663,13 @@ class RestApi:
     def list_volumes(self, method, match, body):
         from ..cloud import volumes
 
+        # scope to the caller: an authenticated non-superuser only sees
+        # their own volumes regardless of the requested filter
+        ident = getattr(self._ident, "user", "")
+        superuser = getattr(self._ident, "superuser", False)
         user = body.get("user", "")
+        if ident and not superuser:
+            user = ident
         if user:
             return 200, [
                 v.to_doc() for v in volumes.volumes_for_user(self.store, user)
@@ -673,6 +692,10 @@ class RestApi:
         host = body.get("host", "")
         if not host:
             raise ApiError(400, "host required")
+        # the target host must be the caller's too — attaching a foreign
+        # volume mutates someone else's machine (reference host_spawn.go
+        # checks both sides)
+        self._spawn_host_owner(host)
         self._spawn_call(
             volumes.attach_volume, self.store, match["volume"], host
         )

@@ -200,6 +200,57 @@ def cmd_user(args) -> int:
     return 0
 
 
+def cmd_host(args) -> int:
+    """Spawn-host lifecycle (reference operations/host.go)."""
+    call = _client(args)
+    a = args.action
+    if a == "spawn":
+        out = call("POST", "/rest/v2/hosts", {
+            "user": args.user, "distro": args.distro,
+            "no_expiration": args.no_expiration,
+        })
+    elif a == "list":
+        hosts = call("GET", "/rest/v2/hosts")
+        if args.user and isinstance(hosts, list):
+            hosts = [h for h in hosts if h.get("started_by") == args.user]
+        out = hosts
+    elif a in ("start", "stop", "terminate"):
+        out = call("POST", f"/rest/v2/hosts/{args.id}/{a}",
+                   {"user": args.user})
+    elif a == "extend":
+        out = call("POST", f"/rest/v2/hosts/{args.id}/extend_expiration",
+                   {"hours": args.hours})
+    else:
+        print(f"unknown host action {a!r}", file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0 if not (isinstance(out, dict) and "error" in out) else 1
+
+
+def cmd_volume(args) -> int:
+    """Volume management (reference operations/host.go volume commands)."""
+    call = _client(args)
+    a = args.action
+    if a == "create":
+        out = call("POST", "/rest/v2/volumes",
+                   {"user": args.user, "size_gb": args.size_gb})
+    elif a == "list":
+        from urllib.parse import urlencode
+
+        q = f"?{urlencode({'user': args.user})}" if args.user else ""
+        out = call("GET", f"/rest/v2/volumes{q}")
+    elif a == "attach":
+        out = call("POST", f"/rest/v2/volumes/{args.id}/attach",
+                   {"host": args.host})
+    elif a == "detach":
+        out = call("POST", f"/rest/v2/volumes/{args.id}/detach", {})
+    else:
+        print(f"unknown volume action {a!r}", file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0 if not (isinstance(out, dict) and "error" in out) else 1
+
+
 def cmd_last_green(args) -> int:
     """Most recent successful version for the given variants (reference
     operations/last_green.go)."""
@@ -356,6 +407,27 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--finalize", action="store_true")
     pa.add_argument("--api-server", default="http://127.0.0.1:9090")
     pa.set_defaults(fn=cmd_patch)
+
+    ho = sub.add_parser("host", help="spawn-host lifecycle")
+    ho.add_argument("action",
+                    choices=["spawn", "list", "start", "stop", "terminate",
+                             "extend"])
+    ho.add_argument("--id", default="")
+    ho.add_argument("--distro", default="")
+    ho.add_argument("--user", default="")
+    ho.add_argument("--hours", type=float, default=0.0)
+    ho.add_argument("--no-expiration", action="store_true")
+    ho.add_argument("--api-server", default="http://127.0.0.1:9090")
+    ho.set_defaults(fn=cmd_host)
+
+    vo = sub.add_parser("volume", help="volume management")
+    vo.add_argument("action", choices=["create", "list", "attach", "detach"])
+    vo.add_argument("--id", default="")
+    vo.add_argument("--user", default="")
+    vo.add_argument("--host", default="")
+    vo.add_argument("--size-gb", type=int, default=0)
+    vo.add_argument("--api-server", default="http://127.0.0.1:9090")
+    vo.set_defaults(fn=cmd_volume)
 
     lg = sub.add_parser(
         "last-green",

@@ -214,3 +214,22 @@ def test_overlapping_fragments_merge_selections(store):
         fragment b on Task { id status }
     """)
     assert set(out["data"]["task"]) == {"id", "display_name", "status"}
+
+
+def test_my_hosts_and_volumes(store):
+    from evergreen_tpu.cloud.spawnhost import create_spawn_host
+    from evergreen_tpu.cloud.volumes import create_volume
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models.distro import Distro
+
+    distro_mod.insert(store, Distro(id="ws", provider="mock"))
+    create_spawn_host(store, "alice", "ws")
+    create_spawn_host(store, "bob", "ws")
+    create_volume(store, "alice", 16)
+    gql = GraphQLApi(store)
+    out = gql.execute('{ myHosts(userId: "alice") { id started_by } '
+                      '  myVolumes(userId: "alice") { id size_gb } }')
+    assert "errors" not in out, out
+    assert len(out["data"]["myHosts"]) == 1
+    assert out["data"]["myHosts"][0]["started_by"] == "alice"
+    assert out["data"]["myVolumes"][0]["size_gb"] == 16
