@@ -38,6 +38,12 @@ class TickCache:
         self._primed = False
         #: runnable task id → materialized Task
         self._runnable: Dict[str, Task] = {}
+        #: incrementally-maintained dependency-met flags + the reverse
+        #: dependency index that drives their invalidation: a task's flag
+        #: changes only when the task itself or one of its parents churns
+        self._deps_met: Dict[str, bool] = {}
+        self._dep_edges: Dict[str, List[str]] = {}   # task → parent ids
+        self._dependents: Dict[str, Set[str]] = {}   # parent → task ids
         task_mod.coll(store).add_listener(self._on_task_change)
         #: active host id → materialized Host (same dirty-tracking scheme
         #: over the hosts collection: assignments/terminations churn a few
@@ -72,6 +78,41 @@ class TickCache:
             return False
         return True
 
+    def _reindex_deps(self, t: Task) -> None:
+        for p in self._dep_edges.pop(t.id, ()):
+            deps = self._dependents.get(p)
+            if deps is not None:
+                deps.discard(t.id)
+                if not deps:
+                    del self._dependents[p]
+        parents = [d.task_id for d in t.depends_on]
+        if parents:
+            self._dep_edges[t.id] = parents
+            for p in parents:
+                self._dependents.setdefault(p, set()).add(t.id)
+
+    def _drop_dep_index(self, tid: str) -> None:
+        for p in self._dep_edges.pop(tid, ()):
+            deps = self._dependents.get(p)
+            if deps is not None:
+                deps.discard(tid)
+                if not deps:  # don't leak one empty set per historic parent
+                    del self._dependents[p]
+        self._deps_met.pop(tid, None)
+
+    def _recompute_deps_met(self, ids) -> None:
+        """Recompute flags for a subset, with membership semantics over
+        the FULL runnable set (snapshot.compute_deps_met in_snapshot)."""
+        from .snapshot import deps_met_for
+
+        tasks = [self._runnable[i] for i in ids]
+        if not tasks:
+            return
+        self._deps_met.update(
+            deps_met_for(tasks, task_mod.coll(self.store),
+                         in_snapshot=self._runnable.keys())
+        )
+
     def apply_dirty(self) -> int:
         """Fold pending changes into the runnable map; returns changes."""
         with self._lock:
@@ -81,20 +122,36 @@ class TickCache:
                 self._runnable = {
                     t.id: t for t in task_mod.find_host_runnable(self.store)
                 }
+                self._deps_met.clear()
+                self._dep_edges.clear()
+                self._dependents.clear()
+                for t in self._runnable.values():
+                    self._reindex_deps(t)
+                self._recompute_deps_met(list(self._runnable))
                 self._primed = True
                 return len(self._runnable)
             with self._dirty_lock:
                 dirty, self._dirty = self._dirty, set()
             coll = task_mod.coll(self.store)
+            # a churned task invalidates its own flag and its dependents'
+            # (their membership/finished check reads the parent's state)
+            affected: Set[str] = set()
+            for tid in dirty:
+                affected |= self._dependents.get(tid, set())
             n = 0
             for tid in dirty:
                 doc = coll.get(tid)
                 if self._qualifies(doc):
-                    self._runnable[tid] = Task.from_doc(doc)
+                    t = Task.from_doc(doc)
+                    self._runnable[tid] = t
+                    self._reindex_deps(t)
+                    affected.add(tid)
                     n += 1
                 elif tid in self._runnable:
                     del self._runnable[tid]
+                    self._drop_dep_index(tid)
                     n += 1
+            self._recompute_deps_met(affected & self._runnable.keys())
             return n
 
     def _host_qualifies(self, doc: Optional[dict]) -> bool:
@@ -156,6 +213,7 @@ class TickCache:
             now,
             runnable_tasks=self.runnable_in_store_order(),
             active_hosts=self.active_hosts_in_store_order(),
+            deps_met=self._deps_met,
         )
 
     def runnable_count(self) -> int:

@@ -95,6 +95,7 @@ def gather_tick_inputs(
     now: float,
     runnable_tasks: Optional[List[Task]] = None,
     active_hosts: Optional[List[Host]] = None,
+    deps_met: Optional[Dict[str, bool]] = None,
 ) -> Tuple[
     List[Distro],
     Dict[str, List[Task]],
@@ -144,17 +145,21 @@ def gather_tick_inputs(
         tasks_by_distro[alias.id] = tasks
 
     # Resolve dependency parents + running-task estimates from raw docs
-    # (materializing Task objects here is hot-loop cost).
-    from ..globals import DEFAULT_TASK_DURATION_S, TASK_COMPLETED_STATUSES
+    # (materializing Task objects here is hot-loop cost). The incremental
+    # TickCache supplies its maintained deps-met map instead; restricting
+    # it to this gather's runnable set keeps warm output == cold output.
+    from ..globals import DEFAULT_TASK_DURATION_S
 
     coll = task_mod.coll(store)
-    parent_ids = {d.task_id for t in runnable for d in t.depends_on}
-    finished_status = {
-        doc["_id"]: doc["status"]
-        for doc in coll.find_ids(list(parent_ids))
-        if doc["status"] in TASK_COMPLETED_STATUSES
-    }
-    deps_met = compute_deps_met(runnable, finished_status)
+    if deps_met is None:
+        from .snapshot import deps_met_for
+
+        deps_met = deps_met_for(runnable, coll)
+    else:
+        # fail CLOSED on a missing flag: a maintenance gap must show up
+        # as a held-back task (and a warm/cold fuzzer diff), never as a
+        # task dispatched ahead of unfinished parents
+        deps_met = {t.id: deps_met.get(t.id, False) for t in runnable}
 
     hosts_by_distro: Dict[str, List[Host]] = {d.id: [] for d in distros}
     if active_hosts is None:
