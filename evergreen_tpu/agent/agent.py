@@ -142,6 +142,7 @@ class Agent:
             exec_timeout_s=cfg.exec_timeout_s,
             idle_timeout_s=cfg.idle_timeout_s,
             abort_event=abort_event,
+            comm=self.comm,
         )
 
         status = TaskStatus.SUCCEEDED.value

@@ -74,6 +74,13 @@ class Communicator(abc.ABC):
     def send_log(self, task_id: str, lines: List[str]) -> None:
         ...
 
+    def select_tests(
+        self, task_id: str, tests: List[str], strategies: str = ""
+    ) -> List[str]:
+        """Test-selection recommendation; the default (no server
+        strategy available) selects everything."""
+        return list(tests)
+
 
 class LocalCommunicator(Communicator):
     """Direct store binding — the in-process transport used by the smoke
@@ -160,6 +167,13 @@ class LocalCommunicator(Communicator):
         task_mod.coll(self.store).update(task_id, {"last_heartbeat": now})
         t = task_mod.get(self.store, task_id)
         return bool(t and t.aborted)
+
+    def select_tests(
+        self, task_id: str, tests: List[str], strategies: str = ""
+    ) -> List[str]:
+        from ..models.testselection import select_tests
+
+        return select_tests(self.store, task_id, tests, strategies)
 
     def end_task(
         self, task_id: str, status: str, details_type: str = "",

@@ -83,6 +83,9 @@ class CommandContext:
     idle_timeout_s: float = 0.0
     #: sink for generate.tasks payloads, keyval state, etc.
     artifacts: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: the agent's communicator — commands that consult the server
+    #: (test_selection.get) use it; None in bare command tests
+    comm: Any = None
 
 
 class Command(abc.ABC):

@@ -454,12 +454,79 @@ class PerfSend(Command):
 @register_command
 class TestSelectionGet(Command):
     """Ask the test-selection service which tests to run (reference
-    test_selection.get + config_test_selection.go); without a configured
-    service every test is selected."""
+    agent/command/test_selection_get.go + config_test_selection.go).
+
+    Params mirror the reference: ``output_file`` (required — a JSON file
+    of ``{"tests": [{"name": ...}]}`` is written), ``tests`` and/or
+    ``tests_file`` (a JSON array of names), ``usage_rate`` (0..1 —
+    proportion of runs that actually apply selection; otherwise a no-op
+    that selects everything), ``strategies`` (comma-separated names for
+    the service). The selection backend is the server's strategy over
+    historical test results (models/testselection.py); without a
+    communicator every test is selected — the service is advisory and
+    must never silently drop coverage.
+    """
 
     name = "test_selection.get"
 
     def execute(self, ctx: CommandContext) -> CommandResult:
-        tests = self.params.get("tests", [])
-        ctx.expansions.put("selected_tests", ",".join(tests))
+        import random
+
+        output_file = ctx.expansions.expand(
+            str(self.params.get("output_file", ""))
+        )
+        if not output_file:
+            return CommandResult(
+                failed=True, error="must specify output_file"
+            )
+        tests = [
+            ctx.expansions.expand(str(x))
+            for x in self.params.get("tests", [])
+        ]
+        tests_file = ctx.expansions.expand(
+            str(self.params.get("tests_file", ""))
+        )
+        if tests_file:
+            try:
+                with open(_resolve(ctx, tests_file)) as f:
+                    tests.extend(str(x) for x in json.load(f))
+            except (OSError, json.JSONDecodeError) as e:
+                return CommandResult(
+                    failed=True, error=f"reading tests_file: {e}"
+                )
+        # str() first: a YAML numeric 0 must mean "never", not falsy-default
+        rate_raw = ctx.expansions.expand(
+            str(self.params.get("usage_rate", "1"))
+        ) or "1"
+        try:
+            rate = float(rate_raw)
+        except ValueError:
+            return CommandResult(
+                failed=True, error=f"bad usage_rate {rate_raw!r}"
+            )
+        if not (0.0 <= rate <= 1.0):
+            return CommandResult(
+                failed=True, error="usage_rate must be between 0 and 1"
+            )
+        strategies = ctx.expansions.expand(
+            str(self.params.get("strategies", ""))
+        )
+
+        selected = tests
+        if ctx.comm is not None and random.random() < rate:
+            try:
+                selected = ctx.comm.select_tests(
+                    ctx.task_id, tests, strategies
+                )
+            except Exception as e:  # advisory: failure -> run everything
+                ctx.log(f"test selection unavailable ({e}); running all")
+                selected = tests
+        path = _resolve(ctx, output_file)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"tests": [{"name": n} for n in selected]}, f)
+        ctx.expansions.put("selected_tests", ",".join(selected))
+        ctx.log(
+            f"test_selection.get: {len(selected)}/{len(tests)} selected"
+        )
         return CommandResult()

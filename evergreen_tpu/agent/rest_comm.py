@@ -114,6 +114,17 @@ class RestCommunicator(Communicator):
             "POST", f"/rest/v2/tasks/{task_id}/agent/end", body
         )
 
+    def select_tests(
+        self, task_id: str, tests: List[str], strategies: str = ""
+    ) -> List[str]:
+        resp = self._call(
+            "POST", f"/rest/v2/tasks/{task_id}/select_tests",
+            {"tests": tests, "strategies": strategies},
+        )
+        out = resp.get("tests")
+        # advisory service: any malformed answer means run everything
+        return [str(x) for x in out] if isinstance(out, list) else list(tests)
+
     def send_log(self, task_id: str, lines: List[str]) -> None:
         self._call(
             "POST", f"/rest/v2/tasks/{task_id}/agent/logs", {"lines": lines}

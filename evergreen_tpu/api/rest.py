@@ -306,6 +306,8 @@ class RestApi:
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/queue_position",
           self.queue_position)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/tests", self.task_tests)
+        r("POST", r"/rest/v2/tasks/(?P<task>[^/]+)/select_tests",
+          self.select_tests)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/artifacts", self.task_artifacts)
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/annotations", self.get_annotations)
         r("PUT", r"/rest/v2/tasks/(?P<task>[^/]+)/annotation", self.put_annotation)
@@ -1081,6 +1083,21 @@ class RestApi:
                 self.store, match["task"], int(body.get("execution", 0) or 0)
             )
         ]
+
+    def select_tests(self, method, match, body):
+        """Test-selection recommendation (the TSS seam,
+        models/testselection.py; reference test_selection.get)."""
+        from ..models.testselection import select_tests
+
+        tests = body.get("tests") or []
+        if not isinstance(tests, list):
+            raise ApiError(400, "tests must be a list")
+        return 200, {
+            "tests": select_tests(
+                self.store, match["task"], [str(x) for x in tests],
+                strategies=str(body.get("strategies", "")),
+            )
+        }
 
     def task_artifacts(self, method, match, body):
         import dataclasses as _dc

@@ -1,0 +1,73 @@
+"""Test selection service.
+
+The reference delegates to an external test-selection service (TSS) —
+config_test_selection.go + the test_selection.get agent command — whose
+job is to recommend the subset of a task's tests worth running. This is
+the in-process equivalent behind the same command: strategies over the
+framework's own historical test results.
+
+Default strategy ``failed-first``: a test is DESELECTED only when recent
+history for the same (project, variant, task) shows it consistently
+passing; failures anywhere in the window and tests with no history (new
+tests) are always selected. That matches the TSS goal — skip the tests
+that demonstrably never fail — while never skipping anything the data
+cannot vouch for.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..globals import TASK_COMPLETED_STATUSES
+from ..storage.store import Store
+from . import artifact as artifact_mod
+from . import task as task_mod
+
+#: how many recent finished executions of the same task definition to consult
+HISTORY_WINDOW = 5
+#: minimum consistently-passing observations before a test may be skipped
+MIN_OBSERVATIONS = 2
+
+
+def select_tests(
+    store: Store, task_id: str, tests: List[str], strategies: str = ""
+) -> List[str]:
+    """Recommend the subset of ``tests`` to run for ``task_id``.
+
+    Unknown strategy names fall back to selecting everything (the
+    reference treats the service as advisory — a selection failure must
+    never drop coverage).
+    """
+    if not tests:
+        return []
+    strategy = (strategies or "failed-first").split(",")[0].strip()
+    if strategy not in ("failed-first",):
+        return list(tests)
+    t = task_mod.get(store, task_id)
+    if t is None:
+        return list(tests)
+
+    # recent finished runs of the same task definition (any execution)
+    history = task_mod.find(
+        store,
+        lambda d: d["project"] == t.project
+        and d["build_variant"] == t.build_variant
+        and d["display_name"] == t.display_name
+        and d["_id"] != task_id
+        and d["status"] in TASK_COMPLETED_STATUSES,
+    )
+    history.sort(key=lambda h: h.finish_time, reverse=True)
+    passes: Dict[str, int] = {}
+    failed: set = set()
+    for h in history[:HISTORY_WINDOW]:
+        for r in artifact_mod.get_test_results(store, h.id, h.execution):
+            if r.status == "pass":
+                passes[r.test_name] = passes.get(r.test_name, 0) + 1
+            else:
+                failed.add(r.test_name)
+
+    selected = [
+        name
+        for name in tests
+        if name in failed or passes.get(name, 0) < MIN_OBSERVATIONS
+    ]
+    return selected

@@ -34,7 +34,7 @@ from ..models.task_queue import DistroQueueInfo, TaskGroupInfo, TaskQueue
 from ..storage.store import Store
 from . import serial
 from .persister import persist_task_queue
-from .snapshot import Snapshot, build_snapshot, compute_deps_met
+from .snapshot import Snapshot, build_snapshot
 
 
 #: distro-id suffix marking secondary (alias) queue rows in the solve

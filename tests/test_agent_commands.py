@@ -247,3 +247,96 @@ def test_idle_timeout_vs_active_output(tmp_path):
         get_command("shell.exec", {"script": "sleep 60"}).execute(ctx2)
     assert _t.time() - t0 < 20
     assert any("idle timeout" in line for line in lines2)
+
+
+def test_test_selection_failed_first(store, tmp_path):
+    """models/testselection.py: consistently-passing tests are skipped;
+    failures and new tests always run; the command writes the reference's
+    output-file shape."""
+    import json as _json
+
+    from evergreen_tpu.agent.comm import LocalCommunicator
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.models import artifact as artifact_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.artifact import TestResult
+    from evergreen_tpu.models.task import Task
+
+    common = dict(project="p", build_variant="bv", display_name="unit",
+                  status="success")
+    # history: stable always passes; flaky failed once; "new" has none
+    for i in range(3):
+        hid = f"hist{i}"
+        task_mod.insert(store, Task(id=hid, finish_time=1000.0 + i, **common))
+        artifact_mod.attach_test_results(store, hid, 0, [
+            TestResult(test_name="stable", status="pass"),
+            TestResult(test_name="flaky",
+                       status="fail" if i == 1 else "pass"),
+        ])
+    task_mod.insert(store, Task(id="cur", **common))
+
+    from evergreen_tpu.models.testselection import select_tests
+    got = select_tests(store, "cur", ["stable", "flaky", "new"])
+    assert got == ["flaky", "new"]
+    # unknown strategy is advisory: select everything
+    assert select_tests(store, "cur", ["stable"], "quantum") == ["stable"]
+
+    # the command end to end through a communicator
+    from evergreen_tpu.agent.command.base import (
+        CommandContext,
+        Expansions,
+        get_command,
+    )
+
+    comm = LocalCommunicator(store, DispatcherService(store))
+    ctx = CommandContext(work_dir=str(tmp_path), expansions=Expansions({}),
+                         task_id="cur", comm=comm)
+    cmd = get_command("test_selection.get", {
+        "output_file": "selected.json",
+        "tests": ["stable", "flaky", "new"],
+    })
+    res = cmd.execute(ctx)
+    assert not res.failed
+    out = _json.load(open(tmp_path / "selected.json"))
+    assert [t["name"] for t in out["tests"]] == ["flaky", "new"]
+    assert ctx.expansions.get("selected_tests") == "flaky,new"
+
+    # usage_rate 0 -> no-op: everything selected
+    cmd = get_command("test_selection.get", {
+        "output_file": "all.json", "usage_rate": "0",
+        "tests": ["stable", "flaky"],
+    })
+    assert not cmd.execute(ctx).failed
+    out = _json.load(open(tmp_path / "all.json"))
+    assert [t["name"] for t in out["tests"]] == ["stable", "flaky"]
+
+    # missing output_file is a command failure (reference validate())
+    cmd = get_command("test_selection.get", {"tests": ["x"]})
+    assert cmd.execute(ctx).failed
+
+
+def test_test_selection_numeric_zero_usage_rate_disables(store, tmp_path):
+    """YAML numeric 0 (not just the string \"0\") must disable selection."""
+    import json as _json
+
+    from evergreen_tpu.agent.comm import LocalCommunicator
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.agent.command.base import (
+        CommandContext,
+        Expansions,
+        get_command,
+    )
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.task import Task
+
+    task_mod.insert(store, Task(id="cur", project="p", build_variant="bv",
+                                display_name="unit"))
+    comm = LocalCommunicator(store, DispatcherService(store))
+    ctx = CommandContext(work_dir=str(tmp_path), expansions=Expansions({}),
+                         task_id="cur", comm=comm)
+    cmd = get_command("test_selection.get", {
+        "output_file": "z.json", "usage_rate": 0, "tests": ["a", "b"],
+    })
+    assert not cmd.execute(ctx).failed
+    out = _json.load(open(tmp_path / "z.json"))
+    assert [t["name"] for t in out["tests"]] == ["a", "b"]
