@@ -358,6 +358,11 @@ class GraphQLApi:
             "buildVariants": self._q_build_variants,
             "displayTasks": self._q_display_tasks,
             "patches": self._q_patches,
+            "waterfall": self._q_waterfall,
+            "taskArtifacts": self._q_task_artifacts,
+            "user": self._q_user,
+            "taskQueue": self._q_task_queue,
+            "annotation": self._q_annotation,
         }
         self.mutations: Dict[str, Callable] = {
             "scheduleTask": self._m_schedule,
@@ -444,6 +449,97 @@ class GraphQLApi:
         doc = h.to_doc()
         doc["id"] = doc["_id"]
         return doc
+
+    def _q_waterfall(self, projectId: str, limit: int = 10):
+        """Spruce waterfall grid: recent MAINLINE versions × variant
+        status rollups (reference graphql waterfall resolvers — patch
+        and trigger versions never appear on the waterfall)."""
+        from ..globals import (
+            TASK_IN_PROGRESS_STATUSES,
+            TaskStatus,
+            is_mainline_requester,
+        )
+
+        versions = version_mod.find(
+            self.store,
+            lambda d: d["project"] == projectId
+            and is_mainline_requester(d.get("requester", "")),
+        )
+        versions.sort(key=lambda v: v.revision_order_number, reverse=True)
+        selected = versions[: int(limit)]
+        wanted = {v.id for v in selected}
+        # one grouped scan over tasks, not one scan per version
+        cells: Dict[tuple, dict] = {}
+        for doc in task_mod.coll(self.store).find(
+            lambda d: d["version"] in wanted
+        ):
+            cell = cells.setdefault(
+                (doc["version"], doc["build_variant"]),
+                {"name": doc["build_variant"], "total": 0, "success": 0,
+                 "failed": 0, "in_progress": 0},
+            )
+            cell["total"] += 1
+            status = doc["status"]
+            if status == TaskStatus.SUCCEEDED.value:
+                cell["success"] += 1
+            elif status == TaskStatus.FAILED.value:
+                cell["failed"] += 1
+            elif status in TASK_IN_PROGRESS_STATUSES:
+                cell["in_progress"] += 1
+        return [
+            {
+                "id": v.id, "revision": v.revision, "message": v.message,
+                "order": v.revision_order_number, "status": v.status,
+                "build_variants": sorted(
+                    (c for (vid, _), c in cells.items() if vid == v.id),
+                    key=lambda c: c["name"],
+                ),
+            }
+            for v in selected
+        ]
+
+    def _q_task_artifacts(self, taskId: str, execution: int = 0):
+        from ..models.artifact import get_artifacts
+
+        return [
+            {"name": f.name, "link": f.link, "visibility": f.visibility}
+            for f in get_artifacts(self.store, taskId, int(execution))
+        ]
+
+    def _q_user(self, userId: str):
+        from ..models import user as user_mod
+
+        u = user_mod.get_user(self.store, userId)
+        if u is None:
+            return None
+        # never expose the API key over GraphQL
+        return {"id": u.id, "display_name": u.display_name,
+                "roles": list(u.roles)}
+
+    def _q_task_queue(self, distroId: str):
+        from ..models import task_queue as tq_mod
+
+        q = tq_mod.load(self.store, distroId)
+        if q is None:
+            return []
+        return [
+            {"id": i.id, "display_name": i.display_name,
+             "project": i.project, "build_variant": i.build_variant,
+             "expected_duration_s": i.expected_duration_s,
+             "dependencies_met": i.dependencies_met,
+             "task_group": i.task_group}
+            for i in q.queue
+        ]
+
+    def _q_annotation(self, taskId: str, execution: int = 0):
+        from ..models.annotations import get_annotation
+
+        ann = get_annotation(self.store, taskId, int(execution))
+        if ann is None:
+            return None
+        import dataclasses as _dc
+
+        return _dc.asdict(ann)
 
     def _q_my_hosts(self, userId: str):
         """Spruce myHosts: the user's spawn hosts (reference

@@ -12,7 +12,8 @@ from evergreen_tpu.models.version import Version
 
 
 def seed(store):
-    version_mod.insert(store, Version(id="v1", project="p", status="started"))
+    version_mod.insert(store, Version(id="v1", project="p", status="started",
+                                      requester="gitter_request"))
     build_mod.insert(store, Build(id="b1", version="v1", build_variant="lin"))
     task_mod.insert_many(
         store,
@@ -233,3 +234,47 @@ def test_my_hosts_and_volumes(store):
     assert len(out["data"]["myHosts"]) == 1
     assert out["data"]["myHosts"][0]["started_by"] == "alice"
     assert out["data"]["myVolumes"][0]["size_gb"] == 16
+
+
+def test_waterfall_queue_user_annotation_queries(store):
+    from evergreen_tpu.models import user as user_mod
+    from evergreen_tpu.models import annotations as ann_mod
+    from evergreen_tpu.models.task_queue import DistroQueueInfo
+    from evergreen_tpu.scheduler.persister import persist_task_queue
+
+    seed(store)
+    user_mod.create_user(store, "alice", roles=["project:p"])
+    ann_mod.add_issue(store, "t2", 0,
+                      ann_mod.IssueLink(url="http://jira/X-1", added_by="me"))
+    persist_task_queue(store, "d1",
+                       [task_mod.get(store, "t1")], {"t1": 3.0},
+                       {"t1": True}, DistroQueueInfo(), now=1e9)
+    gql = GraphQLApi(store)
+    out = gql.execute("""
+    {
+      waterfall(projectId: "p", limit: 5) {
+        id status build_variants { name total success failed }
+      }
+      taskQueue(distroId: "d1") { id dependencies_met }
+      user(userId: "alice") { id roles }
+      annotation(taskId: "t2") { task_id issues }
+      taskArtifacts(taskId: "t1") { name }
+    }
+    """)
+    assert "errors" not in out, out
+    w = out["data"]["waterfall"]
+    assert w[0]["id"] == "v1"
+    # patch versions never appear on the waterfall
+    version_mod.insert(store, Version(id="vp", project="p",
+                                      requester="patch_request"))
+    w2 = gql.execute('{ waterfall(projectId: "p") { id } }')
+    assert [x["id"] for x in w2["data"]["waterfall"]] == ["v1"]
+    bv = w[0]["build_variants"][0]
+    # the shared seed leaves build_variant unset; the rollup still counts
+    assert bv["total"] == 2 and bv["success"] == 1 and bv["failed"] == 1
+    assert out["data"]["taskQueue"][0]["id"] == "t1"
+    assert out["data"]["user"]["roles"] == ["project:p"]
+    assert out["data"]["annotation"]["issues"][0]["url"] == "http://jira/X-1"
+    # the api key never leaks through the user resolver
+    out2 = gql.execute('{ user(userId: "alice") { id api_key } }')
+    assert out2["data"]["user"].get("api_key") is None
