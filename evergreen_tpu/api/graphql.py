@@ -451,9 +451,11 @@ class GraphQLApi:
         return doc
 
     def _q_waterfall(self, projectId: str, limit: int = 10):
-        """Spruce waterfall grid: recent MAINLINE versions × variant
-        status rollups (reference graphql waterfall resolvers — patch
-        and trigger versions never appear on the waterfall)."""
+        """Spruce waterfall grid: recent mainline versions × variant
+        status rollups (reference graphql waterfall resolvers). Patch
+        versions never appear; system requesters — repotracker commits,
+        periodic/ad-hoc builds and downstream TRIGGER versions — do,
+        matching the reference's SystemVersionRequesterTypes."""
         from ..globals import (
             TASK_IN_PROGRESS_STATUSES,
             TaskStatus,
@@ -466,7 +468,7 @@ class GraphQLApi:
             and is_mainline_requester(d.get("requester", "")),
         )
         versions.sort(key=lambda v: v.revision_order_number, reverse=True)
-        selected = versions[: int(limit)]
+        selected = versions[: max(1, min(int(limit), 50))]
         wanted = {v.id for v in selected}
         # one grouped scan over tasks, not one scan per version
         cells: Dict[tuple, dict] = {}

@@ -333,6 +333,10 @@ class RestApi:
         )
         r("POST", r"/rest/v2/subscriptions", self.create_subscription)
         r("GET", r"/rest/v2/subscriptions", self.list_subscriptions)
+        r("DELETE", r"/rest/v2/subscriptions/(?P<sub>[^/]+)",
+          self.delete_subscription)
+        r("DELETE", r"/rest/v2/distros/(?P<distro>[^/]+)", self.delete_distro)
+        r("DELETE", r"/rest/v2/volumes/(?P<volume>[^/]+)", self.delete_volume)
         r("GET", r"/rest/v2/stats/spans", self.list_spans)
         r("GET", r"/rest/v2/stats/hosts", self.host_stats)
         r("GET", r"/rest/v2/stats/system", self.system_stats)
@@ -1246,7 +1250,10 @@ class RestApi:
                 subscriber_type=body["subscriber_type"],
                 subscriber_target=body["subscriber_target"],
                 filters=body.get("filters", {}),
-                owner=body.get("owner", ""),
+                # the authenticated identity owns what it creates; the
+                # body field only matters in dev mode (no auth)
+                owner=getattr(self._ident, "user", "")
+                or body.get("owner", ""),
             )
         except KeyError as e:
             raise ApiError(400, f"missing subscription field {e}")
@@ -1263,6 +1270,66 @@ class RestApi:
 
     def list_subscriptions(self, method, match, body):
         return 200, self.store.collection("subscriptions").find()
+
+    def delete_subscription(self, method, match, body):
+        """DELETE a subscription by id (reference rest/route
+        subscriptions DELETE; only the owner or a superuser may)."""
+        doc = self.store.collection("subscriptions").get(match["sub"])
+        if doc is None:
+            raise ApiError(404, "subscription not found")
+        owner = doc.get("owner", "")
+        if owner:
+            self._require_owner(owner)
+        elif getattr(self._ident, "user", "") and not getattr(
+            self._ident, "superuser", False
+        ):
+            # unowned (system-created) subscriptions are admin-only to
+            # delete — anyone-can-delete would let one user silently
+            # destroy another's notifications
+            raise ApiError(403, "unowned subscription: admin only")
+        self.store.collection("subscriptions").remove(match["sub"])
+        return 200, {"ok": True}
+
+    def delete_distro(self, method, match, body):
+        """DELETE a distro (reference rest/route/distro.go DELETE; admin
+        path — _ADMIN_PATHS gates it when auth is on). Refused while
+        hosts still reference it."""
+        if distro_mod.get(self.store, match["distro"]) is None:
+            raise ApiError(404, "distro not found")
+        n_hosts = host_mod.coll(self.store).count(
+            lambda d: d["distro_id"] == match["distro"]
+            and d["status"] not in ("terminated",)
+        )
+        if n_hosts:
+            raise ApiError(
+                409, f"distro has {n_hosts} live host(s); drain it first"
+            )
+        distro_mod.coll(self.store).remove(match["distro"])
+        # clear persisted queues so nothing reads phantom demand for a
+        # distro that can never run it (reference DeleteDistroById →
+        # ClearTaskQueue), and leave an audit event
+        from ..models import task_queue as tq_mod
+
+        tq_mod.coll(self.store).remove(match["distro"])
+        tq_mod.coll(self.store, secondary=True).remove(match["distro"])
+        event_mod.log(
+            self.store, event_mod.RESOURCE_HOST, "DISTRO_REMOVED",
+            match["distro"], {},
+        )
+        return 200, {"ok": True}
+
+    def delete_volume(self, method, match, body):
+        """DELETE an unattached volume (reference volume delete)."""
+        from ..cloud import volumes
+
+        v = volumes.get_volume(self.store, match["volume"])
+        if v is None:
+            raise ApiError(404, "volume not found")
+        self._require_owner(v.created_by)
+        if v.host_id:
+            raise ApiError(409, f"volume attached to {v.host_id}; detach first")
+        self.store.collection("volumes").remove(match["volume"])
+        return 200, {"ok": True}
 
     def list_spans(self, method, match, body):
         from ..utils.tracing import get_spans

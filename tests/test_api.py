@@ -632,3 +632,93 @@ def test_spawn_host_ownership_enforced(store):
     assert st == 200
     st, out = call(root, "POST", f"/rest/v2/hosts/{h.id}/terminate")
     assert st == 200
+
+
+def test_delete_routes(store, server):
+    """DELETE subscriptions / distros / volumes (reference DELETE routes),
+    with safety refusals: live hosts block distro delete, attachment
+    blocks volume delete."""
+    base, api = server
+    from evergreen_tpu.cloud.spawnhost import create_spawn_host
+    from evergreen_tpu.cloud.volumes import create_volume, attach_volume
+    from evergreen_tpu.events.triggers import Subscription, add_subscription
+    from evergreen_tpu.globals import Provider
+    from evergreen_tpu.models.host import Host
+
+    comm = RestCommunicator(base)
+    add_subscription(store, Subscription(
+        id="sub1", resource_type="TASK", trigger="outcome",
+        subscriber_type="email", subscriber_target="a@b"))
+    assert comm._call("DELETE", "/rest/v2/subscriptions/sub1") == {"ok": True}
+    assert "error" in comm._call("DELETE", "/rest/v2/subscriptions/sub1")
+
+    distro_mod.insert(store, Distro(id="dd", provider=Provider.MOCK.value))
+    host_mod.insert(store, Host(id="hh", distro_id="dd", status="running"))
+    out = comm._call("DELETE", "/rest/v2/distros/dd")
+    assert "live host" in out.get("error", "")
+    host_mod.coll(store).update("hh", {"status": "terminated"})
+    assert comm._call("DELETE", "/rest/v2/distros/dd") == {"ok": True}
+    assert distro_mod.get(store, "dd") is None
+
+    distro_mod.insert(store, Distro(id="ws2", provider=Provider.MOCK.value))
+    h = create_spawn_host(store, "alice", "ws2")
+    v = create_volume(store, "alice", 4)
+    attach_volume(store, v.id, h.id)
+    out = comm._call("DELETE", f"/rest/v2/volumes/{v.id}")
+    assert "detach first" in out.get("error", "")
+    from evergreen_tpu.cloud.volumes import detach_volume
+    detach_volume(store, v.id)
+    assert comm._call("DELETE", f"/rest/v2/volumes/{v.id}") == {"ok": True}
+
+
+def test_subscription_ownership_on_delete(store):
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.models import user as user_mod
+
+    bob = user_mod.create_user(store, "bob")
+    alice = user_mod.create_user(store, "alice")
+    root = user_mod.create_user(store, "root",
+                                roles=[user_mod.SCOPE_SUPERUSER])
+    api = RestApi(store, require_auth=True)
+
+    def call(u, method, path, body=None):
+        return api.handle(method, path, body or {}, headers={
+            "api-key": u.api_key, "api-user": u.id})
+
+    st, sub = call(bob, "POST", "/rest/v2/subscriptions", {
+        "resource_type": "TASK", "trigger": "outcome",
+        "subscriber_type": "email", "subscriber_target": "bob@x"})
+    assert st == 201 and sub["owner"] == "bob"  # identity-stamped
+    sid = sub["_id"]
+    st, out = call(alice, "DELETE", f"/rest/v2/subscriptions/{sid}")
+    assert st == 403
+    st, out = call(bob, "DELETE", f"/rest/v2/subscriptions/{sid}")
+    assert st == 200
+    # unowned (system-created) subscriptions: admin only
+    store.collection("subscriptions").upsert({
+        "_id": "sys1", "resource_type": "TASK", "trigger": "outcome",
+        "subscriber_type": "email", "subscriber_target": "x",
+        "filters": {}, "owner": "", "enabled": True})
+    st, out = call(alice, "DELETE", "/rest/v2/subscriptions/sys1")
+    assert st == 403 and "admin only" in out["error"]
+    st, out = call(root, "DELETE", "/rest/v2/subscriptions/sys1")
+    assert st == 200
+
+
+def test_delete_distro_clears_queue(store, server):
+    base, api = server
+    from evergreen_tpu.globals import Provider
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.task_queue import DistroQueueInfo
+    from evergreen_tpu.scheduler.persister import persist_task_queue
+
+    from evergreen_tpu.models.task import Task as _Task
+
+    comm = RestCommunicator(base)
+    distro_mod.insert(store, Distro(id="dq", provider=Provider.MOCK.value))
+    task_mod.insert(store, _Task(id="qt", distro_id="dq"))
+    persist_task_queue(store, "dq", [task_mod.get(store, "qt")], {}, {},
+                       DistroQueueInfo(), now=1e9)
+    assert tq_mod.load(store, "dq") is not None
+    assert comm._call("DELETE", "/rest/v2/distros/dq") == {"ok": True}
+    assert tq_mod.load(store, "dq") is None
