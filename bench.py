@@ -10,43 +10,18 @@ Prints ONE JSON line:
 where vs_baseline is the speedup factor (serial ms / tpu ms).
 """
 import json
-import os
 import statistics
-import subprocess
 import sys
 import time
 
+import os
 
-def _probe_tpu(timeout_s: float = 45.0) -> bool:
-    """The axon TPU tunnel sometimes hangs so hard that ``import jax``
-    blocks forever. Probe device initialization in a subprocess with a
-    timeout; on failure, strip the axon plugin and fall back to CPU so the
-    benchmark always completes."""
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        return False
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return False
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+from evergreen_tpu.utils.jaxenv import ensure_usable_backend
 
-
-if os.environ.get("_EVG_BENCH_REEXEC") != "1" and not _probe_tpu():
-    # sitecustomize already registered the axon plugin in THIS interpreter;
-    # clearing the env now is too late — re-exec with a clean environment.
+_cpu_requested = os.environ.get("JAX_PLATFORMS") == "cpu"
+if ensure_usable_backend() == "cpu" and not _cpu_requested:
     print("# tpu unavailable (tunnel probe failed) — cpu fallback",
           file=sys.stderr)
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env["_EVG_BENCH_REEXEC"] = "1"
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 from evergreen_tpu.ops.solve import run_solve_packed
 from evergreen_tpu.scheduler import serial

@@ -476,6 +476,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.fn in (cmd_service, cmd_solver, cmd_smoke):
+        # (bench.py self-hardens with the same helper — no double probe.)
+        # These run the solve. The image's axon TPU tunnel hangs jax backend
+        # init for hours when the relay is down; probe once and pin CPU
+        # rather than hanging the command (see utils/jaxenv.py).
+        from .utils.jaxenv import ensure_usable_backend
+
+        ensure_usable_backend()
     return args.fn(args)
 
 

@@ -1,0 +1,91 @@
+"""JAX environment hardening for the flaky axon/TPU tunnel.
+
+The image's sitecustomize registers the axon PJRT plugin at interpreter
+start whenever ``PALLAS_AXON_POOL_IPS`` is set — and it imports jax while
+doing so.  Two consequences every driver-facing entry point must survive:
+
+1. ``jax`` is already in ``sys.modules`` before any of our code runs, so
+   mutating ``JAX_PLATFORMS`` in ``os.environ`` afterwards is a no-op for
+   this process (jax read it at import time).  The working in-process
+   override is ``jax.config.update("jax_platforms", "cpu")``.
+2. When the tunnel relay is hung, *backend initialization* (the first
+   ``jax.devices()`` / traced op) blocks forever under the ambient
+   ``JAX_PLATFORMS=axon`` — the observed MULTICHIP_r01 rc=124.
+
+``XLA_FLAGS`` (for virtual host devices) is still read at first backend
+init, so setting it post-import but pre-init works.
+
+Empirically verified matrix (2026-07-29, tunnel hung):
+  - ambient env → ``jax.devices()`` blocks >40s
+  - ambient env + ``jax.config.update('jax_platforms','cpu')`` → OK
+  - post-import ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` +
+    config update → 8 CpuDevices
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+
+def probe_tpu(timeout_s: float = 45.0) -> bool:
+    """True iff the axon TPU backend initializes in a fresh subprocess
+    within ``timeout_s``.  The subprocess inherits the ambient env, so it
+    exercises exactly the path the caller would take."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return False
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return False
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend (optionally with ``n_devices``
+    virtual host devices) in a way that works even though sitecustomize
+    already imported jax.  Also scrubs the env so child processes start
+    clean (no axon plugin registration at their interpreter start)."""
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            flags += f" --xla_force_host_platform_device_count={n_devices}"
+        elif int(m.group(1)) < n_devices:
+            flags = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+            )
+        os.environ["XLA_FLAGS"] = flags.strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None and len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices but the CPU backend was already "
+            f"initialized with {len(jax.devices())}; call force_cpu() "
+            "before any jax.devices()/traced op in this process"
+        )
+
+
+def ensure_usable_backend(timeout_s: float = 45.0) -> str:
+    """Keep the real TPU when the tunnel answers; otherwise pin CPU so the
+    caller never hangs.  Returns the platform chosen.
+
+    Only the axon plugin has the hang failure mode, so on machines without
+    it (no ``PALLAS_AXON_POOL_IPS``) jax's normal backend selection is left
+    completely alone — a native TPU/GPU stays usable."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return os.environ.get("JAX_PLATFORMS") or "default"
+    if probe_tpu(timeout_s):
+        return "axon"
+    force_cpu()
+    return "cpu"

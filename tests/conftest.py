@@ -1,17 +1,23 @@
 """Test harness configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported so that
-multi-device sharding paths are exercised without TPU hardware (the analog of
-the reference's real-local-MongoDB test bootstrap, testutil/config.go:28-70).
+Forces JAX onto a virtual 8-device CPU mesh so multi-device sharding paths
+are exercised without TPU hardware (the analog of the reference's
+real-local-MongoDB test bootstrap, testutil/config.go:28-70).
+
+The image exports ``JAX_PLATFORMS=axon`` and its sitecustomize imports jax
+at interpreter start, so a plain ``setdefault`` here is a no-op and env
+mutation alone cannot reach the already-imported jax.  ``force_cpu`` does
+the working override (``jax.config.update``) and scrubs the env for child
+processes; see evergreen_tpu/utils/jaxenv.py for the verified matrix.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from evergreen_tpu.utils.jaxenv import force_cpu
+
+if not os.environ.get("EVG_TEST_REAL_BACKEND"):
+    # Opt-out for running the suite against real hardware on a machine
+    # whose jax env is trustworthy: EVG_TEST_REAL_BACKEND=1 pytest …
+    force_cpu(n_devices=8)
 
 import pytest  # noqa: E402
 

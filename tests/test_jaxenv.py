@@ -1,0 +1,54 @@
+"""The axon-env hardening helper (utils/jaxenv.py) — the contract that keeps
+every driver-facing entry point (bench, __graft_entry__, conftest, CLI) from
+hanging on the image's flaky TPU tunnel."""
+import os
+from unittest import mock
+
+from evergreen_tpu.utils import jaxenv
+
+
+def test_probe_short_circuits_without_axon_env():
+    """No subprocess is spawned when the env can't hang in the first place."""
+    with mock.patch.object(jaxenv.subprocess, "run") as run:
+        with mock.patch.dict(os.environ, {"PALLAS_AXON_POOL_IPS": ""}):
+            assert jaxenv.probe_tpu() is False
+        with mock.patch.dict(
+            os.environ,
+            {"PALLAS_AXON_POOL_IPS": "127.0.0.1", "JAX_PLATFORMS": "cpu"},
+        ):
+            assert jaxenv.probe_tpu() is False
+    run.assert_not_called()
+
+
+def test_ensure_usable_backend_leaves_non_axon_machines_alone():
+    """A native TPU/GPU machine (no axon plugin) must keep jax's own backend
+    selection — forcing CPU there would be a silent perf cliff."""
+    with mock.patch.object(jaxenv, "force_cpu") as fc:
+        with mock.patch.dict(
+            os.environ, {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "tpu"}
+        ):
+            assert jaxenv.ensure_usable_backend() == "tpu"
+    fc.assert_not_called()
+
+
+def test_force_cpu_raises_existing_device_count_flag():
+    """A smaller pre-existing --xla_force_host_platform_device_count value is
+    rewritten upward (a stale value would misdiagnose as backend-already-
+    initialized); a larger one is kept."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    with mock.patch.dict(os.environ, env, clear=True):
+        jaxenv.force_cpu(n_devices=8)
+        assert "--xla_force_host_platform_device_count=8" in os.environ["XLA_FLAGS"]
+        jaxenv.force_cpu(n_devices=4)  # never shrinks
+        assert "--xla_force_host_platform_device_count=8" in os.environ["XLA_FLAGS"]
+
+
+def test_force_cpu_guard_rejects_unreachable_device_count():
+    """Once the CPU backend is initialized (this test process: 8 devices),
+    asking for more must fail loudly instead of silently under-sharding."""
+    import pytest
+
+    with mock.patch.dict(os.environ):  # don't leak XLA_FLAGS=64 to children
+        with pytest.raises(RuntimeError, match="initialized"):
+            jaxenv.force_cpu(n_devices=64)
