@@ -21,8 +21,10 @@ class AgentMonitor:
         min_backoff_s: float = 1.0,
         max_backoff_s: float = 60.0,
         max_respawns: int = 0,
+        host_secret: str = "",
     ) -> None:
         self.host_id = host_id
+        self.host_secret = host_secret
         self.api_server = api_server
         self.working_dir = working_dir
         self.min_backoff_s = min_backoff_s
@@ -36,6 +38,8 @@ class AgentMonitor:
             "--host-id", self.host_id,
             "--api-server", self.api_server,
         ]
+        if self.host_secret:
+            argv += ["--host-secret", self.host_secret]
         if self.working_dir:
             argv += ["--working-dir", self.working_dir]
         return argv

@@ -19,11 +19,17 @@ from .comm import Communicator, TaskConfig
 
 class RestCommunicator(Communicator):
     def __init__(
-        self, base_url: str, retries: int = 3, backoff_s: float = 0.2
+        self, base_url: str, retries: int = 3, backoff_s: float = 0.2,
+        host_id: str = "", host_secret: str = "",
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.retries = retries
         self.backoff_s = backoff_s
+        #: host credential sent on every call (reference: the agent's
+        #: client attaches Host-Id/Host-Secret headers; the secret is
+        #: handed to the agent at deploy time, never over the wire)
+        self.host_id = host_id
+        self.host_secret = host_secret
 
     # -- transport ----------------------------------------------------------- #
 
@@ -32,9 +38,12 @@ class RestCommunicator(Communicator):
         data = json.dumps(body or {}).encode() if method != "GET" else None
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
+            headers = {"Content-Type": "application/json"}
+            if self.host_id:
+                headers["Host-Id"] = self.host_id
+                headers["Host-Secret"] = self.host_secret
             req = urllib.request.Request(
-                url, data=data, method=method,
-                headers={"Content-Type": "application/json"},
+                url, data=data, method=method, headers=headers
             )
             try:
                 with urllib.request.urlopen(req, timeout=30) as resp:

@@ -446,7 +446,7 @@ class GraphQLApi:
         h = host_mod.get(self.store, hostId)
         if h is None:
             return None
-        doc = h.to_doc()
+        doc = h.to_api_doc()
         doc["id"] = doc["_id"]
         return doc
 
@@ -547,7 +547,7 @@ class GraphQLApi:
         """Spruce myHosts: the user's spawn hosts (reference
         graphql host resolvers over host.ByUserWithRunningStatus)."""
         return [
-            {**h.to_doc(), "id": h.id}
+            {**h.to_api_doc(), "id": h.id}
             for h in host_mod.find(
                 self.store,
                 lambda d: d.get("user_host") and d["started_by"] == userId,
@@ -565,7 +565,7 @@ class GraphQLApi:
 
     def _q_hosts(self, distroId: str = ""):
         return [
-            {**h.to_doc(), "id": h.id}
+            {**h.to_api_doc(), "id": h.id}
             for h in host_mod.find(
                 self.store,
                 (lambda d: d["distro_id"] == distroId) if distroId else None,

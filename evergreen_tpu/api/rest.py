@@ -80,16 +80,35 @@ class RestApi:
         from .github_hooks import GithubHookHandler
 
         self.github_hooks = GithubHookHandler(store)
-        self.webhook_secret = ""
+        self._webhook_secret_override = ""
         from ..events.github_status import install as _install_ghs
         from ..events.senders import install as _install_senders
 
         _install_ghs(store)
         _install_senders(store)
 
+    @property
+    def webhook_secret(self) -> str:
+        """Live view of the hook secret: an explicit override (CLI flag or
+        test) wins; otherwise the stored ApiConfig section is consulted per
+        delivery so admin edits apply without a restart."""
+        if self._webhook_secret_override:
+            return self._webhook_secret_override
+        from ..settings import ApiConfig
+
+        return ApiConfig.get(self.store).github_webhook_secret
+
+    @webhook_secret.setter
+    def webhook_secret(self, value: str) -> None:
+        self._webhook_secret_override = value
+
     def _github_hook(self, raw: bytes, headers: Dict[str, str], body: dict):
         from .github_hooks import verify_signature
 
+        if self.require_auth and not self.webhook_secret:
+            # production mode with no secret configured: fail closed rather
+            # than accept unsigned payloads that create versions/patches
+            return 401, {"error": "github webhook secret not configured"}
         if not verify_signature(
             self.webhook_secret, raw, headers.get("x-hub-signature-256", "")
         ):
@@ -102,27 +121,93 @@ class RestApi:
     ) -> Optional[Tuple[int, Any]]:
         """API-key auth + role gating (reference: gimlet auth middleware +
         role manager, environment.go:1249; agent routes use host
-        credentials instead of user keys)."""
-        if self._rate_limiter is not None:
-            key = headers.get("api-user") or headers.get("x-forwarded-for", "anon")
-            if not self._rate_limiter.allow(key):
-                return 429, {"error": "rate limit exceeded"}
+        credentials instead of user keys).
+
+        Rate limiting is two-tier: a coarse PRE-auth bucket keyed on the
+        server-derived peer address (bounds credential brute-forcing, which
+        fails before identity exists), then a per-identity bucket AFTER
+        auth.  Neither keys on spoofable client headers when auth is on —
+        rotating identities would bypass the limit, and spoofing a
+        victim's would starve them."""
         self._ident.user = ""
         self._ident.superuser = False
-        if not self.require_auth or _AGENT_PATHS.match(path):
-            return None
-        from ..models import user as user_mod
+        if self._rate_limiter is not None:
+            peer = headers.get("x-peer-addr") or "anon"
+            if not self._rate_limiter.allow(
+                f"peer:{peer}", limit=4 * self._rate_limiter.limit
+            ):
+                return 429, {"error": "rate limit exceeded"}
+        denied = None
+        if self.require_auth and _AGENT_PATHS.match(path):
+            denied = self._authorize_agent(path, headers)
+        elif self.require_auth:
+            from ..models import user as user_mod
 
-        u = user_mod.user_by_api_key(self.store, headers.get("api-key", ""))
-        if u is None or u.id != headers.get("api-user", u.id):
-            return 401, {"error": "invalid or missing API credentials"}
-        self._ident.user = u.id
-        self._ident.superuser = u.has_scope(user_mod.SCOPE_SUPERUSER)
-        mutating = method in ("POST", "PUT", "PATCH", "DELETE")
-        if mutating and _ADMIN_PATHS.match(path) and not u.has_scope(
-            user_mod.SCOPE_SUPERUSER
+            u = user_mod.user_by_api_key(self.store, headers.get("api-key", ""))
+            if u is None or u.id != headers.get("api-user", u.id):
+                return 401, {"error": "invalid or missing API credentials"}
+            self._ident.user = u.id
+            self._ident.superuser = u.has_scope(user_mod.SCOPE_SUPERUSER)
+            mutating = method in ("POST", "PUT", "PATCH", "DELETE")
+            if mutating and _ADMIN_PATHS.match(path) and not u.has_scope(
+                user_mod.SCOPE_SUPERUSER
+            ):
+                denied = 403, {"error": "admin scope required"}
+        if denied is not None:
+            return denied
+        if self._rate_limiter is not None:
+            # without auth there is no trustworthy identity; the api-user
+            # header at least keeps well-behaved clients in separate
+            # buckets (the peer bucket above still bounds abusers)
+            key = (
+                getattr(self._ident, "user", "")
+                or (not self.require_auth and headers.get("api-user"))
+                or headers.get("x-peer-addr")
+                or "anon"
+            )
+            if not self._rate_limiter.allow(key):
+                return 429, {"error": "rate limit exceeded"}
+        return None
+
+    def _authorize_agent(
+        self, path: str, headers: Dict[str, str]
+    ) -> Optional[Tuple[int, Any]]:
+        """Host-credential auth for the agent protocol (reference
+        rest/route/host_agent.go middleware: every agent call carries
+        Host-Id/Host-Secret; the host doc's secret is set at creation).
+
+        A host may only act as itself: the path's host id must match the
+        credential, and task-scoped routes require the task to be
+        dispatched to (or running on) the authenticated host."""
+        import hmac as _hmac
+
+        host_id = headers.get("host-id", "")
+        h = host_mod.get(self.store, host_id) if host_id else None
+        if (
+            h is None
+            or not h.secret
+            or not _hmac.compare_digest(h.secret, headers.get("host-secret", ""))
         ):
-            return 403, {"error": "admin scope required"}
+            return 401, {"error": "invalid or missing host credentials"}
+        m = re.match(r"^/rest/v2/hosts/([^/]+)/agent/", path)
+        if m and m.group(1) != host_id:
+            return 403, {"error": "host credential does not match path host"}
+        # task-scoped calls — both /tasks/<t>/agent/* and the host-scoped
+        # /hosts/<h>/agent/task_config/<t> — require the task to be bound
+        # to the authenticated host (its resolved config carries expansions)
+        m = re.match(
+            r"^/rest/v2/(?:tasks/([^/]+)/agent/"
+            r"|hosts/[^/]+/agent/task_config/([^/]+)$)",
+            path,
+        )
+        if m:
+            task_id = m.group(1) or m.group(2)
+            t = task_mod.get(self.store, task_id)
+            if t is None:
+                return 404, {"error": f"no task {task_id!r}"}
+            if t.host_id != host_id and h.running_task != t.id:
+                return 403, {"error": "task is not assigned to this host"}
+        self._ident.user = f"host/{host_id}"
         return None
 
     # ------------------------------------------------------------------ #
@@ -181,6 +266,9 @@ class RestApi:
             for k, v in environ.items()
             if k.startswith("HTTP_")
         }
+        # server-derived peer address for rate-limit keying; deliberately
+        # set after the dict build so a spoofed X-Peer-Addr header loses
+        headers["x-peer-addr"] = environ.get("REMOTE_ADDR", "")
         if path in ("/", "/ui"):
             from .ui import PAGE
 
@@ -566,7 +654,7 @@ class RestApi:
             self.store, user, distro,
             no_expiration=bool(body.get("no_expiration", False)),
         )
-        return 200, h.to_doc()
+        return 200, h.to_api_doc()
 
     def _spawn_host_owner(self, host_id: str):
         """Fetch + validate + ownership-gate a spawn host; returns it."""
@@ -715,13 +803,13 @@ class RestApi:
         return 200, {"ok": True}
 
     def list_hosts(self, method, match, body):
-        return 200, [h.to_doc() for h in host_mod.find(self.store)]
+        return 200, [h.to_api_doc() for h in host_mod.find(self.store)]
 
     def get_host(self, method, match, body):
         h = host_mod.get(self.store, match["host"])
         if h is None:
             raise ApiError(404, "host not found")
-        return 200, h.to_doc()
+        return 200, h.to_api_doc()
 
     def list_distros(self, method, match, body):
         return 200, [d.to_doc() for d in distro_mod.find_all(self.store)]

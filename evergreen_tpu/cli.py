@@ -31,6 +31,9 @@ def cmd_service(args) -> int:
         require_auth=args.require_auth,
         rate_limit_per_min=args.rate_limit,
     )
+    if args.github_webhook_secret:
+        # CLI flag wins over the stored ApiConfig section
+        api.webhook_secret = args.github_webhook_secret
     queue = JobQueue(store, workers=args.workers)
     runner = build_cron_runner(store, queue)
     runner.run_background()
@@ -51,7 +54,9 @@ def cmd_agent(args) -> int:
     from .agent.agent import Agent, AgentOptions
     from .agent.rest_comm import RestCommunicator
 
-    comm = RestCommunicator(args.api_server)
+    comm = RestCommunicator(
+        args.api_server, host_id=args.host_id, host_secret=args.host_secret
+    )
     agent = Agent(
         comm,
         AgentOptions(host_id=args.host_id, work_dir=args.working_dir or ""),
@@ -83,6 +88,7 @@ def cmd_agent_monitor(args) -> int:
         api_server=args.api_server,
         working_dir=args.working_dir,
         max_respawns=args.max_respawns,
+        host_secret=args.host_secret,
     ).run()
     return 0
 
@@ -370,10 +376,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="require API keys on user routes")
     s.add_argument("--rate-limit", type=int, default=0,
                    help="requests/min per user (0 = unlimited)")
+    s.add_argument("--github-webhook-secret", default="",
+                   help="HMAC secret for /hooks/github (overrides the "
+                        "stored api config section)")
     s.set_defaults(fn=cmd_service)
 
     a = sub.add_parser("agent", help="run a worker agent")
     a.add_argument("--host-id", required=True)
+    a.add_argument("--host-secret", default="")
     a.add_argument("--api-server", default="http://127.0.0.1:9090")
     a.add_argument("--working-dir", default="")
     a.add_argument("--once", action="store_true",
@@ -382,6 +392,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     am = sub.add_parser("agent-monitor", help="supervise an agent process")
     am.add_argument("--host-id", required=True)
+    am.add_argument("--host-secret", default="")
     am.add_argument("--api-server", default="http://127.0.0.1:9090")
     am.add_argument("--working-dir", default="")
     am.add_argument("--max-respawns", type=int, default=0)

@@ -54,6 +54,7 @@ def create_spawn_host(
         no_expiration=no_expiration,
         expiration_time=0.0 if no_expiration else now + DEFAULT_EXPIRATION_S,
         creation_time=now,
+        secret=uuid.uuid4().hex,
     )
     host_mod.insert(store, h)
     event_mod.log(

@@ -8,6 +8,7 @@ doc), and decommission when dropped from the settings list.
 from __future__ import annotations
 
 import time as _time
+import uuid
 from typing import List, Optional
 
 from ..globals import HostStatus, Provider
@@ -64,6 +65,7 @@ def update_static_distro(
                     ip_address=name,
                     provision_time=now,
                     last_communication_time=now,
+                    secret=uuid.uuid4().hex,
                 ),
             )
             out.append(hid)

@@ -170,6 +170,16 @@ MAX_INTENT_HOSTS_IN_FLIGHT = 5000
 #: (reference: task.UnscheduleStaleUnderwaterHostTasks, one week).
 UNDERWATER_UNSCHEDULE_THRESHOLD_S = 7 * 24 * 3600
 
+#: Per-task time-in-queue is clamped here in BOTH solver paths (device
+#: snapshot + serial oracle + evgpack).  Rationale: the device solve
+#: accumulates unit TIQ in float32, and unbounded ages (months-old tasks ×
+#: large units) push the sum past the 2^24 mantissa where rounding can flip
+#: the floor((tiq/60)/len) rank boundaries against the float64 oracle.  Two
+#: weeks is semantically safe: mainline rank already zeroes out past one
+#: week (planner.go:253-259) and the underwater unscheduler removes
+#: week-old tasks anyway; the clamp just bounds the float32 mass.
+MAX_TASK_TIME_IN_QUEUE_S = 14 * 24 * 3600
+
 #: Alert threshold for estimated makespan at max hosts
 #: (reference scheduler/wrapper.go:22, 24h).
 DYNAMIC_DISTRO_RUNTIME_ALERT_THRESHOLD_S = 24 * 3600

@@ -58,6 +58,11 @@ class Host:
     needs_reprovision: str = ""
     provision_attempts: int = 0
 
+    #: per-host agent credential, generated at creation and handed to the
+    #: agent at deploy time; agent routes authenticate with it (reference
+    #: host.Secret + rest/route middleware host-ID/secret check)
+    secret: str = ""
+
     # Container-pool topology (reference host.go parent/container fields)
     parent_id: str = ""
     has_containers: bool = False
@@ -100,6 +105,14 @@ class Host:
         doc["_id"] = doc.pop("id")
         return doc
 
+    def to_api_doc(self) -> dict:
+        """Store doc minus the agent credential — the ONLY shape API
+        surfaces may serialize (a leaked secret lets any API user
+        impersonate the host's agent)."""
+        doc = self.to_doc()
+        doc.pop("secret", None)
+        return doc
+
     @classmethod
     def from_doc(cls, doc: dict) -> "Host":
         doc = dict(doc)
@@ -120,6 +133,7 @@ def new_intent(distro_id: str, provider: str) -> Host:
         distro_id=distro_id,
         provider=provider,
         status=HostStatus.UNINITIALIZED.value,
+        secret=uuid.uuid4().hex,
     )
 
 

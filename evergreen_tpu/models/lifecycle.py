@@ -107,9 +107,14 @@ def update_dependencies_on_finish(
             return any(d["task_id"] == parent_id for d in doc.get("depends_on", []))
 
         for doc in coll.find(affects):
+            # docs returned by find() alias live store state: mutate a COPY
+            # of the edge list and land it via coll.update so concurrent
+            # readers never see half-updated edges and the change always
+            # fires the dirty-set listener (tick-cache invariant)
+            deps = [dict(d) for d in doc["depends_on"]]
             changed = False
             became_blocked = False
-            for dep in doc["depends_on"]:
+            for dep in deps:
                 if dep["task_id"] != parent_id:
                     continue
                 if parent_blocked:
@@ -124,14 +129,14 @@ def update_dependencies_on_finish(
                             dep["unattainable"] = True
                             became_blocked = True
             if changed:
-                coll.update(doc["_id"], {"depends_on": doc["depends_on"]})
+                coll.update(doc["_id"], {"depends_on": deps})
                 if (
                     not became_blocked
                     and doc["status"] == TaskStatus.UNDISPATCHED.value
                     and doc.get("activated")
                     and all(
                         d["finished"] and not d["unattainable"]
-                        for d in doc["depends_on"]
+                        for d in deps
                     )
                 ):
                     newly_ready.append(doc["_id"])

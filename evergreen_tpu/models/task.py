@@ -14,6 +14,7 @@ from typing import Dict, Iterable, List, Optional
 
 from ..globals import (
     DEFAULT_TASK_DURATION_S,
+    MAX_TASK_TIME_IN_QUEUE_S,
     STEPBACK_TASK_ACTIVATOR,
     TASK_COMPLETED_STATUSES,
     TaskStatus,
@@ -213,9 +214,9 @@ class Task:
         ingest time."""
         now = _time.time() if now is None else now
         if self.activated_time > 0.0:
-            return max(0.0, now - self.activated_time)
+            return min(max(0.0, now - self.activated_time), MAX_TASK_TIME_IN_QUEUE_S)
         if self.ingest_time > 0.0:
-            return max(0.0, now - self.ingest_time)
+            return min(max(0.0, now - self.ingest_time), MAX_TASK_TIME_IN_QUEUE_S)
         return 0.0
 
     def wait_since_dependencies_met(self, now: Optional[float] = None) -> float:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import random
 import secrets
 import time as _time
 from typing import List, Optional
@@ -99,7 +100,11 @@ class RateLimiter:
         self.limit = limit
         self.window_s = window_s
 
-    def allow(self, key: str, now: Optional[float] = None) -> bool:
+    def allow(
+        self, key: str, now: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> bool:
+        limit = self.limit if limit is None else limit
         now = _time.time() if now is None else now
         bucket = int(now // self.window_s)
         doc_id = f"{key}:{bucket}"
@@ -114,6 +119,10 @@ class RateLimiter:
         if not coll.mutate(doc_id, bump):
             coll.upsert({"_id": doc_id, "n": 1, "at": now})
             count["n"] = 1
-        # opportunistic cleanup of old windows
-        coll.remove_where(lambda d: now - d.get("at", now) > 2 * self.window_s)
-        return count["n"] <= self.limit
+        # probabilistic cleanup of old windows — a full-collection scan on
+        # every request would let any client buy O(collection) work per call
+        if random.random() < 1.0 / 64:
+            coll.remove_where(
+                lambda d: now - d.get("at", now) > 2 * self.window_s
+            )
+        return count["n"] <= limit

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..globals import (
     DEFAULT_TASK_DURATION_S,
+    MAX_TASK_TIME_IN_QUEUE_S,
     FeedbackRule,
     Provider,
     RoundingRule,
@@ -479,7 +480,8 @@ def build_snapshot(
             )
         }
         evgpack.pack_task_columns(
-            flat_tasks, now, float(DEFAULT_TASK_DURATION_S), cols
+            flat_tasks, now, float(DEFAULT_TASK_DURATION_S),
+            float(MAX_TASK_TIME_IN_QUEUE_S), cols
         )
     elif n_t:
         fill("t_valid", [True] * n_t)
@@ -507,7 +509,9 @@ def build_snapshot(
         ingest = np.fromiter((t.ingest_time for t in flat_tasks), np.float64, n_t)
         basis = np.where(act > 0.0, act, ingest)
         a["t_time_in_queue_s"][:n_t] = np.where(
-            basis > 0.0, np.maximum(0.0, now - basis), 0.0
+            basis > 0.0,
+            np.minimum(np.maximum(0.0, now - basis), MAX_TASK_TIME_IN_QUEUE_S),
+            0.0,
         )
         sched = np.fromiter(
             (t.scheduled_time for t in flat_tasks), np.float64, n_t

@@ -135,3 +135,16 @@ class NotifyConfig(ConfigSection):
     buffer_target_per_interval: int = 20
     buffer_interval_seconds: int = 60
     eventual_consistency_delay_s: float = 0.0
+
+
+@register_section
+@dataclasses.dataclass
+class ApiConfig(ConfigSection):
+    """HTTP surface settings (reference config_api.go + the webhook secret
+    the GitHub hook route validates against, rest/route/github.go)."""
+
+    section_id = "api"
+
+    url: str = ""
+    github_webhook_secret: str = ""
+    max_request_body_bytes: int = 32 * 1024 * 1024

@@ -81,3 +81,15 @@ def _m0002(store: Store) -> None:
             }
             coll.update(doc["_id"], {"cols": cols})
             coll.mutate(doc["_id"], lambda d: d.pop("queue", None))
+
+
+@register_migration("0003-backfill-host-secrets")
+def _m0003(store: Store) -> None:
+    """Hosts created before agent credentials existed get a secret minted,
+    so enabling ``require_auth`` does not lock out a pre-existing fleet
+    (their agents pick it up on the next monitor-driven respawn)."""
+    import uuid
+
+    coll = store.collection("hosts")
+    for doc in coll.find(lambda d: not d.get("secret")):
+        coll.update(doc["_id"], {"secret": uuid.uuid4().hex})

@@ -49,7 +49,7 @@ double AsDouble(PyObject* obj, bool* ok) {
   return v;
 }
 
-// pack_task_columns(tasks, now, default_duration_s, out) -> None
+// pack_task_columns(tasks, now, default_duration_s, max_tiq_s, out) -> None
 //
 // ``out`` maps column name -> writable contiguous numpy views:
 //   int32:  t_priority, t_group_order, t_num_dependents
@@ -60,8 +60,10 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   PyObject* tasks;
   double now;
   double default_dur;
+  double max_tiq;
   PyObject* out;
-  if (!PyArg_ParseTuple(args, "OddO", &tasks, &now, &default_dur, &out)) {
+  if (!PyArg_ParseTuple(args, "OdddO", &tasks, &now, &default_dur, &max_tiq,
+                        &out)) {
     return nullptr;
   }
   PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
@@ -171,11 +173,11 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
       const double deps_met_t = AsDouble(dmt, &good);
       const double duration = AsDouble(dur, &good);
       if (good) {
-        // Task.time_in_queue: activated time, else ingest time
+        // Task.time_in_queue: activated time, else ingest time; clamped at
+        // MAX_TASK_TIME_IN_QUEUE_S (globals.py) to bound float32 unit sums
         const double basis = activated > 0.0 ? activated : ingest;
-        tiq[i] = basis > 0.0 && now > basis
-                     ? static_cast<float>(now - basis)
-                     : 0.0f;
+        const double raw_tiq = basis > 0.0 && now > basis ? now - basis : 0.0;
+        tiq[i] = static_cast<float>(raw_tiq < max_tiq ? raw_tiq : max_tiq);
         // Task.wait_since_dependencies_met
         const double start = sched > deps_met_t ? sched : deps_met_t;
         wait[i] = start > 0.0 && now > start

@@ -174,11 +174,11 @@ def test_auth_enforcement(store):
     # anonymous user route → 401
     status, _ = api.handle("GET", "/rest/v2/status", {}, {})
     assert status == 401
-    # agent routes stay host-credentialed (exempt)
+    # agent routes require host credentials, not user keys
     status, _ = api.handle(
         "GET", "/rest/v2/hosts/h1/agent/next_task", {}, {}
     )
-    assert status in (404, 200)  # not 401
+    assert status == 401
     # valid key passes; admin mutation needs superuser
     u = user_mod.create_user(store, "dev")
     hdrs = {"api-user": "dev", "api-key": u.api_key}
@@ -193,6 +193,117 @@ def test_auth_enforcement(store):
     status, _ = api.handle(
         "POST", "/rest/v2/admin/settings",
         {"service_flags": {"scheduler_disabled": True}}, hdrs,
+    )
+    assert status == 200
+
+
+def test_agent_host_credential_auth(store):
+    """Agent protocol auth (ADVICE r1 high): a host may only act with its
+    creation-time secret, only as itself, and only on its own tasks."""
+    from evergreen_tpu.globals import HostStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+
+    api = RestApi(store, require_auth=True)
+    h = host_mod.new_intent("d1", "mock")
+    h.status = HostStatus.RUNNING.value
+    host_mod.insert(store, h)
+    assert h.secret  # generated at creation
+    other = host_mod.new_intent("d1", "mock")
+    host_mod.insert(store, other)
+    task_mod.insert(
+        store,
+        task_mod.Task(id="t1", distro_id="d1",
+                      status=TaskStatus.DISPATCHED.value, host_id=h.id),
+    )
+
+    good = {"host-id": h.id, "host-secret": h.secret}
+    # no/wrong credentials → 401
+    assert api.handle(
+        "GET", f"/rest/v2/hosts/{h.id}/agent/next_task", {}, {}
+    )[0] == 401
+    assert api.handle(
+        "GET", f"/rest/v2/hosts/{h.id}/agent/next_task", {},
+        {"host-id": h.id, "host-secret": "nope"},
+    )[0] == 401
+    # valid credentials pass
+    assert api.handle(
+        "GET", f"/rest/v2/hosts/{h.id}/agent/next_task", {}, good
+    )[0] == 200
+    # a host cannot act as another host
+    assert api.handle(
+        "GET", f"/rest/v2/hosts/{other.id}/agent/next_task", {}, good
+    )[0] == 403
+    # task routes: bound host passes, foreign host is rejected
+    assert api.handle(
+        "POST", "/rest/v2/tasks/t1/agent/heartbeat", {}, good
+    )[0] == 200
+    assert api.handle(
+        "POST", "/rest/v2/tasks/t1/agent/end", {"status": "success"},
+        {"host-id": other.id, "host-secret": other.secret},
+    )[0] == 403
+    # host-scoped task_config is task-bound too (expansions live there)
+    assert api.handle(
+        "GET", f"/rest/v2/hosts/{other.id}/agent/task_config/t1", {},
+        {"host-id": other.id, "host-secret": other.secret},
+    )[0] == 403
+
+
+def test_host_secret_never_serialized_by_api(store):
+    """The agent credential must not leak through any read surface —
+    a leaked secret lets any API user impersonate the host's agent."""
+    from evergreen_tpu.models import host as host_mod
+
+    h = host_mod.new_intent("d1", "mock")
+    host_mod.insert(store, h)
+    api = RestApi(store)
+    _, hosts = api.handle("GET", "/rest/v2/hosts", {}, {})
+    assert hosts and all("secret" not in doc for doc in hosts)
+    _, one = api.handle("GET", f"/rest/v2/hosts/{h.id}", {}, {})
+    assert "secret" not in one
+
+    from evergreen_tpu.api.graphql import GraphQLApi
+
+    gql = GraphQLApi(store)
+    data = gql.execute(
+        "query { host(hostId: \"%s\") { id } }" % h.id
+    )
+    assert "errors" not in data or not data["errors"]
+    # raw resolver doc is redacted at source
+    assert "secret" not in (gql._q_host(h.id) or {})
+
+
+def test_host_secret_backfill_migration(store):
+    from evergreen_tpu.storage.migrations import apply_migrations
+
+    store.collection("hosts").insert({"_id": "old-host", "distro_id": "d1",
+                                      "status": "running",
+                                      "started_by": "mci"})
+    results = dict(apply_migrations(store))
+    assert results["0003-backfill-host-secrets"] == "applied"
+    assert store.collection("hosts").get("old-host")["secret"]
+
+
+def test_webhook_secret_fail_closed(store):
+    """Production mode with no webhook secret must reject unsigned hooks
+    (ADVICE r1 medium); configured secret is loaded from ApiConfig."""
+    from evergreen_tpu.settings import ApiConfig
+
+    api = RestApi(store, require_auth=True)
+    status, payload = api._github_hook(b"{}", {}, {})
+    assert status == 401 and "not configured" in payload["error"]
+
+    ApiConfig(github_webhook_secret="s3cret").set(store)
+    api2 = RestApi(store, require_auth=True)
+    assert api2.webhook_secret == "s3cret"
+    import hashlib
+    import hmac as hmac_mod
+
+    raw = b'{"zen": "ok"}'
+    sig = "sha256=" + hmac_mod.new(b"s3cret", raw, hashlib.sha256).hexdigest()
+    status, _ = api2._github_hook(
+        raw, {"x-hub-signature-256": sig, "x-github-event": "ping"},
+        {"zen": "ok"},
     )
     assert status == 200
 

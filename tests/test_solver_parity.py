@@ -86,7 +86,10 @@ def random_problem(rng: random.Random, n_distros=3, max_tasks=40, max_hosts=10):
                 # zeros exercise the fallback branches (ingest-time basis,
                 # zero-wait, default duration) in both solver paths
                 activated_time=rng.choice(
-                    [0.0, NOW - rng.uniform(0, 3e5), NOW - rng.uniform(0, 3e5)]
+                    [0.0, NOW - rng.uniform(0, 3e5), NOW - rng.uniform(0, 3e5),
+                     # ancient task: exercises the MAX_TASK_TIME_IN_QUEUE_S
+                     # clamp identically in device + oracle paths
+                     NOW - rng.uniform(30, 90) * 86400.0]
                 ),
                 create_time=NOW - 4e5,
                 scheduled_time=rng.choice([0.0, NOW - rng.uniform(0, 4e3)]),
