@@ -21,7 +21,33 @@ def cmd_service(args) -> int:
     from .storage.store import global_store
     from .units.crons import build_cron_runner
 
-    store = global_store()
+    lease = None
+    if args.data_dir:
+        # Durable deployment: WAL-backed store + writer lease so a standby
+        # replica can take over this data dir if we die (storage/durable.py)
+        import os as _os
+
+        from .storage.durable import DurableStore
+        from .storage.lease import FileLease
+
+        lease = FileLease(_os.path.join(args.data_dir, "writer.lease"))
+        print(f"acquiring writer lease on {args.data_dir} ...")
+        lease.acquire()
+
+        def _deposed():
+            # Another replica stole the lease while we stalled: stop
+            # writing IMMEDIATELY — two writers on one WAL is split-brain.
+            print("writer lease lost — terminating to avoid split-brain",
+                  file=sys.stderr, flush=True)
+            _os._exit(70)
+
+        lease.start_renewing(on_lost=_deposed)
+        store = DurableStore(args.data_dir)
+        from .storage.store import set_global_store
+
+        set_global_store(store)
+    else:
+        store = global_store()
     from .storage.migrations import apply_migrations
 
     for name, result in apply_migrations(store):
@@ -46,6 +72,9 @@ def cmd_service(args) -> int:
     finally:
         runner.stop()
         queue.close()
+        if lease is not None:
+            store.close()
+            lease.release()
     return 0
 
 
@@ -379,6 +408,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--github-webhook-secret", default="",
                    help="HMAC secret for /hooks/github (overrides the "
                         "stored api config section)")
+    s.add_argument("--data-dir", default="",
+                   help="durable WAL+snapshot data directory (default: "
+                        "in-memory store); replicas sharing it coordinate "
+                        "via a writer lease")
     s.set_defaults(fn=cmd_service)
 
     a = sub.add_parser("agent", help="run a worker agent")

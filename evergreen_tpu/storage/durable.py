@@ -1,0 +1,218 @@
+"""Durable store engine: append-only WAL + snapshot compaction.
+
+The reference's resume story is Mongo-backed statelessness — any app-server
+replica picks up ticks because every document, amboy job, and outbox row
+lives in the shared DB (reference environment.go:431-486, db/db_utils.go).
+This engine gives the same property to a single node without an external
+database: every write that lands in a collection is appended to a
+write-ahead log before the call returns, and recovery replays
+``snapshot.json`` + ``wal.log`` into an ordinary in-memory store.  Kill -9
+the process mid-run and a fresh process resumes with all tasks, queues,
+jobs, and events intact (tests/test_durable_store.py proves it, including
+a real SIGKILL subprocess).
+
+Design notes:
+- Ops are logged as full-document puts (docs are small; this makes
+  ``mutate``/``compare_and_set``/partial ``update`` all journal the same
+  way and keeps replay trivial and idempotent).
+- Serialization happens synchronously under the collection lock so WAL
+  order is exactly apply order; the file append itself is buffered and
+  flushed per-op (an OS-level write survives SIGKILL; fsync — surviving
+  power loss — is available via ``sync="fsync"``).
+- Compaction writes a point-in-time snapshot (atomic tmp+rename) then
+  truncates the WAL; it runs inline when the WAL exceeds
+  ``compact_every_ops`` and at ``close()``.
+- Insertion order is preserved through snapshot+replay because snapshots
+  serialize docs in dict order and puts replay in log order — the
+  ``key_order`` determinism contract the scheduler's tie-breaks rely on.
+
+Multi-process: replicas coordinate through ``FileLease`` (storage/lease.py)
+— one active writer, standbys take over a stale lease and recover from the
+same directory.  See cli.py ``service --data-dir``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+from .store import Collection, Store
+
+SNAPSHOT_FILE = "snapshot.json"
+WAL_FILE = "wal.log"
+
+
+class _Journal:
+    """Append-only op log shared by all collections of one store."""
+
+    def __init__(self, path: str, sync: str = "flush") -> None:
+        self.path = path
+        self.sync = sync  # "none" | "flush" | "fsync"
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", encoding="utf-8")
+        self.ops = 0
+        self.suspended = False  # True during recovery replay
+
+    def append(self, record: dict) -> None:
+        if self.suspended:
+            return
+        line = json.dumps(record, separators=(",", ":"), default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            if self.sync != "none":
+                self._fh.flush()
+                if self.sync == "fsync":
+                    os.fsync(self._fh.fileno())
+            self.ops += 1
+
+    def rotate(self) -> None:
+        """Truncate after a successful snapshot (under the caller's
+        whole-store quiesce)."""
+        with self._lock:
+            self._fh.close()
+            self._fh = open(self.path, "w", encoding="utf-8")
+            self.ops = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                self._fh.close()
+
+
+class DurableStore(Store):
+    """Store whose collections journal every write to a WAL, with
+    snapshot+replay recovery from ``data_dir``."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        sync: str = "flush",
+        compact_every_ops: int = 500_000,
+    ) -> None:
+        super().__init__()
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
+        self.compact_every_ops = compact_every_ops
+        self._compact_lock = threading.Lock()
+        self._journal = _Journal(os.path.join(data_dir, WAL_FILE), sync=sync)
+        self._recover()
+
+    # -- Store interface ----------------------------------------------------- #
+
+    def collection(self, name: str) -> Collection:
+        with self._lock:
+            coll = self._collections.get(name)
+            if coll is None:
+                coll = Collection(name, journal=self._on_op)
+                self._collections[name] = coll
+            return coll
+
+    # -- journaling ---------------------------------------------------------- #
+
+    def _on_op(self, record: dict) -> None:
+        self._journal.append(record)
+        if (
+            self._journal.ops >= self.compact_every_ops
+            and not self._journal.suspended
+        ):
+            self.checkpoint(blocking=False)
+
+    # -- recovery / compaction ----------------------------------------------- #
+
+    def _recover(self) -> None:
+        snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+        self._journal.suspended = True
+        try:
+            if os.path.exists(snap_path):
+                with open(snap_path, encoding="utf-8") as fh:
+                    snap = json.load(fh)
+                for name, docs in snap.get("collections", {}).items():
+                    coll = self.collection(name)
+                    for doc in docs:
+                        coll.upsert(doc)
+            wal_path = self._journal.path
+            if os.path.exists(wal_path):
+                with open(wal_path, encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            # torn final line from a crash mid-append
+                            break
+                        self._apply(rec)
+        finally:
+            self._journal.suspended = False
+
+    def _apply(self, rec: dict) -> None:
+        coll = self.collection(rec["c"])
+        op = rec["o"]
+        if op == "p":
+            coll.upsert(rec["d"])
+        elif op == "pm":
+            for d in rec["ds"]:
+                coll.upsert(d)
+        elif op == "r":
+            coll.remove(rec["i"])
+        elif op == "x":
+            coll.clear()
+
+    def checkpoint(self, blocking: bool = True) -> None:
+        """Write an atomic snapshot of every collection, then truncate the
+        WAL.
+
+        Correctness: writers are fully quiesced by taking the store lock
+        (no new collections) plus every collection's lock in sorted order
+        before the snapshot is cut, so no op can land in memory without
+        being either in the snapshot or in the post-rotation WAL.  The
+        snapshot renames into place before the WAL shrinks, so a crash at
+        any point leaves a recoverable full state.
+
+        ``blocking=False`` (the inline size-trigger path, which runs while
+        holding one collection's lock) skips if another thread is already
+        compacting — that avoids two compactors deadlocking on each
+        other's held collection."""
+        if not self._compact_lock.acquire(blocking=blocking):
+            return
+        acquired: Dict[str, Collection] = {}
+        try:
+            snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+            tmp_path = snap_path + ".tmp"
+            # Quiesce: grab every collection's lock (never while holding the
+            # store lock — a writer inside mutate() may create a collection).
+            # Loop because a collection can be created while we acquire;
+            # once a pass finds nothing new, all writers are blocked.
+            while True:
+                with self._lock:
+                    missing = [
+                        (n, c)
+                        for n, c in sorted(self._collections.items())
+                        if n not in acquired
+                    ]
+                if not missing:
+                    break
+                for name, coll in missing:
+                    coll._lock.acquire()
+                    acquired[name] = coll
+            payload = {
+                # no copy needed: every writer is blocked
+                "collections": {
+                    name: list(coll._docs.values())
+                    for name, coll in sorted(acquired.items())
+                }
+            }
+            with open(tmp_path, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, separators=(",", ":"), default=str)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp_path, snap_path)
+            self._journal.rotate()
+        finally:
+            for coll in acquired.values():
+                coll._lock.release()
+            self._compact_lock.release()
+
+    def close(self) -> None:
+        self.checkpoint()
+        self._journal.close()

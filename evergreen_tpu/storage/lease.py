@@ -1,0 +1,146 @@
+"""File-based writer lease for multi-replica deployments.
+
+The reference runs N app-server replicas against shared Mongo, relying on
+amboy scope locks for mutual exclusion (reference environment.go:469-486).
+With the WAL engine the shared resource is a data directory, so replicas
+coordinate through a lease file instead: exactly one process holds the
+lease and owns the store; standbys poll, and when the holder dies (crash,
+SIGKILL) its lease goes stale and a standby takes over, recovering from
+the same WAL — the "any replica resumes statelessly" property at the
+process level (tests/test_durable_store.py::test_lease_failover).
+
+The lease is a JSON file created with O_EXCL; liveness is signalled by
+re-writing it (renewal) every ``ttl/3``.  A lease older than ``ttl`` is
+considered abandoned and may be stolen.  O_EXCL-create after unlink is the
+atomicity primitive; the steal path re-checks ownership after writing to
+close the two-stealers race.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+import uuid
+from typing import Optional
+
+
+class FileLease:
+    def __init__(self, path: str, ttl_s: float = 10.0) -> None:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self.ttl_s = ttl_s
+        self.owner_id = uuid.uuid4().hex
+        self.lost = False
+        self._renewer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- core ---------------------------------------------------------------- #
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _write(self) -> None:
+        tmp = f"{self.path}.{self.owner_id}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(
+                {"owner": self.owner_id, "pid": os.getpid(),
+                 "at": _time.time()},
+                fh,
+            )
+        os.replace(tmp, self.path)
+
+    def try_acquire(self) -> bool:
+        """One non-blocking attempt; steals a stale lease."""
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            cur = self._read()
+            if cur is not None and cur.get("owner") == self.owner_id:
+                return True
+            if cur is None:
+                # unreadable/corrupt: live unless the FILE is old — an
+                # empty file would otherwise be "stealable" in the instant
+                # between another process's O_EXCL create and its payload
+                # write (closed by writing through the fd, but belt+braces)
+                try:
+                    if _time.time() - os.path.getmtime(self.path) <= self.ttl_s:
+                        return False
+                except OSError:
+                    return False  # vanished: let the next attempt recreate
+            elif _time.time() - cur.get("at", 0) <= self.ttl_s:
+                return False  # live holder
+            # stale — steal, then verify we won the race
+            self._write()
+            _time.sleep(0.05)
+            cur = self._read()
+            return cur is not None and cur.get("owner") == self.owner_id
+        else:
+            # write the payload through the O_EXCL fd itself so no other
+            # process ever observes an empty lease file from us
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(
+                    {"owner": self.owner_id, "pid": os.getpid(),
+                     "at": _time.time()},
+                    fh,
+                )
+            return True
+
+    def acquire(self, timeout_s: Optional[float] = None,
+                poll_s: float = 0.5) -> bool:
+        deadline = None if timeout_s is None else _time.time() + timeout_s
+        while True:
+            if self.try_acquire():
+                return True
+            if deadline is not None and _time.time() >= deadline:
+                return False
+            _time.sleep(poll_s)
+
+    def renew(self) -> bool:
+        cur = self._read()
+        if cur is None or cur.get("owner") != self.owner_id:
+            return False  # lost it (stolen after a long stall)
+        self._write()
+        return True
+
+    def release(self) -> None:
+        self.stop_renewing()
+        cur = self._read()
+        if cur is not None and cur.get("owner") == self.owner_id:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    # -- background renewal --------------------------------------------------- #
+
+    def start_renewing(self, on_lost=None) -> None:
+        """Renew every ttl/3 in a daemon thread.  A failed renewal means
+        the lease was stolen while we stalled: ``self.lost`` is set, the
+        loop stops, and ``on_lost`` (if any) fires — the holder MUST stop
+        serving, or two writers interleave the same WAL (split-brain)."""
+
+        def loop():
+            while not self._stop.wait(self.ttl_s / 3.0):
+                if not self.renew():
+                    self.lost = True
+                    if on_lost is not None:
+                        on_lost()
+                    return
+
+        self.lost = False
+        self._stop.clear()
+        self._renewer = threading.Thread(target=loop, daemon=True)
+        self._renewer.start()
+
+    def stop_renewing(self) -> None:
+        self._stop.set()
+        if self._renewer is not None:
+            self._renewer.join(timeout=2.0)
+            self._renewer = None

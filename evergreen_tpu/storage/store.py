@@ -19,11 +19,20 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 
 class Collection:
-    """A named map of id -> document (a plain dict)."""
+    """A named map of id -> document (a plain dict).
 
-    def __init__(self, name: str) -> None:
+    ``journal`` (optional) is the durability hook: every write op emits a
+    full-document record to it while still holding the collection lock, so
+    a WAL's order is exactly the apply order (see storage/durable.py).
+    Contract for callers: all mutation goes through this API (an in-place
+    edit of a doc returned by get()/find() would dodge the journal), and a
+    ``mutate`` callback must not touch other collections (the compactor
+    acquires collection locks in bulk)."""
+
+    def __init__(self, name: str, journal=None) -> None:
         self.name = name
         self._docs: Dict[str, dict] = {}
+        self._journal = journal
         self._lock = threading.RLock()
         #: change listeners: fn(doc_id) called after any write touching the
         #: doc. Callbacks MUST be trivial (set a dirty flag) — they run
@@ -44,6 +53,14 @@ class Collection:
         for fn in self._listeners:
             fn(doc_id)
 
+    def _log_put(self, doc: dict) -> None:
+        if self._journal is not None:
+            self._journal({"c": self.name, "o": "p", "d": doc})
+
+    def _log_remove(self, doc_id: str) -> None:
+        if self._journal is not None:
+            self._journal({"c": self.name, "o": "r", "i": doc_id})
+
     # -- basic CRUD --------------------------------------------------------- #
 
     def insert(self, doc: dict) -> None:
@@ -55,6 +72,7 @@ class Collection:
             if self._key_order_cache is not None:
                 self._key_order_cache[doc_id] = self._order_rank
             self._order_rank += 1
+            self._log_put(doc)
             self._notify(doc_id)
 
     def upsert(self, doc: dict) -> None:
@@ -64,6 +82,7 @@ class Collection:
                     self._key_order_cache[doc["_id"]] = self._order_rank
                 self._order_rank += 1
             self._docs[doc["_id"]] = doc
+            self._log_put(doc)
             self._notify(doc["_id"])
 
     def insert_many(self, docs: Iterable[dict]) -> None:
@@ -79,6 +98,12 @@ class Collection:
                 if self._key_order_cache is not None:
                     self._key_order_cache[doc["_id"]] = self._order_rank
                 self._order_rank += 1
+            # journal AFTER applying: the append may trigger an inline
+            # auto-compaction whose snapshot must already contain the batch
+            # (the rotation discards this record)
+            if docs and self._journal is not None:
+                self._journal({"c": self.name, "o": "pm", "ds": docs})
+            for doc in docs:
                 self._notify(doc["_id"])
 
     def get(self, doc_id: str) -> Optional[dict]:
@@ -114,6 +139,7 @@ class Collection:
             if gone:
                 if self._key_order_cache is not None:
                     self._key_order_cache.pop(doc_id, None)
+                self._log_remove(doc_id)
                 self._notify(doc_id)
             return gone
 
@@ -124,6 +150,7 @@ class Collection:
                 del self._docs[i]
                 if self._key_order_cache is not None:
                     self._key_order_cache.pop(i, None)
+                self._log_remove(i)
                 self._notify(i)
             return len(doomed)
 
@@ -133,6 +160,8 @@ class Collection:
             self._docs.clear()
             self._key_order_cache = None
             self._order_rank = 0
+            if ids and self._journal is not None:
+                self._journal({"c": self.name, "o": "x"})
             for i in ids:
                 self._notify(i)
 
@@ -170,6 +199,7 @@ class Collection:
                 if doc.get(key) != val:
                     return False
             doc.update(update)
+            self._log_put(doc)
             self._notify(doc_id)
             return True
 
@@ -179,6 +209,7 @@ class Collection:
             if doc is None:
                 return False
             doc.update(update)
+            self._log_put(doc)
             self._notify(doc_id)
             return True
 
@@ -190,6 +221,7 @@ class Collection:
             for doc in self._docs.values():
                 if pred(doc):
                     doc.update(update)
+                    self._log_put(doc)
                     self._notify(doc["_id"])
                     n += 1
             return n
@@ -201,6 +233,7 @@ class Collection:
             if doc is None:
                 return False
             fn(doc)
+            self._log_put(doc)
             self._notify(doc_id)
             return True
 
@@ -227,15 +260,21 @@ class Store:
 
     def clear_collections(self, *names: str) -> None:
         """Test seam, mirroring the reference's db.ClearCollections pattern
-        (reference testutil usage throughout *_test.go)."""
+        (reference testutil usage throughout *_test.go).
+
+        The store lock is NOT held while clearing: taking collection locks
+        under it would invert the durable compactor's order (collection
+        locks first, store lock briefly after) and deadlock."""
         with self._lock:
             if not names:
-                for coll in self._collections.values():
-                    coll.clear()
+                targets = list(self._collections.values())
             else:
-                for name in names:
-                    if name in self._collections:
-                        self._collections[name].clear()
+                targets = [
+                    self._collections[n] for n in names
+                    if n in self._collections
+                ]
+        for coll in targets:
+            coll.clear()
 
     def __getitem__(self, name: str) -> Collection:
         return self.collection(name)
@@ -260,3 +299,12 @@ def reset_global_store() -> Store:
     with _GLOBAL_LOCK:
         _GLOBAL_STORE = Store()
         return _GLOBAL_STORE
+
+
+def set_global_store(store: Store) -> Store:
+    """Install a specific store (e.g. a DurableStore) as the process-wide
+    default."""
+    global _GLOBAL_STORE
+    with _GLOBAL_LOCK:
+        _GLOBAL_STORE = store
+        return store

@@ -1,0 +1,244 @@
+"""Durable store engine: WAL + snapshot recovery, SIGKILL survival, writer
+lease failover — the reference's Mongo-backed stateless-resume property
+(environment.go:431-486) at the single-node level."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from evergreen_tpu.storage.durable import DurableStore
+from evergreen_tpu.storage.lease import FileLease
+
+
+def test_basic_ops_survive_reopen(tmp_path):
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    c = s.collection("tasks")
+    c.insert({"_id": "t1", "status": "undispatched", "priority": 1})
+    c.insert({"_id": "t2", "status": "undispatched"})
+    c.update("t1", {"status": "dispatched"})
+    assert c.compare_and_set("t2", {"status": "undispatched"},
+                             {"status": "dispatched"})
+    c.mutate("t1", lambda doc: doc.setdefault("tags", []).append("x"))
+    c.insert({"_id": "t3", "status": "will-be-removed"})
+    c.remove("t3")
+    s.collection("events").insert_many(
+        [{"_id": f"e{i}", "n": i} for i in range(5)]
+    )
+    # no close() — simulates process death with only buffered appends
+
+    s2 = DurableStore(d)
+    t1 = s2.collection("tasks").get("t1")
+    assert t1["status"] == "dispatched" and t1["tags"] == ["x"]
+    assert s2.collection("tasks").get("t2")["status"] == "dispatched"
+    assert s2.collection("tasks").get("t3") is None
+    assert len(s2.collection("events")) == 5
+
+
+def test_key_order_preserved_across_recovery(tmp_path):
+    """Insertion-order ranks are the scheduler's deterministic tie-break;
+    recovery must reproduce them (snapshot order + WAL replay order)."""
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    c = s.collection("tasks")
+    ids = [f"t{i}" for i in range(20)]
+    for i in ids:
+        c.insert({"_id": i})
+    c.remove("t7")
+    s.checkpoint()
+    c.insert({"_id": "late1"})
+    c.insert({"_id": "late2"})
+
+    s2 = DurableStore(d)
+    order = s2.collection("tasks").key_order()
+    expect = [i for i in ids if i != "t7"] + ["late1", "late2"]
+    assert sorted(order, key=order.__getitem__) == expect
+
+
+def test_checkpoint_compacts_and_recovers(tmp_path):
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    c = s.collection("k")
+    for i in range(50):
+        c.upsert({"_id": "x", "i": i})
+    assert s._journal.ops == 50
+    s.checkpoint()
+    assert s._journal.ops == 0
+    assert os.path.getsize(os.path.join(d, "wal.log")) == 0
+    c.upsert({"_id": "x", "i": 99})
+
+    s2 = DurableStore(d)
+    assert s2.collection("k").get("x")["i"] == 99
+
+
+def test_auto_compaction_threshold(tmp_path):
+    d = str(tmp_path / "data")
+    s = DurableStore(d, compact_every_ops=10)
+    c = s.collection("k")
+    for i in range(25):
+        c.upsert({"_id": f"d{i}"})
+    # WAL was rotated at least twice; state intact on reopen
+    assert s._journal.ops < 25
+    s2 = DurableStore(d)
+    assert len(s2.collection("k")) == 25
+
+
+def test_insert_many_survives_inline_compaction(tmp_path):
+    """The batch append itself can trigger auto-compaction; the snapshot it
+    cuts must already contain the batch (journal-after-apply ordering)."""
+    d = str(tmp_path / "data")
+    s = DurableStore(d, compact_every_ops=1)
+    s.collection("k").insert_many([{"_id": f"b{i}"} for i in range(10)])
+    s2 = DurableStore(d)
+    assert len(s2.collection("k")) == 10
+
+
+def test_clear_collections_on_durable_store(tmp_path):
+    """clear_collections must not deadlock against the compactor's lock
+    order (collection locks first, store lock briefly after)."""
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    s.collection("a").insert({"_id": "x"})
+    s.collection("b").insert({"_id": "y"})
+    s.clear_collections("a")
+    s.checkpoint()
+    s2 = DurableStore(d)
+    assert len(s2.collection("a")) == 0
+    assert s2.collection("b").get("y") is not None
+
+
+def test_torn_final_wal_line_tolerated(tmp_path):
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    s.collection("k").insert({"_id": "ok"})
+    with open(os.path.join(d, "wal.log"), "a", encoding="utf-8") as fh:
+        fh.write('{"c":"k","o":"p","d":{"_id":"torn"')  # crash mid-append
+    s2 = DurableStore(d)
+    assert s2.collection("k").get("ok") is not None
+    assert s2.collection("k").get("torn") is None
+
+
+def test_sigkill_subprocess_resumes(tmp_path):
+    """The VERDICT's acceptance test: kill -9 a process mid-run; a fresh
+    process resumes tasks/queues/jobs/events from the same directory."""
+    d = str(tmp_path / "data")
+    child_src = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {os.getcwd()!r})
+        from evergreen_tpu.storage.durable import DurableStore
+        s = DurableStore({d!r})
+        tasks = s.collection("tasks")
+        jobs = s.collection("jobs")
+        events = s.collection("events")
+        for i in range(200):
+            tasks.insert({{"_id": f"t{{i}}", "status": "undispatched"}})
+            jobs.upsert({{"_id": f"j{{i % 7}}", "state": "running", "i": i}})
+            events.insert({{"_id": f"e{{i}}", "kind": "TASK_CREATED"}})
+        s.collection("task_queues").upsert(
+            {{"_id": "d1", "cols": {{"id": [f"t{{i}}" for i in range(200)]}}}}
+        )
+        print("SEEDED", flush=True)
+        time.sleep(60)   # parked: the only way out is SIGKILL
+    """)
+    env = {**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    p = subprocess.Popen(
+        [sys.executable, "-c", child_src], stdout=subprocess.PIPE, env=env
+    )
+    try:
+        line = p.stdout.readline().decode()
+        assert "SEEDED" in line
+    finally:
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+
+    s = DurableStore(d)  # the replacement process
+    assert len(s.collection("tasks")) == 200
+    assert len(s.collection("jobs")) == 7
+    assert len(s.collection("events")) == 200
+    q = s.collection("task_queues").get("d1")
+    assert q and len(q["cols"]["id"]) == 200
+
+
+def test_full_tick_on_durable_store(tmp_path):
+    """The scheduler runs unchanged on the durable engine, and its outputs
+    (queues, intent hosts) survive a reopen."""
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models import task_queue as queue_mod
+    from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    distro_mod.insert(
+        s, Distro(id="d1",
+                  host_allocator_settings=HostAllocatorSettings(
+                      maximum_hosts=5)),
+    )
+    task_mod.insert_many(
+        s,
+        [Task(id=f"t{i}", distro_id="d1", status="undispatched",
+              activated=True, expected_duration_s=60.0) for i in range(8)],
+    )
+    run_tick(s, TickOptions())
+    q = queue_mod.load(s, "d1")
+    assert q is not None and len(q.queue) == 8
+
+    s2 = DurableStore(d)
+    q2 = queue_mod.load(s2, "d1")
+    assert [i.id for i in q2.queue] == [i.id for i in q.queue]
+    assert len(s2.collection("hosts")) > 0  # intent hosts persisted
+
+
+def test_lease_mutual_exclusion_and_failover(tmp_path):
+    path = str(tmp_path / "writer.lease")
+    a = FileLease(path, ttl_s=0.6)
+    b = FileLease(path, ttl_s=0.6)
+    assert a.try_acquire()
+    assert not b.try_acquire()        # live holder blocks standby
+    assert a.renew()
+    assert not b.try_acquire()
+    # holder "dies" (no release, no renewals) → lease goes stale → steal
+    time.sleep(0.8)
+    assert b.try_acquire()
+    assert not a.renew()              # old holder observes the loss
+    b.release()
+    assert a.try_acquire()            # released lease is free immediately
+
+
+def test_concurrent_writes_during_checkpoint(tmp_path):
+    """No op may be lost to compaction: writers hammer one collection
+    while checkpoints run; every write must survive recovery."""
+    import threading
+
+    d = str(tmp_path / "data")
+    s = DurableStore(d)
+    c = s.collection("k")
+    stop = threading.Event()
+    wrote = []
+
+    def writer(wid):
+        i = 0
+        while not stop.is_set():
+            c.upsert({"_id": f"w{wid}-{i}", "v": i})
+            wrote.append(f"w{wid}-{i}")
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(5):
+        time.sleep(0.02)
+        s.checkpoint()
+    stop.set()
+    for t in threads:
+        t.join()
+    s2 = DurableStore(d)
+    missing = [i for i in wrote if s2.collection("k").get(i) is None]
+    assert not missing, f"lost {len(missing)} writes: {missing[:5]}"
