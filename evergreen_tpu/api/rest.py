@@ -1140,12 +1140,8 @@ class RestApi:
         doc = tq_mod.coll(self.store).get(t.distro_id)
         if doc is None:
             return 200, {"position": -1, "queue_length": 0}
-        ids = doc["cols"]["id"] if doc.get("cols") else [
-            i["id"] for i in doc.get("queue", [])
-        ]
-        durs = doc["cols"]["expected_duration_s"] if doc.get("cols") else [
-            i["expected_duration_s"] for i in doc.get("queue", [])
-        ]
+        ids = tq_mod.doc_column(doc, "id")
+        durs = tq_mod.doc_column(doc, "expected_duration_s")
         try:
             pos = ids.index(t.id)
         except ValueError:

@@ -63,6 +63,9 @@ def cmd_service(args) -> int:
     queue = JobQueue(store, workers=args.workers)
     runner = build_cron_runner(store, queue)
     runner.run_background()
+    from .utils.gctune import tune_gc_for_long_lived_heap
+
+    tune_gc_for_long_lived_heap()
     server = api.serve(args.host, args.port)
     print(f"evergreen-tpu service listening on {args.host}:{args.port}")
     try:

@@ -40,8 +40,16 @@ def wake_dependents(store: Store, ready_ids: List[str], now: float) -> int:
                 continue
             want = set(tids)
             updated = False
+            rows = qdoc.get("rows")
             cols = qdoc.get("cols")
-            if cols is not None:
+            if rows is not None:
+                met = qdoc.get("dependencies_met") or []
+                for idx, r in enumerate(rows):
+                    if r[0] in want and idx < len(met) and not met[idx]:
+                        met[idx] = True
+                        updated = True
+                        n += 1
+            elif cols is not None:
                 ids = cols["id"]
                 met = cols["dependencies_met"]
                 for idx, qid in enumerate(ids):

@@ -21,7 +21,7 @@ from ..storage.store import Collection, Store
 COLLECTION = "hosts"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class Host:
     id: str
     distro_id: str = ""

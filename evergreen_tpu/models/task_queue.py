@@ -76,6 +76,35 @@ _ITEM_FIELDS = tuple(
     f.name for f in dataclasses.fields(TaskQueueItem)
 )
 
+#: field order of one row in the row-major persist format — exactly
+#: Task.queue_row()'s tuple (models/task.py), which is memoized per task
+#: instance so the every-tick persist writes shared tuples instead of
+#: transposing 50k rows into columns (the read side transposes instead,
+#: TTL-amortized).  sort_value / dependencies_met ride as separate
+#: top-level columns because they are the only per-tick-dynamic fields.
+ROW_FIELDS = (
+    "id", "display_name", "build_variant", "project", "version",
+    "requester", "revision_order_number", "priority", "task_group",
+    "task_group_max_hosts", "task_group_order", "expected_duration_s",
+    "num_dependents", "dependencies",
+)
+_ROW_INDEX = {n: i for i, n in enumerate(ROW_FIELDS)}
+
+
+def doc_column(doc: dict, name: str) -> list:
+    """One logical column from a queue doc in ANY persisted format
+    (row-major 'rows', columnar 'cols', or legacy item-list 'queue')."""
+    rows = doc.get("rows")
+    if rows is not None:
+        if name in ("sort_value", "dependencies_met"):
+            return doc.get(name) or []
+        idx = _ROW_INDEX[name]
+        return [r[idx] for r in rows]
+    cols = doc.get("cols")
+    if cols is not None:
+        return cols.get(name, [])
+    return [i.get(name) for i in doc.get("queue", [])]
+
 
 @dataclasses.dataclass
 class TaskQueue:
@@ -102,10 +131,24 @@ class TaskQueue:
         info_doc["task_group_infos"] = [
             TaskGroupInfo(**g) for g in info_doc.get("task_group_infos", [])
         ]
+        rows = doc.get("rows")
         cols = doc.get("cols")
-        if cols is not None:
-            # columnar persist format (scheduler/persister.py): one list per
-            # field — 50k-item queues write in milliseconds; items are
+        if rows is not None:
+            # row-major persist format (scheduler/persister.py): each row
+            # is Task.queue_row() in ROW_FIELDS order; the two dynamic
+            # columns ride separately.  Dependencies are copied — rows are
+            # memoized tuples shared across ticks.
+            sv = doc.get("sort_value") or [0.0] * len(rows)
+            dm = doc.get("dependencies_met") or [True] * len(rows)
+            queue = [
+                TaskQueueItem(
+                    r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7], s,
+                    r[8], r[9], r[10], r[11], r[12], list(r[13]), bool(m),
+                )
+                for r, s, m in zip(rows, sv, dm)
+            ]
+        elif cols is not None:
+            # columnar persist format: one list per field — items are
             # reconstructed here on the read side (TTL-amortized)
             names = list(_ITEM_FIELDS)
             queue = [

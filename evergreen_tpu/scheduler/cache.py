@@ -38,6 +38,10 @@ class TickCache:
         self._primed = False
         #: runnable task id → materialized Task
         self._runnable: Dict[str, Task] = {}
+        #: (store insertion rank, Task) kept sorted — maintained
+        #: incrementally so the per-tick "emit in cold-scan order" contract
+        #: costs O(changes) instead of a full 50k-key sort every tick
+        self._sorted: List[Tuple[int, Task]] = []
         #: incrementally-maintained dependency-met flags + the reverse
         #: dependency index that drives their invalidation: a task's flag
         #: changes only when the task itself or one of its parents churns
@@ -122,6 +126,11 @@ class TickCache:
                 self._runnable = {
                     t.id: t for t in task_mod.find_host_runnable(self.store)
                 }
+                order = task_mod.coll(self.store).key_order()
+                self._sorted = sorted(
+                    (order.get(t.id, 1 << 60), t)
+                    for t in self._runnable.values()
+                )
                 self._deps_met.clear()
                 self._dep_edges.clear()
                 self._dependents.clear()
@@ -139,18 +148,35 @@ class TickCache:
             for tid in dirty:
                 affected |= self._dependents.get(tid, set())
             n = 0
+            fresh: List[Tuple[int, Task]] = []
+            gone: Set[str] = set()
+            order = coll.key_order()
             for tid in dirty:
                 doc = coll.get(tid)
                 if self._qualifies(doc):
                     t = Task.from_doc(doc)
+                    if tid in self._runnable:
+                        gone.add(tid)  # replaced instance leaves _sorted
                     self._runnable[tid] = t
+                    fresh.append((order.get(tid, 1 << 60), t))
                     self._reindex_deps(t)
                     affected.add(tid)
                     n += 1
                 elif tid in self._runnable:
                     del self._runnable[tid]
+                    gone.add(tid)
                     self._drop_dep_index(tid)
                     n += 1
+            if gone:
+                self._sorted = [
+                    e for e in self._sorted if e[1].id not in gone
+                ]
+            if fresh:
+                # plain tuple compare (ranks are unique, so the Task in
+                # slot 1 is never compared); timsort exploits the sorted
+                # prefix: O(n + k log k) comparisons at C speed
+                self._sorted.extend(sorted(fresh))
+                self._sorted.sort()
             self._recompute_deps_met(affected & self._runnable.keys())
             return n
 
@@ -197,11 +223,8 @@ class TickCache:
         would emit it (value-tied tasks break ties by input position in the
         planner, serial.py, so ordering is part of correctness)."""
         self.apply_dirty()
-        order = task_mod.coll(self.store).key_order()
         with self._lock:
-            tasks = list(self._runnable.values())
-        tasks.sort(key=lambda t: order.get(t.id, 1 << 60))
-        return tasks
+            return [t for _, t in self._sorted]
 
     def gather(self, now: float) -> Tuple:
         """Same contract as scheduler.wrapper.gather_tick_inputs, served

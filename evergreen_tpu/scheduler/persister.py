@@ -8,7 +8,7 @@ is retained.
 from __future__ import annotations
 
 import time as _time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Union
 
 from ..models import task as task_mod
 from ..models.task import Task
@@ -20,14 +20,18 @@ def persist_task_queue(
     store: Store,
     distro_id: str,
     plan: List[Task],
-    sort_values: Dict[str, float],
+    sort_values: Union[Dict[str, float], Sequence[float]],
     deps_met: Dict[str, bool],
     info: DistroQueueInfo,
     max_scheduled_per_distro: int = 0,
     secondary: bool = False,
     now: Optional[float] = None,
 ) -> int:
-    """Persist the plan; returns the number of queue items written."""
+    """Persist the plan; returns the number of queue items written.
+
+    ``sort_values`` is either an id→value mapping (serial/cmp paths) or a
+    sequence positionally aligned with ``plan`` (the batched solve's
+    unpack, which avoids materializing 50k-entry dicts every tick)."""
     now = _time.time() if now is None else now
     # columnar persist: one list comprehension per field instead of 50k
     # small dicts — queue writes are every-tick work (the read side
@@ -36,35 +40,19 @@ def persist_task_queue(
     cut = _cap_cut(plan, max_scheduled_per_distro)
     if cut < n:
         plan = plan[:cut]
-    # static per-task columns come from Task.queue_row (memoized on the
-    # instance — under the incremental cache an unchanged task extracts
-    # its 13 attributes once, ever) and transpose in C via zip; only
-    # sort_value and dependencies_met are recomputed each tick.
-    (ids, display_names, build_variants, projects, versions,
-     requesters, revision_orders, priorities, task_groups,
-     group_max_hosts, group_orders, expected_durations,
-     num_dependents, dependencies) = (
-        (list(c) for c in zip(*[t.queue_row() for t in plan]))
-        if plan else ([] for _ in range(14))
-    )
-    cols = {
-        "id": ids,
-        "display_name": display_names,
-        "build_variant": build_variants,
-        "project": projects,
-        "version": versions,
-        "requester": requesters,
-        "revision_order_number": revision_orders,
-        "priority": priorities,
-        "sort_value": [sort_values.get(i, 0.0) for i in ids],
-        "task_group": task_groups,
-        "task_group_max_hosts": group_max_hosts,
-        "task_group_order": group_orders,
-        "expected_duration_s": expected_durations,
-        "num_dependents": num_dependents,
-        "dependencies": dependencies,
-        "dependencies_met": [deps_met.get(i, True) for i in ids],
-    }
+    # Row-major persist: each row IS Task.queue_row()'s memoized tuple
+    # (models/task_queue.py ROW_FIELDS), so the every-tick write just
+    # collects shared tuples — no 50k-row transpose.  Only sort_value and
+    # dependencies_met are recomputed per tick; the read side transposes
+    # on TTL-amortized rebuilds (TaskQueue.from_doc / doc_column).
+    rows = [t.queue_row() for t in plan]
+    ids = [r[0] for r in rows]
+    if isinstance(sort_values, dict):
+        sort_col = [sort_values.get(i, 0.0) for i in ids]
+    else:
+        sort_col = list(sort_values[: len(ids)])
+        sort_col += [0.0] * (len(ids) - len(sort_col))
+    met_col = [deps_met.get(i, True) for i in ids]
     info_doc = {
         **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
         "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],
@@ -74,20 +62,29 @@ def persist_task_queue(
         {
             "_id": distro_id,
             "distro_id": distro_id,
-            "cols": cols,
+            "rows": rows,
+            "sort_value": sort_col,
+            "dependencies_met": met_col,
             "info": info_doc,
             "generated_at": now,
         },
         secondary=secondary,
     )
-    task_mod.mark_scheduled(
-        store,
-        cols["id"],
-        now,
-        deps_met_ids=[
-            tid for tid, met in zip(cols["id"], cols["dependencies_met"]) if met
-        ],
-    )
+    # Candidate pre-filter on the materialized Task attributes: in steady
+    # state every planned task is already stamped, so the per-task store
+    # get() round (50k/tick at config-3 scale) collapses to zero.
+    # mark_scheduled itself re-checks the live doc before mutating.
+    cand = [
+        (t.id, met)
+        for t, met in zip(plan, met_col)
+        if t.scheduled_time <= 0.0
+        or (met and t.dependencies_met_time <= 0.0)
+    ]
+    if cand:
+        task_mod.mark_scheduled(
+            store, [tid for tid, _ in cand], now,
+            deps_met_ids=[tid for tid, met in cand if met],
+        )
     return len(plan)
 
 

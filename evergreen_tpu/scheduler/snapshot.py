@@ -19,6 +19,7 @@ ships exactly three host→device buffers.
 from __future__ import annotations
 
 import dataclasses
+import operator as _operator
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -333,9 +334,28 @@ def build_snapshot(
     deps_met: Dict[str, bool],
     now: float,
     force_dims: Dict[str, int] = None,
+    dims_memo: Dict[str, int] = None,
+    memb_memo: Dict[str, tuple] = None,
 ) -> Snapshot:
     """``force_dims`` overrides the computed bucket sizes (the sharded
-    solve pads every shard to common dims so the blocks stack)."""
+    solve pads every shard to common dims so the blocks stack).
+
+    ``memb_memo`` (caller-owned, persisted across ticks) caches each
+    distro's unit memberships/segments keyed on the IDENTITY of its task
+    instances: unit formation reads only static task attributes
+    (task_group/version/depends_on), and the tick cache replaces changed
+    docs with new instances, so an identical task sequence ⇒ identical
+    memberships.  Cached arrays are stored base-relative and rebased with
+    one vectorized add, which preserves unit/segment creation order
+    exactly — the warm build remains bit-identical to a cold one (the
+    warm/cold fuzzer pins this).  Only the deps-met column is recomputed
+    per tick (it is genuinely dynamic).
+
+    ``dims_memo`` (caller-owned, persisted across ticks) adds hysteresis:
+    a dimension keeps its previous bucket while the live count still fits
+    and the bucket is not >4x oversized.  Without it, churn oscillating a
+    count across a bucket edge forces an XLA recompile (~2s) every few
+    ticks — the single worst churn-tick spike."""
     d_index = {d.id: i for i, d in enumerate(distros)}
     n_d = len(distros)
 
@@ -345,8 +365,8 @@ def build_snapshot(
     # "" segments first (global seg id == distro index), then each distro's
     # named task-group segments in first-seen order.
     flat_tasks: List[Task] = []
-    t_distro: List[int] = []
-    u_distro: List[int] = []
+    t_counts: List[int] = []
+    u_counts: List[int] = []
     unit_base = 0
     from ..utils.native import get_evgpack
 
@@ -360,32 +380,81 @@ def build_snapshot(
     seg_max_hosts_l: List[int] = [0] * n_d
     named_base = n_d
     fn = evgpack.build_memberships if evgpack is not None else None
+    _is = _operator.is_
     for d in distros:
         tasks = tasks_by_distro.get(d.id, [])
         base = len(flat_tasks)
         di = d_index[d.id]
+        gv = bool(d.planner_settings.group_versions)
         seg_slice = t_seg_np[base:base + len(tasks)]
         dm_slice = t_dm_np[base:base + len(tasks)]
-        if fn is not None:
-            n_units_d, mt, mu, _gkeys, snames, smax = fn(
-                tasks, bool(d.planner_settings.group_versions), base,
-                unit_base, di, named_base, seg_slice, deps_met, dm_slice,
-                False,
-            )
+        entry = memb_memo.get(d.id) if memb_memo is not None else None
+        if (
+            entry is not None
+            and entry[0] == gv
+            and len(entry[1]) == len(tasks)
+            and all(map(_is, entry[1], tasks))
+        ):
+            _, _, n_units_d, mt_local, mu_local, snames, smax, seg_local = entry
+            # rebase cached local ids into this build's coordinates
+            mt_arr = mt_local + np.int32(base)
+            mu_arr = mu_local + np.int32(unit_base)
+            if len(tasks):
+                np.copyto(
+                    seg_slice,
+                    np.where(seg_local < 0, np.int32(di),
+                             seg_local + np.int32(named_base)),
+                )
+                if evgpack is not None:
+                    evgpack.fill_deps_met(tasks, deps_met, dm_slice)
+                elif deps_met is not None:
+                    dm_slice[:] = np.fromiter(
+                        (deps_met.get(t.id, True) for t in tasks),
+                        np.uint8, len(tasks),
+                    )
+                else:
+                    dm_slice[:] = 1
         else:
-            n_units_d, mt, mu, _gkeys, snames, smax = build_memberships(
-                d, tasks, base, unit_base, di, named_base, seg_slice,
-                deps_met, dm_slice, False,
-            )
+            if fn is not None:
+                n_units_d, mt, mu, _gkeys, snames, smax = fn(
+                    tasks, gv, base, unit_base, di, named_base, seg_slice,
+                    deps_met, dm_slice, False,
+                )
+            else:
+                n_units_d, mt, mu, _gkeys, snames, smax = build_memberships(
+                    d, tasks, base, unit_base, di, named_base, seg_slice,
+                    deps_met, dm_slice, False,
+                )
+            mt_arr = np.frombuffer(mt, np.int32)
+            mu_arr = np.frombuffer(mu, np.int32)
+            if memb_memo is not None:
+                # store base-relative: grouped segments as local ordinals,
+                # ungrouped (== di) as -1
+                seg_local = np.where(
+                    seg_slice >= n_d, seg_slice - np.int32(named_base),
+                    np.int32(-1),
+                ) if len(tasks) else seg_slice.copy()
+                memb_memo[d.id] = (
+                    gv, tasks, n_units_d,
+                    mt_arr - np.int32(base), mu_arr - np.int32(unit_base),
+                    snames, smax, seg_local,
+                )
         seg_names.extend((di, nm) for nm in snames)
         seg_max_hosts_l.extend(smax)
         named_base += len(snames)
         flat_tasks.extend(tasks)
-        t_distro.extend([di] * len(tasks))
-        u_distro.extend([di] * n_units_d)
-        m_task_parts.append(np.frombuffer(mt, np.int32))
-        m_unit_parts.append(np.frombuffer(mu, np.int32))
+        t_counts.append(len(tasks))
+        u_counts.append(n_units_d)
+        m_task_parts.append(mt_arr)
+        m_unit_parts.append(mu_arr)
         unit_base += n_units_d
+
+    if memb_memo is not None and len(memb_memo) > n_d:
+        # evict entries for distros that left the set — a deleted distro
+        # must not pin its task list in memory for the service's lifetime
+        live = {d.id for d in distros}
+        for k in [k for k in memb_memo if k not in live]:
+            del memb_memo[k]
 
     m_task = (
         np.concatenate(m_task_parts) if m_task_parts
@@ -395,6 +464,11 @@ def build_snapshot(
         np.concatenate(m_unit_parts) if m_unit_parts
         else np.empty(0, np.int32)
     )
+    # distro-index columns via repeat over per-distro counts (a Python
+    # list of 50k ints costs more to convert than it does to compute)
+    d_arange = np.arange(n_d, dtype=np.int32)
+    t_distro = np.repeat(d_arange, t_counts)
+    u_distro = np.repeat(d_arange, u_counts)
     n_t, n_m, n_u = len(flat_tasks), len(m_task), len(u_distro)
 
     # ---- hosts (may introduce segments no queued task names) -------------- #
@@ -435,14 +509,20 @@ def build_snapshot(
     if force_dims is not None:
         dims = dict(force_dims)
     else:
-        dims = {
-            "N": _bucket(max(n_t, 1)),
-            "M": _bucket(max(n_m, 1)),
-            "U": _bucket(max(n_u, 1)),
-            "G": _bucket(max(n_g, 1)),
-            "H": _bucket(max(n_h, 1)),
-            "D": _bucket(max(n_d, 1), minimum=8),
+        counts = {
+            "N": max(n_t, 1), "M": max(n_m, 1), "U": max(n_u, 1),
+            "G": max(n_g, 1), "H": max(n_h, 1), "D": max(n_d, 1),
         }
+        dims = {
+            k: _bucket(c, minimum=8 if k == "D" else 32)
+            for k, c in counts.items()
+        }
+        if dims_memo is not None:
+            for k, c in counts.items():
+                prev = dims_memo.get(k, 0)
+                if prev >= c and prev <= 4 * dims[k]:
+                    dims[k] = prev
+            dims_memo.update(dims)
     N, M, U = dims["N"], dims["M"], dims["U"]
     G, H, D = dims["G"], dims["H"], dims["D"]
 
@@ -536,7 +616,7 @@ def build_snapshot(
     # memberships (padding points at dummy task N-1 / unit U-1)
     fill("m_task", m_task, pad=N - 1)
     fill("m_unit", m_unit, pad=U - 1)
-    fill("m_valid", [True] * n_m)
+    a["m_valid"][:n_m] = True
 
     fill("u_distro", u_distro, pad=D - 1)
 

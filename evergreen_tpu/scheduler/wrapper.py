@@ -77,6 +77,22 @@ def tick_cache_for(store: Store):
         return entry[1]
 
 
+#: per-store snapshot memos (shape hysteresis + membership cache) — the
+#: scheduler's own cross-tick state, kept here rather than stuffed onto
+#: the storage-layer object
+_sched_memos: Dict[int, tuple] = {}
+
+
+def _snapshot_memos_for(store: Store) -> Tuple[dict, dict]:
+    key = id(store)
+    with _tick_caches_lock:
+        entry = _sched_memos.get(key)
+        if entry is None or entry[0] is not store:
+            entry = (store, {}, {})
+            _sched_memos[key] = entry
+        return entry[1], entry[2]
+
+
 @dataclasses.dataclass
 class TickResult:
     #: distro id -> number of queue items persisted this tick
@@ -204,14 +220,15 @@ def _unpack_solve(
     ro = real.tolist()
     vl = vals.tolist()
     plans: Dict[str, List[Task]] = {}
-    sort_values: Dict[str, Dict[str, float]] = {}
+    # per-distro sort values ALIGNED with plans[did] (the persister
+    # consumes them positionally — building 50k-entry id→value dicts per
+    # tick was pure overhead)
+    sort_values: Dict[str, List[float]] = {}
     for di, did in enumerate(snapshot.distro_ids):
         lo, hi = int(bounds[di]), int(bounds[di + 1])
         seg = ro[lo:hi]
         plans[did] = [flat[i] for i in seg]
-        sort_values[did] = dict(
-            zip((task_ids[i] for i in seg), vl[lo:hi])
-        )
+        sort_values[did] = vl[lo:hi]
 
     # per-segment TaskGroupInfos
     seg_infos: Dict[int, List[TaskGroupInfo]] = {}
@@ -305,9 +322,11 @@ def run_tick(
     infos: Dict[str, DistroQueueInfo] = {}
     if solver_distros and opts.planner_version == PlannerVersion.TPU.value:
         t1 = _time.perf_counter()
+        dims_memo, memb_memo = _snapshot_memos_for(store)
         snapshot = build_snapshot(
             solver_distros, tasks_by_distro, hosts_by_distro,
-            running_estimates, deps_met, now,
+            running_estimates, deps_met, now, dims_memo=dims_memo,
+            memb_memo=memb_memo,
         )
         t2 = _time.perf_counter()
         out = run_solve_packed(snapshot)

@@ -180,8 +180,7 @@ def sample_system_stats(store: Store, now: Optional[float] = None) -> dict:
 
     queues = {}
     for qdoc in task_queue_mod.coll(store).find():
-        cols = qdoc.get("cols") or {}
-        n = len(cols.get("id", qdoc.get("queue", [])))
+        n = len(task_queue_mod.doc_column(qdoc, "id"))
         queues[qdoc["_id"]] = {
             "length": n,
             "age_s": round(max(0.0, now - qdoc.get("generated_at", now)), 3),

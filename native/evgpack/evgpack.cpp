@@ -618,11 +618,84 @@ PyObject* BuildMemberships(PyObject*, PyObject* args) {
                        seg_names, seg_max_out);
 }
 
+// fill_deps_met(tasks, deps_met, out) -> None
+//
+// out[i] = bool(deps_met.get(tasks[i].id, True)) into a writable uint8
+// buffer.  Used by the snapshot's membership-memo hit path, where the
+// cached unit grouping is reused but the deps-met column (the only
+// dynamic input) must be refreshed each tick.
+PyObject* FillDepsMet(PyObject*, PyObject* args) {
+  PyObject* tasks;
+  PyObject* deps_met;
+  PyObject* out;
+  if (!PyArg_ParseTuple(args, "OOO", &tasks, &deps_met, &out)) {
+    return nullptr;
+  }
+  if (deps_met == Py_None) deps_met = nullptr;
+  if (deps_met != nullptr && !PyDict_Check(deps_met)) {
+    PyErr_SetString(PyExc_TypeError, "deps_met must be a dict or None");
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
+  if (seq == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  static PyObject* s_id_attr = PyUnicode_InternFromString("id");
+  Py_buffer view{};
+  if (PyObject_GetBuffer(out, &view, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS)
+      != 0) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  if (view.itemsize != 1 || view.len < n) {
+    PyBuffer_Release(&view);
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError,
+                    "out must be a writable uint8 buffer of >= n");
+    return nullptr;
+  }
+  auto* buf = static_cast<uint8_t*>(view.buf);
+  bool good = true;
+  for (Py_ssize_t i = 0; good && i < n; ++i) {
+    PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+    if (deps_met == nullptr) {
+      buf[i] = 1;
+      continue;
+    }
+    PyObject* tid = PyObject_GetAttr(t, s_id_attr);
+    if (tid == nullptr) {
+      good = false;
+      break;
+    }
+    PyObject* got = PyDict_GetItemWithError(deps_met, tid);  // borrowed
+    Py_DECREF(tid);
+    if (got == nullptr) {
+      if (PyErr_Occurred()) {
+        good = false;
+        break;
+      }
+      buf[i] = 1;
+    } else {
+      int truth = PyObject_IsTrue(got);
+      if (truth < 0) {
+        good = false;
+        break;
+      }
+      buf[i] = truth ? 1 : 0;
+    }
+  }
+  PyBuffer_Release(&view);
+  Py_DECREF(seq);
+  if (!good) return nullptr;
+  Py_RETURN_NONE;
+}
+
 PyMethodDef kMethods[] = {
     {"pack_task_columns", PackTaskColumns, METH_VARARGS,
      "Fill per-task snapshot columns in one native pass."},
     {"build_memberships", BuildMemberships, METH_VARARGS,
      "Planner unit grouping: (n_units, m_task, m_unit, group_keys)."},
+    {"fill_deps_met", FillDepsMet, METH_VARARGS,
+     "out[i] = deps_met.get(tasks[i].id, True) as uint8."},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -1,0 +1,119 @@
+"""Membership-memo correctness: a snapshot built with warm memos must be
+bit-identical to a cold build — unit/segment creation order is the
+planner's deterministic tie-break, so any divergence is a queue-order bug."""
+import dataclasses
+
+import numpy as np
+
+from evergreen_tpu.scheduler.snapshot import build_snapshot
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+
+def _assert_snapshots_equal(a, b):
+    assert a.distro_ids == b.distro_ids
+    assert a.task_ids == b.task_ids
+    assert a.seg_names == b.seg_names
+    assert (a.n_tasks, a.n_units, a.n_segs) == (b.n_tasks, b.n_units, b.n_segs)
+    for name in a.arrays:
+        np.testing.assert_array_equal(
+            np.asarray(a.arrays[name]), np.asarray(b.arrays[name]),
+            err_msg=name,
+        )
+
+
+def test_row_fields_match_queue_row_order():
+    """ROW_FIELDS, Task.queue_row()'s tuple, and TaskQueue.from_doc's
+    positional mapping must agree — a silent drift corrupts every
+    persisted queue."""
+    from evergreen_tpu.models.task import Dependency, Task
+    from evergreen_tpu.models.task_queue import ROW_FIELDS, TaskQueue
+
+    t = Task(
+        id="tid", display_name="dn", build_variant="bv", project="pr",
+        version="v", requester="patch_request", revision_order_number=7,
+        priority=3, task_group="g", task_group_max_hosts=2,
+        task_group_order=4, expected_duration_s=60.0, num_dependents=5,
+        depends_on=[Dependency(task_id="parent")],
+    )
+    row = t.queue_row()
+    assert len(row) == len(ROW_FIELDS)
+    for name, value in zip(ROW_FIELDS, row):
+        if name == "dependencies":
+            assert value == ["parent"]
+        else:
+            assert value == getattr(t, name), name
+    # round-trip through the row-major doc format
+    q = TaskQueue.from_doc(
+        {"distro_id": "d", "rows": [row], "sort_value": [9.5],
+         "dependencies_met": [False]}
+    )
+    item = q.queue[0]
+    for name, value in zip(ROW_FIELDS, row):
+        got = getattr(item, name)
+        assert got == (list(value) if name == "dependencies" else value), name
+    assert item.sort_value == 9.5 and item.dependencies_met is False
+
+
+def test_memoized_build_identical_to_cold():
+    p = generate_problem(20, 2_000, seed=11, task_group_fraction=0.3,
+                         dep_fraction=0.4, patch_fraction=0.5)
+    memo: dict = {}
+    warm0 = build_snapshot(*p, NOW, memb_memo=memo)   # primes the memo
+    cold = build_snapshot(*p, NOW)
+    warm = build_snapshot(*p, NOW, memb_memo=memo)    # full memo hits
+    _assert_snapshots_equal(cold, warm0)
+    _assert_snapshots_equal(cold, warm)
+
+
+def test_memo_invalidates_on_changed_tasks_and_flags():
+    distros, tasks_by_distro, hosts, ests, deps_met = generate_problem(
+        8, 600, seed=5, task_group_fraction=0.3, dep_fraction=0.4
+    )
+    memo: dict = {}
+    build_snapshot(distros, tasks_by_distro, hosts, ests, deps_met, NOW,
+                   memb_memo=memo)
+
+    # replace one task instance in one distro (the cache's change signal)
+    did = distros[3].id
+    tasks2 = {k: list(v) for k, v in tasks_by_distro.items()}
+    old = tasks2[did][0]
+    tasks2[did][0] = dataclasses.replace(old, task_group="fresh-group",
+                                         task_group_max_hosts=2)
+    warm = build_snapshot(distros, tasks2, hosts, ests, deps_met, NOW,
+                          memb_memo=memo)
+    cold = build_snapshot(distros, tasks2, hosts, ests, deps_met, NOW)
+    _assert_snapshots_equal(cold, warm)
+
+    # flip a deps-met flag only (task identity unchanged ⇒ memo hit, but
+    # the dm column is recomputed per tick)
+    some = next(t.id for ts in tasks2.values() for t in ts
+                if deps_met.get(t.id, True))
+    deps2 = dict(deps_met)
+    deps2[some] = False
+    warm2 = build_snapshot(distros, tasks2, hosts, ests, deps2, NOW,
+                           memb_memo=memo)
+    cold2 = build_snapshot(distros, tasks2, hosts, ests, deps2, NOW)
+    _assert_snapshots_equal(cold2, warm2)
+
+
+def test_memo_with_group_versions_toggle():
+    distros, tasks_by_distro, hosts, ests, deps_met = generate_problem(
+        4, 300, seed=9, task_group_fraction=0.4
+    )
+    memo: dict = {}
+    build_snapshot(distros, tasks_by_distro, hosts, ests, deps_met, NOW,
+                   memb_memo=memo)
+    d2 = [
+        dataclasses.replace(
+            d,
+            planner_settings=dataclasses.replace(
+                d.planner_settings,
+                group_versions=not d.planner_settings.group_versions,
+            ),
+        )
+        for d in distros
+    ]
+    warm = build_snapshot(d2, tasks_by_distro, hosts, ests, deps_met, NOW,
+                          memb_memo=memo)
+    cold = build_snapshot(d2, tasks_by_distro, hosts, ests, deps_met, NOW)
+    _assert_snapshots_equal(cold, warm)
