@@ -59,16 +59,18 @@ class RestApi:
         store: Store,
         dispatcher_service: Optional[DispatcherService] = None,
         require_auth: bool = False,
-        rate_limit_per_min: int = 0,
+        rate_limit_per_min: Optional[int] = None,
     ) -> None:
         self.store = store
         self.svc = dispatcher_service or DispatcherService(store)
         self.require_auth = require_auth
-        self._rate_limiter = None
-        if rate_limit_per_min:
-            from ..models.user import RateLimiter
+        #: None = per-request default from the admin-editable rate_limit
+        #: config section (live, like webhook_secret); 0 = explicitly
+        #: unlimited; >0 = fixed limit
+        self._rate_limit_explicit = rate_limit_per_min
+        from ..models.user import RateLimiter
 
-            self._rate_limiter = RateLimiter(store, rate_limit_per_min)
+        self._rate_limiter = RateLimiter(store, 0)
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
         #: per-request authenticated identity (thread-local: the WSGI
         #: server is threading). Set by _authorize, read by ownership
@@ -131,10 +133,18 @@ class RestApi:
         victim's would starve them."""
         self._ident.user = ""
         self._ident.superuser = False
-        if self._rate_limiter is not None:
+        limit = self._rate_limit_explicit
+        pre_mult = 4
+        if limit is None:
+            from ..settings import RateLimitConfig
+
+            rl = RateLimitConfig.get(self.store)
+            limit = rl.requests_per_minute
+            pre_mult = rl.pre_auth_multiplier
+        if limit:
             peer = headers.get("x-peer-addr") or "anon"
             if not self._rate_limiter.allow(
-                f"peer:{peer}", limit=4 * self._rate_limiter.limit
+                f"peer:{peer}", limit=pre_mult * limit
             ):
                 return 429, {"error": "rate limit exceeded"}
         denied = None
@@ -155,7 +165,7 @@ class RestApi:
                 denied = 403, {"error": "admin scope required"}
         if denied is not None:
             return denied
-        if self._rate_limiter is not None:
+        if limit:
             # without auth there is no trustworthy identity; the api-user
             # header at least keeps well-behaved clients in separate
             # buckets (the peer bucket above still bounds abusers)
@@ -165,7 +175,7 @@ class RestApi:
                 or headers.get("x-peer-addr")
                 or "anon"
             )
-            if not self._rate_limiter.allow(key):
+            if not self._rate_limiter.allow(key, limit=limit):
                 return 429, {"error": "rate limit exceeded"}
         return None
 
@@ -1119,7 +1129,9 @@ class RestApi:
             cls = all_sections().get(sid)
             if cls is None:
                 raise ApiError(400, f"unknown config section {sid!r}")
-            section = cls.get(self.store)
+            # edit the BASE document: get() applies overrides, and a
+            # get→set round trip through it would bake them in permanently
+            section = cls.get_base(self.store)
             known = {f.name for f in _dc.fields(section)}
             for k, v in values.items():
                 if k not in known:

@@ -60,6 +60,10 @@ def cmd_service(args) -> int:
     if args.github_webhook_secret:
         # CLI flag wins over the stored ApiConfig section
         api.webhook_secret = args.github_webhook_secret
+    if args.workers is None:
+        from .settings import AmboyConfig
+
+        args.workers = AmboyConfig.get(store).pool_size_local
     queue = JobQueue(store, workers=args.workers)
     runner = build_cron_runner(store, queue)
     runner.run_background()
@@ -403,11 +407,13 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("service", help="run the app server")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=9090)
-    s.add_argument("--workers", type=int, default=8)
+    s.add_argument("--workers", type=int, default=None,
+                   help="job-queue workers (default: amboy config section)")
     s.add_argument("--require-auth", action="store_true",
                    help="require API keys on user routes")
-    s.add_argument("--rate-limit", type=int, default=0,
-                   help="requests/min per user (0 = unlimited)")
+    s.add_argument("--rate-limit", type=int, default=None,
+                   help="requests/min per user (0 = force-unlimited; "
+                        "default: the rate_limit config section)")
     s.add_argument("--github-webhook-secret", default="",
                    help="HMAC secret for /hooks/github (overrides the "
                         "stored api config section)")

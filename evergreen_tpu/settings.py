@@ -8,7 +8,7 @@ kill-switches checked at the top of every job/route).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Type
+from typing import Dict, List, Optional, Type
 
 from .storage.store import Store
 
@@ -23,17 +23,59 @@ class ConfigSection:
     section_id: str = ""
 
     @classmethod
-    def get(cls, store: Store) -> "ConfigSection":
+    def get_base(cls, store: Store) -> "ConfigSection":
+        """The stored section WITHOUT overrides — what admin edits must
+        start from, or a get→set round trip would bake override values
+        into the base document."""
         doc = store.collection(CONFIG_COLLECTION).get(cls.section_id)
         if doc is None:
             return cls()
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in doc.items() if k in known})
 
+    @classmethod
+    def get(cls, store: Store) -> "ConfigSection":
+        section = cls.get_base(store)
+        if _apply_overrides(store, section):
+            # an override produced an invalid section (e.g. a type the
+            # validator rejects): fail safe to the stored base rather
+            # than hand consumers a poisoned config
+            if section.validate_and_default():
+                section = cls.get_base(store)
+        return section
+
     def set(self, store: Store) -> None:
+        err = self.validate_and_default()
+        if err:
+            raise ValueError(f"config section {self.section_id!r}: {err}")
         doc = dataclasses.asdict(self)
         doc["_id"] = self.section_id
         store.collection(CONFIG_COLLECTION).upsert(doc)
+
+    def validate_and_default(self) -> str:
+        """Normalize fields and return "" or an error message (reference
+        ConfigSection.ValidateAndDefault). Subclasses override as needed;
+        a failed validation blocks ``set``."""
+        return ""
+
+
+def _apply_overrides(store: Store, section: "ConfigSection") -> bool:
+    """Field-level overrides from the ``overrides`` section (reference
+    config_overrides.go: Override{SectionID, Field, Value}), applied on
+    every read so the stored base document is never clobbered.  Returns
+    True iff any override was applied."""
+    if section.section_id == OverridesConfig.section_id:
+        return False  # the overrides section itself is never overridden
+    doc = store.collection(CONFIG_COLLECTION).get(OverridesConfig.section_id)
+    if not doc:
+        return False
+    known = {f.name for f in dataclasses.fields(section)}
+    applied = False
+    for ov in doc.get("overrides", []):
+        if ov.get("section_id") == section.section_id and ov.get("field") in known:
+            setattr(section, ov["field"], ov.get("value"))
+            applied = True
+    return applied
 
 
 _SECTIONS: Dict[str, Type[ConfigSection]] = {}
@@ -148,3 +190,365 @@ class ApiConfig(ConfigSection):
     url: str = ""
     github_webhook_secret: str = ""
     max_request_body_bytes: int = 32 * 1024 * 1024
+
+
+@register_section
+@dataclasses.dataclass
+class OverridesConfig(ConfigSection):
+    """Field-level overrides over other sections (reference
+    config_overrides.go Override{SectionID, Field, Value})."""
+
+    section_id = "overrides"
+
+    #: list of {"section_id": ..., "field": ..., "value": ...}
+    overrides: List[Dict] = dataclasses.field(default_factory=list)
+
+    def validate_and_default(self) -> str:
+        for ov in self.overrides:
+            if not ov.get("section_id") or not ov.get("field"):
+                return "every override needs section_id and field"
+            if "value" not in ov:
+                return f"override of {ov['field']!r} has no value"
+            if ov["section_id"] == self.section_id:
+                return "the overrides section cannot override itself"
+            cls = _SECTIONS.get(ov["section_id"])
+            if cls is None:
+                return f"unknown section {ov['section_id']!r}"
+            if ov["field"] not in {f.name for f in dataclasses.fields(cls)}:
+                return (
+                    f"section {ov['section_id']!r} has no field "
+                    f"{ov['field']!r}"
+                )
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class AuthConfig(ConfigSection):
+    """User-manager selection + per-manager settings (reference
+    config_auth.go:103-116; consumed by auth.load_user_manager)."""
+
+    section_id = "auth"
+
+    preferred_type: str = "naive"  # naive | github | okta | api_only | external
+    allow_service_users: bool = False
+    background_reauth_minutes: int = 0
+    github_client_id: str = ""
+    github_client_secret: str = ""
+    github_organization: str = ""
+    okta_client_id: str = ""
+    okta_client_secret: str = ""
+    okta_issuer: str = ""
+    external_validation_url: str = ""
+
+    def validate_and_default(self) -> str:
+        if self.preferred_type not in (
+            "naive", "github", "okta", "api_only", "external",
+        ):
+            return f"unknown auth manager type {self.preferred_type!r}"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class RepotrackerConfig(ConfigSection):
+    """reference config_repotracker.go:11-15."""
+
+    section_id = "repotracker"
+
+    revs_to_fetch: int = 25
+    max_revs_to_search: int = 50
+    max_concurrent_requests: int = 0
+
+    def validate_and_default(self) -> str:
+        if self.revs_to_fetch <= 0:
+            self.revs_to_fetch = 25
+        if self.max_revs_to_search <= 0:
+            self.max_revs_to_search = 2 * self.revs_to_fetch
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class UiConfig(ConfigSection):
+    """reference config_ui.go:18-33."""
+
+    section_id = "ui"
+
+    url: str = ""
+    http_listen_addr: str = ""
+    secret: str = ""
+    default_project: str = ""
+    csrf_key: str = ""
+    cors_origins: List[str] = dataclasses.field(default_factory=list)
+    login_domain: str = ""
+
+    def validate_and_default(self) -> str:
+        if self.csrf_key and len(self.csrf_key) != 32:
+            return "csrf_key must be 32 characters"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class RateLimitConfig(ConfigSection):
+    """Per-surface request budgets (reference config_ratelimit.go;
+    consumed by RestApi's limiter when no explicit limit is passed)."""
+
+    section_id = "rate_limit"
+
+    requests_per_minute: int = 0  # 0 = unlimited
+    pre_auth_multiplier: int = 4
+
+    def validate_and_default(self) -> str:
+        if self.pre_auth_multiplier <= 0:
+            self.pre_auth_multiplier = 4
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class SpawnHostConfig(ConfigSection):
+    """reference config_spawnhost.go."""
+
+    section_id = "spawnhost"
+
+    unexpirable_hosts_per_user: int = 1
+    unexpirable_volumes_per_user: int = 1
+    spawn_hosts_per_user: int = 3
+
+    def validate_and_default(self) -> str:
+        if self.spawn_hosts_per_user < 0:
+            return "spawn_hosts_per_user cannot be negative"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class SleepScheduleConfig(ConfigSection):
+    """reference config_sleep_schedule.go."""
+
+    section_id = "sleep_schedule"
+
+    permanently_exempt_hosts: List[str] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@register_section
+@dataclasses.dataclass
+class TriggerConfig(ConfigSection):
+    """Downstream project triggers (reference config_triggers.go)."""
+
+    section_id = "triggers"
+
+    generate_task_distro: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class LoggerConfig(ConfigSection):
+    """reference config_logger.go."""
+
+    section_id = "logger_config"
+
+    buffer_count: int = 100
+    buffer_interval_seconds: int = 20
+    default_level: str = "info"
+
+    def validate_and_default(self) -> str:
+        if self.default_level not in ("debug", "info", "warning", "error"):
+            return f"unknown log level {self.default_level!r}"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class AmboyConfig(ConfigSection):
+    """Background job plane sizing (reference config_amboy.go; consumed
+    by queue.jobs.JobQueue via cli service startup)."""
+
+    section_id = "amboy"
+
+    pool_size_local: int = 8
+    retry_max_attempts: int = 10
+    lock_timeout_minutes: int = 10
+
+    def validate_and_default(self) -> str:
+        if self.pool_size_local <= 0:
+            self.pool_size_local = 8
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class CloudProvidersConfig(ConfigSection):
+    """Provider credentials/regions (reference config_cloud.go — secrets
+    referenced via the parameter store, never inline)."""
+
+    section_id = "providers"
+
+    aws_default_region: str = "us-east-1"
+    aws_allowed_regions: List[str] = dataclasses.field(
+        default_factory=lambda: ["us-east-1"]
+    )
+    aws_parameter_prefix: str = ""
+    docker_default_registry: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class ContainerPoolsConfig(ConfigSection):
+    """reference config_containerpools.go:10-28."""
+
+    section_id = "container_pools"
+
+    #: list of {"id": ..., "distro": ..., "max_containers": N, "port": N}
+    pools: List[Dict] = dataclasses.field(default_factory=list)
+
+    def validate_and_default(self) -> str:
+        seen = set()
+        for p in self.pools:
+            if not p.get("id"):
+                return "every container pool needs an id"
+            if p["id"] in seen:
+                return f"duplicate container pool id {p['id']!r}"
+            seen.add(p["id"])
+            if int(p.get("max_containers", 0)) <= 0:
+                return f"pool {p['id']!r} needs max_containers > 0"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class CostConfig(ConfigSection):
+    """reference config_cost.go (cost attribution at MarkEnd)."""
+
+    section_id = "cost"
+
+    financial_formula_savings_plan_rate: float = 0.0
+    on_demand_discount: float = 0.0
+    savings_plan_discount: float = 0.0
+
+
+@register_section
+@dataclasses.dataclass
+class ParameterStoreConfig(ConfigSection):
+    """reference cloud/parameterstore config section."""
+
+    section_id = "parameter_store"
+
+    prefix: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class ProjectCreationConfig(ConfigSection):
+    """reference config_project_creation.go."""
+
+    section_id = "project_creation"
+
+    total_project_limit: int = 0
+    repo_project_limit: int = 0
+    jira_project: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class SingleTaskDistroConfig(ConfigSection):
+    """reference config_single_task_distro.go."""
+
+    section_id = "single_task_distro"
+
+    #: project -> allowed task name patterns
+    project_tasks_pairs: List[Dict] = dataclasses.field(default_factory=list)
+
+
+@register_section
+@dataclasses.dataclass
+class TestSelectionConfig(ConfigSection):
+    """reference config_test_selection.go."""
+
+    section_id = "test_selection"
+
+    url: str = ""
+    default_strategies: List[str] = dataclasses.field(default_factory=list)
+
+
+@register_section
+@dataclasses.dataclass
+class TracerConfig(ConfigSection):
+    """OTel-shaped trace export (reference config_tracer.go:11-23;
+    consumed by utils/tracing.py's exporter)."""
+
+    section_id = "tracer"
+
+    enabled: bool = False
+    collector_endpoint: str = ""
+    sample_ratio: float = 1.0
+
+    def validate_and_default(self) -> str:
+        if not 0.0 <= self.sample_ratio <= 1.0:
+            return "sample_ratio must be within [0, 1]"
+        if self.enabled and not self.collector_endpoint:
+            return "enabled tracer needs a collector_endpoint"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
+class SlackConfig(ConfigSection):
+    """reference config.go SlackConfig (notification channel)."""
+
+    section_id = "slack"
+
+    token: str = ""
+    level: str = "error"
+    name: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class JiraConfig(ConfigSection):
+    """reference config.go JIRAConfig (build-baron ticketing)."""
+
+    section_id = "jira"
+
+    host: str = ""
+    default_project: str = ""
+    email: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class SplunkConfig(ConfigSection):
+    """reference config_splunk.go (log shipping)."""
+
+    section_id = "splunk"
+
+    server_url: str = ""
+    token: str = ""
+    channel: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class GithubCheckRunConfig(ConfigSection):
+    """reference config_github_check_run.go."""
+
+    section_id = "github_check_run"
+
+    check_run_limit: int = 0
+
+
+@register_section
+@dataclasses.dataclass
+class BucketsConfig(ConfigSection):
+    """Blob-store layout for task output (reference config_buckets.go;
+    consumed by models/artifact.py's content-addressed store)."""
+
+    section_id = "buckets"
+
+    log_bucket_name: str = ""
+    test_results_bucket_name: str = ""
+    long_retention_name: str = ""
