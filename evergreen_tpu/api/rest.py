@@ -332,6 +332,11 @@ class RestApi:
         r = self.route
         # agent protocol (reference rest/route/host_agent.go, agent.go)
         r("GET", r"/rest/v2/hosts/(?P<host>[^/]+)/agent/next_task", self.next_task)
+        r(
+            "POST",
+            r"/rest/v2/hosts/(?P<host>[^/]+)/agent/provisioning_done",
+            self.provisioning_done,
+        )
         r("GET", r"/rest/v2/tasks/(?P<task>[^/]+)/agent/config", self.task_config)
         r(
             "GET",
@@ -455,6 +460,11 @@ class RestApi:
             # reference checkHostHealth (rest/route/host_agent.go): an
             # agent on any non-running host exits instead of polling
             return 200, {"task_id": "", "should_exit": True}
+        if h.needs_reprovision:
+            # the host must change bootstrap method: the agent exits so
+            # the reprovision job can convert the freed host (reference
+            # host_agent.go:112-160 reprovisioning health check)
+            return 200, {"task_id": "", "should_exit": True}
         t = assign_next_available_task(self.store, self.svc, h)
         # single-task distros run exactly one task per host, then the agent
         # exits and the host is recycled (reference units/host_allocator.go
@@ -473,6 +483,16 @@ class RestApi:
             "build_id": t.build_id,
             "should_exit": False,
         }
+
+    def provisioning_done(self, method, match, body):
+        """Phone-home for self-provisioning (user-data) hosts; the route
+        sits under the host-credentialed agent path (reference
+        rest/route/host_provisioning.go + provisioning_user_data_done.go).
+        """
+        from ..cloud.provisioning import mark_provisioning_done
+
+        ok = mark_provisioning_done(self.store, match["host"])
+        return 200, {"ok": ok}
 
     def task_config(self, method, match, body):
         t = task_mod.get(self.store, match["task"])

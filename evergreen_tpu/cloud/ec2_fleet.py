@@ -31,10 +31,14 @@ class FakeEC2Client:
 
     def __init__(self) -> None:
         self.instances: Dict[str, dict] = {}
+        #: raw launch specs, newest last (lets tests assert on what the
+        #: cloud API was actually asked for, e.g. user data payloads)
+        self.fleet_requests: list = []
 
     def create_fleet(self, launch_spec: dict) -> str:
         with self._lock:
             iid = f"i-{next(self._seq):012x}"
+        self.fleet_requests.append(dict(launch_spec))
         self.instances[iid] = {
             "state": "pending",
             "type": launch_spec.get("instance_type", "m5.large"),
@@ -121,6 +125,7 @@ class EC2FleetManager(CloudManager):
                 "ami": settings.get("ami", ""),
                 "subnet": settings.get("subnet_id", ""),
                 "key_name": settings.get("key_name", ""),
+                "user_data": host.user_data,
             }
         )
         host_mod.coll(store).update(

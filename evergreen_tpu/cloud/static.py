@@ -55,6 +55,8 @@ def update_static_distro(
         seen.add(hid)
         existing = host_mod.get(store, hid)
         if existing is None:
+            from .provisioning import needs_reprovisioning
+
             host_mod.insert(
                 store,
                 Host(
@@ -66,13 +68,25 @@ def update_static_distro(
                     provision_time=now,
                     last_communication_time=now,
                     secret=uuid.uuid4().hex,
+                    bootstrap_method=d.bootstrap_settings.method,
+                    needs_reprovision=needs_reprovisioning(d, None),
                 ),
             )
             out.append(hid)
-        elif existing.status != HostStatus.RUNNING.value:
-            host_mod.coll(store).update(
-                hid, {"status": HostStatus.RUNNING.value}
-            )
+        else:
+            from .provisioning import needs_reprovisioning
+
+            update: dict = {}
+            if existing.status != HostStatus.RUNNING.value:
+                update["status"] = HostStatus.RUNNING.value
+            # the reference re-evaluates the bootstrap transition for
+            # every static host on each allocator pass
+            # (scheduler/wrapper.go:233-266 via UpdateStaticDistro)
+            want = needs_reprovisioning(d, existing)
+            if want != existing.needs_reprovision:
+                update["needs_reprovision"] = want
+            if update:
+                host_mod.coll(store).update(hid, update)
     # decommission hosts removed from the settings list
     for h in host_mod.find(
         store,

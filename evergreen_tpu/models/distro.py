@@ -66,6 +66,37 @@ class HostAllocatorSettings:
 
 
 @dataclasses.dataclass
+class BootstrapSettings:
+    """How a host of this distro acquires a running agent (reference
+    model/distro/distro.go BootstrapSettings: method + communication).
+
+    - ``legacy-ssh``/``ssh``: the server pushes the agent over a host
+      transport (agent-deploy job) and re-pushes it when it goes silent.
+    - ``user-data``: the host self-provisions from generated user data
+      (cloud/userdata.py) and phones home; the agent monitor keeps the
+      agent alive locally.
+    - ``preconfigured-image``: the image already runs an agent monitor;
+      no provisioning step beyond the cloud instance coming up.
+    """
+
+    METHOD_LEGACY_SSH = "legacy-ssh"
+    METHOD_SSH = "ssh"
+    METHOD_USER_DATA = "user-data"
+    METHOD_PRECONFIGURED = "preconfigured-image"
+
+    method: str = "legacy-ssh"
+    communication: str = "legacy-ssh"
+    env: dict = dataclasses.field(default_factory=dict)
+
+    def is_legacy(self) -> bool:
+        """Reference distro.LegacyBootstrap()."""
+        return self.method in ("", self.METHOD_LEGACY_SSH)
+
+    def self_provisions(self) -> bool:
+        return self.method in (self.METHOD_USER_DATA, self.METHOD_PRECONFIGURED)
+
+
+@dataclasses.dataclass
 class DispatcherSettings:
     version: str = DispatcherVersion.REVISED_WITH_DEPENDENCIES.value
 
@@ -97,6 +128,9 @@ class Distro:
         default_factory=DispatcherSettings
     )
     finder_settings: FinderSettings = dataclasses.field(default_factory=FinderSettings)
+    bootstrap_settings: BootstrapSettings = dataclasses.field(
+        default_factory=BootstrapSettings
+    )
     single_task_distro: bool = False
 
     def is_ephemeral(self) -> bool:
@@ -116,6 +150,7 @@ class Distro:
             ("host_allocator_settings", HostAllocatorSettings),
             ("dispatcher_settings", DispatcherSettings),
             ("finder_settings", FinderSettings),
+            ("bootstrap_settings", BootstrapSettings),
         ):
             if isinstance(doc.get(key), dict):
                 doc[key] = sub(**doc[key])

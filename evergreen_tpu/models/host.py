@@ -20,6 +20,14 @@ from ..storage.store import Collection, Store
 
 COLLECTION = "hosts"
 
+# Reprovision transitions (reference model/host/host.go:196-209
+# ReprovisionType). "restart-agent" is the analog of RestartJasper: same
+# bootstrap method, but the host's agent runtime must be bounced.
+REPROVISION_NONE = ""
+REPROVISION_TO_NEW = "convert-to-new"
+REPROVISION_TO_LEGACY = "convert-to-legacy"
+REPROVISION_RESTART_AGENT = "restart-agent"
+
 
 @dataclasses.dataclass(slots=True)
 class Host:
@@ -55,8 +63,21 @@ class Host:
 
     total_idle_time_s: float = 0.0
     provision_time: float = 0.0
+    #: pending bootstrap transition (REPROVISION_* below); consumed by
+    #: cloud/provisioning.reprovision_hosts and gates next_task
     needs_reprovision: str = ""
     provision_attempts: int = 0
+    #: bootstrap method the host was actually provisioned with — compared
+    #: against the distro's current BootstrapSettings.method to detect
+    #: needed reprovisioning (reference host.Distro.BootstrapSettings
+    #: snapshot vs the live distro, scheduler/wrapper.go:233-266)
+    bootstrap_method: str = ""
+    #: consecutive failed agent (re)deploys; poisons the host at the cap
+    agent_deploy_attempts: int = 0
+    #: generated cloud-init payload for self-provisioning hosts; attached
+    #: to the provider's launch request (reference ec2 LaunchTemplate
+    #: UserData)
+    user_data: str = ""
 
     #: per-host agent credential, generated at creation and handed to the
     #: agent at deploy time; agent routes authenticate with it (reference
@@ -108,9 +129,11 @@ class Host:
     def to_api_doc(self) -> dict:
         """Store doc minus the agent credential — the ONLY shape API
         surfaces may serialize (a leaked secret lets any API user
-        impersonate the host's agent)."""
+        impersonate the host's agent). The generated user_data embeds the
+        same secret, so it is stripped too."""
         doc = self.to_doc()
         doc.pop("secret", None)
+        doc.pop("user_data", None)
         return doc
 
     @classmethod

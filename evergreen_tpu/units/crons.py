@@ -12,7 +12,13 @@ import time as _time
 from typing import List
 
 from ..events.triggers import process_unprocessed_events
-from ..cloud.provisioning import create_hosts_from_intents, provision_ready_hosts
+from ..cloud.provisioning import (
+    agent_keepalive,
+    create_hosts_from_intents,
+    mark_hosts_needing_reprovision,
+    provision_ready_hosts,
+    reprovision_hosts,
+)
 from ..ingestion.generate import process_generate_requests
 from ..models import taskstats
 from ..queue.jobs import CronRunner, FnJob, Job, JobQueue
@@ -122,6 +128,18 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             job_type="host-drawdown",
         ),
         FnJob(
+            f"agent-keepalive-{now:.3f}",
+            lambda s: agent_keepalive(s),
+            scopes=["agent-keepalive"],
+            job_type="agent-keepalive",
+        ),
+        FnJob(
+            f"reprovision-{now:.3f}",
+            _reprovision_pass,
+            scopes=["reprovision"],
+            job_type="reprovision",
+        ),
+        FnJob(
             f"spawnhost-expiration-{now:.3f}",
             _expire_spawn_hosts,
             scopes=["spawnhost-expiration"],
@@ -134,6 +152,13 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             job_type="sleep-schedules",
         ),
     ]
+
+
+def _reprovision_pass(s: Store) -> None:
+    """Mark bootstrap-method drift, then convert whatever is free (the
+    reference's convert_host_to_new/_to_legacy job pair)."""
+    mark_hosts_needing_reprovision(s)
+    reprovision_hosts(s)
 
 
 def _expire_spawn_hosts(s: Store) -> None:

@@ -38,4 +38,7 @@ def store():
     docker_mod.reset_default_client()
     triggers._SENDERS.clear()
     github_status._store_ref = None
+    from evergreen_tpu.cloud import provisioning as prov_mod
+
+    prov_mod.set_transport(prov_mod.LocalTransport())
     return reset_global_store()

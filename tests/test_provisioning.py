@@ -1,0 +1,476 @@
+"""Provisioning completeness: user-data generation, self-provisioning
+phone-home, server-driven agent deploy + keep-alive, and the
+reprovisioning state machine.
+
+Reference analogs: cloud/userdata/*_test.go,
+units/provisioning_user_data_done_test.go,
+units/provisioning_agent_deploy.go retry/poison accounting,
+units/provisioning_convert_host_to_{new,legacy}_test.go and
+scheduler/wrapper.go:233-266 needsReprovisioning.
+"""
+import dataclasses
+
+import pytest
+
+from evergreen_tpu.api.rest import RestApi
+from evergreen_tpu.cloud import provisioning as prov
+from evergreen_tpu.cloud import userdata as ud
+from evergreen_tpu.cloud.provisioning import (
+    FakeTransport,
+    agent_keepalive,
+    create_hosts_from_intents,
+    deploy_agent,
+    mark_hosts_needing_reprovision,
+    mark_provisioning_done,
+    needs_reprovisioning,
+    provision_ready_hosts,
+    reprovision_hosts,
+)
+from evergreen_tpu.cloud.static import update_static_distro
+from evergreen_tpu.globals import HostStatus, Provider
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models.distro import BootstrapSettings, Distro
+from evergreen_tpu.models.host import (
+    REPROVISION_NONE,
+    REPROVISION_RESTART_AGENT,
+    REPROVISION_TO_LEGACY,
+    REPROVISION_TO_NEW,
+    new_intent,
+)
+
+NOW = 1_700_000_000.0
+
+
+def events_of(store, kind):
+    return [
+        d
+        for d in store.collection("events").find(
+            lambda d: d["event_type"] == kind
+        )
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# user data
+# --------------------------------------------------------------------------- #
+
+
+def test_userdata_directive_validation():
+    ud.UserData(directive="#!/bin/sh", content="echo hi").validate()
+    with pytest.raises(ud.UserDataError):
+        ud.UserData(directive="", content="x").validate()
+    with pytest.raises(ud.UserDataError):
+        ud.UserData(directive="#notreal", content="x").validate()
+    # persist is Windows-only (reference options.go:40-41)
+    with pytest.raises(ud.UserDataError):
+        ud.UserData(directive="#!/bin/sh", content="x", persist=True).validate()
+    ud.UserData(directive="<powershell>", content="x", persist=True).validate()
+
+
+def test_userdata_windows_closing_tag_and_persist():
+    u = ud.UserData(directive="<powershell>", content="Write-Host hi",
+                    persist=True)
+    out = u.render()
+    assert out.startswith("<powershell>\n")
+    assert "<persist>true</persist>" in out
+    assert out.rstrip().endswith("</powershell>")
+
+
+def test_userdata_parse_round_trip():
+    u = ud.parse("#!/bin/bash\necho one\n")
+    assert u.directive == "#!/bin/bash"
+    assert u.content.strip() == "echo one"
+    w = ud.parse("<powershell>\nWrite-Host x\n</powershell>")
+    assert w.directive == "<powershell>"
+    assert w.content.strip() == "Write-Host x"
+
+
+def test_userdata_merge_shell_parts_custom_first():
+    custom = ud.UserData(directive="#!/bin/sh", content="echo custom")
+    prov_part = ud.UserData(directive="#!/bin/sh", content="echo provision")
+    merged = ud.merge_parts([custom, prov_part])
+    assert merged.index("echo custom") < merged.index("echo provision")
+    # single directive line survives
+    assert merged.count("#!/bin/sh") == 1
+
+
+def test_userdata_merge_mixed_types_multipart():
+    parts = [
+        ud.UserData(directive="#cloud-config", content="runcmd: [ls]"),
+        ud.UserData(directive="#!/bin/sh", content="echo hi"),
+    ]
+    merged = ud.merge_parts(parts)
+    assert "multipart/mixed" in merged
+    assert "text/cloud-config" in merged
+    assert "text/x-shellscript" in merged
+
+
+def test_provisioning_script_contains_secret_setup_and_phone_home(store):
+    d = Distro(id="d1", setup="echo setup-step",
+               bootstrap_settings=BootstrapSettings(method="user-data"))
+    h = new_intent("d1", Provider.MOCK.value)
+    payload = ud.for_host(d, h, "http://api:9090")
+    assert h.secret in payload
+    assert "echo setup-step" in payload
+    assert f"hosts/{h.id}/agent/provisioning_done" in payload
+    assert "agent-monitor" in payload
+
+
+def test_userdata_merge_windows_custom_shell_goes_multipart():
+    """A Windows provisioning part plus a custom #! part must not be
+    concatenated under one interpreter (or trip persist validation) —
+    mixed interpreters become a MIME multipart."""
+    custom = ud.UserData(directive="#!/bin/sh", content="echo custom")
+    win = ud.UserData(directive="<powershell>", content="Write-Host p",
+                      persist=True)
+    merged = ud.merge_parts([custom, win])
+    assert "multipart/mixed" in merged
+    assert "</powershell>" in merged
+
+
+def test_malformed_custom_user_data_does_not_stall_create_pass(store):
+    """Reference behavior to preserve: one distro's bad settings must not
+    take down provisioning for everyone (per-host isolation)."""
+    d = Distro(
+        id="d-bad",
+        provider=Provider.MOCK.value,
+        provider_settings={"user_data": "echo no directive"},
+        bootstrap_settings=BootstrapSettings(method="user-data"),
+    )
+    distro_mod.insert(store, d)
+    bad = new_intent("d-bad", Provider.MOCK.value)
+    host_mod.insert(store, bad)
+    _make_distro(store, "d-good", "user-data")
+    good = new_intent("d-good", Provider.MOCK.value)
+    host_mod.insert(store, good)
+    spawned = create_hosts_from_intents(store, NOW)
+    assert set(spawned) == {bad.id, good.id}
+    # the bad host still got the framework provisioning part
+    doc = host_mod.coll(store).get(bad.id)
+    assert "provisioning_done" in doc["user_data"]
+    assert events_of(store, "HOST_USER_DATA_INVALID")
+
+
+def test_api_url_resolved_from_config_and_secret_redacted(store):
+    from evergreen_tpu.settings import ApiConfig
+
+    cfg = ApiConfig.get(store)
+    cfg.url = "https://evg.example.com"
+    cfg.set(store)
+    _make_distro(store, "d-url", "user-data")
+    intent = new_intent("d-url", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    create_hosts_from_intents(store, NOW)
+    h = host_mod.get(store, intent.id)
+    assert "https://evg.example.com" in h.user_data
+    # user_data embeds the host secret → API doc shape must strip it
+    api_doc = h.to_api_doc()
+    assert "user_data" not in api_doc and "secret" not in api_doc
+
+
+def test_ec2_spawn_request_carries_user_data(store):
+    from evergreen_tpu.cloud import ec2_fleet
+
+    ec2_fleet.reset_default_client()
+    d = Distro(
+        id="d-ec2ud",
+        provider=Provider.EC2_FLEET.value,
+        provider_settings={"instance_type": "m5.large"},
+        bootstrap_settings=BootstrapSettings(method="user-data"),
+    )
+    distro_mod.insert(store, d)
+    intent = new_intent("d-ec2ud", Provider.EC2_FLEET.value)
+    host_mod.insert(store, intent)
+    create_hosts_from_intents(store, NOW)
+    client = ec2_fleet.default_client()
+    req = client.fleet_requests[-1]
+    assert "provisioning_done" in req["user_data"]
+
+
+# --------------------------------------------------------------------------- #
+# self-provisioning (user-data) lifecycle
+# --------------------------------------------------------------------------- #
+
+
+def _make_distro(store, distro_id, method, setup=""):
+    d = Distro(
+        id=distro_id,
+        provider=Provider.MOCK.value,
+        setup=setup,
+        bootstrap_settings=BootstrapSettings(
+            method=method,
+            communication="rpc" if method != "legacy-ssh" else "legacy-ssh",
+        ),
+    )
+    distro_mod.insert(store, d)
+    return d
+
+
+def test_user_data_host_waits_for_phone_home(store):
+    _make_distro(store, "d-ud", "user-data")
+    intent = new_intent("d-ud", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    create_hosts_from_intents(store, NOW)
+    doc = host_mod.coll(store).get(intent.id)
+    assert doc["bootstrap_method"] == "user-data"
+    assert "provisioning_done" in doc["user_data"]
+    # cloud says running, but the host has not phoned home: held in
+    # PROVISIONING, not promoted
+    provision_ready_hosts(store, NOW + 5)
+    h = host_mod.get(store, intent.id)
+    assert h.status == HostStatus.PROVISIONING.value
+    provision_ready_hosts(store, NOW + 10)
+    assert host_mod.get(store, intent.id).status == HostStatus.PROVISIONING.value
+    # phone-home promotes to RUNNING (provisioning_user_data_done.go)
+    assert mark_provisioning_done(store, intent.id, NOW + 30)
+    h = host_mod.get(store, intent.id)
+    assert h.status == HostStatus.RUNNING.value
+    assert h.agent_start_time == NOW + 30
+    assert events_of(store, "HOST_PROVISIONED")
+    # idempotent
+    assert mark_provisioning_done(store, intent.id, NOW + 31)
+
+
+def test_user_data_host_times_out_to_provision_failed(store):
+    _make_distro(store, "d-ud2", "user-data")
+    intent = new_intent("d-ud2", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW)
+    assert host_mod.get(store, intent.id).status == HostStatus.PROVISIONING.value
+    provision_ready_hosts(store, NOW + prov.USER_DATA_DONE_TIMEOUT_S + 1)
+    h = host_mod.get(store, intent.id)
+    assert h.status in (
+        HostStatus.PROVISION_FAILED.value,
+        HostStatus.TERMINATED.value,
+    )
+    assert events_of(store, "HOST_PROVISION_FAILED")
+
+
+def test_provisioning_done_rest_route_is_host_credentialed(store):
+    _make_distro(store, "d-ud3", "user-data")
+    intent = new_intent("d-ud3", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW)
+    api = RestApi(store, require_auth=True)
+    path = f"/rest/v2/hosts/{intent.id}/agent/provisioning_done"
+    st, _ = api.handle("POST", path, {}, headers={})
+    assert st in (401, 403)
+    st, out = api.handle(
+        "POST", path, {},
+        headers={"host-id": intent.id, "host-secret": intent.secret},
+    )
+    assert st == 200 and out["ok"]
+    assert host_mod.get(store, intent.id).status == HostStatus.RUNNING.value
+
+
+# --------------------------------------------------------------------------- #
+# server-driven (ssh) deploy + keep-alive
+# --------------------------------------------------------------------------- #
+
+
+def test_ssh_bootstrap_deploys_agent_over_transport(store):
+    d = _make_distro(store, "d-ssh", "ssh", setup="echo prep")
+    intent = new_intent("d-ssh", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    t = FakeTransport()
+    create_hosts_from_intents(store, NOW)
+    ready = provision_ready_hosts(store, NOW, transport=t)
+    assert ready == [intent.id]
+    h = host_mod.get(store, intent.id)
+    assert h.status == HostStatus.RUNNING.value
+    # the deploy script carried the secret + setup script
+    (hid, script), = [s for s in t.scripts if s[0] == intent.id]
+    assert intent.secret in script and "echo prep" in script
+    assert events_of(store, "AGENT_DEPLOYED")
+    assert d.bootstrap_settings.is_legacy() is False
+
+
+def test_deploy_failure_retries_then_poisons(store):
+    d = _make_distro(store, "d-fail", "ssh")
+    intent = new_intent("d-fail", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    t = FakeTransport()
+    t.fail_next(intent.id, times=prov.MAX_AGENT_DEPLOY_ATTEMPTS + 5)
+    create_hosts_from_intents(store, NOW)
+    for i in range(prov.MAX_AGENT_DEPLOY_ATTEMPTS):
+        provision_ready_hosts(store, NOW + i, transport=t)
+    h = host_mod.get(store, intent.id)
+    assert h.status in (
+        HostStatus.PROVISION_FAILED.value,
+        HostStatus.TERMINATED.value,
+    )
+    assert len(events_of(store, "AGENT_DEPLOY_FAILED")) == (
+        prov.MAX_AGENT_DEPLOY_ATTEMPTS
+    )
+    assert events_of(store, "HOST_PROVISION_FAILED")
+
+
+def test_keepalive_redeploys_silent_agent(store):
+    d = _make_distro(store, "d-ka", "ssh")
+    intent = new_intent("d-ka", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    t = FakeTransport()
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW, transport=t)
+    # still-fresh host: no redeploy
+    assert agent_keepalive(store, NOW + 60, transport=t) == []
+    # silent past the threshold: redeploy + stamp liveness
+    later = NOW + prov.MAX_UNCOMMUNICATED_S + 60
+    assert agent_keepalive(store, later, transport=t) == [intent.id]
+    h = host_mod.get(store, intent.id)
+    assert h.last_communication_time == later
+    # user-data hosts respawn locally via the agent monitor — keep-alive
+    # never reaches over the transport for them
+    _make_distro(store, "d-ka-ud", "user-data")
+    ud_intent = new_intent("d-ka-ud", Provider.MOCK.value)
+    host_mod.insert(store, ud_intent)
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW)
+    mark_provisioning_done(store, ud_intent.id, NOW)
+    n_scripts = len(t.scripts)
+    assert agent_keepalive(store, later * 2, transport=t) != [ud_intent.id]
+    assert all(hid != ud_intent.id for hid, _ in t.scripts[n_scripts:])
+
+
+def test_keepalive_skips_busy_hosts(store):
+    _make_distro(store, "d-busy", "ssh")
+    intent = new_intent("d-busy", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    t = FakeTransport()
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW, transport=t)
+    host_mod.coll(store).update(intent.id, {"running_task": "t1"})
+    later = NOW + prov.MAX_UNCOMMUNICATED_S + 60
+    assert agent_keepalive(store, later, transport=t) == []
+
+
+# --------------------------------------------------------------------------- #
+# reprovisioning state machine
+# --------------------------------------------------------------------------- #
+
+
+def test_needs_reprovisioning_transitions():
+    legacy = Distro(id="dl", bootstrap_settings=BootstrapSettings(
+        method="legacy-ssh"))
+    modern = Distro(id="dm", bootstrap_settings=BootstrapSettings(
+        method="user-data"))
+    # no host: only non-legacy distros require provisioning-to-new
+    assert needs_reprovisioning(legacy, None) == REPROVISION_NONE
+    assert needs_reprovisioning(modern, None) == REPROVISION_TO_NEW
+    # drift in both directions
+    h = host_mod.Host(id="h1", bootstrap_method="legacy-ssh")
+    assert needs_reprovisioning(modern, h) == REPROVISION_TO_NEW
+    h2 = host_mod.Host(id="h2", bootstrap_method="user-data")
+    assert needs_reprovisioning(legacy, h2) == REPROVISION_TO_LEGACY
+    assert needs_reprovisioning(modern, h2) == REPROVISION_NONE
+    # a marked transition is preserved while consistent, dropped when not
+    h3 = host_mod.Host(id="h3", bootstrap_method="legacy-ssh",
+                       needs_reprovision=REPROVISION_TO_NEW)
+    assert needs_reprovisioning(modern, h3) == REPROVISION_TO_NEW
+    assert needs_reprovisioning(legacy, h3) == REPROVISION_NONE
+    h4 = host_mod.Host(id="h4", bootstrap_method="user-data",
+                       needs_reprovision=REPROVISION_RESTART_AGENT)
+    assert needs_reprovisioning(modern, h4) == REPROVISION_RESTART_AGENT
+    # restart-agent is method-agnostic: a legacy host's pending bounce
+    # survives the mark pass instead of being silently cleared
+    h5 = host_mod.Host(id="h5", bootstrap_method="legacy-ssh",
+                       needs_reprovision=REPROVISION_RESTART_AGENT)
+    assert needs_reprovisioning(legacy, h5) == REPROVISION_RESTART_AGENT
+
+
+def test_full_lifecycle_with_reprovision_and_agent_respawn(store):
+    """The VERDICT's done-criterion: intent → building → provisioning →
+    running → reprovision → running with a fresh agent deploy."""
+    d = _make_distro(store, "d-life", "legacy-ssh")
+    intent = new_intent("d-life", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    assert host_mod.get(store, intent.id).status == (
+        HostStatus.UNINITIALIZED.value)
+    t = FakeTransport()
+    create_hosts_from_intents(store, NOW)
+    assert host_mod.get(store, intent.id).status in (
+        HostStatus.STARTING.value,
+        HostStatus.BUILDING.value,
+        HostStatus.PROVISIONING.value,
+    )
+    provision_ready_hosts(store, NOW, transport=t)
+    h = host_mod.get(store, intent.id)
+    assert h.status == HostStatus.RUNNING.value
+    first_agent_start = h.agent_start_time
+    assert h.bootstrap_method == "legacy-ssh"
+
+    # operator flips the distro to user-data bootstrap
+    doc = distro_mod.coll(store).get("d-life")
+    doc["bootstrap_settings"]["method"] = "user-data"
+    distro_mod.coll(store).update("d-life", doc)
+    assert mark_hosts_needing_reprovision(store, NOW + 100) == [intent.id]
+    h = host_mod.get(store, intent.id)
+    assert h.needs_reprovision == REPROVISION_TO_NEW
+
+    # a busy host is not converted; its agent is told to exit via
+    # next_task so the host frees up (host_agent.go health checks)
+    host_mod.coll(store).update(intent.id, {"running_task": "t-busy"})
+    assert reprovision_hosts(store, NOW + 110, transport=t) == []
+    api = RestApi(store)
+    st, out = api.handle(
+        "GET", f"/rest/v2/hosts/{intent.id}/agent/next_task", {}, headers={}
+    )
+    assert st == 200 and out["should_exit"]
+    host_mod.coll(store).update(intent.id, {"running_task": ""})
+
+    # freed host converts: provisioned with the new method, agent redeployed
+    assert reprovision_hosts(store, NOW + 120, transport=t) == [intent.id]
+    h = host_mod.get(store, intent.id)
+    assert h.status == HostStatus.RUNNING.value
+    assert h.needs_reprovision == REPROVISION_NONE
+    assert h.bootstrap_method == "user-data"
+    assert h.agent_start_time == NOW + 120 > first_agent_start
+    assert events_of(store, "HOST_REPROVISIONED")
+    # and next_task serves it normally again
+    st, out = api.handle(
+        "GET", f"/rest/v2/hosts/{intent.id}/agent/next_task", {}, headers={}
+    )
+    assert st == 200 and not out["should_exit"]
+
+
+def test_reprovision_failure_returns_host_to_running_for_retry(store):
+    _make_distro(store, "d-rf", "legacy-ssh")
+    intent = new_intent("d-rf", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    t = FakeTransport()
+    create_hosts_from_intents(store, NOW)
+    provision_ready_hosts(store, NOW, transport=t)
+    doc = distro_mod.coll(store).get("d-rf")
+    doc["bootstrap_settings"]["method"] = "ssh"
+    distro_mod.coll(store).update("d-rf", doc)
+    mark_hosts_needing_reprovision(store, NOW)
+    t.fail_next(intent.id, times=1)
+    assert reprovision_hosts(store, NOW + 10, transport=t) == []
+    h = host_mod.get(store, intent.id)
+    assert h.status == HostStatus.RUNNING.value
+    assert h.needs_reprovision == REPROVISION_TO_NEW
+    # next pass succeeds
+    assert reprovision_hosts(store, NOW + 20, transport=t) == [intent.id]
+    assert host_mod.get(store, intent.id).bootstrap_method == "ssh"
+
+
+def test_static_update_marks_reprovision_on_bootstrap_change(store):
+    d = Distro(
+        id="d-static",
+        provider=Provider.STATIC.value,
+        provider_settings={"hosts": [{"name": "10.0.0.1"}]},
+        bootstrap_settings=BootstrapSettings(method="legacy-ssh"),
+    )
+    distro_mod.insert(store, d)
+    update_static_distro(store, d, NOW)
+    hid = "static-d-static-10.0.0.1"
+    assert host_mod.get(store, hid).needs_reprovision == REPROVISION_NONE
+    d2 = dataclasses.replace(
+        d, bootstrap_settings=BootstrapSettings(method="user-data")
+    )
+    distro_mod.upsert(store, d2)
+    update_static_distro(store, d2, NOW + 10)
+    assert host_mod.get(store, hid).needs_reprovision == REPROVISION_TO_NEW
