@@ -1,0 +1,551 @@
+"""Pluggable user managers behind one loader.
+
+Reference: auth/ package — LoadUserManager (auth.go:17) selects between
+naive (config users), GitHub OAuth (auth/github.go), Okta OIDC
+(auth/okta.go), API-only service users (auth/only_api.go), and external
+(auth/external.go) managers, all implementing gimlet.UserManager. Here the
+same selection runs over the runtime-editable ``auth`` config section
+(settings.AuthConfig), the OAuth/OIDC network legs sit behind injectable
+clients (fakes in tests — the zero-egress seam), and successful logins
+mint store-backed session tokens the REST middleware accepts alongside
+API keys. Routes are unchanged: session auth is an additional credential
+the same ``_authorize`` path resolves.
+"""
+from __future__ import annotations
+
+import abc
+import hashlib
+import secrets
+import time as _time
+import urllib.parse
+from typing import Dict, List, Optional
+
+from ..models import user as user_mod
+from ..models.user import User
+from ..storage.store import Store
+
+SESSIONS = "sessions"
+AUTH_STATES = "auth_states"
+
+#: login session lifetime (reference: gimlet usercache TTL / Okta
+#: ExpireAfterMinutes default)
+SESSION_TTL_S = 24 * 3600.0
+#: OAuth state nonce lifetime
+STATE_TTL_S = 10 * 60.0
+
+
+class AuthError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------- #
+# session primitives (shared by every manager that logs users in)
+# --------------------------------------------------------------------------- #
+
+
+def _mint_session(store: Store, user_id: str, now: Optional[float] = None) -> str:
+    now = _time.time() if now is None else now
+    token = secrets.token_hex(24)
+    coll = store.collection(SESSIONS)
+    coll.insert(
+        {
+            "_id": token,
+            "user_id": user_id,
+            "created_at": now,
+            "expires_at": now + SESSION_TTL_S,
+        }
+    )
+    # opportunistic purge so expired sessions cannot accumulate unbounded
+    coll.remove_where(lambda d: d["expires_at"] < now)
+    return token
+
+
+def session_user(
+    store: Store, token: str, now: Optional[float] = None
+) -> Optional[User]:
+    if not token:
+        return None
+    now = _time.time() if now is None else now
+    doc = store.collection(SESSIONS).get(token)
+    if doc is None or doc["expires_at"] < now:
+        return None
+    return user_mod.get_user(store, doc["user_id"])
+
+
+def clear_session(store: Store, token: str) -> bool:
+    return store.collection(SESSIONS).remove(token)
+
+
+def _issue_state(store: Store, now: Optional[float] = None) -> str:
+    now = _time.time() if now is None else now
+    state = secrets.token_hex(16)
+    coll = store.collection(AUTH_STATES)
+    coll.insert({"_id": state, "created_at": now})
+    # opportunistic expiry of stale nonces
+    coll.remove_where(lambda d: now - d["created_at"] > STATE_TTL_S)
+    return state
+
+
+def _consume_state(store: Store, state: str, now: Optional[float] = None) -> bool:
+    now = _time.time() if now is None else now
+    coll = store.collection(AUTH_STATES)
+    doc = coll.get(state or "")
+    if doc is None or now - doc["created_at"] > STATE_TTL_S:
+        return False
+    coll.remove(state)
+    return True
+
+
+# --------------------------------------------------------------------------- #
+# manager interface
+# --------------------------------------------------------------------------- #
+
+
+class UserManager(abc.ABC):
+    """The gimlet.UserManager surface the routes consume."""
+
+    #: True when login is an IdP redirect (GitHub/Okta), False when the
+    #: server validates credentials itself (naive)
+    is_redirect = False
+
+    def get_user_by_token(
+        self, store: Store, token: str, now: Optional[float] = None
+    ) -> Optional[User]:
+        return session_user(store, token, now)
+
+    def create_user_token(
+        self, store: Store, username: str, password: str
+    ) -> Optional[str]:
+        """Password login; only the naive manager supports it (reference
+        github.go:94 CreateUserToken → error)."""
+        raise AuthError("this auth manager does not support password login")
+
+    def login_redirect(self, store: Store, callback_url: str) -> str:
+        raise AuthError("this auth manager does not use a login redirect")
+
+    def login_callback(self, store: Store, params: Dict[str, str]) -> str:
+        raise AuthError("this auth manager does not use a login callback")
+
+    def clear_user(self, store: Store, token: str) -> bool:
+        return clear_session(store, token)
+
+    def get_or_create_user(
+        self,
+        store: Store,
+        user_id: str,
+        display_name: str = "",
+        email: str = "",
+    ) -> User:
+        u = user_mod.get_user(store, user_id)
+        if u is not None:
+            return u
+        return user_mod.create_user(
+            store, user_id, display_name=display_name, email=email
+        )
+
+
+# --------------------------------------------------------------------------- #
+# naive
+# --------------------------------------------------------------------------- #
+
+
+def _password_matches(stored: str, given: str) -> bool:
+    if stored.startswith("sha256:"):
+        return stored[7:] == hashlib.sha256(given.encode()).hexdigest()
+    return secrets.compare_digest(stored, given)
+
+
+class NaiveUserManager(UserManager):
+    """Config-listed users with passwords (reference auth/naive.go +
+    NaiveAuthConfig, config_auth.go:34-36). Passwords may be stored
+    plaintext (reference behavior) or as ``sha256:<hexdigest>``."""
+
+    def __init__(self, users: List[Dict]) -> None:
+        self.users = {u.get("username", ""): u for u in users if u.get("username")}
+
+    def create_user_token(
+        self, store: Store, username: str, password: str
+    ) -> Optional[str]:
+        entry = self.users.get(username)
+        # an entry with no stored password is unloggable-into, never
+        # open: empty-vs-empty must not authenticate
+        if (
+            entry is None
+            or not entry.get("password")
+            or not _password_matches(entry["password"], password)
+        ):
+            return None
+        self.get_or_create_user(
+            store,
+            username,
+            display_name=entry.get("display_name", username),
+            email=entry.get("email", ""),
+        )
+        return _mint_session(store, username)
+
+
+# --------------------------------------------------------------------------- #
+# GitHub OAuth
+# --------------------------------------------------------------------------- #
+
+
+class GithubOAuthClient:
+    """Network leg of the GitHub OAuth web flow (reference auth/github.go
+    token exchange + thirdparty user/org lookups). Injectable; the
+    in-image default is the fake."""
+
+    def exchange_code(self, code: str) -> Optional[str]:  # pragma: no cover
+        raise NotImplementedError
+
+    def get_user(self, access_token: str) -> Optional[Dict]:  # pragma: no cover
+        """→ {"login": ..., "name": ..., "email": ...}"""
+        raise NotImplementedError
+
+    def user_in_organization(
+        self, access_token: str, login: str, org: str
+    ) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FakeGithubOAuth(GithubOAuthClient):
+    def __init__(self) -> None:
+        self.codes: Dict[str, str] = {}  # code → access token
+        self.tokens: Dict[str, Dict] = {}  # access token → user info
+        self.org_members: Dict[str, set] = {}  # org → {login}
+
+    def add_user(self, code: str, login: str, orgs: List[str],
+                 name: str = "", email: str = "") -> None:
+        token = f"gho_{secrets.token_hex(8)}"
+        self.codes[code] = token
+        self.tokens[token] = {"login": login, "name": name or login,
+                              "email": email}
+        for org in orgs:
+            self.org_members.setdefault(org, set()).add(login)
+
+    def exchange_code(self, code: str) -> Optional[str]:
+        return self.codes.get(code)
+
+    def get_user(self, access_token: str) -> Optional[Dict]:
+        return self.tokens.get(access_token)
+
+    def user_in_organization(self, access_token: str, login: str, org: str) -> bool:
+        return login in self.org_members.get(org, set())
+
+
+class GithubUserManager(UserManager):
+    """GitHub OAuth web-application flow (reference auth/github.go:46-178):
+    redirect to GitHub with a state nonce, exchange the callback code for
+    an access token, admit the user if they belong to the configured
+    organization (or the explicit allow-list)."""
+
+    is_redirect = True
+
+    def __init__(
+        self,
+        client_id: str,
+        client_secret: str,
+        organization: str,
+        users: Optional[List[str]] = None,
+        client: Optional[GithubOAuthClient] = None,
+    ) -> None:
+        if not (client_id and client_secret):
+            raise AuthError("github auth requires client id and secret")
+        if not organization and not users:
+            raise AuthError("github auth requires an organization or user list")
+        self.client_id = client_id
+        self.organization = organization
+        self.users = set(users or [])
+        self.client = client or FakeGithubOAuth()
+
+    def login_redirect(self, store: Store, callback_url: str) -> str:
+        state = _issue_state(store)
+        q = urllib.parse.urlencode(
+            {
+                "client_id": self.client_id,
+                "redirect_uri": callback_url,
+                "scope": "user:email read:org",
+                "state": state,
+            }
+        )
+        return f"https://github.com/login/oauth/authorize?{q}"
+
+    def login_callback(self, store: Store, params: Dict[str, str]) -> str:
+        if not _consume_state(store, params.get("state", "")):
+            raise AuthError("invalid or expired OAuth state")
+        token = self.client.exchange_code(params.get("code", ""))
+        if not token:
+            raise AuthError("could not exchange OAuth code")
+        info = self.client.get_user(token)
+        if not info or not info.get("login"):
+            raise AuthError("could not resolve GitHub user")
+        login = info["login"]
+        allowed = login in self.users or (
+            self.organization
+            and self.client.user_in_organization(token, login, self.organization)
+        )
+        if not allowed:
+            raise AuthError(
+                f"GitHub user {login!r} is not in the allowed organization"
+            )
+        self.get_or_create_user(
+            store, login, display_name=info.get("name", login),
+            email=info.get("email", ""),
+        )
+        return _mint_session(store, login)
+
+
+# --------------------------------------------------------------------------- #
+# Okta / OIDC
+# --------------------------------------------------------------------------- #
+
+
+class OidcClient:
+    """Network leg of the OIDC authorization-code flow (reference
+    auth/okta.go token exchange + claim validation)."""
+
+    def exchange_code(self, code: str) -> Optional[Dict]:  # pragma: no cover
+        """→ claims dict: {"email": ..., "name": ..., "groups": [...]}"""
+        raise NotImplementedError
+
+
+class FakeOidc(OidcClient):
+    def __init__(self) -> None:
+        self.codes: Dict[str, Dict] = {}
+
+    def add_user(self, code: str, email: str, groups: List[str],
+                 name: str = "") -> None:
+        self.codes[code] = {"email": email, "name": name or email,
+                            "groups": list(groups)}
+
+    def exchange_code(self, code: str) -> Optional[Dict]:
+        return self.codes.get(code)
+
+
+def reconcile_okta_id(email: str, expected_domains: List[str]) -> str:
+    """Username from an OIDC email (reference auth/okta.go:61-76
+    makeReconciliateID): strip the domain only when it is allow-listed
+    (or the list is empty — legacy behavior), so accounts sharing a
+    local-part across domains cannot collide."""
+    local, _, domain = email.partition("@")
+    if not domain:
+        return email
+    if not expected_domains or domain in expected_domains:
+        return local
+    return email
+
+
+class OktaUserManager(UserManager):
+    """Okta-shaped OIDC manager (reference auth/okta.go:17-60): redirect
+    to the issuer's authorize endpoint, exchange the code for claims,
+    require the configured user group, derive the username from the
+    email claim."""
+
+    is_redirect = True
+
+    def __init__(
+        self,
+        client_id: str,
+        client_secret: str,
+        issuer: str,
+        user_group: str = "",
+        expected_email_domains: Optional[List[str]] = None,
+        scopes: Optional[List[str]] = None,
+        client: Optional[OidcClient] = None,
+    ) -> None:
+        if not (client_id and client_secret and issuer):
+            raise AuthError("okta auth requires client id, secret, and issuer")
+        self.client_id = client_id
+        self.issuer = issuer.rstrip("/")
+        self.user_group = user_group
+        self.expected_email_domains = expected_email_domains or []
+        self.scopes = scopes or ["openid", "email", "profile", "groups"]
+        self.client = client or FakeOidc()
+
+    def login_redirect(self, store: Store, callback_url: str) -> str:
+        state = _issue_state(store)
+        q = urllib.parse.urlencode(
+            {
+                "client_id": self.client_id,
+                "redirect_uri": callback_url,
+                "response_type": "code",
+                "scope": " ".join(self.scopes),
+                "state": state,
+            }
+        )
+        return f"{self.issuer}/v1/authorize?{q}"
+
+    def login_callback(self, store: Store, params: Dict[str, str]) -> str:
+        if not _consume_state(store, params.get("state", "")):
+            raise AuthError("invalid or expired OAuth state")
+        claims = self.client.exchange_code(params.get("code", ""))
+        if not claims or not claims.get("email"):
+            raise AuthError("could not exchange OIDC code")
+        if self.user_group and self.user_group not in claims.get("groups", []):
+            raise AuthError(
+                f"user is not in required group {self.user_group!r}"
+            )
+        user_id = reconcile_okta_id(
+            claims["email"], self.expected_email_domains
+        )
+        self.get_or_create_user(
+            store, user_id, display_name=claims.get("name", user_id),
+            email=claims["email"],
+        )
+        return _mint_session(store, user_id)
+
+
+# --------------------------------------------------------------------------- #
+# API-only + external
+# --------------------------------------------------------------------------- #
+
+
+class OnlyApiUserManager(UserManager):
+    """Service users with API keys and no interactive login (reference
+    auth/only_api.go: only users flagged only_api are served). Session
+    tokens are never minted; the REST middleware's API-key path is the
+    sole credential."""
+
+    def get_user_by_token(
+        self, store: Store, token: str, now: Optional[float] = None
+    ) -> Optional[User]:
+        return None
+
+    def clear_user(self, store: Store, token: str) -> bool:
+        return False
+
+
+class ExternalUserManager(UserManager):
+    """Users are provisioned and authenticated by an external system
+    (reference auth/external.go: a fronting proxy asserts identity);
+    sessions are honored but never minted here."""
+
+
+class MultiUserManager(UserManager):
+    """Ordered chain; first manager that resolves wins (reference
+    makeMultiManager via gimlet's multi user manager)."""
+
+    def __init__(self, managers: List[UserManager]) -> None:
+        if not managers:
+            raise AuthError("multi auth requires at least one manager")
+        self.managers = managers
+        self.is_redirect = managers[0].is_redirect
+
+    def get_user_by_token(
+        self, store: Store, token: str, now: Optional[float] = None
+    ) -> Optional[User]:
+        for m in self.managers:
+            u = m.get_user_by_token(store, token, now)
+            if u is not None:
+                return u
+        return None
+
+    def create_user_token(
+        self, store: Store, username: str, password: str
+    ) -> Optional[str]:
+        supported = False
+        for m in self.managers:
+            try:
+                tok = m.create_user_token(store, username, password)
+            except AuthError:
+                continue
+            supported = True
+            if tok:
+                return tok
+        if not supported:
+            raise AuthError("no manager in the chain supports password login")
+        return None
+
+    def login_redirect(self, store: Store, callback_url: str) -> str:
+        for m in self.managers:
+            if m.is_redirect:
+                return m.login_redirect(store, callback_url)
+        raise AuthError("no manager in the chain uses a login redirect")
+
+    def login_callback(self, store: Store, params: Dict[str, str]) -> str:
+        last_err: Optional[AuthError] = None
+        for m in self.managers:
+            if not m.is_redirect:
+                continue
+            try:
+                return m.login_callback(store, params)
+            except AuthError as exc:
+                last_err = exc
+        raise last_err or AuthError("no manager handled the login callback")
+
+
+# --------------------------------------------------------------------------- #
+# loader
+# --------------------------------------------------------------------------- #
+
+
+def load_user_manager(
+    store: Store,
+    github_client: Optional[GithubOAuthClient] = None,
+    oidc_client: Optional[OidcClient] = None,
+) -> UserManager:
+    """Build the configured manager (reference auth.go:17 LoadUserManager):
+    honor preferred_type first, then fall through the same precedence
+    chain — okta, naive, github, api-only, external."""
+    from ..settings import AuthConfig
+
+    cfg = AuthConfig.get(store)
+
+    def make(kind: str) -> UserManager:
+        if kind == "naive":
+            return NaiveUserManager(getattr(cfg, "naive_users", []) or [])
+        if kind == "github":
+            return GithubUserManager(
+                cfg.github_client_id,
+                cfg.github_client_secret,
+                cfg.github_organization,
+                users=getattr(cfg, "github_users", []) or [],
+                client=github_client,
+            )
+        if kind == "okta":
+            return OktaUserManager(
+                cfg.okta_client_id,
+                cfg.okta_client_secret,
+                cfg.okta_issuer,
+                user_group=getattr(cfg, "okta_user_group", ""),
+                expected_email_domains=getattr(
+                    cfg, "okta_expected_email_domains", []
+                )
+                or [],
+                client=oidc_client,
+            )
+        if kind == "api_only":
+            return OnlyApiUserManager()
+        if kind == "external":
+            return ExternalUserManager()
+        if kind == "multi":
+            # ordered chain of other kinds (reference makeMultiManager)
+            return MultiUserManager(
+                [make(k) for k in getattr(cfg, "multi_managers", []) or []]
+            )
+        raise AuthError(f"unknown auth manager type {kind!r}")
+
+    if cfg.preferred_type:
+        try:
+            return make(cfg.preferred_type)
+        except AuthError:
+            pass
+    # precedence fallback (auth.go:34-51)
+    if cfg.okta_client_id and cfg.okta_issuer:
+        try:
+            return make("okta")
+        except AuthError:
+            pass
+    if getattr(cfg, "naive_users", None):
+        return make("naive")
+    if cfg.github_client_id and cfg.github_client_secret:
+        try:
+            return make("github")
+        except AuthError:
+            pass
+    if cfg.allow_service_users:
+        return make("api_only")
+    if cfg.external_validation_url:
+        return make("external")
+    # an empty config still yields a working (empty) naive manager so the
+    # API-key path keeps functioning
+    return NaiveUserManager([])

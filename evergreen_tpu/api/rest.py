@@ -47,10 +47,26 @@ class ApiError(Exception):
 Handler = Callable[[str, re.Match, dict], Tuple[int, Any]]
 
 
+def _session_token(headers: Dict[str, str]) -> str:
+    """Session credential from Authorization: Bearer … or the evg-token
+    cookie (the shapes gimlet's user middleware accepts)."""
+    authz = headers.get("authorization", "")
+    if authz.lower().startswith("bearer "):
+        return authz[7:].strip()
+    for part in headers.get("cookie", "").split(";"):
+        name, _, value = part.strip().partition("=")
+        if name == "evg-token":
+            return value
+    return ""
+
+
 #: route prefixes the agent protocol uses (host-credentialed in the
 #: reference; exempt from user-key auth)
 _AGENT_PATHS = re.compile(r"^/rest/v2/(hosts/[^/]+/agent/|tasks/[^/]+/agent/)")
 _ADMIN_PATHS = re.compile(r"^/rest/v2/(admin/|distros/[^/]+$|projects/[^/]+$)")
+#: login surface: reachable without credentials (it is how you get them);
+#: still behind the pre-auth peer rate limit
+_LOGIN_PATHS = re.compile(r"^/(login(/redirect|/callback)?|logout)$")
 
 
 class RestApi:
@@ -60,10 +76,14 @@ class RestApi:
         dispatcher_service: Optional[DispatcherService] = None,
         require_auth: bool = False,
         rate_limit_per_min: Optional[int] = None,
+        user_manager=None,
     ) -> None:
         self.store = store
         self.svc = dispatcher_service or DispatcherService(store)
         self.require_auth = require_auth
+        #: pluggable login manager (api/auth.py); None → built lazily from
+        #: the admin-editable auth config section
+        self._user_manager = user_manager
         #: None = per-request default from the admin-editable rate_limit
         #: config section (live, like webhook_secret); 0 = explicitly
         #: unlimited; >0 = fixed limit
@@ -88,6 +108,19 @@ class RestApi:
 
         _install_ghs(store)
         _install_senders(store)
+
+    @property
+    def user_manager(self):
+        if self._user_manager is None:
+            from .auth import load_user_manager
+
+            self._user_manager = load_user_manager(self.store)
+        return self._user_manager
+
+    def reload_user_manager(self) -> None:
+        """Drop the cached manager so the next request re-reads the auth
+        config section (called after admin edits to it)."""
+        self._user_manager = None
 
     @property
     def webhook_secret(self) -> str:
@@ -133,6 +166,7 @@ class RestApi:
         victim's would starve them."""
         self._ident.user = ""
         self._ident.superuser = False
+        self._ident.headers = headers
         limit = self._rate_limit_explicit
         pre_mult = 4
         if limit is None:
@@ -150,11 +184,20 @@ class RestApi:
         denied = None
         if self.require_auth and _AGENT_PATHS.match(path):
             denied = self._authorize_agent(path, headers)
-        elif self.require_auth:
+        elif self.require_auth and not _LOGIN_PATHS.match(path):
             from ..models import user as user_mod
 
             u = user_mod.user_by_api_key(self.store, headers.get("api-key", ""))
-            if u is None or u.id != headers.get("api-user", u.id):
+            if u is not None and u.id != headers.get("api-user", u.id):
+                u = None
+            if u is None:
+                # session token minted by the configured user manager
+                # (reference: gimlet session cookie auth alongside the
+                # api-key middleware)
+                u = self.user_manager.get_user_by_token(
+                    self.store, _session_token(headers)
+                )
+            if u is None:
                 return 401, {"error": "invalid or missing API credentials"}
             self._ident.user = u.id
             self._ident.superuser = u.has_scope(user_mod.SCOPE_SUPERUSER)
@@ -423,6 +466,12 @@ class RestApi:
         r("GET", r"/rest/v2/admin/settings", self.get_admin)
         r("POST", r"/rest/v2/admin/settings", self.set_admin)
         r("GET", r"/rest/v2/status", self.status)
+        # login surface (reference service/ui.go login routes + gimlet
+        # user-manager handlers); manager-agnostic
+        r("POST", r"/login", self.login)
+        r("GET", r"/login/redirect", self.login_redirect)
+        r("GET", r"/login/callback", self.login_callback)
+        r("POST", r"/logout", self.logout)
         r("GET", r"/rest/v2/events", self.list_events)
         r(
             "GET",
@@ -483,6 +532,61 @@ class RestApi:
             "build_id": t.build_id,
             "should_exit": False,
         }
+
+    # -- login surface --------------------------------------------------- #
+
+    def login(self, method, match, body):
+        """Password login (naive manager). Redirect-based managers point
+        the client at /login/redirect instead."""
+        from .auth import AuthError
+
+        mgr = self.user_manager
+        if mgr.is_redirect:
+            return 400, {
+                "error": "this deployment logs in via an identity provider",
+                "redirect": "/login/redirect",
+            }
+        try:
+            token = mgr.create_user_token(
+                self.store, body.get("username", ""), body.get("password", "")
+            )
+        except AuthError as e:
+            return 400, {"error": str(e)}
+        if not token:
+            return 401, {"error": "invalid username or password"}
+        return 200, {"token": token}
+
+    def login_redirect(self, method, match, body):
+        from .auth import AuthError
+
+        callback = body.get(
+            "callback", f"{self._own_url()}/login/callback"
+        )
+        try:
+            url = self.user_manager.login_redirect(self.store, callback)
+        except AuthError as e:
+            return 400, {"error": str(e)}
+        return 200, {"redirect": url}
+
+    def login_callback(self, method, match, body):
+        from .auth import AuthError
+
+        try:
+            token = self.user_manager.login_callback(self.store, body)
+        except AuthError as e:
+            return 401, {"error": str(e)}
+        return 200, {"token": token}
+
+    def logout(self, method, match, body):
+        headers = getattr(self._ident, "headers", {}) or {}
+        token = body.get("token", "") or _session_token(headers)
+        ok = self.user_manager.clear_user(self.store, token)
+        return 200, {"ok": ok}
+
+    def _own_url(self) -> str:
+        from ..settings import ApiConfig
+
+        return ApiConfig.get(self.store).url or "http://localhost:9090"
 
     def provisioning_done(self, method, match, body):
         """Phone-home for self-provisioning (user-data) hosts; the route
@@ -1159,6 +1263,10 @@ class RestApi:
                 setattr(section, k, v)
             section.set(self.store)
             updated.append(sid)
+        if "auth" in updated:
+            # the user manager is built from the auth section; a stale
+            # cache would keep serving revoked credentials/managers
+            self.reload_user_manager()
         return 200, {"updated": updated}
 
     def queue_position(self, method, match, body):

@@ -230,22 +230,42 @@ class AuthConfig(ConfigSection):
 
     section_id = "auth"
 
-    preferred_type: str = "naive"  # naive | github | okta | api_only | external
+    #: naive | github | okta | api_only | external | multi
+    preferred_type: str = "naive"
     allow_service_users: bool = False
     background_reauth_minutes: int = 0
+    #: manager kinds chained in order when preferred_type == "multi"
+    #: (reference AuthConfig.Multi read-write list)
+    multi_managers: List[str] = dataclasses.field(default_factory=list)
+    #: naive manager: [{"username", "password"|"sha256:<hex>",
+    #: "display_name", "email"}] (reference NaiveAuthConfig.Users)
+    naive_users: List[Dict] = dataclasses.field(default_factory=list)
     github_client_id: str = ""
     github_client_secret: str = ""
     github_organization: str = ""
+    #: explicit GitHub allow-list admitted without org membership
+    github_users: List[str] = dataclasses.field(default_factory=list)
     okta_client_id: str = ""
     okta_client_secret: str = ""
     okta_issuer: str = ""
+    okta_user_group: str = ""
+    okta_expected_email_domains: List[str] = dataclasses.field(
+        default_factory=list
+    )
     external_validation_url: str = ""
 
     def validate_and_default(self) -> str:
-        if self.preferred_type not in (
-            "naive", "github", "okta", "api_only", "external",
-        ):
+        kinds = ("naive", "github", "okta", "api_only", "external")
+        if self.preferred_type not in kinds + ("multi",):
             return f"unknown auth manager type {self.preferred_type!r}"
+        if self.preferred_type == "multi" and not self.multi_managers:
+            return "multi auth needs a multi_managers list"
+        for k in self.multi_managers:
+            if k not in kinds:
+                return f"unknown manager kind {k!r} in multi_managers"
+        for u in self.naive_users:
+            if not u.get("username"):
+                return "every naive auth user needs a username"
         return ""
 
 

@@ -1,0 +1,354 @@
+"""Pluggable auth managers behind one loader (reference auth/ package:
+auth.go:17 LoadUserManager, naive.go, github.go, okta.go, only_api.go,
+external.go) and their REST wiring: login routes + session-token auth
+alongside API keys, with routes otherwise unchanged.
+"""
+import pytest
+
+from evergreen_tpu.api import auth as auth_mod
+from evergreen_tpu.api.auth import (
+    AuthError,
+    ExternalUserManager,
+    FakeGithubOAuth,
+    FakeOidc,
+    GithubUserManager,
+    MultiUserManager,
+    NaiveUserManager,
+    OktaUserManager,
+    OnlyApiUserManager,
+    load_user_manager,
+    reconcile_okta_id,
+    session_user,
+)
+from evergreen_tpu.api.rest import RestApi
+from evergreen_tpu.models import user as user_mod
+from evergreen_tpu.settings import AuthConfig
+
+
+NAIVE_USERS = [
+    {"username": "alice", "password": "wonderland", "display_name": "Alice",
+     "email": "alice@example.com"},
+    {"username": "bob", "password": "sha256:"
+     "df6b07176a9b17cc4c9afc257bd404732e7d09b76436c7890f7b7be14e579794"},
+]
+
+
+# --------------------------------------------------------------------------- #
+# naive
+# --------------------------------------------------------------------------- #
+
+
+def test_naive_login_and_session(store):
+    mgr = NaiveUserManager(NAIVE_USERS)
+    assert mgr.create_user_token(store, "alice", "wrong") is None
+    assert mgr.create_user_token(store, "nobody", "x") is None
+    tok = mgr.create_user_token(store, "alice", "wonderland")
+    assert tok
+    u = mgr.get_user_by_token(store, tok)
+    assert u is not None and u.id == "alice" and u.email == "alice@example.com"
+    # logout kills the session
+    assert mgr.clear_user(store, tok)
+    assert mgr.get_user_by_token(store, tok) is None
+
+
+def test_naive_hashed_password(store):
+    mgr = NaiveUserManager(NAIVE_USERS)
+    import hashlib
+
+    assert NAIVE_USERS[1]["password"].endswith(
+        hashlib.sha256(b"builder").hexdigest()
+    )
+    assert mgr.create_user_token(store, "bob", "builder")
+    assert mgr.create_user_token(store, "bob", "not-builder") is None
+
+
+def test_session_expiry(store):
+    import time
+
+    mgr = NaiveUserManager(NAIVE_USERS)
+    tok = mgr.create_user_token(store, "alice", "wonderland")
+    assert session_user(store, tok) is not None
+    # after TTL the session is dead
+    assert mgr.get_user_by_token(
+        store, tok, now=time.time() + auth_mod.SESSION_TTL_S + 1
+    ) is None
+
+
+# --------------------------------------------------------------------------- #
+# GitHub OAuth
+# --------------------------------------------------------------------------- #
+
+
+def _github_mgr(client=None):
+    return GithubUserManager(
+        "cid", "csecret", "my-org", users=["vip"], client=client
+    )
+
+
+def test_github_login_flow(store):
+    client = FakeGithubOAuth()
+    client.add_user("code-1", "octocat", ["my-org"], name="Octo Cat")
+    mgr = _github_mgr(client)
+    assert mgr.is_redirect
+    url = mgr.login_redirect(store, "http://evg/login/callback")
+    assert url.startswith("https://github.com/login/oauth/authorize?")
+    assert "client_id=cid" in url
+    state = url.split("state=")[1].split("&")[0]
+    tok = mgr.login_callback(store, {"code": "code-1", "state": state})
+    u = mgr.get_user_by_token(store, tok)
+    assert u.id == "octocat" and u.display_name == "Octo Cat"
+    # password login is not a thing for oauth managers (github.go:94)
+    with pytest.raises(AuthError):
+        mgr.create_user_token(store, "octocat", "pw")
+
+
+def test_github_rejects_non_members_and_bad_state(store):
+    client = FakeGithubOAuth()
+    client.add_user("code-out", "outsider", ["other-org"])
+    client.add_user("code-vip", "vip", [])
+    mgr = _github_mgr(client)
+    url = mgr.login_redirect(store, "cb")
+    state = url.split("state=")[1].split("&")[0]
+    with pytest.raises(AuthError, match="not in the allowed organization"):
+        mgr.login_callback(store, {"code": "code-out", "state": state})
+    # state nonce is single-use / must exist
+    with pytest.raises(AuthError, match="state"):
+        mgr.login_callback(store, {"code": "code-out", "state": "forged"})
+    # explicit allow-list admits without org membership
+    url2 = mgr.login_redirect(store, "cb")
+    state2 = url2.split("state=")[1].split("&")[0]
+    assert mgr.login_callback(store, {"code": "code-vip", "state": state2})
+
+
+# --------------------------------------------------------------------------- #
+# Okta / OIDC
+# --------------------------------------------------------------------------- #
+
+
+def test_okta_login_flow_with_group_and_domain_reconciliation(store):
+    client = FakeOidc()
+    client.add_user("c1", "dev@corp.com", ["evergreen-users"], name="Dev")
+    client.add_user("c2", "intern@other.com", ["evergreen-users"])
+    client.add_user("c3", "noaccess@corp.com", ["randos"])
+    mgr = OktaUserManager(
+        "cid", "csec", "https://corp.okta.com/oauth2/default",
+        user_group="evergreen-users",
+        expected_email_domains=["corp.com"],
+        client=client,
+    )
+    url = mgr.login_redirect(store, "cb")
+    assert url.startswith("https://corp.okta.com/oauth2/default/v1/authorize?")
+    state = url.split("state=")[1].split("&")[0]
+    tok = mgr.login_callback(store, {"code": "c1", "state": state})
+    # corp.com is allow-listed → local-part username (okta.go:61-76)
+    assert mgr.get_user_by_token(store, tok).id == "dev"
+    # other.com is not → full email as username (no collision)
+    state2 = mgr.login_redirect(store, "cb").split("state=")[1].split("&")[0]
+    tok2 = mgr.login_callback(store, {"code": "c2", "state": state2})
+    assert mgr.get_user_by_token(store, tok2).id == "intern@other.com"
+    # group gate
+    state3 = mgr.login_redirect(store, "cb").split("state=")[1].split("&")[0]
+    with pytest.raises(AuthError, match="group"):
+        mgr.login_callback(store, {"code": "c3", "state": state3})
+
+
+def test_reconcile_okta_id_unit():
+    assert reconcile_okta_id("a@x.com", []) == "a"  # legacy: always strip
+    assert reconcile_okta_id("a@x.com", ["x.com"]) == "a"
+    assert reconcile_okta_id("a@y.com", ["x.com"]) == "a@y.com"
+    assert reconcile_okta_id("no-at-sign", ["x.com"]) == "no-at-sign"
+
+
+# --------------------------------------------------------------------------- #
+# api-only / external / multi
+# --------------------------------------------------------------------------- #
+
+
+def test_only_api_manager_never_mints_sessions(store):
+    mgr = OnlyApiUserManager()
+    assert mgr.get_user_by_token(store, "anything") is None
+    with pytest.raises(AuthError):
+        mgr.create_user_token(store, "svc", "pw")
+
+
+def test_external_manager_honors_existing_sessions_only(store):
+    mgr = ExternalUserManager()
+    user_mod.create_user(store, "ext-user")
+    tok = auth_mod._mint_session(store, "ext-user")
+    assert mgr.get_user_by_token(store, tok).id == "ext-user"
+    with pytest.raises(AuthError):
+        mgr.login_redirect(store, "cb")
+
+
+def test_multi_manager_chains(store):
+    client = FakeGithubOAuth()
+    client.add_user("gcode", "ghuser", ["my-org"])
+    multi = MultiUserManager(
+        [_github_mgr(client), NaiveUserManager(NAIVE_USERS)]
+    )
+    # password login falls through to naive
+    tok = multi.create_user_token(store, "alice", "wonderland")
+    assert multi.get_user_by_token(store, tok).id == "alice"
+    # redirect goes to the github member
+    url = multi.login_redirect(store, "cb")
+    state = url.split("state=")[1].split("&")[0]
+    tok2 = multi.login_callback(store, {"code": "gcode", "state": state})
+    assert multi.get_user_by_token(store, tok2).id == "ghuser"
+
+
+# --------------------------------------------------------------------------- #
+# loader
+# --------------------------------------------------------------------------- #
+
+
+def _set_auth(store, **kw):
+    cfg = AuthConfig.get(store)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    cfg.set(store)
+
+
+def test_loader_selects_by_preferred_type(store):
+    _set_auth(store, preferred_type="naive", naive_users=NAIVE_USERS)
+    assert isinstance(load_user_manager(store), NaiveUserManager)
+    _set_auth(store, preferred_type="github", github_client_id="id",
+              github_client_secret="sec", github_organization="org")
+    assert isinstance(load_user_manager(store), GithubUserManager)
+    _set_auth(store, preferred_type="okta", okta_client_id="id",
+              okta_client_secret="sec", okta_issuer="https://x.okta.com")
+    assert isinstance(load_user_manager(store), OktaUserManager)
+    _set_auth(store, preferred_type="api_only")
+    assert isinstance(load_user_manager(store), OnlyApiUserManager)
+    _set_auth(store, preferred_type="external")
+    assert isinstance(load_user_manager(store), ExternalUserManager)
+
+
+def test_passwordless_naive_entry_cannot_log_in(store):
+    """A config entry without a password must not authenticate against an
+    empty password."""
+    mgr = NaiveUserManager([{"username": "svc"}])
+    assert mgr.create_user_token(store, "svc", "") is None
+
+
+def test_expired_sessions_are_purged_on_mint(store):
+    mgr = NaiveUserManager(NAIVE_USERS)
+    tok = mgr.create_user_token(store, "alice", "wonderland")
+    coll = store.collection(auth_mod.SESSIONS)
+    coll.update(tok, {"expires_at": 1.0})  # long expired
+    mgr.create_user_token(store, "alice", "wonderland")
+    assert coll.get(tok) is None
+
+
+def test_loader_builds_multi_chain_from_config(store):
+    _set_auth(
+        store,
+        preferred_type="multi",
+        multi_managers=["okta", "naive"],
+        naive_users=NAIVE_USERS,
+        okta_client_id="id",
+        okta_client_secret="sec",
+        okta_issuer="https://x.okta.com",
+    )
+    mgr = load_user_manager(store)
+    assert isinstance(mgr, MultiUserManager)
+    assert [type(m).__name__ for m in mgr.managers] == [
+        "OktaUserManager", "NaiveUserManager",
+    ]
+    # config validation rejects an empty or bogus chain
+    cfg = AuthConfig.get(store)
+    cfg.multi_managers = []
+    assert "multi_managers" in cfg.validate_and_default()
+    cfg.multi_managers = ["nope"]
+    assert "nope" in cfg.validate_and_default()
+
+
+def test_admin_auth_edit_reloads_user_manager(store):
+    _set_auth(store, preferred_type="naive", naive_users=NAIVE_USERS)
+    root = user_mod.create_user(store, "root",
+                                roles=[user_mod.SCOPE_SUPERUSER])
+    api = RestApi(store, require_auth=True)
+    hdrs = {"api-key": root.api_key, "api-user": root.id}
+    st, _ = api.handle("POST", "/login",
+                       {"username": "carol", "password": "pw"})
+    assert st == 401
+    st, _ = api.handle(
+        "POST", "/rest/v2/admin/settings",
+        {"auth": {"naive_users": NAIVE_USERS + [
+            {"username": "carol", "password": "pw"}]}},
+        headers=hdrs,
+    )
+    assert st == 200
+    # the manager cache was dropped: the new user can log in immediately
+    st, out = api.handle("POST", "/login",
+                         {"username": "carol", "password": "pw"})
+    assert st == 200 and out["token"]
+
+
+def test_loader_falls_through_on_broken_preference(store):
+    # preferred github but missing its credentials → precedence chain
+    # lands on naive (auth.go:34-51 fall-through)
+    _set_auth(store, preferred_type="github", github_client_id="",
+              github_client_secret="", naive_users=NAIVE_USERS)
+    assert isinstance(load_user_manager(store), NaiveUserManager)
+
+
+# --------------------------------------------------------------------------- #
+# REST wiring
+# --------------------------------------------------------------------------- #
+
+
+def test_rest_login_and_session_auth(store):
+    _set_auth(store, preferred_type="naive", naive_users=NAIVE_USERS)
+    api = RestApi(store, require_auth=True)
+    # login is reachable without credentials
+    st, out = api.handle("POST", "/login",
+                         {"username": "alice", "password": "wonderland"})
+    assert st == 200 and out["token"]
+    token = out["token"]
+    st, _ = api.handle("POST", "/login",
+                       {"username": "alice", "password": "nope"})
+    assert st == 401
+    # the minted session authenticates ordinary routes two ways
+    st, _ = api.handle("GET", "/rest/v2/status", {}, headers={})
+    assert st == 401
+    st, _ = api.handle("GET", "/rest/v2/status", {},
+                       headers={"authorization": f"Bearer {token}"})
+    assert st == 200
+    st, _ = api.handle("GET", "/rest/v2/status", {},
+                       headers={"cookie": f"a=b; evg-token={token}"})
+    assert st == 200
+    # API keys still work unchanged alongside sessions
+    u = user_mod.create_user(store, "keyuser")
+    st, _ = api.handle("GET", "/rest/v2/status", {},
+                       headers={"api-key": u.api_key, "api-user": u.id})
+    assert st == 200
+    # logout invalidates the session
+    st, out = api.handle("POST", "/logout", {"token": token})
+    assert st == 200 and out["ok"]
+    st, _ = api.handle("GET", "/rest/v2/status", {},
+                       headers={"authorization": f"Bearer {token}"})
+    assert st == 401
+
+
+def test_rest_redirect_manager_flow(store):
+    client = FakeGithubOAuth()
+    client.add_user("the-code", "octocat", ["my-org"])
+    api = RestApi(store, require_auth=True,
+                  user_manager=_github_mgr(client))
+    st, out = api.handle("POST", "/login", {"username": "x", "password": "y"})
+    assert st == 400 and out["redirect"] == "/login/redirect"
+    st, out = api.handle("GET", "/login/redirect", {})
+    assert st == 200
+    state = out["redirect"].split("state=")[1].split("&")[0]
+    st, out = api.handle("GET", "/login/callback",
+                         {"code": "the-code", "state": state})
+    assert st == 200 and out["token"]
+    st, _ = api.handle("GET", "/rest/v2/status", {},
+                       headers={"authorization": f"Bearer {out['token']}"})
+    assert st == 200
+    # bad code → 401
+    st2, out2 = api.handle("GET", "/login/redirect", {})
+    state2 = out2["redirect"].split("state=")[1].split("&")[0]
+    st, _ = api.handle("GET", "/login/callback",
+                       {"code": "wrong", "state": state2})
+    assert st == 401
