@@ -59,9 +59,13 @@ def _payload(channel: str, ntf: Notification) -> dict:
         base.update({"project_or_issue": ntf.subscriber_target,
                      "kind": channel, "summary": ntf.subject,
                      "description": ntf.body})
-    else:  # webhook: the reference POSTs a signed JSON payload
+    else:  # webhook: the reference POSTs a signed JSON payload; the
+        # subscription/notification ids let the drain transport find the
+        # HMAC secret and stamp the id header (util/webhook_grip.go)
         base.update({"url": ntf.subscriber_target,
-                     "payload": {"subject": ntf.subject, "body": ntf.body}})
+                     "payload": {"subject": ntf.subject, "body": ntf.body},
+                     "subscription_id": ntf.subscription_id,
+                     "notification_id": ntf.id})
     return base
 
 

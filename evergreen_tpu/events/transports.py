@@ -1,0 +1,314 @@
+"""Real outbound delivery transports behind an egress flag.
+
+Reference: units/event_send.go dispatches notification docs to per-channel
+senders — SMTP email, Slack, Jira issues/comments, signed evergreen
+webhooks (util/webhook_grip.go: POST with an ``X-Evergreen-Signature:
+sha256=<hmac>`` header, util/hmac_hash.go), and GitHub commit statuses
+(units/github_status_api.go → POST /repos/{owner}/{repo}/statuses/{sha}).
+
+This image is zero-egress, so senders default to outbox collections
+(events/senders.py). The transports here are the real client code: stdlib
+HTTP/SMTP, unit-tested against local fake servers, and wired to an
+``outbox drain`` job that delivers undrained rows whenever the notify
+config's egress flag is on. Delivery accounting (attempts, give-up cap)
+lives on the outbox row so a crash mid-drain resumes cleanly from the
+durable store.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import smtplib
+import time as _time
+import urllib.error
+import urllib.request
+from email.message import EmailMessage
+from typing import Callable, Dict, List, Optional
+
+from ..storage.store import Store
+from .senders import OUTBOX
+from .github_status import OUTBOX_COLLECTION as GITHUB_OUTBOX
+
+#: drained rows that failed this many times are abandoned (reference
+#: webhookRetryLimit / notification send job retry caps)
+MAX_DELIVERY_ATTEMPTS = 3
+
+HMAC_HEADER = "X-Evergreen-Signature"
+NOTIFICATION_ID_HEADER = "X-Evergreen-Notification-Id"
+
+
+class DeliveryError(Exception):
+    pass
+
+
+def calculate_hmac(secret: bytes, body: bytes) -> str:
+    """``sha256=<hexdigest>`` (reference util/hmac_hash.go:16-28)."""
+    mac = hmac.new(secret, body, hashlib.sha256)
+    return "sha256=" + mac.hexdigest()
+
+
+def _post_json(
+    url: str,
+    payload: dict,
+    headers: Optional[Dict[str, str]] = None,
+    timeout_s: float = 10.0,
+) -> int:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url,
+        data=body,
+        method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        raise DeliveryError(f"POST {url} → {e.code}") from e
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        # ValueError covers urllib's malformed-url family (unknown url
+        # type, InvalidURL) — user-supplied webhook targets hit it
+        raise DeliveryError(f"POST {url} failed: {e}") from e
+
+
+# --------------------------------------------------------------------------- #
+# transports (one per channel)
+# --------------------------------------------------------------------------- #
+
+
+class WebhookTransport:
+    """Signed JSON POST (reference util/webhook_grip.go:86-110): body is
+    HMAC-SHA256-signed with the subscription's secret; the signature and
+    notification id ride dedicated headers."""
+
+    def __init__(self, store: Store, timeout_s: float = 10.0) -> None:
+        self.store = store
+        self.timeout_s = timeout_s
+
+    def _secret_for(self, doc: dict) -> bytes:
+        sub_id = doc.get("subscription_id", "")
+        if sub_id:
+            sub = self.store.collection("subscriptions").get(sub_id)
+            if sub and sub.get("subscriber_secret"):
+                return str(sub["subscriber_secret"]).encode()
+        return b""
+
+    def deliver(self, doc: dict) -> None:
+        payload = doc.get("payload", {})
+        # sign exactly the bytes _post_json will send (json.dumps is
+        # deterministic for identical input)
+        body = json.dumps(payload).encode()
+        _post_json(
+            doc["url"],
+            payload,
+            {
+                HMAC_HEADER: calculate_hmac(self._secret_for(doc), body),
+                NOTIFICATION_ID_HEADER: doc.get("notification_id", ""),
+            },
+            self.timeout_s,
+        )
+
+
+class SmtpTransport:
+    """SMTP email delivery (reference units/event_send.go emailSender via
+    the notify config's SMTP settings)."""
+
+    def __init__(self, host: str, port: int, sender: str,
+                 timeout_s: float = 10.0) -> None:
+        if not host:
+            raise DeliveryError("smtp transport needs a host")
+        self.host = host
+        self.port = port
+        self.sender = sender
+        self.timeout_s = timeout_s
+
+    def deliver(self, doc: dict) -> None:
+        msg = EmailMessage()
+        msg["From"] = self.sender
+        msg["To"] = doc.get("to", "")
+        msg["Subject"] = doc.get("subject", "")
+        msg.set_content(doc.get("body", ""))
+        try:
+            with smtplib.SMTP(self.host, self.port,
+                              timeout=self.timeout_s) as smtp:
+                smtp.send_message(msg)
+        except (OSError, smtplib.SMTPException) as e:
+            raise DeliveryError(f"smtp send failed: {e}") from e
+
+
+class GithubStatusTransport:
+    """Commit-status poster (reference units/github_status_api.go +
+    thirdparty/github.go UpdateCommitStatus: POST
+    /repos/{owner}/{repo}/statuses/{sha})."""
+
+    def __init__(self, api_url: str, token: str,
+                 timeout_s: float = 10.0) -> None:
+        self.api_url = api_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+
+    def deliver(self, doc: dict) -> None:
+        url = f"{self.api_url}/repos/{doc['repo']}/statuses/{doc['sha']}"
+        headers = {"Accept": "application/vnd.github+json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        _post_json(
+            url,
+            {
+                "state": doc.get("state", "success"),
+                "description": doc.get("description", ""),
+                "context": doc.get("context", "evergreen-tpu"),
+            },
+            headers,
+            self.timeout_s,
+        )
+
+
+class SlackTransport:
+    """Slack message poster (reference units/event_send.go slack sender;
+    the API endpoint is configurable so tests point it at a local fake)."""
+
+    def __init__(self, api_url: str, token: str,
+                 timeout_s: float = 10.0) -> None:
+        if not api_url:
+            raise DeliveryError("slack transport needs an api_url")
+        self.api_url = api_url
+        self.token = token
+        self.timeout_s = timeout_s
+
+    def deliver(self, doc: dict) -> None:
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        _post_json(
+            self.api_url,
+            {"channel": doc.get("slack_channel", ""),
+             "text": doc.get("text", "")},
+            headers,
+            self.timeout_s,
+        )
+
+
+class JiraTransport:
+    """Jira issue/comment creator (reference units/event_send.go jira
+    senders over thirdparty/jira.go)."""
+
+    def __init__(self, host: str, timeout_s: float = 10.0) -> None:
+        if not host:
+            raise DeliveryError("jira transport needs a host")
+        self.host = host.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def deliver(self, doc: dict) -> None:
+        if doc.get("kind") == "jira-comment":
+            url = (f"{self.host}/rest/api/2/issue/"
+                   f"{doc.get('project_or_issue', '')}/comment")
+            payload = {"body": doc.get("description", "")}
+        else:
+            url = f"{self.host}/rest/api/2/issue"
+            payload = {
+                "fields": {
+                    "project": {"key": doc.get("project_or_issue", "")},
+                    "summary": doc.get("summary", ""),
+                    "description": doc.get("description", ""),
+                    "issuetype": {"name": "Task"},
+                }
+            }
+        _post_json(url, payload, timeout_s=self.timeout_s)
+
+
+# --------------------------------------------------------------------------- #
+# outbox drain
+# --------------------------------------------------------------------------- #
+
+#: outbox collection → transport key
+_OUTBOX_TRANSPORT = {
+    OUTBOX["email"]: "email",
+    OUTBOX["slack"]: "slack",
+    OUTBOX["jira"]: "jira",
+    OUTBOX["webhook"]: "webhook",
+    GITHUB_OUTBOX: "github-status",
+}
+
+
+def build_transports(store: Store) -> Dict[str, object]:
+    """Construct the configured transports (reference: the env's senders
+    built at startup from config, environment.go). Channels missing their
+    config are skipped — their outboxes simply keep accumulating."""
+    from ..settings import JiraConfig, NotifyConfig, SlackConfig
+
+    notify = NotifyConfig.get(store)
+    slack = SlackConfig.get(store)
+    jira = JiraConfig.get(store)
+    out: Dict[str, object] = {
+        "webhook": WebhookTransport(store, notify.webhook_timeout_s)
+    }
+    if notify.smtp_host:
+        out["email"] = SmtpTransport(
+            notify.smtp_host, notify.smtp_port, notify.smtp_from
+        )
+    if notify.github_api_url and notify.github_status_token:
+        out["github-status"] = GithubStatusTransport(
+            notify.github_api_url, notify.github_status_token
+        )
+    if slack.api_url:
+        out["slack"] = SlackTransport(slack.api_url, slack.token)
+    if jira.host:
+        out["jira"] = JiraTransport(jira.host)
+    return out
+
+
+def drain_outboxes(
+    store: Store,
+    transports: Optional[Dict[str, object]] = None,
+    now: Optional[float] = None,
+    max_attempts: int = MAX_DELIVERY_ATTEMPTS,
+    max_per_collection: Optional[int] = None,
+) -> Dict[str, int]:
+    """Deliver undrained outbox rows through the real transports
+    (reference units/event_send.go send jobs). No-op unless the notify
+    config's egress flag is on (or transports are injected — the test
+    seam). Returns delivered counts per collection.
+
+    Each collection drains at most ``max_per_collection`` rows per call
+    (default: the notify config's buffer_target_per_interval, the
+    reference's per-interval notification budget) so one backed-up
+    channel cannot monopolize the cron tick with blocking network I/O.
+    """
+    from ..settings import NotifyConfig
+
+    cfg = NotifyConfig.get(store)
+    if transports is None:
+        if not cfg.egress_enabled:
+            return {}
+        transports = build_transports(store)
+    if max_per_collection is None:
+        max_per_collection = max(1, cfg.buffer_target_per_interval)
+    now = _time.time() if now is None else now
+    delivered: Dict[str, int] = {}
+    for collection, key in _OUTBOX_TRANSPORT.items():
+        transport = transports.get(key)
+        if transport is None:
+            continue
+        coll = store.collection(collection)
+        rows = coll.find(
+            lambda d: not d.get("delivered") and not d.get("failed")
+        )
+        for doc in rows[:max_per_collection]:
+            try:
+                transport.deliver(doc)
+            except Exception as e:  # noqa: BLE001 — one poison row (bad
+                # URL, missing field) must cost itself an attempt, never
+                # abort the drain for every other row and channel
+                attempts = doc.get("attempts", 0) + 1
+                update = {"attempts": attempts, "error": str(e)}
+                if attempts >= max_attempts:
+                    update["failed"] = True
+                coll.update(doc["_id"], update)
+                continue
+            coll.update(
+                doc["_id"], {"delivered": True, "delivered_at": now}
+            )
+            delivered[collection] = delivered.get(collection, 0) + 1
+    return delivered

@@ -47,6 +47,9 @@ class Subscription:
     filters: Dict[str, str] = dataclasses.field(default_factory=dict)
     owner: str = ""
     enabled: bool = True
+    #: HMAC secret for webhook subscribers (reference
+    #: event.WebhookSubscriber.Secret, model/event/subscribers.go:132)
+    subscriber_secret: str = ""
 
     def to_doc(self) -> dict:
         doc = dataclasses.asdict(self)

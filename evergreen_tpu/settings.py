@@ -177,6 +177,16 @@ class NotifyConfig(ConfigSection):
     buffer_target_per_interval: int = 20
     buffer_interval_seconds: int = 60
     eventual_consistency_delay_s: float = 0.0
+    #: master egress switch: off (the in-image default) leaves deliveries
+    #: in the per-channel outboxes; on drains them through the real
+    #: transports (events/transports.py)
+    egress_enabled: bool = False
+    smtp_host: str = ""
+    smtp_port: int = 25
+    smtp_from: str = "evergreen@localhost"
+    webhook_timeout_s: float = 10.0
+    github_api_url: str = "https://api.github.com"
+    github_status_token: str = ""
 
 
 @register_section
@@ -525,6 +535,8 @@ class SlackConfig(ConfigSection):
     token: str = ""
     level: str = "error"
     name: str = ""
+    #: message-post endpoint; configurable so tests aim a local fake
+    api_url: str = ""
 
 
 @register_section

@@ -218,8 +218,22 @@ def event_notifier_jobs(store: Store, now: float) -> List[Job]:
             lambda s: process_unprocessed_events(s),
             scopes=["event-notifier"],
             job_type="event-notifier",
-        )
+        ),
+        FnJob(
+            f"outbox-drain-{now:.3f}",
+            _drain_outboxes,
+            scopes=["outbox-drain"],
+            job_type="outbox-drain",
+        ),
     ]
+
+
+def _drain_outboxes(s: Store) -> None:
+    """Deliver outbox rows through real transports when egress is enabled
+    (reference units/event_send.go send jobs); no-op otherwise."""
+    from ..events.transports import drain_outboxes
+
+    drain_outboxes(s)
 
 
 def stats_jobs(store: Store, now: float) -> List[Job]:

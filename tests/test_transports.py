@@ -1,0 +1,365 @@
+"""Outbound delivery transports against local fake servers.
+
+Reference analogs: units/event_send_test.go (per-channel senders),
+util/webhook_grip_test.go (HMAC signing), units/github_status_api.go.
+The egress flag keeps the zero-egress default (outbox only); these tests
+flip it / inject transports and assert the wire traffic.
+"""
+import hashlib
+import hmac as hmac_mod
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from evergreen_tpu.events import transports as tx
+from evergreen_tpu.events.senders import install as install_senders
+from evergreen_tpu.events.transports import (
+    DeliveryError,
+    GithubStatusTransport,
+    JiraTransport,
+    SlackTransport,
+    SmtpTransport,
+    WebhookTransport,
+    calculate_hmac,
+    drain_outboxes,
+)
+from evergreen_tpu.events.triggers import (
+    Subscription,
+    TRIGGER_OUTCOME,
+    add_subscription,
+    register_sender,
+)
+from evergreen_tpu.settings import NotifyConfig, SlackConfig
+
+NOW = 1_700_000_000.0
+
+
+# --------------------------------------------------------------------------- #
+# local fake servers
+# --------------------------------------------------------------------------- #
+
+
+class _Recorder(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        self.server.requests.append(
+            {
+                "path": self.path,
+                "headers": {k.lower(): v for k, v in self.headers.items()},
+                "body": body,
+            }
+        )
+        code = self.server.respond_with
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def http_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Recorder)
+    srv.requests = []
+    srv.respond_with = 200
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+class _FakeSmtpServer:
+    """Just enough SMTP to accept one message (smtplib client side)."""
+
+    def __init__(self) -> None:
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.messages = []
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn) -> None:
+        f = conn.makefile("rb")
+        conn.sendall(b"220 fake ESMTP\r\n")
+        data_mode = False
+        data = []
+        while True:
+            line = f.readline()
+            if not line:
+                break
+            if data_mode:
+                if line.rstrip() == b".":
+                    self.messages.append(b"".join(data).decode())
+                    data_mode = False
+                    conn.sendall(b"250 OK\r\n")
+                else:
+                    data.append(line)
+                continue
+            cmd = line.strip().upper()
+            if cmd.startswith(b"EHLO") or cmd.startswith(b"HELO"):
+                conn.sendall(b"250 fake\r\n")
+            elif cmd.startswith(b"DATA"):
+                data_mode = True
+                conn.sendall(b"354 go\r\n")
+            elif cmd.startswith(b"QUIT"):
+                conn.sendall(b"221 bye\r\n")
+                break
+            else:
+                conn.sendall(b"250 OK\r\n")
+        conn.close()
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+# --------------------------------------------------------------------------- #
+# individual transports
+# --------------------------------------------------------------------------- #
+
+
+def test_webhook_delivery_signs_payload(store, http_server):
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/hook"
+    add_subscription(
+        store,
+        Subscription(
+            id="sub-1", resource_type="TASK", trigger=TRIGGER_OUTCOME,
+            subscriber_type="webhook", subscriber_target=url,
+            subscriber_secret="topsecret",
+        ),
+    )
+    doc = {
+        "_id": "row1", "url": url, "delivered": False,
+        "payload": {"subject": "s", "body": "b"},
+        "subscription_id": "sub-1", "notification_id": "ntf-9",
+    }
+    WebhookTransport(store).deliver(doc)
+    (req,) = http_server.requests
+    assert req["path"] == "/hook"
+    expected = "sha256=" + hmac_mod.new(
+        b"topsecret", req["body"], hashlib.sha256
+    ).hexdigest()
+    assert req["headers"]["x-evergreen-signature"] == expected
+    assert req["headers"]["x-evergreen-notification-id"] == "ntf-9"
+    assert json.loads(req["body"]) == {"subject": "s", "body": "b"}
+
+
+def test_webhook_error_raises(store, http_server):
+    http_server.respond_with = 500
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/hook"
+    with pytest.raises(DeliveryError, match="500"):
+        WebhookTransport(store).deliver(
+            {"_id": "r", "url": url, "payload": {}}
+        )
+
+
+def test_github_status_transport(http_server):
+    base = f"http://127.0.0.1:{http_server.server_address[1]}"
+    t = GithubStatusTransport(base, "ghp_token")
+    t.deliver({"repo": "evergreen-ci/evergreen", "sha": "abc123",
+               "state": "failure", "description": "1 task failed",
+               "context": "evergreen-tpu"})
+    (req,) = http_server.requests
+    assert req["path"] == "/repos/evergreen-ci/evergreen/statuses/abc123"
+    assert req["headers"]["authorization"] == "Bearer ghp_token"
+    body = json.loads(req["body"])
+    assert body["state"] == "failure" and body["context"] == "evergreen-tpu"
+
+
+def test_slack_and_jira_transports(http_server):
+    base = f"http://127.0.0.1:{http_server.server_address[1]}"
+    SlackTransport(f"{base}/api/chat.postMessage", "xoxb").deliver(
+        {"slack_channel": "#ci", "text": "hello"}
+    )
+    JiraTransport(base).deliver(
+        {"kind": "jira", "project_or_issue": "EVG", "summary": "s",
+         "description": "d"}
+    )
+    JiraTransport(base).deliver(
+        {"kind": "jira-comment", "project_or_issue": "EVG-123",
+         "description": "a comment"}
+    )
+    paths = [r["path"] for r in http_server.requests]
+    assert paths == [
+        "/api/chat.postMessage",
+        "/rest/api/2/issue",
+        "/rest/api/2/issue/EVG-123/comment",
+    ]
+    slack_req = http_server.requests[0]
+    assert slack_req["headers"]["authorization"] == "Bearer xoxb"
+    issue = json.loads(http_server.requests[1]["body"])
+    assert issue["fields"]["project"]["key"] == "EVG"
+
+
+def test_smtp_transport():
+    srv = _FakeSmtpServer()
+    try:
+        t = SmtpTransport("127.0.0.1", srv.port, "evg@example.com")
+        t.deliver({"to": "dev@example.com", "subject": "task failed",
+                   "body": "details here"})
+    finally:
+        srv.close()
+    assert len(srv.messages) == 1
+    assert "Subject: task failed" in srv.messages[0]
+    assert "dev@example.com" in srv.messages[0]
+
+
+# --------------------------------------------------------------------------- #
+# outbox drain
+# --------------------------------------------------------------------------- #
+
+
+def test_drain_noop_without_egress_flag(store):
+    store.collection("webhook_outbox").insert(
+        {"_id": "r1", "url": "http://x", "payload": {}, "delivered": False}
+    )
+    assert drain_outboxes(store) == {}
+    assert not store.collection("webhook_outbox").get("r1")["delivered"]
+
+
+def test_drain_delivers_and_marks(store, http_server):
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/h"
+    store.collection("webhook_outbox").insert(
+        {"_id": "r1", "url": url, "payload": {"a": 1}, "delivered": False}
+    )
+    out = drain_outboxes(
+        store, transports={"webhook": WebhookTransport(store)}, now=NOW
+    )
+    assert out == {"webhook_outbox": 1}
+    row = store.collection("webhook_outbox").get("r1")
+    assert row["delivered"] and row["delivered_at"] == NOW
+    # an already-delivered row is not re-sent
+    drain_outboxes(
+        store, transports={"webhook": WebhookTransport(store)}, now=NOW + 1
+    )
+    assert len(http_server.requests) == 1
+
+
+def test_drain_retries_then_abandons(store, http_server):
+    http_server.respond_with = 503
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/h"
+    store.collection("webhook_outbox").insert(
+        {"_id": "r1", "url": url, "payload": {}, "delivered": False}
+    )
+    t = {"webhook": WebhookTransport(store)}
+    for i in range(tx.MAX_DELIVERY_ATTEMPTS):
+        assert drain_outboxes(store, transports=t) == {}
+    row = store.collection("webhook_outbox").get("r1")
+    assert row["attempts"] == tx.MAX_DELIVERY_ATTEMPTS
+    assert row["failed"] and "503" in row["error"]
+    # abandoned rows are not retried
+    n = len(http_server.requests)
+    drain_outboxes(store, transports=t)
+    assert len(http_server.requests) == n
+
+
+def test_poison_row_costs_itself_not_the_drain(store, http_server):
+    """A malformed row (bad URL scheme → ValueError inside urllib) must
+    be attempt-accounted like any failure, and rows after it still
+    deliver."""
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/ok"
+    coll = store.collection("webhook_outbox")
+    coll.insert({"_id": "bad", "url": "not-a-url", "payload": {},
+                 "delivered": False})
+    coll.insert({"_id": "good", "url": url, "payload": {},
+                 "delivered": False})
+    t = {"webhook": WebhookTransport(store)}
+    out = drain_outboxes(store, transports=t)
+    assert out == {"webhook_outbox": 1}
+    assert coll.get("good")["delivered"]
+    assert coll.get("bad")["attempts"] == 1
+    for _ in range(tx.MAX_DELIVERY_ATTEMPTS):
+        drain_outboxes(store, transports=t)
+    assert coll.get("bad")["failed"]
+
+
+def test_drain_batch_cap(store, http_server):
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/h"
+    coll = store.collection("webhook_outbox")
+    for i in range(5):
+        coll.insert({"_id": f"r{i}", "url": url, "payload": {},
+                     "delivered": False})
+    out = drain_outboxes(
+        store, transports={"webhook": WebhookTransport(store)},
+        max_per_collection=2,
+    )
+    assert out == {"webhook_outbox": 2}
+    assert len(http_server.requests) == 2
+
+
+def test_egress_flag_end_to_end(store, http_server):
+    """Flag on + configured endpoints → the cron-shaped drain call
+    builds transports from config and delivers (the VERDICT's done
+    criterion)."""
+    base = f"http://127.0.0.1:{http_server.server_address[1]}"
+    notify = NotifyConfig.get(store)
+    notify.egress_enabled = True
+    notify.github_api_url = base
+    notify.github_status_token = "tkn"
+    notify.set(store)
+    slack = SlackConfig.get(store)
+    slack.api_url = f"{base}/slack"
+    slack.set(store)
+    store.collection("github_status_outbox").insert(
+        {"_id": "g1", "repo": "o/r", "sha": "s1", "state": "success",
+         "description": "", "context": "evergreen-tpu", "delivered": False}
+    )
+    store.collection("slack_outbox").insert(
+        {"_id": "s1", "slack_channel": "#x", "text": "t",
+         "channel_type": "slack", "delivered": False}
+    )
+    out = drain_outboxes(store, now=NOW)
+    assert out == {"github_status_outbox": 1, "slack_outbox": 1}
+    paths = sorted(r["path"] for r in http_server.requests)
+    assert paths == ["/repos/o/r/statuses/s1", "/slack"]
+
+
+def test_notification_pipeline_to_wire(store, http_server):
+    """Subscription → notification → webhook outbox → drain → signed POST:
+    the full reference pipeline (trigger/process.go → event_send.go) on
+    local fakes."""
+    from evergreen_tpu.events.triggers import _SENDERS, Notification
+
+    install_senders(store)
+    url = f"http://127.0.0.1:{http_server.server_address[1]}/wh"
+    add_subscription(
+        store,
+        Subscription(
+            id="sub-e2e", resource_type="TASK", trigger=TRIGGER_OUTCOME,
+            subscriber_type="webhook", subscriber_target=url,
+            subscriber_secret="k",
+        ),
+    )
+    sender = _SENDERS["webhook"]
+    sender(Notification(
+        id="n1", subscription_id="sub-e2e", subscriber_type="webhook",
+        subscriber_target=url, subject="task finished", body="ok",
+        created_at=NOW,
+    ))
+    rows = store.collection("webhook_outbox").find(lambda d: True)
+    assert len(rows) == 1 and rows[0]["subscription_id"] == "sub-e2e"
+    out = drain_outboxes(
+        store, transports={"webhook": WebhookTransport(store)}
+    )
+    assert out == {"webhook_outbox": 1}
+    (req,) = http_server.requests
+    assert req["headers"]["x-evergreen-signature"] == calculate_hmac(
+        b"k", req["body"]
+    )
