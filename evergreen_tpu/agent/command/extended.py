@@ -114,6 +114,21 @@ class ZipExtract(Command):
 
 
 @register_command
+class AutoPack(Command):
+    """Format from the target's extension (reference
+    agent/command/archive_auto_create.go via registry.go:22
+    archive.auto_pack): .zip packs a zip, anything else a tarball."""
+
+    name = "archive.auto_pack"
+
+    def execute(self, ctx: CommandContext) -> CommandResult:
+        p = ctx.expansions.expand_any(self.params)
+        if p.get("target", "").endswith(".zip"):
+            return ZipPack(self.params).execute(ctx)
+        return TargzPack(self.params).execute(ctx)
+
+
+@register_command
 class AutoExtract(Command):
     name = "archive.auto_extract"
 

@@ -3,14 +3,23 @@
 The reference polls GitHub / receives push webhooks and creates a version
 per new revision (repotracker/repotracker.go:88 FetchRevisions, :220
 StoreRevisions, :613 CreateVersionFromConfig). Here the VCS boundary is the
-RevisionSource interface: production implementations fetch from a git
-provider; tests push revisions directly.
+RevisionSource interface (the repotracker/github_poller.go analog):
+production implementations poll a git provider — a GitHub-API-shaped HTTP
+client or a local clone — and ``fetch_revisions`` turns whatever is new
+since the recorded head into versions; tests push revisions directly.
 """
 from __future__ import annotations
 
+import abc
+import base64
 import dataclasses
+import json
+import subprocess
 import time as _time
-from typing import List, Optional
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
 
 from ..globals import Requester
 from ..models import event as event_mod
@@ -20,6 +29,9 @@ from .parser import ProjectParseError
 from .project import CreatedVersion, create_version
 
 PROJECT_REFS_COLLECTION = "project_refs"
+#: per-project polling head: {_id: project_id, last_revision}
+#: (reference model.Repository, repotracker.go StoreRevisions' head update)
+REPO_REVISIONS_COLLECTION = "repo_revisions"
 
 
 @dataclasses.dataclass
@@ -133,4 +145,246 @@ def store_revisions(
                 timestamp=now,
             )
         next_order += 1
+    if revisions and requester == Requester.REPOTRACKER.value:
+        # only real polled commits advance the polling head — downstream
+        # triggers / periodic builds pass synthetic revision strings that
+        # must never corrupt it
+        store.collection(REPO_REVISIONS_COLLECTION).upsert(
+            {"_id": project_id, "last_revision": revisions[-1].revision}
+        )
     return out
+
+
+# --------------------------------------------------------------------------- #
+# Revision sources (the github_poller.go seam)
+# --------------------------------------------------------------------------- #
+
+
+class RevisionSource(abc.ABC):
+    """What the poller needs from a VCS provider (reference
+    repotracker/github_poller.go GetRecentRevisions /
+    GetRevisionsAfterRevision)."""
+
+    @abc.abstractmethod
+    def get_recent_revisions(self, n: int) -> List[Revision]:
+        """Newest-first list of the n most recent revisions."""
+
+    @abc.abstractmethod
+    def get_revisions_after(self, revision: str, max_revs: int) -> List[Revision]:
+        """Newest-first revisions after (not including) ``revision``;
+        raises KeyError when the base revision cannot be found within
+        ``max_revs`` (the reference's revision-not-found error that
+        forces a base-revision update)."""
+
+
+class GithubApiRevisionSource(RevisionSource):
+    """GitHub-API-shaped poller (reference repotracker/github_poller.go
+    over thirdparty/github.go): lists commits on the branch and reads the
+    project file at each revision via the contents API. ``api_url`` is
+    injectable so tests aim a local fake server; egress deployments point
+    it at the real API."""
+
+    def __init__(
+        self,
+        owner: str,
+        repo: str,
+        branch: str,
+        remote_path: str,
+        api_url: str = "https://api.github.com",
+        token: str = "",
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.owner = owner
+        self.repo = repo
+        self.branch = branch
+        self.remote_path = remote_path
+        self.api_url = api_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+
+    def _get(self, path: str, params: Optional[Dict[str, str]] = None):
+        url = f"{self.api_url}{path}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        headers = {"Accept": "application/vnd.github+json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _config_at(self, sha: str) -> str:
+        try:
+            doc = self._get(
+                f"/repos/{self.owner}/{self.repo}/contents/{self.remote_path}",
+                {"ref": sha},
+            )
+        except urllib.error.HTTPError:
+            return ""
+        return base64.b64decode(doc.get("content", "")).decode()
+
+    def _to_revision(self, c: dict) -> Revision:
+        commit = c.get("commit", {})
+        author = commit.get("author", {})
+        ts = author.get("date", "")
+        try:
+            import datetime as _dt
+
+            create_time = _dt.datetime.fromisoformat(
+                ts.replace("Z", "+00:00")
+            ).timestamp() if ts else 0.0
+        except ValueError:
+            create_time = 0.0
+        return Revision(
+            revision=c.get("sha", ""),
+            author=(c.get("author") or {}).get("login", "")
+            or author.get("name", ""),
+            message=commit.get("message", ""),
+            create_time=create_time,
+            config_yaml=self._config_at(c.get("sha", "")),
+        )
+
+    def get_recent_revisions(self, n: int) -> List[Revision]:
+        commits = self._get(
+            f"/repos/{self.owner}/{self.repo}/commits",
+            {"sha": self.branch, "per_page": str(n)},
+        )
+        return [self._to_revision(c) for c in commits[:n]]
+
+    def get_revisions_after(self, revision: str, max_revs: int) -> List[Revision]:
+        commits = self._get(
+            f"/repos/{self.owner}/{self.repo}/commits",
+            {"sha": self.branch, "per_page": str(max_revs)},
+        )
+        out = []
+        for c in commits:
+            if c.get("sha") == revision:
+                return [self._to_revision(x) for x in out]
+            out.append(c)
+        raise KeyError(
+            f"revision {revision!r} not found in the last {max_revs} commits"
+        )
+
+
+class LocalGitRevisionSource(RevisionSource):
+    """Polls a local clone with git plumbing — the in-image (zero-egress)
+    production source and the smoke-test path."""
+
+    def __init__(self, repo_dir: str, branch: str, remote_path: str,
+                 timeout_s: float = 10.0) -> None:
+        self.repo_dir = repo_dir
+        self.branch = branch
+        self.remote_path = remote_path
+        self.timeout_s = timeout_s
+
+    def _git(self, *args: str) -> str:
+        # timeboxed like the HTTP source: a git process hung on a stale
+        # mount must not wedge the whole repotracker cron (which polls all
+        # projects sequentially under one scope lock)
+        return subprocess.run(
+            ["git", "-C", self.repo_dir, *args],
+            check=True, capture_output=True, text=True,
+            timeout=self.timeout_s,
+        ).stdout
+
+    def _revs(self, rev_range: str, cap: int) -> List[Revision]:
+        fmt = "%H%x1f%an%x1f%ct%x1f%s"
+        raw = self._git(
+            "log", f"--max-count={cap}", f"--format={fmt}", rev_range
+        )
+        out = []
+        for line in raw.splitlines():
+            sha, author, ct, msg = line.split("\x1f", 3)
+            try:
+                config = self._git("show", f"{sha}:{self.remote_path}")
+            except subprocess.CalledProcessError:
+                config = ""
+            out.append(
+                Revision(revision=sha, author=author, message=msg,
+                         create_time=float(ct), config_yaml=config)
+            )
+        return out
+
+    def get_recent_revisions(self, n: int) -> List[Revision]:
+        return self._revs(self.branch, n)
+
+    def get_revisions_after(self, revision: str, max_revs: int) -> List[Revision]:
+        try:
+            out = self._revs(f"{revision}..{self.branch}", max_revs + 1)
+        except subprocess.CalledProcessError as e:
+            raise KeyError(f"revision {revision!r} unknown: {e.stderr}") from e
+        if len(out) > max_revs:
+            raise KeyError(
+                f"revision {revision!r} not within the last {max_revs} commits"
+            )
+        return out
+
+
+#: project id → source; populated at service wiring (the reference builds
+#: its poller per project ref from GitHub settings)
+_SOURCES: Dict[str, RevisionSource] = {}
+
+
+def register_revision_source(project_id: str, source: RevisionSource) -> None:
+    _SOURCES[project_id] = source
+
+
+def fetch_revisions(
+    store: Store,
+    project_id: str,
+    source: Optional[RevisionSource] = None,
+    now: Optional[float] = None,
+) -> List[CreatedVersion]:
+    """One polling pass for a project (reference
+    repotracker.go:88 FetchRevisions): everything new since the recorded
+    head — or the configured recent-N on first activation — becomes
+    versions, oldest first. A head that fell out of the searchable window
+    fast-forwards to the newest revision (the reference's
+    update-base-revision recovery) so polling can resume."""
+    now = _time.time() if now is None else now
+    src = source or _SOURCES.get(project_id)
+    if src is None:
+        return []
+    ref = get_project_ref(store, project_id)
+    if ref is None or not ref.enabled:
+        return []
+    from ..settings import RepotrackerConfig
+
+    cfg = RepotrackerConfig.get(store)
+    head_doc = store.collection(REPO_REVISIONS_COLLECTION).get(project_id)
+    try:
+        if head_doc and head_doc.get("last_revision"):
+            newest_first = src.get_revisions_after(
+                head_doc["last_revision"], cfg.max_revs_to_search
+            )
+        else:
+            newest_first = src.get_recent_revisions(cfg.revs_to_fetch)
+    except KeyError as e:
+        # base revision vanished (force-push / shallow window): record the
+        # newest head and resume from there next pass
+        recent = src.get_recent_revisions(1)
+        if recent:
+            store.collection(REPO_REVISIONS_COLLECTION).upsert(
+                {"_id": project_id, "last_revision": recent[0].revision}
+            )
+        event_mod.log(
+            store,
+            event_mod.RESOURCE_VERSION,
+            "REPOTRACKER_BASE_UPDATED",
+            project_id,
+            {"error": str(e)},
+            timestamp=now,
+        )
+        return []
+    return store_revisions(
+        store, project_id, list(reversed(newest_first)), now=now
+    )
+
+
+def fetch_all_projects(store: Store, now: Optional[float] = None) -> int:
+    """Poll every project with a registered source (the repotracker cron
+    body, units/repotracker.go:48)."""
+    n = 0
+    for project_id in list(_SOURCES):
+        n += len(fetch_revisions(store, project_id, now=now))
+    return n

@@ -30,16 +30,29 @@ from ..globals import MAX_DURATION_PER_DISTRO_HOST_S
 _WEEK_S = 7.0 * 24.0 * 3600.0
 
 
+# Segment reductions spelled as scatter-reduce primitives directly
+# (jnp.zeros(n).at[seg].{add,max,min}), not via the jax.ops.segment_*
+# alias surface — the deprecated-alias shim can disappear in a jax
+# upgrade and this is the hot path. Semantics are identical: XLA lowers
+# both to the same scatter-reduce.
+
+
 def _seg_sum(x, seg, n):
-    return jax.ops.segment_sum(x, seg, num_segments=n)
+    return jnp.zeros((n,) + x.shape[1:], x.dtype).at[seg].add(x)
 
 
 def _seg_max(x, seg, n):
-    return jax.ops.segment_max(x, seg, num_segments=n)
+    init = jnp.full((n,) + x.shape[1:], -jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating
+    ) else jnp.full((n,) + x.shape[1:], jnp.iinfo(x.dtype).min, x.dtype)
+    return init.at[seg].max(x)
 
 
 def _seg_min(x, seg, n):
-    return jax.ops.segment_min(x, seg, num_segments=n)
+    init = jnp.full((n,) + x.shape[1:], jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating
+    ) else jnp.full((n,) + x.shape[1:], jnp.iinfo(x.dtype).max, x.dtype)
+    return init.at[seg].min(x)
 
 
 # --------------------------------------------------------------------------- #

@@ -329,7 +329,13 @@ def run_tick(
             memb_memo=memb_memo,
         )
         t2 = _time.perf_counter()
-        out = run_solve_packed(snapshot)
+        # optional XLA profiler capture of exactly this solve (SURVEY §5:
+        # profiler hooks beside the control-plane spans; enabled via the
+        # tracer config's xla_profile_dir)
+        from ..utils.tracing import maybe_xla_profile
+
+        with maybe_xla_profile(store):
+            out = run_solve_packed(snapshot)
         t3 = _time.perf_counter()
         snapshot_ms = (t2 - t1) * 1e3
         solve_ms = (t3 - t2) * 1e3

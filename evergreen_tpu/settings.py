@@ -516,6 +516,10 @@ class TracerConfig(ConfigSection):
     enabled: bool = False
     collector_endpoint: str = ""
     sample_ratio: float = 1.0
+    #: when set, the batched solve runs under the XLA/JAX profiler and
+    #: writes its trace here (SURVEY §5's TPU-equivalent ask: profiler
+    #: hooks next to the OTel control-plane spans)
+    xla_profile_dir: str = ""
 
     def validate_and_default(self) -> str:
         if not 0.0 <= self.sample_ratio <= 1.0:

@@ -208,6 +208,32 @@ def activation_jobs(store: Store, now: float) -> List[Job]:
     ]
 
 
+def repotracker_jobs(store: Store, now: float) -> List[Job]:
+    """Poll registered revision sources for new commits (reference
+    units/repotracker.go:48, populated per project every few minutes)."""
+    flags = ServiceFlags.get(store)
+    if flags.repotracker_disabled:
+        return []
+    from ..ingestion.repotracker import _SOURCES
+
+    if not _SOURCES:
+        return []
+    return [
+        FnJob(
+            f"repotracker-{now:.3f}",
+            _fetch_all_projects,
+            scopes=["repotracker"],
+            job_type="repotracker",
+        )
+    ]
+
+
+def _fetch_all_projects(s: Store) -> None:
+    from ..ingestion.repotracker import fetch_all_projects
+
+    fetch_all_projects(s)
+
+
 def event_notifier_jobs(store: Store, now: float) -> List[Job]:
     flags = ServiceFlags.get(store)
     if flags.event_processing_disabled:
@@ -253,7 +279,21 @@ def stats_jobs(store: Store, now: float) -> List[Job]:
             scopes=["system-stats"],
             job_type="system-stats",
         ),
+        FnJob(
+            f"span-export-{now:.3f}",
+            _export_spans,
+            scopes=["span-export"],
+            job_type="span-export",
+        ),
     ]
+
+
+def _export_spans(s: Store) -> None:
+    """OTLP push of finished spans when the tracer section is enabled
+    (reference environment.go:1070 tracer init + OTLP collector)."""
+    from ..utils.tracing import export_spans
+
+    export_spans(s)
 
 
 def hourly_jobs(store: Store, now: float) -> List[Job]:
@@ -307,6 +347,7 @@ def build_cron_runner(store: Store, queue: JobQueue) -> CronRunner:
         IntervalOperation("task-monitoring", 5 * 60.0, task_monitoring_jobs)
     )
     runner.register(IntervalOperation("activation", 60.0, activation_jobs))
+    runner.register(IntervalOperation("repotracker", 60.0, repotracker_jobs))
     runner.register(IntervalOperation("event-notifier", 60.0, event_notifier_jobs))
     runner.register(IntervalOperation("stats", 60.0, stats_jobs))
     runner.register(IntervalOperation("hourly", 3600.0, hourly_jobs))

@@ -33,20 +33,28 @@ class Tracer:
         with _seq_lock:
             span_id = f"span-{next(_seq)}"
         parent = getattr(_local, "current", None)
+        # every span records its ROOT so an exporter can assign one trace
+        # id to the whole nesting chain, however deep
+        root = getattr(_local, "root", None) if parent else span_id
         start = _time.perf_counter()
         record: Dict[str, Any] = {
             "_id": span_id,
             "component": self.component,
             "name": name,
             "parent": parent,
+            "trace_root": root or span_id,
             "started_at": _time.time(),
             "attributes": dict(attributes),
         }
         _local.current = span_id
+        if parent is None:
+            _local.root = span_id
         try:
             yield record
         finally:
             _local.current = parent
+            if parent is None:
+                _local.root = None
             record["duration_ms"] = (_time.perf_counter() - start) * 1e3
             if self.store is not None:
                 self.store.collection(SPANS_COLLECTION).upsert(record)
@@ -58,3 +66,145 @@ def get_spans(store: Store, component: str = "") -> List[dict]:
     )
     spans.sort(key=lambda d: d["started_at"])
     return spans
+
+
+# --------------------------------------------------------------------------- #
+# OTLP export (reference config_tracer.go + environment.go:1070 tracer init)
+# --------------------------------------------------------------------------- #
+
+
+def _stable_id(s: str, hex_chars: int) -> str:
+    """Process- and restart-stable id digits (sha256, NOT Python's salted
+    hash(): parent/child links must survive service restarts)."""
+    import hashlib
+
+    return hashlib.sha256(s.encode()).hexdigest()[:hex_chars]
+
+
+def _otlp_payload(spans: List[dict]) -> dict:
+    """Shape store spans as an OTLP/HTTP JSON ExportTraceServiceRequest
+    (one resource, one scope per component)."""
+    by_component: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_component.setdefault(s.get("component", ""), []).append(s)
+    scope_spans = []
+    for component, group in by_component.items():
+        otlp_spans = []
+        for s in group:
+            start_ns = int(s.get("started_at", 0.0) * 1e9)
+            end_ns = start_ns + int(s.get("duration_ms", 0.0) * 1e6)
+            otlp_spans.append(
+                {
+                    # the recorded root spans the whole nesting chain, so
+                    # grandchildren share the root's trace id
+                    "traceId": _stable_id(
+                        s.get("trace_root") or s["_id"], 32
+                    ),
+                    "spanId": _stable_id(s["_id"], 16),
+                    "parentSpanId": (
+                        _stable_id(s["parent"], 16) if s.get("parent") else ""
+                    ),
+                    "name": s.get("name", ""),
+                    "startTimeUnixNano": str(start_ns),
+                    "endTimeUnixNano": str(end_ns),
+                    "attributes": [
+                        {"key": k, "value": {"stringValue": str(v)}}
+                        for k, v in (s.get("attributes") or {}).items()
+                    ],
+                }
+            )
+        scope_spans.append(
+            {"scope": {"name": f"evergreen_tpu.{component}"},
+             "spans": otlp_spans}
+        )
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name",
+                         "value": {"stringValue": "evergreen-tpu"}}
+                    ]
+                },
+                "scopeSpans": scope_spans,
+            }
+        ]
+    }
+
+
+def export_spans(store: Store, endpoint: str = "", batch: int = 512) -> int:
+    """Push un-exported spans to an OTLP/HTTP collector (`/v1/traces`),
+    marking them exported. No-op unless the tracer config section is
+    enabled (reference: tracing is configured from the tracer section,
+    config_tracer.go:11-23, and initialized env-wide, environment.go:1070).
+    Sampling drops (1 - sample_ratio) of spans at export time,
+    deterministically by span id."""
+    import json as _json
+    import urllib.request
+
+    from ..settings import TracerConfig
+
+    cfg = TracerConfig.get(store)
+    endpoint = endpoint or cfg.collector_endpoint
+    if not cfg.enabled or not endpoint:
+        return 0
+    coll = store.collection(SPANS_COLLECTION)
+    pending = coll.find(lambda d: not d.get("exported"))[:batch]
+    if cfg.sample_ratio < 1.0:
+        keep = []
+        for s in pending:
+            # stable across restarts (sha256, not salted hash) and keyed
+            # on the ROOT so a trace is kept or dropped whole
+            bucket = int(_stable_id(s.get("trace_root") or s["_id"], 8), 16)
+            if (bucket % 10_000) / 10_000.0 < cfg.sample_ratio:
+                keep.append(s)
+            else:
+                coll.update(s["_id"], {"exported": True, "sampled_out": True})
+        pending = keep
+    if not pending:
+        return 0
+    body = _json.dumps(_otlp_payload(pending)).encode()
+    req = urllib.request.Request(
+        endpoint.rstrip("/") + "/v1/traces",
+        data=body,
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10.0):
+        pass
+    for s in pending:
+        coll.update(s["_id"], {"exported": True})
+    return len(pending)
+
+
+# --------------------------------------------------------------------------- #
+# XLA / JAX profiler hooks (SURVEY §5: per-solve profiler next to OTel)
+# --------------------------------------------------------------------------- #
+
+
+#: dirs already captured by this process — the hook is one-shot per
+#: configured directory so a forgotten config entry cannot tax every tick
+#: and fill the disk with traces
+_profiled_dirs: set = set()
+
+
+@contextlib.contextmanager
+def maybe_xla_profile(store: Optional[Store]) -> Iterator[bool]:
+    """Run the body under ``jax.profiler.trace`` when the tracer config
+    names an xla_profile_dir; yields whether profiling is active. The
+    trace (TensorBoard-loadable) covers exactly ONE batched solve per
+    configured directory per process: after the capture the hook latches
+    off until the operator points it somewhere new."""
+    profile_dir = ""
+    if store is not None:
+        from ..settings import TracerConfig
+
+        profile_dir = TracerConfig.get(store).xla_profile_dir
+    if not profile_dir or profile_dir in _profiled_dirs:
+        yield False
+        return
+    _profiled_dirs.add(profile_dir)
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield True

@@ -39,6 +39,8 @@ def store():
     triggers._SENDERS.clear()
     github_status._store_ref = None
     from evergreen_tpu.cloud import provisioning as prov_mod
+    from evergreen_tpu.ingestion import repotracker as repotracker_mod
 
     prov_mod.set_transport(prov_mod.LocalTransport())
+    repotracker_mod._SOURCES.clear()
     return reset_global_store()

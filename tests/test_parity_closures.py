@@ -1,0 +1,361 @@
+"""Round-2 parity closures: archive.auto_pack, the repotracker poller
+behind the RevisionSource seam (local git + GitHub-API-shaped fake), and
+the OTel/XLA observability hooks.
+"""
+import json
+import os
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from evergreen_tpu.ingestion import repotracker as rt
+from evergreen_tpu.ingestion.repotracker import (
+    GithubApiRevisionSource,
+    LocalGitRevisionSource,
+    ProjectRef,
+    fetch_revisions,
+    register_revision_source,
+    upsert_project_ref,
+)
+from evergreen_tpu.models import version as version_mod
+from evergreen_tpu.settings import TracerConfig
+from evergreen_tpu.utils.tracing import Tracer, export_spans, maybe_xla_profile
+
+NOW = 1_700_000_000.0
+
+MINIMAL_YML = """
+tasks:
+  - name: compile
+    commands:
+      - command: shell.exec
+        params: {script: "true"}
+buildvariants:
+  - name: bv1
+    run_on: [d1]
+    tasks: [compile]
+"""
+
+
+# --------------------------------------------------------------------------- #
+# archive.auto_pack
+# --------------------------------------------------------------------------- #
+
+
+def test_archive_auto_pack_picks_format(tmp_path):
+    from evergreen_tpu.agent.command.base import get_command
+    import tarfile
+    import zipfile
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.txt").write_text("hello")
+
+    class Ctx:
+        work_dir = str(tmp_path)
+        from evergreen_tpu.agent.command.base import Expansions
+
+        expansions = Expansions({})
+
+        def log(self, msg):
+            pass
+
+    for target, opener in (("out.zip", zipfile.is_zipfile),
+                           ("out.tgz", tarfile.is_tarfile)):
+        cmd = get_command("archive.auto_pack",
+                          {"target": target, "source_dir": "src",
+                           "include": ["**"]})
+        res = cmd.execute(Ctx())
+        assert not res.failed
+        assert opener(str(tmp_path / target))
+
+
+# --------------------------------------------------------------------------- #
+# repotracker poller — local git source
+# --------------------------------------------------------------------------- #
+
+
+def _git(repo, *args):
+    subprocess.run(["git", "-C", repo, *args], check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@x",
+                        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@x"})
+
+
+def _make_repo(tmp_path, n_commits=3):
+    repo = str(tmp_path / "proj")
+    os.makedirs(repo)
+    _git(repo, "init", "-b", "main")
+    for i in range(n_commits):
+        with open(os.path.join(repo, "evergreen.yml"), "w") as f:
+            f.write(MINIMAL_YML + f"# rev {i}\n")
+        _git(repo, "add", "-A")
+        _git(repo, "commit", "-m", f"commit {i}")
+    return repo
+
+
+def test_local_git_poller_creates_versions(store, tmp_path):
+    repo = _make_repo(tmp_path, 3)
+    upsert_project_ref(store, ProjectRef(id="proj", branch="main"))
+    src = LocalGitRevisionSource(repo, "main", "evergreen.yml")
+    created = fetch_revisions(store, "proj", source=src, now=NOW)
+    # first activation: recent-N, oldest first
+    assert len(created) == 3
+    versions = version_mod.find_by_project_order(store, "proj", 0, 1 << 60)
+    assert [v.message for v in versions] == [
+        "commit 0", "commit 1", "commit 2"]
+    # nothing new → nothing created
+    assert fetch_revisions(store, "proj", source=src, now=NOW + 1) == []
+    # a new commit is picked up incrementally
+    with open(os.path.join(repo, "evergreen.yml"), "a") as f:
+        f.write("# rev 3\n")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-m", "commit 3")
+    created = fetch_revisions(store, "proj", source=src, now=NOW + 2)
+    assert len(created) == 1
+    head = store.collection(rt.REPO_REVISIONS_COLLECTION).get("proj")
+    versions = version_mod.find_by_project_order(store, "proj", 0, 1 << 60)
+    assert head["last_revision"] == versions[-1].revision
+
+
+def test_poller_base_update_recovery(store, tmp_path):
+    """A head outside the searchable window fast-forwards instead of
+    wedging the poller (the reference's update-base-revision path)."""
+    repo = _make_repo(tmp_path, 2)
+    upsert_project_ref(store, ProjectRef(id="proj", branch="main"))
+    store.collection(rt.REPO_REVISIONS_COLLECTION).upsert(
+        {"_id": "proj", "last_revision": "f" * 40}  # unknown sha
+    )
+    src = LocalGitRevisionSource(repo, "main", "evergreen.yml")
+    assert fetch_revisions(store, "proj", source=src, now=NOW) == []
+    head = store.collection(rt.REPO_REVISIONS_COLLECTION).get("proj")
+    assert head["last_revision"] != "f" * 40
+    # next pass resumes cleanly
+    assert fetch_revisions(store, "proj", source=src, now=NOW + 1) == []
+
+
+# --------------------------------------------------------------------------- #
+# repotracker poller — GitHub-API-shaped source against a fake server
+# --------------------------------------------------------------------------- #
+
+
+class _GithubFake(BaseHTTPRequestHandler):
+    def do_GET(self):
+        import base64
+        from urllib.parse import parse_qs, urlparse
+
+        u = urlparse(self.path)
+        if u.path.endswith("/commits"):
+            payload = self.server.commits
+        else:  # contents API
+            sha = parse_qs(u.query).get("ref", [""])[0]
+            payload = {
+                "content": base64.b64encode(
+                    (MINIMAL_YML + f"# at {sha}\n").encode()
+                ).decode()
+            }
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def github_fake():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _GithubFake)
+    srv.commits = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_github_api_poller(store, github_fake):
+    github_fake.commits = [  # newest first, like the real API
+        {"sha": "c3", "commit": {"message": "three",
+                                 "author": {"name": "ann",
+                                            "date": "2026-01-03T00:00:00Z"}}},
+        {"sha": "c2", "commit": {"message": "two",
+                                 "author": {"name": "ann",
+                                            "date": "2026-01-02T00:00:00Z"}}},
+        {"sha": "c1", "commit": {"message": "one",
+                                 "author": {"name": "ann",
+                                            "date": "2026-01-01T00:00:00Z"}}},
+    ]
+    base = f"http://127.0.0.1:{github_fake.server_address[1]}"
+    upsert_project_ref(store, ProjectRef(id="proj", owner="o", repo="r"))
+    src = GithubApiRevisionSource("o", "r", "main", "evergreen.yml",
+                                  api_url=base)
+    created = fetch_revisions(store, "proj", source=src, now=NOW)
+    assert [c.version.message for c in created] == ["one", "two", "three"]
+    assert created[0].version.revision == "c1"
+    # incremental: only commits after the recorded head
+    github_fake.commits.insert(
+        0, {"sha": "c4", "commit": {"message": "four",
+                                    "author": {"name": "bo",
+                                               "date": "2026-01-04T00:00:00Z"}}})
+    created = fetch_revisions(store, "proj", source=src, now=NOW + 1)
+    assert [c.version.message for c in created] == ["four"]
+
+
+def test_repotracker_cron_polls_registered_sources(store, tmp_path):
+    from evergreen_tpu.units.crons import repotracker_jobs
+
+    assert repotracker_jobs(store, NOW) == []  # nothing registered
+    repo = _make_repo(tmp_path, 1)
+    upsert_project_ref(store, ProjectRef(id="proj", branch="main"))
+    register_revision_source(
+        "proj", LocalGitRevisionSource(repo, "main", "evergreen.yml")
+    )
+    jobs = repotracker_jobs(store, NOW)
+    assert [j.job_type for j in jobs] == ["repotracker"]
+    for j in jobs:
+        j.fn(store)
+    assert version_mod.find_by_project_order(store, "proj", 0, 1 << 60)
+
+
+# --------------------------------------------------------------------------- #
+# OTel export + XLA profiler hook
+# --------------------------------------------------------------------------- #
+
+
+class _OtlpFake(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        self.server.bodies.append(
+            (self.path, json.loads(self.rfile.read(length)))
+        )
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):
+        pass
+
+
+def test_otlp_span_export(store):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _OtlpFake)
+    srv.bodies = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        with Tracer(store, "scheduler").span("tick", n_tasks=5):
+            pass
+        # disabled → no-op
+        assert export_spans(store) == 0
+        cfg = TracerConfig.get(store)
+        cfg.enabled = True
+        cfg.collector_endpoint = f"http://127.0.0.1:{srv.server_address[1]}"
+        cfg.set(store)
+        assert export_spans(store) == 1
+        (path, body), = srv.bodies
+        assert path == "/v1/traces"
+        scope = body["resourceSpans"][0]["scopeSpans"][0]
+        assert scope["scope"]["name"] == "evergreen_tpu.scheduler"
+        span = scope["spans"][0]
+        assert span["name"] == "tick"
+        assert {"key": "n_tasks", "value": {"stringValue": "5"}} in (
+            span["attributes"])
+        # already-exported spans are not re-sent
+        assert export_spans(store) == 0
+    finally:
+        srv.shutdown()
+
+
+def test_synthetic_revisions_do_not_corrupt_polling_head(store, tmp_path):
+    """Downstream triggers / periodic builds call store_revisions with
+    synthetic revision strings; the polling head must only track real
+    polled commits."""
+    from evergreen_tpu.globals import Requester
+    from evergreen_tpu.ingestion.repotracker import Revision, store_revisions
+
+    repo = _make_repo(tmp_path, 2)
+    upsert_project_ref(store, ProjectRef(id="proj", branch="main"))
+    src = LocalGitRevisionSource(repo, "main", "evergreen.yml")
+    fetch_revisions(store, "proj", source=src, now=NOW)
+    head = store.collection(rt.REPO_REVISIONS_COLLECTION).get("proj")
+    real_head = head["last_revision"]
+    # a trigger-requester version lands; head must be untouched
+    store_revisions(
+        store, "proj",
+        [Revision(revision="trigger-abc123", config_yaml=MINIMAL_YML)],
+        now=NOW + 1, requester=Requester.TRIGGER.value,
+    )
+    head = store.collection(rt.REPO_REVISIONS_COLLECTION).get("proj")
+    assert head["last_revision"] == real_head
+    # and polling continues without tripping base-update recovery
+    assert fetch_revisions(store, "proj", source=src, now=NOW + 2) == []
+    events = store.collection("events").find(
+        lambda d: d["event_type"] == "REPOTRACKER_BASE_UPDATED"
+    )
+    assert events == []
+
+
+def test_otlp_trace_ids_are_stable_and_shared_across_nesting(store):
+    t = Tracer(store, "scheduler")
+    with t.span("root"):
+        with t.span("child"):
+            with t.span("grandchild"):
+                pass
+    spans = {s["name"]: s for s in store.collection("spans").find()}
+    assert (spans["grandchild"]["trace_root"]
+            == spans["child"]["trace_root"]
+            == spans["root"]["_id"])
+    from evergreen_tpu.utils.tracing import _otlp_payload
+
+    payload = _otlp_payload(list(spans.values()))
+    otlp = {s["name"]: s for s in
+            payload["resourceSpans"][0]["scopeSpans"][0]["spans"]}
+    # whole chain shares ONE trace id; parent links are consistent
+    assert (otlp["root"]["traceId"] == otlp["child"]["traceId"]
+            == otlp["grandchild"]["traceId"])
+    assert otlp["grandchild"]["parentSpanId"] == otlp["child"]["spanId"]
+    assert otlp["child"]["parentSpanId"] == otlp["root"]["spanId"]
+    # ids are sha256-derived (stable across processes), not hash()-salted
+    import hashlib
+
+    assert otlp["root"]["spanId"] == hashlib.sha256(
+        spans["root"]["_id"].encode()
+    ).hexdigest()[:16]
+
+
+def test_xla_profile_hook_is_one_shot(store, tmp_path):
+    from evergreen_tpu.utils import tracing as tr
+
+    tr._profiled_dirs.clear()
+    cfg = TracerConfig.get(store)
+    cfg.xla_profile_dir = str(tmp_path / "once")
+    cfg.set(store)
+    with maybe_xla_profile(store) as active:
+        assert active
+    # second entry latches off — a forgotten config entry cannot tax
+    # every tick
+    with maybe_xla_profile(store) as active:
+        assert not active
+    # pointing at a new directory re-arms
+    cfg.xla_profile_dir = str(tmp_path / "twice")
+    cfg.set(store)
+    with maybe_xla_profile(store) as active:
+        assert active
+    tr._profiled_dirs.clear()
+
+
+def test_xla_profile_hook(store, tmp_path):
+    # off by default
+    with maybe_xla_profile(store) as active:
+        assert not active
+    cfg = TracerConfig.get(store)
+    cfg.xla_profile_dir = str(tmp_path / "xla")
+    cfg.set(store)
+    import jax.numpy as jnp
+
+    with maybe_xla_profile(store) as active:
+        assert active
+        jnp.ones((8, 8)).sum().block_until_ready()
+    # the profiler wrote a tensorboard-loadable trace directory
+    assert any((tmp_path / "xla").rglob("*"))
