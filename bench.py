@@ -107,6 +107,9 @@ def main() -> None:
         run_solve_packed(s1)
         extra[name] = (time.perf_counter() - t1) * 1e3
 
+    # --- dispatch-path scale check (next_task under concurrency) ----------- #
+    dispatch = measure_dispatch()
+
     result = {
         "metric": "sched_tick_50k_tasks_200_distros",
         "value": round(tpu_ms, 2),
@@ -122,6 +125,23 @@ def main() -> None:
         f"churn_tick={churn_ms:.1f}ms {configs} target=<500ms",
         file=sys.stderr,
     )
+    print(
+        f"# dispatch: {dispatch['n_agents']} agents x "
+        f"{dispatch['queue_len']} queue drain "
+        f"p50={dispatch['p50_ms']}ms p99={dispatch['p99_ms']}ms "
+        f"max={dispatch['max_ms']}ms {dispatch['pulls_per_s']:.0f} pulls/s "
+        f"budget=1000ms",
+        file=sys.stderr,
+    )
+
+
+def measure_dispatch() -> dict:
+    """Concurrent next_task FULL drain at reduced scale (the 200×50k run
+    lives in tools/bench_dispatch.py); budget is the reference's 1s
+    slow-path threshold (rest/route/host_agent.go:103-110)."""
+    from tools.bench_dispatch import run_bench
+
+    return run_bench(n_agents=100, queue_len=20_000, pulls_per_agent=200)
 
 
 def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro) -> float:

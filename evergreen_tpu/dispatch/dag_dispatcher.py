@@ -77,6 +77,8 @@ class DAGDispatcher:
         self._items: Dict[str, TaskQueueItem] = {}
         self._groups: Dict[str, _GroupUnit] = {}
         self._dispatched: set = set()
+        self._pos: Dict[str, int] = {}
+        self._next_live: List[int] = [0]
 
     # -- rebuild ------------------------------------------------------------ #
 
@@ -108,6 +110,8 @@ class DAGDispatcher:
             self._items = {it.id: it for it in items}
             self._dispatched = set()
             self._groups = {}
+            self._pos = {}
+            self._next_live = []
             for it in items:
                 if it.task_group:
                     gid = composite_group_id(
@@ -129,7 +133,31 @@ class DAGDispatcher:
                 unit.tasks.sort(key=lambda it: it.task_group_order)
 
             self._sorted = self._topo_sort(items)
+            # Skip-pointer over the scan order: consumed items (dispatched,
+            # already-started, dead groups) are unlinked with union-find
+            # path compression, so draining a 50k queue costs O(n α(n))
+            # total instead of O(n²) — the reference's linear FindNextTask
+            # rescan is its slow-path-budget risk at this depth.
+            self._pos = {it.id: i for i, it in enumerate(self._sorted)}
+            self._next_live = list(range(len(self._sorted) + 1))
             self._last_updated = now
+
+    def _first_live(self, i: int) -> int:
+        """Smallest live index ≥ i, with path compression."""
+        nxt = self._next_live
+        root = i
+        while nxt[root] != root:
+            root = nxt[root]
+        while nxt[i] != root:
+            nxt[i], i = root, nxt[i]
+        return root
+
+    def _consume(self, item_id: str) -> None:
+        """Permanently remove an item from the scan order (valid only for
+        within-epoch-permanent states: dispatched or already started)."""
+        i = self._pos.get(item_id)
+        if i is not None and self._next_live[i] == i:
+            self._next_live[i] = i + 1
 
     def _topo_sort(self, items: List[TaskQueueItem]) -> List[TaskQueueItem]:
         """Stabilized Kahn: dependency order first, planner queue rank as the
@@ -176,13 +204,19 @@ class DAGDispatcher:
                     if nxt is not None:
                         return nxt
 
-            for it in self._sorted:
+            n = len(self._sorted)
+            i = self._first_live(0)
+            while i < n:
+                it = self._sorted[i]
+                i = self._first_live(i + 1)
                 if it.task_group_max_hosts == 0:
-                    if not it.dependencies_met:
-                        continue
                     if it.id in self._dispatched:
+                        self._consume(it.id)
                         continue
+                    if not it.dependencies_met:
+                        continue  # transient: stays in the scan order
                     self._dispatched.add(it.id)
+                    self._consume(it.id)
                     t = task_mod.get(self.store, it.id)
                     if t is None:
                         return None
@@ -196,7 +230,14 @@ class DAGDispatcher:
                         it.task_group, it.build_variant, it.project, it.version
                     )
                     unit = self._groups.get(gid)
-                    if unit is None or not self._group_has_dispatchable(unit):
+                    if unit is None:
+                        # group removed (single-host blocking): dead slot
+                        self._consume(it.id)
+                        continue
+                    if not self._group_has_dispatchable(unit):
+                        if all(g.id in self._dispatched for g in unit.tasks):
+                            # fully handed out — permanently done this epoch
+                            self._consume(it.id)
                         continue
                     running = host_mod.coll(self.store).count(
                         lambda doc: doc["running_task_group"] == unit.group
@@ -229,13 +270,17 @@ class DAGDispatcher:
                 return None
             if self._blocked_single_host_group(unit, t):
                 self._groups.pop(unit.id, None)
+                for g in unit.tasks:
+                    self._consume(g.id)
                 return None
             if t.start_time > 0.0:
                 self._dispatched.add(it.id)
+                self._consume(it.id)
                 continue
             if not self._deps_met_fresh(t):
                 continue
             self._dispatched.add(it.id)
+            self._consume(it.id)
             return it
         return None
 

@@ -176,6 +176,12 @@ class RevisionSource(abc.ABC):
         ``max_revs`` (the reference's revision-not-found error that
         forces a base-revision update)."""
 
+    def get_head_revision(self) -> str:
+        """Sha of the newest revision only — used by base-update recovery,
+        which has no use for the config payload."""
+        recent = self.get_recent_revisions(1)
+        return recent[0].revision if recent else ""
+
 
 class GithubApiRevisionSource(RevisionSource):
     """GitHub-API-shaped poller (reference repotracker/github_poller.go
@@ -244,18 +250,35 @@ class GithubApiRevisionSource(RevisionSource):
             config_yaml=self._config_at(c.get("sha", "")),
         )
 
+    #: GitHub caps the commits listing at 100 per page; deeper windows
+    #: must paginate or they silently shrink
+    _PAGE_CAP = 100
+
+    def _list_commits(self, n: int) -> List[dict]:
+        out: List[dict] = []
+        page = 1
+        while len(out) < n:
+            batch = self._get(
+                f"/repos/{self.owner}/{self.repo}/commits",
+                {
+                    "sha": self.branch,
+                    "per_page": str(min(n - len(out), self._PAGE_CAP)),
+                    "page": str(page),
+                },
+            )
+            if not batch:
+                break
+            out.extend(batch)
+            if len(batch) < self._PAGE_CAP:
+                break
+            page += 1
+        return out[:n]
+
     def get_recent_revisions(self, n: int) -> List[Revision]:
-        commits = self._get(
-            f"/repos/{self.owner}/{self.repo}/commits",
-            {"sha": self.branch, "per_page": str(n)},
-        )
-        return [self._to_revision(c) for c in commits[:n]]
+        return [self._to_revision(c) for c in self._list_commits(n)]
 
     def get_revisions_after(self, revision: str, max_revs: int) -> List[Revision]:
-        commits = self._get(
-            f"/repos/{self.owner}/{self.repo}/commits",
-            {"sha": self.branch, "per_page": str(max_revs)},
-        )
+        commits = self._list_commits(max_revs)
         out = []
         for c in commits:
             if c.get("sha") == revision:
@@ -264,6 +287,10 @@ class GithubApiRevisionSource(RevisionSource):
         raise KeyError(
             f"revision {revision!r} not found in the last {max_revs} commits"
         )
+
+    def get_head_revision(self) -> str:
+        commits = self._list_commits(1)
+        return commits[0].get("sha", "") if commits else ""
 
 
 class LocalGitRevisionSource(RevisionSource):
@@ -319,6 +346,9 @@ class LocalGitRevisionSource(RevisionSource):
             )
         return out
 
+    def get_head_revision(self) -> str:
+        return self._git("rev-parse", self.branch).strip()
+
 
 #: project id → source; populated at service wiring (the reference builds
 #: its poller per project ref from GitHub settings)
@@ -361,11 +391,11 @@ def fetch_revisions(
             newest_first = src.get_recent_revisions(cfg.revs_to_fetch)
     except KeyError as e:
         # base revision vanished (force-push / shallow window): record the
-        # newest head and resume from there next pass
-        recent = src.get_recent_revisions(1)
-        if recent:
+        # newest head (sha only — no config fetch) and resume next pass
+        head = src.get_head_revision()
+        if head:
             store.collection(REPO_REVISIONS_COLLECTION).upsert(
-                {"_id": project_id, "last_revision": recent[0].revision}
+                {"_id": project_id, "last_revision": head}
             )
         event_mod.log(
             store,
@@ -383,8 +413,21 @@ def fetch_revisions(
 
 def fetch_all_projects(store: Store, now: Optional[float] = None) -> int:
     """Poll every project with a registered source (the repotracker cron
-    body, units/repotracker.go:48)."""
+    body, units/repotracker.go:48). One project's broken source (hung
+    mount, network blip) costs that project its pass, never the others —
+    the reference runs one amboy job per project for the same isolation."""
+    now = _time.time() if now is None else now
     n = 0
     for project_id in list(_SOURCES):
-        n += len(fetch_revisions(store, project_id, now=now))
+        try:
+            n += len(fetch_revisions(store, project_id, now=now))
+        except Exception as e:  # noqa: BLE001 — per-project isolation
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_VERSION,
+                "REPOTRACKER_POLL_FAILED",
+                project_id,
+                {"error": str(e)},
+                timestamp=now,
+            )
     return n

@@ -134,11 +134,13 @@ def _otlp_payload(spans: List[dict]) -> dict:
 
 def export_spans(store: Store, endpoint: str = "", batch: int = 512) -> int:
     """Push un-exported spans to an OTLP/HTTP collector (`/v1/traces`),
-    marking them exported. No-op unless the tracer config section is
-    enabled (reference: tracing is configured from the tracer section,
+    then DELETE them locally — once exported, the collector is the span
+    store, and keeping them would grow the collection and its per-minute
+    scan without bound. No-op unless the tracer config section is enabled
+    (reference: tracing is configured from the tracer section,
     config_tracer.go:11-23, and initialized env-wide, environment.go:1070).
-    Sampling drops (1 - sample_ratio) of spans at export time,
-    deterministically by span id."""
+    Sampling drops (1 - sample_ratio) of whole traces at export time,
+    deterministically by trace root."""
     import json as _json
     import urllib.request
 
@@ -149,7 +151,7 @@ def export_spans(store: Store, endpoint: str = "", batch: int = 512) -> int:
     if not cfg.enabled or not endpoint:
         return 0
     coll = store.collection(SPANS_COLLECTION)
-    pending = coll.find(lambda d: not d.get("exported"))[:batch]
+    pending = coll.find()[:batch]
     if cfg.sample_ratio < 1.0:
         keep = []
         for s in pending:
@@ -159,7 +161,7 @@ def export_spans(store: Store, endpoint: str = "", batch: int = 512) -> int:
             if (bucket % 10_000) / 10_000.0 < cfg.sample_ratio:
                 keep.append(s)
             else:
-                coll.update(s["_id"], {"exported": True, "sampled_out": True})
+                coll.remove(s["_id"])
         pending = keep
     if not pending:
         return 0
@@ -172,8 +174,11 @@ def export_spans(store: Store, endpoint: str = "", batch: int = 512) -> int:
     )
     with urllib.request.urlopen(req, timeout=10.0):
         pass
+    # the collector owns exported spans now: drop them so the spans
+    # collection (and the per-minute not-yet-exported scan) stays bounded
+    # on a long-lived service
     for s in pending:
-        coll.update(s["_id"], {"exported": True})
+        coll.remove(s["_id"])
     return len(pending)
 
 

@@ -204,6 +204,75 @@ def test_github_api_poller(store, github_fake):
     assert [c.version.message for c in created] == ["four"]
 
 
+def test_github_poller_paginates_past_the_100_cap(store, github_fake):
+    """GitHub caps per_page at 100; a deeper search window must paginate
+    instead of silently shrinking (which would cause spurious base
+    fast-forwards that skip commits)."""
+    # fake serves pages: override do_GET behavior via commit list slicing
+    all_commits = [
+        {"sha": f"c{i}", "commit": {"message": f"m{i}",
+                                    "author": {"name": "a", "date": ""}}}
+        for i in range(250, 0, -1)  # newest first: c250 … c1
+    ]
+
+    class Paged(_GithubFake):
+        def do_GET(self):
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            if u.path.endswith("/commits"):
+                q = parse_qs(u.query)
+                per = min(int(q.get("per_page", ["30"])[0]), 100)
+                page = int(q.get("page", ["1"])[0])
+                payload = all_commits[(page - 1) * per: page * per]
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                super().do_GET()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Paged)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        src = GithubApiRevisionSource("o", "r", "main", "evergreen.yml",
+                                      api_url=base)
+        # head 150 commits deep: only reachable by fetching page 2
+        revs = src.get_revisions_after("c100", max_revs=200)
+        assert len(revs) == 150
+        assert revs[0].revision == "c250" and revs[-1].revision == "c101"
+        assert src.get_head_revision() == "c250"
+    finally:
+        srv.shutdown()
+
+
+def test_fetch_all_projects_isolates_broken_sources(store, tmp_path):
+    """One project's failing source must not stop the others from being
+    polled."""
+
+    class Broken(LocalGitRevisionSource):
+        def get_recent_revisions(self, n):
+            raise RuntimeError("stale mount")
+
+    repo = _make_repo(tmp_path, 1)
+    upsert_project_ref(store, ProjectRef(id="bad", branch="main"))
+    upsert_project_ref(store, ProjectRef(id="good", branch="main"))
+    register_revision_source("bad", Broken(repo, "main", "evergreen.yml"))
+    register_revision_source(
+        "good", LocalGitRevisionSource(repo, "main", "evergreen.yml")
+    )
+    from evergreen_tpu.ingestion.repotracker import fetch_all_projects
+
+    assert fetch_all_projects(store, now=NOW) == 1
+    assert version_mod.find_by_project_order(store, "good", 0, 1 << 60)
+    fails = store.collection("events").find(
+        lambda d: d["event_type"] == "REPOTRACKER_POLL_FAILED"
+    )
+    assert len(fails) == 1 and fails[0]["resource_id"] == "bad"
+
+
 def test_repotracker_cron_polls_registered_sources(store, tmp_path):
     from evergreen_tpu.units.crons import repotracker_jobs
 
