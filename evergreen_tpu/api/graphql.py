@@ -27,6 +27,108 @@ class GraphQLError(Exception):
     pass
 
 
+#: sentinel distinguishing "no default" from "default null" in var defs
+_ABSENT = object()
+
+#: placeholder served for private project vars; saves that round-trip it
+#: must never overwrite the real value (reference redact_secrets_plugin.go)
+REDACTED = "{REDACTED}"
+
+
+def filter_sort_paginate(
+    rows: List[dict],
+    key_map: Dict[str, str],
+    filters: List,
+    sortBy: str,
+    sortDir: str,
+    limit: int,
+    page: int,
+    default_key: str,
+) -> Tuple[List[dict], int, int]:
+    """Shared table semantics for the paginated resolvers (taskTests,
+    versionTasks): returns (page_rows, total, filtered)."""
+    total = len(rows)
+    for pred in filters:
+        rows = [r for r in rows if pred(r)]
+    filtered = len(rows)
+    key = key_map.get((sortBy or "").upper(), default_key)
+    rows.sort(key=lambda r: r[key], reverse=sortDir.upper() == "DESC")
+    limit = max(0, int(limit))
+    if limit:
+        start = max(0, int(page)) * limit
+        rows = rows[start: start + limit]
+    return rows, total, filtered
+
+
+def _type_str(t: dict) -> str:
+    if "list" in t:
+        s = f"[{_type_str(t['list'])}]"
+    else:
+        s = t["name"]
+    return s + ("!" if t.get("non_null") else "")
+
+
+def _coerce_variable(name: str, t: dict, value: Any) -> Any:
+    """Scalar/list coercion per the spec's CoerceVariableValues subset:
+    null against non-null errors; Int/Float/String/Boolean/ID are checked;
+    Int is accepted for Float; unknown (object/enum) types pass through."""
+    if value is None:
+        if t.get("non_null"):
+            raise GraphQLError(
+                f"variable ${name} of type {_type_str(t)} must not be null"
+            )
+        return None
+    if "list" in t:
+        if not isinstance(value, list):
+            value = [value]  # spec: single value coerces to 1-item list
+        return [_coerce_variable(name, t["list"], v) for v in value]
+    tn = t.get("name", "")
+    if tn == "Int":
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise GraphQLError(f"variable ${name} expects Int")
+        return value
+    if tn == "Float":
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise GraphQLError(f"variable ${name} expects Float")
+        return float(value)
+    if tn == "Boolean":
+        if not isinstance(value, bool):
+            raise GraphQLError(f"variable ${name} expects Boolean")
+        return value
+    if tn in ("String", "ID"):
+        if not isinstance(value, str):
+            raise GraphQLError(f"variable ${name} expects {tn}")
+        return value
+    return value  # custom scalars / input objects / enums: pass through
+
+
+def coerce_variables(
+    var_defs: List[dict], provided: Dict[str, Any]
+) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    declared = {d["name"] for d in var_defs}
+    for d in var_defs:
+        name = d["name"]
+        if name in provided:
+            out[name] = _coerce_variable(name, d["type"], provided[name])
+        elif d["default"] is not _ABSENT:
+            out[name] = d["default"]
+        elif d["type"].get("non_null"):
+            raise GraphQLError(
+                f"variable ${name} of required type "
+                f"{_type_str(d['type'])} was not provided"
+            )
+        else:
+            out[name] = None
+    # spec: every used variable must be declared — enforced at use time
+    # (_resolve_vars checks membership); extra provided vars are ignored
+    # only when the operation declares no variables at all (legacy
+    # callers that never sent definitions keep working)
+    if not declared and provided:
+        return dict(provided)
+    return out
+
+
 # --------------------------------------------------------------------------- #
 # Minimal GraphQL document parser
 # --------------------------------------------------------------------------- #
@@ -80,9 +182,10 @@ class _Parser:
         if got != value:
             raise GraphQLError(f"expected {value!r}, got {got!r}")
 
-    def parse_document(self) -> Tuple[str, List[dict]]:
+    def parse_document(self) -> Tuple[str, List[dict], List[dict]]:
         op = "query"
         selection: Optional[List[dict]] = None
+        var_defs: List[dict] = []
         fragments: Dict[str, List[dict]] = {}
         while self.peek() is not None:
             kind, val = self.peek()
@@ -94,32 +197,61 @@ class _Parser:
                 fragments[frag_name] = self.parse_selection_set()
                 continue
             this_op = "query"
+            this_defs: List[dict] = []
             if kind == "name" and val in ("query", "mutation"):
                 this_op = val
                 self.next()
                 if self.peek() and self.peek()[0] == "name":
                     self.next()  # operation name
                 if self.peek() and self.peek()[1] == "(":
-                    self._skip_variable_defs()
+                    this_defs = self._parse_variable_defs()
             if selection is None:  # execute the first operation
                 op = this_op
+                var_defs = this_defs
                 selection = self.parse_selection_set()
             else:
                 self.parse_selection_set()  # skip extra operations
         if selection is None:
             raise GraphQLError("no operation in document")
-        return op, _flatten_fragments(selection, fragments, set())
+        return op, _flatten_fragments(selection, fragments, set()), var_defs
 
-    def _skip_variable_defs(self) -> None:
-        depth = 0
-        while True:
-            _, val = self.next()
-            if val == "(":
-                depth += 1
-            elif val == ")":
-                depth -= 1
-                if depth == 0:
-                    return
+    def _parse_variable_defs(self) -> List[dict]:
+        """``($id: String!, $n: Int = 5, $ids: [ID!]!)`` → typed defs the
+        executor coerces inputs against (the typing the round-1 executor
+        skipped; reference: gqlgen's generated operation validation)."""
+        defs: List[dict] = []
+        self.expect("(")
+        while self.peek() and self.peek()[1] != ")":
+            self.expect("$")
+            name = self.next()[1]
+            self.expect(":")
+            vtype = self._parse_type()
+            default = _ABSENT
+            if self.peek() and self.peek()[1] == "=":
+                self.next()
+                default = self.parse_value()
+            defs.append({"name": name, "type": vtype, "default": default})
+            if self.peek() and self.peek()[1] == ",":
+                self.next()
+        self.expect(")")
+        return defs
+
+    def _parse_type(self) -> dict:
+        """Type reference: Name, [Type], with ! suffixes."""
+        if self.peek() and self.peek()[1] == "[":
+            self.next()
+            inner = self._parse_type()
+            self.expect("]")
+            t: dict = {"list": inner, "non_null": False}
+        else:
+            kind, name = self.next()
+            if kind != "name":
+                raise GraphQLError(f"expected type name, got {name!r}")
+            t = {"name": name, "non_null": False}
+        if self.peek() and self.peek()[1] == "!":
+            self.next()
+            t["non_null"] = True
+        return t
 
     def parse_selection_set(self) -> List[dict]:
         self.expect("{")
@@ -216,6 +348,16 @@ class _Parser:
                     self.next()
             self.expect("]")
             return items
+        if val == "{":  # input object literal
+            obj: Dict[str, Any] = {}
+            while self.peek() and self.peek()[1] != "}":
+                key = self.next()[1]
+                self.expect(":")
+                obj[key] = self.parse_value()
+                if self.peek() and self.peek()[1] == ",":
+                    self.next()
+            self.expect("}")
+            return obj
         raise GraphQLError(f"unsupported value token {val!r}")
 
 
@@ -331,6 +473,9 @@ def _project(
         if not _directives_allow(field, variables):
             continue
         name = field["name"]
+        if name == "__typename":
+            out[field["alias"]] = value.get("__typename", "JSON")
+            continue
         sub = value.get(name)
         out[field["alias"]] = _project(
             sub, field["selection"], store, variables
@@ -363,6 +508,11 @@ class GraphQLApi:
             "user": self._q_user,
             "taskQueue": self._q_task_queue,
             "annotation": self._q_annotation,
+            "projectSettings": self._q_project_settings,
+            "spruceConfig": self._q_spruce_config,
+            "taskHistory": self._q_task_history,
+            "versionTasks": self._q_version_tasks,
+            "buildBaron": self._q_build_baron,
         }
         self.mutations: Dict[str, Callable] = {
             "scheduleTask": self._m_schedule,
@@ -370,6 +520,14 @@ class GraphQLApi:
             "abortTask": self._m_abort,
             "restartTask": self._m_restart,
             "setTaskPriority": self._m_priority,
+            "scheduleTasks": self._m_schedule_tasks,
+            "restartVersion": self._m_restart_version,
+            "schedulePatch": self._m_schedule_patch,
+            "addAnnotationIssue": self._m_add_annotation_issue,
+            "removeAnnotationIssue": self._m_remove_annotation_issue,
+            "moveAnnotationIssue": self._m_move_annotation_issue,
+            "editAnnotationNote": self._m_edit_annotation_note,
+            "saveProjectSettings": self._m_save_project_settings,
         }
 
     # -- entry --------------------------------------------------------------- #
@@ -377,18 +535,42 @@ class GraphQLApi:
     def execute(
         self, query: str, variables: Optional[Dict[str, Any]] = None
     ) -> Dict[str, Any]:
-        variables = variables or {}
         try:
-            op, selection = _Parser(_tokenize(query)).parse_document()
+            op, selection, var_defs = _Parser(
+                _tokenize(query)
+            ).parse_document()
+            variables = coerce_variables(var_defs, variables or {})
             registry = self.queries if op == "query" else self.mutations
             data: Dict[str, Any] = {}
             for field in selection:
                 if not _directives_allow(field, variables):
                     continue
-                fn = registry.get(field["name"])
+                name = field["name"]
+                if name == "__typename":
+                    data[field["alias"]] = (
+                        "Query" if op == "query" else "Mutation"
+                    )
+                    continue
+                if name == "__schema":
+                    data[field["alias"]] = _project(
+                        self._introspect_schema(), field["selection"],
+                        self.store, variables,
+                    )
+                    continue
+                if name == "__type":
+                    args = {
+                        k: _resolve_vars(v, variables)
+                        for k, v in field["args"].items()
+                    }
+                    data[field["alias"]] = _project(
+                        self._introspect_type(args.get("name", "")),
+                        field["selection"], self.store, variables,
+                    )
+                    continue
+                fn = registry.get(name)
                 if fn is None:
                     raise GraphQLError(
-                        f"unknown {op} field {field['name']!r}"
+                        f"unknown {op} field {name!r}"
                     )
                 args = {
                     k: _resolve_vars(v, variables)
@@ -402,6 +584,79 @@ class GraphQLApi:
             return {"errors": [{"message": str(e)}]}
         except TypeError as e:
             return {"errors": [{"message": f"bad arguments: {e}"}]}
+
+    # -- introspection stubs --------------------------------------------- #
+    # Enough of the introspection surface for clients to list operations
+    # and probe field existence (the reference serves gqlgen's full
+    # generated introspection; this is the schemaless-subset honest
+    # equivalent: every field reports type "JSON").
+
+    def _field_stub(self, name: str, fn: Callable) -> dict:
+        import inspect
+
+        args = []
+        for pname, p in inspect.signature(fn).parameters.items():
+            if pname == "self":
+                continue
+            args.append(
+                {
+                    "name": pname,
+                    "type": {"name": "JSON", "kind": "SCALAR",
+                             "ofType": None},
+                    "defaultValue": (
+                        None if p.default is inspect.Parameter.empty
+                        else repr(p.default)
+                    ),
+                }
+            )
+        return {
+            "name": name,
+            "args": args,
+            "type": {"name": "JSON", "kind": "SCALAR", "ofType": None},
+            "isDeprecated": False,
+            "deprecationReason": None,
+            "description": (fn.__doc__ or "").strip() or None,
+        }
+
+    def _introspect_schema(self) -> dict:
+        return {
+            "queryType": {"name": "Query"},
+            "mutationType": {"name": "Mutation"},
+            "subscriptionType": None,
+            "types": [
+                self._introspect_type("Query"),
+                self._introspect_type("Mutation"),
+                {"name": "JSON", "kind": "SCALAR", "fields": None,
+                 "description": "schemaless document scalar"},
+                *(
+                    {"name": n, "kind": "SCALAR", "fields": None,
+                     "description": None}
+                    for n in ("String", "ID", "Int", "Float", "Boolean")
+                ),
+            ],
+            "directives": [
+                {"name": "include", "locations": ["FIELD",
+                                                  "FRAGMENT_SPREAD",
+                                                  "INLINE_FRAGMENT"]},
+                {"name": "skip", "locations": ["FIELD", "FRAGMENT_SPREAD",
+                                               "INLINE_FRAGMENT"]},
+            ],
+        }
+
+    def _introspect_type(self, name: str) -> Optional[dict]:
+        if name == "Query":
+            fields = [self._field_stub(n, f)
+                      for n, f in sorted(self.queries.items())]
+        elif name == "Mutation":
+            fields = [self._field_stub(n, f)
+                      for n, f in sorted(self.mutations.items())]
+        elif name in ("JSON", "String", "ID", "Int", "Float", "Boolean"):
+            return {"name": name, "kind": "SCALAR", "fields": None,
+                    "description": None}
+        else:
+            return None
+        return {"name": name, "kind": "OBJECT", "fields": fields,
+                "description": None}
 
     # -- query resolvers ------------------------------------------------------ #
 
@@ -592,18 +847,65 @@ class GraphQLApi:
     def _q_projects(self):
         return self.store.collection("project_refs").find()
 
-    def _q_task_logs(self, taskId: str):
-        doc = self.store.collection("task_logs").get(taskId)
-        return {"taskId": taskId, "lines": doc["lines"] if doc else []}
+    def _q_task_logs(self, taskId: str, execution: int = 0):
+        """Sectioned logs (reference graphql task_logs resolver returning
+        taskLogs/agentLogs/systemLogs/eventLogs; Spruce's log viewer
+        tabs). Agent/system sections split by line prefix; event logs come
+        from the task's event documents."""
+        from ..models import event as event_mod
 
-    def _q_task_tests(self, taskId: str, execution: int = 0):
+        doc = self.store.collection("task_logs").get(taskId)
+        lines = doc["lines"] if doc else []
+        agent_lines = [l for l in lines if l.startswith("[agent]")]
+        system_lines = [l for l in lines if l.startswith("[system]")]
+        events = [
+            {"eventType": e.event_type, "timestamp": e.timestamp,
+             "data": e.data}
+            for e in event_mod.find_by_resource(self.store, taskId)
+        ]
+        return {
+            "taskId": taskId,
+            "execution": int(execution),
+            "lines": lines,  # legacy flat view
+            "taskLogs": [
+                l for l in lines
+                if not l.startswith(("[agent]", "[system]"))
+            ],
+            "agentLogs": agent_lines,
+            "systemLogs": system_lines,
+            "eventLogs": events,
+        }
+
+    def _q_task_tests(
+        self, taskId: str, execution: int = 0, testName: str = "",
+        statuses: Optional[List[str]] = None, sortBy: str = "",
+        sortDir: str = "ASC", limit: int = 0, page: int = 0,
+    ):
+        """Paginated/filtered test results (reference graphql
+        task_resolver.go Tests over the filterSortAndPaginateCedarTestResults
+        shape Spruce's test table drives)."""
         from ..models.artifact import get_test_results
 
-        return [
+        rows = [
             {"testName": r.test_name, "status": r.status,
              "durationS": r.duration_s, "logUrl": r.log_url}
-            for r in get_test_results(self.store, taskId, execution)
+            for r in get_test_results(self.store, taskId, int(execution))
         ]
+        filters = []
+        if testName:
+            needle = testName.lower()
+            filters.append(lambda r: needle in r["testName"].lower())
+        if statuses:
+            allowed = set(statuses)
+            filters.append(lambda r: r["status"] in allowed)
+        rows, total, filtered = filter_sort_paginate(
+            rows,
+            {"TEST_NAME": "testName", "STATUS": "status",
+             "DURATION": "durationS"},
+            filters, sortBy, sortDir, limit, page, "testName",
+        )
+        return {"testResults": rows, "totalTestCount": total,
+                "filteredTestCount": filtered}
 
     def _q_build_variants(self, versionId: str):
         """Per-variant task rollups for a version (the Spruce waterfall
@@ -632,6 +934,165 @@ class GraphQLApi:
         docs.sort(key=lambda d: d.get("create_time", 0.0), reverse=True)
         return docs[: int(limit)]
 
+    def _q_project_settings(self, projectId: str):
+        """Spruce project-settings page bundle (reference graphql
+        project_settings_resolver.go: projectRef + vars + aliases +
+        subscriptions for one project)."""
+        ref = self.store.collection("project_refs").get(projectId)
+        if ref is None:
+            return None
+        pvars = self.store.collection("project_vars").get(projectId) or {}
+        redacted = {}
+        private = set(pvars.get("private_vars", []))
+        for k, v in (pvars.get("vars") or {}).items():
+            redacted[k] = REDACTED if k in private else v
+        aliases = [
+            dict(a)
+            for a in self.store.collection("patch_aliases").find(
+                lambda d: d.get("project") == projectId
+            )
+        ]
+        # copy before stripping secrets: find() hands back the LIVE store
+        # documents — popping on them would destroy the webhook HMAC
+        # secrets the delivery transport signs with
+        subs = [
+            {k: v for k, v in s.items() if k != "subscriber_secret"}
+            for s in self.store.collection("subscriptions").find(
+                lambda d: d.get("owner") == projectId
+                or (d.get("filters") or {}).get("project") == projectId
+            )
+        ]
+        return {
+            "projectRef": {**ref, "id": ref["_id"]},
+            "vars": {"vars": redacted,
+                     "privateVars": sorted(private)},
+            "aliases": aliases,
+            "subscriptions": subs,
+        }
+
+    def _q_spruce_config(self):
+        """Deployment config the Spruce shell loads once (reference
+        graphql config_resolver.go SpruceConfig: banner, providers,
+        spawn-host limits, jira host, UI urls)."""
+        from ..settings import (
+            ApiConfig,
+            JiraConfig,
+            SpawnHostConfig,
+            UiConfig,
+        )
+
+        ui = UiConfig.get(self.store)
+        jira = JiraConfig.get(self.store)
+        spawn = SpawnHostConfig.get(self.store)
+        api = ApiConfig.get(self.store)
+        return {
+            "banner": ui.banner,
+            "bannerTheme": ui.banner_theme,
+            "ui": {"url": ui.url, "defaultProject": ui.default_project},
+            "api": {"url": api.url},
+            "jira": {"host": jira.host},
+            "spawnHost": {
+                "spawnHostsPerUser": spawn.spawn_hosts_per_user,
+                "unexpirableHostsPerUser": spawn.unexpirable_hosts_per_user,
+                "unexpirableVolumesPerUser": (
+                    spawn.unexpirable_volumes_per_user
+                ),
+            },
+            "providers": {
+                "aws": {"maxVolumeSizeGb": spawn.max_volume_size_gb}
+            },
+        }
+
+    def _q_task_history(
+        self, taskName: str, buildVariant: str, projectId: str,
+        limit: int = 20,
+    ):
+        """Past mainline executions of one task name × variant, newest
+        first (reference graphql task_history resolver backing Spruce's
+        task-history view)."""
+        from ..globals import is_mainline_requester
+
+        version_orders = {
+            v.id: (v.revision_order_number, v.revision)
+            for v in version_mod.find(
+                self.store,
+                lambda d: d["project"] == projectId
+                and is_mainline_requester(d.get("requester", "")),
+            )
+        }
+        rows = []
+        for t in task_mod.find(
+            self.store,
+            lambda d: d["display_name"] == taskName
+            and d["build_variant"] == buildVariant
+            and d["version"] in version_orders,
+        ):
+            order, revision = version_orders[t.version]
+            rows.append(
+                {
+                    "id": t.id, "status": t.status, "version": t.version,
+                    "order": order, "revision": revision,
+                    "durationS": (
+                        t.finish_time - t.start_time
+                        if t.finish_time and t.start_time else 0.0
+                    ),
+                    "execution": t.execution,
+                }
+            )
+        rows.sort(key=lambda r: r["order"], reverse=True)
+        return rows[: max(1, min(int(limit), 100))]
+
+    def _q_version_tasks(
+        self, versionId: str, statuses: Optional[List[str]] = None,
+        variant: str = "", taskName: str = "", sortBy: str = "",
+        sortDir: str = "ASC", limit: int = 0, page: int = 0,
+    ):
+        """Filtered/sorted/paginated task table for a version (reference
+        graphql version_resolver.go Tasks — the Spruce version page's
+        main table)."""
+        docs = []
+        for t in task_mod.find(
+            self.store, lambda d: d["version"] == versionId
+        ):
+            docs.append(
+                {"id": t.id, "displayName": t.display_name,
+                 "status": t.status, "buildVariant": t.build_variant,
+                 "priority": t.priority, "execution": t.execution,
+                 "expectedDurationS": t.expected_duration_s}
+            )
+        filters = []
+        if statuses:
+            allowed = set(statuses)
+            filters.append(lambda d: d["status"] in allowed)
+        if variant:
+            filters.append(lambda d: variant in d["buildVariant"])
+        if taskName:
+            needle = taskName.lower()
+            filters.append(lambda d: needle in d["displayName"].lower())
+        docs, total, filtered = filter_sort_paginate(
+            docs,
+            {"NAME": "displayName", "STATUS": "status",
+             "VARIANT": "buildVariant", "DURATION": "expectedDurationS"},
+            filters, sortBy, sortDir, limit, page, "displayName",
+        )
+        return {"tasks": docs, "totalCount": total,
+                "filteredCount": filtered}
+
+    def _q_build_baron(self, taskId: str, execution: int = 0):
+        """Build-baron panel: configured-ness + suggested tickets
+        (reference graphql annotation/build-baron resolvers)."""
+        from ..models.annotations import build_baron_suggest, get_annotation
+
+        suggestions = build_baron_suggest(self.store, taskId)
+        ann = get_annotation(self.store, taskId, int(execution))
+        import dataclasses as _dc
+
+        return {
+            "buildBaronConfigured": bool(suggestions) or ann is not None,
+            "suggestedIssues": [_dc.asdict(s) for s in suggestions],
+            "annotation": _dc.asdict(ann) if ann else None,
+        }
+
     # -- mutation resolvers --------------------------------------------------- #
 
     def _m_schedule(self, taskId: str):
@@ -659,3 +1120,154 @@ class GraphQLApi:
     def _m_priority(self, taskId: str, priority: int):
         task_mod.coll(self.store).update(taskId, {"priority": int(priority)})
         return self._task_doc(taskId)
+
+    def _m_schedule_tasks(self, taskIds: List[str]):
+        """Bulk activation (reference graphql mutation scheduleTasks —
+        Spruce's multi-select table action)."""
+        from ..models.lifecycle import activate_task_with_dependencies
+
+        out = []
+        for tid in taskIds:
+            activate_task_with_dependencies(self.store, tid, "graphql")
+            doc = self._task_doc(tid)
+            if doc is not None:
+                out.append(doc)
+        return out
+
+    def _m_restart_version(self, versionId: str, abort: bool = False,
+                           failedOnly: bool = True):
+        """Restart a version's (failed) tasks (reference graphql mutation
+        restartVersions over model.RestartTasksInVersion)."""
+        from ..globals import TASK_IN_PROGRESS_STATUSES, TaskStatus
+        from ..units.task_jobs import abort_task, restart_task
+
+        restarted = []
+        for t in task_mod.find(
+            self.store, lambda d: d["version"] == versionId
+        ):
+            # abort first: in-progress tasks are never FAILED yet, so the
+            # failedOnly skip must not shadow an explicit abort request;
+            # the aborted task restarts when its agent reports in
+            # (reference SetResetWhenFinished semantics)
+            if abort and t.status in TASK_IN_PROGRESS_STATUSES:
+                abort_task(self.store, t.id, by="graphql")
+                task_mod.coll(self.store).update(
+                    t.id, {"reset_when_finished": True}
+                )
+                restarted.append(t.id)
+                continue
+            if failedOnly and t.status != TaskStatus.FAILED.value:
+                continue
+            if t.finish_time > 0.0 or not failedOnly:
+                restart_task(self.store, t.id, by="graphql")
+                restarted.append(t.id)
+        return {"versionId": versionId, "restartedTaskIds": restarted}
+
+    def _m_schedule_patch(self, patchId: str, variantTasks=None):
+        """Finalize a patch into a runnable version (reference graphql
+        mutation schedulePatch → FinalizePatch). A variantTasks selection
+        ([{variant, tasks}]) narrows the patch's requested set first —
+        the reference's configure-then-schedule flow."""
+        from ..ingestion.patches import finalize_patch, get_patch
+
+        if variantTasks:
+            variants = sorted(
+                {vt.get("variant", "") for vt in variantTasks} - {""}
+            )
+            tasks = sorted(
+                {t for vt in variantTasks for t in vt.get("tasks", [])}
+            )
+            self.store.collection("patches").update(
+                patchId, {"variants": variants, "tasks": tasks}
+            )
+        created = finalize_patch(self.store, patchId)
+        p = get_patch(self.store, patchId)
+        doc = p.to_doc() if p else {}
+        doc["id"] = patchId
+        if created is not None:
+            doc["versionId"] = created.version.id
+        return doc
+
+    def _m_add_annotation_issue(
+        self, taskId: str, execution: int, url: str, issueKey: str = "",
+        isIssue: bool = True,
+    ):
+        """reference graphql annotation_resolver.go AddAnnotationIssue."""
+        from ..models.annotations import IssueLink, add_issue
+
+        user = getattr(self, "acting_user", "") or "graphql"
+        add_issue(
+            self.store, taskId, int(execution),
+            IssueLink(url=url, issue_key=issueKey, source="user",
+                      added_by=user),
+            suspected=not isIssue,
+        )
+        return self._q_annotation(taskId, execution)
+
+    def _m_remove_annotation_issue(
+        self, taskId: str, execution: int, issueKey: str,
+        isIssue: bool = True,
+    ):
+        from ..models.annotations import remove_issue
+
+        remove_issue(
+            self.store, taskId, int(execution), issueKey,
+            suspected=not isIssue,
+        )
+        return self._q_annotation(taskId, execution)
+
+    def _m_move_annotation_issue(
+        self, taskId: str, execution: int, issueKey: str,
+        isIssue: bool = True,
+    ):
+        """Move between confirmed issues and suspected issues; isIssue is
+        the DESTINATION (reference MoveAnnotationIssue)."""
+        from ..models.annotations import move_issue_to_suspected
+
+        move_issue_to_suspected(
+            self.store, taskId, int(execution), issueKey,
+            to_suspected=not isIssue,
+        )
+        return self._q_annotation(taskId, execution)
+
+    def _m_edit_annotation_note(
+        self, taskId: str, execution: int, note: str,
+    ):
+        from ..models.annotations import set_note
+
+        set_note(self.store, taskId, int(execution), note)
+        return self._q_annotation(taskId, execution)
+
+    def _m_save_project_settings(self, projectId: str, projectRef=None,
+                                 vars=None):
+        """Subset of reference saveProjectSettingsForSection: update
+        project-ref fields and/or project vars."""
+        coll = self.store.collection("project_refs")
+        ref = coll.get(projectId)
+        if ref is None:
+            raise GraphQLError(f"project {projectId!r} not found")
+        if projectRef:
+            known = set(ref)
+            updates = {
+                k: v for k, v in dict(projectRef).items()
+                if k in known and k != "_id"
+            }
+            if updates:
+                coll.update(projectId, updates)
+        if vars is not None:
+            vdoc = self.store.collection("project_vars").get(projectId) or {
+                "_id": projectId, "vars": {}, "private_vars": []
+            }
+            existing = dict(vdoc.get("vars", {}))
+            incoming = dict(vars.get("vars", existing))
+            # a client that round-trips the redacted read must not
+            # overwrite real secrets with the placeholder (reference
+            # strips {REDACTED} before saving)
+            for k, v in incoming.items():
+                if v == REDACTED and k in existing:
+                    incoming[k] = existing[k]
+            vdoc["vars"] = incoming
+            if "privateVars" in vars:
+                vdoc["private_vars"] = list(vars["privateVars"])
+            self.store.collection("project_vars").upsert(vdoc)
+        return self._q_project_settings(projectId)

@@ -2,9 +2,11 @@
 
 The reference's UI surface is Spruce (a separate React app on the GraphQL
 API). This is the dependency-free stand-in: one HTML page with hash
-routing over the REST API — overview (versions / hosts / events), distro
-queue views, version drill-down, and task detail with logs, test results
-and artifacts. Enough to watch and debug the system from a browser.
+routing — overview (versions / hosts / events), distro queue views,
+version drill-down, task detail with logs/tests/artifacts over REST, plus
+a project waterfall grid and patch list/detail pages over the GraphQL
+endpoint (the same queries Spruce drives). Enough to watch and debug the
+system from a browser.
 """
 from __future__ import annotations
 
@@ -35,7 +37,8 @@ PAGE = """<!doctype html>
 </head>
 <body>
 <h1>evergreen-tpu</h1>
-<nav><a href="#/">overview</a><a href="#/queues">queues</a></nav>
+<nav><a href="#/">overview</a><a href="#/queues">queues</a><a
+ href="#/waterfall">waterfall</a><a href="#/patches">patches</a></nav>
 <div id="statusbar">loading…</div>
 <div id="view"></div>
 <script>
@@ -142,6 +145,114 @@ async function queues() {
   return blocks;
 }
 
+async function gql(query, variables) {
+  const r = await fetch("/graphql", {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({ query, variables: variables || {} }),
+  });
+  if (!r.ok) throw new Error(`/graphql -> ${r.status}`);
+  const out = await r.json();
+  if (out.errors) throw new Error(out.errors[0].message);
+  return out.data;
+}
+
+function cellClass(c) {
+  if (c.failed > 0) return "failed";
+  if (c.in_progress > 0) return "started";
+  if (c.success === c.total && c.total > 0) return "success";
+  return "undispatched";
+}
+
+async function waterfallView(projectId) {
+  // the Spruce waterfall grid over the GraphQL waterfall query
+  const projects = (await gql("{ projects { _id } }")).projects;
+  if (!projects.length) return [el("p", {}, "no projects yet")];
+  const pid = projectId || projects[0]._id;
+  const data = await gql(
+    "query W($p: String!) { waterfall(projectId: $p, limit: 20) " +
+    "{ id revision message order status build_variants " +
+    "{ name total success failed in_progress } } }", { p: pid });
+  const rows = data.waterfall;
+  const variantNames = [...new Set(
+    rows.flatMap(r => r.build_variants.map(c => c.name)))].sort();
+  const parts = [
+    el("h2", {}, "Waterfall — ",
+      ...projects.map(p => el("a", {
+        href: `#/waterfall/${p._id}`,
+        class: p._id === pid ? "" : "muted",
+      }, ` ${p._id} `))),
+  ];
+  const header = ["version", ...variantNames];
+  const body = rows.map(r => {
+    const byName = Object.fromEntries(
+      r.build_variants.map(c => [c.name, c]));
+    return tr([
+      el("a", { href: `#/version/${r.id}` },
+        `${(r.revision || r.id).slice(0, 10)} ${
+          (r.message || "").slice(0, 40)}`),
+      ...variantNames.map(n => {
+        const c = byName[n];
+        if (!c) return ["—", "muted"];
+        return [`${c.success}/${c.total}${c.failed ? " ✗" + c.failed : ""}`,
+                cellClass(c)];
+      }),
+    ]);
+  });
+  parts.push(table(header, body));
+  return parts;
+}
+
+async function patchesView() {
+  const data = await gql(
+    "{ patches(limit: 30) { _id project author description status " +
+    "version create_time } }");
+  return [
+    el("h2", {}, "Patches"),
+    table(["patch", "project", "author", "status", "description"],
+      data.patches.map(p => tr([
+        el("a", { href: `#/patch/${p._id}` }, p._id),
+        [p.project], [p.author], statusCell(p.status),
+        [(p.description || "").slice(0, 60)],
+      ]))),
+  ];
+}
+
+async function patchView(pid) {
+  const data = await gql(
+    "query P($id: String!) { patch(patchId: $id) { id project author " +
+    "description status version variants tasks githash activated } }",
+    { id: pid });
+  const p = data.patch;
+  if (!p) return [el("p", { class: "failed" }, `patch ${pid} not found`)];
+  const parts = [
+    el("h2", {}, `Patch ${p.id}`),
+    el("p", {}, `project ${p.project} · author ${p.author} · status `,
+      el("span", { class: p.status }, p.status),
+      ` · base ${(p.githash || "").slice(0, 10) || "—"}`),
+    el("p", {}, (p.description || "").slice(0, 200)),
+    el("p", {}, `variants: ${(p.variants || []).join(", ") || "—"} · ` +
+      `tasks: ${(p.tasks || []).join(", ") || "—"}`),
+  ];
+  if (p.version) {
+    parts.push(el("p", {}, "version: ",
+      el("a", { href: `#/version/${p.version}` }, p.version)));
+    const vt = await gql(
+      "query T($v: String!) { versionTasks(versionId: $v) " +
+      "{ tasks { id displayName status buildVariant } } }",
+      { v: p.version });
+    parts.push(el("h2", {}, "Tasks"));
+    parts.push(table(["task", "variant", "status"],
+      vt.versionTasks.tasks.map(t => tr([
+        el("a", { href: `#/task/${t.id}` }, t.displayName || t.id),
+        [t.buildVariant], statusCell(t.status),
+      ]))));
+  } else {
+    parts.push(el("p", { class: "muted" }, "not finalized yet"));
+  }
+  return parts;
+}
+
 async function versionView(vid) {
   const [v, tasks] = await Promise.all([
     j(`/rest/v2/versions/${vid}`), j(`/rest/v2/versions/${vid}/tasks`),
@@ -206,6 +317,10 @@ async function route(isRefresh) {
     if (h.startsWith("#/task/")) nodes = await taskView(h.slice(7));
     else if (h.startsWith("#/version/")) nodes = await versionView(h.slice(10));
     else if (h === "#/queues") nodes = await queues();
+    else if (h.startsWith("#/waterfall"))
+      nodes = await waterfallView(h.slice(12) || "");
+    else if (h === "#/patches") nodes = await patchesView();
+    else if (h.startsWith("#/patch/")) nodes = await patchView(h.slice(8));
     else nodes = await overview();
     if (my !== gen) return;  // user navigated while we were fetching
     view.replaceChildren(...nodes);
@@ -222,7 +337,8 @@ window.addEventListener("hashchange", () => route(false));
 route(false);
 setInterval(() => {  // background refresh only on the live views
   const h = location.hash || "#/";
-  if (h === "#/" || h === "#/queues") route(true);
+  if (h === "#/" || h === "#/queues" || h.startsWith("#/waterfall"))
+    route(true);
 }, 5000);
 </script>
 </body>

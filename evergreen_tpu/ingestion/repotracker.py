@@ -256,20 +256,24 @@ class GithubApiRevisionSource(RevisionSource):
 
     def _list_commits(self, n: int) -> List[dict]:
         out: List[dict] = []
+        # page offsets are relative to per_page, so per_page must stay
+        # CONSTANT across pages — shrinking it on the last page would
+        # re-fetch earlier commits and skip the tail
+        per_page = min(n, self._PAGE_CAP)
         page = 1
         while len(out) < n:
             batch = self._get(
                 f"/repos/{self.owner}/{self.repo}/commits",
                 {
                     "sha": self.branch,
-                    "per_page": str(min(n - len(out), self._PAGE_CAP)),
+                    "per_page": str(per_page),
                     "page": str(page),
                 },
             )
             if not batch:
                 break
             out.extend(batch)
-            if len(batch) < self._PAGE_CAP:
+            if len(batch) < per_page:
                 break
             page += 1
         return out[:n]

@@ -71,6 +71,54 @@ def add_issue(
     upsert_annotation(store, ann)
 
 
+def remove_issue(
+    store: Store, task_id: str, execution: int, issue_key: str,
+    suspected: bool = False,
+) -> bool:
+    """Drop an issue link by key (reference annotations RemoveIssueFromAnnotation)."""
+    ann = get_annotation(store, task_id, execution)
+    if ann is None:
+        return False
+    links = ann.suspected_issues if suspected else ann.issues
+    kept = [l for l in links if l.issue_key != issue_key]
+    if len(kept) == len(links):
+        return False
+    if suspected:
+        ann.suspected_issues = kept
+    else:
+        ann.issues = kept
+    upsert_annotation(store, ann)
+    return True
+
+
+def move_issue_to_suspected(
+    store: Store, task_id: str, execution: int, issue_key: str,
+    to_suspected: bool = True,
+) -> bool:
+    """Move a link between issues↔suspected (reference MoveIssueToAnnotation)."""
+    ann = get_annotation(store, task_id, execution)
+    if ann is None:
+        return False
+    src = ann.issues if to_suspected else ann.suspected_issues
+    dst = ann.suspected_issues if to_suspected else ann.issues
+    for link in list(src):
+        if link.issue_key == issue_key:
+            src.remove(link)
+            dst.append(link)
+            upsert_annotation(store, ann)
+            return True
+    return False
+
+
+def set_note(store: Store, task_id: str, execution: int, note: str) -> None:
+    """Replace the annotation note (reference UpdateAnnotationNote)."""
+    ann = get_annotation(store, task_id, execution) or Annotation(
+        task_id=task_id, execution=execution
+    )
+    ann.note = note
+    upsert_annotation(store, ann)
+
+
 #: build-baron ticket search: project id + task doc → suspected issues
 TicketSearcher = Callable[[str, dict], List[IssueLink]]
 _TICKET_SEARCHERS: Dict[str, TicketSearcher] = {}

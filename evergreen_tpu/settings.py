@@ -312,6 +312,10 @@ class UiConfig(ConfigSection):
     csrf_key: str = ""
     cors_origins: List[str] = dataclasses.field(default_factory=list)
     login_domain: str = ""
+    #: site-wide announcement banner (reference admin settings Banner /
+    #: BannerTheme, surfaced to Spruce via spruceConfig)
+    banner: str = ""
+    banner_theme: str = "ANNOUNCEMENT"
 
     def validate_and_default(self) -> str:
         if self.csrf_key and len(self.csrf_key) != 32:
@@ -346,6 +350,7 @@ class SpawnHostConfig(ConfigSection):
     unexpirable_hosts_per_user: int = 1
     unexpirable_volumes_per_user: int = 1
     spawn_hosts_per_user: int = 3
+    max_volume_size_gb: int = 500
 
     def validate_and_default(self) -> str:
         if self.spawn_hosts_per_user < 0:

@@ -1,0 +1,510 @@
+"""Spruce-tier GraphQL breadth: typed variable definitions, introspection
+stubs, the projectSettings/spruceConfig/taskHistory/versionTasks/
+taskTests-pagination/sectioned-logs/buildBaron resolvers, and the
+annotation + bulk mutations. Reference analogs: graphql/*_resolver.go +
+gqlgen's operation validation; docs/graphql.md is the served-operation
+inventory this file backs.
+"""
+import pytest
+
+from evergreen_tpu.api.graphql import GraphQLApi
+from evergreen_tpu.globals import Requester, TaskStatus
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import version as version_mod
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.models.version import Version
+
+
+def gql_ok(gql, query, variables=None):
+    out = gql.execute(query, variables)
+    assert "errors" not in out, out
+    return out["data"]
+
+
+def gql_err(gql, query, variables=None):
+    out = gql.execute(query, variables)
+    assert "errors" in out, out
+    return out["errors"][0]["message"]
+
+
+def seed_mainline(store, n=4):
+    for i in range(1, n + 1):
+        version_mod.insert(
+            store,
+            Version(id=f"v{i}", project="p", status="created",
+                    requester=Requester.REPOTRACKER.value,
+                    revision=f"sha{i}", revision_order_number=i),
+        )
+        task_mod.insert_many(
+            store,
+            [
+                Task(id=f"t{i}-compile", display_name="compile",
+                     build_variant="lin", version=f"v{i}", project="p",
+                     status=(TaskStatus.SUCCEEDED.value if i % 2
+                             else TaskStatus.FAILED.value),
+                     activated=True,
+                     start_time=100.0 * i, finish_time=100.0 * i + 60),
+                Task(id=f"t{i}-test", display_name="unit-test",
+                     build_variant="win", version=f"v{i}", project="p",
+                     status=TaskStatus.UNDISPATCHED.value, activated=True),
+            ],
+        )
+
+
+# --------------------------------------------------------------------------- #
+# typed variable definitions
+# --------------------------------------------------------------------------- #
+
+
+def test_variable_definitions_typed_and_defaulted(store):
+    seed_mainline(store, 1)
+    gql = GraphQLApi(store)
+    q = ('query T($id: String!, $lim: Int = 5) '
+         '{ taskHistory(taskName: "compile", buildVariant: "lin", '
+         'projectId: "p", limit: $lim) { id } '
+         'task(taskId: $id) { id } }')
+    data = gql_ok(gql, q, {"id": "t1-compile"})
+    assert data["task"]["id"] == "t1-compile"
+    # required variable missing → error naming the variable and type
+    msg = gql_err(gql, q, {})
+    assert "$id" in msg and "String!" in msg
+    # type mismatch → error
+    msg = gql_err(gql, q, {"id": 42})
+    assert "expects String" in msg
+    # wrong-typed default-bearing variable also checked when provided
+    msg = gql_err(gql, q, {"id": "t1-compile", "lim": "ten"})
+    assert "expects Int" in msg
+
+
+def test_variable_list_and_null_semantics(store):
+    seed_mainline(store, 1)
+    gql = GraphQLApi(store)
+    q = ('query V($ids: [String!], $flag: Boolean) '
+         '{ versionTasks(versionId: "v1", statuses: $ids) '
+         '{ tasks { id } filteredCount } '
+         'task(taskId: "t1-compile") { id status @include(if: $flag) } }')
+    data = gql_ok(gql, q, {"ids": ["success"], "flag": False})
+    assert data["versionTasks"]["filteredCount"] == 1
+    assert "status" not in data["task"]
+    # single value coerces to one-item list (spec rule)
+    data = gql_ok(gql, q, {"ids": "success", "flag": True})
+    assert data["versionTasks"]["filteredCount"] == 1
+    assert data["task"]["status"] == "success"
+    # null against nullable list is fine; declared-null flag too
+    data = gql_ok(gql, q, {"ids": None, "flag": True})
+    assert data["versionTasks"]["filteredCount"] == 2
+    # non-null violation
+    msg = gql_err(
+        gql,
+        'query R($x: Int!) { versionTasks(versionId: "v1", limit: $x) '
+        '{ totalCount } }',
+        {"x": None},
+    )
+    assert "must not be null" in msg
+
+
+# --------------------------------------------------------------------------- #
+# introspection
+# --------------------------------------------------------------------------- #
+
+
+def test_introspection_schema_and_typename(store):
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ __typename __schema { queryType { name } mutationType { name } '
+        'types { name kind } } }',
+    )
+    assert data["__typename"] == "Query"
+    assert data["__schema"]["queryType"]["name"] == "Query"
+    type_names = {t["name"] for t in data["__schema"]["types"]}
+    assert {"Query", "Mutation", "String", "Int"} <= type_names
+    data = gql_ok(
+        gql,
+        '{ __type(name: "Query") { name fields { name args { name } } } }',
+    )
+    field_names = {f["name"] for f in data["__type"]["fields"]}
+    # the operation inventory is discoverable
+    assert {"task", "versionTasks", "projectSettings", "spruceConfig",
+            "taskHistory", "buildBaron"} <= field_names
+    task_field = next(f for f in data["__type"]["fields"]
+                      if f["name"] == "task")
+    assert [a["name"] for a in task_field["args"]] == ["taskId"]
+    data = gql_ok(gql, '{ __type(name: "Mutation") { fields { name } } }')
+    mutation_names = {f["name"] for f in data["__type"]["fields"]}
+    assert {"scheduleTasks", "restartVersion", "addAnnotationIssue",
+            "editAnnotationNote", "schedulePatch"} <= mutation_names
+
+
+# --------------------------------------------------------------------------- #
+# Spruce-tier resolvers
+# --------------------------------------------------------------------------- #
+
+
+def test_project_settings_bundle_redacts_secrets(store):
+    store.collection("project_refs").upsert(
+        {"_id": "p", "display_name": "Proj", "enabled": True}
+    )
+    store.collection("project_vars").upsert(
+        {"_id": "p", "vars": {"user": "u", "token": "hunter2"},
+         "private_vars": ["token"]}
+    )
+    store.collection("subscriptions").upsert(
+        {"_id": "s1", "owner": "p", "subscriber_type": "webhook",
+         "subscriber_secret": "sssh"}
+    )
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ projectSettings(projectId: "p") { projectRef { id display_name } '
+        'vars { vars privateVars } subscriptions { subscriber_type '
+        'subscriber_secret } } }',
+    )
+    ps = data["projectSettings"]
+    assert ps["projectRef"]["id"] == "p"
+    assert ps["vars"]["vars"] == {"user": "u", "token": "{REDACTED}"}
+    assert ps["vars"]["privateVars"] == ["token"]
+    assert ps["subscriptions"][0]["subscriber_secret"] is None
+
+
+def test_project_settings_read_does_not_destroy_secrets(store):
+    """Reading projectSettings must not mutate the live store docs: the
+    webhook HMAC secret and real var values survive the query."""
+    store.collection("project_refs").upsert({"_id": "p", "enabled": True})
+    store.collection("project_vars").upsert(
+        {"_id": "p", "vars": {"token": "hunter2"}, "private_vars": ["token"]}
+    )
+    store.collection("subscriptions").upsert(
+        {"_id": "s1", "owner": "p", "subscriber_secret": "sssh"}
+    )
+    gql = GraphQLApi(store)
+    for _ in range(2):
+        gql_ok(gql, '{ projectSettings(projectId: "p") '
+                    '{ subscriptions { subscriber_secret } '
+                    'vars { vars } } }')
+    assert store.collection("subscriptions").get("s1")[
+        "subscriber_secret"] == "sssh"
+    assert store.collection("project_vars").get("p")["vars"][
+        "token"] == "hunter2"
+
+
+def test_save_project_settings_redacted_round_trip_keeps_secret(store):
+    """Saving back a read (where private vars show {REDACTED}) must not
+    overwrite the real secret with the placeholder."""
+    store.collection("project_refs").upsert({"_id": "p", "enabled": True})
+    store.collection("project_vars").upsert(
+        {"_id": "p", "vars": {"token": "hunter2", "plain": "x"},
+         "private_vars": ["token"]}
+    )
+    gql = GraphQLApi(store)
+    read = gql_ok(gql, '{ projectSettings(projectId: "p") '
+                       '{ vars { vars privateVars } } }')
+    round_tripped = read["projectSettings"]["vars"]
+    round_tripped["vars"]["plain"] = "y"  # the user's actual edit
+    gql_ok(
+        gql,
+        'mutation($v: JSON) { saveProjectSettings(projectId: "p", '
+        'vars: $v) { vars { vars } } }',
+        {"v": round_tripped},
+    )
+    stored = store.collection("project_vars").get("p")["vars"]
+    assert stored == {"token": "hunter2", "plain": "y"}
+
+
+def test_restart_version_abort_restarts_in_progress(store):
+    seed_mainline(store, 1)
+    task_mod.coll(store).update(
+        "t1-test", {"status": TaskStatus.STARTED.value}
+    )
+    task_mod.coll(store).update(
+        "t1-compile", {"status": TaskStatus.FAILED.value}
+    )
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        'mutation { restartVersion(versionId: "v1", abort: true) '
+        '{ restartedTaskIds } }',
+    )
+    # the in-progress task is aborted + marked reset-when-finished, and
+    # the finished-failed one restarts immediately
+    assert set(data["restartVersion"]["restartedTaskIds"]) == {
+        "t1-test", "t1-compile"}
+    t = task_mod.get(store, "t1-test")
+    assert t.aborted and t.reset_when_finished
+
+
+def test_schedule_patch_honors_variant_tasks_selection(store):
+    from evergreen_tpu.ingestion.patches import Patch, insert_patch
+
+    store.collection("project_refs").upsert(
+        {"_id": "p", "enabled": True, "patching_disabled": False}
+    )
+    yml = """
+tasks:
+  - name: compile
+    commands: [{command: shell.exec, params: {script: "true"}}]
+  - name: lint
+    commands: [{command: shell.exec, params: {script: "true"}}]
+buildvariants:
+  - name: bv1
+    run_on: [d1]
+    tasks: [compile, lint]
+"""
+    insert_patch(store, Patch(id="p-sel", project="p", config_yaml=yml,
+                              variants=["*"], tasks=["*"]))
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        'mutation { schedulePatch(patchId: "p-sel", variantTasks: '
+        '[{variant: "bv1", tasks: ["compile"]}]) { versionId } }',
+    )
+    vid = data["schedulePatch"]["versionId"]
+    names = {t.display_name
+             for t in task_mod.find(store, lambda d: d["version"] == vid)}
+    assert names == {"compile"}
+
+
+def test_spruce_config(store):
+    from evergreen_tpu.settings import UiConfig
+
+    ui = UiConfig.get(store)
+    ui.banner = "maintenance at noon"
+    ui.set(store)
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ spruceConfig { banner bannerTheme spawnHost '
+        '{ spawnHostsPerUser } jira { host } } }',
+    )
+    cfg = data["spruceConfig"]
+    assert cfg["banner"] == "maintenance at noon"
+    assert cfg["spawnHost"]["spawnHostsPerUser"] == 3
+
+
+def test_task_history_newest_first_mainline_only(store):
+    seed_mainline(store, 4)
+    # a patch version with the same task name must NOT appear
+    version_mod.insert(
+        store, Version(id="pv", project="p",
+                       requester=Requester.PATCH.value,
+                       revision_order_number=99),
+    )
+    task_mod.insert(
+        store, Task(id="pt", display_name="compile", build_variant="lin",
+                    version="pv", project="p", activated=True),
+    )
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ taskHistory(taskName: "compile", buildVariant: "lin", '
+        'projectId: "p", limit: 3) { id order status durationS } }',
+    )
+    rows = data["taskHistory"]
+    assert [r["order"] for r in rows] == [4, 3, 2]
+    assert all(r["id"] != "pt" for r in rows)
+    assert rows[0]["durationS"] == 60.0
+
+
+def test_version_tasks_filter_sort_paginate(store):
+    seed_mainline(store, 1)
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ versionTasks(versionId: "v1", variant: "lin") '
+        '{ tasks { id } totalCount filteredCount } }',
+    )
+    vt = data["versionTasks"]
+    assert vt["totalCount"] == 2 and vt["filteredCount"] == 1
+    assert vt["tasks"][0]["id"] == "t1-compile"
+    data = gql_ok(
+        gql,
+        '{ versionTasks(versionId: "v1", sortBy: "NAME", sortDir: "DESC", '
+        'limit: 1, page: 1) { tasks { displayName } totalCount } }',
+    )
+    # DESC by name: [unit-test, compile]; page 1 of size 1 → compile
+    assert data["versionTasks"]["tasks"][0]["displayName"] == "compile"
+
+
+def test_task_tests_pagination_shape(store):
+    from evergreen_tpu.models.artifact import TestResult, attach_test_results
+
+    seed_mainline(store, 1)
+    attach_test_results(
+        store, "t1-compile", 0,
+        [TestResult(test_name=f"test_{i}",
+                    status="fail" if i % 3 == 0 else "pass",
+                    duration_s=float(i)) for i in range(10)],
+    )
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ taskTests(taskId: "t1-compile", statuses: ["fail"], '
+        'sortBy: "DURATION", sortDir: "DESC", limit: 2, page: 0) '
+        '{ testResults { testName status } totalTestCount '
+        'filteredTestCount } }',
+    )
+    tt = data["taskTests"]
+    assert tt["totalTestCount"] == 10
+    assert tt["filteredTestCount"] == 4  # 0,3,6,9
+    assert [r["testName"] for r in tt["testResults"]] == ["test_9", "test_6"]
+
+
+def test_task_logs_sections(store):
+    seed_mainline(store, 1)
+    store.collection("task_logs").upsert(
+        {"_id": "t1-compile",
+         "lines": ["building", "[agent] heartbeat ok", "[system] oom check"]}
+    )
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        '{ taskLogs(taskId: "t1-compile") '
+        '{ lines taskLogs agentLogs systemLogs eventLogs { eventType } } }',
+    )
+    tl = data["taskLogs"]
+    assert tl["taskLogs"] == ["building"]
+    assert tl["agentLogs"] == ["[agent] heartbeat ok"]
+    assert tl["systemLogs"] == ["[system] oom check"]
+    assert len(tl["lines"]) == 3
+
+
+def test_build_baron_panel(store):
+    from evergreen_tpu.models.annotations import (
+        IssueLink,
+        register_ticket_searcher,
+    )
+
+    seed_mainline(store, 1)
+    register_ticket_searcher(
+        "p", lambda proj, doc: [IssueLink(url="https://j/EVG-1",
+                                          issue_key="EVG-1")],
+    )
+    try:
+        gql = GraphQLApi(store)
+        data = gql_ok(
+            gql,
+            '{ buildBaron(taskId: "t1-compile") { buildBaronConfigured '
+            'suggestedIssues { issue_key } } }',
+        )
+        bb = data["buildBaron"]
+        assert bb["buildBaronConfigured"]
+        assert bb["suggestedIssues"][0]["issue_key"] == "EVG-1"
+    finally:
+        from evergreen_tpu.models import annotations as ann_mod
+
+        ann_mod._TICKET_SEARCHERS.clear()
+
+
+# --------------------------------------------------------------------------- #
+# mutations
+# --------------------------------------------------------------------------- #
+
+
+def test_bulk_schedule_and_restart_version(store):
+    seed_mainline(store, 1)
+    task_mod.coll(store).update("t1-test", {"activated": False})
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        'mutation { scheduleTasks(taskIds: ["t1-test"]) { id activated } }',
+    )
+    assert data["scheduleTasks"][0]["activated"] is True
+    # restartVersion(failedOnly) only touches finished failed tasks
+    task_mod.coll(store).update(
+        "t1-compile",
+        {"status": TaskStatus.FAILED.value, "finish_time": 50.0},
+    )
+    data = gql_ok(
+        gql,
+        'mutation { restartVersion(versionId: "v1") '
+        '{ versionId restartedTaskIds } }',
+    )
+    assert data["restartVersion"]["restartedTaskIds"] == ["t1-compile"]
+    t = task_mod.get(store, "t1-compile")
+    assert t.status == TaskStatus.UNDISPATCHED.value and t.execution == 1
+
+
+def test_annotation_mutations_round_trip(store):
+    seed_mainline(store, 1)
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        'mutation { addAnnotationIssue(taskId: "t1-compile", execution: 0, '
+        'url: "https://j/EVG-7", issueKey: "EVG-7") '
+        '{ issues { issue_key } suspected_issues { issue_key } } }',
+    )
+    assert data["addAnnotationIssue"]["issues"][0]["issue_key"] == "EVG-7"
+    # move to suspected (isIssue: false = destination suspected)
+    data = gql_ok(
+        gql,
+        'mutation { moveAnnotationIssue(taskId: "t1-compile", execution: 0, '
+        'issueKey: "EVG-7", isIssue: false) '
+        '{ issues { issue_key } suspected_issues { issue_key } } }',
+    )
+    ann = data["moveAnnotationIssue"]
+    assert ann["issues"] == []
+    assert ann["suspected_issues"][0]["issue_key"] == "EVG-7"
+    data = gql_ok(
+        gql,
+        'mutation { editAnnotationNote(taskId: "t1-compile", execution: 0, '
+        'note: "flaky dns") { note } }',
+    )
+    assert data["editAnnotationNote"]["note"] == "flaky dns"
+    data = gql_ok(
+        gql,
+        'mutation { removeAnnotationIssue(taskId: "t1-compile", '
+        'execution: 0, issueKey: "EVG-7", isIssue: false) '
+        '{ suspected_issues { issue_key } } }',
+    )
+    assert data["removeAnnotationIssue"]["suspected_issues"] == []
+
+
+def test_save_project_settings_mutation(store):
+    store.collection("project_refs").upsert(
+        {"_id": "p", "display_name": "Old", "enabled": True}
+    )
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        'mutation($ref: JSON, $vars: JSON) { '
+        'saveProjectSettings(projectId: "p", '
+        'projectRef: $ref, vars: $vars) { projectRef { display_name } '
+        'vars { vars privateVars } } }',
+        {"ref": {"display_name": "New"},
+         "vars": {"vars": {"k": "v"}, "privateVars": ["k"]}},
+    )
+    ps = data["saveProjectSettings"]
+    assert ps["projectRef"]["display_name"] == "New"
+    assert ps["vars"]["vars"] == {"k": "{REDACTED}"}
+
+
+def test_schedule_patch_mutation(store):
+    """schedulePatch finalizes an unfinalized patch into a version."""
+    from evergreen_tpu.ingestion.patches import Patch, insert_patch
+
+    store.collection("project_refs").upsert(
+        {"_id": "p", "enabled": True, "branch": "main",
+         "remote_path": "evergreen.yml", "patching_disabled": False}
+    )
+    yml = """
+tasks:
+  - name: compile
+    commands:
+      - command: shell.exec
+        params: {script: "true"}
+buildvariants:
+  - name: bv1
+    run_on: [d1]
+    tasks: [compile]
+"""
+    p = Patch(id="p-1", project="p", author="alice", config_yaml=yml,
+              variants=["*"], tasks=["*"])
+    insert_patch(store, p)
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        f'mutation {{ schedulePatch(patchId: "{p.id}") '
+        '{ id versionId } }',
+    )
+    assert data["schedulePatch"]["versionId"]
+    assert version_mod.get(store, data["schedulePatch"]["versionId"])
