@@ -484,8 +484,11 @@ def _project(
 
 
 class GraphQLApi:
-    def __init__(self, store: Store) -> None:
+    def __init__(self, store: Store, acting_user: str = "") -> None:
         self.store = store
+        #: authenticated user performing this request (set by the REST
+        #: layer) — audit attribution for annotation edits
+        self.acting_user = acting_user
         self.queries: Dict[str, Callable] = {
             "task": self._q_task,
             "tasks": self._q_tasks,
@@ -851,10 +854,18 @@ class GraphQLApi:
         """Sectioned logs (reference graphql task_logs resolver returning
         taskLogs/agentLogs/systemLogs/eventLogs; Spruce's log viewer
         tabs). Agent/system sections split by line prefix; event logs come
-        from the task's event documents."""
+        from the task's event documents. The flat ``task_logs`` doc holds
+        the CURRENT execution — an archived execution's logs are served
+        only if a per-execution doc exists, never mislabeled."""
         from ..models import event as event_mod
 
-        doc = self.store.collection("task_logs").get(taskId)
+        doc = self.store.collection("task_logs").get(
+            f"{taskId}:{execution}"
+        )
+        if doc is None:
+            t = task_mod.get(self.store, taskId)
+            if t is None or t.execution == int(execution):
+                doc = self.store.collection("task_logs").get(taskId)
         lines = doc["lines"] if doc else []
         agent_lines = [l for l in lines if l.startswith("[agent]")]
         system_lines = [l for l in lines if l.startswith("[system]")]
@@ -1158,8 +1169,9 @@ class GraphQLApi:
                 continue
             if failedOnly and t.status != TaskStatus.FAILED.value:
                 continue
-            if t.finish_time > 0.0 or not failedOnly:
-                restart_task(self.store, t.id, by="graphql")
+            # restart_task itself refuses non-finished tasks; only report
+            # ids that actually restarted
+            if restart_task(self.store, t.id, by="graphql"):
                 restarted.append(t.id)
         return {"versionId": versionId, "restartedTaskIds": restarted}
 
@@ -1195,7 +1207,7 @@ class GraphQLApi:
         """reference graphql annotation_resolver.go AddAnnotationIssue."""
         from ..models.annotations import IssueLink, add_issue
 
-        user = getattr(self, "acting_user", "") or "graphql"
+        user = self.acting_user or "graphql"
         add_issue(
             self.store, taskId, int(execution),
             IssueLink(url=url, issue_key=issueKey, source="user",

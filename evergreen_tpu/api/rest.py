@@ -31,6 +31,7 @@ from ..models import task as task_mod
 from ..models import version as version_mod
 from ..models.lifecycle import mark_end, mark_task_started
 from ..settings import ServiceFlags, all_sections, get_section
+from ..storage.replica import ReplicaReadOnly
 from ..storage.store import Store
 from ..units import task_jobs
 
@@ -290,6 +291,14 @@ class RestApi:
                     return handler(method, match, body)
                 except ApiError as e:
                     return e.status, {"error": e.message}
+                except ReplicaReadOnly as e:
+                    # read replica: mutations must go to the writer
+                    # (reference: any replica writes to shared Mongo; here
+                    # the client retries against the primary)
+                    return 503, {
+                        "error": "this server is a read-only replica",
+                        "primary": e.primary_url,
+                    }
                 except KeyError as e:
                     return 404, {"error": f"not found: {e}"}
                 except (ValueError, TypeError) as e:
@@ -1394,9 +1403,10 @@ class RestApi:
     def graphql(self, method, match, body):
         from .graphql import GraphQLApi
 
-        result = GraphQLApi(self.store).execute(
-            body.get("query", ""), body.get("variables") or {}
-        )
+        result = GraphQLApi(
+            self.store,
+            acting_user=getattr(self._ident, "user", ""),
+        ).execute(body.get("query", ""), body.get("variables") or {})
         return 200, result
 
     def status(self, method, match, body):

@@ -22,6 +22,36 @@ def cmd_service(args) -> int:
     from .units.crons import build_cron_runner
 
     lease = None
+    if getattr(args, "replica_of", ""):
+        # Read replica: tail the primary's WAL, serve reads, 503 writes
+        # toward the primary (storage/replica.py). No lease, no job plane
+        # — background work belongs to the writer.
+        if not args.data_dir:
+            print("--replica-of requires --data-dir", file=sys.stderr)
+            return 2
+        from .storage.replica import ReplicaStore
+        from .storage.store import set_global_store
+
+        store = ReplicaStore(args.data_dir, primary_url=args.replica_of)
+        store.start()
+        set_global_store(store)
+        api = RestApi(
+            store,
+            require_auth=args.require_auth,
+            rate_limit_per_min=args.rate_limit,
+        )
+        server = api.serve(args.host, args.port)
+        print(
+            f"evergreen-tpu READ REPLICA on {args.host}:{args.port} "
+            f"(primary: {args.replica_of})"
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            store.close()
+        return 0
     if args.data_dir:
         # Durable deployment: WAL-backed store + writer lease so a standby
         # replica can take over this data dir if we die (storage/durable.py)
@@ -421,6 +451,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="durable WAL+snapshot data directory (default: "
                         "in-memory store); replicas sharing it coordinate "
                         "via a writer lease")
+    s.add_argument("--replica-of", default="",
+                   help="run as a READ replica tailing --data-dir's WAL; "
+                        "writes get 503 pointing at this primary URL")
     s.set_defaults(fn=cmd_service)
 
     a = sub.add_parser("agent", help="run a worker agent")

@@ -1,0 +1,250 @@
+"""Read replicas: WAL-tailing processes serving the read surface.
+
+The reference scales horizontally because N app servers share one Mongo —
+any replica serves any request (reference environment.go:431-486). This
+framework's durable engine (storage/durable.py) has ONE active writer
+(storage/lease.py); read scaling comes from this module instead: a
+``ReplicaStore`` opens the same data directory read-only, replays
+``snapshot.json`` + ``wal.log``, then TAILS the WAL — every write the
+primary journals becomes visible here within one poll interval. The
+replica's collections reject writes (``ReplicaReadOnly``), and the REST
+layer maps that to 503 + the primary's URL so clients retry their
+mutation against the writer. Lag is bounded by the poll interval;
+consistency is per-document (the WAL is full-document puts in apply
+order).
+
+Checkpoint handling: the primary's compaction atomically replaces the
+snapshot then truncates the WAL in place. The replica detects the
+truncation (tail position beyond file size), reloads the fresh snapshot,
+and replays from offset 0 — full-document puts make any overlap
+idempotent. A torn final line (primary mid-append) leaves the tail
+position at the line start for the next poll.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, Iterable, Optional
+
+from .durable import SNAPSHOT_FILE, WAL_FILE
+from .store import Collection, Store
+
+
+class ReplicaReadOnly(RuntimeError):
+    """Raised on any write against a replica's collections."""
+
+    def __init__(self, primary_url: str = "") -> None:
+        super().__init__("store is a read-only replica")
+        self.primary_url = primary_url
+
+
+#: collections that are per-server scratch state, writable locally on a
+#: replica (never part of the replicated data set's contract): rate-limit
+#: windows are about THIS server's traffic
+LOCAL_SCRATCH_COLLECTIONS = frozenset({"rate_limits"})
+
+
+class _ReadOnlyCollection(Collection):
+    """Collection that only the replica's replay thread may write. The
+    permission is THREAD-LOCAL: a concurrent REST thread must get
+    ReplicaReadOnly even while the tail thread is mid-apply."""
+
+    def __init__(self, name: str, owner: "ReplicaStore") -> None:
+        super().__init__(name)
+        self._owner = owner
+
+    def _guard(self) -> None:
+        if not getattr(self._owner._applying, "on", False):
+            raise ReplicaReadOnly(self._owner.primary_url)
+
+    def insert(self, doc: dict) -> None:
+        self._guard()
+        super().insert(doc)
+
+    def upsert(self, doc: dict) -> None:
+        self._guard()
+        super().upsert(doc)
+
+    def insert_many(self, docs: Iterable[dict]) -> None:
+        self._guard()
+        super().insert_many(docs)
+
+    def remove(self, doc_id: str) -> bool:
+        self._guard()
+        return super().remove(doc_id)
+
+    def remove_where(self, pred: Callable[[dict], bool]) -> int:
+        self._guard()
+        return super().remove_where(pred)
+
+    def clear(self) -> None:
+        self._guard()
+        super().clear()
+
+    def compare_and_set(self, *a, **kw) -> bool:
+        self._guard()
+        return super().compare_and_set(*a, **kw)
+
+    def update(self, doc_id: str, update) -> bool:
+        self._guard()
+        return super().update(doc_id, update)
+
+    def update_where(self, *a, **kw) -> int:
+        self._guard()
+        return super().update_where(*a, **kw)
+
+    def mutate(self, doc_id: str, fn) -> bool:
+        self._guard()
+        return super().mutate(doc_id, fn)
+
+
+class ReplicaStore(Store):
+    def __init__(
+        self,
+        data_dir: str,
+        primary_url: str = "",
+        poll_interval_s: float = 0.5,
+    ) -> None:
+        super().__init__()
+        self.data_dir = data_dir
+        self.primary_url = primary_url
+        self.poll_interval_s = poll_interval_s
+        #: thread-local write permission; only replay code sets .on
+        self._applying = threading.local()
+        self._wal_pos = 0
+        #: identity of the snapshot we last loaded; a new checkpoint can
+        #: replace the snapshot while leaving the WAL at/below our tail
+        #: position (e.g. both empty), so truncation detection alone is
+        #: not enough
+        self._snap_stat: Optional[tuple] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._load_snapshot()
+        self.poll()
+
+    # -- Store interface ------------------------------------------------- #
+
+    def collection(self, name: str) -> Collection:
+        with self._lock:
+            coll = self._collections.get(name)
+            if coll is None:
+                if name in LOCAL_SCRATCH_COLLECTIONS:
+                    coll = Collection(name)  # per-server writable scratch
+                else:
+                    coll = _ReadOnlyCollection(name, self)
+                self._collections[name] = coll
+            return coll
+
+    # -- replication ----------------------------------------------------- #
+
+    def _snapshot_stat(self) -> Optional[tuple]:
+        try:
+            st = os.stat(os.path.join(self.data_dir, SNAPSHOT_FILE))
+        except FileNotFoundError:
+            return None
+        return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+    @staticmethod
+    def _replace_all(coll: Collection, docs) -> None:
+        """Swap a collection's contents in ONE lock hold so concurrent
+        readers see either the old or the new state, never an empty or
+        half-loaded one."""
+        with coll._lock:
+            coll._docs = {d["_id"]: d for d in docs}
+            coll._key_order_cache = None
+            coll._order_rank = 0
+
+    def _load_snapshot(self) -> None:
+        snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+        self._snap_stat = self._snapshot_stat()
+        snap = {"collections": {}}
+        if os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as fh:
+                snap = json.load(fh)
+        loaded = snap.get("collections", {})
+        with self._lock:
+            names = set(self._collections) | set(loaded)
+        for name in names:
+            if name in LOCAL_SCRATCH_COLLECTIONS:
+                continue  # per-server state is never reset by replication
+            self._replace_all(self.collection(name), loaded.get(name, []))
+        self._wal_pos = 0
+
+    def _apply(self, rec: dict) -> None:
+        coll = self.collection(rec["c"])
+        op = rec["o"]
+        if op == "p":
+            coll.upsert(rec["d"])
+        elif op == "pm":
+            for d in rec["ds"]:
+                coll.upsert(d)
+        elif op == "r":
+            coll.remove(rec["i"])
+        elif op == "x":
+            coll.clear()
+
+    def poll(self) -> int:
+        """Apply every WAL record appended since the last poll; returns
+        how many were applied. Handles the primary's checkpoint
+        truncation by reloading the snapshot and replaying from zero."""
+        wal_path = os.path.join(self.data_dir, WAL_FILE)
+        size = (
+            os.path.getsize(wal_path) if os.path.exists(wal_path) else 0
+        )
+        if size < self._wal_pos or self._snapshot_stat() != self._snap_stat:
+            # primary checkpointed: fresh snapshot (+ truncated WAL).
+            # Snapshot-rename happens BEFORE wal truncation, so reloading
+            # snapshot then replaying whatever WAL remains can only
+            # re-apply full-document puts — idempotent.
+            self._load_snapshot()
+            size = (
+                os.path.getsize(wal_path) if os.path.exists(wal_path) else 0
+            )
+        if size == self._wal_pos:
+            return 0
+        applied = 0
+        self._applying.on = True
+        try:
+            with open(wal_path, "rb") as fh:
+                fh.seek(self._wal_pos)
+                while True:
+                    line_start = fh.tell()
+                    line = fh.readline()
+                    if not line or not line.endswith(b"\n"):
+                        # torn tail (primary mid-append): retry next poll
+                        self._wal_pos = line_start
+                        break
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        self._wal_pos = line_start
+                        break
+                    self._apply(rec)
+                    applied += 1
+                    self._wal_pos = fh.tell()
+        finally:
+            self._applying.on = False
+        return applied
+
+    # -- background tail -------------------------------------------------- #
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll()
+            except OSError:
+                pass  # transient FS race with the primary's rotation
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

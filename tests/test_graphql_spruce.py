@@ -424,6 +424,61 @@ def test_bulk_schedule_and_restart_version(store):
     assert t.status == TaskStatus.UNDISPATCHED.value and t.execution == 1
 
 
+def test_restarted_task_ids_only_reports_actual_restarts(store):
+    seed_mainline(store, 1)
+    # t1-test is undispatched (not finished) — restart_task refuses it
+    gql = GraphQLApi(store)
+    data = gql_ok(
+        gql,
+        'mutation { restartVersion(versionId: "v1", failedOnly: false) '
+        '{ restartedTaskIds } }',
+    )
+    assert data["restartVersion"]["restartedTaskIds"] == ["t1-compile"]
+
+
+def test_task_logs_execution_never_mislabels(store):
+    """Asking for an archived execution must not serve the current
+    execution's lines under the old label."""
+    seed_mainline(store, 1)
+    store.collection("task_logs").upsert(
+        {"_id": "t1-compile", "lines": ["current-exec-line"]}
+    )
+    task_mod.coll(store).update("t1-compile", {"execution": 2})
+    gql = GraphQLApi(store)
+    data = gql_ok(gql, '{ taskLogs(taskId: "t1-compile", execution: 0) '
+                       '{ lines } }')
+    assert data["taskLogs"]["lines"] == []
+    data = gql_ok(gql, '{ taskLogs(taskId: "t1-compile", execution: 2) '
+                       '{ lines } }')
+    assert data["taskLogs"]["lines"] == ["current-exec-line"]
+    # a per-execution doc serves the archived lines
+    store.collection("task_logs").upsert(
+        {"_id": "t1-compile:0", "lines": ["old-exec-line"]}
+    )
+    data = gql_ok(gql, '{ taskLogs(taskId: "t1-compile", execution: 0) '
+                       '{ lines } }')
+    assert data["taskLogs"]["lines"] == ["old-exec-line"]
+
+
+def test_annotation_attribution_uses_authenticated_user(store):
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.models import user as user_mod
+
+    seed_mainline(store, 1)
+    u = user_mod.create_user(store, "carol")
+    api = RestApi(store, require_auth=True)
+    st, out = api.handle(
+        "POST", "/graphql",
+        {"query": 'mutation { addAnnotationIssue(taskId: "t1-compile", '
+                  'execution: 0, url: "https://j/E-1", issueKey: "E-1") '
+                  '{ issues { issue_key added_by } } }'},
+        headers={"api-key": u.api_key, "api-user": u.id},
+    )
+    assert st == 200, out
+    assert out["data"]["addAnnotationIssue"]["issues"][0]["added_by"] == (
+        "carol")
+
+
 def test_annotation_mutations_round_trip(store):
     seed_mainline(store, 1)
     gql = GraphQLApi(store)
