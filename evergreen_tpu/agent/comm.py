@@ -236,8 +236,12 @@ class LocalCommunicator(Communicator):
 
     def send_log(self, task_id: str, lines: List[str]) -> None:
         coll = self.store.collection("task_logs")
-        doc = coll.get(task_id)
-        if doc is None:
+
+        def extend(doc: dict) -> None:
+            doc["lines"] = doc["lines"] + list(lines)
+
+        # mutate() journals the write — in-place doc edits would bypass
+        # the WAL, so appended lines would vanish on restart and never
+        # reach read replicas
+        if not coll.mutate(task_id, extend):
             coll.upsert({"_id": task_id, "lines": list(lines)})
-        else:
-            doc["lines"].extend(lines)

@@ -498,6 +498,7 @@ class RestApi:
           self.delete_subscription)
         r("DELETE", r"/rest/v2/distros/(?P<distro>[^/]+)", self.delete_distro)
         r("DELETE", r"/rest/v2/volumes/(?P<volume>[^/]+)", self.delete_volume)
+        r("GET", r"/rest/v2/admin/log_lines", self.list_log_lines)
         r("GET", r"/rest/v2/stats/spans", self.list_spans)
         r("GET", r"/rest/v2/stats/hosts", self.host_stats)
         r("GET", r"/rest/v2/stats/system", self.system_stats)
@@ -675,11 +676,14 @@ class RestApi:
         coll = self.store.collection("task_logs")
         tid = match["task"]
         lines = [str(x) for x in body.get("lines", [])]
-        doc = coll.get(tid)
-        if doc is None:
+
+        def extend(doc: dict) -> None:
+            doc["lines"] = doc["lines"] + lines
+
+        # journaled append (see agent/comm.py send_log): in-place edits
+        # bypass the WAL → lost on restart, invisible to replicas
+        if not coll.mutate(tid, extend):
             coll.upsert({"_id": tid, "lines": lines})
-        else:
-            doc["lines"].extend(lines)
         return 200, {"ok": True}
 
     # -- tasks ----------------------------------------------------------- #
@@ -1569,6 +1573,20 @@ class RestApi:
         from ..utils.tracing import get_spans
 
         return 200, get_spans(self.store)[-200:]
+
+    def list_log_lines(self, method, match, body):
+        """Recent structured log records from the in-store ring
+        (utils/log.StoreSink) — operator debugging surface."""
+        from ..utils.log import StoreSink
+
+        coll = self.store.collection(StoreSink.COLLECTION)
+        docs = coll.find()
+        docs.sort(key=lambda d: d["_id"])
+        limit = int(body.get("limit", 200))
+        level = body.get("level", "")
+        if level:
+            docs = [d for d in docs if d.get("level") == level]
+        return 200, docs[-limit:]
 
     def system_stats(self, method, match, body):
         """Recent system samples (tasks by status, queue lengths/age, job

@@ -82,6 +82,12 @@ def cmd_service(args) -> int:
 
     for name, result in apply_migrations(store):
         print(f"migration {name}: {result}")
+    # structured logging plane: JSON lines on stderr + a capped in-store
+    # ring (reference grip senders; level from the logger_config section)
+    from .utils import log as log_mod
+
+    log_mod.reset_sinks(log_mod.json_line_sink, log_mod.StoreSink(store))
+    log_mod.configure(store)
     api = RestApi(
         store,
         require_auth=args.require_auth,

@@ -132,6 +132,14 @@ class JobQueue:
                 job.job_id,
                 {"type": job.job_type, "error": error[-2000:]},
             )
+            from ..utils.log import get_logger
+
+            get_logger("amboy").error(
+                "job failed",
+                job_id=job.job_id,
+                job_type=job.job_type,
+                error=error.strip().splitlines()[-1] if error else "",
+            )
         coll.update(
             job.job_id,
             {

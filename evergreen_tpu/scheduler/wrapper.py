@@ -470,6 +470,21 @@ def run_tick(
         worst_time_to_empty_distro=worst[0],
     ):
         pass
+    # the structured runtime-stats line operators grep for (reference
+    # grip message.Fields, scheduler/wrapper.go:93-128)
+    from ..utils.log import get_logger
+
+    get_logger("scheduler").info(
+        "runtime-stats",
+        operation="tick",
+        n_tasks=n_tasks,
+        n_distros=len(distros),
+        snapshot_ms=round(snapshot_ms, 2),
+        solve_ms=round(solve_ms, 2),
+        total_ms=round(total_ms, 2),
+        new_hosts=sum(new_hosts.values()),
+        worst_time_to_empty_s=worst[1],
+    )
     return TickResult(
         queues=queues,
         new_hosts=new_hosts,

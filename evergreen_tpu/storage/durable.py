@@ -50,6 +50,19 @@ class _Journal:
         self.path = path
         self.sync = sync  # "none" | "flush" | "fsync"
         self._lock = threading.Lock()
+        # Repair a torn tail BEFORE appending: a crash mid-append leaves
+        # an unterminated final line; appending straight onto it would
+        # merge two records into one terminated-but-corrupt line that
+        # readers cannot distinguish from data loss.
+        try:
+            if os.path.getsize(path) > 0:
+                with open(path, "rb") as fh:
+                    fh.seek(-1, os.SEEK_END)
+                    if fh.read(1) != b"\n":
+                        with open(path, "a", encoding="utf-8") as repair:
+                            repair.write("\n")
+        except FileNotFoundError:
+            pass
         self._fh = open(path, "a", encoding="utf-8")
         self.ops = 0
         self.suspended = False  # True during recovery replay
@@ -136,11 +149,15 @@ class DurableStore(Store):
             if os.path.exists(wal_path):
                 with open(wal_path, encoding="utf-8") as fh:
                     for line in fh:
+                        if not line.endswith("\n"):
+                            break  # torn final line from a crash mid-append
                         try:
                             rec = json.loads(line)
                         except json.JSONDecodeError:
-                            # torn final line from a crash mid-append
-                            break
+                            # terminated-but-unparseable (e.g. the newline-
+                            # repaired stub of a torn append): that ONE
+                            # record is lost; everything after it is intact
+                            continue
                         self._apply(rec)
         finally:
             self._journal.suspended = False

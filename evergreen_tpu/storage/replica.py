@@ -218,8 +218,16 @@ class ReplicaStore(Store):
                     try:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
-                        self._wal_pos = line_start
-                        break
+                        # a TERMINATED line that doesn't parse can never
+                        # become valid — skipping it loses one record but
+                        # halting here would stall replication forever
+                        self._wal_pos = fh.tell()
+                        continue
+                    if rec.get("c") in LOCAL_SCRATCH_COLLECTIONS:
+                        # the primary's per-server scratch (rate-limit
+                        # windows) must not clobber this replica's own
+                        self._wal_pos = fh.tell()
+                        continue
                     self._apply(rec)
                     applied += 1
                     self._wal_pos = fh.tell()

@@ -102,6 +102,17 @@ def restart_task(
             "host_id": doc.get("host_id", ""),
         }
     )
+    # rotate the flat log doc to its per-execution archive so the new
+    # execution starts clean and old logs stay queryable
+    # (graphql taskLogs(execution:) reads "{taskId}:{execution}")
+    log_coll = store.collection("task_logs")
+    log_doc = log_coll.get(task_id)
+    if log_doc is not None:
+        log_coll.upsert(
+            {"_id": f"{task_id}:{doc['execution']}",
+             "lines": list(log_doc.get("lines", []))}
+        )
+        log_coll.remove(task_id)
 
     # reset dependency edges that pointed at this task on dependents
     def reset_dep_edges(dep_doc: dict) -> None:

@@ -1,0 +1,174 @@
+"""Structured logging plane: leveled field-based records through
+pluggable, buffered sinks.
+
+Reference: grip — every component logs ``message.Fields`` documents with
+``runner``/``operation`` keys (e.g. the scheduler's runtime-stats lines,
+scheduler/wrapper.go:93-128, and the distro-scheduler-report blob,
+units/host_allocator.go:336-362), buffered senders flush on count or
+interval (the Splunk/Slack senders), and levels gate what ships. Here:
+
+- ``Logger(component)`` emits ``{ts, level, component, message, **fields}``
+  records;
+- sinks are callables registered via ``add_sink``; the default writes
+  JSON lines to stderr, ``StoreSink`` keeps a capped ring in the store
+  (served at /rest/v2/admin/log_lines for debugging), ``BufferedSink``
+  wraps any sink with count/age flushing per the logger_config section;
+- ``configure(store)`` applies the admin-editable section
+  (settings.LoggerConfig: default_level, buffer knobs).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time as _time
+from typing import Any, Callable, Dict, List, Optional
+
+LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+Sink = Callable[[dict], None]
+
+_lock = threading.Lock()
+_sinks: List[Sink] = []
+_threshold = LEVELS["info"]
+
+
+def set_level(level: str) -> None:
+    global _threshold
+    _threshold = LEVELS.get(level, LEVELS["info"])
+
+
+def add_sink(sink: Sink) -> None:
+    with _lock:
+        _sinks.append(sink)
+
+
+def remove_sink(sink: Sink) -> None:
+    with _lock:
+        if sink in _sinks:
+            _sinks.remove(sink)
+
+
+def reset_sinks(*sinks: Sink) -> None:
+    """Replace all sinks (tests; service wiring)."""
+    with _lock:
+        _sinks.clear()
+        _sinks.extend(sinks)
+
+
+def json_line_sink(record: dict) -> None:
+    sys.stderr.write(
+        json.dumps(record, separators=(",", ":"), default=str) + "\n"
+    )
+
+
+class BufferedSink:
+    """Flush-on-count-or-age wrapper (reference grip's buffered senders;
+    knobs from LoggerConfig.buffer_count / buffer_interval_seconds)."""
+
+    def __init__(self, inner: Callable[[List[dict]], None],
+                 count: int = 100, interval_s: float = 20.0) -> None:
+        self.inner = inner
+        self.count = count
+        self.interval_s = interval_s
+        self._buf: List[dict] = []
+        self._last_flush = _time.time()
+        self._lock = threading.Lock()
+
+    def __call__(self, record: dict) -> None:
+        flush_now: Optional[List[dict]] = None
+        with self._lock:
+            self._buf.append(record)
+            if (
+                len(self._buf) >= self.count
+                or _time.time() - self._last_flush >= self.interval_s
+            ):
+                flush_now = self._buf
+                self._buf = []
+                self._last_flush = _time.time()
+        if flush_now:
+            self.inner(flush_now)
+
+    def flush(self) -> None:
+        with self._lock:
+            out, self._buf = self._buf, []
+            self._last_flush = _time.time()
+        if out:
+            self.inner(out)
+
+
+class StoreSink:
+    """Capped ring of recent log records in the store — the analog of the
+    reference's stats-log collections, inspectable over the admin API."""
+
+    COLLECTION = "log_lines"
+
+    def __init__(self, store, cap: int = 2000) -> None:
+        self.store = store
+        self.cap = cap
+        # resume after the highest surviving id — with a durable store a
+        # fresh process must not overwrite or reorder prior records
+        existing = store.collection(self.COLLECTION).key_order()
+        self._seq = max(
+            (int(k.rsplit("-", 1)[1]) for k in existing), default=0
+        )
+        self._lock = threading.Lock()
+
+    def __call__(self, record: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        coll = self.store.collection(self.COLLECTION)
+        coll.upsert({"_id": f"log-{seq:012d}", **record})
+        if seq % 256 == 0:  # amortized trim
+            ids = sorted(coll.key_order())
+            for doc_id in ids[: max(0, len(ids) - self.cap)]:
+                coll.remove(doc_id)
+
+
+def configure(store) -> None:
+    """Apply the runtime-editable logger_config section."""
+    from ..settings import LoggerConfig
+
+    cfg = LoggerConfig.get(store)
+    set_level(cfg.default_level)
+
+
+class Logger:
+    def __init__(self, component: str) -> None:
+        self.component = component
+
+    def _emit(self, level: str, message: str, fields: Dict[str, Any]) -> None:
+        if LEVELS[level] < _threshold:
+            return
+        record = {
+            "ts": _time.time(),
+            "level": level,
+            "component": self.component,
+            "message": message,
+            **fields,
+        }
+        with _lock:
+            sinks = list(_sinks)
+        for sink in sinks:
+            try:
+                sink(record)
+            except Exception:
+                # a broken sink must never take down the caller
+                pass
+
+    def debug(self, message: str, **fields: Any) -> None:
+        self._emit("debug", message, fields)
+
+    def info(self, message: str, **fields: Any) -> None:
+        self._emit("info", message, fields)
+
+    def warning(self, message: str, **fields: Any) -> None:
+        self._emit("warning", message, fields)
+
+    def error(self, message: str, **fields: Any) -> None:
+        self._emit("error", message, fields)
+
+
+def get_logger(component: str) -> Logger:
+    return Logger(component)

@@ -460,6 +460,30 @@ def test_task_logs_execution_never_mislabels(store):
     assert data["taskLogs"]["lines"] == ["old-exec-line"]
 
 
+def test_restart_rotates_logs_to_archived_execution(store):
+    """restart_task rotates the flat log doc into the per-execution
+    archive, so old logs stay queryable and the new execution starts
+    clean."""
+    from evergreen_tpu.units.task_jobs import restart_task
+
+    seed_mainline(store, 1)
+    task_mod.coll(store).update(
+        "t1-compile",
+        {"status": TaskStatus.FAILED.value, "finish_time": 50.0},
+    )
+    store.collection("task_logs").upsert(
+        {"_id": "t1-compile", "lines": ["exec0-line"]}
+    )
+    assert restart_task(store, "t1-compile")
+    gql = GraphQLApi(store)
+    data = gql_ok(gql, '{ taskLogs(taskId: "t1-compile", execution: 0) '
+                       '{ lines } }')
+    assert data["taskLogs"]["lines"] == ["exec0-line"]
+    data = gql_ok(gql, '{ taskLogs(taskId: "t1-compile", execution: 1) '
+                       '{ lines } }')
+    assert data["taskLogs"]["lines"] == []
+
+
 def test_annotation_attribution_uses_authenticated_user(store):
     from evergreen_tpu.api.rest import RestApi
     from evergreen_tpu.models import user as user_mod

@@ -204,6 +204,76 @@ def test_rate_limited_replica_serves_reads(tmp_path):
     assert 429 in sts
 
 
+def test_replica_skips_primary_rate_limit_records(tmp_path):
+    """The primary journals its rate_limits writes; a replica must not
+    let them clobber its own per-server windows."""
+    primary = DurableStore(str(tmp_path))
+    replica = ReplicaStore(str(tmp_path))
+    replica.collection("rate_limits").upsert({"_id": "u:1", "n": 7})
+    primary.collection("rate_limits").upsert({"_id": "u:1", "n": 1})
+    primary.collection("tasks").insert({"_id": "t1"})
+    replica.poll()
+    assert replica.collection("rate_limits").get("u:1")["n"] == 7
+    assert replica.collection("tasks").get("t1") is not None
+
+
+def test_corrupt_terminated_wal_line_does_not_stall_replication(tmp_path):
+    """A terminated-but-unparseable line (merged torn append) loses that
+    one record, never everything after it — on the replica AND on
+    primary recovery."""
+    primary = DurableStore(str(tmp_path))
+    primary.collection("tasks").insert({"_id": "t1"})
+    wal = os.path.join(str(tmp_path), "wal.log")
+    with open(wal, "a", encoding="utf-8") as fh:
+        fh.write('{"c": "tasks", "o": "p", "d": {"_id": "GARBAGE\n')
+    primary.collection("tasks").insert({"_id": "t2"})
+    replica = ReplicaStore(str(tmp_path))
+    assert replica.collection("tasks").get("t1") is not None
+    assert replica.collection("tasks").get("t2") is not None
+    # primary recovery tolerates it the same way
+    recovered = DurableStore(str(tmp_path))
+    assert recovered.collection("tasks").get("t2") is not None
+
+
+def test_journal_repairs_torn_tail_on_open(tmp_path):
+    """A crash mid-append leaves an unterminated line; the next writer
+    terminates it before appending so records never merge."""
+    primary = DurableStore(str(tmp_path))
+    primary.collection("tasks").insert({"_id": "t1"})
+    primary._journal.close()
+    wal = os.path.join(str(tmp_path), "wal.log")
+    with open(wal, "a", encoding="utf-8") as fh:
+        fh.write('{"c": "tasks", "o": "p", "d": {"_id": "half')  # no \n
+    # a fresh writer repairs the tail, then appends cleanly
+    writer2 = DurableStore(str(tmp_path))
+    writer2.collection("tasks").insert({"_id": "t2"})
+    replica = ReplicaStore(str(tmp_path))
+    assert replica.collection("tasks").get("t1") is not None
+    assert replica.collection("tasks").get("t2") is not None
+    assert replica.collection("tasks").get("half") is None
+
+
+def test_task_log_appends_reach_replicas(tmp_path):
+    """Log appends must be journaled writes (the in-place extend bug made
+    them invisible to replicas and lost on restart)."""
+    from evergreen_tpu.api.rest import RestApi as _Api
+
+    primary = DurableStore(str(tmp_path))
+    api = _Api(primary)
+    primary.collection("tasks").insert(
+        {"_id": "t1", "status": "started", "execution": 0}
+    )
+    api.handle("POST", "/rest/v2/tasks/t1/agent/logs", {"lines": ["one"]})
+    api.handle("POST", "/rest/v2/tasks/t1/agent/logs", {"lines": ["two"]})
+    replica = ReplicaStore(str(tmp_path))
+    assert replica.collection("task_logs").get("t1")["lines"] == [
+        "one", "two"]
+    # and a primary restart keeps them
+    recovered = DurableStore(str(tmp_path))
+    assert recovered.collection("task_logs").get("t1")["lines"] == [
+        "one", "two"]
+
+
 def test_replica_tolerates_torn_tail(tmp_path):
     primary = DurableStore(str(tmp_path))
     primary.collection("tasks").insert({"_id": "t1"})

@@ -1,0 +1,174 @@
+"""Structured logging plane (utils/log.py): leveled field records,
+buffered sinks, in-store ring, config-section wiring, and the
+runtime-stats line the scheduler tick emits. Reference analog: grip
+message.Fields logging with buffered senders (scheduler/wrapper.go:93-128
+runtime-stats; config_logger.go knobs).
+"""
+import pytest
+
+from evergreen_tpu.api.rest import RestApi
+from evergreen_tpu.settings import LoggerConfig
+from evergreen_tpu.utils import log as log_mod
+from evergreen_tpu.utils.log import (
+    BufferedSink,
+    Logger,
+    StoreSink,
+    add_sink,
+    configure,
+    reset_sinks,
+    set_level,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_log_state():
+    yield
+    reset_sinks()
+    set_level("info")
+
+
+def test_logger_emits_field_records():
+    got = []
+    reset_sinks(got.append)
+    log = Logger("scheduler")
+    log.info("runtime-stats", operation="tick", n_tasks=5)
+    (rec,) = got
+    assert rec["component"] == "scheduler"
+    assert rec["level"] == "info"
+    assert rec["message"] == "runtime-stats"
+    assert rec["operation"] == "tick" and rec["n_tasks"] == 5
+    assert rec["ts"] > 0
+
+
+def test_level_threshold_and_config(store):
+    got = []
+    reset_sinks(got.append)
+    log = Logger("c")
+    log.debug("hidden")
+    assert got == []
+    cfg = LoggerConfig.get(store)
+    cfg.default_level = "debug"
+    cfg.set(store)
+    configure(store)
+    log.debug("visible")
+    assert [r["message"] for r in got] == ["visible"]
+    cfg.default_level = "error"
+    cfg.set(store)
+    configure(store)
+    log.warning("suppressed")
+    log.error("boom")
+    assert [r["message"] for r in got] == ["visible", "boom"]
+
+
+def test_broken_sink_never_breaks_caller():
+    got = []
+
+    def bad(rec):
+        raise RuntimeError("sink down")
+
+    reset_sinks(bad, got.append)
+    Logger("c").info("still delivered")
+    assert [r["message"] for r in got] == ["still delivered"]
+
+
+def test_buffered_sink_flushes_on_count_and_age():
+    batches = []
+    sink = BufferedSink(batches.append, count=3, interval_s=9999)
+    log = Logger("c")
+    reset_sinks(sink)
+    log.info("a")
+    log.info("b")
+    assert batches == []
+    log.info("c")
+    assert len(batches) == 1 and len(batches[0]) == 3
+    # age-based flush
+    sink2 = BufferedSink(batches.append, count=1000, interval_s=0.0)
+    reset_sinks(sink2)
+    log.info("d")
+    assert len(batches) == 2
+    # manual flush drains the remainder
+    sink3 = BufferedSink(batches.append, count=1000, interval_s=9999)
+    reset_sinks(sink3)
+    log.info("e")
+    sink3.flush()
+    assert [r["message"] for r in batches[-1]] == ["e"]
+
+
+def test_store_sink_ring_and_admin_route(store):
+    sink = StoreSink(store, cap=50)
+    reset_sinks(sink)
+    log = Logger("scheduler")
+    for i in range(300):
+        log.info("line", n=i)
+    coll = store.collection(StoreSink.COLLECTION)
+    assert len(coll) <= 50 + 256  # cap plus one amortized-trim window
+    api = RestApi(store)
+    st, out = api.handle("GET", "/rest/v2/admin/log_lines", {"limit": 10})
+    assert st == 200 and len(out) == 10
+    assert out[-1]["n"] == 299  # newest last
+    st, out = api.handle("GET", "/rest/v2/admin/log_lines",
+                         {"level": "error"})
+    assert st == 200 and out == []
+
+
+def test_store_sink_resumes_seq_after_restart(tmp_path):
+    """With a durable store, a fresh process's sink must continue after
+    the surviving ids, never overwrite or reorder them."""
+    from evergreen_tpu.storage.durable import DurableStore
+
+    store = DurableStore(str(tmp_path))
+    sink = StoreSink(store, cap=100)
+    reset_sinks(sink)
+    log = Logger("c")
+    log.info("before-restart")
+    store.close()
+    store2 = DurableStore(str(tmp_path))
+    sink2 = StoreSink(store2, cap=100)
+    reset_sinks(sink2)
+    log.info("after-restart")
+    docs = store2.collection(StoreSink.COLLECTION).find()
+    docs.sort(key=lambda d: d["_id"])
+    assert [d["message"] for d in docs] == ["before-restart",
+                                           "after-restart"]
+
+
+def test_tick_emits_runtime_stats_line(store):
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+    got = []
+    reset_sinks(got.append)
+    distro_mod.insert(store, Distro(id="d1"))
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status="undispatched", activated=True,
+             expected_duration_s=60),
+    )
+    run_tick(store, TickOptions(create_intent_hosts=False))
+    stats = [r for r in got if r["message"] == "runtime-stats"]
+    assert stats, got
+    rec = stats[-1]
+    assert rec["component"] == "scheduler"
+    assert rec["n_tasks"] == 1 and rec["n_distros"] == 1
+    assert rec["total_ms"] > 0
+
+
+def test_job_failure_logs_error_line(store):
+    from evergreen_tpu.queue.jobs import FnJob, JobQueue
+
+    got = []
+    reset_sinks(got.append)
+
+    def boom(s):
+        raise ValueError("job exploded")
+
+    q = JobQueue(store, workers=1)
+    q.put(FnJob("j1", boom, job_type="test-job"))
+    q.wait_idle()
+    q.close()
+    errs = [r for r in got if r["level"] == "error"]
+    assert errs and errs[0]["job_type"] == "test-job"
+    assert "job exploded" in errs[0]["error"]
