@@ -453,8 +453,11 @@ class RestApi:
 
         # patches
         r("POST", r"/rest/v2/patches", self.create_patch)
+        r("GET", r"/rest/v2/patches", self.list_patches)
         r("GET", r"/rest/v2/patches/(?P<patch>[^/]+)", self.get_patch)
         r("POST", r"/rest/v2/patches/(?P<patch>[^/]+)/finalize", self.finalize)
+        r("POST", r"/rest/v2/patches/(?P<patch>[^/]+)/cancel",
+          self.cancel_patch)
 
         # task output + annotations (reference rest/route/annotations.go,
         # artifact_sign.go, test results routes)
@@ -1245,6 +1248,35 @@ class RestApi:
             raise ApiError(409, "patch cannot be finalized")
         return 200, {"version_id": created.version.id,
                      "n_tasks": len(created.tasks)}
+
+    def list_patches(self, method, match, body):
+        """Recent patches, newest first, SUMMARY shape only — full docs
+        carry multi-MB diffs and config YAML (reference patch_list.go
+        projects the same summary)."""
+        project = body.get("project", "")
+        docs = self.store.collection("patches").find(
+            (lambda d: d["project"] == project) if project else None
+        )
+        docs.sort(key=lambda d: d.get("create_time", 0.0), reverse=True)
+        return 200, [
+            {
+                "_id": d["_id"],
+                "project": d.get("project", ""),
+                "author": d.get("author", ""),
+                "description": d.get("description", ""),
+                "status": d.get("status", ""),
+                "version": d.get("version", ""),
+                "create_time": d.get("create_time", 0.0),
+                "activated": d.get("activated", False),
+            }
+            for d in docs[: int(body.get("limit", 50))]
+        ]
+
+    def cancel_patch(self, method, match, body):
+        ok = patch_mod.cancel_patch(self.store, match["patch"])
+        if not ok:
+            raise ApiError(404, "patch not found")
+        return 200, {"ok": True}
 
     # -- admin ------------------------------------------------------------- #
 

@@ -193,6 +193,126 @@ def cmd_validate(args) -> int:
     return 0
 
 
+def cmd_list(args) -> int:
+    """List tasks / variants / distros / aliases / projects (reference
+    operations/list.go). Project structure comes from a local file
+    (--file) with matrix axes expanded; distros/projects from the
+    server."""
+    if args.file:
+        from .ingestion.matrix import expand_matrices
+        from .ingestion.parser import parse_project
+
+        pp = parse_project(open(args.file).read())
+        expand_matrices(pp)
+        if args.tasks:
+            for t in pp.tasks:
+                print(t.name)
+        elif args.variants:
+            for bv in pp.buildvariants:
+                print(f"{bv.name}\t{bv.display_name or bv.name}")
+        elif args.task_groups:
+            for g in pp.task_groups:
+                print(f"{g.name}\t(max_hosts={g.max_hosts})")
+        else:
+            print("choose one of --tasks/--variants/--task-groups "
+                  "with --file", file=sys.stderr)
+            return 2
+        return 0
+    call = _client(args)
+    if args.distros or args.projects:
+        path = "/rest/v2/distros" if args.distros else "/rest/v2/projects"
+        out = call("GET", path)
+        if not isinstance(out, list):  # auth/replica/error body
+            print(json.dumps(out), file=sys.stderr)
+            return 1
+        for d in out:
+            print(d["_id"])
+        return 0
+    print("need --file or one of --distros/--projects", file=sys.stderr)
+    return 2
+
+
+def cmd_evaluate(args) -> int:
+    """Render the fully-parsed project — matrices expanded, tags intact
+    (reference operations/evaluate.go)."""
+    import dataclasses as _dc
+
+    from .ingestion.matrix import expand_matrices
+    from .ingestion.parser import parse_project
+
+    pp = parse_project(open(args.file).read())
+    expand_matrices(pp)
+    doc = _dc.asdict(pp)
+    if args.tasks:
+        doc = {"tasks": doc["tasks"]}
+    elif args.variants:
+        doc = {"buildvariants": doc["buildvariants"]}
+    import yaml as _yaml
+
+    print(_yaml.safe_dump(doc, sort_keys=False, default_flow_style=False))
+    return 0
+
+
+def cmd_patch_list(args) -> int:
+    """List recent patches (reference operations/patch_list.go)."""
+    from urllib.parse import quote
+
+    call = _client(args)
+    path = "/rest/v2/patches"
+    if args.project:
+        path += f"?project={quote(args.project)}"
+    out = call("GET", path)
+    if not isinstance(out, list):
+        print(json.dumps(out), file=sys.stderr)
+        return 1
+    for p in out:
+        status = p.get("status", "")
+        print(f"{p['_id']}\t{p.get('project', '')}\t{status}"
+              f"\t{p.get('description', '')[:60]}")
+    return 0
+
+
+def cmd_patch_cancel(args) -> int:
+    """Cancel a patch: abort its in-flight tasks and deactivate the rest
+    (reference operations/patch_cancel.go)."""
+    call = _client(args)
+    out = call("POST", f"/rest/v2/patches/{args.patch_id}/cancel")
+    print(json.dumps(out, indent=2))
+    return 1 if isinstance(out, dict) and "error" in out else 0
+
+
+def cmd_patch_finalize(args) -> int:
+    """Finalize an unfinalized patch into a runnable version (reference
+    operations/patch_finalize.go)."""
+    call = _client(args)
+    out = call("POST", f"/rest/v2/patches/{args.patch_id}/finalize")
+    print(json.dumps(out, indent=2))
+    return 1 if isinstance(out, dict) and "error" in out else 0
+
+
+def cmd_login(args) -> int:
+    """Password login against the service; prints the session token
+    (reference operations/login.go against the naive manager)."""
+    import getpass
+
+    call = _client(args)
+    password = args.password or getpass.getpass("password: ")
+    out = call("POST", "/login",
+               {"username": args.username, "password": password})
+    if "token" in out:
+        print(out["token"])
+        return 0
+    print(json.dumps(out), file=sys.stderr)
+    return 1
+
+
+def cmd_version(args) -> int:
+    from . import __version__
+
+    print(f"evergreen-tpu {__version__}")
+    return 0
+
+
 def _client(args):
     import urllib.error
     import urllib.request
@@ -500,6 +620,47 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--api-server", default="http://127.0.0.1:9090")
     pa.set_defaults(fn=cmd_patch)
 
+    li = sub.add_parser("list", help="list tasks/variants/distros/projects")
+    li.add_argument("--file", default="", help="local project file")
+    li.add_argument("--tasks", action="store_true")
+    li.add_argument("--variants", action="store_true")
+    li.add_argument("--task-groups", action="store_true", dest="task_groups")
+    li.add_argument("--distros", action="store_true")
+    li.add_argument("--projects", action="store_true")
+    li.add_argument("--api-server", default="http://127.0.0.1:9090")
+    li.set_defaults(fn=cmd_list)
+
+    ev = sub.add_parser("evaluate",
+                        help="render the parsed project (matrices expanded)")
+    ev.add_argument("file")
+    ev.add_argument("--tasks", action="store_true")
+    ev.add_argument("--variants", action="store_true")
+    ev.set_defaults(fn=cmd_evaluate)
+
+    pl = sub.add_parser("patch-list", help="list recent patches")
+    pl.add_argument("--project", default="")
+    pl.add_argument("--api-server", default="http://127.0.0.1:9090")
+    pl.set_defaults(fn=cmd_patch_list)
+
+    pc = sub.add_parser("patch-cancel", help="cancel a patch")
+    pc.add_argument("patch_id")
+    pc.add_argument("--api-server", default="http://127.0.0.1:9090")
+    pc.set_defaults(fn=cmd_patch_cancel)
+
+    pf = sub.add_parser("patch-finalize", help="finalize a patch")
+    pf.add_argument("patch_id")
+    pf.add_argument("--api-server", default="http://127.0.0.1:9090")
+    pf.set_defaults(fn=cmd_patch_finalize)
+
+    lo = sub.add_parser("login", help="password login; prints session token")
+    lo.add_argument("--username", required=True)
+    lo.add_argument("--password", default="")
+    lo.add_argument("--api-server", default="http://127.0.0.1:9090")
+    lo.set_defaults(fn=cmd_login)
+
+    ve = sub.add_parser("version", help="print the version")
+    ve.set_defaults(fn=cmd_version)
+
     ho = sub.add_parser("host", help="spawn-host lifecycle")
     ho.add_argument("action",
                     choices=["spawn", "list", "start", "stop", "terminate",
@@ -576,7 +737,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .utils.jaxenv import ensure_usable_backend
 
         ensure_usable_backend()
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `evergreen ... | head` closing the pipe is not an error; keep
+        # the interpreter's shutdown flush from re-raising on stdout
+        import os as _os
+
+        _os.dup2(_os.open(_os.devnull, _os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

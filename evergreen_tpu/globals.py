@@ -99,6 +99,7 @@ class PatchStatus(str, enum.Enum):
     STARTED = "started"
     SUCCEEDED = "success"
     FAILED = "failed"
+    CANCELLED = "cancelled"
 
 
 # --------------------------------------------------------------------------- #

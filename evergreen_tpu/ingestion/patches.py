@@ -85,6 +85,36 @@ def get_patch(store: Store, patch_id: str) -> Optional[Patch]:
     return Patch.from_doc(doc) if doc else None
 
 
+def cancel_patch(
+    store: Store, patch_id: str, now: Optional[float] = None
+) -> bool:
+    """Cancel a patch (reference operations/patch_cancel.go →
+    model.CancelPatch): abort its in-flight tasks, deactivate the
+    undispatched ones, and mark the patch cancelled. An unfinalized
+    patch just flips status."""
+    now = _time.time() if now is None else now
+    p = get_patch(store, patch_id)
+    if p is None:
+        return False
+    if p.version:
+        from ..globals import TASK_IN_PROGRESS_STATUSES, TaskStatus
+        from ..models import task as task_mod
+        from ..units.task_jobs import abort_task
+
+        for t in task_mod.find(
+            store, lambda d: d["version"] == p.version
+        ):
+            if t.status in TASK_IN_PROGRESS_STATUSES:
+                abort_task(store, t.id, by="patch-cancel", now=now)
+            elif t.status == TaskStatus.UNDISPATCHED.value and t.activated:
+                task_mod.coll(store).update(t.id, {"activated": False})
+    store.collection(PATCHES_COLLECTION).update(
+        patch_id, {"status": PatchStatus.CANCELLED.value,
+                   "finish_time": now}
+    )
+    return True
+
+
 def finalize_patch(
     store: Store, patch_id: str, now: Optional[float] = None
 ) -> Optional[CreatedVersion]:
@@ -95,6 +125,9 @@ def finalize_patch(
     now = _time.time() if now is None else now
     p = get_patch(store, patch_id)
     if p is None or p.version:
+        return None
+    if p.status == PatchStatus.CANCELLED.value:
+        # finalizing must not resurrect a cancelled patch
         return None
     ref = get_project_ref(store, p.project)
     if ref is None or ref.patching_disabled:

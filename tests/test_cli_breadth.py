@@ -1,0 +1,168 @@
+"""CLI breadth: list/evaluate/patch-list/patch-cancel/patch-finalize/
+login/version (reference operations/list.go, evaluate.go,
+patch_list.go, patch_cancel.go, patch_finalize.go, login.go).
+Server-backed commands run against a live HTTP service.
+"""
+import json
+import threading
+
+import pytest
+
+from evergreen_tpu.api.rest import RestApi
+from evergreen_tpu.cli import main as cli_main
+from evergreen_tpu.globals import PatchStatus, TaskStatus
+from evergreen_tpu.ingestion.patches import Patch, get_patch, insert_patch
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.storage.store import set_global_store
+
+YML = """
+tasks:
+  - name: compile
+    commands: [{command: shell.exec, params: {script: "true"}}]
+  - name: lint
+    commands: [{command: shell.exec, params: {script: "true"}}]
+task_groups:
+  - name: tg1
+    max_hosts: 2
+    tasks: [compile, lint]
+buildvariants:
+  - name: bv1
+    display_name: Linux
+    run_on: [d1]
+    tasks: [compile, lint]
+"""
+
+
+@pytest.fixture()
+def server(store):
+    set_global_store(store)
+    api = RestApi(store)
+    srv = api.serve(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", store
+    srv.shutdown()
+
+
+def run_cli(capsys, *argv):
+    rc = cli_main(list(argv))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_list_and_evaluate_local_file(tmp_path, capsys):
+    f = tmp_path / "evergreen.yml"
+    f.write_text(YML)
+    rc, out = run_cli(capsys, "list", "--file", str(f), "--tasks")
+    assert rc == 0 and out.splitlines() == ["compile", "lint"]
+    rc, out = run_cli(capsys, "list", "--file", str(f), "--variants")
+    assert rc == 0 and "bv1\tLinux" in out
+    rc, out = run_cli(capsys, "list", "--file", str(f), "--task-groups")
+    assert rc == 0 and "tg1\t(max_hosts=2)" in out
+    rc, out = run_cli(capsys, "evaluate", str(f), "--tasks")
+    assert rc == 0 and "compile" in out and "buildvariants" not in out
+    rc, out = run_cli(capsys, "evaluate", str(f))
+    assert rc == 0 and "buildvariants" in out
+
+
+def test_list_distros_and_projects_via_server(server, capsys):
+    base, store = server
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models import distro as distro_mod
+
+    distro_mod.insert(store, Distro(id="d-cli"))
+    store.collection("project_refs").upsert({"_id": "proj-cli"})
+    rc, out = run_cli(capsys, "list", "--distros", "--api-server", base)
+    assert rc == 0 and "d-cli" in out
+    rc, out = run_cli(capsys, "list", "--projects", "--api-server", base)
+    assert rc == 0 and "proj-cli" in out
+
+
+def test_patch_list_finalize_cancel_flow(server, capsys):
+    base, store = server
+    store.collection("project_refs").upsert(
+        {"_id": "p", "enabled": True, "patching_disabled": False}
+    )
+    insert_patch(store, Patch(id="pa-1", project="p", config_yaml=YML,
+                              variants=["*"], tasks=["*"],
+                              description="try things"))
+    rc, out = run_cli(capsys, "patch-list", "--api-server", base)
+    assert rc == 0 and "pa-1" in out and "try things" in out
+    rc, out = run_cli(capsys, "patch-finalize", "pa-1",
+                      "--api-server", base)
+    assert rc == 0
+    version_id = get_patch(store, "pa-1").version
+    assert version_id
+    # one task started, one undispatched → cancel aborts + deactivates
+    tasks = task_mod.find(store, lambda d: d["version"] == version_id)
+    task_mod.coll(store).update(
+        tasks[0].id, {"status": TaskStatus.STARTED.value}
+    )
+    rc, out = run_cli(capsys, "patch-cancel", "pa-1", "--api-server", base)
+    assert rc == 0
+    p = get_patch(store, "pa-1")
+    assert p.status == PatchStatus.CANCELLED.value
+    aborted = task_mod.get(store, tasks[0].id)
+    assert aborted.aborted
+    other = task_mod.get(store, tasks[1].id)
+    assert not other.activated
+
+
+def test_cancelled_patch_cannot_be_finalized(server, capsys):
+    base, store = server
+    store.collection("project_refs").upsert(
+        {"_id": "p", "enabled": True, "patching_disabled": False}
+    )
+    insert_patch(store, Patch(id="pa-c", project="p", config_yaml=YML,
+                              variants=["*"], tasks=["*"]))
+    rc, _ = run_cli(capsys, "patch-cancel", "pa-c", "--api-server", base)
+    assert rc == 0
+    rc, _ = run_cli(capsys, "patch-finalize", "pa-c", "--api-server", base)
+    assert rc == 1  # finalize refuses; exit code reflects it
+    p = get_patch(store, "pa-c")
+    assert p.status == PatchStatus.CANCELLED.value and not p.version
+
+
+def test_cli_error_bodies_exit_nonzero(server, capsys):
+    base, store = server
+    rc, _ = run_cli(capsys, "patch-cancel", "no-such", "--api-server", base)
+    assert rc == 1
+    # auth-required server: list prints the error and exits 1, no traceback
+    auth_api = RestApi(store, require_auth=True)
+    srv = auth_api.serve(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        auth_base = f"http://127.0.0.1:{srv.server_address[1]}"
+        rc, out = run_cli(capsys, "list", "--distros",
+                          "--api-server", auth_base)
+        assert rc == 1
+    finally:
+        srv.shutdown()
+
+
+def test_patch_list_is_summary_shape(server, capsys):
+    base, store = server
+    insert_patch(store, Patch(id="pa-big", project="p", config_yaml=YML,
+                              diff="x" * 100_000))
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base}/rest/v2/patches") as r:
+        payload = r.read()
+    assert len(payload) < 10_000  # diff/config never ship in listings
+    docs = json.loads(payload)
+    assert docs[0]["_id"] == "pa-big"
+    assert "diff" not in docs[0] and "config_yaml" not in docs[0]
+
+
+def test_login_and_version(server, capsys):
+    base, store = server
+    from evergreen_tpu.settings import AuthConfig
+
+    cfg = AuthConfig.get(store)
+    cfg.preferred_type = "naive"
+    cfg.naive_users = [{"username": "dev", "password": "pw"}]
+    cfg.set(store)
+    rc, out = run_cli(capsys, "login", "--username", "dev",
+                      "--password", "pw", "--api-server", base)
+    assert rc == 0 and len(out.strip()) == 48  # session token hex
+    rc, out = run_cli(capsys, "version")
+    assert rc == 0 and out.startswith("evergreen-tpu ")
