@@ -352,8 +352,29 @@ class RestApi:
 
                 for k, vs in parse_qs(qs, keep_blank_values=True).items():
                     body.setdefault(k, vs[-1])
-            status, payload = self.handle(method, path.split("?")[0], body,
-                                          headers)
+            t0 = _time.perf_counter()
+            try:
+                status, payload = self.handle(
+                    method, path.split("?")[0], body, headers
+                )
+            except Exception:
+                # a handler bug becomes a clean JSON 500 — and an access
+                # record, since 5xx is exactly what sampling must catch
+                import traceback as _tb
+
+                from ..utils.log import get_logger
+
+                get_logger("api").error(
+                    "unhandled handler exception",
+                    method=method,
+                    path=path.split("?")[0],
+                    error=_tb.format_exc().strip().splitlines()[-1],
+                )
+                status, payload = 500, {"error": "internal server error"}
+            self._sample_request_log(
+                method, path, status, (_time.perf_counter() - t0) * 1e3,
+                headers.get("x-peer-addr", ""),
+            )
         reason = {200: "OK", 201: "Created", 400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
                   409: "Conflict", 429: "Too Many Requests",
@@ -362,6 +383,33 @@ class RestApi:
             f"{status} {reason.get(status, 'OK')}", [("Content-Type", JSON)]
         )
         return [json.dumps(payload, default=str).encode()]
+
+    def _sample_request_log(
+        self, method: str, path: str, status: int, duration_ms: float,
+        peer: str,
+    ) -> None:
+        """Sampled structured access log (reference
+        service/sampled_request_logger.go); ratio from the logger_config
+        section, errors always logged when sampling is on."""
+        import random
+
+        from ..settings import LoggerConfig
+
+        ratio = LoggerConfig.get(self.store).request_sample_ratio
+        if ratio <= 0.0:
+            return
+        if status < 500 and random.random() >= ratio:
+            return
+        from ..utils.log import get_logger
+
+        get_logger("api").info(
+            "request",
+            method=method,
+            path=path.split("?")[0],
+            status=status,
+            duration_ms=round(duration_ms, 2),
+            peer=peer,
+        )
 
     def serve(self, host: str = "127.0.0.1", port: int = 9090):
         """Run a blocking HTTP server (CLI `service web`)."""
@@ -1258,6 +1306,7 @@ class RestApi:
             (lambda d: d["project"] == project) if project else None
         )
         docs.sort(key=lambda d: d.get("create_time", 0.0), reverse=True)
+        limit = max(1, min(int(body.get("limit", 50)), 500))
         return 200, [
             {
                 "_id": d["_id"],
@@ -1269,7 +1318,7 @@ class RestApi:
                 "create_time": d.get("create_time", 0.0),
                 "activated": d.get("activated", False),
             }
-            for d in docs[: int(body.get("limit", 50))]
+            for d in docs[:limit]
         ]
 
     def cancel_patch(self, method, match, body):

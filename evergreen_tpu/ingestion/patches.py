@@ -96,6 +96,14 @@ def cancel_patch(
     p = get_patch(store, patch_id)
     if p is None:
         return False
+    if p.status in (
+        PatchStatus.SUCCEEDED.value,
+        PatchStatus.FAILED.value,
+        PatchStatus.CANCELLED.value,
+    ):
+        # terminal patches keep their history — a late cancel must not
+        # rewrite a finished outcome
+        return False
     if p.version:
         from ..globals import TASK_IN_PROGRESS_STATUSES, TaskStatus
         from ..models import task as task_mod

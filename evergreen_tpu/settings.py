@@ -390,10 +390,19 @@ class LoggerConfig(ConfigSection):
     buffer_count: int = 100
     buffer_interval_seconds: int = 20
     default_level: str = "info"
+    #: fraction of HTTP requests logged as structured records (reference
+    #: service/sampled_request_logger.go); 0 disables
+    request_sample_ratio: float = 0.0
 
     def validate_and_default(self) -> str:
         if self.default_level not in ("debug", "info", "warning", "error"):
             return f"unknown log level {self.default_level!r}"
+        # overrides arrive untyped — a TypeError here would defeat the
+        # fail-safe-to-base path in ConfigSection.get
+        if not isinstance(self.request_sample_ratio, (int, float)) or (
+            not 0.0 <= self.request_sample_ratio <= 1.0
+        ):
+            return "request_sample_ratio must be a number within [0, 1]"
         return ""
 
 
@@ -527,8 +536,10 @@ class TracerConfig(ConfigSection):
     xla_profile_dir: str = ""
 
     def validate_and_default(self) -> str:
-        if not 0.0 <= self.sample_ratio <= 1.0:
-            return "sample_ratio must be within [0, 1]"
+        if not isinstance(self.sample_ratio, (int, float)) or (
+            not 0.0 <= self.sample_ratio <= 1.0
+        ):
+            return "sample_ratio must be a number within [0, 1]"
         if self.enabled and not self.collector_endpoint:
             return "enabled tracer needs a collector_endpoint"
         return ""

@@ -153,6 +153,47 @@ def test_patch_list_is_summary_shape(server, capsys):
     assert "diff" not in docs[0] and "config_yaml" not in docs[0]
 
 
+def test_cancel_refuses_terminal_patches(server, capsys):
+    base, store = server
+    insert_patch(store, Patch(id="pa-done", project="p",
+                              status=PatchStatus.SUCCEEDED.value,
+                              finish_time=123.0))
+    rc, _ = run_cli(capsys, "patch-cancel", "pa-done", "--api-server", base)
+    assert rc == 1
+    p = get_patch(store, "pa-done")
+    assert p.status == PatchStatus.SUCCEEDED.value
+    assert p.finish_time == 123.0
+
+
+def test_patch_list_limit_clamped(server, capsys):
+    base, store = server
+    insert_patch(store, Patch(id="pa-x", project="p"))
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base}/rest/v2/patches?limit=-1") as r:
+        docs = json.loads(r.read())
+    assert len(docs) == 1  # negative limit clamps, never un-bounds
+
+
+def test_untyped_override_fails_safe(server, capsys):
+    """A string value in a field override must fall back to the stored
+    base section, not TypeError every request (the validator is the
+    override fail-safe)."""
+    base, store = server
+    from evergreen_tpu.settings import LoggerConfig, OverridesConfig
+
+    ov = OverridesConfig.get(store)
+    ov.overrides = [{"section_id": "logger_config",
+                     "field": "request_sample_ratio", "value": "0.5"}]
+    ov.set(store)
+    cfg = LoggerConfig.get(store)  # must not raise
+    assert cfg.request_sample_ratio == 0.0  # base value, override rejected
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base}/rest/v2/status") as r:
+        assert r.status == 200
+
+
 def test_login_and_version(server, capsys):
     base, store = server
     from evergreen_tpu.settings import AuthConfig

@@ -156,6 +156,33 @@ def test_tick_emits_runtime_stats_line(store):
     assert rec["total_ms"] > 0
 
 
+def test_sampled_request_logger(store):
+    """HTTP access sampling (reference service/sampled_request_logger.go):
+    off by default; at ratio 1.0 every request logs; 5xx always logs
+    while sampling is on."""
+    import threading
+    import urllib.request
+
+    got = []
+    reset_sinks(got.append)
+    api = RestApi(store)
+    srv = api.serve(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        urllib.request.urlopen(f"{base}/rest/v2/status").read()
+        assert got == []  # ratio defaults to 0 → no access records
+        cfg = LoggerConfig.get(store)
+        cfg.request_sample_ratio = 1.0
+        cfg.set(store)
+        urllib.request.urlopen(f"{base}/rest/v2/status").read()
+        reqs = [r for r in got if r["message"] == "request"]
+        assert reqs and reqs[0]["path"] == "/rest/v2/status"
+        assert reqs[0]["status"] == 200 and reqs[0]["duration_ms"] >= 0
+    finally:
+        srv.shutdown()
+
+
 def test_job_failure_logs_error_line(store):
     from evergreen_tpu.queue.jobs import FnJob, JobQueue
 
