@@ -97,6 +97,8 @@ class RestApi:
         #: server is threading). Set by _authorize, read by ownership
         #: checks on user-resource routes (spawn hosts, volumes).
         self._ident = threading.local()
+        #: (ratio, read_at) — see _sample_request_log
+        self._sample_ratio_cache: Optional[Tuple[float, float]] = None
         self._register_routes()
         #: GitHub webhook intake (reference rest/route/github.go); secret +
         #: config fetcher injectable
@@ -390,12 +392,21 @@ class RestApi:
     ) -> None:
         """Sampled structured access log (reference
         service/sampled_request_logger.go); ratio from the logger_config
-        section, errors always logged when sampling is on."""
+        section (TTL-cached: two store reads per request on the default
+        ratio-0 path would tax the dispatch hot loop), errors always
+        logged when sampling is on."""
         import random
 
-        from ..settings import LoggerConfig
+        now = _time.monotonic()
+        cached = self._sample_ratio_cache
+        if cached is None or now - cached[1] > 5.0:
+            from ..settings import LoggerConfig
 
-        ratio = LoggerConfig.get(self.store).request_sample_ratio
+            cached = (
+                LoggerConfig.get(self.store).request_sample_ratio, now
+            )
+            self._sample_ratio_cache = cached
+        ratio = cached[0]
         if ratio <= 0.0:
             return
         if status < 500 and random.random() >= ratio:
@@ -543,6 +554,9 @@ class RestApi:
             r"/rest/v2/projects/(?P<project>[^/]+)/waterfall",
             self.waterfall,
         )
+        r("GET", r"/rest/v2/keys", self.list_keys)
+        r("POST", r"/rest/v2/keys", self.add_key)
+        r("DELETE", r"/rest/v2/keys/(?P<name>[^/]+)", self.delete_key)
         r("POST", r"/rest/v2/subscriptions", self.create_subscription)
         r("GET", r"/rest/v2/subscriptions", self.list_subscriptions)
         r("DELETE", r"/rest/v2/subscriptions/(?P<sub>[^/]+)",
@@ -1654,6 +1668,49 @@ class RestApi:
         from ..utils.tracing import get_spans
 
         return 200, get_spans(self.store)[-200:]
+
+    def _key_user(self, body: dict) -> str:
+        """The authenticated user; without auth (dev mode) the caller
+        names themselves."""
+        user = getattr(self._ident, "user", "") or body.get("user", "")
+        if not user:
+            raise ApiError(401, "user identity required for key management")
+        return user
+
+    def list_keys(self, method, match, body):
+        """reference rest/route keys routes + operations/keys.go list."""
+        from ..models import user as user_mod
+
+        u = user_mod.get_user(self.store, self._key_user(body))
+        if u is None:
+            raise ApiError(404, "user not found")
+        return 200, u.public_keys
+
+    def add_key(self, method, match, body):
+        from ..models import user as user_mod
+
+        name = body.get("name", "")
+        key = body.get("key", "")
+        if not name or not key:
+            raise ApiError(400, "both name and key are required")
+        try:
+            ok = user_mod.add_public_key(
+                self.store, self._key_user(body), name, key
+            )
+        except user_mod.PublicKeyError as e:
+            raise ApiError(400, str(e))
+        if not ok:
+            raise ApiError(404, "user not found")
+        return 200, {"ok": True}
+
+    def delete_key(self, method, match, body):
+        from ..models import user as user_mod
+
+        if not user_mod.delete_public_key(
+            self.store, self._key_user(body), match["name"]
+        ):
+            raise ApiError(404, "no such key")
+        return 200, {"ok": True}
 
     def list_log_lines(self, method, match, body):
         """Recent structured log records from the in-store ring

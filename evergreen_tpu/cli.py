@@ -306,6 +306,57 @@ def cmd_login(args) -> int:
     return 1
 
 
+def cmd_keys(args) -> int:
+    """Manage SSH public keys (reference operations/keys.go)."""
+    call = _client(args)
+    auth = {"user": args.user} if args.user else {}
+    if args.action == "list":
+        out = call("GET", "/rest/v2/keys", auth or None)
+        if not isinstance(out, list):
+            print(json.dumps(out), file=sys.stderr)
+            return 1
+        for k in out:
+            print(f"{k['name']}\t{k['key'][:60]}")
+        return 0
+    if args.action == "add":
+        if args.key:
+            key_text = args.key
+        elif args.file:
+            with open(args.file) as fh:
+                key_text = fh.read().strip()
+        else:
+            print("keys add needs --key or --file", file=sys.stderr)
+            return 2
+        out = call("POST", "/rest/v2/keys",
+                   {"name": args.name, "key": key_text, **auth})
+    else:  # delete
+        from urllib.parse import quote
+
+        out = call("DELETE", f"/rest/v2/keys/{quote(args.name)}",
+                   auth or None)
+    print(json.dumps(out))
+    return 1 if isinstance(out, dict) and "error" in out else 0
+
+
+def cmd_subscriptions(args) -> int:
+    """List / delete notification subscriptions (reference
+    operations/subscriptions.go over the REST routes)."""
+    call = _client(args)
+    if args.action == "list":
+        out = call("GET", "/rest/v2/subscriptions")
+        if not isinstance(out, list):
+            print(json.dumps(out), file=sys.stderr)
+            return 1
+        for s in out:
+            print(f"{s['_id']}\t{s.get('resource_type', '')}"
+                  f"\t{s.get('trigger', '')}\t{s.get('subscriber_type', '')}"
+                  f"\t{s.get('subscriber_target', '')}")
+        return 0
+    out = call("DELETE", f"/rest/v2/subscriptions/{args.sub_id}")
+    print(json.dumps(out))
+    return 1 if isinstance(out, dict) and "error" in out else 0
+
+
 def cmd_version(args) -> int:
     from . import __version__
 
@@ -657,6 +708,22 @@ def build_parser() -> argparse.ArgumentParser:
     lo.add_argument("--password", default="")
     lo.add_argument("--api-server", default="http://127.0.0.1:9090")
     lo.set_defaults(fn=cmd_login)
+
+    ke = sub.add_parser("keys", help="manage SSH public keys")
+    ke.add_argument("action", choices=["list", "add", "delete"])
+    ke.add_argument("--name", default="")
+    ke.add_argument("--key", default="", help="key text (or use --file)")
+    ke.add_argument("--file", default="", help="read key from file")
+    ke.add_argument("--user", default="",
+                    help="acting user (dev mode without auth)")
+    ke.add_argument("--api-server", default="http://127.0.0.1:9090")
+    ke.set_defaults(fn=cmd_keys)
+
+    su = sub.add_parser("subscriptions", help="list/delete subscriptions")
+    su.add_argument("action", choices=["list", "delete"])
+    su.add_argument("--sub-id", default="", dest="sub_id")
+    su.add_argument("--api-server", default="http://127.0.0.1:9090")
+    su.set_defaults(fn=cmd_subscriptions)
 
     ve = sub.add_parser("version", help="print the version")
     ve.set_defaults(fn=cmd_version)

@@ -156,8 +156,19 @@ def create_hosts_from_intents(
             # distro edits can be detected as reprovision transitions
             update["bootstrap_method"] = boot.method
             if d and boot.method == boot.METHOD_USER_DATA:
+                keys: List[str] = []
+                if h.user_host and h.started_by:
+                    # spawn hosts get their owner's SSH keys (reference
+                    # cloud/spawn.go authorized_keys injection)
+                    from ..models import user as user_mod
+
+                    owner = user_mod.get_user(store, h.started_by)
+                    if owner is not None:
+                        keys = [k["key"] for k in owner.public_keys]
                 try:
-                    update["user_data"] = userdata_mod.for_host(d, h, api_url)
+                    update["user_data"] = userdata_mod.for_host(
+                        d, h, api_url, authorized_keys=keys
+                    )
                 except userdata_mod.UserDataError as exc:
                     # a distro saved with malformed custom user data must
                     # not stall the whole create pass: fall back to the

@@ -237,12 +237,37 @@ def merge_parts(parts: List[UserData]) -> str:
     return "\n".join(out) + "\n"
 
 
-def for_host(distro, host, api_url: str) -> str:
+def for_host(
+    distro, host, api_url: str,
+    authorized_keys: Optional[List[str]] = None,
+) -> str:
     """Full user-data payload for a spawning host: custom distro user data
-    (provider_settings["user_data"]) merged with the provisioning script."""
+    (provider_settings["user_data"]) merged with the provisioning script,
+    plus the owner's SSH public keys for spawn hosts (reference: spawn
+    hosts write the user's PubKeys into authorized_keys,
+    cloud/spawn.go)."""
     parts: List[UserData] = []
     custom = (distro.provider_settings or {}).get("user_data", "")
     if custom:
         parts.append(parse(custom))
+    if authorized_keys and not _is_windows(distro.arch):
+        # quoted-delimiter heredoc: nothing in the key text is expanded or
+        # interpreted; model-level validation (models/user.py) already
+        # rejects newlines/quotes, so a key line can never terminate the
+        # heredoc early — defense in depth against shell injection
+        delim = "EVG_AUTHORIZED_KEYS_EOF_7f3a"
+        key_block = "\n".join(
+            k for k in authorized_keys if delim not in k and "\n" not in k
+        )
+        parts.append(
+            UserData(
+                directive="#!/bin/sh",
+                content=(
+                    f"mkdir -p ~{distro.user}/.ssh\n"
+                    f"cat >> ~{distro.user}/.ssh/authorized_keys "
+                    f"<<'{delim}'\n{key_block}\n{delim}"
+                ),
+            )
+        )
     parts.append(provisioning_script(distro, host, api_url))
     return merge_parts(parts)

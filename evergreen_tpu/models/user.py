@@ -10,6 +10,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import random
+import re
 import secrets
 import time as _time
 from typing import List, Optional
@@ -33,6 +34,10 @@ class User:
     roles: List[str] = dataclasses.field(default_factory=list)
     created_at: float = 0.0
     banned: bool = False
+    #: named SSH public keys ([{name, key, created_at}]) — injected into
+    #: the user's spawn hosts at provision time (reference
+    #: model/user/user.go:35 PubKeys + cloud spawn-host authorized_keys)
+    public_keys: List[dict] = dataclasses.field(default_factory=list)
 
     def has_scope(self, scope: str) -> bool:
         return not self.banned and (
@@ -89,6 +94,57 @@ def grant_role(store: Store, user_id: str, role: str) -> bool:
             doc["roles"].append(role)
 
     return coll(store).mutate(user_id, add)
+
+
+#: key names must be route- and shell-addressable; key text must be one
+#: line of the ssh authorized_keys charset — this is the guard that keeps
+#: user-controlled key text from ever being able to escape the user-data
+#: script that writes it (cloud/userdata.py)
+_KEY_NAME_RE = re.compile(r"^[A-Za-z0-9._\-]{1,64}$")
+_KEY_TEXT_RE = re.compile(r"^[A-Za-z0-9+/=@.:_\- ]{1,16384}$")
+
+
+class PublicKeyError(ValueError):
+    pass
+
+
+def add_public_key(
+    store: Store, user_id: str, name: str, key: str,
+    now: Optional[float] = None,
+) -> bool:
+    """Add a named SSH public key (reference user.AddPublicKey); names
+    are unique per user — re-adding a name replaces the key."""
+    if not _KEY_NAME_RE.match(name):
+        raise PublicKeyError(
+            "key name must be 1-64 chars of letters, digits, . _ -"
+        )
+    if not _KEY_TEXT_RE.match(key):
+        raise PublicKeyError(
+            "key must be a single line of ssh public-key characters"
+        )
+    now = _time.time() if now is None else now
+
+    def add(doc: dict) -> None:
+        keys = [k for k in doc.get("public_keys", []) if k["name"] != name]
+        keys.append({"name": name, "key": key, "created_at": now})
+        doc["public_keys"] = keys
+
+    return coll(store).mutate(user_id, add)
+
+
+def delete_public_key(store: Store, user_id: str, name: str) -> bool:
+    """reference user.DeletePublicKey; False when no such key name."""
+    removed = {"n": 0}
+
+    def drop(doc: dict) -> None:
+        keys = doc.get("public_keys", [])
+        kept = [k for k in keys if k["name"] != name]
+        removed["n"] = len(keys) - len(kept)
+        doc["public_keys"] = kept
+
+    if not coll(store).mutate(user_id, drop):
+        return False
+    return removed["n"] > 0
 
 
 class RateLimiter:

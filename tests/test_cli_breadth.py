@@ -194,6 +194,104 @@ def test_untyped_override_fails_safe(server, capsys):
         assert r.status == 200
 
 
+def test_keys_management_flow(server, capsys):
+    """keys add/list/delete (reference operations/keys.go) + spawn-host
+    user data carries the owner's keys."""
+    base, store = server
+    from evergreen_tpu.models import user as user_mod
+
+    user_mod.create_user(store, "dev")
+    rc, _ = run_cli(capsys, "keys", "add", "--name", "laptop",
+                    "--key", "ssh-ed25519 AAAA dev@laptop",
+                    "--user", "dev", "--api-server", base)
+    assert rc == 0
+    rc, out = run_cli(capsys, "keys", "list", "--user", "dev",
+                      "--api-server", base)
+    assert rc == 0 and "laptop\tssh-ed25519" in out
+    # re-adding a name replaces, not duplicates
+    run_cli(capsys, "keys", "add", "--name", "laptop",
+            "--key", "ssh-ed25519 BBBB dev@laptop", "--user", "dev",
+            "--api-server", base)
+    u = user_mod.get_user(store, "dev")
+    assert len(u.public_keys) == 1 and "BBBB" in u.public_keys[0]["key"]
+    # spawn-host user data embeds the key
+    from evergreen_tpu.cloud.provisioning import create_hosts_from_intents
+    from evergreen_tpu.cloud.spawnhost import create_spawn_host
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models.distro import BootstrapSettings, Distro
+
+    distro_mod.insert(store, Distro(
+        id="ws", provider="mock",
+        bootstrap_settings=BootstrapSettings(method="user-data"),
+    ))
+    h = create_spawn_host(store, "dev", "ws")
+    create_hosts_from_intents(store)
+    doc = host_mod.coll(store).get(h.id)
+    assert "ssh-ed25519 BBBB" in doc["user_data"]
+    assert "authorized_keys" in doc["user_data"]
+    # delete
+    rc, _ = run_cli(capsys, "keys", "delete", "--name", "laptop",
+                    "--user", "dev", "--api-server", base)
+    assert rc == 0
+    assert user_mod.get_user(store, "dev").public_keys == []
+    rc, _ = run_cli(capsys, "keys", "delete", "--name", "laptop",
+                    "--user", "dev", "--api-server", base)
+    assert rc == 1  # no such key
+
+
+def test_key_validation_blocks_shell_metacharacters(server, capsys):
+    """User-controlled key text lands in a root-executed user-data
+    script; quotes/newlines must be rejected at add time and the embed
+    uses a quoted heredoc."""
+    base, store = server
+    from evergreen_tpu.models import user as user_mod
+
+    user_mod.create_user(store, "eve")
+    rc, _ = run_cli(capsys, "keys", "add", "--name", "x",
+                    "--key", "ssh-ed25519 AAAA x'; rm -rf / #",
+                    "--user", "eve", "--api-server", base)
+    assert rc == 1  # 400 from validation
+    assert user_mod.get_user(store, "eve").public_keys == []
+    # undeletable names are rejected at add time too
+    rc, _ = run_cli(capsys, "keys", "add", "--name", "work/laptop",
+                    "--key", "ssh-ed25519 AAAA ok",
+                    "--user", "eve", "--api-server", base)
+    assert rc == 1
+    # missing --key/--file is a usage error, not a traceback
+    rc, _ = run_cli(capsys, "keys", "add", "--name", "x",
+                    "--user", "eve", "--api-server", base)
+    assert rc == 2
+    # the embed itself is a quoted heredoc (no interpolation)
+    from evergreen_tpu.cloud import userdata as ud
+    from evergreen_tpu.models.distro import BootstrapSettings, Distro
+    from evergreen_tpu.models.host import new_intent
+
+    d = Distro(id="ws2", bootstrap_settings=BootstrapSettings(
+        method="user-data"))
+    payload = ud.for_host(d, new_intent("ws2", "mock"), "http://a",
+                          authorized_keys=["ssh-ed25519 AAAA ok"])
+    assert "<<'EVG_AUTHORIZED_KEYS_EOF_7f3a'" in payload
+    assert "echo 'ssh-" not in payload
+
+
+def test_subscriptions_cli(server, capsys):
+    base, store = server
+    from evergreen_tpu.events.triggers import Subscription, add_subscription
+
+    add_subscription(store, Subscription(
+        id="sub-cli", resource_type="TASK", trigger="outcome",
+        subscriber_type="email", subscriber_target="dev@x.com",
+    ))
+    rc, out = run_cli(capsys, "subscriptions", "list", "--api-server", base)
+    assert rc == 0 and "sub-cli" in out and "dev@x.com" in out
+    rc, _ = run_cli(capsys, "subscriptions", "delete", "--sub-id",
+                    "sub-cli", "--api-server", base)
+    assert rc == 0
+    rc, out = run_cli(capsys, "subscriptions", "list", "--api-server", base)
+    assert "sub-cli" not in out
+
+
 def test_login_and_version(server, capsys):
     base, store = server
     from evergreen_tpu.settings import AuthConfig

@@ -175,6 +175,7 @@ def test_sampled_request_logger(store):
         cfg = LoggerConfig.get(store)
         cfg.request_sample_ratio = 1.0
         cfg.set(store)
+        api._sample_ratio_cache = None  # expire the 5s TTL cache
         urllib.request.urlopen(f"{base}/rest/v2/status").read()
         reqs = [r for r in got if r["message"] == "request"]
         assert reqs and reqs[0]["path"] == "/rest/v2/status"
