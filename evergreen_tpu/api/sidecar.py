@@ -45,9 +45,7 @@ def _solve_buffers(
     u8_buf: np.ndarray,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run the packed solve on raw arena buffers."""
-    import jax
-
-    from ..ops.solve import _packed_solve
+    from ..ops.solve import _packed_solve, split_packed
     from ..scheduler.snapshot import arena_for_dims
 
     dims = dict(zip("NMUGHD", shape))
@@ -57,9 +55,8 @@ def _solve_buffers(
     if want != got:
         raise ValueError(f"buffer sizes {got} do not match shape key (want {want})")
     bufs = {"f32": f32_buf, "i32": i32_buf, "u8": u8_buf}
-    out_i32, out_f32 = _packed_solve(bufs, arena.layout_key())
-    out_i32, out_f32 = jax.device_get((out_i32, out_f32))
-    return np.asarray(out_i32), np.asarray(out_f32)
+    out = np.asarray(_packed_solve(bufs, arena.layout_key()))
+    return split_packed(out, dims)
 
 
 class _Handler(socketserver.StreamRequestHandler):

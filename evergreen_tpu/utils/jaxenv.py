@@ -76,7 +76,8 @@ def force_cpu(n_devices: int | None = None) -> None:
         )
 
 
-def ensure_usable_backend(timeout_s: float = 45.0) -> str:
+def ensure_usable_backend(timeout_s: float = 45.0, attempts: int = 1,
+                          retry_sleep_s: float = 10.0) -> str:
     """Keep the real TPU when the tunnel answers; otherwise pin CPU so the
     caller never hangs.  Returns the platform chosen.
 
@@ -85,7 +86,16 @@ def ensure_usable_backend(timeout_s: float = 45.0) -> str:
     completely alone — a native TPU/GPU stays usable."""
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return os.environ.get("JAX_PLATFORMS") or "default"
-    if probe_tpu(timeout_s):
-        return "axon"
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # probe_tpu fails deterministically here — skip the retry sleeps
+        force_cpu()
+        return "cpu"
+    for attempt in range(max(attempts, 1)):
+        if attempt:
+            import time
+
+            time.sleep(retry_sleep_s)
+        if probe_tpu(timeout_s):
+            return "axon"
     force_cpu()
     return "cpu"
