@@ -2,20 +2,21 @@
 
 The reference serves a gqlgen schema of ~139k generated lines
 (graphql/generated.go) backing the Spruce UI; the hand-written substance is
-the resolvers. Here: a compact spec-subset executor (single operation,
-field arguments, variables, aliases, nested selection sets, named and
-inline fragments (flattened at parse time; type conditions are advisory
-over the schemaless doc store), @include/@skip directives on fields
-or directives) over a resolver registry covering the operationally
-important queries (task, tasks, version, build, host, hosts, distros,
-patch, projects, taskLogs, taskTests) and mutations (scheduleTask,
-unscheduleTask, abortTask, restartTask, setTaskPriority).
+the resolvers. Here: a spec-subset executor (single operation, field
+arguments, typed variables, aliases, nested selection sets, named and
+inline fragments flattened at parse time, @include/@skip directives)
+over a resolver registry, executed against the TYPED schema generated in
+api/schema.py from the domain dataclasses: selections on declared object
+types validate field-by-field, ``__typename`` resolves to real type
+names, and ``__schema``/``__type`` serve full spec introspection
+(ofType chains, input objects, enums, meta-types).
 """
 from __future__ import annotations
 
 import re
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import schema as schema_mod
 from ..models import build as build_mod
 from ..models import host as host_mod
 from ..models import task as task_mod
@@ -460,25 +461,52 @@ def _project(
     selection: Optional[List[dict]],
     store: Store,
     variables: Optional[Dict[str, Any]] = None,
+    type_ref: Optional[dict] = None,
+    registry: Optional[Dict[str, dict]] = None,
 ) -> Any:
+    """Project a resolver result through the selection set, threading the
+    declared result type: selections on a schema OBJECT type validate
+    field-by-field (unknown field -> error, matching the reference's
+    generated executor), while JSON-scalar values keep the permissive
+    raw-document projection."""
     if selection is None or value is None:
         return value
     if isinstance(value, list):
-        return [_project(v, selection, store, variables) for v in value]
+        elem = schema_mod.element_ref(type_ref)
+        return [
+            _project(v, selection, store, variables, elem, registry)
+            for v in value
+        ]
     if not isinstance(value, dict):
         return value
     variables = variables or {}
+    tname = schema_mod.named_type(type_ref)
+    tdef = (registry or {}).get(tname) if tname else None
+    fields_def = (
+        tdef["fields"] if tdef and tdef["kind"] == "OBJECT" else None
+    )
     out = {}
     for field in selection:
         if not _directives_allow(field, variables):
             continue
         name = field["name"]
         if name == "__typename":
-            out[field["alias"]] = value.get("__typename", "JSON")
+            out[field["alias"]] = (
+                tname if fields_def is not None
+                else value.get("__typename", "JSON")
+            )
             continue
+        child_ref = None
+        if fields_def is not None:
+            fdef = fields_def.get(name)
+            if fdef is None:
+                raise GraphQLError(
+                    f"unknown field {name!r} on type {tname!r}"
+                )
+            child_ref = fdef["type"]
         sub = value.get(name)
         out[field["alias"]] = _project(
-            sub, field["selection"], store, variables
+            sub, field["selection"], store, variables, child_ref, registry
         )
     return out
 
@@ -544,6 +572,8 @@ class GraphQLApi:
             ).parse_document()
             variables = coerce_variables(var_defs, variables or {})
             registry = self.queries if op == "query" else self.mutations
+            sreg = schema_mod.schema()
+            op_type = sreg["Query" if op == "query" else "Mutation"]
             data: Dict[str, Any] = {}
             for field in selection:
                 if not _directives_allow(field, variables):
@@ -556,8 +586,9 @@ class GraphQLApi:
                     continue
                 if name == "__schema":
                     data[field["alias"]] = _project(
-                        self._introspect_schema(), field["selection"],
+                        schema_mod.render_schema(sreg), field["selection"],
                         self.store, variables,
+                        schema_mod.named("__Schema"), sreg,
                     )
                     continue
                 if name == "__type":
@@ -566,8 +597,11 @@ class GraphQLApi:
                         for k, v in field["args"].items()
                     }
                     data[field["alias"]] = _project(
-                        self._introspect_type(args.get("name", "")),
+                        schema_mod.render_type(
+                            sreg.get(args.get("name", ""))
+                        ),
                         field["selection"], self.store, variables,
+                        schema_mod.named("__Type"), sreg,
                     )
                     continue
                 fn = registry.get(name)
@@ -579,87 +613,16 @@ class GraphQLApi:
                     k: _resolve_vars(v, variables)
                     for k, v in field["args"].items()
                 }
+                fdef = op_type["fields"].get(name)
                 data[field["alias"]] = _project(
-                    fn(**args), field["selection"], self.store, variables
+                    fn(**args), field["selection"], self.store, variables,
+                    fdef["type"] if fdef else None, sreg,
                 )
             return {"data": data}
         except GraphQLError as e:
             return {"errors": [{"message": str(e)}]}
         except TypeError as e:
             return {"errors": [{"message": f"bad arguments: {e}"}]}
-
-    # -- introspection stubs --------------------------------------------- #
-    # Enough of the introspection surface for clients to list operations
-    # and probe field existence (the reference serves gqlgen's full
-    # generated introspection; this is the schemaless-subset honest
-    # equivalent: every field reports type "JSON").
-
-    def _field_stub(self, name: str, fn: Callable) -> dict:
-        import inspect
-
-        args = []
-        for pname, p in inspect.signature(fn).parameters.items():
-            if pname == "self":
-                continue
-            args.append(
-                {
-                    "name": pname,
-                    "type": {"name": "JSON", "kind": "SCALAR",
-                             "ofType": None},
-                    "defaultValue": (
-                        None if p.default is inspect.Parameter.empty
-                        else repr(p.default)
-                    ),
-                }
-            )
-        return {
-            "name": name,
-            "args": args,
-            "type": {"name": "JSON", "kind": "SCALAR", "ofType": None},
-            "isDeprecated": False,
-            "deprecationReason": None,
-            "description": (fn.__doc__ or "").strip() or None,
-        }
-
-    def _introspect_schema(self) -> dict:
-        return {
-            "queryType": {"name": "Query"},
-            "mutationType": {"name": "Mutation"},
-            "subscriptionType": None,
-            "types": [
-                self._introspect_type("Query"),
-                self._introspect_type("Mutation"),
-                {"name": "JSON", "kind": "SCALAR", "fields": None,
-                 "description": "schemaless document scalar"},
-                *(
-                    {"name": n, "kind": "SCALAR", "fields": None,
-                     "description": None}
-                    for n in ("String", "ID", "Int", "Float", "Boolean")
-                ),
-            ],
-            "directives": [
-                {"name": "include", "locations": ["FIELD",
-                                                  "FRAGMENT_SPREAD",
-                                                  "INLINE_FRAGMENT"]},
-                {"name": "skip", "locations": ["FIELD", "FRAGMENT_SPREAD",
-                                               "INLINE_FRAGMENT"]},
-            ],
-        }
-
-    def _introspect_type(self, name: str) -> Optional[dict]:
-        if name == "Query":
-            fields = [self._field_stub(n, f)
-                      for n, f in sorted(self.queries.items())]
-        elif name == "Mutation":
-            fields = [self._field_stub(n, f)
-                      for n, f in sorted(self.mutations.items())]
-        elif name in ("JSON", "String", "ID", "Int", "Float", "Boolean"):
-            return {"name": name, "kind": "SCALAR", "fields": None,
-                    "description": None}
-        else:
-            return None
-        return {"name": name, "kind": "OBJECT", "fields": fields,
-                "description": None}
 
     # -- query resolvers ------------------------------------------------------ #
 
@@ -943,7 +906,7 @@ class GraphQLApi:
             (lambda d: d["project"] == project) if project else None
         )
         docs.sort(key=lambda d: d.get("create_time", 0.0), reverse=True)
-        return docs[: int(limit)]
+        return [{**d, "id": d["_id"]} for d in docs[: int(limit)]]
 
     def _q_project_settings(self, projectId: str):
         """Spruce project-settings page bundle (reference graphql

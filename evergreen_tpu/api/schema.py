@@ -1,0 +1,650 @@
+"""GraphQL type system generated from the domain model.
+
+The reference compiles hand-written SDL into ~123k LoC of gqlgen
+bindings (/root/reference/graphql/generated.go + schema/*.graphql); the
+schema and the Go model structs are kept in sync by codegen.  Here the
+same sync is achieved the other way around: object types are GENERATED
+at import time from the framework's own dataclasses (the single source
+of truth the resolvers serialize), and only resolver-shaped composites
+(waterfall rows, log sections, pagination envelopes) plus the Query /
+Mutation operation types are declared by hand.
+
+The registry drives three things in api/graphql.py:
+  1. full spec introspection (``__schema`` / ``__type`` with ofType
+     chains, input objects, enums, and the ``__Type``/``__Field``
+     meta-types),
+  2. type-threaded projection: selections on declared OBJECT types are
+     validated field-by-field (unknown field -> GraphQLError) and
+     ``__typename`` resolves to the real type name,
+  3. redaction-by-construction: sensitive dataclass fields (host
+     secrets, API keys) are excluded at generation, so no query can even
+     *name* them.
+
+Type refs use the introspection wire shape directly
+(``{"kind", "name", "ofType"}``) so rendering is the identity.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------------------- #
+# Type references (introspection wire shape)
+# --------------------------------------------------------------------------- #
+
+
+def named(name: str, kind: str = "OBJECT") -> dict:
+    return {"kind": kind, "name": name, "ofType": None}
+
+
+def scalar(name: str) -> dict:
+    return named(name, "SCALAR")
+
+
+def enum_ref(name: str) -> dict:
+    return named(name, "ENUM")
+
+
+def input_ref(name: str) -> dict:
+    return named(name, "INPUT_OBJECT")
+
+
+def nn(ref: dict) -> dict:
+    return {"kind": "NON_NULL", "name": None, "ofType": ref}
+
+
+def lst(ref: dict) -> dict:
+    return {"kind": "LIST", "name": None, "ofType": ref}
+
+
+STRING = scalar("String")
+ID = scalar("ID")
+INT = scalar("Int")
+FLOAT = scalar("Float")
+BOOLEAN = scalar("Boolean")
+JSON = scalar("JSON")
+
+
+def named_type(ref: Optional[dict]) -> Optional[str]:
+    """Innermost named type of a (possibly wrapped) ref."""
+    while ref is not None and ref.get("ofType") is not None:
+        ref = ref["ofType"]
+    return ref.get("name") if ref else None
+
+
+def element_ref(ref: Optional[dict]) -> Optional[dict]:
+    """The element ref when ``ref`` is a (possibly non-null) list, else
+    None (permissive: the value decides)."""
+    if ref is None:
+        return None
+    if ref["kind"] == "NON_NULL":
+        ref = ref["ofType"]
+    if ref is not None and ref["kind"] == "LIST":
+        return ref["ofType"]
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# Field / type definitions
+# --------------------------------------------------------------------------- #
+
+
+def field(ref: dict, args: Optional[Dict[str, dict]] = None,
+          description: str = "") -> dict:
+    return {"type": ref, "args": args or {}, "description": description}
+
+
+def arg(ref: dict, default: Any = None, has_default: bool = False) -> dict:
+    return {"type": ref, "default": default, "has_default": has_default}
+
+
+def obj(name: str, fields: Dict[str, dict], description: str = "") -> dict:
+    return {"kind": "OBJECT", "name": name, "fields": fields,
+            "description": description}
+
+
+def input_obj(name: str, fields: Dict[str, dict],
+              description: str = "") -> dict:
+    return {"kind": "INPUT_OBJECT", "name": name, "inputFields": fields,
+            "description": description}
+
+
+def scalar_def(name: str, description: str = "") -> dict:
+    return {"kind": "SCALAR", "name": name, "description": description}
+
+
+def enum_def(name: str, values: List[str], description: str = "") -> dict:
+    return {"kind": "ENUM", "name": name, "enumValues": list(values),
+            "description": description}
+
+
+# --------------------------------------------------------------------------- #
+# Dataclass -> OBJECT type generation
+# --------------------------------------------------------------------------- #
+
+_SCALAR_HINTS = {str: STRING, bool: BOOLEAN, int: INT, float: FLOAT}
+
+
+def _ref_for_hint(hint: Any, registry: Dict[str, dict],
+                  nullable: bool = False) -> dict:
+    """Map a typing hint to a type ref, registering nested dataclasses
+    on the way.  Plain scalars and lists are non-null (dataclass defaults
+    guarantee presence); Optional[...] and unknown shapes stay nullable."""
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        inner = _ref_for_hint(args[0], registry, nullable=True)
+        return inner  # Optional[X] -> nullable X
+    if hint in _SCALAR_HINTS:
+        ref = _SCALAR_HINTS[hint]
+        return ref if nullable else nn(ref)
+    if origin in (list, typing.List):
+        (elem,) = typing.get_args(hint) or (Any,)
+        elem_r = _ref_for_hint(elem, registry)
+        ref = lst(elem_r)
+        return ref if nullable else nn(ref)
+    if origin in (dict, typing.Dict) or hint in (dict, Any):
+        return JSON
+    if dataclasses.is_dataclass(hint) and isinstance(hint, type):
+        register_dataclass(registry, hint)
+        ref = named(hint.__name__)
+        return ref if nullable else nn(ref)
+    return JSON  # tuples, unions of exotica — honest schemaless fallback
+
+
+def register_dataclass(
+    registry: Dict[str, dict],
+    cls: type,
+    name: Optional[str] = None,
+    exclude: Tuple[str, ...] = (),
+    extra: Optional[Dict[str, dict]] = None,
+    with_id: bool = False,
+    description: str = "",
+) -> str:
+    """Generate (and register) an OBJECT type from a dataclass.  Fields
+    keep their snake_case doc names — resolvers serialize via to_doc()/
+    asdict, so the wire names ARE the dataclass names."""
+    tname = name or cls.__name__
+    if tname in registry:
+        return tname
+    registry[tname] = None  # cycle guard (self-referential dataclasses)
+    hints = typing.get_type_hints(cls)
+    fields: Dict[str, dict] = {}
+    if with_id:
+        fields["id"] = field(nn(ID))
+    for f in dataclasses.fields(cls):
+        if f.name.startswith("_") or f.name in exclude:
+            continue
+        fields[f.name] = field(_ref_for_hint(hints[f.name], registry))
+    for k, v in (extra or {}).items():
+        fields[k] = v
+    registry[tname] = obj(
+        tname, fields,
+        description or f"Generated from {cls.__module__}.{cls.__qualname__}",
+    )
+    return tname
+
+
+# --------------------------------------------------------------------------- #
+# The schema
+# --------------------------------------------------------------------------- #
+
+
+def _pagination_args() -> Dict[str, dict]:
+    return {
+        "sortBy": arg(STRING, "", True),
+        "sortDir": arg(STRING, "ASC", True),
+        "limit": arg(INT, 0, True),
+        "page": arg(INT, 0, True),
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def schema() -> Dict[str, dict]:
+    """name -> type definition for the whole served schema."""
+    from ..cloud.volumes import Volume
+    from ..ingestion.patches import Patch
+    from ..models.annotations import Annotation, IssueLink
+    from ..models.artifact import ArtifactFile
+    from ..models.build import Build
+    from ..models.distro import Distro
+    from ..models.host import Host
+    from ..models.task import Task
+    from ..models.task_queue import TaskQueueItem
+    from ..models.user import User
+    from ..models.version import Version
+
+    reg: Dict[str, dict] = {}
+    for sname, desc in (
+        ("String", ""), ("ID", ""), ("Int", ""), ("Float", ""),
+        ("Boolean", ""),
+        ("JSON", "schemaless document scalar — raw store documents and "
+                 "free-form maps project through unvalidated"),
+    ):
+        reg[sname] = scalar_def(sname, desc)
+
+    # -- generated entity types (exclusions = redaction by construction) -- #
+    register_dataclass(reg, Task, with_id=True)
+    register_dataclass(
+        reg, Host, exclude=("secret",), with_id=True,
+        description="Generated from models.host.Host; the agent "
+                    "credential (secret) is excluded at generation",
+    )
+    register_dataclass(reg, Distro, with_id=True)
+    register_dataclass(reg, Build, with_id=True)
+    register_dataclass(reg, Version, with_id=True)
+    register_dataclass(
+        reg, User, exclude=("api_key",), with_id=True,
+        description="Generated from models.user.User; api_key excluded",
+    )
+    register_dataclass(reg, Patch, with_id=True)
+    register_dataclass(reg, Volume, with_id=True)
+    register_dataclass(reg, Annotation)
+    register_dataclass(reg, ArtifactFile)
+    register_dataclass(reg, TaskQueueItem, with_id=True)
+    register_dataclass(
+        reg, Patch, name="SchedulePatchResult", with_id=True,
+        extra={"versionId": field(STRING)},
+    )
+
+    # -- resolver-shaped composites -------------------------------------- #
+    reg["WaterfallBuildVariant"] = obj("WaterfallBuildVariant", {
+        "name": field(nn(STRING)),
+        "total": field(nn(INT)),
+        "success": field(nn(INT)),
+        "failed": field(nn(INT)),
+        "in_progress": field(nn(INT)),
+    })
+    reg["WaterfallVersion"] = obj("WaterfallVersion", {
+        "id": field(nn(ID)),
+        "revision": field(nn(STRING)),
+        "message": field(nn(STRING)),
+        "order": field(nn(INT)),
+        "status": field(nn(STRING)),
+        "build_variants": field(nn(lst(nn(named("WaterfallBuildVariant"))))),
+    })
+    reg["TaskEventLogEntry"] = obj("TaskEventLogEntry", {
+        "eventType": field(nn(STRING)),
+        "timestamp": field(nn(FLOAT)),
+        "data": field(JSON),
+    })
+    reg["TaskLogs"] = obj("TaskLogs", {
+        "taskId": field(nn(ID)),
+        "execution": field(nn(INT)),
+        "lines": field(nn(lst(nn(STRING))), description="legacy flat view"),
+        "taskLogs": field(nn(lst(nn(STRING)))),
+        "agentLogs": field(nn(lst(nn(STRING)))),
+        "systemLogs": field(nn(lst(nn(STRING)))),
+        "eventLogs": field(nn(lst(nn(named("TaskEventLogEntry"))))),
+    })
+    reg["TestResultRow"] = obj("TestResultRow", {
+        "testName": field(nn(STRING)),
+        "status": field(nn(STRING)),
+        "durationS": field(nn(FLOAT)),
+        "logUrl": field(nn(STRING)),
+    })
+    reg["TaskTestResult"] = obj("TaskTestResult", {
+        "testResults": field(nn(lst(nn(named("TestResultRow"))))),
+        "totalTestCount": field(nn(INT)),
+        "filteredTestCount": field(nn(INT)),
+    })
+    reg["VariantTaskSummary"] = obj("VariantTaskSummary", {
+        "id": field(nn(ID)),
+        "displayName": field(nn(STRING)),
+        "status": field(nn(STRING)),
+    })
+    reg["GroupedBuildVariant"] = obj("GroupedBuildVariant", {
+        "variant": field(nn(STRING)),
+        "tasks": field(nn(lst(nn(named("VariantTaskSummary"))))),
+    })
+    reg["ProjectVars"] = obj("ProjectVars", {
+        "vars": field(JSON, description="private values read as {REDACTED}"),
+        "privateVars": field(nn(lst(nn(STRING)))),
+    })
+    reg["ProjectSettings"] = obj("ProjectSettings", {
+        "projectRef": field(JSON, description="raw project_refs document"),
+        "vars": field(nn(named("ProjectVars"))),
+        "aliases": field(nn(lst(JSON))),
+        "subscriptions": field(nn(lst(JSON))),
+    })
+    reg["UiConfigInfo"] = obj("UiConfigInfo", {
+        "url": field(nn(STRING)),
+        "defaultProject": field(nn(STRING)),
+    })
+    reg["ApiConfigInfo"] = obj("ApiConfigInfo", {"url": field(nn(STRING))})
+    reg["JiraConfigInfo"] = obj("JiraConfigInfo", {"host": field(nn(STRING))})
+    reg["SpawnHostLimits"] = obj("SpawnHostLimits", {
+        "spawnHostsPerUser": field(nn(INT)),
+        "unexpirableHostsPerUser": field(nn(INT)),
+        "unexpirableVolumesPerUser": field(nn(INT)),
+    })
+    reg["AwsProviderInfo"] = obj("AwsProviderInfo", {
+        "maxVolumeSizeGb": field(nn(INT)),
+    })
+    reg["ProvidersInfo"] = obj("ProvidersInfo", {
+        "aws": field(nn(named("AwsProviderInfo"))),
+    })
+    reg["SpruceConfig"] = obj("SpruceConfig", {
+        "banner": field(nn(STRING)),
+        "bannerTheme": field(nn(STRING)),
+        "ui": field(nn(named("UiConfigInfo"))),
+        "api": field(nn(named("ApiConfigInfo"))),
+        "jira": field(nn(named("JiraConfigInfo"))),
+        "spawnHost": field(nn(named("SpawnHostLimits"))),
+        "providers": field(nn(named("ProvidersInfo"))),
+    })
+    reg["TaskHistoryEntry"] = obj("TaskHistoryEntry", {
+        "id": field(nn(ID)),
+        "status": field(nn(STRING)),
+        "version": field(nn(STRING)),
+        "order": field(nn(INT)),
+        "revision": field(nn(STRING)),
+        "durationS": field(nn(FLOAT)),
+        "execution": field(nn(INT)),
+    })
+    reg["VersionTaskRow"] = obj("VersionTaskRow", {
+        "id": field(nn(ID)),
+        "displayName": field(nn(STRING)),
+        "status": field(nn(STRING)),
+        "buildVariant": field(nn(STRING)),
+        "priority": field(nn(INT)),
+        "execution": field(nn(INT)),
+        "expectedDurationS": field(nn(FLOAT)),
+    })
+    reg["VersionTasks"] = obj("VersionTasks", {
+        "tasks": field(nn(lst(nn(named("VersionTaskRow"))))),
+        "totalCount": field(nn(INT)),
+        "filteredCount": field(nn(INT)),
+    })
+    reg["BuildBaron"] = obj("BuildBaron", {
+        "buildBaronConfigured": field(nn(BOOLEAN)),
+        "suggestedIssues": field(nn(lst(nn(named("IssueLink"))))),
+        "annotation": field(named("Annotation")),
+    })
+    reg["RestartVersionResult"] = obj("RestartVersionResult", {
+        "versionId": field(nn(STRING)),
+        "restartedTaskIds": field(nn(lst(nn(STRING)))),
+    })
+
+    # -- input objects ---------------------------------------------------- #
+    reg["VariantTasksInput"] = input_obj("VariantTasksInput", {
+        "variant": arg(nn(STRING)),
+        "tasks": arg(nn(lst(nn(STRING)))),
+    })
+    reg["ProjectVarsInput"] = input_obj("ProjectVarsInput", {
+        "vars": arg(JSON),
+        "privateVars": arg(lst(nn(STRING))),
+    })
+
+    # -- operations -------------------------------------------------------- #
+    reg["Query"] = obj("Query", {
+        "task": field(named("Task"), {"taskId": arg(nn(STRING))}),
+        "tasks": field(nn(lst(nn(named("Task")))),
+                       {"versionId": arg(nn(STRING))}),
+        "version": field(named("Version"), {"versionId": arg(nn(STRING))}),
+        "build": field(named("Build"), {"buildId": arg(nn(STRING))}),
+        "host": field(named("Host"), {"hostId": arg(nn(STRING))}),
+        "hosts": field(nn(lst(nn(named("Host")))),
+                       {"distroId": arg(STRING, "", True)}),
+        "myHosts": field(nn(lst(nn(named("Host")))),
+                         {"userId": arg(nn(STRING))}),
+        "myVolumes": field(nn(lst(nn(named("Volume")))),
+                           {"userId": arg(nn(STRING))}),
+        "distros": field(nn(lst(nn(named("Distro"))))),
+        "patch": field(named("Patch"), {"patchId": arg(nn(STRING))}),
+        "patches": field(nn(lst(nn(named("Patch")))),
+                         {"project": arg(STRING, "", True),
+                          "limit": arg(INT, 20, True)}),
+        "projects": field(nn(lst(JSON)),
+                          description="raw project_refs documents"),
+        "taskLogs": field(nn(named("TaskLogs")),
+                          {"taskId": arg(nn(STRING)),
+                           "execution": arg(INT, 0, True)}),
+        "taskTests": field(nn(named("TaskTestResult")), {
+            "taskId": arg(nn(STRING)),
+            "execution": arg(INT, 0, True),
+            "testName": arg(STRING, "", True),
+            "statuses": arg(lst(nn(STRING))),
+            **_pagination_args(),
+        }),
+        "buildVariants": field(nn(lst(nn(named("GroupedBuildVariant")))),
+                               {"versionId": arg(nn(STRING))}),
+        "displayTasks": field(nn(lst(JSON)), {"buildId": arg(nn(STRING))}),
+        "waterfall": field(nn(lst(nn(named("WaterfallVersion")))),
+                           {"projectId": arg(nn(STRING)),
+                            "limit": arg(INT, 10, True)}),
+        "taskArtifacts": field(nn(lst(nn(named("ArtifactFile")))),
+                               {"taskId": arg(nn(STRING)),
+                                "execution": arg(INT, 0, True)}),
+        "user": field(named("User"), {"userId": arg(nn(STRING))}),
+        "taskQueue": field(nn(lst(nn(named("TaskQueueItem")))),
+                           {"distroId": arg(nn(STRING))}),
+        "annotation": field(named("Annotation"),
+                            {"taskId": arg(nn(STRING)),
+                             "execution": arg(INT, 0, True)}),
+        "projectSettings": field(named("ProjectSettings"),
+                                 {"projectId": arg(nn(STRING))}),
+        "spruceConfig": field(nn(named("SpruceConfig"))),
+        "taskHistory": field(nn(lst(nn(named("TaskHistoryEntry")))), {
+            "taskName": arg(nn(STRING)),
+            "buildVariant": arg(nn(STRING)),
+            "projectId": arg(nn(STRING)),
+            "limit": arg(INT, 20, True),
+        }),
+        "versionTasks": field(nn(named("VersionTasks")), {
+            "versionId": arg(nn(STRING)),
+            "statuses": arg(lst(nn(STRING))),
+            "variant": arg(STRING, "", True),
+            "taskName": arg(STRING, "", True),
+            **_pagination_args(),
+        }),
+        "buildBaron": field(nn(named("BuildBaron")),
+                            {"taskId": arg(nn(STRING)),
+                             "execution": arg(INT, 0, True)}),
+    })
+
+    reg["Mutation"] = obj("Mutation", {
+        "scheduleTask": field(named("Task"), {"taskId": arg(nn(STRING))}),
+        "unscheduleTask": field(named("Task"), {"taskId": arg(nn(STRING))}),
+        "abortTask": field(named("Task"), {"taskId": arg(nn(STRING))}),
+        "restartTask": field(named("Task"), {"taskId": arg(nn(STRING))}),
+        "setTaskPriority": field(named("Task"),
+                                 {"taskId": arg(nn(STRING)),
+                                  "priority": arg(nn(INT))}),
+        "scheduleTasks": field(nn(lst(nn(named("Task")))),
+                               {"taskIds": arg(nn(lst(nn(STRING))))}),
+        "restartVersion": field(nn(named("RestartVersionResult")), {
+            "versionId": arg(nn(STRING)),
+            "abort": arg(BOOLEAN, False, True),
+            "failedOnly": arg(BOOLEAN, True, True),
+        }),
+        "schedulePatch": field(nn(named("SchedulePatchResult")), {
+            "patchId": arg(nn(STRING)),
+            "variantTasks": arg(lst(nn(input_ref("VariantTasksInput")))),
+        }),
+        "addAnnotationIssue": field(named("Annotation"), {
+            "taskId": arg(nn(STRING)),
+            "execution": arg(nn(INT)),
+            "url": arg(nn(STRING)),
+            "issueKey": arg(STRING, "", True),
+            "isIssue": arg(BOOLEAN, True, True),
+        }),
+        "removeAnnotationIssue": field(named("Annotation"), {
+            "taskId": arg(nn(STRING)),
+            "execution": arg(nn(INT)),
+            "issueKey": arg(nn(STRING)),
+            "isIssue": arg(BOOLEAN, True, True),
+        }),
+        "moveAnnotationIssue": field(named("Annotation"), {
+            "taskId": arg(nn(STRING)),
+            "execution": arg(nn(INT)),
+            "issueKey": arg(nn(STRING)),
+            "isIssue": arg(BOOLEAN, True, True),
+        }),
+        "editAnnotationNote": field(named("Annotation"), {
+            "taskId": arg(nn(STRING)),
+            "execution": arg(nn(INT)),
+            "note": arg(nn(STRING)),
+        }),
+        "saveProjectSettings": field(named("ProjectSettings"), {
+            "projectId": arg(nn(STRING)),
+            "projectRef": arg(JSON),
+            "vars": arg(input_ref("ProjectVarsInput")),
+        }),
+    })
+
+    _register_meta_types(reg)
+    return reg
+
+
+def _register_meta_types(reg: Dict[str, dict]) -> None:
+    """The introspection meta-schema, so introspection queries themselves
+    type-check (the spec's __Schema/__Type/__Field/__InputValue shapes)."""
+    reg["__TypeKind"] = enum_def("__TypeKind", [
+        "SCALAR", "OBJECT", "INTERFACE", "UNION", "ENUM", "INPUT_OBJECT",
+        "LIST", "NON_NULL",
+    ])
+    type_ref = named("__Type")
+    reg["__InputValue"] = obj("__InputValue", {
+        "name": field(nn(STRING)),
+        "description": field(STRING),
+        "type": field(nn(type_ref)),
+        "defaultValue": field(STRING),
+    })
+    reg["__Field"] = obj("__Field", {
+        "name": field(nn(STRING)),
+        "description": field(STRING),
+        "args": field(nn(lst(nn(named("__InputValue"))))),
+        "type": field(nn(type_ref)),
+        "isDeprecated": field(nn(BOOLEAN)),
+        "deprecationReason": field(STRING),
+    })
+    reg["__EnumValue"] = obj("__EnumValue", {
+        "name": field(nn(STRING)),
+        "description": field(STRING),
+        "isDeprecated": field(nn(BOOLEAN)),
+        "deprecationReason": field(STRING),
+    })
+    reg["__Type"] = obj("__Type", {
+        "kind": field(nn(enum_ref("__TypeKind"))),
+        "name": field(STRING),
+        "description": field(STRING),
+        "fields": field(lst(nn(named("__Field"))),
+                        {"includeDeprecated": arg(BOOLEAN, False, True)}),
+        "inputFields": field(lst(nn(named("__InputValue")))),
+        "interfaces": field(lst(nn(type_ref))),
+        "enumValues": field(lst(nn(named("__EnumValue"))),
+                            {"includeDeprecated": arg(BOOLEAN, False, True)}),
+        "possibleTypes": field(lst(nn(type_ref))),
+        "ofType": field(type_ref),
+    })
+    reg["__Directive"] = obj("__Directive", {
+        "name": field(nn(STRING)),
+        "description": field(STRING),
+        "locations": field(nn(lst(nn(STRING)))),
+        "args": field(nn(lst(nn(named("__InputValue"))))),
+    })
+    reg["__Schema"] = obj("__Schema", {
+        "queryType": field(nn(type_ref)),
+        "mutationType": field(type_ref),
+        "subscriptionType": field(type_ref),
+        "types": field(nn(lst(nn(type_ref)))),
+        "directives": field(nn(lst(nn(named("__Directive"))))),
+    })
+
+
+# --------------------------------------------------------------------------- #
+# Introspection rendering (registry -> spec response documents)
+# --------------------------------------------------------------------------- #
+
+
+def _render_default(value: Any, has_default: bool) -> Optional[str]:
+    if not has_default:
+        return None
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, str):
+        return f'"{value}"'
+    return repr(value)
+
+
+def _render_input_value(name: str, a: dict) -> dict:
+    return {
+        "name": name,
+        "description": None,
+        "type": a["type"],
+        "defaultValue": _render_default(
+            a.get("default"), a.get("has_default", False)
+        ),
+    }
+
+
+def render_type(tdef: Optional[dict]) -> Optional[dict]:
+    """One registry entry -> a full ``__Type`` response document."""
+    if tdef is None:
+        return None
+    out = {
+        "kind": tdef["kind"],
+        "name": tdef["name"],
+        "description": tdef.get("description") or None,
+        "fields": None,
+        "inputFields": None,
+        "interfaces": [] if tdef["kind"] == "OBJECT" else None,
+        "enumValues": None,
+        "possibleTypes": None,
+        "ofType": None,
+    }
+    if tdef["kind"] == "OBJECT":
+        out["fields"] = [
+            {
+                "name": fname,
+                "description": f.get("description") or None,
+                "args": [
+                    _render_input_value(an, a)
+                    for an, a in f["args"].items()
+                ],
+                "type": f["type"],
+                "isDeprecated": False,
+                "deprecationReason": None,
+            }
+            for fname, f in tdef["fields"].items()
+        ]
+    elif tdef["kind"] == "INPUT_OBJECT":
+        out["inputFields"] = [
+            _render_input_value(an, a)
+            for an, a in tdef["inputFields"].items()
+        ]
+    elif tdef["kind"] == "ENUM":
+        out["enumValues"] = [
+            {"name": v, "description": None, "isDeprecated": False,
+             "deprecationReason": None}
+            for v in tdef["enumValues"]
+        ]
+    return out
+
+
+def render_schema(reg: Dict[str, dict]) -> dict:
+    """The full ``__schema`` response document."""
+    return {
+        "queryType": {"kind": "OBJECT", "name": "Query", "ofType": None},
+        "mutationType": {"kind": "OBJECT", "name": "Mutation",
+                         "ofType": None},
+        "subscriptionType": None,
+        "types": [render_type(t) for n, t in sorted(reg.items())],
+        "directives": [
+            {
+                "name": "include",
+                "description": None,
+                "locations": ["FIELD", "FRAGMENT_SPREAD", "INLINE_FRAGMENT"],
+                "args": [_render_input_value("if", arg(nn(BOOLEAN)))],
+            },
+            {
+                "name": "skip",
+                "description": None,
+                "locations": ["FIELD", "FRAGMENT_SPREAD", "INLINE_FRAGMENT"],
+                "args": [_render_input_value("if", arg(nn(BOOLEAN)))],
+            },
+        ],
+    }

@@ -205,13 +205,13 @@ async function waterfallView(projectId) {
 
 async function patchesView() {
   const data = await gql(
-    "{ patches(limit: 30) { _id project author description status " +
+    "{ patches(limit: 30) { id project author description status " +
     "version create_time } }");
   return [
     el("h2", {}, "Patches"),
     table(["patch", "project", "author", "status", "description"],
       data.patches.map(p => tr([
-        el("a", { href: `#/patch/${p._id}` }, p._id),
+        el("a", { href: `#/patch/${p.id}` }, p.id),
         [p.project], [p.author], statusCell(p.status),
         [(p.description || "").slice(0, 60)],
       ]))),

@@ -1,0 +1,186 @@
+"""The generated GraphQL type system (api/schema.py): registry/resolver
+agreement, typed introspection, unknown-field validation, __typename.
+
+Reference parity: gqlgen's generated schema + introspection
+(/root/reference/graphql/generated.go, graphql/schema/*.graphql).
+"""
+import pytest
+
+from evergreen_tpu.api import schema as schema_mod
+from evergreen_tpu.api.graphql import GraphQLApi
+from evergreen_tpu.models.distro import Distro
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.task import Dependency, Task
+from evergreen_tpu.storage.store import Store
+
+
+@pytest.fixture()
+def store():
+    return Store()
+
+
+@pytest.fixture()
+def gql(store):
+    return GraphQLApi(store)
+
+
+def test_schema_and_resolver_registries_agree(gql):
+    """Every resolver has a schema field and vice versa — the analog of
+    gqlgen failing the build when schema and resolvers drift."""
+    reg = schema_mod.schema()
+    assert set(reg["Query"]["fields"]) == set(gql.queries)
+    assert set(reg["Mutation"]["fields"]) == set(gql.mutations)
+
+
+def test_schema_types_are_well_formed():
+    reg = schema_mod.schema()
+    for name, tdef in reg.items():
+        assert tdef is not None, f"unresolved type {name}"
+        assert tdef["name"] == name
+        if tdef["kind"] == "OBJECT":
+            for fname, fdef in tdef["fields"].items():
+                inner = schema_mod.named_type(fdef["type"])
+                assert inner in reg, (
+                    f"{name}.{fname} references undeclared type {inner!r}"
+                )
+                for aname, adef in fdef["args"].items():
+                    ainner = schema_mod.named_type(adef["type"])
+                    assert ainner in reg, (
+                        f"{name}.{fname}({aname}) references {ainner!r}"
+                    )
+
+
+def test_generated_task_type_matches_dataclass():
+    reg = schema_mod.schema()
+    fields = reg["Task"]["fields"]
+    assert "display_name" in fields and "depends_on" in fields
+    # private packing cache never leaks into the schema
+    assert "_qrow" not in fields
+    # list-of-dataclass maps to [Dependency!]!
+    dep = fields["depends_on"]["type"]
+    assert dep["kind"] == "NON_NULL"
+    assert dep["ofType"]["kind"] == "LIST"
+    assert schema_mod.named_type(dep) == "Dependency"
+    assert reg["Dependency"]["fields"]["unattainable"]["type"] == (
+        schema_mod.nn(schema_mod.BOOLEAN)
+    )
+
+
+def test_sensitive_fields_excluded():
+    reg = schema_mod.schema()
+    assert "secret" not in reg["Host"]["fields"]
+    assert "api_key" not in reg["User"]["fields"]
+
+
+def test_unknown_field_on_typed_object_errors(gql, store):
+    task_mod.insert(store, Task(id="t1", display_name="compile"))
+    out = gql.execute('{ task(taskId: "t1") { id displayNameTypo } }')
+    assert "displayNameTypo" in out["errors"][0]["message"]
+    assert "Task" in out["errors"][0]["message"]
+
+
+def test_nested_typed_selection_and_typename(gql, store):
+    task_mod.insert(
+        store,
+        Task(id="t1", display_name="compile",
+             depends_on=[Dependency(task_id="t0", status="success")]),
+    )
+    out = gql.execute(
+        '{ task(taskId: "t1") { __typename display_name '
+        "depends_on { __typename task_id } } }"
+    )
+    t = out["data"]["task"]
+    assert t["__typename"] == "Task"
+    assert t["depends_on"][0] == {"__typename": "Dependency",
+                                  "task_id": "t0"}
+
+
+def test_nested_unknown_field_errors(gql, store):
+    task_mod.insert(
+        store,
+        Task(id="t1", depends_on=[Dependency(task_id="t0")]),
+    )
+    out = gql.execute(
+        '{ task(taskId: "t1") { depends_on { task_id nope } } }'
+    )
+    assert "nope" in out["errors"][0]["message"]
+    assert "Dependency" in out["errors"][0]["message"]
+
+
+def test_json_scalar_fields_stay_permissive(gql, store):
+    """Raw store documents declared as JSON project any key."""
+    store.collection("project_refs").insert(
+        {"_id": "p1", "enabled": True, "branch": "main"}
+    )
+    out = gql.execute("{ projects { _id branch anything } }")
+    assert out["data"]["projects"][0]["branch"] == "main"
+    assert out["data"]["projects"][0]["anything"] is None
+
+
+def test_full_introspection_query(gql):
+    """The graphiql-style introspection query executes and returns typed
+    fields with ofType chains."""
+    out = gql.execute(
+        """
+        { __schema {
+            queryType { name }
+            mutationType { name }
+            types {
+              kind name
+              fields { name args { name type { kind name ofType { kind name } } defaultValue }
+                       type { kind name ofType { kind name ofType { kind name } } } }
+              inputFields { name type { kind name } }
+              enumValues { name }
+            }
+            directives { name locations }
+        } }
+        """
+    )
+    assert "errors" not in out, out.get("errors")
+    s = out["data"]["__schema"]
+    assert s["queryType"]["name"] == "Query"
+    by_name = {t["name"]: t for t in s["types"]}
+    task_fields = {f["name"]: f for f in by_name["Task"]["fields"]}
+    # Task.priority: Int!
+    pr = task_fields["priority"]["type"]
+    assert pr["kind"] == "NON_NULL" and pr["ofType"]["name"] == "Int"
+    # input object introspects its fields
+    vt = by_name["VariantTasksInput"]
+    assert vt["kind"] == "INPUT_OBJECT"
+    assert {f["name"] for f in vt["inputFields"]} == {"variant", "tasks"}
+    # enum meta-type
+    assert {v["name"] for v in by_name["__TypeKind"]["enumValues"]} >= {
+        "OBJECT", "SCALAR", "NON_NULL"
+    }
+    # query field args carry rendered defaults
+    q_fields = {f["name"]: f for f in by_name["Query"]["fields"]}
+    wf_args = {a["name"]: a for a in q_fields["waterfall"]["args"]}
+    assert wf_args["limit"]["defaultValue"] == "10"
+    assert wf_args["projectId"]["type"]["kind"] == "NON_NULL"
+
+
+def test_type_introspection_by_name(gql):
+    out = gql.execute(
+        '{ __type(name: "Host") { name kind fields { name } } }'
+    )
+    fields = {f["name"] for f in out["data"]["__type"]["fields"]}
+    assert "distro_id" in fields and "secret" not in fields
+    # unknown type -> null, not an error
+    out2 = gql.execute('{ __type(name: "Nope") { name } }')
+    assert out2["data"]["__type"] is None
+
+
+def test_distro_nested_settings_typed(gql, store):
+    distro_mod.insert(store, Distro(id="d1"))
+    out = gql.execute(
+        "{ distros { id planner_settings { version target_time_s } "
+        "host_allocator_settings { maximum_hosts } } }"
+    )
+    d = out["data"]["distros"][0]
+    assert d["planner_settings"]["version"] == "tpu"
+    assert isinstance(
+        d["host_allocator_settings"]["maximum_hosts"], int
+    )
+    bad = gql.execute("{ distros { planner_settings { nope } } }")
+    assert "PlannerSettings" in bad["errors"][0]["message"]

@@ -1,0 +1,125 @@
+"""Contract test: every GraphQL query embedded in the served UI
+(api/ui.py) executes cleanly against the typed schema over a seeded
+store.  Guards against UI/schema drift — a selection the generated type
+system rejects (e.g. `_id` on a generated entity type) must fail HERE,
+not silently in the browser.
+"""
+import re
+
+import pytest
+
+from evergreen_tpu.api.graphql import GraphQLApi
+from evergreen_tpu.api.ui import PAGE
+from evergreen_tpu.ingestion.patches import Patch
+from evergreen_tpu.models import build as build_mod
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models import user as user_mod
+from evergreen_tpu.models import version as version_mod
+from evergreen_tpu.models.build import Build
+from evergreen_tpu.models.distro import Distro
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.models.user import User
+from evergreen_tpu.models.version import Version
+from evergreen_tpu.storage.store import Store
+
+
+def extract_ui_queries(src: str):
+    """Pull each gql(...) first argument out of the page's JS: string
+    literals concatenated with `+` up to the closing `)` or the
+    variables object."""
+    queries = []
+    for m in re.finditer(r"gql\(", src):
+        tail = src[m.end():]
+        # balanced-paren scan (quote-aware) to find the call's closing ')'
+        depth, i, in_str = 1, 0, ""
+        while i < len(tail) and depth:
+            c = tail[i]
+            if in_str:
+                if c == "\\":
+                    i += 1
+                elif c == in_str:
+                    in_str = ""
+            elif c in "\"'`":
+                in_str = c
+            elif c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            i += 1
+        arg = tail[: i - 1]
+        # the variables object (`, { id: pid }`) contains no double-quoted
+        # literals, so joining all "..." pieces yields exactly the query
+        parts = re.findall(r'"((?:[^"\\]|\\.)*)"', arg)
+        q = "".join(parts).strip()
+        # skip the gql() helper definition itself — real call sites pass
+        # a document starting with '{', 'query', or 'mutation'
+        if q.startswith(("{", "query", "mutation")):
+            queries.append(q)
+    return queries
+
+
+def dummy_variables(query: str):
+    fills = {"String": "x", "Int": 1, "Float": 1.0, "Boolean": True}
+    out = {}
+    for name, typ in re.findall(r"\$(\w+)\s*:\s*(\w+)", query):
+        out[name] = fills.get(typ, "x")
+    return out
+
+
+@pytest.fixture()
+def seeded_store():
+    """One of every entity, all with id 'x' (the dummy variable value),
+    so selections actually project through non-null documents."""
+    store = Store()
+    distro_mod.insert(store, Distro(id="x"))
+    version_mod.insert(
+        store,
+        Version(id="x", project="x", requester="gitter_request",
+                revision="abc123", message="seed"),
+    )
+    build_mod.insert(store, Build(id="x", version="x", project="x"))
+    task_mod.insert(
+        store,
+        Task(id="x", display_name="seed-task", project="x", version="x",
+             build_id="x", build_variant="v1", distro_id="x"),
+    )
+    host_mod.insert(store, Host(id="x", distro_id="x"))
+    user_mod.coll(store).insert(
+        User(id="x", display_name="Seed").to_doc()
+    )
+    store.collection("project_refs").insert(
+        {"_id": "x", "enabled": True, "branch": "main"}
+    )
+    store.collection("patches").insert(
+        {**Patch(id="x", project="x", author="x",
+                 description="seed patch").to_doc()}
+    )
+    store.collection("task_logs").insert(
+        {"_id": "x", "lines": ["hello", "[agent] hi", "[system] sys"]}
+    )
+    return store
+
+
+def test_ui_page_embeds_queries():
+    qs = extract_ui_queries(PAGE)
+    assert len(qs) >= 5, f"extraction broke: {qs}"
+    assert any("patches" in q for q in qs)
+    assert any("waterfall" in q for q in qs)
+
+
+def test_every_ui_query_executes_without_errors(seeded_store):
+    gql = GraphQLApi(seeded_store)
+    for q in extract_ui_queries(PAGE):
+        out = gql.execute(q, dummy_variables(q))
+        assert "errors" not in out, (q, out.get("errors"))
+
+
+def test_patches_list_resolves_ids(seeded_store):
+    """The regression the typed schema exposed: the list view must get
+    real ids back (resolver adds `id`; `_id` is not in the Patch type)."""
+    gql = GraphQLApi(seeded_store)
+    out = gql.execute("{ patches(limit: 30) { id project status } }")
+    assert out["data"]["patches"][0]["id"] == "x"
