@@ -100,7 +100,20 @@ def _coerce_variable(name: str, t: dict, value: Any) -> Any:
         if not isinstance(value, str):
             raise GraphQLError(f"variable ${name} expects {tn}")
         return value
-    return value  # custom scalars / input objects / enums: pass through
+    tdef = schema_mod.schema().get(tn)
+    if tdef is not None and tdef["kind"] == "INPUT_OBJECT":
+        if not isinstance(value, dict):
+            raise GraphQLError(
+                f"variable ${name} expects input object {tn}"
+            )
+        for k in value:
+            if k not in tdef["inputFields"]:
+                raise GraphQLError(
+                    f"variable ${name}: unknown field {k!r} on input "
+                    f"object {tn}"
+                )
+        return value
+    return value  # custom scalars / enums: pass through
 
 
 def coerce_variables(
@@ -623,6 +636,17 @@ class GraphQLApi:
             return {"errors": [{"message": str(e)}]}
         except TypeError as e:
             return {"errors": [{"message": f"bad arguments: {e}"}]}
+        except Exception as e:  # resolver crash -> spec error entry, not
+            # an HTTP 500 (the gqlgen analog recovers resolver panics);
+            # the class name is kept, internals are not leaked
+            from ..utils.log import get_logger
+
+            get_logger("graphql").error(
+                "resolver crash", error=repr(e)
+            )
+            return {"errors": [{
+                "message": f"internal error: {type(e).__name__}"
+            }]}
 
     # -- query resolvers ------------------------------------------------------ #
 

@@ -168,6 +168,16 @@ def register_dataclass(
     asdict, so the wire names ARE the dataclass names."""
     tname = name or cls.__name__
     if tname in registry:
+        if exclude or extra or with_id:
+            # a nested-hint auto-registration got there first WITHOUT the
+            # exclusions — silently keeping it would expose the fields
+            # this call redacts. Fail loudly; fix = register this type
+            # earlier in schema().
+            raise RuntimeError(
+                f"type {tname!r} was already auto-registered without "
+                f"exclude={exclude!r}/extra/with_id — move its explicit "
+                "registration before whatever dataclass references it"
+            )
         return tname
     registry[tname] = None  # cycle guard (self-referential dataclasses)
     hints = typing.get_type_hints(cls)

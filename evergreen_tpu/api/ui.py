@@ -2,11 +2,16 @@
 
 The reference's UI surface is Spruce (a separate React app on the GraphQL
 API). This is the dependency-free stand-in: one HTML page with hash
-routing — overview (versions / hosts / events), distro queue views,
-version drill-down, task detail with logs/tests/artifacts over REST, plus
-a project waterfall grid and patch list/detail pages over the GraphQL
-endpoint (the same queries Spruce drives). Enough to watch and debug the
-system from a browser.
+routing, driving the same GraphQL queries and mutations Spruce does —
+overview (versions / hosts / events), distro queue views, a version page
+with a filterable/sortable/paginated task table and bulk restart, task
+detail with action buttons (restart/abort/schedule/unschedule/priority),
+sectioned log tabs, a filterable test table, build-baron annotations with
+issue editing, per-task mainline history, a hosts page, patch list/detail,
+a project waterfall grid, a project-settings editor (vars with private
+redaction round-trip), and an admin page (banner + service flags).
+Every gql() document embedded here is executed against the typed schema
+in CI (tests/test_ui_queries.py).
 """
 from __future__ import annotations
 
@@ -33,19 +38,41 @@ PAGE = """<!doctype html>
   a { color: #2457a7; text-decoration: none; cursor: pointer; }
   a:hover { text-decoration: underline; }
   .muted { color: #999; }
+  button { margin-right: 6px; margin-bottom: 4px; cursor: pointer; }
+  input, select { margin-right: 8px; padding: 1px 4px; }
+  .tabs a { margin-right: 10px; } .tabs .active { font-weight: bold; }
+  .histbox { display: inline-block; width: 13px; height: 13px;
+             margin-right: 2px; border-radius: 2px; background: #ccc; }
+  .histbox.success { background: #0a7d36; }
+  .histbox.failed { background: #c0392b; }
 </style>
 </head>
 <body>
 <h1>evergreen-tpu</h1>
 <nav><a href="#/">overview</a><a href="#/queues">queues</a><a
- href="#/waterfall">waterfall</a><a href="#/patches">patches</a></nav>
+ href="#/waterfall">waterfall</a><a href="#/patches">patches</a><a
+ href="#/hosts">hosts</a><a href="#/projects">projects</a><a
+ href="#/admin">admin</a></nav>
 <div id="statusbar">loading…</div>
 <div id="view"></div>
 <script>
-async function j(p) {
-  const r = await fetch(p);
+async function j(p, opts) {
+  const r = await fetch(p, opts);
   if (!r.ok) throw new Error(`${p} -> ${r.status}`);
   return r.json();
+}
+async function gql(query, variables) {
+  const data = await j("/graphql", {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({ query, variables: variables || {} }),
+  });
+  if (data.errors) throw new Error(data.errors.map(e => e.message).join("; "));
+  return data.data;
+}
+async function mut(query, variables) {
+  try { await gql(query, variables); } catch (err) { alert(err); }
+  route(false);
 }
 function el(tag, attrs, ...children) {
   const e = document.createElement(tag);
@@ -69,6 +96,7 @@ function tr(cells) {
       : el("td", { class: c[1] || "" }, String(c[0]))));
 }
 function statusCell(s) { return [s, s]; }
+function btn(label, fn) { return el("button", { onclick: fn }, label); }
 const view = document.getElementById("view");
 
 async function statusbar() {
@@ -88,84 +116,66 @@ async function overview() {
   const vrows = versions.slice(0, 15).map((v, i) => {
     const tasks = taskLists[i];
     const done = tasks.filter(t => t.status === "success").length;
+    const failed = tasks.filter(t => t.status === "failed").length;
     return tr([
       el("a", { href: `#/version/${v._id}` }, v._id),
-      [v.project], statusCell(v.status), [`${done}/${tasks.length} ok`],
+      [v.project], statusCell(v.status),
+      [`${done}/${tasks.length}${failed ? " ✗" + failed : ""}`,
+       failed ? "failed" : ""],
+      [(v.message || "").slice(0, 60)],
     ]);
   });
-  return [
-    el("h2", {}, "Recent versions"),
-    table(["version", "project", "status", "tasks"], vrows),
+  const parts = [
+    el("h2", {}, "Versions"),
+    table(["version", "project", "status", "tasks", "message"], vrows),
     el("h2", {}, "Hosts"),
     table(["host", "distro", "status", "running task"],
-      hosts.slice(0, 30).map(h => tr([
+      hosts.slice(0, 20).map(h => tr([
         [h._id], [h.distro_id], statusCell(h.status),
         h.running_task
           ? el("a", { href: `#/task/${h.running_task}` }, h.running_task)
           : ["—", "muted"],
       ]))),
     el("h2", {}, "Recent events"),
-    table(["type", "resource"],
-      events.slice(-20).reverse().map(e =>
-        tr([[e.event_type], [e.resource_id]]))),
+    // /rest/v2/events sorts ascending — newest are at the END
+    table(["type", "resource"], events.slice(-15).reverse().map(e =>
+      tr([[e.event_type], [e.resource_id]]))),
   ];
+  return parts;
 }
 
 async function queues() {
   const distros = await j("/rest/v2/distros");
-  // parallel fetch; 404 means "no queue yet" (empty), anything else is
-  // surfaced — an operator must be able to tell errors from empty queues
+  // per-distro failure isolation: a distro without a persisted queue
+  // 404s — render it as empty instead of failing the whole page
   const results = await Promise.all(distros.map(d =>
-    j(`/rest/v2/distros/${d._id}/queue`)
-      .then(q => ({ items: q.queue }))
-      .catch(e => String(e).includes("404") ? { items: [] }
-                                            : { error: String(e) })));
-  const blocks = [el("h2", {}, "Task queues")];
-  distros.forEach((d, k) => {
-    const r = results[k];
-    const planner = d.planner_settings
-      ? ` (${d.planner_settings.version})` : "";
-    if (r.error) {
+    j(`/rest/v2/distros/${d._id}/queue`).catch(() => ({ items: [] }))
+  ));
+  const blocks = [];
+  distros.forEach((d, i) => {
+    const r = results[i];
+    const planner = d.planner_settings && d.planner_settings.version
+      ? ` · planner ${d.planner_settings.version}` : "";
+    if (!r.items || !r.items.length) {
       blocks.push(el("h2", {}, `${d._id}${planner}`));
-      blocks.push(el("p", { class: "failed" }, r.error));
+      blocks.push(el("p", { class: "muted" }, "queue empty"));
       return;
     }
     blocks.push(el("h2", {},
       `${d._id} — ${r.items.length} queued${planner}`));
-    blocks.push(table(["#", "task", "group", "deps met", "expected s"],
-      r.items.slice(0, 20).map((i, n) => tr([
+    blocks.push(table(["#", "task", "project", "group", "deps met"],
+      r.items.slice(0, 50).map((it, n) => tr([
         [n + 1],
-        el("a", { href: `#/task/${i.id}` }, i.id),
-        [i.task_group || "—", i.task_group ? "" : "muted"],
-        [i.dependencies_met ? "yes" : "no",
-         i.dependencies_met ? "success" : "undispatched"],
-        [Math.round(i.expected_duration_s)],
+        el("a", { href: `#/task/${it.id}` }, it.display_name || it.id),
+        [it.project], [it.task_group || "—"],
+        [it.dependencies_met ? "yes" : "no",
+         it.dependencies_met ? "" : "muted"],
       ]))));
   });
   return blocks;
 }
 
-async function gql(query, variables) {
-  const r = await fetch("/graphql", {
-    method: "POST",
-    headers: { "Content-Type": "application/json" },
-    body: JSON.stringify({ query, variables: variables || {} }),
-  });
-  if (!r.ok) throw new Error(`/graphql -> ${r.status}`);
-  const out = await r.json();
-  if (out.errors) throw new Error(out.errors[0].message);
-  return out.data;
-}
-
-function cellClass(c) {
-  if (c.failed > 0) return "failed";
-  if (c.in_progress > 0) return "started";
-  if (c.success === c.total && c.total > 0) return "success";
-  return "undispatched";
-}
-
 async function waterfallView(projectId) {
-  // the Spruce waterfall grid over the GraphQL waterfall query
   const projects = (await gql("{ projects { _id } }")).projects;
   if (!projects.length) return [el("p", {}, "no projects yet")];
   const pid = projectId || projects[0]._id;
@@ -202,6 +212,12 @@ async function waterfallView(projectId) {
   parts.push(table(header, body));
   return parts;
 }
+function cellClass(c) {
+  if (c.failed) return "failed";
+  if (c.in_progress) return "started";
+  if (c.total && c.success === c.total) return "success";
+  return "";
+}
 
 async function patchesView() {
   const data = await gql(
@@ -234,6 +250,11 @@ async function patchView(pid) {
     el("p", {}, `variants: ${(p.variants || []).join(", ") || "—"} · ` +
       `tasks: ${(p.tasks || []).join(", ") || "—"}`),
   ];
+  if (!p.version) {
+    parts.push(btn("Schedule patch", () => mut(
+      "mutation SP($id: String!) { schedulePatch(patchId: $id) { id } }",
+      { id: p.id })));
+  }
   if (p.version) {
     parts.push(el("p", {}, "version: ",
       el("a", { href: `#/version/${p.version}` }, p.version)));
@@ -253,57 +274,377 @@ async function patchView(pid) {
   return parts;
 }
 
+// -- version page: filterable/sortable/paginated task table ------------- //
+let vtState = {};
 async function versionView(vid) {
-  const [v, tasks] = await Promise.all([
-    j(`/rest/v2/versions/${vid}`), j(`/rest/v2/versions/${vid}/tasks`),
-  ]);
-  return [
+  if (vtState.vid !== vid)  // filters/pagination are per-version
+    vtState = { vid, status: "", variant: "", name: "", sortBy: "NAME",
+                sortDir: "ASC", page: 0 };
+  const v = (await gql(
+    "query V($id: String!) { version(versionId: $id) " +
+    "{ id project status message revision requester errors } }",
+    { id: vid })).version;
+  if (!v) return [el("p", { class: "failed" }, `version ${vid} not found`)];
+  const vt = (await gql(
+    "query VT($v: String!, $st: [String!], $var: String, $n: String, " +
+    "$sb: String, $sd: String, $pg: Int) " +
+    "{ versionTasks(versionId: $v, statuses: $st, variant: $var, " +
+    "taskName: $n, sortBy: $sb, sortDir: $sd, limit: 25, page: $pg) " +
+    "{ tasks { id displayName status buildVariant priority execution " +
+    "expectedDurationS } totalCount filteredCount } }",
+    { v: vid, st: vtState.status ? [vtState.status] : null,
+      var: vtState.variant, n: vtState.name, sb: vtState.sortBy,
+      sd: vtState.sortDir, pg: vtState.page })).versionTasks;
+  const filters = el("p", {},
+    el("input", { placeholder: "task name", value: vtState.name,
+                  onchange: e => { vtState.name = e.target.value;
+                                   vtState.page = 0; route(false); } }),
+    el("input", { placeholder: "variant", value: vtState.variant,
+                  onchange: e => { vtState.variant = e.target.value;
+                                   vtState.page = 0; route(false); } }),
+    el("select", { onchange: e => { vtState.status = e.target.value;
+                                    vtState.page = 0; route(false); } },
+      ...["", "success", "failed", "started", "dispatched",
+          "undispatched"].map(s => el("option",
+        { value: s, selected: vtState.status === s }, s || "any status"))),
+    btn("sort name", () => { vtState.sortBy = "NAME"; flipDir(); }),
+    btn("sort status", () => { vtState.sortBy = "STATUS"; flipDir(); }),
+    btn("sort duration", () => { vtState.sortBy = "DURATION"; flipDir(); }),
+    ` ${vt.filteredCount}/${vt.totalCount} tasks · page ${vtState.page + 1} `,
+    btn("prev", () => { vtState.page = Math.max(0, vtState.page - 1);
+                        route(false); }),
+    btn("next", () => { vtState.page += 1; route(false); }),
+  );
+  const parts = [
     el("h2", {}, `Version ${vid}`),
     el("p", {}, `project ${v.project} · status `,
       el("span", { class: v.status }, v.status),
       ` · ${(v.message || "").slice(0, 120)}`),
-    table(["task", "variant", "status", "host"],
-      tasks.map(t => tr([
-        el("a", { href: `#/task/${t._id}` },
-          `${t.display_name || t._id}`),
-        [t.build_variant], statusCell(t.status),
-        [t.host_id || "—", t.host_id ? "" : "muted"],
+    el("p", {},
+      btn("Restart failed", () => mut(
+        "mutation RV($v: String!) { restartVersion(versionId: $v, " +
+        "failedOnly: true) { versionId restartedTaskIds } }", { v: vid })),
+      btn("Restart all", () => mut(
+        "mutation RA($v: String!) { restartVersion(versionId: $v, " +
+        "failedOnly: false) { versionId restartedTaskIds } }", { v: vid })),
+    ),
+    filters,
+    table(["task", "variant", "status", "priority", "exec"],
+      vt.tasks.map(t => tr([
+        el("a", { href: `#/task/${t.id}` }, t.displayName || t.id),
+        [t.buildVariant], statusCell(t.status), [t.priority],
+        [t.execution],
       ]))),
   ];
+  if ((v.errors || []).length) {
+    parts.push(el("h2", {}, "Config errors"));
+    parts.push(el("pre", {}, v.errors.join("\\n")));
+  }
+  return parts;
+}
+function flipDir() {
+  vtState.sortDir = vtState.sortDir === "ASC" ? "DESC" : "ASC";
+  route(false);
 }
 
+// -- task page: actions, history, log tabs, tests, annotations ---------- //
+let taskState = {};
 async function taskView(tid) {
-  const t = await j(`/rest/v2/tasks/${tid}`);
+  if (taskState.tid !== tid)  // tab/filter state is per-task
+    taskState = { tid, logTab: "all", testStatus: "" };
+  const t = (await gql(
+    "query T($id: String!) { task(taskId: $id) { id display_name status " +
+    "version build_variant project execution host_id activated priority " +
+    "details_type details_desc details_timed_out expected_duration_s " +
+    "start_time finish_time } }", { id: tid })).task;
+  if (!t) return [el("p", { class: "failed" }, `task ${tid} not found`)];
   const parts = [
     el("h2", {}, `Task ${t.display_name || tid}`),
     el("p", {},
       el("span", { class: t.status }, t.status),
       ` · version `, el("a", { href: `#/version/${t.version}` }, t.version),
-      ` · execution ${t.execution} · host ${t.host_id || "—"}`),
+      ` · execution ${t.execution} · host ${t.host_id || "—"}` +
+      (t.details_desc ? ` · ${t.details_desc}` : "") +
+      (t.details_timed_out ? " · TIMED OUT" : "")),
+    el("p", {},
+      btn("Restart", () => mut(
+        "mutation R($id: String!) { restartTask(taskId: $id) { id } }",
+        { id: tid })),
+      btn("Abort", () => mut(
+        "mutation A($id: String!) { abortTask(taskId: $id) { id } }",
+        { id: tid })),
+      t.activated
+        ? btn("Unschedule", () => mut(
+            "mutation U($id: String!) { unscheduleTask(taskId: $id) " +
+            "{ id } }", { id: tid }))
+        : btn("Schedule", () => mut(
+            "mutation S($id: String!) { scheduleTask(taskId: $id) " +
+            "{ id } }", { id: tid })),
+      btn(`Priority (${t.priority})`, () => {
+        const p = prompt("new priority", t.priority);
+        if (p !== null) mut(
+          "mutation P($id: String!, $p: Int!) " +
+          "{ setTaskPriority(taskId: $id, priority: $p) { id } }",
+          { id: tid, p: parseInt(p, 10) || 0 });
+      }),
+    ),
   ];
+  // mainline history strip
   try {
-    const tests = await j(`/rest/v2/tasks/${tid}/tests`);
-    if (tests.length) {
-      parts.push(el("h2", {}, "Test results"));
-      parts.push(table(["test", "status"],
-        tests.map(r => tr([[r.test_name], statusCell(r.status)]))));
+    const hist = (await gql(
+      "query H($n: String!, $bv: String!, $p: String!) " +
+      "{ taskHistory(taskName: $n, buildVariant: $bv, projectId: $p, " +
+      "limit: 30) { id status order revision } }",
+      { n: t.display_name, bv: t.build_variant, p: t.project }))
+      .taskHistory;
+    if (hist.length) {
+      parts.push(el("h2", {}, "History (mainline, newest first)"));
+      parts.push(el("p", {}, ...hist.map(h => el("a", {
+        href: `#/task/${h.id}`, class: `histbox ${h.status}`,
+        title: `${h.revision.slice(0, 8)} ${h.status}`,
+      }))));
     }
   } catch (e) {}
+  // test results with status filter
   try {
-    const arts = await j(`/rest/v2/tasks/${tid}/artifacts`);
+    const tt = (await gql(
+      "query TT($id: String!, $ex: Int, $st: [String!]) " +
+      "{ taskTests(taskId: $id, execution: $ex, statuses: $st, " +
+      "sortBy: \\"STATUS\\", sortDir: \\"DESC\\") " +
+      "{ testResults { testName status durationS logUrl } " +
+      "totalTestCount filteredTestCount } }",
+      { id: tid, ex: t.execution,
+        st: taskState.testStatus ? [taskState.testStatus] : null }))
+      .taskTests;
+    if (tt.totalTestCount) {
+      parts.push(el("h2", {},
+        `Test results (${tt.filteredTestCount}/${tt.totalTestCount}) `,
+        el("select", { onchange: e => {
+          taskState.testStatus = e.target.value; route(false); } },
+          ...["", "pass", "fail", "skip"].map(s => el("option",
+            { value: s, selected: taskState.testStatus === s },
+            s || "any")))));
+      parts.push(table(["test", "status", "duration"],
+        tt.testResults.map(r => tr([
+          r.logUrl ? el("a", { href: r.logUrl }, r.testName)
+                   : [r.testName],
+          statusCell(r.status), [`${r.durationS.toFixed(1)}s`],
+        ]))));
+    }
+  } catch (e) {}
+  // artifacts
+  try {
+    const arts = (await gql(
+      "query AR($id: String!, $ex: Int) { taskArtifacts(taskId: $id, " +
+      "execution: $ex) { name link visibility } }",
+      { id: tid, ex: t.execution })).taskArtifacts;
     if (arts.length) {
       parts.push(el("h2", {}, "Artifacts"));
       parts.push(table(["name", "link"],
-        arts.map(a => tr([[a.name],
-                          el("a", { href: a.link }, a.link)]))));
+        arts.filter(a => a.visibility !== "none").map(a => tr([
+          [a.name], el("a", { href: a.link }, a.link)]))));
     }
   } catch (e) {}
+  // annotations / build baron
   try {
-    const logs = await j(`/rest/v2/tasks/${tid}/logs`);
-    parts.push(el("h2", {}, "Logs"));
-    parts.push(el("pre", {},
-      (logs.lines || []).slice(-400).join("\\n") || "(empty)"));
+    const bb = (await gql(
+      "query BB($id: String!, $ex: Int) { buildBaron(taskId: $id, " +
+      "execution: $ex) { buildBaronConfigured " +
+      "suggestedIssues { url issue_key source } " +
+      "annotation { note issues { url issue_key added_by } " +
+      "suspected_issues { url issue_key added_by } } } }",
+      { id: tid, ex: t.execution })).buildBaron;
+    if (bb.buildBaronConfigured || t.status === "failed") {
+      parts.push(el("h2", {}, "Build baron"));
+      const ann = bb.annotation || {};
+      parts.push(el("p", {}, `note: ${ann.note || "—"} `,
+        btn("Edit note", () => {
+          const n = prompt("annotation note", ann.note || "");
+          if (n !== null) mut(
+            "mutation EN($id: String!, $ex: Int!, $n: String!) " +
+            "{ editAnnotationNote(taskId: $id, execution: $ex, " +
+            "note: $n) { note } }", { id: tid, ex: t.execution, n });
+        }),
+        btn("Add issue", () => {
+          const url = prompt("issue url");
+          if (url) mut(
+            "mutation AI($id: String!, $ex: Int!, $u: String!, " +
+            "$k: String) { addAnnotationIssue(taskId: $id, " +
+            "execution: $ex, url: $u, issueKey: $k) { note } }",
+            { id: tid, ex: t.execution, u: url,
+              k: url.split("/").pop() });
+        })));
+      const issues = (ann.issues || []).concat(ann.suspected_issues || []);
+      if (issues.length)
+        parts.push(table(["issue", "url", "added by"], issues.map(i => tr([
+          [i.issue_key || "—"], el("a", { href: i.url }, i.url),
+          [i.added_by || "—"]]))));
+      if ((bb.suggestedIssues || []).length)
+        parts.push(el("p", { class: "muted" },
+          `suggested: ${bb.suggestedIssues.map(s => s.issue_key)
+            .join(", ")}`));
+    }
   } catch (e) {}
+  // sectioned logs
+  try {
+    const logs = (await gql(
+      "query L($id: String!, $ex: Int) { taskLogs(taskId: $id, " +
+      "execution: $ex) { lines taskLogs agentLogs systemLogs " +
+      "eventLogs { eventType timestamp } } }",
+      { id: tid, ex: t.execution })).taskLogs;
+    const tabs = { all: logs.lines, task: logs.taskLogs,
+                   agent: logs.agentLogs, system: logs.systemLogs };
+    parts.push(el("h2", {}, "Logs"));
+    parts.push(el("p", { class: "tabs" },
+      ...Object.keys(tabs).concat(["event"]).map(name => el("a", {
+        class: taskState.logTab === name ? "active" : "",
+        onclick: () => { taskState.logTab = name; route(false); },
+      }, name))));
+    if (taskState.logTab === "event") {
+      parts.push(table(["event", "at"], logs.eventLogs.map(e => tr([
+        [e.eventType], [new Date(e.timestamp * 1000).toISOString()]]))));
+    } else {
+      const lines = tabs[taskState.logTab] || [];
+      parts.push(el("pre", {},
+        lines.slice(-400).join("\\n") || "(empty)"));
+    }
+  } catch (e) {}
+  return parts;
+}
+
+// -- hosts page --------------------------------------------------------- //
+const hostState = { distro: "" };
+async function hostsView() {
+  const data = await gql(
+    "query HS($d: String) { hosts(distroId: $d) { id distro_id provider " +
+    "status started_by running_task task_count " +
+    "last_communication_time } }", { d: hostState.distro });
+  return [
+    el("h2", {}, "Hosts"),
+    el("p", {},
+      el("input", { placeholder: "filter by distro",
+                    value: hostState.distro,
+                    onchange: e => { hostState.distro = e.target.value;
+                                     route(false); } }),
+      ` ${data.hosts.length} hosts`),
+    table(["host", "distro", "provider", "status", "started by",
+           "running task", "tasks run"],
+      data.hosts.map(h => tr([
+        [h.id], [h.distro_id], [h.provider], statusCell(h.status),
+        [h.started_by || "—"],
+        h.running_task
+          ? el("a", { href: `#/task/${h.running_task}` }, h.running_task)
+          : ["—", "muted"],
+        [h.task_count],
+      ]))),
+  ];
+}
+
+// -- project settings --------------------------------------------------- //
+async function projectsView() {
+  const projects = (await gql("{ projects { _id enabled branch } }"))
+    .projects;
+  return [
+    el("h2", {}, "Projects"),
+    table(["project", "branch", "enabled"], projects.map(p => tr([
+      el("a", { href: `#/project/${p._id}` }, p._id),
+      [p.branch || "—"],
+      [p.enabled === false ? "no" : "yes",
+       p.enabled === false ? "muted" : ""],
+    ]))),
+  ];
+}
+
+async function projectSettingsView(pid) {
+  const ps = (await gql(
+    "query PS($id: String!) { projectSettings(projectId: $id) " +
+    "{ projectRef vars { vars privateVars } aliases subscriptions } }",
+    { id: pid })).projectSettings;
+  if (!ps) return [el("p", { class: "failed" }, `project ${pid} not found`)];
+  const ref = ps.projectRef || {};
+  const parts = [
+    el("h2", {}, `Project ${pid}`),
+    table(["setting", "value"],
+      Object.entries(ref).filter(([k]) => k !== "_id").map(([k, v]) =>
+        tr([[k], [JSON.stringify(v)]]))),
+    el("h2", {}, "Variables (private values read back redacted)"),
+  ];
+  const varsObj = (ps.vars && ps.vars.vars) || {};
+  const priv = new Set((ps.vars && ps.vars.privateVars) || []);
+  parts.push(table(["name", "value", "private"],
+    Object.entries(varsObj).map(([k, v]) => tr([
+      [k], [v], [priv.has(k) ? "yes" : "no", priv.has(k) ? "" : "muted"],
+    ]))));
+  parts.push(el("p", {},
+    btn("Add variable", () => {
+      const k = prompt("variable name");
+      if (!k) return;
+      const v = prompt("value");
+      if (v === null) return;
+      const isPriv = confirm("private (redacted on read)?");
+      const newVars = { ...varsObj, [k]: v };
+      const newPriv = [...priv];
+      if (isPriv) newPriv.push(k);
+      mut(
+        "mutation SV($id: String!, $vars: ProjectVarsInput) " +
+        "{ saveProjectSettings(projectId: $id, vars: $vars) " +
+        "{ projectRef } }",
+        { id: pid, vars: { vars: newVars, privateVars: newPriv } });
+    })));
+  if ((ps.aliases || []).length) {
+    parts.push(el("h2", {}, "Patch aliases"));
+    parts.push(el("pre", {}, JSON.stringify(ps.aliases, null, 2)));
+  }
+  if ((ps.subscriptions || []).length) {
+    parts.push(el("h2", {}, "Subscriptions"));
+    parts.push(el("pre", {},
+      JSON.stringify(ps.subscriptions, null, 2).slice(0, 4000)));
+  }
+  return parts;
+}
+
+// -- admin page --------------------------------------------------------- //
+async function adminView() {
+  let settings;
+  try {
+    settings = await j("/rest/v2/admin/settings");
+  } catch (err) {
+    return [el("p", { class: "failed" },
+      "admin settings unavailable (admin scope required): " + err)];
+  }
+  async function setSection(sid, values) {
+    try {
+      await j("/rest/v2/admin/settings", {
+        method: "POST",
+        headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ [sid]: values }),
+      });
+    } catch (err) { alert(err); }
+    route(false);
+  }
+  const flags = settings.service_flags || {};
+  const ui = settings.ui || {};
+  const parts = [
+    el("h2", {}, "Service flags (degraded-mode circuit breakers)"),
+    table(["flag", "state", ""], Object.entries(flags)
+      .filter(([k]) => k !== "section_id")
+      .map(([k, v]) => tr([
+        [k], [v ? "DISABLED" : "enabled", v ? "failed" : "success"],
+        btn(v ? "enable" : "disable",
+            () => setSection("service_flags", { [k]: !v })),
+      ]))),
+    el("h2", {}, "Banner"),
+    el("p", {},
+      el("input", { id: "bannerText", value: ui.banner || "", size: 60 }),
+      btn("Set banner", () => setSection("ui", {
+        banner: document.getElementById("bannerText").value })),
+    ),
+    el("h2", {}, "Config sections"),
+    el("p", { class: "muted" },
+      `${Object.keys(settings).length} runtime-editable sections ` +
+      `(full editor via admin REST/CLI): ` +
+      Object.keys(settings).sort().join(", ")),
+  ];
   return parts;
 }
 
@@ -321,6 +662,11 @@ async function route(isRefresh) {
       nodes = await waterfallView(h.slice(12) || "");
     else if (h === "#/patches") nodes = await patchesView();
     else if (h.startsWith("#/patch/")) nodes = await patchView(h.slice(8));
+    else if (h === "#/hosts") nodes = await hostsView();
+    else if (h === "#/projects") nodes = await projectsView();
+    else if (h.startsWith("#/project/"))
+      nodes = await projectSettingsView(h.slice(10));
+    else if (h === "#/admin") nodes = await adminView();
     else nodes = await overview();
     if (my !== gen) return;  // user navigated while we were fetching
     view.replaceChildren(...nodes);
@@ -337,7 +683,8 @@ window.addEventListener("hashchange", () => route(false));
 route(false);
 setInterval(() => {  // background refresh only on the live views
   const h = location.hash || "#/";
-  if (h === "#/" || h === "#/queues" || h.startsWith("#/waterfall"))
+  if (h === "#/" || h === "#/queues" || h.startsWith("#/waterfall") ||
+      h === "#/hosts")
     route(true);
 }, 5000);
 </script>

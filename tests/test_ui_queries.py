@@ -27,11 +27,12 @@ from evergreen_tpu.storage.store import Store
 
 
 def extract_ui_queries(src: str):
-    """Pull each gql(...) first argument out of the page's JS: string
-    literals concatenated with `+` up to the closing `)` or the
-    variables object."""
+    """Pull each gql(...)/mut(...) first argument out of the page's JS:
+    string literals concatenated with `+` up to the closing `)` or the
+    variables object.  mut() is the mutation wrapper — its documents
+    must validate too (the drift class this test exists to catch)."""
     queries = []
-    for m in re.finditer(r"gql\(", src):
+    for m in re.finditer(r"(?:gql|mut)\(", src):
         tail = src[m.end():]
         # balanced-paren scan (quote-aware) to find the call's closing ')'
         depth, i, in_str = 1, 0, ""
@@ -50,10 +51,10 @@ def extract_ui_queries(src: str):
                 depth -= 1
             i += 1
         arg = tail[: i - 1]
-        # the variables object (`, { id: pid }`) contains no double-quoted
-        # literals, so joining all "..." pieces yields exactly the query
+        arg = _first_argument(arg)
         parts = re.findall(r'"((?:[^"\\]|\\.)*)"', arg)
-        q = "".join(parts).strip()
+        # unescape JS string escapes (\" inside GraphQL string literals)
+        q = "".join(parts).replace('\\"', '"').strip()
         # skip the gql() helper definition itself — real call sites pass
         # a document starting with '{', 'query', or 'mutation'
         if q.startswith(("{", "query", "mutation")):
@@ -61,11 +62,39 @@ def extract_ui_queries(src: str):
     return queries
 
 
+def _first_argument(arg: str) -> str:
+    """Truncate at the first top-level comma so string literals inside
+    the variables object (e.g. url.split("/")) are not mistaken for
+    query text."""
+    depth, in_str, skip = 0, "", False
+    for i, c in enumerate(arg):
+        if skip:
+            skip = False
+            continue
+        if in_str:
+            if c == "\\":
+                skip = True
+            elif c == in_str:
+                in_str = ""
+        elif c in "\"'`":
+            in_str = c
+        elif c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        elif c == "," and depth == 0:
+            return arg[:i]
+    return arg
+
+
 def dummy_variables(query: str):
-    fills = {"String": "x", "Int": 1, "Float": 1.0, "Boolean": True}
+    fills = {"String": "x", "ID": "x", "Int": 1, "Float": 1.0,
+             "Boolean": True}
     out = {}
-    for name, typ in re.findall(r"\$(\w+)\s*:\s*(\w+)", query):
-        out[name] = fills.get(typ, "x")
+    for name, typ in re.findall(r"\$(\w+)\s*:\s*\[?(\w+)", query):
+        filled = fills.get(typ, {})  # input objects fill as {}
+        # list-typed variables coerce single values per the spec
+        out[name] = filled
     return out
 
 
@@ -105,9 +134,13 @@ def seeded_store():
 
 def test_ui_page_embeds_queries():
     qs = extract_ui_queries(PAGE)
-    assert len(qs) >= 5, f"extraction broke: {qs}"
+    assert len(qs) >= 15, f"extraction broke: {qs}"
     assert any("patches" in q for q in qs)
     assert any("waterfall" in q for q in qs)
+    # the mutation documents (mut() call sites) are extracted too
+    assert any(q.startswith("mutation") for q in qs)
+    assert any("restartVersion" in q for q in qs)
+    assert any("saveProjectSettings" in q for q in qs)
 
 
 def test_every_ui_query_executes_without_errors(seeded_store):
