@@ -639,6 +639,10 @@ class GraphQLApi:
         except Exception as e:  # resolver crash -> spec error entry, not
             # an HTTP 500 (the gqlgen analog recovers resolver panics);
             # the class name is kept, internals are not leaked
+            from ..storage.replica import ReplicaReadOnly
+
+            if isinstance(e, ReplicaReadOnly):
+                raise  # REST layer forwards/503s replica writes
             from ..utils.log import get_logger
 
             get_logger("graphql").error(

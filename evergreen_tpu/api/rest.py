@@ -70,6 +70,31 @@ _ADMIN_PATHS = re.compile(r"^/rest/v2/(admin/|distros/[^/]+$|projects/[^/]+$)")
 _LOGIN_PATHS = re.compile(r"^/(login(/redirect|/callback)?|logout)$")
 
 
+_GQL_COMMENT = re.compile(r"#[^\n]*")
+
+
+def _is_graphql_mutation(document: str) -> bool:
+    """True when the document's operation is a mutation. Fast path: after
+    stripping comments, a document starting with ``{`` or ``query`` is a
+    read and one starting with ``mutation`` is a write — no parse, so
+    the replica's hot read path (UI polling) pays nothing extra. Only
+    odd shapes (leading fragment definitions) take the full parse; an
+    unparseable document counts as a mutation so it forwards and fails
+    with the PRIMARY's error (identical executors, consistent answer)."""
+    head = _GQL_COMMENT.sub("", document).lstrip()
+    if head.startswith(("{", "query")):
+        return False
+    if head.startswith("mutation"):
+        return True
+    from .graphql import _Parser, _tokenize
+
+    try:
+        op, _, _ = _Parser(_tokenize(document)).parse_document()
+    except Exception:
+        return True
+    return op != "query"
+
+
 class RestApi:
     def __init__(
         self,
@@ -78,8 +103,14 @@ class RestApi:
         require_auth: bool = False,
         rate_limit_per_min: Optional[int] = None,
         user_manager=None,
+        forward_writes: bool = True,
     ) -> None:
         self.store = store
+        #: read replicas proxy mutations to the primary writer instead of
+        #: 503ing (reference: any app server writes to shared Mongo;
+        #: here writes serialize at the WAL writer). False restores the
+        #: 503-with-primary-hint behavior.
+        self.forward_writes = forward_writes
         self.svc = dispatcher_service or DispatcherService(store)
         self.require_auth = require_auth
         #: pluggable login manager (api/auth.py); None → built lazily from
@@ -281,9 +312,13 @@ class RestApi:
         headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Any]:
         body = body or {}
-        denied = self._authorize(method, path, headers or {})
+        headers = headers or {}
+        denied = self._authorize(method, path, headers)
         if denied is not None:
             return denied
+        forwarded = self._maybe_forward(method, path, body, headers)
+        if forwarded is not None:
+            return forwarded
         for m, pattern, handler in self._routes:
             if m != method:
                 continue
@@ -308,6 +343,89 @@ class RestApi:
                     # field) is a 400, not a WSGI stack trace
                     return 400, {"error": f"bad request: {e}"}
         return 404, {"error": f"no route for {method} {path}"}
+
+    # -- replica write forwarding ---------------------------------------- #
+
+    def _maybe_forward(
+        self, method: str, path: str, body: dict,
+        headers: Dict[str, str], raw: bytes = b"",
+    ) -> Optional[Tuple[int, Any]]:
+        """On a read replica, proxy mutating requests to the primary
+        BEFORE any local handler runs (no partial local side effects),
+        then tail the WAL so this replica immediately serves its own
+        write back (read-your-writes). Detection is up-front: non-GET
+        methods mutate, except /graphql documents whose operation parses
+        as a query."""
+        from ..storage.replica import ReplicaStore
+
+        if not self.forward_writes or method == "GET":
+            return None
+        store = self.store
+        if not isinstance(store, ReplicaStore) or not store.primary_url:
+            return None
+        if headers.get("x-evg-forwarded"):
+            # loop guard: a forwarded request must never hop again (a
+            # replica misconfigured to point at another replica degrades
+            # to the 503 path instead of ping-ponging)
+            return None
+        if path == "/graphql" and not _is_graphql_mutation(
+            body.get("query", "")
+        ):
+            return None  # queries serve locally from the WAL tail
+        return self._forward_to_primary(method, path, body, headers, raw)
+
+    def _forward_to_primary(
+        self, method: str, path: str, body: dict,
+        headers: Dict[str, str], raw: bytes = b"",
+    ) -> Tuple[int, Any]:
+        # Limitation (documented): the primary sees the REPLICA's socket
+        # address, so its pre-auth rate-limit bucket aggregates all users
+        # funneled through one replica (fail-closed: worst case spurious
+        # 429s, never a bypass). Post-auth limiting keys on the
+        # authenticated identity, which forwards intact.
+        import http.client
+        import urllib.error
+        import urllib.request
+
+        primary = self.store.primary_url.rstrip("/")
+        fwd_headers = {"Content-Type": JSON, "X-Evg-Forwarded": "1"}
+        for h in ("api-user", "api-key", "authorization", "cookie",
+                  # webhook HMAC + delivery metadata must survive the hop
+                  "x-hub-signature-256", "x-github-event",
+                  "x-github-delivery"):
+            if headers.get(h):
+                fwd_headers[h] = headers[h]
+        req = urllib.request.Request(
+            primary + path,
+            # raw bytes when given (webhook HMAC covers the exact body);
+            # otherwise re-serialize the parsed JSON
+            data=raw or json.dumps(body, default=str).encode(),
+            method=method,
+            headers=fwd_headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                status, resp_raw = resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            status, resp_raw = e.code, e.read()
+        except (OSError, ValueError, http.client.HTTPException):
+            return 503, {
+                "error": "this server is a read-only replica and the "
+                         "primary is unreachable",
+                "primary": self.store.primary_url,
+            }
+        try:
+            payload = json.loads(resp_raw or b"{}")
+        except json.JSONDecodeError:
+            payload = {"error": "primary returned a non-JSON response"}
+        if status < 500:
+            try:
+                # the primary journaled the write before responding —
+                # one poll makes it visible to this replica's reads
+                self.store.poll()
+            except OSError:
+                pass  # transient FS race; the tail thread catches up
+        return status, payload
 
     def wsgi_app(self, environ, start_response):
         method = environ["REQUEST_METHOD"]
@@ -339,7 +457,20 @@ class RestApi:
             start_response("200 OK", [("Content-Type", "text/html")])
             return [PAGE.encode()]
         if path == "/hooks/github":
-            status, payload = self._github_hook(raw, headers, body)
+            # replicas forward webhooks as RAW bytes (the HMAC signature
+            # covers the exact body); fall back to 503 if somehow a
+            # store write still fires locally
+            fwd = self._maybe_forward(method, path, body, headers, raw)
+            if fwd is not None:
+                status, payload = fwd
+            else:
+                try:
+                    status, payload = self._github_hook(raw, headers, body)
+                except ReplicaReadOnly as e:
+                    status, payload = 503, {
+                        "error": "this server is a read-only replica",
+                        "primary": e.primary_url,
+                    }
         else:
             # query-string params merge into the handler body (JSON body
             # keys win) so GET endpoints can take ?limit= / ?variants= /

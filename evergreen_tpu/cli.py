@@ -23,9 +23,10 @@ def cmd_service(args) -> int:
 
     lease = None
     if getattr(args, "replica_of", ""):
-        # Read replica: tail the primary's WAL, serve reads, 503 writes
-        # toward the primary (storage/replica.py). No lease, no job plane
-        # — background work belongs to the writer.
+        # Read replica: tail the primary's WAL, serve reads locally,
+        # and transparently FORWARD writes to the primary (rest.py
+        # _maybe_forward; read-your-writes via an immediate poll). No
+        # lease, no job plane — background work belongs to the writer.
         if not args.data_dir:
             print("--replica-of requires --data-dir", file=sys.stderr)
             return 2
@@ -42,8 +43,8 @@ def cmd_service(args) -> int:
         )
         server = api.serve(args.host, args.port)
         print(
-            f"evergreen-tpu READ REPLICA on {args.host}:{args.port} "
-            f"(primary: {args.replica_of})"
+            f"evergreen-tpu replica on {args.host}:{args.port} "
+            f"(reads local, writes forward to {args.replica_of})"
         )
         try:
             server.serve_forever()
@@ -629,8 +630,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-memory store); replicas sharing it coordinate "
                         "via a writer lease")
     s.add_argument("--replica-of", default="",
-                   help="run as a READ replica tailing --data-dir's WAL; "
-                        "writes get 503 pointing at this primary URL")
+                   help="run as a replica tailing --data-dir's WAL: "
+                        "reads serve locally, writes forward to this "
+                        "primary URL (503 with a hint if unreachable)")
     s.set_defaults(fn=cmd_service)
 
     a = sub.add_parser("agent", help="run a worker agent")

@@ -112,6 +112,11 @@ class ReplicaStore(Store):
         self.poll_interval_s = poll_interval_s
         #: thread-local write permission; only replay code sets .on
         self._applying = threading.local()
+        #: serializes poll()/_load_snapshot: the background tail thread
+        #: and REST threads doing post-forward catch-up polls must not
+        #: interleave (an older full-document put re-applied after a
+        #: newer one would undo the read-your-writes guarantee)
+        self._poll_lock = threading.Lock()
         self._wal_pos = 0
         #: identity of the snapshot we last loaded; a new checkpoint can
         #: replace the snapshot while leaving the WAL at/below our tail
@@ -187,7 +192,13 @@ class ReplicaStore(Store):
     def poll(self) -> int:
         """Apply every WAL record appended since the last poll; returns
         how many were applied. Handles the primary's checkpoint
-        truncation by reloading the snapshot and replaying from zero."""
+        truncation by reloading the snapshot and replaying from zero.
+        Thread-safe: callers (tail thread, post-forward catch-up) are
+        serialized."""
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> int:
         wal_path = os.path.join(self.data_dir, WAL_FILE)
         size = (
             os.path.getsize(wal_path) if os.path.exists(wal_path) else 0
