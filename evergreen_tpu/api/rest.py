@@ -72,6 +72,21 @@ _LOGIN_PATHS = re.compile(r"^/(login(/redirect|/callback)?|logout)$")
 
 _GQL_COMMENT = re.compile(r"#[^\n]*")
 
+#: GETs that WRITE (login state/session minting, task assignment) — they
+#: must forward to the primary like any other mutation
+_MUTATING_GETS = re.compile(
+    r"^/login/(redirect|callback)$"
+    r"|^/rest/v2/hosts/[^/]+/agent/next_task$"
+)
+#: POSTs that only read (validation, URL signing, test selection) — they
+#: serve locally so replicas keep offloading them and keep working when
+#: the primary is down
+_READONLY_POSTS = re.compile(
+    r"^/rest/v2/(projects/[^/]+/validate"
+    r"|artifacts/sign"
+    r"|tasks/[^/]+/select_tests)$"
+)
+
 
 def _is_graphql_mutation(document: str) -> bool:
     """True when the document's operation is a mutation. Fast path: after
@@ -358,7 +373,11 @@ class RestApi:
         as a query."""
         from ..storage.replica import ReplicaStore
 
-        if not self.forward_writes or method == "GET":
+        if not self.forward_writes:
+            return None
+        if method == "GET" and not _MUTATING_GETS.match(path):
+            return None
+        if method == "POST" and _READONLY_POSTS.match(path):
             return None
         store = self.store
         if not isinstance(store, ReplicaStore) or not store.primary_url:
@@ -390,6 +409,8 @@ class RestApi:
         primary = self.store.primary_url.rstrip("/")
         fwd_headers = {"Content-Type": JSON, "X-Evg-Forwarded": "1"}
         for h in ("api-user", "api-key", "authorization", "cookie",
+                  # agent protocol credentials
+                  "host-id", "host-secret",
                   # webhook HMAC + delivery metadata must survive the hop
                   "x-hub-signature-256", "x-github-event",
                   "x-github-delivery"):

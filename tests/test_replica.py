@@ -490,3 +490,61 @@ def test_concurrent_polls_never_regress(primary_server):
         t.join(timeout=5)
     assert not errors, f"document version regressed: {errors[:5]}"
     assert replica.collection("counters").get("c")["n"] == 299
+
+
+def test_forward_routing_decisions(tmp_path):
+    """Route-aware classification: mutating GETs forward, read-only
+    POSTs stay local."""
+    DurableStore(str(tmp_path))
+    replica = ReplicaStore(str(tmp_path), primary_url="http://127.0.0.1:9")
+    rapi = RestApi(replica)
+
+    # mutating GETs forward (unreachable primary → 503 with hint)
+    for path in ("/login/redirect",
+                 "/rest/v2/hosts/h1/agent/next_task"):
+        got = rapi._maybe_forward("GET", path, {}, {})
+        assert got is not None and got[0] == 503, path
+
+    # plain GETs and read-only POSTs stay local (None = run the handler)
+    assert rapi._maybe_forward("GET", "/rest/v2/distros", {}, {}) is None
+    for path in ("/rest/v2/projects/p/validate",
+                 "/rest/v2/artifacts/sign",
+                 "/rest/v2/tasks/t/select_tests"):
+        assert rapi._maybe_forward("POST", path, {}, {}) is None, path
+
+    # read-only POST actually works with the primary DOWN
+    st, out = rapi.handle("POST", "/rest/v2/projects/p/validate",
+                          {"config_yaml": "tasks: []"})
+    assert st == 200 and "issues" in out
+
+
+def test_agent_credentials_relay_through_replica(primary_server):
+    """An authenticated agent can drive the protocol via a replica: the
+    host-id/host-secret headers survive the forward hop."""
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models import host as host_mod
+
+    pstore, purl, data_dir = primary_server
+    host_mod.insert(
+        pstore,
+        Host(id="h-agent", distro_id="d1", status="running",
+             secret="s3cr3t"),
+    )
+    replica = ReplicaStore(str(data_dir), primary_url=purl)
+    replica.poll()
+    rapi = RestApi(replica, require_auth=True)
+    creds = {"host-id": "h-agent", "host-secret": "s3cr3t"}
+
+    # mutating GET: next_task forwards WITH credentials → 200 (empty
+    # queue, but authenticated)
+    st, out = rapi.handle(
+        "GET", "/rest/v2/hosts/h-agent/agent/next_task", {}, creds
+    )
+    assert st == 200, out
+
+    # bad secret still dies (at the replica's own auth, before any hop)
+    st, out = rapi.handle(
+        "GET", "/rest/v2/hosts/h-agent/agent/next_task", {},
+        {"host-id": "h-agent", "host-secret": "wrong"},
+    )
+    assert st == 401

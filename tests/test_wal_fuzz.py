@@ -1,0 +1,175 @@
+"""Crash-recovery fuzz for the durable engine (storage/durable.py).
+
+The reference gets crash safety from Mongo's journal; this engine claims
+the same contract from its own WAL. These tests attack that claim:
+recovery from a WAL truncated at EVERY byte offset must (a) never raise
+and (b) yield exactly the state of the longest complete-record prefix —
+no resurrection, no partial application, no reordering. Checkpoint
+crash-window tests cover a death between the snapshot rename and the WAL
+truncation (the design's stated any-point-recoverable property).
+"""
+import json
+import os
+import random
+
+from evergreen_tpu.storage.durable import (
+    SNAPSHOT_FILE,
+    WAL_FILE,
+    DurableStore,
+)
+
+
+def _expected_state(wal_bytes: bytes) -> dict:
+    """Reference model mirroring recovery semantics: complete records
+    apply in order; the torn final segment gets the engine's newline
+    repair, so if it happens to parse (crash after content, before the
+    newline) it APPLIES, and only unparseable junk is dropped."""
+    state: dict = {}
+    for line in wal_bytes.split(b"\n"):  # final element = torn tail or ""
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        coll = state.setdefault(rec["c"], {})
+        if rec["o"] == "p":
+            coll[rec["d"]["_id"]] = rec["d"]
+        elif rec["o"] == "pm":
+            for d in rec["ds"]:
+                coll[d["_id"]] = d
+        elif rec["o"] == "r":
+            coll.pop(rec["i"], None)
+        elif rec["o"] == "x":
+            coll.clear()
+    return {n: docs for n, docs in state.items() if True}
+
+
+def _dump_store(store: DurableStore) -> dict:
+    out = {}
+    with store._lock:
+        names = list(store._collections)
+    for name in names:
+        coll = store.collection(name)
+        out[name] = {d["_id"]: d for d in coll.find()}
+    return out
+
+
+def _seed_workload(store: DurableStore, seed: int = 7, ops: int = 120):
+    """Deterministic mixed workload: inserts, updates, removes, bulk
+    puts, and a clear, across three collections."""
+    rng = random.Random(seed)
+    names = ["tasks", "hosts", "events"]
+    live: dict = {n: set() for n in names}
+    for i in range(ops):
+        n = rng.choice(names)
+        coll = store.collection(n)
+        roll = rng.random()
+        if roll < 0.5 or not live[n]:
+            coll.upsert({"_id": f"{n}-{i}", "v": i, "blob": "x" * rng.randrange(40)})
+            live[n].add(f"{n}-{i}")
+        elif roll < 0.7:
+            doc_id = rng.choice(sorted(live[n]))
+            coll.update(doc_id, {"v": i * 1000})
+        elif roll < 0.85:
+            doc_id = rng.choice(sorted(live[n]))
+            coll.remove(doc_id)
+            live[n].discard(doc_id)
+        elif roll < 0.95:
+            coll.insert_many(
+                [{"_id": f"{n}-bulk-{i}-{k}", "v": k} for k in range(3)]
+            )
+            live[n] |= {f"{n}-bulk-{i}-{k}" for k in range(3)}
+        else:
+            coll.clear()
+            live[n] = set()
+
+
+def test_recovery_at_every_truncation_offset(tmp_path):
+    src = str(tmp_path / "src")
+    store = DurableStore(src)
+    _seed_workload(store)
+    store._journal.close()  # flush without checkpoint: WAL holds it all
+    wal = open(os.path.join(src, WAL_FILE), "rb").read()
+    assert len(wal) > 2000
+
+    # every offset is overkill at ~1 recovery/offset; sample densely and
+    # ALWAYS include record boundaries (both sides) and the full file
+    boundaries = [i + 1 for i, b in enumerate(wal) if b == 0x0A]
+    offsets = sorted(
+        set(range(0, len(wal) + 1, 97))
+        | set(boundaries)
+        | {b - 1 for b in boundaries}
+        | {len(wal)}
+    )
+    crash_dir = str(tmp_path / "crash")
+    for cut in offsets:
+        os.makedirs(crash_dir, exist_ok=True)
+        with open(os.path.join(crash_dir, WAL_FILE), "wb") as fh:
+            fh.write(wal[:cut])
+        recovered = DurableStore(crash_dir)
+        got = _dump_store(recovered)
+        want = _expected_state(wal[:cut])
+        got = {n: d for n, d in got.items() if d}
+        want = {n: d for n, d in want.items() if d}
+        assert got == want, f"divergence at truncation offset {cut}"
+        recovered._journal.close()
+        for f in os.listdir(crash_dir):
+            os.remove(os.path.join(crash_dir, f))
+
+
+def test_recovery_is_idempotent_across_restarts(tmp_path):
+    """Recover, recover again, recover after a checkpoint — state never
+    drifts."""
+    d = str(tmp_path / "data")
+    store = DurableStore(d)
+    _seed_workload(store, seed=11)
+    want = _dump_store(store)
+    store._journal.close()
+
+    s1 = DurableStore(d)
+    assert _dump_store(s1) == want
+    s1._journal.close()
+    s2 = DurableStore(d)
+    assert _dump_store(s2) == want
+    s2.checkpoint()
+    s2._journal.close()
+    s3 = DurableStore(d)
+    assert _dump_store(s3) == want
+    s3._journal.close()
+
+
+def test_crash_after_snapshot_rename_before_wal_truncate(tmp_path):
+    """The checkpoint's stated crash window: snapshot.json is already the
+    new state but the full WAL is still on disk. Replaying the whole WAL
+    over the snapshot must be a no-op (full-document puts, same tail)."""
+    d = str(tmp_path / "data")
+    store = DurableStore(d)
+    _seed_workload(store, seed=23)
+    want = _dump_store(store)
+    wal_before = open(os.path.join(d, WAL_FILE), "rb").read()
+    store.checkpoint()
+    store._journal.close()
+    # resurrect the pre-checkpoint WAL next to the new snapshot
+    with open(os.path.join(d, WAL_FILE), "wb") as fh:
+        fh.write(wal_before)
+
+    recovered = DurableStore(d)
+    assert _dump_store(recovered) == want
+    recovered._journal.close()
+
+
+def test_crash_with_orphan_snapshot_tmp(tmp_path):
+    """Death between tmp write and rename: the .tmp file must be ignored
+    and the old snapshot + full WAL win."""
+    d = str(tmp_path / "data")
+    store = DurableStore(d)
+    _seed_workload(store, seed=31)
+    want = _dump_store(store)
+    store._journal.close()
+    with open(os.path.join(d, SNAPSHOT_FILE + ".tmp"), "w") as fh:
+        fh.write('{"collections": {"tasks": [{"_id": "GARBAGE"}]}}')
+
+    recovered = DurableStore(d)
+    got = _dump_store(recovered)
+    assert got == want
+    assert "GARBAGE" not in got.get("tasks", {})
+    recovered._journal.close()
