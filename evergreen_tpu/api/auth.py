@@ -502,15 +502,33 @@ def load_user_manager(
                 client=github_client,
             )
         if kind == "okta":
-            return OktaUserManager(
-                cfg.okta_client_id,
-                cfg.okta_client_secret,
-                cfg.okta_issuer,
-                user_group=getattr(cfg, "okta_user_group", ""),
-                expected_email_domains=getattr(
-                    cfg, "okta_expected_email_domains", []
+            # fall back to the okta_service section's credentials ONLY
+            # when the auth section configures no okta fields at all
+            # (reference config_okta_service.go). Never mix fields across
+            # the two sections — a partial auth config plus a separate
+            # service app would pair a client_id with the wrong secret.
+            from ..settings import OktaServiceConfig
+
+            if (cfg.okta_client_id or cfg.okta_client_secret
+                    or cfg.okta_issuer):
+                return OktaUserManager(
+                    cfg.okta_client_id,
+                    cfg.okta_client_secret,
+                    cfg.okta_issuer,
+                    user_group=getattr(cfg, "okta_user_group", ""),
+                    expected_email_domains=getattr(
+                        cfg, "okta_expected_email_domains", []
+                    )
+                    or [],
+                    client=oidc_client,
                 )
-                or [],
+            svc = OktaServiceConfig.get(store)
+            return OktaUserManager(
+                svc.client_id,
+                svc.client_secret,
+                svc.issuer,
+                user_group=svc.user_group,
+                expected_email_domains=svc.expected_email_domains or [],
                 client=oidc_client,
             )
         if kind == "api_only":
@@ -529,12 +547,13 @@ def load_user_manager(
             return make(cfg.preferred_type)
         except AuthError:
             pass
-    # precedence fallback (auth.go:34-51)
-    if cfg.okta_client_id and cfg.okta_issuer:
-        try:
-            return make("okta")
-        except AuthError:
-            pass
+    # precedence fallback (auth.go:34-51); okta credentials may come
+    # from either the auth section or the okta_service section — make()
+    # raises cleanly when neither is configured
+    try:
+        return make("okta")
+    except AuthError:
+        pass
     if getattr(cfg, "naive_users", None):
         return make("naive")
     if cfg.github_client_id and cfg.github_client_secret:

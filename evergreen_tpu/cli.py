@@ -89,6 +89,9 @@ def cmd_service(args) -> int:
 
     log_mod.reset_sinks(log_mod.json_line_sink, log_mod.StoreSink(store))
     log_mod.configure(store)
+    # (the host deploy transport resolves from the ssh config section at
+    # use time — see cloud/provisioning.get_transport — so runtime edits
+    # to that section apply without a restart)
     api = RestApi(
         store,
         require_auth=args.require_auth,

@@ -96,16 +96,88 @@ class FakeTransport(HostTransport):
         return True, ""
 
 
-_transport: HostTransport = LocalTransport()
+class SshTransport(HostTransport):
+    """Real ssh transport: pipes the script to ``bash -s`` on the host
+    (reference units/provisioning_agent_deploy.go RunSSHCommand over
+    jasper; here plain OpenSSH, configured by the ``ssh`` config section
+    — key paths, user, -o options). Selected via transport_from_config
+    when a key is configured; the zero-egress image keeps the default
+    LocalTransport."""
+
+    def __init__(self, user: str, key_path: str,
+                 options: Optional[List[str]] = None,
+                 connect_timeout_s: float = 10.0,
+                 script_timeout_s: float = 1800.0) -> None:
+        self.user = user
+        self.key_path = key_path
+        self.options = list(options or [])
+        self.connect_timeout_s = connect_timeout_s
+        self.script_timeout_s = script_timeout_s
+
+    def run_script(self, store: Store, host: Host, script: str) -> Tuple[bool, str]:
+        import subprocess
+
+        addr = host.ip_address or host.external_id or host.id
+        cmd = ["ssh", "-i", self.key_path,
+               "-o", f"ConnectTimeout={int(self.connect_timeout_s)}",
+               "-o", "BatchMode=yes"]
+        for opt in self.options:
+            cmd += ["-o", opt]
+        cmd.append(f"{self.user}@{addr}")
+        cmd.append("bash -s")
+        try:
+            proc = subprocess.run(
+                cmd, input=script.encode(), capture_output=True,
+                timeout=self.script_timeout_s,
+            )
+        except (subprocess.TimeoutExpired, OSError) as e:
+            return False, f"ssh transport error: {e}"
+        out = (proc.stdout + proc.stderr).decode(errors="replace")
+        return proc.returncode == 0, out
 
 
-def set_transport(t: HostTransport) -> None:
+def transport_from_config(store: Store) -> HostTransport:
+    """Build the deploy transport from the ``ssh`` config section: a
+    task-host key selects SshTransport, otherwise the in-image default
+    (agents as supervised subprocesses) stands."""
+    from ..settings import SshConfig
+
+    cfg = SshConfig.get(store)
+    if cfg.task_host_key_path:
+        return SshTransport(
+            cfg.user, cfg.task_host_key_path, cfg.options,
+            cfg.connect_timeout_s, cfg.script_timeout_s,
+        )
+    return LocalTransport()
+
+
+_transport: Optional[HostTransport] = None  # explicit injection (tests)
+_config_transport_cache: Optional[Tuple[float, HostTransport]] = None
+
+
+def set_transport(t: Optional[HostTransport]) -> None:
+    """Explicitly inject a transport (tests, embedders). None restores
+    config-driven resolution."""
     global _transport
     _transport = t
 
 
-def get_transport() -> HostTransport:
-    return _transport
+def get_transport(store: Optional[Store] = None) -> HostTransport:
+    """The deploy transport: an explicitly injected one wins; otherwise
+    resolve from the ``ssh`` config section at USE time (TTL-cached) so
+    runtime edits to the section take effect without a restart."""
+    global _config_transport_cache
+    if _transport is not None:
+        return _transport
+    if store is None:
+        return LocalTransport()
+    now = _time.monotonic()
+    cached = _config_transport_cache
+    if cached is not None and now - cached[0] < 5.0:
+        return cached[1]
+    t = transport_from_config(store)
+    _config_transport_cache = (now, t)
+    return t
 
 
 # --------------------------------------------------------------------------- #
@@ -260,7 +332,7 @@ def deploy_agent(
     failure counter and stamps agent liveness; failure increments it and
     poisons the host at the cap (reference
     provisioning_agent_deploy.go:186-295)."""
-    transport = transport or get_transport()
+    transport = transport or get_transport(store)
     ok, output = transport.run_script(
         store,
         h,

@@ -194,11 +194,16 @@ class JiraTransport:
     """Jira issue/comment creator (reference units/event_send.go jira
     senders over thirdparty/jira.go)."""
 
-    def __init__(self, host: str, timeout_s: float = 10.0) -> None:
+    def __init__(self, host: str, timeout_s: float = 10.0,
+                 custom_fields: Optional[Dict[str, Dict]] = None) -> None:
         if not host:
             raise DeliveryError("jira transport needs a host")
         self.host = host.rstrip("/")
         self.timeout_s = timeout_s
+        #: project key → {"fields": {...}, "components": [...],
+        #: "labels": [...]} from the jira_notifications config section
+        #: (reference config_jira_notifications.go)
+        self.custom_fields = custom_fields or {}
 
     def deliver(self, doc: dict) -> None:
         if doc.get("kind") == "jira-comment":
@@ -207,14 +212,22 @@ class JiraTransport:
             payload = {"body": doc.get("description", "")}
         else:
             url = f"{self.host}/rest/api/2/issue"
-            payload = {
-                "fields": {
-                    "project": {"key": doc.get("project_or_issue", "")},
-                    "summary": doc.get("summary", ""),
-                    "description": doc.get("description", ""),
-                    "issuetype": {"name": "Task"},
-                }
+            project = doc.get("project_or_issue", "")
+            fields = {
+                "project": {"key": project},
+                "summary": doc.get("summary", ""),
+                "description": doc.get("description", ""),
+                "issuetype": {"name": "Task"},
             }
+            custom = self.custom_fields.get(project) or {}
+            fields.update(custom.get("fields") or {})
+            if custom.get("components"):
+                fields["components"] = [
+                    {"name": c} for c in custom["components"]
+                ]
+            if custom.get("labels"):
+                fields["labels"] = list(custom["labels"])
+            payload = {"fields": fields}
         _post_json(url, payload, timeout_s=self.timeout_s)
 
 
@@ -255,7 +268,12 @@ def build_transports(store: Store) -> Dict[str, object]:
     if slack.api_url:
         out["slack"] = SlackTransport(slack.api_url, slack.token)
     if jira.host:
-        out["jira"] = JiraTransport(jira.host)
+        from ..settings import JiraNotificationsConfig
+
+        out["jira"] = JiraTransport(
+            jira.host,
+            custom_fields=JiraNotificationsConfig.get(store).custom_fields,
+        )
     return out
 
 

@@ -265,6 +265,50 @@ def _unpack_solve(
     return plans, sort_values, infos, new_hosts
 
 
+def _apply_release_mode(store: Store, distros):
+    """Release-window overrides applied at settings-resolution time
+    (reference model/distro/distro.go:680-748): scale auto-tunable
+    distros' max hosts and override the planner target time. Returns
+    REPLACED copies — cached distro objects are never mutated — and the
+    identical list when the section is inactive."""
+    import dataclasses as _dc
+    import math as _math
+
+    from ..settings import ReleaseModeConfig, ServiceFlags
+
+    if ServiceFlags.get(store).release_mode_disabled:
+        return distros
+    cfg = ReleaseModeConfig.get(store)
+    if not (cfg.distro_max_hosts_factor > 0
+            or cfg.target_time_seconds_override > 0):
+        return distros
+    out = []
+    for d in distros:
+        has, ps = d.host_allocator_settings, d.planner_settings
+        changed = False
+        if cfg.distro_max_hosts_factor > 0 and has.auto_tune_maximum_hosts:
+            has = _dc.replace(
+                has,
+                maximum_hosts=int(
+                    _math.ceil(
+                        has.maximum_hosts * cfg.distro_max_hosts_factor
+                    )
+                ),
+            )
+            changed = True
+        if cfg.target_time_seconds_override > 0:
+            ps = _dc.replace(
+                ps, target_time_s=float(cfg.target_time_seconds_override)
+            )
+            changed = True
+        out.append(
+            _dc.replace(d, host_allocator_settings=has,
+                        planner_settings=ps)
+            if changed else d
+        )
+    return out
+
+
 def run_tick(
     store: Store,
     opts: Optional[TickOptions] = None,
@@ -298,6 +342,8 @@ def run_tick(
             running_estimates,
             deps_met,
         ) = gather_tick_inputs(store, now)
+
+    distros = _apply_release_mode(store, distros)
 
     queues: Dict[str, int] = {}
     new_hosts: Dict[str, int] = {}

@@ -119,6 +119,7 @@ class ServiceFlags(ConfigSection):
     stepback_disabled: bool = False
     patching_disabled: bool = False
     generate_tasks_disabled: bool = False
+    release_mode_disabled: bool = False
 
 
 @register_section
@@ -604,3 +605,80 @@ class BucketsConfig(ConfigSection):
     log_bucket_name: str = ""
     test_results_bucket_name: str = ""
     long_retention_name: str = ""
+
+
+@register_section
+@dataclasses.dataclass
+class OktaServiceConfig(ConfigSection):
+    """Service-level Okta/OIDC credentials (reference
+    config_okta_service.go). The user-manager loader
+    (api/auth.py load_user_manager) falls back to this section when the
+    auth section's okta fields are empty — one credential set can serve
+    both interactive login and service auth."""
+
+    section_id = "okta_service"
+
+    client_id: str = ""
+    client_secret: str = ""
+    issuer: str = ""
+    user_group: str = ""
+    expected_email_domains: List[str] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@register_section
+@dataclasses.dataclass
+class SshConfig(ConfigSection):
+    """SSH key pairs + connection options for host transports (reference
+    config_ssh.go SSHConfig/SSHKeyPair; consumed by
+    cloud/provisioning.py SshTransport when a distro bootstraps over
+    ssh)."""
+
+    section_id = "ssh"
+
+    task_host_key_name: str = ""
+    #: private-key file path (the reference stores a Secrets Manager ARN;
+    #: here the parameter-store seam or a file path)
+    task_host_key_path: str = ""
+    spawn_host_key_name: str = ""
+    spawn_host_key_path: str = ""
+    user: str = "ubuntu"
+    connect_timeout_s: float = 10.0
+    #: bound on one deploy/setup script run — unrelated to connect time
+    #: (package installs on first provision can take minutes)
+    script_timeout_s: float = 1800.0
+    #: extra -o options, e.g. ["StrictHostKeyChecking=no"]
+    options: List[str] = dataclasses.field(default_factory=list)
+
+
+@register_section
+@dataclasses.dataclass
+class JiraNotificationsConfig(ConfigSection):
+    """Per-project custom fields/components/labels stamped onto created
+    Jira issues (reference config_jira_notifications.go; consumed by
+    events/transports.py JiraTransport)."""
+
+    section_id = "jira_notifications"
+
+    #: project key → {"fields": {name: value}, "components": [...],
+    #: "labels": [...]}
+    custom_fields: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+
+
+@register_section
+@dataclasses.dataclass
+class ReleaseModeConfig(ConfigSection):
+    """Release-window scheduler overrides (reference
+    config_release_mode.go, applied in distro settings resolution
+    model/distro/distro.go:680-748): scale auto-tunable distros' max
+    hosts, and override planner target time / host idle time. Gated by
+    service_flags.release_mode_disabled. Consumed by
+    scheduler/wrapper.py (settings resolution) and
+    units/host_jobs.py (idle termination)."""
+
+    section_id = "release_mode"
+
+    distro_max_hosts_factor: float = 0.0
+    target_time_seconds_override: int = 0
+    idle_time_seconds_override: int = 0

@@ -129,11 +129,21 @@ def terminate_idle_hosts(store: Store, now: Optional[float] = None) -> List[str]
     units/host_monitoring_idle_termination.go)."""
     now = _time.time() if now is None else now
     reaped: List[str] = []
+    # release-mode idle override takes precedence over distro + default
+    # (reference model/distro/distro.go:688-692)
+    from ..settings import ReleaseModeConfig, ServiceFlags
+
+    idle_override = 0
+    if not ServiceFlags.get(store).release_mode_disabled:
+        idle_override = ReleaseModeConfig.get(
+            store
+        ).idle_time_seconds_override
     for d in distro_mod.find_all(store):
         if not d.is_ephemeral():
             continue
-        cutoff = d.host_allocator_settings.acceptable_host_idle_time_s or (
-            DEFAULT_IDLE_CUTOFF_S
+        cutoff = idle_override or (
+            d.host_allocator_settings.acceptable_host_idle_time_s
+            or DEFAULT_IDLE_CUTOFF_S
         )
         hosts = host_mod.all_active_hosts(store, d.id)
         running = [h for h in hosts if h.status == HostStatus.RUNNING.value]

@@ -143,3 +143,218 @@ def test_rate_limit_config_feeds_rest_api_live(store):
     api0 = RestApi(store, rate_limit_per_min=0)
     for _ in range(5):
         assert api0.handle("GET", "/rest/v2/status", {}, hdrs)[0] == 200
+
+
+# --------------------------------------------------------------------------- #
+# Round-2 sections and their live consumers (reference
+# config_okta_service.go, config_ssh.go, config_jira_notifications.go,
+# config_release_mode.go)
+# --------------------------------------------------------------------------- #
+
+
+def test_okta_service_section_feeds_user_manager(store):
+    from evergreen_tpu.api.auth import OktaUserManager, load_user_manager
+    from evergreen_tpu.settings import AuthConfig, OktaServiceConfig
+
+    auth = AuthConfig.get_base(store)
+    auth.preferred_type = "okta"
+    auth.set(store)
+    svc = OktaServiceConfig.get_base(store)
+    svc.client_id = "svc-id"
+    svc.client_secret = "svc-secret"
+    svc.issuer = "https://okta.example.com"
+    svc.user_group = "engineers"
+    svc.set(store)
+
+    mgr = load_user_manager(store)
+    assert isinstance(mgr, OktaUserManager)
+    assert mgr.client_id == "svc-id"
+    assert mgr.user_group == "engineers"
+    # explicit auth-section credentials still win over the service ones
+    auth.okta_client_id = "auth-id"
+    auth.okta_client_secret = "auth-secret"
+    auth.okta_issuer = "https://other.example.com"
+    auth.set(store)
+    mgr2 = load_user_manager(store)
+    assert mgr2.client_id == "auth-id"
+
+
+def test_ssh_section_selects_ssh_transport(store):
+    import evergreen_tpu.cloud.provisioning as prov
+    from evergreen_tpu.cloud.provisioning import (
+        LocalTransport,
+        SshTransport,
+        get_transport,
+        set_transport,
+        transport_from_config,
+    )
+    from evergreen_tpu.settings import SshConfig
+
+    assert isinstance(transport_from_config(store), LocalTransport)
+    cfg = SshConfig.get_base(store)
+    cfg.task_host_key_path = "/etc/evg/task_host.pem"
+    cfg.user = "admin"
+    cfg.options = ["StrictHostKeyChecking=no"]
+    cfg.set(store)
+    t = transport_from_config(store)
+    assert isinstance(t, SshTransport)
+    assert t.user == "admin" and "StrictHostKeyChecking=no" in t.options
+    assert t.script_timeout_s == 1800.0
+
+    # the section is LIVE: get_transport(store) resolves at use time —
+    # a runtime edit takes effect without a restart
+    prev = prov._transport
+    try:
+        set_transport(None)
+        prov._config_transport_cache = None
+        assert isinstance(get_transport(store), SshTransport)
+        cfg.task_host_key_path = ""
+        cfg.set(store)
+        prov._config_transport_cache = None  # skip the 5s TTL
+        assert isinstance(get_transport(store), LocalTransport)
+        # explicit injection still wins
+        fake = prov.FakeTransport()
+        set_transport(fake)
+        assert get_transport(store) is fake
+    finally:
+        set_transport(prev)
+
+
+def test_ssh_transport_failure_is_clean(store):
+    """ssh to an unreachable host reports (False, output) — no raise."""
+    from evergreen_tpu.cloud.provisioning import SshTransport
+    from evergreen_tpu.models.host import Host
+
+    t = SshTransport("nobody", "/nonexistent/key", connect_timeout_s=1.0)
+    ok, out = t.run_script(
+        store, Host(id="h1", ip_address="127.0.0.1"), "echo hi"
+    )
+    assert ok is False
+    assert out  # some diagnostic text
+
+
+def test_jira_notifications_custom_fields(store):
+    from evergreen_tpu.events.transports import JiraTransport
+
+    t = JiraTransport(
+        "https://jira.example.com",
+        custom_fields={
+            "EVG": {
+                "fields": {"customfield_12345": "evergreen"},
+                "components": ["scheduler"],
+                "labels": ["auto-filed"],
+            }
+        },
+    )
+    captured = {}
+
+    def fake_post(url, payload, timeout_s=0):
+        captured["url"] = url
+        captured["payload"] = payload
+
+    import evergreen_tpu.events.transports as tr
+
+    orig = tr._post_json
+    tr._post_json = fake_post
+    try:
+        t.deliver({"kind": "jira-issue", "project_or_issue": "EVG",
+                   "summary": "task failed", "description": "boom"})
+    finally:
+        tr._post_json = orig
+    fields = captured["payload"]["fields"]
+    assert fields["customfield_12345"] == "evergreen"
+    assert fields["components"] == [{"name": "scheduler"}]
+    assert fields["labels"] == ["auto-filed"]
+    # other projects are untouched
+    tr._post_json = fake_post
+    try:
+        t.deliver({"kind": "jira-issue", "project_or_issue": "OTHER",
+                   "summary": "s", "description": "d"})
+    finally:
+        tr._post_json = orig
+    assert "customfield_12345" not in captured["payload"]["fields"]
+
+
+def test_release_mode_scales_auto_tune_distros(store):
+    import dataclasses
+
+    from evergreen_tpu.models.distro import (
+        Distro,
+        HostAllocatorSettings,
+        PlannerSettings,
+    )
+    from evergreen_tpu.scheduler.wrapper import _apply_release_mode
+    from evergreen_tpu.settings import ReleaseModeConfig, ServiceFlags
+
+    tunable = Distro(
+        id="auto",
+        host_allocator_settings=HostAllocatorSettings(
+            maximum_hosts=10, auto_tune_maximum_hosts=True
+        ),
+        planner_settings=PlannerSettings(target_time_s=60.0),
+    )
+    pinned = Distro(
+        id="pinned",
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=10),
+    )
+
+    # inactive section: identical list back
+    assert _apply_release_mode(store, [tunable, pinned]) == [tunable, pinned]
+
+    cfg = ReleaseModeConfig.get_base(store)
+    cfg.distro_max_hosts_factor = 1.5
+    cfg.target_time_seconds_override = 120
+    cfg.set(store)
+    out = _apply_release_mode(store, [tunable, pinned])
+    assert out[0].host_allocator_settings.maximum_hosts == 15
+    assert out[0].planner_settings.target_time_s == 120.0
+    # intentionally-pinned max hosts stays; target time still overrides
+    assert out[1].host_allocator_settings.maximum_hosts == 10
+    assert out[1].planner_settings.target_time_s == 120.0
+    # originals never mutate (they may be cached)
+    assert tunable.host_allocator_settings.maximum_hosts == 10
+
+    # the service flag kills it
+    flags = ServiceFlags.get_base(store)
+    flags.release_mode_disabled = True
+    flags.set(store)
+    assert _apply_release_mode(store, [tunable]) == [tunable]
+
+
+def test_release_mode_idle_override_reaps_sooner(store):
+    import time as _t
+
+    from evergreen_tpu.cloud.mock import MockCloudManager
+    from evergreen_tpu.globals import HostStatus, Provider
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.settings import ReleaseModeConfig
+    from evergreen_tpu.units.host_jobs import terminate_idle_hosts
+
+    MockCloudManager.reset()
+    now = _t.time()
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1", provider=Provider.MOCK.value,
+            host_allocator_settings=HostAllocatorSettings(
+                maximum_hosts=5, acceptable_host_idle_time_s=3600.0
+            ),
+        ),
+    )
+    host_mod.insert(
+        store,
+        Host(id="h1", distro_id="d1", provider=Provider.MOCK.value,
+             status=HostStatus.RUNNING.value,
+             start_time=now - 600, provision_time=now - 600,
+             last_communication_time=now - 600),
+    )
+    # idle 10min < distro's 1h cutoff: stays
+    assert terminate_idle_hosts(store, now=now) == []
+    # release mode says 5min: reaped
+    cfg = ReleaseModeConfig.get_base(store)
+    cfg.idle_time_seconds_override = 300
+    cfg.set(store)
+    assert terminate_idle_hosts(store, now=now) == ["h1"]

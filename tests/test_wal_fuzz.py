@@ -40,7 +40,7 @@ def _expected_state(wal_bytes: bytes) -> dict:
             coll.pop(rec["i"], None)
         elif rec["o"] == "x":
             coll.clear()
-    return {n: docs for n, docs in state.items() if True}
+    return state
 
 
 def _dump_store(store: DurableStore) -> dict:
