@@ -51,8 +51,8 @@ PAGE = """<!doctype html>
 <h1>evergreen-tpu</h1>
 <nav><a href="#/">overview</a><a href="#/queues">queues</a><a
  href="#/waterfall">waterfall</a><a href="#/patches">patches</a><a
- href="#/hosts">hosts</a><a href="#/projects">projects</a><a
- href="#/admin">admin</a></nav>
+ href="#/hosts">hosts</a><a href="#/spawn">spawn</a><a
+ href="#/projects">projects</a><a href="#/admin">admin</a></nav>
 <div id="statusbar">loading…</div>
 <div id="view"></div>
 <script>
@@ -540,6 +540,40 @@ async function hostsView() {
   ];
 }
 
+// -- spawn hosts (Spruce "My Hosts" / "My Volumes") --------------------- //
+async function spawnView() {
+  const uid = localStorage.getItem("evgUser") || "";
+  const parts = [
+    el("h2", {}, "Spawn hosts"),
+    el("p", {},
+      el("input", { placeholder: "user id", value: uid,
+                    onchange: e => { localStorage.setItem(
+                      "evgUser", e.target.value); route(false); } }),
+      uid ? ` showing hosts/volumes for ${uid}` : " enter a user id"),
+  ];
+  if (!uid) return parts;
+  const data = await gql(
+    "query MH($u: String!) { myHosts(userId: $u) { id distro_id status " +
+    "instance_type no_expiration expiration_time } " +
+    "myVolumes(userId: $u) { id size_gb availability_zone host_id " +
+    "no_expiration } }", { u: uid });
+  parts.push(el("h2", {}, `Hosts (${data.myHosts.length})`));
+  parts.push(table(["host", "distro", "status", "type", "expires"],
+    data.myHosts.map(h => tr([
+      [h.id], [h.distro_id], statusCell(h.status),
+      [h.instance_type || "—"],
+      [h.no_expiration ? "never"
+        : new Date(h.expiration_time * 1000).toISOString().slice(0, 16)],
+    ]))));
+  parts.push(el("h2", {}, `Volumes (${data.myVolumes.length})`));
+  parts.push(table(["volume", "size", "zone", "attached to"],
+    data.myVolumes.map(v => tr([
+      [v.id], [`${v.size_gb} GB`], [v.availability_zone || "—"],
+      [v.host_id || "—", v.host_id ? "" : "muted"],
+    ]))));
+  return parts;
+}
+
 // -- project settings --------------------------------------------------- //
 async function projectsView() {
   const projects = (await gql("{ projects { _id enabled branch } }"))
@@ -663,6 +697,7 @@ async function route(isRefresh) {
     else if (h === "#/patches") nodes = await patchesView();
     else if (h.startsWith("#/patch/")) nodes = await patchView(h.slice(8));
     else if (h === "#/hosts") nodes = await hostsView();
+    else if (h === "#/spawn") nodes = await spawnView();
     else if (h === "#/projects") nodes = await projectsView();
     else if (h.startsWith("#/project/"))
       nodes = await projectSettingsView(h.slice(10));

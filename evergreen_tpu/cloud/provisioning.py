@@ -152,7 +152,10 @@ def transport_from_config(store: Store) -> HostTransport:
 
 
 _transport: Optional[HostTransport] = None  # explicit injection (tests)
-_config_transport_cache: Optional[Tuple[float, HostTransport]] = None
+#: per-store (time, transport) — keyed weakly so two stores in one
+#: process never see each other's resolved transport, and dead stores
+#: don't pin entries
+_config_transport_cache: "weakref.WeakKeyDictionary" = None
 
 
 def set_transport(t: Optional[HostTransport]) -> None:
@@ -164,19 +167,24 @@ def set_transport(t: Optional[HostTransport]) -> None:
 
 def get_transport(store: Optional[Store] = None) -> HostTransport:
     """The deploy transport: an explicitly injected one wins; otherwise
-    resolve from the ``ssh`` config section at USE time (TTL-cached) so
-    runtime edits to the section take effect without a restart."""
+    resolve from the ``ssh`` config section at USE time (TTL-cached per
+    store) so runtime edits to the section take effect without a
+    restart."""
     global _config_transport_cache
     if _transport is not None:
         return _transport
     if store is None:
         return LocalTransport()
+    import weakref
+
+    if _config_transport_cache is None:
+        _config_transport_cache = weakref.WeakKeyDictionary()
     now = _time.monotonic()
-    cached = _config_transport_cache
+    cached = _config_transport_cache.get(store)
     if cached is not None and now - cached[0] < 5.0:
         return cached[1]
     t = transport_from_config(store)
-    _config_transport_cache = (now, t)
+    _config_transport_cache[store] = (now, t)
     return t
 
 

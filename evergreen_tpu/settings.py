@@ -682,3 +682,13 @@ class ReleaseModeConfig(ConfigSection):
     distro_max_hosts_factor: float = 0.0
     target_time_seconds_override: int = 0
     idle_time_seconds_override: int = 0
+
+    def validate_and_default(self) -> str:
+        if self.distro_max_hosts_factor < 0:
+            return "distro_max_hosts_factor must be >= 0"
+        if self.target_time_seconds_override < 0:
+            return "target_time_seconds_override must be >= 0"
+        if self.idle_time_seconds_override < 0:
+            # a negative cutoff would instantly reap every free host
+            return "idle_time_seconds_override must be >= 0"
+        return ""

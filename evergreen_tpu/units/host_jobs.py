@@ -141,9 +141,10 @@ def terminate_idle_hosts(store: Store, now: Optional[float] = None) -> List[str]
     for d in distro_mod.find_all(store):
         if not d.is_ephemeral():
             continue
-        cutoff = idle_override or (
-            d.host_allocator_settings.acceptable_host_idle_time_s
-            or DEFAULT_IDLE_CUTOFF_S
+        cutoff = (
+            idle_override if idle_override > 0
+            else (d.host_allocator_settings.acceptable_host_idle_time_s
+                  or DEFAULT_IDLE_CUTOFF_S)
         )
         hosts = host_mod.all_active_hosts(store, d.id)
         running = [h for h in hosts if h.status == HostStatus.RUNNING.value]

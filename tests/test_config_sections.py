@@ -358,3 +358,8 @@ def test_release_mode_idle_override_reaps_sooner(store):
     cfg.idle_time_seconds_override = 300
     cfg.set(store)
     assert terminate_idle_hosts(store, now=now) == ["h1"]
+    # a negative override can never be saved (it would instantly reap
+    # every free host) — validate_and_default blocks it
+    cfg.idle_time_seconds_override = -300
+    with pytest.raises(ValueError):
+        cfg.set(store)
