@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import abc
 import time as _time
+import weakref as _weakref
 from typing import Dict, List, Optional, Tuple
 
 from ..globals import HostStatus
@@ -155,7 +156,9 @@ _transport: Optional[HostTransport] = None  # explicit injection (tests)
 #: per-store (time, transport) — keyed weakly so two stores in one
 #: process never see each other's resolved transport, and dead stores
 #: don't pin entries
-_config_transport_cache: "weakref.WeakKeyDictionary" = None
+_config_transport_cache: "weakref.WeakKeyDictionary" = (
+    _weakref.WeakKeyDictionary()
+)
 
 
 def set_transport(t: Optional[HostTransport]) -> None:
@@ -170,15 +173,10 @@ def get_transport(store: Optional[Store] = None) -> HostTransport:
     resolve from the ``ssh`` config section at USE time (TTL-cached per
     store) so runtime edits to the section take effect without a
     restart."""
-    global _config_transport_cache
     if _transport is not None:
         return _transport
     if store is None:
         return LocalTransport()
-    import weakref
-
-    if _config_transport_cache is None:
-        _config_transport_cache = weakref.WeakKeyDictionary()
     now = _time.monotonic()
     cached = _config_transport_cache.get(store)
     if cached is not None and now - cached[0] < 5.0:

@@ -1,0 +1,166 @@
+"""Pallas TPU kernels for the solve's ragged per-distro reductions.
+
+The snapshot lays task columns out DISTRO-MAJOR (snapshot.py:
+``t_distro = np.repeat(d_arange, t_counts)``), so every per-distro
+aggregate is a reduction over one contiguous range of the flat task
+axis.  The lax path expresses those as 7 separate scatter-adds
+(``zeros(D).at[t_distro].add(x)``) — 7 passes over HBM, and scatters
+lower to serialized updates on TPU.  This kernel exploits the layout
+instead: a grid of (distro, tile) steps sweeps each distro's contiguous
+range once in 8×128 VMEM tiles, computes ALL SEVEN statistics from the
+same loaded tiles, and accumulates into one output row per distro —
+one pass over HBM, no scatters, regular DMA.
+
+This is the "ragged tiling" pattern the blueprint calls for (the
+long-context analog: geometric bucket padding + contiguous segments +
+masked block sweeps).  Raggedness is handled with scalar-prefetched
+offsets: the (d, k) grid step loads the k-th aligned tile overlapping
+distro d's range and masks elements outside ``[offs[d], offs[d+1])``,
+so distro boundaries need no alignment with tiles.
+
+The kernel is OPTIONAL: the lax segment path stays the default
+implementation, and an interpret-mode parity fuzzer
+(tests/test_pallas_kernels.py) pins the two paths equal on CPU.
+Enable in the solve with EVERGREEN_TPU_PALLAS=1 (TPU) or =interpret
+(CPU debugging); see ops/solve.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover — jax built without pallas
+    PALLAS_AVAILABLE = False
+
+#: tile geometry: 8 sublanes × 128 lanes of f32 — the minimum f32 tile
+ROWS = 8
+LANES = 128
+BLOCK = ROWS * LANES
+
+#: stat i lives in lane i of each distro's output row
+N_STATS = 7
+STAT_NAMES = (
+    "d_length", "d_deps_met", "d_expected_dur_s", "d_over_count",
+    "d_over_dur_s", "d_wait_over", "d_merge",
+)
+
+
+def k_blocks_for(t_counts) -> int:
+    """Static grid depth: the max number of BLOCK-aligned tiles any one
+    distro's contiguous range can overlap.  Computed host-side from the
+    real per-distro counts at snapshot-build time; bucketed to the next
+    power of two so distinct compiled grids grow only logarithmically
+    with queue depth."""
+    counts = np.asarray(t_counts, np.int64)
+    span = int(counts.max()) if counts.size else 0
+    # a range of c elements starting anywhere overlaps at most
+    # ceil(c / BLOCK) + 1 aligned tiles
+    k = (span + BLOCK - 1) // BLOCK + 1
+    return max(1, 1 << int(k - 1).bit_length())
+
+
+@functools.partial(jax.jit, static_argnames=("k_blocks", "interpret"))
+def fused_distro_stats(
+    t_valid, t_deps_met, t_expected_s, t_wait_dep_met_s, t_is_merge,
+    d_task_offset, d_thresh, *, k_blocks: int, interpret: bool = False,
+):
+    """All seven per-distro queue statistics in ONE ragged tile sweep.
+
+    Inputs are the flat distro-major task columns (any length; padded to
+    a tile multiple here), the (D+1,) element offsets of each distro's
+    contiguous range, and the (D,) per-distro duration threshold
+    (callers pre-clamp zeros to 1.0, mirroring the lax path).  Returns a
+    dict of 7 (D,) float32 arrays matching the lax segment path
+    (parity-fuzzed in interpret mode)."""
+    n = t_valid.shape[0]
+    nb = max(1, -(-n // BLOCK))  # tiles in the padded task axis
+    pad = nb * BLOCK - n
+
+    def prep(x):
+        x = x.astype(jnp.float32)
+        if pad:
+            x = jnp.pad(x, (0, pad))
+        return x.reshape(nb * ROWS, LANES)
+
+    cols = [prep(x) for x in (t_valid, t_deps_met, t_expected_s,
+                              t_wait_dep_met_s, t_is_merge)]
+    D = d_thresh.shape[0]
+    offs = d_task_offset.astype(jnp.int32)
+    th = d_thresh.astype(jnp.float32)
+
+    def tile_index(d, k, offs_ref, th_ref):
+        # the k-th aligned tile overlapping distro d's range, clamped so
+        # out-of-span grid steps re-load a valid tile (their mask is
+        # all-false, so the load is wasted but harmless)
+        return (jnp.minimum(offs_ref[d] // BLOCK + k, nb - 1), 0)
+
+    def kernel(offs_ref, th_ref, valid_ref, deps_ref, dur_ref, wait_ref,
+               merge_ref, out_ref):
+        d = pl.program_id(0)
+        k = pl.program_id(1)
+        start = offs_ref[d]
+        end = offs_ref[d + 1]
+        raw = start // BLOCK + k
+        tile = jnp.minimum(raw, nb - 1)
+        base = tile * BLOCK
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1)
+        idx = base + rows * LANES + lanes
+        # raw == tile: a clamped (out-of-span) step re-loads an earlier
+        # tile — its elements are in range but NOT this step's to count
+        in_range = (idx >= start) & (idx < end) & (raw == tile)
+
+        valid = in_range & (valid_ref[:] > 0.5)
+        deps = valid & (deps_ref[:] > 0.5)
+        dur = dur_ref[:]
+        thresh = th_ref[d]
+        over = deps & (dur > thresh)
+        wait_over = deps & (wait_ref[:] > thresh)
+        merge = deps & (merge_ref[:] > 0.5)
+
+        stats = (
+            jnp.sum(jnp.where(valid, 1.0, 0.0)),
+            jnp.sum(jnp.where(deps, 1.0, 0.0)),
+            jnp.sum(jnp.where(deps, dur, 0.0)),
+            jnp.sum(jnp.where(over, 1.0, 0.0)),
+            jnp.sum(jnp.where(over, dur, 0.0)),
+            jnp.sum(jnp.where(wait_over, 1.0, 0.0)),
+            jnp.sum(jnp.where(merge, 1.0, 0.0)),
+        )
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        partial = jnp.zeros((1, LANES), jnp.float32)
+        for i, s in enumerate(stats):
+            partial = partial + jnp.where(lane == i, s, 0.0)
+
+        @pl.when(k == 0)
+        def _():
+            out_ref[:] = partial
+
+        @pl.when(k != 0)
+        def _():
+            out_ref[:] = out_ref[:] + partial
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # offsets + thresholds
+        grid=(D, k_blocks),
+        in_specs=[pl.BlockSpec((ROWS, LANES), tile_index)] * 5,
+        out_specs=pl.BlockSpec(
+            (1, LANES), lambda d, k, offs_ref, th_ref: (d, 0)
+        ),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((D, LANES), jnp.float32),
+        interpret=interpret,
+    )(offs, th, *cols)
+    return {name: out[:, i] for i, name in enumerate(STAT_NAMES)}

@@ -165,8 +165,17 @@ def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
 # --------------------------------------------------------------------------- #
 
 
-def allocator(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-    """Batched utilization-based host allocation + queue aggregate info."""
+def allocator(
+    a: Dict[str, jnp.ndarray],
+    pallas_cfg: Tuple[bool, int, bool] = (False, 0, False),
+) -> Dict[str, jnp.ndarray]:
+    """Batched utilization-based host allocation + queue aggregate info.
+
+    ``pallas_cfg`` = (use, k_blocks, interpret): when enabled, the seven
+    task→distro aggregates come from ONE ragged tile sweep over the
+    contiguous distro-major task columns (ops/pallas_kernels.py) instead
+    of seven scatter-adds; the lax path stays the default and the
+    reference implementation (interpret-mode parity fuzzed)."""
     G = a["g_distro"].shape[0]
     D = a["d_valid"].shape[0]
     f32 = jnp.float32
@@ -244,9 +253,29 @@ def allocator(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     free_approx = _seg_sum(free_contrib, gd, D)
     d_free = _seg_sum(h_free.astype(f32), a["h_distro"], D)
     d_existing = _seg_sum(h_valid.astype(f32), a["h_distro"], D)
-    d_deps_met = _seg_sum(
-        jnp.where(deps_met, 1.0, 0.0), t_distro, D
-    )
+
+    use_pallas, k_blocks, pallas_interpret = pallas_cfg
+    if use_pallas and k_blocks > 0:
+        from .pallas_kernels import fused_distro_stats
+
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(a["d_task_count"]).astype(jnp.int32)]
+        )
+        fused = fused_distro_stats(
+            t_valid.astype(f32), a["t_deps_met"].astype(f32),
+            a["t_expected_s"].astype(f32),
+            a["t_wait_dep_met_s"].astype(f32),
+            a["t_is_merge"].astype(f32),
+            offsets, thresh_d,
+            k_blocks=k_blocks, interpret=pallas_interpret,
+        )
+        d_deps_met = fused["d_deps_met"]
+    else:
+        fused = None
+        d_deps_met = _seg_sum(
+            jnp.where(deps_met, 1.0, 0.0), t_distro, D
+        )
 
     # never exceed the number of dependency-met tasks (:113-118)
     required = jnp.where(
@@ -269,12 +298,20 @@ def allocator(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     required = jnp.where(a["d_valid"], required, 0.0)
 
     # ---- distro-level queue info (persisted, model/task_queue.go:48-78) ---- #
-    d_len = _seg_sum(t_valid.astype(f32), t_distro, D)
-    d_exp_dur = _seg_sum(jnp.where(deps_met, dur, 0.0), t_distro, D)
-    d_over_cnt = _seg_sum(over.astype(f32), t_distro, D)
-    d_over_dur = _seg_sum(jnp.where(over, dur, 0.0), t_distro, D)
-    d_wait_over = _seg_sum(wait_over.astype(f32), t_distro, D)
-    d_merge = _seg_sum(merge_met.astype(f32), t_distro, D)
+    if fused is not None:
+        d_len = fused["d_length"]
+        d_exp_dur = fused["d_expected_dur_s"]
+        d_over_cnt = fused["d_over_count"]
+        d_over_dur = fused["d_over_dur_s"]
+        d_wait_over = fused["d_wait_over"]
+        d_merge = fused["d_merge"]
+    else:
+        d_len = _seg_sum(t_valid.astype(f32), t_distro, D)
+        d_exp_dur = _seg_sum(jnp.where(deps_met, dur, 0.0), t_distro, D)
+        d_over_cnt = _seg_sum(over.astype(f32), t_distro, D)
+        d_over_dur = _seg_sum(jnp.where(over, dur, 0.0), t_distro, D)
+        d_wait_over = _seg_sum(wait_over.astype(f32), t_distro, D)
+        d_merge = _seg_sum(merge_met.astype(f32), t_distro, D)
 
     i32 = jnp.int32
     return {
@@ -303,25 +340,44 @@ def allocator(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
 # --------------------------------------------------------------------------- #
 
 
-def solve(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+def solve(
+    a: Dict[str, jnp.ndarray],
+    pallas_cfg: Tuple[bool, int, bool] = (False, 0, False),
+) -> Dict[str, jnp.ndarray]:
     """The whole scheduling tick on device: ordered queues + spawn counts."""
     out = planner(a)
-    out.update(allocator(a))
+    out.update(allocator(a, pallas_cfg))
     return out
 
 
 @functools.cache
 def _compiled_solve():
-    return jax.jit(solve)
+    return jax.jit(solve, static_argnums=(1,))
 
 
-def run_solve(arrays: Dict) -> Dict:
+def run_solve(arrays: Dict, pallas_cfg=(False, 0, False)) -> Dict:
     """Run the jitted solve on numpy inputs, returning numpy outputs.
     Compilation is cached per shape bucket (snapshot padding keeps the set
     of distinct shapes small under churn)."""
     fn = _compiled_solve()
-    out = fn(arrays)
+    out = fn(arrays, pallas_cfg)
     return {k: jax.device_get(v) for k, v in out.items()}
+
+
+def pallas_cfg_from_env(k_blocks: int) -> Tuple[bool, int, bool]:
+    """Resolve the optional pallas path from EVERGREEN_TPU_PALLAS:
+    "1" → pallas kernels (real TPU); "interpret" → pallas in interpreter
+    mode (CPU debugging/tests); anything else — including "0"/"off" and
+    typos — stays on the default lax path (fail-safe for an
+    experimental kernel)."""
+    import os
+
+    from .pallas_kernels import PALLAS_AVAILABLE
+
+    mode = os.environ.get("EVERGREEN_TPU_PALLAS", "")
+    if mode not in ("1", "interpret") or not k_blocks or not PALLAS_AVAILABLE:
+        return (False, 0, False)
+    return (True, k_blocks, mode == "interpret")
 
 
 # --------------------------------------------------------------------------- #
@@ -356,8 +412,8 @@ OUTPUT_SPEC = (
 )
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _packed_solve(bufs: Dict, layout_key):
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _packed_solve(bufs: Dict, layout_key, pallas_cfg=(False, 0, False)):
     """One fused result buffer: i32 outputs followed by the f32 outputs
     bitcast to i32, so the host pays exactly ONE device fetch per tick.
     Over the tunnel-attached TPU every blocking sync costs a full network
@@ -366,7 +422,7 @@ def _packed_solve(bufs: Dict, layout_key):
     from .packing import unpack
 
     a = unpack(bufs, layout_key)
-    out = solve(a)
+    out = solve(a, pallas_cfg)
     parts = [out[name] for name, kind, _ in OUTPUT_SPEC if kind == "i32"]
     parts += [
         jax.lax.bitcast_convert_type(out[name], jnp.int32)
@@ -388,7 +444,10 @@ def run_solve_packed(snapshot) -> Dict:
     """One tick's device work with four transfers total: three arena
     buffers up (batched into the jit dispatch), one packed result buffer
     down."""
-    buf = _packed_solve(snapshot.arena.buffers, snapshot.arena.layout_key())
+    buf = _packed_solve(
+        snapshot.arena.buffers, snapshot.arena.layout_key(),
+        pallas_cfg_from_env(getattr(snapshot, "k_blocks", 0)),
+    )
     buf_np = np.asarray(buf)
 
     N, _, _, G, _, D = snapshot.shape_key()

@@ -160,6 +160,12 @@ def build_memberships(
     )
 
 
+def _pallas_k_blocks(t_counts) -> int:
+    from ..ops.pallas_kernels import k_blocks_for
+
+    return k_blocks_for(t_counts)
+
+
 def _bucket(n: int, minimum: int = 32) -> int:
     """Round up to the next bucket size: powers of two interleaved with
     1.5× midpoints, so padding waste stays ≤ 50% while distinct compiled
@@ -198,6 +204,9 @@ class Snapshot:
     #: the task objects in flat (task_ids) order — lets result unpacking
     #: index tasks positionally instead of round-tripping through id dicts
     flat_tasks: List[Task] = None
+    #: static grid depth for the optional pallas ragged-tile reduction
+    #: (ops/pallas_kernels.k_blocks_for over the real per-distro counts)
+    k_blocks: int = 0
 
     def shape_key(self) -> Tuple[int, ...]:
         a = self.arrays
@@ -293,7 +302,8 @@ FIELD_KINDS: Dict[str, str] = {
     "h_running": "u8", "h_elapsed_s": "f32", "h_expected_s": "f32",
     "h_std_s": "f32",
     # distros [D]
-    "d_valid": "u8", "d_min_hosts": "i32", "d_max_hosts": "i32",
+    "d_task_count": "i32", "d_valid": "u8", "d_min_hosts": "i32",
+    "d_max_hosts": "i32",
     "d_future_fraction": "f32", "d_round_up": "u8", "d_feedback": "u8",
     "d_disabled": "u8", "d_ephemeral": "u8", "d_is_docker": "u8",
     "d_thresh_s": "f32", "d_patch_factor": "f32", "d_patch_tiq_factor": "f32",
@@ -641,6 +651,10 @@ def build_snapshot(
     ps_l = [d.planner_settings for d in distros]
     hs_l = [d.host_allocator_settings for d in distros]
     fill("d_valid", [True] * n_d)
+    # contiguous distro-major range lengths — the pallas ragged-tile
+    # reduction (ops/pallas_kernels.py) derives each distro's [start,
+    # end) from their cumulative sum
+    fill("d_task_count", t_counts)
     fill("d_min_hosts", [h.minimum_hosts for h in hs_l])
     fill("d_max_hosts", [h.maximum_hosts for h in hs_l])
     fill("d_future_fraction", [h.future_host_fraction for h in hs_l])
@@ -679,4 +693,5 @@ def build_snapshot(
         arrays=a,
         arena=arena,
         flat_tasks=flat_tasks,
+        k_blocks=_pallas_k_blocks(t_counts),
     )
