@@ -177,6 +177,10 @@ def get_transport(store: Optional[Store] = None) -> HostTransport:
         return _transport
     if store is None:
         return LocalTransport()
+    global _config_transport_cache
+    if _config_transport_cache is None:
+        # tolerate a nulled-out cache (defensive vs embedders/tests)
+        _config_transport_cache = _weakref.WeakKeyDictionary()
     now = _time.monotonic()
     cached = _config_transport_cache.get(store)
     if cached is not None and now - cached[0] < 5.0:

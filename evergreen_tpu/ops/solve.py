@@ -28,8 +28,6 @@ from jax import lax
 
 from ..globals import MAX_DURATION_PER_DISTRO_HOST_S
 
-_WEEK_S = 7.0 * 24.0 * 3600.0
-
 
 # Segment reductions spelled as scatter-reduce primitives directly
 # (jnp.zeros(n).at[seg].{add,max,min}), not via the jax.ops.segment_*
@@ -77,7 +75,6 @@ def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
 
     # ---- unit aggregates (scheduler/planner.go:310-340 unitInfo) ---------- #
     u_len = _seg_sum(m_valid.astype(f32), m_unit, U)
-    u_len_safe = jnp.maximum(u_len, 1.0)
     u_merge = _seg_max(gather(a["t_is_merge"].astype(jnp.int32)), m_unit, U) > 0
     u_patch = _seg_max(gather(a["t_is_patch"].astype(jnp.int32)), m_unit, U) > 0
     u_non_group = (
@@ -88,10 +85,14 @@ def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     )
     u_generate = _seg_max(gather(a["t_generate"].astype(jnp.int32)), m_unit, U) > 0
     u_stepback = _seg_max(gather(a["t_stepback"].astype(jnp.int32)), m_unit, U) > 0
-    u_tiq = _seg_sum(gather(a["t_time_in_queue_s"].astype(f32)), m_unit, U)
     u_max_priority = _seg_max(gather(a["t_priority"]), m_unit, U).astype(f32)
-    u_runtime = _seg_sum(gather(a["t_expected_s"].astype(f32)), m_unit, U)
     u_max_numdep = _seg_max(gather(a["t_num_dependents"]), m_unit, U).astype(f32)
+    # time-in-queue / runtime rank terms arrive precomputed from the
+    # snapshot builder (exact f64 there; an on-device f32 segment sum
+    # diverges from the f64 oracle past ~2^24 summed seconds)
+    u_tiq_term = a["u_tiq_term"]
+    u_mainline_hours = a["u_mainline_hours"]
+    u_runtime_term = a["u_runtime_term"]
 
     ud = a["u_distro"]
 
@@ -106,23 +107,17 @@ def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     # ---- computeRankValue (planner.go:223-268) ---------------------------- #
     patch_rank = jnp.trunc(a["d_patch_factor"][ud]) + jnp.trunc(
         a["d_patch_tiq_factor"][ud]
-    ) * jnp.floor((u_tiq / 60.0) / u_len_safe)
+    ) * u_tiq_term
     merge_rank = jnp.trunc(a["d_cq_factor"][ud])
-    avg_life = u_tiq / u_len_safe
-    mainline_rank = jnp.where(
-        avg_life < _WEEK_S,
-        jnp.trunc(a["d_mainline_tiq_factor"][ud])
-        * jnp.trunc((_WEEK_S - avg_life) / 3600.0),
-        0.0,
+    mainline_rank = (
+        jnp.trunc(a["d_mainline_tiq_factor"][ud]) * u_mainline_hours
     ) + jnp.where(u_stepback, jnp.trunc(a["d_stepback_factor"][ud]), 0.0)
 
     rank = 1.0 + jnp.where(
         u_patch, patch_rank, jnp.where(u_merge, merge_rank, mainline_rank)
     )
     rank = rank + jnp.trunc(a["d_numdep_factor"][ud] * u_max_numdep)
-    rank = rank + jnp.trunc(a["d_runtime_factor"][ud]) * jnp.floor(
-        (u_runtime / 60.0) / u_len_safe
-    )
+    rank = rank + jnp.trunc(a["d_runtime_factor"][ud]) * u_runtime_term
 
     u_value = priority * rank + u_len  # planner.go:209-217
 

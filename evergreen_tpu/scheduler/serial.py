@@ -149,9 +149,16 @@ def unit_value(
         contains_non_group = contains_non_group or not t.task_group
         contains_generate = contains_generate or t.generate_task
         contains_stepback = contains_stepback or t.is_stepback_activated()
-        time_in_queue_s += t.time_in_queue(now)
+        # whole seconds: the reference sums int64 nanoseconds
+        # (planner.go:318-322); integer seconds keep the f64 sum exact
+        # and order-independent, matching the snapshot builder's
+        # precomputed u_tiq_term bit-for-bit
+        time_in_queue_s += math.floor(t.time_in_queue(now))
         max_priority = max(max_priority, t.priority)
-        expected_runtime_s += t.fetch_expected_duration().average_s
+        # whole seconds, same rationale as time_in_queue_s above — keeps
+        # the sum exact in f64 and bit-identical to the snapshot
+        # builder's u_runtime_term
+        expected_runtime_s += math.floor(t.fetch_expected_duration().average_s)
         max_num_dependents = max(max_num_dependents, t.num_dependents)
 
     # computePriority (planner.go:271-304)

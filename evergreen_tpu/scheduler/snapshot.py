@@ -292,8 +292,13 @@ FIELD_KINDS: Dict[str, str] = {
     "t_deps_met": "u8", "t_seg": "i32",
     # memberships [M]
     "m_task": "i32", "m_unit": "i32", "m_valid": "u8",
-    # units [U]
-    "u_distro": "i32",
+    # units [U] — the three rank terms are precomputed host-side in f64
+    # (SURVEY §7 "precompute host-side"): an f32 device segment-sum of
+    # time-in-queue diverges from the f64 oracle past ~2^24 summed
+    # seconds, while the terms themselves (floor of per-unit averages)
+    # are small integers, exact in f32.
+    "u_distro": "i32", "u_tiq_term": "f32", "u_mainline_hours": "f32",
+    "u_runtime_term": "f32",
     # segments [G]
     "g_distro": "i32", "g_unnamed": "u8", "g_max_hosts": "i32",
     "g_valid": "u8",
@@ -559,6 +564,11 @@ def build_snapshot(
     from ..utils.native import get_evgpack
 
     evgpack = get_evgpack()
+    # scratch (host-only, not shipped to device): whole-second expected
+    # durations feeding the exact u_runtime_term sum below — floored in
+    # f64 before the f32 store, since casting first can round up across
+    # an integer
+    t_exp_floor = np.zeros(max(n_t, 1), np.float32)
     if evgpack is not None and n_t:
         cols = {
             name: a[name][:n_t]
@@ -569,6 +579,7 @@ def build_snapshot(
                 "t_wait_dep_met_s",
             )
         }
+        cols["t_expected_floor_s"] = t_exp_floor[:n_t]
         evgpack.pack_task_columns(
             flat_tasks, now, float(DEFAULT_TASK_DURATION_S),
             float(MAX_TASK_TIME_IN_QUEUE_S), cols
@@ -598,10 +609,19 @@ def build_snapshot(
         act = np.fromiter((t.activated_time for t in flat_tasks), np.float64, n_t)
         ingest = np.fromiter((t.ingest_time for t in flat_tasks), np.float64, n_t)
         basis = np.where(act > 0.0, act, ingest)
-        a["t_time_in_queue_s"][:n_t] = np.where(
-            basis > 0.0,
-            np.minimum(np.maximum(0.0, now - basis), MAX_TASK_TIME_IN_QUEUE_S),
-            0.0,
+        # floored in f64 before the f32 store (whole seconds — the
+        # reference sums int64 nanoseconds, planner.go:318-322 — and
+        # integer-valued sums are exact and order-independent in f64,
+        # making the per-unit rank terms below bit-identical to the
+        # serial oracle)
+        a["t_time_in_queue_s"][:n_t] = np.floor(
+            np.where(
+                basis > 0.0,
+                np.minimum(
+                    np.maximum(0.0, now - basis), MAX_TASK_TIME_IN_QUEUE_S
+                ),
+                0.0,
+            )
         )
         sched = np.fromiter(
             (t.scheduled_time for t in flat_tasks), np.float64, n_t
@@ -616,9 +636,9 @@ def build_snapshot(
         dur = np.fromiter(
             (t.expected_duration_s for t in flat_tasks), np.float64, n_t
         )
-        a["t_expected_s"][:n_t] = np.where(
-            dur > 0.0, dur, float(DEFAULT_TASK_DURATION_S)
-        )
+        exp64 = np.where(dur > 0.0, dur, float(DEFAULT_TASK_DURATION_S))
+        a["t_expected_s"][:n_t] = exp64
+        t_exp_floor[:n_t] = np.floor(exp64)
         fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
     fill("t_deps_met", t_dm_np[:n_t].view(np.bool_))
     fill("t_seg", t_seg_np[:n_t], pad=G - 1)
@@ -629,6 +649,36 @@ def build_snapshot(
     a["m_valid"][:n_m] = True
 
     fill("u_distro", u_distro, pad=D - 1)
+
+    # per-unit planner rank terms, exact in f64 (mirrors the serial
+    # oracle's arithmetic op-for-op: scheduler/serial.py unit_value /
+    # reference planner.go:223-268)
+    if n_m:
+        tiq64 = a["t_time_in_queue_s"][:n_t].astype(np.float64)
+        expf64 = t_exp_floor[:n_t].astype(np.float64)
+        u_tiq_sum = np.bincount(m_unit, weights=tiq64[m_task], minlength=n_u)
+        u_exp_sum = np.bincount(m_unit, weights=expf64[m_task], minlength=n_u)
+        u_len64 = np.maximum(
+            np.bincount(m_unit, minlength=n_u).astype(np.float64), 1.0
+        )
+        fill(
+            "u_tiq_term",
+            np.floor((u_tiq_sum / 60.0) / u_len64).astype(np.float32),
+        )
+        avg_life = u_tiq_sum / u_len64
+        week_s = 7 * 24 * 3600.0
+        fill(
+            "u_mainline_hours",
+            np.where(
+                avg_life < week_s,
+                np.trunc((week_s - avg_life) / 3600.0),
+                0.0,
+            ).astype(np.float32),
+        )
+        fill(
+            "u_runtime_term",
+            np.floor((u_exp_sum / 60.0) / u_len64).astype(np.float32),
+        )
 
     # segments
     fill("g_distro", [di for di, _ in seg_names], pad=D - 1)

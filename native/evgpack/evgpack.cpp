@@ -12,6 +12,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -55,7 +56,8 @@ double AsDouble(PyObject* obj, bool* ok) {
 //   int32:  t_priority, t_group_order, t_num_dependents
 //   uint8:  t_valid, t_is_merge, t_is_patch, t_stepback, t_generate,
 //           t_in_group
-//   float32: t_time_in_queue_s, t_expected_s, t_wait_dep_met_s
+//   float32: t_time_in_queue_s, t_expected_s, t_expected_floor_s,
+//            t_wait_dep_met_s
 PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   PyObject* tasks;
   double now;
@@ -92,11 +94,11 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   Py_buffer b_valid{}, b_merge{}, b_patch{}, b_stepback{}, b_generate{},
       b_in_group{};
   Py_buffer b_priority{}, b_group_order{}, b_numdep{};
-  Py_buffer b_tiq{}, b_expected{}, b_wait{};
+  Py_buffer b_tiq{}, b_expected{}, b_expected_floor{}, b_wait{};
   Py_buffer* all[] = {&b_valid,    &b_merge,       &b_patch, &b_stepback,
                       &b_generate, &b_in_group,    &b_priority,
                       &b_group_order, &b_numdep,   &b_tiq,   &b_expected,
-                      &b_wait};
+                      &b_expected_floor, &b_wait};
   int acquired = 0;
   bool ok = view("t_valid", 1, &b_valid) && ++acquired &&
             view("t_is_merge", 1, &b_merge) && ++acquired &&
@@ -109,6 +111,7 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
             view("t_num_dependents", 4, &b_numdep) && ++acquired &&
             view("t_time_in_queue_s", 4, &b_tiq) && ++acquired &&
             view("t_expected_s", 4, &b_expected) && ++acquired &&
+            view("t_expected_floor_s", 4, &b_expected_floor) && ++acquired &&
             view("t_wait_dep_met_s", 4, &b_wait) && ++acquired;
   if (!ok) {
     for (int i = 0; i < acquired; ++i) PyBuffer_Release(all[i]);
@@ -127,6 +130,7 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   auto* numdep = static_cast<int32_t*>(b_numdep.buf);
   auto* tiq = static_cast<float*>(b_tiq.buf);
   auto* expected = static_cast<float*>(b_expected.buf);
+  auto* expected_floor = static_cast<float*>(b_expected_floor.buf);
   auto* wait = static_cast<float*>(b_wait.buf);
 
   bool good = true;
@@ -177,15 +181,21 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
         // MAX_TASK_TIME_IN_QUEUE_S (globals.py) to bound float32 unit sums
         const double basis = activated > 0.0 ? activated : ingest;
         const double raw_tiq = basis > 0.0 && now > basis ? now - basis : 0.0;
-        tiq[i] = static_cast<float>(raw_tiq < max_tiq ? raw_tiq : max_tiq);
+        // floor in f64 BEFORE the f32 store: the f32 cast can round up
+        // across an integer, which would break the exact per-unit rank
+        // terms (snapshot.py u_tiq_term) vs the serial oracle
+        tiq[i] = static_cast<float>(
+            std::floor(raw_tiq < max_tiq ? raw_tiq : max_tiq));
         // Task.wait_since_dependencies_met
         const double start = sched > deps_met_t ? sched : deps_met_t;
         wait[i] = start > 0.0 && now > start
                       ? static_cast<float>(now - start)
                       : 0.0f;
         // Task.fetch_expected_duration default
-        expected[i] = static_cast<float>(duration > 0.0 ? duration
-                                                        : default_dur);
+        const double exp_dur = duration > 0.0 ? duration : default_dur;
+        expected[i] = static_cast<float>(exp_dur);
+        // whole-second copy feeding the exact u_runtime_term sum
+        expected_floor[i] = static_cast<float>(std::floor(exp_dur));
       }
       if (PyErr_Occurred()) good = false;
     }

@@ -206,11 +206,11 @@ def test_ssh_section_selects_ssh_transport(store):
     prev = prov._transport
     try:
         set_transport(None)
-        prov._config_transport_cache = None
+        prov._config_transport_cache.clear()
         assert isinstance(get_transport(store), SshTransport)
         cfg.task_host_key_path = ""
         cfg.set(store)
-        prov._config_transport_cache = None  # skip the 5s TTL
+        prov._config_transport_cache.clear()  # skip the 5s TTL
         assert isinstance(get_transport(store), LocalTransport)
         # explicit injection still wins
         fake = prov.FakeTransport()

