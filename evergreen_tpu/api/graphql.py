@@ -28,6 +28,9 @@ class GraphQLError(Exception):
     pass
 
 
+from .graphql_ops import SpruceOpsMixin  # noqa: E402 — needs GraphQLError
+
+
 #: sentinel distinguishing "no default" from "default null" in var defs
 _ABSENT = object()
 
@@ -524,7 +527,7 @@ def _project(
     return out
 
 
-class GraphQLApi:
+class GraphQLApi(SpruceOpsMixin):
     def __init__(self, store: Store, acting_user: str = "") -> None:
         self.store = store
         #: authenticated user performing this request (set by the REST
@@ -573,6 +576,10 @@ class GraphQLApi:
             "editAnnotationNote": self._m_edit_annotation_note,
             "saveProjectSettings": self._m_save_project_settings,
         }
+        # breadth tier (api/graphql_ops.py — spawn hosts, volumes,
+        # distro editor, project/repo settings, user prefs, admin, …)
+        self.queries.update(self._spruce_queries())
+        self.mutations.update(self._spruce_mutations())
 
     # -- entry --------------------------------------------------------------- #
 

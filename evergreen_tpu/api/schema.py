@@ -505,6 +505,13 @@ def schema() -> Dict[str, dict]:
         }),
     })
 
+    # breadth-tier operations (spawn/volume/distro-editor/project/repo/
+    # user/admin/quarantine — api/schema_ext.py, resolvers in
+    # api/graphql_ops.py)
+    from .schema_ext import extend as _extend_spruce
+
+    _extend_spruce(reg)
+
     _register_meta_types(reg)
     return reg
 

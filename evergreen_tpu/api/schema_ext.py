@@ -1,0 +1,638 @@
+"""Schema declarations for the Spruce breadth tier (api/graphql_ops.py).
+
+Field-for-field parity with the reference operation SDL
+(/root/reference/graphql/schema/{query,mutation}.graphql — see
+docs/GRAPHQL_DIFF.md for the machine-generated diff). Composite return
+shapes that exist only for one resolver are declared here; entity types
+come from the generated dataclass registry in api/schema.py.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .schema import (
+    BOOLEAN,
+    FLOAT,
+    INT,
+    JSON,
+    STRING,
+    arg,
+    field,
+    input_obj,
+    input_ref,
+    lst,
+    named,
+    nn,
+    obj,
+)
+
+
+def extend(reg: Dict[str, dict]) -> None:
+    """Register the breadth-tier operation fields + their composites."""
+
+    # -- composites -------------------------------------------------------- #
+    reg["ClientBinary"] = obj("ClientBinary", {
+        "os": field(nn(STRING)),
+        "arch": field(nn(STRING)),
+        "url": field(nn(STRING)),
+    })
+    reg["ClientConfig"] = obj("ClientConfig", {
+        "latestRevision": field(STRING),
+        "clientBinaries": field(nn(lst(nn(named("ClientBinary"))))),
+    })
+    reg["EventLogEntry"] = obj("EventLogEntry", {
+        "timestamp": field(nn(FLOAT)),
+        "eventType": field(nn(STRING)),
+        "resourceId": field(STRING),
+        "user": field(STRING),
+        "before": field(JSON),
+        "after": field(JSON),
+        "data": field(JSON),
+    })
+    reg["EventsPayload"] = obj("EventsPayload", {
+        "count": field(nn(INT)),
+        "eventLogEntries": field(nn(lst(nn(named("EventLogEntry"))))),
+    })
+    reg["TaskQueueDistro"] = obj("TaskQueueDistro", {
+        "id": field(nn(STRING)),
+        "taskCount": field(nn(INT)),
+        "hostCount": field(nn(INT)),
+    })
+    reg["GithubProjectConflicts"] = obj("GithubProjectConflicts", {
+        "prTestingIdentifiers": field(lst(nn(STRING))),
+        "commitQueueIdentifiers": field(lst(nn(STRING))),
+        "commitCheckIdentifiers": field(lst(nn(STRING))),
+    })
+    reg["Project"] = obj(
+        "Project",
+        {"id": field(nn(STRING)), "identifier": field(nn(STRING))},
+        description="project_ref document + id/identifier aliases; "
+                    "remaining fields project as JSON",
+    )
+    # loose document fields on Project (raw project_refs doc)
+    reg["Project"]["fields"].update({
+        k: field(JSON) for k in (
+            "display_name", "owner", "repo", "branch", "enabled",
+            "remote_path", "batch_time_minutes", "deactivate_previous",
+            "stepback_disabled", "stepback_bisect", "patching_disabled",
+            "dispatching_disabled", "default_distro", "repo_ref_id",
+            "hidden", "pr_testing_enabled", "commit_queue_enabled",
+            "github_checks_enabled", "_id",
+        )
+    })
+    reg["GroupedProjects"] = obj("GroupedProjects", {
+        "groupDisplayName": field(nn(STRING)),
+        "repo": field(JSON),
+        "projects": field(nn(lst(nn(named("Project"))))),
+    })
+    reg["RepoSettings"] = obj("RepoSettings", {
+        "repoRef": field(JSON),
+        "vars": field(JSON),
+        "aliases": field(lst(JSON)),
+    })
+    reg["PublicKey"] = obj("PublicKey", {
+        "name": field(nn(STRING)),
+        "key": field(nn(STRING)),
+    })
+    reg["UserConfig"] = obj("UserConfig", {
+        "user": field(nn(STRING)),
+        "api_key": field(nn(STRING)),
+        "api_server_host": field(nn(STRING)),
+        "ui_server_host": field(nn(STRING)),
+    })
+    reg["TaskTestResultSample"] = obj("TaskTestResultSample", {
+        "taskId": field(nn(STRING)),
+        "execution": field(nn(INT)),
+        "totalTestCount": field(nn(INT)),
+        "matchingFailedTestNames": field(nn(lst(nn(STRING)))),
+    })
+    reg["MainlineCommitVersion"] = obj("MainlineCommitVersion", {
+        "version": field(JSON),
+        "rolledUpVersions": field(JSON),
+    })
+    reg["MainlineCommits"] = obj("MainlineCommits", {
+        "versions": field(nn(lst(nn(named("MainlineCommitVersion"))))),
+        "nextPageOrderNumber": field(INT),
+        "prevPageOrderNumber": field(INT),
+    })
+    reg["BuildVariantTuple"] = obj("BuildVariantTuple", {
+        "buildVariant": field(nn(STRING)),
+        "displayName": field(nn(STRING)),
+    })
+    reg["Image"] = obj("Image", {
+        "id": field(nn(STRING)),
+        "distros": field(nn(lst(nn(named("Distro"))))),
+        "latestTask": field(JSON),
+    })
+    reg["VariantQuarantineStatus"] = obj("VariantQuarantineStatus", {
+        "projectIdentifier": field(nn(STRING)),
+        "buildVariant": field(nn(STRING)),
+        "quarantined": field(nn(BOOLEAN)),
+    })
+    reg["QuarantinedTest"] = obj("QuarantinedTest", {
+        "testName": field(nn(STRING)),
+        "status": field(nn(STRING)),
+    })
+    reg["CreatedTicket"] = obj("CreatedTicket", {
+        "key": field(nn(STRING)),
+        "taskId": field(nn(STRING)),
+    })
+    reg["NewDistroPayload"] = obj("NewDistroPayload", {
+        "newDistroId": field(nn(STRING)),
+    })
+    reg["DeleteDistroPayload"] = obj("DeleteDistroPayload", {
+        "deletedDistroId": field(nn(STRING)),
+    })
+    reg["SaveDistroPayload"] = obj("SaveDistroPayload", {
+        "distro": field(nn(named("Distro"))),
+        "hostCount": field(nn(INT)),
+    })
+    reg["ServiceFlag"] = obj("ServiceFlag", {
+        "name": field(nn(STRING)),
+        "enabled": field(nn(BOOLEAN)),
+    })
+    reg["RestartAdminTasksPayload"] = obj("RestartAdminTasksPayload", {
+        "numRestartedTasks": field(nn(INT)),
+    })
+    reg["AdminTasksToRestartPayload"] = obj("AdminTasksToRestartPayload", {
+        "tasksToRestart": field(nn(lst(named("Task")))),
+    })
+    reg["SetLastRevisionPayload"] = obj("SetLastRevisionPayload", {
+        "mergeBaseRevision": field(nn(STRING)),
+    })
+    reg["DeleteGithubAppCredentialsPayload"] = obj(
+        "DeleteGithubAppCredentialsPayload", {"oldAppId": field(nn(INT))}
+    )
+    reg["UpdateBetaFeaturesPayload"] = obj("UpdateBetaFeaturesPayload", {
+        "betaFeatures": field(JSON),
+    })
+    reg["RefreshGitHubStatusesPayload"] = obj("RefreshGitHubStatusesPayload", {
+        "versionId": field(nn(STRING)),
+    })
+    reg["Subscription"] = obj("Subscription", {
+        "id": field(nn(STRING)),
+        "resource_type": field(STRING),
+        "trigger": field(STRING),
+        "subscriber_type": field(STRING),
+        "subscriber_target": field(STRING),
+        "filters": field(JSON),
+        "owner": field(STRING),
+        "enabled": field(BOOLEAN),
+        "_id": field(STRING),
+    })
+
+    # -- input objects ------------------------------------------------------ #
+    for name, fields in (
+        ("SpawnHostInput", {
+            "distroId": arg(nn(STRING)),
+            "userId": arg(STRING, "", True),
+            "noExpiration": arg(BOOLEAN, False, True),
+            "expiration": arg(FLOAT),
+            "userDataScript": arg(STRING),
+            "volumeId": arg(STRING),
+            "instanceTags": arg(lst(JSON)),
+            "publicKey": arg(JSON),
+        }),
+        ("EditSpawnHostInput", {
+            "hostId": arg(nn(STRING)),
+            "displayName": arg(STRING),
+            "instanceType": arg(STRING),
+            "expiration": arg(FLOAT),
+            "noExpiration": arg(BOOLEAN),
+            "addedInstanceTags": arg(lst(JSON)),
+            "deletedInstanceTags": arg(lst(JSON)),
+            "volume": arg(STRING),
+            "servicePassword": arg(STRING),
+        }),
+        ("UpdateSpawnHostStatusInput", {
+            "hostId": arg(nn(STRING)),
+            "action": arg(nn(STRING)),
+        }),
+        ("SpawnVolumeInput", {
+            "size": arg(nn(INT)),
+            "availabilityZone": arg(STRING, "", True),
+            "expiration": arg(FLOAT),
+            "noExpiration": arg(BOOLEAN, False, True),
+            "host": arg(STRING),
+        }),
+        ("UpdateVolumeInput", {
+            "volumeId": arg(nn(STRING)),
+            "name": arg(STRING),
+            "expiration": arg(FLOAT),
+            "noExpiration": arg(BOOLEAN),
+        }),
+        ("VolumeHost", {
+            "volumeId": arg(nn(STRING)),
+            "hostId": arg(nn(STRING)),
+        }),
+        ("CreateDistroInput", {"newDistroId": arg(nn(STRING))}),
+        ("CopyDistroInput", {
+            "distroIdToCopy": arg(nn(STRING)),
+            "newDistroId": arg(nn(STRING)),
+        }),
+        ("DeleteDistroInput", {"distroId": arg(nn(STRING))}),
+        ("SaveDistroInput", {
+            "distro": arg(nn(JSON)),
+            "onSave": arg(STRING, "NONE", True),
+        }),
+        ("CreateProjectInput", {
+            "identifier": arg(nn(STRING)),
+            "displayName": arg(STRING),
+            "owner": arg(STRING),
+            "repo": arg(STRING),
+            "branch": arg(STRING, "main", True),
+        }),
+        ("CopyProjectInput", {
+            "projectIdToCopy": arg(nn(STRING)),
+            "newProjectIdentifier": arg(nn(STRING)),
+        }),
+        ("MoveProjectInput", {
+            "projectId": arg(nn(STRING)),
+            "newOwner": arg(nn(STRING)),
+            "newRepo": arg(nn(STRING)),
+        }),
+        ("DefaultSectionToRepoInput", {
+            "projectId": arg(nn(STRING)),
+            "section": arg(nn(STRING)),
+        }),
+        ("PromoteVarsToRepoInput", {
+            "projectId": arg(nn(STRING)),
+            "varNames": arg(nn(lst(nn(STRING)))),
+        }),
+        ("SetLastRevisionInput", {
+            "projectIdentifier": arg(nn(STRING)),
+            "revision": arg(nn(STRING)),
+        }),
+        ("DeleteGithubAppCredentialsInput", {
+            "projectId": arg(nn(STRING)),
+        }),
+        ("ProjectSettingsInput", {
+            "projectId": arg(STRING),
+            "projectRef": arg(JSON),
+            "vars": arg(input_ref("ProjectVarsInput")),
+        }),
+        ("RepoSettingsInput", {
+            "repoId": arg(STRING),
+            "repoRef": arg(JSON),
+            "vars": arg(input_ref("ProjectVarsInput")),
+        }),
+        ("DeactivateStepbackTaskInput", {
+            "projectId": arg(nn(STRING)),
+            "buildVariant": arg(nn(STRING)),
+            "taskName": arg(nn(STRING)),
+        }),
+        ("RestartAdminTasksOptions", {
+            "startTime": arg(FLOAT),
+            "endTime": arg(FLOAT),
+            "includeTestFailed": arg(BOOLEAN, True, True),
+            "includeSystemFailed": arg(BOOLEAN, True, True),
+            "includeSetupFailed": arg(BOOLEAN, True, True),
+        }),
+        ("ServiceFlagInput", {
+            "name": arg(nn(STRING)),
+            "enabled": arg(nn(BOOLEAN)),
+        }),
+        ("TaskPriority", {
+            "taskId": arg(nn(STRING)),
+            "priority": arg(nn(INT)),
+        }),
+        ("PublicKeyInput", {
+            "name": arg(nn(STRING)),
+            "key": arg(nn(STRING)),
+        }),
+        ("UpdateBetaFeaturesInput", {"betaFeatures": arg(JSON)}),
+        ("AddFavoriteProjectInput", {
+            "projectIdentifier": arg(nn(STRING)),
+        }),
+        ("RemoveFavoriteProjectInput", {
+            "projectIdentifier": arg(nn(STRING)),
+        }),
+        ("SubscriptionInput", {
+            "id": arg(STRING),
+            "resourceType": arg(nn(STRING)),
+            "trigger": arg(nn(STRING)),
+            "selectors": arg(lst(JSON)),
+            "subscriber": arg(nn(JSON)),
+        }),
+        ("VersionToRestart", {"versionId": arg(nn(STRING))}),
+        ("RefreshGitHubStatusesInput", {"versionId": arg(nn(STRING))}),
+        ("MainlineCommitsOptions", {
+            "projectIdentifier": arg(nn(STRING)),
+            "limit": arg(INT, 5, True),
+            "skipOrderNumber": arg(INT),
+        }),
+        ("BuildVariantOptions", {
+            "variants": arg(lst(nn(STRING))),
+            "tasks": arg(lst(nn(STRING))),
+            "statuses": arg(lst(nn(STRING))),
+        }),
+        ("TestFilter", {
+            "testName": arg(nn(STRING)),
+            "testStatus": arg(STRING),
+        }),
+        ("QuarantineTestInput", {
+            "projectIdentifier": arg(nn(STRING)),
+            "buildVariant": arg(nn(STRING)),
+            "taskName": arg(nn(STRING)),
+            "testName": arg(nn(STRING)),
+        }),
+        ("QuarantineTaskInput", {
+            "projectIdentifier": arg(nn(STRING)),
+            "buildVariant": arg(nn(STRING)),
+            "taskName": arg(nn(STRING)),
+        }),
+        ("QuarantineVariantInput", {
+            "projectIdentifier": arg(nn(STRING)),
+            "buildVariant": arg(nn(STRING)),
+        }),
+        ("MetadataLinkInput", {
+            "url": arg(nn(STRING)),
+            "text": arg(nn(STRING)),
+        }),
+        ("AdminEventsInput", {
+            "limit": arg(INT, 15, True),
+            "before": arg(FLOAT),
+        }),
+        ("DistroEventsInput", {
+            "distroId": arg(nn(STRING)),
+            "limit": arg(INT, 0, True),
+            "before": arg(FLOAT),
+        }),
+    ):
+        reg[name] = input_obj(name, fields)
+
+    # -- Query fields ------------------------------------------------------- #
+    reg["Query"]["fields"].update({
+        "distro": field(named("Distro"), {"distroId": arg(nn(STRING))}),
+        "distroEvents": field(nn(named("EventsPayload")),
+                              {"opts": arg(nn(input_ref("DistroEventsInput")))}),
+        "distroTaskQueue": field(nn(lst(nn(named("TaskQueueItem")))),
+                                 {"distroId": arg(nn(STRING))}),
+        "taskQueueDistros": field(nn(lst(nn(named("TaskQueueDistro"))))),
+        "awsRegions": field(lst(nn(STRING))),
+        "clientConfig": field(named("ClientConfig")),
+        "instanceTypes": field(nn(lst(nn(STRING)))),
+        "subnetAvailabilityZones": field(nn(lst(nn(STRING)))),
+        "adminSettings": field(JSON),
+        "adminEvents": field(nn(named("EventsPayload")),
+                             {"opts": arg(input_ref("AdminEventsInput"))}),
+        "adminTasksToRestart": field(
+            nn(named("AdminTasksToRestartPayload")),
+            {"opts": arg(input_ref("RestartAdminTasksOptions"))},
+        ),
+        "project": field(nn(named("Project")),
+                         {"projectIdentifier": arg(nn(STRING))}),
+        "projectEvents": field(
+            nn(named("EventsPayload")),
+            {"projectIdentifier": arg(nn(STRING)),
+             "limit": arg(INT, 0, True), "before": arg(FLOAT)},
+        ),
+        "repoEvents": field(
+            nn(named("EventsPayload")),
+            {"repoId": arg(nn(STRING)), "limit": arg(INT, 0, True),
+             "before": arg(FLOAT)},
+        ),
+        "repoSettings": field(nn(named("RepoSettings")),
+                              {"repoId": arg(nn(STRING))}),
+        "viewableProjectRefs": field(nn(lst(nn(named("GroupedProjects"))))),
+        "isRepo": field(nn(BOOLEAN),
+                        {"projectOrRepoId": arg(nn(STRING))}),
+        "githubProjectConflicts": field(
+            nn(named("GithubProjectConflicts")),
+            {"projectId": arg(nn(STRING))},
+        ),
+        "taskAllExecutions": field(nn(lst(JSON)),
+                                   {"taskId": arg(nn(STRING))}),
+        "taskTestSample": field(
+            lst(nn(named("TaskTestResultSample"))),
+            {"versionId": arg(nn(STRING)),
+             "taskIds": arg(nn(lst(nn(STRING)))),
+             "filters": arg(lst(nn(input_ref("TestFilter"))))},
+        ),
+        "myPublicKeys": field(nn(lst(nn(named("PublicKey"))))),
+        "userLite": field(nn(named("User")),
+                          {"userId": arg(STRING, "", True)}),
+        "userConfig": field(named("UserConfig")),
+        "mySubscriptions": field(nn(lst(nn(named("Subscription"))))),
+        "mainlineCommits": field(
+            named("MainlineCommits"),
+            {"options": arg(nn(input_ref("MainlineCommitsOptions"))),
+             "buildVariantOptions": arg(input_ref("BuildVariantOptions"))},
+        ),
+        "buildVariantsForTaskName": field(
+            lst(nn(named("BuildVariantTuple"))),
+            {"projectIdentifier": arg(nn(STRING)),
+             "taskName": arg(nn(STRING))},
+        ),
+        "taskNamesForBuildVariant": field(
+            lst(nn(STRING)),
+            {"projectIdentifier": arg(nn(STRING)),
+             "buildVariant": arg(nn(STRING))},
+        ),
+        "hasVersion": field(nn(BOOLEAN), {"patchId": arg(nn(STRING))}),
+        "image": field(named("Image"), {"imageId": arg(nn(STRING))}),
+        "images": field(nn(lst(nn(STRING)))),
+        "variantQuarantineStatus": field(
+            nn(named("VariantQuarantineStatus")),
+            {"projectIdentifier": arg(nn(STRING)),
+             "buildVariant": arg(nn(STRING))},
+        ),
+        "bbGetCreatedTickets": field(nn(lst(nn(named("CreatedTicket")))),
+                                     {"taskId": arg(nn(STRING))}),
+    })
+
+    # -- Mutation fields ---------------------------------------------------- #
+    reg["Mutation"]["fields"].update({
+        "spawnHost": field(nn(named("Host")),
+                           {"spawnHostInput": arg(input_ref("SpawnHostInput"))}),
+        "editSpawnHost": field(nn(named("Host")),
+                               {"spawnHost": arg(input_ref("EditSpawnHostInput"))}),
+        "updateSpawnHostStatus": field(
+            nn(named("Host")),
+            {"updateSpawnHostStatusInput":
+             arg(input_ref("UpdateSpawnHostStatusInput"))},
+        ),
+        "spawnVolume": field(nn(BOOLEAN),
+                             {"spawnVolumeInput": arg(nn(input_ref("SpawnVolumeInput")))}),
+        "updateVolume": field(nn(BOOLEAN),
+                              {"updateVolumeInput": arg(nn(input_ref("UpdateVolumeInput")))}),
+        "removeVolume": field(nn(BOOLEAN), {"volumeId": arg(nn(STRING))}),
+        "migrateVolume": field(
+            nn(BOOLEAN),
+            {"volumeId": arg(nn(STRING)),
+             "spawnHostInput": arg(input_ref("SpawnHostInput"))},
+        ),
+        "attachVolumeToHost": field(
+            nn(BOOLEAN), {"volumeAndHost": arg(nn(input_ref("VolumeHost")))}
+        ),
+        "detachVolumeFromHost": field(nn(BOOLEAN),
+                                      {"volumeId": arg(nn(STRING))}),
+        "updateHostStatus": field(
+            nn(INT),
+            {"hostIds": arg(nn(lst(nn(STRING)))), "status": arg(nn(STRING)),
+             "notes": arg(STRING, "", True)},
+        ),
+        "reprovisionToNew": field(nn(INT),
+                                  {"hostIds": arg(nn(lst(nn(STRING))))}),
+        "restartJasper": field(nn(INT),
+                               {"hostIds": arg(nn(lst(nn(STRING))))}),
+        "createDistro": field(nn(named("NewDistroPayload")),
+                              {"opts": arg(nn(input_ref("CreateDistroInput")))}),
+        "copyDistro": field(nn(named("NewDistroPayload")),
+                            {"opts": arg(nn(input_ref("CopyDistroInput")))}),
+        "deleteDistro": field(nn(named("DeleteDistroPayload")),
+                              {"opts": arg(nn(input_ref("DeleteDistroInput")))}),
+        "saveDistro": field(nn(named("SaveDistroPayload")),
+                            {"opts": arg(nn(input_ref("SaveDistroInput")))}),
+        "createProject": field(nn(named("Project")),
+                               {"project": arg(nn(input_ref("CreateProjectInput")))}),
+        "copyProject": field(nn(named("Project")),
+                             {"project": arg(nn(input_ref("CopyProjectInput")))}),
+        "deleteProject": field(nn(BOOLEAN), {"projectId": arg(nn(STRING))}),
+        "attachProjectToRepo": field(nn(named("Project")),
+                                     {"projectId": arg(nn(STRING))}),
+        "detachProjectFromRepo": field(nn(named("Project")),
+                                       {"projectId": arg(nn(STRING))}),
+        "attachProjectToNewRepo": field(
+            nn(named("Project")),
+            {"project": arg(nn(input_ref("MoveProjectInput")))},
+        ),
+        "defaultSectionToRepo": field(
+            STRING, {"opts": arg(nn(input_ref("DefaultSectionToRepoInput")))}
+        ),
+        "promoteVarsToRepo": field(
+            nn(BOOLEAN), {"opts": arg(nn(input_ref("PromoteVarsToRepoInput")))}
+        ),
+        "forceRepotrackerRun": field(nn(BOOLEAN),
+                                     {"projectId": arg(nn(STRING))}),
+        "setLastRevision": field(
+            nn(named("SetLastRevisionPayload")),
+            {"opts": arg(nn(input_ref("SetLastRevisionInput")))},
+        ),
+        "deleteGithubAppCredentials": field(
+            named("DeleteGithubAppCredentialsPayload"),
+            {"opts": arg(nn(input_ref("DeleteGithubAppCredentialsInput")))},
+        ),
+        "saveProjectSettingsForSection": field(
+            nn(named("ProjectSettings")),
+            {"projectSettings": arg(input_ref("ProjectSettingsInput")),
+             "section": arg(nn(STRING))},
+        ),
+        "saveRepoSettingsForSection": field(
+            nn(named("RepoSettings")),
+            {"repoSettings": arg(input_ref("RepoSettingsInput")),
+             "section": arg(nn(STRING))},
+        ),
+        "deactivateStepbackTask": field(
+            nn(BOOLEAN),
+            {"opts": arg(nn(input_ref("DeactivateStepbackTaskInput")))},
+        ),
+        "setPatchVisibility": field(
+            nn(lst(nn(named("Patch")))),
+            {"patchIds": arg(nn(lst(nn(STRING)))),
+             "hidden": arg(nn(BOOLEAN))},
+        ),
+        "saveAdminSettings": field(
+            nn(JSON), {"adminSettings": arg(nn(JSON))}
+        ),
+        "setServiceFlags": field(
+            nn(lst(nn(named("ServiceFlag")))),
+            {"updatedFlags": arg(nn(lst(nn(input_ref("ServiceFlagInput")))))},
+        ),
+        "restartAdminTasks": field(
+            nn(named("RestartAdminTasksPayload")),
+            {"opts": arg(nn(input_ref("RestartAdminTasksOptions")))},
+        ),
+        "overrideTaskDependencies": field(named("Task"),
+                                          {"taskId": arg(nn(STRING))}),
+        "setTaskPriorities": field(
+            nn(lst(nn(named("Task")))),
+            {"taskPriorities": arg(nn(lst(nn(input_ref("TaskPriority")))))},
+        ),
+        "createPublicKey": field(
+            nn(lst(nn(named("PublicKey")))),
+            {"publicKeyInput": arg(nn(input_ref("PublicKeyInput")))},
+        ),
+        "removePublicKey": field(nn(lst(nn(named("PublicKey")))),
+                                 {"keyName": arg(nn(STRING))}),
+        "updatePublicKey": field(
+            nn(lst(nn(named("PublicKey")))),
+            {"targetKeyName": arg(nn(STRING)),
+             "updateInfo": arg(nn(input_ref("PublicKeyInput")))},
+        ),
+        "updateUserSettings": field(nn(BOOLEAN),
+                                    {"userSettings": arg(JSON)}),
+        "updateBetaFeatures": field(
+            named("UpdateBetaFeaturesPayload"),
+            {"opts": arg(nn(input_ref("UpdateBetaFeaturesInput")))},
+        ),
+        "addFavoriteProject": field(
+            nn(named("Project")),
+            {"opts": arg(nn(input_ref("AddFavoriteProjectInput")))},
+        ),
+        "removeFavoriteProject": field(
+            nn(named("Project")),
+            {"opts": arg(nn(input_ref("RemoveFavoriteProjectInput")))},
+        ),
+        "saveSubscription": field(
+            nn(BOOLEAN),
+            {"subscription": arg(nn(input_ref("SubscriptionInput")))},
+        ),
+        "deleteSubscriptions": field(
+            nn(INT), {"subscriptionIds": arg(nn(lst(nn(STRING))))}
+        ),
+        "clearMySubscriptions": field(nn(INT)),
+        "restartVersions": field(
+            lst(nn(named("Version"))),
+            {"versionId": arg(nn(STRING)),
+             "abort": arg(BOOLEAN, False, True),
+             "versionsToRestart": arg(lst(nn(input_ref("VersionToRestart"))))},
+        ),
+        "scheduleUndispatchedBaseTasks": field(
+            lst(nn(named("Task"))), {"versionId": arg(nn(STRING))}
+        ),
+        "setVersionPriority": field(
+            STRING,
+            {"versionId": arg(nn(STRING)), "priority": arg(nn(INT))},
+        ),
+        "unscheduleVersionTasks": field(
+            STRING,
+            {"versionId": arg(nn(STRING)),
+             "abort": arg(BOOLEAN, False, True)},
+        ),
+        "refreshGitHubStatuses": field(
+            named("RefreshGitHubStatusesPayload"),
+            {"opts": arg(nn(input_ref("RefreshGitHubStatusesInput")))},
+        ),
+        "bbCreateTicket": field(
+            nn(BOOLEAN),
+            {"taskId": arg(nn(STRING)), "execution": arg(INT)},
+        ),
+        "setAnnotationMetadataLinks": field(
+            nn(BOOLEAN),
+            {"taskId": arg(nn(STRING)), "execution": arg(nn(INT)),
+             "metadataLinks": arg(nn(lst(nn(input_ref("MetadataLinkInput")))))},
+        ),
+        "quarantineTest": field(
+            nn(named("QuarantinedTest")),
+            {"opts": arg(nn(input_ref("QuarantineTestInput")))},
+        ),
+        "unquarantineTest": field(
+            nn(named("QuarantinedTest")),
+            {"opts": arg(nn(input_ref("QuarantineTestInput")))},
+        ),
+        "quarantineTask": field(
+            named("Task"), {"opts": arg(nn(input_ref("QuarantineTaskInput")))}
+        ),
+        "unquarantineTask": field(
+            named("Task"), {"opts": arg(nn(input_ref("QuarantineTaskInput")))}
+        ),
+        "quarantineVariant": field(
+            nn(named("VariantQuarantineStatus")),
+            {"opts": arg(nn(input_ref("QuarantineVariantInput")))},
+        ),
+        "unquarantineVariant": field(
+            nn(named("VariantQuarantineStatus")),
+            {"opts": arg(nn(input_ref("QuarantineVariantInput")))},
+        ),
+    })
