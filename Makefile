@@ -1,0 +1,25 @@
+# Workflow entry points. `make hooks` once per clone; after that every
+# `git commit` runs the full-suite gate (tools/hooks/pre-commit) and a
+# red suite refuses the commit — this is the only documented commit path.
+
+.PHONY: test gate hooks bench multichip native
+
+hooks:
+	sh tools/install_hooks.sh
+
+test:
+	python -m pytest tests/ -q
+
+gate:
+	python tools/gate.py
+
+bench:
+	python bench.py
+
+multichip:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+native:
+	$(MAKE) -C native/evgsolve
+	python -c "from evergreen_tpu.utils.native import get_evgpack; \
+	           print('evgpack:', get_evgpack())"

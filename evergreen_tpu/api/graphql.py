@@ -462,6 +462,8 @@ def _resolve_vars(value: Any, variables: Dict[str, Any]) -> Any:
         if name not in variables:
             raise GraphQLError(f"missing variable ${name}")
         return variables[name]
+    if isinstance(value, dict):
+        return {k: _resolve_vars(v, variables) for k, v in value.items()}
     if isinstance(value, list):
         return [_resolve_vars(v, variables) for v in value]
     return value
@@ -650,10 +652,14 @@ class GraphQLApi(SpruceOpsMixin):
 
             if isinstance(e, ReplicaReadOnly):
                 raise  # REST layer forwards/503s replica writes
+            import traceback
+
             from ..utils.log import get_logger
 
             get_logger("graphql").error(
-                "resolver crash", error=repr(e)
+                "resolver crash",
+                error=repr(e),
+                traceback=traceback.format_exc(),
             )
             return {"errors": [{
                 "message": f"internal error: {type(e).__name__}"
@@ -698,13 +704,16 @@ class GraphQLApi(SpruceOpsMixin):
         doc["id"] = doc["_id"]
         return doc
 
-    def _q_host(self, hostId: str):
-        h = host_mod.get(self.store, hostId)
+    def _host_doc(self, host_id: str) -> Optional[dict]:
+        h = host_mod.get(self.store, host_id)
         if h is None:
             return None
         doc = h.to_api_doc()
         doc["id"] = doc["_id"]
         return doc
+
+    def _q_host(self, hostId: str):
+        return self._host_doc(hostId)
 
     def _q_waterfall(self, projectId: str, limit: int = 10):
         """Spruce waterfall grid: recent mainline versions × variant

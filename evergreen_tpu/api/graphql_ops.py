@@ -287,6 +287,7 @@ class SpruceOpsMixin:
             self._me(),
             int(inp.get("size", 0)),
             zone=inp.get("availabilityZone", ""),
+            volume_type=inp.get("type", "") or "gp3",
         )
         updates = {}
         if inp.get("noExpiration"):
@@ -596,6 +597,14 @@ class SpruceOpsMixin:
         for sid, cls in settings_mod.all_sections().items():
             section = cls.get(self.store)
             out[sid] = dataclasses.asdict(section)
+        # the announcement banner is a top-level AdminSettings field in the
+        # reference (config.go Settings.Banner/BannerTheme), stored here on
+        # the ui section; surface it under its reference name too
+        ui = out.get("ui") or {}
+        out["banner"] = {
+            "text": ui.get("banner", ""),
+            "theme": ui.get("banner_theme", ""),
+        }
         return out
 
     def _m_save_admin_settings(self, adminSettings=None):
@@ -603,6 +612,21 @@ class SpruceOpsMixin:
         sections = settings_mod.all_sections()
         saved = []
         for sid, payload in dict(adminSettings or {}).items():
+            if sid == "banner":
+                # reference-shaped {text, theme} → ui section fields
+                payload = dict(payload or {})
+                ui = settings_mod.UiConfig.get_base(self.store)
+                if "text" in payload:
+                    ui.banner = str(payload["text"] or "")
+                if "theme" in payload:
+                    ui.banner_theme = str(payload["theme"] or "")
+                ui.set(self.store)
+                saved.append(sid)
+                event_mod.log(
+                    self.store, event_mod.RESOURCE_ADMIN,
+                    "CONFIG_SECTION_SAVED", "banner", {"user": self._me()},
+                )
+                continue
             cls = sections.get(sid)
             if cls is None:
                 raise _err(f"unknown config section {sid!r}")
@@ -1367,13 +1391,15 @@ class SpruceOpsMixin:
         pid = inp.get("projectIdentifier", "")
         limit = int(inp.get("limit", 5))
         skip_order = int(inp.get("skipOrderNumber", 0) or 0)
+        import sys
+
         from ..globals import Requester as Req
 
-        versions = [
-            v for v in version_mod.find_by_project_order(self.store, pid)
-            if v.requester == Req.REPOTRACKER.value
-            and (not skip_order or v.revision_order_number < skip_order)
-        ]
+        hi = (skip_order - 1) if skip_order else sys.maxsize
+        versions = version_mod.find_by_project_order(
+            self.store, pid, 0, hi, requester=Req.REPOTRACKER.value
+        )
+        versions.reverse()  # finder sorts ascending; page is newest-first
         page = versions[:limit]
         bv_opts = dict(buildVariantOptions or {})
         want_variants = set(bv_opts.get("variants") or [])

@@ -214,6 +214,7 @@ def extend(reg: Dict[str, dict]) -> None:
             "expiration": arg(FLOAT),
             "noExpiration": arg(BOOLEAN, False, True),
             "host": arg(STRING),
+            "type": arg(STRING, "", True),
         }),
         ("UpdateVolumeInput", {
             "volumeId": arg(nn(STRING)),

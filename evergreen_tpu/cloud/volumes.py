@@ -36,6 +36,9 @@ class Volume:
     home_volume: bool = False
     expiration_time: float = 0.0
     no_expiration: bool = False
+    #: user-facing label (reference model/host/volume.go DisplayName)
+    display_name: str = ""
+    volume_type: str = "gp3"
 
     def to_doc(self) -> dict:
         doc = dataclasses.asdict(self)
@@ -46,12 +49,15 @@ class Volume:
     def from_doc(cls, doc: dict) -> "Volume":
         doc = dict(doc)
         doc["id"] = doc.pop("_id")
-        return cls(**doc)
+        return cls(**{k: v for k, v in doc.items() if k in _VOLUME_FIELDS})
+
+
+_VOLUME_FIELDS = frozenset(f.name for f in dataclasses.fields(Volume))
 
 
 def create_volume(
     store: Store, user: str, size_gb: int, zone: str = "",
-    now: Optional[float] = None,
+    now: Optional[float] = None, volume_type: str = "gp3",
 ) -> Volume:
     now = _time.time() if now is None else now
     v = Volume(
@@ -60,6 +66,7 @@ def create_volume(
         size_gb=size_gb,
         availability_zone=zone,
         expiration_time=now + 24 * 3600.0,
+        volume_type=volume_type,
     )
     store.collection(VOLUMES_COLLECTION).insert(v.to_doc())
     return v

@@ -9,7 +9,7 @@ from __future__ import annotations
 import dataclasses
 import time as _time
 import uuid
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..globals import (
     HOST_ACTIVE_STATUSES,
@@ -93,6 +93,18 @@ class Host:
     zone: str = ""
     ip_address: str = ""
     external_id: str = ""  # cloud-provider instance id
+
+    # Spawn-host user surface (reference model/host/host.go DisplayName /
+    # InstanceTags / ProvisionOptions; edited via rest/route/host_spawn.go
+    # and the editSpawnHost mutation)
+    display_name: str = ""
+    instance_tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    provision_options: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
+    #: RDP/admin password was set for a Windows spawn host (write-only;
+    #: the password itself is never stored)
+    service_password_set: bool = False
 
     def __post_init__(self) -> None:
         if self.creation_time == 0.0:

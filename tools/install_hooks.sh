@@ -1,0 +1,9 @@
+#!/bin/sh
+# Install the repo's git hooks (currently: the pre-commit test gate).
+# This is the ONLY supported way to set up a working copy for commits.
+set -e
+cd "$(git rev-parse --show-toplevel)"
+mkdir -p .git/hooks
+cp tools/hooks/pre-commit .git/hooks/pre-commit
+chmod +x .git/hooks/pre-commit
+echo "installed .git/hooks/pre-commit (full-suite gate)"
