@@ -1261,6 +1261,7 @@ class GraphQLApi(SpruceOpsMixin):
                                  vars=None):
         """Subset of reference saveProjectSettingsForSection: update
         project-ref fields and/or project vars."""
+        self._require_project_admin(projectId)
         coll = self.store.collection("project_refs")
         ref = coll.get(projectId)
         if ref is None:

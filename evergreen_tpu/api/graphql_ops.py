@@ -181,6 +181,43 @@ class SpruceOpsMixin:
             doc = user_mod.coll(self.store).get(user_id)
         return doc
 
+    # -- authorization (reference graphql directives @requireHostAccess / --- #
+    # -- @requireDistroAccess / @requireProjectAdmin, graphql/schema/
+    # -- directives + graphql/resolver helpers) ----------------------------- #
+
+    def _is_superuser(self) -> bool:
+        u = user_mod.get_user(self.store, self._me())
+        return u is not None and u.has_scope(user_mod.SCOPE_SUPERUSER)
+
+    def _require_superuser(self, what: str) -> None:
+        if not self._is_superuser():
+            raise _err(f"{what} requires superuser access")
+
+    def _require_project_admin(self, project_id: str) -> None:
+        """Superuser or a ``project:<id>`` scope (reference
+        @requireProjectAdmin on project-settings mutations)."""
+        u = user_mod.get_user(self.store, self._me())
+        if u is None or not u.has_scope(f"project:{project_id}"):
+            raise _err(
+                f"project {project_id!r} admin access required"
+            )
+
+    def _require_host_owner(self, doc: dict) -> None:
+        """Spawn-host mutations act only on hosts the user started
+        (reference spawn-host ownership checks in host_spawn routes)."""
+        if doc.get("started_by") != self._me() and not self._is_superuser():
+            raise _err(
+                f"host {doc.get('_id', '')!r} is not owned by you"
+            )
+
+    def _require_volume_owner(self, volume_id: str) -> vol_mod.Volume:
+        v = vol_mod.get_volume(self.store, volume_id)
+        if v is None:
+            raise _err(f"volume {volume_id!r} not found")
+        if v.created_by != self._me() and not self._is_superuser():
+            raise _err(f"volume {volume_id!r} is not owned by you")
+        return v
+
     def _volume_doc(self, v: vol_mod.Volume) -> dict:
         return {**v.to_doc(), "id": v.id}
 
@@ -191,6 +228,10 @@ class SpruceOpsMixin:
 
     def _m_spawn_host(self, spawnHostInput=None):
         inp = dict(spawnHostInput or {})
+        if inp.get("userId") and inp["userId"] != self._me():
+            # spawning on behalf of another user is an admin action (the
+            # reference has no userId on SpawnHostInput at all)
+            self._require_superuser("spawnHost for another user")
         user = self._me(inp.get("userId", ""))
         h = spawn_mod.create_spawn_host(
             self.store,
@@ -212,6 +253,7 @@ class SpruceOpsMixin:
         if updates:
             host_mod.coll(self.store).update(h.id, updates)
         if inp.get("volumeId"):
+            self._require_volume_owner(inp["volumeId"])
             vol_mod.attach_volume(self.store, inp["volumeId"], h.id)
         if inp.get("publicKey"):
             pk = inp["publicKey"]
@@ -231,6 +273,7 @@ class SpruceOpsMixin:
         doc = host_mod.coll(self.store).get(host_id)
         if doc is None or not doc.get("user_host"):
             raise _err(f"spawn host {host_id!r} not found")
+        self._require_host_owner(doc)
         updates: Dict[str, Any] = {}
         if "displayName" in inp:
             updates["display_name"] = str(inp["displayName"])
@@ -250,6 +293,7 @@ class SpruceOpsMixin:
         if updates:
             host_mod.coll(self.store).update(host_id, updates)
         if inp.get("volume"):
+            self._require_volume_owner(inp["volume"])
             vol_mod.attach_volume(self.store, inp["volume"], host_id)
         if inp.get("servicePassword"):
             # RDP password for Windows spawn hosts: stored write-only
@@ -265,6 +309,9 @@ class SpruceOpsMixin:
     def _m_update_spawn_host_status(self, updateSpawnHostStatusInput=None):
         inp = dict(updateSpawnHostStatusInput or {})
         host_id, action = inp.get("hostId", ""), inp.get("action", "")
+        doc = host_mod.coll(self.store).get(host_id)
+        if doc is not None and doc.get("user_host"):
+            self._require_host_owner(doc)
         try:
             if action == "START":
                 spawn_mod.start_spawn_host(self.store, host_id)
@@ -305,8 +352,7 @@ class SpruceOpsMixin:
     def _m_update_volume(self, updateVolumeInput=None):
         inp = dict(updateVolumeInput or {})
         vid = inp.get("volumeId", "")
-        if vol_mod.get_volume(self.store, vid) is None:
-            raise _err(f"volume {vid!r} not found")
+        self._require_volume_owner(vid)
         updates: Dict[str, Any] = {}
         if "name" in inp and inp["name"] is not None:
             updates["display_name"] = str(inp["name"])
@@ -321,9 +367,7 @@ class SpruceOpsMixin:
         return True
 
     def _m_remove_volume(self, volumeId: str):
-        v = vol_mod.get_volume(self.store, volumeId)
-        if v is None:
-            raise _err(f"volume {volumeId!r} not found")
+        v = self._require_volume_owner(volumeId)
         if v.host_id:
             vol_mod.detach_volume(self.store, volumeId)
         self.store.collection(vol_mod.VOLUMES_COLLECTION).remove(volumeId)
@@ -332,9 +376,7 @@ class SpruceOpsMixin:
     def _m_migrate_volume(self, volumeId: str, spawnHostInput=None):
         """Reference graphql/spawn_resolver.go MigrateVolume: spawn a new
         host and move the volume onto it."""
-        v = vol_mod.get_volume(self.store, volumeId)
-        if v is None:
-            raise _err(f"volume {volumeId!r} not found")
+        v = self._require_volume_owner(volumeId)
         new_host = self._m_spawn_host(spawnHostInput=spawnHostInput)
         if v.host_id:
             vol_mod.detach_volume(self.store, volumeId)
@@ -343,6 +385,10 @@ class SpruceOpsMixin:
 
     def _m_attach_volume(self, volumeAndHost=None):
         inp = dict(volumeAndHost or {})
+        self._require_volume_owner(inp.get("volumeId", ""))
+        hdoc = host_mod.coll(self.store).get(inp.get("hostId", ""))
+        if hdoc is not None and hdoc.get("user_host"):
+            self._require_host_owner(hdoc)
         try:
             vol_mod.attach_volume(
                 self.store, inp.get("volumeId", ""), inp.get("hostId", "")
@@ -352,6 +398,7 @@ class SpruceOpsMixin:
         return True
 
     def _m_detach_volume(self, volumeId: str):
+        self._require_volume_owner(volumeId)
         try:
             vol_mod.detach_volume(self.store, volumeId)
         except vol_mod.VolumeError as e:
@@ -367,6 +414,7 @@ class SpruceOpsMixin:
     def _m_update_host_status(
         self, hostIds: List[str], status: str, notes: str = ""
     ):
+        self._require_superuser("updateHostStatus")
         if status not in self._HOST_STATUS_VALUES:
             raise _err(f"invalid host status {status!r}")
         n = 0
@@ -387,6 +435,7 @@ class SpruceOpsMixin:
     def _m_reprovision_to_new(self, hostIds: List[str]):
         """Mark hosts for agent reprovisioning (reference
         host.MarkAsReprovisioning, graphql/host_resolver.go)."""
+        self._require_superuser("reprovisionToNew")
         n = 0
         for hid in hostIds:
             doc = host_mod.coll(self.store).get(hid)
@@ -401,6 +450,7 @@ class SpruceOpsMixin:
     def _m_restart_jasper(self, hostIds: List[str]):
         """Restart the host-control daemon: modeled as a reprovision of
         the supervision layer only (jasper-by-design seam)."""
+        self._require_superuser("restartJasper")
         n = 0
         for hid in hostIds:
             doc = host_mod.coll(self.store).get(hid)
@@ -457,6 +507,7 @@ class SpruceOpsMixin:
         return out
 
     def _m_create_distro(self, opts=None):
+        self._require_superuser("createDistro")
         inp = dict(opts or {})
         new_id = inp.get("newDistroId", "")
         if not new_id:
@@ -472,6 +523,7 @@ class SpruceOpsMixin:
         return {"newDistroId": new_id}
 
     def _m_copy_distro(self, opts=None):
+        self._require_superuser("copyDistro")
         inp = dict(opts or {})
         src_id, new_id = inp.get("distroIdToCopy", ""), inp.get("newDistroId", "")
         src = distro_mod.get(self.store, src_id)
@@ -489,6 +541,7 @@ class SpruceOpsMixin:
         return {"newDistroId": new_id}
 
     def _m_delete_distro(self, opts=None):
+        self._require_superuser("deleteDistro")
         inp = dict(opts or {})
         distro_id = inp.get("distroId", "")
         if distro_mod.get(self.store, distro_id) is None:
@@ -501,6 +554,7 @@ class SpruceOpsMixin:
         return {"deletedDistroId": distro_id}
 
     def _m_save_distro(self, opts=None):
+        self._require_superuser("saveDistro")
         inp = dict(opts or {})
         ddoc = dict(inp.get("distro") or {})
         distro_id = ddoc.get("id") or ddoc.get("_id") or ""
@@ -587,8 +641,7 @@ class SpruceOpsMixin:
     # ------------------------------------------------------------------ #
 
     def _require_admin(self) -> None:
-        u = user_mod.get_user(self.store, self._me())
-        if u is None or not u.has_scope("superuser"):
+        if not self._is_superuser():
             raise _err("admin access required")
 
     def _q_admin_settings(self):
@@ -825,6 +878,7 @@ class SpruceOpsMixin:
         }
 
     def _m_create_project(self, project=None):
+        self._require_superuser("createProject")
         inp = dict(project or {})
         pid = inp.get("identifier") or inp.get("id") or ""
         if not pid:
@@ -849,6 +903,7 @@ class SpruceOpsMixin:
     def _m_copy_project(self, project=None):
         inp = dict(project or {})
         src = inp.get("projectIdToCopy", "")
+        self._require_project_admin(src)
         new_id = inp.get("newProjectIdentifier", "")
         doc = self._ref_doc(src)
         if self.store.collection("project_refs").get(new_id) is not None:
@@ -876,6 +931,7 @@ class SpruceOpsMixin:
     def _m_delete_project(self, projectId: str):
         """Reference 'deleteProject' hides + disables rather than
         removing history (model/project_ref.go HideBranch)."""
+        self._require_project_admin(projectId)
         self._ref_doc(projectId)
         self.store.collection("project_refs").update(
             projectId, {"enabled": False, "hidden": True}
@@ -887,6 +943,7 @@ class SpruceOpsMixin:
         return True
 
     def _m_attach_project_to_repo(self, projectId: str):
+        self._require_project_admin(projectId)
         doc = self._ref_doc(projectId)
         repo_id = f"{doc.get('owner', '')}/{doc.get('repo', '')}"
         if self.store.collection("repo_refs").get(repo_id) is None:
@@ -905,6 +962,7 @@ class SpruceOpsMixin:
         return self._q_project(projectId)
 
     def _m_detach_project_from_repo(self, projectId: str):
+        self._require_project_admin(projectId)
         self._ref_doc(projectId)
         self.store.collection("project_refs").update(
             projectId, {"repo_ref_id": ""}
@@ -918,6 +976,7 @@ class SpruceOpsMixin:
     def _m_attach_project_to_new_repo(self, project=None):
         inp = dict(project or {})
         pid = inp.get("projectId", "")
+        self._require_project_admin(pid)
         self._ref_doc(pid)
         self.store.collection("project_refs").update(
             pid, {"owner": inp.get("newOwner", ""),
@@ -930,6 +989,7 @@ class SpruceOpsMixin:
         apply (reference project_settings section defaulting)."""
         inp = dict(opts or {})
         pid, section = inp.get("projectId", ""), inp.get("section", "")
+        self._require_project_admin(pid)
         doc = self._ref_doc(pid)
         section_fields = {
             "GENERAL": ("batch_time_minutes", "remote_path",
@@ -954,6 +1014,7 @@ class SpruceOpsMixin:
     def _m_promote_vars_to_repo(self, opts=None):
         inp = dict(opts or {})
         pid = inp.get("projectId", "")
+        self._require_project_admin(pid)
         names = list(inp.get("varNames") or [])
         doc = self._ref_doc(pid)
         repo_id = doc.get("repo_ref_id", "")
@@ -979,6 +1040,7 @@ class SpruceOpsMixin:
         """Immediate polling pass for one project (reference enqueues a
         repotracker amboy job; here the pass runs inline — it is the
         same body the repotracker cron runs, units/crons.py)."""
+        self._require_project_admin(projectId)
         self._ref_doc(projectId)
         event_mod.log(
             self.store, event_mod.RESOURCE_VERSION, "REPOTRACKER_FORCED",
@@ -992,6 +1054,7 @@ class SpruceOpsMixin:
         inp = dict(opts or {})
         pid = inp.get("projectIdentifier", "")
         rev = inp.get("revision", "")
+        self._require_project_admin(pid)
         if not rev:
             raise _err("revision is required")
         self._ref_doc(pid)
@@ -1003,6 +1066,7 @@ class SpruceOpsMixin:
     def _m_delete_github_app_creds(self, opts=None):
         inp = dict(opts or {})
         pid = inp.get("projectId", "")
+        self._require_project_admin(pid)
         self._ref_doc(pid)
         self.store.collection("github_app_creds").remove(pid)
         return {"oldAppId": 0}
@@ -1029,6 +1093,7 @@ class SpruceOpsMixin:
         return self._m_save_project_settings(projectId=pid, projectRef=ref)
 
     def _m_save_repo_section(self, repoSettings=None, section: str = ""):
+        self._require_superuser("saveRepoSettingsForSection")
         if section not in self._PROJECT_SECTIONS:
             raise _err(f"unknown settings section {section!r}")
         inp = dict(repoSettings or {})

@@ -427,8 +427,10 @@ class RestApi:
         try:
             with urllib.request.urlopen(req, timeout=15) as resp:
                 status, resp_raw = resp.status, resp.read()
+                resp_headers = resp.headers
         except urllib.error.HTTPError as e:
             status, resp_raw = e.code, e.read()
+            resp_headers = e.headers
         except (OSError, ValueError, http.client.HTTPException):
             return 503, {
                 "error": "this server is a read-only replica and the "
@@ -446,6 +448,16 @@ class RestApi:
                 self.store.poll()
             except OSError:
                 pass  # transient FS race; the tail thread catches up
+        # response headers that carry protocol meaning must survive the
+        # hop (ADVICE r2: forwarding silently dropped them all); stashed
+        # thread-locally so handle() keeps its (status, payload) shape
+        self._ident.response_headers = [
+            (h, v) for h, v in (resp_headers or {}).items()
+            if h.lower() in (
+                "retry-after", "location", "set-cookie",
+                "x-ratelimit-limit", "x-ratelimit-remaining",
+            )
+        ]
         return status, payload
 
     def wsgi_app(self, environ, start_response):
@@ -533,8 +545,11 @@ class RestApi:
                   401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
                   409: "Conflict", 429: "Too Many Requests",
                   503: "Service Unavailable"}
+        extra = getattr(self._ident, "response_headers", None) or []
+        self._ident.response_headers = []
         start_response(
-            f"{status} {reason.get(status, 'OK')}", [("Content-Type", JSON)]
+            f"{status} {reason.get(status, 'OK')}",
+            [("Content-Type", JSON), *extra],
         )
         return [json.dumps(payload, default=str).encode()]
 

@@ -170,31 +170,31 @@ def test_migrate_volume(gql, store):
 # --------------------------------------------------------------------------- #
 
 
-def test_update_host_status_and_reprovision(gql, store):
+def test_update_host_status_and_reprovision(admin_gql, store):
     from evergreen_tpu.models.host import Host
     from evergreen_tpu.models import host as host_mod
 
     seed_distro(store)
     for i in range(3):
         host_mod.insert(store, Host(id=f"h{i}", distro_id="d1", status="running"))
-    n = ok(gql, """
+    n = ok(admin_gql, """
         mutation($ids: [String!]!) {
           updateHostStatus(hostIds: $ids, status: "quarantined", notes: "bad disk")
         }""", {"ids": ["h0", "h1", "missing"]})["updateHostStatus"]
     assert n == 2
     assert host_mod.get(store, "h0").status == "quarantined"
 
-    assert ok(gql, """
+    assert ok(admin_gql, """
         mutation { reprovisionToNew(hostIds: ["h2"]) }
     """)["reprovisionToNew"] == 1
     assert host_mod.get(store, "h2").needs_reprovision == "to-new"
 
-    assert ok(gql, """
+    assert ok(admin_gql, """
         mutation { restartJasper(hostIds: ["h2"]) }
     """)["restartJasper"] == 1
     assert host_mod.get(store, "h2").needs_reprovision == "restart-jasper"
 
-    assert "invalid host status" in err(gql, """
+    assert "invalid host status" in err(admin_gql, """
         mutation { updateHostStatus(hostIds: ["h0"], status: "nonsense") }
     """)
 
@@ -204,17 +204,17 @@ def test_update_host_status_and_reprovision(gql, store):
 # --------------------------------------------------------------------------- #
 
 
-def test_distro_crud(gql, store):
+def test_distro_crud(admin_gql, store):
     seed_distro(store, "base")
-    out = ok(gql, """
+    out = ok(admin_gql, """
         mutation { createDistro(opts: {newDistroId: "fresh"}) { newDistroId } }
     """)["createDistro"]
     assert out["newDistroId"] == "fresh"
-    assert "already exists" in err(gql, """
+    assert "already exists" in err(admin_gql, """
         mutation { createDistro(opts: {newDistroId: "fresh"}) { newDistroId } }
     """)
 
-    ok(gql, """
+    ok(admin_gql, """
         mutation {
           copyDistro(opts: {distroIdToCopy: "base", newDistroId: "base2"}) {
             newDistroId
@@ -222,7 +222,7 @@ def test_distro_crud(gql, store):
         }""")
     assert distro_mod.get(store, "base2").provider == "mock"
 
-    saved = ok(gql, """
+    saved = ok(admin_gql, """
         mutation($d: JSON!) {
           saveDistro(opts: {distro: $d, onSave: "NONE"}) {
             distro { id } hostCount
@@ -231,25 +231,25 @@ def test_distro_crud(gql, store):
     assert saved["distro"]["id"] == "base2"
     assert distro_mod.get(store, "base2").user == "ubuntu"
 
-    ok(gql, 'mutation { deleteDistro(opts: {distroId: "base2"}) { deletedDistroId } }')
+    ok(admin_gql, 'mutation { deleteDistro(opts: {distroId: "base2"}) { deletedDistroId } }')
     assert distro_mod.get(store, "base2") is None
 
-    d = ok(gql, 'query { distro(distroId: "fresh") { id provider } }')["distro"]
+    d = ok(admin_gql, 'query { distro(distroId: "fresh") { id provider } }')["distro"]
     assert d == {"id": "fresh", "provider": "mock"}
 
-    events = ok(gql, """
+    events = ok(admin_gql, """
         query { distroEvents(opts: {distroId: "fresh"}) { count } }
     """)["distroEvents"]
     assert events["count"] >= 1  # DISTRO_CREATED
 
 
-def test_save_distro_decommission_fleet(gql, store):
+def test_save_distro_decommission_fleet(admin_gql, store):
     from evergreen_tpu.models.host import Host
     from evergreen_tpu.models import host as host_mod
 
     seed_distro(store, "dd")
     host_mod.insert(store, Host(id="hh", distro_id="dd", status="running"))
-    out = ok(gql, """
+    out = ok(admin_gql, """
         mutation($d: JSON!) {
           saveDistro(opts: {distro: $d, onSave: "DECOMMISSION"}) { hostCount }
         }""", {"d": {"id": "dd"}})["saveDistro"]
@@ -347,36 +347,36 @@ def test_admin_restart_tasks(admin_gql, store):
 # --------------------------------------------------------------------------- #
 
 
-def test_project_crud_and_repo_attach(gql, store):
-    ok(gql, """
+def test_project_crud_and_repo_attach(admin_gql, store):
+    ok(admin_gql, """
         mutation {
           createProject(project: {identifier: "newproj", owner: "org",
                                   repo: "code"}) { id }
         }""")
-    assert "already exists" in err(gql, """
+    assert "already exists" in err(admin_gql, """
         mutation { createProject(project: {identifier: "newproj"}) { id } }
     """)
 
-    p = ok(gql, 'query { project(projectIdentifier: "newproj") { id owner } }')
+    p = ok(admin_gql, 'query { project(projectIdentifier: "newproj") { id owner } }')
     assert p["project"]["owner"] == "org"
 
-    attached = ok(gql, """
+    attached = ok(admin_gql, """
         mutation { attachProjectToRepo(projectId: "newproj") { repo_ref_id } }
     """)["attachProjectToRepo"]
     assert attached["repo_ref_id"] == "org/code"
-    assert ok(gql, 'query { isRepo(projectOrRepoId: "org/code") }')["isRepo"]
+    assert ok(admin_gql, 'query { isRepo(projectOrRepoId: "org/code") }')["isRepo"]
 
-    grouped = ok(gql, """
+    grouped = ok(admin_gql, """
         query { viewableProjectRefs { groupDisplayName projects { id } } }
     """)["viewableProjectRefs"]
     assert grouped[0]["groupDisplayName"] == "org/code"
 
-    ok(gql, """
+    ok(admin_gql, """
         mutation { detachProjectFromRepo(projectId: "newproj") { id } }
     """)
     assert store.collection("project_refs").get("newproj")["repo_ref_id"] == ""
 
-    moved = ok(gql, """
+    moved = ok(admin_gql, """
         mutation {
           attachProjectToNewRepo(project: {projectId: "newproj",
             newOwner: "neworg", newRepo: "newcode"}) { repo_ref_id }
@@ -384,13 +384,13 @@ def test_project_crud_and_repo_attach(gql, store):
     assert moved["repo_ref_id"] == "neworg/newcode"
 
 
-def test_copy_project_strips_private_vars(gql, store):
+def test_copy_project_strips_private_vars(admin_gql, store):
     seed_project(store)
     store.collection("project_vars").upsert({
         "_id": "proj", "vars": {"public": "1", "token": "hunter2"},
         "private_vars": ["token"],
     })
-    ok(gql, """
+    ok(admin_gql, """
         mutation {
           copyProject(project: {projectIdToCopy: "proj",
                                 newProjectIdentifier: "proj2"}) { id }
@@ -400,21 +400,21 @@ def test_copy_project_strips_private_vars(gql, store):
     assert store.collection("project_refs").get("proj2")["enabled"] is False
 
 
-def test_delete_project_hides(gql, store):
+def test_delete_project_hides(admin_gql, store):
     seed_project(store)
-    assert ok(gql, 'mutation { deleteProject(projectId: "proj") }')["deleteProject"]
+    assert ok(admin_gql, 'mutation { deleteProject(projectId: "proj") }')["deleteProject"]
     doc = store.collection("project_refs").get("proj")
     assert doc["hidden"] is True and doc["enabled"] is False
 
 
-def test_promote_vars_to_repo(gql, store):
+def test_promote_vars_to_repo(admin_gql, store):
     seed_project(store)
-    ok(gql, 'mutation { attachProjectToRepo(projectId: "proj") { id } }')
+    ok(admin_gql, 'mutation { attachProjectToRepo(projectId: "proj") { id } }')
     store.collection("project_vars").upsert({
         "_id": "proj", "vars": {"a": "1", "secret": "x"},
         "private_vars": ["secret"],
     })
-    assert ok(gql, """
+    assert ok(admin_gql, """
         mutation {
           promoteVarsToRepo(opts: {projectId: "proj",
                                    varNames: ["a", "secret"]})
@@ -425,10 +425,10 @@ def test_promote_vars_to_repo(gql, store):
     assert rvars["private_vars"] == ["secret"]
 
 
-def test_repo_settings_and_events(gql, store):
+def test_repo_settings_and_events(admin_gql, store):
     seed_project(store)
-    ok(gql, 'mutation { attachProjectToRepo(projectId: "proj") { id } }')
-    out = ok(gql, """
+    ok(admin_gql, 'mutation { attachProjectToRepo(projectId: "proj") { id } }')
+    out = ok(admin_gql, """
         mutation($rs: RepoSettingsInput) {
           saveRepoSettingsForSection(repoSettings: $rs, section: "GENERAL") {
             repoRef
@@ -436,21 +436,21 @@ def test_repo_settings_and_events(gql, store):
         }""", {"rs": {"repoId": "org/code", "repoRef": {"batch_time_minutes": 30}}}
     )["saveRepoSettingsForSection"]
     assert out["repoRef"]["batch_time_minutes"] == 30
-    events = ok(gql, 'query { repoEvents(repoId: "org/code") { count } }')
+    events = ok(admin_gql, 'query { repoEvents(repoId: "org/code") { count } }')
     assert events["repoEvents"]["count"] >= 1
 
-    settings = ok(gql, 'query { repoSettings(repoId: "org/code") { repoRef vars } }')
+    settings = ok(admin_gql, 'query { repoSettings(repoId: "org/code") { repoRef vars } }')
     assert settings["repoSettings"]["repoRef"]["batch_time_minutes"] == 30
 
 
-def test_save_project_settings_for_section_vars_redaction(gql, store):
+def test_save_project_settings_for_section_vars_redaction(admin_gql, store):
     seed_project(store)
     store.collection("project_vars").upsert({
         "_id": "proj", "vars": {"token": "real-secret"},
         "private_vars": ["token"],
     })
     # round-tripping the redacted value must NOT clobber the secret
-    ok(gql, """
+    ok(admin_gql, """
         mutation($ps: ProjectSettingsInput) {
           saveProjectSettingsForSection(projectSettings: $ps, section: "VARS") {
             vars { vars }
@@ -460,7 +460,7 @@ def test_save_project_settings_for_section_vars_redaction(gql, store):
     stored = store.collection("project_vars").get("proj")
     assert stored["vars"] == {"token": "real-secret", "new": "v"}
 
-    assert "unknown settings section" in err(gql, """
+    assert "unknown settings section" in err(admin_gql, """
         mutation {
           saveProjectSettingsForSection(projectSettings: {projectId: "proj"},
                                         section: "BOGUS") { vars { vars } }
@@ -480,24 +480,24 @@ def test_github_project_conflicts(gql, store):
     assert out["commitQueueIdentifiers"] == []
 
 
-def test_set_last_revision_and_force_repotracker(gql, store):
+def test_set_last_revision_and_force_repotracker(admin_gql, store):
     seed_project(store)
-    out = ok(gql, """
+    out = ok(admin_gql, """
         mutation {
           setLastRevision(opts: {projectIdentifier: "proj",
                                  revision: "abc123"}) { mergeBaseRevision }
         }""")["setLastRevision"]
     assert out["mergeBaseRevision"] == "abc123"
     assert store.collection("repotracker_state").get("proj")["last_revision"] == "abc123"
-    assert ok(gql, 'mutation { forceRepotrackerRun(projectId: "proj") }')[
+    assert ok(admin_gql, 'mutation { forceRepotrackerRun(projectId: "proj") }')[
         "forceRepotrackerRun"
     ]
 
 
-def test_default_section_to_repo_clears_vars(gql, store):
+def test_default_section_to_repo_clears_vars(admin_gql, store):
     seed_project(store)
     store.collection("project_vars").upsert({"_id": "proj", "vars": {"a": "1"}})
-    out = ok(gql, """
+    out = ok(admin_gql, """
         mutation {
           defaultSectionToRepo(opts: {projectId: "proj", section: "VARS"})
         }""")
@@ -871,3 +871,88 @@ def test_bb_create_ticket_and_metadata_links(gql, store):
 
     doc = store.collection(ann_mod.COLLECTION).get("t1:0")
     assert doc["metadata_links"][0]["text"] == "CI run"
+
+
+# --------------------------------------------------------------------------- #
+# authorization (reference @requireDistroAccess / @requireProjectAdmin /
+# spawn-host ownership; ADVICE r3: any authenticated user could
+# terminate others' spawn hosts, delete distros, hide projects)
+# --------------------------------------------------------------------------- #
+
+
+def test_spawn_host_ownership_enforced(gql, store):
+    seed_distro(store)
+    user_mod.create_user(store, "mallory")
+    other = GraphQLApi(store, acting_user="mallory")
+    h = ok(gql, """
+        mutation($i: SpawnHostInput) {
+          spawnHost(spawnHostInput: $i) { id }
+        }""", {"i": {"distroId": "d1"}})["spawnHost"]
+
+    assert "not owned by you" in err(other, """
+        mutation($i: EditSpawnHostInput) {
+          editSpawnHost(spawnHost: $i) { id }
+        }""", {"i": {"hostId": h["id"], "displayName": "stolen"}})
+    assert "not owned by you" in err(other, """
+        mutation($i: UpdateSpawnHostStatusInput) {
+          updateSpawnHostStatus(updateSpawnHostStatusInput: $i) { status }
+        }""", {"i": {"hostId": h["id"], "action": "TERMINATE"}})
+    # impersonation via the userId passthrough is an admin-only action
+    assert "superuser" in err(other, """
+        mutation($i: SpawnHostInput) {
+          spawnHost(spawnHostInput: $i) { id }
+        }""", {"i": {"distroId": "d1", "userId": "alice"}})
+
+
+def test_volume_ownership_enforced(gql, store):
+    seed_distro(store)
+    user_mod.create_user(store, "mallory")
+    other = GraphQLApi(store, acting_user="mallory")
+    ok(gql, """
+        mutation($i: SpawnVolumeInput!) { spawnVolume(spawnVolumeInput: $i) }
+    """, {"i": {"size": 10, "availabilityZone": "z"}})
+    vid = ok(gql, 'query { myVolumes(userId: "alice") { id } }')[
+        "myVolumes"][0]["id"]
+    assert "not owned by you" in err(
+        other, 'mutation { removeVolume(volumeId: "%s") }' % vid)
+    assert "not owned by you" in err(other, """
+        mutation($i: UpdateVolumeInput!) { updateVolume(updateVolumeInput: $i) }
+    """, {"i": {"volumeId": vid, "name": "stolen"}})
+    # the attach side paths enforce ownership too
+    assert "not owned by you" in err(other, """
+        mutation($i: SpawnHostInput) {
+          spawnHost(spawnHostInput: $i) { id }
+        }""", {"i": {"distroId": "d1", "volumeId": vid}})
+    mh = ok(other, """
+        mutation($i: SpawnHostInput) {
+          spawnHost(spawnHostInput: $i) { id }
+        }""", {"i": {"distroId": "d1"}})["spawnHost"]
+    assert "not owned by you" in err(other, """
+        mutation($i: EditSpawnHostInput) {
+          editSpawnHost(spawnHost: $i) { id }
+        }""", {"i": {"hostId": mh["id"], "volume": vid}})
+
+
+def test_distro_and_project_mutations_gated(gql, store):
+    seed_distro(store)
+    seed_project(store)
+    assert "superuser" in err(gql, """
+        mutation { createDistro(opts: {newDistroId: "d9"}) { newDistroId } }
+    """)
+    assert "superuser" in err(gql, """
+        mutation { saveDistro(opts: {distro: {id: "d1"}}) { distro { id } } }
+    """)
+    assert "admin access required" in err(gql, """
+        mutation { deleteProject(projectId: "proj") }
+    """)
+    assert "superuser" in err(gql, """
+        mutation($i: [String!]!, $s: String!) {
+          updateHostStatus(hostIds: $i, status: $s)
+        }""", {"i": ["h1"], "s": "quarantined"})
+
+
+def test_project_admin_scope_grants_access(gql, store):
+    seed_project(store)
+    user_mod.grant_role(store, "alice", "project:proj")
+    out = ok(gql, 'mutation { deleteProject(projectId: "proj") }')
+    assert out["deleteProject"] is True

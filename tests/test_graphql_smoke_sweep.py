@@ -1,0 +1,25 @@
+"""Every served GraphQL operation must execute clean (behavior parity,
+not name parity — docs/GRAPHQL_DIFF.md's "executes" column is backed by
+this sweep). A served-but-crashing resolver fails here, so it can never
+count toward parity again (VERDICT r3 weak #1/#2)."""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
+
+
+def test_every_served_operation_executes():
+    from graphql_smoke import run_all
+
+    results = run_all()
+    bad = {
+        f"{v['kind']}.{k}": v["error"]
+        for k, v in results.items()
+        if not v["ok"]
+    }
+    assert not bad, bad
+    # the sweep must actually be a sweep — both roots, full breadth
+    assert sum(1 for v in results.values() if v["kind"] == "Query") >= 46
+    assert sum(1 for v in results.values() if v["kind"] == "Mutation") >= 69

@@ -191,12 +191,16 @@ def test_project_settings_read_does_not_destroy_secrets(store):
 def test_save_project_settings_redacted_round_trip_keeps_secret(store):
     """Saving back a read (where private vars show {REDACTED}) must not
     overwrite the real secret with the placeholder."""
+    from evergreen_tpu.models import user as user_mod
+
+    user_mod.create_user(store, "admin")
+    user_mod.grant_role(store, "admin", "superuser")
     store.collection("project_refs").upsert({"_id": "p", "enabled": True})
     store.collection("project_vars").upsert(
         {"_id": "p", "vars": {"token": "hunter2", "plain": "x"},
          "private_vars": ["token"]}
     )
-    gql = GraphQLApi(store)
+    gql = GraphQLApi(store, acting_user="admin")
     read = gql_ok(gql, '{ projectSettings(projectId: "p") '
                        '{ vars { vars privateVars } } }')
     round_tripped = read["projectSettings"]["vars"]
@@ -539,10 +543,14 @@ def test_annotation_mutations_round_trip(store):
 
 
 def test_save_project_settings_mutation(store):
+    from evergreen_tpu.models import user as user_mod
+
+    user_mod.create_user(store, "admin")
+    user_mod.grant_role(store, "admin", "superuser")
     store.collection("project_refs").upsert(
         {"_id": "p", "display_name": "Old", "enabled": True}
     )
-    gql = GraphQLApi(store)
+    gql = GraphQLApi(store, acting_user="admin")
     data = gql_ok(
         gql,
         'mutation($ref: JSON, $vars: JSON) { '

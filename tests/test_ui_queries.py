@@ -144,7 +144,11 @@ def test_ui_page_embeds_queries():
 
 
 def test_every_ui_query_executes_without_errors(seeded_store):
-    gql = GraphQLApi(seeded_store)
+    from evergreen_tpu.models import user as user_mod
+
+    user_mod.create_user(seeded_store, "admin")
+    user_mod.grant_role(seeded_store, "admin", "superuser")
+    gql = GraphQLApi(seeded_store, acting_user="admin")
     for q in extract_ui_queries(PAGE):
         out = gql.execute(q, dummy_variables(q))
         assert "errors" not in out, (q, out.get("errors"))

@@ -1,0 +1,449 @@
+#!/usr/bin/env python
+"""Happy-path smoke execution of every served GraphQL operation.
+
+Behavior-parity backend for tools/graphql_diff.py (VERDICT r3 ask #2):
+an operation only counts as *served* if one real invocation against a
+seeded store returns data with no error entry. Arguments are generated
+from the typed schema — required args are filled from a name-based
+fixture mapping into the seeded world; per-op overrides cover the few
+operations whose happy path needs specific shapes.
+
+Run directly for a human-readable report of any non-executing ops:
+
+    python tools/graphql_smoke.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+# --------------------------------------------------------------------------- #
+# Seeded world
+# --------------------------------------------------------------------------- #
+
+#: canonical fixture ids, used both by the seeder and the arg filler
+IDS = {
+    "task": "smoke-task",
+    "exec0_task": "smoke-task",
+    "host": "smoke-host",
+    "spawn_host": "smoke-spawn-host",
+    "distro": "smoke-distro",
+    "project": "smoke-project",
+    "repo": "smoke-repo",
+    "version": "smoke-version",
+    "build": "smoke-build",
+    "patch": "smoke-patch",
+    "volume": "smoke-volume",
+    "user": "smoke-admin",
+    "subscription": "smoke-sub",
+    "image": "ubuntu2204",
+}
+
+
+def seed():
+    """A fresh store holding one of everything, owned by IDS['user']."""
+    import time as _time
+
+    from evergreen_tpu.cloud.volumes import VOLUMES_COLLECTION, Volume
+    from evergreen_tpu.globals import Requester, TaskStatus
+    from evergreen_tpu.ingestion.patches import Patch
+    from evergreen_tpu.ingestion.repotracker import (
+        ProjectRef,
+        upsert_project_ref,
+    )
+    from evergreen_tpu.models import build as build_mod
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import event as event_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models import user as user_mod
+    from evergreen_tpu.models import version as version_mod
+    from evergreen_tpu.models.build import Build
+    from evergreen_tpu.models.distro import Distro, HostAllocatorSettings
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.models.version import Version
+    from evergreen_tpu.storage.store import Store
+
+    store = Store()
+    me = IDS["user"]
+    user_mod.create_user(store, me, display_name="Smoke Admin")
+    user_mod.grant_role(store, me, "superuser")
+    user_mod.add_public_key(store, me, "laptop", "ssh-rsa AAAA smoke")
+
+    d = Distro(
+        id=IDS["distro"],
+        provider="mock",
+        host_allocator_settings=HostAllocatorSettings(maximum_hosts=10),
+    )
+    d.provider_settings["spawn_allowed"] = True
+    distro_mod.insert(store, d)
+
+    upsert_project_ref(store, ProjectRef(
+        id=IDS["project"], owner="org", repo="code", branch="main",
+        enabled=True,
+    ))
+    # repo-level ref + attach the project to it (the shape
+    # attachProjectToRepo writes)
+    store.collection("repo_refs").insert({
+        "_id": IDS["repo"], "owner": "org", "repo": "code",
+    })
+    store.collection("project_refs").update(
+        IDS["project"], {"repo_ref_id": IDS["repo"]}
+    )
+
+    now = _time.time()
+    version_mod.insert(store, Version(
+        id=IDS["version"], project=IDS["project"],
+        requester=Requester.REPOTRACKER.value, revision="abc123",
+        revision_order_number=7, author=me, message="smoke commit",
+        create_time=now, status="failed", activated=True,
+    ))
+    build_mod.insert(store, Build(
+        id=IDS["build"], version=IDS["version"], project=IDS["project"],
+        build_variant="bv1", display_name="BV 1", status="failed",
+    ))
+    task_mod.insert(store, Task(
+        id=IDS["task"], distro_id=IDS["distro"], project=IDS["project"],
+        version=IDS["version"], build_id=IDS["build"], build_variant="bv1",
+        display_name="unit-tests", status=TaskStatus.FAILED.value,
+        activated=True, requester=Requester.REPOTRACKER.value,
+        revision="abc123", finish_time=now, start_time=now - 60.0,
+    ))
+
+    host_mod.insert(store, Host(
+        id=IDS["host"], distro_id=IDS["distro"], provider="mock",
+        status="running", started_by="mci",
+    ))
+    host_mod.insert(store, Host(
+        id=IDS["spawn_host"], distro_id=IDS["distro"], provider="mock",
+        status="running", started_by=me, user_host=True,
+        no_expiration=False,
+    ))
+
+    store.collection(VOLUMES_COLLECTION).insert(Volume(
+        id=IDS["volume"], created_by=me, size_gb=100,
+        availability_zone="us-east-1a",
+    ).to_doc())
+
+    patch_doc = Patch(
+        id=IDS["patch"], project=IDS["project"], author=me,
+        description="smoke patch", status="created",
+    ).to_doc()
+    store.collection("patches").insert(patch_doc)
+
+    event_mod.log(store, event_mod.RESOURCE_ADMIN, "SMOKE", "smoke", {})
+    return store
+
+
+# --------------------------------------------------------------------------- #
+# Argument generation from the typed schema
+# --------------------------------------------------------------------------- #
+
+#: arg-name → fixture value; matched case-insensitively, most specific
+#: name wins (exact match first, then suffix match)
+ARG_VALUES: Dict[str, Any] = {
+    "taskid": IDS["task"], "taskids": [IDS["task"]],
+    "hostid": IDS["spawn_host"], "hostids": [IDS["spawn_host"]],
+    "distroid": IDS["distro"], "distroids": [IDS["distro"]],
+    "projectid": IDS["project"], "projectids": [IDS["project"]],
+    "identifier": IDS["project"],
+    "projectidentifier": IDS["project"],
+    "repoid": IDS["repo"],
+    "versionid": IDS["version"], "versionids": [IDS["version"]],
+    "buildid": IDS["build"],
+    "patchid": IDS["patch"], "patchids": [IDS["patch"]],
+    "volumeid": IDS["volume"],
+    "userid": IDS["user"],
+    "subscriptionids": [IDS["subscription"]],
+    "imageid": IDS["image"],
+    "execution": 0,
+    "priority": 50,
+    "limit": 5,
+    "page": 0,
+    "testname": "",
+    "taskname": "unit-tests",
+    "buildvariant": "bv1", "variant": "bv1",
+    "displayname": "Smoke Name",
+    "name": "laptop",
+    "key": "ssh-rsa AAAA smoke2",
+    "keyname": "laptop",
+    "note": "smoke note",
+    "owner": "org", "repo": "code", "branch": "main",
+    "url": "https://jira.example.com/SMOKE-1",
+    "issuekey": "SMOKE-1",
+    "isissue": True,
+    "section": "GENERAL",
+    "varnames": [],
+    "revision": "abc123",
+}
+
+
+def _unwrap(t: dict) -> Tuple[str, Optional[str], bool]:
+    """(kind, name, required) of a type ref with NON_NULL/LIST peeled."""
+    required = t.get("kind") == "NON_NULL"
+    while t and t.get("kind") in ("NON_NULL", "LIST"):
+        t = t.get("ofType") or {}
+    return t.get("kind", "SCALAR"), t.get("name"), required
+
+
+def _is_list(t: dict) -> bool:
+    while t and t.get("kind") == "NON_NULL":
+        t = t.get("ofType") or {}
+    return bool(t) and t.get("kind") == "LIST"
+
+
+def value_for(name: str, type_ref: dict, reg: Dict[str, dict]):
+    """A fixture value for one argument/input field, or None."""
+    key = name.lower()
+    if key in ARG_VALUES:
+        return ARG_VALUES[key]
+    kind, tname, _ = _unwrap(type_ref)
+    listy = _is_list(type_ref)
+    if kind == "INPUT_OBJECT":
+        inner = input_object_value(tname, reg)
+        return [inner] if listy else inner
+    for suffix, v in ARG_VALUES.items():
+        if key.endswith(suffix):
+            return v
+    if tname == "Boolean":
+        return True
+    if tname == "Int":
+        return [1] if listy else 1
+    if tname == "Float":
+        return [1.0] if listy else 1.0
+    if tname == "JSON":
+        return {}
+    return [] if listy else ""
+
+
+def input_object_value(tname: str, reg: Dict[str, dict]) -> dict:
+    """Minimal happy-path dict for an input object: required fields only,
+    plus any field with a direct fixture mapping."""
+    tdef = reg.get(tname) or {}
+    out = {}
+    fields = tdef.get("inputFields") or tdef.get("fields") or {}
+    for fname, fdef in fields.items():
+        _, _, required = _unwrap(fdef.get("type") or {})
+        if required or fname.lower() in ARG_VALUES:
+            out[fname] = value_for(fname, fdef.get("type") or {}, reg)
+    return out
+
+
+def selection_for(type_ref: dict, reg: Dict[str, dict]) -> str:
+    """A minimal selection set for the op's result type ('' for scalars)."""
+    kind, tname, _ = _unwrap(type_ref)
+    if kind != "OBJECT":
+        return ""
+    fields = (reg.get(tname) or {}).get("fields") or {}
+    for cand in ("id", "name", "status"):
+        if cand in fields:
+            return "{ %s }" % cand
+    for fname, fdef in fields.items():
+        fkind, _, _ = _unwrap(fdef.get("type") or {})
+        if fkind == "SCALAR" and not (fdef.get("args") or {}):
+            return "{ %s }" % fname
+    return "{ __typename }"
+
+
+# --------------------------------------------------------------------------- #
+# Per-op overrides: ops whose generic happy path needs a specific shape
+# --------------------------------------------------------------------------- #
+
+OVERRIDES: Dict[str, Dict[str, Any]] = {
+    "spawnHost": {"spawnHostInput": {"distroId": IDS["distro"]}},
+    "editSpawnHost": {"spawnHost": {
+        "hostId": IDS["spawn_host"], "displayName": "smokebox"}},
+    "updateSpawnHostStatus": {"updateSpawnHostStatusInput": {
+        "hostId": IDS["spawn_host"], "action": "STOP"}},
+    "spawnVolume": {"spawnVolumeInput": {
+        "size": 100, "availabilityZone": "us-east-1a"}},
+    "updateVolume": {"updateVolumeInput": {
+        "volumeId": IDS["volume"], "name": "smokevol"}},
+    "attachVolumeToHost": {"volumeAndHost": {
+        "volumeId": IDS["volume"], "hostId": IDS["spawn_host"]}},
+    "migrateVolume": {
+        "volumeId": IDS["volume"],
+        "spawnHostInput": {"distroId": IDS["distro"]},
+    },
+    "updateHostStatus": {
+        "hostIds": [IDS["host"]], "status": "quarantined"},
+    "saveAdminSettings": {"adminSettings": {
+        "banner": {"text": "smoke", "theme": "ANNOUNCEMENT"}}},
+    "setServiceFlags": {"updatedFlags": [
+        {"name": "alerts_disabled", "enabled": True}]},
+    "restartAdminTasks": {"opts": {
+        "startTime": 0.0, "endTime": 4102444800.0}},
+    "adminTasksToRestart": {"opts": {
+        "startTime": 0.0, "endTime": 4102444800.0}},
+    "adminEvents": {"opts": {}},
+    "mainlineCommits": {"options": {"projectIdentifier": IDS["project"]}},
+    "setLastRevision": {"opts": {
+        "projectIdentifier": IDS["project"], "revision": "abc123"}},
+    "saveSubscription": {"subscription": {
+        "resource_type": "TASK", "trigger": "outcome",
+        "selectors": [{"type": "id", "data": IDS["task"]}],
+        "subscriber": {"type": "email", "target": "smoke@example.com"},
+    }},
+    "saveDistro": {"opts": {"distro": {"id": IDS["distro"]}}},
+    "saveProjectSettingsForSection": {
+        "projectSettings": {"projectId": IDS["project"]},
+        "section": "GENERAL"},
+    "saveRepoSettingsForSection": {
+        "repoSettings": {"repoId": IDS["repo"]}, "section": "GENERAL"},
+    "setTaskPriorities": {"taskPriorities": [
+        {"taskId": IDS["task"], "priority": 50}]},
+    "updateUserSettings": {"userSettings": {"timezone": "UTC"}},
+    "updateBetaFeatures": {"opts": {"betaFeatures": {}}},
+    "copyDistro": {"opts": {
+        "distroIdToCopy": IDS["distro"], "newDistroId": "smoke-distro-2"}},
+    "createDistro": {"opts": {"newDistroId": "smoke-distro-new"}},
+    "copyProject": {"project": {
+        "projectIdToCopy": IDS["project"],
+        "newProjectIdentifier": "smoke-project-2"}},
+    "createProject": {"project": {
+        "identifier": "smoke-project-new", "owner": "org", "repo": "code"}},
+    "attachProjectToNewRepo": {"project": {
+        "projectId": IDS["project"], "newOwner": "org2", "newRepo": "code2"}},
+    "bbCreateTicket": {"taskId": IDS["task"]},
+    "setAnnotationMetadataLinks": {
+        "taskId": IDS["task"], "execution": 0,
+        "metadataLinks": [{"url": "https://x", "text": "x"}]},
+    "overrideTaskDependencies": {"taskId": IDS["task"]},
+    "setPatchVisibility": {
+        "patchIds": [IDS["patch"]], "hidden": True},
+    "deleteSubscriptions": {"subscriptionIds": []},
+    "removePublicKey": {"keyName": "laptop"},
+    "updatePublicKey": {
+        "targetKeyName": "laptop",
+        "updateInfo": {"name": "laptop2", "key": "ssh-rsa BBBB smoke"}},
+    "createPublicKey": {"publicKeyInput": {
+        "name": "desktop", "key": "ssh-rsa CCCC smoke"}},
+    "taskTestSample": {
+        "versionId": IDS["version"], "taskIds": [IDS["task"]],
+        "filters": []},
+    "buildVariantsForTaskName": {
+        "projectIdentifier": IDS["project"], "taskName": "unit-tests"},
+    "taskNamesForBuildVariant": {
+        "projectIdentifier": IDS["project"], "buildVariant": "bv1"},
+    "githubProjectConflicts": {"projectId": IDS["project"]},
+    "restartVersions": {
+        "versionId": IDS["version"], "abort": False,
+        "versionsToRestart": [{"versionId": IDS["version"]}]},
+}
+
+#: ops that need extra world state beyond seed(); name → setup(store)
+SETUP: Dict[str, Any] = {}
+
+
+def _setup_quarantined_task(store):
+    from evergreen_tpu.models import task as task_mod
+
+    task_mod.coll(store).update(IDS["task"], {"status": "quarantined"})
+
+
+SETUP["unquarantineTask"] = _setup_quarantined_task
+
+
+def _setup_detached_volume_host(store):
+    pass
+
+
+def _setup_attached_volume(store):
+    from evergreen_tpu.cloud.volumes import VOLUMES_COLLECTION
+
+    store.collection(VOLUMES_COLLECTION).update(
+        IDS["volume"], {"host_id": IDS["spawn_host"]}
+    )
+
+
+SETUP["detachVolumeFromHost"] = _setup_attached_volume
+
+
+def _setup_subscription(store):
+    store.collection("subscriptions").insert({
+        "_id": IDS["subscription"], "owner": IDS["user"],
+        "resource_type": "TASK", "trigger": "outcome",
+        "selectors": [{"type": "id", "data": IDS["task"]}],
+        "subscriber": {"type": "email", "target": "smoke@example.com"},
+    })
+
+
+SETUP["deleteSubscriptions"] = _setup_subscription
+
+
+# --------------------------------------------------------------------------- #
+# Runner
+# --------------------------------------------------------------------------- #
+
+
+def run_all() -> Dict[str, Dict[str, str]]:
+    """op name → {kind, ok, error} for every served operation."""
+    from evergreen_tpu.api.graphql import GraphQLApi
+    from evergreen_tpu.api.schema import schema
+
+    reg = schema()
+    results: Dict[str, Dict[str, str]] = {}
+    for opname, root in [
+        *((q, "Query") for q in GraphQLApi(seed()).queries),
+        *((m, "Mutation") for m in GraphQLApi(seed()).mutations),
+    ]:
+        store = seed()
+        if opname in SETUP:
+            SETUP[opname](store)
+        api = GraphQLApi(store, acting_user=IDS["user"])
+        fdef = (reg.get(root, {}).get("fields") or {}).get(opname)
+        if fdef is None:
+            # op served but not declared in the typed schema
+            results[opname] = {
+                "kind": root, "ok": False, "error": "not in typed schema"}
+            continue
+        args = dict(OVERRIDES.get(opname) or {})
+        for aname, adef in (fdef.get("args") or {}).items():
+            if aname in args:
+                continue
+            _, _, required = _unwrap(adef.get("type") or {})
+            if required and not adef.get("has_default"):
+                args[aname] = value_for(aname, adef.get("type") or {}, reg)
+        sel = selection_for(fdef.get("type") or {}, reg)
+        if args:
+            var_defs = ", ".join(f"$a{i}: JSON" for i in range(len(args)))
+            arg_list = ", ".join(
+                f"{a}: $a{i}" for i, a in enumerate(args))
+            doc = (
+                f"{root.lower()}({var_defs}) "
+                f"{{ {opname}({arg_list}) {sel} }}"
+            )
+            variables = {f"a{i}": v for i, v in enumerate(args.values())}
+        else:
+            doc = f"{root.lower()} {{ {opname} {sel} }}"
+            variables = {}
+        try:
+            out = api.execute(doc, variables)
+        except Exception as e:  # noqa: BLE001 — report, don't crash sweep
+            out = {"errors": [{"message": f"raised {type(e).__name__}: {e}"}]}
+        if "errors" in out:
+            results[opname] = {
+                "kind": root, "ok": False,
+                "error": out["errors"][0]["message"]}
+        else:
+            results[opname] = {"kind": root, "ok": True, "error": ""}
+    return results
+
+
+def main() -> int:
+    results = run_all()
+    bad = {k: v for k, v in results.items() if not v["ok"]}
+    ok_n = len(results) - len(bad)
+    print(f"executed clean: {ok_n}/{len(results)}")
+    for name, r in sorted(bad.items()):
+        print(f"  FAIL {r['kind']}.{name}: {r['error']}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
