@@ -59,6 +59,50 @@ def _seg_min(x, seg, n):
 # --------------------------------------------------------------------------- #
 
 
+def _f32_sortable_u32(x):
+    """Order-preserving f32 → u32 (IEEE-754 total order incl. ±inf):
+    negative floats flip all bits, non-negative set the sign bit."""
+    b = lax.bitcast_convert_type(x, jnp.uint32)
+    return jnp.where((b >> 31) == 1, ~b, b | jnp.uint32(1 << 31))
+
+
+def _i32_sortable_u32(x):
+    """Order-preserving i32 → u32 (flip the sign bit)."""
+    return lax.bitcast_convert_type(
+        x.astype(jnp.int32), jnp.uint32
+    ) ^ jnp.uint32(1 << 31)
+
+
+def _sort_packed_u64(d_key, neg_value, unit, group_order, num_dependents,
+                     priority, expected_s, idx, bits_u):
+    """The planner's 7-field lexicographic comparison as THREE u64 keys
+    (exact — every field keeps its full comparison width):
+
+      key1 = distro | sortable(neg value) | unit        (asc, asc, asc)
+      key2 = sortable(group order) | sortable(-numdep)  (asc, asc)
+      key3 = sortable(-priority)   | sortable(-expected)
+
+    u64 arithmetic needs x64 mode; ``jax.enable_x64`` scoped around the
+    packing affects only the ops created here — the rest of the solve
+    stays f32/i32. The descending fields negate BEFORE the sortable
+    transform, exactly like the variadic form's negated keys."""
+    with jax.enable_x64(True):
+        u64 = jnp.uint64
+        k1 = (
+            (d_key.astype(u64) << (32 + bits_u))
+            | (_f32_sortable_u32(neg_value).astype(u64) << bits_u)
+            | unit.astype(u64)
+        )
+        k2 = (
+            _i32_sortable_u32(group_order).astype(u64) << 32
+        ) | _i32_sortable_u32(-num_dependents.astype(jnp.int32)).astype(u64)
+        k3 = (
+            _i32_sortable_u32(-priority.astype(jnp.int32)).astype(u64) << 32
+        ) | _f32_sortable_u32(-expected_s).astype(u64)
+        out = lax.sort((k1, k2, k3, idx), num_keys=3)[3]
+    return out
+
+
 def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     """Compute per-unit sorting values and the global queue ordering."""
     N = a["t_valid"].shape[0]
@@ -132,21 +176,35 @@ def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     t_best_unit = jnp.where(t_best_unit == big, 0, t_best_unit)
 
     # ---- global lexicographic sort (one fused sort for all distros) ------- #
+    # The 7 comparison keys pack EXACTLY into three u64 composites
+    # (order-preserving bit transforms; static widths from the compiled
+    # dims), because variadic lax.sort costs ~6ms per extra key at 50k
+    # tasks on one CPU core — 8 keys tripled the whole solve. Stability
+    # of lax.sort supplies the final arange tie-break.
     t_valid = a["t_valid"]
     D_key = jnp.where(t_valid, a["t_distro"], D).astype(jnp.int32)
     neg_value = jnp.where(t_valid, -t_best_value, jnp.inf).astype(f32)
-    keys = (
-        D_key,
-        neg_value,
-        t_best_unit.astype(jnp.int32),
-        a["t_group_order"].astype(jnp.int32),
-        -a["t_num_dependents"].astype(jnp.int32),
-        -a["t_priority"].astype(jnp.int32),
-        -a["t_expected_s"].astype(f32),
-        jnp.arange(N, dtype=jnp.int32),
-    )
-    sorted_ops = lax.sort(keys, num_keys=8)
-    order = sorted_ops[7]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    bits_d = int(D + 1).bit_length()
+    bits_u = int(U).bit_length()
+    if bits_d + bits_u <= 32:
+        order = _sort_packed_u64(
+            D_key, neg_value, t_best_unit, a["t_group_order"],
+            a["t_num_dependents"], a["t_priority"], a["t_expected_s"],
+            idx, bits_u,
+        )
+    else:  # astronomically wide dims: keep the variadic form
+        keys = (
+            D_key,
+            neg_value,
+            t_best_unit.astype(jnp.int32),
+            a["t_group_order"].astype(jnp.int32),
+            -a["t_num_dependents"].astype(jnp.int32),
+            -a["t_priority"].astype(jnp.int32),
+            -a["t_expected_s"].astype(f32),
+            idx,
+        )
+        order = lax.sort(keys, num_keys=8)[7]
 
     return {
         "order": order,
@@ -435,14 +493,22 @@ def split_packed(buf_np: "np.ndarray", dims: Dict) -> Tuple:
     return buf_np[:i32_total], buf_np[i32_total:].view(np.float32)
 
 
-def run_solve_packed(snapshot) -> Dict:
-    """One tick's device work with four transfers total: three arena
-    buffers up (batched into the jit dispatch), one packed result buffer
-    down."""
-    buf = _packed_solve(
+def dispatch_solve_packed(snapshot):
+    """Enqueue one tick's device work and return the in-flight device
+    buffer WITHOUT blocking on the result. JAX dispatch is asynchronous:
+    the XLA computation runs on its own threads after this returns, so
+    the caller can overlap host work (packing the next snapshot,
+    persisting the previous plan) with the device solve. Pair with
+    ``fetch_solve_packed``."""
+    return _packed_solve(
         snapshot.arena.buffers, snapshot.arena.layout_key(),
         pallas_cfg_from_env(getattr(snapshot, "k_blocks", 0)),
     )
+
+
+def fetch_solve_packed(buf, snapshot) -> Dict:
+    """Block on an in-flight solve from ``dispatch_solve_packed`` and
+    unpack the result buffer into named output arrays."""
     buf_np = np.asarray(buf)
 
     N, _, _, G, _, D = snapshot.shape_key()
@@ -456,3 +522,10 @@ def run_solve_packed(snapshot) -> Dict:
         out[name] = bufs_np[kind][offs[kind] : offs[kind] + size]
         offs[kind] += size
     return out
+
+
+def run_solve_packed(snapshot) -> Dict:
+    """One tick's device work with four transfers total: three arena
+    buffers up (batched into the jit dispatch), one packed result buffer
+    down."""
+    return fetch_solve_packed(dispatch_solve_packed(snapshot), snapshot)

@@ -168,17 +168,26 @@ def _pallas_k_blocks(t_counts) -> int:
 
 def _bucket(n: int, minimum: int = 32) -> int:
     """Round up to the next bucket size: powers of two interleaved with
-    1.5× midpoints, so padding waste stays ≤ 50% while distinct compiled
-    shapes grow only logarithmically with queue size. All buckets ≥ 32 are
-    multiples of 16, so power-of-two meshes divide them evenly."""
+    1.25×/1.5×/1.75× quarter-points, so padding waste stays ≤ 25% (wasted
+    padding is wasted device FLOPs — at 50k tasks the old 1.5× grid padded
+    31%) while distinct compiled shapes still grow only logarithmically
+    with queue size. All buckets ≥ 64 are multiples of 16, so power-of-two
+    meshes divide them evenly; the dims-memo hysteresis in build_snapshot
+    keeps churn from walking the finer grid into recompiles."""
     if n <= minimum:
         return minimum
     lo = 1 << (int(n).bit_length() - 1)
     if n <= lo:
         return lo
-    mid = lo + lo // 2
-    if n <= mid:
-        return mid
+    if lo >= 64:
+        for num in (5, 6, 7):  # lo·1.25, lo·1.5, lo·1.75
+            q = lo * num // 4
+            if n <= q:
+                return q
+    else:
+        mid = lo + lo // 2
+        if n <= mid:
+            return mid
     return lo * 2
 
 
@@ -341,6 +350,71 @@ def _factor(v: float) -> float:
     return float(v) if v > 0 else 1.0
 
 
+#: time-independent per-task columns memcpy'd from the static memo into
+#: the arena each tick (plus scratch t_expected_floor_s/t_basis/t_start,
+#: which stay host-side)
+_STATIC_ARENA_COLS = (
+    "t_is_merge", "t_is_patch", "t_stepback", "t_generate", "t_in_group",
+    "t_priority", "t_group_order", "t_num_dependents", "t_expected_s",
+)
+
+
+def _pack_static(tasks: List[Task], evgpack) -> Dict[str, np.ndarray]:
+    """Static (time-independent) column block for one distro's task list,
+    cacheable for as long as the task instances are unchanged. Native
+    when evgpack is available; the pure-Python body below is the
+    behavioral reference (the warm/cold fuzzer pins both)."""
+    n = len(tasks)
+    cols: Dict[str, np.ndarray] = {
+        "t_is_merge": np.zeros(n, np.uint8),
+        "t_is_patch": np.zeros(n, np.uint8),
+        "t_stepback": np.zeros(n, np.uint8),
+        "t_generate": np.zeros(n, np.uint8),
+        "t_in_group": np.zeros(n, np.uint8),
+        "t_priority": np.zeros(n, np.int32),
+        "t_group_order": np.zeros(n, np.int32),
+        "t_num_dependents": np.zeros(n, np.int32),
+        "t_expected_s": np.zeros(n, np.float32),
+        "t_expected_floor_s": np.zeros(n, np.float32),
+        "t_basis": np.zeros(n, np.float64),
+        "t_start": np.zeros(n, np.float64),
+    }
+    if not n:
+        return cols
+    if evgpack is not None:
+        evgpack.pack_task_static_columns(
+            tasks, float(DEFAULT_TASK_DURATION_S), cols
+        )
+        return cols
+    merge_flags = [
+        is_github_merge_queue_requester(t.requester) for t in tasks
+    ]
+    cols["t_is_merge"][:] = merge_flags
+    cols["t_is_patch"][:] = [
+        (not m) and is_patch_requester(t.requester)
+        for m, t in zip(merge_flags, tasks)
+    ]
+    cols["t_stepback"][:] = [t.is_stepback_activated() for t in tasks]
+    cols["t_generate"][:] = [bool(t.generate_task) for t in tasks]
+    cols["t_in_group"][:] = [bool(t.task_group) for t in tasks]
+    cols["t_priority"][:] = [t.priority for t in tasks]
+    cols["t_group_order"][:] = [t.task_group_order for t in tasks]
+    cols["t_num_dependents"][:] = [t.num_dependents for t in tasks]
+    act = np.fromiter((t.activated_time for t in tasks), np.float64, n)
+    ingest = np.fromiter((t.ingest_time for t in tasks), np.float64, n)
+    cols["t_basis"][:] = np.where(act > 0.0, act, ingest)
+    sched = np.fromiter((t.scheduled_time for t in tasks), np.float64, n)
+    dmt = np.fromiter(
+        (t.dependencies_met_time for t in tasks), np.float64, n
+    )
+    cols["t_start"][:] = np.maximum(sched, dmt)
+    dur = np.fromiter((t.expected_duration_s for t in tasks), np.float64, n)
+    exp64 = np.where(dur > 0.0, dur, float(DEFAULT_TASK_DURATION_S))
+    cols["t_expected_s"][:] = exp64
+    cols["t_expected_floor_s"][:] = np.floor(exp64)
+    return cols
+
+
 def build_snapshot(
     distros: List[Distro],
     tasks_by_distro: Dict[str, List[Task]],
@@ -391,6 +465,8 @@ def build_snapshot(
     t_dm_np = np.ones(max(n_t_total, 1), np.uint8)
     m_task_parts: List[np.ndarray] = []
     m_unit_parts: List[np.ndarray] = []
+    static_jobs: List[Tuple[int, int, Dict[str, np.ndarray]]] = []
+    flat_task_ids: List[str] = []
     seg_names: List[Tuple[int, str]] = [(di, "") for di in range(n_d)]
     seg_max_hosts_l: List[int] = [0] * n_d
     named_base = n_d
@@ -410,7 +486,12 @@ def build_snapshot(
             and len(entry[1]) == len(tasks)
             and all(map(_is, entry[1], tasks))
         ):
-            _, _, n_units_d, mt_local, mu_local, snames, smax, seg_local = entry
+            (_, _, n_units_d, mt_local, mu_local, snames, smax, seg_local,
+             scols, t_ids, seg_pairs_c, pairs_di) = entry
+            seg_pairs = (
+                seg_pairs_c if pairs_di == di
+                else [(di, nm) for nm in snames]
+            )
             # rebase cached local ids into this build's coordinates
             mt_arr = mt_local + np.int32(base)
             mu_arr = mu_local + np.int32(unit_base)
@@ -442,6 +523,9 @@ def build_snapshot(
                 )
             mt_arr = np.frombuffer(mt, np.int32)
             mu_arr = np.frombuffer(mu, np.int32)
+            scols = _pack_static(tasks, evgpack)
+            t_ids = [t.id for t in tasks]
+            seg_pairs = [(di, nm) for nm in snames]
             if memb_memo is not None:
                 # store base-relative: grouped segments as local ordinals,
                 # ungrouped (== di) as -1
@@ -452,11 +536,14 @@ def build_snapshot(
                 memb_memo[d.id] = (
                     gv, tasks, n_units_d,
                     mt_arr - np.int32(base), mu_arr - np.int32(unit_base),
-                    snames, smax, seg_local,
+                    snames, smax, seg_local, scols, t_ids, seg_pairs, di,
                 )
-        seg_names.extend((di, nm) for nm in snames)
+        seg_names.extend(seg_pairs)
         seg_max_hosts_l.extend(smax)
         named_base += len(snames)
+        if len(tasks):
+            static_jobs.append((base, len(tasks), scols))
+        flat_task_ids.extend(t_ids)
         flat_tasks.extend(tasks)
         t_counts.append(len(tasks))
         u_counts.append(n_units_d)
@@ -504,20 +591,53 @@ def build_snapshot(
         return idx
 
     flat_hosts: List[Host] = []
-    h_distro: List[int] = []
-    h_seg: List[int] = []
+    h_counts: List[int] = []
     for d in distros:
-        di = d_index[d.id]
-        for h in hosts_by_distro.get(d.id, []):
-            flat_hosts.append(h)
-            h_distro.append(di)
-            name = (
-                h.task_group_string()
-                if h.running_task and h.running_task_group
-                else ""
-            )
-            h_seg.append(seg_for(di, name))
+        hs = hosts_by_distro.get(d.id, [])
+        flat_hosts.extend(hs)
+        h_counts.append(len(hs))
     n_h = len(flat_hosts)
+    h_distro_np = np.repeat(d_arange, h_counts)
+    # one native pass fills the host state columns (into temporaries —
+    # the arena does not exist until dims are known) and reports the few
+    # hosts running a task-group task; those map through seg_for, which
+    # may append segments, so this must run before dims are computed
+    hcols_tmp = {
+        "h_free": np.zeros(max(n_h, 1), np.uint8),
+        "h_running": np.zeros(max(n_h, 1), np.uint8),
+        "h_elapsed_s": np.zeros(max(n_h, 1), np.float32),
+        "h_expected_s": np.zeros(max(n_h, 1), np.float32),
+        "h_std_s": np.zeros(max(n_h, 1), np.float32),
+    }
+    named_hosts: List[Tuple[int, str]] = []
+    if evgpack is not None and n_h:
+        named_hosts = evgpack.pack_host_columns(
+            flat_hosts, running_estimates, hcols_tmp
+        )
+    elif n_h:
+        ests = [
+            running_estimates.get(h.id) if h.running_task else None
+            for h in flat_hosts
+        ]
+        hcols_tmp["h_free"][:n_h] = [h.is_free() for h in flat_hosts]
+        hcols_tmp["h_running"][:n_h] = [e is not None for e in ests]
+        hcols_tmp["h_elapsed_s"][:n_h] = [
+            e.elapsed_s if e else 0.0 for e in ests
+        ]
+        hcols_tmp["h_expected_s"][:n_h] = [
+            e.expected_s if e else 0.0 for e in ests
+        ]
+        hcols_tmp["h_std_s"][:n_h] = [
+            e.std_dev_s if e else 0.0 for e in ests
+        ]
+        for i, h in enumerate(flat_hosts):
+            if h.running_task and h.running_task_group:
+                named_hosts.append((i, h.task_group_string()))
+    # default segment = the distro's "" segment (global seg id == distro
+    # index); named-group hosts overwrite their slot
+    h_seg_np = h_distro_np.copy()
+    for i, name in named_hosts:
+        h_seg_np[i] = seg_for(int(h_distro_np[i]), name)
     n_g = len(seg_names)
 
     # ---- padded arena allocation ------------------------------------------ #
@@ -557,89 +677,47 @@ def build_snapshot(
             arr[:n] = values
         return arr
 
-    # task columns: one native pass when the evgpack extension is
-    # available (native/evgpack — ~12 Python-level passes collapse into a
-    # single C loop), else the pure-Python reference implementation below.
+    # task columns: per-distro static blocks (computed natively by
+    # evgpack.pack_task_static_columns on first sight of a task list and
+    # memoized alongside the memberships) are memcpy'd into the arena;
+    # only the two time-dependent columns are computed per tick, as one
+    # vectorized f64 pass over the cached time bases.
     fill("t_distro", t_distro, pad=D - 1)
-    from ..utils.native import get_evgpack
-
-    evgpack = get_evgpack()
     # scratch (host-only, not shipped to device): whole-second expected
     # durations feeding the exact u_runtime_term sum below — floored in
     # f64 before the f32 store, since casting first can round up across
     # an integer
     t_exp_floor = np.zeros(max(n_t, 1), np.float32)
-    if evgpack is not None and n_t:
-        cols = {
-            name: a[name][:n_t]
-            for name in (
-                "t_valid", "t_is_merge", "t_is_patch", "t_stepback",
-                "t_generate", "t_in_group", "t_priority", "t_group_order",
-                "t_num_dependents", "t_time_in_queue_s", "t_expected_s",
-                "t_wait_dep_met_s",
-            )
-        }
-        cols["t_expected_floor_s"] = t_exp_floor[:n_t]
-        evgpack.pack_task_columns(
-            flat_tasks, now, float(DEFAULT_TASK_DURATION_S),
-            float(MAX_TASK_TIME_IN_QUEUE_S), cols
-        )
-    elif n_t:
-        fill("t_valid", [True] * n_t)
-        fill("t_priority", [t.priority for t in flat_tasks])
-        merge_flags = [
-            is_github_merge_queue_requester(t.requester) for t in flat_tasks
-        ]
-        fill("t_is_merge", merge_flags)
-        fill(
-            "t_is_patch",
-            [
-                (not m) and is_patch_requester(t.requester)
-                for m, t in zip(merge_flags, flat_tasks)
-            ],
-        )
-        fill("t_stepback", [t.is_stepback_activated() for t in flat_tasks])
-        fill("t_generate", [t.generate_task for t in flat_tasks])
-        fill("t_in_group", [bool(t.task_group) for t in flat_tasks])
-        fill("t_group_order", [t.task_group_order for t in flat_tasks])
-        # Vectorized forms of Task.time_in_queue /
-        # wait_since_dependencies_met / fetch_expected_duration over raw
-        # columns (the serial oracle still calls the methods; the parity
-        # fuzzer pins these forms to the method semantics).
-        act = np.fromiter((t.activated_time for t in flat_tasks), np.float64, n_t)
-        ingest = np.fromiter((t.ingest_time for t in flat_tasks), np.float64, n_t)
-        basis = np.where(act > 0.0, act, ingest)
-        # floored in f64 before the f32 store (whole seconds — the
+    basis = np.zeros(max(n_t, 1), np.float64)
+    start = np.zeros(max(n_t, 1), np.float64)
+    a["t_valid"][:n_t] = True
+    for base, n, scols in static_jobs:
+        for name in _STATIC_ARENA_COLS:
+            a[name][base:base + n] = scols[name]
+        t_exp_floor[base:base + n] = scols["t_expected_floor_s"]
+        basis[base:base + n] = scols["t_basis"]
+        start[base:base + n] = scols["t_start"]
+    if n_t:
+        # floored in f64 BEFORE the f32 store (whole seconds — the
         # reference sums int64 nanoseconds, planner.go:318-322 — and
         # integer-valued sums are exact and order-independent in f64,
         # making the per-unit rank terms below bit-identical to the
         # serial oracle)
-        a["t_time_in_queue_s"][:n_t] = np.floor(
+        np.floor(
             np.where(
-                basis > 0.0,
+                basis[:n_t] > 0.0,
                 np.minimum(
-                    np.maximum(0.0, now - basis), MAX_TASK_TIME_IN_QUEUE_S
+                    np.maximum(0.0, now - basis[:n_t]),
+                    MAX_TASK_TIME_IN_QUEUE_S,
                 ),
                 0.0,
-            )
+            ),
+            out=basis[:n_t],
         )
-        sched = np.fromiter(
-            (t.scheduled_time for t in flat_tasks), np.float64, n_t
-        )
-        dmt = np.fromiter(
-            (t.dependencies_met_time for t in flat_tasks), np.float64, n_t
-        )
-        start = np.maximum(sched, dmt)
+        a["t_time_in_queue_s"][:n_t] = basis[:n_t]
         a["t_wait_dep_met_s"][:n_t] = np.where(
-            start > 0.0, np.maximum(0.0, now - start), 0.0
+            start[:n_t] > 0.0, np.maximum(0.0, now - start[:n_t]), 0.0
         )
-        dur = np.fromiter(
-            (t.expected_duration_s for t in flat_tasks), np.float64, n_t
-        )
-        exp64 = np.where(dur > 0.0, dur, float(DEFAULT_TASK_DURATION_S))
-        a["t_expected_s"][:n_t] = exp64
-        t_exp_floor[:n_t] = np.floor(exp64)
-        fill("t_num_dependents", [t.num_dependents for t in flat_tasks])
     fill("t_deps_met", t_dm_np[:n_t].view(np.bool_))
     fill("t_seg", t_seg_np[:n_t], pad=G - 1)
 
@@ -681,21 +759,25 @@ def build_snapshot(
         )
 
     # segments
-    fill("g_distro", [di for di, _ in seg_names], pad=D - 1)
+    fill(
+        "g_distro",
+        np.fromiter((di for di, _ in seg_names), np.int32, n_g),
+        pad=D - 1,
+    )
     fill("g_unnamed", [name == "" for _, name in seg_names])
     fill("g_max_hosts", seg_max_hosts_l)
-    fill("g_valid", [True] * n_g)
+    a["g_valid"][:n_g] = True
 
-    # hosts
-    fill("h_valid", [True] * n_h)
-    fill("h_distro", h_distro, pad=D - 1)
-    fill("h_seg", h_seg, pad=G - 1)
-    fill("h_free", [h.is_free() for h in flat_hosts])
-    ests = [running_estimates.get(h.id) if h.running_task else None for h in flat_hosts]
-    fill("h_running", [e is not None for e in ests])
-    fill("h_elapsed_s", [e.elapsed_s if e else 0.0 for e in ests])
-    fill("h_expected_s", [e.expected_s if e else 0.0 for e in ests])
-    fill("h_std_s", [e.std_dev_s if e else 0.0 for e in ests])
+    # hosts (state columns packed into hcols_tmp above, pre-dims)
+    a["h_valid"][:n_h] = True
+    fill("h_distro", h_distro_np, pad=D - 1)
+    fill("h_seg", h_seg_np, pad=G - 1)
+    if n_h:
+        a["h_free"][:n_h] = hcols_tmp["h_free"][:n_h].view(np.bool_)
+        a["h_running"][:n_h] = hcols_tmp["h_running"][:n_h].view(np.bool_)
+        a["h_elapsed_s"][:n_h] = hcols_tmp["h_elapsed_s"][:n_h]
+        a["h_expected_s"][:n_h] = hcols_tmp["h_expected_s"][:n_h]
+        a["h_std_s"][:n_h] = hcols_tmp["h_std_s"][:n_h]
 
     # distro settings matrix
     ps_l = [d.planner_settings for d in distros]
@@ -732,7 +814,7 @@ def build_snapshot(
     return Snapshot(
         now=now,
         distro_ids=[d.id for d in distros],
-        task_ids=[t.id for t in flat_tasks],
+        task_ids=flat_task_ids,
         host_ids=[h.id for h in flat_hosts],
         seg_names=seg_names,
         n_tasks=n_t,

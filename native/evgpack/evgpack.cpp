@@ -50,22 +50,22 @@ double AsDouble(PyObject* obj, bool* ok) {
   return v;
 }
 
-// pack_task_columns(tasks, now, default_duration_s, max_tiq_s, out) -> None
+// pack_task_static_columns(tasks, default_duration_s, out) -> None
 //
-// ``out`` maps column name -> writable contiguous numpy views:
+// The time-INdependent subset of pack_task_columns, plus the f64 time
+// bases (t_basis = activated-or-ingest, t_start = max(scheduled,
+// deps-met)) from which the per-tick dynamic columns (time-in-queue,
+// wait-since-deps-met) are one vectorized numpy expression. Outputs are
+// cacheable per unchanged task list (snapshot.py static-column memo):
+//   uint8:  t_is_merge, t_is_patch, t_stepback, t_generate, t_in_group
 //   int32:  t_priority, t_group_order, t_num_dependents
-//   uint8:  t_valid, t_is_merge, t_is_patch, t_stepback, t_generate,
-//           t_in_group
-//   float32: t_time_in_queue_s, t_expected_s, t_expected_floor_s,
-//            t_wait_dep_met_s
-PyObject* PackTaskColumns(PyObject*, PyObject* args) {
+//   float32: t_expected_s, t_expected_floor_s
+//   float64: t_basis, t_start
+PyObject* PackTaskStaticColumns(PyObject*, PyObject* args) {
   PyObject* tasks;
-  double now;
   double default_dur;
-  double max_tiq;
   PyObject* out;
-  if (!PyArg_ParseTuple(args, "OdddO", &tasks, &now, &default_dur, &max_tiq,
-                        &out)) {
+  if (!PyArg_ParseTuple(args, "OdO", &tasks, &default_dur, &out)) {
     return nullptr;
   }
   PyObject* seq = PySequence_Fast(tasks, "tasks must be a sequence");
@@ -91,17 +91,15 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
     return true;
   };
 
-  Py_buffer b_valid{}, b_merge{}, b_patch{}, b_stepback{}, b_generate{},
-      b_in_group{};
+  Py_buffer b_merge{}, b_patch{}, b_stepback{}, b_generate{}, b_in_group{};
   Py_buffer b_priority{}, b_group_order{}, b_numdep{};
-  Py_buffer b_tiq{}, b_expected{}, b_expected_floor{}, b_wait{};
-  Py_buffer* all[] = {&b_valid,    &b_merge,       &b_patch, &b_stepback,
-                      &b_generate, &b_in_group,    &b_priority,
-                      &b_group_order, &b_numdep,   &b_tiq,   &b_expected,
-                      &b_expected_floor, &b_wait};
+  Py_buffer b_expected{}, b_expected_floor{}, b_basis{}, b_start{};
+  Py_buffer* all[] = {&b_merge,       &b_patch,   &b_stepback,
+                      &b_generate,    &b_in_group, &b_priority,
+                      &b_group_order, &b_numdep,  &b_expected,
+                      &b_expected_floor, &b_basis, &b_start};
   int acquired = 0;
-  bool ok = view("t_valid", 1, &b_valid) && ++acquired &&
-            view("t_is_merge", 1, &b_merge) && ++acquired &&
+  bool ok = view("t_is_merge", 1, &b_merge) && ++acquired &&
             view("t_is_patch", 1, &b_patch) && ++acquired &&
             view("t_stepback", 1, &b_stepback) && ++acquired &&
             view("t_generate", 1, &b_generate) && ++acquired &&
@@ -109,17 +107,16 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
             view("t_priority", 4, &b_priority) && ++acquired &&
             view("t_group_order", 4, &b_group_order) && ++acquired &&
             view("t_num_dependents", 4, &b_numdep) && ++acquired &&
-            view("t_time_in_queue_s", 4, &b_tiq) && ++acquired &&
             view("t_expected_s", 4, &b_expected) && ++acquired &&
             view("t_expected_floor_s", 4, &b_expected_floor) && ++acquired &&
-            view("t_wait_dep_met_s", 4, &b_wait) && ++acquired;
+            view("t_basis", 8, &b_basis) && ++acquired &&
+            view("t_start", 8, &b_start) && ++acquired;
   if (!ok) {
     for (int i = 0; i < acquired; ++i) PyBuffer_Release(all[i]);
     Py_DECREF(seq);
     return nullptr;
   }
 
-  auto* valid = static_cast<uint8_t*>(b_valid.buf);
   auto* merge = static_cast<uint8_t*>(b_merge.buf);
   auto* patch = static_cast<uint8_t*>(b_patch.buf);
   auto* stepback = static_cast<uint8_t*>(b_stepback.buf);
@@ -128,10 +125,10 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   auto* priority = static_cast<int32_t*>(b_priority.buf);
   auto* group_order = static_cast<int32_t*>(b_group_order.buf);
   auto* numdep = static_cast<int32_t*>(b_numdep.buf);
-  auto* tiq = static_cast<float*>(b_tiq.buf);
   auto* expected = static_cast<float*>(b_expected.buf);
   auto* expected_floor = static_cast<float*>(b_expected_floor.buf);
-  auto* wait = static_cast<float*>(b_wait.buf);
+  auto* basis_out = static_cast<double*>(b_basis.buf);
+  auto* start_out = static_cast<double*>(b_start.buf);
 
   bool good = true;
   for (Py_ssize_t i = 0; good && i < n; ++i) {
@@ -154,7 +151,6 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
         !st || !dmt || !dur) {
       good = false;
     } else {
-      valid[i] = 1;
       const bool is_merge = StrEquals(req, "github_merge_request");
       merge[i] = is_merge ? 1 : 0;
       patch[i] = (!is_merge && (StrEquals(req, "patch_request") ||
@@ -163,8 +159,7 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
                      : 0;
       stepback[i] = StrEquals(act_by, "stepback-activator") ? 1 : 0;
       generate[i] = PyObject_IsTrue(gen) ? 1 : 0;
-      const bool grouped =
-          PyUnicode_Check(tg) && PyUnicode_GetLength(tg) > 0;
+      const bool grouped = PyUnicode_Check(tg) && PyUnicode_GetLength(tg) > 0;
       in_group[i] = grouped ? 1 : 0;
 
       priority[i] = static_cast<int32_t>(PyLong_AsLong(prio));
@@ -177,24 +172,10 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
       const double deps_met_t = AsDouble(dmt, &good);
       const double duration = AsDouble(dur, &good);
       if (good) {
-        // Task.time_in_queue: activated time, else ingest time; clamped at
-        // MAX_TASK_TIME_IN_QUEUE_S (globals.py) to bound float32 unit sums
-        const double basis = activated > 0.0 ? activated : ingest;
-        const double raw_tiq = basis > 0.0 && now > basis ? now - basis : 0.0;
-        // floor in f64 BEFORE the f32 store: the f32 cast can round up
-        // across an integer, which would break the exact per-unit rank
-        // terms (snapshot.py u_tiq_term) vs the serial oracle
-        tiq[i] = static_cast<float>(
-            std::floor(raw_tiq < max_tiq ? raw_tiq : max_tiq));
-        // Task.wait_since_dependencies_met
-        const double start = sched > deps_met_t ? sched : deps_met_t;
-        wait[i] = start > 0.0 && now > start
-                      ? static_cast<float>(now - start)
-                      : 0.0f;
-        // Task.fetch_expected_duration default
+        basis_out[i] = activated > 0.0 ? activated : ingest;
+        start_out[i] = sched > deps_met_t ? sched : deps_met_t;
         const double exp_dur = duration > 0.0 ? duration : default_dur;
         expected[i] = static_cast<float>(exp_dur);
-        // whole-second copy feeding the exact u_runtime_term sum
         expected_floor[i] = static_cast<float>(std::floor(exp_dur));
       }
       if (PyErr_Occurred()) good = false;
@@ -217,6 +198,154 @@ PyObject* PackTaskColumns(PyObject*, PyObject* args) {
   Py_DECREF(seq);
   if (!good) return nullptr;
   Py_RETURN_NONE;
+}
+
+// pack_host_columns(hosts, estimates, out) -> [(index, group_string)...]
+//
+// One native pass over the host fleet: emits h_free / h_running /
+// h_elapsed_s / h_expected_s / h_std_s directly into arena views and
+// returns the (rare) hosts that are running a task-group task, as
+// (flat index, task_group_string) pairs for the caller's segment
+// mapping. ``estimates`` maps host id -> RunningTaskEstimate.
+PyObject* PackHostColumns(PyObject*, PyObject* args) {
+  static PyObject* s_running_task = PyUnicode_InternFromString("running_task");
+  static PyObject* s_running_group =
+      PyUnicode_InternFromString("running_task_group");
+  static PyObject* s_teardown =
+      PyUnicode_InternFromString("task_group_teardown_start_time");
+  static PyObject* s_host_id = PyUnicode_InternFromString("id");
+  static PyObject* s_elapsed = PyUnicode_InternFromString("elapsed_s");
+  static PyObject* s_expected = PyUnicode_InternFromString("expected_s");
+  static PyObject* s_std = PyUnicode_InternFromString("std_dev_s");
+  static PyObject* s_tgs = PyUnicode_InternFromString("task_group_string");
+
+  PyObject* hosts;
+  PyObject* estimates;
+  PyObject* out;
+  if (!PyArg_ParseTuple(args, "OOO", &hosts, &estimates, &out)) {
+    return nullptr;
+  }
+  if (!PyDict_Check(estimates)) {
+    PyErr_SetString(PyExc_TypeError, "estimates must be a dict");
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(hosts, "hosts must be a sequence");
+  if (seq == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+  auto view = [&](const char* name, Py_ssize_t itemsize,
+                  Py_buffer* buf) -> bool {
+    PyObject* arr = PyDict_GetItemString(out, name);  // borrowed
+    if (arr == nullptr) {
+      PyErr_Format(PyExc_KeyError, "missing output column %s", name);
+      return false;
+    }
+    if (PyObject_GetBuffer(arr, buf, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) !=
+        0) {
+      return false;
+    }
+    if (buf->itemsize != itemsize || buf->len < n * itemsize) {
+      PyBuffer_Release(buf);
+      PyErr_Format(PyExc_ValueError, "column %s has wrong shape/dtype", name);
+      return false;
+    }
+    return true;
+  };
+
+  Py_buffer b_free{}, b_running{}, b_elapsed{}, b_expected{}, b_std{};
+  Py_buffer* all[] = {&b_free, &b_running, &b_elapsed, &b_expected, &b_std};
+  int acquired = 0;
+  bool ok = view("h_free", 1, &b_free) && ++acquired &&
+            view("h_running", 1, &b_running) && ++acquired &&
+            view("h_elapsed_s", 4, &b_elapsed) && ++acquired &&
+            view("h_expected_s", 4, &b_expected) && ++acquired &&
+            view("h_std_s", 4, &b_std) && ++acquired;
+  if (!ok) {
+    for (int i = 0; i < acquired; ++i) PyBuffer_Release(all[i]);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  auto* hfree = static_cast<uint8_t*>(b_free.buf);
+  auto* hrun = static_cast<uint8_t*>(b_running.buf);
+  auto* helap = static_cast<float*>(b_elapsed.buf);
+  auto* hexp = static_cast<float*>(b_expected.buf);
+  auto* hstd = static_cast<float*>(b_std.buf);
+
+  PyObject* named = PyList_New(0);
+  if (named == nullptr) {
+    for (auto* b : all) PyBuffer_Release(b);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+
+  bool good = true;
+  for (Py_ssize_t i = 0; good && i < n; ++i) {
+    PyObject* h = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+    PyObject* rt = PyObject_GetAttr(h, s_running_task);
+    PyObject* rg = PyObject_GetAttr(h, s_running_group);
+    PyObject* td = PyObject_GetAttr(h, s_teardown);
+    PyObject* hid = PyObject_GetAttr(h, s_host_id);
+    if (!rt || !rg || !td || !hid) {
+      good = false;
+    } else {
+      const bool has_task =
+          PyUnicode_Check(rt) && PyUnicode_GetLength(rt) > 0;
+      const double teardown = AsDouble(td, &good);
+      // Host.is_free: no running task and not tearing down
+      hfree[i] = (!has_task && teardown <= 0.0) ? 1 : 0;
+      PyObject* est =
+          has_task ? PyDict_GetItem(estimates, hid) : nullptr;  // borrowed
+      if (est != nullptr && est != Py_None) {
+        hrun[i] = 1;
+        PyObject* e = PyObject_GetAttr(est, s_elapsed);
+        PyObject* x = PyObject_GetAttr(est, s_expected);
+        PyObject* sd = PyObject_GetAttr(est, s_std);
+        if (!e || !x || !sd) {
+          good = false;
+        } else {
+          helap[i] = static_cast<float>(AsDouble(e, &good));
+          hexp[i] = static_cast<float>(AsDouble(x, &good));
+          hstd[i] = static_cast<float>(AsDouble(sd, &good));
+        }
+        Py_XDECREF(e);
+        Py_XDECREF(x);
+        Py_XDECREF(sd);
+      } else {
+        hrun[i] = 0;
+        helap[i] = 0.0f;
+        hexp[i] = 0.0f;
+        hstd[i] = 0.0f;
+      }
+      if (has_task && PyUnicode_Check(rg) && PyUnicode_GetLength(rg) > 0) {
+        PyObject* gs = PyObject_CallMethodNoArgs(h, s_tgs);
+        if (gs == nullptr) {
+          good = false;
+        } else {
+          PyObject* pair = Py_BuildValue("(nO)", i, gs);
+          Py_DECREF(gs);
+          if (pair == nullptr || PyList_Append(named, pair) != 0) {
+            Py_XDECREF(pair);
+            good = false;
+          } else {
+            Py_DECREF(pair);
+          }
+        }
+      }
+      if (PyErr_Occurred()) good = false;
+    }
+    Py_XDECREF(rt);
+    Py_XDECREF(rg);
+    Py_XDECREF(td);
+    Py_XDECREF(hid);
+  }
+
+  for (auto* b : all) PyBuffer_Release(b);
+  Py_DECREF(seq);
+  if (!good) {
+    Py_DECREF(named);
+    return nullptr;
+  }
+  return named;
 }
 
 // build_memberships(tasks, group_versions, base) ->
@@ -700,8 +829,10 @@ PyObject* FillDepsMet(PyObject*, PyObject* args) {
 }
 
 PyMethodDef kMethods[] = {
-    {"pack_task_columns", PackTaskColumns, METH_VARARGS,
-     "Fill per-task snapshot columns in one native pass."},
+    {"pack_task_static_columns", PackTaskStaticColumns, METH_VARARGS,
+     "Time-independent task columns + f64 time bases (cacheable)."},
+    {"pack_host_columns", PackHostColumns, METH_VARARGS,
+     "Host fleet columns in one pass; returns named-group (i, key) pairs."},
     {"build_memberships", BuildMemberships, METH_VARARGS,
      "Planner unit grouping: (n_units, m_task, m_unit, group_keys)."},
     {"fill_deps_met", FillDepsMet, METH_VARARGS,

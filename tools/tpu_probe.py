@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Background TPU-tunnel prober (VERDICT r3 ask #3a).
+
+Probes the axon TPU tunnel on an interval, appends every attempt to
+TPU_PROBE_LOG.jsonl at the repo root, and on the FIRST healthy window
+runs the real benchmark on the TPU and snapshots the proof to
+TPU_EVIDENCE.json (via bench.py's own evidence writer).
+
+    python tools/tpu_probe.py                # daemon, probe every 180s
+    python tools/tpu_probe.py --once         # single probe, exit 0/1
+    python tools/tpu_probe.py --interval 60  # custom cadence
+
+The service entry point (`cli.py service`) starts this loop in a daemon
+thread so a long-running deployment captures evidence whenever the
+tunnel first comes up — no operator action needed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "TPU_PROBE_LOG.jsonl")
+EVIDENCE = os.path.join(ROOT, "TPU_EVIDENCE.json")
+
+if ROOT not in sys.path:
+    sys.path.append(ROOT)
+
+
+def _log(record: dict) -> None:
+    record["t"] = round(time.time(), 1)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def _probe_env() -> dict:
+    """Env for probe/capture subprocesses: undo a force_cpu scrub (it
+    blanks PALLAS_AXON_POOL_IPS in THIS process but stashes the original
+    in EVG_AXON_POOL_IPS_ORIG) so the prober keeps testing the tunnel
+    even after the service fell back to CPU at boot."""
+    env = dict(os.environ)
+    if not env.get("PALLAS_AXON_POOL_IPS") and env.get(
+        "EVG_AXON_POOL_IPS_ORIG"
+    ):
+        env["PALLAS_AXON_POOL_IPS"] = env["EVG_AXON_POOL_IPS_ORIG"]
+    env.pop("JAX_PLATFORMS", None)  # let the axon backend win
+    return env
+
+
+def probe_once(timeout_s: float = 45.0) -> bool:
+    from evergreen_tpu.utils.jaxenv import probe_tpu
+
+    ok = probe_tpu(timeout_s, env=_probe_env())
+    _log({"event": "probe", "ok": ok})
+    return ok
+
+
+def capture_evidence(timeout_s: float = 1800.0) -> bool:
+    """Run the full benchmark in a fresh process on the live tunnel;
+    bench.py writes TPU_EVIDENCE.json itself when the backend is axon."""
+    _log({"event": "capture_start"})
+    env = _probe_env()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            timeout=timeout_s, capture_output=True, env=env, text=True,
+        )
+    except (subprocess.TimeoutExpired, OSError) as e:
+        _log({"event": "capture_failed", "error": str(e)[:200]})
+        return False
+    ok = r.returncode == 0 and os.path.exists(EVIDENCE)
+    _log({
+        "event": "capture_done", "ok": ok, "rc": r.returncode,
+        "stdout": r.stdout.strip()[-500:],
+        "stderr": r.stderr.strip()[-500:],
+    })
+    return ok
+
+
+def daemon_loop(interval_s: float = 180.0) -> None:
+    """Probe forever; capture bench evidence on the first healthy window
+    (re-capture at most once per day after a success, and back off an
+    hour after a failed capture — a flappy tunnel must not relaunch the
+    full benchmark every probe interval)."""
+    next_capture_after = 0.0
+    while True:
+        try:
+            if probe_once() and time.time() >= next_capture_after:
+                ok = capture_evidence()
+                next_capture_after = time.time() + (86_400 if ok else 3_600)
+        except Exception as e:  # noqa: BLE001 — the prober must survive
+            _log({"event": "probe_error", "error": repr(e)[:200]})
+        time.sleep(interval_s)
+
+
+def main() -> int:
+    if "--once" in sys.argv:
+        ok = probe_once()
+        print(f"tpu probe: {'healthy' if ok else 'unreachable'}")
+        if ok and not os.path.exists(EVIDENCE):
+            capture_evidence()
+        return 0 if ok else 1
+    interval = 180.0
+    if "--interval" in sys.argv:
+        interval = float(sys.argv[sys.argv.index("--interval") + 1])
+    print(f"tpu prober: every {interval:.0f}s -> {LOG}", flush=True)
+    daemon_loop(interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
