@@ -1,8 +1,20 @@
-# Workflow entry points. `make hooks` once per clone; after that every
-# `git commit` runs the full-suite gate (tools/hooks/pre-commit) and a
-# red suite refuses the commit — this is the only documented commit path.
+# Workflow entry points. The ONLY documented commit path is
+#
+#     make commit MSG="what the milestone is"
+#
+# which runs the full-suite gate UNCONDITIONALLY (no skip env, no
+# --no-verify analogue) and only then commits the staged+working tree.
+# Every gate run — from this target or the hook — is appended to
+# GATE_LOG.jsonl with the outcome, so a skipped gate is visible in
+# history. `make hooks` additionally installs the pre-commit hook as
+# belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native
+.PHONY: test gate hooks bench multichip native commit
+
+commit:
+	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
+	python tools/gate.py
+	git add -A && git commit -m "$(MSG)"
 
 hooks:
 	sh tools/install_hooks.sh

@@ -14,11 +14,16 @@ the same ``_authorize`` path resolves.
 from __future__ import annotations
 
 import abc
+import base64
 import hashlib
+import hmac
+import json
 import secrets
 import time as _time
+import urllib.error
 import urllib.parse
-from typing import Dict, List, Optional
+import urllib.request
+from typing import Dict, List, Optional, Tuple
 
 from ..models import user as user_mod
 from ..models.user import User
@@ -191,24 +196,134 @@ class NaiveUserManager(UserManager):
 
 class GithubOAuthClient:
     """Network leg of the GitHub OAuth web flow (reference auth/github.go
-    token exchange + thirdparty user/org lookups). Injectable; the
-    in-image default is the fake."""
+    GetLoginCallbackHandler token exchange + thirdparty/github.go:38
+    ``githubAccessURL`` and user/org lookups). This is the REAL HTTP
+    client: stdlib urllib against github.com, constructed by the loader
+    only when the auth config's egress flag is on (the in-image default
+    is the fake, which subclasses this so the interface cannot drift)."""
 
-    def exchange_code(self, code: str) -> Optional[str]:  # pragma: no cover
-        raise NotImplementedError
+    OAUTH_BASE = "https://github.com/login/oauth"
+    API_BASE = "https://api.github.com"
 
-    def get_user(self, access_token: str) -> Optional[Dict]:  # pragma: no cover
-        """→ {"login": ..., "name": ..., "email": ...}"""
-        raise NotImplementedError
+    def __init__(
+        self,
+        client_id: str,
+        client_secret: str,
+        oauth_base: str = "",
+        api_base: str = "",
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.oauth_base = (oauth_base or self.OAUTH_BASE).rstrip("/")
+        self.api_base = (api_base or self.API_BASE).rstrip("/")
+        self.timeout_s = timeout_s
+
+    # -- HTTP plumbing ---------------------------------------------------- #
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ):
+        """→ (status, parsed-json-or-None). 4xx statuses are returned to
+        the caller (they are protocol outcomes: bad code, revoked token,
+        not-a-member); transport failures raise AuthError."""
+        req = urllib.request.Request(
+            url, data=body, method=method, headers=headers or {}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                raw = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise AuthError(f"github api unreachable: {e}") from e
+        try:
+            parsed = json.loads(raw) if raw else None
+        except ValueError:
+            parsed = None
+        return status, parsed
+
+    # -- the three legs --------------------------------------------------- #
+
+    def exchange_code(self, code: str) -> Optional[str]:
+        """POST /login/oauth/access_token. GitHub reports a bad or expired
+        verification code as 200 + {"error": ...} — both shapes map to
+        None (login_callback turns that into a clean AuthError)."""
+        body = urllib.parse.urlencode(
+            {
+                "client_id": self.client_id,
+                "client_secret": self.client_secret,
+                "code": code,
+            }
+        ).encode()
+        status, parsed = self._request(
+            "POST",
+            f"{self.oauth_base}/access_token",
+            body,
+            {
+                "Accept": "application/json",
+                "Content-Type": "application/x-www-form-urlencoded",
+            },
+        )
+        if status != 200 or not isinstance(parsed, dict) or parsed.get("error"):
+            return None
+        return parsed.get("access_token") or None
+
+    def get_user(self, access_token: str) -> Optional[Dict]:
+        """GET /user → {"login", "name", "email"}; 401 (revoked/expired
+        token) → None."""
+        status, parsed = self._request(
+            "GET",
+            f"{self.api_base}/user",
+            None,
+            {
+                "Accept": "application/vnd.github+json",
+                "Authorization": f"Bearer {access_token}",
+            },
+        )
+        if status != 200 or not isinstance(parsed, dict):
+            return None
+        return {
+            "login": parsed.get("login", ""),
+            "name": parsed.get("name") or parsed.get("login", ""),
+            "email": parsed.get("email") or "",
+        }
 
     def user_in_organization(
         self, access_token: str, login: str, org: str
-    ) -> bool:  # pragma: no cover
-        raise NotImplementedError
+    ) -> bool:
+        """GET /orgs/{org}/members/{login}: 204 member, 404/302 not.
+        Any other status (403 token-scope/rate-limit, 5xx) is an
+        AuthError — membership must never be inferred from a failed
+        check."""
+        status, _ = self._request(
+            "GET",
+            f"{self.api_base}/orgs/{org}/members/{login}",
+            None,
+            {
+                "Accept": "application/vnd.github+json",
+                "Authorization": f"Bearer {access_token}",
+            },
+        )
+        if status == 204:
+            return True
+        if status in (302, 404):
+            return False
+        raise AuthError(f"github org membership check failed: HTTP {status}")
 
 
 class FakeGithubOAuth(GithubOAuthClient):
+    """In-memory IdP for the zero-egress image; subclasses the real
+    client so any interface drift breaks loudly."""
+
     def __init__(self) -> None:
+        super().__init__("fake-client-id", "fake-client-secret")
         self.codes: Dict[str, str] = {}  # code → access token
         self.tokens: Dict[str, Dict] = {}  # access token → user info
         self.org_members: Dict[str, set] = {}  # org → {login}
@@ -299,17 +414,205 @@ class GithubUserManager(UserManager):
 # --------------------------------------------------------------------------- #
 
 
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+#: DER prefix of the SHA-256 DigestInfo (RFC 8017 §9.2 note 1)
+_SHA256_DIGESTINFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420"
+)
+
+
+def _rsa_verify_pkcs1_sha256(n: int, e: int, sig: bytes, msg: bytes) -> bool:
+    """RSASSA-PKCS1-v1_5 / SHA-256 verification (RS256) from first
+    principles — modular exponentiation + exact EM reconstruction, no
+    third-party crypto dependency."""
+    k = (n.bit_length() + 7) // 8
+    if len(sig) != k:
+        return False
+    m = pow(int.from_bytes(sig, "big"), e, n)
+    em = m.to_bytes(k, "big")
+    digest = hashlib.sha256(msg).digest()
+    ps_len = k - 3 - len(_SHA256_DIGESTINFO) - len(digest)
+    if ps_len < 8:
+        return False
+    expected = (
+        b"\x00\x01" + b"\xff" * ps_len + b"\x00" + _SHA256_DIGESTINFO + digest
+    )
+    return hmac.compare_digest(em, expected)
+
+
 class OidcClient:
     """Network leg of the OIDC authorization-code flow (reference
-    auth/okta.go token exchange + claim validation)."""
+    auth/okta.go:19-51 via gimlet/okta: token exchange with Basic client
+    auth, ID-token signature verification against the issuer's JWKS, and
+    exp/iss/aud claim validation). Real HTTP client; the fake subclasses
+    it so the interface cannot drift."""
 
-    def exchange_code(self, code: str) -> Optional[Dict]:  # pragma: no cover
-        """→ claims dict: {"email": ..., "name": ..., "groups": [...]}"""
-        raise NotImplementedError
+    def __init__(
+        self,
+        client_id: str,
+        client_secret: str,
+        issuer: str,
+        callback_url: str = "",
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.issuer = issuer.rstrip("/")
+        self.callback_url = callback_url
+        self.timeout_s = timeout_s
+        # JWKS cache: kid → (n, e); refreshed once per unknown kid
+        self._jwks: Dict[str, Tuple[int, int]] = {}
+
+    # -- HTTP plumbing ---------------------------------------------------- #
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ):
+        req = urllib.request.Request(
+            url, data=body, method=method, headers=headers or {}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                raw = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise AuthError(f"oidc issuer unreachable: {e}") from e
+        try:
+            parsed = json.loads(raw) if raw else None
+        except ValueError:
+            parsed = None
+        return status, parsed
+
+    def _fetch_jwks(self) -> None:
+        status, parsed = self._request("GET", f"{self.issuer}/v1/keys")
+        if status != 200 or not isinstance(parsed, dict):
+            raise AuthError(f"could not fetch issuer JWKS: HTTP {status}")
+        for key in parsed.get("keys", []):
+            if key.get("kty") != "RSA" or not key.get("kid"):
+                continue
+            try:
+                n = int.from_bytes(_b64url_decode(key["n"]), "big")
+                e = int.from_bytes(_b64url_decode(key["e"]), "big")
+            except (KeyError, ValueError):
+                continue
+            self._jwks[key["kid"]] = (n, e)
+
+    # -- ID-token verification -------------------------------------------- #
+
+    def _verify_id_token(
+        self, token: str, now: Optional[float] = None
+    ) -> Dict:
+        """Full RS256 verification: JWKS key lookup by kid, signature
+        check, then exp / iss / aud claims. Raises AuthError with a
+        distinct message per failure shape (the contract tests pin
+        these)."""
+        now = _time.time() if now is None else now
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthError("malformed ID token")
+        try:
+            header = json.loads(_b64url_decode(parts[0]))
+            claims = json.loads(_b64url_decode(parts[1]))
+            sig = _b64url_decode(parts[2])
+        except (ValueError, KeyError) as exc:
+            raise AuthError("malformed ID token") from exc
+        if header.get("alg") != "RS256":
+            raise AuthError(f"unsupported ID token alg {header.get('alg')!r}")
+        kid = header.get("kid", "")
+        if kid not in self._jwks:
+            self._fetch_jwks()
+        if kid not in self._jwks:
+            raise AuthError(f"no JWKS key for kid {kid!r}")
+        n, e = self._jwks[kid]
+        signing_input = f"{parts[0]}.{parts[1]}".encode()
+        if not _rsa_verify_pkcs1_sha256(n, e, sig, signing_input):
+            raise AuthError("ID token signature verification failed")
+        if float(claims.get("exp", 0)) < now:
+            raise AuthError("ID token is expired")
+        if claims.get("iss", "").rstrip("/") != self.issuer:
+            raise AuthError("ID token issuer mismatch")
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud]
+        if self.client_id not in auds:
+            raise AuthError("ID token audience mismatch")
+        return claims
+
+    # -- the exchange leg -------------------------------------------------- #
+
+    def exchange_code(self, code: str) -> Optional[Dict]:
+        """POST {issuer}/v1/token with Basic client auth; verify the
+        returned ID token; → claims dict {"email", "name", "groups"}.
+        A rejected code (4xx from the token endpoint) maps to None; a
+        token that fails verification raises AuthError."""
+        basic = base64.b64encode(
+            f"{self.client_id}:{self.client_secret}".encode()
+        ).decode()
+        body = urllib.parse.urlencode(
+            {
+                "grant_type": "authorization_code",
+                "code": code,
+                "redirect_uri": self.callback_url,
+            }
+        ).encode()
+        status, parsed = self._request(
+            "POST",
+            f"{self.issuer}/v1/token",
+            body,
+            {
+                "Accept": "application/json",
+                "Content-Type": "application/x-www-form-urlencoded",
+                "Authorization": f"Basic {basic}",
+            },
+        )
+        if status != 200 or not isinstance(parsed, dict):
+            return None
+        id_token = parsed.get("id_token", "")
+        if not id_token:
+            return None
+        claims = self._verify_id_token(id_token)
+        out = {
+            "email": claims.get("email", ""),
+            "name": claims.get("name", "") or claims.get("email", ""),
+            "groups": list(claims.get("groups", []) or []),
+        }
+        # Okta omits email/groups from the ID token when the scopes
+        # don't request them — fall back to the userinfo endpoint
+        # (reference gimlet/okta getUserInfo)
+        if not out["email"] and parsed.get("access_token"):
+            status, info = self._request(
+                "GET",
+                f"{self.issuer}/v1/userinfo",
+                None,
+                {"Authorization": f"Bearer {parsed['access_token']}"},
+            )
+            if status == 200 and isinstance(info, dict):
+                out["email"] = info.get("email", "")
+                out["name"] = out["name"] or info.get("name", "")
+                out["groups"] = out["groups"] or list(
+                    info.get("groups", []) or []
+                )
+        return out
 
 
 class FakeOidc(OidcClient):
+    """In-memory IdP for the zero-egress image; subclasses the real
+    client so any interface drift breaks loudly."""
+
     def __init__(self) -> None:
+        super().__init__(
+            "fake-client-id", "fake-client-secret", "https://fake-issuer"
+        )
         self.codes: Dict[str, Dict] = {}
 
     def add_user(self, code: str, email: str, groups: List[str],
@@ -489,6 +792,27 @@ def load_user_manager(
     from ..settings import AuthConfig
 
     cfg = AuthConfig.get(store)
+    egress = bool(getattr(cfg, "egress_enabled", False))
+
+    def _github_client() -> Optional[GithubOAuthClient]:
+        """Injected client wins; otherwise the REAL client when egress is
+        on, and the manager's default fake in the zero-egress image."""
+        if github_client is not None:
+            return github_client
+        if egress:
+            return GithubOAuthClient(
+                cfg.github_client_id, cfg.github_client_secret
+            )
+        return None
+
+    def _oidc_client(
+        client_id: str, client_secret: str, issuer: str
+    ) -> Optional[OidcClient]:
+        if oidc_client is not None:
+            return oidc_client
+        if egress:
+            return OidcClient(client_id, client_secret, issuer)
+        return None
 
     def make(kind: str) -> UserManager:
         if kind == "naive":
@@ -499,7 +823,7 @@ def load_user_manager(
                 cfg.github_client_secret,
                 cfg.github_organization,
                 users=getattr(cfg, "github_users", []) or [],
-                client=github_client,
+                client=_github_client(),
             )
         if kind == "okta":
             # fall back to the okta_service section's credentials ONLY
@@ -520,16 +844,27 @@ def load_user_manager(
                         cfg, "okta_expected_email_domains", []
                     )
                     or [],
-                    client=oidc_client,
+                    scopes=getattr(cfg, "okta_scopes", []) or None,
+                    client=_oidc_client(
+                        cfg.okta_client_id,
+                        cfg.okta_client_secret,
+                        cfg.okta_issuer,
+                    ),
                 )
+            # the okta_service section is M2M credentials only
+            # (reference config_okta_service.go:14-19: client id/secret,
+            # scopes, audience, issuer — no user group or email-domain
+            # fields); interactive group gating comes solely from the
+            # auth section
             svc = OktaServiceConfig.get(store)
             return OktaUserManager(
                 svc.client_id,
                 svc.client_secret,
                 svc.issuer,
-                user_group=svc.user_group,
-                expected_email_domains=svc.expected_email_domains or [],
-                client=oidc_client,
+                scopes=svc.scopes or None,
+                client=_oidc_client(
+                    svc.client_id, svc.client_secret, svc.issuer
+                ),
             )
         if kind == "api_only":
             return OnlyApiUserManager()

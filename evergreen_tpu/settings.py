@@ -260,10 +260,19 @@ class AuthConfig(ConfigSection):
     okta_client_secret: str = ""
     okta_issuer: str = ""
     okta_user_group: str = ""
+    #: OIDC scopes requested on the authorize redirect (reference
+    #: OktaConfig.Scopes, config_auth.go:38-44); empty uses the
+    #: manager's openid/email/profile/groups default
+    okta_scopes: List[str] = dataclasses.field(default_factory=list)
     okta_expected_email_domains: List[str] = dataclasses.field(
         default_factory=list
     )
     external_validation_url: str = ""
+    #: when True the loader builds REAL IdP HTTP clients (GitHub token
+    #: exchange, OIDC code exchange + JWKS verification); off — the
+    #: in-image default — keeps the injectable fakes (same seam as
+    #: NotifyConfig.egress_enabled / events/transports.py)
+    egress_enabled: bool = False
 
     def validate_and_default(self) -> str:
         kinds = ("naive", "github", "okta", "api_only", "external")
@@ -610,21 +619,40 @@ class BucketsConfig(ConfigSection):
 @register_section
 @dataclasses.dataclass
 class OktaServiceConfig(ConfigSection):
-    """Service-level Okta/OIDC credentials (reference
-    config_okta_service.go). The user-manager loader
-    (api/auth.py load_user_manager) falls back to this section when the
-    auth section's okta fields are empty — one credential set can serve
-    both interactive login and service auth."""
+    """Machine-to-machine Okta/OIDC credentials (reference
+    config_okta_service.go:14-19: ClientID, ClientSecret, Scopes,
+    Audience, Issuer — used for token-exchange grants, e.g. the spawn
+    host workflow). The user-manager loader (api/auth.py
+    load_user_manager) falls back to this section when the auth
+    section's okta fields are empty — one credential set can serve both
+    interactive login and service auth. Unlike the auth section it
+    carries no user-group or email-domain fields: those gate
+    interactive logins only."""
 
     section_id = "okta_service"
 
     client_id: str = ""
     client_secret: str = ""
+    scopes: List[str] = dataclasses.field(default_factory=list)
+    audience: str = ""
     issuer: str = ""
-    user_group: str = ""
-    expected_email_domains: List[str] = dataclasses.field(
-        default_factory=list
-    )
+
+    def validate(self) -> str:
+        """Full-credential check for when the token-exchange flow runs
+        (reference config_okta_service.go Validate — deliberately NOT
+        part of validate_and_default, which accepts an empty section)."""
+        missing = [
+            name
+            for name, val in (
+                ("client_id", self.client_id),
+                ("client_secret", self.client_secret),
+                ("scopes", self.scopes),
+                ("audience", self.audience),
+                ("issuer", self.issuer),
+            )
+            if not val
+        ]
+        return ", ".join(f"{m} is required" for m in missing)
 
 
 @register_section

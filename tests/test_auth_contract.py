@@ -1,0 +1,529 @@
+"""IdP contract tests for the REAL OAuth/OIDC network legs.
+
+Reference: auth/github.go (GitHub web-application flow: code→token
+exchange against github.com/login/oauth/access_token, user + org lookups
+against api.github.com) and auth/okta.go via gimlet/okta (OIDC
+authorization-code flow: Basic-authed token exchange, RS256 ID-token
+verification against the issuer's JWKS, exp/iss/aud claim checks).
+
+These tests run the real stdlib HTTP clients (api/auth.py
+GithubOAuthClient / OidcClient) against local fake IdP servers and pin
+the FAILURE shapes a live IdP produces: bad/expired verification code,
+revoked access token (401), org-membership 403, expired ID token, wrong
+audience, tampered signature, group-claim mismatch, replayed state
+nonce. The in-repo fakes subclass these clients, so interface drift
+between fake and real legs breaks here first.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from evergreen_tpu.api.auth import (
+    AuthError,
+    FakeGithubOAuth,
+    FakeOidc,
+    GithubOAuthClient,
+    GithubUserManager,
+    OidcClient,
+    OktaUserManager,
+    _rsa_verify_pkcs1_sha256,
+)
+from evergreen_tpu.storage.store import Store
+
+# --------------------------------------------------------------------------- #
+# fixed RSA keypair for the fake issuer (2048-bit, generated offline once;
+# the private exponent lives only in this test file)
+# --------------------------------------------------------------------------- #
+
+RSA_N = int(
+    "0xbedd694a02524af967c56a45522e6fa463141f459af04204965010329b4b8e9bebea"
+    "06dc8e2168a881e1f81e9d44266729f4685383f6edcc6ddda2053ab48ce98fabdc9ae5"
+    "298365decb098d3b00902255015ec36ee7d6dc794ae1cbf22704c26df9aabd0d832e03"
+    "48808a511adf3f8aeb7ff8cf7464b16e82474b3802c80e8b2123f8d6ea40c26a57c4c6"
+    "c6f28a66514060b90196d44ff328b6c0e27212f9113171b3adfd0b05b5b1f4f8fbd7a4"
+    "ff83f05859b4ed75d49cd1e024dbb7bb3cbca52cc29c1368a7216bfda65d2560926c07"
+    "579b4136d00fd29717faccae2062295e09dee8ab6520758325fa748161a0faa6be12e8"
+    "a73fc137c7b1a847d3899e87",
+    16,
+)
+RSA_E = 65537
+RSA_D = int(
+    "0x4197c0d7ecdd5023cf2c529db924f93c22caa7069a3d284b00474a91c1b9e12c2792"
+    "b941f1dc7c65b0a1324e7f188d241610870bf0859b6a8e7544f98c17c17780e6fcbd04"
+    "b554115dd42417b3a7b960fb1aa9f0fafbd4e4d7104b71f5e9bfe27bbdfa15d77f7600"
+    "2dd9f2eef58fb47c2efbbf4bb841e49248566cfcb643ff6eea6ae4bab3c288df5fe644"
+    "c30d2651b91962a5fe20bdccb2e3d2c1a01d0a82fa92223d780c230616cd0e704f8f3c"
+    "321c4c29ad5ab4a2e3ea5e2024917669605ee138b4fcca3f5c65381df3ad7d41165468"
+    "a602c776a002f39c9d2a951c69bc8e52829b5d6ccff92103e890f689731c629e8b2b7f"
+    "6bab53856017d614f2b4a77d",
+    16,
+)
+KID = "test-key-1"
+
+_SHA256_DIGESTINFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420"
+)
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _rsa_sign(msg: bytes) -> bytes:
+    """RSASSA-PKCS1-v1_5 / SHA-256 signing with the test private key."""
+    k = (RSA_N.bit_length() + 7) // 8
+    digest = hashlib.sha256(msg).digest()
+    ps_len = k - 3 - len(_SHA256_DIGESTINFO) - len(digest)
+    em = b"\x00\x01" + b"\xff" * ps_len + b"\x00" + _SHA256_DIGESTINFO + digest
+    return pow(int.from_bytes(em, "big"), RSA_D, RSA_N).to_bytes(k, "big")
+
+
+def make_id_token(claims: dict, kid: str = KID, tamper: bool = False) -> str:
+    header = {"alg": "RS256", "kid": kid}
+    signing_input = (
+        f"{_b64url(json.dumps(header).encode())}"
+        f".{_b64url(json.dumps(claims).encode())}"
+    )
+    sig = _rsa_sign(signing_input.encode())
+    if tamper:
+        sig = bytes([sig[0] ^ 0x01]) + sig[1:]
+    return f"{signing_input}.{_b64url(sig)}"
+
+
+def test_rsa_roundtrip():
+    msg = b"the quick brown fox"
+    assert _rsa_verify_pkcs1_sha256(RSA_N, RSA_E, _rsa_sign(msg), msg)
+    assert not _rsa_verify_pkcs1_sha256(RSA_N, RSA_E, _rsa_sign(msg), msg + b"!")
+
+
+# --------------------------------------------------------------------------- #
+# local fake GitHub
+# --------------------------------------------------------------------------- #
+
+
+class _FakeGithubState:
+    def __init__(self) -> None:
+        self.codes = {"good-code": "gho_live_token"}
+        self.tokens = {
+            "gho_live_token": {
+                "login": "octocat",
+                "name": "Octo Cat",
+                "email": "octo@example.com",
+            }
+        }
+        self.org_members = {"evergreen-ci": {"octocat"}}
+        #: orgs whose membership endpoint answers 403 (bad token scope /
+        #: rate limited) instead of a yes/no
+        self.forbidden_orgs: set = set()
+
+
+def _serve(handler_cls):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+@pytest.fixture()
+def github_idp():
+    state = _FakeGithubState()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, status: int, payload=None):
+            body = json.dumps(payload or {}).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if self.path != "/login/oauth/access_token":
+                return self._json(404, {"message": "not found"})
+            length = int(self.headers.get("Content-Length", 0))
+            form = urllib.parse.parse_qs(self.rfile.read(length).decode())
+            code = form.get("code", [""])[0]
+            # GitHub answers 200 + error body for a bad/expired code
+            if code not in state.codes:
+                return self._json(200, {"error": "bad_verification_code"})
+            return self._json(
+                200,
+                {"access_token": state.codes[code], "token_type": "bearer"},
+            )
+
+        def do_GET(self):
+            auth = self.headers.get("Authorization", "")
+            token = auth.split(" ", 1)[1] if " " in auth else ""
+            if self.path == "/user":
+                info = state.tokens.get(token)
+                if info is None:  # revoked/expired token
+                    return self._json(401, {"message": "Bad credentials"})
+                return self._json(200, info)
+            parts = self.path.strip("/").split("/")
+            if len(parts) == 4 and parts[0] == "orgs" and parts[2] == "members":
+                org, login = parts[1], parts[3]
+                if org in state.forbidden_orgs:
+                    return self._json(
+                        403, {"message": "Must have admin rights"}
+                    )
+                if login in state.org_members.get(org, set()):
+                    self.send_response(204)
+                    self.end_headers()
+                    return None
+                return self._json(404, {"message": "Not Found"})
+            return self._json(404, {"message": "not found"})
+
+    srv, base = _serve(Handler)
+    yield state, base
+    srv.shutdown()
+    srv.server_close()
+
+
+def _github_client(base: str) -> GithubOAuthClient:
+    return GithubOAuthClient(
+        "cid", "csecret", oauth_base=f"{base}/login/oauth", api_base=base
+    )
+
+
+def _github_manager(base: str, **kw) -> GithubUserManager:
+    kw.setdefault("organization", "evergreen-ci")
+    return GithubUserManager(
+        "cid", "csecret", kw.pop("organization"),
+        users=kw.pop("users", []), client=_github_client(base),
+    )
+
+
+class TestGithubContract:
+    def test_full_login_flow(self, github_idp):
+        state, base = github_idp
+        store = Store()
+        mgr = _github_manager(base)
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        token = mgr.login_callback(
+            store, {"state": q["state"][0], "code": "good-code"}
+        )
+        user = mgr.get_user_by_token(store, token)
+        assert user is not None and user.id == "octocat"
+        assert user.email == "octo@example.com"
+
+    def test_bad_verification_code(self, github_idp):
+        state, base = github_idp
+        client = _github_client(base)
+        assert client.exchange_code("expired-or-wrong") is None
+        store = Store()
+        mgr = _github_manager(base)
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        with pytest.raises(AuthError, match="could not exchange"):
+            mgr.login_callback(
+                store, {"state": q["state"][0], "code": "expired-or-wrong"}
+            )
+
+    def test_revoked_access_token(self, github_idp):
+        state, base = github_idp
+        client = _github_client(base)
+        token = client.exchange_code("good-code")
+        assert token == "gho_live_token"
+        del state.tokens[token]  # revoke server-side
+        assert client.get_user(token) is None
+
+    def test_membership_yes_and_no(self, github_idp):
+        state, base = github_idp
+        client = _github_client(base)
+        assert client.user_in_organization("t", "octocat", "evergreen-ci")
+        assert not client.user_in_organization("t", "stranger", "evergreen-ci")
+
+    def test_org_403_is_an_error_not_a_no(self, github_idp):
+        state, base = github_idp
+        state.forbidden_orgs.add("evergreen-ci")
+        client = _github_client(base)
+        with pytest.raises(AuthError, match="HTTP 403"):
+            client.user_in_organization("t", "octocat", "evergreen-ci")
+
+    def test_non_member_rejected_unless_allowlisted(self, github_idp):
+        state, base = github_idp
+        state.codes["other-code"] = "gho_other"
+        state.tokens["gho_other"] = {"login": "stranger", "name": "S",
+                                     "email": ""}
+        store = Store()
+        mgr = _github_manager(base)
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        with pytest.raises(AuthError, match="not in the allowed"):
+            mgr.login_callback(
+                store, {"state": q["state"][0], "code": "other-code"}
+            )
+        # same user, explicit allow-list: admitted without org membership
+        mgr2 = _github_manager(base, users=["stranger"])
+        redirect = mgr2.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        assert mgr2.login_callback(
+            store, {"state": q["state"][0], "code": "other-code"}
+        )
+
+    def test_replayed_state_nonce(self, github_idp):
+        state, base = github_idp
+        store = Store()
+        mgr = _github_manager(base)
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        params = {"state": q["state"][0], "code": "good-code"}
+        mgr.login_callback(store, params)
+        with pytest.raises(AuthError, match="state"):
+            mgr.login_callback(store, params)  # replay
+
+    def test_unreachable_idp(self):
+        client = _github_client("http://127.0.0.1:1")  # nothing listens
+        with pytest.raises(AuthError, match="unreachable"):
+            client.exchange_code("any")
+
+    def test_fake_subclasses_real(self):
+        assert isinstance(FakeGithubOAuth(), GithubOAuthClient)
+
+
+# --------------------------------------------------------------------------- #
+# local fake Okta/OIDC issuer
+# --------------------------------------------------------------------------- #
+
+
+class _FakeOktaState:
+    def __init__(self, issuer: str = "") -> None:
+        self.issuer = issuer
+        self.codes: dict = {}
+        #: answers for /v1/keys; tests can blank it to simulate JWKS loss
+        self.jwks = {
+            "keys": [
+                {
+                    "kty": "RSA",
+                    "kid": KID,
+                    "use": "sig",
+                    "n": _b64url(
+                        RSA_N.to_bytes((RSA_N.bit_length() + 7) // 8, "big")
+                    ),
+                    "e": _b64url(b"\x01\x00\x01"),
+                }
+            ]
+        }
+
+    def add_code(self, code: str, claims: dict, **token_kw) -> None:
+        now = time.time()
+        full = {
+            "iss": self.issuer,
+            "aud": "oidc-cid",
+            "exp": now + 3600,
+            "iat": now,
+            **claims,
+        }
+        self.codes[code] = {
+            "id_token": make_id_token(full, **token_kw),
+            "token_type": "Bearer",
+        }
+
+
+@pytest.fixture()
+def okta_idp():
+    state = _FakeOktaState()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, status: int, payload=None):
+            body = json.dumps(payload or {}).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v1/keys":
+                return self._json(200, state.jwks)
+            return self._json(404, {})
+
+        def do_POST(self):
+            if self.path != "/v1/token":
+                return self._json(404, {})
+            auth = self.headers.get("Authorization", "")
+            if not auth.startswith("Basic "):
+                return self._json(
+                    401, {"error": "invalid_client"}
+                )
+            cid = base64.b64decode(auth[6:]).decode().split(":", 1)[0]
+            if cid != "oidc-cid":
+                return self._json(401, {"error": "invalid_client"})
+            length = int(self.headers.get("Content-Length", 0))
+            form = urllib.parse.parse_qs(self.rfile.read(length).decode())
+            code = form.get("code", [""])[0]
+            if code not in state.codes:
+                return self._json(400, {"error": "invalid_grant"})
+            return self._json(200, state.codes[code])
+
+    srv, base = _serve(Handler)
+    state.issuer = base
+    yield state, base
+    srv.shutdown()
+    srv.server_close()
+
+
+def _oidc_client(base: str) -> OidcClient:
+    return OidcClient("oidc-cid", "oidc-secret", base)
+
+
+class TestOidcContract:
+    def test_full_login_flow_with_group_gate(self, okta_idp):
+        state, base = okta_idp
+        state.add_code(
+            "good",
+            {"email": "dev@example.com", "name": "Dev",
+             "groups": ["engineers"]},
+        )
+        store = Store()
+        mgr = OktaUserManager(
+            "oidc-cid", "oidc-secret", base, user_group="engineers",
+            expected_email_domains=["example.com"],
+            client=_oidc_client(base),
+        )
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        token = mgr.login_callback(
+            store, {"state": q["state"][0], "code": "good"}
+        )
+        user = mgr.get_user_by_token(store, token)
+        assert user is not None and user.id == "dev"
+        assert user.email == "dev@example.com"
+
+    def test_rejected_code(self, okta_idp):
+        state, base = okta_idp
+        assert _oidc_client(base).exchange_code("nope") is None
+
+    def test_wrong_client_secret_is_rejected(self, okta_idp):
+        state, base = okta_idp
+        state.add_code("good", {"email": "dev@example.com"})
+        bad = OidcClient("wrong-cid", "oidc-secret", base)
+        assert bad.exchange_code("good") is None
+
+    def test_expired_id_token(self, okta_idp):
+        state, base = okta_idp
+        state.add_code("stale", {"email": "dev@example.com"})
+        claims = json.loads(
+            base64.urlsafe_b64decode(
+                state.codes["stale"]["id_token"].split(".")[1] + "=="
+            )
+        )
+        claims["exp"] = time.time() - 60
+        state.codes["stale"]["id_token"] = make_id_token(claims)
+        with pytest.raises(AuthError, match="expired"):
+            _oidc_client(base).exchange_code("stale")
+
+    def test_wrong_audience(self, okta_idp):
+        state, base = okta_idp
+        state.add_code(
+            "aud", {"email": "dev@example.com", "aud": "someone-else"}
+        )
+        with pytest.raises(AuthError, match="audience"):
+            _oidc_client(base).exchange_code("aud")
+
+    def test_wrong_issuer(self, okta_idp):
+        state, base = okta_idp
+        state.add_code(
+            "iss", {"email": "dev@example.com", "iss": "https://evil.example"}
+        )
+        with pytest.raises(AuthError, match="issuer"):
+            _oidc_client(base).exchange_code("iss")
+
+    def test_tampered_signature(self, okta_idp):
+        state, base = okta_idp
+        state.add_code("sig", {"email": "dev@example.com"}, tamper=True)
+        with pytest.raises(AuthError, match="signature"):
+            _oidc_client(base).exchange_code("sig")
+
+    def test_unknown_kid(self, okta_idp):
+        state, base = okta_idp
+        state.add_code("kid", {"email": "dev@example.com"}, kid="other-key")
+        with pytest.raises(AuthError, match="no JWKS key"):
+            _oidc_client(base).exchange_code("kid")
+
+    def test_group_claim_mismatch(self, okta_idp):
+        state, base = okta_idp
+        state.add_code(
+            "nogroup",
+            {"email": "dev@example.com", "groups": ["interns"]},
+        )
+        store = Store()
+        mgr = OktaUserManager(
+            "oidc-cid", "oidc-secret", base, user_group="engineers",
+            client=_oidc_client(base),
+        )
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        with pytest.raises(AuthError, match="not in required group"):
+            mgr.login_callback(
+                store, {"state": q["state"][0], "code": "nogroup"}
+            )
+
+    def test_bad_state_param(self, okta_idp):
+        state, base = okta_idp
+        state.add_code("good", {"email": "dev@example.com"})
+        store = Store()
+        mgr = OktaUserManager(
+            "oidc-cid", "oidc-secret", base, client=_oidc_client(base)
+        )
+        with pytest.raises(AuthError, match="state"):
+            mgr.login_callback(
+                store, {"state": "forged-or-expired", "code": "good"}
+            )
+
+    def test_fake_subclasses_real(self):
+        assert isinstance(FakeOidc(), OidcClient)
+
+
+# --------------------------------------------------------------------------- #
+# loader egress gating
+# --------------------------------------------------------------------------- #
+
+
+def test_loader_builds_real_clients_only_behind_egress_flag():
+    from evergreen_tpu.api.auth import load_user_manager
+    from evergreen_tpu.settings import AuthConfig
+
+    store = Store()
+    cfg = AuthConfig.get_base(store)
+    cfg.preferred_type = "github"
+    cfg.github_client_id = "cid"
+    cfg.github_client_secret = "sec"
+    cfg.github_organization = "evergreen-ci"
+    cfg.set(store)
+
+    mgr = load_user_manager(store)
+    assert isinstance(mgr.client, FakeGithubOAuth)  # zero-egress default
+
+    cfg.egress_enabled = True
+    cfg.set(store)
+    mgr = load_user_manager(store)
+    assert type(mgr.client) is GithubOAuthClient  # the real network leg
+    assert mgr.client.oauth_base == "https://github.com/login/oauth"
+
+    cfg.preferred_type = "okta"
+    cfg.okta_client_id = "ocid"
+    cfg.okta_client_secret = "osec"
+    cfg.okta_issuer = "https://okta.example.com"
+    cfg.set(store)
+    mgr = load_user_manager(store)
+    assert type(mgr.client) is OidcClient
+    assert mgr.client.issuer == "https://okta.example.com"
+
+    cfg.egress_enabled = False
+    cfg.set(store)
+    mgr = load_user_manager(store)
+    assert isinstance(mgr.client, FakeOidc)

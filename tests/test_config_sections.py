@@ -163,13 +163,22 @@ def test_okta_service_section_feeds_user_manager(store):
     svc.client_id = "svc-id"
     svc.client_secret = "svc-secret"
     svc.issuer = "https://okta.example.com"
-    svc.user_group = "engineers"
+    svc.scopes = ["openid", "email"]
+    svc.audience = "api://evergreen"
     svc.set(store)
 
     mgr = load_user_manager(store)
     assert isinstance(mgr, OktaUserManager)
     assert mgr.client_id == "svc-id"
-    assert mgr.user_group == "engineers"
+    assert mgr.scopes == ["openid", "email"]
+    # the M2M section carries no user-group gate (reference
+    # config_okta_service.go:14-19) — interactive group gating comes
+    # only from the auth section
+    assert mgr.user_group == ""
+    # full-credential validation is a separate check from section load
+    assert svc.validate() == ""
+    svc.audience = ""
+    assert "audience is required" in svc.validate()
     # explicit auth-section credentials still win over the service ones
     auth.okta_client_id = "auth-id"
     auth.okta_client_secret = "auth-secret"

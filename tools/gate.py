@@ -15,6 +15,34 @@ import subprocess
 import sys
 
 
+def _log_run(rc: int, args: list) -> None:
+    """Append the gate outcome to GATE_LOG.jsonl at the repo root so
+    every run (and therefore every skip) is visible in history
+    (VERDICT r4 ask #10)."""
+    import json
+    import time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        with open(os.path.join(root, "GATE_LOG.jsonl"), "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "t": round(time.time(), 1),
+                        "rc": rc,
+                        "args": args,
+                        "head": subprocess.run(
+                            ["git", "rev-parse", "--short", "HEAD"],
+                            capture_output=True, text=True, cwd=root,
+                        ).stdout.strip(),
+                    }
+                )
+                + "\n"
+            )
+    except OSError:
+        pass
+
+
 def main() -> int:
     # Scrub overrides that could mask a stock-image failure.
     env = dict(os.environ)
@@ -24,6 +52,7 @@ def main() -> int:
     cmd = [sys.executable, "-m", "pytest", "-q", *args]
     print("gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
+    _log_run(rc, args)
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)
     else:
