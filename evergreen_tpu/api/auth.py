@@ -194,6 +194,36 @@ class NaiveUserManager(UserManager):
 # --------------------------------------------------------------------------- #
 
 
+def _http_json(
+    method: str,
+    url: str,
+    body: Optional[bytes],
+    headers: Optional[Dict[str, str]],
+    timeout_s: float,
+    err_prefix: str,
+):
+    """Shared IdP HTTP leg → (status, parsed-json-or-None). 4xx statuses
+    are returned to the caller (they are protocol outcomes: bad code,
+    revoked token, not-a-member); transport failures raise AuthError."""
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            raw = resp.read()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        status = e.code
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise AuthError(f"{err_prefix} unreachable: {e}") from e
+    try:
+        parsed = json.loads(raw) if raw else None
+    except ValueError:
+        parsed = None
+    return status, parsed
+
+
 class GithubOAuthClient:
     """Network leg of the GitHub OAuth web flow (reference auth/github.go
     GetLoginCallbackHandler token exchange + thirdparty/github.go:38
@@ -219,8 +249,6 @@ class GithubOAuthClient:
         self.api_base = (api_base or self.API_BASE).rstrip("/")
         self.timeout_s = timeout_s
 
-    # -- HTTP plumbing ---------------------------------------------------- #
-
     def _request(
         self,
         method: str,
@@ -228,26 +256,9 @@ class GithubOAuthClient:
         body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
     ):
-        """→ (status, parsed-json-or-None). 4xx statuses are returned to
-        the caller (they are protocol outcomes: bad code, revoked token,
-        not-a-member); transport failures raise AuthError."""
-        req = urllib.request.Request(
-            url, data=body, method=method, headers=headers or {}
+        return _http_json(
+            method, url, body, headers, self.timeout_s, "github api"
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                raw = resp.read()
-                status = resp.status
-        except urllib.error.HTTPError as e:
-            raw = e.read()
-            status = e.code
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            raise AuthError(f"github api unreachable: {e}") from e
-        try:
-            parsed = json.loads(raw) if raw else None
-        except ValueError:
-            parsed = None
-        return status, parsed
 
     # -- the three legs --------------------------------------------------- #
 
@@ -467,8 +478,6 @@ class OidcClient:
         # JWKS cache: kid → (n, e); refreshed once per unknown kid
         self._jwks: Dict[str, Tuple[int, int]] = {}
 
-    # -- HTTP plumbing ---------------------------------------------------- #
-
     def _request(
         self,
         method: str,
@@ -476,23 +485,9 @@ class OidcClient:
         body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
     ):
-        req = urllib.request.Request(
-            url, data=body, method=method, headers=headers or {}
+        return _http_json(
+            method, url, body, headers, self.timeout_s, "oidc issuer"
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                raw = resp.read()
-                status = resp.status
-        except urllib.error.HTTPError as e:
-            raw = e.read()
-            status = e.code
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            raise AuthError(f"oidc issuer unreachable: {e}") from e
-        try:
-            parsed = json.loads(raw) if raw else None
-        except ValueError:
-            parsed = None
-        return status, parsed
 
     def _fetch_jwks(self) -> None:
         status, parsed = self._request("GET", f"{self.issuer}/v1/keys")
@@ -586,10 +581,14 @@ class OidcClient:
             "name": claims.get("name", "") or claims.get("email", ""),
             "groups": list(claims.get("groups", []) or []),
         }
-        # Okta omits email/groups from the ID token when the scopes
-        # don't request them — fall back to the userinfo endpoint
-        # (reference gimlet/okta getUserInfo)
-        if not out["email"] and parsed.get("access_token"):
+        # Okta omits email/groups from the ID token when the auth server
+        # isn't configured to embed them — fall back to the userinfo
+        # endpoint when EITHER is missing (reference gimlet/okta
+        # getUserInfo); a groups-gated manager would otherwise reject
+        # every valid login
+        if (not out["email"] or not out["groups"]) and parsed.get(
+            "access_token"
+        ):
             status, info = self._request(
                 "GET",
                 f"{self.issuer}/v1/userinfo",
@@ -597,7 +596,9 @@ class OidcClient:
                 {"Authorization": f"Bearer {parsed['access_token']}"},
             )
             if status == 200 and isinstance(info, dict):
-                out["email"] = info.get("email", "")
+                # preserve-existing on every field: the ID token's claims
+                # are signature-verified, userinfo only FILLS gaps
+                out["email"] = out["email"] or info.get("email", "")
                 out["name"] = out["name"] or info.get("name", "")
                 out["groups"] = out["groups"] or list(
                     info.get("groups", []) or []
@@ -666,6 +667,11 @@ class OktaUserManager(UserManager):
 
     def login_redirect(self, store: Store, callback_url: str) -> str:
         state = _issue_state(store)
+        # RFC 6749 §4.1.3: the token request's redirect_uri must match
+        # the authorize request's — keep the client in sync so the real
+        # exchange leg sends the same value (an empty redirect_uri is an
+        # invalid_grant at every real issuer)
+        self.client.callback_url = callback_url
         q = urllib.parse.urlencode(
             {
                 "client_id": self.client_id,
@@ -854,13 +860,20 @@ def load_user_manager(
             # the okta_service section is M2M credentials only
             # (reference config_okta_service.go:14-19: client id/secret,
             # scopes, audience, issuer — no user group or email-domain
-            # fields); interactive group gating comes solely from the
-            # auth section
+            # fields). Interactive gating still comes from the AUTH
+            # section even when credentials come from here: a deployment
+            # sharing one credential set must not silently lose its
+            # configured group gate.
             svc = OktaServiceConfig.get(store)
             return OktaUserManager(
                 svc.client_id,
                 svc.client_secret,
                 svc.issuer,
+                user_group=getattr(cfg, "okta_user_group", ""),
+                expected_email_domains=getattr(
+                    cfg, "okta_expected_email_domains", []
+                )
+                or [],
                 scopes=svc.scopes or None,
                 client=_oidc_client(
                     svc.client_id, svc.client_secret, svc.issuer

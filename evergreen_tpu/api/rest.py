@@ -68,6 +68,9 @@ _ADMIN_PATHS = re.compile(r"^/rest/v2/(admin/|distros/[^/]+$|projects/[^/]+$)")
 #: login surface: reachable without credentials (it is how you get them);
 #: still behind the pre-auth peer rate limit
 _LOGIN_PATHS = re.compile(r"^/(login(/redirect|/callback)?|logout)$")
+#: inbound webhook intake: credentialed by its own secret (path token /
+#: payload signature), not user keys — AWS SNS cannot send API headers
+_HOOK_PATHS = re.compile(r"^/hooks/aws(/|$)")
 
 
 _GQL_COMMENT = re.compile(r"#[^\n]*")
@@ -233,7 +236,9 @@ class RestApi:
         denied = None
         if self.require_auth and _AGENT_PATHS.match(path):
             denied = self._authorize_agent(path, headers)
-        elif self.require_auth and not _LOGIN_PATHS.match(path):
+        elif self.require_auth and not (
+            _LOGIN_PATHS.match(path) or _HOOK_PATHS.match(path)
+        ):
             from ..models import user as user_mod
 
             u = user_mod.user_by_api_key(self.store, headers.get("api-key", ""))
@@ -734,6 +739,36 @@ class RestApi:
         r("GET", r"/rest/v2/stats/spans", self.list_spans)
         r("GET", r"/rest/v2/stats/hosts", self.host_stats)
         r("GET", r"/rest/v2/stats/system", self.system_stats)
+
+        # task reliability (reference rest/route/reliability.go)
+        r(
+            "GET",
+            r"/rest/v2/projects/(?P<project>[^/]+)/task_reliability",
+            self.task_reliability,
+        )
+        # permissions (reference rest/route/permissions.go)
+        r("GET", r"/rest/v2/permissions", self.permissions_catalog)
+        r("GET", r"/rest/v2/permissions/users", self.all_users_permissions)
+        r("GET", r"/rest/v2/users/(?P<user>[^/]+)/permissions",
+          self.get_user_permissions)
+        r("POST", r"/rest/v2/users/(?P<user>[^/]+)/permissions",
+          self.post_user_permissions)
+        r("DELETE", r"/rest/v2/users/(?P<user>[^/]+)/permissions",
+          self.delete_user_permissions)
+        # project copy + settings audit (reference project_copy.go,
+        # project_events.go)
+        r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/copy",
+          self.copy_project)
+        r("POST", r"/rest/v2/projects/(?P<project>[^/]+)/copy/variables",
+          self.copy_project_vars)
+        r("GET", r"/rest/v2/projects/(?P<project>[^/]+)/events",
+          self.project_events)
+        # direct notifications (reference rest/route/notification.go)
+        r("POST", r"/rest/v2/notifications/slack", self.notify_slack)
+        r("POST", r"/rest/v2/notifications/email", self.notify_email)
+        # SNS instance-state intake (reference rest/route/sns.go)
+        r("POST", r"/hooks/aws/(?P<token>[^/]+)", self.sns_hook)
+        r("POST", r"/hooks/aws", self.sns_hook_no_token)
 
     # -- agent protocol ------------------------------------------------- #
 
@@ -1911,6 +1946,412 @@ class RestApi:
         stats = self.store.collection("host_stats").find()
         stats.sort(key=lambda d: d["at"])
         return 200, stats[-500:]
+
+    # -- task reliability (reference rest/route/reliability.go) --------- #
+
+    def task_reliability(self, method, match, body):
+        """GET /projects/{id}/task_reliability — Wilson-scored success
+        rates over finished executions (reference reliability.go +
+        model/reliability/query.go)."""
+        from ..models import reliability as rel_mod
+
+        def _csv(key):
+            v = body.get(key, "")
+            if isinstance(v, list):
+                return [str(x) for x in v]
+            return [s for s in str(v).split(",") if s]
+
+        now = _time.time()
+        f = rel_mod.ReliabilityFilter(
+            project=match["project"],
+            tasks=_csv("tasks"),
+            after_date=float(body.get("after_date") or (now - 28 * 86400)),
+            before_date=float(body.get("before_date") or now),
+            group_by=body.get("group_by") or rel_mod.GROUP_BY_TASK,
+            group_num_days=int(body.get("group_num_days", 1) or 1),
+            requesters=_csv("requesters") or None,
+            variants=_csv("variants") or None,
+            distros=_csv("distros") or None,
+            significance=float(body.get("significance", 0.05) or 0.05),
+            sort=body.get("sort") or rel_mod.SORT_LATEST,
+            limit=int(body.get("limit", rel_mod.MAX_LIMIT) or rel_mod.MAX_LIMIT),
+        )
+        try:
+            scores = rel_mod.get_task_reliability_scores(self.store, f)
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return 200, [s.to_doc() for s in scores]
+
+    # -- permissions (reference rest/route/permissions.go) -------------- #
+
+    #: the permission catalog the UI renders pickers from (reference
+    #: permissionsGetHandler.getAllPermissions — project + distro
+    #: permission keys mapped onto this repo's scope model)
+    _PERMISSION_CATALOG = {
+        "projectPermissions": [
+            {"key": "project_settings",
+             "name": "Project Settings",
+             "levels": ["admin", "view", "none"]},
+            {"key": "project_tasks",
+             "name": "Tasks (restart/abort/set priority)",
+             "levels": ["admin", "view", "none"]},
+            {"key": "project_patches",
+             "name": "Patches",
+             "levels": ["admin", "none"]},
+            {"key": "project_logs",
+             "name": "Logs",
+             "levels": ["view", "none"]},
+        ],
+        "distroPermissions": [
+            {"key": "distro_settings",
+             "name": "Distro Settings",
+             "levels": ["admin", "edit", "view", "none"]},
+            {"key": "distro_hosts",
+             "name": "Spawn Hosts",
+             "levels": ["edit", "view", "none"]},
+        ],
+    }
+
+    def _require_superuser(self) -> None:
+        """Role-editing gate (reference editRoles middleware). Only
+        enforced when an authenticated identity exists (dev mode has no
+        verified identity to check)."""
+        ident = getattr(self._ident, "user", "")
+        if ident and not getattr(self._ident, "superuser", False):
+            raise ApiError(403, "superuser scope required")
+
+    def permissions_catalog(self, method, match, body):
+        return 200, self._PERMISSION_CATALOG
+
+    def all_users_permissions(self, method, match, body):
+        """GET /permissions/users → {user: [roles]} for every user that
+        holds any role (reference makeGetAllUsersPermissions)."""
+        self._require_superuser()
+        from ..models import user as user_mod
+
+        return 200, {
+            d["_id"]: d.get("roles", [])
+            for d in user_mod.coll(self.store).find(
+                lambda d: d.get("roles")
+            )
+        }
+
+    def get_user_permissions(self, method, match, body):
+        from ..models import user as user_mod
+
+        u = user_mod.get_user(self.store, match["user"])
+        if u is None:
+            raise ApiError(404, f"no user {match['user']!r}")
+        return 200, {"user_id": u.id, "roles": list(u.roles)}
+
+    def post_user_permissions(self, method, match, body):
+        """POST /users/{id}/permissions {"role": ...} — grant (reference
+        makeModifyUserPermissions)."""
+        self._require_superuser()
+        from ..models import user as user_mod
+
+        role = body.get("role", "")
+        if not role:
+            raise ApiError(400, "missing role")
+        if not user_mod.grant_role(self.store, match["user"], role):
+            raise ApiError(404, f"no user {match['user']!r}")
+        u = user_mod.get_user(self.store, match["user"])
+        return 200, {"user_id": u.id, "roles": list(u.roles)}
+
+    def delete_user_permissions(self, method, match, body):
+        """DELETE /users/{id}/permissions — revoke one role when given,
+        else all (reference makeDeleteUserPermissions strips all)."""
+        self._require_superuser()
+        from ..models import user as user_mod
+
+        role = body.get("role", "")
+        ok = (
+            user_mod.revoke_role(self.store, match["user"], role)
+            if role
+            else user_mod.revoke_all_roles(self.store, match["user"])
+        )
+        if not ok:
+            raise ApiError(404, f"no user {match['user']!r}")
+        return 200, {"ok": True}
+
+    # -- project copy + vars (reference rest/route/project_copy.go) ----- #
+
+    def _require_project_admin(self, project_id: str) -> None:
+        """reference requireProjectAdmin middleware: superuser or the
+        per-project admin scope."""
+        ident = getattr(self._ident, "user", "")
+        if not ident or getattr(self._ident, "superuser", False):
+            return
+        from ..models import user as user_mod
+
+        u = user_mod.get_user(self.store, ident)
+        if u is not None and u.has_scope(f"project:{project_id}"):
+            return
+        raise ApiError(
+            403, f"project admin scope required for {project_id!r}"
+        )
+
+    def copy_project(self, method, match, body):
+        """POST /projects/{id}/copy {"new_project": ...}: duplicate the
+        project ref (disabled until reviewed, like the reference) and its
+        non-private variables (reference project_copy.go
+        makeCopyProject → data.CopyProject)."""
+        import dataclasses as _dc
+
+        from ..models import project_vars as pvars_mod
+
+        self._require_project_admin(match["project"])
+        new_id = body.get("new_project", "")
+        if not new_id:
+            raise ApiError(400, "missing new_project")
+        src = repotracker_mod.get_project_ref(self.store, match["project"])
+        if src is None:
+            raise ApiError(404, f"no project {match['project']!r}")
+        if repotracker_mod.get_project_ref(self.store, new_id) is not None:
+            raise ApiError(400, f"project {new_id!r} already exists")
+        dup = _dc.replace(src, id=new_id)
+        # the copy starts disabled so it cannot ingest/schedule until a
+        # human reviews it (reference data.CopyProject sets Enabled=false)
+        dup.enabled = False
+        repotracker_mod.upsert_project_ref(self.store, dup)
+        pvars_mod.copy_vars(
+            self.store, match["project"], new_id, include_private=False
+        )
+        event_mod.log(
+            self.store, event_mod.RESOURCE_PROJECT, "PROJECT_COPIED",
+            new_id, {"copied_from": match["project"],
+                     "user": getattr(self._ident, "user", "")},
+        )
+        return 200, dup.to_doc()
+
+    def copy_project_vars(self, method, match, body):
+        """POST /projects/{id}/copy/variables (reference
+        copyVariablesHandler: copy_to required; dry_run previews with
+        private values redacted; include_private; overwrite)."""
+        from ..models import project_vars as pvars_mod
+
+        copy_to = body.get("copy_to", "")
+        if not copy_to:
+            raise ApiError(400, "missing copy_to")
+        # BOTH sides need the admin scope (reference: requireProjectAdmin
+        # wraps the URL/source project, and Run re-checks settings-edit on
+        # the destination) — source-side auth is what keeps a destination
+        # admin from exfiltrating another project's private values
+        self._require_project_admin(match["project"])
+        self._require_project_admin(copy_to)
+        if repotracker_mod.get_project_ref(self.store, copy_to) is None:
+            raise ApiError(404, f"no project {copy_to!r}")
+        dry_run = bool(body.get("dry_run"))
+        copied = pvars_mod.copy_vars(
+            self.store,
+            match["project"],
+            copy_to,
+            dry_run=dry_run,
+            include_private=bool(body.get("include_private")),
+            overwrite=bool(body.get("overwrite")),
+        )
+        if not dry_run:
+            event_mod.log(
+                self.store, event_mod.RESOURCE_PROJECT,
+                "PROJECT_VARS_COPIED", copy_to,
+                {"copied_from": match["project"],
+                 "keys": sorted(copied),
+                 "user": getattr(self._ident, "user", "")},
+            )
+        return 200, {"vars": copied, "dry_run": dry_run}
+
+    def project_events(self, method, match, body):
+        """GET /projects/{id}/events — settings-change audit trail with
+        keyed pagination (reference project_events.go projectEventsGet:
+        newest-first, ?ts= continues before that timestamp). The cursor
+        is (timestamp, id), not timestamp alone — events sharing one
+        time.time() tick at a page boundary must not vanish."""
+        limit = int(body.get("limit", 10) or 10)
+        before_ts = float(body.get("ts") or _time.time() + 1)
+        before_id = body.get("id", "")
+
+        def seq(event_id: str) -> int:
+            # ids are "evt-{n}" with a monotonically increasing n; the
+            # tiebreak must be NUMERIC ("evt-9" vs "evt-10" would invert
+            # lexicographically)
+            try:
+                return int(event_id.rsplit("-", 1)[-1])
+            except ValueError:
+                return 0
+
+        before_key = (before_ts, seq(before_id)) if before_id else None
+        evs = [
+            e
+            for e in event_mod.find_by_resource(
+                self.store, match["project"]
+            )
+            if e.resource_type == event_mod.RESOURCE_PROJECT
+            and (
+                (e.timestamp, seq(e.id)) < before_key
+                if before_key is not None
+                else e.timestamp < before_ts
+            )
+        ]
+        evs.sort(key=lambda e: (e.timestamp, seq(e.id)), reverse=True)
+        page = evs[:limit]
+        import dataclasses as _dc
+
+        out = {"events": [_dc.asdict(e) for e in page]}
+        if len(evs) > limit:
+            out["next_ts"] = page[-1].timestamp
+            out["next_id"] = page[-1].id
+        return 200, out
+
+    # -- direct notifications (reference rest/route/notification.go) ---- #
+
+    def _notify_direct(self, channel: str, doc: dict):
+        """Slack/email POST bodies become outbox rows the drain job
+        delivers exactly like subscription-driven notifications
+        (reference notification.go sends through the env's senders)."""
+        from ..events.senders import OUTBOX, insert_outbox_row
+
+        insert_outbox_row(
+            self.store, OUTBOX[channel], {"channel_type": channel, **doc}
+        )
+        return 200, {"ok": True}
+
+    def notify_slack(self, method, match, body):
+        target = body.get("target", "")
+        if not target:
+            raise ApiError(400, "missing target")
+        return self._notify_direct(
+            "slack",
+            {"slack_channel": target, "text": body.get("msg", "")},
+        )
+
+    def notify_email(self, method, match, body):
+        recipients = body.get("recipients") or []
+        if isinstance(recipients, str):
+            recipients = [r for r in recipients.split(",") if r]
+        if not recipients:
+            raise ApiError(400, "missing recipients")
+        return self._notify_direct(
+            "email",
+            {
+                "to": ",".join(recipients),
+                "subject": body.get("subject", ""),
+                "body": body.get("body", ""),
+            },
+        )
+
+    # -- SNS intake (reference rest/route/sns.go) ----------------------- #
+
+    def sns_hook_no_token(self, method, match, body):
+        """Token-less /hooks/aws: only acceptable when no secret is
+        configured AND auth is off (dev mode); production fails closed."""
+        return self.sns_hook(method, _FakeMatch({"token": ""}), body)
+
+    def sns_hook(self, method, match, body):
+        """POST /hooks/aws/{token} — EC2 EventBridge notifications via
+        SNS (reference sns.go ec2SNS). The path token stands in for the
+        reference's signed-payload verification (requireValidSNSPayload
+        fetches the SNS signing cert, which a zero-egress deployment
+        cannot); AWS keeps the full subscribe URL secret. Instance
+        state-changes drive the same host transitions as the reference:
+        terminated/stopped → externally-terminated reconciliation +
+        stranded-task cleanup; running → agent-start bookkeeping."""
+        from ..settings import ApiConfig
+
+        secret = ApiConfig.get(self.store).sns_secret
+        if self.require_auth and not secret:
+            return 401, {"error": "sns secret not configured"}
+        if secret and not _hmac_compare(secret, match["token"] or ""):
+            return 401, {"error": "invalid sns token"}
+
+        msg_type = body.get("Type", "")
+        if msg_type == "SubscriptionConfirmation":
+            # the reference GETs the SubscribeURL; zero-egress logs it for
+            # the operator to confirm out-of-band
+            event_mod.log(
+                self.store, event_mod.RESOURCE_ADMIN,
+                "SNS_SUBSCRIPTION_REQUESTED", "sns",
+                {"subscribe_url": body.get("SubscribeURL", "")},
+            )
+            return 200, {"ok": True}
+        if msg_type == "UnsubscribeConfirmation":
+            return 200, {"ok": True}
+        if msg_type != "Notification":
+            raise ApiError(400, f"unknown SNS message type {msg_type!r}")
+
+        try:
+            notification = json.loads(body.get("Message", "") or "{}")
+        except ValueError:
+            raise ApiError(400, "unparseable SNS message body")
+        detail_type = notification.get("detail-type", "")
+        if detail_type != "EC2 Instance State-change Notification":
+            raise ApiError(400, f"unknown detail type {detail_type!r}")
+        instance_id = (notification.get("detail") or {}).get(
+            "instance-id", ""
+        )
+        # an empty instance id must never reach the lookup: hosts not
+        # created by a cloud provider carry the default external_id=""
+        # and would match — a malformed event could terminate a healthy
+        # host
+        if not instance_id:
+            raise ApiError(400, "notification is missing instance-id")
+        state = (notification.get("detail") or {}).get("state", "")
+        h = next(
+            iter(
+                host_mod.find(
+                    self.store,
+                    lambda d: d["_id"] == instance_id
+                    or d.get("external_id") == instance_id,
+                )
+            ),
+            None,
+        )
+        # unknown host: ack so AWS stops retrying (reference
+        # handleInstanceTerminated early return)
+        if h is None:
+            return 200, {"ok": True, "host": None}
+        if state in ("terminated", "stopped", "stopping"):
+            if h.status != HostStatus.TERMINATED.value:
+                now = _time.time()
+                host_mod.coll(self.store).update(
+                    h.id,
+                    {
+                        "status": HostStatus.TERMINATED.value,
+                        "termination_time": now,
+                    },
+                )
+                event_mod.log(
+                    self.store, event_mod.RESOURCE_HOST,
+                    "HOST_EXTERNALLY_TERMINATED", h.id,
+                    {"sns_state": state}, timestamp=now,
+                )
+                if h.running_task:
+                    from ..units.host_jobs import fix_stranded_task
+
+                    fix_stranded_task(
+                        self.store, h.running_task, h.id, now
+                    )
+        elif state == "running":
+            event_mod.log(
+                self.store, event_mod.RESOURCE_HOST,
+                "HOST_INSTANCE_RUNNING", h.id, {"sns_state": state},
+            )
+        return 200, {"ok": True, "host": h.id}
+
+
+def _hmac_compare(a: str, b: str) -> bool:
+    import hmac as _hmac_mod
+
+    return _hmac_mod.compare_digest(a, b)
+
+
+class _FakeMatch:
+    """Minimal re.Match stand-in for handler-to-handler delegation."""
+
+    def __init__(self, groups: Dict[str, str]) -> None:
+        self._groups = groups
+
+    def __getitem__(self, key: str) -> str:
+        return self._groups[key]
 
 
 def dataclasses_to_dict(x):

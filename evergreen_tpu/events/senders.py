@@ -25,24 +25,33 @@ OUTBOX = {
 }
 
 
+def insert_outbox_row(store: Store, collection: str, fields: dict) -> None:
+    """The ONE place the outbox row envelope is built (_id/created_at/
+    delivered) — the drain job's expectations live here, and both
+    subscription-driven sends and the direct notification routes
+    (api/rest.py notify_slack/notify_email) go through it. Ids are
+    process-restart-safe UUIDs so undrained docs are never
+    overwritten."""
+    store.collection(collection).insert(
+        {
+            "_id": f"ntf-{uuid.uuid4().hex}",
+            "created_at": _time.time(),
+            "delivered": False,
+            **fields,
+        }
+    )
+
+
 def make_outbox_sender(
     store: Store,
     collection: str,
     payload_fn: Callable[[Notification], dict],
 ) -> Callable[[Notification], None]:
     """Shared outbox delivery: the store is closure-captured (multiple
-    installs against different stores stay independent) and ids are
-    process-restart-safe UUIDs so undrained docs are never overwritten."""
+    installs against different stores stay independent)."""
 
     def send(ntf: Notification) -> None:
-        store.collection(collection).insert(
-            {
-                "_id": f"ntf-{uuid.uuid4().hex}",
-                "created_at": _time.time(),
-                "delivered": False,
-                **payload_fn(ntf),
-            }
-        )
+        insert_outbox_row(store, collection, payload_fn(ntf))
 
     return send
 

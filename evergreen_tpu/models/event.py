@@ -25,6 +25,7 @@ RESOURCE_VERSION = "VERSION"
 RESOURCE_PATCH = "PATCH"
 RESOURCE_DISTRO = "DISTRO"
 RESOURCE_ADMIN = "ADMIN"
+RESOURCE_PROJECT = "PROJECT"
 
 
 @dataclasses.dataclass

@@ -96,6 +96,24 @@ def grant_role(store: Store, user_id: str, role: str) -> bool:
     return coll(store).mutate(user_id, add)
 
 
+def revoke_role(store: Store, user_id: str, role: str) -> bool:
+    def drop(doc: dict) -> None:
+        if role in doc["roles"]:
+            doc["roles"].remove(role)
+
+    return coll(store).mutate(user_id, drop)
+
+
+def revoke_all_roles(store: Store, user_id: str) -> bool:
+    """reference rest/route/permissions.go deleteUserPermissions: strip
+    every role from the user in one shot."""
+
+    def clear(doc: dict) -> None:
+        doc["roles"] = []
+
+    return coll(store).mutate(user_id, clear)
+
+
 #: key names must be route- and shell-addressable; key text must be one
 #: line of the ssh authorized_keys charset — this is the guard that keeps
 #: user-controlled key text from ever being able to escape the user-data

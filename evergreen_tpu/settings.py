@@ -200,6 +200,11 @@ class ApiConfig(ConfigSection):
 
     url: str = ""
     github_webhook_secret: str = ""
+    #: path token for the SNS intake route /hooks/aws/{token} (reference
+    #: sns.go verifies the signed SNS payload; zero-egress deployments
+    #: cannot fetch the signing cert, so the subscribe URL carries this
+    #: secret instead)
+    sns_secret: str = ""
     max_request_body_bytes: int = 32 * 1024 * 1024
 
 

@@ -301,6 +301,8 @@ class _FakeOktaState:
     def __init__(self, issuer: str = "") -> None:
         self.issuer = issuer
         self.codes: dict = {}
+        #: access token → userinfo claims served at /v1/userinfo
+        self.userinfo: dict = {}
         #: answers for /v1/keys; tests can blank it to simulate JWKS loss
         self.jwks = {
             "keys": [
@@ -316,7 +318,9 @@ class _FakeOktaState:
             ]
         }
 
-    def add_code(self, code: str, claims: dict, **token_kw) -> None:
+    def add_code(
+        self, code: str, claims: dict, access_token: str = "", **token_kw
+    ) -> None:
         now = time.time()
         full = {
             "iss": self.issuer,
@@ -325,10 +329,13 @@ class _FakeOktaState:
             "iat": now,
             **claims,
         }
-        self.codes[code] = {
+        tok = {
             "id_token": make_id_token(full, **token_kw),
             "token_type": "Bearer",
         }
+        if access_token:
+            tok["access_token"] = access_token
+        self.codes[code] = tok
 
 
 @pytest.fixture()
@@ -349,6 +356,12 @@ def okta_idp():
         def do_GET(self):
             if self.path == "/v1/keys":
                 return self._json(200, state.jwks)
+            if self.path == "/v1/userinfo":
+                tok = self.headers.get("Authorization", "").split(" ")[-1]
+                info = state.userinfo.get(tok)
+                return (
+                    self._json(200, info) if info else self._json(401, {})
+                )
             return self._json(404, {})
 
         def do_POST(self):
@@ -364,6 +377,12 @@ def okta_idp():
                 return self._json(401, {"error": "invalid_client"})
             length = int(self.headers.get("Content-Length", 0))
             form = urllib.parse.parse_qs(self.rfile.read(length).decode())
+            # RFC 6749 §4.1.3: real issuers reject a token request whose
+            # redirect_uri does not match the authorize request's — an
+            # empty one is always invalid_grant (pins the regression
+            # where the loader-built client sent "")
+            if not form.get("redirect_uri", [""])[0]:
+                return self._json(400, {"error": "invalid_grant"})
             code = form.get("code", [""])[0]
             if code not in state.codes:
                 return self._json(400, {"error": "invalid_grant"})
@@ -377,7 +396,10 @@ def okta_idp():
 
 
 def _oidc_client(base: str) -> OidcClient:
-    return OidcClient("oidc-cid", "oidc-secret", base)
+    return OidcClient(
+        "oidc-cid", "oidc-secret", base,
+        callback_url="https://evg.example/cb",
+    )
 
 
 class TestOidcContract:
@@ -471,6 +493,31 @@ class TestOidcContract:
             mgr.login_callback(
                 store, {"state": q["state"][0], "code": "nogroup"}
             )
+
+    def test_groups_come_from_userinfo_when_id_token_omits_them(
+        self, okta_idp
+    ):
+        """Common Okta shape: email in the ID token, groups only from
+        /v1/userinfo — a groups-gated manager must still admit the
+        user."""
+        state, base = okta_idp
+        state.add_code(
+            "uig", {"email": "dev@example.com"}, access_token="at-1"
+        )
+        state.userinfo["at-1"] = {
+            "email": "dev@example.com", "groups": ["engineers"],
+        }
+        store = Store()
+        mgr = OktaUserManager(
+            "oidc-cid", "oidc-secret", base, user_group="engineers",
+            client=_oidc_client(base),
+        )
+        redirect = mgr.login_redirect(store, "https://evg.example/cb")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(redirect).query)
+        token = mgr.login_callback(
+            store, {"state": q["state"][0], "code": "uig"}
+        )
+        assert mgr.get_user_by_token(store, token) is not None
 
     def test_bad_state_param(self, okta_idp):
         state, base = okta_idp

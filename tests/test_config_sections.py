@@ -171,10 +171,16 @@ def test_okta_service_section_feeds_user_manager(store):
     assert isinstance(mgr, OktaUserManager)
     assert mgr.client_id == "svc-id"
     assert mgr.scopes == ["openid", "email"]
-    # the M2M section carries no user-group gate (reference
-    # config_okta_service.go:14-19) — interactive group gating comes
-    # only from the auth section
+    # the M2M section carries no user-group fields (reference
+    # config_okta_service.go:14-19), but the AUTH section's gate must
+    # survive the credential fallback — shared credentials must not
+    # silently drop group gating
     assert mgr.user_group == ""
+    auth.okta_user_group = "engineers"
+    auth.set(store)
+    assert load_user_manager(store).user_group == "engineers"
+    auth.okta_user_group = ""
+    auth.set(store)
     # full-credential validation is a separate check from section load
     assert svc.validate() == ""
     svc.audience = ""

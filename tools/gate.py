@@ -15,6 +15,50 @@ import subprocess
 import sys
 
 
+def tree_hash() -> str:
+    """Canonical content hash of the ENTIRE working tree (tracked diffs
+    + untracked files), independent of what happens to be staged: build
+    a throwaway index with everything added and write-tree it. Used so
+    a cached green gate result can never be reused for a different
+    tree."""
+    import tempfile
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fd, idx = tempfile.mkstemp(prefix="gate-index-")
+    os.close(fd)
+    env = dict(os.environ, GIT_INDEX_FILE=idx)
+    try:
+        subprocess.run(
+            ["git", "read-tree", "HEAD"], env=env, cwd=root, check=True,
+            capture_output=True,
+        )
+        subprocess.run(
+            ["git", "add", "-A"], env=env, cwd=root, check=True,
+            capture_output=True,
+        )
+        # append-only logs grow between the gate run and the hook's
+        # check (the gate log from this very run; the probe log from the
+        # background daemon) — they must not perturb the hash the reuse
+        # window is keyed by, and neither holds code the suite covers
+        subprocess.run(
+            ["git", "rm", "--cached", "-q", "--ignore-unmatch",
+             "GATE_LOG.jsonl", "TPU_PROBE_LOG.jsonl"],
+            env=env, cwd=root, capture_output=True,
+        )
+        out = subprocess.run(
+            ["git", "write-tree"], env=env, cwd=root, check=True,
+            capture_output=True, text=True,
+        ).stdout.strip()
+    except subprocess.CalledProcessError:
+        return "unknown"
+    finally:
+        try:
+            os.unlink(idx)
+        except OSError:
+            pass
+    return out
+
+
 def _log_run(rc: int, args: list) -> None:
     """Append the gate outcome to GATE_LOG.jsonl at the repo root so
     every run (and therefore every skip) is visible in history
@@ -35,6 +79,7 @@ def _log_run(rc: int, args: list) -> None:
                             ["git", "rev-parse", "--short", "HEAD"],
                             capture_output=True, text=True, cwd=root,
                         ).stdout.strip(),
+                        "tree": tree_hash(),
                     }
                 )
                 + "\n"
@@ -44,6 +89,9 @@ def _log_run(rc: int, args: list) -> None:
 
 
 def main() -> int:
+    if sys.argv[1:] == ["--tree-hash"]:
+        print(tree_hash())
+        return 0
     # Scrub overrides that could mask a stock-image failure.
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
