@@ -107,7 +107,22 @@ def main() -> None:
         pipe_ms.append((time.perf_counter() - t1) * 1e3)
     fetch_solve_packed(inflight, cur)
 
-    tpu_ms = statistics.median(pipe_ms)
+    pipe_med = statistics.median(pipe_ms)
+
+    # --- overlap proof (VERDICT r4 weak #1 / ask #6) ----------------------- #
+    # The pipelined cadence only counts as the headline if the measured
+    # timeline actually shows host packing hiding behind device compute:
+    # overlap_efficiency = saved time / the most that COULD be hidden
+    # (min(pack, solve)). 1.0 = pipelined tick == max(pack, solve);
+    # ~0 = no overlap (CPU fallback shares the packer's cores — expected
+    # there; a TPU window is where this proves out). Below 0.5 the
+    # headline stays the honest sequential number.
+    pack_med = statistics.median(snap_ms)
+    solve_med = statistics.median(solve_ms)
+    hideable = max(min(pack_med, solve_med), 1e-9)
+    overlap_eff = (pack_med + solve_med - pipe_med) / hideable
+    overlap_proven = overlap_eff >= 0.5
+    tpu_ms = pipe_med if overlap_proven else seq_ms
 
     # --- serial baseline (reference-equivalent loop over distros) ---------- #
     t4 = time.perf_counter()
@@ -117,7 +132,7 @@ def main() -> None:
     serial_ms = (time.perf_counter() - t4) * 1e3
 
     # --- churn config (BASELINE config 5): store-backed incremental ticks -- #
-    churn_ms = measure_churn_ticks(
+    churn = measure_churn_ticks(
         distros, tasks_by_distro, hosts_by_distro
     )
 
@@ -150,6 +165,11 @@ def main() -> None:
         "vs_baseline": round(serial_ms / tpu_ms, 2),
         "backend": _backend,
         "sequential_tick_ms": round(seq_ms, 2),
+        "pipelined_tick_ms": round(pipe_med, 2),
+        "overlap_efficiency": round(overlap_eff, 3),
+        "overlap_proven": overlap_proven,
+        "churn_tick_ms": round(churn["churn_ms"], 2),
+        "store_steady_tick_ms": round(churn["store_steady_ms"], 2),
         "probe_history": _probe_history,
     }
     print(json.dumps(result))
@@ -157,11 +177,18 @@ def main() -> None:
         write_tpu_evidence(result)
     configs = " ".join(f"{k}={v:.0f}ms" for k, v in extra.items())
     print(
-        f"# backend={_backend} snapshot={statistics.median(snap_ms):.1f}ms "
-        f"solve={statistics.median(solve_ms):.1f}ms "
-        f"sequential_tick={seq_ms:.1f}ms pipelined_tick={tpu_ms:.1f}ms "
+        f"# backend={_backend} snapshot={pack_med:.1f}ms "
+        f"solve={solve_med:.1f}ms "
+        f"sequential_tick={seq_ms:.1f}ms pipelined_tick={pipe_med:.1f}ms "
+        f"overlap_eff={overlap_eff:.2f} "
+        f"({'PROVEN — headline is pipelined' if overlap_proven else 'not proven — headline is sequential'}) "
         f"serial_baseline={serial_ms:.1f}ms gen={gen_s:.1f}s "
-        f"churn_tick={churn_ms:.1f}ms {configs} target=<500ms",
+        f"churn_tick={churn['churn_ms']:.1f}ms "
+        f"store_steady_tick={churn['store_steady_ms']:.1f}ms "
+        f"churn_breakdown=snapshot:{churn['churn_snapshot_ms']:.1f}"
+        f"+solve:{churn['churn_solve_ms']:.1f}"
+        f"+store:{churn['churn_store_ms']:.1f} "
+        f"{configs} target=<500ms",
         file=sys.stderr,
     )
     print(
@@ -205,9 +232,12 @@ def measure_dispatch() -> dict:
     return run_bench(n_agents=100, queue_len=20_000, pulls_per_agent=200)
 
 
-def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro) -> float:
-    """Store-backed tick under small churn with the incremental cache
-    (BASELINE config 5: stepback + generate.tasks re-plan)."""
+def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro) -> dict:
+    """Store-backed ticks with and without churn (BASELINE config 5:
+    stepback + generate.tasks re-plan). Returns the churn median PLUS the
+    store-backed steady median and a component breakdown — the honest
+    comparison for "churn ≤ 2× steady" is against the same store-backed
+    path, not the store-less snapshot+solve loop."""
     import random
 
     from evergreen_tpu.globals import TaskStatus
@@ -232,9 +262,18 @@ def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro) -> float:
 
     tune_gc_for_long_lived_heap()  # same tuning as cli.cmd_service
     rng = random.Random(0)
-    times = []
     coll = task_mod.coll(store)
-    for tick in range(3):
+
+    steady = []
+    for k in range(5):
+        t1 = time.perf_counter()
+        run_tick(store, opts, now=NOW + 0.1 * k)
+        steady.append((time.perf_counter() - t1) * 1e3)
+
+    times = []
+    snap_ms = []
+    solve_ms = []
+    for tick in range(5):
         # ~200 tasks finish, ~100 new tasks appear
         for t in rng.sample(all_tasks, 200):
             coll.update(t.id, {"status": TaskStatus.SUCCEEDED.value})
@@ -248,9 +287,21 @@ def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro) -> float:
             )
         task_mod.insert_many(store, fresh)
         t1 = time.perf_counter()
-        run_tick(store, opts, now=NOW + tick)
+        res = run_tick(store, opts, now=NOW + tick + 1)
         times.append((time.perf_counter() - t1) * 1e3)
-    return statistics.median(times)
+        snap_ms.append(res.snapshot_ms)
+        solve_ms.append(res.solve_ms)
+    churn = statistics.median(times)
+    return {
+        "churn_ms": churn,
+        "store_steady_ms": statistics.median(steady),
+        "churn_snapshot_ms": statistics.median(snap_ms),
+        "churn_solve_ms": statistics.median(solve_ms),
+        # store plumbing: gather + persist + unpack + intent accounting
+        "churn_store_ms": churn
+        - statistics.median(snap_ms)
+        - statistics.median(solve_ms),
+    }
 
 
 if __name__ == "__main__":

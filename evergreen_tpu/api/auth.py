@@ -81,24 +81,34 @@ def clear_session(store: Store, token: str) -> bool:
     return store.collection(SESSIONS).remove(token)
 
 
-def _issue_state(store: Store, now: Optional[float] = None) -> str:
+def _issue_state(
+    store: Store, now: Optional[float] = None,
+    data: Optional[Dict] = None,
+) -> str:
+    """Mint a one-shot state nonce; ``data`` rides the state record
+    (e.g. the per-login callback URL the token exchange must repeat) —
+    NEVER shared mutable client state, which a concurrent or malicious
+    /login/redirect could poison."""
     now = _time.time() if now is None else now
     state = secrets.token_hex(16)
     coll = store.collection(AUTH_STATES)
-    coll.insert({"_id": state, "created_at": now})
+    coll.insert({"_id": state, "created_at": now, **(data or {})})
     # opportunistic expiry of stale nonces
     coll.remove_where(lambda d: now - d["created_at"] > STATE_TTL_S)
     return state
 
 
-def _consume_state(store: Store, state: str, now: Optional[float] = None) -> bool:
+def _consume_state(
+    store: Store, state: str, now: Optional[float] = None
+) -> Optional[Dict]:
+    """One-shot redeem → the state record (None if unknown/expired)."""
     now = _time.time() if now is None else now
     coll = store.collection(AUTH_STATES)
     doc = coll.get(state or "")
     if doc is None or now - doc["created_at"] > STATE_TTL_S:
-        return False
+        return None
     coll.remove(state)
-    return True
+    return doc
 
 
 # --------------------------------------------------------------------------- #
@@ -396,7 +406,7 @@ class GithubUserManager(UserManager):
         return f"https://github.com/login/oauth/authorize?{q}"
 
     def login_callback(self, store: Store, params: Dict[str, str]) -> str:
-        if not _consume_state(store, params.get("state", "")):
+        if _consume_state(store, params.get("state", "")) is None:
             raise AuthError("invalid or expired OAuth state")
         token = self.client.exchange_code(params.get("code", ""))
         if not token:
@@ -545,11 +555,18 @@ class OidcClient:
 
     # -- the exchange leg -------------------------------------------------- #
 
-    def exchange_code(self, code: str) -> Optional[Dict]:
+    def exchange_code(
+        self, code: str, redirect_uri: str = ""
+    ) -> Optional[Dict]:
         """POST {issuer}/v1/token with Basic client auth; verify the
         returned ID token; → claims dict {"email", "name", "groups"}.
         A rejected code (4xx from the token endpoint) maps to None; a
-        token that fails verification raises AuthError."""
+        token that fails verification raises AuthError.
+
+        ``redirect_uri`` is the per-login callback from the state record
+        (RFC 6749 §4.1.3 requires it to match the authorize leg's);
+        the constructor-level ``callback_url`` is only the fallback for
+        direct client use."""
         basic = base64.b64encode(
             f"{self.client_id}:{self.client_secret}".encode()
         ).decode()
@@ -557,7 +574,7 @@ class OidcClient:
             {
                 "grant_type": "authorization_code",
                 "code": code,
-                "redirect_uri": self.callback_url,
+                "redirect_uri": redirect_uri or self.callback_url,
             }
         ).encode()
         status, parsed = self._request(
@@ -621,7 +638,9 @@ class FakeOidc(OidcClient):
         self.codes[code] = {"email": email, "name": name or email,
                             "groups": list(groups)}
 
-    def exchange_code(self, code: str) -> Optional[Dict]:
+    def exchange_code(
+        self, code: str, redirect_uri: str = ""
+    ) -> Optional[Dict]:
         return self.codes.get(code)
 
 
@@ -666,12 +685,11 @@ class OktaUserManager(UserManager):
         self.client = client or FakeOidc()
 
     def login_redirect(self, store: Store, callback_url: str) -> str:
-        state = _issue_state(store)
         # RFC 6749 §4.1.3: the token request's redirect_uri must match
-        # the authorize request's — keep the client in sync so the real
-        # exchange leg sends the same value (an empty redirect_uri is an
-        # invalid_grant at every real issuer)
-        self.client.callback_url = callback_url
+        # the authorize request's — it rides THIS login's state record
+        # (shared client state would let a concurrent or attacker-issued
+        # redirect poison every in-flight exchange)
+        state = _issue_state(store, data={"callback": callback_url})
         q = urllib.parse.urlencode(
             {
                 "client_id": self.client_id,
@@ -684,9 +702,13 @@ class OktaUserManager(UserManager):
         return f"{self.issuer}/v1/authorize?{q}"
 
     def login_callback(self, store: Store, params: Dict[str, str]) -> str:
-        if not _consume_state(store, params.get("state", "")):
+        state_doc = _consume_state(store, params.get("state", ""))
+        if state_doc is None:
             raise AuthError("invalid or expired OAuth state")
-        claims = self.client.exchange_code(params.get("code", ""))
+        claims = self.client.exchange_code(
+            params.get("code", ""),
+            redirect_uri=state_doc.get("callback", ""),
+        )
         if not claims or not claims.get("email"):
             raise AuthError("could not exchange OIDC code")
         if self.user_group and self.user_group not in claims.get("groups", []):

@@ -1949,6 +1949,18 @@ class RestApi:
 
     # -- task reliability (reference rest/route/reliability.go) --------- #
 
+    @staticmethod
+    def _num(body: dict, key: str, default, cast=float):
+        """Numeric query/body param → 400 on malformed input (the
+        dispatch loop would surface a bare ValueError as a 500)."""
+        v = body.get(key)
+        if v in (None, ""):
+            return default
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            raise ApiError(400, f"invalid numeric parameter {key!r}")
+
     def task_reliability(self, method, match, body):
         """GET /projects/{id}/task_reliability — Wilson-scored success
         rates over finished executions (reference reliability.go +
@@ -1965,16 +1977,16 @@ class RestApi:
         f = rel_mod.ReliabilityFilter(
             project=match["project"],
             tasks=_csv("tasks"),
-            after_date=float(body.get("after_date") or (now - 28 * 86400)),
-            before_date=float(body.get("before_date") or now),
+            after_date=self._num(body, "after_date", now - 28 * 86400),
+            before_date=self._num(body, "before_date", now),
             group_by=body.get("group_by") or rel_mod.GROUP_BY_TASK,
-            group_num_days=int(body.get("group_num_days", 1) or 1),
+            group_num_days=self._num(body, "group_num_days", 1, int),
             requesters=_csv("requesters") or None,
             variants=_csv("variants") or None,
             distros=_csv("distros") or None,
-            significance=float(body.get("significance", 0.05) or 0.05),
+            significance=self._num(body, "significance", 0.05),
             sort=body.get("sort") or rel_mod.SORT_LATEST,
-            limit=int(body.get("limit", rel_mod.MAX_LIMIT) or rel_mod.MAX_LIMIT),
+            limit=self._num(body, "limit", rel_mod.MAX_LIMIT, int),
         )
         try:
             scores = rel_mod.get_task_reliability_scores(self.store, f)
@@ -2166,8 +2178,8 @@ class RestApi:
         newest-first, ?ts= continues before that timestamp). The cursor
         is (timestamp, id), not timestamp alone — events sharing one
         time.time() tick at a page boundary must not vanish."""
-        limit = int(body.get("limit", 10) or 10)
-        before_ts = float(body.get("ts") or _time.time() + 1)
+        limit = self._num(body, "limit", 10, int)
+        before_ts = self._num(body, "ts", _time.time() + 1)
         before_id = body.get("id", "")
 
         def seq(event_id: str) -> int:
@@ -2257,10 +2269,14 @@ class RestApi:
         stranded-task cleanup; running → agent-start bookkeeping."""
         from ..settings import ApiConfig
 
+        import hmac as _hmac
+
         secret = ApiConfig.get(self.store).sns_secret
         if self.require_auth and not secret:
             return 401, {"error": "sns secret not configured"}
-        if secret and not _hmac_compare(secret, match["token"] or ""):
+        if secret and not _hmac.compare_digest(
+            secret, match["token"] or ""
+        ):
             return 401, {"error": "invalid sns token"}
 
         msg_type = body.get("Type", "")
@@ -2336,12 +2352,6 @@ class RestApi:
                 "HOST_INSTANCE_RUNNING", h.id, {"sns_state": state},
             )
         return 200, {"ok": True, "host": h.id}
-
-
-def _hmac_compare(a: str, b: str) -> bool:
-    import hmac as _hmac_mod
-
-    return _hmac_mod.compare_digest(a, b)
 
 
 class _FakeMatch:

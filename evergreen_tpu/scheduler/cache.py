@@ -42,6 +42,15 @@ class TickCache:
         #: incrementally so the per-tick "emit in cold-scan order" contract
         #: costs O(changes) instead of a full 50k-key sort every tick
         self._sorted: List[Tuple[int, Task]] = []
+        #: per-distro (rank, Task) entries + the exported plain lists.
+        #: Exported list OBJECTS are regenerated only for distros whose
+        #: membership changed — an unchanged distro hands the snapshot
+        #: memo the IDENTICAL list across ticks, and gather skips the
+        #: full 50k split-by-distro loop (churn work ∝ churn size)
+        self._distro_entries: Dict[str, List[Tuple[int, Task]]] = {}
+        self._alias_entries: Dict[str, List[Tuple[int, Task]]] = {}
+        self._distro_lists: Dict[str, List[Task]] = {}
+        self._alias_lists: Dict[str, List[Task]] = {}
         #: incrementally-maintained dependency-met flags + the reverse
         #: dependency index that drives their invalidation: a task's flag
         #: changes only when the task itself or one of its parents churns
@@ -137,6 +146,7 @@ class TickCache:
                 for t in self._runnable.values():
                     self._reindex_deps(t)
                 self._recompute_deps_met(list(self._runnable))
+                self._rebuild_distro_lists_from_sorted()
                 self._primed = True
                 return len(self._runnable)
             with self._dirty_lock:
@@ -150,21 +160,41 @@ class TickCache:
             n = 0
             fresh: List[Tuple[int, Task]] = []
             gone: Set[str] = set()
+            #: distro ids whose primary/alias membership changed — only
+            #: these have their per-distro lists rebuilt below
+            dirty_primary: Set[str] = set()
+            dirty_alias: Set[str] = set()
+            fresh_primary: Dict[str, List[Tuple[int, Task]]] = {}
+            fresh_alias: Dict[str, List[Tuple[int, Task]]] = {}
             order = coll.key_order()
             for tid in dirty:
                 doc = coll.get(tid)
+                old = self._runnable.get(tid)
                 if self._qualifies(doc):
                     t = Task.from_doc(doc)
-                    if tid in self._runnable:
+                    rank = order.get(tid, 1 << 60)
+                    if old is not None:
                         gone.add(tid)  # replaced instance leaves _sorted
+                        dirty_primary.add(old.distro_id)
+                        dirty_alias.update(old.secondary_distros)
                     self._runnable[tid] = t
-                    fresh.append((order.get(tid, 1 << 60), t))
+                    fresh.append((rank, t))
+                    dirty_primary.add(t.distro_id)
+                    fresh_primary.setdefault(t.distro_id, []).append(
+                        (rank, t)
+                    )
+                    for sd in t.secondary_distros:
+                        if sd != t.distro_id:
+                            dirty_alias.add(sd)
+                            fresh_alias.setdefault(sd, []).append((rank, t))
                     self._reindex_deps(t)
                     affected.add(tid)
                     n += 1
-                elif tid in self._runnable:
+                elif old is not None:
                     del self._runnable[tid]
                     gone.add(tid)
+                    dirty_primary.add(old.distro_id)
+                    dirty_alias.update(old.secondary_distros)
                     self._drop_dep_index(tid)
                     n += 1
             if gone:
@@ -177,8 +207,77 @@ class TickCache:
                 # prefix: O(n + k log k) comparisons at C speed
                 self._sorted.extend(sorted(fresh))
                 self._sorted.sort()
+            self._patch_distro_lists(
+                dirty_primary, fresh_primary, gone,
+                self._distro_entries, self._distro_lists,
+            )
+            self._patch_distro_lists(
+                dirty_alias, fresh_alias, gone,
+                self._alias_entries, self._alias_lists,
+            )
             self._recompute_deps_met(affected & self._runnable.keys())
+            # tripwire: the deps-met map must track the runnable set
+            # KEY-FOR-KEY (the gather passthrough depends on it, and the
+            # snapshot fill defaults a missing id to met) — compare key
+            # sets, not sizes: one stale key plus one missing key is
+            # size-coincident and is exactly the shape a maintenance bug
+            # would produce. A gap repairs itself fail-closed here.
+            if self._deps_met.keys() != self._runnable.keys():
+                self._deps_met = {
+                    k: v for k, v in self._deps_met.items()
+                    if k in self._runnable
+                }
+                missing = [
+                    k for k in self._runnable if k not in self._deps_met
+                ]
+                self._recompute_deps_met(missing)
             return n
+
+    def _rebuild_distro_lists_from_sorted(self) -> None:
+        """Cold prime of the per-distro views from the global order."""
+        self._distro_entries = {}
+        self._alias_entries = {}
+        for rank, t in self._sorted:
+            self._distro_entries.setdefault(t.distro_id, []).append(
+                (rank, t)
+            )
+            for sd in t.secondary_distros:
+                if sd != t.distro_id:
+                    self._alias_entries.setdefault(sd, []).append((rank, t))
+        self._distro_lists = {
+            did: [t for _, t in ent]
+            for did, ent in self._distro_entries.items()
+        }
+        self._alias_lists = {
+            did: [t for _, t in ent]
+            for did, ent in self._alias_entries.items()
+        }
+
+    @staticmethod
+    def _patch_distro_lists(
+        dirty_distros: Set[str],
+        fresh_by_distro: Dict[str, List[Tuple[int, Task]]],
+        gone: Set[str],
+        entries: Dict[str, List[Tuple[int, Task]]],
+        lists: Dict[str, List[Task]],
+    ) -> None:
+        """Rebuild ONLY the touched distros' ordered views; untouched
+        distros keep their existing list objects (identity is what the
+        snapshot membership memo keys on)."""
+        for did in dirty_distros:
+            ent = entries.get(did, [])
+            if gone:
+                ent = [e for e in ent if e[1].id not in gone]
+            add = fresh_by_distro.get(did)
+            if add:
+                ent.extend(sorted(add))
+                ent.sort()
+            if ent:
+                entries[did] = ent
+                lists[did] = [t for _, t in ent]
+            else:
+                entries.pop(did, None)
+                lists.pop(did, None)
 
     def _host_qualifies(self, doc: Optional[dict]) -> bool:
         return doc is not None and is_active_host_doc(doc)
@@ -228,15 +327,19 @@ class TickCache:
 
     def gather(self, now: float) -> Tuple:
         """Same contract as scheduler.wrapper.gather_tick_inputs, served
-        from the warm runnable map."""
+        from the warm per-distro views: no 50k flatten/split loop, no
+        deps-met dict rebuild — per-tick assembly cost is O(distros),
+        not O(tasks)."""
         from .wrapper import gather_tick_inputs
 
+        self.apply_dirty()
         return gather_tick_inputs(
             self.store,
             now,
-            runnable_tasks=self.runnable_in_store_order(),
             active_hosts=self.active_hosts_in_store_order(),
             deps_met=self._deps_met,
+            by_distro=self._distro_lists,
+            alias_by_distro=self._alias_lists,
         )
 
     def runnable_count(self) -> int:

@@ -21,7 +21,7 @@ def persist_task_queue(
     distro_id: str,
     plan: List[Task],
     sort_values: Union[Dict[str, float], Sequence[float]],
-    deps_met: Dict[str, bool],
+    deps_met: Union[Dict[str, bool], Sequence[bool]],
     info: DistroQueueInfo,
     max_scheduled_per_distro: int = 0,
     secondary: bool = False,
@@ -29,9 +29,10 @@ def persist_task_queue(
 ) -> int:
     """Persist the plan; returns the number of queue items written.
 
-    ``sort_values`` is either an id→value mapping (serial/cmp paths) or a
-    sequence positionally aligned with ``plan`` (the batched solve's
-    unpack, which avoids materializing 50k-entry dicts every tick)."""
+    ``sort_values`` and ``deps_met`` are either id-keyed mappings
+    (serial/cmp paths) or sequences positionally aligned with ``plan``
+    (the batched solve's unpack, which avoids materializing 50k-entry
+    dicts every tick)."""
     now = _time.time() if now is None else now
     # columnar persist: one list comprehension per field instead of 50k
     # small dicts — queue writes are every-tick work (the read side
@@ -46,13 +47,17 @@ def persist_task_queue(
     # dependencies_met are recomputed per tick; the read side transposes
     # on TTL-amortized rebuilds (TaskQueue.from_doc / doc_column).
     rows = [t.queue_row() for t in plan]
-    ids = [r[0] for r in rows]
+    n_rows = len(rows)
     if isinstance(sort_values, dict):
-        sort_col = [sort_values.get(i, 0.0) for i in ids]
+        sort_col = [sort_values.get(r[0], 0.0) for r in rows]
     else:
-        sort_col = list(sort_values[: len(ids)])
-        sort_col += [0.0] * (len(ids) - len(sort_col))
-    met_col = [deps_met.get(i, True) for i in ids]
+        sort_col = list(sort_values[:n_rows])
+        sort_col += [0.0] * (n_rows - len(sort_col))
+    if isinstance(deps_met, dict):
+        met_col = [deps_met.get(r[0], True) for r in rows]
+    else:
+        met_col = list(deps_met[:n_rows])
+        met_col += [True] * (n_rows - len(met_col))
     info_doc = {
         **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
         "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],

@@ -483,8 +483,14 @@ def build_snapshot(
         if (
             entry is not None
             and entry[0] == gv
-            and len(entry[1]) == len(tasks)
-            and all(map(_is, entry[1], tasks))
+            and (
+                entry[1] is tasks  # TickCache reuses list objects for
+                # untouched distros — O(1) hit instead of O(n) is-scan
+                or (
+                    len(entry[1]) == len(tasks)
+                    and all(map(_is, entry[1], tasks))
+                )
+            )
         ):
             (_, _, n_units_d, mt_local, mu_local, snames, smax, seg_local,
              scols, t_ids, seg_pairs_c, pairs_di) = entry

@@ -40,6 +40,11 @@ from .snapshot import Snapshot, build_snapshot
 #: distro-id suffix marking secondary (alias) queue rows in the solve
 ALIAS_SUFFIX = "::alias"
 
+#: shared empty task list for distros with no runnable work — a stable
+#: object so the snapshot membership memo sees identity across ticks
+#: (nothing in the tick path mutates task lists)
+_EMPTY_TASKS: List[Task] = []
+
 
 @dataclasses.dataclass
 class TickOptions:
@@ -112,6 +117,8 @@ def gather_tick_inputs(
     runnable_tasks: Optional[List[Task]] = None,
     active_hosts: Optional[List[Host]] = None,
     deps_met: Optional[Dict[str, bool]] = None,
+    by_distro: Optional[Dict[str, List[Task]]] = None,
+    alias_by_distro: Optional[Dict[str, List[Task]]] = None,
 ) -> Tuple[
     List[Distro],
     Dict[str, List[Task]],
@@ -126,6 +133,12 @@ def gather_tick_inputs(
     supply warm sets (already in store order); when absent, the cold-path
     finders scan the collections (scheduler/task_finder.go:34-36 analog) —
     never the full task history.
+
+    ``by_distro``/``alias_by_distro`` are the TickCache's maintained
+    per-distro views (store order, unchanged distros keep identical list
+    objects): assembly then costs O(distros) and ``deps_met`` is passed
+    through as-is — the cache maintains it key-for-key with the runnable
+    set (the apply_dirty tripwire repairs any gap fail-closed).
     """
     # The snapshot covers the allocator's distro set (a superset that
     # includes disabled distros, which still maintain minimum hosts); task
@@ -135,21 +148,33 @@ def gather_tick_inputs(
     all_ids = {d.id for d in distros}
     distro_ids = {d.id for d in distro_mod.find_needs_planning(store)}
 
-    if runnable_tasks is None:
-        runnable_tasks = task_mod.find_host_runnable(store)
+    if by_distro is not None:
+        tasks_by_distro = {
+            d.id: by_distro.get(d.id, _EMPTY_TASKS)
+            if d.id in distro_ids else _EMPTY_TASKS
+            for d in distros
+        }
+        alias_tasks = {
+            did: tasks
+            for did, tasks in (alias_by_distro or {}).items()
+            if did in distro_ids and tasks
+        }
+    else:
+        if runnable_tasks is None:
+            runnable_tasks = task_mod.find_host_runnable(store)
 
-    tasks_by_distro: Dict[str, List[Task]] = {d.id: [] for d in distros}
-    alias_tasks: Dict[str, List[Task]] = {}
-    runnable: List[Task] = []
-    for t in runnable_tasks:
-        if t.distro_id in distro_ids:
-            tasks_by_distro[t.distro_id].append(t)
-            runnable.append(t)
-        for sd in t.secondary_distros:
-            if sd in distro_ids and sd != t.distro_id:
-                alias_tasks.setdefault(sd, []).append(t)
-                if t.distro_id not in distro_ids:
-                    runnable.append(t)
+        tasks_by_distro = {d.id: [] for d in distros}
+        alias_tasks = {}
+        runnable: List[Task] = []
+        for t in runnable_tasks:
+            if t.distro_id in distro_ids:
+                tasks_by_distro[t.distro_id].append(t)
+                runnable.append(t)
+            for sd in t.secondary_distros:
+                if sd in distro_ids and sd != t.distro_id:
+                    alias_tasks.setdefault(sd, []).append(t)
+                    if t.distro_id not in distro_ids:
+                        runnable.append(t)
 
     # Secondary (alias) queues plan as extra rows of the SAME batched solve
     # (the reference runs a separate alias-scheduler job per distro,
@@ -167,7 +192,13 @@ def gather_tick_inputs(
     from ..globals import DEFAULT_TASK_DURATION_S
 
     coll = task_mod.coll(store)
-    if deps_met is None:
+    if by_distro is not None:
+        # passthrough: the cache's map is maintained key-for-key with the
+        # runnable set; rebuilding a 50k-entry restriction dict per tick
+        # was the single largest gather cost under churn
+        if deps_met is None:
+            raise ValueError("by_distro gather requires the cache deps map")
+    elif deps_met is None:
         from .snapshot import deps_met_for
 
         deps_met = deps_met_for(runnable, coll)
@@ -202,12 +233,11 @@ def gather_tick_inputs(
 def _unpack_solve(
     snapshot: Snapshot,
     out: Dict[str, np.ndarray],
-) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, DistroQueueInfo], Dict[str, int]]:
-    """Device outputs → per-distro ordered plans, sort values, queue infos,
-    spawn counts."""
+) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, DistroQueueInfo], Dict[str, int], Dict[str, List[bool]]]:
+    """Device outputs → per-distro ordered plans, sort values, positional
+    deps-met columns, queue infos, spawn counts."""
     flat = snapshot.flat_tasks
     n = snapshot.n_tasks
-    task_ids = snapshot.task_ids
     # The solve's first sort key is the distro index, so the returned order
     # is already segmented distro by distro: drop padding, then slice per
     # distro — no per-element Python loop over the padded [N] array.
@@ -217,52 +247,84 @@ def _unpack_solve(
     dpd = t_distro[real]
     vals = np.asarray(out["t_value"])[real].astype(float)
     bounds = np.searchsorted(dpd, np.arange(len(snapshot.distro_ids) + 1))
-    ro = real.tolist()
-    vl = vals.tolist()
+    # one C-level gather over an object ndarray instead of 50k Python
+    # list-index operations, then per-distro C slicing — the unpack is
+    # every-tick work at config-3 scale
+    flat_np = np.empty(len(flat), dtype=object)
+    flat_np[:] = flat
+    ordered_tasks = flat_np[real]
+    # deps-met rides along positionally (the persister consumed an
+    # id→flag dict before — 50k dict lookups per tick)
+    met_ordered = snapshot.arrays["t_deps_met"][:n][real]
     plans: Dict[str, List[Task]] = {}
     # per-distro sort values ALIGNED with plans[did] (the persister
     # consumes them positionally — building 50k-entry id→value dicts per
     # tick was pure overhead)
     sort_values: Dict[str, List[float]] = {}
+    met_cols: Dict[str, List[bool]] = {}
     for di, did in enumerate(snapshot.distro_ids):
         lo, hi = int(bounds[di]), int(bounds[di + 1])
-        seg = ro[lo:hi]
-        plans[did] = [flat[i] for i in seg]
-        sort_values[did] = vl[lo:hi]
+        plans[did] = ordered_tasks[lo:hi].tolist()
+        sort_values[did] = vals[lo:hi].tolist()
+        met_cols[did] = met_ordered[lo:hi].tolist()
 
-    # per-segment TaskGroupInfos
+    # Per-segment / per-distro scalars: pull each device array to host
+    # ONCE and iterate plain lists — scalar indexing into a jax array is
+    # a device op (µs each), and there are 9 fields × thousands of
+    # segments per tick.
+    def host_list(name: str):
+        return np.asarray(out[name]).tolist()
+
+    g_count = host_list("g_count")
+    g_exp = host_list("g_expected_dur_s")
+    g_free = host_list("g_count_free")
+    g_req = host_list("g_count_required")
+    g_over = host_list("g_over_count")
+    g_wait = host_list("g_wait_over")
+    g_merge = host_list("g_merge")
+    g_over_dur = host_list("g_over_dur_s")
+    g_max_hosts = np.asarray(snapshot.arrays["g_max_hosts"]).tolist()
     seg_infos: Dict[int, List[TaskGroupInfo]] = {}
     for gi, (di, name) in enumerate(snapshot.seg_names):
         info = TaskGroupInfo(
             name=name,
-            count=int(out["g_count"][gi]),
-            max_hosts=int(snapshot.arrays["g_max_hosts"][gi]),
-            expected_duration_s=float(out["g_expected_dur_s"][gi]),
-            count_free=int(out["g_count_free"][gi]),
-            count_required=int(out["g_count_required"][gi]),
-            count_duration_over_threshold=int(out["g_over_count"][gi]),
-            count_wait_over_threshold=int(out["g_wait_over"][gi]),
-            count_dep_filled_merge_queue=int(out["g_merge"][gi]),
-            duration_over_threshold_s=float(out["g_over_dur_s"][gi]),
+            count=int(g_count[gi]),
+            max_hosts=int(g_max_hosts[gi]),
+            expected_duration_s=float(g_exp[gi]),
+            count_free=int(g_free[gi]),
+            count_required=int(g_req[gi]),
+            count_duration_over_threshold=int(g_over[gi]),
+            count_wait_over_threshold=int(g_wait[gi]),
+            count_dep_filled_merge_queue=int(g_merge[gi]),
+            duration_over_threshold_s=float(g_over_dur[gi]),
         )
         seg_infos.setdefault(di, []).append(info)
 
+    d_length = host_list("d_length")
+    d_deps_met = host_list("d_deps_met")
+    d_merge = host_list("d_merge")
+    d_exp = host_list("d_expected_dur_s")
+    d_over_count = host_list("d_over_count")
+    d_over_dur = host_list("d_over_dur_s")
+    d_wait = host_list("d_wait_over")
+    d_new = host_list("d_new_hosts")
+    d_thresh = np.asarray(snapshot.arrays["d_thresh_s"]).tolist()
     infos: Dict[str, DistroQueueInfo] = {}
     new_hosts: Dict[str, int] = {}
     for di, did in enumerate(snapshot.distro_ids):
         infos[did] = DistroQueueInfo(
-            length=int(out["d_length"][di]),
-            length_with_dependencies_met=int(out["d_deps_met"][di]),
-            count_dep_filled_merge_queue=int(out["d_merge"][di]),
-            expected_duration_s=float(out["d_expected_dur_s"][di]),
-            max_duration_threshold_s=float(snapshot.arrays["d_thresh_s"][di]),
-            count_duration_over_threshold=int(out["d_over_count"][di]),
-            duration_over_threshold_s=float(out["d_over_dur_s"][di]),
-            count_wait_over_threshold=int(out["d_wait_over"][di]),
+            length=int(d_length[di]),
+            length_with_dependencies_met=int(d_deps_met[di]),
+            count_dep_filled_merge_queue=int(d_merge[di]),
+            expected_duration_s=float(d_exp[di]),
+            max_duration_threshold_s=float(d_thresh[di]),
+            count_duration_over_threshold=int(d_over_count[di]),
+            duration_over_threshold_s=float(d_over_dur[di]),
+            count_wait_over_threshold=int(d_wait[di]),
             task_group_infos=seg_infos.get(di, []),
         )
-        new_hosts[did] = int(out["d_new_hosts"][di])
-    return plans, sort_values, infos, new_hosts
+        new_hosts[did] = int(d_new[di])
+    return plans, sort_values, infos, new_hosts, met_cols
 
 
 def _apply_release_mode(store: Store, distros):
@@ -366,6 +428,9 @@ def run_tick(
     plans: Dict[str, List[Task]] = {}
     sort_values: Dict[str, Dict[str, float]] = {}
     infos: Dict[str, DistroQueueInfo] = {}
+    #: positional deps-met columns from the solve's unpack; distros
+    #: planned host-side (cmp/serial) fall back to the dict
+    met_cols: Dict[str, List[bool]] = {}
     if solver_distros and opts.planner_version == PlannerVersion.TPU.value:
         t1 = _time.perf_counter()
         dims_memo, memb_memo = _snapshot_memos_for(store)
@@ -385,7 +450,7 @@ def run_tick(
         t3 = _time.perf_counter()
         snapshot_ms = (t2 - t1) * 1e3
         solve_ms = (t3 - t2) * 1e3
-        plans, sort_values, infos, new_hosts = _unpack_solve(
+        plans, sort_values, infos, new_hosts, met_cols = _unpack_solve(
             snapshot, out
         )
     elif solver_distros:
@@ -459,7 +524,7 @@ def run_tick(
             base_id,
             plan,
             sort_values.get(d.id, {}),
-            deps_met,
+            met_cols.get(d.id, deps_met),
             info,
             opts.max_scheduled_per_distro,
             secondary=is_alias,

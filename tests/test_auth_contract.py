@@ -519,6 +519,31 @@ class TestOidcContract:
         )
         assert mgr.get_user_by_token(store, token) is not None
 
+    def test_callback_rides_the_state_record(self, okta_idp):
+        """Two interleaved logins with different callbacks must each
+        exchange with THEIR OWN redirect_uri — a later /login/redirect
+        (possibly attacker-issued with a poisoned callback) must not
+        change an in-flight login's token exchange."""
+        state, base = okta_idp
+        state.add_code("c1", {"email": "a@example.com"})
+        state.add_code("c2", {"email": "b@example.com"})
+        store = Store()
+        mgr = OktaUserManager(
+            "oidc-cid", "oidc-secret", base, client=_oidc_client(base)
+        )
+        r1 = mgr.login_redirect(store, "https://evg.example/cb-one")
+        # second redirect BEFORE the first completes, different callback
+        r2 = mgr.login_redirect(store, "https://attacker.example/cb-two")
+        q1 = urllib.parse.parse_qs(urllib.parse.urlparse(r1).query)
+        q2 = urllib.parse.parse_qs(urllib.parse.urlparse(r2).query)
+        # the first login still completes with its own callback
+        assert mgr.login_callback(
+            store, {"state": q1["state"][0], "code": "c1"}
+        )
+        assert mgr.login_callback(
+            store, {"state": q2["state"][0], "code": "c2"}
+        )
+
     def test_bad_state_param(self, okta_idp):
         state, base = okta_idp
         state.add_code("good", {"email": "dev@example.com"})

@@ -36,3 +36,42 @@ def test_shard_map_blocks_match_local_solves(store):
                                       ref["d_new_hosts"])
         np.testing.assert_allclose(np.asarray(out["t_value"][si]),
                                    ref["t_value"])
+
+
+def test_warm_sharded_build_matches_cold():
+    """The memoized warm build (sticky partition + per-shard membership/
+    dims memos, VERDICT r4 ask #5) must produce bit-identical stacked
+    arrays to a cold build — and hand back the same common dims."""
+    problem = generate_problem(
+        10, 800, seed=43, task_group_fraction=0.3, hosts_per_distro=3
+    )
+    cold_subs, cold = build_sharded_snapshot(*problem, NOW, 4)
+    memos: dict = {}
+    build_sharded_snapshot(*problem, NOW, 4, memos=memos)  # prime
+    warm_subs, warm = build_sharded_snapshot(*problem, NOW, 4, memos=memos)
+    assert set(cold) == set(warm)
+    for name in cold:
+        np.testing.assert_array_equal(cold[name], warm[name], err_msg=name)
+    # the sticky partition held (same distro → shard assignment; the
+    # memo stores ids, the live Distro objects resolve per call)
+    assert memos["groups"] == [
+        [d.id for d in g]
+        for g in partition_distros(problem[0], problem[1], 4)
+    ]
+
+
+def test_sharded_memos_repartition_on_imbalance():
+    """Churn that skews the load past 2x mean forces a re-shuffle; the
+    memos reset so stale shard-keyed memberships cannot leak."""
+    distros, tbd, hbd, est, dm = generate_problem(8, 400, seed=44)
+    memos: dict = {}
+    build_sharded_snapshot(distros, tbd, hbd, est, dm, NOW, 4, memos=memos)
+    groups_before = [list(g) for g in memos["groups"]]
+    # pile every task onto one distro: cached assignment becomes skewed
+    big = distros[0].id
+    all_tasks = [t for ts in tbd.values() for t in ts]
+    tbd2 = {d.id: [] for d in distros}
+    tbd2[big] = all_tasks
+    build_sharded_snapshot(distros, tbd2, hbd, est, dm, NOW, 4, memos=memos)
+    groups_after = [list(g) for g in memos["groups"]]
+    assert groups_before != groups_after

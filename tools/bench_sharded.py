@@ -52,9 +52,19 @@ def main() -> int:
         N_DISTROS, N_TASKS, seed=3, task_group_fraction=0.25,
         patch_fraction=0.6, hosts_per_distro=25,
     )
+    memos: dict = {}
     t0 = time.perf_counter()
-    subs, stacked = build_sharded_snapshot(*problem, NOW, n_devices)
+    subs, stacked = build_sharded_snapshot(
+        *problem, NOW, n_devices, memos=memos
+    )
     build_ms = (time.perf_counter() - t0) * 1e3
+    # warm rebuild: sticky partition + per-shard membership/dims memos —
+    # the deployed multichip tick cadence (VERDICT r4 ask #5)
+    t0 = time.perf_counter()
+    subs, stacked = build_sharded_snapshot(
+        *problem, NOW, n_devices, memos=memos
+    )
+    warm_build_ms = (time.perf_counter() - t0) * 1e3
 
     # per-shard solo solves: what a dedicated device per shard would do
     solo_ms = []
@@ -86,6 +96,7 @@ def main() -> int:
         "bound_ms": round(max(solo_ms), 2),
         "stacked_virtual_ms": round(stacked_ms, 2),
         "build_ms": round(build_ms, 2),
+        "warm_build_ms": round(warm_build_ms, 2),
     }
     print(json.dumps(result))
     print("# shard  tasks  solo_solve_ms", file=sys.stderr)
