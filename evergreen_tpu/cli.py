@@ -16,32 +16,30 @@ from typing import List, Optional
 
 def cmd_service(args) -> int:
     """Run the app server: REST API + background job plane
-    (reference operations/service.go `service web`)."""
-    from .api.rest import RestApi
-    from .queue.jobs import JobQueue
-    from .storage.store import global_store
-    from .units.crons import build_cron_runner
+    (reference operations/service.go `service web`). ALL subsystem
+    wiring happens in one place — Environment.build (env.py), the
+    reference's NewEnvironment composition root."""
+    from .env import Environment
 
-    lease = None
-    if getattr(args, "replica_of", ""):
+    if getattr(args, "replica_of", "") and not args.data_dir:
+        print("--replica-of requires --data-dir", file=sys.stderr)
+        return 2
+    if args.data_dir and not getattr(args, "replica_of", ""):
+        print(f"acquiring writer lease on {args.data_dir} ...")
+    env = Environment.build(
+        data_dir=args.data_dir or "",
+        replica_of=getattr(args, "replica_of", "") or "",
+        require_auth=args.require_auth,
+        rate_limit=args.rate_limit,
+        workers=args.workers,
+        webhook_secret=args.github_webhook_secret or "",
+    )
+    api = env.api
+    if env.is_replica:
         # Read replica: tail the primary's WAL, serve reads locally,
         # and transparently FORWARD writes to the primary (rest.py
-        # _maybe_forward; read-your-writes via an immediate poll). No
-        # lease, no job plane — background work belongs to the writer.
-        if not args.data_dir:
-            print("--replica-of requires --data-dir", file=sys.stderr)
-            return 2
-        from .storage.replica import ReplicaStore
-        from .storage.store import set_global_store
-
-        store = ReplicaStore(args.data_dir, primary_url=args.replica_of)
-        store.start()
-        set_global_store(store)
-        api = RestApi(
-            store,
-            require_auth=args.require_auth,
-            rate_limit_per_min=args.rate_limit,
-        )
+        # _maybe_forward). No lease, no job plane — background work
+        # belongs to the writer.
         server = api.serve(args.host, args.port)
         print(
             f"evergreen-tpu replica on {args.host}:{args.port} "
@@ -52,62 +50,9 @@ def cmd_service(args) -> int:
         except KeyboardInterrupt:
             pass
         finally:
-            store.close()
+            env.close()
         return 0
-    if args.data_dir:
-        # Durable deployment: WAL-backed store + writer lease so a standby
-        # replica can take over this data dir if we die (storage/durable.py)
-        import os as _os
-
-        from .storage.durable import DurableStore
-        from .storage.lease import FileLease
-
-        lease = FileLease(_os.path.join(args.data_dir, "writer.lease"))
-        print(f"acquiring writer lease on {args.data_dir} ...")
-        lease.acquire()
-
-        def _deposed():
-            # Another replica stole the lease while we stalled: stop
-            # writing IMMEDIATELY — two writers on one WAL is split-brain.
-            print("writer lease lost — terminating to avoid split-brain",
-                  file=sys.stderr, flush=True)
-            _os._exit(70)
-
-        lease.start_renewing(on_lost=_deposed)
-        store = DurableStore(args.data_dir)
-        from .storage.store import set_global_store
-
-        set_global_store(store)
-    else:
-        store = global_store()
-    from .storage.migrations import apply_migrations
-
-    for name, result in apply_migrations(store):
-        print(f"migration {name}: {result}")
-    # structured logging plane: JSON lines on stderr + a capped in-store
-    # ring (reference grip senders; level from the logger_config section)
-    from .utils import log as log_mod
-
-    log_mod.reset_sinks(log_mod.json_line_sink, log_mod.StoreSink(store))
-    log_mod.configure(store)
-    # (the host deploy transport resolves from the ssh config section at
-    # use time — see cloud/provisioning.get_transport — so runtime edits
-    # to that section apply without a restart)
-    api = RestApi(
-        store,
-        require_auth=args.require_auth,
-        rate_limit_per_min=args.rate_limit,
-    )
-    if args.github_webhook_secret:
-        # CLI flag wins over the stored ApiConfig section
-        api.webhook_secret = args.github_webhook_secret
-    if args.workers is None:
-        from .settings import AmboyConfig
-
-        args.workers = AmboyConfig.get(store).pool_size_local
-    queue = JobQueue(store, workers=args.workers)
-    runner = build_cron_runner(store, queue)
-    runner.run_background()
+    env.cron_runner.run_background()
     # background TPU-tunnel prober: log health on an interval and capture
     # on-device bench evidence on the first healthy window (tools/tpu_probe).
     # EVG_AXON_POOL_IPS_ORIG survives a force_cpu scrub, so the prober
@@ -143,11 +88,7 @@ def cmd_service(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        runner.stop()
-        queue.close()
-        if lease is not None:
-            store.close()
-            lease.release()
+        env.close()
     return 0
 
 

@@ -1,0 +1,209 @@
+"""Unified Environment — the one place the service's subsystems are wired.
+
+Reference: environment.go:233 ``NewEnvironment`` builds the singleton
+``evergreen.Environment`` every layer reaches through: DB(), LocalQueue()/
+RemoteQueue(), Settings(), UserManager(), the tracer, and the client
+roundtrip config. Here the same composition happens once, in
+``Environment.build`` (invoked from cli.py ``service``), and the resulting
+object is threaded through service/API/units — no module assembles its own
+store/queue/settings wiring.
+
+Mapping onto the reference surface:
+  DB()            → ``env.store`` (storage/store, durable or replica)
+  LocalQueue()    → ``env.queue`` (queue/jobs.JobQueue worker pool)
+  RemoteQueue()   → same queue — the durable store + WAL replicas play
+                    Mongo's role as the shared backing
+  Settings()      → ``env.settings(Section)`` (live DB-backed sections)
+  UserManager()   → ``env.user_manager`` (api/auth loader, reloadable)
+  JasperManager() → ``env.host_transport()`` (cloud/provisioning seam)
+  tracer          → ``env.tracer(component)``
+plus the pieces the tick plane needs: ``env.api`` (REST surface),
+``env.dispatcher`` (DAG dispatcher service), ``env.tick_cache``
+(incremental gather), ``env.cron_runner`` (background populators).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from .storage.store import Store
+
+
+@dataclasses.dataclass
+class Environment:
+    store: Store
+    #: REST surface (owns the user manager + dispatcher service)
+    api: object = None
+    #: background job plane (worker pool; scope-locked jobs)
+    queue: object = None
+    #: cron populator runner (units/crons.build_cron_runner)
+    cron_runner: object = None
+    #: writer lease when running durable (None for in-memory / replica)
+    lease: object = None
+    #: True when this process serves reads from a WAL-tailing replica
+    is_replica: bool = False
+    _closers: list = dataclasses.field(default_factory=list)
+
+    # -- reference Environment accessors -------------------------------- #
+
+    def settings(self, section_cls):
+        """Live config section (reference env.Settings() + GetConfig)."""
+        return section_cls.get(self.store)
+
+    @property
+    def user_manager(self):
+        """The API surface's login manager (reference env.UserManager())."""
+        return self.api.user_manager if self.api is not None else None
+
+    def reload_user_manager(self) -> None:
+        if self.api is not None:
+            self.api.reload_user_manager()
+
+    @property
+    def dispatcher(self):
+        """DAG dispatcher service (reference env's dispatcher seam)."""
+        return self.api.svc if self.api is not None else None
+
+    @property
+    def tick_cache(self):
+        """Incremental scheduler gather cache for this store."""
+        from .scheduler.wrapper import tick_cache_for
+
+        return tick_cache_for(self.store)
+
+    def tracer(self, component: str):
+        from .utils.tracing import Tracer
+
+        return Tracer(self.store, component)
+
+    def host_transport(self, distro=None):
+        """Host control-plane transport (reference env.JasperManager());
+        resolved live so ssh config edits apply without restart."""
+        from .cloud.provisioning import get_transport
+
+        return get_transport(self.store, distro)
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def on_close(self, fn: Callable[[], None]) -> None:
+        self._closers.append(fn)
+
+    def close(self) -> None:
+        """Tear down in reverse construction order."""
+        if self.cron_runner is not None:
+            self.cron_runner.stop()
+        if self.queue is not None:
+            self.queue.close()
+        for fn in reversed(self._closers):
+            fn()
+
+    # -- construction ---------------------------------------------------- #
+
+    @classmethod
+    def build(
+        cls,
+        data_dir: str = "",
+        replica_of: str = "",
+        require_auth: bool = False,
+        rate_limit: Optional[int] = None,
+        workers: Optional[int] = None,
+        webhook_secret: str = "",
+        with_job_plane: bool = True,
+        on_lease_lost: Optional[Callable[[], None]] = None,
+        store: Optional[Store] = None,
+    ) -> "Environment":
+        """The single composition root (reference NewEnvironment,
+        environment.go:233): pick the store (WAL replica / durable
+        writer / in-memory / caller-supplied), run migrations, wire
+        logging, REST api, and the background job plane."""
+        from .api.rest import RestApi
+        from .storage.store import global_store, set_global_store
+
+        lease = None
+        is_replica = bool(replica_of)
+        env_store_supplied = store is not None
+        closers: list = []
+        if env_store_supplied:
+            # caller-supplied store (smoke harness, tests): no global
+            # registration, no lease — just the composition
+            pass
+        elif is_replica:
+            if not data_dir:
+                raise ValueError("a replica requires data_dir")
+            from .storage.replica import ReplicaStore
+
+            store = ReplicaStore(data_dir, primary_url=replica_of)
+            store.start()
+            set_global_store(store)
+            closers.append(store.close)
+        elif data_dir:
+            # durable writer: WAL + snapshot engine behind a renewing
+            # lease so a standby can take over the data dir if we die
+            import os as _os
+
+            from .storage.durable import DurableStore
+            from .storage.lease import FileLease
+
+            lease = FileLease(_os.path.join(data_dir, "writer.lease"))
+            lease.acquire()
+
+            def _deposed():  # pragma: no cover — split-brain guard
+                import sys as _sys
+
+                print(
+                    "writer lease lost — terminating to avoid split-brain",
+                    file=_sys.stderr, flush=True,
+                )
+                _os._exit(70)
+
+            lease.start_renewing(on_lost=on_lease_lost or _deposed)
+            store = DurableStore(data_dir)
+            set_global_store(store)
+            closers.append(lease.release)
+            closers.append(store.close)
+        else:
+            store = global_store()
+
+        owns_global_writable = not is_replica and not env_store_supplied
+        if not is_replica:
+            from .storage.migrations import apply_migrations
+
+            for name, result in apply_migrations(store):
+                print(f"migration {name}: {result}")
+
+        # structured logging plane: JSON lines + capped in-store ring.
+        # ONLY when this build owns the process's writable global store:
+        # a replica's store is read-only (the ring would silently drop
+        # every line), and a caller-supplied private store (smoke,
+        # tests) must not hijack process-global logging.
+        if owns_global_writable:
+            from .utils import log as log_mod
+
+            log_mod.reset_sinks(
+                log_mod.json_line_sink, log_mod.StoreSink(store)
+            )
+            log_mod.configure(store)
+
+        api = RestApi(
+            store,
+            require_auth=require_auth,
+            rate_limit_per_min=rate_limit,
+        )
+        if webhook_secret:
+            api.webhook_secret = webhook_secret
+
+        env = cls(
+            store=store, api=api, lease=lease, is_replica=is_replica,
+            _closers=closers,
+        )
+        if with_job_plane and not is_replica:
+            from .queue.jobs import JobQueue
+            from .units.crons import build_cron_runner
+
+            if workers is None:
+                from .settings import AmboyConfig
+
+                workers = AmboyConfig.get(store).pool_size_local
+            env.queue = JobQueue(store, workers=workers)
+            env.cron_runner = build_cron_runner(store, env.queue)
+        return env

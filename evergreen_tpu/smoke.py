@@ -51,22 +51,22 @@ SAMPLE_PROJECT = textwrap.dedent(
 
 
 def run_demo(port: int = 0, verbose: bool = True) -> int:
-    from .api.rest import RestApi
-    from .queue.jobs import JobQueue
+    from .env import Environment
     from .storage.store import Store
-    from .units.crons import build_cron_runner
 
     def log(msg: str) -> None:
         if verbose:
             print(msg)
 
-    store = Store()
-    api = RestApi(store)
+    # the same composition root the service uses (env.py), on a private
+    # in-memory store
+    env = Environment.build(store=Store(), workers=4)
+    store, api = env.store, env.api
     server = api.serve("127.0.0.1", port)
     actual_port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    queue = JobQueue(store, workers=4)
-    runner = build_cron_runner(store, queue)
+    queue = env.queue
+    runner = env.cron_runner
     base = f"http://127.0.0.1:{actual_port}"
     log(f"service up at {base}")
 

@@ -303,6 +303,10 @@ class _FakeOktaState:
         self.codes: dict = {}
         #: access token → userinfo claims served at /v1/userinfo
         self.userinfo: dict = {}
+        #: code → redirect_uri the token endpoint must see for that code
+        #: (RFC 6749 §4.1.3: the exchange's redirect_uri must match the
+        #: authorize leg's for THIS login — how a real issuer behaves)
+        self.expected_redirects: dict = {}
         #: answers for /v1/keys; tests can blank it to simulate JWKS loss
         self.jwks = {
             "keys": [
@@ -380,10 +384,16 @@ def okta_idp():
             # RFC 6749 §4.1.3: real issuers reject a token request whose
             # redirect_uri does not match the authorize request's — an
             # empty one is always invalid_grant (pins the regression
-            # where the loader-built client sent "")
-            if not form.get("redirect_uri", [""])[0]:
+            # where the loader-built client sent ""), and a per-code
+            # binding rejects a DIFFERENT login's callback (pins the
+            # shared-client-state poisoning regression)
+            redirect = form.get("redirect_uri", [""])[0]
+            if not redirect:
                 return self._json(400, {"error": "invalid_grant"})
             code = form.get("code", [""])[0]
+            expected = state.expected_redirects.get(code)
+            if expected is not None and redirect != expected:
+                return self._json(400, {"error": "invalid_grant"})
             if code not in state.codes:
                 return self._json(400, {"error": "invalid_grant"})
             return self._json(200, state.codes[code])
@@ -527,6 +537,11 @@ class TestOidcContract:
         state, base = okta_idp
         state.add_code("c1", {"email": "a@example.com"})
         state.add_code("c2", {"email": "b@example.com"})
+        # the issuer binds each code to ITS authorize leg's callback —
+        # an exchange carrying the other login's callback is rejected,
+        # so shared-client-state poisoning cannot pass this test
+        state.expected_redirects["c1"] = "https://evg.example/cb-one"
+        state.expected_redirects["c2"] = "https://attacker.example/cb-two"
         store = Store()
         mgr = OktaUserManager(
             "oidc-cid", "oidc-secret", base, client=_oidc_client(base)
