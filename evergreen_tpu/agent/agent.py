@@ -131,10 +131,22 @@ class Agent:
 
         import threading as _threading
 
+        from .platform import shim_for_arch
+
         abort_event = _threading.Event()
+        # the distro's arch selects the execution-platform shim (shell
+        # invocation, binary fixup, shell-facing path translation) and
+        # surfaces as expansions task YAML can branch on
+        shim = shim_for_arch(cfg.distro_arch)
+        expansions = Expansions(cfg.expansions)
+        for k, v in shim.platform_expansions().items():
+            # project/task expansions win: a YAML matrix variable named
+            # "os" must not be clobbered by the platform defaults
+            if not expansions.get(k):
+                expansions.put(k, v)
         ctx = CommandContext(
             work_dir=task_dir,
-            expansions=Expansions(cfg.expansions),
+            expansions=expansions,
             task_id=task.id,
             task_name=task.display_name,
             project=task.project,
@@ -143,6 +155,7 @@ class Agent:
             idle_timeout_s=cfg.idle_timeout_s,
             abort_event=abort_event,
             comm=self.comm,
+            platform=shim,
         )
 
         status = TaskStatus.SUCCEEDED.value

@@ -41,6 +41,9 @@ class TaskConfig:
     idle_timeout_s: float = 0.0
     pre_error_fails_task: bool = False
     post_error_fails_task: bool = False
+    #: the distro's execution platform (reference distro.Arch, e.g.
+    #: "windows_amd64") — selects the command layer's PlatformShim
+    distro_arch: str = ""
 
 
 class Communicator(abc.ABC):
@@ -96,10 +99,19 @@ class LocalCommunicator(Communicator):
             return None
         return assign_next_available_task(self.store, self.svc, host)
 
+    def _distro_arch(self, task: Task) -> str:
+        from ..models import distro as distro_mod
+
+        d = distro_mod.get(self.store, task.distro_id)
+        return d.arch if d is not None else ""
+
     def get_task_config(self, task: Task, host_id: str = "") -> TaskConfig:
         doc = self.store.collection(PARSER_PROJECTS_COLLECTION).get(task.version)
         if doc is None:
-            return TaskConfig(task=task, commands=[])
+            return TaskConfig(
+                task=task, commands=[],
+                distro_arch=self._distro_arch(task),
+            )
         task_def = doc.get("tasks", {}).get(task.display_name, {})
         expansions = dict(doc.get("expansions", {}))
         expansions.update(
@@ -157,6 +169,7 @@ class LocalCommunicator(Communicator):
             idle_timeout_s=float(task_def.get("timeout_secs", 0) or 0),
             pre_error_fails_task=bool(doc.get("pre_error_fails_task", False)),
             post_error_fails_task=bool(doc.get("post_error_fails_task", False)),
+            distro_arch=self._distro_arch(task),
         )
 
     def start_task(self, task_id: str) -> None:

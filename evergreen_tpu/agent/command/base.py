@@ -86,6 +86,33 @@ class CommandContext:
     #: the agent's communicator — commands that consult the server
     #: (test_selection.get) use it; None in bare command tests
     comm: Any = None
+    #: execution-platform shim from the distro's arch (agent/platform.py):
+    #: shell selection, binary fixup, shell-facing path translation
+    platform: Any = None
+
+    @property
+    def shim(self):
+        """The platform shim, defaulting to the POSIX profile."""
+        if self.platform is None:
+            from ..platform import PlatformShim
+
+            self.platform = PlatformShim()
+        return self.platform
+
+
+def shim_of(ctx) -> Any:
+    """Platform shim for any context object — real CommandContext or a
+    test double without the field — defaulting to the POSIX profile."""
+    shim = getattr(ctx, "platform", None)
+    if shim is None:
+        from ..platform import PlatformShim
+
+        shim = PlatformShim()
+        try:
+            ctx.platform = shim
+        except (AttributeError, TypeError):
+            pass
+    return shim
 
 
 class Command(abc.ABC):

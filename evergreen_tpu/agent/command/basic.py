@@ -17,6 +17,7 @@ from .base import (
     CommandContext,
     CommandResult,
     register_command,
+    shim_of,
 )
 
 
@@ -124,7 +125,10 @@ class ShellExec(Command):
     def execute(self, ctx: CommandContext) -> CommandResult:
         params = ctx.expansions.expand_any(self.params)
         script = params.get("script", "")
-        shell = params.get("shell", "bash")
+        # shell selection + invocation form are platform decisions
+        # (reference shell.go: ``shell`` param, per-OS invocation;
+        # Windows profiles route cmd/powershell/cygwin-bash correctly)
+        shell = params.get("shell", "") or shim_of(ctx).default_shell
         working_dir = os.path.join(ctx.work_dir, params.get("working_dir", ""))
         env = dict(os.environ)
         env.update({k: str(v) for k, v in params.get("env", {}).items()})
@@ -133,7 +137,7 @@ class ShellExec(Command):
 
         os.makedirs(working_dir, exist_ok=True)
         code, out, err = run_process(
-            ctx, [shell, "-c", script], working_dir, env,
+            ctx, shim_of(ctx).shell_argv(shell, script), working_dir, env,
             timeout_s=ctx.exec_timeout_s,
             idle_timeout_s=ctx.idle_timeout_s,
         )
@@ -163,7 +167,9 @@ class SubprocessExec(Command):
 
     def execute(self, ctx: CommandContext) -> CommandResult:
         params = ctx.expansions.expand_any(self.params)
-        binary = params.get("binary", "")
+        # Windows profiles append .exe to bare binary names (reference
+        # exec.go:370 path handling)
+        binary = shim_of(ctx).resolve_binary(params.get("binary", ""))
         args = [str(a) for a in params.get("args", [])]
         working_dir = os.path.join(ctx.work_dir, params.get("working_dir", ""))
         env = dict(os.environ)

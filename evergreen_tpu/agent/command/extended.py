@@ -17,10 +17,21 @@ import time as _time
 import zipfile
 from typing import Any, Dict, List
 
-from .base import Command, CommandContext, CommandResult, register_command
+from .base import (Command, CommandContext, CommandResult,
+                   register_command, shim_of)
 
 
 def _resolve(ctx: CommandContext, rel: str) -> str:
+    """Join a command param path onto the task dir. Params written in
+    cygwin style on a Windows profile (YAML shared with bash steps)
+    normalize to the native form first (agent/platform.py; POSIX
+    profiles are identity); absoluteness follows the PROFILE's rules,
+    not the host's (a drive-qualified path must not be joined under
+    the task dir just because the test host is POSIX)."""
+    shim = shim_of(ctx)
+    rel = shim.to_native(rel)
+    if shim.is_abs(rel):
+        return rel
     return os.path.normpath(os.path.join(ctx.work_dir, rel))
 
 
@@ -343,9 +354,16 @@ class GitGetProject(Command):
                 error="git.get_project: no origin configured "
                       "(set the git_origin expansion)",
             )
-        cmds = [["git", "clone", origin, directory]]
+        # git is exec'd DIRECTLY (no shell), so the directory on its
+        # argv takes the platform's native-tool form: forward-slashed
+        # drive paths on a Windows profile (native git accepts C:/x/y;
+        # reference git.go normalizes the same way), identity on POSIX.
+        # GitApplyPatch resolves the same param through the same helper,
+        # so clone and apply always target one directory.
+        git_dir = shim_of(ctx).command_path(directory)
+        cmds = [["git", "clone", origin, git_dir]]
         if revision:
-            cmds.append(["git", "-C", directory, "checkout", revision])
+            cmds.append(["git", "-C", git_dir, "checkout", revision])
         for cmd in cmds:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
@@ -364,7 +382,7 @@ class GitApplyPatch(Command):
 
     def execute(self, ctx: CommandContext) -> CommandResult:
         p = ctx.expansions.expand_any(self.params)
-        directory = _resolve(ctx, p.get("directory", "src"))
+        directory = shim_of(ctx).command_path(_resolve(ctx, p.get("directory", "src")))
         diff = ctx.artifacts.get("patch_diff") or ctx.expansions.get("patch_diff")
         if not diff:
             return CommandResult()  # no patch staged (mainline build)

@@ -1,0 +1,171 @@
+"""Per-distro execution-platform shim for agent commands.
+
+Reference: the agent is multiplatform (README.md:12-36) — Windows
+behavior branches through the agent tree keyed on the distro's arch
+(``distro.Arch`` e.g. ``windows_amd64``): shell selection for script
+commands (agent/command/shell.go — the ``shell`` param defaults to
+``sh``; Windows distros run bash-under-cygwin or powershell), binary
+path handling (agent/command/exec.go:370 treats ``/`` as a path
+separator on Windows too), cygwin-style path translation for the
+command lines handed to a bash shell on a Windows host, and
+process-tree cleanup via job objects (agent/util/subtree_windows.go).
+
+Here the seam is one object: ``PlatformShim`` resolved from the
+distro's arch, consulted by every command that builds an argv or hands
+a path to a shell. The pure selection/translation logic is fully
+testable under a simulated Windows profile on any host; execution
+still goes through command/basic.run_process.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+
+#: arches the reference ships agents for (distro settings page)
+KNOWN_ARCHES = (
+    "linux_amd64", "linux_arm64", "linux_s390x", "linux_ppc64le",
+    "osx_amd64", "osx_arm64",
+    "windows_amd64",
+)
+
+_DRIVE_RE = re.compile(r"^([A-Za-z]):[\\/]")
+_CYGDRIVE_RE = re.compile(r"^/cygdrive/([A-Za-z])(/|$)")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlatformShim:
+    """Execution-platform profile for one distro."""
+
+    arch: str = "linux_amd64"
+
+    @property
+    def goos(self) -> str:
+        return self.arch.split("_", 1)[0]
+
+    @property
+    def is_windows(self) -> bool:
+        return self.goos == "windows"
+
+    # -- shell selection -------------------------------------------------- #
+
+    @property
+    def default_shell(self) -> str:
+        """shell.exec default when the YAML names none (reference
+        shell.go:103 defaults to ``sh``; Windows distros conventionally
+        run bash under cygwin — the reference's own CI does)."""
+        return "bash"
+
+    def shell_argv(self, shell: str, script: str) -> list:
+        """The argv a script command runs (reference shell.go:166
+        ``Append(c.Shell)`` + jasper's per-OS invocation).
+
+        POSIX shells take ``-c``; Windows cmd takes ``/C``; powershell
+        takes -NoProfile -NonInteractive -Command. A POSIX-named shell
+        on a Windows profile is cygwin/git-bash — same ``-c`` form."""
+        shell = shell or self.default_shell
+        if self.is_windows:
+            name = shell.lower()
+            if name in ("cmd", "cmd.exe"):
+                return ["cmd.exe", "/C", script]
+            if name in ("powershell", "powershell.exe", "pwsh",
+                        "pwsh.exe"):
+                exe = "pwsh.exe" if name.startswith("pwsh") else (
+                    "powershell.exe"
+                )
+                return [exe, "-NoProfile", "-NonInteractive", "-Command",
+                        script]
+            # POSIX-named shell under cygwin/git-bash: same -c form
+            return [shell, "-c", script]
+        return [shell, "-c", script]
+
+    # -- binary resolution ------------------------------------------------ #
+
+    def resolve_binary(self, binary: str) -> str:
+        """subprocess.exec binary fixup: Windows binaries named without
+        an extension get ``.exe`` appended when they look like bare
+        names or file paths (reference exec.go:370 treats ``/`` as a
+        separator on Windows too)."""
+        if not self.is_windows or not binary:
+            return binary
+        last = binary.replace("\\", "/").rsplit("/", 1)[-1]
+        if "." in last:
+            return binary
+        return binary + ".exe"
+
+    # -- path translation -------------------------------------------------- #
+
+    def to_shell(self, path: str, shell: str = "") -> str:
+        """Translate a native path into what the executing SHELL expects
+        on this platform. On a Windows host running a POSIX-named shell
+        (cygwin/git-bash), ``C:\\data\\mci`` becomes
+        ``/cygdrive/c/data/mci``; cmd/powershell take native backslash
+        paths; POSIX hosts are identity."""
+        if not self.is_windows:
+            return path
+        name = (shell or self.default_shell).lower()
+        if name in ("cmd", "cmd.exe", "powershell", "powershell.exe",
+                    "pwsh", "pwsh.exe"):
+            return self.to_native(path)
+        m = _DRIVE_RE.match(path)
+        if m:
+            rest = path[3:].replace("\\", "/")
+            return f"/cygdrive/{m.group(1).lower()}/{rest}"
+        return path.replace("\\", "/")
+
+    def to_native(self, path: str) -> str:
+        """Translate a cygwin-style path back to the platform-native
+        form (``/cygdrive/c/x`` → ``c:\\x`` on Windows; identity
+        elsewhere)."""
+        if not self.is_windows:
+            return path
+        m = _CYGDRIVE_RE.match(path)
+        if m:
+            rest = path[len(m.group(0)):].replace("/", "\\")
+            return f"{m.group(1).lower()}:\\{rest}"
+        if not path.startswith("/"):
+            # relative or drive-qualified: forward slashes are legal on
+            # Windows but normalize for consistency
+            return path.replace("/", "\\")
+        # a bare absolute POSIX path has no drive mapping to translate
+        return path
+
+    def command_path(self, path: str) -> str:
+        """Path form for a DIRECTLY-exec'd native tool's argv (git,
+        tar, …): native drive form with forward slashes on Windows —
+        native Windows binaries accept ``C:/x/y`` and it stays stable
+        whether the param arrived cygwin-style or backslashed; POSIX is
+        identity. (Paths handed to a SHELL line go through
+        ``to_shell`` instead.)"""
+        if not self.is_windows:
+            return path
+        return self.to_native(path).replace("\\", "/")
+
+    def is_abs(self, path: str) -> bool:
+        """Platform-aware absoluteness: a drive-qualified or UNC path is
+        absolute on a Windows profile even when this agent test-runs on
+        a POSIX host (os.path follows the HOST's rules, not the
+        profile's)."""
+        if self.is_windows:
+            return bool(
+                _DRIVE_RE.match(path)
+                or path.startswith("\\\\")
+                or path.startswith("/")
+            )
+        import os.path as _osp
+
+        return _osp.isabs(path)
+
+    # -- expansions -------------------------------------------------------- #
+
+    def platform_expansions(self) -> dict:
+        """Expansions every task sees (the reference exposes distro arch
+        to task YAML; scripts branch on them)."""
+        return {
+            "distro_arch": self.arch,
+            "os": self.goos,
+            "is_windows": "true" if self.is_windows else "false",
+        }
+
+
+def shim_for_arch(arch: str) -> PlatformShim:
+    return PlatformShim(arch=arch or "linux_amd64")

@@ -97,6 +97,7 @@ class RestCommunicator(Communicator):
             idle_timeout_s=float(cfg.get("idle_timeout_s", 0) or 0),
             pre_error_fails_task=bool(cfg.get("pre_error_fails_task", False)),
             post_error_fails_task=bool(cfg.get("post_error_fails_task", False)),
+            distro_arch=cfg.get("distro_arch", ""),
         )
 
     def start_task(self, task_id: str) -> None:

@@ -904,6 +904,7 @@ class RestApi:
             "idle_timeout_s": cfg.idle_timeout_s,
             "pre_error_fails_task": cfg.pre_error_fails_task,
             "post_error_fails_task": cfg.post_error_fails_task,
+            "distro_arch": cfg.distro_arch,
         }
 
     def start_task(self, method, match, body):

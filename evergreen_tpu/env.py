@@ -169,7 +169,10 @@ class Environment:
             from .storage.migrations import apply_migrations
 
             for name, result in apply_migrations(store):
-                print(f"migration {name}: {result}")
+                # quiet for caller-supplied stores (the smoke harness
+                # owns its own verbosity)
+                if not env_store_supplied:
+                    print(f"migration {name}: {result}")
 
         # structured logging plane: JSON lines + capped in-store ring.
         # ONLY when this build owns the process's writable global store:
