@@ -87,17 +87,10 @@ class CommandContext:
     #: (test_selection.get) use it; None in bare command tests
     comm: Any = None
     #: execution-platform shim from the distro's arch (agent/platform.py):
-    #: shell selection, binary fixup, shell-facing path translation
+    #: shell selection, binary fixup, shell-facing path translation —
+    #: read through module-level shim_of(), which also handles duck-typed
+    #: test contexts
     platform: Any = None
-
-    @property
-    def shim(self):
-        """The platform shim, defaulting to the POSIX profile."""
-        if self.platform is None:
-            from ..platform import PlatformShim
-
-            self.platform = PlatformShim()
-        return self.platform
 
 
 def shim_of(ctx) -> Any:

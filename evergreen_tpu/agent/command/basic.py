@@ -129,10 +129,18 @@ class ShellExec(Command):
         # (reference shell.go: ``shell`` param, per-OS invocation;
         # Windows profiles route cmd/powershell/cygwin-bash correctly)
         shell = params.get("shell", "") or shim_of(ctx).default_shell
-        working_dir = os.path.join(ctx.work_dir, params.get("working_dir", ""))
+        sub_dir = params.get("working_dir", "")
+        working_dir = (
+            os.path.join(ctx.work_dir, sub_dir) if sub_dir else ctx.work_dir
+        )
         env = dict(os.environ)
         env.update({k: str(v) for k, v in params.get("env", {}).items()})
         env.setdefault("EVR_TASK_ID", ctx.task_id)
+        # the working dir as THIS shell sees it: cygwin form for a
+        # POSIX-named shell on a Windows profile, native for cmd/
+        # powershell, identity on POSIX — scripts use $EVG_WORKDIR for
+        # paths they hand to further shell commands
+        env["EVG_WORKDIR"] = shim_of(ctx).to_shell(working_dir, shell)
         continue_on_err = bool(params.get("continue_on_err", False))
 
         os.makedirs(working_dir, exist_ok=True)
@@ -171,7 +179,10 @@ class SubprocessExec(Command):
         # exec.go:370 path handling)
         binary = shim_of(ctx).resolve_binary(params.get("binary", ""))
         args = [str(a) for a in params.get("args", [])]
-        working_dir = os.path.join(ctx.work_dir, params.get("working_dir", ""))
+        sub_dir = params.get("working_dir", "")
+        working_dir = (
+            os.path.join(ctx.work_dir, sub_dir) if sub_dir else ctx.work_dir
+        )
         env = dict(os.environ)
         env.update({k: str(v) for k, v in params.get("env", {}).items()})
         os.makedirs(working_dir, exist_ok=True)

@@ -1267,11 +1267,35 @@ class GraphQLApi(SpruceOpsMixin):
         if ref is None:
             raise GraphQLError(f"project {projectId!r} not found")
         if projectRef:
-            known = set(ref)
-            updates = {
-                k: v for k, v in dict(projectRef).items()
-                if k in known and k != "_id"
+            # the writable field set comes from the ProjectRef MODEL,
+            # not from whatever keys the stored doc happens to carry —
+            # a minimally-created project must still accept every
+            # settings field (its doc starts without most keys). Values
+            # are type-checked against the dataclass before the write:
+            # client JSON must never poison the stored doc (the same
+            # stance _m_save_distro takes), and `enabled: ""` silently
+            # disabling a project is exactly the bug class this blocks.
+            import dataclasses as _dc
+
+            from ..ingestion.repotracker import ProjectRef
+
+            types = {
+                f.name: f.type for f in _dc.fields(ProjectRef)
+                if f.name != "id"
             }
+            check = {"str": str, "bool": bool, "int": int,
+                     "float": (int, float)}
+            updates = {}
+            for k, v in dict(projectRef).items():
+                if k not in types:
+                    continue
+                expected = check.get(str(types[k]))
+                if expected is not None and not isinstance(v, expected):
+                    raise GraphQLError(
+                        f"field {k!r} expects {types[k]}, got "
+                        f"{type(v).__name__}"
+                    )
+                updates[k] = v
             if updates:
                 coll.update(projectId, updates)
         if vars is not None:

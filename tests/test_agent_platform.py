@@ -233,3 +233,23 @@ def test_distro_arch_reaches_the_command_context(store):
     shim = shim_for_arch(cfg.distro_arch)
     assert shim.is_windows
     assert shim.platform_expansions()["is_windows"] == "true"
+
+
+def test_shell_exec_exports_shell_facing_workdir(tmp_path, captured_argv,
+                                                 monkeypatch):
+    """$EVG_WORKDIR carries the working dir in the executing SHELL's
+    path form: cygwin-style for bash on a Windows profile."""
+    captured_env = {}
+
+    def fake_run_process(ctx, argv, working_dir, env, **kw):
+        captured_env.update(env)
+        return 0, "", ""
+
+    monkeypatch.setattr(basic_mod, "run_process", fake_run_process)
+    lines = []
+    ctx = CommandContext(
+        work_dir="C:\\data\\mci\\t9", expansions=Expansions({}),
+        task_id="t9", log=lines.append, platform=WIN,
+    )
+    get_command("shell.exec", {"script": "ls $EVG_WORKDIR"}).execute(ctx)
+    assert captured_env["EVG_WORKDIR"] == "/cygdrive/c/data/mci/t9"

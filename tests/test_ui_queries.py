@@ -87,12 +87,43 @@ def _first_argument(arg: str) -> str:
     return arg
 
 
+#: realistic fills per input type, shaped so every mutation document in
+#: the page EXECUTES cleanly against the seeded store in source order
+#: (e.g. CopyDistroInput creates x-copy before DeleteDistroInput removes
+#: it; the spawn-host fills target the seeded stopped spawn host sh1)
+INPUT_FILLS = {
+    "SpawnHostInput": {"distroId": "x"},
+    "EditSpawnHostInput": {"hostId": "sh1"},
+    "UpdateSpawnHostStatusInput": {"hostId": "sh1", "action": "START"},
+    "SpawnVolumeInput": {"size": 8},
+    "UpdateVolumeInput": {"volumeId": "vol-free", "name": "renamed"},
+    "VolumeHost": {"volumeId": "vol-att", "hostId": "sh1"},
+    "CreateDistroInput": {"newDistroId": "brand-new-distro"},
+    "CopyDistroInput": {"distroIdToCopy": "x", "newDistroId": "x-copy"},
+    "DeleteDistroInput": {"distroId": "x-copy"},
+    "SaveDistroInput": {"onSave": "NONE", "distro": {"id": "x"}},
+    "SubscriptionInput": {
+        "resourceType": "TASK", "trigger": "TASK_FAILED",
+        "subscriber": {"type": "email", "target": "a@x.com"},
+        "selectors": [],
+    },
+    "PublicKeyInput": {"name": "x",
+                       "key": "ssh-ed25519 AAAAC3NzaTESTKEY admin@host"},
+    "RestartAdminTasksOptions": {
+        "startTime": 0.0, "endTime": 9e9, "includeSystemFailed": True,
+        "includeTestFailed": False, "includeSetupFailed": False,
+    },
+    "ProjectSettingsInput": {"projectRef": {"id": "x"}},
+}
+
+
 def dummy_variables(query: str):
     fills = {"String": "x", "ID": "x", "Int": 1, "Float": 1.0,
              "Boolean": True}
     out = {}
     for name, typ in re.findall(r"\$(\w+)\s*:\s*\[?(\w+)", query):
-        filled = fills.get(typ, {})  # input objects fill as {}
+        # input objects fill from the realistic table ({} when unlisted)
+        filled = fills.get(typ, INPUT_FILLS.get(typ, {}))
         # list-typed variables coerce single values per the spec
         out[name] = filled
     return out
@@ -129,6 +160,22 @@ def seeded_store():
     store.collection("task_logs").insert(
         {"_id": "x", "lines": ["hello", "[agent] hi", "[system] sys"]}
     )
+    # spawn page fixtures: a stopped spawn host owned by the acting
+    # admin plus one attached / one detached / one resizable volume —
+    # the spawn-page mutation documents run against these
+    host_mod.insert(
+        store,
+        Host(id="sh1", distro_id="x", provider="mock", status="stopped",
+             user_host=True, started_by="admin"),
+    )
+    from evergreen_tpu.cloud.volumes import Volume
+
+    for vol in (
+        Volume(id="x", created_by="admin", size_gb=8, host_id="sh1"),
+        Volume(id="vol-att", created_by="admin", size_gb=8),
+        Volume(id="vol-free", created_by="admin", size_gb=8),
+    ):
+        store.collection("volumes").insert(vol.to_doc())
     return store
 
 
@@ -160,3 +207,216 @@ def test_patches_list_resolves_ids(seeded_store):
     gql = GraphQLApi(seeded_store)
     out = gql.execute("{ patches(limit: 30) { id project status } }")
     assert out["data"]["patches"][0]["id"] == "x"
+
+
+# --------------------------------------------------------------------------- #
+# Round-5 UI wiring (VERDICT r4 ask #3): the breadth-tier mutations the
+# new pages call, exercised end-to-end with REAL variables — the store
+# must reflect each page action.
+# --------------------------------------------------------------------------- #
+
+
+def _admin_gql(store):
+    user_mod.create_user(store, "admin")
+    user_mod.grant_role(store, "admin", "superuser")
+    return GraphQLApi(store, acting_user="admin")
+
+
+def _page_has(fragment: str) -> None:
+    assert fragment in PAGE, f"UI page lost its {fragment!r} wiring"
+
+
+def test_spawn_page_flow_end_to_end(seeded_store):
+    gql = _admin_gql(seeded_store)
+
+    def run(q, v):
+        out = gql.execute(q, v)
+        assert "errors" not in out, out.get("errors")
+        return out["data"]
+
+    # spawn a host exactly as the page's button does
+    _page_has("spawnHost(spawnHostInput: $in)")
+    host = run(
+        "mutation SH($in: SpawnHostInput) "
+        "{ spawnHost(spawnHostInput: $in) { id status } }",
+        {"in": {"distroId": "x", "userId": "admin"}},
+    )["spawnHost"]
+    # stop → start → edit instance type, via updateSpawnHostStatus /
+    # editSpawnHost
+    _page_has("updateSpawnHostStatus(updateSpawnHostStatusInput: $in)")
+    host_mod.coll(seeded_store).update(host["id"], {"status": "running"})
+    run(
+        "mutation US($in: UpdateSpawnHostStatusInput) "
+        "{ updateSpawnHostStatus(updateSpawnHostStatusInput: $in) "
+        "{ id } }",
+        {"in": {"hostId": host["id"], "action": "STOP"}},
+    )
+    assert host_mod.get(seeded_store, host["id"]).status in (
+        "stopping", "stopped"
+    )
+    _page_has("editSpawnHost(spawnHost: $in)")
+    run(
+        "mutation ES($in: EditSpawnHostInput) "
+        "{ editSpawnHost(spawnHost: $in) { id } }",
+        {"in": {"hostId": host["id"], "instanceType": "m7g.large",
+                "displayName": "workbox"}},
+    )
+    doc = host_mod.coll(seeded_store).get(host["id"])
+    assert doc["instance_type"] == "m7g.large"
+    assert doc["display_name"] == "workbox"
+    # volume lifecycle: create → attach → detach → remove
+    _page_has("spawnVolume(spawnVolumeInput: $in)")
+    run("mutation CV($in: SpawnVolumeInput!) "
+        "{ spawnVolume(spawnVolumeInput: $in) }", {"in": {"size": 16}})
+    vols = seeded_store.collection("volumes").find(
+        lambda d: d.get("size_gb") == 16
+    )
+    assert len(vols) == 1
+    vid = vols[0]["_id"]
+    run("mutation AV($in: VolumeHost!) "
+        "{ attachVolumeToHost(volumeAndHost: $in) }",
+        {"in": {"volumeId": vid, "hostId": host["id"]}})
+    assert seeded_store.collection("volumes").get(vid)["host_id"] == host["id"]
+    run("mutation DV($id: String!) { detachVolumeFromHost(volumeId: $id) }",
+        {"id": vid})
+    run("mutation RV($id: String!) { removeVolume(volumeId: $id) }",
+        {"id": vid})
+    assert seeded_store.collection("volumes").get(vid) is None
+
+
+def test_distro_editor_flow_end_to_end(seeded_store):
+    gql = _admin_gql(seeded_store)
+
+    def run(q, v):
+        out = gql.execute(q, v)
+        assert "errors" not in out, out.get("errors")
+        return out["data"]
+
+    _page_has("saveDistro(opts: $o)")
+    run(
+        "mutation SD($o: SaveDistroInput!) { saveDistro(opts: $o) "
+        "{ hostCount } }",
+        {"o": {"onSave": "NONE", "distro": {
+            "id": "x", "arch": "windows_amd64",
+            "host_allocator_settings": {"minimum_hosts": 2,
+                                        "maximum_hosts": 40},
+        }}},
+    )
+    d = distro_mod.get(seeded_store, "x")
+    assert d.arch == "windows_amd64"
+    assert d.host_allocator_settings.maximum_hosts == 40
+    _page_has("copyDistro(opts: $o)")
+    run("mutation CD($o: CopyDistroInput!) { copyDistro(opts: $o) "
+        "{ newDistroId } }",
+        {"o": {"distroIdToCopy": "x", "newDistroId": "x-dup"}})
+    dup = distro_mod.get(seeded_store, "x-dup")
+    assert dup is not None and dup.arch == "windows_amd64"
+    _page_has("deleteDistro(opts: $o)")
+    run("mutation DD($o: DeleteDistroInput!) { deleteDistro(opts: $o) "
+        "{ deletedDistroId } }", {"o": {"distroId": "x-dup"}})
+    assert distro_mod.get(seeded_store, "x-dup") is None
+
+
+def test_project_settings_flow_end_to_end(seeded_store):
+    gql = _admin_gql(seeded_store)
+
+    def run(q, v=None):
+        out = gql.execute(q, v or {})
+        assert "errors" not in out, out.get("errors")
+        return out["data"]
+
+    _page_has('saveProjectSettingsForSection(projectSettings: $ps')
+    run(
+        "mutation SG($ps: ProjectSettingsInput) "
+        "{ saveProjectSettingsForSection(projectSettings: $ps, "
+        'section: "GENERAL") { projectRef } }',
+        {"ps": {"projectRef": {"id": "x", "batch_time_minutes": 45,
+                               "stepback_bisect": True}}},
+    )
+    ref = seeded_store.collection("project_refs").get("x")
+    assert ref["batch_time_minutes"] == 45 and ref["stepback_bisect"]
+    _page_has("forceRepotrackerRun(projectId: $id)")
+    run("mutation FR($id: String!) { forceRepotrackerRun(projectId: $id) }",
+        {"id": "x"})
+    # subscriptions add + delete round-trip through the page's documents
+    _page_has("saveSubscription(")
+    run(
+        "mutation SS($s: SubscriptionInput!) "
+        "{ saveSubscription(subscription: $s) }",
+        {"s": {"resourceType": "TASK", "trigger": "TASK_FAILED",
+               "subscriber": {"type": "slack", "target": "#ops"},
+               "selectors": [{"type": "project", "data": "x"}]}},
+    )
+    subs = seeded_store.collection("subscriptions").find(
+        lambda d: d.get("subscriber_target") == "#ops"
+    )
+    assert len(subs) == 1
+    _page_has("deleteSubscriptions(subscriptionIds: $ids)")
+    out = run(
+        "mutation DS($ids: [String!]!) "
+        "{ deleteSubscriptions(subscriptionIds: $ids) }",
+        {"ids": [subs[0]["_id"]]},
+    )
+    assert out["deleteSubscriptions"] == 1
+
+
+def test_admin_and_keys_flow_end_to_end(seeded_store):
+    gql = _admin_gql(seeded_store)
+
+    def run(q, v=None):
+        out = gql.execute(q, v or {})
+        assert "errors" not in out, out.get("errors")
+        return out["data"]
+
+    # generic section editor: the page loads a section's JSON, edits it,
+    # and saves through saveAdminSettings
+    _page_has("saveAdminSettings(adminSettings: $s)")
+    run("mutation SA($s: JSON!) { saveAdminSettings(adminSettings: $s) }",
+        {"s": {"scheduler": {"target_time_seconds": 99}}})
+    from evergreen_tpu.settings import SchedulerConfig
+
+    assert SchedulerConfig.get(seeded_store).target_time_seconds == 99
+    _page_has("restartAdminTasks(opts: $o)")
+    out = run(
+        "mutation RA($o: RestartAdminTasksOptions!) "
+        "{ restartAdminTasks(opts: $o) { numRestartedTasks } }",
+        {"o": {"startTime": 0.0, "endTime": 9e9,
+               "includeSystemFailed": True, "includeTestFailed": False,
+               "includeSetupFailed": False}},
+    )
+    assert out["restartAdminTasks"]["numRestartedTasks"] >= 0
+    # keys page: create → update → remove
+    _page_has("createPublicKey(publicKeyInput: $in)")
+    run("mutation CK($in: PublicKeyInput!) "
+        "{ createPublicKey(publicKeyInput: $in) { name } }",
+        {"in": {"name": "laptop", "key": "ssh-ed25519 AAAATEST me@box"}})
+    _page_has("updatePublicKey(targetKeyName: $t")
+    run("mutation UK($t: String!, $u: PublicKeyInput!) "
+        "{ updatePublicKey(targetKeyName: $t, updateInfo: $u) { name } }",
+        {"t": "laptop", "u": {"name": "laptop",
+                              "key": "ssh-ed25519 AAAANEW me@box"}})
+    keys = run("{ myPublicKeys { name key } }")["myPublicKeys"]
+    assert any(k["name"] == "laptop" and "AAAANEW" in k["key"]
+               for k in keys)
+    _page_has("removePublicKey(keyName: $n)")
+    run("mutation RK($n: String!) { removePublicKey(keyName: $n) "
+        "{ name } }", {"n": "laptop"})
+    assert all(
+        k["name"] != "laptop"
+        for k in run("{ myPublicKeys { name key } }")["myPublicKeys"]
+    )
+
+
+def test_project_settings_rejects_ill_typed_fields(seeded_store):
+    """Client JSON must not poison project_refs: a string for a bool
+    field (the `enabled: ""` silent-disable bug class) errors instead
+    of writing."""
+    gql = _admin_gql(seeded_store)
+    out = gql.execute(
+        "mutation SG($ps: ProjectSettingsInput) "
+        "{ saveProjectSettingsForSection(projectSettings: $ps, "
+        'section: "GENERAL") { projectRef } }',
+        {"ps": {"projectRef": {"id": "x", "enabled": ""}}},
+    )
+    assert "errors" in out and "expects" in out["errors"][0]["message"]
+    assert seeded_store.collection("project_refs").get("x")["enabled"] is True
