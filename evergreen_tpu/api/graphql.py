@@ -1290,7 +1290,14 @@ class GraphQLApi(SpruceOpsMixin):
                 if k not in types:
                     continue
                 expected = check.get(str(types[k]))
-                if expected is not None and not isinstance(v, expected):
+                ill_typed = expected is not None and (
+                    not isinstance(v, expected)
+                    # bool IS an int subclass — reject it explicitly for
+                    # numeric fields or `true` lands in batch_time
+                    or (str(types[k]) in ("int", "float")
+                        and isinstance(v, bool))
+                )
+                if ill_typed:
                     raise GraphQLError(
                         f"field {k!r} expects {types[k]}, got "
                         f"{type(v).__name__}"

@@ -668,7 +668,9 @@ async function spawnView() {
 // -- distro editor (Spruce distro settings; saveDistro/copyDistro/
 //    deleteDistro made user-reachable) ---------------------------------- //
 async function distroView(did) {
-  const d = await j(`/rest/v2/distros/${did}`);
+  // j() throws on the REST 404 — catch it so the page renders the
+  // friendly message instead of the generic route() error
+  const d = await j(`/rest/v2/distros/${did}`).catch(() => null);
   if (!d) return [el("p", { class: "failed" }, `distro ${did} not found`)];
   const ps = d.planner_settings || {};
   const has = d.host_allocator_settings || {};

@@ -235,7 +235,7 @@ def test_distro_arch_reaches_the_command_context(store):
     assert shim.platform_expansions()["is_windows"] == "true"
 
 
-def test_shell_exec_exports_shell_facing_workdir(tmp_path, captured_argv,
+def test_shell_exec_exports_shell_facing_workdir(captured_argv,
                                                  monkeypatch):
     """$EVG_WORKDIR carries the working dir in the executing SHELL's
     path form: cygwin-style for bash on a Windows profile."""
@@ -246,6 +246,10 @@ def test_shell_exec_exports_shell_facing_workdir(tmp_path, captured_argv,
         return 0, "", ""
 
     monkeypatch.setattr(basic_mod, "run_process", fake_run_process)
+    # the simulated drive path must not create a literal 'C:\...' dir
+    # in the POSIX cwd
+    monkeypatch.setattr(basic_mod.os, "makedirs",
+                        lambda *a, **k: None)
     lines = []
     ctx = CommandContext(
         work_dir="C:\\data\\mci\\t9", expansions=Expansions({}),
