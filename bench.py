@@ -158,20 +158,19 @@ def main() -> None:
     # --- dispatch-path scale check (next_task under concurrency) ----------- #
     dispatch = measure_dispatch()
 
-    result = {
-        "metric": "sched_tick_50k_tasks_200_distros",
-        "value": round(tpu_ms, 2),
-        "unit": "ms",
-        "vs_baseline": round(serial_ms / tpu_ms, 2),
-        "backend": _backend,
-        "sequential_tick_ms": round(seq_ms, 2),
-        "pipelined_tick_ms": round(pipe_med, 2),
-        "overlap_efficiency": round(overlap_eff, 3),
-        "overlap_proven": overlap_proven,
-        "churn_tick_ms": round(churn["churn_ms"], 2),
-        "store_steady_tick_ms": round(churn["store_steady_ms"], 2),
-        "probe_history": _probe_history,
-    }
+    from evergreen_tpu.utils.benchgen import bench_result_payload
+
+    result = bench_result_payload(
+        tpu_ms=tpu_ms,
+        serial_ms=serial_ms,
+        backend=_backend,
+        seq_ms=seq_ms,
+        pipe_med=pipe_med,
+        overlap_eff=overlap_eff,
+        overlap_proven=overlap_proven,
+        churn=churn,
+        probe_history=_probe_history,
+    )
     print(json.dumps(result))
     if _backend == "axon":
         write_tpu_evidence(result)

@@ -2,18 +2,20 @@
 
 Speaks the agent protocol over HTTP against the REST API (api/rest.py), the
 way the reference agent only ever talks to the app server through its
-retrying REST client (agent/internal/client/). Retries with backoff on
-transport errors.
+retrying REST client (agent/internal/client/). Transport errors retry
+under the shared RetryPolicy (utils/retry.py): bounded attempts, jittered
+exponential backoff, per-call deadline, and a retry-exhausted breadcrumb.
 """
 from __future__ import annotations
 
 import json
-import time as _time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
 from ..models.task import Task
+from ..utils import faults
+from ..utils.retry import RetryPolicy
 from .comm import Communicator, TaskConfig
 
 
@@ -21,10 +23,23 @@ class RestCommunicator(Communicator):
     def __init__(
         self, base_url: str, retries: int = 3, backoff_s: float = 0.2,
         host_id: str = "", host_secret: str = "",
+        call_deadline_s: float = 120.0,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.retries = retries
         self.backoff_s = backoff_s
+        self.policy = RetryPolicy(
+            attempts=retries,
+            base_backoff_s=backoff_s,
+            deadline_s=call_deadline_s or None,
+            # faults.FaultError counts as a transport failure so the
+            # agent.comm seam exercises THIS retry path whatever fault
+            # kind the plan/env spec chooses
+            retry_on=(
+                urllib.error.URLError, TimeoutError, ConnectionError,
+                faults.FaultError,
+            ),
+        )
         #: host credential sent on every call (reference: the agent's
         #: client attaches Host-Id/Host-Secret headers; the secret is
         #: handed to the agent at deploy time, never over the wire)
@@ -36,8 +51,9 @@ class RestCommunicator(Communicator):
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         url = f"{self.base_url}{path}"
         data = json.dumps(body or {}).encode() if method != "GET" else None
-        last_err: Optional[Exception] = None
-        for attempt in range(self.retries):
+
+        def attempt() -> dict:
+            faults.fire("agent.comm")
             headers = {"Content-Type": "application/json"}
             if self.host_id:
                 headers["Host-Id"] = self.host_id
@@ -50,17 +66,23 @@ class RestCommunicator(Communicator):
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
                 # 4xx/5xx with a JSON body is a protocol answer, not a
-                # transport failure
+                # transport failure — never retried
                 try:
                     payload = json.loads(e.read() or b"{}")
                 except json.JSONDecodeError:
                     payload = {"error": str(e)}
                 payload["_status"] = e.code
                 return payload
-            except (urllib.error.URLError, TimeoutError) as e:
-                last_err = e
-                _time.sleep(self.backoff_s * (2 ** attempt))
-        raise ConnectionError(f"agent->server call failed: {last_err}")
+
+        try:
+            return self.policy.call(
+                attempt, operation="agent-comm", component="agent"
+            )
+        except (
+            urllib.error.URLError, TimeoutError, ConnectionError,
+            faults.FaultError,
+        ) as e:
+            raise ConnectionError(f"agent->server call failed: {e}") from e
 
     # -- protocol ------------------------------------------------------------ #
 

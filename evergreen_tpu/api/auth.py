@@ -204,6 +204,17 @@ class NaiveUserManager(UserManager):
 # --------------------------------------------------------------------------- #
 
 
+class _NoRedirectHandler(urllib.request.HTTPRedirectHandler):
+    """Refuse to follow redirects: a 3xx surfaces as HTTPError so the
+    caller observes the actual status instead of the redirect target's."""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None
+
+
+_NO_REDIRECT_OPENER = urllib.request.build_opener(_NoRedirectHandler)
+
+
 def _http_json(
     method: str,
     url: str,
@@ -211,15 +222,24 @@ def _http_json(
     headers: Optional[Dict[str, str]],
     timeout_s: float,
     err_prefix: str,
+    follow_redirects: bool = True,
 ):
     """Shared IdP HTTP leg → (status, parsed-json-or-None). 4xx statuses
     are returned to the caller (they are protocol outcomes: bad code,
-    revoked token, not-a-member); transport failures raise AuthError."""
+    revoked token, not-a-member); transport failures raise AuthError.
+
+    ``follow_redirects=False`` installs a no-redirect opener so a 302 is
+    RETURNED as the status rather than silently chased — the GitHub
+    org-membership check needs to see the 302 a scope-less token gets."""
     req = urllib.request.Request(
         url, data=body, method=method, headers=headers or {}
     )
+    opener = (
+        urllib.request.urlopen if follow_redirects
+        else _NO_REDIRECT_OPENER.open
+    )
     try:
-        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        with opener(req, timeout=timeout_s) as resp:
             raw = resp.read()
             status = resp.status
     except urllib.error.HTTPError as e:
@@ -265,9 +285,11 @@ class GithubOAuthClient:
         url: str,
         body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
+        follow_redirects: bool = True,
     ):
         return _http_json(
-            method, url, body, headers, self.timeout_s, "github api"
+            method, url, body, headers, self.timeout_s, "github api",
+            follow_redirects=follow_redirects,
         )
 
     # -- the three legs --------------------------------------------------- #
@@ -322,7 +344,12 @@ class GithubOAuthClient:
         """GET /orgs/{org}/members/{login}: 204 member, 404/302 not.
         Any other status (403 token-scope/rate-limit, 5xx) is an
         AuthError — membership must never be inferred from a failed
-        check."""
+        check.
+
+        The 302 (requester lacks ``read:org`` scope) must be OBSERVED,
+        not followed: urllib's default opener would chase it to the
+        public-members endpoint, whose 204/404 conflates 'private
+        member' with 'not a member'."""
         status, _ = self._request(
             "GET",
             f"{self.api_base}/orgs/{org}/members/{login}",
@@ -331,6 +358,7 @@ class GithubOAuthClient:
                 "Accept": "application/vnd.github+json",
                 "Authorization": f"Bearer {access_token}",
             },
+            follow_redirects=False,
         )
         if status == 204:
             return True
@@ -485,8 +513,12 @@ class OidcClient:
         self.issuer = issuer.rstrip("/")
         self.callback_url = callback_url
         self.timeout_s = timeout_s
-        # JWKS cache: kid → (n, e); refreshed once per unknown kid
+        # JWKS cache: kid → (n, e); refreshed on unknown kid or
+        # signature failure, throttled so forged tokens cannot drive
+        # unbounded outbound fetches at the issuer
         self._jwks: Dict[str, Tuple[int, int]] = {}
+        self._jwks_fetched_at = 0.0
+        self._jwks_min_refetch_s = 30.0
 
     def _request(
         self,
@@ -499,7 +531,17 @@ class OidcClient:
             method, url, body, headers, self.timeout_s, "oidc issuer"
         )
 
+    def _maybe_refetch_jwks(self, now: float) -> bool:
+        """Rate-limited refetch for the unknown-kid / stale-key paths.
+        Unauthenticated callers can force verification failures at will;
+        the throttle caps what that costs the issuer (and us)."""
+        if now - self._jwks_fetched_at < self._jwks_min_refetch_s:
+            return False
+        self._fetch_jwks()
+        return True
+
     def _fetch_jwks(self) -> None:
+        self._jwks_fetched_at = _time.time()
         status, parsed = self._request("GET", f"{self.issuer}/v1/keys")
         if status != 200 or not isinstance(parsed, dict):
             raise AuthError(f"could not fetch issuer JWKS: HTTP {status}")
@@ -536,13 +578,24 @@ class OidcClient:
             raise AuthError(f"unsupported ID token alg {header.get('alg')!r}")
         kid = header.get("kid", "")
         if kid not in self._jwks:
-            self._fetch_jwks()
+            self._maybe_refetch_jwks(now)
         if kid not in self._jwks:
             raise AuthError(f"no JWKS key for kid {kid!r}")
         n, e = self._jwks[kid]
         signing_input = f"{parts[0]}.{parts[1]}".encode()
         if not _rsa_verify_pkcs1_sha256(n, e, sig, signing_input):
-            raise AuthError("ID token signature verification failed")
+            # the issuer may have rotated the key while REUSING the kid —
+            # a stale cached (n, e) would otherwise fail every login until
+            # restart. Refetch the JWKS once (rate-limited: forged tokens
+            # must not turn into unbounded fetches) and retry.
+            refreshed = (
+                self._jwks.get(kid)
+                if self._maybe_refetch_jwks(now) else None
+            )
+            if refreshed is None or not _rsa_verify_pkcs1_sha256(
+                refreshed[0], refreshed[1], sig, signing_input
+            ):
+                raise AuthError("ID token signature verification failed")
         if float(claims.get("exp", 0)) < now:
             raise AuthError("ID token is expired")
         if claims.get("iss", "").rstrip("/") != self.issuer:

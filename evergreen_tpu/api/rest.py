@@ -2183,14 +2183,17 @@ class RestApi:
         before_ts = self._num(body, "ts", _time.time() + 1)
         before_id = body.get("id", "")
 
-        def seq(event_id: str) -> int:
+        def seq(event_id: str):
             # ids are "evt-{n}" with a monotonically increasing n; the
             # tiebreak must be NUMERIC ("evt-9" vs "evt-10" would invert
-            # lexicographically)
+            # lexicographically). Non-conforming ids fall back to
+            # lexicographic comparison — collapsing them all to one rank
+            # would skip or duplicate same-timestamp events at a page
+            # boundary.
             try:
-                return int(event_id.rsplit("-", 1)[-1])
+                return (0, int(event_id.rsplit("-", 1)[-1]), "")
             except ValueError:
-                return 0
+                return (1, 0, event_id)
 
         before_key = (before_ts, seq(before_id)) if before_id else None
         evs = [

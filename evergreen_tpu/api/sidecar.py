@@ -55,7 +55,10 @@ def _solve_buffers(
     if want != got:
         raise ValueError(f"buffer sizes {got} do not match shape key (want {want})")
     bufs = {"f32": f32_buf, "i32": i32_buf, "u8": u8_buf}
-    out = np.asarray(_packed_solve(bufs, arena.layout_key()))
+    from ..ops.solve import x64_scope
+
+    with x64_scope():
+        out = np.asarray(_packed_solve(bufs, arena.layout_key()))
     return split_packed(out, dims)
 
 

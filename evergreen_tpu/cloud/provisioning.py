@@ -51,6 +51,14 @@ USER_DATA_DONE_TIMEOUT_S = 10 * 60.0
 #: re-deployed (reference host.NeedsNewAgent via MaxUncommunicatedTime)
 MAX_UNCOMMUNICATED_S = 10 * 60.0
 
+#: retry for the IDEMPOTENT provider status read. Spawn itself is never
+#: retried in-call — a spawn that committed at the provider but raised on
+#: the response leg would double-provision; its retry unit is the cron
+#: pass (provision_attempts accounting → poison at the cap).
+from ..utils.retry import RetryPolicy as _RetryPolicy  # noqa: E402
+
+_STATUS_RETRY = _RetryPolicy(attempts=2, base_backoff_s=0.1, deadline_s=15.0)
+
 
 # --------------------------------------------------------------------------- #
 # Host transport seam (replaces jasper gRPC / SSH)
@@ -272,7 +280,45 @@ def create_hosts_from_intents(
             if fresh is None:
                 continue
             h = fresh  # spawn must see the user_data payload
-        mgr.spawn_host(store, h)
+        # Cloud-provider errors are steady-state (rate limits, capacity).
+        # Spawn is NOT retried in-call (non-idempotent — see
+        # _STATUS_RETRY note): a failure charges the host one provision
+        # attempt, the next cron pass retries, and the cap poisons it —
+        # one sick provider call never aborts the whole create pass.
+        from ..utils import faults
+        from ..utils.log import get_logger, incr_counter
+
+        try:
+            faults.fire("cloud.spawn")
+            mgr.spawn_host(store, h)
+        except Exception as exc:  # noqa: BLE001 — provider SDKs raise
+            # whatever they like; all of it is a failed spawn
+            attempts = h.provision_attempts + 1
+            host_mod.coll(store).update(
+                h.id, {"provision_attempts": attempts}
+            )
+            incr_counter("cloud.spawn_failed")
+            get_logger("cloud").error(
+                "host-spawn-failed",
+                host=h.id,
+                distro=h.distro_id,
+                attempts=attempts,
+                error=repr(exc)[-300:],
+            )
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_HOST,
+                "HOST_SPAWN_FAILED",
+                h.id,
+                {"attempts": attempts, "error": str(exc)[-300:]},
+                timestamp=now,
+            )
+            if attempts >= MAX_PROVISION_ATTEMPTS:
+                _poison(
+                    store, h,
+                    f"failed {attempts} times to spawn cloud instance", now,
+                )
+            continue
         spawned.append(h.id)
         event_mod.log(
             store, event_mod.RESOURCE_HOST, "HOST_STARTED", h.id, timestamp=now
@@ -418,7 +464,23 @@ def provision_ready_hosts(
             mgr = get_manager(h.provider)
         except KeyError:
             continue
-        if mgr.get_instance_status(store, h) != CloudHostStatus.RUNNING:
+        try:
+            status = _STATUS_RETRY.call(
+                mgr.get_instance_status, store, h,
+                operation="cloud-status", component="cloud",
+            )
+        except Exception as exc:  # noqa: BLE001 — a provider status
+            # error holds THIS host where it is; the pass continues
+            from ..utils.log import get_logger, incr_counter
+
+            incr_counter("cloud.status_failed")
+            get_logger("cloud").warning(
+                "host-status-check-failed",
+                host=h.id,
+                error=repr(exc)[-300:],
+            )
+            continue
+        if status != CloudHostStatus.RUNNING:
             continue
         if h.distro_id not in distros:
             distros[h.distro_id] = distro_mod.get(store, h.distro_id)

@@ -27,6 +27,9 @@ from email.message import EmailMessage
 from typing import Callable, Dict, List, Optional
 
 from ..storage.store import Store
+from ..utils import faults
+from ..utils.log import get_logger, incr_counter
+from ..utils.retry import RetryPolicy
 from .senders import OUTBOX
 from .github_status import OUTBOX_COLLECTION as GITHUB_OUTBOX
 
@@ -48,6 +51,16 @@ def calculate_hmac(secret: bytes, body: bytes) -> str:
     return "sha256=" + mac.hexdigest()
 
 
+#: transient-transport retry inside ONE delivery attempt; the durable
+#: cross-drain accounting (outbox row attempts) stays the backstop
+_POST_RETRY = RetryPolicy(
+    attempts=2,
+    base_backoff_s=0.1,
+    deadline_s=15.0,
+    retry_on=(urllib.error.URLError, OSError),
+)
+
+
 def _post_json(
     url: str,
     payload: dict,
@@ -55,20 +68,30 @@ def _post_json(
     timeout_s: float = 10.0,
 ) -> int:
     body = json.dumps(payload).encode()
-    req = urllib.request.Request(
-        url,
-        data=body,
-        method="POST",
-        headers={"Content-Type": "application/json", **(headers or {})},
-    )
+
+    def attempt() -> int:
+        req = urllib.request.Request(
+            url,
+            data=body,
+            method="POST",
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            # a protocol answer (4xx/5xx) — retrying won't change it
+            raise DeliveryError(f"POST {url} → {e.code}") from e
+        except ValueError as e:
+            # urllib's malformed-url family (unknown url type, InvalidURL)
+            # — user-supplied webhook targets hit it; not retryable
+            raise DeliveryError(f"POST {url} failed: {e}") from e
+
     try:
-        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return resp.status
-    except urllib.error.HTTPError as e:
-        raise DeliveryError(f"POST {url} → {e.code}") from e
-    except (urllib.error.URLError, OSError, ValueError) as e:
-        # ValueError covers urllib's malformed-url family (unknown url
-        # type, InvalidURL) — user-supplied webhook targets hit it
+        return _POST_RETRY.call(
+            attempt, operation="event-post", component="events"
+        )
+    except (urllib.error.URLError, OSError) as e:
         raise DeliveryError(f"POST {url} failed: {e}") from e
 
 
@@ -315,14 +338,32 @@ def drain_outboxes(
         )
         for doc in rows[:max_per_collection]:
             try:
+                faults.fire("events.deliver")
                 transport.deliver(doc)
             except Exception as e:  # noqa: BLE001 — one poison row (bad
                 # URL, missing field) must cost itself an attempt, never
                 # abort the drain for every other row and channel
                 attempts = doc.get("attempts", 0) + 1
                 update = {"attempts": attempts, "error": str(e)}
+                incr_counter("events.delivery_failed")
                 if attempts >= max_attempts:
                     update["failed"] = True
+                    incr_counter("events.row_abandoned")
+                    get_logger("events").error(
+                        "outbox-row-abandoned",
+                        collection=collection,
+                        row=doc["_id"],
+                        attempts=attempts,
+                        error=str(e)[-300:],
+                    )
+                else:
+                    get_logger("events").warning(
+                        "outbox-delivery-failed",
+                        collection=collection,
+                        row=doc["_id"],
+                        attempts=attempts,
+                        error=str(e)[-300:],
+                    )
                 coll.update(doc["_id"], update)
                 continue
             coll.update(

@@ -208,16 +208,47 @@ class GithubApiRevisionSource(RevisionSource):
         self.token = token
         self.timeout_s = timeout_s
 
+    #: transient-transport retry for one API read (the reference's
+    #: thirdparty/github.go retrying client); HTTPError is a protocol
+    #: answer (404 = no file at that rev) and must pass through UNretried
+    _RETRY = None  # built lazily so import stays cheap
+
     def _get(self, path: str, params: Optional[Dict[str, str]] = None):
+        from ..utils.retry import RetryPolicy, TransientError
+
+        if GithubApiRevisionSource._RETRY is None:
+            GithubApiRevisionSource._RETRY = RetryPolicy(
+                attempts=3,
+                base_backoff_s=0.2,
+                deadline_s=60.0,
+                retry_on=(TransientError,),
+            )
         url = f"{self.api_url}{path}"
         if params:
             url += "?" + urllib.parse.urlencode(params)
         headers = {"Accept": "application/vnd.github+json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(url, headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            return json.loads(resp.read())
+
+        def attempt():
+            req = urllib.request.Request(url, headers=headers)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError:
+                raise  # protocol answer — callers branch on it
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                raise TransientError(f"github api unreachable: {e}") from e
+
+        try:
+            return GithubApiRevisionSource._RETRY.call(
+                attempt, operation="repotracker-poll",
+                component="repotracker",
+            )
+        except TransientError as e:
+            raise OSError(str(e)) from e
 
     def _config_at(self, sha: str) -> str:
         try:

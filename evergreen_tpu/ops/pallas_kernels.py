@@ -127,19 +127,24 @@ def fused_distro_stats(
         wait_over = deps & (wait_ref[:] > thresh)
         merge = deps & (merge_ref[:] > 0.5)
 
+        # f32 literals spelled explicitly: the solve call runs under
+        # x64_scope (ops/solve.py), where a weak-python-float where()
+        # would sum as f64 and fail the swap into the f32 out ref
+        one = jnp.float32(1.0)
+        zero = jnp.float32(0.0)
         stats = (
-            jnp.sum(jnp.where(valid, 1.0, 0.0)),
-            jnp.sum(jnp.where(deps, 1.0, 0.0)),
-            jnp.sum(jnp.where(deps, dur, 0.0)),
-            jnp.sum(jnp.where(over, 1.0, 0.0)),
-            jnp.sum(jnp.where(over, dur, 0.0)),
-            jnp.sum(jnp.where(wait_over, 1.0, 0.0)),
-            jnp.sum(jnp.where(merge, 1.0, 0.0)),
+            jnp.sum(jnp.where(valid, one, zero)),
+            jnp.sum(jnp.where(deps, one, zero)),
+            jnp.sum(jnp.where(deps, dur, zero)),
+            jnp.sum(jnp.where(over, one, zero)),
+            jnp.sum(jnp.where(over, dur, zero)),
+            jnp.sum(jnp.where(wait_over, one, zero)),
+            jnp.sum(jnp.where(merge, one, zero)),
         )
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
         partial = jnp.zeros((1, LANES), jnp.float32)
         for i, s in enumerate(stats):
-            partial = partial + jnp.where(lane == i, s, 0.0)
+            partial = partial + jnp.where(lane == i, s, zero)
 
         @pl.when(k == 0)
         def _():

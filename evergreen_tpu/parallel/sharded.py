@@ -174,7 +174,17 @@ def sharded_solve_fn(mesh, axis: str = "shard"):
             out_specs={k: P(axis) for k in _OUT_KEYS},
             check_rep=False,
         )
-    return jax.jit(fn)
+    jfn = jax.jit(fn)
+
+    def call(stacked):
+        # x64 must be on at trace AND lowering time for the u64 sort-key
+        # packing inside the local solve (see ops/solve.py x64_scope)
+        from ..ops.solve import x64_scope
+
+        with x64_scope():
+            return jfn(stacked)
+
+    return call
 
 
 from ..scheduler.snapshot import FIELD_KINDS as _FIELD_KINDS  # noqa: E402

@@ -68,11 +68,28 @@ class FnJob(Job):
 
 
 class JobQueue:
-    """Scope-locked worker-pool queue."""
+    """Scope-locked worker-pool queue with poison-job quarantine.
 
-    def __init__(self, store: Store, workers: int = 4, name: str = "service") -> None:
+    A job type that fails ``poison_threshold`` consecutive runs is
+    quarantined: new enqueues of that type are dropped (recorded in the
+    jobs collection as ``quarantined``) until ``quarantine_s`` passes,
+    then ONE probe job is admitted — success lifts the quarantine, another
+    failure re-arms it. A crashing populator-produced job can therefore
+    never wedge the cron loop or monopolize the worker pool.
+    """
+
+    def __init__(
+        self,
+        store: Store,
+        workers: int = 4,
+        name: str = "service",
+        poison_threshold: int = 5,
+        quarantine_s: float = 300.0,
+    ) -> None:
         self.store = store
         self.name = name
+        self.poison_threshold = max(1, poison_threshold)
+        self.quarantine_s = quarantine_s
         self._executor = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"jobq-{name}"
         )
@@ -81,21 +98,55 @@ class JobQueue:
         self._held_scopes: Set[str] = set()
         self._waiting: List[Job] = []
         self._closed = False
+        #: job type → consecutive failure count
+        self._failures: Dict[str, int] = {}
+        #: job type → quarantine expiry (absolute time)
+        self._quarantined_until: Dict[str, float] = {}
+        #: job type currently running its single post-quarantine probe
+        self._probing: Set[str] = set()
 
     # -- enqueue ------------------------------------------------------------- #
 
     def put(self, job: Job) -> bool:
-        """Enqueue unless a job with the same id is already pending/running."""
+        """Enqueue unless a job with the same id is already pending/running
+        or the job type sits in poison quarantine."""
+        now = _time.time()
         with self._lock:
             if self._closed or job.job_id in self._pending:
                 return False
+            until = self._quarantined_until.get(job.job_type)
+            if until is not None:
+                if now < until or job.job_type in self._probing:
+                    # drop, but leave an auditable record
+                    self.store.collection(JOBS_COLLECTION).upsert(
+                        {
+                            "_id": job.job_id,
+                            "type": job.job_type,
+                            "status": "quarantined",
+                            "enqueued_at": now,
+                            "scopes": job.scopes,
+                            "error": "job type is quarantined",
+                        }
+                    )
+                    from ..utils.log import get_logger, incr_counter
+
+                    incr_counter("jobs.quarantined_drop")
+                    get_logger("amboy").warning(
+                        "job-quarantine-drop",
+                        job_id=job.job_id,
+                        job_type=job.job_type,
+                        until=round(until, 3),
+                    )
+                    return False
+                # cooldown elapsed: admit exactly one probe
+                self._probing.add(job.job_type)
             self._pending[job.job_id] = job
             self.store.collection(JOBS_COLLECTION).upsert(
                 {
                     "_id": job.job_id,
                     "type": job.job_type,
                     "status": "pending",
-                    "enqueued_at": _time.time(),
+                    "enqueued_at": now,
                     "scopes": job.scopes,
                     "error": "",
                 }
@@ -148,6 +199,7 @@ class JobQueue:
                 "error": error[-2000:],
             },
         )
+        self._account_outcome(job, failed=bool(error))
         with self._lock:
             self._pending.pop(job.job_id, None)
             for s in job.scopes:
@@ -160,6 +212,34 @@ class JobQueue:
                 else:
                     still_waiting.append(w)
             self._waiting = still_waiting
+
+    def _account_outcome(self, job: Job, failed: bool) -> None:
+        """Poison accounting: consecutive failures per job type arm the
+        quarantine; one success clears it."""
+        from ..utils.log import get_logger, incr_counter
+
+        with self._lock:
+            self._probing.discard(job.job_type)
+            if not failed:
+                self._failures.pop(job.job_type, None)
+                if self._quarantined_until.pop(job.job_type, None) is not None:
+                    get_logger("amboy").info(
+                        "job-quarantine-lifted", job_type=job.job_type
+                    )
+                return
+            n = self._failures.get(job.job_type, 0) + 1
+            self._failures[job.job_type] = n
+            was_probe = job.job_type in self._quarantined_until
+            if n >= self.poison_threshold or was_probe:
+                until = _time.time() + self.quarantine_s
+                self._quarantined_until[job.job_type] = until
+                incr_counter("jobs.quarantined")
+                get_logger("amboy").error(
+                    "job-quarantined",
+                    job_type=job.job_type,
+                    consecutive_failures=n,
+                    quarantine_s=self.quarantine_s,
+                )
 
     # -- introspection / lifecycle ------------------------------------------- #
 

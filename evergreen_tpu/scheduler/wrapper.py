@@ -56,6 +56,13 @@ class TickOptions:
     max_intent_hosts: int = MAX_INTENT_HOSTS_IN_FLIGHT
     #: incremental runnable-set maintenance between ticks (scheduler/cache.py)
     use_cache: bool = False
+    #: wall budget for the packed device solve; an overrun counts as a
+    #: breaker failure and the tick falls back to the serial oracle
+    #: (0 = no deadline)
+    solve_deadline_s: float = 0.0
+    #: whole-tick budget: when exceeded, optional work is shed — stats
+    #: first, then event emission — but never planning (0 = unlimited)
+    tick_budget_s: float = 0.0
 
 
 #: per-store TickCache singletons. Intentionally strong references: a
@@ -98,6 +105,36 @@ def _snapshot_memos_for(store: Store) -> Tuple[dict, dict]:
         return entry[1], entry[2]
 
 
+#: consecutive solve failures before the breaker opens, and how long it
+#: stays open before half-open probes (the reference's planner=tpu →
+#: tunable downgrade, generalized)
+SOLVE_BREAKER_THRESHOLD = 3
+SOLVE_BREAKER_COOLDOWN_S = 60.0
+
+#: per-store circuit breakers around the packed device solve
+_solve_breakers: Dict[int, tuple] = {}
+
+
+def solve_breaker_for(store: Store):
+    """Per-store breaker guarding the device-solve path of run_tick."""
+    from ..utils.circuit import CircuitBreaker
+
+    key = id(store)
+    with _tick_caches_lock:
+        entry = _solve_breakers.get(key)
+        if entry is None or entry[0] is not store:
+            entry = (
+                store,
+                CircuitBreaker(
+                    "scheduler.solve",
+                    failure_threshold=SOLVE_BREAKER_THRESHOLD,
+                    cooldown_s=SOLVE_BREAKER_COOLDOWN_S,
+                ),
+            )
+            _solve_breakers[key] = entry
+        return entry[1]
+
+
 @dataclasses.dataclass
 class TickResult:
     #: distro id -> number of queue items persisted this tick
@@ -109,6 +146,14 @@ class TickResult:
     snapshot_ms: float = 0.0
     solve_ms: float = 0.0
     total_ms: float = 0.0
+    #: which planner actually produced the solver-distro queues:
+    #: "tpu" | "serial" | "" (no solver distros)
+    planner_used: str = ""
+    #: non-empty when the tick degraded: "solve-failed" | "solve-deadline"
+    #: | "breaker-open" | "persist-failed"
+    degraded: str = ""
+    #: optional work shed under the tick budget ("events", "stats")
+    shed: List[str] = dataclasses.field(default_factory=list)
 
 
 def gather_tick_inputs(
@@ -371,13 +416,53 @@ def _apply_release_mode(store: Store, distros):
     return out
 
 
+def _solve_bounded(store: Store, snapshot, deadline_s: float):
+    """The packed solve under a wall deadline. With a deadline the solve
+    runs on a daemon worker and a hang past the budget raises
+    TimeoutError — the wedged call is abandoned (a dead tunnel/sidecar
+    would otherwise block run_tick forever, well past the 15s cadence).
+    Without one it runs inline. The solve seam fires inside the bounded
+    region so injected hangs are caught like real ones."""
+    import threading
+
+    from ..ops.solve import run_solve_packed
+    from ..utils import faults
+    from ..utils.tracing import maybe_xla_profile
+
+    def work():
+        faults.fire("scheduler.solve")
+        with maybe_xla_profile(store):
+            return run_solve_packed(snapshot)
+
+    if deadline_s <= 0:
+        return work()
+    result: list = []
+
+    def runner():
+        try:
+            result.append(("ok", work()))
+        except BaseException as exc:  # noqa: BLE001 — relayed to caller
+            result.append(("err", exc))
+
+    th = threading.Thread(target=runner, daemon=True, name="tick-solve")
+    th.start()
+    th.join(deadline_s)
+    if th.is_alive() or not result:
+        raise TimeoutError(
+            f"solve exceeded its {deadline_s}s deadline"
+        )
+    kind, val = result[0]
+    if kind == "err":
+        raise val
+    return val
+
+
 def run_tick(
     store: Store,
     opts: Optional[TickOptions] = None,
     now: Optional[float] = None,
 ) -> TickResult:
     """One full scheduling tick over every distro."""
-    from ..ops.solve import run_solve_packed  # deferred: keeps jax import lazy
 
     opts = opts or TickOptions()
     now = _time.time() if now is None else now
@@ -431,29 +516,70 @@ def run_tick(
     #: positional deps-met columns from the solve's unpack; distros
     #: planned host-side (cmp/serial) fall back to the dict
     met_cols: Dict[str, List[bool]] = {}
-    if solver_distros and opts.planner_version == PlannerVersion.TPU.value:
-        t1 = _time.perf_counter()
-        dims_memo, memb_memo = _snapshot_memos_for(store)
-        snapshot = build_snapshot(
-            solver_distros, tasks_by_distro, hosts_by_distro,
-            running_estimates, deps_met, now, dims_memo=dims_memo,
-            memb_memo=memb_memo,
-        )
-        t2 = _time.perf_counter()
-        # optional XLA profiler capture of exactly this solve (SURVEY §5:
-        # profiler hooks beside the control-plane spans; enabled via the
-        # tracer config's xla_profile_dir)
-        from ..utils.tracing import maybe_xla_profile
+    planner_used = ""
+    degraded = ""
+    shed: List[str] = []
+    from ..utils import faults
+    from ..utils.log import get_logger, incr_counter
 
-        with maybe_xla_profile(store):
-            out = run_solve_packed(snapshot)
-        t3 = _time.perf_counter()
-        snapshot_ms = (t2 - t1) * 1e3
-        solve_ms = (t3 - t2) * 1e3
-        plans, sort_values, infos, new_hosts, met_cols = _unpack_solve(
-            snapshot, out
+    _rlog = get_logger("resilience")
+
+    # Circuit-broken device path (the reference's planner=tpu → tunable
+    # downgrade): a raising or deadline-blowing solve degrades THIS tick
+    # to the serial oracle; repeated failures open the breaker so
+    # subsequent ticks skip the device path until half-open probes pass.
+    want_tpu = (
+        bool(solver_distros)
+        and opts.planner_version == PlannerVersion.TPU.value
+    )
+    breaker = solve_breaker_for(store) if want_tpu else None
+    if want_tpu and not breaker.allow(now=now):
+        want_tpu = False
+        degraded = "breaker-open"
+        incr_counter("scheduler.tick.breaker_open")
+        _rlog.warning(
+            "degraded-tick", reason=degraded, fallback="serial"
         )
-    elif solver_distros:
+    if want_tpu:
+        try:
+            t1 = _time.perf_counter()
+            dims_memo, memb_memo = _snapshot_memos_for(store)
+            snapshot = build_snapshot(
+                solver_distros, tasks_by_distro, hosts_by_distro,
+                running_estimates, deps_met, now, dims_memo=dims_memo,
+                memb_memo=memb_memo,
+            )
+            t2 = _time.perf_counter()
+            # bounded solve (optionally XLA-profiled inside — SURVEY §5:
+            # profiler hooks beside the control-plane spans, enabled via
+            # the tracer config's xla_profile_dir)
+            out = _solve_bounded(store, snapshot, opts.solve_deadline_s)
+            t3 = _time.perf_counter()
+            snapshot_ms = (t2 - t1) * 1e3
+            solve_ms = (t3 - t2) * 1e3
+            plans, sort_values, infos, new_hosts, met_cols = _unpack_solve(
+                snapshot, out
+            )
+            planner_used = "tpu"
+            breaker.record_success(now=now)
+        except Exception as exc:  # noqa: BLE001 — ANY solve-path failure
+            # degrades the tick; it must never kill it
+            want_tpu = False
+            degraded = (
+                "solve-deadline" if isinstance(exc, TimeoutError)
+                else "solve-failed"
+            )
+            breaker.record_failure(now=now, error=repr(exc))
+            incr_counter("scheduler.tick.solve_failed")
+            _rlog.error(
+                "degraded-tick",
+                reason=degraded,
+                fallback="serial",
+                error=repr(exc)[-300:],
+            )
+            plans, sort_values, infos, met_cols = {}, {}, {}, {}
+            new_hosts = {}
+    if not want_tpu and solver_distros:
         results = serial.serial_tick(
             solver_distros, tasks_by_distro, hosts_by_distro,
             running_estimates, deps_met, now,
@@ -462,6 +588,7 @@ def run_tick(
         infos = {d: r[1] for d, r in results.items()}
         new_hosts = {d: r[2] for d, r in results.items()}
         sort_values = {d: r[3] for d, r in results.items()}
+        planner_used = "serial"
 
     if cmp_distros:
         from . import cmp_prioritizer
@@ -509,82 +636,146 @@ def run_tick(
 
     # Persist queues + create intent hosts (scheduler/scheduler.go:176-220),
     # honoring the global intent-host cap (units/host_allocator.go:35).
+    # A storage fault (WAL write error) while persisting ONE distro's
+    # queue must not abandon every other distro's plan: the failed queue
+    # doc stays one tick stale and the next tick rewrites it.
     n_intents_in_flight = host_mod.coll(store).count(
         lambda doc: doc["status"] == HostStatus.UNINITIALIZED.value
     )
     budget = max(0, opts.max_intent_hosts - n_intents_in_flight)
+
+    def _over_budget() -> bool:
+        return (
+            opts.tick_budget_s > 0
+            and _time.perf_counter() - t0 > opts.tick_budget_s
+        )
+
     for d in distros:
         plan = plans.get(d.id, [])
         is_alias = d.id.endswith(ALIAS_SUFFIX)
         base_id = d.id[: -len(ALIAS_SUFFIX)] if is_alias else d.id
         info = infos.get(d.id, DistroQueueInfo())
         info.secondary_queue = is_alias
-        queues[d.id] = persist_task_queue(
-            store,
-            base_id,
-            plan,
-            sort_values.get(d.id, {}),
-            met_cols.get(d.id, deps_met),
-            info,
-            opts.max_scheduled_per_distro,
-            secondary=is_alias,
-            now=now,
-        )
+        try:
+            queues[d.id] = persist_task_queue(
+                store,
+                base_id,
+                plan,
+                sort_values.get(d.id, {}),
+                met_cols.get(d.id, deps_met),
+                info,
+                opts.max_scheduled_per_distro,
+                secondary=is_alias,
+                now=now,
+            )
+        except Exception as exc:  # noqa: BLE001 — isolate per distro
+            queues[d.id] = 0
+            degraded = degraded or "persist-failed"
+            incr_counter("scheduler.tick.persist_failed")
+            _rlog.error(
+                "queue-persist-failed",
+                distro=base_id,
+                error=repr(exc)[-300:],
+            )
+            continue
         if is_alias:
             continue  # alias rows never spawn hosts (units/scheduler_alias.go)
         if opts.create_intent_hosts:
             n = min(new_hosts.get(d.id, 0), budget)
             budget -= n
             created = []
-            for _ in range(n):
-                intent = new_intent(d.id, d.provider)
-                host_mod.insert(store, intent)
-                created.append(intent)
+            try:
+                for _ in range(n):
+                    intent = new_intent(d.id, d.provider)
+                    host_mod.insert(store, intent)
+                    created.append(intent)
+            except Exception as exc:  # noqa: BLE001 — isolate per distro
+                degraded = degraded or "persist-failed"
+                incr_counter("scheduler.tick.persist_failed")
+                _rlog.error(
+                    "intent-create-failed",
+                    distro=base_id,
+                    error=repr(exc)[-300:],
+                )
             intent_hosts.extend(created)
             if created:
-                event_mod.log(
-                    store,
-                    event_mod.RESOURCE_HOST,
-                    "HOSTS_CREATED",
-                    d.id,
-                    {"count": len(created)},
-                    timestamp=now,
-                )
+                # event emission is optional work: over the tick budget
+                # it is shed before anything that affects planning
+                if _over_budget():
+                    if "events" not in shed:
+                        shed.append("events")
+                    continue
+                try:
+                    event_mod.log(
+                        store,
+                        event_mod.RESOURCE_HOST,
+                        "HOSTS_CREATED",
+                        d.id,
+                        {"count": len(created)},
+                        timestamp=now,
+                    )
+                except Exception as exc:  # noqa: BLE001 — events are
+                    # optional work; a storage fault here never kills
+                    # the tick
+                    degraded = degraded or "persist-failed"
+                    incr_counter("scheduler.tick.persist_failed")
+                    _rlog.error(
+                        "event-emit-failed",
+                        distro=base_id,
+                        error=repr(exc)[-300:],
+                    )
 
-    total_ms = (_time.perf_counter() - t0) * 1e3
-    # per-solve timing span (the reference's scheduler span attributes,
-    # SURVEY §5 tracing; sink is the store's spans collection)
-    from ..utils.tracing import Tracer
+    # Stats are the FIRST work shed under the tick budget (before events,
+    # long before planning): the time-to-empty estimate + tracer span are
+    # telemetry, not decisions.
+    worst = ("", 0.0)
+    if _over_budget():
+        if "stats" not in shed:
+            shed.append("stats")
+    else:
+        # per-solve timing span (the reference's scheduler span
+        # attributes, SURVEY §5 tracing; sink is the store's spans
+        # collection)
+        from ..utils.tracing import Tracer
 
-    # time-to-empty estimate per tick (the reference's allocator telemetry,
-    # units/host_allocator.go:295-334): queued work over usable capacity
-    tte = {}
-    for d in distros:
-        info = infos.get(d.id)
-        if info is None or d.id.endswith(ALIAS_SUFFIX):
-            continue
-        capacity = max(
-            len(hosts_by_distro.get(d.id, [])) + new_hosts.get(d.id, 0), 1
+        # time-to-empty estimate per tick (the reference's allocator
+        # telemetry, units/host_allocator.go:295-334): queued work over
+        # usable capacity
+        tte = {}
+        for d in distros:
+            info = infos.get(d.id)
+            if info is None or d.id.endswith(ALIAS_SUFFIX):
+                continue
+            capacity = max(
+                len(hosts_by_distro.get(d.id, [])) + new_hosts.get(d.id, 0), 1
+            )
+            tte[d.id] = round(info.expected_duration_s / capacity, 1)
+        worst = max(tte.items(), key=lambda kv: kv[1]) if tte else ("", 0.0)
+
+        with Tracer(store, "scheduler").span(
+            "tick",
+            n_tasks=n_tasks,
+            n_distros=len(distros),
+            snapshot_ms=round(snapshot_ms, 2),
+            solve_ms=round(solve_ms, 2),
+            total_ms=round((_time.perf_counter() - t0) * 1e3, 2),
+            planner=opts.planner_version,
+            worst_time_to_empty_s=worst[1],
+            worst_time_to_empty_distro=worst[0],
+        ):
+            pass
+    if shed:
+        incr_counter("scheduler.tick.shed")
+        _rlog.warning(
+            "degraded-tick",
+            reason="budget-exceeded",
+            shed=list(shed),
+            budget_s=opts.tick_budget_s,
         )
-        tte[d.id] = round(info.expected_duration_s / capacity, 1)
-    worst = max(tte.items(), key=lambda kv: kv[1]) if tte else ("", 0.0)
-
-    with Tracer(store, "scheduler").span(
-        "tick",
-        n_tasks=n_tasks,
-        n_distros=len(distros),
-        snapshot_ms=round(snapshot_ms, 2),
-        solve_ms=round(solve_ms, 2),
-        total_ms=round(total_ms, 2),
-        planner=opts.planner_version,
-        worst_time_to_empty_s=worst[1],
-        worst_time_to_empty_distro=worst[0],
-    ):
-        pass
+    total_ms = (_time.perf_counter() - t0) * 1e3
     # the structured runtime-stats line operators grep for (reference
-    # grip message.Fields, scheduler/wrapper.go:93-128)
-    from ..utils.log import get_logger
-
+    # grip message.Fields, scheduler/wrapper.go:93-128); it survives
+    # shedding — it IS the breadcrumb trail
     get_logger("scheduler").info(
         "runtime-stats",
         operation="tick",
@@ -595,6 +786,9 @@ def run_tick(
         total_ms=round(total_ms, 2),
         new_hosts=sum(new_hosts.values()),
         worst_time_to_empty_s=worst[1],
+        planner_used=planner_used,
+        degraded=degraded,
+        shed=list(shed),
     )
     return TickResult(
         queues=queues,
@@ -605,4 +799,7 @@ def run_tick(
         snapshot_ms=snapshot_ms,
         solve_ms=solve_ms,
         total_ms=total_ms,
+        planner_used=planner_used,
+        degraded=degraded,
+        shed=shed,
     )

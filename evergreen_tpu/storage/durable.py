@@ -71,7 +71,25 @@ class _Journal:
         if self.suspended:
             return
         line = json.dumps(record, separators=(",", ":"), default=str)
+        # fault seam: "raise" models a disk error surfacing to the writer;
+        # "torn" flushes a half record with no terminator THEN raises —
+        # exactly the crash-mid-append shape recovery must absorb
+        from ..utils import faults
+
+        directive = faults.fire("wal.append")
+        if directive == "torn":
+            with self._lock:
+                self._fh.write(line[: max(1, len(line) // 2)])
+                self._fh.flush()
+                self._torn = True
+            raise OSError("injected torn WAL append")
         with self._lock:
+            if getattr(self, "_torn", False):
+                # terminate the injected torn stub exactly like the
+                # open-time repair: the stub becomes one unparseable line,
+                # every later record stays intact
+                self._fh.write("\n")
+                self._torn = False
             self._fh.write(line + "\n")
             if self.sync != "none":
                 self._fh.flush()

@@ -103,6 +103,10 @@ class FileLease:
             _time.sleep(poll_s)
 
     def renew(self) -> bool:
+        from ..utils import faults
+
+        if faults.fire("lease.renew") == "lost":
+            return False  # injected steal: the holder must stand down
         cur = self._read()
         if cur is None or cur.get("owner") != self.owner_id:
             return False  # lost it (stolen after a long stall)
@@ -130,6 +134,14 @@ class FileLease:
             while not self._stop.wait(self.ttl_s / 3.0):
                 if not self.renew():
                     self.lost = True
+                    from ..utils.log import get_logger, incr_counter
+
+                    incr_counter("lease.lost")
+                    get_logger("resilience").error(
+                        "lease-lost",
+                        path=self.path,
+                        owner=self.owner_id,
+                    )
                     if on_lost is not None:
                         on_lost()
                     return

@@ -39,6 +39,12 @@ def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
         opts = TickOptions(
             create_intent_hosts=not flags.host_allocator_disabled,
             use_cache=True,  # long-lived service: incremental gathering
+            # resilience: a solve slower than this degrades the tick to
+            # the serial oracle (breaker-counted), and a tick past its
+            # budget sheds stats/events — planning always completes
+            # before the next 15s tick fires
+            solve_deadline_s=10.0,
+            tick_budget_s=12.0,
         )
         run_tick(s, opts, now=_time.time())
 

@@ -137,3 +137,36 @@ def generate_problem(
     all_tasks = [t for ts in tasks_by_distro.values() for t in ts]
     deps_met = compute_deps_met(all_tasks, {})
     return distros, tasks_by_distro, hosts_by_distro, estimates, deps_met
+
+
+def bench_result_payload(
+    *,
+    tpu_ms: float,
+    serial_ms: float,
+    backend: str,
+    seq_ms: float,
+    pipe_med: float,
+    overlap_eff: float,
+    overlap_proven: bool,
+    churn: dict,
+    probe_history: list,
+) -> dict:
+    """The BENCH JSON line. ``pipelined_tick_ms`` appears ONLY when the
+    measured timeline proves the overlap (VERDICT r5 ask #3) — an
+    unproven pipelined number must not be advertised at all."""
+    out = {
+        "metric": "sched_tick_50k_tasks_200_distros",
+        "value": round(tpu_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(serial_ms / tpu_ms, 2),
+        "backend": backend,
+        "sequential_tick_ms": round(seq_ms, 2),
+        "overlap_efficiency": round(overlap_eff, 3),
+        "overlap_proven": overlap_proven,
+        "churn_tick_ms": round(churn["churn_ms"], 2),
+        "store_steady_tick_ms": round(churn["store_steady_ms"], 2),
+        "probe_history": probe_history,
+    }
+    if overlap_proven:
+        out["pipelined_tick_ms"] = round(pipe_med, 2)
+    return out

@@ -1,0 +1,125 @@
+"""Circuit breaker: closed → open → half-open with probes.
+
+Mirrors the reference's planner downgrade path (a failing ``planner=tpu``
+distro falls back to ``tunable``) generalized into a reusable breaker: the
+scheduler wraps the packed device solve with one so a failing or
+deadline-blowing solve degrades that tick to the serial oracle instead of
+killing the tick, then probes its way back to the device path.
+
+States:
+
+  ``closed``     calls flow; ``failure_threshold`` consecutive failures
+                 trip it open.
+  ``open``       calls are refused (``allow()`` is False) until
+                 ``cooldown_s`` has passed since the trip.
+  ``half-open``  after the cooldown, up to ``probes`` calls are admitted;
+                 one success closes the breaker, one failure re-opens it
+                 (and restarts the cooldown).
+
+Every transition emits a ``breaker-transition`` structured log record and
+bumps ``breaker.<name>.<to-state>`` counters, so soak runs audit the
+open → half-open → closed cycle from the log stream alone. Time is an
+explicit ``now`` (falling back to ``time.monotonic``) so tick-driven
+callers keep the breaker deterministic under test clocks.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Optional
+
+from .log import get_logger, incr_counter
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 3,
+        cooldown_s: float = 60.0,
+        probes: int = 1,
+    ) -> None:
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self.probes = max(1, probes)
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_in_flight = 0
+        self._log = get_logger("resilience")
+
+    # -- state --------------------------------------------------------------- #
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, to: str, now: float, **fields) -> None:
+        """Caller holds the lock."""
+        if self._state == to:
+            return
+        frm, self._state = self._state, to
+        incr_counter(f"breaker.{self.name}.{to}")
+        self._log.warning(
+            "breaker-transition",
+            breaker=self.name,
+            from_state=frm,
+            to_state=to,
+            at=round(now, 3),
+            **fields,
+        )
+
+    # -- the protocol --------------------------------------------------------- #
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        """May a call proceed? Open breakers refuse until the cooldown,
+        then admit up to ``probes`` half-open probe calls."""
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if now - self._opened_at < self.cooldown_s:
+                    return False
+                self._transition(HALF_OPEN, now)
+                self._probes_in_flight = 0
+            # half-open: admit a bounded number of probes
+            if self._probes_in_flight < self.probes:
+                self._probes_in_flight += 1
+                return True
+            return False
+
+    def record_success(self, now: Optional[float] = None) -> None:
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._transition(CLOSED, now)
+            self._probes_in_flight = 0
+
+    def record_failure(
+        self, now: Optional[float] = None, error: str = ""
+    ) -> None:
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            self._consecutive_failures += 1
+            incr_counter(f"breaker.{self.name}.failures")
+            if self._state == HALF_OPEN or (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._opened_at = now
+                self._probes_in_flight = 0
+                self._transition(
+                    OPEN,
+                    now,
+                    consecutive_failures=self._consecutive_failures,
+                    error=error[-300:],
+                )

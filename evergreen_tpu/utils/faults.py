@@ -1,0 +1,179 @@
+"""Seam-based deterministic fault injection (test/soak only).
+
+Production elastic schedulers treat component failure as steady state
+(Aryl, PAPERS.md); proving that the tick *degrades* instead of *dying*
+needs a way to fire faults at the exact seams where reality fails. Each
+instrumented seam calls ``fire("<seam>")``; with no plan installed that is
+one global read and a return — the production path stays untouched.
+
+Instrumented seams:
+
+  ``scheduler.solve``   device/sidecar solve raising or hanging
+                        (scheduler/wrapper.py run_tick)
+  ``wal.append``        WAL write errors and torn writes
+                        (storage/durable.py _Journal)
+  ``lease.renew``       lease loss mid-tick (storage/lease.py)
+  ``agent.comm``        agent→server transport faults (agent/rest_comm.py)
+  ``cloud.spawn``       cloud-provider spawn errors (cloud/provisioning.py)
+  ``events.deliver``    event-sender failures (events/transports.py)
+
+A plan is installed explicitly (``install(plan)`` — tests, the fault
+matrix soak) or via the ``EVG_FAULTS`` env spec at import time:
+``seam:kind@index[,seam:kind@index...]`` — e.g.
+``EVG_FAULTS=scheduler.solve:raise@2,wal.append:raise@5``.
+
+Fault kinds:
+
+  ``raise``  raise the configured exception (default FaultError)
+  ``hang``   sleep ``delay_s`` then return (a stall the caller's deadline
+             must catch)
+  anything else (``torn``, ``lost``, …) is returned to the seam as a
+  directive string — the seam implements the special behavior (e.g. the
+  WAL writes half a record, the lease reports itself stolen).
+
+Schedules are per-seam call indices, so a seeded run replays exactly:
+``FaultPlan.seeded(seed, {"wal.append": 0.1})`` derives the firing
+indices from one RNG and the plan records every fired fault in ``fired``.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class FaultError(RuntimeError):
+    """Default injected failure."""
+
+
+class Fault:
+    """One injected fault: what happens when its schedule slot fires."""
+
+    def __init__(
+        self,
+        kind: str = "raise",
+        exc: Optional[BaseException] = None,
+        delay_s: float = 0.0,
+    ) -> None:
+        self.kind = kind
+        self.exc = exc
+        self.delay_s = delay_s
+
+    def __repr__(self) -> str:  # readable audit trails
+        return f"Fault({self.kind!r}, delay_s={self.delay_s})"
+
+
+class FaultPlan:
+    """Deterministic schedule of faults keyed by (seam, call index)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._at: Dict[str, Dict[int, Fault]] = {}
+        self._always: Dict[str, Fault] = {}
+        self._calls: Dict[str, int] = {}
+        #: audit trail: (seam, call index, kind) per fired fault
+        self.fired: List[Tuple[str, int, str]] = []
+
+    # -- authoring ----------------------------------------------------------- #
+
+    def at(self, seam: str, call_index: int, fault: Fault) -> "FaultPlan":
+        """Fire ``fault`` on the seam's ``call_index``-th call (0-based)."""
+        self._at.setdefault(seam, {})[call_index] = fault
+        return self
+
+    def always(self, seam: str, fault: Fault) -> "FaultPlan":
+        """Fire ``fault`` on every call of the seam."""
+        self._always[seam] = fault
+        return self
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        rates: Dict[str, float],
+        horizon: int = 1000,
+        fault: Optional[Fault] = None,
+    ) -> "FaultPlan":
+        """Seeded random schedule: each seam fires with its rate at every
+        call index below ``horizon``. Same seed → same schedule, so a
+        failing soak run replays exactly."""
+        plan = cls()
+        rng = random.Random(seed)
+        for seam in sorted(rates):
+            for i in range(horizon):
+                if rng.random() < rates[seam]:
+                    plan.at(seam, i, fault or Fault("raise"))
+        return plan
+
+    # -- firing -------------------------------------------------------------- #
+
+    def fire(
+        self, seam: str, sleep: Callable[[float], None] = _time.sleep
+    ) -> Optional[str]:
+        with self._lock:
+            idx = self._calls.get(seam, 0)
+            self._calls[seam] = idx + 1
+            fault = self._at.get(seam, {}).get(idx) or self._always.get(seam)
+            if fault is None:
+                return None
+            self.fired.append((seam, idx, fault.kind))
+        from .log import get_logger, incr_counter
+
+        incr_counter("faults.fired")
+        incr_counter(f"faults.fired.{seam}")
+        get_logger("faults").warning(
+            "fault-injected", seam=seam, call_index=idx, kind=fault.kind
+        )
+        if fault.kind == "raise":
+            raise fault.exc if fault.exc is not None else FaultError(
+                f"injected fault at {seam}"
+            )
+        if fault.kind == "hang":
+            sleep(fault.delay_s)
+            return None
+        return fault.kind
+
+
+_active: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _active
+    _active = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def fire(seam: str) -> Optional[str]:
+    """The seam hook. No plan installed → one global read and out."""
+    plan = _active
+    if plan is None:
+        return None
+    return plan.fire(seam)
+
+
+def _plan_from_env(spec: str) -> FaultPlan:
+    """``seam:kind@index[,...]`` — the soak tool's env-driven install."""
+    plan = FaultPlan()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        seam, _, rest = part.partition(":")
+        kind, _, idx = rest.partition("@")
+        plan.at(seam.strip(), int(idx) if idx else 0, Fault(kind or "raise"))
+    return plan
+
+
+if os.environ.get("EVG_FAULTS"):
+    install(_plan_from_env(os.environ["EVG_FAULTS"]))

@@ -32,6 +32,36 @@ _lock = threading.Lock()
 _sinks: List[Sink] = []
 _threshold = LEVELS["info"]
 
+# -- counters ---------------------------------------------------------------- #
+# Process-local monotonic counters riding beside the log stream (the
+# reference's grip counters / expvar-style stats). Resilience breadcrumbs
+# (breaker transitions, retry exhaustion, degraded ticks, quarantined
+# jobs) bump these so a soak run is auditable without parsing every line.
+
+_counter_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def incr_counter(name: str, by: int = 1) -> int:
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + by
+        return _counters[name]
+
+
+def get_counter(name: str) -> int:
+    with _counter_lock:
+        return _counters.get(name, 0)
+
+
+def counters_snapshot() -> Dict[str, int]:
+    with _counter_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counter_lock:
+        _counters.clear()
+
 
 def set_level(level: str) -> None:
     global _threshold

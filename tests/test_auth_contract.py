@@ -123,6 +123,9 @@ class _FakeGithubState:
         #: orgs whose membership endpoint answers 403 (bad token scope /
         #: rate limited) instead of a yes/no
         self.forbidden_orgs: set = set()
+        #: orgs whose membership endpoint answers 302 → public-members
+        #: (GitHub's shape when the token lacks read:org)
+        self.redirect_orgs: set = set()
 
 
 def _serve(handler_cls):
@@ -176,6 +179,30 @@ def github_idp():
                     return self._json(
                         403, {"message": "Must have admin rights"}
                     )
+                if org in state.redirect_orgs:
+                    # GitHub 302s a scope-less requester to the public
+                    # membership endpoint
+                    self.send_response(302)
+                    self.send_header(
+                        "Location",
+                        f"/orgs/{org}/public_members/{login}",
+                    )
+                    self.end_headers()
+                    return None
+                if login in state.org_members.get(org, set()):
+                    self.send_response(204)
+                    self.end_headers()
+                    return None
+                return self._json(404, {"message": "Not Found"})
+            if (
+                len(parts) == 4
+                and parts[0] == "orgs"
+                and parts[2] == "public_members"
+            ):
+                # the redirect TARGET: says 204 for public members — if
+                # the client silently followed the 302 it would wrongly
+                # conflate this with a private-membership yes
+                org, login = parts[1], parts[3]
                 if login in state.org_members.get(org, set()):
                     self.send_response(204)
                     self.end_headers()
@@ -250,6 +277,21 @@ class TestGithubContract:
         client = _github_client(base)
         with pytest.raises(AuthError, match="HTTP 403"):
             client.user_in_organization("t", "octocat", "evergreen-ci")
+
+    def test_org_302_is_observed_not_followed(self, github_idp):
+        """A scope-less token gets a 302 → public-members; the client
+        must OBSERVE the 302 (not a member) instead of silently
+        following it to the public endpoint's 204 — which would admit a
+        public member of the org without ever checking private
+        membership (ADVICE r5 #1)."""
+        state, base = github_idp
+        state.redirect_orgs.add("evergreen-ci")
+        client = _github_client(base)
+        # octocat IS a public member (204 at the redirect target); the
+        # unfollowed 302 still reads as not-a-member
+        assert not client.user_in_organization(
+            "t", "octocat", "evergreen-ci"
+        )
 
     def test_non_member_rejected_unless_allowlisted(self, github_idp):
         state, base = github_idp
@@ -485,6 +527,32 @@ class TestOidcContract:
         state.add_code("kid", {"email": "dev@example.com"}, kid="other-key")
         with pytest.raises(AuthError, match="no JWKS key"):
             _oidc_client(base).exchange_code("kid")
+
+    def test_key_rotation_under_reused_kid_self_heals(self, okta_idp):
+        """The issuer rotated its key but kept the kid: a client holding
+        the stale cached (n, e) must refetch the JWKS once and retry
+        verification instead of failing every login until restart
+        (ADVICE r5 #2)."""
+        state, base = okta_idp
+        state.add_code("rot", {"email": "dev@example.com"})
+        client = _oidc_client(base)
+        # poison the cache with a stale pre-rotation key under the SAME
+        # kid (any modulus that is not the live signing key)
+        client._jwks[KID] = (RSA_N + 2, RSA_E)
+        claims = client.exchange_code("rot")
+        assert claims is not None and claims["email"] == "dev@example.com"
+        # the refetch replaced the stale entry with the live key
+        assert client._jwks[KID] == (RSA_N, RSA_E)
+
+    def test_rotation_refetch_does_not_mask_bad_signatures(self, okta_idp):
+        """The one-shot refetch is for rotation only: a genuinely
+        tampered token still fails after the refreshed key re-check."""
+        state, base = okta_idp
+        state.add_code("rot2", {"email": "dev@example.com"}, tamper=True)
+        client = _oidc_client(base)
+        client._jwks[KID] = (RSA_N + 2, RSA_E)
+        with pytest.raises(AuthError, match="signature"):
+            client.exchange_code("rot2")
 
     def test_group_claim_mismatch(self, okta_idp):
         state, base = okta_idp

@@ -1,0 +1,30 @@
+"""The BENCH JSON line must not advertise an unproven pipelined number
+(VERDICT r5 ask #3): ``pipelined_tick_ms`` appears only when
+``overlap_proven`` is true."""
+from evergreen_tpu.utils.benchgen import bench_result_payload
+
+_KW = dict(
+    tpu_ms=60.0,
+    serial_ms=600.0,
+    backend="cpu",
+    seq_ms=60.0,
+    pipe_med=55.0,
+    overlap_eff=0.1,
+    churn={"churn_ms": 100.0, "store_steady_ms": 80.0},
+    probe_history=[],
+)
+
+
+def test_pipelined_field_absent_when_unproven():
+    out = bench_result_payload(overlap_proven=False, **_KW)
+    assert "pipelined_tick_ms" not in out
+    assert out["overlap_proven"] is False
+    # the proof trail still ships
+    assert out["overlap_efficiency"] == 0.1
+    assert out["sequential_tick_ms"] == 60.0
+
+
+def test_pipelined_field_present_when_proven():
+    out = bench_result_payload(overlap_proven=True, **_KW)
+    assert out["pipelined_tick_ms"] == 55.0
+    assert out["overlap_proven"] is True

@@ -3,7 +3,12 @@
 spirit applied to other subsystems)."""
 import string
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# the container may not carry hypothesis; a missing optional dep must
+# skip this module, not error the whole collection
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from evergreen_tpu.ingestion.parser import ProjectParseError, parse_project
 from evergreen_tpu.ingestion.validator import validate_project

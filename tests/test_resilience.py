@@ -1,0 +1,299 @@
+"""Tick-pipeline resilience: the fault matrix (tools/fault_matrix.py)
+plus unit coverage for the RetryPolicy/Deadline, the circuit breaker, and
+the degradation bookkeeping run_tick now carries.
+
+Acceptance contract (ISSUE 1): for every injected fault class — solve
+raise, solve hang past deadline, WAL write error (+ torn write), lease
+loss, agent-comm timeout, provider error, sender error — the tick
+completes (possibly degraded) with the store consistent; the breaker's
+serial-fallback tick passes the solver-parity check; and the breaker's
+open → half-open → closed cycle is asserted via the structured log.
+"""
+import random
+
+import pytest
+
+from evergreen_tpu.utils import faults
+from evergreen_tpu.utils import log as log_mod
+from evergreen_tpu.utils.circuit import CircuitBreaker
+from evergreen_tpu.utils.faults import Fault, FaultPlan
+from evergreen_tpu.utils.retry import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+)
+
+from tools.fault_matrix import CASES, run_case
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    yield
+    faults.uninstall()
+    log_mod.reset_counters()
+
+
+# --------------------------------------------------------------------------- #
+# the fault matrix — one case per injected fault class
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_fault_matrix(case, store):
+    out = run_case(case, seed=0)
+    assert out["ok"], {
+        k: v for k, v in out.items() if k != "logs"
+    }
+
+
+def test_fault_matrix_replays_with_seed(store):
+    """A seeded schedule is deterministic: same seed, same firing
+    pattern."""
+    a = FaultPlan.seeded(42, {"wal.append": 0.2}, horizon=50)
+    b = FaultPlan.seeded(42, {"wal.append": 0.2}, horizon=50)
+    assert a._at == {} or a._at.keys() == b._at.keys()
+    assert {
+        s: sorted(d) for s, d in a._at.items()
+    } == {s: sorted(d) for s, d in b._at.items()}
+
+
+def test_breaker_fallback_parity_detail(store):
+    """The degraded tick's persisted ordering equals the serial oracle's
+    — spelled out beyond the matrix case so a parity break names the
+    distro."""
+    from evergreen_tpu.models.task_queue import COLLECTION, doc_column
+    from evergreen_tpu.scheduler import serial
+    from evergreen_tpu.scheduler.wrapper import (
+        TickOptions,
+        gather_tick_inputs,
+        run_tick,
+    )
+    from evergreen_tpu.utils.benchgen import NOW
+    from tools.fault_matrix import _seed_store
+
+    _seed_store(store, n_distros=2, n_tasks=40, seed=3)
+    faults.install(FaultPlan().always("scheduler.solve", Fault("raise")))
+    res = run_tick(
+        store,
+        TickOptions(underwater_unschedule=False),
+        now=NOW,
+    )
+    faults.uninstall()
+    assert res.planner_used == "serial" and res.degraded == "solve-failed"
+    distros, tbd, *_ = gather_tick_inputs(store, NOW)
+    for d in distros:
+        want = [
+            t.id
+            for t in serial.plan_distro_queue(d, tbd.get(d.id, []), NOW)[0]
+        ]
+        doc = store.collection(COLLECTION).get(d.id)
+        assert doc is not None, d.id
+        assert doc_column(doc, "id") == want, d.id
+
+
+# --------------------------------------------------------------------------- #
+# RetryPolicy / Deadline
+# --------------------------------------------------------------------------- #
+
+
+def test_retry_policy_bounded_attempts_and_breadcrumbs():
+    got = []
+    log_mod.reset_sinks(got.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ValueError("nope")
+
+    policy = RetryPolicy(attempts=3, base_backoff_s=0.0)
+    with pytest.raises(ValueError):
+        policy.call(flaky, operation="unit-test", sleep=lambda s: None)
+    log_mod.reset_sinks()
+    assert len(calls) == 3
+    assert log_mod.get_counter("retry.exhausted") == 1
+    assert log_mod.get_counter("retry.exhausted.unit-test") == 1
+    (rec,) = [r for r in got if r.get("message") == "retry-exhausted"]
+    assert rec["attempts"] == 3 and rec["operation"] == "unit-test"
+
+
+def test_retry_policy_succeeds_mid_sequence():
+    state = {"n": 0}
+
+    def eventually():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ValueError("warming up")
+        return "ok"
+
+    policy = RetryPolicy(attempts=5, base_backoff_s=0.0)
+    assert policy.call(eventually, sleep=lambda s: None) == "ok"
+    assert state["n"] == 3
+    assert log_mod.get_counter("retry.exhausted") == 0
+
+
+def test_retry_policy_jitter_is_replayable():
+    policy = RetryPolicy(attempts=4, base_backoff_s=0.5, jitter=0.5)
+    a = [policy.backoff_s(i, random.Random(9)) for i in range(3)]
+    b = [policy.backoff_s(i, random.Random(9)) for i in range(3)]
+    assert a == b
+    # exponential envelope holds under jitter
+    assert all(
+        0.25 * (2 ** i) <= v <= 0.5 * (2 ** i) for i, v in zip(range(3), a)
+    )
+
+
+def test_retry_policy_gives_up_when_deadline_dies_first():
+    clock = {"t": 0.0}
+    sleeps = []
+
+    def flaky():
+        raise ValueError("nope")
+
+    policy = RetryPolicy(attempts=10, base_backoff_s=5.0, jitter=0.0)
+    deadline = Deadline(6.0, clock=lambda: clock["t"])
+    with pytest.raises(ValueError):
+        policy.call(
+            flaky, deadline=deadline, sleep=sleeps.append
+        )
+    # first backoff (5s) fits the 6s budget; the second (10s) does not —
+    # bounded attempts stop at 2 calls, 1 sleep
+    assert len(sleeps) == 1
+
+
+def test_deadline_check_raises():
+    clock = {"t": 0.0}
+    d = Deadline(1.0, clock=lambda: clock["t"])
+    d.check()
+    clock["t"] = 2.0
+    assert d.exceeded()
+    with pytest.raises(DeadlineExceeded):
+        d.check("unit")
+    assert Deadline(None).remaining() == float("inf")
+
+
+# --------------------------------------------------------------------------- #
+# CircuitBreaker
+# --------------------------------------------------------------------------- #
+
+
+def test_breaker_full_cycle_with_log():
+    got = []
+    log_mod.reset_sinks(got.append)
+    b = CircuitBreaker("unit", failure_threshold=2, cooldown_s=10.0)
+    assert b.allow(now=0.0)
+    b.record_failure(now=0.0)
+    assert b.state == "closed" and b.allow(now=0.1)
+    b.record_failure(now=0.2)
+    assert b.state == "open"
+    assert not b.allow(now=1.0)  # cooling down
+    assert b.allow(now=11.0)  # half-open probe admitted
+    assert b.state == "half-open"
+    assert not b.allow(now=11.0)  # only one probe at a time
+    b.record_success(now=11.5)
+    assert b.state == "closed" and b.allow(now=12.0)
+    log_mod.reset_sinks()
+    transitions = [
+        (r["from_state"], r["to_state"])
+        for r in got
+        if r.get("message") == "breaker-transition"
+    ]
+    assert transitions == [
+        ("closed", "open"),
+        ("open", "half-open"),
+        ("half-open", "closed"),
+    ]
+    assert log_mod.get_counter("breaker.unit.open") == 1
+
+
+def test_breaker_failed_probe_reopens():
+    b = CircuitBreaker("unit2", failure_threshold=1, cooldown_s=10.0)
+    b.record_failure(now=0.0)
+    assert b.state == "open"
+    assert b.allow(now=11.0)
+    b.record_failure(now=11.1)  # probe failed
+    assert b.state == "open"
+    assert not b.allow(now=12.0)  # cooldown restarted
+    assert b.allow(now=22.0)
+    b.record_success(now=22.1)
+    assert b.state == "closed"
+
+
+# --------------------------------------------------------------------------- #
+# fault injector
+# --------------------------------------------------------------------------- #
+
+
+def test_faults_noop_without_plan():
+    assert faults.fire("scheduler.solve") is None
+
+
+def test_faults_fire_at_index_and_audit():
+    plan = faults.install(
+        FaultPlan().at("x", 1, Fault("raise")).at("x", 2, Fault("weird"))
+    )
+    assert faults.fire("x") is None  # call 0
+    with pytest.raises(faults.FaultError):
+        faults.fire("x")  # call 1
+    assert faults.fire("x") == "weird"  # call 2: directive returned
+    assert plan.fired == [("x", 1, "raise"), ("x", 2, "weird")]
+    assert log_mod.get_counter("faults.fired.x") == 2
+
+
+def test_faults_env_spec_parsing():
+    plan = faults._plan_from_env("a:raise@2, b:torn@0,c:hang")
+    assert set(plan._at) == {"a", "b", "c"}
+    assert plan._at["a"][2].kind == "raise"
+    assert plan._at["b"][0].kind == "torn"
+    assert plan._at["c"][0].kind == "hang"
+
+
+def test_agent_comm_default_fault_kind_maps_to_connection_error():
+    """A bare `agent.comm:raise` env-spec fault (default FaultError) must
+    ride the same retry → ConnectionError contract as a real transport
+    failure — the agent loop never sees a raw RuntimeError."""
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+
+    comm = RestCommunicator("http://127.0.0.1:9", retries=2, backoff_s=0.0)
+    plan = faults.install(FaultPlan().always("agent.comm", Fault("raise")))
+    with pytest.raises(ConnectionError):
+        comm.start_task("t1")
+    faults.uninstall()
+    assert plan._calls.get("agent.comm") == 2  # retried, then bounded
+
+
+# --------------------------------------------------------------------------- #
+# tick budget / degradation bookkeeping
+# --------------------------------------------------------------------------- #
+
+
+def test_unbudgeted_tick_sheds_nothing(store):
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.utils.benchgen import NOW
+    from tools.fault_matrix import _seed_store
+
+    _seed_store(store, n_distros=2, n_tasks=30, seed=5)
+    res = run_tick(
+        store, TickOptions(underwater_unschedule=False), now=NOW
+    )
+    assert res.shed == [] and res.degraded == ""
+    assert res.planner_used == "tpu"
+    # stats ran: the tick span landed
+    assert store.collection("spans").find(lambda d: True)
+
+
+def test_runtime_stats_line_carries_degradation_fields(store):
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.utils.benchgen import NOW
+    from tools.fault_matrix import _seed_store
+
+    got = []
+    log_mod.reset_sinks(got.append)
+    _seed_store(store, n_distros=2, n_tasks=30, seed=6)
+    faults.install(FaultPlan().always("scheduler.solve", Fault("raise")))
+    run_tick(store, TickOptions(underwater_unschedule=False), now=NOW)
+    faults.uninstall()
+    log_mod.reset_sinks()
+    (stats,) = [r for r in got if r.get("message") == "runtime-stats"]
+    assert stats["planner_used"] == "serial"
+    assert stats["degraded"] == "solve-failed"
+    assert any(r.get("message") == "degraded-tick" for r in got)

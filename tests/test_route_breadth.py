@@ -307,6 +307,39 @@ def test_project_events_same_timestamp_boundary(api, store):
     assert seen == [3, 2, 1, 0]  # numeric-seq tiebreak keeps newest first
 
 
+def test_project_events_non_numeric_ids_page_cleanly(api, store):
+    """Ids that don't parse as ``evt-{n}`` fall back to lexicographic
+    comparison (ADVICE r5 #4): same-timestamp events at a page boundary
+    are neither skipped nor duplicated."""
+    from evergreen_tpu.models.event import Event
+
+    _seed_project(store)
+    for suffix in ("aaa", "bbb", "ccc", "ddd"):
+        event_mod.coll(store).insert(
+            Event(
+                id=f"custom-{suffix}",
+                resource_type=event_mod.RESOURCE_PROJECT,
+                event_type="PROJECT_MODIFIED",
+                resource_id="proj",
+                timestamp=3000.0,
+                data={"tag": suffix},
+            ).to_doc()
+        )
+    seen = []
+    st, body = api.handle(
+        "GET", "/rest/v2/projects/proj/events", {"limit": 3}, {}
+    )
+    assert st == 200
+    seen += [e["data"]["tag"] for e in body["events"]]
+    st, body = api.handle(
+        "GET", "/rest/v2/projects/proj/events",
+        {"limit": 3, "ts": body["next_ts"], "id": body["next_id"]}, {},
+    )
+    seen += [e["data"]["tag"] for e in body["events"]]
+    assert sorted(seen) == ["aaa", "bbb", "ccc", "ddd"]  # none lost/doubled
+    assert seen == ["ddd", "ccc", "bbb", "aaa"]  # lexicographic, newest first
+
+
 def test_project_events_pagination(api, store):
     _seed_project(store)
     for i in range(5):

@@ -1,6 +1,7 @@
 """Explicit distro-sharded shard_map solve: per-device blocks must equal
 independent local solves (parallel/sharded.py)."""
 import numpy as np
+import pytest
 
 from evergreen_tpu.ops.solve import run_solve
 from evergreen_tpu.parallel.mesh import make_mesh
@@ -20,14 +21,18 @@ def test_partition_balances_by_task_count():
     assert max(loads) - min(loads) <= max(len(tbd[d.id]) for d in distros)
 
 
-def test_shard_map_blocks_match_local_solves(store):
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_shard_map_blocks_match_local_solves(store, n_dev):
+    """Equality at every mesh size the padding-to-common-dims path can
+    see (VERDICT r5 ask #10) — device counts off the happy-path 8 hit
+    different shard shapes."""
     problem = generate_problem(
         10, 500, seed=41, task_group_fraction=0.3, hosts_per_distro=3
     )
-    n_dev = 4
     subs, stacked = build_sharded_snapshot(*problem, NOW, n_dev)
     mesh = make_mesh(n_dev)
     out = sharded_solve_fn(mesh)(stacked)
+    assert len(subs) == n_dev
     for si, sub in enumerate(subs):
         ref = run_solve(sub.arrays)
         np.testing.assert_array_equal(np.asarray(out["order"][si]),

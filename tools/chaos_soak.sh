@@ -1,8 +1,17 @@
 #!/usr/bin/env bash
 # Chaos soak: service + agents + revision pushes + random aborts/restarts.
+#
+# Fault-matrix mode (`--faults`, or FAULT_MATRIX=1): instead of the
+# service soak, run every injected-fault class from tools/fault_matrix.py
+# across several seeds — solve raise/hang, WAL error + torn write, lease
+# loss, agent-comm timeout, provider error, sender error, breaker cycle,
+# job quarantine, tick-budget shed. Exits nonzero if any case fails.
 set -e
 export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$PYTHONPATH"
+if [ "${1:-}" = "--faults" ] || [ -n "${FAULT_MATRIX:-}" ]; then
+  exec python tools/fault_matrix.py --seeds "${SEEDS:-3}"
+fi
 PORT=${PORT:-19270}
 python -m evergreen_tpu service --port $PORT > /tmp/chaos_svc.log 2>&1 &
 SVC=$!

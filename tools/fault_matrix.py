@@ -1,0 +1,518 @@
+#!/usr/bin/env python
+"""Fault-matrix soak: every injected fault class must leave the tick
+pipeline alive and the store consistent.
+
+One case per fault class from the resilience layer (utils/faults.py
+seams): solve raise, solve hang past deadline, WAL write error, torn WAL
+write, lease loss, agent-comm timeout, cloud-provider error, event-sender
+error, plus the breaker's full open→half-open→closed cycle and the job
+quarantine. Each case builds its own store, installs a deterministic
+FaultPlan, runs the pipeline, and returns a result dict with ``ok`` and
+the captured structured-log records — `tests/test_resilience.py`
+parametrizes over the same registry, and ``tools/chaos_soak.sh --faults``
+runs it standalone against several seeds.
+"""
+from __future__ import annotations
+
+import tempfile
+from typing import Callable, Dict, List
+
+from evergreen_tpu.globals import HostStatus, Provider
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.task_queue import COLLECTION as TQ_COLLECTION
+from evergreen_tpu.models.task_queue import doc_column
+from evergreen_tpu.scheduler import serial
+from evergreen_tpu.scheduler.wrapper import (
+    SOLVE_BREAKER_COOLDOWN_S,
+    SOLVE_BREAKER_THRESHOLD,
+    TickOptions,
+    run_tick,
+    solve_breaker_for,
+)
+from evergreen_tpu.storage.store import Store
+from evergreen_tpu.utils import faults
+from evergreen_tpu.utils import log as log_mod
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+from evergreen_tpu.utils.faults import Fault, FaultPlan
+
+OPTS = TickOptions(create_intent_hosts=True, underwater_unschedule=False)
+
+
+def _seed_store(store, n_distros: int = 3, n_tasks: int = 60, seed: int = 7):
+    """A small, fully-plannable problem inserted into ``store``."""
+    distros, tasks_by_distro, hosts_by_distro, _, _ = generate_problem(
+        n_distros, n_tasks, seed=seed, hosts_per_distro=2
+    )
+    for d in distros:
+        distro_mod.insert(store, d)
+    all_tasks = [t for ts in tasks_by_distro.values() for t in ts]
+    task_mod.insert_many(store, all_tasks)
+    for hs in hosts_by_distro.values():
+        host_mod.insert_many(store, hs)
+    return distros, tasks_by_distro, hosts_by_distro
+
+
+def _capture_logs():
+    got: List[dict] = []
+    log_mod.add_sink(got.append)
+    return got, lambda: log_mod.remove_sink(got.append)
+
+
+def _serial_parity(store, now: float) -> bool:
+    """The degraded tick's persisted queues must equal the serial
+    oracle's ordering — the existing solver-parity contract, applied to
+    the fallback path."""
+    from evergreen_tpu.models.task_queue import SECONDARY_COLLECTION
+    from evergreen_tpu.scheduler.wrapper import ALIAS_SUFFIX, gather_tick_inputs
+
+    distros, tbd, hbd, est, dm = gather_tick_inputs(store, now)
+    for d in distros:
+        is_alias = d.id.endswith(ALIAS_SUFFIX)
+        doc = store.collection(
+            SECONDARY_COLLECTION if is_alias else TQ_COLLECTION
+        ).get(d.id.split("::")[0])
+        if doc is None:
+            return False
+        want = [t.id for t in serial.plan_distro_queue(
+            d, tbd.get(d.id, []), now
+        )[0]]
+        got = doc_column(doc, "id")
+        if got != want:
+            return False
+    return True
+
+
+# --------------------------------------------------------------------------- #
+# cases
+# --------------------------------------------------------------------------- #
+
+
+def case_solve_raise(seed: int = 0) -> dict:
+    store = Store()
+    _seed_store(store, seed=seed + 7)
+    got, stop = _capture_logs()
+    faults.install(FaultPlan().always("scheduler.solve", Fault("raise")))
+    try:
+        res = run_tick(store, OPTS, now=NOW)
+    finally:
+        faults.uninstall()
+        stop()
+    return {
+        "ok": (
+            res.degraded == "solve-failed"
+            and res.planner_used == "serial"
+            and sum(res.queues.values()) > 0
+            and _serial_parity(store, NOW)
+        ),
+        "result": res,
+        "logs": got,
+    }
+
+
+def case_solve_hang(seed: int = 0) -> dict:
+    store = Store()
+    _seed_store(store, seed=seed + 11)
+    import dataclasses as _dc
+
+    opts = _dc.replace(OPTS, solve_deadline_s=0.05)
+    got, stop = _capture_logs()
+    faults.install(
+        FaultPlan().always("scheduler.solve", Fault("hang", delay_s=0.3))
+    )
+    try:
+        res = run_tick(store, opts, now=NOW)
+    finally:
+        faults.uninstall()
+        stop()
+    return {
+        "ok": (
+            res.degraded == "solve-deadline"
+            and res.planner_used == "serial"
+            and sum(res.queues.values()) > 0
+            and _serial_parity(store, NOW)
+        ),
+        "result": res,
+        "logs": got,
+    }
+
+
+def case_breaker_cycle(seed: int = 0) -> dict:
+    """THRESHOLD failing ticks trip the breaker open; the next tick is
+    refused (serial without touching the device); after the cooldown a
+    half-open probe succeeds and closes it."""
+    store = Store()
+    _seed_store(store, seed=seed + 13)
+    got, stop = _capture_logs()
+    plan = FaultPlan()
+    for i in range(SOLVE_BREAKER_THRESHOLD):
+        plan.at("scheduler.solve", i, Fault("raise"))
+    faults.install(plan)
+    try:
+        states = []
+        for k in range(SOLVE_BREAKER_THRESHOLD):
+            res = run_tick(store, OPTS, now=NOW + k)
+            states.append(res.degraded)
+        open_tick = run_tick(
+            store, OPTS, now=NOW + SOLVE_BREAKER_THRESHOLD
+        )
+        probe_tick = run_tick(
+            store, OPTS,
+            now=NOW + SOLVE_BREAKER_THRESHOLD + SOLVE_BREAKER_COOLDOWN_S + 1,
+        )
+    finally:
+        faults.uninstall()
+        stop()
+    transitions = [
+        (r.get("from_state"), r.get("to_state"))
+        for r in got
+        if r.get("message") == "breaker-transition"
+    ]
+    return {
+        "ok": (
+            all(s == "solve-failed" for s in states)
+            and open_tick.degraded == "breaker-open"
+            and probe_tick.planner_used == "tpu"
+            and probe_tick.degraded == ""
+            and ("closed", "open") in transitions
+            and ("open", "half-open") in transitions
+            and ("half-open", "closed") in transitions
+        ),
+        "transitions": transitions,
+        "logs": got,
+        "breaker_state": solve_breaker_for(store).state,
+    }
+
+
+def case_wal_error(seed: int = 0) -> dict:
+    from evergreen_tpu.storage.durable import DurableStore
+
+    data_dir = tempfile.mkdtemp(prefix="fault-wal-")
+    store = DurableStore(data_dir)
+    _seed_store(store, seed=seed + 17)
+    got, stop = _capture_logs()
+    # fire on the FIRST journaled write of the tick (seeding is done):
+    # that lands inside queue persist / intent creation, which must be
+    # isolated per distro
+    faults.install(
+        FaultPlan().at("wal.append", 0, Fault("raise", OSError("disk full")))
+    )
+    try:
+        res = run_tick(store, OPTS, now=NOW)
+    finally:
+        faults.uninstall()
+        stop()
+    # next tick (fault cleared) persists everything
+    res2 = run_tick(store, OPTS, now=NOW + 1)
+    # recovery from the same directory stays consistent
+    recovered = DurableStore(data_dir)
+    queues_survive = all(
+        recovered.collection(TQ_COLLECTION).get(did) is not None
+        for did in res2.queues
+        if not did.endswith("::alias")
+    )
+    return {
+        "ok": (
+            res.degraded == "persist-failed"
+            and sum(res2.queues.values()) > 0
+            and res2.degraded == ""
+            and queues_survive
+        ),
+        "result": res,
+        "logs": got,
+    }
+
+
+def case_wal_torn(seed: int = 0) -> dict:
+    from evergreen_tpu.storage.durable import DurableStore
+
+    data_dir = tempfile.mkdtemp(prefix="fault-torn-")
+    store = DurableStore(data_dir)
+    _seed_store(store, seed=seed + 19)
+    faults.install(FaultPlan().at("wal.append", 0, Fault("torn")))
+    try:
+        res = run_tick(store, OPTS, now=NOW)
+    finally:
+        faults.uninstall()
+    res2 = run_tick(store, OPTS, now=NOW + 1)
+    # recover WITHOUT close(): exactly the crash shape — snapshot (if
+    # any) + a WAL holding one torn stub and everything after it
+    recovered = DurableStore(data_dir)
+    queues_survive = all(
+        recovered.collection(TQ_COLLECTION).get(did) is not None
+        for did in res2.queues
+        if not did.endswith("::alias")
+    )
+    tasks_survive = (
+        len(recovered.collection("tasks").key_order())
+        == len(store.collection("tasks").key_order())
+    )
+    return {
+        "ok": (
+            res.degraded == "persist-failed"
+            and sum(res2.queues.values()) > 0
+            and queues_survive
+            and tasks_survive
+        ),
+        "result": res,
+    }
+
+
+def case_lease_loss(seed: int = 0) -> dict:
+    import os
+    import threading
+
+    from evergreen_tpu.storage.lease import FileLease
+
+    data_dir = tempfile.mkdtemp(prefix="fault-lease-")
+    lease = FileLease(os.path.join(data_dir, "lease.json"), ttl_s=0.3)
+    assert lease.try_acquire()
+    got, stop = _capture_logs()
+    lost_evt = threading.Event()
+    faults.install(FaultPlan().always("lease.renew", Fault("lost")))
+    try:
+        lease.start_renewing(on_lost=lost_evt.set)
+        fired = lost_evt.wait(timeout=5.0)
+    finally:
+        lease.stop_renewing()
+        faults.uninstall()
+        stop()
+    return {
+        "ok": (
+            fired
+            and lease.lost
+            and any(r.get("message") == "lease-lost" for r in got)
+        ),
+        "logs": got,
+    }
+
+
+def case_agent_comm(seed: int = 0) -> dict:
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+
+    got, stop = _capture_logs()
+    comm = RestCommunicator(
+        "http://127.0.0.1:9", retries=3, backoff_s=0.0
+    )
+    plan = faults.install(
+        FaultPlan().always(
+            "agent.comm", Fault("raise", TimeoutError("injected timeout"))
+        )
+    )
+    raised = False
+    try:
+        try:
+            comm.next_task("h1")
+        except ConnectionError:
+            raised = True
+    finally:
+        faults.uninstall()
+        stop()
+    return {
+        "ok": (
+            raised
+            and plan._calls.get("agent.comm") == 3  # bounded attempts
+            and any(r.get("message") == "retry-exhausted" for r in got)
+        ),
+        "logs": got,
+    }
+
+
+def case_provider_error(seed: int = 0) -> dict:
+    from evergreen_tpu.cloud.provisioning import (
+        MAX_PROVISION_ATTEMPTS,
+        create_hosts_from_intents,
+    )
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.host import new_intent
+
+    store = Store()
+    distro_mod.insert(store, Distro(id="dp", provider=Provider.MOCK.value))
+    intent = new_intent("dp", Provider.MOCK.value)
+    host_mod.insert(store, intent)
+    got, stop = _capture_logs()
+    faults.install(FaultPlan().always("cloud.spawn", Fault("raise")))
+    try:
+        for k in range(MAX_PROVISION_ATTEMPTS):
+            spawned = create_hosts_from_intents(store, now=NOW + k)
+    finally:
+        faults.uninstall()
+        stop()
+    h = host_mod.get(store, intent.id)
+    # _poison marks PROVISION_FAILED then asks the provider to terminate,
+    # which may advance it to TERMINATED — both are poisoned end states
+    poisoned = h is not None and h.status in (
+        HostStatus.PROVISION_FAILED.value,
+        HostStatus.TERMINATED.value,
+    )
+    return {
+        "ok": (
+            spawned == []
+            and poisoned
+            and h.provision_attempts == MAX_PROVISION_ATTEMPTS
+            and any(
+                r.get("message") == "host-spawn-failed" for r in got
+            )
+        ),
+        "logs": got,
+    }
+
+
+def case_sender_error(seed: int = 0) -> dict:
+    from evergreen_tpu.events.senders import OUTBOX, insert_outbox_row
+    from evergreen_tpu.events.transports import drain_outboxes
+
+    store = Store()
+    insert_outbox_row(
+        store, OUTBOX["slack"],
+        {"channel_type": "slack", "slack_channel": "#x", "text": "hi"},
+    )
+
+    class _Recorder:
+        def __init__(self):
+            self.delivered = []
+
+        def deliver(self, doc):
+            self.delivered.append(doc["_id"])
+
+    slack = _Recorder()
+    got, stop = _capture_logs()
+    faults.install(FaultPlan().always("events.deliver", Fault("raise")))
+    try:
+        for _ in range(3):
+            drain_outboxes(store, transports={"slack": slack}, now=NOW)
+    finally:
+        faults.uninstall()
+        stop()
+    row = store.collection(OUTBOX["slack"]).find(lambda d: True)[0]
+    # fault cleared: a fresh row delivers — the channel recovered
+    insert_outbox_row(
+        store, OUTBOX["slack"],
+        {"channel_type": "slack", "slack_channel": "#x", "text": "again"},
+    )
+    drain_outboxes(store, transports={"slack": slack}, now=NOW + 1)
+    return {
+        "ok": (
+            row.get("failed") is True
+            and row.get("attempts") == 3
+            and len(slack.delivered) == 1
+            and any(
+                r.get("message") == "outbox-row-abandoned" for r in got
+            )
+        ),
+        "logs": got,
+    }
+
+
+def case_job_quarantine(seed: int = 0) -> dict:
+    from evergreen_tpu.queue.jobs import FnJob, JobQueue
+
+    store = Store()
+    q = JobQueue(store, workers=1, poison_threshold=2, quarantine_s=60.0)
+    got, stop = _capture_logs()
+
+    def boom(s):
+        raise RuntimeError("poison")
+
+    try:
+        for i in range(2):
+            assert q.put(FnJob(f"poison-{i}", boom, job_type="poison"))
+            q.wait_idle(5.0)
+        dropped = not q.put(FnJob("poison-2", boom, job_type="poison"))
+        other_ok = q.put(FnJob("fine-0", lambda s: None, job_type="fine"))
+        q.wait_idle(5.0)
+        # cooldown elapses → exactly one probe runs; success lifts it
+        with q._lock:
+            q._quarantined_until["poison"] = 0.0
+        probe_ok = q.put(
+            FnJob("probe-0", lambda s: None, job_type="poison")
+        )
+        q.wait_idle(5.0)
+        lifted = q.put(FnJob("after-0", lambda s: None, job_type="poison"))
+        q.wait_idle(5.0)
+    finally:
+        stop()
+        q.close()
+    return {
+        "ok": (
+            dropped
+            and other_ok
+            and probe_ok
+            and lifted
+            and any(r.get("message") == "job-quarantined" for r in got)
+            and any(
+                r.get("message") == "job-quarantine-lifted" for r in got
+            )
+        ),
+        "logs": got,
+    }
+
+
+def case_tick_budget_shed(seed: int = 0) -> dict:
+    import dataclasses as _dc
+
+    store = Store()
+    _seed_store(store, seed=seed + 23)
+    opts = _dc.replace(OPTS, tick_budget_s=1e-9)
+    got, stop = _capture_logs()
+    try:
+        res = run_tick(store, opts, now=NOW)
+    finally:
+        stop()
+    # planning is never shed: queues persisted despite the blown budget
+    return {
+        "ok": (
+            sum(res.queues.values()) > 0
+            and "stats" in res.shed
+            and any(r.get("message") == "degraded-tick" for r in got)
+            and not store.collection("spans").find(lambda d: True)
+        ),
+        "result": res,
+        "logs": got,
+    }
+
+
+CASES: Dict[str, Callable[[int], dict]] = {
+    "solve-raise": case_solve_raise,
+    "solve-hang": case_solve_hang,
+    "breaker-cycle": case_breaker_cycle,
+    "wal-error": case_wal_error,
+    "wal-torn": case_wal_torn,
+    "lease-loss": case_lease_loss,
+    "agent-comm": case_agent_comm,
+    "provider-error": case_provider_error,
+    "sender-error": case_sender_error,
+    "job-quarantine": case_job_quarantine,
+    "tick-budget-shed": case_tick_budget_shed,
+}
+
+
+def run_case(name: str, seed: int = 0) -> dict:
+    return CASES[name](seed)
+
+
+def main() -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seeds", type=int, default=3)
+    p.add_argument("--case", default="", help="run one case only")
+    args = p.parse_args()
+    names = [args.case] if args.case else sorted(CASES)
+    failures = 0
+    for seed in range(args.seeds):
+        for name in names:
+            out = run_case(name, seed)
+            ok = bool(out.get("ok"))
+            failures += 0 if ok else 1
+            print(json.dumps({"case": name, "seed": seed, "ok": ok}))
+    print(json.dumps({"fault_matrix_failures": failures}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
