@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit
+.PHONY: test gate hooks bench multichip native commit perf-guard
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -27,6 +27,13 @@ gate:
 
 bench:
 	python bench.py
+
+# store-path regression guard (slow; excluded from tier-1): churn ticks
+# must stay <= 2x store-backed steady ticks and the churn store
+# component must hold the checked-in floor (tools/perf_floor.json;
+# refresh with `python tools/perf_guard.py --write-floor`)
+perf-guard:
+	python tools/perf_guard.py
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -328,29 +328,31 @@ def mark_scheduled(
     counting pre-dependency wait)."""
     c = coll(store)
     deps_met_set = set(deps_met_ids)
-    n = 0
-    for tid in task_ids:
-        # check-before-mutate: mutate() fires change notifications, and a
-        # steady-state tick must not dirty 50k unchanged tasks
-        doc = c.get(tid)
-        if doc is None:
-            continue
-        needs_sched = doc.get("scheduled_time", 0.0) <= 0.0
-        needs_dmt = (
-            tid in deps_met_set and doc.get("dependencies_met_time", 0.0) <= 0.0
-        )
-        if not (needs_sched or needs_dmt):
-            continue
-
-        def stamp(d: dict) -> None:
-            nonlocal n
-            if d.get("scheduled_time", 0.0) <= 0.0:
-                d["scheduled_time"] = when
-                n += 1
-            if tid in deps_met_set and d.get("dependencies_met_time", 0.0) <= 0.0:
-                d["dependencies_met_time"] = when
-
-        c.mutate(tid, stamp)
+    # check-before-mutate (a steady-state tick must not dirty unchanged
+    # tasks), then ONE batched update per stamp kind: each bulk_update is
+    # a single lock acquisition and a single WAL record instead of a
+    # mutate round per task; the only_if predicate re-checks under the
+    # lock so a concurrent stamp can't be double-applied
+    docs = c.find_ids(task_ids)
+    sched_ids = [
+        d["_id"] for d in docs if d.get("scheduled_time", 0.0) <= 0.0
+    ]
+    dmt_ids = [
+        d["_id"]
+        for d in docs
+        if d["_id"] in deps_met_set
+        and d.get("dependencies_met_time", 0.0) <= 0.0
+    ]
+    n = c.bulk_update(
+        sched_ids,
+        {"scheduled_time": when},
+        only_if=lambda d: d.get("scheduled_time", 0.0) <= 0.0,
+    )
+    c.bulk_update(
+        dmt_ids,
+        {"dependencies_met_time": when},
+        only_if=lambda d: d.get("dependencies_met_time", 0.0) <= 0.0,
+    )
     return n
 
 

@@ -37,9 +37,14 @@ class Arena:
         self._layout[name] = (kind, self._sizes[kind], size)
         self._sizes[kind] += size
 
-    def finalize(self) -> None:
-        for kind, total in self._sizes.items():
-            self._bufs[kind] = np.zeros(max(total, 1), dtype=_DTYPES[kind])
+    def finalize(self, pool: "ArenaPool" = None) -> None:
+        if pool is not None:
+            self._bufs = pool.take(self._sizes)
+        else:
+            for kind, total in self._sizes.items():
+                self._bufs[kind] = np.zeros(
+                    max(total, 1), dtype=_DTYPES[kind]
+                )
         self._finalized = True
 
     def view(self, name: str) -> np.ndarray:
@@ -53,6 +58,52 @@ class Arena:
     def layout_key(self) -> Tuple:
         """Hashable static layout for jit."""
         return tuple(self._plan)
+
+
+class ArenaPool:
+    """Double-buffered arena backing store.
+
+    The pipelined tick keeps at most TWO snapshots in flight (the packer
+    writes snapshot t+1 while the device still reads snapshot t's
+    buffers), so two rotating buffer sets per layout suffice — and
+    rotating them means the steady-state tick does one memset per buffer
+    instead of a fresh multi-MB allocation + page-fault walk. The caller
+    owns the pool (one per scheduler store, one per bench loop) and must
+    not keep more than ``depth`` pooled snapshots alive at once.
+    """
+
+    #: distinct layouts kept before the oldest is dropped (dim-bucket
+    #: hysteresis keeps the live set tiny; this only bounds churn walks)
+    MAX_LAYOUTS = 4
+
+    def __init__(self, depth: int = 2) -> None:
+        self.depth = depth
+        self._slots: Dict[Tuple, List[Dict[str, np.ndarray]]] = {}
+        self._next: Dict[Tuple, int] = {}
+
+    def take(self, sizes: Dict[str, int]) -> Dict[str, np.ndarray]:
+        key = tuple(sorted(sizes.items()))
+        slots = self._slots.get(key)
+        if slots is None:
+            while len(self._slots) >= self.MAX_LAYOUTS:
+                oldest = next(iter(self._slots))
+                del self._slots[oldest]
+                del self._next[oldest]
+            slots = self._slots[key] = []
+            self._next[key] = 0
+        i = self._next[key]
+        self._next[key] = (i + 1) % self.depth
+        if len(slots) < self.depth:
+            bufs = {
+                kind: np.zeros(max(total, 1), dtype=_DTYPES[kind])
+                for kind, total in sizes.items()
+            }
+            slots.append(bufs)
+            return bufs
+        bufs = slots[i]
+        for b in bufs.values():
+            b.fill(0)
+        return bufs
 
 
 def unpack(bufs: Dict, layout_key: Tuple) -> Dict:

@@ -38,10 +38,13 @@ class TickCache:
         self._primed = False
         #: runnable task id → materialized Task
         self._runnable: Dict[str, Task] = {}
-        #: (store insertion rank, Task) kept sorted — maintained
-        #: incrementally so the per-tick "emit in cold-scan order" contract
-        #: costs O(changes) instead of a full 50k-key sort every tick
+        #: (store insertion rank, Task) kept sorted. Rebuilt LAZILY: the
+        #: tick path consumes only the per-distro views below, so churn
+        #: drains just flag this stale instead of paying a 50k-entry
+        #: filter + re-sort per tick; runnable_in_store_order (tests,
+        #: non-tick callers) rebuilds on demand
         self._sorted: List[Tuple[int, Task]] = []
+        self._sorted_stale = False
         #: per-distro (rank, Task) entries + the exported plain lists.
         #: Exported list OBJECTS are regenerated only for distros whose
         #: membership changed — an unchanged distro hands the snapshot
@@ -197,16 +200,8 @@ class TickCache:
                     dirty_alias.update(old.secondary_distros)
                     self._drop_dep_index(tid)
                     n += 1
-            if gone:
-                self._sorted = [
-                    e for e in self._sorted if e[1].id not in gone
-                ]
-            if fresh:
-                # plain tuple compare (ranks are unique, so the Task in
-                # slot 1 is never compared); timsort exploits the sorted
-                # prefix: O(n + k log k) comparisons at C speed
-                self._sorted.extend(sorted(fresh))
-                self._sorted.sort()
+            if gone or fresh:
+                self._sorted_stale = True
             self._patch_distro_lists(
                 dirty_primary, fresh_primary, gone,
                 self._distro_entries, self._distro_lists,
@@ -323,6 +318,13 @@ class TickCache:
         planner, serial.py, so ordering is part of correctness)."""
         self.apply_dirty()
         with self._lock:
+            if self._sorted_stale:
+                order = task_mod.coll(self.store).key_order()
+                self._sorted = sorted(
+                    (order.get(t.id, 1 << 60), t)
+                    for t in self._runnable.values()
+                )
+                self._sorted_stale = False
             return [t for _, t in self._sorted]
 
     def gather(self, now: float) -> Tuple:

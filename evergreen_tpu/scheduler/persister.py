@@ -4,16 +4,184 @@ Reference: scheduler/task_queue_persister.go:17-84 (PersistTaskQueue +
 capTaskQueueLength). The cap keeps straddling task groups whole: if the cut
 point lands inside a task-group run, the whole group straddling the boundary
 is retained.
+
+Delta persistence: the store path must scale with CHURN size, not queue
+size. A per-distro fingerprint (``PersisterState``) remembers the last
+written plan (by task-instance identity — the TickCache replaces changed
+docs with new instances, so identical instances ⇒ identical rows), the
+dynamic columns, and the doc object itself. Per tick each distro then
+takes one of three write shapes:
+
+  * skip        — plan, sort values, deps-met AND info all unchanged: no
+                  write at all (``generated_at`` intentionally stays put;
+                  the dispatcher's staleness stamp only matters when
+                  content changed).
+  * column patch — same plan, changed dynamics: a versioned field patch
+                  (``Collection.patch``) writes only sort_value /
+                  dependencies_met / info / generated_at; the WAL journals
+                  the patch, not the 50k-row doc.
+  * full rewrite — plan changed (or no valid fingerprint): the classic
+                  whole-doc upsert.
+
+``reset()`` drops every fingerprint — the tick driver calls it when a WAL
+group commit fails, so the next tick full-rewrites instead of patching
+against a base the log may have lost.
 """
 from __future__ import annotations
 
+import operator as _operator
+import threading
 import time as _time
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from ..models import task as task_mod
 from ..models.task import Task
-from ..models.task_queue import DistroQueueInfo
+from ..models.task_queue import DistroQueueInfo, QueueInfoView
 from ..storage.store import Store
+
+#: secondary-queue row suffix in the solve's distro ids — must match
+#: scheduler.wrapper.ALIAS_SUFFIX (importing it would be circular)
+_ALIAS_SUFFIX = "::alias"
+
+
+class _Fingerprint:
+    __slots__ = ("plan", "rows", "sort", "met", "info_key", "doc", "v",
+                 "cand")
+
+    def __init__(self) -> None:
+        self.plan: List[Task] = []
+        self.rows: list = []
+        self.sort: list = []
+        self.met: list = []
+        self.info_key = None
+        self.doc: Optional[dict] = None
+        self.v = -1
+        #: last tick's mark-scheduled candidates — reusable whenever the
+        #: plan instances AND the deps-met column are unchanged (the scan
+        #: reads only those); None = must rescan
+        self.cand: Optional[list] = None
+
+
+class PersisterState:
+    """Per-store delta-persist memory: one fingerprint per
+    (distro, secondary) queue doc."""
+
+    def __init__(self) -> None:
+        self._fps: Dict[Tuple[str, bool], _Fingerprint] = {}
+        #: write-shape counters, exposed for tests/bench introspection
+        self.skipped = 0
+        self.patched = 0
+        self.rewritten = 0
+        #: current + previous tick's solve info columns, the global
+        #: "nothing in any distro's info changed" verdict, and both
+        #: ticks' distro/segment index maps (for the per-distro fallback
+        #: compare when the global verdict is dirty)
+        self._cur_info_cols: Optional[dict] = None
+        self._prev_info_cols: Optional[dict] = None
+        self._cur_did_index: Dict[str, int] = {}
+        self._prev_did_index: Dict[str, int] = {}
+        self._cur_seg_ids: Dict[int, list] = {}
+        self._prev_seg_ids: Dict[int, list] = {}
+        self.infos_static = False
+
+    def reset(self) -> None:
+        """Invalidate every fingerprint (after a lost WAL group: the next
+        tick must re-establish full base docs before patching again)."""
+        self._fps.clear()
+        self._cur_info_cols = None
+        self._prev_info_cols = None
+        self._cur_did_index = {}
+        self._prev_did_index = {}
+        self._cur_seg_ids = {}
+        self._prev_seg_ids = {}
+        self.infos_static = False
+
+    def note_solve_infos(
+        self,
+        cols: Optional[dict],
+        distro_ids: Optional[list] = None,
+        seg_ids_by_di: Optional[Dict[int, list]] = None,
+    ) -> None:
+        """One whole-tick info comparison instead of ~11k per-segment
+        fingerprints: the solve's raw info columns (shared by every
+        QueueInfoView of the tick) are compared wholesale against the
+        previous tick's. Equal ⇒ EVERY distro's info doc is unchanged, so
+        per-distro skip decisions reduce to plan/sort/met checks; unequal
+        ⇒ ``info_static_for`` falls back to a per-distro compare over the
+        kept index maps. A serial-fallback tick (cols=None) clears the
+        epoch — the next solve tick trusts nothing."""
+        prev = self._cur_info_cols
+        self._prev_info_cols = prev
+        self._prev_did_index = self._cur_did_index
+        self._prev_seg_ids = self._cur_seg_ids
+        self._cur_info_cols = cols
+        self._cur_did_index = (
+            {did: di for di, did in enumerate(distro_ids)}
+            if cols is not None and distro_ids is not None else {}
+        )
+        self._cur_seg_ids = dict(seg_ids_by_di or {})
+        if cols is None or prev is None or prev.keys() != cols.keys():
+            self.infos_static = False
+        else:
+            self.infos_static = all(prev[k] == cols[k] for k in cols)
+
+    _D_KEYS = (
+        "d_length", "d_deps_met", "d_merge", "d_expected_dur_s",
+        "d_thresh_s", "d_over_count", "d_over_dur_s", "d_wait_over",
+    )
+    _G_KEYS = (
+        "g_count", "g_max_hosts", "g_expected_dur_s", "g_count_free",
+        "g_count_required", "g_over_count", "g_wait_over", "g_merge",
+        "g_over_dur_s",
+    )
+
+    def info_static_for(self, view: QueueInfoView, did: str) -> bool:
+        """Is this one distro's info unchanged since the previous solve
+        tick? Cheap positive answer when the global epoch is clean;
+        otherwise an O(segments-of-distro) compare against the previous
+        tick's columns (still never builds a doc)."""
+        if self.infos_static:
+            return True
+        prev = self._prev_info_cols
+        cur = view._c
+        if prev is None or cur is not self._cur_info_cols:
+            return False
+        pdi = self._prev_did_index.get(did)
+        if pdi is None:
+            return False
+        di = view._di
+        for k in self._D_KEYS:
+            col = prev[k]
+            if pdi >= len(col) or col[pdi] != cur[k][di]:
+                return False
+        prev_ids = self._prev_seg_ids.get(pdi)
+        cur_ids = view._seg_ids
+        if prev_ids is None or len(prev_ids) != len(cur_ids):
+            return False
+        pnames, cnames = prev["seg_names"], cur["seg_names"]
+        for pg, cg in zip(prev_ids, cur_ids):
+            if pnames[pg][1] != cnames[cg][1]:
+                return False
+            for k in self._G_KEYS:
+                if prev[k][pg] != cur[k][cg]:
+                    return False
+        return True
+
+
+#: per-store PersisterState singletons (same id-keyed pattern as the
+#: scheduler's snapshot memos in wrapper.py)
+_states: Dict[int, tuple] = {}
+_states_lock = threading.Lock()
+
+
+def persister_state_for(store: Store) -> PersisterState:
+    key = id(store)
+    with _states_lock:
+        entry = _states.get(key)
+        if entry is None or entry[0] is not store:
+            entry = (store, PersisterState())
+            _states[key] = entry
+        return entry[1]
 
 
 def persist_task_queue(
@@ -22,31 +190,50 @@ def persist_task_queue(
     plan: List[Task],
     sort_values: Union[Dict[str, float], Sequence[float]],
     deps_met: Union[Dict[str, bool], Sequence[bool]],
-    info: DistroQueueInfo,
+    info: Union[DistroQueueInfo, QueueInfoView],
     max_scheduled_per_distro: int = 0,
     secondary: bool = False,
     now: Optional[float] = None,
+    state: Optional[PersisterState] = None,
 ) -> int:
     """Persist the plan; returns the number of queue items written.
 
     ``sort_values`` and ``deps_met`` are either id-keyed mappings
     (serial/cmp paths) or sequences positionally aligned with ``plan``
     (the batched solve's unpack, which avoids materializing 50k-entry
-    dicts every tick)."""
+    dicts every tick). Passing ``state`` enables delta persistence."""
     now = _time.time() if now is None else now
-    # columnar persist: one list comprehension per field instead of 50k
-    # small dicts — queue writes are every-tick work (the read side
-    # reconstructs items in TaskQueue.from_doc on TTL-amortized rebuilds)
     n = len(plan)
     cut = _cap_cut(plan, max_scheduled_per_distro)
     if cut < n:
         plan = plan[:cut]
+
+    c = _coll(store, secondary)
+    key = (distro_id, secondary)
+    fp = state._fps.get(key) if state is not None else None
+    if fp is not None and c.get(distro_id) is not fp.doc:
+        # the doc was rewritten/removed behind our back (tests, another
+        # writer, a recovery) — the fingerprint no longer describes it
+        fp = None
+    same_plan = (
+        fp is not None
+        and len(fp.plan) == len(plan)
+        and all(map(_operator.is_, fp.plan, plan))
+    )
+
     # Row-major persist: each row IS Task.queue_row()'s memoized tuple
-    # (models/task_queue.py ROW_FIELDS), so the every-tick write just
-    # collects shared tuples — no 50k-row transpose.  Only sort_value and
-    # dependencies_met are recomputed per tick; the read side transposes
-    # on TTL-amortized rebuilds (TaskQueue.from_doc / doc_column).
-    rows = [t.queue_row() for t in plan]
+    # (models/task_queue.py ROW_FIELDS); an unchanged plan reuses the
+    # whole rows list from the fingerprint — zero per-task work.
+    rows = fp.rows if same_plan else [t.queue_row() for t in plan]
+    if not same_plan and fp is not None and rows == fp.rows:
+        # instances were replaced but every queue row is content-identical
+        # (the common shape right after mark_scheduled stamps dirty the
+        # docs): the doc's rows need no write — adopt the new instances
+        # and fall through to the patch/skip paths
+        same_plan = True
+        fp.plan = plan
+        fp.cand = None  # task attributes may have moved — rescan below
+        rows = fp.rows
     n_rows = len(rows)
     if isinstance(sort_values, dict):
         sort_col = [sort_values.get(r[0], 0.0) for r in rows]
@@ -58,13 +245,56 @@ def persist_task_queue(
     else:
         met_col = list(deps_met[:n_rows])
         met_col += [True] * (n_rows - len(met_col))
-    info_doc = {
-        **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
-        "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],
-    }
-    save_doc(
-        store,
-        {
+
+    is_view = isinstance(info, QueueInfoView)
+    # "is the info unchanged?": the view path asks the whole-tick epoch
+    # (falling back to a per-distro column compare); the serial/cmp
+    # dataclass path compares its flattened doc directly
+    if is_view:
+        info_doc_dc = None
+        info_static = False
+        if state is not None and same_plan:
+            did = distro_id + _ALIAS_SUFFIX if secondary else distro_id
+            info_static = state.info_static_for(info, did)
+    else:
+        info_doc_dc = _info_doc(info)
+        info_static = fp is not None and info_doc_dc == fp.info_key
+
+    #: met column unchanged ⇒ the mark-scheduled candidate set is too
+    same_met = same_plan and met_col == fp.met
+
+    if same_plan and info_static and same_met and sort_col == fp.sort:
+        # untouched distro: nothing to write, nothing to journal
+        if state is not None:
+            state.skipped += 1
+    elif same_plan:
+        # only dynamic columns moved: versioned patch of JUST the changed
+        # fields — the WAL carries the patch (plus its expected base
+        # version), never the 50k rows
+        new_v = fp.v + 1
+        fields = {"generated_at": now, "v": new_v}
+        if sort_col != fp.sort:
+            fields["sort_value"] = sort_col
+        if not same_met:
+            fields["dependencies_met"] = met_col
+        if not info_static:
+            fields["info"] = info.doc() if is_view else info_doc_dc
+        patched = c.patch(distro_id, fields)
+        if patched:
+            fp.sort = sort_col
+            fp.met = met_col
+            if not info_static:
+                fp.info_key = None if is_view else info_doc_dc
+            fp.v = new_v
+            if state is not None:
+                state.patched += 1
+        else:  # doc vanished between the identity check and the patch
+            same_plan = False
+    if not same_plan:
+        info_doc = info.doc() if is_view else info_doc_dc
+        live_v = fp.v if fp is not None else _live_version(c, distro_id)
+        new_v = live_v + 1
+        doc = {
             "_id": distro_id,
             "distro_id": distro_id,
             "rows": rows,
@@ -72,25 +302,62 @@ def persist_task_queue(
             "dependencies_met": met_col,
             "info": info_doc,
             "generated_at": now,
-        },
-        secondary=secondary,
-    )
+            "v": new_v,
+        }
+        c.upsert(doc)
+        if state is not None:
+            fp = state._fps.get(key)
+            if fp is None:
+                fp = state._fps[key] = _Fingerprint()
+            fp.plan = plan
+            fp.rows = rows
+            fp.sort = sort_col
+            fp.met = met_col
+            fp.info_key = None if is_view else info_doc
+            fp.doc = doc
+            fp.v = new_v
+            fp.cand = None
+            state.rewritten += 1
+
     # Candidate pre-filter on the materialized Task attributes: in steady
     # state every planned task is already stamped, so the per-task store
-    # get() round (50k/tick at config-3 scale) collapses to zero.
-    # mark_scheduled itself re-checks the live doc before mutating.
-    cand = [
-        (t.id, met)
-        for t, met in zip(plan, met_col)
-        if t.scheduled_time <= 0.0
-        or (met and t.dependencies_met_time <= 0.0)
-    ]
+    # get() round (50k/tick at config-3 scale) collapses to zero — and
+    # the scan itself is skipped whenever plan instances AND the deps-met
+    # column are unchanged (the two inputs it reads), reusing last tick's
+    # candidate set. mark_scheduled re-checks live docs before mutating.
+    if fp is not None and same_met and fp.cand is not None:
+        cand = fp.cand
+    else:
+        cand = [
+            (t.id, met)
+            for t, met in zip(plan, met_col)
+            if t.scheduled_time <= 0.0
+            or (met and t.dependencies_met_time <= 0.0)
+        ]
+        if fp is not None:
+            fp.cand = cand
     if cand:
         task_mod.mark_scheduled(
             store, [tid for tid, _ in cand], now,
             deps_met_ids=[tid for tid, met in cand if met],
         )
     return len(plan)
+
+
+def _live_version(c, distro_id: str) -> int:
+    doc = c.get(distro_id)
+    v = doc.get("v", -1) if doc else -1
+    return v if isinstance(v, int) else -1
+
+
+def _info_doc(info: DistroQueueInfo) -> dict:
+    """Flatten a dataclass DistroQueueInfo into the persisted info doc
+    (task_group_infos last — the field order QueueInfoView.doc() and the
+    byte-identity tests pin)."""
+    return {
+        **{k: v for k, v in info.__dict__.items() if k != "task_group_infos"},
+        "task_group_infos": [dict(g.__dict__) for g in info.task_group_infos],
+    }
 
 
 def _cap_cut(plan: List[Task], max_len: int) -> int:
@@ -107,9 +374,13 @@ def _cap_cut(plan: List[Task], max_len: int) -> int:
     return cut
 
 
-def save_doc(store: Store, doc: dict, secondary: bool = False):
+def _coll(store: Store, secondary: bool = False):
     from ..models.task_queue import coll as tq_coll
 
-    c = tq_coll(store, secondary)
+    return tq_coll(store, secondary)
+
+
+def save_doc(store: Store, doc: dict, secondary: bool = False):
+    c = _coll(store, secondary)
     c.upsert(doc)
     return c

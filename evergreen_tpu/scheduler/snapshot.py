@@ -331,16 +331,18 @@ _DIM_OF_FIELD = {
 }
 
 
-def arena_for_dims(dims: Dict[str, int]) -> Arena:
+def arena_for_dims(dims: Dict[str, int], pool=None) -> Arena:
     """Allocate the canonical snapshot arena for bucket sizes
     ``{"N":…, "M":…, "U":…, "G":…, "H":…, "D":…}``. The field order of
     FIELD_KINDS fully determines the transfer layout — the sidecar protocol
     (api/sidecar.py, native/evgsolve) reconstructs it from the shape key
-    alone."""
+    alone. ``pool`` (an ops.packing.ArenaPool) swaps the fresh allocation
+    for one of two rotating zeroed buffer sets — the double-buffered
+    transfer arenas of the pipelined tick."""
     arena = Arena()
     for name, kind in FIELD_KINDS.items():
         arena.alloc(name, dims[_DIM_OF_FIELD[name[:2]]], kind)
-    arena.finalize()
+    arena.finalize(pool)
     return arena
 
 
@@ -415,6 +417,34 @@ def _pack_static(tasks: List[Task], evgpack) -> Dict[str, np.ndarray]:
     return cols
 
 
+def _memb_equivalent(old_tasks: List[Task], tasks: List[Task]) -> bool:
+    """Soft membership-memo hit: two task lists form identical planner
+    units/segments iff every membership-relevant field matches pairwise —
+    id, task group string inputs (group/variant/project/version),
+    group max-hosts, and the dependency edges. A task re-materialized
+    because only its TIME stamps changed (mark_scheduled dirties the doc
+    every time a fresh task is first planned) then reuses the cached
+    memberships instead of paying a full native rebuild; the static
+    columns are still repacked (stamps feed t_start). Field compares hit
+    the doc's interned strings, so the common case is pointer equality."""
+    if len(old_tasks) != len(tasks):
+        return False
+    for a, b in zip(old_tasks, tasks):
+        if a is b:
+            continue
+        if (
+            a.id != b.id
+            or a.task_group != b.task_group
+            or a.version != b.version
+            or a.build_variant != b.build_variant
+            or a.project != b.project
+            or a.task_group_max_hosts != b.task_group_max_hosts
+            or a.depends_on != b.depends_on
+        ):
+            return False
+    return True
+
+
 def build_snapshot(
     distros: List[Distro],
     tasks_by_distro: Dict[str, List[Task]],
@@ -425,6 +455,7 @@ def build_snapshot(
     force_dims: Dict[str, int] = None,
     dims_memo: Dict[str, int] = None,
     memb_memo: Dict[str, tuple] = None,
+    arena_pool=None,
 ) -> Snapshot:
     """``force_dims`` overrides the computed bucket sizes (the sharded
     solve pads every shard to common dims so the blocks stack).
@@ -480,7 +511,7 @@ def build_snapshot(
         seg_slice = t_seg_np[base:base + len(tasks)]
         dm_slice = t_dm_np[base:base + len(tasks)]
         entry = memb_memo.get(d.id) if memb_memo is not None else None
-        if (
+        hard_hit = (
             entry is not None
             and entry[0] == gv
             and (
@@ -491,9 +522,26 @@ def build_snapshot(
                     and all(map(_is, entry[1], tasks))
                 )
             )
-        ):
+        )
+        # soft hit: instances were replaced (e.g. a scheduled_time stamp
+        # re-materialized the docs) but the membership-relevant fields are
+        # unchanged — reuse the cached unit/segment arrays, repack only
+        # the static columns
+        soft_hit = (
+            not hard_hit
+            and entry is not None
+            and entry[0] == gv
+            and _memb_equivalent(entry[1], tasks)
+        )
+        if hard_hit or soft_hit:
             (_, _, n_units_d, mt_local, mu_local, snames, smax, seg_local,
              scols, t_ids, seg_pairs_c, pairs_di) = entry
+            if soft_hit:
+                scols = _pack_static(tasks, evgpack)
+                memb_memo[d.id] = (
+                    gv, tasks, n_units_d, mt_local, mu_local, snames,
+                    smax, seg_local, scols, t_ids, seg_pairs_c, pairs_di,
+                )
             seg_pairs = (
                 seg_pairs_c if pairs_di == di
                 else [(di, nm) for nm in snames]
@@ -667,7 +715,7 @@ def build_snapshot(
     N, M, U = dims["N"], dims["M"], dims["U"]
     G, H, D = dims["G"], dims["H"], dims["D"]
 
-    arena = arena_for_dims(dims)
+    arena = arena_for_dims(dims, arena_pool)
 
     a: Dict[str, np.ndarray] = {}
     for name, kind in FIELD_KINDS.items():

@@ -30,7 +30,7 @@ from ..models import task as task_mod
 from ..models.distro import Distro
 from ..models.host import Host, new_intent
 from ..models.task import Task
-from ..models.task_queue import DistroQueueInfo, TaskGroupInfo, TaskQueue
+from ..models.task_queue import DistroQueueInfo, QueueInfoView
 from ..storage.store import Store
 from . import serial
 from .persister import persist_task_queue
@@ -63,6 +63,12 @@ class TickOptions:
     #: whole-tick budget: when exceeded, optional work is shed — stats
     #: first, then event emission — but never planning (0 = unlimited)
     tick_budget_s: float = 0.0
+    #: commit the tick's WAL group on the background flusher so the file
+    #: write of tick t overlaps the snapshot of tick t+1 (the long-lived
+    #: service sets this); a deferred write error surfaces at the NEXT
+    #: tick's barrier as degraded="persist-failed". False = the commit
+    #: (and any error) lands before run_tick returns.
+    async_persist: bool = False
 
 
 #: per-store TickCache singletons. Intentionally strong references: a
@@ -95,14 +101,16 @@ def tick_cache_for(store: Store):
 _sched_memos: Dict[int, tuple] = {}
 
 
-def _snapshot_memos_for(store: Store) -> Tuple[dict, dict]:
+def _snapshot_memos_for(store: Store) -> Tuple[dict, dict, "ArenaPool"]:
+    from ..ops.packing import ArenaPool
+
     key = id(store)
     with _tick_caches_lock:
         entry = _sched_memos.get(key)
         if entry is None or entry[0] is not store:
-            entry = (store, {}, {})
+            entry = (store, {}, {}, ArenaPool())
             _sched_memos[key] = entry
-        return entry[1], entry[2]
+        return entry[1], entry[2], entry[3]
 
 
 #: consecutive solve failures before the breaker opens, and how long it
@@ -278,29 +286,29 @@ def gather_tick_inputs(
 def _unpack_solve(
     snapshot: Snapshot,
     out: Dict[str, np.ndarray],
-) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, DistroQueueInfo], Dict[str, int], Dict[str, List[bool]]]:
+) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, QueueInfoView], Dict[str, int], Dict[str, List[bool]], dict]:
     """Device outputs → per-distro ordered plans, sort values, positional
-    deps-met columns, queue infos, spawn counts."""
+    deps-met columns, lazy queue-info views, spawn counts, and the shared
+    raw info columns (for the persister's whole-tick epoch compare)."""
     flat = snapshot.flat_tasks
     n = snapshot.n_tasks
     # The solve's first sort key is the distro index, so the returned order
     # is already segmented distro by distro: drop padding, then slice per
-    # distro — no per-element Python loop over the padded [N] array.
+    # distro.
     order = np.asarray(out["order"])
     real = order[order < n]
     t_distro = np.asarray(snapshot.arrays["t_distro"])
     dpd = t_distro[real]
     vals = np.asarray(out["t_value"])[real].astype(float)
     bounds = np.searchsorted(dpd, np.arange(len(snapshot.distro_ids) + 1))
-    # one C-level gather over an object ndarray instead of 50k Python
-    # list-index operations, then per-distro C slicing — the unpack is
-    # every-tick work at config-3 scale
-    flat_np = np.empty(len(flat), dtype=object)
-    flat_np[:] = flat
-    ordered_tasks = flat_np[real]
+    # gather as a plain list comprehension: filling a 50k object ndarray
+    # (refcount per slot) measures ~15x SLOWER than the interpreter's
+    # specialized list indexing — ~100ms/tick back at config-3 scale
+    ordered_tasks = [flat[i] for i in real.tolist()]
     # deps-met rides along positionally (the persister consumed an
     # id→flag dict before — 50k dict lookups per tick)
-    met_ordered = snapshot.arrays["t_deps_met"][:n][real]
+    met_flat = snapshot.arrays["t_deps_met"][:n][real].tolist()
+    vals_flat = vals.tolist()
     plans: Dict[str, List[Task]] = {}
     # per-distro sort values ALIGNED with plans[did] (the persister
     # consumes them positionally — building 50k-entry id→value dicts per
@@ -309,67 +317,44 @@ def _unpack_solve(
     met_cols: Dict[str, List[bool]] = {}
     for di, did in enumerate(snapshot.distro_ids):
         lo, hi = int(bounds[di]), int(bounds[di + 1])
-        plans[did] = ordered_tasks[lo:hi].tolist()
-        sort_values[did] = vals[lo:hi].tolist()
-        met_cols[did] = met_ordered[lo:hi].tolist()
+        plans[did] = ordered_tasks[lo:hi]
+        sort_values[did] = vals_flat[lo:hi]
+        met_cols[did] = met_flat[lo:hi]
 
     # Per-segment / per-distro scalars: pull each device array to host
-    # ONCE and iterate plain lists — scalar indexing into a jax array is
-    # a device op (µs each), and there are 9 fields × thousands of
-    # segments per tick.
+    # ONCE as plain lists — scalar indexing into a jax array is a device
+    # op (µs each) — and hand them to lazy QueueInfoView objects instead
+    # of materializing ~11k TaskGroupInfo dataclasses per tick; the info
+    # docs are only built for distros whose queue doc is actually written.
     def host_list(name: str):
         return np.asarray(out[name]).tolist()
 
-    g_count = host_list("g_count")
-    g_exp = host_list("g_expected_dur_s")
-    g_free = host_list("g_count_free")
-    g_req = host_list("g_count_required")
-    g_over = host_list("g_over_count")
-    g_wait = host_list("g_wait_over")
-    g_merge = host_list("g_merge")
-    g_over_dur = host_list("g_over_dur_s")
-    g_max_hosts = np.asarray(snapshot.arrays["g_max_hosts"]).tolist()
-    seg_infos: Dict[int, List[TaskGroupInfo]] = {}
-    for gi, (di, name) in enumerate(snapshot.seg_names):
-        info = TaskGroupInfo(
-            name=name,
-            count=int(g_count[gi]),
-            max_hosts=int(g_max_hosts[gi]),
-            expected_duration_s=float(g_exp[gi]),
-            count_free=int(g_free[gi]),
-            count_required=int(g_req[gi]),
-            count_duration_over_threshold=int(g_over[gi]),
-            count_wait_over_threshold=int(g_wait[gi]),
-            count_dep_filled_merge_queue=int(g_merge[gi]),
-            duration_over_threshold_s=float(g_over_dur[gi]),
+    cols = {
+        name: host_list(name)
+        for name in (
+            "g_count", "g_expected_dur_s", "g_count_free",
+            "g_count_required", "g_over_count", "g_wait_over", "g_merge",
+            "g_over_dur_s", "d_length", "d_deps_met", "d_merge",
+            "d_expected_dur_s", "d_over_count", "d_over_dur_s",
+            "d_wait_over",
         )
-        seg_infos.setdefault(di, []).append(info)
+    }
+    cols["g_max_hosts"] = np.asarray(snapshot.arrays["g_max_hosts"]).tolist()
+    cols["d_thresh_s"] = np.asarray(snapshot.arrays["d_thresh_s"]).tolist()
+    cols["seg_names"] = snapshot.seg_names
+    seg_ids_by_di: Dict[int, List[int]] = {}
+    for gi, (di, _name) in enumerate(snapshot.seg_names):
+        seg_ids_by_di.setdefault(di, []).append(gi)
 
-    d_length = host_list("d_length")
-    d_deps_met = host_list("d_deps_met")
-    d_merge = host_list("d_merge")
-    d_exp = host_list("d_expected_dur_s")
-    d_over_count = host_list("d_over_count")
-    d_over_dur = host_list("d_over_dur_s")
-    d_wait = host_list("d_wait_over")
     d_new = host_list("d_new_hosts")
-    d_thresh = np.asarray(snapshot.arrays["d_thresh_s"]).tolist()
-    infos: Dict[str, DistroQueueInfo] = {}
+    infos: Dict[str, QueueInfoView] = {}
     new_hosts: Dict[str, int] = {}
     for di, did in enumerate(snapshot.distro_ids):
-        infos[did] = DistroQueueInfo(
-            length=int(d_length[di]),
-            length_with_dependencies_met=int(d_deps_met[di]),
-            count_dep_filled_merge_queue=int(d_merge[di]),
-            expected_duration_s=float(d_exp[di]),
-            max_duration_threshold_s=float(d_thresh[di]),
-            count_duration_over_threshold=int(d_over_count[di]),
-            duration_over_threshold_s=float(d_over_dur[di]),
-            count_wait_over_threshold=int(d_wait[di]),
-            task_group_infos=seg_infos.get(di, []),
-        )
+        infos[did] = QueueInfoView(di, seg_ids_by_di.get(di, ()), cols)
         new_hosts[did] = int(d_new[di])
-    return plans, sort_values, infos, new_hosts, met_cols
+    return plans, sort_values, infos, new_hosts, met_cols, (
+        cols, snapshot.distro_ids, seg_ids_by_di,
+    )
 
 
 def _apply_release_mode(store: Store, distros):
@@ -468,6 +453,89 @@ def run_tick(
     now = _time.time() if now is None else now
     t0 = _time.perf_counter()
 
+    from .persister import persister_state_for
+
+    pstate = persister_state_for(store)
+    from ..utils.log import get_logger, incr_counter
+
+    _rlog = get_logger("resilience")
+
+    # Persist barrier FIRST, before this tick writes anything: wait out
+    # the previous tick's async WAL group commit and surface its deferred
+    # error. A lost group means the WAL may lack the delta bases the
+    # fingerprints assume, so the delta state is reset (full rewrites
+    # this tick) and a best-effort checkpoint snapshots the in-memory
+    # truth to heal durability.
+    prior_persist_failed = False
+    try:
+        store.sync_persist()
+    except Exception as exc:  # noqa: BLE001 — the previous tick's commit
+        prior_persist_failed = True
+        pstate.reset()
+        store.heal_durability()
+        incr_counter("scheduler.tick.persist_failed")
+        _rlog.error(
+            "wal-group-commit-failed",
+            deferred=True,
+            error=repr(exc)[-300:],
+        )
+
+    # Tick-scoped WAL group: every journaled write until the commit near
+    # the end of the tick rides in ONE framed append (storage/durable.py)
+    # — O(1) journal flushes per tick instead of one per queue doc.
+    store.begin_tick()
+    committed = [False]
+    try:
+        return _run_tick_body(
+            store, opts, now, t0, pstate, prior_persist_failed, committed
+        )
+    finally:
+        if not committed[0]:
+            # an exception escaped mid-tick: commit whatever was buffered
+            # (the in-memory state already contains it) so the group is
+            # never left open
+            try:
+                store.end_tick()
+            except Exception:  # noqa: BLE001 — best-effort cleanup, but
+                # a lost group still invalidates the delta bases: later
+                # patches must not build on a frame the WAL never got
+                pstate.reset()
+                store.heal_durability()
+
+
+def _commit_tick_group(store: Store, opts: TickOptions) -> str:
+    """Commit the tick's WAL group; returns "" or a degradation reason."""
+    try:
+        if opts.async_persist:
+            store.end_tick_async()
+        else:
+            store.end_tick()
+        return ""
+    except Exception as exc:  # noqa: BLE001 — a WAL error degrades the
+        # tick, never kills it
+        from .persister import persister_state_for
+        from ..utils.log import get_logger, incr_counter
+
+        persister_state_for(store).reset()
+        store.heal_durability()
+        incr_counter("scheduler.tick.persist_failed")
+        get_logger("resilience").error(
+            "wal-group-commit-failed",
+            deferred=False,
+            error=repr(exc)[-300:],
+        )
+        return "persist-failed"
+
+
+def _run_tick_body(
+    store: Store,
+    opts: TickOptions,
+    now: float,
+    t0: float,
+    pstate,
+    prior_persist_failed: bool,
+    committed: list,
+) -> TickResult:
     if opts.underwater_unschedule:
         task_mod.unschedule_stale_underwater(
             store, "", now, UNDERWATER_UNSCHEDULE_THRESHOLD_S
@@ -517,7 +585,10 @@ def run_tick(
     #: planned host-side (cmp/serial) fall back to the dict
     met_cols: Dict[str, List[bool]] = {}
     planner_used = ""
-    degraded = ""
+    # a lost group commit from the PREVIOUS tick surfaces on this one:
+    # this tick runs with reset fingerprints (full rewrites) and reports
+    # the batched persist failure
+    degraded = "persist-failed" if prior_persist_failed else ""
     shed: List[str] = []
     from ..utils import faults
     from ..utils.log import get_logger, incr_counter
@@ -535,7 +606,7 @@ def run_tick(
     breaker = solve_breaker_for(store) if want_tpu else None
     if want_tpu and not breaker.allow(now=now):
         want_tpu = False
-        degraded = "breaker-open"
+        degraded = degraded or "breaker-open"
         incr_counter("scheduler.tick.breaker_open")
         _rlog.warning(
             "degraded-tick", reason=degraded, fallback="serial"
@@ -543,11 +614,11 @@ def run_tick(
     if want_tpu:
         try:
             t1 = _time.perf_counter()
-            dims_memo, memb_memo = _snapshot_memos_for(store)
+            dims_memo, memb_memo, arena_pool = _snapshot_memos_for(store)
             snapshot = build_snapshot(
                 solver_distros, tasks_by_distro, hosts_by_distro,
                 running_estimates, deps_met, now, dims_memo=dims_memo,
-                memb_memo=memb_memo,
+                memb_memo=memb_memo, arena_pool=arena_pool,
             )
             t2 = _time.perf_counter()
             # bounded solve (optionally XLA-profiled inside — SURVEY §5:
@@ -557,15 +628,15 @@ def run_tick(
             t3 = _time.perf_counter()
             snapshot_ms = (t2 - t1) * 1e3
             solve_ms = (t3 - t2) * 1e3
-            plans, sort_values, infos, new_hosts, met_cols = _unpack_solve(
-                snapshot, out
-            )
+            (plans, sort_values, infos, new_hosts, met_cols,
+             info_epoch) = _unpack_solve(snapshot, out)
+            pstate.note_solve_infos(*info_epoch)
             planner_used = "tpu"
             breaker.record_success(now=now)
         except Exception as exc:  # noqa: BLE001 — ANY solve-path failure
             # degrades the tick; it must never kill it
             want_tpu = False
-            degraded = (
+            degraded = degraded or (
                 "solve-deadline" if isinstance(exc, TimeoutError)
                 else "solve-failed"
             )
@@ -589,6 +660,9 @@ def run_tick(
         new_hosts = {d: r[2] for d, r in results.items()}
         sort_values = {d: r[3] for d, r in results.items()}
         planner_used = "serial"
+        # a serial tick writes dataclass info docs; the next solve tick
+        # must not trust a stale info epoch
+        pstate.note_solve_infos(None)
 
     if cmp_distros:
         from . import cmp_prioritizer
@@ -636,13 +710,16 @@ def run_tick(
 
     # Persist queues + create intent hosts (scheduler/scheduler.go:176-220),
     # honoring the global intent-host cap (units/host_allocator.go:35).
-    # A storage fault (WAL write error) while persisting ONE distro's
-    # queue must not abandon every other distro's plan: the failed queue
-    # doc stays one tick stale and the next tick rewrites it.
-    n_intents_in_flight = host_mod.coll(store).count(
-        lambda doc: doc["status"] == HostStatus.UNINITIALIZED.value
-    )
-    budget = max(0, opts.max_intent_hosts - n_intents_in_flight)
+    # A host-side failure while persisting ONE distro's queue must not
+    # abandon every other distro's plan (WAL errors now surface at the
+    # batched group commit below, with their own degradation path).
+    if opts.create_intent_hosts:
+        n_intents_in_flight = host_mod.coll(store).count(
+            lambda doc: doc["status"] == HostStatus.UNINITIALIZED.value
+        )
+        budget = max(0, opts.max_intent_hosts - n_intents_in_flight)
+    else:
+        budget = 0  # the 4k-host scan is pure cost when intents are off
 
     def _over_budget() -> bool:
         return (
@@ -667,9 +744,13 @@ def run_tick(
                 opts.max_scheduled_per_distro,
                 secondary=is_alias,
                 now=now,
+                state=pstate,
             )
         except Exception as exc:  # noqa: BLE001 — isolate per distro
             queues[d.id] = 0
+            # the doc may be half-written: drop its fingerprint so the
+            # next tick full-rewrites instead of patching a broken base
+            pstate._fps.pop((base_id, is_alias), None)
             degraded = degraded or "persist-failed"
             incr_counter("scheduler.tick.persist_failed")
             _rlog.error(
@@ -772,6 +853,12 @@ def run_tick(
             shed=list(shed),
             budget_s=opts.tick_budget_s,
         )
+    # Commit the tick's WAL group: sync mode surfaces a write error as
+    # THIS tick's degradation; async mode hands the framed append to the
+    # flusher thread (the write overlaps the next tick's snapshot) and a
+    # deferred error degrades the NEXT tick at its barrier.
+    committed[0] = True
+    degraded = degraded or _commit_tick_group(store, opts)
     total_ms = (_time.perf_counter() - t0) * 1e3
     # the structured runtime-stats line operators grep for (reference
     # grip message.Fields, scheduler/wrapper.go:93-128); it survives

@@ -14,11 +14,35 @@ a real SIGKILL subprocess).
 Design notes:
 - Ops are logged as full-document puts (docs are small; this makes
   ``mutate``/``compare_and_set``/partial ``update`` all journal the same
-  way and keeps replay trivial and idempotent).
+  way and keeps replay trivial and idempotent).  Two narrower ops exist
+  for the tick's hot path: ``um`` (one record for a bulk field update
+  over many ids) and ``u`` (a field patch of one doc, carrying the
+  expected previous doc version when it advances ``v`` so replay drops a
+  patch whose base write was lost).
 - Serialization happens synchronously under the collection lock so WAL
   order is exactly apply order; the file append itself is buffered and
   flushed per-op (an OS-level write survives SIGKILL; fsync — surviving
   power loss — is available via ``sync="fsync"``).
+- Group commit: ``begin_tick()`` opens a tick-scoped buffer — every op
+  until ``end_tick()`` serializes immediately (still under the
+  collection lock, preserving apply order) but lands in ONE framed WAL
+  line ``{"o":"g","n":N,"rs":[...]}`` with a single flush/fsync, so 200
+  queue upserts plus the bulk task stamp cost O(1) journal flushes.  A
+  torn write of the frame loses the WHOLE group (the unterminated line
+  is repaired into one unparseable line on reopen), never a partial
+  tick — per-batch atomicity is the framing's invariant.  The WAL fault
+  seam fires once per BATCH commit, not per buffered op.
+  ``end_tick_async()`` hands the frame to a background flusher thread so
+  the file write of tick *t* overlaps the snapshot of tick *t+1*; a
+  deferred write error surfaces at the next ``sync_persist()`` barrier.
+  Two deliberate consequences of the tick-scoped group: (a) concurrent
+  NON-tick writes that land while the group is open ride in the tick's
+  frame — their durability defers to the commit (bounded by one tick)
+  in exchange for WAL order staying exactly apply order, the classic
+  group-commit latency/throughput trade; (b) while committed frames are
+  still queued for (or being written by) the flusher, later per-op
+  appends queue BEHIND them — still as plain per-op records firing the
+  per-op seam — for the same ordering reason.
 - Compaction writes a point-in-time snapshot (atomic tmp+rename) then
   truncates the WAL; it runs inline when the WAL exceeds
   ``compact_every_ops`` and at ``close()``.
@@ -37,7 +61,7 @@ import os
 import threading
 from typing import Dict, Optional
 
-from .store import Collection, Store
+from .store import Collection, Store, apply_wal_record
 
 SNAPSHOT_FILE = "snapshot.json"
 WAL_FILE = "wal.log"
@@ -66,17 +90,74 @@ class _Journal:
         self._fh = open(path, "a", encoding="utf-8")
         self.ops = 0
         self.suspended = False  # True during recovery replay
+        #: group-commit buffer: when not None, append() serializes into it
+        #: instead of the file (guarded by _lock; the frame is written by
+        #: commit_group)
+        self._group: Optional[list] = None
+        #: owner hook (DurableStore): called under _lock with a serialized
+        #: line when no group is open; returns True if the line was queued
+        #: behind pending unflushed frames (ordering), False to write
+        #: inline as before
+        self.deferred = None
+
+    def begin_group(self) -> None:
+        """Open the tick-scoped buffer; ops serialize but don't hit disk
+        until ``commit_group``. Nested begins are a no-op."""
+        with self._lock:
+            if self._group is None:
+                self._group = []
+
+    # NOTE: group detach lives in DurableStore.end_tick_async, inline
+    # under this lock — detach and flush-queue insertion must be one
+    # atomic step against appends' queue-behind-pending decision.
 
     def append(self, record: dict) -> None:
         if self.suspended:
             return
         line = json.dumps(record, separators=(",", ":"), default=str)
+        with self._lock:
+            if self._group is not None:
+                # group mode: serialization (and its apply-order guarantee,
+                # since the collection lock is held) happens here; the
+                # single framed write + flush happens at commit, possibly
+                # on the flusher thread
+                self._group.append(line)
+                return
+            if self.deferred is not None and self.deferred(line):
+                # a committed-but-unflushed frame is still queued: this op
+                # was applied AFTER that frame's ops, so it must reach the
+                # file after it — it rides the flusher queue as a
+                # singleton batch. Checked under the journal lock so the
+                # decision is atomic with group detach (end-of-tick).
+                return
         # fault seam: "raise" models a disk error surfacing to the writer;
         # "torn" flushes a half record with no terminator THEN raises —
         # exactly the crash-mid-append shape recovery must absorb
         from ..utils import faults
 
         directive = faults.fire("wal.append")
+        self._write_line(line, directive, n_ops=1)
+
+    def commit_group(self, records: list) -> None:
+        """Write buffered records as ONE torn-safe frame with one flush.
+
+        The ``wal.commit`` fault seam fires once per batch — the batched
+        analog of the per-op ``wal.append`` seam, named separately so a
+        scheduled fault targets group commits and cannot be consumed by
+        an unrelated store's per-op append — and the "torn" directive
+        tears the FRAME, so replay loses the whole group atomically
+        (never a partial tick)."""
+        if not records:
+            return
+        from ..utils import faults
+
+        directive = faults.fire("wal.commit")
+        frame = '{"o":"g","n":%d,"rs":[%s]}' % (
+            len(records), ",".join(records)
+        )
+        self._write_line(frame, directive, n_ops=len(records))
+
+    def _write_line(self, line: str, directive, n_ops: int) -> None:
         if directive == "torn":
             with self._lock:
                 self._fh.write(line[: max(1, len(line) // 2)])
@@ -95,7 +176,7 @@ class _Journal:
                 self._fh.flush()
                 if self.sync == "fsync":
                     os.fsync(self._fh.fileno())
-            self.ops += 1
+            self.ops += n_ops
 
     def rotate(self) -> None:
         """Truncate after a successful snapshot (under the caller's
@@ -128,6 +209,18 @@ class DurableStore(Store):
         self.compact_every_ops = compact_every_ops
         self._compact_lock = threading.Lock()
         self._journal = _Journal(os.path.join(data_dir, WAL_FILE), sync=sync)
+        #: background group-commit flusher (started lazily on the first
+        #: async commit); pending frames + deferred errors
+        self._flush_lock = threading.Lock()
+        self._flush_cv = threading.Condition(self._flush_lock)
+        self._flush_queue: list = []
+        self._flush_errors: list = []
+        self._flush_busy = False
+        self._flusher: Optional[threading.Thread] = None
+        # WAL-order guard: while frames sit in the flusher queue, per-op
+        # appends must queue BEHIND them (lock order journal._lock →
+        # _flush_cv; the flusher never holds _flush_cv while writing)
+        self._journal.deferred = self._defer_behind_pending
         self._recover()
 
     # -- Store interface ----------------------------------------------------- #
@@ -149,6 +242,124 @@ class DurableStore(Store):
             and not self._journal.suspended
         ):
             self.checkpoint(blocking=False)
+
+    # -- tick-scoped group commit -------------------------------------------- #
+
+    def begin_tick(self) -> None:
+        """Open the tick's WAL group: every journaled write until the
+        matching ``end_tick*`` lands in one framed append."""
+        self._journal.begin_group()
+
+    def end_tick(self) -> None:
+        """Commit the tick's group synchronously: one framed append, one
+        flush. Raises on a WAL write error (the caller degrades the tick
+        and resets its delta-persist fingerprints)."""
+        self.end_tick_async()
+        self.sync_persist()
+
+    def commit_group_inline(self, records: list) -> None:
+        self._journal.commit_group(records)
+        if (
+            self._journal.ops >= self.compact_every_ops
+            and not self._journal.suspended
+        ):
+            self.checkpoint(blocking=False)
+
+    def _defer_behind_pending(self, line: str) -> bool:
+        """_Journal hook (called under the journal lock): queue a per-op
+        line behind pending unflushed frames so WAL order stays apply
+        order. ``_flush_busy`` counts as pending — the flusher may have
+        popped a frame but not yet taken the journal lock, and an inline
+        append winning that race would land BEFORE the frame it was
+        applied after. Returns False only when the flusher is fully idle —
+        then the inline write is exactly the pre-group behavior."""
+        with self._flush_cv:
+            if not self._flush_queue and not self._flush_busy:
+                return False
+            self._flush_queue.append(("op", line))
+            self._flush_cv.notify()
+            return True
+
+    def end_tick_async(self) -> None:
+        """Commit the tick's group on the background flusher thread so the
+        file write overlaps the next tick's snapshot. Errors are deferred
+        to the next ``sync_persist()`` barrier. Detach + enqueue happen
+        under the journal lock, atomically with concurrent appends'
+        queue-behind-pending decision — no op can slip between the frame
+        leaving the buffer and it entering the flush queue."""
+        j = self._journal
+        with j._lock:
+            records, j._group = j._group, None
+            if not records:
+                return
+            with self._flush_cv:
+                if self._flusher is None or not self._flusher.is_alive():
+                    self._flusher = threading.Thread(
+                        target=self._flush_loop, daemon=True,
+                        name="wal-group-flusher",
+                    )
+                    self._flusher.start()
+                self._flush_queue.append(("frame", records))
+                self._flush_cv.notify()
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._flush_cv:
+                while not self._flush_queue:
+                    self._flush_busy = False
+                    self._flush_cv.notify_all()
+                    self._flush_cv.wait()
+                kind, payload = self._flush_queue.pop(0)
+                self._flush_busy = True
+            try:
+                if kind == "frame":
+                    self.commit_group_inline(payload)
+                else:
+                    # a deferred per-op line: it stays a plain record in
+                    # the file and keeps firing the per-op seam — the
+                    # wal.commit seam's "once per tick frame" contract
+                    # must not be consumed by ride-along ops
+                    from ..utils import faults
+
+                    directive = faults.fire("wal.append")
+                    self._journal._write_line(payload, directive, n_ops=1)
+            except BaseException as exc:  # noqa: BLE001 — deferred to
+                # the sync_persist barrier
+                with self._flush_cv:
+                    self._flush_errors.append(exc)
+
+    def sync_persist(self) -> None:
+        """Barrier: wait until every async group commit has hit the WAL,
+        then raise the first deferred write error (once); further errors
+        from the same window are logged before being dropped so the
+        operator trail is complete."""
+        with self._flush_cv:
+            while self._flush_queue or self._flush_busy:
+                self._flush_cv.wait(timeout=0.1)
+            if not self._flush_errors:
+                return
+            first, rest = self._flush_errors[0], self._flush_errors[1:]
+            self._flush_errors.clear()
+        if rest:
+            from ..utils.log import get_logger
+
+            for exc in rest:
+                get_logger("resilience").error(
+                    "wal-flush-error-dropped", error=repr(exc)[-300:]
+                )
+        raise first
+
+    def heal_durability(self) -> bool:
+        """Best-effort repair after a failed/torn group commit: a full
+        checkpoint snapshots the in-memory truth (which already contains
+        the lost group's writes), so recovery converges even though the
+        WAL frame never landed."""
+        try:
+            self.checkpoint()
+            return True
+        except Exception:  # noqa: BLE001 — the disk may still be broken;
+            # the next tick's full-rewrite pass is the fallback
+            return False
 
     # -- recovery / compaction ----------------------------------------------- #
 
@@ -181,17 +392,10 @@ class DurableStore(Store):
             self._journal.suspended = False
 
     def _apply(self, rec: dict) -> None:
-        coll = self.collection(rec["c"])
-        op = rec["o"]
-        if op == "p":
-            coll.upsert(rec["d"])
-        elif op == "pm":
-            for d in rec["ds"]:
-                coll.upsert(d)
-        elif op == "r":
-            coll.remove(rec["i"])
-        elif op == "x":
-            coll.clear()
+        # the shared decoder (storage/store.py apply_wal_record) — group-
+        # frame atomicity needs no work here: a torn frame never parses,
+        # so either every member replays or none do
+        apply_wal_record(self, rec)
 
     def checkpoint(self, blocking: bool = True) -> None:
         """Write an atomic snapshot of every collection, then truncate the
@@ -208,6 +412,14 @@ class DurableStore(Store):
         holding one collection's lock) skips if another thread is already
         compacting — that avoids two compactors deadlocking on each
         other's held collection."""
+        if blocking and threading.current_thread() is not self._flusher:
+            # drain pending async group commits so rotation can't orphan a
+            # frame that was enqueued before the snapshot was cut (errors
+            # stay deferred for sync_persist — the snapshot itself heals
+            # them, it captures the in-memory truth)
+            with self._flush_cv:
+                while self._flush_queue or self._flush_busy:
+                    self._flush_cv.wait(timeout=0.1)
         if not self._compact_lock.acquire(blocking=blocking):
             return
         acquired: Dict[str, Collection] = {}
@@ -249,5 +461,11 @@ class DurableStore(Store):
             self._compact_lock.release()
 
     def close(self) -> None:
+        # flush any still-open tick group before the final checkpoint so
+        # no buffered record is orphaned
+        try:
+            self.end_tick()
+        except Exception:  # noqa: BLE001 — close() is best-effort
+            pass
         self.checkpoint()
         self._journal.close()

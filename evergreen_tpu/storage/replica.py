@@ -28,7 +28,7 @@ import threading
 from typing import Callable, Dict, Iterable, Optional
 
 from .durable import SNAPSHOT_FILE, WAL_FILE
-from .store import Collection, Store
+from .store import Collection, Store, apply_wal_record
 
 
 class ReplicaReadOnly(RuntimeError):
@@ -97,6 +97,14 @@ class _ReadOnlyCollection(Collection):
     def mutate(self, doc_id: str, fn) -> bool:
         self._guard()
         return super().mutate(doc_id, fn)
+
+    def bulk_update(self, *a, **kw) -> int:
+        self._guard()
+        return super().bulk_update(*a, **kw)
+
+    def patch(self, *a, **kw) -> bool:
+        self._guard()
+        return super().patch(*a, **kw)
 
 
 class ReplicaStore(Store):
@@ -177,17 +185,10 @@ class ReplicaStore(Store):
         self._wal_pos = 0
 
     def _apply(self, rec: dict) -> None:
-        coll = self.collection(rec["c"])
-        op = rec["o"]
-        if op == "p":
-            coll.upsert(rec["d"])
-        elif op == "pm":
-            for d in rec["ds"]:
-                coll.upsert(d)
-        elif op == "r":
-            coll.remove(rec["i"])
-        elif op == "x":
-            coll.clear()
+        # the shared decoder (storage/store.py apply_wal_record) with the
+        # per-server scratch filter — applied per group member too (the
+        # frame itself names no collection)
+        apply_wal_record(self, rec, skip=LOCAL_SCRATCH_COLLECTIONS)
 
     def poll(self) -> int:
         """Apply every WAL record appended since the last poll; returns

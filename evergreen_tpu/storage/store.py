@@ -226,6 +226,59 @@ class Collection:
                     n += 1
             return n
 
+    def bulk_update(
+        self,
+        ids: Iterable[str],
+        fields: Dict[str, Any],
+        only_if: Optional[Callable[[dict], bool]] = None,
+    ) -> int:
+        """Apply the SAME ``fields`` to every existing doc in ``ids``
+        (optionally gated per-doc by ``only_if``, checked under the lock)
+        with ONE journal record for the whole batch. This is the batched
+        write primitive the tick's task stamping uses: 50k per-task
+        ``mutate`` calls collapse to one lock acquisition, one WAL record,
+        and one listener sweep. Returns the number of docs updated."""
+        with self._lock:
+            hit: List[str] = []
+            for doc_id in ids:
+                doc = self._docs.get(doc_id)
+                if doc is None or (only_if is not None and not only_if(doc)):
+                    continue
+                doc.update(fields)
+                hit.append(doc_id)
+            # journal AFTER applying (same ordering contract as
+            # insert_many: an inline auto-compaction snapshot must already
+            # contain the batch)
+            if hit and self._journal is not None:
+                self._journal(
+                    {"c": self.name, "o": "um", "is": hit, "f": fields}
+                )
+            for doc_id in hit:
+                self._notify(doc_id)
+            return len(hit)
+
+    def patch(self, doc_id: str, fields: Dict[str, Any]) -> bool:
+        """Field-level doc update that journals ONLY the patched fields
+        (op "u"), not the full document — the delta-persist primitive for
+        big docs whose dynamic columns churn while the bulk stays put
+        (queue docs: sort_value/dependencies_met vs 50k rows). When
+        ``fields`` advances a doc version counter ``v``, the journal
+        record carries the expected previous version so replay can drop a
+        patch whose base write was lost (torn group frame) instead of
+        corrupting the doc."""
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None:
+                return False
+            rec = {"c": self.name, "o": "u", "i": doc_id, "f": fields}
+            if "v" in fields:
+                rec["pv"] = doc.get("v")
+            doc.update(fields)
+            if self._journal is not None:
+                self._journal(rec)
+            self._notify(doc_id)
+            return True
+
     def mutate(self, doc_id: str, fn: Callable[[dict], None]) -> bool:
         """Run ``fn`` on the document under the collection lock."""
         with self._lock:
@@ -241,6 +294,45 @@ class Collection:
         """Deep-copied point-in-time view (for the snapshot builder)."""
         with self._lock:
             return copy.deepcopy(list(self._docs.values()))
+
+
+def apply_wal_record(store: "Store", rec: dict, skip=()) -> None:
+    """Replay ONE journal record into a store — the single WAL op decoder
+    shared by crash recovery (storage/durable.py) and WAL-tailing
+    replicas (storage/replica.py), so the two can never diverge on an op
+    the other doesn't know. ``skip`` filters collections (the replica's
+    per-server scratch), applied per group member too.
+
+    Ops: "p" full-doc put, "pm" batch of puts, "u" field patch (with an
+    optional ``pv`` expected-previous-version guard — a patch whose base
+    write was lost with its torn group frame is dropped, never applied to
+    the wrong doc), "um" bulk field update, "r" remove, "x" clear, and
+    "g" — a tick's group-commit frame whose members replay in order."""
+    op = rec["o"]
+    if op == "g":
+        for sub in rec["rs"]:
+            if sub.get("c") not in skip:
+                apply_wal_record(store, sub, skip)
+        return
+    coll = store.collection(rec["c"])
+    if op == "p":
+        coll.upsert(rec["d"])
+    elif op == "pm":
+        for d in rec["ds"]:
+            coll.upsert(d)
+    elif op == "u":
+        doc = coll.get(rec["i"])
+        if doc is None:
+            return  # base write lost (dropped group) — skip the patch
+        if "pv" in rec and doc.get("v") != rec["pv"]:
+            return  # version gap: the patch's base is not this doc
+        coll.update(rec["i"], rec["f"])
+    elif op == "um":
+        coll.bulk_update(rec["is"], rec["f"])
+    elif op == "r":
+        coll.remove(rec["i"])
+    elif op == "x":
+        coll.clear()
 
 
 class Store:
@@ -278,6 +370,26 @@ class Store:
 
     def __getitem__(self, name: str) -> Collection:
         return self.collection(name)
+
+    # -- durability hooks (no-ops for the in-memory engine) ------------------ #
+    # The scheduler tick calls these unconditionally; the durable engine
+    # (storage/durable.py) overrides them with WAL group-commit semantics.
+
+    def begin_tick(self) -> None:
+        """Open a tick-scoped journal group (durable engine only)."""
+
+    def end_tick(self) -> None:
+        """Commit the tick's journal group synchronously."""
+
+    def end_tick_async(self) -> None:
+        """Commit the tick's journal group on a background flusher."""
+
+    def sync_persist(self) -> None:
+        """Barrier for async commits; raises a deferred write error."""
+
+    def heal_durability(self) -> bool:
+        """Best-effort repair after a failed group commit."""
+        return True
 
 
 _GLOBAL_STORE: Optional[Store] = None

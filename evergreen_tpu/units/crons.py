@@ -45,6 +45,10 @@ def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
             # before the next 15s tick fires
             solve_deadline_s=10.0,
             tick_budget_s=12.0,
+            # WAL group commit of tick t flushes on the background
+            # flusher, overlapped with tick t+1's snapshot; a deferred
+            # write error degrades the next tick at its barrier
+            async_persist=True,
         )
         run_tick(s, opts, now=_time.time())
 

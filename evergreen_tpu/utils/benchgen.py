@@ -165,7 +165,15 @@ def bench_result_payload(
         "overlap_proven": overlap_proven,
         "churn_tick_ms": round(churn["churn_ms"], 2),
         "store_steady_tick_ms": round(churn["store_steady_ms"], 2),
-        "probe_history": probe_history,
+        # churn breakdown: machine-readable (it was only in the human
+        # comment before), so regression tooling can watch the store
+        # component directly
+        "churn_snapshot_ms": round(churn["churn_snapshot_ms"], 2),
+        "churn_solve_ms": round(churn["churn_solve_ms"], 2),
+        "churn_store_ms": round(churn["churn_store_ms"], 2),
+        # last 4 probes only — the payload must stay bounded however many
+        # retries the tunnel needed
+        "probe_history": probe_history[-4:],
     }
     if overlap_proven:
         out["pipelined_tick_ms"] = round(pipe_med, 2)

@@ -10,8 +10,16 @@ Instrumented seams:
 
   ``scheduler.solve``   device/sidecar solve raising or hanging
                         (scheduler/wrapper.py run_tick)
-  ``wal.append``        WAL write errors and torn writes
-                        (storage/durable.py _Journal)
+  ``wal.append``        per-op WAL write errors and torn writes
+                        (storage/durable.py _Journal.append — ops
+                        journaled OUTSIDE a tick group)
+  ``wal.commit``        the batched analog: fires once per tick-group
+                        COMMIT frame (_Journal.commit_group) — a "torn"
+                        directive tears the whole frame, so replay loses
+                        the batch atomically, never a partial tick. A
+                        separate seam so a scheduled fault targets group
+                        commits and cannot be consumed by an unrelated
+                        store's per-op append
   ``lease.renew``       lease loss mid-tick (storage/lease.py)
   ``agent.comm``        agent→server transport faults (agent/rest_comm.py)
   ``cloud.spawn``       cloud-provider spawn errors (cloud/provisioning.py)

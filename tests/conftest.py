@@ -44,3 +44,11 @@ def store():
     prov_mod.set_transport(prov_mod.LocalTransport())
     repotracker_mod._SOURCES.clear()
     return reset_global_store()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); "
+        "perf guards and soaks",
+    )

@@ -1,6 +1,7 @@
 """The BENCH JSON line must not advertise an unproven pipelined number
 (VERDICT r5 ask #3): ``pipelined_tick_ms`` appears only when
-``overlap_proven`` is true."""
+``overlap_proven`` is true. The churn breakdown ships as machine-readable
+fields and the probe history stays bounded."""
 from evergreen_tpu.utils.benchgen import bench_result_payload
 
 _KW = dict(
@@ -10,7 +11,13 @@ _KW = dict(
     seq_ms=60.0,
     pipe_med=55.0,
     overlap_eff=0.1,
-    churn={"churn_ms": 100.0, "store_steady_ms": 80.0},
+    churn={
+        "churn_ms": 100.0,
+        "store_steady_ms": 80.0,
+        "churn_snapshot_ms": 30.0,
+        "churn_solve_ms": 25.0,
+        "churn_store_ms": 45.0,
+    },
     probe_history=[],
 )
 
@@ -28,3 +35,20 @@ def test_pipelined_field_present_when_proven():
     out = bench_result_payload(overlap_proven=True, **_KW)
     assert out["pipelined_tick_ms"] == 55.0
     assert out["overlap_proven"] is True
+
+
+def test_churn_breakdown_fields_in_payload():
+    out = bench_result_payload(overlap_proven=False, **_KW)
+    assert out["churn_tick_ms"] == 100.0
+    assert out["store_steady_tick_ms"] == 80.0
+    assert out["churn_snapshot_ms"] == 30.0
+    assert out["churn_solve_ms"] == 25.0
+    assert out["churn_store_ms"] == 45.0
+
+
+def test_probe_history_capped_to_last_four():
+    probes = [{"t": float(i), "ok": False} for i in range(9)]
+    out = bench_result_payload(
+        overlap_proven=False, **{**_KW, "probe_history": probes}
+    )
+    assert out["probe_history"] == probes[-4:]

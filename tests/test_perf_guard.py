@@ -1,0 +1,21 @@
+"""Store-path perf guard as a slow-marked test (excluded from tier-1):
+churn ticks must stay within 2x of store-backed steady ticks and the
+churn store component must not regress >25% over the checked-in floor.
+See tools/perf_guard.py for the config."""
+import json
+import os
+
+import pytest
+
+from tools import perf_guard
+
+
+@pytest.mark.slow
+def test_churn_store_path_within_budget():
+    result = perf_guard.run_guard()
+    floor = {}
+    if os.path.exists(perf_guard.FLOOR_PATH):
+        with open(perf_guard.FLOOR_PATH, encoding="utf-8") as fh:
+            floor = json.load(fh)
+    failures = perf_guard.evaluate(result, floor)
+    assert not failures, f"{failures} (result={result})"

@@ -3,10 +3,10 @@
 pipeline alive and the store consistent.
 
 One case per fault class from the resilience layer (utils/faults.py
-seams): solve raise, solve hang past deadline, WAL write error, torn WAL
-write, lease loss, agent-comm timeout, cloud-provider error, event-sender
-error, plus the breaker's full open→half-open→closed cycle and the job
-quarantine. Each case builds its own store, installs a deterministic
+seams): solve raise, solve hang past deadline, WAL group-commit write
+error (sync and async-deferred), torn group frame, lease loss, agent-comm
+timeout, cloud-provider error, event-sender error, plus the breaker's
+full open→half-open→closed cycle and the job quarantine. Each case builds its own store, installs a deterministic
 FaultPlan, runs the pipeline, and returns a result dict with ``ok`` and
 the captured structured-log records — `tests/test_resilience.py`
 parametrizes over the same registry, and ``tools/chaos_soak.sh --faults``
@@ -192,18 +192,20 @@ def case_wal_error(seed: int = 0) -> dict:
     store = DurableStore(data_dir)
     _seed_store(store, seed=seed + 17)
     got, stop = _capture_logs()
-    # fire on the FIRST journaled write of the tick (seeding is done):
-    # that lands inside queue persist / intent creation, which must be
-    # isolated per distro
+    # fire on the tick's WAL GROUP COMMIT (seeding is done, so the first
+    # journaled write after install is the batched frame at end-of-tick):
+    # the whole tick's batch is lost atomically, the tick degrades, and
+    # heal_durability checkpoints the in-memory truth
     faults.install(
-        FaultPlan().at("wal.append", 0, Fault("raise", OSError("disk full")))
+        FaultPlan().at("wal.commit", 0, Fault("raise", OSError("disk full")))
     )
     try:
         res = run_tick(store, OPTS, now=NOW)
     finally:
         faults.uninstall()
         stop()
-    # next tick (fault cleared) persists everything
+    # next tick (fault cleared) full-rewrites: the delta fingerprints
+    # were reset when the group was lost
     res2 = run_tick(store, OPTS, now=NOW + 1)
     # recovery from the same directory stays consistent
     recovered = DurableStore(data_dir)
@@ -218,6 +220,9 @@ def case_wal_error(seed: int = 0) -> dict:
             and sum(res2.queues.values()) > 0
             and res2.degraded == ""
             and queues_survive
+            and any(
+                r.get("message") == "wal-group-commit-failed" for r in got
+            )
         ),
         "result": res,
         "logs": got,
@@ -230,7 +235,9 @@ def case_wal_torn(seed: int = 0) -> dict:
     data_dir = tempfile.mkdtemp(prefix="fault-torn-")
     store = DurableStore(data_dir)
     _seed_store(store, seed=seed + 19)
-    faults.install(FaultPlan().at("wal.append", 0, Fault("torn")))
+    # tear the tick's group FRAME: per-batch atomicity means recovery
+    # sees either the whole tick or none of it — never a partial tick
+    faults.install(FaultPlan().at("wal.commit", 0, Fault("torn")))
     try:
         res = run_tick(store, OPTS, now=NOW)
     finally:
@@ -256,6 +263,55 @@ def case_wal_torn(seed: int = 0) -> dict:
             and tasks_survive
         ),
         "result": res,
+    }
+
+
+def case_wal_async_deferred(seed: int = 0) -> dict:
+    """Async group commit (the service cadence): tick t's WAL frame fails
+    on the background flusher AFTER run_tick returned; the error surfaces
+    at tick t+1's barrier as the batched persist-failed degradation, the
+    delta fingerprints reset (t+1 full-rewrites), and recovery stays
+    consistent."""
+    import dataclasses as _dc
+
+    from evergreen_tpu.storage.durable import DurableStore
+
+    data_dir = tempfile.mkdtemp(prefix="fault-walasync-")
+    store = DurableStore(data_dir)
+    _seed_store(store, seed=seed + 29)
+    opts = _dc.replace(OPTS, async_persist=True)
+    got, stop = _capture_logs()
+    faults.install(
+        FaultPlan().at("wal.commit", 0, Fault("raise", OSError("disk full")))
+    )
+    try:
+        res1 = run_tick(store, opts, now=NOW)   # commit fails off-thread
+        res2 = run_tick(store, opts, now=NOW + 1)  # barrier surfaces it
+    finally:
+        faults.uninstall()
+        stop()
+    res3 = run_tick(store, opts, now=NOW + 2)
+    store.sync_persist()
+    recovered = DurableStore(data_dir)
+    queues_survive = all(
+        recovered.collection(TQ_COLLECTION).get(did) is not None
+        for did in res3.queues
+        if not did.endswith("::alias")
+    )
+    return {
+        "ok": (
+            res1.degraded == ""          # the error had not surfaced yet
+            and res2.degraded == "persist-failed"
+            and res3.degraded == ""
+            and sum(res2.queues.values()) > 0
+            and queues_survive
+            and any(
+                r.get("message") == "wal-group-commit-failed"
+                and r.get("deferred") is True
+                for r in got
+            )
+        ),
+        "logs": got,
     }
 
 
@@ -479,6 +535,7 @@ CASES: Dict[str, Callable[[int], dict]] = {
     "breaker-cycle": case_breaker_cycle,
     "wal-error": case_wal_error,
     "wal-torn": case_wal_torn,
+    "wal-async-deferred": case_wal_async_deferred,
     "lease-loss": case_lease_loss,
     "agent-comm": case_agent_comm,
     "provider-error": case_provider_error,
