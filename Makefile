@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -34,6 +34,14 @@ bench:
 # refresh with `python tools/perf_guard.py --write-floor`)
 perf-guard:
 	python tools/perf_guard.py
+
+# process-level crash/failover matrix (slow; tier-1 runs a reduced
+# sample via tests/test_crash_recovery.py): SIGKILL-shaped deaths at
+# solve / WAL-append / group-flush / dispatch / recovery seams, every
+# run must recover to an invariant-clean store with monotone lease
+# epochs, plus the two-process SIGSTOP-steal-SIGCONT failover case
+crash-matrix:
+	python tools/crash_matrix.py
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -52,6 +52,15 @@ def cmd_service(args) -> int:
         finally:
             env.close()
         return 0
+    if env.recovery_report is not None:
+        r = env.recovery_report
+        print(
+            f"recovery: epoch={r.epoch} reconciled_tasks="
+            f"{r.reconciled_tasks} released_claims="
+            f"{len(r.released_claims)} hosts_terminated="
+            f"{len(r.hosts_terminated)} stale_frames_dropped="
+            f"{r.stale_frames_dropped}"
+        )
     env.cron_runner.run_background()
     # background TPU-tunnel prober: log health on an interval and capture
     # on-device bench evidence on the first healthy window (tools/tpu_probe).

@@ -118,6 +118,13 @@ def assign_next_available_task(
             # Another request raced this host to a task; bail and let the
             # agent re-poll (reference returns nil on CAS failure).
             return None
+        # crash seam INSIDE the CAS pair: a death here leaves a host
+        # claiming a task that was never marked dispatched — exactly the
+        # half-assignment the startup reconciliation pass must heal
+        # (scheduler/recovery.py; tools/crash_matrix.py kill point)
+        from ..utils import faults
+
+        faults.fire("dispatch.assign")
         if not mark_task_dispatched(store, t.id, host.id, now):
             # Task was concurrently taken (e.g. by another distro's queue
             # via secondary distros): release the host and keep looking.

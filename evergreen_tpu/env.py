@@ -42,6 +42,9 @@ class Environment:
     lease: object = None
     #: True when this process serves reads from a WAL-tailing replica
     is_replica: bool = False
+    #: what the startup reconciliation pass healed (durable writers only;
+    #: scheduler/recovery.py RecoveryReport)
+    recovery_report: object = None
     _closers: list = dataclasses.field(default_factory=list)
 
     # -- reference Environment accessors -------------------------------- #
@@ -138,7 +141,10 @@ class Environment:
             closers.append(store.close)
         elif data_dir:
             # durable writer: WAL + snapshot engine behind a renewing
-            # lease so a standby can take over the data dir if we die
+            # lease so a standby can take over the data dir if we die.
+            # The store binds to the lease's fencing epoch at open; a
+            # steal observed later fences every further write
+            # (storage/durable.py EpochFencedError).
             import os as _os
 
             from .storage.durable import DurableStore
@@ -156,8 +162,13 @@ class Environment:
                 )
                 _os._exit(70)
 
+            # renewing starts BEFORE the store opens: a WAL replay longer
+            # than the ttl must not let a standby steal the lease out
+            # from under a booting writer (the store observes a later
+            # loss dynamically through lease.lost — no back-reference
+            # needed)
             lease.start_renewing(on_lost=on_lease_lost or _deposed)
-            store = DurableStore(data_dir)
+            store = DurableStore(data_dir, lease=lease)
             set_global_store(store)
             closers.append(lease.release)
             closers.append(store.close)
@@ -187,6 +198,17 @@ class Environment:
             )
             log_mod.configure(store)
 
+        # startup reconciliation: a durable writer (fresh boot OR a
+        # standby that just stole the lease) heals derived state —
+        # half-dispatched assignments, stranded tasks, phantom building
+        # hosts, stale delta-persist fingerprints — BEFORE the job plane
+        # starts, so the first tick plans against reconciled truth
+        recovery_report = None
+        if lease is not None:
+            from .scheduler.recovery import run_recovery_pass
+
+            recovery_report = run_recovery_pass(store)
+
         api = RestApi(
             store,
             require_auth=require_auth,
@@ -197,7 +219,7 @@ class Environment:
 
         env = cls(
             store=store, api=api, lease=lease, is_replica=is_replica,
-            _closers=closers,
+            recovery_report=recovery_report, _closers=closers,
         )
         if with_job_plane and not is_replica:
             from .queue.jobs import JobQueue

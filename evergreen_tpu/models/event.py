@@ -15,6 +15,10 @@ COLLECTION = "events"
 
 _SEQ = itertools.count()
 _SEQ_LOCK = threading.Lock()
+#: highest seq issued in this process — reseeding (after recovering a
+#: store with surviving ids) must never move the shared counter BELOW
+#: ids already handed out for another store
+_SEQ_HWM = -1
 
 
 # Resource types (reference model/event/event.go)
@@ -54,6 +58,24 @@ def coll(store: Store) -> Collection:
     return store.collection(COLLECTION)
 
 
+def _reseed_past(c: Collection) -> None:
+    """Resume the id sequence past the highest surviving event id — a
+    process that recovered a durable store must not re-issue ids its
+    predecessor already journaled (the crash harness found the first
+    post-restart event colliding with a replayed ``evt-0`` and wedging
+    every event-logging caller). The process-wide high-water mark keeps
+    a reseed against a low-id store from dragging the shared counter
+    back below ids already issued for another store."""
+    global _SEQ
+    floor = _SEQ_HWM
+    for k in c.key_order():
+        try:
+            floor = max(floor, int(k.rsplit("-", 1)[1]))
+        except (IndexError, ValueError):
+            continue
+    _SEQ = itertools.count(floor + 1)
+
+
 def log(
     store: Store,
     resource_type: str,
@@ -62,18 +84,30 @@ def log(
     data: Optional[dict] = None,
     timestamp: Optional[float] = None,
 ) -> Event:
-    with _SEQ_LOCK:
-        seq = next(_SEQ)
-    ev = Event(
-        id=f"evt-{seq}",
-        resource_type=resource_type,
-        event_type=event_type,
-        resource_id=resource_id,
-        timestamp=_time.time() if timestamp is None else timestamp,
-        data=data or {},
-    )
-    coll(store).insert(ev.to_doc())
-    return ev
+    global _SEQ_HWM
+    c = coll(store)
+    for attempt in range(3):
+        with _SEQ_LOCK:
+            seq = next(_SEQ)
+            _SEQ_HWM = max(_SEQ_HWM, seq)
+        ev = Event(
+            id=f"evt-{seq}",
+            resource_type=resource_type,
+            event_type=event_type,
+            resource_id=resource_id,
+            timestamp=_time.time() if timestamp is None else timestamp,
+            data=data or {},
+        )
+        try:
+            c.insert(ev.to_doc())
+            return ev
+        except KeyError:
+            # recovered store carries ids ahead of this process's
+            # counter: jump past them and retry (bounded — concurrent
+            # reseeders can only move the counter forward)
+            with _SEQ_LOCK:
+                _reseed_past(c)
+    raise KeyError("could not allocate a fresh event id after reseeding")
 
 
 def find_unprocessed(store: Store, limit: int = 0) -> List[Event]:

@@ -236,6 +236,20 @@ def assign_running_task(
     )
 
 
+#: the one definition of "no running task" — shared by task-end clearing
+#: below and the recovery pass's half-dispatched-claim release
+#: (scheduler/recovery.py), so a new running_task_* field can't be
+#: cleared in one place and leak in the other
+RUNNING_TASK_CLEAR_FIELDS = {
+    "running_task": "",
+    "running_task_group": "",
+    "running_task_build_variant": "",
+    "running_task_version": "",
+    "running_task_project": "",
+    "running_task_group_order": 0,
+}
+
+
 def clear_running_task(store: Store, host_id: str, task_id: str, now: float) -> bool:
     """Clear assignment at task end, recording last-task affinity state
     (reference host.ClearRunningTask)."""
@@ -247,17 +261,12 @@ def clear_running_task(store: Store, host_id: str, task_id: str, now: float) -> 
         host_id,
         expect={"running_task": task_id},
         update={
-            "running_task": "",
+            **RUNNING_TASK_CLEAR_FIELDS,
             "last_task": task_id,
             "last_group": doc.get("running_task_group", ""),
             "last_build_variant": doc.get("running_task_build_variant", ""),
             "last_version": doc.get("running_task_version", ""),
             "last_project": doc.get("running_task_project", ""),
-            "running_task_group": "",
-            "running_task_build_variant": "",
-            "running_task_version": "",
-            "running_task_project": "",
-            "running_task_group_order": 0,
             "task_count": doc.get("task_count", 0) + 1,
             "last_communication_time": now,
         },

@@ -158,7 +158,9 @@ class TickResult:
     #: "tpu" | "serial" | "" (no solver distros)
     planner_used: str = ""
     #: non-empty when the tick degraded: "solve-failed" | "solve-deadline"
-    #: | "breaker-open" | "persist-failed"
+    #: | "breaker-open" | "persist-failed" | "fenced" (the writer's lease
+    #: epoch was superseded mid-tick: the tick's WAL group was shed and
+    #: the holder stood down)
     degraded: str = ""
     #: optional work shed under the tick budget ("events", "stats")
     shed: List[str] = dataclasses.field(default_factory=list)
@@ -453,12 +455,33 @@ def run_tick(
     now = _time.time() if now is None else now
     t0 = _time.perf_counter()
 
+    from ..storage.lease import EpochFencedError
     from .persister import persister_state_for
 
     pstate = persister_state_for(store)
     from ..utils.log import get_logger, incr_counter
 
     _rlog = get_logger("resilience")
+
+    def _fenced_result() -> TickResult:
+        # the holder's lease epoch was superseded: plan nothing, write
+        # nothing — stand-down already fired through the lease's on_lost
+        incr_counter("scheduler.tick.fenced")
+        _rlog.error("degraded-tick", reason="fenced", fallback="none")
+        return TickResult(
+            queues={}, new_hosts={}, intent_hosts=[], n_tasks=0,
+            n_distros=0, total_ms=(_time.perf_counter() - t0) * 1e3,
+            degraded="fenced",
+        )
+
+    # A holder that was deposed between ticks must not even begin: no
+    # writes, no group. The check re-reads the lease file (one read per
+    # tick) so a steal the renewer has not yet noticed is caught here
+    # rather than after a full solve.
+    try:
+        store.assert_not_fenced(read_lease_file=True)
+    except EpochFencedError:
+        return _fenced_result()
 
     # Persist barrier FIRST, before this tick writes anything: wait out
     # the previous tick's async WAL group commit and surface its deferred
@@ -469,6 +492,9 @@ def run_tick(
     prior_persist_failed = False
     try:
         store.sync_persist()
+    except EpochFencedError:
+        # the previous tick's deferred commit was fenced: stop here
+        return _fenced_result()
     except Exception as exc:  # noqa: BLE001 — the previous tick's commit
         prior_persist_failed = True
         pstate.reset()
@@ -496,6 +522,11 @@ def run_tick(
             # never left open
             try:
                 store.end_tick()
+            except EpochFencedError:
+                # fenced mid-tick: the buffered group was shed by the
+                # store; a fenced holder must not heal (no snapshot
+                # writes) — it owns nothing anymore
+                pstate.reset()
             except Exception:  # noqa: BLE001 — best-effort cleanup, but
                 # a lost group still invalidates the delta bases: later
                 # patches must not build on a frame the WAL never got
@@ -505,12 +536,30 @@ def run_tick(
 
 def _commit_tick_group(store: Store, opts: TickOptions) -> str:
     """Commit the tick's WAL group; returns "" or a degradation reason."""
+    from ..storage.lease import EpochFencedError
+
     try:
         if opts.async_persist:
             store.end_tick_async()
         else:
             store.end_tick()
         return ""
+    except EpochFencedError:
+        # the lease epoch was superseded between begin_tick and the
+        # flush: the store shed the buffered group (nothing reached the
+        # WAL) and stood the holder down via the lease's on_lost path —
+        # report it, write nothing more (no heal: a fenced holder must
+        # not touch the snapshot a newer epoch now owns)
+        from .persister import persister_state_for
+        from ..utils.log import get_logger, incr_counter
+
+        persister_state_for(store).reset()
+        incr_counter("scheduler.tick.fenced")
+        get_logger("resilience").error(
+            "tick-fenced",
+            epoch=getattr(store, "epoch", 0),
+        )
+        return "fenced"
     except Exception as exc:  # noqa: BLE001 — a WAL error degrades the
         # tick, never kills it
         from .persister import persister_state_for
@@ -858,7 +907,11 @@ def _run_tick_body(
     # flusher thread (the write overlaps the next tick's snapshot) and a
     # deferred error degrades the NEXT tick at its barrier.
     committed[0] = True
-    degraded = degraded or _commit_tick_group(store, opts)
+    commit_reason = _commit_tick_group(store, opts)
+    if commit_reason == "fenced":
+        degraded = "fenced"  # supersedes any earlier per-distro reason
+    else:
+        degraded = degraded or commit_reason
     total_ms = (_time.perf_counter() - t0) * 1e3
     # the structured runtime-stats line operators grep for (reference
     # grip message.Fields, scheduler/wrapper.go:93-128); it survives

@@ -53,6 +53,17 @@ Design notes:
 Multi-process: replicas coordinate through ``FileLease`` (storage/lease.py)
 — one active writer, standbys take over a stale lease and recover from the
 same directory.  See cli.py ``service --data-dir``.
+
+Split-brain fencing: a store opened with a ``lease`` binds to the holder's
+fencing epoch.  Every group frame is stamped with it (``"e"``), and a
+commit refuses with ``EpochFencedError`` once a newer epoch is observed —
+either through the renewer's ``lost`` flag or by re-reading the lease file
+at the commit boundary.  A fenced store never writes again (appends,
+frames, snapshots all refuse), standing the stale holder down through the
+lease's ``on_lost`` path.  On replay, frames from a superseded epoch that
+interleave past the fence point (a stale holder's writes racing the new
+holder's) are dropped, so the surviving state is exactly the fenced
+holder's history up to the steal plus the new holder's history after it.
 """
 from __future__ import annotations
 
@@ -61,6 +72,7 @@ import os
 import threading
 from typing import Dict, Optional
 
+from .lease import EpochFencedError, FileLease
 from .store import Collection, Store, apply_wal_record
 
 SNAPSHOT_FILE = "snapshot.json"
@@ -90,6 +102,10 @@ class _Journal:
         self._fh = open(path, "a", encoding="utf-8")
         self.ops = 0
         self.suspended = False  # True during recovery replay
+        #: writer's fencing epoch (0 = unfenced): stamped onto EVERY
+        #: record — group frames and per-op lines alike — so replay can
+        #: drop a superseded holder's writes wherever they interleave
+        self.epoch = 0
         #: group-commit buffer: when not None, append() serializes into it
         #: instead of the file (guarded by _lock; the frame is written by
         #: commit_group)
@@ -114,6 +130,8 @@ class _Journal:
     def append(self, record: dict) -> None:
         if self.suspended:
             return
+        if self.epoch:
+            record["e"] = self.epoch
         line = json.dumps(record, separators=(",", ":"), default=str)
         with self._lock:
             if self._group is not None:
@@ -138,8 +156,12 @@ class _Journal:
         directive = faults.fire("wal.append")
         self._write_line(line, directive, n_ops=1)
 
-    def commit_group(self, records: list) -> None:
+    def commit_group(self, records: list, epoch: int = 0) -> None:
         """Write buffered records as ONE torn-safe frame with one flush.
+
+        ``epoch`` (when non-zero) stamps the frame with the writer's
+        lease epoch (``"e"``) — recovery drops frames from superseded
+        epochs that interleave past a fence point.
 
         The ``wal.commit`` fault seam fires once per batch — the batched
         analog of the per-op ``wal.append`` seam, named separately so a
@@ -152,9 +174,14 @@ class _Journal:
         from ..utils import faults
 
         directive = faults.fire("wal.commit")
-        frame = '{"o":"g","n":%d,"rs":[%s]}' % (
-            len(records), ",".join(records)
-        )
+        if epoch:
+            frame = '{"o":"g","n":%d,"e":%d,"rs":[%s]}' % (
+                len(records), epoch, ",".join(records)
+            )
+        else:
+            frame = '{"o":"g","n":%d,"rs":[%s]}' % (
+                len(records), ",".join(records)
+            )
         self._write_line(frame, directive, n_ops=len(records))
 
     def _write_line(self, line: str, directive, n_ops: int) -> None:
@@ -202,12 +229,22 @@ class DurableStore(Store):
         data_dir: str,
         sync: str = "flush",
         compact_every_ops: int = 500_000,
+        lease: Optional[FileLease] = None,
     ) -> None:
         super().__init__()
         os.makedirs(data_dir, exist_ok=True)
         self.data_dir = data_dir
         self.compact_every_ops = compact_every_ops
         self._compact_lock = threading.Lock()
+        #: split-brain fence: bound to the holder's lease epoch at open.
+        #: epoch 0 (no lease — tests, tools) disables stamping + fencing.
+        self._lease = lease
+        self.epoch = lease.epoch if lease is not None else 0
+        self._fenced = False
+        #: what recovery saw: frames replayed/dropped, highest epoch
+        self.replay_report: Dict[str, int] = {
+            "frames": 0, "stale_frames_dropped": 0, "wal_max_epoch": 0,
+        }
         self._journal = _Journal(os.path.join(data_dir, WAL_FILE), sync=sync)
         #: background group-commit flusher (started lazily on the first
         #: async commit); pending frames + deferred errors
@@ -222,6 +259,95 @@ class DurableStore(Store):
         # _flush_cv; the flusher never holds _flush_cv while writing)
         self._journal.deferred = self._defer_behind_pending
         self._recover()
+        if (
+            self._lease is not None
+            and self.epoch
+            and self.epoch <= self.replay_report["wal_max_epoch"]
+        ):
+            # the WAL already holds frames at/above our lease epoch (e.g.
+            # the lease file was deleted while the log survived): advance
+            # so our frames outrank every replayed one
+            self._lease.ensure_epoch_at_least(
+                self.replay_report["wal_max_epoch"] + 1
+            )
+            self.epoch = self._lease.epoch
+        self._journal.epoch = self.epoch
+        if self.epoch:
+            # durable fence point: a marker record pins this epoch in the
+            # WAL the moment the store opens, BEFORE any commit — a
+            # deposed predecessor's frame that lands after it (its async
+            # flusher racing the takeover) is dropped on the next replay
+            # even though this holder hasn't committed anything yet
+            self._journal._write_line(
+                '{"o":"f","e":%d}' % self.epoch, None, n_ops=0
+            )
+        if self.replay_report["stale_frames_dropped"]:
+            from ..utils.log import get_logger, incr_counter
+
+            incr_counter(
+                "wal.stale_frames_dropped",
+                self.replay_report["stale_frames_dropped"],
+            )
+            get_logger("resilience").warning(
+                "stale-epoch-frames-dropped",
+                dropped=self.replay_report["stale_frames_dropped"],
+                wal_max_epoch=self.replay_report["wal_max_epoch"],
+                epoch=self.epoch,
+            )
+
+    # -- split-brain fence ---------------------------------------------------- #
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced or (
+            self._lease is not None and self._lease.lost
+        )
+
+    def _fence(self, reason: str) -> None:
+        """Refuse this and every future write; stand the holder down via
+        the lease's on_lost path. Idempotent."""
+        first = not self._fenced
+        self._fenced = True
+        if first:
+            from ..utils.log import get_logger, incr_counter
+
+            incr_counter("lease.fenced")
+            get_logger("resilience").error(
+                "epoch-fenced", epoch=self.epoch, reason=reason,
+            )
+            if self._lease is not None:
+                self._lease.stand_down(reason)
+        raise EpochFencedError(
+            f"writer epoch {self.epoch} superseded ({reason}); "
+            "this holder must stop serving"
+        )
+
+    def assert_not_fenced(self, read_lease_file: bool = False) -> None:
+        """Raise EpochFencedError once a newer epoch is observed. The
+        cheap path (flag + renewer's ``lost``) runs on every journaled
+        write; ``read_lease_file=True`` additionally re-reads the lease
+        file — the commit-boundary check that closes the window where a
+        stalled holder has not yet noticed the steal."""
+        if self._lease is None:
+            return
+        if self._fenced:
+            self._fence("already fenced")
+        if self._lease.lost:
+            self._fence("lease lost")
+        if not read_lease_file:
+            return
+        cur = self._lease.peek()
+        if cur is None:
+            if self.epoch:
+                # our lease file vanished while we believe we hold it:
+                # ownership is unprovable — stop writing
+                self._fence("lease file missing")
+            return
+        if self._lease.superseded(cur):
+            # the file carries a newer epoch, OR the monotone floor file
+            # records one (a stalled renewal can clobber the stealer's
+            # file, but never the floor)
+            self._fence("newer epoch issued")
 
     # -- Store interface ----------------------------------------------------- #
 
@@ -236,6 +362,7 @@ class DurableStore(Store):
     # -- journaling ---------------------------------------------------------- #
 
     def _on_op(self, record: dict) -> None:
+        self.assert_not_fenced()
         self._journal.append(record)
         if (
             self._journal.ops >= self.compact_every_ops
@@ -258,7 +385,11 @@ class DurableStore(Store):
         self.sync_persist()
 
     def commit_group_inline(self, records: list) -> None:
-        self._journal.commit_group(records)
+        # re-check the fence at WRITE time (the flusher may run this long
+        # after the enqueue-time check): a deferred EpochFencedError
+        # surfaces at the next sync_persist barrier
+        self.assert_not_fenced(read_lease_file=self.epoch > 0)
+        self._journal.commit_group(records, epoch=self.epoch)
         if (
             self._journal.ops >= self.compact_every_ops
             and not self._journal.suspended
@@ -286,10 +417,22 @@ class DurableStore(Store):
         to the next ``sync_persist()`` barrier. Detach + enqueue happen
         under the journal lock, atomically with concurrent appends'
         queue-behind-pending decision — no op can slip between the frame
-        leaving the buffer and it entering the flush queue."""
+        leaving the buffer and it entering the flush queue.
+
+        This is the fence point: the commit boundary re-reads the lease
+        file, and a superseded epoch DISCARDS the buffered group and
+        raises ``EpochFencedError`` — a stale holder's tick never reaches
+        the WAL (the ``wal.fence`` seam fires just before the check so a
+        fault plan can model a steal landing mid-commit)."""
+        from ..utils import faults
+
+        faults.fire("wal.fence")
         j = self._journal
         with j._lock:
             records, j._group = j._group, None
+            # detach FIRST, check the fence SECOND: on a superseded epoch
+            # the buffered group is dropped on the floor, never written
+            self.assert_not_fenced(read_lease_file=self.epoch > 0)
             if not records:
                 return
             with self._flush_cv:
@@ -366,6 +509,7 @@ class DurableStore(Store):
     def _recover(self) -> None:
         snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
         self._journal.suspended = True
+        max_epoch = 0
         try:
             if os.path.exists(snap_path):
                 with open(snap_path, encoding="utf-8") as fh:
@@ -374,7 +518,13 @@ class DurableStore(Store):
                     coll = self.collection(name)
                     for doc in docs:
                         coll.upsert(doc)
+                # epoch watermark: compaction truncates the WAL, so the
+                # fence point must survive in the snapshot — frames a
+                # deposed holder appends to the rotated log still rank
+                # below it
+                max_epoch = int(snap.get("epoch", 0) or 0)
             wal_path = self._journal.path
+            report = self.replay_report
             if os.path.exists(wal_path):
                 with open(wal_path, encoding="utf-8") as fh:
                     for line in fh:
@@ -387,7 +537,29 @@ class DurableStore(Store):
                             # repaired stub of a torn append): that ONE
                             # record is lost; everything after it is intact
                             continue
+                        op = rec.get("o")
+                        if op == "f":
+                            # fence marker: a holder pinned its epoch at
+                            # open; everything older is superseded
+                            max_epoch = max(
+                                max_epoch, int(rec.get("e", 0) or 0)
+                            )
+                            continue
+                        if op == "g":
+                            report["frames"] += 1
+                        e = int(rec.get("e", 0) or 0)
+                        if e:
+                            if e < max_epoch:
+                                # a superseded holder's write landed past
+                                # the fence point (interleaved with a
+                                # higher-epoch holder's): its effect was
+                                # already logically overridden — drop it,
+                                # whole group frame or single per-op line
+                                report["stale_frames_dropped"] += 1
+                                continue
+                            max_epoch = e
                         self._apply(rec)
+            report["wal_max_epoch"] = max_epoch
         finally:
             self._journal.suspended = False
 
@@ -412,6 +584,9 @@ class DurableStore(Store):
         holding one collection's lock) skips if another thread is already
         compacting — that avoids two compactors deadlocking on each
         other's held collection."""
+        # a fenced (superseded-epoch) holder must not rewrite the snapshot
+        # a higher-epoch holder now owns
+        self.assert_not_fenced(read_lease_file=self.epoch > 0)
         if blocking and threading.current_thread() is not self._flusher:
             # drain pending async group commits so rotation can't orphan a
             # frame that was enqueued before the snapshot was cut (errors
@@ -447,7 +622,12 @@ class DurableStore(Store):
                 "collections": {
                     name: list(coll._docs.values())
                     for name, coll in sorted(acquired.items())
-                }
+                },
+                # the epoch watermark: replay re-seeds its fence point
+                # from here after the WAL truncates below
+                "epoch": max(
+                    self.epoch, self.replay_report["wal_max_epoch"]
+                ),
             }
             with open(tmp_path, "w", encoding="utf-8") as fh:
                 json.dump(payload, fh, separators=(",", ":"), default=str)
@@ -461,11 +641,19 @@ class DurableStore(Store):
             self._compact_lock.release()
 
     def close(self) -> None:
+        if self.fenced:
+            # a fenced holder owns nothing: close the journal handle and
+            # walk away — no final frame, no snapshot
+            self._journal.close()
+            return
         # flush any still-open tick group before the final checkpoint so
         # no buffered record is orphaned
         try:
             self.end_tick()
         except Exception:  # noqa: BLE001 — close() is best-effort
             pass
-        self.checkpoint()
+        try:
+            self.checkpoint()
+        except EpochFencedError:
+            pass  # fenced between the commit and the snapshot: stop here
         self._journal.close()

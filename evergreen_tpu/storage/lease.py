@@ -1,4 +1,5 @@
-"""File-based writer lease for multi-replica deployments.
+"""File-based writer lease with fencing epochs for multi-replica
+deployments.
 
 The reference runs N app-server replicas against shared Mongo, relying on
 amboy scope locks for mutual exclusion (reference environment.go:469-486).
@@ -11,9 +12,26 @@ process level (tests/test_durable_store.py::test_lease_failover).
 
 The lease is a JSON file created with O_EXCL; liveness is signalled by
 re-writing it (renewal) every ``ttl/3``.  A lease older than ``ttl`` is
-considered abandoned and may be stolen.  O_EXCL-create after unlink is the
-atomicity primitive; the steal path re-checks ownership after writing to
-close the two-stealers race.
+considered abandoned and may be stolen.
+
+Fencing epochs: every lease carries a monotonically increasing ``epoch``,
+bumped on every steal.  The atomic steal primitive is claim-by-rename —
+``os.rename`` of the stale lease file to a claimant-private name succeeds
+for exactly ONE stealer; the winner then O_EXCL-creates the new lease at
+``epoch+1`` and verifies ownership by re-reading (a verify-after-rename
+loop, replacing the old probabilistic 50 ms sleep).  Renewal is a
+compare-and-swap: read-verify owner AND epoch, atomically replace, then
+re-read to confirm — a renewal that raced a steal observes the loss
+instead of silently clobbering it.  A sidecar floor file (``<path>.epoch``)
+records the highest epoch ever issued so epochs stay monotone even across
+a clean release+unlink cycle.
+
+The epoch is the split-brain fence: the durable store binds to the
+holder's epoch at open, stamps every WAL group frame with it, and refuses
+commits once a newer epoch is observed (storage/durable.py
+``EpochFencedError``) — so even in the unavoidable window where a stalled
+holder has not yet noticed its loss, its writes cannot corrupt the log a
+higher-epoch holder now owns.
 """
 from __future__ import annotations
 
@@ -22,10 +40,22 @@ import os
 import threading
 import time as _time
 import uuid
-from typing import Optional
+from typing import Callable, Optional
+
+
+class EpochFencedError(RuntimeError):
+    """A writer bound to a superseded lease epoch attempted a commit.
+
+    Raised by the durable engine when the lease file carries a newer
+    epoch (or the renewer already observed the loss): the old holder
+    MUST stop serving — the error is the enforcement of the split-brain
+    guard the lease docstring used to merely request."""
 
 
 class FileLease:
+    #: bounded verify-after-rename attempts in the steal path
+    _STEAL_ATTEMPTS = 5
+
     def __init__(self, path: str, ttl_s: float = 10.0) -> None:
         parent = os.path.dirname(path)
         if parent:
@@ -34,8 +64,17 @@ class FileLease:
         self.ttl_s = ttl_s
         self.owner_id = uuid.uuid4().hex
         self.lost = False
+        #: fencing epoch held (0 = not currently holding)
+        self.epoch = 0
         self._renewer: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._on_lost: Optional[Callable[[], None]] = None
+        self._stood_down = False
+        #: serializes renewals against epoch advancement
+        #: (ensure_epoch_at_least during recovery): a renewal half-done
+        #: across the bump must not read a mixed owner/epoch view and
+        #: spuriously stand the holder down
+        self._epoch_lock = threading.Lock()
 
     # -- core ---------------------------------------------------------------- #
 
@@ -46,23 +85,79 @@ class FileLease:
         except (OSError, json.JSONDecodeError):
             return None
 
+    def peek(self) -> Optional[dict]:
+        """Current lease file content (any holder's), or None. The durable
+        engine's fence check reads the epoch through this."""
+        return self._read()
+
+    def _payload(self) -> dict:
+        return {
+            "owner": self.owner_id,
+            "pid": os.getpid(),
+            "at": _time.time(),
+            "epoch": self.epoch,
+        }
+
     def _write(self) -> None:
         tmp = f"{self.path}.{self.owner_id}"
         with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(
-                {"owner": self.owner_id, "pid": os.getpid(),
-                 "at": _time.time()},
-                fh,
-            )
+            json.dump(self._payload(), fh)
         os.replace(tmp, self.path)
 
-    def try_acquire(self) -> bool:
-        """One non-blocking attempt; steals a stale lease."""
+    # -- epoch floor (monotonicity across unlink cycles) ---------------------- #
+
+    def _floor_path(self) -> str:
+        return f"{self.path}.epoch"
+
+    def _epoch_floor(self) -> int:
+        try:
+            with open(self._floor_path(), encoding="utf-8") as fh:
+                return int(fh.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def _bump_epoch_floor(self, epoch: int) -> None:
+        """Best-effort monotone record of the highest epoch ever issued
+        (tmp+rename so a crash never leaves a torn floor)."""
+        if epoch <= self._epoch_floor():
+            return
+        tmp = f"{self._floor_path()}.{self.owner_id}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(str(epoch))
+            os.replace(tmp, self._floor_path())
+        except OSError:
+            pass
+
+    # -- acquisition ---------------------------------------------------------- #
+
+    def _create_excl(self, epoch: int) -> bool:
+        """O_EXCL create at ``epoch`` — the atomic claim primitive. The
+        payload is written through the O_EXCL fd itself so no other
+        process ever observes an empty lease file from us."""
         try:
             fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
+            return False
+        self.epoch = epoch
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump(self._payload(), fh)
+        self._bump_epoch_floor(epoch)
+        return True
+
+    def try_acquire(self) -> bool:
+        """One non-blocking attempt; steals a stale lease, bumping the
+        fencing epoch. Exactly one claimant can own each epoch: the steal
+        renames the stale file away (atomic — one winner), creates the
+        successor with O_EXCL, then verifies by re-reading."""
+        for _ in range(self._STEAL_ATTEMPTS):
+            if self._create_excl(self._epoch_floor() + 1):
+                if self._verify_owner():
+                    return True
+                continue  # clobbered by a stale renewer's replace: re-steal
             cur = self._read()
             if cur is not None and cur.get("owner") == self.owner_id:
+                self.epoch = int(cur.get("epoch", self.epoch) or 0)
                 return True
             if cur is None:
                 # unreadable/corrupt: live unless the FILE is old — an
@@ -73,24 +168,42 @@ class FileLease:
                     if _time.time() - os.path.getmtime(self.path) <= self.ttl_s:
                         return False
                 except OSError:
-                    return False  # vanished: let the next attempt recreate
+                    continue  # vanished: loop recreates via O_EXCL
             elif _time.time() - cur.get("at", 0) <= self.ttl_s:
                 return False  # live holder
-            # stale — steal, then verify we won the race
-            self._write()
-            _time.sleep(0.05)
-            cur = self._read()
-            return cur is not None and cur.get("owner") == self.owner_id
-        else:
-            # write the payload through the O_EXCL fd itself so no other
-            # process ever observes an empty lease file from us
-            with os.fdopen(fd, "w", encoding="utf-8") as fh:
-                json.dump(
-                    {"owner": self.owner_id, "pid": os.getpid(),
-                     "at": _time.time()},
-                    fh,
-                )
-            return True
+            # stale — claim by renaming the file away: os.rename is the
+            # CAS (exactly one stealer succeeds; losers get ENOENT)
+            claim = f"{self.path}.claim.{self.owner_id}"
+            try:
+                os.rename(self.path, claim)
+            except OSError:
+                continue  # another stealer claimed first: re-evaluate
+            try:
+                with open(claim, encoding="utf-8") as fh:
+                    stale = json.load(fh)
+                stale_epoch = int(stale.get("epoch", 0) or 0)
+            except (OSError, json.JSONDecodeError, ValueError):
+                stale_epoch = 0
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+            next_epoch = max(stale_epoch, self._epoch_floor()) + 1
+            if self._create_excl(next_epoch) and self._verify_owner():
+                return True
+            # lost the post-claim window (fresh acquirer snuck in or a
+            # stale renewer clobbered us): loop and re-evaluate
+        return False
+
+    def _verify_owner(self) -> bool:
+        """Verify step of the verify-after-rename loop: the file must
+        still carry our owner AND epoch after the write settled."""
+        cur = self._read()
+        return (
+            cur is not None
+            and cur.get("owner") == self.owner_id
+            and int(cur.get("epoch", 0) or 0) == self.epoch
+        )
 
     def acquire(self, timeout_s: Optional[float] = None,
                 poll_s: float = 0.5) -> bool:
@@ -102,51 +215,120 @@ class FileLease:
                 return False
             _time.sleep(poll_s)
 
+    def superseded(self, cur: Optional[dict] = None) -> bool:
+        """True when evidence exists that a newer epoch was issued: the
+        lease file carries one, OR the floor file records one. The floor
+        is the load-bearing half — a renewal stalled between its read
+        and its replace can clobber the FILE a stealer just wrote (and
+        then read its own payload back), but the floor only ever moves
+        forward, so the stealer's bump survives the clobber. Pass ``cur``
+        (an already-read lease payload) to skip the re-read."""
+        if cur is None:
+            cur = self._read()
+        if cur is not None and int(cur.get("epoch", 0) or 0) > self.epoch:
+            return True
+        return self._epoch_floor() > self.epoch
+
     def renew(self) -> bool:
+        """Compare-and-swap renewal: verify we still own our epoch, write,
+        verify again, then check the monotone epoch floor — a steal that
+        raced the write is observed as a loss (possibly via the floor,
+        when our replace overwrote the stealer's file) instead of being
+        silently won."""
         from ..utils import faults
 
         if faults.fire("lease.renew") == "lost":
             return False  # injected steal: the holder must stand down
-        cur = self._read()
-        if cur is None or cur.get("owner") != self.owner_id:
-            return False  # lost it (stolen after a long stall)
-        self._write()
-        return True
+        with self._epoch_lock:
+            cur = self._read()
+            if (
+                cur is None
+                or cur.get("owner") != self.owner_id
+                or int(cur.get("epoch", 0) or 0) != self.epoch
+            ):
+                return False  # lost it (stolen after a long stall)
+            self._write()
+            if not self._verify_owner():
+                return False
+            # the file says we own it — but if a newer epoch was ever
+            # ISSUED (floor file), our replace clobbered a completed
+            # steal: we must stand down rather than win by overwrite
+            return self._epoch_floor() <= self.epoch
+
+    def ensure_epoch_at_least(self, epoch: int) -> None:
+        """Advance our held epoch to ``epoch`` (recovery found WAL frames
+        stamped at or above our lease epoch — e.g. the lease file was
+        deleted while the WAL survived — so our frames must outrank
+        them). Serialized against the renewer so a half-done renewal
+        never observes a mixed owner/epoch view."""
+        with self._epoch_lock:
+            if self.epoch == 0 or epoch <= self.epoch:
+                return
+            self.epoch = epoch
+            self._write()
+            self._bump_epoch_floor(epoch)
 
     def release(self) -> None:
+        """Release the lease: only unlink if the file still carries OUR
+        owner+epoch — releasing must not delete a lease a standby just
+        stole — and tolerate losing that race (the store's epoch fence is
+        the correctness backstop either way)."""
         self.stop_renewing()
         cur = self._read()
-        if cur is not None and cur.get("owner") == self.owner_id:
+        if (
+            cur is not None
+            and cur.get("owner") == self.owner_id
+            and int(cur.get("epoch", 0) or 0) == self.epoch
+        ):
             try:
                 os.unlink(self.path)
             except OSError:
                 pass
+        self.epoch = 0
 
     # -- background renewal --------------------------------------------------- #
+
+    def stand_down(self, reason: str = "") -> None:
+        """Mark the lease lost and fire ``on_lost`` exactly once — the
+        common exit for a failed renewal AND a fenced commit (the durable
+        engine calls this when it observes a newer epoch)."""
+        fire = False
+        if not self._stood_down:
+            self._stood_down = True
+            self.lost = True
+            fire = True
+        self._stop.set()
+        if not fire:
+            return
+        from ..utils.log import get_logger, incr_counter
+
+        incr_counter("lease.lost")
+        get_logger("resilience").error(
+            "lease-lost",
+            path=self.path,
+            owner=self.owner_id,
+            epoch=self.epoch,
+            reason=reason,
+        )
+        if self._on_lost is not None:
+            self._on_lost()
 
     def start_renewing(self, on_lost=None) -> None:
         """Renew every ttl/3 in a daemon thread.  A failed renewal means
         the lease was stolen while we stalled: ``self.lost`` is set, the
         loop stops, and ``on_lost`` (if any) fires — the holder MUST stop
-        serving, or two writers interleave the same WAL (split-brain)."""
+        serving; the durable engine enforces it by fencing every commit
+        behind the epoch check once ``lost`` is observed."""
+        self._on_lost = on_lost
 
         def loop():
             while not self._stop.wait(self.ttl_s / 3.0):
                 if not self.renew():
-                    self.lost = True
-                    from ..utils.log import get_logger, incr_counter
-
-                    incr_counter("lease.lost")
-                    get_logger("resilience").error(
-                        "lease-lost",
-                        path=self.path,
-                        owner=self.owner_id,
-                    )
-                    if on_lost is not None:
-                        on_lost()
+                    self.stand_down("renewal failed")
                     return
 
         self.lost = False
+        self._stood_down = False
         self._stop.clear()
         self._renewer = threading.Thread(target=loop, daemon=True)
         self._renewer.start()

@@ -126,6 +126,13 @@ class ReplicaStore(Store):
         #: newer one would undo the read-your-writes guarantee)
         self._poll_lock = threading.Lock()
         self._wal_pos = 0
+        #: highest lease epoch seen in group frames; during a failover a
+        #: superseded holder's frame interleaving past the fence point is
+        #: skipped here exactly like crash recovery drops it
+        #: (storage/durable.py) — a replica must not apply writes the
+        #: next recovery will discard
+        self._max_epoch = 0
+        self.stale_frames_skipped = 0
         #: identity of the snapshot we last loaded; a new checkpoint can
         #: replace the snapshot while leaving the WAL at/below our tail
         #: position (e.g. both empty), so truncation detection alone is
@@ -176,6 +183,11 @@ class ReplicaStore(Store):
             with open(snap_path, encoding="utf-8") as fh:
                 snap = json.load(fh)
         loaded = snap.get("collections", {})
+        # the snapshot's epoch watermark re-seeds the fence point after
+        # the primary's compaction truncated the WAL
+        self._max_epoch = max(
+            self._max_epoch, int(snap.get("epoch", 0) or 0)
+        )
         with self._lock:
             names = set(self._collections) | set(loaded)
         for name in names:
@@ -240,6 +252,24 @@ class ReplicaStore(Store):
                         # windows) must not clobber this replica's own
                         self._wal_pos = fh.tell()
                         continue
+                    op = rec.get("o")
+                    if op == "f":
+                        # a holder's open-time fence marker: advance the
+                        # fence point, nothing to apply
+                        self._max_epoch = max(
+                            self._max_epoch, int(rec.get("e", 0) or 0)
+                        )
+                        self._wal_pos = fh.tell()
+                        continue
+                    e = int(rec.get("e", 0) or 0)
+                    if e and e < self._max_epoch:
+                        # superseded-epoch write (group frame OR per-op
+                        # line) past the fence point
+                        self.stale_frames_skipped += 1
+                        self._wal_pos = fh.tell()
+                        continue
+                    if e:
+                        self._max_epoch = max(self._max_epoch, e)
                     self._apply(rec)
                     applied += 1
                     self._wal_pos = fh.tell()

@@ -391,6 +391,14 @@ class Store:
         """Best-effort repair after a failed group commit."""
         return True
 
+    #: split-brain fence state (durable engine overrides with the lease
+    #: epoch check); an in-memory store can never be superseded
+    fenced: bool = False
+
+    def assert_not_fenced(self, read_lease_file: bool = False) -> None:
+        """Raise EpochFencedError when this writer's lease epoch was
+        superseded (durable engine only)."""
+
 
 _GLOBAL_STORE: Optional[Store] = None
 _GLOBAL_LOCK = threading.Lock()

@@ -31,6 +31,17 @@ from . import host_jobs, task_jobs
 def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
     """The 15s tick (crons_remote_fifteen_second.go:42-55): one batched
     planner+allocator solve, scope-locked so ticks never overlap."""
+    if getattr(store, "fenced", False):
+        # the writer lease was lost/superseded (storage/lease.py on_lost
+        # → storage/durable.py fence): a deposed holder must not enqueue
+        # another tick while its stand-down is in flight — run_tick would
+        # refuse anyway, but not populating keeps the queue quiet
+        from ..utils.log import get_logger
+
+        get_logger("resilience").warning(
+            "scheduler-tick-skipped", reason="fenced"
+        )
+        return []
     flags = ServiceFlags.get(store)
     if flags.scheduler_disabled and flags.host_allocator_disabled:
         return []

@@ -80,21 +80,86 @@ def monitor_host_cloud_state(store: Store, now: Optional[float] = None) -> List[
     return changed
 
 
-def fix_stranded_task(
-    store: Store, task_id: str, host_id: str, now: float
-) -> None:
-    """System-fail a task whose host died (reference
-    units/task_stranded_cleanup.go + model.ResetTaskOrMarkSystemFailed)."""
+#: automatic stranded-task restarts before the task STAYS system-failed
+#: (reference evergreen.MaxTaskExecution bound inside
+#: model.ResetTaskOrMarkSystemFailed; attempt accounting rides the task's
+#: num_automatic_restarts field)
+MAX_STRANDED_TASK_RESTARTS = 3
+
+
+def reset_task_or_mark_system_failed(
+    store: Store,
+    task_id: str,
+    host_id: str,
+    now: float,
+    reason: str = "host terminated while task was running",
+    max_restarts: int = MAX_STRANDED_TASK_RESTARTS,
+) -> str:
+    """The reference's ``ResetTaskOrMarkSystemFailed``: the in-flight
+    execution is system-failed (archived with its details), then — if the
+    task still has automatic restarts left and was not aborted — it is
+    reset to run again, with ``num_automatic_restarts`` accounting the
+    attempts.  Returns "reset", "system-failed", or "" (no-op: the task
+    was already finished or not in flight)."""
+    from ..utils.log import get_logger, incr_counter
+
     t = task_mod.get(store, task_id)
     if t is None or t.is_finished():
-        return
-    mark_end(
+        return ""
+    ended = mark_end(
         store,
         task_id,
         TaskStatus.FAILED.value,
         now=now,
         details_type="system",
-        details_desc=f"host {host_id} was terminated while task was running",
+        details_desc=f"host {host_id}: {reason}",
+    )
+    if ended is None:
+        return ""  # not dispatched/started: nothing in flight to fix
+    attempts = t.num_automatic_restarts
+    if t.aborted or attempts >= max_restarts:
+        incr_counter("recovery.stranded_system_failed")
+        get_logger("resilience").warning(
+            "stranded-task-system-failed",
+            task=task_id,
+            host=host_id,
+            attempts=attempts,
+            reason=reason,
+        )
+        return "system-failed"
+    from .task_jobs import restart_task
+
+    if not restart_task(store, task_id, by="stranded-task-reset", now=now):
+        # mark_end already reset it (reset_when_finished — a restart the
+        # USER requested): don't charge an automatic-restart credit
+        t2 = task_mod.get(store, task_id)
+        if t2 is not None and t2.status == TaskStatus.UNDISPATCHED.value:
+            return "reset"
+        return "system-failed"  # unexpected state: leave it failed
+    task_mod.coll(store).update(
+        task_id, {"num_automatic_restarts": attempts + 1}
+    )
+    incr_counter("recovery.stranded_reset")
+    get_logger("resilience").info(
+        "stranded-task-reset",
+        task=task_id,
+        host=host_id,
+        attempt=attempts + 1,
+        reason=reason,
+    )
+    return "reset"
+
+
+def fix_stranded_task(
+    store: Store, task_id: str, host_id: str, now: float
+) -> None:
+    """Reset-or-system-fail a task whose host died (reference
+    units/task_stranded_cleanup.go + model.ResetTaskOrMarkSystemFailed:
+    the stranded execution is archived as a system failure and the task
+    re-runs automatically while restart attempts remain)."""
+    reset_task_or_mark_system_failed(
+        store, task_id, host_id, now,
+        reason="host was terminated while task was running",
     )
 
 
@@ -107,19 +172,32 @@ def reap_stale_building_hosts(
     provision-failed handling)."""
     now = _time.time() if now is None else now
     reaped: List[str] = []
-    for h in host_mod.find(
-        store,
-        lambda d: d["status"]
-        in (
-            HostStatus.BUILDING.value,
-            HostStatus.STARTING.value,
-            HostStatus.PROVISIONING.value,
-        )
-        and now - max(d.get("start_time", 0.0), d.get("creation_time", 0.0))
-        > stale_after_s,
-    ):
-        _terminate(store, h, "stale building/provisioning", now)
-        reaped.append(h.id)
+    building = (
+        HostStatus.BUILDING.value,
+        HostStatus.STARTING.value,
+        HostStatus.PROVISIONING.value,
+    )
+    c = host_mod.coll(store)
+    for doc in c.find(lambda d: d["status"] in building):
+        born = max(doc.get("start_time") or 0.0, doc.get("creation_time") or 0.0)
+        if born <= 0.0:
+            # a doc missing BOTH timestamps would read as epoch-0 and be
+            # reaped instantly: start its staleness clock now instead,
+            # stamping the doc so the window eventually elapses
+            from ..utils.log import get_logger, incr_counter
+
+            incr_counter("hosts.reap_missing_timestamps")
+            get_logger("resilience").warning(
+                "building-host-missing-timestamps",
+                host=doc["_id"],
+                status=doc["status"],
+            )
+            c.update(doc["_id"], {"creation_time": now})
+            continue
+        if now - born > stale_after_s:
+            _terminate(store, host_mod.Host.from_doc(doc),
+                       "stale building/provisioning", now)
+            reaped.append(doc["_id"])
     return reaped
 
 

@@ -14,7 +14,6 @@ from ..globals import TaskStatus
 from ..models import event as event_mod
 from ..models import host as host_mod
 from ..models import task as task_mod
-from ..models.lifecycle import mark_end
 from ..storage.store import Store
 
 #: a dispatched/started task with no heartbeat for this long is presumed
@@ -29,8 +28,15 @@ def monitor_stale_heartbeats(
     now: Optional[float] = None,
     timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
 ) -> List[str]:
-    """System-fail in-flight tasks whose heartbeat went stale (reference
-    units/task_monitor_execution_timeout.go:73,143)."""
+    """Reap in-flight tasks whose heartbeat went stale (reference
+    units/task_monitor_execution_timeout.go:73,143): the dead execution
+    is archived as a system failure and the task automatically re-runs
+    while restart attempts remain — the same
+    ``reset_task_or_mark_system_failed`` path startup reconciliation uses
+    (scheduler/recovery.py), so a heartbeat lost to a crash and one lost
+    to a hung agent converge identically."""
+    from .host_jobs import reset_task_or_mark_system_failed
+
     now = _time.time() if now is None else now
     reaped: List[str] = []
     for doc in task_mod.coll(store).find(
@@ -39,18 +45,17 @@ def monitor_stale_heartbeats(
         and now - max(d.get("last_heartbeat", 0.0), d.get("dispatch_time", 0.0))
         > timeout_s
     ):
-        mark_end(
-            store,
-            doc["_id"],
-            TaskStatus.FAILED.value,
-            now=now,
-            details_type="system",
-            details_desc="heartbeat timeout: task presumed dead",
+        host_id = doc.get("host_id", "")
+        outcome = reset_task_or_mark_system_failed(
+            store, doc["_id"], host_id or "<none>", now,
+            reason="heartbeat timeout: task presumed dead",
         )
-        reaped.append(doc["_id"])
-        # free the host if it still claims this task
-        if doc.get("host_id"):
-            host_mod.clear_running_task(store, doc["host_id"], doc["_id"], now)
+        if outcome:
+            reaped.append(doc["_id"])
+        # free the host if it still claims this task (mark_end clears a
+        # coherent claim; this covers a claim the task doc never knew)
+        if host_id:
+            host_mod.clear_running_task(store, host_id, doc["_id"], now)
     return reaped
 
 

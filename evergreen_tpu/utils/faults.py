@@ -20,10 +20,21 @@ Instrumented seams:
                         separate seam so a scheduled fault targets group
                         commits and cannot be consumed by an unrelated
                         store's per-op append
+  ``wal.fence``         fires immediately before the group commit's epoch
+                        fence check (storage/durable.py end_tick_async) —
+                        a "call" fault here models a stall between
+                        begin_tick and the flush during which the lease
+                        is stolen mid-commit
   ``lease.renew``       lease loss mid-tick (storage/lease.py)
   ``agent.comm``        agent→server transport faults (agent/rest_comm.py)
   ``cloud.spawn``       cloud-provider spawn errors (cloud/provisioning.py)
   ``events.deliver``    event-sender failures (events/transports.py)
+  ``dispatch.assign``   fires inside the dispatch CAS pair, between the
+                        host claim and the task transition
+                        (dispatch/assign.py) — the crash harness's
+                        duplicate-dispatch kill point
+  ``recovery.pass``     fires at the start of the startup reconciliation
+                        pass (scheduler/recovery.py)
 
 A plan is installed explicitly (``install(plan)`` — tests, the fault
 matrix soak) or via the ``EVG_FAULTS`` env spec at import time:
@@ -35,6 +46,13 @@ Fault kinds:
   ``raise``  raise the configured exception (default FaultError)
   ``hang``   sleep ``delay_s`` then return (a stall the caller's deadline
              must catch)
+  ``crash``  ``os._exit(86)`` — a real process death AT the seam, no
+             atexit/finally cleanup: the crash harness's SIGKILL-shaped
+             kill points (tools/crash_matrix.py)
+  ``call``   invoke ``fault.fn()`` then return (after an optional
+             ``delay_s`` sleep) — lets a test run arbitrary work at the
+             seam, e.g. stealing the lease between begin_tick and the
+             group flush
   anything else (``torn``, ``lost``, …) is returned to the seam as a
   directive string — the seam implements the special behavior (e.g. the
   WAL writes half a record, the lease reports itself stolen).
@@ -64,10 +82,12 @@ class Fault:
         kind: str = "raise",
         exc: Optional[BaseException] = None,
         delay_s: float = 0.0,
+        fn: Optional[Callable[[], None]] = None,
     ) -> None:
         self.kind = kind
         self.exc = exc
         self.delay_s = delay_s
+        self.fn = fn
 
     def __repr__(self) -> str:  # readable audit trails
         return f"Fault({self.kind!r}, delay_s={self.delay_s})"
@@ -140,6 +160,17 @@ class FaultPlan:
             )
         if fault.kind == "hang":
             sleep(fault.delay_s)
+            return None
+        if fault.kind == "crash":
+            # the crash harness's kill point: die like SIGKILL — no
+            # atexit, no finally blocks, no flushes beyond what already
+            # hit the OS
+            os._exit(86)
+        if fault.kind == "call":
+            if fault.delay_s:
+                sleep(fault.delay_s)
+            if fault.fn is not None:
+                fault.fn()
             return None
         return fault.kind
 

@@ -1,0 +1,619 @@
+"""Split-brain-safe failover: lease epochs + CAS steal/renewal, fenced
+WAL commits, superseded-frame replay drops, the startup reconciliation
+pass, and (slow-marked) the real-process crash/failover matrix
+(tools/crash_matrix.py).
+
+Acceptance contract (ISSUE 4): exactly one holder owns each lease epoch;
+a holder whose epoch was superseded mid-tick sheds the tick with
+EpochFencedError and nothing from it reaches the WAL; recovery replays
+drop stale-epoch frames that interleave past the fence point; the
+recovery pass heals half-dispatched assignments, stranded tasks, and
+phantom building hosts before the first tick plans.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from evergreen_tpu.globals import HostStatus, Provider, TaskStatus
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.distro import Distro
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.scheduler.recovery import run_recovery_pass
+from evergreen_tpu.storage.durable import DurableStore
+from evergreen_tpu.storage.lease import EpochFencedError, FileLease
+
+NOW = 1_700_000_000.0
+
+
+# --------------------------------------------------------------------------- #
+# lease epochs + CAS
+# --------------------------------------------------------------------------- #
+
+
+def test_epoch_monotone_across_steals_and_releases(tmp_path):
+    """Epochs increase on every steal AND survive a clean release+unlink
+    cycle (the sidecar floor file carries the high-water mark)."""
+    path = str(tmp_path / "writer.lease")
+    a = FileLease(path, ttl_s=0.4)
+    assert a.try_acquire() and a.epoch == 1
+    # stale steal bumps
+    time.sleep(0.5)
+    b = FileLease(path, ttl_s=0.4)
+    assert b.try_acquire() and b.epoch == 2
+    # clean release + fresh acquire still advances past the floor
+    b.release()
+    c = FileLease(path, ttl_s=0.4)
+    assert c.try_acquire()
+    assert c.epoch == 3
+
+
+def test_steal_is_cas_exactly_one_winner(tmp_path):
+    """N concurrent stealers of one stale lease: exactly one wins, and
+    the winner owns a strictly higher epoch (claim-by-rename is the
+    atomic primitive)."""
+    path = str(tmp_path / "writer.lease")
+    holder = FileLease(path, ttl_s=0.2)
+    assert holder.try_acquire()
+    time.sleep(0.3)  # go stale
+    thieves = [FileLease(path, ttl_s=0.2) for _ in range(8)]
+    results = [None] * len(thieves)
+    barrier = threading.Barrier(len(thieves))
+
+    def steal(i):
+        barrier.wait()
+        results[i] = thieves[i].try_acquire()
+
+    threads = [
+        threading.Thread(target=steal, args=(i,))
+        for i in range(len(thieves))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [t for t, ok in zip(thieves, results) if ok]
+    assert len(winners) == 1
+    assert winners[0].epoch == 2
+    assert not holder.renew()  # the old holder observes the loss
+
+
+def test_renew_is_cas_detects_steal(tmp_path):
+    path = str(tmp_path / "writer.lease")
+    a = FileLease(path, ttl_s=0.3)
+    assert a.try_acquire()
+    time.sleep(0.4)
+    b = FileLease(path, ttl_s=0.3)
+    assert b.try_acquire()
+    # a's renew must fail BOTH on owner and on epoch mismatch — even if
+    # the file somehow carried a's owner id at a different epoch
+    assert not a.renew()
+    assert b.renew()
+
+
+def test_release_only_unlinks_own_lease(tmp_path):
+    """The release read-then-unlink race fix: releasing after a standby
+    stole must NOT delete the standby's lease."""
+    path = str(tmp_path / "writer.lease")
+    a = FileLease(path, ttl_s=0.3)
+    assert a.try_acquire()
+    time.sleep(0.4)
+    b = FileLease(path, ttl_s=0.3)
+    assert b.try_acquire()
+    a.release()  # stale holder releases AFTER the steal
+    assert os.path.exists(path), "release deleted the standby's lease"
+    assert b.renew()
+    b.release()
+    assert not os.path.exists(path)  # the rightful owner's unlink works
+
+
+def test_renewal_clobber_cannot_win(tmp_path):
+    """The stalled-renewal race: A passes its read-verify, stalls, B
+    completes a steal, A's replace clobbers B's lease file and A reads
+    its own payload back. The monotone epoch-floor file outlives the
+    clobber, so A's renewal still observes the loss."""
+    path = str(tmp_path / "writer.lease")
+    a = FileLease(path, ttl_s=0.2)
+    assert a.try_acquire()
+    time.sleep(0.3)
+    b = FileLease(path, ttl_s=0.2)
+    assert b.try_acquire() and b.epoch == 2
+    # simulate the stalled half of a.renew(): the read-verify happened
+    # BEFORE b's steal, so only the blind replace remains
+    a._write()
+    assert not a.renew(), "clobbering renewal must not win"
+    assert a.superseded()
+
+
+def test_stand_down_fires_on_lost_once(tmp_path):
+    path = str(tmp_path / "writer.lease")
+    a = FileLease(path, ttl_s=5.0)
+    assert a.try_acquire()
+    calls = []
+    a._on_lost = lambda: calls.append(1)
+    a.stand_down("test")
+    a.stand_down("test again")
+    assert a.lost and calls == [1]
+
+
+# --------------------------------------------------------------------------- #
+# fenced WAL writes
+# --------------------------------------------------------------------------- #
+
+
+def _steal_from(tmp_path) -> FileLease:
+    thief = FileLease(str(tmp_path / "data" / "writer.lease"), ttl_s=60.0)
+    thief.ttl_s = -1.0  # anything is stale: steal immediately
+    assert thief.try_acquire()
+    return thief
+
+
+def _holder_store(tmp_path, **kw):
+    lease = FileLease(str(tmp_path / "data" / "writer.lease"), ttl_s=60.0)
+    assert lease.try_acquire()
+    store = DurableStore(str(tmp_path / "data"), lease=lease, **kw)
+    return lease, store
+
+
+def test_group_frames_stamped_with_epoch(tmp_path):
+    lease, store = _holder_store(tmp_path)
+    store.begin_tick()
+    store.collection("k").upsert({"_id": "x", "v": 1})
+    store.end_tick()
+    frames = [
+        json.loads(line)
+        for line in open(str(tmp_path / "data" / "wal.log"))
+        if line.startswith('{"o":"g"')
+    ]
+    assert frames and all(f["e"] == lease.epoch for f in frames)
+
+
+def test_fenced_commit_sheds_group_and_stands_down(tmp_path):
+    lease, store = _holder_store(tmp_path)
+    store.collection("k").upsert({"_id": "pre", "v": 0})
+    store.begin_tick()
+    store.collection("k").upsert({"_id": "mid-tick", "v": 1})
+    _steal_from(tmp_path)  # the steal lands before the flush
+    with pytest.raises(EpochFencedError):
+        store.end_tick()
+    assert store.fenced and lease.lost
+    # every further write refuses
+    with pytest.raises(EpochFencedError):
+        store.collection("k").upsert({"_id": "after", "v": 2})
+    with pytest.raises(EpochFencedError):
+        store.checkpoint()
+    # recovery sees the pre-tick write and nothing from the shed group
+    recovered = DurableStore(str(tmp_path / "data"))
+    assert recovered.collection("k").get("pre") is not None
+    assert recovered.collection("k").get("mid-tick") is None
+    assert recovered.collection("k").get("after") is None
+
+
+def test_fenced_close_writes_nothing(tmp_path):
+    lease, store = _holder_store(tmp_path)
+    store.collection("k").upsert({"_id": "pre", "v": 0})
+    _steal_from(tmp_path)
+    try:
+        store.collection("k").upsert({"_id": "post-steal", "v": 1})
+    except EpochFencedError:
+        pass
+    snap = str(tmp_path / "data" / "snapshot.json")
+    store.close()  # must not checkpoint a dir a newer epoch owns
+    assert not os.path.exists(snap)
+
+
+def test_replay_drops_superseded_epoch_frames(tmp_path):
+    """Frames from a superseded epoch that interleave PAST the fence
+    point are dropped; frames before it (and the newer epoch's own)
+    replay normally."""
+    d = tmp_path / "data"
+    d.mkdir()
+    frame = (
+        '{"o":"g","n":1,"e":%d,"rs":['
+        '{"c":"k","o":"p","d":{"_id":"%s","by":%d}}]}\n'
+    )
+    with open(d / "wal.log", "w") as fh:
+        fh.write(frame % (1, "a", 1))   # old holder, pre-fence: applies
+        fh.write(frame % (2, "b", 2))   # new holder: the fence point
+        fh.write(frame % (1, "c", 1))   # stale interleave: DROPPED
+        fh.write(frame % (2, "d", 2))   # new holder continues
+    store = DurableStore(str(d))
+    assert store.collection("k").get("a") is not None
+    assert store.collection("k").get("b") is not None
+    assert store.collection("k").get("c") is None
+    assert store.collection("k").get("d") is not None
+    assert store.replay_report["stale_frames_dropped"] == 1
+    assert store.replay_report["wal_max_epoch"] == 2
+
+
+def test_replay_drops_superseded_per_op_records(tmp_path):
+    """Per-op lines carry the writer's epoch too: a stale holder's
+    between-ticks write (REST mutation, event log) landing past the
+    fence point is erased at replay just like a stale group frame."""
+    d = tmp_path / "data"
+    d.mkdir()
+    with open(d / "wal.log", "w") as fh:
+        fh.write('{"c":"k","o":"p","d":{"_id":"pre"},"e":1}\n')
+        fh.write('{"o":"f","e":2}\n')  # new holder's open-time marker
+        fh.write('{"c":"k","o":"p","d":{"_id":"stale"},"e":1}\n')
+        fh.write('{"c":"k","o":"p","d":{"_id":"new"},"e":2}\n')
+    store = DurableStore(str(d))
+    assert store.collection("k").get("pre") is not None
+    assert store.collection("k").get("stale") is None
+    assert store.collection("k").get("new") is not None
+    assert store.replay_report["stale_frames_dropped"] == 1
+
+
+def test_per_op_records_stamped_with_epoch(tmp_path):
+    lease, store = _holder_store(tmp_path)
+    store.collection("k").upsert({"_id": "x"})
+    lines = [
+        json.loads(line)
+        for line in open(str(tmp_path / "data" / "wal.log"))
+    ]
+    put = next(line for line in lines if line.get("o") == "p")
+    assert put["e"] == lease.epoch
+
+
+def test_stale_write_to_same_doc_cannot_clobber(tmp_path):
+    """The doc-level consequence of frame fencing: a stale holder's
+    version of a doc the new holder rewrote does not survive replay."""
+    d = tmp_path / "data"
+    d.mkdir()
+    rec = '{"o":"g","n":1,"e":%d,"rs":[{"c":"k","o":"p","d":{"_id":"x","owner":%d}}]}\n'
+    with open(d / "wal.log", "w") as fh:
+        fh.write(rec % (2, 2))
+        fh.write(rec % (1, 1))  # stale holder's racing write
+    store = DurableStore(str(d))
+    assert store.collection("k").get("x")["owner"] == 2
+
+
+def test_fence_marker_drops_late_frame_before_first_commit(tmp_path):
+    """A deposed holder's async flusher can land its frame AFTER the new
+    holder opened but BEFORE the new holder's first commit. The new
+    holder's open-time fence marker makes replay drop it anyway."""
+    lease, store = _holder_store(tmp_path)  # epoch 1, no commits yet
+    thief = _steal_from(tmp_path)           # epoch 2
+    new_store = DurableStore(str(tmp_path / "data"), lease=thief)
+    assert new_store.epoch == 2
+    # the stale holder's late frame races in (same inode, append mode)
+    with open(str(tmp_path / "data" / "wal.log"), "a") as fh:
+        fh.write(
+            '{"o":"g","n":1,"e":1,"rs":['
+            '{"c":"k","o":"p","d":{"_id":"late"}}]}\n'
+        )
+    recovered = DurableStore(str(tmp_path / "data"))
+    assert recovered.collection("k").get("late") is None
+    assert recovered.replay_report["stale_frames_dropped"] == 1
+
+
+def test_snapshot_watermark_survives_compaction(tmp_path):
+    """Compaction truncates the WAL; the fence point must survive in the
+    snapshot so a stale frame appended to the fresh log still ranks
+    below it."""
+    lease, store = _holder_store(tmp_path)
+    thief = _steal_from(tmp_path)
+    new_store = DurableStore(str(tmp_path / "data"), lease=thief)
+    new_store.collection("k").upsert({"_id": "mine"})
+    new_store.checkpoint()  # WAL truncated; watermark lives in snapshot
+    with open(str(tmp_path / "data" / "wal.log"), "a") as fh:
+        fh.write(
+            '{"o":"g","n":1,"e":1,"rs":['
+            '{"c":"k","o":"p","d":{"_id":"stale"}}]}\n'
+        )
+    recovered = DurableStore(str(tmp_path / "data"))
+    assert recovered.collection("k").get("mine") is not None
+    assert recovered.collection("k").get("stale") is None
+
+
+def test_event_id_reseed_never_moves_backward(store):
+    """Two recovered stores with different id floors share one process
+    counter; a reseed against the LOW store (the concurrent-collision
+    interleave) must not drag the counter back under ids already issued
+    — the high-water mark wins."""
+    from evergreen_tpu.models import event as event_mod
+    from evergreen_tpu.storage.store import Store as _Store
+
+    high, low = _Store(), _Store()
+    base = {"resource_type": "TASK", "event_type": "X", "resource_id": "r",
+            "timestamp": NOW, "processed_at": 0.0, "data": {}}
+    for _ in range(5):
+        e1 = event_mod.log(high, "TASK", "A", "r")
+    hwm = int(e1.id.split("-")[1])
+    low.collection("events").insert({"_id": "evt-3", **base})
+    # the interleaved half of a concurrent collision: a reseed computed
+    # from the low store landing after higher ids were already issued
+    event_mod._reseed_past(low.collection("events"))
+    e2 = event_mod.log(high, "TASK", "B", "r")
+    assert int(e2.id.split("-")[1]) > hwm
+
+
+def test_standby_epoch_outranks_orphaned_wal_frames(tmp_path):
+    """If the lease file vanished but the WAL kept high-epoch frames, a
+    fresh holder is advanced past them at open so its frames can never
+    be dropped as stale."""
+    d = tmp_path / "data"
+    d.mkdir()
+    with open(d / "wal.log", "w") as fh:
+        fh.write(
+            '{"o":"g","n":1,"e":7,"rs":[{"c":"k","o":"p","d":{"_id":"x"}}]}\n'
+        )
+    lease = FileLease(str(d / "writer.lease"), ttl_s=60.0)
+    assert lease.try_acquire()
+    assert lease.epoch == 1  # no floor file: fresh epoch
+    store = DurableStore(str(d), lease=lease)
+    assert lease.epoch == 8 and store.epoch == 8
+
+
+def test_run_tick_refuses_when_fenced(tmp_path):
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+
+    lease, store = _holder_store(tmp_path)
+    distro_mod.insert(store, Distro(id="d1"))
+    _steal_from(tmp_path)
+    res = run_tick(
+        store, TickOptions(create_intent_hosts=False), now=NOW
+    )
+    assert res.degraded == "fenced"
+    assert res.queues == {}
+
+
+def test_fenced_store_skips_scheduler_tick_population(tmp_path):
+    """The on_lost path at the populator level: once the renewer observed
+    the loss, the cron plane stops enqueueing ticks and per-op writes
+    refuse."""
+    from evergreen_tpu.units.crons import scheduler_tick_jobs
+
+    lease, store = _holder_store(tmp_path)
+    _steal_from(tmp_path)
+    lease.stand_down("renewal failed")  # what the renewer thread does
+    assert store.fenced
+    assert scheduler_tick_jobs(store, NOW) == []
+    with pytest.raises(EpochFencedError):
+        store.collection("poke").upsert({"_id": "x"})
+
+
+# --------------------------------------------------------------------------- #
+# startup reconciliation
+# --------------------------------------------------------------------------- #
+
+
+def test_recovery_releases_half_dispatched_claim(store):
+    """A crash between the dispatch CAS pair leaves a host claiming a
+    task that never transitioned: recovery releases the claim."""
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.UNDISPATCHED.value,
+             activated=True),
+    )
+    host_mod.insert(
+        store,
+        Host(id="h1", distro_id="d1", status=HostStatus.RUNNING.value,
+             running_task="t1"),
+    )
+    report = run_recovery_pass(store, now=NOW)
+    assert report.released_claims == ["h1"]
+    assert host_mod.get(store, "h1").running_task == ""
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.UNDISPATCHED.value
+
+
+def test_recovery_keeps_coherent_assignment(store):
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", last_heartbeat=NOW - 5),
+    )
+    host_mod.insert(
+        store,
+        Host(id="h1", distro_id="d1", status=HostStatus.RUNNING.value,
+             running_task="t1"),
+    )
+    report = run_recovery_pass(store, now=NOW)
+    assert report.released_claims == []
+    assert report.reconciled_tasks == 0
+    assert host_mod.get(store, "h1").running_task == "t1"
+
+
+def test_recovery_resets_stranded_task_with_attempt_accounting(store):
+    """In-flight task on a dead host: archived as a system failure, then
+    reset to run again; num_automatic_restarts carries the accounting."""
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="gone", start_time=NOW - 100,
+             last_heartbeat=NOW - 50),
+    )
+    report = run_recovery_pass(store, now=NOW)
+    assert report.stranded_reset == ["t1"]
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.UNDISPATCHED.value
+    assert t.execution == 1
+    assert t.num_automatic_restarts == 1
+    archived = store.collection("task_archives").get("t1:0")
+    assert archived["status"] == TaskStatus.FAILED.value
+    assert archived["details_type"] == "system"
+
+
+def test_recovery_stale_heartbeat_reset_and_max_restarts(store):
+    """Heartbeat-stale in-flight task on a live host is reset; past the
+    restart cap it STAYS system-failed."""
+    from evergreen_tpu.units.host_jobs import MAX_STRANDED_TASK_RESTARTS
+
+    host_mod.insert(
+        store,
+        Host(id="h1", distro_id="d1", status=HostStatus.RUNNING.value,
+             running_task="t1"),
+    )
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", last_heartbeat=NOW - 3600,
+             num_automatic_restarts=0),
+    )
+    report = run_recovery_pass(store, now=NOW)
+    assert report.stranded_reset == ["t1"]
+
+    # exhaust the attempts: the task stays failed
+    task_mod.coll(store).update(
+        "t1",
+        {"status": TaskStatus.STARTED.value, "host_id": "h1",
+         "last_heartbeat": NOW - 3600,
+         "num_automatic_restarts": MAX_STRANDED_TASK_RESTARTS},
+    )
+    host_mod.coll(store).update("h1", {"running_task": "t1"})
+    report2 = run_recovery_pass(store, now=NOW)
+    assert report2.stranded_failed == ["t1"]
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.FAILED.value
+    assert t.details_type == "system"
+
+
+def test_recovery_reverifies_building_hosts(store):
+    from evergreen_tpu.cloud.mock import MockCloudManager
+
+    MockCloudManager.reset()
+    distro_mod.insert(store, Distro(id="d1", provider=Provider.MOCK.value))
+    host_mod.insert(
+        store,
+        Host(id="alive", distro_id="d1", provider=Provider.MOCK.value,
+             status=HostStatus.PROVISIONING.value, external_id="mock-a"),
+    )
+    host_mod.insert(
+        store,
+        Host(id="ghost", distro_id="d1", provider=Provider.MOCK.value,
+             status=HostStatus.BUILDING.value, external_id="mock-g"),
+    )
+    MockCloudManager.instances["mock-a"] = "running"
+    # mock-g never registered → the provider reports it nonexistent
+    report = run_recovery_pass(store, now=NOW)
+    assert report.hosts_terminated == ["ghost"]
+    assert host_mod.get(store, "ghost").status == HostStatus.TERMINATED.value
+    assert host_mod.get(store, "alive").status == HostStatus.PROVISIONING.value
+
+
+def test_recovery_invalidates_persister_state(store):
+    from evergreen_tpu.scheduler.persister import persister_state_for
+
+    pstate = persister_state_for(store)
+    pstate._fps[("d1", False)] = object()
+    pstate.infos_static = True
+    run_recovery_pass(store, now=NOW)
+    assert pstate._fps == {}
+    assert pstate.infos_static is False
+
+
+def test_recovery_counts_via_structured_log(store):
+    from evergreen_tpu.utils import log as log_mod
+
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.DISPATCHED.value,
+             activated=True, host_id="gone", last_heartbeat=NOW - 10),
+    )
+    log_mod.reset_counters()
+    got = []
+    log_mod.add_sink(got.append)
+    try:
+        run_recovery_pass(store, now=NOW)
+    finally:
+        log_mod.remove_sink(got.append)
+    assert log_mod.get_counter("recovery.reconciled_tasks") == 1
+    recs = [r for r in got if r.get("message") == "recovery-pass"]
+    assert recs and recs[0]["reconciled_tasks"] == 1
+
+
+def test_environment_build_runs_recovery_pass(tmp_path):
+    """A durable-writer Environment heals the data dir before the job
+    plane starts (the standby-takeover entry point)."""
+    from evergreen_tpu.env import Environment
+
+    d = str(tmp_path / "data")
+    seed = DurableStore(d)
+    task_mod.insert(
+        seed,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="gone", last_heartbeat=1.0),
+    )
+    seed.close()
+    env = Environment.build(data_dir=d, with_job_plane=False)
+    try:
+        assert env.recovery_report is not None
+        assert env.recovery_report.reconciled_tasks == 1
+        assert env.store.epoch == env.lease.epoch > 0
+    finally:
+        env.close()
+
+
+# --------------------------------------------------------------------------- #
+# satellite fixes
+# --------------------------------------------------------------------------- #
+
+
+def test_reap_stale_building_missing_timestamps(store):
+    """A building host doc missing BOTH start_time and creation_time must
+    not be reaped instantly: its clock starts at first observation."""
+    from evergreen_tpu.units import host_jobs
+    from evergreen_tpu.utils import log as log_mod
+
+    store.collection("hosts").upsert(
+        {"_id": "h-bare", "distro_id": "d1", "provider": "mock",
+         "status": HostStatus.BUILDING.value, "started_by": "mci",
+         "start_time": 0.0, "creation_time": 0.0, "running_task": ""},
+    )
+    log_mod.reset_counters()
+    reaped = host_jobs.reap_stale_building_hosts(store, NOW)
+    assert reaped == []
+    assert log_mod.get_counter("hosts.reap_missing_timestamps") == 1
+    # the clock started: stamped with the observation time …
+    doc = store.collection("hosts").get("h-bare")
+    assert doc["creation_time"] == NOW
+    # … so once the window genuinely elapses it IS reaped
+    reaped = host_jobs.reap_stale_building_hosts(store, NOW + 16 * 60)
+    assert reaped == ["h-bare"]
+
+
+# --------------------------------------------------------------------------- #
+# crash matrix (subprocess; reduced sample in tier-1, full set slow)
+# --------------------------------------------------------------------------- #
+
+
+def test_crash_point_dispatch_assign_recovers(tmp_path):
+    """The tier-1 reduced sample: one real SIGKILL-shaped death between
+    the dispatch CAS pair; the restarted process reconciles and converges
+    to the uninterrupted run's state."""
+    from tools.crash_matrix import reference_state, run_point
+
+    out = run_point("dispatch.assign", 0, reference=reference_state())
+    assert out["ok"], out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "seam,idx",
+    [p for p in __import__("tools.crash_matrix",
+                           fromlist=["KILL_POINTS"]).KILL_POINTS],
+)
+def test_crash_matrix_full(seam, idx):
+    from tools.crash_matrix import reference_state, run_point
+
+    out = run_point(seam, idx, reference=reference_state())
+    assert out["ok"], out
+
+
+@pytest.mark.slow
+def test_two_process_failover():
+    """Holder SIGSTOPped mid-commit, standby steals + reconciles, holder
+    SIGCONTed: the resumed holder's commit is rejected (EpochFencedError
+    → FENCED/exit 75, or the renewer's stand-down), and zero stale-epoch
+    frames survive past the fence point."""
+    from tools.crash_matrix import failover_case
+
+    out = failover_case()
+    assert out["ok"], out
+    assert out["standby_epoch"] > out["holder_epoch"]

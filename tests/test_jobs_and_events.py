@@ -92,9 +92,15 @@ def test_cloud_reconciliation_strands_task(store):
     changed = host_jobs.monitor_host_cloud_state(store, NOW)
     assert changed == ["h1"]
     assert host_mod.get(store, "h1").status == HostStatus.TERMINATED.value
+    # ResetTaskOrMarkSystemFailed semantics: the stranded execution is
+    # archived as a system failure and the task automatically re-runs
     t = task_mod.get(store, "t1")
-    assert t.status == TaskStatus.FAILED.value
-    assert t.details_type == "system"
+    assert t.status == TaskStatus.UNDISPATCHED.value
+    assert t.execution == 1
+    assert t.num_automatic_restarts == 1
+    archived = store.collection("task_archives").get("t1:0")
+    assert archived["status"] == TaskStatus.FAILED.value
+    assert archived["details_type"] == "system"
 
 
 def test_idle_termination_respects_minimum(store):
@@ -132,10 +138,124 @@ def test_heartbeat_monitor_reaps_dead_tasks(store):
     _running_host(store, "h1", running_task="t1")
     reaped = task_jobs.monitor_stale_heartbeats(store, NOW)
     assert reaped == ["t1"]
+    # the dead execution is archived as a system failure; the task
+    # re-runs automatically (ResetTaskOrMarkSystemFailed semantics)
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.UNDISPATCHED.value
+    assert t.execution == 1
+    assert t.num_automatic_restarts == 1
+    archived = store.collection("task_archives").get("t1:0")
+    assert archived["status"] == TaskStatus.FAILED.value
+    assert archived["details_type"] == "system"
+    assert host_mod.get(store, "h1").is_free()
+
+
+def test_heartbeat_monitor_leaves_fresh_tasks_alone(store):
+    """Neither a recent heartbeat nor a recent dispatch (the pre-first-
+    heartbeat window) may be reaped."""
+    task_mod.insert(
+        store,
+        Task(id="beating", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", last_heartbeat=NOW - 30),
+    )
+    task_mod.insert(
+        store,
+        Task(id="just-dispatched", distro_id="d1",
+             status=TaskStatus.DISPATCHED.value, activated=True,
+             host_id="h2", last_heartbeat=0.0, dispatch_time=NOW - 30),
+    )
+    assert task_jobs.monitor_stale_heartbeats(store, NOW) == []
+    assert task_mod.get(store, "beating").status == TaskStatus.STARTED.value
+    assert (
+        task_mod.get(store, "just-dispatched").status
+        == TaskStatus.DISPATCHED.value
+    )
+
+
+def test_heartbeat_monitor_exhausted_restarts_stay_failed(store):
+    from evergreen_tpu.units.host_jobs import MAX_STRANDED_TASK_RESTARTS
+
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", last_heartbeat=NOW - 3600,
+             num_automatic_restarts=MAX_STRANDED_TASK_RESTARTS),
+    )
+    _running_host(store, "h1", running_task="t1")
+    reaped = task_jobs.monitor_stale_heartbeats(store, NOW)
+    assert reaped == ["t1"]
     t = task_mod.get(store, "t1")
     assert t.status == TaskStatus.FAILED.value
     assert t.details_type == "system"
+    assert t.execution == 0  # no further restart was granted
     assert host_mod.get(store, "h1").is_free()
+
+
+def test_heartbeat_monitor_aborted_task_not_restarted(store):
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", last_heartbeat=NOW - 3600,
+             aborted=True),
+    )
+    _running_host(store, "h1", running_task="t1")
+    assert task_jobs.monitor_stale_heartbeats(store, NOW) == ["t1"]
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.FAILED.value
+
+
+def test_stale_heartbeat_monitor_with_poison_quarantine(store):
+    """The monitor runs as a background job: if its job type turns
+    poisonous (fails poison_threshold consecutive runs) the queue
+    quarantines it — stale tasks wait, the cron loop stays healthy — and
+    the post-cooldown probe reaps them on recovery."""
+    import time as _t
+
+    from evergreen_tpu.queue.jobs import FnJob, JobQueue
+
+    task_mod.insert(
+        store,
+        Task(id="t1", distro_id="d1", status=TaskStatus.STARTED.value,
+             activated=True, host_id="h1", last_heartbeat=NOW - 3600),
+    )
+    _running_host(store, "h1", running_task="t1")
+    q = JobQueue(store, workers=1, poison_threshold=2, quarantine_s=300.0)
+    state = {"broken": True}
+
+    def monitor(s):
+        if state["broken"]:
+            raise RuntimeError("monitor dependency down")
+        task_jobs.monitor_stale_heartbeats(s, NOW)
+
+    try:
+        for i in range(2):
+            assert q.put(FnJob(f"mon-{i}", monitor,
+                               job_type="task-exec-timeout"))
+            q.wait_idle(5.0)
+        # quarantined: further monitor enqueues are dropped, recorded
+        assert not q.put(FnJob("mon-2", monitor,
+                               job_type="task-exec-timeout"))
+        assert (
+            store.collection("jobs").get("mon-2")["status"] == "quarantined"
+        )
+        # the stale task is still waiting — nothing reaped it
+        assert task_mod.get(store, "t1").status == TaskStatus.STARTED.value
+        # dependency heals + cooldown elapses → one probe runs the real
+        # monitor and lifts the quarantine
+        state["broken"] = False
+        with q._lock:
+            q._quarantined_until["task-exec-timeout"] = _t.time() - 1
+        assert q.put(FnJob("mon-probe", monitor,
+                           job_type="task-exec-timeout"))
+        q.wait_idle(5.0)
+        assert q.put(FnJob("mon-after", monitor,
+                           job_type="task-exec-timeout"))
+        q.wait_idle(5.0)
+    finally:
+        q.close()
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.UNDISPATCHED.value  # reset path ran
+    assert t.num_automatic_restarts == 1
 
 
 def test_restart_task_archives_and_resets(store):

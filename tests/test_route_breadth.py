@@ -432,9 +432,14 @@ def test_sns_termination_drives_host_transition(api, store):
     assert st == 200 and body["host"] == "h1"
     h = host_mod.get(store, "h1")
     assert h.status == HostStatus.TERMINATED.value
+    # the stranded task is archived as a system failure and reset to run
+    # again (ResetTaskOrMarkSystemFailed semantics)
     t = task_mod.get(store, "t1")
-    assert t.status == TaskStatus.FAILED.value
-    assert t.details_type == "system"
+    assert t.status == TaskStatus.UNDISPATCHED.value
+    assert t.execution == 1
+    archived = store.collection("task_archives").get("t1:0")
+    assert archived["status"] == TaskStatus.FAILED.value
+    assert archived["details_type"] == "system"
     evs = [e.event_type for e in event_mod.find_by_resource(store, "h1")]
     assert "HOST_EXTERNALLY_TERMINATED" in evs
 

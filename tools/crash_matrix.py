@@ -1,0 +1,620 @@
+#!/usr/bin/env python
+"""Process-level crash/failover matrix: real kills, real recovery.
+
+The fault matrix (tools/fault_matrix.py) injects failures INSIDE a live
+process; this harness proves the other half of the robustness story —
+the process itself dying at the worst possible instruction. A child
+process runs a deterministic tick+dispatch workload against a temp data
+dir behind a ``FileLease``; the parent arranges its death at env-selected
+crash points (utils/faults.py seam names with the ``crash`` kind →
+``os._exit``, the SIGKILL shape: no atexit, no finally, no flushes beyond
+what already hit the OS), restarts it, and asserts invariants:
+
+  * resume ≡ rerun — the crashed-and-recovered run converges to the same
+    final task/queue state as one uninterrupted run of the same workload;
+  * monotone lease epochs — every restart steals at a strictly higher
+    fencing epoch;
+  * no duplicate dispatch — at most one host claims a task, claims and
+    task docs stay coherent;
+  * no torn group applied — the recovered store passes structural
+    invariants (aligned queue columns, legal statuses).
+
+Plus the two-process failover case: the holder is SIGSTOPped mid-commit
+(a ``hang`` fault at the ``wal.fence`` seam widens the window), a standby
+steals the lease and runs its own ticks, the holder is SIGCONTed — its
+resumed commit must be rejected with ``EpochFencedError`` and ZERO frames
+with a superseded epoch may survive past the fence point in the WAL.
+
+Run standalone (``make crash-matrix`` / ``python tools/crash_matrix.py``)
+or through the gate (``python tools/gate.py --crash-matrix``);
+tests/test_crash_recovery.py runs a reduced kill-point sample in tier-1.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time as _time
+from typing import Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: deterministic workload clock (same anchor the fault matrix uses)
+NOW = 1_700_000_000.0
+TICK_S = 15.0
+#: enough ticks for the 24-task workload to fully drain (every task
+#: succeeded, queues empty): resume ≡ rerun is asserted at CONVERGENCE —
+#: a crash mid-dispatch-phase legitimately shifts which tick a task
+#: finishes on (the dispatch path is per-op incremental, not
+#: group-atomic), but the converged state must be identical
+DEFAULT_TICKS = 9
+LEASE_TTL_S = 0.75
+
+#: the ≥12-point kill matrix: (seam, call-index) pairs covering solve,
+#: WAL-append, group-flush (commit + fence), dispatch, recovery-pass and
+#: lease-renewal seams. Indices are per-seam call counts inside the child.
+KILL_POINTS: List[Tuple[str, int]] = [
+    ("recovery.pass", 0),     # dies INSIDE the reconciliation pass
+    ("wal.commit", 0),        # the seed frame's flush
+    ("wal.commit", 1),        # tick 0's group flush
+    ("wal.commit", 3),        # a mid-run group flush
+    ("wal.fence", 1),         # just before tick 0's fence check
+    ("scheduler.solve", 0),   # first device solve
+    ("scheduler.solve", 2),   # a warm solve
+    ("wal.append", 0),        # first per-op append (dispatch-phase write)
+    ("wal.append", 7),        # a later per-op append
+    ("dispatch.assign", 0),   # between the dispatch CAS pair
+    ("dispatch.assign", 3),   # a later half-assignment
+    ("lease.renew", 0),       # the renewer thread's first beat
+    ("lease.renew", 1),       # a later renewal
+]
+
+
+# --------------------------------------------------------------------------- #
+# child: the deterministic workload
+# --------------------------------------------------------------------------- #
+
+
+def _seed_problem(store) -> None:
+    """Small deterministic problem, seeded idempotently (upserts — a
+    crash mid-seed must not make the reseed raise on duplicates) and
+    committed as ONE WAL group so the seed is crash-atomic."""
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.utils.benchgen import generate_problem
+
+    distros, tasks_by_distro, hosts_by_distro, _, _ = generate_problem(
+        2, 24, seed=11, hosts_per_distro=3, dep_fraction=0.25
+    )
+    store.begin_tick()
+    try:
+        for d in distros:
+            distro_mod.coll(store).upsert(d.to_doc())
+        for ts in tasks_by_distro.values():
+            for t in ts:
+                task_mod.coll(store).upsert(t.to_doc())
+        for hs in hosts_by_distro.values():
+            for h in hs:
+                # benchgen stamps phantom running tasks for allocator
+                # realism; the harness needs free hosts whose every
+                # dispatch is a real CAS pair
+                h.running_task = ""
+                h.running_task_group = ""
+                h.running_task_build_variant = ""
+                h.running_task_version = ""
+                h.running_task_project = ""
+                host_mod.coll(store).upsert(h.to_doc())
+        store.collection("harness").upsert({"_id": "progress", "ticks": 0})
+    finally:
+        store.end_tick()
+
+
+def _agent_sim(store, now: float) -> None:
+    """One deterministic agent step: finish everything in flight (tasks
+    run exactly one tick), then dispatch every free host from the queues
+    the tick just persisted — the real CAS pair, including its crash
+    seam."""
+    from evergreen_tpu.dispatch.assign import assign_next_available_task
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.lifecycle import mark_end, mark_task_started
+
+    c = task_mod.coll(store)
+    in_flight = sorted(
+        d["_id"] for d in c.find(
+            lambda d: d["status"]
+            in (TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value)
+        )
+    )
+    for tid in in_flight:
+        mark_task_started(store, tid, now=now)
+        mark_end(store, tid, TaskStatus.SUCCEEDED.value, now=now)
+    svc = DispatcherService(store)  # fresh per step: no TTL staleness
+    hosts = sorted(
+        (h for h in host_mod.find(store) if h.can_run_tasks()
+         and not h.running_task),
+        key=lambda h: h.id,
+    )
+    for h in hosts:
+        assign_next_available_task(store, svc, h, now=now)
+
+
+def child_main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
+    p.add_argument("--crash", default="", help="seam@index kill point")
+    p.add_argument("--stall", type=float, default=0.0,
+                   help="hang this long at the wal.fence seam each tick")
+    p.add_argument("--ttl", type=float, default=LEASE_TTL_S)
+    p.add_argument("--hold", action="store_true",
+                   help="after the ticks, keep the lease until stdin EOF")
+    args = p.parse_args(argv)
+
+    from evergreen_tpu.utils import faults
+
+    plan = faults.FaultPlan()
+    if args.crash:
+        seam, _, idx = args.crash.partition("@")
+        plan.at(seam.strip(), int(idx or 0), faults.Fault("crash"))
+    if args.stall > 0:
+        plan.always("wal.fence", faults.Fault("hang", delay_s=args.stall))
+    if args.crash or args.stall > 0:
+        faults.install(plan)
+
+    from evergreen_tpu.scheduler.recovery import run_recovery_pass
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.lease import EpochFencedError, FileLease
+
+    lease = FileLease(
+        os.path.join(args.data_dir, "writer.lease"), ttl_s=args.ttl
+    )
+    if not lease.acquire(timeout_s=30.0, poll_s=0.1):
+        print("LEASE-TIMEOUT", flush=True)
+        return 3
+    store = DurableStore(args.data_dir, lease=lease)
+    lease.start_renewing(on_lost=lambda: None)  # loss observed via .lost
+
+    prog = store.collection("harness").get("progress")
+    done = prog["ticks"] if prog else 0
+    report = run_recovery_pass(store, now=NOW + done * TICK_S)
+    print("EPOCH " + str(lease.epoch), flush=True)
+    print("RECOVERY " + json.dumps(report.to_doc()), flush=True)
+
+    if prog is None:
+        _seed_problem(store)
+
+    opts = TickOptions(
+        create_intent_hosts=False,  # intent ids are uuids: keeping the
+        # tick idempotent keeps resume ≡ rerun byte-comparable
+        underwater_unschedule=False,
+        use_cache=False,
+    )
+    try:
+        for i in range(done, args.ticks):
+            now = NOW + (i + 1) * TICK_S
+            res = run_tick(store, opts, now=now)
+            if res.degraded == "fenced":
+                print("FENCED", flush=True)
+                os._exit(75)
+            if lease.lost:
+                print("LOST", flush=True)
+                os._exit(70)
+            _agent_sim(store, now)
+            store.collection("harness").upsert(
+                {"_id": "progress", "ticks": i + 1}
+            )
+            print(f"TICK-DONE {i}", flush=True)
+        store.sync_persist()
+    except EpochFencedError:
+        # any fenced write rejection (dispatch/progress per-op appends
+        # included) stands the stale holder down
+        print("FENCED", flush=True)
+        os._exit(75)
+    print("DONE", flush=True)
+    if args.hold:
+        print("HOLDING", flush=True)
+        sys.stdin.readline()  # parent signals; lease stays held meanwhile
+    lease.release()
+    # no store.close(): the WAL must keep its frames for the parent's
+    # epoch scan (everything is already flushed; close() would compact)
+    os._exit(0)
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# parent: orchestration + invariants
+# --------------------------------------------------------------------------- #
+
+
+def _child_cmd(data_dir: str, ticks: int, crash: str = "",
+               stall: float = 0.0, hold: bool = False) -> List[str]:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--data-dir", data_dir, "--ticks", str(ticks),
+    ]
+    if crash:
+        cmd += ["--crash", crash]
+    if stall > 0:
+        cmd += ["--stall", str(stall)]
+    if hold:
+        cmd += ["--hold"]
+    return cmd
+
+
+def _child_env() -> dict:
+    return {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "EVG_FAULTS": "",
+    }
+
+
+def _run_child(data_dir: str, ticks: int, crash: str = "",
+               timeout_s: float = 240.0) -> Tuple[int, str]:
+    proc = subprocess.run(
+        _child_cmd(data_dir, ticks, crash=crash),
+        env=_child_env(), cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=timeout_s,
+    )
+    return proc.returncode, proc.stdout.decode(errors="replace")
+
+
+def wal_frame_epochs(data_dir: str) -> List[int]:
+    """The ``e`` stamp of every parseable group frame, in file order."""
+    out: List[int] = []
+    path = os.path.join(data_dir, "wal.log")
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if not line.endswith("\n"):
+                break
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("o") == "g":
+                out.append(int(rec.get("e", 0) or 0))
+    return out
+
+
+def check_invariants(store) -> List[str]:
+    """Structural invariants every recovered store must satisfy."""
+    from evergreen_tpu.globals import TaskStatus
+
+    problems: List[str] = []
+    legal = {s.value for s in TaskStatus}
+    claims: Dict[str, str] = {}
+    for doc in store.collection("hosts").find():
+        rt = doc.get("running_task", "")
+        if not rt:
+            continue
+        if rt in claims.values():
+            problems.append(f"duplicate claim of task {rt}")
+        claims[doc["_id"]] = rt
+    for doc in store.collection("tasks").find():
+        if doc["status"] not in legal:
+            problems.append(f"illegal status {doc['status']} on {doc['_id']}")
+        if doc.get("execution", 0) < 0:
+            problems.append(f"negative execution on {doc['_id']}")
+        if doc["status"] in ("dispatched", "started"):
+            hid = doc.get("host_id", "")
+            hdoc = store.collection("hosts").get(hid)
+            if hdoc is None or hdoc.get("running_task") != doc["_id"]:
+                problems.append(
+                    f"in-flight task {doc['_id']} not claimed by host {hid!r}"
+                )
+    for hid, rt in claims.items():
+        tdoc = store.collection("tasks").get(rt)
+        if tdoc is None or tdoc["status"] not in ("dispatched", "started"):
+            problems.append(
+                f"host {hid} claims task {rt} that is not in flight"
+            )
+    for coll_name in ("task_queues", "task_queues_secondary"):
+        for doc in store.collection(coll_name).find():
+            n = len(doc.get("rows", []))
+            for col in ("sort_value", "dependencies_met"):
+                if len(doc.get(col, [])) != n:
+                    problems.append(
+                        f"misaligned {col} in {coll_name}/{doc['_id']}"
+                    )
+    # duplicate dispatch: two TASK_DISPATCHED events for the same task at
+    # the same tick timestamp would mean two hosts won the same CAS
+    seen: Dict[tuple, int] = {}
+    for doc in store.collection("events").find(
+        lambda d: d.get("event_type") == "TASK_DISPATCHED"
+    ):
+        key = (doc.get("resource_id"), doc.get("timestamp"))
+        seen[key] = seen.get(key, 0) + 1
+        if seen[key] > 1:
+            problems.append(f"duplicate dispatch event {key}")
+    return problems
+
+
+def canonical_state(store) -> dict:
+    """The resume ≡ rerun comparison surface: converged task state +
+    queue contents (doc versions/timestamps excluded — reruns bump them;
+    content must not differ)."""
+    from evergreen_tpu.models.task_queue import doc_column
+
+    tasks = {
+        d["_id"]: [d["status"], d.get("execution", 0)]
+        for d in store.collection("tasks").find()
+    }
+    queues = {
+        d["_id"]: doc_column(d, "id")
+        for d in store.collection("task_queues").find()
+    }
+    return {"tasks": tasks, "queues": queues}
+
+
+def _open_for_inspection(data_dir: str):
+    from evergreen_tpu.storage.durable import DurableStore
+
+    return DurableStore(data_dir)
+
+
+def run_point(seam: str, index: int, ticks: int = DEFAULT_TICKS,
+              reference: Optional[dict] = None) -> dict:
+    """One kill point: run-with-crash, restart clean, check everything."""
+    data_dir = tempfile.mkdtemp(prefix=f"crash-{seam.replace('.', '-')}-")
+    crash = f"{seam}@{index}"
+    rc1, out1 = _run_child(data_dir, ticks, crash=crash)
+    crashed = rc1 == 86
+    rc2, out2 = _run_child(data_dir, ticks)
+    epochs = [
+        int(line.split()[1])
+        for line in (out1 + out2).splitlines()
+        if line.startswith("EPOCH ")
+    ]
+    store = _open_for_inspection(data_dir)
+    problems = check_invariants(store)
+    prog = store.collection("harness").get("progress")
+    if not prog or prog["ticks"] != ticks:
+        problems.append(f"workload did not converge: progress={prog}")
+    if not crashed and rc1 != 0:
+        problems.append(f"first run died unexpectedly: rc={rc1}")
+    if rc2 != 0:
+        problems.append(f"recovery run failed: rc={rc2}")
+    if epochs != sorted(set(epochs)):
+        problems.append(f"epochs not strictly increasing: {epochs}")
+    parity_ok = True
+    if reference is not None:
+        parity_ok = canonical_state(store) == reference
+        if not parity_ok:
+            problems.append("resume != rerun")
+    return {
+        "point": crash,
+        "ok": crashed and not problems,
+        "crashed": crashed,
+        "rc": (rc1, rc2),
+        "epochs": epochs,
+        "parity_ok": parity_ok,
+        "problems": problems,
+        "data_dir": data_dir,
+        "out": (out1 + out2) if problems else "",
+    }
+
+
+def reference_state(ticks: int = DEFAULT_TICKS) -> dict:
+    """One uninterrupted run of the same workload — the rerun side of
+    resume ≡ rerun."""
+    data_dir = tempfile.mkdtemp(prefix="crash-reference-")
+    rc, out = _run_child(data_dir, ticks)
+    if rc != 0:
+        raise RuntimeError(f"reference run failed rc={rc}:\n{out}")
+    state = canonical_state(_open_for_inspection(data_dir))
+    undrained = [
+        tid for tid, (status, _) in state["tasks"].items()
+        if status != "success"
+    ]
+    if undrained:
+        raise RuntimeError(
+            f"reference workload did not drain in {ticks} ticks "
+            f"({len(undrained)} unfinished: {undrained[:5]}) — parity at "
+            "convergence needs every task finished; raise ticks"
+        )
+    return state
+
+
+def failover_case(ticks: int = 4, stall_s: float = 2.0) -> dict:
+    """Two-process failover: holder SIGSTOPped mid-commit, standby steals
+    and runs, holder SIGCONTed → its resumed commit is fenced; the WAL
+    carries zero superseded-epoch frames past the fence point."""
+    data_dir = tempfile.mkdtemp(prefix="crash-failover-")
+    problems: List[str] = []
+    holder = subprocess.Popen(
+        _child_cmd(data_dir, 999, stall=stall_s),
+        env=_child_env(), cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    holder_out: List[str] = []
+    procs = [holder]
+    try:
+        # wait until the holder has completed a tick, then freeze it —
+        # with the wal.fence stall dominating each tick, the stop lands
+        # inside the begin_tick→flush window with high probability
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            line = holder.stdout.readline().decode(errors="replace")
+            if not line:
+                break
+            holder_out.append(line)
+            if line.startswith("TICK-DONE 0"):
+                break
+        else:
+            problems.append("holder never finished tick 0")
+        _time.sleep(stall_s / 2)  # land inside tick 1's fence stall
+        os.kill(holder.pid, signal.SIGSTOP)
+
+        # standby steals after the ttl and runs its own ticks, then HOLDS
+        # the lease so the resumed holder fences against a live newer
+        # epoch (not a missing file)
+        standby = subprocess.Popen(
+            _child_cmd(data_dir, ticks, hold=True),
+            env=_child_env(), cwd=_REPO_ROOT,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        procs.append(standby)
+        standby_out: List[str] = []
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            line = standby.stdout.readline().decode(errors="replace")
+            if not line:
+                break
+            standby_out.append(line)
+            if line.startswith("HOLDING"):
+                break
+        else:
+            problems.append("standby never reached HOLDING")
+
+        # resume the stale holder: its in-flight commit must fence
+        os.kill(holder.pid, signal.SIGCONT)
+        try:
+            holder.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            holder.kill()
+            problems.append("resumed holder did not stand down")
+        holder_out.append(
+            holder.stdout.read().decode(errors="replace")
+        )
+        if holder.returncode not in (70, 75):
+            problems.append(
+                f"holder exit {holder.returncode}, want 70 (lost) or 75 "
+                "(EpochFencedError at commit)"
+            )
+
+        standby.stdin.close()  # let the standby release and exit
+        try:
+            standby.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            standby.kill()
+            problems.append("standby did not exit after release")
+        standby_text = "".join(standby_out)
+        holder_text = "".join(holder_out)
+
+        holder_epoch = standby_epoch = 0
+        for line in holder_text.splitlines():
+            if line.startswith("EPOCH "):
+                holder_epoch = int(line.split()[1])
+        for line in standby_text.splitlines():
+            if line.startswith("EPOCH "):
+                standby_epoch = int(line.split()[1])
+        if standby_epoch <= holder_epoch:
+            problems.append(
+                f"standby epoch {standby_epoch} !> holder {holder_epoch}"
+            )
+
+        # the acceptance grep: zero frames with a superseded epoch after
+        # the fence point
+        epochs = wal_frame_epochs(data_dir)
+        fence_at = next(
+            (i for i, e in enumerate(epochs) if e >= standby_epoch), None
+        )
+        stale_after_fence = (
+            [] if fence_at is None
+            else [e for e in epochs[fence_at:] if 0 < e < standby_epoch]
+        )
+        if standby_epoch and fence_at is None:
+            problems.append("standby committed no frames")
+        if stale_after_fence:
+            problems.append(
+                f"stale-epoch frames past the fence: {stale_after_fence}"
+            )
+
+        store = _open_for_inspection(data_dir)
+        problems.extend(check_invariants(store))
+        return {
+            "ok": not problems,
+            "problems": problems,
+            "holder_exit": holder.returncode,
+            "holder_epoch": holder_epoch,
+            "standby_epoch": standby_epoch,
+            "frame_epochs": epochs,
+            "fenced_at_commit": "FENCED" in holder_text,
+            "data_dir": data_dir,
+            "holder_out": holder_text if problems else "",
+            "standby_out": standby_text if problems else "",
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.kill(proc.pid, signal.SIGCONT)
+                except OSError:
+                    pass
+                proc.kill()
+
+
+def run_matrix(points: Optional[List[Tuple[str, int]]] = None,
+               ticks: int = DEFAULT_TICKS) -> int:
+    points = points if points is not None else KILL_POINTS
+    reference = reference_state(ticks)
+    failures = 0
+    for seam, idx in points:
+        out = run_point(seam, idx, ticks=ticks, reference=reference)
+        print(json.dumps({
+            k: out[k]
+            for k in ("point", "ok", "crashed", "rc", "epochs",
+                      "parity_ok", "problems")
+        }))
+        if not out["ok"]:
+            failures += 1
+            sys.stderr.write(out["out"] + "\n")
+    fo = failover_case()
+    print(json.dumps({
+        k: fo[k]
+        for k in ("ok", "problems", "holder_exit", "holder_epoch",
+                  "standby_epoch", "frame_epochs", "fenced_at_commit")
+    }))
+    if not fo["ok"]:
+        failures += 1
+        sys.stderr.write(fo["holder_out"] + "\n" + fo["standby_out"] + "\n")
+    print(json.dumps({"crash_matrix_failures": failures,
+                      "points": len(points) + 1}))
+    return 1 if failures else 0
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--child"]
+        return child_main(argv)
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--point", default="",
+                   help="run one kill point only (seam@index)")
+    p.add_argument("--failover-only", action="store_true")
+    p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
+    args = p.parse_args()
+    if args.failover_only:
+        out = failover_case()
+        print(json.dumps({k: v for k, v in out.items()
+                          if not k.endswith("_out")}))
+        return 0 if out["ok"] else 1
+    if args.point:
+        seam, _, idx = args.point.partition("@")
+        out = run_point(seam, int(idx or 0), ticks=args.ticks,
+                        reference=reference_state(args.ticks))
+        print(json.dumps({k: v for k, v in out.items() if k != "out"}))
+        return 0 if out["ok"] else 1
+    return run_matrix(ticks=args.ticks)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,9 @@ pipeline alive and the store consistent.
 
 One case per fault class from the resilience layer (utils/faults.py
 seams): solve raise, solve hang past deadline, WAL group-commit write
-error (sync and async-deferred), torn group frame, lease loss, agent-comm
+error (sync and async-deferred), torn group frame, lease loss, a lease
+steal landing between begin_tick and the group flush (the fenced holder
+sheds the tick — EpochFencedError semantics, storage/lease.py), agent-comm
 timeout, cloud-provider error, event-sender error, plus the breaker's
 full open→half-open→closed cycle and the job quarantine. Each case builds its own store, installs a deterministic
 FaultPlan, runs the pipeline, and returns a result dict with ``ok`` and
@@ -315,6 +317,68 @@ def case_wal_async_deferred(seed: int = 0) -> dict:
     }
 
 
+def case_lease_steal_mid_commit(seed: int = 0) -> dict:
+    """A standby steals the lease BETWEEN begin_tick and the group flush
+    (a ``call`` fault at the ``wal.fence`` seam performs the steal): the
+    fenced holder sheds the tick — EpochFencedError at the commit, the
+    buffered group never reaches the WAL, degraded="fenced" — and a
+    recovery of the data dir sees only pre-tick state, stamped with the
+    old epoch, plus nothing from the fenced tick."""
+    import os
+
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.lease import FileLease
+
+    data_dir = tempfile.mkdtemp(prefix="fault-steal-")
+    holder = FileLease(os.path.join(data_dir, "writer.lease"), ttl_s=60.0)
+    assert holder.try_acquire()
+    store = DurableStore(data_dir, lease=holder)
+    _seed_store(store, seed=seed + 31)
+    store.checkpoint()  # pre-tick state durably snapshotted
+
+    def steal():
+        thief = FileLease(
+            os.path.join(data_dir, "writer.lease"), ttl_s=60.0
+        )
+        thief.ttl_s = -1.0  # force "stale" so the steal fires now
+        assert thief.try_acquire()
+        assert thief.epoch == holder.epoch + 1
+
+    got, stop = _capture_logs()
+    # seed writes are journaled per-op BEFORE the plan installs; the
+    # tick's commit is then this store's first wal.fence firing
+    faults.install(
+        FaultPlan().at("wal.fence", 0, Fault("call", fn=steal))
+    )
+    try:
+        res = run_tick(store, OPTS, now=NOW)
+    finally:
+        faults.uninstall()
+        stop()
+    wal_path = os.path.join(data_dir, "wal.log")
+    wal_after = (
+        open(wal_path, encoding="utf-8").read()
+        if os.path.exists(wal_path) else ""
+    )
+    recovered = DurableStore(data_dir)
+    return {
+        "ok": (
+            res.degraded == "fenced"
+            and holder.lost
+            and store.fenced
+            and '"o":"g"' not in wal_after  # the tick's frame was shed
+            and recovered.collection(TQ_COLLECTION).find(lambda d: True)
+            == []  # pre-tick state only: no queue docs ever landed
+            and len(recovered.collection("tasks").key_order())
+            == len(store.collection("tasks").key_order())
+            and any(r.get("message") == "epoch-fenced" for r in got)
+            and any(r.get("message") == "tick-fenced" for r in got)
+        ),
+        "result": res,
+        "logs": got,
+    }
+
+
 def case_lease_loss(seed: int = 0) -> dict:
     import os
     import threading
@@ -537,6 +601,7 @@ CASES: Dict[str, Callable[[int], dict]] = {
     "wal-torn": case_wal_torn,
     "wal-async-deferred": case_wal_async_deferred,
     "lease-loss": case_lease_loss,
+    "lease-steal-mid-commit": case_lease_steal_mid_commit,
     "agent-comm": case_agent_comm,
     "provider-error": case_provider_error,
     "sender-error": case_sender_error,

@@ -96,11 +96,22 @@ def main() -> int:
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
-    args = sys.argv[1:] or ["tests/"]
+    args = [a for a in sys.argv[1:] if a != "--crash-matrix"]
+    with_crash_matrix = "--crash-matrix" in sys.argv[1:]
+    args = args or ["tests/"]
     cmd = [sys.executable, "-m", "pytest", "-q", *args]
     print("gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
-    _log_run(rc, args)
+    if rc == 0 and with_crash_matrix:
+        # the full process-kill matrix (make crash-matrix) on top of the
+        # suite: real SIGKILL-shaped deaths + the two-process failover
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cm = [sys.executable, os.path.join(root, "tools", "crash_matrix.py")]
+        print("gate:", " ".join(cm), flush=True)
+        rc = subprocess.call(cm, env={**env, "JAX_PLATFORMS": "cpu"})
+        _log_run(rc, [*args, "--crash-matrix"])
+    else:
+        _log_run(rc, args)
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)
     else:
