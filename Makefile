@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -42,6 +42,14 @@ perf-guard:
 # epochs, plus the two-process SIGSTOP-steal-SIGCONT failover case
 crash-matrix:
 	python tools/crash_matrix.py
+
+# storm-soak matrix (fast; tier-1 runs the same cases via
+# tests/test_overload.py): seeded task-churn / event / API / slow-store
+# storms must brown out low-value work only — planning never starves,
+# agent-critical traffic is never shed, the pending/outbox caps hold,
+# and the monitor returns to GREEN with hysteresis after each storm
+overload-matrix:
+	env JAX_PLATFORMS=cpu python tools/overload_matrix.py
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

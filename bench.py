@@ -170,6 +170,7 @@ def main() -> None:
     dispatch = measure_dispatch()
 
     from evergreen_tpu.utils.benchgen import bench_result_payload
+    from evergreen_tpu.utils.log import counters_snapshot
 
     result = bench_result_payload(
         tpu_ms=tpu_ms,
@@ -181,6 +182,12 @@ def main() -> None:
         overlap_proven=overlap_proven,
         churn=churn,
         probe_history=_probe_history,
+        overload_counters={
+            k: v
+            for k, v in counters_snapshot().items()
+            if k.startswith(("overload.", "jobs.quarantined",
+                             "scheduler.tick.shed"))
+        },
     )
     print(json.dumps(result))
     if _backend == "axon":

@@ -73,6 +73,18 @@ _LOGIN_PATHS = re.compile(r"^/(login(/redirect|/callback)?|logout)$")
 _HOOK_PATHS = re.compile(r"^/hooks/aws(/|$)")
 
 
+#: expensive read/list surfaces — the FIRST routes the overload ladder
+#: sheds at RED (collection scans, queue dumps, log reads); everything
+#: the agent protocol needs stays exempt at every level
+_EXPENSIVE_READS = re.compile(
+    r"^/rest/v2/(hosts|distros|versions|patches|projects|volumes)$"
+    r"|^/rest/v2/versions/[^/]+/tasks$"
+    r"|^/rest/v2/builds/[^/]+/display_tasks$"
+    r"|^/rest/v2/tasks/[^/]+/(tests|logs|executions)$"
+    r"|^/rest/v2/distros/[^/]+/queue$"
+    r"|^/rest/v2/projects/[^/]+/last_green$"
+)
+
 _GQL_COMMENT = re.compile(r"#[^\n]*")
 
 #: GETs that WRITE (login state/session minting, task assignment) — they
@@ -232,7 +244,7 @@ class RestApi:
             if not self._rate_limiter.allow(
                 f"peer:{peer}", limit=pre_mult * limit
             ):
-                return 429, {"error": "rate limit exceeded"}
+                return self._rate_limited()
         denied = None
         if self.require_auth and _AGENT_PATHS.match(path):
             denied = self._authorize_agent(path, headers)
@@ -273,8 +285,25 @@ class RestApi:
                 or "anon"
             )
             if not self._rate_limiter.allow(key, limit=limit):
-                return 429, {"error": "rate limit exceeded"}
+                return self._rate_limited()
         return None
+
+    def _rate_limited(self) -> Tuple[int, Any]:
+        """Shared 429 for the two rate-limit tiers: Retry-After is the
+        limiter window remainder, stretched by the overload ladder when
+        the service is also browning out (clients of an overloaded
+        server should sit out longer than one window)."""
+        from ..utils import overload
+
+        retry = max(
+            1.0,
+            self._rate_limiter.retry_after_s(),
+            overload.monitor_for(self.store).retry_after_s(),
+        )
+        self._ident.response_headers = [
+            ("Retry-After", str(int(retry)))
+        ]
+        return 429, {"error": "rate limit exceeded", "retry_after_s": retry}
 
     def _authorize_agent(
         self, path: str, headers: Dict[str, str]
@@ -324,6 +353,57 @@ class RestApi:
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         self._routes.append((method, re.compile(f"^{pattern}$"), handler))
 
+    def _overload_shed(
+        self, method: str, path: str, body: dict
+    ) -> Optional[Tuple[int, Any]]:
+        """Overload-adaptive admission control (utils/overload.py): at
+        RED the expensive read/list endpoints 429 with a level-derived
+        Retry-After; at BLACK every route sheds except the agent
+        protocol, webhooks, login, and admin (operators must be able to
+        tune their way OUT of a brownout). Agent heartbeat/end-task
+        traffic is never shed at any level."""
+        from ..utils import overload
+
+        monitor = overload.monitor_for(self.store)
+        monitor.note_api_request()
+        level = monitor.level()
+        if level < overload.RED:
+            return None
+        if (
+            _AGENT_PATHS.match(path)
+            or _LOGIN_PATHS.match(path)
+            or _HOOK_PATHS.match(path)
+            or _ADMIN_PATHS.match(path)
+        ):
+            return None
+        expensive = (
+            method == "GET" and _EXPENSIVE_READS.match(path) is not None
+        ) or (
+            path == "/graphql"
+            and not _is_graphql_mutation(body.get("query", ""))
+        )
+        if level < overload.BLACK and not expensive:
+            return None
+        from ..utils.log import get_logger, incr_counter
+
+        retry = monitor.retry_after_s(level)
+        incr_counter("overload.api_shed")
+        get_logger("api").warning(
+            "request-shed",
+            method=method,
+            path=path,
+            level=overload.level_name(level),
+            retry_after_s=retry,
+        )
+        self._ident.response_headers = [
+            ("Retry-After", str(int(retry)))
+        ]
+        return 429, {
+            "error": "service overloaded",
+            "level": overload.level_name(level),
+            "retry_after_s": retry,
+        }
+
     def handle(
         self,
         method: str,
@@ -333,6 +413,10 @@ class RestApi:
     ) -> Tuple[int, Any]:
         body = body or {}
         headers = headers or {}
+        self._ident.response_headers = []
+        shed = self._overload_shed(method, path, body)
+        if shed is not None:
+            return shed
         denied = self._authorize(method, path, headers)
         if denied is not None:
             return denied
@@ -708,6 +792,7 @@ class RestApi:
         # admin / events
         r("GET", r"/rest/v2/admin/settings", self.get_admin)
         r("POST", r"/rest/v2/admin/settings", self.set_admin)
+        r("GET", r"/rest/v2/admin/overload", self.get_overload)
         r("GET", r"/rest/v2/status", self.status)
         # login surface (reference service/ui.go login routes + gimlet
         # user-manager handlers); manager-agnostic
@@ -1546,6 +1631,30 @@ class RestApi:
 
     # -- admin ------------------------------------------------------------- #
 
+    def get_overload(self, method, match, body):
+        """Overload-ladder introspection: current level, fused gauges,
+        shed counters, and the aggregate shed records — the operator's
+        one-stop brownout view (exempt from shedding itself, like the
+        rest of the admin surface)."""
+        from ..utils import overload
+        from ..utils.log import counters_snapshot
+
+        monitor = overload.monitor_for(self.store)
+        monitor.evaluate()
+        return 200, {
+            "level": monitor.level_label(),
+            "gauges": {
+                k: round(v, 3) for k, v in monitor.gauges().items()
+            },
+            "retry_after_s": monitor.retry_after_s(),
+            "counters": {
+                k: v
+                for k, v in counters_snapshot().items()
+                if k.startswith(("overload.", "jobs."))
+            },
+            "sheds": overload.shed_totals(self.store),
+        }
+
     def get_admin(self, method, match, body):
         out = {}
         for sid in all_sections():
@@ -2225,10 +2334,27 @@ class RestApi:
         delivers exactly like subscription-driven notifications
         (reference notification.go sends through the env's senders)."""
         from ..events.senders import OUTBOX, insert_outbox_row
+        from ..utils import overload
 
-        insert_outbox_row(
+        outcome = insert_outbox_row(
             self.store, OUTBOX[channel], {"channel_type": channel, **doc}
         )
+        if outcome.reason == "dropped":
+            # discarded at the outbox cap — an explicit caller must be
+            # told so it can retry after the brownout
+            monitor = overload.monitor_for(self.store)
+            retry = max(1.0, monitor.retry_after_s())
+            self._ident.response_headers = [
+                ("Retry-After", str(int(retry)))
+            ]
+            return 429, {
+                "error": "notification outbox saturated",
+                "retry_after_s": retry,
+            }
+        if outcome.reason == "coalesced":
+            # folded into an identical undelivered row: accepted, and
+            # WILL be delivered with it
+            return 200, {"ok": True, "coalesced": True}
         return 200, {"ok": True}
 
     def notify_slack(self, method, match, body):

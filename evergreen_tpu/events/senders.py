@@ -25,21 +25,118 @@ OUTBOX = {
 }
 
 
-def insert_outbox_row(store: Store, collection: str, fields: dict) -> None:
+class OutboxOutcome:
+    """Result of ``insert_outbox_row``: truthy iff a NEW row was
+    inserted; otherwise ``reason`` says what happened ("coalesced" —
+    the notification was folded into an identical undelivered row, so
+    it WILL be delivered; "dropped" — discarded at the outbox cap)."""
+
+    __slots__ = ("inserted", "reason")
+
+    def __init__(self, inserted: bool, reason: str = "") -> None:
+        self.inserted = inserted
+        self.reason = reason
+
+    def __bool__(self) -> bool:
+        return self.inserted
+
+
+def _coalesce_key(fields: dict) -> "str | None":
+    """Channel + target + subject-ish: two undelivered rows with the
+    same key carry the same information to the same place. ``None``
+    (no usable subject) disables coalescing for the row — distinct
+    subjectless notifications must never fold into each other."""
+    target = (
+        fields.get("to")
+        or fields.get("slack_channel")
+        or fields.get("url")
+        or fields.get("project_or_issue")
+        or ""
+    )
+    subject = fields.get("subject") or fields.get("summary") or ""
+    if not subject:
+        text = fields.get("text") or ""
+        subject = text.splitlines()[0] if text else ""
+    if not subject and isinstance(fields.get("payload"), dict):
+        subject = str(fields["payload"].get("subject", ""))
+    if not subject:
+        return None
+    return f"{fields.get('channel_type', '')}|{target}|{subject}"
+
+
+def insert_outbox_row(
+    store: Store, collection: str, fields: dict
+) -> OutboxOutcome:
     """The ONE place the outbox row envelope is built (_id/created_at/
     delivered) — the drain job's expectations live here, and both
     subscription-driven sends and the direct notification routes
     (api/rest.py notify_slack/notify_email) go through it. Ids are
     process-restart-safe UUIDs so undrained docs are never
-    overwritten."""
-    store.collection(collection).insert(
+    overwritten.
+
+    Overload protection (utils/overload.py ladder): at YELLOW or worse,
+    a row whose coalesce key matches an undelivered row folds into it
+    (``coalesced`` counter on the doc) instead of growing the backlog;
+    and the outbox is BOUNDED — at ``OverloadConfig.outbox_cap``
+    undelivered rows, new low-priority notifications drop with a
+    counter + shed record, never silently. The outcome distinguishes
+    inserted / coalesced / dropped so callers (the direct notify
+    routes) never misreport an accepted notification as discarded or
+    vice versa."""
+    from ..utils import overload
+    from ..utils.log import get_logger, incr_counter
+
+    monitor = overload.monitor_for(store)
+    level = monitor.level()
+    key = _coalesce_key(fields)
+    coll = store.collection(collection)
+    if key is not None and level >= overload.YELLOW:
+        # coalesce onto a matching undelivered row (process-local map;
+        # a stale hit — row already delivered/failed — falls through)
+        cmap = monitor.coalesce_map(collection)
+        existing_id = cmap.get(key)
+        if existing_id is not None:
+            hit = {"ok": False}
+
+            def fold(doc: dict) -> None:
+                if not doc.get("delivered") and not doc.get("failed"):
+                    doc["coalesced"] = doc.get("coalesced", 0) + 1
+                    doc["last_coalesced_at"] = _time.time()
+                    hit["ok"] = True
+
+            coll.mutate(existing_id, fold)
+            if hit["ok"]:
+                incr_counter("overload.outbox_coalesced")
+                return OutboxOutcome(False, "coalesced")
+            cmap.pop(key, None)
+    cap = monitor.config.outbox_cap
+    if cap and monitor.outbox_depth(collection) >= cap:
+        # drop-with-counter: notifications are the lowest class of work
+        # and a full outbox under storm must not grow without bound
+        incr_counter("overload.outbox_dropped")
+        incr_counter(f"overload.outbox_dropped.{collection}")
+        overload.record_shed(store, "outbox", collection)
+        get_logger("events").warning(
+            "outbox-row-dropped",
+            collection=collection,
+            cap=cap,
+            coalesce_key=key or "",
+        )
+        return OutboxOutcome(False, "dropped")
+    doc_id = f"ntf-{uuid.uuid4().hex}"
+    coll.insert(
         {
-            "_id": f"ntf-{uuid.uuid4().hex}",
+            "_id": doc_id,
             "created_at": _time.time(),
             "delivered": False,
+            "coalesce_key": key or "",
             **fields,
         }
     )
+    monitor.note_outbox_insert(collection)
+    if key is not None:
+        monitor.coalesce_map(collection)[key] = doc_id
+    return OutboxOutcome(True)
 
 
 def make_outbox_sender(

@@ -370,4 +370,12 @@ def drain_outboxes(
                 doc["_id"], {"delivered": True, "delivered_at": now}
             )
             delivered[collection] = delivered.get(collection, 0) + 1
+        if delivered.get(collection):
+            # keep the overload monitor's depth gauge honest without a
+            # recount (it resyncs periodically anyway)
+            from ..utils import overload
+
+            overload.monitor_for(store).note_outbox_drained(
+                collection, delivered[collection]
+            )
     return delivered

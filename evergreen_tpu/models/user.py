@@ -200,3 +200,9 @@ class RateLimiter:
                 lambda d: now - d.get("at", now) > 2 * self.window_s
             )
         return count["n"] <= limit
+
+    def retry_after_s(self, now: Optional[float] = None) -> float:
+        """Seconds until the current window rolls over — what a limited
+        client should put in its backoff (served as Retry-After)."""
+        now = _time.time() if now is None else now
+        return self.window_s - (now % self.window_s)

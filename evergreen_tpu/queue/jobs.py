@@ -27,6 +27,43 @@ from ..storage.store import Store
 
 JOBS_COLLECTION = "jobs"
 
+# -- priority classes --------------------------------------------------------- #
+# Lower number = more critical. Overload shedding (utils/overload.py
+# ladder) removes the HIGHEST-numbered class first and never touches the
+# agent-critical or planning classes — the storm-soak invariant.
+
+PRIORITY_AGENT = 0  #: agent-critical (keepalives, dispatch-adjacent)
+PRIORITY_PLANNING = 1  #: the scheduler tick and task generation
+PRIORITY_RECONCILE = 2  #: host/cloud reconciliation, trackers (default)
+PRIORITY_STATS = 3  #: stats sampling, notifications, span export
+
+PRIORITY_NAMES = {
+    PRIORITY_AGENT: "agent",
+    PRIORITY_PLANNING: "planning",
+    PRIORITY_RECONCILE: "reconcile",
+    PRIORITY_STATS: "stats",
+}
+
+
+class PutOutcome:
+    """Result of ``JobQueue.put``: truthy iff the job was admitted, with
+    the rejection reason otherwise ("duplicate" | "closed" |
+    "quarantined" | "shed-capacity" | "shed-overload"). Rejections are
+    counted and recorded INSIDE ``put`` — no call site can silently
+    discard an enqueue failure by ignoring the return value."""
+
+    __slots__ = ("accepted", "reason")
+
+    def __init__(self, accepted: bool, reason: str = "") -> None:
+        self.accepted = accepted
+        self.reason = reason
+
+    def __bool__(self) -> bool:
+        return self.accepted
+
+    def __repr__(self) -> str:
+        return f"PutOutcome({self.accepted}, {self.reason!r})"
+
 
 class Job(abc.ABC):
     """One unit of background work (reference amboy.Job).
@@ -34,15 +71,27 @@ class Job(abc.ABC):
     ``job_id`` deduplicates: enqueueing an id already pending is a no-op
     (amboy's EnqueueUnique). ``scopes`` are exclusive locks: two jobs
     sharing a scope never run concurrently (amboy scope locks,
-    units/scheduler.go:48-49).
+    units/scheduler.go:48-49). ``priority`` is the overload-shedding
+    class (PRIORITY_*): under load the queue sheds stats first, then
+    reconcile — never agent or planning work.
     """
 
     job_type: str = "job"
     max_time_s: float = 0.0
+    priority: int = PRIORITY_RECONCILE
 
-    def __init__(self, job_id: str, scopes: Optional[List[str]] = None) -> None:
+    def __init__(
+        self,
+        job_id: str,
+        scopes: Optional[List[str]] = None,
+        priority: Optional[int] = None,
+    ) -> None:
         self.job_id = job_id
         self.scopes = scopes or []
+        if priority is not None:
+            self.priority = priority
+        #: enqueue sequence for FIFO order within a priority class
+        self._seq = 0
 
     @abc.abstractmethod
     def run(self, store: Store) -> None:
@@ -58,8 +107,9 @@ class FnJob(Job):
         fn: Callable[[Store], None],
         scopes: Optional[List[str]] = None,
         job_type: str = "fn",
+        priority: Optional[int] = None,
     ) -> None:
-        super().__init__(job_id, scopes)
+        super().__init__(job_id, scopes, priority=priority)
         self.fn = fn
         self.job_type = job_type
 
@@ -85,19 +135,29 @@ class JobQueue:
         name: str = "service",
         poison_threshold: int = 5,
         quarantine_s: float = 300.0,
+        max_pending: Optional[int] = None,
     ) -> None:
         self.store = store
         self.name = name
         self.poison_threshold = max(1, poison_threshold)
         self.quarantine_s = quarantine_s
+        self._workers = max(1, workers)
         self._executor = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"jobq-{name}"
         )
         self._lock = threading.Lock()
         self._pending: Dict[str, Job] = {}
         self._held_scopes: Set[str] = set()
+        #: every admitted-but-not-running job (ready AND scope-blocked);
+        #: dispatch picks the best (priority, seq) whose scopes are free
         self._waiting: List[Job] = []
+        self._active = 0
+        self._next_seq = 0
         self._closed = False
+        #: explicit bound (tests/embedders); None = live from the
+        #: admin-editable OverloadConfig so operators can retune the cap
+        #: mid-incident without a restart (monitor config TTL applies)
+        self._max_pending_override = max_pending
         #: job type → consecutive failure count
         self._failures: Dict[str, int] = {}
         #: job type → quarantine expiry (absolute time)
@@ -107,13 +167,25 @@ class JobQueue:
 
     # -- enqueue ------------------------------------------------------------- #
 
-    def put(self, job: Job) -> bool:
-        """Enqueue unless a job with the same id is already pending/running
-        or the job type sits in poison quarantine."""
+    def put(self, job: Job) -> PutOutcome:
+        """Enqueue unless a job with the same id is already
+        pending/running, the job type sits in poison quarantine, or the
+        overload ladder says this job's class must shed. Every rejection
+        is counted (and for sheds, recorded + evented) inside this
+        method — the returned outcome is informational, never the only
+        trace."""
+        from ..utils import overload
+        from ..utils.log import get_logger, incr_counter
+
         now = _time.time()
+        monitor = overload.monitor_for(self.store)
+        level = monitor.level()
         with self._lock:
-            if self._closed or job.job_id in self._pending:
-                return False
+            if self._closed:
+                return PutOutcome(False, "closed")
+            if job.job_id in self._pending:
+                incr_counter("jobs.duplicate_drop")
+                return PutOutcome(False, "duplicate")
             until = self._quarantined_until.get(job.job_type)
             if until is not None:
                 if now < until or job.job_type in self._probing:
@@ -128,8 +200,6 @@ class JobQueue:
                             "error": "job type is quarantined",
                         }
                     )
-                    from ..utils.log import get_logger, incr_counter
-
                     incr_counter("jobs.quarantined_drop")
                     get_logger("amboy").warning(
                         "job-quarantine-drop",
@@ -137,9 +207,43 @@ class JobQueue:
                         job_type=job.job_type,
                         until=round(until, 3),
                     )
-                    return False
+                    return PutOutcome(False, "quarantined")
                 # cooldown elapsed: admit exactly one probe
                 self._probing.add(job.job_type)
+            # overload gating: the ladder sheds the stats/notify class at
+            # RED and the reconcile class at BLACK — at enqueue, before
+            # the job costs a pending slot (agent/planning never gated)
+            if (
+                job.priority >= PRIORITY_STATS and level >= overload.RED
+            ) or (
+                job.priority >= PRIORITY_RECONCILE
+                and level >= overload.BLACK
+            ):
+                self._shed_locked(job, "shed-overload", now)
+                return PutOutcome(False, "shed-overload")
+            # bounded pending set (0 = unbounded; sheds the lowest
+            # sheddable class only, never agent/planning work)
+            cap = (
+                self._max_pending_override
+                if self._max_pending_override is not None
+                else monitor.config.queue_max_pending
+            )
+            if cap and len(self._pending) >= cap:
+                victim = self._lowest_class_waiter(below=job.priority)
+                if victim is not None:
+                    # the incoming job outranks a waiting sheddable job:
+                    # that one browns out instead
+                    self._waiting.remove(victim)
+                    self._pending.pop(victim.job_id, None)
+                    self._shed_locked(victim, "shed-capacity", now)
+                elif job.priority >= PRIORITY_RECONCILE:
+                    self._shed_locked(job, "shed-capacity", now)
+                    return PutOutcome(False, "shed-capacity")
+                # agent/planning with no evictable waiter: admit over the
+                # cap — those classes are never shed, and their volume is
+                # naturally bounded by id-dedup and scope locks
+            job._seq = self._next_seq
+            self._next_seq += 1
             self._pending[job.job_id] = job
             self.store.collection(JOBS_COLLECTION).upsert(
                 {
@@ -151,20 +255,81 @@ class JobQueue:
                     "error": "",
                 }
             )
-            if self._try_acquire(job):
-                self._submit(job)
-            else:
-                self._waiting.append(job)
-            return True
+            self._waiting.append(job)
+            self._maybe_dispatch_locked()
+            depth = len(self._pending)
+        monitor.observe("queue_pending", float(depth))
+        return PutOutcome(True)
 
-    def _try_acquire(self, job: Job) -> bool:
-        if any(s in self._held_scopes for s in job.scopes):
-            return False
-        self._held_scopes.update(job.scopes)
-        return True
+    def _lowest_class_waiter(self, below: int) -> Optional[Job]:
+        """The newest waiting job of the lowest (highest-numbered)
+        sheddable class strictly below ``below``'s criticality — the
+        eviction victim when the pending set is full."""
+        victim: Optional[Job] = None
+        for w in self._waiting:
+            if w.priority < max(below + 1, PRIORITY_RECONCILE):
+                continue
+            if (
+                victim is None
+                or (w.priority, w._seq) > (victim.priority, victim._seq)
+            ):
+                victim = w
+        return victim
 
-    def _submit(self, job: Job) -> None:
-        self._executor.submit(self._run_job, job)
+    def _shed_locked(self, job: Job, reason: str, now: float) -> None:
+        """Counted, recorded, evented shed — never a silent drop."""
+        from ..utils import overload
+        from ..utils.log import get_logger, incr_counter
+
+        # a shed job never runs, so it must not keep holding its type's
+        # post-quarantine probe slot (a stuck slot would read as
+        # quarantined forever); worst case a second probe is admitted
+        self._probing.discard(job.job_type)
+        cls = PRIORITY_NAMES.get(job.priority, str(job.priority))
+        incr_counter("overload.jobs_shed")
+        incr_counter(f"overload.jobs_shed.{cls}")
+        self.store.collection(JOBS_COLLECTION).upsert(
+            {
+                "_id": job.job_id,
+                "type": job.job_type,
+                "status": "shed",
+                "enqueued_at": now,
+                "scopes": job.scopes,
+                "error": reason,
+            }
+        )
+        overload.record_shed(
+            self.store, "job", job.job_type, detail=reason
+        )
+        get_logger("amboy").warning(
+            "job-shed",
+            job_id=job.job_id,
+            job_type=job.job_type,
+            priority=cls,
+            reason=reason,
+        )
+
+    def _maybe_dispatch_locked(self) -> None:
+        """Fill free worker slots with the best (priority, seq) waiting
+        jobs whose scopes are free. O(waiting) per slot — the pending
+        set is bounded, and priority dispatch is exactly why a planning
+        tick never sits behind a thousand queued stats jobs."""
+        while self._active < self._workers and not self._closed:
+            best_i = -1
+            for i, w in enumerate(self._waiting):
+                if any(s in self._held_scopes for s in w.scopes):
+                    continue
+                if best_i < 0 or (w.priority, w._seq) < (
+                    self._waiting[best_i].priority,
+                    self._waiting[best_i]._seq,
+                ):
+                    best_i = i
+            if best_i < 0:
+                return
+            job = self._waiting.pop(best_i)
+            self._held_scopes.update(job.scopes)
+            self._active += 1
+            self._executor.submit(self._run_job, job)
 
     # -- execution ----------------------------------------------------------- #
 
@@ -204,14 +369,15 @@ class JobQueue:
             self._pending.pop(job.job_id, None)
             for s in job.scopes:
                 self._held_scopes.discard(s)
-            # release any waiters whose scopes are now free
-            still_waiting = []
-            for w in self._waiting:
-                if self._try_acquire(w):
-                    self._submit(w)
-                else:
-                    still_waiting.append(w)
-            self._waiting = still_waiting
+            self._active -= 1
+            # pull the next-best waiters into the freed slot(s)
+            self._maybe_dispatch_locked()
+            depth = len(self._pending)
+        from ..utils import overload
+
+        overload.monitor_for(self.store).observe(
+            "queue_pending", float(depth)
+        )
 
     def _account_outcome(self, job: Job, failed: bool) -> None:
         """Poison accounting: consecutive failures per job type arm the
@@ -287,14 +453,21 @@ class CronRunner:
         self.ops.append(op)
 
     def tick(self, now: Optional[float] = None, force: bool = False) -> int:
+        from ..utils.log import incr_counter
+
         now = _time.time() if now is None else now
         n = 0
         for op in self.ops:
             if force or now - op.last_run >= op.interval_s:
                 op.last_run = now
                 for job in op.populate(self.store, now):
-                    if self.queue.put(job):
+                    outcome = self.queue.put(job)
+                    if outcome:
                         n += 1
+                    elif outcome.reason.startswith("shed"):
+                        # the put already counted/recorded the shed; this
+                        # adds the per-populator view for storm forensics
+                        incr_counter(f"overload.cron_shed.{op.name}")
         return n
 
     def run_background(self, poll_s: float = 1.0) -> None:

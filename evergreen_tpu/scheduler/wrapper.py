@@ -162,8 +162,13 @@ class TickResult:
     #: epoch was superseded mid-tick: the tick's WAL group was shed and
     #: the holder stood down)
     degraded: str = ""
-    #: optional work shed under the tick budget ("events", "stats")
+    #: optional work shed under the tick budget or the overload ladder
+    #: ("events", "stats")
     shed: List[str] = dataclasses.field(default_factory=list)
+    #: the overload-ladder level this tick planned under
+    #: ("green" | "yellow" | "red" | "black") — the degraded-status
+    #: field's brownout sibling
+    overload: str = "green"
 
 
 def gather_tick_inputs(
@@ -483,6 +488,14 @@ def run_tick(
     except EpochFencedError:
         return _fenced_result()
 
+    # Overload ladder: stamp the tick start (tick-lag gauge) before any
+    # work, so a tick that blows its cadence is visible as lag on the
+    # NEXT evaluation even if everything below degrades
+    from ..utils import overload as overload_mod
+
+    monitor = overload_mod.monitor_for(store)
+    monitor.note_tick_start(now)
+
     # Persist barrier FIRST, before this tick writes anything: wait out
     # the previous tick's async WAL group commit and surface its deferred
     # error. A lost group means the WAL may lack the delta bases the
@@ -491,6 +504,9 @@ def run_tick(
     # truth to heal durability.
     prior_persist_failed = False
     try:
+        # (no latency sample here: the near-zero sync-mode barrier would
+        # dilute the commit-time EWMA below; async flush slowness shows
+        # up in the wal_backlog signal instead)
         store.sync_persist()
     except EpochFencedError:
         # the previous tick's deferred commit was fenced: stop here
@@ -770,11 +786,25 @@ def _run_tick_body(
     else:
         budget = 0  # the 4k-host scan is pure cost when intents are off
 
-    def _over_budget() -> bool:
-        return (
+    # Brownout: at RED or worse the ladder sheds the tick's optional
+    # work (stats, event emission) up front — the same work the tick
+    # budget sheds reactively, but driven by SERVICE-wide load instead
+    # of this tick's own overrun
+    from ..utils import overload as overload_mod
+
+    monitor = overload_mod.monitor_for(store)
+    olevel = monitor.evaluate(now)
+
+    def _shed_optional() -> str:
+        """"" when optional work may run, else the shed reason."""
+        if olevel >= overload_mod.RED:
+            return "overload"
+        if (
             opts.tick_budget_s > 0
             and _time.perf_counter() - t0 > opts.tick_budget_s
-        )
+        ):
+            return "budget-exceeded"
+        return ""
 
     for d in distros:
         plan = plans.get(d.id, [])
@@ -830,10 +860,15 @@ def _run_tick_body(
             intent_hosts.extend(created)
             if created:
                 # event emission is optional work: over the tick budget
-                # it is shed before anything that affects planning
-                if _over_budget():
+                # (or under brownout) it is shed before anything that
+                # affects planning
+                shed_reason = _shed_optional()
+                if shed_reason:
                     if "events" not in shed:
                         shed.append("events")
+                        overload_mod.record_shed(
+                            store, "tick", "events", detail=shed_reason
+                        )
                     continue
                 try:
                     event_mod.log(
@@ -859,9 +894,13 @@ def _run_tick_body(
     # long before planning): the time-to-empty estimate + tracer span are
     # telemetry, not decisions.
     worst = ("", 0.0)
-    if _over_budget():
+    stats_shed_reason = _shed_optional()
+    if stats_shed_reason:
         if "stats" not in shed:
             shed.append("stats")
+            overload_mod.record_shed(
+                store, "tick", "stats", detail=stats_shed_reason
+            )
     else:
         # per-solve timing span (the reference's scheduler span
         # attributes, SURVEY §5 tracing; sink is the store's spans
@@ -898,16 +937,25 @@ def _run_tick_body(
         incr_counter("scheduler.tick.shed")
         _rlog.warning(
             "degraded-tick",
-            reason="budget-exceeded",
+            reason=stats_shed_reason or "budget-exceeded",
             shed=list(shed),
             budget_s=opts.tick_budget_s,
+            overload=overload_mod.level_name(olevel),
         )
     # Commit the tick's WAL group: sync mode surfaces a write error as
     # THIS tick's degradation; async mode hands the framed append to the
     # flusher thread (the write overlaps the next tick's snapshot) and a
-    # deferred error degrades the NEXT tick at its barrier.
+    # deferred error degrades the NEXT tick at its barrier. The commit
+    # duration feeds the ladder's store-latency EWMA — a slow store is
+    # one of the storms the brownout must answer.
     committed[0] = True
+    t_commit = _time.perf_counter()
     commit_reason = _commit_tick_group(store, opts)
+    monitor.observe(
+        "store_latency_ms",
+        (_time.perf_counter() - t_commit) * 1e3,
+        ewma=0.4,
+    )
     if commit_reason == "fenced":
         degraded = "fenced"  # supersedes any earlier per-distro reason
     else:
@@ -929,6 +977,7 @@ def _run_tick_body(
         planner_used=planner_used,
         degraded=degraded,
         shed=list(shed),
+        overload=overload_mod.level_name(olevel),
     )
     return TickResult(
         queues=queues,
@@ -942,4 +991,5 @@ def _run_tick_body(
         planner_used=planner_used,
         degraded=degraded,
         shed=shed,
+        overload=overload_mod.level_name(olevel),
     )

@@ -363,6 +363,78 @@ class RateLimitConfig(ConfigSection):
 
 @register_section
 @dataclasses.dataclass
+class OverloadConfig(ConfigSection):
+    """Overload-protection ladder knobs (consumed by
+    utils/overload.LoadMonitor and every seam that consults it: the
+    JobQueue's bounded pending set, the event outbox caps, the REST
+    surface's adaptive 429s, the tick pipeline's brownout shedding).
+
+    Each ``*_levels`` list is the [yellow, red, black] threshold triple
+    for one fused signal; a 0 threshold disables that rung for that
+    signal. See docs/DEPLOY.md "Overload & brownout tuning"."""
+
+    section_id = "overload"
+
+    enabled: bool = True
+    #: the scheduler cadence the tick-lag signal is measured against
+    tick_cadence_s: float = 15.0
+    #: consecutive calm evaluations before the level steps DOWN
+    hysteresis_ticks: int = 3
+    #: how often gauge pushes may auto-re-evaluate the ladder
+    eval_interval_s: float = 1.0
+    #: hard cap on the JobQueue pending set (sheds lowest class only)
+    queue_max_pending: int = 1000
+    #: hard cap on undelivered rows per notification outbox collection
+    outbox_cap: int = 5000
+    queue_pending_levels: List[float] = dataclasses.field(
+        default_factory=lambda: [200.0, 500.0, 1000.0]
+    )
+    outbox_depth_levels: List[float] = dataclasses.field(
+        default_factory=lambda: [1000.0, 3000.0, 5000.0]
+    )
+    wal_backlog_levels: List[float] = dataclasses.field(
+        default_factory=lambda: [4.0, 16.0, 64.0]
+    )
+    store_latency_ms_levels: List[float] = dataclasses.field(
+        default_factory=lambda: [250.0, 1000.0, 5000.0]
+    )
+    #: seconds the tick runs PAST its cadence
+    tick_lag_levels_s: List[float] = dataclasses.field(
+        default_factory=lambda: [10.0, 30.0, 90.0]
+    )
+    api_rps_levels: List[float] = dataclasses.field(
+        default_factory=lambda: [200.0, 500.0, 2000.0]
+    )
+    #: Retry-After the API sends while shedding at each level
+    retry_after_red_s: float = 30.0
+    retry_after_black_s: float = 60.0
+
+    def validate_and_default(self) -> str:
+        for name in (
+            "queue_pending_levels",
+            "outbox_depth_levels",
+            "wal_backlog_levels",
+            "store_latency_ms_levels",
+            "tick_lag_levels_s",
+            "api_rps_levels",
+        ):
+            levels = getattr(self, name)
+            if not isinstance(levels, list) or len(levels) != 3:
+                return f"{name} must be a [yellow, red, black] triple"
+            if any(not isinstance(v, (int, float)) or v < 0 for v in levels):
+                return f"{name} entries must be numbers >= 0"
+            active = [v for v in levels if v > 0]
+            if active != sorted(active):
+                return f"{name} must be non-decreasing"
+        if self.hysteresis_ticks < 1:
+            self.hysteresis_ticks = 1
+        if self.queue_max_pending < 0 or self.outbox_cap < 0:
+            return "caps cannot be negative"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
 class SpawnHostConfig(ConfigSection):
     """reference config_spawnhost.go."""
 
@@ -649,6 +721,35 @@ class OktaServiceConfig(ConfigSection):
     scopes: List[str] = dataclasses.field(default_factory=list)
     audience: str = ""
     issuer: str = ""
+
+    #: legacy keys from when the interactive-login gates lived on THIS
+    #: section; migration 0004 copies them into the auth section (where
+    #: load_user_manager actually reads them) — a stored doc still
+    #: carrying them predates the migration or was written by old code
+    STALE_KEYS = ("user_group", "expected_email_domains")
+
+    @classmethod
+    def get_base(cls, store: Store) -> "ConfigSection":
+        doc = store.collection(CONFIG_COLLECTION).get(cls.section_id)
+        if doc:
+            stale = [k for k in cls.STALE_KEYS if k in doc]
+            if stale:
+                # LOUD on every load: an operator who upgraded with
+                # these keys set believes a login gate is active that
+                # this section no longer enforces (the silent-weakening
+                # failure mode) — migration 0004 copies the values to
+                # auth.okta_user_group / auth.okta_expected_email_domains
+                from .utils.log import get_logger, incr_counter
+
+                incr_counter("config.okta_service.stale_keys")
+                get_logger("config").warning(
+                    "okta_service carries stale login-gate keys — the "
+                    "group/email-domain gates are enforced from the "
+                    "auth section only (see migration "
+                    "0004-okta-service-gates-to-auth)",
+                    stale_keys=stale,
+                )
+        return super().get_base(store)
 
     def validate(self) -> str:
         """Full-credential check for when the token-exchange flow runs

@@ -471,6 +471,14 @@ class DurableStore(Store):
                 with self._flush_cv:
                     self._flush_errors.append(exc)
 
+    def flush_backlog(self) -> int:
+        """Frames waiting on (or being written by) the async flusher —
+        the WAL-backlog signal the overload monitor fuses
+        (utils/overload.py): a storm that outruns the disk shows up
+        here before anything else."""
+        with self._flush_cv:
+            return len(self._flush_queue) + (1 if self._flush_busy else 0)
+
     def sync_persist(self) -> None:
         """Barrier: wait until every async group commit has hit the WAL,
         then raise the first deferred write error (once); further errors

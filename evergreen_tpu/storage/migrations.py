@@ -93,3 +93,44 @@ def _m0003(store: Store) -> None:
     coll = store.collection("hosts")
     for doc in coll.find(lambda d: not d.get("secret")):
         coll.update(doc["_id"], {"secret": uuid.uuid4().hex})
+
+
+@register_migration("0004-okta-service-gates-to-auth")
+def _m0004(store: Store) -> None:
+    """The interactive-login gates (``user_group`` /
+    ``expected_email_domains``) once lived on the okta_service section;
+    they moved to the auth section (AuthConfig.okta_user_group /
+    okta_expected_email_domains) where load_user_manager enforces them.
+    A store upgraded with the old keys set would silently lose the gate
+    — the section loader drops unknown fields. Copy the stored values
+    into the auth section (never clobbering values an admin already set
+    there) and leave the stale keys in place for the loud load-time
+    warning in settings.OktaServiceConfig.get_base."""
+    from ..settings import CONFIG_COLLECTION, AuthConfig, OktaServiceConfig
+
+    doc = store.collection(CONFIG_COLLECTION).get(
+        OktaServiceConfig.section_id
+    )
+    if not doc:
+        return
+    group = doc.get("user_group") or ""
+    domains = doc.get("expected_email_domains") or []
+    if not group and not domains:
+        return
+    auth = AuthConfig.get_base(store)
+    changed = False
+    if group and not auth.okta_user_group:
+        auth.okta_user_group = group
+        changed = True
+    if domains and not auth.okta_expected_email_domains:
+        auth.okta_expected_email_domains = list(domains)
+        changed = True
+    if changed:
+        auth.set(store)
+        from ..utils.log import get_logger
+
+        get_logger("config").warning(
+            "migrated okta_service login gates into the auth section",
+            user_group=group,
+            expected_email_domains=domains,
+        )

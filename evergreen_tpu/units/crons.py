@@ -21,11 +21,39 @@ from ..cloud.provisioning import (
 )
 from ..ingestion.generate import process_generate_requests
 from ..models import taskstats
-from ..queue.jobs import CronRunner, FnJob, Job, JobQueue
+from ..queue.jobs import (
+    PRIORITY_AGENT,
+    PRIORITY_PLANNING,
+    PRIORITY_STATS,
+    CronRunner,
+    FnJob,
+    Job,
+    JobQueue,
+)
 from ..scheduler.wrapper import TickOptions, run_tick
 from ..settings import HostInitConfig, ServiceFlags
 from ..storage.store import Store
+from ..utils import overload
 from . import host_jobs, task_jobs
+
+
+def _defer_for_overload(store: Store, populator: str, floor: int) -> bool:
+    """True when the overload ladder is at ``floor`` or worse: the
+    populator defers its whole batch this interval (counted + logged —
+    a deferral is a shed-shaped outcome and must be observable)."""
+    level = overload.monitor_for(store).level()
+    if level < floor:
+        return False
+    from ..utils.log import get_logger, incr_counter
+
+    incr_counter("overload.cron_deferred")
+    incr_counter(f"overload.cron_deferred.{populator}")
+    get_logger("overload").info(
+        "cron-deferred",
+        populator=populator,
+        level=overload.level_name(level),
+    )
+    return True
 
 
 def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
@@ -69,6 +97,7 @@ def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
             run,
             scopes=["scheduler-tick"],
             job_type="scheduler-tick",
+            priority=PRIORITY_PLANNING,
         )
     ]
 
@@ -88,6 +117,7 @@ def generate_tasks_jobs(store: Store, now: float) -> List[Job]:
             lambda s: process_generate_requests(s),
             scopes=["generate-tasks"],
             job_type="generate-tasks",
+            priority=PRIORITY_PLANNING,
         )
     ]
 
@@ -123,7 +153,23 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
     flags = ServiceFlags.get(store)
     if flags.monitor_disabled:
         return []
-    return [
+    # agent keepalives ride the agent-critical class: losing them under
+    # load kills healthy task executions, the one thing a brownout must
+    # never do
+    jobs: List[Job] = [
+        FnJob(
+            f"agent-keepalive-{now:.3f}",
+            lambda s: agent_keepalive(s),
+            scopes=["agent-keepalive"],
+            job_type="agent-keepalive",
+            priority=PRIORITY_AGENT,
+        )
+    ]
+    if _defer_for_overload(store, "host-monitoring", overload.BLACK):
+        return jobs
+    # urgent reconciliation: cloud-state truth and idle cost control run
+    # at every level below BLACK
+    jobs += [
         FnJob(
             f"host-monitor-{now:.3f}",
             lambda s: host_jobs.monitor_host_cloud_state(s),
@@ -136,6 +182,12 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             scopes=["idle-termination"],
             job_type="idle-termination",
         ),
+    ]
+    # non-urgent reconciliation defers under RED (ISSUE 5: the level is
+    # consulted at the populator so deferred work never costs a slot)
+    if _defer_for_overload(store, "host-reconcile", overload.RED):
+        return jobs
+    jobs += [
         FnJob(
             f"stale-building-{now:.3f}",
             lambda s: host_jobs.reap_stale_building_hosts(s),
@@ -147,12 +199,6 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             lambda s: host_jobs.host_drawdown(s),
             scopes=["host-drawdown"],
             job_type="host-drawdown",
-        ),
-        FnJob(
-            f"agent-keepalive-{now:.3f}",
-            lambda s: agent_keepalive(s),
-            scopes=["agent-keepalive"],
-            job_type="agent-keepalive",
         ),
         FnJob(
             f"reprovision-{now:.3f}",
@@ -173,6 +219,7 @@ def host_monitoring_jobs(store: Store, now: float) -> List[Job]:
             job_type="sleep-schedules",
         ),
     ]
+    return jobs
 
 
 def _reprovision_pass(s: Store) -> None:
@@ -259,18 +306,26 @@ def event_notifier_jobs(store: Store, now: float) -> List[Job]:
     flags = ServiceFlags.get(store)
     if flags.event_processing_disabled:
         return []
+    # the notifier is notify-class work: the queue's ladder gating sheds
+    # it at RED (counted + recorded) so the event log stops feeding the
+    # outbox under storm. The DRAIN is the opposite: it REDUCES the very
+    # outbox-depth signal that raises the level, so shedding it would
+    # latch the brownout (depth never falls → level never drops → drain
+    # shed again). Pressure-relief work rides the never-shed class.
     return [
         FnJob(
             f"event-notifier-{now:.3f}",
             lambda s: process_unprocessed_events(s),
             scopes=["event-notifier"],
             job_type="event-notifier",
+            priority=PRIORITY_STATS,
         ),
         FnJob(
             f"outbox-drain-{now:.3f}",
             _drain_outboxes,
             scopes=["outbox-drain"],
             job_type="outbox-drain",
+            priority=PRIORITY_PLANNING,
         ),
     ]
 
@@ -287,24 +342,31 @@ def stats_jobs(store: Store, now: float) -> List[Job]:
     flags = ServiceFlags.get(store)
     if flags.background_stats_disabled:
         return []
+    # optional telemetry defers wholesale under RED — cheaper than
+    # enqueueing three jobs for the queue to shed one by one
+    if _defer_for_overload(store, "stats", overload.RED):
+        return []
     return [
         FnJob(
             f"host-stats-{now:.3f}",
             lambda s: host_jobs.sample_host_stats(s),
             scopes=["host-stats"],
             job_type="host-stats",
+            priority=PRIORITY_STATS,
         ),
         FnJob(
             f"system-stats-{now:.3f}",
             lambda s: task_jobs.sample_system_stats(s),
             scopes=["system-stats"],
             job_type="system-stats",
+            priority=PRIORITY_STATS,
         ),
         FnJob(
             f"span-export-{now:.3f}",
             _export_spans,
             scopes=["span-export"],
             job_type="span-export",
+            priority=PRIORITY_STATS,
         ),
     ]
 
@@ -327,6 +389,7 @@ def hourly_jobs(store: Store, now: float) -> List[Job]:
                 lambda s: taskstats.cache_historical_task_data(s),
                 scopes=["cache-task-stats"],
                 job_type="cache-task-stats",
+                priority=PRIORITY_STATS,
             )
         )
     jobs.append(

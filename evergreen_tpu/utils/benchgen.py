@@ -150,10 +150,14 @@ def bench_result_payload(
     overlap_proven: bool,
     churn: dict,
     probe_history: list,
+    overload_counters: dict = None,
 ) -> dict:
     """The BENCH JSON line. ``pipelined_tick_ms`` appears ONLY when the
     measured timeline proves the overlap (VERDICT r5 ask #3) — an
-    unproven pipelined number must not be advertised at all."""
+    unproven pipelined number must not be advertised at all.
+    ``overload_counters`` (overload.* / jobs shed counters observed
+    during the run) ride along so a storm during a bench is visible in
+    the perf trajectory instead of silently skewing the numbers."""
     out = {
         "metric": "sched_tick_50k_tasks_200_distros",
         "value": round(tpu_ms, 2),
@@ -174,6 +178,7 @@ def bench_result_payload(
         # last 4 probes only — the payload must stay bounded however many
         # retries the tunnel needed
         "probe_history": probe_history[-4:],
+        "overload_counters": overload_counters or {},
     }
     if overlap_proven:
         out["pipelined_tick_ms"] = round(pipe_med, 2)

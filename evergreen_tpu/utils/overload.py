@@ -1,0 +1,472 @@
+"""Service-wide overload protection: one load ladder for every seam.
+
+The control plane is a fixed-cadence loop (~200 distros re-planned every
+15 seconds) feeding a job plane, an event plane, and an HTTP surface.
+Each of those already degrades *individually* (circuit breaker, tick
+budget, rate limiter, retry policies) — but under a storm they fail
+independently and unboundedly. This module is the coordinator: a
+``LoadMonitor`` fuses the existing health signals into a small ladder of
+overload levels, and every producer/consumer seam consults the SAME
+level so the service browns out coherently — low-value work sheds first,
+planning and agent-critical paths keep their SLO (the overload-as-input
+stance of elastic schedulers like Aryl, arxiv 2202.07896, and placement
+systems like Tesserae, arxiv 2508.04953, applied to a CI control plane).
+
+Fused signals (gauges; pushed by the producing seam or pulled at
+``evaluate()``):
+
+  ``tick_lag_s``        how far the scheduler tick is running past its
+                        cadence (scheduler/wrapper.py run_tick; also
+                        derived live from the last tick start, so a
+                        stalled tick shows a growing lag)
+  ``queue_pending``     JobQueue pending-set depth (queue/jobs.py)
+  ``wal_backlog``       frames waiting on the async WAL flusher
+                        (storage/durable.py, pulled via
+                        ``store.flush_backlog()``)
+  ``outbox_depth``      undelivered notification-outbox rows, max over
+                        channels (events/senders.py)
+  ``store_latency_ms``  EWMA of tick-commit/persist latency
+                        (scheduler/wrapper.py around the group commit)
+  ``api_rps``           request rate over the HTTP surface (api/rest.py)
+
+Levels (monotone ladder; higher sheds strictly more):
+
+  GREEN   normal operation
+  YELLOW  coalesce notifications; outbox/pending caps enforced
+  RED     stats/notify-class jobs shed at enqueue; tick sheds its
+          optional stats + event emission; non-urgent cloud reconcile
+          defers; expensive read/list API endpoints 429 with Retry-After
+  BLACK   reconcile-class jobs shed too; every API route 429s except
+          agent-critical, webhooks, login, and admin
+
+Hysteresis: upward transitions apply immediately (a storm must brown out
+NOW); downward transitions need ``hysteresis_ticks`` consecutive calm
+evaluations, stepping straight to the calm level. Every transition bumps
+a counter, logs a structured breadcrumb, and emits one admin event — the
+level trail is auditable without parsing every line.
+
+Shedding observability contract: a dropped unit of work is NEVER silent.
+Every drop increments a counter and updates an aggregate record in the
+``overload_sheds`` collection via :func:`record_shed` (per-drop event
+docs would themselves be a memory storm; the aggregate row carries
+count/first/last and an admin event fires on the first drop and every
+100th thereafter).
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# -- levels ------------------------------------------------------------------ #
+
+GREEN = 0
+YELLOW = 1
+RED = 2
+BLACK = 3
+
+LEVEL_NAMES = {GREEN: "green", YELLOW: "yellow", RED: "red", BLACK: "black"}
+LEVELS_BY_NAME = {v: k for k, v in LEVEL_NAMES.items()}
+
+
+def level_name(level: int) -> str:
+    return LEVEL_NAMES.get(level, str(level))
+
+
+#: aggregate shed records (one doc per (kind, key), bounded by the number
+#: of distinct shed sources, not by drop volume)
+SHEDS_COLLECTION = "overload_sheds"
+
+
+class LoadMonitor:
+    """Fuses gauges into one overload level with hysteresis.
+
+    One monitor per store (``monitor_for``), shared by the queue, the
+    event senders, the API surface, and the tick pipeline — that sharing
+    IS the design: every seam consults the same ladder.
+    """
+
+    def __init__(self, store) -> None:
+        self.store = store
+        self._lock = threading.Lock()
+        self._level = GREEN
+        self._gauges: Dict[str, float] = {}
+        #: consecutive calm evaluations (raw < current level)
+        self._calm_streak = 0
+        self._last_eval = 0.0
+        #: logical (caller-clock) and monotonic stamps of the last tick
+        #: start — lag between ticks uses the caller's clock, the live
+        #: "tick stopped coming" check uses monotonic so harnesses that
+        #: drive ticks with a fixed logical ``now`` are not misread
+        self._last_tick_start = 0.0
+        self._last_tick_mono = 0.0
+        #: API request counting window for the rate gauge
+        self._req_count = 0
+        self._req_window_start = 0.0
+        #: config snapshot + TTL (a store read per evaluate would tax the
+        #: hot paths that auto-evaluate)
+        self._cfg = None
+        self._cfg_read_at = 0.0
+        self._cfg_ttl_s = 30.0
+        #: outbox depth bookkeeping: collection -> (count, ops_since_sync)
+        self._outbox: Dict[str, List[int]] = {}
+        #: collection -> {coalesce_key: doc_id} for undelivered rows
+        self._coalesce: Dict[str, Dict[str, str]] = {}
+
+    # -- config --------------------------------------------------------- #
+
+    @property
+    def config(self):
+        now = _time.monotonic()
+        cfg = self._cfg
+        if cfg is None or now - self._cfg_read_at > self._cfg_ttl_s:
+            from ..settings import OverloadConfig
+
+            cfg = OverloadConfig.get(self.store)
+            with self._lock:
+                self._cfg = cfg
+                self._cfg_read_at = now
+        return cfg
+
+    def refresh_config(self) -> None:
+        """Drop the cached section (tests; admin edits apply within the
+        TTL anyway)."""
+        with self._lock:
+            self._cfg = None
+
+    # -- gauge intake ---------------------------------------------------- #
+
+    def observe(self, name: str, value: float, ewma: float = 0.0) -> None:
+        """Record a gauge sample. ``ewma`` > 0 blends with the prior
+        value (weight of the NEW sample); 0 overwrites."""
+        with self._lock:
+            if ewma > 0.0 and name in self._gauges:
+                value = ewma * value + (1.0 - ewma) * self._gauges[name]
+            self._gauges[name] = value
+        self._maybe_auto_evaluate()
+
+    def note_tick_start(self, now: Optional[float] = None) -> float:
+        """Called at the top of every scheduler tick; derives the
+        tick-lag gauge from the gap between tick starts vs the cadence.
+        Returns the observed lag."""
+        now = _time.time() if now is None else now
+        cadence = float(self.config.tick_cadence_s)
+        with self._lock:
+            prev = self._last_tick_start
+            self._last_tick_start = now
+            self._last_tick_mono = _time.monotonic()
+        lag = max(0.0, (now - prev) - cadence) if prev else 0.0
+        self.observe("tick_lag_s", lag)
+        return lag
+
+    def note_api_request(self, now: Optional[float] = None) -> None:
+        with self._lock:
+            if not self._req_window_start:
+                self._req_window_start = _time.monotonic()
+            self._req_count += 1
+        self._maybe_auto_evaluate()
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    # -- outbox bookkeeping (events/senders.py) -------------------------- #
+
+    _OUTBOX_RESYNC_STRIDE = 64
+
+    def outbox_depth(self, collection: str) -> int:
+        """Approximate undelivered-row count for one outbox collection:
+        maintained incrementally, recounted every
+        ``_OUTBOX_RESYNC_STRIDE`` ops so drains/deliveries self-heal the
+        estimate."""
+        with self._lock:
+            entry = self._outbox.get(collection)
+            needs_sync = entry is None or entry[1] >= self._OUTBOX_RESYNC_STRIDE
+        if needs_sync:
+            n = self.store.collection(collection).count(
+                lambda d: not d.get("delivered") and not d.get("failed")
+            )
+            with self._lock:
+                self._outbox[collection] = [n, 0]
+                return n
+        return entry[0]
+
+    def note_outbox_insert(self, collection: str) -> None:
+        with self._lock:
+            entry = self._outbox.setdefault(collection, [0, self._OUTBOX_RESYNC_STRIDE])
+            entry[0] += 1
+            entry[1] += 1
+            depth = max(e[0] for e in self._outbox.values())
+            self._gauges["outbox_depth"] = float(depth)
+        self._maybe_auto_evaluate()
+
+    def note_outbox_drained(self, collection: str, n: int) -> None:
+        """Delivered/abandoned rows leave the undelivered set."""
+        with self._lock:
+            entry = self._outbox.get(collection)
+            if entry is not None:
+                entry[0] = max(0, entry[0] - n)
+                entry[1] += 1
+                self._gauges["outbox_depth"] = float(
+                    max(e[0] for e in self._outbox.values())
+                )
+
+    def coalesce_map(self, collection: str) -> Dict[str, str]:
+        with self._lock:
+            m = self._coalesce.setdefault(collection, {})
+            if len(m) > 8192:
+                # the key map must not itself become the memory leak; it
+                # self-repopulates from subsequent inserts
+                m.clear()
+            return m
+
+    # -- evaluation ------------------------------------------------------ #
+
+    def _signal_level(self, value: float, thresholds: List[float]) -> int:
+        level = GREEN
+        for i, cut in enumerate(thresholds[:3]):
+            if cut > 0 and value >= cut:
+                level = i + 1
+        return level
+
+    def _raw_level(self, now: float) -> Tuple[int, Dict[str, int]]:
+        cfg = self.config
+        with self._lock:
+            gauges = dict(self._gauges)
+            # live tick lag: a tick that simply stopped coming must show
+            # up as growing lag, not a frozen gauge (monotonic clock —
+            # harness ticks carry logical timestamps)
+            if self._last_tick_mono:
+                live = max(
+                    0.0,
+                    (_time.monotonic() - self._last_tick_mono)
+                    - cfg.tick_cadence_s,
+                )
+                gauges["tick_lag_s"] = max(
+                    gauges.get("tick_lag_s", 0.0), live
+                )
+            # API rate over the window since the last evaluation; an
+            # idle window keeps ACCUMULATING (no reset) until it is long
+            # enough to decay the gauge, so a finished API storm cannot
+            # pin the level up forever however often we evaluate
+            mono = _time.monotonic()
+            span = mono - self._req_window_start if self._req_window_start else 0.0
+            if self._req_count and span >= 0.01:
+                # true rate over the real window; sub-10ms windows keep
+                # accumulating instead of producing a noise sample
+                rate = self._req_count / span
+                prev = gauges.get("api_rps", 0.0)
+                gauges["api_rps"] = 0.6 * rate + 0.4 * prev
+                self._gauges["api_rps"] = gauges["api_rps"]
+                self._req_count = 0
+                self._req_window_start = mono
+            elif span > max(0.25, 2.0 * float(cfg.eval_interval_s)):
+                gauges["api_rps"] = self._gauges["api_rps"] = (
+                    0.3 * gauges.get("api_rps", 0.0)
+                )
+                self._req_count = 0
+                self._req_window_start = mono
+        backlog = getattr(self.store, "flush_backlog", lambda: 0)()
+        gauges["wal_backlog"] = float(backlog)
+        with self._lock:
+            self._gauges["wal_backlog"] = float(backlog)
+        per_signal = {
+            "tick_lag_s": self._signal_level(
+                gauges.get("tick_lag_s", 0.0), cfg.tick_lag_levels_s
+            ),
+            "queue_pending": self._signal_level(
+                gauges.get("queue_pending", 0.0), cfg.queue_pending_levels
+            ),
+            "wal_backlog": self._signal_level(
+                gauges.get("wal_backlog", 0.0), cfg.wal_backlog_levels
+            ),
+            "outbox_depth": self._signal_level(
+                gauges.get("outbox_depth", 0.0), cfg.outbox_depth_levels
+            ),
+            "store_latency_ms": self._signal_level(
+                gauges.get("store_latency_ms", 0.0),
+                cfg.store_latency_ms_levels,
+            ),
+            "api_rps": self._signal_level(
+                gauges.get("api_rps", 0.0), cfg.api_rps_levels
+            ),
+        }
+        return max(per_signal.values()), per_signal
+
+    def evaluate(self, now: Optional[float] = None) -> int:
+        """Recompute the level from current gauges. Upward transitions
+        apply immediately; downward ones need ``hysteresis_ticks``
+        consecutive calm evaluations."""
+        cfg = self.config
+        if not cfg.enabled:
+            with self._lock:
+                self._level = GREEN
+            return GREEN
+        now = _time.time() if now is None else now
+        raw, per_signal = self._raw_level(now)
+        transition = None
+        with self._lock:
+            self._last_eval = _time.monotonic()
+            current = self._level
+            if raw > current:
+                transition = (current, raw)
+                self._level = raw
+                self._calm_streak = 0
+            elif raw < current:
+                self._calm_streak += 1
+                if self._calm_streak >= max(1, cfg.hysteresis_ticks):
+                    transition = (current, raw)
+                    self._level = raw
+                    self._calm_streak = 0
+            else:
+                self._calm_streak = 0
+            level = self._level
+        if transition is not None:
+            self._note_transition(transition[0], transition[1], per_signal)
+        return level
+
+    def _maybe_auto_evaluate(self) -> None:
+        """Gauge pushes re-evaluate at most once per eval interval so an
+        API-only or queue-only storm moves the ladder without a tick
+        running."""
+        interval = float(self.config.eval_interval_s)
+        with self._lock:
+            due = _time.monotonic() - self._last_eval >= interval
+        if due:
+            self.evaluate()
+
+    def _note_transition(
+        self, old: int, new: int, per_signal: Dict[str, int]
+    ) -> None:
+        from ..models import event as event_mod
+        from .log import get_logger, incr_counter
+
+        incr_counter("overload.level_change")
+        incr_counter(f"overload.level.{level_name(new)}")
+        drivers = sorted(
+            s for s, lvl in per_signal.items() if lvl >= new and new > GREEN
+        )
+        log = get_logger("overload")
+        emit = log.warning if new > old else log.info
+        emit(
+            "overload-level",
+            old=level_name(old),
+            new=level_name(new),
+            drivers=drivers,
+            gauges={k: round(v, 2) for k, v in self.gauges().items()},
+        )
+        try:
+            event_mod.log(
+                self.store,
+                event_mod.RESOURCE_ADMIN,
+                "OVERLOAD_LEVEL",
+                level_name(new),
+                {"old": level_name(old), "drivers": drivers},
+            )
+        except Exception:  # noqa: BLE001 — a read-only or failing store
+            # must not turn the monitor itself into a crash source
+            pass
+
+    # -- consumption ------------------------------------------------------ #
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def level_label(self) -> str:
+        return level_name(self.level())
+
+    def retry_after_s(self, level: Optional[int] = None) -> float:
+        """Client backoff derived from the level (RED: sit out two
+        cadences; BLACK: four) — the Retry-After the API surface sends."""
+        cfg = self.config
+        level = self.level() if level is None else level
+        if level >= BLACK:
+            return float(cfg.retry_after_black_s)
+        if level >= RED:
+            return float(cfg.retry_after_red_s)
+        return 0.0
+
+
+# -- per-store singletons ----------------------------------------------------- #
+
+_monitors_lock = threading.Lock()
+
+
+def monitor_for(store) -> LoadMonitor:
+    """Per-store LoadMonitor singleton, attached to the store object so
+    their lifetimes are one (a global id-keyed registry would pin every
+    short-lived test/harness store — and its whole dataset — forever)."""
+    monitor = getattr(store, "_overload_monitor", None)
+    if monitor is None:
+        with _monitors_lock:
+            monitor = getattr(store, "_overload_monitor", None)
+            if monitor is None:
+                monitor = LoadMonitor(store)
+                store._overload_monitor = monitor
+    return monitor
+
+
+# -- shed accounting ---------------------------------------------------------- #
+
+
+def record_shed(store, kind: str, key: str, detail: str = "") -> int:
+    """The ONE place a dropped/deferred unit of work is recorded: bump
+    the counters and the per-(kind, key) aggregate doc, emit an admin
+    event on the first drop and every 100th. Returns the running count
+    for this (kind, key). Callers add their own domain record (the jobs
+    collection row, the outbox counter) on top."""
+    from ..models import event as event_mod
+    from .log import get_logger, incr_counter
+
+    incr_counter("overload.shed")
+    incr_counter(f"overload.shed.{kind}")
+    now = _time.time()
+    doc_id = f"{kind}:{key}"
+    coll = store.collection(SHEDS_COLLECTION)
+    box = {"n": 1}
+
+    def bump(doc: dict) -> None:
+        doc["count"] += 1
+        doc["last_at"] = now
+        if detail:
+            doc["detail"] = detail
+        box["n"] = doc["count"]
+
+    if not coll.mutate(doc_id, bump):
+        coll.upsert(
+            {
+                "_id": doc_id,
+                "kind": kind,
+                "key": key,
+                "count": 1,
+                "first_at": now,
+                "last_at": now,
+                "detail": detail,
+            }
+        )
+    n = box["n"]
+    if n == 1 or n % 100 == 0:
+        get_logger("overload").warning(
+            "work-shed", kind=kind, key=key, count=n, detail=detail
+        )
+        try:
+            event_mod.log(
+                store,
+                event_mod.RESOURCE_ADMIN,
+                "WORK_SHED",
+                doc_id,
+                {"kind": kind, "key": key, "count": n},
+            )
+        except Exception:  # noqa: BLE001 — see _note_transition
+            pass
+    return n
+
+
+def shed_totals(store) -> Dict[str, int]:
+    """Aggregate shed counts by record id (the matrix's zero-silent-
+    discard audit reads this)."""
+    return {
+        d["_id"]: d.get("count", 0)
+        for d in store.collection(SHEDS_COLLECTION).find()
+    }

@@ -1,0 +1,595 @@
+"""Overload protection & brownout (ISSUE 5): the load-monitor ladder,
+JobQueue priority shedding, the bounded coalescing outbox, the
+overload-adaptive REST 429s, tick brownout, the okta settings
+migration, and the storm-soak matrix (tools/overload_matrix.py CASES —
+the same registry ``make overload-matrix`` runs across seeds)."""
+from __future__ import annotations
+
+import threading
+import time as _time
+
+import pytest
+
+from evergreen_tpu.queue.jobs import (
+    PRIORITY_AGENT,
+    PRIORITY_PLANNING,
+    PRIORITY_STATS,
+    FnJob,
+    JobQueue,
+)
+from evergreen_tpu.settings import OverloadConfig
+from evergreen_tpu.storage.store import Store
+from evergreen_tpu.utils import log as log_mod
+from evergreen_tpu.utils import overload
+
+
+def _quiet_config(store, **kw) -> OverloadConfig:
+    """An OverloadConfig that never auto-evaluates on gauge pushes, so
+    tests control the ladder with explicit evaluate() calls."""
+    cfg = OverloadConfig(eval_interval_s=3600.0, **kw)
+    cfg.set(store)
+    return cfg
+
+
+def _force_level(store, level: int) -> overload.LoadMonitor:
+    """Drive the monitor to a level through the queue-depth signal (the
+    default thresholds are 200/500/1000)."""
+    monitor = overload.monitor_for(store)
+    value = {
+        overload.GREEN: 0.0,
+        overload.YELLOW: 250.0,
+        overload.RED: 600.0,
+        overload.BLACK: 5000.0,
+    }[level]
+    monitor.observe("queue_pending", value)
+    for _ in range(8):  # downward transitions walk the hysteresis
+        if monitor.evaluate() == level:
+            return monitor
+    raise AssertionError(
+        f"monitor stuck at {monitor.level_label()}, wanted "
+        f"{overload.level_name(level)}"
+    )
+
+
+# --------------------------------------------------------------------------- #
+# monitor
+# --------------------------------------------------------------------------- #
+
+
+def test_monitor_fuses_signals_to_max(store):
+    _quiet_config(store)
+    monitor = overload.monitor_for(store)
+    assert monitor.evaluate() == overload.GREEN
+    monitor.observe("store_latency_ms", 300.0)  # yellow
+    monitor.observe("queue_pending", 600.0)  # red
+    assert monitor.evaluate() == overload.RED
+
+
+def test_monitor_hysteresis_up_fast_down_slow(store):
+    _quiet_config(store, hysteresis_ticks=3)
+    monitor = overload.monitor_for(store)
+    monitor.observe("queue_pending", 5000.0)
+    assert monitor.evaluate() == overload.BLACK  # up: immediate
+    monitor.observe("queue_pending", 0.0)
+    assert monitor.evaluate() == overload.BLACK  # calm 1
+    assert monitor.evaluate() == overload.BLACK  # calm 2
+    assert monitor.evaluate() == overload.GREEN  # calm 3: steps down
+    # a blip resets the streak
+    monitor.observe("queue_pending", 600.0)
+    assert monitor.evaluate() == overload.RED
+    monitor.observe("queue_pending", 0.0)
+    assert monitor.evaluate() == overload.RED
+    monitor.observe("queue_pending", 600.0)
+    assert monitor.evaluate() == overload.RED  # raw==current: reset
+    monitor.observe("queue_pending", 0.0)
+    assert monitor.evaluate() == overload.RED
+    assert monitor.evaluate() == overload.RED
+    assert monitor.evaluate() == overload.GREEN
+
+
+def test_monitor_disabled_pins_green(store):
+    _quiet_config(store, enabled=False)
+    monitor = overload.monitor_for(store)
+    monitor.observe("queue_pending", 10_000.0)
+    assert monitor.evaluate() == overload.GREEN
+
+
+def test_monitor_transition_is_counted_logged_and_evented(store):
+    _quiet_config(store)
+    got = []
+    log_mod.add_sink(got.append)
+    before = log_mod.get_counter("overload.level_change")
+    try:
+        _force_level(store, overload.RED)
+    finally:
+        log_mod.remove_sink(got.append)
+    assert log_mod.get_counter("overload.level_change") == before + 1
+    assert any(r.get("message") == "overload-level" for r in got)
+    events = store.collection("events").find(
+        lambda d: d.get("event_type") == "OVERLOAD_LEVEL"
+    )
+    assert len(events) == 1
+
+
+def test_retry_after_derives_from_level(store):
+    _quiet_config(store, retry_after_red_s=17.0, retry_after_black_s=99.0)
+    monitor = overload.monitor_for(store)
+    assert monitor.retry_after_s(overload.GREEN) == 0.0
+    assert monitor.retry_after_s(overload.RED) == 17.0
+    assert monitor.retry_after_s(overload.BLACK) == 99.0
+
+
+def test_record_shed_counts_and_aggregates(store):
+    before = log_mod.get_counter("overload.shed")
+    for _ in range(3):
+        overload.record_shed(store, "job", "host-stats", detail="test")
+    assert log_mod.get_counter("overload.shed") == before + 3
+    totals = overload.shed_totals(store)
+    assert totals["job:host-stats"] == 3
+    # evented on the first drop
+    events = store.collection("events").find(
+        lambda d: d.get("event_type") == "WORK_SHED"
+    )
+    assert len(events) == 1
+
+
+def test_overload_config_validation(store):
+    cfg = OverloadConfig(queue_pending_levels=[5.0, 2.0, 10.0])
+    assert "non-decreasing" in cfg.validate_and_default()
+    cfg = OverloadConfig(queue_pending_levels=[1.0, 2.0])
+    assert "triple" in cfg.validate_and_default()
+    assert OverloadConfig().validate_and_default() == ""
+
+
+# --------------------------------------------------------------------------- #
+# JobQueue priorities + bounded pending
+# --------------------------------------------------------------------------- #
+
+
+def test_priority_dispatch_planning_before_stats(store):
+    _quiet_config(store)
+    q = JobQueue(store, workers=1)
+    gate = threading.Event()
+    order = []
+    try:
+        assert q.put(FnJob("blocker", lambda s: gate.wait(5)))
+        _time.sleep(0.05)  # blocker occupies the one worker slot
+        assert q.put(
+            FnJob("stats-1", lambda s: order.append("stats"),
+                  priority=PRIORITY_STATS)
+        )
+        assert q.put(
+            FnJob("plan-1", lambda s: order.append("planning"),
+                  priority=PRIORITY_PLANNING)
+        )
+        gate.set()
+        assert q.wait_idle(5.0)
+    finally:
+        gate.set()
+        q.close()
+    assert order == ["planning", "stats"]
+
+
+def test_put_outcome_reasons_and_bool_compat(store):
+    _quiet_config(store)
+    q = JobQueue(store, workers=1)
+    try:
+        first = q.put(FnJob("dup", lambda s: _time.sleep(0.2)))
+        assert first and first.reason == ""
+        dup = q.put(FnJob("dup", lambda s: None))
+        assert not dup and dup.reason == "duplicate"
+    finally:
+        q.close()
+
+
+def test_capacity_sheds_lowest_class_only(store):
+    _quiet_config(store)
+    q = JobQueue(store, workers=1, max_pending=3)
+    gate = threading.Event()
+    ran = []
+    before = log_mod.get_counter("overload.jobs_shed")
+    try:
+        assert q.put(FnJob("blocker", lambda s: gate.wait(5)))
+        _time.sleep(0.05)
+        assert q.put(FnJob("s1", lambda s: ran.append("s1"),
+                           priority=PRIORITY_STATS))
+        assert q.put(FnJob("s2", lambda s: ran.append("s2"),
+                           priority=PRIORITY_STATS))
+        # at cap: another stats job sheds ITSELF (no higher-class victim)
+        out = q.put(FnJob("s3", lambda s: ran.append("s3"),
+                          priority=PRIORITY_STATS))
+        assert not out and out.reason == "shed-capacity"
+        # a planning job evicts the newest waiting stats job instead
+        assert q.put(FnJob("p1", lambda s: ran.append("p1"),
+                           priority=PRIORITY_PLANNING))
+        assert q.pending_count() == 3
+        # an agent job evicts the remaining stats waiter — the cap holds
+        assert q.put(FnJob("a1", lambda s: ran.append("a1"),
+                           priority=PRIORITY_AGENT))
+        assert q.pending_count() == 3
+        # with NO evictable waiter left, critical work rides OVER the cap
+        assert q.put(FnJob("a2", lambda s: ran.append("a2"),
+                           priority=PRIORITY_AGENT))
+        assert q.pending_count() == 4
+        gate.set()
+        assert q.wait_idle(5.0)
+    finally:
+        gate.set()
+        q.close()
+    assert "p1" in ran and "a1" in ran and "a2" in ran
+    # s1/s2 evicted, s3 rejected at the door
+    assert not any(j in ran for j in ("s1", "s2", "s3"))
+    assert log_mod.get_counter("overload.jobs_shed") == before + 3
+    shed_ids = {
+        d["_id"]
+        for d in store.collection("jobs").find(
+            lambda d: d.get("status") == "shed"
+        )
+    }
+    assert shed_ids == {"s1", "s2", "s3"}
+    assert overload.shed_totals(store)  # aggregate records exist
+
+
+def test_level_gating_sheds_stats_at_red_reconcile_at_black(store):
+    _quiet_config(store)
+    _force_level(store, overload.RED)
+    q = JobQueue(store, workers=1)
+    try:
+        out = q.put(FnJob("st", lambda s: None, priority=PRIORITY_STATS))
+        assert not out and out.reason == "shed-overload"
+        assert q.put(FnJob("rc", lambda s: None))  # reconcile ok at RED
+        _force_level(store, overload.BLACK)
+        out = q.put(FnJob("rc2", lambda s: None))
+        assert not out and out.reason == "shed-overload"
+        assert q.put(
+            FnJob("pl", lambda s: None, priority=PRIORITY_PLANNING)
+        )
+        assert q.put(
+            FnJob("ag", lambda s: None, priority=PRIORITY_AGENT)
+        )
+        assert q.wait_idle(5.0)
+    finally:
+        q.close()
+
+
+def test_shed_probe_does_not_wedge_quarantine(store):
+    """A post-quarantine probe that gets overload-shed must release its
+    probe slot — otherwise the type reads as quarantined forever."""
+    _quiet_config(store)
+    q = JobQueue(store, workers=1, poison_threshold=1, quarantine_s=60.0)
+    ran = []
+    try:
+        def boom(s):
+            raise RuntimeError("poison")
+
+        assert q.put(FnJob("b-0", boom, job_type="flaky",
+                           priority=PRIORITY_STATS))
+        assert q.wait_idle(5.0)
+        # cooldown elapsed, but the ladder is RED: the probe sheds
+        with q._lock:
+            q._quarantined_until["flaky"] = 0.0
+        _force_level(store, overload.RED)
+        out = q.put(FnJob("probe-0", lambda s: ran.append(1),
+                          job_type="flaky", priority=PRIORITY_STATS))
+        assert not out and out.reason == "shed-overload"
+        # storm over: the NEXT probe must be admitted, not dropped as
+        # quarantined by a leaked probe slot
+        _force_level(store, overload.GREEN)
+        assert q.put(FnJob("probe-1", lambda s: ran.append(2),
+                           job_type="flaky", priority=PRIORITY_STATS))
+        assert q.wait_idle(5.0)
+    finally:
+        q.close()
+    assert ran == [2]
+
+
+# --------------------------------------------------------------------------- #
+# outbox
+# --------------------------------------------------------------------------- #
+
+
+def test_outbox_cap_drops_with_counter_and_record(store):
+    from evergreen_tpu.events.senders import insert_outbox_row
+
+    _quiet_config(store, outbox_cap=5)
+    before = log_mod.get_counter("overload.outbox_dropped")
+    inserted = sum(
+        1
+        for i in range(9)
+        if insert_outbox_row(
+            store, "email_outbox",
+            {"channel_type": "email", "to": "x@y", "subject": f"s{i}",
+             "body": "b"},
+        )
+    )
+    assert inserted == 5
+    assert log_mod.get_counter("overload.outbox_dropped") == before + 4
+    assert overload.shed_totals(store).get("outbox:email_outbox") == 4
+
+
+def test_outbox_coalesces_at_yellow_not_at_green(store):
+    from evergreen_tpu.events.senders import insert_outbox_row
+
+    _quiet_config(store, outbox_cap=100)
+    row = {"channel_type": "slack", "slack_channel": "#c",
+           "text": "same\nbody"}
+    assert insert_outbox_row(store, "slack_outbox", dict(row))
+    # GREEN: a duplicate still inserts (normal delivery semantics)
+    assert insert_outbox_row(store, "slack_outbox", dict(row))
+    _force_level(store, overload.YELLOW)
+    before = log_mod.get_counter("overload.outbox_coalesced")
+    assert not insert_outbox_row(store, "slack_outbox", dict(row))
+    assert log_mod.get_counter("overload.outbox_coalesced") == before + 1
+    docs = store.collection("slack_outbox").find(lambda d: True)
+    assert len(docs) == 2
+    assert any(d.get("coalesced", 0) == 1 for d in docs)
+
+
+def test_subjectless_notifications_never_coalesce(store):
+    """Distinct notifications with no usable subject must not fold into
+    each other — an empty coalesce key would silently lose the second."""
+    from evergreen_tpu.events.senders import insert_outbox_row
+
+    _quiet_config(store, outbox_cap=100)
+    _force_level(store, overload.YELLOW)
+    row = {"channel_type": "webhook", "url": "http://x/hook",
+           "payload": {"data": "a"}}
+    assert insert_outbox_row(store, "webhook_outbox", dict(row))
+    row2 = {"channel_type": "webhook", "url": "http://x/hook",
+            "payload": {"data": "b"}}
+    assert insert_outbox_row(store, "webhook_outbox", dict(row2))
+    assert len(store.collection("webhook_outbox").find(lambda d: True)) == 2
+
+
+def test_outbox_drain_is_never_shed(store):
+    """The drain REDUCES the outbox-depth signal: shedding it would
+    latch the brownout forever, so it rides the never-shed class while
+    the notifier (which FEEDS the outbox) sheds at RED."""
+    from evergreen_tpu.units.crons import event_notifier_jobs
+
+    _quiet_config(store)
+    jobs = {j.job_type: j for j in event_notifier_jobs(store, 0.0)}
+    assert jobs["outbox-drain"].priority == PRIORITY_PLANNING
+    assert jobs["event-notifier"].priority == PRIORITY_STATS
+    _force_level(store, overload.BLACK)
+    q = JobQueue(store, workers=1)
+    try:
+        assert q.put(jobs["outbox-drain"])  # admitted even at BLACK
+        out = q.put(jobs["event-notifier"])
+        assert not out and out.reason == "shed-overload"
+        assert q.wait_idle(5.0)
+    finally:
+        q.close()
+
+
+# --------------------------------------------------------------------------- #
+# REST: overload-adaptive 429s + Retry-After (satellite: rate-limit paths)
+# --------------------------------------------------------------------------- #
+
+
+def _api(store, **kw):
+    from evergreen_tpu.api.rest import RestApi
+
+    return RestApi(store, **kw)
+
+
+def _retry_after(api):
+    return dict(getattr(api._ident, "response_headers", None) or []).get(
+        "Retry-After"
+    )
+
+
+def test_rate_limit_429_carries_retry_after(store):
+    _quiet_config(store)
+    api = _api(store, rate_limit_per_min=2)
+    assert api.handle("GET", "/rest/v2/projects")[0] == 200
+    assert api.handle("GET", "/rest/v2/projects")[0] == 200
+    status, payload = api.handle("GET", "/rest/v2/projects")
+    assert status == 429 and "rate limit" in payload["error"]
+    retry = _retry_after(api)
+    assert retry is not None and 1 <= int(retry) <= 60
+
+
+def test_rate_limit_retry_after_stretches_with_level(store):
+    _quiet_config(store, retry_after_red_s=120.0)
+    # keying stays per-identity: exhaust ONE api-user's bucket
+    api = _api(store, rate_limit_per_min=1)
+    assert api.handle(
+        "GET", "/rest/v2/tasks/t1", headers={"api-user": "u1"}
+    )[0] in (200, 404)
+    _force_level(store, overload.RED)
+    # a non-expensive route at RED passes the shed check but hits the
+    # rate limit — its Retry-After is stretched to the level's backoff
+    status, _ = api.handle(
+        "GET", "/rest/v2/tasks/t1", headers={"api-user": "u1"}
+    )
+    assert status == 429
+    assert int(_retry_after(api)) >= 120
+
+
+def test_rate_limit_keying_unchanged_post_auth(store):
+    _quiet_config(store)
+    api = _api(store, rate_limit_per_min=1)
+    assert api.handle(
+        "GET", "/rest/v2/tasks/t1", headers={"api-user": "alice"}
+    )[0] in (200, 404)
+    assert api.handle(
+        "GET", "/rest/v2/tasks/t1", headers={"api-user": "alice"}
+    )[0] == 429
+    # a different identity keeps its own bucket
+    assert api.handle(
+        "GET", "/rest/v2/tasks/t1", headers={"api-user": "bob"}
+    )[0] in (200, 404)
+
+
+def test_expensive_reads_shed_at_red_cheap_reads_serve(store):
+    _quiet_config(store, retry_after_red_s=30.0)
+    api = _api(store)
+    _force_level(store, overload.RED)
+    status, payload = api.handle("GET", "/rest/v2/hosts")
+    assert status == 429 and payload["level"] == "red"
+    assert _retry_after(api) == "30"
+    # single-doc reads and mutations still serve at RED
+    assert api.handle("GET", "/rest/v2/tasks/t1")[0] != 429
+    assert api.handle("POST", "/rest/v2/patches", {"project": "p"})[0] != 429
+
+
+def test_black_sheds_everything_but_exempt_surfaces(store):
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models.host import new_intent
+
+    _quiet_config(store, retry_after_black_s=60.0)
+    api = _api(store)
+    h = new_intent("d1", "mock")
+    host_mod.insert(store, h)
+    _force_level(store, overload.BLACK)
+    assert api.handle("GET", "/rest/v2/tasks/t1")[0] == 429
+    assert _retry_after(api) == "60"
+    # agent protocol is never shed — at any level
+    status, _ = api.handle(
+        "GET", f"/rest/v2/hosts/{h.id}/agent/next_task"
+    )
+    assert status != 429
+    assert api.handle(
+        "POST", "/rest/v2/tasks/t1/agent/heartbeat"
+    )[0] != 429
+    # admin stays reachable: operators tune their way OUT of a brownout
+    assert api.handle("GET", "/rest/v2/admin/overload")[0] == 200
+
+
+def test_notify_route_reports_outbox_saturation(store):
+    _quiet_config(store, outbox_cap=2, retry_after_red_s=30.0)
+    api = _api(store)
+    for i in range(2):
+        status, payload = api.handle(
+            "POST", "/rest/v2/notifications/slack",
+            {"target": "#ops", "msg": f"m{i}"},
+        )
+        assert status == 200 and payload["ok"]
+    # outbox full: an explicit caller is told, never silently dropped
+    status, payload = api.handle(
+        "POST", "/rest/v2/notifications/slack",
+        {"target": "#ops", "msg": "m-over"},
+    )
+    assert status == 429 and "saturated" in payload["error"]
+    assert _retry_after(api) is not None
+
+
+def test_admin_overload_route_reports_ladder(store):
+    _quiet_config(store)
+    api = _api(store)
+    _force_level(store, overload.RED)
+    status, payload = api.handle("GET", "/rest/v2/admin/overload")
+    assert status == 200
+    assert payload["level"] == "red"
+    assert "queue_pending" in payload["gauges"]
+    assert payload["retry_after_s"] == 30.0
+
+
+# --------------------------------------------------------------------------- #
+# tick brownout
+# --------------------------------------------------------------------------- #
+
+
+def test_tick_sheds_stats_and_events_at_red(store):
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from tools.fault_matrix import _seed_store
+    from evergreen_tpu.utils.benchgen import NOW
+
+    _seed_store(store)
+    _quiet_config(store)
+    _force_level(store, overload.RED)
+    res = run_tick(
+        store,
+        TickOptions(create_intent_hosts=True, underwater_unschedule=False),
+        now=NOW,
+    )
+    assert res.overload == "red"
+    assert "stats" in res.shed and "events" in res.shed
+    # planning is never shed: queues persisted despite the brownout
+    assert sum(res.queues.values()) > 0
+    assert not store.collection("spans").find(lambda d: True)
+    totals = overload.shed_totals(store)
+    assert totals.get("tick:stats") == 1 and totals.get("tick:events") == 1
+
+
+def test_cron_populators_defer_under_overload(store):
+    from evergreen_tpu.units.crons import host_monitoring_jobs, stats_jobs
+
+    _quiet_config(store)
+    assert stats_jobs(store, 0.0)  # GREEN: populated
+    _force_level(store, overload.RED)
+    assert stats_jobs(store, 1.0) == []
+    monitoring = host_monitoring_jobs(store, 1.0)
+    types = {j.job_type for j in monitoring}
+    assert "agent-keepalive" in types and "host-monitor" in types
+    assert "reprovision" not in types  # non-urgent deferred at RED
+    _force_level(store, overload.BLACK)
+    monitoring = host_monitoring_jobs(store, 2.0)
+    assert {j.job_type for j in monitoring} == {"agent-keepalive"}
+
+
+# --------------------------------------------------------------------------- #
+# satellite: okta settings migration
+# --------------------------------------------------------------------------- #
+
+
+def test_okta_service_gate_migration_and_warning(store):
+    from evergreen_tpu.settings import (
+        CONFIG_COLLECTION,
+        AuthConfig,
+        OktaServiceConfig,
+    )
+    from evergreen_tpu.storage.migrations import apply_migrations
+
+    store.collection(CONFIG_COLLECTION).upsert(
+        {
+            "_id": "okta_service",
+            "client_id": "cid",
+            "user_group": "evergreen-users",
+            "expected_email_domains": ["corp.example"],
+        }
+    )
+    results = dict(apply_migrations(store))
+    assert results["0004-okta-service-gates-to-auth"] == "applied"
+    auth = AuthConfig.get(store)
+    assert auth.okta_user_group == "evergreen-users"
+    assert auth.okta_expected_email_domains == ["corp.example"]
+    # the stale keys stay → every load of the section warns loudly
+    got = []
+    log_mod.add_sink(got.append)
+    try:
+        section = OktaServiceConfig.get(store)
+    finally:
+        log_mod.remove_sink(got.append)
+    assert section.client_id == "cid"
+    warned = [r for r in got if "stale login-gate" in r.get("message", "")
+              or "stale" in r.get("message", "")]
+    assert warned and warned[0]["stale_keys"] == [
+        "user_group", "expected_email_domains",
+    ]
+
+
+def test_okta_migration_never_clobbers_admin_set_gates(store):
+    from evergreen_tpu.settings import CONFIG_COLLECTION, AuthConfig
+    from evergreen_tpu.storage.migrations import apply_migrations
+
+    AuthConfig(okta_user_group="already-set").set(store)
+    store.collection(CONFIG_COLLECTION).upsert(
+        {"_id": "okta_service", "user_group": "legacy-group"}
+    )
+    apply_migrations(store)
+    assert AuthConfig.get(store).okta_user_group == "already-set"
+
+
+# --------------------------------------------------------------------------- #
+# the storm matrix itself (same registry as `make overload-matrix`)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("case", sorted(__import__("tools.overload_matrix", fromlist=["CASES"]).CASES))
+def test_overload_matrix(case, store):
+    from tools.overload_matrix import run_case
+
+    out = run_case(case, seed=0)
+    assert out["ok"], {k: v for k, v in out.items() if k != "logs"}

@@ -36,13 +36,14 @@ def tree_hash() -> str:
             ["git", "add", "-A"], env=env, cwd=root, check=True,
             capture_output=True,
         )
-        # append-only logs grow between the gate run and the hook's
-        # check (the gate log from this very run; the probe log from the
-        # background daemon) — they must not perturb the hash the reuse
-        # window is keyed by, and neither holds code the suite covers
+        # (the append-only runtime logs — GATE_LOG.jsonl,
+        # TPU_PROBE_LOG.jsonl — are gitignored, so `git add -A` already
+        # leaves them out of the hash.) LAST_GREEN.json is tracked but
+        # written BY the gate run this hash keys, so including it would
+        # invalidate the pre-commit hook's reuse window on every run.
         subprocess.run(
             ["git", "rm", "--cached", "-q", "--ignore-unmatch",
-             "GATE_LOG.jsonl", "TPU_PROBE_LOG.jsonl"],
+             "LAST_GREEN.json"],
             env=env, cwd=root, capture_output=True,
         )
         out = subprocess.run(
@@ -60,32 +61,44 @@ def tree_hash() -> str:
 
 
 def _log_run(rc: int, args: list) -> None:
-    """Append the gate outcome to GATE_LOG.jsonl at the repo root so
-    every run (and therefore every skip) is visible in history
-    (VERDICT r4 ask #10)."""
+    """Append the gate outcome to GATE_LOG.jsonl (an UNtracked,
+    gitignored runtime log — every run and therefore every skip stays
+    visible locally, VERDICT r4 ask #10) and, on a green full-suite
+    run, refresh LAST_GREEN.json — the one auditable summary that IS
+    under version control."""
     import json
     import time
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    record = {
+        "t": round(time.time(), 1),
+        "rc": rc,
+        "args": args,
+        "head": subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=root,
+        ).stdout.strip(),
+        "tree": tree_hash(),
+    }
     try:
         with open(os.path.join(root, "GATE_LOG.jsonl"), "a") as f:
-            f.write(
-                json.dumps(
-                    {
-                        "t": round(time.time(), 1),
-                        "rc": rc,
-                        "args": args,
-                        "head": subprocess.run(
-                            ["git", "rev-parse", "--short", "HEAD"],
-                            capture_output=True, text=True, cwd=root,
-                        ).stdout.strip(),
-                        "tree": tree_hash(),
-                    }
-                )
-                + "\n"
-            )
+            f.write(json.dumps(record) + "\n")
     except OSError:
         pass
+    # only a FULL-suite green refreshes the tracked summary — a passing
+    # subset run (including `tests/ --ignore=...` shapes) must not
+    # masquerade as a suite-wide green; the only extra args a full run
+    # carries are the matrix flags this gate itself appends
+    full_suite = bool(args) and args[0] == "tests/" and all(
+        a in ("--crash-matrix", "--overload-matrix") for a in args[1:]
+    )
+    if rc == 0 and full_suite:
+        try:
+            with open(os.path.join(root, "LAST_GREEN.json"), "w") as f:
+                json.dump(record, f, indent=2, sort_keys=True)
+                f.write("\n")
+        except OSError:
+            pass
 
 
 def main() -> int:
@@ -96,22 +109,32 @@ def main() -> int:
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
-    args = [a for a in sys.argv[1:] if a != "--crash-matrix"]
+    flags = {"--crash-matrix", "--overload-matrix"}
+    args = [a for a in sys.argv[1:] if a not in flags]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
+    with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     args = args or ["tests/"]
     cmd = [sys.executable, "-m", "pytest", "-q", *args]
     print("gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ran_flags = []
     if rc == 0 and with_crash_matrix:
         # the full process-kill matrix (make crash-matrix) on top of the
         # suite: real SIGKILL-shaped deaths + the two-process failover
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         cm = [sys.executable, os.path.join(root, "tools", "crash_matrix.py")]
         print("gate:", " ".join(cm), flush=True)
         rc = subprocess.call(cm, env={**env, "JAX_PLATFORMS": "cpu"})
-        _log_run(rc, [*args, "--crash-matrix"])
-    else:
-        _log_run(rc, args)
+        ran_flags.append("--crash-matrix")
+    if rc == 0 and with_overload_matrix:
+        # the storm-soak matrix (make overload-matrix): seeded storms
+        # must brown out low-value work only and recover to GREEN
+        om = [sys.executable,
+              os.path.join(root, "tools", "overload_matrix.py")]
+        print("gate:", " ".join(om), flush=True)
+        rc = subprocess.call(om, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--overload-matrix")
+    _log_run(rc, [*args, *ran_flags])
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)
     else:

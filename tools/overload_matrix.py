@@ -1,0 +1,463 @@
+#!/usr/bin/env python
+"""Storm-soak matrix: seeded storms against the overload-protection
+layer (utils/overload.py), one case per storm class from ISSUE 5.
+
+Each case drives sustained overload through a real seam — a task-churn
+job flood, an event/notification storm, an API scrape storm, a slow
+store (injected via the ``wal.commit`` fault seam) — and asserts the
+brownout invariants:
+
+  * planning ticks never starve: every scheduler tick runs and persists
+    queues, storm or no storm;
+  * agent-critical work is never shed: agent-class jobs and agent
+    protocol requests always get through;
+  * the caps hold: the JobQueue pending set and the notification
+    outboxes stay bounded under sustained pressure (no unbounded memory
+    growth);
+  * nothing is shed silently: every drop shows up in the counters AND
+    the ``overload_sheds`` aggregate records — the two books balance;
+  * the monitor recovers: after the storm ends the ladder returns to
+    GREEN (through its hysteresis) within a bounded number of
+    evaluations.
+
+``tests/test_overload.py`` parametrizes over the same CASES registry;
+``make overload-matrix`` / ``tools/gate.py --overload-matrix`` run it
+standalone across seeds.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time as _time
+from typing import Callable, Dict, List
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from evergreen_tpu.events.senders import insert_outbox_row
+from evergreen_tpu.queue.jobs import (
+    PRIORITY_AGENT,
+    PRIORITY_PLANNING,
+    PRIORITY_STATS,
+    FnJob,
+    JobQueue,
+)
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+from evergreen_tpu.settings import OverloadConfig
+from evergreen_tpu.storage.store import Store
+from evergreen_tpu.utils import faults, overload
+from evergreen_tpu.utils import log as log_mod
+from evergreen_tpu.utils.benchgen import NOW
+from evergreen_tpu.utils.faults import Fault, FaultPlan
+
+from tools.fault_matrix import _capture_logs, _seed_store
+
+OPTS = TickOptions(create_intent_hosts=True, underwater_unschedule=False)
+
+#: bounded post-storm recovery: the ladder must be GREEN within this
+#: many explicit evaluations after the storm ends
+RECOVERY_EVALS = 12
+
+
+def _counters() -> Dict[str, int]:
+    return log_mod.counters_snapshot()
+
+
+def _delta(before: Dict[str, int], name: str) -> int:
+    return log_mod.get_counter(name) - before.get(name, 0)
+
+
+def _drain_to_green(monitor: overload.LoadMonitor) -> int:
+    """Evaluate until GREEN (or the bound); returns evaluations used.
+    The sleep gives time-decayed gauges (api_rps) their idle windows."""
+    for i in range(RECOVERY_EVALS):
+        if monitor.evaluate() == overload.GREEN:
+            return i + 1
+        _time.sleep(0.15)
+    return RECOVERY_EVALS + 1
+
+
+def _sheds_balance(store: Store, before: Dict[str, int], kind: str,
+                   counter: str) -> bool:
+    """Zero-silent-discard audit: the counter delta for one shed class
+    must equal the sum of its aggregate records (fresh store, so the
+    records ARE the delta)."""
+    recorded = sum(
+        d.get("count", 0)
+        for d in store.collection(overload.SHEDS_COLLECTION).find(
+            lambda d: d.get("kind") == kind
+        )
+    )
+    return recorded == _delta(before, counter) and recorded > 0
+
+
+# --------------------------------------------------------------------------- #
+# cases
+# --------------------------------------------------------------------------- #
+
+
+def case_task_churn_storm(seed: int = 0) -> dict:
+    """A flood of stats-class churn jobs against a small bounded queue:
+    planning ticks and agent jobs must ride through untouched while the
+    lowest class browns out."""
+    store = Store()
+    _seed_store(store, seed=seed + 31)
+    OverloadConfig(
+        queue_max_pending=24,
+        queue_pending_levels=[8.0, 16.0, 24.0],
+        hysteresis_ticks=2,
+        eval_interval_s=0.0,
+        tick_cadence_s=0.05,
+    ).set(store)
+    monitor = overload.monitor_for(store)
+    before = _counters()
+    got, stop = _capture_logs()
+    q = JobQueue(store, workers=2, name=f"storm-{seed}")
+
+    planning_results: List = []
+    agent_runs: List[int] = []
+    max_pending = [0]
+
+    def churn(s: Store) -> None:
+        _time.sleep(0.004)
+
+    def plan(s: Store) -> None:
+        planning_results.append(run_tick(s, OPTS, now=NOW))
+
+    planning_ok: List[bool] = []
+    agent_ok: List[bool] = []
+    try:
+        for i in range(150):
+            q.put(
+                FnJob(
+                    f"churn-{seed}-{i}",
+                    churn,
+                    job_type="host-stats",
+                    priority=PRIORITY_STATS,
+                )
+            )
+            if i % 25 == 0:
+                planning_ok.append(
+                    bool(
+                        q.put(
+                            FnJob(
+                                f"tick-{seed}-{i}",
+                                plan,
+                                scopes=["scheduler-tick"],
+                                job_type="scheduler-tick",
+                                priority=PRIORITY_PLANNING,
+                            )
+                        )
+                    )
+                )
+                agent_ok.append(
+                    bool(
+                        q.put(
+                            FnJob(
+                                f"keepalive-{seed}-{i}",
+                                lambda s, _i=i: agent_runs.append(_i),
+                                job_type="agent-keepalive",
+                                priority=PRIORITY_AGENT,
+                            )
+                        )
+                    )
+                )
+            max_pending[0] = max(max_pending[0], q.pending_count())
+        peaked = monitor.level() >= overload.YELLOW
+        q.wait_idle(30.0)
+        evals_to_green = _drain_to_green(monitor)
+    finally:
+        stop()
+        q.close()
+    shed_docs = store.collection("jobs").find(
+        lambda d: d.get("status") == "shed"
+    )
+    return {
+        "ok": (
+            all(planning_ok)
+            and all(agent_ok)
+            and len(planning_results) == len(planning_ok)
+            and all(sum(r.queues.values()) > 0 for r in planning_results)
+            and len(agent_runs) == len(agent_ok)
+            # the cap held: agent/planning jobs may ride over it, churn
+            # never (6 = the worst-case over-cap critical jobs in flight)
+            and max_pending[0] <= 24 + 6
+            and peaked
+            and _delta(before, "overload.jobs_shed") > 0
+            and _delta(before, "overload.jobs_shed.agent") == 0
+            and _delta(before, "overload.jobs_shed.planning") == 0
+            and len(shed_docs) > 0
+            and _sheds_balance(store, before, "job", "overload.jobs_shed")
+            and evals_to_green <= RECOVERY_EVALS
+            and any(r.get("message") == "job-shed" for r in got)
+        ),
+        "max_pending": max_pending[0],
+        "evals_to_green": evals_to_green,
+        "shed": _delta(before, "overload.jobs_shed"),
+        "logs": got,
+    }
+
+
+def case_event_storm(seed: int = 0) -> dict:
+    """A notification fan-out storm: the outbox coalesces duplicates at
+    YELLOW, holds its cap with counted drops at the top, and the ladder
+    steps back to GREEN once the backlog drains."""
+    store = Store()
+    OverloadConfig(
+        outbox_cap=40,
+        outbox_depth_levels=[10.0, 20.0, 40.0],
+        hysteresis_ticks=2,
+        eval_interval_s=0.0,
+    ).set(store)
+    monitor = overload.monitor_for(store)
+    before = _counters()
+    got, stop = _capture_logs()
+    collection = "slack_outbox"
+    inserted = 0
+    try:
+        # phase A: distinct notifications until the cap bites
+        for i in range(100):
+            if insert_outbox_row(
+                store,
+                collection,
+                {
+                    "channel_type": "slack",
+                    "slack_channel": "#ops",
+                    "text": f"storm-{seed}-{i}\nbody",
+                },
+            ):
+                inserted += 1
+        # phase B: repeats of an early (still undelivered) notification
+        # — these must coalesce, not insert or drop
+        for _ in range(50):
+            if insert_outbox_row(
+                store,
+                collection,
+                {
+                    "channel_type": "slack",
+                    "slack_channel": "#ops",
+                    "text": f"storm-{seed}-2\nbody",
+                },
+            ):
+                inserted += 1
+        peaked = monitor.level() >= overload.RED
+        undelivered = store.collection(collection).count(
+            lambda d: not d.get("delivered") and not d.get("failed")
+        )
+        coalesced = _delta(before, "overload.outbox_coalesced")
+        dropped = _delta(before, "overload.outbox_dropped")
+        # storm over: the drain delivers everything
+        coll = store.collection(collection)
+        for doc in coll.find(lambda d: not d.get("delivered")):
+            coll.update(doc["_id"], {"delivered": True})
+        monitor.note_outbox_drained(collection, undelivered)
+        evals_to_green = _drain_to_green(monitor)
+    finally:
+        stop()
+    return {
+        "ok": (
+            undelivered <= 40
+            and peaked
+            and dropped > 0
+            and coalesced > 0
+            # every one of the 150 sends is accounted for exactly once
+            and inserted + coalesced + dropped == 150
+            and _sheds_balance(
+                store, before, "outbox", "overload.outbox_dropped"
+            )
+            and evals_to_green <= RECOVERY_EVALS
+            and any(r.get("message") == "outbox-row-dropped" for r in got)
+        ),
+        "undelivered": undelivered,
+        "inserted": inserted,
+        "coalesced": coalesced,
+        "dropped": dropped,
+        "evals_to_green": evals_to_green,
+        "logs": got,
+    }
+
+
+def case_api_storm(seed: int = 0) -> dict:
+    """A scrape storm on the HTTP surface: expensive list endpoints 429
+    with a level-derived Retry-After while the agent protocol keeps its
+    SLO, then the rate gauge decays and service resumes."""
+    from evergreen_tpu.api.rest import RestApi
+
+    store = Store()
+    _, tasks_by_distro, _ = _seed_store(store, seed=seed + 47)
+    task_id = next(iter(tasks_by_distro.values()))[0].id
+    OverloadConfig(
+        api_rps_levels=[60.0, 120.0, 100000.0],
+        hysteresis_ticks=2,
+        eval_interval_s=0.02,
+        retry_after_red_s=30.0,
+    ).set(store)
+    monitor = overload.monitor_for(store)
+    before = _counters()
+    got, stop = _capture_logs()
+    api = RestApi(store)
+    shed_status = None
+    shed_headers: List = []
+    agent_status = None
+    cheap_status = None
+    try:
+        deadline = _time.monotonic() + 5.0
+        while monitor.level() < overload.RED:
+            api.handle("GET", "/rest/v2/hosts")
+            if _time.monotonic() > deadline:
+                break
+        red = monitor.level() >= overload.RED
+        status, payload = api.handle("GET", "/rest/v2/hosts")
+        shed_status = status
+        shed_headers = list(
+            getattr(api._ident, "response_headers", None) or []
+        )
+        shed_payload = payload
+        # agent-critical traffic is never shed
+        agent_status, _ = api.handle(
+            "POST", f"/rest/v2/tasks/{task_id}/agent/heartbeat"
+        )
+        # a cheap single-doc read is not an expensive list: at RED it
+        # still serves
+        cheap_status, _ = api.handle("GET", f"/rest/v2/tasks/{task_id}")
+        evals_to_green = _drain_to_green(monitor)
+        post_status, _ = api.handle("GET", "/rest/v2/hosts")
+    finally:
+        stop()
+    retry_vals = [v for h, v in shed_headers if h == "Retry-After"]
+    return {
+        "ok": (
+            red
+            and shed_status == 429
+            and shed_payload.get("level") in ("red", "black")
+            and retry_vals == ["30"]
+            and agent_status != 429
+            and cheap_status != 429
+            and _delta(before, "overload.api_shed") > 0
+            and evals_to_green <= RECOVERY_EVALS
+            and post_status == 200
+            and any(r.get("message") == "request-shed" for r in got)
+        ),
+        "shed_status": shed_status,
+        "retry_after": retry_vals,
+        "agent_status": agent_status,
+        "evals_to_green": evals_to_green,
+        "logs": got,
+    }
+
+
+def case_slow_store_storm(seed: int = 0) -> dict:
+    """A store whose WAL writes crawl (hang injected at the wal.commit
+    seam): the commit-latency EWMA drives the ladder to RED, ticks brown
+    out their optional work but keep planning, and the level recovers
+    once the store heals."""
+    from evergreen_tpu.storage.durable import DurableStore
+
+    tmp = tempfile.mkdtemp(prefix=f"overload-slow-{seed}-")
+    store = DurableStore(tmp)
+    try:
+        _seed_store(store, seed=seed + 59)
+        OverloadConfig(
+            store_latency_ms_levels=[3.0, 8.0, 100000.0],
+            hysteresis_ticks=2,
+            eval_interval_s=0.0,
+        ).set(store)
+        monitor = overload.monitor_for(store)
+        got, stop = _capture_logs()
+        faults.install(
+            FaultPlan().always("wal.commit", Fault("hang", delay_s=0.03))
+        )
+        storm_results: List = []
+        try:
+            for t in range(4):
+                storm_results.append(
+                    run_tick(store, OPTS, now=NOW + 15.0 * t)
+                )
+        finally:
+            faults.uninstall()
+        browned = [
+            r for r in storm_results
+            if r.overload in ("red", "black") and "stats" in r.shed
+        ]
+        # store healed: ticks run clean again and the ladder steps down
+        # (the EWMA decays ~0.6x per healthy tick, so a loaded machine
+        # whose storm EWMA overshot needs a few extra ticks)
+        recovery_results: List = []
+        for t in range(4, 4 + 14):
+            recovery_results.append(
+                run_tick(store, OPTS, now=NOW + 15.0 * t)
+            )
+            if recovery_results[-1].overload == "green":
+                break
+        stop()
+        return {
+            "ok": (
+                all(sum(r.queues.values()) > 0 for r in storm_results)
+                and all(
+                    sum(r.queues.values()) > 0 for r in recovery_results
+                )
+                and len(browned) > 0
+                and recovery_results[-1].overload == "green"
+                and not recovery_results[-1].shed
+                and any(
+                    r.get("message") == "degraded-tick"
+                    and r.get("reason") == "overload"
+                    for r in got
+                )
+            ),
+            "storm_overload": [r.overload for r in storm_results],
+            "recovery_overload": [r.overload for r in recovery_results],
+            "logs": got,
+        }
+    finally:
+        store.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+CASES: Dict[str, Callable[[int], dict]] = {
+    "task-churn-storm": case_task_churn_storm,
+    "event-storm": case_event_storm,
+    "api-storm": case_api_storm,
+    "slow-store-storm": case_slow_store_storm,
+}
+
+
+def run_case(name: str, seed: int = 0) -> dict:
+    return CASES[name](seed)
+
+
+def main() -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seeds", type=int, default=3)
+    p.add_argument("--case", default="", help="run one case only")
+    args = p.parse_args()
+    names = [args.case] if args.case else sorted(CASES)
+    failures = 0
+    for seed in range(args.seeds):
+        for name in names:
+            out = run_case(name, seed)
+            ok = bool(out.get("ok"))
+            failures += 0 if ok else 1
+            detail = {
+                k: v for k, v in out.items() if k not in ("logs", "ok")
+            }
+            print(
+                json.dumps(
+                    {"case": name, "seed": seed, "ok": ok, **detail},
+                    default=str,
+                )
+            )
+    print(json.dumps({"overload_matrix_failures": failures}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
