@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -50,6 +50,14 @@ crash-matrix:
 # and the monitor returns to GREEN with hysteresis after each storm
 overload-matrix:
 	env JAX_PLATFORMS=cpu python tools/overload_matrix.py
+
+# resident ≡ rebuild parity: the device-resident state plane's columns
+# must canonicalize identically to a from-scratch snapshot after every
+# step of randomized churn (tests/test_resident_state.py fuzz), plus a
+# mid-scale churn micro-bench asserting the run was delta-shaped (zero
+# fallbacks, one cold rebuild, skip/patch/splice persists dominating)
+resident-parity:
+	env JAX_PLATFORMS=cpu python tools/resident_parity.py
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -169,12 +169,21 @@ _ROW_INDEX = {n: i for i, n in enumerate(ROW_FIELDS)}
 
 def doc_column(doc: dict, name: str) -> list:
     """One logical column from a queue doc in ANY persisted format
-    (row-major 'rows', columnar 'cols', or legacy item-list 'queue')."""
+    (row-major 'rows', columnar 'cols', or legacy item-list 'queue'),
+    always in PLAN order. Docs carrying an ``order`` permutation keep
+    their rows in the id-sorted canonical layout (so churn persists are
+    row splices instead of full rewrites, scheduler/persister.py) and
+    this accessor applies the permutation."""
     rows = doc.get("rows")
     if rows is not None:
         if name in ("sort_value", "dependencies_met"):
-            return doc.get(name) or []
+            col = doc.get(name) or []
+            order = doc.get("order")
+            return [col[i] for i in order] if order is not None else col
         idx = _ROW_INDEX[name]
+        order = doc.get("order")
+        if order is not None:
+            return [rows[i][idx] for i in order]
         return [r[idx] for r in rows]
     cols = doc.get("cols")
     if cols is not None:
@@ -213,15 +222,22 @@ class TaskQueue:
             # row-major persist format (scheduler/persister.py): each row
             # is Task.queue_row() in ROW_FIELDS order; the two dynamic
             # columns ride separately.  Dependencies are copied — rows are
-            # memoized tuples shared across ticks.
+            # memoized tuples shared across ticks.  An ``order``
+            # permutation (canonical id-sorted row layout) maps row
+            # storage order back to plan order.
             sv = doc.get("sort_value") or [0.0] * len(rows)
             dm = doc.get("dependencies_met") or [True] * len(rows)
+            order = doc.get("order")
+            triples = (
+                ((rows[i], sv[i], dm[i]) for i in order)
+                if order is not None else zip(rows, sv, dm)
+            )
             queue = [
                 TaskQueueItem(
                     r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7], s,
                     r[8], r[9], r[10], r[11], r[12], list(r[13]), bool(m),
                 )
-                for r, s, m in zip(rows, sv, dm)
+                for r, s, m in triples
             ]
         elif cols is not None:
             # columnar persist format: one list per field — items are

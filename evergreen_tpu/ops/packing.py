@@ -30,6 +30,8 @@ class Arena:
         self._layout: Dict[str, Tuple[str, int, int]] = {}
         self._sizes = {"f32": 0, "i32": 0, "u8": 0}
         self._finalized = False
+        self._pool: "ArenaPool" = None
+        self._lease: "_ArenaLease" = None
 
     def alloc(self, name: str, size: int, kind: str) -> None:
         assert not self._finalized
@@ -39,13 +41,24 @@ class Arena:
 
     def finalize(self, pool: "ArenaPool" = None) -> None:
         if pool is not None:
-            self._bufs = pool.take(self._sizes)
+            self._lease = pool.take(self._sizes)
+            self._bufs = self._lease.bufs
+            self._pool = pool
         else:
             for kind, total in self._sizes.items():
                 self._bufs[kind] = np.zeros(
                     max(total, 1), dtype=_DTYPES[kind]
                 )
         self._finalized = True
+
+    def close(self) -> None:
+        """Return the leased buffer set to the pool. Idempotent; callers
+        wrap the tick in try/finally so fault paths (a raising solve, a
+        snapshot build that dies mid-fill) can never strand a slot."""
+        if self._pool is not None:
+            self._pool.give_back(self._lease)
+            self._pool = None
+            self._lease = None
 
     def view(self, name: str) -> np.ndarray:
         kind, off, size = self._layout[name]
@@ -60,16 +73,34 @@ class Arena:
         return tuple(self._plan)
 
 
+class _ArenaLease:
+    """One outstanding claim on a pooled buffer set. The lease OBJECT —
+    not the buffer dict — is the return token: after a forced rotation
+    the same dict is live under the thief's newer lease, so dict
+    identity cannot tell the victim's (now void) return from the
+    thief's legitimate one."""
+
+    __slots__ = ("key", "bufs", "revoked")
+
+    def __init__(self, key: Tuple, bufs: Dict[str, np.ndarray]) -> None:
+        self.key = key
+        self.bufs = bufs
+        self.revoked = False
+
+
 class ArenaPool:
-    """Double-buffered arena backing store.
+    """Double-buffered arena backing store with explicit leases.
 
     The pipelined tick keeps at most TWO snapshots in flight (the packer
     writes snapshot t+1 while the device still reads snapshot t's
-    buffers), so two rotating buffer sets per layout suffice — and
-    rotating them means the steady-state tick does one memset per buffer
-    instead of a fresh multi-MB allocation + page-fault walk. The caller
-    owns the pool (one per scheduler store, one per bench loop) and must
-    not keep more than ``depth`` pooled snapshots alive at once.
+    buffers), so two buffer sets per layout suffice — reusing them means
+    the steady-state tick does one memset per buffer instead of a fresh
+    multi-MB allocation + page-fault walk. ``take`` leases a free set and
+    ``Arena.close`` returns it; when no set is free (an exception path
+    abandoned a lease, or the caller really has >depth snapshots alive)
+    the oldest outstanding lease is forcibly rotated — counted in
+    ``forced_rotations`` so a leak shows up in telemetry instead of as
+    silent buffer corruption of an in-flight solve.
     """
 
     #: distinct layouts kept before the oldest is dropped (dim-bucket
@@ -78,32 +109,64 @@ class ArenaPool:
 
     def __init__(self, depth: int = 2) -> None:
         self.depth = depth
-        self._slots: Dict[Tuple, List[Dict[str, np.ndarray]]] = {}
-        self._next: Dict[Tuple, int] = {}
+        #: layout key → list of free buffer sets
+        self._free: Dict[Tuple, List[Dict[str, np.ndarray]]] = {}
+        #: layout key → outstanding leases (oldest first)
+        self._leased: Dict[Tuple, List[_ArenaLease]] = {}
+        self.forced_rotations = 0
 
-    def take(self, sizes: Dict[str, int]) -> Dict[str, np.ndarray]:
+    def _key_slots(self, key: Tuple):
+        if key not in self._free:
+            while len(self._free) >= self.MAX_LAYOUTS:
+                oldest = next(iter(self._free))
+                del self._free[oldest]
+                self._leased.pop(oldest, None)
+            self._free[key] = []
+            self._leased[key] = []
+        return self._free[key], self._leased[key]
+
+    def take(self, sizes: Dict[str, int]) -> _ArenaLease:
         key = tuple(sorted(sizes.items()))
-        slots = self._slots.get(key)
-        if slots is None:
-            while len(self._slots) >= self.MAX_LAYOUTS:
-                oldest = next(iter(self._slots))
-                del self._slots[oldest]
-                del self._next[oldest]
-            slots = self._slots[key] = []
-            self._next[key] = 0
-        i = self._next[key]
-        self._next[key] = (i + 1) % self.depth
-        if len(slots) < self.depth:
+        free, leased = self._key_slots(key)
+        if free:
+            bufs = free.pop()
+            for b in bufs.values():
+                b.fill(0)
+        elif len(leased) < self.depth:
             bufs = {
                 kind: np.zeros(max(total, 1), dtype=_DTYPES[kind])
                 for kind, total in sizes.items()
             }
-            slots.append(bufs)
-            return bufs
-        bufs = slots[i]
-        for b in bufs.values():
-            b.fill(0)
-        return bufs
+        else:
+            # every set is still leased: reclaim the oldest (pre-lease
+            # behavior) but make the anomaly visible. The victim lease
+            # is marked revoked so its eventual give_back is a no-op —
+            # the same dict is live again under the new lease.
+            victim = leased.pop(0)
+            victim.revoked = True
+            bufs = victim.bufs
+            self.forced_rotations += 1
+            from ..utils.log import incr_counter
+
+            incr_counter("arena.pool.forced_rotation")
+            for b in bufs.values():
+                b.fill(0)
+        lease = _ArenaLease(key, bufs)
+        leased.append(lease)
+        return lease
+
+    def give_back(self, lease: _ArenaLease) -> None:
+        if lease.revoked:
+            return  # forcibly reclaimed: the set is live elsewhere
+        leased = self._leased.get(lease.key)
+        if leased is None:
+            return  # layout was evicted while leased: drop the buffers
+        for i, l in enumerate(leased):
+            if l is lease:
+                del leased[i]
+                self._free[lease.key].append(lease.bufs)
+                return
+        # not found: dropped with an evicted-and-recreated layout
 
 
 def unpack(bufs: Dict, layout_key: Tuple) -> Dict:

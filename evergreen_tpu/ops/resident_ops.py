@@ -1,0 +1,159 @@
+"""Device-side delta application for the resident state plane.
+
+The resident plane (scheduler/resident.py) keeps the snapshot columns as
+persistent buffers across ticks. On the CPU backend the numpy truth
+arrays ARE the working set (XLA's CPU client zero-copy-aliases aligned
+host buffers, and compute shares the packer's cores), so publishing a
+tick is a straight memcpy into a double-buffered transfer arena. Over a
+tunnel-attached TPU the economics invert: shipping three multi-MB arena
+buffers per tick costs more than the solve, while a churn tick touches a
+few hundred rows. This module is that upload path: the device keeps the
+three arena buffers resident, and each tick ships only the CHANGED spans.
+Sparse churn spans are coalesced per dtype kind into one (indices, values)
+staging pair applied with a single jitted scatter; the per-tick time
+columns — which are legitimately whole-column dirty every tick because
+their refresh is host-side f64 by design (see FIELD_KINDS in
+scheduler/snapshot.py) — arrive as long contiguous runs and ship as
+value-only ``dynamic_update_slice`` updates, half the bytes of a scatter
+and no index vector. Per-tick transfer is therefore the refreshed time
+columns plus O(churn); the static majority of every buffer (flags, keys,
+settings, group structure) never re-ships.
+
+Enabled by ``EVERGREEN_TPU_RESIDENT_DEVICE=1`` (the plane auto-falls back
+to full host staging whenever the mirror errors); correctness is pinned
+on the CPU backend by tests/test_resident_state.py, which asserts a
+delta-applied mirror is bit-identical to a full upload.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@functools.cache
+def _scatter_fn():
+    """One coalesced delta application: ``buf[idx] = vals``. The input
+    buffer is donated so the update is in place on backends that support
+    aliasing; indices are pre-deduplicated host-side (duplicate indices
+    in an XLA scatter-set are implementation-defined). Built lazily so
+    importing this module never drags jax in."""
+    import jax
+
+    return jax.jit(
+        lambda buf, idx, vals: buf.at[idx].set(vals), donate_argnums=(0,)
+    )
+
+
+def _scatter_rows(buf, idx, vals):
+    return _scatter_fn()(buf, idx, vals)
+
+
+@functools.cache
+def _slice_fn():
+    """Contiguous-run application: ``buf[lo:lo+len(vals)] = vals`` with
+    a traced offset, so one compilation serves a column at any position.
+    Donated like the scatter for in-place update where supported."""
+    import jax
+
+    return jax.jit(
+        lambda buf, vals, lo: jax.lax.dynamic_update_slice(buf, vals, (lo,)),
+        donate_argnums=(0,),
+    )
+
+
+#: a merged dirty run at least this long ships as a value-only slice
+#: update instead of joining the scatter's index vector — below it the
+#: extra dispatch costs more than the ~2x transfer saving
+SLICE_RUN_MIN = 64
+
+
+def merge_spans(spans: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Sort and merge overlapping/adjacent ``[lo, hi)`` spans."""
+    merged: List[Tuple[int, int]] = []
+    for lo, hi in sorted(spans):
+        if hi <= lo:
+            continue
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def coalesce_spans(
+    spans: List[Tuple[int, int]], total: int
+) -> Optional[np.ndarray]:
+    """Merge dirty ``[lo, hi)`` spans into one sorted, deduplicated index
+    vector. Returns None when the spans cover so much of the buffer that
+    a full upload is cheaper (> half) — the caller then re-uploads."""
+    if not spans:
+        return np.empty(0, np.int32)
+    covered = sum(hi - lo for lo, hi in spans)
+    if covered * 2 >= total:
+        return None
+    parts = [np.arange(lo, hi, dtype=np.int32) for lo, hi in spans if hi > lo]
+    if not parts:
+        return np.empty(0, np.int32)
+    idx = np.concatenate(parts)
+    return np.unique(idx)
+
+
+class DeviceMirror:
+    """Persistent device copies of the three typed arena buffers.
+
+    ``sync(truth, spans)`` returns the device buffer dict to feed the
+    packed solve: a full ``device_put`` when the mirror is cold, the
+    layout changed, or ``spans`` is None (a rebuild tick); otherwise
+    long dirty runs (≥ ``SLICE_RUN_MIN``) ship as slice updates and the
+    sparse remainder as one scatter per kind."""
+
+    def __init__(self) -> None:
+        self._bufs = None  # kind -> jax.Array
+        self._shapes: Dict[str, int] = {}
+        #: telemetry: rows shipped as scatters / slice runs / full uploads
+        self.delta_rows = 0
+        self.slice_rows = 0
+        self.full_uploads = 0
+
+    def reset(self) -> None:
+        self._bufs = None
+        self._shapes = {}
+
+    def sync(
+        self,
+        truth: Dict[str, np.ndarray],
+        spans_by_kind: Optional[Dict[str, List[Tuple[int, int]]]],
+    ) -> Dict[str, object]:
+        import jax
+
+        shapes = {k: len(v) for k, v in truth.items()}
+        if (
+            self._bufs is None
+            or shapes != self._shapes
+            or spans_by_kind is None
+        ):
+            self._bufs = {k: jax.device_put(v) for k, v in truth.items()}
+            self._shapes = shapes
+            self.full_uploads += 1
+            return self._bufs
+        out = {}
+        for kind, buf in self._bufs.items():
+            merged = merge_spans(spans_by_kind.get(kind, []))
+            runs = [r for r in merged if r[1] - r[0] >= SLICE_RUN_MIN]
+            sparse = [r for r in merged if r[1] - r[0] < SLICE_RUN_MIN]
+            idx = coalesce_spans(sparse, shapes[kind])
+            if idx is None:  # sparse part alone dirtied too much
+                out[kind] = jax.device_put(truth[kind])
+                self.full_uploads += 1
+                continue
+            for lo, hi in runs:
+                buf = _slice_fn()(buf, truth[kind][lo:hi], lo)
+                self.slice_rows += hi - lo
+            if len(idx):
+                buf = _scatter_rows(buf, idx, truth[kind][idx])
+                self.delta_rows += int(len(idx))
+            out[kind] = buf
+        self._bufs = out
+        return out

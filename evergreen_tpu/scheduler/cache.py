@@ -68,6 +68,33 @@ class TickCache:
         self._hosts_primed = False
         self._active_hosts: Dict[str, Host] = {}
         host_mod.coll(store).add_listener(self._on_host_change)
+        #: cached Distro views (find_needs_hosts_planning order +
+        #: needs_planning id set): distro docs churn rarely, and STABLE
+        #: Distro object identity across ticks is what the resident state
+        #: plane keys its settings-change detection on
+        self._distros_dirty = True
+        self._distro_view_cache = None
+        from ..models import distro as distro_mod
+
+        distro_mod.coll(store).add_listener(self._on_distro_change)
+        store.collection("config").add_listener(self._on_distro_change)
+        #: ---- resident-state-plane delta stream --------------------------- #
+        #: generation stamp bumped on every cold (re)prime — a consumer
+        #: holding state from an older generation has a delta-stream gap
+        #: and must full-rebuild
+        self._prime_gen = 0
+        #: task ids whose deps-met flag was recomputed since last drain
+        self._dm_dirty: Set[str] = set()
+        #: host ids whose doc (or whose running task's doc) churned
+        self._res_hosts_dirty: Set[str] = set()
+        #: running-task ↔ host index so a task-doc change invalidates the
+        #: host row that derives its running estimate from it
+        self._host_of_task: Dict[str, str] = {}
+        self._task_of_host: Dict[str, str] = {}
+        #: per-distro ids that may still need a scheduled_time /
+        #: dependencies_met_time stamp (the persister's candidate scan
+        #: collapses to these instead of walking the whole plan)
+        self._unstamped: Dict[str, Set[str]] = {}
 
     # Runs under the collection lock; touch only the leaf dirty lock.
     def _on_task_change(self, task_id: str) -> None:
@@ -78,6 +105,10 @@ class TickCache:
     def _on_host_change(self, host_id: str) -> None:
         with self._dirty_lock:
             self._hosts_dirty.add(host_id)
+
+    # Runs under the collection lock; a bare flag needs no lock at all.
+    def _on_distro_change(self, _id: str) -> None:
+        self._distros_dirty = True
 
     def _qualifies(self, doc: Optional[dict]) -> bool:
         if doc is None:
@@ -129,6 +160,21 @@ class TickCache:
                          in_snapshot=self._runnable.keys())
         )
 
+    def _note_stamp_state(self, t: Task) -> None:
+        """Track whether ``t`` may still need a scheduled/deps-met stamp."""
+        s = self._unstamped.get(t.distro_id)
+        if t.scheduled_time <= 0.0 or t.dependencies_met_time <= 0.0:
+            if s is None:
+                s = self._unstamped[t.distro_id] = set()
+            s.add(t.id)
+        elif s is not None:
+            s.discard(t.id)
+
+    def _drop_stamp_state(self, t: Task) -> None:
+        s = self._unstamped.get(t.distro_id)
+        if s is not None:
+            s.discard(t.id)
+
     def apply_dirty(self) -> int:
         """Fold pending changes into the runnable map; returns changes."""
         with self._lock:
@@ -146,11 +192,16 @@ class TickCache:
                 self._deps_met.clear()
                 self._dep_edges.clear()
                 self._dependents.clear()
+                self._unstamped = {}
                 for t in self._runnable.values():
                     self._reindex_deps(t)
+                    self._note_stamp_state(t)
                 self._recompute_deps_met(list(self._runnable))
                 self._rebuild_distro_lists_from_sorted()
                 self._primed = True
+                # a cold (re)prime breaks any consumer's delta stream
+                self._prime_gen += 1
+                self._dm_dirty.clear()
                 return len(self._runnable)
             with self._dirty_lock:
                 dirty, self._dirty = self._dirty, set()
@@ -173,6 +224,11 @@ class TickCache:
             for tid in dirty:
                 doc = coll.get(tid)
                 old = self._runnable.get(tid)
+                # a churned task that is RUNNING on a host invalidates the
+                # host row deriving its duration estimate from the doc
+                hid = self._host_of_task.get(tid)
+                if hid is not None:
+                    self._res_hosts_dirty.add(hid)
                 if self._qualifies(doc):
                     t = Task.from_doc(doc)
                     rank = order.get(tid, 1 << 60)
@@ -180,6 +236,8 @@ class TickCache:
                         gone.add(tid)  # replaced instance leaves _sorted
                         dirty_primary.add(old.distro_id)
                         dirty_alias.update(old.secondary_distros)
+                        if old.distro_id != t.distro_id:
+                            self._drop_stamp_state(old)
                     self._runnable[tid] = t
                     fresh.append((rank, t))
                     dirty_primary.add(t.distro_id)
@@ -191,6 +249,7 @@ class TickCache:
                             dirty_alias.add(sd)
                             fresh_alias.setdefault(sd, []).append((rank, t))
                     self._reindex_deps(t)
+                    self._note_stamp_state(t)
                     affected.add(tid)
                     n += 1
                 elif old is not None:
@@ -199,6 +258,7 @@ class TickCache:
                     dirty_primary.add(old.distro_id)
                     dirty_alias.update(old.secondary_distros)
                     self._drop_dep_index(tid)
+                    self._drop_stamp_state(old)
                     n += 1
             if gone or fresh:
                 self._sorted_stale = True
@@ -210,7 +270,9 @@ class TickCache:
                 dirty_alias, fresh_alias, gone,
                 self._alias_entries, self._alias_lists,
             )
-            self._recompute_deps_met(affected & self._runnable.keys())
+            live_affected = affected & self._runnable.keys()
+            self._recompute_deps_met(live_affected)
+            self._dm_dirty |= live_affected
             # tripwire: the deps-met map must track the runnable set
             # KEY-FOR-KEY (the gather passthrough depends on it, and the
             # snapshot fill defaults a missing id to met) — compare key
@@ -226,6 +288,7 @@ class TickCache:
                     k for k in self._runnable if k not in self._deps_met
                 ]
                 self._recompute_deps_met(missing)
+                self._dm_dirty.update(missing)
             return n
 
     def _rebuild_distro_lists_from_sorted(self) -> None:
@@ -277,6 +340,16 @@ class TickCache:
     def _host_qualifies(self, doc: Optional[dict]) -> bool:
         return doc is not None and is_active_host_doc(doc)
 
+    def _index_running_task(self, hid: str, running: str) -> None:
+        old = self._task_of_host.get(hid)
+        if old is not None and old != running:
+            self._host_of_task.pop(old, None)
+        if running:
+            self._task_of_host[hid] = running
+            self._host_of_task[running] = hid
+        else:
+            self._task_of_host.pop(hid, None)
+
     def apply_hosts_dirty(self) -> int:
         """Fold pending host changes into the active-host map."""
         with self._lock:
@@ -286,7 +359,14 @@ class TickCache:
                 self._active_hosts = {
                     h.id: h for h in host_mod.all_active_hosts(self.store)
                 }
+                self._host_of_task.clear()
+                self._task_of_host.clear()
+                for h in self._active_hosts.values():
+                    if h.running_task:
+                        self._index_running_task(h.id, h.running_task)
                 self._hosts_primed = True
+                self._prime_gen += 1
+                self._res_hosts_dirty.clear()
                 return len(self._active_hosts)
             with self._dirty_lock:
                 dirty = self._hosts_dirty
@@ -294,12 +374,16 @@ class TickCache:
             coll = host_mod.coll(self.store)
             n = 0
             for hid in dirty:
+                self._res_hosts_dirty.add(hid)
                 doc = coll.get(hid)
                 if self._host_qualifies(doc):
-                    self._active_hosts[hid] = Host.from_doc(doc)
+                    h = Host.from_doc(doc)
+                    self._active_hosts[hid] = h
+                    self._index_running_task(hid, h.running_task)
                     n += 1
                 elif hid in self._active_hosts:
                     del self._active_hosts[hid]
+                    self._index_running_task(hid, "")
                     n += 1
             return n
 
@@ -327,6 +411,44 @@ class TickCache:
                 self._sorted_stale = False
             return [t for _, t in self._sorted]
 
+    def distro_view(self) -> Tuple[List, Set[str]]:
+        """Cached (find_needs_hosts_planning list, needs_planning id set).
+        Distro docs churn rarely; between changes both the LIST object and
+        the Distro instances keep their identity — which is what the
+        resident state plane's settings-change detection keys on."""
+        from ..models import distro as distro_mod
+
+        with self._lock:
+            if self._distros_dirty or self._distro_view_cache is None:
+                # clear the flag BEFORE the read: a concurrent write that
+                # lands mid-find re-dirties and we recompute next tick
+                self._distros_dirty = False
+                self._distro_view_cache = (
+                    distro_mod.find_needs_hosts_planning(self.store),
+                    {d.id for d in distro_mod.find_needs_planning(self.store)},
+                )
+            return self._distro_view_cache
+
+    def drain_resident_deltas(self) -> Tuple[int, Set[str], Set[str]]:
+        """Hand the resident state plane everything that changed since the
+        last drain: ``(prime_generation, deps-met-dirty ids, host-dirty
+        ids)``. Sets accumulate across ticks that skip the resident path
+        (serial fallback, breaker-open), so a drain is always complete; a
+        prime-generation bump is the one true delta-stream gap."""
+        with self._lock:
+            dm, self._dm_dirty = self._dm_dirty, set()
+            hs, self._res_hosts_dirty = self._res_hosts_dirty, set()
+            return self._prime_gen, dm, hs
+
+    def stamp_candidates(self, distro_id: str):
+        """Ids in this distro's runnable set that may still need a
+        scheduled/deps-met stamp (None before priming: caller must scan)."""
+        if not self._primed:
+            return None
+        with self._lock:
+            s = self._unstamped.get(distro_id)
+            return frozenset(s) if s else frozenset()
+
     def gather(self, now: float) -> Tuple:
         """Same contract as scheduler.wrapper.gather_tick_inputs, served
         from the warm per-distro views: no 50k flatten/split loop, no
@@ -335,6 +457,7 @@ class TickCache:
         from .wrapper import gather_tick_inputs
 
         self.apply_dirty()
+        distros, planning_ids = self.distro_view()
         return gather_tick_inputs(
             self.store,
             now,
@@ -342,8 +465,15 @@ class TickCache:
             deps_met=self._deps_met,
             by_distro=self._distro_lists,
             alias_by_distro=self._alias_lists,
+            distro_view=(distros, planning_ids),
         )
 
     def runnable_count(self) -> int:
         with self._lock:
             return len(self._runnable)
+
+    def runnable_task(self, task_id: str):
+        """The materialized runnable Task for an id, or None (resident
+        state plane: resolve a deps-met-dirty id to its distro rows)."""
+        with self._lock:
+            return self._runnable.get(task_id)

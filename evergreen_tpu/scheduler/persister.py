@@ -34,6 +34,8 @@ import threading
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
+import numpy as np
+
 from ..models import task as task_mod
 from ..models.task import Task
 from ..models.task_queue import DistroQueueInfo, QueueInfoView
@@ -45,14 +47,23 @@ _ALIAS_SUFFIX = "::alias"
 
 
 class _Fingerprint:
-    __slots__ = ("plan", "rows", "sort", "met", "info_key", "doc", "v",
-                 "cand")
+    __slots__ = ("plan", "rows_plan", "rows", "row_index", "order",
+                 "order_np", "sort", "met", "info_key", "doc", "v", "cand")
 
     def __init__(self) -> None:
         self.plan: List[Task] = []
+        #: row tuples in PLAN order (identity-compared against next tick)
+        self.rows_plan: list = []
+        #: row tuples in the doc's canonical id-sorted order
         self.rows: list = []
-        self.sort: list = []
-        self.met: list = []
+        #: task id -> index into the sorted rows
+        self.row_index: Dict[str, int] = {}
+        #: plan position -> sorted row index (the doc's ``order`` field)
+        self.order: list = []
+        self.order_np = None
+        #: dynamic columns ALIGNED WITH THE SORTED ROWS (numpy)
+        self.sort = None
+        self.met = None
         self.info_key = None
         self.doc: Optional[dict] = None
         self.v = -1
@@ -72,6 +83,9 @@ class PersisterState:
         self.skipped = 0
         self.patched = 0
         self.rewritten = 0
+        #: row-level splices (membership/order churn persisted as a
+        #: delta instead of a full rewrite) — a "patch" in spirit
+        self.spliced = 0
         #: current + previous tick's solve info columns, the global
         #: "nothing in any distro's info changed" verdict, and both
         #: ticks' distro/segment index maps (for the per-distro fallback
@@ -184,6 +198,25 @@ def persister_state_for(store: Store) -> PersisterState:
         return entry[1]
 
 
+def _plan_col(values, rows_plan, default, dtype) -> "np.ndarray":
+    """Dynamic column in PLAN order as numpy: id-keyed dict (serial/cmp
+    paths) or a positionally aligned sequence (the solve's unpack)."""
+    n = len(rows_plan)
+    if isinstance(values, dict):
+        return np.asarray(
+            [values.get(r[0], default) for r in rows_plan], dtype
+        )
+    arr = np.asarray(values[:n], dtype)
+    if len(arr) < n:
+        arr = np.concatenate(
+            [arr, np.full(n - len(arr), default, dtype)]
+        )
+    return arr
+
+
+_ROW_ID = _operator.itemgetter(0)
+
+
 def persist_task_queue(
     store: Store,
     distro_id: str,
@@ -195,17 +228,37 @@ def persist_task_queue(
     secondary: bool = False,
     now: Optional[float] = None,
     state: Optional[PersisterState] = None,
+    stamp_hint=None,
 ) -> int:
     """Persist the plan; returns the number of queue items written.
 
     ``sort_values`` and ``deps_met`` are either id-keyed mappings
     (serial/cmp paths) or sequences positionally aligned with ``plan``
     (the batched solve's unpack, which avoids materializing 50k-entry
-    dicts every tick). Passing ``state`` enables delta persistence."""
+    dicts every tick). Passing ``state`` enables delta persistence.
+
+    The doc's canonical layout keeps ``rows`` (and the two dynamic
+    columns) sorted by task id with an ``order`` permutation back into
+    plan order — stateless, so a resumed delta run and a cold rerun
+    write byte-identical docs, and a churn tick's membership/reorder
+    changes persist as a row SPLICE + column patch instead of a full
+    rewrite. Write shapes per distro per tick:
+
+      * skip          — nothing changed, no write at all
+      * column patch  — same rows, changed dynamics: sparse element
+                        patch (few changed entries) or whole-field patch
+      * row splice    — plan membership/order changed: removals, inserts
+                        and changed rows journal as a delta (op "qs")
+      * full rewrite  — no usable fingerprint (or the delta would exceed
+                        half the doc): the classic whole-doc upsert
+
+    ``stamp_hint`` (the TickCache's per-distro unstamped id set) lets the
+    mark-scheduled candidate scan collapse to the handful of fresh tasks.
+    """
     now = _time.time() if now is None else now
-    n = len(plan)
+    n_full = len(plan)
     cut = _cap_cut(plan, max_scheduled_per_distro)
-    if cut < n:
+    if cut < n_full:
         plan = plan[:cut]
 
     c = _coll(store, secondary)
@@ -223,9 +276,12 @@ def persist_task_queue(
 
     # Row-major persist: each row IS Task.queue_row()'s memoized tuple
     # (models/task_queue.py ROW_FIELDS); an unchanged plan reuses the
-    # whole rows list from the fingerprint — zero per-task work.
-    rows = fp.rows if same_plan else [t.queue_row() for t in plan]
-    if not same_plan and fp is not None and rows == fp.rows:
+    # whole plan-order rows list from the fingerprint — zero per-task
+    # work.
+    rows_plan = (
+        fp.rows_plan if same_plan else [t.queue_row() for t in plan]
+    )
+    if not same_plan and fp is not None and rows_plan == fp.rows_plan:
         # instances were replaced but every queue row is content-identical
         # (the common shape right after mark_scheduled stamps dirty the
         # docs): the doc's rows need no write — adopt the new instances
@@ -233,18 +289,11 @@ def persist_task_queue(
         same_plan = True
         fp.plan = plan
         fp.cand = None  # task attributes may have moved — rescan below
-        rows = fp.rows
-    n_rows = len(rows)
-    if isinstance(sort_values, dict):
-        sort_col = [sort_values.get(r[0], 0.0) for r in rows]
-    else:
-        sort_col = list(sort_values[:n_rows])
-        sort_col += [0.0] * (n_rows - len(sort_col))
-    if isinstance(deps_met, dict):
-        met_col = [deps_met.get(r[0], True) for r in rows]
-    else:
-        met_col = list(deps_met[:n_rows])
-        met_col += [True] * (n_rows - len(met_col))
+        rows_plan = fp.rows_plan
+    n_rows = len(rows_plan)
+
+    sort_plan = _plan_col(sort_values, rows_plan, 0.0, np.float64)
+    met_plan = _plan_col(deps_met, rows_plan, True, np.bool_)
 
     is_view = isinstance(info, QueueInfoView)
     # "is the info unchanged?": the view path asks the whole-tick epoch
@@ -260,77 +309,111 @@ def persist_task_queue(
         info_doc_dc = _info_doc(info)
         info_static = fp is not None and info_doc_dc == fp.info_key
 
-    #: met column unchanged ⇒ the mark-scheduled candidate set is too
-    same_met = same_plan and met_col == fp.met
+    same_met = False
+    handled = False
 
-    if same_plan and info_static and same_met and sort_col == fp.sort:
-        # untouched distro: nothing to write, nothing to journal
-        if state is not None:
-            state.skipped += 1
-    elif same_plan:
-        # only dynamic columns moved: versioned patch of JUST the changed
-        # fields — the WAL carries the patch (plus its expected base
-        # version), never the 50k rows
-        new_v = fp.v + 1
-        fields = {"generated_at": now, "v": new_v}
-        if sort_col != fp.sort:
-            fields["sort_value"] = sort_col
-        if not same_met:
-            fields["dependencies_met"] = met_col
-        if not info_static:
-            fields["info"] = info.doc() if is_view else info_doc_dc
-        patched = c.patch(distro_id, fields)
-        if patched:
-            fp.sort = sort_col
-            fp.met = met_col
-            if not info_static:
-                fp.info_key = None if is_view else info_doc_dc
-            fp.v = new_v
+    if same_plan:
+        # project the plan-order columns into the doc's sorted alignment
+        sort_sorted = np.empty(n_rows, np.float64)
+        met_sorted = np.empty(n_rows, np.bool_)
+        if n_rows:
+            sort_sorted[fp.order_np] = sort_plan
+            met_sorted[fp.order_np] = met_plan
+        sort_changed = not np.array_equal(sort_sorted, fp.sort)
+        met_changed = not np.array_equal(met_sorted, fp.met)
+        same_met = not met_changed
+        if not sort_changed and not met_changed and info_static:
+            # untouched distro: nothing to write, nothing to journal
             if state is not None:
-                state.patched += 1
-        else:  # doc vanished between the identity check and the patch
-            same_plan = False
-    if not same_plan:
-        info_doc = info.doc() if is_view else info_doc_dc
-        live_v = fp.v if fp is not None else _live_version(c, distro_id)
-        new_v = live_v + 1
-        doc = {
-            "_id": distro_id,
-            "distro_id": distro_id,
-            "rows": rows,
-            "sort_value": sort_col,
-            "dependencies_met": met_col,
-            "info": info_doc,
-            "generated_at": now,
-            "v": new_v,
-        }
-        c.upsert(doc)
-        if state is not None:
-            fp = state._fps.get(key)
-            if fp is None:
-                fp = state._fps[key] = _Fingerprint()
-            fp.plan = plan
-            fp.rows = rows
-            fp.sort = sort_col
-            fp.met = met_col
-            fp.info_key = None if is_view else info_doc
-            fp.doc = doc
-            fp.v = new_v
-            fp.cand = None
-            state.rewritten += 1
+                state.skipped += 1
+            handled = True
+        else:
+            # only dynamic columns moved: a versioned patch of JUST the
+            # changed fields — sparse when few entries moved, so the WAL
+            # scales with churn, never with queue size
+            new_v = fp.v + 1
+            fields = {"generated_at": now, "v": new_v}
+            if not info_static:
+                fields["info"] = info.doc() if is_view else info_doc_dc
+            elems = {}
+            for name, changed, new_col, old_col, cast in (
+                ("sort_value", sort_changed, sort_sorted, fp.sort, float),
+                ("dependencies_met", met_changed, met_sorted, fp.met,
+                 bool),
+            ):
+                if not changed:
+                    continue
+                diff = np.flatnonzero(new_col != old_col)
+                if len(diff) * 3 < n_rows:
+                    elems[name] = (
+                        [int(i) for i in diff],
+                        [cast(new_col[i]) for i in diff],
+                    )
+                else:
+                    fields[name] = new_col.tolist()
+            ok = (
+                c.patch_list(distro_id, elems, fields)
+                if elems else c.patch(distro_id, fields)
+            )
+            if ok:
+                fp.sort = sort_sorted
+                fp.met = met_sorted
+                if not info_static:
+                    fp.info_key = None if is_view else info_doc_dc
+                fp.v = new_v
+                if state is not None:
+                    state.patched += 1
+                handled = True
+            else:  # doc vanished/diverged between check and patch
+                fp = None
+                same_met = False
+
+    if not handled and fp is not None and n_rows:
+        handled = _persist_splice(
+            c, distro_id, fp, plan, rows_plan, sort_plan, met_plan,
+            info, is_view, info_doc_dc, info_static, now, state,
+        )
+
+    if not handled:
+        _persist_rewrite(
+            c, distro_id, key, plan, rows_plan, sort_plan, met_plan,
+            info, is_view, info_doc_dc, now, state, fp,
+        )
 
     # Candidate pre-filter on the materialized Task attributes: in steady
     # state every planned task is already stamped, so the per-task store
-    # get() round (50k/tick at config-3 scale) collapses to zero — and
-    # the scan itself is skipped whenever plan instances AND the deps-met
-    # column are unchanged (the two inputs it reads), reusing last tick's
-    # candidate set. mark_scheduled re-checks live docs before mutating.
-    if fp is not None and same_met and fp.cand is not None:
+    # get() round (50k/tick at config-3 scale) collapses to zero. The
+    # TickCache's ``stamp_hint`` set short-circuits even the scan; with
+    # no hint, the scan is skipped whenever plan instances AND the
+    # deps-met column are unchanged (the two inputs it reads), reusing
+    # last tick's candidates. mark_scheduled re-checks live docs before
+    # mutating, so a stale candidate is harmless.
+    fp = state._fps.get(key) if state is not None else fp
+    if stamp_hint is not None and cut >= n_full and not stamp_hint:
+        cand = []
+    elif (
+        stamp_hint is not None and fp is not None
+        and fp.row_index is not None
+    ):
+        # scan ONLY the hinted ids: met rides in the fingerprint's
+        # id-sorted column, membership in row_index doubles as the
+        # post-cut plan filter, and mark_scheduled re-checks live docs
+        # so over-inclusion is harmless (sorted for deterministic
+        # journal records)
+        idx, met_col = fp.row_index, fp.met
+        cand = [
+            (tid, bool(met_col[i]))
+            for tid in sorted(stamp_hint)
+            for i in (idx.get(tid),)
+            if i is not None
+        ]
+    elif fp is not None and same_met and fp.cand is not None:
         cand = fp.cand
     else:
+        met_list = met_plan.tolist()
         cand = [
             (t.id, met)
-            for t, met in zip(plan, met_col)
+            for t, met in zip(plan, met_list)
             if t.scheduled_time <= 0.0
             or (met and t.dependencies_met_time <= 0.0)
         ]
@@ -342,6 +425,180 @@ def persist_task_queue(
             deps_met_ids=[tid for tid, met in cand if met],
         )
     return len(plan)
+
+
+def _sorted_layout(rows_plan: list):
+    """Canonical id-sorted layout for plan-order rows: (sorted rows,
+    id → sorted index, plan-position → sorted-index order list). Returns
+    None when ids are not unique (legacy plan-order layout then)."""
+    n = len(rows_plan)
+    rows_sorted = sorted(rows_plan, key=_ROW_ID)
+    index = {r[0]: i for i, r in enumerate(rows_sorted)}
+    if len(index) != n:
+        return None
+    order = [index[r[0]] for r in rows_plan]
+    return rows_sorted, index, order
+
+
+def _persist_splice(
+    c, distro_id, fp, plan, rows_plan, sort_plan, met_plan, info,
+    is_view, info_doc_dc, info_static, now, state,
+) -> bool:
+    """Plan membership/order changed but a fingerprint exists: persist
+    the change as a row splice + sparse column patch. Returns False when
+    a full rewrite is the better (or only sound) shape.
+
+    Known bound: the ``order`` permutation is journaled whole (O(n) ints
+    per splice) — any membership change shifts most plan positions, and
+    replay has no plan knowledge to reconstruct it from the row delta.
+    Docs are per-distro (hundreds to low thousands of rows), so the
+    permutation stays far below the row payload a full rewrite would
+    carry; a delta encoding would only matter if single queue docs grew
+    to the whole-fleet scale the distro sharding exists to prevent."""
+    if fp.doc is None or "order" not in fp.doc:
+        return False  # legacy plan-order doc (duplicate ids): rewrite
+    layout = _sorted_layout(rows_plan)
+    if layout is None:
+        return False
+    rows_sorted, index, order = layout
+    n_rows = len(rows_plan)
+    old_rows, old_index = fp.rows, fp.row_index
+    rm_idx = [
+        i for i, r in enumerate(old_rows) if r[0] not in index
+    ]
+    order_np = np.asarray(order, np.int64)
+    sort_sorted = np.empty(n_rows, np.float64)
+    met_sorted = np.empty(n_rows, np.bool_)
+    sort_sorted[order_np] = sort_plan
+    met_sorted[order_np] = met_plan
+
+    inserts = []
+    row_elem_idx: List[int] = []
+    row_elem_val: list = []
+    surv_i: List[int] = []
+    surv_j: List[int] = []
+    for i, r in enumerate(rows_sorted):
+        j = old_index.get(r[0])
+        if j is None:
+            inserts.append(
+                (i, r, float(sort_sorted[i]), bool(met_sorted[i]))
+            )
+        else:
+            old_r = old_rows[j]
+            if r is not old_r and r != old_r:
+                row_elem_idx.append(i)
+                row_elem_val.append(r)
+            surv_i.append(i)
+            surv_j.append(j)
+    # survivors keep their (possibly stale) dynamic values through the
+    # splice; anything differing afterwards rides as a sparse patch
+    # (gathered as ONE fancy-indexed copy — per-element numpy scalar
+    # stores measured ~40% of the splice cost at 50k-task scale)
+    exp_sort = sort_sorted.copy()
+    exp_met = met_sorted.copy()
+    if surv_i:
+        si = np.asarray(surv_i, np.int64)
+        sj = np.asarray(surv_j, np.int64)
+        exp_sort[si] = fp.sort[sj]
+        exp_met[si] = fp.met[sj]
+    work = len(rm_idx) + len(inserts) + len(row_elem_idx)
+    if work * 2 > max(n_rows, 1):
+        return False  # the delta IS the doc: a rewrite journals less
+
+    new_v = fp.v + 1
+    fields = {"order": order, "generated_at": now, "v": new_v}
+    if not info_static:
+        fields["info"] = info.doc() if is_view else info_doc_dc
+    elems = {}
+    if row_elem_idx:
+        elems["rows"] = (row_elem_idx, row_elem_val)
+    diff = np.flatnonzero(sort_sorted != exp_sort)
+    if len(diff):
+        elems["sort_value"] = (
+            [int(i) for i in diff], [float(sort_sorted[i]) for i in diff]
+        )
+    diff = np.flatnonzero(met_sorted != exp_met)
+    if len(diff):
+        elems["dependencies_met"] = (
+            [int(i) for i in diff], [bool(met_sorted[i]) for i in diff]
+        )
+    if not c.splice_queue(distro_id, rm_idx, inserts, fields, elems or None):
+        return False
+    fp.plan = plan
+    fp.rows_plan = rows_plan
+    fp.rows = rows_sorted
+    fp.row_index = index
+    fp.order = order
+    fp.order_np = order_np
+    fp.sort = sort_sorted
+    fp.met = met_sorted
+    if not info_static:
+        fp.info_key = None if is_view else info_doc_dc
+    fp.v = new_v
+    fp.cand = None
+    if state is not None:
+        if rm_idx or inserts or row_elem_idx:
+            state.spliced += 1
+        else:
+            state.patched += 1
+    return True
+
+
+def _persist_rewrite(
+    c, distro_id, key, plan, rows_plan, sort_plan, met_plan, info,
+    is_view, info_doc_dc, now, state, fp,
+) -> None:
+    info_doc = info.doc() if is_view else info_doc_dc
+    layout = _sorted_layout(rows_plan)
+    n_rows = len(rows_plan)
+    if layout is None:
+        # duplicate ids: keep the legacy plan-order layout (no ``order``)
+        rows_sorted, index = rows_plan, None
+        order = list(range(n_rows))
+        sort_sorted, met_sorted = sort_plan, met_plan
+    else:
+        rows_sorted, index, order = layout
+        order_np = np.asarray(order, np.int64)
+        sort_sorted = np.empty(n_rows, np.float64)
+        met_sorted = np.empty(n_rows, np.bool_)
+        if n_rows:
+            sort_sorted[order_np] = sort_plan
+            met_sorted[order_np] = met_plan
+    live_v = fp.v if fp is not None else _live_version(c, distro_id)
+    new_v = live_v + 1
+    doc = {
+        "_id": distro_id,
+        "distro_id": distro_id,
+        "rows": rows_sorted,
+        "sort_value": sort_sorted.tolist(),
+        "dependencies_met": met_sorted.tolist(),
+        "info": info_doc,
+        "generated_at": now,
+        "v": new_v,
+    }
+    if layout is not None:
+        doc["order"] = order
+    c.upsert(doc)
+    if state is not None:
+        fp = state._fps.get(key)
+        if fp is None:
+            fp = state._fps[key] = _Fingerprint()
+        fp.plan = plan
+        fp.rows_plan = rows_plan
+        fp.rows = rows_sorted
+        fp.row_index = (
+            index if index is not None
+            else {r[0]: i for i, r in enumerate(rows_plan)}
+        )
+        fp.order = order
+        fp.order_np = np.asarray(order, np.int64)
+        fp.sort = np.asarray(sort_sorted, np.float64)
+        fp.met = np.asarray(met_sorted, np.bool_)
+        fp.info_key = None if is_view else info_doc
+        fp.doc = doc
+        fp.v = new_v
+        fp.cand = None
+        state.rewritten += 1
 
 
 def _live_version(c, distro_id: str) -> int:

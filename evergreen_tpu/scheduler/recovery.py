@@ -220,10 +220,17 @@ def run_recovery_pass(
 
     # step 4: the dead process's delta-persist memory is gone; make the
     # invalidation explicit so an in-process failover (tests, embedded
-    # standby) full-rewrites too instead of patching a stale base
+    # standby) full-rewrites too instead of patching a stale base. The
+    # resident state plane's columns are derived state of the SAME kind
+    # — recovery's reconciliation writes bypass its delta stream only in
+    # part, so it is dropped wholesale and rebuilds on the first tick.
     from .persister import persister_state_for
+    from .resident import peek_resident_plane
 
     persister_state_for(store).reset()
+    plane = peek_resident_plane(store)
+    if plane is not None:
+        plane.invalidate("recovery")
 
     if report.reconciled_tasks:
         incr_counter("recovery.reconciled_tasks", report.reconciled_tasks)

@@ -328,6 +328,10 @@ class RunningTaskEstimate:
     elapsed_s: float
     expected_s: float
     std_dev_s: float
+    #: absolute start time when known (0 = unknown): lets the resident
+    #: state plane re-derive elapsed_s at a later ``now`` exactly instead
+    #: of integrating from a stale elapsed sample
+    start_s: float = 0.0
 
 
 @dataclasses.dataclass

@@ -352,6 +352,46 @@ def _factor(v: float) -> float:
     return float(v) if v > 0 else 1.0
 
 
+def pack_distro_settings(a: Dict[str, np.ndarray], distros) -> None:
+    """Fill the per-distro settings columns (everything derived from the
+    Distro document, NOT from this tick's tasks/hosts) into the first
+    ``len(distros)`` rows of the ``d_*`` arrays. The one shared fill for
+    the cold snapshot build and the resident state plane's
+    settings-change maintenance."""
+    n_d = len(distros)
+    if not n_d:
+        return
+    ps_l = [d.planner_settings for d in distros]
+    hs_l = [d.host_allocator_settings for d in distros]
+
+    def fill(name, values):
+        a[name][:n_d] = values
+
+    fill("d_min_hosts", [h.minimum_hosts for h in hs_l])
+    fill("d_max_hosts", [h.maximum_hosts for h in hs_l])
+    fill("d_future_fraction", [h.future_host_fraction for h in hs_l])
+    fill("d_round_up", [h.rounding_rule == RoundingRule.UP.value for h in hs_l])
+    fill(
+        "d_feedback",
+        [h.feedback_rule == FeedbackRule.WAITS_OVER_THRESH.value for h in hs_l],
+    )
+    fill("d_disabled", [d.disabled for d in distros])
+    fill("d_ephemeral", [d.is_ephemeral() for d in distros])
+    fill("d_is_docker", [d.provider == Provider.DOCKER.value for d in distros])
+    fill("d_thresh_s", [p.max_duration_per_host_s() for p in ps_l])
+    fill("d_patch_factor", [_factor(p.patch_factor) for p in ps_l])
+    fill("d_patch_tiq_factor", [_factor(p.patch_time_in_queue_factor) for p in ps_l])
+    fill("d_cq_factor", [_factor(p.commit_queue_factor) for p in ps_l])
+    fill(
+        "d_mainline_tiq_factor",
+        [_factor(p.mainline_time_in_queue_factor) for p in ps_l],
+    )
+    fill("d_runtime_factor", [_factor(p.expected_runtime_factor) for p in ps_l])
+    fill("d_generate_factor", [_factor(p.generate_task_factor) for p in ps_l])
+    fill("d_numdep_factor", [_factor(p.num_dependents_factor) for p in ps_l])
+    fill("d_stepback_factor", [_factor(p.stepback_task_factor) for p in ps_l])
+
+
 #: time-independent per-task columns memcpy'd from the static memo into
 #: the arena each tick (plus scratch t_expected_floor_s/t_basis/t_start,
 #: which stay host-side)
@@ -833,37 +873,14 @@ def build_snapshot(
         a["h_expected_s"][:n_h] = hcols_tmp["h_expected_s"][:n_h]
         a["h_std_s"][:n_h] = hcols_tmp["h_std_s"][:n_h]
 
-    # distro settings matrix
-    ps_l = [d.planner_settings for d in distros]
-    hs_l = [d.host_allocator_settings for d in distros]
+    # distro settings matrix (shared with the resident state plane's
+    # d-column maintenance so the two fills cannot drift)
     fill("d_valid", [True] * n_d)
     # contiguous distro-major range lengths — the pallas ragged-tile
     # reduction (ops/pallas_kernels.py) derives each distro's [start,
     # end) from their cumulative sum
     fill("d_task_count", t_counts)
-    fill("d_min_hosts", [h.minimum_hosts for h in hs_l])
-    fill("d_max_hosts", [h.maximum_hosts for h in hs_l])
-    fill("d_future_fraction", [h.future_host_fraction for h in hs_l])
-    fill("d_round_up", [h.rounding_rule == RoundingRule.UP.value for h in hs_l])
-    fill(
-        "d_feedback",
-        [h.feedback_rule == FeedbackRule.WAITS_OVER_THRESH.value for h in hs_l],
-    )
-    fill("d_disabled", [d.disabled for d in distros])
-    fill("d_ephemeral", [d.is_ephemeral() for d in distros])
-    fill("d_is_docker", [d.provider == Provider.DOCKER.value for d in distros])
-    fill("d_thresh_s", [p.max_duration_per_host_s() for p in ps_l])
-    fill("d_patch_factor", [_factor(p.patch_factor) for p in ps_l])
-    fill("d_patch_tiq_factor", [_factor(p.patch_time_in_queue_factor) for p in ps_l])
-    fill("d_cq_factor", [_factor(p.commit_queue_factor) for p in ps_l])
-    fill(
-        "d_mainline_tiq_factor",
-        [_factor(p.mainline_time_in_queue_factor) for p in ps_l],
-    )
-    fill("d_runtime_factor", [_factor(p.expected_runtime_factor) for p in ps_l])
-    fill("d_generate_factor", [_factor(p.generate_task_factor) for p in ps_l])
-    fill("d_numdep_factor", [_factor(p.num_dependents_factor) for p in ps_l])
-    fill("d_stepback_factor", [_factor(p.stepback_task_factor) for p in ps_l])
+    pack_distro_settings(a, distros)
 
     return Snapshot(
         now=now,

@@ -56,6 +56,12 @@ class TickOptions:
     max_intent_hosts: int = MAX_INTENT_HOSTS_IN_FLIGHT
     #: incremental runnable-set maintenance between ticks (scheduler/cache.py)
     use_cache: bool = False
+    #: device-resident state plane (scheduler/resident.py): keep the
+    #: snapshot columns as persistent buffers across ticks and apply the
+    #: TickCache's deltas in place instead of rebuilding 50k slots.
+    #: Effective only with use_cache (the cache IS the delta stream);
+    #: any resident failure falls back to the full rebuild path.
+    use_resident: bool = True
     #: wall budget for the packed device solve; an overrun counts as a
     #: breaker failure and the tick falls back to the serial oracle
     #: (0 = no deadline)
@@ -179,6 +185,7 @@ def gather_tick_inputs(
     deps_met: Optional[Dict[str, bool]] = None,
     by_distro: Optional[Dict[str, List[Task]]] = None,
     alias_by_distro: Optional[Dict[str, List[Task]]] = None,
+    distro_view: Optional[Tuple[List[Distro], set]] = None,
 ) -> Tuple[
     List[Distro],
     Dict[str, List[Task]],
@@ -203,10 +210,17 @@ def gather_tick_inputs(
     # The snapshot covers the allocator's distro set (a superset that
     # includes disabled distros, which still maintain minimum hosts); task
     # queues are only gathered for the plannable subset (reference
-    # model/distro/db.go:198-224).
-    distros = distro_mod.find_needs_hosts_planning(store)
+    # model/distro/db.go:198-224). ``distro_view`` is the TickCache's
+    # dirty-tracked equivalent (stable Distro identity across ticks —
+    # the resident state plane depends on it); the cached list is copied
+    # because alias rows are appended below.
+    if distro_view is not None:
+        distros = list(distro_view[0])
+        distro_ids = distro_view[1]
+    else:
+        distros = distro_mod.find_needs_hosts_planning(store)
+        distro_ids = {d.id for d in distro_mod.find_needs_planning(store)}
     all_ids = {d.id for d in distros}
-    distro_ids = {d.id for d in distro_mod.find_needs_planning(store)}
 
     if by_distro is not None:
         tasks_by_distro = {
@@ -281,11 +295,19 @@ def gather_tick_inputs(
             rd = running_docs.get(h.running_task)
             if rd is not None:
                 dur = rd.get("expected_duration_s", 0.0)
+                # a missing or zero start_time means "unknown": elapsed
+                # pins to 0 on EVERY tick (the absent-key default always
+                # produced 0 — a present-but-zero value now gets the
+                # same treatment instead of a ~epoch-sized elapsed), and
+                # start_s=0 makes the resident plane freeze the same 0
+                # instead of integrating from a bogus base
+                st = rd.get("start_time", 0.0)
                 running_estimates[h.id] = serial.RunningTaskEstimate(
-                    elapsed_s=max(0.0, now - rd.get("start_time", now)),
+                    elapsed_s=max(0.0, now - st) if st > 0.0 else 0.0,
                     expected_s=dur if dur > 0 else float(DEFAULT_TASK_DURATION_S),
                     std_dev_s=rd.get("duration_std_dev_s", 0.0)
                     if dur > 0 else 0.0,
+                    start_s=st if st > 0.0 else 0.0,
                 )
     return distros, tasks_by_distro, hosts_by_distro, running_estimates, deps_met
 
@@ -299,11 +321,15 @@ def _unpack_solve(
     raw info columns (for the persister's whole-tick epoch compare)."""
     flat = snapshot.flat_tasks
     n = snapshot.n_tasks
-    # The solve's first sort key is the distro index, so the returned order
-    # is already segmented distro by distro: drop padding, then slice per
-    # distro.
+    # The solve's first sort key is the distro index (invalid/hole slots
+    # key as D and sort LAST), so the returned order is already segmented
+    # distro by distro with the n real tasks as its prefix: cut the
+    # prefix, then slice per distro. (The prefix cut — not an
+    # ``order < n`` filter — is what lets the resident state plane's
+    # slab layout, whose valid rows are interleaved with holes, share
+    # this unpack path.)
     order = np.asarray(out["order"])
-    real = order[order < n]
+    real = order[:n]
     t_distro = np.asarray(snapshot.arrays["t_distro"])
     dpd = t_distro[real]
     vals = np.asarray(out["t_value"])[real].astype(float)
@@ -312,20 +338,20 @@ def _unpack_solve(
     # (refcount per slot) measures ~15x SLOWER than the interpreter's
     # specialized list indexing — ~100ms/tick back at config-3 scale
     ordered_tasks = [flat[i] for i in real.tolist()]
-    # deps-met rides along positionally (the persister consumed an
-    # id→flag dict before — 50k dict lookups per tick)
-    met_flat = snapshot.arrays["t_deps_met"][:n][real].tolist()
-    vals_flat = vals.tolist()
+    # deps-met rides along positionally as numpy slices (the persister
+    # consumed an id→flag dict before — 50k dict lookups per tick — and
+    # now compares/patches the columns vectorized)
+    met_flat = np.asarray(snapshot.arrays["t_deps_met"])[real]
     plans: Dict[str, List[Task]] = {}
     # per-distro sort values ALIGNED with plans[did] (the persister
     # consumes them positionally — building 50k-entry id→value dicts per
     # tick was pure overhead)
-    sort_values: Dict[str, List[float]] = {}
-    met_cols: Dict[str, List[bool]] = {}
+    sort_values: Dict[str, np.ndarray] = {}
+    met_cols: Dict[str, np.ndarray] = {}
     for di, did in enumerate(snapshot.distro_ids):
         lo, hi = int(bounds[di]), int(bounds[di + 1])
         plans[did] = ordered_tasks[lo:hi]
-        sort_values[did] = vals_flat[lo:hi]
+        sort_values[did] = vals[lo:hi]
         met_cols[did] = met_flat[lo:hi]
 
     # Per-segment / per-distro scalars: pull each device array to host
@@ -472,6 +498,7 @@ def run_tick(
         # the holder's lease epoch was superseded: plan nothing, write
         # nothing — stand-down already fired through the lease's on_lost
         incr_counter("scheduler.tick.fenced")
+        _invalidate_resident(store, "fenced")
         _rlog.error("degraded-tick", reason="fenced", fallback="none")
         return TickResult(
             queues={}, new_hosts={}, intent_hosts=[], n_tasks=0,
@@ -550,6 +577,16 @@ def run_tick(
                 store.heal_durability()
 
 
+def _invalidate_resident(store: Store, reason: str) -> None:
+    """Drop the resident state plane's columns (if one exists for this
+    store) — mirror of PersisterState.reset() for fenced/recovery paths."""
+    from .resident import peek_resident_plane
+
+    plane = peek_resident_plane(store)
+    if plane is not None:
+        plane.invalidate(reason)
+
+
 def _commit_tick_group(store: Store, opts: TickOptions) -> str:
     """Commit the tick's WAL group; returns "" or a degradation reason."""
     from ..storage.lease import EpochFencedError
@@ -570,6 +607,7 @@ def _commit_tick_group(store: Store, opts: TickOptions) -> str:
         from ..utils.log import get_logger, incr_counter
 
         persister_state_for(store).reset()
+        _invalidate_resident(store, "fenced")
         incr_counter("scheduler.tick.fenced")
         get_logger("resilience").error(
             "tick-fenced",
@@ -677,14 +715,28 @@ def _run_tick_body(
             "degraded-tick", reason=degraded, fallback="serial"
         )
     if want_tpu:
+        snapshot = None
         try:
             t1 = _time.perf_counter()
             dims_memo, memb_memo, arena_pool = _snapshot_memos_for(store)
-            snapshot = build_snapshot(
-                solver_distros, tasks_by_distro, hosts_by_distro,
-                running_estimates, deps_met, now, dims_memo=dims_memo,
-                memb_memo=memb_memo, arena_pool=arena_pool,
-            )
+            if opts.use_resident and opts.use_cache:
+                # device-resident state plane: persistent columns mutated
+                # by the cache's deltas; ANY failure inside falls back to
+                # the full rebuild below (scheduler/resident.py keeps its
+                # own circuit so repeated delta failures stop being tried)
+                from .resident import resident_plane_for
+
+                snapshot = resident_plane_for(store).sync(
+                    tick_cache_for(store), solver_distros, tasks_by_distro,
+                    hosts_by_distro, running_estimates, deps_met, now,
+                    arena_pool=arena_pool,
+                )
+            if snapshot is None:
+                snapshot = build_snapshot(
+                    solver_distros, tasks_by_distro, hosts_by_distro,
+                    running_estimates, deps_met, now, dims_memo=dims_memo,
+                    memb_memo=memb_memo, arena_pool=arena_pool,
+                )
             t2 = _time.perf_counter()
             # bounded solve (optionally XLA-profiled inside — SURVEY §5:
             # profiler hooks beside the control-plane spans, enabled via
@@ -715,6 +767,12 @@ def _run_tick_body(
             )
             plans, sort_values, infos, met_cols = {}, {}, {}, {}
             new_hosts = {}
+        finally:
+            # return the pool-leased transfer arena even when the solve
+            # raised (a fault-injected failure must not strand the slot —
+            # the pool would otherwise churn allocations, ops/packing.py)
+            if snapshot is not None and snapshot.arena is not None:
+                snapshot.arena.close()
     if not want_tpu and solver_distros:
         results = serial.serial_tick(
             solver_distros, tasks_by_distro, hosts_by_distro,
@@ -806,6 +864,7 @@ def _run_tick_body(
             return "budget-exceeded"
         return ""
 
+    tick_cache = tick_cache_for(store) if opts.use_cache else None
     for d in distros:
         plan = plans.get(d.id, [])
         is_alias = d.id.endswith(ALIAS_SUFFIX)
@@ -824,6 +883,13 @@ def _run_tick_body(
                 secondary=is_alias,
                 now=now,
                 state=pstate,
+                # the cache's per-distro unstamped set collapses the
+                # 50k-row candidate scan to the handful of fresh tasks
+                # (alias plans hold other distros' tasks — those scan)
+                stamp_hint=(
+                    tick_cache.stamp_candidates(d.id)
+                    if tick_cache is not None and not is_alias else None
+                ),
             )
         except Exception as exc:  # noqa: BLE001 — isolate per distro
             queues[d.id] = 0

@@ -279,6 +279,103 @@ class Collection:
             self._notify(doc_id)
             return True
 
+    def patch_list(
+        self,
+        doc_id: str,
+        elems: Dict[str, Any],
+        fields: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Sparse element-level patch of list fields (op "pl"): ``elems``
+        maps field name → ``(indices, values)`` applied positionally, so
+        the journal carries only the CHANGED entries of a 50k-element
+        column instead of the whole list. ``fields`` are whole-field
+        patches riding in the same record (version bump, generated_at).
+        Same version-gap guard as ``patch``: when ``fields`` advances
+        ``v``, replay drops the record if the base version is gone."""
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None:
+                return False
+            for name, (idx, vals) in elems.items():
+                lst = doc.get(name)
+                if lst is None or (idx and idx[-1] >= len(lst)):
+                    return False  # base shape mismatch: caller rewrites
+            rec = {"c": self.name, "o": "pl", "i": doc_id, "el": elems}
+            if fields:
+                rec["f"] = fields
+                if "v" in fields:
+                    rec["pv"] = doc.get("v")
+            for name, (idx, vals) in elems.items():
+                lst = doc[name]
+                for i, v in zip(idx, vals):
+                    lst[i] = v
+            if fields:
+                doc.update(fields)
+            if self._journal is not None:
+                self._journal(rec)
+            self._notify(doc_id)
+            return True
+
+    def splice_queue(
+        self,
+        doc_id: str,
+        rm_idx: List[int],
+        inserts: List[tuple],
+        fields: Optional[Dict[str, Any]] = None,
+        elems: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Row-level splice of a queue doc's three aligned columns
+        (``rows`` / ``sort_value`` / ``dependencies_met``), journaling only
+        the delta (op "qs") — the churn-tick write shape of the delta
+        persister. ``rm_idx`` (ascending, pre-splice indices) removes
+        rows; ``inserts`` is ``[(idx, row, sort, met), ...]`` with ``idx``
+        the position in the FINAL list (ascending); ``fields`` are
+        whole-field patches (order permutation, version bump) and
+        ``elems`` sparse element patches applied AFTER the splice."""
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            if doc is None:
+                return False
+            rows = doc.get("rows")
+            sv = doc.get("sort_value")
+            dm = doc.get("dependencies_met")
+            if rows is None or sv is None or dm is None:
+                return False
+            n = len(rows)
+            if len(sv) != n or len(dm) != n:
+                return False
+            if rm_idx and (rm_idx[-1] >= n or rm_idx[0] < 0):
+                return False
+            rec = {
+                "c": self.name, "o": "qs", "i": doc_id,
+                "rm": rm_idx, "ins": inserts,
+            }
+            if fields:
+                rec["f"] = fields
+                if "v" in fields:
+                    rec["pv"] = doc.get("v")
+            if elems:
+                rec["el"] = elems
+            for i in reversed(rm_idx):
+                del rows[i]
+                del sv[i]
+                del dm[i]
+            for i, row, s, m in inserts:
+                rows.insert(i, row)
+                sv.insert(i, s)
+                dm.insert(i, m)
+            if elems:
+                for name, (idx, vals) in elems.items():
+                    lst = doc[name]
+                    for i, v in zip(idx, vals):
+                        lst[i] = v
+            if fields:
+                doc.update(fields)
+            if self._journal is not None:
+                self._journal(rec)
+            self._notify(doc_id)
+            return True
+
     def mutate(self, doc_id: str, fn: Callable[[dict], None]) -> bool:
         """Run ``fn`` on the document under the collection lock."""
         with self._lock:
@@ -329,6 +426,25 @@ def apply_wal_record(store: "Store", rec: dict, skip=()) -> None:
         coll.update(rec["i"], rec["f"])
     elif op == "um":
         coll.bulk_update(rec["is"], rec["f"])
+    elif op == "pl":
+        doc = coll.get(rec["i"])
+        if doc is None:
+            return  # base write lost (dropped group) — skip the patch
+        f = rec.get("f")
+        if f and "pv" in rec and doc.get("v") != rec["pv"]:
+            return  # version gap: the patch's base is not this doc
+        coll.patch_list(rec["i"], rec["el"], f)
+    elif op == "qs":
+        doc = coll.get(rec["i"])
+        if doc is None:
+            return  # base write lost (dropped group) — skip the splice
+        f = rec.get("f")
+        if f and "pv" in rec and doc.get("v") != rec["pv"]:
+            return  # version gap: the splice's base is not this doc
+        coll.splice_queue(
+            rec["i"], rec["rm"], [tuple(i) for i in rec["ins"]],
+            f, rec.get("el"),
+        )
     elif op == "r":
         coll.remove(rec["i"])
     elif op == "x":

@@ -29,27 +29,49 @@ import subprocess
 import sys
 
 
-def probe_tpu(timeout_s: float = 45.0, env: dict | None = None) -> bool:
-    """True iff the axon TPU backend initializes in a fresh subprocess
-    within ``timeout_s``.  With no ``env`` the subprocess inherits the
-    ambient one, so it exercises exactly the path the caller would take;
-    pass an explicit env (e.g. with the original pool address restored
-    after a force_cpu scrub) to probe the tunnel regardless."""
+def probe_tpu_detail(
+    timeout_s: float = 45.0, env: dict | None = None
+) -> tuple[bool, str]:
+    """Probe the axon TPU backend in a fresh subprocess; returns
+    ``(ok, reason)`` where ``reason`` classifies the failure — 5 bench
+    runs of bare ``ok=false`` probes taught us nothing about WHY the
+    tunnel was down, so the cause now rides in every probe record:
+
+      * ``""``            — healthy
+      * ``"cpu-pinned"``  — the caller's env pins JAX_PLATFORMS=cpu
+      * ``"no-pool-ips"`` — no tunnel address configured at all
+      * ``"timeout"``     — backend init hung past ``timeout_s`` (the
+                            classic wedged-relay shape)
+      * ``"backend-error: …"`` — init failed fast; carries the stderr
+                            tail (connect refused vs plugin crash etc.)
+      * ``"spawn-error: …"``   — the probe subprocess could not start
+    """
     env = dict(os.environ) if env is None else dict(env)
     if env.get("JAX_PLATFORMS") == "cpu":
-        return False
+        return False, "cpu-pinned"
     if not env.get("PALLAS_AXON_POOL_IPS"):
-        return False
+        return False, "no-pool-ips"
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s,
             capture_output=True,
             env=env,
+            text=True,
         )
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    except subprocess.TimeoutExpired:
+        return False, "timeout"
+    except OSError as exc:
+        return False, f"spawn-error: {exc!r}"[:200]
+    if r.returncode == 0:
+        return True, ""
+    tail = (r.stderr or r.stdout or "").strip().replace("\n", " ")[-160:]
+    return False, f"backend-error: rc={r.returncode} {tail}"
+
+
+def probe_tpu(timeout_s: float = 45.0, env: dict | None = None) -> bool:
+    """Boolean form of ``probe_tpu_detail`` (existing call sites)."""
+    return probe_tpu_detail(timeout_s, env)[0]
 
 
 def force_cpu(n_devices: int | None = None) -> None:
@@ -97,10 +119,13 @@ def ensure_usable_backend(timeout_s: float = 45.0, attempts: int = 1,
     it (no ``PALLAS_AXON_POOL_IPS``) jax's normal backend selection is left
     completely alone — a native TPU/GPU stays usable.
 
-    ``history``, when given, receives one ``{"t": unix_ts, "ok": bool}``
-    record per probe attempt — bench.py embeds it in the BENCH json so a
-    CPU-fallback run carries the evidence of when the tunnel was tried
-    (VERDICT r3 ask #3)."""
+    ``history``, when given, receives one ``{"t": unix_ts, "ok": bool,
+    "reason": str}`` record per probe attempt — bench.py embeds it in
+    the BENCH json so a CPU-fallback run carries the evidence of when
+    the tunnel was tried AND why it failed (VERDICT r3 ask #3). Retries
+    back off exponentially (``retry_sleep_s`` doubling per attempt): a
+    relay that is restarting gets breathing room instead of four probes
+    in lockstep hitting the same wedged state."""
     import time
 
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
@@ -111,10 +136,12 @@ def ensure_usable_backend(timeout_s: float = 45.0, attempts: int = 1,
         return "cpu"
     for attempt in range(max(attempts, 1)):
         if attempt:
-            time.sleep(retry_sleep_s)
-        ok = probe_tpu(timeout_s)
+            time.sleep(retry_sleep_s * (2 ** (attempt - 1)))
+        ok, reason = probe_tpu_detail(timeout_s)
         if history is not None:
-            history.append({"t": round(time.time(), 1), "ok": ok})
+            history.append(
+                {"t": round(time.time(), 1), "ok": ok, "reason": reason}
+            )
         if ok:
             return "axon"
     force_cpu()

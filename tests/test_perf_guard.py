@@ -1,7 +1,9 @@
 """Store-path perf guard as a slow-marked test (excluded from tier-1):
-churn ticks must stay within 2x of store-backed steady ticks and the
-churn store component must not regress >25% over the checked-in floor.
-See tools/perf_guard.py for the config."""
+churn ticks must stay within 2x of store-backed steady ticks, the
+churn store component must not regress >25% over the checked-in floor,
+and the snapshot/solve/store overlap must stay PROVEN (pipelined
+resident cadence beats sequential with efficiency >= the floor's
+``overlap_efficiency_min``). See tools/perf_guard.py for the config."""
 import json
 import os
 

@@ -90,7 +90,8 @@ def _log_run(rc: int, args: list) -> None:
     # masquerade as a suite-wide green; the only extra args a full run
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
-        a in ("--crash-matrix", "--overload-matrix") for a in args[1:]
+        a in ("--crash-matrix", "--overload-matrix", "--resident-parity")
+        for a in args[1:]
     )
     if rc == 0 and full_suite:
         try:
@@ -109,10 +110,11 @@ def main() -> int:
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
-    flags = {"--crash-matrix", "--overload-matrix"}
+    flags = {"--crash-matrix", "--overload-matrix", "--resident-parity"}
     args = [a for a in sys.argv[1:] if a not in flags]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
+    with_resident_parity = "--resident-parity" in sys.argv[1:]
     args = args or ["tests/"]
     cmd = [sys.executable, "-m", "pytest", "-q", *args]
     print("gate:", " ".join(cmd), flush=True)
@@ -134,6 +136,15 @@ def main() -> int:
         print("gate:", " ".join(om), flush=True)
         rc = subprocess.call(om, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--overload-matrix")
+    if rc == 0 and with_resident_parity:
+        # resident ≡ rebuild parity fuzz + churn micro-bench
+        # (make resident-parity): the device-resident state plane must
+        # canonicalize identically to a from-scratch snapshot under churn
+        rp = [sys.executable,
+              os.path.join(root, "tools", "resident_parity.py")]
+        print("gate:", " ".join(rp), flush=True)
+        rc = subprocess.call(rp, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--resident-parity")
     _log_run(rc, [*args, *ran_flags])
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)

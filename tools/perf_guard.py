@@ -5,14 +5,19 @@ floor.
 
 Runs a small store-backed churn config (a scaled-down BASELINE config 5:
 steady ticks, then churn ticks with finishes + fresh tasks) through the
-REAL run_tick path — TickCache gather, batched solve, delta persister —
-and fails if:
+REAL run_tick path — TickCache gather, batched solve, delta persister,
+device-resident state plane — and fails if:
 
   * median churn tick > ``RATIO_MAX`` x median store-backed steady tick
     (the delta persister's whole job is keeping that ratio bounded), or
   * the churn STORE component (tick - snapshot - solve) regresses more
     than ``REGRESS_FRAC`` above the checked-in floor in
-    ``tools/perf_floor.json``.
+    ``tools/perf_floor.json``, or
+  * the snapshot/solve/store overlap is no longer PROVEN: the pipelined
+    resident cadence must beat the sequential one with efficiency ≥
+    ``overlap_efficiency_min`` (``tools/perf_floor.json``). r05 shipped
+    ``pipelined 61.7ms > sequential 59.1ms`` as a silent bench footnote —
+    this guard makes that shape a hard failure, not an annotation.
 
 The floor is wall-clock on whatever machine runs this, so it is set
 generously (CI boxes vary ~5x) and the guard is marked ``slow`` —
@@ -30,6 +35,10 @@ import statistics
 import sys
 import time
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 FLOOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "perf_floor.json")
 
@@ -42,6 +51,10 @@ STEADY_TICKS = 4
 CHURN_TICKS = 4
 RATIO_MAX = 2.0
 REGRESS_FRAC = 0.25
+#: bench.py's proof bar: (pack + solve - pipelined) / min(pack, solve).
+#: Overridable via perf_floor.json "overlap_efficiency_min"; a noisy box
+#: gets one re-measure before the verdict (best of two medians).
+OVERLAP_EFF_MIN = 0.5
 
 
 def run_guard() -> dict:
@@ -52,7 +65,11 @@ def run_guard() -> dict:
     from evergreen_tpu.scheduler.persister import persister_state_for
     from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
     from evergreen_tpu.storage.store import Store
-    from evergreen_tpu.utils.benchgen import NOW, generate_problem
+    from evergreen_tpu.utils.benchgen import (
+        NOW,
+        generate_problem,
+        measure_resident_overlap,
+    )
     from evergreen_tpu.utils.gctune import tune_gc_for_long_lived_heap
 
     distros, tbd, hbd, _, _ = generate_problem(
@@ -101,6 +118,16 @@ def run_guard() -> dict:
         snap_ms.append(res.snapshot_ms)
         solve_ms.append(res.solve_ms)
 
+    # overlap invariant: the steady resident cadence, sequenced vs
+    # pipelined, on the store the churn just exercised (the plane is
+    # primed and carrying real holes). Box noise gets ONE re-measure —
+    # the guard must catch the r05 regression shape, not a cron spike.
+    ov = measure_resident_overlap(store, ticks=5, warmup=2)
+    if ov["overlap_efficiency"] < OVERLAP_EFF_MIN:
+        ov2 = measure_resident_overlap(store, ticks=5, warmup=1)
+        if ov2["overlap_efficiency"] > ov["overlap_efficiency"]:
+            ov = ov2
+
     # best-of, not median: the guard measures what the CODE costs, and a
     # shared CI box's background spikes land in the slow ticks — min over
     # several ticks is the stable estimator of machine-relative cost
@@ -110,6 +137,10 @@ def run_guard() -> dict:
         c - sn - so for c, sn, so in zip(churn, snap_ms, solve_ms)
     )
     return {
+        "overlap_efficiency": round(ov["overlap_efficiency"], 3),
+        "resident_pack_ms": round(ov["pack_ms"], 2),
+        "resident_sequential_ms": round(ov["sequential_ms"], 2),
+        "resident_pipelined_ms": round(ov["pipelined_ms"], 2),
         "steady_tick_ms": round(steady_best, 2),
         "churn_tick_ms": round(churn_best, 2),
         "churn_store_ms": round(max(store_best, 0.0), 2),
@@ -140,6 +171,17 @@ def evaluate(result: dict, floor: dict) -> list:
                 f"regressed >{int(REGRESS_FRAC * 100)}% over the "
                 f"checked-in floor {floor_ms}ms (limit {limit:.1f}ms)"
             )
+    eff_min = floor.get("overlap_efficiency_min", OVERLAP_EFF_MIN)
+    if result.get("overlap_efficiency") is not None and (
+        result["overlap_efficiency"] < eff_min
+    ):
+        failures.append(
+            f"overlap NOT proven: efficiency "
+            f"{result['overlap_efficiency']} < {eff_min} (pipelined "
+            f"{result['resident_pipelined_ms']}ms vs sequential "
+            f"{result['resident_sequential_ms']}ms) — the pipelined "
+            f"resident cadence must hide pack behind the in-flight solve"
+        )
     return failures
 
 
@@ -150,9 +192,16 @@ def main() -> int:
     args = p.parse_args()
     result = run_guard()
     if args.write_floor:
+        # refresh the machine-relative floor; the overlap bar is a
+        # machine-independent invariant and stays as configured
+        prev = {}
+        if os.path.exists(FLOOR_PATH):
+            with open(FLOOR_PATH, encoding="utf-8") as fh:
+                prev = json.load(fh)
+        prev["churn_store_ms"] = result["churn_store_ms"]
+        prev.setdefault("overlap_efficiency_min", OVERLAP_EFF_MIN)
         with open(FLOOR_PATH, "w", encoding="utf-8") as fh:
-            json.dump({"churn_store_ms": result["churn_store_ms"]}, fh,
-                      indent=2)
+            json.dump(prev, fh, indent=2)
             fh.write("\n")
         print(json.dumps({"wrote_floor": result}))
         return 0

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Resident ≡ rebuild parity matrix + churn micro-bench
+(``make resident-parity``; ``tools/gate.py --resident-parity``).
+
+Two stages, exit non-zero on any divergence:
+
+1. **Parity fuzz** — the randomized churn property suite
+   (tests/test_resident_state.py) in a clean subprocess: after every
+   step of add / complete / block / priority-bump / distro-remove /
+   host-lifecycle churn, the device-resident state plane's columns must
+   canonicalize identically to a from-scratch ``build_snapshot`` of the
+   same gather, plus the fenced-epoch / recovery invalidation and
+   device-mirror cases.
+
+2. **Churn micro-bench** — mid-scale (60 distros × 12k tasks)
+   store-backed churn ticks through the REAL ``run_tick``, resident
+   plane vs full-rebuild path in the SAME process (within-run numbers —
+   wall clock on shared CI boxes varies ~5x between runs, so only the
+   relative comparison is asserted-adjacent; the bound itself lives in
+   tools/perf_guard.py). Each resident tick is followed by an
+   out-of-band canonical-parity check against a cold rebuild, and the
+   run must have been delta-shaped: zero plane fallbacks, exactly one
+   cold rebuild, skip/patch/splice persists dominating full rewrites.
+
+Prints one JSON line per stage; the final line is the verdict.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import statistics
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+N_DISTROS = 60
+N_TASKS = 12_000
+RESIDENT_TICKS = 5
+REBUILD_TICKS = 3
+FINISH_PER_TICK = 120
+FRESH_PER_TICK = 60
+
+
+def run_fuzz() -> int:
+    cmd = [
+        sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+        os.path.join(ROOT, "tests", "test_resident_state.py"),
+    ]
+    print("resident-parity:", " ".join(cmd), flush=True)
+    return subprocess.call(
+        cmd, env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=ROOT
+    )
+
+
+def run_microbench() -> dict:
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.scheduler.persister import persister_state_for
+    from evergreen_tpu.scheduler.resident import (
+        canonicalize,
+        resident_plane_for,
+    )
+    from evergreen_tpu.scheduler.snapshot import build_snapshot
+    from evergreen_tpu.scheduler.wrapper import (
+        TickOptions,
+        run_tick,
+        tick_cache_for,
+    )
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+    distros, tbd, hbd, _, _ = generate_problem(
+        N_DISTROS, N_TASKS, seed=17, task_group_fraction=0.25,
+        dep_fraction=0.25, patch_fraction=0.5, hosts_per_distro=5,
+    )
+    store = Store()
+    for d in distros:
+        distro_mod.insert(store, d)
+    all_tasks = [t for ts in tbd.values() for t in ts]
+    task_mod.insert_many(store, all_tasks)
+    for hs in hbd.values():
+        host_mod.insert_many(store, hs)
+
+    opts = TickOptions(create_intent_hosts=False, use_cache=True,
+                       underwater_unschedule=False)
+    run_tick(store, opts, now=NOW)  # cold prime: compile + plane rebuild
+    run_tick(store, opts, now=NOW + 0.01)  # absorb the stamp storm
+
+    plane = resident_plane_for(store)
+    cache = tick_cache_for(store)
+    pstate = persister_state_for(store)
+    pstate.skipped = pstate.patched = pstate.rewritten = 0
+    pstate.spliced = 0
+    rng = random.Random(5)
+    coll = task_mod.coll(store)
+    failures: list = []
+
+    def churn(tag: str, tick: int) -> None:
+        for t in rng.sample(all_tasks, FINISH_PER_TICK):
+            coll.update(t.id, {"status": TaskStatus.SUCCEEDED.value})
+        fresh = [
+            dataclasses.replace(
+                rng.choice(all_tasks), id=f"rp-{tag}-{tick}-{j}",
+                depends_on=[],
+            )
+            for j in range(FRESH_PER_TICK)
+        ]
+        task_mod.insert_many(store, fresh)
+
+    res_ms = []
+    for tick in range(RESIDENT_TICKS):
+        churn("r", tick)
+        now = NOW + 10.0 * (tick + 1)
+        t1 = time.perf_counter()
+        run_tick(store, opts, now=now)
+        res_ms.append((time.perf_counter() - t1) * 1e3)
+        # out-of-band parity: re-publish the (already synced) resident
+        # columns and canonicalize against a cold rebuild of the gather
+        g = cache.gather(now)
+        snap = plane.sync(cache, *g, now)
+        cold = build_snapshot(*g, now)
+        if snap is None:
+            failures.append(f"tick {tick}: resident plane fell back")
+        elif canonicalize(snap) != canonicalize(cold):
+            failures.append(f"tick {tick}: resident != rebuild canonical")
+
+    stats = plane.stats()
+    rb_opts = dataclasses.replace(opts, use_resident=False)
+    rb_ms = []
+    for tick in range(REBUILD_TICKS):
+        churn("f", tick)
+        t1 = time.perf_counter()
+        run_tick(store, rb_opts, now=NOW + 1000.0 + 10.0 * (tick + 1))
+        rb_ms.append((time.perf_counter() - t1) * 1e3)
+
+    if stats["fallbacks"]:
+        failures.append(f"plane fell back {stats['fallbacks']}x")
+    if stats["rebuilds"] != 1:
+        failures.append(
+            f"expected exactly the cold rebuild, got {stats['rebuilds']} "
+            f"({stats['rebuild_reasons']})"
+        )
+    deltas = pstate.skipped + pstate.patched + pstate.spliced
+    if deltas <= pstate.rewritten:
+        failures.append(
+            f"store path not delta-shaped: skip+patch+splice {deltas} "
+            f"<= rewrite {pstate.rewritten}"
+        )
+    return {
+        "config": f"{N_DISTROS}d x {N_TASKS}t",
+        "churn_resident_ms": round(statistics.median(res_ms), 1),
+        "churn_rebuild_ms": round(statistics.median(rb_ms), 1),
+        "persist": {
+            "skipped": pstate.skipped, "patched": pstate.patched,
+            "spliced": pstate.spliced, "rewritten": pstate.rewritten,
+        },
+        "resident": stats,
+        "failures": failures,
+    }
+
+
+def main() -> int:
+    rc = run_fuzz()
+    if rc != 0:
+        print(json.dumps({"resident_parity": "fuzz RED", "rc": rc}))
+        return rc
+    result = run_microbench()
+    print(json.dumps({"resident_parity_bench": result}))
+    if result["failures"]:
+        print("resident-parity: RED —", "; ".join(result["failures"]),
+              file=sys.stderr)
+        return 1
+    print("resident-parity: green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

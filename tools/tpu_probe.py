@@ -51,10 +51,10 @@ def _probe_env() -> dict:
 
 
 def probe_once(timeout_s: float = 45.0) -> bool:
-    from evergreen_tpu.utils.jaxenv import probe_tpu
+    from evergreen_tpu.utils.jaxenv import probe_tpu_detail
 
-    ok = probe_tpu(timeout_s, env=_probe_env())
-    _log({"event": "probe", "ok": ok})
+    ok, reason = probe_tpu_detail(timeout_s, env=_probe_env())
+    _log({"event": "probe", "ok": ok, "reason": reason})
     return ok
 
 
