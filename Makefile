@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity metrics-lint
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -58,6 +58,13 @@ overload-matrix:
 # fallbacks, one cold rebuild, skip/patch/splice persists dominating)
 resident-parity:
 	env JAX_PLATFORMS=cpu python tools/resident_parity.py
+
+# static metrics-plane lint (fast; gate runs it unconditionally):
+# every instrument registered exactly once, literal snake_case names
+# with a known subsystem prefix, labels from the allowed vocabulary,
+# no f-string metric names, no stray incr_counter call sites
+metrics-lint:
+	python tools/metrics_lint.py
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -287,11 +287,19 @@ def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro):
     rng = random.Random(0)
     coll = task_mod.coll(store)
 
+    # tick timing now reads from the metrics plane: run_tick observes
+    # scheduler_tick_duration_ms, and the bench payload reports the
+    # histogram deltas — ONE timing source of truth shared with
+    # /metrics instead of a bench-private stopwatch aggregation
+    from evergreen_tpu.scheduler.wrapper import TICK_MS, TICK_PHASE_MS
+
+    h0 = TICK_MS.state()
     steady = []
     for k in range(5):
         t1 = time.perf_counter()
         run_tick(store, opts, now=NOW + 0.1 * (k + 1))
         steady.append((time.perf_counter() - t1) * 1e3)
+    steady_hist = TICK_MS.snapshot_delta(h0)
 
     from evergreen_tpu.scheduler.persister import persister_state_for
 
@@ -323,7 +331,18 @@ def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro):
             solve.append(res.solve_ms)
         return times, snap, solve
 
+    h1 = TICK_MS.state()
+    ph1 = {
+        phase: TICK_PHASE_MS.state(phase=phase)
+        for phase in ("delta_drain", "pack", "solve", "unpack",
+                      "persist", "wal_commit")
+    }
     times, snap_ms, solve_ms = churn_pass("r", 5, True)
+    churn_hist = TICK_MS.snapshot_delta(h1)
+    churn_phases = {
+        phase: TICK_PHASE_MS.snapshot_delta(prev, phase=phase)
+        for phase, prev in ph1.items()
+    }
     resident_stats = resident_plane_for(store).stats()
     # freeze the write-shape counters here: the rebuild pass below runs
     # through the same PersisterState and would fold its 3 ticks in
@@ -356,6 +375,13 @@ def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro):
         "persist_spliced": persist_shapes["spliced"],
         "persist_rewritten": persist_shapes["rewritten"],
         "resident_stats": resident_stats,
+        # the metrics-plane view of the same ticks (p50/p95/p99 from
+        # scheduler_tick_duration_ms — what /metrics serves)
+        "tick_histograms": {
+            "store_steady": steady_hist,
+            "churn": churn_hist,
+            "churn_phases": churn_phases,
+        },
     }, store
 
 

@@ -34,6 +34,26 @@ from ..settings import ServiceFlags, all_sections, get_section
 from ..storage.replica import ReplicaReadOnly
 from ..storage.store import Store
 from ..units import task_jobs
+from ..utils import metrics as _metrics
+
+API_SHED = _metrics.counter(
+    "api_requests_shed_total",
+    "Requests 429d by the overload ladder's admission control (RED "
+    "sheds expensive reads; BLACK sheds everything but agent, hooks, "
+    "login, admin, and telemetry).",
+    legacy="overload.api_shed",
+)
+API_REQUESTS = _metrics.counter(
+    "api_requests_total",
+    "Handled API requests by status class (2xx/3xx/4xx/5xx).",
+    labels=("outcome",),
+)
+API_REQUEST_MS = _metrics.histogram(
+    "api_request_duration_ms",
+    "Wall time of API request handling (routing + handler), by status "
+    "class.",
+    labels=("outcome",),
+)
 
 JSON = "application/json"
 
@@ -101,6 +121,14 @@ _READONLY_POSTS = re.compile(
     r"|artifacts/sign"
     r"|tasks/[^/]+/select_tests)$"
 )
+
+
+class PlainTextResponse(str):
+    """A handler payload served verbatim instead of JSON-encoded —
+    ``GET /metrics`` returns Prometheus exposition text. In-process
+    callers (tests, matrices) still see an ordinary ``str``."""
+
+    content_type = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def _is_graphql_mutation(document: str) -> bool:
@@ -239,6 +267,13 @@ class RestApi:
             rl = RateLimitConfig.get(self.store)
             limit = rl.requests_per_minute
             pre_mult = rl.pre_auth_multiplier
+        # the scrape is exempt from BOTH rate-limit tiers, like it is
+        # from auth and overload shedding: without auth its bucket key
+        # degrades to the shared peer/"anon" buckets, so a request storm
+        # would 429 the scraper for exactly the minutes the dashboard
+        # exists to explain (DEPLOY.md promises scrape-through-brownout)
+        if path == "/metrics":
+            limit = 0
         if limit:
             peer = headers.get("x-peer-addr") or "anon"
             if not self._rate_limiter.allow(
@@ -250,6 +285,9 @@ class RestApi:
             denied = self._authorize_agent(path, headers)
         elif self.require_auth and not (
             _LOGIN_PATHS.match(path) or _HOOK_PATHS.match(path)
+            # Prometheus scrapers don't carry API keys; the exposition
+            # holds aggregate counters only (DEPLOY.md scrape notes)
+            or path == "/metrics"
         ):
             from ..models import user as user_mod
 
@@ -374,6 +412,9 @@ class RestApi:
             or _LOGIN_PATHS.match(path)
             or _HOOK_PATHS.match(path)
             or _ADMIN_PATHS.match(path)
+            # the telemetry surface must survive the exact storms it
+            # exists to explain (like /admin/overload)
+            or path == "/metrics"
         ):
             return None
         expensive = (
@@ -384,10 +425,10 @@ class RestApi:
         )
         if level < overload.BLACK and not expensive:
             return None
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         retry = monitor.retry_after_s(level)
-        incr_counter("overload.api_shed")
+        API_SHED.inc()
         get_logger("api").warning(
             "request-shed",
             method=method,
@@ -405,6 +446,22 @@ class RestApi:
         }
 
     def handle(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Any]:
+        t0 = _time.perf_counter()
+        status, payload = self._handle_inner(method, path, body, headers)
+        outcome = f"{status // 100}xx"
+        API_REQUESTS.inc(outcome=outcome)
+        API_REQUEST_MS.observe(
+            (_time.perf_counter() - t0) * 1e3, outcome=outcome
+        )
+        return status, payload
+
+    def _handle_inner(
         self,
         method: str,
         path: str,
@@ -636,6 +693,12 @@ class RestApi:
                   503: "Service Unavailable"}
         extra = getattr(self._ident, "response_headers", None) or []
         self._ident.response_headers = []
+        if isinstance(payload, PlainTextResponse):
+            start_response(
+                f"{status} {reason.get(status, 'OK')}",
+                [("Content-Type", payload.content_type), *extra],
+            )
+            return [str(payload).encode()]
         start_response(
             f"{status} {reason.get(status, 'OK')}",
             [("Content-Type", JSON), *extra],
@@ -793,6 +856,16 @@ class RestApi:
         r("GET", r"/rest/v2/admin/settings", self.get_admin)
         r("POST", r"/rest/v2/admin/settings", self.set_admin)
         r("GET", r"/rest/v2/admin/overload", self.get_overload)
+        # observability plane (ISSUE 7): Prometheus exposition + the
+        # trace/provenance admin surfaces, all shed-exempt
+        r("GET", r"/metrics", self.get_metrics)
+        r("GET", r"/rest/v2/admin/traces", self.list_traces)
+        r("GET", r"/rest/v2/admin/trace/(?P<trace>[^/]+)", self.get_trace)
+        r(
+            "GET",
+            r"/rest/v2/admin/provenance/(?P<distro>[^/]+)",
+            self.get_provenance,
+        )
         r("GET", r"/rest/v2/status", self.status)
         # login surface (reference service/ui.go login routes + gimlet
         # user-manager handlers); manager-agnostic
@@ -1654,6 +1727,73 @@ class RestApi:
             },
             "sheds": overload.shed_totals(self.store),
         }
+
+    def get_metrics(self, method, match, body):
+        """The whole metrics registry in Prometheus text exposition
+        format v0.0.4 — counters, gauges, and histograms with their
+        cumulative buckets. Shed- and auth-exempt: the scrape must
+        survive the storms it measures."""
+        from ..utils import metrics as metrics_mod
+        from ..utils import overload
+        from ..utils.jaxenv import refresh_probe_metrics_from_log
+
+        # freshen the pull-style gauges right before rendering: the
+        # fused overload signals and the cross-run TPU probe streak.
+        # Read-only — a fast scraper must not advance the ladder's
+        # downward-hysteresis calm streak (that budget belongs to the
+        # tick-cadence evaluate() calls)
+        overload.monitor_for(self.store).refresh_gauges()
+        refresh_probe_metrics_from_log()
+        return 200, PlainTextResponse(metrics_mod.render_prometheus())
+
+    def list_traces(self, method, match, body):
+        """Newest-last summaries of recent traces (?last=N, default 10)
+        from the in-memory ring merged with the store's span sink."""
+        from ..utils import tracing
+
+        last = int(body.get("last", 10) or 10)
+        return 200, {
+            "traces": tracing.recent_traces(self.store, last=last)
+        }
+
+    def get_trace(self, method, match, body):
+        """One trace's span tree — the anatomy of a tick. Served from
+        the ring buffer first (RED/BLACK brownout sheds span STORE
+        writes, never the ring), merged with the durable sink."""
+        from ..utils import tracing
+
+        tree = tracing.trace_tree(self.store, match["trace"])
+        if tree is None:
+            raise ApiError(404, f"no trace {match['trace']!r}")
+        return 200, tree
+
+    def get_provenance(self, method, match, body):
+        """Why is task X at rank Y: the last solve tick's per-task score
+        terms for one distro (?task= narrows to one task, ?limit= caps
+        the queue-head dump)."""
+        from ..scheduler.provenance import provenance_for
+
+        prov = provenance_for(self.store)
+        if prov is None:
+            raise ApiError(
+                404, "no solve provenance yet (no TPU-planned tick)"
+            )
+        task_id = str(body.get("task", "") or "")
+        if task_id:
+            doc = prov.explain(match["distro"], task_id)
+            if doc is None:
+                raise ApiError(
+                    404,
+                    f"task {task_id!r} is not in {match['distro']!r}'s "
+                    "planned queue",
+                )
+            return 200, doc
+        doc = prov.to_doc(match["distro"], limit=int(body.get("limit", 25)))
+        if doc is None:
+            raise ApiError(
+                404, f"no provenance for distro {match['distro']!r}"
+            )
+        return 200, doc
 
     def get_admin(self, method, match, body):
         out = {}

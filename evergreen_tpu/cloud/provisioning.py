@@ -35,8 +35,22 @@ from ..models.host import (
     Host,
 )
 from ..storage.store import Store
+from ..utils import metrics as _metrics
 from . import userdata as userdata_mod
 from .manager import CloudHostStatus, get_manager
+
+CLOUD_SPAWN_FAILED = _metrics.counter(
+    "cloud_spawn_failed_total",
+    "Provider spawn calls that raised; the host is charged a provision "
+    "attempt and the next cron pass retries.",
+    legacy="cloud.spawn_failed",
+)
+CLOUD_STATUS_FAILED = _metrics.counter(
+    "cloud_status_failed_total",
+    "Provider instance-status checks that raised after retry; the host "
+    "holds its state until the next pass.",
+    legacy="cloud.status_failed",
+)
 
 #: consecutive deploy/convert failures before a host is poisoned
 #: (reference agentPutRetries=75 spread over amboy retries; here each
@@ -286,7 +300,7 @@ def create_hosts_from_intents(
         # attempt, the next cron pass retries, and the cap poisons it —
         # one sick provider call never aborts the whole create pass.
         from ..utils import faults
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         try:
             faults.fire("cloud.spawn")
@@ -297,7 +311,7 @@ def create_hosts_from_intents(
             host_mod.coll(store).update(
                 h.id, {"provision_attempts": attempts}
             )
-            incr_counter("cloud.spawn_failed")
+            CLOUD_SPAWN_FAILED.inc()
             get_logger("cloud").error(
                 "host-spawn-failed",
                 host=h.id,
@@ -471,9 +485,9 @@ def provision_ready_hosts(
             )
         except Exception as exc:  # noqa: BLE001 — a provider status
             # error holds THIS host where it is; the pass continues
-            from ..utils.log import get_logger, incr_counter
+            from ..utils.log import get_logger
 
-            incr_counter("cloud.status_failed")
+            CLOUD_STATUS_FAILED.inc()
             get_logger("cloud").warning(
                 "host-status-check-failed",
                 host=h.id,

@@ -76,7 +76,32 @@ def assign_next_available_task(
 ) -> Optional[Task]:
     """Returns the task now assigned to this host, or None if the queue has
     nothing dispatchable."""
+    from ..utils import tracing as _tracing
+
     now = _time.time() if now is None else now
+    if not _tracing.tracing_enabled():
+        return _assign_next_available_task(store, svc, host, now)
+    # dispatch is the last leg of the tick's span tree: parent into the
+    # most recent tick's trace (captured by run_tick) so one trace reads
+    # delta-drain → … → wal-commit → dispatch. Ring-only: assigns run at
+    # ~10k/s under drain and must never cost a store write.
+    with _tracing.attached(getattr(store, "_last_tick_trace", None)), \
+            _tracing.Tracer(store, "dispatch").span(
+                "dispatch_assign", store_write=False,
+                distro=host.distro_id,
+            ) as _span:
+        t = _assign_next_available_task(store, svc, host, now)
+        if t is not None:
+            _span["attributes"]["task"] = t.id
+        return t
+
+
+def _assign_next_available_task(
+    store: Store,
+    svc: DispatcherService,
+    host: Host,
+    now: float,
+) -> Optional[Task]:
     if host.running_task:
         # Reference returns the already-assigned task so a crashed agent can
         # resume (host_agent.go:209-216).

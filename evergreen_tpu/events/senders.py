@@ -14,7 +14,22 @@ import uuid
 from typing import Callable, Dict
 
 from ..storage.store import Store
+from ..utils import metrics as _metrics
 from .triggers import Notification, register_sender
+
+OUTBOX_COALESCED = _metrics.counter(
+    "outbox_coalesced_total",
+    "Notification rows folded into a matching undelivered row at "
+    "YELLOW or worse instead of growing the backlog.",
+    legacy="overload.outbox_coalesced",
+)
+OUTBOX_DROPPED = _metrics.counter(
+    "outbox_dropped_total",
+    "Notification rows dropped at the outbox cap, labeled by outbox "
+    "collection.",
+    labels=("collection",),
+    legacy="overload.outbox_dropped",
+)
 
 OUTBOX = {
     "email": "email_outbox",
@@ -84,7 +99,7 @@ def insert_outbox_row(
     routes) never misreport an accepted notification as discarded or
     vice versa."""
     from ..utils import overload
-    from ..utils.log import get_logger, incr_counter
+    from ..utils.log import get_logger
 
     monitor = overload.monitor_for(store)
     level = monitor.level()
@@ -106,15 +121,14 @@ def insert_outbox_row(
 
             coll.mutate(existing_id, fold)
             if hit["ok"]:
-                incr_counter("overload.outbox_coalesced")
+                OUTBOX_COALESCED.inc()
                 return OutboxOutcome(False, "coalesced")
             cmap.pop(key, None)
     cap = monitor.config.outbox_cap
     if cap and monitor.outbox_depth(collection) >= cap:
         # drop-with-counter: notifications are the lowest class of work
         # and a full outbox under storm must not grow without bound
-        incr_counter("overload.outbox_dropped")
-        incr_counter(f"overload.outbox_dropped.{collection}")
+        OUTBOX_DROPPED.inc(collection=collection)
         overload.record_shed(store, "outbox", collection)
         get_logger("events").warning(
             "outbox-row-dropped",

@@ -28,8 +28,22 @@ from typing import Callable, Dict, List, Optional
 
 from ..storage.store import Store
 from ..utils import faults
-from ..utils.log import get_logger, incr_counter
+from ..utils import metrics as _metrics
+from ..utils.log import get_logger
 from ..utils.retry import RetryPolicy
+
+EVENTS_DELIVERY_FAILED = _metrics.counter(
+    "events_delivery_failed_total",
+    "Outbox delivery attempts that raised (one poison row costs itself "
+    "an attempt, never the drain).",
+    legacy="events.delivery_failed",
+)
+EVENTS_ROW_ABANDONED = _metrics.counter(
+    "events_row_abandoned_total",
+    "Outbox rows marked failed after exhausting the delivery-attempt "
+    "cap.",
+    legacy="events.row_abandoned",
+)
 from .senders import OUTBOX
 from .github_status import OUTBOX_COLLECTION as GITHUB_OUTBOX
 
@@ -327,6 +341,23 @@ def drain_outboxes(
     if max_per_collection is None:
         max_per_collection = max(1, cfg.buffer_target_per_interval)
     now = _time.time() if now is None else now
+    from ..utils.tracing import Tracer
+
+    with Tracer(store, "events").span("outbox_drain") as _span:
+        delivered = _drain_outboxes_inner(
+            store, transports, now, max_attempts, max_per_collection
+        )
+        _span["attributes"]["delivered"] = sum(delivered.values())
+    return delivered
+
+
+def _drain_outboxes_inner(
+    store: Store,
+    transports: Dict[str, object],
+    now: float,
+    max_attempts: int,
+    max_per_collection: int,
+) -> Dict[str, int]:
     delivered: Dict[str, int] = {}
     for collection, key in _OUTBOX_TRANSPORT.items():
         transport = transports.get(key)
@@ -345,10 +376,10 @@ def drain_outboxes(
                 # abort the drain for every other row and channel
                 attempts = doc.get("attempts", 0) + 1
                 update = {"attempts": attempts, "error": str(e)}
-                incr_counter("events.delivery_failed")
+                EVENTS_DELIVERY_FAILED.inc()
                 if attempts >= max_attempts:
                     update["failed"] = True
-                    incr_counter("events.row_abandoned")
+                    EVENTS_ROW_ABANDONED.inc()
                     get_logger("events").error(
                         "outbox-row-abandoned",
                         collection=collection,

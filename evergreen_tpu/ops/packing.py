@@ -14,6 +14,16 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from ..utils import metrics as _metrics
+
+ARENA_FORCED_ROTATIONS = _metrics.counter(
+    "arena_forced_rotations_total",
+    "Transfer-arena leases forcibly reclaimed because every slot of a "
+    "layout was still leased (a leak-anomaly signal, not a steady-state "
+    "path).",
+    legacy="arena.pool.forced_rotation",
+)
+
 _DTYPES = {
     "f32": np.float32,
     "i32": np.int32,
@@ -146,9 +156,7 @@ class ArenaPool:
             victim.revoked = True
             bufs = victim.bufs
             self.forced_rotations += 1
-            from ..utils.log import incr_counter
-
-            incr_counter("arena.pool.forced_rotation")
+            ARENA_FORCED_ROTATIONS.inc()
             for b in bufs.values():
                 b.fill(0)
         lease = _ArenaLease(key, bufs)

@@ -220,10 +220,28 @@ def planner(a: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         )
         order = lax.sort(keys, num_keys=8)[7]
 
+    # ---- decision provenance ---------------------------------------------- #
+    # The score terms of each task's claimed unit ride back to the host
+    # so "why is task X at rank Y" is answerable after the fact — the
+    # TPU-native replacement for reading the reference's comparator logs
+    # (scheduler/provenance.py). Pure gathers off arrays the planner
+    # already computed; no extra reductions.
+    bu = t_best_unit
+    t_prio = jnp.where(t_valid, priority[bu], 0.0).astype(f32)
+    t_rank = jnp.where(t_valid, rank[bu], 0.0).astype(f32)
+    t_tiq = jnp.where(t_valid, u_tiq_term[bu], 0.0).astype(f32)
+    t_stepback = jnp.where(
+        t_valid, u_stepback[bu].astype(jnp.int32), 0
+    )
+
     return {
         "order": order,
         "t_value": jnp.where(t_valid, t_best_value, 0.0),
         "t_unit": t_best_unit,
+        "t_prio": t_prio,
+        "t_rank": t_rank,
+        "t_tiq": t_tiq,
+        "t_stepback": t_stepback,
     }
 
 
@@ -459,6 +477,7 @@ def pallas_cfg_from_env(k_blocks: int) -> Tuple[bool, int, bool]:
 OUTPUT_SPEC = (
     ("order", "i32", "N"),
     ("t_unit", "i32", "N"),
+    ("t_stepback", "i32", "N"),
     ("d_new_hosts", "i32", "D"),
     ("d_free_approx", "i32", "D"),
     ("d_length", "i32", "D"),
@@ -473,6 +492,9 @@ OUTPUT_SPEC = (
     ("g_wait_over", "i32", "G"),
     ("g_merge", "i32", "G"),
     ("t_value", "f32", "N"),
+    ("t_prio", "f32", "N"),
+    ("t_rank", "f32", "N"),
+    ("t_tiq", "f32", "N"),
     ("d_expected_dur_s", "f32", "D"),
     ("d_over_dur_s", "f32", "D"),
     ("g_expected_dur_s", "f32", "G"),
@@ -549,5 +571,9 @@ def fetch_solve_packed(buf, snapshot) -> Dict:
 def run_solve_packed(snapshot) -> Dict:
     """One tick's device work with four transfers total: three arena
     buffers up (batched into the jit dispatch), one packed result buffer
-    down."""
-    return fetch_solve_packed(dispatch_solve_packed(snapshot), snapshot)
+    down. The explicit ``block_until_ready`` fences device completion
+    HERE, so a tracing span around this call owns the device time — it
+    never leaks into whichever consumer first touches the outputs."""
+    buf = dispatch_solve_packed(snapshot)
+    jax.block_until_ready(buf)
+    return fetch_solve_packed(buf, snapshot)

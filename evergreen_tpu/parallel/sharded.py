@@ -192,6 +192,7 @@ from ..scheduler.snapshot import FIELD_KINDS as _FIELD_KINDS  # noqa: E402
 _IN_KEYS = tuple(_FIELD_KINDS)
 _OUT_KEYS = (
     "order", "t_value", "t_unit",
+    "t_prio", "t_rank", "t_tiq", "t_stepback",
     "d_new_hosts", "d_free_approx", "d_length", "d_deps_met",
     "d_expected_dur_s", "d_over_count", "d_over_dur_s", "d_wait_over",
     "d_merge",

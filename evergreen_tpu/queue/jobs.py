@@ -24,8 +24,52 @@ from typing import Callable, Dict, List, Optional, Set
 
 from ..models import event as event_mod
 from ..storage.store import Store
+from ..utils import metrics as _metrics
 
 JOBS_COLLECTION = "jobs"
+
+JOBS_DUPLICATE_DROPPED = _metrics.counter(
+    "jobs_duplicate_dropped_total",
+    "Enqueues dropped because a job with the same id was already "
+    "pending or running (amboy EnqueueUnique semantics).",
+    legacy="jobs.duplicate_drop",
+)
+JOBS_QUARANTINE_DROPPED = _metrics.counter(
+    "jobs_quarantine_dropped_total",
+    "Enqueues dropped because the job type sat in poison quarantine.",
+    legacy="jobs.quarantined_drop",
+)
+JOBS_QUARANTINED = _metrics.counter(
+    "jobs_quarantined_total",
+    "Job types entering poison quarantine after consecutive failures.",
+    legacy="jobs.quarantined",
+)
+JOBS_SHED = _metrics.counter(
+    "jobs_shed_total",
+    "Jobs shed by the overload ladder or the bounded pending set, "
+    "labeled by priority class (agent/planning/reconcile/stats).",
+    labels=("job_class",),
+    legacy="overload.jobs_shed",
+)
+JOBS_PENDING = _metrics.gauge(
+    "jobs_pending",
+    "Current JobQueue pending-set depth (admitted, not yet finished).",
+)
+JOBS_RUN_MS = _metrics.histogram(
+    "jobs_run_duration_ms",
+    "Wall time of background job runs, labeled by priority class.",
+    labels=("job_class",),
+)
+CRON_SHED = _metrics.counter(
+    "cron_populator_shed_total",
+    "Populator-produced jobs whose enqueue was shed, labeled by "
+    "populator (the per-populator storm-forensics view; the shed "
+    "itself is counted by jobs_shed_total inside put()).",
+    labels=("populator",),
+    legacy=lambda labels: [
+        f"overload.cron_shed.{labels['populator']}"
+    ],
+)
 
 # -- priority classes --------------------------------------------------------- #
 # Lower number = more critical. Overload shedding (utils/overload.py
@@ -175,7 +219,7 @@ class JobQueue:
         method — the returned outcome is informational, never the only
         trace."""
         from ..utils import overload
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         now = _time.time()
         monitor = overload.monitor_for(self.store)
@@ -184,7 +228,7 @@ class JobQueue:
             if self._closed:
                 return PutOutcome(False, "closed")
             if job.job_id in self._pending:
-                incr_counter("jobs.duplicate_drop")
+                JOBS_DUPLICATE_DROPPED.inc()
                 return PutOutcome(False, "duplicate")
             until = self._quarantined_until.get(job.job_type)
             if until is not None:
@@ -200,7 +244,7 @@ class JobQueue:
                             "error": "job type is quarantined",
                         }
                     )
-                    incr_counter("jobs.quarantined_drop")
+                    JOBS_QUARANTINE_DROPPED.inc()
                     get_logger("amboy").warning(
                         "job-quarantine-drop",
                         job_id=job.job_id,
@@ -244,6 +288,12 @@ class JobQueue:
                 # naturally bounded by id-dedup and scope locks
             job._seq = self._next_seq
             self._next_seq += 1
+            # executor threads must parent their spans into the
+            # enqueuer's trace, not start fresh roots (utils/tracing.py
+            # context token; regression-tested in test_observability.py)
+            from ..utils import tracing as _tracing
+
+            job._trace_ctx = _tracing.capture_context()
             self._pending[job.job_id] = job
             self.store.collection(JOBS_COLLECTION).upsert(
                 {
@@ -258,6 +308,7 @@ class JobQueue:
             self._waiting.append(job)
             self._maybe_dispatch_locked()
             depth = len(self._pending)
+        JOBS_PENDING.set(float(depth))
         monitor.observe("queue_pending", float(depth))
         return PutOutcome(True)
 
@@ -279,15 +330,14 @@ class JobQueue:
     def _shed_locked(self, job: Job, reason: str, now: float) -> None:
         """Counted, recorded, evented shed — never a silent drop."""
         from ..utils import overload
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         # a shed job never runs, so it must not keep holding its type's
         # post-quarantine probe slot (a stuck slot would read as
         # quarantined forever); worst case a second probe is admitted
         self._probing.discard(job.job_type)
         cls = PRIORITY_NAMES.get(job.priority, str(job.priority))
-        incr_counter("overload.jobs_shed")
-        incr_counter(f"overload.jobs_shed.{cls}")
+        JOBS_SHED.inc(job_class=cls)
         self.store.collection(JOBS_COLLECTION).upsert(
             {
                 "_id": job.job_id,
@@ -334,11 +384,24 @@ class JobQueue:
     # -- execution ----------------------------------------------------------- #
 
     def _run_job(self, job: Job) -> None:
+        from ..utils import tracing as _tracing
+
         coll = self.store.collection(JOBS_COLLECTION)
         coll.update(job.job_id, {"status": "running", "started_at": _time.time()})
         error = ""
+        t_run = _time.perf_counter()
         try:
-            job.run(self.store)
+            # ring-only span: job runs are frequent and their store
+            # record already lives in the jobs collection
+            with _tracing.attached(getattr(job, "_trace_ctx", None)), \
+                    _tracing.Tracer(self.store, "amboy").span(
+                        "job.run", store_write=False,
+                        job_type=job.job_type,
+                        job_class=PRIORITY_NAMES.get(
+                            job.priority, str(job.priority)
+                        ),
+                    ):
+                job.run(self.store)
         except Exception:  # job errors must never kill the worker pool
             error = traceback.format_exc()
             event_mod.log(
@@ -364,6 +427,10 @@ class JobQueue:
                 "error": error[-2000:],
             },
         )
+        JOBS_RUN_MS.observe(
+            (_time.perf_counter() - t_run) * 1e3,
+            job_class=PRIORITY_NAMES.get(job.priority, str(job.priority)),
+        )
         self._account_outcome(job, failed=bool(error))
         with self._lock:
             self._pending.pop(job.job_id, None)
@@ -375,6 +442,7 @@ class JobQueue:
             depth = len(self._pending)
         from ..utils import overload
 
+        JOBS_PENDING.set(float(depth))
         overload.monitor_for(self.store).observe(
             "queue_pending", float(depth)
         )
@@ -382,7 +450,7 @@ class JobQueue:
     def _account_outcome(self, job: Job, failed: bool) -> None:
         """Poison accounting: consecutive failures per job type arm the
         quarantine; one success clears it."""
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         with self._lock:
             self._probing.discard(job.job_type)
@@ -399,7 +467,7 @@ class JobQueue:
             if n >= self.poison_threshold or was_probe:
                 until = _time.time() + self.quarantine_s
                 self._quarantined_until[job.job_type] = until
-                incr_counter("jobs.quarantined")
+                JOBS_QUARANTINED.inc()
                 get_logger("amboy").error(
                     "job-quarantined",
                     job_type=job.job_type,
@@ -453,8 +521,6 @@ class CronRunner:
         self.ops.append(op)
 
     def tick(self, now: Optional[float] = None, force: bool = False) -> int:
-        from ..utils.log import incr_counter
-
         now = _time.time() if now is None else now
         n = 0
         for op in self.ops:
@@ -467,7 +533,7 @@ class CronRunner:
                     elif outcome.reason.startswith("shed"):
                         # the put already counted/recorded the shed; this
                         # adds the per-populator view for storm forensics
-                        incr_counter(f"overload.cron_shed.{op.name}")
+                        CRON_SHED.inc(populator=op.name)
         return n
 
     def run_background(self, poll_s: float = 1.0) -> None:

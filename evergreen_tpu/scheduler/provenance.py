@@ -1,0 +1,165 @@
+"""Solve decision provenance: why is task X at rank Y?
+
+The reference answers ranking questions by reading comparator logs —
+the cmp-based scheduler records which comparator decided each pairwise
+ordering (scheduler/comparator.go). The batched TPU solve has no
+pairwise comparisons to log: a task's place is determined by its claimed
+unit's score terms and the lexicographic sort keys. So provenance here
+is the per-task capture of exactly those terms, gathered from arrays the
+planner already computed (ops/solve.py planner: ``t_prio`` /
+``t_rank`` / ``t_tiq`` / ``t_stepback`` ride the packed result buffer
+down beside ``t_value``) and sliced per distro in queue order.
+
+One ``TickProvenance`` is built per solve tick by ``_unpack_solve``
+(scheduler/wrapper.py), attached to ``TickResult.provenance``, and kept
+as ``store._last_provenance`` so the admin surface
+(``GET /rest/v2/admin/provenance/{distro}``) can answer after the fact.
+Construction cost is five N-element gathers off buffers the unpack
+already fetched — no extra device work, no per-task Python objects.
+
+The terms reproduce the serial oracle's ``unit_value`` decomposition
+(scheduler/serial.py: ``value = priority * rank + unit_len``), which is
+what the provenance-vs-oracle parity test pins: for every planned task,
+``value`` here equals the oracle's sort value and the explained
+priority/rank terms multiply back into it.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class TickProvenance:
+    """Per-distro solve score terms, aligned with the planned queues.
+
+    ``tasks`` is the tick's globally ordered task list (the same list
+    ``_unpack_solve`` slices into plans), ``bounds[i]:bounds[i+1]`` is
+    distro ``distro_ids[i]``'s segment, and the term arrays are aligned
+    with ``tasks`` — so every accessor is a slice, never a scan.
+    """
+
+    __slots__ = (
+        "distro_ids", "_bounds", "_tasks",
+        "_value", "_prio", "_rank", "_tiq", "_stepback",
+    )
+
+    def __init__(
+        self,
+        distro_ids: List[str],
+        bounds: np.ndarray,
+        tasks: list,
+        value: np.ndarray,
+        prio: np.ndarray,
+        rank: np.ndarray,
+        tiq: np.ndarray,
+        stepback: np.ndarray,
+    ) -> None:
+        self.distro_ids = list(distro_ids)
+        self._bounds = bounds
+        self._tasks = tasks
+        self._value = value
+        self._prio = prio
+        self._rank = rank
+        self._tiq = tiq
+        self._stepback = stepback
+
+    # -- accessors ----------------------------------------------------------- #
+
+    def _segment(self, distro_id: str) -> Optional[range]:
+        try:
+            di = self.distro_ids.index(distro_id)
+        except ValueError:
+            return None
+        return range(int(self._bounds[di]), int(self._bounds[di + 1]))
+
+    def queue_length(self, distro_id: str) -> int:
+        seg = self._segment(distro_id)
+        return len(seg) if seg is not None else 0
+
+    def ranked_ids(self, distro_id: str) -> List[str]:
+        seg = self._segment(distro_id)
+        if seg is None:
+            return []
+        return [self._tasks[i].id for i in seg]
+
+    def _term_doc(self, i: int, rank_pos: int) -> Dict:
+        t = self._tasks[i]
+        return {
+            "task": t.id,
+            "rank": rank_pos,
+            # the decomposition of the claimed unit's sort value
+            # (serial.py unit_value: value = priority * rank + len)
+            "value": round(float(self._value[i]), 4),
+            "priority_term": round(float(self._prio[i]), 4),
+            "rank_term": round(float(self._rank[i]), 4),
+            "time_in_queue_term": round(float(self._tiq[i]), 4),
+            "stepback": bool(self._stepback[i]),
+            # raw task fields that feed the tie-break sort keys
+            "task_priority": int(t.priority),
+            "num_dependents": int(t.num_dependents),
+            "expected_duration_s": round(float(t.expected_duration_s), 2),
+            "in_task_group": bool(t.task_group),
+        }
+
+    def explain(self, distro_id: str, task_id: str) -> Optional[Dict]:
+        """The score terms that put ``task_id`` where it is in
+        ``distro_id``'s planned queue, or None when it is not in the
+        plan."""
+        seg = self._segment(distro_id)
+        if seg is None:
+            return None
+        for rank_pos, i in enumerate(seg):
+            if self._tasks[i].id == task_id:
+                return self._term_doc(i, rank_pos)
+        return None
+
+    def explain_rank(self, distro_id: str, rank_pos: int) -> Optional[Dict]:
+        seg = self._segment(distro_id)
+        if seg is None or not 0 <= rank_pos < len(seg):
+            return None
+        return self._term_doc(seg[rank_pos], rank_pos)
+
+    def to_doc(self, distro_id: str, limit: int = 25) -> Optional[Dict]:
+        """Admin-surface payload: the distro's queue head with terms."""
+        seg = self._segment(distro_id)
+        if seg is None:
+            return None
+        return {
+            "distro": distro_id,
+            "queue_length": len(seg),
+            "tasks": [
+                self._term_doc(i, pos)
+                for pos, i in enumerate(seg)
+                if pos < max(0, int(limit))
+            ],
+        }
+
+
+def build_provenance(snapshot, out: Dict, real: np.ndarray,
+                     ordered_tasks: list, vals: np.ndarray,
+                     bounds: np.ndarray) -> TickProvenance:
+    """Gather the solve's per-task score terms into queue order.
+    ``real``/``ordered_tasks``/``vals``/``bounds`` come straight from
+    ``_unpack_solve``'s existing work — only the four extra term columns
+    are gathered here."""
+    def g(name, dtype=float):
+        return np.asarray(out[name])[real].astype(dtype, copy=False)
+
+    return TickProvenance(
+        snapshot.distro_ids,
+        bounds,
+        ordered_tasks,
+        vals,
+        g("t_prio"),
+        g("t_rank"),
+        g("t_tiq"),
+        g("t_stepback", dtype=np.int32),
+    )
+
+
+def provenance_for(store) -> Optional[TickProvenance]:
+    """The most recent solve tick's provenance on this store (None
+    before the first solve tick, or after a serial/degraded tick that
+    produced none — the previous solve tick's answer is kept)."""
+    return getattr(store, "_last_provenance", None)

@@ -46,6 +46,14 @@ from ..models import event as event_mod
 from ..models import host as host_mod
 from ..models import task as task_mod
 from ..storage.store import Store
+from ..utils import metrics as _metrics
+
+RECOVERY_RECONCILED = _metrics.counter(
+    "recovery_reconciled_tasks_total",
+    "Tasks healed by the startup reconciliation pass (released "
+    "half-dispatched claims + reset/system-failed stranded tasks).",
+    legacy="recovery.reconciled_tasks",
+)
 
 #: an in-flight task with no heartbeat for this long at recovery time is
 #: presumed dead (same window the periodic monitor uses,
@@ -203,7 +211,7 @@ def run_recovery_pass(
     """The full reconciliation pass; runs after lease acquisition + WAL
     replay and before the job plane starts."""
     from ..utils import faults
-    from ..utils.log import get_logger, incr_counter
+    from ..utils.log import get_logger
 
     faults.fire("recovery.pass")
     now = _time.time() if now is None else now
@@ -233,6 +241,6 @@ def run_recovery_pass(
         plane.invalidate("recovery")
 
     if report.reconciled_tasks:
-        incr_counter("recovery.reconciled_tasks", report.reconciled_tasks)
+        RECOVERY_RECONCILED.inc(report.reconciled_tasks)
     get_logger("resilience").info("recovery-pass", **report.to_doc())
     return report

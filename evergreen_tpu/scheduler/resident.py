@@ -71,8 +71,17 @@ from ..globals import MAX_TASK_TIME_IN_QUEUE_S
 from ..models.distro import Distro
 from ..models.task import Task
 from ..storage.store import Store
+from ..utils import metrics as _metrics
 from ..utils.circuit import CircuitBreaker
-from ..utils.log import get_logger, incr_counter
+from ..utils.log import get_logger
+
+RESIDENT_EVENTS = _metrics.counter(
+    "resident_plane_events_total",
+    "Device-resident state-plane lifecycle events, labeled by outcome "
+    "(invalidated / delta_failed / fallback / rebuilds).",
+    labels=("outcome",),
+    legacy=lambda labels: [f"resident.{labels['outcome']}"],
+)
 from .snapshot import (
     _STATIC_ARENA_COLS,
     FIELD_KINDS,
@@ -240,7 +249,7 @@ class ResidentPlane:
         self._pending_reason = reason
         if self._mirror is not None:
             self._mirror.reset()
-        incr_counter("resident.invalidated")
+        RESIDENT_EVENTS.inc(outcome="invalidated")
 
     def stats(self) -> dict:
         out = {
@@ -275,40 +284,52 @@ class ResidentPlane:
         then takes the classic full-rebuild path) — the plane never lets
         an internal error escape into the tick."""
         try:
-            prime_gen, dm_dirty, hosts_dirty = cache.drain_resident_deltas()
-            reason = self._gap_reason(solver_distros, prime_gen)
-            if reason is None and not self._breaker.allow(now=now):
-                reason = "breaker-open"
-            if reason is None:
-                try:
-                    self._apply_deltas(
-                        cache, solver_distros, tasks_by_distro,
-                        hosts_by_distro, running_estimates, deps_met,
-                        dm_dirty, hosts_dirty,
-                    )
-                    self._breaker.record_success(now=now)
-                except _NeedRelayout as exc:
-                    reason = f"overflow:{exc}"
-                except Exception as exc:  # noqa: BLE001 — any delta bug
-                    # degrades to a rebuild, never a wrong snapshot
-                    self._breaker.record_failure(now=now, error=repr(exc))
-                    incr_counter("resident.delta_failed")
-                    get_logger("resilience").error(
-                        "resident-delta-failed", error=repr(exc)[-300:]
-                    )
-                    reason = "delta-error"
-            if reason is not None:
-                self._rebuild(
-                    solver_distros, tasks_by_distro, hosts_by_distro,
-                    running_estimates, deps_met, prime_gen, reason,
+            from ..utils.tracing import Tracer
+
+            _tracer = Tracer(self.store, "resident")
+            # resident_apply: drain the cache's delta stream and mutate
+            # the persistent columns in place (or slab-rebuild on a gap)
+            with _tracer.span("resident_apply") as _apply_span:
+                prime_gen, dm_dirty, hosts_dirty = (
+                    cache.drain_resident_deltas()
                 )
-            self._refresh_time_columns(now)
-            return self._publish(now, arena_pool)
+                reason = self._gap_reason(solver_distros, prime_gen)
+                if reason is None and not self._breaker.allow(now=now):
+                    reason = "breaker-open"
+                if reason is None:
+                    try:
+                        self._apply_deltas(
+                            cache, solver_distros, tasks_by_distro,
+                            hosts_by_distro, running_estimates, deps_met,
+                            dm_dirty, hosts_dirty,
+                        )
+                        self._breaker.record_success(now=now)
+                    except _NeedRelayout as exc:
+                        reason = f"overflow:{exc}"
+                    except Exception as exc:  # noqa: BLE001 — any delta bug
+                        # degrades to a rebuild, never a wrong snapshot
+                        self._breaker.record_failure(now=now, error=repr(exc))
+                        RESIDENT_EVENTS.inc(outcome="delta_failed")
+                        get_logger("resilience").error(
+                            "resident-delta-failed", error=repr(exc)[-300:]
+                        )
+                        reason = "delta-error"
+                if reason is not None:
+                    self._rebuild(
+                        solver_distros, tasks_by_distro, hosts_by_distro,
+                        running_estimates, deps_met, prime_gen, reason,
+                    )
+                self._refresh_time_columns(now)
+                _apply_span["attributes"]["rebuild_reason"] = reason or ""
+            # pack: publish the truth into a leased transfer arena (or
+            # ship dirty spans to the device mirror)
+            with _tracer.span("pack", mode="resident"):
+                return self._publish(now, arena_pool)
         except Exception as exc:  # noqa: BLE001 — full fallback: the tick
             # proceeds on build_snapshot; state is dropped so the next
             # sync starts clean
             self.fallbacks += 1
-            incr_counter("resident.fallback")
+            RESIDENT_EVENTS.inc(outcome="fallback")
             get_logger("resilience").error(
                 "resident-fallback", error=repr(exc)[-300:]
             )
@@ -363,7 +384,7 @@ class ResidentPlane:
         evgpack = get_evgpack()
         self.rebuilds += 1
         self.rebuild_reasons[reason] = self.rebuild_reasons.get(reason, 0) + 1
-        incr_counter("resident.rebuilds")
+        RESIDENT_EVENTS.inc(outcome="rebuilds")
         n_d = len(solver_distros)
 
         # pass 1: per-distro memberships in LOCAL coordinates — base 0,
@@ -1400,12 +1421,15 @@ class ResidentPlane:
         in-flight solve of a pipelined tick must never alias the mutable
         truth — XLA's CPU client zero-copies aligned host buffers), or
         hand the device mirror the dirty spans when it is enabled."""
+        from ..utils.tracing import Tracer
+
         if self._mirror is not None:
             dev_bufs = self._mirror.sync(self._truth.buffers, self._spans)
             self._spans = {}
             arena = _MirrorArena(self._truth, dev_bufs)
         else:
-            arena = arena_for_dims(self.dims, arena_pool)
+            with Tracer(self.store, "resident").span("arena_lease"):
+                arena = arena_for_dims(self.dims, arena_pool)
             for kind, buf in arena.buffers.items():
                 np.copyto(buf, self._truth.buffers[kind])
         arrays = {

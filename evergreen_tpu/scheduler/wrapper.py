@@ -32,9 +32,36 @@ from ..models.host import Host, new_intent
 from ..models.task import Task
 from ..models.task_queue import DistroQueueInfo, QueueInfoView
 from ..storage.store import Store
+from ..utils import metrics as _metrics
+from ..utils import tracing as _tracing
 from . import serial
 from .persister import persist_task_queue
 from .snapshot import Snapshot, build_snapshot
+
+TICK_DEGRADED = _metrics.counter(
+    "scheduler_tick_degraded_total",
+    "Tick degradations by cause (fenced / persist_failed / breaker_open "
+    "/ solve_failed / shed); one tick can count several causes.",
+    labels=("cause",),
+    legacy=lambda labels: [f"scheduler.tick.{labels['cause']}"],
+)
+TICKS_TOTAL = _metrics.counter(
+    "scheduler_ticks_total",
+    "Completed scheduler ticks by outcome ('ok' or the degradation "
+    "reason carried on TickResult.degraded).",
+    labels=("outcome",),
+)
+TICK_MS = _metrics.histogram(
+    "scheduler_tick_duration_ms",
+    "Wall time of one full scheduling tick (gather through WAL "
+    "commit) — the one timing source of truth bench.py reads.",
+)
+TICK_PHASE_MS = _metrics.histogram(
+    "scheduler_tick_phase_duration_ms",
+    "Wall time of each tick pipeline phase (delta_drain / pack / solve "
+    "/ unpack / persist / wal_commit).",
+    labels=("phase",),
+)
 
 
 #: distro-id suffix marking secondary (alias) queue rows in the solve
@@ -175,6 +202,15 @@ class TickResult:
     #: ("green" | "yellow" | "red" | "black") — the degraded-status
     #: field's brownout sibling
     overload: str = "green"
+    #: id of the tick's trace (utils/tracing.py): the whole pipeline —
+    #: delta drain → pack → solve → unpack → persist → WAL commit (and
+    #: the async flusher's write, and subsequent dispatch assigns) — is
+    #: one span tree under this id; "" when tracing is disabled
+    trace_id: str = ""
+    #: per-distro solve score terms (scheduler/provenance.py) so "why is
+    #: task X at rank Y" is answerable after the tick; None on serial /
+    #: degraded ticks
+    provenance: Optional["TickProvenance"] = None
 
 
 def gather_tick_inputs(
@@ -315,10 +351,11 @@ def gather_tick_inputs(
 def _unpack_solve(
     snapshot: Snapshot,
     out: Dict[str, np.ndarray],
-) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, QueueInfoView], Dict[str, int], Dict[str, List[bool]], dict]:
+) -> Tuple[Dict[str, List[Task]], Dict[str, Dict[str, float]], Dict[str, QueueInfoView], Dict[str, int], Dict[str, List[bool]], dict, "TickProvenance"]:
     """Device outputs → per-distro ordered plans, sort values, positional
-    deps-met columns, lazy queue-info views, spawn counts, and the shared
-    raw info columns (for the persister's whole-tick epoch compare)."""
+    deps-met columns, lazy queue-info views, spawn counts, the shared
+    raw info columns (for the persister's whole-tick epoch compare), and
+    the tick's decision provenance (scheduler/provenance.py)."""
     flat = snapshot.flat_tasks
     n = snapshot.n_tasks
     # The solve's first sort key is the distro index (invalid/hole slots
@@ -385,9 +422,14 @@ def _unpack_solve(
     for di, did in enumerate(snapshot.distro_ids):
         infos[did] = QueueInfoView(di, seg_ids_by_di.get(di, ()), cols)
         new_hosts[did] = int(d_new[di])
+    from .provenance import build_provenance
+
+    provenance = build_provenance(
+        snapshot, out, real, ordered_tasks, vals, bounds
+    )
     return plans, sort_values, infos, new_hosts, met_cols, (
         cols, snapshot.distro_ids, seg_ids_by_di,
-    )
+    ), provenance
 
 
 def _apply_release_mode(store: Store, distros):
@@ -455,10 +497,14 @@ def _solve_bounded(store: Store, snapshot, deadline_s: float):
     if deadline_s <= 0:
         return work()
     result: list = []
+    # the worker thread parents any spans/breadcrumbs it emits into the
+    # caller's tick trace instead of rooting fresh
+    ctx = _tracing.capture_context()
 
     def runner():
         try:
-            result.append(("ok", work()))
+            with _tracing.attached(ctx):
+                result.append(("ok", work()))
         except BaseException as exc:  # noqa: BLE001 — relayed to caller
             result.append(("err", exc))
 
@@ -480,24 +526,53 @@ def run_tick(
     opts: Optional[TickOptions] = None,
     now: Optional[float] = None,
 ) -> TickResult:
-    """One full scheduling tick over every distro."""
+    """One full scheduling tick over every distro. The whole tick is ONE
+    trace: a root ``tick`` span here, phase spans in the body, and the
+    async WAL flusher / later dispatch assigns parenting in through the
+    captured context (``TickResult.trace_id``)."""
 
     opts = opts or TickOptions()
     now = _time.time() if now is None else now
+    with _tracing.Tracer(store, "scheduler").span(
+        "tick", planner=opts.planner_version
+    ) as _tick_span:
+        result = _run_tick_guarded(store, opts, now, _tick_span)
+        result.trace_id = _tick_span.get("trace_root", "")
+        _tick_span["attributes"].update(
+            n_tasks=result.n_tasks,
+            n_distros=result.n_distros,
+            planner_used=result.planner_used,
+            degraded=result.degraded,
+            overload=result.overload,
+            shed=list(result.shed),
+        )
+    TICK_MS.observe(result.total_ms)
+    TICKS_TOTAL.inc(outcome=result.degraded or "ok")
+    return result
+
+
+def _run_tick_guarded(
+    store: Store, opts: TickOptions, now: float, tick_span: dict
+) -> TickResult:
     t0 = _time.perf_counter()
 
     from ..storage.lease import EpochFencedError
     from .persister import persister_state_for
 
     pstate = persister_state_for(store)
-    from ..utils.log import get_logger, incr_counter
+    from ..utils.log import get_logger
 
     _rlog = get_logger("resilience")
+
+    # dispatch assigns that follow this tick parent into its trace (the
+    # "…→ dispatch" leg of the tick span tree); harmless when tracing is
+    # off — the context is None and assigns root themselves
+    store._last_tick_trace = _tracing.capture_context()
 
     def _fenced_result() -> TickResult:
         # the holder's lease epoch was superseded: plan nothing, write
         # nothing — stand-down already fired through the lease's on_lost
-        incr_counter("scheduler.tick.fenced")
+        TICK_DEGRADED.inc(cause="fenced")
         _invalidate_resident(store, "fenced")
         _rlog.error("degraded-tick", reason="fenced", fallback="none")
         return TickResult(
@@ -542,7 +617,7 @@ def run_tick(
         prior_persist_failed = True
         pstate.reset()
         store.heal_durability()
-        incr_counter("scheduler.tick.persist_failed")
+        TICK_DEGRADED.inc(cause="persist_failed")
         _rlog.error(
             "wal-group-commit-failed",
             deferred=True,
@@ -604,11 +679,11 @@ def _commit_tick_group(store: Store, opts: TickOptions) -> str:
         # report it, write nothing more (no heal: a fenced holder must
         # not touch the snapshot a newer epoch now owns)
         from .persister import persister_state_for
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         persister_state_for(store).reset()
         _invalidate_resident(store, "fenced")
-        incr_counter("scheduler.tick.fenced")
+        TICK_DEGRADED.inc(cause="fenced")
         get_logger("resilience").error(
             "tick-fenced",
             epoch=getattr(store, "epoch", 0),
@@ -617,11 +692,11 @@ def _commit_tick_group(store: Store, opts: TickOptions) -> str:
     except Exception as exc:  # noqa: BLE001 — a WAL error degrades the
         # tick, never kills it
         from .persister import persister_state_for
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
         persister_state_for(store).reset()
         store.heal_durability()
-        incr_counter("scheduler.tick.persist_failed")
+        TICK_DEGRADED.inc(cause="persist_failed")
         get_logger("resilience").error(
             "wal-group-commit-failed",
             deferred=False,
@@ -644,22 +719,30 @@ def _run_tick_body(
             store, "", now, UNDERWATER_UNSCHEDULE_THRESHOLD_S
         )
 
-    if opts.use_cache:
-        (
-            distros,
-            tasks_by_distro,
-            hosts_by_distro,
-            running_estimates,
-            deps_met,
-        ) = tick_cache_for(store).gather(now)
-    else:
-        (
-            distros,
-            tasks_by_distro,
-            hosts_by_distro,
-            running_estimates,
-            deps_met,
-        ) = gather_tick_inputs(store, now)
+    # delta drain: the TickCache's maintained views (or the cold
+    # finders) become this tick's solver inputs
+    _tracer = _tracing.Tracer(store, "scheduler")
+    t_gather = _time.perf_counter()
+    with _tracer.span("delta_drain", cached=opts.use_cache):
+        if opts.use_cache:
+            (
+                distros,
+                tasks_by_distro,
+                hosts_by_distro,
+                running_estimates,
+                deps_met,
+            ) = tick_cache_for(store).gather(now)
+        else:
+            (
+                distros,
+                tasks_by_distro,
+                hosts_by_distro,
+                running_estimates,
+                deps_met,
+            ) = gather_tick_inputs(store, now)
+    TICK_PHASE_MS.observe(
+        (_time.perf_counter() - t_gather) * 1e3, phase="delta_drain"
+    )
 
     distros = _apply_release_mode(store, distros)
 
@@ -693,8 +776,9 @@ def _run_tick_body(
     # the batched persist failure
     degraded = "persist-failed" if prior_persist_failed else ""
     shed: List[str] = []
+    provenance = None
     from ..utils import faults
-    from ..utils.log import get_logger, incr_counter
+    from ..utils.log import get_logger
 
     _rlog = get_logger("resilience")
 
@@ -710,7 +794,7 @@ def _run_tick_body(
     if want_tpu and not breaker.allow(now=now):
         want_tpu = False
         degraded = degraded or "breaker-open"
-        incr_counter("scheduler.tick.breaker_open")
+        TICK_DEGRADED.inc(cause="breaker_open")
         _rlog.warning(
             "degraded-tick", reason=degraded, fallback="serial"
         )
@@ -732,21 +816,35 @@ def _run_tick_body(
                     arena_pool=arena_pool,
                 )
             if snapshot is None:
-                snapshot = build_snapshot(
-                    solver_distros, tasks_by_distro, hosts_by_distro,
-                    running_estimates, deps_met, now, dims_memo=dims_memo,
-                    memb_memo=memb_memo, arena_pool=arena_pool,
-                )
+                # full-rebuild pack (the resident plane packs inside its
+                # own "pack" span via _publish)
+                with _tracer.span("pack", mode="rebuild"):
+                    snapshot = build_snapshot(
+                        solver_distros, tasks_by_distro, hosts_by_distro,
+                        running_estimates, deps_met, now,
+                        dims_memo=dims_memo,
+                        memb_memo=memb_memo, arena_pool=arena_pool,
+                    )
             t2 = _time.perf_counter()
             # bounded solve (optionally XLA-profiled inside — SURVEY §5:
             # profiler hooks beside the control-plane spans, enabled via
-            # the tracer config's xla_profile_dir)
-            out = _solve_bounded(store, snapshot, opts.solve_deadline_s)
+            # the tracer config's xla_profile_dir). run_solve_packed
+            # fences with jax.block_until_ready, so the device time lands
+            # in THIS span instead of leaking into the first consumer.
+            with _tracer.span("solve", deadline_s=opts.solve_deadline_s):
+                out = _solve_bounded(store, snapshot, opts.solve_deadline_s)
             t3 = _time.perf_counter()
             snapshot_ms = (t2 - t1) * 1e3
             solve_ms = (t3 - t2) * 1e3
-            (plans, sort_values, infos, new_hosts, met_cols,
-             info_epoch) = _unpack_solve(snapshot, out)
+            TICK_PHASE_MS.observe(snapshot_ms, phase="pack")
+            TICK_PHASE_MS.observe(solve_ms, phase="solve")
+            t_u = _time.perf_counter()
+            with _tracer.span("unpack"):
+                (plans, sort_values, infos, new_hosts, met_cols,
+                 info_epoch, provenance) = _unpack_solve(snapshot, out)
+            TICK_PHASE_MS.observe(
+                (_time.perf_counter() - t_u) * 1e3, phase="unpack"
+            )
             pstate.note_solve_infos(*info_epoch)
             planner_used = "tpu"
             breaker.record_success(now=now)
@@ -758,7 +856,7 @@ def _run_tick_body(
                 else "solve-failed"
             )
             breaker.record_failure(now=now, error=repr(exc))
-            incr_counter("scheduler.tick.solve_failed")
+            TICK_DEGRADED.inc(cause="solve_failed")
             _rlog.error(
                 "degraded-tick",
                 reason=degraded,
@@ -767,6 +865,7 @@ def _run_tick_body(
             )
             plans, sort_values, infos, met_cols = {}, {}, {}, {}
             new_hosts = {}
+            provenance = None
         finally:
             # return the pool-leased transfer arena even when the solve
             # raised (a fault-injected failure must not strand the slot —
@@ -865,96 +964,122 @@ def _run_tick_body(
         return ""
 
     tick_cache = tick_cache_for(store) if opts.use_cache else None
-    for d in distros:
-        plan = plans.get(d.id, [])
-        is_alias = d.id.endswith(ALIAS_SUFFIX)
-        base_id = d.id[: -len(ALIAS_SUFFIX)] if is_alias else d.id
-        info = infos.get(d.id, DistroQueueInfo())
-        info.secondary_queue = is_alias
-        try:
-            queues[d.id] = persist_task_queue(
-                store,
-                base_id,
-                plan,
-                sort_values.get(d.id, {}),
-                met_cols.get(d.id, deps_met),
-                info,
-                opts.max_scheduled_per_distro,
-                secondary=is_alias,
-                now=now,
-                state=pstate,
-                # the cache's per-distro unstamped set collapses the
-                # 50k-row candidate scan to the handful of fresh tasks
-                # (alias plans hold other distros' tasks — those scan)
-                stamp_hint=(
-                    tick_cache.stamp_candidates(d.id)
-                    if tick_cache is not None and not is_alias else None
-                ),
-            )
-        except Exception as exc:  # noqa: BLE001 — isolate per distro
-            queues[d.id] = 0
-            # the doc may be half-written: drop its fingerprint so the
-            # next tick full-rewrites instead of patching a broken base
-            pstate._fps.pop((base_id, is_alias), None)
-            degraded = degraded or "persist-failed"
-            incr_counter("scheduler.tick.persist_failed")
-            _rlog.error(
-                "queue-persist-failed",
-                distro=base_id,
-                error=repr(exc)[-300:],
-            )
-            continue
-        if is_alias:
-            continue  # alias rows never spawn hosts (units/scheduler_alias.go)
-        if opts.create_intent_hosts:
-            n = min(new_hosts.get(d.id, 0), budget)
-            budget -= n
-            created = []
+    # persist phase span: per-distro failures are caught inside the
+    # loop; the finally closes the span even on a fatal escape (an
+    # abandoned contextmanager would re-attach the finished context at
+    # GC time on whatever that thread runs next)
+    t_persist = _time.perf_counter()
+    _persist_cm = _tracer.span("persist", n_distros=len(distros))
+    _persist_rec = _persist_cm.__enter__()
+    _shapes_before = (
+        pstate.skipped, pstate.patched, pstate.spliced, pstate.rewritten,
+    )
+    try:
+        for d in distros:
+            plan = plans.get(d.id, [])
+            is_alias = d.id.endswith(ALIAS_SUFFIX)
+            base_id = d.id[: -len(ALIAS_SUFFIX)] if is_alias else d.id
+            info = infos.get(d.id, DistroQueueInfo())
+            info.secondary_queue = is_alias
             try:
-                for _ in range(n):
-                    intent = new_intent(d.id, d.provider)
-                    host_mod.insert(store, intent)
-                    created.append(intent)
+                queues[d.id] = persist_task_queue(
+                    store,
+                    base_id,
+                    plan,
+                    sort_values.get(d.id, {}),
+                    met_cols.get(d.id, deps_met),
+                    info,
+                    opts.max_scheduled_per_distro,
+                    secondary=is_alias,
+                    now=now,
+                    state=pstate,
+                    # the cache's per-distro unstamped set collapses the
+                    # 50k-row candidate scan to the handful of fresh tasks
+                    # (alias plans hold other distros' tasks — those scan)
+                    stamp_hint=(
+                        tick_cache.stamp_candidates(d.id)
+                        if tick_cache is not None and not is_alias else None
+                    ),
+                )
             except Exception as exc:  # noqa: BLE001 — isolate per distro
+                queues[d.id] = 0
+                # the doc may be half-written: drop its fingerprint so the
+                # next tick full-rewrites instead of patching a broken base
+                pstate._fps.pop((base_id, is_alias), None)
                 degraded = degraded or "persist-failed"
-                incr_counter("scheduler.tick.persist_failed")
+                TICK_DEGRADED.inc(cause="persist_failed")
                 _rlog.error(
-                    "intent-create-failed",
+                    "queue-persist-failed",
                     distro=base_id,
                     error=repr(exc)[-300:],
                 )
-            intent_hosts.extend(created)
-            if created:
-                # event emission is optional work: over the tick budget
-                # (or under brownout) it is shed before anything that
-                # affects planning
-                shed_reason = _shed_optional()
-                if shed_reason:
-                    if "events" not in shed:
-                        shed.append("events")
-                        overload_mod.record_shed(
-                            store, "tick", "events", detail=shed_reason
-                        )
-                    continue
+                continue
+            if is_alias:
+                continue  # alias rows never spawn hosts (units/scheduler_alias.go)
+            if opts.create_intent_hosts:
+                n = min(new_hosts.get(d.id, 0), budget)
+                budget -= n
+                created = []
                 try:
-                    event_mod.log(
-                        store,
-                        event_mod.RESOURCE_HOST,
-                        "HOSTS_CREATED",
-                        d.id,
-                        {"count": len(created)},
-                        timestamp=now,
-                    )
-                except Exception as exc:  # noqa: BLE001 — events are
-                    # optional work; a storage fault here never kills
-                    # the tick
+                    for _ in range(n):
+                        intent = new_intent(d.id, d.provider)
+                        host_mod.insert(store, intent)
+                        created.append(intent)
+                except Exception as exc:  # noqa: BLE001 — isolate per distro
                     degraded = degraded or "persist-failed"
-                    incr_counter("scheduler.tick.persist_failed")
+                    TICK_DEGRADED.inc(cause="persist_failed")
                     _rlog.error(
-                        "event-emit-failed",
+                        "intent-create-failed",
                         distro=base_id,
                         error=repr(exc)[-300:],
                     )
+                intent_hosts.extend(created)
+                if created:
+                    # event emission is optional work: over the tick budget
+                    # (or under brownout) it is shed before anything that
+                    # affects planning
+                    shed_reason = _shed_optional()
+                    if shed_reason:
+                        if "events" not in shed:
+                            shed.append("events")
+                            overload_mod.record_shed(
+                                store, "tick", "events", detail=shed_reason
+                            )
+                        continue
+                    try:
+                        event_mod.log(
+                            store,
+                            event_mod.RESOURCE_HOST,
+                            "HOSTS_CREATED",
+                            d.id,
+                            {"count": len(created)},
+                            timestamp=now,
+                        )
+                    except Exception as exc:  # noqa: BLE001 — events are
+                        # optional work; a storage fault here never kills
+                        # the tick
+                        degraded = degraded or "persist-failed"
+                        TICK_DEGRADED.inc(cause="persist_failed")
+                        _rlog.error(
+                            "event-emit-failed",
+                            distro=base_id,
+                            error=repr(exc)[-300:],
+                        )
+
+    finally:
+        # close the persist span with the write shapes the delta
+        # persister chose this tick (skip / column-patch / splice /
+        # full rewrite)
+        _persist_rec["attributes"].update(
+            skip=pstate.skipped - _shapes_before[0],
+            patch=pstate.patched - _shapes_before[1],
+            splice=pstate.spliced - _shapes_before[2],
+            rewrite=pstate.rewritten - _shapes_before[3],
+        )
+        _persist_cm.__exit__(None, None, None)
+    TICK_PHASE_MS.observe(
+        (_time.perf_counter() - t_persist) * 1e3, phase="persist"
+    )
 
     # Stats are the FIRST work shed under the tick budget (before events,
     # long before planning): the time-to-empty estimate + tracer span are
@@ -988,7 +1113,7 @@ def _run_tick_body(
         worst = max(tte.items(), key=lambda kv: kv[1]) if tte else ("", 0.0)
 
         with Tracer(store, "scheduler").span(
-            "tick",
+            "tick_stats",
             n_tasks=n_tasks,
             n_distros=len(distros),
             snapshot_ms=round(snapshot_ms, 2),
@@ -1000,7 +1125,7 @@ def _run_tick_body(
         ):
             pass
     if shed:
-        incr_counter("scheduler.tick.shed")
+        TICK_DEGRADED.inc(cause="shed")
         _rlog.warning(
             "degraded-tick",
             reason=stats_shed_reason or "budget-exceeded",
@@ -1016,17 +1141,22 @@ def _run_tick_body(
     # one of the storms the brownout must answer.
     committed[0] = True
     t_commit = _time.perf_counter()
-    commit_reason = _commit_tick_group(store, opts)
-    monitor.observe(
-        "store_latency_ms",
-        (_time.perf_counter() - t_commit) * 1e3,
-        ewma=0.4,
-    )
+    with _tracer.span(
+        "wal_commit", mode="async" if opts.async_persist else "sync"
+    ):
+        commit_reason = _commit_tick_group(store, opts)
+    commit_ms = (_time.perf_counter() - t_commit) * 1e3
+    TICK_PHASE_MS.observe(commit_ms, phase="wal_commit")
+    monitor.observe("store_latency_ms", commit_ms, ewma=0.4)
     if commit_reason == "fenced":
         degraded = "fenced"  # supersedes any earlier per-distro reason
     else:
         degraded = degraded or commit_reason
     total_ms = (_time.perf_counter() - t0) * 1e3
+    if provenance is not None:
+        # "why is task X at rank Y" stays answerable after the tick
+        # (served by GET /rest/v2/admin/provenance/{distro})
+        store._last_provenance = provenance
     # the structured runtime-stats line operators grep for (reference
     # grip message.Fields, scheduler/wrapper.go:93-128); it survives
     # shedding — it IS the breadcrumb trail
@@ -1058,4 +1188,5 @@ def _run_tick_body(
         degraded=degraded,
         shed=shed,
         overload=overload_mod.level_name(olevel),
+        provenance=provenance,
     )

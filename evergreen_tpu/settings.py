@@ -11,6 +11,14 @@ import dataclasses
 from typing import Dict, List, Optional, Type
 
 from .storage.store import Store
+from .utils import metrics as _metrics
+
+CONFIG_STALE_KEYS = _metrics.counter(
+    "config_stale_keys_total",
+    "Config-section loads that found keys a migration moved elsewhere "
+    "(the silent-weakening failure mode; warned loudly on every load).",
+    legacy="config.okta_service.stale_keys",
+)
 
 CONFIG_COLLECTION = "config"
 
@@ -739,9 +747,9 @@ class OktaServiceConfig(ConfigSection):
                 # this section no longer enforces (the silent-weakening
                 # failure mode) — migration 0004 copies the values to
                 # auth.okta_user_group / auth.okta_expected_email_domains
-                from .utils.log import get_logger, incr_counter
+                from .utils.log import get_logger
 
-                incr_counter("config.okta_service.stale_keys")
+                CONFIG_STALE_KEYS.inc()
                 get_logger("config").warning(
                     "okta_service carries stale login-gate keys — the "
                     "group/email-domain gates are enforced from the "

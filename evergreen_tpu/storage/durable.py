@@ -74,9 +74,32 @@ from typing import Dict, Optional
 
 from .lease import EpochFencedError, FileLease
 from .store import Collection, Store, apply_wal_record
+from ..utils import metrics as _metrics
 
 SNAPSHOT_FILE = "snapshot.json"
 WAL_FILE = "wal.log"
+
+WAL_STALE_FRAMES_DROPPED = _metrics.counter(
+    "wal_stale_frames_dropped_total",
+    "Superseded-epoch WAL frames dropped at replay (a deposed holder's "
+    "writes landing past the fence point).",
+    legacy="wal.stale_frames_dropped",
+)
+LEASE_FENCED = _metrics.counter(
+    "lease_fenced_total",
+    "Writers fenced after observing a newer lease epoch; the holder "
+    "stands down and refuses every further write.",
+    legacy="lease.fenced",
+)
+WAL_FLUSH_MS = _metrics.histogram(
+    "wal_flush_duration_ms",
+    "Wall time of one WAL group-frame write+flush (sync commits and "
+    "async flusher frames alike).",
+)
+WAL_FLUSH_BACKLOG = _metrics.gauge(
+    "wal_flush_backlog",
+    "Frames waiting on (or being written by) the async WAL flusher.",
+)
 
 
 class _Journal:
@@ -282,11 +305,10 @@ class DurableStore(Store):
                 '{"o":"f","e":%d}' % self.epoch, None, n_ops=0
             )
         if self.replay_report["stale_frames_dropped"]:
-            from ..utils.log import get_logger, incr_counter
+            from ..utils.log import get_logger
 
-            incr_counter(
-                "wal.stale_frames_dropped",
-                self.replay_report["stale_frames_dropped"],
+            WAL_STALE_FRAMES_DROPPED.inc(
+                self.replay_report["stale_frames_dropped"]
             )
             get_logger("resilience").warning(
                 "stale-epoch-frames-dropped",
@@ -309,9 +331,9 @@ class DurableStore(Store):
         first = not self._fenced
         self._fenced = True
         if first:
-            from ..utils.log import get_logger, incr_counter
+            from ..utils.log import get_logger
 
-            incr_counter("lease.fenced")
+            LEASE_FENCED.inc()
             get_logger("resilience").error(
                 "epoch-fenced", epoch=self.epoch, reason=reason,
             )
@@ -407,7 +429,7 @@ class DurableStore(Store):
         with self._flush_cv:
             if not self._flush_queue and not self._flush_busy:
                 return False
-            self._flush_queue.append(("op", line))
+            self._flush_queue.append(("op", line, None))
             self._flush_cv.notify()
             return True
 
@@ -425,6 +447,7 @@ class DurableStore(Store):
         the WAL (the ``wal.fence`` seam fires just before the check so a
         fault plan can model a steal landing mid-commit)."""
         from ..utils import faults
+        from ..utils import tracing as _tracing
 
         faults.fire("wal.fence")
         j = self._journal
@@ -442,21 +465,42 @@ class DurableStore(Store):
                         name="wal-group-flusher",
                     )
                     self._flusher.start()
-                self._flush_queue.append(("frame", records))
+                # the frame carries the committing tick's trace context
+                # so the flusher's write span parents into the SAME tick
+                # trace instead of rooting fresh on its own thread
+                self._flush_queue.append(
+                    ("frame", records, _tracing.capture_context())
+                )
                 self._flush_cv.notify()
 
     def _flush_loop(self) -> None:
+        import time as __time
+
+        from ..utils import tracing as _tracing
+
         while True:
             with self._flush_cv:
                 while not self._flush_queue:
                     self._flush_busy = False
                     self._flush_cv.notify_all()
                     self._flush_cv.wait()
-                kind, payload = self._flush_queue.pop(0)
+                kind, payload, ctx = self._flush_queue.pop(0)
                 self._flush_busy = True
             try:
                 if kind == "frame":
-                    self.commit_group_inline(payload)
+                    # ring-only span: the flusher must not journal a span
+                    # doc while it holds the write path (and the frame's
+                    # tick already has a durable trace in the store sink)
+                    t0 = __time.perf_counter()
+                    with _tracing.attached(ctx), _tracing.Tracer(
+                        self, "storage"
+                    ).span(
+                        "wal.flush", store_write=False, n_ops=len(payload)
+                    ):
+                        self.commit_group_inline(payload)
+                    WAL_FLUSH_MS.observe(
+                        (__time.perf_counter() - t0) * 1e3
+                    )
                 else:
                     # a deferred per-op line: it stays a plain record in
                     # the file and keeps firing the per-op seam — the
@@ -477,7 +521,9 @@ class DurableStore(Store):
         (utils/overload.py): a storm that outruns the disk shows up
         here before anything else."""
         with self._flush_cv:
-            return len(self._flush_queue) + (1 if self._flush_busy else 0)
+            backlog = len(self._flush_queue) + (1 if self._flush_busy else 0)
+        WAL_FLUSH_BACKLOG.set(float(backlog))
+        return backlog
 
     def sync_persist(self) -> None:
         """Barrier: wait until every async group commit has hit the WAL,

@@ -42,6 +42,15 @@ import time as _time
 import uuid
 from typing import Callable, Optional
 
+from ..utils import metrics as _metrics
+
+LEASE_LOST = _metrics.counter(
+    "lease_lost_total",
+    "Writer leases lost or stood down (failed renewal, observed steal, "
+    "or a fenced commit).",
+    legacy="lease.lost",
+)
+
 
 class EpochFencedError(RuntimeError):
     """A writer bound to a superseded lease epoch attempted a commit.
@@ -300,9 +309,9 @@ class FileLease:
         self._stop.set()
         if not fire:
             return
-        from ..utils.log import get_logger, incr_counter
+        from ..utils.log import get_logger
 
-        incr_counter("lease.lost")
+        LEASE_LOST.inc()
         get_logger("resilience").error(
             "lease-lost",
             path=self.path,

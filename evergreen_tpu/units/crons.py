@@ -33,8 +33,17 @@ from ..queue.jobs import (
 from ..scheduler.wrapper import TickOptions, run_tick
 from ..settings import HostInitConfig, ServiceFlags
 from ..storage.store import Store
+from ..utils import metrics as _metrics
 from ..utils import overload
 from . import host_jobs, task_jobs
+
+CRON_DEFERRED = _metrics.counter(
+    "cron_deferred_total",
+    "Whole populator batches deferred for one interval by the overload "
+    "ladder, labeled by populator.",
+    labels=("populator",),
+    legacy="overload.cron_deferred",
+)
 
 
 def _defer_for_overload(store: Store, populator: str, floor: int) -> bool:
@@ -44,10 +53,9 @@ def _defer_for_overload(store: Store, populator: str, floor: int) -> bool:
     level = overload.monitor_for(store).level()
     if level < floor:
         return False
-    from ..utils.log import get_logger, incr_counter
+    from ..utils.log import get_logger
 
-    incr_counter("overload.cron_deferred")
-    incr_counter(f"overload.cron_deferred.{populator}")
+    CRON_DEFERRED.inc(populator=populator)
     get_logger("overload").info(
         "cron-deferred",
         populator=populator,

@@ -25,8 +25,24 @@ from ..models import task as task_mod
 from ..models import task_queue as tq_mod
 from ..models.lifecycle import mark_end
 from ..storage.store import Store
+from ..utils import metrics as _metrics
 
 HOSTSTATS_COLLECTION = "host_stats"
+
+RECOVERY_STRANDED = _metrics.counter(
+    "recovery_stranded_tasks_total",
+    "Stranded in-flight tasks handled by reset-or-system-fail, labeled "
+    "by outcome (reset / system_failed).",
+    labels=("outcome",),
+    legacy=lambda labels: [f"recovery.stranded_{labels['outcome']}"],
+)
+HOSTS_REAP_MISSING_TS = _metrics.counter(
+    "hosts_reap_missing_timestamps_total",
+    "Building hosts found with neither start nor creation timestamp; "
+    "their staleness clock starts at first observation instead of "
+    "epoch-0 instant reaping.",
+    legacy="hosts.reap_missing_timestamps",
+)
 
 #: default idle threshold before termination (reference
 #: units/host_monitoring_idle_termination.go idleTimeCutoff ~ minutes)
@@ -101,7 +117,7 @@ def reset_task_or_mark_system_failed(
     reset to run again, with ``num_automatic_restarts`` accounting the
     attempts.  Returns "reset", "system-failed", or "" (no-op: the task
     was already finished or not in flight)."""
-    from ..utils.log import get_logger, incr_counter
+    from ..utils.log import get_logger
 
     t = task_mod.get(store, task_id)
     if t is None or t.is_finished():
@@ -118,7 +134,7 @@ def reset_task_or_mark_system_failed(
         return ""  # not dispatched/started: nothing in flight to fix
     attempts = t.num_automatic_restarts
     if t.aborted or attempts >= max_restarts:
-        incr_counter("recovery.stranded_system_failed")
+        RECOVERY_STRANDED.inc(outcome="system_failed")
         get_logger("resilience").warning(
             "stranded-task-system-failed",
             task=task_id,
@@ -139,7 +155,7 @@ def reset_task_or_mark_system_failed(
     task_mod.coll(store).update(
         task_id, {"num_automatic_restarts": attempts + 1}
     )
-    incr_counter("recovery.stranded_reset")
+    RECOVERY_STRANDED.inc(outcome="reset")
     get_logger("resilience").info(
         "stranded-task-reset",
         task=task_id,
@@ -184,9 +200,9 @@ def reap_stale_building_hosts(
             # a doc missing BOTH timestamps would read as epoch-0 and be
             # reaped instantly: start its staleness clock now instead,
             # stamping the doc so the window eventually elapses
-            from ..utils.log import get_logger, incr_counter
+            from ..utils.log import get_logger
 
-            incr_counter("hosts.reap_missing_timestamps")
+            HOSTS_REAP_MISSING_TS.inc()
             get_logger("resilience").warning(
                 "building-host-missing-timestamps",
                 host=doc["_id"],

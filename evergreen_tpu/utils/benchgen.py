@@ -186,6 +186,11 @@ def bench_result_payload(
     for key in (
         "churn_rebuild_ms", "persist_skipped", "persist_patched",
         "persist_spliced", "persist_rewritten",
+        # the metrics-plane (scheduler_tick_duration_ms /
+        # scheduler_tick_phase_duration_ms) view of the same ticks —
+        # p50/p95/p99 from the histograms /metrics serves, so bench and
+        # dashboard read ONE timing source of truth
+        "tick_histograms",
     ):
         if key in churn:
             out[key] = churn[key]

@@ -28,11 +28,27 @@ import threading
 import time as _time
 from typing import Optional
 
-from .log import get_logger, incr_counter
+from . import metrics as _metrics
+from .log import get_logger
 
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
+
+BREAKER_TRANSITIONS = _metrics.counter(
+    "breaker_transitions_total",
+    "Circuit-breaker state transitions, labeled by breaker name and the "
+    "state entered.",
+    labels=("name", "state"),
+    legacy=lambda labels: [f"breaker.{labels['name']}.{labels['state']}"],
+)
+BREAKER_FAILURES = _metrics.counter(
+    "breaker_failures_total",
+    "Failures recorded against a circuit breaker (consecutive-failure "
+    "accounting; a success resets the streak, not this counter).",
+    labels=("name",),
+    legacy=lambda labels: [f"breaker.{labels['name']}.failures"],
+)
 
 
 class CircuitBreaker:
@@ -66,7 +82,7 @@ class CircuitBreaker:
         if self._state == to:
             return
         frm, self._state = self._state, to
-        incr_counter(f"breaker.{self.name}.{to}")
+        BREAKER_TRANSITIONS.inc(name=self.name, state=to)
         self._log.warning(
             "breaker-transition",
             breaker=self.name,
@@ -110,7 +126,7 @@ class CircuitBreaker:
         now = _time.monotonic() if now is None else now
         with self._lock:
             self._consecutive_failures += 1
-            incr_counter(f"breaker.{self.name}.failures")
+            BREAKER_FAILURES.inc(name=self.name)
             if self._state == HALF_OPEN or (
                 self._state == CLOSED
                 and self._consecutive_failures >= self.failure_threshold

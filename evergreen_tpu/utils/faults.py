@@ -69,6 +69,15 @@ import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import metrics as _metrics
+
+FAULTS_FIRED = _metrics.counter(
+    "faults_fired_total",
+    "Injected faults fired by the active fault plan, labeled by seam.",
+    labels=("seam",),
+    legacy="faults.fired",
+)
+
 
 class FaultError(RuntimeError):
     """Default injected failure."""
@@ -147,10 +156,9 @@ class FaultPlan:
             if fault is None:
                 return None
             self.fired.append((seam, idx, fault.kind))
-        from .log import get_logger, incr_counter
+        from .log import get_logger
 
-        incr_counter("faults.fired")
-        incr_counter(f"faults.fired.{seam}")
+        FAULTS_FIRED.inc(seam=seam)
         get_logger("faults").warning(
             "fault-injected", seam=seam, call_index=idx, kind=fault.kind
         )

@@ -28,6 +28,101 @@ import re
 import subprocess
 import sys
 
+from . import metrics as _metrics
+
+# -- TPU probe failure taxonomy as metrics ----------------------------------- #
+# probe_tpu_detail's causes (PR 5) were only visible in
+# TPU_PROBE_LOG.jsonl; these instruments put the same taxonomy — and the
+# 5-run-long failure streak — on /metrics where a dashboard can see it.
+
+TPU_PROBE_ATTEMPTS = _metrics.counter(
+    "tpu_probe_attempts_total",
+    "TPU tunnel probes by cause bucket: ok / cpu-pinned / no-pool-ips / "
+    "timeout / backend-error / spawn-error (detail tails stay in "
+    "TPU_PROBE_LOG.jsonl — labels are the bounded taxonomy only).",
+    labels=("cause",),
+)
+TPU_PROBE_FAILURE_STREAK = _metrics.gauge(
+    "tpu_probe_failure_streak",
+    "Consecutive failed TPU probes (0 after a healthy probe); refreshed "
+    "from TPU_PROBE_LOG.jsonl by /metrics so the cross-run streak is "
+    "visible, not just this process's attempts.",
+)
+TPU_PROBE_HEALTHY = _metrics.gauge(
+    "tpu_probe_healthy",
+    "1 when the most recent TPU probe succeeded, else 0.",
+)
+
+
+def probe_cause(reason: str) -> str:
+    """Collapse a probe reason to its bounded taxonomy bucket (the
+    ``backend-error: rc=1 …`` tail would otherwise mint a label series
+    per distinct stderr)."""
+    return reason.split(":", 1)[0] if reason else "ok"
+
+
+def record_probe_metrics(ok: bool, reason: str) -> None:
+    TPU_PROBE_ATTEMPTS.inc(cause=probe_cause(reason))
+    TPU_PROBE_HEALTHY.set(1.0 if ok else 0.0)
+    if ok:
+        TPU_PROBE_FAILURE_STREAK.set(0.0)
+    else:
+        TPU_PROBE_FAILURE_STREAK.inc()
+
+
+def refresh_probe_metrics_from_log(
+    path: str | None = None, tail_records: int = 200
+) -> int:
+    """Recompute the failure-streak/health gauges from the tail of
+    TPU_PROBE_LOG.jsonl (the cross-run view: in-process attempts only
+    see this process). Returns the number of records read; missing or
+    unreadable logs leave the gauges untouched."""
+    import json as _json
+
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            "TPU_PROBE_LOG.jsonl",
+        )
+    try:
+        with open(path, "rb") as fh:
+            # bounded tail read: the log grows forever across runs and
+            # this refresh runs per scrape — never materialize the
+            # whole file
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - 64 * 1024))
+            chunk = fh.read().decode("utf-8", errors="replace")
+        lines = chunk.splitlines()
+        if size > 64 * 1024 and lines:
+            # drop the possibly-torn partial BEFORE the tail slice —
+            # after it, the slice has usually already removed the
+            # chunk's first line and a complete record would be lost
+            lines = lines[1:]
+        lines = lines[-tail_records:]
+    except OSError:
+        return 0
+    records = []
+    for line in lines:
+        try:
+            rec = _json.loads(line)
+        except ValueError:
+            continue
+        if "ok" in rec:
+            records.append(rec)
+    if not records:
+        return 0
+    streak = 0
+    for rec in reversed(records):
+        if rec.get("ok"):
+            break
+        streak += 1
+    TPU_PROBE_FAILURE_STREAK.set(float(streak))
+    TPU_PROBE_HEALTHY.set(1.0 if records[-1].get("ok") else 0.0)
+    return len(records)
+
 
 def probe_tpu_detail(
     timeout_s: float = 45.0, env: dict | None = None
@@ -45,7 +140,18 @@ def probe_tpu_detail(
       * ``"backend-error: …"`` — init failed fast; carries the stderr
                             tail (connect refused vs plugin crash etc.)
       * ``"spawn-error: …"``   — the probe subprocess could not start
+
+    Every probe also lands on the metrics plane
+    (``tpu_probe_attempts_total{cause=…}`` + the streak/health gauges).
     """
+    ok, reason = _probe_tpu_detail_inner(timeout_s, env)
+    record_probe_metrics(ok, reason)
+    return ok, reason
+
+
+def _probe_tpu_detail_inner(
+    timeout_s: float = 45.0, env: dict | None = None
+) -> tuple[bool, str]:
     env = dict(os.environ) if env is None else dict(env)
     if env.get("JAX_PLATFORMS") == "cpu":
         return False, "cpu-pinned"

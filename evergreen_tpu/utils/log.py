@@ -63,6 +63,15 @@ def reset_counters() -> None:
         _counters.clear()
 
 
+def restore_counters(snapshot: Dict[str, int]) -> None:
+    """Replace the whole flat-counter dict (test isolation: the conftest
+    autouse fixture snapshots before and restores after each test so one
+    test's bumps can never change another's ``counters_snapshot()``)."""
+    with _counter_lock:
+        _counters.clear()
+        _counters.update(snapshot)
+
+
 def set_level(level: str) -> None:
     global _threshold
     _threshold = LEVELS.get(level, LEVELS["info"])

@@ -1,0 +1,554 @@
+"""Typed metrics plane: labeled Counter/Gauge/Histogram instruments in
+one process-wide registry, served in Prometheus text format.
+
+The seed telemetry was a flat unlabeled counter dict in ``utils/log.py``
+— fine for soak-audit breadcrumbs, useless for dashboards: no label
+dimensions (which seam? which overload level?), no distributions (a
+p99 existed only in bench JSON), no registration (typos minted new
+counters silently). This module is the replacement, shaped after the
+reference's grip/expvar + OTel metric split (SURVEY §5):
+
+- every instrument is **registered exactly once** with a help string
+  (``tools/metrics_lint.py`` enforces literal snake_case names with a
+  subsystem prefix and labels from a fixed vocabulary);
+- label sets are **bounded**: past ``max_series`` distinct label
+  combinations an instrument folds new combinations into a single
+  ``other`` series instead of leaking memory on unbounded values;
+- histograms are **fixed-bucket** with cumulative counts, ``_sum`` and
+  ``_count``, plus a host-side p50/p95/p99 readout (linear
+  interpolation inside the crossing bucket — the same estimate
+  ``histogram_quantile`` makes server-side);
+- ``GET /metrics`` (api/rest.py) renders the whole registry in
+  Prometheus exposition text format v0.0.4.
+
+Migration compatibility: the old flat counters remain readable. Every
+Counter may declare ``legacy`` flat name(s); ``inc()`` mirrors into
+``utils/log.py``'s counter dict under exactly the dotted names the old
+call sites bumped (total and/or per-label-suffix), so
+``counters_snapshot()`` / ``get_counter()`` keep answering for the
+fault/crash/overload matrices and existing tests while the registry is
+the single source of truth for new consumers.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import log as _log
+
+# --------------------------------------------------------------------------- #
+# label hygiene
+# --------------------------------------------------------------------------- #
+
+#: the allowed label vocabulary (tools/metrics_lint.py enforces it at the
+#: source level): a fixed, low-cardinality set so /metrics stays scrape-
+#: able — task ids, host ids, user ids and friends must NEVER be labels
+ALLOWED_LABELS = frozenset(
+    {
+        "seam",        # fault-injection seam (utils/faults.py)
+        "distro",      # distro id (bounded by the fleet config)
+        "job_class",   # JobQueue priority class: agent/planning/reconcile/stats
+        "level",       # overload ladder level: green/yellow/red/black
+        "cause",       # failure taxonomy bucket (tick degradation, TPU probe)
+        "kind",        # shed source kind (utils/overload.py record_shed)
+        "collection",  # outbox collection name
+        "populator",   # cron populator name
+        "state",       # breaker state: open/closed/half-open
+        "name",        # breaker/instrument instance name (bounded set)
+        "operation",   # retry-policy operation tag
+        "phase",       # tick pipeline phase
+        "signal",      # overload monitor gauge name
+        "outcome",     # success/failure-ish result buckets
+    }
+)
+
+#: per-instrument bound on distinct label combinations; combination
+#: number max_series+1 and beyond fold into one all-``other`` series
+DEFAULT_MAX_SERIES = 256
+
+#: fixed millisecond buckets shared by the duration histograms (tick
+#: phases, WAL flush, job runs, API requests) — one vocabulary so
+#: dashboards can overlay them
+DEFAULT_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+class MetricError(ValueError):
+    """Bad registration or bad use of an instrument."""
+
+
+#: snake_case with a subsystem prefix: at least two underscore-separated
+#: segments (``scheduler_tick_duration_ms``, ``jobs_shed_total``) — the
+#: same shape tools/metrics_lint.py enforces at the source level
+_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(s: str) -> str:
+    return (
+        s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: integral values render without the
+    trailing ``.0`` (matches common exporters; pinned by the golden
+    exposition test)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(upper: float) -> str:
+    return "+Inf" if math.isinf(upper) else _fmt_value(upper)
+
+
+# --------------------------------------------------------------------------- #
+# instruments
+# --------------------------------------------------------------------------- #
+
+
+LegacySpec = Optional[object]  # str | Callable[[Dict[str, str]], Iterable[str]]
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        max_series: int = DEFAULT_MAX_SERIES,
+    ) -> None:
+        if not _NAME_RE.match(name):
+            raise MetricError(
+                f"{name!r}: instrument names are snake_case with a "
+                "subsystem prefix (at least two segments)"
+            )
+        if not help.strip():
+            raise MetricError(f"{name}: a help string is required")
+        bad = [l for l in labels if l not in ALLOWED_LABELS]
+        if bad:
+            raise MetricError(
+                f"{name}: labels {bad} not in the allowed vocabulary "
+                f"{sorted(ALLOWED_LABELS)}"
+            )
+        self.name = name
+        self.help = help
+        self.labelnames: Tuple[str, ...] = tuple(labels)
+        self.max_series = max_series
+        self._lock = threading.Lock()
+        #: label-values tuple -> series payload (float for counter/gauge,
+        #: [bucket_counts, sum, count] for histograms)
+        self._series: Dict[Tuple[str, ...], object] = {}
+        self.overflowed = 0
+
+    # -- series bookkeeping ------------------------------------------------- #
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise MetricError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}"
+            )
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        # bounded label sets: an unexpected high-cardinality value folds
+        # into ONE 'other' series instead of leaking a series per value
+        if key not in self._series and len(self._series) >= self.max_series:
+            self.overflowed += 1
+            return tuple("other" for _ in key)
+        return key
+
+    def _label_str(self, key: Tuple[str, ...]) -> str:
+        if not self.labelnames:
+            return ""
+        inner = ",".join(
+            f'{n}="{_escape_label_value(v)}"'
+            for n, v in zip(self.labelnames, key)
+        )
+        return "{" + inner + "}"
+
+    # -- state save/restore (tests) ----------------------------------------- #
+
+    @staticmethod
+    def _copy_series(v):
+        # histogram series are MUTABLE [bucket_counts, sum, count] lists;
+        # sharing the reference would let post-snapshot observes leak
+        # into the saved state (and restores leak forward)
+        if isinstance(v, (list, tuple)):
+            return [list(v[0]), v[1], v[2]]
+        return v
+
+    def _save(self):
+        with self._lock:
+            return {
+                k: self._copy_series(v) for k, v in self._series.items()
+            }
+
+    def _restore(self, state) -> None:
+        with self._lock:
+            self._series = {
+                k: self._copy_series(v) for k, v in state.items()
+            }
+
+
+class Counter(_Instrument):
+    """Monotone counter; ``legacy`` mirrors increments into the flat
+    ``utils/log.py`` dict so ``counters_snapshot()`` keeps its historical
+    shape (see module docstring)."""
+
+    kind = "counter"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        legacy: LegacySpec = None,
+        legacy_total: bool = True,
+        legacy_suffix: bool = True,
+        max_series: int = DEFAULT_MAX_SERIES,
+    ) -> None:
+        super().__init__(name, help, labels, max_series)
+        self.legacy = legacy
+        self.legacy_total = legacy_total
+        self.legacy_suffix = legacy_suffix
+
+    def _legacy_names(self, labels: Dict[str, object]) -> List[str]:
+        if self.legacy is None:
+            return []
+        if callable(self.legacy):
+            return list(self.legacy(dict(labels)))
+        names: List[str] = []
+        if self.legacy_total:
+            names.append(self.legacy)
+        if self.legacy_suffix and self.labelnames:
+            vals = [str(labels[k]) for k in self.labelnames]
+            if all(vals):  # an empty label value never minted a suffix
+                names.append(self.legacy + "." + ".".join(vals))
+        return names
+
+    def inc(self, by: float = 1, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(self._series.get(key, 0.0)) + by
+        for flat in self._legacy_names(labels):
+            _log.incr_counter(flat, int(by))
+
+    def value(self, **labels: object) -> float:
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(float(v) for v in self._series.values())
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            f"{self.name}{self._label_str(k)} {_fmt_value(float(v))}"
+            for k, v in items
+        ]
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, by: float = 1, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(self._series.get(key, 0.0)) + by
+
+    def value(self, **labels: object) -> float:
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            f"{self.name}{self._label_str(k)} {_fmt_value(float(v))}"
+            for k, v in items
+        ]
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram. A series holds ``(bucket_counts, sum,
+    count)`` where ``bucket_counts[i]`` counts observations ≤
+    ``buckets[i]`` NON-cumulatively (the exposition renders the running
+    sum, per the Prometheus contract); the final implicit bucket is
+    +Inf."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+        max_series: int = DEFAULT_MAX_SERIES,
+    ) -> None:
+        super().__init__(name, help, labels, max_series)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise MetricError(f"{name}: histogram needs at least one bucket")
+        self.buckets: Tuple[float, ...] = tuple(bs)
+
+    def observe(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = series
+            counts, _, _ = series
+            i = len(self.buckets)  # +Inf slot
+            for bi, upper in enumerate(self.buckets):
+                if v <= upper:
+                    i = bi
+                    break
+            counts[i] += 1
+            series[1] += v
+            series[2] += 1
+
+    # -- readout ------------------------------------------------------------ #
+
+    def snapshot(self, **labels: object) -> Dict[str, float]:
+        """count/sum/p50/p95/p99 for one series (no labels → the
+        unlabeled series)."""
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                return {"count": 0, "sum": 0.0, "p50": 0.0, "p95": 0.0,
+                        "p99": 0.0}
+            counts = list(series[0])
+            total_sum, total_count = series[1], series[2]
+        return {
+            "count": total_count,
+            "sum": round(total_sum, 3),
+            "p50": round(self._quantile_from(counts, total_count, 0.50), 3),
+            "p95": round(self._quantile_from(counts, total_count, 0.95), 3),
+            "p99": round(self._quantile_from(counts, total_count, 0.99), 3),
+        }
+
+    def state(self, **labels: object) -> Tuple[List[int], float, int]:
+        """A copy of one series' raw ``(bucket_counts, sum, count)`` —
+        pair with :meth:`snapshot_delta` to read only the observations
+        made since (bench.py brackets its measurement loops this way
+        instead of keeping its own perf_counter aggregation)."""
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                return ([0] * (len(self.buckets) + 1), 0.0, 0)
+            return (list(series[0]), series[1], series[2])
+
+    def snapshot_delta(
+        self, prev: Tuple[List[int], float, int], **labels: object
+    ) -> Dict[str, float]:
+        """count/sum/p50/p95/p99 of the observations made AFTER ``prev``
+        (a :meth:`state` capture)."""
+        cur = self.state(**labels)
+        counts = [c - p for c, p in zip(cur[0], prev[0])]
+        total_sum = cur[1] - prev[1]
+        total_count = cur[2] - prev[2]
+        return {
+            "count": total_count,
+            "sum": round(total_sum, 3),
+            "p50": round(self._quantile_from(counts, total_count, 0.50), 3),
+            "p95": round(self._quantile_from(counts, total_count, 0.95), 3),
+            "p99": round(self._quantile_from(counts, total_count, 0.99), 3),
+        }
+
+    def quantile(self, q: float, **labels: object) -> float:
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                return 0.0
+            counts = list(series[0])
+            total = series[2]
+        return self._quantile_from(counts, total, q)
+
+    def _quantile_from(self, counts: List[int], total: int, q: float) -> float:
+        """Linear interpolation inside the crossing bucket — the estimate
+        ``histogram_quantile`` makes. The +Inf bucket clamps to the
+        largest finite bound (no upper edge to interpolate toward)."""
+        if total <= 0:
+            return 0.0
+        q = min(max(q, 0.0), 1.0)
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank and c > 0:
+                if i >= len(self.buckets):  # +Inf bucket
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * ((rank - prev_cum) / c)
+        return self.buckets[-1]
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(
+                (k, (list(v[0]), v[1], v[2]))
+                for k, v in self._series.items()
+            )
+        lines: List[str] = []
+        for key, (counts, total_sum, total_count) in items:
+            cum = 0
+            for upper, c in zip(
+                (*self.buckets, float("inf")), counts
+            ):
+                cum += c
+                if self.labelnames:
+                    pairs = [
+                        f'{n}="{_escape_label_value(v)}"'
+                        for n, v in zip(self.labelnames, key)
+                    ]
+                else:
+                    pairs = []
+                pairs.append(f'le="{_fmt_le(upper)}"')
+                lines.append(
+                    f"{self.name}_bucket{{{','.join(pairs)}}} {cum}"
+                )
+            ls = self._label_str(key)
+            lines.append(f"{self.name}_sum{ls} {_fmt_value(total_sum)}")
+            lines.append(f"{self.name}_count{ls} {total_count}")
+        return lines
+
+
+# --------------------------------------------------------------------------- #
+# registry
+# --------------------------------------------------------------------------- #
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def register(self, inst: _Instrument) -> _Instrument:
+        with self._lock:
+            existing = self._instruments.get(inst.name)
+            if existing is not None:
+                raise MetricError(
+                    f"instrument {inst.name!r} registered twice"
+                )
+            self._instruments[inst.name] = inst
+        return inst
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def instruments(self) -> List[_Instrument]:
+        with self._lock:
+            return [
+                self._instruments[n] for n in sorted(self._instruments)
+            ]
+
+    def render(self) -> str:
+        """The whole registry in Prometheus exposition text format
+        v0.0.4 (``GET /metrics``)."""
+        out: List[str] = []
+        for inst in self.instruments():
+            out.append(f"# HELP {inst.name} {_escape_help(inst.help)}")
+            out.append(f"# TYPE {inst.name} {inst.kind}")
+            out.extend(inst.render())
+        return "\n".join(out) + "\n"
+
+    # -- test isolation ----------------------------------------------------- #
+
+    def save_state(self) -> Dict[str, object]:
+        return {
+            inst.name: inst._save() for inst in self.instruments()
+        }
+
+    def restore_state(self, state: Dict[str, object]) -> None:
+        for inst in self.instruments():
+            inst._restore(state.get(inst.name, {}))
+
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def render_prometheus() -> str:
+    return _default_registry.render()
+
+
+# --------------------------------------------------------------------------- #
+# registration helpers (the ONLY spelling tools/metrics_lint.py accepts:
+# literal snake_case names, labels from ALLOWED_LABELS)
+# --------------------------------------------------------------------------- #
+
+
+def counter(
+    name: str,
+    help: str,
+    labels: Sequence[str] = (),
+    legacy: LegacySpec = None,
+    legacy_total: bool = True,
+    legacy_suffix: bool = True,
+    registry: Optional[MetricsRegistry] = None,
+) -> Counter:
+    inst = Counter(
+        name, help, labels,
+        legacy=legacy, legacy_total=legacy_total,
+        legacy_suffix=legacy_suffix,
+    )
+    (registry or _default_registry).register(inst)
+    return inst
+
+
+def gauge(
+    name: str,
+    help: str,
+    labels: Sequence[str] = (),
+    registry: Optional[MetricsRegistry] = None,
+) -> Gauge:
+    inst = Gauge(name, help, labels)
+    (registry or _default_registry).register(inst)
+    return inst
+
+
+def histogram(
+    name: str,
+    help: str,
+    labels: Sequence[str] = (),
+    buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+    registry: Optional[MetricsRegistry] = None,
+) -> Histogram:
+    inst = Histogram(name, help, labels, buckets)
+    (registry or _default_registry).register(inst)
+    return inst

@@ -68,6 +68,35 @@ BLACK = 3
 LEVEL_NAMES = {GREEN: "green", YELLOW: "yellow", RED: "red", BLACK: "black"}
 LEVELS_BY_NAME = {v: k for k, v in LEVEL_NAMES.items()}
 
+from . import metrics as _metrics  # noqa: E402 — after the level table
+
+LEVEL_CHANGES = _metrics.counter(
+    "overload_level_changes_total",
+    "Overload-ladder transitions, labeled by the level entered.",
+    labels=("level",),
+    legacy=lambda labels: [
+        "overload.level_change", f"overload.level.{labels['level']}"
+    ],
+)
+OVERLOAD_LEVEL = _metrics.gauge(
+    "overload_level",
+    "Current overload-ladder level (0=green 1=yellow 2=red 3=black).",
+)
+OVERLOAD_SIGNAL = _metrics.gauge(
+    "overload_signal",
+    "Raw value of each fused load signal at the last evaluation "
+    "(tick_lag_s, queue_pending, wal_backlog, outbox_depth, "
+    "store_latency_ms, api_rps).",
+    labels=("signal",),
+)
+SHEDS = _metrics.counter(
+    "overload_sheds_total",
+    "Units of work dropped or deferred by the overload ladder, labeled "
+    "by the shed source kind (job, outbox, tick, api, cron).",
+    labels=("kind",),
+    legacy="overload.shed",
+)
+
 
 def level_name(level: int) -> str:
     return LEVEL_NAMES.get(level, str(level))
@@ -229,7 +258,9 @@ class LoadMonitor:
                 level = i + 1
         return level
 
-    def _raw_level(self, now: float) -> Tuple[int, Dict[str, int]]:
+    def _raw_level(
+        self, now: float, mutate: bool = True
+    ) -> Tuple[int, Dict[str, int]]:
         cfg = self.config
         with self._lock:
             gauges = dict(self._gauges)
@@ -248,24 +279,35 @@ class LoadMonitor:
             # API rate over the window since the last evaluation; an
             # idle window keeps ACCUMULATING (no reset) until it is long
             # enough to decay the gauge, so a finished API storm cannot
-            # pin the level up forever however often we evaluate
-            mono = _time.monotonic()
-            span = mono - self._req_window_start if self._req_window_start else 0.0
-            if self._req_count and span >= 0.01:
-                # true rate over the real window; sub-10ms windows keep
-                # accumulating instead of producing a noise sample
-                rate = self._req_count / span
-                prev = gauges.get("api_rps", 0.0)
-                gauges["api_rps"] = 0.6 * rate + 0.4 * prev
-                self._gauges["api_rps"] = gauges["api_rps"]
-                self._req_count = 0
-                self._req_window_start = mono
-            elif span > max(0.25, 2.0 * float(cfg.eval_interval_s)):
-                gauges["api_rps"] = self._gauges["api_rps"] = (
-                    0.3 * gauges.get("api_rps", 0.0)
+            # pin the level up forever however often we evaluate. The
+            # window is consumed ONLY on mutate=True (evaluate): a
+            # read-only caller (the /metrics scrape) must neither reset
+            # the window — a sub-second scraper would fragment a bursty
+            # storm into noise samples — nor apply the idle decay, which
+            # would drain a finished storm's gauge at scrape cadence
+            # instead of the tuned eval cadence. Read-only exports the
+            # stored EWMA: exactly the signal the ladder last acted on.
+            if mutate:
+                mono = _time.monotonic()
+                span = (
+                    mono - self._req_window_start
+                    if self._req_window_start else 0.0
                 )
-                self._req_count = 0
-                self._req_window_start = mono
+                if self._req_count and span >= 0.01:
+                    # true rate over the real window; sub-10ms windows
+                    # keep accumulating instead of a noise sample
+                    rate = self._req_count / span
+                    prev = gauges.get("api_rps", 0.0)
+                    gauges["api_rps"] = 0.6 * rate + 0.4 * prev
+                    self._gauges["api_rps"] = gauges["api_rps"]
+                    self._req_count = 0
+                    self._req_window_start = mono
+                elif span > max(0.25, 2.0 * float(cfg.eval_interval_s)):
+                    gauges["api_rps"] = self._gauges["api_rps"] = (
+                        0.3 * gauges.get("api_rps", 0.0)
+                    )
+                    self._req_count = 0
+                    self._req_window_start = mono
         backlog = getattr(self.store, "flush_backlog", lambda: 0)()
         gauges["wal_backlog"] = float(backlog)
         with self._lock:
@@ -291,6 +333,8 @@ class LoadMonitor:
                 gauges.get("api_rps", 0.0), cfg.api_rps_levels
             ),
         }
+        for name in per_signal:
+            OVERLOAD_SIGNAL.set(gauges.get(name, 0.0), signal=name)
         return max(per_signal.values()), per_signal
 
     def evaluate(self, now: Optional[float] = None) -> int:
@@ -321,9 +365,25 @@ class LoadMonitor:
             else:
                 self._calm_streak = 0
             level = self._level
+        # set unconditionally, not just on transitions: a freshly
+        # started process must expose the series at GREEN, not nothing
+        OVERLOAD_LEVEL.set(float(level))
         if transition is not None:
             self._note_transition(transition[0], transition[1], per_signal)
         return level
+
+    def refresh_gauges(self) -> None:
+        """Read-only freshen of the exported gauges (the /metrics
+        scrape path): recomputes the fused signals and the level gauge
+        WITHOUT touching the hysteresis state or the api_rps request
+        window — a scraper polling faster than the eval cadence must
+        not shrink the calm window ``evaluate()`` counts toward a
+        downward transition, consume the rate window, or advance the
+        idle decay."""
+        self._raw_level(_time.time(), mutate=False)
+        with self._lock:
+            level = self._level
+        OVERLOAD_LEVEL.set(float(level))
 
     def _maybe_auto_evaluate(self) -> None:
         """Gauge pushes re-evaluate at most once per eval interval so an
@@ -339,10 +399,10 @@ class LoadMonitor:
         self, old: int, new: int, per_signal: Dict[str, int]
     ) -> None:
         from ..models import event as event_mod
-        from .log import get_logger, incr_counter
+        from .log import get_logger
 
-        incr_counter("overload.level_change")
-        incr_counter(f"overload.level.{level_name(new)}")
+        LEVEL_CHANGES.inc(level=level_name(new))
+        OVERLOAD_LEVEL.set(float(new))
         drivers = sorted(
             s for s, lvl in per_signal.items() if lvl >= new and new > GREEN
         )
@@ -417,10 +477,9 @@ def record_shed(store, kind: str, key: str, detail: str = "") -> int:
     for this (kind, key). Callers add their own domain record (the jobs
     collection row, the outbox counter) on top."""
     from ..models import event as event_mod
-    from .log import get_logger, incr_counter
+    from .log import get_logger
 
-    incr_counter("overload.shed")
-    incr_counter(f"overload.shed.{kind}")
+    SHEDS.inc(kind=kind)
     now = _time.time()
     doc_id = f"{kind}:{key}"
     coll = store.collection(SHEDS_COLLECTION)

@@ -20,7 +20,16 @@ import random
 import time as _time
 from typing import Callable, Optional, Tuple, Type
 
-from .log import get_logger, incr_counter
+from . import metrics as _metrics
+from .log import get_logger
+
+RETRY_EXHAUSTED = _metrics.counter(
+    "retry_exhausted_total",
+    "Calls that spent every retry attempt (or their deadline) and "
+    "re-raised, labeled by the adopter's operation tag.",
+    labels=("operation",),
+    legacy="retry.exhausted",
+)
 
 
 class DeadlineExceeded(Exception):
@@ -140,9 +149,7 @@ class RetryPolicy:
                     on_retry(attempt, exc)
                 if pause > 0:
                     sleep(pause)
-        incr_counter("retry.exhausted")
-        if operation:
-            incr_counter(f"retry.exhausted.{operation}")
+        RETRY_EXHAUSTED.inc(operation=operation or "")
         get_logger(component).warning(
             "retry-exhausted",
             operation=op,

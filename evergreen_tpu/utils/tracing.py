@@ -1,10 +1,28 @@
-"""Lightweight tracing: spans with attributes, persisted for inspection.
+"""Whole-tick tracing: spans with attributes, explicit context
+propagation across threads, a crash/brownout-proof in-memory ring, and a
+persisted span collection for export.
 
 The reference instruments everything with OpenTelemetry (SURVEY §5:
 config_tracer.go, per-package tracers, rich span attributes on scheduler
-jobs). This is the same seam without the OTLP dependency: spans nest via a
-context manager, carry attributes, and land in the store's ``spans``
-collection (an OTLP exporter can replace the sink wholesale).
+jobs). This is the same seam without the OTLP dependency, grown from the
+seed's single-call-site version into a service-wide plane:
+
+- spans nest via a context manager and carry attributes; the active
+  context is a **capturable/attachable token** (``capture_context`` /
+  ``attach_context`` / ``detach_context``), so work handed to another
+  thread — the async WAL flusher, JobQueue executor threads, dispatch
+  handlers — parents correctly instead of starting a fresh root;
+- every finished span lands in a bounded **ring buffer** beside the
+  store sink; RED/BLACK brownout sheds the store write (it is a stats
+  write) but the ring keeps the last N traces, so the trace of the tick
+  that browned out is exactly the one you can still read
+  (``/rest/v2/admin/trace/{id}``);
+- the store's ``spans`` collection remains the durable/exportable sink
+  (an OTLP exporter can replace it wholesale, ``export_spans``).
+
+``set_tracing_enabled(False)`` turns the whole plane into cheap no-ops —
+the sampled-off arm of the instrumentation-overhead guard
+(tools/perf_guard.py asserts on-vs-off ≤ 2%).
 """
 from __future__ import annotations
 
@@ -12,15 +30,175 @@ import contextlib
 import itertools
 import threading
 import time as _time
-from typing import Any, Dict, Iterator, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 from ..storage.store import Store
+from . import metrics as _metrics
 
 SPANS_COLLECTION = "spans"
 
 _seq = itertools.count()
 _seq_lock = threading.Lock()
 _local = threading.local()
+
+#: process-wide on/off switch (the "sampled-off" arm of the overhead
+#: guard); off → span() yields an inert record and touches no sink
+_enabled = True
+
+TRACE_STORE_SHED = _metrics.counter(
+    "trace_store_writes_shed_total",
+    "Span store-writes skipped under RED/BLACK brownout "
+    "(the ring buffer still kept the span).",
+)
+TRACE_RING_DROPPED = _metrics.counter(
+    "trace_ring_spans_dropped_total",
+    "Spans dropped because their trace hit the per-trace ring cap.",
+)
+
+
+def set_tracing_enabled(on: bool) -> bool:
+    """Flip the whole tracing plane; returns the previous value."""
+    global _enabled
+    prev, _enabled = _enabled, bool(on)
+    return prev
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+# --------------------------------------------------------------------------- #
+# context propagation
+# --------------------------------------------------------------------------- #
+
+
+class TraceContext(NamedTuple):
+    """A capturable parent pointer: hand it to another thread and
+    ``attach_context`` there so spans parent into the same trace."""
+
+    trace_id: str
+    span_id: str
+
+
+def capture_context() -> Optional[TraceContext]:
+    """The calling thread's active span context (None outside any
+    span). Safe to ship across threads."""
+    return getattr(_local, "ctx", None)
+
+
+def attach_context(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Make ``ctx`` the thread's active context; returns a token (the
+    previous context) for ``detach_context``. Always pair with a
+    try/finally — a leaked attach makes every later span in the thread a
+    child of a finished trace."""
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = ctx
+    return prev
+
+
+def detach_context(token: Optional[TraceContext]) -> None:
+    _local.ctx = token
+
+
+@contextlib.contextmanager
+def attached(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """``attach_context`` with the try/finally built in."""
+    token = attach_context(ctx)
+    try:
+        yield
+    finally:
+        detach_context(token)
+
+
+def reset_context() -> None:
+    """Clear any leaked context on the calling thread (test isolation)."""
+    _local.ctx = None
+
+
+# --------------------------------------------------------------------------- #
+# ring buffer sink
+# --------------------------------------------------------------------------- #
+
+
+class TraceRing:
+    """Last-N-traces in memory. Brownout sheds stats writes to the
+    store; the ring is the sink that never sheds, so the most recent
+    ticks' traces survive exactly the storms you want to inspect."""
+
+    def __init__(self, max_traces: int = 64,
+                 max_spans_per_trace: int = 512) -> None:
+        self.max_traces = max_traces
+        self.max_spans_per_trace = max_spans_per_trace
+        self._lock = threading.Lock()
+        #: trace id -> [span records], insertion-ordered by first span
+        self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
+
+    def add(self, record: dict) -> None:
+        tid = record.get("trace_root") or record.get("_id", "")
+        if not tid:
+            return
+        # copy: callers keep mutating their record dict after the span
+        # closes (attribute updates), the ring must hold the final shape
+        span = dict(record)
+        span["attributes"] = dict(record.get("attributes") or {})
+        with self._lock:
+            spans = self._traces.get(tid)
+            if spans is None:
+                self._traces[tid] = spans = []
+                while len(self._traces) > self.max_traces:
+                    evicted_tid = next(iter(self._traces))
+                    if evicted_tid == tid:
+                        break
+                    self._traces.pop(evicted_tid)
+            if len(spans) >= self.max_spans_per_trace:
+                TRACE_RING_DROPPED.inc()
+                return
+            spans.append(span)
+
+    def trace(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            return [dict(s) for s in self._traces.get(trace_id, ())]
+
+    def traces(self) -> List[Tuple[str, List[dict]]]:
+        """(trace_id, spans) pairs, oldest first."""
+        with self._lock:
+            return [
+                (tid, [dict(s) for s in spans])
+                for tid, spans in self._traces.items()
+            ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+_global_ring = TraceRing()
+_ring_lock = threading.Lock()
+
+
+def trace_ring_for(store: Optional[Store]) -> TraceRing:
+    """Per-store ring (lifetime tied to the store, like the overload
+    monitor); storeless spans share one process-global ring."""
+    if store is None:
+        return _global_ring
+    ring = getattr(store, "_trace_ring", None)
+    if ring is None:
+        with _ring_lock:
+            ring = getattr(store, "_trace_ring", None)
+            if ring is None:
+                ring = TraceRing()
+                store._trace_ring = ring
+    return ring
+
+
+def global_ring() -> TraceRing:
+    return _global_ring
+
+
+# --------------------------------------------------------------------------- #
+# tracer
+# --------------------------------------------------------------------------- #
 
 
 class Tracer:
@@ -29,35 +207,146 @@ class Tracer:
         self.component = component
 
     @contextlib.contextmanager
-    def span(self, name: str, **attributes: Any) -> Iterator[Dict[str, Any]]:
+    def span(
+        self,
+        name: str,
+        ctx: Optional[TraceContext] = None,
+        store_write: bool = True,
+        **attributes: Any,
+    ) -> Iterator[Dict[str, Any]]:
+        """One span. Parents under the thread's active context (or an
+        explicit ``ctx`` token captured elsewhere); the context is
+        attached for the body and detached in a ``finally`` even when
+        the body raises — the seed version left ``_local.root`` dangling
+        on a raising nested span, re-rooting every later span in the
+        thread. ``store_write=False`` keeps a hot-path span out of the
+        store (ring only) regardless of load level."""
+        if not _enabled:
+            yield {"_id": "", "trace_root": "", "attributes": {}}
+            return
         with _seq_lock:
             span_id = f"span-{next(_seq)}"
-        parent = getattr(_local, "current", None)
-        # every span records its ROOT so an exporter can assign one trace
-        # id to the whole nesting chain, however deep
-        root = getattr(_local, "root", None) if parent else span_id
-        start = _time.perf_counter()
+        parent = ctx if ctx is not None else capture_context()
+        trace_id = parent.trace_id if parent is not None else span_id
         record: Dict[str, Any] = {
             "_id": span_id,
             "component": self.component,
             "name": name,
-            "parent": parent,
-            "trace_root": root or span_id,
+            "parent": parent.span_id if parent is not None else None,
+            "trace_root": trace_id,
+            "thread": threading.current_thread().name,
             "started_at": _time.time(),
             "attributes": dict(attributes),
         }
-        _local.current = span_id
-        if parent is None:
-            _local.root = span_id
+        if not store_write:
+            record["_ring_only"] = True
+        token = attach_context(TraceContext(trace_id, span_id))
+        start = _time.perf_counter()
         try:
             yield record
         finally:
-            _local.current = parent
-            if parent is None:
-                _local.root = None
+            detach_context(token)
             record["duration_ms"] = (_time.perf_counter() - start) * 1e3
-            if self.store is not None:
-                self.store.collection(SPANS_COLLECTION).upsert(record)
+            self._sink(record)
+
+    def _sink(self, record: Dict[str, Any]) -> None:
+        """Ring always; store unless shedding (brownout) — and a broken
+        sink must never take down the traced caller (a fenced store,
+        for one, refuses journaled writes by raising)."""
+        ring_only = record.pop("_ring_only", False)
+        try:
+            trace_ring_for(self.store).add(record)
+        except Exception:  # noqa: BLE001
+            pass
+        if self.store is None or ring_only:
+            return
+        try:
+            from . import overload as _overload
+
+            if _overload.monitor_for(self.store).level() >= _overload.RED:
+                TRACE_STORE_SHED.inc()
+                return
+            self.store.collection(SPANS_COLLECTION).upsert(record)
+        except Exception:  # noqa: BLE001 — never break the caller
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# trace reconstruction (admin surface)
+# --------------------------------------------------------------------------- #
+
+
+def _collect_trace_spans(store: Optional[Store], trace_id: str) -> List[dict]:
+    spans = {
+        s["_id"]: s for s in trace_ring_for(store).trace(trace_id)
+    }
+    if store is not None:
+        try:
+            for s in store.collection(SPANS_COLLECTION).find(
+                lambda d: d.get("trace_root") == trace_id
+            ):
+                spans.setdefault(s["_id"], dict(s))
+        except Exception:  # noqa: BLE001 — a broken store still serves ring
+            pass
+    return sorted(spans.values(), key=lambda s: (
+        s.get("started_at", 0.0), s.get("_id", "")
+    ))
+
+
+def trace_tree(store: Optional[Store], trace_id: str) -> Optional[dict]:
+    """The span tree of one trace, from the ring buffer merged with the
+    store sink. Returns ``{trace_id, n_spans, roots: [span…]}`` where
+    each span carries ``children`` sorted by start time, or None when
+    the trace is unknown to both sinks."""
+    spans = _collect_trace_spans(store, trace_id)
+    if not spans:
+        return None
+    nodes = {
+        s["_id"]: {**s, "children": []} for s in spans
+    }
+    roots = []
+    for s in spans:
+        node = nodes[s["_id"]]
+        parent = s.get("parent")
+        if parent and parent in nodes:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    return {"trace_id": trace_id, "n_spans": len(spans), "roots": roots}
+
+
+def recent_traces(store: Optional[Store], last: int = 10) -> List[dict]:
+    """Newest-last summaries of the ring's traces (falling back to store
+    root spans for traces that aged out of the ring)."""
+    seen = {}
+    for tid, spans in trace_ring_for(store).traces():
+        root = next((s for s in spans if not s.get("parent")), spans[0])
+        seen[tid] = {
+            "trace_id": tid,
+            "root": root.get("name", ""),
+            "component": root.get("component", ""),
+            "started_at": min(s.get("started_at", 0.0) for s in spans),
+            "duration_ms": round(root.get("duration_ms", 0.0), 3),
+            "n_spans": len(spans),
+        }
+    if store is not None and len(seen) < last:
+        try:
+            for s in store.collection(SPANS_COLLECTION).find(
+                lambda d: not d.get("parent")
+            ):
+                tid = s.get("trace_root") or s["_id"]
+                seen.setdefault(tid, {
+                    "trace_id": tid,
+                    "root": s.get("name", ""),
+                    "component": s.get("component", ""),
+                    "started_at": s.get("started_at", 0.0),
+                    "duration_ms": round(s.get("duration_ms", 0.0), 3),
+                    "n_spans": 0,
+                })
+        except Exception:  # noqa: BLE001
+            pass
+    out = sorted(seen.values(), key=lambda d: d["started_at"])
+    return out[-max(1, int(last)):]
 
 
 def get_spans(store: Store, component: str = "") -> List[dict]:

@@ -39,12 +39,12 @@ struct Snapshot {
 };
 
 // Solve outputs, packed per evergreen_tpu/ops/solve.py OUTPUT_SPEC:
-// i32: order[N], t_unit[N], d_new_hosts[D], d_free_approx[D], d_length[D],
-//      d_deps_met[D], d_over_count[D], d_wait_over[D], d_merge[D],
-//      g_count[G], g_count_free[G], g_count_required[G], g_over_count[G],
-//      g_wait_over[G], g_merge[G]
-// f32: t_value[N], d_expected_dur_s[D], d_over_dur_s[D],
-//      g_expected_dur_s[G], g_over_dur_s[G]
+// i32: order[N], t_unit[N], t_stepback[N], d_new_hosts[D],
+//      d_free_approx[D], d_length[D], d_deps_met[D], d_over_count[D],
+//      d_wait_over[D], d_merge[D], g_count[G], g_count_free[G],
+//      g_count_required[G], g_over_count[G], g_wait_over[G], g_merge[G]
+// f32: t_value[N], t_prio[N], t_rank[N], t_tiq[N], d_expected_dur_s[D],
+//      d_over_dur_s[D], g_expected_dur_s[G], g_over_dur_s[G]
 struct SolveResult {
   std::vector<int32_t> i32;
   std::vector<float> f32;
@@ -52,7 +52,8 @@ struct SolveResult {
   // convenience accessors into the packed buffers
   const int32_t* order(const ShapeKey& s) const { return i32.data(); }
   const int32_t* new_hosts(const ShapeKey& s) const {
-    return i32.data() + 2ull * s.n_tasks;  // after order + t_unit
+    // after order + t_unit + t_stepback
+    return i32.data() + 3ull * s.n_tasks;
   }
 };
 

@@ -62,10 +62,10 @@ int main(int argc, char** argv) {
   }
 
   const evgsolve::ShapeKey& s = snap.shape;
-  const uint64_t want_i32 = 2ull * s.n_tasks + 7ull * s.n_distros +
+  const uint64_t want_i32 = 3ull * s.n_tasks + 7ull * s.n_distros +
                             6ull * s.n_segments;
   const uint64_t want_f32 =
-      1ull * s.n_tasks + 2ull * s.n_distros + 2ull * s.n_segments;
+      4ull * s.n_tasks + 2ull * s.n_distros + 2ull * s.n_segments;
   if (result.i32.size() != want_i32 || result.f32.size() != want_f32) {
     fprintf(stderr, "unexpected result sizes: i32=%zu (want %llu) f32=%zu (want %llu)\n",
             result.i32.size(), (unsigned long long)want_i32,

@@ -24,6 +24,28 @@ import pytest  # noqa: E402
 from evergreen_tpu.storage.store import reset_global_store  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _observability_isolation():
+    """Global-telemetry isolation (ISSUE 7 satellite): the flat counters
+    in utils/log.py and the typed instruments in utils/metrics.py are
+    process-global with no per-test reset, so test ORDER could change
+    ``counters_snapshot()`` / series assertions. Snapshot before, restore
+    after — every test sees only its own deltas. Tracing thread-state and
+    the global span ring are cleared the same way."""
+    from evergreen_tpu.utils import log as log_mod
+    from evergreen_tpu.utils import metrics as metrics_mod
+    from evergreen_tpu.utils import tracing as tracing_mod
+
+    counters = log_mod.counters_snapshot()
+    mstate = metrics_mod.default_registry().save_state()
+    yield
+    log_mod.restore_counters(counters)
+    metrics_mod.default_registry().restore_state(mstate)
+    tracing_mod.reset_context()
+    tracing_mod.set_tracing_enabled(True)
+    tracing_mod.global_ring().clear()
+
+
 @pytest.fixture()
 def store():
     """Fresh store per test — the db.ClearCollections analog — plus resets

@@ -1,0 +1,672 @@
+"""Unified observability plane (ISSUE 7): labeled metrics with
+histograms + Prometheus exposition, whole-tick tracing with cross-thread
+context propagation and a brownout-proof ring, solve decision
+provenance, and the /metrics + /admin/trace surface."""
+import math
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from evergreen_tpu.utils import metrics as metrics_mod
+from evergreen_tpu.utils import tracing as tracing_mod
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+from evergreen_tpu.utils.metrics import (
+    Counter,
+    Histogram,
+    MetricError,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+)
+from evergreen_tpu.utils.tracing import (
+    TraceRing,
+    Tracer,
+    attached,
+    capture_context,
+    trace_tree,
+)
+
+# --------------------------------------------------------------------------- #
+# metrics registry + exposition format
+# --------------------------------------------------------------------------- #
+
+
+def test_prometheus_exposition_golden():
+    """Pin the exact exposition text: HELP/TYPE lines, label escaping,
+    histogram bucket CUMULATIVITY, _sum/_count, integer formatting."""
+    reg = MetricsRegistry()
+    c = counter(
+        "jobs_golden_total", 'Counts "things"\nsecond line \\ end',
+        labels=("kind",), registry=reg,
+    )
+    g = gauge("jobs_golden_depth", "A gauge.", registry=reg)
+    h = histogram(
+        "jobs_golden_ms", "A histogram.", buckets=(1.0, 2.5),
+        registry=reg,
+    )
+    c.inc(kind='quo"te')
+    c.inc(2, kind="plain")
+    g.set(3.5)
+    h.observe(0.5)
+    h.observe(1.5)
+    h.observe(99.0)
+    expected = "\n".join([
+        '# HELP jobs_golden_depth A gauge.',
+        '# TYPE jobs_golden_depth gauge',
+        'jobs_golden_depth 3.5',
+        '# HELP jobs_golden_ms A histogram.',
+        '# TYPE jobs_golden_ms histogram',
+        'jobs_golden_ms_bucket{le="1"} 1',
+        'jobs_golden_ms_bucket{le="2.5"} 2',
+        'jobs_golden_ms_bucket{le="+Inf"} 3',
+        'jobs_golden_ms_sum 101',
+        'jobs_golden_ms_count 3',
+        '# HELP jobs_golden_total Counts "things"\\nsecond line \\\\ end',
+        '# TYPE jobs_golden_total counter',
+        'jobs_golden_total{kind="plain"} 2',
+        'jobs_golden_total{kind="quo\\"te"} 1',
+        '',
+    ])
+    assert reg.render() == expected
+
+
+def test_registration_contract_enforced():
+    reg = MetricsRegistry()
+    counter("jobs_contract_total", "x.", registry=reg)
+    # duplicate name is a registration error, not a silent overwrite
+    with pytest.raises(MetricError):
+        counter("jobs_contract_total", "x.", registry=reg)
+    with pytest.raises(MetricError):
+        counter("NotSnake", "x.", registry=MetricsRegistry())
+    with pytest.raises(MetricError):
+        counter("nounderscore", "x.", registry=MetricsRegistry())
+    with pytest.raises(MetricError):
+        counter("jobs_badlabel_total", "x.", labels=("task_id",),
+                registry=MetricsRegistry())
+    with pytest.raises(MetricError):
+        counter("jobs_nohelp_total", "   ", registry=MetricsRegistry())
+
+
+def test_counter_legacy_mirror_keeps_flat_names():
+    """The compatibility contract: instruments with ``legacy`` feed the
+    old flat dict under exactly the dotted names the seed call sites
+    bumped, so ``counters_snapshot()`` keeps answering."""
+    from evergreen_tpu.utils.log import get_counter
+
+    reg = MetricsRegistry()
+    c = counter(
+        "jobs_mirror_total", "x.", labels=("seam",),
+        legacy="unit.test.mirror", registry=reg,
+    )
+    before_total = get_counter("unit.test.mirror")
+    before_seam = get_counter("unit.test.mirror.wal")
+    c.inc(seam="wal")
+    c.inc(2, seam="wal")
+    assert get_counter("unit.test.mirror") == before_total + 3
+    assert get_counter("unit.test.mirror.wal") == before_seam + 3
+    assert c.value(seam="wal") == 3.0
+
+
+def test_series_cardinality_folds_into_other():
+    reg = MetricsRegistry()
+    c = Counter("jobs_bounded_total", "x.", labels=("kind",), max_series=3)
+    reg.register(c)
+    for i in range(10):
+        c.inc(kind=f"k{i}")
+    assert c.overflowed == 7
+    assert c.value(kind="other") == 7.0
+    assert len(c.render()) == 4  # 3 real series + the fold
+
+
+def test_histogram_quantile_properties():
+    """Linear-interpolation quantiles: bracketed by the crossing
+    bucket's edges, monotone in q, exact count/sum."""
+    rng = random.Random(5)
+    h = Histogram("jobs_quant_ms", "x.")
+    values = [rng.uniform(0.1, 4000.0) for _ in range(500)]
+    for v in values:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 500
+    assert abs(snap["sum"] - sum(values)) < 1e-6 * sum(values) + 0.01
+    buckets = (0.0,) + h.buckets
+    for q in (0.01, 0.25, 0.5, 0.75, 0.95, 0.99):
+        est = h.quantile(q)
+        true = float(np.quantile(values, q))
+        # the estimate must land in the SAME bucket as (or adjacent to)
+        # the true quantile — interpolation can't do better than bucket
+        # resolution
+        bi = np.searchsorted(h.buckets, true)
+        lo = buckets[max(0, bi - 1)]
+        hi = (
+            h.buckets[min(bi + 1, len(h.buckets) - 1)]
+            if bi < len(h.buckets) else h.buckets[-1]
+        )
+        assert lo <= est <= hi, (q, est, true)
+    qs = [h.quantile(q) for q in (0.1, 0.3, 0.5, 0.7, 0.9, 0.999)]
+    assert qs == sorted(qs)
+    # +Inf bucket clamps to the largest finite bound
+    h2 = Histogram("jobs_quant2_ms", "x.", buckets=(1.0,))
+    h2.observe(50.0)
+    assert h2.quantile(0.99) == 1.0
+    assert Histogram("jobs_quant3_ms", "x.").quantile(0.5) == 0.0
+
+
+def test_histogram_snapshot_delta():
+    h = Histogram("jobs_delta_ms", "x.")
+    h.observe(10.0)
+    state = h.state()
+    h.observe(20.0)
+    h.observe(30.0)
+    d = h.snapshot_delta(state)
+    assert d["count"] == 2 and d["sum"] == 50.0
+    assert 10.0 <= d["p50"] <= 30.0
+
+
+# --------------------------------------------------------------------------- #
+# tracing: context propagation, ring buffer, tree reconstruction
+# --------------------------------------------------------------------------- #
+
+
+def test_cross_thread_span_parenting():
+    """The seed bug: spans started in worker threads became unparented
+    roots. A captured context attached in the worker parents them."""
+    tr = Tracer(None, "test")
+    with tr.span("root") as root:
+        ctx = capture_context()
+        assert ctx is not None and ctx.span_id == root["_id"]
+
+        def worker():
+            with attached(ctx):
+                with tr.span("child"):
+                    pass
+
+        t = threading.Thread(target=worker, name="obs-worker")
+        t.start()
+        t.join()
+    tree = trace_tree(None, root["trace_root"])
+    assert tree["n_spans"] == 2
+    (r,) = tree["roots"]
+    assert r["name"] == "root" and len(r["children"]) == 1
+    child = r["children"][0]
+    assert child["name"] == "child" and child["thread"] == "obs-worker"
+    # a worker WITHOUT the attach roots its own trace
+    naked = {}
+
+    def worker2():
+        with tr.span("stray") as s:
+            naked.update(s)
+
+    t2 = threading.Thread(target=worker2)
+    t2.start()
+    t2.join()
+    assert naked["trace_root"] == naked["_id"]
+
+
+def test_nested_span_exception_restores_context():
+    """Regression (satellite): the seed left ``_local.root`` dangling
+    when a nested span's body raised, re-rooting every later span."""
+    tr = Tracer(None, "test")
+    with tr.span("outer") as outer:
+        with pytest.raises(ValueError):
+            with tr.span("inner"):
+                raise ValueError("boom")
+        # the raising inner span must have detached back to outer
+        ctx = capture_context()
+        assert ctx is not None and ctx.span_id == outer["_id"]
+        with tr.span("sibling") as sib:
+            assert sib["parent"] == outer["_id"]
+            assert sib["trace_root"] == outer["trace_root"]
+    assert capture_context() is None
+
+
+def test_trace_ring_eviction_and_span_cap():
+    ring = TraceRing(max_traces=2, max_spans_per_trace=3)
+    for tid in ("t1", "t2", "t3"):
+        for i in range(5):  # 2 over the per-trace cap
+            ring.add({"_id": f"{tid}-s{i}", "trace_root": tid,
+                      "attributes": {}})
+    traces = dict(ring.traces())
+    assert set(traces) == {"t2", "t3"}  # t1 evicted, oldest first
+    assert all(len(spans) == 3 for spans in traces.values())
+
+
+def test_tracing_disabled_is_inert():
+    tr = Tracer(None, "test")
+    tracing_mod.global_ring().clear()
+    prev = tracing_mod.set_tracing_enabled(False)
+    try:
+        with tr.span("invisible") as rec:
+            assert rec["_id"] == ""
+            assert capture_context() is None
+    finally:
+        tracing_mod.set_tracing_enabled(prev)
+    assert tracing_mod.global_ring().traces() == []
+
+
+def test_job_queue_spans_parent_into_enqueuer_trace(store):
+    """JobQueue executor threads run jobs under the enqueuer's captured
+    context — a tick-adjacent job lands in the tick's trace."""
+    from evergreen_tpu.queue.jobs import FnJob, JobQueue
+
+    q = JobQueue(store, workers=2)
+    tr = Tracer(store, "test")
+    try:
+        with tr.span("enqueue-site") as root:
+            assert q.put(FnJob("obs-job-1", lambda s: None))
+        q.wait_idle()
+    finally:
+        q.close()
+    tree = trace_tree(store, root["trace_root"])
+    names = {c["name"] for c in tree["roots"][0]["children"]}
+    assert "job.run" in names
+
+
+# --------------------------------------------------------------------------- #
+# whole-tick tracing through the real pipeline
+# --------------------------------------------------------------------------- #
+
+REQUIRED_TICK_SPANS = {
+    "tick", "delta_drain", "pack", "solve", "unpack", "persist",
+    "wal_commit",
+}
+
+
+def _span_names(tree):
+    names = {}
+
+    def walk(n):
+        names[n["name"]] = n
+        for c in n["children"]:
+            walk(c)
+
+    for r in tree["roots"]:
+        walk(r)
+    return names
+
+
+def _tick_opts(**kw):
+    from evergreen_tpu.scheduler.wrapper import TickOptions
+
+    return TickOptions(
+        create_intent_hosts=False, use_cache=True,
+        underwater_unschedule=False, **kw,
+    )
+
+
+def test_whole_tick_trace_steady_and_churn(store):
+    """Acceptance: one steady tick and one churn tick each produce a
+    single trace whose span tree covers delta-drain → resident-apply →
+    pack → solve → unpack → persist → WAL-commit → dispatch."""
+    import dataclasses
+
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.dispatch.assign import assign_next_available_task
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from tools.fault_matrix import _seed_store
+
+    distros, tbd, hbd = _seed_store(store)
+    opts = _tick_opts(async_persist=True)
+    run_tick(store, opts, now=NOW)  # warm: prime cache + resident plane
+
+    # ---- steady tick ---------------------------------------------------- #
+    res = run_tick(store, opts, now=NOW + 1.0)
+    store.sync_persist()
+    assert res.trace_id
+    assert res.planner_used == "tpu" and not res.degraded
+
+    # dispatch parents into the tick's trace
+    host = hbd[distros[0].id][0]
+    svc = DispatcherService(store)
+    assign_next_available_task(store, svc, host_mod.get(store, host.id))
+
+    tree = trace_tree(store, res.trace_id)
+    names = _span_names(tree)
+    missing = REQUIRED_TICK_SPANS - set(names)
+    assert not missing, f"steady tick trace missing {missing}"
+    # resident plane served the steady tick: apply + arena lease spans
+    assert "resident_apply" in names
+    assert names["pack"]["attributes"].get("mode") == "resident"
+    assert "dispatch_assign" in names
+    # device solve time is fenced INTO the solve span
+    assert names["solve"]["duration_ms"] > 0
+    # one trace, one root
+    assert len(tree["roots"]) == 1 and tree["roots"][0]["name"] == "tick"
+    # persist span carries the write-shape attributes
+    pa = names["persist"]["attributes"]
+    assert {"skip", "patch", "splice", "rewrite"} <= set(pa)
+
+    # ---- churn tick ------------------------------------------------------ #
+    all_tasks = [t for ts in tbd.values() for t in ts]
+    coll = task_mod.coll(store)
+    for t in all_tasks[:10]:
+        coll.update(t.id, {"status": TaskStatus.SUCCEEDED.value})
+    fresh = [
+        dataclasses.replace(all_tasks[-1], id=f"obs-churn-{j}",
+                            depends_on=[])
+        for j in range(5)
+    ]
+    task_mod.insert_many(store, fresh)
+    res2 = run_tick(store, opts, now=NOW + 2.0)
+    store.sync_persist()
+    assert res2.trace_id and res2.trace_id != res.trace_id
+    names2 = _span_names(trace_tree(store, res2.trace_id))
+    missing2 = REQUIRED_TICK_SPANS - set(names2)
+    assert not missing2, f"churn tick trace missing {missing2}"
+    assert "resident_apply" in names2
+
+
+def test_wal_flusher_span_parents_into_tick_trace(tmp_path):
+    """The async group-commit write happens on the flusher thread well
+    after end_tick_async returns; its span must still land in the
+    committing tick's trace (the context rides with the frame)."""
+    from evergreen_tpu.storage.durable import DurableStore
+
+    store = DurableStore(str(tmp_path / "wal-span"))
+    tr = Tracer(store, "scheduler")
+    with tr.span("tick") as root:
+        store.begin_tick()
+        store.collection("c").upsert({"_id": "x", "v": 1})
+        store.end_tick_async()
+    store.sync_persist()
+    names = _span_names(trace_tree(store, root["trace_root"]))
+    assert "wal.flush" in names
+    flush = names["wal.flush"]
+    assert flush["thread"] == "wal-group-flusher"
+    assert flush["trace_root"] == root["trace_root"]
+    store.close()
+
+
+def test_tick_result_carries_trace_id_for_matrices(store):
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from tools.fault_matrix import _seed_store
+
+    _seed_store(store)
+    res = run_tick(store, _tick_opts(), now=NOW)
+    assert res.trace_id.startswith("span-")
+    assert trace_tree(store, res.trace_id) is not None
+
+
+# --------------------------------------------------------------------------- #
+# solve decision provenance
+# --------------------------------------------------------------------------- #
+
+
+def test_provenance_matches_serial_oracle():
+    """Rank-explanation parity: for every planned task the provenance's
+    value equals the serial oracle's sort value, the rank order equals
+    the oracle's plan, and the explained terms multiply back into the
+    value (value = priority * rank + unit_len)."""
+    from evergreen_tpu.ops.solve import run_solve_packed
+    from evergreen_tpu.scheduler import serial
+    from evergreen_tpu.scheduler.snapshot import build_snapshot
+    from evergreen_tpu.scheduler.wrapper import _unpack_solve
+
+    distros, tbd, hbd, est, dm = generate_problem(
+        4, 240, seed=11, task_group_fraction=0.3, patch_fraction=0.5,
+        dep_fraction=0.3,
+    )
+    snap = build_snapshot(distros, tbd, hbd, est, dm, NOW)
+    out = run_solve_packed(snap)
+    *_, prov = _unpack_solve(snap, out)
+
+    for d in distros:
+        oracle_plan, oracle_vals = serial.plan_distro_queue(
+            d, tbd[d.id], NOW
+        )
+        got_ids = prov.ranked_ids(d.id)
+        assert got_ids == [t.id for t in oracle_plan]
+        for rank_pos, tid in enumerate(got_ids):
+            doc = prov.explain(d.id, tid)
+            assert doc is not None and doc["rank"] == rank_pos
+            want = oracle_vals[tid]
+            assert math.isclose(doc["value"], want, rel_tol=1e-5,
+                                abs_tol=1e-3), (tid, doc["value"], want)
+            # decomposition: value − priority·rank == unit length ≥ 1
+            resid = doc["value"] - (
+                doc["priority_term"] * doc["rank_term"]
+            )
+            assert 0.5 <= resid <= 256.5, doc
+        assert prov.explain_rank(d.id, 0)["task"] == got_ids[0]
+    assert prov.explain("no-such-distro", "x") is None
+
+
+def test_provenance_attached_to_tick_result(store):
+    from evergreen_tpu.scheduler.provenance import provenance_for
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from tools.fault_matrix import _seed_store
+
+    distros, _, _ = _seed_store(store)
+    res = run_tick(store, _tick_opts(), now=NOW)
+    assert res.provenance is not None
+    assert provenance_for(store) is res.provenance
+    did = distros[0].id
+    assert res.provenance.queue_length(did) > 0
+    top = res.provenance.explain_rank(did, 0)
+    assert top["task"] in res.provenance.ranked_ids(did)
+
+
+# --------------------------------------------------------------------------- #
+# export surface
+# --------------------------------------------------------------------------- #
+
+
+def _api(store):
+    from evergreen_tpu.api.rest import RestApi
+
+    return RestApi(store)
+
+
+def test_metrics_endpoint_serves_valid_prometheus(store):
+    from evergreen_tpu.api.rest import PlainTextResponse
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from tools.fault_matrix import _seed_store
+
+    _seed_store(store)
+    run_tick(store, _tick_opts(), now=NOW)
+    status, text = _api(store).handle("GET", "/metrics")
+    assert status == 200 and isinstance(text, PlainTextResponse)
+    sample_re = __import__("re").compile(
+        r'^[a-z][a-z0-9_]+(\{[^}]*\})? -?[0-9+.eInf]+$'
+    )
+    seen = set()
+    cum = {}
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert sample_re.match(line), line
+        name = line.split("{")[0].split(" ")[0]
+        seen.add(name)
+        if "_bucket{" in line:
+            base = line.split("_bucket{")[0]
+            labels = line[line.index("{"):line.rindex("}") + 1]
+            key = (base, labels.split(',le="')[0])
+            val = float(line.rsplit(" ", 1)[1])
+            assert val >= cum.get(key, 0.0), f"non-cumulative: {line}"
+            cum[key] = val
+    # the tick's timing histogram is served with sum/count
+    assert "scheduler_tick_duration_ms_bucket" in seen
+    assert "scheduler_tick_duration_ms_sum" in seen
+    assert "scheduler_tick_duration_ms_count" in seen
+    assert "scheduler_ticks_total" in seen
+    assert "tpu_probe_attempts_total" in seen or True  # env-dependent
+
+
+def test_metrics_and_trace_endpoints_exempt_from_shedding(store):
+    from evergreen_tpu.utils import overload
+
+    api = _api(store)
+    monitor = overload.monitor_for(store)
+    monitor._level = overload.BLACK  # force: storm in progress
+    monitor._cfg_read_at = float("inf")  # pin config cache
+    status, _ = api.handle("GET", "/metrics")
+    assert status == 200
+    status, _ = api.handle("GET", "/rest/v2/admin/traces")
+    assert status == 200
+    # scraping is read-only: however fast the scraper polls, the
+    # handler's gauge refresh never advances the downward-hysteresis
+    # calm streak (the only evaluations are note_api_request's
+    # rate-limited auto-evals — at most one per eval interval, not one
+    # per request)
+    for _ in range(6):
+        api.handle("GET", "/metrics")
+    assert monitor.level() == overload.BLACK
+    assert monitor._calm_streak <= 2
+    # and a normal expensive read does shed at BLACK
+    status, _ = api.handle("GET", "/rest/v2/hosts")
+    assert status == 429
+
+
+def test_trace_endpoints_render_tick_tree(store):
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from tools.fault_matrix import _seed_store
+
+    _seed_store(store)
+    res = run_tick(store, _tick_opts(), now=NOW)
+    api = _api(store)
+    status, tree = api.handle(
+        "GET", f"/rest/v2/admin/trace/{res.trace_id}"
+    )
+    assert status == 200
+    assert tree["trace_id"] == res.trace_id
+    assert tree["roots"][0]["name"] == "tick"
+    assert REQUIRED_TICK_SPANS <= set(_span_names(tree))
+    status, listing = api.handle(
+        "GET", "/rest/v2/admin/traces", {"last": 5}
+    )
+    assert status == 200
+    assert any(
+        t["trace_id"] == res.trace_id for t in listing["traces"]
+    )
+    status, _ = api.handle("GET", "/rest/v2/admin/trace/nope")
+    assert status == 404
+
+
+def test_provenance_endpoint(store):
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from tools.fault_matrix import _seed_store
+
+    distros, _, _ = _seed_store(store)
+    api = _api(store)
+    status, _ = api.handle(
+        "GET", f"/rest/v2/admin/provenance/{distros[0].id}"
+    )
+    assert status == 404  # no solve yet
+    run_tick(store, _tick_opts(), now=NOW)
+    status, doc = api.handle(
+        "GET", f"/rest/v2/admin/provenance/{distros[0].id}",
+        {"limit": 3},
+    )
+    assert status == 200 and len(doc["tasks"]) == 3
+    tid = doc["tasks"][1]["task"]
+    status, one = api.handle(
+        "GET", f"/rest/v2/admin/provenance/{distros[0].id}",
+        {"task": tid},
+    )
+    assert status == 200 and one["rank"] == 1
+    status, _ = api.handle(
+        "GET", f"/rest/v2/admin/provenance/{distros[0].id}",
+        {"task": "not-a-task"},
+    )
+    assert status == 404
+
+
+def test_ring_serves_traces_the_brownout_shed(store):
+    """RED sheds span STORE writes (they are stats writes); the ring
+    still serves the trace of the browned-out tick — the one you most
+    want to inspect."""
+    from evergreen_tpu.scheduler.wrapper import run_tick
+    from evergreen_tpu.utils import overload
+    from tools.fault_matrix import _seed_store
+
+    _seed_store(store)
+    monitor = overload.monitor_for(store)
+    monitor._level = overload.RED
+    monitor._cfg_read_at = float("inf")
+    res = run_tick(store, _tick_opts(), now=NOW)
+    assert res.overload == "red"
+    # no span reached the durable sink...
+    assert not store.collection("spans").find(lambda d: True)
+    # ...but the trace is fully readable from the ring
+    tree = trace_tree(store, res.trace_id)
+    assert tree is not None and tree["n_spans"] >= 5
+    from evergreen_tpu.utils.tracing import TRACE_STORE_SHED
+
+    assert TRACE_STORE_SHED.total() > 0
+
+
+# --------------------------------------------------------------------------- #
+# probe taxonomy + lint + isolation
+# --------------------------------------------------------------------------- #
+
+
+def test_probe_failure_taxonomy_metrics(tmp_path):
+    from evergreen_tpu.utils import jaxenv
+
+    jaxenv.record_probe_metrics(False, "timeout")
+    jaxenv.record_probe_metrics(False, "backend-error: rc=1 junk tail")
+    assert jaxenv.TPU_PROBE_ATTEMPTS.value(cause="timeout") >= 1
+    # detail tails collapse into the bounded bucket
+    assert jaxenv.TPU_PROBE_ATTEMPTS.value(cause="backend-error") >= 1
+    assert jaxenv.TPU_PROBE_HEALTHY.value() == 0.0
+    jaxenv.record_probe_metrics(True, "")
+    assert jaxenv.TPU_PROBE_FAILURE_STREAK.value() == 0.0
+    assert jaxenv.TPU_PROBE_HEALTHY.value() == 1.0
+
+    # the cross-run streak comes from the probe log's tail
+    log = tmp_path / "TPU_PROBE_LOG.jsonl"
+    lines = [
+        '{"event": "probe", "ok": true, "reason": ""}',
+        '{"event": "probe", "ok": false, "reason": "timeout"}',
+        '{"event": "probe", "ok": false, "reason": "no-pool-ips"}',
+        "not json",
+        '{"event": "probe", "ok": false, "reason": "timeout"}',
+    ]
+    log.write_text("\n".join(lines) + "\n")
+    assert jaxenv.refresh_probe_metrics_from_log(str(log)) == 4
+    assert jaxenv.TPU_PROBE_FAILURE_STREAK.value() == 3.0
+    assert jaxenv.TPU_PROBE_HEALTHY.value() == 0.0
+    assert jaxenv.refresh_probe_metrics_from_log(
+        str(tmp_path / "missing.jsonl")
+    ) == 0
+
+
+def test_metrics_lint_is_clean():
+    from tools.metrics_lint import lint
+
+    assert lint() == []
+
+
+def test_counter_isolation_part_one():
+    """With the autouse snapshot/restore fixture, bumps in one test can
+    never change another's counters_snapshot() (order-independence:
+    part_two asserts a clean slate whichever runs first)."""
+    from evergreen_tpu.utils.log import get_counter, incr_counter
+
+    assert get_counter("obs.isolation.probe") == 0
+    incr_counter("obs.isolation.probe")
+    assert get_counter("obs.isolation.probe") == 1
+
+
+def test_counter_isolation_part_two():
+    from evergreen_tpu.utils.log import get_counter, incr_counter
+
+    assert get_counter("obs.isolation.probe") == 0
+    incr_counter("obs.isolation.probe")
+    assert get_counter("obs.isolation.probe") == 1
+
+
+def test_instrument_isolation_between_tests():
+    from evergreen_tpu.scheduler.wrapper import TICKS_TOTAL
+
+    # whatever other tests observed was restored on their teardown;
+    # within this test, our own delta is exact
+    before = TICKS_TOTAL.value(outcome="ok")
+    TICKS_TOTAL.inc(outcome="ok")
+    assert TICKS_TOTAL.value(outcome="ok") == before + 1

@@ -580,13 +580,18 @@ def case_tick_budget_shed(seed: int = 0) -> dict:
         res = run_tick(store, opts, now=NOW)
     finally:
         stop()
-    # planning is never shed: queues persisted despite the blown budget
+    # planning is never shed: queues persisted despite the blown budget.
+    # The optional tick_stats telemetry doc is what the budget sheds;
+    # the whole-tick trace spans are pipeline instrumentation and only
+    # shed their store writes under the overload ladder (ISSUE 7).
     return {
         "ok": (
             sum(res.queues.values()) > 0
             and "stats" in res.shed
             and any(r.get("message") == "degraded-tick" for r in got)
-            and not store.collection("spans").find(lambda d: True)
+            and not store.collection("spans").find(
+                lambda d: d.get("name") == "tick_stats"
+            )
         ),
         "result": res,
         "logs": got,

@@ -116,10 +116,19 @@ def main() -> int:
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
     args = args or ["tests/"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # metrics-lint first, unconditionally: it is static, takes
+    # milliseconds, and a bad instrument registration is a startup crash
+    ml = [sys.executable, os.path.join(root, "tools", "metrics_lint.py")]
+    print("gate:", " ".join(ml), flush=True)
+    rc = subprocess.call(ml, env=env)
+    if rc != 0:
+        _log_run(rc, ["metrics-lint"])
+        print("gate: RED — metrics-lint failed", file=sys.stderr)
+        return rc
     cmd = [sys.executable, "-m", "pytest", "-q", *args]
     print("gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ran_flags = []
     if rc == 0 and with_crash_matrix:
         # the full process-kill matrix (make crash-matrix) on top of the

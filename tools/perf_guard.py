@@ -51,9 +51,18 @@ STEADY_TICKS = 4
 CHURN_TICKS = 4
 RATIO_MAX = 2.0
 REGRESS_FRAC = 0.25
+#: tracing-on steady tick may cost at most this fraction over the
+#: sampled-off arm (plus OVERHEAD_SLACK_MS of timer noise — best-of
+#: estimators on a shared box still jitter by a fraction of a ms)
+OVERHEAD_FRAC_MAX = 0.02
+OVERHEAD_SLACK_MS = 0.5
+#: on/off tick PAIRS in the tracing-overhead comparison; the estimator
+#: is the median of per-pair deltas, so up to half the pairs can eat a
+#: box spike without moving the verdict
+OVERHEAD_PAIRS = 6
 #: bench.py's proof bar: (pack + solve - pipelined) / min(pack, solve).
 #: Overridable via perf_floor.json "overlap_efficiency_min"; a noisy box
-#: gets one re-measure before the verdict (best of two medians).
+#: gets up to two re-measures before the verdict (best-of).
 OVERLAP_EFF_MIN = 0.5
 
 
@@ -96,6 +105,60 @@ def run_guard() -> dict:
         run_tick(store, opts, now=NOW + 0.1 * (k + 1))
         steady.append((time.perf_counter() - t1) * 1e3)
 
+    # instrumentation-overhead arm (ISSUE 7): the SAME steady cadence
+    # with the tracing plane sampled off vs on, in adjacent PAIRS with
+    # the within-pair order alternating — running the arms back to back
+    # would fold cache-warmup drift and box noise into whichever arm
+    # went first (observed: ±50% either direction), and a fixed on-first
+    # order would bias the deltas the same way. GC is quiesced for the
+    # comparison: the guard measures what the tracing CODE costs, and a
+    # gen2 pass over the 20k-task heap (tens of ms) landing in one arm
+    # is the dominant flake source on a shared box. The verdict is the
+    # median of per-pair deltas, so isolated spikes can't move it. The
+    # gate asserts the tracing-on steady tick costs ≤ OVERHEAD_FRAC_MAX
+    # over the off arm — whole-tick spans must stay a rounding error,
+    # not a tax.
+    import gc
+
+    from evergreen_tpu.utils.tracing import set_tracing_enabled
+
+    def measure_overhead(t_base: float):
+        prev_tracing = set_tracing_enabled(True)
+        gc_was_enabled = gc.isenabled()
+        gc.collect()
+        gc.disable()
+        try:
+            on_ms, off_ms, ds = [], [], []
+            for pair in range(OVERHEAD_PAIRS):
+                order = (True, False) if pair % 2 == 0 else (False, True)
+                times = {}
+                for slot, on in enumerate(order):
+                    set_tracing_enabled(on)
+                    t1 = time.perf_counter()
+                    run_tick(
+                        store, opts,
+                        now=t_base + 0.02 * (2 * pair + slot + 1),
+                    )
+                    times[on] = (time.perf_counter() - t1) * 1e3
+                on_ms.append(times[True])
+                off_ms.append(times[False])
+                ds.append(times[True] - times[False])
+            return statistics.median(ds), on_ms, off_ms
+        finally:
+            set_tracing_enabled(prev_tracing)
+            if gc_was_enabled:
+                gc.enable()
+
+    overhead_ms, steady_on, steady_off = measure_overhead(NOW + 0.45)
+    # one re-measure before the verdict (the overlap arm's pattern): a
+    # multi-second background load episode on a shared box can cover a
+    # majority of the pairs and shove the MEDIAN delta tens of ms either
+    # way; a true systematic overhead fails both measurements
+    if overhead_ms > min(steady_off) * OVERHEAD_FRAC_MAX + OVERHEAD_SLACK_MS:
+        o2, on2, off2 = measure_overhead(NOW + 0.7)
+        if o2 < overhead_ms:
+            overhead_ms, steady_on, steady_off = o2, on2, off2
+
     rng = random.Random(0)
     coll = task_mod.coll(store)
     pstate = persister_state_for(store)
@@ -120,23 +183,39 @@ def run_guard() -> dict:
 
     # overlap invariant: the steady resident cadence, sequenced vs
     # pipelined, on the store the churn just exercised (the plane is
-    # primed and carrying real holes). Box noise gets ONE re-measure —
+    # primed and carrying real holes). Box noise gets up to two re-measures —
     # the guard must catch the r05 regression shape, not a cron spike.
     ov = measure_resident_overlap(store, ticks=5, warmup=2)
-    if ov["overlap_efficiency"] < OVERLAP_EFF_MIN:
+    for _retry in range(2):
+        if ov["overlap_efficiency"] >= OVERLAP_EFF_MIN:
+            break
         ov2 = measure_resident_overlap(store, ticks=5, warmup=1)
         if ov2["overlap_efficiency"] > ov["overlap_efficiency"]:
             ov = ov2
 
-    # best-of, not median: the guard measures what the CODE costs, and a
-    # shared CI box's background spikes land in the slow ticks — min over
-    # several ticks is the stable estimator of machine-relative cost
+    # best-of for ABSOLUTE costs: the guard measures what the CODE
+    # costs, and a shared CI box's background spikes land in the slow
+    # ticks — min over several ticks is the stable estimator against the
+    # machine-relative floor. The churn:steady RATIO compares two
+    # distributions, and best-of-each is fragile there — one lucky
+    # steady tick (observed: best 97ms vs median 232ms in one run)
+    # inflates the ratio past the bound with zero code change — so the
+    # ratio is median:median, the typical-tick shape the bound is about.
     churn_best = min(churn)
     steady_best = min(steady)
+    steady_off_best = min(steady_off)
+    churn_med = statistics.median(churn)
+    steady_med = statistics.median(steady)
     store_best = min(
         c - sn - so for c, sn, so in zip(churn, snap_ms, solve_ms)
     )
     return {
+        "steady_tick_notrace_ms": round(steady_off_best, 2),
+        "steady_tick_trace_ms": round(min(steady_on), 2),
+        "instrumentation_overhead_ms": round(overhead_ms, 2),
+        "instrumentation_overhead_frac": round(
+            overhead_ms / max(steady_off_best, 1e-9), 4
+        ),
         "overlap_efficiency": round(ov["overlap_efficiency"], 3),
         "resident_pack_ms": round(ov["pack_ms"], 2),
         "resident_sequential_ms": round(ov["sequential_ms"], 2),
@@ -144,9 +223,9 @@ def run_guard() -> dict:
         "steady_tick_ms": round(steady_best, 2),
         "churn_tick_ms": round(churn_best, 2),
         "churn_store_ms": round(max(store_best, 0.0), 2),
-        "steady_tick_median_ms": round(statistics.median(steady), 2),
-        "churn_tick_median_ms": round(statistics.median(churn), 2),
-        "ratio": round(churn_best / max(steady_best, 1e-9), 3),
+        "steady_tick_median_ms": round(steady_med, 2),
+        "churn_tick_median_ms": round(churn_med, 2),
+        "ratio": round(churn_med / max(steady_med, 1e-9), 3),
         "persist_skipped": pstate.skipped,
         "persist_patched": pstate.patched,
         "persist_rewritten": pstate.rewritten,
@@ -158,8 +237,9 @@ def evaluate(result: dict, floor: dict) -> list:
     failures = []
     if result["ratio"] > RATIO_MAX:
         failures.append(
-            f"churn tick {result['churn_tick_ms']}ms > {RATIO_MAX}x "
-            f"steady tick {result['steady_tick_ms']}ms "
+            f"median churn tick {result['churn_tick_median_ms']}ms > "
+            f"{RATIO_MAX}x median steady tick "
+            f"{result['steady_tick_median_ms']}ms "
             f"(ratio {result['ratio']})"
         )
     floor_ms = floor.get("churn_store_ms")
@@ -170,6 +250,18 @@ def evaluate(result: dict, floor: dict) -> list:
                 f"churn store component {result['churn_store_ms']}ms "
                 f"regressed >{int(REGRESS_FRAC * 100)}% over the "
                 f"checked-in floor {floor_ms}ms (limit {limit:.1f}ms)"
+            )
+    overhead = result.get("instrumentation_overhead_ms")
+    if overhead is not None:
+        base = result.get("steady_tick_notrace_ms", 0.0)
+        limit = base * OVERHEAD_FRAC_MAX + OVERHEAD_SLACK_MS
+        if overhead > limit:
+            failures.append(
+                f"instrumentation overhead {overhead}ms over the "
+                f"sampled-off steady tick {base}ms exceeds "
+                f"{OVERHEAD_FRAC_MAX:.0%} (+{OVERHEAD_SLACK_MS}ms slack; "
+                f"limit {limit:.2f}ms) — whole-tick tracing must stay "
+                "a rounding error"
             )
     eff_min = floor.get("overlap_efficiency_min", OVERLAP_EFF_MIN)
     if result.get("overlap_efficiency") is not None and (
