@@ -59,6 +59,15 @@ overload-matrix:
 resident-parity:
 	env JAX_PLATFORMS=cpu python tools/resident_parity.py
 
+# sharded tick == single-scheduler oracle at 2/4/8 shards (local +
+# stacked solve modes); gate-blocking via tools/gate.py --shard-parity
+shard-parity:
+	env JAX_PLATFORMS=cpu python tools/bench_sharded.py --parity
+
+# N-process sharded-plane churn throughput vs the single-shard plane
+bench-sharded-plane:
+	env JAX_PLATFORMS=cpu python tools/bench_sharded_plane.py
+
 # static metrics-plane lint (fast; gate runs it unconditionally):
 # every instrument registered exactly once, literal snake_case names
 # with a known subsystem prefix, labels from the allowed vocabulary,

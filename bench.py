@@ -155,6 +155,9 @@ def main() -> None:
     # --- dispatch-path scale check (next_task under concurrency) ----------- #
     dispatch = measure_dispatch()
 
+    # --- sharded control plane: N schedulers, one fleet -------------------- #
+    sharded_plane = measure_sharded_plane()
+
     from evergreen_tpu.utils.benchgen import bench_result_payload
     from evergreen_tpu.utils.log import counters_snapshot
 
@@ -179,6 +182,7 @@ def main() -> None:
             "pack_ms": round(ov["pack_ms"], 2),
             "tick_ms": round(ov["sequential_ms"], 2),
         },
+        sharded_plane=sharded_plane,
     )
     print(json.dumps(result))
     if _backend == "axon":
@@ -237,6 +241,53 @@ def write_tpu_evidence(result: dict) -> None:
         json.dump(evidence, f, indent=2)
     print(f"# TPU evidence captured: {evidence['devices']}",
           file=sys.stderr)
+
+
+def measure_sharded_plane() -> dict:
+    """The ``sharded_churn_tick_ms`` arm: the same churn workload
+    partitioned across 4 scheduler shards (one process each — own
+    store, TickCache, resident plane, tick loop) vs the single-shard
+    plane at equal total load (tools/bench_sharded_plane.py). Headline
+    is the dedicated-shard bound (slowest shard gates the round);
+    the contended wall ratio for THIS box rides along. Skip with
+    EVERGREEN_TPU_BENCH_SHARDED=0 (it spawns 9 jax processes)."""
+    if os.environ.get("EVERGREEN_TPU_BENCH_SHARDED", "1") == "0":
+        return {"skipped": True}
+    import subprocess
+
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "bench_sharded_plane.py"),
+        "--shards", os.environ.get("EVERGREEN_TPU_BENCH_SHARDS", "4"),
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=3600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PALLAS_AXON_POOL_IPS": ""},
+        )
+        for line in proc.stderr.splitlines():
+            print(line, file=sys.stderr)
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        # trim the arm detail: the headline + per-shard medians carry
+        # the evidence; full detail reruns via make bench-sharded-plane
+        return {
+            "metric": payload["metric"],
+            "value": payload["value"],
+            "n_shards": payload["n_shards"],
+            "single_churn_tick_ms": payload["single_churn_tick_ms"],
+            "per_shard_churn_ms":
+                payload["dedicated"]["per_shard_median_ms"],
+            "throughput_ratio": payload["throughput_ratio"],
+            "throughput_ratio_observed":
+                payload["throughput_ratio_observed"],
+            "cores": payload["cores"],
+        }
+    except Exception as exc:  # noqa: BLE001 — the sharded arm must not
+        # kill the headline bench run
+        print(f"# sharded-plane arm failed: {exc!r}", file=sys.stderr)
+        return {"error": repr(exc)[-200:]}
 
 
 def measure_dispatch() -> dict:

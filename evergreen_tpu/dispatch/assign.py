@@ -96,6 +96,21 @@ def assign_next_available_task(
         return t
 
 
+def assign_next_available_task_fleet(
+    plane, host_id: str, now: Optional[float] = None
+) -> Optional[Task]:
+    """Global agent pull over the sharded control plane's shard-local
+    queues (scheduler/sharded_plane.py): agents address ONE fleet — the
+    pull locates the host's owning shard (its distro's consistent-hash
+    owner, handoff overrides included) and runs the classic CAS-pair
+    assignment against that shard's store and dispatcher. The agent
+    never knows shards exist."""
+    host = plane.find_host(host_id)
+    if host is None:
+        return None
+    return plane.assign_next_task(host, now=now)
+
+
 def _assign_next_available_task(
     store: Store,
     svc: DispatcherService,

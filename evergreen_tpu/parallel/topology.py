@@ -1,0 +1,193 @@
+"""Shard topology: which scheduler shard owns which distro.
+
+The sharded control plane (scheduler/sharded_plane.py) partitions the
+fleet's distros across N scheduler shards, each with its own lease,
+fenced WAL segment, and resident-plane slabs. The partition function
+lives here and has three properties the plane's correctness and economics
+depend on:
+
+* **Deterministic** — every process (shards, dispatchers, recovery,
+  parity tools) derives the same owner for a distro from nothing but the
+  distro id and the shard count; no assignment table to replicate.
+* **Stable under resizing** — rendezvous (highest-random-weight) hashing:
+  each (shard, distro) pair scores ``blake2b(shard ‖ distro)`` and the
+  max score wins. Removing a shard reassigns exactly the distros it
+  owned; growing from N to N+1 shards moves ~1/(N+1) of the distros and
+  touches nothing else — so a topology change re-primes a handful of
+  distros (delta-shaped, scheduler/resident.py) instead of reshuffling
+  the fleet (tests/test_sharded_plane.py pins the ~1/N bound).
+* **Affinity-aware** — distros coupled through secondary (alias) queues
+  must co-locate: a task's alias row is planned by the shard that owns
+  the task's document, so splitting an alias pair across shards would
+  either lose the alias queue or duplicate the document (and with it the
+  dispatch CAS). Placement therefore hashes a *placement key*: the
+  canonical representative of the distro's alias-affinity group (the
+  Tesserae placement-policy framing — constraints first, balance
+  second).
+
+Ownership **overrides** sit on top of the hash: cross-shard rebalancing
+(a YELLOW shard handing distros to a GREEN sibling) records
+distro → shard overrides sourced from durable handoff records, so an
+override survives crashes exactly as far as the handoff protocol does
+(scheduler/sharded_plane.py).
+
+Per-shard storage naming also lives here so every layer (durable store,
+lease, tools) agrees on it: shard ``k`` journals to ``wal.shard<k>.log``,
+snapshots to ``snapshot.shard<k>.json``, and leases at
+``writer.shard<k>.lease`` inside ONE data directory — segment files are
+merge-replayable into a whole-fleet view (storage/durable.py
+``fleet_segment_ids``).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional
+
+#: virtual-node count is not needed for rendezvous hashing (every shard
+#: scores every key); kept as the documented knob name for a future
+#: weighted variant
+DEFAULT_VNODES = 1
+
+
+def _score(shard_id: int, key: str) -> int:
+    h = hashlib.blake2b(
+        f"{shard_id}\x00{key}".encode("utf-8"), digest_size=8
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
+class ShardTopology:
+    """Deterministic distro → shard assignment for an ``n_shards``-wide
+    control plane, with alias-affinity placement keys and rebalancing
+    overrides."""
+
+    def __init__(
+        self,
+        n_shards: int,
+        affinity: Optional[Dict[str, str]] = None,
+        overrides: Optional[Dict[str, int]] = None,
+    ) -> None:
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = n_shards
+        #: distro id → placement key (alias-affinity representative);
+        #: absent ids place by their own id
+        self.affinity: Dict[str, str] = dict(affinity or {})
+        #: distro id → shard id, from durable handoff records; an
+        #: override names the distro itself (not its placement key):
+        #: a migration moves ONE distro's whole affinity group — the
+        #: plane migrates groups together for the same reason placement
+        #: hashes them together
+        self.overrides: Dict[str, int] = dict(overrides or {})
+
+    # -- assignment ---------------------------------------------------- #
+
+    def placement_key(self, distro_id: str) -> str:
+        return self.affinity.get(distro_id, distro_id)
+
+    def hash_shard_for(self, distro_id: str) -> int:
+        """The pure consistent-hash owner (no overrides) — rendezvous
+        over the placement key."""
+        key = self.placement_key(distro_id)
+        best = 0
+        best_score = -1
+        for shard in range(self.n_shards):
+            s = _score(shard, key)
+            if s > best_score:
+                best, best_score = shard, s
+        return best
+
+    def shard_for(self, distro_id: str) -> int:
+        """The owning shard: rebalancing override first, hash otherwise."""
+        ov = self.overrides.get(distro_id)
+        if ov is not None and 0 <= ov < self.n_shards:
+            return ov
+        return self.hash_shard_for(distro_id)
+
+    def assignments(
+        self, distro_ids: Iterable[str]
+    ) -> Dict[int, List[str]]:
+        """Shard id → owned distro ids (every shard present, possibly
+        empty), preserving the input order within each shard."""
+        out: Dict[int, List[str]] = {k: [] for k in range(self.n_shards)}
+        for did in distro_ids:
+            out[self.shard_for(did)].append(did)
+        return out
+
+    # -- affinity ------------------------------------------------------- #
+
+    @staticmethod
+    def affinity_from_pairs(
+        pairs: Iterable[Iterable[str]],
+    ) -> Dict[str, str]:
+        """Union-find over coupling constraints: each element of
+        ``pairs`` is a set of distro ids that must co-locate (a task's
+        primary distro plus its secondary/alias distros). Returns the
+        distro → canonical-representative map (the lexicographic min of
+        each group); singleton groups are omitted (identity placement)."""
+        parent: Dict[str, str] = {}
+
+        def find(x: str) -> str:
+            parent.setdefault(x, x)
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a: str, b: str) -> None:
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                # lexicographic-min root keeps the representative
+                # deterministic regardless of pair order
+                if rb < ra:
+                    ra, rb = rb, ra
+                parent[rb] = ra
+
+        for group in pairs:
+            ids = [i for i in group if i]
+            for other in ids[1:]:
+                union(ids[0], other)
+        out: Dict[str, str] = {}
+        for x in parent:
+            r = find(x)
+            if r != x:
+                out[x] = r
+        # representatives map to themselves implicitly; include them only
+        # when the group is non-trivial so the dict stays sparse
+        return out
+
+    @classmethod
+    def affinity_from_store(cls, store) -> Dict[str, str]:
+        """Alias-affinity groups from the live documents: every task that
+        plans into secondary distros couples its primary distro to them."""
+        pairs = []
+        for doc in store.collection("tasks").find(
+            lambda d: bool(d.get("secondary_distros"))
+        ):
+            pairs.append(
+                [doc.get("distro_id", "")] + list(doc["secondary_distros"])
+            )
+        return cls.affinity_from_pairs(pairs)
+
+
+# -- per-shard storage naming (one vocabulary for every layer) ----------- #
+
+
+def wal_segment_name(shard_id: Optional[int]) -> str:
+    """WAL file name for a shard (``None``/unsharded keeps the classic
+    name, so a single-scheduler deployment's files are untouched)."""
+    return "wal.log" if shard_id is None else f"wal.shard{shard_id}.log"
+
+
+def snapshot_segment_name(shard_id: Optional[int]) -> str:
+    return (
+        "snapshot.json" if shard_id is None
+        else f"snapshot.shard{shard_id}.json"
+    )
+
+
+def shard_lease_name(shard_id: Optional[int]) -> str:
+    return (
+        "writer.lease" if shard_id is None
+        else f"writer.shard{shard_id}.lease"
+    )

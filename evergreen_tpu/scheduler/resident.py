@@ -229,6 +229,9 @@ class ResidentPlane:
         self.fast_replaces = 0
         self.fast_removes = 0
         self.fallbacks = 0
+        #: distro-SET changes absorbed by splicing surviving slabs
+        #: (topology changes / shard handoffs) instead of a full rebuild
+        self.topology_splices = 0
         #: optional device mirror (tunnel-TPU path): dirty spans per
         #: dtype kind, recorded by every mutator when the mirror is on
         self._mirror = None
@@ -261,6 +264,7 @@ class ResidentPlane:
             "fast_replaces": self.fast_replaces,
             "fast_removes": self.fast_removes,
             "fallbacks": self.fallbacks,
+            "topology_splices": self.topology_splices,
         }
         if self._mirror is not None:
             out["mirror_delta_rows"] = self._mirror.delta_rows
@@ -314,6 +318,38 @@ class ResidentPlane:
                             "resident-delta-failed", error=repr(exc)[-300:]
                         )
                         reason = "delta-error"
+                if reason == "distro-set":
+                    # topology change (shard handoff, enable/disable):
+                    # splice surviving slabs into the new layout and pay
+                    # membership builds only for ADDED distros — any
+                    # ineligibility or error falls back to the classic
+                    # full rebuild below
+                    try:
+                        if self._splice_distro_set(
+                            solver_distros, tasks_by_distro,
+                            hosts_by_distro, running_estimates, deps_met,
+                        ):
+                            reason = None
+                            self.topology_splices += 1
+                            RESIDENT_EVENTS.inc(outcome="topology_splice")
+                            get_logger("scheduler").info(
+                                "resident-topology-splice",
+                                n_distros=len(solver_distros),
+                            )
+                    except Exception as exc:  # noqa: BLE001 — any splice
+                        # bug degrades to a rebuild, never a wrong plane;
+                        # counted + breaker-charged like a delta failure
+                        # so a persistently broken splice opens the
+                        # breaker and shows on /metrics instead of hiding
+                        # in rebuild_reasons
+                        self._breaker.record_failure(
+                            now=now, error=repr(exc)
+                        )
+                        RESIDENT_EVENTS.inc(outcome="splice_failed")
+                        get_logger("resilience").warning(
+                            "resident-splice-failed",
+                            error=repr(exc)[-300:],
+                        )
                 if reason is not None:
                     self._rebuild(
                         solver_distros, tasks_by_distro, hosts_by_distro,
@@ -537,6 +573,275 @@ class ResidentPlane:
             name: (v.view(np.bool_) if FIELD_KINDS[name] == "u8" else v)
             for name, v in self.cols.items()
         }
+
+    # ------------------------------------------------------------------ #
+    # delta-shaped distro-set change (topology change / shard handoff)
+    # ------------------------------------------------------------------ #
+
+    def _splice_distro_set(
+        self,
+        solver_distros: List[Distro],
+        tasks_by_distro: Dict[str, List[Task]],
+        hosts_by_distro: Dict[str, list],
+        running_estimates: Dict[str, object],
+        deps_met: Dict[str, bool],
+    ) -> bool:
+        """Absorb a pure distro-SET change — distros migrated in or out
+        by the sharded control plane's handoffs, or enabled/disabled —
+        without a full rebuild: surviving distros' slabs (columns,
+        high-water marks, hole structure, unit maps, membership edges)
+        are SPLICED into the new layout with constant-shift index fixups,
+        and only ADDED distros pay a membership build + static pack. The
+        re-prime cost is O(moved distros' rows + a memcpy of the rest)
+        instead of O(everything re-derived).
+
+        Returns False (caller full-rebuilds) when any surviving distro
+        churned inside the same gap — its task-list identity changed —
+        or its group-versions semantics flipped; raises nothing the
+        caller doesn't absorb into the rebuild fallback."""
+        if self._truth is None or not self._slabs:
+            return False
+        old_by_did = self._slab_by_did
+        added: List[Tuple[int, "Distro"]] = []
+        for di, d in enumerate(solver_distros):
+            s = old_by_did.get(d.id)
+            if s is None:
+                added.append((di, d))
+                continue
+            lst = tasks_by_distro.get(d.id)
+            if lst is None or lst is not s.tasks:
+                return False  # the distro churned in the same gap
+            if bool(d.planner_settings.group_versions) != s.gv:
+                return False  # membership semantics changed
+        if len(added) == len(solver_distros):
+            return False  # nothing survives — a rebuild costs the same
+
+        from ..utils.native import get_evgpack
+
+        evgpack = get_evgpack()
+        n_d = len(solver_distros)
+
+        # pass 1 (the delta): memberships for ADDED distros only, in the
+        # local block convention of _rebuild (base 0, unit_base 0,
+        # named_base == n_d; rebased into the slabs in pass 3)
+        blocks: Dict[str, tuple] = {}
+        fn = evgpack.build_memberships if evgpack is not None else None
+        for di, d in added:
+            tasks = tasks_by_distro.get(d.id, [])
+            gv = bool(d.planner_settings.group_versions)
+            n = len(tasks)
+            seg_local = np.zeros(max(n, 1), np.int32)
+            dm_local = np.ones(max(n, 1), np.uint8)
+            if fn is not None:
+                nu, mt, mu, _gk, snames, smax = fn(
+                    tasks, gv, 0, 0, di, n_d, seg_local, deps_met,
+                    dm_local, False,
+                )
+            else:
+                nu, mt, mu, _gk, snames, smax = build_memberships(
+                    d, tasks, 0, 0, di, n_d, seg_local, deps_met,
+                    dm_local, False,
+                )
+            blocks[d.id] = (
+                tasks, gv, nu, np.frombuffer(mt, np.int32),
+                np.frombuffer(mu, np.int32), snames, smax, seg_local,
+                dm_local,
+            )
+
+        # pass 2: new layout — surviving slabs keep their caps (and
+        # every high-water mark / hole below it), added slabs size
+        # exactly like a full rebuild would
+        old_pos = {
+            s.did: (s.di, s.t0, s.u0, s.m0, s.g0, s.h0)
+            for s in self._slabs
+        }
+        new_slabs: List[_Slab] = []
+        t0 = u0 = m0 = h0 = 0
+        g0 = n_d
+        for di, d in enumerate(solver_distros):
+            s = old_by_did.get(d.id)
+            if s is None:
+                (tasks, gv, nu, mt, mu, snames, smax, _sl, _dm) = (
+                    blocks[d.id]
+                )
+                hs = hosts_by_distro.get(d.id, [])
+                s = _Slab()
+                s.did, s.gv = d.id, gv
+                s.tcap, s.n = _cap(len(tasks)), len(tasks)
+                s.ucap, s.nu = _cap(nu), nu
+                s.mcap, s.nm = _cap(len(mt)), len(mt)
+                s.gcap = _cap(len(smax) + 2, minimum=8)
+                s.hcap, s.nh = _cap(len(hs), minimum=8), 0
+                s.tasks = tasks
+                s.rows = list(range(len(tasks)))
+                s.row_of = {t.id: j for j, t in enumerate(tasks)}
+                s.snames, s.smax = list(snames), list(smax)
+                s.dep_targets = {
+                    dep.task_id for t in tasks for dep in t.depends_on
+                }
+            s.di, s.dobj = di, d
+            # the cached unit maps hold GLOBAL unit ids — stale once u0
+            # shifts; re-derived lazily from the spliced columns
+            s.vers_unit = s.grp_unit = None
+            s.t0, s.u0, s.m0, s.g0, s.h0 = t0, u0, m0, g0, h0
+            new_slabs.append(s)
+            t0 += s.tcap
+            u0 += s.ucap
+            m0 += s.mcap
+            g0 += s.gcap
+            h0 += s.hcap
+        prev = self.dims
+        dims = {
+            "N": _fine_bucket(t0, prev.get("N", 0)),
+            "M": _fine_bucket(m0, prev.get("M", 0)),
+            "U": _fine_bucket(u0, prev.get("U", 0)),
+            "G": _fine_bucket(g0, prev.get("G", 0)),
+            "H": _fine_bucket(h0, prev.get("H", 0)),
+            "D": _bucket(max(n_d, 1), minimum=8),
+        }
+
+        # pass 3: fresh truth arena — splice surviving slabs' column
+        # ranges (constant-shift fixups on the index-bearing columns),
+        # then the commit below lets the existing fill paths complete
+        # added slabs and every slab's host rows
+        old_cols = self.cols
+        old_slot = self.slot_tasks
+        old_tb, old_tst = self.t_basis, self.t_start
+        old_tef = self.t_expf
+        old_seg_names = self.seg_names
+        truth = arena_for_dims(dims)
+        cols = {name: truth.view(name) for name in FIELD_KINDS}
+        t_basis = np.zeros(dims["N"], np.float64)
+        t_start = np.zeros(dims["N"], np.float64)
+        t_expf = np.zeros(dims["N"], np.float32)
+        h_start = np.zeros(dims["H"], np.float64)
+        slot_tasks: List[Optional[Task]] = [None] * dims["N"]
+        seg_names: List[Tuple[int, str]] = (
+            [(di, "") for di in range(n_d)]
+            + [(-1, "")] * (dims["G"] - n_d)
+        )
+        cols["g_distro"][:n_d] = np.arange(n_d, dtype=np.int32)
+        cols["g_unnamed"][:n_d] = 1
+        cols["g_valid"][:n_d] = 1
+
+        t_fields = [n for n in FIELD_KINDS if n.startswith("t_")]
+        u_fields = [n for n in FIELD_KINDS if n.startswith("u_")]
+        g_fields = [n for n in FIELD_KINDS if n.startswith("g_")]
+        for s in new_slabs:
+            pos = old_pos.get(s.did)
+            if pos is None:
+                continue
+            odi, ot0, ou0, om0, og0, _oh0 = pos
+            hw_t, hw_m, hw_u = s.n, s.nm, s.nu
+            for name in t_fields:
+                cols[name][s.t0:s.t0 + hw_t] = (
+                    old_cols[name][ot0:ot0 + hw_t]
+                )
+            cols["t_distro"][s.t0:s.t0 + s.tcap] = s.di
+            if hw_t:
+                # remap: a row's segment is either this distro's unnamed
+                # id (== the old di) or a named id in [old g0, old
+                # g0+gcap) — both are constant shifts; hole rows reset
+                seg = cols["t_seg"][s.t0:s.t0 + hw_t]
+                valid = (
+                    old_cols["t_valid"][ot0:ot0 + hw_t].astype(bool)
+                )
+                np.copyto(
+                    seg,
+                    np.where(
+                        seg == np.int32(odi), np.int32(s.di),
+                        seg - np.int32(og0) + np.int32(s.g0),
+                    ),
+                    where=valid,
+                )
+                np.copyto(seg, np.int32(s.di), where=~valid)
+            t_basis[s.t0:s.t0 + hw_t] = old_tb[ot0:ot0 + hw_t]
+            t_start[s.t0:s.t0 + hw_t] = old_tst[ot0:ot0 + hw_t]
+            t_expf[s.t0:s.t0 + hw_t] = old_tef[ot0:ot0 + hw_t]
+            slot_tasks[s.t0:s.t0 + hw_t] = old_slot[ot0:ot0 + hw_t]
+            # deps-met can churn without regenerating the task list (a
+            # parent finished elsewhere): refill from the live map
+            dmcol = cols["t_deps_met"]
+            for t in s.tasks:
+                dmcol[s.t0 + s.row_of[t.id]] = deps_met.get(t.id, True)
+            for name in ("m_task", "m_unit", "m_valid"):
+                cols[name][s.m0:s.m0 + hw_m] = (
+                    old_cols[name][om0:om0 + hw_m]
+                )
+            if hw_m:
+                cols["m_task"][s.m0:s.m0 + hw_m] += np.int32(s.t0 - ot0)
+                cols["m_unit"][s.m0:s.m0 + hw_m] += np.int32(s.u0 - ou0)
+            for name in u_fields:
+                cols[name][s.u0:s.u0 + hw_u] = (
+                    old_cols[name][ou0:ou0 + hw_u]
+                )
+            cols["u_distro"][s.u0:s.u0 + hw_u] = s.di
+            # named-segment slab: full cap range (tombstones keep their
+            # positions so later segment ids never shift)
+            for name in g_fields:
+                cols[name][s.g0:s.g0 + s.gcap] = (
+                    old_cols[name][og0:og0 + s.gcap]
+                )
+            cols["g_distro"][s.g0:s.g0 + s.gcap] = s.di
+            for i in range(s.gcap):
+                prev_di, nm = old_seg_names[og0 + i]
+                seg_names[s.g0 + i] = (
+                    (s.di, nm) if prev_di != -1 else (-1, "")
+                )
+
+        # commit the new layout, then complete it with the existing fill
+        # paths (added-slab bodies, host rows for every slab)
+        self._truth = truth
+        self.dims = dims
+        self.cols = cols
+        self.t_basis, self.t_start, self.t_expf = t_basis, t_start, t_expf
+        self.h_start = h_start
+        self._slabs = new_slabs
+        self._slab_by_did = {s.did: s for s in new_slabs}
+        self.distro_ids = [d.id for d in solver_distros]
+        self.slot_tasks = slot_tasks
+        self.seg_names = seg_names
+
+        for s in new_slabs:
+            block = blocks.get(s.did)
+            if block is not None:
+                (tasks, _gv, nu, mt, mu, _snames, _smax, seg_local,
+                 dm_local) = block
+                n = s.n
+                if n:
+                    sl = slice(s.t0, s.t0 + n)
+                    cols["t_valid"][sl] = 1
+                    cols["t_distro"][sl] = s.di
+                    cols["t_seg"][sl] = np.where(
+                        seg_local[:n] < n_d, seg_local[:n],
+                        seg_local[:n] - np.int32(n_d) + np.int32(s.g0),
+                    )
+                    cols["t_deps_met"][sl] = dm_local[:n]
+                    self._pack_static_rows(s.t0, tasks)
+                    for j, t in enumerate(tasks):
+                        self.slot_tasks[s.t0 + j] = t
+                if len(mt):
+                    msl = slice(s.m0, s.m0 + len(mt))
+                    cols["m_task"][msl] = mt + np.int32(s.t0)
+                    cols["m_unit"][msl] = mu + np.int32(s.u0)
+                    cols["m_valid"][msl] = 1
+                if nu:
+                    cols["u_distro"][s.u0:s.u0 + nu] = s.di
+                self._write_seg_slab(s)
+            # host rows: the cold-equivalent refill for EVERY slab (host
+            # churn rides the delta stream, which this gap skipped; the
+            # fill also re-registers host-introduced segments)
+            self._fill_host_rows(
+                s, hosts_by_distro.get(s.did, []), running_estimates
+            )
+            cols["d_task_count"][s.di] = len(s.tasks)
+        cols["d_valid"][:n_d] = 1
+        pack_distro_settings(self._bool_view_cols(), solver_distros)
+
+        self.n_valid = sum(len(s.tasks) for s in new_slabs)
+        if self._mirror is not None:
+            self._spans = None  # layout changed: full upload this tick
+        return True
 
     # ------------------------------------------------------------------ #
     # delta application

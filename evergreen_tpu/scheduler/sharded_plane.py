@@ -1,0 +1,1116 @@
+"""Sharded control plane: N scheduler shards, one fleet, one tick round.
+
+The single-scheduler tick (scheduler/wrapper.py run_tick) is fast, but it
+is still ONE process: every distro funnels through one lease, one WAL,
+one resident plane, so total throughput is capped by one core's tick
+loop. This driver multiplies the whole plane: distros partition across N
+scheduler shards by consistent hash (parallel/topology.py), each shard
+owning its own lease (distinct path + epoch sequence), its own fenced
+WAL segment (``wal.shard<k>.log``), its own TickCache / PersisterState /
+resident-plane slabs (all are per-store singletons already), and each
+shard runs the UNCHANGED run_tick over its subset — concurrently with
+its siblings on a worker pool. The elastic-cluster shape of Aryl:
+capacity is loaned between shards instead of stranded per-shard, with
+the placement constraints framed à la Tesserae (alias-coupled distros
+co-locate; see topology.py).
+
+**Stacked multi-device round.** When the backend exposes at least
+``n_shards`` devices, the per-shard ticks do not solve one by one: each
+tick's packed snapshot registers at a round barrier
+(``TickOptions.solve_fn``) and the LAST shard to arrive stacks every
+shard's buffers on a leading axis and runs ONE ``shard_map`` solve
+(parallel/sharded.py, promoted here from dry-run to the live tick path);
+every shard then unpacks its own block. Shards whose padded dims drift
+apart solve locally for that round while the common dims are re-seeded
+into every shard's dims memo, so the next round stacks again — shape
+hysteresis, not a hard requirement. Any barrier failure (timeout, a
+shard degrading before its solve, a device error) falls back to local
+per-shard solves; correctness never depends on the stacked path
+(tools/bench_sharded.py --parity pins stacked ≡ local ≡ single-plane
+oracle).
+
+**Cross-shard rebalancing.** After each round the driver compares the
+shards' overload ladders (utils/overload.py — every shard store has its
+own LoadMonitor): a shard at YELLOW-or-worse with a GREEN sibling
+migrates whole distros over a **fenced handoff**:
+
+  1. *release* — the source shard, in ONE fenced WAL group, writes a
+     handoff record (``shard_handoffs``: distro group, target, seq,
+     ``state="released"``, and the full document payload) and deletes
+     the group's distro/task/host/queue docs. The group commit is
+     all-or-nothing: a crash before the commit leaves no trace, and a
+     superseded lease epoch sheds it entirely (PR-3 fencing).
+  2. *prime* — the target shard upserts the payload docs plus its own
+     ``state="primed"`` copy of the record, in one fenced group of its
+     own. The target's TickCache/resident plane absorb the new distro
+     through the ordinary listener → delta path (a topology change
+     re-primes delta-shaped, scheduler/resident.py).
+  3. *done* — the source marks its record ``state="done"``.
+
+A crash at ANY point converges to exactly-one-owner on restart:
+``reconcile_handoffs`` re-primes a released-but-unprimed target from the
+durable payload and completes the done-mark — the same
+release/record/re-prime machinery the PR-3 failover reconciliation uses,
+exercised by SIGKILL points in tools/crash_matrix.py
+(``handoff.release`` / ``handoff.record`` / ``handoff.prime``).
+
+**One fleet.** Dispatch stays global: an agent's next-task pull routes
+to the shard that owns its host's distro (``assign_next_task``), so
+shard-local queues serve a single fleet of hosts and agents.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from ..models.host import Host
+from ..parallel.topology import ShardTopology
+from ..storage.store import Store
+from ..utils import metrics as _metrics
+from ..utils import overload as overload_mod
+from ..utils.log import get_logger
+from .wrapper import TickOptions, TickResult, run_tick
+
+#: durable handoff records, one collection per shard store
+HANDOFFS_COLLECTION = "shard_handoffs"
+
+#: collections a distro's documents live in (the handoff payload set)
+_DISTRO_SCOPED = ("distros", "tasks", "hosts", "task_queues",
+                  "task_secondary_queues")
+
+SHARD_TICK_MS = _metrics.histogram(
+    "scheduler_shard_tick_duration_ms",
+    "Wall time of one shard's tick inside a sharded round, labeled by "
+    "shard id (bounded by the configured shard count).",
+    labels=("shard",),
+)
+SHARD_ROUNDS = _metrics.counter(
+    "scheduler_sharded_rounds_total",
+    "Sharded tick rounds by solve mode: 'stacked' (one multi-device "
+    "shard_map solve for every shard), 'local' (per-shard solves), or "
+    "'mixed' (a mid-round fallback).",
+    labels=("outcome",),
+)
+SHARD_HANDOFFS = _metrics.counter(
+    "scheduler_shard_handoffs_total",
+    "Distro handoff protocol steps by SOURCE shard and step outcome "
+    "(released / primed / done / reconciled / aborted).",
+    labels=("shard", "outcome"),
+)
+SHARD_REBALANCES = _metrics.counter(
+    "scheduler_shard_rebalance_total",
+    "Ladder-driven rebalancing migrations initiated, labeled by the "
+    "overloaded source shard.",
+    labels=("shard",),
+)
+
+
+# --------------------------------------------------------------------------- #
+# stacked round barrier
+# --------------------------------------------------------------------------- #
+
+
+class _StackedRound:
+    """One tick round's solve barrier. Every participating shard's
+    run_tick calls ``solve_for(shard_id)`` → the returned callable blocks
+    until either every still-participating shard has registered its
+    packed snapshot (the last arrival stacks + runs ONE shard_map solve
+    and wakes everyone with their block), or the round falls back to
+    local solves (shape drift, a shard leaving before its solve, a
+    timeout, or a device error)."""
+
+    def __init__(self, plane: "ShardedScheduler", shard_ids: Sequence[int],
+                 timeout_s: float = 30.0) -> None:
+        self.plane = plane
+        self.timeout_s = timeout_s
+        self._cv = threading.Condition()
+        self._participants = set(shard_ids)
+        self._snaps: Dict[int, object] = {}
+        self._outs: Optional[Dict[int, dict]] = None
+        self._local = False  # fall back to per-shard solves
+        self._leading = False  # a leader is solving OUTSIDE the lock
+        self.mode = "stacked"
+        #: how each shard's solve actually ran (a round can be MIXED:
+        #: the leader stacks the registered participants while a shard
+        #: that timed out or arrived after a downgrade solves locally)
+        self.stacked_shards: set = set()
+        self.local_shards: set = set()
+
+    def final_mode(self) -> str:
+        if self.stacked_shards and self.local_shards:
+            return "mixed"
+        if self.stacked_shards:
+            return "stacked"
+        return "local"
+
+    def leave(self, shard_id: int) -> None:
+        """A shard finished its tick without reaching the solve (no
+        solver distros, degraded early, serial path): it will never
+        register, so waiting for it would deadlock the round."""
+        with self._cv:
+            self._participants.discard(shard_id)
+            self._maybe_ready_locked()
+            self._cv.notify_all()
+
+    def _maybe_ready_locked(self) -> bool:
+        waiting = self._participants & self._snaps.keys()
+        return bool(waiting) and waiting == self._participants
+
+    def _go_local_locked(self, why: str) -> None:
+        if not self._local:
+            self._local = True
+            self.mode = "local"
+            get_logger("scheduler").info(
+                "sharded-round-local", reason=why,
+            )
+
+    def _try_lead_locked(self) -> Optional[Dict[int, object]]:
+        """Under the lock: claim leadership if every still-participating
+        shard has registered, nobody is leading, and the round has not
+        already produced outputs (a waiter waking AFTER the leader
+        published must consume, not re-solve); returns the snapshot set
+        to solve, or None."""
+        if (
+            self._local
+            or self._leading
+            or self._outs is not None
+            or not self._maybe_ready_locked()
+        ):
+            return None
+        self._leading = True
+        return {k: self._snaps[k] for k in self._participants}
+
+    def solve_for(self, shard_id: int):
+        def _solve(snapshot):
+            from ..ops.solve import run_solve_packed
+
+            to_solve = None
+            with self._cv:
+                if self._local:
+                    # already downgraded: fall through to the local
+                    # solve OUTSIDE the lock — stragglers must solve in
+                    # parallel, not serialized under the barrier lock
+                    self.local_shards.add(shard_id)
+                else:
+                    self._snaps[shard_id] = snapshot
+                    to_solve = self._try_lead_locked()
+                    if to_solve is None and not self._leading:
+                        deadline = _time.monotonic() + self.timeout_s
+                        while self._outs is None and not self._local:
+                            remaining = deadline - _time.monotonic()
+                            if remaining <= 0:
+                                # the round must never outwait a shard's
+                                # own solve deadline: go local
+                                self._go_local_locked("barrier-timeout")
+                                self._cv.notify_all()
+                                break
+                            self._cv.wait(timeout=min(remaining, 0.5))
+                            # participants may have shrunk while we
+                            # waited and we are now the last: lead
+                            to_solve = self._try_lead_locked()
+                            if to_solve is not None:
+                                break
+                    if to_solve is None:
+                        if (
+                            self._outs is not None
+                            and shard_id in self._outs
+                        ):
+                            self.stacked_shards.add(shard_id)
+                            return self._outs[shard_id]
+                        self.local_shards.add(shard_id)
+                        # fall through to the local solve outside the lock
+
+            if to_solve is not None:
+                # LEADER: the one stacked shard_map solve runs OUTSIDE
+                # the barrier lock — a wedged device must never deadlock
+                # the siblings' leave()/wait paths (they time out and go
+                # local; run_tick's own solve deadline abandons us)
+                outs = None
+                try:
+                    outs = self.plane._stacked_solve(to_solve)
+                except Exception as exc:  # noqa: BLE001 — any stack/
+                    # shape/device failure downgrades the whole round
+                    with self._cv:
+                        self._go_local_locked(repr(exc)[-200:])
+                        self._leading = False
+                        self._cv.notify_all()
+                else:
+                    with self._cv:
+                        self._outs = outs
+                        self._leading = False
+                        self._cv.notify_all()
+                if outs is not None and shard_id in outs:
+                    self.stacked_shards.add(shard_id)
+                    return outs[shard_id]
+                self.local_shards.add(shard_id)
+            # local fallback (outside the lock: the solve is the slow part)
+            return run_solve_packed(snapshot)
+
+        return _solve
+
+
+# --------------------------------------------------------------------------- #
+# results
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class ShardedTickResult:
+    """One fleet round: every shard's TickResult plus round metadata."""
+
+    results: Dict[int, TickResult]
+    #: "stacked" | "local" | "mixed" — how the round's solves ran
+    solve_mode: str = "local"
+    #: handoff records initiated by this round's rebalancing pass
+    migrations: List[dict] = dataclasses.field(default_factory=list)
+    total_ms: float = 0.0
+    fleet_level: str = "green"
+
+    @property
+    def n_tasks(self) -> int:
+        return sum(r.n_tasks for r in self.results.values())
+
+    @property
+    def n_distros(self) -> int:
+        return sum(r.n_distros for r in self.results.values())
+
+    @property
+    def degraded(self) -> Dict[int, str]:
+        return {
+            k: r.degraded for k, r in self.results.items() if r.degraded
+        }
+
+
+# --------------------------------------------------------------------------- #
+# the driver
+# --------------------------------------------------------------------------- #
+
+
+class ShardedScheduler:
+    """Drives N scheduler shards over one fleet. Each shard is a Store
+    (plain, or a DurableStore bound to its own lease + WAL segment) whose
+    ``shard_id`` attribute names it; the driver owns the tick round, the
+    stacked solve, ownership routing, and rebalancing — everything else
+    (gather, solve, persist, fencing, budgets) is the unchanged per-store
+    machinery."""
+
+    def __init__(
+        self,
+        stores: Sequence[Store],
+        topology: Optional[ShardTopology] = None,
+        tick_opts: Optional[TickOptions] = None,
+        stacked: str = "auto",
+        rebalance_enabled: bool = True,
+        max_handoffs_per_round: int = 1,
+        barrier_timeout_s: float = 30.0,
+    ) -> None:
+        if not stores:
+            raise ValueError("need at least one shard store")
+        self.stores: List[Store] = list(stores)
+        for k, s in enumerate(self.stores):
+            if getattr(s, "shard_id", None) is None:
+                s.shard_id = k
+        self.n_shards = len(self.stores)
+        self.topology = topology or ShardTopology(self.n_shards)
+        self.tick_opts = tick_opts or TickOptions(use_cache=True)
+        #: "auto" (stack when devices allow), "never", "always"
+        self.stacked = stacked
+        self.rebalance_enabled = rebalance_enabled
+        self.max_handoffs_per_round = max_handoffs_per_round
+        self.barrier_timeout_s = barrier_timeout_s
+        # one worker PER shard, always: a stacked round's solve barrier
+        # needs every shard's tick in flight at once — a pool smaller
+        # than the shard count would starve the barrier into its
+        # timeout (real parallelism is still bounded by cores; idle
+        # waiters release the GIL)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.n_shards),
+            thread_name_prefix="shard-tick",
+        )
+        self._lock = threading.Lock()  # serializes rounds + migrations
+        self._dispatchers: Dict[int, object] = {}
+        #: host id → owning shard (invalidated on migration)
+        self._host_shard: Dict[str, int] = {}
+        self._stacked_fn = None
+        self._stacked_fn_n = 0
+        #: the stacked round's common padded dims (a FLOOR forced into
+        #: every shard's build via TickOptions.force_dims); updated on
+        #: observed drift so the round after a growth spurt stacks again
+        self._common_dims: Optional[Dict[str, int]] = None
+        #: rounds since the floor was (re)measured — forced dims can
+        #: never shrink on their own (every build pads UP to the floor),
+        #: so the floor is periodically dropped for one natural-dims
+        #: probe round, letting a transient spike's padding re-converge
+        #: downward instead of inflating every solve forever
+        self._floor_rounds = 0
+        #: monotone handoff sequence (recovered from durable records)
+        self._seq = 0
+        #: the cron/front store whose ladder receives the fleet fuse as
+        #: a floor (attach_sharded_plane sets it)
+        self.front_store: Optional[Store] = None
+        self._warned_stacked_short = False
+        self._load_handoff_state()
+        self.refresh_affinity()
+
+    def refresh_affinity(self) -> None:
+        """Rebuild the alias-affinity placement map from the documents
+        the shard stores actually hold — a reopened plane must derive
+        the same placement keys seed_partition used, or owner_of() would
+        hash a coupled distro's own id and diverge from where its
+        documents live. Called at construction and before migrations
+        (tasks can gain secondary_distros at any time)."""
+        aff: Dict[str, str] = {}
+        for s in self.stores:
+            aff.update(ShardTopology.affinity_from_store(s))
+        self.topology.affinity = aff
+
+    #: stacked rounds between downward floor re-probes
+    _FLOOR_REPROBE_ROUNDS = 32
+
+    # -- construction helpers ------------------------------------------- #
+
+    @classmethod
+    def build(
+        cls,
+        n_shards: int,
+        data_dir: Optional[str] = None,
+        sync: str = "flush",
+        lease_ttl_s: float = 10.0,
+        **kw,
+    ) -> "ShardedScheduler":
+        """N plain in-memory shard stores, or — with ``data_dir`` — N
+        DurableStores sharing one directory, each journaling to its own
+        WAL segment under its own lease."""
+        stores: List[Store] = []
+        if data_dir is None:
+            for k in range(n_shards):
+                s = Store()
+                s.shard_id = k
+                stores.append(s)
+        else:
+            from ..storage.durable import DurableStore
+            from ..storage.lease import FileLease, shard_lease_path
+
+            try:
+                for k in range(n_shards):
+                    lease = FileLease(
+                        shard_lease_path(data_dir, k), ttl_s=lease_ttl_s
+                    )
+                    if not lease.acquire(timeout_s=30.0, poll_s=0.1):
+                        raise TimeoutError(
+                            f"could not acquire shard {k}'s lease"
+                        )
+                    stores.append(
+                        DurableStore(
+                            data_dir, sync=sync, lease=lease, shard_id=k
+                        )
+                    )
+            except BaseException:
+                # a partial fleet must not leak: release the leases and
+                # close the journals already acquired, or every later
+                # opener waits out TTL steals on orphaned leases
+                for s in stores:
+                    try:
+                        s._journal.close()
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                    try:
+                        s._lease.release()
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                raise
+        return cls(stores, **kw)
+
+    def seed_partition(self, source: Store) -> Dict[int, int]:
+        """Split a seeded single-plane store across the shards by
+        topology (parity harnesses; a real deployment migrates instead).
+        Refreshes alias affinity from the source documents first so
+        coupled distros co-locate. Returns shard → distro count."""
+        self.topology.affinity.update(
+            ShardTopology.affinity_from_store(source)
+        )
+        counts = {k: 0 for k in range(self.n_shards)}
+        for coll_name in _DISTRO_SCOPED:
+            for doc in source.collection(coll_name).find():
+                did = (
+                    doc["_id"] if coll_name in
+                    ("distros", "task_queues", "task_secondary_queues")
+                    else doc.get("distro_id", "")
+                )
+                shard = self.owner_of(did)
+                self.stores[shard].collection(coll_name).upsert(
+                    dict(doc)
+                )
+                if coll_name == "distros":
+                    counts[shard] += 1
+        return counts
+
+    # -- ownership routing ---------------------------------------------- #
+
+    def owner_of(self, distro_id: str) -> int:
+        """The routing owner: hash + overrides, self-healed against the
+        documents' ACTUAL location — affinity learned after placement
+        (a task gaining secondary distros) can move a distro's hash
+        without moving its documents, and routing must follow reality.
+        A located divergence is pinned as an override so the scan runs
+        once per distro."""
+        shard = self.topology.shard_for(distro_id)
+        if (
+            self.stores[shard].collection("distros").get(distro_id)
+            is not None
+        ):
+            return shard
+        for k, s in enumerate(self.stores):
+            if (
+                k != shard
+                and s.collection("distros").get(distro_id) is not None
+            ):
+                self.topology.overrides[distro_id] = k
+                return k
+        return shard  # unplaced (seeding) — the hash owner
+
+    def store_of(self, distro_id: str) -> Store:
+        return self.stores[self.owner_of(distro_id)]
+
+    def host_shard(self, host: Host) -> int:
+        shard = self._host_shard.get(host.id)
+        if shard is None:
+            shard = self.owner_of(host.distro_id)
+            self._host_shard[host.id] = shard
+        return shard
+
+    def find_host(self, host_id: str) -> Optional[Host]:
+        """Global agent pull, step 1: locate the host document wherever
+        its distro's shard lives (cached; a cache miss scans shards)."""
+        from ..models import host as host_mod
+
+        shard = self._host_shard.get(host_id)
+        order = (
+            [shard] + [k for k in range(self.n_shards) if k != shard]
+            if shard is not None else range(self.n_shards)
+        )
+        for k in order:
+            doc = host_mod.coll(self.stores[k]).get(host_id)
+            if doc is not None:
+                self._host_shard[host_id] = k
+                return Host.from_doc(doc)
+        return None
+
+    def assign_next_task(self, host: Host, now: Optional[float] = None):
+        """Global agent pull over shard-local queues: route the host to
+        the shard owning its distro and run the classic CAS-pair
+        assignment there (dispatch/assign.py)."""
+        from ..dispatch.assign import assign_next_available_task
+        from ..dispatch.dag_dispatcher import DispatcherService
+
+        shard = self.host_shard(host)
+        svc = self._dispatchers.get(shard)
+        if svc is None:
+            svc = self._dispatchers.setdefault(
+                shard, DispatcherService(self.stores[shard])
+            )
+        return assign_next_available_task(
+            self.stores[shard], svc, host, now=now
+        )
+
+    # -- the tick round -------------------------------------------------- #
+
+    def _use_stacked(self) -> bool:
+        if self.stacked == "never" or self.n_shards < 2:
+            return False
+        try:
+            import jax
+
+            n_dev = len(jax.devices())
+        except Exception:  # noqa: BLE001 — no backend, no stacking
+            return False
+        if n_dev >= self.n_shards:
+            return True
+        if self.stacked == "always" and not self._warned_stacked_short:
+            # forcing a mesh wider than the device count would just fail
+            # per round (barrier formed, make_mesh raises, round goes
+            # local) — strictly worse than honest local mode; warn ONCE
+            # and solve per-shard
+            self._warned_stacked_short = True
+            get_logger("scheduler").warning(
+                "stacked-solve-underprovisioned",
+                n_shards=self.n_shards,
+                n_devices=n_dev,
+                fallback="local per-shard solves",
+            )
+        return False
+
+    def tick(
+        self,
+        now: Optional[float] = None,
+        opts: Optional[TickOptions] = None,
+    ) -> ShardedTickResult:
+        """One fleet round: every shard's tick runs concurrently on the
+        worker pool (stacked solve when the devices allow it), then the
+        rebalancing pass migrates distros off overloaded shards.
+        ``opts`` overrides the plane's default TickOptions for THIS
+        round (the cron plane passes the service-mode options — solve
+        deadline, tick budget, async persist, allocator flag — per
+        round, exactly like the single-store path)."""
+        now = _time.time() if now is None else now
+        t0 = _time.perf_counter()
+        base_opts = opts or self.tick_opts
+        # the barrier must give up well before any shard's OWN solve
+        # deadline: a straggler would otherwise degrade every healthy
+        # sibling to the serial oracle (and charge their breakers) while
+        # they sit at the barrier
+        barrier_s = self.barrier_timeout_s
+        if base_opts.solve_deadline_s > 0:
+            barrier_s = min(barrier_s, base_opts.solve_deadline_s * 0.5)
+        with self._lock:
+            round_ = (
+                _StackedRound(
+                    self, range(self.n_shards), timeout_s=barrier_s,
+                )
+                if self._use_stacked() else None
+            )
+            if round_ is not None and self._common_dims is not None:
+                self._floor_rounds += 1
+                if self._floor_rounds >= self._FLOOR_REPROBE_ROUNDS:
+                    # downward re-convergence probe: build at natural
+                    # dims this round; the leader re-measures the floor
+                    self._common_dims = None
+                    self._floor_rounds = 0
+
+            def one(k: int) -> TickResult:
+                opts = base_opts
+                if round_ is not None:
+                    # the stacked path packs fresh per round at the
+                    # plane's common dims floor (not the per-store
+                    # resident slabs, whose layouts are shard-local)
+                    opts = dataclasses.replace(
+                        opts, use_resident=False,
+                        solve_fn=round_.solve_for(k),
+                        force_dims=self._common_dims,
+                    )
+                t1 = _time.perf_counter()
+                try:
+                    res = run_tick(self.stores[k], opts, now=now)
+                finally:
+                    if round_ is not None:
+                        round_.leave(k)
+                SHARD_TICK_MS.observe(
+                    (_time.perf_counter() - t1) * 1e3, shard=k
+                )
+                return res
+
+            futures = [
+                self._pool.submit(one, k) for k in range(self.n_shards)
+            ]
+            results = {k: f.result() for k, f in enumerate(futures)}
+            mode = round_.final_mode() if round_ is not None else "local"
+            SHARD_ROUNDS.inc(outcome=mode)
+
+            migrations: List[dict] = []
+            if self.rebalance_enabled:
+                migrations = self._rebalance_locked(results, now)
+
+        fleet = self.fleet_level()
+        if self.front_store is not None:
+            # wire the fuse into the fleet-wide seams: the front store's
+            # ladder (REST 429s, cron deferral, outbox policy all consult
+            # it) gets the fuse as a FLOOR, so correlated shard overload
+            # browns the shared surfaces out — and releases them the
+            # round the fleet calms
+            overload_mod.monitor_for(self.front_store).set_floor(fleet)
+        out = ShardedTickResult(
+            results=results,
+            solve_mode=mode,
+            migrations=migrations,
+            total_ms=(_time.perf_counter() - t0) * 1e3,
+            fleet_level=overload_mod.level_name(fleet),
+        )
+        return out
+
+    # -- stacked solve ---------------------------------------------------- #
+
+    def _stacked_solve(
+        self, snaps: Dict[int, object]
+    ) -> Dict[int, dict]:
+        """Stack every shard's packed arrays on a leading axis, run ONE
+        shard_map solve, and hand each shard its block. Raises on shape
+        drift — the caller downgrades the round to local solves and
+        re-seeds the common dims so the next round stacks."""
+        import jax
+        import numpy as np
+
+        from ..parallel.sharded import _IN_KEYS, sharded_solve_fn
+        from ..parallel.mesh import make_mesh
+
+        order = sorted(snaps)
+        keys = {k: snaps[k].shape_key() for k in order}
+        if len(set(keys.values())) > 1:
+            # record the max bucket per axis as the new common-dims
+            # floor (TickOptions.force_dims on the next round) and
+            # downgrade THIS round to local solves
+            names = ("N", "M", "U", "G", "H", "D")
+            self._common_dims = {
+                name: max(keys[k][i] for k in order)
+                for i, name in enumerate(names)
+            }
+            self._floor_rounds = 0
+            raise ValueError(
+                f"shard dims drifted: {sorted(set(keys.values()))}"
+            )
+        if self._common_dims is None:
+            names = ("N", "M", "U", "G", "H", "D")
+            self._common_dims = {
+                name: keys[order[0]][i] for i, name in enumerate(names)
+            }
+            self._floor_rounds = 0
+        if self._stacked_fn is None or self._stacked_fn_n != len(order):
+            self._stacked_fn = sharded_solve_fn(
+                make_mesh(len(order))
+            )
+            self._stacked_fn_n = len(order)
+        stacked = {
+            name: np.stack(
+                [np.asarray(snaps[k].arrays[name]) for k in order]
+            )
+            for name in _IN_KEYS
+        }
+        out = self._stacked_fn(stacked)
+        jax.block_until_ready(out)
+        return {
+            k: {name: np.asarray(v[i]) for name, v in out.items()}
+            for i, k in enumerate(order)
+        }
+
+    # -- fleet overload --------------------------------------------------- #
+
+    def shard_levels(self) -> Dict[int, int]:
+        return {
+            k: overload_mod.monitor_for(s).level()
+            for k, s in enumerate(self.stores)
+        }
+
+    def fleet_level(self) -> int:
+        """The fleet-level fuse over the per-shard ladders
+        (utils/overload.py fuse_level): one hot shard is rebalancing's
+        job; correlated overload trips the whole fleet."""
+        return overload_mod.fuse_level(list(self.shard_levels().values()))
+
+    # -- rebalancing ------------------------------------------------------ #
+
+    def _rebalance_locked(
+        self, results: Dict[int, TickResult], now: float
+    ) -> List[dict]:
+        # one affinity refresh per rebalancing PASS (not per handoff):
+        # the group-membership scan is O(total tasks) and only needs to
+        # be current once per round
+        self.refresh_affinity()
+        levels = self.shard_levels()
+        hot = sorted(
+            (k for k, lvl in levels.items()
+             if lvl >= overload_mod.YELLOW),
+            key=lambda k: -levels[k],
+        )
+        cold = sorted(
+            (k for k, lvl in levels.items()
+             if lvl == overload_mod.GREEN),
+            key=lambda k: results[k].n_tasks if k in results else 0,
+        )
+        migrations: List[dict] = []
+        for src in hot:
+            if len(migrations) >= self.max_handoffs_per_round:
+                break
+            if not cold:
+                break
+            # consume the target: a round with several handoffs must
+            # SPREAD them, not pile every hot shard's load onto the one
+            # coldest sibling
+            dst = cold.pop(0)
+            did = self._pick_migration_distro(src)
+            if did is None:
+                continue
+            SHARD_REBALANCES.inc(shard=src)
+            try:
+                rec = self.migrate(
+                    did, dst, now=now, _locked=True,
+                    _affinity_fresh=True,
+                )
+            except Exception as exc:  # noqa: BLE001 — an aborted handoff
+                # converges either way: a failed release never committed
+                # (source still owns everything), and a failed prime/done
+                # leg already self-healed via reconcile_handoffs inside
+                # migrate(); log and carry on
+                SHARD_HANDOFFS.inc(shard=src, outcome="aborted")
+                get_logger("resilience").error(
+                    "handoff-aborted", distro=did, src=src, dst=dst,
+                    error=repr(exc)[-300:],
+                )
+                continue
+            migrations.append(rec)
+        return migrations
+
+    def _pick_migration_distro(self, shard: int) -> Optional[str]:
+        """The busiest whole distro on the shard — quickest relief per
+        handoff (whole affinity groups move together, so pick by group
+        aggregate). Busy-ness counts SCHEDULABLE tasks only: finished
+        docs linger in the collection, and migrating a mostly-complete
+        distro moves payload, not load."""
+        from ..globals import TaskStatus
+
+        store = self.stores[shard]
+        by_group: Dict[str, int] = {}
+        rep_of: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        for doc in store.collection("tasks").find(
+            lambda d: d.get("status") == TaskStatus.UNDISPATCHED.value
+            and d.get("activated")
+        ):
+            did = doc.get("distro_id", "")
+            if did:
+                counts[did] = counts.get(did, 0) + 1
+        for doc in store.collection("distros").find():
+            did = doc["_id"]
+            rep = self.topology.placement_key(did)
+            by_group[rep] = by_group.get(rep, 0) + counts.get(did, 0)
+            rep_of.setdefault(rep, did)
+        if not by_group:
+            return None
+        rep = max(by_group, key=lambda r: by_group[r])
+        return rep_of[rep]
+
+    # -- fenced handoff ---------------------------------------------------- #
+
+    def _affinity_group(self, shard: int, distro_id: str) -> List[str]:
+        rep = self.topology.placement_key(distro_id)
+        return [
+            doc["_id"]
+            for doc in self.stores[shard].collection("distros").find()
+            if self.topology.placement_key(doc["_id"]) == rep
+        ]
+
+    def migrate(
+        self,
+        distro_id: str,
+        target: int,
+        now: Optional[float] = None,
+        _locked: bool = False,
+        _affinity_fresh: bool = False,
+    ) -> dict:
+        """Move ``distro_id``'s whole affinity group from its owning
+        shard to ``target`` via the fenced handoff protocol (module
+        docstring). Must not run concurrently with a tick round — callers
+        outside the round hold the plane lock."""
+        if not _locked:
+            with self._lock:
+                return self.migrate(
+                    distro_id, target, now=now, _locked=True,
+                    _affinity_fresh=_affinity_fresh,
+                )
+        from ..utils import faults
+
+        now = _time.time() if now is None else now
+        if not _affinity_fresh:
+            # placement coupling can have changed since the docs landed
+            # (tasks gaining secondary distros): the GROUP must reflect
+            # the live documents or a coupled sibling would be left
+            # behind (the rebalancing pass refreshes once per round)
+            self.refresh_affinity()
+        src = self.owner_of(distro_id)
+        if src == target:
+            raise ValueError(f"{distro_id} already on shard {target}")
+        if not (0 <= target < self.n_shards):
+            raise ValueError(f"no such shard {target}")
+        group = self._affinity_group(src, distro_id)
+        if not group:
+            raise KeyError(
+                f"distro {distro_id!r} not found on shard {src}"
+            )
+        src_store, tgt_store = self.stores[src], self.stores[target]
+        self._seq += 1
+        hid = f"ho-{distro_id}-{self._seq:06d}"
+        group_set = set(group)
+        payload: Dict[str, List[dict]] = {}
+        for coll_name in _DISTRO_SCOPED:
+            docs = src_store.collection(coll_name).find(
+                lambda d, cn=coll_name: (
+                    d["_id"] in group_set
+                    if cn in ("distros", "task_queues",
+                              "task_secondary_queues")
+                    else d.get("distro_id", "") in group_set
+                )
+            )
+            payload[coll_name] = [dict(d) for d in docs]
+        rec = {
+            "_id": hid,
+            "distro": distro_id,
+            "group": sorted(group),
+            "from": src,
+            "to": target,
+            "seq": self._seq,
+            "state": "released",
+            "at": now,
+            "payload": payload,
+        }
+
+        # 1. release: record + deletions in ONE fenced WAL group
+        from ..storage.lease import EpochFencedError
+
+        try:
+            src_store.begin_tick()
+            try:
+                src_store.collection(HANDOFFS_COLLECTION).upsert(rec)
+                for coll_name, docs in payload.items():
+                    coll = src_store.collection(coll_name)
+                    for d in docs:
+                        coll.remove(d["_id"])
+                # crash seam INSIDE the release group: a kill here loses
+                # the whole (uncommitted) group — no durable record, no
+                # deletions, the source still owns everything
+                faults.fire("handoff.release")
+            finally:
+                src_store.end_tick()
+        except EpochFencedError:
+            # the group was SHED with the deposed holder: its durable
+            # state still owns the group and a successor replays it —
+            # healing here would mint a second owner
+            raise
+        except Exception:
+            # the in-memory release already applied (collections mutate
+            # before the journal), whether or not the frame reached the
+            # WAL: checkpoint the in-memory truth so the durable state
+            # matches, then converge ownership from the released record
+            # — otherwise the group is deleted-but-never-primed until a
+            # restart
+            try:
+                src_store.heal_durability()
+                self.reconcile_handoffs(now=now)
+            except Exception as heal_exc:  # noqa: BLE001
+                get_logger("resilience").error(
+                    "handoff-heal-failed",
+                    handoff=hid,
+                    error=repr(heal_exc)[-300:],
+                )
+            raise
+        SHARD_HANDOFFS.inc(shard=src, outcome="released")
+        try:
+            # crash seam BETWEEN release and prime: the durable record
+            # says released; reconcile_handoffs re-primes the target
+            faults.fire("handoff.record")
+
+            self._prime_target(rec, tgt_store)
+            SHARD_HANDOFFS.inc(shard=src, outcome="primed")
+            # crash seam BETWEEN prime and the done-mark: both records
+            # exist; reconciliation completes the done-mark idempotently
+            faults.fire("handoff.prime")
+
+            src_store.collection(HANDOFFS_COLLECTION).update(
+                hid, {"state": "done"}
+            )
+        except Exception:
+            # the release COMMITTED but the prime/done leg failed: the
+            # group would otherwise be ownerless (deleted from the
+            # source, never primed) until a restart's reconciliation.
+            # Heal in-process, best-effort — a target whose store is
+            # genuinely broken keeps the durable released record, and
+            # startup reconciliation remains the backstop.
+            try:
+                self.reconcile_handoffs(now=now)
+            except Exception as heal_exc:  # noqa: BLE001
+                get_logger("resilience").error(
+                    "handoff-heal-failed",
+                    handoff=hid,
+                    error=repr(heal_exc)[-300:],
+                )
+            raise
+        SHARD_HANDOFFS.inc(shard=src, outcome="done")
+        self._apply_ownership(rec)
+        get_logger("scheduler").info(
+            "distro-handoff", handoff=hid, distros=rec["group"],
+            src=src, dst=target,
+            n_tasks=len(payload.get("tasks", ())),
+        )
+        return {k: v for k, v in rec.items() if k != "payload"}
+
+    def _prime_target(self, rec: dict, tgt_store: Store) -> None:
+        """Step 2: target absorbs the payload + its own 'primed' record
+        in one fenced group (idempotent — reconciliation re-runs it)."""
+        tgt_store.begin_tick()
+        try:
+            for coll_name, docs in rec["payload"].items():
+                coll = tgt_store.collection(coll_name)
+                for d in docs:
+                    coll.upsert(dict(d))
+            tgt_store.collection(HANDOFFS_COLLECTION).upsert(
+                {
+                    **{k: v for k, v in rec.items() if k != "payload"},
+                    "state": "primed",
+                }
+            )
+        finally:
+            tgt_store.end_tick()
+
+    def _apply_ownership(self, rec: dict) -> None:
+        for did in rec["group"]:
+            self.topology.overrides[did] = rec["to"]
+        # host routing for the moved distros changes shard
+        self._host_shard = {
+            hid: k for hid, k in self._host_shard.items()
+            if k != rec["from"]
+        }
+        self._dispatchers.pop(rec["from"], None)
+        self._dispatchers.pop(rec["to"], None)
+
+    # -- recovery --------------------------------------------------------- #
+
+    def _load_handoff_state(self) -> None:
+        """Rebuild ownership overrides + the seq counter from the durable
+        handoff records (any state ≥ released means the target owns the
+        group — reconciliation below guarantees the prime completes)."""
+        latest: Dict[str, tuple] = {}
+        for store in self.stores:
+            for doc in store.collection(HANDOFFS_COLLECTION).find():
+                self._seq = max(self._seq, int(doc.get("seq", 0)))
+                for did in doc.get("group", [doc.get("distro", "")]):
+                    cur = latest.get(did)
+                    if cur is None or doc["seq"] > cur[0]:
+                        latest[did] = (doc["seq"], int(doc["to"]))
+        for did, (_seq, to) in latest.items():
+            if 0 <= to < self.n_shards:
+                self.topology.overrides[did] = to
+
+    def reconcile_handoffs(self, now: Optional[float] = None) -> List[str]:
+        """Converge every mid-flight handoff to exactly-one-owner (run at
+        startup, after per-shard WAL replay + recovery passes): a
+        released-but-unprimed record re-primes the target from the
+        durable payload; a primed-but-not-done record completes the
+        done-mark. Returns the reconciled handoff ids."""
+        healed: List[str] = []
+        for src_id, store in enumerate(self.stores):
+            for doc in store.collection(HANDOFFS_COLLECTION).find(
+                lambda d: d.get("state") == "released"
+            ):
+                to = int(doc["to"])
+                if not (0 <= to < self.n_shards):
+                    continue
+                tgt_store = self.stores[to]
+                primed = tgt_store.collection(HANDOFFS_COLLECTION).get(
+                    doc["_id"]
+                )
+                if primed is None:
+                    self._prime_target(doc, tgt_store)
+                store.collection(HANDOFFS_COLLECTION).update(
+                    doc["_id"], {"state": "done"}
+                )
+                SHARD_HANDOFFS.inc(shard=src_id, outcome="reconciled")
+                self._apply_ownership(doc)
+                healed.append(doc["_id"])
+        if healed:
+            get_logger("resilience").info(
+                "handoffs-reconciled", healed=healed
+            )
+        return healed
+
+    def close(self) -> None:
+        """Shut the worker pool AND the durability resources the plane
+        owns: each durable shard store is closed (final group commit +
+        checkpoint) and its lease released, so a reopened fleet never
+        waits out stale lease TTLs."""
+        self._pool.shutdown(wait=False)
+        for s in self.stores:
+            if getattr(s, "data_dir", None) is not None:
+                try:
+                    s.close()
+                except Exception:  # noqa: BLE001 — best-effort shutdown
+                    pass
+            lease = getattr(s, "_lease", None)
+            if lease is not None:
+                try:
+                    lease.release()
+                except Exception:  # noqa: BLE001 — best-effort shutdown
+                    pass
+
+
+# --------------------------------------------------------------------------- #
+# fleet-wide views + invariants (parity / crash harnesses)
+# --------------------------------------------------------------------------- #
+
+
+def fleet_owner_violations(stores: Sequence[Store]) -> List[str]:
+    """Exactly-one-owner audit: no distro-scoped document may exist in
+    more than one shard store (the handoff protocol's core invariant)."""
+    problems: List[str] = []
+    for coll_name in _DISTRO_SCOPED:
+        seen: Dict[str, int] = {}
+        for k, store in enumerate(stores):
+            for doc in store.collection(coll_name).find():
+                prev = seen.get(doc["_id"])
+                if prev is not None:
+                    problems.append(
+                        f"{coll_name}/{doc['_id']} owned by shards "
+                        f"{prev} and {k}"
+                    )
+                seen[doc["_id"]] = k
+    return problems
+
+
+def merge_fleet_state(stores: Sequence[Store]) -> Store:
+    """Union of every shard store into one plain Store — the merged
+    replay surface (collapse a sharded deployment back to one plane, or
+    compare a sharded run against the single-scheduler oracle). Handoff
+    records are kept under per-shard synthetic ids so both halves of a
+    protocol run stay inspectable. Raises if the shards violate
+    exactly-one-owner."""
+    problems = fleet_owner_violations(stores)
+    if problems:
+        raise ValueError(
+            "cannot merge a fleet violating exactly-one-owner: "
+            + "; ".join(problems[:5])
+        )
+    merged = Store()
+    for k, store in enumerate(stores):
+        for coll_name, coll in sorted(
+            store._collections.items()  # noqa: SLF001 — same package
+        ):
+            out = merged.collection(coll_name)
+            for doc in coll.find():
+                d = dict(doc)
+                if coll_name == HANDOFFS_COLLECTION:
+                    d["_id"] = f"shard{k}:{d['_id']}"
+                elif out.get(d["_id"]) is not None:
+                    # shared-scope docs (events, config, jobs) can
+                    # legitimately repeat across shards; keep both
+                    d["_id"] = f"shard{k}:{d['_id']}"
+                out.upsert(d)
+    return merged
+
+
+def open_fleet(
+    data_dir: str, n_shards: int, **kw
+) -> "ShardedScheduler":
+    """Open (or recover) a durable sharded plane: per-shard segment
+    replay happens inside each DurableStore's recovery, then the
+    cross-shard handoff reconciliation converges mid-flight migrations —
+    the merged-replay story for a whole fleet in one directory."""
+    plane = ShardedScheduler.build(n_shards, data_dir=data_dir, **kw)
+    plane.reconcile_handoffs()
+    return plane
+
+
+# -- per-store plane attachment (units/crons.py) ----------------------------- #
+
+
+def attach_sharded_plane(store: Store, plane: ShardedScheduler) -> None:
+    """Register ``plane`` as the scheduler for the cron plane driven off
+    ``store`` (units/crons.py scheduler_tick_jobs runs plane.tick()
+    instead of the single-store run_tick when one is attached). The
+    front store's overload ladder receives the fleet fuse as a floor
+    each round, so the shared surfaces (REST, crons, outbox) brown out
+    with the fleet."""
+    store._sharded_plane = plane
+    plane.front_store = store
+
+
+def peek_sharded_plane(store: Store) -> Optional[ShardedScheduler]:
+    return getattr(store, "_sharded_plane", None)

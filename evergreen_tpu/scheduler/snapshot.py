@@ -735,13 +735,25 @@ def build_snapshot(
     n_g = len(seg_names)
 
     # ---- padded arena allocation ------------------------------------------ #
+    counts = {
+        "N": max(n_t, 1), "M": max(n_m, 1), "U": max(n_u, 1),
+        "G": max(n_g, 1), "H": max(n_h, 1), "D": max(n_d, 1),
+    }
     if force_dims is not None:
-        dims = dict(force_dims)
-    else:
-        counts = {
-            "N": max(n_t, 1), "M": max(n_m, 1), "U": max(n_u, 1),
-            "G": max(n_g, 1), "H": max(n_h, 1), "D": max(n_d, 1),
+        # forced dims are a FLOOR, maxed with the natural buckets: the
+        # sharded paths force every shard to COMMON dims (the max across
+        # shards, so the floor is exact there), and a shard that has
+        # since grown past the floor pads up instead of overflowing —
+        # the stacked round detects the resulting dims drift and
+        # re-converges (scheduler/sharded_plane.py)
+        dims = {
+            k: max(
+                int(force_dims.get(k, 0)),
+                _bucket(c, minimum=8 if k == "D" else 32),
+            )
+            for k, c in counts.items()
         }
+    else:
         dims = {
             k: _bucket(c, minimum=8 if k == "D" else 32)
             for k, c in counts.items()

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import time as _time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -102,6 +102,20 @@ class TickOptions:
     #: tick's barrier as degraded="persist-failed". False = the commit
     #: (and any error) lands before run_tick returns.
     async_persist: bool = False
+    #: sharded control plane (scheduler/sharded_plane.py): run THIS
+    #: callable instead of run_solve_packed — the stacked multi-device
+    #: round hands every shard's tick the same barrier object so all
+    #: shards' packed buffers solve as ONE shard_map call. The callable
+    #: receives the packed Snapshot and returns the solve output dict;
+    #: any failure degrades exactly like a failing device solve
+    #: (serial-oracle fallback, breaker-counted). None = the classic
+    #: single-device run_solve_packed.
+    solve_fn: Optional[Callable] = None
+    #: minimum padded dims for the snapshot build (a FLOOR, maxed with
+    #: the natural buckets): the sharded plane forces every shard to the
+    #: round's common dims so the packed buffers stack into one
+    #: shard_map solve. None = natural bucketing with hysteresis.
+    force_dims: Optional[Dict[str, int]] = None
 
 
 #: per-store TickCache singletons. Intentionally strong references: a
@@ -476,13 +490,17 @@ def _apply_release_mode(store: Store, distros):
     return out
 
 
-def _solve_bounded(store: Store, snapshot, deadline_s: float):
+def _solve_bounded(
+    store: Store, snapshot, deadline_s: float, solve_fn=None
+):
     """The packed solve under a wall deadline. With a deadline the solve
     runs on a daemon worker and a hang past the budget raises
     TimeoutError — the wedged call is abandoned (a dead tunnel/sidecar
     would otherwise block run_tick forever, well past the 15s cadence).
     Without one it runs inline. The solve seam fires inside the bounded
-    region so injected hangs are caught like real ones."""
+    region so injected hangs are caught like real ones. ``solve_fn``
+    (TickOptions.solve_fn — the sharded plane's stacked-round barrier)
+    replaces the classic single-device call when given."""
     import threading
 
     from ..ops.solve import run_solve_packed
@@ -492,7 +510,7 @@ def _solve_bounded(store: Store, snapshot, deadline_s: float):
     def work():
         faults.fire("scheduler.solve")
         with maybe_xla_profile(store):
-            return run_solve_packed(snapshot)
+            return (solve_fn or run_solve_packed)(snapshot)
 
     if deadline_s <= 0:
         return work()
@@ -533,8 +551,15 @@ def run_tick(
 
     opts = opts or TickOptions()
     now = _time.time() if now is None else now
+    # shard identity rides on every tick span (sharded control plane):
+    # a per-shard trace is greppable by shard id, and the parity/crash
+    # harnesses can attribute a span tree to the shard that produced it
+    _span_attrs = {"planner": opts.planner_version}
+    _shard = getattr(store, "shard_id", None)
+    if _shard is not None:
+        _span_attrs["shard"] = _shard
     with _tracing.Tracer(store, "scheduler").span(
-        "tick", planner=opts.planner_version
+        "tick", **_span_attrs
     ) as _tick_span:
         result = _run_tick_guarded(store, opts, now, _tick_span)
         result.trace_id = _tick_span.get("trace_root", "")
@@ -822,7 +847,10 @@ def _run_tick_body(
                     snapshot = build_snapshot(
                         solver_distros, tasks_by_distro, hosts_by_distro,
                         running_estimates, deps_met, now,
-                        dims_memo=dims_memo,
+                        force_dims=opts.force_dims,
+                        dims_memo=(
+                            dims_memo if opts.force_dims is None else None
+                        ),
                         memb_memo=memb_memo, arena_pool=arena_pool,
                     )
             t2 = _time.perf_counter()
@@ -832,7 +860,10 @@ def _run_tick_body(
             # fences with jax.block_until_ready, so the device time lands
             # in THIS span instead of leaking into the first consumer.
             with _tracer.span("solve", deadline_s=opts.solve_deadline_s):
-                out = _solve_bounded(store, snapshot, opts.solve_deadline_s)
+                out = _solve_bounded(
+                    store, snapshot, opts.solve_deadline_s,
+                    solve_fn=opts.solve_fn,
+                )
             t3 = _time.perf_counter()
             snapshot_ms = (t2 - t1) * 1e3
             solve_ms = (t3 - t2) * 1e3

@@ -151,6 +151,42 @@ class SchedulerConfig(ConfigSection):
 
 @register_section
 @dataclasses.dataclass
+class ShardingConfig(ConfigSection):
+    """Sharded control plane knobs (scheduler/sharded_plane.py +
+    parallel/topology.py). ``n_shards`` > 1 turns the 15s tick into a
+    fleet round over N scheduler shards — each with its own lease, WAL
+    segment and resident plane — partitioned by consistent hash. See
+    docs/DEPLOY.md "Shard count sizing"."""
+
+    section_id = "sharding"
+
+    #: 1 = the classic single-scheduler plane
+    n_shards: int = 1
+    #: stacked multi-device solve: "auto" (stack when the backend has
+    #: >= n_shards devices), "never", "always"
+    stacked_solve: str = "auto"
+    #: ladder-driven distro migration off YELLOW shards
+    rebalance_enabled: bool = True
+    #: whole-distro handoffs a single round may initiate (migrations are
+    #: cheap but re-prime the target's caches — trickle, don't slosh)
+    max_handoffs_per_round: int = 1
+    #: stacked-round barrier timeout before per-shard local solves
+    barrier_timeout_s: float = 30.0
+
+    def validate_and_default(self) -> str:
+        if self.n_shards < 1:
+            return "n_shards must be >= 1"
+        if self.stacked_solve not in ("auto", "never", "always"):
+            return "stacked_solve must be auto/never/always"
+        if self.max_handoffs_per_round < 0:
+            return "max_handoffs_per_round cannot be negative"
+        if self.barrier_timeout_s <= 0:
+            return "barrier_timeout_s must be > 0"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
 class TaskLimitsConfig(ConfigSection):
     """reference config_task_limits.go."""
 

@@ -79,6 +79,34 @@ from ..utils import metrics as _metrics
 SNAPSHOT_FILE = "snapshot.json"
 WAL_FILE = "wal.log"
 
+
+def fleet_segment_ids(data_dir: str) -> list:
+    """Shard ids with a WAL segment or snapshot present in ``data_dir``
+    (sorted; ``None`` for the unsharded classic files). The sharded
+    control plane names per-shard segments ``wal.shard<k>.log`` /
+    ``snapshot.shard<k>.json`` (parallel/topology.py) so one directory
+    holds the whole fleet's durability and a merged replay
+    (scheduler/sharded_plane.py ``merge_fleet_state``) can reconstruct
+    the single-plane view."""
+    import re as _re
+
+    ids = set()
+    try:
+        names = os.listdir(data_dir)
+    except OSError:
+        return []
+    pat = _re.compile(
+        r"^(?:wal\.shard(\d+)\.log|snapshot\.shard(\d+)\.json)$"
+    )
+    for name in names:
+        if name in (WAL_FILE, SNAPSHOT_FILE):
+            ids.add(None)
+            continue
+        m = pat.match(name)
+        if m:
+            ids.add(int(m.group(1) or m.group(2)))
+    return sorted(ids, key=lambda k: (k is not None, k))
+
 WAL_STALE_FRAMES_DROPPED = _metrics.counter(
     "wal_stale_frames_dropped_total",
     "Superseded-epoch WAL frames dropped at replay (a deposed holder's "
@@ -253,10 +281,24 @@ class DurableStore(Store):
         sync: str = "flush",
         compact_every_ops: int = 500_000,
         lease: Optional[FileLease] = None,
+        shard_id: Optional[int] = None,
     ) -> None:
         super().__init__()
         os.makedirs(data_dir, exist_ok=True)
         self.data_dir = data_dir
+        #: scheduler-shard identity (sharded control plane): shard k
+        #: journals to its OWN fenced WAL segment + snapshot inside the
+        #: shared data dir, under its own lease — each shard is an
+        #: independent durability domain, merge-replayable into a fleet
+        #: view. None = the classic unsharded file names.
+        self.shard_id = shard_id
+        from ..parallel.topology import (
+            snapshot_segment_name,
+            wal_segment_name,
+        )
+
+        self._wal_name = wal_segment_name(shard_id)
+        self._snapshot_name = snapshot_segment_name(shard_id)
         self.compact_every_ops = compact_every_ops
         self._compact_lock = threading.Lock()
         #: split-brain fence: bound to the holder's lease epoch at open.
@@ -268,7 +310,9 @@ class DurableStore(Store):
         self.replay_report: Dict[str, int] = {
             "frames": 0, "stale_frames_dropped": 0, "wal_max_epoch": 0,
         }
-        self._journal = _Journal(os.path.join(data_dir, WAL_FILE), sync=sync)
+        self._journal = _Journal(
+            os.path.join(data_dir, self._wal_name), sync=sync
+        )
         #: background group-commit flusher (started lazily on the first
         #: async commit); pending frames + deferred errors
         self._flush_lock = threading.Lock()
@@ -561,7 +605,7 @@ class DurableStore(Store):
     # -- recovery / compaction ----------------------------------------------- #
 
     def _recover(self) -> None:
-        snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+        snap_path = os.path.join(self.data_dir, self._snapshot_name)
         self._journal.suspended = True
         max_epoch = 0
         try:
@@ -653,7 +697,7 @@ class DurableStore(Store):
             return
         acquired: Dict[str, Collection] = {}
         try:
-            snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
+            snap_path = os.path.join(self.data_dir, self._snapshot_name)
             tmp_path = snap_path + ".tmp"
             # Quiesce: grab every collection's lock (never while holding the
             # store lock — a writer inside mutate() may create a collection).

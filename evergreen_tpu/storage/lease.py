@@ -61,6 +61,16 @@ class EpochFencedError(RuntimeError):
     guard the lease docstring used to merely request."""
 
 
+def shard_lease_path(data_dir: str, shard_id: Optional[int]) -> str:
+    """Lease-file path for one scheduler shard (sharded control plane):
+    every shard holds its OWN lease — distinct path, independent epoch
+    sequence — so shard k's failover/fencing story is exactly the
+    single-writer story, replicated N times over one data dir."""
+    from ..parallel.topology import shard_lease_name
+
+    return os.path.join(data_dir, shard_lease_name(shard_id))
+
+
 class FileLease:
     #: bounded verify-after-rename attempts in the steal path
     _STEAL_ATTEMPTS = 5

@@ -82,6 +82,57 @@ def scheduler_tick_jobs(store: Store, now: float) -> List[Job]:
     if flags.scheduler_disabled and flags.host_allocator_disabled:
         return []
 
+    # sharded control plane: when a ShardedScheduler is attached to this
+    # (front) store, the 15s tick is ONE fleet round — per-shard ticks on
+    # the plane's worker pool + the rebalancing pass — instead of a
+    # single-store run_tick. Scope-locked the same way: rounds never
+    # overlap. Every shard's tick runs under the SAME service-mode
+    # options as the classic path (solve deadline, tick budget, async
+    # persist, the allocator kill-switch), and the runtime-tunable
+    # ShardingConfig knobs are re-read per populate so admin edits to
+    # rebalancing/stacking reach a live plane.
+    from ..scheduler.sharded_plane import peek_sharded_plane
+    from ..settings import ShardingConfig
+
+    plane = peek_sharded_plane(store)
+    sharding = ShardingConfig.get(store)
+    if plane is None and sharding.n_shards > 1:
+        # configured but not wired: the service bootstrap does not yet
+        # build a sharded plane (see ROADMAP / docs/DEPLOY.md) — say so
+        # loudly instead of silently running the single plane
+        from ..utils.log import get_logger
+
+        get_logger("scheduler").warning(
+            "sharding-configured-but-not-attached",
+            n_shards=sharding.n_shards,
+            hint="build a ShardedScheduler and attach_sharded_plane()",
+        )
+    if plane is not None:
+        plane.stacked = sharding.stacked_solve
+        plane.rebalance_enabled = sharding.rebalance_enabled
+        plane.max_handoffs_per_round = sharding.max_handoffs_per_round
+        plane.barrier_timeout_s = sharding.barrier_timeout_s
+        round_opts = TickOptions(
+            create_intent_hosts=not flags.host_allocator_disabled,
+            use_cache=True,
+            solve_deadline_s=10.0,
+            tick_budget_s=12.0,
+            async_persist=True,
+        )
+
+        def run_round(s: Store) -> None:
+            plane.tick(now=_time.time(), opts=round_opts)
+
+        return [
+            FnJob(
+                f"scheduler-tick-{now:.3f}",
+                run_round,
+                scopes=["scheduler-tick"],
+                job_type="scheduler-tick",
+                priority=PRIORITY_PLANNING,
+            )
+        ]
+
     def run(s: Store) -> None:
         opts = TickOptions(
             create_intent_hosts=not flags.host_allocator_disabled,

@@ -152,6 +152,7 @@ def bench_result_payload(
     probe_history: list,
     overload_counters: dict = None,
     resident: dict = None,
+    sharded_plane: dict = None,
 ) -> dict:
     """The BENCH JSON line. ``pipelined_tick_ms`` appears ONLY when the
     measured timeline proves the overlap (VERDICT r5 ask #3) — an
@@ -196,6 +197,13 @@ def bench_result_payload(
             out[key] = churn[key]
     if resident:
         out["resident"] = resident
+    if sharded_plane:
+        # the sharded-control-plane arm (tools/bench_sharded_plane.py):
+        # sharded_churn_tick_ms + aggregate-throughput ratio vs the
+        # single-shard plane at equal total load
+        out["sharded_plane"] = sharded_plane
+        if "value" in sharded_plane:
+            out["sharded_churn_tick_ms"] = sharded_plane["value"]
     if overlap_proven:
         out["pipelined_tick_ms"] = round(pipe_med, 2)
     return out

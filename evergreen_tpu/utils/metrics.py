@@ -61,6 +61,7 @@ ALLOWED_LABELS = frozenset(
         "phase",       # tick pipeline phase
         "signal",      # overload monitor gauge name
         "outcome",     # success/failure-ish result buckets
+        "shard",       # scheduler shard id (bounded by the shard count)
     }
 )
 

@@ -98,8 +98,40 @@ SHEDS = _metrics.counter(
 )
 
 
+FLEET_LEVEL = _metrics.gauge(
+    "overload_fleet_level",
+    "Fleet-level overload fuse over the per-shard ladders (sharded "
+    "control plane): 0=green 1=yellow 2=red 3=black.",
+)
+
+
 def level_name(level: int) -> str:
     return LEVEL_NAMES.get(level, str(level))
+
+
+def fuse_level(levels: List[int]) -> int:
+    """The fleet-level fuse over per-shard ladder levels (sharded
+    control plane, scheduler/sharded_plane.py). One hot shard is
+    REBALANCING's job — the driver migrates distros off it while the
+    fleet's shared surfaces keep serving, so a lone outlier lifts the
+    fuse at most to YELLOW. Two or more shards at the same hot level is
+    the correlated-storm shape (shared store, API flood, disk stall):
+    the fuse trips to that level and every fleet-wide seam browns out
+    together, exactly like the single-plane ladder."""
+    if not levels:
+        level = GREEN
+    else:
+        hi = max(levels)
+        if hi <= YELLOW or len(levels) == 1:
+            level = hi
+        elif sum(1 for lvl in levels if lvl >= hi) >= 2:
+            level = hi
+        else:
+            # a single shard above YELLOW: cap the FLEET at YELLOW (or
+            # at the second-hottest shard's level, whichever is worse)
+            level = max(YELLOW, sorted(levels)[-2])
+    FLEET_LEVEL.set(float(level))
+    return level
 
 
 #: aggregate shed records (one doc per (kind, key), bounded by the number
@@ -141,6 +173,12 @@ class LoadMonitor:
         self._outbox: Dict[str, List[int]] = {}
         #: collection -> {coalesce_key: doc_id} for undelivered rows
         self._coalesce: Dict[str, Dict[str, str]] = {}
+        #: externally-imposed level floor (the sharded plane pushes the
+        #: fleet fuse here each round): every consumer of ``level()``
+        #: sees max(own ladder, floor), so correlated shard overload
+        #: browns out the shared surfaces without this store's own
+        #: signals having moved
+        self._floor_level = GREEN
 
     # -- config --------------------------------------------------------- #
 
@@ -429,9 +467,17 @@ class LoadMonitor:
 
     # -- consumption ------------------------------------------------------ #
 
+    def set_floor(self, level: int) -> None:
+        """Impose an external level floor (sharded control plane: the
+        fleet fuse, refreshed every round — GREEN clears it). The floor
+        shapes what consumers SEE, never the hysteresis state the
+        monitor's own signals drive."""
+        with self._lock:
+            self._floor_level = max(GREEN, min(BLACK, int(level)))
+
     def level(self) -> int:
         with self._lock:
-            return self._level
+            return max(self._level, self._floor_level)
 
     def level_label(self) -> str:
         return level_name(self.level())

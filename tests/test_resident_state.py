@@ -403,3 +403,138 @@ def test_faulted_solve_does_not_strand_pool_slots(store):
         faults.uninstall()
     pool = _snapshot_memos_for(store)[2]
     assert pool.forced_rotations == 0
+
+
+# --------------------------------------------------------------------------- #
+# topology changes (sharded control plane handoffs): delta-shaped re-prime
+# --------------------------------------------------------------------------- #
+
+
+def _topology_problem(seed=31):
+    return generate_problem(
+        6, 300, seed=seed, task_group_fraction=0.3, dep_fraction=0.3,
+        hosts_per_distro=3,
+    )
+
+
+def test_distro_added_reprimes_delta_shaped(store):
+    """A distro migrating IN (shard handoff / enablement) must splice
+    into the resident layout — membership build only for the new distro,
+    surviving slabs copied — not trigger a counted full rebuild; and the
+    spliced plane must canonicalize identically to a cold build."""
+    distros, tbd, hbd, _, _ = _topology_problem()
+    for d in distros[:5]:
+        distro_mod.insert(store, d)
+    task_mod.insert_many(
+        store, [t for d in distros[:5] for t in tbd[d.id]]
+    )
+    for d in distros[:5]:
+        host_mod.insert_many(store, hbd[d.id])
+    run_tick(store, OPTS, now=NOW)
+    run_tick(store, OPTS, now=NOW + 1)  # absorb the stamp storm
+    plane = peek_resident_plane(store)
+    rebuilds_before = plane.rebuilds
+
+    d5 = distros[5]
+    distro_mod.insert(store, d5)
+    task_mod.insert_many(store, tbd[d5.id])
+    host_mod.insert_many(store, hbd[d5.id])
+    res = run_tick(store, OPTS, now=NOW + 15.0)
+    assert not res.degraded
+    assert plane.topology_splices == 1
+    assert plane.rebuilds == rebuilds_before, plane.rebuild_reasons
+    assert d5.id in plane.distro_ids
+
+    from evergreen_tpu.scheduler.wrapper import tick_cache_for
+
+    cache = tick_cache_for(store)
+    distros_g, tbd_g, hbd_g, est_g, dm_g = cache.gather(NOW + 30.0)
+    snap = plane.sync(cache, distros_g, tbd_g, hbd_g, est_g, dm_g,
+                      NOW + 30.0)
+    cold = build_snapshot(distros_g, tbd_g, hbd_g, est_g, dm_g,
+                          NOW + 30.0)
+    assert canonicalize(snap) == canonicalize(cold)
+    if snap.arena is not None:
+        snap.arena.close()
+
+
+def test_distro_removed_reprimes_delta_shaped(store):
+    """A distro migrating OUT (handoff release deletes its documents)
+    splices the survivors — no counted full rebuild — and parity holds,
+    including later churn on the surviving slabs."""
+    distros, tbd, hbd, _, _ = _topology_problem(seed=33)
+    for d in distros:
+        distro_mod.insert(store, d)
+    all_tasks = [t for ts in tbd.values() for t in ts]
+    task_mod.insert_many(store, all_tasks)
+    for hs in hbd.values():
+        host_mod.insert_many(store, hs)
+    run_tick(store, OPTS, now=NOW)
+    run_tick(store, OPTS, now=NOW + 1)
+    plane = peek_resident_plane(store)
+    rebuilds_before = plane.rebuilds
+
+    gone = distros[0].id
+    for t in tbd[gone]:
+        task_mod.coll(store).remove(t.id)
+    for h in hbd[gone]:
+        host_mod.coll(store).remove(h.id)
+    distro_mod.coll(store).remove(gone)
+    res = run_tick(store, OPTS, now=NOW + 15.0)
+    assert not res.degraded
+    assert plane.topology_splices == 1
+    assert plane.rebuilds == rebuilds_before, plane.rebuild_reasons
+    assert gone not in plane.distro_ids
+
+    # churn a surviving distro: the spliced slabs must keep absorbing
+    # deltas (unit maps, rows, holes all survived the splice)
+    survivor_tasks = [t for t in all_tasks if t.distro_id != gone]
+    task_mod.coll(store).update(
+        survivor_tasks[0].id, {"status": TaskStatus.SUCCEEDED.value}
+    )
+    res = run_tick(store, OPTS, now=NOW + 30.0)
+    assert not res.degraded
+    assert plane.rebuilds == rebuilds_before
+
+    from evergreen_tpu.scheduler.wrapper import tick_cache_for
+
+    cache = tick_cache_for(store)
+    gathered = cache.gather(NOW + 45.0)
+    snap = plane.sync(cache, *gathered, NOW + 45.0)
+    cold = build_snapshot(*gathered, NOW + 45.0)
+    assert canonicalize(snap) == canonicalize(cold)
+    if snap.arena is not None:
+        snap.arena.close()
+
+
+def test_distro_set_change_with_same_gap_churn_full_rebuilds(store):
+    """Eligibility guard: a surviving distro that ALSO churned inside
+    the same gap (its task-list identity changed) makes the splice
+    unsound — the plane must take the counted full rebuild instead, and
+    parity must still hold."""
+    distros, tbd, hbd, _, _ = _topology_problem(seed=35)
+    for d in distros[:5]:
+        distro_mod.insert(store, d)
+    task_mod.insert_many(
+        store, [t for d in distros[:5] for t in tbd[d.id]]
+    )
+    for d in distros[:5]:
+        host_mod.insert_many(store, hbd[d.id])
+    run_tick(store, OPTS, now=NOW)
+    run_tick(store, OPTS, now=NOW + 1)
+    plane = peek_resident_plane(store)
+    rebuilds_before = plane.rebuilds
+
+    # add a distro AND churn a survivor in the same gap
+    d5 = distros[5]
+    distro_mod.insert(store, d5)
+    task_mod.insert_many(store, tbd[d5.id])
+    surviving = tbd[distros[0].id][0]
+    task_mod.coll(store).update(
+        surviving.id, {"status": TaskStatus.SUCCEEDED.value}
+    )
+    res = run_tick(store, OPTS, now=NOW + 15.0)
+    assert not res.degraded
+    assert plane.topology_splices == 0
+    assert plane.rebuilds == rebuilds_before + 1
+    assert plane.rebuild_reasons.get("distro-set", 0) >= 1

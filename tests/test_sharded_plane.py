@@ -1,0 +1,516 @@
+"""Sharded control plane: topology stability, fenced handoffs,
+fleet-level overload fuse, and sharded ≡ single-scheduler parity
+(scheduler/sharded_plane.py + parallel/topology.py)."""
+import dataclasses
+
+import pytest
+
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task_queue import doc_column
+from evergreen_tpu.parallel.topology import (
+    ShardTopology,
+    shard_lease_name,
+    snapshot_segment_name,
+    wal_segment_name,
+)
+from evergreen_tpu.scheduler.sharded_plane import (
+    HANDOFFS_COLLECTION,
+    ShardedScheduler,
+    fleet_owner_violations,
+    merge_fleet_state,
+)
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+from evergreen_tpu.storage.store import Store
+from evergreen_tpu.utils import overload
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+OPTS = TickOptions(create_intent_hosts=False, use_cache=True,
+                   underwater_unschedule=False)
+
+
+def _seed(store: Store, problem) -> None:
+    distros, tbd, hbd, _, _ = problem
+    for d in distros:
+        distro_mod.insert(store, d)
+    task_mod.insert_many(store, [t for ts in tbd.values() for t in ts])
+    for hs in hbd.values():
+        host_mod.insert_many(store, hs)
+
+
+def _canon_queues(store: Store) -> dict:
+    out = {}
+    for coll in ("task_queues", "task_secondary_queues"):
+        for d in store.collection(coll).find():
+            out[(coll, d["_id"])] = (
+                doc_column(d, "id"),
+                [round(float(v), 6) for v in d.get("sort_value", [])],
+            )
+    return out
+
+
+def _plane(n, problem, **kw) -> ShardedScheduler:
+    src = Store()
+    _seed(src, problem)
+    plane = ShardedScheduler.build(
+        n, tick_opts=OPTS, rebalance_enabled=False,
+        stacked=kw.pop("stacked", "never"), **kw,
+    )
+    plane.seed_partition(src)
+    return plane
+
+
+# --------------------------------------------------------------------------- #
+# topology
+# --------------------------------------------------------------------------- #
+
+
+def test_rendezvous_moves_about_one_over_n_on_grow():
+    ids = [f"d{i:04d}" for i in range(400)]
+    t4, t5 = ShardTopology(4), ShardTopology(5)
+    moved = sum(1 for i in ids if t4.shard_for(i) != t5.shard_for(i))
+    # expectation is 1/5 = 80; allow generous hash noise either way —
+    # the failure mode being pinned is "most keys move" (modulo hashing
+    # would move ~4/5 = 320)
+    assert 40 <= moved <= 140, moved
+
+
+def test_rendezvous_shrink_moves_only_the_removed_shards_keys():
+    ids = [f"d{i:04d}" for i in range(300)]
+    t4, t3 = ShardTopology(4), ShardTopology(3)
+    for i in ids:
+        if t4.shard_for(i) != 3:
+            # rendezvous: dropping shard 3 cannot change the argmax of
+            # the surviving candidates — EXACTLY its keys move
+            assert t3.shard_for(i) == t4.shard_for(i)
+
+
+def test_rendezvous_spreads_keys():
+    t = ShardTopology(4)
+    counts = {k: len(v) for k, v in
+              t.assignments(f"d{i:04d}" for i in range(400)).items()}
+    assert set(counts) == {0, 1, 2, 3}
+    assert all(50 <= c <= 150 for c in counts.values()), counts
+
+
+def test_affinity_groups_colocate_and_override_wins():
+    aff = ShardTopology.affinity_from_pairs(
+        [["a", "b"], ["b", "c"], ["x", "y"]]
+    )
+    t = ShardTopology(8, affinity=aff)
+    assert t.shard_for("a") == t.shard_for("b") == t.shard_for("c")
+    assert t.shard_for("x") == t.shard_for("y")
+    t.overrides["a"] = 7
+    assert t.shard_for("a") == 7
+    assert t.hash_shard_for("a") == t.shard_for("b")
+
+
+def test_segment_and_lease_naming():
+    assert wal_segment_name(None) == "wal.log"
+    assert wal_segment_name(2) == "wal.shard2.log"
+    assert snapshot_segment_name(2) == "snapshot.shard2.json"
+    assert shard_lease_name(0) == "writer.shard0.lease"
+
+
+# --------------------------------------------------------------------------- #
+# fleet fuse
+# --------------------------------------------------------------------------- #
+
+
+def test_fuse_level_single_hot_shard_caps_at_yellow():
+    G, Y, R, B = (overload.GREEN, overload.YELLOW, overload.RED,
+                  overload.BLACK)
+    assert overload.fuse_level([]) == G
+    assert overload.fuse_level([G, G, G, G]) == G
+    assert overload.fuse_level([Y, G, G, G]) == Y
+    # one RED/BLACK shard is rebalancing's job, not a fleet brownout
+    assert overload.fuse_level([R, G, G, G]) == Y
+    assert overload.fuse_level([B, G, G, G]) == Y
+    # correlated overload trips the fleet
+    assert overload.fuse_level([R, R, G, G]) == R
+    assert overload.fuse_level([B, B, G, G]) == B
+    # a single-shard plane IS the classic ladder
+    assert overload.fuse_level([R]) == R
+    # one BLACK + one YELLOW: second-hottest floor applies
+    assert overload.fuse_level([B, Y, G, G]) == Y
+
+
+# --------------------------------------------------------------------------- #
+# plane parity + ticks
+# --------------------------------------------------------------------------- #
+
+
+def test_two_shard_plane_matches_oracle():
+    problem = generate_problem(
+        6, 240, seed=21, task_group_fraction=0.3, hosts_per_distro=2
+    )
+    oracle = Store()
+    _seed(oracle, problem)
+    run_tick(oracle, OPTS, now=NOW)
+    plane = _plane(2, problem)
+    try:
+        r = plane.tick(now=NOW)
+        assert not r.degraded
+        assert r.n_distros == 6
+        assert fleet_owner_violations(plane.stores) == []
+        merged = merge_fleet_state(plane.stores)
+        assert _canon_queues(merged) == _canon_queues(oracle)
+    finally:
+        plane.close()
+
+
+def test_stacked_round_one_shard_map_solve():
+    problem = generate_problem(
+        6, 240, seed=22, task_group_fraction=0.3, hosts_per_distro=2
+    )
+    oracle = Store()
+    _seed(oracle, problem)
+    for i in range(2):
+        run_tick(oracle, OPTS, now=NOW + 15.0 * i)
+    plane = _plane(2, problem, stacked="always")
+    try:
+        r1 = plane.tick(now=NOW)
+        r2 = plane.tick(now=NOW + 15.0)
+        # round 1 discovers the common dims (local), round 2 stacks
+        assert r2.solve_mode == "stacked", (r1.solve_mode, r2.solve_mode)
+        merged = merge_fleet_state(plane.stores)
+        assert _canon_queues(merged) == _canon_queues(oracle)
+    finally:
+        plane.close()
+
+
+def test_alias_tasks_colocate_across_shards():
+    problem = generate_problem(6, 240, seed=23, hosts_per_distro=2)
+    distros, tbd, _, _, _ = problem
+    ts = tbd[distros[0].id]
+    ts[0] = dataclasses.replace(
+        ts[0], secondary_distros=[distros[1].id]
+    )
+    plane = _plane(4, problem)
+    try:
+        assert (
+            plane.owner_of(distros[0].id) == plane.owner_of(distros[1].id)
+        )
+        r = plane.tick(now=NOW)
+        assert not r.degraded
+        # the alias queue landed on the co-located shard
+        shard = plane.owner_of(distros[1].id)
+        sec = plane.stores[shard].collection(
+            "task_secondary_queues"
+        ).get(distros[1].id)
+        assert sec is not None and ts[0].id in doc_column(sec, "id")
+    finally:
+        plane.close()
+
+
+# --------------------------------------------------------------------------- #
+# fenced handoff + global agent pull
+# --------------------------------------------------------------------------- #
+
+
+def _free_hosts(problem):
+    for hs in problem[2].values():
+        for h in hs:
+            h.running_task = ""
+            h.running_task_group = ""
+            h.running_task_build_variant = ""
+            h.running_task_version = ""
+            h.running_task_project = ""
+
+
+def test_handoff_moves_whole_distro_exactly_once():
+    problem = generate_problem(6, 240, seed=24, hosts_per_distro=2)
+    _free_hosts(problem)
+    plane = _plane(2, problem)
+    try:
+        plane.tick(now=NOW)
+        did = next(
+            d["_id"]
+            for d in plane.stores[0].collection("distros").find()
+        )
+        n_tasks = plane.stores[0].collection("tasks").count(
+            lambda t: t["distro_id"] == did
+        )
+        rec = plane.migrate(did, 1, now=NOW + 1)
+        assert rec["state"] == "done" and did in rec["group"]
+        assert plane.owner_of(did) == 1
+        assert fleet_owner_violations(plane.stores) == []
+        assert plane.stores[1].collection("tasks").count(
+            lambda t: t["distro_id"] == did
+        ) == n_tasks
+        src_rec = plane.stores[0].collection(HANDOFFS_COLLECTION).get(
+            rec["_id"]
+        )
+        assert src_rec["state"] == "done"
+        # the moved distro plans on its new shard next round
+        r = plane.tick(now=NOW + 15.0)
+        assert not r.degraded
+        q = plane.stores[1].collection("task_queues").get(did)
+        assert q is not None and len(q["rows"]) > 0
+        # global agent pull routes the moved distro's hosts to shard 1
+        hdoc = next(
+            h for h in plane.stores[1].collection("hosts").find(
+                lambda h: h.get("distro_id") == did
+            )
+        )
+        from evergreen_tpu.dispatch.assign import (
+            assign_next_available_task_fleet,
+        )
+
+        t = assign_next_available_task_fleet(
+            plane, hdoc["_id"], now=NOW + 16.0
+        )
+        assert t is not None and t.distro_id == did
+        # a fresh driver over the same stores re-derives the override
+        plane2 = ShardedScheduler(
+            plane.stores, tick_opts=OPTS, rebalance_enabled=False,
+            stacked="never",
+        )
+        try:
+            assert plane2.owner_of(did) == 1
+        finally:
+            plane2.close()
+    finally:
+        plane.close()
+
+
+def test_failed_prime_self_heals_in_process():
+    """A handoff whose release COMMITTED but whose prime leg failed must
+    not strand the group ownerless until a restart: migrate() re-raises
+    the failure but reconciles first, so the target owns the group the
+    moment the exception surfaces."""
+    from evergreen_tpu.utils import faults
+
+    problem = generate_problem(4, 160, seed=25, hosts_per_distro=2)
+    plane = _plane(2, problem)
+    try:
+        did = next(
+            d["_id"]
+            for d in plane.stores[0].collection("distros").find()
+        )
+        # fail between the source's release commit and the target prime
+        plan = faults.FaultPlan()
+        plan.at("handoff.record", 0, faults.Fault("raise"))
+        faults.install(plan)
+        try:
+            with pytest.raises(Exception):
+                plane.migrate(did, 1, now=NOW)
+        finally:
+            faults.uninstall()
+        # the in-process heal already converged to exactly-one-owner
+        assert plane.stores[1].collection("distros").get(did) is not None
+        assert plane.owner_of(did) == 1
+        assert fleet_owner_violations(plane.stores) == []
+        recs = plane.stores[0].collection(HANDOFFS_COLLECTION).find()
+        assert len(recs) == 1 and recs[0]["state"] == "done"
+    finally:
+        plane.close()
+
+
+def test_reconcile_completes_released_but_unprimed_handoff():
+    """The startup path: a crash left a durable released-but-unprimed
+    record (hand-crafted here exactly as the SIGKILL matrix produces
+    it); reconcile_handoffs re-primes the target from the payload and
+    completes the done-mark, idempotently."""
+    problem = generate_problem(4, 160, seed=25, hosts_per_distro=2)
+    plane = _plane(2, problem)
+    try:
+        did = next(
+            d["_id"]
+            for d in plane.stores[0].collection("distros").find()
+        )
+        # craft the mid-flight state: record + deletions on the source,
+        # nothing on the target (what a kill after the release commit
+        # and before the prime leaves behind)
+        src = plane.stores[0]
+        payload = {
+            coll: [
+                dict(d) for d in src.collection(coll).find(
+                    lambda d, c=coll: (
+                        d["_id"] == did
+                        if c in ("distros", "task_queues",
+                                 "task_secondary_queues")
+                        else d.get("distro_id", "") == did
+                    )
+                )
+            ]
+            for coll in ("distros", "tasks", "hosts", "task_queues",
+                         "task_secondary_queues")
+        }
+        rec = {
+            "_id": f"ho-{did}-000042", "distro": did, "group": [did],
+            "from": 0, "to": 1, "seq": 42, "state": "released",
+            "at": NOW, "payload": payload,
+        }
+        src.collection(HANDOFFS_COLLECTION).upsert(rec)
+        for coll, docs in payload.items():
+            for d in docs:
+                src.collection(coll).remove(d["_id"])
+        assert plane.stores[1].collection("distros").get(did) is None
+
+        healed = plane.reconcile_handoffs(now=NOW + 1)
+        assert healed == [rec["_id"]]
+        assert plane.stores[1].collection("distros").get(did) is not None
+        assert plane.owner_of(did) == 1
+        assert fleet_owner_violations(plane.stores) == []
+        assert src.collection(HANDOFFS_COLLECTION).get(rec["_id"])[
+            "state"
+        ] == "done"
+        # idempotent: a second pass heals nothing
+        assert plane.reconcile_handoffs(now=NOW + 2) == []
+    finally:
+        plane.close()
+
+
+def test_rebalance_migrates_off_yellow_shard():
+    problem = generate_problem(6, 240, seed=26, hosts_per_distro=2)
+    src = Store()
+    _seed(src, problem)
+    plane = ShardedScheduler.build(
+        2, tick_opts=OPTS, rebalance_enabled=True, stacked="never"
+    )
+    try:
+        plane.seed_partition(src)
+        plane.tick(now=NOW)
+        # force shard 0 hot, shard 1 calm
+        m0 = overload.monitor_for(plane.stores[0])
+        m0._level = overload.YELLOW
+        overload.monitor_for(plane.stores[1])._level = overload.GREEN
+        before = {
+            d["_id"] for d in plane.stores[0].collection("distros").find()
+        }
+        assert before, "shard 0 must own something to migrate"
+        r = plane.tick(now=NOW + 15.0)
+        # ladder re-evaluates inside run_tick; re-pin and rebalance once
+        m0._level = overload.YELLOW
+        migs = plane._rebalance_locked(r.results, NOW + 16.0)
+        assert len(migs) == 1
+        assert migs[0]["from"] == 0 and migs[0]["to"] == 1
+        assert fleet_owner_violations(plane.stores) == []
+    finally:
+        plane.close()
+
+
+def test_durable_fleet_segments_and_reopen(tmp_path):
+    from evergreen_tpu.scheduler.sharded_plane import open_fleet
+    from evergreen_tpu.storage.durable import fleet_segment_ids
+
+    problem = generate_problem(4, 80, seed=27, hosts_per_distro=1)
+    data_dir = str(tmp_path / "fleet")
+    plane = ShardedScheduler.build(
+        2, data_dir=data_dir, tick_opts=OPTS, rebalance_enabled=False,
+        stacked="never",
+    )
+    try:
+        src = Store()
+        _seed(src, problem)
+        plane.seed_partition(src)
+        plane.tick(now=NOW)
+        did = next(
+            d["_id"]
+            for d in plane.stores[0].collection("distros").find()
+        )
+        plane.migrate(did, 1, now=NOW + 1)
+        n_docs = {
+            k: s.collection("tasks").count()
+            for k, s in enumerate(plane.stores)
+        }
+    finally:
+        for s in plane.stores:
+            s._lease.release()
+        plane.close()
+    assert set(fleet_segment_ids(data_dir)) == {0, 1}
+
+    reopened = open_fleet(data_dir, 2, lease_ttl_s=0.5)
+    try:
+        assert reopened.owner_of(did) == 1
+        assert fleet_owner_violations(reopened.stores) == []
+        for k, s in enumerate(reopened.stores):
+            assert s.collection("tasks").count() == n_docs[k]
+    finally:
+        for s in reopened.stores:
+            s._lease.release()
+        reopened.close()
+
+
+def test_crons_run_plane_round_when_attached(store):
+    from evergreen_tpu.scheduler.sharded_plane import (
+        attach_sharded_plane,
+    )
+    from evergreen_tpu.units.crons import scheduler_tick_jobs
+
+    problem = generate_problem(4, 80, seed=28, hosts_per_distro=1)
+    plane = _plane(2, problem)
+    try:
+        attach_sharded_plane(store, plane)
+        jobs = scheduler_tick_jobs(store, now=NOW)
+        assert len(jobs) == 1 and jobs[0].job_type == "scheduler-tick"
+        jobs[0].fn(store)
+        # the round actually planned: every shard persisted queues
+        for s in plane.stores:
+            assert s.collection("task_queues").count() > 0
+    finally:
+        plane.close()
+
+
+def test_fleet_fuse_floors_the_front_store_ladder(store):
+    """The fuse is not display-only: an attached front store's ladder
+    receives it as a floor each round, so fleet-wide seams (REST, cron
+    deferral) brown out on correlated shard overload — and release the
+    round the fleet calms."""
+    from evergreen_tpu.scheduler.sharded_plane import (
+        attach_sharded_plane,
+    )
+
+    problem = generate_problem(4, 80, seed=29, hosts_per_distro=1)
+    plane = _plane(2, problem)
+    try:
+        attach_sharded_plane(store, plane)
+        front = overload.monitor_for(store)
+        plane.tick(now=NOW)
+        assert front.level() == overload.GREEN
+        # correlated overload: both shards hot → fuse trips → floor
+        plane.fleet_level = lambda: overload.RED
+        plane.tick(now=NOW + 15.0)
+        assert front.level() == overload.RED  # own signals never moved
+        # fleet calms → the floor clears the same round
+        plane.fleet_level = lambda: overload.GREEN
+        plane.tick(now=NOW + 30.0)
+        assert front.level() == overload.GREEN
+    finally:
+        plane.close()
+
+
+def test_affinity_rederived_on_reopen():
+    """A fresh driver over existing shard stores must re-derive alias
+    affinity from the documents (a reopened fleet would otherwise hash
+    coupled distros by their own ids and route away from where their
+    documents live)."""
+    problem = generate_problem(6, 240, seed=30, hosts_per_distro=1)
+    distros, tbd, _, _, _ = problem
+    ts = tbd[distros[0].id]
+    ts[0] = dataclasses.replace(
+        ts[0], secondary_distros=[distros[1].id]
+    )
+    plane = _plane(4, problem)
+    try:
+        a, b = distros[0].id, distros[1].id
+        owner = plane.owner_of(a)
+        assert plane.owner_of(b) == owner
+        # a FRESH driver over the same stores (the reopen shape)
+        plane2 = ShardedScheduler(
+            plane.stores, tick_opts=OPTS, rebalance_enabled=False,
+            stacked="never",
+        )
+        try:
+            assert plane2.topology.placement_key(a) == \
+                plane2.topology.placement_key(b)
+            # routing follows the documents, whatever the hash says
+            assert plane2.owner_of(a) == owner
+            assert plane2.owner_of(b) == owner
+        finally:
+            plane2.close()
+    finally:
+        plane.close()

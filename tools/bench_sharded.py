@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Sharded-solve scaling bench at BASELINE config-3 scale.
+"""Sharded-solve scaling bench at BASELINE config-3 scale — plus the
+gate-blocking SHARD PARITY matrix (``--parity``).
 
 Validates parallel/sharded.py's linear-scaling claim with numbers
 (VERDICT r3 weak #4): 200 distros / 50k tasks partitioned over 8
@@ -16,6 +17,16 @@ max/mean imbalance over the single-shard times).
     python tools/bench_sharded.py [--devices 8]
 
 Prints one JSON line, then a per-shard table on stderr.
+
+``--parity`` runs the multichip equality check PROMOTED from dry-run to
+the live tick path (tools/gate.py --shard-parity): a seeded fleet is
+partitioned across 2/4/8 scheduler shards (scheduler/sharded_plane.py,
+consistent-hash topology with alias affinity), every shard runs the real
+run_tick — in per-shard local-solve mode AND, when the backend has
+enough devices, the stacked one-shard_map-solve-per-round mode — and the
+merged queue documents must canonically equal a single-scheduler oracle
+run over the same documents at the same ticks. Exits non-zero on any
+divergence or exactly-one-owner violation.
 """
 from __future__ import annotations
 
@@ -33,11 +44,143 @@ N_DISTROS = 200
 N_TASKS = 50_000
 
 
+# --------------------------------------------------------------------------- #
+# shard parity (gate --shard-parity)
+# --------------------------------------------------------------------------- #
+
+PARITY_DISTROS = 24
+PARITY_TASKS = 2400
+PARITY_TICKS = 2
+
+
+def _parity_seed(store):
+    """Deterministic fleet with alias coupling: even/odd distro pairs
+    share tasks through secondary queues, so placement affinity is
+    exercised (coupled distros must co-locate or the alias queue would
+    lose its rows)."""
+    import dataclasses
+
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.utils.benchgen import generate_problem
+
+    distros, tbd, hbd, _, _ = generate_problem(
+        PARITY_DISTROS, PARITY_TASKS, seed=11, task_group_fraction=0.3,
+        hosts_per_distro=3,
+    )
+    for di in range(0, len(distros) - 1, 2):
+        src, dst = distros[di].id, distros[di + 1].id
+        ts = tbd[src]
+        for j in range(0, len(ts), 20):
+            ts[j] = dataclasses.replace(ts[j], secondary_distros=[dst])
+    for d in distros:
+        distro_mod.insert(store, d)
+    task_mod.insert_many(store, [t for ts in tbd.values() for t in ts])
+    for hs in hbd.values():
+        host_mod.insert_many(store, hs)
+
+
+def _canonical_queues(store) -> dict:
+    """The parity comparison surface: every queue doc's ordered task
+    ids, sort values and deps-met columns, primary + secondary."""
+    from evergreen_tpu.models.task_queue import doc_column
+
+    out = {}
+    for coll in ("task_queues", "task_secondary_queues"):
+        for d in store.collection(coll).find():
+            out[(coll, d["_id"])] = (
+                doc_column(d, "id"),
+                [round(float(v), 6) for v in d.get("sort_value", [])],
+                [bool(v) for v in d.get("dependencies_met", [])],
+            )
+    return out
+
+
+def run_parity(shard_counts=(2, 4, 8)) -> int:
+    import jax
+
+    from evergreen_tpu.scheduler.sharded_plane import (
+        ShardedScheduler,
+        fleet_owner_violations,
+        merge_fleet_state,
+    )
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import NOW
+
+    opts = TickOptions(create_intent_hosts=False, use_cache=True,
+                       underwater_unschedule=False)
+    oracle = Store()
+    _parity_seed(oracle)
+    for i in range(PARITY_TICKS):
+        res = run_tick(oracle, opts, now=NOW + 15.0 * i)
+        assert res.planner_used == "tpu", res.degraded
+    want = _canonical_queues(oracle)
+    n_dev = len(jax.devices())
+
+    failures = 0
+    for n in shard_counts:
+        modes = ["never"] + (["always"] if n_dev >= n else [])
+        for stacked in modes:
+            source = Store()
+            _parity_seed(source)
+            plane = ShardedScheduler.build(
+                n, tick_opts=opts, rebalance_enabled=False,
+                stacked=stacked,
+            )
+            try:
+                plane.seed_partition(source)
+                modes_seen = []
+                for i in range(PARITY_TICKS):
+                    r = plane.tick(now=NOW + 15.0 * i)
+                    modes_seen.append(r.solve_mode)
+                    if r.degraded:
+                        failures += 1
+                        print(json.dumps({
+                            "shards": n, "stacked": stacked,
+                            "error": f"degraded: {r.degraded}",
+                        }))
+                violations = fleet_owner_violations(plane.stores)
+                got = _canonical_queues(merge_fleet_state(plane.stores))
+                ok = got == want and not violations
+                stacked_ran = "stacked" in modes_seen
+                if stacked == "always" and not stacked_ran:
+                    ok = False
+                record = {
+                    "shards": n,
+                    "stacked": stacked,
+                    "solve_modes": modes_seen,
+                    "queues": len(got),
+                    "owner_violations": violations,
+                    "parity": got == want,
+                    "ok": ok,
+                }
+                print(json.dumps(record))
+                if not ok:
+                    failures += 1
+                    diff = [
+                        k for k in want
+                        if got.get(k) != want[k]
+                    ][:5]
+                    print(f"# diverged queues: {diff}", file=sys.stderr)
+            finally:
+                plane.close()
+    print(json.dumps({
+        "shard_parity_failures": failures,
+        "shard_counts": list(shard_counts),
+        "n_devices": n_dev,
+    }))
+    return 1 if failures else 0
+
+
 def main() -> int:
     n_devices = 8
     if "--devices" in sys.argv:
         n_devices = int(sys.argv[sys.argv.index("--devices") + 1])
     force_cpu(n_devices)
+    if "--parity" in sys.argv:
+        return run_parity()
     import jax
 
     from evergreen_tpu.ops.solve import run_solve

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Sharded-control-plane churn throughput: N scheduler shards vs ONE.
+
+The headline sharded bench (``sharded_churn_tick_ms``): the BASELINE
+config-5 churn workload (200 distros / 50k tasks, ~200 finishes + ~100
+fresh tasks per tick) is partitioned across N scheduler shards by the
+production consistent-hash topology (parallel/topology.py), each shard
+running in its OWN PROCESS — its own store, TickCache, resident plane
+and tick loop, exactly the deployment shape of scheduler/sharded_plane.py
+— against a single-shard plane carrying the same total load.
+
+Two measurements, same methodology as the multichip dry-run bench
+(tools/bench_sharded.py): on a shared-core CI box every worker contends
+for the same cores, so the CONCURRENT wall is not the deployment number
+— the deployment bound is the **dedicated-shard bound**, each shard
+measured alone on the box (its own core/machine in production) with the
+round gated by the SLOWEST shard:
+
+  * ``throughput_ratio``   (headline) — aggregate churn throughput at
+    equal total load from the dedicated bound:
+    ``single_median_ms / max(per_shard_solo_median_ms)``;
+  * ``throughput_ratio_observed`` — the contended wall-clock ratio on
+    THIS box (approaches the headline as cores approach shards).
+
+Per-shard solo medians also feed the per-shard perf floor
+(tools/perf_guard.py) so one slow shard cannot hide inside an improved
+aggregate.
+
+    python tools/bench_sharded_plane.py [--shards 4] [--ticks 5]
+        [--distros 200] [--tasks 50000]
+
+Prints one JSON line; per-shard tables go to stderr. Workers are real
+processes (one python + jax runtime each) — the actual deployment shape
+of scheduler/sharded_plane.py: own store, own TickCache, own resident
+plane, own tick loop.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_DISTROS = 200
+DEFAULT_TASKS = 50_000
+DEFAULT_TICKS = 5
+WARMUP_TICKS = 2
+SEED = 3
+
+
+# --------------------------------------------------------------------------- #
+# worker: one scheduler shard in its own process
+# --------------------------------------------------------------------------- #
+
+
+def worker_main(args) -> int:
+    from evergreen_tpu.utils.jaxenv import force_cpu
+
+    force_cpu()
+    import dataclasses
+    import random
+
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.parallel.topology import ShardTopology
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import NOW, generate_problem
+    from evergreen_tpu.utils.gctune import tune_gc_for_long_lived_heap
+
+    distros, tbd, hbd, _, _ = generate_problem(
+        args.distros, args.tasks, seed=SEED, task_group_fraction=0.25,
+        patch_fraction=0.6, hosts_per_distro=25,
+    )
+    topo = ShardTopology(args.shards)
+    mine = {
+        d.id for d in distros if topo.shard_for(d.id) == args.worker
+    }
+    store = Store()
+    store.shard_id = args.worker
+    my_tasks = []
+    for d in distros:
+        if d.id not in mine:
+            continue
+        distro_mod.insert(store, d)
+        my_tasks.extend(tbd[d.id])
+        host_mod.insert_many(store, hbd[d.id])
+    task_mod.insert_many(store, my_tasks)
+
+    opts = TickOptions(create_intent_hosts=False, use_cache=True,
+                       underwater_unschedule=False)
+    rng = random.Random(args.worker)
+    coll = task_mod.coll(store)
+    finish_per_tick = max(1, 200 * len(mine) // max(args.distros, 1))
+    fresh_per_tick = max(1, 100 * len(mine) // max(args.distros, 1))
+
+    def churn(tick: int) -> None:
+        for t in rng.sample(my_tasks, min(finish_per_tick, len(my_tasks))):
+            coll.update(t.id, {"status": TaskStatus.SUCCEEDED.value})
+        fresh = [
+            dataclasses.replace(
+                rng.choice(my_tasks), id=f"shard{args.worker}-c{tick}-{j}",
+                depends_on=[],
+            )
+            for j in range(fresh_per_tick)
+        ]
+        task_mod.insert_many(store, fresh)
+
+    run_tick(store, opts, now=NOW)  # compile + prime
+    run_tick(store, opts, now=NOW + 0.01)  # absorb the stamp storm
+    for w in range(WARMUP_TICKS):
+        churn(-1 - w)
+        run_tick(store, opts, now=NOW + 0.1 * (w + 1))
+    tune_gc_for_long_lived_heap()
+
+    print(json.dumps({"ready": args.worker, "n_tasks": len(my_tasks),
+                      "n_distros": len(mine)}), flush=True)
+    sys.stdin.readline()  # GO
+
+    times = []
+    for tick in range(args.ticks):
+        churn(tick)
+        t1 = time.perf_counter()
+        run_tick(store, opts, now=NOW + 10.0 * (tick + 1))
+        times.append((time.perf_counter() - t1) * 1e3)
+    print(json.dumps({
+        "worker": args.worker,
+        "tick_ms": [round(t, 2) for t in times],
+        "median_ms": round(statistics.median(times), 2),
+        "n_tasks": len(my_tasks),
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# parent: one arm (N workers), then the ratio over both arms
+# --------------------------------------------------------------------------- #
+
+
+def _worker_cmd(k: int, n_shards: int, args) -> list:
+    return [
+        sys.executable, os.path.abspath(__file__), "--worker", str(k),
+        "--shards", str(n_shards), "--ticks", str(args.ticks),
+        "--distros", str(args.distros), "--tasks", str(args.tasks),
+    ]
+
+
+def _worker_env() -> dict:
+    return {**os.environ, "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": ""}
+
+
+def run_arm(n_shards: int, args, serial: bool = False) -> dict:
+    """Launch one worker per shard. ``serial=False``: all workers run
+    concurrently between a synchronized GO and the last DONE (the
+    contended-wall number for THIS box). ``serial=True``: workers run
+    one at a time, each alone on the box — the dedicated-shard
+    measurement whose max-median bounds a production round."""
+    env = _worker_env()
+    reports = []
+    wall_s = 0.0
+    if serial:
+        for k in range(n_shards):
+            p = subprocess.Popen(
+                _worker_cmd(k, n_shards, args), cwd=_REPO_ROOT, env=env,
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True,
+            )
+            p.stdout.readline()  # READY
+            p.stdin.write("GO\n")
+            p.stdin.flush()
+            reports.append(json.loads(p.stdout.readline()))
+            p.wait(timeout=240)
+        # a fleet round is gated by its slowest shard
+        wall_s = max(r["median_ms"] for r in reports) * args.ticks / 1e3
+    else:
+        procs = []
+        for k in range(n_shards):
+            procs.append(subprocess.Popen(
+                _worker_cmd(k, n_shards, args), cwd=_REPO_ROOT, env=env,
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True,
+            ))
+        for p in procs:
+            p.stdout.readline()  # READY
+        t0 = time.perf_counter()
+        for p in procs:
+            p.stdin.write("GO\n")
+            p.stdin.flush()
+        for p in procs:
+            reports.append(json.loads(p.stdout.readline()))
+            p.wait(timeout=240)
+        wall_s = time.perf_counter() - t0
+    total_tasks = sum(r["n_tasks"] for r in reports)
+    return {
+        "n_shards": n_shards,
+        "serial": serial,
+        "wall_s": round(wall_s, 3),
+        "per_shard_median_ms": [r["median_ms"] for r in reports],
+        "per_shard_tasks": [r["n_tasks"] for r in reports],
+        # tasks under management × ticks per wall second — the aggregate
+        # churn-replan throughput of the whole plane
+        "throughput_tasks_per_s": round(
+            total_tasks * args.ticks / wall_s, 1
+        ),
+        "round_ms": round(wall_s * 1e3 / args.ticks, 2),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
+    p.add_argument("--distros", type=int, default=DEFAULT_DISTROS)
+    p.add_argument("--tasks", type=int, default=DEFAULT_TASKS)
+    p.add_argument("--worker", type=int, default=-1,
+                   help="(internal) run as shard worker k")
+    args = p.parse_args()
+    if args.worker >= 0:
+        return worker_main(args)
+
+    single = run_arm(1, args)
+    dedicated = run_arm(args.shards, args, serial=True)
+    observed = run_arm(args.shards, args)
+    # median tick vs median tick (the round is gated by the slowest
+    # shard): both sides exclude the harness's churn-apply mutations
+    single_median = single["per_shard_median_ms"][0]
+    ratio = single_median / max(
+        max(dedicated["per_shard_median_ms"]), 1e-9
+    )
+    ratio_obs = (
+        observed["throughput_tasks_per_s"]
+        / max(single["throughput_tasks_per_s"], 1e-9)
+    )
+    result = {
+        "metric": "sharded_churn_tick_ms",
+        "value": dedicated["round_ms"],
+        "unit": "ms",
+        "n_shards": args.shards,
+        "n_distros": args.distros,
+        "n_tasks": args.tasks,
+        "ticks": args.ticks,
+        "dedicated": dedicated,
+        "observed": observed,
+        "single": single,
+        "single_churn_tick_ms": single_median,
+        #: headline — dedicated-shard bound (slowest shard gates the
+        #: round; each shard on its own core/machine in production)
+        "throughput_ratio": round(ratio, 3),
+        #: the contended wall-clock ratio on THIS box
+        "throughput_ratio_observed": round(ratio_obs, 3),
+        "cores": os.cpu_count(),
+    }
+    print(json.dumps(result))
+    print(
+        f"# {args.shards}-shard plane: dedicated round="
+        f"{dedicated['round_ms']:.0f}ms "
+        f"(per-shard solo medians={dedicated['per_shard_median_ms']}) "
+        f"vs single-shard {single_median:.0f}ms -> aggregate churn "
+        f"throughput x{ratio:.2f} dedicated / x{ratio_obs:.2f} observed "
+        f"on {os.cpu_count()} cores (target >= 2.5 dedicated)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

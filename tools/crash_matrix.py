@@ -74,6 +74,22 @@ KILL_POINTS: List[Tuple[str, int]] = [
     ("lease.renew", 1),       # a later renewal
 ]
 
+#: distro-handoff kill points (sharded control plane,
+#: scheduler/sharded_plane.py): the child runs a 2-shard plane with a
+#: deterministic mid-run migration; a SIGKILL at any protocol step must
+#: converge to exactly-one-owner with zero duplicate dispatch across
+#: shards after restart + reconcile_handoffs.
+SHARDED_KILL_POINTS: List[Tuple[str, int]] = [
+    ("handoff.release", 0),   # inside the source's release WAL group —
+    #                           the group never commits; no handoff at all
+    ("handoff.record", 0),    # release durable, target NOT primed —
+    #                           reconciliation re-primes from the record
+    ("handoff.prime", 0),     # target primed, done-mark missing —
+    #                           reconciliation completes it idempotently
+]
+#: which tick of the sharded child triggers the migration
+MIGRATE_TICK = 2
+
 
 # --------------------------------------------------------------------------- #
 # child: the deterministic workload
@@ -159,6 +175,8 @@ def child_main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--ttl", type=float, default=LEASE_TTL_S)
     p.add_argument("--hold", action="store_true",
                    help="after the ticks, keep the lease until stdin EOF")
+    p.add_argument("--sharded", type=int, default=0,
+                   help="run the N-shard control-plane workload instead")
     args = p.parse_args(argv)
 
     from evergreen_tpu.utils import faults
@@ -171,6 +189,9 @@ def child_main(argv: Optional[List[str]] = None) -> int:
         plan.always("wal.fence", faults.Fault("hang", delay_s=args.stall))
     if args.crash or args.stall > 0:
         faults.install(plan)
+
+    if args.sharded > 0:
+        return sharded_child_main(args)
 
     from evergreen_tpu.scheduler.recovery import run_recovery_pass
     from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
@@ -233,13 +254,125 @@ def child_main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
+def sharded_child_main(args) -> int:
+    """The 2-shard control-plane workload: per-shard DurableStores (own
+    lease + WAL segment in ONE data dir), per-shard recovery + handoff
+    reconciliation at startup, deterministic ticks with a forced
+    migration of ``d000`` at MIGRATE_TICK, and a global agent pull
+    dispatching every shard's hosts each step."""
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.scheduler.recovery import run_recovery_pass
+    from evergreen_tpu.scheduler.sharded_plane import ShardedScheduler
+    from evergreen_tpu.scheduler.wrapper import TickOptions
+    from evergreen_tpu.storage.store import Store
+
+    n = args.sharded
+    plane = ShardedScheduler.build(
+        n, data_dir=args.data_dir, lease_ttl_s=args.ttl,
+        rebalance_enabled=False, stacked="never",
+        tick_opts=TickOptions(
+            create_intent_hosts=False, underwater_unschedule=False,
+            use_cache=False,
+        ),
+    )
+    for k, s in enumerate(plane.stores):
+        s._lease.start_renewing(on_lost=lambda: None)
+        print(f"EPOCH{k} {s._lease.epoch}", flush=True)
+
+    prog = plane.stores[0].collection("harness").get("progress")
+    done = prog["ticks"] if prog else 0
+    for k, s in enumerate(plane.stores):
+        report = run_recovery_pass(s, now=NOW + done * TICK_S)
+        print(f"RECOVERY{k} " + json.dumps(report.to_doc()), flush=True)
+    healed = plane.reconcile_handoffs(now=NOW + done * TICK_S)
+    print("RECONCILED " + json.dumps(healed), flush=True)
+
+    if prog is None:
+        tmp = Store()
+        _seed_problem(tmp)  # includes the progress doc
+        tmp.collection("harness").remove("progress")
+        plane.seed_partition(tmp)
+        plane.stores[0].collection("harness").upsert(
+            {"_id": "progress", "ticks": 0}
+        )
+
+    # the deterministic migration: d000 moves AWAY from its hash owner
+    # (idempotent across restarts — a completed or reconciled handoff
+    # already flipped the override, so the re-run skips it)
+    mig_src = plane.topology.hash_shard_for("d000")
+    mig_dst = (mig_src + 1) % n
+
+    def agent_sim(now: float) -> None:
+        from evergreen_tpu.globals import TaskStatus
+        from evergreen_tpu.models import task as task_mod
+        from evergreen_tpu.models.lifecycle import (
+            mark_end,
+            mark_task_started,
+        )
+
+        for store in plane.stores:
+            c = task_mod.coll(store)
+            for tid in sorted(
+                d["_id"] for d in c.find(
+                    lambda d: d["status"] in (
+                        TaskStatus.DISPATCHED.value,
+                        TaskStatus.STARTED.value,
+                    )
+                )
+            ):
+                mark_task_started(store, tid, now=now)
+                mark_end(store, tid, TaskStatus.SUCCEEDED.value, now=now)
+        plane._dispatchers.clear()  # fresh per step: no TTL staleness
+        hosts = sorted(
+            (
+                Host.from_doc(doc)
+                for store in plane.stores
+                for doc in store.collection("hosts").find()
+            ),
+            key=lambda h: h.id,
+        )
+        for h in hosts:
+            if h.can_run_tasks() and not h.running_task:
+                plane.assign_next_task(h, now=now)
+
+    from evergreen_tpu.storage.lease import EpochFencedError
+
+    try:
+        for i in range(done, args.ticks):
+            now = NOW + (i + 1) * TICK_S
+            if i == MIGRATE_TICK and plane.owner_of("d000") != mig_dst:
+                rec = plane.migrate("d000", mig_dst, now=now)
+                print("MIGRATED " + json.dumps(rec["group"]), flush=True)
+            res = plane.tick(now=now)
+            if any(r.degraded == "fenced" for r in res.results.values()):
+                print("FENCED", flush=True)
+                os._exit(75)
+            agent_sim(now)
+            plane.stores[0].collection("harness").upsert(
+                {"_id": "progress", "ticks": i + 1}
+            )
+            print(f"TICK-DONE {i}", flush=True)
+        for s in plane.stores:
+            s.sync_persist()
+    except EpochFencedError:
+        print("FENCED", flush=True)
+        os._exit(75)
+    print("DONE", flush=True)
+    for s in plane.stores:
+        s._lease.release()
+    # no close(): the WAL segments must keep their frames for inspection
+    os._exit(0)
+    return 0
+
+
 # --------------------------------------------------------------------------- #
 # parent: orchestration + invariants
 # --------------------------------------------------------------------------- #
 
 
 def _child_cmd(data_dir: str, ticks: int, crash: str = "",
-               stall: float = 0.0, hold: bool = False) -> List[str]:
+               stall: float = 0.0, hold: bool = False,
+               sharded: int = 0) -> List[str]:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
         "--data-dir", data_dir, "--ticks", str(ticks),
@@ -250,6 +383,8 @@ def _child_cmd(data_dir: str, ticks: int, crash: str = "",
         cmd += ["--stall", str(stall)]
     if hold:
         cmd += ["--hold"]
+    if sharded:
+        cmd += ["--sharded", str(sharded)]
     return cmd
 
 
@@ -324,7 +459,7 @@ def check_invariants(store) -> List[str]:
             problems.append(
                 f"host {hid} claims task {rt} that is not in flight"
             )
-    for coll_name in ("task_queues", "task_queues_secondary"):
+    for coll_name in ("task_queues", "task_secondary_queues"):
         for doc in store.collection(coll_name).find():
             n = len(doc.get("rows", []))
             for col in ("sort_value", "dependencies_met"):
@@ -429,6 +564,147 @@ def reference_state(ticks: int = DEFAULT_TICKS) -> dict:
             "convergence needs every task finished; raise ticks"
         )
     return state
+
+
+def _run_sharded_child(data_dir: str, ticks: int, crash: str = "",
+                       n: int = 2,
+                       timeout_s: float = 240.0) -> Tuple[int, str]:
+    proc = subprocess.run(
+        _child_cmd(data_dir, ticks, crash=crash, sharded=n),
+        env=_child_env(), cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=timeout_s,
+    )
+    return proc.returncode, proc.stdout.decode(errors="replace")
+
+
+def _open_fleet_for_inspection(data_dir: str, n: int) -> list:
+    from evergreen_tpu.storage.durable import DurableStore
+
+    return [DurableStore(data_dir, shard_id=k) for k in range(n)]
+
+
+def run_sharded_point(seam: str, index: int, ticks: int = DEFAULT_TICKS,
+                      n: int = 2,
+                      reference: Optional[dict] = None) -> dict:
+    """One distro-handoff kill point on the 2-shard plane: crash at the
+    protocol seam, restart (per-shard WAL replay + recovery +
+    reconcile_handoffs), then assert exactly-one-owner, no duplicate
+    dispatch across shards, monotone per-shard epochs, and resume ≡
+    rerun at convergence against an uninterrupted sharded run."""
+    from evergreen_tpu.scheduler.sharded_plane import (
+        fleet_owner_violations,
+        merge_fleet_state,
+    )
+
+    data_dir = tempfile.mkdtemp(
+        prefix=f"crash-{seam.replace('.', '-')}-"
+    )
+    crash = f"{seam}@{index}"
+    rc1, out1 = _run_sharded_child(data_dir, ticks, crash=crash, n=n)
+    crashed = rc1 == 86
+    rc2, out2 = _run_sharded_child(data_dir, ticks, n=n)
+    out = out1 + out2
+    problems: List[str] = []
+    stores = _open_fleet_for_inspection(data_dir, n)
+    problems.extend(fleet_owner_violations(stores))
+    parity_ok = True
+    try:
+        merged = merge_fleet_state(stores)
+    except ValueError as exc:
+        problems.append(str(exc))
+        merged = None
+    if merged is not None:
+        problems.extend(check_invariants(merged))
+        prog = stores[0].collection("harness").get("progress")
+        if not prog or prog["ticks"] != ticks:
+            problems.append(f"workload did not converge: progress={prog}")
+        if reference is not None:
+            parity_ok = canonical_state(merged) == reference
+            if not parity_ok:
+                problems.append("resume != rerun")
+    # the migration must actually have happened (a kill point that
+    # silently skips the handoff proves nothing)
+    migrated = any(
+        line.startswith("MIGRATED") for line in out.splitlines()
+    )
+    if not migrated and seam != "handoff.release":
+        # release-crash reruns may reconcile instead of re-migrating;
+        # every other point re-prints MIGRATED on the run that did it
+        migrated = any(
+            s.collection("shard_handoffs").count() > 0 for s in stores
+        )
+    if not migrated:
+        problems.append("no migration was attempted")
+    # monotone epochs per shard
+    epochs: Dict[int, List[int]] = {k: [] for k in range(n)}
+    for line in out.splitlines():
+        for k in range(n):
+            if line.startswith(f"EPOCH{k} "):
+                epochs[k].append(int(line.split()[1]))
+    for k, es in epochs.items():
+        if es != sorted(set(es)):
+            problems.append(f"shard {k} epochs not increasing: {es}")
+    if not crashed and rc1 != 0:
+        problems.append(f"first run died unexpectedly: rc={rc1}")
+    if rc2 != 0:
+        problems.append(f"recovery run failed: rc={rc2}")
+    return {
+        "point": f"sharded:{crash}",
+        "ok": crashed and not problems,
+        "crashed": crashed,
+        "rc": (rc1, rc2),
+        "epochs": epochs,
+        "parity_ok": parity_ok,
+        "problems": problems,
+        "data_dir": data_dir,
+        "out": out if problems else "",
+    }
+
+
+def sharded_reference_state(ticks: int = DEFAULT_TICKS,
+                            n: int = 2) -> dict:
+    """One uninterrupted 2-shard run with the same forced migration —
+    the rerun side of the sharded resume ≡ rerun."""
+    from evergreen_tpu.scheduler.sharded_plane import merge_fleet_state
+
+    data_dir = tempfile.mkdtemp(prefix="crash-sharded-reference-")
+    rc, out = _run_sharded_child(data_dir, ticks, n=n)
+    if rc != 0:
+        raise RuntimeError(f"sharded reference failed rc={rc}:\n{out}")
+    if "MIGRATED" not in out:
+        raise RuntimeError("sharded reference never migrated d000")
+    merged = merge_fleet_state(_open_fleet_for_inspection(data_dir, n))
+    state = canonical_state(merged)
+    undrained = [
+        tid for tid, (status, _) in state["tasks"].items()
+        if status != "success"
+    ]
+    if undrained:
+        raise RuntimeError(
+            f"sharded reference did not drain in {ticks} ticks "
+            f"({len(undrained)} unfinished)"
+        )
+    return state
+
+
+def run_sharded_points(ticks: int = DEFAULT_TICKS) -> int:
+    """The distro-handoff kill points against one shared sharded
+    reference; prints one JSON line per point, returns the failure
+    count (shared by the full matrix and ``--sharded-only``)."""
+    ref = sharded_reference_state(ticks)
+    failures = 0
+    for seam, idx in SHARDED_KILL_POINTS:
+        out = run_sharded_point(seam, idx, ticks=ticks, reference=ref)
+        print(json.dumps({
+            k: out[k]
+            for k in ("point", "ok", "crashed", "rc", "epochs",
+                      "parity_ok", "problems")
+        }))
+        if not out["ok"]:
+            failures += 1
+            sys.stderr.write(out["out"] + "\n")
+    return failures
 
 
 def failover_case(ticks: int = 4, stall_s: float = 2.0) -> dict:
@@ -585,8 +861,12 @@ def run_matrix(points: Optional[List[Tuple[str, int]]] = None,
     if not fo["ok"]:
         failures += 1
         sys.stderr.write(fo["holder_out"] + "\n" + fo["standby_out"] + "\n")
-    print(json.dumps({"crash_matrix_failures": failures,
-                      "points": len(points) + 1}))
+    # distro-handoff kill points on the 2-shard plane
+    failures += run_sharded_points(ticks)
+    print(json.dumps({
+        "crash_matrix_failures": failures,
+        "points": len(points) + 1 + len(SHARDED_KILL_POINTS),
+    }))
     return 1 if failures else 0
 
 
@@ -600,8 +880,12 @@ def main() -> int:
     p.add_argument("--point", default="",
                    help="run one kill point only (seam@index)")
     p.add_argument("--failover-only", action="store_true")
+    p.add_argument("--sharded-only", action="store_true",
+                   help="run only the distro-handoff kill points")
     p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
     args = p.parse_args()
+    if args.sharded_only:
+        return 1 if run_sharded_points(args.ticks) else 0
     if args.failover_only:
         out = failover_case()
         print(json.dumps({k: v for k, v in out.items()

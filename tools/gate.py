@@ -90,7 +90,8 @@ def _log_run(rc: int, args: list) -> None:
     # masquerade as a suite-wide green; the only extra args a full run
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
-        a in ("--crash-matrix", "--overload-matrix", "--resident-parity")
+        a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
+              "--shard-parity")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -110,11 +111,13 @@ def main() -> int:
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
-    flags = {"--crash-matrix", "--overload-matrix", "--resident-parity"}
+    flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
+             "--shard-parity"}
     args = [a for a in sys.argv[1:] if a not in flags]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
+    with_shard_parity = "--shard-parity" in sys.argv[1:]
     args = args or ["tests/"]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # metrics-lint first, unconditionally: it is static, takes
@@ -154,6 +157,17 @@ def main() -> int:
         print("gate:", " ".join(rp), flush=True)
         rc = subprocess.call(rp, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--resident-parity")
+    if rc == 0 and with_shard_parity:
+        # sharded tick ≡ single-scheduler oracle at 2/4/8 shards, in
+        # local AND stacked solve modes (make shard-parity): the
+        # multichip equality check promoted from dry-run to the live
+        # tick path — gate-blocking
+        spar = [sys.executable,
+                os.path.join(root, "tools", "bench_sharded.py"),
+                "--parity"]
+        print("gate:", " ".join(spar), flush=True)
+        rc = subprocess.call(spar, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--shard-parity")
     _log_run(rc, [*args, *ran_flags])
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)

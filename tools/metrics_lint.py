@@ -180,6 +180,26 @@ def lint() -> List[str]:
                     f"{loc}: {name!r} needs a non-empty literal help "
                     "string"
                 )
+            # per-shard instruments must carry the shard label: an
+            # instrument observed once per shard (anything named
+            # *_shard_* / shard_*) without a shard label silently FOLDS
+            # every shard into one series — a shard regression then
+            # hides inside an improved aggregate, exactly what the
+            # sharded perf floor exists to prevent
+            per_shard = "_shard_" in name or name.startswith("shard_")
+            if per_shard:
+                ln_chk = _labels_node(node)
+                label_vals = []
+                if isinstance(ln_chk, (ast.Tuple, ast.List)):
+                    label_vals = [
+                        _literal_str(el)[1] for el in ln_chk.elts
+                    ]
+                if "shard" not in label_vals:
+                    violations.append(
+                        f"{loc}: per-shard instrument {name!r} must "
+                        "carry the 'shard' label (unlabeled per-shard "
+                        "series fold every shard together)"
+                    )
             # labels
             ln = _labels_node(node)
             if ln is not None:

