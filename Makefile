@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity metrics-lint
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity metrics-lint
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -63,6 +63,15 @@ resident-parity:
 # stacked solve modes); gate-blocking via tools/gate.py --shard-parity
 shard-parity:
 	env JAX_PLATFORMS=cpu python tools/bench_sharded.py --parity
+
+# capacity-plane gate: the joint (distros x pools) solve must always be
+# feasible (min/max/quota/fleet-cap), match-or-beat the serial
+# utilization heuristic's time-to-empty on the bench workload, trade
+# capacity across a shared quota the per-distro heuristic cannot see,
+# and fall back to bit-identical heuristic behavior when the solver
+# fails; gate-blocking via tools/gate.py --capacity-parity
+capacity-parity:
+	env JAX_PLATFORMS=cpu python tools/capacity_parity.py
 
 # N-process sharded-plane churn throughput vs the single-shard plane
 bench-sharded-plane:

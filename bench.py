@@ -152,6 +152,9 @@ def main() -> None:
         run_solve_packed(s1)
         extra[name] = (time.perf_counter() - t1) * 1e3
 
+    # --- capacity plane: the joint (distros x pools) host solve ------------- #
+    capacity = measure_capacity(store)
+
     # --- dispatch-path scale check (next_task under concurrency) ----------- #
     dispatch = measure_dispatch()
 
@@ -183,6 +186,7 @@ def main() -> None:
             "tick_ms": round(ov["sequential_ms"], 2),
         },
         sharded_plane=sharded_plane,
+        capacity=capacity,
     )
     print(json.dumps(result))
     if _backend == "axon":
@@ -287,6 +291,70 @@ def measure_sharded_plane() -> dict:
     except Exception as exc:  # noqa: BLE001 — the sharded arm must not
         # kill the headline bench run
         print(f"# sharded-plane arm failed: {exc!r}", file=sys.stderr)
+        return {"error": repr(exc)[-200:]}
+
+
+def measure_capacity(store) -> dict:
+    """The ``capacity_solve_ms`` arm: flip every bench distro into the
+    joint capacity program (``planner_settings.capacity = "tpu"`` + a
+    binding pool quota) on the live churn store and measure the solve
+    inside real ticks, reporting the solver-vs-heuristic intent deltas
+    from the provenance record. Runs LAST against this store — it
+    mutates distro docs and creates intent hosts."""
+    try:
+        from evergreen_tpu.models import distro as distro_mod
+        from evergreen_tpu.scheduler.capacity_plane import (
+            CAPACITY_SOLVE_MS,
+        )
+        from evergreen_tpu.scheduler.provenance import (
+            capacity_provenance_for,
+        )
+        from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+        from evergreen_tpu.settings import CapacityConfig
+
+        coll = distro_mod.coll(store)
+        for doc in coll.find():
+            ps = dict(doc.get("planner_settings") or {})
+            ps["capacity"] = "tpu"
+            coll.update(doc["_id"], {"planner_settings": ps})
+        # quota sits just above the existing fleet (200 distros × 25
+        # hosts) so the solve allocates the 500-intent budget by queue
+        # depth instead of degenerating to "quota already full, zero
+        # intents everywhere"
+        CapacityConfig(
+            pool_quotas={"mock": 5400}, fleet_intent_budget=500
+        ).set(store)
+        opts = TickOptions(use_cache=True, underwater_unschedule=False)
+        h0 = CAPACITY_SOLVE_MS.state()
+        # the FIRST capacity tick sees the quota headroom and allocates
+        # the intent budget; later ticks re-solve a saturated pool (the
+        # intents it created count as active hosts) — report the
+        # first tick's solver-vs-heuristic deltas, time all three
+        run_tick(store, opts, now=NOW + 1000.0)
+        prov = capacity_provenance_for(store)
+        if prov is None:
+            return {"error": "no capacity solve ran"}
+        for k in range(1, 3):
+            run_tick(store, opts, now=NOW + 1000.0 + 15.0 * k)
+        hist = CAPACITY_SOLVE_MS.snapshot_delta(h0)
+        rows = [prov.explain(d) for d in sorted(prov._rows)]
+        solver_intents = sum(r["intents"] for r in rows)
+        heur_intents = sum(max(0, r["heuristic_new"]) for r in rows)
+        changed = sum(
+            1 for r in rows if r["intents"] != r["heuristic_new"]
+        )
+        return {
+            "capacity_solve_ms": hist.get("p50", 0.0),
+            "n_distros": len(rows),
+            "chosen": prov.chosen,
+            "intents_solver": int(solver_intents),
+            "intents_heuristic": int(heur_intents),
+            "distros_changed": int(changed),
+            "fleet": prov.fleet,
+        }
+    except Exception as exc:  # noqa: BLE001 — the capacity arm must not
+        # kill the headline bench run
+        print(f"# capacity arm failed: {exc!r}", file=sys.stderr)
         return {"error": repr(exc)[-200:]}
 
 

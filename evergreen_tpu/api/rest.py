@@ -866,6 +866,12 @@ class RestApi:
             r"/rest/v2/admin/provenance/(?P<distro>[^/]+)",
             self.get_provenance,
         )
+        r("GET", r"/rest/v2/admin/capacity", self.get_capacity_fleet)
+        r(
+            "GET",
+            r"/rest/v2/admin/capacity/(?P<distro>[^/]+)",
+            self.get_capacity,
+        )
         r("GET", r"/rest/v2/status", self.status)
         # login surface (reference service/ui.go login routes + gimlet
         # user-manager handlers); manager-agnostic
@@ -1792,6 +1798,32 @@ class RestApi:
         if doc is None:
             raise ApiError(
                 404, f"no provenance for distro {match['distro']!r}"
+            )
+        return 200, doc
+
+    def get_capacity_fleet(self, method, match, body):
+        """The last applied capacity solve's fleet view: pool usage,
+        budget, and the per-distro decomposition head (?limit=)."""
+        from ..scheduler.provenance import capacity_provenance_for
+
+        prov = capacity_provenance_for(self.store)
+        if prov is None:
+            raise ApiError(
+                404, "no capacity solve yet (no capacity-managed distro "
+                "has planned)"
+            )
+        return 200, prov.to_doc(limit=int(body.get("limit", 50)))
+
+    def get_capacity(self, method, match, body):
+        """Why did distro X get k hosts: the capacity program's term
+        decomposition, binding constraints and trade partners."""
+        from ..scheduler.provenance import explain_capacity
+
+        doc = explain_capacity(self.store, match["distro"])
+        if doc is None:
+            raise ApiError(
+                404,
+                f"no capacity decision for distro {match['distro']!r}",
             )
         return 200, doc
 

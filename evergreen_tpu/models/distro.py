@@ -33,6 +33,12 @@ class PlannerSettings:
     global scheduler config; we bake the commonly-deployed defaults)."""
 
     version: str = PlannerVersion.TPU.value
+    #: host-capacity allocator: "" = the per-distro utilization
+    #: heuristic; "tpu" = the joint capacity program over
+    #: (distros × provider pools) — ops/capacity.py via
+    #: scheduler/capacity_plane.py, breaker-guarded with the heuristic
+    #: as its fallback
+    capacity: str = ""
     target_time_s: float = 0.0  # 0 → use MAX_DURATION_PER_DISTRO_HOST_S
     group_versions: bool = False
     patch_factor: int = 0

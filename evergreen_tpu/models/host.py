@@ -193,6 +193,17 @@ def find(store: Store, pred=None) -> List[Host]:
     return [Host.from_doc(d) for d in coll(store).find(pred)]
 
 
+def count_intents_in_flight(store: Store) -> int:
+    """Intent hosts not yet materialized by the cloud — the ONE
+    definition of "in flight" the intent-budget accounting uses, shared
+    by the classic per-store path (scheduler/wrapper.py) and the
+    sharded driver's fleet split (scheduler/sharded_plane.py) so the
+    two deployments can never enforce different fleet caps."""
+    return coll(store).count(
+        lambda doc: doc["status"] == HostStatus.UNINITIALIZED.value
+    )
+
+
 def is_active_host_doc(doc: dict) -> bool:
     """The allocator's capacity predicate at doc level — the ONE
     definition shared by the cold scan below and the TickCache's warm

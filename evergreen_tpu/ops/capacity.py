@@ -1,0 +1,477 @@
+"""The global capacity program — one batched device solve for host counts.
+
+Replaces the per-distro tail of the utilization heuristic
+(scheduler/serial.py utilization_based_host_allocator, reference
+scheduler/utilization_based_host_allocator.go) for opted-in distros
+(``PlannerSettings.capacity == "tpu"``) with ONE coupled program over
+(distros × provider pools), the CvxCluster shape (PAPERS.md): granular
+allocation as a structured convex relaxation solved in a handful of
+damped-Newton + projection sweeps, then deterministically rounded back
+to integral host intents with an exact feasibility-repair pass.
+
+Formulation.  Decision ``x[d]`` = total hosts distro ``d`` should hold
+(a distro draws from exactly one provider pool — intents materialize as
+``new_intent(d.id, d.provider)`` — so the (distros × pools) coupling
+lives in the constraint matrix, not in a 2-D decision):
+
+    minimize    Σ_d  demand_u[d] / x[d]                (queue drain)
+              + w_price · Σ_d  price[pool(d)] · x[d]   (provider cost)
+              + w_churn/2 · Σ_d (x[d] − existing[d])²  (churn/preemption)
+
+    subject to  lo[d] ≤ x[d] ≤ hi[d]                   (min/max hosts,
+                                                        demand cap)
+                Σ_{pool(d)=p} x[d] ≤ quota[p]          (per-pool quota)
+                Σ_d max(x[d] − existing[d], 0) ≤ B     (fleet intent
+                                                        budget)
+
+``demand_u`` is the distro's dependency-met expected work in
+*threshold units* (seconds / max_duration_per_host_s) — the same
+normalization the utilization heuristic divides by — so the drain term
+is measured in host-rounds and the program is scale-free across
+distros with different target times.  Minimum hosts are HARD (they win
+over quota and budget, exactly like the heuristic's min-hosts top-up):
+the effective quota/budget are floored at the min-hosts mass so the
+projection is always well-defined, and the feasibility checker applies
+the same floors.
+
+The device solve runs damped Newton on the diagonal (the drain term's
+Hessian is diagonal and cheap: 2·demand_u/x³), projecting after every
+step — box clamp, then a per-pool scale-down of the above-minimum mass,
+then the same scale-down for the fleet increment budget.  The
+projections are approximate (a true Dykstra alternation is not worth
+the device round trips); exactness is restored host-side by
+``round_allocation``, whose largest-remainder add-back and greedy
+repair loop guarantee every hard constraint on the *integral* output.
+
+Everything is static-shaped (D padded to buckets, P a compile-time
+constant), branch-free, f32 — the same discipline as ops/solve.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..globals import Provider
+
+#: fixed, deterministic pool vocabulary: pools ARE providers (a distro's
+#: hosts can only come from its own provider), in enum declaration order
+#: so every process — every shard, every parity harness — agrees on the
+#: index without coordination. Index P_BUCKET-1 is the "other" pool for
+#: unknown provider strings.
+POOL_NAMES: Tuple[str, ...] = tuple(p.value for p in Provider)
+P_BUCKET = 8
+_POOL_INDEX: Dict[str, int] = {name: i for i, name in enumerate(POOL_NAMES)}
+assert len(POOL_NAMES) < P_BUCKET
+
+
+def pool_index_of(provider: str) -> int:
+    """Deterministic provider → pool index (unknown → the 'other' slot)."""
+    return _POOL_INDEX.get(provider, P_BUCKET - 1)
+
+
+def pool_name_of(index: int) -> str:
+    return POOL_NAMES[index] if 0 <= index < len(POOL_NAMES) else "other"
+
+
+#: a "no limit" stand-in that survives f32 arithmetic without inf-minus-
+#: inf hazards in the projections
+_BIG = 1.0e7
+
+
+# --------------------------------------------------------------------------- #
+# Inputs
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class CapacityInputs:
+    """The capacity program's problem instance, real-sized (unpadded)
+    numpy columns aligned with ``distro_ids``. Built by the capacity
+    plane from the tick's existing aggregates (QueueInfoView /
+    DistroQueueInfo + host counts) — no store reads of its own."""
+
+    distro_ids: List[str]
+    #: dependency-met expected work, seconds (d_expected_dur_s)
+    demand_s: np.ndarray
+    #: per-distro target time (max_duration_per_host_s)
+    thresh_s: np.ndarray
+    existing: np.ndarray  # active hosts
+    free: np.ndarray      # free hosts (is_free)
+    min_hosts: np.ndarray
+    max_hosts: np.ndarray  # 0 = no allocation (heuristic semantics)
+    #: dependency-met task count — new hosts never exceed deps_met − free
+    deps_met: np.ndarray
+    pool: np.ndarray      # int32 pool index per distro
+    elig: np.ndarray      # bool: row participates in the joint solve
+    #: heuristic new-host counts (warm start + the fallback allocation)
+    heuristic_new: np.ndarray
+    #: pool price vector [P_BUCKET] (relative $/host-hour)
+    price: np.ndarray
+    #: pool quota vector [P_BUCKET] (0 = unlimited), over ELIGIBLE rows
+    quota: np.ndarray
+    #: fleet-wide cap on NEW hosts this solve may request
+    fleet_budget: float
+    #: mild regularizers by default: the drain term (host-rounds) must
+    #: dominate — a churn weight that rivals the marginal drain value
+    #: (demand_u/x², quadratic in the increment here) pins every distro
+    #: near its current fleet and the program degrades to "do nothing"
+    #: (the capacity-parity gate's clamped-heuristic comparison catches
+    #: it)
+    w_price: float = 0.02
+    w_churn: float = 0.001
+    iterations: int = 48
+
+    @property
+    def n(self) -> int:
+        return len(self.distro_ids)
+
+    def demand_units(self) -> np.ndarray:
+        thresh = np.where(self.thresh_s > 0, self.thresh_s, 1.0)
+        return self.demand_s / thresh
+
+    def bounds(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(lo, hi) in hosts. hi folds the max-hosts cap AND the
+        heuristic's demand guard (new ≤ deps_met − free); min hosts are
+        hard and win conflicts."""
+        lo = np.maximum(self.min_hosts.astype(np.float64), 0.0)
+        new_cap = np.maximum(self.deps_met - self.free, 0.0)
+        maxh = np.where(self.max_hosts > 0, self.max_hosts, _BIG)
+        hi = np.minimum(maxh, self.existing + new_cap)
+        return lo, np.maximum(lo, hi)
+
+    def effective_quota(self) -> np.ndarray:
+        """Quota floored at the eligible rows' min-hosts mass per pool
+        (min hosts are hard); 0 stays 'unlimited'."""
+        lo, _ = self.bounds()
+        lo_mass = np.zeros(P_BUCKET)
+        np.add.at(lo_mass, self.pool[self.elig], lo[self.elig])
+        return np.where(self.quota > 0,
+                        np.maximum(self.quota, lo_mass), _BIG)
+
+    def effective_budget(self) -> float:
+        """The fleet budget floored at the hard min-hosts increments
+        (mins win, like the heuristic's min-hosts top-up). When that
+        floor exceeds the tick's in-flight intent allowance, the
+        wrapper's creation loop still clamps — the same policy
+        conflict the classic heuristic's top-up has always had with
+        the global cap."""
+        lo, _ = self.bounds()
+        lo_inc = np.maximum(lo - self.existing, 0.0)
+        return max(float(self.fleet_budget), float(lo_inc[self.elig].sum()))
+
+
+# --------------------------------------------------------------------------- #
+# Device program
+# --------------------------------------------------------------------------- #
+
+
+def _capacity_step_fns(P: int):
+    import jax.numpy as jnp
+
+    def seg_sum(x, seg):
+        return jnp.zeros((P,), x.dtype).at[seg].add(x)
+
+    def project(x, a):
+        lo, hi = a["lo"], a["hi"]
+        elig, pool = a["elig"], a["pool"]
+        existing = a["existing"]
+        x = jnp.clip(x, lo, hi)
+        # per-pool quota: scale the above-minimum mass of over-quota
+        # pools so the pool lands exactly on its (effective) quota
+        xm = jnp.where(elig, x, 0.0)
+        lom = jnp.where(elig, lo, 0.0)
+        pool_sum = seg_sum(xm, pool)
+        lo_sum = seg_sum(lom, pool)
+        over = pool_sum > a["quota"]
+        f = jnp.where(
+            over,
+            jnp.maximum(a["quota"] - lo_sum, 0.0)
+            / jnp.maximum(pool_sum - lo_sum, 1e-9),
+            1.0,
+        )
+        x = jnp.where(elig, lo + (x - lo) * f[pool], x)
+        # fleet intent budget: scale the above-minimum part of the
+        # increments (never below the hard min-hosts increments)
+        inc = jnp.maximum(x - existing, 0.0)
+        inc_min = jnp.maximum(lo - existing, 0.0)
+        tot = jnp.sum(jnp.where(elig, inc, 0.0))
+        tot_min = jnp.sum(jnp.where(elig, inc_min, 0.0))
+        g = jnp.where(
+            tot > a["budget"],
+            jnp.maximum(a["budget"] - tot_min, 0.0)
+            / jnp.maximum(tot - tot_min, 1e-9),
+            1.0,
+        )
+        scaled = inc_min + (inc - inc_min) * g
+        x = jnp.where(elig & (x > existing), existing + scaled, x)
+        return jnp.clip(x, lo, hi)
+
+    def newton(x, a):
+        demand_u, existing = a["demand_u"], a["existing"]
+        price_d = a["price"][a["pool"]]
+        g = (
+            -demand_u / (x * x + 1e-6)
+            + a["w_price"] * price_d
+            + a["w_churn"] * (x - existing)
+        )
+        h = 2.0 * demand_u / (x * x * x + 1e-6) + a["w_churn"]
+        dx = jnp.clip(g / (h + 1e-3), -8.0, 8.0)
+        return x - dx
+
+    return newton, project
+
+
+@functools.cache
+def _compiled_capacity(d_pad: int, n_iters: int):
+    """One compiled program per (padded D, iteration count)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    newton, project = _capacity_step_fns(P_BUCKET)
+
+    def program(a: Dict[str, "jnp.ndarray"]):
+        x0 = jnp.clip(a["anchor"], a["lo"], a["hi"])
+        x0 = project(x0, a)
+
+        def step(_, x):
+            return project(newton(x, a), a)
+
+        x = lax.fori_loop(0, n_iters, step, x0)
+        # non-eligible rows report their anchor untouched
+        return jnp.where(a["elig"], x, a["anchor"])
+
+    return jax.jit(program)
+
+
+def _pad_bucket(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+def run_capacity_solve(inp: CapacityInputs) -> np.ndarray:
+    """The fractional relaxation on device: returns x[n] (total hosts per
+    distro, real-sized). Deterministic for fixed inputs."""
+    import jax
+
+    n = inp.n
+    D = _pad_bucket(max(n, 1))
+    lo, hi = inp.bounds()
+    f32 = np.float32
+
+    def pad(v, fill=0.0, dtype=f32):
+        out = np.full(D, fill, dtype)
+        out[:n] = v
+        return out
+
+    a = {
+        "demand_u": pad(inp.demand_units()),
+        "existing": pad(inp.existing),
+        "lo": pad(lo),
+        "hi": pad(hi),
+        "anchor": pad(
+            np.clip(inp.existing + inp.heuristic_new, lo, hi)
+        ),
+        "pool": pad(inp.pool, fill=P_BUCKET - 1, dtype=np.int32),
+        "elig": pad(inp.elig, fill=False, dtype=bool),
+        "price": inp.price.astype(f32),
+        "quota": inp.effective_quota().astype(f32),
+        "budget": f32(inp.effective_budget()),
+        "w_price": f32(inp.w_price),
+        "w_churn": f32(inp.w_churn),
+    }
+    fn = _compiled_capacity(D, int(inp.iterations))
+    out = jax.device_get(fn(a))
+    return np.asarray(out, dtype=np.float64)[:n]
+
+
+# --------------------------------------------------------------------------- #
+# Deterministic rounding + exact feasibility repair (host-side)
+# --------------------------------------------------------------------------- #
+
+
+def _marginal_loss(demand_u: float, t: float) -> float:
+    """Drain-time increase from removing one host at target ``t`` —
+    the greedy repair removes from the smallest-loss distro first."""
+    if t <= 1.0:
+        return demand_u * _BIG  # removing the last host is always worst
+    return demand_u / (t * (t - 1.0))
+
+
+def round_allocation(x: np.ndarray, inp: CapacityInputs) -> np.ndarray:
+    """Fractional x → integral per-distro host targets satisfying every
+    hard constraint exactly: box, per-pool effective quota, fleet
+    effective budget. Fully deterministic (largest-remainder add-back,
+    index tie-breaks; greedy smallest-marginal-loss repair)."""
+    n = inp.n
+    lo, hi = inp.bounds()
+    lo_i = np.ceil(lo - 1e-6).astype(np.int64)
+    hi_i = np.floor(hi + 1e-6).astype(np.int64)
+    hi_i = np.maximum(lo_i, hi_i)
+    demand_u = inp.demand_units()
+    quota = inp.effective_quota()
+    budget = inp.effective_budget()
+
+    t = np.clip(np.floor(x + 1e-6).astype(np.int64), lo_i, hi_i)
+    # ineligible rows are pass-through: the heuristic allocation stands
+    t = np.where(inp.elig, t, (inp.existing + inp.heuristic_new).astype(
+        np.int64))
+
+    def pool_use():
+        use = np.zeros(P_BUCKET, np.int64)
+        np.add.at(use, inp.pool[inp.elig], t[inp.elig])
+        return use
+
+    def fleet_inc():
+        inc = np.maximum(t - inp.existing.astype(np.int64), 0)
+        return int(inc[inp.elig].sum())
+
+    # largest-remainder add-back, bounded by box/quota/budget headroom
+    rem = x - np.floor(x + 1e-6)
+    order = sorted(
+        (i for i in range(n) if inp.elig[i]),
+        key=lambda i: (-rem[i], i),
+    )
+    use = pool_use()
+    inc_total = fleet_inc()
+    for i in order:
+        if t[i] >= hi_i[i]:
+            continue
+        p = int(inp.pool[i])
+        if use[p] + 1 > quota[p]:
+            continue
+        extra_inc = 1 if t[i] + 1 > inp.existing[i] else 0
+        if inc_total + extra_inc > budget:
+            continue
+        if rem[i] < 0.5 - 1e-9:
+            break  # remainders below half never round up
+        t[i] += 1
+        use[p] += 1
+        inc_total += extra_inc
+
+    # exact repair: pools over quota, then the fleet budget — remove the
+    # smallest-marginal-loss host each step, never below the hard minimum
+    def removable(i):
+        return inp.elig[i] and t[i] > lo_i[i]
+
+    use = pool_use()
+    for p in range(P_BUCKET):
+        while use[p] > quota[p]:
+            cands = [
+                i for i in range(n) if removable(i) and inp.pool[i] == p
+            ]
+            if not cands:
+                break  # min-hosts mass exceeds quota: mins win
+            i = min(
+                cands,
+                key=lambda j: (_marginal_loss(demand_u[j], float(t[j])), j),
+            )
+            t[i] -= 1
+            use[p] -= 1
+    while fleet_inc() > budget:
+        cands = [
+            i for i in range(n)
+            if removable(i) and t[i] > inp.existing[i]
+        ]
+        if not cands:
+            break
+        i = min(
+            cands,
+            key=lambda j: (_marginal_loss(demand_u[j], float(t[j])), j),
+        )
+        t[i] -= 1
+    return t
+
+
+def check_feasible(targets: np.ndarray, inp: CapacityInputs) -> List[str]:
+    """Hard-constraint audit of an integral allocation over the ELIGIBLE
+    rows; returns human-readable violations (empty = feasible)."""
+    problems: List[str] = []
+    lo, hi = inp.bounds()
+    lo_i = np.ceil(lo - 1e-6)
+    hi_i = np.maximum(lo_i, np.floor(hi + 1e-6))
+    for i in range(inp.n):
+        if not inp.elig[i]:
+            continue
+        if targets[i] < lo_i[i] - 1e-9:
+            problems.append(
+                f"{inp.distro_ids[i]}: {targets[i]} < min {lo_i[i]:.0f}"
+            )
+        if targets[i] > hi_i[i] + 1e-9:
+            problems.append(
+                f"{inp.distro_ids[i]}: {targets[i]} > max {hi_i[i]:.0f}"
+            )
+    quota = inp.effective_quota()
+    use = np.zeros(P_BUCKET)
+    np.add.at(use, inp.pool[inp.elig], targets[inp.elig])
+    for p in range(P_BUCKET):
+        if use[p] > quota[p] + 1e-9:
+            problems.append(
+                f"pool {pool_name_of(p)}: {use[p]:.0f} > quota {quota[p]:.0f}"
+            )
+    inc = np.maximum(targets - inp.existing, 0.0)
+    total_inc = float(inc[inp.elig].sum())
+    if total_inc > inp.effective_budget() + 1e-9:
+        problems.append(
+            f"fleet: {total_inc:.0f} new hosts > budget "
+            f"{inp.effective_budget():.0f}"
+        )
+    return problems
+
+
+def drain_seconds(
+    targets: np.ndarray, inp: CapacityInputs
+) -> Tuple[float, float]:
+    """(total, worst) time-to-empty over the eligible rows: each
+    distro's dependency-met work divided by its allocated hosts — the
+    objective the program minimizes and the metric the capacity-parity
+    gate compares against the heuristic."""
+    total = 0.0
+    worst = 0.0
+    for i in range(inp.n):
+        if not inp.elig[i]:
+            continue
+        tte = float(inp.demand_s[i]) / max(float(targets[i]), 1.0)
+        total += tte
+        worst = max(worst, tte)
+    return total, worst
+
+
+def heuristic_allocation(inp: CapacityInputs) -> np.ndarray:
+    """The per-distro utilization heuristic's implied targets
+    (existing + heuristic new hosts) — the fallback allocation and the
+    baseline the parity gate compares against."""
+    return (inp.existing + inp.heuristic_new).astype(np.int64)
+
+
+def solve_capacity(
+    inp: CapacityInputs,
+) -> Tuple[np.ndarray, np.ndarray, str]:
+    """The full pipeline: device relaxation → deterministic rounding →
+    matches-or-beats guard. Returns (targets, fractional x, chosen)
+    where ``chosen`` is "solver" or "heuristic".
+
+    The guard makes "matches or beats" true by construction: the solver
+    allocation is adopted only when it is feasible AND its total drain
+    does not regress the heuristic's (or the heuristic itself violates
+    a pool/fleet constraint — the coupled caps the per-distro loop is
+    blind to — in which case the solver's feasible answer wins)."""
+    x = run_capacity_solve(inp)
+    targets = round_allocation(x, inp)
+    heur = heuristic_allocation(inp)
+    if check_feasible(targets, inp):
+        # the repair pass should make this unreachable; fail safe anyway
+        return heur, x, "heuristic"
+    heur_problems = check_feasible(heur, inp)
+    s_total, s_worst = drain_seconds(targets, inp)
+    h_total, h_worst = drain_seconds(heur, inp)
+    if heur_problems:
+        return targets, x, "solver"
+    if s_total <= h_total + 1e-6:
+        return targets, x, "solver"
+    return heur, x, "heuristic"

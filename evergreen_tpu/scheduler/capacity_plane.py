@@ -1,0 +1,365 @@
+"""Capacity plane driver: the tick-side consumer of ops/capacity.py.
+
+``run_tick`` hands this plane the tick's per-distro aggregates (the
+queue-info views and heuristic spawn counts it already computed) and
+gets back the spawn counts with every capacity-opted distro's count
+replaced by the joint program's answer. The plane owns:
+
+  * eligibility — a distro joins the joint solve only when it opted in
+    (``planner_settings.capacity == "tpu"``), is ephemeral, is not
+    disabled, is not a single-task distro (those allocate 1:1 with
+    dependency-met tasks, reference units/host_allocator.go:174-181 —
+    the bypass keeps identical semantics under either allocator), and
+    has ``maximum_hosts > 0`` (the heuristic's at-max early return
+    treats 0 as "never allocate");
+  * the circuit breaker — a raising or infeasible solve falls this tick
+    back to the heuristic counts (bit-identical: the dict is returned
+    untouched), and repeated failures open the breaker so later ticks
+    skip the device call entirely (the PR-1 shape, same knobs);
+  * provenance — every applied solve leaves a ``CapacityProvenance`` on
+    the store (``scheduler/provenance.py``) so "why did distro X get k
+    hosts" is answerable after the tick, and ``units/host_jobs.py``'s
+    drawdown pass can consume the same targets instead of re-deriving a
+    per-distro guess.
+
+Sharding: each shard's plane solves its own distros; the fleet-level
+coupling (one intent budget, one quota pool) arrives as the driver's
+per-shard slices (``TickOptions.intent_budget`` — an absolute budget
+the sharded plane computed against FLEET in-flight intents — and
+``TickOptions.capacity_quota_scale``, the 1/n_shards quota share), so
+the fleet-wide caps hold exactly even though the solve is per-shard.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models.distro import Distro
+from ..storage.store import Store
+from ..utils import metrics as _metrics
+
+CAPACITY_SOLVES = _metrics.counter(
+    "scheduler_capacity_solves_total",
+    "Capacity-plane joint solves by outcome: 'applied' (solver targets "
+    "adopted), 'matched' (solver chose the heuristic allocation), "
+    "'skipped' (no eligible distros / disabled).",
+    labels=("outcome",),
+)
+CAPACITY_FALLBACKS = _metrics.counter(
+    "scheduler_capacity_fallbacks_total",
+    "Ticks where the capacity plane fell back to the per-distro "
+    "utilization heuristic, by cause (breaker_open / solve_failed / "
+    "infeasible / degraded_tick).",
+    labels=("cause",),
+)
+CAPACITY_SOLVE_MS = _metrics.histogram(
+    "scheduler_capacity_solve_duration_ms",
+    "Wall time of the joint capacity solve (input build through "
+    "rounded, feasibility-checked targets).",
+)
+CAPACITY_INTENTS = _metrics.counter(
+    "scheduler_capacity_intents_total",
+    "New-host intents requested by the capacity plane, labeled by "
+    "provider pool.",
+    labels=("pool",),
+)
+
+#: breaker knobs mirror the solve breaker (scheduler/wrapper.py)
+CAPACITY_BREAKER_THRESHOLD = 3
+CAPACITY_BREAKER_COOLDOWN_S = 60.0
+
+
+class CapacityPlane:
+    """Per-store capacity solver wrapper (see module docstring)."""
+
+    def __init__(self, store: Store) -> None:
+        from ..utils.circuit import CircuitBreaker
+
+        self.store = store
+        self.breaker = CircuitBreaker(
+            "scheduler.capacity",
+            failure_threshold=CAPACITY_BREAKER_THRESHOLD,
+            cooldown_s=CAPACITY_BREAKER_COOLDOWN_S,
+        )
+
+    # -- eligibility --------------------------------------------------------- #
+
+    @staticmethod
+    def eligible(d: Distro, packed_cols=None) -> bool:
+        from .wrapper import ALIAS_SUFFIX
+
+        # the opt-in bit prefers the packed d_cap_on column when this
+        # tick's solve shipped one (the capacity inputs ride the arena
+        # buffer); serial/cmp ticks re-derive from the distro object
+        if packed_cols is not None and d.id in packed_cols:
+            opted = packed_cols[d.id][1]
+        else:
+            opted = d.planner_settings.capacity == "tpu"
+        return (
+            opted
+            and not d.id.endswith(ALIAS_SUFFIX)
+            and d.is_ephemeral()
+            and not d.disabled
+            and not getattr(d, "single_task_distro", False)
+            and d.host_allocator_settings.maximum_hosts > 0
+        )
+
+    # -- the tick hook ------------------------------------------------------- #
+
+    def apply(
+        self,
+        distros: List[Distro],
+        infos: Dict[str, object],
+        new_hosts: Dict[str, int],
+        hosts_by_distro: Dict[str, List],
+        now: float,
+        degraded: bool = False,
+        quota_scale: float = 1.0,
+        intent_budget: Optional[int] = None,
+        packed_cols: Optional[Dict[str, tuple]] = None,
+    ) -> Dict[str, int]:
+        """Replace eligible distros' heuristic spawn counts with the
+        joint solve's; ANY failure returns ``new_hosts`` untouched (the
+        bit-identical heuristic fallback the breaker gate pins) and
+        marks the last provenance stale so the drawdown cron stops
+        steering by targets nothing is maintaining anymore.
+
+        ``packed_cols`` is the solve tick's distro id → (d_pool,
+        d_cap_on) read off the packed buffer (scheduler/wrapper.py);
+        absent on serial/cmp ticks, where the plane re-derives both
+        from the distro objects."""
+        from ..settings import CapacityConfig
+        from ..utils import faults
+        from ..utils.log import get_logger
+        from .provenance import CapacityProvenance
+
+        def mark_stale() -> None:
+            # keep the decomposition answerable on the admin surface,
+            # but stop host_drawdown consuming targets the plane is no
+            # longer maintaining
+            prev = getattr(self.store, "_last_capacity", None)
+            if prev is not None:
+                prev.stale = True
+
+        def fallback(cause: str) -> Dict[str, int]:
+            CAPACITY_FALLBACKS.inc(cause=cause)
+            mark_stale()
+            return new_hosts
+
+        cfg = CapacityConfig.get(self.store)
+        if not cfg.enabled:
+            # the master switch flipped off: old targets must stop
+            # steering drawdown immediately, same as a solver fallback
+            CAPACITY_SOLVES.inc(outcome="skipped")
+            mark_stale()
+            return new_hosts
+        elig_distros = [
+            d for d in distros
+            if self.eligible(d, packed_cols)
+            and d.id in new_hosts and d.id in infos
+        ]
+        if not elig_distros:
+            CAPACITY_SOLVES.inc(outcome="skipped")
+            mark_stale()
+            return new_hosts
+        if degraded:
+            # the planning solve already fell back to the serial oracle
+            # this tick; the capacity program's inputs would be stale —
+            # the heuristic counts stand
+            return fallback("degraded_tick")
+        if not self.breaker.allow(now=now):
+            return fallback("breaker_open")
+
+        t0 = _time.perf_counter()
+        # On a mixed fleet the NON-capacity distros draw from the same
+        # tick intent budget in the wrapper's creation loop: reserve
+        # their heuristic wants up front so solver wants + reserved
+        # wants ≤ budget and the first-come-first-served loop never
+        # clamps (and so never mangles the computed trade). If the
+        # reserved wants alone exhaust the budget, the solver correctly
+        # gets (almost) nothing.
+        solve_budget = intent_budget
+        if solve_budget is not None:
+            elig_ids = {d.id for d in elig_distros}
+            reserved = sum(
+                max(0, int(n)) for did, n in new_hosts.items()
+                if did not in elig_ids
+            )
+            solve_budget = max(0, int(solve_budget) - reserved)
+        try:
+            faults.fire("capacity.solve")
+            inp = self.build_inputs(
+                elig_distros, infos, new_hosts, hosts_by_distro, cfg,
+                quota_scale=quota_scale, intent_budget=solve_budget,
+                packed_cols=packed_cols,
+            )
+            from ..ops import capacity as cap_ops
+
+            targets, x, chosen = cap_ops.solve_capacity(inp)
+            problems = cap_ops.check_feasible(targets, inp)
+            if problems:
+                raise ValueError(
+                    "infeasible capacity targets: " + "; ".join(problems[:3])
+                )
+            # adoption stays INSIDE the guard: a raise in the
+            # provenance decomposition or the intent loop must degrade
+            # to the heuristic like any other capacity failure, never
+            # abort the tick (the wrapper calls apply() unguarded)
+            out = dict(new_hosts)
+            prov = CapacityProvenance.build(inp, targets, x, chosen, now)
+            for i, did in enumerate(inp.distro_ids):
+                intents = int(max(0, targets[i] - inp.existing[i]))
+                out[did] = intents
+                if intents:
+                    CAPACITY_INTENTS.inc(
+                        intents,
+                        pool=cap_ops.pool_name_of(int(inp.pool[i])),
+                    )
+        except Exception as exc:  # noqa: BLE001 — ANY capacity failure
+            # degrades to the heuristic; it must never touch the tick
+            self.breaker.record_failure(now=now, error=repr(exc))
+            cause = (
+                "infeasible"
+                if isinstance(exc, ValueError)
+                and "infeasible" in str(exc) else "solve_failed"
+            )
+            get_logger("resilience").error(
+                "capacity-solve-failed",
+                cause=cause,
+                error=repr(exc)[-300:],
+            )
+            return fallback(cause)
+        self.breaker.record_success(now=now)
+        CAPACITY_SOLVE_MS.observe((_time.perf_counter() - t0) * 1e3)
+        CAPACITY_SOLVES.inc(
+            outcome="applied" if chosen == "solver" else "matched"
+        )
+        self.store._last_capacity = prov
+        return out
+
+    # -- input construction -------------------------------------------------- #
+
+    def build_inputs(
+        self,
+        elig_distros: List[Distro],
+        infos: Dict[str, object],
+        new_hosts: Dict[str, int],
+        hosts_by_distro: Dict[str, List],
+        cfg,
+        quota_scale: float = 1.0,
+        intent_budget: Optional[int] = None,
+        packed_cols: Optional[Dict[str, tuple]] = None,
+    ):
+        """Problem instance from the tick's existing aggregates — the
+        info views (device outputs on solve ticks, dataclasses on
+        serial ones) expose the same three aggregate fields, so the
+        capacity program sees identical numbers either way. Pool
+        indices come off the packed d_pool column when the solve
+        shipped one."""
+        from ..globals import MAX_INTENT_HOSTS_IN_FLIGHT
+        from ..ops import capacity as cap_ops
+
+        n = len(elig_distros)
+        demand_s = np.zeros(n)
+        thresh_s = np.zeros(n)
+        existing = np.zeros(n)
+        free = np.zeros(n)
+        min_h = np.zeros(n)
+        max_h = np.zeros(n)
+        deps_met = np.zeros(n)
+        pool = np.zeros(n, np.int32)
+        heur = np.zeros(n)
+        for i, d in enumerate(elig_distros):
+            info = infos[d.id]
+            hosts = hosts_by_distro.get(d.id, [])
+            demand_s[i] = float(info.expected_duration_s)
+            thresh_s[i] = d.planner_settings.max_duration_per_host_s()
+            existing[i] = len(hosts)
+            free[i] = sum(1 for h in hosts if h.is_free())
+            min_h[i] = d.host_allocator_settings.minimum_hosts
+            max_h[i] = d.host_allocator_settings.maximum_hosts
+            deps_met[i] = int(info.length_with_dependencies_met)
+            pool[i] = (
+                packed_cols[d.id][0]
+                if packed_cols is not None and d.id in packed_cols
+                else cap_ops.pool_index_of(d.provider)
+            )
+            heur[i] = int(new_hosts.get(d.id, 0))
+
+        price = np.zeros(cap_ops.P_BUCKET)
+        quota = np.zeros(cap_ops.P_BUCKET)
+        prices = dict(cfg.pool_prices or {})
+        quotas = dict(cfg.pool_quotas or {})
+        if not prices:
+            from ..cloud.manager import default_pool_prices
+
+            prices = default_pool_prices()
+        # EXACT per-shard split: quota_scale = 1/n_shards; shard k gets
+        # q//n + (1 if k < q%n) so the shares sum to the configured
+        # quota precisely — a max(1, …) floor would let an N-shard
+        # plane exceed a small quota by up to N. A zero share must
+        # still mean "configured and closed", not 0 = unlimited: the
+        # 0.5 sentinel is positive (the convention survives) but below
+        # one host, so the integral repair admits nothing above the
+        # hard-minimum mass on this shard.
+        n_shards = max(1, round(1.0 / quota_scale)) if (
+            0 < quota_scale < 1.0
+        ) else 1
+        shard_k = getattr(self.store, "shard_id", None) or 0
+        shard_k = shard_k % n_shards
+
+        def split(total: float) -> float:
+            whole = int(total)
+            share = whole // n_shards + (
+                1 if shard_k < whole % n_shards else 0
+            )
+            return float(share) if share > 0 else 0.5
+
+        for name, value in prices.items():
+            price[cap_ops.pool_index_of(name)] = float(value)
+        for name, value in quotas.items():
+            q = float(value)
+            quota[cap_ops.pool_index_of(name)] = split(q) if q > 0 else 0.0
+        budget = (
+            cfg.fleet_intent_budget
+            if cfg.fleet_intent_budget > 0 else MAX_INTENT_HOSTS_IN_FLIGHT
+        )
+        budget = split(float(budget))
+        if intent_budget is not None:
+            budget = min(budget, float(max(0, int(intent_budget))))
+        return cap_ops.CapacityInputs(
+            distro_ids=[d.id for d in elig_distros],
+            demand_s=demand_s,
+            thresh_s=thresh_s,
+            existing=existing,
+            free=free,
+            min_hosts=min_h,
+            max_hosts=max_h,
+            deps_met=deps_met,
+            pool=pool,
+            elig=np.ones(n, bool),
+            heuristic_new=heur,
+            price=price,
+            quota=quota,
+            fleet_budget=budget,
+            w_price=cfg.price_weight,
+            w_churn=cfg.preemption_cost,
+            iterations=cfg.iterations,
+        )
+
+
+#: per-store planes (same lifetime pattern as the solve breakers)
+_planes: Dict[int, tuple] = {}
+_planes_lock = __import__("threading").Lock()
+
+
+def capacity_plane_for(store: Store) -> CapacityPlane:
+    key = id(store)
+    with _planes_lock:
+        entry = _planes.get(key)
+        if entry is None or entry[0] is not store:
+            entry = (store, CapacityPlane(store))
+            _planes[key] = entry
+        return entry[1]

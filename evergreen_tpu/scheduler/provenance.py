@@ -163,3 +163,185 @@ def provenance_for(store) -> Optional[TickProvenance]:
     before the first solve tick, or after a serial/degraded tick that
     produced none — the previous solve tick's answer is kept)."""
     return getattr(store, "_last_provenance", None)
+
+
+# --------------------------------------------------------------------------- #
+# Capacity provenance: why did distro X get k hosts?
+# --------------------------------------------------------------------------- #
+
+
+class CapacityProvenance:
+    """Per-distro decomposition of the joint capacity solve
+    (ops/capacity.py via scheduler/capacity_plane.py): for every distro
+    in the program, the objective terms at its adopted target, which
+    constraint bound it, and — when a shared pool quota was binding —
+    the trade partners that gained what it gave up (or vice versa).
+    Kept as ``store._last_capacity`` and served by
+    ``GET /rest/v2/admin/capacity/{distro}``; ``units/host_jobs.py``'s
+    drawdown pass consumes ``target_hosts`` instead of re-deriving a
+    per-distro guess."""
+
+    __slots__ = ("at", "chosen", "fleet", "stale", "_rows")
+
+    def __init__(self, at: float, chosen: str, fleet: Dict,
+                 rows: Dict[str, Dict]) -> None:
+        self.at = at
+        self.chosen = chosen
+        self.fleet = fleet
+        #: set by the capacity plane when a later tick FELL BACK to the
+        #: heuristic: the decomposition stays answerable on the admin
+        #: surface, but ``target_hosts`` stops steering drawdown — the
+        #: heuristic owns the fleet again and shrinking toward a target
+        #: nothing maintains would re-create the grow/shrink fight
+        self.stale = False
+        self._rows = rows
+
+    @classmethod
+    def build(cls, inp, targets, x, chosen: str,
+              now: float) -> "CapacityProvenance":
+        """Decompose one solve. ``inp`` is the ops.capacity
+        CapacityInputs, ``targets`` the adopted integral allocation,
+        ``x`` the device relaxation's fractional answer."""
+        from ..ops import capacity as cap_ops
+
+        lo, hi = inp.bounds()
+        quota = inp.effective_quota()
+        budget = inp.effective_budget()
+        pool_use = np.zeros(cap_ops.P_BUCKET)
+        np.add.at(pool_use, inp.pool[inp.elig], targets[inp.elig])
+        inc = np.maximum(targets - inp.existing, 0.0)
+        fleet_used = float(inc[inp.elig].sum())
+        fleet_bound = fleet_used >= budget - 1e-9
+        anchor = inp.existing + inp.heuristic_new
+        demand_u = inp.demand_units()
+
+        rows: Dict[str, Dict] = {}
+        for i, did in enumerate(inp.distro_ids):
+            p = int(inp.pool[i])
+            t = float(targets[i])
+            binding = []
+            hi_i = max(np.ceil(lo[i] - 1e-6), np.floor(hi[i] + 1e-6))
+            demand_cap = inp.existing[i] + max(
+                inp.deps_met[i] - inp.free[i], 0.0
+            )
+            if t >= hi_i - 1e-9:
+                # which upper bound actually bit: the configured max or
+                # the heuristic's deps-met demand guard
+                binding.append(
+                    "demand" if demand_cap < inp.max_hosts[i] else "max"
+                )
+            elif t <= np.ceil(lo[i] - 1e-6) + 1e-9 and lo[i] > 0:
+                binding.append("min")
+            if quota[p] < cap_ops._BIG and pool_use[p] >= quota[p] - 1e-9:
+                binding.append("quota")
+            if fleet_bound and targets[i] > inp.existing[i]:
+                binding.append("fleet")
+            rows[did] = {
+                "distro": did,
+                "pool": cap_ops.pool_name_of(p),
+                "existing": int(inp.existing[i]),
+                "min_hosts": int(inp.min_hosts[i]),
+                "max_hosts": int(inp.max_hosts[i]),
+                "demand_s": round(float(inp.demand_s[i]), 1),
+                "deps_met": int(inp.deps_met[i]),
+                "heuristic_new": int(inp.heuristic_new[i]),
+                "target": int(targets[i]),
+                "intents": int(max(0, targets[i] - inp.existing[i])),
+                "fractional": round(float(x[i]), 3),
+                # the objective terms AT the adopted target — the
+                # decomposition of why k hosts and not k±1
+                "demand_term": round(
+                    float(demand_u[i]) / max(t, 1.0), 4
+                ),
+                "price_term": round(
+                    float(inp.w_price * inp.price[p] * t), 4
+                ),
+                "churn_term": round(
+                    float(
+                        0.5 * inp.w_churn * (t - inp.existing[i]) ** 2
+                    ),
+                    4,
+                ),
+                "binding": binding,
+                "partners": [],
+            }
+        # trade partners: within a quota-bound pool, who gained what a
+        # shrunk-vs-heuristic distro gave up (and vice versa)
+        for p in range(cap_ops.P_BUCKET):
+            members = [
+                i for i in range(inp.n) if int(inp.pool[i]) == p
+            ]
+            if len(members) < 2 or pool_use[p] < quota[p] - 1e-9:
+                continue
+            gained = [
+                inp.distro_ids[i] for i in members
+                if targets[i] > anchor[i]
+            ]
+            lost = [
+                inp.distro_ids[i] for i in members
+                if targets[i] < anchor[i]
+            ]
+            for i in members:
+                did = inp.distro_ids[i]
+                if targets[i] > anchor[i]:
+                    rows[did]["partners"] = [d for d in lost if d != did]
+                elif targets[i] < anchor[i]:
+                    rows[did]["partners"] = [
+                        d for d in gained if d != did
+                    ]
+        fleet = {
+            "chosen": chosen,
+            "budget": int(budget),
+            "new_hosts": int(fleet_used),
+            "n_distros": inp.n,
+            "pool_use": {
+                cap_ops.pool_name_of(p): int(pool_use[p])
+                for p in range(cap_ops.P_BUCKET)
+                if pool_use[p] > 0
+            },
+        }
+        return cls(now, chosen, fleet, rows)
+
+    # -- accessors ----------------------------------------------------------- #
+
+    def explain(self, distro_id: str) -> Optional[Dict]:
+        row = self._rows.get(distro_id)
+        if row is None:
+            return None
+        return {
+            **row, "chosen": self.chosen, "at": self.at,
+            "stale": self.stale,
+        }
+
+    def target_hosts(self, distro_id: str) -> Optional[int]:
+        if self.stale:
+            return None
+        row = self._rows.get(distro_id)
+        return None if row is None else int(row["target"])
+
+    def to_doc(self, limit: int = 50) -> Dict:
+        return {
+            "at": self.at,
+            "stale": self.stale,
+            "fleet": self.fleet,
+            "distros": [
+                self._rows[k]
+                for k in sorted(self._rows)[: max(0, int(limit))]
+            ],
+        }
+
+
+def capacity_provenance_for(store) -> Optional[CapacityProvenance]:
+    """The most recent applied capacity solve on this store (None before
+    the first one, or after the plane fell back — the last applied
+    answer is kept, stamped with its ``at`` time so consumers can
+    judge freshness)."""
+    return getattr(store, "_last_capacity", None)
+
+
+def explain_capacity(store, distro_id: str) -> Optional[Dict]:
+    """Why did ``distro_id`` get k hosts: the capacity program's term
+    decomposition + binding constraints for the distro, or None when no
+    capacity solve has run (or the distro was not in the program)."""
+    prov = capacity_provenance_for(store)
+    return None if prov is None else prov.explain(distro_id)

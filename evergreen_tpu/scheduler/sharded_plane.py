@@ -106,6 +106,18 @@ SHARD_REBALANCES = _metrics.counter(
     "overloaded source shard.",
     labels=("shard",),
 )
+SHARD_HANDOFFS_COMPACTED = _metrics.counter(
+    "scheduler_shard_handoffs_compacted_total",
+    "Fully-reconciled handoff record triples (released→primed→done) "
+    "removed from the shard stores at compaction checkpoints, labeled "
+    "by the source shard.",
+    labels=("shard",),
+)
+
+#: durable floor for the handoff sequence counter — compaction deletes
+#: the records the counter was recovered from, so the floor rides in a
+#: sentinel doc (state="watermark") the loaders skip for ownership
+HANDOFF_WATERMARK_ID = "__handoff_watermark__"
 
 
 # --------------------------------------------------------------------------- #
@@ -346,8 +358,12 @@ class ShardedScheduler:
         #: probe round, letting a transient spike's padding re-converge
         #: downward instead of inflating every solve forever
         self._floor_rounds = 0
-        #: monotone handoff sequence (recovered from durable records)
+        #: monotone handoff sequence (recovered from durable records +
+        #: the compaction watermark)
         self._seq = 0
+        #: completed tick rounds — drives the periodic handoff-record
+        #: compaction checkpoint
+        self._rounds = 0
         #: the cron/front store whose ladder receives the fleet fuse as
         #: a floor (attach_sharded_plane sets it)
         self.front_store: Optional[Store] = None
@@ -369,6 +385,8 @@ class ShardedScheduler:
 
     #: stacked rounds between downward floor re-probes
     _FLOOR_REPROBE_ROUNDS = 32
+    #: tick rounds between handoff-record compaction checkpoints
+    _COMPACT_EVERY_ROUNDS = 64
 
     # -- construction helpers ------------------------------------------- #
 
@@ -565,6 +583,12 @@ class ShardedScheduler:
         barrier_s = self.barrier_timeout_s
         if base_opts.solve_deadline_s > 0:
             barrier_s = min(barrier_s, base_opts.solve_deadline_s * 0.5)
+        # ONE fleet intent budget: the global in-flight cap is counted
+        # across EVERY shard store and the remainder split per shard —
+        # run_tick's own accounting sees only its shard's intents, so
+        # without this an N-shard plane over-spawns ~N× the cap. The
+        # same split scales the capacity plane's pool quotas/budget.
+        shard_budgets = self._split_intent_budget(base_opts)
         with self._lock:
             round_ = (
                 _StackedRound(
@@ -581,7 +605,13 @@ class ShardedScheduler:
                     self._floor_rounds = 0
 
             def one(k: int) -> TickResult:
-                opts = base_opts
+                opts = dataclasses.replace(
+                    base_opts,
+                    intent_budget=shard_budgets[k],
+                    capacity_quota_scale=(
+                        base_opts.capacity_quota_scale / self.n_shards
+                    ),
+                )
                 if round_ is not None:
                     # the stacked path packs fresh per round at the
                     # plane's common dims floor (not the per-store
@@ -612,6 +642,11 @@ class ShardedScheduler:
             migrations: List[dict] = []
             if self.rebalance_enabled:
                 migrations = self._rebalance_locked(results, now)
+            # periodic compaction checkpoint: fully-reconciled handoff
+            # triples stop accumulating in the shard WAL segments
+            self._rounds += 1
+            if self._rounds % self._COMPACT_EVERY_ROUNDS == 0:
+                self.compact_handoffs()
 
         fleet = self.fleet_level()
         if self.front_store is not None:
@@ -629,6 +664,25 @@ class ShardedScheduler:
             fleet_level=overload_mod.level_name(fleet),
         )
         return out
+
+    def _split_intent_budget(self, opts: TickOptions) -> List[Optional[int]]:
+        """The fleet intent budget, netted against in-flight intents in
+        EVERY shard store, split evenly per shard (remainder to the
+        lowest shard ids — deterministic). Returns per-shard absolute
+        budgets, or all-None when intents are off this round."""
+        if not opts.create_intent_hosts:
+            return [None] * self.n_shards
+        from ..models import host as host_mod
+
+        if opts.intent_budget is not None:
+            fleet = max(0, int(opts.intent_budget))
+        else:
+            in_flight = sum(
+                host_mod.count_intents_in_flight(s) for s in self.stores
+            )
+            fleet = max(0, opts.max_intent_hosts - in_flight)
+        share, rem = divmod(fleet, self.n_shards)
+        return [share + (1 if k < rem else 0) for k in range(self.n_shards)]
 
     # -- stacked solve ---------------------------------------------------- #
 
@@ -967,11 +1021,16 @@ class ShardedScheduler:
     def _load_handoff_state(self) -> None:
         """Rebuild ownership overrides + the seq counter from the durable
         handoff records (any state ≥ released means the target owns the
-        group — reconciliation below guarantees the prime completes)."""
+        group — reconciliation below guarantees the prime completes).
+        The compaction watermark doc only floors the seq counter:
+        compacted groups' ownership is re-derived from where their
+        documents actually live (``owner_of`` self-heals and pins)."""
         latest: Dict[str, tuple] = {}
         for store in self.stores:
             for doc in store.collection(HANDOFFS_COLLECTION).find():
                 self._seq = max(self._seq, int(doc.get("seq", 0)))
+                if doc.get("state") == "watermark":
+                    continue
                 for did in doc.get("group", [doc.get("distro", "")]):
                     cur = latest.get(did)
                     if cur is None or doc["seq"] > cur[0]:
@@ -979,6 +1038,48 @@ class ShardedScheduler:
         for did, (_seq, to) in latest.items():
             if 0 <= to < self.n_shards:
                 self.topology.overrides[did] = to
+
+    def compact_handoffs(self) -> int:
+        """Drop fully-reconciled handoff triples: a source record that
+        reached ``done`` whose target holds the matching ``primed``
+        record has nothing left to converge — both documents (and their
+        embedded payload copies) are removed, and a watermark sentinel
+        keeps the seq counter monotone across reopen. Runs at the
+        periodic round checkpoint and on ``close()``; returns the
+        number of triples compacted."""
+        compacted = 0
+        for src_id, store in enumerate(self.stores):
+            coll = store.collection(HANDOFFS_COLLECTION)
+            done = list(coll.find(lambda d: d.get("state") == "done"))
+            if not done:
+                continue
+            high = 0
+            for doc in done:
+                to = int(doc.get("to", -1))
+                if not (0 <= to < self.n_shards):
+                    continue
+                tgt_coll = self.stores[to].collection(HANDOFFS_COLLECTION)
+                primed = tgt_coll.get(doc["_id"])
+                if primed is None or primed.get("state") != "primed":
+                    continue  # not a reconciled triple yet — keep both
+                tgt_coll.remove(doc["_id"])
+                coll.remove(doc["_id"])
+                high = max(high, int(doc.get("seq", 0)))
+                compacted += 1
+                SHARD_HANDOFFS_COMPACTED.inc(shard=src_id)
+            if high:
+                wm = coll.get(HANDOFF_WATERMARK_ID) or {
+                    "_id": HANDOFF_WATERMARK_ID,
+                    "state": "watermark",
+                    "seq": 0,
+                }
+                if high > int(wm.get("seq", 0)):
+                    coll.upsert({**wm, "seq": high})
+        if compacted:
+            get_logger("scheduler").info(
+                "handoffs-compacted", n=compacted
+            )
+        return compacted
 
     def reconcile_handoffs(self, now: Optional[float] = None) -> List[str]:
         """Converge every mid-flight handoff to exactly-one-owner (run at
@@ -1016,7 +1117,14 @@ class ShardedScheduler:
         """Shut the worker pool AND the durability resources the plane
         owns: each durable shard store is closed (final group commit +
         checkpoint) and its lease released, so a reopened fleet never
-        waits out stale lease TTLs."""
+        waits out stale lease TTLs. Reconciled handoff triples are
+        compacted first — the close-time snapshot checkpoint then
+        persists the trimmed collection instead of the full history."""
+        try:
+            self.compact_handoffs()
+        except Exception:  # noqa: BLE001 — compaction is housekeeping;
+            # it must never block shutdown
+            pass
         self._pool.shutdown(wait=False)
         for s in self.stores:
             if getattr(s, "data_dir", None) is not None:

@@ -324,6 +324,12 @@ FIELD_KINDS: Dict[str, str] = {
     "d_cq_factor": "f32", "d_mainline_tiq_factor": "f32",
     "d_runtime_factor": "f32", "d_generate_factor": "f32",
     "d_numdep_factor": "f32", "d_stepback_factor": "f32",
+    # capacity plane (ops/capacity.py): the distro's provider-pool index
+    # and its joint-solve opt-in flag ride the packed buffer like every
+    # other settings column — the resident plane maintains them through
+    # the shared pack_distro_settings fill, and the sharded stacked
+    # round ships them to the device with the rest of the d-matrix
+    "d_pool": "i32", "d_cap_on": "u8",
 }
 
 _DIM_OF_FIELD = {
@@ -390,6 +396,10 @@ def pack_distro_settings(a: Dict[str, np.ndarray], distros) -> None:
     fill("d_generate_factor", [_factor(p.generate_task_factor) for p in ps_l])
     fill("d_numdep_factor", [_factor(p.num_dependents_factor) for p in ps_l])
     fill("d_stepback_factor", [_factor(p.stepback_task_factor) for p in ps_l])
+    from ..ops.capacity import pool_index_of
+
+    fill("d_pool", [pool_index_of(d.provider) for d in distros])
+    fill("d_cap_on", [p.capacity == "tpu" for p in ps_l])
 
 
 #: time-independent per-task columns memcpy'd from the static memo into

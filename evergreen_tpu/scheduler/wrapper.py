@@ -20,7 +20,6 @@ import numpy as np
 from ..globals import (
     MAX_INTENT_HOSTS_IN_FLIGHT,
     UNDERWATER_UNSCHEDULE_THRESHOLD_S,
-    HostStatus,
     PlannerVersion,
 )
 from ..models import distro as distro_mod
@@ -62,6 +61,12 @@ TICK_PHASE_MS = _metrics.histogram(
     "/ unpack / persist / wal_commit).",
     labels=("phase",),
 )
+INTENT_BUDGET_CLAMPED = _metrics.counter(
+    "scheduler_intent_budget_clamped_total",
+    "Requested intent hosts NOT created because the in-flight intent "
+    "budget (fleet-wide under sharding) was exhausted — each unit is "
+    "one host the allocator wanted but the cap rejected.",
+)
 
 
 #: distro-id suffix marking secondary (alias) queue rows in the solve
@@ -81,6 +86,18 @@ class TickOptions:
     create_intent_hosts: bool = True
     #: global cap on in-flight intent hosts (units/host_allocator.go:35)
     max_intent_hosts: int = MAX_INTENT_HOSTS_IN_FLIGHT
+    #: ABSOLUTE intent budget for THIS tick, already netted against
+    #: fleet-wide in-flight intents by the caller (the sharded plane
+    #: splits one fleet budget across shards this way — without it each
+    #: shard counts only its own store's intents and an N-shard plane
+    #: can over-spawn ~N× the cap). None = the classic computation
+    #: against this store's in-flight count.
+    intent_budget: Optional[int] = None
+    #: capacity plane (scheduler/capacity_plane.py): fraction of the
+    #: configured pool quotas / fleet capacity budget THIS scheduler may
+    #: use — the sharded plane passes 1/n_shards so the fleet-wide caps
+    #: hold exactly across per-shard solves
+    capacity_quota_scale: float = 1.0
     #: incremental runnable-set maintenance between ticks (scheduler/cache.py)
     use_cache: bool = False
     #: device-resident state plane (scheduler/resident.py): keep the
@@ -802,6 +819,17 @@ def _run_tick_body(
     degraded = "persist-failed" if prior_persist_failed else ""
     shed: List[str] = []
     provenance = None
+    #: distro id → (pool index, capacity opt-in) read off the packed
+    #: d_pool / d_cap_on buffer columns on solve ticks (the capacity
+    #: plane's inputs ride the arena like every other settings column);
+    #: None on serial/cmp ticks — the plane re-derives from the distros
+    capacity_cols = None
+    #: True when a tick that WANTED the device solve fell back to the
+    #: serial oracle (raise/deadline/breaker) — distinct from the
+    #: ``degraded`` string, which an earlier persist-failed can mask;
+    #: the capacity plane must not solve on top of oracle-fallback
+    #: numbers, but a deliberately serial-planned tick is fine
+    solve_degraded = False
     from ..utils import faults
     from ..utils.log import get_logger
 
@@ -818,6 +846,7 @@ def _run_tick_body(
     breaker = solve_breaker_for(store) if want_tpu else None
     if want_tpu and not breaker.allow(now=now):
         want_tpu = False
+        solve_degraded = True
         degraded = degraded or "breaker-open"
         TICK_DEGRADED.inc(cause="breaker_open")
         _rlog.warning(
@@ -877,11 +906,21 @@ def _run_tick_body(
                 (_time.perf_counter() - t_u) * 1e3, phase="unpack"
             )
             pstate.note_solve_infos(*info_epoch)
+            # copy the two capacity settings columns out while the
+            # arena views are still this tick's (the lease returns in
+            # the finally below; next tick may re-zero the buffers)
+            _dpool = np.asarray(snapshot.arrays["d_pool"])
+            _dcap = np.asarray(snapshot.arrays["d_cap_on"])
+            capacity_cols = {
+                did: (int(_dpool[i]), bool(_dcap[i]))
+                for i, did in enumerate(snapshot.distro_ids)
+            }
             planner_used = "tpu"
             breaker.record_success(now=now)
         except Exception as exc:  # noqa: BLE001 — ANY solve-path failure
             # degrades the tick; it must never kill it
             want_tpu = False
+            solve_degraded = True
             degraded = degraded or (
                 "solve-deadline" if isinstance(exc, TimeoutError)
                 else "solve-failed"
@@ -897,6 +936,7 @@ def _run_tick_body(
             plans, sort_values, infos, met_cols = {}, {}, {}, {}
             new_hosts = {}
             provenance = None
+            capacity_cols = None
         finally:
             # return the pool-leased transfer arena even when the solve
             # raised (a fault-injected failure must not strand the slot —
@@ -961,18 +1001,36 @@ def _run_tick_body(
             cap = d.host_allocator_settings.maximum_hosts or demand
             new_hosts[d.id] = max(0, min(demand, cap - existing))
 
-    # Persist queues + create intent hosts (scheduler/scheduler.go:176-220),
-    # honoring the global intent-host cap (units/host_allocator.go:35).
-    # A host-side failure while persisting ONE distro's queue must not
-    # abandon every other distro's plan (WAL errors now surface at the
-    # batched group commit below, with their own degradation path).
-    if opts.create_intent_hosts:
-        n_intents_in_flight = host_mod.coll(store).count(
-            lambda doc: doc["status"] == HostStatus.UNINITIALIZED.value
+    # The tick's intent budget, computed BEFORE the capacity hook so the
+    # joint solve optimizes within exactly the allowance the creation
+    # loop below will enforce — otherwise the first-come-first-served
+    # clamp would mangle the trade the program computed.
+    if opts.create_intent_hosts and opts.intent_budget is not None:
+        # fleet-accounted budget from the sharded driver: counting this
+        # store's own intents again would double-charge the shard
+        budget = max(0, int(opts.intent_budget))
+    elif opts.create_intent_hosts:
+        budget = max(
+            0,
+            opts.max_intent_hosts - host_mod.count_intents_in_flight(store),
         )
-        budget = max(0, opts.max_intent_hosts - n_intents_in_flight)
     else:
         budget = 0  # the 4k-host scan is pure cost when intents are off
+
+    # Capacity plane: distros opted into the joint (distros × pools)
+    # program get their heuristic spawn counts replaced by the batched
+    # device solve's; any failure leaves the heuristic counts untouched
+    # (scheduler/capacity_plane.py owns the breaker + fallback).
+    if opts.create_intent_hosts and new_hosts:
+        from .capacity_plane import capacity_plane_for
+
+        new_hosts = capacity_plane_for(store).apply(
+            distros, infos, new_hosts, hosts_by_distro, now,
+            degraded=solve_degraded,
+            quota_scale=opts.capacity_quota_scale,
+            intent_budget=budget,
+            packed_cols=capacity_cols,
+        )
 
     # Brownout: at RED or worse the ladder sheds the tick's optional
     # work (stats, event emission) up front — the same work the tick
@@ -1048,7 +1106,13 @@ def _run_tick_body(
             if is_alias:
                 continue  # alias rows never spawn hosts (units/scheduler_alias.go)
             if opts.create_intent_hosts:
-                n = min(new_hosts.get(d.id, 0), budget)
+                want = new_hosts.get(d.id, 0)
+                n = min(want, budget)
+                if want > n:
+                    # the allocator asked for more than the in-flight
+                    # budget allows: count every rejected host so a
+                    # starved fleet budget is visible, never silent
+                    INTENT_BUDGET_CLAMPED.inc(want - n)
                 budget -= n
                 created = []
                 try:

@@ -187,6 +187,62 @@ class ShardingConfig(ConfigSection):
 
 @register_section
 @dataclasses.dataclass
+class CapacityConfig(ConfigSection):
+    """Capacity-plane knobs (ops/capacity.py program weights + the pool
+    vocabulary's prices and quotas; consumed by
+    scheduler/capacity_plane.py). Pools are providers — a distro's
+    hosts can only come from its own provider — so both dicts are keyed
+    by provider name ("ec2-fleet", "docker", …). Per-distro opt-in is
+    separate: ``planner_settings.capacity = "tpu"`` on the distro. See
+    docs/DEPLOY.md "Capacity plane tuning"."""
+
+    section_id = "capacity"
+
+    #: master switch; off = every distro uses the per-distro heuristic
+    enabled: bool = True
+    #: relative $/host-hour per pool; empty falls back to the provider
+    #: defaults (cloud/manager.py default_pool_prices)
+    pool_prices: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: hard per-pool host caps over capacity-managed distros (0/absent =
+    #: unlimited)
+    pool_quotas: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: weight of the provider-price objective term (0 = drain-only).
+    #: Keep both weights small relative to the drain term's marginal
+    #: host value (demand/x² in threshold units) or the program pins
+    #: every distro to its current fleet — see DEPLOY.md.
+    price_weight: float = 0.02
+    #: weight of the churn/preemption term penalizing targets far from
+    #: the current fleet (spawn storms AND drawdown storms); quadratic
+    #: in the host delta, so keep it small — it is a tiebreaker, not a
+    #: rival of the drain term
+    preemption_cost: float = 0.001
+    #: fleet-wide cap on new hosts one capacity solve may request
+    #: (0 → globals.MAX_INTENT_HOSTS_IN_FLIGHT)
+    fleet_intent_budget: int = 0
+    #: damped-Newton + projection sweeps on device
+    iterations: int = 48
+
+    def validate_and_default(self) -> str:
+        if self.price_weight < 0 or self.preemption_cost < 0:
+            return "capacity weights must be >= 0"
+        if self.fleet_intent_budget < 0:
+            return "fleet_intent_budget must be >= 0"
+        if not isinstance(self.iterations, int) or not (
+            1 <= self.iterations <= 512
+        ):
+            return "iterations must be an int in [1, 512]"
+        for name, d in (("pool_prices", self.pool_prices),
+                        ("pool_quotas", self.pool_quotas)):
+            if not isinstance(d, dict):
+                return f"{name} must be a mapping"
+            for k, v in d.items():
+                if not isinstance(v, (int, float)) or v < 0:
+                    return f"{name}[{k!r}] must be a number >= 0"
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
 class TaskLimitsConfig(ConfigSection):
     """reference config_task_limits.go."""
 

@@ -279,11 +279,34 @@ def _terminate(store: Store, h, reason: str, now: float) -> None:
     )
 
 
+#: capacity-plane targets older than this fall back to the queue-demand
+#: heuristic (a stale joint solve must not drive terminations)
+CAPACITY_TARGET_TTL_S = 10 * 60.0
+
+DRAWDOWN_CAPACITY_TARGETS = _metrics.counter(
+    "hosts_drawdown_capacity_targets_total",
+    "Drawdown passes where a distro's surplus was computed against the "
+    "capacity plane's joint-solve target instead of the per-distro "
+    "queue-demand heuristic.",
+    legacy="hosts.drawdown_capacity_targets",
+)
+
+
 def host_drawdown(store: Store, now: Optional[float] = None) -> List[str]:
     """Overallocation feedback: when the latest queue needs far fewer hosts
     than exist, terminate free surplus (reference units/host_drawdown.go,
-    populated from allocator feedback units/host_allocator.go:327-334)."""
+    populated from allocator feedback units/host_allocator.go:327-334).
+
+    Distros managed by the capacity plane shrink toward the JOINT
+    solve's target instead of the per-distro queue-demand guess — the
+    drawdown side of the same program whose intents grow the fleet, so
+    grow and shrink can never fight across a shared pool."""
     now = _time.time() if now is None else now
+    from ..scheduler.provenance import capacity_provenance_for
+
+    cap = capacity_provenance_for(store)
+    if cap is not None and now - cap.at > CAPACITY_TARGET_TTL_S:
+        cap = None
     reaped: List[str] = []
     for d in distro_mod.find_all(store):
         if not d.is_ephemeral():
@@ -293,10 +316,22 @@ def host_drawdown(store: Store, now: Optional[float] = None) -> List[str]:
             != OverallocatedRule.TERMINATE.value
         ):
             continue
-        queue = tq_mod.load(store, d.id)
-        demand = queue.info.length_with_dependencies_met if queue else 0
         hosts = host_mod.all_active_hosts(store, d.id)
         min_hosts = d.host_allocator_settings.minimum_hosts
+        # only distros CURRENTLY opted into the joint program follow
+        # its target — an opt-out must revert shrink decisions to the
+        # queue-demand heuristic immediately, not after the TTL
+        target = (
+            cap.target_hosts(d.id)
+            if cap is not None and d.planner_settings.capacity == "tpu"
+            else None
+        )
+        if target is not None:
+            DRAWDOWN_CAPACITY_TARGETS.inc()
+            demand = target
+        else:
+            queue = tq_mod.load(store, d.id)
+            demand = queue.info.length_with_dependencies_met if queue else 0
         surplus = len(hosts) - max(demand, min_hosts)
         if surplus <= 0:
             continue

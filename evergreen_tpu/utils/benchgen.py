@@ -153,6 +153,7 @@ def bench_result_payload(
     overload_counters: dict = None,
     resident: dict = None,
     sharded_plane: dict = None,
+    capacity: dict = None,
 ) -> dict:
     """The BENCH JSON line. ``pipelined_tick_ms`` appears ONLY when the
     measured timeline proves the overlap (VERDICT r5 ask #3) — an
@@ -204,6 +205,13 @@ def bench_result_payload(
         out["sharded_plane"] = sharded_plane
         if "value" in sharded_plane:
             out["sharded_churn_tick_ms"] = sharded_plane["value"]
+    if capacity:
+        # the capacity-plane arm (bench.py measure_capacity): joint
+        # (distros × pools) solve latency inside real ticks + the
+        # intents-vs-heuristic delta summary from the provenance record
+        out["capacity"] = capacity
+        if "capacity_solve_ms" in capacity:
+            out["capacity_solve_ms"] = capacity["capacity_solve_ms"]
     if overlap_proven:
         out["pipelined_tick_ms"] = round(pipe_med, 2)
     return out

@@ -62,6 +62,7 @@ ALLOWED_LABELS = frozenset(
         "signal",      # overload monitor gauge name
         "outcome",     # success/failure-ish result buckets
         "shard",       # scheduler shard id (bounded by the shard count)
+        "pool",        # provider capacity pool (fixed Provider vocabulary)
     }
 )
 

@@ -1,0 +1,743 @@
+"""Capacity plane (ISSUE 9): the joint (distros × pools) host solve —
+program feasibility, capacity trading, the breaker's bit-identical
+heuristic fallback, allocator-bypass parity (alias / single-task /
+auto-tune), the fleet-wide intent budget under sharding, handoff-record
+compaction, and the provenance/REST surface."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from evergreen_tpu.globals import HostStatus, OverallocatedRule, Provider
+from evergreen_tpu.models import distro as distro_mod
+from evergreen_tpu.models import host as host_mod
+from evergreen_tpu.models import task as task_mod
+from evergreen_tpu.models.distro import (
+    Distro,
+    HostAllocatorSettings,
+    PlannerSettings,
+)
+from evergreen_tpu.models.host import Host
+from evergreen_tpu.models.task import Task
+from evergreen_tpu.ops import capacity as cap
+from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+from evergreen_tpu.settings import CapacityConfig
+from evergreen_tpu.storage.store import Store
+
+NOW = 1_700_000_000.0
+
+
+# --------------------------------------------------------------------------- #
+# helpers
+# --------------------------------------------------------------------------- #
+
+
+def make_tasks(did, n, dur=900.0):
+    return [
+        Task(
+            id=f"{did}-t{j}",
+            distro_id=did,
+            project="p",
+            version="v1",
+            build_variant="bv",
+            status="undispatched",
+            activated=True,
+            requester="gitter_request",
+            activated_time=NOW - 600,
+            create_time=NOW - 700,
+            scheduled_time=NOW - 600,
+            expected_duration_s=dur,
+        )
+        for j in range(n)
+    ]
+
+
+def seed(store, spec, capacity="tpu", max_hosts=50, **distro_kw):
+    """spec: [(distro_id, n_tasks), ...]"""
+    for did, n in spec:
+        distro_mod.insert(
+            store,
+            Distro(
+                id=did,
+                provider=Provider.MOCK.value,
+                planner_settings=PlannerSettings(capacity=capacity),
+                host_allocator_settings=HostAllocatorSettings(
+                    maximum_hosts=max_hosts
+                ),
+                **distro_kw,
+            ),
+        )
+        task_mod.insert_many(store, make_tasks(did, n))
+
+
+def two_distro_inputs(quota=10.0, **overrides):
+    pool = cap.pool_index_of("mock")
+    q = np.zeros(cap.P_BUCKET)
+    q[pool] = quota
+    kw = dict(
+        distro_ids=["deep", "shallow"],
+        demand_s=np.array([30_000.0, 1_800.0]),
+        thresh_s=np.full(2, 1800.0),
+        existing=np.array([2.0, 2.0]),
+        free=np.zeros(2),
+        min_hosts=np.ones(2),
+        max_hosts=np.full(2, 20.0),
+        deps_met=np.array([40.0, 10.0]),
+        pool=np.full(2, pool, np.int32),
+        elig=np.ones(2, bool),
+        heuristic_new=np.array([14.0, 6.0]),
+        price=np.zeros(cap.P_BUCKET),
+        quota=q,
+        fleet_budget=100.0,
+    )
+    kw.update(overrides)
+    return cap.CapacityInputs(**kw)
+
+
+# --------------------------------------------------------------------------- #
+# the program itself
+# --------------------------------------------------------------------------- #
+
+
+def test_pool_vocabulary_is_fixed_and_padded():
+    # the pool index must be a pure function of the provider string so
+    # every shard/process agrees without coordination
+    assert cap.pool_index_of("mock") == list(Provider).index(Provider.MOCK)
+    assert cap.pool_index_of("no-such-provider") == cap.P_BUCKET - 1
+    assert len(cap.POOL_NAMES) < cap.P_BUCKET
+    assert cap.pool_name_of(cap.pool_index_of("docker")) == "docker"
+
+
+def test_trading_reallocates_within_shared_quota():
+    inp = two_distro_inputs()
+    targets, x, chosen = cap.solve_capacity(inp)
+    # the per-distro heuristic over-asks the shared pool (it cannot see
+    # the coupling); the joint solve fills the quota exactly and gives
+    # the deep queue the larger share
+    assert cap.check_feasible(cap.heuristic_allocation(inp), inp)
+    assert chosen == "solver"
+    assert not cap.check_feasible(targets, inp)
+    assert targets.sum() == 10
+    assert targets[0] > targets[1]
+
+
+def test_uncoupled_solve_matches_or_beats_heuristic():
+    inp = two_distro_inputs(quota=0.0)  # 0 = unlimited
+    targets, _, _ = cap.solve_capacity(inp)
+    assert not cap.check_feasible(targets, inp)
+    s_total, _ = cap.drain_seconds(targets, inp)
+    h_total, _ = cap.drain_seconds(cap.heuristic_allocation(inp), inp)
+    assert s_total <= h_total + 1e-6
+
+
+def test_fleet_budget_caps_total_increments():
+    inp = two_distro_inputs(quota=0.0, fleet_budget=5.0)
+    targets, _, _ = cap.solve_capacity(inp)
+    assert not cap.check_feasible(targets, inp)
+    inc = np.maximum(targets - inp.existing, 0)
+    assert inc.sum() <= 5
+
+
+def test_min_hosts_win_over_quota_and_budget():
+    # mins sum to 8 against a quota of 4 and budget 0: the effective
+    # caps floor at the min mass and every row still lands on its min
+    inp = two_distro_inputs(
+        quota=4.0,
+        fleet_budget=0.0,
+        min_hosts=np.array([5.0, 3.0]),
+        existing=np.zeros(2),
+        heuristic_new=np.zeros(2),
+    )
+    targets, _, _ = cap.solve_capacity(inp)
+    assert not cap.check_feasible(targets, inp)
+    assert targets[0] >= 5 and targets[1] >= 3
+
+
+def test_rounding_repair_is_deterministic():
+    inp = two_distro_inputs()
+    x = cap.run_capacity_solve(inp)
+    t1 = cap.round_allocation(x, inp)
+    t2 = cap.round_allocation(x.copy(), inp)
+    assert (t1 == t2).all()
+
+
+def test_ineligible_rows_pass_through_heuristic():
+    inp = two_distro_inputs(elig=np.array([True, False]))
+    targets, _, _ = cap.solve_capacity(inp)
+    # the ineligible row keeps existing + heuristic_new untouched
+    assert targets[1] == int(inp.existing[1] + inp.heuristic_new[1])
+
+
+# --------------------------------------------------------------------------- #
+# tick integration
+# --------------------------------------------------------------------------- #
+
+
+def test_tick_applies_joint_solve_under_quota(store):
+    seed(store, [("deep", 30), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 8}).set(store)
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert res.degraded == ""
+    assert sum(res.new_hosts.values()) <= 8
+    assert res.new_hosts["deep"] > res.new_hosts["shallow"]
+    assert len(res.intent_hosts) == sum(res.new_hosts.values())
+
+
+def test_tick_without_opt_in_is_pure_heuristic(store):
+    seed(store, [("deep", 30), ("shallow", 3)], capacity="")
+    CapacityConfig(pool_quotas={"mock": 8}).set(store)
+    res = run_tick(store, TickOptions(), now=NOW)
+    # nobody opted in: the quota section exists but the per-distro
+    # heuristic runs untouched (and no capacity provenance appears)
+    from evergreen_tpu.scheduler.provenance import capacity_provenance_for
+
+    assert sum(res.new_hosts.values()) > 8
+    assert capacity_provenance_for(store) is None
+
+
+def test_tick_with_section_disabled_is_pure_heuristic(store):
+    seed(store, [("deep", 30)])
+    CapacityConfig(enabled=False, pool_quotas={"mock": 2}).set(store)
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert sum(res.new_hosts.values()) > 2
+
+
+def test_breaker_fallback_is_bit_identical_heuristic(store):
+    from evergreen_tpu.scheduler.capacity_plane import capacity_plane_for
+    from evergreen_tpu.utils import faults
+
+    ref_store = Store()
+    seed(ref_store, [("deep", 24), ("shallow", 3)], capacity="")
+    ref = run_tick(ref_store, TickOptions(), now=NOW)
+
+    seed(store, [("deep", 24), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 4}).set(store)
+    faults.install(
+        faults.FaultPlan().always("capacity.solve", faults.Fault("raise"))
+    )
+    try:
+        res = run_tick(store, TickOptions(), now=NOW)
+        # solver failure → the serial utilization heuristic's counts,
+        # bit for bit (the quota is NOT applied — that is the honest
+        # pre-capacity behavior the breaker restores)
+        assert res.new_hosts == ref.new_hosts
+        assert res.degraded == ""  # planning itself is untouched
+        for k in range(2):
+            run_tick(store, TickOptions(), now=NOW + 15 * (k + 1))
+        assert capacity_plane_for(store).breaker.state == "open"
+    finally:
+        faults.uninstall()
+
+
+def test_degraded_solve_tick_skips_capacity(store):
+    from evergreen_tpu.utils import faults
+
+    seed(store, [("deep", 10)])
+    CapacityConfig(pool_quotas={"mock": 2}).set(store)
+    faults.install(
+        faults.FaultPlan().always("scheduler.solve", faults.Fault("raise"))
+    )
+    try:
+        res = run_tick(store, TickOptions(), now=NOW)
+    finally:
+        faults.uninstall()
+    # the planning solve degraded to the serial oracle: capacity must
+    # not run on top of a degraded tick — heuristic counts stand
+    assert res.degraded == "solve-failed"
+    assert res.planner_used == "serial"
+    assert sum(res.new_hosts.values()) > 2
+
+
+def test_capacity_runs_on_serial_planner_ticks(store):
+    # the capacity layer is orthogonal to the planner choice: a
+    # serial-planned (non-degraded) tick still solves capacity jointly
+    from evergreen_tpu.globals import PlannerVersion
+
+    seed(store, [("deep", 30), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 8}).set(store)
+    res = run_tick(
+        store,
+        TickOptions(planner_version=PlannerVersion.TUNABLE.value),
+        now=NOW,
+    )
+    assert res.planner_used == "serial"
+    assert sum(res.new_hosts.values()) <= 8
+
+
+# --------------------------------------------------------------------------- #
+# bypass parity (ISSUE 9 satellite): alias / single-task / auto-tune
+# --------------------------------------------------------------------------- #
+
+
+def _seed_alias_problem(store, capacity):
+    seed(store, [("primary", 12), ("other", 2)], capacity=capacity)
+    # tasks on "primary" also plan into "other"'s secondary (alias) queue
+    coll = task_mod.coll(store)
+    for j in range(12):
+        coll.update(f"primary-t{j}", {"secondary_distros": ["other"]})
+
+
+def test_alias_rows_never_get_capacity_intents(store):
+    _seed_alias_problem(store, capacity="tpu")
+    CapacityConfig(pool_quotas={"mock": 6}).set(store)
+    res = run_tick(store, TickOptions(), now=NOW)
+    # the alias row planned a queue but must not appear in spawn counts
+    # under EITHER allocator (reference units/scheduler_alias.go)
+    assert "other::alias" not in res.new_hosts
+    assert set(res.new_hosts) == {"primary", "other"}
+    heur_store = Store()
+    _seed_alias_problem(heur_store, capacity="")
+    heur = run_tick(heur_store, TickOptions(), now=NOW)
+    assert "other::alias" not in heur.new_hosts
+    assert set(heur.new_hosts) == set(res.new_hosts)
+
+
+def test_single_task_distro_bypasses_capacity(store):
+    # single-task distros allocate 1:1 with dependency-met tasks
+    # (reference units/host_allocator.go:174-181) under BOTH allocators
+    # — the capacity plane must leave the bypass untouched even with a
+    # binding quota
+    for did, n, single in (("solo", 5, True), ("bulk", 20, False)):
+        distro_mod.insert(
+            store,
+            Distro(
+                id=did,
+                provider=Provider.MOCK.value,
+                single_task_distro=single,
+                planner_settings=PlannerSettings(capacity="tpu"),
+                host_allocator_settings=HostAllocatorSettings(
+                    maximum_hosts=30
+                ),
+            ),
+        )
+        task_mod.insert_many(store, make_tasks(did, n))
+    CapacityConfig(pool_quotas={"mock": 3}).set(store)
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert res.new_hosts["solo"] == 5  # 1:1, not quota-managed
+    assert res.new_hosts["bulk"] <= 3
+
+    heur_store = Store()
+    distro_mod.insert(
+        heur_store,
+        Distro(
+            id="solo",
+            provider=Provider.MOCK.value,
+            single_task_distro=True,
+            host_allocator_settings=HostAllocatorSettings(maximum_hosts=30),
+        ),
+    )
+    task_mod.insert_many(heur_store, make_tasks("solo", 5))
+    heur = run_tick(heur_store, TickOptions(), now=NOW)
+    assert heur.new_hosts["solo"] == res.new_hosts["solo"]
+
+
+def test_auto_tuned_max_hosts_bounds_both_allocators(store):
+    from evergreen_tpu.units.host_jobs import (
+        HOSTSTATS_COLLECTION,
+        auto_tune_distro_max_hosts,
+    )
+
+    seed(store, [("d1", 40)])
+    d = distro_mod.get(store, "d1")
+    d.host_allocator_settings.auto_tune_maximum_hosts = True
+    distro_mod.upsert(store, d)
+    # historical peak usage of 4 busy hosts → auto-tune pulls max down
+    store.collection(HOSTSTATS_COLLECTION).upsert(
+        {"_id": "d1:1", "distro_id": "d1", "at": NOW - 60,
+         "num_hosts": 6, "num_busy": 4}
+    )
+    assert auto_tune_distro_max_hosts(store, now=NOW) == ["d1"]
+    tuned_max = distro_mod.get(
+        store, "d1"
+    ).host_allocator_settings.maximum_hosts
+    assert tuned_max == 6  # ceil(4 * 1.25) + 1
+
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert res.new_hosts["d1"] <= tuned_max
+    heur_store = Store()
+    seed(heur_store, [("d1", 40)], capacity="", max_hosts=tuned_max)
+    heur = run_tick(heur_store, TickOptions(), now=NOW)
+    assert heur.new_hosts["d1"] <= tuned_max
+    # same binding cap → same allocation under either allocator
+    assert res.new_hosts["d1"] == heur.new_hosts["d1"]
+
+
+# --------------------------------------------------------------------------- #
+# provenance + REST
+# --------------------------------------------------------------------------- #
+
+
+def test_explain_capacity_decomposes_decision(store):
+    from evergreen_tpu.scheduler.provenance import (
+        capacity_provenance_for,
+        explain_capacity,
+    )
+
+    seed(store, [("deep", 30), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 8}).set(store)
+    run_tick(store, TickOptions(), now=NOW)
+    doc = explain_capacity(store, "deep")
+    assert doc is not None
+    assert doc["pool"] == "mock"
+    assert doc["target"] == doc["existing"] + doc["intents"]
+    assert "quota" in doc["binding"]
+    assert "shallow" in doc["partners"] or doc["partners"] == []
+    assert {"demand_term", "price_term", "churn_term"} <= set(doc)
+    prov = capacity_provenance_for(store)
+    assert prov.fleet["pool_use"]["mock"] <= 8
+    assert prov.target_hosts("deep") == doc["target"]
+    assert explain_capacity(store, "nope") is None
+
+
+def test_capacity_admin_routes(store):
+    from evergreen_tpu.api.rest import RestApi
+
+    api = RestApi(store)
+    status, body = api.handle("GET", "/rest/v2/admin/capacity/deep", {})
+    assert status == 404
+    seed(store, [("deep", 30), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 8}).set(store)
+    run_tick(store, TickOptions(), now=NOW)
+    status, body = api.handle("GET", "/rest/v2/admin/capacity/deep", {})
+    assert status == 200 and body["distro"] == "deep"
+    status, body = api.handle("GET", "/rest/v2/admin/capacity", {})
+    assert status == 200
+    assert body["fleet"]["pool_use"]["mock"] <= 8
+    assert len(body["distros"]) == 2
+
+
+# --------------------------------------------------------------------------- #
+# fleet intent budget (ISSUE 9 satellite: the sharded over-spawn leak)
+# --------------------------------------------------------------------------- #
+
+
+def test_tick_options_intent_budget_is_absolute(store):
+    seed(store, [("deep", 30)], capacity="")
+    from evergreen_tpu.scheduler.wrapper import INTENT_BUDGET_CLAMPED
+
+    before = INTENT_BUDGET_CLAMPED.total()
+    res = run_tick(store, TickOptions(intent_budget=3), now=NOW)
+    assert len(res.intent_hosts) == 3
+    assert INTENT_BUDGET_CLAMPED.total() > before
+
+
+def test_sharded_plane_enforces_one_fleet_intent_cap():
+    from evergreen_tpu.scheduler.sharded_plane import ShardedScheduler
+
+    source = Store()
+    seed(source, [(f"d{i}", 25) for i in range(4)], capacity="")
+    plane = ShardedScheduler.build(
+        2, tick_opts=TickOptions(use_cache=True, max_intent_hosts=10),
+        stacked="never", rebalance_enabled=False,
+    )
+    try:
+        plane.seed_partition(source)
+
+        def fleet_intents():
+            return sum(
+                host_mod.coll(s).count(
+                    lambda doc: doc["status"]
+                    == HostStatus.UNINITIALIZED.value
+                )
+                for s in plane.stores
+            )
+
+        plane.tick(now=NOW)
+        # without the fleet split each shard budgets 10 against its OWN
+        # store and a 2-shard plane spawns up to 20
+        assert fleet_intents() <= 10
+        plane.tick(now=NOW + 15)
+        # second round: in-flight intents are counted across EVERY
+        # shard store, so the fleet total still holds the cap
+        assert fleet_intents() <= 10
+    finally:
+        plane.close()
+
+
+# --------------------------------------------------------------------------- #
+# handoff-record compaction (ISSUE 9 satellite, PR 7 follow-up)
+# --------------------------------------------------------------------------- #
+
+
+def test_handoff_compaction_drops_reconciled_triples():
+    from evergreen_tpu.scheduler.sharded_plane import (
+        HANDOFF_WATERMARK_ID,
+        HANDOFFS_COLLECTION,
+        ShardedScheduler,
+    )
+
+    source = Store()
+    seed(source, [("d1", 4), ("d2", 4)], capacity="")
+    plane = ShardedScheduler.build(
+        2, stacked="never", rebalance_enabled=False
+    )
+    try:
+        plane.seed_partition(source)
+        src = plane.owner_of("d1")
+        rec = plane.migrate("d1", 1 - src, now=NOW)
+        assert rec["state"] == "done"
+        # the reconciled triple exists on both sides pre-compaction
+        assert plane.stores[src].collection(HANDOFFS_COLLECTION).get(
+            rec["_id"]
+        )
+        assert plane.compact_handoffs() == 1
+        for s in plane.stores:
+            assert s.collection(HANDOFFS_COLLECTION).get(rec["_id"]) is None
+        wm = plane.stores[src].collection(HANDOFFS_COLLECTION).get(
+            HANDOFF_WATERMARK_ID
+        )
+        assert wm is not None and wm["seq"] == rec["seq"]
+        # compaction is idempotent
+        assert plane.compact_handoffs() == 0
+        # a reopened plane recovers the seq floor from the watermark and
+        # still routes the migrated distro by document location
+        plane2 = ShardedScheduler(plane.stores)
+        assert plane2._seq >= rec["seq"]
+        assert plane2.owner_of("d1") == 1 - src
+    finally:
+        plane.close()
+
+
+def test_compaction_keeps_unreconciled_records():
+    from evergreen_tpu.scheduler.sharded_plane import (
+        HANDOFFS_COLLECTION,
+        ShardedScheduler,
+    )
+
+    source = Store()
+    seed(source, [("d1", 4)], capacity="")
+    plane = ShardedScheduler.build(
+        2, stacked="never", rebalance_enabled=False
+    )
+    try:
+        plane.seed_partition(source)
+        src = plane.owner_of("d1")
+        rec = plane.migrate("d1", 1 - src, now=NOW)
+        # simulate a crash between prime and done: the source record is
+        # still "released" — compaction must leave BOTH records alone
+        plane.stores[src].collection(HANDOFFS_COLLECTION).update(
+            rec["_id"], {"state": "released"}
+        )
+        assert plane.compact_handoffs() == 0
+        assert plane.stores[src].collection(HANDOFFS_COLLECTION).get(
+            rec["_id"]
+        )
+        # reconciliation completes the triple; then compaction eats it
+        plane.reconcile_handoffs(now=NOW)
+        assert plane.compact_handoffs() == 1
+    finally:
+        plane.close()
+
+
+# --------------------------------------------------------------------------- #
+# drawdown consumes the capacity targets
+# --------------------------------------------------------------------------- #
+
+
+def test_host_drawdown_uses_capacity_target(store):
+    from evergreen_tpu.cloud.mock import MockCloudManager
+    from evergreen_tpu.scheduler.provenance import CapacityProvenance
+    from evergreen_tpu.units import host_jobs
+
+    MockCloudManager.reset()
+    distro_mod.insert(
+        store,
+        Distro(
+            id="d1",
+            provider=Provider.MOCK.value,
+            planner_settings=PlannerSettings(capacity="tpu"),
+            host_allocator_settings=HostAllocatorSettings(
+                maximum_hosts=10,
+                hosts_overallocated_rule=OverallocatedRule.TERMINATE.value,
+            ),
+        ),
+    )
+    for i in range(5):
+        host_mod.insert(
+            store,
+            Host(
+                id=f"h{i}", distro_id="d1", provider=Provider.MOCK.value,
+                status=HostStatus.RUNNING.value, external_id=f"mock-h{i}",
+                creation_time=NOW - 3600 + i,
+            ),
+        )
+        MockCloudManager.instances[f"mock-h{i}"] = "running"
+    # the joint solve said d1 should hold 2 hosts; without it the
+    # queue-demand heuristic (no queue doc → demand 0) would reap all 5
+    store._last_capacity = CapacityProvenance(
+        at=NOW - 30.0, chosen="solver", fleet={},
+        rows={"d1": {"target": 2}},
+    )
+    reaped = host_jobs.host_drawdown(store, now=NOW)
+    assert len(reaped) == 3
+    assert len(host_mod.all_active_hosts(store, "d1")) == 2
+
+    # a STALE capacity answer must not drive terminations through the
+    # target path: the heuristic path takes over (no queue doc → demand
+    # 0 → every remaining free host is surplus)
+    store._last_capacity = CapacityProvenance(
+        at=NOW - 3600.0, chosen="solver", fleet={},
+        rows={"d1": {"target": 2}},
+    )
+    assert len(host_jobs.host_drawdown(store, now=NOW)) == 2
+
+
+def test_drawdown_ignores_fallback_stale_and_opted_out_targets(store):
+    from evergreen_tpu.scheduler.provenance import CapacityProvenance
+
+    prov = CapacityProvenance(
+        at=NOW, chosen="solver", fleet={}, rows={"d1": {"target": 2}},
+    )
+    assert prov.target_hosts("d1") == 2
+    # a fallback tick marks the record stale: targets stop steering
+    # (the admin surface still answers, flagged)
+    prov.stale = True
+    assert prov.target_hosts("d1") is None
+    assert prov.explain("d1")["stale"] is True
+
+
+def test_fallback_marks_provenance_stale(store):
+    from evergreen_tpu.scheduler.provenance import capacity_provenance_for
+    from evergreen_tpu.utils import faults
+
+    seed(store, [("deep", 24)])
+    CapacityConfig(pool_quotas={"mock": 4}).set(store)
+    run_tick(store, TickOptions(), now=NOW)
+    prov = capacity_provenance_for(store)
+    assert prov is not None and not prov.stale
+    faults.install(
+        faults.FaultPlan().always("capacity.solve", faults.Fault("raise"))
+    )
+    try:
+        run_tick(store, TickOptions(), now=NOW + 15)
+    finally:
+        faults.uninstall()
+    assert capacity_provenance_for(store).stale
+    assert capacity_provenance_for(store).target_hosts("deep") is None
+
+
+def test_disabling_section_marks_targets_stale(store):
+    from evergreen_tpu.scheduler.provenance import capacity_provenance_for
+
+    seed(store, [("deep", 24)])
+    CapacityConfig(pool_quotas={"mock": 4}).set(store)
+    run_tick(store, TickOptions(), now=NOW)
+    assert not capacity_provenance_for(store).stale
+    CapacityConfig(enabled=False).set(store)
+    run_tick(store, TickOptions(), now=NOW + 15)
+    # the master switch flipped off mid-flight: drawdown must stop
+    # steering by the old joint targets immediately
+    prov = capacity_provenance_for(store)
+    assert prov.stale and prov.target_hosts("deep") is None
+
+
+def test_mixed_fleet_budget_never_mangles_the_trade(store):
+    # capacity and heuristic distros share one tick budget: the solver
+    # must fit in the LEFTOVER after the heuristic distros' wants, so
+    # the creation loop funds everyone exactly as computed (no FCFS
+    # clamp) — every solver intent materializes as a host doc
+    seed(store, [("cap-a", 24), ("cap-b", 6)])
+    seed(store, [("heur-z", 10)], capacity="")
+    res = run_tick(store, TickOptions(intent_budget=12), now=NOW)
+    assert len(res.intent_hosts) == sum(res.new_hosts.values())
+    assert sum(res.new_hosts.values()) <= 12
+    from evergreen_tpu.scheduler.provenance import capacity_provenance_for
+
+    prov = capacity_provenance_for(store)
+    for did in ("cap-a", "cap-b"):
+        # provenance intents == created intents (nothing clamped away)
+        assert prov.explain(did)["intents"] == res.new_hosts[did]
+
+
+def test_solve_fallback_counts_degraded_tick_fallback(store):
+    # the capacity skip keys on the solve fallback itself (a dedicated
+    # flag), not on the degraded STRING an earlier persist-failed can
+    # mask — the degraded_tick fallback is always accounted
+    from evergreen_tpu.scheduler.capacity_plane import CAPACITY_FALLBACKS
+    from evergreen_tpu.utils import faults
+
+    seed(store, [("deep", 10)])
+    CapacityConfig(pool_quotas={"mock": 2}).set(store)
+    before = CAPACITY_FALLBACKS.value(cause="degraded_tick")
+    faults.install(
+        faults.FaultPlan().always("scheduler.solve", faults.Fault("raise"))
+    )
+    try:
+        res = run_tick(store, TickOptions(), now=NOW)
+    finally:
+        faults.uninstall()
+    assert res.planner_used == "serial"
+    assert sum(res.new_hosts.values()) > 2
+    assert CAPACITY_FALLBACKS.value(cause="degraded_tick") == before + 1
+
+
+def test_quota_split_is_exact_across_shards():
+    # quota 4 over an 8-shard plane: shares must SUM to 4 (no max(1,…)
+    # floor inflating a small quota N-fold); zero shares close the pool
+    # via the sub-host sentinel instead of flipping to 0 = unlimited
+    from evergreen_tpu.scheduler.capacity_plane import CapacityPlane
+
+    total = 0.0
+    for k in range(8):
+        s = Store()
+        s.shard_id = k
+        plane = CapacityPlane(s)
+        inp = plane.build_inputs(
+            [
+                Distro(
+                    id="d1",
+                    provider=Provider.MOCK.value,
+                    planner_settings=PlannerSettings(capacity="tpu"),
+                    host_allocator_settings=HostAllocatorSettings(
+                        maximum_hosts=10
+                    ),
+                )
+            ],
+            {"d1": type("I", (), {
+                "expected_duration_s": 1800.0,
+                "length_with_dependencies_met": 5,
+            })()},
+            {"d1": 2},
+            {"d1": []},
+            CapacityConfig(pool_quotas={"mock": 4}),
+            quota_scale=1.0 / 8,
+        )
+        share = inp.quota[cap.pool_index_of("mock")]
+        total += share if share >= 1.0 else 0.0
+        assert share in (0.5, 1.0)
+    assert total == 4.0
+
+
+# --------------------------------------------------------------------------- #
+# config section
+# --------------------------------------------------------------------------- #
+
+
+def test_capacity_config_validation(store):
+    assert CapacityConfig().validate_and_default() == ""
+    assert "weights" in CapacityConfig(price_weight=-1).validate_and_default()
+    assert "iterations" in CapacityConfig(
+        iterations=0
+    ).validate_and_default()
+    assert "pool_quotas" in CapacityConfig(
+        pool_quotas={"mock": -3}
+    ).validate_and_default()
+    with pytest.raises(ValueError):
+        CapacityConfig(fleet_intent_budget=-1).set(store)
+
+
+def test_snapshot_carries_capacity_columns(store):
+    # d_pool / d_cap_on ride the packed buffer like any other settings
+    # column (the resident plane maintains them through the same fill)
+    from evergreen_tpu.scheduler.snapshot import build_snapshot
+
+    distros = [
+        Distro(id="a", provider=Provider.MOCK.value,
+               planner_settings=PlannerSettings(capacity="tpu")),
+        Distro(id="b", provider=Provider.DOCKER.value),
+    ]
+    snap = build_snapshot(distros, {}, {}, {}, {}, NOW)
+    a = snap.arrays
+    assert int(a["d_pool"][0]) == cap.pool_index_of("mock")
+    assert int(a["d_pool"][1]) == cap.pool_index_of("docker")
+    assert bool(a["d_cap_on"][0]) and not bool(a["d_cap_on"][1])

@@ -91,7 +91,7 @@ def _log_run(rc: int, args: list) -> None:
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
         a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
-              "--shard-parity")
+              "--shard-parity", "--capacity-parity")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -112,12 +112,13 @@ def main() -> int:
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
     flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
-             "--shard-parity"}
+             "--shard-parity", "--capacity-parity"}
     args = [a for a in sys.argv[1:] if a not in flags]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
     with_shard_parity = "--shard-parity" in sys.argv[1:]
+    with_capacity_parity = "--capacity-parity" in sys.argv[1:]
     args = args or ["tests/"]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # metrics-lint first, unconditionally: it is static, takes
@@ -168,6 +169,16 @@ def main() -> int:
         print("gate:", " ".join(spar), flush=True)
         rc = subprocess.call(spar, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--shard-parity")
+    if rc == 0 and with_capacity_parity:
+        # joint capacity solve ≡ feasible, matches-or-beats the
+        # utilization oracle's time-to-empty, trades under shared
+        # quotas, and the breaker fallback is bit-identical heuristic
+        # behavior (make capacity-parity)
+        cpar = [sys.executable,
+                os.path.join(root, "tools", "capacity_parity.py")]
+        print("gate:", " ".join(cpar), flush=True)
+        rc = subprocess.call(cpar, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--capacity-parity")
     _log_run(rc, [*args, *ran_flags])
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)

@@ -10,8 +10,10 @@ registration contract that keeps ``/metrics`` scrape-able forever:
     leak waiting to happen);
   * counters end ``_total``; duration histograms end ``_ms``;
   * labels are a literal tuple/list drawn from the **allowed
-    vocabulary** (``utils/metrics.py ALLOWED_LABELS``) — task ids, host
-    ids, user ids can never become labels;
+    vocabulary** (``utils/metrics.py ALLOWED_LABELS``; grown
+    deliberately — e.g. ``pool``, the fixed provider-pool vocabulary of
+    the capacity plane) — task ids, host ids, user ids can never become
+    labels;
   * every name is registered **exactly once** across the tree (module
     scope registers on import; a second registration is a startup
     crash);
