@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity metrics-lint
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -72,6 +72,16 @@ shard-parity:
 # fails; gate-blocking via tools/gate.py --capacity-parity
 capacity-parity:
 	env JAX_PLATFORMS=cpu python tools/capacity_parity.py
+
+# read-serving-plane gate: replica answers ≡ primary at lag 0,
+# bounded-stale answers are a prefix of primary history, a fenced
+# (deposed) primary's frames are never served and the replica withholds
+# serving until the new holder's state arrives, the fingerprint ETag
+# cache 304s >90% of an unchanged-queue scrape storm, and the 10k-agent
+# long-poll soak dispatches every task exactly once; gate-blocking via
+# tools/gate.py --read-parity
+read-parity:
+	env JAX_PLATFORMS=cpu python tools/read_parity.py
 
 # N-process sharded-plane churn throughput vs the single-shard plane
 bench-sharded-plane:

@@ -158,6 +158,9 @@ def main() -> None:
     # --- dispatch-path scale check (next_task under concurrency) ----------- #
     dispatch = measure_dispatch()
 
+    # --- read-serving plane: replica lag, ETag 304s, long-poll soaks ------- #
+    read_path = measure_read_path_arm()
+
     # --- sharded control plane: N schedulers, one fleet -------------------- #
     sharded_plane = measure_sharded_plane()
 
@@ -187,6 +190,7 @@ def main() -> None:
         },
         sharded_plane=sharded_plane,
         capacity=capacity,
+        read_path=read_path,
     )
     print(json.dumps(result))
     if _backend == "axon":
@@ -223,6 +227,16 @@ def main() -> None:
         f"budget=1000ms",
         file=sys.stderr,
     )
+    if "hit_rate_304" in read_path:
+        p99_10k = read_path.get("dispatch_p99_10k_ms", "-")
+        print(
+            f"# read_path: 304_hit_rate={read_path['hit_rate_304']} "
+            f"replica_lag_p50={read_path['replica_lag_p50_ms']}ms "
+            f"p99={read_path['replica_lag_p99_ms']}ms "
+            f"longpoll_p99_1k={read_path['dispatch_p99_1k_ms']}ms "
+            f"longpoll_p99_10k={p99_10k}ms budget=100ms",
+            file=sys.stderr,
+        )
 
 
 def write_tpu_evidence(result: dict) -> None:
@@ -365,6 +379,27 @@ def measure_dispatch() -> dict:
     from tools.bench_dispatch import run_bench
 
     return run_bench(n_agents=100, queue_len=20_000, pulls_per_agent=200)
+
+
+def measure_read_path_arm() -> dict:
+    """The ``read_path`` payload section (ISSUE 11): replica lag
+    p50/p99 through a live tail thread, the fingerprint-ETag 304
+    hit-rate on an unchanged-queue scrape storm, and the long-poll
+    dispatch soaks at 1k/10k parked agents — the same measurement
+    tools/perf_guard.py enforces bounds on. Skip the (thread-heavy) 10k
+    arm with EVERGREEN_TPU_BENCH_READPATH=quick, or everything with
+    =0."""
+    mode = os.environ.get("EVERGREEN_TPU_BENCH_READPATH", "1")
+    if mode == "0":
+        return {"skipped": True}
+    try:
+        from tools.read_parity import measure_read_path
+
+        return measure_read_path(quick=(mode == "quick"))
+    except Exception as exc:  # noqa: BLE001 — the read-path arm must
+        # not kill the headline bench run
+        print(f"# read-path arm failed: {exc!r}", file=sys.stderr)
+        return {"error": repr(exc)[-200:]}
 
 
 def measure_churn_ticks(distros, tasks_by_distro, hosts_by_distro):

@@ -32,6 +32,12 @@ class AgentOptions:
     #: jittered idle backoff bounds (agent/agent.go:233,287-299)
     min_poll_interval_s: float = 0.1
     max_poll_interval_s: float = 5.0
+    #: long-poll park per next_task pull (ISSUE 11): an empty pull
+    #: parks on the server's dispatch hub (dispatch/longpoll.py) this
+    #: long instead of the agent re-polling on the backoff cadence —
+    #: the server clamps it to ReadPathConfig.longpoll_max_wait_s.
+    #: 0 restores the pure poll/backoff behavior.
+    poll_wait_s: float = 20.0
 
 
 class Agent:
@@ -45,10 +51,11 @@ class Agent:
 
     # -- single task -------------------------------------------------------- #
 
-    def run_once(self) -> Optional[str]:
-        """Poll once; run the assigned task to completion if any.
-        Returns the finished task id or None when the queue is empty."""
-        task = self.comm.next_task(self.options.host_id)
+    def run_once(self, wait_s: float = 0.0) -> Optional[str]:
+        """Poll once (long-polling up to ``wait_s``); run the assigned
+        task to completion if any. Returns the finished task id or None
+        when the queue is empty."""
+        task = self.comm.next_task(self.options.host_id, wait_s=wait_s)
         if task is None:
             return None
         cfg = self.comm.get_task_config(task, self.options.host_id)

@@ -48,8 +48,11 @@ class TaskConfig:
 
 class Communicator(abc.ABC):
     @abc.abstractmethod
-    def next_task(self, host_id: str) -> Optional[Task]:
-        ...
+    def next_task(self, host_id: str, wait_s: float = 0.0) -> Optional[Task]:
+        """Pull the next assigned task. ``wait_s`` > 0 long-polls: an
+        empty pull parks on the server's dispatch hub until the host's
+        queue plausibly changed (dispatch/longpoll.py) instead of the
+        agent re-polling on a cadence."""
 
     @abc.abstractmethod
     def get_task_config(self, task: Task, host_id: str = "") -> TaskConfig:
@@ -93,11 +96,33 @@ class LocalCommunicator(Communicator):
         self.store = store
         self.svc = dispatcher_service
 
-    def next_task(self, host_id: str) -> Optional[Task]:
+    def next_task(self, host_id: str, wait_s: float = 0.0) -> Optional[Task]:
         host = host_mod.get(self.store, host_id)
         if host is None:
             return None
-        return assign_next_available_task(self.store, self.svc, host)
+        t = assign_next_available_task(self.store, self.svc, host)
+        if t is not None or wait_s <= 0.0:
+            return t
+        # long-poll: park until the host's distro queue plausibly
+        # changed, then re-pull (the generation is sampled BEFORE each
+        # empty pull so a write racing the park still wakes us)
+        from ..dispatch.longpoll import hub_for
+
+        hub = hub_for(self.store)
+        deadline = _time.monotonic() + wait_s
+        while True:
+            gen = hub.generation(host.distro_id)
+            host = host_mod.get(self.store, host_id)
+            if host is None:
+                return None
+            t = assign_next_available_task(self.store, self.svc, host)
+            if t is not None:
+                return t
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return None
+            if not hub.wait(host.distro_id, host_id, gen, remaining):
+                return None  # clean park timeout
 
     def _distro_arch(self, task: Task) -> str:
         from ..models import distro as distro_mod

@@ -48,7 +48,10 @@ class RestCommunicator(Communicator):
 
     # -- transport ----------------------------------------------------------- #
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def _call(
+        self, method: str, path: str, body: Optional[dict] = None,
+        timeout_s: float = 30.0,
+    ) -> dict:
         url = f"{self.base_url}{path}"
         data = json.dumps(body or {}).encode() if method != "GET" else None
 
@@ -62,7 +65,7 @@ class RestCommunicator(Communicator):
                 url, data=data, method=method, headers=headers
             )
             try:
-                with urllib.request.urlopen(req, timeout=30) as resp:
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
                 # 4xx/5xx with a JSON body is a protocol answer, not a
@@ -86,8 +89,16 @@ class RestCommunicator(Communicator):
 
     # -- protocol ------------------------------------------------------------ #
 
-    def next_task(self, host_id: str) -> Optional[Task]:
-        resp = self._call("GET", f"/rest/v2/hosts/{host_id}/agent/next_task")
+    def next_task(self, host_id: str, wait_s: float = 0.0) -> Optional[Task]:
+        path = f"/rest/v2/hosts/{host_id}/agent/next_task"
+        if wait_s > 0.0:
+            # server-side long-poll (dispatch/longpoll.py): the route
+            # parks this request until the host's queue plausibly
+            # changed, bounded by ReadPathConfig.longpoll_max_wait_s.
+            # The transport timeout stretches past the park so a full
+            # park is a clean empty answer, not a spurious retry.
+            path += f"?wait={wait_s:g}"
+        resp = self._call("GET", path, timeout_s=30.0 + wait_s)
         self.should_exit = bool(resp.get("should_exit"))
         tid = resp.get("task_id")
         if not tid:

@@ -586,8 +586,16 @@ class GraphQLApi(SpruceOpsMixin):
     # -- entry --------------------------------------------------------------- #
 
     def execute(
-        self, query: str, variables: Optional[Dict[str, Any]] = None
+        self,
+        query: str,
+        variables: Optional[Dict[str, Any]] = None,
+        served_by: str = "",
+        staleness_ms: float = -1.0,
     ) -> Dict[str, Any]:
+        """Execute one document. ``served_by``/``staleness_ms`` are set
+        by the REST layer when this query answers from a bounded-stale
+        follower replica (ISSUE 11) — they surface to the client in the
+        spec's ``extensions`` member so UIs can badge stale data."""
         try:
             op, selection, var_defs = _Parser(
                 _tokenize(query)
@@ -640,7 +648,13 @@ class GraphQLApi(SpruceOpsMixin):
                     fn(**args), field["selection"], self.store, variables,
                     fdef["type"] if fdef else None, sreg,
                 )
-            return {"data": data}
+            result: Dict[str, Any] = {"data": data}
+            if served_by:
+                result["extensions"] = {
+                    "served_by": served_by,
+                    "staleness_ms": round(max(0.0, staleness_ms), 1),
+                }
+            return result
         except GraphQLError as e:
             return {"errors": [{"message": str(e)}]}
         except TypeError as e:

@@ -1,0 +1,195 @@
+"""Fingerprint ETag + in-process response cache for the read surface.
+
+Scrape storms and UI refresh loops re-serialize the same unchanged
+answers against the same store: every ``GET /rest/v2/distros/x/queue``
+re-reads and re-serializes a queue doc the persister may not have
+touched for minutes. This module keys read responses on CHANGE TOKENS
+that are O(1) to compute:
+
+* per-collection **generation counters** maintained by Collection
+  listeners (any journaled write to ``hosts`` bumps the hosts gen — the
+  listener increments one int, per the Collection listener contract);
+* the **persister's per-distro fingerprint version** for queue docs —
+  the delta persister already maintains ``v`` as the queue's version
+  watermark (scheduler/persister.py), so the queue route's token is the
+  same fingerprint that decides skip/patch/splice write shapes.
+
+An ``If-None-Match`` hit answers **304 with zero store reads** (one
+token lookup, no handler, no serialization); a token-matched cache hit
+returns the cached payload without re-running the handler. Entries are
+keyed ``(path+params, etag)`` in a bounded LRU, so a token change
+invalidates by key miss and the LRU evicts the garbage.
+
+ETags carry a store tag (primary vs replica id): a response served from
+a bounded-stale replica must never validate a primary-served client
+cache entry, only its own.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from ..utils import metrics as _metrics
+
+API_CACHE_HITS = _metrics.counter(
+    "api_cache_hits_total",
+    "Read-cache hits by endpoint: 304 If-None-Match answers plus "
+    "token-matched response-cache hits (no handler run, no store "
+    "reads beyond the change token).",
+    labels=("endpoint",),
+)
+API_CACHE_MISSES = _metrics.counter(
+    "api_cache_misses_total",
+    "Read-cache misses by endpoint: the handler ran and its response "
+    "was (re)cached under the current change token.",
+    labels=("endpoint",),
+)
+
+#: cacheable GET routes: name (the bounded ``endpoint`` metric label),
+#: compiled pattern, and the collections whose generations key the
+#: response. ``{1}`` in the collection slot means "token from the
+#: persister fingerprint / queue-doc version of match group 1" (the
+#: queue route). Only USER-INDEPENDENT responses belong here — anything
+#: filtered by the authenticated identity (volumes, user keys) must not
+#: share one cache line across users.
+_ROUTES = [
+    ("queue", re.compile(r"^/rest/v2/distros/([^/]+)/queue$"), ("@queue",)),
+    ("hosts", re.compile(r"^/rest/v2/hosts$"), ("hosts",)),
+    ("host", re.compile(r"^/rest/v2/hosts/([^/]+)$"), ("hosts",)),
+    ("distros", re.compile(r"^/rest/v2/distros$"), ("distros",)),
+    ("distro", re.compile(r"^/rest/v2/distros/([^/]+)$"), ("distros",)),
+    ("versions", re.compile(r"^/rest/v2/versions$"), ("versions",)),
+    ("version", re.compile(r"^/rest/v2/versions/([^/]+)$"), ("versions",)),
+    (
+        "version_tasks",
+        re.compile(r"^/rest/v2/versions/([^/]+)/tasks$"),
+        ("tasks",),
+    ),
+    ("task", re.compile(r"^/rest/v2/tasks/([^/]+)$"), ("tasks",)),
+    ("build", re.compile(r"^/rest/v2/builds/([^/]+)$"), ("builds",)),
+    (
+        "build_display",
+        re.compile(r"^/rest/v2/builds/([^/]+)/display_tasks$"),
+        ("display_tasks", "tasks"),
+    ),
+    ("projects", re.compile(r"^/rest/v2/projects$"), ("project_refs",)),
+    ("patches", re.compile(r"^/rest/v2/patches$"), ("patches",)),
+    (
+        "last_green",
+        re.compile(r"^/rest/v2/projects/([^/]+)/last_green$"),
+        ("versions", "builds"),
+    ),
+]
+
+
+class StoreVersions:
+    """Per-store O(1) change tokens: a listener per tracked collection
+    bumps an int on every journaled write. Attached to the store object
+    (``versions_for``) so lifetimes are one."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+        self._gens: Dict[str, int] = {}
+        self._installed: set = set()
+        self._lock = threading.Lock()
+
+    def _ensure(self, name: str) -> None:
+        if name in self._installed:
+            return
+        with self._lock:
+            if name in self._installed:
+                return
+            self._gens.setdefault(name, 0)
+
+            def bump(_doc_id: str, _name: str = name) -> None:
+                # trivial per the Collection listener contract; GIL-
+                # atomic int replace
+                self._gens[_name] = self._gens.get(_name, 0) + 1
+
+            self.store.collection(name).add_listener(bump)
+            self._installed.add(name)
+
+    def gen(self, name: str) -> int:
+        self._ensure(name)
+        return self._gens.get(name, 0)
+
+
+def versions_for(store) -> StoreVersions:
+    sv = getattr(store, "_read_versions", None)
+    if sv is None:
+        sv = StoreVersions(store)
+        store._read_versions = sv
+    return sv
+
+
+def _queue_token(store, distro_id: str) -> str:
+    """The queue route's token: the persister's fingerprint version
+    (bumped on every content-changing write shape, untouched on skip;
+    the doc's own ``v`` is the durable fallback for replicas and cold
+    processes) PLUS the doc's ``generated_at``/``dirty_at`` stamps — a
+    dependency wake flips deps-met flags and stamps ``dirty_at``
+    without a persister pass, and that flip must invalidate too."""
+    from ..scheduler.persister import fingerprint_version
+
+    doc = store.collection("task_queues").get(distro_id)
+    if doc is None:
+        return "q-"
+    v = fingerprint_version(store, distro_id)
+    if v is None:
+        v = doc.get("v", -1)
+    return (
+        f"q{v}.{doc.get('generated_at', 0)}.{doc.get('dirty_at', 0)}"
+    )
+
+
+def route_for(path: str) -> Optional[Tuple[str, "re.Match", tuple]]:
+    for name, pat, colls in _ROUTES:
+        m = pat.match(path)
+        if m:
+            return name, m, colls
+    return None
+
+
+def etag_for(
+    store, store_tag: str, path: str, colls: tuple, match
+) -> str:
+    sv = versions_for(store)
+    parts = []
+    for c in colls:
+        if c == "@queue":
+            parts.append(_queue_token(store, match.group(1)))
+        else:
+            parts.append(str(sv.gen(c)))
+    return f'W/"{store_tag}-{".".join(parts)}"'
+
+
+class ResponseCache:
+    """Bounded LRU of (cache key, etag) → (status, payload,
+    serialized-JSON). Invalidation is by key miss: a changed token
+    means a changed etag means a different key."""
+
+    def __init__(self, max_entries: int = 256) -> None:
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+    def get(self, key: tuple) -> Optional[tuple]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+            return entry
+
+    def put(self, key: tuple, value: tuple) -> None:
+        if self.max_entries <= 0:
+            return
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -54,6 +54,12 @@ API_REQUEST_MS = _metrics.histogram(
     "class.",
     labels=("outcome",),
 )
+READS_DEGRADED = _metrics.counter(
+    "api_reads_degraded_total",
+    "Expensive reads served from the bounded-stale follower replica "
+    "at overload RED (with a Warning header) instead of 429ing — "
+    "shedding is the fallback, not the strategy.",
+)
 
 JSON = "application/json"
 
@@ -163,7 +169,14 @@ class RestApi:
         user_manager=None,
         forward_writes: bool = True,
     ) -> None:
-        self.store = store
+        #: per-request authenticated identity (thread-local: the WSGI
+        #: server is threading). Set by _authorize, read by ownership
+        #: checks on user-resource routes (spawn hosts, volumes). Also
+        #: carries the per-request serving-store override (follower
+        #: reads) — created FIRST because the ``store`` property below
+        #: consults it.
+        self._ident = threading.local()
+        self._store = store
         #: read replicas proxy mutations to the primary writer instead of
         #: 503ing (reference: any app server writes to shared Mongo;
         #: here writes serialize at the WAL writer). False restores the
@@ -171,6 +184,22 @@ class RestApi:
         self.forward_writes = forward_writes
         self.svc = dispatcher_service or DispatcherService(store)
         self.require_auth = require_auth
+        #: attached follower-read replica (storage/replica.py), serving
+        #: list/read GETs when fresh — see attach_read_replica
+        self.read_replica = None
+        #: bounded LRU for the fingerprint ETag response cache
+        #: (api/readcache.py); sized lazily from ReadPathConfig
+        self._response_cache = None
+        #: PROCESS-UNIQUE ETag store tag for primary-served answers:
+        #: generation counters are process-local, so a restarted (or
+        #: failed-over) writer minting the same constant tag could
+        #: falsely 304 a validator from the previous process's counters
+        import uuid as _uuid
+
+        self._etag_tag = f"p-{_uuid.uuid4().hex[:8]}"
+        #: (cfg, read_at) TTL cache of the read_path section — the read
+        #: gate runs per request and must not cost a config read each
+        self._read_cfg: Optional[Tuple[object, float]] = None
         #: pluggable login manager (api/auth.py); None → built lazily from
         #: the admin-editable auth config section
         self._user_manager = user_manager
@@ -182,10 +211,6 @@ class RestApi:
 
         self._rate_limiter = RateLimiter(store, 0)
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
-        #: per-request authenticated identity (thread-local: the WSGI
-        #: server is threading). Set by _authorize, read by ownership
-        #: checks on user-resource routes (spawn hosts, volumes).
-        self._ident = threading.local()
         #: (ratio, read_at) — see _sample_request_log
         self._sample_ratio_cache: Optional[Tuple[float, float]] = None
         self._register_routes()
@@ -200,6 +225,39 @@ class RestApi:
 
         _install_ghs(store)
         _install_senders(store)
+
+    @property
+    def store(self):
+        """The request's serving store: normally the primary this API
+        was built over; during a follower-read dispatch a thread-local
+        override points handlers at the attached replica — every
+        handler keeps reading ``self.store`` unchanged."""
+        override = getattr(self._ident, "store_override", None)
+        return override if override is not None else self._store
+
+    @store.setter
+    def store(self, value) -> None:
+        self._store = value
+
+    def attach_read_replica(self, replica) -> None:
+        """Attach a WAL-tailing ReplicaStore as this API's follower-read
+        target: eligible list/read GETs (and GraphQL queries) serve from
+        it whenever its staleness is under ReadPathConfig's bound and it
+        is not fence-blocked; at RED, expensive reads DEGRADE to it
+        under the looser bound before 429ing (Environment.build wires
+        one tailing the writer's own data dir)."""
+        self.read_replica = replica
+
+    def _read_path_config(self):
+        """TTL-cached ReadPathConfig (the read gate runs per request)."""
+        now = _time.monotonic()
+        cached = self._read_cfg
+        if cached is None or now - cached[1] > 5.0:
+            from ..settings import ReadPathConfig
+
+            cached = (ReadPathConfig.get(self._store), now)
+            self._read_cfg = cached
+        return cached[0]
 
     @property
     def user_manager(self):
@@ -425,6 +483,16 @@ class RestApi:
         )
         if level < overload.BLACK and not expensive:
             return None
+        if (
+            level < overload.BLACK
+            and self._replica_usable(degraded=True) is not None
+            and self._replica_route_ok(method, path, body)
+        ):
+            # RED degrade decided BEFORE any shed side effect: a read
+            # that will be SERVED (bounded-stale, Warning header) must
+            # not count as shed, log as shed, or carry a Retry-After
+            self._ident.degrade_read = True
+            return None
         from ..utils.log import get_logger
 
         retry = monitor.retry_after_s(level)
@@ -471,39 +539,263 @@ class RestApi:
         body = body or {}
         headers = headers or {}
         self._ident.response_headers = []
+        self._ident.serialized_payload = None
+        self._ident.degrade_read = False
         shed = self._overload_shed(method, path, body)
         if shed is not None:
             return shed
+        # ladder integration (ISSUE 11): _overload_shed flags an
+        # expensive RED read it chose to DEGRADE to bounded-stale
+        # replica serving instead of 429ing (BLACK keeps the full shed)
+        degraded = bool(getattr(self._ident, "degrade_read", False))
         denied = self._authorize(method, path, headers)
         if denied is not None:
             return denied
         forwarded = self._maybe_forward(method, path, body, headers)
         if forwarded is not None:
             return forwarded
-        for m, pattern, handler in self._routes:
-            if m != method:
-                continue
-            match = pattern.match(path)
-            if match:
-                try:
-                    return handler(method, match, body)
-                except ApiError as e:
-                    return e.status, {"error": e.message}
-                except ReplicaReadOnly as e:
-                    # read replica: mutations must go to the writer
-                    # (reference: any replica writes to shared Mongo; here
-                    # the client retries against the primary)
-                    return 503, {
-                        "error": "this server is a read-only replica",
-                        "primary": e.primary_url,
-                    }
-                except KeyError as e:
-                    return 404, {"error": f"not found: {e}"}
-                except (ValueError, TypeError) as e:
-                    # malformed client input (?limit=abc, wrong-typed JSON
-                    # field) is a 400, not a WSGI stack trace
-                    return 400, {"error": f"bad request: {e}"}
-        return 404, {"error": f"no route for {method} {path}"}
+        return self._serve_read(method, path, body, headers, degraded)
+
+    def _dispatch_route(
+        self, method: str, path: str, body: dict, serving=None
+    ) -> Tuple[int, Any]:
+        """Run the matching route handler, optionally with the serving
+        store overridden to a follower replica for this request."""
+        if serving is not None:
+            self._ident.store_override = serving
+        try:
+            for m, pattern, handler in self._routes:
+                if m != method:
+                    continue
+                match = pattern.match(path)
+                if match:
+                    try:
+                        return handler(method, match, body)
+                    except ApiError as e:
+                        return e.status, {"error": e.message}
+                    except ReplicaReadOnly as e:
+                        # read replica: mutations must go to the writer
+                        # (reference: any replica writes to shared
+                        # Mongo; here the client retries against the
+                        # primary)
+                        return 503, {
+                            "error": "this server is a read-only replica",
+                            "primary": e.primary_url,
+                        }
+                    except KeyError as e:
+                        return 404, {"error": f"not found: {e}"}
+                    except (ValueError, TypeError) as e:
+                        # malformed client input (?limit=abc, wrong-typed
+                        # JSON field) is a 400, not a WSGI stack trace
+                        return 400, {"error": f"bad request: {e}"}
+            return 404, {"error": f"no route for {method} {path}"}
+        finally:
+            if serving is not None:
+                self._ident.store_override = None
+
+    # -- follower reads + fingerprint ETag cache (ISSUE 11) --------------- #
+
+    def _replica_usable(self, degraded: bool = False):
+        """The attached replica, when it may serve right now: not
+        fence-blocked (a failover's pre-recovery state must never reach
+        readers) and within the configured staleness bound — the normal
+        bound, or the looser RED-degradation bound."""
+        replica = self.read_replica
+        if replica is None:
+            return None
+        cfg = self._read_path_config()
+        if not cfg.follower_reads_enabled:
+            return None
+        if not replica.serve_ready():
+            return None
+        bound = (
+            cfg.degraded_staleness_bound_ms
+            if degraded else cfg.staleness_bound_ms
+        )
+        if replica.staleness_ms() > bound:
+            return None
+        return replica
+
+    def _replica_route_ok(self, method: str, path: str, body: dict) -> bool:
+        """Routes a follower replica may serve: collection-backed reads
+        only. The agent protocol and mutating GETs stay on the primary;
+        ``/admin/*``, ``/metrics`` and ``/stats/*`` introspect THIS
+        process's in-memory state (trace rings, provenance, ladder) and
+        must answer about the primary, not about a tailer."""
+        if method == "GET":
+            if not path.startswith("/rest/v2/"):
+                return False
+            if (
+                _AGENT_PATHS.match(path)
+                or _MUTATING_GETS.match(path)
+                or path.startswith(("/rest/v2/admin/", "/rest/v2/stats/"))
+            ):
+                return False
+            return True
+        if method == "POST" and path == "/graphql":
+            return not _is_graphql_mutation(body.get("query", ""))
+        return False
+
+    def _serve_read(
+        self,
+        method: str,
+        path: str,
+        body: dict,
+        headers: Dict[str, str],
+        degraded: bool,
+    ) -> Tuple[int, Any]:
+        """The read-serving plane in front of the route table: pick the
+        serving store (primary, or the attached replica when fresh),
+        then answer from the fingerprint ETag cache —
+        ``If-None-Match`` → 304 with zero store reads, a token-matched
+        entry → the cached response without re-running the handler —
+        before falling through to the real handler."""
+        from . import readcache
+        from ..storage.replica import ReplicaStore
+
+        cfg = self._read_path_config()
+        # a replica-process API (this server's OWN store is the tailer)
+        # applies the same bounded-staleness/fencing contract to itself:
+        # fence-blocked → never serve (forward the read to the primary,
+        # 503 if unreachable); too stale → prefer the primary, serve
+        # stale with a Warning only when the primary is down
+        # (availability over advisory freshness)
+        own = self._store
+        if (
+            isinstance(own, ReplicaStore)
+            and self.read_replica is None
+            and cfg.follower_reads_enabled
+            and self._replica_route_ok(method, path, body)
+        ):
+            blocked = not own.serve_ready()
+            too_stale = own.staleness_ms() > cfg.staleness_bound_ms
+            if (blocked or too_stale) and own.primary_url:
+                fwd = self._forward_to_primary(method, path, body, headers)
+                if fwd[0] < 500 or blocked:
+                    return fwd
+            elif blocked:
+                return 503, {
+                    "error": "replica cannot serve: a failover is in "
+                             "progress and the new holder's state has "
+                             "not arrived",
+                    "primary": own.primary_url,
+                }
+            if too_stale and not blocked:
+                self._ident.response_headers = (
+                    getattr(self._ident, "response_headers", []) or []
+                ) + [
+                    ("Warning",
+                     '110 - "stale read: replica beyond its staleness '
+                     'bound and the primary is unreachable"'),
+                    ("X-Evg-Staleness-Ms", str(int(own.staleness_ms()))),
+                ]
+        serving = None
+        # the ETag store tag: validators minted by different stores
+        # (primary vs any replica) must never match each other
+        tag = (
+            own.replica_id if isinstance(own, ReplicaStore)
+            else self._etag_tag
+        )
+        if self._replica_route_ok(method, path, body):
+            serving = self._replica_usable(degraded=degraded)
+            if serving is not None:
+                tag = serving.replica_id
+        if degraded and serving is None:
+            # the replica went stale/fenced between the shed check and
+            # here: fall back to the 429 the ladder wanted
+            from ..utils import overload
+
+            monitor = overload.monitor_for(self._store)
+            retry = monitor.retry_after_s(monitor.level())
+            self._ident.response_headers = [
+                ("Retry-After", str(int(retry)))
+            ]
+            return 429, {
+                "error": "service overloaded",
+                "level": monitor.level_label(),
+                "retry_after_s": retry,
+            }
+        extra_headers: List[Tuple[str, str]] = []
+        if serving is not None:
+            extra_headers.append(("X-Evg-Served-By", tag))
+            extra_headers.append(
+                ("X-Evg-Staleness-Ms", str(int(serving.staleness_ms())))
+            )
+            if degraded:
+                READS_DEGRADED.inc()
+                extra_headers.append(
+                    ("Warning",
+                     '110 - "stale read: bounded-stale replica serving '
+                     'under overload"')
+                )
+        route = (
+            readcache.route_for(path)
+            if method == "GET" and cfg.cache_enabled else None
+        )
+        if route is None:
+            status, payload = self._dispatch_route(
+                method, path, body, serving
+            )
+            self._ident.response_headers = (
+                getattr(self._ident, "response_headers", []) or []
+            ) + extra_headers
+            return status, payload
+        name, match, colls = route
+        if self._response_cache is None:
+            self._response_cache = readcache.ResponseCache(
+                max_entries=cfg.cache_max_entries
+            )
+        read_store = serving if serving is not None else self._store
+        etag = readcache.etag_for(read_store, tag, path, colls, match)
+        inm = headers.get("if-none-match", "")
+        key = (
+            path,
+            tuple(sorted((k, str(v)) for k, v in body.items())),
+            etag,
+        )
+        entry = self._response_cache.get(key)
+        if entry is not None:
+            # the validator only ever certifies a KNOWN-200 answer: a
+            # 404'd resource must not 304 (the client would cache the
+            # ghost as an unmodified live resource)
+            if inm and inm == etag:
+                # the whole point: an unchanged fingerprint answers
+                # with no store reads, no handler, no serialization
+                readcache.API_CACHE_HITS.inc(endpoint=name)
+                self._ident.response_headers = (
+                    getattr(self._ident, "response_headers", []) or []
+                ) + extra_headers + [("ETag", etag)]
+                return 304, {}
+            readcache.API_CACHE_HITS.inc(endpoint=name)
+            status, payload, serialized = entry
+            self._ident.serialized_payload = (payload, serialized)
+            self._ident.response_headers = (
+                getattr(self._ident, "response_headers", []) or []
+            ) + extra_headers + [("ETag", etag)]
+            return status, payload
+        status, payload = self._dispatch_route(method, path, body, serving)
+        if status == 200:
+            readcache.API_CACHE_MISSES.inc(endpoint=name)
+            try:
+                serialized = json.dumps(payload, default=str)
+            except (TypeError, ValueError):
+                serialized = None
+            if serialized is not None:
+                self._response_cache.put(key, (status, payload, serialized))
+                self._ident.serialized_payload = (payload, serialized)
+            extra_headers.append(("ETag", etag))
+            if inm and inm == etag:
+                # valid revalidation that had fallen out of the LRU:
+                # the handler re-established the answer — skip the body
+                self._ident.serialized_payload = None
+                self._ident.response_headers = (
+                    getattr(self._ident, "response_headers", []) or []
+                ) + extra_headers
+                return 304, {}
+        self._ident.response_headers = (
+            getattr(self._ident, "response_headers", []) or []
+        ) + extra_headers
+        return status, payload
 
     # -- replica write forwarding ---------------------------------------- #
 
@@ -570,8 +862,19 @@ class RestApi:
             method=method,
             headers=fwd_headers,
         )
+        # the hop timeout stretches past a long-poll ?wait=: a forwarded
+        # agent next_task parks on the PRIMARY's dispatch hub up to its
+        # clamp, and a fixed 15s would abort every idle park as a bogus
+        # "primary unreachable" 503
+        timeout_s = 15.0
         try:
-            with urllib.request.urlopen(req, timeout=15) as resp:
+            wait = float(body.get("wait", 0) or 0)
+        except (TypeError, ValueError):
+            wait = 0.0
+        if wait > 0:
+            timeout_s += min(wait, 300.0)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 status, resp_raw = resp.status, resp.read()
                 resp_headers = resp.headers
         except urllib.error.HTTPError as e:
@@ -687,12 +990,15 @@ class RestApi:
                 method, path, status, (_time.perf_counter() - t0) * 1e3,
                 headers.get("x-peer-addr", ""),
             )
-        reason = {200: "OK", 201: "Created", 400: "Bad Request",
+        reason = {200: "OK", 201: "Created", 304: "Not Modified",
+                  400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
                   409: "Conflict", 429: "Too Many Requests",
                   503: "Service Unavailable"}
         extra = getattr(self._ident, "response_headers", None) or []
         self._ident.response_headers = []
+        stash = getattr(self._ident, "serialized_payload", None)
+        self._ident.serialized_payload = None
         if isinstance(payload, PlainTextResponse):
             start_response(
                 f"{status} {reason.get(status, 'OK')}",
@@ -703,6 +1009,12 @@ class RestApi:
             f"{status} {reason.get(status, 'OK')}",
             [("Content-Type", JSON), *extra],
         )
+        if status == 304:
+            return [b""]  # a 304 carries no body, only the validators
+        if stash is not None and stash[0] is payload:
+            # fingerprint-cache hit: the serialized answer rides along,
+            # so an unchanged queue is not re-serialized per scrape
+            return [stash[1].encode()]
         return [json.dumps(payload, default=str).encode()]
 
     def _sample_request_log(
@@ -956,6 +1268,23 @@ class RestApi:
             # host_agent.go:112-160 reprovisioning health check)
             return 200, {"task_id": "", "should_exit": True}
         t = assign_next_available_task(self.store, self.svc, h)
+        if t is None:
+            # server-side long-poll (dispatch/longpoll.py): ?wait= parks
+            # this request on the sharded hub until the host's queue
+            # plausibly changed, clamped to the configured bound — 10k
+            # idle agents cost condition waits, not re-poll scans
+            try:
+                wait = float(body.get("wait", 0) or 0)
+            except (TypeError, ValueError):
+                wait = 0.0
+            if wait > 0:
+                wait = min(wait, self._read_path_config().longpoll_max_wait_s)
+            if wait > 0:
+                from ..agent.comm import LocalCommunicator
+
+                t = LocalCommunicator(self.store, self.svc).next_task(
+                    h.id, wait_s=wait
+                )
         # single-task distros run exactly one task per host, then the agent
         # exits and the host is recycled (reference units/host_allocator.go
         # :174-181 + agent single-task-distro exit)
@@ -1986,10 +2315,20 @@ class RestApi:
     def graphql(self, method, match, body):
         from .graphql import GraphQLApi
 
+        serving = getattr(self._ident, "store_override", None)
+        kwargs = {}
+        if serving is not None:
+            # follower-read query: badge the answer (spec `extensions`)
+            kwargs = {
+                "served_by": serving.replica_id,
+                "staleness_ms": serving.staleness_ms(),
+            }
         result = GraphQLApi(
             self.store,
             acting_user=getattr(self._ident, "user", ""),
-        ).execute(body.get("query", ""), body.get("variables") or {})
+        ).execute(
+            body.get("query", ""), body.get("variables") or {}, **kwargs
+        )
         return 200, result
 
     def status(self, method, match, body):

@@ -116,7 +116,12 @@ def cmd_agent(args) -> int:
     print(f"agent for host {args.host_id} polling {args.api_server}")
     idle_sleep = agent.options.min_poll_interval_s
     while True:
-        tid = agent.run_once()
+        # the pull long-polls on the server's dispatch hub (ISSUE 11):
+        # an idle fleet parks on condition waits instead of hammering
+        # next_task on the backoff cadence; the backoff sleep below
+        # remains as the between-park breather (and the sole pacing
+        # when poll_wait_s is 0 or the server predates the hub)
+        tid = agent.run_once(wait_s=agent.options.poll_wait_s)
         if tid:
             print(f"completed task {tid}")
             idle_sleep = agent.options.min_poll_interval_s

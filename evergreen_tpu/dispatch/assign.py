@@ -7,6 +7,7 @@ mark it dispatched. The CAS pair is the system's dispatch-race guard.
 """
 from __future__ import annotations
 
+import threading as _threading
 import time as _time
 from typing import Optional, Tuple
 
@@ -18,6 +19,39 @@ from ..models.lifecycle import mark_task_dispatched
 from ..models.task import Task
 from ..storage.store import Store
 from .dag_dispatcher import DispatcherService, TaskSpec
+
+
+#: per-store TTL cache of the large-parser task limit: the config-section
+#: read (two collection gets + dataclass build + override pass) ran once
+#: per assignment — measurable serial work at 10k pulls/s for a knob that
+#: changes at admin cadence
+_limit_cache: dict = {}
+_limit_cache_lock = _threading.Lock()
+_LIMIT_TTL_S = 5.0
+
+
+def _large_parser_limit(store: Store) -> int:
+    key = id(store)
+    now = _time.monotonic()
+    with _limit_cache_lock:
+        entry = _limit_cache.get(key)
+        if entry is not None and entry[0] is store and now - entry[1] < _LIMIT_TTL_S:
+            return entry[2]
+    from ..settings import TaskLimitsConfig
+
+    limit = TaskLimitsConfig.get(
+        store
+    ).max_concurrent_large_parser_project_tasks
+    with _limit_cache_lock:
+        _limit_cache[key] = (store, now, limit)
+        if len(_limit_cache) > 64:  # short-lived test stores must not pin
+            stale = [
+                k for k, v in _limit_cache.items()
+                if now - v[1] >= _LIMIT_TTL_S
+            ]
+            for k in stale:
+                del _limit_cache[k]
+    return limit
 
 
 class _LargeParserGuard:
@@ -39,12 +73,7 @@ class _LargeParserGuard:
 
     def blocks(self, t: Task) -> bool:
         if self._limit is None:
-            from ..settings import TaskLimitsConfig
-
-            self._limit = (
-                TaskLimitsConfig.get(self.store)
-                .max_concurrent_large_parser_project_tasks
-            )
+            self._limit = _large_parser_limit(self.store)
         if self._limit <= 0 or not self._version_is_large(t.version):
             return False
         if self._in_flight is None:
@@ -80,20 +109,35 @@ def assign_next_available_task(
 
     now = _time.time() if now is None else now
     if not _tracing.tracing_enabled():
-        return _assign_next_available_task(store, svc, host, now)
-    # dispatch is the last leg of the tick's span tree: parent into the
-    # most recent tick's trace (captured by run_tick) so one trace reads
-    # delta-drain → … → wal-commit → dispatch. Ring-only: assigns run at
-    # ~10k/s under drain and must never cost a store write.
-    with _tracing.attached(getattr(store, "_last_tick_trace", None)), \
-            _tracing.Tracer(store, "dispatch").span(
-                "dispatch_assign", store_write=False,
-                distro=host.distro_id,
-            ) as _span:
         t = _assign_next_available_task(store, svc, host, now)
-        if t is not None:
-            _span["attributes"]["task"] = t.id
-        return t
+    else:
+        # dispatch is the last leg of the tick's span tree: parent into
+        # the most recent tick's trace (captured by run_tick) so one
+        # trace reads delta-drain → … → wal-commit → dispatch.
+        # Ring-only: assigns run at ~10k/s under drain and must never
+        # cost a store write.
+        with _tracing.attached(getattr(store, "_last_tick_trace", None)), \
+                _tracing.Tracer(store, "dispatch").span(
+                    "dispatch_assign", store_write=False,
+                    distro=host.distro_id,
+                ) as _span:
+            t = _assign_next_available_task(store, svc, host, now)
+            if t is not None:
+                _span["attributes"]["task"] = t.id
+    # decay the long-poll hub's work ledger on proven absence
+    # (dispatch/longpoll.py): an EMPTY pull is evidence outstanding
+    # wake credit was overstated. Successful handouts deliberately do
+    # NOT debit here — a woken waiter already claimed its credit on
+    # exit, and debiting both sides systematically halved the promptly
+    # woken cohort (tasks then sat out the long-poll timeout when no
+    # instant completer swept them). Credit the fleet can't claim
+    # (taken by busy non-parked agents) decays one empty pull at a
+    # time, which is the cheap direction.
+    if t is None:
+        hub = getattr(store, "_longpoll_hub", None)
+        if hub is not None:
+            hub.note_empty(host.distro_id)
+    return t
 
 
 def assign_next_available_task_fleet(

@@ -79,12 +79,41 @@ class DAGDispatcher:
         self._dispatched: set = set()
         self._pos: Dict[str, int] = {}
         self._next_live: List[int] = [0]
+        #: queue-change generation source (dispatch/longpoll.py hub):
+        #: lets the per-pull refresh fast path be one int compare
+        #: instead of a queue-doc read under two locks — at 10k pulling
+        #: agents that read was the first global serialization point
+        from .longpoll import hub_for
+
+        self._hub = hub_for(store)
+        self._seen_gen = -1
+        #: TTL'd running-host count per task-group unit: the max-hosts
+        #: admission check was a full host-collection scan per group
+        #: handout UNDER the dispatcher lock — O(fleet) serialized work.
+        #: The cache recounts at most every GROUP_COUNT_TTL_S and is
+        #: incremented locally on handout, so within one window the
+        #: check can only be CONSERVATIVE (over-count), never over-admit
+        #: beyond the CAS race the reference also carries.
+        self._grp_running: Dict[str, list] = {}
+
+    GROUP_COUNT_TTL_S = 0.25
 
     # -- rebuild ------------------------------------------------------------ #
 
     def refresh(self, now: Optional[float] = None, force: bool = False) -> None:
         now = _time.time() if now is None else now
+        # generation fast path (no locks, no store reads): the long-poll
+        # hub's listener bumps a per-distro int on ANY journaled write
+        # to the queue docs, so an unchanged generation inside the TTL
+        # means the doc-stamp compare below could only answer "still
+        # fresh". Racy by design — a concurrent bump at worst sends us
+        # into the locked slow path.
+        if not force:
+            gen = self._hub.generation(self.distro_id)
+            if gen == self._seen_gen and now - self._last_updated < self.ttl_s:
+                return
         with self._lock:
+            gen = self._hub.generation(self.distro_id)
             if not force and now - self._last_updated < self.ttl_s:
                 # dependency-wake fast path: a MarkEnd flipped queue flags
                 # and stamped the doc dirty (dispatch/wake.py) — rebuild
@@ -95,6 +124,7 @@ class DAGDispatcher:
                     stamp = max(doc.get("generated_at", 0.0),
                                 doc.get("dirty_at", 0.0))
                 if stamp <= self._loaded_stamp:
+                    self._seen_gen = gen
                     return
             queue = tq_mod.load(self.store, self.distro_id,
                                 secondary=self.secondary)
@@ -103,6 +133,7 @@ class DAGDispatcher:
                 max(doc.get("generated_at", 0.0), doc.get("dirty_at", 0.0))
                 if doc else 0.0
             )
+            self._seen_gen = gen
             self.rebuild(queue.queue if queue else [], now)
 
     def rebuild(self, items: List[TaskQueueItem], now: float) -> None:
@@ -140,6 +171,7 @@ class DAGDispatcher:
             # rescan is its slow-path-budget risk at this depth.
             self._pos = {it.id: i for i, it in enumerate(self._sorted)}
             self._next_live = list(range(len(self._sorted) + 1))
+            self._grp_running = {}
             self._last_updated = now
 
     def _first_live(self, i: int) -> int:
@@ -189,12 +221,21 @@ class DAGDispatcher:
     def find_next_task(
         self, spec: TaskSpec, now: Optional[float] = None
     ) -> Optional[TaskQueueItem]:
-        """The agent-facing handout (reference FindNextTask :258-492)."""
+        """The agent-facing handout (reference FindNextTask :258-492).
+
+        Concurrency shape (ISSUE 11): plain queue items are RESERVED
+        under the dispatcher lock (dispatched-set + skip-pointer consume
+        — a few dict ops) and re-validated against the live task doc
+        OUTSIDE it, so the one lock every agent serializes on is held
+        for microseconds, not for store reads and Task materialization.
+        A reservation that fails validation loops for the next
+        candidate, exactly like the old in-lock continue."""
         now = _time.time() if now is None else now
-        with self._lock:
-            # Task-group stickiness: a host that just ran a group task gets
-            # the group's next task if any remain (:269-282).
-            if spec.group:
+        if spec.group:
+            with self._lock:
+                # Task-group stickiness: a host that just ran a group
+                # task gets the group's next task if any remain
+                # (:269-282).
                 gid = composite_group_id(
                     spec.group, spec.build_variant, spec.project, spec.version
                 )
@@ -203,54 +244,90 @@ class DAGDispatcher:
                     nxt = self._next_task_group_task(unit)
                     if nxt is not None:
                         return nxt
+        while True:
+            with self._lock:
+                res = self._scan_next()
+            if res is None:
+                return None
+            kind, it = res
+            if kind == "group":
+                return it
+            # solo item, already reserved: re-validate against the live
+            # document outside the dispatcher lock. Raw-doc checks first
+            # — the common dependency-free task never pays a Task
+            # materialization here (the assign layer builds its own for
+            # the dispatchability gate).
+            doc = task_mod.coll(self.store).get(it.id)
+            if doc is None:
+                return None
+            if doc.get("start_time", 0.0) > 0.0:
+                continue
+            deps = doc.get("depends_on")
+            if deps and not doc.get("override_dependencies", False):
+                if not self._deps_met_fresh(task_mod.Task.from_doc(doc)):
+                    continue
+            return it
 
-            n = len(self._sorted)
-            i = self._first_live(0)
-            while i < n:
-                it = self._sorted[i]
-                i = self._first_live(i + 1)
-                if it.task_group_max_hosts == 0:
-                    if it.id in self._dispatched:
-                        self._consume(it.id)
-                        continue
-                    if not it.dependencies_met:
-                        continue  # transient: stays in the scan order
-                    self._dispatched.add(it.id)
+    def _scan_next(self):
+        """One pass over the live scan order (under the lock): reserve
+        and return the next plain candidate as ``("solo", item)`` — its
+        live-doc validation happens outside — or hand out a group task
+        as ``("group", item)`` (group semantics need the unit state, so
+        they stay under the lock; the max-hosts fleet scan is TTL-cached
+        in ``_grp_running``)."""
+        n = len(self._sorted)
+        i = self._first_live(0)
+        while i < n:
+            it = self._sorted[i]
+            i = self._first_live(i + 1)
+            if it.task_group_max_hosts == 0:
+                if it.id in self._dispatched:
                     self._consume(it.id)
-                    t = task_mod.get(self.store, it.id)
-                    if t is None:
-                        return None
-                    if t.start_time > 0.0:
-                        continue
-                    if not self._deps_met_fresh(t):
-                        continue
-                    return it
-                else:
-                    gid = composite_group_id(
-                        it.task_group, it.build_variant, it.project, it.version
-                    )
-                    unit = self._groups.get(gid)
-                    if unit is None:
-                        # group removed (single-host blocking): dead slot
-                        self._consume(it.id)
-                        continue
-                    if not self._group_has_dispatchable(unit):
-                        if all(g.id in self._dispatched for g in unit.tasks):
-                            # fully handed out — permanently done this epoch
-                            self._consume(it.id)
-                        continue
-                    running = host_mod.coll(self.store).count(
-                        lambda doc: doc["running_task_group"] == unit.group
-                        and doc["running_task_build_variant"] == unit.variant
-                        and doc["running_task_project"] == unit.project
-                        and doc["running_task_version"] == unit.version
-                    )
-                    if running >= unit.max_hosts > 0:
-                        continue
-                    nxt = self._next_task_group_task(unit)
-                    if nxt is not None:
-                        return nxt
-            return None
+                    continue
+                if not it.dependencies_met:
+                    continue  # transient: stays in the scan order
+                self._dispatched.add(it.id)
+                self._consume(it.id)
+                return "solo", it
+            gid = composite_group_id(
+                it.task_group, it.build_variant, it.project, it.version
+            )
+            unit = self._groups.get(gid)
+            if unit is None:
+                # group removed (single-host blocking): dead slot
+                self._consume(it.id)
+                continue
+            if not self._group_has_dispatchable(unit):
+                if all(g.id in self._dispatched for g in unit.tasks):
+                    # fully handed out — permanently done this epoch
+                    self._consume(it.id)
+                continue
+            if self._group_running(unit) >= unit.max_hosts > 0:
+                continue
+            nxt = self._next_task_group_task(unit)
+            if nxt is not None:
+                entry = self._grp_running.get(unit.id)
+                if entry is not None:
+                    entry[1] += 1  # conservative until the TTL recount
+                return "group", nxt
+        return None
+
+    def _group_running(self, unit: _GroupUnit) -> int:
+        """Hosts currently running this group, recounted at most every
+        GROUP_COUNT_TTL_S (the scan is O(fleet) and used to run per
+        group handout under the dispatcher lock)."""
+        entry = self._grp_running.get(unit.id)
+        now_mono = _time.monotonic()
+        if entry is not None and now_mono - entry[0] < self.GROUP_COUNT_TTL_S:
+            return entry[1]
+        running = host_mod.coll(self.store).count(
+            lambda doc: doc["running_task_group"] == unit.group
+            and doc["running_task_build_variant"] == unit.variant
+            and doc["running_task_project"] == unit.project
+            and doc["running_task_version"] == unit.version
+        )
+        self._grp_running[unit.id] = [now_mono, running]
+        return running
 
     def _group_has_dispatchable(self, unit: _GroupUnit) -> bool:
         return any(

@@ -31,8 +31,12 @@ def wake_dependents(store: Store, ready_ids: List[str], now: float) -> int:
         for sd in doc.get("secondary_distros", []):
             by_distro.setdefault(sd, []).append(tid)
 
+    from .longpoll import hub_for
+
+    hub = hub_for(store)
     n = 0
     for distro_id, tids in by_distro.items():
+        n_start = n
         for secondary in (False, True):
             coll = tq_mod.coll(store, secondary)
             qdoc = coll.get(distro_id)
@@ -66,4 +70,13 @@ def wake_dependents(store: Store, ready_ids: List[str], now: float) -> int:
             if updated:
                 # bump the dirty stamp so dispatchers rebuild on next poll
                 coll.update(distro_id, {"dirty_at": now})
+        flipped = n - n_start
+        if flipped:
+            # the stamp write above already bumped the hub's generation
+            # (collection listener); this wakes the PARKED long-pollers,
+            # sized to the entries that actually FLIPPED (not the
+            # candidate set — an inflated hint both stampedes parked
+            # agents and overstates the wake ledger, which then bleeds
+            # out one empty re-check pull at a time)
+            hub.notify(distro_id, n_hint=flipped)
     return n

@@ -217,6 +217,34 @@ class Environment:
         if webhook_secret:
             api.webhook_secret = webhook_secret
 
+        # follower reads (ISSUE 11): a durable writer grows an
+        # in-process WAL-tailing replica of its own data dir and hands
+        # it to the REST surface — list/read GETs serve from the
+        # replica's collections (separate locks, so UI scrapes stop
+        # contending the tick's collection locks) when its staleness is
+        # under ReadPathConfig's bound, and at overload RED expensive
+        # reads degrade to it before 429ing
+        if lease is not None:
+            try:
+                from .settings import ReadPathConfig
+
+                if ReadPathConfig.get(store).follower_reads_enabled:
+                    from .storage.replica import ReplicaStore
+
+                    # default (process-unique) replica id: a "local"
+                    # constant would let a restarted writer's ETags
+                    # falsely validate against the previous process's
+                    # (generation counters restart at zero)
+                    follower = ReplicaStore(
+                        data_dir, poll_interval_s=0.25,
+                    )
+                    follower.start()
+                    api.attach_read_replica(follower)
+                    closers.append(follower.close)
+            except Exception as exc:  # noqa: BLE001 — follower reads
+                # are an optimization; the primary serves without them
+                print(f"follower-read replica unavailable: {exc!r}")
+
         env = cls(
             store=store, api=api, lease=lease, is_replica=is_replica,
             recovery_report=recovery_report, _closers=closers,

@@ -43,9 +43,19 @@ class Event:
     data: dict = dataclasses.field(default_factory=dict)
 
     def to_doc(self) -> dict:
-        doc = dataclasses.asdict(self)
-        doc["_id"] = doc.pop("id")
-        return doc
+        # hand-rolled flat doc: dataclasses.asdict's recursive deepcopy
+        # was 40% of the agent dispatch cycle (two events per handout at
+        # 10k pulls/s). Event payloads are small flat dicts — a shallow
+        # copy keeps the doc detached from the caller's mapping.
+        return {
+            "_id": self.id,
+            "resource_type": self.resource_type,
+            "event_type": self.event_type,
+            "resource_id": self.resource_id,
+            "timestamp": self.timestamp,
+            "processed_at": self.processed_at,
+            "data": dict(self.data),
+        }
 
     @classmethod
     def from_doc(cls, doc: dict) -> "Event":

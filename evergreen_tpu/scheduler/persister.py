@@ -198,6 +198,23 @@ def persister_state_for(store: Store) -> PersisterState:
         return entry[1]
 
 
+def fingerprint_version(
+    store: Store, distro_id: str, secondary: bool = False
+) -> Optional[int]:
+    """The delta persister's in-memory version watermark for one queue
+    doc, or None when this store has no live fingerprint (replicas,
+    cold processes — the caller falls back to the doc's own ``v``).
+    This is the read cache's change token (api/readcache.py): every
+    content-changing write shape bumps it, a skip leaves it, so an
+    unchanged token certifies an unchanged serialized answer."""
+    with _states_lock:
+        entry = _states.get(id(store))
+    if entry is None or entry[0] is not store:
+        return None
+    fp = entry[1]._fps.get((distro_id, secondary))
+    return fp.v if fp is not None and fp.v >= 0 else None
+
+
 def _plan_col(values, rows_plan, default, dtype) -> "np.ndarray":
     """Dynamic column in PLAN order as numpy: id-keyed dict (serial/cmp
     paths) or a positionally aligned sequence (the solve's unpack)."""
@@ -311,6 +328,7 @@ def persist_task_queue(
 
     same_met = False
     handled = False
+    skipped_write = False
 
     if same_plan:
         # project the plan-order columns into the doc's sorted alignment
@@ -327,6 +345,7 @@ def persist_task_queue(
             if state is not None:
                 state.skipped += 1
             handled = True
+            skipped_write = True
         else:
             # only dynamic columns moved: a versioned patch of JUST the
             # changed fields — sparse when few entries moved, so the WAL
@@ -424,6 +443,17 @@ def persist_task_queue(
             store, [tid for tid, _ in cand], now,
             deps_met_ids=[tid for tid, met in cand if met],
         )
+    if not skipped_write:
+        # a persisted content change is the scheduler-side arrival
+        # signal for parked long-pollers (dispatch/longpoll.py): wake a
+        # BOUNDED probe cohort — the ledger plus the completer sweep
+        # (an agent that finishes a task pulls again) drain anything
+        # deeper, and under-estimation decays via re-check claims
+        hub = getattr(store, "_longpoll_hub", None)
+        if hub is not None:
+            hub.notify(
+                distro_id, n_hint=min(32, max(1, len(plan) // 8))
+            )
     return len(plan)
 
 

@@ -463,6 +463,55 @@ class RateLimitConfig(ConfigSection):
 
 @register_section
 @dataclasses.dataclass
+class ReadPathConfig(ConfigSection):
+    """Read-serving plane knobs (ISSUE 11): follower reads off the WAL-
+    tailing replica, the fingerprint ETag/response cache, and the
+    sharded long-poll dispatch hub. Consumed by api/rest.py (routing +
+    cache), api/readcache.py, storage/replica.py (poll cadence is the
+    replica's own knob), and dispatch/longpoll.py."""
+
+    section_id = "read_path"
+
+    #: master switch for replica-backed follower reads (the ETag cache
+    #: and long-poll hub have their own switches below)
+    follower_reads_enabled: bool = True
+    #: serve a list/read from the replica only when its staleness is
+    #: under this bound; above it the primary serves as before
+    staleness_bound_ms: float = 2000.0
+    #: at RED, expensive reads degrade to replica serving under this
+    #: LOOSER bound (with a Warning header) before falling back to 429
+    degraded_staleness_bound_ms: float = 30000.0
+    #: fingerprint ETag + in-process response cache
+    cache_enabled: bool = True
+    cache_max_entries: int = 256
+    #: long-poll dispatch: agents may park on next_task up to this long
+    #: (?wait= is clamped to it); 0 disables server-side parking
+    longpoll_max_wait_s: float = 30.0
+    #: condition-variable shards the parked agents spread across (bounds
+    #: the wake-storm convoy on any single mutex)
+    longpoll_shards: int = 32
+    #: parked waiters re-check their queue generation at least this
+    #: often even without a wake (starvation bound for bounded wakes)
+    longpoll_recheck_s: float = 1.0
+
+    def validate_and_default(self) -> str:
+        if self.staleness_bound_ms < 0 or self.degraded_staleness_bound_ms < 0:
+            return "staleness bounds must be >= 0"
+        if self.degraded_staleness_bound_ms < self.staleness_bound_ms:
+            return (
+                "degraded_staleness_bound_ms must be >= staleness_bound_ms"
+            )
+        if self.cache_max_entries < 0:
+            return "cache_max_entries must be >= 0"
+        if self.longpoll_max_wait_s < 0 or self.longpoll_recheck_s <= 0:
+            return "long-poll waits must be >= 0 (recheck > 0)"
+        if self.longpoll_shards < 1:
+            self.longpoll_shards = 1
+        return ""
+
+
+@register_section
+@dataclasses.dataclass
 class OverloadConfig(ConfigSection):
     """Overload-protection ladder knobs (consumed by
     utils/overload.LoadMonitor and every seam that consults it: the

@@ -78,6 +78,11 @@ from ..utils import metrics as _metrics
 
 SNAPSHOT_FILE = "snapshot.json"
 WAL_FILE = "wal.log"
+#: sidecar suffix for the tiny checkpoint watermark ``{"seq","epoch"}``
+#: (written atomically BEFORE the snapshot renames into place) — a
+#: tailing replica reads it to decide whether a fresh snapshot holds
+#: anything it hasn't already applied, without parsing the snapshot
+SNAPSHOT_META_SUFFIX = ".meta"
 
 
 def fleet_segment_ids(data_dir: str) -> list:
@@ -152,6 +157,14 @@ class _Journal:
             pass
         self._fh = open(path, "a", encoding="utf-8")
         self.ops = 0
+        #: monotone count of terminated WAL LINES ever written to this
+        #: log (across rotations; base re-derived at recovery from the
+        #: snapshot's ``seq`` + a file line count). This is the
+        #: replication watermark: a replica counts the lines it reads
+        #: from offset 0 on the same rule, so
+        #: ``snapshot seq <= replica seq`` means the snapshot holds
+        #: nothing the replica hasn't applied
+        self.total_lines = 0
         self.suspended = False  # True during recovery replay
         #: writer's fencing epoch (0 = unfenced): stamped onto EVERY
         #: record — group frames and per-op lines alike — so replay can
@@ -225,13 +238,19 @@ class _Journal:
         from ..utils import faults
 
         directive = faults.fire("wal.commit")
+        import time as __time
+
+        # commit wall time rides the frame ("ts") so a tailing replica
+        # can measure its lag in TIME, not just bytes — one field per
+        # tick frame, never per buffered op
+        ts = round(__time.time(), 3)
         if epoch:
-            frame = '{"o":"g","n":%d,"e":%d,"rs":[%s]}' % (
-                len(records), epoch, ",".join(records)
+            frame = '{"o":"g","n":%d,"e":%d,"ts":%s,"rs":[%s]}' % (
+                len(records), epoch, ts, ",".join(records)
             )
         else:
-            frame = '{"o":"g","n":%d,"rs":[%s]}' % (
-                len(records), ",".join(records)
+            frame = '{"o":"g","n":%d,"ts":%s,"rs":[%s]}' % (
+                len(records), ts, ",".join(records)
             )
         self._write_line(frame, directive, n_ops=len(records))
 
@@ -249,6 +268,15 @@ class _Journal:
                 # every later record stays intact
                 self._fh.write("\n")
                 self._torn = False
+                self.total_lines += 1  # the stub is now a (garbage) line
+            self.total_lines += 1
+            # stamp the line's ordinal ("s") into the record: replicas
+            # track their applied watermark as max(seq seen), which is
+            # IDEMPOTENT — a re-read generation, a skipped garbage line
+            # or a torn stub can never drift the watermark the way a
+            # counted tail could (every line still ends "}", so the
+            # splice is well-formed JSON)
+            line = '%s,"s":%d}' % (line[:-1], self.total_lines)
             self._fh.write(line + "\n")
             if self.sync != "none":
                 self._fh.flush()
@@ -257,11 +285,19 @@ class _Journal:
             self.ops += n_ops
 
     def rotate(self) -> None:
-        """Truncate after a successful snapshot (under the caller's
-        whole-store quiesce)."""
+        """Start a fresh log generation after a successful snapshot
+        (under the caller's whole-store quiesce). The new log is an
+        atomically-renamed NEW file — a fresh inode — so a tailing
+        replica can tell "truncated and already regrown past my offset"
+        from "still the generation I was reading" (an in-place truncate
+        is invisible once the file regrows)."""
         with self._lock:
             self._fh.close()
-            self._fh = open(self.path, "w", encoding="utf-8")
+            tmp = self.path + ".new"
+            with open(tmp, "w", encoding="utf-8"):
+                pass
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
             self.ops = 0
 
     def close(self) -> None:
@@ -559,6 +595,13 @@ class DurableStore(Store):
                 with self._flush_cv:
                     self._flush_errors.append(exc)
 
+    @property
+    def wal_seq(self) -> int:
+        """Monotone count of WAL lines ever journaled by this store —
+        the primary-side replication watermark a replica's applied seq
+        converges to (tools/read_parity.py's lag-0 equality check)."""
+        return self._journal.total_lines
+
     def flush_backlog(self) -> int:
         """Frames waiting on (or being written by) the async flusher —
         the WAL-backlog signal the overload monitor fuses
@@ -608,6 +651,8 @@ class DurableStore(Store):
         snap_path = os.path.join(self.data_dir, self._snapshot_name)
         self._journal.suspended = True
         max_epoch = 0
+        snap_seq = 0
+        wal_lines = 0
         try:
             if os.path.exists(snap_path):
                 with open(snap_path, encoding="utf-8") as fh:
@@ -621,6 +666,9 @@ class DurableStore(Store):
                 # deposed holder appends to the rotated log still rank
                 # below it
                 max_epoch = int(snap.get("epoch", 0) or 0)
+                # line-seq watermark at the checkpoint cut: the base the
+                # replication seq counts up from
+                snap_seq = int(snap.get("seq", 0) or 0)
             wal_path = self._journal.path
             report = self.replay_report
             if os.path.exists(wal_path):
@@ -628,6 +676,7 @@ class DurableStore(Store):
                     for line in fh:
                         if not line.endswith("\n"):
                             break  # torn final line from a crash mid-append
+                        wal_lines += 1
                         try:
                             rec = json.loads(line)
                         except json.JSONDecodeError:
@@ -658,6 +707,11 @@ class DurableStore(Store):
                             max_epoch = e
                         self._apply(rec)
             report["wal_max_epoch"] = max_epoch
+            # re-seed the monotone line counter so a restarted writer
+            # keeps numbering where the previous one stopped (every
+            # TERMINATED line counts, parseable or not — the replica
+            # counts the lines it reads on the same rule)
+            self._journal.total_lines = snap_seq + wal_lines
         finally:
             self._journal.suspended = False
 
@@ -726,11 +780,29 @@ class DurableStore(Store):
                 "epoch": max(
                     self.epoch, self.replay_report["wal_max_epoch"]
                 ),
+                # the line-seq watermark at this cut (writers are
+                # quiesced, so the counter is stable): replicas compare
+                # it against their own applied seq to skip reloading a
+                # snapshot that holds nothing new
+                "seq": self._journal.total_lines,
             }
             with open(tmp_path, "w", encoding="utf-8") as fh:
                 json.dump(payload, fh, separators=(",", ":"), default=str)
                 fh.flush()
                 os.fsync(fh.fileno())
+            # the tiny meta sidecar lands BEFORE the snapshot renames:
+            # a crash between the two leaves a new meta beside the OLD
+            # snapshot, which no reader consults (the snapshot's stat is
+            # unchanged and the WAL was not truncated). Once the rename
+            # lands, meta and snapshot agree by construction.
+            meta_path = snap_path + SNAPSHOT_META_SUFFIX
+            with open(meta_path + ".tmp", "w", encoding="utf-8") as fh:
+                json.dump(
+                    {"seq": payload["seq"], "epoch": payload["epoch"]}, fh
+                )
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(meta_path + ".tmp", meta_path)
             os.replace(tmp_path, snap_path)
             self._journal.rotate()
         finally:

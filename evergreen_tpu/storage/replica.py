@@ -13,22 +13,60 @@ mutation against the writer. Lag is bounded by the poll interval;
 consistency is per-document (the WAL is full-document puts in apply
 order).
 
-Checkpoint handling: the primary's compaction atomically replaces the
-snapshot then truncates the WAL in place. The replica detects the
-truncation (tail position beyond file size), reloads the fresh snapshot,
-and replays from offset 0 — full-document puts make any overlap
-idempotent. A torn final line (primary mid-append) leaves the tail
-position at the line start for the next poll.
+Checkpoint handling is INCREMENTAL (ISSUE 11): the primary's compaction
+atomically replaces the snapshot (after writing a tiny ``.meta``
+watermark sidecar) then rotates the WAL onto a fresh inode. The replica
+detects the rotation (tail position beyond file size, or the inode
+changed) and compares the sidecar's line-seq watermark against its own
+applied seq: a caught-up replica adopts the watermark and tails the new
+generation from zero — zero content reload, so absorbing a checkpoint
+costs O(1) instead of O(store). Only a replica BEHIND the cut reloads
+the snapshot (counted in ``replica_full_reloads_total``). A torn final
+line (primary mid-append) leaves the tail position at the line start
+for the next poll.
+
+Read-path serving (api/rest.py follower reads) consults two gates:
+``staleness_ms()`` (time since the tail last reached WAL EOF plus the
+frame commit→apply gap) against the configured bound, and
+``serve_ready()`` — False between observing a fence marker that
+supersedes an epoch this replica had been serving and applying the new
+holder's first record, so a failover's pre-recovery state is never
+handed to readers.
 """
 from __future__ import annotations
 
 import json
 import os
 import threading
+import time as _time
 from typing import Callable, Dict, Iterable, Optional
 
-from .durable import SNAPSHOT_FILE, WAL_FILE
+from .durable import SNAPSHOT_FILE, SNAPSHOT_META_SUFFIX, WAL_FILE
 from .store import Collection, Store, apply_wal_record
+from ..utils import metrics as _metrics
+
+REPLICA_FULL_RELOADS = _metrics.counter(
+    "replica_full_reloads_total",
+    "Full snapshot reloads on a WAL-tailing replica. A caught-up "
+    "replica absorbs the primary's checkpoints by watermark compare "
+    "alone — this counter moving with store size is the regression the "
+    "incremental tail exists to prevent.",
+    labels=("replica",),
+)
+REPLICA_LAG_MS = _metrics.gauge(
+    "replica_lag_ms",
+    "Read-replica staleness bound at the last poll: time since the "
+    "replica last reached the end of the primary's WAL (plus the frame "
+    "commit-to-apply gap when replaying).",
+    labels=("replica",),
+)
+REPLICA_FENCE_BLOCKED = _metrics.counter(
+    "replica_fence_blocked_total",
+    "Polls during which the replica refused to serve reads because it "
+    "observed a fence marker (a new lease holder exists) but has not "
+    "yet applied any of the new holder's frames.",
+    labels=("replica",),
+)
 
 
 class ReplicaReadOnly(RuntimeError):
@@ -113,11 +151,24 @@ class ReplicaStore(Store):
         data_dir: str,
         primary_url: str = "",
         poll_interval_s: float = 0.5,
+        replica_id: str = "",
     ) -> None:
         super().__init__()
         self.data_dir = data_dir
         self.primary_url = primary_url
         self.poll_interval_s = poll_interval_s
+        #: identity for the per-replica metric series AND the ETag
+        #: store tag. The default is PROCESS-UNIQUE on purpose: two
+        #: replicas behind one load balancer mint ETags from
+        #: process-local generation counters, so two processes sharing
+        #: a tag could false-304 each other's validators (same counter
+        #: value, different content). Bounded per process — each
+        #: process has its own metrics registry.
+        if not replica_id:
+            import uuid as _uuid
+
+            replica_id = f"r-{_uuid.uuid4().hex[:8]}"
+        self.replica_id = replica_id
         #: thread-local write permission; only replay code sets .on
         self._applying = threading.local()
         #: serializes poll()/_load_snapshot: the background tail thread
@@ -133,15 +184,97 @@ class ReplicaStore(Store):
         #: next recovery will discard
         self._max_epoch = 0
         self.stale_frames_skipped = 0
+        #: read-path fence gate: a fence marker with a NEWER epoch than
+        #: the state we have been serving means a failover happened and
+        #: the new holder's recovery may be rewriting derived state —
+        #: serving stops until one of the new holder's records (or its
+        #: snapshot) is applied. 0 = not pending.
+        self._fence_epoch_pending = 0
+        #: highest epoch of state actually APPLIED here (-1 = nothing
+        #: yet): the fence gate keys on this, so a fresh replica reading
+        #: a holder's open-time marker before any content never blocks,
+        #: while served epoch-0 (pre-lease) history superseded by a
+        #: leased holder does
+        self._applied_epoch = -1
+        self.full_reloads = 0
+        #: replication watermark: ``_base_seq`` is the primary's line
+        #: seq at the snapshot we loaded, ``_line_seq`` the highest
+        #: per-line ordinal stamp ("s", storage/durable.py) consumed
+        #: from the WAL. ``applied_seq = max(base, line_seq)`` is
+        #: directly comparable to the checkpoint sidecar's ``seq`` and
+        #: IDEMPOTENT under re-reads — a double-read generation or a
+        #: skipped garbage line cannot drift it
+        self._base_seq = 0
+        self._line_seq = 0
+        #: staleness tracking: monotonic stamp of the last poll that
+        #: reached WAL EOF, and the worst commit→apply gap that poll saw
+        self._caught_up_mono = 0.0
+        self._apply_gap_ms = 0.0
         #: identity of the snapshot we last loaded; a new checkpoint can
         #: replace the snapshot while leaving the WAL at/below our tail
         #: position (e.g. both empty), so truncation detection alone is
         #: not enough
         self._snap_stat: Optional[tuple] = None
+        #: inode of the WAL generation our tail offset refers to: the
+        #: primary's rotation lands a NEW file, so an offset from the
+        #: previous generation is invalid even when the new file already
+        #: grew past it
+        self._wal_ino: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._load_snapshot()
         self.poll()
+
+    # -- read-path serving state ----------------------------------------- #
+
+    @property
+    def applied_seq(self) -> int:
+        """Primary-comparable replication watermark (see ``wal_seq`` on
+        DurableStore): the snapshot base or the highest line ordinal
+        consumed, whichever is later."""
+        return max(self._base_seq, self._line_seq)
+
+    def serve_ready(self) -> bool:
+        """False while a fence marker is pending: a failover was
+        observed but none of the new holder's records have arrived yet,
+        so the state here is the deposed holder's — possibly ahead of
+        what the new holder's recovery will keep. The read router falls
+        back to the primary until the new epoch's first record lands."""
+        return self._fence_epoch_pending == 0
+
+    def staleness_ms(self, now_mono: Optional[float] = None) -> float:
+        """Upper bound on how far reads here trail the primary's WAL:
+        time since the tail last reached EOF, plus the commit→apply gap
+        that poll observed on its frames. Infinite before the first
+        successful poll."""
+        if not self._caught_up_mono:
+            return float("inf")
+        now_mono = _time.monotonic() if now_mono is None else now_mono
+        return max(
+            0.0, (now_mono - self._caught_up_mono) * 1e3
+        ) + self._apply_gap_ms
+
+    def _note_epoch(self, e: int, marker: bool) -> None:
+        """Fold one observed epoch into the fence state. ``marker``
+        distinguishes a holder's open-time fence record (announces the
+        holder exists) from applied state (proves that holder's writes
+        are flowing here). Applied epoch-0 records (pre-lease history)
+        count as state at epoch 0."""
+        if marker:
+            if e > 0:
+                if self._applied_epoch >= 0 and e > self._applied_epoch:
+                    # a NEW holder superseded state we had been serving
+                    self._fence_epoch_pending = max(
+                        self._fence_epoch_pending, e
+                    )
+                self._max_epoch = max(self._max_epoch, e)
+            return
+        if e > 0:
+            self._max_epoch = max(self._max_epoch, e)
+        self._applied_epoch = max(self._applied_epoch, e)
+        if self._fence_epoch_pending and e >= self._fence_epoch_pending:
+            # the new holder's writes reached us: serving resumes
+            self._fence_epoch_pending = 0
 
     # -- Store interface ------------------------------------------------- #
 
@@ -169,11 +302,31 @@ class ReplicaStore(Store):
     def _replace_all(coll: Collection, docs) -> None:
         """Swap a collection's contents in ONE lock hold so concurrent
         readers see either the old or the new state, never an empty or
-        half-loaded one."""
+        half-loaded one. Listeners get ONE synthetic notification — a
+        reload changes everything at once, and the read cache's
+        generation counters (api/readcache.py) must observe it or an
+        ETag would keep validating pre-reload answers."""
         with coll._lock:
             coll._docs = {d["_id"]: d for d in docs}
             coll._key_order_cache = None
             coll._order_rank = 0
+            coll._notify("__reload__")
+
+    def _read_meta(self) -> Optional[dict]:
+        """The checkpoint's tiny ``snapshot.json.meta`` watermark
+        sidecar ({"seq", "epoch"}), or None for pre-watermark data dirs
+        (then every checkpoint costs a full reload, the old behavior)."""
+        try:
+            with open(
+                os.path.join(
+                    self.data_dir, SNAPSHOT_FILE + SNAPSHOT_META_SUFFIX
+                ),
+                encoding="utf-8",
+            ) as fh:
+                meta = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return meta if isinstance(meta, dict) else None
 
     def _load_snapshot(self) -> None:
         snap_path = os.path.join(self.data_dir, SNAPSHOT_FILE)
@@ -184,10 +337,13 @@ class ReplicaStore(Store):
                 snap = json.load(fh)
         loaded = snap.get("collections", {})
         # the snapshot's epoch watermark re-seeds the fence point after
-        # the primary's compaction truncated the WAL
-        self._max_epoch = max(
-            self._max_epoch, int(snap.get("epoch", 0) or 0)
-        )
+        # the primary's compaction truncated the WAL; a snapshot at (or
+        # past) a pending fence epoch IS the new holder's state, so
+        # serving resumes. An EMPTY snapshot is no state at all — it
+        # must not count as applied (a fresh replica on an empty dir
+        # would otherwise fence-block on the first holder's marker).
+        if loaded:
+            self._note_epoch(int(snap.get("epoch", 0) or 0), marker=False)
         with self._lock:
             names = set(self._collections) | set(loaded)
         for name in names:
@@ -195,6 +351,10 @@ class ReplicaStore(Store):
                 continue  # per-server state is never reset by replication
             self._replace_all(self.collection(name), loaded.get(name, []))
         self._wal_pos = 0
+        self._base_seq = int(snap.get("seq", 0) or 0)
+        self._line_seq = 0
+        self.full_reloads += 1
+        REPLICA_FULL_RELOADS.inc(replica=self.replica_id)
 
     def _apply(self, rec: dict) -> None:
         # the shared decoder (storage/store.py apply_wal_record) with the
@@ -211,23 +371,113 @@ class ReplicaStore(Store):
         with self._poll_lock:
             return self._poll_locked()
 
+    def _wal_stat(self, wal_path: str):
+        try:
+            st = os.stat(wal_path)
+            return st.st_size, st.st_ino
+        except FileNotFoundError:
+            return 0, None
+
     def _poll_locked(self) -> int:
         wal_path = os.path.join(self.data_dir, WAL_FILE)
-        size = (
-            os.path.getsize(wal_path) if os.path.exists(wal_path) else 0
-        )
-        if size < self._wal_pos or self._snapshot_stat() != self._snap_stat:
-            # primary checkpointed: fresh snapshot (+ truncated WAL).
-            # Snapshot-rename happens BEFORE wal truncation, so reloading
-            # snapshot then replaying whatever WAL remains can only
-            # re-apply full-document puts — idempotent.
-            self._load_snapshot()
-            size = (
-                os.path.getsize(wal_path) if os.path.exists(wal_path) else 0
-            )
-        if size == self._wal_pos:
-            return 0
         applied = 0
+        gap_ms = 0.0
+        for _pass in range(2):
+            size, ino = self._wal_stat(wal_path)
+            rotated = size < self._wal_pos or (
+                self._wal_ino is not None
+                and ino is not None
+                and ino != self._wal_ino
+            )
+            if rotated:
+                # the primary checkpointed and started a new WAL
+                # generation (fresh inode; a bare in-place shrink is
+                # the legacy pre-rotation shape). Our byte offset
+                # belongs to the OLD generation — even a new file
+                # already grown past it reads misaligned. The cheap
+                # path: the checkpoint's meta sidecar says what line
+                # seq the snapshot was cut at — if we had already
+                # applied that far, the snapshot holds nothing new and
+                # the new generation tails from zero with NO content
+                # reload; tailing cost stays proportional to write
+                # rate, not store size.
+                meta = self._read_meta()
+                if (
+                    meta is not None
+                    and int(meta.get("seq", -1)) <= self.applied_seq
+                ):
+                    self._snap_stat = self._snapshot_stat()
+                    self._base_seq = int(meta.get("seq", 0) or 0)
+                    self._line_seq = 0
+                    self._wal_pos = 0
+                    self._note_epoch(
+                        int(meta.get("epoch", 0) or 0), marker=False
+                    )
+                else:
+                    # behind the cut (or a pre-watermark dir): part of
+                    # the history now lives only in the snapshot —
+                    # reload it. Snapshot-rename happens BEFORE wal
+                    # rotation, so after the reload the new generation
+                    # only holds records the snapshot predates
+                    # (version-guarded where an overlap could
+                    # double-apply).
+                    self._load_snapshot()
+                size, ino = self._wal_stat(wal_path)
+            self._wal_ino = ino
+            n, g = self._read_wal(wal_path, size)
+            applied += n
+            gap_ms = max(gap_ms, g)
+            # post-read checkpoint audit: a fresh snapshot whose meta
+            # watermark we have caught up to is adopted in place; one
+            # we remain BEHIND after reading every line available means
+            # the missing history lives only in the snapshot (the
+            # rotation happened entirely between two polls, so no
+            # offset/inode signal ever fired) — reload and take one
+            # more read pass over the new generation
+            if self._snapshot_stat() == self._snap_stat:
+                break
+            meta = self._read_meta()
+            if (
+                meta is not None
+                and int(meta.get("seq", -1)) <= self.applied_seq
+            ):
+                self._snap_stat = self._snapshot_stat()
+                self._note_epoch(
+                    int(meta.get("epoch", 0) or 0), marker=False
+                )
+                break
+            self._load_snapshot()
+            post_size, post_ino = self._wal_stat(wal_path)
+            if post_ino is not None and post_ino == ino:
+                # the OLD generation is still in place (we caught the
+                # window between snapshot rename and rotation): every
+                # line in it is already inside the snapshot we just
+                # loaded — re-reading it from zero would double-count
+                # the generation into applied_seq (inflating the
+                # watermark past the primary's numbering, which could
+                # later skip a genuinely needed reload). Skip to its
+                # end; the rotation lands a new inode and resets us.
+                self._wal_pos = post_size
+                break
+        # reached EOF (possibly with a torn tail pending — the data
+        # before it is as fresh as the file goes): refresh the staleness
+        # clock and the exported lag gauge
+        self._caught_up_mono = _time.monotonic()
+        self._apply_gap_ms = gap_ms
+        REPLICA_LAG_MS.set(
+            round(self.staleness_ms(), 3), replica=self.replica_id
+        )
+        if self._fence_epoch_pending:
+            REPLICA_FENCE_BLOCKED.inc(replica=self.replica_id)
+        return applied
+
+    def _read_wal(self, wal_path: str, size: int):
+        """Apply every terminated line from the tail position to EOF;
+        returns (records applied, worst commit→apply gap ms)."""
+        applied = 0
+        gap_ms = 0.0
+        if size == self._wal_pos:
+            return applied, gap_ms
         self._applying.on = True
         try:
             with open(wal_path, "rb") as fh:
@@ -239,43 +489,49 @@ class ReplicaStore(Store):
                         # torn tail (primary mid-append): retry next poll
                         self._wal_pos = line_start
                         break
+                    self._wal_pos = fh.tell()
                     try:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         # a TERMINATED line that doesn't parse can never
-                        # become valid — skipping it loses one record but
-                        # halting here would stall replication forever
-                        self._wal_pos = fh.tell()
+                        # become valid — skipping it loses one record
+                        # but halting here would stall replication
+                        # forever
                         continue
                     if rec.get("c") in LOCAL_SCRATCH_COLLECTIONS:
                         # the primary's per-server scratch (rate-limit
                         # windows) must not clobber this replica's own
-                        self._wal_pos = fh.tell()
                         continue
                     op = rec.get("o")
                     if op == "f":
                         # a holder's open-time fence marker: advance the
-                        # fence point, nothing to apply
-                        self._max_epoch = max(
-                            self._max_epoch, int(rec.get("e", 0) or 0)
+                        # fence point, nothing to apply — and if it
+                        # supersedes an epoch we had been serving, stop
+                        # serving until the new holder's records arrive
+                        self._note_epoch(
+                            int(rec.get("e", 0) or 0), marker=True
                         )
-                        self._wal_pos = fh.tell()
                         continue
+                    s = rec.get("s")
+                    if s:
+                        self._line_seq = max(self._line_seq, int(s))
                     e = int(rec.get("e", 0) or 0)
                     if e and e < self._max_epoch:
                         # superseded-epoch write (group frame OR per-op
                         # line) past the fence point
                         self.stale_frames_skipped += 1
-                        self._wal_pos = fh.tell()
                         continue
-                    if e:
-                        self._max_epoch = max(self._max_epoch, e)
+                    self._note_epoch(e, marker=False)
+                    ts = rec.get("ts")
+                    if ts:
+                        gap_ms = max(
+                            gap_ms, (_time.time() - float(ts)) * 1e3
+                        )
                     self._apply(rec)
                     applied += 1
-                    self._wal_pos = fh.tell()
         finally:
             self._applying.on = False
-        return applied
+        return applied, gap_ms
 
     # -- background tail -------------------------------------------------- #
 

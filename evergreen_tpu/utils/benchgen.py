@@ -154,6 +154,7 @@ def bench_result_payload(
     resident: dict = None,
     sharded_plane: dict = None,
     capacity: dict = None,
+    read_path: dict = None,
 ) -> dict:
     """The BENCH JSON line. ``pipelined_tick_ms`` appears ONLY when the
     measured timeline proves the overlap (VERDICT r5 ask #3) — an
@@ -212,6 +213,13 @@ def bench_result_payload(
         out["capacity"] = capacity
         if "capacity_solve_ms" in capacity:
             out["capacity_solve_ms"] = capacity["capacity_solve_ms"]
+    if read_path:
+        # the read-serving-plane arm (ISSUE 11, tools/read_parity.py
+        # measure_read_path): replica lag p50/p99, fingerprint-ETag 304
+        # hit-rate on an unchanged-queue scrape storm, and long-poll
+        # dispatch p99 at 1k/10k parked agents — perf_guard enforces
+        # the hit-rate and 10k-p99 bounds
+        out["read_path"] = read_path
     if overlap_proven:
         out["pipelined_tick_ms"] = round(pipe_med, 2)
     return out

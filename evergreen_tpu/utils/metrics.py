@@ -63,6 +63,8 @@ ALLOWED_LABELS = frozenset(
         "outcome",     # success/failure-ish result buckets
         "shard",       # scheduler shard id (bounded by the shard count)
         "pool",        # provider capacity pool (fixed Provider vocabulary)
+        "replica",     # read-replica id (bounded by the replica fleet)
+        "endpoint",    # API route pattern (bounded by the route table)
     }
 )
 

@@ -35,9 +35,14 @@ Levels (monotone ladder; higher sheds strictly more):
   YELLOW  coalesce notifications; outbox/pending caps enforced
   RED     stats/notify-class jobs shed at enqueue; tick sheds its
           optional stats + event emission; non-urgent cloud reconcile
-          defers; expensive read/list API endpoints 429 with Retry-After
+          defers; expensive read/list API endpoints DEGRADE to
+          bounded-stale follower-replica serving (Warning header,
+          api/rest.py read plane) when a fresh-enough replica is
+          attached, and 429 with Retry-After otherwise — shedding is
+          the fallback, not the strategy (ISSUE 11)
   BLACK   reconcile-class jobs shed too; every API route 429s except
-          agent-critical, webhooks, login, and admin
+          agent-critical, webhooks, login, and admin (no read
+          degradation — BLACK keeps the full shed)
 
 Hysteresis: upward transitions apply immediately (a storm must brown out
 NOW); downward transitions need ``hysteresis_ticks`` consecutive calm

@@ -91,7 +91,7 @@ def _log_run(rc: int, args: list) -> None:
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
         a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
-              "--shard-parity", "--capacity-parity")
+              "--shard-parity", "--capacity-parity", "--read-parity")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -112,13 +112,14 @@ def main() -> int:
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
     flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
-             "--shard-parity", "--capacity-parity"}
+             "--shard-parity", "--capacity-parity", "--read-parity"}
     args = [a for a in sys.argv[1:] if a not in flags]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
     with_shard_parity = "--shard-parity" in sys.argv[1:]
     with_capacity_parity = "--capacity-parity" in sys.argv[1:]
+    with_read_parity = "--read-parity" in sys.argv[1:]
     args = args or ["tests/"]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # metrics-lint first, unconditionally: it is static, takes
@@ -179,6 +180,16 @@ def main() -> int:
         print("gate:", " ".join(cpar), flush=True)
         rc = subprocess.call(cpar, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--capacity-parity")
+    if rc == 0 and with_read_parity:
+        # follower reads ≡ primary at lag 0, bounded-stale answers are a
+        # prefix of primary history, fenced frames never served, the
+        # scrape-storm 304 hit-rate holds, and the 10k-agent long-poll
+        # soak hands every task out exactly once (make read-parity)
+        rpar = [sys.executable,
+                os.path.join(root, "tools", "read_parity.py")]
+        print("gate:", " ".join(rpar), flush=True)
+        rc = subprocess.call(rpar, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--read-parity")
     _log_run(rc, [*args, *ran_flags])
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)

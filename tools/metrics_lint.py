@@ -50,9 +50,10 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
 #: subsystem prefixes instruments may claim (first name segment); grow
 #: this list deliberately — a new prefix is a new dashboard namespace
 SUBSYSTEMS = {
-    "api", "arena", "breaker", "cloud", "config", "cron", "events",
-    "faults", "hosts", "jobs", "lease", "outbox", "overload",
-    "recovery", "resident", "retry", "scheduler", "tpu", "trace", "wal",
+    "api", "arena", "breaker", "cloud", "config", "cron", "dispatch",
+    "events", "faults", "hosts", "jobs", "lease", "outbox", "overload",
+    "recovery", "replica", "resident", "retry", "scheduler", "tpu",
+    "trace", "wal",
 }
 
 #: files allowed to touch the flat counter dict directly
@@ -201,6 +202,28 @@ def lint() -> List[str]:
                         f"{loc}: per-shard instrument {name!r} must "
                         "carry the 'shard' label (unlabeled per-shard "
                         "series fold every shard together)"
+                    )
+            # per-replica instruments likewise: a *_replica_* series
+            # observed once per read replica without the 'replica'
+            # label silently folds the whole replica fleet into one
+            # series — a lagging replica then hides inside a healthy
+            # aggregate
+            per_replica = (
+                "_replica_" in name or name.startswith("replica_")
+            )
+            if per_replica:
+                ln_chk = _labels_node(node)
+                label_vals = []
+                if isinstance(ln_chk, (ast.Tuple, ast.List)):
+                    label_vals = [
+                        _literal_str(el)[1] for el in ln_chk.elts
+                    ]
+                if "replica" not in label_vals:
+                    violations.append(
+                        f"{loc}: per-replica instrument {name!r} must "
+                        "carry the 'replica' label (unlabeled "
+                        "per-replica series fold every replica "
+                        "together)"
                     )
             # labels
             ln = _labels_node(node)

@@ -295,6 +295,12 @@ def case_api_storm(seed: int = 0) -> dict:
         eval_interval_s=0.02,
         retry_after_red_s=30.0,
     ).set(store)
+    # this case exercises the LADDER, not the read cache: cached
+    # answers roughly double the storm loop's attack rate, which only
+    # raises the rate-EWMA peak the recovery bound then has to decay
+    from evergreen_tpu.settings import ReadPathConfig
+
+    ReadPathConfig(cache_enabled=False).set(store)
     monitor = overload.monitor_for(store)
     before = _counters()
     got, stop = _capture_logs()

@@ -64,6 +64,13 @@ OVERHEAD_PAIRS = 6
 #: Overridable via perf_floor.json "overlap_efficiency_min"; a noisy box
 #: gets up to two re-measures before the verdict (best-of).
 OVERLAP_EFF_MIN = 0.5
+#: read-serving plane (ISSUE 11) acceptance bounds: the fingerprint
+#: ETag cache must answer >90% of an unchanged-queue scrape storm with
+#: 304s, and the long-poll dispatch p99 at 10k parked agents must stay
+#: inside 100ms (machine-independent — the woken cohort is bounded by
+#: the arrival burst, not the fleet)
+CACHE_HIT_RATE_MIN = 0.9
+DISPATCH_P99_10K_MAX_MS = 100.0
 
 
 def run_guard() -> dict:
@@ -210,8 +217,15 @@ def run_guard() -> dict:
         c - sn - so for c, sn, so in zip(churn, snap_ms, solve_ms)
     )
     shard = run_sharded_guard(distros, tbd, hbd)
+    # read-serving plane (ISSUE 11): replica lag, the fingerprint-ETag
+    # 304 hit-rate, and the long-poll dispatch soaks at 1k/10k agents —
+    # the SAME measurement bench.py publishes (tools/read_parity.py)
+    from tools.read_parity import measure_read_path
+
+    read_path = measure_read_path()
     return {
         **shard,
+        "read_path": read_path,
         "steady_tick_notrace_ms": round(steady_off_best, 2),
         "steady_tick_trace_ms": round(min(steady_on), 2),
         "instrumentation_overhead_ms": round(overhead_ms, 2),
@@ -381,6 +395,41 @@ def evaluate(result: dict, floor: dict) -> list:
                 f"{eff_min} — each shard's resident cadence must hide "
                 "pack behind its in-flight solve"
             )
+    # read-serving plane (ISSUE 11): the 304 hit-rate and the 10k-agent
+    # dispatch p99 are machine-independent acceptance bounds; the
+    # 1k-agent p99 additionally holds a machine-relative floor so a
+    # slow regression is caught before it reaches the hard bound
+    rp = result.get("read_path")
+    if rp is not None:
+        hit = rp.get("hit_rate_304")
+        if hit is not None and hit <= CACHE_HIT_RATE_MIN:
+            failures.append(
+                f"fingerprint-ETag 304 hit-rate {hit} <= "
+                f"{CACHE_HIT_RATE_MIN} on an unchanged-queue scrape "
+                "storm — the read cache is not answering revalidations"
+            )
+        p99_10k = rp.get("dispatch_p99_10k_ms")
+        if p99_10k is not None and p99_10k > DISPATCH_P99_10K_MAX_MS:
+            failures.append(
+                f"dispatch p99 {p99_10k}ms at 10k parked agents exceeds "
+                f"the {DISPATCH_P99_10K_MAX_MS}ms budget — the sharded "
+                "long-poll wake path is convoying"
+            )
+        dupes = rp.get("dispatch_duplicates")
+        if dupes:
+            failures.append(
+                f"long-poll soak handed {dupes} tasks out twice"
+            )
+        floor_p99 = floor.get("dispatch_p99_ms")
+        p99_1k = rp.get("dispatch_p99_1k_ms")
+        if floor_p99 is not None and p99_1k is not None:
+            limit = floor_p99 * (1.0 + REGRESS_FRAC)
+            if p99_1k > limit:
+                failures.append(
+                    f"dispatch p99 {p99_1k}ms at 1k agents regressed "
+                    f">{int(REGRESS_FRAC * 100)}% over the checked-in "
+                    f"floor {floor_p99}ms (limit {limit:.1f}ms)"
+                )
     return failures
 
 
@@ -399,6 +448,9 @@ def main() -> int:
                 prev = json.load(fh)
         prev["churn_store_ms"] = result["churn_store_ms"]
         prev["shard_churn_ms"] = result["shard_churn_max_ms"]
+        p99_1k = result.get("read_path", {}).get("dispatch_p99_1k_ms")
+        if p99_1k is not None:
+            prev["dispatch_p99_ms"] = p99_1k
         prev.setdefault("overlap_efficiency_min", OVERLAP_EFF_MIN)
         with open(FLOOR_PATH, "w", encoding="utf-8") as fh:
             json.dump(prev, fh, indent=2)

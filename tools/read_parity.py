@@ -1,0 +1,387 @@
+#!/usr/bin/env python
+"""Read-serving-plane parity gate (ISSUE 11): the follower-read path
+must be indistinguishable from the primary wherever it claims to be.
+
+Checks (all gate-blocking via ``tools/gate.py --read-parity`` /
+``make read-parity``):
+
+  1. **lag-0 equivalence** — a caught-up replica's collections
+     canonicalize identically to the primary's, its applied seq equals
+     the primary's WAL seq, and a REST answer set served over the
+     replica equals the primary's byte-for-byte.
+  2. **bounded-stale prefix** — at any poll point a lagging replica's
+     state equals SOME prefix of the primary's history (the monotone
+     counter probe: observed values never regress and never exceed the
+     primary's write frontier), and checkpoint absorption is
+     watermark-cheap (zero full reloads for a caught-up tail).
+  3. **fencing on the read path** — a deposed holder's frames written
+     past the fence point are never surfaced, and the replica refuses
+     to serve (``serve_ready() == False``) between observing a fence
+     marker and applying the new holder's first record.
+  4. **10k-agent soak** — the sharded long-poll dispatch hands every
+     task out exactly once (zero duplicates) with the full fleet
+     parked.
+  5. **scrape-storm cache** — the fingerprint ETag cache answers an
+     unchanged-queue storm with a 304 hit-rate > 0.9.
+
+Also exports ``measure_read_path()`` — the bench payload's
+``read_path`` section (replica lag p50/p99 + 304 hit-rate + dispatch
+p99 at 1k/10k agents) shared by bench.py and tools/perf_guard.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _canon(store, skip=("rate_limits",)) -> dict:
+    out = {}
+    with store._lock:
+        names = sorted(store._collections)
+    for name in names:
+        if name in skip:
+            continue
+        docs = sorted(
+            (json.dumps(d, sort_keys=True, default=str)
+             for d in store.collection(name).find()),
+        )
+        if docs:
+            out[name] = docs
+    return out
+
+
+def check_lag0_equivalence() -> dict:
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.replica import ReplicaStore
+    from tools.bench_dispatch import seed
+
+    tmp = tempfile.mkdtemp(prefix="readparity-")
+    try:
+        primary = DurableStore(tmp)
+        seed(primary, 400, 20, group_every=10)
+        primary.collection("versions").insert(
+            {"_id": "v1", "project": "p", "create_time": 1.0}
+        )
+        primary.checkpoint()
+        primary.collection("tasks").update("t3", {"priority": 9})
+        replica = ReplicaStore(tmp, replica_id="parity")
+        replica.poll()
+        assert replica.applied_seq == primary.wal_seq, (
+            f"replica seq {replica.applied_seq} != primary "
+            f"{primary.wal_seq}"
+        )
+        assert _canon(replica) == _canon(primary), (
+            "replica collections != primary at lag 0"
+        )
+        papi, rapi = RestApi(primary), RestApi(replica)
+        answers = 0
+        for path in (
+            "/rest/v2/hosts", "/rest/v2/distros",
+            "/rest/v2/distros/d1/queue", "/rest/v2/versions",
+            "/rest/v2/tasks/t3",
+        ):
+            sp, ap = papi.handle("GET", path, {})
+            sr, ar = rapi.handle("GET", path, {})
+            assert (sp, json.dumps(ap, sort_keys=True, default=str)) == (
+                sr, json.dumps(ar, sort_keys=True, default=str)
+            ), f"REST divergence on {path}"
+            answers += 1
+        primary.close()
+        replica.close()
+        return {"rest_answers_equal": answers, "seq": primary.wal_seq}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def check_bounded_stale_prefix() -> dict:
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    tmp = tempfile.mkdtemp(prefix="readparity-")
+    try:
+        primary = DurableStore(tmp)
+        replica = ReplicaStore(tmp, replica_id="parity")
+        reloads0 = replica.full_reloads
+        last_seen = -1
+        frontier = -1
+        for n in range(400):
+            primary.collection("counters").upsert({"_id": "c", "n": n})
+            frontier = n
+            if n % 7 == 0:
+                replica.poll()
+                doc = replica.collection("counters").get("c")
+                seen = doc["n"] if doc else -1
+                assert last_seen <= seen <= frontier, (
+                    f"replica state not a prefix: saw {seen} after "
+                    f"{last_seen}, frontier {frontier}"
+                )
+                last_seen = seen
+            if n % 101 == 100:
+                primary.checkpoint()
+        replica.poll()
+        assert replica.collection("counters").get("c")["n"] == 399
+        # checkpoint absorption must be watermark-cheap: the caught-up
+        # tail saw checkpoints at n=100/201/302 AFTER its poll at
+        # n=98/196/294 left it slightly behind — at most those reload;
+        # a caught-up absorb (the final checkpoint below) must not
+        mid_reloads = replica.full_reloads - reloads0
+        primary.checkpoint()
+        replica.poll()
+        assert replica.full_reloads - reloads0 == mid_reloads, (
+            "caught-up replica full-reloaded on checkpoint absorb"
+        )
+        assert replica.applied_seq == primary.wal_seq
+        primary.close()
+        replica.close()
+        return {
+            "probes": 400 // 7,
+            "behind_cut_reloads": mid_reloads,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def check_read_fencing() -> dict:
+    """A fenced (deposed) primary keeps writing frames past the fence
+    point: the replica must drop them AND refuse to serve between the
+    fence marker and the new holder's first record."""
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    tmp = tempfile.mkdtemp(prefix="readparity-")
+    try:
+        wal = os.path.join(tmp, "wal.log")
+
+        def frame(epoch, doc):
+            rec = json.dumps(
+                {"c": "tasks", "o": "p", "d": doc},
+                separators=(",", ":"),
+            )
+            return (
+                '{"o":"g","n":1,"e":%d,"rs":[%s]}\n' % (epoch, rec)
+            )
+
+        # epoch-1 holder writes, then a new holder (epoch 2) opens with
+        # its fence marker; the deposed holder's async flusher lands two
+        # more frames PAST the marker
+        with open(wal, "w", encoding="utf-8") as fh:
+            fh.write(frame(1, {"_id": "a", "v": "old"}))
+            fh.write(frame(1, {"_id": "b", "v": "old"}))
+        replica = ReplicaStore(tmp, replica_id="parity")
+        replica.poll()
+        assert replica.serve_ready(), "fresh tail must serve"
+        with open(wal, "a", encoding="utf-8") as fh:
+            fh.write('{"o":"f","e":2}\n')
+            fh.write(frame(1, {"_id": "a", "v": "stale-after-fence"}))
+            fh.write(frame(1, {"_id": "c", "v": "stale-new-doc"}))
+        replica.poll()
+        # stale frames never surface…
+        assert replica.collection("tasks").get("a")["v"] == "old", (
+            "deposed holder's frame surfaced past the fence point"
+        )
+        assert replica.collection("tasks").get("c") is None
+        assert replica.stale_frames_skipped >= 2
+        # …and serving is withheld until the new holder's state arrives
+        assert not replica.serve_ready(), (
+            "replica kept serving between fence marker and the new "
+            "holder's first record"
+        )
+        with open(wal, "a", encoding="utf-8") as fh:
+            fh.write(frame(2, {"_id": "a", "v": "new-holder"}))
+        replica.poll()
+        assert replica.serve_ready()
+        assert replica.collection("tasks").get("a")["v"] == "new-holder"
+        replica.close()
+        return {"stale_frames_dropped": replica.stale_frames_skipped}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def check_fencing_via_real_failover() -> dict:
+    """The same invariant through REAL stores: holder A is deposed by a
+    lease steal; its buffered tick never reaches the replica, and the
+    replica converges to holder B's state exactly like crash recovery
+    would."""
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.lease import EpochFencedError, FileLease
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    tmp = tempfile.mkdtemp(prefix="readparity-")
+    try:
+        lease_a = FileLease(os.path.join(tmp, "writer.lease"), ttl_s=0.2)
+        lease_a.acquire()
+        store_a = DurableStore(tmp, lease=lease_a)
+        store_a.collection("tasks").insert({"_id": "t", "by": "a"})
+        replica = ReplicaStore(tmp, replica_id="parity")
+        replica.poll()
+        # B steals the lease (A stalled) and opens the same dir
+        time.sleep(0.3)
+        lease_b = FileLease(os.path.join(tmp, "writer.lease"), ttl_s=0.2)
+        lease_b.acquire()  # steals the stale lease, bumping the epoch
+        store_b = DurableStore(tmp, lease=lease_b)
+        store_b.collection("tasks").update("t", {"by": "b"})
+        # A's late tick must fence, not reach the WAL
+        fenced = False
+        try:
+            store_a.begin_tick()
+            store_a.collection("tasks").update("t", {"by": "a-late"})
+            store_a.end_tick()
+        except EpochFencedError:
+            fenced = True
+        replica.poll()
+        assert fenced, "deposed holder committed past the steal"
+        assert replica.collection("tasks").get("t")["by"] == "b", (
+            f"replica surfaced {replica.collection('tasks').get('t')}"
+        )
+        assert replica.serve_ready()
+        store_b.close()
+        lease_b.release()
+        replica.close()
+        return {"fenced": True}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def measure_cache_hit_rate(storm: int = 60) -> dict:
+    """Unchanged-queue scrape storm against the fingerprint ETag cache:
+    after the first (miss) answer per endpoint, every revalidation must
+    304 with zero store reads."""
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.storage.store import Store
+    from tools.bench_dispatch import seed
+
+    store = Store()
+    seed(store, 300, 5, group_every=10)
+    api = RestApi(store)
+    endpoints = (
+        "/rest/v2/distros/d1/queue", "/rest/v2/hosts", "/rest/v2/distros",
+    )
+    total = hits = 0
+    t0 = time.perf_counter()
+    for path in endpoints:
+        etag = ""
+        for _ in range(storm):
+            headers = {"if-none-match": etag} if etag else {}
+            status, _payload = api.handle("GET", path, {}, headers)
+            total += 1
+            if status == 304:
+                hits += 1
+            else:
+                etag = dict(api._ident.response_headers).get("ETag", "")
+        assert etag, f"no ETag served on {path}"
+    storm_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "requests": total,
+        "hits_304": hits,
+        "hit_rate_304": round(hits / total, 4),
+        "storm_ms": round(storm_ms, 1),
+    }
+
+
+def measure_replica_lag(probes: int = 40) -> dict:
+    """Write→visible latency through the live tail thread."""
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    tmp = tempfile.mkdtemp(prefix="readparity-")
+    try:
+        primary = DurableStore(tmp)
+        replica = ReplicaStore(tmp, poll_interval_s=0.02,
+                               replica_id="parity")
+        replica.start()
+        lags = []
+        for n in range(probes):
+            t0 = time.perf_counter()
+            primary.collection("probe").upsert({"_id": "p", "n": n})
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                doc = replica.collection("probe").get("p")
+                if doc is not None and doc["n"] == n:
+                    break
+                time.sleep(0.002)
+            lags.append((time.perf_counter() - t0) * 1e3)
+        replica.close()
+        primary.close()
+        lags.sort()
+        qs = statistics.quantiles(lags, n=100)
+        return {
+            "probes": probes,
+            "replica_lag_p50_ms": round(qs[49], 2),
+            "replica_lag_p99_ms": round(qs[98], 2),
+            "staleness_ms": round(min(replica.staleness_ms(), 1e6), 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def measure_read_path(quick: bool = False) -> dict:
+    """The bench payload's ``read_path`` section (shared by bench.py and
+    tools/perf_guard.py): replica lag quantiles, the 304 hit-rate, and
+    the long-poll dispatch soak at 1k/10k agents."""
+    from tools.bench_dispatch import read_path_dispatch_section
+
+    out = {}
+    out.update(measure_replica_lag())
+    out.update(measure_cache_hit_rate())
+    out.update(read_path_dispatch_section(quick=quick))
+    return out
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    quick = "--quick" in sys.argv[1:]
+    results = {}
+    failures = []
+    for name, fn in (
+        ("lag0_equivalence", check_lag0_equivalence),
+        ("bounded_stale_prefix", check_bounded_stale_prefix),
+        ("read_fencing", check_read_fencing),
+        ("real_failover_fencing", check_fencing_via_real_failover),
+        ("cache_hit_rate", measure_cache_hit_rate),
+    ):
+        try:
+            results[name] = fn()
+        except AssertionError as exc:
+            failures.append(f"{name}: {exc}")
+        except Exception as exc:  # noqa: BLE001 — a crash is a failure
+            failures.append(f"{name}: crashed: {exc!r}")
+    hit = results.get("cache_hit_rate", {}).get("hit_rate_304", 0.0)
+    if not failures and hit <= 0.9:
+        failures.append(
+            f"304 hit-rate {hit} <= 0.9 on an unchanged-queue storm"
+        )
+    if not failures and not quick:
+        from tools.bench_dispatch import run_soak
+
+        soak = run_soak(n_agents=10_000, waves=8, wave_size=100)
+        results["soak_10k"] = soak
+        if soak["duplicates"]:
+            failures.append(
+                f"10k soak handed {soak['duplicates']} tasks out twice"
+            )
+        if soak["stalled"] or soak["assigned"] != soak["fed"]:
+            failures.append(
+                f"10k soak stalled: assigned {soak['assigned']} of "
+                f"{soak['fed']}"
+            )
+    print(json.dumps({"read_parity": results, "failures": failures}))
+    if failures:
+        for f in failures:
+            print(f"read-parity: FAIL — {f}", file=sys.stderr)
+        return 1
+    print("read-parity: green", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
