@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint scenarios
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -82,6 +82,18 @@ capacity-parity:
 # tools/gate.py --read-parity
 read-parity:
 	env JAX_PLATFORMS=cpu python tools/read_parity.py
+
+# trace-driven scenario sweep (gate-blocking via tools/gate.py
+# --scenarios): six realistic weathers (merge-queue storm, DAG+stepback,
+# spot reclamation, region failover, spawn burst, compressed-week
+# seasonality) plus the migrated fault/overload matrix cases, replayed
+# deterministically through ONE engine; emits SCORECARD.json and diffs
+# it against SCORECARD_GREEN.json — graceful-degradation regressions
+# fail CI like perf regressions. Refresh the baseline deliberately with
+# `python tools/scenario_engine.py --write-green`.
+scenarios:
+	env JAX_PLATFORMS=cpu python tools/scenario_engine.py --sabotage
+	env JAX_PLATFORMS=cpu python tools/scenario_engine.py --check-determinism --diff
 
 # N-process sharded-plane churn throughput vs the single-shard plane
 bench-sharded-plane:

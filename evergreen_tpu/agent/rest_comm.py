@@ -15,8 +15,17 @@ from typing import Any, Dict, List, Optional
 
 from ..models.task import Task
 from ..utils import faults
+from ..utils import metrics as _metrics
+from ..utils.etagcache import ClientEtagCache
 from ..utils.retry import RetryPolicy
 from .comm import Communicator, TaskConfig
+
+API_CLIENT_ETAG_HITS = _metrics.counter(
+    "api_client_etag_hits_total",
+    "Conditional GETs answered 304 Not Modified from this process's "
+    "client-side ETag cache (agent/CLI pollers exercising the server's "
+    "fingerprint ETag cache).",
+)
 
 
 class RestCommunicator(Communicator):
@@ -45,6 +54,11 @@ class RestCommunicator(Communicator):
         #: handed to the agent at deploy time, never over the wire)
         self.host_id = host_id
         self.host_secret = host_secret
+        #: client-side conditional-GET state: the server's fingerprint
+        #: ETag cache (api/readcache.py) answers 304 with zero store
+        #: reads when nothing changed — this poller sends the validator
+        #: it last saw and serves repeats from its own copy
+        self._etag_cache = ClientEtagCache()
 
     # -- transport ----------------------------------------------------------- #
 
@@ -54,6 +68,9 @@ class RestCommunicator(Communicator):
     ) -> dict:
         url = f"{self.base_url}{path}"
         data = json.dumps(body or {}).encode() if method != "GET" else None
+        validator = (
+            self._etag_cache.validator(path) if method == "GET" else None
+        )
 
         def attempt() -> dict:
             faults.fire("agent.comm")
@@ -61,13 +78,27 @@ class RestCommunicator(Communicator):
             if self.host_id:
                 headers["Host-Id"] = self.host_id
                 headers["Host-Secret"] = self.host_secret
+            if validator is not None:
+                headers["If-None-Match"] = validator
             req = urllib.request.Request(
                 url, data=data, method=method, headers=headers
             )
             try:
                 with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                    return json.loads(resp.read() or b"{}")
+                    payload = json.loads(resp.read() or b"{}")
+                    etag = resp.headers.get("ETag")
+                    if method == "GET" and etag:
+                        self._etag_cache.store(path, etag, payload)
+                    return payload
             except urllib.error.HTTPError as e:
+                if e.code == 304:
+                    served = self._etag_cache.serve(path)
+                    if served is not None:
+                        # Not Modified: the server validated our
+                        # fingerprint with zero store reads; serve our
+                        # own copy
+                        API_CLIENT_ETAG_HITS.inc()
+                        return served
                 # 4xx/5xx with a JSON body is a protocol answer, not a
                 # transport failure — never retried
                 try:

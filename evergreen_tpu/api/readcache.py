@@ -55,6 +55,14 @@ API_CACHE_MISSES = _metrics.counter(
 #: filtered by the authenticated identity (volumes, user keys) must not
 #: share one cache line across users.
 _ROUTES = [
+    # the CLI's `status --watch` poll loop: five collection counts whose
+    # generations make an exact change token — an idle service answers
+    # every poll 304
+    (
+        "status",
+        re.compile(r"^/rest/v2/status$"),
+        ("tasks", "hosts", "distros", "versions", "jobs"),
+    ),
     ("queue", re.compile(r"^/rest/v2/distros/([^/]+)/queue$"), ("@queue",)),
     ("hosts", re.compile(r"^/rest/v2/hosts$"), ("hosts",)),
     ("host", re.compile(r"^/rest/v2/hosts/([^/]+)$"), ("hosts",)),

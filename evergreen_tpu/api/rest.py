@@ -98,6 +98,11 @@ _LOGIN_PATHS = re.compile(r"^/(login(/redirect|/callback)?|logout)$")
 #: payload signature), not user keys — AWS SNS cannot send API headers
 _HOOK_PATHS = re.compile(r"^/hooks/aws(/|$)")
 
+#: load-balancer probes: liveness + replica-staleness readiness. Exempt
+#: from auth, rate limits, and overload shedding — a probe that 401s or
+#: 429s ejects a healthy server from rotation exactly when it matters
+_HEALTH_PATHS = re.compile(r"^/healthz(/ready)?$")
+
 
 #: expensive read/list surfaces — the FIRST routes the overload ladder
 #: sheds at RED (collection scans, queue dumps, log reads); everything
@@ -330,7 +335,7 @@ class RestApi:
         # degrades to the shared peer/"anon" buckets, so a request storm
         # would 429 the scraper for exactly the minutes the dashboard
         # exists to explain (DEPLOY.md promises scrape-through-brownout)
-        if path == "/metrics":
+        if path == "/metrics" or _HEALTH_PATHS.match(path):
             limit = 0
         if limit:
             peer = headers.get("x-peer-addr") or "anon"
@@ -346,6 +351,8 @@ class RestApi:
             # Prometheus scrapers don't carry API keys; the exposition
             # holds aggregate counters only (DEPLOY.md scrape notes)
             or path == "/metrics"
+            # LB health probes don't carry credentials either
+            or _HEALTH_PATHS.match(path)
         ):
             from ..models import user as user_mod
 
@@ -471,8 +478,10 @@ class RestApi:
             or _HOOK_PATHS.match(path)
             or _ADMIN_PATHS.match(path)
             # the telemetry surface must survive the exact storms it
-            # exists to explain (like /admin/overload)
+            # exists to explain (like /admin/overload); health probes
+            # must answer or the LB drains a server that is merely busy
             or path == "/metrics"
+            or _HEALTH_PATHS.match(path)
         ):
             return None
         expensive = (
@@ -1171,6 +1180,10 @@ class RestApi:
         # observability plane (ISSUE 7): Prometheus exposition + the
         # trace/provenance admin surfaces, all shed-exempt
         r("GET", r"/metrics", self.get_metrics)
+        # LB probes (ISSUE 12 / ROADMAP item 4): liveness + replica-
+        # staleness readiness
+        r("GET", r"/healthz", self.healthz)
+        r("GET", r"/healthz/ready", self.healthz_ready)
         r("GET", r"/rest/v2/admin/traces", self.list_traces)
         r("GET", r"/rest/v2/admin/trace/(?P<trace>[^/]+)", self.get_trace)
         r(
@@ -2080,6 +2093,56 @@ class RestApi:
         overload.monitor_for(self.store).refresh_gauges()
         refresh_probe_metrics_from_log()
         return 200, PlainTextResponse(metrics_mod.render_prometheus())
+
+    def healthz(self, method, match, body):
+        """Liveness: the process answers HTTP. Always 200 — a wedged
+        scheduler shows up in /metrics and /healthz/ready, not here."""
+        return 200, {"ok": True}
+
+    def healthz_ready(self, method, match, body):
+        """Readiness for load-balancer rotation (ROADMAP item 4): a
+        replica-process server reports 503 while it is fence-blocked
+        (failover in progress) or once its tail staleness exceeds
+        ``ReadPathConfig.readiness_staleness_bound_ms`` — so the LB
+        stops routing to a lagging follower instead of serving it
+        stale. A primary is always ready; its attached follower's lag
+        only degrades follower reads (they fall back to the primary),
+        never the primary's own readiness."""
+        from ..storage.replica import ReplicaStore
+
+        cfg = self._read_path_config()
+        bound = float(
+            cfg.readiness_staleness_bound_ms or cfg.staleness_bound_ms
+        )
+        own = self._store
+        if not isinstance(own, ReplicaStore):
+            payload = {"ready": True, "role": "primary"}
+            if self.read_replica is not None:
+                payload["follower_staleness_ms"] = round(
+                    self.read_replica.staleness_ms(), 1
+                )
+            return 200, payload
+        staleness = own.staleness_ms()
+        payload = {
+            "role": "replica",
+            "replica_id": own.replica_id,
+            "staleness_ms": round(staleness, 1),
+            "staleness_bound_ms": bound,
+        }
+        if not own.serve_ready():
+            return 503, {
+                **payload,
+                "ready": False,
+                "reason": "fence-blocked: a failover is in progress and "
+                          "the new holder's state has not arrived",
+            }
+        if staleness > bound:
+            return 503, {
+                **payload,
+                "ready": False,
+                "reason": "replica staleness exceeds the readiness bound",
+            }
+        return 200, {**payload, "ready": True}
 
     def list_traces(self, method, match, body):
         """Newest-last summaries of recent traces (?last=N, default 10)

@@ -353,17 +353,38 @@ def _client(args):
     import urllib.error
     import urllib.request
 
+    from .utils.etagcache import ClientEtagCache
+
+    # conditional-GET state for polling commands (status --watch, host
+    # list loops): send the last validator per path and serve repeats
+    # from our copy on 304 — the server's fingerprint ETag cache
+    # (api/readcache.py) answers those with zero store reads. Shared
+    # implementation with the agent transport (utils/etagcache.py).
+    etags = ClientEtagCache()
+
     def call(method: str, path: str, body: Optional[dict] = None) -> dict:
+        validator = etags.validator(path) if method == "GET" else None
+        headers = {"Content-Type": "application/json"}
+        if validator is not None:
+            headers["If-None-Match"] = validator
         req = urllib.request.Request(
             f"{args.api_server}{path}",
             data=json.dumps(body or {}).encode() if body is not None else None,
             method=method,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
-                return json.loads(resp.read() or b"{}")
+                payload = json.loads(resp.read() or b"{}")
+                etag = resp.headers.get("ETag")
+                if method == "GET" and etag:
+                    etags.store(path, etag, payload)
+                return payload
         except urllib.error.HTTPError as e:
+            if e.code == 304:
+                served = etags.serve(path)
+                if served is not None:
+                    return served
             # 4xx/5xx with a JSON body is a protocol answer the command
             # should print, not a stack trace
             try:
@@ -409,8 +430,20 @@ def cmd_admin(args) -> int:
 
 def cmd_status(args) -> int:
     call = _client(args)
-    print(json.dumps(call("GET", "/rest/v2/status"), indent=2))
-    return 0
+    if not args.watch:
+        print(json.dumps(call("GET", "/rest/v2/status"), indent=2))
+        return 0
+    # polling loop on ONE client: after the first answer every
+    # unchanged poll is a conditional GET the server 304s from its
+    # fingerprint ETag cache — the CLI exercises the path the
+    # scrape-storm bench proves (--watch-count bounds it for scripts)
+    n = 0
+    while True:
+        print(json.dumps(call("GET", "/rest/v2/status"), indent=2))
+        n += 1
+        if args.watch_count and n >= args.watch_count:
+            return 0
+        _time.sleep(args.watch)
 
 
 def cmd_user(args) -> int:
@@ -763,6 +796,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status", help="service status")
     st.add_argument("--api-server", default="http://127.0.0.1:9090")
+    st.add_argument("--watch", type=float, default=0.0,
+                    help="poll every N seconds (conditional GETs: "
+                         "unchanged polls are served 304)")
+    st.add_argument("--watch-count", type=int, default=0,
+                    help="stop after N polls (0 = forever)")
     st.set_defaults(fn=cmd_status)
 
     us = sub.add_parser("user", help="create users / grant roles")

@@ -134,6 +134,9 @@ class EC2FleetManager(CloudManager):
                 "external_id": iid,
                 "instance_type": settings.get("instance_type", "m5.large"),
                 "zone": settings.get("az", "us-east-1a"),
+                # recorded so the monitoring path can tell a spot
+                # reclamation from an ordinary external termination
+                "spot": bool(settings.get("fleet_use_spot", True)),
                 "status": HostStatus.STARTING.value,
                 "start_time": _time.time(),
             },

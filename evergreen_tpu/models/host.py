@@ -63,6 +63,11 @@ class Host:
 
     total_idle_time_s: float = 0.0
     provision_time: float = 0.0
+    #: the instance is spot/preemptible capacity (recorded at spawn from
+    #: the provider's launch spec): reclamation — the cloud taking it
+    #: back mid-task — is expected weather, counted by
+    #: ``cloud_spot_reclaimed_total`` when the monitor discovers it
+    spot: bool = False
     #: pending bootstrap transition (REPROVISION_* below); consumed by
     #: cloud/provisioning.reprovision_hosts and gates next_task
     needs_reprovision: str = ""

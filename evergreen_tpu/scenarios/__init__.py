@@ -1,0 +1,39 @@
+"""Trace-driven scenario engine: one harness that replays every weather.
+
+Public surface:
+
+  ``ScenarioSpec`` / ``Ev`` / ``SLO``  — the declarative vocabulary
+  ``run_scenario(spec)``               — one seeded replay → scorecard entry
+  ``SCENARIOS``                        — the shipped six-weather library
+  ``SABOTAGE_SCENARIOS``               — deliberately-red self-test specs
+  ``FAULT_SCENARIO_CASES`` / ``OVERLOAD_SCENARIO_CASES`` /
+  ``run_matrix_case``                  — the fault/overload matrix cases
+                                         migrated to run THROUGH the engine
+
+``tools/scenario_engine.py`` is the CLI (SCORECARD.json emission +
+determinism check + last-green diff); ``tools/gate.py --scenarios``
+wires it into CI.
+"""
+from .engine import EVENT_HANDLERS, ScenarioRun, run_scenario
+from .library import SABOTAGE_SCENARIOS, SCENARIOS
+from .matrix import (
+    FAULT_SCENARIO_CASES,
+    OVERLOAD_SCENARIO_CASES,
+    run_matrix_case,
+)
+from .spec import DEFAULT_INVARIANTS, Ev, SLO, ScenarioSpec
+
+__all__ = [
+    "DEFAULT_INVARIANTS",
+    "Ev",
+    "EVENT_HANDLERS",
+    "FAULT_SCENARIO_CASES",
+    "OVERLOAD_SCENARIO_CASES",
+    "SABOTAGE_SCENARIOS",
+    "SCENARIOS",
+    "SLO",
+    "ScenarioRun",
+    "ScenarioSpec",
+    "run_matrix_case",
+    "run_scenario",
+]

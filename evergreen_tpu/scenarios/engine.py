@@ -1,0 +1,848 @@
+"""The trace-driven scenario engine: compile a spec, replay it, score it.
+
+One ``ScenarioRun`` owns a full in-process plane — a Store (in-memory or
+DurableStore + writer lease in a temp data dir), the scheduler tick
+(``run_tick``), the dispatch CAS pair, the cloud manager fakes, the
+provisioning pipeline, and the overload ladder — and replays the spec's
+event timeline on a **virtual clock**: tick ``t`` happens at
+``NOW + (t+1) * tick_s`` regardless of how fast this box runs, so a
+week-of-weather trace compresses to minutes and the scorecard is a
+function of the seed, not the hardware.
+
+Injection rides the existing seams, never new wiring: faults install a
+PR-1 ``FaultPlan`` (scheduler.solve / wal.commit / wal.fence / …), a
+region failover is the PR-3 lease steal fired from the ``wal.fence``
+seam mid-commit (the engine then fails over to the thief's epoch and
+keeps replaying), and spot reclamation terminates instances inside the
+cloud fakes so the monitor pass discovers them the way production would.
+
+Per tick the engine runs the service's real loop:
+
+  events due → ``run_tick`` → cloud reconcile (monitor/provision/expire)
+  → complete due tasks (the deterministic agent) → dispatch free hosts.
+
+At the end it computes stats, runs the spec's checks and SLOs, asserts
+the cross-cutting invariants (scenarios/invariants.py), and returns one
+scorecard entry.
+"""
+from __future__ import annotations
+
+import dataclasses
+import tempfile
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from ..globals import HostStatus, Provider, Requester, TaskStatus
+from ..models import distro as distro_mod
+from ..models import host as host_mod
+from ..models import task as task_mod
+from ..models.distro import (
+    BootstrapSettings,
+    Distro,
+    HostAllocatorSettings,
+    PlannerSettings,
+)
+from ..models.host import Host
+from ..models.task import Dependency, Task
+from ..storage.store import Store
+from ..utils import faults as faults_mod
+from ..utils import log as log_mod
+from ..utils import overload as overload_mod
+from ..utils.benchgen import NOW
+from ..utils.faults import Fault, FaultPlan
+from .invariants import INVARIANT_CHECKS
+from .spec import ScenarioSpec, scorecard_entry_fingerprint
+
+#: counter-name prefixes the scorecard carries (shed / retry / fallback /
+#: recovery / fault accounting — the graceful-degradation ledger)
+SCORECARD_COUNTER_PREFIXES = (
+    "overload.",
+    "faults.",
+    "recovery.",
+    "cloud.",
+    "retry.",
+    "scheduler.tick.",
+    "lease.",
+)
+
+
+def _engine_overload_config(spec: ScenarioSpec) -> dict:
+    """Base OverloadConfig for scenario runs: every wall-clock-coupled
+    signal is disarmed (a slow CI box must not flip a deterministic
+    scenario's ladder) and the cadence matches the spec's virtual tick,
+    so tick-lag reads 0 on schedule. Specs re-arm exactly the signals
+    their trace drives via ``spec.overload``."""
+    off = [0.0, 0.0, 0.0]
+    base = {
+        "tick_cadence_s": spec.tick_s,
+        "eval_interval_s": 1e9,  # no monotonic-clock auto-evaluates
+        "hysteresis_ticks": 2,
+        "tick_lag_levels_s": list(off),
+        "store_latency_ms_levels": list(off),
+        "api_rps_levels": list(off),
+        "wal_backlog_levels": list(off),
+        "queue_pending_levels": list(off),
+        "outbox_depth_levels": list(off),
+    }
+    base.update(spec.overload)
+    return base
+
+
+class ScenarioRun:
+    """One seeded replay of one spec. Mutable state the event handlers
+    and checks read/write; see the module docstring for the loop."""
+
+    def __init__(self, spec: ScenarioSpec, seed: Optional[int] = None):
+        self.spec = spec
+        self.seed = spec.seed if seed is None else seed
+        self.data_dir: Optional[str] = None
+        self.lease = None
+        self._thief = None  # pending failover lease (region-steal event)
+        self.store = self._build_store()
+        self.tick = -1
+        self.now = NOW
+        self.clock_offset = 0.0
+        self.tick_results: List = []
+        self.epochs: List[int] = []
+        self.dwell: Dict[str, int] = {}
+        self.degraded: Dict[str, int] = {}
+        self.stats: Dict = {}
+        self.dispatch_tick: Dict[str, int] = {}
+        self.dispatched_total = 0
+        self.failovers = 0
+        #: completion failure plan: [{"match": prefix, "details_type",
+        #: "remaining": n|None}] consumed in sorted task order
+        self.fail_plan: List[Dict] = []
+        self._counters0 = log_mod.counters_snapshot()
+        #: every structured-log record emitted during the replay (the
+        #: matrix cases' breadcrumb assertions read this)
+        self.logs: List[dict] = []
+        self.fault_plan = FaultPlan()
+        self._events_by_tick: Dict[int, List] = {}
+        for ev in spec.events:
+            self._events_by_tick.setdefault(ev.tick, []).append(ev)
+
+    # -- construction ---------------------------------------------------- #
+
+    def _build_store(self):
+        from ..cloud import docker as docker_mod
+        from ..cloud import ec2_fleet
+
+        ec2_fleet.reset_default_client()
+        docker_mod.reset_default_client()
+        if self.spec.durable:
+            import os
+
+            from ..storage.durable import DurableStore
+            from ..storage.lease import FileLease
+
+            self.data_dir = tempfile.mkdtemp(
+                prefix=f"scenario-{self.spec.name}-"
+            )
+            self.lease = FileLease(
+                os.path.join(self.data_dir, "writer.lease"), ttl_s=600.0
+            )
+            assert self.lease.try_acquire()
+            store = DurableStore(self.data_dir, lease=self.lease)
+        else:
+            store = Store()
+        from ..settings import OverloadConfig
+
+        OverloadConfig(**_engine_overload_config(self.spec)).set(store)
+        for section_name, kwargs in self.spec.config.items():
+            import evergreen_tpu.settings as settings_mod
+
+            getattr(settings_mod, section_name)(**kwargs).set(store)
+        return store
+
+    def tick_options(self):
+        from ..scheduler.wrapper import TickOptions
+
+        base = TickOptions(
+            create_intent_hosts=False,
+            underwater_unschedule=False,
+            use_cache=False,
+        )
+        return dataclasses.replace(base, **self.spec.tick_options)
+
+    # -- bookkeeping ----------------------------------------------------- #
+
+    def counter_delta(self, name: str) -> int:
+        return log_mod.get_counter(name) - self._counters0.get(name, 0)
+
+    def counter_deltas(self) -> Dict[str, int]:
+        out = {}
+        for name, value in log_mod.counters_snapshot().items():
+            if not name.startswith(SCORECARD_COUNTER_PREFIXES):
+                continue
+            delta = value - self._counters0.get(name, 0)
+            if delta:
+                out[name] = delta
+        return out
+
+    def task_duration_ticks(self, task_id: str) -> int:
+        return max(1, int(self.spec.default_task_ticks))
+
+    # -- failover -------------------------------------------------------- #
+
+    def arm_failover(self, thief_lease) -> None:
+        """A lease-steal event hands the engine the thief's lease; when
+        the deposed holder's tick comes back ``degraded="fenced"``, the
+        engine opens the data dir under the thief's (higher) epoch and
+        keeps replaying — the in-process region failover."""
+        self._thief = thief_lease
+
+    def _maybe_failover(self) -> None:
+        if self._thief is None:
+            return
+        from ..scheduler.recovery import run_recovery_pass
+        from ..storage.durable import DurableStore
+
+        thief, self._thief = self._thief, None
+        try:
+            # the deposed holder's store still owns a WAL handle and the
+            # async flusher thread; a fenced close may refuse work, but
+            # the handles must not leak across multi-seed soaks
+            self.store.close()
+        except Exception:  # noqa: BLE001 — fenced stores refuse closes
+            pass
+        self.lease = thief
+        self.store = DurableStore(self.data_dir, lease=thief)
+        run_recovery_pass(self.store, now=self.now)
+        from ..settings import OverloadConfig
+
+        OverloadConfig(**_engine_overload_config(self.spec)).set(self.store)
+        self.failovers += 1
+
+    # -- the replay loop ------------------------------------------------- #
+
+    def execute(self) -> Dict:
+        t0 = _time.perf_counter()
+        from ..scheduler.wrapper import run_tick
+
+        faults_mod.install(self.fault_plan)
+        log_mod.add_sink(self.logs.append)
+        opts = self.tick_options()
+        try:
+            for t in range(self.spec.ticks):
+                self.tick = t
+                self.now = NOW + (t + 1) * self.spec.tick_s \
+                    + self.clock_offset
+                for ev in self._events_by_tick.get(t, ()):
+                    EVENT_HANDLERS[ev.kind](self, **ev.args)
+                res = run_tick(self.store, opts, now=self.now)
+                self.tick_results.append(res)
+                self.epochs.append(
+                    getattr(self.lease, "epoch", 0) if self.lease else 0
+                )
+                self.dwell[res.overload] = (
+                    self.dwell.get(res.overload, 0) + 1
+                )
+                if res.degraded:
+                    self.degraded[res.degraded] = (
+                        self.degraded.get(res.degraded, 0) + 1
+                    )
+                if res.degraded == "fenced":
+                    self._maybe_failover()
+                    continue
+                if self.spec.service_loop:
+                    self._service_pass()
+        finally:
+            faults_mod.uninstall()
+            log_mod.remove_sink(self.logs.append)
+        entry = self._score()
+        entry["timing"] = {
+            "wall_ms": round((_time.perf_counter() - t0) * 1e3, 1)
+        }
+        entry["fingerprint"] = scorecard_entry_fingerprint(entry)
+        self._teardown()
+        return entry
+
+    def _service_pass(self) -> None:
+        """The between-ticks service work, in the order the crons run it:
+        cloud reconcile, provisioning, spawn-host expiry, then the
+        deterministic agent (complete due tasks, dispatch free hosts)."""
+        from ..cloud.provisioning import (
+            create_hosts_from_intents,
+            provision_ready_hosts,
+        )
+        from ..cloud.spawnhost import expire_spawn_hosts
+        from ..units.host_jobs import monitor_host_cloud_state
+
+        monitor_host_cloud_state(self.store, now=self.now)
+        create_hosts_from_intents(self.store, now=self.now)
+        provision_ready_hosts(self.store, now=self.now)
+        expire_spawn_hosts(self.store, now=self.now)
+        self._complete_due_tasks()
+        self._dispatch_free_hosts()
+
+    def _complete_due_tasks(self) -> None:
+        from ..models.lifecycle import mark_end, mark_task_started
+
+        c = task_mod.coll(self.store)
+        due = sorted(
+            d["_id"]
+            for d in c.find(
+                lambda d: d["status"]
+                in (TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value)
+            )
+            if self.dispatch_tick.get(d["_id"], self.tick)
+            + self.task_duration_ticks(d["_id"])
+            <= self.tick
+        )
+        for tid in due:
+            mark_task_started(self.store, tid, now=self.now)
+            status, details = TaskStatus.SUCCEEDED.value, ""
+            for plan in self.fail_plan:
+                if tid.startswith(plan["match"]) and (
+                    plan.get("remaining") is None or plan["remaining"] > 0
+                ):
+                    status = TaskStatus.FAILED.value
+                    details = plan.get("details_type", "test")
+                    if plan.get("remaining") is not None:
+                        plan["remaining"] -= 1
+                    break
+            mark_end(
+                self.store, tid, status, now=self.now,
+                details_type=details,
+            )
+
+    def _dispatch_free_hosts(self) -> None:
+        from ..dispatch.assign import assign_next_available_task
+        from ..dispatch.dag_dispatcher import DispatcherService
+
+        svc = DispatcherService(self.store)  # fresh: no TTL staleness
+        hosts = sorted(
+            (
+                h
+                for h in host_mod.find(self.store)
+                if h.can_run_tasks() and not h.running_task
+            ),
+            key=lambda h: h.id,
+        )
+        for h in hosts:
+            t = assign_next_available_task(self.store, svc, h, now=self.now)
+            if t is not None:
+                self.dispatch_tick[t.id] = self.tick
+                self.dispatched_total += 1
+
+    # -- scoring --------------------------------------------------------- #
+
+    def _base_stats(self) -> Dict:
+        tasks = self.store.collection("tasks").find()
+        finished = [
+            d for d in tasks
+            if d["status"]
+            in (TaskStatus.SUCCEEDED.value, TaskStatus.FAILED.value)
+        ]
+        stepback_events = self.store.collection("events").count(
+            lambda d: d.get("event_type") == "TASK_ACTIVATED_STEPBACK"
+        )
+        level_rank = {"green": 0, "yellow": 1, "red": 2, "black": 3}
+        max_level = max(
+            (level_rank.get(k, 0) for k in self.dwell), default=0
+        )
+        last = self.tick_results[-1] if self.tick_results else None
+        return {
+            "ticks": len(self.tick_results),
+            "tasks_total": len(tasks),
+            "tasks_finished": len(finished),
+            "tasks_succeeded": sum(
+                1 for d in finished
+                if d["status"] == TaskStatus.SUCCEEDED.value
+            ),
+            "tasks_failed": sum(
+                1 for d in finished
+                if d["status"] == TaskStatus.FAILED.value
+            ),
+            "tasks_system_failed": sum(
+                1 for d in finished
+                if d["status"] == TaskStatus.FAILED.value
+                and d.get("details_type") == "system"
+            ),
+            "tasks_unfinished": len(tasks) - len(finished),
+            "dispatched_total": self.dispatched_total,
+            "restarts_total": sum(
+                d.get("num_automatic_restarts", 0) for d in tasks
+            ),
+            "stepback_activations": stepback_events,
+            "max_overload_level": max_level,
+            "ended_green": 1 if last and last.overload == "green" else 0,
+            "fenced_ticks": self.degraded.get("fenced", 0),
+            "failovers": self.failovers,
+            "sheds_total": self.counter_delta("overload.shed"),
+            "spot_reclaimed": self.counter_delta("cloud.spot_reclaimed"),
+        }
+
+    def _score(self) -> Dict:
+        self.stats = {**self._base_stats(), **self.stats}
+        checks = {}
+        for name, fn in self.spec.checks:
+            try:
+                problem = fn(self)
+            except Exception as exc:  # noqa: BLE001 — a raising check is
+                # a failing check, never a crashed scorecard
+                problem = f"check raised: {exc!r}"
+            checks[name] = {"ok": problem is None, "detail": problem or ""}
+        slos = {}
+        for slo in self.spec.slos:
+            slos[slo.name] = slo.evaluate(self.stats)
+        invariants = {}
+        for name in self.spec.invariants:
+            try:
+                problem = INVARIANT_CHECKS[name](self)
+            except Exception as exc:  # noqa: BLE001
+                problem = f"invariant raised: {exc!r}"
+            invariants[name] = {
+                "ok": problem is None, "detail": problem or "",
+            }
+        ok = (
+            all(v["ok"] for v in invariants.values())
+            and all(v["ok"] for v in checks.values())
+            and all(v["ok"] for v in slos.values())
+        )
+        return {
+            "name": self.spec.name,
+            "ok": ok,
+            "seed": self.seed,
+            "deterministic": self.spec.deterministic,
+            "invariants": invariants,
+            "checks": checks,
+            "slos": slos,
+            "dwell_ticks": dict(sorted(self.dwell.items())),
+            "degraded": dict(sorted(self.degraded.items())),
+            "counters": dict(sorted(self.counter_deltas().items())),
+            "stats": {
+                k: self.stats[k] for k in sorted(self.stats)
+                if isinstance(self.stats[k], (int, float, bool, str))
+            },
+        }
+
+    def _teardown(self) -> None:
+        import shutil
+
+        try:
+            if self.lease is not None:
+                self.lease.release()
+            if hasattr(self.store, "close"):
+                self.store.close()
+        except Exception:  # noqa: BLE001 — a fenced/failed-over store may
+            # refuse close work; the scorecard is already computed
+            pass
+        if self.data_dir is not None:
+            shutil.rmtree(self.data_dir, ignore_errors=True)
+
+
+def run_scenario(spec: ScenarioSpec, seed: Optional[int] = None) -> Dict:
+    """Replay one spec once; returns its scorecard entry."""
+    return ScenarioRun(spec, seed=seed).execute()
+
+
+# --------------------------------------------------------------------------- #
+# event vocabulary
+# --------------------------------------------------------------------------- #
+
+
+def _distro_from_spec(dspec: Dict) -> Distro:
+    planner = PlannerSettings(
+        patch_factor=7,
+        patch_time_in_queue_factor=2,
+        commit_queue_factor=20,
+        mainline_time_in_queue_factor=1,
+        expected_runtime_factor=1,
+        num_dependents_factor=2.0,
+        stepback_task_factor=10,
+        **dspec.get("planner", {}),
+    )
+    alloc = HostAllocatorSettings(
+        maximum_hosts=dspec.get("max_hosts", 100),
+        minimum_hosts=dspec.get("min_hosts", 0),
+        future_host_fraction=0.5,
+    )
+    boot = BootstrapSettings(
+        method=dspec.get("bootstrap", BootstrapSettings.METHOD_PRECONFIGURED)
+    )
+    return Distro(
+        id=dspec["id"],
+        provider=dspec.get("provider", Provider.MOCK.value),
+        provider_settings=dspec.get("provider_settings", {}),
+        container_pool=dspec.get("container_pool", ""),
+        planner_settings=planner,
+        host_allocator_settings=alloc,
+        bootstrap_settings=boot,
+    )
+
+
+def ev_fleet(run: ScenarioRun, distros: List[Dict]) -> None:
+    """Create distros and their initial RUNNING hosts (deterministic
+    ids). ``distros``: [{"id", "provider", "hosts", "planner": {...},
+    "provider_settings": {...}, ...}]."""
+    from ..cloud.ec2_fleet import default_client
+    from ..cloud.mock import MockCloudManager
+    from ..cloud.manager import CloudHostStatus
+
+    for dspec in distros:
+        d = _distro_from_spec(dspec)
+        distro_mod.insert(run.store, d)
+        hosts = [
+            Host(
+                id=f"{d.id}-h{hi:03d}",
+                distro_id=d.id,
+                provider=d.provider,
+                status=HostStatus.RUNNING.value,
+                creation_time=run.now - 7200,
+                start_time=run.now - 7200,
+                last_communication_time=run.now,
+                has_containers=dspec.get("has_containers", False),
+            )
+            for hi in range(dspec.get("hosts", 0))
+        ]
+        # register pre-existing hosts with their provider's truth so the
+        # cloud-reconcile pass sees live instances, not NONEXISTENT ones
+        for h in hosts:
+            if d.provider in (
+                Provider.MOCK.value, Provider.DOCKER_MOCK.value
+            ):
+                h.external_id = f"mock-{h.id}"
+                MockCloudManager.instances[h.external_id] = (
+                    CloudHostStatus.RUNNING
+                )
+            elif d.provider in (
+                Provider.EC2_FLEET.value, Provider.EC2_ONDEMAND.value
+            ):
+                spot = bool(dspec.get("provider_settings", {}).get(
+                    "fleet_use_spot", True
+                ))
+                client = default_client()
+                iid = client.create_fleet({"spot": spot})
+                client.describe_instance(iid)  # pending → running
+                h.external_id = iid
+                # mirror what spawn_host records, or ev_spot_reclaim
+                # (which filters on the doc's spot flag) would silently
+                # skip every pre-seeded instance
+                h.spot = spot
+        if hosts:
+            host_mod.insert_many(run.store, hosts)
+
+
+def ev_grow_fleet(
+    run: ScenarioRun, distro: str, n: int, prefix: str = ""
+) -> None:
+    """Add intent hosts with deterministic ids; the service pass spawns
+    them through the real provider (FakeEC2 fleet / docker pools) and
+    provisions them to RUNNING."""
+    prefix = prefix or f"{distro}-g{run.tick}"
+    d = distro_mod.get(run.store, distro)
+    for i in range(n):
+        h = Host(
+            id=f"{prefix}-{i:03d}",
+            distro_id=distro,
+            provider=d.provider if d else Provider.MOCK.value,
+            status=HostStatus.UNINITIALIZED.value,
+            creation_time=run.now,
+        )
+        host_mod.insert(run.store, h)
+
+
+def ev_tasks(
+    run: ScenarioRun,
+    distro: str,
+    n: int,
+    prefix: str = "",
+    requester: str = Requester.REPOTRACKER.value,
+    project: str = "proj",
+    build_variant: str = "bv0",
+    priority: int = 0,
+    dep_chain: bool = False,
+    expected_s: float = 600.0,
+) -> None:
+    """A batch of activated tasks arriving (one commit's build, a patch
+    burst slice, interactive load)."""
+    prefix = prefix or f"{distro}-t{run.tick}"
+    prev_id = ""
+    tasks = []
+    for i in range(n):
+        t = Task(
+            id=f"{prefix}-{i:03d}",
+            display_name=f"{prefix}-{i:03d}",
+            distro_id=distro,
+            project=project,
+            version=f"{prefix}-v",
+            build_variant=build_variant,
+            status=TaskStatus.UNDISPATCHED.value,
+            activated=True,
+            requester=requester,
+            priority=priority,
+            create_time=run.now - 60,
+            activated_time=run.now - 30,
+            scheduled_time=run.now,
+            expected_duration_s=expected_s,
+        )
+        if dep_chain and prev_id:
+            t.depends_on = [Dependency(task_id=prev_id)]
+        prev_id = t.id
+        tasks.append(t)
+    task_mod.insert_many(run.store, tasks)
+
+
+def ev_merge_stack(
+    run: ScenarioRun,
+    distro: str,
+    stack: str,
+    n: int,
+    project: str = "proj",
+) -> None:
+    """One merge-queue patch stack: ``n`` github-merge tasks chained by
+    dependencies (each entry builds on the previous — the conflicting
+    overlap with sibling stacks is that they all race one project)."""
+    prev_id = ""
+    tasks = []
+    for i in range(n):
+        t = Task(
+            id=f"{distro}-{stack}-{i:02d}",
+            display_name=f"{stack}-{i:02d}",
+            distro_id=distro,
+            project=project,
+            version=f"{stack}-v{i}",
+            build_variant="bv0",
+            status=TaskStatus.UNDISPATCHED.value,
+            activated=True,
+            requester=Requester.GITHUB_MERGE.value,
+            create_time=run.now - 120,
+            activated_time=run.now - 60,
+            scheduled_time=run.now,
+            expected_duration_s=300.0,
+        )
+        if prev_id:
+            t.depends_on = [Dependency(task_id=prev_id)]
+        prev_id = t.id
+        tasks.append(t)
+    task_mod.insert_many(run.store, tasks)
+
+
+def ev_dag(run: ScenarioRun, distro: str, nodes: List[Dict]) -> None:
+    """An explicit dependency DAG across revisions: nodes carry
+    display_name / revision_order / deps / activation — the stepback
+    scenario's mainline history."""
+    tasks = []
+    for node in nodes:
+        t = Task(
+            id=node["id"],
+            display_name=node.get("display_name", node["id"]),
+            distro_id=distro,
+            project=node.get("project", "proj"),
+            version=node.get("version", f"{node['id']}-v"),
+            build_variant=node.get("build_variant", "bv0"),
+            status=TaskStatus.UNDISPATCHED.value,
+            activated=node.get("activated", True),
+            requester=node.get(
+                "requester", Requester.REPOTRACKER.value
+            ),
+            revision_order_number=node.get("revision_order", 0),
+            create_time=run.now - 60,
+            activated_time=run.now - 30 if node.get("activated", True)
+            else 0.0,
+            scheduled_time=run.now,
+            expected_duration_s=node.get("expected_s", 300.0),
+        )
+        t.depends_on = [
+            Dependency(task_id=dep) for dep in node.get("deps", ())
+        ]
+        tasks.append(t)
+    task_mod.insert_many(run.store, tasks)
+
+
+def ev_fail_next(
+    run: ScenarioRun,
+    match: str,
+    details_type: str = "test",
+    count: Optional[int] = 1,
+) -> None:
+    """Arm the completion agent: the next ``count`` completions of tasks
+    whose id starts with ``match`` fail with ``details_type``."""
+    run.fail_plan.append(
+        {"match": match, "details_type": details_type, "remaining": count}
+    )
+
+
+def ev_spot_reclaim(run: ScenarioRun, n: int, distro: str = "") -> None:
+    """Reclaim ``n`` spot-backed EC2 instances out from under us —
+    terminated inside the provider fake, host docs untouched, so only
+    the next cloud-reconcile pass can discover it (exactly the
+    production shape). Prefers busy hosts: reclamation mid-task is the
+    scenario the recovery path must survive."""
+    from ..cloud.ec2_fleet import default_client
+
+    client = default_client()
+    candidates = sorted(
+        (
+            h for h in host_mod.find(
+                run.store,
+                lambda d: d["status"] == HostStatus.RUNNING.value
+                and d.get("spot")
+                and d.get("external_id")
+                and (not distro or d["distro_id"] == distro),
+            )
+        ),
+        key=lambda h: (not h.running_task, h.id),
+    )
+    for h in candidates[:n]:
+        inst = client.instances.get(h.external_id)
+        if inst is not None:
+            inst["state"] = "terminated"
+
+
+def ev_lease_steal(run: ScenarioRun, failover: bool = True) -> None:
+    """Arm a mid-commit lease steal at the ``wal.fence`` seam (the PR-3
+    failover machinery): the NEXT group commit observes a thief holding
+    a higher epoch, the tick is fenced and shed, and — unless
+    ``failover=False`` (the migrated matrix case asserts on the deposed
+    holder alone) — the engine fails over to the thief for the
+    remaining ticks."""
+    import os
+
+    from ..storage.lease import FileLease
+
+    assert run.spec.durable, "lease_steal requires a durable scenario"
+    lease_path = os.path.join(run.data_dir, "writer.lease")
+
+    def steal():
+        thief = FileLease(lease_path, ttl_s=600.0)
+        thief.ttl_s = -1.0  # force "stale" so the steal fires now
+        assert thief.try_acquire()
+        thief.ttl_s = 600.0
+        if failover:
+            run.arm_failover(thief)
+
+    calls = run.fault_plan._calls.get("wal.fence", 0)
+    run.fault_plan.at("wal.fence", calls, Fault("call", fn=steal))
+
+
+def ev_gauge(
+    run: ScenarioRun, name: str, value: float, ewma: float = 0.0
+) -> None:
+    """Push one load-ladder gauge sample (the declarative analog of a
+    job-queue backlog or WAL-flusher lag the trace implies)."""
+    overload_mod.monitor_for(run.store).observe(name, value, ewma=ewma)
+
+
+def ev_outbox(
+    run: ScenarioRun, n: int, channel: str = "slack_outbox",
+    distinct: bool = True, key: str = "",
+) -> None:
+    """A notification fan-out burst: ``n`` outbox inserts (distinct
+    texts, or repeats of one coalesce key)."""
+    from ..events.senders import insert_outbox_row
+
+    for i in range(n):
+        text = (
+            f"storm-{run.tick}-{i}\nbody" if distinct
+            else f"{key or 'storm-dup'}\nbody"
+        )
+        insert_outbox_row(
+            run.store, channel,
+            {"channel_type": "slack", "slack_channel": "#ops",
+             "text": text},
+        )
+
+
+def ev_drain_outbox(
+    run: ScenarioRun, channel: str = "slack_outbox"
+) -> None:
+    """The notification drain catching up (delivers every undelivered
+    row and tells the ladder the backlog cleared)."""
+    coll = run.store.collection(channel)
+    undelivered = coll.find(
+        lambda d: not d.get("delivered") and not d.get("failed")
+    )
+    for doc in undelivered:
+        coll.update(doc["_id"], {"delivered": True})
+    overload_mod.monitor_for(run.store).note_outbox_drained(
+        channel, len(undelivered)
+    )
+
+
+def ev_spawn_burst(
+    run: ScenarioRun, distro: str, users: int, prefix: str = "user"
+) -> None:
+    """An interactive spawn-host burst: ``users`` workstation requests
+    land at once (rest/route host_spawn shape, minus the HTTP)."""
+    from ..cloud.spawnhost import create_spawn_host
+
+    for i in range(users):
+        create_spawn_host(
+            run.store, f"{prefix}{i:03d}", distro, now=run.now
+        )
+
+
+def ev_advance_clock(run: ScenarioRun, s: float) -> None:
+    """Jump the virtual clock (expiry sweeps, idle reaping): every
+    subsequent tick happens ``s`` seconds later."""
+    run.clock_offset += s
+
+
+def ev_fault(
+    run: ScenarioRun,
+    seam: str,
+    kind: str = "raise",
+    at: Optional[int] = None,
+    delay_s: float = 0.0,
+    always: bool = False,
+) -> None:
+    """Install one PR-1 fault-plan entry on the live plan. ``at`` is an
+    absolute seam call index; None targets the seam's NEXT call."""
+    fault = Fault(kind, delay_s=delay_s)
+    if always:
+        run.fault_plan.always(seam, fault)
+    else:
+        idx = (
+            at if at is not None
+            else run.fault_plan._calls.get(seam, 0)
+        )
+        run.fault_plan.at(seam, idx, fault)
+
+
+def ev_clear_faults(run: ScenarioRun, seam: str = "") -> None:
+    """Remove scheduled/always faults (one seam, or all)."""
+    if seam:
+        run.fault_plan._at.pop(seam, None)
+        run.fault_plan._always.pop(seam, None)
+    else:
+        run.fault_plan._at.clear()
+        run.fault_plan._always.clear()
+
+
+def ev_container_pools(run: ScenarioRun, pools: List[Dict]) -> None:
+    """Configure docker container pools (parent distro + capacity)."""
+    from ..cloud.docker import ContainerPool, set_container_pools
+
+    set_container_pools(
+        run.store, [ContainerPool(**p) for p in pools]
+    )
+
+
+def ev_call(run: ScenarioRun, fn: Callable) -> None:
+    """Escape hatch for migrated matrix cases: run ``fn(run)`` at this
+    tick."""
+    fn(run)
+
+
+EVENT_HANDLERS: Dict[str, Callable] = {
+    "fleet": ev_fleet,
+    "grow_fleet": ev_grow_fleet,
+    "tasks": ev_tasks,
+    "merge_stack": ev_merge_stack,
+    "dag": ev_dag,
+    "fail_next": ev_fail_next,
+    "spot_reclaim": ev_spot_reclaim,
+    "lease_steal": ev_lease_steal,
+    "gauge": ev_gauge,
+    "outbox": ev_outbox,
+    "drain_outbox": ev_drain_outbox,
+    "spawn_burst": ev_spawn_burst,
+    "advance_clock": ev_advance_clock,
+    "fault": ev_fault,
+    "clear_faults": ev_clear_faults,
+    "container_pools": ev_container_pools,
+    "call": ev_call,
+}

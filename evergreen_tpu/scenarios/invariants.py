@@ -1,0 +1,208 @@
+"""Cross-cutting invariants every scenario asserts.
+
+These are the same contracts the bespoke matrices enforced point-wise
+(tools/crash_matrix.py structural checks, tools/overload_matrix.py
+zero-silent-discard audit), lifted into one vocabulary the scenario
+engine applies to every weather:
+
+  * ``no_duplicate_dispatch`` — at most one host claims a task; claims
+    and in-flight statuses agree; no two TASK_DISPATCHED events for the
+    same (task, timestamp).
+  * ``store_consistent`` — legal task statuses, non-negative executions,
+    queue doc columns aligned, no claim of a finished task.
+  * ``planning_never_starves`` — every tick with plannable work persisted
+    queues, and "planning" never appears in a tick's shed list.
+  * ``monotone_epochs`` — the writer-lease epoch observed tick over tick
+    never decreases (it strictly increases across a failover).
+  * ``counters_match_records`` — the overload ladder's shed counters
+    equal the ``overload_sheds`` aggregate records: nothing was dropped
+    silently.
+  * ``resume_equals_rerun`` — durable runs only: reopening the data dir
+    from WAL + snapshot converges to the live store's canonical state.
+
+Each checker takes the finished ScenarioRun and returns None (pass) or a
+problem string (fail). The scorecard records one entry per invariant.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..globals import TaskStatus
+
+
+def check_store_consistent(store) -> List[str]:
+    """Structural store invariants (the crash matrix's recovered-store
+    checks, applied to any store at any point)."""
+    problems: List[str] = []
+    legal = {s.value for s in TaskStatus}
+    claims: Dict[str, str] = {}
+    claimed_tasks = set()
+    for doc in store.collection("hosts").find():
+        rt = doc.get("running_task", "")
+        if not rt:
+            continue
+        if rt in claimed_tasks:
+            problems.append(f"duplicate claim of task {rt}")
+        claimed_tasks.add(rt)
+        claims[doc["_id"]] = rt
+    for doc in store.collection("tasks").find():
+        if doc["status"] not in legal:
+            problems.append(
+                f"illegal status {doc['status']} on {doc['_id']}"
+            )
+        if doc.get("execution", 0) < 0:
+            problems.append(f"negative execution on {doc['_id']}")
+        if doc["status"] in ("dispatched", "started"):
+            hid = doc.get("host_id", "")
+            hdoc = store.collection("hosts").get(hid)
+            if hdoc is None or hdoc.get("running_task") != doc["_id"]:
+                problems.append(
+                    f"in-flight task {doc['_id']} not claimed by "
+                    f"host {hid!r}"
+                )
+    for hid, rt in claims.items():
+        tdoc = store.collection("tasks").get(rt)
+        if tdoc is None or tdoc["status"] not in ("dispatched", "started"):
+            problems.append(
+                f"host {hid} claims task {rt} that is not in flight"
+            )
+    for coll_name in ("task_queues", "task_secondary_queues"):
+        for doc in store.collection(coll_name).find():
+            n = len(doc.get("rows", []))
+            for col in ("sort_value", "dependencies_met"):
+                if len(doc.get(col, [])) != n:
+                    problems.append(
+                        f"misaligned {col} in {coll_name}/{doc['_id']}"
+                    )
+    return problems
+
+
+def check_duplicate_dispatch(store) -> List[str]:
+    """No two hosts ever won the same dispatch CAS: duplicate
+    TASK_DISPATCHED events for one (task, timestamp) mean two winners."""
+    problems: List[str] = []
+    seen: Dict[tuple, int] = {}
+    for doc in store.collection("events").find(
+        lambda d: d.get("event_type") == "TASK_DISPATCHED"
+    ):
+        key = (doc.get("resource_id"), doc.get("timestamp"))
+        seen[key] = seen.get(key, 0) + 1
+        if seen[key] == 2:
+            problems.append(f"duplicate dispatch event {key}")
+    return problems
+
+
+def canonical_state(store) -> dict:
+    """The resume ≡ rerun comparison surface: converged task state +
+    queue contents (doc versions / timestamps / host identities excluded
+    — replays regenerate those; content must not differ)."""
+    from ..models.task_queue import doc_column
+
+    tasks = {
+        d["_id"]: [d["status"], d.get("execution", 0)]
+        for d in store.collection("tasks").find()
+    }
+    queues = {
+        d["_id"]: doc_column(d, "id")
+        for d in store.collection("task_queues").find()
+    }
+    return {"tasks": tasks, "queues": queues}
+
+
+# --------------------------------------------------------------------------- #
+# run-level checkers (fn(run) -> None | problem)
+# --------------------------------------------------------------------------- #
+
+
+def _inv_no_duplicate_dispatch(run) -> Optional[str]:
+    problems = check_duplicate_dispatch(run.store)
+    return "; ".join(problems[:3]) if problems else None
+
+
+def _inv_store_consistent(run) -> Optional[str]:
+    problems = check_store_consistent(run.store)
+    return "; ".join(problems[:3]) if problems else None
+
+
+def _inv_planning_never_starves(run) -> Optional[str]:
+    for i, res in enumerate(run.tick_results):
+        if res is None:
+            continue  # failover gap: no tick ran this slot
+        if "planning" in res.shed:
+            return f"tick {i} shed planning"
+        if (
+            res.degraded not in ("", "fenced")
+            and sum(res.queues.values()) == 0
+            and res.n_tasks > 0
+        ):
+            return (
+                f"tick {i} degraded={res.degraded!r} persisted no queues "
+                f"for {res.n_tasks} plannable tasks"
+            )
+    return None
+
+
+def _inv_monotone_epochs(run) -> Optional[str]:
+    seq = run.epochs
+    for a, b in zip(seq, seq[1:]):
+        if b < a:
+            return f"lease epoch regressed {a} -> {b}"
+    return None
+
+
+def _inv_counters_match_records(run) -> Optional[str]:
+    """Zero-silent-discard audit (the overload matrix's two-books
+    balance): the run's overload_sheds_total counter delta must equal
+    the sum of the run's ``overload_sheds`` aggregate records (fresh
+    store per run, so the records ARE the delta)."""
+    from ..utils import overload
+
+    recorded = sum(
+        d.get("count", 0)
+        for d in run.store.collection(overload.SHEDS_COLLECTION).find()
+    )
+    counted = run.counter_delta("overload.shed")
+    if recorded != counted:
+        return (
+            f"shed counters ({counted}) != shed records ({recorded}): "
+            "something was dropped silently"
+        )
+    return None
+
+
+def _inv_resume_equals_rerun(run) -> Optional[str]:
+    """Durable runs: a cold reopen of the data dir (WAL replay +
+    snapshot) must converge to the live store's canonical state — the
+    in-process analog of the crash matrix's restart-and-compare."""
+    if not run.spec.durable or run.data_dir is None:
+        return None  # in-memory run: nothing to replay
+    from ..storage.durable import DurableStore
+
+    run.store.sync_persist()
+    recovered = DurableStore(run.data_dir)
+    try:
+        live = canonical_state(run.store)
+        replayed = canonical_state(recovered)
+    finally:
+        recovered.close()
+    if live != replayed:
+        diffs = []
+        for key in ("tasks", "queues"):
+            a, b = live[key], replayed[key]
+            for k in sorted(set(a) | set(b)):
+                if a.get(k) != b.get(k):
+                    diffs.append(f"{key}/{k}: {a.get(k)} != {b.get(k)}")
+                if len(diffs) >= 3:
+                    break
+        return "replay diverged: " + "; ".join(diffs[:3])
+    return None
+
+
+INVARIANT_CHECKS: Dict[str, Callable] = {
+    "no_duplicate_dispatch": _inv_no_duplicate_dispatch,
+    "store_consistent": _inv_store_consistent,
+    "planning_never_starves": _inv_planning_never_starves,
+    "monotone_epochs": _inv_monotone_epochs,
+    "counters_match_records": _inv_counters_match_records,
+    "resume_equals_rerun": _inv_resume_equals_rerun,
+}

@@ -1,0 +1,561 @@
+"""The shipped scenario library: six realistic weathers, one sabotage.
+
+Each spec is a declarative timeline over the engine's event vocabulary
+(scenarios/engine.py) plus named checks for the assertions the SLO
+vocabulary cannot express. All six are deterministic: same seed ⇒ same
+scorecard fingerprint (tools/scenario_engine.py --check-determinism).
+
+  merge-queue-storm   conflicting patch stacks racing one project, a
+                      mid-stack failure blocking its tail
+  dag-stepback        deep dependency DAG, mid-build failure, stepback
+                      activation of the prior revision's task
+  spot-reclamation    mixed EC2-fleet(spot)/docker/static fleet; spot
+                      instances reclaimed mid-task
+  region-failover     lease stolen between begin_tick and the group
+                      flush; the engine fails over to the thief's epoch
+  spawn-burst         interactive spawn-host burst beside CI load, then
+                      the expiry sweep reaps the fleet
+  seasonality         a week compressed to minutes: arrivals + backlog
+                      gauges drive GREEN→…→BLACK→…→GREEN with counted
+                      shedding and a green landing
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..globals import HostStatus, Provider, Requester, TaskStatus
+from .spec import Ev, SLO, ScenarioSpec
+
+# --------------------------------------------------------------------------- #
+# checks
+# --------------------------------------------------------------------------- #
+
+
+def _check_merge_before_patch(run) -> Optional[str]:
+    """Merge-queue entries must outrank plain patches: the first merge
+    dispatch happens no later than the first patch dispatch."""
+    merge_ticks = [
+        t for tid, t in run.dispatch_tick.items() if "-stack" in tid
+    ]
+    patch_ticks = [
+        t for tid, t in run.dispatch_tick.items() if "-patch" in tid
+    ]
+    if not merge_ticks or not patch_ticks:
+        return "storm never dispatched both classes"
+    if min(merge_ticks) > min(patch_ticks):
+        return (
+            f"first merge dispatch at tick {min(merge_ticks)} after "
+            f"first patch dispatch at tick {min(patch_ticks)}"
+        )
+    return None
+
+
+def _check_blocked_tail(run) -> Optional[str]:
+    """The failed stack entry's dependents must stay blocked, never
+    dispatched over an unattainable dependency."""
+    for tid in ("dmq-stackB-02", "dmq-stackB-03"):
+        doc = run.store.collection("tasks").get(tid)
+        if doc is None:
+            return f"{tid} missing"
+        if doc["status"] != TaskStatus.UNDISPATCHED.value:
+            return f"{tid} ran over a failed dependency: {doc['status']}"
+    return None
+
+
+STEPBACK_TARGET = "dsb-int-09"
+
+
+def _check_stepback_scheduled(run) -> Optional[str]:
+    """The stepback-activated task was scheduled AND the packed solve's
+    t_stepback provenance column flagged it (PR-6 provenance riding the
+    result buffer)."""
+    doc = run.store.collection("tasks").get(STEPBACK_TARGET)
+    if doc is None or not doc.get("activated"):
+        return "stepback target never activated"
+    if doc.get("activated_by") != "stepback-activator":
+        return f"activated by {doc.get('activated_by')!r}, not stepback"
+    if STEPBACK_TARGET not in run.dispatch_tick:
+        return "stepback task never dispatched"
+    for res in run.tick_results:
+        prov = getattr(res, "provenance", None)
+        if prov is None:
+            continue
+        terms = prov.explain("dsb", STEPBACK_TARGET)
+        if terms is not None:
+            if not terms.get("stepback"):
+                return "provenance shows stepback=False for the target"
+            if terms.get("rank_term", 0.0) < 10.0:
+                return (
+                    "stepback rank term missing its factor boost: "
+                    f"{terms.get('rank_term')}"
+                )
+            run.stats["stepback_rank_term"] = terms["rank_term"]
+            return None
+    return "stepback task never appeared in solve provenance"
+
+
+def _check_stepback_dedup(run) -> Optional[str]:
+    """Re-delivering the failure (a recovery re-run of mark_end's
+    stepback evaluation) must not activate a second task."""
+    from ..models import task as task_mod
+    from ..models.lifecycle import evaluate_stepback
+
+    failed = task_mod.get(run.store, "dsb-int-10")
+    if failed is None:
+        return "failed task missing"
+    evaluate_stepback(run.store, failed, run.now)  # the re-delivery
+    n = run.store.collection("events").count(
+        lambda d: d.get("event_type") == "TASK_ACTIVATED_STEPBACK"
+    )
+    if n != 1:
+        return f"stepback activated {n} times (dedup broken)"
+    return None
+
+
+def _check_no_stranded_claims(run) -> Optional[str]:
+    """A terminated host must never keep a running_task claim (the
+    stranded-dispatch-claim gap the reclamation scenario exists to
+    catch)."""
+    for doc in run.store.collection("hosts").find(
+        lambda d: d["status"] == HostStatus.TERMINATED.value
+    ):
+        if doc.get("running_task"):
+            return (
+                f"terminated host {doc['_id']} still claims "
+                f"{doc['running_task']}"
+            )
+    return None
+
+
+def _check_reclaimed_restart_credits(run) -> Optional[str]:
+    """Each reclaimed-mid-task execution is archived as a system failure
+    and charged exactly one automatic-restart credit."""
+    reclaimed = run.counter_delta("cloud.spot_reclaimed")
+    reset = run.counter_delta("recovery.stranded_reset")
+    if reset != reclaimed:
+        return (
+            f"{reclaimed} reclamations but {reset} restart-credited "
+            "resets"
+        )
+    return None
+
+
+def _check_mixed_fleet(run) -> Optional[str]:
+    """The fleet really is mixed: ec2-spot, docker containers, and
+    static hosts all ran work."""
+    for distro in ("dspot", "ddock", "dstatic"):
+        if not any(
+            tid.startswith(distro) for tid in run.dispatch_tick
+        ):
+            return f"{distro} never dispatched a task"
+    return None
+
+
+def _check_failover_resumes(run) -> Optional[str]:
+    """After the fenced tick, the thief's very next tick must plan
+    cleanly at a strictly higher epoch."""
+    fenced_at = next(
+        (
+            i for i, r in enumerate(run.tick_results)
+            if r.degraded == "fenced"
+        ),
+        None,
+    )
+    if fenced_at is None:
+        return "no tick was fenced"
+    if fenced_at + 1 >= len(run.tick_results):
+        return "run ended at the fenced tick"
+    after = run.tick_results[fenced_at + 1]
+    if after.degraded:
+        return f"post-failover tick degraded: {after.degraded!r}"
+    if run.epochs[fenced_at + 1] <= run.epochs[fenced_at]:
+        return (
+            f"failover did not raise the epoch: "
+            f"{run.epochs[fenced_at]} -> {run.epochs[fenced_at + 1]}"
+        )
+    run.stats["failover_downtime_ticks"] = 1
+    return None
+
+
+def _check_spawn_lifecycle(run) -> Optional[str]:
+    """Every spawn host reached RUNNING during the burst and was reaped
+    by the expiry sweep after the clock jump."""
+    hosts = run.store.collection("hosts").find(
+        lambda d: d.get("user_host")
+    )
+    if len(hosts) != 40:
+        return f"expected 40 spawn hosts, found {len(hosts)}"
+    ran = sum(1 for d in hosts if d.get("provision_time") or d.get(
+        "start_time"
+    ))
+    run.stats["spawn_hosts_started"] = ran
+    not_reaped = [
+        d["_id"] for d in hosts
+        if d["status"] != HostStatus.TERMINATED.value
+    ]
+    if not_reaped:
+        return (
+            f"{len(not_reaped)} spawn hosts survived expiry "
+            f"(e.g. {not_reaped[0]})"
+        )
+    return None
+
+
+def _check_ladder_cycle(run) -> Optional[str]:
+    """The full GREEN→…→BLACK→…→GREEN cycle, in order."""
+    levels = [r.overload for r in run.tick_results]
+    try:
+        i_black = levels.index("black")
+    except ValueError:
+        return f"never reached BLACK (saw {sorted(set(levels))})"
+    if "green" not in levels[:i_black]:
+        return "did not start GREEN"
+    if "green" not in levels[i_black:]:
+        return "never recovered to GREEN after BLACK"
+    run.stats["ticks_to_recover_green"] = (
+        levels[i_black:].index("green")
+    )
+    return None
+
+
+def _check_outbox_cap_held(run) -> Optional[str]:
+    undelivered = run.store.collection("slack_outbox").count(
+        lambda d: not d.get("delivered") and not d.get("failed")
+    )
+    if undelivered > 400:
+        return f"outbox cap breached: {undelivered} undelivered"
+    return None
+
+
+def _sabotage_duplicate_claim(run) -> None:
+    """Deliberately corrupt the dispatch books — duplicate a host's
+    running-task claim bypassing the CAS — so the invariant layer must
+    catch it (the gate's self-test that a violation fails CI)."""
+    hosts = sorted(
+        (
+            d for d in run.store.collection("hosts").find()
+            if d.get("running_task")
+        ),
+        key=lambda d: d["_id"],
+    )
+    free = sorted(
+        (
+            d for d in run.store.collection("hosts").find()
+            if not d.get("running_task")
+        ),
+        key=lambda d: d["_id"],
+    )
+    if hosts and free:
+        run.store.collection("hosts").update(
+            free[0]["_id"], {"running_task": hosts[0]["running_task"]}
+        )
+
+
+# --------------------------------------------------------------------------- #
+# the six weathers
+# --------------------------------------------------------------------------- #
+
+
+def _merge_queue_storm() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dmq", "provider": Provider.MOCK.value, "hosts": 6},
+        ]}),
+        Ev(0, "tasks", {
+            "distro": "dmq", "n": 12, "prefix": "dmq-patch",
+            "requester": Requester.PATCH.value,
+        }),
+        Ev(0, "merge_stack", {"distro": "dmq", "stack": "stackA", "n": 4}),
+        Ev(1, "merge_stack", {"distro": "dmq", "stack": "stackB", "n": 4}),
+        Ev(1, "merge_stack", {"distro": "dmq", "stack": "stackC", "n": 4}),
+        # the storm's conflict: stackB's second entry breaks mid-merge
+        Ev(2, "fail_next", {"match": "dmq-stackB-01", "count": 1}),
+    ]
+    return ScenarioSpec(
+        name="merge-queue-storm",
+        description="conflicting merge-queue patch stacks racing one "
+                    "project; a mid-stack failure blocks exactly its "
+                    "tail while siblings merge through",
+        ticks=16,
+        events=events,
+        slos=[
+            SLO("one-conflict-failure", "tasks_failed", "==", 1),
+            # everything except the broken stack's 2-entry tail finishes
+            SLO("storm-drains", "tasks_unfinished", "==", 2),
+            SLO("no-system-failures", "tasks_system_failed", "==", 0),
+        ],
+        checks=[
+            ("merge-prioritized", _check_merge_before_patch),
+            ("failed-stack-tail-blocked", _check_blocked_tail),
+        ],
+    )
+
+
+def _dag_stepback() -> ScenarioSpec:
+    # mainline history: revision 9 (all inactive — already built) and
+    # revision 10 (activated), each a 4-deep DAG
+    def rev(order: int, activated: bool):
+        s = f"{order:02d}"
+        return [
+            {"id": f"dsb-compile-{s}", "display_name": "compile",
+             "revision_order": order, "activated": activated},
+            {"id": f"dsb-unit-{s}", "display_name": "unit",
+             "revision_order": order, "activated": activated,
+             "deps": [f"dsb-compile-{s}"]},
+            {"id": f"dsb-int-{s}", "display_name": "integration",
+             "revision_order": order, "activated": activated,
+             "deps": [] if not activated else [f"dsb-unit-{s}"]},
+            {"id": f"dsb-pkg-{s}", "display_name": "package",
+             "revision_order": order, "activated": activated,
+             "deps": [f"dsb-int-{s}"]},
+        ]
+
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dsb", "provider": Provider.MOCK.value, "hosts": 4},
+        ]}),
+        Ev(0, "dag", {"distro": "dsb", "nodes": rev(9, False)}),
+        Ev(0, "dag", {"distro": "dsb", "nodes": rev(10, True)}),
+        # integration-10 fails mid-build → linear stepback must activate
+        # integration-09 (undispatched, inactive, prior revision)
+        Ev(0, "fail_next", {"match": "dsb-int-10", "count": 1}),
+    ]
+    return ScenarioSpec(
+        name="dag-stepback",
+        description="deep dependency DAG; a mid-build failure triggers "
+                    "stepback activation of the prior revision's task, "
+                    "prioritized by the packed solve's t_stepback term "
+                    "and deduplicated on re-replay",
+        ticks=12,
+        events=events,
+        slos=[
+            SLO("one-stepback", "stepback_activations", "==", 1),
+            SLO("one-failure", "tasks_failed", "==", 1),
+            # pkg-10 blocks on the failed integration; everything else
+            # (rev-10 chain + the stepback target) runs
+            SLO("dag-progresses", "tasks_unfinished", "<=", 7),
+        ],
+        checks=[
+            ("stepback-scheduled-and-ranked", _check_stepback_scheduled),
+            ("stepback-dedup-on-replay", _check_stepback_dedup),
+        ],
+    )
+
+
+def _spot_reclamation() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dstatic", "provider": Provider.STATIC.value,
+             "hosts": 4},
+            # container-pool parents host containers instead of running
+            # tasks — their own distro, like production pools
+            {"id": "dparent", "provider": Provider.STATIC.value,
+             "hosts": 2, "has_containers": True},
+            {"id": "dspot", "provider": Provider.EC2_FLEET.value,
+             "hosts": 0,
+             "provider_settings": {"fleet_use_spot": True,
+                                   "instance_type": "m5.large"}},
+            {"id": "ddock", "provider": Provider.DOCKER.value,
+             "hosts": 0, "container_pool": "pool1"},
+        ]}),
+        Ev(0, "container_pools", {"pools": [
+            {"id": "pool1", "distro": "dparent", "max_containers": 2},
+        ]}),
+        Ev(0, "grow_fleet", {"distro": "dspot", "n": 6}),
+        Ev(0, "grow_fleet", {"distro": "ddock", "n": 3}),
+        Ev(1, "tasks", {"distro": "dspot", "n": 18, "prefix": "dspot-t"}),
+        Ev(1, "tasks", {"distro": "dstatic", "n": 8,
+                        "prefix": "dstatic-t"}),
+        Ev(1, "tasks", {"distro": "ddock", "n": 6, "prefix": "ddock-t"}),
+        # mid-run, AWS takes three busy spot instances back
+        Ev(4, "spot_reclaim", {"n": 3, "distro": "dspot"}),
+        # replacement capacity arrives two ticks later
+        Ev(6, "grow_fleet", {"distro": "dspot", "n": 3}),
+    ]
+    return ScenarioSpec(
+        name="spot-reclamation",
+        description="mixed EC2-fleet(spot)/docker/static fleet; spot "
+                    "instances reclaimed mid-task must route through "
+                    "reset-or-system-fail with restart credits and no "
+                    "stranded dispatch claim",
+        ticks=18,
+        events=events,
+        slos=[
+            SLO("reclaimed", "spot_reclaimed", "==", 3),
+            SLO("reclaimed-tasks-restarted", "restarts_total", "==", 3),
+            SLO("no-credit-exhaustion", "tasks_system_failed", "==", 0),
+            SLO("everything-finishes", "tasks_unfinished", "==", 0),
+        ],
+        checks=[
+            ("mixed-fleet-all-ran", _check_mixed_fleet),
+            ("no-stranded-claims", _check_no_stranded_claims),
+            ("restart-credit-accounting",
+             _check_reclaimed_restart_credits),
+        ],
+    )
+
+
+def _region_failover() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dreg", "provider": Provider.MOCK.value, "hosts": 4},
+        ]}),
+        Ev(0, "tasks", {"distro": "dreg", "n": 16, "prefix": "dreg-a"}),
+        # the steal lands between begin_tick and the group flush of
+        # tick 2's commit (the PR-3 wal.fence machinery)
+        Ev(2, "lease_steal", {}),
+        Ev(4, "tasks", {"distro": "dreg", "n": 8, "prefix": "dreg-b"}),
+    ]
+    return ScenarioSpec(
+        name="region-failover",
+        description="writer lease stolen mid-tick (region failover): "
+                    "the fenced holder sheds its tick, the thief "
+                    "resumes at a higher epoch, and the WAL replays to "
+                    "the same converged state",
+        ticks=12,
+        durable=True,
+        events=events,
+        slos=[
+            SLO("one-fenced-tick", "fenced_ticks", "==", 1),
+            SLO("one-failover", "failovers", "==", 1),
+            SLO("work-survives", "tasks_unfinished", "==", 0),
+        ],
+        checks=[
+            ("failover-resumes-next-tick", _check_failover_resumes),
+        ],
+    )
+
+
+def _spawn_burst() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dci", "provider": Provider.MOCK.value, "hosts": 5},
+            {"id": "dws", "provider": Provider.EC2_FLEET.value,
+             "hosts": 0,
+             "provider_settings": {"fleet_use_spot": False,
+                                   "instance_type": "c5.xlarge"}},
+        ]}),
+        Ev(0, "tasks", {"distro": "dci", "n": 20, "prefix": "dci-t"}),
+        Ev(1, "spawn_burst", {"distro": "dws", "users": 25}),
+        Ev(2, "spawn_burst", {"distro": "dws", "users": 15,
+                              "prefix": "late"}),
+        # day over: jump past the 24h default expiration; the expiry
+        # sweep must reap the whole interactive fleet
+        Ev(8, "advance_clock", {"s": 25 * 3600.0}),
+    ]
+    return ScenarioSpec(
+        name="spawn-burst",
+        description="interactive spawn-host burst (40 workstations in "
+                    "two waves) beside CI load: all provision to "
+                    "RUNNING, CI planning is untouched, and the expiry "
+                    "sweep reaps them after the compressed day",
+        ticks=12,
+        events=events,
+        slos=[
+            SLO("ci-unaffected", "tasks_unfinished", "==", 0),
+            SLO("no-failures", "tasks_failed", "==", 0),
+        ],
+        checks=[
+            ("spawn-lifecycle", _check_spawn_lifecycle),
+        ],
+    )
+
+
+def _seasonality() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dsea", "provider": Provider.MOCK.value, "hosts": 6},
+        ]}),
+    ]
+    # a commuter week in 36 ticks: (arrivals, queue-backlog gauge,
+    # outbox burst) per phase — the backlog gauge is the declarative
+    # stand-in for the job plane's pending depth under that traffic
+    phases = [
+        (range(0, 6), 2, 10.0, 0),      # overnight
+        (range(6, 11), 4, 80.0, 0),     # morning ramp → YELLOW
+        (range(11, 16), 6, 160.0, 60),  # storm → RED
+        (range(16, 19), 4, 300.0, 150),  # peak → BLACK
+        (range(19, 25), 2, 100.0, 0),   # decline
+        (range(25, 33), 1, 5.0, 0),     # calm
+        (range(33, 36), 0, 5.0, 0),     # idle tail → the week drains
+    ]
+    for ticks, arrivals, backlog, outbox in phases:
+        for t in ticks:
+            if arrivals:
+                events.append(Ev(t, "tasks", {
+                    "distro": "dsea", "n": arrivals,
+                    "prefix": f"dsea-w{t:02d}",
+                }))
+            events.append(Ev(t, "gauge", {
+                "name": "queue_pending", "value": backlog,
+            }))
+            if outbox:
+                events.append(Ev(t, "outbox", {"n": outbox}))
+            if not outbox and t >= 19:
+                events.append(Ev(t, "drain_outbox", {}))
+    return ScenarioSpec(
+        name="seasonality",
+        description="a week compressed to minutes: arrivals and backlog "
+                    "gauges drive the ladder GREEN→YELLOW→RED→BLACK and "
+                    "back, with stats/events shed (and counted) at the "
+                    "peak and a green landing",
+        ticks=36,
+        events=events,
+        overload={
+            "queue_pending_levels": [50.0, 120.0, 250.0],
+            "outbox_depth_levels": [60.0, 150.0, 280.0],
+            "outbox_cap": 400,
+            "hysteresis_ticks": 2,
+        },
+        slos=[
+            SLO("reaches-black", "max_overload_level", "==", 3),
+            SLO("lands-green", "ended_green", "truthy", 1),
+            SLO("sheds-are-counted", "sheds_total", ">=", 1),
+            SLO("week-drains", "tasks_unfinished", "==", 0),
+        ],
+        checks=[
+            ("full-ladder-cycle", _check_ladder_cycle),
+            ("outbox-cap-held", _check_outbox_cap_held),
+        ],
+    )
+
+
+def _sabotage() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="sabotage-duplicate-claim",
+        description="deliberate invariant violation (a forged duplicate "
+                    "running-task claim): the engine must score this "
+                    "RED — the gate's self-test",
+        ticks=6,
+        events=[
+            # 4 hosts, 2 tasks: at tick 1 two hosts are mid-task and two
+            # are free — the forged duplicate claim has both sides live
+            Ev(0, "fleet", {"distros": [
+                {"id": "dsab", "provider": Provider.MOCK.value,
+                 "hosts": 4},
+            ]}),
+            Ev(0, "tasks", {"distro": "dsab", "n": 2,
+                            "prefix": "dsab-t"}),
+            Ev(1, "call", {"fn": _sabotage_duplicate_claim}),
+        ],
+        slos=[],
+        checks=[],
+        tier1=False,
+    )
+
+
+#: name → spec factory for the default suite (factories, not instances:
+#: specs carry mutable event args and every run deserves a fresh one)
+SCENARIOS: Dict[str, callable] = {
+    "merge-queue-storm": _merge_queue_storm,
+    "dag-stepback": _dag_stepback,
+    "spot-reclamation": _spot_reclamation,
+    "region-failover": _region_failover,
+    "spawn-burst": _spawn_burst,
+    "seasonality": _seasonality,
+}
+
+#: deliberately-broken specs the gate's self-test runs EXPECTING failure
+SABOTAGE_SCENARIOS: Dict[str, callable] = {
+    "sabotage-duplicate-claim": _sabotage,
+}

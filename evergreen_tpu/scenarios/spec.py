@@ -1,0 +1,162 @@
+"""Declarative scenario specs for the trace-driven scenario engine.
+
+A scenario is a *timeline*: a fleet description, a list of events pinned
+to virtual ticks (commits arriving, patch stacks landing, tasks failing,
+spot instances vanishing, a lease being stolen mid-commit, load gauges
+ramping through a compressed week), and a contract — the cross-cutting
+invariants every scenario must keep (resume ≡ rerun, no duplicate
+dispatch, planning never starves, monotone epochs, counters == records)
+plus scenario-specific SLOs evaluated over the run's stats.
+
+The engine (scenarios/engine.py) compiles a spec into a deterministic
+seeded replay against a full in-process plane and emits one scorecard
+entry per scenario; ``tools/scenario_engine.py`` aggregates them into
+``SCORECARD.json`` and ``tools/gate.py --scenarios`` diffs that against
+the last green run.
+
+Specs stay declarative where the vocabulary allows (every stock event
+kind is data → EVENT_HANDLERS), with two escape hatches the matrix
+migrations need: a ``call`` event running an arbitrary function at a
+tick, and ``checks`` — named predicates over the finished run that
+express case-specific assertions the SLO vocabulary cannot.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Tuple
+
+#: invariant names every scenario asserts unless the spec says otherwise
+#: (scenarios/invariants.py maps them to checkers; durable-only checks
+#: skip themselves on in-memory runs)
+DEFAULT_INVARIANTS: Tuple[str, ...] = (
+    "no_duplicate_dispatch",
+    "store_consistent",
+    "planning_never_starves",
+    "monotone_epochs",
+    "counters_match_records",
+    "resume_equals_rerun",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Ev:
+    """One timeline entry: at virtual tick ``tick`` (before that tick's
+    scheduler pass), run the ``kind`` handler with ``args``."""
+
+    tick: int
+    kind: str
+    args: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One scenario-specific service-level objective, evaluated over the
+    run's stats dict. ``op`` is one of "<=", ">=", "==", "truthy".
+    The scorecard records value, bound, pass/fail, and the margin (the
+    relative headroom left before the bound — the number the gate's
+    diff watches shrink)."""
+
+    name: str
+    metric: str
+    op: str
+    bound: float
+
+    def evaluate(self, stats: Dict) -> Dict:
+        value = stats.get(self.metric)
+        ok = False
+        margin = 0.0
+        if value is not None:
+            v = float(value)
+            b = float(self.bound)
+            if self.op == "<=":
+                ok = v <= b
+                margin = (b - v) / max(abs(b), 1.0)
+            elif self.op == ">=":
+                ok = v >= b
+                margin = (v - b) / max(abs(b), 1.0)
+            elif self.op == "==":
+                ok = v == b
+                margin = 0.0 if ok else -abs(v - b) / max(abs(b), 1.0)
+            elif self.op == "truthy":
+                ok = bool(value)
+                margin = 0.0
+        return {
+            "metric": self.metric,
+            "op": self.op,
+            "bound": self.bound,
+            "value": value,
+            "ok": ok,
+            "margin": round(margin, 4),
+        }
+
+
+@dataclasses.dataclass
+class ScenarioSpec:
+    """One replayable weather. See the module docstring; the library of
+    shipped scenarios lives in scenarios/library.py."""
+
+    name: str
+    description: str
+    ticks: int
+    events: List[Ev] = dataclasses.field(default_factory=list)
+    slos: List[SLO] = dataclasses.field(default_factory=list)
+    #: named predicates over the finished run: fn(run) -> None | problem
+    checks: List[Tuple[str, Callable]] = dataclasses.field(
+        default_factory=list
+    )
+    invariants: Tuple[str, ...] = DEFAULT_INVARIANTS
+    seed: int = 0
+    #: virtual seconds between scheduler ticks (the compressed clock:
+    #: a week-long trace replays in minutes by stretching this)
+    tick_s: float = 15.0
+    #: run against a DurableStore + writer lease in a temp data dir
+    #: (failover / WAL scenarios) instead of an in-memory Store
+    durable: bool = False
+    #: same seed ⇒ same scorecard fingerprint. Engine-driven scenarios
+    #: keep this True by running everything on the virtual clock with
+    #: no worker threads; migrated storm cases that exercise real
+    #: threads/timers opt out (their assertions still run).
+    deterministic: bool = True
+    #: ticks a dispatched task runs before the engine completes it
+    default_task_ticks: int = 1
+    #: run the between-ticks service pass (cloud reconcile, provisioning,
+    #: the deterministic agent). Migrated matrix cases turn it off — they
+    #: assert on the tick pipeline alone, exactly like the bespoke
+    #: harnesses they replace.
+    service_loop: bool = True
+    #: TickOptions overrides (dataclasses.replace kwargs)
+    tick_options: Dict = dataclasses.field(default_factory=dict)
+    #: OverloadConfig overrides. The engine BASE config neutralizes every
+    #: wall-clock-coupled signal (store latency, api rate, tick lag) so
+    #: a slow CI box cannot flip a deterministic scenario's ladder; a
+    #: spec re-arms exactly the signals its trace drives.
+    overload: Dict = dataclasses.field(default_factory=dict)
+    #: extra config sections to set: {SectionClassName: {field: value}}
+    config: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    #: run in the tier-1 fast subset (tests/test_scenarios.py); the full
+    #: sweep always runs everything
+    tier1: bool = True
+
+
+def scorecard_entry_fingerprint(entry: Dict) -> str:
+    """Stable hash of one scenario's scorecard entry, excluding the
+    wall-clock fields — the determinism contract is over decisions and
+    counters, never over how fast this box ran them."""
+    import hashlib
+    import json
+
+    def scrub(obj):
+        if isinstance(obj, dict):
+            return {
+                k: scrub(v)
+                for k, v in sorted(obj.items())
+                if k not in ("timing", "wall_ms", "fingerprint")
+            }
+        if isinstance(obj, list):
+            return [scrub(v) for v in obj]
+        if isinstance(obj, float):
+            return round(obj, 6)
+        return obj
+
+    payload = json.dumps(scrub(entry), sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]

@@ -481,6 +481,13 @@ class ReadPathConfig(ConfigSection):
     #: at RED, expensive reads degrade to replica serving under this
     #: LOOSER bound (with a Warning header) before falling back to 429
     degraded_staleness_bound_ms: float = 30000.0
+    #: readiness probe (GET /healthz/ready): a replica-process server
+    #: answers 503 once its staleness exceeds this, so load balancers
+    #: stop routing to a lagging follower. 0 = fall back to
+    #: staleness_bound_ms. Deliberately looser than the serving bound:
+    #: a replica slightly over the SERVING bound still forwards reads
+    #: to the primary, which beats ejecting it from rotation.
+    readiness_staleness_bound_ms: float = 10000.0
     #: fingerprint ETag + in-process response cache
     cache_enabled: bool = True
     cache_max_entries: int = 256
@@ -495,7 +502,11 @@ class ReadPathConfig(ConfigSection):
     longpoll_recheck_s: float = 1.0
 
     def validate_and_default(self) -> str:
-        if self.staleness_bound_ms < 0 or self.degraded_staleness_bound_ms < 0:
+        if (
+            self.staleness_bound_ms < 0
+            or self.degraded_staleness_bound_ms < 0
+            or self.readiness_staleness_bound_ms < 0
+        ):
             return "staleness bounds must be >= 0"
         if self.degraded_staleness_bound_ms < self.staleness_bound_ms:
             return (

@@ -43,6 +43,13 @@ HOSTS_REAP_MISSING_TS = _metrics.counter(
     "epoch-0 instant reaping.",
     legacy="hosts.reap_missing_timestamps",
 )
+CLOUD_SPOT_RECLAIMED = _metrics.counter(
+    "cloud_spot_reclaimed_total",
+    "Spot/preemptible instances the provider took back while we "
+    "considered them live — discovered by the cloud-reconcile monitor; "
+    "any running task routes through reset-or-system-fail.",
+    legacy="cloud.spot_reclaimed",
+)
 
 #: default idle threshold before termination (reference
 #: units/host_monitoring_idle_termination.go idleTimeCutoff ~ minutes)
@@ -82,17 +89,42 @@ def monitor_host_cloud_state(store: Store, now: Optional[float] = None) -> List[
                     "termination_time": now,
                 },
             )
+            if h.spot:
+                # expected weather on spot capacity, but it must be
+                # visible: reclamation rate is a provider-pool signal
+                # the capacity plane's preemption cost models
+                from ..utils.log import get_logger
+
+                CLOUD_SPOT_RECLAIMED.inc()
+                get_logger("cloud").warning(
+                    "spot-instance-reclaimed",
+                    host=h.id,
+                    distro=h.distro_id,
+                    running_task=h.running_task,
+                )
             event_mod.log(
                 store,
                 event_mod.RESOURCE_HOST,
                 "HOST_EXTERNALLY_TERMINATED",
                 h.id,
-                {"cloud_status": cloud_status},
+                {"cloud_status": cloud_status, "spot": h.spot},
                 timestamp=now,
             )
             changed.append(h.id)
             if h.running_task:
                 fix_stranded_task(store, h.running_task, h.id, now)
+                # reset-or-system-fail releases the claim through
+                # mark_end → clear_running_task, but a task that was
+                # never marked dispatched/started (a half-assignment the
+                # recovery pass would heal at startup) no-ops there and
+                # would leave the DEAD host holding a claim forever — a
+                # stranded dispatch claim no live path clears. Fail
+                # closed: a terminated host claims nothing.
+                hdoc = host_mod.coll(store).get(h.id)
+                if hdoc is not None and hdoc.get("running_task"):
+                    host_mod.coll(store).update(
+                        h.id, dict(host_mod.RUNNING_TASK_CLEAR_FIELDS)
+                    )
     return changed
 
 

@@ -1,0 +1,50 @@
+"""Client-side conditional-GET state, shared by every poller.
+
+The server's fingerprint ETag cache (api/readcache.py) answers an
+``If-None-Match`` revalidation with ``304 Not Modified`` and zero store
+reads; this is the client half — remember the last validator + payload
+per path, attach the validator on the next GET, and serve the 304 from
+our own copy. One implementation for the agent transport
+(agent/rest_comm.py) and the CLI client (cli.py) so eviction and
+copy-on-return semantics can never drift between them.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Tuple
+
+#: a poller revisits a handful of endpoints; bound the validator map
+DEFAULT_MAX_ENTRIES = 64
+
+
+class ClientEtagCache:
+    """path → (etag, pristine payload), FIFO-bounded. Payloads are
+    copied both on store and on serve: callers own (and may mutate)
+    every dict they receive, the cache keeps the pristine one."""
+
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES) -> None:
+        self._max = max_entries
+        self._entries: Dict[str, Tuple[str, dict]] = {}
+
+    def validator(self, path: str) -> Optional[str]:
+        """The ``If-None-Match`` value to send for ``path``, if any."""
+        entry = self._entries.get(path)
+        return entry[0] if entry is not None else None
+
+    def store(self, path: str, etag: str, payload: dict) -> None:
+        if len(self._entries) >= self._max and path not in self._entries:
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[path] = (etag, copy.deepcopy(payload))
+
+    def serve(self, path: str) -> Optional[dict]:
+        """The cached payload for a 304 answer (a fresh copy), or None
+        when we never held one (a 304 without a copy must surface as an
+        error, not an empty dict)."""
+        entry = self._entries.get(path)
+        return copy.deepcopy(entry[1]) if entry is not None else None
+
+    def __contains__(self, path: str) -> bool:
+        return path in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -1,0 +1,369 @@
+"""Trace-driven scenario engine (ISSUE 12): the six shipped weathers run
+green, the scorecard is deterministic and diffable, the sabotage
+self-test proves an invariant violation fails the gate, and the
+satellite surfaces (spot-reclamation hardening, client-side
+If-None-Match, the /healthz/ready staleness probe) hold their
+contracts.
+
+Fast subset runs in tier-1; the full determinism sweep is slow-marked
+(``make scenarios`` / ``tools/gate.py --scenarios`` runs it in CI).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from evergreen_tpu.scenarios import (
+    SABOTAGE_SCENARIOS,
+    SCENARIOS,
+    run_scenario,
+)
+
+# --------------------------------------------------------------------------- #
+# the six weathers
+# --------------------------------------------------------------------------- #
+
+
+def _failures(entry: dict) -> dict:
+    out = {}
+    for section in ("invariants", "checks", "slos"):
+        for name, verdict in entry.get(section, {}).items():
+            if not verdict["ok"]:
+                out[f"{section}.{name}"] = verdict
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_green(name, store):
+    entry = run_scenario(SCENARIOS[name]())
+    assert entry["ok"], _failures(entry)
+
+
+def test_scenario_fingerprint_excludes_timing(store):
+    """Two replays of one deterministic spec produce the same
+    fingerprint even though wall time differs (same seed ⇒ same
+    scorecard)."""
+    a = run_scenario(SCENARIOS["dag-stepback"]())
+    b = run_scenario(SCENARIOS["dag-stepback"]())
+    assert a["fingerprint"] == b["fingerprint"]
+    assert a["ok"] and b["ok"]
+
+
+@pytest.mark.slow
+def test_full_sweep_deterministic(store):
+    """The gate's shape: every scenario + migrated matrix case through
+    the engine, each deterministic spec replayed and fingerprint-
+    compared."""
+    from tools.scenario_engine import run_suite
+
+    scorecard = run_suite(check_determinism=True)
+    assert scorecard["ok"], {
+        n: _failures(e)
+        for n, e in scorecard["scenarios"].items()
+        if not e["ok"]
+    }
+    for name, entry in scorecard["scenarios"].items():
+        if entry["deterministic"]:
+            assert entry["invariants"].get(
+                "same_seed_same_scorecard", {"ok": True}
+            )["ok"], name
+
+
+# --------------------------------------------------------------------------- #
+# sabotage: an injected invariant violation must fail the gate
+# --------------------------------------------------------------------------- #
+
+
+def test_sabotage_duplicate_claim_is_caught(store):
+    entry = run_scenario(
+        SABOTAGE_SCENARIOS["sabotage-duplicate-claim"]()
+    )
+    assert not entry["ok"]
+    assert not entry["invariants"]["store_consistent"]["ok"]
+
+
+def test_engine_cli_fails_on_injected_violation(store, tmp_path,
+                                                monkeypatch):
+    """``gate.py --scenarios`` delegates here: a suite containing an
+    invariant-violating scenario must exit non-zero and say which."""
+    import evergreen_tpu.scenarios as scenarios_pkg
+    from tools import scenario_engine
+
+    monkeypatch.setattr(
+        scenarios_pkg, "SCENARIOS",
+        dict(SABOTAGE_SCENARIOS),
+    )
+    rc = scenario_engine.main(
+        ["--no-matrix", "--scorecard", str(tmp_path / "SCORECARD.json")]
+    )
+    assert rc != 0
+    scorecard = json.loads((tmp_path / "SCORECARD.json").read_text())
+    assert not scorecard["ok"]
+
+
+def test_sabotage_selftest_entrypoint(store):
+    """The CLI's --sabotage mode passes exactly when the violation IS
+    caught."""
+    from tools.scenario_engine import run_sabotage
+
+    assert run_sabotage() == 0
+
+
+# --------------------------------------------------------------------------- #
+# scorecard diff: graceful-degradation regressions fail CI
+# --------------------------------------------------------------------------- #
+
+
+def _entry(ok=True, slos=None, dwell=None, sheds=0):
+    return {
+        "ok": ok,
+        "invariants": {"store_consistent": {"ok": True, "detail": ""}},
+        "checks": {},
+        "slos": slos or {},
+        "dwell_ticks": dwell or {},
+        "stats": {"sheds_total": sheds},
+    }
+
+
+def test_diff_flags_regressions(store):
+    from tools.scenario_engine import diff_scorecards
+
+    green = {"scenarios": {
+        "a": _entry(),
+        "b": _entry(slos={"lat": {"ok": True, "margin": 0.8}}),
+        "c": _entry(dwell={"red": 2}, sheds=5),
+        "gone": _entry(),
+    }}
+    new = {"scenarios": {
+        "a": _entry(ok=False),                              # green → red
+        "b": _entry(slos={"lat": {"ok": True, "margin": 0.1}}),  # collapse
+        "c": _entry(dwell={"red": 6, "black": 3}, sheds=50),     # dwell+shed
+    }}
+    regressions = diff_scorecards(new, green)
+    text = "\n".join(regressions)
+    assert "a: was green, now red" in text
+    assert "margin collapsed" in text
+    assert "dwell grew" in text
+    assert "sheds grew" in text
+    assert "gone: scenario disappeared" in text
+
+
+def test_diff_clean_on_identical(store):
+    from tools.scenario_engine import diff_scorecards
+
+    doc = {"scenarios": {"a": _entry(dwell={"red": 2}, sheds=5)}}
+    assert diff_scorecards(doc, doc) == []
+
+
+# --------------------------------------------------------------------------- #
+# satellite: spot-reclamation hardening
+# --------------------------------------------------------------------------- #
+
+
+def test_spot_reclaim_routes_through_reset_with_credit(store):
+    """A spot host vanishing mid-task: the task is reset with one
+    automatic-restart credit, the dead host keeps no claim, and the
+    reclamation is counted."""
+    from evergreen_tpu.cloud import ec2_fleet
+    from evergreen_tpu.globals import HostStatus, Provider, TaskStatus
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.host import Host, new_intent
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.units.host_jobs import monitor_host_cloud_state
+    from evergreen_tpu.utils import log as log_mod
+
+    distro_mod.insert(store, Distro(
+        id="dspot", provider=Provider.EC2_FLEET.value,
+        provider_settings={"fleet_use_spot": True},
+    ))
+    intent = new_intent("dspot", Provider.EC2_FLEET.value)
+    host_mod.insert(store, intent)
+    mgr = ec2_fleet.EC2FleetManager()
+    mgr.spawn_host(store, intent)
+    h = host_mod.get(store, intent.id)
+    assert h.spot is True  # recorded at spawn from the launch spec
+    # instance comes up, task dispatched onto it
+    mgr.client.describe_instance(h.external_id)
+    host_mod.coll(store).update(h.id, {
+        "status": HostStatus.RUNNING.value, "running_task": "t1",
+    })
+    task_mod.insert(store, Task(
+        id="t1", distro_id="dspot", status=TaskStatus.DISPATCHED.value,
+        activated=True, host_id=h.id,
+    ))
+    before = log_mod.get_counter("cloud.spot_reclaimed")
+    # AWS takes the instance back
+    mgr.client.instances[h.external_id]["state"] = "terminated"
+    changed = monitor_host_cloud_state(store, now=1e9)
+    assert h.id in changed
+    assert log_mod.get_counter("cloud.spot_reclaimed") == before + 1
+    t = task_mod.get(store, "t1")
+    assert t.status == TaskStatus.UNDISPATCHED.value  # reset to rerun
+    assert t.num_automatic_restarts == 1
+    hdoc = host_mod.coll(store).get(h.id)
+    assert hdoc["status"] == HostStatus.TERMINATED.value
+    assert hdoc["running_task"] == ""  # no stranded dispatch claim
+
+
+def test_externally_terminated_host_never_keeps_claim(store):
+    """Even when the stranded task is in a state mark_end refuses
+    (never marked dispatched — the half-assignment shape), the dead
+    host's claim is cleared."""
+    from evergreen_tpu.cloud.mock import MockCloudManager
+    from evergreen_tpu.globals import HostStatus, Provider, TaskStatus
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models.distro import Distro
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.units.host_jobs import monitor_host_cloud_state
+
+    distro_mod.insert(store, Distro(id="dm", provider=Provider.MOCK.value))
+    host_mod.insert(store, Host(
+        id="h1", distro_id="dm", provider=Provider.MOCK.value,
+        status=HostStatus.RUNNING.value, external_id="mock-h1",
+        running_task="tweird",
+    ))
+    # cloud truth: gone; task never marked dispatched
+    task_mod.insert(store, Task(
+        id="tweird", distro_id="dm",
+        status=TaskStatus.UNDISPATCHED.value, activated=True,
+        host_id="h1",
+    ))
+    monitor_host_cloud_state(store, now=1e9)
+    hdoc = host_mod.coll(store).get("h1")
+    assert hdoc["status"] == HostStatus.TERMINATED.value
+    assert hdoc["running_task"] == ""
+
+
+# --------------------------------------------------------------------------- #
+# satellite: client-side If-None-Match adoption
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def http_server(store):
+    from evergreen_tpu.api.rest import RestApi
+
+    api = RestApi(store)
+    server = api.serve("127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield api, f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def test_rest_comm_conditional_get(http_server):
+    from evergreen_tpu.agent.rest_comm import (
+        API_CLIENT_ETAG_HITS,
+        RestCommunicator,
+    )
+
+    api, base = http_server
+    comm = RestCommunicator(base)
+    first = comm._call("GET", "/rest/v2/hosts")
+    assert "/rest/v2/hosts" in comm._etag_cache
+    hits0 = API_CLIENT_ETAG_HITS.value()
+    second = comm._call("GET", "/rest/v2/hosts")
+    assert second == first
+    assert API_CLIENT_ETAG_HITS.value() == hits0 + 1  # served via 304
+
+
+def test_rest_comm_revalidates_after_change(http_server):
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+    from evergreen_tpu.globals import HostStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models.host import Host
+
+    api, base = http_server
+    comm = RestCommunicator(base)
+    first = comm._call("GET", "/rest/v2/hosts")
+    host_mod.insert(api._store, Host(
+        id="hnew", distro_id="d", status=HostStatus.RUNNING.value,
+    ))
+    second = comm._call("GET", "/rest/v2/hosts")
+    assert second != first  # the changed fingerprint missed the cache
+    assert any(h.get("host_id") == "hnew" or h.get("_id") == "hnew"
+               for h in second)
+
+
+def test_cli_status_watch_uses_conditional_gets(http_server):
+    from evergreen_tpu import cli
+    from evergreen_tpu.api import readcache
+
+    api, base = http_server
+
+    class Args:
+        api_server = base
+
+    call = cli._client(Args)
+    first = call("GET", "/rest/v2/status")
+    hits0 = readcache.API_CACHE_HITS.value(endpoint="status")
+    second = call("GET", "/rest/v2/status")
+    assert second == first
+    # the server-side fingerprint cache answered the revalidation
+    assert readcache.API_CACHE_HITS.value(endpoint="status") > hits0
+
+
+# --------------------------------------------------------------------------- #
+# satellite: /healthz readiness probe
+# --------------------------------------------------------------------------- #
+
+
+def test_healthz_liveness_and_primary_ready(store):
+    from evergreen_tpu.api.rest import RestApi
+
+    api = RestApi(store)
+    assert api.handle("GET", "/healthz") == (200, {"ok": True})
+    status, payload = api.handle("GET", "/healthz/ready")
+    assert status == 200 and payload["ready"] and payload["role"] == "primary"
+
+
+def test_healthz_exempt_from_auth_and_shedding(store):
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.utils import overload
+
+    api = RestApi(store, require_auth=True)
+    monitor = overload.monitor_for(store)
+    monitor.observe("queue_pending", 1e9)
+    monitor.evaluate()
+    assert monitor.level() == overload.BLACK
+    status, _ = api.handle("GET", "/healthz/ready")
+    assert status == 200  # no 401, no 429 — probes always answer
+
+
+def test_readiness_503_on_stale_replica(tmp_path):
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.settings import ReadPathConfig
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    writer = DurableStore(str(tmp_path))
+    ReadPathConfig(readiness_staleness_bound_ms=1000.0).set(writer)
+    writer.collection("tasks").insert({"_id": "t1", "status": "x"})
+    writer.checkpoint()
+    replica = ReplicaStore(str(tmp_path))
+    replica.poll()
+    try:
+        api = RestApi(replica)
+        status, payload = api.handle("GET", "/healthz/ready")
+        assert status == 200 and payload["ready"]
+        # the tail lags beyond the bound: LBs must stop routing here
+        replica.staleness_ms = lambda *a, **k: 5000.0
+        status, payload = api.handle("GET", "/healthz/ready")
+        assert status == 503 and not payload["ready"]
+        assert "staleness" in payload["reason"]
+        # fence-blocked (failover in progress): not ready either
+        replica.staleness_ms = lambda *a, **k: 0.0
+        replica.serve_ready = lambda: False
+        status, payload = api.handle("GET", "/healthz/ready")
+        assert status == 503 and "fence" in payload["reason"]
+    finally:
+        replica.close()
+        writer.close()

@@ -91,7 +91,8 @@ def _log_run(rc: int, args: list) -> None:
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
         a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
-              "--shard-parity", "--capacity-parity", "--read-parity")
+              "--shard-parity", "--capacity-parity", "--read-parity",
+              "--scenarios")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -112,8 +113,10 @@ def main() -> int:
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
     flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
-             "--shard-parity", "--capacity-parity", "--read-parity"}
+             "--shard-parity", "--capacity-parity", "--read-parity",
+             "--scenarios"}
     args = [a for a in sys.argv[1:] if a not in flags]
+    with_scenarios = "--scenarios" in sys.argv[1:]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
@@ -180,6 +183,25 @@ def main() -> int:
         print("gate:", " ".join(cpar), flush=True)
         rc = subprocess.call(cpar, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--capacity-parity")
+    if rc == 0 and with_scenarios:
+        # the trace-driven scenario sweep (make scenarios): six weathers
+        # + the migrated fault/overload matrix cases through ONE engine,
+        # deterministic (same seed ⇒ same scorecard), a sabotage
+        # self-test proving violations are caught, and the scorecard
+        # diffed against the last green run — a regression in graceful
+        # degradation fails this gate like a perf regression
+        sab = [sys.executable,
+               os.path.join(root, "tools", "scenario_engine.py"),
+               "--sabotage"]
+        print("gate:", " ".join(sab), flush=True)
+        rc = subprocess.call(sab, env={**env, "JAX_PLATFORMS": "cpu"})
+        if rc == 0:
+            sc = [sys.executable,
+                  os.path.join(root, "tools", "scenario_engine.py"),
+                  "--check-determinism", "--diff", "--write-green"]
+            print("gate:", " ".join(sc), flush=True)
+            rc = subprocess.call(sc, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--scenarios")
     if rc == 0 and with_read_parity:
         # follower reads ≡ primary at lag 0, bounded-stale answers are a
         # prefix of primary history, fenced frames never served, the

@@ -23,13 +23,18 @@ brownout invariants:
 ``tests/test_overload.py`` parametrizes over the same CASES registry;
 ``make overload-matrix`` / ``tools/gate.py --overload-matrix`` run it
 standalone across seeds.
+
+The event-storm and slow-store cases are MIGRATED (ISSUE 12): they
+execute as scenario specs through the trace-driven engine
+(evergreen_tpu/scenarios/matrix.py) with their original assertions
+intact; this module delegates for those names. The task-churn and
+API-scrape storms stay bespoke — they exercise real worker threads and
+a live HTTP request loop the virtual-clock engine deliberately avoids.
 """
 from __future__ import annotations
 
 import os
-import shutil
 import sys
-import tempfile
 import time as _time
 from typing import Callable, Dict, List
 
@@ -37,7 +42,6 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-from evergreen_tpu.events.senders import insert_outbox_row
 from evergreen_tpu.queue.jobs import (
     PRIORITY_AGENT,
     PRIORITY_PLANNING,
@@ -48,10 +52,9 @@ from evergreen_tpu.queue.jobs import (
 from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
 from evergreen_tpu.settings import OverloadConfig
 from evergreen_tpu.storage.store import Store
-from evergreen_tpu.utils import faults, overload
+from evergreen_tpu.utils import overload
 from evergreen_tpu.utils import log as log_mod
 from evergreen_tpu.utils.benchgen import NOW
-from evergreen_tpu.utils.faults import Fault, FaultPlan
 
 from tools.fault_matrix import _capture_logs, _seed_store
 
@@ -201,83 +204,23 @@ def case_task_churn_storm(seed: int = 0) -> dict:
     }
 
 
-def case_event_storm(seed: int = 0) -> dict:
-    """A notification fan-out storm: the outbox coalesces duplicates at
-    YELLOW, holds its cap with counted drops at the top, and the ladder
-    steps back to GREEN once the backlog drains."""
-    store = Store()
-    OverloadConfig(
-        outbox_cap=40,
-        outbox_depth_levels=[10.0, 20.0, 40.0],
-        hysteresis_ticks=2,
-        eval_interval_s=0.0,
-    ).set(store)
-    monitor = overload.monitor_for(store)
-    before = _counters()
-    got, stop = _capture_logs()
-    collection = "slack_outbox"
-    inserted = 0
-    try:
-        # phase A: distinct notifications until the cap bites
-        for i in range(100):
-            if insert_outbox_row(
-                store,
-                collection,
-                {
-                    "channel_type": "slack",
-                    "slack_channel": "#ops",
-                    "text": f"storm-{seed}-{i}\nbody",
-                },
-            ):
-                inserted += 1
-        # phase B: repeats of an early (still undelivered) notification
-        # — these must coalesce, not insert or drop
-        for _ in range(50):
-            if insert_outbox_row(
-                store,
-                collection,
-                {
-                    "channel_type": "slack",
-                    "slack_channel": "#ops",
-                    "text": f"storm-{seed}-2\nbody",
-                },
-            ):
-                inserted += 1
-        peaked = monitor.level() >= overload.RED
-        undelivered = store.collection(collection).count(
-            lambda d: not d.get("delivered") and not d.get("failed")
-        )
-        coalesced = _delta(before, "overload.outbox_coalesced")
-        dropped = _delta(before, "overload.outbox_dropped")
-        # storm over: the drain delivers everything
-        coll = store.collection(collection)
-        for doc in coll.find(lambda d: not d.get("delivered")):
-            coll.update(doc["_id"], {"delivered": True})
-        monitor.note_outbox_drained(collection, undelivered)
-        evals_to_green = _drain_to_green(monitor)
-    finally:
-        stop()
-    return {
-        "ok": (
-            undelivered <= 40
-            and peaked
-            and dropped > 0
-            and coalesced > 0
-            # every one of the 150 sends is accounted for exactly once
-            and inserted + coalesced + dropped == 150
-            and _sheds_balance(
-                store, before, "outbox", "overload.outbox_dropped"
-            )
-            and evals_to_green <= RECOVERY_EVALS
-            and any(r.get("message") == "outbox-row-dropped" for r in got)
-        ),
-        "undelivered": undelivered,
-        "inserted": inserted,
-        "coalesced": coalesced,
-        "dropped": dropped,
-        "evals_to_green": evals_to_green,
-        "logs": got,
-    }
+def _engine_case(name: str):
+    """MIGRATED (ISSUE 12): the case runs as a scenario spec through the
+    trace-driven engine (evergreen_tpu/scenarios/matrix.py) with its
+    original assertions intact; this module only delegates."""
+
+    def run(seed: int = 0) -> dict:
+        from evergreen_tpu.scenarios import run_matrix_case
+
+        return run_matrix_case("overload", name, seed)
+
+    run.__name__ = f"case_{name.replace('-', '_')}"
+    return run
+
+
+#: notification fan-out storm: coalesce at YELLOW, counted drops at the
+#: cap, exactly-once send accounting, GREEN after the drain
+case_event_storm = _engine_case("event-storm")
 
 
 def case_api_storm(seed: int = 0) -> dict:
@@ -355,72 +298,10 @@ def case_api_storm(seed: int = 0) -> dict:
     }
 
 
-def case_slow_store_storm(seed: int = 0) -> dict:
-    """A store whose WAL writes crawl (hang injected at the wal.commit
-    seam): the commit-latency EWMA drives the ladder to RED, ticks brown
-    out their optional work but keep planning, and the level recovers
-    once the store heals."""
-    from evergreen_tpu.storage.durable import DurableStore
-
-    tmp = tempfile.mkdtemp(prefix=f"overload-slow-{seed}-")
-    store = DurableStore(tmp)
-    try:
-        _seed_store(store, seed=seed + 59)
-        OverloadConfig(
-            store_latency_ms_levels=[3.0, 8.0, 100000.0],
-            hysteresis_ticks=2,
-            eval_interval_s=0.0,
-        ).set(store)
-        monitor = overload.monitor_for(store)
-        got, stop = _capture_logs()
-        faults.install(
-            FaultPlan().always("wal.commit", Fault("hang", delay_s=0.03))
-        )
-        storm_results: List = []
-        try:
-            for t in range(4):
-                storm_results.append(
-                    run_tick(store, OPTS, now=NOW + 15.0 * t)
-                )
-        finally:
-            faults.uninstall()
-        browned = [
-            r for r in storm_results
-            if r.overload in ("red", "black") and "stats" in r.shed
-        ]
-        # store healed: ticks run clean again and the ladder steps down
-        # (the EWMA decays ~0.6x per healthy tick, so a loaded machine
-        # whose storm EWMA overshot needs a few extra ticks)
-        recovery_results: List = []
-        for t in range(4, 4 + 14):
-            recovery_results.append(
-                run_tick(store, OPTS, now=NOW + 15.0 * t)
-            )
-            if recovery_results[-1].overload == "green":
-                break
-        stop()
-        return {
-            "ok": (
-                all(sum(r.queues.values()) > 0 for r in storm_results)
-                and all(
-                    sum(r.queues.values()) > 0 for r in recovery_results
-                )
-                and len(browned) > 0
-                and recovery_results[-1].overload == "green"
-                and not recovery_results[-1].shed
-                and any(
-                    r.get("message") == "degraded-tick"
-                    and r.get("reason") == "overload"
-                    for r in got
-                )
-            ),
-            "storm_overload": [r.overload for r in storm_results],
-            "recovery_overload": [r.overload for r in recovery_results],
-            "logs": got,
-        }
-    finally:
-        store.close()
-        shutil.rmtree(tmp, ignore_errors=True)
+#: crawling WAL (hang at wal.commit): the commit-latency EWMA drives
+#: RED, ticks brown out optional work but keep planning, and the level
+#: recovers once the store heals
+case_slow_store_storm = _engine_case("slow-store-storm")
 
 
 CASES: Dict[str, Callable[[int], dict]] = {

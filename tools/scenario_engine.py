@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Trace-driven scenario engine CLI: replay every weather, emit the
+scorecard, diff it against the last green run.
+
+    python tools/scenario_engine.py                 # full suite
+    python tools/scenario_engine.py --scenario seasonality
+    python tools/scenario_engine.py --check-determinism
+    python tools/scenario_engine.py --diff          # vs SCORECARD_GREEN
+    python tools/scenario_engine.py --write-green   # refresh baseline
+    python tools/scenario_engine.py --sabotage      # self-test: expect RED
+
+The suite lives in evergreen_tpu/scenarios/ (library.py — six weathers —
+plus the migrated fault/overload matrix cases). Each run writes
+``SCORECARD.json`` (per-scenario pass/fail, invariant verdicts, SLO
+margins, degradation-level dwell times, shed/retry/fallback counters).
+
+``--diff`` compares against ``SCORECARD_GREEN.json`` (the tracked
+last-green baseline) and fails on *graceful-degradation regressions*,
+not on any change:
+
+  * a scenario, invariant, check, or SLO that was green going red
+  * an SLO margin collapsing below half its green headroom (and under
+    0.25 absolute)
+  * RED+BLACK dwell growing beyond 1.5x + 2 ticks of the baseline
+  * total sheds growing beyond 2x + 10 of the baseline
+  * a previously-scored scenario disappearing
+
+``tools/gate.py --scenarios`` runs this with --check-determinism and
+--diff, and refreshes SCORECARD_GREEN.json after a green run — so a
+regression in how the system degrades fails CI the same way a perf
+regression does.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+SCORECARD_PATH = os.path.join(_REPO_ROOT, "SCORECARD.json")
+GREEN_PATH = os.path.join(_REPO_ROOT, "SCORECARD_GREEN.json")
+
+#: diff tolerances (see module docstring) — deliberate constants, not
+#: knobs: loosening them is a reviewed change
+MARGIN_COLLAPSE_RATIO = 0.5
+MARGIN_FLOOR = 0.25
+DWELL_RATIO, DWELL_SLACK = 1.5, 2
+SHED_RATIO, SHED_SLACK = 2.0, 10
+
+
+def _force_cpu() -> None:
+    from evergreen_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(n_devices=1)
+
+
+def run_suite(
+    names: Optional[List[str]] = None,
+    include_matrix: bool = True,
+    check_determinism: bool = False,
+) -> Dict:
+    """Run the scenario suite; returns the scorecard document."""
+    from evergreen_tpu.scenarios import (
+        FAULT_SCENARIO_CASES,
+        OVERLOAD_SCENARIO_CASES,
+        SCENARIOS,
+        run_matrix_case,
+        run_scenario,
+    )
+
+    entries: Dict[str, dict] = {}
+    for name, factory in SCENARIOS.items():
+        if names and name not in names:
+            continue
+        entry = run_scenario(factory())
+        if check_determinism and entry["deterministic"]:
+            replay = run_scenario(factory())
+            if replay["fingerprint"] != entry["fingerprint"]:
+                entry["ok"] = False
+                entry.setdefault("invariants", {})["same_seed_same_scorecard"] = {
+                    "ok": False,
+                    "detail": (
+                        f"replay fingerprint {replay['fingerprint']} != "
+                        f"{entry['fingerprint']}"
+                    ),
+                }
+            else:
+                entry.setdefault("invariants", {})["same_seed_same_scorecard"] = {
+                    "ok": True, "detail": "",
+                }
+        entries[name] = entry
+        print(json.dumps({
+            "scenario": name, "ok": entry["ok"],
+            "dwell": entry["dwell_ticks"],
+            "wall_ms": entry["timing"]["wall_ms"],
+        }))
+    if include_matrix and not names:
+        for name in sorted(FAULT_SCENARIO_CASES):
+            out = run_matrix_case("fault", name, 0)
+            entries[out["entry"]["name"]] = out["entry"]
+            print(json.dumps(
+                {"scenario": out["entry"]["name"], "ok": out["ok"]}
+            ))
+        for name in sorted(OVERLOAD_SCENARIO_CASES):
+            out = run_matrix_case("overload", name, 0)
+            entries[out["entry"]["name"]] = out["entry"]
+            print(json.dumps(
+                {"scenario": out["entry"]["name"], "ok": out["ok"]}
+            ))
+    return {
+        "schema": 1,
+        # an empty run is NOT green: all() over nothing would pass a
+        # suite that never executed
+        "ok": bool(entries) and all(e["ok"] for e in entries.values()),
+        "scenarios": entries,
+    }
+
+
+def _dwell_hot(entry: dict) -> int:
+    dwell = entry.get("dwell_ticks", {})
+    return int(dwell.get("red", 0)) + int(dwell.get("black", 0))
+
+
+def _sheds(entry: dict) -> int:
+    return int(entry.get("stats", {}).get("sheds_total", 0))
+
+
+def diff_scorecards(new: Dict, green: Dict) -> List[str]:
+    """Regressions of NEW relative to GREEN (empty = clean)."""
+    regressions: List[str] = []
+    green_scen = green.get("scenarios", {})
+    new_scen = new.get("scenarios", {})
+    for name, g in green_scen.items():
+        n = new_scen.get(name)
+        if n is None:
+            regressions.append(f"{name}: scenario disappeared")
+            continue
+        if g.get("ok") and not n.get("ok"):
+            regressions.append(f"{name}: was green, now red")
+        for section in ("invariants", "checks"):
+            for key, gv in g.get(section, {}).items():
+                nv = n.get(section, {}).get(key)
+                if gv.get("ok") and nv is not None and not nv.get("ok"):
+                    regressions.append(
+                        f"{name}: {section[:-1]} {key} regressed "
+                        f"({nv.get('detail', '')})"
+                    )
+        for key, gv in g.get("slos", {}).items():
+            nv = n.get("slos", {}).get(key)
+            if nv is None:
+                continue
+            if gv.get("ok") and not nv.get("ok"):
+                regressions.append(f"{name}: SLO {key} regressed")
+                continue
+            gm, nm = gv.get("margin", 0.0), nv.get("margin", 0.0)
+            if (
+                gm > 0
+                and nm < gm * MARGIN_COLLAPSE_RATIO
+                and nm < MARGIN_FLOOR
+            ):
+                regressions.append(
+                    f"{name}: SLO {key} margin collapsed "
+                    f"{gm:.3f} -> {nm:.3f}"
+                )
+        g_hot, n_hot = _dwell_hot(g), _dwell_hot(n)
+        if n_hot > g_hot * DWELL_RATIO + DWELL_SLACK:
+            regressions.append(
+                f"{name}: RED+BLACK dwell grew {g_hot} -> {n_hot} ticks"
+            )
+        g_shed, n_shed = _sheds(g), _sheds(n)
+        if n_shed > g_shed * SHED_RATIO + SHED_SLACK:
+            regressions.append(
+                f"{name}: sheds grew {g_shed} -> {n_shed}"
+            )
+    return regressions
+
+
+def run_sabotage() -> int:
+    """Self-test: the deliberately-broken specs must score RED — proving
+    an invariant violation fails the gate rather than sliding through."""
+    from evergreen_tpu.scenarios import SABOTAGE_SCENARIOS, run_scenario
+
+    rc = 0
+    for name, factory in SABOTAGE_SCENARIOS.items():
+        entry = run_scenario(factory())
+        caught = not entry["ok"]
+        print(json.dumps({"sabotage": name, "caught": caught}))
+        if not caught:
+            print(
+                f"sabotage case {name} was NOT caught — the invariant "
+                "layer is broken", file=sys.stderr,
+            )
+            rc = 1
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", default="",
+                   help="run one scenario only (skips the matrix cases)")
+    p.add_argument("--check-determinism", action="store_true",
+                   help="replay each deterministic scenario and require "
+                        "an identical scorecard fingerprint")
+    p.add_argument("--diff", action="store_true",
+                   help="fail on regressions vs SCORECARD_GREEN.json")
+    p.add_argument("--write-green", action="store_true",
+                   help="refresh SCORECARD_GREEN.json from this run "
+                        "(only when the run itself is green)")
+    p.add_argument("--no-matrix", action="store_true",
+                   help="skip the migrated fault/overload matrix cases")
+    p.add_argument("--sabotage", action="store_true",
+                   help="run the deliberately-red self-test specs and "
+                        "require they are caught")
+    p.add_argument("--scorecard", default=SCORECARD_PATH)
+    args = p.parse_args(argv)
+
+    _force_cpu()
+    if args.sabotage:
+        return run_sabotage()
+
+    names = [args.scenario] if args.scenario else None
+    if names:
+        from evergreen_tpu.scenarios import SCENARIOS
+
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            # a typo must never read as "scenario passed" (or worse,
+            # --write-green an empty baseline that defuses every diff)
+            print(
+                f"unknown scenario(s) {unknown}; known: "
+                f"{sorted(SCENARIOS)}", file=sys.stderr,
+            )
+            return 2
+    scorecard = run_suite(
+        names=names,
+        include_matrix=not args.no_matrix,
+        check_determinism=args.check_determinism,
+    )
+    with open(args.scorecard, "w") as f:
+        json.dump(scorecard, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    rc = 0 if scorecard["ok"] else 1
+    if rc:
+        failed = [
+            n for n, e in scorecard["scenarios"].items() if not e["ok"]
+        ]
+        print(f"scenarios RED: {failed}", file=sys.stderr)
+    if args.diff and os.path.exists(GREEN_PATH):
+        with open(GREEN_PATH) as f:
+            green = json.load(f)
+        regressions = diff_scorecards(scorecard, green)
+        for r in regressions:
+            print(f"scorecard regression: {r}", file=sys.stderr)
+        if regressions:
+            rc = rc or 2
+    if args.write_green and rc == 0:
+        with open(GREEN_PATH, "w") as f:
+            json.dump(scorecard, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
+        print(f"refreshed {os.path.basename(GREEN_PATH)}")
+    print(json.dumps({"scenarios_ok": rc == 0}))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
