@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint scenarios
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint scenarios fleet-runtime
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -94,6 +94,16 @@ read-parity:
 scenarios:
 	env JAX_PLATFORMS=cpu python tools/scenario_engine.py --sabotage
 	env JAX_PLATFORMS=cpu python tools/scenario_engine.py --check-determinism --diff
+
+# supervised-fleet smoke (gate-blocking via tools/gate.py
+# --fleet-runtime): 2 shard worker processes under the production
+# supervisor (runtime/), one induced SIGKILL-at-a-WAL-seam + one
+# induced hang — fenced takeover at a strictly higher lease epoch,
+# zero duplicate dispatch, exactly-one-owner, resume == rerun — plus a
+# sample of the crash-matrix points migrated to the engine's
+# child-process backend (the full 13 run under `make crash-matrix`)
+fleet-runtime:
+	env JAX_PLATFORMS=cpu python tools/fleet_runtime.py
 
 # N-process sharded-plane churn throughput vs the single-shard plane
 bench-sharded-plane:

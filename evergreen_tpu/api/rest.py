@@ -1197,6 +1197,7 @@ class RestApi:
             r"/rest/v2/admin/capacity/(?P<distro>[^/]+)",
             self.get_capacity,
         )
+        r("GET", r"/rest/v2/admin/fleet", self.get_fleet)
         r("GET", r"/rest/v2/status", self.status)
         # login surface (reference service/ui.go login routes + gimlet
         # user-manager handlers); manager-agnostic
@@ -2218,6 +2219,23 @@ class RestApi:
                 f"no capacity decision for distro {match['distro']!r}",
             )
         return 200, doc
+
+    def get_fleet(self, method, match, body):
+        """Process-per-shard fleet runtime state (runtime/supervisor.py
+        fleet_state): per-worker state / lease epoch history / round
+        timing / restart counts plus fleet totals. 404 when this
+        service runs the classic in-process plane (no ``--shards N``
+        supervisor attached)."""
+        from ..runtime.supervisor import peek_fleet_supervisor
+
+        sup = peek_fleet_supervisor(self.store)
+        if sup is None:
+            raise ApiError(
+                404, "no fleet supervisor attached (start the service "
+                "with --shards N --data-dir to run the process-per-"
+                "shard runtime)"
+            )
+        return 200, sup.fleet_state()
 
     def get_admin(self, method, match, body):
         out = {}

@@ -14,13 +14,144 @@ import time as _time
 from typing import List, Optional
 
 
+def _install_graceful_signals(server, on_drain=None) -> None:
+    """SIGTERM/SIGINT → graceful drain: stop accepting requests (the
+    serve loop returns, so the caller's ``finally`` runs the full
+    teardown — crons stopped, async WAL flusher drained, lease
+    released). Before this, only KeyboardInterrupt was handled: a
+    SIGTERM'd writer died mid-flight and left its lease to time out."""
+    import signal
+    import threading
+
+    fired = {"done": False}
+
+    def handler(signum, frame):
+        if fired["done"]:
+            return
+        fired["done"] = True
+        print(
+            f"received signal {signum} — draining before exit ...",
+            file=sys.stderr, flush=True,
+        )
+        if on_drain is not None:
+            try:
+                on_drain()
+            except Exception as exc:  # noqa: BLE001 — drain is
+                # best-effort; the teardown path still runs
+                print(f"drain failed: {exc!r}", file=sys.stderr)
+        # shutdown() must not run on the serve_forever thread
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, handler)
+        except (ValueError, OSError):  # non-main thread / exotic host
+            pass
+
+
+def _cmd_service_fleet(args) -> int:
+    """Process-per-shard service: a supervisor in THIS process spawns
+    one shard worker process per shard over the shared data dir
+    (runtime/supervisor.py), drives fleet rounds on the tick cadence,
+    restarts crashed/hung workers behind the lease fence, and serves
+    the admin/metrics surface (GET /rest/v2/admin/fleet) from the
+    parent."""
+    from .api.rest import RestApi
+    from .runtime.supervisor import (
+        FleetSupervisor,
+        attach_fleet_supervisor,
+    )
+    from .settings import ShardingConfig
+    from .storage.store import Store
+    from .utils.retry import RetryPolicy
+
+    if not args.data_dir:
+        print("--shards N requires --data-dir", file=sys.stderr)
+        return 2
+    front = Store()
+    # the sharding.* knobs live in the durable config like every other
+    # section: read them off shard 0's segment BEFORE any worker spawns
+    # (no lease — the workers own the leases). Inspection-open only:
+    # close the journal HANDLE, never store.close(), whose checkpoint +
+    # fresh-inode WAL rotation would clobber a still-live holder's
+    # segment if a previous fleet's worker 0 survived a supervisor
+    # crash (the crash-matrix inspection idiom). A fresh or unreadable
+    # data dir falls back to the section defaults.
+    sharding = ShardingConfig.get(front)
+    try:
+        from .storage.durable import DurableStore
+
+        cfg_store = DurableStore(args.data_dir, shard_id=0)
+        try:
+            sharding = ShardingConfig.get(cfg_store)
+        finally:
+            cfg_store._journal.close()
+    except Exception as exc:  # noqa: BLE001 — defaults are a fine boot
+        print(f"sharding config read fell back to defaults: {exc!r}",
+              file=sys.stderr)
+    sup = FleetSupervisor(
+        args.data_dir,
+        args.shards,
+        ttl_s=sharding.worker_lease_ttl_s,
+        hb_interval_s=sharding.worker_heartbeat_s,
+        hb_deadline_s=sharding.worker_heartbeat_deadline_s,
+        restart_policy=RetryPolicy(
+            attempts=1_000_000,
+            base_backoff_s=sharding.worker_restart_backoff_s,
+            max_backoff_s=sharding.worker_restart_backoff_max_s,
+        ),
+        rebalance_enabled=sharding.rebalance_enabled,
+        max_handoffs_per_pass=sharding.max_handoffs_per_round,
+    )
+    print(
+        f"spawning {args.shards} shard workers over {args.data_dir} ..."
+    )
+    sup.start()
+    state = sup.fleet_state()
+    ready = sum(
+        1 for w in state["workers"].values() if w["state"] == "ready"
+    )
+    print(f"fleet up: {ready}/{args.shards} workers ready")
+    sup.run_background()
+    api = RestApi(
+        front,
+        require_auth=args.require_auth,
+        rate_limit_per_min=args.rate_limit,
+    )
+    attach_fleet_supervisor(front, sup)
+    server = api.serve(args.host, args.port)
+    _install_graceful_signals(server)
+    print(
+        f"evergreen-tpu fleet service on {args.host}:{args.port} "
+        f"({args.shards} shard worker processes; "
+        f"GET /rest/v2/admin/fleet for state)"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print("draining fleet (flush WAL groups, release shard "
+              "leases, reap workers) ...", file=sys.stderr)
+        sup.stop(graceful=True)
+    return 0
+
+
 def cmd_service(args) -> int:
     """Run the app server: REST API + background job plane
     (reference operations/service.go `service web`). ALL subsystem
     wiring happens in one place — Environment.build (env.py), the
-    reference's NewEnvironment composition root."""
+    reference's NewEnvironment composition root. ``--shards N``
+    switches to the process-per-shard fleet runtime instead
+    (supervisor + N shard worker processes; runtime/)."""
     from .env import Environment
 
+    if getattr(args, "shards", 0) and args.shards >= 1:
+        # any explicit --shards (including 1) runs the supervised
+        # process-per-shard runtime — a 1-shard fleet is a valid shape
+        # (one restartable worker) and silently falling back to the
+        # classic in-process service would ignore every worker_* knob
+        return _cmd_service_fleet(args)
     if getattr(args, "replica_of", "") and not args.data_dir:
         print("--replica-of requires --data-dir", file=sys.stderr)
         return 2
@@ -41,6 +172,7 @@ def cmd_service(args) -> int:
         # _maybe_forward). No lease, no job plane — background work
         # belongs to the writer.
         server = api.serve(args.host, args.port)
+        _install_graceful_signals(server)
         print(
             f"evergreen-tpu replica on {args.host}:{args.port} "
             f"(reads local, writes forward to {args.replica_of})"
@@ -91,6 +223,12 @@ def cmd_service(args) -> int:
 
     tune_gc_for_long_lived_heap()
     server = api.serve(args.host, args.port)
+    # graceful SIGTERM/SIGINT: serve_forever returns and the finally
+    # below runs env.close() — crons stop populating, the async WAL
+    # flusher drains its last group, the store checkpoints, and the
+    # writer lease is RELEASED (a standby takes over immediately
+    # instead of waiting out the TTL)
+    _install_graceful_signals(server)
     print(f"evergreen-tpu service listening on {args.host}:{args.port}")
     try:
         server.serve_forever()
@@ -650,6 +788,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run as a replica tailing --data-dir's WAL: "
                         "reads serve locally, writes forward to this "
                         "primary URL (503 with a hint if unreachable)")
+    s.add_argument("--shards", type=int, default=0,
+                   help="run the process-per-shard fleet runtime: a "
+                        "supervisor in this process + N shard worker "
+                        "processes over --data-dir (each with its own "
+                        "lease + WAL segment); crashed/hung workers "
+                        "restart behind the lease fence")
     s.set_defaults(fn=cmd_service)
 
     a = sub.add_parser("agent", help="run a worker agent")

@@ -1,0 +1,39 @@
+"""Process-per-shard fleet runtime.
+
+The sharded control plane (scheduler/sharded_plane.py) multiplies the
+tick across N shards *in one process*. This package is the deployment
+shape: a **supervisor** process (runtime/supervisor.py) that spawns one
+**shard worker** process per shard (runtime/worker.py) over one shared
+data dir — each worker owning its per-shard lease, fenced WAL segment
+and resident plane exactly like an in-process shard store — and speaks
+a newline-JSON control protocol (runtime/protocol.py) on the worker's
+stdio: hello / round / heartbeat / load / release / prime / done /
+drain / shutdown.
+
+Crash-restart is lease-fenced: a worker that dies (or hangs past its
+heartbeat deadline and is killed) is respawned with exponential
+backoff; the replacement steals the shard lease at a strictly higher
+fencing epoch, so anything the dead worker still had in flight is
+rejected at the WAL fence (storage/lease.py / storage/durable.py) —
+the restart can never double-write, and dispatch stays exactly-once.
+
+``python -m evergreen_tpu service --shards N --data-dir D`` runs the
+supervisor + REST/admin surface in the parent (cli.py);
+``GET /rest/v2/admin/fleet`` and the ``scheduler_fleet_*`` instruments
+expose the runtime; scenarios/procs.py replays scenario specs against
+a supervised fleet with ``proc_kill`` / ``proc_hang`` events.
+"""
+from .protocol import parse_line, send_msg
+from .supervisor import (
+    FleetSupervisor,
+    attach_fleet_supervisor,
+    peek_fleet_supervisor,
+)
+
+__all__ = [
+    "FleetSupervisor",
+    "attach_fleet_supervisor",
+    "parse_line",
+    "peek_fleet_supervisor",
+    "send_msg",
+]

@@ -1,0 +1,84 @@
+"""Newline-JSON control protocol between supervisor and shard workers.
+
+One JSON object per line, each carrying an ``op`` field. The channel is
+the worker's stdio — stdin carries supervisor→worker commands, stdout
+carries worker→supervisor replies and unsolicited messages (heartbeats,
+``fenced``, ``bye``). Structured logging writes to stderr
+(utils/log.py json_line_sink), so the protocol stream stays parseable;
+anything that still lands on stdout without being a protocol message
+(a stray library print, a torn line from a killed writer) is skipped by
+``parse_line`` and counted by the reader — a garbage line must never
+wedge the fleet.
+
+Worker → supervisor ops:
+
+  ``hello``      after lease acquisition + WAL replay + recovery:
+                 shard, pid, lease epoch, recovery summary
+  ``heartbeat``  liveness beat on ``--hb-interval`` (supervisor kills +
+                 restarts a worker that misses its deadline)
+  ``round``      one tick's result: duration, task/distro counts,
+                 degraded reason, overload level, epoch
+  ``agent_done`` harness agent step finished: dispatched / unfinished
+  ``load``       per-affinity-group schedulable counts + round ms
+                 (rebalancing input)
+  ``handoffs``   the shard's non-done durable handoff records
+  ``released`` / ``primed`` / ``done`` — fenced-handoff protocol legs
+  ``drained``    WAL flushed, populating stopped
+  ``fenced``     the worker observed a superseded lease epoch and is
+                 standing down (exit 75 follows)
+  ``ready`` / ``report`` — bench mode (tools/bench_sharded_plane.py)
+  ``bye``        clean shutdown acknowledgement
+
+Supervisor → worker ops: ``tick``, ``agent_sim``, ``load``,
+``handoffs``, ``release``, ``prime``, ``done``, ``status``, ``drain``,
+``shutdown``, plus bench ``go`` and the scenario backend's
+``arm_fault`` (install a PR-1 fault-plan entry at a named seam — the
+``proc_kill``/``proc_hang`` events' delivery vehicle).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import IO, Optional
+
+#: worker exit codes the supervisor interprets (the crash harness's
+#: vocabulary: 86 = fault-plan crash kind, 70 = lease lost, 75 = fenced)
+EXIT_CRASHED = 86
+EXIT_LOST = 70
+EXIT_FENCED = 75
+
+
+def send_msg(fp: IO[str], lock: Optional[threading.Lock] = None,
+             **msg) -> bool:
+    """Write one protocol message (one line, flushed). Returns False —
+    instead of raising — when the peer is gone (closed pipe): senders
+    treat a dead peer as a state to observe, not an error to unwind."""
+    line = json.dumps(msg, separators=(",", ":"), default=str) + "\n"
+    try:
+        if lock is not None:
+            with lock:
+                fp.write(line)
+                fp.flush()
+        else:
+            fp.write(line)
+            fp.flush()
+    except (BrokenPipeError, ValueError, OSError):
+        return False
+    return True
+
+
+def parse_line(line: str) -> Optional[dict]:
+    """One received line → message dict, or None for anything that is
+    not a protocol message: torn lines (no trailing newline is the
+    caller's concern; here: malformed JSON), non-object payloads, and
+    objects without an ``op``. Never raises."""
+    line = line.strip()
+    if not line or not line.startswith("{"):
+        return None
+    try:
+        msg = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    if not isinstance(msg, dict) or not isinstance(msg.get("op"), str):
+        return None
+    return msg

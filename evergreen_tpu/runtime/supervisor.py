@@ -1,0 +1,793 @@
+"""Fleet supervisor: spawn, watch, restart, and drive N shard workers.
+
+One ``FleetSupervisor`` owns the process-per-shard deployment of the
+sharded control plane (scheduler/sharded_plane.py): it spawns one
+``runtime/worker.py`` process per shard over one shared data dir,
+consumes their heartbeats, and drives fleet rounds (one ``tick``
+command per worker per round) plus ladder-driven rebalancing via the
+fenced-handoff control messages (``release`` → ``prime`` → ``done``).
+
+**Crash-restart with fenced takeover.** A worker that exits — or hangs
+past its heartbeat deadline (PR-1 ``Deadline`` vocabulary) and is
+SIGKILLed — is respawned with exponential backoff (PR-1
+``RetryPolicy.backoff_s``). The replacement steals the shard's lease
+at a strictly higher fencing epoch (storage/lease.py claim-by-rename),
+so anything the dead/hung worker still had buffered is rejected at the
+WAL fence (storage/durable.py ``EpochFencedError``): the supervisor
+never needs to know *what* the worker was doing when it died — the
+epoch fence makes the restart safe, the startup recovery pass + the
+supervisor's handoff reconciliation make it convergent.
+
+**Degradation rows** (ARCHITECTURE.md "Fleet runtime"): a crashed
+worker's shard misses rounds until the restart lands (bounded by
+backoff + lease TTL); a crashed supervisor leaves workers running —
+they exit on stdin EOF, release their leases, and a new supervisor
+reopens the fleet cold; a heartbeat partition (worker alive but pipe
+wedged) is indistinguishable from a hang and resolves the same way —
+kill, restart, fence.
+"""
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import threading
+import time as _time
+from queue import Empty, Queue
+from typing import Dict, List, Optional
+
+from ..utils import metrics as _metrics
+from ..utils.log import get_logger
+from ..utils.retry import Deadline, RetryPolicy
+from .protocol import EXIT_CRASHED, parse_line, send_msg
+
+FLEET_RESTARTS = _metrics.counter(
+    "scheduler_fleet_restarts_total",
+    "Shard worker processes respawned by the supervisor after an exit "
+    "or a missed-heartbeat kill, labeled by shard.",
+    labels=("shard",),
+)
+FLEET_HB_MISSES = _metrics.counter(
+    "scheduler_fleet_heartbeat_misses_total",
+    "Workers SIGKILLed for missing their heartbeat deadline (hang or "
+    "pipe partition), labeled by shard.",
+    labels=("shard",),
+)
+FLEET_ROUNDS = _metrics.counter(
+    "scheduler_fleet_rounds_total",
+    "Supervised fleet rounds by outcome: 'full' (every shard replied), "
+    "'partial' (a shard was down or timed out), 'empty' (no worker was "
+    "ready).",
+    labels=("outcome",),
+)
+FLEET_HANDOFFS = _metrics.counter(
+    "scheduler_fleet_handoffs_total",
+    "Cross-process fenced-handoff protocol steps driven over worker "
+    "control messages, by source shard and step outcome.",
+    labels=("shard", "outcome"),
+)
+FLEET_ROUND_MS = _metrics.histogram(
+    "scheduler_fleet_round_duration_ms",
+    "Wall time of one supervised fleet round (slowest worker gates).",
+)
+FLEET_WORKERS_UP = _metrics.gauge(
+    "scheduler_fleet_workers_up",
+    "1 while the shard's worker process is ready (hello received, "
+    "heartbeats current), else 0.",
+    labels=("shard",),
+)
+
+_LEVELS = {"green": 0, "yellow": 1, "red": 2, "black": 3}
+
+
+class WorkerHandle:
+    """One shard's process + protocol state. The reader thread drains
+    stdout: heartbeats refresh the deadline in place, everything else
+    lands on the reply queue for whoever is mid-request."""
+
+    def __init__(self, shard: int, hb_deadline_s: float) -> None:
+        self.shard = shard
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = "new"  # new|starting|ready|backoff|stopping|stopped
+        #: bumped per spawn: a request outstanding against generation g
+        #: must stop waiting when the watchdog respawns the worker (the
+        #: replacement never saw the request — without this, a killed
+        #: worker's round would block its full timeout)
+        self.generation = 0
+        self._req_counter = 0
+        self.replies: Queue = Queue()
+        self.send_lock = threading.Lock()
+        self.hb_deadline_s = hb_deadline_s
+        self.hb_deadline = Deadline.after(None)
+        self.epochs: List[int] = []
+        self.exits: List[int] = []
+        self.restarts = 0
+        self.consecutive_failures = 0
+        #: monotonic time of the last hello — the failure streak only
+        #: resets after a SUSTAINED healthy period, not on hello itself
+        #: (a worker that boots fine but crashes on its first tick
+        #: would otherwise respawn at constant base backoff forever)
+        self.ready_since = 0.0
+        self.next_spawn_at = 0.0
+        self.backoffs: List[float] = []
+        self.level = "green"
+        self.last_round: Dict = {}
+        self.garbage_lines = 0
+        self.fenced_reason = ""
+        self.pid = 0
+
+    @property
+    def epoch(self) -> int:
+        return self.epochs[-1] if self.epochs else 0
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def send(self, **msg) -> bool:
+        if not self.alive():
+            return False
+        return send_msg(self.proc.stdin, self.send_lock, **msg)
+
+    def next_req(self) -> int:
+        self._req_counter += 1
+        return self._req_counter
+
+    def wait_reply(self, op: str, timeout_s: float,
+                   req: Optional[int] = None) -> Optional[dict]:
+        """Next reply matching ``op`` (and the echoed request id when
+        given — a timed-out request's late answer must not satisfy the
+        next one). Stale/unsolicited ops are dropped; ``fenced`` /
+        ``error`` end the wait; so do a worker death or a respawn (the
+        replacement never saw the request)."""
+        gen = self.generation
+        deadline = Deadline.after(timeout_s)
+        while not deadline.exceeded():
+            try:
+                msg = self.replies.get(
+                    timeout=max(0.05, min(0.25, deadline.remaining()))
+                )
+            except Empty:
+                if not self.alive() or self.generation != gen:
+                    return None
+                continue
+            if msg["op"] == op and (
+                req is None or msg.get("req") == req
+            ):
+                return msg
+            if msg["op"] in ("fenced", "error") and (
+                req is None
+                or msg.get("req") is None  # unsolicited (dying worker)
+                or msg.get("req") == req
+            ):
+                return None
+            # a stale reply — or a stale ERROR from an earlier
+            # timed-out request — must not end an unrelated wait
+        return None
+
+
+class FleetSupervisor:
+    def __init__(
+        self,
+        data_dir: str,
+        n_shards: int,
+        ttl_s: float = 5.0,
+        hb_interval_s: float = 1.0,
+        hb_deadline_s: Optional[float] = None,
+        boot_deadline_s: Optional[float] = None,
+        tick_s: float = 15.0,
+        round_timeout_s: float = 60.0,
+        harness: bool = False,
+        recovery_anchor: Optional[float] = None,
+        restart_policy: Optional[RetryPolicy] = None,
+        rebalance_enabled: bool = False,
+        max_handoffs_per_pass: int = 1,
+        worker_env: Optional[dict] = None,
+        spawn_crash: Optional[Dict[int, str]] = None,
+        spawn_hang: Optional[Dict[int, str]] = None,
+        front_store=None,
+        worker_stderr: str = "inherit",
+    ) -> None:
+        self.data_dir = data_dir
+        self.n_shards = n_shards
+        self.ttl_s = ttl_s
+        self.hb_interval_s = hb_interval_s
+        self.hb_deadline_s = (
+            hb_deadline_s if hb_deadline_s is not None
+            else max(4.0 * hb_interval_s, 2.0)
+        )
+        #: a worker wedged BEFORE its first hello (stalled lease
+        #: acquire, hung WAL replay/recovery) has no heartbeats to
+        #: miss — this bounds the whole boot; generous because a
+        #: replacement legitimately waits out the dead holder's lease
+        #: TTL and a large segment replay
+        self.boot_deadline_s = (
+            boot_deadline_s if boot_deadline_s is not None
+            else max(180.0, ttl_s * 12.0)
+        )
+        self.tick_s = tick_s
+        self.round_timeout_s = round_timeout_s
+        self.harness = harness
+        self.recovery_anchor = recovery_anchor
+        #: PR-1 vocabulary: backoff_s(consecutive_failures) paces the
+        #: respawns so a crash-looping shard cannot hot-spin the box
+        self.restart_policy = restart_policy or RetryPolicy(
+            attempts=1_000_000, base_backoff_s=0.25,
+            max_backoff_s=30.0, jitter=0.25,
+        )
+        self.rebalance_enabled = rebalance_enabled
+        self.max_handoffs_per_pass = max_handoffs_per_pass
+        self.worker_env = worker_env or {}
+        #: first-spawn-only fault args (scenario kill points): a
+        #: RESTARTED worker must come back clean, or a crash at
+        #: recovery.pass would loop forever
+        self.spawn_crash = dict(spawn_crash or {})
+        self.spawn_hang = dict(spawn_hang or {})
+        self.front_store = front_store
+        #: "inherit" — workers' stderr (structured logs, tracebacks)
+        #: flows to the parent's stderr; "devnull" — silenced (test
+        #: harnesses whose induced crashes would spam the output)
+        self.worker_stderr = worker_stderr
+        self.handles: Dict[int, WorkerHandle] = {
+            k: WorkerHandle(k, self.hb_deadline_s)
+            for k in range(n_shards)
+        }
+        self.rounds_done = 0
+        self.reconciled: List[str] = []
+        self.migrations: List[dict] = []
+        self._seq = 0
+        self._round_lock = threading.Lock()
+        self._needs_reconcile = False
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._driver: Optional[threading.Thread] = None
+        self._rng = random.Random(1337)
+        self._repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        self._log = get_logger("scheduler")
+
+    # -- spawning --------------------------------------------------------- #
+
+    def _worker_cmd(self, shard: int, first: bool) -> List[str]:
+        cmd = [
+            sys.executable, "-m", "evergreen_tpu.runtime.worker",
+            "--data-dir", self.data_dir,
+            "--shard", str(shard),
+            "--shards", str(self.n_shards),
+            "--ttl", str(self.ttl_s),
+            "--hb-interval", str(self.hb_interval_s),
+            # a replacement steals the dead holder's lease after TTL;
+            # give the acquire poll ample room past it
+            "--lease-timeout", str(max(60.0, self.ttl_s * 10.0)),
+        ]
+        if self.harness:
+            cmd.append("--harness")
+        if self.recovery_anchor is not None:
+            cmd += ["--recovery-now",
+                    str(self.recovery_anchor
+                        + self.rounds_done * self.tick_s)]
+        if first and shard in self.spawn_crash:
+            cmd += ["--crash", self.spawn_crash[shard]]
+        if first and shard in self.spawn_hang:
+            cmd += ["--hang", self.spawn_hang[shard]]
+        return cmd
+
+    def _worker_environ(self) -> dict:
+        env = {**os.environ, "EVG_FAULTS": "", **self.worker_env}
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def spawn(self, shard: int, first: bool = False) -> None:
+        h = self.handles[shard]
+        h.state = "starting"
+        h.generation += 1
+        h.fenced_reason = ""
+        # the boot itself is deadlined: a worker that wedges before
+        # its first hello never heartbeats, so the hang check below
+        # must have SOMETHING to trip on
+        h.hb_deadline = Deadline.after(self.boot_deadline_s)
+        h.proc = subprocess.Popen(
+            self._worker_cmd(shard, first),
+            cwd=self._repo_root, env=self._worker_environ(),
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=(
+                subprocess.DEVNULL
+                if self.worker_stderr == "devnull" else None
+            ),
+            text=True, encoding="utf-8",
+        )
+        h.pid = h.proc.pid
+        threading.Thread(
+            target=self._reader, args=(h, h.proc),
+            daemon=True, name=f"fleet-read-{shard}",
+        ).start()
+
+    def _reader(self, h: WorkerHandle, proc: subprocess.Popen) -> None:
+        for line in proc.stdout:
+            msg = parse_line(line)
+            if msg is None:
+                h.garbage_lines += 1
+                continue
+            op = msg["op"]
+            if op == "heartbeat":
+                h.hb_deadline = Deadline.after(h.hb_deadline_s)
+                continue
+            if op == "hello":
+                h.epochs.append(int(msg.get("epoch", 0)))
+                h.hb_deadline = Deadline.after(h.hb_deadline_s)
+                h.state = "ready"
+                h.ready_since = _time.monotonic()
+                FLEET_WORKERS_UP.set(1, shard=h.shard)
+                self._log.info(
+                    "fleet-worker-ready", shard=h.shard,
+                    epoch=h.epoch, pid=msg.get("pid"),
+                )
+                continue
+            if op == "fenced":
+                h.fenced_reason = str(msg.get("reason", ""))
+            h.replies.put(msg)
+
+    def start(self, monitor: bool = True,
+              ready_timeout_s: float = 120.0) -> None:
+        """Spawn every worker, wait for the fleet to report ready, then
+        reconcile any mid-flight handoffs the previous incarnation left
+        behind. ``monitor=True`` starts the background watchdog."""
+        for k in range(self.n_shards):
+            self.spawn(k, first=True)
+        self.wait_all_ready(timeout_s=ready_timeout_s)
+        self.reconcile_handoffs()
+        if monitor:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="fleet-monitor",
+            )
+            self._monitor.start()
+
+    def wait_all_ready(self, timeout_s: float = 120.0) -> bool:
+        """True when every non-crashed worker reached ready. Workers
+        armed with a spawn-time crash may legitimately die before
+        hello (a recovery.pass kill point) — the monitor restarts
+        them; this wait only needs SOMETHING to converge on."""
+        deadline = Deadline.after(timeout_s)
+        while not deadline.exceeded():
+            pending = [
+                h for h in self.handles.values()
+                if h.state != "ready" and h.alive()
+            ]
+            if not pending and all(
+                h.state == "ready" or not h.alive()
+                for h in self.handles.values()
+            ):
+                return all(
+                    h.state == "ready" for h in self.handles.values()
+                )
+            _time.sleep(0.05)
+        return False
+
+    # -- watchdog --------------------------------------------------------- #
+
+    def _monitor_loop(self) -> None:
+        poll_s = max(0.05, min(self.hb_interval_s / 2.0, 0.5))
+        while not self._stop.wait(poll_s):
+            self.monitor_once()
+
+    def monitor_once(self) -> None:
+        """One watchdog pass: reap exits, kill hangs, respawn due
+        workers (exposed for deterministic tests)."""
+        for h in self.handles.values():
+            if h.state in ("stopping", "stopped"):
+                continue
+            rc = h.proc.poll() if h.proc is not None else None
+            if h.state == "backoff":
+                if _time.monotonic() >= h.next_spawn_at:
+                    h.restarts += 1
+                    FLEET_RESTARTS.inc(shard=h.shard)
+                    self._needs_reconcile = True
+                    self.spawn(h.shard, first=False)
+                continue
+            if rc is not None:
+                self._schedule_restart(h, rc)
+                continue
+            if (
+                h.state in ("ready", "starting")
+                and h.hb_deadline.exceeded()
+            ):
+                # hang / heartbeat partition — or a boot wedged before
+                # the first hello: kill, then the exit path above
+                # schedules the fenced restart
+                FLEET_HB_MISSES.inc(shard=h.shard)
+                self._log.error(
+                    "fleet-worker-hang", shard=h.shard,
+                    state=h.state, deadline_s=h.hb_deadline_s,
+                )
+                try:
+                    h.proc.kill()
+                except OSError:
+                    pass
+
+    #: a worker that stayed ready this long before dying is treated as
+    #: having recovered — its NEXT restart starts the backoff ladder
+    #: over instead of continuing a stale streak
+    BACKOFF_RESET_AFTER_S = 60.0
+
+    def _schedule_restart(self, h: WorkerHandle, rc: int) -> None:
+        h.exits.append(rc)
+        h.state = "backoff"
+        FLEET_WORKERS_UP.set(0, shard=h.shard)
+        if (
+            h.ready_since
+            and _time.monotonic() - h.ready_since
+            > self.BACKOFF_RESET_AFTER_S
+        ):
+            h.consecutive_failures = 0
+        h.ready_since = 0.0
+        backoff = self.restart_policy.backoff_s(
+            h.consecutive_failures, self._rng
+        )
+        h.consecutive_failures += 1
+        h.backoffs.append(backoff)
+        h.next_spawn_at = _time.monotonic() + backoff
+        self._log.error(
+            "fleet-worker-exited", shard=h.shard, rc=rc,
+            crashed=rc == EXIT_CRASHED, backoff_s=round(backoff, 3),
+            restarts=h.restarts,
+        )
+
+    def wait_worker_ready(self, shard: int,
+                          timeout_s: float = 120.0) -> bool:
+        deadline = Deadline.after(timeout_s)
+        h = self.handles[shard]
+        while not deadline.exceeded():
+            if h.state == "ready":
+                return True
+            _time.sleep(0.05)
+        return False
+
+    # -- rounds ----------------------------------------------------------- #
+
+    def round(self, now: Optional[float] = None) -> Dict[int, dict]:
+        """One fleet round: ``tick`` to every ready worker, collect the
+        ``round`` replies. Shards that are down or time out are simply
+        absent from the result — the fleet degrades to the survivors
+        and the watchdog brings the rest back."""
+        from ..utils.tracing import Tracer
+
+        now = _time.time() if now is None else now
+        with self._round_lock:
+            if self._needs_reconcile:
+                self._needs_reconcile = False
+                self.reconcile_handoffs()
+            t0 = _time.perf_counter()
+            tracer = Tracer(self.front_store, "scheduler")
+            with tracer.span("fleet.round", n_shards=self.n_shards):
+                ready = [
+                    h for h in self.handles.values()
+                    if h.state == "ready"
+                ]
+                reqs = {}
+                for h in ready:
+                    reqs[h.shard] = h.next_req()
+                    h.send(op="tick", now=now, req=reqs[h.shard])
+                results: Dict[int, dict] = {}
+                for h in ready:
+                    reply = h.wait_reply(
+                        "round", self.round_timeout_s,
+                        req=reqs[h.shard],
+                    )
+                    if reply is None or reply.get("skipped"):
+                        continue
+                    results[h.shard] = reply
+                    h.last_round = reply
+                    h.level = str(reply.get("level", "green"))
+            self.rounds_done += 1
+            outcome = (
+                "full" if len(results) == self.n_shards
+                else ("partial" if results else "empty")
+            )
+            FLEET_ROUNDS.inc(outcome=outcome)
+            FLEET_ROUND_MS.observe((_time.perf_counter() - t0) * 1e3)
+            if self.rebalance_enabled and results:
+                try:
+                    self.rebalance()
+                except Exception as exc:  # noqa: BLE001 — rebalancing
+                    # is an optimization; a failed pass reconciles
+                    self._needs_reconcile = True
+                    self._log.error(
+                        "fleet-rebalance-failed", error=repr(exc)[-200:]
+                    )
+            return results
+
+    def broadcast(self, op: str, reply_op: str,
+                  timeout_s: float = 30.0, **fields) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        ready = [h for h in self.handles.values() if h.state == "ready"]
+        reqs = {}
+        for h in ready:
+            reqs[h.shard] = h.next_req()
+            h.send(op=op, req=reqs[h.shard], **fields)
+        for h in ready:
+            reply = h.wait_reply(reply_op, timeout_s,
+                                 req=reqs[h.shard])
+            if reply is not None:
+                out[h.shard] = reply
+        return out
+
+    def agent_sim(self, now: Optional[float] = None) -> Dict[int, dict]:
+        return self.broadcast(
+            "agent_sim", "agent_done",
+            timeout_s=self.round_timeout_s,
+            now=_time.time() if now is None else now,
+        )
+
+    def statuses(self) -> Dict[int, dict]:
+        return self.broadcast("status", "status")
+
+    # -- handoffs / rebalancing ------------------------------------------- #
+
+    def migrate(self, distro_id: str, src: int, dst: int,
+                now: Optional[float] = None) -> Optional[dict]:
+        """One fenced handoff across process boundaries: release on the
+        source worker, prime on the target, done-mark on the source —
+        each leg one control message, each leg one fenced WAL group
+        inside the worker. A crash at any leg leaves durable state the
+        next reconciliation converges (exactly-one-owner)."""
+        if src == dst:
+            raise ValueError(f"{distro_id} already on shard {dst}")
+        hs, hd = self.handles[src], self.handles[dst]
+        if hs.state != "ready" or hd.state != "ready":
+            return None
+        self._seq += 1
+        req = hs.next_req()
+        hs.send(op="release", distro=distro_id, target=dst,
+                seq=self._seq, now=now or _time.time(), req=req)
+        released = hs.wait_reply(
+            "released", self.round_timeout_s, req=req
+        )
+        if released is None:
+            self._needs_reconcile = True
+            FLEET_HANDOFFS.inc(shard=src, outcome="aborted")
+            return None
+        FLEET_HANDOFFS.inc(shard=src, outcome="released")
+        rec = released["record"]
+        req = hd.next_req()
+        hd.send(op="prime", record=rec, req=req)
+        if hd.wait_reply("primed", self.round_timeout_s,
+                         req=req) is None:
+            self._needs_reconcile = True
+            FLEET_HANDOFFS.inc(shard=src, outcome="aborted")
+            return None
+        FLEET_HANDOFFS.inc(shard=src, outcome="primed")
+        req = hs.next_req()
+        hs.send(op="done", handoff=rec["_id"], req=req)
+        if hs.wait_reply("done", self.round_timeout_s,
+                         req=req) is None:
+            self._needs_reconcile = True
+            return None
+        FLEET_HANDOFFS.inc(shard=src, outcome="done")
+        out = {k: v for k, v in rec.items() if k != "payload"}
+        self.migrations.append(out)
+        self._log.info(
+            "fleet-distro-handoff", handoff=rec["_id"],
+            distros=rec["group"], src=src, dst=dst,
+        )
+        return out
+
+    def rebalance(self) -> List[dict]:
+        """Ladder-driven pass over the greedy policy shared with the
+        in-process plane (scheduler/sharded_plane.py
+        greedy_rebalance_plan): hot workers' loads queried over the
+        protocol, at most ``max_handoffs_per_pass`` migrations."""
+        from ..scheduler.sharded_plane import greedy_rebalance_plan
+
+        levels = {
+            k: _LEVELS.get(h.level, 0) for k, h in self.handles.items()
+            if h.state == "ready"
+        }
+        hot = [k for k, lvl in levels.items() if lvl >= 1]
+        if not hot:
+            return []
+        # query group loads from the HOT workers only; cold targets
+        # rank by the round results already in hand
+        loads: Dict[int, dict] = {}
+        reps: Dict[int, dict] = {}
+        round_ms: Dict[int, float] = {}
+        reqs = {}
+        for k in hot:
+            h = self.handles[k]
+            reqs[k] = h.next_req()
+            h.send(op="load", req=reqs[k])
+        for k in hot:
+            reply = self.handles[k].wait_reply(
+                "load", self.round_timeout_s, req=reqs[k]
+            )
+            if reply is None:
+                continue
+            loads[k] = dict(reply.get("groups", {}))
+            reps[k] = dict(reply.get("reps", {}))
+            round_ms[k] = float(reply.get("round_ms", 0.0) or 0.0)
+        cold_weight = {
+            k: float(h.last_round.get("n_tasks", 0))
+            for k, h in self.handles.items() if h.state == "ready"
+        }
+        plan = greedy_rebalance_plan(
+            levels, loads, round_ms, self.max_handoffs_per_pass,
+            cold_weight=cold_weight,
+        )
+        done = []
+        for src, dst, rep in plan:
+            distro = reps.get(src, {}).get(rep, rep)
+            rec = self.migrate(distro, src, dst)
+            if rec is not None:
+                done.append(rec)
+        return done
+
+    def reconcile_handoffs(self) -> List[str]:
+        """Converge mid-flight handoffs across the fleet (the
+        cross-process ``ShardedScheduler.reconcile_handoffs``): every
+        released-but-not-done record re-primes its target and completes
+        the done-mark — both legs idempotent. Also recovers the
+        monotone handoff sequence counter. A pass that could not see
+        or heal everything (a worker still restarting, a leg timing
+        out) re-arms ``_needs_reconcile`` so the NEXT round retries —
+        an orphaned released group must never wait for an unrelated
+        restart to re-trigger convergence."""
+        healed: List[str] = []
+        # a not-ready worker's records are invisible to this pass AND
+        # unprimable as a target: the pass is only conclusive when the
+        # whole fleet answered
+        deferred = any(
+            h.state not in ("ready", "stopping", "stopped")
+            for h in self.handles.values()
+        )
+        records = self.broadcast("handoffs", "handoffs")
+        for src, msg in records.items():
+            # the worker-reported high-water covers done + watermark
+            # records too: a restarted supervisor must never mint a
+            # colliding handoff id/seq (ownership is latest-seq-wins)
+            self._seq = max(self._seq, int(msg.get("max_seq", 0)))
+            for rec in msg.get("records", ()):
+                self._seq = max(self._seq, int(rec.get("seq", 0)))
+                if rec.get("state") != "released":
+                    continue
+                dst = int(rec.get("to", -1))
+                hd = self.handles.get(dst)
+                hs = self.handles[src]
+                if hd is None or hd.state != "ready":
+                    deferred = True
+                    continue
+                req = hd.next_req()
+                hd.send(op="prime", record=rec, req=req)
+                if hd.wait_reply("primed", self.round_timeout_s,
+                                 req=req) is None:
+                    deferred = True
+                    continue
+                req = hs.next_req()
+                hs.send(op="done", handoff=rec["_id"], req=req)
+                if hs.wait_reply("done", self.round_timeout_s,
+                                 req=req) is None:
+                    deferred = True
+                    continue
+                FLEET_HANDOFFS.inc(shard=src, outcome="reconciled")
+                healed.append(rec["_id"])
+        if deferred:
+            self._needs_reconcile = True
+        if healed:
+            self.reconciled.extend(healed)
+            self._log.info("fleet-handoffs-reconciled", healed=healed)
+        return healed
+
+    # -- service cadence --------------------------------------------------- #
+
+    def run_background(self) -> None:
+        """Service mode: drive rounds on the tick cadence until stop()
+        (the process-per-shard analog of the 15s cron tick)."""
+        def loop():
+            while not self._stop.wait(self.tick_s):
+                try:
+                    self.round()
+                except Exception as exc:  # noqa: BLE001 — a failed
+                    # round must not kill the driver; the next cadence
+                    # beat retries against whatever workers survive
+                    self._log.error(
+                        "fleet-round-failed", error=repr(exc)[-300:]
+                    )
+
+        self._driver = threading.Thread(
+            target=loop, daemon=True, name="fleet-driver"
+        )
+        self._driver.start()
+
+    # -- shutdown ---------------------------------------------------------- #
+
+    def drain(self, timeout_s: float = 30.0) -> Dict[int, dict]:
+        """Graceful first phase: every worker stops populating and
+        flushes its async WAL group (the SIGTERM path's 'stop taking
+        work' step)."""
+        return self.broadcast("drain", "drained", timeout_s=timeout_s)
+
+    def stop(self, graceful: bool = True,
+             timeout_s: float = 30.0) -> None:
+        """Stop the fleet: drain + shutdown (workers checkpoint,
+        release their shard leases, exit 0), then reap; anything still
+        alive past the timeout is killed — its successor will steal the
+        lease, so even the ungraceful path stays fenced."""
+        self._stop.set()
+        for h in self.handles.values():
+            h.state = "stopping"
+        if graceful:
+            per = max(2.0, timeout_s / 2.0)
+            self.handles_shutdown(per)
+        deadline = Deadline.after(timeout_s)
+        for h in self.handles.values():
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(timeout=max(0.1, deadline.remaining()))
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            FLEET_WORKERS_UP.set(0, shard=h.shard)
+            h.state = "stopped"
+
+    def handles_shutdown(self, timeout_s: float) -> None:
+        for h in self.handles.values():
+            if h.alive():
+                h.send(op="drain")
+        for h in self.handles.values():
+            if h.alive():
+                h.wait_reply("drained", timeout_s)
+        for h in self.handles.values():
+            if h.alive():
+                h.send(op="shutdown")
+
+    # -- introspection ------------------------------------------------------ #
+
+    def fleet_state(self) -> dict:
+        """The admin surface (GET /rest/v2/admin/fleet): per-worker
+        level / epoch / round timing / restart counts + fleet totals."""
+        workers = {}
+        for k, h in self.handles.items():
+            workers[str(k)] = {
+                "state": h.state,
+                "pid": h.pid,
+                "epoch": h.epoch,
+                "epochs": list(h.epochs),
+                "restarts": h.restarts,
+                "exits": list(h.exits),
+                "level": h.level,
+                "last_round_ms": h.last_round.get("ms", 0.0),
+                "last_round_tasks": h.last_round.get("n_tasks", 0),
+                "heartbeat_overdue": (
+                    h.state == "ready" and h.hb_deadline.exceeded()
+                ),
+                "garbage_lines": h.garbage_lines,
+            }
+        return {
+            "n_shards": self.n_shards,
+            "data_dir": self.data_dir,
+            "rounds": self.rounds_done,
+            "workers": workers,
+            "migrations": len(self.migrations),
+            "reconciled_handoffs": len(self.reconciled),
+            "restarts_total": sum(
+                h.restarts for h in self.handles.values()
+            ),
+        }
+
+
+# -- per-store attachment (api/rest.py admin surface) ----------------------- #
+
+
+def attach_fleet_supervisor(store, sup: FleetSupervisor) -> None:
+    """Register ``sup`` as the fleet behind ``store``'s API surface
+    (GET /rest/v2/admin/fleet reads it via ``peek_fleet_supervisor``)."""
+    store._fleet_supervisor = sup
+    sup.front_store = store
+
+
+def peek_fleet_supervisor(store) -> Optional[FleetSupervisor]:
+    return getattr(store, "_fleet_supervisor", None)

@@ -1,0 +1,642 @@
+"""Shard worker: one scheduler shard in its own process.
+
+``python -m evergreen_tpu.runtime.worker --data-dir D --shard K
+--shards N`` opens shard K's durability domain inside the shared data
+dir — its OWN writer lease (``storage/lease.py shard_lease_path``,
+fencing epochs and all), its OWN fenced WAL segment + snapshot
+(``wal.shardK.log``; storage/durable.py ``shard_id``), its own
+TickCache / PersisterState / resident plane (per-store singletons) —
+runs the startup recovery pass, and then takes commands from the
+supervisor over stdin, one newline-JSON message per line
+(runtime/protocol.py):
+
+  * ``tick`` runs ONE unchanged ``run_tick`` over the shard's subset
+    and replies a ``round`` message with timing/degradation/level;
+  * the fenced-handoff legs (``release`` / ``prime`` / ``done``) move a
+    distro's whole affinity group across the process boundary with the
+    PR-7 protocol — record+deletions in one fenced WAL group on the
+    source, payload+primed record in one fenced group on the target —
+    so a crash at any leg converges to exactly-one-owner when the
+    supervisor reconciles;
+  * ``drain`` flushes the async WAL flusher and stops populating;
+    ``shutdown`` additionally checkpoints, releases the lease and
+    exits 0.
+
+A heartbeat thread beats on stdout every ``--hb-interval``; the
+supervisor treats a missed deadline as a hang and SIGKILLs + restarts.
+Any observation of a superseded lease epoch (a fenced commit, a lost
+renewal) makes the worker print ``fenced`` and exit 75/70 — the PR-3
+stand-down, now a process exit the supervisor turns into a fenced
+restart at a strictly higher epoch.
+
+``--bench`` mode is the promoted tools/bench_sharded_plane.py inline
+worker: an in-memory store seeded with the shard's slice of the
+benchmark problem, warmup, then churned+timed ticks between a
+``ready`` message and a ``go`` command — the bench now spawns THIS
+production entrypoint instead of a private copy.
+
+``--crash seam@idx`` / ``--hang seam:delay_s`` install a PR-1 fault
+plan at spawn (the scenario backend's deterministic kill points;
+scenarios/procs.py), and the ``arm_fault`` op installs entries live
+mid-run (``proc_kill`` / ``proc_hang`` events landing at a virtual
+tick).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time as _time
+from typing import List, Optional
+
+from .protocol import EXIT_FENCED, EXIT_LOST, parse_line, send_msg
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="evergreen-tpu shard worker")
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--shard", type=int, default=0)
+    p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--ttl", type=float, default=10.0,
+                   help="shard lease TTL (restart takeover latency)")
+    p.add_argument("--lease-timeout", type=float, default=60.0,
+                   help="how long to poll for the shard lease at boot")
+    p.add_argument("--hb-interval", type=float, default=1.0)
+    p.add_argument("--harness", action="store_true",
+                   help="deterministic harness options: no intent "
+                        "hosts, no cache, sync persist (the crash/"
+                        "scenario workload shape)")
+    p.add_argument("--recovery-now", type=float, default=0.0,
+                   help="virtual clock for the startup recovery pass "
+                        "(harness determinism; 0 = wall clock)")
+    p.add_argument("--crash", default="",
+                   help="seam@index fault-plan crash kill point")
+    p.add_argument("--hang", default="",
+                   help="seam:delay_s always-hang fault")
+    # bench mode (tools/bench_sharded_plane.py)
+    p.add_argument("--bench", action="store_true")
+    p.add_argument("--bench-distros", type=int, default=200)
+    p.add_argument("--bench-tasks", type=int, default=50_000)
+    p.add_argument("--bench-ticks", type=int, default=5)
+    p.add_argument("--bench-seed", type=int, default=3)
+    p.add_argument("--bench-warmup", type=int, default=2)
+    return p
+
+
+def _install_spawn_faults(args) -> None:
+    from ..utils import faults
+
+    plan = faults.FaultPlan()
+    armed = False
+    if args.crash:
+        seam, _, idx = args.crash.partition("@")
+        plan.at(seam.strip(), int(idx or 0), faults.Fault("crash"))
+        armed = True
+    if args.hang:
+        seam, _, delay = args.hang.partition(":")
+        plan.always(
+            seam.strip(), faults.Fault("hang", delay_s=float(delay or 1.0))
+        )
+        armed = True
+    if armed:
+        faults.install(plan)
+
+
+def _live_fault_plan():
+    """The installed plan, installing an empty one on demand — the
+    ``arm_fault`` op must work whether or not spawn-time faults armed."""
+    from ..utils import faults
+
+    plan = faults.active()
+    if plan is None:
+        plan = faults.install(faults.FaultPlan())
+    return plan
+
+
+# --------------------------------------------------------------------------- #
+# the durable shard worker
+# --------------------------------------------------------------------------- #
+
+
+class ShardWorker:
+    def __init__(self, args, proto_out) -> None:
+        self.args = args
+        self.out = proto_out
+        self.out_lock = threading.Lock()
+        self.shard = args.shard
+        self.n_shards = args.shards
+        self.tick_index = 0
+        self.last_round_ms = 0.0
+        self.draining = False
+        self._hb_stop = threading.Event()
+        self.store = None
+        self.lease = None
+        #: request id of the command currently being handled — echoed
+        #: on every reply so the supervisor can pair answers with
+        #: requests across timeouts and respawns
+        self._req = None
+
+    # -- lifecycle -------------------------------------------------------- #
+
+    def send(self, **msg) -> bool:
+        if self._req is not None and "req" not in msg:
+            msg["req"] = self._req
+        return send_msg(self.out, self.out_lock, **msg)
+
+    def open(self) -> None:
+        from ..scheduler.recovery import run_recovery_pass
+        from ..storage.durable import DurableStore
+        from ..storage.lease import FileLease, shard_lease_path
+
+        lease = FileLease(
+            shard_lease_path(self.args.data_dir, self.shard),
+            ttl_s=self.args.ttl,
+        )
+        if not lease.acquire(
+            timeout_s=self.args.lease_timeout, poll_s=0.1
+        ):
+            self.send(op="error", detail="lease-timeout",
+                      shard=self.shard)
+            os._exit(3)
+        self.lease = lease
+        # renewing starts BEFORE replay: a long boot must not get its
+        # lease stolen mid-recovery (env.py does the same for the
+        # classic writer). A lost lease is a process exit — the
+        # supervisor restarts us and the successor steals at a higher
+        # epoch; staying alive would risk split-brain.
+        lease.start_renewing(on_lost=self._deposed)
+        self.store = DurableStore(
+            self.args.data_dir, lease=lease, shard_id=self.shard
+        )
+        report = run_recovery_pass(
+            self.store, now=self.args.recovery_now or None
+        )
+        self.send(
+            op="hello", shard=self.shard, pid=os.getpid(),
+            epoch=lease.epoch,
+            recovered={
+                "released_claims": len(report.released_claims),
+                "stranded_reset": len(report.stranded_reset),
+                "stale_frames_dropped": report.stale_frames_dropped,
+            },
+        )
+
+    def _deposed(self) -> None:  # renewer thread
+        self.send(op="fenced", shard=self.shard, reason="lease-lost")
+        os._exit(EXIT_LOST)
+
+    def _fenced_exit(self, reason: str) -> None:
+        self.send(op="fenced", shard=self.shard, reason=reason)
+        os._exit(EXIT_FENCED)
+
+    def start_heartbeat(self) -> None:
+        def beat():
+            while not self._hb_stop.wait(self.args.hb_interval):
+                if not self.send(
+                    op="heartbeat", shard=self.shard, ts=_time.time()
+                ):
+                    return  # supervisor gone; the stdin EOF path exits
+
+        threading.Thread(
+            target=beat, daemon=True, name=f"shard{self.shard}-hb"
+        ).start()
+
+    def tick_options(self):
+        from ..scheduler.wrapper import TickOptions
+
+        if self.args.harness:
+            return TickOptions(
+                create_intent_hosts=False,
+                underwater_unschedule=False,
+                use_cache=False,
+            )
+        # service mode: the same options units/crons.py passes a
+        # sharded round (solve deadline, tick budget, async persist)
+        return TickOptions(
+            create_intent_hosts=True,
+            use_cache=True,
+            solve_deadline_s=10.0,
+            tick_budget_s=12.0,
+            async_persist=True,
+        )
+
+    # -- ops -------------------------------------------------------------- #
+
+    def op_tick(self, msg: dict) -> None:
+        from ..scheduler.wrapper import run_tick
+
+        if self.draining:
+            self.send(op="round", shard=self.shard, skipped="draining",
+                      tick=self.tick_index)
+            return
+        now = float(msg.get("now") or _time.time())
+        t0 = _time.perf_counter()
+        res = run_tick(self.store, self.tick_options(), now=now)
+        ms = (_time.perf_counter() - t0) * 1e3
+        self.last_round_ms = ms
+        if res.degraded == "fenced" or self.lease.lost:
+            self._fenced_exit("fenced-tick")
+        self.send(
+            op="round", shard=self.shard, tick=self.tick_index,
+            ms=round(ms, 3), n_tasks=res.n_tasks,
+            n_distros=res.n_distros, degraded=res.degraded,
+            level=res.overload, epoch=self.lease.epoch,
+            queued=sum(res.queues.values()),
+        )
+        self.tick_index += 1
+
+    def op_agent_sim(self, msg: dict) -> None:
+        """Deterministic harness agent: finish everything in flight,
+        then dispatch every free host from this shard's queues — the
+        real CAS pair, including its crash seam (the scenario backend's
+        no-duplicate-dispatch surface)."""
+        from ..dispatch.assign import assign_next_available_task
+        from ..dispatch.dag_dispatcher import DispatcherService
+        from ..globals import TaskStatus
+        from ..models import host as host_mod
+        from ..models import task as task_mod
+        from ..models.lifecycle import mark_end, mark_task_started
+
+        now = float(msg.get("now") or _time.time())
+        c = task_mod.coll(self.store)
+        in_flight = sorted(
+            d["_id"] for d in c.find(
+                lambda d: d["status"] in (
+                    TaskStatus.DISPATCHED.value, TaskStatus.STARTED.value,
+                )
+            )
+        )
+        for tid in in_flight:
+            mark_task_started(self.store, tid, now=now)
+            mark_end(self.store, tid, TaskStatus.SUCCEEDED.value, now=now)
+        svc = DispatcherService(self.store)  # fresh: no TTL staleness
+        dispatched = 0
+        hosts = sorted(
+            (h for h in host_mod.find(self.store)
+             if h.can_run_tasks() and not h.running_task),
+            key=lambda h: h.id,
+        )
+        for h in hosts:
+            if assign_next_available_task(
+                self.store, svc, h, now=now
+            ) is not None:
+                dispatched += 1
+        unfinished = c.count(
+            lambda d: d["status"] not in (
+                TaskStatus.SUCCEEDED.value, TaskStatus.FAILED.value,
+            )
+        )
+        self.send(op="agent_done", shard=self.shard,
+                  dispatched=dispatched, unfinished=unfinished)
+
+    def op_status(self, msg: dict) -> None:
+        from ..globals import TaskStatus
+
+        unfinished = self.store.collection("tasks").count(
+            lambda d: d["status"] not in (
+                TaskStatus.SUCCEEDED.value, TaskStatus.FAILED.value,
+            )
+        )
+        self.send(op="status", shard=self.shard, unfinished=unfinished,
+                  tick=self.tick_index, epoch=self.lease.epoch)
+
+    def _topology(self):
+        from ..parallel.topology import ShardTopology
+
+        topo = ShardTopology(self.n_shards)
+        topo.affinity = ShardTopology.affinity_from_store(self.store)
+        return topo
+
+    def op_load(self, msg: dict) -> None:
+        """Rebalancing input: schedulable-task count per affinity group
+        on THIS shard (finished docs linger; moving them moves payload,
+        not load) plus the last round's wall time."""
+        from ..globals import TaskStatus
+
+        topo = self._topology()
+        counts: dict = {}
+        for doc in self.store.collection("tasks").find(
+            lambda d: d.get("status") == TaskStatus.UNDISPATCHED.value
+            and d.get("activated")
+        ):
+            did = doc.get("distro_id", "")
+            if did:
+                counts[did] = counts.get(did, 0) + 1
+        groups: dict = {}
+        reps: dict = {}
+        for doc in self.store.collection("distros").find():
+            did = doc["_id"]
+            rep = topo.placement_key(did)
+            groups[rep] = groups.get(rep, 0) + counts.get(did, 0)
+            reps.setdefault(rep, did)
+        self.send(op="load", shard=self.shard, groups=groups, reps=reps,
+                  round_ms=round(self.last_round_ms, 3))
+
+    def op_handoffs(self, msg: dict) -> None:
+        from ..scheduler.sharded_plane import (
+            HANDOFFS_COLLECTION,
+            HANDOFF_WATERMARK_ID,
+        )
+
+        records = []
+        max_seq = 0
+        for d in self.store.collection(HANDOFFS_COLLECTION).find():
+            # the seq high-water counts EVERY record — done triples and
+            # the compaction watermark included — or a restarted
+            # supervisor would mint colliding handoff ids/seqs and the
+            # latest-seq-wins ownership loaders would pin stale owners
+            max_seq = max(max_seq, int(d.get("seq", 0) or 0))
+            if (
+                d.get("state") not in ("done", "watermark")
+                and d.get("_id") != HANDOFF_WATERMARK_ID
+            ):
+                records.append(dict(d))
+        self.send(op="handoffs", shard=self.shard, records=records,
+                  max_seq=max_seq)
+
+    def op_release(self, msg: dict) -> None:
+        """Handoff leg 1 on the source shard — the SAME record shape
+        and fenced-group idiom as the in-process driver (one source of
+        truth: sharded_plane.handoff_payload/handoff_record/
+        apply_release)."""
+        from ..scheduler.sharded_plane import (
+            apply_release,
+            handoff_payload,
+            handoff_record,
+        )
+        from ..storage.lease import EpochFencedError
+
+        distro_id = msg["distro"]
+        target = int(msg["target"])
+        seq = int(msg.get("seq", 1))
+        now = float(msg.get("now") or _time.time())
+        topo = self._topology()
+        rep = topo.placement_key(distro_id)
+        group = sorted(
+            doc["_id"]
+            for doc in self.store.collection("distros").find()
+            if topo.placement_key(doc["_id"]) == rep
+        )
+        if not group:
+            self.send(op="error", detail=f"distro {distro_id!r} not "
+                      f"on shard {self.shard}")
+            return
+        payload = handoff_payload(self.store, group)
+        rec = handoff_record(
+            distro_id, group, self.shard, target, seq, now, payload
+        )
+        try:
+            apply_release(self.store, rec)
+        except EpochFencedError:
+            self._fenced_exit("fenced-release")
+        except Exception as exc:  # noqa: BLE001 — converge durable
+            # state to the in-memory truth, then let the supervisor's
+            # reconciliation finish the handoff (sharded_plane.migrate
+            # heals the same way)
+            try:
+                self.store.heal_durability()
+            except Exception:  # noqa: BLE001 — best effort
+                pass
+            self.send(op="error", detail=f"release failed: {exc!r}")
+            return
+        self.send(op="released", shard=self.shard, record=rec)
+
+    def op_prime(self, msg: dict) -> None:
+        """Handoff leg 2 on the target shard: payload + 'primed' record
+        in one fenced group (sharded_plane.apply_prime — idempotent,
+        reconciliation re-runs it)."""
+        from ..scheduler.sharded_plane import apply_prime
+        from ..storage.lease import EpochFencedError
+
+        rec = msg["record"]
+        try:
+            apply_prime(self.store, rec)
+        except EpochFencedError:
+            self._fenced_exit("fenced-prime")
+        self.send(op="primed", shard=self.shard, handoff=rec["_id"])
+
+    def op_done(self, msg: dict) -> None:
+        from ..scheduler.sharded_plane import HANDOFFS_COLLECTION
+        from ..storage.lease import EpochFencedError
+
+        hid = msg["handoff"]
+        try:
+            self.store.collection(HANDOFFS_COLLECTION).update(
+                hid, {"state": "done"}
+            )
+        except EpochFencedError:
+            self._fenced_exit("fenced-done")
+        self.send(op="done", shard=self.shard, handoff=hid)
+
+    def op_arm_fault(self, msg: dict) -> None:
+        """Install one PR-1 fault-plan entry live (the proc_kill /
+        proc_hang events' delivery vehicle: kind 'crash' dies AT the
+        named seam, SIGKILL-shaped)."""
+        from ..utils import faults
+
+        plan = _live_fault_plan()
+        seam = msg["seam"]
+        fault = faults.Fault(
+            msg.get("kind", "crash"),
+            delay_s=float(msg.get("delay_s", 0.0)),
+        )
+        if msg.get("always"):
+            plan.always(seam, fault)
+        else:
+            at = msg.get("at")
+            idx = int(at) if at is not None else plan._calls.get(seam, 0)
+            plan.at(seam, idx, fault)
+        self.send(op="armed", shard=self.shard, seam=seam,
+                  kind=fault.kind)
+
+    def op_drain(self, msg: dict) -> None:
+        self.draining = True
+        self.store.sync_persist()
+        self.send(op="drained", shard=self.shard,
+                  epoch=self.lease.epoch)
+
+    def op_shutdown(self, msg: dict) -> None:
+        self._hb_stop.set()
+        try:
+            self.store.sync_persist()
+            self.store.close()
+        except Exception:  # noqa: BLE001 — a fenced store refuses the
+            # final checkpoint; the lease release below still runs
+            pass
+        self.lease.release()
+        self.send(op="bye", shard=self.shard)
+        os._exit(0)
+
+    # -- the command loop ------------------------------------------------- #
+
+    OPS = {
+        "tick": op_tick,
+        "agent_sim": op_agent_sim,
+        "status": op_status,
+        "load": op_load,
+        "handoffs": op_handoffs,
+        "release": op_release,
+        "prime": op_prime,
+        "done": op_done,
+        "arm_fault": op_arm_fault,
+        "drain": op_drain,
+        "shutdown": op_shutdown,
+    }
+
+    def run(self) -> int:
+        from ..storage.lease import EpochFencedError
+
+        self.open()
+        self.start_heartbeat()
+        try:
+            for line in sys.stdin:
+                msg = parse_line(line)
+                if msg is None:
+                    continue  # torn/garbage command line: skip, never die
+                handler = self.OPS.get(msg["op"])
+                if handler is None:
+                    self.send(op="error",
+                              detail=f"unknown op {msg['op']!r}")
+                    continue
+                self._req = msg.get("req")
+                try:
+                    handler(self, msg)
+                finally:
+                    self._req = None
+        except EpochFencedError:
+            self._fenced_exit("fenced-op")
+        # stdin EOF: the supervisor died or dropped us — release and go
+        self._hb_stop.set()
+        try:
+            self.store.close()
+        except Exception:  # noqa: BLE001 — best-effort shutdown
+            pass
+        self.lease.release()
+        return 0
+
+
+# --------------------------------------------------------------------------- #
+# bench mode: the promoted tools/bench_sharded_plane.py inline worker
+# --------------------------------------------------------------------------- #
+
+
+def bench_main(args, proto_out) -> int:
+    """One bench shard: in-memory store seeded with this shard's slice
+    of the baseline churn workload, warmup, then churn+timed ticks on
+    ``go`` — methodology identical to the pre-runtime inline worker
+    (``sharded_churn_tick_ms``)."""
+    import dataclasses
+    import random
+    import statistics
+
+    from ..globals import TaskStatus
+    from ..models import distro as distro_mod
+    from ..models import host as host_mod
+    from ..models import task as task_mod
+    from ..parallel.topology import ShardTopology
+    from ..scheduler.wrapper import TickOptions, run_tick
+    from ..storage.store import Store
+    from ..utils.benchgen import NOW, generate_problem
+    from ..utils.gctune import tune_gc_for_long_lived_heap
+
+    lock = threading.Lock()
+    distros, tbd, hbd, _, _ = generate_problem(
+        args.bench_distros, args.bench_tasks, seed=args.bench_seed,
+        task_group_fraction=0.25, patch_fraction=0.6,
+        hosts_per_distro=25,
+    )
+    topo = ShardTopology(args.shards)
+    mine = {d.id for d in distros if topo.shard_for(d.id) == args.shard}
+    store = Store()
+    store.shard_id = args.shard
+    my_tasks: List = []
+    for d in distros:
+        if d.id not in mine:
+            continue
+        distro_mod.insert(store, d)
+        my_tasks.extend(tbd[d.id])
+        host_mod.insert_many(store, hbd[d.id])
+    task_mod.insert_many(store, my_tasks)
+
+    opts = TickOptions(create_intent_hosts=False, use_cache=True,
+                       underwater_unschedule=False)
+    rng = random.Random(args.shard)
+    coll = task_mod.coll(store)
+    finish_per_tick = max(
+        1, 200 * len(mine) // max(args.bench_distros, 1)
+    )
+    fresh_per_tick = max(
+        1, 100 * len(mine) // max(args.bench_distros, 1)
+    )
+
+    def churn(tick: int) -> None:
+        for t in rng.sample(my_tasks, min(finish_per_tick, len(my_tasks))):
+            coll.update(t.id, {"status": TaskStatus.SUCCEEDED.value})
+        fresh = [
+            dataclasses.replace(
+                rng.choice(my_tasks),
+                id=f"shard{args.shard}-c{tick}-{j}", depends_on=[],
+            )
+            for j in range(fresh_per_tick)
+        ]
+        task_mod.insert_many(store, fresh)
+
+    run_tick(store, opts, now=NOW)  # compile + prime
+    run_tick(store, opts, now=NOW + 0.01)  # absorb the stamp storm
+    for w in range(args.bench_warmup):
+        churn(-1 - w)
+        run_tick(store, opts, now=NOW + 0.1 * (w + 1))
+    tune_gc_for_long_lived_heap()
+
+    send_msg(proto_out, lock, op="ready", shard=args.shard,
+             n_tasks=len(my_tasks), n_distros=len(mine))
+    for line in sys.stdin:
+        msg = parse_line(line)
+        if msg is not None and msg["op"] == "go":
+            break
+    else:
+        return 1
+
+    times = []
+    for tick in range(args.bench_ticks):
+        churn(tick)
+        t1 = _time.perf_counter()
+        run_tick(store, opts, now=NOW + 10.0 * (tick + 1))
+        times.append((_time.perf_counter() - t1) * 1e3)
+    send_msg(
+        proto_out, lock, op="report", worker=args.shard,
+        tick_ms=[round(t, 2) for t in times],
+        median_ms=round(statistics.median(times), 2),
+        n_tasks=len(my_tasks),
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# entry point
+# --------------------------------------------------------------------------- #
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    from ..utils.jaxenv import ensure_usable_backend
+
+    ensure_usable_backend()
+    # the protocol channel is a private dup of stdout; anything that
+    # still prints to sys.stdout (a library warning, a migration note)
+    # lands on stderr instead of corrupting the message stream
+    proto_out = os.fdopen(os.dup(1), "w", encoding="utf-8")
+    sys.stdout = sys.stderr
+    _install_spawn_faults(args)
+    if args.bench:
+        return bench_main(args, proto_out)
+    if not args.data_dir:
+        print("--data-dir is required outside --bench", file=sys.stderr)
+        return 2
+    worker = ShardWorker(args, proto_out)
+    return worker.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,6 +9,11 @@ Public surface:
   ``FAULT_SCENARIO_CASES`` / ``OVERLOAD_SCENARIO_CASES`` /
   ``run_matrix_case``                  — the fault/overload matrix cases
                                          migrated to run THROUGH the engine
+  ``PROC_SCENARIOS`` / ``run_proc_scenario`` / ``run_crash_point``
+                                       — the child-process replay backend
+                                         (scenarios/procs.py): specs with
+                                         proc_kill/proc_hang events against
+                                         a supervised worker-process fleet
 
 ``tools/scenario_engine.py`` is the CLI (SCORECARD.json emission +
 determinism check + last-green diff); ``tools/gate.py --scenarios``
@@ -21,6 +26,12 @@ from .matrix import (
     OVERLOAD_SCENARIO_CASES,
     run_matrix_case,
 )
+from .procs import (
+    PROC_SCENARIOS,
+    ProcScenarioRun,
+    run_crash_point,
+    run_proc_scenario,
+)
 from .spec import DEFAULT_INVARIANTS, Ev, SLO, ScenarioSpec
 
 __all__ = [
@@ -29,11 +40,15 @@ __all__ = [
     "EVENT_HANDLERS",
     "FAULT_SCENARIO_CASES",
     "OVERLOAD_SCENARIO_CASES",
+    "PROC_SCENARIOS",
+    "ProcScenarioRun",
     "SABOTAGE_SCENARIOS",
     "SCENARIOS",
     "SLO",
     "ScenarioRun",
     "ScenarioSpec",
+    "run_crash_point",
     "run_matrix_case",
+    "run_proc_scenario",
     "run_scenario",
 ]

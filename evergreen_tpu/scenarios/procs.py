@@ -1,0 +1,727 @@
+"""Child-process replay backend for the scenario engine.
+
+The in-process engine (scenarios/engine.py) replays a spec against one
+store in one process; this backend replays a spec against a **supervised
+fleet of real worker processes** (runtime/supervisor.py +
+runtime/worker.py over a temp data dir) — the deployment shape of
+``service --shards N`` — so the crash matrix's process-SIGKILL points
+and the supervised-fleet weathers run THROUGH the engine vocabulary
+with the same invariants.
+
+Proc specs are ordinary ``ScenarioSpec``s using the proc event kinds:
+
+  ``proc_fleet``    (tick 0) the workload: n_shards + a seeded problem
+                    partitioned across the shard stores before spawn
+  ``proc_kill``     SIGKILL a named worker — immediately, or AT a named
+                    PR-1 fault seam (``arm_fault`` installs a ``crash``
+                    kind in the live worker: ``os._exit(86)`` at the
+                    seam, the SIGKILL shape — no atexit, no finally)
+  ``proc_hang``     SIGSTOP a named worker: heartbeats stop, the
+                    supervisor's deadline trips, the worker is killed
+                    and restarted — the hang resolves exactly like a
+                    crash, fenced at a higher epoch
+  ``proc_migrate``  drive one fenced handoff over the control protocol
+
+Each virtual tick runs: due events → supervisor round (every live
+worker's ``run_tick``) → the deterministic agent step (complete
+in-flight, dispatch free hosts — the real CAS pair) → wait for any
+killed worker's fenced takeover to land. At the end the fleet drains
+and shuts down, the shard stores are reopened cold, and the scorecard
+asserts the crash-matrix contracts as engine invariants:
+
+  ``no_duplicate_dispatch`` / ``store_consistent`` — on the merged
+  fleet state; ``exactly_one_owner`` — no distro-scoped doc in two
+  shard stores; ``monotone_epochs`` — every restart stole its shard's
+  lease at a strictly higher fencing epoch; ``resume_equals_rerun`` —
+  the crashed-and-recovered fleet converges to the same canonical
+  state as an uninterrupted run of the same spec (kills stripped);
+  ``converged`` — the workload drained.
+
+``run_crash_point`` runs one classic crash-matrix kill point (seam @
+call-index on a 1-shard fleet) through this backend;
+``tools/crash_matrix.py`` delegates its 13-point SIGKILL matrix here
+the way PR 10's tools delegate the fault/overload matrices.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import tempfile
+import time as _time
+from typing import Dict, List, Optional
+
+from ..utils.benchgen import NOW
+from .invariants import (
+    canonical_state,
+    check_duplicate_dispatch,
+    check_store_consistent,
+)
+from .spec import Ev, SLO, ScenarioSpec, scorecard_entry_fingerprint
+
+#: event kinds the proc backend handles (anything else in a proc spec
+#: is a spec error — in-process events cannot reach a child's store)
+PROC_EVENT_KINDS = ("proc_fleet", "proc_kill", "proc_hang",
+                    "proc_migrate")
+
+#: the proc analog of spec.DEFAULT_INVARIANTS
+DEFAULT_PROC_INVARIANTS = (
+    "no_duplicate_dispatch",
+    "store_consistent",
+    "exactly_one_owner",
+    "monotone_epochs",
+    "resume_equals_rerun",
+    "converged",
+)
+
+#: deterministic workload clock (the crash matrix's anchor)
+TICK_S = 15.0
+LEASE_TTL_S = 1.0
+
+
+def _seed_fleet(data_dir: str, n_shards: int, workload: dict) -> None:
+    """Partition one deterministic problem across the shard stores
+    BEFORE any worker spawns (epoch-0 frames — the workers' leased
+    writes land after them). Same workload shape as the crash matrix:
+    phantom running-task stamps cleared so every dispatch is a real
+    CAS pair."""
+    from ..models import distro as distro_mod
+    from ..models import host as host_mod
+    from ..models import task as task_mod
+    from ..parallel.topology import ShardTopology
+    from ..storage.durable import DurableStore
+    from ..utils.benchgen import generate_problem
+
+    distros, tasks_by_distro, hosts_by_distro, _, _ = generate_problem(
+        workload.get("distros", 2),
+        workload.get("tasks", 24),
+        seed=workload.get("seed", 11),
+        hosts_per_distro=workload.get("hosts_per_distro", 3),
+        dep_fraction=workload.get("dep_fraction", 0.25),
+    )
+    topo = ShardTopology(n_shards)
+    stores = [
+        DurableStore(data_dir, shard_id=k) for k in range(n_shards)
+    ]
+    try:
+        for d in distros:
+            store = stores[topo.shard_for(d.id)]
+            store.begin_tick()
+            try:
+                distro_mod.coll(store).upsert(d.to_doc())
+                for t in tasks_by_distro[d.id]:
+                    task_mod.coll(store).upsert(t.to_doc())
+                for h in hosts_by_distro[d.id]:
+                    h.running_task = ""
+                    h.running_task_group = ""
+                    h.running_task_build_variant = ""
+                    h.running_task_version = ""
+                    h.running_task_project = ""
+                    host_mod.coll(store).upsert(h.to_doc())
+            finally:
+                store.end_tick()
+    finally:
+        for s in stores:
+            s.sync_persist()
+            s.close()
+
+
+def _open_fleet_stores(data_dir: str, n_shards: int) -> list:
+    from ..storage.durable import DurableStore
+
+    return [
+        DurableStore(data_dir, shard_id=k) for k in range(n_shards)
+    ]
+
+
+class ProcScenarioRun:
+    """One replay of one proc spec against a supervised fleet."""
+
+    def __init__(self, spec: ScenarioSpec,
+                 with_reference: bool = True) -> None:
+        self.spec = spec
+        self.with_reference = with_reference
+        fleet_evs = [e for e in spec.events if e.kind == "proc_fleet"]
+        if len(fleet_evs) != 1 or fleet_evs[0].tick != 0:
+            raise ValueError(
+                "a proc spec needs exactly one proc_fleet event at "
+                "tick 0"
+            )
+        self.workload = dict(fleet_evs[0].args)
+        self.n_shards = int(self.workload.get("shards", 2))
+        bad = [
+            e.kind for e in spec.events
+            if e.kind not in PROC_EVENT_KINDS
+        ]
+        if bad:
+            raise ValueError(
+                f"proc specs only take {PROC_EVENT_KINDS}; got {bad}"
+            )
+        late = [
+            (e.kind, e.tick) for e in spec.events
+            if not (0 <= e.tick < spec.ticks)
+        ]
+        if late:
+            # an event past the timeline would silently never fire —
+            # the fault it was meant to inject would score as tested
+            raise ValueError(
+                f"events outside [0, ticks={spec.ticks}): {late}"
+            )
+        self.sup = None
+        self.data_dir: Optional[str] = None
+        self.rounds: List[Dict[int, dict]] = []
+        self.dispatched_total = 0
+        self.unfinished = -1
+        self.converged_at = -1
+        self.fault_exits = 0
+        self.stats: Dict = {}
+        self.reference_state: Optional[dict] = None
+
+    # -- events ----------------------------------------------------------- #
+
+    def _apply_event(self, ev: Ev) -> None:
+        if ev.kind == "proc_fleet":
+            return  # consumed at setup
+        if ev.kind == "proc_kill":
+            shard = int(ev.args.get("worker", 0))
+            seam = ev.args.get("seam", "")
+            h = self.sup.handles[shard]
+            if seam:
+                h.send(op="arm_fault", seam=seam, kind="crash",
+                       at=ev.args.get("at"))
+                h.wait_reply("armed", 10.0)
+            elif h.alive():
+                os.kill(h.pid, signal.SIGKILL)
+        elif ev.kind == "proc_hang":
+            shard = int(ev.args.get("worker", 0))
+            seam = ev.args.get("seam", "")
+            h = self.sup.handles[shard]
+            if seam:
+                h.send(op="arm_fault", seam=seam, kind="hang",
+                       delay_s=float(ev.args.get("delay_s", 30.0)),
+                       always=bool(ev.args.get("always", True)))
+                h.wait_reply("armed", 10.0)
+            elif h.alive():
+                os.kill(h.pid, signal.SIGSTOP)
+        elif ev.kind == "proc_migrate":
+            distro = ev.args["distro"]
+            src = int(ev.args["from"])
+            dst = int(ev.args["to"])
+            self.sup.migrate(distro, src, dst)
+
+    # -- the replay loop -------------------------------------------------- #
+
+    def _build_supervisor(self):
+        from ..runtime.supervisor import FleetSupervisor
+        from ..utils.retry import RetryPolicy
+
+        return FleetSupervisor(
+            self.data_dir,
+            self.n_shards,
+            ttl_s=self.workload.get("ttl_s", LEASE_TTL_S),
+            hb_interval_s=0.25,
+            hb_deadline_s=1.5,
+            tick_s=self.spec.tick_s,
+            round_timeout_s=180.0,
+            harness=True,
+            recovery_anchor=NOW,
+            restart_policy=RetryPolicy(
+                attempts=1_000_000, base_backoff_s=0.25,
+                max_backoff_s=2.0, jitter=0.0,
+            ),
+            worker_stderr="devnull",  # induced crashes would spam CI
+        )
+
+    def _events_by_tick(self) -> Dict[int, List[Ev]]:
+        out: Dict[int, List[Ev]] = {}
+        for ev in self.spec.events:
+            if ev.kind != "proc_fleet":
+                out.setdefault(ev.tick, []).append(ev)
+        return out
+
+    def _wait_fleet_healthy(self, timeout_s: float = 60.0) -> None:
+        """Let fenced takeovers land before the next virtual tick: any
+        worker that died gets restarted by the watchdog (backoff +
+        lease-TTL steal) — the round loop must not outrun it forever."""
+        from ..utils.retry import Deadline
+
+        deadline = Deadline.after(timeout_s)
+        while not deadline.exceeded():
+            if all(
+                h.state == "ready" for h in self.sup.handles.values()
+            ):
+                return
+            _time.sleep(0.05)
+
+    def execute(self) -> Dict:
+        t0 = _time.perf_counter()
+        self.data_dir = tempfile.mkdtemp(
+            prefix=f"proc-{self.spec.name}-"
+        )
+        _seed_fleet(self.data_dir, self.n_shards, self.workload)
+        self.sup = self._build_supervisor()
+        self.sup.start()
+        events = self._events_by_tick()
+        try:
+            max_ticks = self.spec.ticks * 3  # crash retries headroom
+            for i in range(max_ticks):
+                now = NOW + (i + 1) * self.spec.tick_s
+                for ev in events.pop(i, ()):
+                    self._apply_event(ev)
+                self.rounds.append(self.sup.round(now=now))
+                done = self.sup.agent_sim(now=now)
+                self.dispatched_total += sum(
+                    r.get("dispatched", 0) for r in done.values()
+                )
+                if done and len(done) == self.n_shards:
+                    self.unfinished = sum(
+                        r.get("unfinished", 0) for r in done.values()
+                    )
+                    if self.unfinished == 0 and not events:
+                        self.converged_at = i
+                        break
+                self._wait_fleet_healthy()
+            self.sup.drain()
+        finally:
+            self.sup.stop(graceful=True)
+        try:
+            if (
+                self.with_reference
+                and self._has_faults()
+                and self.reference_state is None
+            ):
+                self.reference_state = _reference_canonical(self.spec)
+            entry = self._score()
+            entry["timing"] = {
+                "wall_ms": round((_time.perf_counter() - t0) * 1e3, 1)
+            }
+            entry["fingerprint"] = scorecard_entry_fingerprint(entry)
+        finally:
+            # the temp data dir (per-shard WAL segments) must go even
+            # when scoring/reference raises — failed gate loops would
+            # otherwise accumulate multi-MB orphans
+            self._teardown()
+        return entry
+
+    def _has_faults(self) -> bool:
+        return any(
+            e.kind in ("proc_kill", "proc_hang")
+            for e in self.spec.events
+        )
+
+    # -- scoring ---------------------------------------------------------- #
+
+    def _score(self) -> Dict:
+        from ..scheduler.sharded_plane import (
+            fleet_owner_violations,
+            merge_fleet_state,
+        )
+
+        stores = _open_fleet_stores(self.data_dir, self.n_shards)
+        self.stores = stores
+        try:
+            self.owner_violations = fleet_owner_violations(stores)
+            try:
+                self.merged = merge_fleet_state(stores)
+            except ValueError:
+                self.merged = None
+            #: the run's own converged canonical state — the rerun side
+            #: a later crashed run compares against (captured here,
+            #: before the data dir is torn down)
+            self.reference_canonical = (
+                canonical_state(self.merged)
+                if self.merged is not None else None
+            )
+            sup = self.sup
+            self.stats = {
+                "ticks": len(self.rounds),
+                "converged_at": self.converged_at,
+                "unfinished_final": self.unfinished,
+                "dispatched_total": self.dispatched_total,
+                "restarts_total": sum(
+                    h.restarts for h in sup.handles.values()
+                ),
+                "crash_exits": sum(
+                    1 for h in sup.handles.values()
+                    for rc in h.exits if rc == 86
+                ),
+                "kill_exits": sum(
+                    1 for h in sup.handles.values()
+                    for rc in h.exits if rc < 0
+                ),
+                "max_epoch": max(
+                    (h.epoch for h in sup.handles.values()), default=0
+                ),
+                "migrations": len(sup.migrations),
+                "reconciled_handoffs": len(sup.reconciled),
+                **self.stats,
+            }
+            invariants = {}
+            for name in (self.spec.invariants or ()):
+                fn = PROC_INVARIANT_CHECKS.get(name)
+                if fn is None:
+                    invariants[name] = {
+                        "ok": False,
+                        "detail": f"unknown proc invariant {name!r}",
+                    }
+                    continue
+                try:
+                    problem = fn(self)
+                except Exception as exc:  # noqa: BLE001 — a raising
+                    # check is a failing check, never a crashed scorecard
+                    problem = f"invariant raised: {exc!r}"
+                invariants[name] = {
+                    "ok": problem is None, "detail": problem or "",
+                }
+            checks = {}
+            for name, fn in self.spec.checks:
+                try:
+                    problem = fn(self)
+                except Exception as exc:  # noqa: BLE001
+                    problem = f"check raised: {exc!r}"
+                checks[name] = {
+                    "ok": problem is None, "detail": problem or "",
+                }
+            slos = {s.name: s.evaluate(self.stats) for s in self.spec.slos}
+            ok = (
+                all(v["ok"] for v in invariants.values())
+                and all(v["ok"] for v in checks.values())
+                and all(v["ok"] for v in slos.values())
+            )
+            return {
+                "name": self.spec.name,
+                "ok": ok,
+                "seed": self.spec.seed,
+                "deterministic": False,  # real processes, real clocks
+                "backend": "procs",
+                "invariants": invariants,
+                "checks": checks,
+                "slos": slos,
+                "stats": {
+                    k: self.stats[k] for k in sorted(self.stats)
+                    if isinstance(self.stats[k], (int, float, bool, str))
+                },
+            }
+        finally:
+            for s in stores:
+                try:
+                    s.close()
+                except Exception:  # noqa: BLE001 — inspection handles
+                    pass
+
+    def _teardown(self) -> None:
+        import shutil
+
+        if self.data_dir is not None:
+            shutil.rmtree(self.data_dir, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------- #
+# proc invariants
+# --------------------------------------------------------------------------- #
+
+
+def _pinv_no_duplicate_dispatch(run: ProcScenarioRun) -> Optional[str]:
+    if run.merged is None:
+        return "fleet not mergeable (owner violations)"
+    problems = check_duplicate_dispatch(run.merged)
+    return "; ".join(problems[:3]) if problems else None
+
+
+def _pinv_store_consistent(run: ProcScenarioRun) -> Optional[str]:
+    if run.merged is None:
+        return "fleet not mergeable (owner violations)"
+    problems = check_store_consistent(run.merged)
+    return "; ".join(problems[:3]) if problems else None
+
+
+def _pinv_exactly_one_owner(run: ProcScenarioRun) -> Optional[str]:
+    return (
+        "; ".join(run.owner_violations[:3])
+        if run.owner_violations else None
+    )
+
+
+def _pinv_monotone_epochs(run: ProcScenarioRun) -> Optional[str]:
+    for k, h in run.sup.handles.items():
+        es = h.epochs
+        if es != sorted(set(es)):
+            return f"shard {k} epochs not strictly increasing: {es}"
+        if h.restarts and not es:
+            return (
+                f"shard {k}: {h.restarts} restart(s) but no takeover "
+                "ever said hello"
+            )
+        # a crash BEFORE the first hello (e.g. inside the recovery
+        # pass) leaves only the successor's epoch observed — strictly-
+        # increasing over the observed hellos is the checkable half;
+        # the lease's epoch floor guarantees the unobserved half
+    return None
+
+
+def _pinv_resume_equals_rerun(run: ProcScenarioRun) -> Optional[str]:
+    """The crashed-and-recovered fleet must converge to the same
+    canonical state as an uninterrupted run of the same spec (the
+    crash matrix's resume ≡ rerun, fleet-wide)."""
+    if run.reference_state is None:
+        return None  # no faults in the spec: nothing to compare
+    if run.merged is None:
+        return "fleet not mergeable (owner violations)"
+    live = canonical_state(run.merged)
+    if live != run.reference_state:
+        diffs = []
+        for key in ("tasks", "queues"):
+            a = live[key]
+            b = run.reference_state[key]
+            for k in sorted(set(a) | set(b)):
+                if a.get(k) != b.get(k):
+                    diffs.append(f"{key}/{k}: {a.get(k)} != {b.get(k)}")
+                if len(diffs) >= 3:
+                    break
+        return "resume != rerun: " + "; ".join(diffs[:3])
+    return None
+
+
+def _pinv_converged(run: ProcScenarioRun) -> Optional[str]:
+    if run.unfinished != 0:
+        return (
+            f"workload did not drain: {run.unfinished} unfinished "
+            f"after {len(run.rounds)} rounds"
+        )
+    return None
+
+
+PROC_INVARIANT_CHECKS = {
+    "no_duplicate_dispatch": _pinv_no_duplicate_dispatch,
+    "store_consistent": _pinv_store_consistent,
+    "exactly_one_owner": _pinv_exactly_one_owner,
+    "monotone_epochs": _pinv_monotone_epochs,
+    "resume_equals_rerun": _pinv_resume_equals_rerun,
+    "converged": _pinv_converged,
+}
+
+
+def _reference_canonical(spec: ScenarioSpec) -> dict:
+    """The rerun side: the same spec with every proc_kill / proc_hang
+    stripped, replayed uninterrupted; returns the merged canonical
+    state at convergence."""
+    import dataclasses
+
+    clean = dataclasses.replace(
+        spec,
+        name=f"{spec.name}-reference",
+        events=[
+            e for e in spec.events
+            if e.kind not in ("proc_kill", "proc_hang")
+        ],
+        checks=[],
+        slos=[],
+        invariants=("converged",),
+    )
+    run = ProcScenarioRun(clean, with_reference=False)
+    entry = run.execute()
+    if not entry["ok"]:
+        raise RuntimeError(
+            f"proc reference run failed: {entry['invariants']}"
+        )
+    # the data dir is torn down inside execute(); the canonical state
+    # was captured from the merged view at scoring time
+    return run.reference_canonical
+
+
+def run_proc_scenario(spec: ScenarioSpec) -> Dict:
+    """Replay one proc spec; returns its scorecard entry."""
+    return ProcScenarioRun(spec).execute()
+
+
+# --------------------------------------------------------------------------- #
+# the supervised-fleet weathers (gate --fleet-runtime)
+# --------------------------------------------------------------------------- #
+
+
+def _proc_sigkill_spec(seed: int = 0) -> ScenarioSpec:
+    """2-shard supervised fleet; worker 0 is killed AT the wal.commit
+    seam mid-round (SIGKILL shape) and must come back fenced at a
+    strictly higher epoch with zero duplicate dispatch and resume ≡
+    rerun state."""
+
+    def restarted(run: ProcScenarioRun) -> Optional[str]:
+        if run.stats.get("restarts_total", 0) < 1:
+            return "no worker restart happened"
+        if run.stats.get("crash_exits", 0) < 1:
+            return "no crash-shaped (exit 86) death observed"
+        h = run.sup.handles[0]
+        if len(h.epochs) < 2 or h.epochs[-1] <= h.epochs[0]:
+            return (
+                f"shard 0 takeover not at a higher epoch: {h.epochs}"
+            )
+        return None
+
+    return ScenarioSpec(
+        name="proc-fleet-sigkill",
+        description="supervised 2-shard fleet: SIGKILL-shaped worker "
+                    "death at the wal.commit seam mid-round, fenced "
+                    "takeover at a higher epoch, fleet converges",
+        ticks=12,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", {
+                "shards": 2, "distros": 4, "tasks": 32, "seed": 11,
+                "hosts_per_distro": 3,
+            }),
+            Ev(2, "proc_kill", {"worker": 0, "seam": "wal.commit"}),
+        ],
+        slos=[
+            SLO("bounded-restarts", "restarts_total", "<=", 3),
+        ],
+        checks=[("fenced-restart", restarted)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
+def _proc_hang_spec(seed: int = 0) -> ScenarioSpec:
+    """2-shard fleet; worker 1 is SIGSTOPped: its heartbeats stop, the
+    supervisor's missed-heartbeat deadline kills and restarts it, and
+    the replacement steals the shard lease at a higher epoch."""
+
+    def hang_resolved(run: ProcScenarioRun) -> Optional[str]:
+        if run.stats.get("kill_exits", 0) < 1:
+            return "the hung worker was never killed"
+        if run.stats.get("restarts_total", 0) < 1:
+            return "the hung worker was never restarted"
+        h = run.sup.handles[1]
+        if len(h.epochs) < 2 or h.epochs[-1] <= h.epochs[0]:
+            return f"shard 1 takeover not at a higher epoch: {h.epochs}"
+        return None
+
+    return ScenarioSpec(
+        name="proc-fleet-hang",
+        description="supervised 2-shard fleet: a SIGSTOPped worker "
+                    "misses its heartbeat deadline, is killed and "
+                    "restarted fenced; the fleet converges",
+        ticks=12,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", {
+                "shards": 2, "distros": 4, "tasks": 32, "seed": 11,
+                "hosts_per_distro": 3,
+            }),
+            Ev(2, "proc_hang", {"worker": 1}),
+        ],
+        slos=[
+            SLO("bounded-restarts", "restarts_total", "<=", 3),
+        ],
+        checks=[("hang-resolved", hang_resolved)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
+PROC_SCENARIOS: Dict[str, callable] = {
+    "proc-fleet-sigkill": _proc_sigkill_spec,
+    "proc-fleet-hang": _proc_hang_spec,
+}
+
+
+# --------------------------------------------------------------------------- #
+# crash-matrix delegation (tools/crash_matrix.py KILL_POINTS)
+# --------------------------------------------------------------------------- #
+
+
+def _crash_point_spec(seam: str, index: int,
+                      ticks: int) -> ScenarioSpec:
+    return ScenarioSpec(
+        name=f"proc-crash-{seam.replace('.', '-')}-{index}",
+        description=f"crash-matrix kill point {seam}@{index} through "
+                    "the supervised-fleet backend",
+        ticks=ticks,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", {
+                "shards": 1, "distros": 2, "tasks": 24, "seed": 11,
+                "hosts_per_distro": 3,
+            }),
+        ],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
+def run_crash_point(
+    seam: str,
+    index: int,
+    ticks: int = 9,
+    reference: Optional[dict] = None,
+) -> dict:
+    """One classic crash-matrix kill point through the fleet runtime:
+    a 1-shard supervised fleet whose worker is spawned with
+    ``--crash seam@index`` (the deterministic PR-1 kill point — only
+    the FIRST spawn carries it; the supervisor's restart comes back
+    clean), driven to convergence, then checked against the
+    crash-matrix contracts. Returns the legacy point-result shape
+    (``point`` / ``ok`` / ``crashed`` / ``epochs`` / ``parity_ok`` /
+    ``problems``) tools/crash_matrix.py prints."""
+    spec = _crash_point_spec(seam, index, ticks)
+    run = ProcScenarioRun(spec, with_reference=False)
+    # splice the spawn-time kill point into the supervisor build —
+    # only the FIRST spawn carries it; the watchdog's restart is clean
+    orig_build = run._build_supervisor
+
+    def build_with_crash():
+        sup = orig_build()
+        sup.spawn_crash = {0: f"{seam}@{index}"}
+        return sup
+
+    run._build_supervisor = build_with_crash
+    run.reference_state = reference
+    entry = run.execute()
+    problems = [
+        f"{section}:{name}: {v['detail']}"
+        for section in ("invariants", "checks")
+        for name, v in entry.get(section, {}).items()
+        if not v["ok"]
+    ]
+    crashed = entry["stats"].get("crash_exits", 0) >= 1
+    if not crashed:
+        # lease.renew kill points can fire between rounds; a point that
+        # never fired at all proves nothing
+        problems.append("kill point never fired (no exit-86 death)")
+    if reference is not None:
+        parity_ok = not any(
+            p.startswith("invariants:resume_equals_rerun")
+            for p in problems
+        )
+    else:
+        parity_ok = True
+    return {
+        "point": f"{seam}@{index}",
+        "ok": crashed and not problems,
+        "crashed": crashed,
+        "rc": entry["stats"].get("restarts_total", 0),
+        "epochs": [
+            h for hd in ([] if run.sup is None else
+                         run.sup.handles.values())
+            for h in hd.epochs
+        ],
+        "parity_ok": parity_ok,
+        "problems": problems,
+        "entry": entry,
+    }
+
+
+def proc_reference_state(ticks: int = 9) -> dict:
+    """The uninterrupted 1-shard fleet run of the crash workload — the
+    rerun side every kill point compares against."""
+    spec = _crash_point_spec("reference", 0, ticks)
+    run = ProcScenarioRun(spec, with_reference=False)
+    entry = run.execute()
+    if not entry["ok"]:
+        raise RuntimeError(
+            f"proc crash reference failed: {entry['invariants']}"
+        )
+    return run.reference_canonical

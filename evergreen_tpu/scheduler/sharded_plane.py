@@ -120,6 +120,134 @@ SHARD_HANDOFFS_COMPACTED = _metrics.counter(
 HANDOFF_WATERMARK_ID = "__handoff_watermark__"
 
 
+def handoff_payload(store: Store, group) -> Dict[str, List[dict]]:
+    """Every distro-scoped document of ``group`` on ``store`` — the
+    release leg's payload set. ONE definition of which collections are
+    id-keyed vs distro_id-keyed, shared by the in-process driver and
+    the worker-process release op (runtime/worker.py)."""
+    group_set = set(group)
+    payload: Dict[str, List[dict]] = {}
+    for coll_name in _DISTRO_SCOPED:
+        docs = store.collection(coll_name).find(
+            lambda d, cn=coll_name: (
+                d["_id"] in group_set
+                if cn in ("distros", "task_queues",
+                          "task_secondary_queues")
+                else d.get("distro_id", "") in group_set
+            )
+        )
+        payload[coll_name] = [dict(d) for d in docs]
+    return payload
+
+
+def handoff_record(distro_id: str, group, src: int, dst: int,
+                   seq: int, now: float,
+                   payload: Dict[str, List[dict]]) -> dict:
+    """The durable release record (state="released", full payload)."""
+    return {
+        "_id": f"ho-{distro_id}-{seq:06d}",
+        "distro": distro_id,
+        "group": sorted(group),
+        "from": src,
+        "to": dst,
+        "seq": seq,
+        "state": "released",
+        "at": now,
+        "payload": payload,
+    }
+
+
+def apply_release(store: Store, rec: dict) -> None:
+    """Handoff leg 1: record + deletions in ONE fenced WAL group —
+    all-or-nothing; the ``handoff.release`` crash seam fires INSIDE
+    the group (a kill there loses the whole uncommitted group)."""
+    from ..utils import faults
+
+    store.begin_tick()
+    try:
+        store.collection(HANDOFFS_COLLECTION).upsert(rec)
+        for coll_name, docs in rec["payload"].items():
+            coll = store.collection(coll_name)
+            for d in docs:
+                coll.remove(d["_id"])
+        faults.fire("handoff.release")
+    finally:
+        store.end_tick()
+
+
+def apply_prime(store: Store, rec: dict) -> None:
+    """Handoff leg 2: payload + the target's own 'primed' record in
+    one fenced group (idempotent — reconciliation re-runs it)."""
+    store.begin_tick()
+    try:
+        for coll_name, docs in rec.get("payload", {}).items():
+            coll = store.collection(coll_name)
+            for d in docs:
+                coll.upsert(dict(d))
+        store.collection(HANDOFFS_COLLECTION).upsert({
+            **{k: v for k, v in rec.items() if k != "payload"},
+            "state": "primed",
+        })
+    finally:
+        store.end_tick()
+
+
+def greedy_rebalance_plan(
+    levels: Dict[int, int],
+    loads: Dict[int, Dict[str, int]],
+    round_ms: Dict[int, float],
+    max_handoffs: int,
+    cold_weight: Optional[Dict[int, float]] = None,
+) -> List[tuple]:
+    """Pick up to ``max_handoffs`` migrations as (src, dst, group_rep).
+
+    Replaces busiest-affinity-group-first with a greedy score: each
+    candidate group g on a hot (YELLOW+) shard s scores
+    ``schedulable(g) × round_ms(s)`` — the group's schedulable-task
+    count normalized by the shard's round *rate* — so at equal backlog
+    the shard whose rounds are slower is relieved first (every queued
+    task there waits longer per round), and at equal round time the
+    busiest group still wins. Zero-schedulable groups never move
+    (payload, not load). Targets are GREEN shards, coldest first, and
+    each pick consumes its target so a multi-handoff pass SPREADS load
+    across siblings; at most one group leaves any source per pass
+    (trickle, don't slosh). ``loads`` only needs entries for the HOT
+    shards — cold targets are ordered by ``cold_weight`` (e.g. the
+    round's task counts, already in hand) so callers never pay a
+    per-group scan of every calm shard. Shared by the in-process
+    driver (``_rebalance_locked``) and the fleet supervisor
+    (runtime/supervisor.py ``rebalance``), which feeds it worker-
+    reported loads over the control protocol."""
+    weight = cold_weight or {}
+    cold = sorted(
+        (k for k, lvl in levels.items() if lvl == overload_mod.GREEN),
+        key=lambda k: (
+            weight.get(k, sum(loads.get(k, {}).values())), k,
+        ),
+    )
+    candidates = sorted(
+        (
+            (cnt * max(round_ms.get(s, 0.0), 1.0), s, rep)
+            for s, lvl in levels.items()
+            if lvl >= overload_mod.YELLOW
+            for rep, cnt in (loads.get(s) or {}).items()
+            if cnt > 0
+        ),
+        key=lambda c: (-c[0], c[1], c[2]),
+    )
+    picks: List[tuple] = []
+    moved_from: set = set()
+    for _score, src, rep in candidates:
+        if len(picks) >= max_handoffs or not cold:
+            break
+        if src in moved_from:
+            continue
+        dst = cold.pop(0)
+        moved_from.add(src)
+        picks.append((src, dst, rep))
+    return picks
+
+
 # --------------------------------------------------------------------------- #
 # stacked round barrier
 # --------------------------------------------------------------------------- #
@@ -762,29 +890,32 @@ class ShardedScheduler:
         # be current once per round
         self.refresh_affinity()
         levels = self.shard_levels()
-        hot = sorted(
-            (k for k, lvl in levels.items()
-             if lvl >= overload_mod.YELLOW),
-            key=lambda k: -levels[k],
-        )
-        cold = sorted(
-            (k for k, lvl in levels.items()
-             if lvl == overload_mod.GREEN),
-            key=lambda k: results[k].n_tasks if k in results else 0,
+        if not any(
+            lvl >= overload_mod.YELLOW for lvl in levels.values()
+        ):
+            return []
+        # group-load scans only for the HOT shards (O(tasks) each);
+        # cold targets rank by the round's task counts already in hand
+        loads: Dict[int, Dict[str, int]] = {}
+        reps: Dict[int, Dict[str, str]] = {}
+        for k in range(self.n_shards):
+            if levels.get(k, 0) >= overload_mod.YELLOW:
+                loads[k], reps[k] = self._group_loads(k)
+        round_ms = {
+            k: (results[k].total_ms if k in results else 0.0)
+            for k in range(self.n_shards)
+        }
+        cold_weight = {
+            k: float(results[k].n_tasks) if k in results else 0.0
+            for k in range(self.n_shards)
+        }
+        plan = greedy_rebalance_plan(
+            levels, loads, round_ms, self.max_handoffs_per_round,
+            cold_weight=cold_weight,
         )
         migrations: List[dict] = []
-        for src in hot:
-            if len(migrations) >= self.max_handoffs_per_round:
-                break
-            if not cold:
-                break
-            # consume the target: a round with several handoffs must
-            # SPREAD them, not pile every hot shard's load onto the one
-            # coldest sibling
-            dst = cold.pop(0)
-            did = self._pick_migration_distro(src)
-            if did is None:
-                continue
+        for src, dst, rep in plan:
+            did = reps[src].get(rep, rep)
             SHARD_REBALANCES.inc(shard=src)
             try:
                 rec = self.migrate(
@@ -805,12 +936,14 @@ class ShardedScheduler:
             migrations.append(rec)
         return migrations
 
-    def _pick_migration_distro(self, shard: int) -> Optional[str]:
-        """The busiest whole distro on the shard — quickest relief per
-        handoff (whole affinity groups move together, so pick by group
-        aggregate). Busy-ness counts SCHEDULABLE tasks only: finished
-        docs linger in the collection, and migrating a mostly-complete
-        distro moves payload, not load."""
+    def _group_loads(
+        self, shard: int
+    ) -> tuple:
+        """Per-affinity-group schedulable-task counts on one shard
+        (the rebalancing policy's load input) plus a representative
+        distro per group. SCHEDULABLE tasks only: finished docs linger
+        in the collection, and migrating a mostly-complete distro
+        moves payload, not load."""
         from ..globals import TaskStatus
 
         store = self.stores[shard]
@@ -829,10 +962,7 @@ class ShardedScheduler:
             rep = self.topology.placement_key(did)
             by_group[rep] = by_group.get(rep, 0) + counts.get(did, 0)
             rep_of.setdefault(rep, did)
-        if not by_group:
-            return None
-        rep = max(by_group, key=lambda r: by_group[r])
-        return rep_of[rep]
+        return by_group, rep_of
 
     # -- fenced handoff ---------------------------------------------------- #
 
@@ -862,8 +992,6 @@ class ShardedScheduler:
                     distro_id, target, now=now, _locked=True,
                     _affinity_fresh=_affinity_fresh,
                 )
-        from ..utils import faults
-
         now = _time.time() if now is None else now
         if not _affinity_fresh:
             # placement coupling can have changed since the docs landed
@@ -883,48 +1011,20 @@ class ShardedScheduler:
             )
         src_store, tgt_store = self.stores[src], self.stores[target]
         self._seq += 1
-        hid = f"ho-{distro_id}-{self._seq:06d}"
-        group_set = set(group)
-        payload: Dict[str, List[dict]] = {}
-        for coll_name in _DISTRO_SCOPED:
-            docs = src_store.collection(coll_name).find(
-                lambda d, cn=coll_name: (
-                    d["_id"] in group_set
-                    if cn in ("distros", "task_queues",
-                              "task_secondary_queues")
-                    else d.get("distro_id", "") in group_set
-                )
-            )
-            payload[coll_name] = [dict(d) for d in docs]
-        rec = {
-            "_id": hid,
-            "distro": distro_id,
-            "group": sorted(group),
-            "from": src,
-            "to": target,
-            "seq": self._seq,
-            "state": "released",
-            "at": now,
-            "payload": payload,
-        }
+        payload = handoff_payload(src_store, group)
+        rec = handoff_record(
+            distro_id, group, src, target, self._seq, now, payload
+        )
+        hid = rec["_id"]
 
-        # 1. release: record + deletions in ONE fenced WAL group
+        # 1. release: record + deletions in ONE fenced WAL group (the
+        # handoff.release crash seam fires INSIDE the group — a kill
+        # there loses the whole uncommitted group: no durable record,
+        # no deletions, the source still owns everything)
         from ..storage.lease import EpochFencedError
 
         try:
-            src_store.begin_tick()
-            try:
-                src_store.collection(HANDOFFS_COLLECTION).upsert(rec)
-                for coll_name, docs in payload.items():
-                    coll = src_store.collection(coll_name)
-                    for d in docs:
-                        coll.remove(d["_id"])
-                # crash seam INSIDE the release group: a kill here loses
-                # the whole (uncommitted) group — no durable record, no
-                # deletions, the source still owns everything
-                faults.fire("handoff.release")
-            finally:
-                src_store.end_tick()
+            apply_release(src_store, rec)
         except EpochFencedError:
             # the group was SHED with the deposed holder: its durable
             # state still owns the group and a successor replays it —
@@ -947,6 +1047,8 @@ class ShardedScheduler:
                     error=repr(heal_exc)[-300:],
                 )
             raise
+        from ..utils import faults
+
         SHARD_HANDOFFS.inc(shard=src, outcome="released")
         try:
             # crash seam BETWEEN release and prime: the durable record
@@ -990,20 +1092,7 @@ class ShardedScheduler:
     def _prime_target(self, rec: dict, tgt_store: Store) -> None:
         """Step 2: target absorbs the payload + its own 'primed' record
         in one fenced group (idempotent — reconciliation re-runs it)."""
-        tgt_store.begin_tick()
-        try:
-            for coll_name, docs in rec["payload"].items():
-                coll = tgt_store.collection(coll_name)
-                for d in docs:
-                    coll.upsert(dict(d))
-            tgt_store.collection(HANDOFFS_COLLECTION).upsert(
-                {
-                    **{k: v for k, v in rec.items() if k != "payload"},
-                    "state": "primed",
-                }
-            )
-        finally:
-            tgt_store.end_tick()
+        apply_prime(tgt_store, rec)
 
     def _apply_ownership(self, rec: dict) -> None:
         for did in rec["group"]:

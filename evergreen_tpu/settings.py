@@ -172,6 +172,20 @@ class ShardingConfig(ConfigSection):
     max_handoffs_per_round: int = 1
     #: stacked-round barrier timeout before per-shard local solves
     barrier_timeout_s: float = 30.0
+    # -- process-per-shard runtime (runtime/supervisor.py; `service
+    # -- --shards N`) -------------------------------------------------
+    #: per-shard lease TTL in process mode — ALSO the worst-case fenced
+    #: takeover latency (a replacement steals a dead worker's lease
+    #: only after it goes stale)
+    worker_lease_ttl_s: float = 5.0
+    #: worker heartbeat cadence on the control pipe
+    worker_heartbeat_s: float = 1.0
+    #: missed-heartbeat deadline after which the supervisor kills +
+    #: restarts a worker (hang / pipe-partition detection)
+    worker_heartbeat_deadline_s: float = 5.0
+    #: exponential restart backoff bounds (PR-1 RetryPolicy shape)
+    worker_restart_backoff_s: float = 0.25
+    worker_restart_backoff_max_s: float = 30.0
 
     def validate_and_default(self) -> str:
         if self.n_shards < 1:
@@ -182,6 +196,23 @@ class ShardingConfig(ConfigSection):
             return "max_handoffs_per_round cannot be negative"
         if self.barrier_timeout_s <= 0:
             return "barrier_timeout_s must be > 0"
+        if self.worker_lease_ttl_s <= 0:
+            return "worker_lease_ttl_s must be > 0"
+        if self.worker_heartbeat_s <= 0:
+            return "worker_heartbeat_s must be > 0"
+        if self.worker_heartbeat_deadline_s < self.worker_heartbeat_s:
+            return (
+                "worker_heartbeat_deadline_s must be >= "
+                "worker_heartbeat_s"
+            )
+        if self.worker_restart_backoff_s <= 0:
+            return "worker_restart_backoff_s must be > 0"
+        if (self.worker_restart_backoff_max_s
+                < self.worker_restart_backoff_s):
+            return (
+                "worker_restart_backoff_max_s must be >= "
+                "worker_restart_backoff_s"
+            )
         return ""
 
 

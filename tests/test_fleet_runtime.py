@@ -1,0 +1,462 @@
+"""Process-per-shard fleet runtime (evergreen_tpu/runtime/).
+
+Covers the ISSUE-13 contracts: control-protocol framing (torn/garbage
+lines), supervisor spawn/heartbeat/restart-with-backoff, SIGKILL a
+worker mid-round → fenced takeover at a strictly higher lease epoch
+with zero duplicate dispatch, cross-process fenced handoffs, graceful
+drain releasing every shard lease (including the classic service's
+SIGTERM path), and the admin fleet endpoint shape.
+
+Process-spawning tests keep the workload tiny (a couple of distros,
+a couple dozen tasks) and lease TTLs short so a fenced takeover lands
+in ~2s; the full weathers + crash-point sample run under
+``tools/fleet_runtime.py`` (gate --fleet-runtime).
+"""
+from __future__ import annotations
+
+import io
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from evergreen_tpu.runtime.protocol import parse_line, send_msg
+from evergreen_tpu.runtime.supervisor import (
+    FleetSupervisor,
+    attach_fleet_supervisor,
+    peek_fleet_supervisor,
+)
+from evergreen_tpu.scenarios.procs import _seed_fleet
+from evergreen_tpu.utils.benchgen import NOW
+from evergreen_tpu.utils.retry import RetryPolicy
+
+TICK_S = 15.0
+
+
+def _policy(base: float = 0.2, cap: float = 2.0) -> RetryPolicy:
+    return RetryPolicy(
+        attempts=1_000_000, base_backoff_s=base, max_backoff_s=cap,
+        jitter=0.0,
+    )
+
+
+def _fleet(data_dir, n_shards: int, workload=None,
+           **kw) -> FleetSupervisor:
+    _seed_fleet(
+        str(data_dir), n_shards,
+        workload or {"distros": 2, "tasks": 16, "seed": 11},
+    )
+    kw.setdefault("ttl_s", 1.0)
+    kw.setdefault("hb_interval_s", 0.2)
+    kw.setdefault("hb_deadline_s", 1.2)
+    kw.setdefault("harness", True)
+    kw.setdefault("recovery_anchor", NOW)
+    kw.setdefault("restart_policy", _policy())
+    return FleetSupervisor(str(data_dir), n_shards, **kw)
+
+
+def _drive_to_convergence(sup: FleetSupervisor, max_rounds: int = 24,
+                          start: int = 0) -> int:
+    """Round + agent step until the workload drains; returns the
+    number of rounds driven."""
+    for i in range(start, start + max_rounds):
+        now = NOW + (i + 1) * TICK_S
+        sup.round(now=now)
+        done = sup.agent_sim(now=now)
+        if (
+            len(done) == sup.n_shards
+            and sum(r.get("unfinished", 0) for r in done.values()) == 0
+        ):
+            return i + 1 - start
+        # let a fenced takeover land before the next virtual tick
+        deadline = time.time() + 30.0
+        while time.time() < deadline and not all(
+            h.state == "ready" for h in sup.handles.values()
+        ):
+            time.sleep(0.05)
+    raise AssertionError("fleet did not converge")
+
+
+# --------------------------------------------------------------------------- #
+# control-protocol framing
+# --------------------------------------------------------------------------- #
+
+
+def test_parse_line_accepts_only_protocol_messages():
+    assert parse_line('{"op":"round","ms":1.5}\n') == {
+        "op": "round", "ms": 1.5,
+    }
+    # torn line (a killed writer's partial flush)
+    assert parse_line('{"op":"round","ms"') is None
+    # garbage: a stray library print on the channel
+    assert parse_line("some warning text\n") is None
+    assert parse_line("") is None
+    assert parse_line("   \n") is None
+    # JSON but not a protocol message
+    assert parse_line("[1,2,3]\n") is None
+    assert parse_line('{"no_op_field":1}\n') is None
+    assert parse_line('{"op":7}\n') is None
+
+
+def test_send_msg_survives_closed_pipe():
+    buf = io.StringIO()
+    assert send_msg(buf, op="tick", now=1.0)
+    assert parse_line(buf.getvalue()) == {"op": "tick", "now": 1.0}
+    buf.close()
+    assert send_msg(buf, op="tick") is False  # dead peer: no raise
+
+
+def test_worker_skips_garbage_command_lines(tmp_path):
+    """Torn/garbage lines on a live worker's stdin must be skipped —
+    the next well-formed command still executes."""
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start(monitor=False)
+        h = sup.handles[0]
+        assert h.state == "ready"
+        h.proc.stdin.write("NOT JSON AT ALL\n")
+        h.proc.stdin.write('{"op":"status"\n')  # torn
+        h.proc.stdin.write('{"no_op": true}\n')
+        h.proc.stdin.flush()
+        h.send(op="status")
+        reply = h.wait_reply("status", 15.0)
+        assert reply is not None and reply["shard"] == 0
+        # unknown ops answer an error instead of dying
+        h.send(op="definitely-not-an-op")
+        err = h.wait_reply("status", 5.0)  # error ends the wait → None
+        assert err is None
+        assert h.alive()
+    finally:
+        sup.stop()
+
+
+# --------------------------------------------------------------------------- #
+# spawn / heartbeat / rounds
+# --------------------------------------------------------------------------- #
+
+
+def test_spawn_heartbeat_and_rounds(tmp_path):
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start(monitor=False)
+        h = sup.handles[0]
+        assert h.state == "ready"
+        assert h.epochs == [1]  # first lease acquisition
+        time.sleep(0.6)  # a few beats
+        assert not h.hb_deadline.exceeded()
+        r = sup.round(now=NOW + TICK_S)
+        assert 0 in r and r[0]["epoch"] == 1
+        assert r[0]["n_tasks"] == 16
+        rounds = _drive_to_convergence(sup, start=1)
+        assert rounds >= 1
+        assert sup.rounds_done >= 2
+    finally:
+        sup.stop()
+
+
+def test_restart_backoff_grows_exponentially(tmp_path):
+    """PR-1 RetryPolicy shape: consecutive failures widen the respawn
+    pause. A quick hello does NOT reset the streak (boot-then-crash
+    loops must keep widening); only a sustained healthy period does."""
+    sup = FleetSupervisor(
+        str(tmp_path), 1, restart_policy=_policy(base=0.1, cap=10.0),
+    )
+    h = sup.handles[0]
+    sup._schedule_restart(h, 86)
+    h.state = "new"
+    # a hello that is immediately followed by another crash: the
+    # streak keeps growing (ready_since too recent to count as healthy)
+    h.ready_since = time.monotonic()
+    sup._schedule_restart(h, 86)
+    h.state = "new"
+    sup._schedule_restart(h, 86)
+    assert h.backoffs == [
+        pytest.approx(0.1), pytest.approx(0.2), pytest.approx(0.4),
+    ]
+    # a SUSTAINED healthy period before the next death restarts the
+    # ladder from base
+    h.ready_since = time.monotonic() - (
+        FleetSupervisor.BACKOFF_RESET_AFTER_S + 1.0
+    )
+    sup._schedule_restart(h, 86)
+    assert h.backoffs[-1] == pytest.approx(0.1)
+
+
+def test_sigkill_mid_round_fenced_takeover(tmp_path):
+    """The acceptance centerpiece: kill a worker AT the wal.commit seam
+    mid-round; the supervisor restarts it; the replacement steals the
+    shard lease at a STRICTLY higher epoch; the fleet converges with
+    zero duplicate dispatch and exactly-one-owner."""
+    from evergreen_tpu.scenarios.invariants import (
+        check_duplicate_dispatch,
+        check_store_consistent,
+    )
+    from evergreen_tpu.scenarios.procs import _open_fleet_stores
+    from evergreen_tpu.scheduler.sharded_plane import (
+        fleet_owner_violations,
+        merge_fleet_state,
+    )
+
+    sup = _fleet(
+        tmp_path, 2,
+        workload={"distros": 4, "tasks": 24, "seed": 11},
+    )
+    try:
+        sup.start()
+        sup.round(now=NOW + TICK_S)
+        sup.agent_sim(now=NOW + TICK_S)
+        h = sup.handles[0]
+        h.send(op="arm_fault", seam="wal.commit", kind="crash")
+        assert h.wait_reply("armed", 10.0) is not None
+        _drive_to_convergence(sup, start=1)
+        assert h.exits == [86], "the armed crash must have fired"
+        assert h.restarts == 1
+        assert len(h.epochs) == 2 and h.epochs[1] > h.epochs[0], (
+            f"takeover must steal at a higher epoch: {h.epochs}"
+        )
+        assert sup.handles[1].restarts == 0
+    finally:
+        sup.stop()
+    stores = _open_fleet_stores(str(tmp_path), 2)
+    try:
+        assert fleet_owner_violations(stores) == []
+        merged = merge_fleet_state(stores)
+        assert check_duplicate_dispatch(merged) == []
+        assert check_store_consistent(merged) == []
+    finally:
+        for s in stores:
+            s.close()
+
+
+def test_hang_detection_kills_and_restarts(tmp_path):
+    """A SIGSTOPped worker stops heartbeating; the supervisor's
+    missed-heartbeat deadline kills it and the restart comes back
+    fenced at a higher epoch."""
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start()
+        h = sup.handles[0]
+        os.kill(h.pid, signal.SIGSTOP)
+        deadline = time.time() + 30.0
+        while time.time() < deadline and h.restarts == 0:
+            time.sleep(0.05)
+        while time.time() < deadline and h.state != "ready":
+            time.sleep(0.05)
+        assert h.restarts == 1
+        assert h.exits and h.exits[0] < 0  # killed, not exited
+        assert len(h.epochs) == 2 and h.epochs[1] > h.epochs[0]
+        _drive_to_convergence(sup)
+    finally:
+        sup.stop()
+
+
+# --------------------------------------------------------------------------- #
+# cross-process fenced handoff
+# --------------------------------------------------------------------------- #
+
+
+def test_migrate_over_control_protocol(tmp_path):
+    from evergreen_tpu.scenarios.procs import _open_fleet_stores
+    from evergreen_tpu.scheduler.sharded_plane import (
+        HANDOFFS_COLLECTION,
+        fleet_owner_violations,
+    )
+
+    sup = _fleet(
+        tmp_path, 2,
+        workload={"distros": 4, "tasks": 24, "seed": 11},
+    )
+    try:
+        sup.start()
+        sup.round(now=NOW + TICK_S)
+        # find a distro and move it off its owner
+        st = sup.broadcast("load", "load")
+        src, reps = next(
+            (k, v["reps"]) for k, v in sorted(st.items())
+            if v["reps"]
+        )
+        distro = sorted(reps.values())[0]
+        dst = (src + 1) % 2
+        rec = sup.migrate(distro, src, dst, now=NOW + 16.0)
+        assert rec is not None and rec["state"] == "released"
+        assert distro in rec["group"]
+        sup.drain()
+    finally:
+        sup.stop()
+    stores = _open_fleet_stores(str(tmp_path), 2)
+    try:
+        assert fleet_owner_violations(stores) == []
+        # the moved distro's documents now live on the target
+        assert stores[dst].collection("distros").get(distro) is not None
+        assert stores[src].collection("distros").get(distro) is None
+        src_rec = stores[src].collection(HANDOFFS_COLLECTION).get(
+            rec["_id"]
+        )
+        tgt_rec = stores[dst].collection(HANDOFFS_COLLECTION).get(
+            rec["_id"]
+        )
+        assert src_rec["state"] == "done"
+        assert tgt_rec["state"] == "primed"
+    finally:
+        for s in stores:
+            s.close()
+
+
+# --------------------------------------------------------------------------- #
+# graceful shutdown
+# --------------------------------------------------------------------------- #
+
+
+def test_graceful_stop_releases_all_shard_leases(tmp_path):
+    from evergreen_tpu.storage.lease import shard_lease_path
+
+    sup = _fleet(tmp_path, 2,
+                 workload={"distros": 4, "tasks": 24, "seed": 11})
+    sup.start()
+    for k in range(2):
+        assert os.path.exists(shard_lease_path(str(tmp_path), k))
+    sup.round(now=NOW + TICK_S)
+    sup.stop(graceful=True)
+    for k in range(2):
+        assert not os.path.exists(shard_lease_path(str(tmp_path), k)), (
+            f"shard {k}'s lease must be RELEASED on graceful stop, "
+            "not left to time out"
+        )
+    for h in sup.handles.values():
+        assert h.proc.poll() == 0, "workers must exit cleanly"
+
+
+@pytest.mark.slow
+def test_service_sigterm_releases_writer_lease(tmp_path):
+    """The classic (unsharded) service path: a SIGTERM'd writer must
+    drain and RELEASE its lease before exit — previously only
+    KeyboardInterrupt was handled and the lease was left to TTL out."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    data_dir = str(tmp_path / "svc")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "evergreen_tpu", "service",
+         "--data-dir", data_dir, "--port", str(port)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 120.0
+        for line in proc.stdout:
+            if "listening" in line:
+                break
+            if time.time() > deadline:
+                raise AssertionError("service never came up")
+        lease_path = os.path.join(data_dir, "writer.lease")
+        assert os.path.exists(lease_path)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        assert proc.returncode == 0
+        assert not os.path.exists(lease_path), (
+            "SIGTERM must release the writer lease (graceful drain), "
+            "not abandon it to the TTL"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# --------------------------------------------------------------------------- #
+# admin surface
+# --------------------------------------------------------------------------- #
+
+
+def test_admin_fleet_endpoint_shape(tmp_path):
+    from evergreen_tpu.api.rest import ApiError, RestApi
+    from evergreen_tpu.storage.store import Store
+
+    store = Store()
+    api = RestApi(store)
+    with pytest.raises(ApiError) as exc:
+        api.get_fleet("GET", {}, {})
+    assert exc.value.status == 404
+
+    sup = FleetSupervisor(str(tmp_path), 2)
+    attach_fleet_supervisor(store, sup)
+    assert peek_fleet_supervisor(store) is sup
+    status, doc = api.get_fleet("GET", {}, {})
+    assert status == 200
+    assert doc["n_shards"] == 2
+    assert set(doc) >= {
+        "workers", "rounds", "restarts_total", "migrations",
+        "reconciled_handoffs", "data_dir",
+    }
+    for k in ("0", "1"):
+        w = doc["workers"][k]
+        assert set(w) >= {
+            "state", "epoch", "epochs", "restarts", "level",
+            "last_round_ms", "exits", "heartbeat_overdue",
+        }
+
+
+def test_fleet_state_tracks_rounds_and_levels(tmp_path):
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start(monitor=False)
+        sup.round(now=NOW + TICK_S)
+        st = sup.fleet_state()
+        w = st["workers"]["0"]
+        assert st["rounds"] == 1
+        assert w["state"] == "ready"
+        assert w["level"] in ("green", "yellow", "red", "black")
+        assert w["last_round_ms"] > 0
+    finally:
+        sup.stop()
+
+
+# --------------------------------------------------------------------------- #
+# bench mode (tools/bench_sharded_plane.py dedupe)
+# --------------------------------------------------------------------------- #
+
+
+def test_bench_mode_speaks_the_protocol(tmp_path):
+    """The bench spawns the production worker entrypoint: ready → go →
+    report with the original report fields (methodology unchanged)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "evergreen_tpu.runtime.worker",
+         "--bench", "--shard", "0", "--shards", "1",
+         "--bench-distros", "2", "--bench-tasks", "40",
+         "--bench-ticks", "2", "--bench-warmup", "1"],
+        cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        ready = None
+        deadline = time.time() + 180.0
+        while time.time() < deadline:
+            msg = parse_line(proc.stdout.readline())
+            if msg and msg["op"] == "ready":
+                ready = msg
+                break
+        assert ready is not None and ready["n_tasks"] == 40
+        proc.stdin.write('{"op":"go"}\n')
+        proc.stdin.flush()
+        report = None
+        while time.time() < deadline:
+            msg = parse_line(proc.stdout.readline())
+            if msg and msg["op"] == "report":
+                report = msg
+                break
+        assert report is not None
+        assert len(report["tick_ms"]) == 2
+        assert report["median_ms"] > 0
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -20,6 +20,7 @@ from evergreen_tpu.scheduler.sharded_plane import (
     HANDOFFS_COLLECTION,
     ShardedScheduler,
     fleet_owner_violations,
+    greedy_rebalance_plan,
     merge_fleet_state,
 )
 from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
@@ -362,6 +363,56 @@ def test_reconcile_completes_released_but_unprimed_handoff():
         assert plane.reconcile_handoffs(now=NOW + 2) == []
     finally:
         plane.close()
+
+
+def test_greedy_rebalance_prefers_slower_shard_at_equal_backlog():
+    """The policy score is schedulable-count × source round time: at
+    equal backlog the shard whose rounds are SLOWER is relieved first
+    (each queued task there waits longer per round)."""
+    levels = {0: overload.YELLOW, 1: overload.YELLOW, 2: overload.GREEN}
+    loads = {0: {"a": 100}, 1: {"b": 100}, 2: {}}
+    round_ms = {0: 50.0, 1: 400.0, 2: 40.0}
+    plan = greedy_rebalance_plan(levels, loads, round_ms, 1)
+    assert plan == [(1, 2, "b")]
+
+
+def test_greedy_rebalance_busiest_group_wins_at_equal_round_time():
+    levels = {0: overload.RED, 1: overload.GREEN}
+    loads = {0: {"small": 5, "big": 80}, 1: {}}
+    plan = greedy_rebalance_plan(levels, loads, {0: 100.0}, 1)
+    assert plan == [(0, 1, "big")]
+
+
+def test_greedy_rebalance_caps_and_spreads():
+    """max-handoffs-per-pass cap holds; targets are consumed per pick
+    (spread, don't pile); at most one group leaves any source."""
+    levels = {0: overload.RED, 1: overload.YELLOW,
+              2: overload.GREEN, 3: overload.GREEN}
+    loads = {0: {"a": 90, "a2": 80}, 1: {"b": 70},
+             2: {"c": 1}, 3: {}}
+    round_ms = {k: 100.0 for k in levels}
+    plan = greedy_rebalance_plan(levels, loads, round_ms, 2)
+    assert len(plan) == 2
+    srcs = [p[0] for p in plan]
+    dsts = [p[1] for p in plan]
+    assert sorted(srcs) == [0, 1], "one group per source per pass"
+    assert len(set(dsts)) == 2, "targets must spread"
+    assert dsts[0] == 3, "coldest sibling takes the hottest group"
+    # the cap itself
+    assert len(greedy_rebalance_plan(levels, loads, round_ms, 1)) == 1
+
+
+def test_greedy_rebalance_never_moves_payload_only_groups():
+    """Zero-schedulable groups (finished docs lingering) never move,
+    and a fleet with no hot shard plans nothing."""
+    levels = {0: overload.YELLOW, 1: overload.GREEN}
+    assert greedy_rebalance_plan(
+        levels, {0: {"done": 0}, 1: {}}, {0: 100.0}, 4
+    ) == []
+    calm = {0: overload.GREEN, 1: overload.GREEN}
+    assert greedy_rebalance_plan(
+        calm, {0: {"a": 50}, 1: {}}, {0: 100.0}, 4
+    ) == []
 
 
 def test_rebalance_migrates_off_yellow_shard():

@@ -5,9 +5,11 @@ The headline sharded bench (``sharded_churn_tick_ms``): the BASELINE
 config-5 churn workload (200 distros / 50k tasks, ~200 finishes + ~100
 fresh tasks per tick) is partitioned across N scheduler shards by the
 production consistent-hash topology (parallel/topology.py), each shard
-running in its OWN PROCESS — its own store, TickCache, resident plane
-and tick loop, exactly the deployment shape of scheduler/sharded_plane.py
-— against a single-shard plane carrying the same total load.
+running in its OWN PROCESS — the **production shard worker entrypoint**
+(``python -m evergreen_tpu.runtime.worker --bench``, the same binary
+``service --shards N`` supervises; this harness used to carry a private
+inline copy) — against a single-shard plane carrying the same total
+load.
 
 Two measurements, same methodology as the multichip dry-run bench
 (tools/bench_sharded.py): on a shared-core CI box every worker contends
@@ -30,16 +32,18 @@ aggregate.
         [--distros 200] [--tasks 50000]
 
 Prints one JSON line; per-shard tables go to stderr. Workers are real
-processes (one python + jax runtime each) — the actual deployment shape
-of scheduler/sharded_plane.py: own store, own TickCache, own resident
-plane, own tick loop.
+processes (one python + jax runtime each) speaking the fleet runtime's
+newline-JSON protocol (runtime/protocol.py): the worker warms up,
+reports ``ready``, waits for ``go``, runs the churned+timed ticks and
+reports ``report`` — identical timing methodology to the pre-runtime
+harness (``tick_ms`` measures ``run_tick`` wall time worker-side, churn
+mutations excluded).
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
-import statistics
 import subprocess
 import sys
 import time
@@ -51,106 +55,22 @@ if _REPO_ROOT not in sys.path:
 DEFAULT_DISTROS = 200
 DEFAULT_TASKS = 50_000
 DEFAULT_TICKS = 5
-WARMUP_TICKS = 2
 SEED = 3
 
 
 # --------------------------------------------------------------------------- #
-# worker: one scheduler shard in its own process
-# --------------------------------------------------------------------------- #
-
-
-def worker_main(args) -> int:
-    from evergreen_tpu.utils.jaxenv import force_cpu
-
-    force_cpu()
-    import dataclasses
-    import random
-
-    from evergreen_tpu.globals import TaskStatus
-    from evergreen_tpu.models import distro as distro_mod
-    from evergreen_tpu.models import host as host_mod
-    from evergreen_tpu.models import task as task_mod
-    from evergreen_tpu.parallel.topology import ShardTopology
-    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
-    from evergreen_tpu.storage.store import Store
-    from evergreen_tpu.utils.benchgen import NOW, generate_problem
-    from evergreen_tpu.utils.gctune import tune_gc_for_long_lived_heap
-
-    distros, tbd, hbd, _, _ = generate_problem(
-        args.distros, args.tasks, seed=SEED, task_group_fraction=0.25,
-        patch_fraction=0.6, hosts_per_distro=25,
-    )
-    topo = ShardTopology(args.shards)
-    mine = {
-        d.id for d in distros if topo.shard_for(d.id) == args.worker
-    }
-    store = Store()
-    store.shard_id = args.worker
-    my_tasks = []
-    for d in distros:
-        if d.id not in mine:
-            continue
-        distro_mod.insert(store, d)
-        my_tasks.extend(tbd[d.id])
-        host_mod.insert_many(store, hbd[d.id])
-    task_mod.insert_many(store, my_tasks)
-
-    opts = TickOptions(create_intent_hosts=False, use_cache=True,
-                       underwater_unschedule=False)
-    rng = random.Random(args.worker)
-    coll = task_mod.coll(store)
-    finish_per_tick = max(1, 200 * len(mine) // max(args.distros, 1))
-    fresh_per_tick = max(1, 100 * len(mine) // max(args.distros, 1))
-
-    def churn(tick: int) -> None:
-        for t in rng.sample(my_tasks, min(finish_per_tick, len(my_tasks))):
-            coll.update(t.id, {"status": TaskStatus.SUCCEEDED.value})
-        fresh = [
-            dataclasses.replace(
-                rng.choice(my_tasks), id=f"shard{args.worker}-c{tick}-{j}",
-                depends_on=[],
-            )
-            for j in range(fresh_per_tick)
-        ]
-        task_mod.insert_many(store, fresh)
-
-    run_tick(store, opts, now=NOW)  # compile + prime
-    run_tick(store, opts, now=NOW + 0.01)  # absorb the stamp storm
-    for w in range(WARMUP_TICKS):
-        churn(-1 - w)
-        run_tick(store, opts, now=NOW + 0.1 * (w + 1))
-    tune_gc_for_long_lived_heap()
-
-    print(json.dumps({"ready": args.worker, "n_tasks": len(my_tasks),
-                      "n_distros": len(mine)}), flush=True)
-    sys.stdin.readline()  # GO
-
-    times = []
-    for tick in range(args.ticks):
-        churn(tick)
-        t1 = time.perf_counter()
-        run_tick(store, opts, now=NOW + 10.0 * (tick + 1))
-        times.append((time.perf_counter() - t1) * 1e3)
-    print(json.dumps({
-        "worker": args.worker,
-        "tick_ms": [round(t, 2) for t in times],
-        "median_ms": round(statistics.median(times), 2),
-        "n_tasks": len(my_tasks),
-    }), flush=True)
-    return 0
-
-
-# --------------------------------------------------------------------------- #
-# parent: one arm (N workers), then the ratio over both arms
+# parent: one arm (N production workers), then the ratio over both arms
 # --------------------------------------------------------------------------- #
 
 
 def _worker_cmd(k: int, n_shards: int, args) -> list:
     return [
-        sys.executable, os.path.abspath(__file__), "--worker", str(k),
-        "--shards", str(n_shards), "--ticks", str(args.ticks),
-        "--distros", str(args.distros), "--tasks", str(args.tasks),
+        sys.executable, "-m", "evergreen_tpu.runtime.worker",
+        "--bench", "--shard", str(k), "--shards", str(n_shards),
+        "--bench-ticks", str(args.ticks),
+        "--bench-distros", str(args.distros),
+        "--bench-tasks", str(args.tasks),
+        "--bench-seed", str(SEED),
     ]
 
 
@@ -159,9 +79,26 @@ def _worker_env() -> dict:
             "PALLAS_AXON_POOL_IPS": ""}
 
 
+def _read_op(proc, op: str) -> dict:
+    """Next protocol message with the given op (heartbeats and stray
+    lines skipped — runtime/protocol.py parse_line semantics)."""
+    from evergreen_tpu.runtime.protocol import parse_line
+
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"bench worker pipe closed waiting for {op!r} "
+                f"(rc={proc.poll()})"
+            )
+        msg = parse_line(line)
+        if msg is not None and msg["op"] == op:
+            return msg
+
+
 def run_arm(n_shards: int, args, serial: bool = False) -> dict:
     """Launch one worker per shard. ``serial=False``: all workers run
-    concurrently between a synchronized GO and the last DONE (the
+    concurrently between a synchronized GO and the last report (the
     contended-wall number for THIS box). ``serial=True``: workers run
     one at a time, each alone on the box — the dedicated-shard
     measurement whose max-median bounds a production round."""
@@ -175,10 +112,10 @@ def run_arm(n_shards: int, args, serial: bool = False) -> dict:
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL, text=True,
             )
-            p.stdout.readline()  # READY
-            p.stdin.write("GO\n")
+            _read_op(p, "ready")
+            p.stdin.write('{"op":"go"}\n')
             p.stdin.flush()
-            reports.append(json.loads(p.stdout.readline()))
+            reports.append(_read_op(p, "report"))
             p.wait(timeout=240)
         # a fleet round is gated by its slowest shard
         wall_s = max(r["median_ms"] for r in reports) * args.ticks / 1e3
@@ -191,13 +128,13 @@ def run_arm(n_shards: int, args, serial: bool = False) -> dict:
                 stderr=subprocess.DEVNULL, text=True,
             ))
         for p in procs:
-            p.stdout.readline()  # READY
+            _read_op(p, "ready")
         t0 = time.perf_counter()
         for p in procs:
-            p.stdin.write("GO\n")
+            p.stdin.write('{"op":"go"}\n')
             p.stdin.flush()
         for p in procs:
-            reports.append(json.loads(p.stdout.readline()))
+            reports.append(_read_op(p, "report"))
             p.wait(timeout=240)
         wall_s = time.perf_counter() - t0
     total_tasks = sum(r["n_tasks"] for r in reports)
@@ -222,11 +159,7 @@ def main() -> int:
     p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
     p.add_argument("--distros", type=int, default=DEFAULT_DISTROS)
     p.add_argument("--tasks", type=int, default=DEFAULT_TASKS)
-    p.add_argument("--worker", type=int, default=-1,
-                   help="(internal) run as shard worker k")
     args = p.parse_args()
-    if args.worker >= 0:
-        return worker_main(args)
 
     single = run_arm(1, args)
     dedicated = run_arm(args.shards, args, serial=True)

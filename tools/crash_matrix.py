@@ -839,11 +839,27 @@ def failover_case(ticks: int = 4, stall_s: float = 2.0) -> dict:
 
 def run_matrix(points: Optional[List[Tuple[str, int]]] = None,
                ticks: int = DEFAULT_TICKS) -> int:
+    """The full matrix. The 13 process-SIGKILL points run THROUGH the
+    scenario engine's child-process backend (scenarios/procs.py
+    ``run_crash_point``: a 1-shard supervised fleet whose worker dies
+    at the seam and is restarted fenced by the production supervisor)
+    — the same delegation PR 10 gave the fault/overload matrices.
+    ``run_point`` above remains the bespoke single-point harness for
+    ``--point`` and the tier-1 reduced sample
+    (tests/test_crash_recovery.py); the failover and distro-handoff
+    cases stay bespoke (two live processes / a live 2-shard plane have
+    no engine analog yet)."""
+    from evergreen_tpu.scenarios.procs import (
+        proc_reference_state,
+        run_crash_point,
+    )
+
     points = points if points is not None else KILL_POINTS
-    reference = reference_state(ticks)
+    reference = proc_reference_state(ticks)
     failures = 0
     for seam, idx in points:
-        out = run_point(seam, idx, ticks=ticks, reference=reference)
+        out = run_crash_point(seam, idx, ticks=ticks,
+                              reference=reference)
         print(json.dumps({
             k: out[k]
             for k in ("point", "ok", "crashed", "rc", "epochs",
@@ -851,7 +867,9 @@ def run_matrix(points: Optional[List[Tuple[str, int]]] = None,
         }))
         if not out["ok"]:
             failures += 1
-            sys.stderr.write(out["out"] + "\n")
+            sys.stderr.write(
+                json.dumps(out.get("entry", {}), default=str) + "\n"
+            )
     fo = failover_case()
     print(json.dumps({
         k: fo[k]

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Fleet-runtime smoke: the process-per-shard deployment, end to end.
+
+    python tools/fleet_runtime.py              # the full smoke
+    python tools/fleet_runtime.py --scenario proc-fleet-sigkill
+    python tools/fleet_runtime.py --points     # crash points only
+
+Runs (gate-blocking via ``tools/gate.py --fleet-runtime`` /
+``make fleet-runtime``):
+
+  1. the supervised-fleet weathers (scenarios/procs.py
+     ``PROC_SCENARIOS``): a 2-shard fleet with one induced
+     SIGKILL-shaped worker death at a WAL seam (``proc_kill``) and one
+     induced hang (``proc_hang`` → missed-heartbeat kill + restart) —
+     each must converge with a fenced takeover at a strictly higher
+     lease epoch, zero duplicate dispatch, exactly-one-owner, and
+     resume ≡ rerun state vs an uninterrupted run;
+  2. a sample of the migrated crash-matrix engine points
+     (``run_crash_point`` — the backend ``crash-matrix`` runs all 13
+     through): one kill inside a WAL group commit, one between the
+     dispatch CAS pair, one inside the startup recovery pass.
+
+Prints one JSON line per case; exits non-zero on any failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: the smoke's crash-point sample (the full 13 run under
+#: ``gate.py --crash-matrix``; these three cover a group commit, the
+#: dispatch CAS pair, and the recovery pass itself)
+SMOKE_POINTS: List[Tuple[str, int]] = [
+    ("wal.commit", 1),
+    ("dispatch.assign", 0),
+    ("recovery.pass", 0),
+]
+
+
+def _force_cpu() -> None:
+    from evergreen_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(n_devices=1)
+
+
+def run_weathers(names: Optional[List[str]] = None) -> int:
+    from evergreen_tpu.scenarios.procs import (
+        PROC_SCENARIOS,
+        run_proc_scenario,
+    )
+
+    failures = 0
+    for name, factory in PROC_SCENARIOS.items():
+        if names and name not in names:
+            continue
+        entry = run_proc_scenario(factory())
+        print(json.dumps({
+            "scenario": name,
+            "ok": entry["ok"],
+            "stats": entry["stats"],
+            "wall_ms": entry["timing"]["wall_ms"],
+        }))
+        if not entry["ok"]:
+            failures += 1
+            bad = {
+                section: {
+                    k: v for k, v in entry.get(section, {}).items()
+                    if not v.get("ok")
+                }
+                for section in ("invariants", "checks", "slos")
+            }
+            print(json.dumps({"scenario": name, "failed": bad}),
+                  file=sys.stderr)
+    return failures
+
+
+def run_points() -> int:
+    from evergreen_tpu.scenarios.procs import (
+        proc_reference_state,
+        run_crash_point,
+    )
+
+    reference = proc_reference_state()
+    failures = 0
+    for seam, idx in SMOKE_POINTS:
+        out = run_crash_point(seam, idx, reference=reference)
+        print(json.dumps({
+            k: out[k]
+            for k in ("point", "ok", "crashed", "epochs",
+                      "parity_ok", "problems")
+        }))
+        if not out["ok"]:
+            failures += 1
+    return failures
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", default="",
+                   help="run one supervised-fleet weather only")
+    p.add_argument("--points", action="store_true",
+                   help="run only the crash-point sample")
+    p.add_argument("--weathers", action="store_true",
+                   help="run only the supervised-fleet weathers")
+    args = p.parse_args()
+
+    if args.scenario and args.points:
+        # the combination would skip BOTH blocks and report a green
+        # smoke that ran nothing
+        print("--scenario and --points are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    _force_cpu()
+    if args.scenario:
+        from evergreen_tpu.scenarios.procs import PROC_SCENARIOS
+
+        if args.scenario not in PROC_SCENARIOS:
+            # a typo must never read as "smoke passed"
+            print(
+                f"unknown scenario {args.scenario!r}; known: "
+                f"{sorted(PROC_SCENARIOS)}", file=sys.stderr,
+            )
+            return 2
+    failures = 0
+    if not args.points:
+        failures += run_weathers(
+            [args.scenario] if args.scenario else None
+        )
+    if not args.weathers and not args.scenario:
+        failures += run_points()
+    print(json.dumps({"fleet_runtime_ok": failures == 0}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

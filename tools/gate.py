@@ -92,7 +92,7 @@ def _log_run(rc: int, args: list) -> None:
     full_suite = bool(args) and args[0] == "tests/" and all(
         a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
               "--shard-parity", "--capacity-parity", "--read-parity",
-              "--scenarios")
+              "--scenarios", "--fleet-runtime")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -114,8 +114,9 @@ def main() -> int:
         env.pop(k, None)
     flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
              "--shard-parity", "--capacity-parity", "--read-parity",
-             "--scenarios"}
+             "--scenarios", "--fleet-runtime"}
     args = [a for a in sys.argv[1:] if a not in flags]
+    with_fleet_runtime = "--fleet-runtime" in sys.argv[1:]
     with_scenarios = "--scenarios" in sys.argv[1:]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
@@ -202,6 +203,18 @@ def main() -> int:
             print("gate:", " ".join(sc), flush=True)
             rc = subprocess.call(sc, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--scenarios")
+    if rc == 0 and with_fleet_runtime:
+        # the supervised-fleet smoke (make fleet-runtime): 2 shard
+        # worker processes under the production supervisor, one induced
+        # SIGKILL at a WAL seam + one induced hang — each must take
+        # over fenced at a higher lease epoch with zero duplicate
+        # dispatch and resume ≡ rerun — plus the migrated crash-matrix
+        # engine points sample
+        fr = [sys.executable,
+              os.path.join(root, "tools", "fleet_runtime.py")]
+        print("gate:", " ".join(fr), flush=True)
+        rc = subprocess.call(fr, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--fleet-runtime")
     if rc == 0 and with_read_parity:
         # follower reads ≡ primary at lag 0, bounded-stale answers are a
         # prefix of primary history, fenced frames never served, the
