@@ -99,9 +99,12 @@ scenarios:
 # --fleet-runtime): 2 shard worker processes under the production
 # supervisor (runtime/), one induced SIGKILL-at-a-WAL-seam + one
 # induced hang — fenced takeover at a strictly higher lease epoch,
-# zero duplicate dispatch, exactly-one-owner, resume == rerun — plus a
-# sample of the crash-matrix points migrated to the engine's
-# child-process backend (the full 13 run under `make crash-matrix`)
+# zero duplicate dispatch, exactly-one-owner, resume == rerun — plus
+# the SUPERVISOR-kill weathers (orphan workers adopted live, zero
+# shard-lease epoch bumps, mid-handoff reconciled), a sample of the
+# crash-matrix points migrated to the engine's child-process backend
+# (the full 13 run under `make crash-matrix`), and the split-brain
+# sabotage self-test (stale supervisor: every command rejected)
 fleet-runtime:
 	env JAX_PLATFORMS=cpu python tools/fleet_runtime.py
 

@@ -2223,9 +2223,12 @@ class RestApi:
     def get_fleet(self, method, match, body):
         """Process-per-shard fleet runtime state (runtime/supervisor.py
         fleet_state): per-worker state / lease epoch history / round
-        timing / restart counts plus fleet totals. 404 when this
-        service runs the classic in-process plane (no ``--shards N``
-        supervisor attached)."""
+        timing / restart counts / adoption state (``adopted``,
+        ``orphan``, ``orphan_ticks``, ``stale_rejects``) plus fleet
+        totals (``supervisor_epoch``, ``adoptions_total``,
+        ``orphaned_total``, ``deposed``). 404 when this service runs
+        the classic in-process plane (no ``--shards N`` supervisor
+        attached)."""
         from ..runtime.supervisor import peek_fleet_supervisor
 
         sup = peek_fleet_supervisor(self.store)

@@ -102,16 +102,33 @@ def _cmd_service_fleet(args) -> int:
         ),
         rebalance_enabled=sharding.rebalance_enabled,
         max_handoffs_per_pass=sharding.max_handoffs_per_round,
+        orphan_grace_s=sharding.orphan_grace_s,
+        supervisor_lease_ttl_s=sharding.supervisor_lease_ttl_s,
     )
     print(
-        f"spawning {args.shards} shard workers over {args.data_dir} ..."
+        f"acquiring fleet lease, then adopting/spawning "
+        f"{args.shards} shard workers over {args.data_dir} ..."
     )
-    sup.start()
+    try:
+        sup.start()
+    except RuntimeError as exc:
+        # a LIVE supervisor already commands this fleet: refuse to
+        # split-brain it (a dead one's lease would have been stolen)
+        print(f"cannot start fleet service: {exc}", file=sys.stderr)
+        return 1
     state = sup.fleet_state()
     ready = sum(
         1 for w in state["workers"].values() if w["state"] == "ready"
     )
-    print(f"fleet up: {ready}/{args.shards} workers ready")
+    adopted = sum(
+        1 for w in state["workers"].values() if w["adopted"]
+    )
+    print(
+        f"fleet up: {ready}/{args.shards} workers ready "
+        f"({adopted} adopted live from a previous supervisor, "
+        f"{args.shards - adopted} spawned; supervisor epoch "
+        f"{state['supervisor_epoch']})"
+    )
     sup.run_background()
     api = RestApi(
         front,

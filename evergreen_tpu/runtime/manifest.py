@@ -1,0 +1,120 @@
+"""Fleet manifest: how a restarted supervisor finds live workers.
+
+Each shard worker records itself under ``<data_dir>/fleet/`` —
+``shard<k>.json`` with its pid, spawn generation, shard-lease epoch and
+the path of its re-attachable control socket — so a supervisor that
+crashed and came back can **adopt** the still-running worker over the
+socket instead of cold-respawning it (no shard-lease epoch bump, no
+recovery pass, resident plane stays warm; runtime/supervisor.py
+``_try_adopt``).
+
+Entries are written atomically (tmp + rename) by the worker itself at
+boot and removed on every clean exit path (shutdown, orphan-grace
+expiry, stdin EOF with orphan mode off). A crash leaves a stale entry
+behind by design: adoption validates the recorded pid is alive and the
+socket answers before trusting it, and unlinks what it cannot adopt.
+
+The control socket is a unix-domain socket. Its path lives in the
+system temp dir keyed by a hash of the data dir (not inside the data
+dir) because ``sun_path`` is limited to ~107 bytes and data dirs —
+especially pytest tmp dirs — routinely blow past that; the manifest
+entry records the real path, so nothing ever needs to derive it.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import tempfile
+from typing import Dict, Optional
+
+#: subdirectory of the data dir holding one entry file per shard
+FLEET_DIR = "fleet"
+
+
+def fleet_dir(data_dir: str) -> str:
+    return os.path.join(data_dir, FLEET_DIR)
+
+
+def entry_path(data_dir: str, shard: int) -> str:
+    return os.path.join(fleet_dir(data_dir), f"shard{shard}.json")
+
+
+def socket_path(data_dir: str, shard: int) -> str:
+    """A per-(data dir, shard) UDS path short enough for sun_path."""
+    key = hashlib.sha1(
+        os.path.abspath(data_dir).encode("utf-8")
+    ).hexdigest()[:10]
+    return os.path.join(
+        tempfile.gettempdir(), f"evg-fleet-{key}-{shard}.sock"
+    )
+
+
+def write_entry(data_dir: str, shard: int, *, pid: int, sock: str,
+                generation: int, epoch: int) -> None:
+    """Atomically record this worker in the manifest (tmp + rename —
+    a reader never observes a torn entry)."""
+    os.makedirs(fleet_dir(data_dir), exist_ok=True)
+    path = entry_path(data_dir, shard)
+    tmp = f"{path}.{pid}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({
+            "shard": shard,
+            "pid": pid,
+            "sock": sock,
+            "generation": generation,
+            "epoch": epoch,
+        }, fh)
+    os.replace(tmp, path)
+
+
+def read_entry(data_dir: str, shard: int) -> Optional[dict]:
+    try:
+        with open(entry_path(data_dir, shard), encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and doc.get("pid") else None
+
+
+def read_all(data_dir: str) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    try:
+        names = os.listdir(fleet_dir(data_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("shard") and name.endswith(".json")):
+            continue
+        try:
+            shard = int(name[len("shard"):-len(".json")])
+        except ValueError:
+            continue
+        entry = read_entry(data_dir, shard)
+        if entry is not None:
+            out[shard] = entry
+    return out
+
+
+def remove_entry(data_dir: str, shard: int,
+                 sock: Optional[str] = None) -> None:
+    """Best-effort cleanup on a clean worker exit (and by a supervisor
+    that found an entry it could not adopt)."""
+    for path in (entry_path(data_dir, shard), sock):
+        if not path:
+            continue
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def connect(sock_path: str, timeout_s: float = 5.0) -> socket.socket:
+    """Connect to a worker's control socket; raises OSError when the
+    worker is gone (the adoption probe's failure path)."""
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.settimeout(timeout_s)
+    conn.connect(sock_path)
+    conn.settimeout(None)
+    return conn

@@ -1,21 +1,36 @@
 """Newline-JSON control protocol between supervisor and shard workers.
 
-One JSON object per line, each carrying an ``op`` field. The channel is
-the worker's stdio — stdin carries supervisor→worker commands, stdout
-carries worker→supervisor replies and unsolicited messages (heartbeats,
-``fenced``, ``bye``). Structured logging writes to stderr
-(utils/log.py json_line_sink), so the protocol stream stays parseable;
-anything that still lands on stdout without being a protocol message
-(a stray library print, a torn line from a killed writer) is skipped by
-``parse_line`` and counted by the reader — a garbage line must never
-wedge the fleet.
+One JSON object per line, each carrying an ``op`` field. The PRIMARY
+channel is the worker's stdio — stdin carries supervisor→worker
+commands, stdout carries worker→supervisor replies and unsolicited
+messages (heartbeats, ``fenced``, ``bye``). The same framing also runs
+over each worker's re-attachable per-shard unix-domain socket
+(runtime/manifest.py): a restarted supervisor connects there and sends
+``adopt`` to take over a live worker without respawning it. Structured
+logging writes to stderr (utils/log.py json_line_sink), so the protocol
+stream stays parseable; anything that still lands on a channel without
+being a protocol message (a stray library print, a torn line from a
+killed writer) is skipped by ``parse_line`` and counted by the reader —
+a garbage line must never wedge the fleet.
+
+**Supervisor fencing.** Every supervisor→worker command is stamped with
+the sender's supervisor-lease epoch (``sup``, storage/lease.py
+``supervisor_lease_path``). Workers track the highest epoch they have
+observed and answer anything older with ``stale_sup`` instead of
+executing it — two supervisors can never split-brain the fleet; the
+deposed one reads the reject as its stand-down order.
 
 Worker → supervisor ops:
 
   ``hello``      after lease acquisition + WAL replay + recovery:
-                 shard, pid, lease epoch, recovery summary
+                 shard, pid, lease epoch, recovery summary. An ADOPTION
+                 hello instead carries ``adopted=true`` plus the live
+                 worker's tick index / orphan-tick count — same epoch,
+                 no recovery summary (nothing was recovered; the
+                 process never died)
   ``heartbeat``  liveness beat on ``--hb-interval`` (supervisor kills +
-                 restarts a worker that misses its deadline)
+                 restarts a worker that misses its deadline); carries
+                 the cumulative ``stale_rejects`` count
   ``round``      one tick's result: duration, task/distro counts,
                  degraded reason, overload level, epoch
   ``agent_done`` harness agent step finished: dispatched / unfinished
@@ -26,14 +41,18 @@ Worker → supervisor ops:
   ``drained``    WAL flushed, populating stopped
   ``fenced``     the worker observed a superseded lease epoch and is
                  standing down (exit 75 follows)
+  ``stale_sup``  command rejected: its ``sup`` epoch is older than one
+                 already observed (split-brain guard; counted)
   ``ready`` / ``report`` — bench mode (tools/bench_sharded_plane.py)
   ``bye``        clean shutdown acknowledgement
 
 Supervisor → worker ops: ``tick``, ``agent_sim``, ``load``,
 ``handoffs``, ``release``, ``prime``, ``done``, ``status``, ``drain``,
-``shutdown``, plus bench ``go`` and the scenario backend's
-``arm_fault`` (install a PR-1 fault-plan entry at a named seam — the
-``proc_kill``/``proc_hang`` events' delivery vehicle).
+``shutdown``, ``adopt`` (take over a live worker on its control
+socket — answered with the adoption ``hello``), plus bench ``go`` and
+the scenario backend's ``arm_fault`` (install a PR-1 fault-plan entry
+at a named seam — the ``proc_kill``/``proc_hang`` events' delivery
+vehicle).
 """
 from __future__ import annotations
 

@@ -18,11 +18,27 @@ never needs to know *what* the worker was doing when it died — the
 epoch fence makes the restart safe, the startup recovery pass + the
 supervisor's handoff reconciliation make it convergent.
 
+**Surviving its own death** (ISSUE 14). The supervisor is fenced and
+replaceable exactly like its workers: it holds a fleet-scope
+``FileLease`` (storage/lease.py ``supervisor_lease_path``) whose epoch
+stamps every command it sends (``sup``); workers reject anything
+stamped older than the highest epoch they have seen (``stale_sup``),
+so two supervisors can never split-brain the fleet and a deposed one
+stands down without touching the workers (they belong to its
+successor). A supervisor crash no longer kills the fleet: workers go
+**orphan** on stdin EOF (keep their leases, tick locally for a bounded
+grace) and the restarted supervisor **adopts** them over their
+per-shard control sockets via the fleet manifest
+(runtime/manifest.py) — no respawn, no shard-lease epoch bump, no
+recovery pass, resident planes stay warm — then runs
+``reconcile_handoffs`` first thing, so a supervisor killed between the
+release and prime legs of a handoff converges to exactly-one-owner.
+
 **Degradation rows** (ARCHITECTURE.md "Fleet runtime"): a crashed
 worker's shard misses rounds until the restart lands (bounded by
-backoff + lease TTL); a crashed supervisor leaves workers running —
-they exit on stdin EOF, release their leases, and a new supervisor
-reopens the fleet cold; a heartbeat partition (worker alive but pipe
+backoff + lease TTL); a crashed supervisor leaves workers running in
+orphan mode until adoption (worst case: the orphan grace expires and
+they drain + release); a heartbeat partition (worker alive but pipe
 wedged) is indistinguishable from a hang and resolves the same way —
 kill, restart, fence.
 """
@@ -30,6 +46,7 @@ from __future__ import annotations
 
 import os
 import random
+import signal
 import subprocess
 import sys
 import threading
@@ -40,7 +57,12 @@ from typing import Dict, List, Optional
 from ..utils import metrics as _metrics
 from ..utils.log import get_logger
 from ..utils.retry import Deadline, RetryPolicy
+from . import manifest as manifest_mod
 from .protocol import EXIT_CRASHED, parse_line, send_msg
+
+#: synthetic exit code for an adopted worker found dead: its real exit
+#: status went to the dead supervisor (or init) — unobservable here
+EXIT_GONE = 113
 
 FLEET_RESTARTS = _metrics.counter(
     "scheduler_fleet_restarts_total",
@@ -76,6 +98,32 @@ FLEET_WORKERS_UP = _metrics.gauge(
     "1 while the shard's worker process is ready (hello received, "
     "heartbeats current), else 0.",
     labels=("shard",),
+)
+FLEET_ADOPTIONS = _metrics.counter(
+    "scheduler_fleet_adoptions_total",
+    "Live shard workers adopted by a (re)starting supervisor over "
+    "their control sockets instead of being cold-respawned (no "
+    "shard-lease epoch bump, no recovery pass), labeled by shard.",
+    labels=("shard",),
+)
+FLEET_ORPHANED = _metrics.counter(
+    "scheduler_fleet_orphaned_workers_total",
+    "Adopted workers that had entered orphan mode (supervisor died, "
+    "worker kept its lease and ticked locally until adoption), "
+    "labeled by shard.",
+    labels=("shard",),
+)
+FLEET_STALE_REJECTS = _metrics.counter(
+    "scheduler_fleet_stale_supervisor_rejects_total",
+    "Commands a worker rejected because they carried a superseded "
+    "supervisor fencing epoch (split-brain guard; reported through "
+    "worker heartbeats), labeled by shard.",
+    labels=("shard",),
+)
+FLEET_SUP_EPOCH = _metrics.gauge(
+    "scheduler_fleet_supervisor_epoch",
+    "This supervisor's fleet-lease fencing epoch (0 until the fleet "
+    "lease is acquired; monotone across supervisor restarts).",
 )
 
 _LEVELS = {"green": 0, "yellow": 1, "red": 2, "black": 3}
@@ -116,18 +164,86 @@ class WorkerHandle:
         self.garbage_lines = 0
         self.fenced_reason = ""
         self.pid = 0
+        #: supervisor fencing epoch stamped on every command sent
+        self.sup_epoch = 0
+        #: adoption transport (no Popen): socket + its file pair
+        self.conn = None
+        self._conn_w = None
+        self._conn_r = None
+        self.adopted = False
+        self.adopt_hello: Dict = {}
+        self.orphan = False
+        self.stale_rejects = 0
 
     @property
     def epoch(self) -> int:
         return self.epochs[-1] if self.epochs else 0
 
+    def _pid_gone(self) -> bool:
+        """True when the adopted worker's pid is gone. Reaps the zombie
+        first when we happen to be its parent (the in-process harness
+        re-adopts workers the same test process spawned)."""
+        try:
+            done, _ = os.waitpid(self.pid, os.WNOHANG)
+            if done == self.pid:
+                return True
+        except (ChildProcessError, OSError):
+            pass
+        try:
+            os.kill(self.pid, 0)
+            return False
+        except OSError:
+            return True
+
     def alive(self) -> bool:
-        return self.proc is not None and self.proc.poll() is None
+        if self.proc is not None:
+            return self.proc.poll() is None
+        if self.conn is not None and self.pid:
+            return not self._pid_gone()
+        return False
+
+    def poll_exit(self) -> Optional[int]:
+        """Exit code when the worker process is gone, else None.
+        Adopted workers report the synthetic ``EXIT_GONE`` — their real
+        status was delivered to the dead supervisor, not us."""
+        if self.proc is not None:
+            return self.proc.poll()
+        if self.conn is not None and self.pid:
+            return EXIT_GONE if self._pid_gone() else None
+        return None
+
+    def kill(self) -> None:
+        try:
+            if self.proc is not None:
+                self.proc.kill()
+            elif self.pid:
+                os.kill(self.pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+    def close_conn(self) -> None:
+        for f in (self._conn_w, self._conn_r, self.conn):
+            if f is None:
+                continue
+            try:
+                f.close()
+            except (OSError, ValueError):
+                pass
+        self.conn = self._conn_w = self._conn_r = None
+        self.adopted = False
 
     def send(self, **msg) -> bool:
         if not self.alive():
             return False
-        return send_msg(self.proc.stdin, self.send_lock, **msg)
+        if self.sup_epoch and "sup" not in msg:
+            # every command carries the supervisor fencing epoch —
+            # workers reject anything stamped older than the highest
+            # they have observed
+            msg["sup"] = self.sup_epoch
+        w = self.proc.stdin if self.proc is not None else self._conn_w
+        if w is None:
+            return False
+        return send_msg(w, self.send_lock, **msg)
 
     def next_req(self) -> int:
         self._req_counter += 1
@@ -155,7 +271,7 @@ class WorkerHandle:
                 req is None or msg.get("req") == req
             ):
                 return msg
-            if msg["op"] in ("fenced", "error") and (
+            if msg["op"] in ("fenced", "error", "stale_sup") and (
                 req is None
                 or msg.get("req") is None  # unsolicited (dying worker)
                 or msg.get("req") == req
@@ -187,6 +303,10 @@ class FleetSupervisor:
         spawn_hang: Optional[Dict[int, str]] = None,
         front_store=None,
         worker_stderr: str = "inherit",
+        orphan_grace_s: float = 300.0,
+        orphan_tick_s: Optional[float] = None,
+        supervisor_lease_ttl_s: float = 5.0,
+        adopt: bool = True,
     ) -> None:
         self.data_dir = data_dir
         self.n_shards = n_shards
@@ -228,6 +348,28 @@ class FleetSupervisor:
         #: flows to the parent's stderr; "devnull" — silenced (test
         #: harnesses whose induced crashes would spam the output)
         self.worker_stderr = worker_stderr
+        #: how long a worker outlives a dead supervisor (orphan mode:
+        #: lease kept, local ticks) before draining; 0 restores the
+        #: pre-adoption exit-on-EOF behavior
+        self.orphan_grace_s = orphan_grace_s
+        self.orphan_tick_s = (
+            orphan_tick_s if orphan_tick_s is not None else tick_s
+        )
+        #: fleet-lease TTL = worst-case takeover latency after a
+        #: supervisor death (the successor steals once it goes stale)
+        self.supervisor_lease_ttl_s = supervisor_lease_ttl_s
+        #: False disables manifest adoption (always cold-spawn)
+        self.adopt_enabled = adopt
+        #: generous: a successor legitimately waits out a dead
+        #: predecessor's lease TTL (tests shrink this)
+        self.fleet_acquire_timeout_s = max(
+            30.0, supervisor_lease_ttl_s * 10.0
+        )
+        self.fleet_lease = None
+        self.deposed = False
+        self.crashed = False
+        self.adoptions_total = 0
+        self.orphaned_total = 0
         self.handles: Dict[int, WorkerHandle] = {
             k: WorkerHandle(k, self.hb_deadline_s)
             for k in range(n_shards)
@@ -250,6 +392,7 @@ class FleetSupervisor:
     # -- spawning --------------------------------------------------------- #
 
     def _worker_cmd(self, shard: int, first: bool) -> List[str]:
+        h = self.handles[shard]
         cmd = [
             sys.executable, "-m", "evergreen_tpu.runtime.worker",
             "--data-dir", self.data_dir,
@@ -260,6 +403,13 @@ class FleetSupervisor:
             # a replacement steals the dead holder's lease after TTL;
             # give the acquire poll ample room past it
             "--lease-timeout", str(max(60.0, self.ttl_s * 10.0)),
+            # supervisor fencing + survivability: the worker rejects
+            # commands stamped older than this epoch, and outlives a
+            # dead supervisor for the orphan grace
+            "--sup-epoch", str(self.sup_epoch),
+            "--generation", str(h.generation),
+            "--orphan-grace", str(self.orphan_grace_s),
+            "--orphan-tick-s", str(self.orphan_tick_s),
         ]
         if self.harness:
             cmd.append("--harness")
@@ -280,6 +430,7 @@ class FleetSupervisor:
 
     def spawn(self, shard: int, first: bool = False) -> None:
         h = self.handles[shard]
+        h.close_conn()  # a respawn replaces any adopted transport
         h.state = "starting"
         h.generation += 1
         h.fenced_reason = ""
@@ -299,41 +450,205 @@ class FleetSupervisor:
         )
         h.pid = h.proc.pid
         threading.Thread(
-            target=self._reader, args=(h, h.proc),
+            target=self._reader, args=(h, h.proc.stdout),
             daemon=True, name=f"fleet-read-{shard}",
         ).start()
 
-    def _reader(self, h: WorkerHandle, proc: subprocess.Popen) -> None:
-        for line in proc.stdout:
-            msg = parse_line(line)
-            if msg is None:
-                h.garbage_lines += 1
-                continue
-            op = msg["op"]
-            if op == "heartbeat":
-                h.hb_deadline = Deadline.after(h.hb_deadline_s)
-                continue
-            if op == "hello":
-                h.epochs.append(int(msg.get("epoch", 0)))
-                h.hb_deadline = Deadline.after(h.hb_deadline_s)
-                h.state = "ready"
-                h.ready_since = _time.monotonic()
-                FLEET_WORKERS_UP.set(1, shard=h.shard)
+    def _reader(self, h: WorkerHandle, rfile) -> None:
+        """Drain one worker channel (spawn stdout or adoption socket):
+        heartbeats refresh the deadline in place, everything else lands
+        on the reply queue for whoever is mid-request."""
+        try:
+            for line in rfile:
+                msg = parse_line(line)
+                if msg is None:
+                    h.garbage_lines += 1
+                    continue
+                op = msg["op"]
+                if op == "heartbeat":
+                    h.hb_deadline = Deadline.after(h.hb_deadline_s)
+                    h.orphan = bool(msg.get("orphan"))
+                    n = int(msg.get("stale_rejects", 0) or 0)
+                    if n > h.stale_rejects:
+                        FLEET_STALE_REJECTS.inc(
+                            n - h.stale_rejects, shard=h.shard
+                        )
+                        h.stale_rejects = n
+                    continue
+                if op == "hello":
+                    h.epochs.append(int(msg.get("epoch", 0)))
+                    h.hb_deadline = Deadline.after(h.hb_deadline_s)
+                    if msg.get("adopted"):
+                        h.adopted = True
+                        h.adopt_hello = dict(msg)
+                        h.orphan = False
+                        h.stale_rejects = int(
+                            msg.get("stale_rejects", 0) or 0
+                        )
+                        self.adoptions_total += 1
+                        FLEET_ADOPTIONS.inc(shard=h.shard)
+                        if msg.get("orphaned"):
+                            self.orphaned_total += 1
+                            FLEET_ORPHANED.inc(shard=h.shard)
+                    h.state = "ready"
+                    h.ready_since = _time.monotonic()
+                    FLEET_WORKERS_UP.set(1, shard=h.shard)
+                    self._log.info(
+                        "fleet-worker-ready", shard=h.shard,
+                        epoch=h.epoch, pid=msg.get("pid"),
+                        adopted=bool(msg.get("adopted")),
+                    )
+                    continue
+                if op == "fenced":
+                    h.fenced_reason = str(msg.get("reason", ""))
+                if op == "stale_sup":
+                    # a worker answering US with stale_sup has seen a
+                    # newer supervisor epoch: we have been deposed
+                    if int(msg.get("sup_seen", 0) or 0) > self.sup_epoch:
+                        self._fleet_deposed(
+                            "a worker observed a newer supervisor epoch"
+                        )
+                h.replies.put(msg)
+        except (OSError, ValueError):
+            pass  # channel torn down under us (simulate_crash, stop)
+
+    # -- fleet lease (supervisor fencing) ---------------------------------- #
+
+    @property
+    def sup_epoch(self) -> int:
+        return (
+            self.fleet_lease.epoch
+            if self.fleet_lease is not None else 0
+        )
+
+    def _acquire_fleet_lease(self) -> None:
+        from ..storage.lease import FileLease, supervisor_lease_path
+
+        if self.fleet_lease is not None:
+            return
+        lease = FileLease(
+            supervisor_lease_path(self.data_dir),
+            ttl_s=self.supervisor_lease_ttl_s,
+        )
+        # a dead predecessor's lease goes stale after its TTL and is
+        # stolen at a strictly higher epoch; a LIVE holder keeps
+        # renewing and this acquire times out — refuse to run
+        if not lease.acquire(
+            timeout_s=self.fleet_acquire_timeout_s, poll_s=0.1,
+        ):
+            raise RuntimeError(
+                "another supervisor holds the fleet lease for "
+                f"{self.data_dir!r} — refusing to split-brain the fleet"
+            )
+        lease.start_renewing(on_lost=self._fleet_deposed)
+        self.fleet_lease = lease
+        for h in self.handles.values():
+            h.sup_epoch = lease.epoch
+        FLEET_SUP_EPOCH.set(lease.epoch)
+        self._log.info(
+            "fleet-lease-acquired", epoch=lease.epoch,
+            data_dir=self.data_dir,
+        )
+
+    def _fleet_deposed(self, reason: str = "fleet lease lost") -> None:
+        """A newer supervisor owns the fleet: stand down WITHOUT
+        touching the workers — they belong to the successor now (it
+        adopts them; killing them would be sabotage)."""
+        if self.deposed:
+            return
+        self.deposed = True
+        self._stop.set()
+        self._log.error(
+            "fleet-supervisor-deposed", reason=reason,
+            epoch=self.sup_epoch,
+        )
+
+    # -- adoption ----------------------------------------------------------- #
+
+    def _try_adopt(self, shard: int) -> bool:
+        """Adopt a live worker from the fleet manifest instead of
+        cold-spawning it: validate the recorded pid, connect to its
+        control socket, send ``adopt`` at our fencing epoch, and wait
+        for the adoption hello (same shard-lease epoch, no recovery).
+        Any failure falls back to the cold spawn."""
+        entry = manifest_mod.read_entry(self.data_dir, shard)
+        if entry is None:
+            return False
+        pid = int(entry.get("pid", 0) or 0)
+        sock_path = str(entry.get("sock", "") or "")
+        if not pid or not sock_path:
+            return False
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            # stale entry from a crashed worker: clean it up
+            manifest_mod.remove_entry(self.data_dir, shard, sock_path)
+            return False
+        try:
+            conn = manifest_mod.connect(sock_path, timeout_s=5.0)
+        except OSError:
+            return False
+        h = self.handles[shard]
+        h.generation += 1
+        h.pid = pid
+        h.proc = None
+        h.conn = conn
+        h._conn_w = conn.makefile("w", encoding="utf-8")
+        h._conn_r = conn.makefile("r", encoding="utf-8")
+        h.sup_epoch = self.sup_epoch
+        h.state = "starting"
+        h.hb_deadline = Deadline.after(
+            max(self.hb_deadline_s, 5.0)
+        )
+        threading.Thread(
+            target=self._reader, args=(h, h._conn_r),
+            daemon=True, name=f"fleet-adopt-read-{shard}",
+        ).start()
+        req = h.next_req()
+        if not h.send(op="adopt", req=req):
+            h.close_conn()
+            h.state = "new"
+            return False
+        deadline = Deadline.after(10.0)
+        while not deadline.exceeded():
+            if h.state == "ready" and h.adopted:
                 self._log.info(
-                    "fleet-worker-ready", shard=h.shard,
-                    epoch=h.epoch, pid=msg.get("pid"),
+                    "fleet-worker-adopted", shard=shard, pid=pid,
+                    epoch=h.epoch,
+                    orphan_ticks=h.adopt_hello.get("orphan_ticks", 0),
                 )
-                continue
-            if op == "fenced":
-                h.fenced_reason = str(msg.get("reason", ""))
-            h.replies.put(msg)
+                return True
+            _time.sleep(0.05)
+        # the worker may have PROCESSED the adopt without answering in
+        # time (wedged mid-tick): it would keep the shard lease through
+        # its whole orphan grace while our cold spawn blocks on the
+        # acquire — kill it first, exactly what the hang deadline would
+        # do, so the replacement steals cleanly after one TTL
+        h.close_conn()
+        self._log.error(
+            "fleet-adopt-timeout", shard=shard, pid=pid,
+        )
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+        manifest_mod.remove_entry(self.data_dir, shard, sock_path)
+        h.state = "new"
+        return False
 
     def start(self, monitor: bool = True,
               ready_timeout_s: float = 120.0) -> None:
-        """Spawn every worker, wait for the fleet to report ready, then
-        reconcile any mid-flight handoffs the previous incarnation left
-        behind. ``monitor=True`` starts the background watchdog."""
+        """Acquire the fleet lease (fencing epoch for every command),
+        ADOPT any live workers a dead predecessor left behind, spawn
+        the rest, wait for the fleet to report ready, then reconcile
+        any mid-flight handoffs the previous incarnation left behind
+        (a supervisor killed between the release and prime legs
+        converges to exactly-one-owner right here).
+        ``monitor=True`` starts the background watchdog."""
+        self._acquire_fleet_lease()
         for k in range(self.n_shards):
+            if self.adopt_enabled and self._try_adopt(k):
+                continue
             self.spawn(k, first=True)
         self.wait_all_ready(timeout_s=ready_timeout_s)
         self.reconcile_handoffs()
@@ -375,10 +690,12 @@ class FleetSupervisor:
     def monitor_once(self) -> None:
         """One watchdog pass: reap exits, kill hangs, respawn due
         workers (exposed for deterministic tests)."""
+        if self.deposed or self.crashed:
+            return  # the workers belong to our successor
         for h in self.handles.values():
             if h.state in ("stopping", "stopped"):
                 continue
-            rc = h.proc.poll() if h.proc is not None else None
+            rc = h.poll_exit()
             if h.state == "backoff":
                 if _time.monotonic() >= h.next_spawn_at:
                     h.restarts += 1
@@ -401,10 +718,7 @@ class FleetSupervisor:
                     "fleet-worker-hang", shard=h.shard,
                     state=h.state, deadline_s=h.hb_deadline_s,
                 )
-                try:
-                    h.proc.kill()
-                except OSError:
-                    pass
+                h.kill()
 
     #: a worker that stayed ready this long before dying is treated as
     #: having recovered — its NEXT restart starts the backoff ladder
@@ -453,6 +767,8 @@ class FleetSupervisor:
         and the watchdog brings the rest back."""
         from ..utils.tracing import Tracer
 
+        if self.deposed or self.crashed:
+            return {}  # a stood-down supervisor commands nobody
         now = _time.time() if now is None else now
         with self._round_lock:
             if self._needs_reconcile:
@@ -501,6 +817,8 @@ class FleetSupervisor:
     def broadcast(self, op: str, reply_op: str,
                   timeout_s: float = 30.0, **fields) -> Dict[int, dict]:
         out: Dict[int, dict] = {}
+        if self.deposed or self.crashed:
+            return out
         ready = [h for h in self.handles.values() if h.state == "ready"]
         reqs = {}
         for h in ready:
@@ -534,6 +852,8 @@ class FleetSupervisor:
         next reconciliation converges (exactly-one-owner)."""
         if src == dst:
             raise ValueError(f"{distro_id} already on shard {dst}")
+        if self.deposed or self.crashed:
+            return None
         hs, hd = self.handles[src], self.handles[dst]
         if hs.state != "ready" or hd.state != "ready":
             return None
@@ -706,13 +1026,49 @@ class FleetSupervisor:
         work' step)."""
         return self.broadcast("drain", "drained", timeout_s=timeout_s)
 
+    def simulate_crash(self) -> None:
+        """Harness hook (scenarios/procs.py ``sup_kill``): die the way
+        SIGKILL would. Threads stop (they would die with the process),
+        worker pipes close (the kernel would close them — workers see
+        stdin EOF and go orphan), and the fleet lease is ABANDONED, not
+        released, so the successor must steal it at a strictly higher
+        epoch exactly like a real supervisor death."""
+        self.crashed = True
+        self._stop.set()
+        if self.fleet_lease is not None:
+            # only the renewer thread stops — the file stays, goes
+            # stale after its TTL, and is stolen by the successor
+            self.fleet_lease.stop_renewing()
+        for h in self.handles.values():
+            h.state = "stopped"
+            if h.proc is not None:
+                for f in (h.proc.stdin, h.proc.stdout):
+                    try:
+                        f.close()
+                    except (OSError, ValueError):
+                        pass
+            h.close_conn()
+
     def stop(self, graceful: bool = True,
              timeout_s: float = 30.0) -> None:
         """Stop the fleet: drain + shutdown (workers checkpoint,
         release their shard leases, exit 0), then reap; anything still
         alive past the timeout is killed — its successor will steal the
-        lease, so even the ungraceful path stays fenced."""
+        lease, so even the ungraceful path stays fenced. A DEPOSED
+        supervisor instead detaches: the workers belong to its
+        successor, so it closes its channels and leaves them running."""
         self._stop.set()
+        if self.deposed:
+            for h in self.handles.values():
+                h.state = "stopped"
+                if h.proc is not None:
+                    for f in (h.proc.stdin, h.proc.stdout):
+                        try:
+                            f.close()
+                        except (OSError, ValueError):
+                            pass
+                h.close_conn()
+            return
         for h in self.handles.values():
             h.state = "stopping"
         if graceful:
@@ -720,18 +1076,32 @@ class FleetSupervisor:
             self.handles_shutdown(per)
         deadline = Deadline.after(timeout_s)
         for h in self.handles.values():
-            if h.proc is None:
-                continue
-            try:
-                h.proc.wait(timeout=max(0.1, deadline.remaining()))
-            except subprocess.TimeoutExpired:
-                h.proc.kill()
+            if h.proc is not None:
                 try:
-                    h.proc.wait(timeout=5.0)
+                    h.proc.wait(timeout=max(0.1, deadline.remaining()))
                 except subprocess.TimeoutExpired:
-                    pass
+                    h.proc.kill()
+                    try:
+                        h.proc.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        pass
+            elif h.conn is not None and h.pid:
+                # adopted worker: no Popen to wait on — poll the pid
+                while (
+                    not h._pid_gone() and not deadline.exceeded()
+                ):
+                    _time.sleep(0.05)
+                if not h._pid_gone():
+                    h.kill()
+            h.close_conn()
             FLEET_WORKERS_UP.set(0, shard=h.shard)
             h.state = "stopped"
+        if self.fleet_lease is not None:
+            try:
+                self.fleet_lease.release()
+            except OSError:
+                pass
+            self.fleet_lease = None
 
     def handles_shutdown(self, timeout_s: float) -> None:
         for h in self.handles.values():
@@ -765,6 +1135,10 @@ class FleetSupervisor:
                     h.state == "ready" and h.hb_deadline.exceeded()
                 ),
                 "garbage_lines": h.garbage_lines,
+                "adopted": h.adopted,
+                "orphan": h.orphan,
+                "orphan_ticks": h.adopt_hello.get("orphan_ticks", 0),
+                "stale_rejects": h.stale_rejects,
             }
         return {
             "n_shards": self.n_shards,
@@ -776,6 +1150,10 @@ class FleetSupervisor:
             "restarts_total": sum(
                 h.restarts for h in self.handles.values()
             ),
+            "supervisor_epoch": self.sup_epoch,
+            "deposed": self.deposed,
+            "adoptions_total": self.adoptions_total,
+            "orphaned_total": self.orphaned_total,
         }
 
 

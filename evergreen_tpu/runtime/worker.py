@@ -22,12 +22,27 @@ supervisor over stdin, one newline-JSON message per line
     ``shutdown`` additionally checkpoints, releases the lease and
     exits 0.
 
-A heartbeat thread beats on stdout every ``--hb-interval``; the
-supervisor treats a missed deadline as a hang and SIGKILLs + restarts.
-Any observation of a superseded lease epoch (a fenced commit, a lost
-renewal) makes the worker print ``fenced`` and exit 75/70 — the PR-3
-stand-down, now a process exit the supervisor turns into a fenced
+A heartbeat thread beats on the active channel every ``--hb-interval``;
+the supervisor treats a missed deadline as a hang and SIGKILLs +
+restarts. Any observation of a superseded lease epoch (a fenced commit,
+a lost renewal) makes the worker print ``fenced`` and exit 75/70 — the
+PR-3 stand-down, now a process exit the supervisor turns into a fenced
 restart at a strictly higher epoch.
+
+**Surviving the supervisor** (ISSUE 14). stdin EOF — the supervisor
+died — no longer kills the worker. With ``--orphan-grace G`` > 0 it
+goes **orphan**: it keeps renewing its shard lease and drives
+autonomous LOCAL ticks (no handoffs, no rebalance, no stacked rounds —
+everything that needs a coordinator) on the ``--orphan-tick-s``
+cadence, for at most G seconds; at expiry it drains and releases
+exactly like the old EOF path. Meanwhile it has been listening on a
+per-shard unix-domain control socket recorded in the fleet manifest
+(runtime/manifest.py), so a restarted supervisor can ``adopt`` it —
+same process, same shard-lease epoch, no recovery pass, resident plane
+still warm. Every supervisor command carries the supervisor-lease
+fencing epoch (``sup``); anything stamped older than the highest epoch
+this worker has observed is answered ``stale_sup`` and NOT executed —
+the split-brain guard for the control plane itself.
 
 ``--bench`` mode is the promoted tools/bench_sharded_plane.py inline
 worker: an in-memory store seeded with the shard's slice of the
@@ -48,8 +63,10 @@ import os
 import sys
 import threading
 import time as _time
+from queue import Empty, Queue
 from typing import List, Optional
 
+from . import manifest
 from .protocol import EXIT_FENCED, EXIT_LOST, parse_line, send_msg
 
 
@@ -74,6 +91,18 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="seam@index fault-plan crash kill point")
     p.add_argument("--hang", default="",
                    help="seam:delay_s always-hang fault")
+    p.add_argument("--sup-epoch", type=int, default=0,
+                   help="spawning supervisor's fencing epoch; commands "
+                        "stamped with an older 'sup' are rejected")
+    p.add_argument("--generation", type=int, default=0,
+                   help="supervisor spawn generation (recorded in the "
+                        "fleet manifest)")
+    p.add_argument("--orphan-grace", type=float, default=0.0,
+                   help="seconds to keep serving after stdin EOF "
+                        "(orphan mode; 0 = release and exit "
+                        "immediately, the pre-adoption behavior)")
+    p.add_argument("--orphan-tick-s", type=float, default=15.0,
+                   help="autonomous local-tick cadence while orphaned")
     # bench mode (tools/bench_sharded_plane.py)
     p.add_argument("--bench", action="store_true")
     p.add_argument("--bench-distros", type=int, default=200)
@@ -119,19 +148,62 @@ def _live_fault_plan():
 # --------------------------------------------------------------------------- #
 
 
+class _Channel:
+    """One control channel: the spawn-time stdio pair, or an accepted
+    control-socket connection (the adoption path)."""
+
+    def __init__(self, name: str, rfile, wfile, sock=None) -> None:
+        self.name = name
+        self.rfile = rfile
+        self.wfile = wfile
+        self.sock = sock
+
+    def close(self) -> None:
+        for f in (self.rfile, self.wfile, self.sock):
+            if f is None:
+                continue
+            try:
+                f.close()
+            except (OSError, ValueError):
+                pass
+
+
 class ShardWorker:
     def __init__(self, args, proto_out) -> None:
         self.args = args
-        self.out = proto_out
         self.out_lock = threading.Lock()
+        self.stdio = _Channel("stdio", sys.stdin, proto_out)
+        #: the channel replies + heartbeats go to; switched by adoption
+        self.active = self.stdio
+        self.inbox: Queue = Queue()
         self.shard = args.shard
         self.n_shards = args.shards
         self.tick_index = 0
         self.last_round_ms = 0.0
+        #: last supervisor-commanded tick 'now' — orphan-mode ticks
+        #: extend THIS clock so a harness's virtual timeline stays
+        #: coherent across a supervisor outage
+        self.last_now = 0.0
         self.draining = False
         self._hb_stop = threading.Event()
         self.store = None
         self.lease = None
+        #: highest supervisor fencing epoch observed; commands stamped
+        #: older are rejected (split-brain guard)
+        self.sup_epoch = int(getattr(args, "sup_epoch", 0) or 0)
+        self.stale_rejects = 0
+        self.adoptions = 0
+        #: recovery passes this process has EVER run (1 = boot only);
+        #: the adoption hello reports it so 'adoption ran no recovery'
+        #: is a checkable claim, not an inference from pid continuity
+        self.recovery_passes = 0
+        #: orphan-mode state: monotonic entry time (None = attached)
+        self.orphaned_at: Optional[float] = None
+        self._orphan_deadline = 0.0
+        self._next_orphan_tick = 0.0
+        self.orphan_ticks = 0
+        self.listener = None
+        self.sock_path = ""
         #: request id of the command currently being handled — echoed
         #: on every reply so the supervisor can pair answers with
         #: requests across timeouts and respawns
@@ -142,7 +214,7 @@ class ShardWorker:
     def send(self, **msg) -> bool:
         if self._req is not None and "req" not in msg:
             msg["req"] = self._req
-        return send_msg(self.out, self.out_lock, **msg)
+        return send_msg(self.active.wfile, self.out_lock, **msg)
 
     def open(self) -> None:
         from ..scheduler.recovery import run_recovery_pass
@@ -172,6 +244,12 @@ class ShardWorker:
         report = run_recovery_pass(
             self.store, now=self.args.recovery_now or None
         )
+        self.recovery_passes += 1
+        # re-attachable control socket + manifest entry BEFORE hello:
+        # from the first ready moment on, a restarted supervisor can
+        # find and adopt this worker
+        self._start_listener()
+        self._write_manifest()
         self.send(
             op="hello", shard=self.shard, pid=os.getpid(),
             epoch=lease.epoch,
@@ -184,23 +262,201 @@ class ShardWorker:
 
     def _deposed(self) -> None:  # renewer thread
         self.send(op="fenced", shard=self.shard, reason="lease-lost")
+        self._cleanup_manifest()
         os._exit(EXIT_LOST)
 
     def _fenced_exit(self, reason: str) -> None:
         self.send(op="fenced", shard=self.shard, reason=reason)
+        self._cleanup_manifest()
         os._exit(EXIT_FENCED)
 
     def start_heartbeat(self) -> None:
         def beat():
             while not self._hb_stop.wait(self.args.hb_interval):
-                if not self.send(
-                    op="heartbeat", shard=self.shard, ts=_time.time()
-                ):
-                    return  # supervisor gone; the stdin EOF path exits
+                # a failed send (dead supervisor) is NOT an exit: the
+                # orphan path keeps the worker alive for adoption and
+                # beats resume on the adopted channel
+                self.send(
+                    op="heartbeat", shard=self.shard, ts=_time.time(),
+                    stale_rejects=self.stale_rejects,
+                    orphan=self.orphaned_at is not None,
+                )
 
         threading.Thread(
             target=beat, daemon=True, name=f"shard{self.shard}-hb"
         ).start()
+
+    # -- manifest + control socket (runtime/manifest.py) ------------------ #
+
+    def _start_listener(self) -> None:
+        import socket as socket_mod
+
+        if not self.args.data_dir:
+            return
+        path = manifest.socket_path(self.args.data_dir, self.shard)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        srv = socket_mod.socket(
+            socket_mod.AF_UNIX, socket_mod.SOCK_STREAM
+        )
+        srv.bind(path)
+        try:
+            os.chmod(path, 0o600)
+        except OSError:
+            pass
+        srv.listen(4)
+        self.listener = srv
+        self.sock_path = path
+
+        def accept_loop():
+            n = 0
+            while True:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return  # listener closed: shutting down
+                n += 1
+                chan = _Channel(
+                    f"sock{n}",
+                    conn.makefile("r", encoding="utf-8"),
+                    conn.makefile("w", encoding="utf-8"),
+                    sock=conn,
+                )
+                self._start_channel_reader(chan)
+
+        threading.Thread(
+            target=accept_loop, daemon=True,
+            name=f"shard{self.shard}-accept",
+        ).start()
+
+    def _write_manifest(self) -> None:
+        if not self.sock_path:
+            return
+        manifest.write_entry(
+            self.args.data_dir, self.shard, pid=os.getpid(),
+            sock=self.sock_path, generation=self.args.generation,
+            epoch=self.lease.epoch if self.lease else 0,
+        )
+
+    def _cleanup_manifest(self) -> None:
+        if self.listener is not None:
+            try:
+                self.listener.close()
+            except OSError:
+                pass
+            self.listener = None
+        if self.args.data_dir and self.sock_path:
+            manifest.remove_entry(
+                self.args.data_dir, self.shard, self.sock_path
+            )
+
+    def _start_channel_reader(self, chan: _Channel) -> None:
+        def read():
+            try:
+                for line in chan.rfile:
+                    msg = parse_line(line)
+                    if msg is None:
+                        continue  # torn/garbage line: skip, never die
+                    self.inbox.put(("cmd", msg, chan))
+            except (OSError, ValueError):
+                pass
+            self.inbox.put(("eof", None, chan))
+
+        threading.Thread(
+            target=read, daemon=True,
+            name=f"shard{self.shard}-read-{chan.name}",
+        ).start()
+
+    # -- supervisor fencing + adoption ------------------------------------ #
+
+    def _reject_stale(self, msg: dict, chan: _Channel,
+                      reason: str) -> None:
+        """Answer (and count) a command from a superseded — or never
+        adopted — supervisor. The command is NOT executed; the sender
+        reads the reject as evidence it has been deposed."""
+        self.stale_rejects += 1
+        send_msg(
+            chan.wfile, self.out_lock, op="stale_sup",
+            shard=self.shard, req=msg.get("req"),
+            rejected_op=msg.get("op"), reason=reason,
+            sup_seen=self.sup_epoch, got=msg.get("sup"),
+        )
+
+    def _handle_adopt(self, msg: dict, chan: _Channel) -> None:
+        """A (re)connecting supervisor takes this live worker over: no
+        respawn, no shard-lease epoch bump, no recovery pass — the
+        adoption hello proves process continuity (pid + tick index).
+
+        A NEW channel must present a STRICTLY higher supervisor epoch:
+        a legitimate successor always steals the fleet lease at one,
+        while a rogue that merely read the current lease file can
+        replay only the current epoch — equal-epoch adoption over a
+        foreign channel would let it hijack the active channel without
+        ever holding the lease. Re-adoption over the already-active
+        channel (same supervisor) may carry the same epoch."""
+        sup = int(msg.get("sup", 0) or 0)
+        if sup < self.sup_epoch or (
+            chan is not self.active and sup == self.sup_epoch
+        ):
+            self._reject_stale(msg, chan, reason="stale-epoch")
+            return
+        self.sup_epoch = sup
+        was_orphan = self.orphaned_at is not None
+        self.orphaned_at = None
+        old = self.active
+        self.active = chan
+        self.adoptions += 1
+        if old is not None and old is not chan and old is not self.stdio:
+            old.close()  # a superseded adoption socket
+        self._write_manifest()
+        self.send(
+            op="hello", req=msg.get("req"), shard=self.shard,
+            pid=os.getpid(), epoch=self.lease.epoch, adopted=True,
+            orphaned=was_orphan, orphan_ticks=self.orphan_ticks,
+            tick=self.tick_index, stale_rejects=self.stale_rejects,
+            recovery_passes=self.recovery_passes,
+        )
+
+    # -- orphan mode ------------------------------------------------------- #
+
+    def _enter_orphan(self) -> None:
+        self.orphaned_at = _time.monotonic()
+        self._orphan_deadline = (
+            self.orphaned_at + self.args.orphan_grace
+        )
+        self._next_orphan_tick = (
+            self.orphaned_at + self.args.orphan_tick_s
+        )
+        print(
+            f"shard {self.shard}: supervisor gone (stdin EOF) — "
+            f"orphan mode for {self.args.orphan_grace}s "
+            f"(lease kept, local ticks every "
+            f"{self.args.orphan_tick_s}s)",
+            file=sys.stderr,
+        )
+
+    def _autonomous_tick(self) -> None:
+        """One LOCAL tick while orphaned: same run_tick, but no
+        handoffs, no rebalance, no stacked rounds — exactly the
+        behaviors an orphan has no coordinator for."""
+        from ..scheduler.wrapper import run_tick
+
+        if self.draining:
+            return
+        self.orphan_ticks += 1
+        if self.last_now:
+            now = (
+                self.last_now
+                + self.orphan_ticks * self.args.orphan_tick_s
+            )
+        else:
+            now = _time.time()
+        res = run_tick(self.store, self.tick_options(), now=now)
+        if res.degraded == "fenced" or self.lease.lost:
+            self._fenced_exit("fenced-orphan-tick")
+        self.tick_index += 1
 
     def tick_options(self):
         from ..scheduler.wrapper import TickOptions
@@ -231,6 +487,7 @@ class ShardWorker:
                       tick=self.tick_index)
             return
         now = float(msg.get("now") or _time.time())
+        self.last_now = now
         t0 = _time.perf_counter()
         res = run_tick(self.store, self.tick_options(), now=now)
         ms = (_time.perf_counter() - t0) * 1e3
@@ -465,6 +722,7 @@ class ShardWorker:
             # final checkpoint; the lease release below still runs
             pass
         self.lease.release()
+        self._cleanup_manifest()
         self.send(op="bye", shard=self.shard)
         os._exit(0)
 
@@ -484,35 +742,88 @@ class ShardWorker:
         "shutdown": op_shutdown,
     }
 
+    def _handle_cmd(self, msg: dict, chan: _Channel) -> None:
+        op = msg.get("op")
+        if chan is not self.active:
+            # only adoption may arrive on a not-yet-adopted channel;
+            # anything else there is by definition a foreign
+            # supervisor's command (the sabotage surface)
+            if op == "adopt":
+                self._handle_adopt(msg, chan)
+            else:
+                self._reject_stale(msg, chan,
+                                   reason="channel-not-adopted")
+            return
+        sup = msg.get("sup")
+        if sup is not None:
+            sup = int(sup)
+            if sup < self.sup_epoch:
+                self._reject_stale(msg, chan, reason="stale-epoch")
+                return
+            self.sup_epoch = sup
+        if op == "adopt":  # re-adoption over the already-active channel
+            self._handle_adopt(msg, chan)
+            return
+        handler = self.OPS.get(op)
+        if handler is None:
+            self.send(op="error", req=msg.get("req"),
+                      detail=f"unknown op {op!r}")
+            return
+        self._req = msg.get("req")
+        try:
+            handler(self, msg)
+        finally:
+            self._req = None
+
     def run(self) -> int:
         from ..storage.lease import EpochFencedError
 
         self.open()
         self.start_heartbeat()
-        try:
-            for line in sys.stdin:
-                msg = parse_line(line)
-                if msg is None:
-                    continue  # torn/garbage command line: skip, never die
-                handler = self.OPS.get(msg["op"])
-                if handler is None:
-                    self.send(op="error",
-                              detail=f"unknown op {msg['op']!r}")
-                    continue
-                self._req = msg.get("req")
-                try:
-                    handler(self, msg)
-                finally:
-                    self._req = None
-        except EpochFencedError:
-            self._fenced_exit("fenced-op")
-        # stdin EOF: the supervisor died or dropped us — release and go
+        self._start_channel_reader(self.stdio)
+        while True:
+            timeout = None
+            if self.orphaned_at is not None:
+                due = min(self._orphan_deadline,
+                          self._next_orphan_tick)
+                timeout = max(0.0, due - _time.monotonic())
+            try:
+                kind, payload, chan = self.inbox.get(timeout=timeout)
+            except Empty:
+                kind, payload, chan = None, None, None
+            try:
+                if kind == "cmd":
+                    self._handle_cmd(payload, chan)
+                elif kind == "eof":
+                    if chan is self.active:
+                        if self.args.orphan_grace <= 0:
+                            break  # legacy: EOF = release and exit
+                        if self.orphaned_at is None:
+                            self._enter_orphan()
+                    elif chan is not self.stdio:
+                        chan.close()  # a dropped foreign connection
+                if self.orphaned_at is not None:
+                    now_m = _time.monotonic()
+                    if now_m >= self._orphan_deadline:
+                        break  # grace expired: drain and go
+                    if now_m >= self._next_orphan_tick:
+                        self._autonomous_tick()
+                        self._next_orphan_tick = (
+                            _time.monotonic()
+                            + self.args.orphan_tick_s
+                        )
+            except EpochFencedError:
+                self._fenced_exit("fenced-op")
+        # supervisor gone for good (EOF with orphan mode off, or the
+        # orphan grace expired un-adopted) — drain, release, exit
         self._hb_stop.set()
+        self.draining = True
         try:
             self.store.close()
         except Exception:  # noqa: BLE001 — best-effort shutdown
             pass
         self.lease.release()
+        self._cleanup_manifest()
         return 0
 
 

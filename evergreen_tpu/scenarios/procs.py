@@ -21,6 +21,18 @@ Proc specs are ordinary ``ScenarioSpec``s using the proc event kinds:
                     and restarted — the hang resolves exactly like a
                     crash, fenced at a higher epoch
   ``proc_migrate``  drive one fenced handoff over the control protocol
+  ``sup_kill``      crash the SUPERVISOR itself (``at``: "idle", or
+                    "mid_round" — kill after the tick fan-out left,
+                    before replies — or "mid_handoff" — kill between
+                    the release and prime legs of a live migration).
+                    Workers observe stdin EOF and go ORPHAN: leases
+                    kept, autonomous local ticks, bounded grace
+  ``sup_restart``   start a fresh supervisor over the same data dir:
+                    it steals the fleet lease at a strictly higher
+                    epoch, ADOPTS the orphaned workers via the fleet
+                    manifest + control sockets (same pids, same
+                    shard-lease epochs, no recovery pass) and runs
+                    ``reconcile_handoffs`` first thing
 
 Each virtual tick runs: due events → supervisor round (every live
 worker's ``run_tick``) → the deterministic agent step (complete
@@ -61,7 +73,7 @@ from .spec import Ev, SLO, ScenarioSpec, scorecard_entry_fingerprint
 #: event kinds the proc backend handles (anything else in a proc spec
 #: is a spec error — in-process events cannot reach a child's store)
 PROC_EVENT_KINDS = ("proc_fleet", "proc_kill", "proc_hang",
-                    "proc_migrate")
+                    "proc_migrate", "sup_kill", "sup_restart")
 
 #: the proc analog of spec.DEFAULT_INVARIANTS
 DEFAULT_PROC_INVARIANTS = (
@@ -167,6 +179,9 @@ class ProcScenarioRun:
                 f"events outside [0, ticks={spec.ticks}): {late}"
             )
         self.sup = None
+        #: previous supervisor incarnations (sup_kill/sup_restart):
+        #: scoring aggregates restarts/exits/epochs across ALL of them
+        self.sups: List = []
         self.data_dir: Optional[str] = None
         self.rounds: List[Dict[int, dict]] = []
         self.dispatched_total = 0
@@ -178,7 +193,7 @@ class ProcScenarioRun:
 
     # -- events ----------------------------------------------------------- #
 
-    def _apply_event(self, ev: Ev) -> None:
+    def _apply_event(self, ev: Ev, now: float) -> None:
         if ev.kind == "proc_fleet":
             return  # consumed at setup
         if ev.kind == "proc_kill":
@@ -207,6 +222,92 @@ class ProcScenarioRun:
             src = int(ev.args["from"])
             dst = int(ev.args["to"])
             self.sup.migrate(distro, src, dst)
+        elif ev.kind == "sup_kill":
+            at = ev.args.get("at", "idle")
+            if at == "mid_round":
+                # fan the tick out, then die before collecting a single
+                # reply — the workers execute it into the void
+                ready = [
+                    h for h in self.sup.handles.values()
+                    if h.state == "ready"
+                ]
+                for h in ready:
+                    h.send(op="tick", now=now, req=h.next_req())
+                self.sup.simulate_crash()
+            elif at == "mid_handoff":
+                self._release_then_crash(now)
+            else:
+                self.sup.simulate_crash()
+        elif ev.kind == "sup_restart":
+            self._restart_supervisor()
+
+    def _release_then_crash(self, now: float) -> None:
+        """Drive the RELEASE leg of a real migration, then crash the
+        supervisor before the prime leg ever leaves: the released
+        record is durable on the source, the target knows nothing —
+        the successor's post-adoption ``reconcile_handoffs`` must
+        converge it to exactly-one-owner."""
+        sup = self.sup
+        loads = sup.broadcast("load", "load")
+        src = dst = None
+        distro = None
+        for k in sorted(loads):
+            reps = loads[k].get("reps") or {}
+            if reps:
+                src = k
+                distro = sorted(reps.values())[0]
+                dst = next(
+                    j for j in range(self.n_shards) if j != k
+                )
+                break
+        if distro is None:  # nothing to move: degrade to a plain kill
+            sup.simulate_crash()
+            return
+        hs = sup.handles[src]
+        sup._seq += 1
+        req = hs.next_req()
+        hs.send(op="release", distro=distro, target=dst,
+                seq=sup._seq, now=now, req=req)
+        hs.wait_reply("released", 60.0, req=req)
+        sup.simulate_crash()
+
+    def _restart_supervisor(self) -> None:
+        """The successor: a fresh supervisor over the same data dir —
+        steals the fleet lease at a higher epoch, adopts the orphans,
+        reconciles. Adoption quality is scored: zero shard-lease epoch
+        bumps, zero pid changes, zero recovery passes."""
+        old = self.sup
+        pre = {k: (h.pid, h.epoch) for k, h in old.handles.items()}
+        self.sups.append(old)
+        sup2 = self._build_supervisor()
+        sup2.start()
+        self.sup = sup2
+        adopted = [
+            k for k, h in sup2.handles.items() if h.adopted
+        ]
+
+        def bump(key: str, by: int) -> None:
+            self.stats[key] = self.stats.get(key, 0) + by
+
+        bump("sup_restarts", 1)
+        bump("adoptions_total", len(adopted))
+        bump("adoption_epoch_bumps", sum(
+            1 for k in adopted if sup2.handles[k].epoch != pre[k][1]
+        ))
+        bump("adoption_pid_changes", sum(
+            1 for k in adopted if sup2.handles[k].pid != pre[k][0]
+        ))
+        # the worker counts every recovery pass it has EVER run; an
+        # adopted process must still be at its single boot-time pass
+        bump("adoption_recoveries", sum(
+            1 for k in adopted
+            if sup2.handles[k].adopt_hello.get("recovery_passes", 1)
+            > 1
+        ))
+        bump("orphan_adoptions", sum(
+            1 for k in adopted
+            if sup2.handles[k].adopt_hello.get("orphaned")
+        ))
 
     # -- the replay loop -------------------------------------------------- #
 
@@ -229,6 +330,15 @@ class ProcScenarioRun:
                 max_backoff_s=2.0, jitter=0.0,
             ),
             worker_stderr="devnull",  # induced crashes would spam CI
+            # survivability knobs sized for the harness: workers ride
+            # out a supervisor kill for a minute (bounded so a leaked
+            # orphan still dies), tick locally every second meanwhile,
+            # and the successor steals the fleet lease after ~1s
+            orphan_grace_s=float(
+                self.workload.get("orphan_grace_s", 60.0)
+            ),
+            orphan_tick_s=1.0,
+            supervisor_lease_ttl_s=1.0,
         )
 
     def _events_by_tick(self) -> Dict[int, List[Ev]]:
@@ -246,6 +356,8 @@ class ProcScenarioRun:
 
         deadline = Deadline.after(timeout_s)
         while not deadline.exceeded():
+            if self.sup.crashed or self.sup.deposed:
+                return  # nobody is coming until sup_restart fires
             if all(
                 h.state == "ready" for h in self.sup.handles.values()
             ):
@@ -266,7 +378,7 @@ class ProcScenarioRun:
             for i in range(max_ticks):
                 now = NOW + (i + 1) * self.spec.tick_s
                 for ev in events.pop(i, ()):
-                    self._apply_event(ev)
+                    self._apply_event(ev, now)
                 self.rounds.append(self.sup.round(now=now))
                 done = self.sup.agent_sim(now=now)
                 self.dispatched_total += sum(
@@ -280,9 +392,26 @@ class ProcScenarioRun:
                         self.converged_at = i
                         break
                 self._wait_fleet_healthy()
+            self.stats["supervisor_epoch"] = self.sup.sup_epoch
             self.sup.drain()
         finally:
             self.sup.stop(graceful=True)
+            # crashed incarnations still hold the Popen objects for
+            # workers the successor adopted: reap the zombies (the
+            # successor's stop() already ended the processes)
+            for old in self.sups:
+                for h in old.handles.values():
+                    if h.proc is None:
+                        continue
+                    if h.proc.poll() is None:
+                        try:
+                            h.proc.kill()
+                        except OSError:
+                            pass
+                    try:
+                        h.proc.wait(timeout=5.0)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
         try:
             if (
                 self.with_reference
@@ -304,7 +433,7 @@ class ProcScenarioRun:
 
     def _has_faults(self) -> bool:
         return any(
-            e.kind in ("proc_kill", "proc_hang")
+            e.kind in ("proc_kill", "proc_hang", "sup_kill")
             for e in self.spec.events
         )
 
@@ -331,28 +460,37 @@ class ProcScenarioRun:
                 canonical_state(self.merged)
                 if self.merged is not None else None
             )
-            sup = self.sup
+            # aggregate across EVERY supervisor incarnation: a
+            # sup_kill/sup_restart weather's restarts, exits and
+            # handoffs are spread over self.sups + the final one
+            all_sups = [*self.sups, self.sup]
             self.stats = {
                 "ticks": len(self.rounds),
                 "converged_at": self.converged_at,
                 "unfinished_final": self.unfinished,
                 "dispatched_total": self.dispatched_total,
                 "restarts_total": sum(
-                    h.restarts for h in sup.handles.values()
+                    h.restarts for s in all_sups
+                    for h in s.handles.values()
                 ),
                 "crash_exits": sum(
-                    1 for h in sup.handles.values()
+                    1 for s in all_sups for h in s.handles.values()
                     for rc in h.exits if rc == 86
                 ),
                 "kill_exits": sum(
-                    1 for h in sup.handles.values()
+                    1 for s in all_sups for h in s.handles.values()
                     for rc in h.exits if rc < 0
                 ),
                 "max_epoch": max(
-                    (h.epoch for h in sup.handles.values()), default=0
+                    (h.epoch for s in all_sups
+                     for h in s.handles.values()), default=0
                 ),
-                "migrations": len(sup.migrations),
-                "reconciled_handoffs": len(sup.reconciled),
+                "migrations": sum(
+                    len(s.migrations) for s in all_sups
+                ),
+                "reconciled_handoffs": sum(
+                    len(s.reconciled) for s in all_sups
+                ),
                 **self.stats,
             }
             invariants = {}
@@ -442,13 +580,22 @@ def _pinv_exactly_one_owner(run: ProcScenarioRun) -> Optional[str]:
 
 
 def _pinv_monotone_epochs(run: ProcScenarioRun) -> Optional[str]:
-    for k, h in run.sup.handles.items():
-        es = h.epochs
-        if es != sorted(set(es)):
+    sups = [*run.sups, run.sup]
+    for k in range(run.n_shards):
+        es = [e for s in sups for e in s.handles[k].epochs]
+        # an ADOPTION legitimately re-observes the same epoch (that is
+        # the whole point: no bump) — collapse consecutive repeats,
+        # then require strictly increasing; a lower epoch appearing
+        # later is still caught
+        collapsed = [
+            e for i, e in enumerate(es) if i == 0 or e != es[i - 1]
+        ]
+        if collapsed != sorted(set(collapsed)):
             return f"shard {k} epochs not strictly increasing: {es}"
-        if h.restarts and not es:
+        restarts = sum(s.handles[k].restarts for s in sups)
+        if restarts and not es:
             return (
-                f"shard {k}: {h.restarts} restart(s) but no takeover "
+                f"shard {k}: {restarts} restart(s) but no takeover "
                 "ever said hello"
             )
         # a crash BEFORE the first hello (e.g. inside the recovery
@@ -511,7 +658,8 @@ def _reference_canonical(spec: ScenarioSpec) -> dict:
         name=f"{spec.name}-reference",
         events=[
             e for e in spec.events
-            if e.kind not in ("proc_kill", "proc_hang")
+            if e.kind not in ("proc_kill", "proc_hang",
+                              "sup_kill", "sup_restart")
         ],
         checks=[],
         slos=[],
@@ -621,10 +769,124 @@ def _proc_hang_spec(seed: int = 0) -> ScenarioSpec:
     )
 
 
+def _sup_kill_midround_spec(seed: int = 0) -> ScenarioSpec:
+    """The ISSUE-14 acceptance centerpiece: the SUPERVISOR is SIGKILLed
+    mid-round fan-out on a 2-shard fleet; both workers go orphan (shard
+    leases kept, autonomous local ticks), a restarted supervisor steals
+    the fleet lease at a higher epoch and ADOPTS both live workers —
+    zero shard-lease epoch bumps, zero recovery passes, same pids
+    (resident plane never re-primed) — and rounds resume to
+    convergence with zero duplicate dispatch."""
+
+    def adopted_live(run: ProcScenarioRun) -> Optional[str]:
+        st = run.stats
+        if st.get("sup_restarts", 0) < 1:
+            return "the supervisor never restarted"
+        if st.get("adoptions_total", 0) < 2:
+            return (
+                "both live workers must be adopted, got "
+                f"{st.get('adoptions_total', 0)}"
+            )
+        if st.get("orphan_adoptions", 0) < 2:
+            return "workers were not adopted FROM orphan mode"
+        if st.get("adoption_epoch_bumps", 0):
+            return "adoption bumped a shard-lease epoch"
+        if st.get("adoption_pid_changes", 0):
+            return "adoption changed a worker pid (cold respawn)"
+        if st.get("adoption_recoveries", 0):
+            return "an adopted worker reported a recovery pass"
+        if st.get("restarts_total", 0):
+            return "a worker was cold-restarted"
+        return None
+
+    return ScenarioSpec(
+        name="proc-sup-kill-midround",
+        description="2-shard fleet: supervisor killed mid-round "
+                    "fan-out; workers orphan, the restarted "
+                    "supervisor adopts both live (no epoch bumps, no "
+                    "recovery), rounds resume, fleet converges",
+        ticks=14,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", {
+                "shards": 2, "distros": 4, "tasks": 32, "seed": 11,
+                "hosts_per_distro": 3,
+            }),
+            Ev(2, "sup_kill", {"at": "mid_round"}),
+            Ev(3, "sup_restart", {}),
+        ],
+        slos=[
+            SLO("no-worker-restarts", "restarts_total", "<=", 0),
+        ],
+        checks=[("adopted-live", adopted_live)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
+def _sup_kill_midhandoff_spec(seed: int = 0) -> ScenarioSpec:
+    """Supervisor killed BETWEEN the release and prime legs of a live
+    migration: the released record is durable on the source, the
+    target knows nothing — the successor's post-adoption
+    ``reconcile_handoffs`` must converge to exactly-one-owner."""
+
+    def handoff_reconciled(run: ProcScenarioRun) -> Optional[str]:
+        st = run.stats
+        if st.get("sup_restarts", 0) < 1:
+            return "the supervisor never restarted"
+        if st.get("adoptions_total", 0) < 2:
+            return (
+                "both live workers must be adopted, got "
+                f"{st.get('adoptions_total', 0)}"
+            )
+        if st.get("reconciled_handoffs", 0) < 1:
+            return (
+                "the released-but-unprimed handoff was never "
+                "reconciled by the successor"
+            )
+        return None
+
+    return ScenarioSpec(
+        name="proc-sup-kill-midhandoff",
+        description="2-shard fleet: supervisor killed between the "
+                    "release and prime handoff legs; the restarted "
+                    "supervisor adopts the workers and reconciles to "
+                    "exactly-one-owner",
+        ticks=14,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", {
+                "shards": 2, "distros": 4, "tasks": 32, "seed": 11,
+                "hosts_per_distro": 3,
+            }),
+            Ev(2, "sup_kill", {"at": "mid_handoff"}),
+            Ev(3, "sup_restart", {}),
+        ],
+        slos=[
+            SLO("no-worker-restarts", "restarts_total", "<=", 0),
+        ],
+        checks=[("handoff-reconciled", handoff_reconciled)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
 PROC_SCENARIOS: Dict[str, callable] = {
     "proc-fleet-sigkill": _proc_sigkill_spec,
     "proc-fleet-hang": _proc_hang_spec,
+    "proc-sup-kill-midround": _sup_kill_midround_spec,
+    "proc-sup-kill-midhandoff": _sup_kill_midhandoff_spec,
 }
+
+#: the supervisor-crash subset (tools/crash_matrix.py run_sup_points
+#: runs these inside the full matrix; gate --fleet-runtime runs every
+#: PROC_SCENARIOS weather including them)
+SUP_KILL_SCENARIOS = ("proc-sup-kill-midround",
+                      "proc-sup-kill-midhandoff")
 
 
 # --------------------------------------------------------------------------- #

@@ -186,6 +186,16 @@ class ShardingConfig(ConfigSection):
     #: exponential restart backoff bounds (PR-1 RetryPolicy shape)
     worker_restart_backoff_s: float = 0.25
     worker_restart_backoff_max_s: float = 30.0
+    #: how long a worker outlives a dead supervisor (orphan mode: keeps
+    #: its shard lease, ticks locally, waits for adoption on its
+    #: control socket), then drains and releases; 0 restores the old
+    #: exit-on-EOF behavior. This bounds a supervisor outage's blast
+    #: radius: restart within the grace = zero lost work
+    orphan_grace_s: float = 300.0
+    #: fleet-scope supervisor lease TTL — ALSO the worst-case takeover
+    #: latency after a supervisor death (the successor steals the
+    #: fencing epoch only once the lease goes stale)
+    supervisor_lease_ttl_s: float = 5.0
 
     def validate_and_default(self) -> str:
         if self.n_shards < 1:
@@ -213,6 +223,10 @@ class ShardingConfig(ConfigSection):
                 "worker_restart_backoff_max_s must be >= "
                 "worker_restart_backoff_s"
             )
+        if self.orphan_grace_s < 0:
+            return "orphan_grace_s cannot be negative"
+        if self.supervisor_lease_ttl_s <= 0:
+            return "supervisor_lease_ttl_s must be > 0"
         return ""
 
 

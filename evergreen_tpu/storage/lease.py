@@ -71,6 +71,17 @@ def shard_lease_path(data_dir: str, shard_id: Optional[int]) -> str:
     return os.path.join(data_dir, shard_lease_name(shard_id))
 
 
+def supervisor_lease_path(data_dir: str) -> str:
+    """Lease-file path for the FLEET SUPERVISOR scope (process-per-shard
+    runtime, runtime/supervisor.py). The supervisor holds no shard data
+    — its lease fences the *control plane*: exactly one supervisor may
+    command the fleet, every command carries the lease's epoch, and
+    workers reject commands stamped with a superseded one
+    (``stale_sup``), so two supervisors can never split-brain the fleet
+    the same way two writers can never split-brain a WAL segment."""
+    return os.path.join(data_dir, "supervisor.lease")
+
+
 class FileLease:
     #: bounded verify-after-rename attempts in the steal path
     _STEAL_ATTEMPTS = 5

@@ -7,6 +7,16 @@ with zero duplicate dispatch, cross-process fenced handoffs, graceful
 drain releasing every shard lease (including the classic service's
 SIGTERM path), and the admin fleet endpoint shape.
 
+Plus the ISSUE-14 survivability contracts: a supervisor crash puts
+workers in ORPHAN mode (shard lease kept + renewed, autonomous local
+ticks, bounded grace, clean drain at expiry), a restarted supervisor
+ADOPTS live workers over the fleet-manifest control sockets (same
+pids, zero shard-lease epoch bumps, zero recovery passes), the
+supervisor fleet lease fences the control plane (a second supervisor
+cannot acquire it; its stale-epoch commands are rejected with
+``stale_sup``), and adoption-within-grace converges with the usual
+no-duplicate-dispatch / exactly-one-owner invariants.
+
 Process-spawning tests keep the workload tiny (a couple of distros,
 a couple dozen tasks) and lease TTLs short so a fenced takeover lands
 in ~2s; the full weathers + crash-point sample run under
@@ -43,19 +53,40 @@ def _policy(base: float = 0.2, cap: float = 2.0) -> RetryPolicy:
     )
 
 
-def _fleet(data_dir, n_shards: int, workload=None,
+def _fleet(data_dir, n_shards: int, workload=None, seed: bool = True,
            **kw) -> FleetSupervisor:
-    _seed_fleet(
-        str(data_dir), n_shards,
-        workload or {"distros": 2, "tasks": 16, "seed": 11},
-    )
+    if seed:
+        _seed_fleet(
+            str(data_dir), n_shards,
+            workload or {"distros": 2, "tasks": 16, "seed": 11},
+        )
     kw.setdefault("ttl_s", 1.0)
     kw.setdefault("hb_interval_s", 0.2)
     kw.setdefault("hb_deadline_s", 1.2)
     kw.setdefault("harness", True)
     kw.setdefault("recovery_anchor", NOW)
     kw.setdefault("restart_policy", _policy())
+    kw.setdefault("orphan_grace_s", 30.0)
+    kw.setdefault("orphan_tick_s", 0.5)
+    kw.setdefault("supervisor_lease_ttl_s", 1.0)
     return FleetSupervisor(str(data_dir), n_shards, **kw)
+
+
+def _reap(sup: FleetSupervisor) -> None:
+    """Wait out a crashed-then-superseded supervisor's Popen handles
+    so adopted workers never linger as zombies of the test process."""
+    for h in sup.handles.values():
+        if h.proc is None:
+            continue
+        if h.proc.poll() is None:
+            try:
+                h.proc.kill()
+            except OSError:
+                pass
+        try:
+            h.proc.wait(timeout=10.0)
+        except Exception:  # noqa: BLE001 — best effort
+            pass
 
 
 def _drive_to_convergence(sup: FleetSupervisor, max_rounds: int = 24,
@@ -369,6 +400,210 @@ def test_service_sigterm_releases_writer_lease(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# supervisor survivability (ISSUE 14): orphan mode, adoption, fencing
+# --------------------------------------------------------------------------- #
+
+
+def _read_json(path):
+    import json
+
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def test_orphan_keeps_renewing_then_drains_at_grace_expiry(tmp_path):
+    """A supervisor crash puts the worker in orphan mode: it KEEPS its
+    shard lease (renewals keep landing), keeps ticking locally, and at
+    grace expiry drains, RELEASES the lease and removes its manifest
+    entry — the bounded worst case of an unrecovered supervisor."""
+    from evergreen_tpu.runtime import manifest
+    from evergreen_tpu.storage.lease import shard_lease_path
+
+    sup = _fleet(tmp_path, 1, orphan_grace_s=4.0, orphan_tick_s=0.5)
+    sup.start()
+    sup.round(now=NOW + TICK_S)
+    h = sup.handles[0]
+    lease_path = shard_lease_path(str(tmp_path), 0)
+    entry = manifest.read_entry(str(tmp_path), 0)
+    assert entry is not None and entry["pid"] == h.pid
+    assert os.path.exists(entry["sock"])
+    sup.simulate_crash()
+    # mid-grace: lease still held by the SAME epoch and still renewing
+    time.sleep(1.0)
+    assert h.proc.poll() is None, "worker must outlive the supervisor"
+    doc1 = _read_json(lease_path)
+    assert doc1["epoch"] == 1
+    time.sleep(0.8)
+    doc2 = _read_json(lease_path)
+    assert doc2["at"] > doc1["at"], "orphan must keep renewing"
+    # grace expiry: clean exit, lease released, manifest entry gone
+    assert h.proc.wait(timeout=30.0) == 0
+    assert not os.path.exists(lease_path), (
+        "an expired orphan must RELEASE its lease, not abandon it"
+    )
+    assert manifest.read_entry(str(tmp_path), 0) is None
+    assert not os.path.exists(entry["sock"])
+
+
+def test_adoption_within_grace_no_epoch_bump_no_recovery(tmp_path):
+    """The acceptance centerpiece, in-process: kill the supervisor,
+    restart it, and both live workers are ADOPTED — same pids, same
+    shard-lease epochs (zero bumps), no recovery pass, autonomous
+    orphan ticks recorded — then the fleet converges with zero
+    duplicate dispatch and exactly-one-owner."""
+    from evergreen_tpu.scenarios.invariants import (
+        check_duplicate_dispatch,
+        check_store_consistent,
+    )
+    from evergreen_tpu.scenarios.procs import _open_fleet_stores
+    from evergreen_tpu.scheduler.sharded_plane import (
+        fleet_owner_violations,
+        merge_fleet_state,
+    )
+
+    sup = _fleet(
+        tmp_path, 2,
+        workload={"distros": 4, "tasks": 24, "seed": 11},
+    )
+    sup2 = None
+    try:
+        sup.start()
+        sup.round(now=NOW + TICK_S)
+        sup.agent_sim(now=NOW + TICK_S)
+        pre = {k: (h.pid, h.epoch) for k, h in sup.handles.items()}
+        assert sup.sup_epoch == 1
+        sup.simulate_crash()
+        time.sleep(1.6)  # orphan + at least one autonomous tick
+        sup2 = _fleet(tmp_path, 2, seed=False)
+        sup2.start()
+        assert sup2.sup_epoch > sup.sup_epoch, (
+            "the successor must steal the fleet lease at a higher epoch"
+        )
+        for k, h in sup2.handles.items():
+            assert h.adopted, f"shard {k} was not adopted"
+            assert h.pid == pre[k][0], "adoption must keep the process"
+            assert h.epochs == [pre[k][1]], (
+                f"adoption must not bump the shard lease: {h.epochs}"
+            )
+            assert h.adopt_hello.get("recovery_passes") == 1, (
+                "an adopted worker must still be at its single "
+                "boot-time recovery pass"
+            )
+            assert h.adopt_hello.get("orphaned") is True
+            assert h.adopt_hello.get("tick", 0) >= 1, (
+                "tick continuity proves the plane stayed warm"
+            )
+            assert h.restarts == 0
+        _drive_to_convergence(sup2, start=1)
+    finally:
+        if sup2 is not None:
+            sup2.stop()
+        _reap(sup)
+    stores = _open_fleet_stores(str(tmp_path), 2)
+    try:
+        assert fleet_owner_violations(stores) == []
+        merged = merge_fleet_state(stores)
+        assert check_duplicate_dispatch(merged) == []
+        assert check_store_consistent(merged) == []
+    finally:
+        for s in stores:
+            s.close()
+
+
+def test_stale_supervisor_commands_rejected(tmp_path):
+    """The split-brain guard at the worker: commands carrying a
+    superseded supervisor epoch come back ``stale_sup`` and do NOT
+    execute; the live fleet keeps working and learns the reject count
+    through heartbeats."""
+    import threading
+
+    from evergreen_tpu.runtime import manifest
+    from evergreen_tpu.runtime.protocol import parse_line, send_msg
+
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start()
+        sup.round(now=NOW + TICK_S)
+        pre_tick = sup.statuses()[0]["tick"]
+        entry = manifest.read_entry(str(tmp_path), 0)
+        conn = manifest.connect(entry["sock"], timeout_s=5.0)
+        rf = conn.makefile("r", encoding="utf-8")
+        wf = conn.makefile("w", encoding="utf-8")
+        lock = threading.Lock()
+        try:
+            # the current-epoch adopt is the replay attack: the rogue
+            # read the CURRENT fleet-lease epoch; only a strictly
+            # higher one (an actual steal) may adopt a foreign channel
+            for op, sup_e in (("adopt", sup.sup_epoch), ("adopt", 0),
+                              ("tick", 0), ("shutdown", 0)):
+                req = f"rogue-{op}-{sup_e}"
+                send_msg(wf, lock, op=op, sup=sup_e, req=req,
+                         now=NOW + 30.0)
+                reply = None
+                while reply is None:
+                    msg = parse_line(rf.readline())
+                    if msg is not None and msg.get("req") == req:
+                        reply = msg
+                assert reply["op"] == "stale_sup", (
+                    f"rogue {op!r} must be rejected, got {reply}"
+                )
+        finally:
+            for f in (rf, wf, conn):
+                f.close()
+        # nothing executed: same tick index, same process, and the
+        # live supervisor still commands the fleet
+        st = sup.statuses()[0]
+        assert st["tick"] == pre_tick
+        assert sup.round(now=NOW + 2 * TICK_S)
+        time.sleep(0.6)  # a heartbeat carries the reject count
+        assert sup.handles[0].stale_rejects >= 4
+        assert sup.fleet_state()["workers"]["0"]["stale_rejects"] >= 4
+    finally:
+        sup.stop()
+
+
+def test_second_supervisor_cannot_acquire_held_fleet_lease(tmp_path):
+    """Supervisor fencing half one: while a live supervisor renews the
+    fleet lease, a second one's start() must refuse to run rather than
+    split-brain the fleet."""
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start()
+        rogue = _fleet(tmp_path, 1, seed=False)
+        rogue.fleet_acquire_timeout_s = 1.5
+        rogue.adopt_enabled = False
+        with pytest.raises(RuntimeError, match="fleet lease"):
+            rogue.start()
+        # the live fleet is untouched
+        assert sup.round(now=NOW + TICK_S)
+    finally:
+        sup.stop()
+
+
+def test_deposed_supervisor_stands_down_without_killing_workers(
+    tmp_path,
+):
+    """Supervisor fencing half two: a supervisor whose fleet lease is
+    gone stops commanding (rounds return empty) and its stop() leaves
+    the workers RUNNING — they belong to the successor."""
+    sup = _fleet(tmp_path, 1)
+    try:
+        sup.start()
+        h = sup.handles[0]
+        sup._fleet_deposed("test: simulated loss")
+        assert sup.round(now=NOW + TICK_S) == {}
+        assert sup.broadcast("status", "status") == {}
+        sup.stop()
+        assert h.proc.poll() is None, (
+            "a deposed supervisor must NOT kill its successor's workers"
+        )
+    finally:
+        sup.deposed = False
+        sup.crashed = True  # detach cleanly
+        _reap(sup)
+
+
+# --------------------------------------------------------------------------- #
 # admin surface
 # --------------------------------------------------------------------------- #
 
@@ -391,13 +626,16 @@ def test_admin_fleet_endpoint_shape(tmp_path):
     assert doc["n_shards"] == 2
     assert set(doc) >= {
         "workers", "rounds", "restarts_total", "migrations",
-        "reconciled_handoffs", "data_dir",
+        "reconciled_handoffs", "data_dir", "supervisor_epoch",
+        "adoptions_total", "orphaned_total", "deposed",
     }
+    assert doc["supervisor_epoch"] == 0  # never started → no lease
     for k in ("0", "1"):
         w = doc["workers"][k]
         assert set(w) >= {
             "state", "epoch", "epochs", "restarts", "level",
             "last_round_ms", "exits", "heartbeat_overdue",
+            "adopted", "orphan", "orphan_ticks", "stale_rejects",
         }
 
 

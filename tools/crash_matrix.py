@@ -707,6 +707,39 @@ def run_sharded_points(ticks: int = DEFAULT_TICKS) -> int:
     return failures
 
 
+def run_sup_points() -> int:
+    """The SUPERVISOR-crash points (ISSUE 14) through the proc
+    backend: SIGKILL mid-round fan-out and between the handoff
+    release→prime legs on a supervised 2-shard fleet. Each must end in
+    live ADOPTION — zero shard-lease epoch bumps, zero recovery
+    passes, exactly-one-owner, resume ≡ rerun (the sup_kill weathers;
+    the gate's fleet-runtime smoke runs the same two)."""
+    from evergreen_tpu.scenarios.procs import (
+        PROC_SCENARIOS,
+        SUP_KILL_SCENARIOS,
+        run_proc_scenario,
+    )
+
+    failures = 0
+    for name in SUP_KILL_SCENARIOS:
+        entry = run_proc_scenario(PROC_SCENARIOS[name]())
+        stats = entry.get("stats", {})
+        print(json.dumps({
+            "point": name,
+            "ok": entry["ok"],
+            "adoptions": stats.get("adoptions_total", 0),
+            "epoch_bumps": stats.get("adoption_epoch_bumps", 0),
+            "reconciled": stats.get("reconciled_handoffs", 0),
+            "restarts": stats.get("restarts_total", 0),
+        }))
+        if not entry["ok"]:
+            failures += 1
+            sys.stderr.write(
+                json.dumps(entry, default=str) + "\n"
+            )
+    return failures
+
+
 def failover_case(ticks: int = 4, stall_s: float = 2.0) -> dict:
     """Two-process failover: holder SIGSTOPped mid-commit, standby steals
     and runs, holder SIGCONTed → its resumed commit is fenced; the WAL
@@ -881,9 +914,13 @@ def run_matrix(points: Optional[List[Tuple[str, int]]] = None,
         sys.stderr.write(fo["holder_out"] + "\n" + fo["standby_out"] + "\n")
     # distro-handoff kill points on the 2-shard plane
     failures += run_sharded_points(ticks)
+    # supervisor-crash points: mid-round + mid-handoff SIGKILL of the
+    # SUPERVISOR itself, resolved by orphan mode + live adoption
+    n_sup = run_sup_points()
+    failures += n_sup
     print(json.dumps({
         "crash_matrix_failures": failures,
-        "points": len(points) + 1 + len(SHARDED_KILL_POINTS),
+        "points": len(points) + 1 + len(SHARDED_KILL_POINTS) + 2,
     }))
     return 1 if failures else 0
 
@@ -900,8 +937,12 @@ def main() -> int:
     p.add_argument("--failover-only", action="store_true")
     p.add_argument("--sharded-only", action="store_true",
                    help="run only the distro-handoff kill points")
+    p.add_argument("--sup-only", action="store_true",
+                   help="run only the supervisor-crash points")
     p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
     args = p.parse_args()
+    if args.sup_only:
+        return 1 if run_sup_points() else 0
     if args.sharded_only:
         return 1 if run_sharded_points(args.ticks) else 0
     if args.failover_only:

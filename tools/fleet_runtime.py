@@ -4,21 +4,31 @@
     python tools/fleet_runtime.py              # the full smoke
     python tools/fleet_runtime.py --scenario proc-fleet-sigkill
     python tools/fleet_runtime.py --points     # crash points only
+    python tools/fleet_runtime.py --sabotage   # split-brain self-test
 
 Runs (gate-blocking via ``tools/gate.py --fleet-runtime`` /
 ``make fleet-runtime``):
 
   1. the supervised-fleet weathers (scenarios/procs.py
      ``PROC_SCENARIOS``): a 2-shard fleet with one induced
-     SIGKILL-shaped worker death at a WAL seam (``proc_kill``) and one
+     SIGKILL-shaped worker death at a WAL seam (``proc_kill``), one
      induced hang (``proc_hang`` → missed-heartbeat kill + restart) —
      each must converge with a fenced takeover at a strictly higher
      lease epoch, zero duplicate dispatch, exactly-one-owner, and
-     resume ≡ rerun state vs an uninterrupted run;
+     resume ≡ rerun state vs an uninterrupted run — plus the two
+     SUPERVISOR-kill weathers (``sup_kill`` mid-round / mid-handoff →
+     orphan workers, fleet-lease steal, live adoption with zero
+     shard-lease epoch bumps and zero recovery passes,
+     exactly-one-owner after the mid-handoff point);
   2. a sample of the migrated crash-matrix engine points
      (``run_crash_point`` — the backend ``crash-matrix`` runs all 13
      through): one kill inside a WAL group commit, one between the
-     dispatch CAS pair, one inside the startup recovery pass.
+     dispatch CAS pair, one inside the startup recovery pass;
+  3. the split-brain sabotage run: a SECOND supervisor against a held
+     fleet lease must fail to acquire it AND see every command it
+     forces over the worker control sockets rejected (``stale_sup``) —
+     if any lands, the smoke exits non-zero (the scenario engine's
+     sabotage pattern: prove the guard catches the attack).
 
 Prints one JSON line per case; exits non-zero on any failure.
 """
@@ -101,6 +111,144 @@ def run_points() -> int:
     return failures
 
 
+def run_sabotage() -> int:
+    """Split-brain self-test (worker-side stale-epoch guard): boot a
+    real 2-shard fleet, then play a SECOND supervisor against it —
+    it must fail to acquire the held fleet lease, and every command it
+    forces over the worker control sockets (stamped with its stale
+    epoch 0) must come back ``stale_sup`` without executing. Returns
+    the failure count; any command that LANDS is a failure."""
+    import shutil
+    import tempfile
+    import threading
+
+    from evergreen_tpu.runtime import manifest
+    from evergreen_tpu.runtime.protocol import parse_line, send_msg
+    from evergreen_tpu.runtime.supervisor import FleetSupervisor
+    from evergreen_tpu.scenarios.procs import _seed_fleet
+    from evergreen_tpu.storage.lease import (
+        FileLease,
+        supervisor_lease_path,
+    )
+    from evergreen_tpu.utils.benchgen import NOW
+    from evergreen_tpu.utils.retry import RetryPolicy
+
+    problems: List[str] = []
+    data_dir = tempfile.mkdtemp(prefix="fleet-sabotage-")
+    sup = FleetSupervisor(
+        data_dir, 2, ttl_s=1.0, hb_interval_s=0.25,
+        hb_deadline_s=1.5, harness=True, recovery_anchor=NOW,
+        restart_policy=RetryPolicy(
+            attempts=1_000_000, base_backoff_s=0.25,
+            max_backoff_s=2.0, jitter=0.0,
+        ),
+        worker_stderr="devnull",
+        orphan_grace_s=60.0, supervisor_lease_ttl_s=2.0,
+    )
+    try:
+        _seed_fleet(data_dir, 2, {"distros": 4, "tasks": 24,
+                                  "seed": 11})
+        sup.start()
+        sup.round(now=NOW + 15.0)
+        pre_ticks = {
+            k: r.get("tick", -1)
+            for k, r in sup.statuses().items()
+        }
+
+        # (a) the held fleet lease cannot be acquired
+        rogue_lease = FileLease(
+            supervisor_lease_path(data_dir), ttl_s=2.0
+        )
+        if rogue_lease.try_acquire():
+            problems.append(
+                "rogue supervisor ACQUIRED the held fleet lease"
+            )
+
+        # (b) every forced command is rejected with stale_sup —
+        # including an adopt REPLAYING the current epoch (a rogue can
+        # read the lease file; only a strictly-higher epoch, i.e. an
+        # actual steal, may adopt a foreign channel)
+        import json as _json
+
+        with open(supervisor_lease_path(data_dir),
+                  encoding="utf-8") as fh:
+            held_epoch = int(_json.load(fh)["epoch"])
+        lock = threading.Lock()
+        landed = 0
+        rejected = 0
+        for shard in range(2):
+            entry = manifest.read_entry(data_dir, shard)
+            if entry is None:
+                problems.append(f"no manifest entry for shard {shard}")
+                continue
+            conn = manifest.connect(entry["sock"], timeout_s=5.0)
+            rf = conn.makefile("r", encoding="utf-8")
+            wf = conn.makefile("w", encoding="utf-8")
+            try:
+                for op, sup_e in (("adopt", held_epoch), ("adopt", 0),
+                                  ("tick", 0), ("release", 0),
+                                  ("prime", 0), ("drain", 0),
+                                  ("shutdown", 0)):
+                    req = f"rogue-{shard}-{op}-{sup_e}"
+                    send_msg(wf, lock, op=op, sup=sup_e, req=req,
+                             now=NOW + 30.0, distro="d-000", target=1,
+                             record={}, handoff="h")
+                    reply = None
+                    while True:
+                        line = rf.readline()
+                        if not line:
+                            break
+                        msg = parse_line(line)
+                        if msg is not None and msg.get("req") == req:
+                            reply = msg
+                            break
+                    if reply is None:
+                        problems.append(
+                            f"shard {shard}: no reply to rogue {op!r}"
+                        )
+                    elif reply["op"] != "stale_sup":
+                        landed += 1
+                        problems.append(
+                            f"shard {shard}: rogue {op!r} LANDED "
+                            f"(reply {reply['op']!r})"
+                        )
+                    else:
+                        rejected += 1
+            finally:
+                for f in (rf, wf, conn):
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+
+        # (c) the live fleet is untouched: same workers, rounds work,
+        # no rogue tick executed
+        post = sup.statuses()
+        if sorted(post) != [0, 1]:
+            problems.append(
+                f"live fleet lost workers after sabotage: {sorted(post)}"
+            )
+        for k, r in post.items():
+            if r.get("tick", -1) != pre_ticks.get(k):
+                problems.append(
+                    f"shard {k} ticked under a rogue command "
+                    f"({pre_ticks.get(k)} -> {r.get('tick')})"
+                )
+        if not sup.round(now=NOW + 30.0):
+            problems.append("live supervisor round failed after sabotage")
+        print(json.dumps({
+            "sabotage": "stale-supervisor",
+            "ok": not problems,
+            "rejected": rejected,
+            "landed": landed,
+            "problems": problems,
+        }))
+        return 1 if problems else 0
+    finally:
+        sup.stop(graceful=True)
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scenario", default="",
@@ -109,12 +257,20 @@ def main() -> int:
                    help="run only the crash-point sample")
     p.add_argument("--weathers", action="store_true",
                    help="run only the supervised-fleet weathers")
+    p.add_argument("--sabotage", action="store_true",
+                   help="run only the split-brain sabotage self-test")
     args = p.parse_args()
 
-    if args.scenario and args.points:
-        # the combination would skip BOTH blocks and report a green
-        # smoke that ran nothing
-        print("--scenario and --points are mutually exclusive",
+    exclusive = [
+        n for n, v in (("--scenario", args.scenario),
+                       ("--points", args.points),
+                       ("--sabotage", args.sabotage))
+        if v
+    ]
+    if len(exclusive) > 1:
+        # any combination would skip blocks and report a green smoke
+        # that ran nothing
+        print(f"{' and '.join(exclusive)} are mutually exclusive",
               file=sys.stderr)
         return 2
     _force_cpu()
@@ -129,12 +285,19 @@ def main() -> int:
             )
             return 2
     failures = 0
-    if not args.points:
+    if args.sabotage:
+        failures += run_sabotage()
+    if not args.points and not args.sabotage:
         failures += run_weathers(
             [args.scenario] if args.scenario else None
         )
-    if not args.weathers and not args.scenario:
+    if not args.weathers and not args.scenario and not args.sabotage:
         failures += run_points()
+    if not (args.weathers or args.scenario or args.sabotage
+            or args.points):
+        # the full smoke ends with the split-brain self-test: the
+        # stale-supervisor guard must CATCH the attack
+        failures += run_sabotage()
     print(json.dumps({"fleet_runtime_ok": failures == 0}))
     return 1 if failures else 0
 

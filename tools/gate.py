@@ -208,8 +208,12 @@ def main() -> int:
         # worker processes under the production supervisor, one induced
         # SIGKILL at a WAL seam + one induced hang — each must take
         # over fenced at a higher lease epoch with zero duplicate
-        # dispatch and resume ≡ rerun — plus the migrated crash-matrix
-        # engine points sample
+        # dispatch and resume ≡ rerun — plus the SUPERVISOR-kill
+        # weathers (mid-round + mid-handoff: orphan workers adopted
+        # live with zero epoch bumps, handoff reconciled to
+        # exactly-one-owner), the migrated crash-matrix engine points
+        # sample, and the split-brain sabotage self-test (a second
+        # supervisor's stale-epoch commands must ALL be rejected)
         fr = [sys.executable,
               os.path.join(root, "tools", "fleet_runtime.py")]
         print("gate:", " ".join(fr), flush=True)

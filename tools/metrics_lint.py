@@ -225,6 +225,26 @@ def lint() -> List[str]:
                         "per-replica series fold every replica "
                         "together)"
                     )
+            # per-worker fleet instruments likewise (fleet runtime,
+            # runtime/supervisor.py): a *_worker(s)_* series observed
+            # once per shard worker without the 'shard' label folds
+            # the whole fleet into one series — one crash-looping or
+            # permanently-orphaned worker then hides inside a healthy
+            # aggregate
+            per_worker = "_worker_" in name or "_workers_" in name
+            if per_worker:
+                ln_chk = _labels_node(node)
+                label_vals = []
+                if isinstance(ln_chk, (ast.Tuple, ast.List)):
+                    label_vals = [
+                        _literal_str(el)[1] for el in ln_chk.elts
+                    ]
+                if "shard" not in label_vals:
+                    violations.append(
+                        f"{loc}: per-worker instrument {name!r} must "
+                        "carry the 'shard' label (unlabeled per-"
+                        "worker series fold the whole fleet together)"
+                    )
             # labels
             ln = _labels_node(node)
             if ln is not None:
