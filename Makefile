@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint scenarios fleet-runtime
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -112,10 +112,21 @@ fleet-runtime:
 bench-sharded-plane:
 	env JAX_PLATFORMS=cpu python tools/bench_sharded_plane.py
 
-# static metrics-plane lint (fast; gate runs it unconditionally):
-# every instrument registered exactly once, literal snake_case names
-# with a known subsystem prefix, labels from the allowed vocabulary,
-# no f-string metric names, no stray incr_counter call sites
+# evglint: all six static passes (lockgraph, tracercheck, fencecheck,
+# shedcheck, seamcheck, metrics) over the whole package, milliseconds
+# fast; the sabotage self-test runs first so a pass that has gone blind
+# cannot hand back a trusted "clean". Suppressions require a
+# justification (`# evglint: disable=<pass> -- <why>`); the gate runs
+# the same two commands unconditionally.
+lint:
+	python -m tools.evglint --sabotage
+	python -m tools.evglint
+
+# static metrics-plane lint (fast; the gate's evglint stage includes it
+# as the `metrics` pass): every instrument registered exactly once,
+# literal snake_case names with a known subsystem prefix, labels from
+# the allowed vocabulary, no f-string metric names, no stray
+# incr_counter call sites. Kept as a standalone alias of that pass.
 metrics-lint:
 	python tools/metrics_lint.py
 

@@ -365,7 +365,7 @@ class GitGetProject(Command):
         if revision:
             cmds.append(["git", "-C", git_dir, "checkout", revision])
         for cmd in cmds:
-            proc = subprocess.run(cmd, capture_output=True, text=True)
+            proc = subprocess.run(cmd, capture_output=True, text=True)  # evglint: disable=seamcheck -- task-scoped git clone is the workload; failure surfaces as the task's CommandResult
             if proc.returncode != 0:
                 return CommandResult(
                     failed=True,
@@ -386,7 +386,7 @@ class GitApplyPatch(Command):
         diff = ctx.artifacts.get("patch_diff") or ctx.expansions.get("patch_diff")
         if not diff:
             return CommandResult()  # no patch staged (mainline build)
-        proc = subprocess.run(
+        proc = subprocess.run(  # evglint: disable=seamcheck -- task-scoped git apply is the workload; failure surfaces as the task's CommandResult
             ["git", "-C", directory, "apply", "-"],
             input=diff, capture_output=True, text=True,
         )

@@ -46,7 +46,7 @@ class AgentMonitor:
 
     def run_once(self) -> int:
         """Run one agent process to completion; returns its exit code."""
-        proc = subprocess.run(self._agent_argv())
+        proc = subprocess.run(self._agent_argv())  # evglint: disable=seamcheck -- periodic local sampling; a failed sample skips one beat, the monitor loop itself retries
         return proc.returncode
 
     def run(self, log=print) -> None:

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import re
 import threading
+
+from ..utils import lockcheck as _lockcheck
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -101,7 +103,7 @@ class StoreVersions:
         self.store = store
         self._gens: Dict[str, int] = {}
         self._installed: set = set()
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("api.readcache.listeners")
 
     def _ensure(self, name: str) -> None:
         if name in self._installed:
@@ -180,7 +182,7 @@ class ResponseCache:
 
     def __init__(self, max_entries: int = 256) -> None:
         self.max_entries = max_entries
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("api.readcache.etag")
         self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
 
     def get(self, key: tuple) -> Optional[tuple]:

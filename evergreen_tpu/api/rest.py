@@ -883,7 +883,7 @@ class RestApi:
         if wait > 0:
             timeout_s += min(wait, 300.0)
         try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:  # evglint: disable=seamcheck -- single-shot by design: retrying a forwarded write could double-apply on the primary; unreachable degrades to an explicit 503
                 status, resp_raw = resp.status, resp.read()
                 resp_headers = resp.headers
         except urllib.error.HTTPError as e:

@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Dict, List, Optional
 
@@ -53,7 +55,7 @@ def get_container_pools(store: Store) -> Dict[str, ContainerPool]:
 
 class FakeDockerClient:
     _seq = itertools.count(1)
-    _lock = threading.Lock()
+    _lock = _lockcheck.make_lock("cloud.docker")
 
     def __init__(self) -> None:
         self.containers: Dict[str, dict] = {}

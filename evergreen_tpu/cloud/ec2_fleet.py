@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Dict, Optional
 
@@ -27,7 +29,7 @@ class FakeEC2Client:
     gets from cloud/ec2_client.go's interface + mocks)."""
 
     _seq = itertools.count(1)
-    _lock = threading.Lock()
+    _lock = _lockcheck.make_lock("cloud.ec2")
 
     def __init__(self) -> None:
         self.instances: Dict[str, dict] = {}

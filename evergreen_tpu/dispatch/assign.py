@@ -8,6 +8,8 @@ mark it dispatched. The CAS pair is the system's dispatch-race guard.
 from __future__ import annotations
 
 import threading as _threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Optional, Tuple
 
@@ -26,7 +28,7 @@ from .dag_dispatcher import DispatcherService, TaskSpec
 #: per assignment — measurable serial work at 10k pulls/s for a knob that
 #: changes at admin cadence
 _limit_cache: dict = {}
-_limit_cache_lock = _threading.Lock()
+_limit_cache_lock = _lockcheck.make_lock("dispatch.assign.limits")
 _LIMIT_TTL_S = 5.0
 
 

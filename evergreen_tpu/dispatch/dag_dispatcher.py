@@ -21,6 +21,8 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Dict, List, Optional
 
@@ -70,7 +72,7 @@ class DAGDispatcher:
         self.distro_id = distro_id
         self.ttl_s = ttl_s
         self.secondary = secondary
-        self._lock = threading.RLock()
+        self._lock = _lockcheck.make_rlock("dispatch.dag")
         self._last_updated = 0.0
         self._loaded_stamp = 0.0
         self._sorted: List[TaskQueueItem] = []
@@ -391,7 +393,7 @@ class DispatcherService:
     def __init__(self, store: Store, ttl_s: float = DEFAULT_TTL_S) -> None:
         self.store = store
         self.ttl_s = ttl_s
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("dispatch.dag.claims")
         self._dispatchers: Dict[str, DAGDispatcher] = {}
 
     def get(self, distro_id: str, secondary: bool = False) -> DAGDispatcher:

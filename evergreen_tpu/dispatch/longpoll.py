@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import random as _random
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Dict, Optional
 
@@ -68,7 +70,7 @@ class LongPollHub:
         self.n_shards = max(1, int(n_shards))
         self.recheck_s = max(0.01, float(recheck_s))
         self._conds = [
-            threading.Condition(threading.Lock())
+            _lockcheck.make_condition("dispatch.longpoll.shard")
             for _ in range(self.n_shards)
         ]
         #: waiters parked per shard (under that shard's lock)
@@ -89,7 +91,7 @@ class LongPollHub:
         #: round-robin cursor for hinted wakes
         self._rr = 0
         self._total_waiting = 0
-        self._count_lock = threading.Lock()
+        self._count_lock = _lockcheck.make_lock("dispatch.longpoll.count")
 
     # -- generation ------------------------------------------------------ #
 
@@ -270,7 +272,7 @@ class LongPollHub:
 
 # -- per-store singleton ----------------------------------------------------- #
 
-_hub_lock = threading.Lock()
+_hub_lock = _lockcheck.make_lock("dispatch.longpoll.hub")
 
 
 def hub_for(store, n_shards: Optional[int] = None) -> LongPollHub:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Callable, Dict, List, Optional
 
@@ -23,7 +25,7 @@ SUBSCRIPTIONS_COLLECTION = "subscriptions"
 NOTIFICATIONS_COLLECTION = "notifications"
 
 _seq = itertools.count()
-_seq_lock = threading.Lock()
+_seq_lock = _lockcheck.make_lock("events.seq")
 
 
 # trigger names (reference trigger/registry.go trigger constants)

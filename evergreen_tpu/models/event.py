@@ -6,6 +6,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import List, Optional
 
@@ -14,7 +16,7 @@ from ..storage.store import Collection, Store
 COLLECTION = "events"
 
 _SEQ = itertools.count()
-_SEQ_LOCK = threading.Lock()
+_SEQ_LOCK = _lockcheck.make_lock("events.model_seq")
 #: highest seq issued in this process — reseeding (after recovering a
 #: store with surviving ids) must never move the shared counter BELOW
 #: ids already handed out for another store

@@ -17,6 +17,8 @@ from __future__ import annotations
 import abc
 import dataclasses
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -189,7 +191,7 @@ class JobQueue:
         self._executor = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"jobq-{name}"
         )
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("jobs.queue")
         self._pending: Dict[str, Job] = {}
         self._held_scopes: Set[str] = set()
         #: every admitted-but-not-running job (ready AND scope-blocked);

@@ -50,6 +50,8 @@ import signal
 import subprocess
 import sys
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from queue import Empty, Queue
 from typing import Dict, List, Optional
@@ -145,7 +147,7 @@ class WorkerHandle:
         self.generation = 0
         self._req_counter = 0
         self.replies: Queue = Queue()
-        self.send_lock = threading.Lock()
+        self.send_lock = _lockcheck.make_lock("runtime.supervisor.send")
         self.hb_deadline_s = hb_deadline_s
         self.hb_deadline = Deadline.after(None)
         self.epochs: List[int] = []
@@ -378,7 +380,7 @@ class FleetSupervisor:
         self.reconciled: List[str] = []
         self.migrations: List[dict] = []
         self._seq = 0
-        self._round_lock = threading.Lock()
+        self._round_lock = _lockcheck.make_lock("runtime.supervisor.round")
         self._needs_reconcile = False
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -787,7 +789,7 @@ class FleetSupervisor:
                     h.send(op="tick", now=now, req=reqs[h.shard])
                 results: Dict[int, dict] = {}
                 for h in ready:
-                    reply = h.wait_reply(
+                    reply = h.wait_reply(  # evglint: disable=lockgraph -- round serialization is the contract: rebalance/adopt must not interleave mid-round; bounded by round_timeout_s per shard
                         "round", self.round_timeout_s,
                         req=reqs[h.shard],
                     )

@@ -62,6 +62,8 @@ import argparse
 import os
 import sys
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from queue import Empty, Queue
 from typing import List, Optional
@@ -171,7 +173,7 @@ class _Channel:
 class ShardWorker:
     def __init__(self, args, proto_out) -> None:
         self.args = args
-        self.out_lock = threading.Lock()
+        self.out_lock = _lockcheck.make_lock("runtime.worker.out")
         self.stdio = _Channel("stdio", sys.stdin, proto_out)
         #: the channel replies + heartbeats go to; switched by adoption
         self.active = self.stdio
@@ -295,7 +297,7 @@ class ShardWorker:
             return
         path = manifest.socket_path(self.args.data_dir, self.shard)
         try:
-            os.unlink(path)
+            os.unlink(path)  # evglint: disable=fencecheck -- unlinks this worker's OWN stale control-socket file before binding a fresh one; a unix socket beside the store, never store state
         except OSError:
             pass
         srv = socket_mod.socket(
@@ -653,7 +655,7 @@ class ShardWorker:
             # heals the same way)
             try:
                 self.store.heal_durability()
-            except Exception:  # noqa: BLE001 — best effort
+            except Exception:  # noqa: BLE001 — best effort  # evglint: disable=shedcheck -- durability heal is advisory; supervisor reconciliation converges the handoff either way
                 pass
             self.send(op="error", detail=f"release failed: {exc!r}")
             return
@@ -718,7 +720,7 @@ class ShardWorker:
         try:
             self.store.sync_persist()
             self.store.close()
-        except Exception:  # noqa: BLE001 — a fenced store refuses the
+        except Exception:  # noqa: BLE001 — a fenced store refuses the  # evglint: disable=shedcheck -- a fenced store refuses the final checkpoint; the lease release below is the operative cleanup
             # final checkpoint; the lease release below still runs
             pass
         self.lease.release()
@@ -820,7 +822,7 @@ class ShardWorker:
         self.draining = True
         try:
             self.store.close()
-        except Exception:  # noqa: BLE001 — best-effort shutdown
+        except Exception:  # noqa: BLE001 — best-effort shutdown  # evglint: disable=shedcheck -- fenced/broken store on final drain; lease release + manifest cleanup below still run
             pass
         self.lease.release()
         self._cleanup_manifest()
@@ -851,7 +853,7 @@ def bench_main(args, proto_out) -> int:
     from ..utils.benchgen import NOW, generate_problem
     from ..utils.gctune import tune_gc_for_long_lived_heap
 
-    lock = threading.Lock()
+    lock = _lockcheck.make_lock("runtime.worker.bench")
     distros, tbd, hbd, _, _ = generate_problem(
         args.bench_distros, args.bench_tasks, seed=args.bench_seed,
         task_group_fraction=0.25, patch_fraction=0.6,

@@ -204,7 +204,7 @@ class ScenarioRun:
             # async flusher thread; a fenced close may refuse work, but
             # the handles must not leak across multi-seed soaks
             self.store.close()
-        except Exception:  # noqa: BLE001 — fenced stores refuse closes
+        except Exception:  # noqa: BLE001 — fenced stores refuse closes  # evglint: disable=shedcheck -- a deposed holder's close is refused by the fence by design; handles die with the run
             pass
         self.lease = thief
         self.store = DurableStore(self.data_dir, lease=thief)
@@ -426,11 +426,11 @@ class ScenarioRun:
                 self.lease.release()
             if hasattr(self.store, "close"):
                 self.store.close()
-        except Exception:  # noqa: BLE001 — a fenced/failed-over store may
+        except Exception:  # noqa: BLE001 — a fenced/failed-over store may  # evglint: disable=shedcheck -- teardown after the scorecard is computed; nothing reads the store again
             # refuse close work; the scorecard is already computed
             pass
         if self.data_dir is not None:
-            shutil.rmtree(self.data_dir, ignore_errors=True)
+            shutil.rmtree(self.data_dir, ignore_errors=True)  # evglint: disable=fencecheck -- harness-owned temp data dir removed after the plane is closed; no live holder to fence against
 
 
 def run_scenario(spec: ScenarioSpec, seed: Optional[int] = None) -> Dict:

@@ -520,6 +520,272 @@ def _seasonality() -> ScenarioSpec:
     )
 
 
+# --------------------------------------------------------------------------- #
+# capacity-plane weathers (ISSUE 15 satellite; closes the ROADMAP item-5
+# "capacity weather" remainder)
+# --------------------------------------------------------------------------- #
+
+
+def _capacity_recorder(rec):
+    """`call` event fn: wrap the (store-cached) CapacityPlane.apply so
+    every tick's heuristic-in / decision-out pair lands in ``rec`` —
+    including whether the plane fell back by returning the heuristic
+    dict ITSELF (the bit-identity the breaker gate pins)."""
+
+    def install(run):
+        from ..scheduler.capacity_plane import capacity_plane_for
+
+        plane = capacity_plane_for(run.store)
+        orig = plane.apply
+
+        def recording_apply(distros, infos, new_hosts, hosts_by_distro,
+                            now, **kw):
+            before = dict(new_hosts)
+            out = orig(distros, infos, new_hosts, hosts_by_distro, now,
+                       **kw)
+            rec.append({
+                "tick": run.tick,
+                "in": before,
+                "out": dict(out),
+                "identity_fallback": out is new_hosts,
+                "existing": {
+                    d.id: len(hosts_by_distro.get(d.id, []))
+                    for d in distros
+                },
+            })
+            return out
+
+        plane.apply = recording_apply
+
+    return install
+
+
+def _set_capacity_config(**fields):
+    def fn(run):
+        from ..settings import CapacityConfig
+
+        cfg = CapacityConfig.get(run.store)
+        import dataclasses as _dc
+
+        _dc.replace(cfg, **fields).set(run.store)
+
+    return fn
+
+
+def _cap_share(entries, distro):
+    """``distro``'s share of all capacity intents granted in ``entries``
+    (0.0 when no intents were granted at all)."""
+    total = sum(sum(e["out"].values()) for e in entries)
+    mine = sum(e["out"].get(distro, 0) for e in entries)
+    return (mine / total) if total else 0.0
+
+
+def _capacity_price_spike(spike_tick: int = 6) -> ScenarioSpec:
+    rec = []
+
+    def check_solver_ran(run) -> Optional[str]:
+        applied = [e for e in rec if not e["identity_fallback"]]
+        pre = [e for e in applied if e["tick"] < spike_tick]
+        post = [e for e in applied if e["tick"] >= spike_tick]
+        if not pre or not post:
+            return (f"capacity solve must run on both sides of the "
+                    f"spike (pre={len(pre)}, post={len(post)})")
+        return None
+
+    def check_retraded(run) -> Optional[str]:
+        applied = [e for e in rec if not e["identity_fallback"]]
+        pre = _cap_share(
+            [e for e in applied if e["tick"] < spike_tick], "dpricey"
+        )
+        post = _cap_share(
+            [e for e in applied if e["tick"] >= spike_tick], "dpricey"
+        )
+        if pre <= 0.0:
+            return "pricey pool got nothing even at par pricing"
+        if post >= pre:
+            return (f"price spike did not move capacity off the pricey "
+                    f"pool (share {pre:.2f} -> {post:.2f})")
+        return None
+
+    def check_no_fallbacks(run) -> Optional[str]:
+        bad = [e["tick"] for e in rec if e["identity_fallback"]]
+        if bad:
+            return f"capacity plane fell back on ticks {bad}"
+        return None
+
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dcheap", "provider": Provider.MOCK.value, "hosts": 2,
+             "planner": {"capacity": "tpu"}, "max_hosts": 30},
+            {"id": "dpricey", "provider": Provider.EC2_FLEET.value,
+             "hosts": 2, "planner": {"capacity": "tpu"}, "max_hosts": 30,
+             "provider_settings": {"fleet_use_spot": False}},
+        ]}),
+        Ev(0, "call", {"fn": _capacity_recorder(rec)}),
+        # symmetric steady demand: with pools at par the solver splits
+        # the shared intent budget roughly evenly
+        *[Ev(t, "tasks", {"distro": d, "n": 8, "expected_s": 1800.0,
+                          "prefix": f"{d}-w{t}"})
+          for t in (1, 3, 5, 7, 9)
+          for d in ("dcheap", "dpricey")],
+        # tick `spike_tick`: the pricey pool's $/host-hour jumps 30x —
+        # the next solve must re-trade the budget toward the cheap pool
+        Ev(spike_tick, "call", {"fn": _set_capacity_config(
+            pool_prices={"mock": 1.0, "ec2-fleet": 30.0},
+            price_weight=0.2,
+        )}),
+    ]
+    return ScenarioSpec(
+        name="capacity-price-spike",
+        description="two capacity-opted distros on different provider "
+                    "pools under one fleet intent budget; a 30x price "
+                    "spike on one pool mid-run must re-trade capacity "
+                    "toward the cheap pool with zero solver fallbacks",
+        ticks=12,
+        events=events,
+        slos=[],
+        checks=[
+            ("solver-ran-both-sides", check_solver_ran),
+            ("spike-retrades-pools", check_retraded),
+            ("zero-capacity-fallbacks", check_no_fallbacks),
+        ],
+        tick_options={"create_intent_hosts": True},
+        config={"CapacityConfig": {
+            "pool_prices": {"mock": 1.0, "ec2-fleet": 1.0},
+            "price_weight": 0.2,
+            "fleet_intent_budget": 6,
+        }},
+    )
+
+
+def _capacity_quota_squeeze(
+    squeeze_tick: int = 4, fault_tick: int = 11
+) -> ScenarioSpec:
+    rec = []
+    quota_after = 8
+    pool_distros = ("ddeep", "dshallow")
+
+    def _headroom(e):
+        return max(
+            0, quota_after - sum(e["existing"].get(d, 0)
+                                 for d in pool_distros)
+        )
+
+    def check_feasible(run) -> Optional[str]:
+        # the deliberate capacity.solve fault at `fault_tick` is the ONE
+        # allowed fallback; the squeeze itself must never cause one
+        bad = [e["tick"] for e in rec
+               if e["identity_fallback"] and e["tick"] != fault_tick]
+        if bad:
+            return f"quota squeeze broke feasibility on ticks {bad}"
+        return None
+
+    def check_quota_respected(run) -> Optional[str]:
+        # post-squeeze the solver may only grant what the squeezed
+        # quota leaves over the EXISTING fleet (hosts the quota change
+        # cannot un-spawn drain through drawdown, not through the solve)
+        for e in rec:
+            if e["tick"] <= squeeze_tick or e["identity_fallback"]:
+                continue
+            granted = sum(e["out"].get(d, 0) for d in pool_distros)
+            if granted > _headroom(e):
+                return (f"tick {e['tick']}: granted {granted} new hosts "
+                        f"over headroom {_headroom(e)} of the squeezed "
+                        f"quota {quota_after}")
+        return None
+
+    def check_squeeze_binds(run) -> Optional[str]:
+        # the squeeze must be VISIBLE: at least one post-squeeze solve
+        # where the heuristic asked for more than the headroom and the
+        # solver held the line (otherwise this weather proves nothing)
+        for e in rec:
+            if e["tick"] <= squeeze_tick or e["identity_fallback"]:
+                continue
+            asked = sum(e["in"].get(d, 0) for d in pool_distros)
+            granted = sum(e["out"].get(d, 0) for d in pool_distros)
+            if asked > _headroom(e) and granted <= _headroom(e) < asked:
+                return None
+        return ("no post-squeeze tick where demand exceeded the "
+                "squeezed quota's headroom — the squeeze never bound")
+
+    def check_deep_outbids_shallow(run) -> Optional[str]:
+        solved = [e for e in rec if not e["identity_fallback"]]
+        deep = sum(e["out"].get("ddeep", 0) for e in solved)
+        shallow = sum(e["out"].get("dshallow", 0) for e in solved)
+        if deep <= shallow:
+            return (f"inside the shared pool the deep backlog must "
+                    f"outbid the shallow one (deep={deep}, "
+                    f"shallow={shallow})")
+        return None
+
+    def check_fallback_bit_identical(run) -> Optional[str]:
+        falls = [e for e in rec if e["tick"] == fault_tick]
+        if not falls:
+            return f"no capacity call recorded on fault tick {fault_tick}"
+        e = falls[0]
+        if not e["identity_fallback"]:
+            return "the injected capacity.solve fault did not fall back"
+        if e["out"] != e["in"]:
+            return ("fallback altered the heuristic counts — the "
+                    "bit-identical contract is broken")
+        return None
+
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "ddeep", "provider": Provider.MOCK.value, "hosts": 2,
+             "planner": {"capacity": "tpu"}, "max_hosts": 40},
+            {"id": "dshallow", "provider": Provider.MOCK.value, "hosts": 2,
+             "planner": {"capacity": "tpu"}, "max_hosts": 40},
+        ]}),
+        Ev(0, "call", {"fn": _capacity_recorder(rec)}),
+        # asymmetric backlogs inside ONE provider pool: a deep queue of
+        # long tasks vs a shallow queue of short ones
+        *[Ev(t, "tasks", {"distro": "ddeep", "n": 14,
+                          "expected_s": 2400.0, "prefix": f"ddeep-w{t}"})
+          for t in (1, 3)],
+        *[Ev(t, "tasks", {"distro": "dshallow", "n": 3,
+                          "expected_s": 600.0, "prefix": f"dshallow-w{t}"})
+          for t in (1, 3)],
+        # tick `squeeze_tick`: the shared pool quota collapses 24 -> 8
+        # (below the fleet the generous quota already built); the solver
+        # must keep every later grant inside the shrunken headroom
+        # without ever going infeasible
+        Ev(squeeze_tick, "call", {"fn": _set_capacity_config(
+            pool_quotas={"mock": quota_after},
+        )}),
+        # post-squeeze demand storms that the heuristic would chase with
+        # new hosts — the squeezed quota must hold the line
+        *[Ev(t, "tasks", {"distro": "ddeep", "n": 30,
+                          "expected_s": 2400.0, "prefix": f"ddeep-s{t}"})
+          for t in (6, 9)],
+        # tick `fault_tick`: a raising capacity solve — the plane must
+        # hand back the heuristic's counts bit-identically
+        Ev(fault_tick, "fault", {"seam": "capacity.solve"}),
+    ]
+    return ScenarioSpec(
+        name="capacity-quota-squeeze",
+        description="two capacity-opted distros sharing one provider "
+                    "pool; the pool quota collapses mid-run (solver "
+                    "keeps trading inside the smaller box, deep backlog "
+                    "outbids shallow) and an injected solve fault must "
+                    "fall back to bit-identical heuristic counts",
+        ticks=12,
+        events=events,
+        slos=[],
+        checks=[
+            ("squeeze-stays-feasible", check_feasible),
+            ("squeezed-quota-respected", check_quota_respected),
+            ("squeeze-binds", check_squeeze_binds),
+            ("deep-backlog-outbids-shallow", check_deep_outbids_shallow),
+            ("fallback-bit-identical", check_fallback_bit_identical),
+        ],
+        tick_options={"create_intent_hosts": True},
+        config={"CapacityConfig": {
+            "pool_quotas": {"mock": 24},
+        }},
+    )
+
+
 def _sabotage() -> ScenarioSpec:
     return ScenarioSpec(
         name="sabotage-duplicate-claim",
@@ -553,6 +819,8 @@ SCENARIOS: Dict[str, callable] = {
     "region-failover": _region_failover,
     "spawn-burst": _spawn_burst,
     "seasonality": _seasonality,
+    "capacity-price-spike": _capacity_price_spike,
+    "capacity-quota-squeeze": _capacity_quota_squeeze,
 }
 
 #: deliberately-broken specs the gate's self-test runs EXPECTING failure

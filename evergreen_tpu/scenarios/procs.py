@@ -410,7 +410,7 @@ class ProcScenarioRun:
                             pass
                     try:
                         h.proc.wait(timeout=5.0)
-                    except Exception:  # noqa: BLE001 — best effort
+                    except Exception:  # noqa: BLE001 — best effort  # evglint: disable=shedcheck -- child already SIGKILLed; the wait only reaps the zombie
                         pass
         try:
             if (
@@ -543,14 +543,14 @@ class ProcScenarioRun:
             for s in stores:
                 try:
                     s.close()
-                except Exception:  # noqa: BLE001 — inspection handles
+                except Exception:  # noqa: BLE001 — inspection handles  # evglint: disable=shedcheck -- post-run inspection handles on a dead fleet's stores
                     pass
 
     def _teardown(self) -> None:
         import shutil
 
         if self.data_dir is not None:
-            shutil.rmtree(self.data_dir, ignore_errors=True)
+            shutil.rmtree(self.data_dir, ignore_errors=True)  # evglint: disable=fencecheck -- harness-owned temp data dir removed after every worker process exited; no live holder to fence against
 
 
 # --------------------------------------------------------------------------- #

@@ -19,6 +19,8 @@ Invariants:
 from __future__ import annotations
 
 import threading
+
+from ..utils import lockcheck as _lockcheck
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..globals import TaskStatus
@@ -32,8 +34,8 @@ from ..storage.store import Store
 class TickCache:
     def __init__(self, store: Store) -> None:
         self.store = store
-        self._lock = threading.Lock()  # guards _runnable/_primed
-        self._dirty_lock = threading.Lock()  # leaf lock: guards _dirty only
+        self._lock = _lockcheck.make_lock("sched.cache")  # guards _runnable/_primed
+        self._dirty_lock = _lockcheck.make_lock("sched.cache.dirty")  # leaf lock: guards _dirty only
         self._dirty: Set[str] = set()
         self._primed = False
         #: runnable task id → materialized Task

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..models.distro import Distro
 from ..storage.store import Store
+from ..utils import lockcheck as _lockcheck
 from ..utils import metrics as _metrics
 
 CAPACITY_SOLVES = _metrics.counter(
@@ -352,7 +353,7 @@ class CapacityPlane:
 
 #: per-store planes (same lifetime pattern as the solve breakers)
 _planes: Dict[int, tuple] = {}
-_planes_lock = __import__("threading").Lock()
+_planes_lock = _lockcheck.make_lock("scheduler.capacity_planes")
 
 
 def capacity_plane_for(store: Store) -> CapacityPlane:

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import operator as _operator
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -185,7 +187,7 @@ class PersisterState:
 #: per-store PersisterState singletons (same id-keyed pattern as the
 #: scheduler's snapshot memos in wrapper.py)
 _states: Dict[int, tuple] = {}
-_states_lock = threading.Lock()
+_states_lock = _lockcheck.make_lock("persister.states")
 
 
 def persister_state_for(store: Store) -> PersisterState:

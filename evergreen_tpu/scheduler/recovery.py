@@ -55,6 +55,14 @@ RECOVERY_RECONCILED = _metrics.counter(
     legacy="recovery.reconciled_tasks",
 )
 
+RECOVERY_PROVIDER_ERRORS = _metrics.counter(
+    "recovery_provider_errors_total",
+    "Building-host status probes the cloud provider failed during a "
+    "recovery pass (the host is left to the periodic monitor; a spike "
+    "here means recovery healed less than it should have).",
+    legacy="recovery.provider_errors",
+)
+
 #: an in-flight task with no heartbeat for this long at recovery time is
 #: presumed dead (same window the periodic monitor uses,
 #: units/task_jobs.py::DEFAULT_HEARTBEAT_TIMEOUT_S)
@@ -176,7 +184,9 @@ def _reverify_building_hosts(
         try:
             cloud_status = mgr.get_instance_status(store, h)
         except Exception:  # noqa: BLE001 — an unreachable provider must
-            # not block recovery; the periodic monitor retries
+            # not block recovery; the periodic monitor retries, and the
+            # skipped probe is counted so it cannot hide
+            RECOVERY_PROVIDER_ERRORS.inc()
             continue
         if cloud_status not in (
             CloudHostStatus.TERMINATED,

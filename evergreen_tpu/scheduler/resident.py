@@ -63,6 +63,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from ..utils import lockcheck as _lockcheck
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -1787,7 +1789,7 @@ class _MirrorArena:
 
 #: per-store plane singletons (the id-keyed pattern of the snapshot memos)
 _planes: Dict[int, tuple] = {}
-_planes_lock = threading.Lock()
+_planes_lock = _lockcheck.make_lock("resident.planes")
 
 
 def resident_plane_for(store: Store) -> ResidentPlane:

@@ -62,6 +62,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
@@ -266,7 +268,7 @@ class _StackedRound:
                  timeout_s: float = 30.0) -> None:
         self.plane = plane
         self.timeout_s = timeout_s
-        self._cv = threading.Condition()
+        self._cv = _lockcheck.make_condition("sharded.round_cv")
         self._participants = set(shard_ids)
         self._snaps: Dict[int, object] = {}
         self._outs: Optional[Dict[int, dict]] = None
@@ -470,7 +472,7 @@ class ShardedScheduler:
             max_workers=max(1, self.n_shards),
             thread_name_prefix="shard-tick",
         )
-        self._lock = threading.Lock()  # serializes rounds + migrations
+        self._lock = _lockcheck.make_lock("sharded.plane")  # serializes rounds + migrations
         self._dispatchers: Dict[int, object] = {}
         #: host id → owning shard (invalidated on migration)
         self._host_shard: Dict[str, int] = {}
@@ -561,11 +563,11 @@ class ShardedScheduler:
                 for s in stores:
                     try:
                         s._journal.close()
-                    except Exception:  # noqa: BLE001 — best effort
+                    except Exception:  # noqa: BLE001 — best effort  # evglint: disable=shedcheck -- partial-fleet unwind; the re-raise below propagates the original failure
                         pass
                     try:
                         s._lease.release()
-                    except Exception:  # noqa: BLE001 — best effort
+                    except Exception:  # noqa: BLE001 — best effort  # evglint: disable=shedcheck -- partial-fleet unwind; the re-raise below propagates the original failure
                         pass
                 raise
         return cls(stores, **kw)
@@ -1211,7 +1213,7 @@ class ShardedScheduler:
         persists the trimmed collection instead of the full history."""
         try:
             self.compact_handoffs()
-        except Exception:  # noqa: BLE001 — compaction is housekeeping;
+        except Exception:  # noqa: BLE001 — compaction is housekeeping;  # evglint: disable=shedcheck -- compaction is housekeeping; shutdown must not block and close-time recovery heals
             # it must never block shutdown
             pass
         self._pool.shutdown(wait=False)
@@ -1219,13 +1221,13 @@ class ShardedScheduler:
             if getattr(s, "data_dir", None) is not None:
                 try:
                     s.close()
-                except Exception:  # noqa: BLE001 — best-effort shutdown
+                except Exception:  # noqa: BLE001 — best-effort shutdown  # evglint: disable=shedcheck -- best-effort shutdown; close is idempotent and startup recovery heals
                     pass
             lease = getattr(s, "_lease", None)
             if lease is not None:
                 try:
                     lease.release()
-                except Exception:  # noqa: BLE001 — best-effort shutdown
+                except Exception:  # noqa: BLE001 — best-effort shutdown  # evglint: disable=shedcheck -- best-effort shutdown; lease TTL expiry covers a failed release
                     pass
 
 

@@ -142,7 +142,9 @@ class TickOptions:
 #: interpreter). Guarded so concurrent first ticks cannot register two
 #: listeners.
 _tick_caches: Dict[int, object] = {}
-_tick_caches_lock = __import__("threading").Lock()
+from ..utils import lockcheck as _lockcheck
+
+_tick_caches_lock = _lockcheck.make_lock("sched.tick_caches")
 
 
 def tick_cache_for(store: Store):
@@ -1041,7 +1043,7 @@ def _run_tick_body(
     monitor = overload_mod.monitor_for(store)
     olevel = monitor.evaluate(now)
 
-    def _shed_optional() -> str:
+    def _shed_optional() -> str:  # evglint: disable=shedcheck -- predicate only: the callers acting on the reason record the shed (stats_shed events + scheduler_ticks_degraded counter)
         """"" when optional work may run, else the shed reason."""
         if olevel >= overload_mod.RED:
             return "overload"

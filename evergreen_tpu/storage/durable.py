@@ -70,6 +70,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from ..utils import lockcheck as _lockcheck
 from typing import Dict, Optional
 
 from .lease import EpochFencedError, FileLease
@@ -141,7 +143,7 @@ class _Journal:
     def __init__(self, path: str, sync: str = "flush") -> None:
         self.path = path
         self.sync = sync  # "none" | "flush" | "fsync"
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("wal.journal")
         # Repair a torn tail BEFORE appending: a crash mid-append leaves
         # an unterminated final line; appending straight onto it would
         # merge two records into one terminated-but-corrupt line that
@@ -281,7 +283,7 @@ class _Journal:
             if self.sync != "none":
                 self._fh.flush()
                 if self.sync == "fsync":
-                    os.fsync(self._fh.fileno())
+                    os.fsync(self._fh.fileno())  # evglint: disable=lockgraph -- the fsync IS the WAL write barrier: appends must queue behind durability; group commit amortizes it to one per tick
             self.ops += n_ops
 
     def rotate(self) -> None:
@@ -336,7 +338,7 @@ class DurableStore(Store):
         self._wal_name = wal_segment_name(shard_id)
         self._snapshot_name = snapshot_segment_name(shard_id)
         self.compact_every_ops = compact_every_ops
-        self._compact_lock = threading.Lock()
+        self._compact_lock = _lockcheck.make_lock("durable.compact")
         #: split-brain fence: bound to the holder's lease epoch at open.
         #: epoch 0 (no lease — tests, tools) disables stamping + fencing.
         self._lease = lease
@@ -351,7 +353,7 @@ class DurableStore(Store):
         )
         #: background group-commit flusher (started lazily on the first
         #: async commit); pending frames + deferred errors
-        self._flush_lock = threading.Lock()
+        self._flush_lock = _lockcheck.make_lock("durable.flush")
         self._flush_cv = threading.Condition(self._flush_lock)
         self._flush_queue: list = []
         self._flush_errors: list = []
@@ -820,7 +822,7 @@ class DurableStore(Store):
         # no buffered record is orphaned
         try:
             self.end_tick()
-        except Exception:  # noqa: BLE001 — close() is best-effort
+        except Exception:  # noqa: BLE001 — close() is best-effort  # evglint: disable=shedcheck -- close() is best-effort; a fenced store refuses the final frame by design and recovery replays the WAL
             pass
         try:
             self.checkpoint()

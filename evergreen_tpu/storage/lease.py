@@ -38,6 +38,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 import uuid
 from typing import Callable, Optional
@@ -104,7 +106,7 @@ class FileLease:
         #: (ensure_epoch_at_least during recovery): a renewal half-done
         #: across the bump must not read a mixed owner/epoch view and
         #: spuriously stand the holder down
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = _lockcheck.make_lock("lease.epoch")
 
     # -- core ---------------------------------------------------------------- #
 

@@ -38,6 +38,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Callable, Dict, Iterable, Optional
 
@@ -175,7 +177,7 @@ class ReplicaStore(Store):
         #: and REST threads doing post-forward catch-up polls must not
         #: interleave (an older full-document put re-applied after a
         #: newer one would undo the read-your-writes guarantee)
-        self._poll_lock = threading.Lock()
+        self._poll_lock = _lockcheck.make_lock("replica.poll")
         self._wal_pos = 0
         #: highest lease epoch seen in group frames; during a failover a
         #: superseded holder's frame interleaving past the fence point is

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import copy
 import threading
+
+from ..utils import lockcheck as _lockcheck
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 
@@ -33,7 +35,7 @@ class Collection:
         self.name = name
         self._docs: Dict[str, dict] = {}
         self._journal = journal
-        self._lock = threading.RLock()
+        self._lock = _lockcheck.make_rlock("store.collection")
         #: change listeners: fn(doc_id) called after any write touching the
         #: doc. Callbacks MUST be trivial (set a dirty flag) — they run
         #: under the collection lock.
@@ -456,7 +458,7 @@ class Store:
 
     def __init__(self) -> None:
         self._collections: Dict[str, Collection] = {}
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("store.db")
 
     def collection(self, name: str) -> Collection:
         with self._lock:
@@ -517,7 +519,7 @@ class Store:
 
 
 _GLOBAL_STORE: Optional[Store] = None
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = _lockcheck.make_lock("store.global")
 
 
 def global_store() -> Store:

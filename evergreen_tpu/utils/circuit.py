@@ -25,6 +25,8 @@ callers keep the breaker deterministic under test clocks.
 from __future__ import annotations
 
 import threading
+
+from . import lockcheck as _lockcheck
 import time as _time
 from typing import Optional
 
@@ -63,7 +65,7 @@ class CircuitBreaker:
         self.failure_threshold = max(1, failure_threshold)
         self.cooldown_s = cooldown_s
         self.probes = max(1, probes)
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("circuit.breaker")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0

@@ -66,6 +66,8 @@ from __future__ import annotations
 import os
 import random
 import threading
+
+from . import lockcheck as _lockcheck
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -106,7 +108,7 @@ class FaultPlan:
     """Deterministic schedule of faults keyed by (seam, call index)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("faults.plan")
         self._at: Dict[str, Dict[int, Fault]] = {}
         self._always: Dict[str, Fault] = {}
         self._calls: Dict[str, int] = {}

@@ -158,7 +158,7 @@ def _probe_tpu_detail_inner(
     if not env.get("PALLAS_AXON_POOL_IPS"):
         return False, "no-pool-ips"
     try:
-        r = subprocess.run(
+        r = subprocess.run(  # evglint: disable=seamcheck -- diagnostic probe of the child-interpreter env; the failure IS the reported result
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s,
             capture_output=True,

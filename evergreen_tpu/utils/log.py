@@ -21,6 +21,8 @@ from __future__ import annotations
 import json
 import sys
 import threading
+
+from . import lockcheck as _lockcheck
 import time as _time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -28,7 +30,7 @@ LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
 
 Sink = Callable[[dict], None]
 
-_lock = threading.Lock()
+_lock = _lockcheck.make_lock("log.stream")
 _sinks: List[Sink] = []
 _threshold = LEVELS["info"]
 
@@ -38,7 +40,7 @@ _threshold = LEVELS["info"]
 # (breaker transitions, retry exhaustion, degraded ticks, quarantined
 # jobs) bump these so a soak run is auditable without parsing every line.
 
-_counter_lock = threading.Lock()
+_counter_lock = _lockcheck.make_lock("log.counters")
 _counters: Dict[str, int] = {}
 
 
@@ -112,7 +114,7 @@ class BufferedSink:
         self.interval_s = interval_s
         self._buf: List[dict] = []
         self._last_flush = _time.time()
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("log.batch_sink")
 
     def __call__(self, record: dict) -> None:
         flush_now: Optional[List[dict]] = None
@@ -151,7 +153,7 @@ class StoreSink:
         self._seq = max(
             (int(k.rsplit("-", 1)[1]) for k in existing), default=0
         )
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("log.event_writer")
 
     def __call__(self, record: dict) -> None:
         with self._lock:
@@ -193,8 +195,10 @@ class Logger:
             try:
                 sink(record)
             except Exception:
-                # a broken sink must never take down the caller
-                pass
+                # a broken sink must never take down the caller — but a
+                # sink that drops every record must not stay invisible
+                # either (zero-silent-discards): count the loss
+                incr_counter("log.sink_errors")
 
     def debug(self, message: str, **fields: Any) -> None:
         self._emit("debug", message, fields)

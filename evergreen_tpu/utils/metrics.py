@@ -34,6 +34,8 @@ from __future__ import annotations
 import math
 import re
 import threading
+
+from . import lockcheck as _lockcheck
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import log as _log
@@ -154,7 +156,7 @@ class _Instrument:
         self.help = help
         self.labelnames: Tuple[str, ...] = tuple(labels)
         self.max_series = max_series
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("metrics.instrument")
         #: label-values tuple -> series payload (float for counter/gauge,
         #: [bucket_counts, sum, count] for histograms)
         self._series: Dict[Tuple[str, ...], object] = {}
@@ -455,7 +457,7 @@ class Histogram(_Instrument):
 
 class MetricsRegistry:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("metrics.registry")
         self._instruments: Dict[str, _Instrument] = {}
 
     def register(self, inst: _Instrument) -> _Instrument:

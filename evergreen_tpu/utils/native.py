@@ -13,9 +13,11 @@ import os
 import subprocess
 import sysconfig
 import threading
+
+from . import lockcheck as _lockcheck
 from typing import Optional
 
-_lock = threading.Lock()
+_lock = _lockcheck.make_lock("native.loader")
 _module = None
 _attempted = False
 
@@ -31,7 +33,7 @@ def _build(src: str, out: str) -> bool:
         f"-I{include}", src, "-o", out,
     ]
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)  # evglint: disable=seamcheck -- build-time compiler invocation; no runtime fault surface, the import falls back to the Python packer
         return r.returncode == 0
     except (OSError, subprocess.TimeoutExpired):
         return False

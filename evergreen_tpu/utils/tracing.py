@@ -29,6 +29,8 @@ from __future__ import annotations
 import contextlib
 import itertools
 import threading
+
+from . import lockcheck as _lockcheck
 import time as _time
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
@@ -39,7 +41,7 @@ from . import metrics as _metrics
 SPANS_COLLECTION = "spans"
 
 _seq = itertools.count()
-_seq_lock = threading.Lock()
+_seq_lock = _lockcheck.make_lock("trace.seq")
 _local = threading.local()
 
 #: process-wide on/off switch (the "sampled-off" arm of the overhead
@@ -130,7 +132,7 @@ class TraceRing:
                  max_spans_per_trace: int = 512) -> None:
         self.max_traces = max_traces
         self.max_spans_per_trace = max_spans_per_trace
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("trace.sink")
         #: trace id -> [span records], insertion-ordered by first span
         self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
 
@@ -174,7 +176,7 @@ class TraceRing:
 
 
 _global_ring = TraceRing()
-_ring_lock = threading.Lock()
+_ring_lock = _lockcheck.make_lock("trace.ring")
 
 
 def trace_ring_for(store: Optional[Store]) -> TraceRing:
@@ -256,7 +258,7 @@ class Tracer:
         ring_only = record.pop("_ring_only", False)
         try:
             trace_ring_for(self.store).add(record)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # evglint: disable=shedcheck -- tracing must never break the traced caller; loss is bounded by the ring buffer
             pass
         if self.store is None or ring_only:
             return
@@ -267,7 +269,7 @@ class Tracer:
                 TRACE_STORE_SHED.inc()
                 return
             self.store.collection(SPANS_COLLECTION).upsert(record)
-        except Exception:  # noqa: BLE001 — never break the caller
+        except Exception:  # noqa: BLE001 — never break the caller  # evglint: disable=shedcheck -- tracing must never break the traced caller; loss is bounded by the ring buffer
             pass
 
 
@@ -286,7 +288,7 @@ def _collect_trace_spans(store: Optional[Store], trace_id: str) -> List[dict]:
                 lambda d: d.get("trace_root") == trace_id
             ):
                 spans.setdefault(s["_id"], dict(s))
-        except Exception:  # noqa: BLE001 — a broken store still serves ring
+        except Exception:  # noqa: BLE001 — a broken store still serves ring  # evglint: disable=shedcheck -- tracing must never break the traced caller; loss is bounded by the ring buffer
             pass
     return sorted(spans.values(), key=lambda s: (
         s.get("started_at", 0.0), s.get("_id", "")
@@ -343,7 +345,7 @@ def recent_traces(store: Optional[Store], last: int = 10) -> List[dict]:
                     "duration_ms": round(s.get("duration_ms", 0.0), 3),
                     "n_spans": 0,
                 })
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # evglint: disable=shedcheck -- tracing must never break the traced caller; loss is bounded by the ring buffer
             pass
     out = sorted(seen.values(), key=lambda d: d["started_at"])
     return out[-max(1, int(last)):]
@@ -461,7 +463,7 @@ def export_spans(store: Store, endpoint: str = "", batch: int = 512) -> int:
         method="POST",
         headers={"Content-Type": "application/json"},
     )
-    with urllib.request.urlopen(req, timeout=10.0):
+    with urllib.request.urlopen(req, timeout=10.0):  # evglint: disable=seamcheck -- the export is its own retry loop: a failed POST leaves spans in the collection and the next sweep re-drains them
         pass
     # the collector owns exported spans now: drop them so the spans
     # collection (and the per-minute not-yet-exported scan) stays bounded

@@ -46,6 +46,62 @@ def _observability_isolation():
     tracing_mod.global_ring().clear()
 
 
+@pytest.fixture(autouse=True)
+def _thread_and_lease_hygiene():
+    """Concurrency hygiene at teardown (ISSUE 15 satellite): a test
+    that leaks a non-daemon thread hangs interpreter exit, and a test
+    that strands an ArenaPool lease corrupts a LATER test's in-flight
+    solve when the pool force-rotates — today only the forced-rotation
+    counter would notice, many ticks later. Fail the leaking test
+    itself, with names, while the evidence still points at it."""
+    import threading
+    import time
+
+    from evergreen_tpu.scheduler import wrapper as _wrapper
+
+    threads_before = set(threading.enumerate())
+
+    def _lease_counts():
+        with _wrapper._tick_caches_lock:
+            return {
+                id(pool): sum(len(v) for v in pool._leased.values())
+                for (_s, _m1, _m2, pool) in _wrapper._sched_memos.values()
+            }
+
+    leases_before = _lease_counts()
+    yield
+    leaked = [
+        t for t in threading.enumerate()
+        if t not in threads_before and t.is_alive() and not t.daemon
+    ]
+    if leaked:
+        # a teardown that already signalled its threads gets a beat to
+        # join them before we call it a leak
+        deadline = time.monotonic() + 2.0
+        while leaked and time.monotonic() < deadline:
+            time.sleep(0.05)
+            leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        pytest.fail(
+            "test leaked non-daemon thread(s): "
+            + ", ".join(sorted(t.name for t in leaked)),
+            pytrace=False,
+        )
+    leases_after = _lease_counts()
+    stranded = {
+        k: n - leases_before.get(k, 0)
+        for k, n in leases_after.items()
+        if n > leases_before.get(k, 0)
+    }
+    if stranded:
+        pytest.fail(
+            f"test stranded {sum(stranded.values())} ArenaPool "
+            "lease(s) — every Arena.finalize(pool=...) needs a "
+            "try/finally close() so fault paths return the buffers",
+            pytrace=False,
+        )
+
+
 @pytest.fixture()
 def store():
     """Fresh store per test — the db.ClearCollections analog — plus resets

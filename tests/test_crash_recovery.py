@@ -403,6 +403,31 @@ def test_recovery_releases_half_dispatched_claim(store):
     assert t.status == TaskStatus.UNDISPATCHED.value
 
 
+def test_recovery_counts_provider_probe_failures(store, monkeypatch):
+    """evglint shedcheck regression: a building host whose provider
+    probe raises is SKIPPED by recovery (the periodic monitor retries),
+    but the skip must be counted — an unreachable provider during
+    recovery can no longer hide."""
+    from evergreen_tpu.cloud.mock import MockCloudManager
+    from evergreen_tpu.utils.log import get_counter
+
+    host_mod.insert(
+        store,
+        Host(id="hb1", distro_id="d1", provider=Provider.MOCK.value,
+             status=HostStatus.BUILDING.value, external_id="mock-hb1"),
+    )
+
+    def boom(self, store_, h):
+        raise RuntimeError("provider API down")
+
+    monkeypatch.setattr(MockCloudManager, "get_instance_status", boom)
+    before = get_counter("recovery.provider_errors")
+    run_recovery_pass(store, now=NOW)
+    assert get_counter("recovery.provider_errors") == before + 1
+    # the host is left for the periodic monitor, not terminated
+    assert host_mod.get(store, "hb1").status == HostStatus.BUILDING.value
+
+
 def test_recovery_keeps_coherent_assignment(store):
     task_mod.insert(
         store,

@@ -1,0 +1,513 @@
+"""evglint (ISSUE 15): the shared static-analysis core, the six passes,
+the suppression contract, and — the load-bearing regression — a fully
+clean tree (every finding the passes surfaced in existing code is fixed
+or carries a justified suppression; anything NEW fails here before it
+fails the gate)."""
+import textwrap
+
+import pytest
+
+from tools.evglint import core
+from tools.evglint.passes import (
+    ALL_PASSES,
+    fencecheck,
+    lockgraph,
+    metricscheck,
+    seamcheck,
+    shedcheck,
+    tracercheck,
+)
+
+
+def mod(rel, source):
+    return core.Module(rel, textwrap.dedent(source))
+
+
+def run_on(p, *modules):
+    return p.run(list(modules))
+
+
+# --------------------------------------------------------------------------- #
+# core: suppressions
+# --------------------------------------------------------------------------- #
+
+
+def test_suppression_requires_justification():
+    m = mod("evergreen_tpu/x.py", """\
+        import threading
+        _l = threading.Lock()  # evglint: disable=lockgraph
+        """)
+    assert len(m.bad_suppressions) == 1
+    assert "justification" in m.bad_suppressions[0].message
+    # and WITHOUT the reason it does not suppress
+    assert m.is_suppressed("lockgraph", 2) is False
+
+
+def test_trailing_suppression_covers_its_line():
+    m = mod("evergreen_tpu/x.py", """\
+        import threading
+        _l = threading.Lock()  # evglint: disable=lockgraph -- unit-test lock
+        """)
+    assert m.is_suppressed("lockgraph", 2)
+    assert not m.is_suppressed("shedcheck", 2)
+    findings = core.run_passes([lockgraph], [m])
+    assert findings == []
+
+
+def test_standalone_suppression_covers_next_line():
+    m = mod("evergreen_tpu/x.py", """\
+        import threading
+        # evglint: disable=lockgraph -- unit-test lock
+        _l = threading.Lock()
+        """)
+    assert m.is_suppressed("lockgraph", 3)
+    assert core.run_passes([lockgraph], [m]) == []
+
+
+def test_unsuppressed_finding_survives_runner():
+    m = mod("evergreen_tpu/x.py", """\
+        import threading
+        _l = threading.Lock()
+        """)
+    findings = core.run_passes([lockgraph], [m])
+    assert len(findings) == 1
+    assert findings[0].passname == "lockgraph"
+
+
+# --------------------------------------------------------------------------- #
+# sabotage self-test: one seeded violation per pass, each caught
+# --------------------------------------------------------------------------- #
+
+
+def test_sabotage_selftest_catches_every_pass():
+    assert core.sabotage_selftest(ALL_PASSES) == 0
+
+
+def test_sabotage_selftest_reports_blind_pass():
+    class Blind:
+        NAME = "blind"
+        SABOTAGE = {"rel": "evergreen_tpu/x.py", "source": "x = 1\n"}
+
+        @staticmethod
+        def run(modules):
+            return []
+
+    assert core.sabotage_selftest([Blind]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# lockgraph
+# --------------------------------------------------------------------------- #
+
+
+def test_lockgraph_detects_static_inversion():
+    m = mod("evergreen_tpu/x.py", """\
+        from evergreen_tpu.utils import lockcheck as _lockcheck
+        A = _lockcheck.make_lock("a")
+        B = _lockcheck.make_lock("b")
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+        """)
+    msgs = [f.message for f in run_on(lockgraph, m)]
+    assert any("inversion" in s for s in msgs)
+
+
+def test_lockgraph_consistent_order_is_clean():
+    m = mod("evergreen_tpu/x.py", """\
+        from evergreen_tpu.utils import lockcheck as _lockcheck
+        A = _lockcheck.make_lock("a")
+        B = _lockcheck.make_lock("b")
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with A:
+                with B:
+                    pass
+        """)
+    assert run_on(lockgraph, m) == []
+
+
+def test_lockgraph_blocking_call_under_lock():
+    m = mod("evergreen_tpu/x.py", """\
+        import time
+        from evergreen_tpu.utils import lockcheck as _lockcheck
+        A = _lockcheck.make_lock("a")
+
+        def f():
+            with A:
+                time.sleep(1)
+        """)
+    msgs = [f.message for f in run_on(lockgraph, m)]
+    assert any("blocking call" in s and "sleep" in s for s in msgs)
+
+
+def test_lockgraph_condition_over_existing_lock_is_not_raw():
+    m = mod("evergreen_tpu/x.py", """\
+        import threading
+        from evergreen_tpu.utils import lockcheck as _lockcheck
+
+        class C:
+            def __init__(self):
+                self._l = _lockcheck.make_lock("c.l")
+                self._cv = threading.Condition(self._l)
+        """)
+    assert run_on(lockgraph, m) == []
+
+
+def test_lockgraph_self_attr_locks_resolve_through_class():
+    m = mod("evergreen_tpu/x.py", """\
+        from evergreen_tpu.utils import lockcheck as _lockcheck
+
+        class C:
+            def __init__(self):
+                self._a = _lockcheck.make_lock("c.a")
+                self._b = _lockcheck.make_lock("c.b")
+
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    msgs = [f.message for f in run_on(lockgraph, m)]
+    assert any("inversion" in s for s in msgs)
+
+
+# --------------------------------------------------------------------------- #
+# tracercheck
+# --------------------------------------------------------------------------- #
+
+
+def test_tracercheck_flags_all_four_violation_kinds():
+    m = mod("evergreen_tpu/ops/x.py", """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def bad(x):
+            if x > 0:
+                x = x + 1
+            y = float(x)
+            z = np.argsort(x)
+            return x.item() + y + z
+        """)
+    msgs = [f.message for f in run_on(tracercheck, m)]
+    assert any("branch on a traced value" in s for s in msgs)
+    assert any("float() on a traced value" in s for s in msgs)
+    assert any("NumPy call" in s for s in msgs)
+    assert any(".item()" in s for s in msgs)
+
+
+def test_tracercheck_static_idioms_are_clean():
+    m = mod("evergreen_tpu/ops/x.py", """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def ok(x, n, mask=None):
+            if n > 4:                       # static arg
+                x = x * 2
+            if x.shape[0] > 8:              # shapes are static
+                x = x[:8]
+            if mask is None:                # structural, not traced
+                mask = jnp.ones_like(x)
+            lit = np.float32(0.5)           # weak-type literal cast
+            return jnp.where(mask > 0, x * lit, x)
+        """)
+    assert run_on(tracercheck, m) == []
+
+
+def test_tracercheck_ignores_non_ops_modules():
+    m = mod("evergreen_tpu/api/x.py", """\
+        import jax
+
+        @jax.jit
+        def bad(x):
+            return x.item()
+        """)
+    assert run_on(tracercheck, m) == []
+
+
+def test_tracercheck_jit_wrap_site_static_argnums():
+    m = mod("evergreen_tpu/ops/x.py", """\
+        import jax
+
+        def solve(arr, n):
+            if n > 2:                       # static via wrap site
+                arr = arr * 2
+            return arr
+
+        solve_j = jax.jit(solve, static_argnums=(1,))
+        """)
+    assert run_on(tracercheck, m) == []
+
+
+# --------------------------------------------------------------------------- #
+# fencecheck
+# --------------------------------------------------------------------------- #
+
+
+def test_fencecheck_flags_store_path_mutation_outside_storage():
+    m = mod("evergreen_tpu/scheduler/x.py", """\
+        import os
+
+        def clobber(data_dir):
+            os.rename(os.path.join(data_dir, "wal.log"), "/tmp/x")
+        """)
+    assert len(run_on(fencecheck, m)) == 1
+
+
+def test_fencecheck_exempts_storage_and_unrelated_paths():
+    inside = mod("evergreen_tpu/storage/x.py", """\
+        import os
+
+        def fine(data_dir):
+            os.rename(os.path.join(data_dir, "wal.log"), "/tmp/x")
+        """)
+    unrelated = mod("evergreen_tpu/agent/x.py", """\
+        def fine(workdir):
+            with open(workdir + "/task_output.txt", "w") as f:
+                f.write("hi")
+        """)
+    assert run_on(fencecheck, inside, unrelated) == []
+
+
+# --------------------------------------------------------------------------- #
+# shedcheck
+# --------------------------------------------------------------------------- #
+
+
+def test_shedcheck_broad_silent_swallow_vs_narrow_and_fallback():
+    m = mod("evergreen_tpu/x.py", """\
+        def a():
+            try:
+                work()
+            except Exception:
+                pass            # flagged: pure broad swallow
+
+        def b():
+            try:
+                work()
+            except OSError:
+                pass            # narrow teardown: fine
+
+        def c():
+            try:
+                work()
+            except Exception:
+                result = None   # fallback action taken: fine
+            return result
+        """)
+    findings = run_on(shedcheck, m)
+    assert len(findings) == 1
+    assert findings[0].line == 4
+
+
+def test_shedcheck_discard_function_needs_instrument():
+    bad = mod("evergreen_tpu/x.py", """\
+        def shed_load(q, n):
+            del q[:n]
+        """)
+    good = mod("evergreen_tpu/y.py", """\
+        SHEDS = object()
+
+        def shed_load(q, n):
+            del q[:n]
+            SHEDS.inc(n)
+        """)
+    assert len(run_on(shedcheck, bad)) == 1
+    assert run_on(shedcheck, good) == []
+
+
+def test_shedcheck_is_finished_is_not_a_shed_path():
+    m = mod("evergreen_tpu/x.py", """\
+        def is_finished(t):
+            return t.done
+        """)
+    assert run_on(shedcheck, m) == []
+
+
+# --------------------------------------------------------------------------- #
+# seamcheck
+# --------------------------------------------------------------------------- #
+
+
+def test_seamcheck_flags_unseamed_external_call():
+    m = mod("evergreen_tpu/cloud/x.py", """\
+        import subprocess
+
+        def provision(host):
+            subprocess.run(["ssh", host])
+        """)
+    assert len(run_on(seamcheck, m)) == 1
+
+
+def test_seamcheck_seam_registered_module_is_exempt():
+    m = mod("evergreen_tpu/cloud/x.py", """\
+        import subprocess
+        from ..utils.retry import RetryPolicy
+
+        def provision(host):
+            subprocess.run(["ssh", host])
+        """)
+    assert run_on(seamcheck, m) == []
+
+
+# --------------------------------------------------------------------------- #
+# metrics pass + the migrated CLI
+# --------------------------------------------------------------------------- #
+
+
+def test_metrics_pass_catches_seeded_violations():
+    m = mod("evergreen_tpu/utils/x.py", """\
+        from . import metrics as _metrics
+
+        A = _metrics.counter(f"dyn_{1}", "h")
+        B = _metrics.counter("scheduler_things", "h")
+        C = _metrics.histogram("scheduler_wait_s", "h")
+        """)
+    msgs = [f.message for f in run_on(metricscheck, m)]
+    assert any("literal string" in s for s in msgs)
+    assert any("_total" in s for s in msgs)
+    assert any("_ms" in s for s in msgs)
+
+
+def test_metrics_lint_cli_is_the_sixth_pass():
+    """tools/metrics_lint.py must stay a faithful alias: clean tree ⇒
+    empty list, same strings as the pass emits."""
+    from tools import metrics_lint
+
+    assert metrics_lint.lint() == []
+
+
+# --------------------------------------------------------------------------- #
+# THE regression test: the whole tree is clean under all six passes
+# --------------------------------------------------------------------------- #
+
+
+def test_whole_tree_is_clean():
+    """Every finding evglint surfaced in existing code was fixed or
+    suppressed with a justification; a regression in ANY pass over ANY
+    package file fails here (and would fail the gate identically)."""
+    findings = core.run_passes(core.load_passes(), core.iter_modules())
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# --------------------------------------------------------------------------- #
+# review regressions
+# --------------------------------------------------------------------------- #
+
+
+def test_lockgraph_with_statement_blocking_context_expr():
+    """Review regression: `with urlopen(req) as r:` under a held lock
+    is the dominant blocking idiom and must be flagged like the bare
+    call form."""
+    m = mod("evergreen_tpu/x.py", """\
+        from urllib.request import urlopen
+        from evergreen_tpu.utils import lockcheck as _lockcheck
+        A = _lockcheck.make_lock("a")
+
+        def f(req):
+            with A:
+                with urlopen(req) as resp:
+                    return resp.read()
+        """)
+    msgs = [f.message for f in run_on(lockgraph, m)]
+    assert any("blocking call" in s and "urlopen" in s for s in msgs)
+
+
+def test_trailing_suppression_maps_to_innermost_statement_only():
+    """Review regression: a suppression on the FINAL line of a function
+    body previously also mapped to the enclosing FunctionDef (whose
+    span ends on the same line), silently suppressing an unrelated
+    finding anchored at the `def` line. Only the innermost
+    non-compound statement may inherit the suppression."""
+    m = mod("evergreen_tpu/x.py", """\
+        def shed_load(q, n):
+            try:
+                del q[:n]
+            except Exception:
+                pass  # evglint: disable=shedcheck -- pinned to this line, NOT to shed_load
+        """)
+    findings = core.run_passes([shedcheck], [m])
+    # neither the swallow at line 4 (the suppression sits on line 5 and
+    # must not crawl up to the handler) nor — the regression — the
+    # uninstrumented shed_load finding at line 1 is suppressed
+    assert sorted(f.line for f in findings) == [1, 4]
+    assert any("shed_load" in f.message for f in findings)
+    # placed ON the except line, the suppression covers exactly the
+    # swallow and nothing else
+    m2 = mod("evergreen_tpu/x.py", """\
+        def shed_load(q, n):
+            try:
+                del q[:n]
+            except Exception:  # evglint: disable=shedcheck -- justified for THIS swallow only
+                pass
+        """)
+    findings2 = core.run_passes([shedcheck], [m2])
+    assert [f.line for f in findings2] == [1]
+    assert "shed_load" in findings2[0].message
+
+
+def test_metrics_multiscope_instrument_needs_every_label():
+    """Review regression: shard/replica/worker scope rules are
+    independent — a name matching two scopes is checked for both."""
+    m = mod("evergreen_tpu/utils/x.py", """\
+        from . import metrics as _metrics
+
+        A = _metrics.gauge(
+            "scheduler_shard_replica_lag_ms",
+            "per-shard per-replica applied lag",
+            labels=("shard",),
+        )
+        """)
+    msgs = [f.message for f in run_on(metricscheck, m)]
+    assert any("'replica' label" in s for s in msgs)
+    assert not any("'shard' label" in s for s in msgs)
+
+
+def test_lockgraph_catches_the_import_dodge():
+    """Review regression: `__import__("threading").Lock()` is the same
+    raw primitive with the import hidden in a call — the inventory rule
+    must see it (capacity_plane.py shipped one for two PRs)."""
+    m = mod("evergreen_tpu/x.py", """\
+        _l = __import__("threading").Lock()
+        """)
+    msgs = [f.message for f in run_on(lockgraph, m)]
+    assert any("raw threading.Lock()" in s for s in msgs)
+
+
+def test_fencecheck_tracks_store_paths_through_locals():
+    """Review regression: hiding the data-dir path behind local
+    variables must not blind the pass (the fleet-manifest write shape)."""
+    m = mod("evergreen_tpu/runtime/x.py", """\
+        import os
+
+
+        def publish(data_dir, shard, pid):
+            path = os.path.join(data_dir, "fleet", f"{shard}.json")
+            tmp = f"{path}.{pid}"
+            with open(tmp, "w") as fh:
+                fh.write("{}")
+            os.replace(tmp, path)
+        """)
+    findings = run_on(fencecheck, m)
+    assert len(findings) == 2  # the open AND the replace

@@ -67,8 +67,14 @@ def test_broken_sink_never_breaks_caller():
         raise RuntimeError("sink down")
 
     reset_sinks(bad, got.append)
+    from evergreen_tpu.utils.log import get_counter
+
+    before = get_counter("log.sink_errors")
     Logger("c").info("still delivered")
     assert [r["message"] for r in got] == ["still delivered"]
+    # evglint shedcheck regression: the swallowed sink failure must
+    # reconcile somewhere — the loss is counted, never silent
+    assert get_counter("log.sink_errors") == before + 1
 
 
 def test_buffered_sink_flushes_on_count_and_age():

@@ -44,6 +44,11 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
+# runtime lock-order witness for the parent harness AND (via inherited
+# env) every child process: an inversion in a surviving child fails that
+# child's exit code; parent-side inversions fail the matrix at the end
+os.environ.setdefault("EVERGREEN_TPU_LOCKCHECK", "1")
+
 #: deterministic workload clock (same anchor the fault matrix uses)
 NOW = 1_700_000_000.0
 TICK_S = 15.0
@@ -248,6 +253,14 @@ def child_main(argv: Optional[List[str]] = None) -> int:
         print("HOLDING", flush=True)
         sys.stdin.readline()  # parent signals; lease stays held meanwhile
     lease.release()
+    # a surviving child audits the lock-order witness before reporting
+    # success: an inversion on any of its threads is a failure even
+    # though the workload converged
+    from evergreen_tpu.utils import lockcheck
+
+    if lockcheck.violations():
+        print("LOCK-INVERSION", flush=True)
+        os._exit(77)
     # no store.close(): the WAL must keep its frames for the parent's
     # epoch scan (everything is already flushed; close() would compact)
     os._exit(0)
@@ -956,7 +969,16 @@ def main() -> int:
                         reference=reference_state(args.ticks))
         print(json.dumps({k: v for k, v in out.items() if k != "out"}))
         return 0 if out["ok"] else 1
-    return run_matrix(ticks=args.ticks)
+    rc = run_matrix(ticks=args.ticks)
+    # parent-side witness audit: the harness itself runs stores, leases
+    # and dispatch in-process; any inversion recorded here is a failure
+    from evergreen_tpu.utils import lockcheck
+
+    inversions = lockcheck.violations()
+    if inversions:
+        print(json.dumps({"lockcheck_inversions": len(inversions)}))
+        rc = rc or 1
+    return rc
 
 
 if __name__ == "__main__":

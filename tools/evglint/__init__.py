@@ -1,0 +1,38 @@
+"""evglint — project-wide static analysis for evergreen_tpu.
+
+A shared AST/scope-analysis core (tools/evglint/core.py) plus six
+project-specific passes (tools/evglint/passes/):
+
+  lockgraph    lock inventory + static acquisition-order graph: raw
+               ``threading.Lock()`` creations (invisible to the runtime
+               witness) are findings, nested ``with`` acquisitions build
+               an order graph whose inversions/cycles are findings, and
+               blocking calls (sleep, subprocess, socket IO, wait_reply)
+               under a held lock are findings. Paired with the runtime
+               witness in evergreen_tpu/utils/lockcheck.py.
+  tracercheck  JIT-purity/static-shape discipline in ops/: no Python
+               branching on traced values, no .item()/float() on
+               tracers, no NumPy calls inside jitted bodies.
+  fencecheck   every mutation of the data dir goes through the
+               epoch-stamped DurableStore/lease APIs; a direct
+               open(...,'w')/os.rename against store paths outside
+               storage/ is a finding.
+  shedcheck    every drop/shed/evict path increments a registered
+               instrument, and a broad except handler may not swallow
+               work silently (counters == records, zero silent
+               discards — enforced at parse time).
+  seamcheck    external side-effects (sockets, subprocess, HTTP) must
+               live in a module wired to a fault seam or RetryPolicy,
+               keeping the scenario engine's injection surface complete.
+  metrics      the ISSUE-7 metrics-plane lint, migrated onto this core
+               (tools/metrics_lint.py is now a thin alias).
+
+Suppressions: ``# evglint: disable=<pass>[,<pass>] -- <reason>`` on the
+finding line (or a standalone comment on the line above). The reason is
+REQUIRED — a suppression without one is itself a finding.
+
+Run: ``python -m tools.evglint`` (all passes), ``--pass NAME`` for one,
+``--sabotage`` for the self-test that seeds one violation per pass and
+asserts it is caught. Wired as ``make lint`` and run unconditionally by
+``tools/gate.py``.
+"""

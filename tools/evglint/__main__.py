@@ -1,0 +1,62 @@
+"""CLI: ``python -m tools.evglint [--pass NAME ...] [--sabotage]``.
+
+Exit 0 = clean (or, under --sabotage, every pass caught its seed).
+Exit 1 = unsuppressed findings (or a seeded violation escaped).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="evglint")
+    ap.add_argument(
+        "--pass", dest="passes", action="append", metavar="NAME",
+        help="run only this pass (repeatable; default: all)",
+    )
+    ap.add_argument(
+        "--sabotage", action="store_true",
+        help="self-test: seed one violation per pass, assert caught",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list passes and exit",
+    )
+    args = ap.parse_args(argv)
+
+    passes = core.load_passes(args.passes)
+    if args.list:
+        for p in passes:
+            doc = (p.__doc__ or "").strip().split("\n")[0]
+            print(f"{p.NAME:12s} {doc}")
+        return 0
+
+    if args.sabotage:
+        escaped = core.sabotage_selftest(passes)
+        if escaped:
+            print(f"evglint sabotage: {escaped} pass(es) BLIND",
+                  file=sys.stderr)
+            return 1
+        print(f"evglint sabotage: all {len(passes)} passes catch "
+              "their seeded violation")
+        return 0
+
+    modules = core.iter_modules()
+    findings = core.run_passes(passes, modules)
+    n_suppressed = sum(m.n_suppression_comments for m in modules)
+    if findings:
+        print(f"evglint: {len(findings)} finding(s)")
+        for f in findings:
+            print(f"  {f}")
+        return 1
+    print(
+        f"evglint: clean ({len(passes)} passes, {len(modules)} files, "
+        f"{n_suppressed} suppression(s))"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

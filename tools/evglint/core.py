@@ -1,0 +1,208 @@
+"""evglint core: module walker, finding model, suppressions, runner.
+
+Passes are plain modules (tools/evglint/passes/*) exporting:
+
+  ``NAME``      the pass id used in suppressions and --pass
+  ``run(modules) -> List[Finding]``   whole-project analysis
+  ``SABOTAGE``  {"rel": ..., "source": ...} — a synthetic module seeded
+                with exactly the violation class the pass exists to
+                catch; the --sabotage self-test asserts it is caught.
+
+The core owns suppression semantics so every pass inherits them: a
+``# evglint: disable=<pass>[,<pass>] -- <reason>`` comment suppresses
+that pass's findings on its own line (trailing comment) or on the next
+line (standalone comment). The justification after ``--`` is mandatory;
+a suppression without one is a finding from the ``core`` pseudo-pass.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Set
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PACKAGE_DIR = os.path.join(REPO_ROOT, "evergreen_tpu")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*evglint:\s*disable=([a-zA-Z0-9_,\-]+)(?:\s+--\s*(\S.*))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: where, which pass, and what to do about it."""
+
+    passname: str
+    rel: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.rel}:{self.line}: [{self.passname}] {self.message}"
+
+
+class Module:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, rel: str, source: str) -> None:
+        self.rel = rel
+        self.source = source
+        self.lines = source.split("\n")
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=rel)
+        except SyntaxError as exc:
+            self.parse_error = exc
+        #: line -> set of pass names suppressed there (reason present)
+        self.suppressions: Dict[int, Set[str]] = {}
+        #: distinct justified suppression COMMENTS (a trailing comment
+        #: may map to two lines; audits count comments, not mappings)
+        self.n_suppression_comments = 0
+        #: suppressions missing the mandatory justification
+        self.bad_suppressions: List[Finding] = []
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            passes = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.bad_suppressions.append(
+                    Finding(
+                        "core", self.rel, i,
+                        "suppression without justification — write "
+                        "`# evglint: disable=<pass> -- <why this is "
+                        "safe>` naming the invariant that holds",
+                    )
+                )
+                continue
+            self.n_suppression_comments += 1
+            code_before = text[: m.start()].strip()
+            target = i if code_before else i + 1
+            self.suppressions.setdefault(target, set()).update(passes)
+            # a trailing suppression also covers a multi-line statement
+            # that ENDS on this line — but only the INNERMOST one.
+            # Mapping every enclosing stmt that happens to end here
+            # (the function whose last line this is, an enclosing
+            # with/try) would silently widen the suppression to
+            # findings its justification never argued for.
+            if code_before and self.tree is not None:
+                candidates = [
+                    node for node in ast.walk(self.tree)
+                    if (
+                        getattr(node, "end_lineno", None) == i
+                        and isinstance(node, ast.stmt)
+                        and node.lineno < i
+                        and not isinstance(
+                            node,
+                            (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef),
+                        )
+                    )
+                ]
+                if candidates:
+                    innermost = max(candidates, key=lambda n: n.lineno)
+                    self.suppressions.setdefault(
+                        innermost.lineno, set()
+                    ).update(passes)
+
+    def is_suppressed(self, passname: str, line: int) -> bool:
+        return passname in self.suppressions.get(line, ())
+
+    def segment(self, node: ast.AST) -> str:
+        """Source text of a node ('' when unavailable)."""
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:
+            return ""
+
+
+def iter_modules(root: str = PACKAGE_DIR) -> List[Module]:
+    out: List[Module] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO_ROOT)
+            with open(path, encoding="utf-8") as fh:
+                out.append(Module(rel, fh.read()))
+    return out
+
+
+def load_passes(names: Optional[Iterable[str]] = None) -> List:
+    from .passes import ALL_PASSES
+
+    if names is None:
+        return list(ALL_PASSES)
+    by_name = {p.NAME: p for p in ALL_PASSES}
+    missing = [n for n in names if n not in by_name]
+    if missing:
+        raise SystemExit(
+            f"evglint: unknown pass(es) {', '.join(missing)} "
+            f"(known: {', '.join(sorted(by_name))})"
+        )
+    return [by_name[n] for n in names]
+
+
+def run_passes(
+    passes: List, modules: Optional[List[Module]] = None
+) -> List[Finding]:
+    """Run the passes, apply suppressions, and fold in core findings
+    (parse errors, justification-less suppressions)."""
+    if modules is None:
+        modules = iter_modules()
+    findings: List[Finding] = []
+    for m in modules:
+        findings.extend(m.bad_suppressions)
+        if m.parse_error is not None:
+            findings.append(
+                Finding("core", m.rel, m.parse_error.lineno or 0,
+                        f"unparseable: {m.parse_error.msg}")
+            )
+    parseable = [m for m in modules if m.tree is not None]
+    by_rel = {m.rel: m for m in modules}
+    for p in passes:
+        for f in p.run(parseable):
+            mod = by_rel.get(f.rel)
+            if mod is not None and mod.is_suppressed(f.passname, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.rel, f.line, f.passname))
+    return findings
+
+
+def sabotage_selftest(passes: List) -> int:
+    """Seed one violation per pass, assert the pass catches it. Returns
+    the count of passes whose seed ESCAPED (0 == healthy)."""
+    escaped = 0
+    for p in passes:
+        sab = getattr(p, "SABOTAGE", None)
+        if not sab:
+            print(f"evglint sabotage: {p.NAME}: NO SELF-TEST SEED")
+            escaped += 1
+            continue
+        module = Module(sab["rel"], sab["source"])
+        assert module.parse_error is None, (p.NAME, module.parse_error)
+        caught = [f for f in p.run([module]) if f.rel == sab["rel"]]
+        if caught:
+            print(
+                f"evglint sabotage: {p.NAME}: caught seeded violation "
+                f"({caught[0].message[:60]}…)"
+            )
+        else:
+            print(
+                f"evglint sabotage: {p.NAME}: seeded violation ESCAPED"
+            )
+            escaped += 1
+    return escaped

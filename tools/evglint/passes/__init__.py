@@ -1,0 +1,19 @@
+"""Pass registry. Order is the report order; names are the suppression
+vocabulary (``# evglint: disable=<name> -- reason``)."""
+from . import (  # noqa: F401
+    fencecheck,
+    lockgraph,
+    metricscheck,
+    seamcheck,
+    shedcheck,
+    tracercheck,
+)
+
+ALL_PASSES = [
+    lockgraph,
+    tracercheck,
+    fencecheck,
+    shedcheck,
+    seamcheck,
+    metricscheck,
+]

@@ -1,0 +1,163 @@
+"""fencecheck — data-dir mutations go through the epoch-stamped store.
+
+The split-brain contract (PR 3) holds because every byte under the data
+dir is written by ``storage/`` code that stamps the holder's lease epoch
+and re-checks the fence at commit. A direct ``open(..., 'w')`` /
+``os.rename`` / ``shutil.rmtree`` against a store path from anywhere
+else bypasses the fence: a deposed holder could clobber the new
+holder's state and no epoch would ever say so.
+
+Heuristic: outside ``evergreen_tpu/storage/``, a mutating filesystem
+call whose argument text mentions a store-path marker (``data_dir``,
+``wal``, ``snapshot``, ``lease``, ``manifest``) is a finding. Mutations
+of unrelated paths (task workdirs, bench outputs) don't match and are
+ignored. Legitimate non-store files living beside the store (the
+supervisor's fleet manifest) carry a suppression naming the invariant
+that makes the bypass safe.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Module
+
+NAME = "fencecheck"
+
+_EXEMPT_PREFIX = "evergreen_tpu/storage/"
+_MARKERS = ("data_dir", "wal", "snapshot", "lease", "manifest")
+_WRITE_MODES = ("w", "a", "x", "+")
+
+#: (module alias, attr) mutating calls
+_MUTATORS = {
+    ("os", "rename"), ("os", "replace"), ("os", "remove"),
+    ("os", "unlink"), ("os", "truncate"),
+    ("shutil", "rmtree"), ("shutil", "move"),
+}
+_PATH_WRITERS = {"write_text", "write_bytes"}
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    fn = node.func
+    if not (isinstance(fn, ast.Name) and fn.id == "open"):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(c in mode.value for c in _WRITE_MODES)
+    return False
+
+
+def _mutator_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value.id if isinstance(fn.value, ast.Name) else ""
+        if (recv, fn.attr) in _MUTATORS:
+            return f"{recv}.{fn.attr}"
+        if fn.attr in _PATH_WRITERS:
+            return f".{fn.attr}"
+    if _open_write_mode(node):
+        return "open(…, 'w')"
+    return None
+
+
+def _tainted_names(fnode: ast.FunctionDef, m: Module) -> set:
+    """Names assigned (directly or transitively) from a marker-bearing
+    expression inside this function — ``tmp = f\"{path}.{pid}\"`` after
+    ``path = entry_path(data_dir, shard)`` is still a store path even
+    though the mutating call's own text never says so."""
+    tainted: set = set()
+    assigns = [n for n in ast.walk(fnode) if isinstance(n, ast.Assign)]
+    # marker-bearing params count as sources too (data_dir et al.)
+    for a in fnode.args.args + fnode.args.kwonlyargs:
+        if any(mk in a.arg.lower() for mk in _MARKERS):
+            tainted.add(a.arg)
+    changed = True
+    while changed:
+        changed = False
+        for node in assigns:
+            seg = m.segment(node.value).lower()
+            refs = {
+                n.id for n in ast.walk(node.value)
+                if isinstance(n, ast.Name)
+            }
+            if any(mk in seg for mk in _MARKERS) or refs & tainted:
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+    return tainted
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        if m.rel.startswith(_EXEMPT_PREFIX) or "/tests/" in m.rel:
+            continue
+        taint_cache = {}
+        parents = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _mutator_name(node)
+            if name is None:
+                continue
+            seg = m.segment(node).lower()
+            hit = any(mk in seg for mk in _MARKERS)
+            if not hit:
+                # variable indirection: walk up to the enclosing
+                # function and consult its store-path taint set
+                anc = node
+                while anc in parents and not isinstance(
+                    anc, ast.FunctionDef
+                ):
+                    anc = parents[anc]
+                if isinstance(anc, ast.FunctionDef):
+                    if anc not in taint_cache:
+                        taint_cache[anc] = _tainted_names(anc, m)
+                    refs = {
+                        n.id for n in ast.walk(node)
+                        if isinstance(n, ast.Name)
+                    }
+                    hit = bool(refs & taint_cache[anc])
+            if hit:
+                findings.append(Finding(
+                    NAME, m.rel, node.lineno,
+                    f"direct {name} against a store path — data-dir "
+                    "mutations must go through the epoch-stamped "
+                    "DurableStore/lease APIs in storage/ (a deposed "
+                    "holder bypasses the fence here); route through "
+                    "the store or suppress naming the fencing invariant",
+                ))
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/scheduler/sabotage_fence.py",
+    "source": '''\
+import os
+
+
+def clobber(data_dir):
+    with open(os.path.join(data_dir, "snapshot.json"), "w") as f:
+        f.write("{}")              # seeded: unfenced store write
+    os.rename(
+        os.path.join(data_dir, "wal.log"),
+        os.path.join(data_dir, "wal.old"),
+    )
+
+
+def clobber_indirect(data_dir):
+    p = os.path.join(data_dir, "wal.log")
+    tmp = p + ".tmp"
+    os.rename(tmp, p)              # seeded: marker hidden behind locals
+''',
+}

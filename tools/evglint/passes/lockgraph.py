@@ -1,0 +1,340 @@
+"""lockgraph — lock inventory, static acquisition order, blocking calls.
+
+Three rules over the whole package:
+
+1. **Inventory**: every lock is created through the
+   ``utils/lockcheck.py`` factories (``make_lock``/``make_rlock``/
+   ``make_condition``) so the runtime lock-order witness can see it. A
+   raw ``threading.Lock()`` / ``RLock()`` / zero-arg ``Condition()``
+   creation is a finding. (``threading.Condition(existing_lock)`` is
+   fine — the condition adds no lock of its own.)
+
+2. **Order graph**: within each function, nested ``with <lock>`` blocks
+   contribute ``outer → inner`` edges to one project-wide order graph,
+   with lock identity resolved through the factory ROLE strings
+   (``self._x = make_lock("role")`` class attrs, module globals, and
+   ``Condition(shared_lock)`` aliases). An edge pair seen in both
+   directions, or any longer cycle, is a finding at every contributing
+   site. This is the static half of the witness: it proves ordering over
+   acquisitions the runtime may never exercise.
+
+3. **Lock-held-across-blocking-call**: a call that can block on the
+   outside world (sleep, subprocess, socket IO, ``urlopen``, ``fsync``,
+   ``wait_reply``, ``communicate``) while a registered lock is held
+   starves every contender of that lock for the call's duration — a
+   finding unless suppressed with the invariant that bounds the wait.
+   (``cv.wait()`` is exempt: it releases the lock.)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Module
+
+NAME = "lockgraph"
+
+#: attribute tails that block on the outside world when called
+_BLOCKING_ATTRS = {
+    "sleep", "wait_reply", "communicate", "urlopen", "fsync",
+    "check_call", "check_output", "accept", "connect", "recv",
+    "sendall", "getaddrinfo",
+}
+#: (receiver, attr) pairs that block (receiver alias substring match)
+_BLOCKING_RECEIVER_ATTRS = {("subprocess", "run"), ("subprocess", "call")}
+
+_FACTORIES = {"make_lock", "make_rlock", "make_condition"}
+
+
+def _factory_role(node: ast.AST) -> Optional[str]:
+    """role string when ``node`` is ``[_lockcheck.]make_*("role"...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = (
+        fn.attr if isinstance(fn, ast.Attribute)
+        else fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name not in _FACTORIES:
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return "<dynamic>"
+
+
+def _threading_receiver(expr: ast.AST) -> bool:
+    """True for a receiver that denotes the threading module: a plain
+    alias Name, or the ``__import__("threading")`` dodge."""
+    if isinstance(expr, ast.Name):
+        return "threading" in expr.id
+    return (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "__import__"
+        and expr.args
+        and isinstance(expr.args[0], ast.Constant)
+        and expr.args[0].value == "threading"
+    )
+
+
+def _raw_threading_lock(node: ast.Call) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when node creates a RAW primitive the
+    witness cannot see."""
+    fn = node.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and _threading_receiver(fn.value)
+        and fn.attr in ("Lock", "RLock", "Condition")
+    ):
+        if fn.attr == "Condition" and node.args:
+            return None  # wraps an existing (witnessed) lock
+        return fn.attr
+    return None
+
+
+class _LockSymbols(ast.NodeVisitor):
+    """module globals + class attrs that hold factory-made locks."""
+
+    def __init__(self) -> None:
+        self.globals: Dict[str, str] = {}  # name -> role
+        self.attrs: Dict[Tuple[str, str], str] = {}  # (class, attr) -> role
+        self._class: Optional[str] = None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _record(self, target: ast.AST, role: str) -> None:
+        if isinstance(target, ast.Name) and self._class is None:
+            self.globals[target.id] = role
+        elif isinstance(target, ast.Name) and self._class is not None:
+            self.attrs[(self._class, target.id)] = role
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self._class is not None
+        ):
+            self.attrs[(self._class, target.attr)] = role
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        role = _factory_role(node.value)
+        if role is None and isinstance(node.value, ast.Call):
+            # Condition(shared_lock) aliases the shared lock's role
+            fn = node.value.func
+            if (
+                isinstance(fn, ast.Attribute) and fn.attr == "Condition"
+                and node.value.args
+            ):
+                arg = node.value.args[0]
+                if (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                    and self._class is not None
+                ):
+                    role = self.attrs.get((self._class, arg.attr))
+                elif isinstance(arg, ast.Name):
+                    role = self.globals.get(arg.id)
+        if role is not None:
+            for t in node.targets:
+                self._record(t, role)
+        self.generic_visit(node)
+
+
+def _resolve_lock(
+    expr: ast.AST, syms: _LockSymbols, cls: Optional[str]
+) -> Optional[str]:
+    """role of a ``with``-statement context expr, if it names a lock."""
+    if isinstance(expr, ast.Name):
+        return syms.globals.get(expr.id)
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and cls is not None
+    ):
+        return syms.attrs.get((cls, expr.attr))
+    return None
+
+
+def _is_blocking_call(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value.id if isinstance(fn.value, ast.Name) else ""
+        if fn.attr in _BLOCKING_ATTRS:
+            return f"{recv + '.' if recv else ''}{fn.attr}"
+        for rsub, attr in _BLOCKING_RECEIVER_ATTRS:
+            if fn.attr == attr and rsub in recv:
+                return f"{recv}.{attr}"
+    elif isinstance(fn, ast.Name) and fn.id in ("urlopen", "sleep"):
+        return fn.id
+    return None
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    #: (held_role, inner_role) -> first "rel:line" that witnessed it
+    edges: Dict[Tuple[str, str], str] = {}
+
+    for m in modules:
+        if m.rel.endswith("utils/lockcheck.py") or "/tests/" in m.rel:
+            continue
+        syms = _LockSymbols()
+        syms.visit(m.tree)
+
+        # rule 1: inventory
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call):
+                raw = _raw_threading_lock(node)
+                if raw is not None:
+                    findings.append(Finding(
+                        NAME, m.rel, node.lineno,
+                        f"raw threading.{raw}() — create it through "
+                        "utils/lockcheck.make_lock/make_rlock/"
+                        "make_condition with a role name so the runtime "
+                        "lock-order witness can see it",
+                    ))
+
+        # rules 2+3: walk each function with a held-lock stack
+        def own_exprs(stmt):
+            """Expression nodes belonging to THIS statement (stop at
+            nested statement suites — those recurse via walk())."""
+            for _field, value in ast.iter_fields(stmt):
+                vals = value if isinstance(value, list) else [value]
+                for v in vals:
+                    if isinstance(v, ast.expr):
+                        yield from ast.walk(v)
+                    elif isinstance(v, ast.withitem):
+                        yield from ast.walk(v.context_expr)
+
+        def scan_blocking(stmt, held) -> None:
+            if not held:
+                return
+            for sub in own_exprs(stmt):
+                if isinstance(sub, ast.Call):
+                    blk = _is_blocking_call(sub)
+                    if blk is not None:
+                        roles = ", ".join(r for r, _ in held)
+                        findings.append(Finding(
+                            NAME, m.rel, sub.lineno,
+                            f"blocking call {blk}() while holding "
+                            f"lock(s) {roles} — every contender stalls "
+                            "for the call's duration; move it outside "
+                            "the lock or suppress naming the bound",
+                        ))
+
+        def walk(body, held: List[Tuple[str, int]], cls) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk(stmt.body, [], cls)
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    walk(stmt.body, [], stmt.name)
+                    continue
+                if isinstance(stmt, ast.With):
+                    # the context expressions themselves run while the
+                    # CURRENT locks are held — `with urlopen(req) as r:`
+                    # under a lock is the dominant blocking idiom
+                    scan_blocking(stmt, held)
+                    acquired: List[Tuple[str, int]] = []
+                    for item in stmt.items:
+                        role = _resolve_lock(item.context_expr, syms, cls)
+                        if role is not None:
+                            for outer, _ in held:
+                                if outer != role:
+                                    edges.setdefault(
+                                        (outer, role),
+                                        f"{m.rel}:{stmt.lineno}",
+                                    )
+                            acquired.append((role, stmt.lineno))
+                    walk(stmt.body, held + acquired, cls)
+                    continue
+                scan_blocking(stmt, held)
+                # recurse into nested suites (if/for/try/while bodies)
+                for field in ("body", "orelse", "finalbody"):
+                    sub_body = getattr(stmt, field, None)
+                    if sub_body:
+                        walk(sub_body, held, cls)
+                for handler in getattr(stmt, "handlers", []):
+                    walk(handler.body, held, cls)
+
+        walk(m.tree.body, [], None)
+
+    # rule 2 verdicts: pairwise inversions + longer cycles
+    seen_pairs: Set[frozenset] = set()
+    for (a, b), site in sorted(edges.items()):
+        if (b, a) in edges and frozenset((a, b)) not in seen_pairs:
+            seen_pairs.add(frozenset((a, b)))
+            other = edges[(b, a)]
+            rel, line = site.rsplit(":", 1)
+            findings.append(Finding(
+                NAME, rel, int(line),
+                f"lock-order inversion: {a!r} → {b!r} here but "
+                f"{b!r} → {a!r} at {other} — pick one order and make "
+                "the other side drop/retake",
+            ))
+    # longer cycles: DFS over the remaining digraph
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        if frozenset((a, b)) not in seen_pairs:
+            graph.setdefault(a, []).append(b)
+    state: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        state[n] = 1
+        stack.append(n)
+        for nxt in graph.get(n, ()):
+            if state.get(nxt, 0) == 1:
+                return stack[stack.index(nxt):] + [nxt]
+            if state.get(nxt, 0) == 0:
+                cyc = dfs(nxt)
+                if cyc:
+                    return cyc
+        stack.pop()
+        state[n] = 2
+        return None
+
+    for n in sorted(graph):
+        if state.get(n, 0) == 0:
+            cyc = dfs(n)
+            if cyc:
+                site = edges[(cyc[0], cyc[1])]
+                rel, line = site.rsplit(":", 1)
+                findings.append(Finding(
+                    NAME, rel, int(line),
+                    "lock-order cycle: " + " → ".join(cyc),
+                ))
+                break
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/sabotage/locks.py",
+    "source": '''\
+import threading
+
+from ..utils import lockcheck as _lockcheck
+
+_raw = threading.Lock()          # seeded: invisible to the witness
+_dodge = __import__("threading").Lock()   # seeded: the import-dodge form
+_a = _lockcheck.make_lock("sab.a")
+_b = _lockcheck.make_lock("sab.b")
+
+
+def forward():
+    with _a:
+        with _b:
+            pass
+
+
+def backward():
+    with _b:
+        with _a:                  # seeded: inversion of forward()
+            import time
+            time.sleep(1)         # seeded: blocking under two locks
+''',
+}

@@ -1,0 +1,202 @@
+"""metrics — the metrics-plane registration contract (ISSUE 7),
+migrated onto the evglint core as the sixth pass.
+
+Same rules as the original ``tools/metrics_lint.py`` (which now
+delegates here so its CLI and output survive):
+
+  * literal snake_case instrument names with a known subsystem prefix;
+  * counters end ``_total``; duration histograms end ``_ms``;
+  * labels literal and drawn from ``utils/metrics.py ALLOWED_LABELS``;
+  * per-shard / per-replica / per-worker series carry the label that
+    keeps one sick member from hiding in the aggregate;
+  * every name registered exactly once across the tree;
+  * no ``incr_counter`` call sites outside utils/log.py / metrics.py.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from typing import Dict, List, Tuple
+
+from ..core import REPO_ROOT, Finding, Module
+
+NAME = "metrics"
+
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+REG_FUNCS = {"counter", "gauge", "histogram"}
+REG_RECEIVERS = re.compile(r"metrics")
+NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+
+SUBSYSTEMS = {
+    "api", "arena", "breaker", "cloud", "config", "cron", "dispatch",
+    "events", "faults", "hosts", "jobs", "lease", "outbox", "overload",
+    "recovery", "replica", "resident", "retry", "scheduler", "tpu",
+    "trace", "wal",
+}
+
+INCR_COUNTER_ALLOWED = {
+    "evergreen_tpu/utils/log.py",
+    "evergreen_tpu/utils/metrics.py",
+}
+
+
+def _allowed_labels() -> frozenset:
+    from evergreen_tpu.utils.metrics import ALLOWED_LABELS
+
+    return ALLOWED_LABELS
+
+
+def _is_registration(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in REG_FUNCS:
+        base = fn.value
+        return isinstance(base, ast.Name) and bool(
+            REG_RECEIVERS.search(base.id)
+        )
+    return False
+
+
+def _literal_str(node) -> Tuple[bool, str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True, node.value
+    return False, ""
+
+
+def _labels_node(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _label_values(call: ast.Call) -> List[str]:
+    ln = _labels_node(call)
+    if isinstance(ln, (ast.Tuple, ast.List)):
+        return [_literal_str(el)[1] for el in ln.elts]
+    return []
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    allowed_labels = _allowed_labels()
+    findings: List[Finding] = []
+    registered: Dict[str, str] = {}
+
+    def emit(rel: str, line: int, msg: str) -> None:
+        findings.append(Finding(NAME, rel, line, msg))
+
+    for m in modules:
+        rel = m.rel
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (
+                node.func.id if isinstance(node.func, ast.Name)
+                else node.func.attr if isinstance(node.func, ast.Attribute)
+                else ""
+            )
+            if fname == "incr_counter" and rel not in INCR_COUNTER_ALLOWED:
+                emit(rel, node.lineno,
+                     "direct incr_counter() call — register a typed "
+                     "instrument in utils/metrics.py terms and let its "
+                     "`legacy` mirror feed the flat dict")
+            if not _is_registration(node):
+                continue
+            kind = node.func.attr
+            line = node.lineno
+            if not node.args:
+                emit(rel, line, f"{kind}() with no name")
+                continue
+            ok, name = _literal_str(node.args[0])
+            if not ok:
+                emit(rel, line,
+                     f"{kind}() name must be a literal string "
+                     "(no f-strings, no concatenation, no variables)")
+                continue
+            if not NAME_RE.match(name):
+                emit(rel, line,
+                     f"{name!r} is not snake_case with a subsystem prefix")
+            else:
+                prefix = name.split("_", 1)[0]
+                if prefix not in SUBSYSTEMS:
+                    emit(rel, line,
+                         f"{name!r} claims unknown subsystem prefix "
+                         f"{prefix!r} (known: "
+                         f"{', '.join(sorted(SUBSYSTEMS))})")
+            if kind == "counter" and not name.endswith("_total"):
+                emit(rel, line, f"counter {name!r} must end with _total")
+            if kind == "histogram" and not name.endswith("_ms"):
+                emit(rel, line,
+                     f"histogram {name!r} must end with _ms (every "
+                     "duration histogram shares the ms bucket "
+                     "vocabulary)")
+            help_node = node.args[1] if len(node.args) >= 2 else next(
+                (kw.value for kw in node.keywords if kw.arg == "help"),
+                None,
+            )
+            hval = ""
+            if help_node is not None:
+                _hok, hval = _literal_str(help_node)
+            if help_node is None or not hval.strip():
+                emit(rel, line,
+                     f"{name!r} needs a non-empty literal help string")
+            # each scope rule is INDEPENDENT (a *_shard_*_replica_*
+            # series needs both labels); dedupe only identical demands
+            demanded = set()
+            for scope, label, folded in (
+                ("_shard_", "shard", "every shard"),
+                ("_replica_", "replica", "every replica"),
+                ("_worker_", "shard", "the whole fleet"),
+                ("_workers_", "shard", "the whole fleet"),
+            ):
+                if scope in name or name.startswith(scope.strip("_") + "_"):
+                    if (
+                        label not in _label_values(node)
+                        and label not in demanded
+                    ):
+                        demanded.add(label)
+                        emit(rel, line,
+                             f"per-{scope.strip('_')} instrument "
+                             f"{name!r} must carry the {label!r} label "
+                             f"(unlabeled series fold {folded} together)")
+            ln = _labels_node(node)
+            if ln is not None:
+                if not isinstance(ln, (ast.Tuple, ast.List)):
+                    emit(rel, line,
+                         f"{name!r} labels must be a literal tuple/list")
+                else:
+                    for el in ln.elts:
+                        lok, lval = _literal_str(el)
+                        if not lok:
+                            emit(rel, line,
+                                 f"{name!r} has a non-literal label")
+                        elif lval not in allowed_labels:
+                            emit(rel, line,
+                                 f"{name!r} label {lval!r} is not in "
+                                 "the allowed vocabulary ("
+                                 f"{', '.join(sorted(allowed_labels))})")
+            if any(kw.arg == "registry" for kw in node.keywords):
+                continue
+            prev = registered.get(name)
+            if prev is not None:
+                emit(rel, line, f"{name!r} already registered at {prev}")
+            else:
+                registered[name] = f"{rel}:{line}"
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/utils/sabotage_metrics.py",
+    "source": '''\
+from . import metrics as _metrics
+
+BAD = _metrics.counter(
+    f"dynamic_{1}_name",           # seeded: non-literal instrument name
+    "help text",
+)
+''',
+}

@@ -1,0 +1,86 @@
+"""seamcheck — external side-effects stay on the injection surface.
+
+The scenario engine and fault matrix can only prove degradation for
+failures they can INJECT. Every place the process touches the outside
+world — sockets, subprocesses, HTTP — must therefore live in a module
+wired to the fault-seam registry (``faults.fire(...)``) or the unified
+``RetryPolicy``, so a fault plan can reach it and a retry budget bounds
+it. An external call in a module with neither is a hole in the
+injection surface: the one seam the crash matrix cannot exercise is the
+one production will.
+
+Heuristic: a call to ``subprocess.run/Popen/check_*``, ``urlopen``,
+``socket.socket``/``create_connection``, or an ``HTTP(S)Connection``
+constructor, in a module whose source never mentions ``faults.fire`` or
+``RetryPolicy``, is a finding. Harness/bootstrap code that is itself
+the failure-observer (smoke drivers, the native-lib builder) suppresses
+with that justification.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Module
+
+NAME = "seamcheck"
+
+_SEAM_TOKENS = ("faults.fire", "RetryPolicy", "retry_policy")
+
+_EXTERNAL = {
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("socket", "socket"), ("socket", "create_connection"),
+}
+_EXTERNAL_ATTRS = {"urlopen", "HTTPConnection", "HTTPSConnection"}
+
+
+def _external_call(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value.id if isinstance(fn.value, ast.Name) else ""
+        if (recv, fn.attr) in _EXTERNAL:
+            return f"{recv}.{fn.attr}"
+        if fn.attr in _EXTERNAL_ATTRS:
+            return f"{recv + '.' if recv else ''}{fn.attr}"
+    elif isinstance(fn, ast.Name) and fn.id in _EXTERNAL_ATTRS:
+        return fn.id
+    return None
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        if "/tests/" in m.rel:
+            continue
+        if any(tok in m.source for tok in _SEAM_TOKENS):
+            continue  # module is on the injection surface already
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _external_call(node)
+            if name is not None:
+                findings.append(Finding(
+                    NAME, m.rel, node.lineno,
+                    f"external side-effect {name}() in a module with "
+                    "no fault seam or RetryPolicy — the scenario "
+                    "engine cannot inject failure here; wrap it in a "
+                    "registered seam/policy or suppress naming why "
+                    "this surface needs neither",
+                ))
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/cloud/sabotage_seam.py",
+    "source": '''\
+import subprocess
+from urllib.request import urlopen
+
+
+def provision(host):
+    subprocess.run(["ssh", host, "true"])   # seeded: unseamed subprocess
+    return urlopen("http://metadata/latest").read()  # seeded: unseamed HTTP
+''',
+}

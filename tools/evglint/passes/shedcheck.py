@@ -1,0 +1,121 @@
+"""shedcheck — zero silent discards, enforced at parse time.
+
+The "counters == records" invariant (PR 4, scenario engine): any path
+that drops, sheds, or evicts work must increment a registered
+instrument, so the scorecard's counter deltas reconcile against store
+records and a regression in graceful degradation is visible. Two rules:
+
+1. A function whose name says it discards work (a ``shed``/``evict``/
+   ``discard`` name segment) must touch an
+   instrument (``.inc()`` / ``.observe()`` / ``record_shed`` /
+   ``log_event``) somewhere in its body — otherwise the drop is
+   invisible to the zero-silent-discards reconciliation.
+
+2. A broad handler (``except Exception`` / ``except BaseException`` /
+   bare ``except``) whose body neither calls anything nor raises is a
+   silent swallow — the one shape of ``except`` that can hide dropped
+   work, a dead thread, or a poisoned job with no trace. Narrow
+   handlers (``except OSError: pass`` teardown) are left alone.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from ..core import Finding, Module
+
+NAME = "shedcheck"
+
+#: segment-aware: `shed` must start a name segment (``is_finished`` and
+#: spec-factory names like ``*_budget_shed`` in scenarios/ are not
+#: discard paths — the former by tokenization, the latter by scope)
+_DISCARD_NAME = re.compile(r"(^|_)(shed|evict|discard)")
+_INSTRUMENT_ATTRS = {"inc", "observe", "set"}
+_INSTRUMENT_NAMES = {"record_shed", "log_event", "incr_counter"}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _touches_instrument(fnode: ast.FunctionDef) -> bool:
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and (
+                fn.attr in _INSTRUMENT_ATTRS
+                or fn.attr in _INSTRUMENT_NAMES
+            ):
+                return True
+            if isinstance(fn, ast.Name) and fn.id in _INSTRUMENT_NAMES:
+                return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for n in ast.walk(t):
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.append(n.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """Only a PURE swallow counts: every statement is ``pass`` /
+    ``continue``. A handler that raises, calls, returns, or assigns a
+    fallback has taken a visible degradation action — that shape is the
+    caller's design, not a silent discard."""
+    return all(
+        isinstance(node, (ast.Pass, ast.Continue)) for node in handler.body
+    )
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        if "/tests/" in m.rel:
+            continue
+        spec_module = m.rel.startswith("evergreen_tpu/scenarios/")
+        for node in ast.walk(m.tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and _DISCARD_NAME.search(node.name)
+                and not spec_module
+            ):
+                if not _touches_instrument(node):
+                    findings.append(Finding(
+                        NAME, m.rel, node.lineno,
+                        f"{node.name}() discards work without touching "
+                        "an instrument — every shed/evict path must "
+                        "increment a registered counter (counters == "
+                        "records, zero silent discards)",
+                    ))
+            elif isinstance(node, ast.ExceptHandler):
+                if _is_broad(node) and _swallows(node):
+                    findings.append(Finding(
+                        NAME, m.rel, node.lineno,
+                        "broad except swallows silently — log a "
+                        "breadcrumb or bump a counter so the discard "
+                        "reconciles (counters == records), or narrow "
+                        "the exception type",
+                    ))
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/queue/sabotage_shed.py",
+    "source": '''\
+def shed_overflow(queue, n):
+    del queue[:n]                  # seeded: uninstrumented shed
+
+
+def tick(work):
+    try:
+        work()
+    except Exception:              # seeded: silent broad swallow
+        pass
+''',
+}

@@ -1,0 +1,218 @@
+"""tracercheck — JIT-purity / static-shape discipline in ``ops/``.
+
+The recompile/TPU-divergence bug class: code inside a jitted body that
+forces a tracer to a Python value (``.item()``, ``float()``/``int()``/
+``bool()``), calls host NumPy, or branches Python-side on a traced
+value either crashes under jit, silently recompiles per value, or — the
+worst case — bakes one trace's value into every later call. The pass
+finds jitted bodies (``@jax.jit`` / ``functools.partial(jax.jit, …)``
+decorators, ``jax.jit(fn, …)`` wrap sites, and ``pl.pallas_call``
+kernels) and walks them with a traced-name set:
+
+  * parameters are traced, minus ``static_argnames``/``static_argnums``;
+  * assignments from traced expressions propagate taint, EXCEPT values
+    derived from ``.shape``/``.ndim``/``.dtype``/``.size``/``len()`` —
+    those are static under tracing and branching on them is the
+    intended idiom;
+  * ``if``/``while`` on tainted names, ``.item()``, non-constant
+    ``float()/int()/bool()``, and ``np.*()`` calls (other than literal
+    dtype casts like ``np.float32(0.5)``, the weak-type-control idiom)
+    are findings.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Module
+
+NAME = "tracercheck"
+
+_SCOPE_PREFIX = "evergreen_tpu/ops/"
+
+#: np.<attr>(...) calls that are literal casts / host-side constants —
+#: the deliberate f32-literal weak-type idiom, not host compute
+_NP_CAST_OK = {
+    "float32", "float64", "int32", "int64", "uint8", "uint16",
+    "uint32", "uint64", "int8", "int16", "bool_", "dtype",
+}
+#: deriving these from a tracer yields a STATIC value — names assigned
+#: from them are not tainted and branching on them is fine
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``pl.pallas_call(...)``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in ("jit", "pallas_call")
+    return isinstance(fn, ast.Name) and fn.id == "jit"
+
+
+def _static_names_from_call(call: ast.Call, fnode) -> Set[str]:
+    """static_argnames/static_argnums resolved to parameter names."""
+    out: Set[str] = set()
+    params = [a.arg for a in fnode.args.args] if fnode is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    if 0 <= el.value < len(params):
+                        out.add(params[el.value])
+    return out
+
+
+def _collect_jitted(module: Module) -> Dict[ast.FunctionDef, Set[str]]:
+    """jitted FunctionDef → static param names."""
+    funcs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef):
+            funcs.setdefault(node.name, []).append(node)
+
+    jitted: Dict[ast.FunctionDef, Set[str]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+                    jitted[node] = set()
+                elif isinstance(dec, ast.Name) and dec.id == "jit":
+                    jitted[node] = set()
+                elif isinstance(dec, ast.Call):
+                    # functools.partial(jax.jit, static_argnames=…) or
+                    # jax.jit(static_argnums=…) as a decorator factory
+                    inner_names = {
+                        a.attr if isinstance(a, ast.Attribute)
+                        else getattr(a, "id", "")
+                        for a in ast.walk(dec)
+                    }
+                    if "jit" in inner_names:
+                        jitted[node] = _static_names_from_call(dec, node)
+        elif isinstance(node, ast.Call) and _is_jit_call(node):
+            # jax.jit(fn, …) / pl.pallas_call(kernel, …) wrap sites
+            if node.args and isinstance(node.args[0], ast.Name):
+                for f in funcs.get(node.args[0].id, []):
+                    jitted[f] = _static_names_from_call(node, f)
+    return jitted
+
+
+def _refs(expr: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _is_static_expr(expr: ast.AST, module: Module) -> bool:
+    """True when the expression only consumes trace-static facts."""
+    seg = module.segment(expr)
+    if any(f".{a}" in seg for a in _STATIC_ATTRS):
+        return True
+    if "len(" in seg or "isinstance(" in seg:
+        return True
+    if " is None" in seg or " is not None" in seg:
+        return True
+    return False
+
+
+def _check_body(
+    fnode: ast.FunctionDef, static: Set[str], module: Module,
+    findings: List[Finding],
+) -> None:
+    tainted: Set[str] = {
+        a.arg
+        for a in (
+            fnode.args.args + fnode.args.kwonlyargs
+            + ([fnode.args.vararg] if fnode.args.vararg else [])
+        )
+        if a is not None and a.arg not in static and a.arg != "self"
+    }
+
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Assign) and not _is_static_expr(
+            node.value, module
+        ):
+            if _refs(node.value) & tainted:
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        elif isinstance(node, (ast.If, ast.While)):
+            if (
+                _refs(node.test) & tainted
+                and not _is_static_expr(node.test, module)
+            ):
+                findings.append(Finding(
+                    NAME, module.rel, node.lineno,
+                    "Python branch on a traced value inside a jitted "
+                    "body — each value recompiles (or the first trace's "
+                    "branch is baked in); use jnp.where/lax.cond, or "
+                    "hoist the value to a static arg",
+                ))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "item":
+                findings.append(Finding(
+                    NAME, module.rel, node.lineno,
+                    ".item() inside a jitted body forces a device sync "
+                    "and fails under trace — return the array and read "
+                    "it host-side",
+                ))
+            elif (
+                isinstance(fn, ast.Name)
+                and fn.id in ("float", "int", "bool")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+                and not _is_static_expr(node.args[0], module)
+                and _refs(node.args[0]) & tainted
+            ):
+                findings.append(Finding(
+                    NAME, module.rel, node.lineno,
+                    f"{fn.id}() on a traced value inside a jitted body "
+                    "— a ConcretizationTypeError on TPU; keep it an "
+                    "array or make the input static",
+                ))
+            elif (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("np", "numpy")
+                and fn.attr not in _NP_CAST_OK
+            ):
+                findings.append(Finding(
+                    NAME, module.rel, node.lineno,
+                    f"host NumPy call np.{fn.attr}() inside a jitted "
+                    "body — runs at trace time on tracer inputs (crash) "
+                    "or bakes a constant; use jnp",
+                ))
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        if not m.rel.startswith(_SCOPE_PREFIX):
+            continue
+        for fnode, static in _collect_jitted(m).items():
+            _check_body(fnode, static, m, findings)
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/ops/sabotage_ops.py",
+    "source": '''\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad(x):
+    if x > 0:                      # seeded: branch on a traced value
+        x = x + 1
+    y = float(x)                   # seeded: tracer concretization
+    z = np.argsort(x)              # seeded: host NumPy in a jitted body
+    return jnp.sum(x) + y + z[0] + x.item()   # seeded: .item()
+''',
+}

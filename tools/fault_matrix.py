@@ -18,6 +18,19 @@ standalone against several seeds.
 """
 from __future__ import annotations
 
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# the runtime lock-order witness: every registered lock in the plane is
+# wrapped for the whole soak; any acquisition-order inversion recorded
+# anywhere in this process fails the matrix (set BEFORE the package
+# imports below create their locks)
+os.environ.setdefault("EVERGREEN_TPU_LOCKCHECK", "1")
+
 import tempfile
 from typing import Callable, Dict, List
 
@@ -366,6 +379,11 @@ def main() -> int:
             ok = bool(out.get("ok"))
             failures += 0 if ok else 1
             print(json.dumps({"case": name, "seed": seed, "ok": ok}))
+    from evergreen_tpu.utils import lockcheck
+
+    inversions = lockcheck.violations()
+    print(json.dumps({"lockcheck_inversions": len(inversions)}))
+    failures += len(inversions)
     print(json.dumps({"fault_matrix_failures": failures}))
     return 1 if failures else 0
 

@@ -44,6 +44,11 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
+# runtime lock-order witness for the parent harness AND (via inherited
+# env) every child process: an inversion in a surviving child fails that
+# child's exit code; parent-side inversions fail the matrix at the end
+os.environ.setdefault("EVERGREEN_TPU_LOCKCHECK", "1")
+
 #: the smoke's crash-point sample (the full 13 run under
 #: ``gate.py --crash-matrix``; these three cover a group commit, the
 #: dispatch CAS pair, and the recovery pass itself)
@@ -298,6 +303,12 @@ def main() -> int:
         # the full smoke ends with the split-brain self-test: the
         # stale-supervisor guard must CATCH the attack
         failures += run_sabotage()
+    from evergreen_tpu.utils import lockcheck
+
+    inversions = lockcheck.violations()
+    if inversions:
+        print(json.dumps({"lockcheck_inversions": len(inversions)}))
+        failures += len(inversions)
     print(json.dumps({"fleet_runtime_ok": failures == 0}))
     return 1 if failures else 0
 

@@ -126,15 +126,20 @@ def main() -> int:
     with_read_parity = "--read-parity" in sys.argv[1:]
     args = args or ["tests/"]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # metrics-lint first, unconditionally: it is static, takes
-    # milliseconds, and a bad instrument registration is a startup crash
-    ml = [sys.executable, os.path.join(root, "tools", "metrics_lint.py")]
-    print("gate:", " ".join(ml), flush=True)
-    rc = subprocess.call(ml, env=env)
-    if rc != 0:
-        _log_run(rc, ["metrics-lint"])
-        print("gate: RED — metrics-lint failed", file=sys.stderr)
-        return rc
+    # evglint first, unconditionally: all six static passes (lockgraph,
+    # tracercheck, fencecheck, shedcheck, seamcheck, metrics) take
+    # milliseconds, and each guards a bug class that is a runtime crash
+    # or a silent correctness hole. The sabotage self-test runs first so
+    # a pass that has gone blind fails the gate before a clean report
+    # from it could be trusted.
+    for lint_args in (["--sabotage"], []):
+        el = [sys.executable, "-m", "tools.evglint", *lint_args]
+        print("gate:", " ".join(el), flush=True)
+        rc = subprocess.call(el, env=env, cwd=root)
+        if rc != 0:
+            _log_run(rc, ["evglint", *lint_args])
+            print("gate: RED — evglint failed", file=sys.stderr)
+            return rc
     cmd = [sys.executable, "-m", "pytest", "-q", *args]
     print("gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)

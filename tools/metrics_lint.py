@@ -1,284 +1,42 @@
 #!/usr/bin/env python
-"""Static lint for the metrics plane (ISSUE 7 satellite).
+"""Static lint for the metrics plane (ISSUE 7 satellite) — now a thin
+alias over the evglint ``metrics`` pass (tools/evglint/passes/
+metricscheck.py), where the rules moved verbatim when evglint
+generalized this tool into a six-pass framework (ISSUE 15).
 
-Walks every ``evergreen_tpu/**/*.py`` AST and enforces the instrument
-registration contract that keeps ``/metrics`` scrape-able forever:
+CLI, output format, and exit semantics are preserved so ``make
+metrics-lint`` and any scripting against it keep working:
 
-  * every instrument name is a **literal** snake_case string with a
-    subsystem prefix from the known registry — no f-strings, no
-    concatenation, no variables (a dynamic name is an unbounded series
-    leak waiting to happen);
-  * counters end ``_total``; duration histograms end ``_ms``;
-  * labels are a literal tuple/list drawn from the **allowed
-    vocabulary** (``utils/metrics.py ALLOWED_LABELS``; grown
-    deliberately — e.g. ``pool``, the fixed provider-pool vocabulary of
-    the capacity plane) — task ids, host ids, user ids can never become
-    labels;
-  * every name is registered **exactly once** across the tree (module
-    scope registers on import; a second registration is a startup
-    crash);
-  * no new ``incr_counter(...)`` call sites outside ``utils/log.py`` /
-    ``utils/metrics.py`` — the flat counter dict is a compatibility
-    view now, fed only by the instruments' ``legacy`` mirrors.
-
-Wired as ``make metrics-lint`` and run unconditionally by
-``tools/gate.py`` (it is static and takes milliseconds).
+  * every instrument name is a literal snake_case string with a known
+    subsystem prefix; counters end ``_total``, histograms ``_ms``;
+  * labels literal and from the allowed vocabulary; per-shard /
+    per-replica / per-worker series carry their disaggregation label;
+  * every name registered exactly once; no stray ``incr_counter``.
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
+from typing import List
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-from evergreen_tpu.utils.metrics import ALLOWED_LABELS  # noqa: E402
-
-PACKAGE_DIR = os.path.join(_REPO_ROOT, "evergreen_tpu")
-
-#: the registration helpers (module-level attribute calls:
-#: ``_metrics.counter(...)``) and the receivers they hang off
-REG_FUNCS = {"counter", "gauge", "histogram"}
-REG_RECEIVERS = re.compile(r"metrics")
-
-NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
-
-#: subsystem prefixes instruments may claim (first name segment); grow
-#: this list deliberately — a new prefix is a new dashboard namespace
-SUBSYSTEMS = {
-    "api", "arena", "breaker", "cloud", "config", "cron", "dispatch",
-    "events", "faults", "hosts", "jobs", "lease", "outbox", "overload",
-    "recovery", "replica", "resident", "retry", "scheduler", "tpu",
-    "trace", "wal",
-}
-
-#: files allowed to touch the flat counter dict directly
-INCR_COUNTER_ALLOWED = {
-    os.path.join("evergreen_tpu", "utils", "log.py"),
-    os.path.join("evergreen_tpu", "utils", "metrics.py"),
-}
-
-
-def _iter_py_files() -> List[str]:
-    out = []
-    for dirpath, dirnames, filenames in os.walk(PACKAGE_DIR):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                out.append(os.path.join(dirpath, fn))
-    return sorted(out)
-
-
-def _is_registration(call: ast.Call) -> bool:
-    fn = call.func
-    if isinstance(fn, ast.Attribute) and fn.attr in REG_FUNCS:
-        # receiver must look like a metrics module alias
-        # (metrics / _metrics / metrics_mod); _Instrument subclasses
-        # are constructed with CapWords names so they never match
-        base = fn.value
-        return isinstance(base, ast.Name) and bool(
-            REG_RECEIVERS.search(base.id)
-        )
-    return False
-
-
-def _literal_str(node) -> Tuple[bool, str]:
-    """(is_literal, value). JoinedStr (f-string) and anything computed
-    is not literal."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return True, node.value
-    return False, ""
-
-
-def _labels_node(call: ast.Call):
-    for kw in call.keywords:
-        if kw.arg == "labels":
-            return kw.value
-    if len(call.args) >= 3:
-        return call.args[2]
-    return None
-
 
 def lint() -> List[str]:
-    violations: List[str] = []
-    registered: Dict[str, str] = {}
+    from tools.evglint import core
+    from tools.evglint.passes import metricscheck
 
-    for path in _iter_py_files():
-        rel = os.path.relpath(path, _REPO_ROOT)
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        try:
-            tree = ast.parse(src, filename=rel)
-        except SyntaxError as exc:
-            violations.append(f"{rel}: unparseable: {exc}")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            # rule: the flat dict is fed only through legacy mirrors
-            fname = (
-                node.func.id if isinstance(node.func, ast.Name)
-                else node.func.attr if isinstance(node.func, ast.Attribute)
-                else ""
-            )
-            if fname == "incr_counter" and rel not in INCR_COUNTER_ALLOWED:
-                violations.append(
-                    f"{rel}:{node.lineno}: direct incr_counter() call — "
-                    "register a typed instrument in utils/metrics.py "
-                    "terms and let its `legacy` mirror feed the flat dict"
-                )
-            if not _is_registration(node):
-                continue
-            kind = node.func.attr
-            loc = f"{rel}:{node.lineno}"
-            if not node.args:
-                violations.append(f"{loc}: {kind}() with no name")
-                continue
-            ok, name = _literal_str(node.args[0])
-            if not ok:
-                violations.append(
-                    f"{loc}: {kind}() name must be a literal string "
-                    "(no f-strings, no concatenation, no variables)"
-                )
-                continue
-            if not NAME_RE.match(name):
-                violations.append(
-                    f"{loc}: {name!r} is not snake_case with a "
-                    "subsystem prefix"
-                )
-            else:
-                prefix = name.split("_", 1)[0]
-                if prefix not in SUBSYSTEMS:
-                    violations.append(
-                        f"{loc}: {name!r} claims unknown subsystem "
-                        f"prefix {prefix!r} (known: "
-                        f"{', '.join(sorted(SUBSYSTEMS))})"
-                    )
-            if kind == "counter" and not name.endswith("_total"):
-                violations.append(
-                    f"{loc}: counter {name!r} must end with _total"
-                )
-            if kind == "histogram" and not name.endswith("_ms"):
-                violations.append(
-                    f"{loc}: histogram {name!r} must end with _ms "
-                    "(every duration histogram shares the ms bucket "
-                    "vocabulary)"
-                )
-            # help string
-            help_node = node.args[1] if len(node.args) >= 2 else next(
-                (kw.value for kw in node.keywords if kw.arg == "help"),
-                None,
-            )
-            hval = ""
-            if help_node is not None:
-                # allow implicit adjacent-literal concatenation: the
-                # parser folds it into one Constant already
-                hok, hval = _literal_str(help_node)
-            if help_node is None or not hval.strip():
-                violations.append(
-                    f"{loc}: {name!r} needs a non-empty literal help "
-                    "string"
-                )
-            # per-shard instruments must carry the shard label: an
-            # instrument observed once per shard (anything named
-            # *_shard_* / shard_*) without a shard label silently FOLDS
-            # every shard into one series — a shard regression then
-            # hides inside an improved aggregate, exactly what the
-            # sharded perf floor exists to prevent
-            per_shard = "_shard_" in name or name.startswith("shard_")
-            if per_shard:
-                ln_chk = _labels_node(node)
-                label_vals = []
-                if isinstance(ln_chk, (ast.Tuple, ast.List)):
-                    label_vals = [
-                        _literal_str(el)[1] for el in ln_chk.elts
-                    ]
-                if "shard" not in label_vals:
-                    violations.append(
-                        f"{loc}: per-shard instrument {name!r} must "
-                        "carry the 'shard' label (unlabeled per-shard "
-                        "series fold every shard together)"
-                    )
-            # per-replica instruments likewise: a *_replica_* series
-            # observed once per read replica without the 'replica'
-            # label silently folds the whole replica fleet into one
-            # series — a lagging replica then hides inside a healthy
-            # aggregate
-            per_replica = (
-                "_replica_" in name or name.startswith("replica_")
-            )
-            if per_replica:
-                ln_chk = _labels_node(node)
-                label_vals = []
-                if isinstance(ln_chk, (ast.Tuple, ast.List)):
-                    label_vals = [
-                        _literal_str(el)[1] for el in ln_chk.elts
-                    ]
-                if "replica" not in label_vals:
-                    violations.append(
-                        f"{loc}: per-replica instrument {name!r} must "
-                        "carry the 'replica' label (unlabeled "
-                        "per-replica series fold every replica "
-                        "together)"
-                    )
-            # per-worker fleet instruments likewise (fleet runtime,
-            # runtime/supervisor.py): a *_worker(s)_* series observed
-            # once per shard worker without the 'shard' label folds
-            # the whole fleet into one series — one crash-looping or
-            # permanently-orphaned worker then hides inside a healthy
-            # aggregate
-            per_worker = "_worker_" in name or "_workers_" in name
-            if per_worker:
-                ln_chk = _labels_node(node)
-                label_vals = []
-                if isinstance(ln_chk, (ast.Tuple, ast.List)):
-                    label_vals = [
-                        _literal_str(el)[1] for el in ln_chk.elts
-                    ]
-                if "shard" not in label_vals:
-                    violations.append(
-                        f"{loc}: per-worker instrument {name!r} must "
-                        "carry the 'shard' label (unlabeled per-"
-                        "worker series fold the whole fleet together)"
-                    )
-            # labels
-            ln = _labels_node(node)
-            if ln is not None:
-                if not isinstance(ln, (ast.Tuple, ast.List)):
-                    violations.append(
-                        f"{loc}: {name!r} labels must be a literal "
-                        "tuple/list"
-                    )
-                else:
-                    for el in ln.elts:
-                        lok, lval = _literal_str(el)
-                        if not lok:
-                            violations.append(
-                                f"{loc}: {name!r} has a non-literal "
-                                "label"
-                            )
-                        elif lval not in ALLOWED_LABELS:
-                            violations.append(
-                                f"{loc}: {name!r} label {lval!r} is not "
-                                "in the allowed vocabulary "
-                                f"({', '.join(sorted(ALLOWED_LABELS))})"
-                            )
-            # registered exactly once (test-local registries pass
-            # registry=..., which exempts them from the global-name rule)
-            if any(kw.arg == "registry" for kw in node.keywords):
-                continue
-            prev = registered.get(name)
-            if prev is not None:
-                violations.append(
-                    f"{loc}: {name!r} already registered at {prev}"
-                )
-            else:
-                registered[name] = loc
-    return violations
+    findings = core.run_passes([metricscheck], core.iter_modules())
+    # metrics-pass findings plus the core parse errors the original
+    # tool reported (a syntactically broken file must stay a failure
+    # here, not just in the full evglint run)
+    return [
+        f"{f.rel}:{f.line}: {f.message}" for f in findings
+        if f.passname == metricscheck.NAME
+        or (f.passname == "core" and "unparseable" in f.message)
+    ]
 
 
 def main() -> int:
