@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime fuzz fuzz-soak
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -107,6 +107,25 @@ scenarios:
 # sabotage self-test (stale supervisor: every command rejected)
 fleet-runtime:
 	env JAX_PLATFORMS=cpu python tools/fleet_runtime.py
+
+# property-based weather fuzzing (gate-blocking via tools/gate.py
+# --fuzz): sabotage self-test first — a seeded duplicate-dispatch
+# corruption must be FOUND by the invariant net, shrink to a minimal
+# timeline, and replay deterministically (same seed => identical
+# fingerprints) on BOTH the in-process and child-process backends —
+# then a pinned-seed randomized campaign over the engine's whole event
+# vocabulary. Failures shrink and land in FUZZ_FINDINGS/ as
+# ready-to-check-in regression specs; FUZZCARD.json diffs against
+# FUZZCARD_GREEN.json. `make fuzz-soak` explores fresh seeds with a
+# bigger box (not gate-blocking; findings are the point).
+fuzz:
+	env JAX_PLATFORMS=cpu python tools/fuzz_matrix.py --sabotage
+	env JAX_PLATFORMS=cpu python tools/fuzz_matrix.py --diff
+
+fuzz-soak:
+	env JAX_PLATFORMS=cpu python tools/fuzz_matrix.py \
+	  --budget 300 --proc-budget 120 \
+	  --start-seed $$(date +%s)
 
 # N-process sharded-plane churn throughput vs the single-shard plane
 bench-sharded-plane:

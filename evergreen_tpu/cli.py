@@ -163,12 +163,33 @@ def cmd_service(args) -> int:
     (supervisor + N shard worker processes; runtime/)."""
     from .env import Environment
 
+    # trace capture: tap the WAL journal, dispatch/agent/lease log
+    # breadcrumbs, and (in fleet mode) supervisor control-IPC into a
+    # JSONL timeline that scenarios/trace.py distills back into a
+    # replayable ScenarioSpec. Appended as events happen, so a crashed
+    # service still leaves its timeline behind.
+    capture_path = (
+        getattr(args, "capture_trace", "")
+        or os.environ.get("EVG_TRACE_CAPTURE", "")
+    )
+    recorder = None
+    if capture_path:
+        from .scenarios.trace import TraceRecorder
+
+        recorder = TraceRecorder(path=capture_path).start()
+        print(f"trace capture -> {capture_path} "
+              f"(replay: evergreen-tpu replay-trace {capture_path})")
+
     if getattr(args, "shards", 0) and args.shards >= 1:
         # any explicit --shards (including 1) runs the supervised
         # process-per-shard runtime — a 1-shard fleet is a valid shape
         # (one restartable worker) and silently falling back to the
         # classic in-process service would ignore every worker_* knob
-        return _cmd_service_fleet(args)
+        try:
+            return _cmd_service_fleet(args)
+        finally:
+            if recorder is not None:
+                recorder.stop()
     if getattr(args, "replica_of", "") and not args.data_dir:
         print("--replica-of requires --data-dir", file=sys.stderr)
         return 2
@@ -200,6 +221,8 @@ def cmd_service(args) -> int:
             pass
         finally:
             env.close()
+            if recorder is not None:
+                recorder.stop()
         return 0
     if env.recovery_report is not None:
         r = env.recovery_report
@@ -252,8 +275,61 @@ def cmd_service(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # after env.close(): the shutdown WAL compaction is part of
+        # the timeline a replay needs
         env.close()
+        if recorder is not None:
+            recorder.stop()
     return 0
+
+
+def cmd_replay_trace(args) -> int:
+    """Distill a captured trace into a ScenarioSpec and replay it: the
+    incident-to-regression path. Accepts either a ``--capture-trace``
+    JSONL file or a durable ``--data-dir`` (WAL segments + snapshots)."""
+    import json
+
+    from .scenarios.engine import (
+        run_scenario,
+        scorecard_entry_fingerprint,
+    )
+    from .scenarios.trace import (
+        capture_data_dir,
+        save_regression_spec,
+        spec_from_trace_file,
+        spec_to_jsonable,
+    )
+
+    if os.path.isdir(args.trace):
+        spec = capture_data_dir(args.trace, name=args.name)
+    else:
+        spec = spec_from_trace_file(args.trace, name=args.name)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(spec_to_jsonable(spec, lossy=True), f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        print(f"spec -> {args.out}")
+    if args.no_run:
+        return 0
+    entry = run_scenario(spec)
+    replay = run_scenario(spec)
+    deterministic = (
+        scorecard_entry_fingerprint(entry)
+        == scorecard_entry_fingerprint(replay)
+    )
+    print(json.dumps({
+        "name": spec.name,
+        "ok": entry["ok"],
+        "deterministic": deterministic,
+        "fingerprint": entry.get("fingerprint", ""),
+        "invariants": {
+            k: v.get("ok") for k, v in entry.get("invariants", {}).items()
+        },
+    }, indent=1, sort_keys=True))
+    if args.save_regression and entry["ok"] and deterministic:
+        print(f"regression -> {save_regression_spec(spec, lossy=True)}")
+    return 0 if entry["ok"] and deterministic else 1
 
 
 def cmd_agent(args) -> int:
@@ -811,7 +887,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "processes over --data-dir (each with its own "
                         "lease + WAL segment); crashed/hung workers "
                         "restart behind the lease fence")
+    s.add_argument("--capture-trace", default="",
+                   help="append the live plane's WAL/log/IPC timeline "
+                        "to this JSONL file for `replay-trace` (env: "
+                        "EVG_TRACE_CAPTURE)")
     s.set_defaults(fn=cmd_service)
+
+    rt = sub.add_parser(
+        "replay-trace",
+        help="compile a captured trace (JSONL file or durable data "
+             "dir) into a scenario spec and replay it deterministically",
+    )
+    rt.add_argument("trace",
+                    help="--capture-trace JSONL file, or a durable "
+                         "--data-dir with WAL segments + snapshots")
+    rt.add_argument("--name", default="captured-trace")
+    rt.add_argument("--out", default="",
+                    help="also write the compiled spec JSON here")
+    rt.add_argument("--no-run", action="store_true",
+                    help="compile only; skip the replay")
+    rt.add_argument("--save-regression", action="store_true",
+                    help="on a green deterministic replay, check the "
+                         "spec into scenarios/regressions/")
+    rt.set_defaults(fn=cmd_replay_trace)
 
     a = sub.add_parser("agent", help="run a worker agent")
     a.add_argument("--host-id", required=True)

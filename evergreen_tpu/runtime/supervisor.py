@@ -66,6 +66,32 @@ from .protocol import EXIT_CRASHED, parse_line, send_msg
 #: status went to the dead supervisor (or init) — unobservable here
 EXIT_GONE = 113
 
+#: trace-capture taps: fn(direction, shard, msg) for every control-IPC
+#: message — direction "send" (supervisor → worker command) or "recv"
+#: (worker → supervisor reply/heartbeat/hello). Taps observe the
+#: protocol; they run outside any lock and cannot fail a send.
+_IPC_TAPS: list = []
+
+
+def add_ipc_tap(tap) -> None:
+    if tap not in _IPC_TAPS:
+        _IPC_TAPS.append(tap)
+
+
+def remove_ipc_tap(tap) -> None:
+    try:
+        _IPC_TAPS.remove(tap)
+    except ValueError:
+        pass
+
+
+def _tap_ipc(direction: str, shard, msg: dict) -> None:
+    for tap in list(_IPC_TAPS):
+        try:
+            tap(direction, shard, msg)
+        except Exception:  # noqa: BLE001 — observation must not break IPC  # evglint: disable=shedcheck -- a broken trace tap must never fail the control message it observed; the recorder is a pure observer and the IPC itself is counted by the fleet metrics
+            pass
+
 FLEET_RESTARTS = _metrics.counter(
     "scheduler_fleet_restarts_total",
     "Shard worker processes respawned by the supervisor after an exit "
@@ -245,6 +271,8 @@ class WorkerHandle:
         w = self.proc.stdin if self.proc is not None else self._conn_w
         if w is None:
             return False
+        if _IPC_TAPS:
+            _tap_ipc("send", self.shard, msg)
         return send_msg(w, self.send_lock, **msg)
 
     def next_req(self) -> int:
@@ -466,6 +494,8 @@ class FleetSupervisor:
                 if msg is None:
                     h.garbage_lines += 1
                     continue
+                if _IPC_TAPS:
+                    _tap_ipc("recv", h.shard, msg)
                 op = msg["op"]
                 if op == "heartbeat":
                     h.hb_deadline = Deadline.after(h.hb_deadline_s)

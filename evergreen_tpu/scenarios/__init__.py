@@ -14,12 +14,34 @@ Public surface:
                                          (scenarios/procs.py): specs with
                                          proc_kill/proc_hang events against
                                          a supervised worker-process fleet
+  ``generate_weather`` / ``campaign`` / ``shrink_spec`` /
+  ``sabotage_selftest``                — the property-based weather fuzzer
+                                         (scenarios/fuzz.py): seeded random
+                                         timelines over this vocabulary,
+                                         delta-debugging shrinker, soak
+                                         campaign + found-bug self-test
+  ``TraceRecorder`` / ``trace_to_spec`` / ``save_regression_spec`` /
+  ``load_regression_specs``            — trace capture (scenarios/trace.py):
+                                         a live plane's WAL journal, log
+                                         stream, and supervisor IPC recorded
+                                         and distilled back into a replayable
+                                         ``ScenarioSpec``; fuzz-found minimal
+                                         timelines are checked in under
+                                         ``scenarios/regressions/``
 
 ``tools/scenario_engine.py`` is the CLI (SCORECARD.json emission +
 determinism check + last-green diff); ``tools/gate.py --scenarios``
-wires it into CI.
+wires it into CI, and ``tools/gate.py --fuzz`` (tools/fuzz_matrix.py)
+runs the fuzz campaign + sabotage self-test.
 """
 from .engine import EVENT_HANDLERS, ScenarioRun, run_scenario
+from .fuzz import (
+    campaign,
+    generate_proc_weather,
+    generate_weather,
+    sabotage_selftest,
+    shrink_spec,
+)
 from .library import SABOTAGE_SCENARIOS, SCENARIOS
 from .matrix import (
     FAULT_SCENARIO_CASES,
@@ -33,6 +55,15 @@ from .procs import (
     run_proc_scenario,
 )
 from .spec import DEFAULT_INVARIANTS, Ev, SLO, ScenarioSpec
+from .trace import (
+    TraceRecorder,
+    capture_data_dir,
+    load_regression_specs,
+    save_regression_spec,
+    spec_from_jsonable,
+    spec_to_jsonable,
+    trace_to_spec,
+)
 
 __all__ = [
     "DEFAULT_INVARIANTS",
@@ -47,8 +78,20 @@ __all__ = [
     "SLO",
     "ScenarioRun",
     "ScenarioSpec",
+    "TraceRecorder",
+    "campaign",
+    "capture_data_dir",
+    "generate_proc_weather",
+    "generate_weather",
+    "load_regression_specs",
     "run_crash_point",
     "run_matrix_case",
     "run_proc_scenario",
     "run_scenario",
+    "sabotage_selftest",
+    "save_regression_spec",
+    "shrink_spec",
+    "spec_from_jsonable",
+    "spec_to_jsonable",
+    "trace_to_spec",
 ]

@@ -92,9 +92,13 @@ class ScenarioRun:
     """One seeded replay of one spec. Mutable state the event handlers
     and checks read/write; see the module docstring for the loop."""
 
-    def __init__(self, spec: ScenarioSpec, seed: Optional[int] = None):
+    def __init__(self, spec: ScenarioSpec, seed: Optional[int] = None,
+                 keep_data_dir: bool = False):
         self.spec = spec
         self.seed = spec.seed if seed is None else seed
+        #: trace capture reads the WAL after the run: leave the durable
+        #: data dir on disk for the caller to harvest (and remove)
+        self.keep_data_dir = keep_data_dir
         self.data_dir: Optional[str] = None
         self.lease = None
         self._thief = None  # pending failover lease (region-steal event)
@@ -294,7 +298,11 @@ class ScenarioRun:
             mark_task_started(self.store, tid, now=self.now)
             status, details = TaskStatus.SUCCEEDED.value, ""
             for plan in self.fail_plan:
-                if tid.startswith(plan["match"]) and (
+                hit = (
+                    tid == plan["match"] if plan.get("exact")
+                    else tid.startswith(plan["match"])
+                )
+                if hit and (
                     plan.get("remaining") is None or plan["remaining"] > 0
                 ):
                     status = TaskStatus.FAILED.value
@@ -429,7 +437,7 @@ class ScenarioRun:
         except Exception:  # noqa: BLE001 — a fenced/failed-over store may  # evglint: disable=shedcheck -- teardown after the scorecard is computed; nothing reads the store again
             # refuse close work; the scorecard is already computed
             pass
-        if self.data_dir is not None:
+        if self.data_dir is not None and not self.keep_data_dir:
             shutil.rmtree(self.data_dir, ignore_errors=True)  # evglint: disable=fencecheck -- harness-owned temp data dir removed after the plane is closed; no live holder to fence against
 
 
@@ -533,15 +541,25 @@ def ev_grow_fleet(
     provisions them to RUNNING."""
     prefix = prefix or f"{distro}-g{run.tick}"
     d = distro_mod.get(run.store, distro)
-    for i in range(n):
+    hosts_coll = run.store.collection("hosts")
+    created, i = 0, 0
+    while created < n:
+        # two grows on one distro in one tick share the default prefix
+        # (fuzzer-found, seed 160077) — number past taken ids instead
+        # of crashing, keeping ids stable for every existing scenario
+        hid = f"{prefix}-{i:03d}"
+        i += 1
+        if hosts_coll.get(hid) is not None:
+            continue
         h = Host(
-            id=f"{prefix}-{i:03d}",
+            id=hid,
             distro_id=distro,
             provider=d.provider if d else Provider.MOCK.value,
             status=HostStatus.UNINITIALIZED.value,
             creation_time=run.now,
         )
         host_mod.insert(run.store, h)
+        created += 1
 
 
 def ev_tasks(
@@ -638,6 +656,7 @@ def ev_dag(run: ScenarioRun, distro: str, nodes: List[Dict]) -> None:
             requester=node.get(
                 "requester", Requester.REPOTRACKER.value
             ),
+            priority=node.get("priority", 0),
             revision_order_number=node.get("revision_order", 0),
             create_time=run.now - 60,
             activated_time=run.now - 30 if node.get("activated", True)
@@ -657,11 +676,16 @@ def ev_fail_next(
     match: str,
     details_type: str = "test",
     count: Optional[int] = 1,
+    exact: bool = False,
 ) -> None:
     """Arm the completion agent: the next ``count`` completions of tasks
-    whose id starts with ``match`` fail with ``details_type``."""
+    whose id starts with ``match`` fail with ``details_type``.
+    ``exact`` requires a full-id match — trace capture arms one plan per
+    originally-failed task, and a prefix would mis-fire on ids that
+    happen to extend it (``t-1`` vs ``t-10``)."""
     run.fail_plan.append(
-        {"match": match, "details_type": details_type, "remaining": count}
+        {"match": match, "details_type": details_type,
+         "remaining": count, "exact": exact}
     )
 
 

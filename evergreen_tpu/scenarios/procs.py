@@ -110,6 +110,7 @@ def _seed_fleet(data_dir: str, n_shards: int, workload: dict) -> None:
         hosts_per_distro=workload.get("hosts_per_distro", 3),
         dep_fraction=workload.get("dep_fraction", 0.25),
     )
+    sabotage = bool(workload.get("sabotage_duplicate_claim"))
     topo = ShardTopology(n_shards)
     stores = [
         DurableStore(data_dir, shard_id=k) for k in range(n_shards)
@@ -131,6 +132,24 @@ def _seed_fleet(data_dir: str, n_shards: int, workload: dict) -> None:
                     host_mod.coll(store).upsert(h.to_doc())
             finally:
                 store.end_tick()
+        if sabotage:
+            # fuzz-gate self-test: forge two TASK_DISPATCHED events for
+            # one (task, timestamp) — two CAS winners — bypassing the
+            # dispatch path entirely. The campaign MUST score this fleet
+            # red (no_duplicate_dispatch) or the invariant layer is dead.
+            store = stores[0]
+            store.begin_tick()
+            try:
+                ev_coll = store.collection("events")
+                for i in range(2):
+                    ev_coll.upsert({
+                        "_id": f"sabotage-dup-{i}",
+                        "event_type": "TASK_DISPATCHED",
+                        "resource_id": "sabotage-t0",
+                        "timestamp": NOW,
+                    })
+            finally:
+                store.end_tick()
     finally:
         for s in stores:
             s.sync_persist()
@@ -149,9 +168,12 @@ class ProcScenarioRun:
     """One replay of one proc spec against a supervised fleet."""
 
     def __init__(self, spec: ScenarioSpec,
-                 with_reference: bool = True) -> None:
+                 with_reference: bool = True,
+                 seed: Optional[int] = None,
+                 keep_data_dir: bool = False) -> None:
         self.spec = spec
         self.with_reference = with_reference
+        self.keep_data_dir = keep_data_dir
         fleet_evs = [e for e in spec.events if e.kind == "proc_fleet"]
         if len(fleet_evs) != 1 or fleet_evs[0].tick != 0:
             raise ValueError(
@@ -159,6 +181,15 @@ class ProcScenarioRun:
                 "tick 0"
             )
         self.workload = dict(fleet_evs[0].args)
+        # seed flows END TO END: an explicit seed overrides both the
+        # spec's stamp and the workload generator's, so a fuzzer-found
+        # timeline replays the same seeded problem in process mode that
+        # it replayed in-process (ISSUE 16 satellite bugfix)
+        if seed is not None:
+            self.workload["seed"] = int(seed)
+        # the scorecard reports the EFFECTIVE workload seed (what
+        # generate_problem actually consumed), never a dead spec stamp
+        self.seed = int(self.workload.get("seed", 11))
         self.n_shards = int(self.workload.get("shards", 2))
         bad = [
             e.kind for e in spec.events
@@ -418,7 +449,9 @@ class ProcScenarioRun:
                 and self._has_faults()
                 and self.reference_state is None
             ):
-                self.reference_state = _reference_canonical(self.spec)
+                self.reference_state = _reference_canonical(
+                    self.spec, seed=self.seed
+                )
             entry = self._score()
             entry["timing"] = {
                 "wall_ms": round((_time.perf_counter() - t0) * 1e3, 1)
@@ -528,7 +561,7 @@ class ProcScenarioRun:
             return {
                 "name": self.spec.name,
                 "ok": ok,
-                "seed": self.spec.seed,
+                "seed": self.seed,
                 "deterministic": False,  # real processes, real clocks
                 "backend": "procs",
                 "invariants": invariants,
@@ -549,7 +582,10 @@ class ProcScenarioRun:
     def _teardown(self) -> None:
         import shutil
 
-        if self.data_dir is not None:
+        # trace capture reads the per-shard WAL segments after the run:
+        # leave the data dir on disk for the caller to harvest (and
+        # remove)
+        if self.data_dir is not None and not self.keep_data_dir:
             shutil.rmtree(self.data_dir, ignore_errors=True)  # evglint: disable=fencecheck -- harness-owned temp data dir removed after every worker process exited; no live holder to fence against
 
 
@@ -647,10 +683,13 @@ PROC_INVARIANT_CHECKS = {
 }
 
 
-def _reference_canonical(spec: ScenarioSpec) -> dict:
+def _reference_canonical(spec: ScenarioSpec,
+                         seed: Optional[int] = None) -> dict:
     """The rerun side: the same spec with every proc_kill / proc_hang
     stripped, replayed uninterrupted; returns the merged canonical
-    state at convergence."""
+    state at convergence. ``seed`` pins the reference to the crashed
+    run's effective workload seed — resume ≡ rerun compares the SAME
+    seeded problem."""
     import dataclasses
 
     clean = dataclasses.replace(
@@ -665,7 +704,7 @@ def _reference_canonical(spec: ScenarioSpec) -> dict:
         slos=[],
         invariants=("converged",),
     )
-    run = ProcScenarioRun(clean, with_reference=False)
+    run = ProcScenarioRun(clean, with_reference=False, seed=seed)
     entry = run.execute()
     if not entry["ok"]:
         raise RuntimeError(
@@ -676,9 +715,13 @@ def _reference_canonical(spec: ScenarioSpec) -> dict:
     return run.reference_canonical
 
 
-def run_proc_scenario(spec: ScenarioSpec) -> Dict:
-    """Replay one proc spec; returns its scorecard entry."""
-    return ProcScenarioRun(spec).execute()
+def run_proc_scenario(spec: ScenarioSpec,
+                      seed: Optional[int] = None) -> Dict:
+    """Replay one proc spec; returns its scorecard entry. ``seed``
+    overrides the workload seed end-to-end (same contract as
+    ``engine.run_scenario(spec, seed)``), so a fuzzer-found timeline
+    replays the identical seeded problem in process mode."""
+    return ProcScenarioRun(spec, seed=seed).execute()
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,706 @@
+"""Trace capture: round-trip a live plane's event stream into a spec.
+
+Any run — an in-process scenario, a crash-matrix session against the
+supervised fleet, a production incident under ``service`` — leaves two
+kinds of evidence: the durable story (WAL segments + snapshots in the
+data dir, storage/durable.py) and the runtime story (structured log
+records, supervisor control-IPC traffic). This module turns either into
+a deterministic, seeded ``ScenarioSpec`` whose replay carries a diffable
+scorecard:
+
+  ``events_from_wal(data_dir)``   offline: parse every WAL segment +
+                                  snapshot in a data dir into semantic
+                                  ``TraceEvent``s (task arrivals with
+                                  their dependency edges, completions
+                                  with failure class, distro/host
+                                  inventory, fence frames)
+  ``TraceRecorder``               live: tap the WAL journal
+                                  (storage/durable.py journal taps), the
+                                  structured-log stream (dispatch/agent/
+                                  fault breadcrumbs) and the supervisor
+                                  control IPC (runtime/supervisor.py ipc
+                                  taps) into a JSONL trace file
+  ``trace_to_spec(events)``       compile semantic events into a replay
+                                  spec: the fleet at tick 0, exact task
+                                  DAGs bucketed into virtual ticks,
+                                  originally-failed tasks armed as
+                                  exact-match ``fail_next`` plans
+  ``spec_to_jsonable`` / ``spec_from_jsonable`` / ``save_regression_spec``
+  / ``load_regression_specs``     the checked-in regression format every
+                                  fuzz-found minimal timeline ships in
+                                  (scenarios/regressions/*.json)
+
+The round-trip contract is over the **canonical surface** (the same
+tasks+queues view resume ≡ rerun compares): replaying the captured spec
+must converge to the captured run's canonical fingerprint, and the
+replay itself is deterministic — same seed ⇒ same scorecard
+fingerprint. Wall-clock shape (which host ran what, how long a tick
+took) is deliberately NOT part of the contract; decisions and converged
+state are.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time as _time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..utils import lockcheck as _lockcheck
+
+from ..globals import Provider, TaskStatus
+from .spec import DEFAULT_INVARIANTS, Ev, SLO, ScenarioSpec
+
+#: statuses that mean a task's story ended (the completion the replay's
+#: deterministic agent must reproduce)
+_FINISHED = (TaskStatus.SUCCEEDED.value, TaskStatus.FAILED.value)
+
+#: providers ev_fleet can faithfully re-create; anything else (a real
+#: cloud only production talks to) replays against the mock provider
+_REPLAYABLE_PROVIDERS = {
+    Provider.MOCK.value,
+    Provider.DOCKER_MOCK.value,
+    Provider.EC2_FLEET.value,
+    Provider.EC2_ONDEMAND.value,
+}
+
+REGRESSIONS_DIR = os.path.join(os.path.dirname(__file__), "regressions")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One semantic capture record. ``ts`` is the wall/frame timestamp
+    when one was recoverable, else None (snapshot-resident docs: their
+    arrival order was compacted away)."""
+
+    kind: str
+    data: dict
+    ts: Optional[float] = None
+
+
+# --------------------------------------------------------------------------- #
+# WAL → semantic events
+# --------------------------------------------------------------------------- #
+
+
+class _FleetStateTracker:
+    """Replays raw WAL op records over a minimal doc model of the three
+    collections a spec can re-create (distros / tasks / hosts), emitting
+    a semantic TraceEvent at each first-seen and each finish transition.
+    Shared by the offline parser and the live recorder so the two can
+    never diverge on what a record means."""
+
+    _COLLS = ("distros", "tasks", "hosts")
+
+    def __init__(self) -> None:
+        self.docs: Dict[str, Dict[str, dict]] = {c: {} for c in self._COLLS}
+        self.first_ts: Dict[Tuple[str, str], Optional[float]] = {}
+        self.events: List[TraceEvent] = []
+        self._finished: set = set()
+
+    # -- feeding ---------------------------------------------------------- #
+
+    def feed_snapshot(self, collections: Dict[str, list]) -> None:
+        """Compacted history: docs whose arrival records were truncated
+        away. They land with ts=None — the spec builder buckets them at
+        tick 0."""
+        for coll in self._COLLS:
+            for doc in collections.get(coll, ()):
+                self._upsert(coll, dict(doc), ts=None)
+
+    def feed_record(self, rec: dict, ts: Optional[float] = None) -> None:
+        op = rec.get("o")
+        if op == "g":
+            frame_ts = rec.get("ts", ts)
+            for sub in rec.get("rs", ()):
+                self.feed_record(sub, ts=frame_ts)
+            return
+        if op == "f":
+            self.events.append(TraceEvent(
+                "fence", {"epoch": int(rec.get("e", 0) or 0)}, ts=ts,
+            ))
+            return
+        coll = rec.get("c")
+        if coll not in self.docs:
+            return
+        if op == "p":
+            self._upsert(coll, dict(rec["d"]), ts=ts)
+        elif op == "pm":
+            for d in rec.get("ds", ()):
+                self._upsert(coll, dict(d), ts=ts)
+        elif op == "u":
+            self._patch(coll, rec.get("i"), rec.get("f") or {}, ts=ts)
+        elif op == "um":
+            for i in rec.get("is", ()):
+                self._patch(coll, i, rec.get("f") or {}, ts=ts)
+        elif op in ("pl", "qs"):
+            f = rec.get("f")
+            if f:
+                self._patch(coll, rec.get("i"), f, ts=ts)
+        elif op == "r":
+            self.docs[coll].pop(rec.get("i"), None)
+        elif op == "x":
+            self.docs[coll].clear()
+
+    # -- doc model --------------------------------------------------------- #
+
+    def _upsert(self, coll: str, doc: dict, ts: Optional[float]) -> None:
+        did = doc.get("_id")
+        if did is None:
+            return
+        fresh = did not in self.docs[coll]
+        if fresh:
+            self.first_ts[(coll, did)] = ts
+            self.events.append(TraceEvent(
+                {"distros": "distro", "tasks": "task_arrival",
+                 "hosts": "host"}[coll],
+                {"id": did}, ts=ts,
+            ))
+        self.docs[coll][did] = doc
+        if coll == "tasks":
+            self._note_finish(did, doc, ts)
+
+    def _patch(self, coll: str, did, fields: dict,
+               ts: Optional[float]) -> None:
+        if did is None:
+            return
+        doc = self.docs[coll].get(did)
+        if doc is None:
+            # base write lost to a torn frame — synthesize the doc so a
+            # later finish transition is still observed
+            doc = {"_id": did}
+            self.docs[coll][did] = doc
+            self.first_ts[(coll, did)] = ts
+        doc.update(fields)
+        if coll == "tasks":
+            self._note_finish(did, doc, ts)
+
+    def _note_finish(self, tid: str, doc: dict,
+                     ts: Optional[float]) -> None:
+        if doc.get("status") in _FINISHED and tid not in self._finished:
+            self._finished.add(tid)
+            self.events.append(TraceEvent(
+                "task_finish",
+                {"id": tid, "status": doc["status"],
+                 "details_type": doc.get("details_type", "")},
+                ts=ts,
+            ))
+
+
+def _iter_segments(data_dir: str):
+    """Yield ``(shard_id, snapshot_doc_or_None, wal_records)`` per
+    durability segment in ``data_dir`` (unsharded classic files and the
+    fleet's per-shard segments alike)."""
+    from ..parallel.topology import snapshot_segment_name, wal_segment_name
+    from ..storage.durable import fleet_segment_ids
+
+    for shard in fleet_segment_ids(data_dir):
+        snap_doc = None
+        snap_path = os.path.join(data_dir, snapshot_segment_name(shard))
+        try:
+            with open(snap_path, encoding="utf-8") as fh:
+                snap_doc = json.load(fh)
+        except (OSError, ValueError):
+            snap_doc = None
+        records: List[dict] = []
+        wal_path = os.path.join(data_dir, wal_segment_name(shard))
+        try:
+            with open(wal_path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn/repaired stub: skip, keep reading
+        except OSError:
+            pass
+        yield shard, snap_doc, records
+
+
+def events_from_wal(data_dir: str) -> List[TraceEvent]:
+    """Parse every durability segment in ``data_dir`` into semantic
+    trace events. Snapshots contribute the compacted prefix (ts=None),
+    WAL lines the live tail (frame ``ts`` when present). The final doc
+    state rides along in one trailing ``state`` event so the spec
+    builder sees exactly what the plane converged to."""
+    tracker = _FleetStateTracker()
+    for _shard, snap_doc, records in _iter_segments(data_dir):
+        if snap_doc:
+            tracker.feed_snapshot(snap_doc.get("collections", {}))
+        for rec in records:
+            tracker.feed_record(rec)
+    tracker.events.append(TraceEvent(
+        "state",
+        {"docs": tracker.docs,
+         "first_ts": {
+             f"{coll}/{did}": ts
+             for (coll, did), ts in tracker.first_ts.items()
+         }},
+    ))
+    return tracker.events
+
+
+# --------------------------------------------------------------------------- #
+# semantic events → ScenarioSpec
+# --------------------------------------------------------------------------- #
+
+
+def _dep_depth(tasks: Dict[str, dict]) -> int:
+    memo: Dict[str, int] = {}
+
+    def depth(tid: str, stack: frozenset) -> int:
+        if tid in memo:
+            return memo[tid]
+        if tid in stack:
+            return 0  # cycle guard: corrupt capture must not recurse out
+        doc = tasks.get(tid)
+        deps = [d.get("task_id") for d in (doc or {}).get("depends_on", [])]
+        memo[tid] = 1 + max(
+            (depth(d, stack | {tid}) for d in deps if d in tasks),
+            default=0,
+        )
+        return memo[tid]
+
+    return max((depth(t, frozenset()) for t in tasks), default=0)
+
+
+def trace_to_spec(
+    events: List[TraceEvent],
+    name: str = "captured-trace",
+    tick_s: float = 15.0,
+    seed: int = 0,
+    max_arrival_ticks: int = 24,
+) -> ScenarioSpec:
+    """Compile captured events into a replayable spec.
+
+    The fleet (distros + host counts) lands at tick 0; tasks are
+    re-created exactly (ids, dependency edges, requester, priority,
+    revision order) as ``dag`` events bucketed into virtual ticks by
+    their captured arrival timestamps; every originally-FAILED task arms
+    one exact-match ``fail_next`` plan so the deterministic agent
+    reproduces the failure pattern. ``ticks`` is sized so the replay
+    converges: arrival span + dependency depth + drain time at the
+    captured host capacity."""
+    state = next(
+        (e.data for e in reversed(events) if e.kind == "state"), None,
+    )
+    if state is None:
+        # live-recorder path: rebuild the state from the event stream
+        tracker = _FleetStateTracker()
+        for e in events:
+            if e.kind == "wal_record":
+                tracker.feed_record(e.data["rec"], ts=e.ts)
+        state = {"docs": tracker.docs, "first_ts": {
+            f"{coll}/{did}": ts
+            for (coll, did), ts in tracker.first_ts.items()
+        }}
+    docs = state["docs"]
+    first_ts = state.get("first_ts", {})
+    distros = docs.get("distros", {})
+    tasks = docs.get("tasks", {})
+    hosts = docs.get("hosts", {})
+
+    hosts_by_distro: Dict[str, int] = {}
+    for h in hosts.values():
+        did = h.get("distro_id", "")
+        hosts_by_distro[did] = hosts_by_distro.get(did, 0) + 1
+
+    fleet = []
+    for did in sorted(distros):
+        d = distros[did]
+        provider = d.get("provider", Provider.MOCK.value)
+        if provider not in _REPLAYABLE_PROVIDERS:
+            provider = Provider.MOCK.value
+        fleet.append({
+            "id": did,
+            "provider": provider,
+            "hosts": hosts_by_distro.get(did, 0),
+            "max_hosts": max(
+                100, int(
+                    (d.get("host_allocator_settings") or {})
+                    .get("maximum_hosts", 100) or 100
+                ),
+            ),
+        })
+    # tasks referencing a distro that never had a doc (partial capture)
+    # still need a home for the queue to exist
+    for t in tasks.values():
+        did = t.get("distro_id", "")
+        if did and all(f["id"] != did for f in fleet):
+            fleet.append({
+                "id": did, "provider": Provider.MOCK.value,
+                "hosts": max(1, hosts_by_distro.get(did, 0)),
+            })
+
+    # arrival ticks: anchor at the earliest timestamped arrival;
+    # snapshot-resident docs (ts None) land at tick 0
+    stamps = [
+        ts for key, ts in first_ts.items()
+        if ts is not None and key.startswith("tasks/")
+    ]
+    anchor = min(stamps) if stamps else None
+
+    def arrival_tick(tid: str) -> int:
+        ts = first_ts.get(f"tasks/{tid}")
+        if ts is None or anchor is None:
+            return 0
+        return min(int((ts - anchor) // tick_s), max_arrival_ticks)
+
+    nodes_by_tick: Dict[int, Dict[str, list]] = {}
+    fail_events: List[Ev] = []
+    for tid in sorted(tasks):
+        t = tasks[tid]
+        did = t.get("distro_id", "")
+        node = {
+            "id": tid,
+            "display_name": t.get("display_name", tid),
+            "project": t.get("project", "proj"),
+            "version": t.get("version", f"{tid}-v"),
+            "build_variant": t.get("build_variant", "bv0"),
+            "activated": bool(t.get("activated", True)),
+            "requester": t.get("requester", ""),
+            "priority": int(t.get("priority", 0) or 0),
+            "revision_order": int(
+                t.get("revision_order_number", 0) or 0
+            ),
+            "expected_s": float(t.get("expected_duration_s", 300.0) or 300.0),
+            "deps": [
+                d.get("task_id") for d in t.get("depends_on", [])
+                if d.get("task_id")
+            ],
+        }
+        nodes_by_tick.setdefault(arrival_tick(tid), {}) \
+            .setdefault(did, []).append(node)
+        if t.get("status") == TaskStatus.FAILED.value:
+            fail_events.append(Ev(0, "fail_next", {
+                "match": tid, "exact": True, "count": 1,
+                "details_type": t.get("details_type", "") or "test",
+            }))
+
+    spec_events: List[Ev] = [Ev(0, "fleet", {"distros": fleet})]
+    spec_events.extend(fail_events)
+    for tick in sorted(nodes_by_tick):
+        for did in sorted(nodes_by_tick[tick]):
+            spec_events.append(Ev(tick, "dag", {
+                "distro": did, "nodes": nodes_by_tick[tick][did],
+            }))
+
+    n_hosts = max(1, sum(f.get("hosts", 0) for f in fleet))
+    arrival_span = max(nodes_by_tick, default=0)
+    drain = -(-len(tasks) // n_hosts)  # ceil
+    ticks = arrival_span + 2 * (_dep_depth(tasks) + drain) + 6
+    return ScenarioSpec(
+        name=name,
+        description=(
+            f"captured trace: {len(tasks)} tasks across "
+            f"{len(fleet)} distros, {len(fail_events)} failures "
+            "re-armed; replay converges to the captured canonical "
+            "fingerprint"
+        ),
+        ticks=ticks,
+        events=spec_events,
+        seed=seed,
+        tick_s=tick_s,
+        invariants=DEFAULT_INVARIANTS,
+    )
+
+
+def capture_data_dir(
+    data_dir: str, name: str = "captured-trace", **kw
+) -> ScenarioSpec:
+    """One-call offline capture: WAL segments + snapshots → spec."""
+    return trace_to_spec(events_from_wal(data_dir), name=name, **kw)
+
+
+# --------------------------------------------------------------------------- #
+# canonical fingerprints (the round-trip parity surface)
+# --------------------------------------------------------------------------- #
+
+
+def canonical_fingerprint_of_state(state: dict) -> str:
+    """Stable hash of a canonical_state() dict (tasks + queues)."""
+    payload = json.dumps(state, sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def canonical_fingerprint(store) -> str:
+    from .invariants import canonical_state
+
+    return canonical_fingerprint_of_state(canonical_state(store))
+
+
+# --------------------------------------------------------------------------- #
+# spec (de)serialization + the checked-in regression corpus
+# --------------------------------------------------------------------------- #
+
+
+def spec_to_jsonable(spec: ScenarioSpec, lossy: bool = False) -> dict:
+    """Serialize a spec to the checked-in regression format. ``call``
+    events and ``checks`` hold live callables and cannot round-trip;
+    without ``lossy`` they are an error so a regression spec can never
+    silently lose the assertion that made it red."""
+    dropped = []
+    events = []
+    for ev in spec.events:
+        if ev.kind == "call":
+            if not lossy:
+                raise ValueError(
+                    f"spec {spec.name!r} has a 'call' event at tick "
+                    f"{ev.tick}: callables don't serialize (pass "
+                    "lossy=True to drop it, recorded as such)"
+                )
+            dropped.append(f"call@{ev.tick}")
+            continue
+        events.append({"tick": ev.tick, "kind": ev.kind, "args": ev.args})
+    if spec.checks and not lossy:
+        raise ValueError(
+            f"spec {spec.name!r} carries {len(spec.checks)} live "
+            "check callables (pass lossy=True to drop them)"
+        )
+    doc = {
+        "schema": 1,
+        "name": spec.name,
+        "description": spec.description,
+        "ticks": spec.ticks,
+        "seed": spec.seed,
+        "tick_s": spec.tick_s,
+        "durable": spec.durable,
+        "deterministic": spec.deterministic,
+        "default_task_ticks": spec.default_task_ticks,
+        "service_loop": spec.service_loop,
+        "tick_options": spec.tick_options,
+        "overload": spec.overload,
+        "config": spec.config,
+        "tier1": spec.tier1,
+        "invariants": list(spec.invariants),
+        "events": events,
+        "slos": [
+            {"name": s.name, "metric": s.metric, "op": s.op,
+             "bound": s.bound}
+            for s in spec.slos
+        ],
+    }
+    if dropped or (spec.checks and lossy):
+        doc["lossy"] = {
+            "dropped_events": dropped,
+            "dropped_checks": [name for name, _ in spec.checks],
+        }
+    return doc
+
+
+def spec_from_jsonable(doc: dict) -> ScenarioSpec:
+    return ScenarioSpec(
+        name=doc["name"],
+        description=doc.get("description", ""),
+        ticks=int(doc["ticks"]),
+        events=[
+            Ev(int(e["tick"]), e["kind"], dict(e.get("args", {})))
+            for e in doc.get("events", ())
+        ],
+        slos=[
+            SLO(s["name"], s["metric"], s["op"], s["bound"])
+            for s in doc.get("slos", ())
+        ],
+        invariants=tuple(doc.get("invariants", DEFAULT_INVARIANTS)),
+        seed=int(doc.get("seed", 0)),
+        tick_s=float(doc.get("tick_s", 15.0)),
+        durable=bool(doc.get("durable", False)),
+        deterministic=bool(doc.get("deterministic", True)),
+        default_task_ticks=int(doc.get("default_task_ticks", 1)),
+        service_loop=bool(doc.get("service_loop", True)),
+        tick_options=dict(doc.get("tick_options", {})),
+        overload=dict(doc.get("overload", {})),
+        config=dict(doc.get("config", {})),
+        tier1=bool(doc.get("tier1", True)),
+    )
+
+
+def save_regression_spec(
+    spec: ScenarioSpec, out_dir: Optional[str] = None,
+    lossy: bool = False,
+) -> str:
+    """Write one fuzz-found minimal timeline as a ready-to-check-in
+    regression spec; returns the path. The repo rule (ARCHITECTURE.md):
+    every such spec IS checked in under scenarios/regressions/ so the
+    weather that broke an invariant replays in CI forever."""
+    out_dir = out_dir or REGRESSIONS_DIR
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"{spec.name}.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(spec_to_jsonable(spec, lossy=lossy), fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_regression_specs(
+    reg_dir: Optional[str] = None,
+) -> Dict[str, Callable[[], ScenarioSpec]]:
+    """The checked-in fuzz-regression corpus as scenario factories
+    (same shape as library.SCENARIOS — tools/scenario_engine.py and the
+    tier-1 green test run them alongside the shipped weathers)."""
+    reg_dir = reg_dir or REGRESSIONS_DIR
+    out: Dict[str, Callable[[], ScenarioSpec]] = {}
+    try:
+        names = sorted(os.listdir(reg_dir))
+    except OSError:
+        return out
+    for fname in names:
+        if not fname.endswith(".json"):
+            continue
+        path = os.path.join(reg_dir, fname)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            raise RuntimeError(
+                f"unreadable regression spec {path}: {exc}"
+            ) from exc
+
+        def factory(doc=doc):
+            return spec_from_jsonable(doc)
+
+        out[doc["name"]] = factory
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# live capture
+# --------------------------------------------------------------------------- #
+
+#: structured-log events worth a trace line (dispatch/agent timings,
+#: fault + lease breadcrumbs); everything else is volume without signal
+_LOG_EVENT_MARKERS = (
+    "dispatch", "agent", "fault", "lease", "tick", "recovery",
+)
+
+
+class TraceRecorder:
+    """Tap a live plane's three streams into one timeline.
+
+    ``start()`` installs a WAL journal tap (every line any _Journal in
+    the process writes), a structured-log sink (filtered to dispatch/
+    agent/fault/lease breadcrumbs), and a supervisor control-IPC tap
+    (every command sent to and message received from a worker).
+    ``stop()`` removes them and returns the events; with ``path`` set,
+    every event is also appended to a JSONL trace file as it happens, so
+    a crashed process still leaves its timeline behind."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self.events: List[TraceEvent] = []
+        self._lock = _lockcheck.make_lock("scenarios.trace.recorder")
+        self._fh = None
+        self._started = False
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def start(self) -> "TraceRecorder":
+        from ..runtime import supervisor as supervisor_mod
+        from ..storage import durable as durable_mod
+        from ..utils import log as log_mod
+
+        if self._started:
+            return self
+        if self.path:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        durable_mod.add_journal_tap(self._on_wal_line)
+        log_mod.add_sink(self._on_log)
+        supervisor_mod.add_ipc_tap(self._on_ipc)
+        self._started = True
+        return self
+
+    def stop(self) -> List[TraceEvent]:
+        from ..runtime import supervisor as supervisor_mod
+        from ..storage import durable as durable_mod
+        from ..utils import log as log_mod
+
+        if self._started:
+            durable_mod.remove_journal_tap(self._on_wal_line)
+            log_mod.remove_sink(self._on_log)
+            supervisor_mod.remove_ipc_tap(self._on_ipc)
+            self._started = False
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+        return list(self.events)
+
+    def __enter__(self) -> "TraceRecorder":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- taps --------------------------------------------------------------- #
+
+    def _emit(self, ev: TraceEvent) -> None:
+        with self._lock:
+            self.events.append(ev)
+            if self._fh is not None:
+                self._fh.write(json.dumps(
+                    {"t": ev.ts, "kind": ev.kind, "data": ev.data},
+                    separators=(",", ":"), default=str,
+                ) + "\n")
+                self._fh.flush()
+
+    def _on_wal_line(self, path: str, line: str) -> None:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return
+        self._emit(TraceEvent(
+            "wal_record",
+            {"segment": os.path.basename(path), "rec": rec},
+            ts=round(_time.time(), 3),
+        ))
+
+    def _on_log(self, record: dict) -> None:
+        event = str(record.get("message", ""))
+        if not any(m in event for m in _LOG_EVENT_MARKERS):
+            return
+        self._emit(TraceEvent(
+            "log", dict(record), ts=round(_time.time(), 3),
+        ))
+
+    def _on_ipc(self, direction: str, shard, msg: dict) -> None:
+        self._emit(TraceEvent(
+            "ipc",
+            {"direction": direction, "shard": shard,
+             "op": msg.get("op", ""),
+             "req": msg.get("req"), "epoch": msg.get("epoch")},
+            ts=round(_time.time(), 3),
+        ))
+
+    # -- compile ------------------------------------------------------------ #
+
+    def spec(self, name: str = "captured-trace", **kw) -> ScenarioSpec:
+        return trace_to_spec(list(self.events), name=name, **kw)
+
+
+def read_trace_file(path: str) -> List[TraceEvent]:
+    events: List[TraceEvent] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            events.append(TraceEvent(
+                doc.get("kind", ""), doc.get("data", {}), ts=doc.get("t"),
+            ))
+    return events
+
+
+def spec_from_trace_file(path: str, name: str = "captured-trace",
+                         **kw) -> ScenarioSpec:
+    """Compile a recorder's JSONL trace file back into a replay spec
+    (the incident-to-regression path: copy the trace off the box, run
+    this, check the spec in)."""
+    return trace_to_spec(read_trace_file(path), name=name, **kw)

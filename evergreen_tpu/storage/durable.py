@@ -136,6 +136,24 @@ WAL_FLUSH_BACKLOG = _metrics.gauge(
     "Frames waiting on (or being written by) the async WAL flusher.",
 )
 
+#: trace-capture taps: fn(path, line) called for every committed WAL
+#: line in the process (scenarios/trace.py TraceRecorder). Taps run
+#: OUTSIDE the journal lock and after the write — they observe
+#: durability, they cannot delay or fail it.
+_JOURNAL_TAPS: list = []
+
+
+def add_journal_tap(tap) -> None:
+    if tap not in _JOURNAL_TAPS:
+        _JOURNAL_TAPS.append(tap)
+
+
+def remove_journal_tap(tap) -> None:
+    try:
+        _JOURNAL_TAPS.remove(tap)
+    except ValueError:
+        pass
+
 
 class _Journal:
     """Append-only op log shared by all collections of one store."""
@@ -285,6 +303,11 @@ class _Journal:
                 if self.sync == "fsync":
                     os.fsync(self._fh.fileno())  # evglint: disable=lockgraph -- the fsync IS the WAL write barrier: appends must queue behind durability; group commit amortizes it to one per tick
             self.ops += n_ops
+        for tap in list(_JOURNAL_TAPS):
+            try:
+                tap(self.path, line)
+            except Exception:  # noqa: BLE001 — a broken tap must never  # evglint: disable=shedcheck -- a broken trace tap must never fail the WAL write it observed; the record itself is already durably committed above
+                pass  # fail the write it observed
 
     def rotate(self) -> None:
         """Start a fresh log generation after a successful snapshot

@@ -139,6 +139,20 @@ def generate_problem(
     return distros, tasks_by_distro, hosts_by_distro, estimates, deps_met
 
 
+def _probe_cause_histogram(probe_history: list) -> dict:
+    """Collapse probe attempts to the bounded cause taxonomy (see
+    jaxenv.probe_cause): {"ok": 2, "timeout": 3, ...}."""
+    from .jaxenv import probe_cause
+
+    causes: dict = {}
+    for rec in probe_history:
+        cause = "ok" if rec.get("ok") else probe_cause(
+            rec.get("reason", "")
+        )
+        causes[cause] = causes.get(cause, 0) + 1
+    return causes
+
+
 def bench_result_payload(
     *,
     tpu_ms: float,
@@ -182,6 +196,10 @@ def bench_result_payload(
         # last 4 probes only — the payload must stay bounded however many
         # retries the tunnel needed
         "probe_history": probe_history[-4:],
+        # ...but the cause taxonomy over ALL attempts stays (bounded by
+        # the taxonomy itself): a 12-retry run truncated to its last 4
+        # probes must not hide what the first 8 died of
+        "probe_causes": _probe_cause_histogram(probe_history),
         "overload_counters": overload_counters or {},
     }
     # resident-state-plane breakdown: the delta-driven churn tick vs the

@@ -180,6 +180,48 @@ def probe_tpu(timeout_s: float = 45.0, env: dict | None = None) -> bool:
     return probe_tpu_detail(timeout_s, env)[0]
 
 
+def probe_backend_detail(
+    backend: str, timeout_s: float = 45.0, env: dict | None = None
+) -> tuple[bool, str]:
+    """Probe an arbitrary jax backend (``gpu``/``cuda``, ``tpu``) in a
+    fresh subprocess — the escape hatch for boxes where the accelerator
+    is NOT behind the axon tunnel (tools/tpu_probe.py --backend gpu).
+    Same ``(ok, reason)`` taxonomy as ``probe_tpu_detail`` minus the
+    tunnel-specific buckets; the probe asserts the devices that come up
+    actually belong to the requested platform (a silent CPU fallback
+    must read as a failure, not health)."""
+    backend = {"gpu": "cuda"}.get(backend, backend)
+    env = dict(os.environ) if env is None else dict(env)
+    env["JAX_PLATFORMS"] = backend
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # not probing the tunnel
+    try:
+        r = subprocess.run(  # evglint: disable=seamcheck -- diagnostic probe of the child-interpreter env; the failure IS the reported result
+            [
+                sys.executable, "-c",
+                "import jax; ds = jax.devices(); "
+                "assert ds, 'no devices'; "
+                "print(ds[0].platform)",
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+            env=env,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        ok, reason = False, "timeout"
+    except OSError as exc:
+        ok, reason = False, f"spawn-error: {exc!r}"[:200]
+    else:
+        if r.returncode == 0:
+            ok, reason = True, ""
+        else:
+            tail = (r.stderr or r.stdout or "").strip()
+            tail = tail.replace("\n", " ")[-160:]
+            ok, reason = False, f"backend-error: rc={r.returncode} {tail}"
+    record_probe_metrics(ok, reason)
+    return ok, reason
+
+
 def force_cpu(n_devices: int | None = None) -> None:
     """Pin this process to the CPU backend (optionally with ``n_devices``
     virtual host devices) in a way that works even though sitecustomize

@@ -1,0 +1,280 @@
+"""Seeded stdlib-``random`` property-testing fallback.
+
+The container may not carry ``hypothesis`` (it is an optional test
+extra, pyproject.toml); a missing optional dep must never silently
+skip a property suite — a skipped fuzz test reads as "fuzzed and
+green" in CI. This module mirrors the slice of the hypothesis API the
+repo's property tests and the weather fuzzer actually use, drawing
+examples from ``random.Random`` seeded per test (deterministic across
+runs — a failure reproduces by rerunning the same test), so
+``tests/test_property_fuzz.py`` and ``scenarios/fuzz.py`` run with or
+without the real dependency:
+
+    try:
+        from hypothesis import given, settings, strategies as st
+    except ImportError:
+        from evergreen_tpu.utils.proptest import given, settings
+        from evergreen_tpu.utils import proptest as st
+
+Differences from hypothesis, on purpose: no example database, no
+coverage-guided generation, and failure shrinking is just "report the
+failing example + its index" (rerun reproduces it). The weather
+fuzzer's own delta-debugging shrinker (scenarios/fuzz.py) covers the
+shrinking story where it matters.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import math
+import random
+import string
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+DEFAULT_MAX_EXAMPLES = 100
+
+_PRINTABLE = string.ascii_letters + string.digits + string.punctuation \
+    + " \t\n"
+
+
+class Strategy:
+    """One value generator: ``example(rng)`` draws from a seeded rng."""
+
+    def __init__(self, draw_fn: Callable[[random.Random], Any],
+                 label: str = "strategy") -> None:
+        self._draw = draw_fn
+        self.label = label
+
+    def example(self, rng: Optional[random.Random] = None) -> Any:
+        return self._draw(rng if rng is not None else random.Random())
+
+    def map(self, fn: Callable[[Any], Any]) -> "Strategy":
+        return Strategy(lambda rng: fn(self._draw(rng)),
+                        f"{self.label}.map")
+
+    def filter(self, pred: Callable[[Any], bool],
+               max_tries: int = 100) -> "Strategy":
+        def draw(rng: random.Random) -> Any:
+            for _ in range(max_tries):
+                v = self._draw(rng)
+                if pred(v):
+                    return v
+            raise ValueError(
+                f"{self.label}: filter predicate rejected "
+                f"{max_tries} consecutive draws"
+            )
+
+        return Strategy(draw, f"{self.label}.filter")
+
+    def __repr__(self) -> str:
+        return f"<proptest.{self.label}>"
+
+
+# --------------------------------------------------------------------------- #
+# the strategy vocabulary (hypothesis.strategies subset)
+# --------------------------------------------------------------------------- #
+
+
+def none() -> Strategy:
+    return Strategy(lambda rng: None, "none")
+
+
+def booleans() -> Strategy:
+    return Strategy(lambda rng: rng.random() < 0.5, "booleans")
+
+
+def integers(min_value: Optional[int] = None,
+             max_value: Optional[int] = None) -> Strategy:
+    lo = -(2**31) if min_value is None else int(min_value)
+    hi = 2**31 if max_value is None else int(max_value)
+
+    def draw(rng: random.Random) -> int:
+        # bias toward the boundary values bugs live at
+        r = rng.random()
+        if r < 0.15:
+            return lo
+        if r < 0.3:
+            return hi
+        if r < 0.4 and lo <= 0 <= hi:
+            return 0
+        return rng.randint(lo, hi)
+
+    return Strategy(draw, f"integers({lo},{hi})")
+
+
+def floats(min_value: Optional[float] = None,
+           max_value: Optional[float] = None,
+           allow_nan: bool = True, allow_infinity: bool = True,
+           width: int = 64) -> Strategy:
+    lo = -1e9 if min_value is None else float(min_value)
+    hi = 1e9 if max_value is None else float(max_value)
+    specials: List[float] = [0.0, -0.0, 1.0, -1.0, 0.5, 1e-9]
+    if allow_nan:
+        specials.append(float("nan"))
+    if allow_infinity:
+        specials.extend((float("inf"), float("-inf")))
+
+    def draw(rng: random.Random) -> float:
+        if rng.random() < 0.25:
+            v = rng.choice(specials)
+            if math.isfinite(v) and not (lo <= v <= hi):
+                return rng.uniform(lo, hi)
+            return v
+        v = rng.uniform(lo, hi)
+        if width == 32:
+            import struct
+
+            v = struct.unpack("f", struct.pack("f", v))[0]
+        return v
+
+    return Strategy(draw, "floats")
+
+
+def text(alphabet: str = _PRINTABLE, min_size: int = 0,
+         max_size: int = 32) -> Strategy:
+    chars = alphabet or _PRINTABLE
+
+    def draw(rng: random.Random) -> str:
+        n = rng.randint(min_size, max_size)
+        return "".join(rng.choice(chars) for _ in range(n))
+
+    return Strategy(draw, "text")
+
+
+def sampled_from(elements: Sequence[Any]) -> Strategy:
+    pool = list(elements)
+    if not pool:
+        raise ValueError("sampled_from needs a non-empty sequence")
+    return Strategy(lambda rng: rng.choice(pool), "sampled_from")
+
+
+def one_of(*strategies: Strategy) -> Strategy:
+    pool = list(strategies)
+    return Strategy(
+        lambda rng: rng.choice(pool).example(rng), "one_of"
+    )
+
+
+def lists(elements: Strategy, min_size: int = 0,
+          max_size: int = 8) -> Strategy:
+    def draw(rng: random.Random) -> List[Any]:
+        n = rng.randint(min_size, max_size)
+        return [elements.example(rng) for _ in range(n)]
+
+    return Strategy(draw, "lists")
+
+
+def dictionaries(keys: Strategy, values: Strategy, min_size: int = 0,
+                 max_size: int = 8) -> Strategy:
+    def draw(rng: random.Random) -> Dict[Any, Any]:
+        n = rng.randint(min_size, max_size)
+        out: Dict[Any, Any] = {}
+        tries = 0
+        while len(out) < n and tries < n * 10:
+            out[keys.example(rng)] = values.example(rng)
+            tries += 1
+        return out
+
+    return Strategy(draw, "dictionaries")
+
+
+def fixed_dictionaries(
+    mapping: Dict[Any, Strategy],
+    optional: Optional[Dict[Any, Strategy]] = None,
+) -> Strategy:
+    def draw(rng: random.Random) -> Dict[Any, Any]:
+        out = {k: s.example(rng) for k, s in mapping.items()}
+        for k, s in (optional or {}).items():
+            if rng.random() < 0.5:
+                out[k] = s.example(rng)
+        return out
+
+    return Strategy(draw, "fixed_dictionaries")
+
+
+def just(value: Any) -> Strategy:
+    return Strategy(lambda rng: value, "just")
+
+
+def builds(fn: Callable, *arg_strategies: Strategy,
+           **kw_strategies: Strategy) -> Strategy:
+    def draw(rng: random.Random) -> Any:
+        return fn(
+            *(s.example(rng) for s in arg_strategies),
+            **{k: s.example(rng) for k, s in kw_strategies.items()},
+        )
+
+    return Strategy(draw, f"builds({getattr(fn, '__name__', fn)!r})")
+
+
+def composite(fn: Callable) -> Callable[..., Strategy]:
+    """``@composite`` functions take ``draw`` first, like hypothesis."""
+
+    @functools.wraps(fn)
+    def make(*args: Any, **kwargs: Any) -> Strategy:
+        def draw_value(rng: random.Random) -> Any:
+            return fn(lambda s: s.example(rng), *args, **kwargs)
+
+        return Strategy(draw_value, f"composite({fn.__name__})")
+
+    return make
+
+
+# --------------------------------------------------------------------------- #
+# given / settings (the runner)
+# --------------------------------------------------------------------------- #
+
+
+class settings:  # noqa: N801 — mirrors the hypothesis name
+    """Decorator stacking like hypothesis: ``@settings(...)`` above
+    ``@given(...)``. Only ``max_examples`` is honored; the rest of the
+    knobs are accepted and ignored (deadline has no meaning without a
+    background scheduler)."""
+
+    def __init__(self, max_examples: int = DEFAULT_MAX_EXAMPLES,
+                 **_ignored: Any) -> None:
+        self.max_examples = int(max_examples)
+
+    def __call__(self, fn: Callable) -> Callable:
+        fn._proptest_settings = self  # noqa: SLF001 — own protocol
+        return fn
+
+
+def given(*strategies: Strategy,
+          **kw_strategies: Strategy) -> Callable:
+    """Run the test once per seeded example. The per-test seed stream
+    is derived from the test name, so a red example reproduces on rerun
+    and is reported with its example index."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> None:
+            s = getattr(wrapper, "_proptest_settings", None)
+            n = s.max_examples if s is not None else DEFAULT_MAX_EXAMPLES
+            base = zlib.crc32(fn.__qualname__.encode("utf-8"))
+            for i in range(n):
+                rng = random.Random((base << 24) ^ i)
+                vals = tuple(st.example(rng) for st in strategies)
+                kvals = {
+                    k: st.example(rng)
+                    for k, st in kw_strategies.items()
+                }
+                try:
+                    fn(*args, *vals, **kwargs, **kvals)
+                except Exception as exc:
+                    raise AssertionError(
+                        f"property failed on example {i}/{n} "
+                        f"(seeded fallback; deterministic rerun): "
+                        f"args={vals!r} kwargs={kvals!r}: {exc!r}"
+                    ) from exc
+
+        # the drawn parameters are filled HERE, not by the caller —
+        # pytest must not read them off the wrapped signature and go
+        # hunting for fixtures named after them (hypothesis does the
+        # same surgery)
+        wrapper.__signature__ = inspect.Signature()
+        del wrapper.__wrapped__
+        return wrapper
+
+    return deco

@@ -1,0 +1,234 @@
+"""Property-based weather fuzzing (ISSUE 16): seeded weather generation
+is a pure function of the seed, every shipped weather double-runs
+fingerprint-identical, the delta-debugging shrinker collapses long
+failing timelines to minimal ones without swapping the finding, and the
+sabotage self-test proves the whole loop can find a planted violation.
+
+Fast subset runs in tier-1; the child-process arm and a real campaign
+slice are slow-marked (``make fuzz`` / ``tools/gate.py --fuzz`` runs
+the full sabotage + campaign in CI).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from evergreen_tpu.scenarios import (
+    SCENARIOS,
+    Ev,
+    ScenarioSpec,
+    run_scenario,
+)
+from evergreen_tpu.scenarios.engine import scorecard_entry_fingerprint
+from evergreen_tpu.scenarios import fuzz
+
+# --------------------------------------------------------------------------- #
+# same seed => same weather => same scorecard
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_shipped_weather_double_run_fingerprint_identical(name, store):
+    """Every shipped weather is a deterministic replay: two runs of the
+    same spec produce byte-identical scorecard fingerprints (timing
+    fields are scrubbed by the fingerprint)."""
+    a = run_scenario(SCENARIOS[name]())
+    b = run_scenario(SCENARIOS[name]())
+    assert a["ok"], name
+    assert scorecard_entry_fingerprint(a) == scorecard_entry_fingerprint(b)
+
+
+def test_generate_weather_pure_function_of_seed(store):
+    for seed in (1, 42, fuzz.DEFAULT_CAMPAIGN_SEED):
+        a, b = fuzz.generate_weather(seed), fuzz.generate_weather(seed)
+        assert a.events == b.events
+        assert (a.ticks, a.durable, a.seed) == (b.ticks, b.durable, b.seed)
+    # distinct seeds explore distinct weather (not a constant generator)
+    assert fuzz.generate_weather(1).events != fuzz.generate_weather(2).events
+
+
+def test_generated_weather_runs_green_and_deterministic(store):
+    spec = fuzz.generate_weather(fuzz.DEFAULT_CAMPAIGN_SEED)
+    a, b = fuzz.run_case(spec), fuzz.run_case(spec)
+    assert a["ok"], fuzz.red_keys(a)
+    assert scorecard_entry_fingerprint(a) == scorecard_entry_fingerprint(b)
+
+
+def test_generate_proc_weather_pure_function_of_seed():
+    a = fuzz.generate_proc_weather(7)
+    b = fuzz.generate_proc_weather(7)
+    assert a.events == b.events
+    assert [e.kind for e in a.events][0] == "proc_fleet"
+
+
+# --------------------------------------------------------------------------- #
+# shrinker: long failing timeline -> minimal one, same finding
+# --------------------------------------------------------------------------- #
+
+
+def _long_failing_spec(n_noise: int = 29) -> ScenarioSpec:
+    """One sabotage needle in a haystack of benign task bursts."""
+    from evergreen_tpu.scenarios.library import _sabotage_duplicate_claim
+
+    # the forged duplicate claim needs a busy host AND a free host
+    # alive at the same moment, so the needle fires in a quiet window
+    # (2 running tasks, 6 free hosts) BEFORE the noise burst arrives
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "d0", "provider": "mock", "hosts": 8},
+        ]}),
+        Ev(3, "tasks", {"distro": "d0", "n": 2, "prefix": "busy-"}),
+    ]
+    for i in range(n_noise - 1):
+        events.append(Ev(5 + (i % 7), "tasks", {
+            "distro": "d0", "n": 2, "prefix": f"noise{i}-",
+        }))
+    events.append(Ev(4, "call", {"fn": _sabotage_duplicate_claim}))
+    return ScenarioSpec(
+        name="shrink-haystack",
+        description="29 benign events + 1 planted violation",
+        ticks=16,
+        events=events,
+        # tasks run 3 ticks so a busy host exists when the sabotage
+        # fires (the forged duplicate claim needs one to copy)
+        default_task_ticks=3,
+        tier1=False,
+    )
+
+
+def test_shrinker_collapses_30_events_to_minimal(store):
+    spec = _long_failing_spec()
+    assert len(spec.events) == 31  # fleet + 29 noise + 1 needle
+    entry = fuzz.run_case(spec)
+    assert not entry["ok"]
+    red = fuzz.red_keys(entry)
+
+    minimal = fuzz.shrink_spec(spec, fails=fuzz.fails_matching(red))
+    # the needle plus its pinned fleet — noise gone
+    assert len(minimal.events) <= 5, [e.kind for e in minimal.events]
+    assert any(e.kind == "call" for e in minimal.events)
+    # the minimal timeline still fails for the ORIGINAL reason
+    m = fuzz.run_case(minimal)
+    assert not m["ok"]
+    assert set(red) & set(fuzz.red_keys(m))
+    # and deterministically so
+    m2 = fuzz.run_case(minimal)
+    assert (scorecard_entry_fingerprint(m)
+            == scorecard_entry_fingerprint(m2))
+
+
+def test_shrinker_keeps_green_spec_unchanged(store):
+    """A spec that does not fail shrinks to itself (no predicate ever
+    matches, so nothing is removed)."""
+    spec = fuzz.generate_weather(fuzz.DEFAULT_CAMPAIGN_SEED)
+    entry = fuzz.run_case(spec)
+    assert entry["ok"]
+    minimal = fuzz.shrink_spec(
+        spec, fails=lambda s: not fuzz.run_case(s)["ok"], max_runs=10
+    )
+    assert len(minimal.events) == len(spec.events)
+
+
+def test_shrinker_never_drops_pinned_fleet(store):
+    spec = _long_failing_spec(n_noise=4)
+    minimal = fuzz.shrink_spec(spec)
+    assert minimal.events[0].kind == "fleet"
+    assert minimal.events[0].tick == 0
+
+
+# --------------------------------------------------------------------------- #
+# sabotage self-test: the fuzzer must find a planted violation
+# --------------------------------------------------------------------------- #
+
+
+def test_sabotage_selftest_in_process(store):
+    res = fuzz.sabotage_selftest()
+    assert res["caught"], res
+    assert res["still_caught"], res
+    assert res["deterministic"], res
+    assert res["ok"], res
+    assert res["shrunk_events"] <= 5
+
+
+@pytest.mark.slow
+def test_sabotage_selftest_child_process(store):
+    res = fuzz.sabotage_selftest(proc=True)
+    assert res["caught"], res
+    assert res["deterministic"], res
+    assert res["ok"], res
+
+
+# --------------------------------------------------------------------------- #
+# campaign: time-boxed, seeded, failures emitted as regression specs
+# --------------------------------------------------------------------------- #
+
+
+def test_campaign_time_boxed_and_green(store):
+    report = fuzz.campaign(time_budget_s=5.0, max_cases=4)
+    assert report["ok"], report["failures"]
+    assert 1 <= report["cases"] <= 4
+    assert report["start_seed"] == fuzz.DEFAULT_CAMPAIGN_SEED
+
+
+def test_campaign_emits_shrunk_regression_spec(store, tmp_path,
+                                               monkeypatch):
+    """A campaign that hits a red weather shrinks it and writes a
+    ready-to-check-in spec into emit_dir."""
+    from evergreen_tpu.scenarios.library import _sabotage_duplicate_claim
+
+    real_generate = fuzz.generate_weather
+
+    def rigged(seed, sabotage=False):
+        spec = real_generate(seed, sabotage=sabotage)
+        events = list(spec.events) + [
+            Ev(2, "call", {"fn": _sabotage_duplicate_claim})
+        ]
+        return dataclasses.replace(spec, events=tuple(events))
+
+    monkeypatch.setattr(fuzz, "generate_weather", rigged)
+    report = fuzz.campaign(
+        time_budget_s=30.0, max_cases=1, emit_dir=str(tmp_path)
+    )
+    assert not report["ok"]
+    assert len(report["failures"]) == 1
+    fail = report["failures"][0]
+    assert fail["red"]
+    emitted = list(tmp_path.glob("*.json"))
+    assert len(emitted) == 1
+    # the emitted spec is loadable through the regression corpus loader
+    from evergreen_tpu.scenarios.trace import load_regression_specs
+
+    loaded = load_regression_specs(str(tmp_path))
+    assert len(loaded) == 1
+
+
+def test_red_keys_taxonomy(store):
+    entry = {
+        "ok": False,
+        "invariants": {"store_consistent": {"ok": False, "detail": "x"},
+                       "monotone_epochs": {"ok": True, "detail": ""}},
+        "checks": {"drained": {"ok": False, "detail": "y"}},
+        "slos": {},
+        "error": "RuntimeError('boom')",
+    }
+    assert set(fuzz.red_keys(entry)) == {
+        "store_consistent", "drained", "crashed",
+    }
+    assert fuzz.red_keys({"ok": True, "invariants": {}, "checks": {},
+                          "slos": {}}) == []
+
+
+def test_fails_matching_requires_the_original_finding(store):
+    """The shrink predicate accepts only reductions reproducing the
+    original red keys — a green weather never matches, and a finding
+    that fails differently does not either."""
+    haystack = _long_failing_spec(n_noise=2)
+    red = fuzz.red_keys(fuzz.run_case(haystack))
+    assert red
+    pred = fuzz.fails_matching(red)
+    assert pred(haystack)
+    green = fuzz.generate_weather(fuzz.DEFAULT_CAMPAIGN_SEED)
+    assert not pred(green)
+    # a predicate for an unrelated failure rejects the haystack
+    assert not fuzz.fails_matching(["planning_never_starves"])(haystack)

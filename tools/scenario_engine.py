@@ -72,9 +72,16 @@ def run_suite(
         run_matrix_case,
         run_scenario,
     )
+    from evergreen_tpu.scenarios.trace import load_regression_specs
+
+    # fuzz-found minimal timelines checked in under
+    # scenarios/regressions/ replay alongside the shipped weathers —
+    # once a bug is found and fixed, its timeline stays in the suite
+    suite = dict(SCENARIOS)
+    suite.update(load_regression_specs())
 
     entries: Dict[str, dict] = {}
-    for name, factory in SCENARIOS.items():
+    for name, factory in suite.items():
         if names and name not in names:
             continue
         entry = run_scenario(factory())
@@ -226,14 +233,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     names = [args.scenario] if args.scenario else None
     if names:
         from evergreen_tpu.scenarios import SCENARIOS
+        from evergreen_tpu.scenarios.trace import load_regression_specs
 
-        unknown = [n for n in names if n not in SCENARIOS]
+        known = set(SCENARIOS) | set(load_regression_specs())
+        unknown = [n for n in names if n not in known]
         if unknown:
             # a typo must never read as "scenario passed" (or worse,
             # --write-green an empty baseline that defuses every diff)
             print(
                 f"unknown scenario(s) {unknown}; known: "
-                f"{sorted(SCENARIOS)}", file=sys.stderr,
+                f"{sorted(known)}", file=sys.stderr,
             )
             return 2
     scorecard = run_suite(

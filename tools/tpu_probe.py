@@ -9,6 +9,15 @@ TPU_EVIDENCE.json (via bench.py's own evidence writer).
     python tools/tpu_probe.py                # daemon, probe every 180s
     python tools/tpu_probe.py --once         # single probe, exit 0/1
     python tools/tpu_probe.py --interval 60  # custom cadence
+    python tools/tpu_probe.py --once --backend gpu   # probe CUDA instead
+
+``--once`` prints the failure cause (the bounded taxonomy from
+jaxenv.probe_tpu_detail: cpu-pinned / no-pool-ips / timeout /
+backend-error / spawn-error) plus a cause histogram + failure streak
+over the log tail, so one invocation answers both "is it down" and
+"what has it been dying of". ``--backend gpu`` is the escape hatch for
+boxes whose accelerator is NOT behind the axon tunnel: it probes the
+CUDA backend directly with the same taxonomy.
 
 The service entry point (`cli.py service`) starts this loop in a daemon
 thread so a long-running deployment captures evidence whenever the
@@ -50,12 +59,71 @@ def _probe_env() -> dict:
     return env
 
 
-def probe_once(timeout_s: float = 45.0) -> bool:
-    from evergreen_tpu.utils.jaxenv import probe_tpu_detail
+def probe_once(timeout_s: float = 45.0, backend: str = "axon") -> bool:
+    return probe_once_detail(timeout_s, backend)[0]
 
-    ok, reason = probe_tpu_detail(timeout_s, env=_probe_env())
-    _log({"event": "probe", "ok": ok, "reason": reason})
-    return ok
+
+def probe_once_detail(
+    timeout_s: float = 45.0, backend: str = "axon"
+) -> tuple:
+    from evergreen_tpu.utils.jaxenv import (
+        probe_backend_detail,
+        probe_tpu_detail,
+    )
+
+    if backend == "axon":
+        ok, reason = probe_tpu_detail(timeout_s, env=_probe_env())
+    else:
+        ok, reason = probe_backend_detail(
+            backend, timeout_s, env=_probe_env()
+        )
+    rec = {"event": "probe", "ok": ok, "reason": reason}
+    if backend != "axon":
+        rec["backend"] = backend
+    _log(rec)
+    return ok, reason
+
+
+def probe_log_summary(tail_records: int = 200) -> dict:
+    """Cause histogram + failure streak over the log tail: the
+    cross-run answer to "what has the tunnel been dying of". Same
+    bounded-tail discipline as jaxenv.refresh_probe_metrics_from_log."""
+    from evergreen_tpu.utils.jaxenv import probe_cause
+
+    try:
+        with open(LOG, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - 64 * 1024))
+            lines = fh.read().decode("utf-8", errors="replace").splitlines()
+        if size > 64 * 1024 and lines:
+            lines = lines[1:]  # drop the possibly-torn partial
+    except OSError:
+        return {"attempts": 0, "causes": {}, "failure_streak": 0}
+    records = []
+    for line in lines[-tail_records:]:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("event") == "probe":
+            records.append(rec)
+    causes: dict = {}
+    for rec in records:
+        cause = "ok" if rec.get("ok") else probe_cause(
+            rec.get("reason", "")
+        )
+        causes[cause] = causes.get(cause, 0) + 1
+    streak = 0
+    for rec in reversed(records):
+        if rec.get("ok"):
+            break
+        streak += 1
+    return {
+        "attempts": len(records),
+        "causes": causes,
+        "failure_streak": streak,
+    }
 
 
 def capture_evidence(timeout_s: float = 1800.0) -> bool:
@@ -127,10 +195,21 @@ def daemon_loop(interval_s: float = 180.0) -> None:
 
 
 def main() -> int:
+    backend = "axon"
+    if "--backend" in sys.argv:
+        backend = sys.argv[sys.argv.index("--backend") + 1]
     if "--once" in sys.argv:
-        ok = probe_once()
-        print(f"tpu probe: {'healthy' if ok else 'unreachable'}")
-        if ok and not os.path.exists(EVIDENCE):
+        ok, reason = probe_once_detail(backend=backend)
+        label = backend if backend != "axon" else "tpu"
+        state = "healthy" if ok else f"unreachable ({reason})"
+        print(f"{label} probe: {state}")
+        summary = probe_log_summary()
+        print(
+            f"{label} probe log: {summary['attempts']} attempts, "
+            f"failure streak {summary['failure_streak']}, causes "
+            f"{json.dumps(summary['causes'], sort_keys=True)}"
+        )
+        if ok and backend == "axon" and not os.path.exists(EVIDENCE):
             capture_evidence()
         return 0 if ok else 1
     interval = 180.0
