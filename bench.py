@@ -164,6 +164,9 @@ def main() -> None:
     # --- sharded control plane: N schedulers, one fleet -------------------- #
     sharded_plane = measure_sharded_plane()
 
+    # --- solver-leader plane: one stacked solve serving the fleet ---------- #
+    solver_leader = measure_solver_leader()
+
     from evergreen_tpu.utils.benchgen import bench_result_payload
     from evergreen_tpu.utils.log import counters_snapshot
 
@@ -191,6 +194,7 @@ def main() -> None:
         sharded_plane=sharded_plane,
         capacity=capacity,
         read_path=read_path,
+        solver_leader=solver_leader,
     )
     print(json.dumps(result))
     if _backend == "axon":
@@ -306,6 +310,62 @@ def measure_sharded_plane() -> dict:
         # kill the headline bench run
         print(f"# sharded-plane arm failed: {exc!r}", file=sys.stderr)
         return {"error": repr(exc)[-200:]}
+
+
+def measure_solver_leader() -> dict:
+    """The ``solver_leader_round_ms`` arm (tools/bench_solver_leader.py):
+    a 2-shard process fleet driven with the solver-leader elected
+    (stacked rounds over shared-memory arenas) vs the same fleet
+    solving locally. Acts on the PR-16 probe taxonomy: ``cpu-pinned``
+    and ``no-pool-ips`` mean the axon tunnel can NEVER come up on this
+    box, so instead of recording another identical tunnel failure the
+    arm probes the non-tunnel ``gpu`` escape hatch once and routes the
+    leader's stacked solve there when it answers. Skip with
+    EVERGREEN_TPU_BENCH_SOLVER=0."""
+    if os.environ.get("EVERGREEN_TPU_BENCH_SOLVER", "1") == "0":
+        return {"skipped": True}
+    import subprocess
+
+    from evergreen_tpu.utils.jaxenv import probe_backend_detail, probe_cause
+
+    backend = "cpu"
+    routed = ""
+    terminal = {"cpu-pinned", "no-pool-ips"}
+    causes = {
+        probe_cause(rec.get("reason", ""))
+        for rec in _probe_history if not rec.get("ok")
+    }
+    if _backend != "axon" and causes & terminal:
+        ok, reason = probe_backend_detail("gpu", timeout_s=60.0)
+        if ok:
+            backend = "gpu"
+            routed = "probe-taxonomy: tunnel terminal, gpu answered"
+        else:
+            routed = f"gpu escape hatch probed, no: {reason[:80]}"
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "bench_solver_leader.py"),
+        "--backend", backend,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800,
+        )
+        for line in proc.stderr.splitlines():
+            print(line, file=sys.stderr)
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        if proc.returncode != 0:
+            # the fleet never stacked: the number measured local
+            # rounds under the stacked name — keep it, flagged
+            payload["error"] = "fleet never reached stacked rounds"
+        if routed:
+            payload["routed"] = routed
+        return payload
+    except Exception as exc:  # noqa: BLE001 — the solver-leader arm
+        # must not kill the headline bench run
+        print(f"# solver-leader arm failed: {exc!r}", file=sys.stderr)
+        return {"error": repr(exc)[-200:], "routed": routed}
 
 
 def measure_capacity(store) -> dict:

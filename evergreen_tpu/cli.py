@@ -104,6 +104,9 @@ def _cmd_service_fleet(args) -> int:
         max_handoffs_per_pass=sharding.max_handoffs_per_round,
         orphan_grace_s=sharding.orphan_grace_s,
         supervisor_lease_ttl_s=sharding.supervisor_lease_ttl_s,
+        solver=sharding.solver_leader,
+        solver_lease_ttl_s=sharding.solver_lease_ttl_s,
+        solver_timeout_s=sharding.solver_timeout_s,
     )
     print(
         f"acquiring fleet lease, then adopting/spawning "

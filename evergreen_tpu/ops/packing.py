@@ -117,8 +117,17 @@ class ArenaPool:
     #: hysteresis keeps the live set tiny; this only bounds churn walks)
     MAX_LAYOUTS = 4
 
-    def __init__(self, depth: int = 2) -> None:
+    def __init__(self, depth: int = 2, backing=None) -> None:
         self.depth = depth
+        #: optional buffer-set provider (``allocate(sizes) -> bufs|None``)
+        #: consulted before a fresh heap allocation — the solver-leader
+        #: plane hands the pool views into a cross-process shared-memory
+        #: segment here, so a packed snapshot IS the publication and the
+        #: fleet-round publish needs no extra copy. A backing that cannot
+        #: host ``sizes`` (capacity, or its one set already vended)
+        #: returns None and the pool falls back to the heap; the vended
+        #: set then circulates through the free list like any other.
+        self.backing = backing
         #: layout key → list of free buffer sets
         self._free: Dict[Tuple, List[Dict[str, np.ndarray]]] = {}
         #: layout key → outstanding leases (oldest first)
@@ -143,10 +152,18 @@ class ArenaPool:
             for b in bufs.values():
                 b.fill(0)
         elif len(leased) < self.depth:
-            bufs = {
-                kind: np.zeros(max(total, 1), dtype=_DTYPES[kind])
-                for kind, total in sizes.items()
-            }
+            bufs = (
+                self.backing.allocate(sizes)
+                if self.backing is not None else None
+            )
+            if bufs is None:
+                bufs = {
+                    kind: np.zeros(max(total, 1), dtype=_DTYPES[kind])
+                    for kind, total in sizes.items()
+                }
+            else:
+                for b in bufs.values():
+                    b.fill(0)
         else:
             # every set is still leased: reclaim the oldest (pre-lease
             # behavior) but make the anomaly visible. The victim lease

@@ -199,3 +199,46 @@ _OUT_KEYS = (
     "g_count", "g_expected_dur_s", "g_count_free", "g_count_required",
     "g_over_count", "g_over_dur_s", "g_wait_over", "g_merge",
 )
+
+
+class StackedSolveCache:
+    """Compile-once-per-shard-count cache around ``sharded_solve_fn``.
+
+    Both stacked-solve drivers — the in-process sharded plane
+    (scheduler/sharded_plane.py) and the cross-process solver-leader
+    service (runtime/solver.py) — need the same thing: stack every
+    shard's packed arrays on a leading axis, run ONE shard_map solve
+    over a mesh sized to the participant count, and hand each shard its
+    block back. Keeping the mesh/jit cache here means the two planes
+    cannot drift in how they build the stacked executable."""
+
+    def __init__(self) -> None:
+        self._fn = None
+        self._fn_n = 0
+
+    def solve_blocks(self, blocks: "Dict[int, Dict]") -> "Dict[int, Dict]":
+        """``{shard: arrays}`` in, ``{shard: outputs}`` out (numpy, one
+        block per shard, shards in sorted order on the stack axis). All
+        blocks must share one shape — callers enforce/repair dims
+        agreement themselves."""
+        import jax
+        import numpy as np
+
+        from .mesh import make_mesh
+
+        order = sorted(blocks)
+        if self._fn is None or self._fn_n != len(order):
+            self._fn = sharded_solve_fn(make_mesh(len(order)))
+            self._fn_n = len(order)
+        stacked = {
+            name: np.stack(
+                [np.asarray(blocks[k][name]) for k in order]
+            )
+            for name in _IN_KEYS
+        }
+        out = self._fn(stacked)
+        jax.block_until_ready(out)
+        return {
+            k: {name: np.asarray(v[i]) for name, v in out.items()}
+            for i, k in enumerate(order)
+        }

@@ -52,9 +52,14 @@ def socket_path(data_dir: str, shard: int) -> str:
 
 
 def write_entry(data_dir: str, shard: int, *, pid: int, sock: str,
-                generation: int, epoch: int) -> None:
+                generation: int, epoch: int, shm: str = "",
+                shm_bytes: int = 0) -> None:
     """Atomically record this worker in the manifest (tmp + rename —
-    a reader never observes a torn entry)."""
+    a reader never observes a torn entry). ``shm``/``shm_bytes`` name
+    the worker's solver-leader shared-memory segment (runtime/solver.py)
+    so the leader can attach it and a successor supervisor can reap it
+    if this pid dies — every segment in existence is manifest-registered
+    or about to be."""
     os.makedirs(fleet_dir(data_dir), exist_ok=True)
     path = entry_path(data_dir, shard)
     tmp = f"{path}.{pid}"
@@ -65,6 +70,8 @@ def write_entry(data_dir: str, shard: int, *, pid: int, sock: str,
             "sock": sock,
             "generation": generation,
             "epoch": epoch,
+            "shm": shm,
+            "shm_bytes": shm_bytes,
         }, fh)
     os.replace(tmp, path)  # evglint: disable=fencecheck -- the atomic publish of the manifest entry above; same non-store file, same generation/epoch fencing
 

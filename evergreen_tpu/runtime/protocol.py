@@ -32,7 +32,10 @@ Worker → supervisor ops:
                  restarts a worker that misses its deadline); carries
                  the cumulative ``stale_rejects`` count
   ``round``      one tick's result: duration, task/distro counts,
-                 degraded reason, overload level, epoch
+                 degraded reason, overload level, epoch. When the tick
+                 carried a solver stamp it also reports ``solve``
+                 (stacked / local / skipped) and ``solve_cause`` — how
+                 the shard met the solver-leader plane this round
   ``agent_done`` harness agent step finished: dispatched / unfinished
   ``load``       per-affinity-group schedulable counts + round ms
                  (rebalancing input)
@@ -53,6 +56,16 @@ socket — answered with the adoption ``hello``), plus bench ``go`` and
 the scenario backend's ``arm_fault`` (install a PR-1 fault-plan entry
 at a named seam — the ``proc_kill``/``proc_hang`` events' delivery
 vehicle).
+
+**Solver-leader stamp.** A ``tick`` may carry a ``solver`` object —
+``{epoch, seq, timeout_s, dims?}`` — announcing that the sender also
+holds the solver lease (storage/lease.py ``solver_lease_path``) and
+will serve this round's stacked solve over the worker's shared-memory
+segment (runtime/solver.py). The heavy traffic — packed input arenas
+out, solved column blocks back — never touches this protocol: it rides
+the per-shard shm segment, fenced by the same epoch carried here. No
+stamp (orphan mode, no leader, 1-shard fleet) means the worker solves
+locally, as ever.
 """
 from __future__ import annotations
 

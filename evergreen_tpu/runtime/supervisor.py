@@ -296,6 +296,12 @@ class WorkerHandle:
             except Empty:
                 if not self.alive() or self.generation != gen:
                     return None
+                if self.state == "stopped":
+                    # a crashed supervisor closed this handle mid-wait
+                    # (leader death at a solver seam): no reply can
+                    # arrive on a closed pipe — don't sit out the
+                    # round timeout
+                    return None
                 continue
             if msg["op"] == op and (
                 req is None or msg.get("req") == req
@@ -337,6 +343,9 @@ class FleetSupervisor:
         orphan_tick_s: Optional[float] = None,
         supervisor_lease_ttl_s: float = 5.0,
         adopt: bool = True,
+        solver: str = "never",
+        solver_lease_ttl_s: float = 5.0,
+        solver_timeout_s: float = 10.0,
     ) -> None:
         self.data_dir = data_dir
         self.n_shards = n_shards
@@ -398,6 +407,18 @@ class FleetSupervisor:
         self.fleet_lease = None
         self.deposed = False
         self.crashed = False
+        #: solver-leader plane (runtime/solver.py): "auto" serves one
+        #: stacked solve per round when ≥2 shards and enough devices;
+        #: "never" (the ctor default — the service CLI wires "auto"
+        #: from ShardingConfig.solver_leader) keeps every worker on its
+        #: local solve. The solver lease is SEPARATE from the fleet
+        #: lease — losing it only degrades rounds to local solves,
+        #: never the control plane.
+        self.solver_mode = solver
+        self.solver_lease_ttl_s = solver_lease_ttl_s
+        self.solver_timeout_s = solver_timeout_s
+        self.solver_service = None
+        self.shm_reaped: List[str] = []
         self.adoptions_total = 0
         self.orphaned_total = 0
         self.handles: Dict[int, WorkerHandle] = {
@@ -678,6 +699,8 @@ class FleetSupervisor:
         converges to exactly-one-owner right here).
         ``monitor=True`` starts the background watchdog."""
         self._acquire_fleet_lease()
+        self._reap_shm()
+        self._start_solver()
         for k in range(self.n_shards):
             if self.adopt_enabled and self._try_adopt(k):
                 continue
@@ -690,6 +713,58 @@ class FleetSupervisor:
                 name="fleet-monitor",
             )
             self._monitor.start()
+
+    def _reap_shm(self) -> None:
+        """Shm hygiene on takeover: unlink solver segments whose
+        creating worker died (SIGKILLed fleets cannot clean up after
+        themselves) — live workers' segments are left for adoption."""
+        from .solver import reap_orphan_segments
+
+        try:
+            self.shm_reaped = reap_orphan_segments(
+                self.data_dir, self.n_shards
+            )
+        except OSError:
+            self.shm_reaped = []
+        if self.shm_reaped:
+            self._log.info(
+                "fleet-shm-reaped", segments=len(self.shm_reaped),
+            )
+
+    def _start_solver(self) -> None:
+        """Elect this supervisor the solver-leader when the stacked
+        path is viable. Every failure here is SOFT: the fleet runs,
+        workers solve locally, and a later incarnation may elect."""
+        if self.solver_mode == "never" or self.n_shards < 2:
+            return
+        try:
+            import jax
+
+            if len(jax.devices()) < self.n_shards:
+                self._log.info(
+                    "solver-leader-unavailable", reason="devices",
+                )
+                return
+        except Exception:  # noqa: BLE001 — no backend at all
+            return
+        from .solver import SolverService
+
+        svc = SolverService(
+            self.data_dir, self.n_shards,
+            lease_ttl_s=self.solver_lease_ttl_s,
+            timeout_s=self.solver_timeout_s, supervisor=self,
+        )
+        if not svc.acquire():
+            # a live leader elsewhere holds it: unlike the fleet lease
+            # this is NOT split-brain — we just don't serve solves
+            self._log.info(
+                "solver-leader-unavailable", reason="lease-held",
+            )
+            return
+        self.solver_service = svc
+        self._log.info(
+            "solver-leader-elected", epoch=svc.lease.epoch,
+        )
 
     def wait_all_ready(self, timeout_s: float = 120.0) -> bool:
         """True when every non-crashed worker reached ready. Workers
@@ -813,10 +888,30 @@ class FleetSupervisor:
                     h for h in self.handles.values()
                     if h.state == "ready"
                 ]
+                # solver-leader plane: stamp the round and serve ONE
+                # stacked solve over the workers' shm publications in
+                # a side thread; any shard the serve misses times out
+                # into its local solve — the round never blocks on it
+                stamp = None
+                serve = None
+                svc = self.solver_service
+                if svc is not None and len(ready) >= 2:
+                    stamp = svc.stamp()
+                if stamp is not None:
+                    serve = threading.Thread(
+                        target=svc.serve_round,
+                        args=([h.shard for h in ready],
+                              stamp["seq"], stamp["timeout_s"]),
+                        daemon=True, name="fleet-solver-serve",
+                    )
+                    serve.start()
                 reqs = {}
                 for h in ready:
                     reqs[h.shard] = h.next_req()
-                    h.send(op="tick", now=now, req=reqs[h.shard])
+                    msg = dict(op="tick", now=now, req=reqs[h.shard])
+                    if stamp is not None:
+                        msg["solver"] = stamp
+                    h.send(**msg)
                 results: Dict[int, dict] = {}
                 for h in ready:
                     reply = h.wait_reply(  # evglint: disable=lockgraph -- round serialization is the contract: rebalance/adopt must not interleave mid-round; bounded by round_timeout_s per shard
@@ -828,6 +923,11 @@ class FleetSupervisor:
                     results[h.shard] = reply
                     h.last_round = reply
                     h.level = str(reply.get("level", "green"))
+                if serve is not None:
+                    # replies are in, so the serve is done or doomed;
+                    # join so rounds stay strictly serialized (two
+                    # serve threads on one segment set would race)
+                    serve.join(timeout=self.round_timeout_s)
             self.rounds_done += 1
             outcome = (
                 "full" if len(results) == self.n_shards
@@ -1071,6 +1171,12 @@ class FleetSupervisor:
             # only the renewer thread stops — the file stays, goes
             # stale after its TTL, and is stolen by the successor
             self.fleet_lease.stop_renewing()
+        if self.solver_service is not None:
+            # same discipline for the solver lease: abandoned, never
+            # released — the successor leader must STEAL it at a
+            # strictly higher epoch, and until then affected workers
+            # degrade to local solves within the round
+            self.solver_service.detach()
         for h in self.handles.values():
             h.state = "stopped"
             if h.proc is not None:
@@ -1090,6 +1196,9 @@ class FleetSupervisor:
         supervisor instead detaches: the workers belong to its
         successor, so it closes its channels and leaves them running."""
         self._stop.set()
+        if self.solver_service is not None:
+            self.solver_service.stop()
+            self.solver_service = None
         if self.deposed:
             for h in self.handles.values():
                 h.state = "stopped"
@@ -1186,6 +1295,15 @@ class FleetSupervisor:
             "deposed": self.deposed,
             "adoptions_total": self.adoptions_total,
             "orphaned_total": self.orphaned_total,
+            "solver_epoch": (
+                self.solver_service.epoch
+                if self.solver_service is not None else 0
+            ),
+            "solver_rounds": (
+                dict(self.solver_service.round_outcomes)
+                if self.solver_service is not None else {}
+            ),
+            "shm_reaped": len(self.shm_reaped),
         }
 
 

@@ -210,6 +210,12 @@ class ShardWorker:
         #: on every reply so the supervisor can pair answers with
         #: requests across timeouts and respawns
         self._req = None
+        #: solver-leader plane (runtime/solver.py): created lazily at
+        #: the first tick command carrying a solver stamp; None until
+        #: then, and never in orphan mode — local solves need no leader
+        self.solver = None
+        self._shm_name = ""
+        self._shm_bytes = 0
 
     # -- lifecycle -------------------------------------------------------- #
 
@@ -340,9 +346,16 @@ class ShardWorker:
             self.args.data_dir, self.shard, pid=os.getpid(),
             sock=self.sock_path, generation=self.args.generation,
             epoch=self.lease.epoch if self.lease else 0,
+            shm=self._shm_name, shm_bytes=self._shm_bytes,
         )
 
     def _cleanup_manifest(self) -> None:
+        if self.solver is not None:
+            # every exit path unlinks this shard's solver segment: a
+            # successor worker recreates it, and anything we leak here
+            # is caught by the supervisor's reap_orphan_segments
+            self.solver.close(unlink=True)
+            self.solver = None
         if self.listener is not None:
             try:
                 self.listener.close()
@@ -479,6 +492,51 @@ class ShardWorker:
             async_persist=True,
         )
 
+    def _solver_options(self, opts, sol: dict):
+        """Wire this round's solver-leader stamp (runtime/solver.py)
+        into the tick: the leader's cross-process solve_fn plus its
+        common-dims floor, so every shard publishes at the same padded
+        shape and ONE stacked solve serves the round. A failing or
+        absent leader degrades exactly like a failing device solve —
+        the solve_fn itself falls back to the local run_solve_packed."""
+        import dataclasses
+
+        from .solver import SolverClient
+
+        if self.solver is None:
+            self.solver = SolverClient(
+                self.args.data_dir, self.shard,
+                on_segment_change=self._on_shm_change,
+            )
+            # zero-copy publish: snapshot arenas vend straight out of
+            # the shared segment, so packing IS publishing
+            from ..scheduler.wrapper import _snapshot_memos_for
+
+            _, _, pool = _snapshot_memos_for(self.store)
+            pool.backing = self.solver.arena_backing()
+        dims = sol.get("dims")
+        force = (
+            {k: int(v) for k, v in dims.items()}
+            if dims else opts.force_dims
+        )
+        # "skipped" survives when the tick never reaches the solve at
+        # all (nothing to schedule); the closure overwrites it on call
+        self.solver.last_solve = "skipped"
+        self.solver.last_cause = ""
+        return dataclasses.replace(
+            opts,
+            solve_fn=self.solver.solve_fn(
+                int(sol.get("epoch", 0)), int(sol.get("seq", 0)),
+                float(sol.get("timeout_s", 10.0)),
+            ),
+            force_dims=force,
+        )
+
+    def _on_shm_change(self, name: str, nbytes: int) -> None:
+        self._shm_name = name
+        self._shm_bytes = nbytes
+        self._write_manifest()
+
     # -- ops -------------------------------------------------------------- #
 
     def op_tick(self, msg: dict) -> None:
@@ -490,19 +548,28 @@ class ShardWorker:
             return
         now = float(msg.get("now") or _time.time())
         self.last_now = now
+        opts = self.tick_options()
+        sol = msg.get("solver")
+        if sol and self.args.data_dir:
+            opts = self._solver_options(opts, sol)
         t0 = _time.perf_counter()
-        res = run_tick(self.store, self.tick_options(), now=now)
+        res = run_tick(self.store, opts, now=now)
         ms = (_time.perf_counter() - t0) * 1e3
         self.last_round_ms = ms
         if res.degraded == "fenced" or self.lease.lost:
             self._fenced_exit("fenced-tick")
-        self.send(
+        reply = dict(
             op="round", shard=self.shard, tick=self.tick_index,
             ms=round(ms, 3), n_tasks=res.n_tasks,
             n_distros=res.n_distros, degraded=res.degraded,
             level=res.overload, epoch=self.lease.epoch,
             queued=sum(res.queues.values()),
         )
+        if sol and self.solver is not None:
+            reply["solve"] = self.solver.last_solve
+            reply["solve_cause"] = self.solver.last_cause
+            reply["solve_stale_accepted"] = self.solver.stale_accepted
+        self.send(**reply)
         self.tick_index += 1
 
     def op_agent_sim(self, msg: dict) -> None:

@@ -240,7 +240,12 @@ def generate_proc_weather(seed: int,
     events: List[Ev] = [Ev(0, "proc_fleet", workload)]
     ticks = 12
     if not sabotage:
-        storm = rng.choice(("kill", "hang", "sup", "none"))
+        storms = ["kill", "hang", "sup", "none"]
+        if shards == 2:
+            # solver-leader storms need a real 2-shard fleet: a
+            # 1-shard round never elects (stacking one shard is local)
+            storms.append("leader")
+        storm = rng.choice(tuple(storms))
         if storm == "kill":
             events.append(Ev(rng.randint(1, 3), "proc_kill", {
                 "worker": rng.randrange(shards),
@@ -255,6 +260,28 @@ def generate_proc_weather(seed: int,
             t = rng.randint(1, 3)
             events.append(Ev(t, "sup_kill", {"at": at}))
             events.append(Ev(t + 1, "sup_restart", {}))
+            ticks = 14
+        elif storm == "leader":
+            # events[0] holds THIS dict: the solver plane opt-in and
+            # the both-shards load floor (the hash topology can land 2
+            # distros on one shard, starving the stack) ride along
+            workload["distros"] = 6
+            workload["tasks"] = 36
+            workload["solver"] = "auto"
+            workload["solver_timeout_s"] = 6.0
+            t = rng.randint(1, 3)
+            if rng.random() < 0.5:
+                events.append(Ev(t, "leader_kill", {
+                    "seam": rng.choice((
+                        "solver.round", "solver.publish",
+                        "solver.solve", "solver.return",
+                    )),
+                }))
+                events.append(Ev(t + 1, "sup_restart", {}))
+            else:
+                events.append(Ev(t, "leader_hang", {
+                    "seam": "solver.solve", "delay_s": 8.0,
+                }))
             ticks = 14
     return ScenarioSpec(
         name=(f"fuzz-proc-sabotage-{seed}" if sabotage

@@ -72,8 +72,14 @@ from .spec import Ev, SLO, ScenarioSpec, scorecard_entry_fingerprint
 
 #: event kinds the proc backend handles (anything else in a proc spec
 #: is a spec error — in-process events cannot reach a child's store)
+#: ``leader_kill`` / ``leader_hang`` target the SOLVER LEADER
+#: (runtime/solver.py SolverService, living inside the supervisor):
+#: a fault armed at a named solver seam in the harness process —
+#: ``call``-crash for kill, ``hang`` for a stall — so leader death
+#: lands exactly at the publish/solve/return seams of a live round
 PROC_EVENT_KINDS = ("proc_fleet", "proc_kill", "proc_hang",
-                    "proc_migrate", "sup_kill", "sup_restart")
+                    "proc_migrate", "sup_kill", "sup_restart",
+                    "leader_kill", "leader_hang")
 
 #: the proc analog of spec.DEFAULT_INVARIANTS
 DEFAULT_PROC_INVARIANTS = (
@@ -213,6 +219,10 @@ class ProcScenarioRun:
         #: previous supervisor incarnations (sup_kill/sup_restart):
         #: scoring aggregates restarts/exits/epochs across ALL of them
         self.sups: List = []
+        #: True once a leader_kill/leader_hang installed a fault plan
+        #: (process-global — execute()'s finally restores the previous)
+        self._armed_faults = False
+        self._prev_faults = None
         self.data_dir: Optional[str] = None
         self.rounds: List[Dict[int, dict]] = []
         self.dispatched_total = 0
@@ -271,6 +281,42 @@ class ProcScenarioRun:
                 self.sup.simulate_crash()
         elif ev.kind == "sup_restart":
             self._restart_supervisor()
+        elif ev.kind == "leader_kill":
+            # crash the supervisor AT a solver seam of the NEXT round's
+            # serve: the fault plan is process-global (the SolverService
+            # runs in this harness process), installed fresh so index 0
+            # is the next fire of the seam; execute() restores the
+            # previous plan in its finally
+            from ..utils import faults
+
+            sup = self.sup
+            if sup.solver_service is None:
+                # never elected (device-starved host, lease held
+                # elsewhere): no solver seam will ever fire — degrade
+                # to a plain supervisor kill so the scheduled
+                # sup_restart still has a corpse to replace
+                sup.simulate_crash()
+                return
+            seam = ev.args.get("seam", "solver.round")
+            plan = faults.FaultPlan().at(
+                seam, int(ev.args.get("index", 0)),
+                faults.Fault("call", fn=sup.simulate_crash),
+            )
+            faults.install(plan)
+            self._armed_faults = True
+        elif ev.kind == "leader_hang":
+            from ..utils import faults
+
+            seam = ev.args.get("seam", "solver.solve")
+            plan = faults.FaultPlan().at(
+                seam, int(ev.args.get("index", 0)),
+                faults.Fault(
+                    "hang",
+                    delay_s=float(ev.args.get("delay_s", 8.0)),
+                ),
+            )
+            faults.install(plan)
+            self._armed_faults = True
 
     def _release_then_crash(self, now: float) -> None:
         """Drive the RELEASE leg of a real migration, then crash the
@@ -339,6 +385,23 @@ class ProcScenarioRun:
             1 for k in adopted
             if sup2.handles[k].adopt_hello.get("orphaned")
         ))
+        # solver-leader re-election: the successor must STEAL
+        # solver.lease at a strictly higher epoch than the incarnation
+        # it replaced (the dead leader abandoned, never released)
+        old_sep = (
+            old.solver_service.lease.epoch
+            if old.solver_service is not None else 0
+        )
+        new_sep = (
+            sup2.solver_service.lease.epoch
+            if sup2.solver_service is not None else 0
+        )
+        if old_sep or new_sep:
+            bump("solver_reelections", 1 if new_sep > old_sep else 0)
+            self.stats["solver_epoch_prev"] = max(
+                self.stats.get("solver_epoch_prev", 0), old_sep
+            )
+            self.stats["solver_epoch_last"] = new_sep
 
     # -- the replay loop -------------------------------------------------- #
 
@@ -370,6 +433,14 @@ class ProcScenarioRun:
             ),
             orphan_tick_s=1.0,
             supervisor_lease_ttl_s=1.0,
+            # solver-leader plane: the workload opts in ("auto"); tight
+            # TTL/timeout so leader death degrades and re-elects inside
+            # the harness's tick cadence
+            solver=self.workload.get("solver", "never"),
+            solver_lease_ttl_s=1.0,
+            solver_timeout_s=float(
+                self.workload.get("solver_timeout_s", 6.0)
+            ),
         )
 
     def _events_by_tick(self) -> Dict[int, List[Ev]]:
@@ -396,7 +467,10 @@ class ProcScenarioRun:
             _time.sleep(0.05)
 
     def execute(self) -> Dict:
+        from ..utils import faults
+
         t0 = _time.perf_counter()
+        self._prev_faults = faults.active()
         self.data_dir = tempfile.mkdtemp(
             prefix=f"proc-{self.spec.name}-"
         )
@@ -426,6 +500,13 @@ class ProcScenarioRun:
             self.stats["supervisor_epoch"] = self.sup.sup_epoch
             self.sup.drain()
         finally:
+            if self._armed_faults:
+                # the leader fault plan is process-global: restore
+                # whatever was installed before this replay
+                if self._prev_faults is not None:
+                    faults.install(self._prev_faults)
+                else:
+                    faults.uninstall()
             self.sup.stop(graceful=True)
             # crashed incarnations still hold the Popen objects for
             # workers the successor adopted: reap the zombies (the
@@ -466,7 +547,8 @@ class ProcScenarioRun:
 
     def _has_faults(self) -> bool:
         return any(
-            e.kind in ("proc_kill", "proc_hang", "sup_kill")
+            e.kind in ("proc_kill", "proc_hang", "sup_kill",
+                       "leader_kill", "leader_hang")
             for e in self.spec.events
         )
 
@@ -497,6 +579,30 @@ class ProcScenarioRun:
             # sup_kill/sup_restart weather's restarts, exits and
             # handoffs are spread over self.sups + the final one
             all_sups = [*self.sups, self.sup]
+            solver_stacked = 0
+            solver_local = 0
+            stale_by_shard: Dict[int, int] = {}
+            for rnd in self.rounds:
+                for shard, reply in rnd.items():
+                    sol = reply.get("solve")
+                    if sol == "stacked":
+                        solver_stacked += 1
+                    elif sol == "local":
+                        solver_local += 1
+                    # cumulative per-worker counter: the per-round max
+                    # is the lifetime total, summing rounds would
+                    # double-count
+                    stale_by_shard[shard] = max(
+                        stale_by_shard.get(shard, 0),
+                        int(reply.get("solve_stale_accepted", 0)),
+                    )
+            if solver_stacked or solver_local or stale_by_shard:
+                self.stats["solver_stacked_replies"] = solver_stacked
+                self.stats["solver_local_replies"] = solver_local
+                self.stats["solver_stale_accepted"] = sum(
+                    stale_by_shard.values()
+                )
+                self.stats["shm_leaked"] = self._count_leaked_segments()
             self.stats = {
                 "ticks": len(self.rounds),
                 "converged_at": self.converged_at,
@@ -579,9 +685,38 @@ class ProcScenarioRun:
                 except Exception:  # noqa: BLE001 — inspection handles  # evglint: disable=shedcheck -- post-run inspection handles on a dead fleet's stores
                     pass
 
+    def _count_leaked_segments(self) -> int:
+        """Solver shm segments still attachable after the fleet stopped.
+
+        Clean exits unlink their segment; a leaked one means a worker
+        (or a crashed leader's reap pass) skipped hygiene — scenarios
+        gate on this being zero."""
+        from ..runtime.solver import Segment, segment_name
+
+        leaked = 0
+        for shard in range(self.n_shards):
+            seg = Segment.attach(segment_name(self.data_dir, shard))
+            if seg is not None:
+                leaked += 1
+                seg.close()
+        return leaked
+
     def _teardown(self) -> None:
         import shutil
 
+        from ..runtime.solver import Segment, segment_name
+
+        # leaked solver segments live in /dev/shm, not the data dir:
+        # rmtree won't reach them, so force-unlink before the run's
+        # evidence disappears (leak already counted by _score)
+        if self.data_dir is not None:
+            for shard in range(self.n_shards):
+                seg = Segment.attach(
+                    segment_name(self.data_dir, shard)
+                )
+                if seg is not None:
+                    seg.unlink()
+                    seg.close()
         # trace capture reads the per-shard WAL segments after the run:
         # leave the data dir on disk for the caller to harvest (and
         # remove)
@@ -698,7 +833,8 @@ def _reference_canonical(spec: ScenarioSpec,
         events=[
             e for e in spec.events
             if e.kind not in ("proc_kill", "proc_hang",
-                              "sup_kill", "sup_restart")
+                              "sup_kill", "sup_restart",
+                              "leader_kill", "leader_hang")
         ],
         checks=[],
         slos=[],
@@ -918,11 +1054,159 @@ def _sup_kill_midhandoff_spec(seed: int = 0) -> ScenarioSpec:
     )
 
 
+#: the solver-leader fleets need load on BOTH shards (the topology
+#: hash-partitions distros; 2 distros can land on one shard, leaving
+#: the other with nothing to publish and the leader declining the
+#: single publication as partial) — 6 distros spreads reliably
+_SOLVER_WORKLOAD = {
+    "shards": 2, "distros": 6, "tasks": 36, "seed": 7,
+    "hosts_per_distro": 3, "solver": "auto", "solver_timeout_s": 6.0,
+}
+
+
+def _check_solver_survived(run: "ProcScenarioRun") -> Optional[str]:
+    """Shared acceptance for every leader-death weather: the fleet
+    degraded to local (never corrupted), the successor re-elected at a
+    higher epoch, and stacked rounds RESUMED after the restart."""
+    st = run.stats
+    if st.get("sup_restarts", 0) < 1:
+        return "the supervisor never restarted"
+    if st.get("solver_stacked_replies", 0) < 2:
+        return (
+            "fleet never produced a stacked round, got "
+            f"{st.get('solver_stacked_replies', 0)} stacked replies"
+        )
+    if st.get("solver_reelections", 0) < 1:
+        return "the successor never re-elected a solver leader"
+    if st.get("solver_stale_accepted", 0):
+        return (
+            "a worker accepted a stale leader's result: "
+            f"{st['solver_stale_accepted']}"
+        )
+    if st.get("shm_leaked", 0):
+        return f"{st['shm_leaked']} solver shm segment(s) leaked"
+    for i, rnd in enumerate(run.rounds):
+        if i <= 3:
+            continue  # pre-restart rounds don't prove recovery
+        stacked = sum(
+            1 for r in rnd.values() if r.get("solve") == "stacked"
+        )
+        if stacked >= 2:
+            return None
+    return "no fully stacked round after the supervisor restart"
+
+
+def _leader_kill_spec(seam: str, slug: str,
+                      seed: int = 0) -> ScenarioSpec:
+    """Leader SIGKILL-shaped death at one solver seam on a 2-shard
+    durable fleet: workers must degrade to local within the round
+    (fenced at the shm header, never a torn fleet solve), orphan, get
+    adopted by the successor, and return to stacked rounds under the
+    successor's strictly-higher solver epoch."""
+    return ScenarioSpec(
+        name=f"proc-leader-kill-{slug}",
+        description=f"2-shard solver fleet: leader dies at {seam}; "
+                    "workers degrade to local, successor re-elects "
+                    "and stacked rounds resume",
+        ticks=14,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", dict(_SOLVER_WORKLOAD)),
+            Ev(2, "leader_kill", {"seam": seam}),
+            Ev(3, "sup_restart", {}),
+        ],
+        slos=[
+            SLO("no-worker-restarts", "restarts_total", "<=", 0),
+        ],
+        checks=[("solver-survived", _check_solver_survived)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
+def _leader_kill_publish_spec(seed: int = 0) -> ScenarioSpec:
+    return _leader_kill_spec("solver.publish", "publish", seed)
+
+
+def _leader_kill_solve_spec(seed: int = 0) -> ScenarioSpec:
+    return _leader_kill_spec("solver.solve", "solve", seed)
+
+
+def _leader_kill_return_spec(seed: int = 0) -> ScenarioSpec:
+    """The nastiest point: the leader dies AFTER writing the first
+    shard's result — one shard got a solved column, the other must
+    fence at out_seq and degrade local, and resume ≡ rerun still
+    holds (stacked and local solves are bit-identical)."""
+    return _leader_kill_spec("solver.return", "return", seed)
+
+
+def _leader_kill_midround_spec(seed: int = 0) -> ScenarioSpec:
+    return _leader_kill_spec("solver.round", "midround", seed)
+
+
+def _leader_hang_spec(seed: int = 0) -> ScenarioSpec:
+    """The leader stalls INSIDE the stacked solve, past the workers'
+    solver timeout: both degrade to local that round; when the stalled
+    solve finally lands its out_seq is from a finished round, so
+    nobody accepts it, and the next round goes stacked again — no
+    restart, no re-election, same leader."""
+
+    def hang_degraded(run: ProcScenarioRun) -> Optional[str]:
+        st = run.stats
+        if st.get("solver_local_replies", 0) < 1:
+            return "no round ever degraded to local solve"
+        if st.get("solver_stale_accepted", 0):
+            return (
+                "a worker accepted the stalled leader's late result: "
+                f"{st['solver_stale_accepted']}"
+            )
+        if st.get("shm_leaked", 0):
+            return f"{st['shm_leaked']} solver shm segment(s) leaked"
+        saw_local = False
+        for rnd in run.rounds:
+            solves = [r.get("solve") for r in rnd.values()]
+            if "local" in solves:
+                saw_local = True
+            elif saw_local and solves.count("stacked") >= 2:
+                return None
+        return "no stacked round after the timeout-degraded one"
+
+    return ScenarioSpec(
+        name="proc-leader-hang",
+        description="2-shard solver fleet: leader stalls inside the "
+                    "stacked solve past the worker timeout; that "
+                    "round degrades to local, the late result is "
+                    "fenced at out_seq, stacked rounds resume",
+        ticks=14,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", dict(_SOLVER_WORKLOAD)),
+            Ev(2, "leader_hang",
+               {"seam": "solver.solve", "delay_s": 8.0}),
+        ],
+        slos=[
+            SLO("no-worker-restarts", "restarts_total", "<=", 0),
+        ],
+        checks=[("hang-degraded", hang_degraded)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
 PROC_SCENARIOS: Dict[str, callable] = {
     "proc-fleet-sigkill": _proc_sigkill_spec,
     "proc-fleet-hang": _proc_hang_spec,
     "proc-sup-kill-midround": _sup_kill_midround_spec,
     "proc-sup-kill-midhandoff": _sup_kill_midhandoff_spec,
+    "proc-leader-kill-publish": _leader_kill_publish_spec,
+    "proc-leader-kill-solve": _leader_kill_solve_spec,
+    "proc-leader-kill-return": _leader_kill_return_spec,
+    "proc-leader-kill-midround": _leader_kill_midround_spec,
+    "proc-leader-hang": _leader_hang_spec,
 }
 
 #: the supervisor-crash subset (tools/crash_matrix.py run_sup_points
@@ -930,6 +1214,15 @@ PROC_SCENARIOS: Dict[str, callable] = {
 #: PROC_SCENARIOS weather including them)
 SUP_KILL_SCENARIOS = ("proc-sup-kill-midround",
                       "proc-sup-kill-midhandoff")
+
+#: the solver-leader death subset (tools/crash_matrix.py
+#: run_solver_points runs these; gate --fleet-runtime gets them via
+#: PROC_SCENARIOS like every other weather)
+SOLVER_SCENARIOS = ("proc-leader-kill-publish",
+                    "proc-leader-kill-solve",
+                    "proc-leader-kill-return",
+                    "proc-leader-kill-midround",
+                    "proc-leader-hang")
 
 
 # --------------------------------------------------------------------------- #

@@ -238,6 +238,12 @@ class ResidentPlane:
         #: dtype kind, recorded by every mutator when the mirror is on
         self._mirror = None
         self._spans: Optional[Dict[str, List[Tuple[int, int]]]] = None
+        #: optional cross-process publication sink (the solver-leader
+        #: plane's shm segment, runtime/solver.py ShmResidentSink):
+        #: dirty spans sync straight into the fleet leader's input
+        #: regions, so an unchanged fleet round uploads coalesced spans
+        #: instead of repacking — same span stream the mirror uses
+        self._shm_sink = None
         if os.environ.get("EVERGREEN_TPU_RESIDENT_DEVICE") == "1":
             from ..ops.resident_ops import DeviceMirror
 
@@ -246,6 +252,21 @@ class ResidentPlane:
     # ------------------------------------------------------------------ #
     # public surface
     # ------------------------------------------------------------------ #
+
+    def _tracks_spans(self) -> bool:
+        return self._mirror is not None or self._shm_sink is not None
+
+    def attach_shm_sink(self, sink) -> None:
+        """Publish through ``sink`` (``sync(truth_buffers, spans) ->
+        bufs | None``) from the next tick on; None from the sink falls
+        back to the classic arena copy for that tick."""
+        self._shm_sink = sink
+        self._spans = None  # first sink publish is a full sync
+
+    def detach_shm_sink(self) -> None:
+        self._shm_sink = None
+        if self._mirror is None:
+            self._spans = None
 
     def invalidate(self, reason: str) -> None:
         """Drop the resident columns; the next sync full-rebuilds. Called
@@ -272,6 +293,10 @@ class ResidentPlane:
             out["mirror_delta_rows"] = self._mirror.delta_rows
             out["mirror_slice_rows"] = self._mirror.slice_rows
             out["mirror_full_uploads"] = self._mirror.full_uploads
+        if self._shm_sink is not None:
+            out["shm_full_syncs"] = self._shm_sink.full_syncs
+            out["shm_span_syncs"] = self._shm_sink.span_syncs
+            out["shm_bytes_synced"] = self._shm_sink.bytes_synced
         return out
 
     def sync(
@@ -563,7 +588,7 @@ class ResidentPlane:
         self.prime_gen = prime_gen
         self._ready = True
         self._pending_reason = ""
-        if self._mirror is not None:
+        if self._tracks_spans():
             self._spans = None  # full upload this tick
         get_logger("scheduler").info(
             "resident-rebuild", reason=reason, n_tasks=self.n_valid,
@@ -841,7 +866,7 @@ class ResidentPlane:
         pack_distro_settings(self._bool_view_cols(), solver_distros)
 
         self.n_valid = sum(len(s.tasks) for s in new_slabs)
-        if self._mirror is not None:
+        if self._tracks_spans():
             self._spans = None  # layout changed: full upload this tick
         return True
 
@@ -860,7 +885,7 @@ class ResidentPlane:
         dm_dirty: Set[str],
         hosts_dirty: Set[str],
     ) -> None:
-        if self._mirror is not None and self._spans is None:
+        if self._tracks_spans() and self._spans is None:
             self._spans = {}
         for di, d in enumerate(solver_distros):
             s = self._slabs[di]
@@ -1730,11 +1755,22 @@ class ResidentPlane:
         hand the device mirror the dirty spans when it is enabled."""
         from ..utils.tracing import Tracer
 
+        arena = None
         if self._mirror is not None:
             dev_bufs = self._mirror.sync(self._truth.buffers, self._spans)
             self._spans = {}
             arena = _MirrorArena(self._truth, dev_bufs)
-        else:
+        elif self._shm_sink is not None:
+            # cross-process publication: dirty spans sync into the
+            # solver-leader segment and the segment views ARE the
+            # snapshot buffers (zero-copy publish at the solve)
+            shm_bufs = self._shm_sink.sync(
+                self._truth.buffers, self._spans
+            )
+            if shm_bufs is not None:
+                self._spans = {}
+                arena = _MirrorArena(self._truth, shm_bufs)
+        if arena is None:
             with Tracer(self.store, "resident").span("arena_lease"):
                 arena = arena_for_dims(self.dims, arena_pool)
             for kind, buf in arena.buffers.items():

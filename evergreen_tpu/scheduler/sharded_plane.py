@@ -476,8 +476,9 @@ class ShardedScheduler:
         self._dispatchers: Dict[int, object] = {}
         #: host id → owning shard (invalidated on migration)
         self._host_shard: Dict[str, int] = {}
-        self._stacked_fn = None
-        self._stacked_fn_n = 0
+        from ..parallel.sharded import StackedSolveCache
+
+        self._stacked_cache = StackedSolveCache()
         #: the stacked round's common padded dims (a FLOOR forced into
         #: every shard's build via TickOptions.force_dims); updated on
         #: observed drift so the round after a growth spurt stacks again
@@ -823,12 +824,6 @@ class ShardedScheduler:
         shard_map solve, and hand each shard its block. Raises on shape
         drift — the caller downgrades the round to local solves and
         re-seeds the common dims so the next round stacks."""
-        import jax
-        import numpy as np
-
-        from ..parallel.sharded import _IN_KEYS, sharded_solve_fn
-        from ..parallel.mesh import make_mesh
-
         order = sorted(snaps)
         keys = {k: snaps[k].shape_key() for k in order}
         if len(set(keys.values())) > 1:
@@ -850,23 +845,9 @@ class ShardedScheduler:
                 name: keys[order[0]][i] for i, name in enumerate(names)
             }
             self._floor_rounds = 0
-        if self._stacked_fn is None or self._stacked_fn_n != len(order):
-            self._stacked_fn = sharded_solve_fn(
-                make_mesh(len(order))
-            )
-            self._stacked_fn_n = len(order)
-        stacked = {
-            name: np.stack(
-                [np.asarray(snaps[k].arrays[name]) for k in order]
-            )
-            for name in _IN_KEYS
-        }
-        out = self._stacked_fn(stacked)
-        jax.block_until_ready(out)
-        return {
-            k: {name: np.asarray(v[i]) for name, v in out.items()}
-            for i, k in enumerate(order)
-        }
+        return self._stacked_cache.solve_blocks(
+            {k: snaps[k].arrays for k in order}
+        )
 
     # -- fleet overload --------------------------------------------------- #
 

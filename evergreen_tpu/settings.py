@@ -196,6 +196,19 @@ class ShardingConfig(ConfigSection):
     #: latency after a supervisor death (the successor steals the
     #: fencing epoch only once the lease goes stale)
     supervisor_lease_ttl_s: float = 5.0
+    #: solver-leader plane (runtime/solver.py): "auto" serves one
+    #: stacked shard_map solve per fleet round over cross-process
+    #: shared-memory arenas when the backend has >= n_shards devices;
+    #: "never" keeps every worker on its local solve
+    solver_leader: str = "auto"
+    #: solver lease TTL — worst-case window a dead leader's rounds
+    #: degrade to local solves before a successor steals the lease at
+    #: a strictly higher epoch (independent of the supervisor lease:
+    #: data plane and control plane re-elect separately)
+    solver_lease_ttl_s: float = 5.0
+    #: per-round worker wait on the leader's solved block before the
+    #: local-solve fallback (see docs/DEPLOY.md "Solver-leader sizing")
+    solver_timeout_s: float = 10.0
 
     def validate_and_default(self) -> str:
         if self.n_shards < 1:
@@ -227,6 +240,12 @@ class ShardingConfig(ConfigSection):
             return "orphan_grace_s cannot be negative"
         if self.supervisor_lease_ttl_s <= 0:
             return "supervisor_lease_ttl_s must be > 0"
+        if self.solver_leader not in ("auto", "never"):
+            return "solver_leader must be auto/never"
+        if self.solver_lease_ttl_s <= 0:
+            return "solver_lease_ttl_s must be > 0"
+        if self.solver_timeout_s <= 0:
+            return "solver_timeout_s must be > 0"
         return ""
 
 

@@ -84,6 +84,20 @@ def supervisor_lease_path(data_dir: str) -> str:
     return os.path.join(data_dir, "supervisor.lease")
 
 
+def solver_lease_path(data_dir: str) -> str:
+    """Lease-file path for the SOLVER-LEADER scope (runtime/solver.py).
+
+    Exactly one process per fleet may own the device mesh and run the
+    stacked one-``shard_map``-solve-per-round service; its epoch stamps
+    every shared-memory publication and every returned column block, so
+    a deposed leader's writes fence at the shm header exactly like a
+    deposed supervisor's commands fence at ``stale_sup``. Separate from
+    the supervisor lease on purpose: supervisor re-election (control
+    plane) and solver re-election (data plane) are independent failure
+    domains, each with its own epoch sequence."""
+    return os.path.join(data_dir, "solver.lease")
+
+
 class FileLease:
     #: bounded verify-after-rename attempts in the steal path
     _STEAL_ATTEMPTS = 5

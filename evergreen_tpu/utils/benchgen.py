@@ -169,6 +169,7 @@ def bench_result_payload(
     sharded_plane: dict = None,
     capacity: dict = None,
     read_path: dict = None,
+    solver_leader: dict = None,
 ) -> dict:
     """The BENCH JSON line. ``pipelined_tick_ms`` appears ONLY when the
     measured timeline proves the overlap (VERDICT r5 ask #3) — an
@@ -238,6 +239,15 @@ def bench_result_payload(
         # dispatch p99 at 1k/10k parked agents — perf_guard enforces
         # the hit-rate and 10k-p99 bounds
         out["read_path"] = read_path
+    if solver_leader:
+        # the solver-leader-plane arm (ISSUE 17,
+        # tools/bench_solver_leader.py): one stacked shard_map solve
+        # serving a 2-shard process fleet over shared-memory arenas vs
+        # the same fleet solving locally; carries the probe-taxonomy
+        # routing verdict when the gpu escape hatch was consulted
+        out["solver_leader"] = solver_leader
+        if "value" in solver_leader:
+            out["solver_leader_round_ms"] = solver_leader["value"]
     if overlap_proven:
         out["pipelined_tick_ms"] = round(pipe_med, 2)
     return out

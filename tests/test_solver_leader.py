@@ -1,0 +1,506 @@
+"""Solver-leader plane (runtime/solver.py): cross-process stacked solve
+over shared-memory arenas — wire-format parity against the in-process
+oracle, the degrade-to-local ladder, dirty-span publication, and shm
+hygiene."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from evergreen_tpu.parallel.sharded import StackedSolveCache
+from evergreen_tpu.runtime import manifest
+from evergreen_tpu.runtime.solver import (
+    Segment,
+    ShmResidentSink,
+    SolverClient,
+    SolverService,
+    input_arrays,
+    out_elems_for_dims,
+    reap_orphan_segments,
+    segment_name,
+    sizes_for_dims,
+)
+from evergreen_tpu.scheduler.snapshot import FIELD_KINDS
+from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+_DIMS = ("N", "M", "U", "G", "H", "D")
+
+
+def _shard_snapshots(n_shards, seed=41, n_distros=None, n_tasks=400):
+    from evergreen_tpu.parallel.sharded import build_sharded_snapshot
+
+    problem = generate_problem(
+        n_distros or max(2 * n_shards, 4), n_tasks, seed=seed,
+        task_group_fraction=0.3, hosts_per_distro=3,
+    )
+    subs, _ = build_sharded_snapshot(*problem, NOW, n_shards)
+    return subs
+
+
+def _register(data_dir, shard, client):
+    """The worker-side manifest write the test harness stands in for."""
+    def on_change(name, nbytes):
+        manifest.write_entry(
+            data_dir, shard, pid=os.getpid(), sock="test",
+            generation=1, epoch=1, shm=name, shm_bytes=nbytes,
+        )
+
+    client._on_segment_change = on_change
+
+
+def _run_fleet_round(data_dir, subs, svc, timeout_s=60.0,
+                     corrupt_shard=None):
+    """Publish every shard from a thread (exactly the worker's blocking
+    solve_fn call), serve from this thread, return per-shard outputs."""
+    clients, results, threads = {}, {}, []
+    seq = svc.seq + 1
+    svc.seq = seq
+    for k, snap in enumerate(subs):
+        c = SolverClient(data_dir, k)
+        _register(data_dir, k, c)
+        clients[k] = c
+
+        def run(k=k, c=c, snap=snap):
+            # publish + wait, exactly the worker's blocking solve_fn
+            # body; the serve loop polls for the publications
+            results[k] = c._try_stacked(
+                snap, svc.lease.epoch, seq, timeout_s
+            )
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        threads.append(t)
+    try:
+        if corrupt_shard is not None:
+            # wait until the victim's publication is up, then tear it
+            from evergreen_tpu.runtime.solver import H_STATE, S_PUBLISHED
+
+            seg = None
+            import time as _t
+
+            deadline = _t.monotonic() + 10.0
+            while _t.monotonic() < deadline:
+                seg = clients[corrupt_shard]._seg
+                if seg is not None and int(seg.hdr[H_STATE]) == S_PUBLISHED:
+                    break
+                _t.sleep(0.001)
+            assert seg is not None
+            seg.region("i32", 4)[:] += 1  # payload no longer matches CRC
+        outcome = svc.serve_round([k for k in clients], seq, timeout_s)
+        for t in threads:
+            t.join(timeout=timeout_s + 10.0)
+            assert not t.is_alive()
+    finally:
+        for c in clients.values():
+            c.close(unlink=True)
+    return outcome, clients, results
+
+
+@pytest.fixture
+def svc(tmp_path):
+    svc = SolverService(
+        str(tmp_path), 8, lease_ttl_s=5.0, timeout_s=60.0
+    )
+    assert svc.acquire(timeout_s=10.0)
+    yield svc
+    svc.stop()
+
+
+# --------------------------------------------------------------------------- #
+# wire format
+# --------------------------------------------------------------------------- #
+
+
+def test_segment_layout_roundtrip(tmp_path):
+    dims = {"N": 16, "M": 16, "U": 8, "G": 8, "H": 8, "D": 8}
+    sizes = sizes_for_dims(dims)
+    seg = Segment.create("evg-sol-test-layout", sizes, 64)
+    try:
+        rng = np.random.default_rng(3)
+        for kind, n in sizes.items():
+            view = seg.region(kind, n)
+            view[:] = (rng.random(n) * 100).astype(view.dtype)
+        arrays = input_arrays(seg, dims)
+        assert set(arrays) == set(FIELD_KINDS)
+        offs = {"f32": 0, "i32": 0, "u8": 0}
+        for name, kind in FIELD_KINDS.items():
+            size = len(arrays[name])
+            raw = seg.region(kind, sizes[kind])[
+                offs[kind]: offs[kind] + size
+            ]
+            offs[kind] += size
+            got = arrays[name].view(np.uint8) if kind == "u8" else (
+                arrays[name]
+            )
+            np.testing.assert_array_equal(np.asarray(got), raw, err_msg=name)
+        assert all(offs[k] == sizes[k] for k in offs)
+    finally:
+        seg.unlink()
+        seg.close()
+
+
+def test_segment_create_reuses_leftover(tmp_path):
+    name = "evg-sol-test-reuse"
+    caps = {"f32": 64, "i32": 64, "u8": 64}
+    seg = Segment.create(name, caps, 32)
+    seg.close()  # SIGKILL analog: mapped file left behind, no unlink
+    again = Segment.create(name, caps, 32)
+    try:
+        assert not again.created  # reused, not replaced
+        assert again.caps == caps
+    finally:
+        again.unlink()
+        again.close()
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_cross_process_parity_with_inprocess_oracle(tmp_path, svc, n_shards):
+    """The acceptance bar: a cross-process stacked round must be
+    BIT-IDENTICAL to the in-process stacked oracle at 2/4/8 shards."""
+    subs = _shard_snapshots(n_shards)
+    oracle = StackedSolveCache().solve_blocks(
+        {k: subs[k].arrays for k in range(n_shards)}
+    )
+    outcome, clients, results = _run_fleet_round(
+        str(tmp_path), subs, svc
+    )
+    assert outcome == "stacked"
+    for k in range(n_shards):
+        assert clients[k].last_solve == "stacked", clients[k].last_cause
+        assert results[k] is not None
+        assert set(results[k]) == set(oracle[k])
+        for name, ref in oracle[k].items():
+            got, ref = np.asarray(results[k][name]), np.asarray(ref)
+            if got.dtype == ref.dtype:  # bit-identical, not just ==
+                assert got.tobytes() == ref.tobytes(), f"shard{k}:{name}"
+            else:
+                np.testing.assert_array_equal(
+                    got, ref, err_msg=f"shard{k}:{name}"
+                )
+
+
+# --------------------------------------------------------------------------- #
+# the degraded ladder — every rung ends in a correct local round
+# --------------------------------------------------------------------------- #
+
+
+def test_no_leader_times_out_to_local(tmp_path):
+    subs = _shard_snapshots(2)
+    c = SolverClient(str(tmp_path), 0)
+    try:
+        out = c._try_stacked(subs[0], epoch=1, seq=1, timeout_s=0.2)
+        assert out is None
+        assert c.fallbacks == {"timeout": 1}
+        assert c.last_solve == "local" and c.last_cause == "timeout"
+    finally:
+        c.close(unlink=True)
+
+
+def test_stale_epoch_stamp_never_publishes(tmp_path):
+    subs = _shard_snapshots(2)
+    c = SolverClient(str(tmp_path), 0)
+    try:
+        c.epoch_seen = 7  # a newer leader has already been observed
+        out = c._try_stacked(subs[0], epoch=3, seq=9, timeout_s=5.0)
+        assert out is None
+        assert c.fallbacks == {"stale-epoch": 1}
+        assert c._seg is None  # rejected before any segment work
+    finally:
+        c.close(unlink=True)
+
+
+def test_torn_publication_declined_other_shard_still_served(tmp_path, svc):
+    """A checksum-invalid publication must degrade ONLY its shard; with
+    <2 valid publications the round declines everyone to local — never
+    a corrupted fleet solve."""
+    subs = _shard_snapshots(2)
+    outcome, clients, results = _run_fleet_round(
+        str(tmp_path), subs, svc, corrupt_shard=0
+    )
+    assert outcome == "declined"
+    assert results[0] is None
+    assert clients[0].fallbacks == {"declined:torn-publication": 1}
+    # the survivor alone is not a stack: declined back to local too
+    assert results[1] is None
+    assert clients[1].fallbacks == {"declined:partial": 1}
+
+
+def test_torn_publication_with_quorum_solves_the_rest(tmp_path, svc):
+    subs = _shard_snapshots(4)
+    outcome, clients, results = _run_fleet_round(
+        str(tmp_path), subs, svc, corrupt_shard=2
+    )
+    assert outcome == "stacked"
+    assert clients[2].fallbacks == {"declined:torn-publication": 1}
+    oracle = StackedSolveCache().solve_blocks(
+        {k: subs[k].arrays for k in (0, 1, 3)}
+    )
+    for k in (0, 1, 3):
+        assert clients[k].last_solve == "stacked"
+        for name, ref in oracle[k].items():
+            np.testing.assert_array_equal(
+                np.asarray(results[k][name]), np.asarray(ref),
+                err_msg=f"shard{k}:{name}",
+            )
+
+
+def test_shape_drift_declines_and_records_floor(tmp_path, svc):
+    subs_a = _shard_snapshots(2, seed=5, n_tasks=100)
+    subs_b = _shard_snapshots(2, seed=6, n_tasks=2000)
+    mixed = [subs_a[0], subs_b[1]]
+    keys = [dict(zip(_DIMS, s.shape_key())) for s in mixed]
+    assert keys[0] != keys[1]  # the premise: shapes actually drift
+    outcome, clients, results = _run_fleet_round(
+        str(tmp_path), mixed, svc
+    )
+    assert outcome == "declined"
+    for k in (0, 1):
+        assert results[k] is None
+        assert clients[k].fallbacks == {"declined:shape-drift": 1}
+    assert svc.common_dims == {
+        d: max(keys[0][d], keys[1][d]) for d in _DIMS
+    }
+    # the floor rides the next stamp so shards republish at one shape
+    stamp = svc.stamp()
+    assert stamp["dims"] == svc.common_dims
+
+
+def test_leader_deposed_mid_round_aborts_without_writes(tmp_path, svc):
+    """Lease steal mid-round: the deposed leader must stop serving at
+    the next seam and write NOTHING; workers degrade to local."""
+    subs = _shard_snapshots(2)
+    svc._deposed()  # what superseded()/on_lost delivers
+    outcome, clients, results = _run_fleet_round(
+        str(tmp_path), subs, svc, timeout_s=1.0
+    )
+    assert outcome == "aborted"
+    for k in (0, 1):
+        assert results[k] is None
+        assert clients[k].fallbacks == {"timeout": 1}
+
+
+def test_stale_leader_result_fenced_at_header(tmp_path, svc):
+    """A result block stamped with an older epoch is rejected exactly
+    like stale_sup — and the defensive stale-accepted rail stays 0."""
+    subs = _shard_snapshots(2)
+    c = SolverClient(str(tmp_path), 0)
+    _register(str(tmp_path), 0, c)
+    try:
+        done = {}
+
+        def run():
+            done["out"] = c._try_stacked(
+                subs[0], epoch=5, seq=1, timeout_s=1.5
+            )
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        from evergreen_tpu.runtime.solver import (
+            H_OUT_EPOCH, H_OUT_SEQ, H_STATE, S_PUBLISHED, S_SOLVED,
+        )
+        import time as _t
+
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline:
+            if c._seg is not None and int(c._seg.hdr[H_STATE]) == S_PUBLISHED:
+                break
+            _t.sleep(0.001)
+        hdr = c._seg.hdr
+        hdr[H_OUT_EPOCH] = 3  # a stale leader's write: epoch 3 < 5
+        hdr[H_OUT_SEQ] = 1
+        hdr[H_STATE] = S_SOLVED
+        t.join(timeout=20.0)
+        assert not t.is_alive()
+        assert done["out"] is None
+        assert c.fallbacks == {"timeout": 1}  # rejected, then timed out
+        assert int(hdr[H_STATE]) == S_PUBLISHED  # re-armed, not consumed
+    finally:
+        c.close(unlink=True)
+
+
+def test_solver_lease_steal_elects_strictly_higher_epoch(tmp_path):
+    a = SolverService(str(tmp_path), 2, lease_ttl_s=0.4, timeout_s=5.0)
+    assert a.acquire(timeout_s=5.0)
+    first = a.lease.epoch
+    a.detach()  # simulate_crash: abandoned, NOT released
+    b = SolverService(str(tmp_path), 2, lease_ttl_s=0.4, timeout_s=5.0)
+    try:
+        assert b.acquire(timeout_s=10.0)
+        assert b.lease.epoch > first
+    finally:
+        b.stop()
+        a.lease.stop_renewing()
+
+
+# --------------------------------------------------------------------------- #
+# dirty-span publication (resident sink)
+# --------------------------------------------------------------------------- #
+
+
+def test_resident_sink_publishes_spans_not_repacks(tmp_path):
+    c = SolverClient(str(tmp_path), 0)
+    sink = c.resident_sink()
+    rng = np.random.default_rng(1)
+    truth = {
+        "f32": rng.random(64).astype(np.float32),
+        "i32": rng.integers(0, 50, 64).astype(np.int32),
+        "u8": rng.integers(0, 2, 64).astype(np.uint8),
+    }
+    try:
+        bufs = sink.sync(truth, None)  # cold: the one full publication
+        assert bufs is not None and sink.full_syncs == 1
+        for kind in truth:
+            np.testing.assert_array_equal(bufs[kind], truth[kind])
+        # a small mutation: only its span crosses the boundary
+        truth["i32"][10:14] = [-1, -2, -3, -4]
+        truth["f32"][3] = 99.5
+        bufs2 = sink.sync(
+            truth, {"i32": [(10, 14)], "f32": [(3, 4)]}
+        )
+        assert bufs2 is bufs  # same segment views: no repack, no remap
+        assert sink.full_syncs == 1 and sink.span_syncs == 1
+        for kind in truth:
+            np.testing.assert_array_equal(bufs[kind], truth[kind])
+        # unchanged round: empty span dict → zero bytes moved
+        before = sink.bytes_synced
+        sink.sync(truth, {})
+        assert sink.full_syncs == 1 and sink.bytes_synced == before
+        # the sink's views count as the publication (zero-copy check)
+        assert sink.owns(bufs)
+    finally:
+        c.close(unlink=True)
+
+
+def test_resident_plane_span_gate_widens_to_sink():
+    """The resident plane must track dirty spans when ONLY the shm sink
+    is attached (no device mirror)."""
+    from evergreen_tpu.scheduler.resident import ResidentPlane
+
+    plane = ResidentPlane.__new__(ResidentPlane)
+    plane._mirror = None
+    plane._shm_sink = None
+    assert not plane._tracks_spans()
+    plane.attach_shm_sink(object())
+    assert plane._tracks_spans()
+    assert plane._spans is None  # first sink publish is a full sync
+    plane.detach_shm_sink()
+    assert not plane._tracks_spans()
+
+
+def test_arena_pool_backing_vends_segment_views(tmp_path):
+    from evergreen_tpu.ops.packing import ArenaPool
+
+    c = SolverClient(str(tmp_path), 0)
+    pool = ArenaPool(backing=c.arena_backing())
+    sizes = {"f32": 32, "i32": 16, "u8": 8}
+    try:
+        lease = pool.take(sizes)
+        # the vended set IS the segment: publishing it costs no copy
+        assert c._backing is not None
+        assert lease.bufs is c._backing.vended
+        seg_view = c._seg.region("f32", 32)
+        lease.bufs["f32"][:] = 7.0
+        np.testing.assert_array_equal(seg_view, lease.bufs["f32"])
+        # depth-2 pool: the second concurrent set falls back to heap
+        lease2 = pool.take(sizes)
+        assert lease2.bufs is not lease.bufs
+        pool.give_back(lease)
+        pool.give_back(lease2)
+    finally:
+        c.close(unlink=True)
+
+
+# --------------------------------------------------------------------------- #
+# shm hygiene
+# --------------------------------------------------------------------------- #
+
+
+def test_reap_orphan_segments_unlinks_dead_pids(tmp_path):
+    data = str(tmp_path)
+    c = SolverClient(data, 0)
+    c.ensure_capacity({"f32": 32, "i32": 32, "u8": 32})
+    name = c.name
+    c.close(unlink=False)  # SIGKILL analog: segment survives the pid
+    # manifest entry pointing at a pid that cannot exist
+    manifest.write_entry(
+        data, 0, pid=2 ** 22 + 1, sock="gone", generation=1, epoch=1,
+        shm=name, shm_bytes=1024,
+    )
+    probe = Segment.attach(name)
+    assert probe is not None  # leaked right now
+    probe.close()
+    reaped = reap_orphan_segments(data, 1)
+    assert name in reaped
+    assert Segment.attach(name) is None  # gone
+
+
+def test_reap_spares_live_pids(tmp_path):
+    data = str(tmp_path)
+    c = SolverClient(data, 0)
+    c.ensure_capacity({"f32": 32, "i32": 32, "u8": 32})
+    manifest.write_entry(
+        data, 0, pid=os.getpid(), sock="live", generation=1, epoch=1,
+        shm=c.name, shm_bytes=1024,
+    )
+    try:
+        assert reap_orphan_segments(data, 1) == []
+        probe = Segment.attach(c.name)
+        assert probe is not None
+        probe.close()
+    finally:
+        c.close(unlink=True)
+
+
+def test_reap_probes_deterministic_names_without_manifest(tmp_path):
+    """A fleet SIGKILLed before any manifest write must still not leak:
+    the reaper probes the deterministic per-shard names directly."""
+    data = str(tmp_path)
+    c = SolverClient(data, 1)
+    c.ensure_capacity({"f32": 8, "i32": 8, "u8": 8})
+    name = c.name
+    c.close(unlink=False)
+    assert reap_orphan_segments(data, 2) == [name]
+    assert Segment.attach(name) is None
+
+
+# --------------------------------------------------------------------------- #
+# end to end: a real 2-shard fleet
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_stacked_round_end_to_end(tmp_path):
+    from evergreen_tpu.runtime.supervisor import FleetSupervisor
+    from evergreen_tpu.scenarios.procs import _seed_fleet
+
+    data = str(tmp_path)
+    # enough distros that the hash topology lands work on BOTH shards —
+    # a shard with nothing to solve never publishes, and a one-shard
+    # "stack" is (correctly) declined as partial
+    _seed_fleet(data, 2, {"distros": 6, "tasks": 36, "seed": 7})
+    sup = FleetSupervisor(
+        data, 2, ttl_s=2.0, hb_interval_s=0.25,
+        round_timeout_s=120.0, harness=True, recovery_anchor=NOW,
+        worker_stderr="devnull", supervisor_lease_ttl_s=2.0,
+        solver="auto", solver_lease_ttl_s=2.0, solver_timeout_s=45.0,
+    )
+    try:
+        sup.start(monitor=False)
+        assert sup.solver_service is not None
+        assert sup.solver_service.leading()
+        last = {}
+        for i in range(3):  # round 1 may shape-drift; 2+ ride the floor
+            last = sup.round(now=NOW + (i + 1) * 15.0)
+            assert set(last) == {0, 1}
+        assert [last[k].get("solve") for k in (0, 1)] == [
+            "stacked", "stacked",
+        ]
+        outcomes = sup.solver_service.round_outcomes
+        assert outcomes.get("stacked", 0) >= 1
+        state = sup.fleet_state()
+        assert state["solver_epoch"] >= 1
+    finally:
+        sup.stop(graceful=True)
+    # clean shutdown leaves zero segments behind
+    for k in range(2):
+        assert Segment.attach(segment_name(data, k)) is None

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Solver-leader round bench: ONE stacked solve serving a process fleet.
+
+The ``solver_leader_round_ms`` arm (ISSUE 17, toward ROADMAP item 5):
+a real 2-shard supervised fleet (``runtime/supervisor.py``, worker
+processes, shared-memory arena publication) driven for N rounds with
+the solver-leader plane elected (``solver="auto"``) and again with it
+disabled (``solver="never"``, every worker solves locally) — same
+workload, same sockets, same WAL traffic, so the delta is the
+stacked-vs-local solve path itself plus the shm publish/return hops.
+
+    python tools/bench_solver_leader.py [--shards 2] [--rounds 5]
+        [--backend cpu|gpu]
+
+``--backend gpu`` is the non-tunnel escape hatch (bench.py routes here
+automatically when the TPU-probe taxonomy says the axon tunnel can
+never come up on this box: ``cpu-pinned`` / ``no-pool-ips``). Prints
+one JSON line; per-round tables go to stderr.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: deterministic workload clock (the proc harness anchor)
+TICK_S = 15.0
+#: first rounds pay XLA compile plus the shape-drift convergence ladder
+#: (round 1 declines to establish the common-dims floor) — unmeasured
+WARMUP_ROUNDS = 2
+
+
+def _run_fleet(args, solver_mode: str):
+    """One fleet lifetime: seed, boot, N timed rounds, teardown.
+    Returns (per-round wall ms, per-round sorted solve outcomes)."""
+    from evergreen_tpu.runtime.supervisor import FleetSupervisor
+    from evergreen_tpu.scenarios.procs import _seed_fleet
+    from evergreen_tpu.utils.benchgen import NOW
+
+    data_dir = tempfile.mkdtemp(prefix=f"bench-solver-{solver_mode}-")
+    sup = FleetSupervisor(
+        data_dir, args.shards, ttl_s=5.0, hb_interval_s=0.5,
+        round_timeout_s=300.0, harness=True, recovery_anchor=NOW,
+        worker_stderr="devnull", supervisor_lease_ttl_s=5.0,
+        solver=solver_mode, solver_timeout_s=60.0,
+    )
+    try:
+        _seed_fleet(data_dir, args.shards, {
+            "distros": args.distros, "tasks": args.tasks, "seed": 3,
+            "hosts_per_distro": 4,
+        })
+        sup.start()
+        round_ms, outcomes = [], []
+        for i in range(WARMUP_ROUNDS + args.rounds):
+            now = NOW + (i + 1) * TICK_S
+            t0 = time.perf_counter()
+            replies = sup.round(now=now)
+            dt = (time.perf_counter() - t0) * 1e3
+            solves = sorted(
+                r.get("solve", "") for r in replies.values()
+            )
+            # no agent sim: the queues never drain, every round
+            # re-solves the same full problem — a stable measurand
+            if i >= WARMUP_ROUNDS:
+                round_ms.append(dt)
+                outcomes.append(solves)
+        return round_ms, outcomes
+    finally:
+        sup.stop(graceful=True)
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--shards", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--distros", type=int, default=8)
+    p.add_argument("--tasks", type=int, default=240)
+    p.add_argument("--backend", default="cpu", choices=("cpu", "gpu"))
+    args = p.parse_args()
+
+    if args.backend == "gpu":
+        # non-tunnel accelerator: the leader's stacked shard_map solve
+        # runs on CUDA devices in this process; workers stay on CPU
+        os.environ["JAX_PLATFORMS"] = "cuda"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    else:
+        from evergreen_tpu.utils.jaxenv import force_cpu
+
+        force_cpu(n_devices=args.shards)
+
+    stacked_ms, stacked_out = _run_fleet(args, "auto")
+    local_ms, _ = _run_fleet(args, "never")
+
+    stacked_rounds = sum(
+        1 for o in stacked_out if o and all(s == "stacked" for s in o)
+    )
+    for i, (ms, o) in enumerate(zip(stacked_ms, stacked_out)):
+        print(f"# round {i}: {ms:.1f}ms {o}", file=sys.stderr)
+    value = statistics.median(stacked_ms)
+    local = statistics.median(local_ms)
+    print(json.dumps({
+        "metric": "solver_leader_round_ms",
+        "value": round(value, 2),
+        "unit": "ms",
+        "backend": args.backend,
+        "n_shards": args.shards,
+        "rounds": args.rounds,
+        "stacked_rounds": stacked_rounds,
+        "local_round_ms": round(local, 2),
+        # >1 means one fleet-wide stacked solve beat N local solves on
+        # this box; on shared CPU cores the shm+sync overhead can eat
+        # the win — the deployment case is a device mesh the workers
+        # don't have
+        "vs_local": round(local / value, 2) if value else 0.0,
+    }))
+    # a bench fleet that never stacked measured nothing — fail loudly
+    # instead of recording a local-solve number under the stacked name
+    return 0 if stacked_rounds >= max(1, args.rounds - 1) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -753,6 +753,43 @@ def run_sup_points() -> int:
     return failures
 
 
+def run_solver_points() -> int:
+    """The solver-LEADER death points (ISSUE 17) through the proc
+    backend: the supervisor (= elected solver leader) dies at each
+    solver seam — after collecting publications, after the stacked
+    solve, after writing the FIRST shard's result, and at round start
+    — plus a hang past the worker timeout. Every shard must degrade
+    to a local solve that round (fenced at the shm header, never a
+    torn fleet solve), the successor must re-elect the solver lease
+    at a strictly higher epoch, stacked rounds must resume, and zero
+    stale results / zero leaked shm segments are tolerated."""
+    from evergreen_tpu.scenarios.procs import (
+        PROC_SCENARIOS,
+        SOLVER_SCENARIOS,
+        run_proc_scenario,
+    )
+
+    failures = 0
+    for name in SOLVER_SCENARIOS:
+        entry = run_proc_scenario(PROC_SCENARIOS[name]())
+        stats = entry.get("stats", {})
+        print(json.dumps({
+            "point": name,
+            "ok": entry["ok"],
+            "stacked": stats.get("solver_stacked_replies", 0),
+            "local": stats.get("solver_local_replies", 0),
+            "reelections": stats.get("solver_reelections", 0),
+            "stale_accepted": stats.get("solver_stale_accepted", 0),
+            "shm_leaked": stats.get("shm_leaked", 0),
+        }))
+        if not entry["ok"]:
+            failures += 1
+            sys.stderr.write(
+                json.dumps(entry, default=str) + "\n"
+            )
+    return failures
+
+
 def failover_case(ticks: int = 4, stall_s: float = 2.0) -> dict:
     """Two-process failover: holder SIGSTOPped mid-commit, standby steals
     and runs, holder SIGCONTed → its resumed commit is fenced; the WAL
@@ -931,9 +968,15 @@ def run_matrix(points: Optional[List[Tuple[str, int]]] = None,
     # SUPERVISOR itself, resolved by orphan mode + live adoption
     n_sup = run_sup_points()
     failures += n_sup
+    # solver-leader death points: the leader dies (or stalls) at each
+    # solver seam, resolved by degrade-to-local + re-election
+    from evergreen_tpu.scenarios.procs import SOLVER_SCENARIOS
+
+    failures += run_solver_points()
     print(json.dumps({
         "crash_matrix_failures": failures,
-        "points": len(points) + 1 + len(SHARDED_KILL_POINTS) + 2,
+        "points": len(points) + 1 + len(SHARDED_KILL_POINTS) + 2
+        + len(SOLVER_SCENARIOS),
     }))
     return 1 if failures else 0
 
@@ -952,8 +995,18 @@ def main() -> int:
                    help="run only the distro-handoff kill points")
     p.add_argument("--sup-only", action="store_true",
                    help="run only the supervisor-crash points")
+    p.add_argument("--solver-only", action="store_true",
+                   help="run only the solver-leader death points")
     p.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
     args = p.parse_args()
+    # the sup/solver points run supervisors IN THIS PROCESS; the
+    # solver-leader's stacked shard_map solve needs a device per shard
+    # — pin the backend before anything initializes jax
+    from evergreen_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(n_devices=2)
+    if args.solver_only:
+        return 1 if run_solver_points() else 0
     if args.sup_only:
         return 1 if run_sup_points() else 0
     if args.sharded_only:

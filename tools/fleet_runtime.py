@@ -19,7 +19,12 @@ Runs (gate-blocking via ``tools/gate.py --fleet-runtime`` /
      SUPERVISOR-kill weathers (``sup_kill`` mid-round / mid-handoff →
      orphan workers, fleet-lease steal, live adoption with zero
      shard-lease epoch bumps and zero recovery passes,
-     exactly-one-owner after the mid-handoff point);
+     exactly-one-owner after the mid-handoff point) — plus the
+     solver-LEADER death weathers (``leader_kill`` at each solver
+     seam / ``leader_hang`` past the worker timeout → every shard
+     degrades to a LOCAL solve that round, the successor re-elects
+     the solver lease at a strictly higher epoch, stacked rounds
+     resume, zero stale results accepted, zero shm segments leaked);
   2. a sample of the migrated crash-matrix engine points
      (``run_crash_point`` — the backend ``crash-matrix`` runs all 13
      through): one kill inside a WAL group commit, one between the
@@ -62,17 +67,21 @@ SMOKE_POINTS: List[Tuple[str, int]] = [
 def _force_cpu() -> None:
     from evergreen_tpu.utils.jaxenv import force_cpu
 
-    force_cpu(n_devices=1)
+    # 2 host devices: the solver-leader weathers run the leader's
+    # stacked shard_map solve IN THIS PROCESS, one device per shard
+    force_cpu(n_devices=2)
 
 
 def run_weathers(names: Optional[List[str]] = None) -> int:
+    from evergreen_tpu.scenarios.library import PROC_WEATHERS
     from evergreen_tpu.scenarios.procs import (
         PROC_SCENARIOS,
         run_proc_scenario,
     )
 
     failures = 0
-    for name, factory in PROC_SCENARIOS.items():
+    suite = {**PROC_SCENARIOS, **PROC_WEATHERS}
+    for name, factory in suite.items():
         if names and name not in names:
             continue
         entry = run_proc_scenario(factory())
@@ -280,13 +289,15 @@ def main() -> int:
         return 2
     _force_cpu()
     if args.scenario:
+        from evergreen_tpu.scenarios.library import PROC_WEATHERS
         from evergreen_tpu.scenarios.procs import PROC_SCENARIOS
 
-        if args.scenario not in PROC_SCENARIOS:
+        known = {**PROC_SCENARIOS, **PROC_WEATHERS}
+        if args.scenario not in known:
             # a typo must never read as "smoke passed"
             print(
                 f"unknown scenario {args.scenario!r}; known: "
-                f"{sorted(PROC_SCENARIOS)}", file=sys.stderr,
+                f"{sorted(known)}", file=sys.stderr,
             )
             return 2
     failures = 0
