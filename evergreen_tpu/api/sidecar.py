@@ -8,11 +8,16 @@ needed — the snapshot arena layout is fully determined by the shape key,
 snapshot.arena_for_dims). The C++ client lives in native/evgsolve.
 
 Wire format (little-endian):
-  request:  magic "EVGS" | u32 version=1 | 6×u32 shape key (N,M,U,G,H,D)
+  request:  magic "EVGS" | u32 version=2 | 8×u32 shape key (N,M,U,G,H,D,P,C)
             | u64 n_f32 | f32 data | u64 n_i32 | i32 data | u64 n_u8 | u8 data
   response: u32 status (0=ok) |
             ok   → u64 n_i32 | i32 data | u64 n_f32 | f32 data
             err  → u32 msg_len | msg bytes
+
+Version 2 widened the shape key 6 → 8 dims for the fused capacity page
+(P pool rows, C config slots); the fused-capacity trip count is carried
+IN-BAND by the c_cfg page inside the f32 payload, so the protocol
+itself needed no extra field.
 """
 from __future__ import annotations
 
@@ -25,7 +30,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 MAGIC = b"EVGS"
-VERSION = 1
+VERSION = 2
 
 
 def _read_exact(sock_file, n: int) -> bytes:
@@ -39,27 +44,36 @@ def _read_exact(sock_file, n: int) -> bytes:
 
 
 def _solve_buffers(
-    shape: Tuple[int, int, int, int, int, int],
+    shape: Tuple[int, ...],
     f32_buf: np.ndarray,
     i32_buf: np.ndarray,
     u8_buf: np.ndarray,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run the packed solve on raw arena buffers."""
-    from ..ops.solve import _packed_solve, split_packed
+    from ..ops.capacity import C_ITERS, C_VALID
+    from ..ops.solve import _packed_solve, split_packed, with_output_dims
     from ..scheduler.snapshot import arena_for_dims
 
-    dims = dict(zip("NMUGHD", shape))
+    dims = dict(zip("NMUGHDPC", shape))
     arena = arena_for_dims(dims)
     want = {k: v.shape[0] for k, v in arena.buffers.items()}
     got = {"f32": f32_buf.shape[0], "i32": i32_buf.shape[0], "u8": u8_buf.shape[0]}
     if want != got:
         raise ValueError(f"buffer sizes {got} do not match shape key (want {want})")
     bufs = {"f32": f32_buf, "i32": i32_buf, "u8": u8_buf}
+    # the fused-capacity trip count rides in-band on the c_cfg page
+    _, c_off, c_size = arena._layout["c_cfg"]
+    page = f32_buf[c_off: c_off + c_size]
+    cap_iters = 0
+    if c_size > C_ITERS and float(page[C_VALID]) > 0.0:
+        cap_iters = max(0, min(int(page[C_ITERS]), 512))
     from ..ops.solve import x64_scope
 
     with x64_scope():
-        out = np.asarray(_packed_solve(bufs, arena.layout_key()))
-    return split_packed(out, dims)
+        out = np.asarray(_packed_solve(
+            bufs, arena.layout_key(), (False, 0, False), cap_iters
+        ))
+    return split_packed(out, with_output_dims(dims))
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -77,7 +91,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 (version,) = struct.unpack("<I", _read_exact(self.rfile, 4))
                 if version != VERSION:
                     raise ValueError(f"unsupported protocol version {version}")
-                shape = struct.unpack("<6I", _read_exact(self.rfile, 24))
+                shape = struct.unpack("<8I", _read_exact(self.rfile, 32))
                 bufs = []
                 for dtype, itemsize in ((np.float32, 4), (np.int32, 4), (np.uint8, 1)):
                     (count,) = struct.unpack("<Q", _read_exact(self.rfile, 8))
@@ -139,7 +153,7 @@ class SidecarClient:
         bufs = snapshot.arena.buffers
         f.write(MAGIC)
         f.write(struct.pack("<I", VERSION))
-        f.write(struct.pack("<6I", *snapshot.shape_key()))
+        f.write(struct.pack("<8I", *snapshot.shape_key()))
         for kind, dtype in (("f32", "<f4"), ("i32", "<i4"), ("u8", "u1")):
             arr = np.ascontiguousarray(bufs[kind])
             f.write(struct.pack("<Q", arr.shape[0]))

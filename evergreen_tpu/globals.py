@@ -196,6 +196,11 @@ CONSECUTIVE_SYSTEM_FAILURE_THRESHOLD = 3
 #: Default seconds between scheduler ticks (reference operations/service.go:99).
 SCHEDULER_TICK_INTERVAL_S = 15
 
+#: Suffix appended to a distro id for its secondary-queue alias row.
+#: Lives here (not scheduler/wrapper.py) so the snapshot packer and the
+#: capacity plane can test for alias rows without importing the wrapper.
+ALIAS_SUFFIX = "::alias"
+
 # --------------------------------------------------------------------------- #
 # Planner / allocator enum knobs (reference model/distro/distro.go:267-300)
 # --------------------------------------------------------------------------- #

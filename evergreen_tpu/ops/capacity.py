@@ -80,6 +80,23 @@ def pool_name_of(index: int) -> str:
 #: inf hazards in the projections
 _BIG = 1.0e7
 
+# --------------------------------------------------------------------------- #
+# Packed capacity-config page (the fused program's scalar channel)
+# --------------------------------------------------------------------------- #
+
+#: the ``c_cfg`` arena column is a fixed C_BUCKET-wide f32 page carrying
+#: the capacity program's scalars into the packed solve; slot indices
+#: are part of the wire format (sidecar v2 / solver-leader shm v2)
+C_BUCKET = 8
+C_VALID = 0          # > 0 ⇔ a capacity page rode this tick
+C_BUDGET_BASE = 1    # tick intent allowance BEFORE reserving non-elig rows
+C_SPLIT_BUDGET = 2   # this shard's split of the fleet intent budget
+C_W_PRICE = 3
+C_W_CHURN = 4
+C_AFF_T0 = 5         # affinity softmax temperature (annealed)
+C_AFF_ANNEAL = 6     # per-iteration temperature decay factor
+C_ITERS = 7          # damped-Newton iteration count (static trip count)
+
 
 # --------------------------------------------------------------------------- #
 # Inputs
@@ -254,13 +271,22 @@ def _pad_bucket(n: int) -> int:
     return b
 
 
-def run_capacity_solve(inp: CapacityInputs) -> np.ndarray:
+def run_capacity_solve(inp: CapacityInputs,
+                       d_pad: Optional[int] = None) -> np.ndarray:
     """The fractional relaxation on device: returns x[n] (total hosts per
-    distro, real-sized). Deterministic for fixed inputs."""
+    distro, real-sized). Deterministic for fixed inputs.
+
+    ``d_pad`` pins the padded row count. The fused-vs-two-call parity
+    contract needs it: XLA's reduction trees reassociate differently at
+    different padded shapes, so the two-call fallback must run at the
+    SAME padded D as the fused program to stay bit-identical (padding
+    rows are exact zeros, which never perturb the partial sums — only
+    the tree shape over the nonzero values matters)."""
     import jax
 
     n = inp.n
-    D = _pad_bucket(max(n, 1))
+    D = _pad_bucket(max(n, 1)) if d_pad is None else int(d_pad)
+    assert D >= n, f"d_pad {D} < instance rows {n}"
     lo, hi = inp.bounds()
     f32 = np.float32
 
@@ -449,19 +475,15 @@ def heuristic_allocation(inp: CapacityInputs) -> np.ndarray:
     return (inp.existing + inp.heuristic_new).astype(np.int64)
 
 
-def solve_capacity(
-    inp: CapacityInputs,
+def solve_capacity_from_x(
+    inp: CapacityInputs, x: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, str]:
-    """The full pipeline: device relaxation → deterministic rounding →
-    matches-or-beats guard. Returns (targets, fractional x, chosen)
-    where ``chosen`` is "solver" or "heuristic".
-
-    The guard makes "matches or beats" true by construction: the solver
-    allocation is adopted only when it is feasible AND its total drain
-    does not regress the heuristic's (or the heuristic itself violates
-    a pool/fleet constraint — the coupled caps the per-distro loop is
-    blind to — in which case the solver's feasible answer wins)."""
-    x = run_capacity_solve(inp)
+    """Rounding + matches-or-beats guard over a precomputed fractional
+    relaxation ``x`` — the host half shared by the two-call pipeline
+    (``solve_capacity``) and the fused consumer, which slices x out of
+    the packed solve's ``cap_x`` column instead of launching a second
+    device call. Returns (targets, x, chosen)."""
+    x = np.asarray(x, dtype=np.float64)[: inp.n]
     targets = round_allocation(x, inp)
     heur = heuristic_allocation(inp)
     if check_feasible(targets, inp):
@@ -475,3 +497,58 @@ def solve_capacity(
     if s_total <= h_total + 1e-6:
         return targets, x, "solver"
     return heur, x, "heuristic"
+
+
+def solve_capacity(
+    inp: CapacityInputs, d_pad: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, str]:
+    """The full two-call pipeline: device relaxation → deterministic
+    rounding → matches-or-beats guard. Returns (targets, fractional x,
+    chosen) where ``chosen`` is "solver" or "heuristic".
+
+    The guard makes "matches or beats" true by construction: the solver
+    allocation is adopted only when it is feasible AND its total drain
+    does not regress the heuristic's (or the heuristic itself violates
+    a pool/fleet constraint — the coupled caps the per-distro loop is
+    blind to — in which case the solver's feasible answer wins)."""
+    x = run_capacity_solve(inp, d_pad=d_pad)
+    return solve_capacity_from_x(inp, x)
+
+
+def round_affinity(aff: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Deterministic largest-remainder rounding of the fused program's
+    per-unit pool affinities: ``aff`` [U, P_BUCKET] soft assignment,
+    ``counts`` [U] integral task counts per unit → integral [U, P_BUCKET]
+    task placements summing exactly to ``counts`` per row. Advisory
+    placement hints (trade partners / provenance), so the only hard
+    constraint is the row-sum; ties break by pool index."""
+    aff = np.asarray(aff, dtype=np.float64)
+    counts = np.asarray(counts, dtype=np.int64)
+    u = len(counts)
+    out = np.zeros((u, P_BUCKET), dtype=np.int64)
+    act = counts > 0
+    if not act.any():
+        return out
+    # vectorized over the active rows: a fused tick rounds thousands of
+    # units, and a per-row Python loop was the dominant host cost of the
+    # whole fused consume (~45ms at 4k units vs <1ms here)
+    rows = np.maximum(aff[act, :P_BUCKET], 0.0)
+    c = counts[act]
+    s = rows.sum(axis=1)
+    nosig = s <= 0.0
+    want = rows / np.where(nosig, 1.0, s)[:, None] * c[:, None]
+    base = np.floor(want + 1e-9).astype(np.int64)
+    rem = want - base
+    left = c - base.sum(axis=1)
+    # largest remainder, ties by pool index: stable sort on -rem keeps
+    # equal remainders in pool order, so rank<left picks the same pools
+    # the sequential sweep did
+    order = np.argsort(-rem, axis=1, kind="stable")
+    rank = np.empty_like(order)
+    k = order.shape[0]
+    rank[np.arange(k)[:, None], order] = np.arange(P_BUCKET)[None, :]
+    base += rank < left[:, None]
+    base[nosig] = 0
+    base[nosig, P_BUCKET - 1] = c[nosig]
+    out[act] = base
+    return out

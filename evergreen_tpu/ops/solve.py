@@ -27,6 +27,26 @@ import numpy as np
 from jax import lax
 
 from ..globals import MAX_DURATION_PER_DISTRO_HOST_S
+from .capacity import (
+    C_AFF_ANNEAL,
+    C_AFF_T0,
+    C_BUCKET,
+    C_BUDGET_BASE,
+    C_ITERS,
+    C_SPLIT_BUDGET,
+    C_VALID,
+    C_W_CHURN,
+    C_W_PRICE,
+    P_BUCKET,
+    _BIG,
+    _capacity_step_fns,
+)
+
+#: anneal sweeps for the advisory task-group→pool affinity block — a
+#: deliberately smaller budget than the Newton relaxation's C_ITERS (the
+#: [U, P] softmax dwarfs the [D] Newton step at fleet-scale U, and the
+#: hints it feeds are rounded host-side anyway)
+AFFINITY_ITERS_MAX = 12
 
 
 def x64_scope():
@@ -421,6 +441,175 @@ def allocator(
 
 
 # --------------------------------------------------------------------------- #
+# Fused capacity + affinity block
+# --------------------------------------------------------------------------- #
+
+
+def capacity_affinity(
+    a: Dict[str, jnp.ndarray],
+    out: Dict[str, jnp.ndarray],
+    cap_iters: int,
+) -> Dict[str, jnp.ndarray]:
+    """The capacity program + task-group→pool affinity, fused into the
+    packed solve: everything the two-call path computed host-side
+    arrives as packed columns (d_alias/d_single_task/p_price/p_quota/
+    c_cfg) and the damped-Newton relaxation (ops/capacity.py
+    ``_capacity_step_fns`` — the SAME closures) runs here, fed by the
+    allocator's own aggregates instead of a host round trip.
+
+    Parity contract with ``run_capacity_solve``: the Newton loop
+    carries x ALONE through its own ``fori_loop`` (merging the affinity
+    carry into it could change the compiled loop body), every operand is
+    the same f32 value the host-side instance builder produces (integer
+    counts are exact; the one division double-rounds innocuously), and
+    the affinity block consumes the FINISHED x (one-way coupling) so it
+    cannot perturb the targets. One Newton step matches the two-call
+    program bit for bit; across iterations XLA may contract the loop
+    body differently inside this larger program, so the relaxations
+    agree to float ulps while the INTEGRAL targets and rounded
+    allocations — the actual contract, pinned by the capacity-parity
+    gate — come out identical.
+
+    Affinity is a mean-field annealed softmax over the P_BUCKET pools
+    per unit (Differentiable Combinatorial Scheduling's relaxation
+    shape): utility = home-pool bonus − price + capacity headroom −
+    congestion(A), temperature T_k = T0·anneal^k, rounded host-side by
+    the largest-remainder machinery (ops/capacity.py round_affinity).
+    Advisory placement hints — never a hard constraint."""
+    D = a["d_valid"].shape[0]
+    U = a["u_distro"].shape[0]
+    P = P_BUCKET
+    f32 = jnp.float32
+    c = a["c_cfg"].astype(f32)
+
+    d_valid = a["d_valid"]
+    alias = a["d_alias"]
+    single = a["d_single_task"]
+    maxh_raw = a["d_max_hosts"].astype(f32)
+    existing = _seg_sum(a["h_valid"].astype(f32), a["h_distro"], D)
+    free = _seg_sum(
+        (a["h_valid"] & a["h_free"]).astype(f32), a["h_distro"], D
+    )
+    required = out["d_new_hosts"].astype(f32)
+    deps = out["d_deps_met"].astype(f32)
+    demand = out["d_expected_dur_s"].astype(f32)
+    thresh = jnp.where(a["d_thresh_s"] > 0, a["d_thresh_s"], 1.0).astype(f32)
+
+    # eligibility — the device mirror of CapacityPlane.eligible over the
+    # packed settings columns
+    elig = (
+        d_valid
+        & a["d_cap_on"]
+        & ~alias
+        & ~single
+        & a["d_ephemeral"]
+        & ~a["d_disabled"]
+        & (maxh_raw > 0)
+    )
+
+    # instance columns — the same formulas CapacityInputs computes
+    # host-side (all integer-valued ⇒ f32-exact)
+    demand_u = demand / thresh
+    lo = jnp.maximum(a["d_min_hosts"].astype(f32), 0.0)
+    new_cap = jnp.maximum(deps - free, 0.0)
+    maxh = jnp.where(maxh_raw > 0, maxh_raw, f32(_BIG))
+    hi = jnp.maximum(lo, jnp.minimum(maxh, existing + new_cap))
+    anchor = jnp.clip(existing + required, lo, hi)
+
+    def pool_sum(x):
+        return jnp.zeros((P,), f32).at[a["d_pool"]].add(x)
+
+    # effective quota / budget — the device mirror of effective_quota()
+    # / effective_budget() (min hosts are hard and floor both)
+    lo_mass = pool_sum(jnp.where(elig, lo, 0.0))
+    quota = jnp.where(
+        a["p_quota"] > 0,
+        jnp.maximum(a["p_quota"], lo_mass),
+        f32(_BIG),
+    )
+    # reserved: non-eligible rows draw from the same tick intent budget
+    # first (capacity_plane.apply's host loop over new_hosts) —
+    # single-task rows want their 1:1 bypass count, everything else its
+    # heuristic required count
+    bypass = jnp.maximum(
+        0.0,
+        jnp.minimum(deps, jnp.where(maxh_raw > 0, maxh_raw, deps) - existing),
+    )
+    want = jnp.where(single, bypass, required)
+    reserved = jnp.sum(
+        jnp.where(d_valid & ~alias & ~elig, jnp.maximum(want, 0.0), 0.0)
+    )
+    base = c[C_BUDGET_BASE]
+    budget = jnp.where(
+        base >= 0,
+        jnp.minimum(c[C_SPLIT_BUDGET], jnp.maximum(base - reserved, 0.0)),
+        c[C_SPLIT_BUDGET],
+    )
+    lo_inc = jnp.maximum(lo - existing, 0.0)
+    budget = jnp.maximum(budget, jnp.sum(jnp.where(elig, lo_inc, 0.0)))
+
+    cap_a = {
+        "demand_u": demand_u,
+        "existing": existing,
+        "lo": lo,
+        "hi": hi,
+        "anchor": anchor,
+        "pool": a["d_pool"],
+        "elig": elig,
+        "price": a["p_price"].astype(f32),
+        "quota": quota,
+        "budget": budget,
+        "w_price": c[C_W_PRICE],
+        "w_churn": c[C_W_CHURN],
+    }
+    newton, project = _capacity_step_fns(P)
+    x0 = project(jnp.clip(anchor, lo, hi), cap_a)
+
+    def x_step(_, x):
+        return project(newton(x, cap_a), cap_a)
+
+    x = lax.fori_loop(0, cap_iters, x_step, x0)
+    cap_x = jnp.where(elig, x, anchor)
+
+    # ---- task-group → pool affinity (anneal over the finished x) ---------- #
+    ud = a["u_distro"]
+    u_valid = _seg_sum(a["m_valid"].astype(f32), a["m_unit"], U) > 0
+    home = (
+        jnp.arange(P, dtype=jnp.int32)[None, :] == a["d_pool"][ud][:, None]
+    ).astype(f32)
+    pool_x = pool_sum(jnp.where(elig, cap_x, 0.0))
+    headroom = pool_x / jnp.maximum(jnp.sum(pool_x), 1.0)
+    n_units = jnp.maximum(jnp.sum(u_valid.astype(f32)), 1.0)
+    t0 = jnp.where(c[C_AFF_T0] > 0, c[C_AFF_T0], 1.0)
+    anneal = jnp.clip(
+        jnp.where(c[C_AFF_ANNEAL] > 0, c[C_AFF_ANNEAL], 0.92), 0.5, 1.0
+    )
+
+    def util(A):
+        load = jnp.sum(jnp.where(u_valid[:, None], A, 0.0), axis=0) / n_units
+        return (
+            2.0 * home
+            - c[C_W_PRICE] * cap_a["price"][None, :]
+            + headroom[None, :]
+            - load[None, :]
+        )
+
+    def a_step(k, A):
+        t = jnp.maximum(t0 * anneal ** k.astype(f32), 1e-3)
+        return jax.nn.softmax(util(A) / t, axis=-1)
+
+    A0 = jnp.full((U, P), 1.0 / P, f32)
+    # the anneal is over ADVISORY hints with no two-call twin to match,
+    # and its mean-field fixed point settles in ~a dozen sweeps — running
+    # it for the full Newton budget would make the [U, P] softmax the
+    # dominant device cost of the fused block at large U for no sharper
+    # placement (the host rounds the soft rows either way)
+    A = lax.fori_loop(0, min(cap_iters, AFFINITY_ITERS_MAX), a_step, A0)
+    aff = jnp.where(u_valid[:, None], A, 0.0)
+    return {"cap_x": cap_x, "aff_pool": aff.reshape(U * P)}
+
+
+# --------------------------------------------------------------------------- #
 # Combined solve
 # --------------------------------------------------------------------------- #
 
@@ -428,25 +617,43 @@ def allocator(
 def solve(
     a: Dict[str, jnp.ndarray],
     pallas_cfg: Tuple[bool, int, bool] = (False, 0, False),
+    cap_iters: int = 0,
 ) -> Dict[str, jnp.ndarray]:
-    """The whole scheduling tick on device: ordered queues + spawn counts."""
+    """The whole scheduling tick on device: ordered queues + spawn counts
+    + capacity targets + pool affinities, ONE program. ``cap_iters`` is
+    the capacity block's static trip count (0 on ticks that shipped no
+    capacity page — the block still runs so the output layout is static,
+    but collapses to the projected warm start and a uniform softmax)."""
     out = planner(a)
     out.update(allocator(a, pallas_cfg))
+    out.update(capacity_affinity(a, out, cap_iters))
     return out
 
 
 @functools.cache
 def _compiled_solve():
-    return jax.jit(solve, static_argnums=(1,))
+    return jax.jit(solve, static_argnums=(1, 2))
 
 
-def run_solve(arrays: Dict, pallas_cfg=(False, 0, False)) -> Dict:
+def capacity_iters(snapshot) -> int:
+    """The tick's static capacity trip count, read off the packed c_cfg
+    page (0 ⇔ no page rode this snapshot; the fused block degrades to a
+    shape-preserving no-op). Clamped like CapacityConfig.iterations."""
+    arrays = getattr(snapshot, "arrays", None)
+    c = arrays.get("c_cfg") if arrays is not None else None
+    if c is None or len(c) < C_BUCKET or float(c[C_VALID]) <= 0.0:
+        return 0
+    return max(0, min(512, int(float(c[C_ITERS]))))
+
+
+def run_solve(arrays: Dict, pallas_cfg=(False, 0, False),
+              cap_iters: int = 0) -> Dict:
     """Run the jitted solve on numpy inputs, returning numpy outputs.
     Compilation is cached per shape bucket (snapshot padding keeps the set
     of distinct shapes small under churn)."""
     fn = _compiled_solve()
     with x64_scope():
-        out = fn(arrays, pallas_cfg)
+        out = fn(arrays, pallas_cfg, cap_iters)
     return {k: jax.device_get(v) for k, v in out.items()}
 
 
@@ -473,7 +680,8 @@ def pallas_cfg_from_env(k_blocks: int) -> Tuple[bool, int, bool]:
 # --------------------------------------------------------------------------- #
 
 #: output name → (dtype kind, dim symbol); dims resolve from the shape key
-#: (N tasks, G segments, D distros).
+#: (N tasks, G segments, D distros, U units; "UP" = U·P_BUCKET, the
+#: flattened per-unit pool-affinity block — see with_output_dims).
 OUTPUT_SPEC = (
     ("order", "i32", "N"),
     ("t_unit", "i32", "N"),
@@ -499,11 +707,24 @@ OUTPUT_SPEC = (
     ("d_over_dur_s", "f32", "D"),
     ("g_expected_dur_s", "f32", "G"),
     ("g_over_dur_s", "f32", "G"),
+    ("cap_x", "f32", "D"),
+    ("aff_pool", "f32", "UP"),
 )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _packed_solve(bufs: Dict, layout_key, pallas_cfg=(False, 0, False)):
+def with_output_dims(dims: Dict) -> Dict:
+    """Resolve the derived output dims OUTPUT_SPEC references: "UP" is
+    the flattened [U, P_BUCKET] affinity block. The ONE place the
+    derivation lives — every OUTPUT_SPEC consumer (split_packed, the
+    solver-leader result layout, the sidecar) goes through it."""
+    out = dict(dims)
+    out["UP"] = int(dims["U"]) * P_BUCKET
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _packed_solve(bufs: Dict, layout_key, pallas_cfg=(False, 0, False),
+                  cap_iters: int = 0):
     """One fused result buffer: i32 outputs followed by the f32 outputs
     bitcast to i32, so the host pays exactly ONE device fetch per tick.
     Over the tunnel-attached TPU every blocking sync costs a full network
@@ -512,7 +733,7 @@ def _packed_solve(bufs: Dict, layout_key, pallas_cfg=(False, 0, False)):
     from .packing import unpack
 
     a = unpack(bufs, layout_key)
-    out = solve(a, pallas_cfg)
+    out = solve(a, pallas_cfg, cap_iters)
     parts = [out[name] for name, kind, _ in OUTPUT_SPEC if kind == "i32"]
     parts += [
         jax.lax.bitcast_convert_type(out[name], jnp.int32)
@@ -547,6 +768,7 @@ def dispatch_solve_packed(snapshot):
         return _packed_solve(
             snapshot.arena.buffers, snapshot.arena.layout_key(),
             pallas_cfg_from_env(getattr(snapshot, "k_blocks", 0)),
+            capacity_iters(snapshot),
         )
 
 
@@ -555,8 +777,8 @@ def fetch_solve_packed(buf, snapshot) -> Dict:
     unpack the result buffer into named output arrays."""
     buf_np = np.asarray(buf)
 
-    N, _, _, G, _, D = snapshot.shape_key()
-    dims = {"N": N, "G": G, "D": D}
+    N, _, U, G, _, D = snapshot.shape_key()[:6]
+    dims = with_output_dims({"N": N, "U": U, "G": G, "D": D})
     i32_np, f32_np = split_packed(buf_np, dims)
     out: Dict = {}
     offs = {"i32": 0, "f32": 0}

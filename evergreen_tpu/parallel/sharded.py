@@ -141,9 +141,10 @@ def build_sharded_snapshot(
     return subs, stacked
 
 
-def sharded_solve_fn(mesh, axis: str = "shard"):
+def sharded_solve_fn(mesh, axis: str = "shard", cap_iters: int = 0):
     """The shard_map-wrapped solve: per-device local blocks, no
-    collectives."""
+    collectives. ``cap_iters`` is the static fused-capacity trip count
+    (0 compiles the solve without the capacity/affinity block)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -153,7 +154,7 @@ def sharded_solve_fn(mesh, axis: str = "shard"):
         # each device sees [1, ...] blocks: drop the shard axis, solve
         # locally, restore the axis
         local = {k: v[0] for k, v in block.items()}
-        out = solve(local)
+        out = solve(local, cap_iters=cap_iters)
         return {k: v[None, ...] for k, v in out.items()}
 
     try:
@@ -198,7 +199,27 @@ _OUT_KEYS = (
     "d_merge",
     "g_count", "g_expected_dur_s", "g_count_free", "g_count_required",
     "g_over_count", "g_over_dur_s", "g_wait_over", "g_merge",
+    "cap_x", "aff_pool",
 )
+
+
+def _blocks_cap_iters(blocks: "Dict[int, Dict]") -> int:
+    """Static fused-capacity trip count for a set of shard blocks: the
+    max across every shard's packed ``c_cfg`` page (0 when no shard
+    carries a live page — the solve then compiles without the capacity
+    block). Using the max keeps the stacked program uniform; a shard
+    with a zero page runs the extra iterations as exact no-ops."""
+    from ..ops.capacity import C_ITERS, C_VALID
+
+    iters = 0
+    for b in blocks.values():
+        c = b.get("c_cfg")
+        if c is None:
+            continue
+        c = np.asarray(c)
+        if c.shape[0] > C_ITERS and float(c[C_VALID]) > 0.0:
+            iters = max(iters, int(c[C_ITERS]))
+    return max(0, min(iters, 512))
 
 
 class StackedSolveCache:
@@ -214,22 +235,28 @@ class StackedSolveCache:
 
     def __init__(self) -> None:
         self._fn = None
-        self._fn_n = 0
+        self._fn_key = None
 
     def solve_blocks(self, blocks: "Dict[int, Dict]") -> "Dict[int, Dict]":
         """``{shard: arrays}`` in, ``{shard: outputs}`` out (numpy, one
         block per shard, shards in sorted order on the stack axis). All
         blocks must share one shape — callers enforce/repair dims
-        agreement themselves."""
+        agreement themselves. The executable is keyed on (shard count,
+        fused-capacity trip count) so a page appearing/disappearing
+        recompiles instead of running the wrong static loop."""
         import jax
         import numpy as np
 
         from .mesh import make_mesh
 
         order = sorted(blocks)
-        if self._fn is None or self._fn_n != len(order):
-            self._fn = sharded_solve_fn(make_mesh(len(order)))
-            self._fn_n = len(order)
+        cap_iters = _blocks_cap_iters(blocks)
+        key = (len(order), cap_iters)
+        if self._fn is None or self._fn_key != key:
+            self._fn = sharded_solve_fn(
+                make_mesh(len(order)), cap_iters=cap_iters
+            )
+            self._fn_key = key
         stacked = {
             name: np.stack(
                 [np.asarray(blocks[k][name]) for k in order]

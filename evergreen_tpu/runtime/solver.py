@@ -115,18 +115,23 @@ SOLVER_EPOCH = _metrics.gauge(
 # --------------------------------------------------------------------------- #
 
 _MAGIC = 0x45564753  # "EVGS"
-_VERSION = 1
+#: version 2: the shape key widened 6 → 8 dims (…, P, C) for the fused
+#: capacity page, which renumbers every header slot after it. A v1
+#: reader attaching to a v2 segment (or vice versa) would misread the
+#: region offsets, so ``attach`` rejects any version mismatch outright —
+#: the affected shard just solves locally until both sides roll.
+_VERSION = 2
 
 #: header slots (uint64 each); the header is a single 256-byte page so
 #: payload regions start 8-aligned
 H_MAGIC, H_VERSION, H_STATE, H_EPOCH, H_SEQ = 0, 1, 2, 3, 4
-H_SHAPE = 5  # 5..10: shape key (N, M, U, G, H, D)
-H_N_F32, H_N_I32, H_N_U8, H_IN_CRC = 11, 12, 13, 14
+H_SHAPE = 5  # 5..12: shape key (N, M, U, G, H, D, P, C)
+H_N_F32, H_N_I32, H_N_U8, H_IN_CRC = 13, 14, 15, 16
 H_OUT_EPOCH, H_OUT_SEQ, H_OUT_N_I32, H_OUT_N_F32, H_OUT_CRC = (
-    15, 16, 17, 18, 19,
+    17, 18, 19, 20, 21,
 )
-H_DECLINE = 20
-H_CAP_F32, H_CAP_I32, H_CAP_U8, H_CAP_OUT = 21, 22, 23, 24
+H_DECLINE = 22
+H_CAP_F32, H_CAP_I32, H_CAP_U8, H_CAP_OUT = 23, 24, 25, 26
 HEADER_SLOTS = 32
 HEADER_BYTES = HEADER_SLOTS * 8
 
@@ -140,7 +145,7 @@ DECLINE_CAUSES = {
     3: "torn-publication",
     4: "leader-abort",
 }
-_DIM_NAMES = ("N", "M", "U", "G", "H", "D")
+_DIM_NAMES = ("N", "M", "U", "G", "H", "D", "P", "C")
 
 
 def segment_name(data_dir: str, shard: int) -> str:
@@ -155,7 +160,11 @@ def segment_name(data_dir: str, shard: int) -> str:
 
 def sizes_for_dims(dims: Dict[str, int]) -> Dict[str, int]:
     """Element totals per arena kind for the canonical FIELD_KINDS
-    layout at ``dims`` (mirrors scheduler.snapshot.arena_for_dims)."""
+    layout at ``dims`` (mirrors scheduler.snapshot.arena_for_dims,
+    including its fixed P/C capacity-page dims when absent)."""
+    from ..scheduler.snapshot import _FIXED_DIMS
+
+    dims = {**_FIXED_DIMS, **dims}
     sizes = {"f32": 0, "i32": 0, "u8": 0}
     for name, kind in FIELD_KINDS.items():
         sizes[kind] += dims[_DIM_OF_FIELD[name[:2]]]
@@ -165,6 +174,9 @@ def sizes_for_dims(dims: Dict[str, int]) -> Dict[str, int]:
 def out_elems_for_dims(dims: Dict[str, int]) -> Tuple[int, int]:
     """(i32 elements, f32 elements) of the packed result block at
     ``dims`` — the OUTPUT_SPEC layout ops/solve.py split_packed uses."""
+    from ..ops.solve import with_output_dims
+
+    dims = with_output_dims(dims)
     n_i32 = sum(dims[d] for _, kind, d in OUTPUT_SPEC if kind == "i32")
     n_f32 = sum(dims[d] for _, kind, d in OUTPUT_SPEC if kind == "f32")
     return n_i32, n_f32
@@ -252,7 +264,8 @@ class Segment:
             return None
         _unregister_from_tracker(name)  # 3.10 registers on attach too
         seg = cls(shm, name, False)
-        if int(seg.hdr[H_MAGIC]) != _MAGIC:
+        if (int(seg.hdr[H_MAGIC]) != _MAGIC
+                or int(seg.hdr[H_VERSION]) != _VERSION):
             seg.close()
             return None
         return seg
@@ -345,7 +358,9 @@ class Segment:
         )
 
     def shape_key(self) -> Tuple[int, ...]:
-        return tuple(int(self.hdr[H_SHAPE + i]) for i in range(6))
+        return tuple(
+            int(self.hdr[H_SHAPE + i]) for i in range(len(_DIM_NAMES))
+        )
 
 
 def input_arrays(seg: Segment, dims: Dict[str, int]) -> Dict[str, np.ndarray]:
@@ -353,6 +368,9 @@ def input_arrays(seg: Segment, dims: Dict[str, int]) -> Dict[str, np.ndarray]:
     regions at ``dims`` — the FIELD_KINDS order fully determines the
     layout (the same contract the sidecar protocol relies on). u8
     fields come back as bool views, matching ``Snapshot.arrays``."""
+    from ..scheduler.snapshot import _FIXED_DIMS
+
+    dims = {**_FIXED_DIMS, **dims}
     sizes = sizes_for_dims(dims)
     regions = {kind: seg.region(kind, n) for kind, n in sizes.items()}
     offs = {"f32": 0, "i32": 0, "u8": 0}
@@ -676,6 +694,9 @@ class SolverClient:
             SOLVER_STALE_ACCEPTED.inc()
             self.stale_accepted += 1
             return None
+        from ..ops.solve import with_output_dims
+
+        dims = with_output_dims(dims)
         i32_half = block[:n_i32]
         f32_half = block[n_i32:].view(np.float32)
         out: Dict[str, np.ndarray] = {}

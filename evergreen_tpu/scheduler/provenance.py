@@ -181,13 +181,17 @@ class CapacityProvenance:
     drawdown pass consumes ``target_hosts`` instead of re-deriving a
     per-distro guess."""
 
-    __slots__ = ("at", "chosen", "fleet", "stale", "_rows")
+    __slots__ = ("at", "chosen", "fleet", "stale", "affinity", "_rows")
 
     def __init__(self, at: float, chosen: str, fleet: Dict,
                  rows: Dict[str, Dict]) -> None:
         self.at = at
         self.chosen = chosen
         self.fleet = fleet
+        #: fused solves only: the rounded task-group→pool placement
+        #: hints ({"pools": {pool: tasks}, "units": U}) — advisory, so
+        #: they live beside the decomposition, never inside it
+        self.affinity = None
         #: set by the capacity plane when a later tick FELL BACK to the
         #: heuristic: the decomposition stays answerable on the admin
         #: surface, but ``target_hosts`` stops steering drawdown — the
@@ -217,6 +221,11 @@ class CapacityProvenance:
 
         rows: Dict[str, Dict] = {}
         for i, did in enumerate(inp.distro_ids):
+            if not inp.elig[i]:
+                # full-row fused instances carry every snapshot row;
+                # only program participants get a decomposition (a
+                # pass-through row's "target" must never steer drawdown)
+                continue
             p = int(inp.pool[i])
             t = float(targets[i])
             binding = []
@@ -269,7 +278,8 @@ class CapacityProvenance:
         # shrunk-vs-heuristic distro gave up (and vice versa)
         for p in range(cap_ops.P_BUCKET):
             members = [
-                i for i in range(inp.n) if int(inp.pool[i]) == p
+                i for i in range(inp.n)
+                if inp.elig[i] and int(inp.pool[i]) == p
             ]
             if len(members) < 2 or pool_use[p] < quota[p] - 1e-9:
                 continue
@@ -293,7 +303,7 @@ class CapacityProvenance:
             "chosen": chosen,
             "budget": int(budget),
             "new_hosts": int(fleet_used),
-            "n_distros": inp.n,
+            "n_distros": int(np.count_nonzero(inp.elig)),
             "pool_use": {
                 cap_ops.pool_name_of(p): int(pool_use[p])
                 for p in range(cap_ops.P_BUCKET)
@@ -320,7 +330,7 @@ class CapacityProvenance:
         return None if row is None else int(row["target"])
 
     def to_doc(self, limit: int = 50) -> Dict:
-        return {
+        doc = {
             "at": self.at,
             "stale": self.stale,
             "fleet": self.fleet,
@@ -329,6 +339,9 @@ class CapacityProvenance:
                 for k in sorted(self._rows)[: max(0, int(limit))]
             ],
         }
+        if self.affinity is not None:
+            doc["affinity"] = self.affinity
+        return doc
 
 
 def capacity_provenance_for(store) -> Optional[CapacityProvenance]:

@@ -309,11 +309,18 @@ class ResidentPlane:
         deps_met: Dict[str, bool],
         now: float,
         arena_pool=None,
+        capacity_page=None,
     ) -> Optional[Snapshot]:
         """Bring the resident columns up to date and publish a Snapshot.
         Returns None when the plane cannot serve this tick (the caller
         then takes the classic full-rebuild path) — the plane never lets
-        an internal error escape into the tick."""
+        an internal error escape into the tick.
+
+        ``capacity_page`` is the tick's fused-capacity input page
+        (scheduler/capacity_plane.py ``build_capacity_page``; None clears
+        it) — a few fixed f32 slots refreshed in place every tick, like
+        the time columns: never a rebuild, and under the device mirror
+        only its dirty spans ship."""
         try:
             from ..utils.tracing import Tracer
 
@@ -383,6 +390,7 @@ class ResidentPlane:
                         running_estimates, deps_met, prime_gen, reason,
                     )
                 self._refresh_time_columns(now)
+                self._set_capacity_page(capacity_page)
                 _apply_span["attributes"]["rebuild_reason"] = reason or ""
             # pack: publish the truth into a leased transfer arena (or
             # ship dirty spans to the device mirror)
@@ -1745,6 +1753,19 @@ class ResidentPlane:
                 "t_time_in_queue_s", "t_wait_dep_met_s", "u_tiq_term",
                 "u_mainline_hours", "u_runtime_term", "h_elapsed_s",
             ):
+                kind, off, size = self._truth._layout[name]
+                self._spans.setdefault(kind, []).append((off, off + size))
+
+    def _set_capacity_page(self, page) -> None:
+        """Refresh (or clear) the fused-capacity page columns in place —
+        a couple dozen f32 slots maintained per tick exactly like the
+        time columns: never a rebuild trigger, and the device mirror
+        ships only these spans."""
+        from .snapshot import pack_capacity_page
+
+        pack_capacity_page(self.cols, page)
+        if self._spans is not None:
+            for name in ("p_price", "p_quota", "c_cfg"):
                 kind, off, size = self._truth._layout[name]
                 self._spans.setdefault(kind, []).append((off, off + size))
 

@@ -25,6 +25,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..globals import (
+    ALIAS_SUFFIX,
     DEFAULT_TASK_DURATION_S,
     MAX_TASK_TIME_IN_QUEUE_S,
     FeedbackRule,
@@ -36,6 +37,7 @@ from ..globals import (
 from ..models.distro import Distro
 from ..models.host import Host
 from ..models.task import Task
+from ..ops.capacity import C_BUCKET, P_BUCKET
 from ..ops.packing import Arena
 from .serial import RunningTaskEstimate
 
@@ -226,6 +228,8 @@ class Snapshot:
             len(a["g_distro"]),
             len(a["h_valid"]),
             len(a["d_valid"]),
+            len(a["p_price"]),
+            len(a["c_cfg"]),
         )
 
 
@@ -328,13 +332,29 @@ FIELD_KINDS: Dict[str, str] = {
     # and its joint-solve opt-in flag ride the packed buffer like every
     # other settings column — the resident plane maintains them through
     # the shared pack_distro_settings fill, and the sharded stacked
-    # round ships them to the device with the rest of the d-matrix
+    # round ships them to the device with the rest of the d-matrix.
+    # d_alias/d_single_task complete the fused program's on-device
+    # eligibility mirror (CapacityPlane.eligible)
     "d_pool": "i32", "d_cap_on": "u8",
+    "d_alias": "u8", "d_single_task": "u8",
+    # capacity page — fixed-width pool vectors [P = P_BUCKET] and the
+    # scalar config page [C = C_BUCKET] (ops/capacity.py C_* slots):
+    # per-shard pre-split prices/quotas plus budget/weights/temperature/
+    # iteration scalars, so the fused solve needs NO host-side capacity
+    # inputs at all. Zero page (c_cfg[C_VALID] == 0) ⇔ no capacity this
+    # tick; the fused block degrades to a shape-preserving no-op.
+    "p_price": "f32", "p_quota": "f32",
+    "c_cfg": "f32",
 }
 
 _DIM_OF_FIELD = {
     "t_": "N", "m_": "M", "u_": "U", "g_": "G", "h_": "H", "d_": "D",
+    "p_": "P", "c_": "C",
 }
+
+#: the fixed dims: P/C never bucket — they are compile-time constants of
+#: the capacity program, identical across every shard and process
+_FIXED_DIMS = {"P": P_BUCKET, "C": C_BUCKET}
 
 
 def arena_for_dims(dims: Dict[str, int], pool=None) -> Arena:
@@ -345,6 +365,7 @@ def arena_for_dims(dims: Dict[str, int], pool=None) -> Arena:
     alone. ``pool`` (an ops.packing.ArenaPool) swaps the fresh allocation
     for one of two rotating zeroed buffer sets — the double-buffered
     transfer arenas of the pipelined tick."""
+    dims = {**_FIXED_DIMS, **dims}
     arena = Arena()
     for name, kind in FIELD_KINDS.items():
         arena.alloc(name, dims[_DIM_OF_FIELD[name[:2]]], kind)
@@ -400,6 +421,28 @@ def pack_distro_settings(a: Dict[str, np.ndarray], distros) -> None:
 
     fill("d_pool", [pool_index_of(d.provider) for d in distros])
     fill("d_cap_on", [p.capacity == "tpu" for p in ps_l])
+    fill("d_alias", [d.id.endswith(ALIAS_SUFFIX) for d in distros])
+    fill(
+        "d_single_task",
+        [bool(getattr(d, "single_task_distro", False)) for d in distros],
+    )
+
+
+def pack_capacity_page(a: Dict[str, np.ndarray], page) -> None:
+    """Write (or clear, ``page=None``) the tick's capacity page into the
+    fixed-width p_/c_ columns. ``page`` is the capacity plane's
+    ``build_capacity_page`` dict — already per-shard split, f32-exact.
+    Shared by the cold snapshot build (scheduler/wrapper.py) and the
+    resident plane's per-tick page refresh so the two fills cannot
+    drift."""
+    if page is None:
+        a["p_price"][:] = 0.0
+        a["p_quota"][:] = 0.0
+        a["c_cfg"][:] = 0.0
+        return
+    a["p_price"][:P_BUCKET] = page["p_price"]
+    a["p_quota"][:P_BUCKET] = page["p_quota"]
+    a["c_cfg"][:C_BUCKET] = page["c_cfg"]
 
 
 #: time-independent per-task columns memcpy'd from the static memo into

@@ -69,8 +69,10 @@ INTENT_BUDGET_CLAMPED = _metrics.counter(
 )
 
 
-#: distro-id suffix marking secondary (alias) queue rows in the solve
-ALIAS_SUFFIX = "::alias"
+#: distro-id suffix marking secondary (alias) queue rows in the solve —
+#: defined in globals (the packer needs it to fill the d_alias column)
+#: and re-exported here for the long tail of existing importers
+from ..globals import ALIAS_SUFFIX  # noqa: E402  (re-export)
 
 #: shared empty task list for distros with no runnable work — a stable
 #: object so the snapshot membership memo sees identity across ticks
@@ -837,6 +839,30 @@ def _run_tick_body(
 
     _rlog = get_logger("resilience")
 
+    # The tick's intent budget, computed BEFORE the solve so (a) the
+    # fused capacity page can ship it to the device and (b) the joint
+    # solve optimizes within exactly the allowance the creation loop
+    # below will enforce — otherwise the first-come-first-served clamp
+    # would mangle the trade the program computed. Nothing between here
+    # and the creation loop mints intents, so the count stays honest.
+    if opts.create_intent_hosts and opts.intent_budget is not None:
+        # fleet-accounted budget from the sharded driver: counting this
+        # store's own intents again would double-charge the shard
+        budget = max(0, int(opts.intent_budget))
+    elif opts.create_intent_hosts:
+        budget = max(
+            0,
+            opts.max_intent_hosts - host_mod.count_intents_in_flight(store),
+        )
+    else:
+        budget = 0  # the 4k-host scan is pure cost when intents are off
+
+    #: extract_fused_view's capture of the packed solve's capacity
+    #: outputs (cap_x / affinity / input columns) — the fused rung of
+    #: the capacity plane's fallback ladder; None on serial/cmp ticks,
+    #: failed solves, or when no capacity page rode the snapshot
+    fused_view = None
+
     # Circuit-broken device path (the reference's planner=tpu → tunable
     # downgrade): a raising or deadline-blowing solve degrades THIS tick
     # to the serial oracle; repeated failures open the breaker so
@@ -859,6 +885,17 @@ def _run_tick_body(
         try:
             t1 = _time.perf_counter()
             dims_memo, memb_memo, arena_pool = _snapshot_memos_for(store)
+            # the fused capacity page: pool economics + budget/knobs as
+            # packed columns, so the capacity program runs INSIDE this
+            # tick's one solve (None keeps the device block a no-op)
+            capacity_page = None
+            if opts.create_intent_hosts:
+                from .capacity_plane import capacity_plane_for
+
+                capacity_page = capacity_plane_for(store).build_capacity_page(
+                    quota_scale=opts.capacity_quota_scale,
+                    intent_budget=budget,
+                )
             if opts.use_resident and opts.use_cache:
                 # device-resident state plane: persistent columns mutated
                 # by the cache's deltas; ANY failure inside falls back to
@@ -869,7 +906,7 @@ def _run_tick_body(
                 snapshot = resident_plane_for(store).sync(
                     tick_cache_for(store), solver_distros, tasks_by_distro,
                     hosts_by_distro, running_estimates, deps_met, now,
-                    arena_pool=arena_pool,
+                    arena_pool=arena_pool, capacity_page=capacity_page,
                 )
             if snapshot is None:
                 # full-rebuild pack (the resident plane packs inside its
@@ -884,6 +921,12 @@ def _run_tick_body(
                         ),
                         memb_memo=memb_memo, arena_pool=arena_pool,
                     )
+                    # page columns are packed post-build (and re-zeroed
+                    # when absent: pool-leased arenas can carry a stale
+                    # page from an earlier tick)
+                    from .snapshot import pack_capacity_page
+
+                    pack_capacity_page(snapshot.arrays, capacity_page)
             t2 = _time.perf_counter()
             # bounded solve (optionally XLA-profiled inside — SURVEY §5:
             # profiler hooks beside the control-plane spans, enabled via
@@ -917,6 +960,12 @@ def _run_tick_body(
                 did: (int(_dpool[i]), bool(_dcap[i]))
                 for i, did in enumerate(snapshot.distro_ids)
             }
+            if capacity_page is not None:
+                # same arena-lifetime rule as capacity_cols: copy the
+                # fused capacity outputs out before the lease returns
+                from .capacity_plane import extract_fused_view
+
+                fused_view = extract_fused_view(snapshot, out)
             planner_used = "tpu"
             breaker.record_success(now=now)
         except Exception as exc:  # noqa: BLE001 — ANY solve-path failure
@@ -939,6 +988,7 @@ def _run_tick_body(
             new_hosts = {}
             provenance = None
             capacity_cols = None
+            fused_view = None
         finally:
             # return the pool-leased transfer arena even when the solve
             # raised (a fault-injected failure must not strand the slot —
@@ -1003,26 +1053,13 @@ def _run_tick_body(
             cap = d.host_allocator_settings.maximum_hosts or demand
             new_hosts[d.id] = max(0, min(demand, cap - existing))
 
-    # The tick's intent budget, computed BEFORE the capacity hook so the
-    # joint solve optimizes within exactly the allowance the creation
-    # loop below will enforce — otherwise the first-come-first-served
-    # clamp would mangle the trade the program computed.
-    if opts.create_intent_hosts and opts.intent_budget is not None:
-        # fleet-accounted budget from the sharded driver: counting this
-        # store's own intents again would double-charge the shard
-        budget = max(0, int(opts.intent_budget))
-    elif opts.create_intent_hosts:
-        budget = max(
-            0,
-            opts.max_intent_hosts - host_mod.count_intents_in_flight(store),
-        )
-    else:
-        budget = 0  # the 4k-host scan is pure cost when intents are off
-
     # Capacity plane: distros opted into the joint (distros × pools)
     # program get their heuristic spawn counts replaced by the batched
-    # device solve's; any failure leaves the heuristic counts untouched
-    # (scheduler/capacity_plane.py owns the breaker + fallback).
+    # device solve's — served straight from the fused view (zero extra
+    # device calls) when this tick's solve carried a capacity page; any
+    # failure leaves the heuristic counts untouched
+    # (scheduler/capacity_plane.py owns the breakers + fallback ladder).
+    # The intent budget itself was computed before the solve, above.
     if opts.create_intent_hosts and new_hosts:
         from .capacity_plane import capacity_plane_for
 
@@ -1032,6 +1069,10 @@ def _run_tick_body(
             quota_scale=opts.capacity_quota_scale,
             intent_budget=budget,
             packed_cols=capacity_cols,
+            # a cmp distro draws from the same budget but is invisible
+            # to the packed solve: the device's reserved-wants mirror
+            # would be wrong, so mixed ticks pin the two-call rung
+            fused=fused_view if not cmp_distros else None,
         )
 
     # Brownout: at RED or worse the ladder sheds the tick's optional

@@ -285,6 +285,22 @@ class CapacityConfig(ConfigSection):
     fleet_intent_budget: int = 0
     #: damped-Newton + projection sweeps on device
     iterations: int = 48
+    #: fused capacity solve (ops/solve.py capacity_affinity): "auto"
+    #: rides the capacity program + pool affinity inside the ONE packed
+    #: planning solve whenever the tick's preconditions hold (packed
+    #: solve succeeded, no cmp-planned distros, fused breaker closed);
+    #: "two_call" still packs the capacity page (the device block runs
+    #: and its outputs are discarded) but serves from the dedicated
+    #: second solve — the A/B knob for the fused-vs-fallback rung
+    #: comparison without sabotage faults; "never" skips the page
+    #: entirely and pins the classic pre-fused pipeline
+    fused: str = "auto"
+    #: initial softmax temperature of the annealed task-group→pool
+    #: affinity block (higher = softer early assignments)
+    affinity_temperature: float = 1.0
+    #: per-iteration temperature decay (clipped to [0.5, 1.0] on
+    #: device; values near 1 anneal slowly)
+    affinity_anneal: float = 0.92
 
     def validate_and_default(self) -> str:
         if self.price_weight < 0 or self.preemption_cost < 0:
@@ -295,6 +311,12 @@ class CapacityConfig(ConfigSection):
             1 <= self.iterations <= 512
         ):
             return "iterations must be an int in [1, 512]"
+        if self.fused not in ("auto", "two_call", "never"):
+            return "fused must be auto/two_call/never"
+        if self.affinity_temperature <= 0:
+            return "affinity_temperature must be > 0"
+        if not 0.5 <= self.affinity_anneal <= 1.0:
+            return "affinity_anneal must be in [0.5, 1.0]"
         for name, d in (("pool_prices", self.pool_prices),
                         ("pool_quotas", self.pool_quotas)):
             if not isinstance(d, dict):

@@ -63,6 +63,7 @@ ALLOWED_LABELS = frozenset(
         "phase",       # tick pipeline phase
         "signal",      # overload monitor gauge name
         "outcome",     # success/failure-ish result buckets
+        "mode",        # execution-path selector (fused/two_call/heuristic)
         "shard",       # scheduler shard id (bounded by the shard count)
         "pool",        # provider capacity pool (fixed Provider vocabulary)
         "replica",     # read-replica id (bounded by the replica fleet)

@@ -15,7 +15,7 @@ namespace evgsolve {
 
 namespace {
 constexpr char kMagic[4] = {'E', 'V', 'G', 'S'};
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 }  // namespace
 
 Client::Client(const std::string& host, uint16_t port)

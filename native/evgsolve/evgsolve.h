@@ -5,11 +5,14 @@
 // receive queue orderings + spawn counts, matching the north-star
 // architecture (SURVEY §7 step 5: Solve(SnapshotTensor) -> queues, spawns).
 //
-// Wire protocol (little-endian), version 1:
-//   request:  "EVGS" | u32 version | 6x u32 shape key (N,M,U,G,H,D)
+// Wire protocol (little-endian), version 2:
+//   request:  "EVGS" | u32 version | 8x u32 shape key (N,M,U,G,H,D,P,C)
 //             | u64 n_f32 | f32[] | u64 n_i32 | i32[] | u64 n_u8 | u8[]
 //   response: u32 status | ok: u64 n_i32, i32[], u64 n_f32, f32[]
 //                        | err: u32 len, msg
+// Version 2 widened the shape key for the fused capacity page: P pool
+// rows (prices/quotas) and C config slots in the f32 arena; the solve
+// additionally returns cap_x[D] + aff_pool[U*P] in the f32 half.
 #ifndef EVGSOLVE_H
 #define EVGSOLVE_H
 
@@ -26,6 +29,8 @@ struct ShapeKey {
   uint32_t n_segments;     // G: distro x task-group segments
   uint32_t n_hosts;        // H
   uint32_t n_distros;      // D
+  uint32_t n_pools;        // P: capacity pool rows (fixed P_BUCKET=8)
+  uint32_t n_cfg;          // C: capacity config slots (fixed C_BUCKET=8)
 };
 
 // Snapshot transfer arenas. Field layout within each arena is the canonical
@@ -44,7 +49,8 @@ struct Snapshot {
 //      d_wait_over[D], d_merge[D], g_count[G], g_count_free[G],
 //      g_count_required[G], g_over_count[G], g_wait_over[G], g_merge[G]
 // f32: t_value[N], t_prio[N], t_rank[N], t_tiq[N], d_expected_dur_s[D],
-//      d_over_dur_s[D], g_expected_dur_s[G], g_over_dur_s[G]
+//      d_over_dur_s[D], g_expected_dur_s[G], g_over_dur_s[G],
+//      cap_x[D], aff_pool[U*P]
 struct SolveResult {
   std::vector<int32_t> i32;
   std::vector<float> f32;

@@ -3,7 +3,7 @@
 //
 // Snapshot dump format (written by tests / tools via
 // evergreen_tpu.api.sidecar dump helpers): the wire request payload without
-// magic/version — 6x u32 shape key, then u64-count-prefixed f32/i32/u8
+// magic/version — 8x u32 shape key, then u64-count-prefixed f32/i32/u8
 // arenas.
 //
 // Usage: evgsolve_cli <host> <port> <snapshot.bin> [repeats]
@@ -65,7 +65,8 @@ int main(int argc, char** argv) {
   const uint64_t want_i32 = 3ull * s.n_tasks + 7ull * s.n_distros +
                             6ull * s.n_segments;
   const uint64_t want_f32 =
-      4ull * s.n_tasks + 2ull * s.n_distros + 2ull * s.n_segments;
+      4ull * s.n_tasks + 3ull * s.n_distros + 2ull * s.n_segments +
+      1ull * s.n_units * s.n_pools;
   if (result.i32.size() != want_i32 || result.f32.size() != want_f32) {
     fprintf(stderr, "unexpected result sizes: i32=%zu (want %llu) f32=%zu (want %llu)\n",
             result.i32.size(), (unsigned long long)want_i32,

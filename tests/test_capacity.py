@@ -741,3 +741,251 @@ def test_snapshot_carries_capacity_columns(store):
     assert int(a["d_pool"][0]) == cap.pool_index_of("mock")
     assert int(a["d_pool"][1]) == cap.pool_index_of("docker")
     assert bool(a["d_cap_on"][0]) and not bool(a["d_cap_on"][1])
+
+
+# --------------------------------------------------------------------------- #
+# fused device program (ISSUE 18): priority + capacity + affinity in ONE
+# solve — the fused rung must be indistinguishable from the two-call path
+# in every integral output, while spending zero extra device calls
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("rng_seed", [0, 1, 2, 3])
+def test_fused_tick_matches_two_call_randomized(rng_seed):
+    # randomized workloads (sizes, quotas, budgets, max-hosts — feasible
+    # and infeasible alike): fused="auto", fused="two_call" and
+    # fused="never" ticks over identical stores must land the same spawn
+    # counts, the
+    # same staleness, and the same per-distro targets — and the fused
+    # tick must actually be served by the fused rung whenever the
+    # two-call tick solved (same ladder depth, never a silent downgrade)
+    import random
+
+    from evergreen_tpu.scheduler.capacity_plane import FUSED_SOLVES
+    from evergreen_tpu.scheduler.provenance import capacity_provenance_for
+
+    rng = random.Random(rng_seed)
+    spec = [(f"d{i}", rng.randint(1, 40)) for i in range(rng.randint(2, 6))]
+    quota = rng.choice([2, 6, 12, 30])
+    max_hosts = rng.choice([3, 8, 50])
+    budget = rng.choice([None, rng.randint(1, 20)])
+    results = {}
+    modes = {}
+    for knob in ("auto", "two_call", "never"):
+        st = Store()
+        seed(st, spec, max_hosts=max_hosts)
+        CapacityConfig(pool_quotas={"mock": quota}, fused=knob).set(st)
+        before = {
+            m: FUSED_SOLVES.value(mode=m)
+            for m in ("fused", "two_call", "heuristic")
+        }
+        opts = (TickOptions() if budget is None
+                else TickOptions(intent_budget=budget))
+        res = run_tick(st, opts, now=NOW)
+        assert res.degraded == ""
+        prov = capacity_provenance_for(st)
+        targets = None
+        if prov is not None and not prov.stale:
+            targets = {d: prov.target_hosts(d) for d, _ in spec}
+        results[knob] = (res.new_hosts, prov is not None and prov.stale,
+                         targets)
+        modes[knob] = {
+            m: FUSED_SOLVES.value(mode=m) - before[m] for m in before
+        }
+    assert results["auto"] == results["never"], (spec, quota, budget)
+    assert results["auto"] == results["two_call"], (spec, quota, budget)
+    # same ladder depth: heuristic ⇔ heuristic, else fused ⇔ two_call
+    assert modes["auto"]["heuristic"] == modes["never"]["heuristic"]
+    assert modes["two_call"]["heuristic"] == modes["never"]["heuristic"]
+    if modes["never"]["two_call"]:
+        assert modes["auto"]["fused"] == 1
+        assert modes["auto"]["two_call"] == 0
+        # the pinned A/B knob packs the page but serves via the
+        # dedicated call — no fused-rung serve, no heuristic downgrade
+        assert modes["two_call"]["fused"] == 0
+        assert modes["two_call"]["two_call"] == 1
+
+
+def test_fused_output_spec_round_trips_solver_segments():
+    # OUTPUT_SPEC round-trip through the runtime/solver.py shm segment
+    # with the widened 8-dim shape key: the capacity page rides the
+    # typed input regions and cap_x / aff_pool ride the packed result
+    # block bit for bit — the layout both the solver-leader and the
+    # sidecar rely on
+    from evergreen_tpu.ops import solve as solve_ops
+    from evergreen_tpu.runtime import solver as rt
+    from evergreen_tpu.scheduler.capacity_plane import CapacityPlane
+    from evergreen_tpu.scheduler.snapshot import (
+        build_snapshot,
+        pack_capacity_page,
+    )
+
+    st = Store()
+    CapacityConfig(pool_quotas={"mock": 8}).set(st)
+    distros = [
+        Distro(id=did, provider=Provider.MOCK.value,
+               planner_settings=PlannerSettings(capacity="tpu"),
+               host_allocator_settings=HostAllocatorSettings(
+                   maximum_hosts=50))
+        for did in ("deep", "shallow")
+    ]
+    tbd = {"deep": make_tasks("deep", 20),
+           "shallow": make_tasks("shallow", 4)}
+    snap = build_snapshot(distros, tbd, {}, {}, {}, NOW)
+    page = CapacityPlane(st).build_capacity_page(intent_budget=8)
+    assert page is not None
+    pack_capacity_page(snap.arrays, page)
+    out = solve_ops.run_solve_packed(snap)
+    assert "cap_x" in out and "aff_pool" in out
+
+    key = snap.shape_key()
+    assert len(key) == 8 and key[6:] == (cap.P_BUCKET, 8)
+    dims = dict(zip(rt._DIM_NAMES, key))
+    n_i32, n_f32 = rt.out_elems_for_dims(dims)
+    seg = rt.Segment.create(
+        "evg-test-fused-rt", rt.sizes_for_dims(dims), n_i32 + n_f32
+    )
+    try:
+        # worker publish: typed input regions + the 8-dim header key
+        bufs = snap.arena.buffers
+        for kind in ("f32", "i32", "u8"):
+            np.copyto(seg.region(kind, len(bufs[kind])), bufs[kind])
+        for i, v in enumerate(key):
+            seg.hdr[rt.H_SHAPE + i] = v
+        assert seg.shape_key() == key
+        # leader side: named arrays reconstructed from the regions must
+        # carry the capacity page through the hop
+        arrays = rt.input_arrays(seg, dims)
+        for name in ("p_price", "p_quota", "c_cfg", "d_alias",
+                     "d_single_task"):
+            np.testing.assert_array_equal(arrays[name], snap.arrays[name])
+        # leader result write: the split_packed i32/f32 halves
+        block = np.concatenate(
+            [np.ascontiguousarray(out[n], np.int32)
+             for n, k, _ in solve_ops.OUTPUT_SPEC if k == "i32"]
+            + [np.ascontiguousarray(out[n], np.float32).view(np.int32)
+               for n, k, _ in solve_ops.OUTPUT_SPEC if k == "f32"]
+        )
+        assert block.size == n_i32 + n_f32
+        np.copyto(seg.out_region(block.size), block)
+        # worker read-back through the same OUTPUT_SPEC walk the
+        # solver client and sidecar use
+        odims = solve_ops.with_output_dims(
+            {k: dims[k] for k in ("N", "U", "G", "D")}
+        )
+        raw = np.array(seg.out_region(n_i32 + n_f32), copy=True)
+        halves = dict(zip(
+            ("i32", "f32"), solve_ops.split_packed(raw, odims)
+        ))
+        offs = {"i32": 0, "f32": 0}
+        got = {}
+        for name, kind, dim in solve_ops.OUTPUT_SPEC:
+            size = odims[dim]
+            got[name] = halves[kind][offs[kind]: offs[kind] + size]
+            offs[kind] += size
+        assert got["aff_pool"].size == key[2] * cap.P_BUCKET
+        np.testing.assert_array_equal(
+            got["cap_x"], np.asarray(out["cap_x"], np.float32))
+        np.testing.assert_array_equal(
+            got["aff_pool"], np.asarray(out["aff_pool"], np.float32))
+    finally:
+        seg.unlink()
+        seg.close()
+
+
+def test_fused_tick_provenance_carries_affinity(store):
+    # a fused-served tick attaches the task-group→pool affinity summary
+    # to the capacity provenance, and explain_capacity still decomposes
+    # the decision from the fused outputs
+    from evergreen_tpu.scheduler.capacity_plane import FUSED_SOLVES
+    from evergreen_tpu.scheduler.provenance import (
+        capacity_provenance_for,
+        explain_capacity,
+    )
+
+    seed(store, [("deep", 30), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 8}).set(store)
+    before = FUSED_SOLVES.value(mode="fused")
+    res = run_tick(store, TickOptions(), now=NOW)
+    assert res.degraded == ""
+    assert FUSED_SOLVES.value(mode="fused") == before + 1
+    prov = capacity_provenance_for(store)
+    assert prov is not None and not prov.stale
+    assert prov.affinity is not None
+    assert prov.affinity["units"] > 0
+    assert set(prov.affinity["pools"]) == {"mock"}
+    assert (sum(prov.affinity["pools"].values())
+            >= prov.affinity["units"])
+    assert prov.to_doc()["affinity"] == prov.affinity
+    doc = explain_capacity(store, "deep")
+    assert doc is not None
+    assert doc["target"] == doc["existing"] + doc["intents"]
+    assert {"demand_term", "price_term", "churn_term"} <= set(doc)
+
+
+def test_degraded_tick_serves_no_fused_solve(store):
+    # a degraded planning tick skips capacity entirely — the fused rung
+    # must not fire either (its inputs would be the same stale snapshot)
+    from evergreen_tpu.scheduler.capacity_plane import FUSED_SOLVES
+    from evergreen_tpu.utils import faults
+
+    seed(store, [("deep", 10)])
+    CapacityConfig(pool_quotas={"mock": 2}).set(store)
+    before = {m: FUSED_SOLVES.value(mode=m)
+              for m in ("fused", "two_call", "heuristic")}
+    faults.install(
+        faults.FaultPlan().always("scheduler.solve", faults.Fault("raise"))
+    )
+    try:
+        res = run_tick(store, TickOptions(), now=NOW)
+    finally:
+        faults.uninstall()
+    assert res.degraded == "solve-failed"
+    assert FUSED_SOLVES.value(mode="fused") == before["fused"]
+    assert FUSED_SOLVES.value(mode="two_call") == before["two_call"]
+    assert FUSED_SOLVES.value(mode="heuristic") == before["heuristic"] + 1
+
+
+def test_fused_sabotage_degrades_to_two_call_not_heuristic(store):
+    # the fused rung has its OWN breaker: sabotaging "capacity.fused"
+    # drops the tick to the two-call rung (quota still applied, same
+    # counts as a fused="never" fleet), never to the heuristic — and
+    # after the threshold the fused breaker opens while the whole-plane
+    # breaker stays closed
+    from evergreen_tpu.scheduler.capacity_plane import (
+        FUSED_SOLVES,
+        capacity_plane_for,
+    )
+    from evergreen_tpu.utils import faults
+
+    ref_store = Store()
+    seed(ref_store, [("deep", 24), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 6}, fused="never").set(ref_store)
+    ref = run_tick(ref_store, TickOptions(), now=NOW)
+
+    seed(store, [("deep", 24), ("shallow", 3)])
+    CapacityConfig(pool_quotas={"mock": 6}).set(store)
+    faults.install(
+        faults.FaultPlan().always("capacity.fused", faults.Fault("raise"))
+    )
+    try:
+        before_tc = FUSED_SOLVES.value(mode="two_call")
+        res = run_tick(store, TickOptions(), now=NOW)
+        assert res.new_hosts == ref.new_hosts
+        assert FUSED_SOLVES.value(mode="two_call") == before_tc + 1
+        plane = capacity_plane_for(store)
+        assert plane.breaker.state != "open"
+        for k in range(2):
+            run_tick(store, TickOptions(), now=NOW + 15 * (k + 1))
+        assert plane.fused_breaker.state == "open"
+    finally:
+        faults.uninstall()
+    # breaker open: the fused rung is skipped WITHOUT the fault seam —
+    # the tick still solves (two-call), it does not degrade further
+    before = {m: FUSED_SOLVES.value(mode=m)
+              for m in ("fused", "two_call", "heuristic")}
+    res2 = run_tick(store, TickOptions(), now=NOW + 45)
+    assert sum(res2.new_hosts.values()) <= 6
+    assert FUSED_SOLVES.value(mode="fused") == before["fused"]
+    assert FUSED_SOLVES.value(mode="two_call") == before["two_call"] + 1
+    assert FUSED_SOLVES.value(mode="heuristic") == before["heuristic"]

@@ -152,6 +152,55 @@ def test_resident_matches_rebuild_fuzz(store, seed):
     assert plane.fallbacks == 0
 
 
+def test_capacity_page_rides_delta_syncs(store):
+    # ISSUE 18: the fused-capacity input page (p_price / p_quota /
+    # c_cfg) is refreshed in place on EVERY sync like the time columns —
+    # a changed quota or budget between ticks must never force a rebuild,
+    # and clearing the page (capacity off) zeroes the valid bit in place
+    from evergreen_tpu.ops import capacity as cap
+    from evergreen_tpu.scheduler.capacity_plane import CapacityPlane
+    from evergreen_tpu.settings import CapacityConfig
+
+    _seed(store)
+    CapacityConfig(pool_quotas={"mock": 9}).set(store)
+    cp = CapacityPlane(store)
+    cache = TickCache(store)
+    plane = ResidentPlane(store)
+    mock = cap.pool_index_of("mock")
+
+    def _sync_with_page(now, page):
+        distros, tbd, hbd, est, dm = cache.gather(now)
+        return plane.sync(cache, distros, tbd, hbd, est, dm, now,
+                          capacity_page=page)
+
+    snap = _sync_with_page(NOW, cp.build_capacity_page(intent_budget=5))
+    assert snap is not None
+    a = snap.arrays
+    assert float(a["c_cfg"][cap.C_VALID]) == 1.0
+    assert float(a["c_cfg"][cap.C_BUDGET_BASE]) == 5.0
+    assert float(a["p_quota"][mock]) == 9.0
+
+    # quota + budget change between ticks, plus ordinary task churn:
+    # the page must follow through the DELTA path, not a rebuild
+    CapacityConfig(pool_quotas={"mock": 4}).set(store)
+    coll = task_mod.coll(store)
+    tid = next(iter(t["_id"] for t in coll.find()))
+    coll.update(tid, {"priority": 55})
+    snap = _sync_with_page(NOW + 15, cp.build_capacity_page(intent_budget=2))
+    assert snap is not None
+    a = snap.arrays
+    assert float(a["p_quota"][mock]) == 4.0
+    assert float(a["c_cfg"][cap.C_BUDGET_BASE]) == 2.0
+    assert plane.rebuilds == 1, plane.stats()  # the cold prime only
+
+    # page cleared (no capacity this tick): valid bit drops in place
+    snap = _sync_with_page(NOW + 30, None)
+    assert snap is not None
+    assert float(snap.arrays["c_cfg"][cap.C_VALID]) == 0.0
+    assert float(snap.arrays["p_quota"][mock]) == 0.0
+    assert plane.rebuilds == 1, plane.stats()
+
+
 def test_epoch_change_forces_counted_rebuild(store):
     _seed(store)
     cache = TickCache(store)

@@ -24,8 +24,10 @@ def small_snapshot():
 
 
 def unpack_result(snapshot, i32_buf, f32_buf):
-    N, _, _, G, _, D = snapshot.shape_key()
-    dims = {"N": N, "G": G, "D": D}
+    from evergreen_tpu.ops.solve import with_output_dims
+
+    N, _, U, G, _, D, P, C = snapshot.shape_key()
+    dims = with_output_dims({"N": N, "U": U, "G": G, "D": D})
     out, offs = {}, {"i32": 0, "f32": 0}
     bufs = {"i32": i32_buf, "f32": f32_buf}
     for name, kind, dim in OUTPUT_SPEC:
@@ -64,7 +66,7 @@ def test_sidecar_python_client_matches_local_solve(store):
 
 def dump_snapshot(snapshot, path):
     with open(path, "wb") as f:
-        f.write(struct.pack("<6I", *snapshot.shape_key()))
+        f.write(struct.pack("<8I", *snapshot.shape_key()))
         for kind, dtype in (("f32", "<f4"), ("i32", "<i4"), ("u8", "u1")):
             arr = np.ascontiguousarray(snapshot.arena.buffers[kind])
             f.write(struct.pack("<Q", arr.shape[0]))

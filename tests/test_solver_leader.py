@@ -24,7 +24,7 @@ from evergreen_tpu.runtime.solver import (
 from evergreen_tpu.scheduler.snapshot import FIELD_KINDS
 from evergreen_tpu.utils.benchgen import NOW, generate_problem
 
-_DIMS = ("N", "M", "U", "G", "H", "D")
+_DIMS = ("N", "M", "U", "G", "H", "D", "P", "C")
 
 
 def _shard_snapshots(n_shards, seed=41, n_distros=None, n_tasks=400):

@@ -53,11 +53,12 @@ PARITY_TASKS = 2400
 PARITY_TICKS = 2
 
 
-def _parity_seed(store):
+def _parity_seed(store, capacity=False):
     """Deterministic fleet with alias coupling: even/odd distro pairs
     share tasks through secondary queues, so placement affinity is
     exercised (coupled distros must co-locate or the alias queue would
-    lose its rows)."""
+    lose its rows). ``capacity=True`` opts every distro into the joint
+    capacity program (the fused round)."""
     import dataclasses
 
     from evergreen_tpu.models import distro as distro_mod
@@ -69,6 +70,9 @@ def _parity_seed(store):
         PARITY_DISTROS, PARITY_TASKS, seed=11, task_group_fraction=0.3,
         hosts_per_distro=3,
     )
+    if capacity:
+        for d in distros:
+            d.planner_settings.capacity = "tpu"
     for di in range(0, len(distros) - 1, 2):
         src, dst = distros[di].id, distros[di + 1].id
         ts = tbd[src]
@@ -166,12 +170,98 @@ def run_parity(shard_counts=(2, 4, 8)) -> int:
                     print(f"# diverged queues: {diff}", file=sys.stderr)
             finally:
                 plane.close()
+    failures += run_fused_round()
     print(json.dumps({
         "shard_parity_failures": failures,
         "shard_counts": list(shard_counts),
         "n_devices": n_dev,
     }))
     return 1 if failures else 0
+
+
+def run_fused_round(shards=2) -> int:
+    """Fused-mode stacked round (PR 18): two identical sharded fleets
+    with the capacity plane ON — one serving from the fused view (the
+    capacity program inside the one stacked solve), one pinned to the
+    classic two-call rung — must agree queue-for-queue after ticking
+    with intent creation live. The fused fleet must actually be served
+    by the fused rung (counter delta = shards × ticks) while
+    scheduler_capacity_solves_total stays flat — the saved device call,
+    asserted fleet-wide."""
+    import jax
+
+    from evergreen_tpu.scheduler import capacity_plane as cp
+    from evergreen_tpu.scheduler.sharded_plane import (
+        ShardedScheduler,
+        merge_fleet_state,
+    )
+    from evergreen_tpu.scheduler.wrapper import TickOptions
+    from evergreen_tpu.settings import CapacityConfig
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import NOW
+
+    n_dev = len(jax.devices())
+    stacked = "always" if n_dev >= shards else "never"
+    opts = TickOptions(create_intent_hosts=True, use_cache=True,
+                       underwater_unschedule=False)
+    failures = 0
+    queues = {}
+    counters = {}
+    for label, fused_knob in (("fused", "auto"), ("two_call", "never")):
+        source = Store()
+        _parity_seed(source, capacity=True)
+        plane = ShardedScheduler.build(
+            shards, tick_opts=opts, rebalance_enabled=False,
+            stacked=stacked,
+        )
+        try:
+            plane.seed_partition(source)
+            for st in plane.stores:
+                CapacityConfig(
+                    pool_quotas={"mock": 30}, fused=fused_knob
+                ).set(st)
+            cap0 = cp.CAPACITY_SOLVES.total()
+            fused0 = cp.FUSED_SOLVES.value(mode="fused")
+            modes_seen = []
+            for i in range(PARITY_TICKS):
+                r = plane.tick(now=NOW + 15.0 * i)
+                modes_seen.append(r.solve_mode)
+                if r.degraded:
+                    failures += 1
+                    print(json.dumps({
+                        "round": "fused", "mode": label,
+                        "error": f"degraded: {r.degraded}",
+                    }))
+            queues[label] = _canonical_queues(
+                merge_fleet_state(plane.stores)
+            )
+            counters[label] = {
+                "capacity_solves_delta":
+                    cp.CAPACITY_SOLVES.total() - cap0,
+                "fused_delta":
+                    cp.FUSED_SOLVES.value(mode="fused") - fused0,
+                "solve_modes": modes_seen,
+            }
+        finally:
+            plane.close()
+    ok = queues["fused"] == queues["two_call"]
+    served_fused = counters["fused"]["fused_delta"] >= shards * PARITY_TICKS
+    saved_calls = counters["fused"]["capacity_solves_delta"] == 0
+    record = {
+        "round": "fused",
+        "shards": shards,
+        "stacked": stacked,
+        "queue_parity": ok,
+        "fused_served_all_ticks": served_fused,
+        "capacity_solves_flat": saved_calls,
+        "counters": {
+            k: {kk: vv for kk, vv in v.items() if kk != "solve_modes"}
+            for k, v in counters.items()
+        },
+        "ok": ok and served_fused and saved_calls,
+    }
+    print(json.dumps(record))
+    return 0 if record["ok"] else failures + 1
 
 
 def main() -> int:

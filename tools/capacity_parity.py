@@ -13,7 +13,17 @@
      exactly and gives the deep queue the larger share;
   4. **safe to lose** — a fault-injected capacity solve falls the tick
      back to BIT-IDENTICAL per-distro heuristic behavior, and repeated
-     failures open the breaker.
+     failures open the breaker;
+  5. **fused ≡ two-call** (PR 18) — the capacity program fused into the
+     packed planning solve produces IDENTICAL integral targets and
+     rounded allocations as the separate two-call device program at the
+     same padded shape (the relaxations agree to float ulps: the
+     instances are bit-identical — one Newton step matches exactly —
+     but XLA fuses the iterated loop body differently inside the
+     larger program); a fused-rung sabotage falls the tick to the
+     two-call rung with bit-identical spawn counts; and fused ticks
+     never move ``scheduler_capacity_solves_total`` (the saved device
+     call, asserted via the counter staying flat).
 
 Wired as ``make capacity-parity`` and ``tools/gate.py
 --capacity-parity``. Exits non-zero on any failure; prints one JSON
@@ -364,18 +374,155 @@ def run_breaker_fallback() -> None:
     )
 
 
+# --------------------------------------------------------------------------- #
+# 5. fused ≡ two-call bit parity
+# --------------------------------------------------------------------------- #
+
+
+def run_fused_bit_parity() -> dict:
+    """Device-vs-host relaxation parity at the SAME padded shape: the
+    packed solve's ``cap_x`` column must equal ``run_capacity_solve``
+    over the full-row instance rebuilt from the fused view, bit for bit
+    in f32 — and so must the rounded targets either way."""
+    from evergreen_tpu.ops import capacity as cap
+    from evergreen_tpu.ops.solve import run_solve_packed
+    from evergreen_tpu.scheduler.capacity_plane import (
+        CapacityPlane,
+        build_fused_inputs,
+        extract_fused_view,
+    )
+    from evergreen_tpu.scheduler.snapshot import (
+        build_snapshot,
+        pack_capacity_page,
+    )
+    from evergreen_tpu.settings import CapacityConfig
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+    distros, tbd, hbd, est, dm = generate_problem(
+        40, 2_000, seed=7, hosts_per_distro=4
+    )
+    for d in distros:
+        d.planner_settings.capacity = "tpu"
+    snapshot = build_snapshot(distros, tbd, hbd, est, dm, NOW)
+    store = Store()
+    CapacityConfig(pool_quotas={"mock": 60}).set(store)
+    page = CapacityPlane(store).build_capacity_page(intent_budget=500)
+    pack_capacity_page(snapshot.arrays, page)
+    out = run_solve_packed(snapshot)
+    view = extract_fused_view(snapshot, out)
+    check(view is not None, "fused parity: view extracted from the solve")
+    inp = build_fused_inputs(view)
+    check(bool(inp.elig.any()), "fused parity: instance has eligible rows")
+    x_host = np.asarray(
+        cap.run_capacity_solve(inp, d_pad=view["d_pad"]), np.float32
+    )
+    x_dev = np.asarray(view["cap_x"][: inp.n], np.float32)
+    # the instance bits are identical (a single Newton step matches
+    # exactly); across iterations XLA may contract/fuse the loop body
+    # differently inside the larger fused program, so the relaxation is
+    # pinned to float-ulp agreement while the INTEGRAL artifacts below
+    # — the actual contract — must be identical
+    max_dx = float(np.abs(x_host - x_dev).max())
+    check(
+        max_dx <= 1e-5,
+        f"fused parity: relaxations agree to float ulps "
+        f"(max |Δ| {max_dx:.3e} ≤ 1e-5)",
+    )
+    t_fused, _, _ = cap.solve_capacity_from_x(inp, view["cap_x"])
+    t_two, _, _ = cap.solve_capacity(inp, d_pad=view["d_pad"])
+    check(
+        np.array_equal(t_fused, t_two),
+        "fused parity: rounded targets and allocations identical",
+    )
+    rounded = cap.round_affinity(view["aff_pool"], view["unit_counts"])
+    check(
+        bool((rounded.sum(axis=1) == view["unit_counts"]).all()),
+        "fused parity: affinity rounding conserves per-unit task counts",
+    )
+    return {
+        "n_distros": int(inp.n),
+        "n_elig": int(inp.elig.sum()),
+        "targets_total": int(t_fused.sum()),
+        "max_relaxation_delta": max_dx,
+    }
+
+
+def run_fused_tick_parity() -> None:
+    """Full-tick ladder parity: a fused tick and a fused-sabotaged tick
+    (two-call rung) on identically seeded stores produce bit-identical
+    spawn counts; fused ticks leave scheduler_capacity_solves_total
+    flat while scheduler_fused_solves_total{mode="fused"} counts."""
+    from evergreen_tpu.scheduler import capacity_plane as cp
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.settings import CapacityConfig
+    from evergreen_tpu.utils import faults
+
+    s_fused, now = _seed_capacity_store(capacity_on=True)
+    CapacityConfig(pool_quotas={"mock": 12}).set(s_fused)
+    cap0 = cp.CAPACITY_SOLVES.total()
+    f0 = cp.FUSED_SOLVES.value(mode="fused")
+    r_fused = run_tick(s_fused, TickOptions(), now=now)
+    check(
+        cp.CAPACITY_SOLVES.total() == cap0,
+        "fused tick: scheduler_capacity_solves_total stayed flat "
+        "(exactly one device call this tick)",
+    )
+    check(
+        cp.FUSED_SOLVES.value(mode="fused") == f0 + 1,
+        "fused tick: served by the fused rung",
+    )
+    prov = getattr(s_fused, "_last_capacity", None)
+    check(
+        prov is not None and prov.affinity is not None,
+        "fused tick: affinity hints attached to provenance",
+    )
+
+    # sabotage ONLY the fused rung: the tick must fall to the two-call
+    # rung (same full-row instance, same padded D) bit-identically
+    s_two, _ = _seed_capacity_store(capacity_on=True)
+    CapacityConfig(pool_quotas={"mock": 12}).set(s_two)
+    faults.install(
+        faults.FaultPlan().always("capacity.fused", faults.Fault("raise"))
+    )
+    try:
+        t0 = cp.FUSED_SOLVES.value(mode="two_call")
+        r_two = run_tick(s_two, TickOptions(), now=now)
+        check(
+            cp.FUSED_SOLVES.value(mode="two_call") == t0 + 1,
+            "fused fallback: served by the two-call rung",
+        )
+    finally:
+        faults.uninstall()
+    check(
+        r_fused.new_hosts == r_two.new_hosts,
+        f"fused fallback: bit-identical spawn counts "
+        f"({r_fused.new_hosts} == {r_two.new_hosts})",
+    )
+    pf = getattr(s_fused, "_last_capacity", None)
+    pt = getattr(s_two, "_last_capacity", None)
+    same_targets = pf is not None and pt is not None and all(
+        pf.target_hosts(d) == pt.target_hosts(d)
+        for d in ("deep", "mid", "shallow")
+    )
+    check(same_targets, "fused fallback: identical adopted targets")
+
+
 def main() -> int:
     t0 = time.perf_counter()
     run_fuzz()
     bench = run_bench_workload()
     trading = run_trading()
     run_breaker_fallback()
+    fused = run_fused_bit_parity()
+    run_fused_tick_parity()
     summary = {
         "metric": "capacity_parity",
         "ok": not FAILURES,
         "failures": FAILURES,
         "bench": bench,
         "trading": trading,
+        "fused": fused,
         "total_s": round(time.perf_counter() - t0, 1),
     }
     print(json.dumps(summary))

@@ -217,6 +217,7 @@ def run_guard() -> dict:
         c - sn - so for c, sn, so in zip(churn, snap_ms, solve_ms)
     )
     shard = run_sharded_guard(distros, tbd, hbd)
+    fused = run_fused_guard()
     # read-serving plane (ISSUE 11): replica lag, the fingerprint-ETag
     # 304 hit-rate, and the long-poll dispatch soaks at 1k/10k agents —
     # the SAME measurement bench.py publishes (tools/read_parity.py)
@@ -225,6 +226,7 @@ def run_guard() -> dict:
     read_path = measure_read_path()
     return {
         **shard,
+        **fused,
         "read_path": read_path,
         "steady_tick_notrace_ms": round(steady_off_best, 2),
         "steady_tick_trace_ms": round(min(steady_on), 2),
@@ -332,6 +334,88 @@ def run_sharded_guard(distros, tbd, hbd) -> dict:
         plane.close()
 
 
+#: fused-capacity arm (ISSUE 18): measured capacity ticks per mode, and
+#: the paired-slack bound — the fused tick replaces a two-call tick on
+#: the same box in the same run, so it may cost at most this fraction
+#: more (pure timer noise headroom; the whole point is that it saves a
+#: device round trip, which CPU wall-clock undersells)
+FUSED_TICKS = 4
+FUSED_SLACK_FRAC = 0.20
+
+
+def run_fused_guard() -> dict:
+    """Fused-capacity arm (ISSUE 18): identical capacity-enabled fleets
+    ticked with the capacity targets served from the packed solve
+    (``fused="auto"``) vs the two-call rung (``fused="two_call"`` — the
+    SAME device program, answered by the dedicated second capacity
+    call). The guard pins BOTH halves of the claim: the fused tick
+    actually skips the second device call
+    (``scheduler_capacity_solves_total`` flat while the fused counter
+    advances every tick), and it does not cost more wall-clock than the
+    two-call tick it replaces — the saved call is the whole delta."""
+    from evergreen_tpu.models import distro as distro_mod
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.scheduler import capacity_plane as cp
+    from evergreen_tpu.scheduler.wrapper import TickOptions, run_tick
+    from evergreen_tpu.settings import CapacityConfig
+    from evergreen_tpu.storage.store import Store
+    from evergreen_tpu.utils.benchgen import NOW, generate_problem
+
+    opts = TickOptions(use_cache=True, underwater_unschedule=False)
+
+    def measure(knob: str) -> dict:
+        distros, tbd, hbd, _, _ = generate_problem(
+            40, 6000, seed=5, task_group_fraction=0.3,
+            hosts_per_distro=4,
+        )
+        store = Store()
+        for d in distros:
+            d.planner_settings.capacity = "tpu"
+            distro_mod.insert(store, d)
+        task_mod.insert_many(
+            store, [t for ts in tbd.values() for t in ts]
+        )
+        for hs in hbd.values():
+            host_mod.insert_many(store, hs)
+        CapacityConfig(
+            pool_quotas={"mock": 300}, fleet_intent_budget=120,
+            fused=knob,
+        ).set(store)
+        run_tick(store, opts, now=NOW)  # warm: compile + cache prime
+        run_tick(store, opts, now=NOW + 0.01)
+        cap0 = cp.CAPACITY_SOLVES.total()
+        fused0 = cp.FUSED_SOLVES.value(mode="fused")
+        times = []
+        for k in range(FUSED_TICKS):
+            t1 = time.perf_counter()
+            run_tick(store, opts, now=NOW + 15.0 * (k + 1))
+            times.append((time.perf_counter() - t1) * 1e3)
+        return {
+            "tick_ms": round(min(times), 2),
+            "capacity_solves_delta": cp.CAPACITY_SOLVES.total() - cap0,
+            "fused_delta": cp.FUSED_SOLVES.value(mode="fused") - fused0,
+        }
+
+    two = measure("two_call")
+    fus = measure("auto")
+    if fus["tick_ms"] > two["tick_ms"] * (1.0 + FUSED_SLACK_FRAC):
+        # one paired re-measure before the verdict: a shared box's
+        # background spike landing in the fused arm is the flake source
+        two2, fus2 = measure("two_call"), measure("auto")
+        if fus2["tick_ms"] / max(two2["tick_ms"], 1e-9) < (
+            fus["tick_ms"] / max(two["tick_ms"], 1e-9)
+        ):
+            two, fus = two2, fus2
+    return {
+        "fused_tick_ms": fus["tick_ms"],
+        "two_call_tick_ms": two["tick_ms"],
+        "fused_capacity_solves_delta": fus["capacity_solves_delta"],
+        "fused_served_ticks": fus["fused_delta"],
+        "two_call_capacity_solves_delta": two["capacity_solves_delta"],
+    }
+
+
 def evaluate(result: dict, floor: dict) -> list:
     """Returns a list of failure strings (empty = pass)."""
     failures = []
@@ -395,6 +479,40 @@ def evaluate(result: dict, floor: dict) -> list:
                 f"{eff_min} — each shard's resident cadence must hide "
                 "pack behind its in-flight solve"
             )
+    # fused capacity (ISSUE 18): the fused rung must SAVE the second
+    # device call — counter-asserted, machine-independent — and the
+    # fused tick must not cost more than the two-call tick it replaces
+    if result.get("fused_tick_ms") is not None:
+        if result.get("fused_capacity_solves_delta", 1) != 0:
+            failures.append(
+                "fused ticks still paid "
+                f"{result['fused_capacity_solves_delta']} dedicated "
+                "capacity device calls — scheduler_capacity_solves_total "
+                "must stay flat while the fused rung serves"
+            )
+        if result.get("fused_served_ticks", 0) < FUSED_TICKS:
+            failures.append(
+                f"only {result.get('fused_served_ticks', 0)}/"
+                f"{FUSED_TICKS} measured ticks were served by the fused "
+                "rung — the arm measured a fallback, not the fused path"
+            )
+        limit = result["two_call_tick_ms"] * (1.0 + FUSED_SLACK_FRAC)
+        if result["fused_tick_ms"] > limit:
+            failures.append(
+                f"fused capacity tick {result['fused_tick_ms']}ms > "
+                f"two-call tick {result['two_call_tick_ms']}ms "
+                f"+{FUSED_SLACK_FRAC:.0%} slack (limit {limit:.1f}ms) — "
+                "fusing the capacity solve must not cost wall-clock"
+            )
+        floor_fused = floor.get("fused_tick_ms")
+        if floor_fused is not None and result["fused_tick_ms"] > (
+            floor_fused * (1.0 + REGRESS_FRAC)
+        ):
+            failures.append(
+                f"fused capacity tick {result['fused_tick_ms']}ms "
+                f"regressed >{int(REGRESS_FRAC * 100)}% over the "
+                f"checked-in floor {floor_fused}ms"
+            )
     # read-serving plane (ISSUE 11): the 304 hit-rate and the 10k-agent
     # dispatch p99 are machine-independent acceptance bounds; the
     # 1k-agent p99 additionally holds a machine-relative floor so a
@@ -448,6 +566,8 @@ def main() -> int:
                 prev = json.load(fh)
         prev["churn_store_ms"] = result["churn_store_ms"]
         prev["shard_churn_ms"] = result["shard_churn_max_ms"]
+        if result.get("fused_tick_ms") is not None:
+            prev["fused_tick_ms"] = result["fused_tick_ms"]
         p99_1k = result.get("read_path", {}).get("dispatch_p99_1k_ms")
         if p99_1k is not None:
             prev["dispatch_p99_ms"] = p99_1k
