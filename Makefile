@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime fuzz fuzz-soak
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix disk-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime fuzz fuzz-soak soak
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -42,6 +42,19 @@ perf-guard:
 # epochs, plus the two-process SIGSTOP-steal-SIGCONT failover case
 crash-matrix:
 	python tools/crash_matrix.py
+
+# disk-fault matrix (gate-blocking via tools/gate.py --disk-matrix):
+# the crash matrix's sibling — the process LIVES while the disk rots
+# under it. Fault seams (WAL append/commit, snapshot publish) x kinds
+# (ENOSPC, EIO, torn, short, bitrot) x store configs (classic,
+# durable+lease, 2-shard fleet), the same seams driven through the
+# scenario engine's disk_fault weathers, bespoke cases (unstamped-WAL
+# upgrade compat, manifest/lease rot, replica read-repair), and fuzzer
+# disk_fault reachability. Every point must detect, quarantine with a
+# forensic .corrupt-<ts> copy, self-heal while serving, and hold
+# resume == rerun with zero corrupt frames applied.
+disk-matrix:
+	env JAX_PLATFORMS=cpu python tools/disk_matrix.py
 
 # storm-soak matrix (fast; tier-1 runs the same cases via
 # tests/test_overload.py): seeded task-churn / event / API / slow-store
@@ -126,6 +139,15 @@ fuzz-soak:
 	env JAX_PLATFORMS=cpu python tools/fuzz_matrix.py \
 	  --budget 300 --proc-budget 120 \
 	  --start-seed $$(date +%s)
+
+# always-on soak (not gate-blocking; findings are the point):
+# SOAK_MINUTES (default 10) of fresh-seed weather fuzzing — sabotage
+# self-test first, then the budget split between the in-process arm
+# and the supervised 2-shard child-process arm, disk_fault weathers
+# included — with the FUZZCARD diffed against green. docs/DEPLOY.md
+# documents the N-hour deployment invocation.
+soak:
+	env JAX_PLATFORMS=cpu python tools/soak.py
 
 # N-process sharded-plane churn throughput vs the single-shard plane
 bench-sharded-plane:

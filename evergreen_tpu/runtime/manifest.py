@@ -29,6 +29,8 @@ import socket
 import tempfile
 from typing import Dict, Optional
 
+from ..storage import integrity as _integrity
+
 #: subdirectory of the data dir holding one entry file per shard
 FLEET_DIR = "fleet"
 
@@ -59,12 +61,16 @@ def write_entry(data_dir: str, shard: int, *, pid: int, sock: str,
     the worker's solver-leader shared-memory segment (runtime/solver.py)
     so the leader can attach it and a successor supervisor can reap it
     if this pid dies — every segment in existence is manifest-registered
-    or about to be."""
+    or about to be.
+
+    Routed through the shared checksummed writer: the entry carries a
+    ``"k"`` CRC (read_entry rejects bitrot instead of adopting garbage)
+    and an injected ENOSPC at the ``manifest.write`` seam unlinks the
+    tmp instead of stranding it beside a truncated record."""
     os.makedirs(fleet_dir(data_dir), exist_ok=True)
-    path = entry_path(data_dir, shard)
-    tmp = f"{path}.{pid}"
-    with open(tmp, "w", encoding="utf-8") as fh:  # evglint: disable=fencecheck -- supervisor/worker-owned fleet manifest BESIDE the store, never store state: atomic tmp+rename, stale entries fenced by generation+epoch fields and the fleet-scope supervisor lease
-        json.dump({
+    _integrity.atomic_write_json(
+        entry_path(data_dir, shard),
+        {
             "shard": shard,
             "pid": pid,
             "sock": sock,
@@ -72,8 +78,10 @@ def write_entry(data_dir: str, shard: int, *, pid: int, sock: str,
             "epoch": epoch,
             "shm": shm,
             "shm_bytes": shm_bytes,
-        }, fh)
-    os.replace(tmp, path)  # evglint: disable=fencecheck -- the atomic publish of the manifest entry above; same non-store file, same generation/epoch fencing
+        },
+        seam="manifest.write",
+        tmp_tag=str(pid),
+    )
 
 
 def read_entry(data_dir: str, shard: int) -> Optional[dict]:
@@ -81,6 +89,11 @@ def read_entry(data_dir: str, shard: int) -> Optional[dict]:
         with open(entry_path(data_dir, shard), encoding="utf-8") as fh:
             doc = json.load(fh)
     except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    if _integrity.verify_doc(doc) is False:
+        # bitrot in a manifest entry: treat like a stale/absent entry —
+        # the supervisor cold-respawns instead of adopting over a socket
+        # path it cannot trust
         return None
     return doc if isinstance(doc, dict) and doc.get("pid") else None
 

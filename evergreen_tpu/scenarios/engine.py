@@ -51,7 +51,7 @@ from ..utils import overload as overload_mod
 from ..utils.benchgen import NOW
 from ..utils.faults import Fault, FaultPlan
 from .invariants import INVARIANT_CHECKS
-from .spec import ScenarioSpec, scorecard_entry_fingerprint
+from .spec import Ev, ScenarioSpec, scorecard_entry_fingerprint
 
 #: counter-name prefixes the scorecard carries (shed / retry / fallback /
 #: recovery / fault accounting — the graceful-degradation ledger)
@@ -63,6 +63,7 @@ SCORECARD_COUNTER_PREFIXES = (
     "retry.",
     "scheduler.tick.",
     "lease.",
+    "storage.",
 )
 
 
@@ -836,6 +837,56 @@ def ev_clear_faults(run: ScenarioRun, seam: str = "") -> None:
         run.fault_plan._always.clear()
 
 
+#: disk_fault targets → the utils/faults.py seam each one arms
+_DISK_FAULT_SEAMS = {
+    "wal": "wal.commit",
+    "snapshot": "snapshot.write",
+}
+
+
+def ev_disk_fault(
+    run: ScenarioRun,
+    target: str = "wal",
+    kind: str = "bitrot",
+    at: Optional[int] = None,
+) -> None:
+    """Arm one storage-integrity fault (enospc/eio/short/bitrot) at a
+    disk seam, then schedule the follow-through that makes the run
+    CONVERGE despite it: a ``snapshot`` target forces a checkpoint next
+    tick so the armed fault actually lands (tolerating the loud
+    enospc/eio raise — a failed checkpoint leaves the previous one
+    authoritative), and every target schedules a ``scrub()`` the tick
+    after, so detection + quarantine + rebuild happen inside the replay
+    and resume≡rerun holds at convergence. No-op on non-durable specs —
+    there is no disk to fault."""
+    from ..storage.durable import DurableStore
+
+    if not isinstance(run.store, DurableStore):
+        return
+    seam = _DISK_FAULT_SEAMS.get(target)
+    if seam is None:
+        raise ValueError(f"unknown disk_fault target {target!r}")
+    ev_fault(run, seam=seam, kind=kind, at=at)
+
+    def _force_checkpoint(r: ScenarioRun) -> None:
+        try:
+            r.store.checkpoint()
+        except OSError:
+            pass  # injected enospc/eio: previous checkpoint stays live
+
+    def _scrub(r: ScenarioRun) -> None:
+        if isinstance(r.store, DurableStore):
+            r.store.scrub()
+
+    if target == "snapshot":
+        run._events_by_tick.setdefault(run.tick + 1, []).append(
+            Ev(run.tick + 1, "call", {"fn": _force_checkpoint})
+        )
+    run._events_by_tick.setdefault(run.tick + 2, []).append(
+        Ev(run.tick + 2, "call", {"fn": _scrub})
+    )
+
+
 def ev_container_pools(run: ScenarioRun, pools: List[Dict]) -> None:
     """Configure docker container pools (parent distro + capacity)."""
     from ..cloud.docker import ContainerPool, set_container_pools
@@ -867,6 +918,7 @@ EVENT_HANDLERS: Dict[str, Callable] = {
     "advance_clock": ev_advance_clock,
     "fault": ev_fault,
     "clear_faults": ev_clear_faults,
+    "disk_fault": ev_disk_fault,
     "container_pools": ev_container_pools,
     "call": ev_call,
 }

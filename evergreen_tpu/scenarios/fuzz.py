@@ -5,8 +5,11 @@ the six cross-cutting invariants where a human thought to look; this
 module checks them everywhere a seeded generator can reach. A **weather**
 is drawn from the existing ``Ev`` vocabulary — task bursts, merge
 stacks, dependency DAGs, fleet growth, spot reclamation, notification
-storms, clock jumps, fault seams (utils/faults.py), writer lease steals
-— as a pure function of one integer seed, replayed deterministically
+storms, clock jumps, fault seams (utils/faults.py), writer lease steals,
+disk faults at the storage seams (ENOSPC/EIO/short-write/bitrot against
+the WAL or a published snapshot, with the self-heal scrub scheduled
+behind them) — as a pure function of one integer seed, replayed
+deterministically
 under ``DEFAULT_INVARIANTS``. A proc variant composes the child-process
 vocabulary (worker SIGKILLs at WAL seams, hangs, supervisor kills) for
 the supervised-fleet backend.
@@ -55,6 +58,12 @@ DEFAULT_CAMPAIGN_SEED = 16_0001
 #: the proc arm, where the blast radius is a worker process.
 SAFE_FAULT_SEAMS = ("scheduler.solve",)
 DURABLE_FAULT_SEAMS = ("wal.commit",)
+
+#: the disk-fault vocabulary the generator draws for durable weathers
+#: (scenarios/engine.py ev_disk_fault schedules the forced checkpoint
+#: and the self-heal scrub behind each one)
+DISK_FAULT_TARGETS = ("wal", "snapshot")
+DISK_FAULT_KINDS = ("enospc", "bitrot", "short", "eio")
 
 #: seams the proc arm SIGKILLs workers at (crash-matrix vocabulary)
 PROC_KILL_SEAMS = ("wal.commit", "wal.append", "lease.renew")
@@ -188,6 +197,18 @@ def generate_weather(seed: int, sabotage: bool = False) -> ScenarioSpec:
                     and t >= 2:
                 lease_stolen = True
                 events.append(Ev(t, "lease_steal", {}))
+
+    if durable:
+        # disk weather rides on its OWN rng stream so its addition left
+        # every pre-existing seed's event sequence untouched (the pinned
+        # campaign anchor and the checked-in regression corpus replay
+        # byte-identically)
+        drng = random.Random(int(seed) ^ 0xD15C0)
+        if drng.random() < 0.5:
+            events.append(Ev(drng.randint(1, span), "disk_fault", {
+                "target": drng.choice(DISK_FAULT_TARGETS),
+                "kind": drng.choice(DISK_FAULT_KINDS),
+            }))
 
     if sabotage:
         from .library import _sabotage_duplicate_claim

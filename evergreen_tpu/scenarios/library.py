@@ -1,4 +1,4 @@
-"""The shipped scenario library: six realistic weathers, one sabotage.
+"""The shipped scenario library: eight realistic weathers, one sabotage.
 
 Each spec is a declarative timeline over the engine's event vocabulary
 (scenarios/engine.py) plus named checks for the assertions the SLO
@@ -18,6 +18,14 @@ scorecard fingerprint (tools/scenario_engine.py --check-determinism).
   seasonality         a week compressed to minutes: arrivals + backlog
                       gauges drive GREEN→…→BLACK→…→GREEN with counted
                       shedding and a green landing
+  disk-bitrot-snapshot  a published snapshot silently rots on disk: the
+                      scrub detects the digest mismatch, quarantines
+                      the file and rebuilds a verified checkpoint while
+                      serving never stops
+  disk-enospc-commit  the disk fills at a WAL group commit: the frame
+                      is shed loudly (RED floor) instead of raising
+                      mid-commit, and the first accepted frame heals
+                      durability back to GREEN
 """
 from __future__ import annotations
 
@@ -855,6 +863,130 @@ PROC_WEATHERS: Dict[str, callable] = {
 }
 
 
+def _check_snapshot_healed(run) -> Optional[str]:
+    """The rotted snapshot was quarantined (forensics kept), a verified
+    checkpoint replaced it, and the published pair passes its digest."""
+    import os
+
+    from ..storage import integrity as integrity_mod
+    from ..storage.durable import SNAPSHOT_FILE
+
+    if run.counter_delta("storage.snapshot_quarantined") != 1:
+        return (
+            f"{run.counter_delta('storage.snapshot_quarantined')} "
+            "snapshots quarantined (want exactly the injected one)"
+        )
+    if run.counter_delta("storage.rebuilds") < 1:
+        return "no self-heal rebuild was counted"
+    if not any(
+        name.startswith(SNAPSHOT_FILE + ".corrupt-")
+        for name in os.listdir(run.data_dir)
+    ):
+        return "no .corrupt-<ts> forensic file kept beside the store"
+    snap = os.path.join(run.data_dir, SNAPSHOT_FILE)
+    meta = _read_json(snap + ".meta")
+    if meta is None:
+        return "healed snapshot has no .meta sidecar"
+    if meta.get("crc") != integrity_mod.file_crc32(snap):
+        return "healed snapshot does not match its recorded digest"
+    return None
+
+
+def _read_json(path) -> Optional[dict]:
+    import json
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _check_enospc_shed_healed(run) -> Optional[str]:
+    """The full disk shed exactly one group loudly (breadcrumbs both
+    ways), and durability healed — shed writes re-covered by checkpoint,
+    floor released."""
+    if run.counter_delta("storage.enospc_sheds") != 1:
+        return (
+            f"{run.counter_delta('storage.enospc_sheds')} ENOSPC sheds "
+            "(want exactly the injected one)"
+        )
+    shed = [r for r in run.logs if r.get("message") == "wal-enospc-shed"]
+    healed = [
+        r for r in run.logs if r.get("message") == "wal-enospc-healed"
+    ]
+    if not shed:
+        return "no wal-enospc-shed breadcrumb"
+    if not healed:
+        return "durability never healed after the shed"
+    return None
+
+
+def _disk_bitrot_snapshot() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "drot", "provider": Provider.MOCK.value, "hosts": 4},
+        ]}),
+        Ev(0, "tasks", {"distro": "drot", "n": 8, "prefix": "drot-t"}),
+        # arms snapshot.write:bitrot, forces a checkpoint next tick (the
+        # rot lands on the PUBLISHED file), and scrubs the tick after
+        Ev(2, "disk_fault", {"target": "snapshot", "kind": "bitrot"}),
+        Ev(6, "tasks", {"distro": "drot", "n": 4, "prefix": "drot-b"}),
+    ]
+    return ScenarioSpec(
+        name="disk-bitrot-snapshot",
+        description="a published snapshot rots on disk after its "
+                    "rename: the scrub catches the digest mismatch, "
+                    "quarantines the file as .corrupt-<ts> and rebuilds "
+                    "a verified checkpoint — serving and scheduling "
+                    "never notice",
+        ticks=12,
+        durable=True,
+        events=events,
+        slos=[
+            SLO("work-survives", "tasks_unfinished", "==", 0),
+            SLO("no-failures", "tasks_failed", "==", 0),
+            SLO("ends-green", "ended_green", "==", 1),
+        ],
+        checks=[
+            ("snapshot-quarantined-and-healed", _check_snapshot_healed),
+        ],
+    )
+
+
+def _disk_enospc_commit() -> ScenarioSpec:
+    events = [
+        Ev(0, "fleet", {"distros": [
+            {"id": "dfull", "provider": Provider.MOCK.value, "hosts": 4},
+        ]}),
+        Ev(0, "tasks", {"distro": "dfull", "n": 8, "prefix": "dfull-t"}),
+        # the next WAL group commit hits a full disk: SHED + RED floor,
+        # never a raise mid-commit; the scrub two ticks later verifies
+        # the surviving log still passes its stamps
+        Ev(2, "disk_fault", {"target": "wal", "kind": "enospc"}),
+        Ev(6, "tasks", {"distro": "dfull", "n": 4, "prefix": "dfull-b"}),
+    ]
+    return ScenarioSpec(
+        name="disk-enospc-commit",
+        description="the disk fills at a WAL group commit: the frame is "
+                    "shed loudly with the overload floor forced RED, "
+                    "in-memory truth keeps every write, and the first "
+                    "accepted frame re-covers them durably and heals "
+                    "back to GREEN",
+        ticks=12,
+        durable=True,
+        events=events,
+        slos=[
+            SLO("work-survives", "tasks_unfinished", "==", 0),
+            SLO("no-failures", "tasks_failed", "==", 0),
+            SLO("ends-green", "ended_green", "==", 1),
+        ],
+        checks=[
+            ("enospc-shed-then-healed", _check_enospc_shed_healed),
+        ],
+    )
+
+
 def _sabotage() -> ScenarioSpec:
     return ScenarioSpec(
         name="sabotage-duplicate-claim",
@@ -890,6 +1022,8 @@ SCENARIOS: Dict[str, callable] = {
     "seasonality": _seasonality,
     "capacity-price-spike": _capacity_price_spike,
     "capacity-quota-squeeze": _capacity_quota_squeeze,
+    "disk-bitrot-snapshot": _disk_bitrot_snapshot,
+    "disk-enospc-commit": _disk_enospc_commit,
 }
 
 #: deliberately-broken specs the gate's self-test runs EXPECTING failure

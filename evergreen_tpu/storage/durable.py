@@ -46,6 +46,15 @@ Design notes:
 - Compaction writes a point-in-time snapshot (atomic tmp+rename) then
   truncates the WAL; it runs inline when the WAL exceeds
   ``compact_every_ops`` and at ``close()``.
+- Integrity (storage/integrity.py): every WAL line carries a trailing
+  CRC32 stamp and every snapshot a whole-file digest in its ``.meta``
+  sidecar.  Replay treats a CRC-failed line as the end of the valid
+  prefix (counted, never applied, never fatal), quarantines a corrupt
+  snapshot aside as ``.corrupt-<ts>`` and rebuilds from the retained
+  ``.prev`` checkpoint generation + both WAL generations, and a
+  commit-time ENOSPC sheds the group loudly (RED overload floor) with
+  a heal checkpoint once the disk accepts writes again.  ``scrub()``
+  runs the same detection on demand against a live store.
 - Insertion order is preserved through snapshot+replay because snapshots
   serialize docs in dict order and puts replay in log order — the
   ``key_order`` determinism contract the scheduler's tie-breaks rely on.
@@ -74,6 +83,7 @@ import threading
 from ..utils import lockcheck as _lockcheck
 from typing import Dict, Optional
 
+from . import integrity as _integrity
 from .lease import EpochFencedError, FileLease
 from .store import Collection, Store, apply_wal_record
 from ..utils import metrics as _metrics
@@ -134,6 +144,32 @@ WAL_FLUSH_MS = _metrics.histogram(
 WAL_FLUSH_BACKLOG = _metrics.gauge(
     "wal_flush_backlog",
     "Frames waiting on (or being written by) the async WAL flusher.",
+)
+WAL_CORRUPT_FRAMES = _metrics.counter(
+    "wal_corrupt_frames_total",
+    "CRC-failed WAL lines treated as end-of-valid-prefix (replay and "
+    "replica tailer alike): never applied, never halting serving.",
+    legacy="storage.wal_corrupt_frames",
+)
+WAL_ENOSPC_SHEDS = _metrics.counter(
+    "wal_enospc_sheds_total",
+    "Tick group frames shed because the disk reported ENOSPC at commit; "
+    "the overload floor flips to RED and a heal checkpoint re-covers "
+    "the shed writes from memory truth once the disk accepts again.",
+    legacy="storage.enospc_sheds",
+)
+SNAPSHOT_QUARANTINED = _metrics.counter(
+    "storage_snapshot_quarantined_total",
+    "Snapshots whose whole-file digest (or parse) failed and were moved "
+    "aside as .corrupt-<ts> instead of being replayed as truth.",
+    legacy="storage.snapshot_quarantined",
+)
+STORAGE_REBUILDS = _metrics.counter(
+    "storage_rebuilds_total",
+    "Self-heal rebuilds after detected storage rot: recovery or scrub "
+    "quarantined something and re-covered state with a fresh verified "
+    "checkpoint.",
+    legacy="storage.rebuilds",
 )
 
 #: trace-capture taps: fn(path, line) called for every committed WAL
@@ -281,6 +317,20 @@ class _Journal:
                 self._fh.flush()
                 self._torn = True
             raise OSError("injected torn WAL append")
+        if directive == "short":
+            # a SILENT short write: half the record reaches the OS, no
+            # terminator, and — unlike "torn" — no error surfaces to the
+            # writer. The stub is repaired into one unparseable line by
+            # the next append (the _torn branch below) or dropped as a
+            # torn tail at recovery; the stub never got its CRC splice,
+            # so the PARSE check (not the stamp) convicts it — counted
+            # as a corrupt frame, and scrub()/the open-time self-heal
+            # re-cover the lost record from memory truth.
+            with self._lock:
+                self._fh.write(line[: max(1, len(line) // 2)])
+                self._fh.flush()
+                self._torn = True
+            return
         with self._lock:
             if getattr(self, "_torn", False):
                 # terminate the injected torn stub exactly like the
@@ -297,12 +347,28 @@ class _Journal:
             # counted tail could (every line still ends "}", so the
             # splice is well-formed JSON)
             line = '%s,"s":%d}' % (line[:-1], self.total_lines)
+            # end-to-end CRC stamp, spliced LAST so it covers the record,
+            # the epoch and the ordinal alike. Absence of the stamp is
+            # the version marker: pre-integrity WALs replay unchecked
+            # (upgrade compatibility), a failed recompute is corruption.
+            if _integrity.wal_crc_enabled():
+                line = _integrity.stamp_wal_line(line)
             self._fh.write(line + "\n")
             if self.sync != "none":
                 self._fh.flush()
                 if self.sync == "fsync":
                     os.fsync(self._fh.fileno())  # evglint: disable=lockgraph -- the fsync IS the WAL write barrier: appends must queue behind durability; group commit amortizes it to one per tick
             self.ops += n_ops
+            if directive == "bitrot":
+                # post-write decay: the line committed cleanly and THEN a
+                # byte rotted on disk — corrupt mid-line so the CRC check
+                # (not the JSON parser) is what has to catch it
+                self._fh.flush()
+                nbytes = len(line.encode("utf-8")) + 1
+                size = os.path.getsize(self.path)
+                _integrity.corrupt_byte(
+                    self.path, max(0, size - 1 - nbytes // 2)
+                )
         for tap in list(_JOURNAL_TAPS):
             try:
                 tap(self.path, line)
@@ -311,19 +377,25 @@ class _Journal:
 
     def rotate(self) -> None:
         """Start a fresh log generation after a successful snapshot
-        (under the caller's whole-store quiesce). The new log is an
-        atomically-renamed NEW file — a fresh inode — so a tailing
-        replica can tell "truncated and already regrown past my offset"
-        from "still the generation I was reading" (an in-place truncate
-        is invisible once the file regrows)."""
+        (under the caller's whole-store quiesce). The new log is a NEW
+        file — a fresh inode — so a tailing replica can tell "truncated
+        and already regrown past my offset" from "still the generation I
+        was reading" (an in-place truncate is invisible once the file
+        regrows). The outgoing generation is retained as ``<wal>.prev``
+        — exactly one checkpoint interval of history — so recovery can
+        rebuild from the PREVIOUS checkpoint + both logs when the
+        current snapshot is quarantined (integrity self-heal)."""
         with self._lock:
             self._fh.close()
-            tmp = self.path + ".new"
-            with open(tmp, "w", encoding="utf-8"):
-                pass
-            os.replace(tmp, self.path)
+            try:
+                os.replace(self.path, self.path + ".prev")
+            except OSError:
+                pass  # nothing written yet: start the generation fresh
             self._fh = open(self.path, "a", encoding="utf-8")
             self.ops = 0
+            # an un-terminated injected stub rode out with the old
+            # generation: the fresh log must not start with a repair
+            self._torn = False
 
     def close(self) -> None:
         with self._lock:
@@ -367,9 +439,16 @@ class DurableStore(Store):
         self._lease = lease
         self.epoch = lease.epoch if lease is not None else 0
         self._fenced = False
-        #: what recovery saw: frames replayed/dropped, highest epoch
+        #: ENOSPC latch: a commit-time full disk shed a group frame and
+        #: floored the overload ladder at RED; the next accepted frame
+        #: triggers the heal checkpoint and releases the floor
+        self._enospc_floor = False
+        #: what recovery saw: frames replayed/dropped, highest epoch,
+        #: plus what the integrity plane caught (CRC-failed lines at the
+        #: end of the valid prefix, quarantined snapshots)
         self.replay_report: Dict[str, int] = {
             "frames": 0, "stale_frames_dropped": 0, "wal_max_epoch": 0,
+            "corrupt_frames": 0, "snapshots_quarantined": 0,
         }
         self._journal = _Journal(
             os.path.join(data_dir, self._wal_name), sync=sync
@@ -421,6 +500,43 @@ class DurableStore(Store):
                 wal_max_epoch=self.replay_report["wal_max_epoch"],
                 epoch=self.epoch,
             )
+        if (
+            self.replay_report["corrupt_frames"]
+            or self.replay_report["snapshots_quarantined"]
+        ):
+            # detection → quarantine → self-heal: recovery stopped at the
+            # end of the valid prefix (and/or fell back past a quarantined
+            # snapshot). Keep the rotted log bytes aside for the scrub
+            # runbook, then re-cover everything recovered with one fresh,
+            # verified checkpoint so the rot cannot be replayed twice.
+            from ..utils.log import get_logger
+
+            STORAGE_REBUILDS.inc()
+            get_logger("resilience").error(
+                "storage-integrity-rebuild",
+                corrupt_frames=self.replay_report["corrupt_frames"],
+                snapshots_quarantined=self.replay_report[
+                    "snapshots_quarantined"
+                ],
+                data_dir=self.data_dir,
+            )
+            corrupt_wal = getattr(self, "_corrupt_wal_path", None)
+            if corrupt_wal and os.path.exists(corrupt_wal):
+                import shutil as _shutil
+                import time as __time
+
+                try:
+                    _shutil.copyfile(
+                        corrupt_wal,
+                        "%s.corrupt-%d"
+                        % (corrupt_wal, int(__time.time() * 1000)),
+                    )
+                except OSError:
+                    pass
+            try:
+                self.checkpoint()
+            except Exception:  # noqa: BLE001 — the disk may still be  # evglint: disable=shedcheck -- heal is best-effort at open: recovery already serves the valid prefix; a sick disk keeps the loud counters and retries at the next checkpoint
+                pass
 
     # -- split-brain fence ---------------------------------------------------- #
 
@@ -516,12 +632,51 @@ class DurableStore(Store):
         # after the enqueue-time check): a deferred EpochFencedError
         # surfaces at the next sync_persist barrier
         self.assert_not_fenced(read_lease_file=self.epoch > 0)
-        self._journal.commit_group(records, epoch=self.epoch)
+        try:
+            self._journal.commit_group(records, epoch=self.epoch)
+        except OSError as exc:
+            import errno as _errno
+
+            if exc.errno != _errno.ENOSPC:
+                raise
+            # a FULL DISK at the commit boundary: raising mid-commit
+            # would fail every tick while the memory truth stays intact.
+            # Instead the frame is SHED in the PR-3 fencing shape — the
+            # detached group is dropped on the floor, loudly counted —
+            # and the overload ladder floors at RED so the plane stops
+            # feeding the disk expensive work. The in-memory state still
+            # holds every shed write; the first accepted frame below
+            # triggers a heal checkpoint that re-covers them durably.
+            self._shed_group_enospc(len(records))
+            return
+        if self._enospc_floor:
+            # the disk accepted a frame again: re-cover the shed groups
+            # from memory truth and release the floor
+            from ..utils import overload as _overload
+            from ..utils.log import get_logger
+
+            if self.heal_durability():
+                self._enospc_floor = False
+                _overload.monitor_for(self).set_floor(_overload.GREEN)
+                get_logger("resilience").warning(
+                    "wal-enospc-healed", data_dir=self.data_dir
+                )
         if (
             self._journal.ops >= self.compact_every_ops
             and not self._journal.suspended
         ):
             self.checkpoint(blocking=False)
+
+    def _shed_group_enospc(self, n_ops: int) -> None:
+        from ..utils import overload as _overload
+        from ..utils.log import get_logger
+
+        WAL_ENOSPC_SHEDS.inc()
+        self._enospc_floor = True
+        _overload.monitor_for(self).set_floor(_overload.RED)
+        get_logger("resilience").error(
+            "wal-enospc-shed", n_ops=n_ops, data_dir=self.data_dir
+        )
 
     def _defer_behind_pending(self, line: str) -> bool:
         """_Journal hook (called under the journal lock): queue a per-op
@@ -670,18 +825,244 @@ class DurableStore(Store):
             # the next tick's full-rewrite pass is the fallback
             return False
 
+    def scrub(self) -> Dict[str, int]:
+        """Integrity scrub: re-verify everything on disk against its
+        digests while the store serves, and self-heal any rot found.
+
+        Scans the WAL's stamped lines (a CRC failure is counted into
+        ``wal_corrupt_frames_total`` and keeps a forensic copy of the
+        log aside) and recomputes the published snapshot's whole-file
+        digest (a mismatch quarantines it as ``.corrupt-<ts>``). Any
+        finding — including a silently short-written stub the journal
+        already knows about — triggers one heal checkpoint that
+        re-covers the in-memory truth with fresh, verified files. This
+        is what the scenario engine's ``disk_fault`` weathers run a few
+        ticks after every injection, and what docs/DEPLOY.md's scrub
+        runbook invokes on live data dirs.
+
+        Returns ``{"wal_corrupt_frames", "snapshot_corrupt",
+        "torn_stub", "healed"}``."""
+        report = {
+            "wal_corrupt_frames": 0, "snapshot_corrupt": 0,
+            "torn_stub": 0, "healed": 0,
+        }
+        # settle async commits so the scan sees a stable tail (write
+        # errors stay deferred for the next sync_persist barrier)
+        with self._flush_cv:
+            while self._flush_queue or self._flush_busy:
+                self._flush_cv.wait(timeout=0.1)
+        wal_path = self._journal.path
+        with self._journal._lock:
+            if not self._journal._fh.closed:
+                self._journal._fh.flush()
+            report["torn_stub"] = int(
+                getattr(self._journal, "_torn", False)
+            )
+        try:
+            with open(wal_path, "rb") as fh:
+                for line in fh:
+                    if not line.endswith(b"\n"):
+                        break  # an unterminated tail is torn, not rotten
+                    if _integrity.verify_wal_line(line) is False:
+                        report["wal_corrupt_frames"] += 1
+                        WAL_CORRUPT_FRAMES.inc()
+                        break  # end of the verifiable prefix
+                    try:
+                        json.loads(line)
+                    except (ValueError, UnicodeDecodeError):
+                        # a TERMINATED line no parser accepts — the
+                        # newline-repaired stub of a silent short write.
+                        # It carries no stamp (the splice never ran), so
+                        # only the parse check can convict it
+                        report["wal_corrupt_frames"] += 1
+                        WAL_CORRUPT_FRAMES.inc()
+                        break
+        except OSError:
+            pass
+        snap_path = os.path.join(self.data_dir, self._snapshot_name)
+        meta = None
+        try:
+            with open(
+                snap_path + SNAPSHOT_META_SUFFIX, encoding="utf-8"
+            ) as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            meta = None
+        if (
+            isinstance(meta, dict)
+            and meta.get("crc")
+            and os.path.exists(snap_path)
+            and _integrity.file_crc32(snap_path) != meta["crc"]
+        ):
+            report["snapshot_corrupt"] = 1
+            SNAPSHOT_QUARANTINED.inc()
+            _integrity.quarantine(snap_path)
+        if (
+            report["wal_corrupt_frames"]
+            or report["snapshot_corrupt"]
+            or report["torn_stub"]
+        ):
+            from ..utils.log import get_logger
+
+            if report["wal_corrupt_frames"]:
+                import shutil as _shutil
+                import time as __time
+
+                try:
+                    _shutil.copyfile(
+                        wal_path,
+                        "%s.corrupt-%d"
+                        % (wal_path, int(__time.time() * 1000)),
+                    )
+                except OSError:
+                    pass
+            STORAGE_REBUILDS.inc()
+            get_logger("resilience").error(
+                "storage-scrub-heal",
+                data_dir=self.data_dir,
+                **{k: v for k, v in report.items() if k != "healed"},
+            )
+            report["healed"] = int(self.heal_durability())
+        return report
+
     # -- recovery / compaction ----------------------------------------------- #
+
+    def _load_trusted_snapshot(
+        self, snap_path: str, meta_path: str
+    ):
+        """Parse + digest-verify one snapshot generation. A snapshot
+        whose ``.meta`` digest fails the recompute — or whose bytes no
+        longer parse — is quarantined aside as ``.corrupt-<ts>`` (never
+        replayed as truth, never deleted) and counted. Returns the
+        payload dict, or None when missing/quarantined; metas without a
+        digest (pre-integrity checkpoints) load unchecked for upgrade
+        compatibility."""
+        if not os.path.exists(snap_path):
+            return None
+        meta = None
+        try:
+            with open(meta_path, encoding="utf-8") as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            meta = None
+        bad = False
+        if isinstance(meta, dict) and meta.get("crc"):
+            bad = _integrity.file_crc32(snap_path) != meta["crc"]
+        if not bad:
+            try:
+                with open(snap_path, encoding="utf-8") as fh:
+                    return json.load(fh)
+            except (ValueError, UnicodeDecodeError, OSError):
+                bad = True
+        from ..utils.log import get_logger
+
+        self.replay_report["snapshots_quarantined"] += 1
+        SNAPSHOT_QUARANTINED.inc()
+        qpath = _integrity.quarantine(snap_path)
+        get_logger("resilience").error(
+            "snapshot-quarantined",
+            snapshot=snap_path,
+            quarantined_to=qpath or "",
+            digest_mismatch=bool(
+                isinstance(meta, dict) and meta.get("crc")
+            ),
+        )
+        return None
+
+    def _replay_wal_file(self, wal_path: str, state: dict) -> None:
+        """Replay one WAL generation into the store, CRC-verifying each
+        terminated line first. A line whose stamp fails the recompute
+        marks the END OF THE VALID PREFIX: it is counted, never applied,
+        and nothing after it (in this or any later generation) replays —
+        the self-heal checkpoint in ``__init__`` then re-covers the
+        recovered truth. Unstamped lines (pre-integrity WALs) replay
+        unchecked."""
+        report = self.replay_report
+        if state.get("corrupt_stop") or not os.path.exists(wal_path):
+            return
+        # binary read: a rotted byte can break the utf-8 encoding itself,
+        # and a decode error mid-iteration must not abort the replay of
+        # the valid prefix before it
+        with open(wal_path, "rb") as fh:
+            for line in fh:
+                if not line.endswith(b"\n"):
+                    break  # torn final line from a crash mid-append
+                verdict = _integrity.verify_wal_line(line)
+                if verdict is False:
+                    report["corrupt_frames"] += 1
+                    WAL_CORRUPT_FRAMES.inc()
+                    state["corrupt_stop"] = True
+                    self._corrupt_wal_path = wal_path
+                    break
+                state["wal_lines"] += 1
+                try:
+                    rec = json.loads(line)
+                except (ValueError, UnicodeDecodeError):
+                    # terminated-but-unparseable (e.g. the newline-
+                    # repaired stub of a torn append): that ONE
+                    # record is lost; everything after it is intact.
+                    # Counted so the loss is loud — and so the open-time
+                    # self-heal checkpoint re-covers the recovered truth
+                    # with a clean generation
+                    report["corrupt_frames"] += 1
+                    WAL_CORRUPT_FRAMES.inc()
+                    self._corrupt_wal_path = wal_path
+                    continue
+                s = int(rec.get("s", 0) or 0)
+                if s:
+                    state["max_line_seq"] = max(state["max_line_seq"], s)
+                op = rec.get("o")
+                if op == "f":
+                    # fence marker: a holder pinned its epoch at
+                    # open; everything older is superseded
+                    state["max_epoch"] = max(
+                        state["max_epoch"], int(rec.get("e", 0) or 0)
+                    )
+                    continue
+                if s and s <= state["snap_seq"]:
+                    # already folded into the snapshot base we loaded:
+                    # the rebuild path replays the PREVIOUS generation's
+                    # log behind a newer base, and a crash between the
+                    # snapshot rename and the rotation leaves the full
+                    # log beside the snapshot that covers it
+                    continue
+                if op == "g":
+                    report["frames"] += 1
+                e = int(rec.get("e", 0) or 0)
+                if e:
+                    if e < state["max_epoch"]:
+                        # a superseded holder's write landed past
+                        # the fence point (interleaved with a
+                        # higher-epoch holder's): its effect was
+                        # already logically overridden — drop it,
+                        # whole group frame or single per-op line
+                        report["stale_frames_dropped"] += 1
+                        continue
+                    state["max_epoch"] = e
+                self._apply(rec)
 
     def _recover(self) -> None:
         snap_path = os.path.join(self.data_dir, self._snapshot_name)
+        meta_path = snap_path + SNAPSHOT_META_SUFFIX
+        wal_path = self._journal.path
         self._journal.suspended = True
-        max_epoch = 0
-        snap_seq = 0
-        wal_lines = 0
+        state = {
+            "max_epoch": 0, "snap_seq": 0, "wal_lines": 0,
+            "max_line_seq": 0, "corrupt_stop": False,
+        }
         try:
-            if os.path.exists(snap_path):
-                with open(snap_path, encoding="utf-8") as fh:
-                    snap = json.load(fh)
+            snap = self._load_trusted_snapshot(snap_path, meta_path)
+            replay_paths = [wal_path]
+            if snap is None and self.replay_report["snapshots_quarantined"]:
+                # the current snapshot was quarantined: rebuild from the
+                # PREVIOUS checkpoint generation (retained by rotate()/
+                # checkpoint() as .prev) + both log generations — the
+                # previous cut anchors exactly where <wal>.prev begins
+                snap = self._load_trusted_snapshot(
+                    snap_path + ".prev", meta_path + ".prev"
+                )
+                replay_paths = [wal_path + ".prev", wal_path]
+            if snap is not None:
                 for name, docs in snap.get("collections", {}).items():
                     coll = self.collection(name)
                     for doc in docs:
@@ -690,53 +1071,24 @@ class DurableStore(Store):
                 # fence point must survive in the snapshot — frames a
                 # deposed holder appends to the rotated log still rank
                 # below it
-                max_epoch = int(snap.get("epoch", 0) or 0)
+                state["max_epoch"] = int(snap.get("epoch", 0) or 0)
                 # line-seq watermark at the checkpoint cut: the base the
                 # replication seq counts up from
-                snap_seq = int(snap.get("seq", 0) or 0)
-            wal_path = self._journal.path
-            report = self.replay_report
-            if os.path.exists(wal_path):
-                with open(wal_path, encoding="utf-8") as fh:
-                    for line in fh:
-                        if not line.endswith("\n"):
-                            break  # torn final line from a crash mid-append
-                        wal_lines += 1
-                        try:
-                            rec = json.loads(line)
-                        except json.JSONDecodeError:
-                            # terminated-but-unparseable (e.g. the newline-
-                            # repaired stub of a torn append): that ONE
-                            # record is lost; everything after it is intact
-                            continue
-                        op = rec.get("o")
-                        if op == "f":
-                            # fence marker: a holder pinned its epoch at
-                            # open; everything older is superseded
-                            max_epoch = max(
-                                max_epoch, int(rec.get("e", 0) or 0)
-                            )
-                            continue
-                        if op == "g":
-                            report["frames"] += 1
-                        e = int(rec.get("e", 0) or 0)
-                        if e:
-                            if e < max_epoch:
-                                # a superseded holder's write landed past
-                                # the fence point (interleaved with a
-                                # higher-epoch holder's): its effect was
-                                # already logically overridden — drop it,
-                                # whole group frame or single per-op line
-                                report["stale_frames_dropped"] += 1
-                                continue
-                            max_epoch = e
-                        self._apply(rec)
-            report["wal_max_epoch"] = max_epoch
+                state["snap_seq"] = int(snap.get("seq", 0) or 0)
+            for path in replay_paths:
+                self._replay_wal_file(path, state)
+            self.replay_report["wal_max_epoch"] = state["max_epoch"]
             # re-seed the monotone line counter so a restarted writer
             # keeps numbering where the previous one stopped (every
             # TERMINATED line counts, parseable or not — the replica
-            # counts the lines it reads on the same rule)
-            self._journal.total_lines = snap_seq + wal_lines
+            # counts the lines it reads on the same rule). The max()
+            # with the highest stamped ordinal keeps the counter
+            # monotone through the rebuild path, where the base is the
+            # previous generation's cut.
+            self._journal.total_lines = max(
+                state["snap_seq"] + state["wal_lines"],
+                state["max_line_seq"],
+            )
         finally:
             self._journal.suspended = False
 
@@ -811,25 +1163,76 @@ class DurableStore(Store):
                 # snapshot that holds nothing new
                 "seq": self._journal.total_lines,
             }
-            with open(tmp_path, "w", encoding="utf-8") as fh:
-                json.dump(payload, fh, separators=(",", ":"), default=str)
-                fh.flush()
-                os.fsync(fh.fileno())
+            from ..utils import faults as _faults
+
+            meta_path = snap_path + SNAPSHOT_META_SUFFIX
+            try:
+                with open(tmp_path, "w", encoding="utf-8") as fh:
+                    # the snapshot.write seam fires with the tmp OPEN so
+                    # an injected enospc/eio lands mid-write — exactly
+                    # the stranded-tmp shape the cleanup below absorbs
+                    directive = _faults.fire("snapshot.write")
+                    json.dump(
+                        payload, fh, separators=(",", ":"), default=str
+                    )
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            except BaseException:
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+                raise
+            # retain the outgoing generation BEFORE the new pair lands:
+            # hardlink the current snapshot+meta aside as ``.prev`` —
+            # with the WAL's own ``.prev`` (rotate()) that is exactly
+            # one checkpoint interval of rebuildable history should the
+            # incoming snapshot later fail its digest
+            for cur in (meta_path, snap_path):
+                try:
+                    os.link(cur, cur + ".prevtmp")
+                    os.replace(cur + ".prevtmp", cur + ".prev")
+                except OSError:
+                    # first checkpoint (nothing to retain) or a linkless
+                    # filesystem: the rebuild path simply has no .prev
+                    pass
             # the tiny meta sidecar lands BEFORE the snapshot renames:
             # a crash between the two leaves a new meta beside the OLD
             # snapshot, which no reader consults (the snapshot's stat is
             # unchanged and the WAL was not truncated). Once the rename
-            # lands, meta and snapshot agree by construction.
-            meta_path = snap_path + SNAPSHOT_META_SUFFIX
-            with open(meta_path + ".tmp", "w", encoding="utf-8") as fh:
-                json.dump(
-                    {"seq": payload["seq"], "epoch": payload["epoch"]}, fh
-                )
-                fh.flush()
-                os.fsync(fh.fileno())
+            # lands, meta and snapshot agree by construction. The meta
+            # now carries the snapshot's whole-file digest — recovery
+            # recomputes it before trusting the bytes.
+            try:
+                with open(meta_path + ".tmp", "w", encoding="utf-8") as fh:
+                    json.dump(
+                        {
+                            "seq": payload["seq"],
+                            "epoch": payload["epoch"],
+                            "crc": _integrity.file_crc32(tmp_path),
+                        },
+                        fh,
+                    )
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            except BaseException:
+                for leftover in (meta_path + ".tmp", tmp_path):
+                    try:
+                        os.unlink(leftover)
+                    except OSError:
+                        pass
+                raise
             os.replace(meta_path + ".tmp", meta_path)
             os.replace(tmp_path, snap_path)
             self._journal.rotate()
+            if directive == "bitrot":
+                # post-publish decay of the snapshot itself: the rename
+                # landed cleanly, then a byte rotted — the next reopen's
+                # digest check must quarantine it, never replay it
+                _integrity.corrupt_byte(snap_path)
+            elif directive == "short":
+                with open(snap_path, "r+b") as fh:
+                    fh.truncate(max(1, os.path.getsize(snap_path) // 2))
         finally:
             for coll in acquired.values():
                 coll._lock.release()

@@ -1,0 +1,208 @@
+"""Storage integrity plane: end-to-end CRC32 checksums + the one
+sanctioned atomic writer for small control files.
+
+Everything the durable plane persists trusts the disk it lands on; this
+module is where that trust is checked.  Three surfaces:
+
+- **WAL line stamps** — every line the journal writes gains a trailing
+  ``"k":"<crc32 hex>"`` field computed over the full line *before* the
+  stamp was spliced (so verification strips the stamp, restores the
+  closing brace, and recompares).  The format is versioned by absence:
+  an unstamped line (pre-integrity WALs, hand-written fixtures) verifies
+  as ``None`` — accepted on replay for upgrade compatibility — while a
+  stamped line that fails the recompute is *corrupt* and marks the end
+  of the valid prefix (storage/durable.py, storage/replica.py).
+- **Snapshot digests** — checkpoints record a whole-file CRC in the
+  ``.meta`` sidecar; recovery recomputes before trusting the bytes and
+  quarantines a mismatch aside as ``<name>.corrupt-<ts>`` rather than
+  replaying bitrot as truth.
+- **``atomic_write_json``** — the shared checksummed tmp+rename writer
+  for manifests and lease files.  It embeds a ``"k"`` digest in the
+  document (``verify_doc`` on the read side), fires a disk-fault seam
+  *mid-write* (so injected ENOSPC/EIO land with the tmp file already on
+  disk — the stranded-``.tmp`` shape the except-path must clean up),
+  and implements the ``short`` / ``bitrot`` fault directives
+  (utils/faults.py) so every consumer of the helper inherits the whole
+  fault vocabulary.
+
+The WAL stamp can be disabled (``set_wal_crc_enabled``) so
+tools/perf_guard.py can measure the stamping overhead against an
+unstamped arm; production never turns it off.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import zlib
+from typing import Optional
+
+#: suffix pattern of a stamped WAL line: the stamp is ALWAYS the final
+#: field, spliced after the journal's ``"s"`` ordinal, so verification
+#: is a tail match + one crc32 over the restored original
+_WAL_STAMP_RE = re.compile(r',"k":"([0-9a-f]{8})"\}$')
+
+_WAL_CRC_ENABLED = True
+
+
+def wal_crc_enabled() -> bool:
+    return _WAL_CRC_ENABLED
+
+
+def set_wal_crc_enabled(on: bool) -> bool:
+    """Toggle WAL line stamping (perf_guard's unstamped arm). Returns
+    the previous setting so callers can restore it."""
+    global _WAL_CRC_ENABLED
+    prev = _WAL_CRC_ENABLED
+    _WAL_CRC_ENABLED = bool(on)
+    return prev
+
+
+def crc32_hex(data) -> str:
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return "%08x" % (zlib.crc32(data) & 0xFFFFFFFF)
+
+
+def stamp_wal_line(line: str) -> str:
+    """Splice the CRC stamp into a serialized WAL line (which must end
+    ``}``). The digest covers the line as it stood BEFORE the splice."""
+    return '%s,"k":"%s"}' % (line[:-1], crc32_hex(line))
+
+
+def verify_wal_line(line) -> Optional[bool]:
+    """Three-valued verdict on one terminated WAL line (str or bytes,
+    trailing newline tolerated): ``None`` = unstamped old-format line
+    (accepted), ``True`` = stamp matches, ``False`` = corrupt."""
+    if isinstance(line, bytes):
+        try:
+            line = line.decode("utf-8")
+        except UnicodeDecodeError:
+            # a bitrot-ed byte can break the encoding itself; if any
+            # stamp-shaped tail survives, the line claims integrity it
+            # cannot prove — corrupt, not old-format
+            return False if b'"k":"' in line else None
+    line = line.rstrip("\n")
+    m = _WAL_STAMP_RE.search(line)
+    if m is None:
+        return None
+    original = line[: m.start()] + "}"
+    return crc32_hex(original) == m.group(1)
+
+
+def file_crc32(path: str) -> str:
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return "%08x" % (crc & 0xFFFFFFFF)
+
+
+# -- checksummed documents (manifest entries, lease files) ----------------- #
+
+def stamped_doc(doc: dict) -> dict:
+    """Return a copy of ``doc`` carrying a ``"k"`` CRC over its own
+    canonical serialization (sorted keys, ``"k"`` excluded)."""
+    body = {k: v for k, v in doc.items() if k != "k"}
+    payload = dict(body)
+    payload["k"] = crc32_hex(
+        json.dumps(body, sort_keys=True, separators=(",", ":"), default=str)
+    )
+    return payload
+
+
+def verify_doc(doc) -> Optional[bool]:
+    """``None`` = no stamp (old-format document, accepted), ``True`` =
+    stamp matches, ``False`` = corrupt."""
+    if not isinstance(doc, dict) or "k" not in doc:
+        return None
+    return stamped_doc(doc)["k"] == doc["k"]
+
+
+# -- fault helpers --------------------------------------------------------- #
+
+def corrupt_byte(path: str, offset: Optional[int] = None) -> None:
+    """Flip one byte in ``path`` in place — the post-write bitrot the
+    ``bitrot`` fault directive models (and tests inject directly)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    if offset is None or not (0 <= offset < size):
+        offset = size // 2
+    with open(path, "r+b") as fh:
+        fh.seek(offset)
+        byte = fh.read(1)
+        fh.seek(offset)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def quarantine(path: str) -> Optional[str]:
+    """Move a corrupt file aside as ``<path>.corrupt-<ts>`` (never
+    deleted — the forensic copy the scrub runbook inspects). Returns the
+    quarantine path, or None if the file was already gone."""
+    dest = "%s.corrupt-%d" % (path, int(time.time() * 1000))
+    try:
+        os.replace(path, dest)
+    except OSError:
+        return None
+    return dest
+
+
+# -- the shared atomic checksummed writer ---------------------------------- #
+
+def atomic_write_json(
+    path: str,
+    doc: dict,
+    seam: Optional[str] = None,
+    tmp_tag: Optional[str] = None,
+    fsync: bool = False,
+) -> dict:
+    """Atomically write ``doc`` (plus its ``"k"`` stamp) to ``path`` via
+    tmp+rename.  The single sanctioned write path for manifests and
+    lease files (evglint's diskcheck pass flags bypasses).
+
+    ``seam`` names a utils/faults.py seam fired with the tmp file
+    already open: an injected ``enospc``/``eio`` raises from inside the
+    write — and the except path unlinks the tmp, so a full disk never
+    strands a ``.tmp`` or publishes a truncated document.  The ``short``
+    directive truncates the tmp before the rename (a torn publish the
+    CRC catches at read); ``bitrot`` corrupts one byte after the rename
+    (silent post-write decay, likewise caught by ``verify_doc``).
+
+    Returns the stamped payload that landed."""
+    payload = stamped_doc(doc)
+    tmp = "%s.%s" % (path, tmp_tag or "tmp")
+    directive = None
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            if seam:
+                from ..utils import faults
+
+                directive = faults.fire(seam)
+            json.dump(payload, fh, separators=(",", ":"), default=str)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        if directive == "short":
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as fh:
+                fh.truncate(max(1, size // 2))
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed write must not strand its tmp: a full disk is exactly
+        # when leaked tmp files hurt most (satellite regression — the
+        # old manifest writer leaked here)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if directive == "bitrot":
+        corrupt_byte(path)
+    return payload

@@ -127,9 +127,21 @@ class FileLease:
     def _read(self) -> Optional[dict]:
         try:
             with open(self.path, encoding="utf-8") as fh:
-                return json.load(fh)
-        except (OSError, json.JSONDecodeError):
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            # ValueError covers both malformed JSON and bitrot bytes
+            # that break the UTF-8 decode itself
             return None
+        from . import integrity as _integrity
+
+        if _integrity.verify_doc(doc) is False:
+            # a bitrot-ed lease is indistinguishable from garbage: treat
+            # it exactly like an unreadable file — the holder cannot
+            # prove ownership through rot, and a sufficiently old file
+            # stays stealable (try_acquire's mtime path). Unstamped
+            # documents (pre-integrity holders) verify as None and pass.
+            return None
+        return doc
 
     def peek(self) -> Optional[dict]:
         """Current lease file content (any holder's), or None. The durable
@@ -145,10 +157,17 @@ class FileLease:
         }
 
     def _write(self) -> None:
-        tmp = f"{self.path}.{self.owner_id}"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(self._payload(), fh)
-        os.replace(tmp, self.path)
+        # the shared checksummed writer: CRC-stamped payload, atomic
+        # tmp+rename, guaranteed tmp cleanup on a failed write, and the
+        # lease.write disk-fault seam (enospc/eio/short/bitrot)
+        from . import integrity as _integrity
+
+        _integrity.atomic_write_json(
+            self.path,
+            self._payload(),
+            seam="lease.write",
+            tmp_tag=self.owner_id,
+        )
 
     # -- epoch floor (monotonicity across unlink cycles) ---------------------- #
 
@@ -186,8 +205,10 @@ class FileLease:
         except FileExistsError:
             return False
         self.epoch = epoch
+        from . import integrity as _integrity
+
         with os.fdopen(fd, "w", encoding="utf-8") as fh:
-            json.dump(self._payload(), fh)
+            json.dump(_integrity.stamped_doc(self._payload()), fh)
         self._bump_epoch_floor(epoch)
         return True
 

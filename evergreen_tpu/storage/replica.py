@@ -32,6 +32,17 @@ frame commit→apply gap) against the configured bound, and
 supersedes an epoch this replica had been serving and applying the new
 holder's first record, so a failover's pre-recovery state is never
 handed to readers.
+
+Integrity (storage/integrity.py): the tailer CRC-verifies every
+terminated line before parsing it. A failed stamp marks the end of this
+replica's valid prefix — counted into ``wal_corrupt_frames_total``,
+never applied, and serving CONTINUES on the prefix already absorbed.
+The replica then performs READ-REPAIR: as soon as the primary's
+checkpoint watermark moves past what this replica holds, it re-snapshots
+from the primary's published checkpoint (digest-verified; counted in
+``replica_read_repairs_total``) instead of ever parsing past the rot,
+so staleness stays bounded by the primary's checkpoint cadence rather
+than growing without bound.
 """
 from __future__ import annotations
 
@@ -43,7 +54,13 @@ from ..utils import lockcheck as _lockcheck
 import time as _time
 from typing import Callable, Dict, Iterable, Optional
 
-from .durable import SNAPSHOT_FILE, SNAPSHOT_META_SUFFIX, WAL_FILE
+from . import integrity as _integrity
+from .durable import (
+    SNAPSHOT_FILE,
+    SNAPSHOT_META_SUFFIX,
+    WAL_CORRUPT_FRAMES,
+    WAL_FILE,
+)
 from .store import Collection, Store, apply_wal_record
 from ..utils import metrics as _metrics
 
@@ -68,6 +85,14 @@ REPLICA_FENCE_BLOCKED = _metrics.counter(
     "observed a fence marker (a new lease holder exists) but has not "
     "yet applied any of the new holder's frames.",
     labels=("replica",),
+)
+REPLICA_READ_REPAIRS = _metrics.counter(
+    "replica_read_repairs_total",
+    "Re-snapshots from the primary's checkpoint forced by a CRC-failed "
+    "local WAL prefix: the follower refuses to parse past the rot and "
+    "repairs from published, digest-verified state instead.",
+    labels=("replica",),
+    legacy="storage.replica_read_repairs",
 )
 
 
@@ -222,6 +247,12 @@ class ReplicaStore(Store):
         #: previous generation is invalid even when the new file already
         #: grew past it
         self._wal_ino: Optional[int] = None
+        #: read-repair state: a CRC-failed line ended this replica's
+        #: valid prefix. ``_corrupt_mark`` ((inode, offset) of the rotten
+        #: line) keeps the corrupt-frame counter from re-firing on every
+        #: poll that re-encounters the same bytes.
+        self._repair_pending = False
+        self._corrupt_mark: Optional[tuple] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._load_snapshot()
@@ -335,8 +366,34 @@ class ReplicaStore(Store):
         self._snap_stat = self._snapshot_stat()
         snap = {"collections": {}}
         if os.path.exists(snap_path):
-            with open(snap_path, encoding="utf-8") as fh:
-                snap = json.load(fh)
+            # digest-verify before trusting the bytes: a replica must
+            # never swap its served (stale but valid) state for rot. On
+            # a mismatch — or a parse failure — keep serving what we
+            # have; the primary's own reopen/scrub quarantines and
+            # republishes, and a later poll retries against the fresh
+            # stat. Metas without a digest load unchecked (upgrade
+            # compatibility with pre-integrity checkpoints).
+            meta = self._read_meta()
+            try:
+                bad = bool(
+                    meta
+                    and meta.get("crc")
+                    and _integrity.file_crc32(snap_path) != meta["crc"]
+                )
+                if not bad:
+                    with open(snap_path, encoding="utf-8") as fh:
+                        snap = json.load(fh)
+            except (OSError, ValueError):
+                bad = True
+            if bad:
+                from ..utils.log import get_logger
+
+                get_logger("resilience").warning(
+                    "replica-snapshot-rejected",
+                    replica=self.replica_id,
+                    snapshot=snap_path,
+                )
+                return
         loaded = snap.get("collections", {})
         # the snapshot's epoch watermark re-seeds the fence point after
         # the primary's compaction truncated the WAL; a snapshot at (or
@@ -355,6 +412,12 @@ class ReplicaStore(Store):
         self._wal_pos = 0
         self._base_seq = int(snap.get("seq", 0) or 0)
         self._line_seq = 0
+        # a full reload adopts the primary's published cut wholesale —
+        # including a rebased line numbering after the primary's own
+        # integrity heal — which by construction repairs a corrupt-prefix
+        # stall (the cut is always at/after the rot)
+        self._repair_pending = False
+        self._corrupt_mark = None
         self.full_reloads += 1
         REPLICA_FULL_RELOADS.inc(replica=self.replica_id)
 
@@ -384,6 +447,26 @@ class ReplicaStore(Store):
         wal_path = os.path.join(self.data_dir, WAL_FILE)
         applied = 0
         gap_ms = 0.0
+        if self._repair_pending:
+            # READ-REPAIR: our local WAL prefix ended at a CRC-failed
+            # line. The moment the primary's checkpoint watermark moves
+            # past what we hold, re-snapshot from its published (digest-
+            # verified) checkpoint instead of ever parsing past the rot.
+            # Until then, keep serving the valid prefix — staleness is
+            # bounded by the primary's checkpoint cadence, not by the
+            # corruption.
+            meta = self._read_meta()
+            if meta is not None and int(meta.get("seq", -1)) > self.applied_seq:
+                REPLICA_READ_REPAIRS.inc(replica=self.replica_id)
+                from ..utils.log import get_logger
+
+                get_logger("resilience").warning(
+                    "replica-read-repair",
+                    replica=self.replica_id,
+                    applied_seq=self.applied_seq,
+                    checkpoint_seq=int(meta.get("seq", 0) or 0),
+                )
+                self._load_snapshot()
         for _pass in range(2):
             size, ino = self._wal_stat(wal_path)
             rotated = size < self._wal_pos or (
@@ -412,6 +495,10 @@ class ReplicaStore(Store):
                     self._base_seq = int(meta.get("seq", 0) or 0)
                     self._line_seq = 0
                     self._wal_pos = 0
+                    # a rotation leaves any rotten bytes behind in the
+                    # old generation: the fresh log starts clean
+                    self._repair_pending = False
+                    self._corrupt_mark = None
                     self._note_epoch(
                         int(meta.get("epoch", 0) or 0), marker=False
                     )
@@ -491,10 +578,33 @@ class ReplicaStore(Store):
                         # torn tail (primary mid-append): retry next poll
                         self._wal_pos = line_start
                         break
+                    verdict = _integrity.verify_wal_line(line)
+                    if verdict is False:
+                        # CRC-failed line: end of THIS replica's valid
+                        # prefix. Never applied, never fatal — serving
+                        # continues on what was absorbed; the poll loop's
+                        # read-repair re-snapshots from the primary's
+                        # next checkpoint. The (inode, offset) mark keeps
+                        # re-encounters of the same rotten bytes from
+                        # re-counting.
+                        mark = (self._wal_ino, line_start)
+                        if mark != self._corrupt_mark:
+                            self._corrupt_mark = mark
+                            self._repair_pending = True
+                            WAL_CORRUPT_FRAMES.inc()
+                            from ..utils.log import get_logger
+
+                            get_logger("resilience").error(
+                                "replica-corrupt-frame",
+                                replica=self.replica_id,
+                                offset=line_start,
+                            )
+                        self._wal_pos = line_start
+                        break
                     self._wal_pos = fh.tell()
                     try:
                         rec = json.loads(line)
-                    except json.JSONDecodeError:
+                    except (ValueError, UnicodeDecodeError):
                         # a TERMINATED line that doesn't parse can never
                         # become valid — skipping it loses one record
                         # but halting here would stall replication
@@ -517,6 +627,13 @@ class ReplicaStore(Store):
                     s = rec.get("s")
                     if s:
                         self._line_seq = max(self._line_seq, int(s))
+                        if int(s) <= self._base_seq:
+                            # already folded into the snapshot base we
+                            # loaded: after a read-repair reload the same
+                            # (unrotated) generation replays from zero,
+                            # and re-applying a pre-cut record behind the
+                            # newer base would regress documents
+                            continue
                     e = int(rec.get("e", 0) or 0)
                     if e and e < self._max_epoch:
                         # superseded-epoch write (group frame OR per-op

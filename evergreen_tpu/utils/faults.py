@@ -35,6 +35,20 @@ Instrumented seams:
                         duplicate-dispatch kill point
   ``recovery.pass``     fires at the start of the startup reconciliation
                         pass (scheduler/recovery.py)
+  ``snapshot.write``    fires inside ``checkpoint()`` before the snapshot
+                        tmp is written (storage/durable.py) — ``enospc``/
+                        ``eio`` model the checkpoint failing loudly;
+                        ``bitrot``/``short`` corrupt/truncate the
+                        PUBLISHED snapshot after the rename, the silent
+                        decay recovery's digest check must catch
+  ``manifest.write``    fires mid-write inside the shared checksummed
+                        writer for fleet manifest entries
+                        (storage/integrity.py atomic_write_json via
+                        runtime/manifest.py) — the tmp file is already
+                        open when the fault lands, so the stranded-tmp
+                        cleanup path is what's under test
+  ``lease.write``       same seam for lease-file publishes
+                        (storage/lease.py _write)
 
 A plan is installed explicitly (``install(plan)`` — tests, the fault
 matrix soak) or via the ``EVG_FAULTS`` env spec at import time:
@@ -53,9 +67,17 @@ Fault kinds:
              ``delay_s`` sleep) — lets a test run arbitrary work at the
              seam, e.g. stealing the lease between begin_tick and the
              group flush
-  anything else (``torn``, ``lost``, …) is returned to the seam as a
-  directive string — the seam implements the special behavior (e.g. the
-  WAL writes half a record, the lease reports itself stolen).
+  ``enospc`` raise ``OSError(errno.ENOSPC)`` — a full disk AT the seam;
+             the WAL commit path converts it into a loud SHED + RED
+             floor instead of a mid-commit raise
+  ``eio``    raise ``OSError(errno.EIO)`` — a hard I/O error surfacing
+             to the writer (handled like any other disk raise: deferred
+             error, degraded tick, heal)
+  anything else (``torn``, ``short``, ``bitrot``, ``lost``, …) is
+  returned to the seam as a directive string — the seam implements the
+  special behavior (the WAL writes half a record, the atomic writer
+  truncates its tmp or flips a published byte, the lease reports itself
+  stolen).
 
 Schedules are per-seam call indices, so a seeded run replays exactly:
 ``FaultPlan.seeded(seed, {"wal.append": 0.1})`` derives the firing
@@ -182,6 +204,16 @@ class FaultPlan:
             if fault.fn is not None:
                 fault.fn()
             return None
+        if fault.kind == "enospc":
+            import errno as _errno
+
+            raise OSError(
+                _errno.ENOSPC, f"injected ENOSPC at {seam}"
+            )
+        if fault.kind == "eio":
+            import errno as _errno
+
+            raise OSError(_errno.EIO, f"injected EIO at {seam}")
         return fault.kind
 
 

@@ -1,4 +1,4 @@
-"""evglint (ISSUE 15): the shared static-analysis core, the six passes,
+"""evglint (ISSUE 15): the shared static-analysis core, the seven passes,
 the suppression contract, and — the load-bearing regression — a fully
 clean tree (every finding the passes surfaced in existing code is fixed
 or carries a justified suppression; anything NEW fails here before it
@@ -10,6 +10,7 @@ import pytest
 from tools.evglint import core
 from tools.evglint.passes import (
     ALL_PASSES,
+    diskcheck,
     fencecheck,
     lockgraph,
     metricscheck,
@@ -291,6 +292,41 @@ def test_fencecheck_exempts_storage_and_unrelated_paths():
 
 
 # --------------------------------------------------------------------------- #
+# diskcheck
+# --------------------------------------------------------------------------- #
+
+
+def test_diskcheck_flags_unstamped_store_write_in_durable_plane():
+    m = mod("evergreen_tpu/runtime/x.py", """\
+        import os
+
+        def publish(data_dir):
+            snap = os.path.join(data_dir, "snapshot.json")
+            with open(snap + ".tmp", "w") as f:
+                f.write("{}")
+            os.replace(snap + ".tmp", snap)
+        """)
+    assert len(run_on(diskcheck, m)) == 2
+
+
+def test_diskcheck_exempts_sanctioned_writers_and_other_packages():
+    sanctioned = mod("evergreen_tpu/storage/durable.py", """\
+        import os
+
+        def checkpoint(data_dir):
+            with open(os.path.join(data_dir, "snapshot.tmp"), "w") as f:
+                f.write("{}")
+        """)
+    elsewhere = mod("evergreen_tpu/scheduler/x.py", """\
+        import os
+
+        def fine(data_dir):
+            os.rename(os.path.join(data_dir, "wal.log"), "/tmp/x")
+        """)
+    assert run_on(diskcheck, sanctioned, elsewhere) == []
+
+
+# --------------------------------------------------------------------------- #
 # shedcheck
 # --------------------------------------------------------------------------- #
 
@@ -399,7 +435,7 @@ def test_metrics_lint_cli_is_the_sixth_pass():
 
 
 # --------------------------------------------------------------------------- #
-# THE regression test: the whole tree is clean under all six passes
+# THE regression test: the whole tree is clean under all seven passes
 # --------------------------------------------------------------------------- #
 
 

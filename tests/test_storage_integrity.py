@@ -1,0 +1,382 @@
+"""Storage integrity plane (ISSUE 19): end-to-end checksums, disk-fault
+seams, and self-healing recovery.
+
+Covers the integrity primitives (WAL line stamps, checksummed atomic
+JSON publishes, snapshot digests), the detection contract (a CRC-failed
+frame ends the valid prefix — counted, never applied, never a halt),
+the self-heal paths (scrub → quarantine + rebuild, ENOSPC shed + heal,
+replica read-repair), upgrade compatibility (unstamped pre-CRC logs
+replay cleanly under a stamping binary), and the new vocabulary's
+reachability (scenario ``disk_fault`` events, fuzz disk weathers, the
+perf guard's checksum-overhead arm). The exhaustive seams x kinds x
+configs sweep runs under ``make disk-matrix`` (tools/disk_matrix.py);
+tier-1 keeps one representative of each failure class.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from evergreen_tpu.storage import integrity
+from evergreen_tpu.storage.durable import (
+    SNAPSHOT_FILE,
+    WAL_FILE,
+    DurableStore,
+)
+from evergreen_tpu.utils import faults
+from evergreen_tpu.utils.log import get_counter
+
+
+def _delta(before: dict, name: str) -> int:
+    return get_counter(name) - before.get(name, 0)
+
+
+def _counters() -> dict:
+    from evergreen_tpu.utils.log import counters_snapshot
+
+    return counters_snapshot()
+
+
+def _tick(store, t: int) -> None:
+    store.collection("oplog").upsert({"_id": f"op-{t}", "t": t})
+    store.begin_tick()
+    try:
+        jobs = store.collection("jobs")
+        for j in range(3):
+            jobs.upsert({"_id": f"job-{t}-{j}", "tick": t})
+    finally:
+        store.end_tick()
+
+
+def _canonical(store) -> dict:
+    return {
+        name: sorted(store.collection(name).find(),
+                     key=lambda d: d["_id"])
+        for name in sorted(store._collections)
+    }
+
+
+# --------------------------------------------------------------------------- #
+# primitives
+# --------------------------------------------------------------------------- #
+
+
+def test_wal_line_stamp_roundtrip():
+    line = json.dumps({"op": "upsert", "doc": {"_id": "x"}})
+    stamped = integrity.stamp_wal_line(line)
+    assert stamped.endswith("}")
+    assert integrity.verify_wal_line(stamped) is True
+    # tampering anywhere in the payload fails the stamp
+    tampered = stamped.replace('"x"', '"y"')
+    assert integrity.verify_wal_line(tampered) is False
+    # a pre-CRC line has no verdict (upgrade compat, not a failure)
+    assert integrity.verify_wal_line(line) is None
+
+
+def test_stamped_doc_roundtrip(tmp_path):
+    path = str(tmp_path / "doc.json")
+    integrity.atomic_write_json(path, {"pid": 42, "sock": "/tmp/x"})
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert integrity.verify_doc(doc) is True
+    doc["pid"] = 43  # tamper
+    assert integrity.verify_doc(doc) is False
+
+
+def test_atomic_write_failure_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "doc.json")
+    integrity.atomic_write_json(path, {"v": 1}, seam="manifest.write")
+    plan = faults.FaultPlan().at("manifest.write", 0,
+                                 faults.Fault("enospc"))
+    faults.install(plan)
+    try:
+        with pytest.raises(OSError):
+            integrity.atomic_write_json(path, {"v": 2},
+                                        seam="manifest.write")
+    finally:
+        faults.uninstall()
+    # the failed publish vanished: old doc intact, no stranded tmp
+    with open(path, encoding="utf-8") as fh:
+        assert json.load(fh)["v"] == 1
+    assert os.listdir(str(tmp_path)) == ["doc.json"]
+
+
+# --------------------------------------------------------------------------- #
+# WAL: upgrade compat + corrupt-frame prefix
+# --------------------------------------------------------------------------- #
+
+
+def test_unstamped_wal_replays_under_stamping_binary(tmp_path):
+    data_dir = str(tmp_path)
+    prev = integrity.set_wal_crc_enabled(False)
+    try:
+        old = DurableStore(data_dir)
+        for t in range(3):
+            _tick(old, t)
+        old.sync_persist()
+        live = _canonical(old)
+    finally:
+        integrity.set_wal_crc_enabled(prev)
+    reopened = DurableStore(data_dir)
+    assert reopened.replay_report["corrupt_frames"] == 0
+    assert reopened.replay_report["frames"] > 0
+    assert _canonical(reopened) == live
+
+
+def test_corrupt_frame_ends_valid_prefix_never_applied(tmp_path):
+    data_dir = str(tmp_path)
+    store = DurableStore(data_dir)
+    for t in range(4):
+        _tick(store, t)
+    store.sync_persist()
+    wal = os.path.join(data_dir, WAL_FILE)
+    size = os.path.getsize(wal)
+    # rot a byte in the back half: a prefix stays valid
+    integrity.corrupt_byte(wal, int(size * 0.75))
+    before = _counters()
+    reopened = DurableStore(data_dir)
+    # counted, never applied — and open-time self-heal rebuilt a
+    # verified checkpoint with the forensic log kept beside the store
+    assert reopened.replay_report["corrupt_frames"] >= 1
+    assert _delta(before, "storage.rebuilds") >= 1
+    assert any(".corrupt-" in n for n in os.listdir(data_dir))
+    # the healed pair is clean: a second cold open replays it whole
+    again = DurableStore(data_dir)
+    assert again.replay_report["corrupt_frames"] == 0
+    assert _canonical(again) == _canonical(reopened)
+
+
+def test_scrub_convicts_terminated_short_write_stub(tmp_path):
+    data_dir = str(tmp_path)
+    store = DurableStore(data_dir)
+    _tick(store, 0)
+    plan = faults.FaultPlan().at("wal.append", 1, faults.Fault("short"))
+    faults.install(plan)
+    try:
+        _tick(store, 1)  # the per-op append is silently half-written
+        _tick(store, 2)  # the next write terminates the garbage stub
+    finally:
+        faults.uninstall()
+    before = _counters()
+    report = store.scrub()
+    assert report["wal_corrupt_frames"] >= 1
+    assert report["healed"]
+    assert _delta(before, "storage.wal_corrupt_frames") >= 1
+    # post-heal the store reopens to the full in-memory truth
+    assert _canonical(DurableStore(data_dir)) == _canonical(store)
+
+
+# --------------------------------------------------------------------------- #
+# snapshot: digest, quarantine, rebuild
+# --------------------------------------------------------------------------- #
+
+
+def test_snapshot_bitrot_quarantined_and_rebuilt(tmp_path):
+    data_dir = str(tmp_path)
+    store = DurableStore(data_dir)
+    for t in range(3):
+        _tick(store, t)
+    store.checkpoint()
+    snap = os.path.join(data_dir, SNAPSHOT_FILE)
+    integrity.corrupt_byte(snap)
+    before = _counters()
+    report = store.scrub()
+    assert report["snapshot_corrupt"] == 1
+    assert _delta(before, "storage.snapshot_quarantined") == 1
+    assert _delta(before, "storage.rebuilds") >= 1
+    assert any(
+        n.startswith(SNAPSHOT_FILE + ".corrupt-")
+        for n in os.listdir(data_dir)
+    )
+    # the rebuilt snapshot passes its own digest and a cold reopen
+    # resumes to the same state (resume == rerun)
+    with open(snap + ".meta", encoding="utf-8") as fh:
+        meta = json.load(fh)
+    assert meta["crc"] == integrity.file_crc32(snap)
+    assert _canonical(DurableStore(data_dir)) == _canonical(store)
+
+
+def test_quarantined_snapshot_at_open_falls_back_to_wal(tmp_path):
+    data_dir = str(tmp_path)
+    store = DurableStore(data_dir)
+    for t in range(3):
+        _tick(store, t)
+    store.checkpoint()
+    _tick(store, 3)
+    store.sync_persist()
+    truth = _canonical(store)
+    integrity.corrupt_byte(os.path.join(data_dir, SNAPSHOT_FILE))
+    before = _counters()
+    reopened = DurableStore(data_dir)
+    assert reopened.replay_report["snapshots_quarantined"] == 1
+    assert _delta(before, "storage.snapshot_quarantined") == 1
+    # the .prev retention hardlink + WAL still reconstruct everything
+    assert _canonical(reopened) == truth
+
+
+# --------------------------------------------------------------------------- #
+# ENOSPC: shed loudly, heal on the first accepted frame
+# --------------------------------------------------------------------------- #
+
+
+def test_enospc_commit_sheds_then_heals(tmp_path):
+    data_dir = str(tmp_path)
+    store = DurableStore(data_dir)
+    _tick(store, 0)
+    before = _counters()
+    plan = faults.FaultPlan().at("wal.commit", 0,
+                                 faults.Fault("enospc"))
+    faults.install(plan)
+    try:
+        _tick(store, 1)  # the group frame hits the full disk: SHED
+    finally:
+        faults.uninstall()
+    assert _delta(before, "storage.enospc_sheds") == 1
+    assert store._enospc_floor  # overload floor forced RED
+    _tick(store, 2)  # first accepted frame re-covers and heals
+    store.sync_persist()
+    assert not store._enospc_floor
+    # nothing was lost: the shed writes live in memory and the heal
+    # checkpoint re-covered them durably
+    assert _canonical(DurableStore(data_dir)) == _canonical(store)
+
+
+# --------------------------------------------------------------------------- #
+# manifest + lease ride the same checksummed writer
+# --------------------------------------------------------------------------- #
+
+
+def test_manifest_rot_refused_and_enospc_keeps_old_entry(tmp_path):
+    from evergreen_tpu.runtime import manifest
+
+    data_dir = str(tmp_path)
+
+    def write(pid: int) -> None:
+        manifest.write_entry(data_dir, 0, pid=pid, sock="/tmp/s.sock",
+                             generation=1, epoch=2)
+
+    write(os.getpid())
+    entry = manifest.read_entry(data_dir, 0)
+    assert entry and entry["pid"] == os.getpid()
+    integrity.corrupt_byte(manifest.entry_path(data_dir, 0))
+    assert manifest.read_entry(data_dir, 0) is None  # refused, not garbage
+    write(os.getpid())  # next publish self-heals
+    plan = faults.FaultPlan().at("manifest.write", 0,
+                                 faults.Fault("enospc"))
+    faults.install(plan)
+    try:
+        with pytest.raises(OSError):
+            write(99999)
+    finally:
+        faults.uninstall()
+    entry = manifest.read_entry(data_dir, 0)
+    assert entry and entry["pid"] == os.getpid()  # old entry survives
+    fleet = manifest.fleet_dir(data_dir)
+    assert all(n.endswith(".json") for n in os.listdir(fleet))
+
+
+def test_corrupt_lease_unreadable_not_stealable_until_ttl(tmp_path):
+    from evergreen_tpu.storage.lease import FileLease
+
+    path = str(tmp_path / "writer.lease")
+    holder = FileLease(path, ttl_s=10.0)
+    assert holder.acquire(timeout_s=5.0)
+    holder_epoch = holder.epoch
+    integrity.corrupt_byte(path)
+    assert holder.peek() is None  # unreadable, never garbage ownership
+    thief = FileLease(path, ttl_s=1.0)
+    # fresh rot is NOT stealable (the holder may still be renewing)...
+    assert not thief.try_acquire()
+    # ...but aged past TTL it is — rot cannot deadlock the writer role
+    old = time.time() - 60
+    os.utime(path, (old, old))
+    assert thief.try_acquire()
+    assert thief.epoch > holder_epoch  # fencing stays monotone
+    thief.release()
+
+
+# --------------------------------------------------------------------------- #
+# replica: valid-prefix stop + read-repair
+# --------------------------------------------------------------------------- #
+
+
+def test_replica_stops_at_rot_then_read_repairs(tmp_path):
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    data_dir = str(tmp_path)
+    primary = DurableStore(data_dir)
+    for t in range(3):
+        _tick(primary, t)
+    primary.sync_persist()
+    replica = ReplicaStore(data_dir, poll_interval_s=3600.0,
+                           replica_id="t19")
+    try:
+        replica.poll()
+        assert _canonical(replica) == _canonical(primary)
+        consumed = os.path.getsize(os.path.join(data_dir, WAL_FILE))
+        for t in range(3, 5):
+            _tick(primary, t)
+        primary.sync_persist()
+        before = _counters()
+        integrity.corrupt_byte(os.path.join(data_dir, WAL_FILE),
+                               consumed + 16)
+        replica.poll()
+        # counted and skipped — the replica keeps serving its prefix
+        assert _delta(before, "storage.wal_corrupt_frames") >= 1
+        assert _canonical(replica) != _canonical(primary)
+        # the primary's scrub heals; the replica read-repairs from the
+        # fresh verified checkpoint and converges
+        assert primary.scrub()["wal_corrupt_frames"] >= 1
+        replica.poll()
+        assert _delta(before, "storage.replica_read_repairs") >= 1
+        assert _canonical(replica) == _canonical(primary)
+        assert replica.staleness_ms() < 60_000
+    finally:
+        replica.close()
+
+
+# --------------------------------------------------------------------------- #
+# vocabulary reachability: engine event, fuzz weathers, perf arm
+# --------------------------------------------------------------------------- #
+
+
+def test_engine_disk_fault_event_runs_green(store):
+    from evergreen_tpu.scenarios.engine import run_scenario
+    from tools.disk_matrix import _engine_spec
+
+    entry = run_scenario(_engine_spec("wal", "enospc"))
+    bad = {
+        f"{sec}.{name}": v
+        for sec in ("invariants", "checks", "slos")
+        for name, v in entry.get(sec, {}).items()
+        if not v["ok"]
+    }
+    assert entry["ok"], bad
+
+
+def test_fuzzer_draws_disk_fault_weathers():
+    from evergreen_tpu.scenarios import fuzz
+
+    hits = 0
+    for seed in range(fuzz.DEFAULT_CAMPAIGN_SEED,
+                      fuzz.DEFAULT_CAMPAIGN_SEED + 60):
+        spec = fuzz.generate_weather(seed)
+        hits += any(e.kind == "disk_fault" for e in spec.events)
+    assert hits >= 1, "disk_fault vocabulary unreachable from the fuzzer"
+
+
+def test_perf_guard_checksum_clause_bites():
+    from tools.perf_guard import CHECKSUM_FRAC_MAX, evaluate
+
+    base = {"ratio": 0.0, "churn_tick_median_ms": 0,
+            "steady_tick_median_ms": 0, "churn_store_ms": 0}
+    over = dict(base, wal_unstamped_tick_ms=10.0,
+                wal_stamped_tick_ms=14.0, checksum_overhead_ms=4.0)
+    assert any("checksum" in f.lower() for f in evaluate(over, {}))
+    under = dict(base, wal_unstamped_tick_ms=10.0,
+                 wal_stamped_tick_ms=10.2,
+                 checksum_overhead_ms=10.0 * CHECKSUM_FRAC_MAX)
+    assert not evaluate(under, {})

@@ -1,6 +1,7 @@
 """Pass registry. Order is the report order; names are the suppression
 vocabulary (``# evglint: disable=<name> -- reason``)."""
 from . import (  # noqa: F401
+    diskcheck,
     fencecheck,
     lockgraph,
     metricscheck,
@@ -13,6 +14,7 @@ ALL_PASSES = [
     lockgraph,
     tracercheck,
     fencecheck,
+    diskcheck,
     shedcheck,
     seamcheck,
     metricscheck,

@@ -1,0 +1,105 @@
+"""diskcheck — durable-plane writes go through the checksummed writers.
+
+The storage integrity contract (end-to-end CRC32, PR 19) holds because
+every byte the durable plane persists is written by one of the two
+sanctioned paths: the journal/snapshot machinery in
+``storage/durable.py`` (WAL line stamps + snapshot digests) or
+``storage/integrity.py``'s ``atomic_write_json`` (document stamps,
+guaranteed tmp cleanup, the disk-fault seams). A raw ``open(..., 'w')``
+or ``os.replace`` against a store path from elsewhere in the durable
+plane publishes bytes NO DIGEST EVER COVERS: bitrot there replays as
+truth, and an ENOSPC there strands tmp files the way the old manifest
+writer did.
+
+Scope: ``evergreen_tpu/storage/`` and ``evergreen_tpu/runtime/`` — the
+modules that own or sit beside the data dir. (fencecheck polices the
+rest of the tree, where the failure mode is fence bypass rather than
+unstamped bytes; these two passes meet at the storage/ boundary each
+exempts for the other.) ``storage/durable.py`` and
+``storage/integrity.py`` ARE the sanctioned writers, so they are exempt.
+A suppression must name the invariant that makes the unstamped write
+safe (e.g. a self-validating payload).
+
+Heuristic: identical to fencecheck's — a mutating filesystem call whose
+argument text (or local-variable taint) mentions a store-path marker.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Module
+from .fencecheck import _mutator_name, _tainted_names, _MARKERS
+
+NAME = "diskcheck"
+
+#: the durable plane this pass polices
+_SCOPE_PREFIXES = ("evergreen_tpu/storage/", "evergreen_tpu/runtime/")
+#: the sanctioned checksummed writers themselves
+_EXEMPT = (
+    "evergreen_tpu/storage/durable.py",
+    "evergreen_tpu/storage/integrity.py",
+)
+
+
+def run(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        if not m.rel.startswith(_SCOPE_PREFIXES):
+            continue
+        if m.rel in _EXEMPT or "/tests/" in m.rel:
+            continue
+        taint_cache = {}
+        parents = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _mutator_name(node)
+            if name is None:
+                continue
+            seg = m.segment(node).lower()
+            hit = any(mk in seg for mk in _MARKERS)
+            if not hit:
+                anc = node
+                while anc in parents and not isinstance(
+                    anc, ast.FunctionDef
+                ):
+                    anc = parents[anc]
+                if isinstance(anc, ast.FunctionDef):
+                    if anc not in taint_cache:
+                        taint_cache[anc] = _tainted_names(anc, m)
+                    refs = {
+                        n.id for n in ast.walk(node)
+                        if isinstance(n, ast.Name)
+                    }
+                    hit = bool(refs & taint_cache[anc])
+            if hit:
+                findings.append(Finding(
+                    NAME, m.rel, node.lineno,
+                    f"direct {name} against a store path from the "
+                    "durable plane — bytes published here carry no CRC "
+                    "stamp, so bitrot replays as truth and a full disk "
+                    "strands tmp files; route through "
+                    "storage/integrity.py atomic_write_json (or the "
+                    "journal/snapshot machinery) or suppress naming "
+                    "the invariant that makes the unstamped write safe",
+                ))
+    return findings
+
+
+SABOTAGE = {
+    "rel": "evergreen_tpu/storage/sabotage_disk.py",
+    "source": '''\
+import os
+
+
+def publish_unstamped(data_dir):
+    snap = os.path.join(data_dir, "snapshot.json")
+    with open(snap + ".tmp", "w") as f:   # seeded: unstamped tmp write
+        f.write("{}")
+    os.replace(snap + ".tmp", snap)       # seeded: unstamped publish
+''',
+}

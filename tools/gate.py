@@ -90,9 +90,9 @@ def _log_run(rc: int, args: list) -> None:
     # masquerade as a suite-wide green; the only extra args a full run
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
-        a in ("--crash-matrix", "--overload-matrix", "--resident-parity",
-              "--shard-parity", "--capacity-parity", "--read-parity",
-              "--scenarios", "--fleet-runtime", "--fuzz")
+        a in ("--crash-matrix", "--disk-matrix", "--overload-matrix",
+              "--resident-parity", "--shard-parity", "--capacity-parity",
+              "--read-parity", "--scenarios", "--fleet-runtime", "--fuzz")
         for a in args[1:]
     )
     if rc == 0 and full_suite:
@@ -112,13 +112,14 @@ def main() -> int:
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
-    flags = {"--crash-matrix", "--overload-matrix", "--resident-parity",
-             "--shard-parity", "--capacity-parity", "--read-parity",
-             "--scenarios", "--fleet-runtime", "--fuzz"}
+    flags = {"--crash-matrix", "--disk-matrix", "--overload-matrix",
+             "--resident-parity", "--shard-parity", "--capacity-parity",
+             "--read-parity", "--scenarios", "--fleet-runtime", "--fuzz"}
     args = [a for a in sys.argv[1:] if a not in flags]
     with_fleet_runtime = "--fleet-runtime" in sys.argv[1:]
     with_scenarios = "--scenarios" in sys.argv[1:]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
+    with_disk_matrix = "--disk-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
     with_shard_parity = "--shard-parity" in sys.argv[1:]
@@ -152,6 +153,17 @@ def main() -> int:
         print("gate:", " ".join(cm), flush=True)
         rc = subprocess.call(cm, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--crash-matrix")
+    if rc == 0 and with_disk_matrix:
+        # the disk-fault matrix (make disk-matrix): the process LIVES
+        # while the disk rots under it — seams x kinds x store configs
+        # plus engine-driven disk weathers, bespoke integrity cases
+        # (upgrade-compat, manifest, lease, replica read-repair), and
+        # fuzzer disk_fault reachability; every point must detect,
+        # quarantine, self-heal, and hold resume == rerun
+        dm = [sys.executable, os.path.join(root, "tools", "disk_matrix.py")]
+        print("gate:", " ".join(dm), flush=True)
+        rc = subprocess.call(dm, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--disk-matrix")
     if rc == 0 and with_overload_matrix:
         # the storm-soak matrix (make overload-matrix): seeded storms
         # must brown out low-value work only and recover to GREEN

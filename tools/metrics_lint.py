@@ -2,7 +2,7 @@
 """Static lint for the metrics plane (ISSUE 7 satellite) — now a thin
 alias over the evglint ``metrics`` pass (tools/evglint/passes/
 metricscheck.py), where the rules moved verbatim when evglint
-generalized this tool into a six-pass framework (ISSUE 15).
+generalized this tool into a multi-pass framework (ISSUE 15).
 
 CLI, output format, and exit semantics are preserved so ``make
 metrics-lint`` and any scripting against it keep working:

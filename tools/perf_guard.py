@@ -60,6 +60,15 @@ OVERHEAD_SLACK_MS = 0.5
 #: is the median of per-pair deltas, so up to half the pairs can eat a
 #: box spike without moving the verdict
 OVERHEAD_PAIRS = 6
+#: end-to-end WAL checksum arm (ISSUE 19): stamping every frame with a
+#: CRC32 may cost at most this fraction over the unstamped durable tick
+#: (plus OVERHEAD_SLACK_MS of timer noise) — integrity rides on the
+#: serialize+flush it protects, it must never become a tax
+CHECKSUM_FRAC_MAX = 0.03
+#: journaled docs per measured durable tick — big enough that the group
+#: frame carries real serialize+flush work for the stamp to hide behind
+CHECKSUM_DOCS = 1500
+CHECKSUM_PAIRS = 6
 #: bench.py's proof bar: (pack + solve - pipelined) / min(pack, solve).
 #: Overridable via perf_floor.json "overlap_efficiency_min"; a noisy box
 #: gets up to two re-measures before the verdict (best-of).
@@ -218,6 +227,7 @@ def run_guard() -> dict:
     )
     shard = run_sharded_guard(distros, tbd, hbd)
     fused = run_fused_guard()
+    checksum = run_checksum_guard()
     # read-serving plane (ISSUE 11): replica lag, the fingerprint-ETag
     # 304 hit-rate, and the long-poll dispatch soaks at 1k/10k agents —
     # the SAME measurement bench.py publishes (tools/read_parity.py)
@@ -227,6 +237,7 @@ def run_guard() -> dict:
     return {
         **shard,
         **fused,
+        **checksum,
         "read_path": read_path,
         "steady_tick_notrace_ms": round(steady_off_best, 2),
         "steady_tick_trace_ms": round(min(steady_on), 2),
@@ -416,6 +427,81 @@ def run_fused_guard() -> dict:
     }
 
 
+def run_checksum_guard() -> dict:
+    """WAL end-to-end checksum overhead (ISSUE 19): the SAME durable
+    steady tick — one per-op append plus a CHECKSUM_DOCS group frame —
+    with line stamping on vs off, in adjacent pairs with the
+    within-pair order alternating (the instrumentation arm's pattern)
+    and GC quiesced. The verdict is the median of per-pair deltas, with
+    one re-measure before failing on a shared-box spike."""
+    import gc
+    import shutil
+    import tempfile
+
+    from evergreen_tpu.storage import integrity
+    from evergreen_tpu.storage.durable import DurableStore
+
+    data_dir = tempfile.mkdtemp(prefix="perfguard-crc-")
+    store = DurableStore(data_dir)
+    payload = "x" * 160
+    tick_no = [0]
+
+    def one_tick() -> float:
+        tick_no[0] += 1
+        t1 = time.perf_counter()
+        store.collection("oplog").upsert(
+            {"_id": "op-%d" % tick_no[0], "t": tick_no[0]}
+        )
+        store.begin_tick()
+        jobs = store.collection("jobs")
+        for j in range(CHECKSUM_DOCS):
+            jobs.upsert(
+                {"_id": "job-%d" % j, "tick": tick_no[0], "p": payload}
+            )
+        store.end_tick()
+        return (time.perf_counter() - t1) * 1e3
+
+    def measure():
+        prev = integrity.set_wal_crc_enabled(True)
+        gc_was_enabled = gc.isenabled()
+        gc.collect()
+        gc.disable()
+        try:
+            on_ms, off_ms, ds = [], [], []
+            one_tick()  # warm: handles, dict shapes, page cache
+            for pair in range(CHECKSUM_PAIRS):
+                order = (True, False) if pair % 2 == 0 else (False, True)
+                times = {}
+                for on in order:
+                    integrity.set_wal_crc_enabled(on)
+                    times[on] = one_tick()
+                on_ms.append(times[True])
+                off_ms.append(times[False])
+                ds.append(times[True] - times[False])
+            return statistics.median(ds), on_ms, off_ms
+        finally:
+            integrity.set_wal_crc_enabled(prev)
+            if gc_was_enabled:
+                gc.enable()
+
+    try:
+        overhead, on_ms, off_ms = measure()
+        if overhead > min(off_ms) * CHECKSUM_FRAC_MAX + OVERHEAD_SLACK_MS:
+            o2, on2, off2 = measure()
+            if o2 < overhead:
+                overhead, on_ms, off_ms = o2, on2, off2
+    finally:
+        store.close()
+        shutil.rmtree(data_dir, ignore_errors=True)
+    base = min(off_ms)
+    return {
+        "wal_stamped_tick_ms": round(min(on_ms), 2),
+        "wal_unstamped_tick_ms": round(base, 2),
+        "checksum_overhead_ms": round(overhead, 2),
+        "checksum_overhead_frac": round(overhead / max(base, 1e-9), 4),
+    }
+
+
 def evaluate(result: dict, floor: dict) -> list:
     """Returns a list of failure strings (empty = pass)."""
     failures = []
@@ -446,6 +532,27 @@ def evaluate(result: dict, floor: dict) -> list:
                 f"{OVERHEAD_FRAC_MAX:.0%} (+{OVERHEAD_SLACK_MS}ms slack; "
                 f"limit {limit:.2f}ms) — whole-tick tracing must stay "
                 "a rounding error"
+            )
+    checksum = result.get("checksum_overhead_ms")
+    if checksum is not None:
+        base = result.get("wal_unstamped_tick_ms", 0.0)
+        limit = base * CHECKSUM_FRAC_MAX + OVERHEAD_SLACK_MS
+        if checksum > limit:
+            failures.append(
+                f"WAL checksum overhead {checksum}ms over the unstamped "
+                f"durable tick {base}ms exceeds {CHECKSUM_FRAC_MAX:.0%} "
+                f"(+{OVERHEAD_SLACK_MS}ms slack; limit {limit:.2f}ms) — "
+                "end-to-end integrity must ride the flush it protects, "
+                "not tax it"
+            )
+        floor_crc = floor.get("wal_stamped_tick_ms")
+        if floor_crc is not None and result["wal_stamped_tick_ms"] > (
+            floor_crc * (1.0 + REGRESS_FRAC)
+        ):
+            failures.append(
+                f"stamped durable tick {result['wal_stamped_tick_ms']}ms "
+                f"regressed >{int(REGRESS_FRAC * 100)}% over the "
+                f"checked-in floor {floor_crc}ms"
             )
     eff_min = floor.get("overlap_efficiency_min", OVERLAP_EFF_MIN)
     if result.get("overlap_efficiency") is not None and (
@@ -568,6 +675,8 @@ def main() -> int:
         prev["shard_churn_ms"] = result["shard_churn_max_ms"]
         if result.get("fused_tick_ms") is not None:
             prev["fused_tick_ms"] = result["fused_tick_ms"]
+        if result.get("wal_stamped_tick_ms") is not None:
+            prev["wal_stamped_tick_ms"] = result["wal_stamped_tick_ms"]
         p99_1k = result.get("read_path", {}).get("dispatch_p99_1k_ms")
         if p99_1k is not None:
             prev["dispatch_p99_ms"] = p99_1k

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Always-on soak arm (ISSUE 19 satellite): a time-boxed, fresh-seed
+randomized-weather campaign sized by ``SOAK_MINUTES`` (default 10).
+
+    make soak                      # 10 minutes
+    SOAK_MINUTES=120 make soak     # two hours
+    SOAK_SEED=777 make soak        # pin the seed stream (reproduce)
+
+Unlike ``make fuzz`` (pinned seeds, gate-blocking), the soak explores
+NEW weather every run: the sabotage self-test proves the invariant net
+still bites on both backends, then the budget is split between the
+in-process engine arm and the child-process arm (a real supervised
+2-shard fleet under worker SIGKILLs / hangs / supervisor kills). The
+drawn vocabulary includes the ``disk_fault`` weathers — ENOSPC at a
+WAL group commit, snapshot bitrot/short after the rename, EIO — so
+every soak also exercises the storage-integrity plane's detection →
+quarantine → self-heal path.
+
+Findings shrink and land in ``FUZZ_FINDINGS/`` as ready-to-check-in
+regression specs (repo rule: every finding is promoted to
+``evergreen_tpu/scenarios/regressions/`` with its fix). The resulting
+FUZZCARD.json is diffed against FUZZCARD_GREEN.json — new failures or
+a case-throughput collapse fail the soak. See docs/DEPLOY.md for the
+N-hour deployment invocation and triage runbook.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: fraction of the box spent on the child-process (supervised-fleet)
+#: arm; proc cases are ~10x slower per case, so most of the box goes to
+#: in-process breadth and the proc arm gets depth on a few seeds
+PROC_FRACTION = 0.3
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        minutes = float(os.environ.get("SOAK_MINUTES", "10"))
+    except ValueError:
+        print("soak: SOAK_MINUTES must be a number", file=sys.stderr)
+        return 2
+    seed_env = os.environ.get("SOAK_SEED", "")
+    start_seed = int(seed_env) if seed_env else int(time.time())
+    budget_s = max(60.0, minutes * 60.0)
+    # clamp each arm to at least the gate's pinned box (45s engine /
+    # 25s proc, plus proc headroom): FUZZCARD_GREEN was recorded at
+    # that box, and the --diff throughput-collapse check is only
+    # meaningful against an equal-or-bigger box
+    proc_budget = max(budget_s * PROC_FRACTION, 35.0)
+    inproc_budget = max(budget_s - proc_budget, 45.0)
+    # the proc arm's case cap scales with the box (the gate default of
+    # 6 would silently truncate an N-hour soak to minutes of coverage)
+    proc_max_cases = max(6, int(proc_budget / 8.0))
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    fuzz_tool = os.path.join(_REPO_ROOT, "tools", "fuzz_matrix.py")
+
+    print(json.dumps({
+        "soak_minutes": minutes, "start_seed": start_seed,
+        "inproc_budget_s": round(inproc_budget, 1),
+        "proc_budget_s": round(proc_budget, 1),
+    }), flush=True)
+
+    # the net must still bite before any green from it can be trusted
+    sab = [sys.executable, fuzz_tool, "--sabotage"]
+    print("soak:", " ".join(sab), flush=True)
+    rc = subprocess.call(sab, env=env, cwd=_REPO_ROOT)
+    if rc != 0:
+        print("soak: RED — sabotage self-test failed; the invariant "
+              "net is blind, nothing below would mean anything",
+              file=sys.stderr)
+        return rc
+
+    campaign = [
+        sys.executable, fuzz_tool,
+        "--budget", str(inproc_budget),
+        "--proc-budget", str(proc_budget),
+        "--proc-max-cases", str(proc_max_cases),
+        "--start-seed", str(start_seed),
+        "--diff",
+    ]
+    print("soak:", " ".join(campaign), flush=True)
+    rc = subprocess.call(campaign, env=env, cwd=_REPO_ROOT)
+    if rc != 0:
+        print("soak: RED — campaign found failures (shrunk specs in "
+              "FUZZ_FINDINGS/) or throughput collapsed vs green",
+              file=sys.stderr)
+    else:
+        print("soak: green")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
