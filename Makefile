@@ -9,7 +9,7 @@
 # history. `make hooks` additionally installs the pre-commit hook as
 # belt-and-suspenders for anyone committing by hand.
 
-.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix disk-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime fuzz fuzz-soak soak
+.PHONY: test gate hooks bench multichip native commit perf-guard crash-matrix disk-matrix net-matrix overload-matrix resident-parity capacity-parity read-parity metrics-lint lint scenarios fleet-runtime fuzz fuzz-soak soak
 
 commit:
 	@test -n "$(MSG)" || { echo "usage: make commit MSG='message'"; exit 1; }
@@ -55,6 +55,22 @@ crash-matrix:
 # resume == rerun with zero corrupt frames applied.
 disk-matrix:
 	env JAX_PLATFORMS=cpu python tools/disk_matrix.py
+
+# network-chaos matrix (gate-blocking via tools/gate.py --net-matrix):
+# the disk matrix's sibling — the processes LIVE while the wires between
+# them fail. Transport faults (partition one-way + symmetric, drop,
+# delay, duplicate, reorder, half-open) at every seam (supervisor IPC
+# send/recv, socket adoption, solver publish/return, agent request,
+# replica tail) x plane configs (classic, 2-shard fleet, fleet +
+# solver-leader), plus the shipped net weathers, bespoke seam cases
+# (wait_reply reorder/duplication hardening, dispatch-CAS duplicate
+# delivery, adoption half-open, full-jitter retry spread), and fuzzer
+# net_fault reachability with a shrunk deterministic timeline. Every
+# point must detect, degrade boundedly (orphan/fenced-restart — never
+# split-brain, never double-dispatch, stale-accepted == 0), and hold
+# resume == rerun. The unfenced-duplicate sabotage self-test runs first.
+net-matrix:
+	env JAX_PLATFORMS=cpu python tools/net_matrix.py
 
 # storm-soak matrix (fast; tier-1 runs the same cases via
 # tests/test_overload.py): seeded task-churn / event / API / slow-store

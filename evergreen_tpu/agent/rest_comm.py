@@ -41,6 +41,12 @@ class RestCommunicator(Communicator):
             attempts=retries,
             base_backoff_s=backoff_s,
             deadline_s=call_deadline_s or None,
+            # FULL jitter (utils/retry.py): agent failures are
+            # fleet-correlated — every parked agent sees the same
+            # partition heal at the same instant, and a band-limited
+            # jitter would synchronize their retries into one storm.
+            # Uniform-[0, ceiling] pauses spread the reconnect wave.
+            full_jitter=True,
             # faults.FaultError counts as a transport failure so the
             # agent.comm seam exercises THIS retry path whatever fault
             # kind the plan/env spec chooses
@@ -72,8 +78,7 @@ class RestCommunicator(Communicator):
             self._etag_cache.validator(path) if method == "GET" else None
         )
 
-        def attempt() -> dict:
-            faults.fire("agent.comm")
+        def _do_request() -> dict:
             headers = {"Content-Type": "application/json"}
             if self.host_id:
                 headers["Host-Id"] = self.host_id
@@ -107,6 +112,37 @@ class RestCommunicator(Communicator):
                     payload = {"error": str(e)}
                 payload["_status"] = e.code
                 return payload
+
+        def attempt() -> dict:
+            faults.fire("agent.comm")
+            # the per-request-leg transport seam (utils/faults.py
+            # network-chaos vocabulary): agent.comm above stays the
+            # whole-call seam for raise/hang plans
+            directive = faults.fire("agent.request")
+            if directive in ("drop", "partition"):
+                # the request vanished before the server saw it —
+                # retryable; a persistent partition (always-fault)
+                # exhausts the budget and surfaces as ConnectionError
+                raise faults.FaultError(
+                    f"injected {directive} at agent.request: {path}"
+                )
+            if directive == "half_open":
+                # the server DID the work; only the response
+                # black-holed. The retry that follows re-delivers a
+                # request the server already processed — exactly the
+                # duplicate the dispatch CAS must fence.
+                _do_request()
+                raise TimeoutError(
+                    f"injected half_open at agent.request: {path} "
+                    "(response lost after server processing)"
+                )
+            out = _do_request()
+            if directive == "duplicate":
+                # at-least-once transport: the server sees the request
+                # twice; idempotent routes (and the dispatch CAS) must
+                # make the copies agree — serve the later answer
+                out = _do_request()
+            return out
 
         try:
             return self.policy.call(

@@ -103,6 +103,7 @@ def _cmd_service_fleet(args) -> int:
         rebalance_enabled=sharding.rebalance_enabled,
         max_handoffs_per_pass=sharding.max_handoffs_per_round,
         orphan_grace_s=sharding.orphan_grace_s,
+        command_silence_s=sharding.worker_command_silence_s,
         supervisor_lease_ttl_s=sharding.supervisor_lease_ttl_s,
         solver=sharding.solver_leader,
         solver_lease_ttl_s=sharding.solver_lease_ttl_s,

@@ -130,10 +130,39 @@ def remove_entry(data_dir: str, shard: int,
             pass
 
 
+#: half-open peers kept alive for the process lifetime: the dangling
+#: socketpair end must not be garbage-collected, or the "connected"
+#: end would see ECONNRESET and the fault would degrade into a plain
+#: connect error instead of a never-answering peer
+_half_open_peers: list = []
+
+
 def connect(sock_path: str, timeout_s: float = 5.0) -> socket.socket:
     """Connect to a worker's control socket; raises OSError when the
-    worker is gone (the adoption probe's failure path)."""
-    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)  # evglint: disable=seamcheck -- outbound adoption probe over a local unix socket: OSError IS the probe's answer (worker gone), and the fleet-runtime harness drives the failure modes (kill/hang) directly
+    worker is gone (the adoption probe's failure path).
+
+    ``sock.adopt`` transport seam (utils/faults.py): ``drop`` /
+    ``partition`` refuse the connect (the supervisor falls back to a
+    cold spawn), ``half_open`` hands back a connected-looking socket
+    whose peer never answers — the adoption deadline in
+    ``_try_adopt`` must bound it (SIGKILL + cold spawn)."""
+    from ..utils import faults
+
+    directive = faults.fire("sock.adopt")
+    if directive in ("drop", "partition"):
+        import errno as _errno
+
+        raise OSError(
+            _errno.ECONNREFUSED,
+            f"injected {directive} at sock.adopt: {sock_path}",
+        )
+    if directive == "half_open":
+        ours, theirs = socket.socketpair(
+            socket.AF_UNIX, socket.SOCK_STREAM
+        )
+        _half_open_peers.append(theirs)
+        return ours
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)  # evglint: disable=seamcheck -- outbound adoption probe over a local unix socket: OSError IS the probe's answer (worker gone), the sock.adopt fault seam above injects the transport failures, and the fleet-runtime harness drives kill/hang directly
     conn.settimeout(timeout_s)
     conn.connect(sock_path)
     conn.settimeout(None)

@@ -30,7 +30,13 @@ Worker → supervisor ops:
                  process never died)
   ``heartbeat``  liveness beat on ``--hb-interval`` (supervisor kills +
                  restarts a worker that misses its deadline); carries
-                 the cumulative ``stale_rejects`` count
+                 the cumulative ``stale_rejects`` count and the
+                 cumulative ``cmd_silences`` count — command-staleness
+                 orphan entries, the worker's detector for a ONE-WAY
+                 partition where its heartbeats still flow out but no
+                 supervisor command has arrived within the
+                 command-silence deadline (the supervisor mirrors the
+                 delta into scheduler_fleet_command_silence_total)
   ``round``      one tick's result: duration, task/distro counts,
                  degraded reason, overload level, epoch. When the tick
                  carried a solver stamp it also reports ``solve``

@@ -56,6 +56,7 @@ import time as _time
 from queue import Empty, Queue
 from typing import Dict, List, Optional
 
+from ..utils import faults as _faults
 from ..utils import metrics as _metrics
 from ..utils.log import get_logger
 from ..utils.retry import Deadline, RetryPolicy
@@ -153,6 +154,22 @@ FLEET_SUP_EPOCH = _metrics.gauge(
     "This supervisor's fleet-lease fencing epoch (0 until the fleet "
     "lease is acquired; monotone across supervisor restarts).",
 )
+FLEET_CMD_SILENCE = _metrics.counter(
+    "scheduler_fleet_command_silence_total",
+    "Workers that stopped hearing supervisor commands past the "
+    "command-staleness deadline and entered orphan mode (one-way "
+    "partition detection: heartbeats flow out, commands never arrive; "
+    "reported through worker heartbeats), labeled by shard.",
+    labels=("shard",),
+)
+IPC_STALE_REPLIES = _metrics.counter(
+    "runtime_ipc_stale_replies_total",
+    "Late or duplicated control-IPC replies for an already-completed "
+    "request id, counted and dropped by wait_reply so a reordered "
+    "or duplicated reply can never satisfy a newer wait, labeled by "
+    "shard.",
+    labels=("shard",),
+)
 
 _LEVELS = {"green": 0, "yellow": 1, "red": 2, "black": 3}
 
@@ -202,6 +219,18 @@ class WorkerHandle:
         self.adopt_hello: Dict = {}
         self.orphan = False
         self.stale_rejects = 0
+        #: cumulative command-silence orphan entries reported by the
+        #: worker's heartbeats (one-way partition detections)
+        self.cmd_silences = 0
+        #: transport-chaos state: a ``reorder`` directive holds one
+        #: message here until the seam's next message passes it
+        self._send_hold: Optional[dict] = None
+        self._recv_hold: Optional[dict] = None
+        #: completed (answered or timed-out) request ids — a late or
+        #: duplicated reply for one of these is counted and dropped,
+        #: never matched to a newer wait. Insert-ordered for bounded
+        #: pruning.
+        self._done_reqs: Dict[int, None] = {}
 
     @property
     def epoch(self) -> int:
@@ -268,12 +297,40 @@ class WorkerHandle:
             # workers reject anything stamped older than the highest
             # they have observed
             msg["sup"] = self.sup_epoch
+        # transport chaos (utils/faults.py): the generic seam first,
+        # then the shard-scoped alias so a plan can partition ONE
+        # worker. Fired BEFORE the send lock so a delay fault cannot
+        # serialize unrelated shards' commands.
+        directive = _faults.fire("ipc.send") or _faults.fire(
+            f"ipc.send.{self.shard}"
+        )
+        if directive in ("drop", "partition", "half_open"):
+            # the command black-holes: the write would have landed in
+            # a dead buffer, so the sender legitimately sees success —
+            # detection is downstream (reply timeout, the worker's
+            # command-silence deadline)
+            return True
         w = self.proc.stdin if self.proc is not None else self._conn_w
         if w is None:
             return False
+        if directive == "reorder" and self._send_hold is None:
+            self._send_hold = dict(msg)
+            return True
         if _IPC_TAPS:
             _tap_ipc("send", self.shard, msg)
-        return send_msg(w, self.send_lock, **msg)
+        ok = send_msg(w, self.send_lock, **msg)
+        if ok and directive == "duplicate":
+            # at-least-once transport: the worker sees the command
+            # twice — sup-epoch fencing + idempotent ops must absorb it
+            send_msg(w, self.send_lock, **msg)
+        held, self._send_hold = self._send_hold, None
+        if ok and held is not None:
+            # the previously held message goes out AFTER this one: the
+            # minimal adjacent-swap reorder
+            if _IPC_TAPS:
+                _tap_ipc("send", self.shard, held)
+            send_msg(w, self.send_lock, **held)
+        return ok
 
     def next_req(self) -> int:
         self._req_counter += 1
@@ -288,34 +345,49 @@ class WorkerHandle:
         replacement never saw the request)."""
         gen = self.generation
         deadline = Deadline.after(timeout_s)
-        while not deadline.exceeded():
-            try:
-                msg = self.replies.get(
-                    timeout=max(0.05, min(0.25, deadline.remaining()))
-                )
-            except Empty:
-                if not self.alive() or self.generation != gen:
+        try:
+            while not deadline.exceeded():
+                try:
+                    msg = self.replies.get(
+                        timeout=max(0.05, min(0.25, deadline.remaining()))
+                    )
+                except Empty:
+                    if not self.alive() or self.generation != gen:
+                        return None
+                    if self.state == "stopped":
+                        # a crashed supervisor closed this handle
+                        # mid-wait (leader death at a solver seam): no
+                        # reply can arrive on a closed pipe — don't sit
+                        # out the round timeout
+                        return None
+                    continue
+                mreq = msg.get("req")
+                if mreq is not None and mreq in self._done_reqs:
+                    # a duplicated — or reordered-past-its-own-wait —
+                    # reply for a request that already completed (or
+                    # timed out): counted and dropped; it must never
+                    # satisfy a newer wait, not even as its error leg
+                    IPC_STALE_REPLIES.inc(shard=self.shard)
+                    continue
+                if msg["op"] == op and (req is None or mreq == req):
+                    return msg
+                if msg["op"] in ("fenced", "error", "stale_sup") and (
+                    req is None
+                    or mreq is None  # unsolicited (dying worker)
+                    or mreq == req
+                ):
                     return None
-                if self.state == "stopped":
-                    # a crashed supervisor closed this handle mid-wait
-                    # (leader death at a solver seam): no reply can
-                    # arrive on a closed pipe — don't sit out the
-                    # round timeout
-                    return None
-                continue
-            if msg["op"] == op and (
-                req is None or msg.get("req") == req
-            ):
-                return msg
-            if msg["op"] in ("fenced", "error", "stale_sup") and (
-                req is None
-                or msg.get("req") is None  # unsolicited (dying worker)
-                or msg.get("req") == req
-            ):
-                return None
-            # a stale reply — or a stale ERROR from an earlier
-            # timed-out request — must not end an unrelated wait
-        return None
+                # a stale reply — or a stale ERROR from an earlier
+                # timed-out request — must not end an unrelated wait
+            return None
+        finally:
+            # whatever happened to the wait, this request id is spent:
+            # any later delivery carrying it is late or duplicated
+            if req is not None:
+                self._done_reqs[req] = None
+                if len(self._done_reqs) > 1024:
+                    for k in list(self._done_reqs)[:512]:
+                        del self._done_reqs[k]
 
 
 class FleetSupervisor:
@@ -341,6 +413,7 @@ class FleetSupervisor:
         worker_stderr: str = "inherit",
         orphan_grace_s: float = 300.0,
         orphan_tick_s: Optional[float] = None,
+        command_silence_s: float = 0.0,
         supervisor_lease_ttl_s: float = 5.0,
         adopt: bool = True,
         solver: str = "never",
@@ -394,6 +467,14 @@ class FleetSupervisor:
         self.orphan_tick_s = (
             orphan_tick_s if orphan_tick_s is not None else tick_s
         )
+        #: worker-side command-staleness deadline (one-way partition
+        #: detection: the supervisor hears heartbeats, the worker hears
+        #: no commands): after this many seconds without an executed
+        #: command an ATTACHED worker enters orphan mode instead of
+        #: trusting a silent channel forever. 0 (the ctor default)
+        #: disables it — an idle supervisor legitimately sends nothing;
+        #: the service CLI wires ShardingConfig.worker_command_silence_s
+        self.command_silence_s = command_silence_s
         #: fleet-lease TTL = worst-case takeover latency after a
         #: supervisor death (the successor steals once it goes stale)
         self.supervisor_lease_ttl_s = supervisor_lease_ttl_s
@@ -461,6 +542,7 @@ class FleetSupervisor:
             "--generation", str(h.generation),
             "--orphan-grace", str(self.orphan_grace_s),
             "--orphan-tick-s", str(self.orphan_tick_s),
+            "--command-silence-s", str(self.command_silence_s),
         ]
         if self.harness:
             cmd.append("--harness")
@@ -515,55 +597,92 @@ class FleetSupervisor:
                 if msg is None:
                     h.garbage_lines += 1
                     continue
-                if _IPC_TAPS:
-                    _tap_ipc("recv", h.shard, msg)
-                op = msg["op"]
-                if op == "heartbeat":
-                    h.hb_deadline = Deadline.after(h.hb_deadline_s)
-                    h.orphan = bool(msg.get("orphan"))
-                    n = int(msg.get("stale_rejects", 0) or 0)
-                    if n > h.stale_rejects:
-                        FLEET_STALE_REJECTS.inc(
-                            n - h.stale_rejects, shard=h.shard
-                        )
-                        h.stale_rejects = n
+                # transport chaos on the worker→supervisor direction
+                # (utils/faults.py): generic seam, then shard-scoped
+                directive = _faults.fire("ipc.recv") or _faults.fire(
+                    f"ipc.recv.{h.shard}"
+                )
+                if directive in ("drop", "partition", "half_open"):
+                    continue  # the reply/heartbeat never arrives
+                if directive == "reorder" and h._recv_hold is None:
+                    h._recv_hold = msg
                     continue
-                if op == "hello":
-                    h.epochs.append(int(msg.get("epoch", 0)))
-                    h.hb_deadline = Deadline.after(h.hb_deadline_s)
-                    if msg.get("adopted"):
-                        h.adopted = True
-                        h.adopt_hello = dict(msg)
-                        h.orphan = False
-                        h.stale_rejects = int(
-                            msg.get("stale_rejects", 0) or 0
-                        )
-                        self.adoptions_total += 1
-                        FLEET_ADOPTIONS.inc(shard=h.shard)
-                        if msg.get("orphaned"):
-                            self.orphaned_total += 1
-                            FLEET_ORPHANED.inc(shard=h.shard)
-                    h.state = "ready"
-                    h.ready_since = _time.monotonic()
-                    FLEET_WORKERS_UP.set(1, shard=h.shard)
-                    self._log.info(
-                        "fleet-worker-ready", shard=h.shard,
-                        epoch=h.epoch, pid=msg.get("pid"),
-                        adopted=bool(msg.get("adopted")),
-                    )
-                    continue
-                if op == "fenced":
-                    h.fenced_reason = str(msg.get("reason", ""))
-                if op == "stale_sup":
-                    # a worker answering US with stale_sup has seen a
-                    # newer supervisor epoch: we have been deposed
-                    if int(msg.get("sup_seen", 0) or 0) > self.sup_epoch:
-                        self._fleet_deposed(
-                            "a worker observed a newer supervisor epoch"
-                        )
-                h.replies.put(msg)
+                self._handle_recv(h, msg)
+                if directive == "duplicate":
+                    # at-least-once delivery: req-id matching
+                    # (wait_reply) must reject the second copy
+                    self._handle_recv(h, dict(msg))
+                held, h._recv_hold = h._recv_hold, None
+                if held is not None:
+                    # adjacent-swap reorder: the held message lands
+                    # AFTER the one that followed it on the wire
+                    self._handle_recv(h, held)
         except (OSError, ValueError):
             pass  # channel torn down under us (simulate_crash, stop)
+
+    def _handle_recv(self, h: WorkerHandle, msg: dict) -> None:
+        """Dispatch one received protocol message: heartbeats refresh
+        the deadline in place, everything else lands on the reply
+        queue for whoever is mid-request."""
+        if _IPC_TAPS:
+            _tap_ipc("recv", h.shard, msg)
+        op = msg["op"]
+        if op == "heartbeat":
+            h.hb_deadline = Deadline.after(h.hb_deadline_s)
+            h.orphan = bool(msg.get("orphan"))
+            n = int(msg.get("stale_rejects", 0) or 0)
+            if n > h.stale_rejects:
+                FLEET_STALE_REJECTS.inc(
+                    n - h.stale_rejects, shard=h.shard
+                )
+                h.stale_rejects = n
+            # cumulative command-silence orphan entries: the worker's
+            # one-way-partition detections, mirrored into the fleet
+            # counter exactly like the stale-reject deltas
+            n = int(msg.get("cmd_silences", 0) or 0)
+            if n > h.cmd_silences:
+                FLEET_CMD_SILENCE.inc(
+                    n - h.cmd_silences, shard=h.shard
+                )
+                h.cmd_silences = n
+            return
+        if op == "hello":
+            h.epochs.append(int(msg.get("epoch", 0)))
+            h.hb_deadline = Deadline.after(h.hb_deadline_s)
+            if msg.get("adopted"):
+                h.adopted = True
+                h.adopt_hello = dict(msg)
+                h.orphan = False
+                h.stale_rejects = int(
+                    msg.get("stale_rejects", 0) or 0
+                )
+                h.cmd_silences = int(
+                    msg.get("cmd_silences", 0) or 0
+                )
+                self.adoptions_total += 1
+                FLEET_ADOPTIONS.inc(shard=h.shard)
+                if msg.get("orphaned"):
+                    self.orphaned_total += 1
+                    FLEET_ORPHANED.inc(shard=h.shard)
+            h.state = "ready"
+            h.ready_since = _time.monotonic()
+            FLEET_WORKERS_UP.set(1, shard=h.shard)
+            self._log.info(
+                "fleet-worker-ready", shard=h.shard,
+                epoch=h.epoch, pid=msg.get("pid"),
+                adopted=bool(msg.get("adopted")),
+            )
+            return
+        if op == "fenced":
+            h.fenced_reason = str(msg.get("reason", ""))
+        if op == "stale_sup":
+            # a worker answering US with stale_sup has seen a
+            # newer supervisor epoch: we have been deposed
+            if int(msg.get("sup_seen", 0) or 0) > self.sup_epoch:
+                self._fleet_deposed(
+                    "a worker observed a newer supervisor epoch"
+                )
+        h.replies.put(msg)
 
     # -- fleet lease (supervisor fencing) ---------------------------------- #
 

@@ -105,6 +105,13 @@ def _build_parser() -> argparse.ArgumentParser:
                         "immediately, the pre-adoption behavior)")
     p.add_argument("--orphan-tick-s", type=float, default=15.0,
                    help="autonomous local-tick cadence while orphaned")
+    p.add_argument("--command-silence-s", type=float, default=0.0,
+                   help="attached-mode command-staleness deadline: "
+                        "after this many seconds without an executed "
+                        "supervisor command the worker enters orphan "
+                        "mode — one-way partition detection (the "
+                        "supervisor hears our heartbeats, we hear "
+                        "nothing); 0 = disabled")
     # bench mode (tools/bench_sharded_plane.py)
     p.add_argument("--bench", action="store_true")
     p.add_argument("--bench-distros", type=int, default=200)
@@ -204,6 +211,12 @@ class ShardWorker:
         self._orphan_deadline = 0.0
         self._next_orphan_tick = 0.0
         self.orphan_ticks = 0
+        #: command-staleness detection (one-way partition): monotonic
+        #: time of the last EXECUTED supervisor command, and how many
+        #: times the silence deadline tripped (reported in heartbeats,
+        #: mirrored into scheduler_fleet_command_silence_total)
+        self._last_cmd_mono = _time.monotonic()
+        self.cmd_silences = 0
         self.listener = None
         self.sock_path = ""
         #: request id of the command currently being handled — echoed
@@ -287,6 +300,7 @@ class ShardWorker:
                 self.send(
                     op="heartbeat", shard=self.shard, ts=_time.time(),
                     stale_rejects=self.stale_rejects,
+                    cmd_silences=self.cmd_silences,
                     orphan=self.orphaned_at is not None,
                 )
 
@@ -436,7 +450,7 @@ class ShardWorker:
 
     # -- orphan mode ------------------------------------------------------- #
 
-    def _enter_orphan(self) -> None:
+    def _enter_orphan(self, reason: str = "stdin EOF") -> None:
         self.orphaned_at = _time.monotonic()
         self._orphan_deadline = (
             self.orphaned_at + self.args.orphan_grace
@@ -445,7 +459,7 @@ class ShardWorker:
             self.orphaned_at + self.args.orphan_tick_s
         )
         print(
-            f"shard {self.shard}: supervisor gone (stdin EOF) — "
+            f"shard {self.shard}: supervisor gone ({reason}) — "
             f"orphan mode for {self.args.orphan_grace}s "
             f"(lease kept, local ticks every "
             f"{self.args.orphan_tick_s}s)",
@@ -830,6 +844,19 @@ class ShardWorker:
                 self._reject_stale(msg, chan, reason="stale-epoch")
                 return
             self.sup_epoch = sup
+        # an accepted command on the active channel is proof the
+        # supervisor can reach us: refresh the command-staleness clock,
+        # and if a one-way partition had pushed us into orphan mode,
+        # its heal ends it — the supervisor never stopped hearing our
+        # heartbeats, so no adoption handshake is coming to rescue us
+        self._last_cmd_mono = _time.monotonic()
+        if self.orphaned_at is not None and op != "adopt":
+            self.orphaned_at = None
+            print(
+                f"shard {self.shard}: supervisor commands resumed — "
+                "leaving orphan mode (partition healed)",
+                file=sys.stderr,
+            )
         if op == "adopt":  # re-adoption over the already-active channel
             self._handle_adopt(msg, chan)
             return
@@ -850,11 +877,20 @@ class ShardWorker:
         self.open()
         self.start_heartbeat()
         self._start_channel_reader(self.stdio)
+        silence_s = float(
+            getattr(self.args, "command_silence_s", 0.0) or 0.0
+        )
         while True:
             timeout = None
             if self.orphaned_at is not None:
                 due = min(self._orphan_deadline,
                           self._next_orphan_tick)
+                timeout = max(0.0, due - _time.monotonic())
+            elif silence_s > 0:
+                # attached but bounded: wake when the command-staleness
+                # deadline would expire, instead of blocking forever on
+                # a channel that may be one-way partitioned
+                due = self._last_cmd_mono + silence_s
                 timeout = max(0.0, due - _time.monotonic())
             try:
                 kind, payload, chan = self.inbox.get(timeout=timeout)
@@ -871,6 +907,23 @@ class ShardWorker:
                             self._enter_orphan()
                     elif chan is not self.stdio:
                         chan.close()  # a dropped foreign connection
+                if (
+                    self.orphaned_at is None
+                    and silence_s > 0
+                    and self.args.orphan_grace > 0
+                    and _time.monotonic() - self._last_cmd_mono
+                    >= silence_s
+                ):
+                    # one-way partition detected: the channel is open
+                    # (no EOF) but no command has arrived for the whole
+                    # deadline — go orphan instead of trusting a silent
+                    # channel forever; a resumed command heals it
+                    # (_handle_cmd), adoption rescues it, or the orphan
+                    # grace bounds it
+                    self.cmd_silences += 1
+                    self._enter_orphan(
+                        reason=f"command silence {silence_s:g}s"
+                    )
                 if self.orphaned_at is not None:
                     now_m = _time.monotonic()
                     if now_m >= self._orphan_deadline:

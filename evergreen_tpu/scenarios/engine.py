@@ -887,6 +887,131 @@ def ev_disk_fault(
     )
 
 
+#: net_fault targets → the utils/faults.py transport seam each one arms
+_NET_FAULT_SEAMS = {
+    "agent": "agent.request",
+    "replica": "replica.tail",
+    "ipc": "ipc.send",
+    "adopt": "sock.adopt",
+}
+
+
+def _lossy_claim_storm(run: ScenarioRun, agents: int = 0) -> None:
+    """Drive every free host's next-task claim THROUGH the
+    ``agent.request`` transport seam with an at-least-once retry shim —
+    the in-process stand-in for a parked agent fleet re-requesting work
+    across a lossy network. Directive semantics mirror
+    agent/rest_comm.py:
+
+    - ``drop``/``partition``: the request vanished before the server saw
+      it — retry with a fresh attempt.
+    - ``half_open``: the server DID the work but the response
+      black-holed — the claim lands, then the agent retries anyway.
+      That retry is duplicate delivery; the dispatch CAS (and the
+      running-task resume path in dispatch/assign.py) must fence it.
+    - ``duplicate``: the transport delivered the same request twice.
+
+    Every assignment path funnels through ``assign_next_available_task``
+    so the no-duplicate-dispatch invariant audits the result for free.
+    """
+    from ..dispatch.assign import assign_next_available_task
+    from ..dispatch.dag_dispatcher import DispatcherService
+
+    svc = DispatcherService(run.store)  # fresh: no TTL staleness
+    hosts = sorted(
+        (
+            h
+            for h in host_mod.find(run.store)
+            if h.can_run_tasks() and not h.running_task
+        ),
+        key=lambda h: h.id,
+    )
+    if agents:
+        hosts = hosts[:agents]
+
+    def _claim(host_id: str) -> Optional[Task]:
+        cur = host_mod.get(run.store, host_id)
+        if cur is None:
+            return None
+        t = assign_next_available_task(run.store, svc, cur, now=run.now)
+        if t is not None:
+            run.dispatch_tick.setdefault(t.id, run.tick)
+            run.dispatched_total += 1
+        return t
+
+    for h in hosts:
+        for _attempt in range(4):  # bounded at-least-once retry budget
+            directive = faults_mod.fire("agent.request")
+            if directive in ("drop", "partition"):
+                continue  # lost before the server saw it: retry
+            t = _claim(h.id)
+            if directive == "half_open":
+                # response lost after processing — the agent's retry
+                # re-delivers a claim the server already honored
+                _claim(h.id)
+            elif directive == "duplicate":
+                _claim(h.id)
+            if t is not None or directive is None:
+                break
+
+
+def ev_net_fault(
+    run: ScenarioRun,
+    target: str = "agent",
+    kind: str = "drop",
+    rate: float = 0.3,
+    agents: int = 0,
+    at: Optional[int] = None,
+    always: bool = False,
+) -> None:
+    """Arm one network-chaos fault (drop/duplicate/reorder/partition/
+    half_open/delay) at a transport seam, then schedule the
+    follow-through that makes the run CONVERGE despite it.
+
+    The ``agent`` target seeds a replayable lossy window onto the live
+    plan — each upcoming ``agent.request`` call independently faulted
+    with probability ``rate`` from a seed-derived stream — fires a
+    claim storm through it next tick, and clears the seam the tick
+    after, so the partition HEALS inside the replay and resume≡rerun
+    holds at convergence. Other targets arm the seam only (``at``-next
+    or the given absolute index); the weather/matrix case drives its
+    own exercise and clears via ``clear_faults``.
+    """
+    import random as _random
+
+    seam = _NET_FAULT_SEAMS.get(target)
+    if seam is None:
+        raise ValueError(f"unknown net_fault target {target!r}")
+
+    if target != "agent":
+        ev_fault(run, seam=seam, kind=kind, at=at, always=always)
+        return
+
+    # replayable lossy window: derived from (scenario seed, tick), never
+    # wall clock, so a resumed run re-arms the identical window
+    rng = _random.Random((int(run.seed or 0) ^ 0x4E46) + run.tick * 7919)
+    base = run.fault_plan._calls.get(seam, 0)
+    window = max(1, agents or 8) * 4  # matches the storm's retry budget
+    for i in range(window):
+        if rng.random() < max(0.0, min(1.0, rate)):
+            run.fault_plan.at(seam, base + i, Fault(kind))
+
+    storm_agents = agents
+
+    def _storm(r: ScenarioRun) -> None:
+        _lossy_claim_storm(r, agents=storm_agents)
+
+    def _heal(r: ScenarioRun) -> None:
+        ev_clear_faults(r, seam=seam)
+
+    run._events_by_tick.setdefault(run.tick + 1, []).append(
+        Ev(run.tick + 1, "call", {"fn": _storm})
+    )
+    run._events_by_tick.setdefault(run.tick + 2, []).append(
+        Ev(run.tick + 2, "call", {"fn": _heal})
+    )
+
+
 def ev_container_pools(run: ScenarioRun, pools: List[Dict]) -> None:
     """Configure docker container pools (parent distro + capacity)."""
     from ..cloud.docker import ContainerPool, set_container_pools
@@ -919,6 +1044,7 @@ EVENT_HANDLERS: Dict[str, Callable] = {
     "fault": ev_fault,
     "clear_faults": ev_clear_faults,
     "disk_fault": ev_disk_fault,
+    "net_fault": ev_net_fault,
     "container_pools": ev_container_pools,
     "call": ev_call,
 }

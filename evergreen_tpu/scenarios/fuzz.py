@@ -68,6 +68,11 @@ DISK_FAULT_KINDS = ("enospc", "bitrot", "short", "eio")
 #: seams the proc arm SIGKILLs workers at (crash-matrix vocabulary)
 PROC_KILL_SEAMS = ("wal.commit", "wal.append", "lease.renew")
 
+#: the network-chaos vocabulary the generator draws for agent-side
+#: lossy windows (scenarios/engine.py ev_net_fault seeds the window,
+#: fires the claim storm, and heals it so the weather converges)
+NET_FAULT_KINDS = ("drop", "half_open", "duplicate", "partition")
+
 
 # --------------------------------------------------------------------------- #
 # the generator: seed → weather
@@ -209,6 +214,17 @@ def generate_weather(seed: int, sabotage: bool = False) -> ScenarioSpec:
                 "target": drng.choice(DISK_FAULT_TARGETS),
                 "kind": drng.choice(DISK_FAULT_KINDS),
             }))
+
+    # network chaos rides its OWN rng stream for the same reason as the
+    # disk stream above: every pre-existing seed replays byte-identically
+    nrng = random.Random(int(seed) ^ 0x4E4654)
+    if nrng.random() < 0.4:
+        events.append(Ev(nrng.randint(1, span), "net_fault", {
+            "target": "agent",
+            "kind": nrng.choice(NET_FAULT_KINDS),
+            "rate": round(nrng.uniform(0.15, 0.45), 2),
+            "agents": nrng.randint(3, 8),
+        }))
 
     if sabotage:
         from .library import _sabotage_duplicate_claim

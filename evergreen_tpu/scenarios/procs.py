@@ -79,7 +79,8 @@ from .spec import Ev, SLO, ScenarioSpec, scorecard_entry_fingerprint
 #: lands exactly at the publish/solve/return seams of a live round
 PROC_EVENT_KINDS = ("proc_fleet", "proc_kill", "proc_hang",
                     "proc_migrate", "sup_kill", "sup_restart",
-                    "leader_kill", "leader_hang")
+                    "leader_kill", "leader_hang",
+                    "net_fault", "net_heal")
 
 #: the proc analog of spec.DEFAULT_INVARIANTS
 DEFAULT_PROC_INVARIANTS = (
@@ -223,6 +224,10 @@ class ProcScenarioRun:
         #: (process-global — execute()'s finally restores the previous)
         self._armed_faults = False
         self._prev_faults = None
+        #: the accumulating net_fault plan (net_fault/net_heal share
+        #: one installed plan; a later leader_kill would clobber it —
+        #: proc specs never mix the two fault families on one timeline)
+        self._net_plan = None
         self.data_dir: Optional[str] = None
         self.rounds: List[Dict[int, dict]] = []
         self.dispatched_total = 0
@@ -317,6 +322,43 @@ class ProcScenarioRun:
             )
             faults.install(plan)
             self._armed_faults = True
+        elif ev.kind == "net_fault":
+            # arm a transport fault at a supervisor-side seam. The
+            # seams fire in THIS harness process (the supervisor owns
+            # both IPC directions), so shard-scoped aliases like
+            # ``ipc.send.0`` partition ONE worker of the fleet while
+            # its siblings keep talking — the Jepsen one-way-partition
+            # shape. Faults accumulate onto one installed plan until a
+            # ``net_heal`` clears the seam (or the run's finally
+            # restores the pre-replay plan).
+            from ..utils import faults
+
+            seam = ev.args.get("seam", "ipc.send")
+            kind = ev.args.get("kind", "partition")
+            if self._net_plan is None:
+                self._net_plan = faults.FaultPlan()
+                faults.install(self._net_plan)
+                self._armed_faults = True
+            fault = faults.Fault(
+                kind, delay_s=float(ev.args.get("delay_s", 0.0))
+            )
+            if ev.args.get("at") is not None:
+                self._net_plan.at(seam, int(ev.args["at"]), fault)
+            else:
+                self._net_plan.always(seam, fault)
+        elif ev.kind == "net_heal":
+            # the partition heals: clear one seam (or every armed
+            # transport fault) so the degraded side reconnects and the
+            # run converges — resume≡rerun compares POST-heal states
+            seam = ev.args.get("seam", "")
+            plan = self._net_plan
+            if plan is not None:
+                if seam:
+                    plan._at.pop(seam, None)
+                    plan._always.pop(seam, None)
+                else:
+                    plan._at.clear()
+                    plan._always.clear()
 
     def _release_then_crash(self, now: float) -> None:
         """Drive the RELEASE leg of a real migration, then crash the
@@ -416,7 +458,11 @@ class ProcScenarioRun:
             hb_interval_s=0.25,
             hb_deadline_s=1.5,
             tick_s=self.spec.tick_s,
-            round_timeout_s=180.0,
+            # partition weathers shrink this: a black-holed tick
+            # command otherwise blocks the round for the full default
+            round_timeout_s=float(
+                self.workload.get("round_timeout_s", 180.0)
+            ),
             harness=True,
             recovery_anchor=NOW,
             restart_policy=RetryPolicy(
@@ -432,6 +478,13 @@ class ProcScenarioRun:
                 self.workload.get("orphan_grace_s", 60.0)
             ),
             orphan_tick_s=1.0,
+            # command-staleness deadline (one-way-partition detection):
+            # 0 keeps it off unless the weather opts in — a partitioned
+            # worker orphans after this many silent seconds and ticks
+            # locally until commands resume
+            command_silence_s=float(
+                self.workload.get("command_silence_s", 0.0)
+            ),
             supervisor_lease_ttl_s=1.0,
             # solver-leader plane: the workload opts in ("auto"); tight
             # TTL/timeout so leader death degrades and re-elects inside
@@ -548,7 +601,7 @@ class ProcScenarioRun:
     def _has_faults(self) -> bool:
         return any(
             e.kind in ("proc_kill", "proc_hang", "sup_kill",
-                       "leader_kill", "leader_hang")
+                       "leader_kill", "leader_hang", "net_fault")
             for e in self.spec.events
         )
 
@@ -834,7 +887,8 @@ def _reference_canonical(spec: ScenarioSpec,
             e for e in spec.events
             if e.kind not in ("proc_kill", "proc_hang",
                               "sup_kill", "sup_restart",
-                              "leader_kill", "leader_hang")
+                              "leader_kill", "leader_hang",
+                              "net_fault", "net_heal")
         ],
         checks=[],
         slos=[],
@@ -1197,6 +1251,63 @@ def _leader_hang_spec(seed: int = 0) -> ScenarioSpec:
     )
 
 
+def _net_oneway_partition_spec(seed: int = 0) -> ScenarioSpec:
+    """The Jepsen one-way partition: supervisor→worker 0 commands are
+    black-holed at the ``ipc.send.0`` seam while worker 0's heartbeats
+    keep flowing the other way. The heartbeat watchdog must NOT kill a
+    worker it can still hear (no split-brain restart); instead the
+    worker's command-staleness deadline fires — it orphans, keeps its
+    shard lease, and ticks locally — and when the partition heals the
+    next delivered command clears orphan mode in place: zero cold
+    restarts, zero epoch bumps, zero duplicate dispatch."""
+
+    def partition_ridden_out(run: ProcScenarioRun) -> Optional[str]:
+        h = run.sup.handles[0]
+        if h.cmd_silences < 1:
+            return (
+                "worker 0 never tripped its command-staleness "
+                "deadline (cmd_silences == 0)"
+            )
+        if run.stats.get("restarts_total", 0):
+            return "the partitioned worker was cold-restarted"
+        if len(h.epochs) != 1:
+            return (
+                f"worker 0 bumped its shard-lease epoch: {h.epochs}"
+            )
+        return None
+
+    return ScenarioSpec(
+        name="proc-net-oneway-partition",
+        description="2-shard fleet: supervisor→worker commands "
+                    "black-holed one way (heartbeats still flow); the "
+                    "command-staleness deadline orphans the worker, "
+                    "the heal un-orphans it in place — no restart, no "
+                    "epoch bump, no duplicate dispatch",
+        ticks=14,
+        seed=seed,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", {
+                "shards": 2, "distros": 4, "tasks": 32, "seed": 11,
+                "hosts_per_distro": 3,
+                # partitioned rounds must go partial FAST, and the
+                # silence deadline must fire inside the blackout window
+                "round_timeout_s": 4.0, "command_silence_s": 2.0,
+            }),
+            Ev(2, "net_fault", {"seam": "ipc.send.0",
+                                "kind": "partition"}),
+            Ev(5, "net_heal", {"seam": "ipc.send.0"}),
+        ],
+        slos=[
+            SLO("no-worker-restarts", "restarts_total", "<=", 0),
+        ],
+        checks=[("partition-ridden-out", partition_ridden_out)],
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
 PROC_SCENARIOS: Dict[str, callable] = {
     "proc-fleet-sigkill": _proc_sigkill_spec,
     "proc-fleet-hang": _proc_hang_spec,
@@ -1207,6 +1318,7 @@ PROC_SCENARIOS: Dict[str, callable] = {
     "proc-leader-kill-return": _leader_kill_return_spec,
     "proc-leader-kill-midround": _leader_kill_midround_spec,
     "proc-leader-hang": _leader_hang_spec,
+    "proc-net-oneway-partition": _net_oneway_partition_spec,
 }
 
 #: the supervisor-crash subset (tools/crash_matrix.py run_sup_points

@@ -192,6 +192,14 @@ class ShardingConfig(ConfigSection):
     #: exit-on-EOF behavior. This bounds a supervisor outage's blast
     #: radius: restart within the grace = zero lost work
     orphan_grace_s: float = 300.0
+    #: worker-side command-staleness deadline (one-way partition
+    #: detection): an ATTACHED worker that hears no supervisor command
+    #: for this long — while its own heartbeats may still be getting
+    #: through — enters orphan mode (bounded by orphan_grace_s) instead
+    #: of trusting the silent channel forever; a resumed command heals
+    #: it in place. Must comfortably exceed the round cadence; 0
+    #: disables the deadline
+    worker_command_silence_s: float = 120.0
     #: fleet-scope supervisor lease TTL — ALSO the worst-case takeover
     #: latency after a supervisor death (the successor steals the
     #: fencing epoch only once the lease goes stale)
@@ -238,6 +246,8 @@ class ShardingConfig(ConfigSection):
             )
         if self.orphan_grace_s < 0:
             return "orphan_grace_s cannot be negative"
+        if self.worker_command_silence_s < 0:
+            return "worker_command_silence_s cannot be negative"
         if self.supervisor_lease_ttl_s <= 0:
             return "supervisor_lease_ttl_s must be > 0"
         if self.solver_leader not in ("auto", "never"):

@@ -62,6 +62,7 @@ from .durable import (
     WAL_FILE,
 )
 from .store import Collection, Store, apply_wal_record
+from ..utils import faults as _faults
 from ..utils import metrics as _metrics
 
 REPLICA_FULL_RELOADS = _metrics.counter(
@@ -444,6 +445,16 @@ class ReplicaStore(Store):
             return 0, None
 
     def _poll_locked(self) -> int:
+        # ``replica.tail`` transport seam (utils/faults.py): a dropped
+        # / partitioned / half-open tail reads NOTHING this poll and —
+        # critically — does not refresh the caught-up clock, so
+        # staleness_ms() grows monotonically until serve_staleness
+        # bounds flip reads back to the primary. half_open is the
+        # nasty shape: the filesystem handle stays "connected" (no
+        # error to observe), the data just never arrives.
+        directive = _faults.fire("replica.tail")
+        if directive in ("drop", "partition", "half_open"):
+            return 0
         wal_path = os.path.join(self.data_dir, WAL_FILE)
         applied = 0
         gap_ms = 0.0

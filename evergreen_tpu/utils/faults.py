@@ -50,6 +50,31 @@ Instrumented seams:
   ``lease.write``       same seam for lease-file publishes
                         (storage/lease.py _write)
 
+Transport seams (the network-chaos plane; tools/net_matrix.py):
+
+  ``ipc.send``          supervisor→worker control framing, fired in
+                        ``WorkerHandle.send`` before the line hits the
+                        pipe/socket (runtime/supervisor.py). A
+                        shard-scoped alias ``ipc.send.<shard>`` fires
+                        when the generic seam stayed quiet, so a plan
+                        can partition ONE worker of a fleet
+  ``ipc.recv``          worker→supervisor framing, fired per parsed
+                        protocol line in the supervisor's reader thread
+                        (``ipc.recv.<shard>`` scoped alias, same rule)
+  ``sock.adopt``        the re-attachable adoption socket connect
+                        (runtime/manifest.py ``connect``)
+  ``solver.publish`` / ``solver.return``
+                        the solver shm handshake legs (runtime/
+                        solver.py — shared memory cannot drop frames,
+                        so only ``delay``-shaped faults make sense
+                        here; staleness is fenced by epoch/seq)
+  ``agent.request``     one agent→server request leg INSIDE the retry
+                        loop (agent/rest_comm.py; also honored by the
+                        scenario engine's in-process claim storms) —
+                        ``agent.comm`` above stays the whole-call seam
+  ``replica.tail``      the replica WAL tailer's poll entry
+                        (storage/replica.py _poll_locked)
+
 A plan is installed explicitly (``install(plan)`` — tests, the fault
 matrix soak) or via the ``EVG_FAULTS`` env spec at import time:
 ``seam:kind@index[,seam:kind@index...]`` — e.g.
@@ -73,11 +98,33 @@ Fault kinds:
   ``eio``    raise ``OSError(errno.EIO)`` — a hard I/O error surfacing
              to the writer (handled like any other disk raise: deferred
              error, degraded tick, heal)
+  ``delay``  sleep ``delay_s`` then return — a latency spike the seam
+             never notices (identical mechanics to ``hang``; the
+             separate name keeps transport plans self-describing)
   anything else (``torn``, ``short``, ``bitrot``, ``lost``, …) is
   returned to the seam as a directive string — the seam implements the
   special behavior (the WAL writes half a record, the atomic writer
   truncates its tmp or flips a published byte, the lease reports itself
   stolen).
+
+Transport directive kinds (interpreted by the transport seams above):
+
+  ``drop``       the message/request vanishes — senders see success,
+                 receivers see nothing
+  ``duplicate``  the message is delivered twice (at-least-once
+                 transport); req-id matching / the dispatch CAS must
+                 fence the second copy
+  ``reorder``    the message is held and delivered AFTER the seam's
+                 next message (adjacent swap — the minimal reorder)
+  ``partition``  persistent ``drop`` (arm with ``always``); one-way by
+                 arming a single direction/scoped seam, symmetric by
+                 arming both
+  ``half_open``  the connection looks up but writes black-hole: adopt
+                 sockets hand back a never-answering peer, request
+                 legs time out after the server already did the work,
+                 replica tails read nothing while reporting no error
+  ``stale``      the seam serves its previous answer (solver handshake:
+                 a stale epoch/seq the consumer must fence)
 
 Schedules are per-seam call indices, so a seeded run replays exactly:
 ``FaultPlan.seeded(seed, {"wal.append": 0.1})`` derives the firing
@@ -190,7 +237,7 @@ class FaultPlan:
             raise fault.exc if fault.exc is not None else FaultError(
                 f"injected fault at {seam}"
             )
-        if fault.kind == "hang":
+        if fault.kind in ("hang", "delay"):
             sleep(fault.delay_s)
             return None
         if fault.kind == "crash":

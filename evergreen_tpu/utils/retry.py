@@ -94,6 +94,14 @@ class RetryPolicy:
     max_backoff_s: float = 10.0
     #: fraction of each backoff randomized (0 = deterministic backoff)
     jitter: float = 0.5
+    #: full-jitter mode (AWS "exponential backoff and jitter"): each
+    #: pause is uniform in [0, base * 2^attempt] instead of shaving at
+    #: most ``jitter`` off the exponential ceiling. Adopters whose
+    #: failures are fleet-correlated (every agent sees the same
+    #: partition heal at the same instant) need the full spread — a
+    #: 50%-band jitter still synchronizes half the fleet's retries
+    #: into the same window (thundering-herd storm on heal)
+    full_jitter: bool = False
     #: budget gating RETRY SCHEDULING: no backoff sleep or fresh attempt
     #: starts past it. It cannot preempt an attempt already executing —
     #: the called I/O must carry its own timeout (urlopen timeout=,
@@ -104,6 +112,8 @@ class RetryPolicy:
     def backoff_s(self, attempt: int, rng: random.Random) -> float:
         """Backoff after the given 0-based attempt."""
         base = min(self.max_backoff_s, self.base_backoff_s * (2 ** attempt))
+        if self.full_jitter:
+            return base * rng.random()
         if self.jitter <= 0:
             return base
         return base * (1.0 - self.jitter * rng.random())

@@ -1,0 +1,420 @@
+"""Network-chaos plane (ISSUE 20): the transport-fault vocabulary at
+every seam (utils/faults.py), the detection → bounded-degradation
+contracts it feeds — wait_reply's req-id hardening against duplicated /
+reordered replies, the worker's command-staleness deadline (one-way
+partition detection), the dispatch CAS under duplicate delivery, the
+agent transport's full-jitter retry spread, socket-adoption refusal and
+half-open shapes, and the replica tail's staleness bound under a silent
+wire. tools/net_matrix.py runs the full seam x kind x plane-config
+grid; these are the tier-1 regression anchors.
+"""
+import random
+import socket
+import threading
+import time
+import types
+
+import pytest
+
+from evergreen_tpu.utils import faults
+
+
+# --------------------------------------------------------------------------- #
+# the transport-fault vocabulary itself
+# --------------------------------------------------------------------------- #
+
+
+def test_transport_kinds_surface_as_directives():
+    """Transport kinds are DIRECTIVES, not exceptions: the seam's owner
+    reads the kind back and implements wire semantics itself (a raise
+    could not express "deliver this twice")."""
+    plan = faults.FaultPlan()
+    for i, kind in enumerate(
+        ("drop", "duplicate", "reorder", "partition", "half_open")
+    ):
+        plan.at("x.seam", i, faults.Fault(kind))
+    faults.install(plan)
+    try:
+        got = [faults.fire("x.seam") for _ in range(6)]
+    finally:
+        faults.uninstall()
+    assert got == [
+        "drop", "duplicate", "reorder", "partition", "half_open", None,
+    ]
+
+
+def test_transport_plan_counts_fired_per_seam():
+    plan = faults.FaultPlan().always("y.seam", faults.Fault("drop"))
+    faults.install(plan)
+    try:
+        before = faults.FAULTS_FIRED.value(seam="y.seam")
+        for _ in range(3):
+            assert faults.fire("y.seam") == "drop"
+    finally:
+        faults.uninstall()
+    assert faults.FAULTS_FIRED.value(seam="y.seam") == before + 3
+    assert plan.fired == [
+        ("y.seam", 0, "drop"), ("y.seam", 1, "drop"),
+        ("y.seam", 2, "drop"),
+    ]
+
+
+def test_delay_kind_sleeps_then_proceeds():
+    plan = faults.FaultPlan().at(
+        "z.seam", 0, faults.Fault("delay", delay_s=0.05)
+    )
+    faults.install(plan)
+    try:
+        t0 = time.monotonic()
+        assert faults.fire("z.seam") is None  # delayed, NOT dropped
+        assert time.monotonic() - t0 >= 0.04
+        assert faults.fire("z.seam") is None  # one-shot
+    finally:
+        faults.uninstall()
+
+
+# --------------------------------------------------------------------------- #
+# wait_reply hardening: duplicated / reordered replies (satellite b)
+# --------------------------------------------------------------------------- #
+
+
+def _handle(shard=0):
+    from evergreen_tpu.runtime.supervisor import WorkerHandle
+
+    return WorkerHandle(shard, hb_deadline_s=5.0)
+
+
+def test_wait_reply_rejects_reordered_stale_reply():
+    """A reply reordered past its own wait — arriving while a NEWER
+    request is in flight — is counted into
+    runtime_ipc_stale_replies_total and dropped, never matched."""
+    from evergreen_tpu.runtime.supervisor import IPC_STALE_REPLIES
+
+    h = _handle(shard=91)
+    before = IPC_STALE_REPLIES.value(shard=91)
+    h.replies.put({"op": "round", "req": 1, "body": "first"})
+    assert h.wait_reply("round", 1.0, req=1)["body"] == "first"
+    # the wire reorders: req 1's late duplicate lands ahead of req 2
+    h.replies.put({"op": "round", "req": 1, "body": "late"})
+    h.replies.put({"op": "round", "req": 2, "body": "second"})
+    got = h.wait_reply("round", 1.0, req=2)
+    assert got is not None and got["body"] == "second"
+    assert IPC_STALE_REPLIES.value(shard=91) == before + 1
+
+
+def test_wait_reply_rejects_duplicated_error_leg():
+    """Even a spent request's ERROR leg must not end a newer wait — the
+    error fence applies only to live request ids."""
+    from evergreen_tpu.runtime.supervisor import IPC_STALE_REPLIES
+
+    h = _handle(shard=92)
+    before = IPC_STALE_REPLIES.value(shard=92)
+    h.replies.put({"op": "round", "req": 5, "body": "a"})
+    h.wait_reply("round", 1.0, req=5)
+    h.replies.put({"op": "error", "req": 5})  # duplicated error copy
+    h.replies.put({"op": "round", "req": 6, "body": "b"})
+    got = h.wait_reply("round", 1.0, req=6)
+    assert got is not None and got["body"] == "b"
+    assert IPC_STALE_REPLIES.value(shard=92) == before + 1
+
+
+def test_wait_reply_timed_out_request_id_is_spent():
+    """A request that TIMED OUT is spent too: its answer arriving later
+    must not satisfy the next request's wait."""
+    from evergreen_tpu.runtime.supervisor import IPC_STALE_REPLIES
+
+    h = _handle(shard=93)
+    h.proc = types.SimpleNamespace(poll=lambda: None)  # "alive"
+    before = IPC_STALE_REPLIES.value(shard=93)
+    assert h.wait_reply("round", 0.1, req=11) is None  # times out
+    h.replies.put({"op": "round", "req": 11, "body": "too-late"})
+    h.replies.put({"op": "round", "req": 12, "body": "mine"})
+    got = h.wait_reply("round", 1.0, req=12)
+    assert got is not None and got["body"] == "mine"
+    assert IPC_STALE_REPLIES.value(shard=93) == before + 1
+
+
+def test_done_req_book_is_bounded():
+    h = _handle()
+    for req in range(1200):
+        h.replies.put({"op": "round", "req": req})
+        h.wait_reply("round", 1.0, req=req)
+    assert len(h._done_reqs) <= 1024
+
+
+# --------------------------------------------------------------------------- #
+# command-staleness deadline (satellite a)
+# --------------------------------------------------------------------------- #
+
+
+def test_command_silence_knob_validates():
+    from evergreen_tpu.settings import ShardingConfig
+
+    assert ShardingConfig().worker_command_silence_s == 120.0
+    cfg = ShardingConfig(worker_command_silence_s=-1.0)
+    assert "worker_command_silence_s" in cfg.validate_and_default()
+
+
+def test_supervisor_mirrors_cmd_silence_delta_from_heartbeats():
+    """The worker reports CUMULATIVE cmd_silences in heartbeats; the
+    supervisor mirrors deltas into
+    scheduler_fleet_command_silence_total{shard} exactly like the
+    stale-reject deltas (idempotent across repeated beats)."""
+    from evergreen_tpu.runtime.supervisor import (
+        FLEET_CMD_SILENCE,
+        FleetSupervisor,
+    )
+
+    h = _handle(shard=94)
+    before = FLEET_CMD_SILENCE.value(shard=94)
+    recv = FleetSupervisor._handle_recv
+    sup = types.SimpleNamespace()  # heartbeat branch never touches self
+    recv(sup, h, {"op": "heartbeat", "cmd_silences": 2})
+    recv(sup, h, {"op": "heartbeat", "cmd_silences": 2})  # repeat: no-op
+    recv(sup, h, {"op": "heartbeat", "cmd_silences": 3})
+    assert FLEET_CMD_SILENCE.value(shard=94) == before + 3
+    assert h.cmd_silences == 3
+
+
+# --------------------------------------------------------------------------- #
+# dispatch CAS vs duplicate delivery
+# --------------------------------------------------------------------------- #
+
+
+def test_duplicate_delivery_resolves_to_same_assignment(store):
+    """At-least-once delivery at the agent seam: the same pull landing
+    twice — and once more with a STALE host snapshot — always resolves
+    to the one assignment the CAS made. One TASK_DISPATCHED, one
+    owner."""
+    from evergreen_tpu.dispatch.assign import assign_next_available_task
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.globals import HostStatus, TaskStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+
+    now = 1_700_000_000.0
+    for tid in ("c1", "c2"):
+        task_mod.insert(store, Task(
+            id=tid, distro_id="d1",
+            status=TaskStatus.UNDISPATCHED.value, activated=True,
+        ))
+    host_mod.insert(store, Host(
+        id="h1", distro_id="d1", status=HostStatus.RUNNING.value,
+    ))
+    tq_mod.save(store, TaskQueue(
+        distro_id="d1",
+        queue=[TaskQueueItem(id="c1", dependencies_met=True),
+               TaskQueueItem(id="c2", dependencies_met=True)],
+        generated_at=now,
+    ))
+    svc = DispatcherService(store)
+    stale = host_mod.get(store, "h1")
+    first = assign_next_available_task(
+        store, svc, host_mod.get(store, "h1"), now=now
+    )
+    dup = assign_next_available_task(
+        store, svc, host_mod.get(store, "h1"), now=now
+    )
+    via_stale = assign_next_available_task(store, svc, stale, now=now)
+    assert first is not None and first.id == "c1"
+    assert dup is not None and dup.id == "c1"  # resume, not re-claim
+    assert via_stale is None or via_stale.id == "c1"  # CAS fenced
+    dispatched = store.collection("events").find(
+        lambda d: d.get("event_type") == "TASK_DISPATCHED"
+    )
+    assert len(dispatched) == 1
+    assert host_mod.get(store, "h1").running_task == "c1"
+
+
+# --------------------------------------------------------------------------- #
+# agent transport: full jitter + retry budget (satellite c)
+# --------------------------------------------------------------------------- #
+
+
+def test_agent_retry_backoff_is_full_jitter_and_spreads():
+    """Agent failures are fleet-correlated (every parked agent sees the
+    same partition heal at once): backoff must be FULL jitter — uniform
+    over [0, ceiling] — so the reconnect wave spreads, including into
+    the low half a band-limited jitter never reaches."""
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+
+    policy = RestCommunicator("http://127.0.0.1:1").policy
+    assert policy.full_jitter
+    base = policy.base_backoff_s
+    pauses = [policy.backoff_s(0, random.Random(i)) for i in range(64)]
+    assert all(0.0 <= p <= base for p in pauses)
+    assert max(pauses) - min(pauses) > 0.5 * base, "no spread"
+    assert min(pauses) < 0.5 * base, "low half never reached"
+    # seeded => replayable: the matrix can reproduce a storm exactly
+    assert pauses == [
+        policy.backoff_s(0, random.Random(i)) for i in range(64)
+    ]
+
+
+def test_agent_request_partition_exhausts_bounded_budget():
+    """A persistent partition at agent.request burns the BOUNDED retry
+    budget and surfaces as ConnectionError — it must not hang."""
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+
+    comm = RestCommunicator("http://127.0.0.1:1", retries=2,
+                            backoff_s=0.01)
+    faults.install(faults.FaultPlan().always(
+        "agent.request", faults.Fault("partition"),
+    ))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            comm._call("GET", "/rest/v2/hosts")
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        faults.uninstall()
+
+
+# --------------------------------------------------------------------------- #
+# socket adoption: refused + half-open (tentpole seam sock.adopt)
+# --------------------------------------------------------------------------- #
+
+
+def test_adopt_connect_refused_under_drop_and_partition():
+    from evergreen_tpu.runtime import manifest
+
+    for kind in ("drop", "partition"):
+        faults.install(faults.FaultPlan().at(
+            "sock.adopt", 0, faults.Fault(kind),
+        ))
+        try:
+            with pytest.raises(OSError):
+                manifest.connect("/tmp/no-such-worker.sock")
+        finally:
+            faults.uninstall()
+
+
+def test_adopt_halfopen_socket_stays_silent():
+    """half_open hands back a connected-looking socket whose peer never
+    answers: writes land, reads time out — the adoption probe's
+    deadline, not an error, must bound it."""
+    from evergreen_tpu.runtime import manifest
+
+    faults.install(faults.FaultPlan().at(
+        "sock.adopt", 0, faults.Fault("half_open"),
+    ))
+    try:
+        conn = manifest.connect("/tmp/no-such-worker.sock")
+    finally:
+        faults.uninstall()
+    try:
+        conn.settimeout(0.2)
+        conn.sendall(b'{"op":"adopt"}\n')
+        with pytest.raises(socket.timeout):
+            conn.recv(64)
+    finally:
+        conn.close()
+
+
+# --------------------------------------------------------------------------- #
+# replica tail under a silent wire
+# --------------------------------------------------------------------------- #
+
+
+def test_replica_tail_fault_freezes_watermark_and_grows_staleness(
+    tmp_path,
+):
+    """drop/partition/half_open at replica.tail: polls return without
+    applying (the wire is silently dead), the applied watermark
+    freezes, and staleness_ms keeps GROWING — the signal rest.py's
+    readiness bound turns into "stop serving". Healing the seam catches
+    the tail back up to the primary's watermark."""
+    from evergreen_tpu.storage.durable import DurableStore
+    from evergreen_tpu.storage.replica import ReplicaStore
+
+    primary = DurableStore(str(tmp_path))
+    for i in range(5):
+        primary.collection("tasks").insert({"_id": f"t{i}"})
+    replica = ReplicaStore(
+        str(tmp_path), poll_interval_s=3600.0, replica_id="chaos",
+    )
+    try:
+        assert replica.applied_seq == primary.wal_seq
+        faults.install(faults.FaultPlan().always(
+            "replica.tail", faults.Fault("half_open"),
+        ))
+        try:
+            primary.collection("tasks").insert({"_id": "during"})
+            frozen = replica.applied_seq
+            assert replica.poll() == 0
+            assert replica.applied_seq == frozen
+            s0 = replica.staleness_ms()
+            time.sleep(0.05)
+            assert replica.poll() == 0
+            assert replica.staleness_ms() > s0
+        finally:
+            faults.uninstall()
+        replica.poll()  # healed wire: catch back up
+        assert replica.applied_seq == primary.wal_seq
+        assert replica.collection("tasks").get("during") is not None
+    finally:
+        replica.close()
+        primary.close()
+
+
+# --------------------------------------------------------------------------- #
+# agent.request duplication end to end (real server, real wire)
+# --------------------------------------------------------------------------- #
+
+
+def test_agent_request_duplication_never_double_claims(store):
+    """The ``duplicate`` kind sends the SAME pull twice over a real
+    server. The second copy must resolve to the same assignment (the
+    CAS's resume path), never claim a second task."""
+    from tools.bench_dispatch import seed
+
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+    from evergreen_tpu.api.rest import RestApi
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.task_queue import TaskQueueItem
+
+    hosts = seed(store, 0, 1)
+    task_mod.insert(store, task_mod.Task(
+        id="dup-t", distro_id="d1", status="undispatched",
+        activated=True, project="p", build_variant="bv", version="v",
+    ))
+    task_mod.insert(store, task_mod.Task(
+        id="dup-u", distro_id="d1", status="undispatched",
+        activated=True, project="p", build_variant="bv", version="v",
+    ))
+    tq_mod.save(store, tq_mod.TaskQueue(
+        distro_id="d1",
+        queue=[
+            TaskQueueItem(id="dup-t", display_name="dup-t", project="p",
+                          build_variant="bv", version="v",
+                          dependencies=[], dependencies_met=True),
+            TaskQueueItem(id="dup-u", display_name="dup-u", project="p",
+                          build_variant="bv", version="v",
+                          dependencies=[], dependencies_met=True),
+        ],
+        generated_at=time.time(),
+    ))
+    api = RestApi(store)
+    srv = api.serve("127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    comm = RestCommunicator(f"http://127.0.0.1:{srv.server_address[1]}")
+    faults.install(faults.FaultPlan().at(
+        "agent.request", 0, faults.Fault("duplicate"),
+    ))
+    try:
+        t = comm.next_task(hosts[0].id)
+    finally:
+        faults.uninstall()
+        srv.shutdown()
+    assert t is not None and t.id == "dup-t"
+    dispatched = store.collection("events").find(
+        lambda d: d.get("event_type") == "TASK_DISPATCHED"
+    )
+    assert len(dispatched) == 1, [d["resource_id"] for d in dispatched]
+    assert host_mod.get(store, hosts[0].id).running_task == "dup-t"

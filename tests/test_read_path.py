@@ -657,3 +657,86 @@ def test_read_path_config_validation(store):
     cfg = ReadPathConfig(longpoll_shards=0)
     assert cfg.validate_and_default() == ""
     assert cfg.longpoll_shards == 1
+
+
+# --------------------------------------------------------------------------- #
+# long-poll under transport chaos (ISSUE 20 satellite)
+# --------------------------------------------------------------------------- #
+
+
+def test_longpoll_reconnect_after_dropped_request_no_double_claim(store):
+    """A parked agent's long-poll request DROPS on the wire (the
+    network-chaos ``drop`` fault at agent.request); the retry budget
+    reconnects, work arrives, and the reconnected pull claims it —
+    exactly once (one TASK_DISPATCHED, one owner) and with the hub's
+    wake-credit ledger fully claimed, not leaked."""
+    from tools.bench_dispatch import seed
+
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+    from evergreen_tpu.dispatch.longpoll import hub_for
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.task_queue import TaskQueueItem
+    from evergreen_tpu.utils import faults
+
+    hosts = seed(store, 0, 1)
+    api = RestApi(store)
+    srv = api.serve("127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    comm = RestCommunicator(
+        f"http://127.0.0.1:{port}", retries=4, backoff_s=0.05,
+    )
+    hub = hub_for(store)
+    got = {}
+
+    def parked_agent():
+        got["task"] = comm.next_task(hosts[0].id, wait_s=10.0)
+
+    # the agent's FIRST pull vanishes before the server sees it; the
+    # retry (full-jitter paced) reconnects and parks on the empty queue
+    faults.install(faults.FaultPlan().at(
+        "agent.request", 0, faults.Fault("drop"),
+    ))
+    try:
+        th = threading.Thread(target=parked_agent)
+        th.start()
+        time.sleep(0.4)
+        assert th.is_alive(), "agent gave up instead of reconnecting"
+        task_mod.insert(store, task_mod.Task(
+            id="fresh", distro_id="d1", status="undispatched",
+            activated=True, project="p", build_variant="bv", version="v",
+        ))
+        tq_mod.save(store, tq_mod.TaskQueue(
+            distro_id="d1",
+            queue=[TaskQueueItem(
+                id="fresh", display_name="fresh", project="p",
+                build_variant="bv", version="v", dependencies=[],
+                dependencies_met=True,
+            )],
+            generated_at=time.time(),
+        ))
+        hub.notify("d1", n_hint=1)
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert got["task"] is not None and got["task"].id == "fresh"
+        # exactly one claim: one dispatch record, one owner
+        dispatched = store.collection("events").find(
+            lambda d: d.get("event_type") == "TASK_DISPATCHED"
+        )
+        assert len(dispatched) == 1, dispatched
+        assert host_mod.get(store, hosts[0].id).running_task == "fresh"
+        # the wake credit was CLAIMED by the woken pull, not leaked to
+        # wake (and starve) a later parked agent
+        assert hub.pending("d1") == 0
+        # a redelivered pull (the agent re-asking after its reply was
+        # lost) resumes the SAME assignment — still one dispatch record
+        again = comm.next_task(hosts[0].id)
+        assert again is not None and again.id == "fresh"
+        assert len(store.collection("events").find(
+            lambda d: d.get("event_type") == "TASK_DISPATCHED"
+        )) == 1
+    finally:
+        faults.uninstall()
+        srv.shutdown()

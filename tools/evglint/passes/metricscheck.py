@@ -33,8 +33,8 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
 SUBSYSTEMS = {
     "api", "arena", "breaker", "cloud", "config", "cron", "dispatch",
     "events", "faults", "hosts", "jobs", "lease", "outbox", "overload",
-    "recovery", "replica", "resident", "retry", "scheduler", "storage",
-    "tpu", "trace", "wal",
+    "recovery", "replica", "resident", "retry", "runtime", "scheduler",
+    "storage", "tpu", "trace", "wal",
 }
 
 INCR_COUNTER_ALLOWED = {

@@ -15,6 +15,20 @@ constructor, in a module whose source never mentions ``faults.fire`` or
 ``RetryPolicy``, is a finding. Harness/bootstrap code that is itself
 the failure-observer (smoke drivers, the native-lib builder) suppresses
 with that justification.
+
+Canonical transport-seam names (the network-chaos plane's injection
+surface, exercised by ``tools/net_matrix.py``; keep this inventory in
+sync with ARCHITECTURE.md's "Network chaos" section):
+
+- ``ipc.send`` / ``ipc.recv`` — supervisor↔worker control IPC, both
+  directions, each with per-shard aliases (``ipc.send.<shard>``) so a
+  plan can partition ONE worker;
+- ``sock.adopt`` — the orphan-adoption socket connect;
+- ``solver.publish`` / ``solver.return`` — the solver-leader's shm
+  legs (delay/stale shapes only: the payload plane is checksummed);
+- ``agent.request`` — the agent's REST pull (drop/duplicate/half-open
+  feed the dispatch CAS its duplicate-delivery diet);
+- ``replica.tail`` — the read replica's WAL tail poll.
 """
 from __future__ import annotations
 

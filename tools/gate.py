@@ -90,7 +90,8 @@ def _log_run(rc: int, args: list) -> None:
     # masquerade as a suite-wide green; the only extra args a full run
     # carries are the matrix flags this gate itself appends
     full_suite = bool(args) and args[0] == "tests/" and all(
-        a in ("--crash-matrix", "--disk-matrix", "--overload-matrix",
+        a in ("--crash-matrix", "--disk-matrix", "--net-matrix",
+              "--overload-matrix",
               "--resident-parity", "--shard-parity", "--capacity-parity",
               "--read-parity", "--scenarios", "--fleet-runtime", "--fuzz")
         for a in args[1:]
@@ -112,7 +113,8 @@ def main() -> int:
     env = dict(os.environ)
     for k in ("EVG_TPU_EGRESS", "EVG_TPU_DATA_DIR"):
         env.pop(k, None)
-    flags = {"--crash-matrix", "--disk-matrix", "--overload-matrix",
+    flags = {"--crash-matrix", "--disk-matrix", "--net-matrix",
+             "--overload-matrix",
              "--resident-parity", "--shard-parity", "--capacity-parity",
              "--read-parity", "--scenarios", "--fleet-runtime", "--fuzz"}
     args = [a for a in sys.argv[1:] if a not in flags]
@@ -120,6 +122,7 @@ def main() -> int:
     with_scenarios = "--scenarios" in sys.argv[1:]
     with_crash_matrix = "--crash-matrix" in sys.argv[1:]
     with_disk_matrix = "--disk-matrix" in sys.argv[1:]
+    with_net_matrix = "--net-matrix" in sys.argv[1:]
     with_overload_matrix = "--overload-matrix" in sys.argv[1:]
     with_resident_parity = "--resident-parity" in sys.argv[1:]
     with_shard_parity = "--shard-parity" in sys.argv[1:]
@@ -164,6 +167,18 @@ def main() -> int:
         print("gate:", " ".join(dm), flush=True)
         rc = subprocess.call(dm, env={**env, "JAX_PLATFORMS": "cpu"})
         ran_flags.append("--disk-matrix")
+    if rc == 0 and with_net_matrix:
+        # the network-chaos matrix (make net-matrix): partition/latency/
+        # loss/duplication/reordering/half-open at every transport seam,
+        # across classic + 2-shard fleet + solver-leader plane configs;
+        # every point must detect, degrade boundedly (never split-brain,
+        # never double-dispatch, stale-accepted == 0), and hold
+        # resume == rerun — with the unfenced-duplicate sabotage
+        # self-test run first
+        nm = [sys.executable, os.path.join(root, "tools", "net_matrix.py")]
+        print("gate:", " ".join(nm), flush=True)
+        rc = subprocess.call(nm, env={**env, "JAX_PLATFORMS": "cpu"})
+        ran_flags.append("--net-matrix")
     if rc == 0 and with_overload_matrix:
         # the storm-soak matrix (make overload-matrix): seeded storms
         # must brown out low-value work only and recover to GREEN

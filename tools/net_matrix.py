@@ -1,0 +1,829 @@
+#!/usr/bin/env python
+"""Network-chaos matrix (ISSUE 20 tentpole) — the Jepsen-style partition
+analogue of tools/disk_matrix.py. Where the disk matrix rots the bytes
+under a living process, this matrix cuts the WIRES between living
+processes — partition (one-way and symmetric), loss, duplication,
+reordering, latency, and half-open connections at every transport seam
+(utils/faults.py network-chaos vocabulary) — and asserts the detection →
+bounded-degradation contract end to end:
+
+  * zero duplicate dispatch: at-least-once delivery (retries after drop,
+    half-open re-delivery, outright duplication) never double-claims a
+    task — the dispatch CAS and the running-task resume path fence every
+    copy;
+  * exactly-one-owner + monotone epochs: a partitioned worker orphans on
+    its command-staleness deadline (never split-brains), heals in place
+    when commands resume, and any fenced restart lands at a strictly
+    higher epoch;
+  * stale-accepted == 0: delayed solver-leader results past the round's
+    timeout are fenced at out_seq, never applied;
+  * resume == rerun: a run that rode out the chaos converges to the same
+    canonical state as an uninterrupted reference replay;
+  * degrade-within-one-round: a leader delay past the solve timeout
+    degrades exactly the affected round to local solves, then recovers.
+
+Five arms, all run by default (``make net-matrix`` / ``gate
+--net-matrix``); the SABOTAGE self-test runs FIRST — a deliberately
+unfenced duplicate delivery (a forged second claim bypassing the CAS)
+must be caught red, or the whole matrix refuses to certify anything:
+
+  sabotage  plant an unfenced duplicate delivery; the invariant plane
+            must convict it (the matrix's own smoke detector);
+  grid      seam x kind points across three plane configs — classic
+            (in-process engine: lossy agent claim storms + replica
+            tail), fleet2 (2-shard supervised fleet over real worker
+            processes: IPC partition/drop/delay/duplicate/reorder), and
+            leader2 (solver-leader fleet: delayed publish/return,
+            partitioned worker);
+  weathers  the shipped scenarios/library.py + procs.py net weathers;
+  cases     bespoke seam cases: wait_reply reorder/duplication
+            hardening, sock.adopt refused + half-open, duplicate
+            delivery against the dispatch CAS, full-jitter retry
+            spread;
+  fuzz      reachability: the weather fuzzer must actually draw
+            ``net_fault`` events, drawn cases must run green, and one
+            shrunk net_fault timeline must replay deterministically.
+
+One JSON line per case; summary line; exit 1 on any failure. Failed
+proc cases keep their data dirs for inspection (engine runs clean up
+through the scenario harness).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from evergreen_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter start, so env
+# vars alone cannot reach it — and the leader2 arm elects a
+# solver-leader, which requires >= n_shards devices. Pin the same
+# virtual 8-device CPU mesh the test harness uses (tests/conftest.py);
+# without it _start_solver soft-fails and every solver point would
+# pass vacuously (guarded by the solver-engaged check below).
+force_cpu(n_devices=8)
+
+CONFIGS = ("classic", "fleet2", "leader2")
+
+#: classic (in-process) agent-transport grid: kind x loss rate, each
+#: driven through the scenario engine's ``net_fault`` storm + heal
+AGENT_KINDS = ("drop", "half_open", "duplicate", "partition")
+AGENT_RATES = (0.3, 0.6)
+
+#: classic replica-tail grid: the tail survives every silent-wire shape
+REPLICA_KINDS = ("half_open", "drop", "partition")
+
+#: fleet2 (2-shard supervised fleet) grid: (seam, kind, delay_s).
+#: ``ipc.send.0`` faults black-hole supervisor→worker commands for ONE
+#: shard (the one-way partition: heartbeats still flow back);
+#: ``ipc.recv.0`` faults eat worker→supervisor traffic instead, which
+#: starves the heartbeat watchdog into a fenced restart — never a
+#: split-brain.
+FLEET_GRID: List[Tuple[str, str, float]] = [
+    ("ipc.send.0", "partition", 0.0),
+    ("ipc.send.0", "drop", 0.0),
+    ("ipc.send.0", "delay", 0.3),
+    ("ipc.send.0", "duplicate", 0.0),
+    ("ipc.recv.0", "drop", 0.0),
+    ("ipc.recv.0", "duplicate", 0.0),
+    ("ipc.recv.0", "reorder", 0.0),
+]
+
+#: leader2 (solver-leader fleet) grid: delayed solver legs + a
+#: partitioned worker under an elected leader. ``solver.return`` gets a
+#: delay PAST the workers' solve timeout (6s): that round must degrade
+#: to local solves — and the late result must be fenced, never accepted.
+LEADER_GRID: List[Tuple[str, str, float]] = [
+    ("solver.publish", "delay", 0.5),
+    ("solver.return", "delay", 8.0),
+    ("ipc.send.0", "partition", 0.0),
+]
+
+WEATHERS = ("net-agent-storm-loss", "net-agent-storm-halfopen",
+            "net-replica-halfopen")
+PROC_WEATHERS = ("proc-net-oneway-partition",)
+
+
+def _emit(res: dict) -> dict:
+    print(json.dumps(res), flush=True)
+    return res
+
+
+def _entry_result(arm: str, point: str, entry: dict,
+                  extra_problems: Optional[List[str]] = None) -> dict:
+    problems = list(extra_problems or [])
+    if not entry.get("ok"):
+        problems.append(json.dumps(entry, default=str)[:2000])
+    return {"arm": arm, "point": point, "ok": not problems,
+            "problems": problems}
+
+
+# ------------------------------------------------------------- sabotage arm
+
+def run_sabotage() -> List[dict]:
+    """The matrix's own smoke detector, run before anything it would
+    certify: a claim storm under half-open responses PLUS a forged
+    duplicate claim that bypasses the dispatch CAS entirely (duplicate
+    delivery with the fence ripped out). The invariant plane MUST score
+    it red with ``no_duplicate_dispatch`` among the convictions — a
+    green here means the matrix is blind and every other point is
+    vacuous."""
+    from evergreen_tpu.globals import Provider
+    from evergreen_tpu.scenarios.engine import run_scenario
+    from evergreen_tpu.scenarios.library import _sabotage_duplicate_claim
+    from evergreen_tpu.scenarios.spec import Ev, ScenarioSpec
+
+    spec = ScenarioSpec(
+        name="net-sabotage-unfenced-duplicate",
+        description="half-open claim storm with an UNFENCED duplicate "
+                    "delivery spliced in (forged second claim, CAS "
+                    "bypassed): the invariant plane must convict it",
+        ticks=8,
+        events=[
+            # 4 hosts, 2 tasks: at tick 1 two hosts are mid-task and
+            # two are free — the forged duplicate claim has both sides
+            # live (same balance as the library's sabotage weather)
+            Ev(0, "fleet", {"distros": [
+                {"id": "dsab", "provider": Provider.MOCK.value,
+                 "hosts": 4},
+            ]}),
+            Ev(0, "tasks", {"distro": "dsab", "n": 2,
+                            "prefix": "dsab-t"}),
+            Ev(1, "net_fault", {"target": "agent", "kind": "half_open",
+                                "rate": 0.3, "agents": 4}),
+            Ev(1, "call", {"fn": _sabotage_duplicate_claim}),
+        ],
+        tier1=False,
+    )
+    entry = run_scenario(spec)
+    problems: List[str] = []
+    if entry.get("ok"):
+        problems.append(
+            "the planted unfenced duplicate delivery was NOT caught — "
+            "the invariant plane is blind; refusing to certify"
+        )
+    else:
+        # the conviction must come from the dispatch-books invariants
+        # (a second live claim → no_duplicate_dispatch; a claim that
+        # outlived its task → store_consistent), not from an unrelated
+        # SLO that happened to trip
+        inv = entry.get("invariants", {})
+        books = ("no_duplicate_dispatch", "store_consistent")
+        if all(inv.get(k, {}).get("ok", True) for k in books):
+            problems.append(
+                "sabotage scored red, but not by the dispatch-books "
+                "invariants: "
+                + json.dumps(entry.get("invariants"), default=str)[:800]
+            )
+    return [_emit({"arm": "sabotage", "point": "unfenced-duplicate",
+                   "ok": not problems, "problems": problems})]
+
+
+# ----------------------------------------------------------------- grid arm
+
+def _classic_agent_spec(kind: str, rate: float):
+    from evergreen_tpu.globals import Provider
+    from evergreen_tpu.scenarios.spec import SLO, Ev, ScenarioSpec
+
+    return ScenarioSpec(
+        name="net-grid-agent-%s-%d" % (kind, int(rate * 100)),
+        description="matrix-generated agent chaos: %s at %d%% across "
+                    "a claim storm" % (kind, int(rate * 100)),
+        ticks=12,
+        events=[
+            Ev(0, "fleet", {"distros": [
+                {"id": "dgrid", "provider": Provider.MOCK.value,
+                 "hosts": 6},
+            ]}),
+            Ev(0, "tasks", {"distro": "dgrid", "n": 12,
+                            "prefix": "ng-t"}),
+            Ev(2, "net_fault", {"target": "agent", "kind": kind,
+                                "rate": rate, "agents": 6}),
+            Ev(6, "tasks", {"distro": "dgrid", "n": 4,
+                            "prefix": "ng-b"}),
+        ],
+        slos=[
+            SLO("work-survives", "tasks_unfinished", "==", 0),
+            SLO("no-failures", "tasks_failed", "==", 0),
+        ],
+    )
+
+
+def _classic_replica_spec(kind: str):
+    import dataclasses
+
+    from evergreen_tpu.scenarios.library import _net_replica_halfopen
+    from evergreen_tpu.scenarios.spec import Ev
+
+    spec = _net_replica_halfopen()
+    if kind == "half_open":
+        return spec
+    events = [
+        dataclasses.replace(e, args={**e.args, "kind": kind})
+        if e.kind == "net_fault" else e
+        for e in spec.events
+    ]
+    return dataclasses.replace(
+        spec, name="net-replica-%s" % kind, events=events,
+        description=spec.description.replace("half-open", kind),
+    )
+
+
+def run_classic_grid() -> List[dict]:
+    from evergreen_tpu.scenarios.engine import run_scenario
+
+    results = []
+    for kind in AGENT_KINDS:
+        for rate in AGENT_RATES:
+            point = "classic:agent.request:%s@%d" % (kind,
+                                                     int(rate * 100))
+            entry = run_scenario(_classic_agent_spec(kind, rate))
+            results.append(_emit(_entry_result("grid", point, entry)))
+    for kind in REPLICA_KINDS:
+        point = "classic:replica.tail:%s" % kind
+        entry = run_scenario(_classic_replica_spec(kind))
+        results.append(_emit(_entry_result("grid", point, entry)))
+    return results
+
+
+def _proc_net_spec(config: str, seam: str, kind: str, delay_s: float):
+    """One proc-backend chaos point: arm the fault at tick 2, heal at
+    tick 5, converge under the full proc invariant set (duplicate
+    dispatch, exactly-one-owner, monotone epochs, resume == rerun)."""
+    from evergreen_tpu.scenarios.procs import (
+        _SOLVER_WORKLOAD,
+        DEFAULT_PROC_INVARIANTS,
+    )
+    from evergreen_tpu.scenarios.spec import SLO, Ev, ScenarioSpec
+
+    if config == "leader2":
+        workload = dict(_SOLVER_WORKLOAD)
+        workload["round_timeout_s"] = 30.0
+        if not seam.startswith("solver."):
+            # the command-silence detector is under test only at the
+            # IPC seams; solver points run the shipped leader workload
+            # so a silence-orphan cannot disengage the stacked plane
+            # mid-measurement
+            workload["command_silence_s"] = 2.0
+    else:
+        workload = {"shards": 2, "distros": 4, "tasks": 32, "seed": 11,
+                    "hosts_per_distro": 3, "round_timeout_s": 4.0,
+                    "command_silence_s": 2.0}
+    fault_args: Dict = {"seam": seam, "kind": kind}
+    if delay_s:
+        fault_args["delay_s"] = delay_s
+    if seam.startswith("solver."):
+        # one delayed leg (plan index 0 on the freshly armed plan =
+        # the seam's next fire): exactly one round degrades
+        fault_args["at"] = 0
+    slug = "%s-%s" % (seam.replace(".", "-"), kind)
+    checks: List = []
+    if config == "leader2":
+        checks.append(("stale-accepted-zero", _check_stale_zero))
+        if seam.startswith("solver."):
+            # anti-vacuity: a solver point where the stacked plane
+            # never engaged (no devices, lease lost) proves nothing
+            checks.append(("solver-engaged", _check_solver_engaged))
+        if seam == "solver.return":
+            checks.append(("degrade-within-one-round",
+                           _check_degrade_one_round))
+    return ScenarioSpec(
+        name="net-%s-%s" % (config, slug),
+        description="matrix-generated %s chaos point: %s at %s"
+                    % (config, kind, seam),
+        ticks=14,
+        durable=True,
+        deterministic=False,
+        events=[
+            Ev(0, "proc_fleet", workload),
+            Ev(2, "net_fault", fault_args),
+            Ev(5, "net_heal", {"seam": seam}),
+        ],
+        slos=[
+            # a recv-side blackout starves the heartbeat watchdog once
+            # per deadline window until the heal: each cycle is a
+            # fenced restart by design, so the drop point's bound is
+            # the blackout span, not one-off fencing
+            SLO("bounded-restarts", "restarts_total", "<=",
+                6 if (seam.startswith("ipc.recv") and kind == "drop")
+                else 3),
+        ],
+        checks=checks,
+        invariants=DEFAULT_PROC_INVARIANTS,
+        tier1=False,
+    )
+
+
+def _check_solver_engaged(run) -> Optional[str]:
+    n = (run.stats.get("solver_stacked_replies", 0)
+         + run.stats.get("solver_local_replies", 0))
+    if n < 1:
+        return ("the solver plane never engaged (no stacked or local "
+                "replies) — the point is vacuous")
+    return None
+
+
+def _check_stale_zero(run) -> Optional[str]:
+    n = run.stats.get("solver_stale_accepted", 0)
+    if n:
+        return "a worker accepted a stale solver result: %d" % n
+    return None
+
+
+def _check_degrade_one_round(run) -> Optional[str]:
+    """The delayed solver.return must cost at most the round it landed
+    in: at least one round degrades to a local solve, and some LATER
+    round is fully stacked again (bounded degradation, then recovery)."""
+    saw_local = False
+    for rnd in run.rounds:
+        solves = [r.get("solve") for r in rnd.values()]
+        if "local" in solves:
+            saw_local = True
+        elif saw_local and solves.count("stacked") >= 2:
+            return None
+    if not saw_local:
+        return "the delayed return never degraded any round to local"
+    return "no fully stacked round after the degraded one"
+
+
+def run_proc_grid(config: str) -> List[dict]:
+    from evergreen_tpu.scenarios.procs import run_proc_scenario
+
+    grid = FLEET_GRID if config == "fleet2" else LEADER_GRID
+    results = []
+    for seam, kind, delay_s in grid:
+        point = "%s:%s:%s" % (config, seam, kind)
+        spec = _proc_net_spec(config, seam, kind, delay_s)
+        entry = run_proc_scenario(spec)
+        res = _entry_result("grid", point, entry)
+        if not res["ok"]:
+            res["data_dir"] = entry.get("data_dir")
+        results.append(_emit(res))
+    return results
+
+
+def run_grid(only_point: Optional[str] = None) -> List[dict]:
+    results = []
+    for res in run_classic_grid() if only_point is None else []:
+        results.append(res)
+    if only_point is not None:
+        # single-point mode: route to the owning config
+        config = only_point.split(":", 1)[0]
+        if config == "classic":
+            raise SystemExit(
+                "--point supports proc configs (fleet2/leader2); "
+                "classic points run via --grid-only"
+            )
+        from evergreen_tpu.scenarios.procs import run_proc_scenario
+
+        grid = FLEET_GRID if config == "fleet2" else LEADER_GRID
+        for seam, kind, delay_s in grid:
+            if "%s:%s:%s" % (config, seam, kind) != only_point:
+                continue
+            spec = _proc_net_spec(config, seam, kind, delay_s)
+            entry = run_proc_scenario(spec)
+            results.append(_emit(_entry_result("grid", only_point,
+                                               entry)))
+        return results
+    for config in ("fleet2", "leader2"):
+        results.extend(run_proc_grid(config))
+    return results
+
+
+# ------------------------------------------------------------- weathers arm
+
+def run_weathers() -> List[dict]:
+    from evergreen_tpu.scenarios.engine import run_scenario
+    from evergreen_tpu.scenarios.library import SCENARIOS
+    from evergreen_tpu.scenarios.procs import (
+        PROC_SCENARIOS,
+        run_proc_scenario,
+    )
+
+    results = []
+    for name in WEATHERS:
+        entry = run_scenario(SCENARIOS[name]())
+        results.append(_emit(_entry_result("weathers", name, entry)))
+    for name in PROC_WEATHERS:
+        entry = run_proc_scenario(PROC_SCENARIOS[name]())
+        results.append(_emit(_entry_result("weathers", name, entry)))
+    return results
+
+
+# ---------------------------------------------------------------- cases arm
+
+def wait_reply_reorder_case() -> dict:
+    """A reply reordered past its own wait (delivered after the wait
+    timed out and a NEWER request is in flight) must be counted and
+    dropped — never matched to the newer wait."""
+    from evergreen_tpu.runtime.supervisor import (
+        IPC_STALE_REPLIES,
+        WorkerHandle,
+    )
+
+    problems: List[str] = []
+    h = WorkerHandle(0, hb_deadline_s=5.0)
+    before = IPC_STALE_REPLIES.value(shard=0)
+
+    # request 1 answers normally and completes
+    h.replies.put({"op": "round", "req": 1, "body": "first"})
+    got = h.wait_reply("round", 1.0, req=1)
+    if not got or got.get("body") != "first":
+        problems.append("baseline reply lost: %r" % (got,))
+
+    # the reorder: request 1's LATE duplicate arrives ahead of request
+    # 2's real answer
+    h.replies.put({"op": "round", "req": 1, "body": "late-dup"})
+    h.replies.put({"op": "round", "req": 2, "body": "second"})
+    got = h.wait_reply("round", 1.0, req=2)
+    if not got or got.get("body") != "second":
+        problems.append(
+            "reordered stale reply satisfied the newer wait: %r"
+            % (got,)
+        )
+    moved = IPC_STALE_REPLIES.value(shard=0) - before
+    if moved != 1:
+        problems.append(
+            "stale-reply counter moved %s, want exactly 1" % moved
+        )
+    return {"arm": "cases", "point": "wait-reply-reorder",
+            "ok": not problems, "problems": problems}
+
+
+def wait_reply_duplicate_error_case() -> dict:
+    """A duplicated ERROR leg carrying a spent request id must not end
+    a newer wait either — the error fence only applies to live ids."""
+    from evergreen_tpu.runtime.supervisor import (
+        IPC_STALE_REPLIES,
+        WorkerHandle,
+    )
+
+    problems: List[str] = []
+    h = WorkerHandle(1, hb_deadline_s=5.0)
+    before = IPC_STALE_REPLIES.value(shard=1)
+
+    h.replies.put({"op": "round", "req": 7, "body": "a"})
+    h.wait_reply("round", 1.0, req=7)
+    # the transport duplicates the worker's error for the finished
+    # request; a fresh request must still get ITS answer
+    h.replies.put({"op": "error", "req": 7})
+    h.replies.put({"op": "round", "req": 8, "body": "b"})
+    got = h.wait_reply("round", 1.0, req=8)
+    if not got or got.get("body") != "b":
+        problems.append(
+            "a stale duplicated error ended the newer wait: %r" % (got,)
+        )
+    moved = IPC_STALE_REPLIES.value(shard=1) - before
+    if moved != 1:
+        problems.append(
+            "stale-reply counter moved %s, want exactly 1" % moved
+        )
+    return {"arm": "cases", "point": "wait-reply-duplicate-error",
+            "ok": not problems, "problems": problems}
+
+
+def sock_adopt_refused_case() -> dict:
+    """``drop``/``partition`` at sock.adopt surface as a refused
+    connect (OSError) — the supervisor's adoption probe falls back to a
+    cold spawn instead of hanging."""
+    from evergreen_tpu.runtime import manifest
+    from evergreen_tpu.utils import faults
+
+    problems: List[str] = []
+    for kind in ("drop", "partition"):
+        plan = faults.FaultPlan().at("sock.adopt", 0, faults.Fault(kind))
+        faults.install(plan)
+        try:
+            try:
+                manifest.connect("/tmp/definitely-not-a-socket.sock")
+                problems.append("%s did not refuse the connect" % kind)
+            except OSError:
+                pass
+        finally:
+            faults.uninstall()
+    return {"arm": "cases", "point": "sock-adopt-refused",
+            "ok": not problems, "problems": problems}
+
+
+def sock_adopt_halfopen_case() -> dict:
+    """``half_open`` at sock.adopt hands back a connected-looking
+    socket whose peer never answers: reads time out instead of erroring
+    — exactly the shape _try_adopt's deadline must bound."""
+    import socket as _socket
+
+    from evergreen_tpu.runtime import manifest
+    from evergreen_tpu.utils import faults
+
+    problems: List[str] = []
+    plan = faults.FaultPlan().at("sock.adopt", 0,
+                                 faults.Fault("half_open"))
+    faults.install(plan)
+    try:
+        conn = manifest.connect("/tmp/definitely-not-a-socket.sock")
+    finally:
+        faults.uninstall()
+    try:
+        conn.settimeout(0.2)
+        try:
+            conn.sendall(b'{"op":"adopt"}\n')  # lands in a dead buffer
+        except OSError:
+            problems.append("half-open socket errored on write")
+        try:
+            data = conn.recv(64)
+            problems.append(
+                "half-open socket answered: %r" % (data,)
+            )
+        except _socket.timeout:
+            pass  # the contract: silence, not an error
+        except OSError:
+            problems.append(
+                "half-open socket errored instead of staying silent"
+            )
+    finally:
+        conn.close()
+    return {"arm": "cases", "point": "sock-adopt-halfopen",
+            "ok": not problems, "problems": problems}
+
+
+def dispatch_cas_duplicate_case() -> dict:
+    """Duplicate delivery against the dispatch CAS, no scenario engine
+    in the way: the same next_task claim lands twice (and a third time
+    with a STALE host snapshot still claiming to be free). Exactly one
+    TASK_DISPATCHED may exist; every redelivery must resolve to the
+    SAME task, never a second one."""
+    from evergreen_tpu.dispatch.assign import assign_next_available_task
+    from evergreen_tpu.dispatch.dag_dispatcher import DispatcherService
+    from evergreen_tpu.globals import HostStatus, TaskStatus
+    from evergreen_tpu.models import host as host_mod
+    from evergreen_tpu.models import task as task_mod
+    from evergreen_tpu.models import task_queue as tq_mod
+    from evergreen_tpu.models.host import Host
+    from evergreen_tpu.models.task import Task
+    from evergreen_tpu.models.task_queue import TaskQueue, TaskQueueItem
+    from evergreen_tpu.storage.store import Store
+
+    problems: List[str] = []
+    now = 1_700_000_000.0
+    store = Store()
+    task_mod.insert(store, Task(
+        id="nt1", distro_id="d1",
+        status=TaskStatus.UNDISPATCHED.value, activated=True,
+    ))
+    task_mod.insert(store, Task(
+        id="nt2", distro_id="d1",
+        status=TaskStatus.UNDISPATCHED.value, activated=True,
+    ))
+    host_mod.insert(store, Host(
+        id="h1", distro_id="d1", status=HostStatus.RUNNING.value,
+    ))
+    tq_mod.save(store, TaskQueue(
+        distro_id="d1",
+        queue=[TaskQueueItem(id="nt1", dependencies_met=True),
+               TaskQueueItem(id="nt2", dependencies_met=True)],
+        generated_at=now,
+    ))
+    svc = DispatcherService(store)
+    stale = host_mod.get(store, "h1")  # pre-claim snapshot
+
+    first = assign_next_available_task(
+        store, svc, host_mod.get(store, "h1"), now=now
+    )
+    if first is None or first.id != "nt1":
+        problems.append("baseline claim failed: %r" % (first,))
+    second = assign_next_available_task(
+        store, svc, host_mod.get(store, "h1"), now=now
+    )
+    if second is None or second.id != first.id:
+        problems.append(
+            "duplicate delivery claimed a DIFFERENT task: %r"
+            % (second,)
+        )
+    third = assign_next_available_task(store, svc, stale, now=now)
+    if third is not None and third.id != first.id:
+        problems.append(
+            "stale-snapshot redelivery double-claimed: %r" % (third,)
+        )
+    dispatched = store.collection("events").find(
+        lambda d: d.get("event_type") == "TASK_DISPATCHED"
+    )
+    if len(dispatched) != 1:
+        problems.append(
+            "%d TASK_DISPATCHED events for one claim (want 1)"
+            % len(dispatched)
+        )
+    h = host_mod.get(store, "h1")
+    if h.running_task != "nt1":
+        problems.append(
+            "host claim book wrong after redeliveries: %r"
+            % (h.running_task,)
+        )
+    return {"arm": "cases", "point": "dispatch-cas-duplicate",
+            "ok": not problems, "problems": problems}
+
+
+def retry_jitter_spread_case() -> dict:
+    """The agent transport's full-jitter backoff must SPREAD a
+    correlated retry wave: across a simulated parked fleet, first-retry
+    pauses must span most of [0, base] instead of clustering in the
+    band-jitter corner — and be replayable from the rng seed."""
+    import random
+
+    from evergreen_tpu.agent.rest_comm import RestCommunicator
+
+    problems: List[str] = []
+    policy = RestCommunicator("http://127.0.0.1:1").policy
+    if not policy.full_jitter:
+        problems.append("agent transport policy is not full-jitter")
+    pauses = [
+        policy.backoff_s(0, random.Random(1000 + i)) for i in range(64)
+    ]
+    base = policy.base_backoff_s
+    if not all(0.0 <= p <= base for p in pauses):
+        problems.append("a full-jitter pause escaped [0, base]")
+    spread = max(pauses) - min(pauses)
+    if spread < 0.5 * base:
+        problems.append(
+            "fleet retry pauses did not spread: span %.4f of base %.4f"
+            % (spread, base)
+        )
+    # the band-jitter default would keep every pause above base/2;
+    # full jitter must reach the low half or the fleet still storms
+    if min(pauses) >= 0.5 * base:
+        problems.append(
+            "no pause landed in [0, base/2): the wave stays "
+            "synchronized"
+        )
+    replay = [
+        policy.backoff_s(0, random.Random(1000 + i)) for i in range(64)
+    ]
+    if replay != pauses:
+        problems.append("jitter schedule is not seed-replayable")
+    return {"arm": "cases", "point": "retry-full-jitter-spread",
+            "ok": not problems, "problems": problems}
+
+
+def run_cases() -> List[dict]:
+    results = []
+    for fn in (wait_reply_reorder_case, wait_reply_duplicate_error_case,
+               sock_adopt_refused_case, sock_adopt_halfopen_case,
+               dispatch_cas_duplicate_case, retry_jitter_spread_case):
+        results.append(_emit(fn()))
+    return results
+
+
+# ----------------------------------------------------------------- fuzz arm
+
+def run_fuzz_reachability(want: int = 3,
+                          max_probe: int = 200) -> List[dict]:
+    """The weather fuzzer must actually draw ``net_fault`` events (the
+    vocabulary is reachable, not dead), drawn cases must run green, and
+    a sabotaged net timeline must shrink to a minimal reproduction that
+    replays deterministically."""
+    from evergreen_tpu.scenarios import fuzz as fuzz_mod
+
+    results = []
+    found = []
+    for seed in range(fuzz_mod.DEFAULT_CAMPAIGN_SEED,
+                      fuzz_mod.DEFAULT_CAMPAIGN_SEED + max_probe):
+        spec = fuzz_mod.generate_weather(seed)
+        if any(e.kind == "net_fault" for e in spec.events):
+            found.append((seed, spec))
+            if len(found) >= want:
+                break
+    if len(found) < want:
+        return [_emit({
+            "arm": "fuzz", "point": "reachability", "ok": False,
+            "problems": [
+                "only %d/%d probed weathers drew a net_fault in %d "
+                "seeds" % (len(found), want, max_probe)
+            ],
+        })]
+    for seed, spec in found:
+        entry = fuzz_mod.run_case(spec)
+        results.append(_emit(_entry_result(
+            "fuzz", "w%d" % seed, entry
+        )))
+    results.append(_emit(shrunk_net_timeline_case(found[0][0])))
+    return results
+
+
+def shrunk_net_timeline_case(seed: int) -> dict:
+    """Plant a sabotage into a weather that drew a net_fault, shrink
+    the red timeline, and prove the shrunk reproduction (a) still
+    carries the violation and (b) replays fingerprint-identically —
+    the fuzzer's net_fault vocabulary round-trips through the whole
+    shrink/replay pipeline."""
+    import dataclasses
+
+    from evergreen_tpu.scenarios import fuzz as fuzz_mod
+    from evergreen_tpu.scenarios.library import _sabotage_duplicate_claim
+    from evergreen_tpu.scenarios.spec import Ev
+
+    problems: List[str] = []
+    base = fuzz_mod.generate_weather(seed)
+    net_evs = [e for e in base.events if e.kind == "net_fault"]
+    if not net_evs:
+        return {"arm": "fuzz", "point": "shrunk-net-timeline",
+                "ok": False,
+                "problems": ["seed %d drew no net_fault" % seed]}
+    sab_tick = max(1, net_evs[0].tick)
+    spec = dataclasses.replace(
+        base,
+        name="%s-net-sab" % base.name,
+        events=list(base.events) + [
+            Ev(sab_tick, "call", {"fn": _sabotage_duplicate_claim})
+        ],
+    )
+    entry = fuzz_mod.run_case(spec)
+    if entry["ok"]:
+        problems.append("the sabotaged net timeline was not caught")
+        return {"arm": "fuzz", "point": "shrunk-net-timeline",
+                "ok": False, "problems": problems}
+    red = fuzz_mod.red_keys(entry)
+    minimal = fuzz_mod.shrink_spec(
+        spec, fails=fuzz_mod.fails_matching(red), max_runs=60,
+    )
+    e1 = fuzz_mod.run_case(minimal)
+    e2 = fuzz_mod.run_case(minimal)
+    if not (set(red) & set(fuzz_mod.red_keys(e1))):
+        problems.append(
+            "the shrunk timeline lost the original violation"
+        )
+    f1 = e1.get("fingerprint")
+    if not f1 or f1 != e2.get("fingerprint"):
+        problems.append(
+            "the shrunk net timeline did not replay "
+            "deterministically: %r != %r" % (f1, e2.get("fingerprint"))
+        )
+    return {"arm": "fuzz", "point": "shrunk-net-timeline",
+            "ok": not problems, "problems": problems,
+            "shrunk_events": len(minimal.events),
+            "shrunk_ticks": minimal.ticks}
+
+
+# -------------------------------------------------------------------- main
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="network-chaos partition matrix"
+    )
+    parser.add_argument("--grid-only", action="store_true")
+    parser.add_argument("--weathers-only", action="store_true")
+    parser.add_argument("--cases-only", action="store_true")
+    parser.add_argument("--fuzz-only", action="store_true")
+    parser.add_argument(
+        "--point", default=None,
+        help="run one proc grid point: config:seam:kind "
+             "(e.g. fleet2:ipc.send.0:partition)",
+    )
+    args = parser.parse_args(argv)
+
+    selected = [args.grid_only, args.weathers_only, args.cases_only,
+                args.fuzz_only]
+    run_all = not any(selected)
+
+    results: List[dict] = []
+    # the self-test gates EVERYTHING: a matrix that cannot convict a
+    # planted violation must not certify a single point
+    if args.point is None:
+        results.extend(run_sabotage())
+        if not results[-1]["ok"]:
+            print(json.dumps({
+                "net_matrix_points": len(results),
+                "net_matrix_failures": 1,
+                "failed": [results[-1]["point"]],
+                "aborted": "sabotage self-test failed",
+            }), flush=True)
+            return 1
+    if args.point is not None:
+        results.extend(run_grid(only_point=args.point))
+    else:
+        if run_all or args.grid_only:
+            results.extend(run_grid())
+        if run_all or args.weathers_only:
+            results.extend(run_weathers())
+        if run_all or args.cases_only:
+            results.extend(run_cases())
+        if run_all or args.fuzz_only:
+            results.extend(run_fuzz_reachability())
+
+    failures = [r for r in results if not r["ok"]]
+    print(json.dumps({
+        "net_matrix_points": len(results),
+        "net_matrix_failures": len(failures),
+        "failed": [r["point"] for r in failures],
+    }), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
